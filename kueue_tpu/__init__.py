@@ -1,0 +1,32 @@
+"""kueue_tpu: a TPU-native quota-admission framework.
+
+A ground-up rebuild of the capabilities of Kueue (the Kubernetes-native job
+queueing controller, reference snapshot ~v0.6.1): quota-based admission of
+batch workloads across ResourceFlavors, ClusterQueues and cohorts, with
+borrowing/lending limits, StrictFIFO/BestEffortFIFO queueing, priority
+preemption, flavor fungibility and partial admission.
+
+The design is TPU-first, not a port: a host-side control plane keeps the
+reference's admission semantics (queue manager, cache, lifecycle
+controllers), while the per-tick hot path -- flavor assignment and
+preemption-victim search over the pending-Workload x ClusterQueue x
+ResourceFlavor state -- is encoded as dense integer tensors
+(`kueue_tpu.solver.schema`) and solved as one batched JAX/XLA program
+(`kueue_tpu.models.flavor_fit`) that runs on every workload at once instead
+of the reference's sequential per-head loop
+(reference: pkg/scheduler/scheduler.go:174-288).
+
+Package layout:
+  api/         object model (ResourceFlavor, ClusterQueue, Workload, ...)
+  core/        workload resource math, admitted-state cache, snapshots
+  queue/       pending-state queue manager (FIFO heaps, inadmissible parking)
+  solver/      dense tensor schema + sequential referee solver
+  models/      batched JAX solver models (flavor-fit, preemption, fair share)
+  ops/         reusable masked/segment kernels used by the models
+  parallel/    device-mesh sharding of the solve
+  scheduler/   the scheduling tick orchestration
+  controllers/ in-memory API store + lifecycle reconcilers + jobframework
+  utils/       generic helpers (keyed heap, backoff)
+"""
+
+__version__ = "0.1.0"

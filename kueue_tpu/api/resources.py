@@ -1,0 +1,95 @@
+"""Integer resource arithmetic.
+
+All quota math in the framework is integer, in canonical units per resource
+(reference: pkg/workload/workload.go:245-296):
+
+  * ``cpu``               -> milliCPU
+  * everything else       -> absolute units (bytes for memory, count for pods/GPUs)
+
+Quantities may be given as Kubernetes-style strings ("500m", "10Gi", "2k"),
+ints, or floats; they are converted once at the API boundary and never again.
+"""
+
+from __future__ import annotations
+
+import math
+import re
+from typing import Union
+
+Quantity = Union[int, float, str]
+
+CPU = "cpu"
+MEMORY = "memory"
+PODS = "pods"
+EPHEMERAL_STORAGE = "ephemeral-storage"
+
+_BINARY_SUFFIXES = {
+    "Ki": 1024,
+    "Mi": 1024**2,
+    "Gi": 1024**3,
+    "Ti": 1024**4,
+    "Pi": 1024**5,
+    "Ei": 1024**6,
+}
+_DECIMAL_SUFFIXES = {
+    "n": 10**-9,
+    "u": 10**-6,
+    "m": 10**-3,
+    "": 1,
+    "k": 10**3,
+    "M": 10**6,
+    "G": 10**9,
+    "T": 10**12,
+    "P": 10**15,
+    "E": 10**18,
+}
+
+_QUANTITY_RE = re.compile(
+    r"^(?P<sign>[+-]?)(?P<num>\d+(?:\.\d*)?|\.\d+)(?P<suffix>Ki|Mi|Gi|Ti|Pi|Ei|[numkMGTPE]?)$"
+)
+
+
+def parse_quantity(q: Quantity) -> float:
+    """Parse a Kubernetes-style quantity into a plain number of base units."""
+    if isinstance(q, (int, float)):
+        return float(q)
+    s = q.strip()
+    m = _QUANTITY_RE.match(s)
+    if not m:
+        raise ValueError(f"invalid quantity {q!r}")
+    num = float(m.group("num"))
+    suffix = m.group("suffix")
+    if suffix in _BINARY_SUFFIXES:
+        mult = _BINARY_SUFFIXES[suffix]
+    else:
+        mult = _DECIMAL_SUFFIXES[suffix]
+    val = num * mult
+    if m.group("sign") == "-":
+        val = -val
+    return val
+
+
+def resource_value(name: str, q: Quantity) -> int:
+    """Integer value of a quantity for a resource: milli-units for cpu,
+    absolute (rounded-up) units for everything else.
+
+    Mirrors workload.ResourceValue (reference: pkg/workload/workload.go:263-269).
+    """
+    v = parse_quantity(q)
+    if name == CPU:
+        return int(math.ceil(v * 1000))
+    return int(math.ceil(v))
+
+
+def format_quantity(name: str, v: int) -> str:
+    """Human-readable rendering of an integer resource value (for messages)."""
+    if name == CPU:
+        if v % 1000 == 0:
+            return str(v // 1000)
+        return f"{v}m"
+    if name in (MEMORY, EPHEMERAL_STORAGE) or name.startswith("hugepages-"):
+        for suffix in ("Ei", "Pi", "Ti", "Gi", "Mi", "Ki"):
+            unit = _BINARY_SUFFIXES[suffix]
+            if v != 0 and v % unit == 0:
+                return f"{v // unit}{suffix}"
+    return str(v)

@@ -1,0 +1,469 @@
+"""Object model: the framework's counterpart of the reference CRDs.
+
+These are plain Python dataclasses, not Kubernetes objects: the framework can
+be embedded in-process (tests, bench) or fronted by any API layer
+(`kueue_tpu.controllers.store` provides a watchable in-memory store).
+
+Reference parity:
+  ResourceFlavor        apis/kueue/v1beta1/resourceflavor_types.go
+  ClusterQueue          apis/kueue/v1beta1/clusterqueue_types.go
+  LocalQueue            apis/kueue/v1beta1/localqueue_types.go
+  Workload/PodSet       apis/kueue/v1beta1/workload_types.go
+  WorkloadPriorityClass apis/kueue/v1beta1/workloadpriorityclass_types.go
+
+All resource values are canonical integers (see api/resources.py).
+"""
+
+from __future__ import annotations
+
+import itertools
+import time as _time
+from dataclasses import dataclass, field
+from enum import IntEnum
+from typing import Dict, List, Mapping, Optional, Sequence, Tuple
+
+from kueue_tpu.api.resources import Quantity, resource_value
+
+# ---------------------------------------------------------------------------
+# Enums / policies
+# ---------------------------------------------------------------------------
+
+
+class QueueingStrategy:
+    STRICT_FIFO = "StrictFIFO"
+    BEST_EFFORT_FIFO = "BestEffortFIFO"
+
+
+class PreemptionPolicy:
+    NEVER = "Never"
+    LOWER_PRIORITY = "LowerPriority"
+    LOWER_OR_NEWER_EQUAL_PRIORITY = "LowerOrNewerEqualPriority"
+    ANY = "Any"
+
+
+class BorrowWithinCohortPolicy:
+    NEVER = "Never"
+    LOWER_PRIORITY = "LowerPriority"
+
+
+class FlavorFungibilityPolicy:
+    BORROW = "Borrow"
+    PREEMPT = "Preempt"
+    TRY_NEXT_FLAVOR = "TryNextFlavor"
+
+
+class StopPolicy:
+    NONE = "None"
+    HOLD = "Hold"
+    HOLD_AND_DRAIN = "HoldAndDrain"
+
+
+@dataclass(frozen=True)
+class BorrowWithinCohort:
+    """reference: apis/kueue/v1beta1/clusterqueue_types.go (BorrowWithinCohort)."""
+
+    policy: str = BorrowWithinCohortPolicy.NEVER
+    max_priority_threshold: Optional[int] = None
+
+
+@dataclass(frozen=True)
+class ClusterQueuePreemption:
+    """reference: apis/kueue/v1beta1/clusterqueue_types.go (ClusterQueuePreemption)."""
+
+    within_cluster_queue: str = PreemptionPolicy.NEVER
+    reclaim_within_cohort: str = PreemptionPolicy.NEVER
+    borrow_within_cohort: Optional[BorrowWithinCohort] = None
+
+
+@dataclass(frozen=True)
+class FlavorFungibility:
+    """Defaults mirror the reference (pkg/cache/clusterqueue.go:174)."""
+
+    when_can_borrow: str = FlavorFungibilityPolicy.BORROW
+    when_can_preempt: str = FlavorFungibilityPolicy.TRY_NEXT_FLAVOR
+
+
+# ---------------------------------------------------------------------------
+# Label / node selection (host-side string world)
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class MatchExpression:
+    """A label/node-selector requirement (k8s NodeSelectorRequirement subset)."""
+
+    key: str
+    operator: str  # In | NotIn | Exists | DoesNotExist | Gt | Lt
+    values: Tuple[str, ...] = ()
+
+    def matches(self, labels: Mapping[str, str]) -> bool:
+        has = self.key in labels
+        val = labels.get(self.key)
+        if self.operator == "In":
+            return has and val in self.values
+        if self.operator == "NotIn":
+            return not has or val not in self.values
+        if self.operator == "Exists":
+            return has
+        if self.operator == "DoesNotExist":
+            return not has
+        if self.operator == "Gt":
+            return has and _is_int(val) and int(val) > int(self.values[0])
+        if self.operator == "Lt":
+            return has and _is_int(val) and int(val) < int(self.values[0])
+        raise ValueError(f"unknown operator {self.operator}")
+
+
+def _is_int(s: Optional[str]) -> bool:
+    if s is None:
+        return False
+    try:
+        int(s)
+        return True
+    except ValueError:
+        return False
+
+
+@dataclass(frozen=True)
+class LabelSelector:
+    """k8s metav1.LabelSelector subset; empty selector matches everything."""
+
+    match_labels: Tuple[Tuple[str, str], ...] = ()
+    match_expressions: Tuple[MatchExpression, ...] = ()
+
+    @staticmethod
+    def everything() -> "LabelSelector":
+        return LabelSelector()
+
+    @staticmethod
+    def nothing() -> "LabelSelector":
+        return LabelSelector(match_expressions=(MatchExpression("__none__", "In", ()),))
+
+    @staticmethod
+    def of(**labels: str) -> "LabelSelector":
+        return LabelSelector(match_labels=tuple(sorted(labels.items())))
+
+    def matches(self, labels: Mapping[str, str]) -> bool:
+        for k, v in self.match_labels:
+            if labels.get(k) != v:
+                return False
+        return all(e.matches(labels) for e in self.match_expressions)
+
+
+@dataclass(frozen=True)
+class Taint:
+    key: str
+    value: str = ""
+    effect: str = "NoSchedule"  # NoSchedule | PreferNoSchedule | NoExecute
+
+
+@dataclass(frozen=True)
+class Toleration:
+    key: str = ""
+    operator: str = "Equal"  # Equal | Exists
+    value: str = ""
+    effect: str = ""  # empty tolerates all effects
+
+    def tolerates(self, taint: Taint) -> bool:
+        if self.effect and self.effect != taint.effect:
+            return False
+        if self.key == "":
+            # Empty key with Exists tolerates everything.
+            return self.operator == "Exists"
+        if self.key != taint.key:
+            return False
+        if self.operator == "Exists":
+            return True
+        return self.value == taint.value
+
+
+# ---------------------------------------------------------------------------
+# ResourceFlavor
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class ResourceFlavor:
+    name: str
+    node_labels: Tuple[Tuple[str, str], ...] = ()
+    node_taints: Tuple[Taint, ...] = ()
+    tolerations: Tuple[Toleration, ...] = ()
+
+    @staticmethod
+    def make(name: str, node_labels: Optional[Mapping[str, str]] = None,
+             node_taints: Sequence[Taint] = (),
+             tolerations: Sequence[Toleration] = ()) -> "ResourceFlavor":
+        return ResourceFlavor(
+            name=name,
+            node_labels=tuple(sorted((node_labels or {}).items())),
+            node_taints=tuple(node_taints),
+            tolerations=tuple(tolerations),
+        )
+
+    @property
+    def labels_dict(self) -> Dict[str, str]:
+        return dict(self.node_labels)
+
+
+# ---------------------------------------------------------------------------
+# ClusterQueue quotas
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class ResourceQuota:
+    """Integer quota for one (flavor, resource); canonical units.
+
+    reference: pkg/cache/clusterqueue.go:106-110 (ResourceQuota).
+    """
+
+    nominal: int
+    borrowing_limit: Optional[int] = None
+    lending_limit: Optional[int] = None
+
+    @staticmethod
+    def make(name: str, nominal: Quantity, borrowing_limit: Optional[Quantity] = None,
+             lending_limit: Optional[Quantity] = None) -> "ResourceQuota":
+        return ResourceQuota(
+            nominal=resource_value(name, nominal),
+            borrowing_limit=None if borrowing_limit is None else resource_value(name, borrowing_limit),
+            lending_limit=None if lending_limit is None else resource_value(name, lending_limit),
+        )
+
+
+@dataclass(frozen=True)
+class FlavorQuotas:
+    name: str  # flavor name
+    resources: Tuple[Tuple[str, ResourceQuota], ...]  # ordered (resource -> quota)
+
+    @staticmethod
+    def make(name: str, **quotas: "Quantity | Tuple") -> "FlavorQuotas":
+        """FlavorQuotas.make("on-demand", cpu=10, memory="10Gi",
+        gpu=(4, 2) )  # (nominal, borrowingLimit) or (nominal, borrow, lend)
+        """
+        res = []
+        for rname, spec in quotas.items():
+            rname = rname.replace("_", "-")
+            if isinstance(spec, tuple):
+                res.append((rname, ResourceQuota.make(rname, *spec)))
+            else:
+                res.append((rname, ResourceQuota.make(rname, spec)))
+        return FlavorQuotas(name=name, resources=tuple(res))
+
+    @property
+    def resources_dict(self) -> Dict[str, ResourceQuota]:
+        return dict(self.resources)
+
+
+@dataclass(frozen=True)
+class ResourceGroup:
+    """An ordered list of flavors covering a set of resources.
+
+    Flavor order is the preference order tried by the assigner
+    (reference: apis/kueue/v1beta1/clusterqueue_types.go ResourceGroup).
+    """
+
+    covered_resources: Tuple[str, ...]
+    flavors: Tuple[FlavorQuotas, ...]
+
+
+@dataclass
+class ClusterQueue:
+    name: str
+    resource_groups: Tuple[ResourceGroup, ...] = ()
+    cohort: str = ""
+    queueing_strategy: str = QueueingStrategy.BEST_EFFORT_FIFO
+    namespace_selector: LabelSelector = field(default_factory=LabelSelector.everything)
+    preemption: ClusterQueuePreemption = field(default_factory=ClusterQueuePreemption)
+    flavor_fungibility: FlavorFungibility = field(default_factory=FlavorFungibility)
+    admission_checks: Tuple[str, ...] = ()
+    stop_policy: str = StopPolicy.NONE
+
+
+@dataclass
+class LocalQueue:
+    name: str
+    namespace: str
+    cluster_queue: str
+
+    @property
+    def key(self) -> str:
+        return f"{self.namespace}/{self.name}"
+
+
+@dataclass(frozen=True)
+class WorkloadPriorityClass:
+    name: str
+    value: int
+
+
+# ---------------------------------------------------------------------------
+# Workload
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class PodSet:
+    """A homogeneous set of pods in a Workload.
+
+    `requests` are per-pod; canonical integers are computed on construction.
+    reference: apis/kueue/v1beta1/workload_types.go:110-147.
+    """
+
+    name: str
+    count: int
+    requests: Dict[str, int] = field(default_factory=dict)
+    min_count: Optional[int] = None  # enables partial admission when set
+    node_selector: Tuple[Tuple[str, str], ...] = ()
+    # Required node-affinity terms: OR of terms, each term an AND of expressions.
+    affinity_terms: Tuple[Tuple[MatchExpression, ...], ...] = ()
+    tolerations: Tuple[Toleration, ...] = ()
+
+    @staticmethod
+    def make(name: str, count: int, min_count: Optional[int] = None,
+             node_selector: Optional[Mapping[str, str]] = None,
+             affinity_terms: Sequence[Sequence[MatchExpression]] = (),
+             tolerations: Sequence[Toleration] = (),
+             **requests: Quantity) -> "PodSet":
+        reqs = {r.replace("_", "-"): resource_value(r.replace("_", "-"), q)
+                for r, q in requests.items()}
+        return PodSet(
+            name=name, count=count, requests=reqs, min_count=min_count,
+            node_selector=tuple(sorted((node_selector or {}).items())),
+            affinity_terms=tuple(tuple(t) for t in affinity_terms),
+            tolerations=tuple(tolerations),
+        )
+
+
+# Condition types (reference: apis/kueue/v1beta1/workload_types.go conditions)
+CONDITION_QUOTA_RESERVED = "QuotaReserved"
+CONDITION_ADMITTED = "Admitted"
+CONDITION_EVICTED = "Evicted"
+CONDITION_FINISHED = "Finished"
+CONDITION_PODS_READY = "PodsReady"
+
+# Eviction reasons
+EVICTED_BY_PREEMPTION = "Preempted"
+EVICTED_BY_PODS_READY_TIMEOUT = "PodsReadyTimeout"
+EVICTED_BY_ADMISSION_CHECK = "AdmissionCheck"
+EVICTED_BY_CLUSTER_QUEUE_STOPPED = "ClusterQueueStopped"
+EVICTED_BY_DEACTIVATION = "InactiveWorkload"
+
+
+@dataclass
+class Condition:
+    type: str
+    status: bool
+    reason: str = ""
+    message: str = ""
+    last_transition_time: float = 0.0
+
+
+@dataclass
+class PodSetAssignment:
+    name: str
+    flavors: Dict[str, str]  # resource -> flavor name
+    resource_usage: Dict[str, int]  # per-pod-set totals
+    count: int
+
+
+@dataclass
+class Admission:
+    cluster_queue: str
+    pod_set_assignments: List[PodSetAssignment] = field(default_factory=list)
+
+
+@dataclass
+class AdmissionCheckState:
+    name: str
+    state: str  # Pending | Ready | Retry | Rejected
+    message: str = ""
+    pod_set_updates: List[dict] = field(default_factory=list)
+
+
+@dataclass
+class RequeueState:
+    count: int = 0
+    requeue_at: Optional[float] = None
+
+
+_uid_counter = itertools.count(1)
+
+
+@dataclass
+class Workload:
+    name: str
+    namespace: str = "default"
+    queue_name: str = ""  # LocalQueue name
+    pod_sets: List[PodSet] = field(default_factory=list)
+    priority: int = 0
+    priority_class: str = ""
+    priority_class_source: str = ""  # "kueue.x-k8s.io/workloadpriorityclass" or pod PC
+    creation_time: float = field(default_factory=_time.time)
+    uid: str = ""
+    active: bool = True
+
+    # Status
+    conditions: List[Condition] = field(default_factory=list)
+    admission: Optional[Admission] = None
+    reclaimable_pods: Dict[str, int] = field(default_factory=dict)  # podset name -> count
+    admission_check_states: Dict[str, AdmissionCheckState] = field(default_factory=dict)
+    requeue_state: Optional[RequeueState] = None
+
+    def __post_init__(self) -> None:
+        if not self.uid:
+            self.uid = f"uid-{next(_uid_counter):08d}"
+
+    # -- condition helpers (reference: pkg/workload/workload.go:369-505) ----
+
+    @property
+    def key(self) -> str:
+        return f"{self.namespace}/{self.name}"
+
+    def find_condition(self, ctype: str) -> Optional[Condition]:
+        for c in self.conditions:
+            if c.type == ctype:
+                return c
+        return None
+
+    def condition_true(self, ctype: str) -> bool:
+        c = self.find_condition(ctype)
+        return c is not None and c.status
+
+    def set_condition(self, ctype: str, status: bool, reason: str = "",
+                      message: str = "", now: Optional[float] = None) -> None:
+        now = _time.time() if now is None else now
+        c = self.find_condition(ctype)
+        if c is None:
+            self.conditions.append(
+                Condition(ctype, status, reason, message, last_transition_time=now))
+        else:
+            if c.status != status:
+                c.last_transition_time = now
+            c.status, c.reason, c.message = status, reason, message
+
+    @property
+    def has_quota_reservation(self) -> bool:
+        return self.condition_true(CONDITION_QUOTA_RESERVED)
+
+    @property
+    def is_admitted(self) -> bool:
+        return self.condition_true(CONDITION_ADMITTED)
+
+    @property
+    def is_evicted(self) -> bool:
+        return self.condition_true(CONDITION_EVICTED)
+
+    @property
+    def is_finished(self) -> bool:
+        return self.condition_true(CONDITION_FINISHED)
+
+    def quota_reserved_time(self, now: float) -> float:
+        c = self.find_condition(CONDITION_QUOTA_RESERVED)
+        if c is None or not c.status:
+            return now
+        return c.last_transition_time
+
+    def can_be_partially_admitted(self) -> bool:
+        return any(ps.min_count is not None and ps.min_count < ps.count
+                   for ps in self.pod_sets)

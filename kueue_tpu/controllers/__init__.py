@@ -1,0 +1,3 @@
+"""Lifecycle plumbing: the in-memory API runtime and reconciler logic."""
+
+from kueue_tpu.controllers.runtime import Framework

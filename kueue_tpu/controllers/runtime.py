@@ -1,0 +1,189 @@
+"""The in-process runtime: wires queue manager, cache and scheduler together
+and applies workload lifecycle transitions.
+
+This is the counterpart of the reference's controller wiring
+(cmd/kueue/main.go + pkg/controller/core/): object writes feed the pending
+queues and the admitted cache, the scheduler tick admits/preempts, and the
+reconciler pass (`reconcile()`) applies the follow-on transitions that the
+reference performs asynchronously through watch events
+(core/workload_controller.go): evicted workloads release quota and requeue,
+finished workloads release quota, admission-check state flips workloads from
+QuotaReserved to Admitted.
+
+Being an in-memory, synchronous analog of envtest, it is also the test
+fixture for integration-style tests.
+"""
+
+from __future__ import annotations
+
+import time as _time
+from typing import Callable, Dict, List, Optional
+
+from kueue_tpu.api.types import (
+    CONDITION_ADMITTED,
+    CONDITION_EVICTED,
+    CONDITION_FINISHED,
+    CONDITION_QUOTA_RESERVED,
+    ClusterQueue,
+    LocalQueue,
+    ResourceFlavor,
+    Workload,
+)
+from kueue_tpu.core.cache import Cache
+from kueue_tpu.core.workload import WorkloadInfo, WorkloadOrdering
+from kueue_tpu.queue.manager import Manager, RequeueReason
+from kueue_tpu.scheduler.scheduler import Scheduler
+
+
+class Framework:
+    def __init__(self, batch_solver=None,
+                 ordering: Optional[WorkloadOrdering] = None,
+                 clock: Callable[[], float] = _time.time):
+        self.clock = clock
+        self.ordering = ordering or WorkloadOrdering()
+        self.namespaces: Dict[str, Dict[str, str]] = {"default": {}}
+        self.workloads: Dict[str, Workload] = {}
+        self.cache = Cache()
+        self.queues = Manager(ordering=self.ordering,
+                              namespace_lister=self.namespaces.get,
+                              clock=clock)
+        self.scheduler = Scheduler(
+            queues=self.queues, cache=self.cache,
+            apply_admission=self._apply_admission,
+            apply_preemption=self._apply_preemption,
+            namespace_lister=self.namespaces.get,
+            batch_solver=batch_solver,
+            ordering=self.ordering, clock=clock)
+        self._evicted_dirty: List[Workload] = []
+
+    # -- admin objects -------------------------------------------------------
+
+    def create_namespace(self, name: str, labels: Optional[Dict[str, str]] = None) -> None:
+        self.namespaces[name] = labels or {}
+
+    def create_resource_flavor(self, flavor: ResourceFlavor) -> None:
+        self.cache.add_or_update_resource_flavor(flavor)
+        # Requeue CQs that reference this flavor (the ResourceFlavor
+        # reconciler's job in the reference, cache.go:712-723).
+        using = [
+            cq.name for cq in self.cache.cluster_queues.values()
+            if any(fq.name == flavor.name
+                   for rg in cq.resource_groups for fq in rg.flavors)
+        ]
+        if using:
+            self.queues.queue_inadmissible_workloads(using)
+
+    def create_cluster_queue(self, spec: ClusterQueue) -> None:
+        self.cache.add_cluster_queue(spec)
+        self.queues.add_cluster_queue(spec, pending=list(self.workloads.values()))
+
+    def update_cluster_queue(self, spec: ClusterQueue) -> None:
+        self.cache.update_cluster_queue(spec)
+        self.queues.update_cluster_queue(spec)
+
+    def create_local_queue(self, lq: LocalQueue) -> None:
+        self.cache.add_local_queue(lq)
+        self.queues.add_local_queue(lq, pending=list(self.workloads.values()))
+
+    # -- workload lifecycle --------------------------------------------------
+
+    def submit(self, wl: Workload) -> None:
+        """A new pending workload enters the system."""
+        self.workloads[wl.key] = wl
+        self.queues.add_or_update_workload(wl)
+
+    def finish(self, wl: Workload) -> None:
+        """Mark a workload Finished and release its quota
+        (core/workload_controller.go finished handling)."""
+        wl.set_condition(CONDITION_FINISHED, True, reason="JobFinished",
+                         now=self.clock())
+        self.cache.delete_workload(wl)
+        self.queues.delete_workload(wl)
+        self.queues.queue_associated_inadmissible_workloads(wl)
+
+    def delete_workload(self, wl: Workload) -> None:
+        self.workloads.pop(wl.key, None)
+        self.cache.delete_workload(wl)
+        self.queues.delete_workload(wl)
+        self.queues.queue_associated_inadmissible_workloads(wl)
+
+    def set_admission_check_state(self, wl: Workload, check: str, state: str,
+                                  message: str = "") -> None:
+        from kueue_tpu.api.types import AdmissionCheckState
+        wl.admission_check_states[check] = AdmissionCheckState(
+            name=check, state=state, message=message)
+
+    # -- scheduler callbacks -------------------------------------------------
+
+    def _apply_admission(self, wl: Workload) -> bool:
+        # The API write is in-memory: nothing can fail here.
+        return True
+
+    def _apply_preemption(self, wl: Workload, message: str) -> None:
+        wl.set_condition(CONDITION_EVICTED, True, reason="Preempted",
+                         message=message, now=self.clock())
+        self._evicted_dirty.append(wl)
+
+    # -- reconcile pass ------------------------------------------------------
+
+    def reconcile(self) -> None:
+        """Apply async lifecycle transitions (workload_controller.go analog)."""
+        evicted, self._evicted_dirty = self._evicted_dirty, []
+        for wl in evicted:
+            if wl.has_quota_reservation:
+                self.cache.delete_workload(wl)
+                wl.admission = None
+                wl.set_condition(CONDITION_QUOTA_RESERVED, False,
+                                 reason="Evicted", now=self.clock())
+                wl.set_condition(CONDITION_ADMITTED, False, reason="Evicted",
+                                 now=self.clock())
+                self.queues.queue_associated_inadmissible_workloads(wl)
+            self.queues.add_or_update_workload(wl)
+        # Two-phase admission: flip Admitted once every check is Ready
+        # (workload_controller.go:175-184).
+        for wl in self.workloads.values():
+            if not wl.has_quota_reservation or wl.is_admitted or wl.admission is None:
+                continue
+            cq = self.cache.cluster_queues.get(wl.admission.cluster_queue)
+            if cq is None:
+                continue
+            checks = cq.admission_checks
+            if checks and all(
+                    wl.admission_check_states.get(c) is not None
+                    and wl.admission_check_states[c].state == "Ready"
+                    for c in checks):
+                wl.set_condition(CONDITION_ADMITTED, True, reason="Admitted",
+                                 now=self.clock())
+                self.cache.add_or_update_workload(wl)
+
+    # -- driving -------------------------------------------------------------
+
+    def tick(self) -> int:
+        """One scheduling cycle plus the reconcile pass; returns admissions."""
+        admitted = self.scheduler.schedule(timeout=0.0)
+        self.reconcile()
+        return admitted
+
+    def run_until_settled(self, max_ticks: int = 100) -> int:
+        """Tick until no progress is made; returns total admissions."""
+        total = 0
+        idle = 0
+        for _ in range(max_ticks):
+            n = self.tick()
+            total += n
+            if n == 0:
+                idle += 1
+                if idle >= 2:
+                    break
+            else:
+                idle = 0
+        return total
+
+    # -- introspection -------------------------------------------------------
+
+    def admitted_workloads(self, cq_name: str) -> List[str]:
+        cq = self.cache.cluster_queues[cq_name]
+        return sorted(cq.workloads)
+
+    def pending_workloads(self, cq_name: str) -> int:
+        return self.queues.pending(cq_name)

@@ -1,0 +1,10 @@
+"""Core state layer: workload resource model, admitted-state cache, snapshots."""
+
+from kueue_tpu.core.workload import (
+    WorkloadInfo,
+    PodSetResources,
+    AssignmentClusterQueueState,
+    WorkloadOrdering,
+)
+from kueue_tpu.core.cache import Cache, CachedClusterQueue, Cohort
+from kueue_tpu.core.snapshot import Snapshot

@@ -1,0 +1,408 @@
+"""Admitted-workload cache: quota state per ClusterQueue and cohort.
+
+Counterpart of reference pkg/cache/: mirrors workloads holding quota into
+per-ClusterQueue usage maps, supports optimistic assume/forget during
+admission (cache.go:498-546), and produces per-tick snapshots that the
+solver consumes (snapshot.go:95-201). LendingLimit guaranteed-quota math
+follows clusterqueue.go:211-229,583-629.
+
+FlavorResourceQuantities is `{flavor: {resource: int}}` throughout.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Iterable, List, Optional, Set
+
+from kueue_tpu import features
+from kueue_tpu.api.types import (
+    ClusterQueue,
+    ClusterQueuePreemption,
+    FlavorFungibility,
+    LocalQueue,
+    ResourceFlavor,
+    ResourceGroup,
+    StopPolicy,
+    Workload,
+)
+from kueue_tpu.core.workload import WorkloadInfo
+
+FlavorResourceQuantities = Dict[str, Dict[str, int]]
+
+
+def frq_clone(q: FlavorResourceQuantities) -> FlavorResourceQuantities:
+    return {f: dict(r) for f, r in q.items()}
+
+
+def frq_add(dst: FlavorResourceQuantities, src: FlavorResourceQuantities) -> None:
+    for f, res in src.items():
+        d = dst.setdefault(f, {})
+        for r, v in res.items():
+            d[r] = d.get(r, 0) + v
+
+
+class Cohort:
+    """A set of ClusterQueues that can borrow from each other.
+
+    `requestable_resources` / `usage` are populated only on snapshots
+    (reference: pkg/cache/clusterqueue.go:78-90).
+    """
+
+    __slots__ = ("name", "members", "requestable_resources", "usage",
+                 "allocatable_generation")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.members: Set["CachedClusterQueue"] = set()
+        self.requestable_resources: FlavorResourceQuantities = {}
+        self.usage: FlavorResourceQuantities = {}
+        self.allocatable_generation = 0
+
+
+class CachedClusterQueue:
+    """Internal ClusterQueue state (reference: pkg/cache/clusterqueue.go:44-75)."""
+
+    def __init__(self, spec: ClusterQueue,
+                 resource_flavors: Dict[str, ResourceFlavor]):
+        self.name = spec.name
+        self.cohort: Optional[Cohort] = None
+        self.cohort_name = spec.cohort
+        self.resource_groups: List[ResourceGroup] = []
+        self.rg_by_resource: Dict[str, ResourceGroup] = {}
+        self.usage: FlavorResourceQuantities = {}
+        self.admitted_usage: FlavorResourceQuantities = {}
+        self.workloads: Dict[str, WorkloadInfo] = {}
+        self.namespace_selector = spec.namespace_selector
+        self.preemption: ClusterQueuePreemption = ClusterQueuePreemption()
+        self.flavor_fungibility: FlavorFungibility = FlavorFungibility()
+        self.admission_checks: Set[str] = set()
+        self.guaranteed_quota: FlavorResourceQuantities = {}
+        # Bumped when admitted workloads are deleted or resource groups change,
+        # invalidating flavor-search resume state (clusterqueue.go:62-63).
+        self.allocatable_generation = 1
+        self.has_missing_flavors = False
+        self.is_stopped = False
+        self.update(spec, resource_flavors)
+
+    # -- spec mirroring -----------------------------------------------------
+
+    def update(self, spec: ClusterQueue,
+               resource_flavors: Dict[str, ResourceFlavor]) -> None:
+        if [rg for rg in self.resource_groups] != list(spec.resource_groups):
+            self.allocatable_generation += 1
+        self.cohort_name = spec.cohort
+        self.resource_groups = list(spec.resource_groups)
+        self.rg_by_resource = {}
+        for rg in self.resource_groups:
+            for r in rg.covered_resources:
+                self.rg_by_resource[r] = rg
+        self.namespace_selector = spec.namespace_selector
+        self.is_stopped = spec.stop_policy != StopPolicy.NONE
+        self.admission_checks = set(spec.admission_checks)
+        self.preemption = spec.preemption
+        self.flavor_fungibility = spec.flavor_fungibility
+
+        # Prune usage for removed flavors/resources; keep existing counts.
+        new_usage: FlavorResourceQuantities = {}
+        new_admitted: FlavorResourceQuantities = {}
+        for rg in self.resource_groups:
+            for fq in rg.flavors:
+                new_usage[fq.name] = {
+                    r: self.usage.get(fq.name, {}).get(r, 0)
+                    for r, _ in fq.resources
+                }
+                new_admitted[fq.name] = {
+                    r: self.admitted_usage.get(fq.name, {}).get(r, 0)
+                    for r, _ in fq.resources
+                }
+        self.usage = new_usage
+        self.admitted_usage = new_admitted
+
+        self.update_with_flavors(resource_flavors)
+
+        # Guaranteed quota = nominal - lendingLimit when lending enabled
+        # (reference: clusterqueue.go:211-229).
+        self.guaranteed_quota = {}
+        if features.enabled(features.LENDING_LIMIT):
+            for rg in self.resource_groups:
+                for fq in rg.flavors:
+                    for rname, quota in fq.resources:
+                        if quota.lending_limit is not None:
+                            self.guaranteed_quota.setdefault(fq.name, {})[rname] = \
+                                quota.nominal - quota.lending_limit
+
+    def update_with_flavors(self, resource_flavors: Dict[str, ResourceFlavor]) -> None:
+        self.has_missing_flavors = any(
+            fq.name not in resource_flavors
+            for rg in self.resource_groups for fq in rg.flavors)
+
+    def active(self) -> bool:
+        return not self.has_missing_flavors and not self.is_stopped
+
+    # -- label keys per resource group (affinity mask input) ---------------
+
+    def label_keys(self, rg: ResourceGroup,
+                   resource_flavors: Dict[str, ResourceFlavor]) -> Set[str]:
+        keys: Set[str] = set()
+        for fq in rg.flavors:
+            flv = resource_flavors.get(fq.name)
+            if flv is not None:
+                keys.update(k for k, _ in flv.node_labels)
+        return keys
+
+    # -- quota math (reference: clusterqueue.go:583-629) --------------------
+
+    def _guaranteed(self, flavor: str, resource: str) -> int:
+        if not features.enabled(features.LENDING_LIMIT):
+            return 0
+        return self.guaranteed_quota.get(flavor, {}).get(resource, 0)
+
+    def requestable_cohort_quota(self, flavor: str, resource: str) -> int:
+        """Total quota requestable by this CQ in its cohort; includes own
+        guaranteed (non-lendable) quota when LendingLimit is enabled."""
+        assert self.cohort is not None
+        avail = self.cohort.requestable_resources.get(flavor, {}).get(resource, 0)
+        return avail + self._guaranteed(flavor, resource)
+
+    def used_cohort_quota(self, flavor: str, resource: str) -> int:
+        assert self.cohort is not None
+        used = self.cohort.usage.get(flavor, {}).get(resource, 0)
+        if features.enabled(features.LENDING_LIMIT):
+            cq_used = self.usage.get(flavor, {}).get(resource, 0)
+            used += min(cq_used, self._guaranteed(flavor, resource))
+        return used
+
+    def fit_in_cohort(self, q: FlavorResourceQuantities) -> bool:
+        """reference: clusterqueue.go:130-144."""
+        for flavor, resources in q.items():
+            if self.cohort is None or flavor not in self.cohort.requestable_resources:
+                return False
+            for resource, value in resources.items():
+                available = (self.requestable_cohort_quota(flavor, resource)
+                             - self.used_cohort_quota(flavor, resource))
+                if available < value:
+                    return False
+        return True
+
+    def is_borrowing(self) -> bool:
+        if self.cohort is None:
+            return False
+        for rg in self.resource_groups:
+            for fq in rg.flavors:
+                fusage = self.usage.get(fq.name)
+                if not fusage:
+                    continue
+                for rname, quota in fq.resources:
+                    if fusage.get(rname, 0) > quota.nominal:
+                        return True
+        return False
+
+    # -- workload usage accounting -----------------------------------------
+
+    def _update_usage(self, wi: WorkloadInfo, usage: FlavorResourceQuantities,
+                      m: int) -> None:
+        # Only (flavor, resource) pairs configured on this CQ are tracked
+        # (reference: clusterqueue.go:473-485).
+        for ps in wi.total_requests:
+            for res, flv in ps.flavors.items():
+                v = ps.requests.get(res)
+                fusage = usage.get(flv)
+                if v is not None and fusage is not None and res in fusage:
+                    fusage[res] += v * m
+
+    def _update_cohort_usage(self, wi: WorkloadInfo, m: int) -> None:
+        """Lending-aware cohort usage delta; must run after _update_usage
+        (reference: clusterqueue.go:487-508)."""
+        assert self.cohort is not None
+        for ps in wi.total_requests:
+            for res, flv in ps.flavors.items():
+                v = ps.requests.get(res)
+                fusage = self.cohort.usage.get(flv)
+                if v is None or fusage is None or res not in fusage:
+                    continue
+                after = self.usage.get(flv, {}).get(res, 0) - self._guaranteed(flv, res)
+                before = after - v * m
+                if before > 0:
+                    fusage[res] -= before
+                if after > 0:
+                    fusage[res] += after
+
+    def add_workload_usage(self, wi: WorkloadInfo, *, cohort_too: bool = False,
+                           admitted: bool = False) -> None:
+        self.workloads[wi.key] = wi
+        self._update_usage(wi, self.usage, 1)
+        if admitted:
+            self._update_usage(wi, self.admitted_usage, 1)
+        if cohort_too and self.cohort is not None:
+            if features.enabled(features.LENDING_LIMIT):
+                self._update_cohort_usage(wi, 1)
+            else:
+                self._update_usage(wi, self.cohort.usage, 1)
+
+    def remove_workload_usage(self, wi: WorkloadInfo, *, cohort_too: bool = False,
+                              admitted: bool = False) -> None:
+        self.workloads.pop(wi.key, None)
+        self._update_usage(wi, self.usage, -1)
+        if admitted:
+            self._update_usage(wi, self.admitted_usage, -1)
+        if cohort_too and self.cohort is not None:
+            if features.enabled(features.LENDING_LIMIT):
+                self._update_cohort_usage(wi, -1)
+            else:
+                self._update_usage(wi, self.cohort.usage, -1)
+
+
+class Cache:
+    """Thread-safe mirror of admitted workloads (reference: pkg/cache/cache.go)."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self.cluster_queues: Dict[str, CachedClusterQueue] = {}
+        self.cohorts: Dict[str, Cohort] = {}
+        self.resource_flavors: Dict[str, ResourceFlavor] = {}
+        self.local_queues: Dict[str, LocalQueue] = {}
+        self.assumed_workloads: Dict[str, str] = {}  # wl key -> cq name
+
+    # -- flavors ------------------------------------------------------------
+
+    def add_or_update_resource_flavor(self, flavor: ResourceFlavor) -> None:
+        with self._lock:
+            self.resource_flavors[flavor.name] = flavor
+            for cq in self.cluster_queues.values():
+                cq.update_with_flavors(self.resource_flavors)
+
+    def delete_resource_flavor(self, name: str) -> None:
+        with self._lock:
+            self.resource_flavors.pop(name, None)
+            for cq in self.cluster_queues.values():
+                cq.update_with_flavors(self.resource_flavors)
+
+    # -- cluster queues ------------------------------------------------------
+
+    def add_cluster_queue(self, spec: ClusterQueue) -> CachedClusterQueue:
+        with self._lock:
+            if spec.name in self.cluster_queues:
+                raise ValueError(f"ClusterQueue {spec.name} already exists")
+            cq = CachedClusterQueue(spec, self.resource_flavors)
+            self.cluster_queues[spec.name] = cq
+            self._update_cohort_membership(cq)
+            return cq
+
+    def update_cluster_queue(self, spec: ClusterQueue) -> None:
+        with self._lock:
+            cq = self.cluster_queues[spec.name]
+            cq.update(spec, self.resource_flavors)
+            self._update_cohort_membership(cq)
+
+    def delete_cluster_queue(self, name: str) -> None:
+        with self._lock:
+            cq = self.cluster_queues.pop(name, None)
+            if cq is None:
+                return
+            if cq.cohort is not None:
+                cq.cohort.members.discard(cq)
+                if not cq.cohort.members:
+                    self.cohorts.pop(cq.cohort.name, None)
+
+    def _update_cohort_membership(self, cq: CachedClusterQueue) -> None:
+        if cq.cohort is not None and cq.cohort.name != cq.cohort_name:
+            cq.cohort.members.discard(cq)
+            if not cq.cohort.members:
+                self.cohorts.pop(cq.cohort.name, None)
+            cq.cohort = None
+        if cq.cohort_name:
+            cohort = self.cohorts.get(cq.cohort_name)
+            if cohort is None:
+                cohort = Cohort(cq.cohort_name)
+                self.cohorts[cq.cohort_name] = cohort
+            cohort.members.add(cq)
+            cq.cohort = cohort
+
+    # -- local queues --------------------------------------------------------
+
+    def add_local_queue(self, lq: LocalQueue) -> None:
+        with self._lock:
+            self.local_queues[lq.key] = lq
+
+    def delete_local_queue(self, lq: LocalQueue) -> None:
+        with self._lock:
+            self.local_queues.pop(lq.key, None)
+
+    def cluster_queue_for(self, wl: Workload) -> Optional[str]:
+        lq = self.local_queues.get(f"{wl.namespace}/{wl.queue_name}")
+        return lq.cluster_queue if lq else None
+
+    # -- workloads (reference: cache.go:330-546) ----------------------------
+
+    def add_or_update_workload(self, wl: Workload) -> bool:
+        with self._lock:
+            if wl.admission is None:
+                return False
+            self._delete_workload_locked(wl)
+            cq = self.cluster_queues.get(wl.admission.cluster_queue)
+            if cq is None:
+                return False
+            wi = WorkloadInfo(wl, cluster_queue=cq.name)
+            cq.add_workload_usage(wi, admitted=wl.is_admitted)
+            return True
+
+    def delete_workload(self, wl: Workload) -> None:
+        with self._lock:
+            self._delete_workload_locked(wl)
+
+    def _delete_workload_locked(self, wl: Workload) -> None:
+        key = wl.key
+        cq_name = self.assumed_workloads.get(key)
+        if cq_name is None and wl.admission is not None:
+            cq_name = wl.admission.cluster_queue
+        if cq_name is None:
+            return
+        cq = self.cluster_queues.get(cq_name)
+        if cq is not None and key in cq.workloads:
+            wi = cq.workloads[key]
+            cq.remove_workload_usage(wi, admitted=wl.is_admitted)
+            # Quota was freed: resume states against this CQ are now stale.
+            cq.allocatable_generation += 1
+        self.assumed_workloads.pop(key, None)
+
+    def assume_workload(self, wl: Workload) -> None:
+        """Optimistically account a just-admitted workload before the API
+        write lands (reference: cache.go:498-524)."""
+        with self._lock:
+            if wl.admission is None:
+                raise ValueError("workload has no admission")
+            key = wl.key
+            if key in self.assumed_workloads:
+                raise ValueError(f"workload {key} already assumed")
+            cq = self.cluster_queues.get(wl.admission.cluster_queue)
+            if cq is None:
+                raise ValueError(f"ClusterQueue {wl.admission.cluster_queue} not found")
+            wi = WorkloadInfo(wl, cluster_queue=cq.name)
+            cq.add_workload_usage(wi, admitted=wl.is_admitted)
+            self.assumed_workloads[key] = cq.name
+
+    def forget_workload(self, wl: Workload) -> None:
+        with self._lock:
+            if wl.key not in self.assumed_workloads:
+                raise ValueError(f"workload {wl.key} is not assumed")
+            self._delete_workload_locked(wl)
+
+    def is_assumed_or_admitted(self, wl: Workload) -> bool:
+        with self._lock:
+            if wl.key in self.assumed_workloads:
+                return True
+            if wl.admission is None:
+                return False
+            cq = self.cluster_queues.get(wl.admission.cluster_queue)
+            return cq is not None and wl.key in cq.workloads
+
+    def usage(self, cq_name: str) -> FlavorResourceQuantities:
+        with self._lock:
+            return frq_clone(self.cluster_queues[cq_name].usage)
+
+    # -- snapshot ------------------------------------------------------------
+
+    def snapshot(self):
+        from kueue_tpu.core.snapshot import Snapshot
+        with self._lock:
+            return Snapshot.build(self)

@@ -1,0 +1,104 @@
+"""Per-tick snapshot of the admitted-state cache.
+
+Counterpart of reference pkg/cache/snapshot.go: deep-copies active
+ClusterQueues, rebuilds cohorts with accumulated requestable resources and
+usage (lending-aware, snapshot.go:160-201), and exposes the
+add/remove-workload simulation primitive used by preemption
+(snapshot.go:41-67).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Set
+
+from kueue_tpu import features
+from kueue_tpu.api.types import ResourceFlavor
+from kueue_tpu.core.cache import (
+    Cache,
+    CachedClusterQueue,
+    Cohort,
+    FlavorResourceQuantities,
+    frq_clone,
+)
+from kueue_tpu.core.workload import WorkloadInfo
+
+
+class Snapshot:
+    __slots__ = ("cluster_queues", "resource_flavors", "inactive_cluster_queues")
+
+    def __init__(self):
+        self.cluster_queues: Dict[str, CachedClusterQueue] = {}
+        self.resource_flavors: Dict[str, ResourceFlavor] = {}
+        self.inactive_cluster_queues: Set[str] = set()
+
+    @staticmethod
+    def build(cache: Cache) -> "Snapshot":
+        snap = Snapshot()
+        snap.resource_flavors = dict(cache.resource_flavors)
+        for name, cq in cache.cluster_queues.items():
+            if not cq.active():
+                snap.inactive_cluster_queues.add(name)
+                continue
+            snap.cluster_queues[name] = _snapshot_cq(cq)
+        for cohort in cache.cohorts.values():
+            cohort_copy = Cohort(cohort.name)
+            for member in cohort.members:
+                if not member.active():
+                    continue
+                cq_copy = snap.cluster_queues[member.name]
+                _accumulate(cq_copy, cohort_copy)
+                cq_copy.cohort = cohort_copy
+                cohort_copy.members.add(cq_copy)
+                cohort_copy.allocatable_generation += cq_copy.allocatable_generation
+        return snap
+
+    # Preemption simulation primitives (reference: snapshot.go:41-67).
+
+    def remove_workload(self, wi: WorkloadInfo) -> None:
+        cq = self.cluster_queues[wi.cluster_queue]
+        cq.remove_workload_usage(wi, cohort_too=True)
+
+    def add_workload(self, wi: WorkloadInfo) -> None:
+        cq = self.cluster_queues[wi.cluster_queue]
+        cq.add_workload_usage(wi, cohort_too=True)
+
+
+def _snapshot_cq(cq: CachedClusterQueue) -> CachedClusterQueue:
+    cc = CachedClusterQueue.__new__(CachedClusterQueue)
+    cc.name = cq.name
+    cc.cohort = None
+    cc.cohort_name = cq.cohort_name
+    cc.resource_groups = cq.resource_groups  # immutable per tick
+    cc.rg_by_resource = cq.rg_by_resource
+    cc.usage = frq_clone(cq.usage)
+    cc.admitted_usage = frq_clone(cq.admitted_usage)
+    cc.workloads = dict(cq.workloads)
+    cc.namespace_selector = cq.namespace_selector
+    cc.preemption = cq.preemption
+    cc.flavor_fungibility = cq.flavor_fungibility
+    cc.admission_checks = set(cq.admission_checks)
+    cc.guaranteed_quota = cq.guaranteed_quota if features.enabled(features.LENDING_LIMIT) else {}
+    cc.allocatable_generation = cq.allocatable_generation
+    cc.has_missing_flavors = cq.has_missing_flavors
+    cc.is_stopped = cq.is_stopped
+    return cc
+
+
+def _accumulate(cq: CachedClusterQueue, cohort: Cohort) -> None:
+    """Fold a member CQ into cohort requestable/usage totals
+    (reference: snapshot.go:160-201 accumulateResources)."""
+    lending = features.enabled(features.LENDING_LIMIT)
+    for rg in cq.resource_groups:
+        for fq in rg.flavors:
+            res = cohort.requestable_resources.setdefault(fq.name, {})
+            for rname, quota in fq.resources:
+                if lending and quota.lending_limit is not None:
+                    res[rname] = res.get(rname, 0) + quota.lending_limit
+                else:
+                    res[rname] = res.get(rname, 0) + quota.nominal
+    for fname, resources in cq.usage.items():
+        used = cohort.usage.setdefault(fname, {})
+        for rname, val in resources.items():
+            if lending:
+                val = max(0, val - cq._guaranteed(fname, rname))
+            used[rname] = used.get(rname, 0) + val

@@ -1,0 +1,169 @@
+"""Workload resource model: per-PodSet integer totals and assignment state.
+
+Counterpart of reference pkg/workload/workload.go: WorkloadInfo precomputes
+`total_requests` (per-PodSet requests scaled by count minus reclaimable pods,
+workload.go:185-213,244-296), holds the flavor-search resume state
+(AssignmentClusterQueueState, workload.go:45-92), and the queue-ordering
+timestamp rule (eviction vs creation, workload.go Ordering).
+"""
+
+from __future__ import annotations
+
+import copy
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from kueue_tpu.api.types import (
+    CONDITION_EVICTED,
+    EVICTED_BY_PODS_READY_TIMEOUT,
+    Workload,
+)
+
+
+@dataclass
+class PodSetResources:
+    """Total requests for one PodSet (requests scaled by count)."""
+
+    name: str
+    requests: Dict[str, int]
+    count: int
+    # Assigned flavors per resource, populated once admitted.
+    flavors: Dict[str, str] = field(default_factory=dict)
+
+    def scaled_to(self, count: int) -> "PodSetResources":
+        """Per-pod rescaling used by partial admission
+        (reference: pkg/workload/workload.go ScaledTo)."""
+        if self.count == 0:
+            return PodSetResources(self.name, dict(self.requests), count)
+        per_pod = {r: v // self.count for r, v in self.requests.items()}
+        return PodSetResources(
+            name=self.name,
+            requests={r: v * count for r, v in per_pod.items()},
+            count=count,
+        )
+
+
+@dataclass
+class AssignmentClusterQueueState:
+    """Flavor-search resume state, invalidated by allocatable generations.
+
+    reference: pkg/workload/workload.go:45-92.
+    `last_tried_flavor_idx[podset][resource]` is the index (into the resource
+    group's flavor list) of the last flavor tried; -1 means the whole list was
+    exhausted and the next attempt starts from 0.
+    """
+
+    last_tried_flavor_idx: List[Dict[str, int]] = field(default_factory=list)
+    cluster_queue_generation: int = 0
+    cohort_generation: int = 0
+
+    def next_flavor_to_try(self, podset_idx: int, resource: str) -> int:
+        if podset_idx >= len(self.last_tried_flavor_idx):
+            return 0
+        last = self.last_tried_flavor_idx[podset_idx].get(resource, -1)
+        return last + 1
+
+    def pending_flavors(self) -> bool:
+        """True if any resource still has untried flavors
+        (reference: workload.go PendingFlavors)."""
+        return any(idx != -1
+                   for ps in self.last_tried_flavor_idx
+                   for idx in ps.values())
+
+
+@dataclass
+class WorkloadOrdering:
+    """Which timestamp orders requeued workloads
+    (reference: pkg/workload Ordering; config waitForPodsReady.requeuingStrategy)."""
+
+    pods_ready_requeuing_timestamp: str = "Eviction"  # "Eviction" | "Creation"
+
+    def queue_order_time(self, wl: Workload) -> float:
+        c = wl.find_condition(CONDITION_EVICTED)
+        relevant = c is not None and c.status
+        if relevant and self.pods_ready_requeuing_timestamp == "Creation" \
+                and c.reason == EVICTED_BY_PODS_READY_TIMEOUT:
+            relevant = False
+        if relevant:
+            return c.last_transition_time
+        return wl.creation_time
+
+
+class WorkloadInfo:
+    """A Workload plus its precomputed integer resource totals.
+
+    reference: pkg/workload/workload.go:94-112 (Info).
+    """
+
+    __slots__ = ("obj", "cluster_queue", "total_requests", "last_assignment")
+
+    def __init__(self, obj: Workload, cluster_queue: str = ""):
+        self.obj = obj
+        self.cluster_queue = cluster_queue
+        self.total_requests: List[PodSetResources] = self._compute_totals(obj)
+        self.last_assignment: Optional[AssignmentClusterQueueState] = None
+
+    @staticmethod
+    def _compute_totals(wl: Workload) -> List[PodSetResources]:
+        # From admission if admitted (usage as admitted), else from the spec
+        # (reference: totalRequestsFromAdmission / totalRequestsFromPodSets).
+        counts = {ps.name: ps.count for ps in wl.pod_sets}
+        after_reclaim = {
+            name: c - wl.reclaimable_pods.get(name, 0) for name, c in counts.items()
+        }
+        if wl.admission is not None:
+            out = []
+            for psa in wl.admission.pod_set_assignments:
+                res = PodSetResources(
+                    name=psa.name,
+                    requests=dict(psa.resource_usage),
+                    count=psa.count if psa.count is not None else counts[psa.name],
+                    flavors=dict(psa.flavors),
+                )
+                cur = after_reclaim.get(psa.name, res.count)
+                if cur != res.count:
+                    res = PodSetResources(
+                        name=res.name,
+                        requests=res.scaled_to(cur).requests,
+                        count=cur,
+                        flavors=res.flavors,
+                    )
+                out.append(res)
+            return out
+        out = []
+        for ps in wl.pod_sets:
+            count = after_reclaim[ps.name]
+            out.append(PodSetResources(
+                name=ps.name,
+                requests={r: v * count for r, v in ps.requests.items()},
+                count=count,
+            ))
+        return out
+
+    @property
+    def key(self) -> str:
+        return self.obj.key
+
+    @property
+    def priority(self) -> int:
+        return self.obj.priority
+
+    def usage(self) -> Dict[str, Dict[str, int]]:
+        """Flavor -> resource -> quantity used by this (admitted) workload."""
+        out: Dict[str, Dict[str, int]] = {}
+        for ps in self.total_requests:
+            for res, q in ps.requests.items():
+                flv = ps.flavors.get(res)
+                if flv is None:
+                    continue
+                out.setdefault(flv, {}).setdefault(res, 0)
+                out[flv][res] += q
+        return out
+
+    def clone(self) -> "WorkloadInfo":
+        c = WorkloadInfo.__new__(WorkloadInfo)
+        c.obj = self.obj
+        c.cluster_queue = self.cluster_queue
+        c.total_requests = copy.deepcopy(self.total_requests)
+        c.last_assignment = self.last_assignment
+        return c

@@ -1,0 +1,59 @@
+"""Feature gates (reference: pkg/features/kube_features.go:29-110).
+
+Defaults mirror the reference snapshot: beta gates on, alpha gates off.
+"""
+
+from __future__ import annotations
+
+from contextlib import contextmanager
+from typing import Dict, Iterator
+
+PARTIAL_ADMISSION = "PartialAdmission"
+QUEUE_VISIBILITY = "QueueVisibility"
+FLAVOR_FUNGIBILITY = "FlavorFungibility"
+PROVISIONING_ACC = "ProvisioningACC"
+VISIBILITY_ON_DEMAND = "VisibilityOnDemand"
+PRIORITY_SORTING_WITHIN_COHORT = "PrioritySortingWithinCohort"
+MULTI_KUEUE = "MultiKueue"
+LENDING_LIMIT = "LendingLimit"
+# Greenfield (KEP-1714 / KEP-79): implemented natively by this framework.
+FAIR_SHARING = "FairSharing"
+
+_DEFAULTS: Dict[str, bool] = {
+    PARTIAL_ADMISSION: True,
+    QUEUE_VISIBILITY: False,
+    FLAVOR_FUNGIBILITY: True,
+    PROVISIONING_ACC: False,
+    VISIBILITY_ON_DEMAND: False,
+    PRIORITY_SORTING_WITHIN_COHORT: True,
+    MULTI_KUEUE: False,
+    LENDING_LIMIT: False,
+    FAIR_SHARING: False,
+}
+
+_gates: Dict[str, bool] = dict(_DEFAULTS)
+
+
+def enabled(name: str) -> bool:
+    return _gates[name]
+
+
+def set_enabled(name: str, value: bool) -> None:
+    if name not in _gates:
+        raise KeyError(f"unknown feature gate {name}")
+    _gates[name] = value
+
+
+def reset() -> None:
+    _gates.clear()
+    _gates.update(_DEFAULTS)
+
+
+@contextmanager
+def override(name: str, value: bool) -> Iterator[None]:
+    old = _gates[name]
+    set_enabled(name, value)
+    try:
+        yield
+    finally:
+        _gates[name] = old

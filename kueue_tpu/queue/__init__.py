@@ -1,0 +1,7 @@
+"""Pending-workload state: per-ClusterQueue FIFO heaps and the queue manager."""
+
+from kueue_tpu.queue.manager import (
+    Manager,
+    RequeueReason,
+    PendingClusterQueue,
+)

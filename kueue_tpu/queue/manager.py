@@ -1,0 +1,362 @@
+"""Queue manager: pending workloads per ClusterQueue.
+
+Counterpart of reference pkg/queue/: a keyed heap per ClusterQueue ordered by
+(priority desc, queue-order timestamp asc) (cluster_queue_strict_fifo.go:53-66),
+an `inadmissible` parking lot with the popCycle/queueInadmissibleCycle race
+guard (cluster_queue_impl.go:40-63,177-229), StrictFIFO vs BestEffortFIFO
+requeue policies, requeue backoff (RequeueState.requeue_at), cohort-wide
+inadmissible flushes, and the blocking `heads()` used by the scheduler tick
+(manager.go:470-508).
+"""
+
+from __future__ import annotations
+
+import threading
+import time as _time
+from typing import Callable, Dict, List, Mapping, Optional
+
+from kueue_tpu.api.types import (
+    CONDITION_EVICTED,
+    EVICTED_BY_PODS_READY_TIMEOUT,
+    ClusterQueue,
+    LocalQueue,
+    QueueingStrategy,
+    Workload,
+)
+from kueue_tpu.core.workload import WorkloadInfo, WorkloadOrdering
+from kueue_tpu.utils.heap import KeyedHeap
+
+
+class RequeueReason:
+    GENERIC = ""
+    NAMESPACE_MISMATCH = "NamespaceMismatch"
+    FAILED_AFTER_NOMINATION = "FailedAfterNomination"
+    PENDING_PREEMPTION = "PendingPreemption"
+
+
+def _evicted_by_pods_ready_timeout(wl: Workload) -> bool:
+    c = wl.find_condition(CONDITION_EVICTED)
+    return c is not None and c.status and c.reason == EVICTED_BY_PODS_READY_TIMEOUT
+
+
+class PendingClusterQueue:
+    """Per-CQ pending heap + inadmissible parking lot
+    (reference: clusterQueueBase, cluster_queue_impl.go:40-63)."""
+
+    def __init__(self, spec: ClusterQueue, ordering: WorkloadOrdering,
+                 clock: Callable[[], float] = _time.time):
+        self.name = spec.name
+        self.strategy = spec.queueing_strategy
+        self.cohort = spec.cohort
+        self.namespace_selector = spec.namespace_selector
+        self.active = True
+        self._ordering = ordering
+        self._clock = clock
+        self.heap: KeyedHeap[WorkloadInfo] = KeyedHeap(
+            key_fn=lambda wi: wi.key, less=self._less)
+        self.inadmissible: Dict[str, WorkloadInfo] = {}
+        # popCycle / queueInadmissibleCycle race guard
+        # (cluster_queue_impl.go:49-57).
+        self.pop_cycle = 0
+        self.queue_inadmissible_cycle = -1
+
+    def _less(self, a: WorkloadInfo, b: WorkloadInfo) -> bool:
+        """Priority desc, then queue-order timestamp asc
+        (cluster_queue_strict_fifo.go:53-66)."""
+        pa, pb = a.obj.priority, b.obj.priority
+        if pa != pb:
+            return pa > pb
+        ta = self._ordering.queue_order_time(a.obj)
+        tb = self._ordering.queue_order_time(b.obj)
+        return not tb < ta
+
+    def update(self, spec: ClusterQueue) -> None:
+        self.cohort = spec.cohort
+        self.strategy = spec.queueing_strategy
+        self.namespace_selector = spec.namespace_selector
+
+    # -- backoff (cluster_queue_impl.go:139-150) ----------------------------
+
+    def _backoff_expired(self, wi: WorkloadInfo) -> bool:
+        rs = wi.obj.requeue_state
+        if rs is None or rs.requeue_at is None:
+            return True
+        if not _evicted_by_pods_ready_timeout(wi.obj):
+            return True
+        return self._clock() >= rs.requeue_at
+
+    # -- mutations ----------------------------------------------------------
+
+    def push_or_update(self, wi: WorkloadInfo) -> None:
+        key = wi.key
+        old = self.inadmissible.get(key)
+        if old is not None:
+            # Keep parked if nothing admission-relevant changed
+            # (cluster_queue_impl.go:113-131).
+            if (old.obj.pod_sets == wi.obj.pod_sets
+                    and old.obj.reclaimable_pods == wi.obj.reclaimable_pods
+                    and old.obj.find_condition(CONDITION_EVICTED)
+                    == wi.obj.find_condition(CONDITION_EVICTED)):
+                self.inadmissible[key] = wi
+                return
+            del self.inadmissible[key]
+        if self.heap.get_by_key(key) is None and not self._backoff_expired(wi):
+            self.inadmissible[key] = wi
+            return
+        self.heap.push_or_update(wi)
+
+    def delete(self, wl: Workload) -> None:
+        key = wl.key
+        self.inadmissible.pop(key, None)
+        self.heap.delete(key)
+
+    def requeue_if_not_present(self, wi: WorkloadInfo, reason: str) -> bool:
+        """cluster_queue_impl.go:177-203 + per-strategy immediate rules."""
+        if self.strategy == QueueingStrategy.STRICT_FIFO:
+            immediate = reason != RequeueReason.NAMESPACE_MISMATCH
+        else:
+            immediate = reason in (RequeueReason.FAILED_AFTER_NOMINATION,
+                                   RequeueReason.PENDING_PREEMPTION)
+        key = wi.key
+        pending_flavors = (wi.last_assignment is not None
+                           and wi.last_assignment.pending_flavors())
+        if self._backoff_expired(wi) and (
+                immediate or self.queue_inadmissible_cycle >= self.pop_cycle
+                or pending_flavors):
+            parked = self.inadmissible.pop(key, None)
+            if parked is not None:
+                wi = parked
+            return self.heap.push_if_not_present(wi)
+
+        if key in self.inadmissible or self.heap.get_by_key(key) is not None:
+            return False
+        self.inadmissible[key] = wi
+        return True
+
+    def queue_inadmissible_workloads(
+            self, ns_labels: Callable[[str], Optional[Mapping[str, str]]]) -> bool:
+        """Move parked workloads back to the heap (cluster_queue_impl.go:205-229)."""
+        self.queue_inadmissible_cycle = self.pop_cycle
+        if not self.inadmissible:
+            return False
+        remaining: Dict[str, WorkloadInfo] = {}
+        moved = False
+        for key, wi in self.inadmissible.items():
+            labels = ns_labels(wi.obj.namespace)
+            if labels is None or not self.namespace_selector.matches(labels) \
+                    or not self._backoff_expired(wi):
+                remaining[key] = wi
+            else:
+                moved = self.heap.push_if_not_present(wi) or moved
+        self.inadmissible = remaining
+        return moved
+
+    def pop(self) -> Optional[WorkloadInfo]:
+        self.pop_cycle += 1
+        return self.heap.pop()
+
+    # -- stats --------------------------------------------------------------
+
+    @property
+    def pending_active(self) -> int:
+        return len(self.heap)
+
+    @property
+    def pending_inadmissible(self) -> int:
+        return len(self.inadmissible)
+
+    @property
+    def pending(self) -> int:
+        return self.pending_active + self.pending_inadmissible
+
+
+class Manager:
+    """reference: pkg/queue/manager.go:63-79."""
+
+    def __init__(self, ordering: Optional[WorkloadOrdering] = None,
+                 namespace_lister: Optional[Callable[[str], Optional[Mapping[str, str]]]] = None,
+                 clock: Callable[[], float] = _time.time):
+        self._cond = threading.Condition()
+        self.ordering = ordering or WorkloadOrdering()
+        self.cluster_queues: Dict[str, PendingClusterQueue] = {}
+        self.local_queues: Dict[str, LocalQueue] = {}
+        self._ns_lister = namespace_lister or (lambda name: {})
+        self._clock = clock
+        self._stopped = False
+
+    # -- cluster queues ------------------------------------------------------
+
+    def add_cluster_queue(self, spec: ClusterQueue,
+                          pending: List[Workload] = ()) -> None:
+        with self._cond:
+            if spec.name in self.cluster_queues:
+                raise ValueError(f"queue {spec.name} already exists")
+            cq = PendingClusterQueue(spec, self.ordering, self._clock)
+            self.cluster_queues[spec.name] = cq
+            # Re-adopt pending workloads that arrived before the CQ
+            # (manager.go:121-134).
+            for wl in pending:
+                lq = self.local_queues.get(f"{wl.namespace}/{wl.queue_name}")
+                if lq is not None and lq.cluster_queue == spec.name \
+                        and not wl.has_quota_reservation and not wl.is_finished \
+                        and wl.active:
+                    cq.push_or_update(WorkloadInfo(wl, cluster_queue=spec.name))
+            self._cond.notify_all()
+
+    def update_cluster_queue(self, spec: ClusterQueue) -> None:
+        with self._cond:
+            cq = self.cluster_queues[spec.name]
+            old_cohort = cq.cohort
+            cq.update(spec)
+            if cq.cohort != old_cohort:
+                self._queue_cohort_inadmissible(cq.cohort)
+            self._cond.notify_all()
+
+    def delete_cluster_queue(self, name: str) -> None:
+        with self._cond:
+            self.cluster_queues.pop(name, None)
+
+    # -- local queues --------------------------------------------------------
+
+    def add_local_queue(self, lq: LocalQueue, pending: List[Workload] = ()) -> None:
+        with self._cond:
+            self.local_queues[lq.key] = lq
+            cq = self.cluster_queues.get(lq.cluster_queue)
+            if cq is not None:
+                for wl in pending:
+                    if wl.namespace == lq.namespace and wl.queue_name == lq.name \
+                            and not wl.has_quota_reservation and not wl.is_finished \
+                            and wl.active:
+                        cq.push_or_update(WorkloadInfo(wl, cluster_queue=cq.name))
+                self._cond.notify_all()
+
+    def delete_local_queue(self, lq: LocalQueue) -> None:
+        with self._cond:
+            self.local_queues.pop(lq.key, None)
+
+    # -- workloads -----------------------------------------------------------
+
+    def cluster_queue_for(self, wl: Workload) -> Optional[str]:
+        lq = self.local_queues.get(f"{wl.namespace}/{wl.queue_name}")
+        return lq.cluster_queue if lq else None
+
+    def add_or_update_workload(self, wl: Workload) -> bool:
+        with self._cond:
+            cq_name = self.cluster_queue_for(wl)
+            if cq_name is None:
+                return False
+            cq = self.cluster_queues.get(cq_name)
+            if cq is None:
+                return False
+            cq.push_or_update(WorkloadInfo(wl, cluster_queue=cq_name))
+            self._cond.notify_all()
+            return True
+
+    def delete_workload(self, wl: Workload) -> None:
+        with self._cond:
+            cq_name = self.cluster_queue_for(wl)
+            if cq_name:
+                cq = self.cluster_queues.get(cq_name)
+                if cq is not None:
+                    cq.delete(wl)
+
+    def requeue_workload(self, wi: WorkloadInfo, reason: str) -> bool:
+        """manager.go RequeueWorkload; caller must pass a still-pending info."""
+        with self._cond:
+            if wi.obj.has_quota_reservation or wi.obj.is_finished or not wi.obj.active:
+                return False
+            cq = self.cluster_queues.get(wi.cluster_queue)
+            if cq is None:
+                return False
+            added = cq.requeue_if_not_present(wi, reason)
+            if added:
+                self._cond.notify_all()
+            return added
+
+    # -- inadmissible flushes ------------------------------------------------
+
+    def queue_associated_inadmissible_workloads(self, wl: Workload) -> None:
+        """After a workload releases quota, flush its CQ's cohort
+        (manager.go:424-447)."""
+        with self._cond:
+            cq_name = self.cluster_queue_for(wl)
+            if cq_name is None and wl.admission is not None:
+                cq_name = wl.admission.cluster_queue
+            cq = self.cluster_queues.get(cq_name or "")
+            if cq is None:
+                return
+            self._queue_cohort_inadmissible(cq.cohort, fallback=cq)
+
+    def queue_inadmissible_workloads(self, cq_names) -> None:
+        with self._cond:
+            queued = False
+            cohorts = set()
+            for name in cq_names:
+                cq = self.cluster_queues.get(name)
+                if cq is None:
+                    continue
+                if cq.cohort:
+                    cohorts.add(cq.cohort)
+                else:
+                    queued = cq.queue_inadmissible_workloads(self._ns_lister) or queued
+            for cohort in cohorts:
+                queued = self._flush_cohort(cohort) or queued
+            if queued:
+                self._cond.notify_all()
+
+    def _queue_cohort_inadmissible(self, cohort: str,
+                                   fallback: Optional[PendingClusterQueue] = None) -> None:
+        if cohort:
+            if self._flush_cohort(cohort):
+                self._cond.notify_all()
+        elif fallback is not None:
+            if fallback.queue_inadmissible_workloads(self._ns_lister):
+                self._cond.notify_all()
+
+    def _flush_cohort(self, cohort: str) -> bool:
+        queued = False
+        for cq in self.cluster_queues.values():
+            if cq.cohort == cohort:
+                queued = cq.queue_inadmissible_workloads(self._ns_lister) or queued
+        return queued
+
+    # -- heads ---------------------------------------------------------------
+
+    def heads(self, timeout: Optional[float] = None) -> List[WorkloadInfo]:
+        """Block until at least one CQ has a head, then pop one head per CQ
+        (manager.go:470-508)."""
+        deadline = None if timeout is None else self._clock() + timeout
+        with self._cond:
+            while not self._stopped:
+                out = self._heads_locked()
+                if out:
+                    return out
+                remaining = None
+                if deadline is not None:
+                    remaining = deadline - self._clock()
+                    if remaining <= 0:
+                        return []
+                self._cond.wait(remaining)
+            return []
+
+    def _heads_locked(self) -> List[WorkloadInfo]:
+        out: List[WorkloadInfo] = []
+        for cq in self.cluster_queues.values():
+            if not cq.active:
+                continue
+            wi = cq.pop()
+            if wi is not None:
+                out.append(wi)
+        return out
+
+    def stop(self) -> None:
+        with self._cond:
+            self._stopped = True
+            self._cond.notify_all()
+
+    # -- stats ---------------------------------------------------------------
+
+    def pending(self, cq_name: str) -> int:
+        with self._cond:
+            cq = self.cluster_queues.get(cq_name)
+            return cq.pending if cq else 0

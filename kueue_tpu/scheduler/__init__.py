@@ -1,0 +1,4 @@
+"""Scheduling tick orchestration (counterpart of reference pkg/scheduler/)."""
+
+from kueue_tpu.scheduler.preemption import get_targets
+from kueue_tpu.scheduler.scheduler import Scheduler, SchedulerMetrics

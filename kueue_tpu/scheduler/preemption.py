@@ -1,0 +1,232 @@
+"""Preemption-victim search.
+
+Counterpart of reference pkg/scheduler/preemption/preemption.go: candidate
+collection (findCandidates :256-303), deterministic candidate ordering
+(candidatesOrdering :397-424), and the greedy remove-until-fits /
+add-back-minimal heuristic (minimalPreemptions :172-231), simulated on the
+tick snapshot.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Set
+
+from kueue_tpu.api.types import (
+    BorrowWithinCohortPolicy,
+    CONDITION_EVICTED,
+    PreemptionPolicy,
+)
+from kueue_tpu.core.cache import CachedClusterQueue, FlavorResourceQuantities
+from kueue_tpu.core.snapshot import Snapshot
+from kueue_tpu.core.workload import WorkloadInfo, WorkloadOrdering
+from kueue_tpu.solver.modes import PREEMPT
+from kueue_tpu.solver.referee import Assignment
+
+ResourcesPerFlavor = Dict[str, Set[str]]
+
+
+def get_targets(wi: WorkloadInfo, assignment: Assignment, snapshot: Snapshot,
+                ordering: WorkloadOrdering, now: float) -> List[WorkloadInfo]:
+    """Workloads to evict so `wi` fits (preemption.go:81-126)."""
+    res_per_flv = _resources_requiring_preemption(assignment)
+    cq = snapshot.cluster_queues[wi.cluster_queue]
+
+    candidates = _find_candidates(wi, ordering, cq, res_per_flv)
+    if not candidates:
+        return []
+    candidates.sort(key=lambda c: _candidate_sort_key(c, cq.name, now))
+
+    same_queue = [c for c in candidates if c.cluster_queue == wi.cluster_queue]
+
+    if len(same_queue) == len(candidates):
+        # No cross-queue candidates: preempt within the CQ, borrowing allowed.
+        return _minimal_preemptions(wi, assignment, snapshot, res_per_flv,
+                                    candidates, True, None)
+
+    bwc = cq.preemption.borrow_within_cohort
+    if bwc is not None and bwc.policy != BorrowWithinCohortPolicy.NEVER:
+        threshold = wi.priority
+        if bwc.max_priority_threshold is not None \
+                and bwc.max_priority_threshold < threshold:
+            threshold = bwc.max_priority_threshold + 1
+        return _minimal_preemptions(wi, assignment, snapshot, res_per_flv,
+                                    candidates, True, threshold)
+
+    targets = _minimal_preemptions(wi, assignment, snapshot, res_per_flv,
+                                   candidates, False, None)
+    if not targets:
+        # Second attempt: only same-queue candidates, with borrowing.
+        targets = _minimal_preemptions(wi, assignment, snapshot, res_per_flv,
+                                       same_queue, True, None)
+    return targets
+
+
+def _resources_requiring_preemption(assignment: Assignment) -> ResourcesPerFlavor:
+    out: ResourcesPerFlavor = {}
+    for ps in assignment.pod_sets:
+        for res, fa in ps.flavors.items():
+            if fa.mode != PREEMPT:
+                continue
+            out.setdefault(fa.name, set()).add(res)
+    return out
+
+
+def _find_candidates(wi: WorkloadInfo, ordering: WorkloadOrdering,
+                     cq: CachedClusterQueue,
+                     res_per_flv: ResourcesPerFlavor) -> List[WorkloadInfo]:
+    candidates: List[WorkloadInfo] = []
+    wl_priority = wi.priority
+
+    if cq.preemption.within_cluster_queue != PreemptionPolicy.NEVER:
+        consider_same_prio = (cq.preemption.within_cluster_queue
+                              == PreemptionPolicy.LOWER_OR_NEWER_EQUAL_PRIORITY)
+        preemptor_ts = ordering.queue_order_time(wi.obj)
+        for cand in cq.workloads.values():
+            cand_priority = cand.obj.priority
+            if cand_priority > wl_priority:
+                continue
+            if cand_priority == wl_priority and not (
+                    consider_same_prio
+                    and preemptor_ts < ordering.queue_order_time(cand.obj)):
+                continue
+            if not _uses_resources(cand, res_per_flv):
+                continue
+            candidates.append(cand)
+
+    if cq.cohort is not None \
+            and cq.preemption.reclaim_within_cohort != PreemptionPolicy.NEVER:
+        only_lower_prio = cq.preemption.reclaim_within_cohort != PreemptionPolicy.ANY
+        for cohort_cq in cq.cohort.members:
+            if cohort_cq is cq or not _cq_is_borrowing(cohort_cq, res_per_flv):
+                continue
+            for cand in cohort_cq.workloads.values():
+                if only_lower_prio and cand.obj.priority >= wl_priority:
+                    continue
+                if not _uses_resources(cand, res_per_flv):
+                    continue
+                candidates.append(cand)
+    return candidates
+
+
+def _cq_is_borrowing(cq: CachedClusterQueue,
+                     res_per_flv: ResourcesPerFlavor) -> bool:
+    if cq.cohort is None:
+        return False
+    for rg in cq.resource_groups:
+        for fq in rg.flavors:
+            fusage = cq.usage.get(fq.name, {})
+            quotas = fq.resources_dict
+            for rname in res_per_flv.get(fq.name, ()):
+                quota = quotas.get(rname)
+                if quota is not None and fusage.get(rname, 0) > quota.nominal:
+                    return True
+    return False
+
+
+def _uses_resources(wi: WorkloadInfo, res_per_flv: ResourcesPerFlavor) -> bool:
+    for ps in wi.total_requests:
+        for res, flv in ps.flavors.items():
+            if res in res_per_flv.get(flv, ()):
+                return True
+    return False
+
+
+def _candidate_sort_key(c: WorkloadInfo, cq_name: str, now: float):
+    """Evicted first, other-CQ first, lowest priority, newest admission,
+    UID tiebreak (preemption.go:397-424)."""
+    return (
+        not c.obj.condition_true(CONDITION_EVICTED),
+        c.cluster_queue == cq_name,
+        c.obj.priority,
+        -c.obj.quota_reserved_time(now),
+        c.obj.uid,
+    )
+
+
+def _total_requests_for_assignment(wi: WorkloadInfo,
+                                   assignment: Assignment) -> FlavorResourceQuantities:
+    # Use the assignment's own request totals: unlike wi.total_requests they
+    # include the synthetic "pods" resource when the CQ accounts for it.
+    usage: FlavorResourceQuantities = {}
+    for ps in assignment.pod_sets:
+        for res, q in ps.requests.items():
+            flv = ps.flavors[res].name
+            usage.setdefault(flv, {})
+            usage[flv][res] = usage[flv].get(res, 0) + q
+    return usage
+
+
+def _minimal_preemptions(wi: WorkloadInfo, assignment: Assignment,
+                         snapshot: Snapshot, res_per_flv: ResourcesPerFlavor,
+                         candidates: List[WorkloadInfo], allow_borrowing: bool,
+                         allow_borrowing_below_priority: Optional[int],
+                         ) -> List[WorkloadInfo]:
+    """Greedy remove-until-fits then add-back refinement (preemption.go:172-231)."""
+    wl_req = _total_requests_for_assignment(wi, assignment)
+    cq = snapshot.cluster_queues[wi.cluster_queue]
+
+    targets: List[WorkloadInfo] = []
+    fits = False
+    for cand in candidates:
+        cand_cq = snapshot.cluster_queues[cand.cluster_queue]
+        if cq is not cand_cq and not _cq_is_borrowing(cand_cq, res_per_flv):
+            continue
+        if cq is not cand_cq and allow_borrowing_below_priority is not None \
+                and cand.obj.priority >= allow_borrowing_below_priority:
+            # Once a candidate at/above the threshold is targeted, the
+            # preemptor may no longer borrow (preemption.go:184-198).
+            allow_borrowing = False
+        snapshot.remove_workload(cand)
+        targets.append(cand)
+        if _workload_fits(wl_req, cq, allow_borrowing):
+            fits = True
+            break
+
+    if not fits:
+        for t in targets:
+            snapshot.add_workload(t)
+        return []
+
+    # Add candidates back (reverse order) while the workload still fits.
+    i = len(targets) - 2
+    while i >= 0:
+        snapshot.add_workload(targets[i])
+        if _workload_fits(wl_req, cq, allow_borrowing):
+            targets[i] = targets[-1]
+            targets.pop()
+        else:
+            snapshot.remove_workload(targets[i])
+        i -= 1
+
+    # Restore the snapshot.
+    for t in targets:
+        snapshot.add_workload(t)
+    return targets
+
+
+def _workload_fits(wl_req: FlavorResourceQuantities, cq: CachedClusterQueue,
+                   allow_borrowing: bool) -> bool:
+    """preemption.go:352-389."""
+    for rg in cq.resource_groups:
+        for fq in rg.flavors:
+            flv_req = wl_req.get(fq.name)
+            if flv_req is None:
+                continue
+            cq_usage = cq.usage.get(fq.name, {})
+            quotas = fq.resources_dict
+            for rname, req in flv_req.items():
+                quota = quotas.get(rname)
+                if quota is None:
+                    continue
+                if cq.cohort is None or not allow_borrowing:
+                    if cq_usage.get(rname, 0) + req > quota.nominal:
+                        return False
+                elif quota.borrowing_limit is not None:
+                    if cq_usage.get(rname, 0) + req > quota.nominal + quota.borrowing_limit:
+                        return False
+                if cq.cohort is not None:
+                    cohort_used = cq.used_cohort_quota(fq.name, rname)
+                    requestable = cq.requestable_cohort_quota(fq.name, rname)
+                    if cohort_used + req > requestable:
+                        return False
+    return True

@@ -1,0 +1,372 @@
+"""The scheduling tick.
+
+Counterpart of reference pkg/scheduler/scheduler.go:174-288: pop queue heads,
+snapshot the cache, nominate (flavor assignment + preemption targets), order
+entries (borrowing < priority < FIFO), admit at most one borrowing workload
+per cohort per cycle, issue preemptions, and requeue losers.
+
+The flavor-assignment step is pluggable: by default every head is solved
+sequentially with the referee (`kueue_tpu.solver.referee`); when a
+`batch_solver` is supplied (see `kueue_tpu.models.flavor_fit.BatchSolver`)
+all heads are solved in one batched JAX program on the accelerator, and only
+preemption-target search runs host-side on the snapshot.
+"""
+
+from __future__ import annotations
+
+import time as _time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence, Set
+
+from kueue_tpu import features
+from kueue_tpu.api.types import Admission, PodSetAssignment, Workload
+from kueue_tpu.core.cache import (
+    Cache,
+    CachedClusterQueue,
+    FlavorResourceQuantities,
+    frq_add,
+)
+from kueue_tpu.core.snapshot import Snapshot
+from kueue_tpu.core.workload import WorkloadInfo, WorkloadOrdering
+from kueue_tpu.queue.manager import Manager, RequeueReason
+from kueue_tpu.scheduler import preemption as preemption_mod
+from kueue_tpu.solver import podset_reducer
+from kueue_tpu.solver.modes import FIT, NO_FIT, PREEMPT
+from kueue_tpu.solver.referee import Assignment, assign_flavors
+
+# Entry statuses (scheduler.go:289-300).
+NOT_NOMINATED = ""
+NOMINATED = "nominated"
+SKIPPED = "skipped"
+ASSUMED = "assumed"
+
+
+@dataclass
+class Entry:
+    info: WorkloadInfo
+    assignment: Optional[Assignment] = None
+    status: str = NOT_NOMINATED
+    inadmissible_msg: str = ""
+    requeue_reason: str = RequeueReason.GENERIC
+    preemption_targets: List[WorkloadInfo] = field(default_factory=list)
+
+
+@dataclass
+class SchedulerMetrics:
+    admission_attempts: int = 0
+    admitted: int = 0
+    preempted: int = 0
+    skipped: int = 0
+    inadmissible: int = 0
+    last_tick_seconds: float = 0.0
+
+
+class Scheduler:
+    def __init__(self, queues: Manager, cache: Cache,
+                 apply_admission: Optional[Callable[[Workload], bool]] = None,
+                 apply_preemption: Optional[Callable[[Workload, str], None]] = None,
+                 namespace_lister: Optional[Callable[[str], Optional[dict]]] = None,
+                 batch_solver=None,
+                 ordering: Optional[WorkloadOrdering] = None,
+                 clock: Callable[[], float] = _time.time):
+        self.queues = queues
+        self.cache = cache
+        self.apply_admission = apply_admission or (lambda wl: True)
+        self.apply_preemption = apply_preemption or (lambda wl, msg: None)
+        self._ns_lister = namespace_lister or (lambda name: {})
+        self.batch_solver = batch_solver
+        self.ordering = ordering or WorkloadOrdering()
+        self.clock = clock
+        self.metrics = SchedulerMetrics()
+
+    # -- one tick -----------------------------------------------------------
+
+    def schedule(self, timeout: Optional[float] = 0.0) -> int:
+        """Run one scheduling cycle; returns the number of admissions."""
+        heads = self.queues.heads(timeout=timeout)
+        if not heads:
+            return 0
+        start = self.clock()
+        snapshot = self.cache.snapshot()
+        entries = self._nominate(heads, snapshot)
+        entries.sort(key=self._entry_sort_key)
+        admitted = self._admission_cycle(entries, snapshot)
+        for e in entries:
+            if e.status != ASSUMED:
+                self._requeue_and_update(e)
+        self.metrics.admission_attempts += 1
+        self.metrics.last_tick_seconds = self.clock() - start
+        return admitted
+
+    # -- nomination (scheduler.go:317-351) ----------------------------------
+
+    def _nominate(self, heads: Sequence[WorkloadInfo],
+                  snapshot: Snapshot) -> List[Entry]:
+        entries: List[Entry] = []
+        solvable: List[Entry] = []
+        for wi in heads:
+            e = Entry(info=wi)
+            cq = snapshot.cluster_queues.get(wi.cluster_queue)
+            if self.cache.is_assumed_or_admitted(wi.obj):
+                continue
+            if _has_retry_or_rejected_checks(wi.obj):
+                e.inadmissible_msg = "The workload has failed admission checks"
+            elif wi.cluster_queue in snapshot.inactive_cluster_queues:
+                e.inadmissible_msg = f"ClusterQueue {wi.cluster_queue} is inactive"
+            elif cq is None:
+                e.inadmissible_msg = f"ClusterQueue {wi.cluster_queue} not found"
+            else:
+                ns = self._ns_lister(wi.obj.namespace)
+                if ns is None:
+                    e.inadmissible_msg = "Could not obtain workload namespace"
+                elif not cq.namespace_selector.matches(ns):
+                    e.inadmissible_msg = \
+                        "Workload namespace doesn't match ClusterQueue selector"
+                    e.requeue_reason = RequeueReason.NAMESPACE_MISMATCH
+                else:
+                    solvable.append(e)
+            entries.append(e)
+
+        self._solve(solvable, snapshot)
+        return entries
+
+    def _solve(self, entries: List[Entry], snapshot: Snapshot) -> None:
+        """Flavor-assign all nominable entries, batched when possible."""
+        if self.batch_solver is not None and entries:
+            assignments = self.batch_solver.solve(
+                [e.info for e in entries], snapshot)
+        else:
+            assignments = None
+        for i, e in enumerate(entries):
+            full = assignments[i] if assignments is not None else None
+            assignment, targets = self._get_assignment(e.info, snapshot, full)
+            e.assignment = assignment
+            e.preemption_targets = targets
+            e.inadmissible_msg = assignment.message()
+            e.info.last_assignment = assignment.last_state
+
+    def _get_assignment(self, wi: WorkloadInfo, snap: Snapshot,
+                        precomputed: Optional[Assignment]):
+        """scheduler.go getAssignments (:390-429)."""
+        cq = snap.cluster_queues[wi.cluster_queue]
+        full = precomputed if precomputed is not None else \
+            assign_flavors(wi, cq, snap.resource_flavors)
+        mode = full.representative_mode
+        if mode == FIT:
+            return full, []
+        targets: List[WorkloadInfo] = []
+        if mode == PREEMPT:
+            targets = preemption_mod.get_targets(
+                wi, full, snap, self.ordering, self.clock())
+        if not features.enabled(features.PARTIAL_ADMISSION) or targets:
+            return full, targets
+        if wi.obj.can_be_partially_admitted():
+            def fits(counts):
+                assignment = assign_flavors(wi, cq, snap.resource_flavors, counts)
+                if assignment.representative_mode == FIT:
+                    return (assignment, []), True
+                t = preemption_mod.get_targets(
+                    wi, assignment, snap, self.ordering, self.clock())
+                if t:
+                    return (assignment, t), True
+                return None, False
+
+            result, found = podset_reducer.search(wi.obj.pod_sets, fits)
+            if found:
+                return result
+        return full, []
+
+    # -- ordering (scheduler.go:564-588) ------------------------------------
+
+    def _entry_sort_key(self, e: Entry):
+        borrows = e.assignment.borrowing if e.assignment is not None else False
+        key = [borrows]
+        if features.enabled(features.PRIORITY_SORTING_WITHIN_COHORT):
+            key.append(-e.info.obj.priority)
+        key.append(self.ordering.queue_order_time(e.info.obj))
+        return tuple(key)
+
+    # -- admission cycle (scheduler.go:204-275) ------------------------------
+
+    def _admission_cycle(self, entries: List[Entry], snapshot: Snapshot) -> int:
+        cycle_cohorts_usage: Dict[str, FlavorResourceQuantities] = {}
+        cycle_cohorts_skip_preemption: Set[str] = set()
+        admitted = 0
+        for e in entries:
+            if e.assignment is None:
+                continue
+            mode = e.assignment.representative_mode
+            if mode == NO_FIT:
+                continue
+            cq = snapshot.cluster_queues[e.info.cluster_queue]
+            if cq.cohort is not None:
+                cohort = cq.cohort.name
+                if _has_common_flavor_resources(
+                        cycle_cohorts_usage.get(cohort), e.assignment.usage):
+                    total = _common_usage_sum(
+                        cycle_cohorts_usage[cohort], e.assignment.usage)
+                    if (mode == FIT and not cq.fit_in_cohort(total)) or (
+                            mode == PREEMPT
+                            and cohort in cycle_cohorts_skip_preemption):
+                        e.status = SKIPPED
+                        e.inadmissible_msg = \
+                            "other workloads in the cohort were prioritized"
+                        # Do not skip flavors on the retry (scheduler.go:225-229).
+                        e.info.last_assignment = None
+                        self.metrics.skipped += 1
+                        continue
+                frq_add(cycle_cohorts_usage.setdefault(cohort, {}),
+                        _resources_to_reserve(e, cq))
+            if mode != FIT:
+                if e.preemption_targets:
+                    # Next attempt should try all flavors (scheduler.go:240).
+                    e.info.last_assignment = None
+                    preempted = self._issue_preemptions(e, cq)
+                    if preempted:
+                        e.inadmissible_msg += \
+                            f". Pending the preemption of {preempted} workload(s)"
+                        e.requeue_reason = RequeueReason.PENDING_PREEMPTION
+                    if cq.cohort is not None:
+                        cycle_cohorts_skip_preemption.add(cq.cohort.name)
+                continue
+            e.status = NOMINATED
+            if self._admit(e, cq):
+                admitted += 1
+            if cq.cohort is not None:
+                cycle_cohorts_skip_preemption.add(cq.cohort.name)
+        return admitted
+
+    def _issue_preemptions(self, e: Entry, cq: CachedClusterQueue) -> int:
+        count = 0
+        for target in e.preemption_targets:
+            if not target.obj.is_evicted:
+                origin = "ClusterQueue" if cq.name == target.cluster_queue else "cohort"
+                self.apply_preemption(
+                    target.obj,
+                    f"Preempted to accommodate a higher priority Workload ({origin})")
+            count += 1
+        self.metrics.preempted += count
+        return count
+
+    def _admit(self, e: Entry, cq: CachedClusterQueue) -> bool:
+        """scheduler.go admit (:493-541): assume in cache, then apply."""
+        wl = e.info.obj
+        admission = Admission(
+            cluster_queue=e.info.cluster_queue,
+            pod_set_assignments=[
+                PodSetAssignment(
+                    name=ps.name,
+                    flavors={r: fa.name for r, fa in ps.flavors.items()},
+                    resource_usage=dict(ps.requests),
+                    count=ps.count,
+                )
+                for ps in e.assignment.pod_sets
+            ],
+        )
+        wl.admission = admission
+        wl.set_condition("QuotaReserved", True, reason="QuotaReserved",
+                         now=self.clock())
+        if not cq.admission_checks:
+            wl.set_condition("Admitted", True, reason="Admitted", now=self.clock())
+        try:
+            self.cache.assume_workload(wl)
+        except ValueError as err:
+            wl.admission = None
+            wl.set_condition("QuotaReserved", False, reason="Pending",
+                             message=str(err), now=self.clock())
+            e.inadmissible_msg = f"Failed to admit workload: {err}"
+            return False
+        e.status = ASSUMED
+        ok = self.apply_admission(wl)
+        if not ok:
+            self.cache.forget_workload(wl)
+            # Roll the reservation back off the object so it can requeue
+            # (the reference applies admission to a deep copy instead).
+            wl.admission = None
+            wl.set_condition("QuotaReserved", False, reason="Pending",
+                             message="admission apply failed", now=self.clock())
+            e.status = NOMINATED
+            self._requeue_and_update(e)
+            return False
+        self.metrics.admitted += 1
+        return True
+
+    # -- requeue (scheduler.go:590-607) --------------------------------------
+
+    def _requeue_and_update(self, e: Entry) -> None:
+        if e.status != NOT_NOMINATED and e.requeue_reason == RequeueReason.GENERIC:
+            e.requeue_reason = RequeueReason.FAILED_AFTER_NOMINATION
+        self.queues.requeue_workload(e.info, e.requeue_reason)
+        if e.status in (NOT_NOMINATED, SKIPPED):
+            wl = e.info.obj
+            if wl.has_quota_reservation:
+                wl.admission = None
+                wl.set_condition("QuotaReserved", False, reason="Pending",
+                                 message=e.inadmissible_msg, now=self.clock())
+            self.metrics.inadmissible += 1
+
+
+# -- cohort cycle-usage helpers (scheduler.go:134-173) -----------------------
+
+
+def _has_common_flavor_resources(cohort_usage: Optional[FlavorResourceQuantities],
+                                 assignment: FlavorResourceQuantities) -> bool:
+    if not cohort_usage:
+        return False
+    for flavor, resources in assignment.items():
+        cr = cohort_usage.get(flavor)
+        if cr is None:
+            continue
+        if any(r in cr for r in resources):
+            return True
+    return False
+
+
+def _common_usage_sum(cohort_usage: FlavorResourceQuantities,
+                      assignment: FlavorResourceQuantities,
+                      ) -> FlavorResourceQuantities:
+    out: FlavorResourceQuantities = {}
+    for flavor, resources in assignment.items():
+        cr = cohort_usage.get(flavor)
+        if cr is None:
+            continue
+        common = {r: v + cr[r] for r, v in resources.items() if r in cr}
+        if common:
+            out[flavor] = common
+    return out
+
+
+def _resources_to_reserve(e: Entry,
+                          cq: CachedClusterQueue) -> FlavorResourceQuantities:
+    """How much of the assignment usage actually reserves cohort quota this
+    cycle (scheduler.go:353-387)."""
+    if e.assignment.representative_mode != PREEMPT:
+        return e.assignment.usage
+    reserved: FlavorResourceQuantities = {}
+    for flavor, resources in e.assignment.usage.items():
+        reserved[flavor] = {}
+        for resource, usage in resources.items():
+            rg = cq.rg_by_resource.get(resource)
+            nominal, borrowing_limit = 0, None
+            if rg is not None:
+                for fq in rg.flavors:
+                    if fq.name == flavor:
+                        quota = fq.resources_dict.get(resource)
+                        if quota is not None:
+                            nominal = quota.nominal
+                            borrowing_limit = quota.borrowing_limit
+                        break
+            used = cq.usage.get(flavor, {}).get(resource, 0)
+            if not e.assignment.borrowing:
+                reserved[flavor][resource] = max(0, min(usage, nominal - used))
+            elif borrowing_limit is None:
+                reserved[flavor][resource] = usage
+            else:
+                reserved[flavor][resource] = min(
+                    usage, nominal + borrowing_limit - used)
+    return reserved
+
+
+def _has_retry_or_rejected_checks(wl: Workload) -> bool:
+    return any(s.state in ("Retry", "Rejected")
+               for s in wl.admission_check_states.values())

@@ -1,0 +1,10 @@
+"""Solvers for the per-tick admission problem.
+
+`referee` is the sequential implementation, decision-equivalent to the
+reference Go scheduler; `schema` encodes a cache snapshot plus pending
+workloads into dense integer tensors consumed by the batched JAX models in
+`kueue_tpu.models`.
+"""
+
+from kueue_tpu.solver.modes import NO_FIT, PREEMPT, FIT
+from kueue_tpu.solver.referee import Assignment, PodSetAssignmentResult, assign_flavors

@@ -1,0 +1,61 @@
+"""Host-side flavor eligibility: taints/tolerations and node affinity.
+
+This is the "string world" boundary: eligibility is pure string matching and
+is computed on the host into boolean masks that the tensor solver consumes.
+Semantics mirror the reference flavor selector, which replicates
+kube-scheduler's NodeAffinity filter
+(reference: pkg/scheduler/flavorassigner/flavorassigner.go:396-410,498-542).
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Optional, Set, Tuple
+
+from kueue_tpu.api.types import PodSet, ResourceFlavor, Taint, Toleration
+
+
+def find_untolerated_taint(taints: Iterable[Taint],
+                           tolerations: Iterable[Toleration]) -> Optional[Taint]:
+    """First NoSchedule/NoExecute taint not tolerated, if any."""
+    tols = list(tolerations)
+    for taint in taints:
+        if taint.effect not in ("NoSchedule", "NoExecute"):
+            continue
+        if not any(t.tolerates(taint) for t in tols):
+            return taint
+    return None
+
+
+def _affinity_matches(podset: PodSet, flavor_labels: dict,
+                      allowed_keys: Set[str]) -> bool:
+    # Node-selector map, restricted to the group's label keys: all must match.
+    for k, v in podset.node_selector:
+        if k in allowed_keys and flavor_labels.get(k) != v:
+            return False
+    # Required affinity terms are ORed; expressions within a term are ANDed.
+    # A term that becomes empty after key filtering makes the affinity match
+    # everything (flavorassigner.go:522-529).
+    terms = []
+    for term in podset.affinity_terms:
+        kept = tuple(e for e in term if e.key in allowed_keys)
+        if not kept:
+            terms = []
+            break
+        terms.append(kept)
+    if terms:
+        return any(all(e.matches(flavor_labels) for e in term) for term in terms)
+    return True
+
+
+def flavor_eligible(podset: PodSet, flavor: ResourceFlavor,
+                    allowed_keys: Set[str]) -> Tuple[bool, str]:
+    """Whether this PodSet may be placed on this flavor; returns (ok, reason)."""
+    # Only the pod's own tolerations count; a flavor's `tolerations` are
+    # injected into pods at admission, not used for eligibility
+    # (flavorassigner.go:396-398).
+    taint = find_untolerated_taint(flavor.node_taints, podset.tolerations)
+    if taint is not None:
+        return False, f"untolerated taint {taint.key} in flavor {flavor.name}"
+    if not _affinity_matches(podset, flavor.labels_dict, allowed_keys):
+        return False, f"flavor {flavor.name} doesn't match node affinity"
+    return True, ""

@@ -1,0 +1,8 @@
+"""Flavor assignment modes, ordered by preference
+(reference: pkg/scheduler/flavorassigner/flavorassigner.go:199-209)."""
+
+NO_FIT = 0
+PREEMPT = 1
+FIT = 2
+
+MODE_NAMES = {NO_FIT: "NoFit", PREEMPT: "Preempt", FIT: "Fit"}

@@ -1,0 +1,46 @@
+"""Partial-admission pod-count search.
+
+Counterpart of reference pkg/scheduler/flavorassigner/podset_reducer.go:
+binary-search the largest proportional reduction of PodSet counts (towards
+min_count) that still fits.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Sequence, Tuple, TypeVar
+
+from kueue_tpu.api.types import PodSet
+
+R = TypeVar("R")
+
+
+def search(pod_sets: Sequence[PodSet],
+           fits: Callable[[List[int]], Tuple[Optional[R], bool]],
+           ) -> Tuple[Optional[R], bool]:
+    full_counts = [ps.count for ps in pod_sets]
+    deltas = [ps.count - (ps.min_count if ps.min_count is not None else ps.count)
+              for ps in pod_sets]
+    total_delta = sum(deltas)
+    if total_delta == 0:
+        return None, False
+
+    def counts_for(i: int) -> List[int]:
+        return [full_counts[k] - (deltas[k] * i) // total_delta
+                for k in range(len(deltas))]
+
+    last_good_idx = 0
+    last_r: Optional[R] = None
+
+    # Smallest i in [0, total_delta] with fits(counts_for(i)) true
+    # (Go sort.Search semantics; i==0 is the full count).
+    lo, hi = 0, total_delta + 1
+    while lo < hi:
+        mid = (lo + hi) // 2
+        r, ok = fits(counts_for(mid))
+        if ok:
+            last_good_idx = mid
+            last_r = r
+            hi = mid
+        else:
+            lo = mid + 1
+    return last_r, lo == last_good_idx

@@ -1,0 +1,309 @@
+"""Sequential reference solver ("the referee").
+
+Implements the exact decision semantics of the reference flavor assigner
+(pkg/scheduler/flavorassigner/flavorassigner.go) against this framework's
+data model. The batched JAX models in `kueue_tpu.models` are verified
+decision-equivalent to this implementation, and the scheduler falls back to
+it when the device solve is unavailable.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from kueue_tpu import features
+from kueue_tpu.api.types import FlavorFungibilityPolicy, BorrowWithinCohortPolicy
+from kueue_tpu.core.cache import CachedClusterQueue, FlavorResourceQuantities
+from kueue_tpu.core.workload import (
+    AssignmentClusterQueueState,
+    PodSetResources,
+    WorkloadInfo,
+)
+from kueue_tpu.solver.eligibility import flavor_eligible
+from kueue_tpu.solver.modes import FIT, NO_FIT, PREEMPT
+
+PODS_RESOURCE = "pods"
+
+
+@dataclass
+class FlavorAssignment:
+    name: str
+    mode: int
+    tried_flavor_idx: int = 0
+    borrow: bool = False
+
+
+@dataclass
+class PodSetAssignmentResult:
+    name: str
+    flavors: Dict[str, FlavorAssignment] = field(default_factory=dict)
+    reasons: List[str] = field(default_factory=list)
+    error: Optional[str] = None
+    requests: Dict[str, int] = field(default_factory=dict)
+    count: int = 0
+
+    @property
+    def representative_mode(self) -> int:
+        if self.error is None and not self.reasons:
+            return FIT
+        if not self.flavors:
+            return NO_FIT
+        return min(fa.mode for fa in self.flavors.values())
+
+
+@dataclass
+class Assignment:
+    pod_sets: List[PodSetAssignmentResult] = field(default_factory=list)
+    borrowing: bool = False
+    usage: FlavorResourceQuantities = field(default_factory=dict)
+    last_state: Optional[AssignmentClusterQueueState] = None
+
+    @property
+    def representative_mode(self) -> int:
+        """Worst mode across pod sets (flavorassigner.go:61-78)."""
+        if not self.pod_sets:
+            return NO_FIT
+        return min(ps.representative_mode for ps in self.pod_sets)
+
+    def message(self) -> str:
+        parts = []
+        for ps in self.pod_sets:
+            if ps.error is not None:
+                return f"failed to assign flavors to pod set {ps.name}: {ps.error}"
+            if ps.reasons:
+                parts.append("couldn't assign flavors to pod set %s: %s"
+                             % (ps.name, ", ".join(sorted(ps.reasons))))
+        return "; ".join(parts)
+
+
+def assign_flavors(wi: WorkloadInfo, cq: CachedClusterQueue,
+                   resource_flavors: Dict[str, "ResourceFlavor"],
+                   counts: Optional[List[int]] = None) -> Assignment:
+    """Assign a flavor to every requested resource of every pod set.
+
+    Mirrors FlavorAssigner.Assign (flavorassigner.go:253-329), including the
+    resume-from-last-flavor state keyed on allocatable generations
+    (flavorassigner.go:244-247).
+    """
+    if wi.last_assignment is not None and _last_assignment_outdated(wi, cq):
+        wi.last_assignment = None
+
+    if counts is None:
+        requests = wi.total_requests
+    else:
+        requests = [wi.total_requests[i].scaled_to(c) for i, c in enumerate(counts)]
+
+    assignment = Assignment(
+        usage={},
+        last_state=AssignmentClusterQueueState(
+            cluster_queue_generation=cq.allocatable_generation,
+            cohort_generation=(cq.cohort.allocatable_generation
+                               if cq.cohort is not None else 0),
+        ),
+    )
+
+    for ps_idx, podset in enumerate(requests):
+        ps_requests = dict(podset.requests)
+        if PODS_RESOURCE in cq.rg_by_resource:
+            ps_requests[PODS_RESOURCE] = podset.count
+
+        psa = PodSetAssignmentResult(
+            name=podset.name, requests=ps_requests, count=podset.count)
+
+        for res_name in ps_requests:
+            if res_name in psa.flavors:
+                # Same resource group as an already-assigned resource.
+                continue
+            flavors, reasons, error = _find_flavor_for_podset_resource(
+                wi, cq, resource_flavors, ps_idx, ps_requests, res_name,
+                assignment.usage)
+            if error is not None or not flavors:
+                psa.flavors = {}
+                psa.reasons = reasons
+                psa.error = error
+                break
+            psa.flavors.update(flavors)
+            psa.reasons.extend(reasons)
+
+        _append_podset(assignment, ps_requests, psa)
+        if psa.error is not None or (ps_requests and not psa.flavors):
+            return assignment
+    return assignment
+
+
+def _last_assignment_outdated(wi: WorkloadInfo, cq: CachedClusterQueue) -> bool:
+    la = wi.last_assignment
+    return (cq.allocatable_generation > la.cluster_queue_generation
+            or (cq.cohort is not None
+                and cq.cohort.allocatable_generation > la.cohort_generation))
+
+
+def _append_podset(assignment: Assignment, requests: Dict[str, int],
+                   psa: PodSetAssignmentResult) -> None:
+    """Accumulate usage + resume state (flavorassigner.go:342-356)."""
+    flavor_idx: Dict[str, int] = {}
+    assignment.pod_sets.append(psa)
+    for resource, fa in psa.flavors.items():
+        if fa.borrow:
+            assignment.borrowing = True
+        assignment.usage.setdefault(fa.name, {})
+        assignment.usage[fa.name][resource] = (
+            assignment.usage[fa.name].get(resource, 0) + requests[resource])
+        flavor_idx[resource] = fa.tried_flavor_idx
+    assignment.last_state.last_tried_flavor_idx.append(flavor_idx)
+
+
+def _find_flavor_for_podset_resource(
+        wi: WorkloadInfo, cq: CachedClusterQueue,
+        resource_flavors: Dict[str, "ResourceFlavor"],
+        ps_idx: int, requests: Dict[str, int], res_name: str,
+        assignment_usage: FlavorResourceQuantities,
+) -> Tuple[Dict[str, FlavorAssignment], List[str], Optional[str]]:
+    """Try the resource group's flavors in order for all grouped resources
+    (flavorassigner.go:363-476). Returns (assignments, reasons, error)."""
+    rg = cq.rg_by_resource.get(res_name)
+    if rg is None:
+        return {}, [f"resource {res_name} unavailable in ClusterQueue"], None
+
+    grouped = {r: v for r, v in requests.items() if r in rg.covered_resources}
+    podset = wi.obj.pod_sets[ps_idx]
+    allowed_keys = cq.label_keys(rg, resource_flavors)
+
+    reasons: List[str] = []
+    best_assignment: Dict[str, FlavorAssignment] = {}
+    best_mode = NO_FIT
+    assigned_flavor_idx = -1
+    fungibility = features.enabled(features.FLAVOR_FUNGIBILITY)
+
+    idx = 0
+    if wi.last_assignment is not None:
+        idx = wi.last_assignment.next_flavor_to_try(ps_idx, res_name)
+
+    num_flavors = len(rg.flavors)
+    while idx < num_flavors:
+        fq = rg.flavors[idx]
+        flavor = resource_flavors.get(fq.name)
+        if flavor is None:
+            reasons.append(f"flavor {fq.name} not found")
+            idx += 1
+            continue
+        ok, why = flavor_eligible(podset, flavor, allowed_keys)
+        if not ok:
+            reasons.append(why)
+            idx += 1
+            continue
+
+        assigned_flavor_idx = idx
+        needs_borrowing = False
+        assignments: Dict[str, FlavorAssignment] = {}
+        representative_mode = FIT
+        quotas = fq.resources_dict
+        for rname, val in grouped.items():
+            quota = quotas.get(rname)
+            prev = assignment_usage.get(fq.name, {}).get(rname, 0)
+            mode, borrow, reason = _fits_resource_quota(
+                cq, fq.name, rname, val + prev, quota)
+            if reason is not None:
+                reasons.append(reason)
+            representative_mode = min(representative_mode, mode)
+            needs_borrowing = needs_borrowing or borrow
+            if representative_mode == NO_FIT:
+                break
+            assignments[rname] = FlavorAssignment(
+                name=fq.name, mode=mode, borrow=borrow)
+
+        if fungibility:
+            if not _should_try_next_flavor(
+                    representative_mode, cq.flavor_fungibility, needs_borrowing):
+                best_assignment = assignments
+                best_mode = representative_mode
+                break
+            if representative_mode > best_mode:
+                best_assignment = assignments
+                best_mode = representative_mode
+        else:
+            if representative_mode > best_mode:
+                best_assignment = assignments
+                best_mode = representative_mode
+                if best_mode == FIT:
+                    return best_assignment, [], None
+        idx += 1
+
+    if fungibility:
+        for fa in best_assignment.values():
+            if assigned_flavor_idx == num_flavors - 1:
+                # Whole list exhausted: restart from the first flavor next time
+                # (flavorassigner.go:462-470).
+                fa.tried_flavor_idx = -1
+            else:
+                fa.tried_flavor_idx = assigned_flavor_idx
+        if best_mode == FIT:
+            return best_assignment, [], None
+    return best_assignment, reasons, None
+
+
+def _should_try_next_flavor(representative_mode: int, fungibility,
+                            needs_borrowing: bool) -> bool:
+    """flavorassigner.go:478-496."""
+    policy_preempt = fungibility.when_can_preempt
+    policy_borrow = fungibility.when_can_borrow
+    if representative_mode == PREEMPT and policy_preempt == FlavorFungibilityPolicy.PREEMPT:
+        if not needs_borrowing or policy_borrow == FlavorFungibilityPolicy.BORROW:
+            return False
+    if representative_mode == FIT and needs_borrowing \
+            and policy_borrow == FlavorFungibilityPolicy.BORROW:
+        return False
+    if representative_mode == FIT and not needs_borrowing:
+        return False
+    return True
+
+
+def _fits_resource_quota(cq: CachedClusterQueue, flavor: str, resource: str,
+                         val: int, quota) -> Tuple[int, bool, Optional[str]]:
+    """Mode for one (flavor, resource) given CQ and cohort state
+    (flavorassigner.go:550-600)."""
+    borrow = False
+    used = cq.usage.get(flavor, {}).get(resource, 0)
+    nominal = quota.nominal if quota is not None else 0
+    borrowing_limit = quota.borrowing_limit if quota is not None else None
+
+    mode = NO_FIT
+    if val <= nominal:
+        # Could fit if quota is reclaimed from the cohort or CQ workloads
+        # are preempted.
+        mode = PREEMPT
+
+    cohort_available = nominal
+    if cq.cohort is not None:
+        cohort_available = cq.requestable_cohort_quota(flavor, resource)
+
+    bwc = cq.preemption.borrow_within_cohort
+    if bwc is not None and bwc.policy != BorrowWithinCohortPolicy.NEVER:
+        # Preemption-with-borrowing can admit beyond nominal quota.
+        if (borrowing_limit is None or val <= nominal + borrowing_limit) \
+                and val <= cohort_available:
+            mode = PREEMPT
+            borrow = val > nominal
+
+    if borrowing_limit is not None and used + val > nominal + borrowing_limit:
+        return mode, borrow, (f"borrowing limit for {resource} in flavor "
+                              f"{flavor} exceeded")
+
+    cohort_used = used
+    if cq.cohort is not None:
+        cohort_used = cq.used_cohort_quota(flavor, resource)
+
+    lack = cohort_used + val - cohort_available
+    if lack <= 0:
+        return FIT, used + val > nominal, None
+
+    if cq.cohort is None:
+        if mode == NO_FIT:
+            msg = f"insufficient quota for {resource} in flavor {flavor} in ClusterQueue"
+        else:
+            msg = f"insufficient unused quota for {resource} in flavor {flavor}, {lack} more needed"
+    else:
+        msg = (f"insufficient unused quota in cohort for {resource} in flavor "
+               f"{flavor}, {lack} more needed")
+    return mode, borrow, msg

@@ -1,0 +1,3 @@
+"""Generic helpers."""
+
+from kueue_tpu.utils.heap import KeyedHeap

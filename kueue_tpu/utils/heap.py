@@ -1,0 +1,118 @@
+"""A keyed binary heap with in-place update/delete.
+
+Counterpart of reference pkg/util/heap (heap.go): items are addressed by a
+string key; ordering comes from a user `less` function. Used for the pending
+queues.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Generic, List, Optional, TypeVar
+
+T = TypeVar("T")
+
+
+class KeyedHeap(Generic[T]):
+    def __init__(self, key_fn: Callable[[T], str], less: Callable[[T, T], bool]):
+        self._key_fn = key_fn
+        self._less = less
+        self._items: List[T] = []
+        self._index: Dict[str, int] = {}
+
+    def __len__(self) -> int:
+        return len(self._items)
+
+    def __contains__(self, key: str) -> bool:
+        return key in self._index
+
+    def get_by_key(self, key: str) -> Optional[T]:
+        i = self._index.get(key)
+        return None if i is None else self._items[i]
+
+    def items(self) -> List[T]:
+        return list(self._items)
+
+    def push_if_not_present(self, item: T) -> bool:
+        key = self._key_fn(item)
+        if key in self._index:
+            return False
+        self._push(key, item)
+        return True
+
+    def push_or_update(self, item: T) -> None:
+        key = self._key_fn(item)
+        i = self._index.get(key)
+        if i is None:
+            self._push(key, item)
+        else:
+            self._items[i] = item
+            self._fix(i)
+
+    def delete(self, key: str) -> Optional[T]:
+        i = self._index.get(key)
+        if i is None:
+            return None
+        return self._remove_at(i)
+
+    def peek(self) -> Optional[T]:
+        return self._items[0] if self._items else None
+
+    def pop(self) -> Optional[T]:
+        if not self._items:
+            return None
+        return self._remove_at(0)
+
+    # -- internals ----------------------------------------------------------
+
+    def _push(self, key: str, item: T) -> None:
+        self._items.append(item)
+        i = len(self._items) - 1
+        self._index[key] = i
+        self._up(i)
+
+    def _remove_at(self, i: int) -> T:
+        item = self._items[i]
+        del self._index[self._key_fn(item)]
+        last = self._items.pop()
+        if i < len(self._items):
+            self._items[i] = last
+            self._index[self._key_fn(last)] = i
+            self._fix(i)
+        return item
+
+    def _fix(self, i: int) -> None:
+        if not self._down(i):
+            self._up(i)
+
+    def _up(self, i: int) -> None:
+        items = self._items
+        while i > 0:
+            parent = (i - 1) // 2
+            if not self._less(items[i], items[parent]):
+                break
+            self._swap(i, parent)
+            i = parent
+
+    def _down(self, i: int) -> bool:
+        items = self._items
+        n = len(items)
+        start = i
+        while True:
+            left = 2 * i + 1
+            if left >= n:
+                break
+            smallest = left
+            right = left + 1
+            if right < n and self._less(items[right], items[left]):
+                smallest = right
+            if not self._less(items[smallest], items[i]):
+                break
+            self._swap(i, smallest)
+            i = smallest
+        return i > start
+
+    def _swap(self, i: int, j: int) -> None:
+        items = self._items
+        items[i], items[j] = items[j], items[i]
+        self._index[self._key_fn(items[i])] = i
+        self._index[self._key_fn(items[j])] = j

@@ -1,0 +1,19 @@
+import os
+
+# Solver tests run on a virtual 8-device CPU mesh; must be set before jax import.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import pytest
+
+from kueue_tpu import features
+
+
+@pytest.fixture(autouse=True)
+def reset_features():
+    features.reset()
+    yield
+    features.reset()

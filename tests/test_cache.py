@@ -1,0 +1,135 @@
+from kueue_tpu import features
+from kueue_tpu.api.types import Admission, PodSetAssignment
+from kueue_tpu.core.cache import Cache
+
+from tests.util import fq, make_cq, make_flavor, make_lq, make_wl, rg
+
+
+def admit(wl, cq_name, flavor, admitted=True):
+    wl.admission = Admission(
+        cluster_queue=cq_name,
+        pod_set_assignments=[
+            PodSetAssignment(
+                name=ps.name,
+                flavors={r: flavor for r in ps.requests},
+                resource_usage={r: v * ps.count for r, v in ps.requests.items()},
+                count=ps.count,
+            ) for ps in wl.pod_sets
+        ])
+    wl.set_condition("QuotaReserved", True)
+    if admitted:
+        wl.set_condition("Admitted", True)
+    return wl
+
+
+def build_cache():
+    cache = Cache()
+    cache.add_or_update_resource_flavor(make_flavor("default"))
+    cache.add_cluster_queue(make_cq(
+        "cq-a", rg(("cpu", "memory"), fq("default", cpu=10, memory="10Gi")),
+        cohort="co"))
+    cache.add_cluster_queue(make_cq(
+        "cq-b", rg(("cpu", "memory"), fq("default", cpu=5, memory="5Gi")),
+        cohort="co"))
+    cache.add_local_queue(make_lq("main", cq="cq-a"))
+    return cache
+
+
+def test_usage_accounting():
+    cache = build_cache()
+    wl = admit(make_wl("w1", cpu=2, memory="1Gi"), "cq-a", "default")
+    assert cache.add_or_update_workload(wl)
+    assert cache.usage("cq-a")["default"]["cpu"] == 2000
+    assert cache.usage("cq-a")["default"]["memory"] == 1024**3
+    cache.delete_workload(wl)
+    assert cache.usage("cq-a")["default"]["cpu"] == 0
+
+
+def test_assume_and_forget():
+    cache = build_cache()
+    wl = admit(make_wl("w1", cpu=2), "cq-a", "default")
+    cache.assume_workload(wl)
+    assert cache.is_assumed_or_admitted(wl)
+    assert cache.usage("cq-a")["default"]["cpu"] == 2000
+    cache.forget_workload(wl)
+    assert not cache.is_assumed_or_admitted(wl)
+    assert cache.usage("cq-a")["default"]["cpu"] == 0
+
+
+def test_snapshot_cohort_aggregation():
+    cache = build_cache()
+    wl = admit(make_wl("w1", cpu=2), "cq-a", "default")
+    cache.add_or_update_workload(wl)
+    snap = cache.snapshot()
+    cqa = snap.cluster_queues["cq-a"]
+    assert cqa.cohort is not None
+    # Cohort requestable = 10 + 5 CPUs.
+    assert cqa.cohort.requestable_resources["default"]["cpu"] == 15000
+    assert cqa.cohort.usage["default"]["cpu"] == 2000
+    assert cqa.requestable_cohort_quota("default", "cpu") == 15000
+    assert cqa.used_cohort_quota("default", "cpu") == 2000
+
+
+def test_snapshot_isolated_from_cache():
+    cache = build_cache()
+    snap = cache.snapshot()
+    wl = admit(make_wl("w1", cpu=2), "cq-a", "default")
+    cache.add_or_update_workload(wl)
+    assert snap.cluster_queues["cq-a"].usage["default"]["cpu"] == 0
+
+
+def test_snapshot_remove_add_workload_roundtrip():
+    cache = build_cache()
+    wl = admit(make_wl("w1", cpu=2), "cq-a", "default")
+    cache.add_or_update_workload(wl)
+    snap = cache.snapshot()
+    cqa = snap.cluster_queues["cq-a"]
+    wi = cqa.workloads[wl.key]
+    snap.remove_workload(wi)
+    assert cqa.usage["default"]["cpu"] == 0
+    assert cqa.cohort.usage["default"]["cpu"] == 0
+    snap.add_workload(wi)
+    assert cqa.usage["default"]["cpu"] == 2000
+    assert cqa.cohort.usage["default"]["cpu"] == 2000
+
+
+def test_lending_limit_guaranteed_quota():
+    features.set_enabled(features.LENDING_LIMIT, True)
+    cache = Cache()
+    cache.add_or_update_resource_flavor(make_flavor("default"))
+    # cq-a lends at most 4 of its 10 CPUs; 6 are guaranteed.
+    cache.add_cluster_queue(make_cq(
+        "cq-a", rg("cpu", fq("default", cpu=(10, None, 4))), cohort="co"))
+    cache.add_cluster_queue(make_cq(
+        "cq-b", rg("cpu", fq("default", cpu=5)), cohort="co"))
+    snap = cache.snapshot()
+    cqa = snap.cluster_queues["cq-a"]
+    cqb = snap.cluster_queues["cq-b"]
+    # Cohort requestable counts cq-a's lending limit (4), not nominal (10).
+    assert cqa.cohort.requestable_resources["default"]["cpu"] == 4000 + 5000
+    # From cq-a's view: lendable pool + own guaranteed 6.
+    assert cqa.requestable_cohort_quota("default", "cpu") == 9000 + 6000
+    # From cq-b's view: no guaranteed quota of its own.
+    assert cqb.requestable_cohort_quota("default", "cpu") == 9000
+
+
+def test_lending_limit_cohort_usage():
+    features.set_enabled(features.LENDING_LIMIT, True)
+    cache = Cache()
+    cache.add_or_update_resource_flavor(make_flavor("default"))
+    cache.add_cluster_queue(make_cq(
+        "cq-a", rg("cpu", fq("default", cpu=(10, None, 4))), cohort="co"))
+    cache.add_cluster_queue(make_cq(
+        "cq-b", rg("cpu", fq("default", cpu=5)), cohort="co"))
+    cache.add_local_queue(make_lq("main", cq="cq-a"))
+    # Usage of 8 CPUs: 6 guaranteed + 2 above.
+    wl = admit(make_wl("w1", cpu=8), "cq-a", "default")
+    cache.add_or_update_workload(wl)
+    snap = cache.snapshot()
+    cqa = snap.cluster_queues["cq-a"]
+    # Cohort usage only tracks what exceeds guaranteed: 8 - 6 = 2.
+    assert cqa.cohort.usage["default"]["cpu"] == 2000
+    # cq-a's own used-cohort view adds min(usage, guaranteed) = 6.
+    assert cqa.used_cohort_quota("default", "cpu") == 8000
+    cqb = snap.cluster_queues["cq-b"]
+    assert cqb.used_cohort_quota("default", "cpu") == 2000

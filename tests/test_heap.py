@@ -1,0 +1,66 @@
+import random
+
+from kueue_tpu.utils.heap import KeyedHeap
+
+
+def make_heap():
+    return KeyedHeap(key_fn=lambda x: x[0], less=lambda a, b: a[1] < b[1])
+
+
+def test_push_pop_order():
+    h = make_heap()
+    items = [(f"k{i}", v) for i, v in enumerate([5, 3, 8, 1, 9, 2])]
+    for it in items:
+        assert h.push_if_not_present(it)
+    popped = [h.pop()[1] for _ in range(len(items))]
+    assert popped == sorted(v for _, v in items)
+    assert h.pop() is None
+
+
+def test_push_if_not_present_dedup():
+    h = make_heap()
+    assert h.push_if_not_present(("a", 1))
+    assert not h.push_if_not_present(("a", 2))
+    assert h.get_by_key("a") == ("a", 1)
+
+
+def test_update_reorders():
+    h = make_heap()
+    h.push_or_update(("a", 10))
+    h.push_or_update(("b", 5))
+    h.push_or_update(("a", 1))
+    assert h.pop() == ("a", 1)
+
+
+def test_delete():
+    h = make_heap()
+    for i in range(10):
+        h.push_if_not_present((f"k{i}", i))
+    h.delete("k0")
+    h.delete("k5")
+    assert len(h) == 8
+    assert h.pop() == ("k1", 1)
+
+
+def test_randomized_against_sort():
+    rnd = random.Random(42)
+    h = make_heap()
+    live = {}
+    for step in range(2000):
+        op = rnd.random()
+        key = f"k{rnd.randrange(50)}"
+        if op < 0.5:
+            val = rnd.randrange(1000)
+            h.push_or_update((key, val))
+            live[key] = val
+        elif op < 0.75 and live:
+            h.delete(key)
+            live.pop(key, None)
+        elif live:
+            item = h.pop()
+            assert item[1] == min(live.values())
+            del live[item[0]]
+    while live:
+        item = h.pop()
+        assert item[1] == min(live.values())
+        del live[item[0]]

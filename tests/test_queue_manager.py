@@ -1,0 +1,107 @@
+from kueue_tpu.core.workload import WorkloadInfo, WorkloadOrdering
+from kueue_tpu.queue.manager import Manager, RequeueReason
+
+from tests.util import make_cq, make_lq, make_wl, rg, fq
+
+
+def build_manager(strategy="BestEffortFIFO", cohort=""):
+    m = Manager()
+    m.add_cluster_queue(make_cq(
+        "cq", rg("cpu", fq("default", cpu=10)), strategy=strategy,
+        cohort=cohort))
+    m.add_local_queue(make_lq("main", cq="cq"))
+    return m
+
+
+def test_heads_priority_then_fifo():
+    m = build_manager()
+    m.add_or_update_workload(make_wl("old-low", priority=0, creation_time=1.0))
+    m.add_or_update_workload(make_wl("new-high", priority=5, creation_time=2.0))
+    m.add_or_update_workload(make_wl("newer-high", priority=5, creation_time=3.0))
+    heads = m.heads(timeout=0)
+    assert [h.obj.name for h in heads] == ["new-high"]
+    assert m.heads(timeout=0)[0].obj.name == "newer-high"
+    assert m.heads(timeout=0)[0].obj.name == "old-low"
+    assert m.heads(timeout=0) == []
+
+
+def test_one_head_per_cq():
+    m = Manager()
+    for name in ("cq-a", "cq-b"):
+        m.add_cluster_queue(make_cq(name, rg("cpu", fq("default", cpu=10))))
+    m.add_local_queue(make_lq("a", cq="cq-a"))
+    m.add_local_queue(make_lq("b", cq="cq-b"))
+    m.add_or_update_workload(make_wl("wa1", "a"))
+    m.add_or_update_workload(make_wl("wa2", "a"))
+    m.add_or_update_workload(make_wl("wb1", "b"))
+    heads = m.heads(timeout=0)
+    assert sorted(h.obj.name for h in heads) == ["wa1", "wb1"]
+
+
+def test_best_effort_parks_inadmissible():
+    m = build_manager(strategy="BestEffortFIFO")
+    wl = make_wl("w")
+    m.add_or_update_workload(wl)
+    wi = m.heads(timeout=0)[0]
+    # Generic requeue -> parked as inadmissible, not in the heap.
+    assert m.requeue_workload(wi, RequeueReason.GENERIC)
+    assert m.heads(timeout=0) == []
+    assert m.pending("cq") == 1
+    # A relevant event (workload finished in the cohort) flushes the parking lot.
+    m.queue_inadmissible_workloads(["cq"])
+    assert [h.obj.name for h in m.heads(timeout=0)] == ["w"]
+
+
+def test_best_effort_requeues_after_nomination_failure():
+    m = build_manager(strategy="BestEffortFIFO")
+    m.add_or_update_workload(make_wl("w"))
+    wi = m.heads(timeout=0)[0]
+    assert m.requeue_workload(wi, RequeueReason.FAILED_AFTER_NOMINATION)
+    assert [h.obj.name for h in m.heads(timeout=0)] == ["w"]
+
+
+def test_strict_fifo_requeues_immediately():
+    m = build_manager(strategy="StrictFIFO")
+    m.add_or_update_workload(make_wl("w"))
+    wi = m.heads(timeout=0)[0]
+    assert m.requeue_workload(wi, RequeueReason.GENERIC)
+    assert [h.obj.name for h in m.heads(timeout=0)] == ["w"]
+
+
+def test_race_guard_requeues_when_flush_during_schedule():
+    # If a flush happens between Pop and requeue, the workload must go back
+    # to the heap, not the parking lot (cluster_queue_impl.go:49-57).
+    m = build_manager(strategy="BestEffortFIFO")
+    m.add_or_update_workload(make_wl("w"))
+    wi = m.heads(timeout=0)[0]
+    m.queue_inadmissible_workloads(["cq"])  # concurrent event mid-cycle
+    assert m.requeue_workload(wi, RequeueReason.GENERIC)
+    assert [h.obj.name for h in m.heads(timeout=0)] == ["w"]
+
+
+def test_requeue_with_pending_flavors_goes_to_heap():
+    from kueue_tpu.core.workload import AssignmentClusterQueueState
+    m = build_manager(strategy="BestEffortFIFO")
+    m.add_or_update_workload(make_wl("w"))
+    wi = m.heads(timeout=0)[0]
+    wi.last_assignment = AssignmentClusterQueueState(
+        last_tried_flavor_idx=[{"cpu": 0}])
+    # Untried flavors remain: retry immediately.
+    assert m.requeue_workload(wi, RequeueReason.GENERIC)
+    assert [h.obj.name for h in m.heads(timeout=0)] == ["w"]
+
+
+def test_cohort_flush():
+    m = Manager()
+    m.add_cluster_queue(make_cq("cq-a", rg("cpu", fq("default", cpu=1)), cohort="co"))
+    m.add_cluster_queue(make_cq("cq-b", rg("cpu", fq("default", cpu=1)), cohort="co"))
+    m.add_local_queue(make_lq("a", cq="cq-a"))
+    m.add_local_queue(make_lq("b", cq="cq-b"))
+    m.add_or_update_workload(make_wl("wa", "a"))
+    wi = m.heads(timeout=0)[0]
+    m.requeue_workload(wi, RequeueReason.GENERIC)
+    assert m.heads(timeout=0) == []
+    # Finishing a workload on cq-b's local queue flushes the whole cohort.
+    finished = make_wl("wb", "b")
+    m.queue_associated_inadmissible_workloads(finished)
+    assert [h.obj.name for h in m.heads(timeout=0)] == ["wa"]

@@ -1,0 +1,309 @@
+"""Flavor-assigner referee tests: scenarios modeled on the reference's
+flavorassigner_test.go semantics."""
+
+from kueue_tpu import features
+from kueue_tpu.api.types import (
+    BorrowWithinCohort,
+    ClusterQueuePreemption,
+    FlavorFungibility,
+    MatchExpression,
+    PodSet,
+    Taint,
+    Toleration,
+    Workload,
+)
+from kueue_tpu.core.cache import Cache
+from kueue_tpu.core.workload import WorkloadInfo
+from kueue_tpu.solver.modes import FIT, NO_FIT, PREEMPT
+from kueue_tpu.solver.referee import assign_flavors
+
+from tests.util import fq, make_cq, make_flavor, make_lq, make_wl, rg
+from tests.test_cache import admit
+
+
+def solve(cache, wl, cq_name, counts=None):
+    snap = cache.snapshot()
+    cq = snap.cluster_queues[cq_name]
+    wi = WorkloadInfo(wl, cluster_queue=cq_name)
+    return assign_flavors(wi, cq, snap.resource_flavors, counts)
+
+
+def single_cq_cache(quota_cpu=4, **cq_kwargs):
+    cache = Cache()
+    cache.add_or_update_resource_flavor(make_flavor("default"))
+    cache.add_cluster_queue(
+        make_cq("cq", rg("cpu", fq("default", cpu=quota_cpu)), **cq_kwargs))
+    cache.add_local_queue(make_lq("main", cq="cq"))
+    return cache
+
+
+def test_single_flavor_fit():
+    cache = single_cq_cache()
+    a = solve(cache, make_wl("w", cpu=2), "cq")
+    assert a.representative_mode == FIT
+    assert a.pod_sets[0].flavors["cpu"].name == "default"
+    assert not a.borrowing
+    assert a.usage == {"default": {"cpu": 2000}}
+
+
+def test_no_fit_exceeds_nominal():
+    cache = single_cq_cache(quota_cpu=1)
+    a = solve(cache, make_wl("w", cpu=2), "cq")
+    assert a.representative_mode == NO_FIT
+    assert "insufficient quota" in a.message()
+
+
+def test_preempt_mode_when_used():
+    cache = single_cq_cache(quota_cpu=4)
+    cache.add_or_update_workload(admit(make_wl("w0", cpu=3), "cq", "default"))
+    a = solve(cache, make_wl("w", cpu=2), "cq")
+    assert a.representative_mode == PREEMPT
+    assert a.pod_sets[0].flavors["cpu"].mode == PREEMPT
+
+
+def test_multiple_resources_same_flavor():
+    cache = Cache()
+    cache.add_or_update_resource_flavor(make_flavor("default"))
+    cache.add_cluster_queue(make_cq(
+        "cq", rg(("cpu", "memory"), fq("default", cpu=4, memory="4Gi"))))
+    a = solve(cache, make_wl("w", cpu=2, memory="1Gi"), "cq")
+    assert a.representative_mode == FIT
+    flavors = a.pod_sets[0].flavors
+    assert flavors["cpu"].name == "default"
+    assert flavors["memory"].name == "default"
+
+
+def test_one_resource_no_fit_fails_podset():
+    cache = Cache()
+    cache.add_or_update_resource_flavor(make_flavor("default"))
+    cache.add_cluster_queue(make_cq(
+        "cq", rg(("cpu", "memory"), fq("default", cpu=4, memory="1Gi"))))
+    a = solve(cache, make_wl("w", cpu=2, memory="2Gi"), "cq")
+    assert a.representative_mode == NO_FIT
+
+
+def test_resource_not_in_cq():
+    cache = single_cq_cache()
+    a = solve(cache, make_wl("w", cpu=1, **{"nvidia_com/gpu": 1}), "cq")
+    # gpu resource isn't configured on the CQ.
+    assert a.representative_mode == NO_FIT
+    assert "unavailable in ClusterQueue" in a.message()
+
+
+def test_second_flavor_when_first_full():
+    cache = Cache()
+    cache.add_or_update_resource_flavor(make_flavor("on-demand"))
+    cache.add_or_update_resource_flavor(make_flavor("spot"))
+    cache.add_cluster_queue(make_cq(
+        "cq", rg("cpu", fq("on-demand", cpu=2), fq("spot", cpu=10))))
+    a = solve(cache, make_wl("w", cpu=4), "cq")
+    assert a.representative_mode == FIT
+    assert a.pod_sets[0].flavors["cpu"].name == "spot"
+
+
+def test_taint_skips_flavor():
+    cache = Cache()
+    cache.add_or_update_resource_flavor(
+        ResourceFlavor := make_flavor("tainted"))
+    # Recreate with taints.
+    from kueue_tpu.api.types import ResourceFlavor as RF
+    cache.add_or_update_resource_flavor(RF.make(
+        "tainted", node_taints=[Taint(key="gpu", value="true")]))
+    cache.add_or_update_resource_flavor(make_flavor("clean"))
+    cache.add_cluster_queue(make_cq(
+        "cq", rg("cpu", fq("tainted", cpu=10), fq("clean", cpu=10))))
+    a = solve(cache, make_wl("w", cpu=2), "cq")
+    assert a.pod_sets[0].flavors["cpu"].name == "clean"
+
+    # A workload tolerating the taint takes the first flavor.
+    wl = make_wl("w2", pod_sets=[PodSet.make(
+        "main", count=1, cpu=2,
+        tolerations=[Toleration(key="gpu", operator="Equal", value="true")])])
+    a2 = solve(cache, wl, "cq")
+    assert a2.pod_sets[0].flavors["cpu"].name == "tainted"
+
+
+def test_node_affinity_selects_flavor():
+    from kueue_tpu.api.types import ResourceFlavor as RF
+    cache = Cache()
+    cache.add_or_update_resource_flavor(RF.make("east", node_labels={"zone": "east"}))
+    cache.add_or_update_resource_flavor(RF.make("west", node_labels={"zone": "west"}))
+    cache.add_cluster_queue(make_cq(
+        "cq", rg("cpu", fq("east", cpu=10), fq("west", cpu=10))))
+    wl = make_wl("w", pod_sets=[PodSet.make(
+        "main", count=1, cpu=2, node_selector={"zone": "west"})])
+    a = solve(cache, wl, "cq")
+    assert a.pod_sets[0].flavors["cpu"].name == "west"
+
+    wl2 = make_wl("w2", pod_sets=[PodSet.make(
+        "main", count=1, cpu=2,
+        affinity_terms=[[MatchExpression("zone", "In", ("west",))]])])
+    a2 = solve(cache, wl2, "cq")
+    assert a2.pod_sets[0].flavors["cpu"].name == "west"
+
+
+def test_borrowing_in_cohort():
+    cache = Cache()
+    cache.add_or_update_resource_flavor(make_flavor("default"))
+    cache.add_cluster_queue(make_cq(
+        "cq-a", rg("cpu", fq("default", cpu=4)), cohort="co"))
+    cache.add_cluster_queue(make_cq(
+        "cq-b", rg("cpu", fq("default", cpu=4)), cohort="co"))
+    a = solve(cache, make_wl("w", cpu=6), "cq-a")
+    assert a.representative_mode == FIT
+    assert a.borrowing
+    assert a.pod_sets[0].flavors["cpu"].borrow
+
+
+def test_borrowing_limit_blocks():
+    cache = Cache()
+    cache.add_or_update_resource_flavor(make_flavor("default"))
+    cache.add_cluster_queue(make_cq(
+        "cq-a", rg("cpu", fq("default", cpu=(4, 1))), cohort="co"))
+    cache.add_cluster_queue(make_cq(
+        "cq-b", rg("cpu", fq("default", cpu=4)), cohort="co"))
+    a = solve(cache, make_wl("w", cpu=6), "cq-a")
+    assert a.representative_mode == NO_FIT
+    assert "borrowing limit" in a.message()
+
+
+def test_cohort_usage_limits_borrowing():
+    cache = Cache()
+    cache.add_or_update_resource_flavor(make_flavor("default"))
+    cache.add_cluster_queue(make_cq(
+        "cq-a", rg("cpu", fq("default", cpu=4)), cohort="co"))
+    cache.add_cluster_queue(make_cq(
+        "cq-b", rg("cpu", fq("default", cpu=4)), cohort="co"))
+    cache.add_local_queue(make_lq("a", cq="cq-a"))
+    cache.add_local_queue(make_lq("b", cq="cq-b"))
+    cache.add_or_update_workload(admit(make_wl("wa", "a", cpu=1), "cq-a", "default"))
+    cache.add_or_update_workload(admit(make_wl("wb", "b", cpu=4), "cq-b", "default"))
+    # Cohort has 8 total, 5 used. 6 > nominal and borrowWithinCohort is off:
+    # NoFit.
+    a = solve(cache, make_wl("w", "a", cpu=6), "cq-a")
+    assert a.representative_mode == NO_FIT
+    # 4 fits nominal but not unused cohort quota (5+4 > 8): Preempt.
+    a2 = solve(cache, make_wl("w2", "a", cpu=4), "cq-a")
+    assert a2.representative_mode == PREEMPT
+
+
+def test_borrow_within_cohort_enables_preempt_with_borrow():
+    cache = Cache()
+    cache.add_or_update_resource_flavor(make_flavor("default"))
+    preemption = ClusterQueuePreemption(
+        reclaim_within_cohort="Any",
+        borrow_within_cohort=BorrowWithinCohort(policy="LowerPriority"))
+    cache.add_cluster_queue(make_cq(
+        "cq-a", rg("cpu", fq("default", cpu=4)), cohort="co",
+        preemption=preemption))
+    cache.add_cluster_queue(make_cq(
+        "cq-b", rg("cpu", fq("default", cpu=4)), cohort="co"))
+    cache.add_local_queue(make_lq("b", cq="cq-b"))
+    cache.add_or_update_workload(admit(make_wl("wb", "b", cpu=4), "cq-b", "default"))
+    # 6 > nominal 4, but within cohort capacity 8: preempt-with-borrow.
+    a = solve(cache, make_wl("w", cpu=6), "cq-a")
+    assert a.representative_mode == PREEMPT
+    assert a.pod_sets[0].flavors["cpu"].borrow
+
+
+def test_fungibility_stop_at_first_fit_with_borrow():
+    # Default whenCanBorrow=Borrow: stop at first flavor that fits, even
+    # borrowing.
+    cache = Cache()
+    cache.add_or_update_resource_flavor(make_flavor("f1"))
+    cache.add_or_update_resource_flavor(make_flavor("f2"))
+    cache.add_cluster_queue(make_cq(
+        "cq-a", rg("cpu", fq("f1", cpu=2), fq("f2", cpu=10)), cohort="co"))
+    cache.add_cluster_queue(make_cq(
+        "cq-b", rg("cpu", fq("f1", cpu=10), fq("f2", cpu=0)), cohort="co"))
+    a = solve(cache, make_wl("w", cpu=4), "cq-a")
+    assert a.representative_mode == FIT
+    assert a.pod_sets[0].flavors["cpu"].name == "f1"
+    assert a.borrowing
+
+
+def test_fungibility_try_next_when_borrow():
+    cache = Cache()
+    cache.add_or_update_resource_flavor(make_flavor("f1"))
+    cache.add_or_update_resource_flavor(make_flavor("f2"))
+    fung = FlavorFungibility(when_can_borrow="TryNextFlavor")
+    cache.add_cluster_queue(make_cq(
+        "cq-a", rg("cpu", fq("f1", cpu=2), fq("f2", cpu=10)), cohort="co",
+        fungibility=fung))
+    cache.add_cluster_queue(make_cq(
+        "cq-b", rg("cpu", fq("f1", cpu=10), fq("f2", cpu=0)), cohort="co"))
+    a = solve(cache, make_wl("w", cpu=4), "cq-a")
+    # f2 fits without borrowing and is preferred under TryNextFlavor.
+    assert a.representative_mode == FIT
+    assert a.pod_sets[0].flavors["cpu"].name == "f2"
+    assert not a.borrowing
+
+
+def test_last_state_resume_index():
+    cache = Cache()
+    cache.add_or_update_resource_flavor(make_flavor("f1"))
+    cache.add_or_update_resource_flavor(make_flavor("f2"))
+    cache.add_or_update_resource_flavor(make_flavor("f3"))
+    fung = FlavorFungibility(when_can_preempt="Preempt")
+    cache.add_cluster_queue(make_cq(
+        "cq", rg("cpu", fq("f1", cpu=2), fq("f2", cpu=4), fq("f3", cpu=10)),
+        fungibility=fung))
+    cache.add_local_queue(make_lq("main", cq="cq"))
+    cache.add_or_update_workload(admit(make_wl("w0", cpu=4), "cq", "f2"))
+    wl = make_wl("w", cpu=4)
+    snap = cache.snapshot()
+    wi = WorkloadInfo(wl, cluster_queue="cq")
+    a = assign_flavors(wi, snap.cluster_queues["cq"], snap.resource_flavors)
+    # f1: NoFit (4>2). f2: preempt possible (4<=4, used) -> whenCanPreempt=
+    # Preempt stops there.
+    assert a.representative_mode == PREEMPT
+    assert a.pod_sets[0].flavors["cpu"].name == "f2"
+    assert a.last_state.last_tried_flavor_idx[0]["cpu"] == 1
+
+    # Resume: next attempt starts at f3 and fits.
+    wi.last_assignment = a.last_state
+    a2 = assign_flavors(wi, snap.cluster_queues["cq"], snap.resource_flavors)
+    assert a2.representative_mode == FIT
+    assert a2.pod_sets[0].flavors["cpu"].name == "f3"
+    # Reached the end of the list: resume resets to -1.
+    assert a2.last_state.last_tried_flavor_idx[0]["cpu"] == -1
+
+
+def test_resume_state_invalidated_by_generation():
+    cache = Cache()
+    cache.add_or_update_resource_flavor(make_flavor("f1"))
+    cache.add_or_update_resource_flavor(make_flavor("f2"))
+    cache.add_cluster_queue(make_cq(
+        "cq", rg("cpu", fq("f1", cpu=4), fq("f2", cpu=10))))
+    snap = cache.snapshot()
+    wi = WorkloadInfo(make_wl("w", cpu=2), cluster_queue="cq")
+    wi.last_assignment = __import__(
+        "kueue_tpu.core.workload", fromlist=["AssignmentClusterQueueState"]
+    ).AssignmentClusterQueueState(
+        last_tried_flavor_idx=[{"cpu": 0}],
+        cluster_queue_generation=0)
+    # CQ generation (1) exceeds the recorded generation (0): state cleared,
+    # search starts at f1 again.
+    a = assign_flavors(wi, snap.cluster_queues["cq"], snap.resource_flavors)
+    assert a.pod_sets[0].flavors["cpu"].name == "f1"
+
+
+def test_pods_resource_counted():
+    cache = Cache()
+    cache.add_or_update_resource_flavor(make_flavor("default"))
+    cache.add_cluster_queue(make_cq(
+        "cq", rg(("cpu", "pods"), fq("default", cpu=100, pods=3))))
+    wl = make_wl("w", pod_sets=[PodSet.make("main", count=5, cpu="100m")])
+    a = solve(cache, wl, "cq")
+    assert a.representative_mode == NO_FIT  # 5 pods > 3
+
+
+def test_partial_admission_scaling():
+    cache = single_cq_cache(quota_cpu=4)
+    wl = make_wl("w", pod_sets=[PodSet.make("main", count=8, min_count=2, cpu=1)])
+    a = solve(cache, wl, "cq")
+    assert a.representative_mode == NO_FIT
+    a2 = solve(cache, wl, "cq", counts=[4])
+    assert a2.representative_mode == FIT
+    assert a2.pod_sets[0].count == 4
+    assert a2.usage["default"]["cpu"] == 4000

@@ -1,0 +1,37 @@
+from kueue_tpu.api.resources import format_quantity, parse_quantity, resource_value
+
+
+def test_parse_plain():
+    assert parse_quantity(5) == 5.0
+    assert parse_quantity("10") == 10.0
+    assert parse_quantity(2.5) == 2.5
+
+
+def test_parse_milli():
+    assert parse_quantity("500m") == 0.5
+    assert resource_value("cpu", "500m") == 500
+    assert resource_value("cpu", 2) == 2000
+    assert resource_value("cpu", "1.5") == 1500
+
+
+def test_parse_binary():
+    assert parse_quantity("1Ki") == 1024
+    assert resource_value("memory", "10Gi") == 10 * 1024**3
+    assert resource_value("memory", "512Mi") == 512 * 1024**2
+
+
+def test_parse_decimal_suffixes():
+    assert parse_quantity("2k") == 2000
+    assert resource_value("memory", "1M") == 10**6
+
+
+def test_counted_resources():
+    assert resource_value("pods", 3) == 3
+    assert resource_value("nvidia.com/gpu", "4") == 4
+
+
+def test_format():
+    assert format_quantity("cpu", 2000) == "2"
+    assert format_quantity("cpu", 1500) == "1500m"
+    assert format_quantity("memory", 10 * 1024**3) == "10Gi"
+    assert format_quantity("pods", 7) == "7"

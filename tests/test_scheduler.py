@@ -1,0 +1,191 @@
+"""End-to-end scheduler tests over the in-memory runtime (scenarios modeled
+on the reference scheduler_test.go / integration suites)."""
+
+from kueue_tpu.api.types import (
+    BorrowWithinCohort,
+    ClusterQueuePreemption,
+    LabelSelector,
+)
+from kueue_tpu.controllers.runtime import Framework
+
+from tests.util import fq, make_cq, make_flavor, make_lq, make_wl, rg
+
+
+def single_cq_framework(quota_cpu=4, strategy="BestEffortFIFO", **cq_kwargs):
+    fw = Framework()
+    fw.create_resource_flavor(make_flavor("default"))
+    fw.create_cluster_queue(make_cq(
+        "cq", rg("cpu", fq("default", cpu=quota_cpu)), strategy=strategy,
+        **cq_kwargs))
+    fw.create_local_queue(make_lq("main", cq="cq"))
+    return fw
+
+
+def test_admit_until_full_then_park():
+    fw = single_cq_framework(quota_cpu=4)
+    for i in range(6):
+        fw.submit(make_wl(f"w{i}", cpu=1, creation_time=float(i)))
+    admitted = fw.run_until_settled()
+    assert admitted == 4
+    assert fw.admitted_workloads("cq") == [f"default/w{i}" for i in range(4)]
+    assert fw.pending_workloads("cq") == 2
+
+
+def test_fifo_order_respected():
+    fw = single_cq_framework(quota_cpu=2)
+    fw.submit(make_wl("later", cpu=2, creation_time=10.0))
+    fw.submit(make_wl("earlier", cpu=2, creation_time=5.0))
+    fw.tick()
+    assert fw.admitted_workloads("cq") == ["default/earlier"]
+
+
+def test_priority_order_respected():
+    fw = single_cq_framework(quota_cpu=2)
+    fw.submit(make_wl("low", cpu=2, priority=0, creation_time=1.0))
+    fw.submit(make_wl("high", cpu=2, priority=10, creation_time=2.0))
+    fw.tick()
+    assert fw.admitted_workloads("cq") == ["default/high"]
+
+
+def test_free_quota_admits_parked():
+    fw = single_cq_framework(quota_cpu=2)
+    w0 = make_wl("w0", cpu=2)
+    fw.submit(w0)
+    fw.submit(make_wl("w1", cpu=2))
+    fw.run_until_settled()
+    assert fw.admitted_workloads("cq") == ["default/w0"]
+    fw.finish(w0)
+    fw.run_until_settled()
+    assert fw.admitted_workloads("cq") == ["default/w1"]
+
+
+def test_preemption_end_to_end():
+    fw = single_cq_framework(
+        quota_cpu=4,
+        preemption=ClusterQueuePreemption(within_cluster_queue="LowerPriority"))
+    low = make_wl("low", cpu=4, priority=-1)
+    fw.submit(low)
+    fw.run_until_settled()
+    assert fw.admitted_workloads("cq") == ["default/low"]
+    fw.submit(make_wl("high", cpu=4, priority=10))
+    fw.run_until_settled()
+    assert fw.admitted_workloads("cq") == ["default/high"]
+    assert low.is_evicted
+
+
+def test_borrowing_and_reclaim():
+    fw = Framework()
+    fw.create_resource_flavor(make_flavor("default"))
+    fw.create_cluster_queue(make_cq(
+        "cq-a", rg("cpu", fq("default", cpu=4)), cohort="co",
+        preemption=ClusterQueuePreemption(reclaim_within_cohort="Any")))
+    fw.create_cluster_queue(make_cq(
+        "cq-b", rg("cpu", fq("default", cpu=4)), cohort="co"))
+    fw.create_local_queue(make_lq("a", cq="cq-a"))
+    fw.create_local_queue(make_lq("b", cq="cq-b"))
+    # cq-b borrows the whole cohort.
+    for i in range(4):
+        fw.submit(make_wl(f"b{i}", "b", cpu=2, creation_time=float(i)))
+    fw.run_until_settled()
+    assert len(fw.admitted_workloads("cq-b")) == 4
+    # cq-a reclaims its nominal quota.
+    fw.submit(make_wl("a0", "a", cpu=4, creation_time=10.0))
+    fw.run_until_settled()
+    assert fw.admitted_workloads("cq-a") == ["default/a0"]
+    assert len(fw.admitted_workloads("cq-b")) == 2
+
+
+def test_one_borrowing_admission_per_cohort_per_cycle():
+    fw = Framework()
+    fw.create_resource_flavor(make_flavor("default"))
+    for name in ("cq-a", "cq-b"):
+        fw.create_cluster_queue(make_cq(
+            name, rg("cpu", fq("default", cpu=2)), cohort="co"))
+    fw.create_local_queue(make_lq("a", cq="cq-a"))
+    fw.create_local_queue(make_lq("b", cq="cq-b"))
+    # Both heads want 3 cpu (borrowing); cohort only fits one (4 total).
+    fw.submit(make_wl("wa", "a", cpu=3, creation_time=1.0))
+    fw.submit(make_wl("wb", "b", cpu=3, creation_time=2.0))
+    admitted_first_tick = fw.scheduler.schedule(timeout=0.0)
+    assert admitted_first_tick == 1
+    fw.reconcile()
+    fw.run_until_settled()
+    total = fw.admitted_workloads("cq-a") + fw.admitted_workloads("cq-b")
+    assert total == ["default/wa"]
+
+
+def test_namespace_selector_mismatch():
+    fw = single_cq_framework(
+        quota_cpu=4, namespace_selector=LabelSelector.of(team="alpha"))
+    fw.create_namespace("ns-beta", {"team": "beta"})
+    fw.create_namespace("ns-alpha", {"team": "alpha"})
+    fw.create_local_queue(make_lq("main", namespace="ns-beta", cq="cq"))
+    fw.create_local_queue(make_lq("main", namespace="ns-alpha", cq="cq"))
+    fw.submit(make_wl("w-beta", namespace="ns-beta", cpu=1))
+    fw.submit(make_wl("w-alpha", namespace="ns-alpha", cpu=1))
+    fw.run_until_settled()
+    assert fw.admitted_workloads("cq") == ["ns-alpha/w-alpha"]
+    assert fw.pending_workloads("cq") == 1
+
+
+def test_strict_fifo_blocks_behind_head():
+    fw = single_cq_framework(quota_cpu=4, strategy="StrictFIFO")
+    fw.submit(make_wl("big", cpu=10, creation_time=1.0))
+    fw.submit(make_wl("small", cpu=1, creation_time=2.0))
+    # StrictFIFO requeues the inadmissible head into the heap, so the small
+    # workload behind it is stuck waiting.
+    for _ in range(3):
+        fw.tick()
+    assert fw.admitted_workloads("cq") == []
+
+
+def test_best_effort_skips_blocked_head():
+    fw = single_cq_framework(quota_cpu=4, strategy="BestEffortFIFO")
+    fw.submit(make_wl("big", cpu=10, creation_time=1.0))
+    fw.submit(make_wl("small", cpu=1, creation_time=2.0))
+    fw.run_until_settled()
+    assert fw.admitted_workloads("cq") == ["default/small"]
+
+
+def test_two_phase_admission_checks():
+    fw = single_cq_framework(quota_cpu=4, admission_checks=("prov",))
+    wl = make_wl("w", cpu=2)
+    fw.submit(wl)
+    fw.run_until_settled()
+    # Quota reserved but not admitted until the check is Ready.
+    assert wl.has_quota_reservation
+    assert not wl.is_admitted
+    fw.set_admission_check_state(wl, "prov", "Ready")
+    fw.reconcile()
+    assert wl.is_admitted
+
+
+def test_partial_admission():
+    fw = single_cq_framework(quota_cpu=4)
+    from kueue_tpu.api.types import PodSet
+    wl = make_wl("w", pod_sets=[PodSet.make("main", count=8, min_count=2, cpu=1)])
+    fw.submit(wl)
+    fw.run_until_settled()
+    assert fw.admitted_workloads("cq") == ["default/w"]
+    assert wl.admission.pod_set_assignments[0].count == 4
+
+
+def test_apply_admission_failure_requeues_cleanly():
+    fw = single_cq_framework(quota_cpu=4)
+    fails = {"n": 1}
+
+    def flaky_apply(wl):
+        if fails["n"] > 0:
+            fails["n"] -= 1
+            return False
+        return True
+
+    fw.scheduler.apply_admission = flaky_apply
+    wl = make_wl("w", cpu=2)
+    fw.submit(wl)
+    fw.tick()
+    # First apply failed: no reservation left behind, workload still queued.
+    assert not wl.has_quota_reservation
+    assert wl.admission is None
+    fw.run_until_settled()
+    assert fw.admitted_workloads("cq") == ["default/w"]

@@ -1,0 +1,74 @@
+"""Fluent test builders (counterpart of reference pkg/util/testing/wrappers.go)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from kueue_tpu.api.types import (
+    BorrowWithinCohort,
+    ClusterQueue,
+    ClusterQueuePreemption,
+    FlavorFungibility,
+    FlavorQuotas,
+    LabelSelector,
+    LocalQueue,
+    PodSet,
+    ResourceFlavor,
+    ResourceGroup,
+    Workload,
+)
+
+
+def make_flavor(name: str, **labels) -> ResourceFlavor:
+    return ResourceFlavor.make(name, node_labels=labels or None)
+
+
+def make_cq(name: str, *groups: ResourceGroup, cohort: str = "",
+            strategy: str = "BestEffortFIFO",
+            preemption: Optional[ClusterQueuePreemption] = None,
+            fungibility: Optional[FlavorFungibility] = None,
+            namespace_selector: Optional[LabelSelector] = None,
+            admission_checks=()) -> ClusterQueue:
+    kwargs = {}
+    if preemption is not None:
+        kwargs["preemption"] = preemption
+    if fungibility is not None:
+        kwargs["flavor_fungibility"] = fungibility
+    if namespace_selector is not None:
+        kwargs["namespace_selector"] = namespace_selector
+    return ClusterQueue(
+        name=name, resource_groups=tuple(groups), cohort=cohort,
+        queueing_strategy=strategy, admission_checks=tuple(admission_checks),
+        **kwargs)
+
+
+def rg(resources, *flavors: FlavorQuotas) -> ResourceGroup:
+    if isinstance(resources, str):
+        resources = (resources,)
+    return ResourceGroup(covered_resources=tuple(resources),
+                         flavors=tuple(flavors))
+
+
+def fq(name: str, **quotas) -> FlavorQuotas:
+    return FlavorQuotas.make(name, **quotas)
+
+
+def make_lq(name: str = "main", namespace: str = "default",
+            cq: str = "cq") -> LocalQueue:
+    return LocalQueue(name=name, namespace=namespace, cluster_queue=cq)
+
+
+_wl_seq = [0]
+
+
+def make_wl(name: str, cq_or_lq: str = "main", priority: int = 0,
+            creation_time: Optional[float] = None, namespace: str = "default",
+            pod_sets=None, **requests) -> Workload:
+    _wl_seq[0] += 1
+    if pod_sets is None:
+        pod_sets = [PodSet.make("main", count=1, **requests)]
+    return Workload(
+        name=name, namespace=namespace, queue_name=cq_or_lq,
+        pod_sets=list(pod_sets), priority=priority,
+        creation_time=creation_time if creation_time is not None else float(_wl_seq[0]),
+    )
