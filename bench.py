@@ -1,0 +1,127 @@
+"""Admission-solve benchmark.
+
+Shape: the north-star target from BASELINE.md -- 1k ClusterQueues x 100
+cohorts x 8 ResourceFlavors with a 50k-deep pending backlog. The reference
+admits one head per ClusterQueue per scheduling cycle (manager.go:489-508),
+so each tick nominates <=1k workloads; the backlog drains across ticks.
+
+The timed region is one tick's nomination solve -- what the reference does
+sequentially in nominate()/flavorassigner.Assign (scheduler.go:317-351) --
+here as: usage tensor refresh + batched device solve + decision decode.
+The ClusterQueue-side encoding is static across ticks (keyed on allocatable
+generations) and the backlog is pre-encoded once, modeling the incremental
+encoder of the production scheduler.
+
+Prints ONE JSON line:
+  {"metric": "p99_tick_solve_ms", "value": ..., "unit": "ms",
+   "vs_baseline": <north-star 100ms / value>}
+
+Env knobs: KUEUE_BENCH_SMOKE=1 (tiny shapes), KUEUE_BENCH_TICKS=N.
+"""
+
+from __future__ import annotations
+
+import gc
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def main() -> None:
+    smoke = os.environ.get("KUEUE_BENCH_SMOKE") == "1"
+    if smoke:
+        num_cqs, num_cohorts, num_flavors = 32, 8, 4
+        backlog, ticks = 256, 5
+    else:
+        num_cqs, num_cohorts, num_flavors = 1000, 100, 8
+        backlog, ticks = 50_000, int(os.environ.get("KUEUE_BENCH_TICKS", "50"))
+    heads_per_tick = num_cqs
+
+    from kueue_tpu.models.flavor_fit import (
+        decode_assignments,
+        device_static,
+        solve_flavor_fit,
+    )
+    from kueue_tpu.solver import schema as sch
+    from kueue_tpu.utils.synthetic import synthetic_problem
+
+    import jax
+
+    t0 = time.perf_counter()
+    cache, pending = synthetic_problem(
+        num_cqs=num_cqs, num_cohorts=num_cohorts, num_flavors=num_flavors,
+        num_pending=backlog, usage_fill=0.7, seed=42)
+    snapshot = cache.snapshot()
+    t_gen = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    enc = sch.encode_cluster_queues(snapshot)
+    static = device_static(enc)
+    # Pre-encode the whole backlog once (incremental-encoder model).
+    wt_all = sch.encode_workloads(pending, snapshot, enc,
+                                  pad_to=len(pending))
+    t_enc = time.perf_counter() - t0
+
+    def tick(i: int):
+        lo = (i * heads_per_tick) % backlog
+        hi = min(lo + heads_per_tick, backlog)
+        usage = sch.encode_usage(snapshot, enc)  # per-tick usage refresh
+        wt = sch.WorkloadTensors(
+            wl_cq=wt_all.wl_cq[lo:hi], req=wt_all.req[lo:hi],
+            has_req=wt_all.has_req[lo:hi],
+            podset_valid=wt_all.podset_valid[lo:hi],
+            podset_unsat=wt_all.podset_unsat[lo:hi],
+            elig=wt_all.elig[lo:hi], resume_slot=wt_all.resume_slot[lo:hi],
+            wl_valid=wt_all.wl_valid[lo:hi], num_real=hi - lo)
+        out = solve_flavor_fit(enc, usage, wt, static=static)
+        heads = pending[lo:hi]
+        assignments = decode_assignments(heads, snapshot, enc, out)
+        return out, assignments
+
+    # Warmup (compile).
+    tick(0)
+
+    # Long-running-scheduler GC discipline: the setup objects (50k encoded
+    # workloads, the snapshot) are permanent; keep collector passes from
+    # stalling the tick loop. Per-tick garbage is acyclic and dies by
+    # refcount.
+    gc.collect()
+    gc.freeze()
+    gc.set_threshold(200_000, 100, 100)
+
+    times = []
+    decisions = 0
+    fit_count = 0
+    for i in range(ticks):
+        t0 = time.perf_counter()
+        out, assignments = tick(i)
+        times.append(time.perf_counter() - t0)
+        decisions += len(assignments)
+        fit_count += int((out["wl_mode"][:len(assignments)] == 2).sum())
+
+    times_ms = np.array(times) * 1000.0
+    p50 = float(np.percentile(times_ms, 50))
+    p99 = float(np.percentile(times_ms, 99))
+    decisions_per_sec = decisions / (sum(times) or 1e-9)
+
+    print(
+        f"# shape: {num_cqs} CQs x {num_cohorts} cohorts x {num_flavors} "
+        f"flavors, backlog {backlog}, {heads_per_tick} heads/tick, "
+        f"{ticks} ticks on {jax.default_backend()}\n"
+        f"# setup: generate {t_gen:.2f}s, encode {t_enc:.2f}s\n"
+        f"# tick solve: p50 {p50:.2f}ms  p99 {p99:.2f}ms  "
+        f"({decisions_per_sec:,.0f} decisions/s; {fit_count}/{decisions} Fit)",
+        file=sys.stderr)
+    print(json.dumps({
+        "metric": "p99_tick_solve_ms",
+        "value": round(p99, 3),
+        "unit": "ms",
+        "vs_baseline": round(100.0 / p99, 3) if p99 > 0 else None,
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,10 @@
+"""Batched solver models (the device-side hot path).
+
+Quota arithmetic is exact int64; enable x64 before any jax array exists.
+"""
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
+
+from kueue_tpu.models.flavor_fit import BatchSolver, solve_flavor_fit

@@ -1,0 +1,463 @@
+"""Batched flavor assignment on the accelerator.
+
+One XLA program solves flavor assignment for EVERY pending workload at once,
+replacing the reference's sequential per-head loops
+(flavorassigner.go:363-600). The workload axis is embarrassingly parallel --
+each head is solved against the same immutable snapshot
+(scheduler.go:317-351), which is what makes the dense batched formulation
+decision-equivalent: cross-workload interactions (one-admission-per-cohort)
+stay in the host admission loop exactly as in the reference.
+
+Shapes (see solver/schema.py): the kernel is [W] x scan over P podsets x
+dense [G,S,R] flavor/mode math. All control flow is masks and reductions --
+no data-dependent branching -- so XLA tiles it onto the MXU/VPU and the
+compiled program is reused across ticks of the same padded shape.
+
+Integer semantics are exact (int64; TPU emulates i64 on the VPU).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kueue_tpu import features
+from kueue_tpu.core.snapshot import Snapshot
+from kueue_tpu.core.workload import AssignmentClusterQueueState, WorkloadInfo
+from kueue_tpu.solver import schema as sch
+from kueue_tpu.solver.modes import FIT, NO_FIT, PREEMPT
+from kueue_tpu.solver.referee import (
+    Assignment,
+    FlavorAssignment,
+    PodSetAssignmentResult,
+)
+
+MODE_SENTINEL = FIT + 1  # "no resource in group" marker for masked mins
+
+
+def solve_core(
+    # CQ-side [C,F,R] and friends
+    nominal, borrow_limit, guaranteed, usage,
+    cohort_requestable, cohort_usage, cohort_id,
+    group_of_resource, slot_flavor, num_flavors,
+    bwc_enabled, borrow_policy_is_borrow, preempt_policy_is_preempt,
+    # workload-side; elig is per (workload, podset, group, slot) because
+    # affinity matching is restricted to each group's label keys
+    # (flavorassigner.go:498-542)
+    wl_cq, req, has_req, podset_valid, podset_unsat, elig, resume_slot,
+    num_slots: int,
+    fungibility_enabled: bool = True,
+):
+    """Returns per-(W,P) assignment tensors; see outputs dict at the end."""
+    W = wl_cq.shape[0]
+    P = req.shape[1]
+    F = nominal.shape[1]
+    R = nominal.shape[2]
+    G = slot_flavor.shape[1]
+    S = num_slots
+
+    # Gather the per-workload view of its ClusterQueue (one gather, reused
+    # by every podset iteration).
+    nomW = nominal[wl_cq]              # [W,F,R]
+    blimW = borrow_limit[wl_cq]        # [W,F,R]
+    guarW = guaranteed[wl_cq]          # [W,F,R]
+    usedW = usage[wl_cq]               # [W,F,R]
+    kW = cohort_id[wl_cq]              # [W]
+    creqW = cohort_requestable[kW]     # [W,F,R]
+    cuseW = cohort_usage[kW]           # [W,F,R]
+    gorW = group_of_resource[wl_cq]    # [W,R]
+    slotW = slot_flavor[wl_cq]         # [W,G,S]
+    nfW = num_flavors[wl_cq]           # [W,G]
+    bwcW = bwc_enabled[wl_cq]          # [W]
+    bPolW = borrow_policy_is_borrow[wl_cq]    # [W]
+    pPolW = preempt_policy_is_preempt[wl_cq]  # [W]
+
+    # Cohort-available quota per (flavor, resource), from this CQ's seat:
+    # requestable lendable pool + own guaranteed (clusterqueue.go:583-600).
+    cohort_avail = creqW + guarW                       # [W,F,R]
+    # Used cohort quota: above-guaranteed pool usage + own within-guaranteed.
+    cohort_used = cuseW + jnp.minimum(usedW, guarW)    # [W,F,R]
+
+    slot_ok = slotW >= 0                               # [W,G,S]
+    sf = jnp.maximum(slotW, 0)                         # safe gather index
+    wix = jnp.arange(W)
+
+    def gather_fr(x):
+        """[W,F,R] -> [W,G,S,R]: the CQ quantity at each slot's flavor."""
+        return x[wix[:, None, None], sf, :]
+
+    nom_s = gather_fr(nomW)
+    blim_s = gather_fr(blimW)
+    guar_s = gather_fr(guarW)
+    used_s = gather_fr(usedW)
+    cav_s = gather_fr(cohort_avail)
+    cus_s = gather_fr(cohort_used)
+
+    member = has_req[:, :, None, :] & (gorW[:, None, :] ==
+                                       jnp.arange(G)[None, :, None])[:, None, :, :]
+    # member: [W,P,G,R] -- resource r belongs to group g and is requested.
+    group_has_req = member.any(axis=3)                 # [W,P,G]
+
+    arangeS = jnp.arange(S)
+
+    def podset_step(carry_usage, p):
+        r_req = jax.lax.dynamic_index_in_dim(req, p, axis=1, keepdims=False)
+        r_has = jax.lax.dynamic_index_in_dim(has_req, p, axis=1, keepdims=False)
+        p_valid = jax.lax.dynamic_index_in_dim(podset_valid, p, axis=1,
+                                               keepdims=False)
+        p_unsat = jax.lax.dynamic_index_in_dim(podset_unsat, p, axis=1,
+                                               keepdims=False)
+        e_p = jax.lax.dynamic_index_in_dim(elig, p, axis=1, keepdims=False)
+        res_p = jax.lax.dynamic_index_in_dim(resume_slot, p, axis=1,
+                                             keepdims=False)
+        memb = jax.lax.dynamic_index_in_dim(member, p, axis=1, keepdims=False)
+        ghr = jax.lax.dynamic_index_in_dim(group_has_req, p, axis=1,
+                                           keepdims=False)
+
+        # Requested value incl. earlier podsets' usage on the same flavor
+        # (flavorassigner.go:420).
+        carry_s = carry_usage[wix[:, None, None], sf, :]  # [W,G,S,R]
+        val = r_req[:, None, None, :] + carry_s                     # [W,G,S,R]
+
+        # --- fitsResourceQuota, vectorized (flavorassigner.go:550-600) ---
+        mode = jnp.where(val <= nom_s, PREEMPT, NO_FIT)
+        bwc_ok = (bwcW[:, None, None, None]
+                  & (val <= nom_s + blim_s) & (val <= cav_s))
+        mode = jnp.where(bwc_ok, PREEMPT, mode)
+        borrow = bwc_ok & (val > nom_s)
+        over_blim = used_s + val > nom_s + blim_s
+        lack = cus_s + val - cav_s
+        fit = (~over_blim) & (lack <= 0)
+        mode = jnp.where(fit, FIT, mode)
+        borrow = jnp.where(fit, used_s + val > nom_s, borrow)
+
+        # --- per-slot representative mode over the group's resources ---
+        mode_masked = jnp.where(memb[:, :, None, :], mode, MODE_SENTINEL)
+        rep = mode_masked.min(axis=3)                  # [W,G,S]
+        rep = jnp.minimum(rep, FIT)
+        needs_borrow = (borrow & memb[:, :, None, :]).any(axis=3)
+
+        sv = (slot_ok & e_p
+              & (arangeS[None, None, :] < nfW[..., None])
+              & (arangeS[None, None, :] >= res_p[..., None]))
+
+        if fungibility_enabled:
+            # --- fungibility stop rule (flavorassigner.go:478-496) ---
+            pPol = pPolW[:, None, None]
+            bPol = bPolW[:, None, None]
+            stop = ((rep == PREEMPT) & pPol & (~needs_borrow | bPol)) \
+                | ((rep == FIT) & needs_borrow & bPol) \
+                | ((rep == FIT) & ~needs_borrow)
+        else:
+            # Gate off: stop at the first Fit, borrowing or not
+            # (flavorassigner.go:450-458).
+            stop = rep == FIT
+        stop = stop & sv
+
+        first_stop = jnp.where(stop, arangeS[None, None, :], S).min(axis=2)
+        stopped = first_stop < S                        # [W,G]
+        rep_valid = jnp.where(sv, rep, -1)
+        best_idx = jnp.argmax(rep_valid, axis=2)        # first occurrence of max
+        best_mode = rep_valid.max(axis=2)
+        chosen = jnp.where(stopped, first_stop,
+                           jnp.where(best_mode > NO_FIT, best_idx, -1))
+
+        # Resume bookkeeping (flavorassigner.go:412,462-470): the last slot
+        # whose eligibility checks passed, or the stop slot. With the
+        # FlavorFungibility gate off the referee leaves TriedFlavorIdx at
+        # its zero value (the recording loop is skipped).
+        if fungibility_enabled:
+            last_elig = jnp.where(sv, arangeS[None, None, :], -1).max(axis=2)
+            assigned_idx = jnp.where(stopped, first_stop, last_elig)
+            tried = jnp.where(assigned_idx == nfW - 1, -1, assigned_idx)
+            tried = jnp.where(assigned_idx < 0, -1, tried)
+        else:
+            tried = jnp.zeros_like(first_stop)
+
+        chosen_safe = jnp.maximum(chosen, 0)
+        gix = jnp.arange(G)
+        # Per-group mode at the chosen slot.
+        g_mode = rep[wix[:, None], gix[None, :], chosen_safe]   # [W,G]
+        g_mode = jnp.where(chosen >= 0, g_mode, NO_FIT)
+
+        group_ok = (~ghr) | ((chosen >= 0) & (g_mode > NO_FIT))
+        # A requested resource no group of this CQ covers fails the podset
+        # ("resource unavailable in ClusterQueue", flavorassigner.go:370-375).
+        uncovered = (r_has & (gorW < 0)).any(axis=1)
+        ps_ok = p_valid & (~p_unsat) & (~uncovered) & group_ok.all(axis=1)
+
+        # Per-resource outputs at the chosen slot of the resource's group.
+        mode_at_chosen = mode[wix[:, None], gix[None, :], chosen_safe, :]
+        borrow_at_chosen = borrow[wix[:, None], gix[None, :], chosen_safe, :]
+        flavor_at_chosen = slotW[wix[:, None], gix[None, :], chosen_safe]
+
+        gor_safe = jnp.maximum(gorW, 0)                         # [W,R]
+        rix = jnp.arange(R)
+        chosen_g = chosen[wix[:, None], gor_safe]               # [W,R]
+        res_flavor = flavor_at_chosen[wix[:, None], gor_safe]
+        res_mode = mode_at_chosen[wix[:, None], gor_safe, rix[None, :]]
+        res_borrow = borrow_at_chosen[wix[:, None], gor_safe, rix[None, :]]
+
+        res_assigned = r_has & (gorW >= 0) & (chosen_g >= 0) & ps_ok[:, None]
+        res_flavor = jnp.where(res_assigned, res_flavor, -1)
+        res_mode = jnp.where(res_assigned, res_mode, NO_FIT)
+        res_borrow = res_borrow & res_assigned
+
+        # Podset representative mode (referee PodSetAssignmentResult).
+        g_mode_req = jnp.where(ghr, g_mode, MODE_SENTINEL)
+        ps_mode = jnp.minimum(g_mode_req.min(axis=1), FIT)
+        ps_mode = jnp.where(ps_ok, ps_mode, NO_FIT)
+        ps_mode = jnp.where(p_valid, ps_mode, MODE_SENTINEL)
+
+        # Usage contribution: only podsets with a full assignment add usage
+        # (flavorassigner.go:324-327 clears flavors on failure).
+        one_hot_f = (jnp.maximum(res_flavor, 0)[..., None]
+                     == jnp.arange(F)[None, None, :])   # [W,R,F]
+        contrib = one_hot_f & res_assigned[..., None]   # ps_ok already folded in
+        addFR = jnp.swapaxes(contrib, 1, 2) * r_req[:, None, :]  # [W,F,R]
+        carry_usage = carry_usage + addFR
+
+        # Compact dtypes: the whole output pytree is fetched host-side once
+        # per tick, and device->host latency dominates on remote links.
+        outputs = dict(
+            res_flavor=res_flavor.astype(jnp.int16),
+            res_mode=res_mode.astype(jnp.int8),
+            res_borrow=res_borrow,
+            group_chosen=chosen.astype(jnp.int16),
+            group_tried=tried.astype(jnp.int16),
+            ps_ok=ps_ok,
+            ps_mode=ps_mode.astype(jnp.int8),
+        )
+        return carry_usage, outputs
+
+    carry0 = jnp.zeros((W, F, R), dtype=req.dtype)
+    _, outs = jax.lax.scan(podset_step, carry0, jnp.arange(P))
+    # outs arrays are [P,W,...]; transpose to [W,P,...].
+    outs = {k: jnp.moveaxis(v, 0, 1) for k, v in outs.items()}
+
+    ps_mode = outs["ps_mode"]
+    wl_mode = jnp.minimum(ps_mode, MODE_SENTINEL).min(axis=1)
+    wl_mode = jnp.where(wl_mode == MODE_SENTINEL, NO_FIT, wl_mode)
+    has_ps = podset_valid.any(axis=1)
+    outs["wl_mode"] = jnp.where(has_ps, wl_mode, NO_FIT).astype(jnp.int8)
+    return outs
+
+
+_solve_kernel = functools.partial(
+    jax.jit, static_argnames=("num_slots", "fungibility_enabled"))(solve_core)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("num_slots", "shapes",
+                                    "fungibility_enabled"))
+def _solve_kernel_packed(
+    nominal, borrow_limit, guaranteed, lendable, cohort_id,
+    group_of_resource, slot_flavor, num_flavors,
+    bwc_enabled, borrow_policy_is_borrow, preempt_policy_is_preempt,
+    buf_i64, buf_i32, buf_u8, *, num_slots: int, shapes,
+    fungibility_enabled: bool = True,
+):
+    """Transfer-minimal entry: statics live on device across ticks; the
+    dynamic side arrives as three packed buffers (i64: usage+requests,
+    i32: cq index+resume slots, u8: masks) and cohort aggregates are
+    computed on device. Device->host RPCs, not FLOPs, bound the tick."""
+    W, P, R, G, K = shapes
+    C, F = nominal.shape[0], nominal.shape[1]
+    S = num_slots
+
+    usage = buf_i64[:C * F * R].reshape(C, F, R)
+    req = buf_i64[C * F * R:].reshape(W, P, R)
+    wl_cq = buf_i32[:W]
+    resume_slot = buf_i32[W:].reshape(W, P, G)
+    off = 0
+    has_req = buf_u8[off:off + W * P * R].reshape(W, P, R).astype(bool)
+    off += W * P * R
+    podset_valid = buf_u8[off:off + W * P].reshape(W, P).astype(bool)
+    off += W * P
+    podset_unsat = buf_u8[off:off + W * P].reshape(W, P).astype(bool)
+    off += W * P
+    elig = buf_u8[off:off + W * P * G * S].reshape(W, P, G, S).astype(bool)
+
+    # Cohort aggregation (snapshot.go:160-201), on device.
+    above = jnp.maximum(usage - guaranteed, 0)
+    cohort_usage = jax.ops.segment_sum(above, cohort_id, num_segments=K)
+    cohort_requestable = jax.ops.segment_sum(lendable, cohort_id,
+                                             num_segments=K)
+
+    return solve_core(
+        nominal, borrow_limit, guaranteed, usage,
+        cohort_requestable, cohort_usage, cohort_id,
+        group_of_resource, slot_flavor, num_flavors,
+        bwc_enabled, borrow_policy_is_borrow, preempt_policy_is_preempt,
+        wl_cq, req, has_req, podset_valid, podset_unsat, elig, resume_slot,
+        num_slots=num_slots, fungibility_enabled=fungibility_enabled)
+
+
+def device_static(enc: sch.CQEncoding) -> tuple:
+    """Move the generation-stable CQ-side tensors to the device once; they
+    are reused across ticks (the snapshot-copy avoidance called out in
+    SURVEY §7: incremental re-encoding keyed on allocatable generations)."""
+    return tuple(jnp.asarray(x) for x in (
+        enc.nominal, enc.borrow_limit, enc.guaranteed, enc.lendable,
+        enc.cohort_id, enc.group_of_resource, enc.slot_flavor,
+        enc.num_flavors, enc.bwc_enabled, enc.borrow_policy_is_borrow,
+        enc.preempt_policy_is_preempt))
+
+
+def pack_dynamic(usage_cfr: np.ndarray, wl: sch.WorkloadTensors):
+    """Pack the per-tick dynamic tensors into three typed buffers: every
+    host->device transfer is a round trip on remote-attached TPUs, so the
+    tick ships exactly three."""
+    buf_i64 = np.concatenate([usage_cfr.ravel(), wl.req.ravel()])
+    buf_i32 = np.concatenate([wl.wl_cq.ravel(), wl.resume_slot.ravel()])
+    buf_u8 = np.concatenate([
+        wl.has_req.ravel(), wl.podset_valid.ravel(),
+        wl.podset_unsat.ravel(), wl.elig.ravel()]).astype(np.uint8)
+    return buf_i64, buf_i32, buf_u8
+
+
+def solve_flavor_fit(enc: sch.CQEncoding, usage: sch.UsageTensors,
+                     wl: sch.WorkloadTensors,
+                     static: Optional[tuple] = None) -> Dict[str, np.ndarray]:
+    """Run the batched solve; returns numpy output tensors.
+
+    Per tick: three packed host->device transfers, one dispatch, one batched
+    device_get of the compact output pytree.
+    """
+    if static is None:
+        static = device_static(enc)
+    W, P, R = wl.req.shape
+    G = wl.resume_slot.shape[2]
+    buf_i64, buf_i32, buf_u8 = pack_dynamic(usage.usage, wl)
+    out = _solve_kernel_packed(
+        *static,
+        jnp.asarray(buf_i64), jnp.asarray(buf_i32), jnp.asarray(buf_u8),
+        num_slots=enc.num_slots,
+        shapes=(W, P, R, G, enc.num_cohorts),
+        fungibility_enabled=features.enabled(features.FLAVOR_FUNGIBILITY),
+    )
+    return jax.device_get(out)
+
+
+def decode_assignments(workloads: Sequence[WorkloadInfo], snapshot: Snapshot,
+                       enc: sch.CQEncoding,
+                       out: Dict[str, np.ndarray]) -> List[Assignment]:
+    """Materialize referee-compatible Assignment objects from the kernel
+    outputs (truncating at the first failed podset, like
+    flavorassigner.go:323-327)."""
+    assignments: List[Assignment] = []
+    # One C-level conversion each; per-element numpy indexing in the loop
+    # below would dominate the decode at 1k workloads/tick.
+    res_flavor = out["res_flavor"].tolist()
+    res_mode = out["res_mode"].tolist()
+    res_borrow = out["res_borrow"].tolist()
+    group_tried = out["group_tried"].tolist()
+    ps_ok_arr = out["ps_ok"].tolist()
+    group_of_resource = enc.group_of_resource.tolist()
+    for w, wi in enumerate(workloads):
+        cq = snapshot.cluster_queues[wi.cluster_queue]
+        a = Assignment(
+            usage={},
+            last_state=AssignmentClusterQueueState(
+                cluster_queue_generation=cq.allocatable_generation,
+                cohort_generation=(cq.cohort.allocatable_generation
+                                   if cq.cohort is not None else 0),
+            ),
+        )
+        ci = enc.cq_index[wi.cluster_queue]
+        gor_row = group_of_resource[ci]
+        for p, ps in enumerate(wi.total_requests):
+            requests = dict(ps.requests)
+            if sch.PODS_RESOURCE in cq.rg_by_resource:
+                requests[sch.PODS_RESOURCE] = ps.count
+            psa = PodSetAssignmentResult(
+                name=ps.name, requests=requests, count=ps.count)
+            ok = ps_ok_arr[w][p]
+            flavor_idx: Dict[str, int] = {}
+            if ok:
+                rf_row = res_flavor[w][p]
+                rm_row = res_mode[w][p]
+                rb_row = res_borrow[w][p]
+                gt_row = group_tried[w][p]
+                for rname in requests:
+                    ri = enc.resource_index.get(rname)
+                    if ri is None:
+                        continue
+                    f = rf_row[ri]
+                    if f < 0:
+                        continue
+                    fa = FlavorAssignment(
+                        name=enc.flavor_names[f],
+                        mode=rm_row[ri],
+                        borrow=rb_row[ri],
+                        tried_flavor_idx=gt_row[gor_row[ri]],
+                    )
+                    psa.flavors[rname] = fa
+                    if fa.borrow:
+                        a.borrowing = True
+                    a.usage.setdefault(fa.name, {})
+                    a.usage[fa.name][rname] = (
+                        a.usage[fa.name].get(rname, 0) + requests[rname])
+                    flavor_idx[rname] = fa.tried_flavor_idx
+                if any(fa.mode < FIT for fa in psa.flavors.values()):
+                    # Non-Fit assignments always carry reasons in the referee
+                    # (fitsResourceQuota appends one per shortfall); the
+                    # presence of reasons is what makes representative_mode
+                    # read the per-flavor modes.
+                    psa.reasons = ["insufficient unused quota"]
+            else:
+                psa.reasons = ["insufficient quota or no eligible flavor"]
+            a.pod_sets.append(psa)
+            a.last_state.last_tried_flavor_idx.append(flavor_idx)
+            if not ok:
+                break
+        assignments.append(a)
+    return assignments
+
+
+class BatchSolver:
+    """Scheduler plug-in: batched device solve for all heads of a tick.
+
+    Drop-in for the sequential referee path
+    (`Scheduler(batch_solver=BatchSolver())`); preemption-target search
+    stays host-side on the snapshot, as in the reference
+    (scheduler.go:390-429).
+
+    The CQ-side encoding and its device tensors are cached across ticks and
+    invalidated by the same signals that invalidate flavor-search resume
+    state: allocatable generations, cohort membership, policies, and the
+    flavor set.
+    """
+
+    def __init__(self):
+        self._key = None
+        self._enc: Optional[sch.CQEncoding] = None
+        self._static: Optional[tuple] = None
+
+    def _encoding_for(self, snapshot: Snapshot) -> sch.CQEncoding:
+        key = (
+            tuple(sorted(
+                (name, cq.allocatable_generation, cq.cohort_name,
+                 cq.preemption, cq.flavor_fungibility)
+                for name, cq in snapshot.cluster_queues.items())),
+            tuple(sorted(snapshot.resource_flavors.items())),
+            # The encoding bakes in gate-dependent quota splits.
+            features.enabled(features.LENDING_LIMIT),
+        )
+        if key != self._key:
+            self._enc = sch.encode_cluster_queues(snapshot)
+            self._static = device_static(self._enc)
+            self._key = key
+        return self._enc
+
+    def solve(self, workloads: Sequence[WorkloadInfo],
+              snapshot: Snapshot) -> List[Assignment]:
+        enc = self._encoding_for(snapshot)
+        usage = sch.encode_usage(snapshot, enc)
+        wt = sch.encode_workloads(workloads, snapshot, enc)
+        out = solve_flavor_fit(enc, usage, wt, static=self._static)
+        return decode_assignments(workloads, snapshot, enc, out)

@@ -1,0 +1,3 @@
+"""Device-mesh sharding of the admission solve."""
+
+from kueue_tpu.parallel.mesh import make_mesh, sharded_flavor_fit

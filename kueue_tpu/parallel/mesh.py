@@ -1,0 +1,143 @@
+"""Multi-chip sharding of the admission solve.
+
+This is the ICI-scaling story of the framework (the analog of the reference's
+intra-process parallelize.Until + multi-replica deployment, mapped onto a TPU
+device mesh):
+
+  * ClusterQueue usage state is sharded across devices on the CQ axis; cohort
+    aggregates (requestable/lending pools and above-guaranteed usage,
+    snapshot.go:160-201) are computed with on-device `segment_sum` + `psum`
+    collectives, and the full usage view is rebuilt with a tiled
+    `all_gather` -- all riding ICI.
+  * The pending-workload batch is data-parallel over the same mesh axis:
+    each device solves its workload shard against the replicated snapshot
+    (valid because heads are independent within a tick;
+    scheduler.go:317-351).
+
+All shapes are padded host-side to multiples of the mesh size, and the
+compiled sharded program is cached per (mesh, shape) so steady-state ticks
+re-dispatch without re-tracing.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from kueue_tpu import features
+from kueue_tpu.models.flavor_fit import solve_core
+
+AXIS = "wl"
+
+_PROGRAM_CACHE: Dict[Tuple, "jax.stages.Wrapped"] = {}
+
+
+def make_mesh(n_devices: Optional[int] = None, axis: str = AXIS) -> Mesh:
+    devices = jax.devices()
+    if n_devices is not None:
+        devices = devices[:n_devices]
+    return Mesh(np.array(devices), (axis,))
+
+
+def _pad_axis(x: np.ndarray, axis: int, multiple: int) -> np.ndarray:
+    n = x.shape[axis]
+    rem = (-n) % multiple
+    if rem == 0:
+        return x
+    pad = [(0, 0)] * x.ndim
+    pad[axis] = (0, rem)
+    return np.pad(x, pad)
+
+
+def _build_program(mesh: Mesh, C: int, K: int, num_slots: int,
+                   fungibility_enabled: bool):
+    sharded = P(AXIS)
+    repl = P()
+
+    @functools.partial(
+        shard_map, mesh=mesh,
+        in_specs=(sharded, sharded, sharded, sharded,   # usage/guar/lend/cohort_id (C axis)
+                  repl, repl, repl, repl,               # nominal/blim/guar_full/cohort_id_full
+                  repl, repl, repl, repl, repl, repl,   # group/slot/nf/policies
+                  sharded, sharded, sharded, sharded, sharded, sharded, sharded),
+        out_specs=sharded,
+        check_rep=False)
+    def run(usage_shard, guar_shard, lend_shard, cid_shard,
+            nominal, borrow_limit, guaranteed, cohort_id_full,
+            group_of_resource, slot_flavor, num_flavors,
+            bwc_enabled, borrow_pol, preempt_pol,
+            wl_cq, req, has_req, podset_valid, podset_unsat, elig, resume_slot):
+        # --- cohort aggregation over the sharded CQ axis (ICI psum) ---
+        above = jnp.maximum(usage_shard - guar_shard, 0)
+        part_cu = jax.ops.segment_sum(above, cid_shard, num_segments=K + 1)
+        cohort_usage = jax.lax.psum(part_cu, AXIS)[:K]
+        part_cr = jax.ops.segment_sum(lend_shard, cid_shard, num_segments=K + 1)
+        cohort_requestable = jax.lax.psum(part_cr, AXIS)[:K]
+        # Rebuild the full usage view for the workload-side gathers.
+        usage_full = jax.lax.all_gather(usage_shard, AXIS, axis=0, tiled=True)
+
+        return solve_core(
+            nominal, borrow_limit, guaranteed,
+            usage_full[:C],
+            cohort_requestable, cohort_usage, cohort_id_full,
+            group_of_resource, slot_flavor, num_flavors,
+            bwc_enabled, borrow_pol, preempt_pol,
+            wl_cq, req, has_req, podset_valid, podset_unsat, elig, resume_slot,
+            num_slots=num_slots, fungibility_enabled=fungibility_enabled)
+
+    return jax.jit(run)
+
+
+def sharded_flavor_fit(enc, usage_tensors, wt, mesh: Mesh) -> Dict[str, np.ndarray]:
+    """Run the batched flavor-fit solve sharded over `mesh`.
+
+    CQ usage aggregation happens on-device (psum over the mesh axis); the
+    workload axis is data-parallel. Returns the same outputs as
+    `models.flavor_fit.solve_flavor_fit`, truncated to the input sizes.
+    """
+    n_dev = mesh.devices.size
+    C = enc.nominal.shape[0]
+    W = wt.wl_cq.shape[0]
+    K = enc.num_cohorts
+    fungible = features.enabled(features.FLAVOR_FUNGIBILITY)
+
+    key = (id(mesh), n_dev, C, K, W, enc.num_slots, fungible,
+           wt.req.shape, wt.elig.shape)
+    program = _PROGRAM_CACHE.get(key)
+    if program is None:
+        program = _build_program(mesh, C, K, enc.num_slots, fungible)
+        _PROGRAM_CACHE[key] = program
+
+    # Pad the sharded axes to multiples of the mesh size.
+    usage = _pad_axis(usage_tensors.usage, 0, n_dev)
+    guaranteed_p = _pad_axis(enc.guaranteed, 0, n_dev)
+    lendable_p = _pad_axis(enc.lendable, 0, n_dev)
+    # Padding CQs land in a dead cohort slot (K) that no real CQ reads.
+    cohort_id_p = _pad_axis(enc.cohort_id, 0, n_dev)
+    cohort_id_p[C:] = K
+
+    out = program(
+        jnp.asarray(usage), jnp.asarray(guaranteed_p), jnp.asarray(lendable_p),
+        jnp.asarray(cohort_id_p),
+        jnp.asarray(enc.nominal), jnp.asarray(enc.borrow_limit),
+        jnp.asarray(enc.guaranteed), jnp.asarray(enc.cohort_id),
+        jnp.asarray(enc.group_of_resource), jnp.asarray(enc.slot_flavor),
+        jnp.asarray(enc.num_flavors),
+        jnp.asarray(enc.bwc_enabled), jnp.asarray(enc.borrow_policy_is_borrow),
+        jnp.asarray(enc.preempt_policy_is_preempt),
+        jnp.asarray(_pad_axis(wt.wl_cq, 0, n_dev)),
+        jnp.asarray(_pad_axis(wt.req, 0, n_dev)),
+        jnp.asarray(_pad_axis(wt.has_req, 0, n_dev)),
+        jnp.asarray(_pad_axis(wt.podset_valid, 0, n_dev)),
+        jnp.asarray(_pad_axis(wt.podset_unsat, 0, n_dev)),
+        jnp.asarray(_pad_axis(wt.elig, 0, n_dev)),
+        jnp.asarray(_pad_axis(wt.resume_slot, 0, n_dev)),
+    )
+    return {k: np.asarray(v)[:W] if v.ndim >= 1 else np.asarray(v)
+            for k, v in out.items()}

@@ -1,0 +1,326 @@
+"""Dense tensor encoding of the per-tick admission problem.
+
+This replaces the reference's per-workload pointer-chasing over the cache
+snapshot (pkg/cache/snapshot.go + flavorassigner's per-flavor loops) with a
+TPU-friendly dense layout: every quantity is an integer tensor indexed by a
+global (ClusterQueue, Flavor, Resource) vocabulary, so the whole batch of
+pending workloads is solved by one XLA program
+(`kueue_tpu.models.flavor_fit`).
+
+Axes:
+  W  workloads (padded to a bucket size)
+  P  pod sets per workload (padded)
+  C  cluster queues
+  F  flavors   (global vocabulary)
+  R  resources (global vocabulary)
+  G  resource groups per CQ (padded)
+  S  flavor slots per group (padded); slot order is the assignment
+     preference order
+  K  cohorts (every CQ belongs to one; cohort-less CQs get singletons,
+     which is arithmetically identical -- see fits math in the model)
+
+The "string world" (taints, tolerations, node affinity, namespace
+selectors) never reaches the device: it is folded into the boolean
+eligibility mask `elig[W,P,F]` here on the host
+(reference: flavorassigner.go:396-410 and :498-542).
+
+All quantities are int64 (canonical units); NO_LIMIT encodes a nil
+borrowingLimit.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from kueue_tpu import features
+from kueue_tpu.api.types import (
+    BorrowWithinCohortPolicy,
+    FlavorFungibilityPolicy,
+)
+from kueue_tpu.core.cache import CachedClusterQueue
+from kueue_tpu.core.snapshot import Snapshot
+from kueue_tpu.core.workload import WorkloadInfo
+from kueue_tpu.solver.eligibility import flavor_eligible
+
+PODS_RESOURCE = "pods"
+
+# Large sentinel for "no borrowing limit"; keeps nominal+limit < 2^63.
+NO_LIMIT = np.int64(1) << 62
+
+
+def _pad_pow2(n: int, floor: int = 8) -> int:
+    out = floor
+    while out < n:
+        out *= 2
+    return out
+
+
+@dataclass
+class CQEncoding:
+    """Static (per-generation) encoding of the ClusterQueue/cohort side."""
+
+    cq_names: List[str]
+    cq_index: Dict[str, int]
+    flavor_names: List[str]
+    flavor_index: Dict[str, int]
+    resource_names: List[str]
+    resource_index: Dict[str, int]
+    cohort_names: List[str]
+
+    nominal: np.ndarray        # [C,F,R] i64
+    borrow_limit: np.ndarray   # [C,F,R] i64 (NO_LIMIT when nil)
+    guaranteed: np.ndarray     # [C,F,R] i64 (0 unless LendingLimit)
+    lendable: np.ndarray       # [C,F,R] i64 (lendingLimit if set+enabled else nominal)
+    cohort_id: np.ndarray      # [C] i32
+    group_of_resource: np.ndarray  # [C,R] i32, -1 when not covered
+    slot_flavor: np.ndarray    # [C,G,S] i32 global flavor idx, -1 pad
+    num_flavors: np.ndarray    # [C,G] i32
+    bwc_enabled: np.ndarray    # [C] bool
+    borrow_policy_is_borrow: np.ndarray    # [C] bool (whenCanBorrow == Borrow)
+    preempt_policy_is_preempt: np.ndarray  # [C] bool (whenCanPreempt == Preempt)
+
+    num_cohorts: int
+    num_groups: int
+    num_slots: int
+
+    def cohort_requestable(self) -> np.ndarray:
+        """[K,F,R] sum of members' lendable quota (snapshot.go:160-178)."""
+        k = self.num_cohorts
+        out = np.zeros((k,) + self.lendable.shape[1:], dtype=np.int64)
+        np.add.at(out, self.cohort_id, self.lendable)
+        return out
+
+
+@dataclass
+class UsageTensors:
+    """The fast-changing side: per-CQ usage and its cohort aggregation."""
+
+    usage: np.ndarray         # [C,F,R] i64
+    cohort_usage: np.ndarray  # [K,F,R] i64: sum of max(0, usage-guaranteed)
+    cohort_requestable: np.ndarray  # [K,F,R] i64
+
+
+@dataclass
+class WorkloadTensors:
+    """The batch of pending workloads to solve."""
+
+    wl_cq: np.ndarray        # [W] i32
+    req: np.ndarray          # [W,P,R] i64
+    has_req: np.ndarray      # [W,P,R] bool
+    podset_valid: np.ndarray  # [W,P] bool
+    podset_unsat: np.ndarray  # [W,P] bool (requests a resource outside the vocab)
+    # Eligibility is per (group, slot): affinity matching is restricted to
+    # each group's label keys, so one flavor can be eligible in one group
+    # and ineligible in another (flavorassigner.go:498-542).
+    elig: np.ndarray         # [W,P,G,S] bool
+    resume_slot: np.ndarray  # [W,P,G] i32 (first slot to try)
+    wl_valid: np.ndarray     # [W] bool (padding rows are False)
+    num_real: int
+
+
+def encode_cluster_queues(snapshot: Snapshot) -> CQEncoding:
+    cq_names = sorted(snapshot.cluster_queues)
+    cq_index = {n: i for i, n in enumerate(cq_names)}
+    flavor_names = sorted(snapshot.resource_flavors)
+    flavor_index = {n: i for i, n in enumerate(flavor_names)}
+
+    resources = set()
+    max_groups = 1
+    max_slots = 1
+    for cq in snapshot.cluster_queues.values():
+        max_groups = max(max_groups, len(cq.resource_groups))
+        for rg in cq.resource_groups:
+            resources.update(rg.covered_resources)
+            max_slots = max(max_slots, len(rg.flavors))
+    resource_names = sorted(resources)
+    resource_index = {n: i for i, n in enumerate(resource_names)}
+
+    C, F, R = len(cq_names), len(flavor_names), len(resource_names)
+    G, S = max_groups, max_slots
+
+    nominal = np.zeros((C, F, R), dtype=np.int64)
+    borrow_limit = np.full((C, F, R), NO_LIMIT, dtype=np.int64)
+    guaranteed = np.zeros((C, F, R), dtype=np.int64)
+    lendable = np.zeros((C, F, R), dtype=np.int64)
+    cohort_id = np.zeros(C, dtype=np.int32)
+    group_of_resource = np.full((C, R), -1, dtype=np.int32)
+    slot_flavor = np.full((C, G, S), -1, dtype=np.int32)
+    num_flavors = np.zeros((C, G), dtype=np.int32)
+    bwc_enabled = np.zeros(C, dtype=bool)
+    borrow_is_borrow = np.zeros(C, dtype=bool)
+    preempt_is_preempt = np.zeros(C, dtype=bool)
+
+    lending_on = features.enabled(features.LENDING_LIMIT)
+
+    cohort_names: List[str] = []
+    cohort_idx: Dict[str, int] = {}
+    for ci, name in enumerate(cq_names):
+        cq = snapshot.cluster_queues[name]
+        cohort = cq.cohort.name if cq.cohort is not None else f"__solo__/{name}"
+        if cohort not in cohort_idx:
+            cohort_idx[cohort] = len(cohort_names)
+            cohort_names.append(cohort)
+        cohort_id[ci] = cohort_idx[cohort]
+
+        bwc = cq.preemption.borrow_within_cohort
+        bwc_enabled[ci] = (bwc is not None
+                           and bwc.policy != BorrowWithinCohortPolicy.NEVER)
+        borrow_is_borrow[ci] = (cq.flavor_fungibility.when_can_borrow
+                                == FlavorFungibilityPolicy.BORROW)
+        preempt_is_preempt[ci] = (cq.flavor_fungibility.when_can_preempt
+                                  == FlavorFungibilityPolicy.PREEMPT)
+
+        for gi, rg in enumerate(cq.resource_groups):
+            num_flavors[ci, gi] = len(rg.flavors)
+            for r in rg.covered_resources:
+                group_of_resource[ci, resource_index[r]] = gi
+            for si, fquotas in enumerate(rg.flavors):
+                fi = flavor_index.get(fquotas.name, -1)
+                slot_flavor[ci, gi, si] = fi
+                if fi < 0:
+                    continue
+                for rname, quota in fquotas.resources:
+                    ri = resource_index[rname]
+                    nominal[ci, fi, ri] = quota.nominal
+                    if quota.borrowing_limit is not None:
+                        borrow_limit[ci, fi, ri] = quota.borrowing_limit
+                    if lending_on and quota.lending_limit is not None:
+                        lendable[ci, fi, ri] = quota.lending_limit
+                        guaranteed[ci, fi, ri] = quota.nominal - quota.lending_limit
+                    else:
+                        lendable[ci, fi, ri] = quota.nominal
+
+    return CQEncoding(
+        cq_names=cq_names, cq_index=cq_index,
+        flavor_names=flavor_names, flavor_index=flavor_index,
+        resource_names=resource_names, resource_index=resource_index,
+        cohort_names=cohort_names,
+        nominal=nominal, borrow_limit=borrow_limit, guaranteed=guaranteed,
+        lendable=lendable, cohort_id=cohort_id,
+        group_of_resource=group_of_resource, slot_flavor=slot_flavor,
+        num_flavors=num_flavors, bwc_enabled=bwc_enabled,
+        borrow_policy_is_borrow=borrow_is_borrow,
+        preempt_policy_is_preempt=preempt_is_preempt,
+        num_cohorts=len(cohort_names), num_groups=G, num_slots=S,
+    )
+
+
+def encode_usage(snapshot: Snapshot, enc: CQEncoding) -> UsageTensors:
+    C = len(enc.cq_names)
+    F = len(enc.flavor_names)
+    R = len(enc.resource_names)
+    usage = np.zeros((C, F, R), dtype=np.int64)
+    for ci, name in enumerate(enc.cq_names):
+        cq = snapshot.cluster_queues[name]
+        for fname, resources in cq.usage.items():
+            fi = enc.flavor_index.get(fname)
+            if fi is None:
+                continue
+            for rname, val in resources.items():
+                ri = enc.resource_index.get(rname)
+                if ri is not None:
+                    usage[ci, fi, ri] = val
+    above_guaranteed = np.maximum(usage - enc.guaranteed, 0)
+    cohort_usage = np.zeros((enc.num_cohorts, F, R), dtype=np.int64)
+    np.add.at(cohort_usage, enc.cohort_id, above_guaranteed)
+    return UsageTensors(
+        usage=usage,
+        cohort_usage=cohort_usage,
+        cohort_requestable=enc.cohort_requestable(),
+    )
+
+
+def encode_workloads(workloads: Sequence[WorkloadInfo], snapshot: Snapshot,
+                     enc: CQEncoding,
+                     counts: Optional[Sequence[Optional[Sequence[int]]]] = None,
+                     pad_to: Optional[int] = None) -> WorkloadTensors:
+    """Encode pending workloads against the CQ encoding.
+
+    Taint/affinity eligibility and the resume-from-last-flavor slot are
+    computed here, host-side. `counts` optionally overrides pod counts per
+    workload (partial admission).
+    """
+    n = len(workloads)
+    W = pad_to if pad_to is not None else _pad_pow2(max(n, 1))
+    P = 1
+    for wi in workloads:
+        P = max(P, len(wi.total_requests))
+    F = len(enc.flavor_names)
+    R = len(enc.resource_names)
+    G = enc.num_groups
+
+    S = enc.num_slots
+    wl_cq = np.zeros(W, dtype=np.int32)
+    req = np.zeros((W, P, R), dtype=np.int64)
+    has_req = np.zeros((W, P, R), dtype=bool)
+    podset_valid = np.zeros((W, P), dtype=bool)
+    podset_unsat = np.zeros((W, P), dtype=bool)
+    elig = np.zeros((W, P, G, S), dtype=bool)
+    resume_slot = np.zeros((W, P, G), dtype=np.int32)
+    wl_valid = np.zeros(W, dtype=bool)
+
+    for w, wi in enumerate(workloads):
+        cq = snapshot.cluster_queues[wi.cluster_queue]
+        ci = enc.cq_index[wi.cluster_queue]
+        wl_cq[w] = ci
+        wl_valid[w] = True
+
+        # Stale resume state is dropped exactly like the referee
+        # (flavorassigner.go:244-247).
+        last = wi.last_assignment
+        if last is not None:
+            outdated = (cq.allocatable_generation > last.cluster_queue_generation
+                        or (cq.cohort is not None
+                            and cq.cohort.allocatable_generation
+                            > last.cohort_generation))
+            if outdated:
+                last = None
+
+        totals = wi.total_requests
+        if counts is not None and counts[w] is not None:
+            totals = [totals[i].scaled_to(c) for i, c in enumerate(counts[w])]
+
+        group_keys = [cq.label_keys(rg, snapshot.resource_flavors)
+                      for rg in cq.resource_groups]
+
+        for p, ps in enumerate(totals):
+            podset_valid[w, p] = True
+            requests = dict(ps.requests)
+            if PODS_RESOURCE in cq.rg_by_resource:
+                requests[PODS_RESOURCE] = ps.count
+            for rname, val in requests.items():
+                ri = enc.resource_index.get(rname)
+                if ri is None:
+                    # A resource outside the global vocabulary is covered by
+                    # no CQ: the podset can never be satisfied.
+                    podset_unsat[w, p] = True
+                    continue
+                req[w, p, ri] = val
+                has_req[w, p, ri] = True
+
+            # Eligibility per (group, slot): each group's label keys scope
+            # the affinity match.
+            podset = wi.obj.pod_sets[p]
+            for gi, rg in enumerate(cq.resource_groups):
+                for si, fquotas in enumerate(rg.flavors):
+                    flavor = snapshot.resource_flavors.get(fquotas.name)
+                    if flavor is None:
+                        continue
+                    ok, _ = flavor_eligible(podset, flavor, group_keys[gi])
+                    elig[w, p, gi, si] = ok
+                # Resume slot for this group: any covered requested
+                # resource carries the group's shared index.
+                if last is not None:
+                    for rname in rg.covered_resources:
+                        if rname in requests:
+                            resume_slot[w, p, gi] = \
+                                last.next_flavor_to_try(p, rname)
+                            break
+
+    return WorkloadTensors(
+        wl_cq=wl_cq, req=req, has_req=has_req, podset_valid=podset_valid,
+        podset_unsat=podset_unsat, elig=elig, resume_slot=resume_slot,
+        wl_valid=wl_valid, num_real=n)

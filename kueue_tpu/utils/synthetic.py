@@ -1,0 +1,112 @@
+"""Synthetic problem generator for benchmarks and compile checks.
+
+Shapes follow the north-star scale target (BASELINE.md): up to 50k pending
+Workloads x 1k ClusterQueues x 100 cohorts x 8 ResourceFlavors.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import List, Tuple
+
+from kueue_tpu.api.types import (
+    Admission,
+    ClusterQueue,
+    ClusterQueuePreemption,
+    FlavorQuotas,
+    LocalQueue,
+    PodSet,
+    PodSetAssignment,
+    ResourceFlavor,
+    ResourceGroup,
+    Workload,
+)
+from kueue_tpu.core.cache import Cache
+from kueue_tpu.core.snapshot import Snapshot
+from kueue_tpu.core.workload import WorkloadInfo
+
+
+def synthetic_problem(
+    num_cqs: int = 1000,
+    num_cohorts: int = 100,
+    num_flavors: int = 8,
+    num_pending: int = 1000,
+    usage_fill: float = 0.5,
+    seed: int = 0,
+) -> Tuple[Cache, List[WorkloadInfo]]:
+    """Build a cache (with admitted usage) plus pending workloads.
+
+    `num_pending` is the batch handed to the solver in one tick: the
+    reference admits one head per ClusterQueue per cycle
+    (manager.go:489-508), so a 1k-CQ cluster solves <=1k heads/tick
+    regardless of the 50k-deep backlog.
+    """
+    rnd = random.Random(seed)
+    cache = Cache()
+
+    for f in range(num_flavors):
+        cache.add_or_update_resource_flavor(ResourceFlavor.make(f"flavor-{f}"))
+
+    for c in range(num_cqs):
+        n_flavors = rnd.randint(2, min(4, num_flavors))
+        chosen = rnd.sample(range(num_flavors), n_flavors)
+        fqs = tuple(
+            FlavorQuotas.make(
+                f"flavor-{fi}",
+                cpu=rnd.randint(16, 128),
+                memory=f"{rnd.randint(64, 512)}Gi",
+            )
+            for fi in chosen
+        )
+        cache.add_cluster_queue(ClusterQueue(
+            name=f"cq-{c}",
+            resource_groups=(ResourceGroup(("cpu", "memory"), fqs),),
+            cohort=f"cohort-{c % num_cohorts}",
+            preemption=ClusterQueuePreemption(
+                within_cluster_queue="LowerPriority",
+                reclaim_within_cohort="Any"),
+        ))
+        cache.add_local_queue(LocalQueue(
+            name=f"lq-{c}", namespace="default", cluster_queue=f"cq-{c}"))
+
+    # Admitted usage: fill roughly `usage_fill` of each CQ's first flavor.
+    for c in range(num_cqs):
+        cq = cache.cluster_queues[f"cq-{c}"]
+        fq0 = cq.resource_groups[0].flavors[0]
+        quota = fq0.resources_dict["cpu"].nominal
+        target = int(quota * usage_fill)
+        if target <= 0:
+            continue
+        wl = Workload(
+            name=f"adm-{c}", namespace="default", queue_name=f"lq-{c}",
+            creation_time=float(c),
+            pod_sets=[PodSet.make("main", count=1)])
+        wl.admission = Admission(
+            cluster_queue=f"cq-{c}",
+            pod_set_assignments=[PodSetAssignment(
+                name="main",
+                flavors={"cpu": fq0.name, "memory": fq0.name},
+                resource_usage={"cpu": target,
+                                "memory": target * (1024 ** 2)},
+                count=1)])
+        wl.set_condition("QuotaReserved", True, now=float(c))
+        wl.set_condition("Admitted", True, now=float(c))
+        cache.add_or_update_workload(wl)
+
+    pending: List[WorkloadInfo] = []
+    for i in range(num_pending):
+        c = i % num_cqs
+        n_podsets = rnd.randint(1, 2)
+        pod_sets = [
+            PodSet.make(
+                f"ps{p}", count=rnd.randint(1, 8),
+                cpu=rnd.randint(1, 8),
+                memory=f"{rnd.randint(1, 16)}Gi")
+            for p in range(n_podsets)
+        ]
+        wl = Workload(
+            name=f"pend-{i}", namespace="default", queue_name=f"lq-{c}",
+            priority=rnd.randint(-2, 2), creation_time=float(i),
+            pod_sets=pod_sets)
+        pending.append(WorkloadInfo(wl, cluster_queue=f"cq-{c}"))
+    return cache, pending

@@ -1,11 +1,18 @@
 import os
 
-# Solver tests run on a virtual 8-device CPU mesh; must be set before jax import.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Solver tests run on a virtual 8-device CPU mesh; must be set before the
+# backend initializes. Env vars alone are not enough here: the image's
+# sitecustomize force-registers a TPU platform, so pin the platform through
+# jax.config as well.
+os.environ["JAX_PLATFORMS"] = "cpu"
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (
         xla_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest
 

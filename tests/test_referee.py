@@ -84,7 +84,7 @@ def test_one_resource_no_fit_fails_podset():
 
 def test_resource_not_in_cq():
     cache = single_cq_cache()
-    a = solve(cache, make_wl("w", cpu=1, **{"nvidia_com/gpu": 1}), "cq")
+    a = solve(cache, make_wl("w", cpu=1, **{"gpu": 1}), "cq")
     # gpu resource isn't configured on the CQ.
     assert a.representative_mode == NO_FIT
     assert "unavailable in ClusterQueue" in a.message()

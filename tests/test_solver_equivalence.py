@@ -1,0 +1,229 @@
+"""Decision-equivalence: the batched JAX solver must reproduce the referee's
+decisions exactly (modes, flavor choices, borrow flags, usage, resume state)
+on randomized problems."""
+
+import random
+
+import pytest
+
+from kueue_tpu.api.types import (
+    BorrowWithinCohort,
+    ClusterQueuePreemption,
+    FlavorFungibility,
+    PodSet,
+    ResourceFlavor,
+    Taint,
+    Toleration,
+    Workload,
+)
+from kueue_tpu.core.cache import Cache
+from kueue_tpu.core.workload import WorkloadInfo
+from kueue_tpu.models.flavor_fit import BatchSolver
+from kueue_tpu.solver.referee import assign_flavors
+
+from tests.util import fq, make_cq, make_flavor, make_lq, make_wl, rg
+from tests.test_cache import admit
+
+
+def random_problem(seed: int, num_cqs=4, num_flavors=3, num_wls=24):
+    rnd = random.Random(seed)
+    cache = Cache()
+    flavors = []
+    for i in range(num_flavors):
+        taints = []
+        if rnd.random() < 0.3:
+            taints = [Taint(key="special", value="true")]
+        labels = {"tier": f"t{i}"} if rnd.random() < 0.5 else None
+        flavors.append(ResourceFlavor.make(f"f{i}", node_labels=labels,
+                                           node_taints=taints))
+        cache.add_or_update_resource_flavor(flavors[-1])
+
+    cohorts = ["co-a", "co-b", ""]
+    resources = ["cpu", "memory"]
+    for c in range(num_cqs):
+        n_flavors = rnd.randint(1, num_flavors)
+        order = rnd.sample(range(num_flavors), n_flavors)
+        fqs = []
+        for fi in order:
+            quotas = {}
+            for r in resources:
+                nominal = rnd.randint(0, 12)
+                borrow = rnd.choice([None, rnd.randint(0, 6)])
+                quotas[r] = (nominal, borrow)
+            fqs.append(fq(f"f{fi}", **quotas))
+        preemption = ClusterQueuePreemption(
+            within_cluster_queue=rnd.choice(["Never", "LowerPriority"]),
+            reclaim_within_cohort=rnd.choice(["Never", "Any"]),
+            borrow_within_cohort=rnd.choice([
+                None, BorrowWithinCohort(policy="LowerPriority")]))
+        fungibility = FlavorFungibility(
+            when_can_borrow=rnd.choice(["Borrow", "TryNextFlavor"]),
+            when_can_preempt=rnd.choice(["Preempt", "TryNextFlavor"]))
+        cq = make_cq(f"cq{c}", rg(tuple(resources), *fqs),
+                     cohort=rnd.choice(cohorts),
+                     preemption=preemption, fungibility=fungibility)
+        cache.add_cluster_queue(cq)
+        cache.add_local_queue(make_lq(f"lq{c}", cq=f"cq{c}"))
+
+    # Random admitted workloads to create usage.
+    for i in range(num_wls // 2):
+        c = rnd.randrange(num_cqs)
+        wl = make_wl(f"adm{i}", f"lq{c}",
+                     cpu=rnd.randint(1, 4), memory=rnd.randint(1, 4))
+        flavor = f"f{rnd.randrange(num_flavors)}"
+        cache.add_or_update_workload(admit(wl, f"cq{c}", flavor))
+
+    # Pending workloads to solve.
+    pending = []
+    for i in range(num_wls):
+        c = rnd.randrange(num_cqs)
+        pod_sets = []
+        for p in range(rnd.randint(1, 2)):
+            kwargs = {}
+            if rnd.random() < 0.25:
+                kwargs["tolerations"] = [
+                    Toleration(key="special", operator="Equal", value="true")]
+            if rnd.random() < 0.25:
+                kwargs["node_selector"] = {"tier": f"t{rnd.randrange(num_flavors)}"}
+            pod_sets.append(PodSet.make(
+                f"ps{p}", count=rnd.randint(1, 3),
+                cpu=rnd.randint(0, 5), memory=rnd.randint(0, 5), **kwargs))
+        wl = make_wl(f"pend{i}", f"lq{c}", priority=rnd.randint(-2, 2),
+                     pod_sets=pod_sets)
+        pending.append(WorkloadInfo(wl, cluster_queue=f"cq{c}"))
+    return cache, pending
+
+
+def assert_assignment_equal(ref, got, ctx):
+    assert got.representative_mode == ref.representative_mode, \
+        f"{ctx}: mode {got.representative_mode} != {ref.representative_mode}"
+    if ref.representative_mode == 0:
+        # NoFit: flavor details beyond the failing podset are unspecified,
+        # but the resume state still matters (it drives requeue decisions).
+        assert (got.last_state.last_tried_flavor_idx
+                == ref.last_state.last_tried_flavor_idx), f"{ctx}: last state"
+        return
+    assert got.borrowing == ref.borrowing, f"{ctx}: borrowing"
+    assert got.usage == ref.usage, f"{ctx}: usage {got.usage} != {ref.usage}"
+    assert len(got.pod_sets) == len(ref.pod_sets), f"{ctx}: podsets"
+    for p, (rps, gps) in enumerate(zip(ref.pod_sets, got.pod_sets)):
+        ref_flavors = {r: (fa.name, fa.mode, fa.borrow, fa.tried_flavor_idx)
+                       for r, fa in rps.flavors.items()}
+        got_flavors = {r: (fa.name, fa.mode, fa.borrow, fa.tried_flavor_idx)
+                       for r, fa in gps.flavors.items()}
+        assert got_flavors == ref_flavors, \
+            f"{ctx} podset {p}: {got_flavors} != {ref_flavors}"
+    assert (got.last_state.last_tried_flavor_idx
+            == ref.last_state.last_tried_flavor_idx), f"{ctx}: last state"
+
+
+@pytest.mark.parametrize("seed", range(20))
+def test_random_equivalence(seed):
+    cache, pending = random_problem(seed)
+    snap_ref = cache.snapshot()
+    snap_jax = cache.snapshot()
+
+    ref_results = []
+    for wi in pending:
+        cq = snap_ref.cluster_queues[wi.cluster_queue]
+        ref_results.append(
+            assign_flavors(wi.clone(), cq, snap_ref.resource_flavors))
+
+    solver = BatchSolver()
+    jax_results = solver.solve([wi.clone() for wi in pending], snap_jax)
+
+    for i, (ref, got) in enumerate(zip(ref_results, jax_results)):
+        assert_assignment_equal(ref, got, f"seed={seed} wl={pending[i].key}")
+
+
+def test_equivalence_with_resume_state(seed=7):
+    # Second attempts must resume from the recorded flavor index in both
+    # implementations.
+    cache, pending = random_problem(seed)
+    snap = cache.snapshot()
+    solver = BatchSolver()
+
+    ref_infos = [wi.clone() for wi in pending]
+    jax_infos = [wi.clone() for wi in pending]
+
+    # First pass records resume state on the infos.
+    for wi in ref_infos:
+        a = assign_flavors(wi, snap.cluster_queues[wi.cluster_queue],
+                           snap.resource_flavors)
+        wi.last_assignment = a.last_state
+    first = solver.solve(jax_infos, snap)
+    for wi, a in zip(jax_infos, first):
+        wi.last_assignment = a.last_state
+
+    # Second pass must agree.
+    ref2 = []
+    for wi in ref_infos:
+        ref2.append(assign_flavors(
+            wi, snap.cluster_queues[wi.cluster_queue], snap.resource_flavors))
+    got2 = solver.solve(jax_infos, snap)
+    for i, (ref, got) in enumerate(zip(ref2, got2)):
+        assert_assignment_equal(ref, got, f"resume wl={ref_infos[i].key}")
+
+
+def _solve_both(cache, wl, cq_name):
+    snap = cache.snapshot()
+    wi = WorkloadInfo(wl, cluster_queue=cq_name)
+    ref = assign_flavors(wi.clone(), snap.cluster_queues[cq_name],
+                         snap.resource_flavors)
+    got = BatchSolver().solve([wi.clone()], snap)[0]
+    return ref, got
+
+
+def test_resource_in_vocab_but_not_in_cq():
+    # 'gpu' exists in the global vocabulary (cq-b covers it) but cq-a does
+    # not cover it: both solvers must reject the workload.
+    cache = Cache()
+    cache.add_or_update_resource_flavor(make_flavor("default"))
+    cache.add_cluster_queue(make_cq("cq-a", rg("cpu", fq("default", cpu=8))))
+    cache.add_cluster_queue(make_cq(
+        "cq-b", rg("gpu", fq("default", **{"gpu": 4}))))
+    wl = make_wl("w", pod_sets=[
+        PodSet.make(
+            "main", count=1, cpu=1, **{"gpu": 1})])
+    ref, got = _solve_both(cache, wl, "cq-a")
+    assert ref.representative_mode == 0
+    assert got.representative_mode == 0
+    assert_assignment_equal(ref, got, "uncovered-resource")
+
+
+def test_same_flavor_in_two_groups_group_scoped_affinity():
+    # fA appears in two groups; the tier selector is only constraining in
+    # the group whose flavors carry the 'tier' label key.
+    from kueue_tpu.api.types import ResourceFlavor as RF
+    cache = Cache()
+    cache.add_or_update_resource_flavor(RF.make("fA"))
+    cache.add_or_update_resource_flavor(RF.make("fB", node_labels={"tier": "t1"}))
+    cache.add_cluster_queue(make_cq(
+        "cq",
+        rg("cpu", fq("fA", cpu=8)),
+        rg("gpu", fq("fB", **{"gpu": 4}),
+           fq("fA", **{"gpu": 4}))))
+    wl = make_wl("w", pod_sets=[PodSet.make(
+        "main", count=1, cpu=1, node_selector={"tier": "t1"},
+        **{"gpu": 1})])
+    ref, got = _solve_both(cache, wl, "cq")
+    assert ref.representative_mode == 2
+    assert_assignment_equal(ref, got, "two-group-flavor")
+
+
+def test_fungibility_gate_off():
+    from kueue_tpu import features
+    features.set_enabled(features.FLAVOR_FUNGIBILITY, False)
+    cache = Cache()
+    cache.add_or_update_resource_flavor(make_flavor("f0"))
+    cache.add_or_update_resource_flavor(make_flavor("f1"))
+    fung = FlavorFungibility(when_can_preempt="Preempt")
+    cache.add_cluster_queue(make_cq(
+        "cq", rg("cpu", fq("f0", cpu=4), fq("f1", cpu=8)), fungibility=fung))
+    cache.add_local_queue(make_lq("main", cq="cq"))
+    cache.add_or_update_workload(admit(make_wl("w0", cpu=4), "cq", "f0"))
+    # Gate off ignores whenCanPreempt=Preempt: keep scanning to the Fit on f1.
+    ref, got = _solve_both(cache, make_wl("w", cpu=2), "cq")
+    assert ref.representative_mode == 2
+    assert ref.pod_sets[0].flavors["cpu"].name == "f1"
+    assert_assignment_equal(ref, got, "gate-off")
