@@ -83,6 +83,26 @@ class FlavorFungibility:
     when_can_preempt: str = FlavorFungibilityPolicy.TRY_NEXT_FLAVOR
 
 
+class FairSharingStrategy:
+    """Fair-share preemption rules (KEP-1714 S2-a / S2-b)."""
+
+    LESS_THAN_OR_EQUAL_TO_FINAL_SHARE = "LessThanOrEqualToFinalShare"
+    LESS_THAN_INITIAL_SHARE = "LessThanInitialShare"
+
+
+@dataclass(frozen=True)
+class FairSharing:
+    """Weight-based fair sharing of borrowed capacity (KEP-1714).
+
+    The reference snapshot only designs this (keps/1714-fair-sharing);
+    this framework implements it natively. Weight scales the tolerated
+    share: a CQ with weight 2 may borrow twice as much as its siblings
+    before being considered over-share.
+    """
+
+    weight: float = 1.0
+
+
 # ---------------------------------------------------------------------------
 # Label / node selection (host-side string world)
 # ---------------------------------------------------------------------------
@@ -278,6 +298,7 @@ class ClusterQueue:
     flavor_fungibility: FlavorFungibility = field(default_factory=FlavorFungibility)
     admission_checks: Tuple[str, ...] = ()
     stop_policy: str = StopPolicy.NONE
+    fair_sharing: Optional[FairSharing] = None
 
 
 @dataclass

@@ -1,0 +1,70 @@
+"""Runtime configuration (counterpart of reference apis/config/v1beta1 +
+pkg/config).
+
+One Configuration object drives the runtime: waitForPodsReady gating and
+requeuing backoff (apis/config/v1beta1/configuration_types.go), queue
+visibility, and the fair-sharing knobs this framework implements natively
+(KEP-1714).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional, Tuple
+
+from kueue_tpu.api.types import FairSharingStrategy
+
+REQUEUING_TIMESTAMP_EVICTION = "Eviction"
+REQUEUING_TIMESTAMP_CREATION = "Creation"
+
+# Base/factor of the PodsReady requeue backoff
+# (reference: core/workload_controller.go:393-399).
+BACKOFF_BASE_SECONDS = 1.0
+BACKOFF_FACTOR = 1.41284738
+
+
+@dataclass(frozen=True)
+class RequeuingStrategy:
+    timestamp: str = REQUEUING_TIMESTAMP_EVICTION
+    # None = endless requeueing; otherwise deactivate after this many
+    # requeues (workload_controller.go:373-384).
+    backoff_limit_count: Optional[int] = None
+
+
+@dataclass(frozen=True)
+class WaitForPodsReady:
+    enable: bool = False
+    timeout_seconds: float = 300.0
+    # Block new admissions while any admitted workload is not PodsReady
+    # (KEP-349 all-or-nothing).
+    block_admission: bool = True
+    requeuing_strategy: RequeuingStrategy = field(default_factory=RequeuingStrategy)
+
+
+@dataclass(frozen=True)
+class FairSharingConfig:
+    enable: bool = False
+    preemption_strategies: Tuple[str, ...] = (
+        FairSharingStrategy.LESS_THAN_OR_EQUAL_TO_FINAL_SHARE,
+        FairSharingStrategy.LESS_THAN_INITIAL_SHARE,
+    )
+
+
+@dataclass(frozen=True)
+class QueueVisibility:
+    max_count: int = 10
+    update_interval_seconds: float = 5.0
+
+
+@dataclass(frozen=True)
+class Configuration:
+    namespace: str = "kueue-system"
+    wait_for_pods_ready: Optional[WaitForPodsReady] = None
+    fair_sharing: Optional[FairSharingConfig] = None
+    queue_visibility: QueueVisibility = field(default_factory=QueueVisibility)
+
+
+def requeue_backoff_seconds(requeue_count: int) -> float:
+    """Backoff before an evicted-by-PodsReady workload requeues:
+    base * factor^(n-1) (workload_controller.go:393-404, jitter omitted)."""
+    return BACKOFF_BASE_SECONDS * (BACKOFF_FACTOR ** max(0, requeue_count - 1))

@@ -19,41 +19,71 @@ from __future__ import annotations
 import time as _time
 from typing import Callable, Dict, List, Optional
 
+from kueue_tpu import features
 from kueue_tpu.api.types import (
     CONDITION_ADMITTED,
     CONDITION_EVICTED,
     CONDITION_FINISHED,
+    CONDITION_PODS_READY,
     CONDITION_QUOTA_RESERVED,
+    EVICTED_BY_DEACTIVATION,
+    EVICTED_BY_PODS_READY_TIMEOUT,
     ClusterQueue,
     LocalQueue,
+    RequeueState,
     ResourceFlavor,
     Workload,
+    WorkloadPriorityClass,
 )
+from kueue_tpu.config import Configuration, requeue_backoff_seconds
 from kueue_tpu.core.cache import Cache
 from kueue_tpu.core.workload import WorkloadInfo, WorkloadOrdering
 from kueue_tpu.queue.manager import Manager, RequeueReason
+from kueue_tpu.scheduler.preemption import DEFAULT_FAIR_STRATEGIES
 from kueue_tpu.scheduler.scheduler import Scheduler
 
 
 class Framework:
     def __init__(self, batch_solver=None,
+                 config: Optional[Configuration] = None,
                  ordering: Optional[WorkloadOrdering] = None,
                  clock: Callable[[], float] = _time.time):
         self.clock = clock
-        self.ordering = ordering or WorkloadOrdering()
+        self.config = config or Configuration()
+        wfpr = self.config.wait_for_pods_ready
+        if ordering is None:
+            ordering = WorkloadOrdering(
+                pods_ready_requeuing_timestamp=(
+                    wfpr.requeuing_strategy.timestamp if wfpr else "Eviction"))
+        self.ordering = ordering
+        if self.config.fair_sharing is not None:
+            # NOTE: fair sharing is a process-global switch (KEP-1714 scopes
+            # it cluster-wide); an explicit config sets the gate either way.
+            features.set_enabled(features.FAIR_SHARING,
+                                 self.config.fair_sharing.enable)
+        fair_strategies = (
+            self.config.fair_sharing.preemption_strategies
+            if self.config.fair_sharing is not None else DEFAULT_FAIR_STRATEGIES)
         self.namespaces: Dict[str, Dict[str, str]] = {"default": {}}
         self.workloads: Dict[str, Workload] = {}
+        self.priority_classes: Dict[str, WorkloadPriorityClass] = {}
         self.cache = Cache()
         self.queues = Manager(ordering=self.ordering,
                               namespace_lister=self.namespaces.get,
                               clock=clock)
+        gate = None
+        if wfpr is not None and wfpr.enable and wfpr.block_admission:
+            gate = self._all_admitted_pods_ready
         self.scheduler = Scheduler(
             queues=self.queues, cache=self.cache,
             apply_admission=self._apply_admission,
             apply_preemption=self._apply_preemption,
             namespace_lister=self.namespaces.get,
             batch_solver=batch_solver,
-            ordering=self.ordering, clock=clock)
+            ordering=self.ordering,
+            pods_ready_gate=gate,
+            fair_strategies=fair_strategies,
+            clock=clock)
         self._evicted_dirty: List[Workload] = []
 
     # -- admin objects -------------------------------------------------------
@@ -85,12 +115,39 @@ class Framework:
         self.cache.add_local_queue(lq)
         self.queues.add_local_queue(lq, pending=list(self.workloads.values()))
 
+    def create_workload_priority_class(self, pc: WorkloadPriorityClass) -> None:
+        self.priority_classes[pc.name] = pc
+
     # -- workload lifecycle --------------------------------------------------
 
     def submit(self, wl: Workload) -> None:
         """A new pending workload enters the system."""
+        if wl.priority_class and wl.priority_class in self.priority_classes:
+            # Priority resolution from WorkloadPriorityClass
+            # (reference: pkg/util/priority).
+            wl.priority = self.priority_classes[wl.priority_class].value
         self.workloads[wl.key] = wl
         self.queues.add_or_update_workload(wl)
+
+    def mark_pods_ready(self, wl: Workload, ready: bool = True) -> None:
+        """The job integration reports pod readiness (KEP-349)."""
+        wl.set_condition(CONDITION_PODS_READY, ready, reason="PodsReady",
+                         now=self.clock())
+        if ready:
+            # Readiness may unblock gated admissions; re-open parked queues.
+            self.queues.queue_inadmissible_workloads(
+                list(self.queues.cluster_queues))
+
+    def _all_admitted_pods_ready(self) -> bool:
+        """cache.PodsReadyForAllAdmittedWorkloads (cache.go:118-143)."""
+        for cq in self.cache.cluster_queues.values():
+            for wi in cq.workloads.values():
+                wl = self.workloads.get(wi.key)
+                if wl is None:
+                    wl = wi.obj
+                if wl.is_admitted and not wl.condition_true(CONDITION_PODS_READY):
+                    return False
+        return True
 
     def finish(self, wl: Workload) -> None:
         """Mark a workload Finished and release its quota
@@ -128,6 +185,7 @@ class Framework:
 
     def reconcile(self) -> None:
         """Apply async lifecycle transitions (workload_controller.go analog)."""
+        self._reconcile_not_ready_timeouts()
         evicted, self._evicted_dirty = self._evicted_dirty, []
         for wl in evicted:
             if wl.has_quota_reservation:
@@ -138,7 +196,8 @@ class Framework:
                 wl.set_condition(CONDITION_ADMITTED, False, reason="Evicted",
                                  now=self.clock())
                 self.queues.queue_associated_inadmissible_workloads(wl)
-            self.queues.add_or_update_workload(wl)
+            if wl.active:
+                self.queues.add_or_update_workload(wl)
         # Two-phase admission: flip Admitted once every check is Ready
         # (workload_controller.go:175-184).
         for wl in self.workloads.values():
@@ -156,10 +215,45 @@ class Framework:
                                  now=self.clock())
                 self.cache.add_or_update_workload(wl)
 
+    def _reconcile_not_ready_timeouts(self) -> None:
+        """Evict admitted workloads that exceeded the PodsReady timeout, with
+        exponential requeue backoff and deactivation after the backoff limit
+        (workload_controller.go:342-406)."""
+        wfpr = self.config.wait_for_pods_ready
+        if wfpr is None or not wfpr.enable:
+            return
+        now = self.clock()
+        limit = wfpr.requeuing_strategy.backoff_limit_count
+        for wl in list(self.workloads.values()):
+            if not wl.active or wl.is_evicted or not wl.is_admitted:
+                continue
+            if wl.condition_true(CONDITION_PODS_READY):
+                continue
+            admitted_at = wl.find_condition(CONDITION_ADMITTED).last_transition_time
+            if now - admitted_at < wfpr.timeout_seconds:
+                continue
+            count = (wl.requeue_state.count if wl.requeue_state else 0) + 1
+            if limit is not None and count > limit:
+                wl.active = False
+                wl.set_condition(CONDITION_EVICTED, True,
+                                 reason=EVICTED_BY_DEACTIVATION,
+                                 message="Deactivated by reaching the requeue "
+                                         "backoffLimitCount", now=now)
+            else:
+                wl.requeue_state = RequeueState(
+                    count=count,
+                    requeue_at=now + requeue_backoff_seconds(count))
+                wl.set_condition(CONDITION_EVICTED, True,
+                                 reason=EVICTED_BY_PODS_READY_TIMEOUT,
+                                 message=f"Exceeded the PodsReady timeout "
+                                         f"{wfpr.timeout_seconds}s", now=now)
+            self._evicted_dirty.append(wl)
+
     # -- driving -------------------------------------------------------------
 
     def tick(self) -> int:
         """One scheduling cycle plus the reconcile pass; returns admissions."""
+        self.queues.flush_expired_backoffs()
         admitted = self.scheduler.schedule(timeout=0.0)
         self.reconcile()
         return admitted

@@ -76,6 +76,7 @@ class CachedClusterQueue:
         self.preemption: ClusterQueuePreemption = ClusterQueuePreemption()
         self.flavor_fungibility: FlavorFungibility = FlavorFungibility()
         self.admission_checks: Set[str] = set()
+        self.fair_weight: float = 1.0
         self.guaranteed_quota: FlavorResourceQuantities = {}
         # Bumped when admitted workloads are deleted or resource groups change,
         # invalidating flavor-search resume state (clusterqueue.go:62-63).
@@ -101,6 +102,8 @@ class CachedClusterQueue:
         self.admission_checks = set(spec.admission_checks)
         self.preemption = spec.preemption
         self.flavor_fungibility = spec.flavor_fungibility
+        self.fair_weight = (spec.fair_sharing.weight
+                            if spec.fair_sharing is not None else 1.0)
 
         # Prune usage for removed flavors/resources; keep existing counts.
         new_usage: FlavorResourceQuantities = {}

@@ -77,6 +77,7 @@ def _snapshot_cq(cq: CachedClusterQueue) -> CachedClusterQueue:
     cc.preemption = cq.preemption
     cc.flavor_fungibility = cq.flavor_fungibility
     cc.admission_checks = set(cq.admission_checks)
+    cc.fair_weight = cq.fair_weight
     cc.guaranteed_quota = cq.guaranteed_quota if features.enabled(features.LENDING_LIMIT) else {}
     cc.allocatable_generation = cq.allocatable_generation
     cc.has_missing_flavors = cq.has_missing_flavors

@@ -8,3 +8,4 @@ import jax
 jax.config.update("jax_enable_x64", True)
 
 from kueue_tpu.models.flavor_fit import BatchSolver, solve_flavor_fit
+from kueue_tpu.models.fair_share import share_values

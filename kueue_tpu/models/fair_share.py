@@ -1,0 +1,76 @@
+"""Batched weighted-DRF share values on the accelerator (KEP-1714).
+
+Computes the share value of every ClusterQueue in one program: usage above
+nominal summed over flavors per resource, divided by the cohort's lendable
+capacity, max over resources, divided by weight. Integer parts-per-1024,
+exactly matching `kueue_tpu.solver.fair_share.dominant_resource_share`.
+
+At the north-star scale (1k CQs) the host loop is per-CQ Python; this model
+scores all CQs in one fused XLA program -- it is also the building block
+for device-side fair ordering of the admission batch.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kueue_tpu.core.snapshot import Snapshot
+from kueue_tpu.solver import schema as sch
+from kueue_tpu.solver.fair_share import SHARE_SCALE
+
+_BIG = np.float64(np.inf)
+
+
+@functools.partial(jax.jit, static_argnames=("num_cohorts",))
+def _share_kernel(nominal, lendable, usage, cohort_id, weight,
+                  num_cohorts: int):
+    """[C,F,R] quota/usage -> per-CQ share values (scaled int ratio / weight).
+
+    Returns (share[C] f64, dominant[C] i32).
+    """
+    # Usage above nominal, summed over flavors: [C,R].
+    above = jnp.maximum(usage - nominal, 0).sum(axis=1)
+    # Cohort lendable capacity per resource: [K,R] -> per CQ [C,R].
+    lend_r = lendable.sum(axis=1)
+    cohort_lendable = jax.ops.segment_sum(lend_r, cohort_id,
+                                          num_segments=num_cohorts)
+    cap = cohort_lendable[cohort_id]
+    ratio = jnp.where(cap > 0, (above * SHARE_SCALE) // jnp.maximum(cap, 1), 0)
+    # Zero capacity but positive overage is an infinite share.
+    inf_mask = (cap <= 0) & (above > 0)
+    ratio_f = jnp.where(inf_mask, jnp.inf, ratio.astype(jnp.float64))
+    share = ratio_f.max(axis=1)
+    dominant = jnp.argmax(ratio_f, axis=1).astype(jnp.int32)
+    weighted = jnp.where(
+        share == 0.0, 0.0,
+        jnp.where(weight > 0, share / weight, jnp.inf))
+    return weighted, dominant
+
+
+def share_values(snapshot: Snapshot,
+                 enc: sch.CQEncoding = None) -> Dict[str, Tuple[float, str]]:
+    """Share value + dominant resource for every ClusterQueue."""
+    if enc is None:
+        enc = sch.encode_cluster_queues(snapshot)
+    usage = sch.encode_usage(snapshot, enc)
+    weight = np.array(
+        [snapshot.cluster_queues[n].fair_weight for n in enc.cq_names],
+        dtype=np.float64)
+    share, dominant = jax.device_get(_share_kernel(
+        jnp.asarray(enc.nominal), jnp.asarray(enc.lendable),
+        jnp.asarray(usage.usage), jnp.asarray(enc.cohort_id),
+        jnp.asarray(weight), num_cohorts=enc.num_cohorts))
+    out = {}
+    for i, name in enumerate(enc.cq_names):
+        cq = snapshot.cluster_queues[name]
+        if cq.cohort is None:
+            out[name] = (0.0, "")
+        else:
+            dom = enc.resource_names[int(dominant[i])] if share[i] > 0 else ""
+            out[name] = (float(share[i]), dom)
+    return out

@@ -445,8 +445,10 @@ class BatchSolver:
                  cq.preemption, cq.flavor_fungibility)
                 for name, cq in snapshot.cluster_queues.items())),
             tuple(sorted(snapshot.resource_flavors.items())),
-            # The encoding bakes in gate-dependent quota splits.
+            # The encoding bakes in gate-dependent quota splits and the
+            # fair-sharing preempt-while-borrowing flag.
             features.enabled(features.LENDING_LIMIT),
+            features.enabled(features.FAIR_SHARING),
         )
         if key != self._key:
             self._enc = sch.encode_cluster_queues(snapshot)

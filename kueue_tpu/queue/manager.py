@@ -55,6 +55,10 @@ class PendingClusterQueue:
         self.heap: KeyedHeap[WorkloadInfo] = KeyedHeap(
             key_fn=lambda wi: wi.key, less=self._less)
         self.inadmissible: Dict[str, WorkloadInfo] = {}
+        # Admission-relevant state at park time; the runtime shares Workload
+        # objects, so change detection must compare against a snapshot, not
+        # the (same) object.
+        self._parked_fingerprint: Dict[str, tuple] = {}
         # popCycle / queueInadmissibleCycle race guard
         # (cluster_queue_impl.go:49-57).
         self.pop_cycle = 0
@@ -87,27 +91,41 @@ class PendingClusterQueue:
 
     # -- mutations ----------------------------------------------------------
 
+    @staticmethod
+    def _fingerprint(wi: WorkloadInfo) -> tuple:
+        evicted = wi.obj.find_condition(CONDITION_EVICTED)
+        return (
+            [(ps.name, ps.count, dict(ps.requests)) for ps in wi.obj.pod_sets],
+            dict(wi.obj.reclaimable_pods),
+            (evicted.status, evicted.reason, evicted.last_transition_time)
+            if evicted else None,
+        )
+
+    def _park(self, key: str, wi: WorkloadInfo) -> None:
+        self.inadmissible[key] = wi
+        self._parked_fingerprint[key] = self._fingerprint(wi)
+
+    def _unpark(self, key: str) -> Optional[WorkloadInfo]:
+        self._parked_fingerprint.pop(key, None)
+        return self.inadmissible.pop(key, None)
+
     def push_or_update(self, wi: WorkloadInfo) -> None:
         key = wi.key
-        old = self.inadmissible.get(key)
-        if old is not None:
+        if key in self.inadmissible:
             # Keep parked if nothing admission-relevant changed
             # (cluster_queue_impl.go:113-131).
-            if (old.obj.pod_sets == wi.obj.pod_sets
-                    and old.obj.reclaimable_pods == wi.obj.reclaimable_pods
-                    and old.obj.find_condition(CONDITION_EVICTED)
-                    == wi.obj.find_condition(CONDITION_EVICTED)):
+            if self._parked_fingerprint.get(key) == self._fingerprint(wi):
                 self.inadmissible[key] = wi
                 return
-            del self.inadmissible[key]
+            self._unpark(key)
         if self.heap.get_by_key(key) is None and not self._backoff_expired(wi):
-            self.inadmissible[key] = wi
+            self._park(key, wi)
             return
         self.heap.push_or_update(wi)
 
     def delete(self, wl: Workload) -> None:
         key = wl.key
-        self.inadmissible.pop(key, None)
+        self._unpark(key)
         self.heap.delete(key)
 
     def requeue_if_not_present(self, wi: WorkloadInfo, reason: str) -> bool:
@@ -123,14 +141,14 @@ class PendingClusterQueue:
         if self._backoff_expired(wi) and (
                 immediate or self.queue_inadmissible_cycle >= self.pop_cycle
                 or pending_flavors):
-            parked = self.inadmissible.pop(key, None)
+            parked = self._unpark(key)
             if parked is not None:
                 wi = parked
             return self.heap.push_if_not_present(wi)
 
         if key in self.inadmissible or self.heap.get_by_key(key) is not None:
             return False
-        self.inadmissible[key] = wi
+        self._park(key, wi)
         return True
 
     def queue_inadmissible_workloads(
@@ -139,16 +157,13 @@ class PendingClusterQueue:
         self.queue_inadmissible_cycle = self.pop_cycle
         if not self.inadmissible:
             return False
-        remaining: Dict[str, WorkloadInfo] = {}
         moved = False
-        for key, wi in self.inadmissible.items():
+        for key, wi in list(self.inadmissible.items()):
             labels = ns_labels(wi.obj.namespace)
-            if labels is None or not self.namespace_selector.matches(labels) \
-                    or not self._backoff_expired(wi):
-                remaining[key] = wi
-            else:
+            if labels is not None and self.namespace_selector.matches(labels) \
+                    and self._backoff_expired(wi):
+                self._unpark(key)
                 moved = self.heap.push_if_not_present(wi) or moved
-        self.inadmissible = remaining
         return moved
 
     def pop(self) -> Optional[WorkloadInfo]:
@@ -286,6 +301,22 @@ class Manager:
             if cq is None:
                 return
             self._queue_cohort_inadmissible(cq.cohort, fallback=cq)
+
+    def flush_expired_backoffs(self) -> None:
+        """Move parked workloads whose requeue backoff has expired back to
+        their heaps (the reference does this with per-workload RequeueAfter
+        timers, workload_controller.go:352-356)."""
+        with self._cond:
+            moved = False
+            for cq in self.cluster_queues.values():
+                for key, wi in list(cq.inadmissible.items()):
+                    rs = wi.obj.requeue_state
+                    if rs is not None and rs.requeue_at is not None \
+                            and cq._backoff_expired(wi):
+                        cq._unpark(key)
+                        moved = cq.heap.push_if_not_present(wi) or moved
+            if moved:
+                self._cond.notify_all()
 
     def queue_inadmissible_workloads(self, cq_names) -> None:
         with self._cond:

@@ -11,25 +11,42 @@ from __future__ import annotations
 
 from typing import Dict, List, Optional, Set
 
+from kueue_tpu import features
 from kueue_tpu.api.types import (
     BorrowWithinCohortPolicy,
     CONDITION_EVICTED,
+    FairSharingStrategy,
     PreemptionPolicy,
 )
 from kueue_tpu.core.cache import CachedClusterQueue, FlavorResourceQuantities
 from kueue_tpu.core.snapshot import Snapshot
 from kueue_tpu.core.workload import WorkloadInfo, WorkloadOrdering
+from kueue_tpu.solver.fair_share import dominant_resource_share
 from kueue_tpu.solver.modes import PREEMPT
 from kueue_tpu.solver.referee import Assignment
 
 ResourcesPerFlavor = Dict[str, Set[str]]
 
+DEFAULT_FAIR_STRATEGIES = (
+    FairSharingStrategy.LESS_THAN_OR_EQUAL_TO_FINAL_SHARE,
+    FairSharingStrategy.LESS_THAN_INITIAL_SHARE,
+)
+
 
 def get_targets(wi: WorkloadInfo, assignment: Assignment, snapshot: Snapshot,
-                ordering: WorkloadOrdering, now: float) -> List[WorkloadInfo]:
-    """Workloads to evict so `wi` fits (preemption.go:81-126)."""
+                ordering: WorkloadOrdering, now: float,
+                fair_strategies=DEFAULT_FAIR_STRATEGIES) -> List[WorkloadInfo]:
+    """Workloads to evict so `wi` fits (preemption.go:81-126).
+
+    With the FairSharing gate on and the CQ in a cohort, victim selection is
+    share-based (KEP-1714) instead of the classic priority/reclaim rules.
+    """
     res_per_flv = _resources_requiring_preemption(assignment)
     cq = snapshot.cluster_queues[wi.cluster_queue]
+
+    if features.enabled(features.FAIR_SHARING) and cq.cohort is not None:
+        return _fair_preemptions(wi, assignment, snapshot, res_per_flv,
+                                 ordering, now, fair_strategies)
 
     candidates = _find_candidates(wi, ordering, cq, res_per_flv)
     if not candidates:
@@ -199,6 +216,121 @@ def _minimal_preemptions(wi: WorkloadInfo, assignment: Assignment,
         i -= 1
 
     # Restore the snapshot.
+    for t in targets:
+        snapshot.add_workload(t)
+    return targets
+
+
+def _negated_usage(wi: WorkloadInfo) -> FlavorResourceQuantities:
+    return {f: {r: -v for r, v in res.items()}
+            for f, res in wi.usage().items()}
+
+
+def _fair_preemptions(wi: WorkloadInfo, assignment: Assignment,
+                      snapshot: Snapshot, res_per_flv: ResourcesPerFlavor,
+                      ordering: WorkloadOrdering, now: float,
+                      strategies) -> List[WorkloadInfo]:
+    """Share-based victim search (KEP-1714 "Preemption algorithm").
+
+    Round by round, pick the next victim from the cohort member with the
+    highest share value, admitting it only if the configured strategy holds:
+      * LessThanOrEqualToFinalShare (S2-a): after removing the victim, the
+        offender's share is still >= the preemptor's share with the incoming
+        workload admitted.
+      * LessThanInitialShare (S2-b): the offender's current share strictly
+        exceeds the preemptor's prospective share.
+    Own-CQ victims follow the classic WithinClusterQueue policy. Ends with
+    the same add-back minimization as the classic path.
+    """
+    cq = snapshot.cluster_queues[wi.cluster_queue]
+    wl_req = _total_requests_for_assignment(wi, assignment)
+
+    # Per-CQ candidate queues, best victim first. Cross-CQ candidates still
+    # honor the preemptor's reclaimWithinCohort contract: Never forbids any
+    # cross-queue eviction, LowerPriority restricts victims by priority
+    # (fair-share rules replace only the share comparison, not the
+    # admin-facing policy).
+    per_cq: Dict[str, List[WorkloadInfo]] = {}
+    own = _find_candidates(wi, ordering, cq, res_per_flv)
+    own = [c for c in own if c.cluster_queue == cq.name]
+    if own:
+        own.sort(key=lambda c: _candidate_sort_key(c, cq.name, now))
+        per_cq[cq.name] = own
+    reclaim = cq.preemption.reclaim_within_cohort
+    if reclaim != PreemptionPolicy.NEVER:
+        only_lower = reclaim != PreemptionPolicy.ANY
+        for member in cq.cohort.members:
+            if member is cq:
+                continue
+            cands = [c for c in member.workloads.values()
+                     if _uses_resources(c, res_per_flv)
+                     and not (only_lower and c.obj.priority >= wi.priority)]
+            if cands:
+                cands.sort(key=lambda c: _candidate_sort_key(c, cq.name, now))
+                per_cq[member.name] = cands
+
+    targets: List[WorkloadInfo] = []
+    fits = False
+    while True:
+        if _workload_fits(wl_req, cq, True):
+            fits = True
+            break
+        share_x, _ = dominant_resource_share(cq, wl_req)
+        order = sorted(
+            (name for name, cands in per_cq.items() if cands),
+            key=lambda n: -dominant_resource_share(
+                snapshot.cluster_queues[n])[0])
+        best = None
+        for strategy in strategies:
+            for y_name in order:
+                y = snapshot.cluster_queues[y_name]
+                cands = per_cq[y_name]
+                if y is cq:
+                    # Preempting our own workload always improves our share.
+                    best = (y_name, 0)
+                    break
+                if not _cq_is_borrowing(y, res_per_flv):
+                    continue
+                # Scan the CQ's sorted candidates for the first that
+                # satisfies the strategy (KEP-1714: "checking which of them
+                # matches"), not just the head.
+                for zi, z in enumerate(cands):
+                    if strategy == FairSharingStrategy.LESS_THAN_OR_EQUAL_TO_FINAL_SHARE:
+                        share_y_wo, _ = dominant_resource_share(
+                            y, _negated_usage(z))
+                        ok = share_y_wo >= share_x
+                    else:
+                        share_y, _ = dominant_resource_share(y)
+                        ok = share_y > share_x
+                    if ok:
+                        best = (y_name, zi)
+                        break
+                if best is not None:
+                    break
+            if best is not None:
+                break
+        if best is None:
+            break
+        y_name, zi = best
+        z = per_cq[y_name].pop(zi)
+        snapshot.remove_workload(z)
+        targets.append(z)
+
+    if not fits:
+        for t in targets:
+            snapshot.add_workload(t)
+        return []
+
+    # Add-back minimization, as in the classic path (preemption.go:214-224).
+    i = len(targets) - 2
+    while i >= 0:
+        snapshot.add_workload(targets[i])
+        if _workload_fits(wl_req, cq, True):
+            targets[i] = targets[-1]
+            targets.pop()
+        else:
+            snapshot.remove_workload(targets[i])
+        i -= 1
     for t in targets:
         snapshot.add_workload(t)
     return targets

@@ -30,7 +30,7 @@ from kueue_tpu.core.snapshot import Snapshot
 from kueue_tpu.core.workload import WorkloadInfo, WorkloadOrdering
 from kueue_tpu.queue.manager import Manager, RequeueReason
 from kueue_tpu.scheduler import preemption as preemption_mod
-from kueue_tpu.solver import podset_reducer
+from kueue_tpu.solver import fair_share, podset_reducer
 from kueue_tpu.solver.modes import FIT, NO_FIT, PREEMPT
 from kueue_tpu.solver.referee import Assignment, assign_flavors
 
@@ -49,6 +49,8 @@ class Entry:
     inadmissible_msg: str = ""
     requeue_reason: str = RequeueReason.GENERIC
     preemption_targets: List[WorkloadInfo] = field(default_factory=list)
+    # ClusterQueue share value at nomination time (KEP-1714 fair sharing).
+    share: float = 0.0
 
 
 @dataclass
@@ -68,6 +70,8 @@ class Scheduler:
                  namespace_lister: Optional[Callable[[str], Optional[dict]]] = None,
                  batch_solver=None,
                  ordering: Optional[WorkloadOrdering] = None,
+                 pods_ready_gate: Optional[Callable[[], bool]] = None,
+                 fair_strategies=preemption_mod.DEFAULT_FAIR_STRATEGIES,
                  clock: Callable[[], float] = _time.time):
         self.queues = queues
         self.cache = cache
@@ -76,6 +80,12 @@ class Scheduler:
         self._ns_lister = namespace_lister or (lambda name: {})
         self.batch_solver = batch_solver
         self.ordering = ordering or WorkloadOrdering()
+        # waitForPodsReady.blockAdmission (KEP-349): admission is withheld
+        # while the gate reports not-ready. The reference blocks the loop on
+        # a condvar (cache.go:118-173); this synchronous runtime skips the
+        # cycle's admissions and requeues instead.
+        self.pods_ready_gate = pods_ready_gate
+        self.fair_strategies = tuple(fair_strategies)
         self.clock = clock
         self.metrics = SchedulerMetrics()
 
@@ -137,6 +147,8 @@ class Scheduler:
                 [e.info for e in entries], snapshot)
         else:
             assignments = None
+        fair = features.enabled(features.FAIR_SHARING)
+        shares: Dict[str, float] = {}
         for i, e in enumerate(entries):
             full = assignments[i] if assignments is not None else None
             assignment, targets = self._get_assignment(e.info, snapshot, full)
@@ -144,6 +156,14 @@ class Scheduler:
             e.preemption_targets = targets
             e.inadmissible_msg = assignment.message()
             e.info.last_assignment = assignment.last_state
+            if fair:
+                cq_name = e.info.cluster_queue
+                if cq_name not in shares:
+                    cq = snapshot.cluster_queues.get(cq_name)
+                    shares[cq_name] = (
+                        fair_share.dominant_resource_share(cq)[0]
+                        if cq is not None else 0.0)
+                e.share = shares[cq_name]
 
     def _get_assignment(self, wi: WorkloadInfo, snap: Snapshot,
                         precomputed: Optional[Assignment]):
@@ -157,7 +177,8 @@ class Scheduler:
         targets: List[WorkloadInfo] = []
         if mode == PREEMPT:
             targets = preemption_mod.get_targets(
-                wi, full, snap, self.ordering, self.clock())
+                wi, full, snap, self.ordering, self.clock(),
+                fair_strategies=self.fair_strategies)
         if not features.enabled(features.PARTIAL_ADMISSION) or targets:
             return full, targets
         if wi.obj.can_be_partially_admitted():
@@ -166,7 +187,8 @@ class Scheduler:
                 if assignment.representative_mode == FIT:
                     return (assignment, []), True
                 t = preemption_mod.get_targets(
-                    wi, assignment, snap, self.ordering, self.clock())
+                    wi, assignment, snap, self.ordering, self.clock(),
+                    fair_strategies=self.fair_strategies)
                 if t:
                     return (assignment, t), True
                 return None, False
@@ -181,6 +203,9 @@ class Scheduler:
     def _entry_sort_key(self, e: Entry):
         borrows = e.assignment.borrowing if e.assignment is not None else False
         key = [borrows]
+        if features.enabled(features.FAIR_SHARING):
+            # Lowest current share admits first (KEP-1714).
+            key.append(e.share)
         if features.enabled(features.PRIORITY_SORTING_WITHIN_COHORT):
             key.append(-e.info.obj.priority)
         key.append(self.ordering.queue_order_time(e.info.obj))
@@ -217,6 +242,14 @@ class Scheduler:
                         continue
                 frq_add(cycle_cohorts_usage.setdefault(cohort, {}),
                         _resources_to_reserve(e, cq))
+            if mode == FIT and self.pods_ready_gate is not None \
+                    and not self.pods_ready_gate():
+                # Admission blocked until all admitted workloads are ready
+                # (scheduler.go:256-266).
+                e.status = SKIPPED
+                e.inadmissible_msg = ("Waiting for all admitted workloads to "
+                                      "be in the PodsReady condition")
+                continue
             if mode != FIT:
                 if e.preemption_targets:
                     # Next attempt should try all flavors (scheduler.go:240).
@@ -266,6 +299,10 @@ class Scheduler:
         wl.admission = admission
         wl.set_condition("QuotaReserved", True, reason="QuotaReserved",
                          now=self.clock())
+        if wl.is_evicted:
+            # A readmitted workload is no longer evicted.
+            wl.set_condition("Evicted", False, reason="QuotaReserved",
+                             now=self.clock())
         if not cq.admission_checks:
             wl.set_condition("Admitted", True, reason="Admitted", now=self.clock())
         try:

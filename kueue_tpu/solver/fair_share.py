@@ -1,0 +1,70 @@
+"""Weighted dominant-resource share values (KEP-1714, implemented natively).
+
+The share value of a ClusterQueue is a DRF variant: for each resource, total
+usage above nominal quota (summed across flavors) divided by the cohort's
+lendable capacity for that resource; the share is the maximum of these
+ratios, divided by the CQ's fair-sharing weight
+(keps/1714-fair-sharing/README.md "Share value function and weights").
+
+Scaled to integer parts-per-1024 so comparisons are exact and the batched
+device model (`kueue_tpu.models.fair_share`) produces identical values.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, Optional, Tuple
+
+from kueue_tpu.core.cache import CachedClusterQueue, FlavorResourceQuantities
+
+SHARE_SCALE = 1024
+INFINITE_SHARE = math.inf
+
+
+def dominant_resource_share(cq: CachedClusterQueue,
+                            delta: Optional[FlavorResourceQuantities] = None,
+                            ) -> Tuple[float, str]:
+    """Share value of `cq` (optionally as-if `delta` usage were added).
+
+    Returns (value, dominant_resource). 0 when the CQ borrows nothing or has
+    no cohort; infinite when it borrows with weight 0.
+    """
+    if cq.cohort is None:
+        return 0.0, ""
+
+    # Usage above nominal per resource, summed across flavors.
+    above: Dict[str, int] = {}
+    for rg in cq.resource_groups:
+        for fq in rg.flavors:
+            fusage = cq.usage.get(fq.name, {})
+            for rname, quota in fq.resources:
+                used = fusage.get(rname, 0)
+                if delta is not None:
+                    used += delta.get(fq.name, {}).get(rname, 0)
+                if used > quota.nominal:
+                    above[rname] = above.get(rname, 0) + used - quota.nominal
+
+    # Lendable capacity per resource across the cohort.
+    lendable: Dict[str, int] = {}
+    for fname, resources in cq.cohort.requestable_resources.items():
+        for rname, val in resources.items():
+            lendable[rname] = lendable.get(rname, 0) + val
+
+    share = 0.0
+    dominant = ""
+    for rname, t in above.items():
+        cap = lendable.get(rname, 0)
+        if cap <= 0:
+            if t > 0:
+                share = INFINITE_SHARE
+                dominant = rname
+            continue
+        ratio = (t * SHARE_SCALE) // cap
+        if ratio > share:
+            share = float(ratio)
+            dominant = rname
+    if share == 0.0:
+        return 0.0, dominant
+    if cq.fair_weight <= 0:
+        return INFINITE_SHARE, dominant
+    return share / cq.fair_weight, dominant

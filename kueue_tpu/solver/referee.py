@@ -279,8 +279,11 @@ def _fits_resource_quota(cq: CachedClusterQueue, flavor: str, resource: str,
         cohort_available = cq.requestable_cohort_quota(flavor, resource)
 
     bwc = cq.preemption.borrow_within_cohort
-    if bwc is not None and bwc.policy != BorrowWithinCohortPolicy.NEVER:
-        # Preemption-with-borrowing can admit beyond nominal quota.
+    if (bwc is not None and bwc.policy != BorrowWithinCohortPolicy.NEVER) \
+            or features.enabled(features.FAIR_SHARING):
+        # Preemption-with-borrowing can admit beyond nominal quota; fair
+        # sharing (KEP-1714) implies it globally, since share-based
+        # preemption targets borrowers to make room for borrowing requests.
         if (borrowing_limit is None or val <= nominal + borrowing_limit) \
                 and val <= cohort_available:
             mode = PREEMPT

@@ -166,8 +166,11 @@ def encode_cluster_queues(snapshot: Snapshot) -> CQEncoding:
         cohort_id[ci] = cohort_idx[cohort]
 
         bwc = cq.preemption.borrow_within_cohort
-        bwc_enabled[ci] = (bwc is not None
-                           and bwc.policy != BorrowWithinCohortPolicy.NEVER)
+        # Fair sharing implies preempt-while-borrowing (see referee
+        # _fits_resource_quota).
+        bwc_enabled[ci] = (
+            (bwc is not None and bwc.policy != BorrowWithinCohortPolicy.NEVER)
+            or features.enabled(features.FAIR_SHARING))
         borrow_is_borrow[ci] = (cq.flavor_fungibility.when_can_borrow
                                 == FlavorFungibilityPolicy.BORROW)
         preempt_is_preempt[ci] = (cq.flavor_fungibility.when_can_preempt

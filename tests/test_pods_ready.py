@@ -1,0 +1,117 @@
+"""waitForPodsReady lifecycle: admission gating, timeout eviction with
+exponential backoff, deactivation (KEP-349; workload_controller.go:342-406)."""
+
+from kueue_tpu.config import (
+    Configuration,
+    RequeuingStrategy,
+    WaitForPodsReady,
+    requeue_backoff_seconds,
+)
+from kueue_tpu.controllers.runtime import Framework
+
+from tests.util import fq, make_cq, make_flavor, make_lq, make_wl, rg
+
+
+class FakeClock:
+    def __init__(self):
+        self.now = 1000.0
+
+    def __call__(self):
+        return self.now
+
+
+def ready_framework(block_admission=True, backoff_limit=None, timeout=300.0):
+    clock = FakeClock()
+    fw = Framework(
+        config=Configuration(wait_for_pods_ready=WaitForPodsReady(
+            enable=True, timeout_seconds=timeout,
+            block_admission=block_admission,
+            requeuing_strategy=RequeuingStrategy(
+                backoff_limit_count=backoff_limit))),
+        clock=clock)
+    fw.create_resource_flavor(make_flavor("default"))
+    fw.create_cluster_queue(make_cq("cq", rg("cpu", fq("default", cpu=8))))
+    fw.create_local_queue(make_lq("main", cq="cq"))
+    return fw, clock
+
+
+def test_block_admission_until_pods_ready():
+    fw, clock = ready_framework()
+    w0 = make_wl("w0", cpu=2, creation_time=1.0)
+    fw.submit(w0)
+    fw.run_until_settled()
+    assert fw.admitted_workloads("cq") == ["default/w0"]
+    # Second workload is gated: w0's pods are not ready yet.
+    fw.submit(make_wl("w1", cpu=2, creation_time=2.0))
+    fw.run_until_settled()
+    assert fw.admitted_workloads("cq") == ["default/w0"]
+    # Pods come up: the gate opens.
+    fw.mark_pods_ready(w0)
+    fw.run_until_settled()
+    assert len(fw.admitted_workloads("cq")) == 2
+
+
+def test_timeout_evicts_with_backoff():
+    fw, clock = ready_framework(timeout=300.0)
+    w0 = make_wl("w0", cpu=2, creation_time=1.0)
+    fw.submit(w0)
+    fw.run_until_settled()
+    assert w0.is_admitted
+    # Time passes beyond the timeout without the pods becoming ready.
+    clock.now += 301.0
+    fw.reconcile()
+    fw.reconcile()
+    assert w0.is_evicted
+    assert w0.find_condition("Evicted").reason == "PodsReadyTimeout"
+    assert w0.requeue_state.count == 1
+    assert w0.requeue_state.requeue_at == clock.now + requeue_backoff_seconds(1)
+    assert not w0.has_quota_reservation
+    # The requeue respects the backoff: nothing admitted before requeue_at.
+    fw.run_until_settled()
+    assert fw.admitted_workloads("cq") == []
+    # After the backoff expires, the framework readmits on its own.
+    clock.now += 2.0
+    fw.run_until_settled()
+    assert fw.admitted_workloads("cq") == ["default/w0"]
+    assert not w0.is_evicted
+
+
+def test_deactivation_after_backoff_limit():
+    fw, clock = ready_framework(timeout=10.0, backoff_limit=1)
+    w0 = make_wl("w0", cpu=2, creation_time=1.0)
+    fw.submit(w0)
+    fw.run_until_settled()
+    # First timeout: backoff requeue (count=1).
+    clock.now += 11.0
+    fw.reconcile()
+    assert w0.requeue_state.count == 1
+    assert w0.active
+    # Readmit after backoff.
+    clock.now += 5.0
+    fw.run_until_settled()
+    assert w0.is_admitted
+    # Second timeout exceeds backoffLimitCount=1: deactivated.
+    clock.now += 11.0
+    fw.reconcile()
+    assert not w0.active
+    assert w0.find_condition("Evicted").reason == "InactiveWorkload"
+    # Deactivated workloads never requeue.
+    fw.run_until_settled()
+    assert fw.admitted_workloads("cq") == []
+    assert fw.pending_workloads("cq") == 0
+
+
+def test_backoff_formula():
+    assert requeue_backoff_seconds(1) == 1.0
+    assert abs(requeue_backoff_seconds(2) - 1.41284738) < 1e-9
+    assert abs(requeue_backoff_seconds(3) - 1.41284738**2) < 1e-9
+
+
+def test_priority_class_resolution():
+    from kueue_tpu.api.types import WorkloadPriorityClass
+    fw, clock = ready_framework()
+    fw.create_workload_priority_class(WorkloadPriorityClass("high", 100))
+    wl = make_wl("w", cpu=1)
+    wl.priority_class = "high"
+    fw.submit(wl)
+    assert wl.priority == 100
