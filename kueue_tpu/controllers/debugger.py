@@ -1,0 +1,48 @@
+"""State dumper (counterpart of reference pkg/debugger/debugger.go:41-64).
+
+Dumps the full admitted-state cache and the pending queues as a plain dict
+(JSON-serializable); optionally registered on SIGUSR2 like the reference.
+"""
+
+from __future__ import annotations
+
+import json
+import signal
+import sys
+from typing import Dict
+
+from kueue_tpu.core.cache import Cache
+from kueue_tpu.queue.manager import Manager
+
+
+class Dumper:
+    def __init__(self, cache: Cache, queues: Manager):
+        self.cache = cache
+        self.queues = queues
+
+    def dump(self) -> Dict:
+        cache_dump = {}
+        for name, cq in self.cache.cluster_queues.items():
+            cache_dump[name] = {
+                "cohort": cq.cohort_name,
+                "usage": {f: dict(r) for f, r in cq.usage.items()},
+                "admittedWorkloads": sorted(cq.workloads),
+                "allocatableGeneration": cq.allocatable_generation,
+                "active": cq.active(),
+            }
+        queue_dump = {}
+        for name, cq in self.queues.cluster_queues.items():
+            queue_dump[name] = {
+                "active": [wi.key for wi in cq.heap.items()],
+                "inadmissible": sorted(cq.inadmissible),
+                "popCycle": cq.pop_cycle,
+            }
+        return {"cache": cache_dump, "queues": queue_dump}
+
+    def dump_json(self) -> str:
+        return json.dumps(self.dump(), indent=2, sort_keys=True)
+
+    def listen_for_signal(self) -> None:
+        """SIGUSR2 -> dump to stderr (debugger.go ListenForSignal)."""
+        signal.signal(signal.SIGUSR2,
+                      lambda *_: print(self.dump_json(), file=sys.stderr))

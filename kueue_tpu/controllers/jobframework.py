@@ -1,0 +1,222 @@
+"""Job-integration framework: the generic job <-> Workload sync engine.
+
+Counterpart of reference pkg/controller/jobframework/: a `GenericJob`
+protocol (interface.go:32-114), an integration registry keyed by job type
+(integrationmanager.go:44-95), and the reconciler state machine
+(reconciler.go:159-440) that creates Workloads from job pod sets, starts
+jobs on admission (injecting the assigned flavors' node selectors and
+tolerations, pkg/podset), stops them on eviction (restoring templates), and
+propagates Finished / PodsReady / reclaimable-pod updates.
+
+Jobs here are host-side orchestration objects (a TPU training run, a batch
+process); "running" means the framework invoked the job's `run` hook with
+the admitted placement info.
+"""
+
+from __future__ import annotations
+
+import abc
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence, Tuple, Type
+
+from kueue_tpu.api.types import (
+    PodSet,
+    PodSetAssignment,
+    ResourceFlavor,
+    Workload,
+)
+
+
+@dataclass
+class PodSetInfo:
+    """Placement info merged into a pod template at start and restored at
+    stop (reference: pkg/podset/podset.go:50-165)."""
+
+    name: str
+    count: int
+    node_selector: Dict[str, str] = field(default_factory=dict)
+    labels: Dict[str, str] = field(default_factory=dict)
+    annotations: Dict[str, str] = field(default_factory=dict)
+    tolerations: List = field(default_factory=list)
+
+
+def podset_infos_from_admission(
+        wl: Workload, flavors: Dict[str, ResourceFlavor]) -> List[PodSetInfo]:
+    """Build per-PodSet placement info from the admission's flavor
+    assignment (reference: jobframework/reconciler.go startJob ->
+    getPodSetsInfoFromStatus)."""
+    infos: List[PodSetInfo] = []
+    for psa in wl.admission.pod_set_assignments:
+        info = PodSetInfo(name=psa.name, count=psa.count)
+        for flavor_name in psa.flavors.values():
+            flavor = flavors.get(flavor_name)
+            if flavor is None:
+                continue
+            info.node_selector.update(flavor.labels_dict)
+            info.tolerations.extend(flavor.tolerations)
+        infos.append(info)
+    return infos
+
+
+class GenericJob(abc.ABC):
+    """The integration contract (reference: jobframework/interface.go:32-55)."""
+
+    @property
+    @abc.abstractmethod
+    def name(self) -> str: ...
+
+    @property
+    def namespace(self) -> str:
+        return "default"
+
+    @property
+    @abc.abstractmethod
+    def queue_name(self) -> str:
+        """The LocalQueue this job targets (kueue.x-k8s.io/queue-name)."""
+
+    @abc.abstractmethod
+    def is_suspended(self) -> bool: ...
+
+    @abc.abstractmethod
+    def suspend(self) -> None: ...
+
+    @abc.abstractmethod
+    def run(self, podset_infos: Sequence[PodSetInfo]) -> None:
+        """Unsuspend with the admitted placement."""
+
+    @abc.abstractmethod
+    def restore(self, podset_infos: Sequence[PodSetInfo]) -> None:
+        """Undo placement info on stop."""
+
+    @abc.abstractmethod
+    def pod_sets(self) -> List[PodSet]: ...
+
+    @abc.abstractmethod
+    def finished(self) -> Tuple[bool, bool]:
+        """(finished, success)."""
+
+    def pods_ready(self) -> bool:
+        return False
+
+    def is_active(self) -> bool:
+        """Any pods still running (drives stopJob)."""
+        return not self.is_suspended()
+
+    # Optional capabilities (interface.go:56-114).
+
+    def reclaimable_pods(self) -> Dict[str, int]:
+        return {}
+
+    def priority_class(self) -> str:
+        return ""
+
+    def priority(self) -> int:
+        return 0
+
+
+# -- integration registry (integrationmanager.go) ---------------------------
+
+_INTEGRATIONS: Dict[str, Type[GenericJob]] = {}
+
+
+def register_integration(kind: str):
+    def wrap(cls: Type[GenericJob]):
+        if kind in _INTEGRATIONS:
+            raise ValueError(f"integration {kind} already registered")
+        _INTEGRATIONS[kind] = cls
+        cls.kind = kind
+        return cls
+    return wrap
+
+
+def integrations() -> Dict[str, Type[GenericJob]]:
+    return dict(_INTEGRATIONS)
+
+
+class JobReconciler:
+    """The job <-> workload state machine (reconciler.go:159-440).
+
+    Driven by the runtime after every scheduling tick and on job events.
+    """
+
+    def __init__(self, framework):
+        self.fw = framework
+        # job key -> (job, workload key)
+        self.jobs: Dict[str, Tuple[GenericJob, str]] = {}
+
+    @staticmethod
+    def job_key(job: GenericJob) -> str:
+        return f"{job.namespace}/{job.name}"
+
+    def submit(self, job: GenericJob) -> Workload:
+        """Admit a job into the queueing system: default-suspend it and
+        create its Workload (reconciler.go handleJobWithNoWorkload)."""
+        if not job.is_suspended():
+            job.suspend()
+        wl = Workload(
+            name=f"job-{job.name}",
+            namespace=job.namespace,
+            queue_name=job.queue_name,
+            pod_sets=list(job.pod_sets()),
+            priority=job.priority(),
+            priority_class=job.priority_class(),
+        )
+        self.jobs[self.job_key(job)] = (job, wl.key)
+        self.fw.submit(wl)
+        return wl
+
+    def delete(self, job: GenericJob) -> None:
+        entry = self.jobs.pop(self.job_key(job), None)
+        if entry is None:
+            return
+        wl = self.fw.workloads.get(entry[1])
+        if wl is not None:
+            self.fw.delete_workload(wl)
+
+    def reconcile(self) -> None:
+        """One pass of the job state machine over all tracked jobs."""
+        for job, wl_key in list(self.jobs.values()):
+            wl = self.fw.workloads.get(wl_key)
+            if wl is None:
+                continue
+
+            # 1. Propagate Finished (reconciler.go step 2).
+            done, success = job.finished()
+            if done and not wl.is_finished:
+                self.fw.finish(wl)
+                continue
+            if wl.is_finished:
+                continue
+
+            # 2. Sync reclaimable pods (step 4; KEP-78 dynamic reclaim).
+            reclaimable = job.reclaimable_pods()
+            if reclaimable and reclaimable != wl.reclaimable_pods:
+                self.fw.update_reclaimable_pods(wl, reclaimable)
+
+            # 3. PodsReady condition from the job (step 5).
+            if job.pods_ready() and not wl.condition_true("PodsReady"):
+                self.fw.mark_pods_ready(wl)
+
+            # 4. Evicted -> stop the job (step 6).
+            if wl.is_evicted and not job.is_suspended():
+                self._stop_job(job, wl)
+                continue
+
+            # 5. Admitted -> start the job (step 7).
+            if wl.is_admitted and job.is_suspended():
+                infos = podset_infos_from_admission(
+                    wl, self.fw.cache.resource_flavors)
+                job.run(infos)
+
+            # 6. Job unsuspended without admission -> hold it (step 8).
+            if not job.is_suspended() and not wl.is_admitted \
+                    and not wl.has_quota_reservation:
+                self._stop_job(job, wl)
+
+    def _stop_job(self, job: GenericJob, wl: Workload) -> None:
+        infos = []
+        if wl.admission is not None:
+            infos = podset_infos_from_admission(
+                wl, self.fw.cache.resource_flavors)
+        job.suspend()
+        job.restore(infos)

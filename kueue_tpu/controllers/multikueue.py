@@ -1,0 +1,195 @@
+"""MultiKueue: multi-cluster workload dispatch.
+
+Counterpart of reference pkg/controller/admissionchecks/multikueue/: for a
+workload that reserved quota locally and whose ClusterQueue carries a
+MultiKueue AdmissionCheck, mirror the workload onto every worker cluster
+(multikueue/workload.go:56-300), keep the first cluster that reserves
+quota, delete the mirror from the rest, sync remote Finished back, and
+garbage-collect orphans. Worker loss is handled with reconnect accounting
+and a workerLostTimeout before requeueing
+(multikueuecluster.go:64-188, config defaults.go:49).
+
+The remote boundary is the `RemoteClient` protocol; `InProcessRemote` wraps
+another Framework instance (the envtest-style two-cluster simulation used
+by the reference's integration tests), while a production deployment can
+implement it over gRPC.
+"""
+
+from __future__ import annotations
+
+import abc
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from kueue_tpu.api.types import AdmissionCheckState, Workload
+
+MULTIKUEUE_CHECK_CONTROLLER = "kueue.x-k8s.io/multikueue"
+DEFAULT_WORKER_LOST_TIMEOUT = 15 * 60.0
+
+
+class RemoteClient(abc.ABC):
+    """A connection to one worker cluster."""
+
+    @abc.abstractmethod
+    def connected(self) -> bool: ...
+
+    @abc.abstractmethod
+    def create_workload(self, wl: Workload) -> None: ...
+
+    @abc.abstractmethod
+    def delete_workload(self, key: str) -> None: ...
+
+    @abc.abstractmethod
+    def get_status(self, key: str) -> Optional[dict]:
+        """{'quota_reserved': bool, 'admitted': bool, 'finished': bool,
+        'success': bool} or None if absent."""
+
+
+class InProcessRemote(RemoteClient):
+    """A worker cluster hosted by another Framework instance in-process."""
+
+    def __init__(self, framework, queue_name: str = "main"):
+        self.fw = framework
+        self.queue_name = queue_name
+        self._up = True
+
+    def set_connected(self, up: bool) -> None:
+        self._up = up
+
+    def connected(self) -> bool:
+        return self._up
+
+    def create_workload(self, wl: Workload) -> None:
+        import copy
+        remote = Workload(
+            name=wl.name, namespace=wl.namespace, queue_name=self.queue_name,
+            pod_sets=copy.deepcopy(wl.pod_sets), priority=wl.priority,
+            creation_time=wl.creation_time)
+        self.fw.submit(remote)
+
+    def delete_workload(self, key: str) -> None:
+        wl = self.fw.workloads.get(key)
+        if wl is not None:
+            self.fw.delete_workload(wl)
+
+    def get_status(self, key: str) -> Optional[dict]:
+        wl = self.fw.workloads.get(key)
+        if wl is None:
+            return None
+        return {
+            "quota_reserved": wl.has_quota_reservation,
+            "admitted": wl.is_admitted,
+            "finished": wl.is_finished,
+            "success": wl.is_finished,
+        }
+
+
+@dataclass
+class _Dispatch:
+    created_on: List[str] = field(default_factory=list)
+    kept_on: Optional[str] = None
+    lost_since: Optional[float] = None
+
+
+class MultiKueueController:
+    """Drives MultiKueue-type AdmissionChecks against worker clusters."""
+
+    def __init__(self, framework, check_name: str = "multikueue",
+                 worker_lost_timeout: float = DEFAULT_WORKER_LOST_TIMEOUT):
+        self.fw = framework
+        self.check_name = check_name
+        self.clusters: Dict[str, RemoteClient] = {}
+        self.worker_lost_timeout = worker_lost_timeout
+        self._dispatches: Dict[str, _Dispatch] = {}
+
+    def add_cluster(self, name: str, client: RemoteClient) -> None:
+        self.clusters[name] = client
+
+    def remove_cluster(self, name: str) -> None:
+        self.clusters.pop(name, None)
+
+    def reconcile(self) -> None:
+        now = self.fw.clock()
+        for wl in list(self.fw.workloads.values()):
+            cq = self.fw.cache.cluster_queues.get(
+                wl.admission.cluster_queue if wl.admission else "")
+            if cq is None or self.check_name not in cq.admission_checks:
+                continue
+            if wl.is_finished:
+                self._gc(wl.key)
+                continue
+            if not wl.has_quota_reservation:
+                continue
+            self._reconcile_workload(wl, now)
+        # GC dispatches whose local workload disappeared
+        # (multikueuecluster.go:476-500).
+        for key in list(self._dispatches):
+            if key not in self.fw.workloads:
+                self._gc(key)
+
+    def _reconcile_workload(self, wl: Workload, now: float) -> None:
+        d = self._dispatches.setdefault(wl.key, _Dispatch())
+
+        # Create the mirror on every connected worker (workload.go:232-300).
+        if d.kept_on is None:
+            for name, client in self.clusters.items():
+                if name not in d.created_on and client.connected():
+                    client.create_workload(wl)
+                    d.created_on.append(name)
+            if not wl.admission_check_states.get(self.check_name):
+                wl.admission_check_states[self.check_name] = \
+                    AdmissionCheckState(name=self.check_name, state="Pending",
+                                        message="dispatched to workers")
+
+        # First worker to reserve quota wins (workload.go:94-148).
+        statuses = {}
+        for name in list(d.created_on):
+            client = self.clusters.get(name)
+            if client is None or not client.connected():
+                continue
+            statuses[name] = client.get_status(wl.key)
+
+        if d.kept_on is None:
+            winner = next((n for n, s in statuses.items()
+                           if s and s["quota_reserved"]), None)
+            if winner is not None:
+                d.kept_on = winner
+                for name in d.created_on:
+                    if name != winner:
+                        client = self.clusters.get(name)
+                        if client is not None and client.connected():
+                            client.delete_workload(wl.key)
+                d.created_on = [winner]
+                wl.admission_check_states[self.check_name] = \
+                    AdmissionCheckState(
+                        name=self.check_name, state="Ready",
+                        message=f'The workload got reservation on "{winner}"')
+            return
+
+        # Kept worker: watch status (remote watch analog).
+        status = statuses.get(d.kept_on)
+        client = self.clusters.get(d.kept_on)
+        if client is None or not client.connected() or status is None:
+            # Worker lost: wait out the timeout, then retry the whole
+            # dispatch (multikueuecluster.go workerLostTimeout).
+            if d.lost_since is None:
+                d.lost_since = now
+            elif now - d.lost_since >= self.worker_lost_timeout:
+                self._dispatches[wl.key] = _Dispatch()
+                wl.admission_check_states[self.check_name] = \
+                    AdmissionCheckState(name=self.check_name, state="Retry",
+                                        message="Reserving remote lost")
+            return
+        d.lost_since = None
+        if status["finished"]:
+            self.fw.finish(wl)
+            self._gc(wl.key)
+
+    def _gc(self, key: str) -> None:
+        d = self._dispatches.pop(key, None)
+        if d is None:
+            return
+        for name in d.created_on:
+            client = self.clusters.get(name)
+            if client is not None and client.connected():
+                client.delete_workload(key)

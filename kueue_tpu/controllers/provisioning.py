@@ -1,0 +1,126 @@
+"""Provisioning admission-check controller.
+
+Counterpart of reference pkg/controller/admissionchecks/provisioning/: for
+every workload with QuotaReserved whose ClusterQueue carries a provisioning
+AdmissionCheck, create a ProvisioningRequest against a capacity provider
+(the cluster-autoscaler analog -- here a pluggable callback that brings up
+TPU slices/nodepools), track its outcome with bounded retries
+(controller.go:793+), flip the check state, and inject the provisioned
+placement into the workload's PodSetUpdates (controller.go:549-560).
+"""
+
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+from kueue_tpu.api.types import AdmissionCheckState, Workload
+
+PROVISIONING_CHECK_CONTROLLER = "kueue.x-k8s.io/provisioning-request"
+
+
+@dataclass
+class ProvisioningRequestConfig:
+    """reference: apis/kueue/v1beta1/provisioningrequestconfig_types.go:25-58."""
+
+    name: str
+    provisioning_class: str = "queued-provisioning.gke.io"
+    parameters: Dict[str, str] = field(default_factory=dict)
+    max_retries: int = 3
+
+
+@dataclass
+class ProvisioningRequest:
+    name: str
+    workload_key: str
+    provisioning_class: str
+    parameters: Dict[str, str]
+    pod_sets: List[dict]
+    state: str = "Pending"  # Pending | Provisioned | Failed
+    attempt: int = 1
+    node_selector: Dict[str, str] = field(default_factory=dict)
+
+
+class ProvisioningController:
+    """Drives check states for provisioning-type AdmissionChecks."""
+
+    def __init__(self, framework,
+                 provider: Optional[Callable[[ProvisioningRequest], None]] = None):
+        self.fw = framework
+        # The capacity provider observes requests and flips their state
+        # (cluster-autoscaler analog). Default provider provisions
+        # instantly.
+        self.provider = provider or self._instant_provider
+        self.configs: Dict[str, ProvisioningRequestConfig] = {}
+        # check name -> config name
+        self.checks: Dict[str, str] = {}
+        self.requests: Dict[str, ProvisioningRequest] = {}
+        self._seq = itertools.count(1)
+
+    @staticmethod
+    def _instant_provider(req: ProvisioningRequest) -> None:
+        req.state = "Provisioned"
+
+    def register_check(self, check_name: str,
+                       config: ProvisioningRequestConfig) -> None:
+        self.configs[config.name] = config
+        self.checks[check_name] = config.name
+
+    def reconcile(self) -> None:
+        for wl in list(self.fw.workloads.values()):
+            if not wl.has_quota_reservation or wl.is_finished or wl.is_evicted:
+                continue
+            cq = self.fw.cache.cluster_queues.get(
+                wl.admission.cluster_queue if wl.admission else "")
+            if cq is None:
+                continue
+            for check_name in cq.admission_checks:
+                if check_name not in self.checks:
+                    continue
+                self._reconcile_check(wl, check_name)
+
+    def _reconcile_check(self, wl: Workload, check_name: str) -> None:
+        config = self.configs[self.checks[check_name]]
+        state = wl.admission_check_states.get(check_name)
+        if state is not None and state.state in ("Ready", "Rejected"):
+            return
+        key = f"{wl.key}/{check_name}"
+        req = self.requests.get(key)
+        if req is None:
+            req = ProvisioningRequest(
+                name=f"prov-{next(self._seq):06d}",
+                workload_key=wl.key,
+                provisioning_class=config.provisioning_class,
+                parameters=dict(config.parameters),
+                pod_sets=[{"name": psa.name, "count": psa.count,
+                           "requests": dict(psa.resource_usage)}
+                          for psa in wl.admission.pod_set_assignments],
+            )
+            self.requests[key] = req
+            wl.admission_check_states[check_name] = AdmissionCheckState(
+                name=check_name, state="Pending",
+                message=f"Created ProvisioningRequest {req.name}")
+        self.provider(req)
+        if req.state == "Provisioned":
+            updates = [{"name": ps["name"],
+                        "nodeSelector": dict(req.node_selector)}
+                       for ps in req.pod_sets]
+            wl.admission_check_states[check_name] = AdmissionCheckState(
+                name=check_name, state="Ready",
+                message=f"ProvisioningRequest {req.name} provisioned",
+                pod_set_updates=updates)
+        elif req.state == "Failed":
+            if req.attempt >= config.max_retries:
+                wl.admission_check_states[check_name] = AdmissionCheckState(
+                    name=check_name, state="Rejected",
+                    message=f"ProvisioningRequest {req.name} failed "
+                            f"after {req.attempt} attempts")
+            else:
+                # Retry with a fresh request (controller.go backoff+retry).
+                req.attempt += 1
+                req.state = "Pending"
+                wl.admission_check_states[check_name] = AdmissionCheckState(
+                    name=check_name, state="Retry",
+                    message=f"ProvisioningRequest {req.name} failed; "
+                            f"attempt {req.attempt}")

@@ -36,6 +36,7 @@ from kueue_tpu.api.types import (
     WorkloadPriorityClass,
 )
 from kueue_tpu.config import Configuration, requeue_backoff_seconds
+from kueue_tpu.metrics import REGISTRY
 from kueue_tpu.core.cache import Cache
 from kueue_tpu.core.workload import WorkloadInfo, WorkloadOrdering
 from kueue_tpu.queue.manager import Manager, RequeueReason
@@ -85,6 +86,8 @@ class Framework:
             fair_strategies=fair_strategies,
             clock=clock)
         self._evicted_dirty: List[Workload] = []
+        from kueue_tpu.controllers.jobframework import JobReconciler
+        self.job_reconciler = JobReconciler(self)
 
     # -- admin objects -------------------------------------------------------
 
@@ -111,9 +114,18 @@ class Framework:
         self.cache.update_cluster_queue(spec)
         self.queues.update_cluster_queue(spec)
 
+    def delete_cluster_queue(self, name: str) -> None:
+        self.cache.delete_cluster_queue(name)
+        self.queues.delete_cluster_queue(name)
+        self.update_metrics_gauges()
+
     def create_local_queue(self, lq: LocalQueue) -> None:
         self.cache.add_local_queue(lq)
         self.queues.add_local_queue(lq, pending=list(self.workloads.values()))
+
+    def delete_local_queue(self, lq: LocalQueue) -> None:
+        self.cache.delete_local_queue(lq)
+        self.queues.delete_local_queue(lq)
 
     def create_workload_priority_class(self, pc: WorkloadPriorityClass) -> None:
         self.priority_classes[pc.name] = pc
@@ -128,6 +140,25 @@ class Framework:
             wl.priority = self.priority_classes[wl.priority_class].value
         self.workloads[wl.key] = wl
         self.queues.add_or_update_workload(wl)
+
+    def submit_job(self, job) -> Workload:
+        """Run a GenericJob through the queueing system (jobframework)."""
+        return self.job_reconciler.submit(job)
+
+    def update_reclaimable_pods(self, wl: Workload,
+                                reclaimable: Dict[str, int]) -> None:
+        """Shrink a workload's held quota as pods complete (KEP-78;
+        core/workload_controller.go reclaimable handling)."""
+        was_admitted = self.cache.is_assumed_or_admitted(wl)
+        if was_admitted:
+            self.cache.delete_workload(wl)
+        wl.reclaimable_pods = dict(reclaimable)
+        if wl.admission is not None and was_admitted:
+            self.cache.add_or_update_workload(wl)
+            # Freed quota may unblock cohort members.
+            self.queues.queue_associated_inadmissible_workloads(wl)
+        else:
+            self.queues.add_or_update_workload(wl)
 
     def mark_pods_ready(self, wl: Workload, ready: bool = True) -> None:
         """The job integration reports pod readiness (KEP-349)."""
@@ -179,7 +210,55 @@ class Framework:
     def _apply_preemption(self, wl: Workload, message: str) -> None:
         wl.set_condition(CONDITION_EVICTED, True, reason="Preempted",
                          message=message, now=self.clock())
+        if wl.admission is not None:
+            REGISTRY.preempted_workloads_total.inc(wl.admission.cluster_queue)
+        self._count_eviction(wl, "Preempted")
         self._evicted_dirty.append(wl)
+
+    def _count_eviction(self, wl: Workload, reason: str) -> None:
+        cq = wl.admission.cluster_queue if wl.admission is not None else ""
+        REGISTRY.evicted_workloads_total.inc(cq, reason)
+
+    def update_metrics_gauges(self) -> None:
+        """Refresh per-CQ gauges (reported by the CQ reconciler in the
+        reference, clusterqueue_controller.go); stale series for deleted
+        objects are pruned (metrics.ClearClusterQueueMetrics analog)."""
+        live = set(self.queues.cluster_queues) | set(self.cache.cluster_queues)
+        for gauge in (REGISTRY.pending_workloads,
+                      REGISTRY.reserving_active_workloads,
+                      REGISTRY.admitted_active_workloads,
+                      REGISTRY.cluster_queue_status,
+                      REGISTRY.cluster_queue_resource_usage,
+                      REGISTRY.cluster_queue_fair_share):
+            gauge.prune(lambda key: key and key[0] in live)
+        for name, cq in self.cache.cluster_queues.items():
+            live_fr = {(name, f, r) for f, res in cq.usage.items() for r in res}
+            REGISTRY.cluster_queue_resource_usage.prune(
+                lambda key: key[0] != name or key in live_fr)
+        for name, pending_cq in self.queues.cluster_queues.items():
+            REGISTRY.pending_workloads.set(
+                name, "active", value=pending_cq.pending_active)
+            REGISTRY.pending_workloads.set(
+                name, "inadmissible", value=pending_cq.pending_inadmissible)
+        for name, cq in self.cache.cluster_queues.items():
+            reserving = len(cq.workloads)
+            admitted = sum(
+                1 for wi in cq.workloads.values()
+                if (self.workloads.get(wi.key) or wi.obj).is_admitted)
+            REGISTRY.reserving_active_workloads.set(name, value=reserving)
+            REGISTRY.admitted_active_workloads.set(name, value=admitted)
+            REGISTRY.cluster_queue_status.set(
+                name, "active", value=1.0 if cq.active() else 0.0)
+            for fname, resources in cq.usage.items():
+                for rname, used in resources.items():
+                    REGISTRY.cluster_queue_resource_usage.set(
+                        name, fname, rname, value=used)
+        if features.enabled(features.FAIR_SHARING):
+            from kueue_tpu.solver.fair_share import dominant_resource_share
+            snap = self.cache.snapshot()
+            for name, cq in snap.cluster_queues.items():
+                REGISTRY.cluster_queue_fair_share.set(
+                    name, value=dominant_resource_share(cq)[0])
 
     # -- reconcile pass ------------------------------------------------------
 
@@ -196,21 +275,41 @@ class Framework:
                 wl.set_condition(CONDITION_ADMITTED, False, reason="Evicted",
                                  now=self.clock())
                 self.queues.queue_associated_inadmissible_workloads(wl)
+            # Retry checks reset to Pending for the next attempt
+            # (workload.SyncAdmissionChecks).
+            for s in wl.admission_check_states.values():
+                if s.state == "Retry":
+                    s.state = "Pending"
             if wl.active:
                 self.queues.add_or_update_workload(wl)
-        # Two-phase admission: flip Admitted once every check is Ready
-        # (workload_controller.go:175-184).
-        for wl in self.workloads.values():
-            if not wl.has_quota_reservation or wl.is_admitted or wl.admission is None:
+        # Two-phase admission: flip Admitted once every check is Ready;
+        # Retry/Rejected checks evict (workload_controller.go:175-184,
+        # :244-253).
+        for wl in list(self.workloads.values()):
+            if not wl.has_quota_reservation or wl.admission is None:
                 continue
             cq = self.cache.cluster_queues.get(wl.admission.cluster_queue)
             if cq is None:
                 continue
             checks = cq.admission_checks
-            if checks and all(
-                    wl.admission_check_states.get(c) is not None
-                    and wl.admission_check_states[c].state == "Ready"
-                    for c in checks):
+            states = [wl.admission_check_states.get(c) for c in checks]
+            if any(s is not None and s.state in ("Retry", "Rejected")
+                   for s in states):
+                rejected = any(s is not None and s.state == "Rejected"
+                               for s in states)
+                if rejected:
+                    wl.active = False
+                if not wl.is_evicted:
+                    wl.set_condition(
+                        CONDITION_EVICTED, True,
+                        reason="AdmissionCheck",
+                        message="At least one admission check is false",
+                        now=self.clock())
+                    self._count_eviction(wl, "AdmissionCheck")
+                    self._evicted_dirty.append(wl)
+                continue
+            if not wl.is_admitted and checks and all(
+                    s is not None and s.state == "Ready" for s in states):
                 wl.set_condition(CONDITION_ADMITTED, True, reason="Admitted",
                                  now=self.clock())
                 self.cache.add_or_update_workload(wl)
@@ -239,6 +338,7 @@ class Framework:
                                  reason=EVICTED_BY_DEACTIVATION,
                                  message="Deactivated by reaching the requeue "
                                          "backoffLimitCount", now=now)
+                self._count_eviction(wl, EVICTED_BY_DEACTIVATION)
             else:
                 wl.requeue_state = RequeueState(
                     count=count,
@@ -247,6 +347,7 @@ class Framework:
                                  reason=EVICTED_BY_PODS_READY_TIMEOUT,
                                  message=f"Exceeded the PodsReady timeout "
                                          f"{wfpr.timeout_seconds}s", now=now)
+                self._count_eviction(wl, EVICTED_BY_PODS_READY_TIMEOUT)
             self._evicted_dirty.append(wl)
 
     # -- driving -------------------------------------------------------------
@@ -256,6 +357,7 @@ class Framework:
         self.queues.flush_expired_backoffs()
         admitted = self.scheduler.schedule(timeout=0.0)
         self.reconcile()
+        self.job_reconciler.reconcile()
         return admitted
 
     def run_until_settled(self, max_ticks: int = 100) -> int:

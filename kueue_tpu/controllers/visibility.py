@@ -1,0 +1,75 @@
+"""Visibility API: on-demand pending-workload listings.
+
+Counterpart of reference pkg/visibility/ (the embedded
+visibility.kueue.x-k8s.io apiserver, api/rest/pending_workloads_cq.go:60-91)
+and the QueueVisibility snapshot workers
+(clusterqueue_controller.go:685-720): ordered pending-workload views per
+ClusterQueue or LocalQueue with positions and priorities, straight from the
+queue manager's heaps.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional
+
+from kueue_tpu.queue.manager import Manager
+
+
+@dataclass
+class PendingWorkloadInfo:
+    name: str
+    namespace: str
+    local_queue: str
+    priority: int
+    position_in_cluster_queue: int
+    position_in_local_queue: int
+
+
+class VisibilityServer:
+    def __init__(self, queues: Manager, max_count: int = 4000):
+        self.queues = queues
+        self.max_count = max_count
+
+    def pending_workloads_in_cq(self, cq_name: str, offset: int = 0,
+                                limit: Optional[int] = None,
+                                ) -> List[PendingWorkloadInfo]:
+        """Pending workloads of a ClusterQueue in admission order."""
+        cq = self.queues.cluster_queues.get(cq_name)
+        if cq is None:
+            return []
+        limit = self.max_count if limit is None else limit
+        # Heap order first (admission order), then the parking lot.
+        items = sorted(cq.heap.items(),
+                       key=lambda wi: (-wi.obj.priority,
+                                       self.queues.ordering.queue_order_time(wi.obj)))
+        items += sorted(cq.inadmissible.values(),
+                        key=lambda wi: (-wi.obj.priority,
+                                        self.queues.ordering.queue_order_time(wi.obj)))
+        out: List[PendingWorkloadInfo] = []
+        lq_positions = {}
+        for pos, wi in enumerate(items):
+            lq_key = f"{wi.obj.namespace}/{wi.obj.queue_name}"
+            lq_pos = lq_positions.get(lq_key, 0)
+            lq_positions[lq_key] = lq_pos + 1
+            if pos < offset or len(out) >= limit:
+                continue
+            out.append(PendingWorkloadInfo(
+                name=wi.obj.name, namespace=wi.obj.namespace,
+                local_queue=wi.obj.queue_name, priority=wi.obj.priority,
+                position_in_cluster_queue=pos,
+                position_in_local_queue=lq_pos))
+        return out
+
+    def pending_workloads_in_lq(self, namespace: str, lq_name: str,
+                                offset: int = 0,
+                                limit: Optional[int] = None,
+                                ) -> List[PendingWorkloadInfo]:
+        lq = self.queues.local_queues.get(f"{namespace}/{lq_name}")
+        if lq is None:
+            return []
+        all_cq = self.pending_workloads_in_cq(lq.cluster_queue)
+        mine = [p for p in all_cq
+                if p.namespace == namespace and p.local_queue == lq_name]
+        limit = self.max_count if limit is None else limit
+        return mine[offset:offset + limit]

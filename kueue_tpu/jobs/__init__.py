@@ -1,0 +1,15 @@
+"""Job integrations (counterpart of reference pkg/controller/jobs/).
+
+Importing this package registers the built-in integrations:
+  batch     single-PodSet parallel jobs (jobs/job)
+  multirole launcher/worker- and head/worker-group jobs, covering the
+            MPIJob, kubeflow *Job and RayJob/RayCluster shapes
+            (jobs/mpijob, jobs/kubeflow, jobs/rayjob, jobs/raycluster)
+  jobset    lists of replicated jobs (jobs/jobset)
+  podgroup  plain pods grouped by annotation (jobs/pod, KEP-976)
+"""
+
+from kueue_tpu.jobs.batch_job import BatchJob
+from kueue_tpu.jobs.multi_role_job import MultiRoleJob, Role
+from kueue_tpu.jobs.jobset import JobSet, ReplicatedJob
+from kueue_tpu.jobs.pod_group import PodGroup, GroupedPod

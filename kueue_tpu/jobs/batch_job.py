@@ -1,0 +1,104 @@
+"""Single-PodSet batch job integration (reference: pkg/controller/jobs/job/).
+
+Supports suspend/resume, partial admission via parallelism rewrite
+(job_controller.go partial-admission handling), reclaimable pods from the
+completion count (KEP-78), and PodsReady reporting.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from kueue_tpu.api.types import PodSet
+from kueue_tpu.controllers.jobframework import (
+    GenericJob,
+    PodSetInfo,
+    register_integration,
+)
+
+
+@register_integration("batch")
+class BatchJob(GenericJob):
+    def __init__(self, name: str, queue_name: str, parallelism: int,
+                 requests: Optional[Dict[str, object]] = None,
+                 completions: Optional[int] = None,
+                 min_parallelism: Optional[int] = None,
+                 namespace: str = "default",
+                 priority: int = 0,
+                 on_run: Optional[Callable[["BatchJob"], None]] = None,
+                 **podset_kwargs):
+        self._name = name
+        self._namespace = namespace
+        self._queue_name = queue_name
+        self.parallelism = parallelism
+        self.original_parallelism = parallelism
+        self.completions = completions if completions is not None else parallelism
+        self.min_parallelism = min_parallelism
+        self._priority = priority
+        self._suspended = True
+        self._requests = dict(requests or {})
+        self._podset_kwargs = podset_kwargs
+        self._on_run = on_run
+        self.ready_pods = 0
+        self.succeeded = 0
+        self.failed = False
+        self.podset_info: Optional[PodSetInfo] = None
+
+    # -- GenericJob ---------------------------------------------------------
+
+    @property
+    def name(self) -> str:
+        return self._name
+
+    @property
+    def namespace(self) -> str:
+        return self._namespace
+
+    @property
+    def queue_name(self) -> str:
+        return self._queue_name
+
+    def is_suspended(self) -> bool:
+        return self._suspended
+
+    def suspend(self) -> None:
+        self._suspended = True
+        self.ready_pods = 0
+
+    def run(self, podset_infos: Sequence[PodSetInfo]) -> None:
+        info = podset_infos[0]
+        # Partial admission rewrites parallelism (job.go RunWithPodSetsInfo).
+        self.parallelism = info.count
+        self.podset_info = info
+        self._suspended = False
+        if self._on_run is not None:
+            self._on_run(self)
+
+    def restore(self, podset_infos: Sequence[PodSetInfo]) -> None:
+        self.parallelism = self.original_parallelism
+        self.podset_info = None
+
+    def pod_sets(self) -> List[PodSet]:
+        return [PodSet.make(
+            "main", count=self.parallelism,
+            min_count=self.min_parallelism,
+            **self._requests, **self._podset_kwargs)]
+
+    def finished(self) -> Tuple[bool, bool]:
+        if self.failed:
+            return True, False
+        return self.succeeded >= self.completions, True
+
+    def pods_ready(self) -> bool:
+        return not self._suspended and self.ready_pods >= self.parallelism
+
+    def reclaimable_pods(self) -> Dict[str, int]:
+        # Completed pods no longer hold quota (KEP-78).
+        if self.succeeded == 0:
+            return {}
+        remaining = max(self.parallelism - self.succeeded, 0)
+        return {"main": self.parallelism - remaining} if remaining < self.parallelism else {}
+
+    def priority(self) -> int:
+        return self._priority

@@ -1,0 +1,94 @@
+"""JobSet integration (reference: pkg/controller/jobs/jobset/): a list of
+replicated jobs, each mapping to one PodSet with count = replicas *
+parallelism (jobset_controller.go PodSets)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from kueue_tpu.api.types import PodSet
+from kueue_tpu.controllers.jobframework import (
+    GenericJob,
+    PodSetInfo,
+    register_integration,
+)
+
+
+@dataclass
+class ReplicatedJob:
+    name: str
+    replicas: int
+    parallelism: int
+    requests: Dict[str, object] = field(default_factory=dict)
+    podset_kwargs: Dict[str, object] = field(default_factory=dict)
+
+    @property
+    def pod_count(self) -> int:
+        return self.replicas * self.parallelism
+
+
+@register_integration("jobset")
+class JobSet(GenericJob):
+    def __init__(self, name: str, queue_name: str,
+                 replicated_jobs: Sequence[ReplicatedJob],
+                 namespace: str = "default", priority: int = 0,
+                 on_run: Optional[Callable[["JobSet"], None]] = None):
+        self._name = name
+        self._namespace = namespace
+        self._queue_name = queue_name
+        self.replicated_jobs = list(replicated_jobs)
+        self._priority = priority
+        self._suspended = True
+        self._on_run = on_run
+        self.ready_jobs: Dict[str, bool] = {}
+        self.succeeded = False
+        self.failed = False
+        self.podset_infos: List[PodSetInfo] = []
+
+    @property
+    def name(self) -> str:
+        return self._name
+
+    @property
+    def namespace(self) -> str:
+        return self._namespace
+
+    @property
+    def queue_name(self) -> str:
+        return self._queue_name
+
+    def is_suspended(self) -> bool:
+        return self._suspended
+
+    def suspend(self) -> None:
+        self._suspended = True
+        self.ready_jobs.clear()
+
+    def run(self, podset_infos: Sequence[PodSetInfo]) -> None:
+        self.podset_infos = list(podset_infos)
+        self._suspended = False
+        if self._on_run is not None:
+            self._on_run(self)
+
+    def restore(self, podset_infos: Sequence[PodSetInfo]) -> None:
+        self.podset_infos = []
+
+    def pod_sets(self) -> List[PodSet]:
+        return [
+            PodSet.make(rj.name, count=rj.pod_count,
+                        **rj.requests, **rj.podset_kwargs)
+            for rj in self.replicated_jobs
+        ]
+
+    def finished(self) -> Tuple[bool, bool]:
+        if self.failed:
+            return True, False
+        return self.succeeded, True
+
+    def pods_ready(self) -> bool:
+        return not self._suspended and all(
+            self.ready_jobs.get(rj.name, False) for rj in self.replicated_jobs)
+
+    def priority(self) -> int:
+        return self._priority

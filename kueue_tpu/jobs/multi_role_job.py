@@ -1,0 +1,104 @@
+"""Heterogeneous-role job integration.
+
+One class covers the reference's launcher/worker and head/worker-group job
+shapes -- MPIJob (jobs/mpijob), the kubeflow *Job family
+(jobs/kubeflow/kubeflowjob + pytorchjob/tfjob/paddlejob/xgboostjob/mxjob),
+and RayJob/RayCluster (jobs/rayjob, jobs/raycluster): each role becomes one
+PodSet and the whole job is admitted atomically (the all-or-nothing
+invariant of multi-PodSet workloads, flavorassigner.go:282-329).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from kueue_tpu.api.types import PodSet
+from kueue_tpu.controllers.jobframework import (
+    GenericJob,
+    PodSetInfo,
+    register_integration,
+)
+
+
+@dataclass
+class Role:
+    """One homogeneous role (launcher, worker, head, worker-group...)."""
+
+    name: str
+    count: int
+    requests: Dict[str, object] = field(default_factory=dict)
+    min_count: Optional[int] = None
+    podset_kwargs: Dict[str, object] = field(default_factory=dict)
+
+
+@register_integration("multirole")
+class MultiRoleJob(GenericJob):
+    def __init__(self, name: str, queue_name: str, roles: Sequence[Role],
+                 namespace: str = "default", priority: int = 0,
+                 on_run: Optional[Callable[["MultiRoleJob"], None]] = None):
+        if not roles:
+            raise ValueError("MultiRoleJob needs at least one role")
+        self._name = name
+        self._namespace = namespace
+        self._queue_name = queue_name
+        self.roles = list(roles)
+        self._priority = priority
+        self._suspended = True
+        self._on_run = on_run
+        self.ready_roles: Dict[str, bool] = {}
+        self.succeeded = False
+        self.failed = False
+        self.podset_infos: List[PodSetInfo] = []
+
+    @property
+    def name(self) -> str:
+        return self._name
+
+    @property
+    def namespace(self) -> str:
+        return self._namespace
+
+    @property
+    def queue_name(self) -> str:
+        return self._queue_name
+
+    def is_suspended(self) -> bool:
+        return self._suspended
+
+    def suspend(self) -> None:
+        self._suspended = True
+        self.ready_roles.clear()
+
+    def run(self, podset_infos: Sequence[PodSetInfo]) -> None:
+        self.podset_infos = list(podset_infos)
+        by_name = {i.name: i for i in podset_infos}
+        for role in self.roles:
+            info = by_name.get(role.name)
+            if info is not None:
+                role.count = info.count
+        self._suspended = False
+        if self._on_run is not None:
+            self._on_run(self)
+
+    def restore(self, podset_infos: Sequence[PodSetInfo]) -> None:
+        self.podset_infos = []
+
+    def pod_sets(self) -> List[PodSet]:
+        return [
+            PodSet.make(role.name, count=role.count, min_count=role.min_count,
+                        **role.requests, **role.podset_kwargs)
+            for role in self.roles
+        ]
+
+    def finished(self) -> Tuple[bool, bool]:
+        if self.failed:
+            return True, False
+        return self.succeeded, True
+
+    def pods_ready(self) -> bool:
+        return not self._suspended and all(
+            self.ready_roles.get(r.name, False) for r in self.roles)
+
+    def priority(self) -> int:
+        return self._priority

@@ -1,0 +1,132 @@
+"""Plain-pod and pod-group integration (reference: pkg/controller/jobs/pod/,
+KEP-976).
+
+Pods carry an admission gate (the scheduling-gate analog,
+pod_controller.go:161-232); a group is the set of pods sharing a group name
+with an expected total count. Pods with the same requests shape form one
+PodSet (role hashing, pod_controller.go:526-587); the group is admitted
+atomically and pods are ungated together. A single ungrouped pod is a group
+of one.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from kueue_tpu.api.types import PodSet
+from kueue_tpu.controllers.jobframework import (
+    GenericJob,
+    PodSetInfo,
+    register_integration,
+)
+
+
+@dataclass
+class GroupedPod:
+    name: str
+    requests: Dict[str, object] = field(default_factory=dict)
+    group: str = ""  # empty = single-pod group
+    gated: bool = True
+    finished: bool = False
+    succeeded: bool = True
+    running: bool = False
+    node_selector: Dict[str, str] = field(default_factory=dict)
+
+    def role_key(self) -> Tuple:
+        return tuple(sorted((k, str(v)) for k, v in self.requests.items()))
+
+
+@register_integration("podgroup")
+class PodGroup(GenericJob):
+    def __init__(self, name: str, queue_name: str,
+                 pods: Sequence[GroupedPod],
+                 total_count: Optional[int] = None,
+                 namespace: str = "default", priority: int = 0,
+                 on_run: Optional[Callable[["PodGroup"], None]] = None):
+        self._name = name
+        self._namespace = namespace
+        self._queue_name = queue_name
+        self.pods = list(pods)
+        self.total_count = total_count if total_count is not None else len(self.pods)
+        self._priority = priority
+        self._on_run = on_run
+        self.podset_infos: List[PodSetInfo] = []
+
+    @property
+    def name(self) -> str:
+        return self._name
+
+    @property
+    def namespace(self) -> str:
+        return self._namespace
+
+    @property
+    def queue_name(self) -> str:
+        return self._queue_name
+
+    def add_pod(self, pod: GroupedPod) -> None:
+        """Late-arriving group members (pod_controller.go group assembly)."""
+        self.pods.append(pod)
+
+    def has_all_members(self) -> bool:
+        return len(self.pods) >= self.total_count
+
+    def is_suspended(self) -> bool:
+        # Suspension = all non-finished pods still gated.
+        return all(p.gated for p in self.pods if not p.finished)
+
+    def suspend(self) -> None:
+        for p in self.pods:
+            if not p.finished:
+                p.gated = True
+                p.running = False
+
+    def run(self, podset_infos: Sequence[PodSetInfo]) -> None:
+        self.podset_infos = list(podset_infos)
+        by_name = {i.name: i for i in podset_infos}
+        roles = self._roles()
+        for role_key, members in roles.items():
+            info = by_name.get(self._role_name(role_key))
+            for p in members:
+                if info is not None:
+                    p.node_selector.update(info.node_selector)
+                p.gated = False
+                p.running = True
+        if self._on_run is not None:
+            self._on_run(self)
+
+    def restore(self, podset_infos: Sequence[PodSetInfo]) -> None:
+        self.podset_infos = []
+        for p in self.pods:
+            p.node_selector.clear()
+
+    def _roles(self) -> Dict[Tuple, List[GroupedPod]]:
+        roles: Dict[Tuple, List[GroupedPod]] = {}
+        for p in self.pods:
+            roles.setdefault(p.role_key(), []).append(p)
+        return roles
+
+    @staticmethod
+    def _role_name(role_key: Tuple) -> str:
+        return f"role-{abs(hash(role_key)) % 10**8:08d}"
+
+    def pod_sets(self) -> List[PodSet]:
+        return [
+            PodSet.make(self._role_name(key), count=len(members),
+                        **members[0].requests)
+            for key, members in sorted(self._roles().items())
+        ]
+
+    def finished(self) -> Tuple[bool, bool]:
+        if not self.pods:
+            return False, True
+        if all(p.finished for p in self.pods):
+            return True, all(p.succeeded for p in self.pods)
+        return False, True
+
+    def pods_ready(self) -> bool:
+        return all(p.running or p.finished for p in self.pods)
+
+    def priority(self) -> int:
+        return self._priority

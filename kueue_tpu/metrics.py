@@ -1,0 +1,193 @@
+"""Metrics registry (counterpart of reference pkg/metrics/metrics.go).
+
+A dependency-free Prometheus-style registry: counters, gauges and
+histograms with labels, exportable in the text exposition format. The
+metric names and label sets mirror the reference
+(metrics.go:55-178), plus the per-tick phase timings the TPU build adds
+(snapshot / tensorize / device solve / apply).
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import defaultdict
+from typing import Dict, Iterable, List, Optional, Tuple
+
+_DEFAULT_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+                    0.5, 1.0, 2.5, 5.0, 10.0)
+
+
+class _Metric:
+    def __init__(self, name: str, help_text: str, label_names: Tuple[str, ...]):
+        self.name = name
+        self.help = help_text
+        self.label_names = label_names
+        self._lock = threading.Lock()
+
+
+class Counter(_Metric):
+    def __init__(self, name, help_text, label_names=()):
+        super().__init__(name, help_text, tuple(label_names))
+        self.values: Dict[Tuple, float] = defaultdict(float)
+
+    def inc(self, *labels, by: float = 1.0) -> None:
+        with self._lock:
+            self.values[tuple(labels)] += by
+
+    def get(self, *labels) -> float:
+        return self.values.get(tuple(labels), 0.0)
+
+    def collect(self):
+        for labels, v in sorted(self.values.items()):
+            yield self.name, labels, v
+
+
+class Gauge(_Metric):
+    def __init__(self, name, help_text, label_names=()):
+        super().__init__(name, help_text, tuple(label_names))
+        self.values: Dict[Tuple, float] = {}
+
+    def set(self, *labels, value: float) -> None:
+        with self._lock:
+            self.values[tuple(labels)] = value
+
+    def get(self, *labels) -> float:
+        return self.values.get(tuple(labels), 0.0)
+
+    def clear(self, *labels) -> None:
+        with self._lock:
+            self.values.pop(tuple(labels), None)
+
+    def prune(self, keep) -> None:
+        """Drop series whose label tuple fails the predicate (stale-object
+        cleanup; reference metrics.ClearClusterQueueMetrics)."""
+        with self._lock:
+            for key in [k for k in self.values if not keep(k)]:
+                del self.values[key]
+
+    def collect(self):
+        for labels, v in sorted(self.values.items()):
+            yield self.name, labels, v
+
+
+class Histogram(_Metric):
+    def __init__(self, name, help_text, label_names=(), buckets=_DEFAULT_BUCKETS):
+        super().__init__(name, help_text, tuple(label_names))
+        self.buckets = tuple(buckets)
+        self.counts: Dict[Tuple, List[int]] = {}
+        self.sums: Dict[Tuple, float] = defaultdict(float)
+        self.totals: Dict[Tuple, int] = defaultdict(int)
+
+    def observe(self, *labels, value: float) -> None:
+        key = tuple(labels)
+        with self._lock:
+            if key not in self.counts:
+                self.counts[key] = [0] * (len(self.buckets) + 1)
+            i = 0
+            while i < len(self.buckets) and value > self.buckets[i]:
+                i += 1
+            self.counts[key][i] += 1
+            self.sums[key] += value
+            self.totals[key] += 1
+
+    def percentile(self, q: float, *labels) -> float:
+        """Approximate percentile from bucket boundaries."""
+        key = tuple(labels)
+        counts = self.counts.get(key)
+        if not counts:
+            return 0.0
+        total = self.totals[key]
+        target = q * total
+        cum = 0
+        for i, c in enumerate(counts):
+            cum += c
+            if cum >= target:
+                return self.buckets[i] if i < len(self.buckets) else float("inf")
+        return float("inf")
+
+    def collect(self):
+        for key in sorted(self.counts):
+            cum = 0
+            for i, b in enumerate(self.buckets):
+                cum += self.counts[key][i]
+                yield f"{self.name}_bucket", key + (f'le="{b}"',), cum
+            yield f"{self.name}_bucket", key + ('le="+Inf"',), self.totals[key]
+            yield f"{self.name}_sum", key, self.sums[key]
+            yield f"{self.name}_count", key, self.totals[key]
+
+
+class Registry:
+    """All framework metrics (names mirror metrics.go)."""
+
+    def __init__(self):
+        p = "kueue_"
+        self.admission_attempts_total = Counter(
+            p + "admission_attempts_total",
+            "Total scheduling attempts", ("result",))
+        self.admission_attempt_duration_seconds = Histogram(
+            p + "admission_attempt_duration_seconds",
+            "Latency of a scheduling attempt", ("result",))
+        self.pending_workloads = Gauge(
+            p + "pending_workloads",
+            "Pending workloads per CQ", ("cluster_queue", "status"))
+        self.admitted_workloads_total = Counter(
+            p + "admitted_workloads_total",
+            "Admitted workloads per CQ", ("cluster_queue",))
+        self.admission_wait_time_seconds = Histogram(
+            p + "admission_wait_time_seconds",
+            "Queued-to-admitted wait time", ("cluster_queue",),
+            buckets=(1, 5, 10, 30, 60, 300, 600, 1800, 3600))
+        self.evicted_workloads_total = Counter(
+            p + "evicted_workloads_total",
+            "Evictions per CQ and reason", ("cluster_queue", "reason"))
+        self.preempted_workloads_total = Counter(
+            p + "preempted_workloads_total",
+            "Preemptions per CQ", ("cluster_queue",))
+        self.reserving_active_workloads = Gauge(
+            p + "reserving_active_workloads",
+            "Workloads holding quota per CQ", ("cluster_queue",))
+        self.admitted_active_workloads = Gauge(
+            p + "admitted_active_workloads",
+            "Admitted workloads per CQ", ("cluster_queue",))
+        self.cluster_queue_status = Gauge(
+            p + "cluster_queue_status",
+            "CQ active status", ("cluster_queue", "status"))
+        self.cluster_queue_resource_usage = Gauge(
+            p + "cluster_queue_resource_usage",
+            "Quota usage", ("cluster_queue", "flavor", "resource"))
+        self.cluster_queue_nominal_quota = Gauge(
+            p + "cluster_queue_nominal_quota",
+            "Nominal quota", ("cluster_queue", "flavor", "resource"))
+        self.cluster_queue_fair_share = Gauge(
+            p + "cluster_queue_fair_sharing_weighted_share",
+            "Fair-sharing share value", ("cluster_queue",))
+        # TPU-build additions: per-tick phase timings.
+        self.tick_phase_seconds = Histogram(
+            p + "tick_phase_seconds",
+            "Per-phase tick latency (snapshot/tensorize/solve/apply)",
+            ("phase",))
+
+    def all_metrics(self) -> Iterable[_Metric]:
+        return [v for v in vars(self).values() if isinstance(v, _Metric)]
+
+    def export_text(self) -> str:
+        """Prometheus text exposition format."""
+        lines: List[str] = []
+        for m in self.all_metrics():
+            lines.append(f"# HELP {m.name} {m.help}")
+            kind = {"Counter": "counter", "Gauge": "gauge",
+                    "Histogram": "histogram"}[type(m).__name__]
+            lines.append(f"# TYPE {m.name} {kind}")
+            for name, labels, value in m.collect():
+                rendered = []
+                for i, lv in enumerate(labels):
+                    if isinstance(lv, str) and "=" in lv:
+                        rendered.append(lv)
+                    else:
+                        rendered.append(f'{m.label_names[i]}="{lv}"')
+                label_str = "{" + ",".join(rendered) + "}" if rendered else ""
+                lines.append(f"{name}{label_str} {value}")
+        return "\n".join(lines) + "\n"
+
+
+REGISTRY = Registry()

@@ -95,7 +95,9 @@ class PendingClusterQueue:
     def _fingerprint(wi: WorkloadInfo) -> tuple:
         evicted = wi.obj.find_condition(CONDITION_EVICTED)
         return (
-            [(ps.name, ps.count, dict(ps.requests)) for ps in wi.obj.pod_sets],
+            [(ps.name, ps.count, ps.min_count, tuple(sorted(ps.requests.items())),
+              ps.node_selector, ps.affinity_terms, ps.tolerations)
+             for ps in wi.obj.pod_sets],
             dict(wi.obj.reclaimable_pods),
             (evicted.status, evicted.reason, evicted.last_transition_time)
             if evicted else None,

@@ -20,6 +20,7 @@ from typing import Callable, Dict, List, Optional, Sequence, Set
 
 from kueue_tpu import features
 from kueue_tpu.api.types import Admission, PodSetAssignment, Workload
+from kueue_tpu.metrics import REGISTRY
 from kueue_tpu.core.cache import (
     Cache,
     CachedClusterQueue,
@@ -106,6 +107,10 @@ class Scheduler:
                 self._requeue_and_update(e)
         self.metrics.admission_attempts += 1
         self.metrics.last_tick_seconds = self.clock() - start
+        result = "success" if admitted else "inadmissible"
+        REGISTRY.admission_attempts_total.inc(result)
+        REGISTRY.admission_attempt_duration_seconds.observe(
+            result, value=self.metrics.last_tick_seconds)
         return admitted
 
     # -- nomination (scheduler.go:317-351) ----------------------------------
@@ -245,7 +250,9 @@ class Scheduler:
             if mode == FIT and self.pods_ready_gate is not None \
                     and not self.pods_ready_gate():
                 # Admission blocked until all admitted workloads are ready
-                # (scheduler.go:256-266).
+                # (scheduler.go:256-266). Preemptions still proceed while
+                # blocked, matching the reference's loop order (the preempt
+                # branch above runs before the PodsReady wait).
                 e.status = SKIPPED
                 e.inadmissible_msg = ("Waiting for all admitted workloads to "
                                       "be in the PodsReady condition")
@@ -296,6 +303,12 @@ class Scheduler:
                 for ps in e.assignment.pod_sets
             ],
         )
+        # Wait time runs from creation, or from the eviction being recovered
+        # from (scheduler.go:516-520); capture before clearing Evicted.
+        wait_started = wl.creation_time
+        evicted_cond = wl.find_condition("Evicted")
+        if evicted_cond is not None and evicted_cond.status:
+            wait_started = evicted_cond.last_transition_time
         wl.admission = admission
         wl.set_condition("QuotaReserved", True, reason="QuotaReserved",
                          now=self.clock())
@@ -326,6 +339,9 @@ class Scheduler:
             self._requeue_and_update(e)
             return False
         self.metrics.admitted += 1
+        REGISTRY.admitted_workloads_total.inc(e.info.cluster_queue)
+        REGISTRY.admission_wait_time_seconds.observe(
+            e.info.cluster_queue, value=max(0.0, self.clock() - wait_started))
         return True
 
     # -- requeue (scheduler.go:590-607) --------------------------------------

@@ -1,0 +1,147 @@
+"""Two-phase admission: provisioning and MultiKueue check controllers
+(scenarios modeled on the reference's admissionchecks integration suites;
+the two-cluster setup mirrors test/integration/multikueue)."""
+
+from kueue_tpu.controllers.multikueue import (
+    InProcessRemote,
+    MultiKueueController,
+)
+from kueue_tpu.controllers.provisioning import (
+    ProvisioningController,
+    ProvisioningRequestConfig,
+)
+from kueue_tpu.controllers.runtime import Framework
+
+from tests.util import fq, make_cq, make_flavor, make_lq, make_wl, rg
+
+
+def checked_framework(checks=("prov",), quota_cpu=8):
+    fw = Framework()
+    fw.create_resource_flavor(make_flavor("default"))
+    fw.create_cluster_queue(make_cq(
+        "cq", rg("cpu", fq("default", cpu=quota_cpu)),
+        admission_checks=checks))
+    fw.create_local_queue(make_lq("main", cq="cq"))
+    return fw
+
+
+def test_provisioning_success_admits():
+    fw = checked_framework()
+    ctrl = ProvisioningController(fw)
+    ctrl.register_check("prov", ProvisioningRequestConfig(name="default-prov"))
+    wl = make_wl("w", cpu=2)
+    fw.submit(wl)
+    fw.run_until_settled()
+    assert wl.has_quota_reservation and not wl.is_admitted
+    ctrl.reconcile()   # creates the request; instant provider provisions it
+    fw.reconcile()     # flips Admitted
+    assert wl.is_admitted
+    assert wl.admission_check_states["prov"].state == "Ready"
+    assert len(ctrl.requests) == 1
+
+
+def test_provisioning_retry_then_reject():
+    fw = checked_framework()
+    outcomes = iter(["Failed", "Failed"])
+
+    def flaky_provider(req):
+        if req.state == "Pending":
+            req.state = next(outcomes, "Failed")
+
+    ctrl = ProvisioningController(fw, provider=flaky_provider)
+    ctrl.register_check("prov", ProvisioningRequestConfig(
+        name="p", max_retries=2))
+    wl = make_wl("w", cpu=2)
+    fw.submit(wl)
+    fw.run_until_settled()
+    ctrl.reconcile()
+    assert wl.admission_check_states["prov"].state == "Retry"
+    # Retry evicts and releases quota; the check resets to Pending.
+    fw.reconcile()
+    fw.reconcile()
+    assert not wl.has_quota_reservation
+    assert wl.admission_check_states["prov"].state == "Pending"
+    # Re-reserve; second attempt fails and exhausts retries -> Rejected.
+    fw.run_until_settled()
+    assert wl.has_quota_reservation
+    ctrl.reconcile()
+    assert wl.admission_check_states["prov"].state == "Rejected"
+    fw.reconcile()
+    fw.reconcile()
+    assert not wl.active
+
+
+def make_worker(name="worker"):
+    worker = Framework()
+    worker.create_resource_flavor(make_flavor("default"))
+    worker.create_cluster_queue(make_cq(
+        "cq", rg("cpu", fq("default", cpu=8))))
+    worker.create_local_queue(make_lq("main", cq="cq"))
+    return worker
+
+
+def test_multikueue_first_reservation_wins():
+    manager = checked_framework(checks=("multikueue",))
+    worker1, worker2 = make_worker(), make_worker()
+    mk = MultiKueueController(manager, check_name="multikueue")
+    mk.add_cluster("w1", InProcessRemote(worker1))
+    mk.add_cluster("w2", InProcessRemote(worker2))
+
+    wl = make_wl("train", cpu=2)
+    manager.submit(wl)
+    manager.run_until_settled()
+    mk.reconcile()  # dispatch to both workers
+    assert wl.key in worker1.workloads and wl.key in worker2.workloads
+
+    # worker1 admits first.
+    worker1.run_until_settled()
+    mk.reconcile()
+    assert wl.admission_check_states["multikueue"].state == "Ready"
+    assert "w1" in wl.admission_check_states["multikueue"].message
+    # The mirror on the losing worker was deleted.
+    assert wl.key not in worker2.workloads
+    manager.reconcile()
+    assert wl.is_admitted
+
+    # Remote finishes -> local finishes, remote mirror GCed.
+    worker1.finish(worker1.workloads[wl.key])
+    mk.reconcile()
+    assert wl.is_finished
+    assert wl.key not in worker1.workloads
+
+
+def test_multikueue_worker_lost_retries():
+    manager = checked_framework(checks=("multikueue",))
+
+    class FakeClock:
+        now = 1000.0
+
+        def __call__(self):
+            return FakeClock.now
+
+    manager.clock = FakeClock()
+    worker1 = make_worker()
+    remote1 = InProcessRemote(worker1)
+    mk = MultiKueueController(manager, check_name="multikueue",
+                              worker_lost_timeout=60.0)
+    mk.add_cluster("w1", remote1)
+
+    wl = make_wl("train", cpu=2)
+    manager.submit(wl)
+    manager.run_until_settled()
+    mk.reconcile()
+    worker1.run_until_settled()
+    mk.reconcile()
+    assert wl.admission_check_states["multikueue"].state == "Ready"
+
+    # The worker disconnects; after workerLostTimeout the check retries.
+    remote1.set_connected(False)
+    mk.reconcile()
+    assert wl.admission_check_states["multikueue"].state == "Ready"
+    FakeClock.now += 61.0
+    mk.reconcile()
+    assert wl.admission_check_states["multikueue"].state == "Retry"
+    # The Retry check evicts the local workload for a fresh dispatch.
+    manager.reconcile()
+    manager.reconcile()
+    assert not wl.has_quota_reservation

@@ -1,0 +1,167 @@
+"""Jobframework + integrations: job <-> workload lifecycle (scenarios
+modeled on the reference's jobframework reconciler and per-integration
+tests)."""
+
+from kueue_tpu.api.types import ClusterQueuePreemption, ResourceFlavor
+from kueue_tpu.controllers.runtime import Framework
+from kueue_tpu.jobs import (
+    BatchJob,
+    GroupedPod,
+    JobSet,
+    MultiRoleJob,
+    PodGroup,
+    ReplicatedJob,
+    Role,
+)
+
+from tests.util import fq, make_cq, make_flavor, make_lq, rg
+
+
+def job_framework(quota_cpu=8, **cq_kwargs):
+    fw = Framework()
+    fw.create_resource_flavor(ResourceFlavor.make(
+        "default", node_labels={"pool": "tpu-v5e"}))
+    fw.create_cluster_queue(make_cq(
+        "cq", rg("cpu", fq("default", cpu=quota_cpu)), **cq_kwargs))
+    fw.create_local_queue(make_lq("main", cq="cq"))
+    return fw
+
+
+def test_batch_job_lifecycle():
+    fw = job_framework()
+    launched = []
+    job = BatchJob("train", "main", parallelism=4, requests={"cpu": 1},
+                   on_run=lambda j: launched.append(j.name))
+    wl = fw.submit_job(job)
+    assert job.is_suspended()
+    fw.run_until_settled()
+    # Admitted: job started with flavor node selectors injected.
+    assert not job.is_suspended()
+    assert launched == ["train"]
+    assert job.podset_info.node_selector == {"pool": "tpu-v5e"}
+    assert wl.is_admitted
+    # Finish the job: quota released.
+    job.succeeded = 4
+    fw.tick()
+    assert wl.is_finished
+    assert fw.cache.usage("cq")["default"]["cpu"] == 0
+
+
+def test_batch_job_partial_admission():
+    fw = job_framework(quota_cpu=4)
+    job = BatchJob("wide", "main", parallelism=8, min_parallelism=2,
+                   requests={"cpu": 1})
+    fw.submit_job(job)
+    fw.run_until_settled()
+    assert not job.is_suspended()
+    assert job.parallelism == 4  # shrunk to the available quota
+    # Stopping restores the original parallelism.
+    job.failed = True
+    fw.tick()
+    assert job.finished()[0]
+
+
+def test_batch_job_preemption_stops_job():
+    fw = job_framework(
+        quota_cpu=4,
+        preemption=ClusterQueuePreemption(within_cluster_queue="LowerPriority"))
+    low = BatchJob("low", "main", parallelism=4, requests={"cpu": 1}, priority=-1)
+    fw.submit_job(low)
+    fw.run_until_settled()
+    assert not low.is_suspended()
+    high = BatchJob("high", "main", parallelism=4, requests={"cpu": 1}, priority=5)
+    fw.submit_job(high)
+    fw.run_until_settled()
+    # Low got preempted and suspended; high is running.
+    assert low.is_suspended()
+    assert low.parallelism == low.original_parallelism
+    assert not high.is_suspended()
+
+
+def test_multi_role_job_atomic_admission():
+    fw = job_framework(quota_cpu=8)
+    job = MultiRoleJob("mpi", "main", roles=[
+        Role("launcher", count=1, requests={"cpu": 1}),
+        Role("worker", count=6, requests={"cpu": 1}),
+    ])
+    wl = fw.submit_job(job)
+    fw.run_until_settled()
+    assert not job.is_suspended()
+    assert [ps.name for ps in wl.pod_sets] == ["launcher", "worker"]
+    assert {i.name: i.count for i in job.podset_infos} == \
+        {"launcher": 1, "worker": 6}
+
+    # A second job needing 8 can't fit atomically (1 cpu free).
+    job2 = MultiRoleJob("mpi2", "main", roles=[
+        Role("launcher", count=1, requests={"cpu": 1}),
+        Role("worker", count=7, requests={"cpu": 1}),
+    ])
+    fw.submit_job(job2)
+    fw.run_until_settled()
+    assert job2.is_suspended()
+
+
+def test_jobset_integration():
+    fw = job_framework(quota_cpu=8)
+    js = JobSet("set", "main", replicated_jobs=[
+        ReplicatedJob("driver", replicas=1, parallelism=1, requests={"cpu": 1}),
+        ReplicatedJob("workers", replicas=2, parallelism=3, requests={"cpu": 1}),
+    ])
+    wl = fw.submit_job(js)
+    fw.run_until_settled()
+    assert not js.is_suspended()
+    assert {ps.name: ps.count for ps in wl.pod_sets} == \
+        {"driver": 1, "workers": 6}
+    js.succeeded = True
+    fw.tick()
+    assert wl.is_finished
+
+
+def test_pod_group_gating():
+    fw = job_framework(quota_cpu=4)
+    pods = [GroupedPod(f"p{i}", requests={"cpu": 1}, group="g") for i in range(3)]
+    group = PodGroup("g", "main", pods=pods, total_count=3)
+    wl = fw.submit_job(group)
+    assert all(p.gated for p in pods)
+    fw.run_until_settled()
+    # Admitted atomically: all pods ungated with placement injected.
+    assert all(not p.gated and p.running for p in pods)
+    assert all(p.node_selector == {"pool": "tpu-v5e"} for p in pods)
+    assert wl.is_admitted
+    # All pods finish -> workload finished.
+    for p in pods:
+        p.finished = True
+        p.running = False
+    fw.tick()
+    assert wl.is_finished
+    assert fw.cache.usage("cq")["default"]["cpu"] == 0
+
+
+def test_pod_group_heterogeneous_roles():
+    fw = job_framework(quota_cpu=8)
+    pods = ([GroupedPod(f"w{i}", requests={"cpu": 1}, group="g") for i in range(4)]
+            + [GroupedPod("head", requests={"cpu": 2}, group="g")])
+    group = PodGroup("g", "main", pods=pods, total_count=5)
+    wl = fw.submit_job(group)
+    fw.run_until_settled()
+    # Two role PodSets: 4x1cpu + 1x2cpu.
+    counts = sorted(ps.count for ps in wl.pod_sets)
+    assert counts == [1, 4]
+    assert all(not p.gated for p in pods)
+
+
+def test_reclaimable_pods_release_quota():
+    fw = job_framework(quota_cpu=4)
+    job = BatchJob("j", "main", parallelism=4, completions=4, requests={"cpu": 1})
+    fw.submit_job(job)
+    fw.run_until_settled()
+    assert fw.cache.usage("cq")["default"]["cpu"] == 4000
+    # Two pods complete: their quota is reclaimed before the job finishes.
+    job.succeeded = 2
+    fw.tick()
+    assert fw.cache.usage("cq")["default"]["cpu"] == 2000
+    # The freed quota admits another job.
+    job2 = BatchJob("j2", "main", parallelism=2, requests={"cpu": 1})
+    fw.submit_job(job2)
+    fw.run_until_settled()
+    assert not job2.is_suspended()

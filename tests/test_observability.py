@@ -1,0 +1,144 @@
+"""Metrics, visibility API and the state dumper."""
+
+import json
+
+from kueue_tpu.controllers.debugger import Dumper
+from kueue_tpu.controllers.runtime import Framework
+from kueue_tpu.controllers.visibility import VisibilityServer
+from kueue_tpu.metrics import REGISTRY, Histogram, Registry
+
+from tests.util import fq, make_cq, make_flavor, make_lq, make_wl, rg
+
+
+def small_framework(quota_cpu=2):
+    fw = Framework()
+    fw.create_resource_flavor(make_flavor("default"))
+    fw.create_cluster_queue(make_cq("cq", rg("cpu", fq("default", cpu=quota_cpu))))
+    fw.create_local_queue(make_lq("main", cq="cq"))
+    return fw
+
+
+def test_admission_metrics_count():
+    fw = small_framework(quota_cpu=2)
+    before = REGISTRY.admitted_workloads_total.get("cq")
+    fw.submit(make_wl("w0", cpu=1))
+    fw.submit(make_wl("w1", cpu=1))
+    fw.submit(make_wl("w2", cpu=1))  # won't fit
+    fw.run_until_settled()
+    assert REGISTRY.admitted_workloads_total.get("cq") - before == 2
+    fw.update_metrics_gauges()
+    assert REGISTRY.pending_workloads.get("cq", "inadmissible") == 1
+    assert REGISTRY.reserving_active_workloads.get("cq") == 2
+    assert REGISTRY.cluster_queue_resource_usage.get("cq", "default", "cpu") == 2000
+
+
+def test_export_text_format():
+    fw = small_framework()
+    fw.submit(make_wl("w", cpu=1))
+    fw.run_until_settled()
+    text = REGISTRY.export_text()
+    assert "# TYPE kueue_admitted_workloads_total counter" in text
+    assert 'kueue_admitted_workloads_total{cluster_queue="cq"}' in text
+    assert "# TYPE kueue_admission_attempt_duration_seconds histogram" in text
+
+
+def test_histogram_percentile():
+    h = Histogram("x", "test", buckets=(0.01, 0.1, 1.0))
+    for v in [0.005] * 90 + [0.5] * 10:
+        h.observe(value=v)
+    assert h.percentile(0.5) == 0.01
+    assert h.percentile(0.99) == 1.0
+
+
+def test_visibility_positions():
+    fw = small_framework(quota_cpu=0)
+    fw.create_local_queue(make_lq("other", cq="cq"))
+    fw.submit(make_wl("low", priority=0, creation_time=1.0))
+    fw.submit(make_wl("high", priority=5, creation_time=2.0))
+    fw.submit(make_wl("other-wl", "other", priority=0, creation_time=3.0))
+    vis = VisibilityServer(fw.queues)
+    pending = vis.pending_workloads_in_cq("cq")
+    assert [p.name for p in pending] == ["high", "low", "other-wl"]
+    assert [p.position_in_cluster_queue for p in pending] == [0, 1, 2]
+    assert pending[2].position_in_local_queue == 0
+    by_lq = vis.pending_workloads_in_lq("default", "main")
+    assert [p.name for p in by_lq] == ["high", "low"]
+
+
+def test_visibility_includes_inadmissible():
+    fw = small_framework(quota_cpu=1)
+    fw.submit(make_wl("fits", cpu=1, creation_time=1.0))
+    fw.submit(make_wl("parked", cpu=1, creation_time=2.0))
+    fw.run_until_settled()
+    vis = VisibilityServer(fw.queues)
+    pending = vis.pending_workloads_in_cq("cq")
+    assert [p.name for p in pending] == ["parked"]
+
+
+def test_dumper_roundtrip():
+    fw = small_framework(quota_cpu=1)
+    fw.submit(make_wl("running", cpu=1, creation_time=1.0))
+    fw.submit(make_wl("waiting", cpu=1, creation_time=2.0))
+    fw.run_until_settled()
+    dump = json.loads(Dumper(fw.cache, fw.queues).dump_json())
+    assert dump["cache"]["cq"]["admittedWorkloads"] == ["default/running"]
+    assert dump["cache"]["cq"]["usage"]["default"]["cpu"] == 1000
+    assert dump["queues"]["cq"]["inadmissible"] == ["default/waiting"]
+
+
+def test_gauges_pruned_on_cq_delete():
+    fw = small_framework()
+    fw.submit(make_wl("w", cpu=1))
+    fw.run_until_settled()
+    fw.update_metrics_gauges()
+    assert REGISTRY.cluster_queue_resource_usage.get("cq", "default", "cpu") == 1000
+    fw.delete_cluster_queue("cq")
+    assert REGISTRY.cluster_queue_resource_usage.get("cq", "default", "cpu") == 0
+    assert ("cq", "active") not in REGISTRY.pending_workloads.values
+
+
+def test_eviction_metrics_all_reasons():
+    from kueue_tpu.config import Configuration, WaitForPodsReady
+    from tests.test_pods_ready import FakeClock
+    clock = FakeClock()
+    fw = Framework(config=Configuration(
+        wait_for_pods_ready=WaitForPodsReady(enable=True, timeout_seconds=10.0,
+                                             block_admission=False)), clock=clock)
+    fw.create_resource_flavor(make_flavor("default"))
+    fw.create_cluster_queue(make_cq("cq", rg("cpu", fq("default", cpu=4))))
+    fw.create_local_queue(make_lq("main", cq="cq"))
+    before = REGISTRY.evicted_workloads_total.get("cq", "PodsReadyTimeout")
+    fw.submit(make_wl("w", cpu=1))
+    fw.run_until_settled()
+    clock.now += 11.0
+    fw.reconcile()
+    assert REGISTRY.evicted_workloads_total.get("cq", "PodsReadyTimeout") - before == 1
+
+
+def test_readmission_wait_time_measured():
+    from kueue_tpu.api.types import ClusterQueuePreemption
+    from tests.test_pods_ready import FakeClock
+    clock = FakeClock()
+    fw = Framework(clock=clock)
+    fw.create_resource_flavor(make_flavor("default"))
+    fw.create_cluster_queue(make_cq(
+        "cq", rg("cpu", fq("default", cpu=2)),
+        preemption=ClusterQueuePreemption(within_cluster_queue="LowerPriority")))
+    fw.create_local_queue(make_lq("main", cq="cq"))
+    low = make_wl("low", cpu=2, priority=-1, creation_time=clock.now)
+    fw.submit(low)
+    fw.run_until_settled()
+    high = make_wl("high", cpu=2, priority=5, creation_time=clock.now)
+    fw.submit(high)
+    fw.run_until_settled()
+    assert low.is_evicted
+    # high finishes 100s later; low waits that long from its eviction.
+    clock.now += 100.0
+    fw.finish(high)
+    hist = REGISTRY.admission_wait_time_seconds
+    count_before = hist.totals.get(("cq",), 0)
+    fw.run_until_settled()
+    assert low.is_admitted
+    assert hist.totals[("cq",)] == count_before + 1
+    # The new observation is ~100s (bucketed between 60 and 300).
+    assert hist.percentile(1.0, "cq") >= 60
