@@ -318,6 +318,21 @@ class WorkloadPriorityClass:
     value: int
 
 
+@dataclass(frozen=True)
+class AdmissionCheck:
+    """A two-phase admission gate definition (KEP-993).
+
+    reference: apis/kueue/v1beta1/admissioncheck_types.go — names the
+    controller that drives the check and an optional parameters reference.
+    """
+
+    name: str
+    controller_name: str
+    # (api_group, kind, name) of a controller-specific parameters object,
+    # e.g. a ProvisioningRequestConfig.
+    parameters: Optional[Tuple[str, str, str]] = None
+
+
 # ---------------------------------------------------------------------------
 # Workload
 # ---------------------------------------------------------------------------
@@ -339,6 +354,9 @@ class PodSet:
     # Required node-affinity terms: OR of terms, each term an AND of expressions.
     affinity_terms: Tuple[Tuple[MatchExpression, ...], ...] = ()
     tolerations: Tuple[Toleration, ...] = ()
+    # Optional full template; when set, `requests` is derived from it by
+    # workload.adjust_resources (pkg/workload/resources.go).
+    template: Optional[PodTemplate] = None
 
     @staticmethod
     def make(name: str, count: int, min_count: Optional[int] = None,
@@ -354,6 +372,60 @@ class PodSet:
             affinity_terms=tuple(tuple(t) for t in affinity_terms),
             tolerations=tuple(tolerations),
         )
+
+
+@dataclass
+class Container:
+    """Resource envelope of one container (k8s core/v1 Container subset).
+
+    `requests`/`limits` are canonical integers keyed by resource name.
+    """
+
+    name: str = ""
+    requests: Dict[str, int] = field(default_factory=dict)
+    limits: Dict[str, int] = field(default_factory=dict)
+
+    @staticmethod
+    def make(name: str = "",
+             requests: Optional[Mapping[str, Quantity]] = None,
+             limits: Optional[Mapping[str, Quantity]] = None) -> "Container":
+        return Container(
+            name=name,
+            requests={r: resource_value(r, q) for r, q in (requests or {}).items()},
+            limits={r: resource_value(r, q) for r, q in (limits or {}).items()},
+        )
+
+
+@dataclass
+class PodTemplate:
+    """The resource-bearing part of a pod template (core/v1 PodSpec subset).
+
+    Job integrations attach one per PodSet so the resource-adjustment
+    pipeline (reference: pkg/workload/resources.go AdjustResources) can fold
+    RuntimeClass overhead, LimitRange defaults and limits->requests
+    defaulting before the per-pod totals are computed
+    (pkg/util/limitrange/limitrange.go TotalRequests).
+    """
+
+    containers: List[Container] = field(default_factory=list)
+    init_containers: List[Container] = field(default_factory=list)
+    overhead: Dict[str, int] = field(default_factory=dict)
+    runtime_class_name: Optional[str] = None
+
+    def total_requests(self) -> Dict[str, int]:
+        """total = max(sum(containers), max(initContainers)) + overhead
+        (limitrange.go:83-101)."""
+        total: Dict[str, int] = {}
+        for c in self.containers:
+            for r, v in c.requests.items():
+                total[r] = total.get(r, 0) + v
+        for c in self.init_containers:
+            for r, v in c.requests.items():
+                if v > total.get(r, 0):
+                    total[r] = v
+        for r, v in self.overhead.items():
+            total[r] = total.get(r, 0) + v
+        return total
 
 
 # Condition types (reference: apis/kueue/v1beta1/workload_types.go conditions)

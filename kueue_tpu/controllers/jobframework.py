@@ -189,9 +189,15 @@ class JobReconciler:
                 continue
 
             # 2. Sync reclaimable pods (step 4; KEP-78 dynamic reclaim).
+            # A rejected update (webhook: shrinking/out-of-range counts) is
+            # dropped, like a denied SSA patch in the reference.
             reclaimable = job.reclaimable_pods()
             if reclaimable and reclaimable != wl.reclaimable_pods:
-                self.fw.update_reclaimable_pods(wl, reclaimable)
+                from kueue_tpu.webhooks import ValidationError
+                try:
+                    self.fw.update_reclaimable_pods(wl, reclaimable)
+                except ValidationError:
+                    pass
 
             # 3. PodsReady condition from the job (step 5).
             if job.pods_ready() and not wl.condition_true("PodsReady"):

@@ -28,6 +28,7 @@ from kueue_tpu.api.types import (
     CONDITION_QUOTA_RESERVED,
     EVICTED_BY_DEACTIVATION,
     EVICTED_BY_PODS_READY_TIMEOUT,
+    AdmissionCheck,
     ClusterQueue,
     LocalQueue,
     RequeueState,
@@ -42,6 +43,9 @@ from kueue_tpu.core.workload import WorkloadInfo, WorkloadOrdering
 from kueue_tpu.queue.manager import Manager, RequeueReason
 from kueue_tpu.scheduler.preemption import DEFAULT_FAIR_STRATEGIES
 from kueue_tpu.scheduler.scheduler import Scheduler
+from kueue_tpu.utils import limitrange as limitrange_mod
+from kueue_tpu.utils.limitrange import LimitRange
+from kueue_tpu import webhooks
 
 
 class Framework:
@@ -68,6 +72,13 @@ class Framework:
         self.namespaces: Dict[str, Dict[str, str]] = {"default": {}}
         self.workloads: Dict[str, Workload] = {}
         self.priority_classes: Dict[str, WorkloadPriorityClass] = {}
+        # namespace -> LimitRanges; runtime-class name -> pod overhead
+        # (the string-world inputs to workload.AdjustResources).
+        self.limit_ranges: Dict[str, List[LimitRange]] = {}
+        self.runtime_classes: Dict[str, Dict[str, int]] = {}
+        self.cluster_queue_specs: Dict[str, ClusterQueue] = {}
+        self.admission_checks: Dict[str, AdmissionCheck] = {}
+        self._ns_summaries: Dict[str, limitrange_mod.Summary] = {}
         self.cache = Cache()
         self.queues = Manager(ordering=self.ordering,
                               namespace_lister=self.namespaces.get,
@@ -84,6 +95,7 @@ class Framework:
             ordering=self.ordering,
             pods_ready_gate=gate,
             fair_strategies=fair_strategies,
+            workload_validator=self._validate_workload_resources,
             clock=clock)
         self._evicted_dirty: List[Workload] = []
         from kueue_tpu.controllers.jobframework import JobReconciler
@@ -94,7 +106,83 @@ class Framework:
     def create_namespace(self, name: str, labels: Optional[Dict[str, str]] = None) -> None:
         self.namespaces[name] = labels or {}
 
+    def create_limit_range(self, lr: LimitRange) -> None:
+        """Register a namespace LimitRange and re-adjust + requeue pending
+        workloads in that namespace — the reference's Workload reconciler
+        watches LimitRanges for exactly this (workload_controller.go
+        LimitRange watch handler)."""
+        self.limit_ranges.setdefault(lr.namespace, []).append(lr)
+        self._ns_summaries.pop(lr.namespace, None)
+        self._readjust_pending(namespace=lr.namespace)
+
+    def create_runtime_class(self, name: str,
+                             overhead: Dict[str, int]) -> None:
+        self.runtime_classes[name] = dict(overhead)
+        self._readjust_pending()
+
+    def _readjust_pending(self, namespace: Optional[str] = None) -> None:
+        """Re-run AdjustResources on not-yet-reserved workloads after a
+        LimitRange/RuntimeClass change, and re-open parked queues so a
+        previously-inadmissible workload gets another nomination."""
+        for wl in self.workloads.values():
+            if wl.has_quota_reservation or wl.is_finished:
+                continue
+            if namespace is not None and wl.namespace != namespace:
+                continue
+            limitrange_mod.adjust_resources(
+                wl, self.limit_ranges.get(wl.namespace, []),
+                self.runtime_classes)
+            self.queues.add_or_update_workload(wl)
+        self.queues.queue_inadmissible_workloads(
+            list(self.queues.cluster_queues))
+
+    def _ns_summary(self, namespace: str) -> limitrange_mod.Summary:
+        """Summaries fold only on LimitRange writes, not per nomination."""
+        s = self._ns_summaries.get(namespace)
+        if s is None:
+            s = limitrange_mod.summarize(self.limit_ranges.get(namespace, []))
+            self._ns_summaries[namespace] = s
+        return s
+
+    def _validate_workload_resources(self, wl: Workload) -> List[str]:
+        """Nomination-time gate (scheduler.go validateResources +
+        validateLimitRange)."""
+        reasons = limitrange_mod.validate_limits_fit_requests(wl)
+        summary = self._ns_summary(wl.namespace)
+        if summary:
+            for i, ps in enumerate(wl.pod_sets):
+                if ps.template is None:
+                    continue
+                reasons += summary.validate_pod_template(
+                    ps.template, path=f"podSets[{i}].template")
+        return reasons
+
+    def create_admission_check(self, ac: "AdmissionCheck") -> None:
+        errs = webhooks.validate_admission_check(ac)
+        if errs:
+            raise webhooks.ValidationError(errs)
+        self.admission_checks[ac.name] = ac
+
+    def update_admission_check(self, ac: "AdmissionCheck") -> None:
+        old = self.admission_checks.get(ac.name)
+        errs = (webhooks.validate_admission_check_update(ac, old)
+                if old is not None else webhooks.validate_admission_check(ac))
+        if errs:
+            raise webhooks.ValidationError(errs)
+        self.admission_checks[ac.name] = ac
+
+    def update_local_queue(self, lq: LocalQueue) -> None:
+        old = self.cache.local_queues.get(lq.key)
+        errs = (webhooks.validate_local_queue_update(lq, old)
+                if old is not None else webhooks.validate_local_queue(lq))
+        if errs:
+            raise webhooks.ValidationError(errs)
+        self.cache.add_local_queue(lq)
+
     def create_resource_flavor(self, flavor: ResourceFlavor) -> None:
+        errs = webhooks.validate_resource_flavor(flavor)
+        if errs:
+            raise webhooks.ValidationError(errs)
         self.cache.add_or_update_resource_flavor(flavor)
         # Requeue CQs that reference this flavor (the ResourceFlavor
         # reconciler's job in the reference, cache.go:712-723).
@@ -107,19 +195,34 @@ class Framework:
             self.queues.queue_inadmissible_workloads(using)
 
     def create_cluster_queue(self, spec: ClusterQueue) -> None:
+        webhooks.default_cluster_queue(spec)
+        errs = webhooks.validate_cluster_queue(spec)
+        if errs:
+            raise webhooks.ValidationError(errs)
+        self.cluster_queue_specs[spec.name] = spec
         self.cache.add_cluster_queue(spec)
         self.queues.add_cluster_queue(spec, pending=list(self.workloads.values()))
 
     def update_cluster_queue(self, spec: ClusterQueue) -> None:
+        old = self.cluster_queue_specs.get(spec.name)
+        errs = (webhooks.validate_cluster_queue_update(spec, old)
+                if old is not None else webhooks.validate_cluster_queue(spec))
+        if errs:
+            raise webhooks.ValidationError(errs)
+        self.cluster_queue_specs[spec.name] = spec
         self.cache.update_cluster_queue(spec)
         self.queues.update_cluster_queue(spec)
 
     def delete_cluster_queue(self, name: str) -> None:
+        self.cluster_queue_specs.pop(name, None)
         self.cache.delete_cluster_queue(name)
         self.queues.delete_cluster_queue(name)
         self.update_metrics_gauges()
 
     def create_local_queue(self, lq: LocalQueue) -> None:
+        errs = webhooks.validate_local_queue(lq)
+        if errs:
+            raise webhooks.ValidationError(errs)
         self.cache.add_local_queue(lq)
         self.queues.add_local_queue(lq, pending=list(self.workloads.values()))
 
@@ -134,6 +237,16 @@ class Framework:
 
     def submit(self, wl: Workload) -> None:
         """A new pending workload enters the system."""
+        webhooks.default_workload(wl)
+        errs = webhooks.validate_workload(wl)
+        if errs:
+            raise webhooks.ValidationError(errs)
+        # Fold RuntimeClass overhead, LimitRange defaults and limits->
+        # requests into podset requests (workload.AdjustResources; done by
+        # the Workload reconciler on create in the reference,
+        # core/workload_controller.go:408-438).
+        limitrange_mod.adjust_resources(
+            wl, self.limit_ranges.get(wl.namespace, []), self.runtime_classes)
         if wl.priority_class and wl.priority_class in self.priority_classes:
             # Priority resolution from WorkloadPriorityClass
             # (reference: pkg/util/priority).
@@ -149,6 +262,15 @@ class Framework:
                                 reclaimable: Dict[str, int]) -> None:
         """Shrink a workload's held quota as pods complete (KEP-78;
         core/workload_controller.go reclaimable handling)."""
+        # Webhook gate: counts within [0, podset count], non-decreasing while
+        # quota is reserved (workload_webhook.go:375-390).
+        proposed = Workload(
+            name=wl.name, namespace=wl.namespace, queue_name=wl.queue_name,
+            pod_sets=wl.pod_sets, conditions=wl.conditions,
+            admission=wl.admission, reclaimable_pods=dict(reclaimable))
+        errs = webhooks.validate_workload_update(proposed, wl)
+        if errs:
+            raise webhooks.ValidationError(errs)
         was_admitted = self.cache.is_assumed_or_admitted(wl)
         if was_admitted:
             self.cache.delete_workload(wl)

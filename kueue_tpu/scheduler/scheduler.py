@@ -73,6 +73,8 @@ class Scheduler:
                  ordering: Optional[WorkloadOrdering] = None,
                  pods_ready_gate: Optional[Callable[[], bool]] = None,
                  fair_strategies=preemption_mod.DEFAULT_FAIR_STRATEGIES,
+                 workload_validator: Optional[
+                     Callable[[Workload], List[str]]] = None,
                  clock: Callable[[], float] = _time.time):
         self.queues = queues
         self.cache = cache
@@ -86,6 +88,11 @@ class Scheduler:
         # a condvar (cache.go:118-173); this synchronous runtime skips the
         # cycle's admissions and requeues instead.
         self.pods_ready_gate = pods_ready_gate
+        # Per-workload admissibility gate run at nomination time — the
+        # reference validates resource limits and the namespace LimitRange
+        # summary here (scheduler.go:330-340 validateResources/
+        # validateLimitRange); returns reasons, empty == admissible.
+        self.workload_validator = workload_validator or (lambda wl: [])
         self.fair_strategies = tuple(fair_strategies)
         self.clock = clock
         self.metrics = SchedulerMetrics()
@@ -139,7 +146,11 @@ class Scheduler:
                         "Workload namespace doesn't match ClusterQueue selector"
                     e.requeue_reason = RequeueReason.NAMESPACE_MISMATCH
                 else:
-                    solvable.append(e)
+                    reasons = self.workload_validator(wi.obj)
+                    if reasons:
+                        e.inadmissible_msg = "; ".join(reasons)
+                    else:
+                        solvable.append(e)
             entries.append(e)
 
         self._solve(solvable, snapshot)

@@ -1,0 +1,209 @@
+"""LimitRange summarization, validation and workload resource adjustment.
+
+Counterpart of reference pkg/util/limitrange/limitrange.go and
+pkg/workload/resources.go: namespaces can carry LimitRange constraints that
+(a) default container requests/limits and (b) bound what a pod may request.
+Workload podset requests are derived from their pod templates only after
+RuntimeClass overhead, LimitRange defaults and limits->requests defaulting
+have been folded in (AdjustResources, resources.go:102-115), and the
+scheduler rejects workloads whose templates violate the active LimitRange
+summary (scheduler.go validateResources/validateLimitRange analog).
+
+All quantities are canonical integers keyed by resource name.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Mapping, Optional, Sequence
+
+from kueue_tpu.api.types import Container, PodTemplate, Workload
+
+LIMIT_TYPE_POD = "Pod"
+LIMIT_TYPE_CONTAINER = "Container"
+
+
+@dataclass
+class LimitRangeItem:
+    """One constraint row (k8s core/v1 LimitRangeItem subset)."""
+
+    type: str  # Pod | Container
+    max: Dict[str, int] = field(default_factory=dict)
+    min: Dict[str, int] = field(default_factory=dict)
+    default: Dict[str, int] = field(default_factory=dict)  # default limits
+    default_request: Dict[str, int] = field(default_factory=dict)
+
+
+@dataclass
+class LimitRange:
+    name: str = ""
+    namespace: str = "default"
+    items: List[LimitRangeItem] = field(default_factory=list)
+
+
+def _merge_keep_min(a: Dict[str, int], b: Mapping[str, int]) -> Dict[str, int]:
+    out = dict(a)
+    for k, v in b.items():
+        if k not in out or v < out[k]:
+            out[k] = v
+    return out
+
+
+def _merge_keep_max(a: Dict[str, int], b: Mapping[str, int]) -> Dict[str, int]:
+    out = dict(a)
+    for k, v in b.items():
+        if k not in out or v > out[k]:
+            out[k] = v
+    return out
+
+
+def _merge_keep_first(a: Dict[str, int], b: Mapping[str, int]) -> Dict[str, int]:
+    out = dict(a)
+    for k, v in b.items():
+        out.setdefault(k, v)
+    return out
+
+
+class Summary(dict):
+    """limit type -> folded LimitRangeItem (limitrange.go:31-57).
+
+    Max keeps the lowest value across ranges, Min the highest, defaults the
+    first encountered.
+    """
+
+    def validate_pod_template(self, pt: PodTemplate,
+                              path: str = "podSpec") -> List[str]:
+        """ValidatePodSpec (limitrange.go:103-118): container-level bounds on
+        every (init)container, pod-level bounds on the pod total."""
+        reasons: List[str] = []
+        reasons += self._validate_containers(
+            pt.init_containers, f"{path}.initContainers")
+        reasons += self._validate_containers(
+            pt.containers, f"{path}.containers")
+        pod_range = self.get(LIMIT_TYPE_POD)
+        if pod_range is not None:
+            total = pt.total_requests()
+            over = _greater_keys(total, pod_range.max)
+            if over:
+                reasons.append(_violate_max(path, over))
+            under = _greater_keys(pod_range.min, total)
+            if under:
+                reasons.append(_violate_min(path, under))
+        return reasons
+
+    def _validate_containers(self, containers: Sequence[Container],
+                             path: str) -> List[str]:
+        crange = self.get(LIMIT_TYPE_CONTAINER)
+        if crange is None:
+            return []
+        reasons = []
+        for i, c in enumerate(containers):
+            cmin = _merge_keep_min(dict(c.requests), c.limits)
+            cmax = _merge_keep_max(dict(c.requests), c.limits)
+            over = _greater_keys(cmax, crange.max)
+            if over:
+                reasons.append(_violate_max(f"{path}[{i}]", over))
+            under = _greater_keys(crange.min, cmin)
+            if under:
+                reasons.append(_violate_min(f"{path}[{i}]", under))
+        return reasons
+
+
+def _greater_keys(a: Mapping[str, int], b: Mapping[str, int]) -> List[str]:
+    """Keys present in both where a[k] > b[k] (resource.GetGreaterKeys)."""
+    return sorted(k for k, v in a.items() if k in b and v > b[k])
+
+
+def _violate_max(path: str, keys: List[str]) -> str:
+    return f"the requests of {path}[{', '.join(keys)}] exceeds the limits"
+
+
+def _violate_min(path: str, keys: List[str]) -> str:
+    return f"the requests of {path}[{', '.join(keys)}] are less than the limits"
+
+
+def summarize(ranges: Sequence[LimitRange]) -> Summary:
+    """Fold many LimitRanges into one Summary (limitrange.go:37-45)."""
+    out = Summary()
+    for lr in ranges:
+        for item in lr.items:
+            cur = out.get(item.type)
+            if cur is None:
+                cur = LimitRangeItem(type=item.type)
+                out[item.type] = cur
+            cur.max = _merge_keep_min(cur.max, item.max)
+            cur.min = _merge_keep_max(cur.min, item.min)
+            cur.default = _merge_keep_first(cur.default, item.default)
+            cur.default_request = _merge_keep_first(
+                cur.default_request, item.default_request)
+    return out
+
+
+def adjust_resources(
+        wl: Workload,
+        limit_ranges: Sequence[LimitRange] = (),
+        runtime_class_overheads: Optional[Mapping[str, Mapping[str, int]]] = None,
+) -> None:
+    """workload.AdjustResources (resources.go:102-115): for every podset
+    that carries a template, fold in
+
+    1. RuntimeClass pod overhead when the template names a runtime class and
+       has no explicit overhead (handlePodOverhead, resources.go:36-53),
+    2. LimitRange container defaults: default -> limits, defaultRequest ->
+       requests, first-value-wins (handlePodLimitRange, resources.go:57-86),
+    3. limits -> requests defaulting (handleLimitsToRequests, :88-100),
+
+    then recompute the podset's per-pod `requests` from the template.
+    """
+    overheads = runtime_class_overheads or {}
+    summary = summarize(limit_ranges)
+    crange = summary.get(LIMIT_TYPE_CONTAINER)
+    for ps in wl.pod_sets:
+        pt = ps.template
+        if pt is None:
+            continue
+        if pt.runtime_class_name and not pt.overhead:
+            oh = overheads.get(pt.runtime_class_name)
+            if oh is not None:
+                pt.overhead = dict(oh)
+        for c in list(pt.init_containers) + list(pt.containers):
+            if crange is not None:
+                c.limits = _merge_keep_first(c.limits, crange.default)
+                c.requests = _merge_keep_first(
+                    c.requests, crange.default_request)
+            c.requests = _merge_keep_first(c.requests, c.limits)
+        ps.requests = pt.total_requests()
+
+
+def validate_workload_against(
+        wl: Workload, limit_ranges: Sequence[LimitRange]) -> List[str]:
+    """The scheduler-side admission gate (scheduler.go nominate ->
+    validateLimitRange): reasons why the workload's templates violate the
+    namespace's LimitRange summary; empty means admissible."""
+    if not limit_ranges:
+        return []
+    summary = summarize(limit_ranges)
+    reasons: List[str] = []
+    for i, ps in enumerate(wl.pod_sets):
+        if ps.template is None:
+            continue
+        reasons += summary.validate_pod_template(
+            ps.template, path=f"podSets[{i}].template")
+    return reasons
+
+
+def validate_limits_fit_requests(wl: Workload) -> List[str]:
+    """scheduler.go validateResources: requests must not exceed limits."""
+    reasons: List[str] = []
+    for i, ps in enumerate(wl.pod_sets):
+        if ps.template is None:
+            continue
+        for kind, containers in (("initContainers", ps.template.init_containers),
+                                 ("containers", ps.template.containers)):
+            for j, c in enumerate(containers):
+                bad = _greater_keys(c.requests, c.limits)
+                if bad:
+                    reasons.append(
+                        f"requests exceed limits in podSets[{i}].template."
+                        f"{kind}[{j}]: {', '.join(bad)}")
+    return reasons

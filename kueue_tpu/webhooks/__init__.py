@@ -1,0 +1,42 @@
+"""Admission webhooks: defaulting + validation for every API object kind.
+
+Counterpart of reference pkg/webhooks/ — in the reference these run as
+apiserver admission webhooks; here they are pure functions invoked by the
+runtime (and any API front end) before an object write is accepted.
+
+`validate_*` functions return a list of human-readable error strings
+(field-path prefixed, like field.ErrorList); empty list == valid.
+`default_*` functions mutate the object in place and return it.
+"""
+
+from kueue_tpu.webhooks.defaulting import (
+    default_cluster_queue,
+    default_workload,
+)
+from kueue_tpu.webhooks.validation import (
+    ValidationError,
+    validate_admission_check,
+    validate_admission_check_update,
+    validate_cluster_queue,
+    validate_cluster_queue_update,
+    validate_local_queue,
+    validate_local_queue_update,
+    validate_resource_flavor,
+    validate_workload,
+    validate_workload_update,
+)
+
+__all__ = [
+    "ValidationError",
+    "default_cluster_queue",
+    "default_workload",
+    "validate_admission_check",
+    "validate_admission_check_update",
+    "validate_cluster_queue",
+    "validate_cluster_queue_update",
+    "validate_local_queue",
+    "validate_local_queue_update",
+    "validate_resource_flavor",
+    "validate_workload",
+    "validate_workload_update",
+]

@@ -1,0 +1,31 @@
+"""Defaulting webhooks (reference: pkg/webhooks/*_webhook.go Default()).
+
+Our dataclasses already carry most defaults in their field initializers;
+these functions cover the data-dependent cases.
+"""
+
+from __future__ import annotations
+
+from kueue_tpu import features
+from kueue_tpu.api.types import ClusterQueue, Workload
+
+DEFAULT_POD_SET_NAME = "main"
+
+
+def default_workload(wl: Workload) -> Workload:
+    """workload_webhook.go:58-81: name a lone unnamed podset "main"; drop
+    minCount when PartialAdmission is gated off."""
+    if len(wl.pod_sets) == 1 and not wl.pod_sets[0].name:
+        wl.pod_sets[0].name = DEFAULT_POD_SET_NAME
+    if not features.enabled(features.PARTIAL_ADMISSION):
+        for ps in wl.pod_sets:
+            ps.min_count = None
+    return wl
+
+
+def default_cluster_queue(cq: ClusterQueue) -> ClusterQueue:
+    """clusterqueue_webhook.go:60-85. Preemption / borrowWithinCohort /
+    flavorFungibility defaults are carried by the dataclass field defaults
+    (api/types.py); nothing data-dependent remains, but the hook exists so
+    an API front end has a single defaulting entry point per kind."""
+    return cq

@@ -1,0 +1,276 @@
+"""Validation rules for API objects (reference: pkg/webhooks/*_webhook.go).
+
+Each rule mirrors the reference's semantics; returns are lists of
+"field.path: message" strings so callers can surface all violations at once.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import List, Optional
+
+from kueue_tpu.api.types import (
+    AdmissionCheck,
+    BorrowWithinCohortPolicy,
+    ClusterQueue,
+    LocalQueue,
+    PreemptionPolicy,
+    QueueingStrategy,
+    ResourceFlavor,
+    Workload,
+)
+
+
+class ValidationError(ValueError):
+    """Raised by the runtime when a webhook rejects an object."""
+
+    def __init__(self, errs: List[str]):
+        super().__init__("; ".join(errs))
+        self.errors = errs
+
+
+_DNS1123_LABEL = re.compile(r"^[a-z0-9]([-a-z0-9]*[a-z0-9])?$")
+_QUALIFIED_NAME = re.compile(
+    r"^([a-z0-9A-Z]([-a-z0-9A-Z_.]*[a-z0-9A-Z])?/)?"
+    r"[a-z0-9A-Z]([-a-z0-9A-Z_.]*[a-z0-9A-Z])?$")
+
+_TAINT_EFFECTS = ("NoSchedule", "PreferNoSchedule", "NoExecute")
+_PREEMPTION_POLICIES = (
+    PreemptionPolicy.NEVER, PreemptionPolicy.LOWER_PRIORITY,
+    PreemptionPolicy.LOWER_OR_NEWER_EQUAL_PRIORITY, PreemptionPolicy.ANY)
+
+
+def is_dns1123_label(value: str) -> bool:
+    return len(value) <= 63 and bool(_DNS1123_LABEL.match(value))
+
+
+def is_dns1123_subdomain(value: str) -> bool:
+    return (len(value) <= 253
+            and all(is_dns1123_label(part) for part in value.split(".")))
+
+
+def _name_reference(name: str, path: str) -> List[str]:
+    if not is_dns1123_subdomain(name):
+        return [f"{path}: {name!r} must be a DNS-1123 subdomain"]
+    return []
+
+
+# ---------------------------------------------------------------------------
+# ClusterQueue (clusterqueue_webhook.go:116-236)
+# ---------------------------------------------------------------------------
+
+
+def validate_cluster_queue(cq: ClusterQueue) -> List[str]:
+    errs: List[str] = []
+    if cq.cohort:
+        errs += _name_reference(cq.cohort, "spec.cohort")
+    if cq.queueing_strategy not in (
+            QueueingStrategy.STRICT_FIFO, QueueingStrategy.BEST_EFFORT_FIFO):
+        errs.append(f"spec.queueingStrategy: unknown {cq.queueing_strategy!r}")
+    errs += _validate_resource_groups(cq)
+    errs += _validate_preemption(cq)
+    return errs
+
+
+def _validate_preemption(cq: ClusterQueue) -> List[str]:
+    errs: List[str] = []
+    p = cq.preemption
+    if (p.reclaim_within_cohort == PreemptionPolicy.NEVER
+            and p.borrow_within_cohort is not None
+            and p.borrow_within_cohort.policy != BorrowWithinCohortPolicy.NEVER):
+        errs.append("spec.preemption: reclaimWithinCohort=Never and "
+                    "borrowWithinCohort.Policy!=Never")
+    for fld, val in (("withinClusterQueue", p.within_cluster_queue),
+                     ("reclaimWithinCohort", p.reclaim_within_cohort)):
+        if val not in _PREEMPTION_POLICIES:
+            errs.append(f"spec.preemption.{fld}: unknown policy {val!r}")
+    return errs
+
+
+def _validate_resource_groups(cq: ClusterQueue) -> List[str]:
+    errs: List[str] = []
+    seen_resources: set = set()
+    seen_flavors: set = set()
+    for gi, rg in enumerate(cq.resource_groups):
+        path = f"spec.resourceGroups[{gi}]"
+        for res in rg.covered_resources:
+            if not _QUALIFIED_NAME.match(res):
+                errs.append(f"{path}.coveredResources: invalid name {res!r}")
+            if res in seen_resources:
+                errs.append(f"{path}.coveredResources: duplicate {res!r}")
+            seen_resources.add(res)
+        for fi, fq in enumerate(rg.flavors):
+            fpath = f"{path}.flavors[{fi}]"
+            if fq.name in seen_flavors:
+                errs.append(f"{fpath}.name: duplicate flavor {fq.name!r}")
+            seen_flavors.add(fq.name)
+            errs += _name_reference(fq.name, f"{fpath}.name")
+            # Quotas must cover exactly the covered resources, in order
+            # (clusterqueue_webhook.go:182-195).
+            quota_names = tuple(r for r, _ in fq.resources)
+            if quota_names != tuple(rg.covered_resources):
+                errs.append(f"{fpath}.resources: must match coveredResources "
+                            f"{list(rg.covered_resources)}")
+            for rname, quota in fq.resources:
+                qpath = f"{fpath}.resources[{rname}]"
+                if quota.nominal < 0:
+                    errs.append(f"{qpath}.nominalQuota: must be >= 0")
+                if quota.borrowing_limit is not None:
+                    if quota.borrowing_limit < 0:
+                        errs.append(f"{qpath}.borrowingLimit: must be >= 0")
+                    if not cq.cohort:
+                        errs.append(f"{qpath}.borrowingLimit: must be empty "
+                                    "when cohort is empty")
+                if quota.lending_limit is not None:
+                    if quota.lending_limit < 0:
+                        errs.append(f"{qpath}.lendingLimit: must be >= 0")
+                    if not cq.cohort:
+                        errs.append(f"{qpath}.lendingLimit: must be empty "
+                                    "when cohort is empty")
+                    elif quota.lending_limit > quota.nominal:
+                        errs.append(f"{qpath}.lendingLimit: must be <= "
+                                    "nominalQuota")
+    return errs
+
+
+def validate_cluster_queue_update(new: ClusterQueue,
+                                  old: ClusterQueue) -> List[str]:
+    errs = validate_cluster_queue(new)
+    if new.queueing_strategy != old.queueing_strategy:
+        errs.append("spec.queueingStrategy: field is immutable")
+    return errs
+
+
+# ---------------------------------------------------------------------------
+# Workload (workload_webhook.go:108-390)
+# ---------------------------------------------------------------------------
+
+
+def validate_workload(wl: Workload) -> List[str]:
+    errs: List[str] = []
+    variable_count = 0
+    names = set()
+    for i, ps in enumerate(wl.pod_sets):
+        path = f"spec.podSets[{i}]"
+        if not is_dns1123_label(ps.name):
+            errs.append(f"{path}.name: {ps.name!r} must be a DNS-1123 label")
+        if ps.name in names:
+            errs.append(f"{path}.name: duplicate podset {ps.name!r}")
+        names.add(ps.name)
+        if ps.count < 1:
+            errs.append(f"{path}.count: must be >= 1")
+        if ps.min_count is not None:
+            variable_count += 1
+            if not 0 < ps.min_count <= ps.count:
+                errs.append(f"{path}.minCount: must be in [1, count]")
+    if variable_count > 1:
+        errs.append("spec.podSets: at most one podSet can use minCount")
+    if wl.priority_class:
+        errs += _name_reference(wl.priority_class, "spec.priorityClassName")
+    if wl.queue_name:
+        errs += _name_reference(wl.queue_name, "spec.queueName")
+    errs += _validate_reclaimable(wl)
+    if wl.has_quota_reservation and wl.admission is None:
+        errs.append("status.admission: must be set when QuotaReserved")
+    if wl.admission is not None:
+        psa_names = [a.name for a in wl.admission.pod_set_assignments]
+        if sorted(psa_names) != sorted(ps.name for ps in wl.pod_sets):
+            errs.append("status.admission.podSetAssignments: must have "
+                        "assignments for all podsets")
+    return errs
+
+
+def _validate_reclaimable(wl: Workload) -> List[str]:
+    errs = []
+    by_name = {ps.name: ps for ps in wl.pod_sets}
+    for name, count in wl.reclaimable_pods.items():
+        ps = by_name.get(name)
+        if ps is None:
+            errs.append(f"status.reclaimablePods[{name}]: no such podset")
+        elif not 0 <= count <= ps.count:
+            errs.append(f"status.reclaimablePods[{name}].count: must be in "
+                        f"[0, {ps.count}]")
+    return errs
+
+
+def validate_workload_update(new: Workload, old: Workload) -> List[str]:
+    errs = validate_workload(new)
+    if old.has_quota_reservation:
+        if [_podset_sig(ps) for ps in new.pod_sets] != \
+                [_podset_sig(ps) for ps in old.pod_sets]:
+            errs.append("spec.podSets: field is immutable after quota "
+                        "reservation")
+        if new.priority_class != old.priority_class:
+            errs.append("spec.priorityClassName: field is immutable after "
+                        "quota reservation")
+    if new.has_quota_reservation and old.has_quota_reservation:
+        if new.queue_name != old.queue_name:
+            errs.append("spec.queueName: field is immutable while quota is "
+                        "reserved")
+        # Reclaimable counts can only grow while admitted
+        # (workload_webhook.go:375-390).
+        for name, old_count in old.reclaimable_pods.items():
+            if new.reclaimable_pods.get(name, 0) < old_count:
+                errs.append(f"status.reclaimablePods[{name}].count: cannot "
+                            f"be less than {old_count}")
+    if (new.admission is not None and old.admission is not None
+            and new.admission != old.admission):
+        errs.append("status.admission: field is immutable once set")
+    return errs
+
+
+def _podset_sig(ps) -> tuple:
+    return (ps.name, ps.count, tuple(sorted(ps.requests.items())),
+            ps.min_count)
+
+
+# ---------------------------------------------------------------------------
+# LocalQueue / ResourceFlavor / AdmissionCheck
+# ---------------------------------------------------------------------------
+
+
+def validate_local_queue(lq: LocalQueue) -> List[str]:
+    return _name_reference(lq.cluster_queue, "spec.clusterQueue")
+
+
+def validate_local_queue_update(new: LocalQueue, old: LocalQueue) -> List[str]:
+    errs = validate_local_queue(new)
+    if new.cluster_queue != old.cluster_queue:
+        errs.append("spec.clusterQueue: field is immutable")
+    return errs
+
+
+def validate_resource_flavor(rf: ResourceFlavor) -> List[str]:
+    errs: List[str] = []
+    for k, v in rf.node_labels:
+        if not _QUALIFIED_NAME.match(k):
+            errs.append(f"spec.nodeLabels: invalid key {k!r}")
+    for i, taint in enumerate(rf.node_taints):
+        path = f"spec.nodeTaints[{i}]"
+        if not taint.key or not _QUALIFIED_NAME.match(taint.key):
+            errs.append(f"{path}.key: invalid or empty")
+        if taint.effect not in _TAINT_EFFECTS:
+            errs.append(f"{path}.effect: must be one of "
+                        f"{list(_TAINT_EFFECTS)}")
+    return errs
+
+
+def validate_admission_check(ac: AdmissionCheck) -> List[str]:
+    errs: List[str] = []
+    if not ac.controller_name:
+        errs.append("spec.controllerName: must not be empty")
+    if ac.parameters is not None:
+        api_group, kind, name = ac.parameters
+        if not kind:
+            errs.append("spec.parameters.kind: must not be empty")
+        if not name or not is_dns1123_subdomain(name):
+            errs.append("spec.parameters.name: invalid")
+    return errs
+
+
+def validate_admission_check_update(new: AdmissionCheck,
+                                    old: AdmissionCheck) -> List[str]:
+    errs = validate_admission_check(new)
+    if new.controller_name != old.controller_name:
+        errs.append("spec.controllerName: field is immutable")
+    return errs

@@ -1,0 +1,207 @@
+"""LimitRange summarization + AdjustResources tests.
+
+Mirrors reference pkg/util/limitrange/limitrange_test.go and the
+AdjustResources pipeline in pkg/workload/resources.go.
+"""
+
+from kueue_tpu.api.resources import resource_value
+from kueue_tpu.api.types import (
+    ClusterQueue,
+    Container,
+    FlavorQuotas,
+    LocalQueue,
+    PodSet,
+    PodTemplate,
+    ResourceFlavor,
+    ResourceGroup,
+    Workload,
+)
+from kueue_tpu.controllers.runtime import Framework
+from kueue_tpu.utils.limitrange import (
+    LimitRange,
+    LimitRangeItem,
+    adjust_resources,
+    summarize,
+    validate_limits_fit_requests,
+    validate_workload_against,
+)
+
+CPU = "cpu"
+MEM = "memory"
+
+
+def cpuq(v):
+    return resource_value(CPU, v)
+
+
+class TestSummarize:
+    def test_max_keeps_min_min_keeps_max_defaults_first(self):
+        r1 = LimitRange(items=[LimitRangeItem(
+            type="Container", max={CPU: 4000}, min={CPU: 100},
+            default={CPU: 2000}, default_request={CPU: 500})])
+        r2 = LimitRange(items=[LimitRangeItem(
+            type="Container", max={CPU: 3000}, min={CPU: 200},
+            default={CPU: 1000}, default_request={CPU: 250})])
+        s = summarize([r1, r2])
+        item = s["Container"]
+        assert item.max[CPU] == 3000      # lowest max wins
+        assert item.min[CPU] == 200       # highest min wins
+        assert item.default[CPU] == 2000  # first default wins
+        assert item.default_request[CPU] == 500
+
+
+class TestTotalRequests:
+    def test_max_of_init_and_sum_plus_overhead(self):
+        pt = PodTemplate(
+            containers=[Container.make(requests={CPU: 1}),
+                        Container.make(requests={CPU: 1})],
+            init_containers=[Container.make(requests={CPU: 5})],
+            overhead={CPU: cpuq("100m")})
+        total = pt.total_requests()
+        # init container (5) > sum of main (2); overhead added on top.
+        assert total[CPU] == cpuq(5) + cpuq("100m")
+
+
+class TestAdjustResources:
+    def test_limits_default_to_requests(self):
+        pt = PodTemplate(containers=[Container.make(limits={CPU: 2})])
+        wl = Workload(name="w", pod_sets=[
+            PodSet(name="main", count=1, template=pt)])
+        adjust_resources(wl)
+        assert wl.pod_sets[0].requests[CPU] == cpuq(2)
+
+    def test_limitrange_defaults_applied(self):
+        pt = PodTemplate(containers=[Container.make()])
+        wl = Workload(name="w", pod_sets=[
+            PodSet(name="main", count=1, template=pt)])
+        lr = LimitRange(items=[LimitRangeItem(
+            type="Container", default_request={CPU: cpuq(1)})])
+        adjust_resources(wl, [lr])
+        assert wl.pod_sets[0].requests[CPU] == cpuq(1)
+
+    def test_runtime_class_overhead(self):
+        pt = PodTemplate(containers=[Container.make(requests={CPU: 1})],
+                         runtime_class_name="gvisor")
+        wl = Workload(name="w", pod_sets=[
+            PodSet(name="main", count=1, template=pt)])
+        adjust_resources(wl, [], {"gvisor": {CPU: cpuq("250m")}})
+        assert wl.pod_sets[0].requests[CPU] == cpuq(1) + cpuq("250m")
+
+    def test_explicit_requests_win_over_defaults(self):
+        pt = PodTemplate(containers=[
+            Container.make(requests={CPU: 3}, limits={CPU: 4})])
+        wl = Workload(name="w", pod_sets=[
+            PodSet(name="main", count=1, template=pt)])
+        lr = LimitRange(items=[LimitRangeItem(
+            type="Container", default_request={CPU: cpuq(1)})])
+        adjust_resources(wl, [lr])
+        assert wl.pod_sets[0].requests[CPU] == cpuq(3)
+
+
+class TestValidation:
+    def test_container_over_max(self):
+        pt = PodTemplate(containers=[Container.make(requests={CPU: 8})])
+        wl = Workload(name="w", pod_sets=[
+            PodSet(name="main", count=1, requests={CPU: cpuq(8)},
+                   template=pt)])
+        lr = LimitRange(items=[LimitRangeItem(
+            type="Container", max={CPU: cpuq(4)})])
+        reasons = validate_workload_against(wl, [lr])
+        assert reasons and "exceeds" in reasons[0]
+
+    def test_pod_total_under_min(self):
+        pt = PodTemplate(containers=[Container.make(requests={CPU: 1})])
+        wl = Workload(name="w", pod_sets=[
+            PodSet(name="main", count=1, requests={CPU: cpuq(1)},
+                   template=pt)])
+        lr = LimitRange(items=[LimitRangeItem(
+            type="Pod", min={CPU: cpuq(2)})])
+        reasons = validate_workload_against(wl, [lr])
+        assert reasons and "less than" in reasons[0]
+
+    def test_requests_over_limits(self):
+        pt = PodTemplate(containers=[
+            Container.make(requests={CPU: 4}, limits={CPU: 2})])
+        wl = Workload(name="w", pod_sets=[
+            PodSet(name="main", count=1, requests={CPU: cpuq(4)},
+                   template=pt)])
+        reasons = validate_limits_fit_requests(wl)
+        assert reasons and "exceed limits" in reasons[0]
+
+
+class TestEndToEnd:
+    """The scheduler parks LimitRange-violating workloads as inadmissible
+    (scheduler.go nominate -> validateLimitRange)."""
+
+    def _fw(self):
+        fw = Framework()
+        fw.create_resource_flavor(ResourceFlavor.make("default"))
+        fw.create_cluster_queue(ClusterQueue(
+            name="cq",
+            resource_groups=(ResourceGroup(
+                covered_resources=(CPU,),
+                flavors=(FlavorQuotas.make("default", cpu=10),)),)))
+        fw.create_local_queue(LocalQueue(
+            name="lq", namespace="default", cluster_queue="cq"))
+        return fw
+
+    def test_violating_workload_not_admitted(self):
+        fw = self._fw()
+        fw.create_limit_range(LimitRange(
+            namespace="default",
+            items=[LimitRangeItem(type="Container", max={CPU: cpuq(1)})]))
+        pt = PodTemplate(containers=[Container.make(requests={CPU: 2})])
+        wl = Workload(name="big", queue_name="lq",
+                      pod_sets=[PodSet(name="main", count=1, template=pt)])
+        fw.submit(wl)
+        fw.run_until_settled()
+        assert not wl.has_quota_reservation
+        assert fw.pending_workloads("cq") == 1
+
+    def test_late_limit_range_readjusts_pending_workloads(self):
+        # LimitRange created AFTER submit must re-run AdjustResources on
+        # pending workloads (the reference's LimitRange watch handler).
+        fw = self._fw()
+        pt = PodTemplate(containers=[Container.make()])
+        wl = Workload(name="late", queue_name="lq",
+                      pod_sets=[PodSet(name="main", count=1, template=pt)])
+        fw.submit(wl)
+        fw.create_limit_range(LimitRange(
+            namespace="default",
+            items=[LimitRangeItem(type="Container",
+                                  default_request={CPU: cpuq(2)})]))
+        fw.run_until_settled()
+        assert wl.has_quota_reservation
+        assert wl.admission.pod_set_assignments[0].resource_usage[CPU] \
+            == cpuq(2)
+
+    def test_reclaimable_update_rejected_out_of_range(self):
+        import pytest
+
+        from kueue_tpu import webhooks
+        fw = self._fw()
+        wl = Workload(name="w", queue_name="lq",
+                      pod_sets=[PodSet.make("main", 2, cpu=1)])
+        fw.submit(wl)
+        fw.run_until_settled()
+        assert wl.has_quota_reservation
+        with pytest.raises(webhooks.ValidationError):
+            fw.update_reclaimable_pods(wl, {"main": 5})
+        fw.update_reclaimable_pods(wl, {"main": 1})
+        with pytest.raises(webhooks.ValidationError):
+            fw.update_reclaimable_pods(wl, {"main": 0})  # shrink while reserved
+
+    def test_conforming_workload_admitted_with_defaults(self):
+        fw = self._fw()
+        fw.create_limit_range(LimitRange(
+            namespace="default",
+            items=[LimitRangeItem(type="Container",
+                                  default_request={CPU: cpuq(1)})]))
+        pt = PodTemplate(containers=[Container.make()])
+        wl = Workload(name="defaulted", queue_name="lq",
+                      pod_sets=[PodSet(name="main", count=1, template=pt)])
+        fw.submit(wl)
+        fw.run_until_settled()
+        assert wl.has_quota_reservation
+        assert wl.admission.pod_set_assignments[0].resource_usage[CPU] \
+            == cpuq(1)

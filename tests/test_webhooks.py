@@ -1,0 +1,181 @@
+"""Webhook defaulting + validation tests.
+
+Mirrors the reference's table-driven webhook tests
+(pkg/webhooks/*_webhook_test.go) at the rule level.
+"""
+
+import pytest
+
+from kueue_tpu import webhooks
+from kueue_tpu.api.types import (
+    AdmissionCheck,
+    BorrowWithinCohort,
+    ClusterQueue,
+    ClusterQueuePreemption,
+    FlavorQuotas,
+    LocalQueue,
+    PodSet,
+    ResourceFlavor,
+    ResourceGroup,
+    Taint,
+    Workload,
+)
+from kueue_tpu.controllers.runtime import Framework
+
+
+def make_cq(name="cq", cohort="", **kw):
+    return ClusterQueue(
+        name=name, cohort=cohort,
+        resource_groups=(ResourceGroup(
+            covered_resources=("cpu",),
+            flavors=(FlavorQuotas.make("default", cpu=10),)),),
+        **kw)
+
+
+class TestClusterQueueValidation:
+    def test_valid(self):
+        assert webhooks.validate_cluster_queue(make_cq()) == []
+
+    def test_borrowing_limit_requires_cohort(self):
+        cq = ClusterQueue(
+            name="cq",
+            resource_groups=(ResourceGroup(
+                covered_resources=("cpu",),
+                flavors=(FlavorQuotas.make("default", cpu=(10, 5)),)),))
+        errs = webhooks.validate_cluster_queue(cq)
+        assert any("borrowingLimit" in e and "cohort" in e for e in errs)
+
+    def test_lending_limit_exceeds_nominal(self):
+        cq = ClusterQueue(
+            name="cq", cohort="team",
+            resource_groups=(ResourceGroup(
+                covered_resources=("cpu",),
+                flavors=(FlavorQuotas.make("default", cpu=(10, None, 20)),)),))
+        errs = webhooks.validate_cluster_queue(cq)
+        assert any("lendingLimit" in e and "nominalQuota" in e for e in errs)
+
+    def test_flavor_resources_must_match_covered(self):
+        cq = ClusterQueue(
+            name="cq",
+            resource_groups=(ResourceGroup(
+                covered_resources=("cpu", "memory"),
+                flavors=(FlavorQuotas.make("default", cpu=10),)),))
+        errs = webhooks.validate_cluster_queue(cq)
+        assert any("coveredResources" in e for e in errs)
+
+    def test_duplicate_flavor(self):
+        cq = ClusterQueue(
+            name="cq",
+            resource_groups=(
+                ResourceGroup(covered_resources=("cpu",),
+                              flavors=(FlavorQuotas.make("f1", cpu=10),)),
+                ResourceGroup(covered_resources=("memory",),
+                              flavors=(FlavorQuotas.make("f1", memory=10),)),
+            ))
+        errs = webhooks.validate_cluster_queue(cq)
+        assert any("duplicate flavor" in e for e in errs)
+
+    def test_reclaim_never_with_borrow_within_cohort(self):
+        cq = make_cq(cohort="team", preemption=ClusterQueuePreemption(
+            reclaim_within_cohort="Never",
+            borrow_within_cohort=BorrowWithinCohort(policy="LowerPriority")))
+        errs = webhooks.validate_cluster_queue(cq)
+        assert any("borrowWithinCohort" in e for e in errs)
+
+    def test_queueing_strategy_immutable(self):
+        old = make_cq()
+        new = make_cq(queueing_strategy="StrictFIFO")
+        errs = webhooks.validate_cluster_queue_update(new, old)
+        assert any("queueingStrategy" in e and "immutable" in e for e in errs)
+
+    def test_framework_rejects_invalid(self):
+        fw = Framework()
+        with pytest.raises(webhooks.ValidationError):
+            fw.create_cluster_queue(ClusterQueue(
+                name="cq",
+                resource_groups=(ResourceGroup(
+                    covered_resources=("cpu",),
+                    flavors=(FlavorQuotas.make("default", cpu=(10, 5)),)),)))
+
+
+class TestWorkloadValidation:
+    def test_valid(self):
+        wl = Workload(name="w", pod_sets=[PodSet.make("main", 2, cpu=1)])
+        assert webhooks.validate_workload(wl) == []
+
+    def test_default_podset_name(self):
+        wl = Workload(name="w", pod_sets=[PodSet.make("", 1, cpu=1)])
+        webhooks.default_workload(wl)
+        assert wl.pod_sets[0].name == "main"
+
+    def test_at_most_one_variable_count_podset(self):
+        wl = Workload(name="w", pod_sets=[
+            PodSet.make("a", 4, min_count=1, cpu=1),
+            PodSet.make("b", 4, min_count=2, cpu=1)])
+        errs = webhooks.validate_workload(wl)
+        assert any("minCount" in e for e in errs)
+
+    def test_invalid_podset_name(self):
+        wl = Workload(name="w", pod_sets=[PodSet.make("Main_Set", 1, cpu=1)])
+        errs = webhooks.validate_workload(wl)
+        assert any("DNS-1123" in e for e in errs)
+
+    def test_count_minimum(self):
+        wl = Workload(name="w", pod_sets=[PodSet.make("main", 0, cpu=1)])
+        errs = webhooks.validate_workload(wl)
+        assert any("count" in e for e in errs)
+
+    def test_reclaimable_bounds(self):
+        wl = Workload(name="w", pod_sets=[PodSet.make("main", 2, cpu=1)])
+        wl.reclaimable_pods = {"main": 3}
+        errs = webhooks.validate_workload(wl)
+        assert any("reclaimablePods" in e for e in errs)
+
+    def test_podsets_immutable_after_reservation(self):
+        old = Workload(name="w", pod_sets=[PodSet.make("main", 2, cpu=1)])
+        old.set_condition("QuotaReserved", True)
+        new = Workload(name="w", pod_sets=[PodSet.make("main", 3, cpu=1)])
+        new.set_condition("QuotaReserved", True)
+        errs = webhooks.validate_workload_update(new, old)
+        assert any("podSets" in e and "immutable" in e for e in errs)
+
+    def test_reclaimable_cannot_shrink_while_reserved(self):
+        old = Workload(name="w", pod_sets=[PodSet.make("main", 4, cpu=1)])
+        old.set_condition("QuotaReserved", True)
+        old.reclaimable_pods = {"main": 2}
+        new = Workload(name="w", pod_sets=[PodSet.make("main", 4, cpu=1)])
+        new.set_condition("QuotaReserved", True)
+        new.reclaimable_pods = {"main": 1}
+        errs = webhooks.validate_workload_update(new, old)
+        assert any("cannot be less" in e for e in errs)
+
+
+class TestOtherKinds:
+    def test_local_queue_cq_immutable(self):
+        old = LocalQueue(name="lq", namespace="default", cluster_queue="a")
+        new = LocalQueue(name="lq", namespace="default", cluster_queue="b")
+        errs = webhooks.validate_local_queue_update(new, old)
+        assert any("immutable" in e for e in errs)
+
+    def test_resource_flavor_taint_effect(self):
+        rf = ResourceFlavor.make(
+            "f", node_taints=[Taint(key="gpu", effect="Sometimes")])
+        errs = webhooks.validate_resource_flavor(rf)
+        assert any("effect" in e for e in errs)
+
+    def test_resource_flavor_valid(self):
+        rf = ResourceFlavor.make(
+            "f", node_labels={"cloud/zone": "us-1"},
+            node_taints=[Taint(key="gpu", value="true", effect="NoSchedule")])
+        assert webhooks.validate_resource_flavor(rf) == []
+
+    def test_admission_check_controller_required(self):
+        ac = AdmissionCheck(name="ac", controller_name="")
+        errs = webhooks.validate_admission_check(ac)
+        assert any("controllerName" in e for e in errs)
+
+    def test_admission_check_controller_immutable(self):
+        old = AdmissionCheck(name="ac", controller_name="a")
+        new = AdmissionCheck(name="ac", controller_name="b")
+        errs = webhooks.validate_admission_check_update(new, old)
+        assert any("immutable" in e for e in errs)
