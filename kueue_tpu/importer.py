@@ -1,0 +1,251 @@
+"""Importer: adopt pre-existing running pods into the queueing system.
+
+Counterpart of reference cmd/importer/: pods already running outside the
+framework's control are mapped to LocalQueues (label value -> queue mapping,
+cmd/importer/README.md), checked (queue/CQ/flavor/priority-class existence,
+cmd/importer/pod/check.go:32-75), then imported (cmd/importer/pod/import.go):
+each pod becomes a single-PodSet Workload admitted *directly* into the first
+flavor of its ClusterQueue's first resource group — bypassing the scheduler,
+since the pod is already running and its capacity is already consumed.
+
+Usable as a library (`check`, `import_pods`) or a CLI
+(`python -m kueue_tpu.importer --setup cluster.json --pods pods.json ...`).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from dataclasses import dataclass, field
+from typing import Dict, List, Mapping, Optional, Sequence, Tuple
+
+from kueue_tpu.api.resources import resource_value
+from kueue_tpu.api.types import (
+    Admission,
+    CONDITION_ADMITTED,
+    CONDITION_QUOTA_RESERVED,
+    PodSet,
+    PodSetAssignment,
+    Workload,
+)
+
+
+@dataclass
+class ImportPod:
+    """A pre-existing pod to adopt (corev1.Pod subset)."""
+
+    name: str
+    namespace: str = "default"
+    labels: Dict[str, str] = field(default_factory=dict)
+    requests: Dict[str, object] = field(default_factory=dict)
+    priority_class: str = ""
+
+
+@dataclass
+class ImportSummary:
+    """util.ConcurrentProcessPod's tally (cmd/importer/util/util.go)."""
+
+    total: int = 0
+    imported: int = 0
+    skipped: int = 0
+    failed: int = 0
+    errors: List[str] = field(default_factory=list)
+
+    def ok(self) -> bool:
+        return self.failed == 0
+
+
+def _map_queue(pod: ImportPod, queue_label: str,
+               mapping: Mapping[str, str]) -> Optional[str]:
+    """label value -> LocalQueue name (simple mapping, importer README)."""
+    value = pod.labels.get(queue_label)
+    if value is None:
+        return None
+    return mapping.get(value)
+
+
+def _resolve(fw, pod: ImportPod, queue_label: str,
+             mapping: Mapping[str, str]) -> Tuple[Optional[str], Optional[str],
+                                                  Optional[str], int, str]:
+    """Returns (lq_name, cq_name, flavor, priority, error)."""
+    lq_name = _map_queue(pod, queue_label, mapping)
+    if lq_name is None:
+        return None, None, None, 0, "skip"  # no mapping -> skipped
+    lq = fw.cache.local_queues.get(f"{pod.namespace}/{lq_name}")
+    if lq is None:
+        return lq_name, None, None, 0, f"LocalQueue {lq_name} not found"
+    cq = fw.cache.cluster_queues.get(lq.cluster_queue)
+    if cq is None:
+        return lq_name, lq.cluster_queue, None, 0, \
+            f"ClusterQueue {lq.cluster_queue} not found"
+    if not cq.resource_groups:
+        return lq_name, cq.name, None, 0, \
+            f"ClusterQueue {cq.name} has no resource groups"
+    rg = cq.resource_groups[0]
+    if not rg.flavors:
+        return lq_name, cq.name, None, 0, \
+            f"ClusterQueue {cq.name} has no flavors"
+    flavor = rg.flavors[0].name
+    if flavor not in fw.cache.resource_flavors:
+        return lq_name, cq.name, flavor, 0, \
+            f"ResourceFlavor {flavor} not found"
+    priority = 0
+    if pod.priority_class:
+        pc = fw.priority_classes.get(pod.priority_class)
+        if pc is None:
+            return lq_name, cq.name, flavor, 0, \
+                f"priority class {pod.priority_class} not found"
+        priority = pc.value
+    return lq_name, cq.name, flavor, priority, ""
+
+
+def check(fw, pods: Sequence[ImportPod], queue_label: str,
+          mapping: Mapping[str, str]) -> ImportSummary:
+    """The pre-import validation pass (cmd/importer/pod/check.go)."""
+    summary = ImportSummary(total=len(pods))
+    for pod in pods:
+        _, _, _, _, err = _resolve(fw, pod, queue_label, mapping)
+        if err == "skip":
+            summary.skipped += 1
+        elif err:
+            summary.failed += 1
+            summary.errors.append(f"{pod.namespace}/{pod.name}: {err}")
+    return summary
+
+
+def import_pods(fw, pods: Sequence[ImportPod], queue_label: str,
+                mapping: Mapping[str, str],
+                add_labels: Optional[Mapping[str, str]] = None,
+                ) -> ImportSummary:
+    """Adopt the pods (cmd/importer/pod/import.go): per pod, create a
+    Workload with its requests, admit it directly (Imported reason) into
+    the first flavor, and account its usage in the cache."""
+    summary = ImportSummary(total=len(pods))
+    now = fw.clock()
+    for pod in pods:
+        lq_name, cq_name, flavor, priority, err = _resolve(
+            fw, pod, queue_label, mapping)
+        if err == "skip":
+            summary.skipped += 1
+            continue
+        if err:
+            summary.failed += 1
+            summary.errors.append(f"{pod.namespace}/{pod.name}: {err}")
+            continue
+        requests = {r: resource_value(r, q) for r, q in pod.requests.items()}
+        wl = Workload(
+            name=f"pod-{pod.name}", namespace=pod.namespace,
+            queue_name=lq_name,
+            pod_sets=[PodSet(name="main", count=1, requests=dict(requests))],
+            priority=priority, priority_class=pod.priority_class)
+        wl.admission = Admission(
+            cluster_queue=cq_name,
+            pod_set_assignments=[PodSetAssignment(
+                name="main",
+                flavors={r: flavor for r in requests},
+                resource_usage=dict(requests),
+                count=1)])
+        wl.set_condition(CONDITION_QUOTA_RESERVED, True, reason="Imported",
+                         message=f"Imported into ClusterQueue {cq_name}",
+                         now=now)
+        wl.set_condition(CONDITION_ADMITTED, True, reason="Imported",
+                         message=f"Imported into ClusterQueue {cq_name}",
+                         now=now)
+        fw.workloads[wl.key] = wl
+        fw.cache.add_or_update_workload(wl)
+        if add_labels:
+            pod.labels.update(add_labels)
+        summary.imported += 1
+    fw.update_metrics_gauges()
+    return summary
+
+
+# ---------------------------------------------------------------------------
+# CLI (cmd/importer/main.go analog, against a JSON-described in-memory
+# cluster instead of a kubeconfig)
+# ---------------------------------------------------------------------------
+
+
+def _parse_mapping(args: argparse.Namespace) -> Dict[str, str]:
+    mapping: Dict[str, str] = {}
+    for entry in (args.queuemapping or "").split(","):
+        if not entry:
+            continue
+        k, _, v = entry.partition("=")
+        mapping[k] = v
+    if args.queuemapping_file:
+        with open(args.queuemapping_file) as f:
+            mapping.update(json.load(f))
+    return mapping
+
+
+def _load_framework(setup_path: str):
+    from kueue_tpu.api.types import (
+        ClusterQueue,
+        FlavorQuotas,
+        LocalQueue,
+        ResourceFlavor,
+        ResourceGroup,
+        WorkloadPriorityClass,
+    )
+    from kueue_tpu.controllers.runtime import Framework
+
+    with open(setup_path) as f:
+        spec = json.load(f)
+    fw = Framework()
+    for rf in spec.get("resource_flavors", []):
+        fw.create_resource_flavor(ResourceFlavor.make(rf["name"]))
+    for cq in spec.get("cluster_queues", []):
+        fw.create_cluster_queue(ClusterQueue(
+            name=cq["name"], cohort=cq.get("cohort", ""),
+            resource_groups=tuple(
+                ResourceGroup(
+                    covered_resources=tuple(rg["covered_resources"]),
+                    flavors=tuple(
+                        FlavorQuotas.make(fq["name"], **fq["quotas"])
+                        for fq in rg["flavors"]))
+                for rg in cq.get("resource_groups", []))))
+    for lq in spec.get("local_queues", []):
+        fw.create_local_queue(LocalQueue(
+            name=lq["name"], namespace=lq.get("namespace", "default"),
+            cluster_queue=lq["cluster_queue"]))
+    for pc in spec.get("priority_classes", []):
+        fw.create_workload_priority_class(
+            WorkloadPriorityClass(name=pc["name"], value=pc["value"]))
+    return fw
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="kueue-importer",
+        description="Import pre-existing pods into the queueing system.")
+    parser.add_argument("mode", choices=["check", "import"])
+    parser.add_argument("--setup", required=True,
+                        help="JSON file describing flavors/queues")
+    parser.add_argument("--pods", required=True,
+                        help="JSON file: list of pods "
+                             "(name/namespace/labels/requests)")
+    parser.add_argument("--queuelabel", required=True)
+    parser.add_argument("--queuemapping", default="",
+                        help="val=queue[,val=queue...]")
+    parser.add_argument("--queuemapping-file", default="")
+    args = parser.parse_args(argv)
+
+    fw = _load_framework(args.setup)
+    with open(args.pods) as f:
+        pods = [ImportPod(**p) for p in json.load(f)]
+    mapping = _parse_mapping(args)
+    if args.mode == "check":
+        summary = check(fw, pods, args.queuelabel, mapping)
+    else:
+        summary = import_pods(fw, pods, args.queuelabel, mapping)
+    print(json.dumps({
+        "mode": args.mode, "total": summary.total,
+        "imported": summary.imported, "skipped": summary.skipped,
+        "failed": summary.failed, "errors": summary.errors}))
+    return 0 if summary.ok() else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,15 +1,30 @@
 """Job integrations (counterpart of reference pkg/controller/jobs/).
 
 Importing this package registers the built-in integrations:
-  batch     single-PodSet parallel jobs (jobs/job)
-  multirole launcher/worker- and head/worker-group jobs, covering the
-            MPIJob, kubeflow *Job and RayJob/RayCluster shapes
-            (jobs/mpijob, jobs/kubeflow, jobs/rayjob, jobs/raycluster)
-  jobset    lists of replicated jobs (jobs/jobset)
-  podgroup  plain pods grouped by annotation (jobs/pod, KEP-976)
+  batch               single-PodSet parallel jobs (jobs/job)
+  multirole           generic heterogeneous-role jobs
+  jobset              lists of replicated jobs (jobs/jobset)
+  podgroup            plain pods grouped by annotation (jobs/pod, KEP-976)
+  mpijob              kubeflow mpi-operator launcher/worker (jobs/mpijob)
+  kubeflow.pytorchjob / tfjob / paddlejob / xgboostjob / mxjob
+                      kubeflow training-operator family (jobs/kubeflow)
+  rayjob / raycluster Ray head + worker groups (jobs/rayjob, jobs/raycluster)
+  noop                stub for parent-managed kinds (jobs/noop)
 """
 
 from kueue_tpu.jobs.batch_job import BatchJob
 from kueue_tpu.jobs.multi_role_job import MultiRoleJob, Role
 from kueue_tpu.jobs.jobset import JobSet, ReplicatedJob
 from kueue_tpu.jobs.pod_group import PodGroup, GroupedPod
+from kueue_tpu.jobs.kubeflow import (
+    KubeflowJob,
+    MXJob,
+    PaddleJob,
+    PyTorchJob,
+    ReplicaSpec,
+    TFJob,
+    XGBoostJob,
+)
+from kueue_tpu.jobs.mpijob import MPIJob
+from kueue_tpu.jobs.noop import NoopJob
+from kueue_tpu.jobs.ray import RayCluster, RayJob, WorkerGroup

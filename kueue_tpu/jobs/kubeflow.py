@@ -1,0 +1,179 @@
+"""Kubeflow training-operator job family.
+
+Counterpart of reference pkg/controller/jobs/kubeflow/: a shared adapter
+(`KubeflowJob`, kubeflowjob/kubeflowjob_controller.go) over per-framework
+replica-spec maps, plus the five concrete integrations — PyTorchJob, TFJob,
+PaddleJob, XGBoostJob, MXJob (jobs/{pytorchjob,tfjob,paddlejob,xgboostjob,
+mxjob}/..._controller.go:98 OrderedReplicaTypes).
+
+Each present replica type becomes one PodSet, emitted in the framework's
+canonical order; the whole job is admitted atomically. Priority-class
+resolution follows kubeflowjob_controller.go:146-165: the run policy's
+scheduling-policy priority class wins, else the first replica template (in
+canonical order) that names one.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from kueue_tpu.api.types import PodSet
+from kueue_tpu.controllers.jobframework import (
+    GenericJob,
+    PodSetInfo,
+    register_integration,
+)
+
+
+@dataclass
+class ReplicaSpec:
+    """One replica type's spec (kftraining.ReplicaSpec analog)."""
+
+    replicas: int
+    requests: Dict[str, object] = field(default_factory=dict)
+    priority_class: str = ""
+    ready: int = 0  # replicas currently ready (status mirror)
+    podset_kwargs: Dict[str, object] = field(default_factory=dict)
+
+
+class KubeflowJob(GenericJob):
+    """Shared adapter over a replica-spec map (kubeflowjob_controller.go)."""
+
+    # Canonical replica-type order; subclasses override.
+    REPLICA_ORDER: Tuple[str, ...] = ()
+
+    def __init__(self, name: str, queue_name: str,
+                 replica_specs: Dict[str, ReplicaSpec],
+                 namespace: str = "default",
+                 scheduling_priority_class: str = "",
+                 priority: int = 0,
+                 on_run: Optional[Callable[["KubeflowJob"], None]] = None):
+        unknown = set(replica_specs) - set(self.REPLICA_ORDER)
+        if unknown:
+            raise ValueError(
+                f"unknown replica types {sorted(unknown)}; "
+                f"{type(self).__name__} supports {list(self.REPLICA_ORDER)}")
+        self._name = name
+        self._namespace = namespace
+        self._queue_name = queue_name
+        self.replica_specs = dict(replica_specs)
+        self.scheduling_priority_class = scheduling_priority_class
+        self._priority = priority
+        self._suspended = True
+        self._on_run = on_run
+        self.succeeded = False
+        self.failed = False
+        self.podset_infos: List[PodSetInfo] = []
+
+    # -- GenericJob ---------------------------------------------------------
+
+    @property
+    def name(self) -> str:
+        return self._name
+
+    @property
+    def namespace(self) -> str:
+        return self._namespace
+
+    @property
+    def queue_name(self) -> str:
+        return self._queue_name
+
+    def ordered_replica_types(self) -> List[str]:
+        """Present replica types in canonical order
+        (OrderedReplicaTypes filtered to the spec, kubeflowjob ReplicaSpecs)."""
+        return [rt for rt in self.REPLICA_ORDER if rt in self.replica_specs]
+
+    def is_suspended(self) -> bool:
+        return self._suspended
+
+    def suspend(self) -> None:
+        self._suspended = True
+        for spec in self.replica_specs.values():
+            spec.ready = 0
+
+    def run(self, podset_infos: Sequence[PodSetInfo]) -> None:
+        self.podset_infos = list(podset_infos)
+        self._suspended = False
+        if self._on_run is not None:
+            self._on_run(self)
+
+    def restore(self, podset_infos: Sequence[PodSetInfo]) -> None:
+        self.podset_infos = []
+
+    def pod_sets(self) -> List[PodSet]:
+        return [
+            PodSet.make(rt.lower(), count=self.replica_specs[rt].replicas,
+                        **self.replica_specs[rt].requests,
+                        **self.replica_specs[rt].podset_kwargs)
+            for rt in self.ordered_replica_types()
+        ]
+
+    def finished(self) -> Tuple[bool, bool]:
+        if self.failed:
+            return True, False
+        return self.succeeded, True
+
+    def pods_ready(self) -> bool:
+        """All replicas of all types ready (kubeflowjob PodsReady)."""
+        return not self._suspended and all(
+            spec.ready >= spec.replicas
+            for spec in self.replica_specs.values())
+
+    def priority_class(self) -> str:
+        if self.scheduling_priority_class:
+            return self.scheduling_priority_class
+        for rt in self.ordered_replica_types():
+            if self.replica_specs[rt].priority_class:
+                return self.replica_specs[rt].priority_class
+        return ""
+
+    def priority(self) -> int:
+        return self._priority
+
+
+@register_integration("kubeflow.pytorchjob")
+class PyTorchJob(KubeflowJob):
+    """jobs/kubeflow/jobs/pytorchjob/pytorchjob_controller.go:98."""
+
+    REPLICA_ORDER = ("Master", "Worker")
+
+
+@register_integration("kubeflow.tfjob")
+class TFJob(KubeflowJob):
+    """jobs/kubeflow/jobs/tfjob/tfjob_controller.go:98."""
+
+    REPLICA_ORDER = ("Chief", "Master", "PS", "Worker", "Eval")
+
+
+@register_integration("kubeflow.paddlejob")
+class PaddleJob(KubeflowJob):
+    """jobs/kubeflow/jobs/paddlejob/paddlejob_controller.go:98."""
+
+    REPLICA_ORDER = ("Master", "Worker")
+
+
+@register_integration("kubeflow.xgboostjob")
+class XGBoostJob(KubeflowJob):
+    """jobs/kubeflow/jobs/xgboostjob/xgboostjob_controller.go:98."""
+
+    REPLICA_ORDER = ("Master", "Worker")
+
+
+@register_integration("kubeflow.mxjob")
+class MXJob(KubeflowJob):
+    """jobs/kubeflow/jobs/mxjob/mxjob_controller.go:98 — the replica order
+    depends on the job mode (MXTrain vs MXTune)."""
+
+    TRAIN_ORDER = ("Scheduler", "Server", "Worker")
+    TUNE_ORDER = ("TunerTracker", "TunerServer", "Tuner")
+    REPLICA_ORDER = TRAIN_ORDER + TUNE_ORDER  # superset for validation
+
+    def __init__(self, *args, job_mode: str = "MXTrain", **kwargs):
+        self.job_mode = job_mode
+        super().__init__(*args, **kwargs)
+
+    def ordered_replica_types(self) -> List[str]:
+        order = self.TRAIN_ORDER if self.job_mode == "MXTrain" else self.TUNE_ORDER
+        return [rt for rt in order if rt in self.replica_specs]

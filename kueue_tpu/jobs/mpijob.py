@@ -1,0 +1,40 @@
+"""MPIJob integration (reference: pkg/controller/jobs/mpijob/).
+
+Launcher + Worker replica types (mpijob_controller.go:107 orderedReplicaTypes),
+admitted atomically; priority class resolves from the run policy's
+scheduling policy first, then the launcher template, then the worker
+template (mpijob_controller.go priorityClass handling).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+from kueue_tpu.controllers.jobframework import register_integration
+from kueue_tpu.jobs.kubeflow import KubeflowJob, ReplicaSpec
+
+LAUNCHER = "Launcher"
+WORKER = "Worker"
+
+
+@register_integration("mpijob")
+class MPIJob(KubeflowJob):
+    """kubeflow mpi-operator v2beta1 MPIJob."""
+
+    REPLICA_ORDER = (LAUNCHER, WORKER)
+
+    @staticmethod
+    def simple(name: str, queue_name: str, workers: int,
+               worker_requests: Dict[str, object],
+               launcher_requests: Dict[str, object] | None = None,
+               **kwargs) -> "MPIJob":
+        """Common shape: one launcher + N workers."""
+        return MPIJob(
+            name=name, queue_name=queue_name,
+            replica_specs={
+                LAUNCHER: ReplicaSpec(
+                    replicas=1,
+                    requests=dict(launcher_requests or {"cpu": 1})),
+                WORKER: ReplicaSpec(replicas=workers,
+                                    requests=dict(worker_requests)),
+            }, **kwargs)

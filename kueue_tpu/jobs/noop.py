@@ -1,0 +1,54 @@
+"""No-op integration stub (reference: pkg/controller/jobs/noop/).
+
+Used for kinds whose lifecycle a parent object manages (e.g. the pods of a
+framework-managed job): it contributes no PodSets and never starts or stops
+anything; the reconciler effectively skips it.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+from kueue_tpu.api.types import PodSet
+from kueue_tpu.controllers.jobframework import (
+    GenericJob,
+    PodSetInfo,
+    register_integration,
+)
+
+
+@register_integration("noop")
+class NoopJob(GenericJob):
+    def __init__(self, name: str, namespace: str = "default"):
+        self._name = name
+        self._namespace = namespace
+
+    @property
+    def name(self) -> str:
+        return self._name
+
+    @property
+    def namespace(self) -> str:
+        return self._namespace
+
+    @property
+    def queue_name(self) -> str:
+        return ""
+
+    def is_suspended(self) -> bool:
+        return True
+
+    def suspend(self) -> None:
+        pass
+
+    def run(self, podset_infos: Sequence[PodSetInfo]) -> None:
+        pass
+
+    def restore(self, podset_infos: Sequence[PodSetInfo]) -> None:
+        pass
+
+    def pod_sets(self) -> List[PodSet]:
+        return []
+
+    def finished(self) -> Tuple[bool, bool]:
+        return False, False

@@ -1,0 +1,125 @@
+"""RayJob / RayCluster integrations (reference: pkg/controller/jobs/rayjob/,
+pkg/controller/jobs/raycluster/).
+
+Both map to a "head" PodSet plus one PodSet per worker group (group name
+lowercased, raycluster_controller.go:90-115); the whole cluster is admitted
+atomically. RayJob wraps a cluster spec and finishes with the job's
+succeed/fail status (rayjob_controller.go Finished from JobDeploymentStatus);
+RayCluster is long-running — it "finishes" only when deleted, and supports
+suspend by tearing down pods (raycluster suspend semantics).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from kueue_tpu.api.types import PodSet
+from kueue_tpu.controllers.jobframework import (
+    GenericJob,
+    PodSetInfo,
+    register_integration,
+)
+
+HEAD_GROUP = "head"
+
+
+@dataclass
+class WorkerGroup:
+    """One Ray worker group (raycluster WorkerGroupSpecs entry)."""
+
+    name: str
+    replicas: int
+    requests: Dict[str, object] = field(default_factory=dict)
+    ready: int = 0
+    podset_kwargs: Dict[str, object] = field(default_factory=dict)
+
+
+class _RayBase(GenericJob):
+    def __init__(self, name: str, queue_name: str,
+                 head_requests: Dict[str, object],
+                 worker_groups: Sequence[WorkerGroup],
+                 namespace: str = "default", priority: int = 0,
+                 on_run: Optional[Callable[["_RayBase"], None]] = None):
+        self._name = name
+        self._namespace = namespace
+        self._queue_name = queue_name
+        self.head_requests = dict(head_requests)
+        self.worker_groups = list(worker_groups)
+        self._priority = priority
+        self._suspended = True
+        self._on_run = on_run
+        self.head_ready = False
+        self.podset_infos: List[PodSetInfo] = []
+
+    @property
+    def name(self) -> str:
+        return self._name
+
+    @property
+    def namespace(self) -> str:
+        return self._namespace
+
+    @property
+    def queue_name(self) -> str:
+        return self._queue_name
+
+    def is_suspended(self) -> bool:
+        return self._suspended
+
+    def suspend(self) -> None:
+        self._suspended = True
+        self.head_ready = False
+        for wg in self.worker_groups:
+            wg.ready = 0
+
+    def run(self, podset_infos: Sequence[PodSetInfo]) -> None:
+        self.podset_infos = list(podset_infos)
+        self._suspended = False
+        if self._on_run is not None:
+            self._on_run(self)
+
+    def restore(self, podset_infos: Sequence[PodSetInfo]) -> None:
+        self.podset_infos = []
+
+    def pod_sets(self) -> List[PodSet]:
+        sets = [PodSet.make(HEAD_GROUP, count=1, **self.head_requests)]
+        for wg in self.worker_groups:
+            sets.append(PodSet.make(wg.name.lower(), count=wg.replicas,
+                                    **wg.requests, **wg.podset_kwargs))
+        return sets
+
+    def pods_ready(self) -> bool:
+        return (not self._suspended and self.head_ready
+                and all(wg.ready >= wg.replicas for wg in self.worker_groups))
+
+    def priority(self) -> int:
+        return self._priority
+
+
+@register_integration("rayjob")
+class RayJob(_RayBase):
+    """A Ray job with an ephemeral cluster (jobs/rayjob/)."""
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.succeeded = False
+        self.failed = False
+
+    def finished(self) -> Tuple[bool, bool]:
+        if self.failed:
+            return True, False
+        return self.succeeded, True
+
+
+@register_integration("raycluster")
+class RayCluster(_RayBase):
+    """A long-running Ray cluster (jobs/raycluster/): never self-finishes;
+    quota is released by deleting it (jobframework delete path)."""
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.deleted = False
+
+    def finished(self) -> Tuple[bool, bool]:
+        return self.deleted, True

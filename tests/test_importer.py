@@ -1,0 +1,116 @@
+"""Importer tests (reference: cmd/importer/pod/{check,import}_test.go)."""
+
+import json
+
+from kueue_tpu.api.types import (
+    ClusterQueue,
+    FlavorQuotas,
+    LocalQueue,
+    ResourceFlavor,
+    ResourceGroup,
+    Workload,
+    PodSet,
+    WorkloadPriorityClass,
+)
+from kueue_tpu.controllers.runtime import Framework
+from kueue_tpu.importer import ImportPod, check, import_pods, main
+
+
+def make_fw():
+    fw = Framework()
+    fw.create_resource_flavor(ResourceFlavor.make("default"))
+    fw.create_cluster_queue(ClusterQueue(
+        name="cq",
+        resource_groups=(ResourceGroup(
+            covered_resources=("cpu",),
+            flavors=(FlavorQuotas.make("default", cpu=10),)),)))
+    fw.create_local_queue(LocalQueue(
+        name="lq", namespace="default", cluster_queue="cq"))
+    fw.create_workload_priority_class(WorkloadPriorityClass("vip", 100))
+    return fw
+
+
+MAPPING = {"team-a": "lq"}
+LABEL = "src.lbl"
+
+
+class TestCheck:
+    def test_ok(self):
+        fw = make_fw()
+        pods = [ImportPod("p1", labels={LABEL: "team-a"},
+                          requests={"cpu": 1})]
+        s = check(fw, pods, LABEL, MAPPING)
+        assert s.ok() and s.skipped == 0
+
+    def test_unmapped_pod_skipped(self):
+        fw = make_fw()
+        pods = [ImportPod("p1", labels={"other": "x"}, requests={"cpu": 1})]
+        s = check(fw, pods, LABEL, MAPPING)
+        assert s.ok() and s.skipped == 1
+
+    def test_missing_local_queue_fails(self):
+        fw = make_fw()
+        pods = [ImportPod("p1", labels={LABEL: "team-a"})]
+        s = check(fw, pods, LABEL, {"team-a": "nope"})
+        assert not s.ok() and "LocalQueue" in s.errors[0]
+
+    def test_unknown_priority_class_fails(self):
+        fw = make_fw()
+        pods = [ImportPod("p1", labels={LABEL: "team-a"},
+                          priority_class="ghost")]
+        s = check(fw, pods, LABEL, MAPPING)
+        assert not s.ok() and "priority class" in s.errors[0]
+
+
+class TestImport:
+    def test_direct_admission_and_usage(self):
+        fw = make_fw()
+        pods = [ImportPod("p1", labels={LABEL: "team-a"},
+                          requests={"cpu": 2}),
+                ImportPod("p2", labels={LABEL: "team-a"},
+                          requests={"cpu": 3}, priority_class="vip")]
+        s = import_pods(fw, pods, LABEL, MAPPING,
+                        add_labels={"managed": "yes"})
+        assert s.imported == 2 and s.ok()
+        # Workloads admitted without a scheduler tick; usage accounted.
+        assert fw.cache.cluster_queues["cq"].usage["default"]["cpu"] == 5000
+        wl = fw.workloads["default/pod-p2"]
+        assert wl.is_admitted and wl.priority == 100
+        assert pods[0].labels["managed"] == "yes"
+
+    def test_imported_usage_visible_to_scheduler(self):
+        fw = make_fw()
+        import_pods(fw, [ImportPod("p1", labels={LABEL: "team-a"},
+                                   requests={"cpu": 8})], LABEL, MAPPING)
+        # Only 2 cpu left; a 4-cpu workload must stay pending.
+        wl = Workload(name="late", queue_name="lq",
+                      pod_sets=[PodSet.make("main", 1, cpu=4)])
+        fw.submit(wl)
+        fw.run_until_settled()
+        assert not wl.has_quota_reservation
+
+
+class TestCLI:
+    def test_check_then_import(self, tmp_path):
+        setup = {
+            "resource_flavors": [{"name": "default"}],
+            "cluster_queues": [{
+                "name": "cq",
+                "resource_groups": [{
+                    "covered_resources": ["cpu"],
+                    "flavors": [{"name": "default",
+                                 "quotas": {"cpu": 10}}]}]}],
+            "local_queues": [{"name": "lq", "cluster_queue": "cq"}],
+        }
+        pods = [{"name": "p1", "labels": {LABEL: "team-a"},
+                 "requests": {"cpu": 1}}]
+        sp = tmp_path / "setup.json"
+        pp = tmp_path / "pods.json"
+        sp.write_text(json.dumps(setup))
+        pp.write_text(json.dumps(pods))
+        rc = main(["check", "--setup", str(sp), "--pods", str(pp),
+                   "--queuelabel", LABEL, "--queuemapping", "team-a=lq"])
+        assert rc == 0
+        rc = main(["import", "--setup", str(sp), "--pods", str(pp),
+                   "--queuelabel", LABEL, "--queuemapping", "team-a=lq"])
+        assert rc == 0

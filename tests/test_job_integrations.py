@@ -1,0 +1,198 @@
+"""Named job-integration tests: kubeflow family, MPIJob, Ray, noop.
+
+Mirrors the per-framework controller tests in reference
+pkg/controller/jobs/{kubeflow,mpijob,rayjob,raycluster}/ at the
+behavioral level: podset construction order, atomic admission,
+priority-class resolution, suspend/resume.
+"""
+
+import pytest
+
+from kueue_tpu.api.types import (
+    ClusterQueue,
+    FlavorQuotas,
+    LocalQueue,
+    ResourceFlavor,
+    ResourceGroup,
+    WorkloadPriorityClass,
+)
+from kueue_tpu.controllers.jobframework import integrations
+from kueue_tpu.controllers.runtime import Framework
+from kueue_tpu.jobs import (
+    MPIJob,
+    MXJob,
+    NoopJob,
+    PyTorchJob,
+    RayCluster,
+    RayJob,
+    ReplicaSpec,
+    TFJob,
+    WorkerGroup,
+)
+
+
+def make_fw(cpu=16):
+    fw = Framework()
+    fw.create_resource_flavor(ResourceFlavor.make("default"))
+    fw.create_cluster_queue(ClusterQueue(
+        name="cq",
+        resource_groups=(ResourceGroup(
+            covered_resources=("cpu",),
+            flavors=(FlavorQuotas.make("default", cpu=cpu),)),)))
+    fw.create_local_queue(LocalQueue(
+        name="lq", namespace="default", cluster_queue="cq"))
+    return fw
+
+
+class TestRegistry:
+    def test_all_reference_integrations_registered(self):
+        kinds = set(integrations())
+        # The reference's integration list (integrationmanager, jobs/*).
+        for kind in ("batch", "jobset", "podgroup", "mpijob", "rayjob",
+                     "raycluster", "noop", "kubeflow.pytorchjob",
+                     "kubeflow.tfjob", "kubeflow.paddlejob",
+                     "kubeflow.xgboostjob", "kubeflow.mxjob"):
+            assert kind in kinds, kind
+
+
+class TestKubeflow:
+    def test_pytorch_podsets_in_master_worker_order(self):
+        job = PyTorchJob(
+            name="pt", queue_name="lq",
+            replica_specs={"Worker": ReplicaSpec(4, {"cpu": 1}),
+                           "Master": ReplicaSpec(1, {"cpu": 1})})
+        assert [ps.name for ps in job.pod_sets()] == ["master", "worker"]
+
+    def test_tfjob_replica_order(self):
+        job = TFJob(
+            name="tf", queue_name="lq",
+            replica_specs={"Worker": ReplicaSpec(2, {"cpu": 1}),
+                           "PS": ReplicaSpec(1, {"cpu": 1}),
+                           "Chief": ReplicaSpec(1, {"cpu": 1})})
+        assert [ps.name for ps in job.pod_sets()] == ["chief", "ps", "worker"]
+
+    def test_unknown_replica_type_rejected(self):
+        with pytest.raises(ValueError):
+            PyTorchJob(name="bad", queue_name="lq",
+                       replica_specs={"Chief": ReplicaSpec(1, {"cpu": 1})})
+
+    def test_mxjob_mode_switches_order(self):
+        train = MXJob(name="mx", queue_name="lq",
+                      replica_specs={"Worker": ReplicaSpec(2, {"cpu": 1}),
+                                     "Scheduler": ReplicaSpec(1, {"cpu": 1})})
+        assert [ps.name for ps in train.pod_sets()] == ["scheduler", "worker"]
+        tune = MXJob(name="mxt", queue_name="lq", job_mode="MXTune",
+                     replica_specs={"Tuner": ReplicaSpec(1, {"cpu": 1})})
+        assert [ps.name for ps in tune.pod_sets()] == ["tuner"]
+
+    def test_priority_class_resolution(self):
+        # schedulingPolicy wins over replica templates
+        # (kubeflowjob_controller.go:146-165).
+        job = PyTorchJob(
+            name="pt", queue_name="lq",
+            scheduling_priority_class="high",
+            replica_specs={"Master": ReplicaSpec(1, {"cpu": 1},
+                                                 priority_class="low")})
+        assert job.priority_class() == "high"
+        job2 = PyTorchJob(
+            name="pt2", queue_name="lq",
+            replica_specs={
+                "Master": ReplicaSpec(1, {"cpu": 1}, priority_class="mid"),
+                "Worker": ReplicaSpec(2, {"cpu": 1}, priority_class="low")})
+        assert job2.priority_class() == "mid"
+
+    def test_workload_priority_class_applied_end_to_end(self):
+        fw = make_fw()
+        fw.create_workload_priority_class(
+            WorkloadPriorityClass(name="vip", value=1000))
+        job = PyTorchJob(
+            name="pt", queue_name="lq", scheduling_priority_class="vip",
+            replica_specs={"Master": ReplicaSpec(1, {"cpu": 1})})
+        wl = fw.submit_job(job)
+        assert wl.priority == 1000
+
+    def test_atomic_admission_and_run(self):
+        fw = make_fw(cpu=8)
+        started = []
+        job = PyTorchJob(
+            name="pt", queue_name="lq",
+            replica_specs={"Master": ReplicaSpec(1, {"cpu": 2}),
+                           "Worker": ReplicaSpec(3, {"cpu": 2})},
+            on_run=lambda j: started.append(j.name))
+        fw.submit_job(job)
+        fw.run_until_settled()
+        assert started == ["pt"]
+        assert not job.is_suspended()
+        # 1*2 + 3*2 = 8 cpu all accounted
+        assert fw.cache.cluster_queues["cq"].usage["default"]["cpu"] == 8000
+
+    def test_too_big_not_admitted(self):
+        fw = make_fw(cpu=4)
+        job = PyTorchJob(
+            name="pt", queue_name="lq",
+            replica_specs={"Master": ReplicaSpec(1, {"cpu": 2}),
+                           "Worker": ReplicaSpec(3, {"cpu": 2})})
+        fw.submit_job(job)
+        fw.run_until_settled()
+        assert job.is_suspended()
+
+
+class TestMPIJob:
+    def test_simple_shape(self):
+        job = MPIJob.simple("mpi", "lq", workers=8,
+                            worker_requests={"cpu": 2})
+        names = [(ps.name, ps.count) for ps in job.pod_sets()]
+        assert names == [("launcher", 1), ("worker", 8)]
+
+    def test_runs_and_finishes(self):
+        fw = make_fw(cpu=32)
+        job = MPIJob.simple("mpi", "lq", workers=8, worker_requests={"cpu": 2})
+        wl = fw.submit_job(job)
+        fw.run_until_settled()
+        assert wl.has_quota_reservation and not job.is_suspended()
+        job.succeeded = True
+        fw.tick()
+        assert wl.is_finished
+        assert fw.cache.cluster_queues["cq"].usage["default"]["cpu"] == 0
+
+
+class TestRay:
+    def test_raycluster_podsets(self):
+        rc = RayCluster(
+            name="rc", queue_name="lq", head_requests={"cpu": 1},
+            worker_groups=[WorkerGroup("GPU-Group", 4, {"cpu": 2}),
+                           WorkerGroup("small", 2, {"cpu": 1})])
+        names = [(ps.name, ps.count) for ps in rc.pod_sets()]
+        assert names == [("head", 1), ("gpu-group", 4), ("small", 2)]
+
+    def test_rayjob_lifecycle(self):
+        fw = make_fw(cpu=16)
+        rj = RayJob(name="rj", queue_name="lq", head_requests={"cpu": 1},
+                    worker_groups=[WorkerGroup("w", 4, {"cpu": 2})])
+        wl = fw.submit_job(rj)
+        fw.run_until_settled()
+        assert not rj.is_suspended()
+        rj.head_ready = True
+        for wg in rj.worker_groups:
+            wg.ready = wg.replicas
+        assert rj.pods_ready()
+        rj.succeeded = True
+        fw.tick()
+        assert wl.is_finished
+
+    def test_raycluster_released_on_delete(self):
+        fw = make_fw(cpu=16)
+        rc = RayCluster(name="rc", queue_name="lq", head_requests={"cpu": 1},
+                        worker_groups=[WorkerGroup("w", 2, {"cpu": 2})])
+        fw.submit_job(rc)
+        fw.run_until_settled()
+        assert fw.cache.cluster_queues["cq"].usage["default"]["cpu"] == 5000
+        fw.job_reconciler.delete(rc)
+        assert fw.cache.cluster_queues["cq"].usage["default"]["cpu"] == 0
+
+
+class TestNoop:
+    def test_contributes_nothing(self):
+        job = NoopJob(name="managed-pod")
+        assert job.pod_sets() == []
+        assert job.finished() == (False, False)
