@@ -1,0 +1,12 @@
+"""Device kernels for the scheduler's hot ops.
+
+  preemption_scan    minimalPreemptions as a device scan (JAX int64 path)
+  preemption_pallas  the same scan as a hand-written Pallas TPU kernel
+
+Quota math is exact integer arithmetic; enable x64 before any kernel is
+traced (same switch as kueue_tpu.models).
+"""
+
+import jax
+
+jax.config.update("jax_enable_x64", True)

@@ -1,0 +1,286 @@
+"""Pallas TPU kernel for the minimalPreemptions scan.
+
+Same decision semantics as ops/preemption_scan.scan_kernel (itself golden
+against reference preemption.go:172-231), hand-scheduled for the TPU:
+
+  * layout: the (flavor, resource) axis is the 128-lane dimension, cohort
+    members are sublanes — one [Ypad, 128] int32 tile holds the whole
+    mutable usage state in VMEM for the entire scan; the feasibility check
+    is a handful of VPU reductions over that tile.
+  * grid = (2N,): steps 0..N-1 are the remove phase, steps N..2N-1 walk the
+    same candidates in reverse for the add-back phase; scan state (usage
+    tile, taken flags) lives in VMEM scratch, control flags in SMEM — both
+    persist across sequential TPU grid steps.
+  * candidate metadata (member index, priority) rides scalar prefetch
+    (PrefetchScalarGridSpec) so the per-step dynamic row update is an SMEM
+    scalar index into the usage tile.
+
+Quota values are rescaled host-side to int32: each (flavor, resource)
+column is divided by the gcd of every value in that column, which preserves
+all per-column comparisons and sums exactly. Columns that still exceed
+int32 after scaling fall back to the int64 XLA scan.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Tuple
+
+import numpy as np
+
+import kueue_tpu.ops  # noqa: F401  (x64 switch)
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from kueue_tpu.ops import preemption_scan as ps
+
+LANES = 128
+SUBLANES = 8
+I32_SENTINEL = np.int32(2**30)  # "no limit" after rescale
+
+
+def _pad_axis(a: np.ndarray, axis: int, to: int) -> np.ndarray:
+    pad = [(0, 0)] * a.ndim
+    pad[axis] = (0, to - a.shape[axis])
+    return np.pad(a, pad)
+
+
+def _rescale_int32(p: ps.Problem):
+    """Per-column gcd rescale to int32; returns None when impossible."""
+    FR = p.usage0.shape[1]
+    cols = []
+    for c in range(FR):
+        vals = [int(v) for v in p.usage0[:, c]] + \
+               [int(v) for v in p.nominal[:, c] if v < ps.BIG] + \
+               [int(v) for v in p.guaranteed[:, c]] + \
+               [int(p.wl_req[c])] + \
+               ([int(p.blim[c])] if p.blim_def[c] else []) + \
+               [int(p.requestable[c])] + \
+               [int(v) for v in p.cand_use[:, c]]
+        g = 0
+        for v in vals:
+            g = math.gcd(g, abs(v))
+        cols.append(g if g > 0 else 1)
+    g = np.asarray(cols, dtype=np.int64)
+
+    def scale(a, sentinel_mask=None):
+        out = a // g
+        if sentinel_mask is not None:
+            out = np.where(sentinel_mask, I32_SENTINEL, out)
+        if out.max(initial=0) >= 2**30:
+            return None
+        return out.astype(np.int32)
+
+    usage0 = scale(p.usage0)
+    nominal = scale(p.nominal, sentinel_mask=~p.q_def | (p.nominal >= ps.BIG))
+    guaranteed = scale(p.guaranteed)
+    wl_req = scale(p.wl_req)
+    blim = scale(p.blim, sentinel_mask=~p.blim_def)
+    requestable = scale(p.requestable)
+    cand_use = scale(p.cand_use)
+    parts = (usage0, nominal, guaranteed, wl_req, blim, requestable, cand_use)
+    if any(x is None for x in parts):
+        return None
+    return parts
+
+
+def _kernel(cand_y, cand_prio, scalars,          # scalar-prefetch (SMEM)
+            usage0, nominal, q_def, guaranteed, wl_req, wl_req_mask,
+            blim, blim_def, requestable, res_mask, cand_use,   # VMEM in
+            victim_out, fits_out,                               # VMEM out
+            U, taken, flags):                                   # scratch
+    n = scalars[0]
+    has_cohort = scalars[1]
+    lending = scalars[2]
+    allow_b0 = scalars[3]
+    has_threshold = scalars[4]
+    threshold = scalars[5]
+
+    s = pl.program_id(0)
+    phase2 = s >= n
+    i = jnp.where(phase2, 2 * n - 1 - s, s)
+
+    # flags: 0=allow_b, 1=done, 2=stop_idx, 3=fits_any
+    @pl.when(s == 0)
+    def _init():
+        U[:, :] = usage0[:, :]
+        flags[0] = allow_b0
+        flags[1] = 0
+        flags[2] = n
+        flags[3] = 0
+
+    y = cand_y[i]
+    prio = cand_prio[i]
+    is_target = y == 0
+
+    def fits_now(allow_b):
+        check = (q_def[0, :] != 0) & (wl_req_mask[0, :] != 0)
+        own = U[0, :] + wl_req[0, :]
+        nominal_cap = jnp.where(check, own <= nominal[0, :], True).all()
+        blim_cap = jnp.where(
+            check & (blim_def[0, :] != 0),
+            own <= nominal[0, :] + blim[0, :], True).all()
+        use_nominal = jnp.logical_or(has_cohort == 0, allow_b == 0)
+        own_ok = jnp.where(use_nominal, nominal_cap, blim_cap)
+        above = jnp.maximum(U[:, :] - guaranteed[:, :], 0).sum(axis=0)
+        cohort_used = above + jnp.where(
+            lending != 0, jnp.minimum(U[0, :], guaranteed[0, :]), 0)
+        cohort_ok = jnp.where(
+            check, cohort_used + wl_req[0, :] <= requestable[0, :],
+            True).all()
+        return own_ok & jnp.logical_or(has_cohort == 0, cohort_ok)
+
+    row = pl.load(U, (pl.ds(y, 1), slice(None)))           # [1,128]
+    nom_row = pl.load(nominal, (pl.ds(y, 1), slice(None)))
+    qd_row = pl.load(q_def, (pl.ds(y, 1), slice(None)))
+    use_row = cand_use[:, :]                                # block [1,128]
+
+    @pl.when(jnp.logical_not(phase2))
+    def _remove():
+        borrowing = ((res_mask[0:1, :] != 0) & (qd_row != 0)
+                     & (row > nom_row)).any()
+        skip = jnp.logical_and(jnp.logical_not(is_target),
+                               jnp.logical_not(borrowing))
+        done = flags[1] != 0
+        act = jnp.logical_and(jnp.logical_not(skip), jnp.logical_not(done))
+        flip = (act & jnp.logical_not(is_target) & (has_threshold != 0)
+                & (prio >= threshold))
+        flags[0] = jnp.where(flip, 0, flags[0])
+        new_row = row - jnp.where(act, use_row, 0)
+        pl.store(U, (pl.ds(y, 1), slice(None)), new_row)
+        taken[i] = act.astype(jnp.int32)
+        # Host semantics: fits is only checked right after an actual removal.
+        fits = fits_now(flags[0]) & act
+        first_fit = fits & (flags[3] == 0)
+        flags[2] = jnp.where(first_fit, i, flags[2])
+        flags[3] = jnp.where(first_fit, 1, flags[3])
+        flags[1] = jnp.where(fits, 1, flags[1])
+        victim_out[:, :] = jnp.zeros((1, LANES), jnp.int32)
+
+    @pl.when(phase2)
+    def _addback():
+        fits_any = flags[3] != 0
+        stop_idx = flags[2]
+        removed = (taken[i] != 0) & (i <= stop_idx) & fits_any
+        tentative = removed & (i != stop_idx)
+        row_now = pl.load(U, (pl.ds(y, 1), slice(None)))
+        row_try = row_now + jnp.where(tentative, use_row, 0)
+        pl.store(U, (pl.ds(y, 1), slice(None)), row_try)
+        fits = fits_now(flags[0])
+        keep_added = tentative & fits
+        # Roll back the tentative add when the preemptor no longer fits.
+        rollback = tentative & jnp.logical_not(keep_added)
+        pl.store(U, (pl.ds(y, 1), slice(None)),
+                 row_try - jnp.where(rollback, use_row, 0))
+        victim = removed & jnp.logical_not(keep_added)
+        victim_out[:, :] = jnp.full((1, LANES), 1, jnp.int32) \
+            * victim.astype(jnp.int32)
+        fits_out[:, :] = jnp.full((1, LANES), 1, jnp.int32) \
+            * fits_any.astype(jnp.int32)
+
+
+@functools.partial(jax.jit, static_argnames=("n", "ypad", "interpret"))
+def _pallas_call(cand_y, cand_prio, scalars,
+                 usage0, nominal, q_def, guaranteed, wl_req, wl_req_mask,
+                 blim, blim_def, requestable, res_mask, cand_use,
+                 *, n: int, ypad: int, interpret: bool):
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(2 * n,),
+        in_specs=[
+            pl.BlockSpec((ypad, LANES), lambda s, *_: (0, 0)),   # usage0
+            pl.BlockSpec((ypad, LANES), lambda s, *_: (0, 0)),   # nominal
+            pl.BlockSpec((ypad, LANES), lambda s, *_: (0, 0)),   # q_def
+            pl.BlockSpec((ypad, LANES), lambda s, *_: (0, 0)),   # guaranteed
+            pl.BlockSpec((1, LANES), lambda s, *_: (0, 0)),      # wl_req
+            pl.BlockSpec((1, LANES), lambda s, *_: (0, 0)),      # wl_req_mask
+            pl.BlockSpec((1, LANES), lambda s, *_: (0, 0)),      # blim
+            pl.BlockSpec((1, LANES), lambda s, *_: (0, 0)),      # blim_def
+            pl.BlockSpec((1, LANES), lambda s, *_: (0, 0)),      # requestable
+            pl.BlockSpec((1, LANES), lambda s, *_: (0, 0)),      # res_mask
+            # candidate i's usage row; forward then reverse walk
+            pl.BlockSpec(
+                (1, LANES),
+                lambda s, *_: (jnp.where(s < n, s, 2 * n - 1 - s), 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, LANES),
+                         lambda s, *_: (jnp.where(s < n, s, 2 * n - 1 - s), 0)),
+            pl.BlockSpec((1, LANES), lambda s, *_: (0, 0)),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((ypad, LANES), jnp.int32),   # U
+            pltpu.SMEM((n,), jnp.int32),            # taken
+            pltpu.SMEM((4,), jnp.int32),            # flags
+        ],
+    )
+    victim, fits = pl.pallas_call(
+        _kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((n, LANES), jnp.int32),
+            jax.ShapeDtypeStruct((1, LANES), jnp.int32),
+        ],
+        interpret=interpret,
+    )(cand_y, cand_prio, scalars,
+      usage0, nominal, q_def, guaranteed, wl_req, wl_req_mask,
+      blim, blim_def, requestable, res_mask, cand_use)
+    return victim[:, 0], fits[0, 0]
+
+
+def scan_kernel_pallas(p: ps.Problem,
+                       interpret: bool | None = None
+                       ) -> Tuple[np.ndarray, np.ndarray]:
+    """Run the Pallas kernel on a Problem; falls back to the int64 XLA scan
+    when the int32 rescale is impossible."""
+    scaled = _rescale_int32(p)
+    if scaled is None:
+        victim, fits = ps.scan_kernel(
+            jnp.asarray(p.usage0), jnp.asarray(p.nominal),
+            jnp.asarray(p.q_def), jnp.asarray(p.guaranteed),
+            jnp.asarray(p.wl_req), jnp.asarray(p.wl_req_mask),
+            jnp.asarray(p.blim), jnp.asarray(p.blim_def),
+            jnp.asarray(p.requestable), jnp.asarray(p.res_mask),
+            jnp.asarray(p.cand_y), jnp.asarray(p.cand_use),
+            jnp.asarray(p.cand_prio),
+            jnp.asarray(p.has_cohort), jnp.asarray(p.lending),
+            jnp.asarray(p.allow_borrowing),
+            jnp.asarray(p.threshold is not None),
+            jnp.asarray(p.threshold or 0, dtype=jnp.int32))
+        return np.asarray(victim), np.asarray(fits)
+
+    usage0, nominal, guaranteed, wl_req, blim, requestable, cand_use = scaled
+    Y, FR = usage0.shape
+    N = cand_use.shape[0]
+    if FR > LANES:
+        raise ValueError(f"FR={FR} exceeds one lane tile")
+    ypad = max(SUBLANES, ((Y + SUBLANES - 1) // SUBLANES) * SUBLANES)
+
+    def pad2(a, rows):
+        return _pad_axis(_pad_axis(np.atleast_2d(a), 1, LANES), 0, rows)
+
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    scalars = np.asarray(
+        [N, int(p.has_cohort), int(p.lending), int(p.allow_borrowing),
+         int(p.threshold is not None), int(p.threshold or 0)],
+        dtype=np.int32)
+    victim, fits = _pallas_call(
+        np.asarray(p.cand_y, dtype=np.int32),
+        np.asarray(p.cand_prio, dtype=np.int32), scalars,
+        pad2(usage0, ypad),
+        # Padded rows must never look borrowing or over-quota: keep their
+        # nominal at the sentinel and usage at zero.
+        pad2(np.where(p.q_def, nominal, I32_SENTINEL), ypad),
+        pad2(p.q_def.astype(np.int32), ypad),
+        pad2(guaranteed, ypad),
+        pad2(wl_req, 1), pad2(p.wl_req_mask.astype(np.int32), 1),
+        pad2(np.where(p.blim_def, blim, I32_SENTINEL), 1),
+        pad2(p.blim_def.astype(np.int32), 1),
+        pad2(requestable, 1), pad2(p.res_mask.astype(np.int32), 1),
+        _pad_axis(cand_use, 1, LANES),
+        n=N, ypad=ypad, interpret=bool(interpret))
+    return np.asarray(victim), np.asarray(fits)

@@ -1,0 +1,308 @@
+"""minimalPreemptions as a device scan.
+
+Counterpart of the greedy victim search in reference
+pkg/scheduler/preemption/preemption.go:172-231 (`minimalPreemptions`) +
+workloadFits (:352-389), reformulated for the accelerator:
+
+  remove phase   a `lax.scan` over the ordered candidates; the carry is the
+                 per-cohort-member usage tensor [Y, FR] plus the
+                 allow-borrowing and done flags. Each step applies the
+                 dynamic skip rule (cross-CQ candidates are skipped once
+                 their CQ stops borrowing), the borrowWithinCohort
+                 threshold flip, subtracts the candidate's usage, and
+                 re-evaluates `workloadFits` — all masks and reductions,
+                 no data-dependent branching.
+  add-back phase a reverse `lax.scan` over the same candidates that re-adds
+                 each taken victim and keeps it admitted when the preemptor
+                 still fits (preemption.go:214-224).
+
+The host wrapper `minimal_preemptions_device` is a drop-in for the
+sequential `scheduler.preemption._minimal_preemptions` (bit-equal decisions;
+see tests/test_preemption_scan.py's randomized equivalence harness).
+
+Integer semantics are exact (int64). The Pallas TPU version of the same
+scan lives in kueue_tpu.ops.preemption_pallas.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import kueue_tpu.ops  # noqa: F401  (enables x64 before tracing)
+import jax
+import jax.numpy as jnp
+
+from kueue_tpu import features
+from kueue_tpu.core.cache import CachedClusterQueue
+from kueue_tpu.core.snapshot import Snapshot
+from kueue_tpu.core.workload import WorkloadInfo
+
+BIG = np.int64(2**62)
+
+
+@dataclass
+class Problem:
+    """One minimalPreemptions instance, densely encoded.
+
+    Axes: Y = cohort members (target ClusterQueue first), FR = the union of
+    (flavor, resource) pairs any member's quota covers, N = ordered
+    candidates.
+    """
+
+    members: List[str]
+    fr_pairs: List[Tuple[str, str]]
+    usage0: np.ndarray        # [Y, FR] int64
+    nominal: np.ndarray       # [Y, FR] int64 (BIG where quota undefined)
+    q_def: np.ndarray         # [Y, FR] bool: quota defined
+    guaranteed: np.ndarray    # [Y, FR] int64
+    wl_req: np.ndarray        # [FR] int64
+    wl_req_mask: np.ndarray   # [FR] bool: pair requested by the preemptor
+    blim: np.ndarray          # [FR] int64: target borrowingLimit (BIG if none)
+    blim_def: np.ndarray      # [FR] bool
+    requestable: np.ndarray   # [FR] int64: target requestable cohort quota
+    res_mask: np.ndarray      # [FR] bool: resources requiring preemption
+    cand_y: np.ndarray        # [N] int32: candidate's member index
+    cand_use: np.ndarray      # [N, FR] int64
+    cand_prio: np.ndarray     # [N] int32
+    has_cohort: bool
+    lending: bool
+    allow_borrowing: bool
+    threshold: Optional[int]
+
+
+def encode_problem(cq: CachedClusterQueue, snapshot: Snapshot,
+                   wl_req: Dict[str, Dict[str, int]],
+                   res_per_flv: Dict[str, set],
+                   candidates: Sequence[WorkloadInfo],
+                   allow_borrowing: bool,
+                   threshold: Optional[int]) -> Problem:
+    """Tensorize one victim search against the tick snapshot."""
+    members = [cq]
+    if cq.cohort is not None:
+        members += [m for m in cq.cohort.members if m is not cq]
+    member_idx = {m.name: i for i, m in enumerate(members)}
+
+    pairs: List[Tuple[str, str]] = []
+    pair_idx: Dict[Tuple[str, str], int] = {}
+    for m in members:
+        for fname, resources in m.usage.items():
+            for rname in resources:
+                key = (fname, rname)
+                if key not in pair_idx:
+                    pair_idx[key] = len(pairs)
+                    pairs.append(key)
+    Y, FR, N = len(members), len(pairs), len(candidates)
+
+    usage0 = np.zeros((Y, FR), dtype=np.int64)
+    nominal = np.full((Y, FR), BIG, dtype=np.int64)
+    q_def = np.zeros((Y, FR), dtype=bool)
+    guaranteed = np.zeros((Y, FR), dtype=np.int64)
+    lending = features.enabled(features.LENDING_LIMIT)
+    for yi, m in enumerate(members):
+        for fname, resources in m.usage.items():
+            for rname, used in resources.items():
+                usage0[yi, pair_idx[(fname, rname)]] = used
+        for rg in m.resource_groups:
+            for fq in rg.flavors:
+                for rname, quota in fq.resources:
+                    fi = pair_idx.get((fq.name, rname))
+                    if fi is None:
+                        continue
+                    nominal[yi, fi] = quota.nominal
+                    q_def[yi, fi] = True
+        if lending:
+            for fname, resources in m.guaranteed_quota.items():
+                for rname, g in resources.items():
+                    fi = pair_idx.get((fname, rname))
+                    if fi is not None:
+                        guaranteed[yi, fi] = g
+
+    wl_req_arr = np.zeros(FR, dtype=np.int64)
+    wl_req_mask = np.zeros(FR, dtype=bool)
+    for fname, resources in wl_req.items():
+        for rname, v in resources.items():
+            fi = pair_idx.get((fname, rname))
+            if fi is not None:
+                wl_req_arr[fi] = v
+                wl_req_mask[fi] = True
+
+    blim = np.full(FR, BIG, dtype=np.int64)
+    blim_def = np.zeros(FR, dtype=bool)
+    requestable = np.zeros(FR, dtype=np.int64)
+    for rg in cq.resource_groups:
+        for fq in rg.flavors:
+            for rname, quota in fq.resources:
+                fi = pair_idx.get((fq.name, rname))
+                if fi is None:
+                    continue
+                if quota.borrowing_limit is not None:
+                    blim[fi] = quota.borrowing_limit
+                    blim_def[fi] = True
+                if cq.cohort is not None:
+                    requestable[fi] = cq.requestable_cohort_quota(
+                        fq.name, rname)
+
+    res_mask = np.zeros(FR, dtype=bool)
+    for fname, resources in res_per_flv.items():
+        for rname in resources:
+            fi = pair_idx.get((fname, rname))
+            if fi is not None:
+                res_mask[fi] = True
+
+    cand_y = np.zeros(N, dtype=np.int32)
+    cand_use = np.zeros((N, FR), dtype=np.int64)
+    cand_prio = np.zeros(N, dtype=np.int32)
+    for i, cand in enumerate(candidates):
+        cand_y[i] = member_idx[cand.cluster_queue]
+        # Only pairs the candidate's own CQ tracks count (_update_usage,
+        # clusterqueue.go:473-485).
+        tracked = snapshot.cluster_queues[cand.cluster_queue].usage
+        for fname, resources in cand.usage().items():
+            if fname not in tracked:
+                continue
+            for rname, v in resources.items():
+                if rname not in tracked[fname]:
+                    continue
+                cand_use[i, pair_idx[(fname, rname)]] = v
+        cand_prio[i] = cand.obj.priority
+
+    return Problem(
+        members=[m.name for m in members], fr_pairs=pairs,
+        usage0=usage0, nominal=nominal, q_def=q_def, guaranteed=guaranteed,
+        wl_req=wl_req_arr, wl_req_mask=wl_req_mask,
+        blim=blim, blim_def=blim_def, requestable=requestable,
+        res_mask=res_mask, cand_y=cand_y, cand_use=cand_use,
+        cand_prio=cand_prio,
+        has_cohort=cq.cohort is not None, lending=lending,
+        allow_borrowing=allow_borrowing, threshold=threshold)
+
+
+# ---------------------------------------------------------------------------
+# The scan (jittable)
+# ---------------------------------------------------------------------------
+
+
+def _fits(U, wl_req, wl_req_mask, t_def, nominal0, blim, blim_def,
+          guaranteed, requestable, has_cohort, lending, allow_b):
+    """workloadFits (preemption.go:352-389) as masked reductions.
+
+    `U` is [Y, FR]; row 0 is the target ClusterQueue.
+    """
+    check = t_def & wl_req_mask                       # [FR]
+    own = U[0] + wl_req
+    nominal_cap = jnp.where(check, own <= nominal0, True)
+    blim_cap = jnp.where(check & blim_def, own <= nominal0 + blim, True)
+    use_nominal = jnp.logical_or(~has_cohort, ~allow_b)
+    own_ok = jnp.where(use_nominal, nominal_cap.all(), blim_cap.all())
+
+    above = jnp.maximum(U - guaranteed, 0).sum(axis=0)      # [FR]
+    cohort_used = above + jnp.where(lending, jnp.minimum(U[0], guaranteed[0]), 0)
+    cohort_ok = jnp.where(check, cohort_used + wl_req <= requestable, True).all()
+    return own_ok & jnp.logical_or(~has_cohort, cohort_ok)
+
+
+@jax.jit
+def scan_kernel(usage0, nominal, q_def, guaranteed, wl_req, wl_req_mask,
+                blim, blim_def, requestable, res_mask,
+                cand_y, cand_use, cand_prio,
+                has_cohort, lending, allow_b0, has_threshold, threshold):
+    """Remove-until-fits + reverse add-back; returns (victim[N], fits)."""
+    t_def = q_def[0]
+    fits_fn = functools.partial(
+        _fits, wl_req=wl_req, wl_req_mask=wl_req_mask, t_def=t_def,
+        nominal0=nominal[0], blim=blim, blim_def=blim_def,
+        guaranteed=guaranteed, requestable=requestable,
+        has_cohort=has_cohort, lending=lending)
+
+    def remove_step(carry, xs):
+        U, allow_b, done = carry
+        y, use, prio = xs
+        is_target = y == 0
+        row = U[y]
+        borrowing = (res_mask & q_def[y] & (row > nominal[y])).any()
+        skip = (~is_target) & ~borrowing
+        act = (~skip) & (~done)
+        allow_b = jnp.where(
+            act & (~is_target) & has_threshold & (prio >= threshold),
+            False, allow_b)
+        U = U.at[y].add(jnp.where(act, -use, 0))
+        # The host checks fits only after an actual removal
+        # (skipped candidates fall through with `continue`).
+        fits = fits_fn(U, allow_b=allow_b) & act
+        done_after = done | fits
+        return (U, allow_b, done_after), (act, done_after)
+
+    carry0 = (usage0, allow_b0, jnp.asarray(False))
+    (U_end, allow_b_end, fits_any), (taken, done_seq) = jax.lax.scan(
+        remove_step, carry0, (cand_y, cand_use, cand_prio))
+
+    # Victims = taken candidates up to and including the stop index.
+    N = cand_y.shape[0]
+    stop_idx = jnp.where(fits_any,
+                         jnp.argmax(done_seq),
+                         N)  # first True
+    in_prefix = jnp.arange(N) <= stop_idx
+    removed = taken & in_prefix
+
+    def addback_step(carry, xs):
+        U, victim_count = carry
+        i, y, use = xs
+        # The last removed candidate is never re-added
+        # (preemption.go:214 starts at len(targets)-2).
+        is_last = i == stop_idx
+        tentative = removed[i] & (~is_last)
+        U_try = U.at[y].add(jnp.where(tentative, use, 0))
+        fits = fits_fn(U_try, allow_b=allow_b_end)
+        keep_added = tentative & fits
+        U = jnp.where(keep_added, U_try, U)
+        victim = removed[i] & ~keep_added
+        return (U, victim_count + victim), victim
+
+    idx_rev = jnp.arange(N - 1, -1, -1)
+    (_, n_victims), victim_rev = jax.lax.scan(
+        addback_step, (U_end, jnp.asarray(0)),
+        (idx_rev, cand_y[idx_rev], cand_use[idx_rev]))
+    victim = victim_rev[::-1]
+    victim = jnp.where(fits_any, victim, False)
+    return victim, fits_any
+
+
+def minimal_preemptions_device(
+        wl_req: Dict[str, Dict[str, int]],
+        cq: CachedClusterQueue, snapshot: Snapshot,
+        res_per_flv: Dict[str, set],
+        candidates: Sequence[WorkloadInfo],
+        allow_borrowing: bool,
+        allow_borrowing_below_priority: Optional[int],
+        backend: str = "jax") -> List[WorkloadInfo]:
+    """Drop-in for scheduler.preemption._minimal_preemptions, solved on the
+    device. Does not mutate the snapshot (the host version restores it)."""
+    if not candidates:
+        return []
+    p = encode_problem(cq, snapshot, wl_req, res_per_flv, candidates,
+                       allow_borrowing, allow_borrowing_below_priority)
+    if backend == "pallas":
+        from kueue_tpu.ops.preemption_pallas import scan_kernel_pallas
+        victim, fits = scan_kernel_pallas(p)
+    else:
+        victim, fits = scan_kernel(
+            jnp.asarray(p.usage0), jnp.asarray(p.nominal),
+            jnp.asarray(p.q_def), jnp.asarray(p.guaranteed),
+            jnp.asarray(p.wl_req), jnp.asarray(p.wl_req_mask),
+            jnp.asarray(p.blim), jnp.asarray(p.blim_def),
+            jnp.asarray(p.requestable), jnp.asarray(p.res_mask),
+            jnp.asarray(p.cand_y), jnp.asarray(p.cand_use),
+            jnp.asarray(p.cand_prio),
+            jnp.asarray(p.has_cohort), jnp.asarray(p.lending),
+            jnp.asarray(p.allow_borrowing),
+            jnp.asarray(p.threshold is not None),
+            jnp.asarray(p.threshold if p.threshold is not None else 0,
+                        dtype=jnp.int32))
+    if not bool(fits):
+        return []
+    mask = np.asarray(victim)
+    return [c for i, c in enumerate(candidates) if mask[i]]

@@ -35,11 +35,16 @@ DEFAULT_FAIR_STRATEGIES = (
 
 def get_targets(wi: WorkloadInfo, assignment: Assignment, snapshot: Snapshot,
                 ordering: WorkloadOrdering, now: float,
-                fair_strategies=DEFAULT_FAIR_STRATEGIES) -> List[WorkloadInfo]:
+                fair_strategies=DEFAULT_FAIR_STRATEGIES,
+                engine: Optional[str] = None) -> List[WorkloadInfo]:
     """Workloads to evict so `wi` fits (preemption.go:81-126).
 
     With the FairSharing gate on and the CQ in a cohort, victim selection is
     share-based (KEP-1714) instead of the classic priority/reclaim rules.
+
+    `engine` selects the minimalPreemptions implementation: None = the
+    sequential host referee; "jax" / "pallas" = the device scan
+    (ops/preemption_scan, ops/preemption_pallas — decision-equivalent).
     """
     res_per_flv = _resources_requiring_preemption(assignment)
     cq = snapshot.cluster_queues[wi.cluster_queue]
@@ -47,6 +52,17 @@ def get_targets(wi: WorkloadInfo, assignment: Assignment, snapshot: Snapshot,
     if features.enabled(features.FAIR_SHARING) and cq.cohort is not None:
         return _fair_preemptions(wi, assignment, snapshot, res_per_flv,
                                  ordering, now, fair_strategies)
+
+    def minimal(cands, allow_borrowing, threshold):
+        if engine in ("jax", "pallas"):
+            from kueue_tpu.ops.preemption_scan import \
+                minimal_preemptions_device
+            wl_req = _total_requests_for_assignment(wi, assignment)
+            return minimal_preemptions_device(
+                wl_req, cq, snapshot, res_per_flv, cands, allow_borrowing,
+                threshold, backend=engine)
+        return _minimal_preemptions(wi, assignment, snapshot, res_per_flv,
+                                    cands, allow_borrowing, threshold)
 
     candidates = _find_candidates(wi, ordering, cq, res_per_flv)
     if not candidates:
@@ -57,8 +73,7 @@ def get_targets(wi: WorkloadInfo, assignment: Assignment, snapshot: Snapshot,
 
     if len(same_queue) == len(candidates):
         # No cross-queue candidates: preempt within the CQ, borrowing allowed.
-        return _minimal_preemptions(wi, assignment, snapshot, res_per_flv,
-                                    candidates, True, None)
+        return minimal(candidates, True, None)
 
     bwc = cq.preemption.borrow_within_cohort
     if bwc is not None and bwc.policy != BorrowWithinCohortPolicy.NEVER:
@@ -66,15 +81,12 @@ def get_targets(wi: WorkloadInfo, assignment: Assignment, snapshot: Snapshot,
         if bwc.max_priority_threshold is not None \
                 and bwc.max_priority_threshold < threshold:
             threshold = bwc.max_priority_threshold + 1
-        return _minimal_preemptions(wi, assignment, snapshot, res_per_flv,
-                                    candidates, True, threshold)
+        return minimal(candidates, True, threshold)
 
-    targets = _minimal_preemptions(wi, assignment, snapshot, res_per_flv,
-                                   candidates, False, None)
+    targets = minimal(candidates, False, None)
     if not targets:
         # Second attempt: only same-queue candidates, with borrowing.
-        targets = _minimal_preemptions(wi, assignment, snapshot, res_per_flv,
-                                       same_queue, True, None)
+        targets = minimal(same_queue, True, None)
     return targets
 
 

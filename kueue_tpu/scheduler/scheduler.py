@@ -75,6 +75,7 @@ class Scheduler:
                  fair_strategies=preemption_mod.DEFAULT_FAIR_STRATEGIES,
                  workload_validator: Optional[
                      Callable[[Workload], List[str]]] = None,
+                 preemption_engine: Optional[str] = None,
                  clock: Callable[[], float] = _time.time):
         self.queues = queues
         self.cache = cache
@@ -94,6 +95,9 @@ class Scheduler:
         # validateLimitRange); returns reasons, empty == admissible.
         self.workload_validator = workload_validator or (lambda wl: [])
         self.fair_strategies = tuple(fair_strategies)
+        # minimalPreemptions engine: None = host referee, "jax"/"pallas" =
+        # device scan (ops/preemption_scan).
+        self.preemption_engine = preemption_engine
         self.clock = clock
         self.metrics = SchedulerMetrics()
 
@@ -194,7 +198,8 @@ class Scheduler:
         if mode == PREEMPT:
             targets = preemption_mod.get_targets(
                 wi, full, snap, self.ordering, self.clock(),
-                fair_strategies=self.fair_strategies)
+                fair_strategies=self.fair_strategies,
+                engine=self.preemption_engine)
         if not features.enabled(features.PARTIAL_ADMISSION) or targets:
             return full, targets
         if wi.obj.can_be_partially_admitted():
@@ -204,7 +209,8 @@ class Scheduler:
                     return (assignment, []), True
                 t = preemption_mod.get_targets(
                     wi, assignment, snap, self.ordering, self.clock(),
-                    fair_strategies=self.fair_strategies)
+                    fair_strategies=self.fair_strategies,
+                    engine=self.preemption_engine)
                 if t:
                     return (assignment, t), True
                 return None, False
