@@ -1,0 +1,167 @@
+// Keyed binary min-heap with in-place update/delete.
+//
+// Native counterpart of reference pkg/util/heap/heap.go (the pending-queue
+// data structure): items are addressed by a caller-assigned uint64 id and
+// ordered by a fixed-width lexicographic int64 key vector, so the hot
+// pending-queue operations (push/update/pop at 50k-workload backlogs) run
+// without interpreter dispatch. Exposed through a C ABI consumed by
+// ctypes (kueue_tpu/utils/native_heap.py).
+//
+// Build: g++ -O2 -shared -fPIC -o _libkueue_heap.so heap.cpp
+
+#include <cstdint>
+#include <cstring>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+struct Heap {
+    int key_len;
+    // Parallel arrays: ids[i] and keys[i*key_len .. ] describe slot i.
+    std::vector<uint64_t> ids;
+    std::vector<int64_t> keys;
+    std::unordered_map<uint64_t, size_t> index;
+
+    bool less(size_t a, size_t b) const {
+        const int64_t* ka = keys.data() + a * key_len;
+        const int64_t* kb = keys.data() + b * key_len;
+        for (int i = 0; i < key_len; i++) {
+            if (ka[i] != kb[i]) return ka[i] < kb[i];
+        }
+        return false;
+    }
+
+    void swap_slots(size_t i, size_t j) {
+        std::swap(ids[i], ids[j]);
+        for (int k = 0; k < key_len; k++) {
+            std::swap(keys[i * key_len + k], keys[j * key_len + k]);
+        }
+        index[ids[i]] = i;
+        index[ids[j]] = j;
+    }
+
+    void up(size_t i) {
+        while (i > 0) {
+            size_t parent = (i - 1) / 2;
+            if (!less(i, parent)) break;
+            swap_slots(i, parent);
+            i = parent;
+        }
+    }
+
+    bool down(size_t i) {
+        size_t n = ids.size(), start = i;
+        for (;;) {
+            size_t left = 2 * i + 1;
+            if (left >= n) break;
+            size_t smallest = left, right = left + 1;
+            if (right < n && less(right, left)) smallest = right;
+            if (!less(smallest, i)) break;
+            swap_slots(i, smallest);
+            i = smallest;
+        }
+        return i > start;
+    }
+
+    void fix(size_t i) {
+        if (!down(i)) up(i);
+    }
+
+    void push(uint64_t id, const int64_t* key) {
+        size_t i = ids.size();
+        ids.push_back(id);
+        keys.insert(keys.end(), key, key + key_len);
+        index[id] = i;
+        up(i);
+    }
+
+    // Removes slot i; returns its id.
+    uint64_t remove_at(size_t i) {
+        uint64_t id = ids[i];
+        index.erase(id);
+        size_t last = ids.size() - 1;
+        if (i != last) {
+            swap_slots(i, last);
+        }
+        ids.pop_back();
+        keys.resize(keys.size() - key_len);
+        if (i < ids.size()) {
+            // After the swap the index entry is stale only for slot i.
+            index[ids[i]] = i;
+            fix(i);
+        }
+        return id;
+    }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* kh_new(int key_len) { return new Heap{key_len}; }
+
+void kh_free(void* h) { delete static_cast<Heap*>(h); }
+
+int64_t kh_len(void* h) {
+    return static_cast<int64_t>(static_cast<Heap*>(h)->ids.size());
+}
+
+int kh_contains(void* h, uint64_t id) {
+    Heap* hp = static_cast<Heap*>(h);
+    return hp->index.count(id) ? 1 : 0;
+}
+
+// Returns 1 when inserted, 0 when the id was already present (no update).
+int kh_push_if_not_present(void* h, uint64_t id, const int64_t* key) {
+    Heap* hp = static_cast<Heap*>(h);
+    if (hp->index.count(id)) return 0;
+    hp->push(id, key);
+    return 1;
+}
+
+void kh_push_or_update(void* h, uint64_t id, const int64_t* key) {
+    Heap* hp = static_cast<Heap*>(h);
+    auto it = hp->index.find(id);
+    if (it == hp->index.end()) {
+        hp->push(id, key);
+        return;
+    }
+    size_t i = it->second;
+    std::memcpy(hp->keys.data() + i * hp->key_len, key,
+                sizeof(int64_t) * hp->key_len);
+    hp->fix(i);
+}
+
+// Returns 1 when the id existed and was removed.
+int kh_delete(void* h, uint64_t id) {
+    Heap* hp = static_cast<Heap*>(h);
+    auto it = hp->index.find(id);
+    if (it == hp->index.end()) return 0;
+    hp->remove_at(it->second);
+    return 1;
+}
+
+// Returns the popped id, or UINT64_MAX when empty.
+uint64_t kh_pop(void* h) {
+    Heap* hp = static_cast<Heap*>(h);
+    if (hp->ids.empty()) return UINT64_MAX;
+    return hp->remove_at(0);
+}
+
+uint64_t kh_peek(void* h) {
+    Heap* hp = static_cast<Heap*>(h);
+    if (hp->ids.empty()) return UINT64_MAX;
+    return hp->ids[0];
+}
+
+// Copies all ids (heap-array order) into out (caller-sized); returns count.
+int64_t kh_items(void* h, uint64_t* out, int64_t cap) {
+    Heap* hp = static_cast<Heap*>(h);
+    int64_t n = static_cast<int64_t>(hp->ids.size());
+    if (n > cap) n = cap;
+    std::memcpy(out, hp->ids.data(), sizeof(uint64_t) * n);
+    return n;
+}
+
+}  // extern "C"

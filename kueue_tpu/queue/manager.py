@@ -11,6 +11,7 @@ inadmissible flushes, and the blocking `heads()` used by the scheduler tick
 
 from __future__ import annotations
 
+import os
 import threading
 import time as _time
 from typing import Callable, Dict, List, Mapping, Optional
@@ -52,8 +53,7 @@ class PendingClusterQueue:
         self.active = True
         self._ordering = ordering
         self._clock = clock
-        self.heap: KeyedHeap[WorkloadInfo] = KeyedHeap(
-            key_fn=lambda wi: wi.key, less=self._less)
+        self.heap = self._make_heap()
         self.inadmissible: Dict[str, WorkloadInfo] = {}
         # Admission-relevant state at park time; the runtime shares Workload
         # objects, so change detection must compare against a snapshot, not
@@ -73,6 +73,21 @@ class PendingClusterQueue:
         ta = self._ordering.queue_order_time(a.obj)
         tb = self._ordering.queue_order_time(b.obj)
         return not tb < ta
+
+    def _make_heap(self):
+        """Native C++ heap when the toolchain built it (utils/native_heap,
+        the counterpart of the reference's Go heap running outside the
+        interpreter); pure-Python fallback otherwise."""
+        if os.environ.get("KUEUE_TPU_NATIVE_HEAP", "1") != "0":
+            from kueue_tpu.utils import native_heap
+            if native_heap.native_available():
+                return native_heap.NativeKeyedHeap(
+                    key_fn=lambda wi: wi.key,
+                    sort_key_fn=lambda wi: (
+                        -wi.obj.priority,
+                        int(self._ordering.queue_order_time(wi.obj) * 1e9)),
+                    key_len=2)
+        return KeyedHeap(key_fn=lambda wi: wi.key, less=self._less)
 
     def update(self, spec: ClusterQueue) -> None:
         self.cohort = spec.cohort

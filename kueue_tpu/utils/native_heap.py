@@ -1,0 +1,189 @@
+"""ctypes binding for the native keyed heap (kueue_tpu/native/heap.cpp).
+
+The shared library is built on first import with the toolchain's g++ and
+cached next to the source; when the toolchain or the build is unavailable
+the caller falls back to the pure-Python `utils.heap.KeyedHeap` (same
+interface, same ordering contract).
+
+`NativeKeyedHeap` orders items by a caller-supplied integer sort-key vector
+(lexicographic ascending), the native mirror of the `less` callable of the
+Python heap.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Callable, Dict, Generic, List, Optional, Sequence, TypeVar
+
+T = TypeVar("T")
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "native")
+_SRC = os.path.join(_NATIVE_DIR, "heap.cpp")
+_LIB = os.path.join(_NATIVE_DIR, "_libkueue_heap.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _build() -> bool:
+    try:
+        if (os.path.exists(_LIB)
+                and os.path.getmtime(_LIB) >= os.path.getmtime(_SRC)):
+            return True
+        result = subprocess.run(
+            ["g++", "-O2", "-shared", "-fPIC", "-std=c++17",
+             "-o", _LIB + ".tmp", _SRC],
+            capture_output=True, timeout=120)
+        if result.returncode != 0:
+            return False
+        os.replace(_LIB + ".tmp", _LIB)
+        return True
+    except (OSError, subprocess.SubprocessError):
+        return False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _tried
+    with _lock:
+        if _tried:
+            return _lib
+        _tried = True
+        if not _build():
+            return None
+        try:
+            lib = ctypes.CDLL(_LIB)
+        except OSError:
+            return None
+        lib.kh_new.restype = ctypes.c_void_p
+        lib.kh_new.argtypes = [ctypes.c_int]
+        lib.kh_free.argtypes = [ctypes.c_void_p]
+        lib.kh_len.restype = ctypes.c_int64
+        lib.kh_len.argtypes = [ctypes.c_void_p]
+        lib.kh_contains.restype = ctypes.c_int
+        lib.kh_contains.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+        lib.kh_push_if_not_present.restype = ctypes.c_int
+        lib.kh_push_if_not_present.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint64, ctypes.POINTER(ctypes.c_int64)]
+        lib.kh_push_or_update.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint64, ctypes.POINTER(ctypes.c_int64)]
+        lib.kh_delete.restype = ctypes.c_int
+        lib.kh_delete.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+        lib.kh_pop.restype = ctypes.c_uint64
+        lib.kh_pop.argtypes = [ctypes.c_void_p]
+        lib.kh_peek.restype = ctypes.c_uint64
+        lib.kh_peek.argtypes = [ctypes.c_void_p]
+        lib.kh_items.restype = ctypes.c_int64
+        lib.kh_items.argtypes = [ctypes.c_void_p,
+                                 ctypes.POINTER(ctypes.c_uint64),
+                                 ctypes.c_int64]
+        _lib = lib
+        return _lib
+
+
+def native_available() -> bool:
+    return _load() is not None
+
+
+_EMPTY = 2**64 - 1
+
+
+class NativeKeyedHeap(Generic[T]):
+    """Drop-in for utils.heap.KeyedHeap, ordered by an integer key vector.
+
+    `sort_key_fn(item)` returns a fixed-length tuple of ints; smaller sorts
+    first (encode "priority desc" as -priority). Keys are refreshed on
+    push_or_update, exactly like the Python heap's `_fix`.
+    """
+
+    def __init__(self, key_fn: Callable[[T], str],
+                 sort_key_fn: Callable[[T], Sequence[int]],
+                 key_len: int):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError("native heap unavailable")
+        self._libref = lib
+        self._key_fn = key_fn
+        self._sort_key_fn = sort_key_fn
+        self._key_len = key_len
+        # +1: the item id is appended as a deterministic final tiebreak
+        # (first-inserted key wins among equal sort keys).
+        self._h = lib.kh_new(key_len + 1)
+        self._next_id = 0
+        self._id_by_key: Dict[str, int] = {}
+        self._obj_by_id: Dict[int, T] = {}
+
+    def __del__(self):
+        try:
+            self._libref.kh_free(self._h)
+        except Exception:
+            pass
+
+    def __len__(self) -> int:
+        return int(self._libref.kh_len(self._h))
+
+    def __contains__(self, key: str) -> bool:
+        return key in self._id_by_key
+
+    def _ckey(self, item: T, item_id: int):
+        vec = tuple(self._sort_key_fn(item))
+        assert len(vec) == self._key_len
+        return (ctypes.c_int64 * (self._key_len + 1))(*vec, item_id)
+
+    def _id_for(self, key: str) -> int:
+        i = self._id_by_key.get(key)
+        if i is None:
+            i = self._next_id
+            self._next_id += 1
+            self._id_by_key[key] = i
+        return i
+
+    def get_by_key(self, key: str) -> Optional[T]:
+        i = self._id_by_key.get(key)
+        return self._obj_by_id.get(i) if i is not None else None
+
+    def items(self) -> List[T]:
+        n = len(self)
+        buf = (ctypes.c_uint64 * n)()
+        got = self._libref.kh_items(self._h, buf, n)
+        return [self._obj_by_id[buf[i]] for i in range(got)]
+
+    def push_if_not_present(self, item: T) -> bool:
+        key = self._key_fn(item)
+        i = self._id_for(key)
+        inserted = self._libref.kh_push_if_not_present(
+            self._h, i, self._ckey(item, i))
+        if inserted:
+            self._obj_by_id[i] = item
+            return True
+        return False
+
+    def push_or_update(self, item: T) -> None:
+        key = self._key_fn(item)
+        i = self._id_for(key)
+        self._obj_by_id[i] = item
+        self._libref.kh_push_or_update(self._h, i, self._ckey(item, i))
+
+    def delete(self, key: str) -> Optional[T]:
+        i = self._id_by_key.get(key)
+        if i is None or not self._libref.kh_delete(self._h, i):
+            return None
+        obj = self._obj_by_id.pop(i)
+        del self._id_by_key[key]
+        return obj
+
+    def peek(self) -> Optional[T]:
+        i = self._libref.kh_peek(self._h)
+        return None if i == _EMPTY else self._obj_by_id[i]
+
+    def pop(self) -> Optional[T]:
+        i = self._libref.kh_pop(self._h)
+        if i == _EMPTY:
+            return None
+        obj = self._obj_by_id.pop(i)
+        del self._id_by_key[self._key_fn(obj)]
+        return obj

@@ -1,0 +1,138 @@
+"""Native C++ keyed heap: parity with the Python heap + microbench sanity.
+
+The native heap (utils/native_heap.py over native/heap.cpp) must order and
+mutate identically to utils/heap.KeyedHeap under the pending-queue ordering
+contract (priority desc, timestamp asc).
+"""
+
+import random
+import time
+
+import pytest
+
+from kueue_tpu.utils import native_heap
+from kueue_tpu.utils.heap import KeyedHeap
+
+pytestmark = pytest.mark.skipif(
+    not native_heap.native_available(), reason="no native toolchain")
+
+
+class Item:
+    def __init__(self, key, priority, ts):
+        self.key = key
+        self.priority = priority
+        self.ts = ts
+
+    def __repr__(self):
+        return f"Item({self.key}, p={self.priority}, t={self.ts})"
+
+
+def make_pair():
+    py = KeyedHeap(
+        key_fn=lambda it: it.key,
+        less=lambda a, b: (a.priority > b.priority
+                           or (a.priority == b.priority and a.ts <= b.ts)))
+    nat = native_heap.NativeKeyedHeap(
+        key_fn=lambda it: it.key,
+        sort_key_fn=lambda it: (-it.priority, int(it.ts * 1e9)),
+        key_len=2)
+    return py, nat
+
+
+class TestParity:
+    def test_basic_order(self):
+        py, nat = make_pair()
+        items = [Item("a", 0, 3.0), Item("b", 5, 9.0), Item("c", 0, 1.0),
+                 Item("d", 5, 2.0)]
+        for it in items:
+            py.push_if_not_present(it)
+            nat.push_if_not_present(it)
+        order_py = [py.pop().key for _ in range(4)]
+        order_nat = [nat.pop().key for _ in range(4)]
+        assert order_py == order_nat == ["d", "b", "c", "a"]
+
+    def test_update_reorders(self):
+        _, nat = make_pair()
+        a, b = Item("a", 0, 1.0), Item("b", 0, 2.0)
+        nat.push_if_not_present(a)
+        nat.push_if_not_present(b)
+        assert nat.peek().key == "a"
+        b.priority = 10
+        nat.push_or_update(b)
+        assert nat.peek().key == "b"
+
+    def test_delete_and_contains(self):
+        _, nat = make_pair()
+        a = Item("a", 0, 1.0)
+        nat.push_if_not_present(a)
+        assert "a" in nat and len(nat) == 1
+        assert nat.delete("a").key == "a"
+        assert "a" not in nat and len(nat) == 0
+        assert nat.delete("a") is None
+        assert nat.pop() is None
+
+    def test_randomized_pop_order_parity(self):
+        rnd = random.Random(7)
+        py, nat = make_pair()
+        live = {}
+        for step in range(3000):
+            op = rnd.random()
+            if op < 0.55 or not live:
+                key = f"k{rnd.randrange(800)}"
+                it = Item(key, rnd.randrange(5),
+                          round(rnd.uniform(0, 100), 6))
+                if key in live:
+                    live[key] = it
+                    py.push_or_update(it)
+                    nat.push_or_update(it)
+                else:
+                    live[key] = it
+                    py.push_if_not_present(it)
+                    nat.push_if_not_present(it)
+            elif op < 0.75:
+                key = rnd.choice(list(live))
+                del live[key]
+                assert (py.delete(key) is None) == (nat.delete(key) is None)
+            else:
+                a, b = py.pop(), nat.pop()
+                # Ties on (priority, ts) may legitimately order differently;
+                # compare sort keys, not identities.
+                assert (a.priority, a.ts) == (b.priority, b.ts)
+                # Keep both heaps consistent: remove whichever the other
+                # popped too.
+                if a.key != b.key:
+                    py.delete(b.key)
+                    nat.delete(a.key)
+                    live.pop(b.key, None)
+                live.pop(a.key, None)
+        while True:
+            a, b = py.pop(), nat.pop()
+            assert (a is None) == (b is None)
+            if a is None:
+                break
+            assert (a.priority, a.ts) == (b.priority, b.ts)
+            if a.key != b.key:
+                py.delete(b.key)
+                nat.delete(a.key)
+
+
+class TestSpeed:
+    def test_native_faster_at_scale(self):
+        n = 20000
+        items = [Item(f"k{i}", random.randrange(10), random.random())
+                 for i in range(n)]
+        py, nat = make_pair()
+        t0 = time.perf_counter()
+        for it in items:
+            py.push_if_not_present(it)
+        while py.pop() is not None:
+            pass
+        t_py = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        for it in items:
+            nat.push_if_not_present(it)
+        while nat.pop() is not None:
+            pass
+        t_nat = time.perf_counter() - t0
+        # The native heap should never be slower than Python at 20k items.
+        assert t_nat < t_py, (t_nat, t_py)
