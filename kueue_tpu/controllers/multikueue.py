@@ -19,12 +19,45 @@ from __future__ import annotations
 
 import abc
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 from kueue_tpu.api.types import AdmissionCheckState, Workload
 
 MULTIKUEUE_CHECK_CONTROLLER = "kueue.x-k8s.io/multikueue"
 DEFAULT_WORKER_LOST_TIMEOUT = 15 * 60.0
+
+# Reconnect backoff for lost workers (multikueuecluster.go:64-69).
+RECONNECT_BASE_SECONDS = 5.0
+RECONNECT_MAX_SECONDS = 300.0
+
+
+@dataclass
+class MultiKueueConfig:
+    """reference: apis/kueue/v1alpha1 MultiKueueConfig — names the worker
+    clusters one MultiKueue AdmissionCheck dispatches to."""
+
+    name: str
+    clusters: Tuple[str, ...] = ()
+
+
+@dataclass
+class MultiKueueCluster:
+    """reference: apis/kueue/v1alpha1 MultiKueueCluster — one worker
+    cluster's connection spec plus its Active condition mirror.
+
+    `kubeconfig_ref` is (location_type, location): the reference reads a
+    kubeconfig from a secret or path (multikueuecluster.go:423-453); the
+    embedded runtime resolves it through a client factory instead.
+    """
+
+    name: str
+    kubeconfig_ref: Tuple[str, str] = ("Path", "")
+    # Status mirror
+    active: bool = False
+    active_reason: str = "Inactive"
+    active_message: str = ""
+    failed_connection_attempts: int = 0
+    next_reconnect_at: Optional[float] = None
 
 
 class RemoteClient(abc.ABC):
@@ -44,14 +77,37 @@ class RemoteClient(abc.ABC):
         """{'quota_reserved': bool, 'admitted': bool, 'finished': bool,
         'success': bool} or None if absent."""
 
+    def list_workload_keys(self) -> List[str]:
+        """Keys of remote workloads this manager created (GC support)."""
+        return []
+
+
+class JobAdapter(abc.ABC):
+    """Per-framework remote job sync (reference: multikueue jobAdapter,
+    batchjob_adapter.go / jobset_adapter.go): creates the *job* object on
+    the worker alongside the mirrored workload and copies remote job status
+    back once the remote is reserving."""
+
+    @abc.abstractmethod
+    def sync_job(self, client: RemoteClient, local_job, wl: Workload) -> None:
+        """Ensure the job exists remotely (create on first call)."""
+
+    @abc.abstractmethod
+    def copy_status_remote_to_local(self, client: RemoteClient, local_job,
+                                    wl: Workload) -> None: ...
+
 
 class InProcessRemote(RemoteClient):
-    """A worker cluster hosted by another Framework instance in-process."""
+    """A worker cluster hosted by another Framework instance in-process
+    (the envtest-style two-cluster simulation of test/integration/multikueue)."""
 
     def __init__(self, framework, queue_name: str = "main"):
         self.fw = framework
         self.queue_name = queue_name
         self._up = True
+        self._created: set = set()
+        # name -> remote GenericJob (job adapter surface)
+        self.jobs: Dict[str, object] = {}
 
     def set_connected(self, up: bool) -> None:
         self._up = up
@@ -66,11 +122,19 @@ class InProcessRemote(RemoteClient):
             pod_sets=copy.deepcopy(wl.pod_sets), priority=wl.priority,
             creation_time=wl.creation_time)
         self.fw.submit(remote)
+        self._created.add(remote.key)
 
     def delete_workload(self, key: str) -> None:
         wl = self.fw.workloads.get(key)
         if wl is not None:
             self.fw.delete_workload(wl)
+        self._created.discard(key)
+        # Adapter-created remote jobs bound to this mirror go with it
+        # (the remote job is owned by the mirrored workload).
+        for job_key, (job, wl_key) in list(self.fw.job_reconciler.jobs.items()):
+            if wl_key == key:
+                del self.fw.job_reconciler.jobs[job_key]
+                self.jobs.pop(job_key, None)
 
     def get_status(self, key: str) -> Optional[dict]:
         wl = self.fw.workloads.get(key)
@@ -82,6 +146,45 @@ class InProcessRemote(RemoteClient):
             "finished": wl.is_finished,
             "success": wl.is_finished,
         }
+
+    def list_workload_keys(self) -> List[str]:
+        return [k for k in self._created if k in self.fw.workloads]
+
+
+class BatchJobAdapter(JobAdapter):
+    """batch/Job adapter (reference: multikueue/batchjob_adapter.go): mirrors
+    a local BatchJob onto the worker and copies remote counters back."""
+
+    @staticmethod
+    def _job_key(local_job) -> str:
+        return f"{local_job.namespace}/{local_job.name}"
+
+    def sync_job(self, client: RemoteClient, local_job, wl: Workload) -> None:
+        if not isinstance(client, InProcessRemote):
+            raise NotImplementedError("adapter requires an InProcessRemote")
+        key = self._job_key(local_job)
+        if key in client.jobs:
+            return
+        from kueue_tpu.jobs.batch_job import BatchJob
+        remote = BatchJob(
+            name=local_job.name, queue_name=client.queue_name,
+            parallelism=local_job.original_parallelism,
+            completions=local_job.completions,
+            requests=dict(local_job._requests),
+            namespace=local_job.namespace)
+        client.jobs[key] = remote
+        # The remote job reuses the mirrored workload rather than creating
+        # a second one (managed-by semantics, workload.go:232-300).
+        client.fw.job_reconciler.jobs[key] = (remote, wl.key)
+
+    def copy_status_remote_to_local(self, client: RemoteClient, local_job,
+                                    wl: Workload) -> None:
+        remote = getattr(client, "jobs", {}).get(self._job_key(local_job))
+        if remote is None:
+            return
+        local_job.ready_pods = remote.ready_pods
+        local_job.succeeded = remote.succeeded
+        local_job.failed = remote.failed
 
 
 @dataclass
@@ -95,21 +198,110 @@ class MultiKueueController:
     """Drives MultiKueue-type AdmissionChecks against worker clusters."""
 
     def __init__(self, framework, check_name: str = "multikueue",
-                 worker_lost_timeout: float = DEFAULT_WORKER_LOST_TIMEOUT):
+                 worker_lost_timeout: float = DEFAULT_WORKER_LOST_TIMEOUT,
+                 client_factory=None):
         self.fw = framework
         self.check_name = check_name
         self.clusters: Dict[str, RemoteClient] = {}
+        self.cluster_specs: Dict[str, MultiKueueCluster] = {}
+        self.configs: Dict[str, MultiKueueConfig] = {}
+        # check name -> MultiKueueConfig name (AdmissionCheck parameters ref)
+        self.check_configs: Dict[str, str] = {}
+        # job kind -> JobAdapter (batchjob_adapter.go / jobset_adapter.go)
+        self.adapters: Dict[str, JobAdapter] = {}
+        # MultiKueueCluster name -> RemoteClient or None (factory connects;
+        # multikueuecluster.go:423-453 builds clients from kubeconfigs)
+        self.client_factory = client_factory
         self.worker_lost_timeout = worker_lost_timeout
         self._dispatches: Dict[str, _Dispatch] = {}
+        # Only specs registered through add_cluster_spec are factory-managed;
+        # directly-registered clients (add_cluster) keep their client object
+        # across transient disconnects so worker-lost-timeout applies.
+        self._factory_managed: set = set()
 
     def add_cluster(self, name: str, client: RemoteClient) -> None:
+        """Directly register a connected worker (tests / embedded use)."""
         self.clusters[name] = client
+        self.cluster_specs.setdefault(
+            name, MultiKueueCluster(name=name, active=True, active_reason="Active"))
 
     def remove_cluster(self, name: str) -> None:
         self.clusters.pop(name, None)
+        self.cluster_specs.pop(name, None)
+
+    def add_cluster_spec(self, spec: MultiKueueCluster) -> None:
+        """Register a worker by spec; the client factory connects it with
+        exponential backoff (multikueuecluster.go:64-69,139-188)."""
+        self.cluster_specs[spec.name] = spec
+        self._factory_managed.add(spec.name)
+
+    def add_config(self, config: MultiKueueConfig) -> None:
+        self.configs[config.name] = config
+
+    def register_adapter(self, kind: str, adapter: JobAdapter) -> None:
+        self.adapters[kind] = adapter
+
+    def _clusters_for_check(self) -> Dict[str, RemoteClient]:
+        """The worker set this check dispatches to: all clusters when no
+        config is bound, the configured subset when one is, and NONE when
+        the bound MultiKueueConfig is missing (the check is inactive in the
+        reference until its config resolves)."""
+        config_name = self.check_configs.get(self.check_name)
+        if config_name is None:
+            return self.clusters
+        config = self.configs.get(config_name)
+        if config is None:
+            return {}
+        return {n: c for n, c in self.clusters.items()
+                if n in config.clusters}
+
+    def reconcile_clusters(self) -> None:
+        """Connection lifecycle for spec-registered workers: try the
+        factory, track the Active condition, back off exponentially on
+        failure (the multikueuecluster reconciler)."""
+        if self.client_factory is None:
+            return
+        now = self.fw.clock()
+        for name, spec in self.cluster_specs.items():
+            if name not in self._factory_managed:
+                continue
+            client = self.clusters.get(name)
+            if client is not None and client.connected():
+                spec.active = True
+                spec.active_reason = "Active"
+                spec.failed_connection_attempts = 0
+                spec.next_reconnect_at = None
+                continue
+            spec.active = False
+            if spec.next_reconnect_at is not None \
+                    and now < spec.next_reconnect_at:
+                continue
+            client = self.client_factory(spec)
+            if client is not None and client.connected():
+                self.clusters[name] = client
+                spec.active = True
+                spec.active_reason = "Active"
+                spec.failed_connection_attempts = 0
+                spec.next_reconnect_at = None
+            else:
+                self.clusters.pop(name, None)
+                spec.failed_connection_attempts += 1
+                spec.active_reason = "ClientConnectionFailed"
+                backoff = min(
+                    RECONNECT_BASE_SECONDS
+                    * 2 ** (spec.failed_connection_attempts - 1),
+                    RECONNECT_MAX_SECONDS)
+                spec.next_reconnect_at = now + backoff
 
     def reconcile(self) -> None:
+        self.reconcile_clusters()
         now = self.fw.clock()
+        # One O(jobs) sweep builds the reverse workload->job map for the
+        # whole pass (vs a scan per reconciled workload).
+        jobs_by_wl = {
+            wl_key: (getattr(type(job), "kind", None), job)
+            for job, wl_key in self.fw.job_reconciler.jobs.values()
+        }
         for wl in list(self.fw.workloads.values()):
             cq = self.fw.cache.cluster_queues.get(
                 wl.admission.cluster_queue if wl.admission else "")
@@ -120,21 +312,36 @@ class MultiKueueController:
                 continue
             if not wl.has_quota_reservation:
                 continue
-            self._reconcile_workload(wl, now)
-        # GC dispatches whose local workload disappeared
-        # (multikueuecluster.go:476-500).
+            self._reconcile_workload(wl, now, jobs_by_wl)
+        # GC dispatches whose local workload disappeared, and remote
+        # orphans no dispatch owns (multikueuecluster.go:476-500).
         for key in list(self._dispatches):
             if key not in self.fw.workloads:
                 self._gc(key)
+        owned = set(self._dispatches)
+        for client in self.clusters.values():
+            if not client.connected():
+                continue
+            for key in client.list_workload_keys():
+                if key not in owned:
+                    client.delete_workload(key)
 
-    def _reconcile_workload(self, wl: Workload, now: float) -> None:
+
+    def _reconcile_workload(self, wl: Workload, now: float,
+                            jobs_by_wl: Dict[str, tuple]) -> None:
         d = self._dispatches.setdefault(wl.key, _Dispatch())
+        workers = self._clusters_for_check()
+        kind, local_job = jobs_by_wl.get(wl.key, (None, None))
+        adapter = self.adapters.get(kind) if kind else None
 
-        # Create the mirror on every connected worker (workload.go:232-300).
+        # Create the mirror (workload + job via the adapter) on every
+        # connected worker (workload.go:232-300).
         if d.kept_on is None:
-            for name, client in self.clusters.items():
+            for name, client in workers.items():
                 if name not in d.created_on and client.connected():
                     client.create_workload(wl)
+                    if adapter is not None and local_job is not None:
+                        adapter.sync_job(client, local_job, wl)
                     d.created_on.append(name)
             if not wl.admission_check_states.get(self.check_name):
                 wl.admission_check_states[self.check_name] = \
@@ -181,6 +388,10 @@ class MultiKueueController:
                                         message="Reserving remote lost")
             return
         d.lost_since = None
+        if adapter is not None and local_job is not None:
+            # Remote job status flows back while the remote runs
+            # (jobAdapter.CopyStatusRemoteObject).
+            adapter.copy_status_remote_to_local(client, local_job, wl)
         if status["finished"]:
             self.fw.finish(wl)
             self._gc(wl.key)

@@ -1,0 +1,258 @@
+"""Watchable in-memory object store: the API front end.
+
+The reference externalizes all state to the Kubernetes apiserver and wires
+controllers through informer watch caches (SURVEY §2.5 "distributed
+communication backend"). This module is that boundary for the embedded
+runtime: a versioned, thread-safe object store with watch fan-out
+(apiserver + client-go analog, usable like envtest in tests), plus
+`StoreAdapter`, the controller that mirrors store writes into a running
+`Framework` — the counterpart of pkg/controller/core's reconcilers feeding
+queue.Manager and cache.Cache from watch events.
+
+Webhooks run at the store boundary exactly as in the reference: defaulting
+then validation on create, update validation (immutability rules) on
+update (pkg/webhooks/).
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Tuple
+
+from kueue_tpu import webhooks
+from kueue_tpu.api.types import (
+    AdmissionCheck,
+    ClusterQueue,
+    LocalQueue,
+    ResourceFlavor,
+    Workload,
+    WorkloadPriorityClass,
+)
+
+# Kind names (the CRD vocabulary).
+KIND_CLUSTER_QUEUE = "ClusterQueue"
+KIND_LOCAL_QUEUE = "LocalQueue"
+KIND_RESOURCE_FLAVOR = "ResourceFlavor"
+KIND_WORKLOAD = "Workload"
+KIND_WORKLOAD_PRIORITY_CLASS = "WorkloadPriorityClass"
+KIND_ADMISSION_CHECK = "AdmissionCheck"
+
+ADDED = "ADDED"
+MODIFIED = "MODIFIED"
+DELETED = "DELETED"
+
+_CLUSTER_SCOPED = {
+    KIND_CLUSTER_QUEUE, KIND_RESOURCE_FLAVOR,
+    KIND_WORKLOAD_PRIORITY_CLASS, KIND_ADMISSION_CHECK,
+}
+
+_VALIDATORS: Dict[str, Tuple[Optional[Callable], Optional[Callable]]] = {
+    # kind -> (validate_create, validate_update)
+    KIND_CLUSTER_QUEUE: (webhooks.validate_cluster_queue,
+                         webhooks.validate_cluster_queue_update),
+    KIND_LOCAL_QUEUE: (webhooks.validate_local_queue,
+                       webhooks.validate_local_queue_update),
+    KIND_RESOURCE_FLAVOR: (webhooks.validate_resource_flavor, None),
+    KIND_WORKLOAD: (webhooks.validate_workload,
+                    webhooks.validate_workload_update),
+    KIND_ADMISSION_CHECK: (webhooks.validate_admission_check,
+                           webhooks.validate_admission_check_update),
+    KIND_WORKLOAD_PRIORITY_CLASS: (None, None),
+}
+
+_DEFAULTERS: Dict[str, Callable] = {
+    KIND_CLUSTER_QUEUE: webhooks.default_cluster_queue,
+    KIND_WORKLOAD: webhooks.default_workload,
+}
+
+
+@dataclass
+class Event:
+    type: str          # ADDED | MODIFIED | DELETED
+    kind: str
+    key: str           # "namespace/name" or "name" for cluster-scoped
+    obj: object
+    resource_version: int
+
+
+def _obj_key(kind: str, obj) -> str:
+    if kind in _CLUSTER_SCOPED:
+        return obj.name
+    return f"{getattr(obj, 'namespace', 'default')}/{obj.name}"
+
+
+class Store:
+    """Versioned object store with watch fan-out (apiserver analog)."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._objects: Dict[str, Dict[str, object]] = {}
+        self._versions: Dict[Tuple[str, str], int] = {}
+        self._rv = itertools.count(1)
+        self._watchers: Dict[str, List[Callable[[Event], None]]] = {}
+
+    # -- watch (informer analog) -------------------------------------------
+
+    def watch(self, kind: str, callback: Callable[[Event], None],
+              send_initial: bool = True) -> None:
+        """Register a watcher; existing objects replay as ADDED first
+        (informer initial list-then-watch semantics)."""
+        with self._lock:
+            self._watchers.setdefault(kind, []).append(callback)
+            if send_initial:
+                for key, obj in self._objects.get(kind, {}).items():
+                    callback(Event(ADDED, kind, key, obj,
+                                   self._versions[(kind, key)]))
+
+    def _notify(self, event: Event) -> None:
+        for cb in self._watchers.get(event.kind, []):
+            cb(event)
+
+    # -- CRUD (webhooked, like apiserver admission) ------------------------
+
+    def create(self, kind: str, obj) -> object:
+        with self._lock:
+            defaulter = _DEFAULTERS.get(kind)
+            if defaulter is not None:
+                defaulter(obj)
+            validate, _ = _VALIDATORS.get(kind, (None, None))
+            if validate is not None:
+                errs = validate(obj)
+                if errs:
+                    raise webhooks.ValidationError(errs)
+            key = _obj_key(kind, obj)
+            if key in self._objects.get(kind, {}):
+                raise ValueError(f"{kind} {key} already exists")
+            rv = next(self._rv)
+            self._objects.setdefault(kind, {})[key] = obj
+            self._versions[(kind, key)] = rv
+            self._notify(Event(ADDED, kind, key, obj, rv))
+            return obj
+
+    def update(self, kind: str, obj) -> object:
+        with self._lock:
+            key = _obj_key(kind, obj)
+            old = self._objects.get(kind, {}).get(key)
+            if old is None:
+                raise KeyError(f"{kind} {key} not found")
+            _, validate_update = _VALIDATORS.get(kind, (None, None))
+            if validate_update is not None and old is not obj:
+                errs = validate_update(obj, old)
+                if errs:
+                    raise webhooks.ValidationError(errs)
+            rv = next(self._rv)
+            self._objects[kind][key] = obj
+            self._versions[(kind, key)] = rv
+            self._notify(Event(MODIFIED, kind, key, obj, rv))
+            return obj
+
+    def update_status(self, kind: str, obj) -> object:
+        """Status writes bypass spec validation (the /status subresource)."""
+        with self._lock:
+            key = _obj_key(kind, obj)
+            if key not in self._objects.get(kind, {}):
+                raise KeyError(f"{kind} {key} not found")
+            rv = next(self._rv)
+            self._objects[kind][key] = obj
+            self._versions[(kind, key)] = rv
+            self._notify(Event(MODIFIED, kind, key, obj, rv))
+            return obj
+
+    def delete(self, kind: str, key: str) -> Optional[object]:
+        with self._lock:
+            obj = self._objects.get(kind, {}).pop(key, None)
+            if obj is None:
+                return None
+            rv = next(self._rv)
+            self._versions.pop((kind, key), None)
+            self._notify(Event(DELETED, kind, key, obj, rv))
+            return obj
+
+    def get(self, kind: str, key: str) -> Optional[object]:
+        with self._lock:
+            return self._objects.get(kind, {}).get(key)
+
+    def list(self, kind: str, namespace: Optional[str] = None) -> List[object]:
+        with self._lock:
+            objs = list(self._objects.get(kind, {}).values())
+        if namespace is not None:
+            objs = [o for o in objs
+                    if getattr(o, "namespace", None) == namespace]
+        return objs
+
+    def resource_version(self, kind: str, key: str) -> Optional[int]:
+        with self._lock:
+            return self._versions.get((kind, key))
+
+
+class StoreAdapter:
+    """Mirrors store events into a Framework (core controllers analog).
+
+    Watches every kueue kind and applies creates/updates/deletes to the
+    runtime's queues and cache, like pkg/controller/core's reconcilers;
+    after each scheduling pass, `sync_status` writes workload status back
+    to the store (the SSA admission-status patch analog,
+    workload.go:416-422).
+    """
+
+    def __init__(self, store: Store, framework):
+        self.store = store
+        self.fw = framework
+        store.watch(KIND_RESOURCE_FLAVOR, self._on_flavor)
+        store.watch(KIND_CLUSTER_QUEUE, self._on_cluster_queue)
+        store.watch(KIND_LOCAL_QUEUE, self._on_local_queue)
+        store.watch(KIND_WORKLOAD_PRIORITY_CLASS, self._on_priority_class)
+        store.watch(KIND_ADMISSION_CHECK, self._on_admission_check)
+        store.watch(KIND_WORKLOAD, self._on_workload)
+
+    def _on_flavor(self, ev: Event) -> None:
+        if ev.type in (ADDED, MODIFIED):
+            self.fw.create_resource_flavor(ev.obj)
+
+    def _on_cluster_queue(self, ev: Event) -> None:
+        if ev.type == ADDED:
+            self.fw.create_cluster_queue(ev.obj)
+        elif ev.type == MODIFIED:
+            self.fw.update_cluster_queue(ev.obj)
+        else:
+            self.fw.delete_cluster_queue(ev.obj.name)
+
+    def _on_local_queue(self, ev: Event) -> None:
+        if ev.type == ADDED:
+            self.fw.create_local_queue(ev.obj)
+        elif ev.type == MODIFIED:
+            self.fw.update_local_queue(ev.obj)
+        else:
+            self.fw.delete_local_queue(ev.obj)
+
+    def _on_priority_class(self, ev: Event) -> None:
+        if ev.type in (ADDED, MODIFIED):
+            self.fw.create_workload_priority_class(ev.obj)
+
+    def _on_admission_check(self, ev: Event) -> None:
+        if ev.type == ADDED:
+            self.fw.create_admission_check(ev.obj)
+        elif ev.type == MODIFIED:
+            self.fw.update_admission_check(ev.obj)
+
+    def _on_workload(self, ev: Event) -> None:
+        if ev.type == ADDED:
+            self.fw.submit(ev.obj)
+        elif ev.type == DELETED:
+            self.fw.delete_workload(ev.obj)
+
+    def sync_status(self) -> None:
+        """Write workload status back (SSA apply analog). The runtime owns
+        the status fields; the store version is the published view."""
+        for wl in list(self.fw.workloads.values()):
+            key = _obj_key(KIND_WORKLOAD, wl)
+            if self.store.get(KIND_WORKLOAD, key) is not None:
+                self.store.update_status(KIND_WORKLOAD, wl)
+
+    def tick(self) -> int:
+        """One scheduling cycle + status publication."""
+        admitted = self.fw.tick()
+        self.sync_status()
+        return admitted

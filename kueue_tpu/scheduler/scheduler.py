@@ -32,6 +32,7 @@ from kueue_tpu.core.workload import WorkloadInfo, WorkloadOrdering
 from kueue_tpu.queue.manager import Manager, RequeueReason
 from kueue_tpu.scheduler import preemption as preemption_mod
 from kueue_tpu.solver import fair_share, podset_reducer
+from kueue_tpu.utils import parallelize
 from kueue_tpu.solver.modes import FIT, NO_FIT, PREEMPT
 from kueue_tpu.solver.referee import Assignment, assign_flavors
 
@@ -294,14 +295,21 @@ class Scheduler:
         return admitted
 
     def _issue_preemptions(self, e: Entry, cq: CachedClusterQueue) -> int:
-        count = 0
-        for target in e.preemption_targets:
-            if not target.obj.is_evicted:
-                origin = "ClusterQueue" if cq.name == target.cluster_queue else "cohort"
-                self.apply_preemption(
-                    target.obj,
-                    f"Preempted to accommodate a higher priority Workload ({origin})")
-            count += 1
+        """IssuePreemptions (preemption.go:129-156): evictions applied with
+        bounded fan-out — the apply callback may cross a network boundary."""
+        targets = [t for t in e.preemption_targets if not t.obj.is_evicted]
+
+        def evict(target: WorkloadInfo) -> None:
+            origin = "ClusterQueue" if cq.name == target.cluster_queue \
+                else "cohort"
+            self.apply_preemption(
+                target.obj,
+                f"Preempted to accommodate a higher priority Workload ({origin})")
+
+        err = parallelize.for_each(targets, evict)
+        if err is not None:
+            raise err
+        count = len(e.preemption_targets)
         self.metrics.preempted += count
         return count
 

@@ -1,0 +1,47 @@
+"""Bounded-fanout parallel apply.
+
+Counterpart of reference pkg/util/parallelize (parallelize.go:25,60): run
+one function over N indices on up to `workers` threads, collecting the
+first error. The reference uses this for the 8-way parallel preemption SSA
+patches (preemption.go:44,135) and workload status writes — host-side I/O
+fan-out, which in this runtime matters when apply callbacks cross a network
+boundary (store-backed or gRPC deployments).
+"""
+
+from __future__ import annotations
+
+from concurrent.futures import ThreadPoolExecutor
+from typing import Callable, Optional, Sequence, TypeVar
+
+T = TypeVar("T")
+
+DEFAULT_WORKERS = 8
+
+
+def until(n: int, fn: Callable[[int], None],
+          workers: int = DEFAULT_WORKERS) -> Optional[BaseException]:
+    """Run fn(0..n-1), at most `workers` at a time; returns the first
+    exception raised (parallelize.Until returns the first error)."""
+    if n <= 0:
+        return None
+    if n == 1 or workers <= 1:
+        # No thread overhead for the common tiny case.
+        try:
+            for i in range(n):
+                fn(i)
+        except BaseException as exc:  # noqa: BLE001 — error-as-value API
+            return exc
+        return None
+    first: list = [None]
+    with ThreadPoolExecutor(max_workers=min(workers, n)) as pool:
+        futures = [pool.submit(fn, i) for i in range(n)]
+        for f in futures:
+            exc = f.exception()
+            if exc is not None and first[0] is None:
+                first[0] = exc
+    return first[0]
+
+
+def for_each(items: Sequence[T], fn: Callable[[T], None],
+             workers: int = DEFAULT_WORKERS) -> Optional[BaseException]:
+    return until(len(items), lambda i: fn(items[i]), workers=workers)

@@ -1,0 +1,170 @@
+"""MultiKueue depth tests: cluster lifecycle with reconnect backoff,
+MultiKueueConfig scoping, batch-job adapter sync, orphan GC.
+
+Mirrors reference test/integration/multikueue/ (two in-process frameworks
+simulate manager + worker clusters, like the two-envtest-apiserver setup).
+"""
+
+from kueue_tpu.api.types import (
+    ClusterQueue,
+    FlavorQuotas,
+    LocalQueue,
+    PodSet,
+    ResourceFlavor,
+    ResourceGroup,
+    Workload,
+)
+from kueue_tpu.controllers.multikueue import (
+    BatchJobAdapter,
+    InProcessRemote,
+    MultiKueueCluster,
+    MultiKueueConfig,
+    MultiKueueController,
+)
+from kueue_tpu.controllers.runtime import Framework
+from kueue_tpu.jobs.batch_job import BatchJob
+
+
+def make_cluster_fw(cpu=10):
+    fw = Framework()
+    fw.create_resource_flavor(ResourceFlavor.make("default"))
+    fw.create_cluster_queue(ClusterQueue(
+        name="cq",
+        resource_groups=(ResourceGroup(
+            covered_resources=("cpu",),
+            flavors=(FlavorQuotas.make("default", cpu=cpu),)),)))
+    fw.create_local_queue(LocalQueue(
+        name="main", namespace="default", cluster_queue="cq"))
+    return fw
+
+
+def make_manager(check="mk"):
+    mgr = Framework()
+    mgr.create_resource_flavor(ResourceFlavor.make("default"))
+    mgr.create_cluster_queue(ClusterQueue(
+        name="cq",
+        resource_groups=(ResourceGroup(
+            covered_resources=("cpu",),
+            flavors=(FlavorQuotas.make("default", cpu=100),)),),
+        admission_checks=(check,)))
+    mgr.create_local_queue(LocalQueue(
+        name="main", namespace="default", cluster_queue="cq"))
+    return mgr
+
+
+class TestClusterLifecycle:
+    def test_factory_connect_with_backoff(self):
+        clock = [1000.0]
+        mgr = Framework(clock=lambda: clock[0])
+        worker = make_cluster_fw()
+        attempts = []
+
+        fail_until = [3]
+
+        def factory(spec):
+            attempts.append(spec.name)
+            if len(attempts) < fail_until[0]:
+                return None
+            return InProcessRemote(worker)
+
+        ctl = MultiKueueController(mgr, client_factory=factory)
+        ctl.add_cluster_spec(MultiKueueCluster(name="w1"))
+
+        ctl.reconcile_clusters()
+        spec = ctl.cluster_specs["w1"]
+        assert not spec.active and spec.failed_connection_attempts == 1
+        first_deadline = spec.next_reconnect_at
+        assert first_deadline == 1000.0 + 5.0
+
+        # Before the backoff deadline: no new attempt.
+        clock[0] = 1002.0
+        ctl.reconcile_clusters()
+        assert len(attempts) == 1
+
+        # After: second attempt fails, backoff doubles.
+        clock[0] = 1006.0
+        ctl.reconcile_clusters()
+        assert len(attempts) == 2
+        assert spec.next_reconnect_at == 1006.0 + 10.0
+
+        # Third attempt succeeds; Active condition flips.
+        clock[0] = 1017.0
+        ctl.reconcile_clusters()
+        assert spec.active and spec.active_reason == "Active"
+        assert spec.failed_connection_attempts == 0
+        assert "w1" in ctl.clusters
+
+
+class TestConfigScoping:
+    def test_dispatch_only_to_configured_clusters(self):
+        mgr = make_manager()
+        w1, w2 = make_cluster_fw(), make_cluster_fw()
+        ctl = MultiKueueController(mgr, check_name="mk")
+        ctl.add_cluster("w1", InProcessRemote(w1))
+        ctl.add_cluster("w2", InProcessRemote(w2))
+        ctl.add_config(MultiKueueConfig(name="cfg", clusters=("w2",)))
+        ctl.check_configs["mk"] = "cfg"
+
+        wl = Workload(name="w", queue_name="main",
+                      pod_sets=[PodSet.make("main", 1, cpu=2)])
+        mgr.submit(wl)
+        mgr.run_until_settled()
+        ctl.reconcile()
+        assert "default/w" not in w1.workloads
+        assert "default/w" in w2.workloads
+
+
+class TestBatchJobAdapter:
+    def test_remote_job_runs_and_finishes_local(self):
+        mgr = make_manager()
+        worker = make_cluster_fw()
+        remote_client = InProcessRemote(worker)
+        ctl = MultiKueueController(mgr, check_name="mk")
+        ctl.add_cluster("w1", remote_client)
+        ctl.register_adapter("batch", BatchJobAdapter())
+
+        job = BatchJob(name="train", queue_name="main", parallelism=2,
+                       requests={"cpu": 1})
+        wl = mgr.submit_job(job)
+        mgr.run_until_settled()
+        assert wl.has_quota_reservation and not wl.is_admitted
+        ctl.reconcile()
+
+        # Remote job mirrored onto the worker and bound to the mirror wl.
+        assert "default/train" in remote_client.jobs
+        worker.run_until_settled()
+        ctl.reconcile()
+        mgr.run_until_settled()
+        assert wl.is_admitted  # check flipped Ready -> two-phase admitted
+
+        # Remote progress flows back; remote finish finishes local.
+        remote_job = remote_client.jobs["default/train"]
+        remote_job.ready_pods = 2
+        ctl.reconcile()
+        assert job.ready_pods == 2
+        remote_job.succeeded = 2
+        worker.run_until_settled()
+        ctl.reconcile()
+        assert wl.is_finished
+
+
+class TestOrphanGC:
+    def test_remote_orphans_deleted(self):
+        mgr = make_manager()
+        worker = make_cluster_fw()
+        client = InProcessRemote(worker)
+        ctl = MultiKueueController(mgr, check_name="mk")
+        ctl.add_cluster("w1", client)
+
+        wl = Workload(name="w", queue_name="main",
+                      pod_sets=[PodSet.make("main", 1, cpu=2)])
+        mgr.submit(wl)
+        mgr.run_until_settled()
+        ctl.reconcile()
+        assert "default/w" in worker.workloads
+
+        # The local workload disappears (user deletion): next reconcile
+        # garbage-collects the remote mirror.
+        mgr.delete_workload(wl)
+        ctl.reconcile()
+        assert "default/w" not in worker.workloads

@@ -1,0 +1,174 @@
+"""Watchable store + StoreAdapter tests (apiserver/informer-wiring analog;
+reference: controller-runtime informer plumbing + envtest-style integration
+suites in test/integration/controller/core/)."""
+
+import pytest
+
+from kueue_tpu import webhooks
+from kueue_tpu.api.types import (
+    ClusterQueue,
+    FlavorQuotas,
+    LocalQueue,
+    PodSet,
+    ResourceFlavor,
+    ResourceGroup,
+    Workload,
+    WorkloadPriorityClass,
+)
+from kueue_tpu.controllers.runtime import Framework
+from kueue_tpu.controllers.store import (
+    ADDED,
+    DELETED,
+    KIND_CLUSTER_QUEUE,
+    KIND_LOCAL_QUEUE,
+    KIND_RESOURCE_FLAVOR,
+    KIND_WORKLOAD,
+    MODIFIED,
+    Store,
+    StoreAdapter,
+)
+
+
+def cq_obj(name="cq", cpu=10):
+    return ClusterQueue(
+        name=name,
+        resource_groups=(ResourceGroup(
+            covered_resources=("cpu",),
+            flavors=(FlavorQuotas.make("default", cpu=cpu),)),))
+
+
+class TestStore:
+    def test_crud_and_versions(self):
+        s = Store()
+        rf = ResourceFlavor.make("default")
+        s.create(KIND_RESOURCE_FLAVOR, rf)
+        assert s.get(KIND_RESOURCE_FLAVOR, "default") is rf
+        v1 = s.resource_version(KIND_RESOURCE_FLAVOR, "default")
+        s.update(KIND_RESOURCE_FLAVOR, rf)
+        assert s.resource_version(KIND_RESOURCE_FLAVOR, "default") > v1
+        assert s.delete(KIND_RESOURCE_FLAVOR, "default") is rf
+        assert s.get(KIND_RESOURCE_FLAVOR, "default") is None
+
+    def test_create_duplicate_rejected(self):
+        s = Store()
+        s.create(KIND_CLUSTER_QUEUE, cq_obj())
+        with pytest.raises(ValueError):
+            s.create(KIND_CLUSTER_QUEUE, cq_obj())
+
+    def test_webhook_validation_at_boundary(self):
+        s = Store()
+        bad = ClusterQueue(
+            name="cq",
+            resource_groups=(ResourceGroup(
+                covered_resources=("cpu",),
+                flavors=(FlavorQuotas.make("f", cpu=(10, 5)),)),))
+        with pytest.raises(webhooks.ValidationError):
+            s.create(KIND_CLUSTER_QUEUE, bad)
+
+    def test_webhook_defaulting_at_boundary(self):
+        s = Store()
+        wl = Workload(name="w", pod_sets=[PodSet.make("", 1, cpu=1)])
+        s.create(KIND_WORKLOAD, wl)
+        assert wl.pod_sets[0].name == "main"
+
+    def test_update_immutability(self):
+        s = Store()
+        s.create(KIND_CLUSTER_QUEUE, cq_obj())
+        changed = cq_obj()
+        changed.queueing_strategy = "StrictFIFO"
+        with pytest.raises(webhooks.ValidationError):
+            s.update(KIND_CLUSTER_QUEUE, changed)
+
+    def test_watch_replay_and_events(self):
+        s = Store()
+        s.create(KIND_RESOURCE_FLAVOR, ResourceFlavor.make("default"))
+        events = []
+        s.watch(KIND_RESOURCE_FLAVOR, events.append)
+        assert [e.type for e in events] == [ADDED]  # initial replay
+        s.create(KIND_RESOURCE_FLAVOR, ResourceFlavor.make("spot"))
+        s.delete(KIND_RESOURCE_FLAVOR, "spot")
+        assert [e.type for e in events] == [ADDED, ADDED, DELETED]
+
+    def test_namespaced_list(self):
+        s = Store()
+        s.create(KIND_LOCAL_QUEUE,
+                 LocalQueue(name="a", namespace="ns1", cluster_queue="cq"))
+        s.create(KIND_LOCAL_QUEUE,
+                 LocalQueue(name="b", namespace="ns2", cluster_queue="cq"))
+        assert [lq.name for lq in s.list(KIND_LOCAL_QUEUE, "ns1")] == ["a"]
+
+
+class TestStoreAdapter:
+    def test_end_to_end_admission_via_store(self):
+        s = Store()
+        fw = Framework()
+        adapter = StoreAdapter(s, fw)
+        s.create(KIND_RESOURCE_FLAVOR, ResourceFlavor.make("default"))
+        s.create(KIND_CLUSTER_QUEUE, cq_obj())
+        s.create(KIND_LOCAL_QUEUE,
+                 LocalQueue(name="lq", namespace="default",
+                            cluster_queue="cq"))
+        wl = Workload(name="w", queue_name="lq",
+                      pod_sets=[PodSet.make("main", 2, cpu=1)])
+        s.create(KIND_WORKLOAD, wl)
+        adapter.tick()
+        # Status flowed back into the store view.
+        stored = s.get(KIND_WORKLOAD, "default/w")
+        assert stored.is_admitted
+        assert stored.admission.cluster_queue == "cq"
+
+    def test_objects_created_before_adapter_replay(self):
+        # List-then-watch: the adapter picks up pre-existing objects.
+        s = Store()
+        s.create(KIND_RESOURCE_FLAVOR, ResourceFlavor.make("default"))
+        s.create(KIND_CLUSTER_QUEUE, cq_obj())
+        s.create(KIND_LOCAL_QUEUE,
+                 LocalQueue(name="lq", namespace="default",
+                            cluster_queue="cq"))
+        s.create(KIND_WORKLOAD,
+                 Workload(name="w", queue_name="lq",
+                          pod_sets=[PodSet.make("main", 1, cpu=1)]))
+        fw = Framework()
+        adapter = StoreAdapter(s, fw)
+        adapter.tick()
+        assert s.get(KIND_WORKLOAD, "default/w").is_admitted
+
+    def test_delete_workload_releases_quota(self):
+        s = Store()
+        fw = Framework()
+        adapter = StoreAdapter(s, fw)
+        s.create(KIND_RESOURCE_FLAVOR, ResourceFlavor.make("default"))
+        s.create(KIND_CLUSTER_QUEUE, cq_obj(cpu=2))
+        s.create(KIND_LOCAL_QUEUE,
+                 LocalQueue(name="lq", namespace="default",
+                            cluster_queue="cq"))
+        w1 = Workload(name="w1", queue_name="lq",
+                      pod_sets=[PodSet.make("main", 1, cpu=2)])
+        s.create(KIND_WORKLOAD, w1)
+        adapter.tick()
+        assert s.get(KIND_WORKLOAD, "default/w1").is_admitted
+        w2 = Workload(name="w2", queue_name="lq",
+                      pod_sets=[PodSet.make("main", 1, cpu=2)])
+        s.create(KIND_WORKLOAD, w2)
+        adapter.tick()
+        assert not w2.is_admitted
+        s.delete(KIND_WORKLOAD, "default/w1")
+        adapter.tick()
+        assert w2.is_admitted
+
+    def test_priority_class_resolution_via_store(self):
+        s = Store()
+        fw = Framework()
+        StoreAdapter(s, fw)
+        s.create(KIND_RESOURCE_FLAVOR, ResourceFlavor.make("default"))
+        s.create(KIND_CLUSTER_QUEUE, cq_obj())
+        s.create(KIND_LOCAL_QUEUE,
+                 LocalQueue(name="lq", namespace="default",
+                            cluster_queue="cq"))
+        from kueue_tpu.controllers.store import KIND_WORKLOAD_PRIORITY_CLASS
+        s.create(KIND_WORKLOAD_PRIORITY_CLASS,
+                 WorkloadPriorityClass(name="vip", value=50))
+        wl = Workload(name="w", queue_name="lq", priority_class="vip",
+                      pod_sets=[PodSet.make("main", 1, cpu=1)])
+        s.create(KIND_WORKLOAD, wl)
+        assert fw.workloads["default/w"].priority == 50
