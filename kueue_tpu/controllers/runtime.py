@@ -45,6 +45,7 @@ from kueue_tpu.scheduler.preemption import DEFAULT_FAIR_STRATEGIES
 from kueue_tpu.scheduler.scheduler import Scheduler
 from kueue_tpu.utils import limitrange as limitrange_mod
 from kueue_tpu.utils.limitrange import LimitRange
+from kueue_tpu import events as events_mod
 from kueue_tpu import webhooks
 
 
@@ -79,6 +80,7 @@ class Framework:
         self.cluster_queue_specs: Dict[str, ClusterQueue] = {}
         self.admission_checks: Dict[str, AdmissionCheck] = {}
         self._ns_summaries: Dict[str, limitrange_mod.Summary] = {}
+        self.events = events_mod.EventRecorder()
         self.cache = Cache()
         self.queues = Manager(ordering=self.ordering,
                               namespace_lister=self.namespaces.get,
@@ -307,6 +309,9 @@ class Framework:
         (core/workload_controller.go finished handling)."""
         wl.set_condition(CONDITION_FINISHED, True, reason="JobFinished",
                          now=self.clock())
+        self.events.event(wl.key, events_mod.NORMAL,
+                          events_mod.REASON_FINISHED, "Workload finished",
+                          now=self.clock())
         self.cache.delete_workload(wl)
         self.queues.delete_workload(wl)
         self.queues.queue_associated_inadmissible_workloads(wl)
@@ -327,11 +332,18 @@ class Framework:
 
     def _apply_admission(self, wl: Workload) -> bool:
         # The API write is in-memory: nothing can fail here.
+        cq = wl.admission.cluster_queue if wl.admission else ""
+        self.events.event(
+            wl.key, events_mod.NORMAL, events_mod.REASON_QUOTA_RESERVED,
+            f"Quota reserved in ClusterQueue {cq}", now=self.clock())
         return True
 
     def _apply_preemption(self, wl: Workload, message: str) -> None:
         wl.set_condition(CONDITION_EVICTED, True, reason="Preempted",
                          message=message, now=self.clock())
+        self.events.event(wl.key, events_mod.NORMAL,
+                          events_mod.REASON_PREEMPTED, message,
+                          now=self.clock())
         if wl.admission is not None:
             REGISTRY.preempted_workloads_total.inc(wl.admission.cluster_queue)
         self._count_eviction(wl, "Preempted")

@@ -1,0 +1,56 @@
+"""Event recording.
+
+Counterpart of the reference's Kubernetes Event emissions on admission /
+preemption / pending transitions (scheduler.go:520-522,605,
+preemption.go:149): a bounded in-memory event log with the same
+(type, reason, message) vocabulary, queryable per object — the embedded
+analog of `kubectl get events`.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from dataclasses import dataclass
+from typing import Deque, List, Optional
+
+NORMAL = "Normal"
+WARNING = "Warning"
+
+# Reasons used by the scheduler/controllers (reference vocabulary).
+REASON_QUOTA_RESERVED = "QuotaReserved"
+REASON_ADMITTED = "Admitted"
+REASON_PREEMPTED = "Preempted"
+REASON_PENDING = "Pending"
+REASON_EVICTED = "EvictedDueToPodsReadyTimeout"
+REASON_FINISHED = "JobFinished"
+
+
+@dataclass(frozen=True)
+class Event:
+    type: str       # Normal | Warning
+    reason: str
+    message: str
+    object_key: str  # "namespace/name" of the involved workload
+    timestamp: float
+
+
+class EventRecorder:
+    """Bounded event sink (newest kept, like the apiserver's event TTL)."""
+
+    def __init__(self, capacity: int = 10_000):
+        self._events: Deque[Event] = deque(maxlen=capacity)
+
+    def event(self, object_key: str, etype: str, reason: str,
+              message: str, now: float = 0.0) -> None:
+        # Messages are truncated like util/api's event-message cap.
+        self._events.append(Event(etype, reason, message[:1024],
+                                  object_key, now))
+
+    def for_object(self, object_key: str,
+                   reason: Optional[str] = None) -> List[Event]:
+        return [e for e in self._events
+                if e.object_key == object_key
+                and (reason is None or e.reason == reason)]
+
+    def all(self) -> List[Event]:
+        return list(self._events)

@@ -7,12 +7,22 @@ with an expected total count. Pods with the same requests shape form one
 PodSet (role hashing, pod_controller.go:526-587); the group is admitted
 atomically and pods are ungated together. A single ungrouped pod is a group
 of one.
+
+Heavyweight group semantics from the reference:
+  * excess-pod cleanup — more members than the expected total are trimmed,
+    newest ungated first (pod_controller.go excess-pod handling)
+  * replacement pods — a failed member may be replaced without losing the
+    group's reservation (KEP-976 "Failed pods replacement")
+  * reclaimable pods — finished members release their share of the quota
+    (KEP-78 via jobframework's reclaimable sync)
+  * expectations store — in-flight deletions are tracked so a stale view
+    never double-processes a group (expectations.go:30-75)
 """
 
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Callable, Dict, List, Optional, Sequence, Tuple
+from typing import Callable, Dict, List, Optional, Sequence, Set, Tuple
 
 from kueue_tpu.api.types import PodSet
 from kueue_tpu.controllers.jobframework import (
@@ -20,6 +30,30 @@ from kueue_tpu.controllers.jobframework import (
     PodSetInfo,
     register_integration,
 )
+
+
+class ExpectationsStore:
+    """Tracks expected-but-unobserved deletions per group
+    (reference: jobs/pod/expectations.go:30-75). A group is only
+    reprocessed when every expected deletion has been observed, guarding
+    against stale informer-cache reads."""
+
+    def __init__(self):
+        self._pending: Dict[str, Set[str]] = {}
+
+    def expect_deletions(self, group: str, pod_names: Sequence[str]) -> None:
+        self._pending.setdefault(group, set()).update(pod_names)
+
+    def observed_deletion(self, group: str, pod_name: str) -> None:
+        keys = self._pending.get(group)
+        if keys is None:
+            return
+        keys.discard(pod_name)
+        if not keys:
+            del self._pending[group]
+
+    def satisfied(self, group: str) -> bool:
+        return not self._pending.get(group)
 
 
 @dataclass
@@ -52,6 +86,7 @@ class PodGroup(GenericJob):
         self._priority = priority
         self._on_run = on_run
         self.podset_infos: List[PodSetInfo] = []
+        self.expectations = ExpectationsStore()
 
     @property
     def name(self) -> str:
@@ -70,7 +105,47 @@ class PodGroup(GenericJob):
         self.pods.append(pod)
 
     def has_all_members(self) -> bool:
-        return len(self.pods) >= self.total_count
+        return len(self.active_pods()) >= self.total_count
+
+    def active_pods(self) -> List[GroupedPod]:
+        return [p for p in self.pods if not p.finished]
+
+    def cleanup_excess(self) -> List[GroupedPod]:
+        """Trim members beyond the expected total, ungated/newest first
+        (pod_controller.go excess-pod cleanup); removals are registered in
+        the expectations store and returned for the caller to delete."""
+        excess = len(self.active_pods()) - self.total_count
+        if excess <= 0:
+            return []
+        candidates = sorted(
+            self.active_pods(),
+            key=lambda p: (not p.gated, self.pods.index(p)), reverse=True)
+        removed = candidates[:excess]
+        self.expectations.expect_deletions(
+            self._name, [p.name for p in removed])
+        for p in removed:
+            self.pods.remove(p)
+            self.expectations.observed_deletion(self._name, p.name)
+        return removed
+
+    def replace_pod(self, failed_name: str, replacement: GroupedPod) -> bool:
+        """Swap a failed member for a fresh pod without dropping the
+        group's reservation (KEP-976 failed-pod replacement)."""
+        for i, p in enumerate(self.pods):
+            if p.name == failed_name and p.finished and not p.succeeded:
+                replacement.gated = p.gated
+                self.pods[i] = replacement
+                return True
+        return False
+
+    def reclaimable_pods(self) -> Dict[str, int]:
+        """Finished members release quota per role (KEP-78)."""
+        out: Dict[str, int] = {}
+        for key, members in self._roles().items():
+            done = sum(1 for p in members if p.finished and p.succeeded)
+            if done:
+                out[self._role_name(key)] = done
+        return out
 
     def is_suspended(self) -> bool:
         # Suspension = all non-finished pods still gated.

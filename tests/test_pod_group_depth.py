@@ -1,0 +1,114 @@
+"""Pod-group heavyweight semantics + event recording tests
+(reference: jobs/pod/pod_controller.go excess cleanup, expectations.go,
+KEP-976 replacement; scheduler Event emissions)."""
+
+from kueue_tpu import events as events_mod
+from kueue_tpu.api.types import (
+    ClusterQueue,
+    ClusterQueuePreemption,
+    FlavorQuotas,
+    LocalQueue,
+    PodSet,
+    ResourceFlavor,
+    ResourceGroup,
+    Workload,
+)
+from kueue_tpu.controllers.runtime import Framework
+from kueue_tpu.jobs.pod_group import ExpectationsStore, GroupedPod, PodGroup
+
+
+def make_fw(cpu=8, preemption=None):
+    fw = Framework()
+    fw.create_resource_flavor(ResourceFlavor.make("default"))
+    kwargs = {"preemption": preemption} if preemption else {}
+    fw.create_cluster_queue(ClusterQueue(
+        name="cq",
+        resource_groups=(ResourceGroup(
+            covered_resources=("cpu",),
+            flavors=(FlavorQuotas.make("default", cpu=cpu),)),), **kwargs))
+    fw.create_local_queue(LocalQueue(
+        name="lq", namespace="default", cluster_queue="cq"))
+    return fw
+
+
+class TestExpectations:
+    def test_satisfied_lifecycle(self):
+        ex = ExpectationsStore()
+        assert ex.satisfied("g")
+        ex.expect_deletions("g", ["p1", "p2"])
+        assert not ex.satisfied("g")
+        ex.observed_deletion("g", "p1")
+        assert not ex.satisfied("g")
+        ex.observed_deletion("g", "p2")
+        assert ex.satisfied("g")
+        ex.observed_deletion("g", "never-expected")  # no-op
+
+
+class TestExcessCleanup:
+    def test_trims_newest_ungated_first(self):
+        pods = [GroupedPod(f"p{i}", {"cpu": 1}, group="g") for i in range(3)]
+        group = PodGroup("g", "lq", pods, total_count=2)
+        group.add_pod(GroupedPod("late", {"cpu": 1}, group="g"))
+        removed = group.cleanup_excess()
+        assert [p.name for p in removed] == ["late", "p2"]
+        assert len(group.pods) == 2
+        assert group.expectations.satisfied("g")
+
+    def test_no_excess_noop(self):
+        pods = [GroupedPod("p0", {"cpu": 1})]
+        group = PodGroup("g", "lq", pods, total_count=2)
+        assert group.cleanup_excess() == []
+
+
+class TestReplacement:
+    def test_failed_pod_replaced_keeps_reservation(self):
+        fw = make_fw()
+        pods = [GroupedPod(f"p{i}", {"cpu": 2}, group="g") for i in range(2)]
+        group = PodGroup("g", "lq", pods)
+        wl = fw.submit_job(group)
+        fw.run_until_settled()
+        assert wl.has_quota_reservation
+        pods[0].finished = True
+        pods[0].succeeded = False
+        assert group.replace_pod("p0", GroupedPod("p0-r", {"cpu": 2},
+                                                  group="g"))
+        fw.tick()
+        assert wl.has_quota_reservation and not wl.is_finished
+        # Replacement of a running pod is refused.
+        assert not group.replace_pod("p1", GroupedPod("x", {"cpu": 2}))
+
+    def test_reclaimable_on_partial_success(self):
+        fw = make_fw()
+        pods = [GroupedPod(f"p{i}", {"cpu": 2}, group="g") for i in range(3)]
+        group = PodGroup("g", "lq", pods)
+        wl = fw.submit_job(group)
+        fw.run_until_settled()
+        assert fw.cache.cluster_queues["cq"].usage["default"]["cpu"] == 6000
+        pods[0].finished = True
+        fw.tick()
+        # One finished pod released its quota share (KEP-78).
+        assert wl.reclaimable_pods
+        assert fw.cache.cluster_queues["cq"].usage["default"]["cpu"] == 4000
+
+
+class TestEvents:
+    def test_admission_preemption_finish_events(self):
+        fw = make_fw(
+            cpu=4,
+            preemption=ClusterQueuePreemption(
+                within_cluster_queue="LowerPriority"))
+        low = Workload(name="low", queue_name="lq", priority=-1,
+                       pod_sets=[PodSet.make("main", 1, cpu=3)])
+        fw.submit(low)
+        fw.run_until_settled()
+        assert fw.events.for_object(
+            "default/low", reason=events_mod.REASON_QUOTA_RESERVED)
+        high = Workload(name="high", queue_name="lq", priority=5,
+                        pod_sets=[PodSet.make("main", 1, cpu=3)])
+        fw.submit(high)
+        fw.run_until_settled()
+        assert fw.events.for_object(
+            "default/low", reason=events_mod.REASON_PREEMPTED)
+        fw.finish(fw.workloads["default/high"])
+        assert fw.events.for_object(
+            "default/high", reason=events_mod.REASON_FINISHED)
