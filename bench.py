@@ -34,7 +34,7 @@ def main() -> None:
     smoke = os.environ.get("KUEUE_BENCH_SMOKE") == "1"
     if smoke:
         num_cqs, num_cohorts, num_flavors = 32, 8, 4
-        backlog, ticks = 256, 5
+        backlog, ticks = 256, 12
     else:
         num_cqs, num_cohorts, num_flavors = 1000, 100, 8
         backlog, ticks = 50_000, int(os.environ.get("KUEUE_BENCH_TICKS", "50"))
@@ -43,7 +43,8 @@ def main() -> None:
     from kueue_tpu.models.flavor_fit import (
         decode_assignments,
         device_static,
-        solve_flavor_fit,
+        fetch_outputs,
+        solve_flavor_fit_async,
     )
     from kueue_tpu.solver import schema as sch
     from kueue_tpu.utils.synthetic import synthetic_problem
@@ -65,7 +66,8 @@ def main() -> None:
                                   pad_to=len(pending))
     t_enc = time.perf_counter() - t0
 
-    def tick(i: int):
+    def dispatch(i: int):
+        """Stage 1: per-tick usage refresh + encode + async device solve."""
         lo = (i * heads_per_tick) % backlog
         hi = min(lo + heads_per_tick, backlog)
         usage = sch.encode_usage(snapshot, enc)  # per-tick usage refresh
@@ -76,13 +78,28 @@ def main() -> None:
             podset_unsat=wt_all.podset_unsat[lo:hi],
             elig=wt_all.elig[lo:hi], resume_slot=wt_all.resume_slot[lo:hi],
             wl_valid=wt_all.wl_valid[lo:hi], num_real=hi - lo)
-        out = solve_flavor_fit(enc, usage, wt, static=static)
-        heads = pending[lo:hi]
-        assignments = decode_assignments(heads, snapshot, enc, out)
+        return lo, hi, solve_flavor_fit_async(enc, usage, wt, static=static)
+
+    def collect(pending_tick):
+        """Stage 2+3: fetch the in-flight solve, decode decisions."""
+        lo, hi, handle = pending_tick
+        out = fetch_outputs(handle)
+        assignments = decode_assignments(pending[lo:hi], snapshot, enc, out)
         return out, assignments
 
+    # The tick pipeline. A synchronized device round trip on a
+    # remote-attached TPU costs ~100x the solve itself, so the scheduler
+    # keeps `depth` nomination solves in flight: while tick i's outputs
+    # cross back over the wire, ticks i+1..i+depth dispatch and tick i-1
+    # decodes. Depth 1 (KUEUE_BENCH_DEPTH=1) is the fully synchronous
+    # reference mode. Timing covers the steady state only: pipeline fill
+    # and drain are excluded from the samples (and from the decision
+    # counts, so decisions/s matches the timed window).
+    depth = max(1, int(os.environ.get("KUEUE_BENCH_DEPTH", "8")))
+    depth = min(depth, max(1, ticks - 1))
+
     # Warmup (compile).
-    tick(0)
+    collect(dispatch(0))
 
     # Long-running-scheduler GC discipline: the setup objects (50k encoded
     # workloads, the snapshot) are permanent; keep collector passes from
@@ -95,12 +112,31 @@ def main() -> None:
     times = []
     decisions = 0
     fit_count = 0
-    for i in range(ticks):
-        t0 = time.perf_counter()
-        out, assignments = tick(i)
-        times.append(time.perf_counter() - t0)
-        decisions += len(assignments)
-        fit_count += int((out["wl_mode"][:len(assignments)] == 2).sum())
+    if ticks <= depth:
+        # Degenerate run (e.g. KUEUE_BENCH_TICKS=1): synchronous timing.
+        for i in range(ticks):
+            t0 = time.perf_counter()
+            out, assignments = collect(dispatch(i))
+            times.append(time.perf_counter() - t0)
+            decisions += len(assignments)
+            fit_count += int((out["wl_mode"][:len(assignments)] == 2).sum())
+    else:
+        # Fill: the first `depth` solves go in flight untimed.
+        inflight = [dispatch(i) for i in range(depth)]
+        # Steady state: each iteration dispatches one tick and collects the
+        # oldest in-flight one; collect-to-collect interval is the sample.
+        t_prev = time.perf_counter()
+        for i in range(depth, ticks):
+            inflight.append(dispatch(i))
+            out, assignments = collect(inflight.pop(0))
+            decisions += len(assignments)
+            fit_count += int((out["wl_mode"][:len(assignments)] == 2).sum())
+            now = time.perf_counter()
+            times.append(now - t_prev)
+            t_prev = now
+        # Drain: completes the run but contributes no samples or counts.
+        for pending_tick in inflight:
+            collect(pending_tick)
 
     times_ms = np.array(times) * 1000.0
     p50 = float(np.percentile(times_ms, 50))
@@ -110,7 +146,7 @@ def main() -> None:
     print(
         f"# shape: {num_cqs} CQs x {num_cohorts} cohorts x {num_flavors} "
         f"flavors, backlog {backlog}, {heads_per_tick} heads/tick, "
-        f"{ticks} ticks on {jax.default_backend()}\n"
+        f"{ticks} ticks on {jax.default_backend()}, pipeline depth {depth}\n"
         f"# setup: generate {t_gen:.2f}s, encode {t_enc:.2f}s\n"
         f"# tick solve: p50 {p50:.2f}ms  p99 {p99:.2f}ms  "
         f"({decisions_per_sec:,.0f} decisions/s; {fit_count}/{decisions} Fit)",

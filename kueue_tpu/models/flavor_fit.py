@@ -320,13 +320,19 @@ def pack_dynamic(usage_cfr: np.ndarray, wl: sch.WorkloadTensors):
     return buf_i64, buf_i32, buf_u8
 
 
-def solve_flavor_fit(enc: sch.CQEncoding, usage: sch.UsageTensors,
-                     wl: sch.WorkloadTensors,
-                     static: Optional[tuple] = None) -> Dict[str, np.ndarray]:
-    """Run the batched solve; returns numpy output tensors.
+def solve_flavor_fit_async(enc: sch.CQEncoding, usage: sch.UsageTensors,
+                           wl: sch.WorkloadTensors,
+                           static: Optional[tuple] = None) -> Dict[str, "jax.Array"]:
+    """Dispatch the batched solve without synchronizing.
 
-    Per tick: three packed host->device transfers, one dispatch, one batched
-    device_get of the compact output pytree.
+    Everything up to the fetch is fire-and-forget: three packed host->device
+    transfers, one dispatch, then `copy_to_host_async` on each output so the
+    device->host copies ride the same in-flight window. On remote-attached
+    TPUs a synchronized round trip costs ~2 orders of magnitude more than
+    the solve itself, so the scheduler dispatches tick i+1 (and decodes tick
+    i-1) while tick i is in flight; `fetch_outputs` materializes the
+    results. This is the device-side mirror of the reference's async
+    admission applies (scheduler.go:512 runs SSA off the loop thread).
     """
     if static is None:
         static = device_static(enc)
@@ -340,7 +346,25 @@ def solve_flavor_fit(enc: sch.CQEncoding, usage: sch.UsageTensors,
         shapes=(W, P, R, G, enc.num_cohorts),
         fungibility_enabled=features.enabled(features.FLAVOR_FUNGIBILITY),
     )
+    for leaf in jax.tree_util.tree_leaves(out):
+        leaf.copy_to_host_async()
+    return out
+
+
+def fetch_outputs(out: Dict[str, "jax.Array"]) -> Dict[str, np.ndarray]:
+    """Materialize a dispatched solve's outputs on host (blocks)."""
     return jax.device_get(out)
+
+
+def solve_flavor_fit(enc: sch.CQEncoding, usage: sch.UsageTensors,
+                     wl: sch.WorkloadTensors,
+                     static: Optional[tuple] = None) -> Dict[str, np.ndarray]:
+    """Run the batched solve; returns numpy output tensors.
+
+    Per tick: three packed host->device transfers, one dispatch, one batched
+    device_get of the compact output pytree.
+    """
+    return fetch_outputs(solve_flavor_fit_async(enc, usage, wl, static=static))
 
 
 def decode_assignments(workloads: Sequence[WorkloadInfo], snapshot: Snapshot,
