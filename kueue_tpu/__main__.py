@@ -1,0 +1,204 @@
+"""Single-binary entry point (counterpart of reference cmd/kueue/main.go).
+
+    python -m kueue_tpu --config controller.yaml --objects setup.yaml \
+        --feature-gates FlavorFungibility=true,FairSharing=true -v 2
+
+Wires the whole runtime the way main.go does (main.go:101-189): load the
+--config Configuration file, apply --feature-gates, build the watchable
+API store + Framework + StoreAdapter (core controllers), register the
+SIGUSR2 state dumper, optionally join leader election, apply the --objects
+manifests (reference example YAML works unchanged), then drive scheduling
+ticks and print the admission summary. --serve keeps the process running
+like the real controller manager, ticking at --tick-interval.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+import uuid
+from typing import List, Optional, Sequence
+
+from kueue_tpu import config as config_mod
+from kueue_tpu import features
+from kueue_tpu.api import serialization
+from kueue_tpu.controllers.debugger import Dumper
+from kueue_tpu.controllers.leaderelection import LeaderElector, LeaseStore
+from kueue_tpu.controllers.runtime import Framework
+from kueue_tpu.controllers.store import (
+    KIND_ADMISSION_CHECK,
+    KIND_CLUSTER_QUEUE,
+    KIND_LOCAL_QUEUE,
+    KIND_RESOURCE_FLAVOR,
+    KIND_WORKLOAD,
+    KIND_WORKLOAD_PRIORITY_CLASS,
+    Store,
+    StoreAdapter,
+)
+from kueue_tpu.metrics import REGISTRY
+
+# Admin kinds apply before workloads regardless of file order, like the
+# reference's informer start ordering guarantees.
+_APPLY_ORDER = [
+    KIND_RESOURCE_FLAVOR, KIND_WORKLOAD_PRIORITY_CLASS, KIND_ADMISSION_CHECK,
+    KIND_CLUSTER_QUEUE, KIND_LOCAL_QUEUE, KIND_WORKLOAD, "Job",
+]
+
+
+def _parse_feature_gates(spec: Optional[str]) -> None:
+    """--feature-gates Gate=true,Other=false (component-base format,
+    main.go:106-108)."""
+    if not spec:
+        return
+    truthy = {"true", "t", "1", "yes", "y"}
+    falsy = {"false", "f", "0", "no", "n"}
+    for part in spec.split(","):
+        if not part.strip():
+            continue
+        if "=" not in part:
+            raise SystemExit(f"--feature-gates: invalid entry {part!r} "
+                             "(want Name=true|false)")
+        name, _, value = part.partition("=")
+        value = value.strip().lower()
+        if value not in truthy | falsy:
+            raise SystemExit(f"--feature-gates: invalid bool {value!r} "
+                             f"for gate {name.strip()!r}")
+        try:
+            features.set_enabled(name.strip(), value in truthy)
+        except KeyError:
+            raise SystemExit(f"--feature-gates: unknown gate {name.strip()!r} "
+                             f"(known: {', '.join(sorted(features.all_gates()))})")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="python -m kueue_tpu",
+        description="TPU-native quota-admission controller runtime")
+    parser.add_argument("--config", help="Configuration YAML file "
+                        "(reference --config format)")
+    parser.add_argument("--feature-gates", default="",
+                        help="comma-separated Gate=bool pairs")
+    parser.add_argument("--objects", action="append", default=[],
+                        help="manifest YAML file(s) to apply on startup "
+                        "(repeatable; reference example format)")
+    parser.add_argument("-v", "--verbosity", type=int, default=0,
+                        help="log verbosity (0-6, zap analog)")
+    parser.add_argument("--ticks", type=int, default=None,
+                        help="run exactly N scheduling ticks")
+    parser.add_argument("--serve", action="store_true",
+                        help="keep running, ticking at --tick-interval")
+    parser.add_argument("--tick-interval", type=float, default=0.1,
+                        help="seconds between ticks with --serve")
+    parser.add_argument("--batch-solver", action="store_true",
+                        help="solve each tick's nominations as one batched "
+                        "device program (TPU path)")
+    parser.add_argument("--leader-elect", action="store_true",
+                        help="join lease-based leader election")
+    parser.add_argument("--dump-state", action="store_true",
+                        help="print the debugger state dump on exit")
+    parser.add_argument("--metrics", action="store_true",
+                        help="print the metrics registry on exit")
+    return parser
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+
+    cfg = config_mod.load(args.config) if args.config else config_mod.Configuration()
+    _parse_feature_gates(args.feature_gates)
+
+    batch_solver = None
+    if args.batch_solver:
+        from kueue_tpu.models.flavor_fit import BatchSolver
+        batch_solver = BatchSolver()
+
+    fw = Framework(batch_solver=batch_solver, config=cfg)
+    store = Store()
+    adapter = StoreAdapter(store, fw)
+
+    dumper = Dumper(fw.cache, fw.queues)
+    dumper.listen_for_signal()  # SIGUSR2, like debugger.go:41-48
+
+    elector = None
+    if args.leader_elect or cfg.leader_election.enable:
+        elector = LeaderElector(LeaseStore(), identity=str(uuid.uuid4()),
+                                config=cfg.leader_election)
+        elector.step()
+
+    applied = 0
+    errors: List[str] = []
+    manifests = []
+    for path in args.objects:
+        manifests.extend(serialization.load_manifests(path))
+    for kind_wanted in _APPLY_ORDER:
+        for kind, obj in manifests:
+            if kind != kind_wanted:
+                continue
+            try:
+                if kind == "Job":
+                    fw.submit_job(obj)
+                else:
+                    store.create(kind, obj)
+                applied += 1
+            except Exception as exc:  # surface, don't abort the rest
+                errors.append(f"{kind} {getattr(obj, 'name', '?')}: {exc}")
+    if args.verbosity >= 1:
+        print(f"applied {applied} objects"
+              + (f", {len(errors)} errors" if errors else ""),
+              file=sys.stderr)
+    for err in errors:
+        print(f"apply error: {err}", file=sys.stderr)
+
+    total_admitted = 0
+
+    def tick_once() -> int:
+        if elector is not None:
+            elector.step()
+            if not elector.is_leader():
+                return 0  # hot standby: reconcile nothing (leader_aware)
+        return adapter.tick()
+
+    if args.serve:
+        try:
+            while True:
+                total_admitted += tick_once()
+                time.sleep(args.tick_interval)
+        except KeyboardInterrupt:
+            pass
+    elif args.ticks is not None:
+        for _ in range(args.ticks):
+            total_admitted += tick_once()
+    else:
+        # Default: run to quiescence (the single-binary demo of SURVEY §7).
+        idle = 0
+        for _ in range(1000):
+            n = tick_once()
+            total_admitted += n
+            idle = idle + 1 if n == 0 else 0
+            if idle >= 2:
+                break
+
+    summary = {
+        "admitted": total_admitted,
+        "clusterQueues": {
+            name: {
+                "admitted": len(cq.workloads),
+                "pending": fw.queues.pending(name),
+            }
+            for name, cq in sorted(fw.cache.cluster_queues.items())
+        },
+    }
+    print(json.dumps(summary, indent=2 if args.verbosity else None))
+
+    if args.dump_state:
+        print(dumper.dump_json(), file=sys.stderr)
+    if args.metrics:
+        for line in REGISTRY.export_text().splitlines():
+            print(line, file=sys.stderr)
+    return 1 if errors else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

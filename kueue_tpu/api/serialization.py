@@ -1,0 +1,287 @@
+"""Decode reference-format CRD manifests into the object model.
+
+The reference's API surface is YAML applied to the apiserver
+(config/components/crd/bases/, examples/). This module is the equivalent
+boundary for the embedded runtime: `decode(doc)` turns one
+kueue.x-k8s.io/v1beta1 document (or a batch/v1 Job with the queue-name
+label) into the corresponding kueue_tpu object, and `load_manifests(path)`
+reads a multi-document YAML file, so reference example files like
+examples/admin/single-clusterqueue-setup.yaml work unchanged.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Mapping, Optional, Sequence, Tuple
+
+from kueue_tpu.api.resources import resource_value
+from kueue_tpu.api.types import (
+    AdmissionCheck,
+    BorrowWithinCohort,
+    ClusterQueue,
+    ClusterQueuePreemption,
+    Container,
+    FairSharing,
+    FlavorFungibility,
+    FlavorQuotas,
+    LabelSelector,
+    LocalQueue,
+    MatchExpression,
+    PodSet,
+    PodTemplate,
+    ResourceFlavor,
+    ResourceGroup,
+    ResourceQuota,
+    Taint,
+    Toleration,
+    Workload,
+    WorkloadPriorityClass,
+)
+
+QUEUE_NAME_LABEL = "kueue.x-k8s.io/queue-name"
+
+
+class DecodeError(ValueError):
+    pass
+
+
+def _meta(doc: Mapping[str, Any]) -> Tuple[str, str]:
+    meta = doc.get("metadata") or {}
+    name = meta.get("name")
+    if not name:
+        raise DecodeError(f"{doc.get('kind', '?')}: metadata.name is required")
+    return name, meta.get("namespace", "default")
+
+
+def _match_expressions(exprs: Optional[Sequence[Mapping]]) -> Tuple[MatchExpression, ...]:
+    out = []
+    for e in exprs or ():
+        out.append(MatchExpression(key=e["key"], operator=e["operator"],
+                                   values=tuple(e.get("values") or ())))
+    return tuple(out)
+
+
+def _label_selector(sel: Optional[Mapping[str, Any]]) -> LabelSelector:
+    if sel is None:
+        return LabelSelector.everything()
+    return LabelSelector(
+        match_labels=tuple(sorted((sel.get("matchLabels") or {}).items())),
+        match_expressions=_match_expressions(sel.get("matchExpressions")))
+
+
+def _tolerations(tols: Optional[Sequence[Mapping]]) -> Tuple[Toleration, ...]:
+    out = []
+    for t in tols or ():
+        out.append(Toleration(
+            key=t.get("key", ""), operator=t.get("operator", "Equal"),
+            value=t.get("value", ""), effect=t.get("effect", "")))
+    return tuple(out)
+
+
+def _taints(taints: Optional[Sequence[Mapping]]) -> Tuple[Taint, ...]:
+    return tuple(Taint(key=t["key"], value=t.get("value", ""),
+                       effect=t.get("effect", ""))
+                 for t in taints or ())
+
+
+def _requests(doc: Optional[Mapping[str, Any]]) -> Dict[str, int]:
+    return {r: resource_value(r, q) for r, q in (doc or {}).items()}
+
+
+def _containers(docs: Optional[Sequence[Mapping]]) -> List[Container]:
+    out = []
+    for c in docs or ():
+        res = c.get("resources") or {}
+        out.append(Container(name=c.get("name", ""),
+                             requests=_requests(res.get("requests")),
+                             limits=_requests(res.get("limits"))))
+    return out
+
+
+def _pod_template(doc: Optional[Mapping[str, Any]]) -> Optional[PodTemplate]:
+    if doc is None:
+        return None
+    spec = doc.get("spec") or doc
+    return PodTemplate(
+        containers=_containers(spec.get("containers")),
+        init_containers=_containers(spec.get("initContainers")),
+        overhead=_requests(spec.get("overhead")),
+        runtime_class_name=spec.get("runtimeClassName"))
+
+
+def _node_affinity_terms(spec: Mapping[str, Any]) -> Tuple[Tuple[MatchExpression, ...], ...]:
+    """requiredDuringSchedulingIgnoredDuringExecution terms (the subset the
+    flavor selector replicates, flavorassigner.go:498-542)."""
+    affinity = ((spec.get("affinity") or {}).get("nodeAffinity") or {})
+    required = affinity.get("requiredDuringSchedulingIgnoredDuringExecution") or {}
+    return tuple(_match_expressions(t.get("matchExpressions"))
+                 for t in required.get("nodeSelectorTerms") or ())
+
+
+# -- kueue kinds -------------------------------------------------------------
+
+def decode_resource_flavor(doc: Mapping[str, Any]) -> ResourceFlavor:
+    name, _ = _meta(doc)
+    spec = doc.get("spec") or {}
+    return ResourceFlavor.make(
+        name,
+        node_labels=spec.get("nodeLabels"),
+        node_taints=_taints(spec.get("nodeTaints")),
+        tolerations=_tolerations(spec.get("tolerations")))
+
+
+def _flavor_quotas(doc: Mapping[str, Any]) -> FlavorQuotas:
+    resources = []
+    for r in doc.get("resources") or ():
+        rname = r["name"]
+        resources.append((rname, ResourceQuota(
+            nominal=resource_value(rname, r.get("nominalQuota", 0)),
+            borrowing_limit=(None if r.get("borrowingLimit") is None
+                             else resource_value(rname, r["borrowingLimit"])),
+            lending_limit=(None if r.get("lendingLimit") is None
+                           else resource_value(rname, r["lendingLimit"])))))
+    return FlavorQuotas(name=doc["name"], resources=tuple(resources))
+
+
+def decode_cluster_queue(doc: Mapping[str, Any]) -> ClusterQueue:
+    name, _ = _meta(doc)
+    spec = doc.get("spec") or {}
+    groups = tuple(
+        ResourceGroup(
+            covered_resources=tuple(g.get("coveredResources") or ()),
+            flavors=tuple(_flavor_quotas(f) for f in g.get("flavors") or ()))
+        for g in spec.get("resourceGroups") or ())
+    cq = ClusterQueue(
+        name=name,
+        resource_groups=groups,
+        cohort=spec.get("cohort", ""),
+        namespace_selector=_label_selector(spec.get("namespaceSelector")),
+        admission_checks=tuple(spec.get("admissionChecks") or ()),
+        stop_policy=spec.get("stopPolicy", "None"),
+    )
+    if spec.get("queueingStrategy"):
+        cq.queueing_strategy = spec["queueingStrategy"]
+    p = spec.get("preemption")
+    if p:
+        bwc = None
+        if p.get("borrowWithinCohort"):
+            b = p["borrowWithinCohort"]
+            bwc = BorrowWithinCohort(
+                policy=b.get("policy", "Never"),
+                max_priority_threshold=b.get("maxPriorityThreshold"))
+        cq.preemption = ClusterQueuePreemption(
+            reclaim_within_cohort=p.get("reclaimWithinCohort", "Never"),
+            within_cluster_queue=p.get("withinClusterQueue", "Never"),
+            borrow_within_cohort=bwc)
+    ff = spec.get("flavorFungibility")
+    if ff:
+        cq.flavor_fungibility = FlavorFungibility(
+            when_can_borrow=ff.get("whenCanBorrow", "Borrow"),
+            when_can_preempt=ff.get("whenCanPreempt", "TryNextFlavor"))
+    fs = spec.get("fairSharing")
+    if fs:
+        cq.fair_sharing = FairSharing(weight=float(fs.get("weight", 1)))
+    return cq
+
+
+def decode_local_queue(doc: Mapping[str, Any]) -> LocalQueue:
+    name, namespace = _meta(doc)
+    spec = doc.get("spec") or {}
+    return LocalQueue(name=name, namespace=namespace,
+                      cluster_queue=spec.get("clusterQueue", ""))
+
+
+def decode_workload_priority_class(doc: Mapping[str, Any]) -> WorkloadPriorityClass:
+    name, _ = _meta(doc)
+    return WorkloadPriorityClass(name=name, value=int(doc.get("value", 0)))
+
+
+def decode_admission_check(doc: Mapping[str, Any]) -> AdmissionCheck:
+    name, _ = _meta(doc)
+    spec = doc.get("spec") or {}
+    params = spec.get("parameters")
+    return AdmissionCheck(
+        name=name,
+        controller_name=spec.get("controllerName", ""),
+        parameters=(None if params is None else
+                    (params.get("apiGroup", ""), params.get("kind", ""),
+                     params.get("name", ""))))
+
+
+def decode_workload(doc: Mapping[str, Any]) -> Workload:
+    name, namespace = _meta(doc)
+    spec = doc.get("spec") or {}
+    pod_sets = []
+    for ps in spec.get("podSets") or ():
+        template = _pod_template(ps.get("template"))
+        ps_spec = (ps.get("template") or {}).get("spec") or {}
+        pod_sets.append(PodSet(
+            name=ps.get("name", "main"),
+            count=int(ps.get("count", 1)),
+            min_count=ps.get("minCount"),
+            requests=(template.total_requests() if template else {}),
+            node_selector=tuple(sorted(
+                (ps_spec.get("nodeSelector") or {}).items())),
+            affinity_terms=_node_affinity_terms(ps_spec),
+            tolerations=_tolerations(ps_spec.get("tolerations")),
+            template=template))
+    return Workload(
+        name=name, namespace=namespace,
+        queue_name=spec.get("queueName", ""),
+        pod_sets=pod_sets,
+        priority=int(spec.get("priority", 0)),
+        priority_class=spec.get("priorityClassName", ""),
+        active=bool(spec.get("active", True)))
+
+
+# -- batch/v1 Job (the kubectl-visible job form) -----------------------------
+
+def decode_batch_job(doc: Mapping[str, Any]):
+    from kueue_tpu.jobs.batch_job import BatchJob
+
+    name, namespace = _meta(doc)
+    labels = (doc.get("metadata") or {}).get("labels") or {}
+    spec = doc.get("spec") or {}
+    template = _pod_template(spec.get("template"))
+    # BatchJob canonicalizes requests itself; hand canonical totals back in
+    # suffix form ("1000m") so they round-trip instead of re-scaling.
+    requests = {r: (f"{v}m" if r == "cpu" else v)
+                for r, v in (template.total_requests() if template else {}).items()}
+    return BatchJob(
+        name=name, namespace=namespace,
+        queue_name=labels.get(QUEUE_NAME_LABEL, ""),
+        parallelism=int(spec.get("parallelism", 1)),
+        completions=int(spec.get("completions", spec.get("parallelism", 1))),
+        requests=requests)
+
+
+_DECODERS = {
+    "ResourceFlavor": decode_resource_flavor,
+    "ClusterQueue": decode_cluster_queue,
+    "LocalQueue": decode_local_queue,
+    "WorkloadPriorityClass": decode_workload_priority_class,
+    "AdmissionCheck": decode_admission_check,
+    "Workload": decode_workload,
+    "Job": decode_batch_job,
+}
+
+
+def decode(doc: Mapping[str, Any]):
+    """Decode one manifest document; returns (kind, object)."""
+    kind = doc.get("kind")
+    if kind not in _DECODERS:
+        raise DecodeError(f"unsupported kind {kind!r} "
+                          f"(supported: {', '.join(sorted(_DECODERS))})")
+    return kind, _DECODERS[kind](doc)
+
+
+def load_manifests(path: str) -> List[Tuple[str, object]]:
+    """Read a multi-document YAML manifest file (kubectl-apply analog)."""
+    import yaml
+
+    out = []
+    with open(path) as fh:
+        for doc in yaml.safe_load_all(fh):
+            if not doc:
+                continue
+            out.append(decode(doc))
+    return out

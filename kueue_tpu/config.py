@@ -1,16 +1,24 @@
 """Runtime configuration (counterpart of reference apis/config/v1beta1 +
 pkg/config).
 
-One Configuration object drives the runtime: waitForPodsReady gating and
-requeuing backoff (apis/config/v1beta1/configuration_types.go), queue
-visibility, and the fair-sharing knobs this framework implements natively
-(KEP-1714).
+One Configuration object drives the runtime. It can be built directly, or
+loaded from a YAML/dict document in the reference's on-disk format
+(camelCase keys, `--config` file of cmd/kueue/main.go:102-105): `load()`
+parses, `set_defaults()` applies the defaulting of
+apis/config/v1beta1/defaults.go:30-50, and `validate_configuration()`
+enforces the rules of pkg/config/validation.go:47-127.
+
+Knobs that only exist to configure Kubernetes transport (webhook TLS
+certs, client QPS/burst, bind addresses) are accepted and carried so
+reference config files load unchanged, but the in-process runtime has no
+TLS/apiserver boundary to apply them to; see PARITY.md for the explicit
+mapping.
 """
 
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Optional, Tuple
+from typing import Any, Dict, List, Mapping, Optional, Tuple
 
 from kueue_tpu.api.types import FairSharingStrategy
 
@@ -21,6 +29,32 @@ REQUEUING_TIMESTAMP_CREATION = "Creation"
 # (reference: core/workload_controller.go:393-399).
 BACKOFF_BASE_SECONDS = 1.0
 BACKOFF_FACTOR = 1.41284738
+
+# Defaults (apis/config/v1beta1/defaults.go:30-58).
+DEFAULT_NAMESPACE = "kueue-system"
+DEFAULT_PODS_READY_TIMEOUT_SECONDS = 300.0
+DEFAULT_QUEUE_VISIBILITY_UPDATE_INTERVAL_SECONDS = 5.0
+DEFAULT_CLUSTER_QUEUES_MAX_COUNT = 10
+DEFAULT_JOB_FRAMEWORK = "batch"
+DEFAULT_MULTIKUEUE_GC_INTERVAL_SECONDS = 60.0
+DEFAULT_MULTIKUEUE_ORIGIN = "multikueue"
+DEFAULT_MULTIKUEUE_WORKER_LOST_TIMEOUT_SECONDS = 15 * 60.0
+DEFAULT_LEADER_ELECTION_ID = "c1f6bfd2.kueue.x-k8s.io"
+DEFAULT_LEASE_DURATION_SECONDS = 15.0
+DEFAULT_RENEW_DEADLINE_SECONDS = 10.0
+DEFAULT_RETRY_PERIOD_SECONDS = 2.0
+
+# Validation bounds (pkg/config/validation.go:30-32).
+QUEUE_VISIBILITY_MAX_COUNT_LIMIT = 4000
+QUEUE_VISIBILITY_MIN_UPDATE_INTERVAL_SECONDS = 1.0
+
+
+class ConfigurationError(ValueError):
+    """Raised by validate_configuration / load on an invalid document."""
+
+    def __init__(self, errors: List[str]):
+        self.errors = list(errors)
+        super().__init__("; ".join(errors))
 
 
 @dataclass(frozen=True)
@@ -34,9 +68,9 @@ class RequeuingStrategy:
 @dataclass(frozen=True)
 class WaitForPodsReady:
     enable: bool = False
-    timeout_seconds: float = 300.0
+    timeout_seconds: float = DEFAULT_PODS_READY_TIMEOUT_SECONDS
     # Block new admissions while any admitted workload is not PodsReady
-    # (KEP-349 all-or-nothing).
+    # (KEP-349 all-or-nothing). Reference defaults this to `enable`.
     block_admission: bool = True
     requeuing_strategy: RequeuingStrategy = field(default_factory=RequeuingStrategy)
 
@@ -52,19 +86,342 @@ class FairSharingConfig:
 
 @dataclass(frozen=True)
 class QueueVisibility:
-    max_count: int = 10
-    update_interval_seconds: float = 5.0
+    max_count: int = DEFAULT_CLUSTER_QUEUES_MAX_COUNT
+    update_interval_seconds: float = DEFAULT_QUEUE_VISIBILITY_UPDATE_INTERVAL_SECONDS
+
+
+@dataclass(frozen=True)
+class PodIntegrationOptions:
+    """Namespace/pod label selectors scoping the pod-group integration
+    (configuration_types.go PodIntegrationOptions). Selectors are full
+    metav1.LabelSelector analogs (matchLabels + matchExpressions)."""
+    namespace_selector: Optional["LabelSelector"] = None
+    pod_selector: Optional["LabelSelector"] = None
+
+
+@dataclass(frozen=True)
+class Integrations:
+    # None = every registered integration (the embedded-library default);
+    # a config file without an `integrations` section gets the reference
+    # default of batch only (defaults.go:141-143).
+    frameworks: Optional[Tuple[str, ...]] = None
+    pod_options: Optional[PodIntegrationOptions] = None
+
+    def enables(self, kind: str) -> bool:
+        return self.frameworks is None or kind in self.frameworks
+
+
+@dataclass(frozen=True)
+class MultiKueueConfig:
+    """MultiKueue controller knobs (configuration_types.go MultiKueue)."""
+    gc_interval_seconds: float = DEFAULT_MULTIKUEUE_GC_INTERVAL_SECONDS
+    origin: str = DEFAULT_MULTIKUEUE_ORIGIN
+    worker_lost_timeout_seconds: float = DEFAULT_MULTIKUEUE_WORKER_LOST_TIMEOUT_SECONDS
+
+
+@dataclass(frozen=True)
+class LeaderElectionConfig:
+    """Lease-based leader election for HA replicas
+    (configv1alpha1.LeaderElectionConfiguration; defaults.go:37-44)."""
+    enable: bool = False
+    resource_name: str = DEFAULT_LEADER_ELECTION_ID
+    lease_duration_seconds: float = DEFAULT_LEASE_DURATION_SECONDS
+    renew_deadline_seconds: float = DEFAULT_RENEW_DEADLINE_SECONDS
+    retry_period_seconds: float = DEFAULT_RETRY_PERIOD_SECONDS
 
 
 @dataclass(frozen=True)
 class Configuration:
-    namespace: str = "kueue-system"
+    namespace: str = DEFAULT_NAMESPACE
+    # Reconcile jobs submitted with no queue name: suspended until queued
+    # (configuration_types.go ManageJobsWithoutQueueName).
+    manage_jobs_without_queue_name: bool = False
     wait_for_pods_ready: Optional[WaitForPodsReady] = None
     fair_sharing: Optional[FairSharingConfig] = None
     queue_visibility: QueueVisibility = field(default_factory=QueueVisibility)
+    integrations: Integrations = field(default_factory=Integrations)
+    multikueue: MultiKueueConfig = field(default_factory=MultiKueueConfig)
+    leader_election: LeaderElectionConfig = field(default_factory=LeaderElectionConfig)
+    # Transport-only reference knobs, carried opaquely (see module doc).
+    extra: Dict[str, Any] = field(default_factory=dict)
 
 
 def requeue_backoff_seconds(requeue_count: int) -> float:
     """Backoff before an evicted-by-PodsReady workload requeues:
     base * factor^(n-1) (workload_controller.go:393-404, jitter omitted)."""
     return BACKOFF_BASE_SECONDS * (BACKOFF_FACTOR ** max(0, requeue_count - 1))
+
+
+# -- loading (pkg/config/config.go:150-170 analog) ---------------------------
+
+_TRANSPORT_KEYS = (
+    "webhook", "metrics", "health", "pprofBindAddress", "controller",
+    "internalCertManagement", "clientConnection", "apiVersion", "kind",
+)
+
+
+def _duration_seconds(v: Any, default: float, field_name: str = "") -> float:
+    """Accept numbers (seconds) or k8s duration strings ("5m", "30s")."""
+    where = f"{field_name}: " if field_name else ""
+    if v is None:
+        return default
+    if isinstance(v, bool):
+        raise ConfigurationError([f"{where}invalid duration {v!r}"])
+    if isinstance(v, (int, float)):
+        return float(v)
+    s = str(v).strip()
+    if not s:
+        raise ConfigurationError([f"{where}invalid duration {v!r}"])
+    units = {"ms": 0.001, "s": 1.0, "m": 60.0, "h": 3600.0}
+    total, num = 0.0, ""
+    i = 0
+    try:
+        while i < len(s):
+            ch = s[i]
+            if ch.isdigit() or ch == ".":
+                num += ch
+                i += 1
+                continue
+            unit = ch
+            if s[i:i + 2] == "ms":
+                unit, i = "ms", i + 1
+            i += 1
+            if not num or unit not in units:
+                raise ValueError(s)
+            total += float(num) * units[unit]
+            num = ""
+        if num:  # bare number
+            total += float(num)
+    except ValueError:
+        raise ConfigurationError([f"{where}invalid duration {s!r}"])
+    return total
+
+
+def _decode_selector(sel: Optional[Mapping[str, Any]]) -> Optional["LabelSelector"]:
+    """Decode a metav1.LabelSelector document (matchLabels AND
+    matchExpressions — the reference's canonical podOptions default is
+    expression-based)."""
+    from kueue_tpu.api.types import LabelSelector, MatchExpression
+
+    if sel is None:
+        return None
+    return LabelSelector(
+        match_labels=tuple(sorted((sel.get("matchLabels") or {}).items())),
+        match_expressions=tuple(
+            MatchExpression(key=e["key"], operator=e["operator"],
+                            values=tuple(e.get("values") or ()))
+            for e in sel.get("matchExpressions") or ()))
+
+
+def from_dict(doc: Mapping[str, Any]) -> Configuration:
+    """Build a Configuration from a reference-format document (camelCase),
+    applying defaulting. Raises ConfigurationError on invalid fields."""
+    doc = dict(doc or {})
+
+    wfpr = None
+    if doc.get("waitForPodsReady") is not None:
+        w = doc["waitForPodsReady"]
+        enable = bool(w.get("enable", False))
+        rs = w.get("requeuingStrategy") or {}
+        wfpr = WaitForPodsReady(
+            enable=enable,
+            timeout_seconds=_duration_seconds(
+                w.get("timeout"), DEFAULT_PODS_READY_TIMEOUT_SECONDS,
+                "waitForPodsReady.timeout"),
+            # BlockAdmission defaults to Enable (defaults.go:118-124).
+            block_admission=bool(w.get("blockAdmission", enable)),
+            requeuing_strategy=RequeuingStrategy(
+                timestamp=rs.get("timestamp", REQUEUING_TIMESTAMP_EVICTION),
+                backoff_limit_count=rs.get("backoffLimitCount"),
+            ))
+
+    fair = None
+    if doc.get("fairSharing") is not None:
+        f = doc["fairSharing"]
+        strategies = tuple(f.get("preemptionStrategies") or
+                           FairSharingConfig().preemption_strategies)
+        fair = FairSharingConfig(enable=bool(f.get("enable", False)),
+                                 preemption_strategies=strategies)
+
+    qv = QueueVisibility()
+    if doc.get("queueVisibility") is not None:
+        q = doc["queueVisibility"]
+        cq = q.get("clusterQueues") or {}
+        qv = QueueVisibility(
+            max_count=int(cq.get("maxCount", DEFAULT_CLUSTER_QUEUES_MAX_COUNT)),
+            update_interval_seconds=float(q.get(
+                "updateIntervalSeconds",
+                DEFAULT_QUEUE_VISIBILITY_UPDATE_INTERVAL_SECONDS)))
+
+    # Config files get the reference default (batch only, defaults.go:141-143).
+    integrations = Integrations(frameworks=(DEFAULT_JOB_FRAMEWORK,))
+    if doc.get("integrations") is not None:
+        it = doc["integrations"]
+        # An explicitly empty list stays empty so validation rejects it
+        # (validation.go "cannot be empty"); only absence defaults.
+        raw_fw = it.get("frameworks")
+        frameworks = (tuple(raw_fw) if raw_fw is not None
+                      else (DEFAULT_JOB_FRAMEWORK,))
+        po = None
+        if it.get("podOptions") is not None:
+            po = PodIntegrationOptions(
+                namespace_selector=_decode_selector(
+                    it["podOptions"].get("namespaceSelector")),
+                pod_selector=_decode_selector(
+                    it["podOptions"].get("podSelector")))
+        integrations = Integrations(frameworks=frameworks, pod_options=po)
+
+    mk = MultiKueueConfig()
+    if doc.get("multiKueue") is not None:
+        m = doc["multiKueue"]
+        mk = MultiKueueConfig(
+            gc_interval_seconds=_duration_seconds(
+                m.get("gcInterval"), DEFAULT_MULTIKUEUE_GC_INTERVAL_SECONDS,
+                "multiKueue.gcInterval"),
+            origin=m.get("origin") or DEFAULT_MULTIKUEUE_ORIGIN,
+            worker_lost_timeout_seconds=_duration_seconds(
+                m.get("workerLostTimeout"),
+                DEFAULT_MULTIKUEUE_WORKER_LOST_TIMEOUT_SECONDS,
+                "multiKueue.workerLostTimeout"))
+
+    le = LeaderElectionConfig()
+    if doc.get("leaderElection") is not None:
+        l = doc["leaderElection"]
+        le = LeaderElectionConfig(
+            enable=bool(l.get("leaderElect", False)),
+            resource_name=l.get("resourceName") or DEFAULT_LEADER_ELECTION_ID,
+            lease_duration_seconds=_duration_seconds(
+                l.get("leaseDuration"), DEFAULT_LEASE_DURATION_SECONDS,
+                "leaderElection.leaseDuration"),
+            renew_deadline_seconds=_duration_seconds(
+                l.get("renewDeadline"), DEFAULT_RENEW_DEADLINE_SECONDS,
+                "leaderElection.renewDeadline"),
+            retry_period_seconds=_duration_seconds(
+                l.get("retryPeriod"), DEFAULT_RETRY_PERIOD_SECONDS,
+                "leaderElection.retryPeriod"))
+
+    cfg = Configuration(
+        namespace=doc.get("namespace") or DEFAULT_NAMESPACE,
+        manage_jobs_without_queue_name=bool(
+            doc.get("manageJobsWithoutQueueName", False)),
+        wait_for_pods_ready=wfpr,
+        fair_sharing=fair,
+        queue_visibility=qv,
+        integrations=integrations,
+        multikueue=mk,
+        leader_election=le,
+        extra={k: doc[k] for k in _TRANSPORT_KEYS if k in doc},
+    )
+    errors = validate_configuration(cfg)
+    if errors:
+        raise ConfigurationError(errors)
+    return cfg
+
+
+def load(path: str) -> Configuration:
+    """Load a configuration file (YAML, reference --config format)."""
+    import yaml
+
+    with open(path) as fh:
+        doc = yaml.safe_load(fh) or {}
+    if not isinstance(doc, dict):
+        raise ConfigurationError([f"config file {path} is not a mapping"])
+    return from_dict(doc)
+
+
+# -- validation (pkg/config/validation.go) -----------------------------------
+
+def known_frameworks() -> Tuple[str, ...]:
+    from kueue_tpu.controllers import jobframework
+    import kueue_tpu.jobs  # noqa: F401  (registers integrations)
+    return tuple(sorted(jobframework.integrations()))
+
+
+def validate_configuration(cfg: Configuration) -> List[str]:
+    errors: List[str] = []
+
+    # waitForPodsReady (validation.go:56-73)
+    wfpr = cfg.wait_for_pods_ready
+    if wfpr is not None and wfpr.enable:
+        rs = wfpr.requeuing_strategy
+        if rs.timestamp not in (REQUEUING_TIMESTAMP_EVICTION,
+                                REQUEUING_TIMESTAMP_CREATION):
+            errors.append(
+                "waitForPodsReady.requeuingStrategy.timestamp: unsupported "
+                f"value {rs.timestamp!r} (want Eviction or Creation)")
+        if rs.backoff_limit_count is not None and rs.backoff_limit_count < 0:
+            errors.append(
+                "waitForPodsReady.requeuingStrategy.backoffLimitCount: "
+                "must not be negative")
+        if wfpr.timeout_seconds <= 0:
+            errors.append("waitForPodsReady.timeout: must be positive")
+
+    # queueVisibility (validation.go:75-90)
+    qv = cfg.queue_visibility
+    if qv.max_count > QUEUE_VISIBILITY_MAX_COUNT_LIMIT:
+        errors.append(
+            f"queueVisibility.clusterQueues.maxCount: must be less than "
+            f"{QUEUE_VISIBILITY_MAX_COUNT_LIMIT}")
+    if qv.update_interval_seconds < QUEUE_VISIBILITY_MIN_UPDATE_INTERVAL_SECONDS:
+        errors.append(
+            "queueVisibility.updateIntervalSeconds: must be greater than or "
+            f"equal to {QUEUE_VISIBILITY_MIN_UPDATE_INTERVAL_SECONDS:g}")
+
+    # integrations (validation.go:92-127)
+    if cfg.integrations.frameworks is not None and not cfg.integrations.frameworks:
+        errors.append("integrations.frameworks: cannot be empty")
+    elif cfg.integrations.frameworks is not None:
+        known = known_frameworks()
+        for fw in cfg.integrations.frameworks:
+            if fw not in known:
+                errors.append(
+                    f"integrations.frameworks: unknown framework {fw!r} "
+                    f"(known: {', '.join(known)})")
+        if "podgroup" in cfg.integrations.frameworks:
+            po = cfg.integrations.pod_options
+            if po is None:
+                errors.append(
+                    "integrations.podOptions: cannot be empty when the pod "
+                    "integration is enabled")
+            elif po.namespace_selector is None:
+                errors.append(
+                    "integrations.podOptions.namespaceSelector: a namespace "
+                    "selector is required")
+            else:
+                # Never reconcile kube-system or the controller namespace
+                # (validation.go prohibitedNamespaces): the selector must
+                # NOT match either namespace, whether it is expressed as
+                # matchLabels or matchExpressions.
+                for prohibited in ("kube-system", cfg.namespace):
+                    if po.namespace_selector.matches(
+                            {"kubernetes.io/metadata.name": prohibited}):
+                        errors.append(
+                            "integrations.podOptions.namespaceSelector: "
+                            f"must not match the {prohibited!r} namespace")
+
+    # fairSharing preemption strategies (reference validates the enum)
+    if cfg.fair_sharing is not None:
+        known_strategies = (FairSharingStrategy.LESS_THAN_OR_EQUAL_TO_FINAL_SHARE,
+                            FairSharingStrategy.LESS_THAN_INITIAL_SHARE)
+        for s in cfg.fair_sharing.preemption_strategies:
+            if s not in known_strategies:
+                errors.append(
+                    f"fairSharing.preemptionStrategies: unsupported value "
+                    f"{s!r} (want one of: {', '.join(known_strategies)})")
+
+    # multiKueue
+    if cfg.multikueue.gc_interval_seconds < 0:
+        errors.append("multiKueue.gcInterval: must not be negative")
+    if cfg.multikueue.worker_lost_timeout_seconds < 0:
+        errors.append("multiKueue.workerLostTimeout: must not be negative")
+
+    # leaderElection
+    le = cfg.leader_election
+    if le.enable:
+        if le.lease_duration_seconds <= le.renew_deadline_seconds:
+            errors.append("leaderElection.leaseDuration: must be greater "
+                          "than renewDeadline")
+        if le.renew_deadline_seconds <= le.retry_period_seconds:
+            errors.append("leaderElection.renewDeadline: must be greater "
+                          "than retryPeriod")
+    return errors

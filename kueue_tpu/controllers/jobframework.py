@@ -133,6 +133,18 @@ def integrations() -> Dict[str, Type[GenericJob]]:
     return dict(_INTEGRATIONS)
 
 
+def kind_of(job: GenericJob) -> Optional[str]:
+    """Registered integration kind of a job instance (exact class first,
+    then subclass match — the registry lookup of integrationmanager.go)."""
+    for kind, cls in _INTEGRATIONS.items():
+        if type(job) is cls:
+            return kind
+    for kind, cls in _INTEGRATIONS.items():
+        if isinstance(job, cls):
+            return kind
+    return None
+
+
 class JobReconciler:
     """The job <-> workload state machine (reconciler.go:159-440).
 
@@ -148,9 +160,29 @@ class JobReconciler:
     def job_key(job: GenericJob) -> str:
         return f"{job.namespace}/{job.name}"
 
-    def submit(self, job: GenericJob) -> Workload:
+    def submit(self, job: GenericJob) -> Optional[Workload]:
         """Admit a job into the queueing system: default-suspend it and
-        create its Workload (reconciler.go handleJobWithNoWorkload)."""
+        create its Workload (reconciler.go handleJobWithNoWorkload).
+
+        Jobs of a non-enabled integration are rejected
+        (integrationmanager.go:44-76: only configured integrations are set
+        up). Jobs without a queue name are only managed when
+        manageJobsWithoutQueueName is set (reconciler.go:173-180); when it
+        is off they are left alone (returns None, job unsuspended)."""
+        cfg = self.fw.config
+        kind = kind_of(job)
+        if kind is not None and not cfg.integrations.enables(kind):
+            raise ValueError(
+                f"integration {kind!r} is not enabled in "
+                f"integrations.frameworks {cfg.integrations.frameworks}")
+        if not job.queue_name:
+            if not cfg.manage_jobs_without_queue_name:
+                return None
+            # Managed but unqueued: held suspended, no workload until a
+            # queue is assigned.
+            if not job.is_suspended():
+                job.suspend()
+            return None
         if not job.is_suspended():
             job.suspend()
         wl = Workload(

@@ -1,0 +1,189 @@
+"""Lease-based leader election for HA replicas.
+
+Counterpart of the reference's controller-runtime leader election wiring
+(cmd/kueue/main.go manager options + apis/config/v1beta1/defaults.go:37-44:
+lease ``c1f6bfd2.kueue.x-k8s.io``, 15s lease / 10s renew / 2s retry) and of
+``pkg/controller/core/leader_aware_reconciler.go``: non-leading replicas do
+not reconcile — they requeue events for one lease duration so nothing is
+missed across a fail-over, keeping hot-standby replicas' webhooks serving
+while only the leader mutates state.
+
+The lease itself is the in-process analog of a coordination.k8s.io Lease:
+a shared `Lease` record in a `LeaseStore` that candidates acquire by
+compare-and-swap on (holder, renew deadline). kube-style semantics: a
+candidate may take the lease when it is unheld or its previous holder's
+lease duration elapsed without renewal; the holder renews every retry
+period and abdicates by zeroing the holder identity.
+"""
+
+from __future__ import annotations
+
+import threading
+import time as _time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, Optional
+
+from kueue_tpu.config import LeaderElectionConfig
+
+
+@dataclass
+class Lease:
+    name: str
+    holder: str = ""
+    acquire_time: float = 0.0
+    renew_time: float = 0.0
+    lease_duration_seconds: float = 15.0
+    # Incremented on every holder change (Lease.spec.leaseTransitions).
+    transitions: int = 0
+
+
+class LeaseStore:
+    """Shared lease records; the CAS point all candidates race on."""
+
+    def __init__(self):
+        self._leases: Dict[str, Lease] = {}
+        self._lock = threading.Lock()
+
+    def try_acquire_or_renew(self, name: str, identity: str,
+                             lease_duration: float, now: float) -> bool:
+        with self._lock:
+            lease = self._leases.get(name)
+            if lease is None:
+                lease = Lease(name=name)
+                self._leases[name] = lease
+            if lease.holder == identity:
+                lease.renew_time = now
+                lease.lease_duration_seconds = lease_duration
+                return True
+            expired = (not lease.holder or
+                       now >= lease.renew_time + lease.lease_duration_seconds)
+            if not expired:
+                return False
+            lease.holder = identity
+            lease.acquire_time = now
+            lease.renew_time = now
+            lease.lease_duration_seconds = lease_duration
+            lease.transitions += 1
+            return True
+
+    def release(self, name: str, identity: str) -> None:
+        with self._lock:
+            lease = self._leases.get(name)
+            if lease is not None and lease.holder == identity:
+                lease.holder = ""
+
+    def holder(self, name: str) -> str:
+        with self._lock:
+            lease = self._leases.get(name)
+            return lease.holder if lease is not None else ""
+
+
+class LeaderElector:
+    """One replica's view of the election.
+
+    Drive it with `step()` from the replica's main loop (or `run()` on a
+    thread): each step renews when leading or retries acquisition when not,
+    spaced by the configured retry period. `is_leader()` answers the
+    question the manager's Elected() channel answers in the reference;
+    leadership is lost implicitly once the renew deadline passes without a
+    successful renewal.
+    """
+
+    def __init__(self, store: LeaseStore, identity: str,
+                 config: Optional[LeaderElectionConfig] = None,
+                 clock: Callable[[], float] = _time.time,
+                 on_started_leading: Optional[Callable[[], None]] = None,
+                 on_stopped_leading: Optional[Callable[[], None]] = None):
+        self.store = store
+        self.identity = identity
+        self.config = config or LeaderElectionConfig(enable=True)
+        self.clock = clock
+        self.on_started_leading = on_started_leading
+        self.on_stopped_leading = on_stopped_leading
+        self._last_renew = 0.0
+        self._last_attempt = -float("inf")
+        self._leading = False
+        self._stop = threading.Event()
+
+    def is_leader(self) -> bool:
+        if not self._leading:
+            return False
+        now = self.clock()
+        if now >= self._last_renew + self.config.renew_deadline_seconds:
+            # Failed to renew within the deadline: no longer leading even
+            # though the lease record may not have been taken over yet.
+            self._set_leading(False)
+        return self._leading
+
+    def step(self) -> bool:
+        """Attempt one acquire/renew if the retry period elapsed; returns
+        current leadership."""
+        now = self.clock()
+        if now - self._last_attempt < self.config.retry_period_seconds:
+            return self.is_leader()
+        self._last_attempt = now
+        ok = self.store.try_acquire_or_renew(
+            self.config.resource_name, self.identity,
+            self.config.lease_duration_seconds, now)
+        if ok:
+            self._last_renew = now
+        self._set_leading(ok or self.is_leader())
+        return self._leading
+
+    def release(self) -> None:
+        """Voluntarily abdicate (graceful shutdown)."""
+        self.store.release(self.config.resource_name, self.identity)
+        self._set_leading(False)
+
+    def _set_leading(self, leading: bool) -> None:
+        if leading and not self._leading:
+            self._leading = True
+            if self.on_started_leading:
+                self.on_started_leading()
+        elif not leading and self._leading:
+            self._leading = False
+            if self.on_stopped_leading:
+                self.on_stopped_leading()
+
+    # -- threaded driving (optional) ----------------------------------------
+
+    def run(self) -> threading.Thread:
+        def loop():
+            while not self._stop.is_set():
+                self.step()
+                self._stop.wait(self.config.retry_period_seconds)
+        t = threading.Thread(target=loop, daemon=True)
+        t.start()
+        return t
+
+    def stop(self) -> None:
+        self._stop.set()
+
+
+class LeaderAwareReconciler:
+    """Decorator that delays reconciles on non-leading replicas
+    (core/leader_aware_reconciler.go:46-74).
+
+    `reconcile(key)` returns either the delegate's result (when leading) or
+    a requeue-after of one lease duration so no event is missed across the
+    period a fail-over can take. Deleted objects are discarded instead of
+    requeued indefinitely (the IgnoreNotFound branch).
+    """
+
+    def __init__(self, elector: LeaderElector, delegate: Callable[[str], object],
+                 exists: Callable[[str], bool]):
+        self.elector = elector
+        self.delegate = delegate
+        self.exists = exists
+
+    def reconcile(self, key: str):
+        if self.elector.is_leader():
+            return self.delegate(key)
+        if not self.exists(key):
+            return None  # discard: object is gone
+        return RequeueAfter(self.elector.config.lease_duration_seconds)
+
+
+@dataclass(frozen=True)
+class RequeueAfter:
+    seconds: float

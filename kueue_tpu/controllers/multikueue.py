@@ -198,10 +198,17 @@ class MultiKueueController:
     """Drives MultiKueue-type AdmissionChecks against worker clusters."""
 
     def __init__(self, framework, check_name: str = "multikueue",
-                 worker_lost_timeout: float = DEFAULT_WORKER_LOST_TIMEOUT,
+                 worker_lost_timeout: Optional[float] = None,
                  client_factory=None):
         self.fw = framework
         self.check_name = check_name
+        if worker_lost_timeout is None:
+            # Wired from the Configuration file (multiKueue.workerLostTimeout,
+            # apis/config defaults.go:49) unless explicitly overridden.
+            runtime_cfg = getattr(framework, "config", None)
+            worker_lost_timeout = (
+                runtime_cfg.multikueue.worker_lost_timeout_seconds
+                if runtime_cfg is not None else DEFAULT_WORKER_LOST_TIMEOUT)
         self.clusters: Dict[str, RemoteClient] = {}
         self.cluster_specs: Dict[str, MultiKueueCluster] = {}
         self.configs: Dict[str, MultiKueueConfig] = {}

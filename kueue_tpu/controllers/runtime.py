@@ -256,8 +256,12 @@ class Framework:
         self.workloads[wl.key] = wl
         self.queues.add_or_update_workload(wl)
 
-    def submit_job(self, job) -> Workload:
-        """Run a GenericJob through the queueing system (jobframework)."""
+    def submit_job(self, job) -> Optional[Workload]:
+        """Run a GenericJob through the queueing system (jobframework).
+
+        Returns None when the job is not managed: no queue name with
+        manageJobsWithoutQueueName off (left alone), or held suspended
+        awaiting a queue with it on."""
         return self.job_reconciler.submit(job)
 
     def update_reclaimable_pods(self, wl: Workload,

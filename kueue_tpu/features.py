@@ -44,6 +44,10 @@ def set_enabled(name: str, value: bool) -> None:
     _gates[name] = value
 
 
+def all_gates() -> Dict[str, bool]:
+    return dict(_gates)
+
+
 def reset() -> None:
     _gates.clear()
     _gates.update(_DEFAULTS)

@@ -28,3 +28,4 @@ from kueue_tpu.jobs.kubeflow import (
 from kueue_tpu.jobs.mpijob import MPIJob
 from kueue_tpu.jobs.noop import NoopJob
 from kueue_tpu.jobs.ray import RayCluster, RayJob, WorkerGroup
+from kueue_tpu.jobs.taints_job import TaintsTolerationsPod

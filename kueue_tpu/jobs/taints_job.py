@@ -1,0 +1,132 @@
+"""Taints/tolerations pod integration (out-of-tree extension sample).
+
+Counterpart of the reference's experimental standalone controller
+``cmd/experimental/podtaintstolerations``: bare pods on clusters whose
+nodes carry an admission taint (``kueue.x-k8s.io/kueue-admission``).
+Suspension is *encoded in the tolerations* rather than a suspend field
+(controller/pod_jobs.go:55-62): a pod without the admission toleration
+cannot schedule anywhere, so it is queued; admission adds the toleration
+plus one toleration per flavor node-selector label
+(pod_jobs.go RunWithPodSetsInfo), and stop removes them again.
+
+Like the reference, this doubles as the template for building an
+integration out-of-tree: it is ordinary `register_integration` usage with
+no special hooks.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from kueue_tpu.api.resources import resource_value
+from kueue_tpu.api.types import PodSet, Toleration
+from kueue_tpu.controllers.jobframework import (
+    GenericJob,
+    PodSetInfo,
+    register_integration,
+)
+
+ADMISSION_TAINT_KEY = "kueue.x-k8s.io/kueue-admission"
+
+
+@register_integration("taintspod")
+class TaintsTolerationsPod(GenericJob):
+    """A single bare pod admitted by toleration rewriting."""
+
+    def __init__(self, name: str, queue_name: str,
+                 requests: Optional[Dict[str, object]] = None,
+                 namespace: str = "default",
+                 tolerations: Sequence[Toleration] = (),
+                 priority: int = 0, priority_class: str = ""):
+        self._name = name
+        self._namespace = namespace
+        self._queue_name = queue_name
+        self._requests = {r: resource_value(r, q)
+                          for r, q in (requests or {}).items()}
+        self.tolerations: List[Toleration] = list(tolerations)
+        self._priority = priority
+        self._priority_class = priority_class
+        self.phase = "Pending"  # Pending | Running | Succeeded | Failed
+        self.deleted = False
+
+    # -- GenericJob ----------------------------------------------------------
+
+    @property
+    def name(self) -> str:
+        return self._name
+
+    @property
+    def namespace(self) -> str:
+        return self._namespace
+
+    @property
+    def queue_name(self) -> str:
+        return self._queue_name
+
+    def is_suspended(self) -> bool:
+        """Suspended = no Exists-toleration for the admission taint
+        (pod_jobs.go:55-62)."""
+        return not any(t.key == ADMISSION_TAINT_KEY and t.operator == "Exists"
+                       for t in self.tolerations)
+
+    def suspend(self) -> None:
+        # Not used directly: stop deletes the pod (JobWithCustomStop,
+        # pod_jobs.go Stop); restore() strips the admission tolerations.
+        pass
+
+    def is_active(self) -> bool:
+        return self.phase == "Running"
+
+    def run(self, podset_infos: Sequence[PodSetInfo]) -> None:
+        """Admission: ensure the admission toleration and one per flavor
+        node-selector label (pod_jobs.go RunWithPodSetsInfo)."""
+        info = podset_infos[0]
+        have = {t.key for t in self.tolerations}
+        if ADMISSION_TAINT_KEY not in have:
+            self.tolerations.append(
+                Toleration(key=ADMISSION_TAINT_KEY, operator="Exists"))
+        else:
+            self.tolerations = [
+                Toleration(key=t.key, operator="Exists")
+                if t.key == ADMISSION_TAINT_KEY else t
+                for t in self.tolerations]
+        for k, v in info.node_selector.items():
+            matched = False
+            out = []
+            for t in self.tolerations:
+                if t.key == k:
+                    out.append(Toleration(key=k, operator="Equal", value=v))
+                    matched = True
+                else:
+                    out.append(t)
+            if not matched:
+                out.append(Toleration(key=k, operator="Equal", value=v))
+            self.tolerations = out
+        self.phase = "Running"
+
+    def restore(self, podset_infos: Sequence[PodSetInfo]) -> None:
+        """Stop: the reference deletes the pod (it cannot be un-admitted);
+        mirror by marking deleted and stripping injected tolerations."""
+        selector_keys = set()
+        for info in podset_infos:
+            selector_keys.update(info.node_selector)
+        self.tolerations = [
+            t for t in self.tolerations
+            if t.key != ADMISSION_TAINT_KEY and t.key not in selector_keys]
+        self.phase = "Pending"
+        self.deleted = True
+
+    def pod_sets(self) -> List[PodSet]:
+        return [PodSet(name="main", count=1, requests=dict(self._requests))]
+
+    def finished(self) -> Tuple[bool, bool]:
+        return self.phase in ("Succeeded", "Failed"), self.phase == "Succeeded"
+
+    def pods_ready(self) -> bool:
+        return self.phase == "Running"
+
+    def priority_class(self) -> str:
+        return self._priority_class
+
+    def priority(self) -> int:
+        return self._priority

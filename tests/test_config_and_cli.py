@@ -1,0 +1,370 @@
+"""Configuration loading/validation (pkg/config analog), YAML manifest
+decoding (examples/ format), leader election, and the __main__ CLI."""
+
+import json
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from kueue_tpu import config as config_mod
+from kueue_tpu.api import serialization
+from kueue_tpu.controllers.leaderelection import (
+    LeaderAwareReconciler,
+    LeaderElector,
+    LeaseStore,
+    RequeueAfter,
+)
+
+SETUP_YAML = textwrap.dedent("""\
+    apiVersion: kueue.x-k8s.io/v1beta1
+    kind: ResourceFlavor
+    metadata:
+      name: "default-flavor"
+    ---
+    apiVersion: kueue.x-k8s.io/v1beta1
+    kind: ClusterQueue
+    metadata:
+      name: "cluster-queue"
+    spec:
+      namespaceSelector: {}
+      resourceGroups:
+      - coveredResources: ["cpu", "memory"]
+        flavors:
+        - name: "default-flavor"
+          resources:
+          - name: "cpu"
+            nominalQuota: 9
+          - name: "memory"
+            nominalQuota: 36Gi
+    ---
+    apiVersion: kueue.x-k8s.io/v1beta1
+    kind: LocalQueue
+    metadata:
+      namespace: "default"
+      name: "user-queue"
+    spec:
+      clusterQueue: "cluster-queue"
+""")
+
+JOB_YAML = textwrap.dedent("""\
+    apiVersion: batch/v1
+    kind: Job
+    metadata:
+      name: sample-job
+      namespace: default
+      labels:
+        kueue.x-k8s.io/queue-name: user-queue
+    spec:
+      parallelism: 3
+      completions: 3
+      suspend: true
+      template:
+        spec:
+          containers:
+          - name: dummy-job
+            resources:
+              requests:
+                cpu: 1
+                memory: "200Mi"
+""")
+
+
+# -- config ------------------------------------------------------------------
+
+class TestConfiguration:
+    def test_defaults(self):
+        cfg = config_mod.from_dict({})
+        assert cfg.namespace == "kueue-system"
+        assert cfg.integrations.frameworks == ("batch",)
+        assert cfg.queue_visibility.max_count == 10
+        assert cfg.multikueue.worker_lost_timeout_seconds == 900.0
+        assert not cfg.leader_election.enable
+
+    def test_wait_for_pods_ready_defaulting(self):
+        cfg = config_mod.from_dict({
+            "waitForPodsReady": {"enable": True, "timeout": "10m"}})
+        w = cfg.wait_for_pods_ready
+        assert w.enable and w.block_admission
+        assert w.timeout_seconds == 600.0
+        assert w.requeuing_strategy.timestamp == "Eviction"
+
+    def test_duration_forms(self):
+        assert config_mod._duration_seconds("1m30s", 0) == 90.0
+        assert config_mod._duration_seconds("500ms", 0) == 0.5
+        assert config_mod._duration_seconds(42, 0) == 42.0
+        assert config_mod._duration_seconds(None, 7.0) == 7.0
+
+    def test_invalid_requeuing_timestamp(self):
+        with pytest.raises(config_mod.ConfigurationError) as ei:
+            config_mod.from_dict({"waitForPodsReady": {
+                "enable": True,
+                "requeuingStrategy": {"timestamp": "Bogus"}}})
+        assert "timestamp" in str(ei.value)
+
+    def test_negative_backoff_limit(self):
+        with pytest.raises(config_mod.ConfigurationError):
+            config_mod.from_dict({"waitForPodsReady": {
+                "enable": True,
+                "requeuingStrategy": {"backoffLimitCount": -1}}})
+
+    def test_queue_visibility_bounds(self):
+        with pytest.raises(config_mod.ConfigurationError):
+            config_mod.from_dict({"queueVisibility": {
+                "clusterQueues": {"maxCount": 4001}}})
+        with pytest.raises(config_mod.ConfigurationError):
+            config_mod.from_dict({"queueVisibility": {
+                "updateIntervalSeconds": 0}})
+
+    def test_unknown_framework(self):
+        with pytest.raises(config_mod.ConfigurationError) as ei:
+            config_mod.from_dict({"integrations": {"frameworks": ["nope"]}})
+        assert "unknown framework" in str(ei.value)
+
+    def test_pod_integration_requires_namespace_selector(self):
+        with pytest.raises(config_mod.ConfigurationError) as ei:
+            config_mod.from_dict({"integrations": {"frameworks": ["podgroup"]}})
+        assert "podOptions" in str(ei.value)
+        # kube-system must never be reconciled (validation.go prohibited).
+        with pytest.raises(config_mod.ConfigurationError):
+            config_mod.from_dict({"integrations": {
+                "frameworks": ["podgroup"],
+                "podOptions": {"namespaceSelector": {"matchLabels": {
+                    "kubernetes.io/metadata.name": "kube-system"}}}}})
+
+    def test_load_file(self, tmp_path):
+        p = tmp_path / "cfg.yaml"
+        p.write_text("namespace: my-ns\n"
+                     "multiKueue:\n  gcInterval: 2m\n  origin: org\n")
+        cfg = config_mod.load(str(p))
+        assert cfg.namespace == "my-ns"
+        assert cfg.multikueue.gc_interval_seconds == 120.0
+        assert cfg.multikueue.origin == "org"
+
+    def test_leader_election_validation(self):
+        with pytest.raises(config_mod.ConfigurationError):
+            config_mod.from_dict({"leaderElection": {
+                "leaderElect": True,
+                "leaseDuration": "5s", "renewDeadline": "10s"}})
+
+
+# -- manifest decoding -------------------------------------------------------
+
+class TestSerialization:
+    def test_reference_setup_manifest(self, tmp_path):
+        p = tmp_path / "setup.yaml"
+        p.write_text(SETUP_YAML)
+        objs = serialization.load_manifests(str(p))
+        kinds = [k for k, _ in objs]
+        assert kinds == ["ResourceFlavor", "ClusterQueue", "LocalQueue"]
+        cq = objs[1][1]
+        fq = cq.resource_groups[0].flavors[0]
+        quotas = dict(fq.resources)
+        assert quotas["cpu"].nominal == 9000  # milliCPU
+        assert quotas["memory"].nominal == 36 * 1024 ** 3
+
+    def test_batch_job_decode_round_trips_requests(self, tmp_path):
+        p = tmp_path / "job.yaml"
+        p.write_text(JOB_YAML)
+        [(kind, job)] = serialization.load_manifests(str(p))
+        assert kind == "Job"
+        [ps] = job.pod_sets()
+        assert ps.count == 3
+        assert ps.requests["cpu"] == 1000  # not double-scaled
+        assert ps.requests["memory"] == 200 * 1024 ** 2
+
+    def test_workload_decode(self):
+        kind, wl = serialization.decode({
+            "kind": "Workload",
+            "metadata": {"name": "w", "namespace": "ns"},
+            "spec": {
+                "queueName": "q",
+                "priorityClassName": "high",
+                "podSets": [{
+                    "name": "main", "count": 2, "minCount": 1,
+                    "template": {"spec": {
+                        "nodeSelector": {"zone": "a"},
+                        "tolerations": [{"key": "k", "operator": "Exists"}],
+                        "containers": [{"resources": {
+                            "requests": {"cpu": "500m"}}}],
+                    }},
+                }],
+            }})
+        assert kind == "Workload"
+        [ps] = wl.pod_sets
+        assert ps.requests["cpu"] == 500 * 2 // 2  # 500m per pod
+        assert ps.min_count == 1
+        assert dict(ps.node_selector) == {"zone": "a"}
+        assert wl.priority_class == "high"
+
+    def test_unsupported_kind(self):
+        with pytest.raises(serialization.DecodeError):
+            serialization.decode({"kind": "Gizmo", "metadata": {"name": "x"}})
+
+
+# -- config wiring into the runtime ------------------------------------------
+
+class TestConfigWiring:
+    def _fw(self, cfg):
+        from kueue_tpu.api import (ClusterQueue, FlavorQuotas, LocalQueue,
+                                   ResourceFlavor, ResourceGroup)
+        from kueue_tpu.controllers.runtime import Framework
+        fw = Framework(config=cfg)
+        fw.create_resource_flavor(ResourceFlavor.make("default"))
+        fw.create_cluster_queue(ClusterQueue(
+            name="cq", resource_groups=(ResourceGroup(
+                ("cpu",), (FlavorQuotas.make("default", cpu=8),)),)))
+        fw.create_local_queue(LocalQueue(
+            name="main", namespace="default", cluster_queue="cq"))
+        return fw
+
+    def test_disabled_integration_rejected(self):
+        from kueue_tpu.jobs import BatchJob
+        from kueue_tpu.jobs.jobset import JobSet, ReplicatedJob
+        cfg = config_mod.from_dict({"integrations": {"frameworks": ["batch"]}})
+        fw = self._fw(cfg)
+        fw.submit_job(BatchJob(name="ok", queue_name="main", parallelism=1,
+                               requests={"cpu": 1}))
+        with pytest.raises(ValueError, match="not enabled"):
+            fw.submit_job(JobSet(name="no", queue_name="main",
+                                 replicated_jobs=[ReplicatedJob(
+                                     "r", 1, 1, {"cpu": 1})]))
+
+    def test_default_library_config_enables_all(self):
+        from kueue_tpu.jobs.jobset import JobSet, ReplicatedJob
+        fw = self._fw(config_mod.Configuration())
+        wl = fw.submit_job(JobSet(name="js", queue_name="main",
+                                  replicated_jobs=[ReplicatedJob(
+                                      "r", 1, 1, {"cpu": 1})]))
+        assert wl is not None
+
+    def test_unqueued_job_unmanaged_by_default(self):
+        from kueue_tpu.jobs import BatchJob
+        fw = self._fw(config_mod.Configuration())
+        job = BatchJob(name="free", queue_name="", parallelism=1,
+                       requests={"cpu": 1})
+        assert fw.submit_job(job) is None
+        assert job.is_suspended()  # constructed suspended, left untouched
+
+    def test_unqueued_job_held_when_managed(self):
+        from kueue_tpu.jobs import BatchJob
+        cfg = config_mod.from_dict({"manageJobsWithoutQueueName": True})
+        fw = self._fw(cfg)
+        job = BatchJob(name="held", queue_name="", parallelism=1,
+                       requests={"cpu": 1})
+        assert fw.submit_job(job) is None
+        assert job.is_suspended()
+
+    def test_multikueue_timeout_from_config(self):
+        from kueue_tpu.controllers.multikueue import MultiKueueController
+        cfg = config_mod.from_dict({"multiKueue": {"workerLostTimeout": "1m"}})
+        fw = self._fw(cfg)
+        ctrl = MultiKueueController(fw)
+        assert ctrl.worker_lost_timeout == 60.0
+
+    def test_fair_sharing_strategy_validated(self):
+        with pytest.raises(config_mod.ConfigurationError, match="unsupported"):
+            config_mod.from_dict({"fairSharing": {
+                "enable": True,
+                "preemptionStrategies": ["LessThanFinalShare"]}})
+
+
+# -- leader election ---------------------------------------------------------
+
+class TestLeaderElection:
+    def test_single_candidate_acquires_and_renews(self):
+        now = [0.0]
+        store = LeaseStore()
+        a = LeaderElector(store, "a", clock=lambda: now[0])
+        assert a.step() and a.is_leader()
+        now[0] += 5.0
+        assert a.step() and a.is_leader()
+
+    def test_second_candidate_waits_for_expiry(self):
+        now = [0.0]
+        store = LeaseStore()
+        a = LeaderElector(store, "a", clock=lambda: now[0])
+        b = LeaderElector(store, "b", clock=lambda: now[0])
+        assert a.step()
+        assert not b.step()
+        # a stops renewing; lease expires after leaseDuration (15s).
+        now[0] += 16.0
+        assert b.step() and b.is_leader()
+        assert not a.is_leader()  # renew deadline passed
+
+    def test_transitions_counted(self):
+        now = [0.0]
+        store = LeaseStore()
+        a = LeaderElector(store, "a", clock=lambda: now[0])
+        b = LeaderElector(store, "b", clock=lambda: now[0])
+        a.step()
+        a.release()
+        b.step()
+        assert store._leases[b.config.resource_name].transitions == 2
+
+    def test_leader_aware_reconciler_defers(self):
+        now = [0.0]
+        store = LeaseStore()
+        a = LeaderElector(store, "a", clock=lambda: now[0])
+        b = LeaderElector(store, "b", clock=lambda: now[0])
+        a.step()
+        b.step()
+        seen = []
+        rec_b = LeaderAwareReconciler(b, seen.append, exists=lambda k: True)
+        out = rec_b.reconcile("obj")
+        assert isinstance(out, RequeueAfter) and not seen
+        rec_a = LeaderAwareReconciler(a, seen.append, exists=lambda k: True)
+        rec_a.reconcile("obj")
+        assert seen == ["obj"]
+        # deleted objects are discarded, not requeued (IgnoreNotFound).
+        rec_gone = LeaderAwareReconciler(b, seen.append, exists=lambda k: False)
+        assert rec_gone.reconcile("gone") is None
+
+
+# -- CLI ---------------------------------------------------------------------
+
+class TestMain:
+    def _write(self, tmp_path):
+        setup = tmp_path / "setup.yaml"
+        setup.write_text(SETUP_YAML)
+        job = tmp_path / "job.yaml"
+        job.write_text(JOB_YAML)
+        return setup, job
+
+    def test_cli_admits_example_job(self, tmp_path):
+        setup, job = self._write(tmp_path)
+        from kueue_tpu.__main__ import main
+        import io, contextlib
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            rc = main(["--objects", str(setup), "--objects", str(job)])
+        assert rc == 0
+        out = json.loads(buf.getvalue())
+        assert out["admitted"] == 1
+        assert out["clusterQueues"]["cluster-queue"]["pending"] == 0
+
+    def test_cli_feature_gates_flag(self, tmp_path):
+        setup, job = self._write(tmp_path)
+        from kueue_tpu.__main__ import main
+        from kueue_tpu import features
+        import io, contextlib
+        with features.override(features.PARTIAL_ADMISSION, False):
+            with contextlib.redirect_stdout(io.StringIO()):
+                rc = main(["--objects", str(setup),
+                           "--feature-gates", "PartialAdmission=true",
+                           "--ticks", "1"])
+            assert rc == 0
+            assert features.enabled(features.PARTIAL_ADMISSION)
+
+    def test_cli_subprocess_smoke(self, tmp_path):
+        setup, job = self._write(tmp_path)
+        proc = subprocess.run(
+            [sys.executable, "-m", "kueue_tpu",
+             "--objects", str(setup), "--objects", str(job)],
+            capture_output=True, text=True, timeout=120,
+            env={"PATH": "/usr/bin:/bin", "JAX_PLATFORMS": "cpu",
+                 "PYTHONPATH": "/root/repo",
+                 "HOME": "/root"})
+        assert proc.returncode == 0, proc.stderr
+        assert json.loads(proc.stdout.strip())["admitted"] == 1

@@ -196,3 +196,53 @@ class TestNoop:
         job = NoopJob(name="managed-pod")
         assert job.pod_sets() == []
         assert job.finished() == (False, False)
+
+
+class TestTaintsTolerationsPod:
+    """The experimental out-of-tree integration sample
+    (cmd/experimental/podtaintstolerations)."""
+
+    def test_suspension_encoded_in_tolerations(self):
+        from kueue_tpu.jobs import TaintsTolerationsPod
+        from kueue_tpu.jobs.taints_job import ADMISSION_TAINT_KEY
+        pod = TaintsTolerationsPod(name="p", queue_name="lq",
+                                   requests={"cpu": 1})
+        assert pod.is_suspended()
+        fw = make_fw()
+        wl = fw.submit_job(pod)
+        fw.run_until_settled()
+        assert not pod.is_suspended()
+        assert any(t.key == ADMISSION_TAINT_KEY and t.operator == "Exists"
+                   for t in pod.tolerations)
+        assert wl.is_admitted
+
+    def test_flavor_labels_become_tolerations(self):
+        from kueue_tpu.api.types import ResourceFlavor as RF
+        from kueue_tpu.jobs import TaintsTolerationsPod
+        fw = Framework()
+        fw.create_resource_flavor(RF.make("spot", node_labels={"tier": "spot"}))
+        fw.create_cluster_queue(ClusterQueue(
+            name="cq", resource_groups=(ResourceGroup(
+                ("cpu",), (FlavorQuotas.make("spot", cpu=4),)),)))
+        fw.create_local_queue(LocalQueue(
+            name="lq", namespace="default", cluster_queue="cq"))
+        pod = TaintsTolerationsPod(name="p", queue_name="lq",
+                                   requests={"cpu": 1})
+        fw.submit_job(pod)
+        fw.run_until_settled()
+        assert any(t.key == "tier" and t.value == "spot" and
+                   t.operator == "Equal" for t in pod.tolerations)
+
+    def test_stop_strips_injected_tolerations(self):
+        from kueue_tpu.jobs import TaintsTolerationsPod
+        fw = make_fw(cpu=2)
+        pod = TaintsTolerationsPod(name="low", queue_name="lq",
+                                   requests={"cpu": 2})
+        fw.submit_job(pod)
+        fw.run_until_settled()
+        assert not pod.is_suspended()
+        wl = fw.workloads["default/job-low"]
+        fw._apply_preemption(wl, "test eviction")
+        fw.tick()
+        assert pod.is_suspended()
+        assert pod.deleted  # the reference deletes the pod on stop
