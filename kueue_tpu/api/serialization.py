@@ -209,6 +209,7 @@ def decode_admission_check(doc: Mapping[str, Any]) -> AdmissionCheck:
 
 def decode_workload(doc: Mapping[str, Any]) -> Workload:
     name, namespace = _meta(doc)
+    labels = dict((doc.get("metadata") or {}).get("labels") or {})
     spec = doc.get("spec") or {}
     pod_sets = []
     for ps in spec.get("podSets") or ():
@@ -227,6 +228,7 @@ def decode_workload(doc: Mapping[str, Any]) -> Workload:
     return Workload(
         name=name, namespace=namespace,
         queue_name=spec.get("queueName", ""),
+        labels=labels,
         pod_sets=pod_sets,
         priority=int(spec.get("priority", 0)),
         priority_class=spec.get("priorityClassName", ""),

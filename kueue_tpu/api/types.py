@@ -488,6 +488,8 @@ class Workload:
     name: str
     namespace: str = "default"
     queue_name: str = ""  # LocalQueue name
+    # metadata.labels analog (e.g. the MultiKueue origin label on mirrors).
+    labels: Dict[str, str] = field(default_factory=dict)
     pod_sets: List[PodSet] = field(default_factory=list)
     priority: int = 0
     priority_class: str = ""

@@ -25,6 +25,12 @@ from kueue_tpu.api.types import AdmissionCheckState, Workload
 
 MULTIKUEUE_CHECK_CONTROLLER = "kueue.x-k8s.io/multikueue"
 DEFAULT_WORKER_LOST_TIMEOUT = 15 * 60.0
+DEFAULT_GC_INTERVAL = 60.0
+DEFAULT_ORIGIN = "multikueue"
+# Label stamped on remote mirrors so GC only touches objects this manager
+# created — survives manager restarts, unlike in-memory dispatch state
+# (reference: multikueue constants.go MultiKueueOriginLabel).
+ORIGIN_LABEL = "kueue.x-k8s.io/multikueue-origin"
 
 # Reconnect backoff for lost workers (multikueuecluster.go:64-69).
 RECONNECT_BASE_SECONDS = 5.0
@@ -106,6 +112,9 @@ class InProcessRemote(RemoteClient):
         self.queue_name = queue_name
         self._up = True
         self._created: set = set()
+        # Origin label value stamped on mirrors (set by the controller on
+        # add_cluster; multiKueue.origin config).
+        self.origin = DEFAULT_ORIGIN
         # name -> remote GenericJob (job adapter surface)
         self.jobs: Dict[str, object] = {}
 
@@ -119,6 +128,7 @@ class InProcessRemote(RemoteClient):
         import copy
         remote = Workload(
             name=wl.name, namespace=wl.namespace, queue_name=self.queue_name,
+            labels={ORIGIN_LABEL: self.origin},
             pod_sets=copy.deepcopy(wl.pod_sets), priority=wl.priority,
             creation_time=wl.creation_time)
         self.fw.submit(remote)
@@ -148,7 +158,13 @@ class InProcessRemote(RemoteClient):
         }
 
     def list_workload_keys(self) -> List[str]:
-        return [k for k in self._created if k in self.fw.workloads]
+        """Mirrors this manager owns: found by the origin label (so GC
+        works across manager restarts), unioned with in-memory bookkeeping
+        for mirrors created before a label scheme change."""
+        by_label = {k for k, w in self.fw.workloads.items()
+                    if w.labels.get(ORIGIN_LABEL) == self.origin}
+        return sorted(by_label | {k for k in self._created
+                                  if k in self.fw.workloads})
 
 
 class BatchJobAdapter(JobAdapter):
@@ -202,13 +218,21 @@ class MultiKueueController:
                  client_factory=None):
         self.fw = framework
         self.check_name = check_name
+        # Wired from the Configuration file (multiKueue section,
+        # apis/config defaults.go:46-49) unless explicitly overridden.
+        runtime_cfg = getattr(framework, "config", None)
+        mk_cfg = runtime_cfg.multikueue if runtime_cfg is not None else None
         if worker_lost_timeout is None:
-            # Wired from the Configuration file (multiKueue.workerLostTimeout,
-            # apis/config defaults.go:49) unless explicitly overridden.
-            runtime_cfg = getattr(framework, "config", None)
             worker_lost_timeout = (
-                runtime_cfg.multikueue.worker_lost_timeout_seconds
-                if runtime_cfg is not None else DEFAULT_WORKER_LOST_TIMEOUT)
+                mk_cfg.worker_lost_timeout_seconds
+                if mk_cfg is not None else DEFAULT_WORKER_LOST_TIMEOUT)
+        # gcInterval throttles the remote-orphan sweep; 0 disables it
+        # (configuration_types.go MultiKueue.GCInterval). The origin label
+        # value marks mirrors as ours.
+        self.gc_interval = (mk_cfg.gc_interval_seconds
+                            if mk_cfg is not None else DEFAULT_GC_INTERVAL)
+        self.origin = mk_cfg.origin if mk_cfg is not None else DEFAULT_ORIGIN
+        self._next_gc_at = 0.0
         self.clusters: Dict[str, RemoteClient] = {}
         self.cluster_specs: Dict[str, MultiKueueCluster] = {}
         self.configs: Dict[str, MultiKueueConfig] = {}
@@ -229,6 +253,8 @@ class MultiKueueController:
     def add_cluster(self, name: str, client: RemoteClient) -> None:
         """Directly register a connected worker (tests / embedded use)."""
         self.clusters[name] = client
+        if hasattr(client, "origin"):
+            client.origin = self.origin
         self.cluster_specs.setdefault(
             name, MultiKueueCluster(name=name, active=True, active_reason="Active"))
 
@@ -285,6 +311,8 @@ class MultiKueueController:
                 continue
             client = self.client_factory(spec)
             if client is not None and client.connected():
+                if hasattr(client, "origin"):
+                    client.origin = self.origin
                 self.clusters[name] = client
                 spec.active = True
                 spec.active_reason = "Active"
@@ -320,18 +348,23 @@ class MultiKueueController:
             if not wl.has_quota_reservation:
                 continue
             self._reconcile_workload(wl, now, jobs_by_wl)
-        # GC dispatches whose local workload disappeared, and remote
-        # orphans no dispatch owns (multikueuecluster.go:476-500).
+        # GC dispatches whose local workload disappeared (part of the
+        # normal reconcile, like wlReconciler's not-found branch) ...
         for key in list(self._dispatches):
             if key not in self.fw.workloads:
                 self._gc(key)
-        owned = set(self._dispatches)
-        for client in self.clusters.values():
-            if not client.connected():
-                continue
-            for key in client.list_workload_keys():
-                if key not in owned:
-                    client.delete_workload(key)
+        # ... and remote orphans no dispatch owns, on the configured GC
+        # cadence; interval 0 disables (multikueuecluster.go:476-500 runs
+        # as a gcInterval-periodic runnable).
+        if self.gc_interval > 0 and now >= self._next_gc_at:
+            self._next_gc_at = now + self.gc_interval
+            owned = set(self._dispatches)
+            for client in self.clusters.values():
+                if not client.connected():
+                    continue
+                for key in client.list_workload_keys():
+                    if key not in owned:
+                        client.delete_workload(key)
 
 
     def _reconcile_workload(self, wl: Workload, now: float,

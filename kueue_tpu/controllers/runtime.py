@@ -102,6 +102,13 @@ class Framework:
         self._evicted_dirty: List[Workload] = []
         from kueue_tpu.controllers.jobframework import JobReconciler
         self.job_reconciler = JobReconciler(self)
+        # QueueVisibility snapshot workers (clusterqueue_controller.go:685):
+        # top-N pending per CQ on the configured cadence, feature-gated.
+        from kueue_tpu.controllers.visibility import QueueVisibilitySnapshotter
+        qv = self.config.queue_visibility
+        self.queue_visibility = QueueVisibilitySnapshotter(
+            self.queues, max_count=qv.max_count,
+            update_interval_seconds=qv.update_interval_seconds)
 
     # -- admin objects -------------------------------------------------------
 
@@ -496,6 +503,8 @@ class Framework:
         admitted = self.scheduler.schedule(timeout=0.0)
         self.reconcile()
         self.job_reconciler.reconcile()
+        if features.enabled(features.QUEUE_VISIBILITY):
+            self.queue_visibility.maybe_update(self.clock())
         return admitted
 
     def run_until_settled(self, max_ticks: int = 100) -> int:

@@ -73,3 +73,38 @@ class VisibilityServer:
                 if p.namespace == namespace and p.local_queue == lq_name]
         limit = self.max_count if limit is None else limit
         return mine[offset:offset + limit]
+
+
+class QueueVisibilitySnapshotter:
+    """Periodic top-N pending-workload snapshots into ClusterQueue status
+    (reference: clusterqueue_controller.go:685-720 — the QueueVisibility
+    snapshot workers — gated by the QueueVisibility feature and configured
+    by queueVisibility.clusterQueues.maxCount / updateIntervalSeconds).
+
+    Drive `maybe_update(now)` from the runtime loop; `snapshot(cq)` reads
+    the last published view (the CQ .status.pendingWorkloadsStatus analog).
+    """
+
+    def __init__(self, queues: Manager, max_count: int = 10,
+                 update_interval_seconds: float = 5.0):
+        self.queues = queues
+        self.max_count = max_count
+        self.update_interval = update_interval_seconds
+        self._server = VisibilityServer(queues, max_count=max_count)
+        self._snapshots: dict = {}
+        self._last_update: Optional[float] = None
+
+    def maybe_update(self, now: float) -> bool:
+        if (self._last_update is not None
+                and now - self._last_update < self.update_interval):
+            return False
+        self._last_update = now
+        self._snapshots = {
+            name: self._server.pending_workloads_in_cq(
+                name, limit=self.max_count)
+            for name in self.queues.cluster_queues
+        }
+        return True
+
+    def snapshot(self, cq_name: str) -> List[PendingWorkloadInfo]:
+        return self._snapshots.get(cq_name, [])

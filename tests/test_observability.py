@@ -142,3 +142,103 @@ def test_readmission_wait_time_measured():
     assert hist.totals[("cq",)] == count_before + 1
     # The new observation is ~100s (bucketed between 60 and 300).
     assert hist.percentile(1.0, "cq") >= 60
+
+
+def test_queue_visibility_snapshots_gated_and_throttled():
+    """The CQ-status snapshot workers (clusterqueue_controller.go:685-720):
+    feature-gated, top-N capped, updated on the configured cadence."""
+    from kueue_tpu import features
+    from kueue_tpu.config import Configuration, QueueVisibility
+
+    clock = [100.0]
+    cfg = Configuration(queue_visibility=QueueVisibility(
+        max_count=2, update_interval_seconds=5.0))
+    fw = Framework(config=cfg, clock=lambda: clock[0])
+    fw.create_resource_flavor(make_flavor("default"))
+    fw.create_cluster_queue(make_cq("cq", rg("cpu", fq("default", cpu=0))))
+    fw.create_local_queue(make_lq("main", cq="cq"))
+    # cpu=1 against zero quota: all stay pending forever.
+    for i, prio in enumerate((5, 3, 1)):
+        fw.submit(make_wl(f"w{i}", priority=prio, creation_time=float(i),
+                          cpu=1))
+
+    with features.override(features.QUEUE_VISIBILITY, False):
+        fw.tick()
+        assert fw.queue_visibility.snapshot("cq") == []  # gated off
+
+    with features.override(features.QUEUE_VISIBILITY, True):
+        fw.tick()
+        snap = fw.queue_visibility.snapshot("cq")
+        live = VisibilityServer(fw.queues).pending_workloads_in_cq(
+            "cq", limit=2)
+        assert len(snap) == 2  # top-N capped at maxCount
+        assert [p.name for p in snap] == [p.name for p in live]
+        # A new arrival inside the interval is not published yet.
+        fw.submit(make_wl("w9", priority=9, creation_time=50.0, cpu=1))
+        clock[0] += 1.0
+        fw.tick()
+        assert [p.name for p in fw.queue_visibility.snapshot("cq")] \
+            == [p.name for p in snap]  # stale view within the interval
+        clock[0] += 5.0
+        fw.tick()
+        names = {p.name for p in fw.queue_visibility.snapshot("cq")}
+        assert "w9" in names  # refreshed after the interval
+
+
+def test_multikueue_gc_interval_and_origin_label():
+    """Remote-orphan GC runs on the configured cadence and only touches
+    mirrors carrying this manager's origin label."""
+    from kueue_tpu.api.types import PodSet, Workload
+    from kueue_tpu.config import Configuration, MultiKueueConfig
+    from kueue_tpu.controllers.multikueue import (
+        ORIGIN_LABEL,
+        InProcessRemote,
+        MultiKueueController,
+    )
+
+    clock = [1000.0]
+    cfg = Configuration(multikueue=MultiKueueConfig(
+        gc_interval_seconds=30.0, origin="mgr-a"))
+    mgr = Framework(config=cfg, clock=lambda: clock[0])
+    mgr.create_resource_flavor(make_flavor("default"))
+    mgr.create_cluster_queue(make_cq("cq", rg("cpu", fq("default", cpu=8))))
+    mgr.create_local_queue(make_lq("main", cq="cq"))
+
+    worker = Framework()
+    worker.create_resource_flavor(make_flavor("default"))
+    worker.create_cluster_queue(make_cq("cq", rg("cpu", fq("default", cpu=8))))
+    worker.create_local_queue(make_lq("main", cq="cq"))
+
+    client = InProcessRemote(worker)
+    ctl = MultiKueueController(mgr, check_name="mk")
+    ctl.add_cluster("w1", client)
+    assert ctl.origin == "mgr-a" and ctl.gc_interval == 30.0
+    assert client.origin == "mgr-a"
+
+    # An orphan mirror with our origin label but no local dispatch (e.g.
+    # left over from before a manager restart).
+    orphan = Workload(name="orphan", queue_name="main",
+                      labels={ORIGIN_LABEL: "mgr-a"},
+                      pod_sets=[PodSet.make("main", 1, cpu=1)])
+    worker.submit(orphan)
+    # A foreign mirror owned by another manager: must never be touched.
+    foreign = Workload(name="foreign", queue_name="main",
+                       labels={ORIGIN_LABEL: "mgr-b"},
+                       pod_sets=[PodSet.make("main", 1, cpu=1)])
+    worker.submit(foreign)
+
+    ctl.reconcile()  # first pass: GC due immediately
+    assert "default/orphan" not in worker.workloads
+    assert "default/foreign" in worker.workloads
+
+    # Within the interval, a new orphan survives; after it, collected.
+    orphan2 = Workload(name="orphan2", queue_name="main",
+                       labels={ORIGIN_LABEL: "mgr-a"},
+                       pod_sets=[PodSet.make("main", 1, cpu=1)])
+    worker.submit(orphan2)
+    clock[0] += 10.0
+    ctl.reconcile()
+    assert "default/orphan2" in worker.workloads
+    clock[0] += 30.0
+    ctl.reconcile()
+    assert "default/orphan2" not in worker.workloads
