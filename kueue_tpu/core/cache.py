@@ -81,6 +81,11 @@ class CachedClusterQueue:
         # Bumped when admitted workloads are deleted or resource groups change,
         # invalidating flavor-search resume state (clusterqueue.go:62-63).
         self.allocatable_generation = 1
+        # Bumped on every usage mutation; the incremental tensor encoder
+        # (solver/schema.py UsageEncoder) re-reads only rows whose version
+        # moved, replacing the reference's full per-tick snapshot copy cost
+        # (snapshot.go:95-129).
+        self.usage_version = 0
         self.has_missing_flavors = False
         self.is_stopped = False
         self.update(spec, resource_flavors)
@@ -120,6 +125,7 @@ class CachedClusterQueue:
                 }
         self.usage = new_usage
         self.admitted_usage = new_admitted
+        self.usage_version += 1
 
         self.update_with_flavors(resource_flavors)
 
@@ -233,6 +239,7 @@ class CachedClusterQueue:
     def add_workload_usage(self, wi: WorkloadInfo, *, cohort_too: bool = False,
                            admitted: bool = False) -> None:
         self.workloads[wi.key] = wi
+        self.usage_version += 1
         self._update_usage(wi, self.usage, 1)
         if admitted:
             self._update_usage(wi, self.admitted_usage, 1)
@@ -245,6 +252,7 @@ class CachedClusterQueue:
     def remove_workload_usage(self, wi: WorkloadInfo, *, cohort_too: bool = False,
                               admitted: bool = False) -> None:
         self.workloads.pop(wi.key, None)
+        self.usage_version += 1
         self._update_usage(wi, self.usage, -1)
         if admitted:
             self._update_usage(wi, self.admitted_usage, -1)

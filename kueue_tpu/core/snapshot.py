@@ -80,6 +80,7 @@ def _snapshot_cq(cq: CachedClusterQueue) -> CachedClusterQueue:
     cc.fair_weight = cq.fair_weight
     cc.guaranteed_quota = cq.guaranteed_quota if features.enabled(features.LENDING_LIMIT) else {}
     cc.allocatable_generation = cq.allocatable_generation
+    cc.usage_version = cq.usage_version
     cc.has_missing_flavors = cq.has_missing_flavors
     cc.is_stopped = cq.is_stopped
     return cc

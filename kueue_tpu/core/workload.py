@@ -43,7 +43,7 @@ class PodSetResources:
         )
 
 
-@dataclass
+@dataclass(slots=True)
 class AssignmentClusterQueueState:
     """Flavor-search resume state, invalidated by allocatable generations.
 

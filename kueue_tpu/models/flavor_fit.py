@@ -372,16 +372,52 @@ def decode_assignments(workloads: Sequence[WorkloadInfo], snapshot: Snapshot,
                        out: Dict[str, np.ndarray]) -> List[Assignment]:
     """Materialize referee-compatible Assignment objects from the kernel
     outputs (truncating at the first failed podset, like
-    flavorassigner.go:323-327)."""
+    flavorassigner.go:323-327).
+
+    The assigned (workload, podset, resource) coordinates are extracted with
+    one vectorized pass over the output tensors; Python touches only the
+    entries that exist. At 1k heads/tick this decode sits on the critical
+    path between two device dispatches, so per-row nested loops would bound
+    the tick (see bench.py).
+    """
+    n = len(workloads)
+    ps_ok_np = out["ps_ok"][:n]                         # [n,P]
+    P = ps_ok_np.shape[1]
+    # Podsets decoded per workload: everything before the first failure plus
+    # the failing podset itself (the referee stops there). Padding rows have
+    # ps_ok False, so all-real-ok workloads cut at their podset count.
+    not_ok = ~ps_ok_np
+    has_fail = not_ok.any(axis=1)
+    first_fail = np.where(has_fail, not_ok.argmax(axis=1), P)
+
+    # Assigned-resource coordinates, one nonzero over the whole batch.
+    # A podset past the first failure is never decoded even if it fits on
+    # its own (the referee's early break), hence the first_fail gate.
+    res_flavor_np = out["res_flavor"][:n]               # [n,P,R]
+    decode_mask = (ps_ok_np
+                   & (np.arange(P)[None, :] <= first_fail[:, None])
+                   )[:, :, None] & (res_flavor_np >= 0)
+    ws, pp, rr = np.nonzero(decode_mask)
+    ci_arr = np.fromiter((enc.cq_index[wi.cluster_queue] for wi in workloads),
+                         dtype=np.int64, count=n)
+    flav_l = res_flavor_np[ws, pp, rr].tolist()
+    mode_l = out["res_mode"][:n][ws, pp, rr].tolist()
+    borrow_l = out["res_borrow"][:n][ws, pp, rr].tolist()
+    tried_l = out["group_tried"][:n][
+        ws, pp, enc.group_of_resource[ci_arr[ws], rr]].tolist()
+    ws_l = ws.tolist()
+    pp_l = pp.tolist()
+    rr_l = rr.tolist()
+    ps_mode_l = out["ps_mode"][:n].tolist()
+    ps_ok_l = ps_ok_np.tolist()
+    first_fail_l = first_fail.tolist()
+
+    flavor_names = enc.flavor_names
+    resource_names = enc.resource_names
+
+    # Skeleton pass: Assignment + PodSetAssignmentResult per decoded podset.
     assignments: List[Assignment] = []
-    # One C-level conversion each; per-element numpy indexing in the loop
-    # below would dominate the decode at 1k workloads/tick.
-    res_flavor = out["res_flavor"].tolist()
-    res_mode = out["res_mode"].tolist()
-    res_borrow = out["res_borrow"].tolist()
-    group_tried = out["group_tried"].tolist()
-    ps_ok_arr = out["ps_ok"].tolist()
-    group_of_resource = enc.group_of_resource.tolist()
+    psa_rows: List[List[Optional[PodSetAssignmentResult]]] = []
     for w, wi in enumerate(workloads):
         cq = snapshot.cluster_queues[wi.cluster_queue]
         a = Assignment(
@@ -392,42 +428,22 @@ def decode_assignments(workloads: Sequence[WorkloadInfo], snapshot: Snapshot,
                                    if cq.cohort is not None else 0),
             ),
         )
-        ci = enc.cq_index[wi.cluster_queue]
-        gor_row = group_of_resource[ci]
+        track_pods = sch.PODS_RESOURCE in cq.rg_by_resource
+        cut = first_fail_l[w]
+        row: List[Optional[PodSetAssignmentResult]] = []
+        ok_row = ps_ok_l[w]
+        pm_row = ps_mode_l[w]
+        lti = a.last_state.last_tried_flavor_idx
         for p, ps in enumerate(wi.total_requests):
+            if p > cut:
+                break
             requests = dict(ps.requests)
-            if sch.PODS_RESOURCE in cq.rg_by_resource:
+            if track_pods:
                 requests[sch.PODS_RESOURCE] = ps.count
             psa = PodSetAssignmentResult(
                 name=ps.name, requests=requests, count=ps.count)
-            ok = ps_ok_arr[w][p]
-            flavor_idx: Dict[str, int] = {}
-            if ok:
-                rf_row = res_flavor[w][p]
-                rm_row = res_mode[w][p]
-                rb_row = res_borrow[w][p]
-                gt_row = group_tried[w][p]
-                for rname in requests:
-                    ri = enc.resource_index.get(rname)
-                    if ri is None:
-                        continue
-                    f = rf_row[ri]
-                    if f < 0:
-                        continue
-                    fa = FlavorAssignment(
-                        name=enc.flavor_names[f],
-                        mode=rm_row[ri],
-                        borrow=rb_row[ri],
-                        tried_flavor_idx=gt_row[gor_row[ri]],
-                    )
-                    psa.flavors[rname] = fa
-                    if fa.borrow:
-                        a.borrowing = True
-                    a.usage.setdefault(fa.name, {})
-                    a.usage[fa.name][rname] = (
-                        a.usage[fa.name].get(rname, 0) + requests[rname])
-                    flavor_idx[rname] = fa.tried_flavor_idx
-                if any(fa.mode < FIT for fa in psa.flavors.values()):
+            if ok_row[p]:
+                if pm_row[p] < FIT:
                     # Non-Fit assignments always carry reasons in the referee
                     # (fitsResourceQuota appends one per shortfall); the
                     # presence of reasons is what makes representative_mode
@@ -436,11 +452,55 @@ def decode_assignments(workloads: Sequence[WorkloadInfo], snapshot: Snapshot,
             else:
                 psa.reasons = ["insufficient quota or no eligible flavor"]
             a.pod_sets.append(psa)
-            a.last_state.last_tried_flavor_idx.append(flavor_idx)
-            if not ok:
-                break
+            lti.append({})
+            row.append(psa)
+        psa_rows.append(row)
         assignments.append(a)
+
+    # Fill pass: one flat loop over the assigned entries.
+    for i in range(len(ws_l)):
+        w = ws_l[i]
+        a = assignments[w]
+        psa = psa_rows[w][pp_l[i]]
+        rname = resource_names[rr_l[i]]
+        fname = flavor_names[flav_l[i]]
+        tried = tried_l[i]
+        fa = FlavorAssignment(name=fname, mode=mode_l[i], borrow=borrow_l[i],
+                              tried_flavor_idx=tried)
+        psa.flavors[rname] = fa
+        if fa.borrow:
+            a.borrowing = True
+        fusage = a.usage.setdefault(fname, {})
+        fusage[rname] = fusage.get(rname, 0) + psa.requests[rname]
+        a.last_state.last_tried_flavor_idx[pp_l[i]][rname] = tried
     return assignments
+
+
+def fit_usage_delta(out: Dict[str, np.ndarray], wt: sch.WorkloadTensors,
+                    enc: sch.CQEncoding):
+    """Vectorized [C,F,R] usage delta of all Fit workloads in a solved batch,
+    plus the indices of the ClusterQueues touched.
+
+    This is the batched mirror of the cache mutations that assume_workload
+    performs per admission (cache.go:498-524): the tick folds every admitted
+    head's usage into the incremental tensor in one scatter-add instead of
+    1k dict walks.
+    """
+    n = wt.num_real
+    C, F, R = enc.nominal.shape
+    wl_fit = out["wl_mode"][:n] == FIT
+    res_flavor = out["res_flavor"][:n]
+    mask = (res_flavor >= 0) & wl_fit[:, None, None] & out["ps_ok"][:n][:, :, None]
+    ws, pp, rr = np.nonzero(mask)
+    delta = np.zeros((C, F, R), dtype=np.int64)
+    if len(ws) == 0:
+        return delta, np.empty(0, dtype=np.int64)
+    cis = wt.wl_cq[:n][ws].astype(np.int64)
+    fis = res_flavor[ws, pp, rr].astype(np.int64)
+    vals = wt.req[:n][ws, pp, rr]
+    flat = (cis * F + fis) * R + rr
+    np.add.at(delta.ravel(), flat, vals)
+    return delta, np.unique(cis)
 
 
 class BatchSolver:
@@ -461,6 +521,7 @@ class BatchSolver:
         self._key = None
         self._enc: Optional[sch.CQEncoding] = None
         self._static: Optional[tuple] = None
+        self._usage_enc: Optional[sch.UsageEncoder] = None
 
     def _encoding_for(self, snapshot: Snapshot) -> sch.CQEncoding:
         key = (
@@ -477,13 +538,25 @@ class BatchSolver:
         if key != self._key:
             self._enc = sch.encode_cluster_queues(snapshot)
             self._static = device_static(self._enc)
+            self._usage_enc = sch.UsageEncoder(self._enc)
             self._key = key
         return self._enc
 
     def solve(self, workloads: Sequence[WorkloadInfo],
               snapshot: Snapshot) -> List[Assignment]:
         enc = self._encoding_for(snapshot)
-        usage = sch.encode_usage(snapshot, enc)
+        usage = self._usage_enc.refresh(snapshot)
         wt = sch.encode_workloads(workloads, snapshot, enc)
         out = solve_flavor_fit(enc, usage, wt, static=self._static)
         return decode_assignments(workloads, snapshot, enc, out)
+
+    # Scheduler admit/forget fast path (see UsageEncoder.apply_delta): keeps
+    # the persistent usage tensor in lockstep with cache.assume/forget so the
+    # next tick's refresh is all version hits.
+    def note_admission(self, cq_name: str, usage_frq) -> None:
+        if self._usage_enc is not None:
+            self._usage_enc.apply_delta(cq_name, usage_frq, 1)
+
+    def note_removal(self, cq_name: str, usage_frq) -> None:
+        if self._usage_enc is not None:
+            self._usage_enc.apply_delta(cq_name, usage_frq, -1)

@@ -343,8 +343,12 @@ class Scheduler:
                              now=self.clock())
         if not cq.admission_checks:
             wl.set_condition("Admitted", True, reason="Admitted", now=self.clock())
+        note_admit = getattr(self.batch_solver, "note_admission", None)
+        note_forget = getattr(self.batch_solver, "note_removal", None)
         try:
             self.cache.assume_workload(wl)
+            if note_admit is not None:
+                note_admit(e.info.cluster_queue, e.assignment.usage)
         except ValueError as err:
             wl.admission = None
             wl.set_condition("QuotaReserved", False, reason="Pending",
@@ -355,6 +359,8 @@ class Scheduler:
         ok = self.apply_admission(wl)
         if not ok:
             self.cache.forget_workload(wl)
+            if note_forget is not None:
+                note_forget(e.info.cluster_queue, e.assignment.usage)
             # Roll the reservation back off the object so it can requeue
             # (the reference applies admission to a deep copy instead).
             wl.admission = None

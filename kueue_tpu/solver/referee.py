@@ -26,7 +26,7 @@ from kueue_tpu.solver.modes import FIT, NO_FIT, PREEMPT
 PODS_RESOURCE = "pods"
 
 
-@dataclass
+@dataclass(slots=True)
 class FlavorAssignment:
     name: str
     mode: int
@@ -34,7 +34,7 @@ class FlavorAssignment:
     borrow: bool = False
 
 
-@dataclass
+@dataclass(slots=True)
 class PodSetAssignmentResult:
     name: str
     flavors: Dict[str, FlavorAssignment] = field(default_factory=dict)
@@ -52,7 +52,7 @@ class PodSetAssignmentResult:
         return min(fa.mode for fa in self.flavors.values())
 
 
-@dataclass
+@dataclass(slots=True)
 class Assignment:
     pod_sets: List[PodSetAssignmentResult] = field(default_factory=list)
     borrowing: bool = False

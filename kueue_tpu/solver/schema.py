@@ -81,26 +81,75 @@ class CQEncoding:
     bwc_enabled: np.ndarray    # [C] bool
     borrow_policy_is_borrow: np.ndarray    # [C] bool (whenCanBorrow == Borrow)
     preempt_policy_is_preempt: np.ndarray  # [C] bool (whenCanPreempt == Preempt)
+    configured: np.ndarray     # [C,F,R] bool: the (flavor,resource) pairs the
+    #                            CQ tracks usage for (clusterqueue.go:473-485)
 
     num_cohorts: int
     num_groups: int
     num_slots: int
 
+    # Lazy memos (the encoding is immutable once built).
+    _cohort_requestable: Optional[np.ndarray] = field(
+        default=None, repr=False, compare=False)
+    _cohort_perm: Optional[np.ndarray] = field(
+        default=None, repr=False, compare=False)
+    _cohort_starts: Optional[np.ndarray] = field(
+        default=None, repr=False, compare=False)
+
+    def _cohort_sort(self):
+        """Members sorted by cohort id, for C-speed segment reductions."""
+        if self._cohort_perm is None:
+            perm = np.argsort(self.cohort_id, kind="stable")
+            sorted_ids = self.cohort_id[perm]
+            starts = np.searchsorted(sorted_ids, np.arange(self.num_cohorts))
+            self._cohort_perm = perm
+            self._cohort_starts = starts
+        return self._cohort_perm, self._cohort_starts
+
+    def cohort_sum(self, per_cq: np.ndarray) -> np.ndarray:
+        """[C,...] -> [K,...] sum over cohort members."""
+        perm, starts = self._cohort_sort()
+        return np.add.reduceat(per_cq[perm], starts, axis=0)
+
     def cohort_requestable(self) -> np.ndarray:
         """[K,F,R] sum of members' lendable quota (snapshot.go:160-178)."""
-        k = self.num_cohorts
-        out = np.zeros((k,) + self.lendable.shape[1:], dtype=np.int64)
-        np.add.at(out, self.cohort_id, self.lendable)
-        return out
+        if self._cohort_requestable is None:
+            self._cohort_requestable = self.cohort_sum(self.lendable)
+        return self._cohort_requestable
 
 
-@dataclass
 class UsageTensors:
-    """The fast-changing side: per-CQ usage and its cohort aggregation."""
+    """The fast-changing side: per-CQ usage and its cohort aggregation.
 
-    usage: np.ndarray         # [C,F,R] i64
-    cohort_usage: np.ndarray  # [K,F,R] i64: sum of max(0, usage-guaranteed)
-    cohort_requestable: np.ndarray  # [K,F,R] i64
+    The cohort aggregates are lazy: the packed device kernel recomputes them
+    on device (segment_sum in `_solve_kernel_packed`), so the per-tick
+    dispatch path never touches them host-side; consumers that do read them
+    (fair-share scoring, the unpacked kernel entry) pay on first access."""
+
+    __slots__ = ("usage", "_enc", "_cohort_usage", "_cohort_requestable")
+
+    def __init__(self, usage: np.ndarray, enc: Optional["CQEncoding"] = None,
+                 cohort_usage: Optional[np.ndarray] = None,
+                 cohort_requestable: Optional[np.ndarray] = None):
+        self.usage = usage            # [C,F,R] i64
+        self._enc = enc
+        self._cohort_usage = cohort_usage
+        self._cohort_requestable = cohort_requestable
+
+    @property
+    def cohort_usage(self) -> np.ndarray:
+        """[K,F,R] i64: sum of max(0, usage-guaranteed) over members."""
+        if self._cohort_usage is None:
+            above = np.maximum(self.usage - self._enc.guaranteed, 0)
+            self._cohort_usage = self._enc.cohort_sum(above)
+        return self._cohort_usage
+
+    @property
+    def cohort_requestable(self) -> np.ndarray:
+        """[K,F,R] i64 (snapshot.go:160-178)."""
+        if self._cohort_requestable is None:
+            self._cohort_requestable = self._enc.cohort_requestable()
+        return self._cohort_requestable
 
 
 @dataclass
@@ -145,6 +194,7 @@ def encode_cluster_queues(snapshot: Snapshot) -> CQEncoding:
     borrow_limit = np.full((C, F, R), NO_LIMIT, dtype=np.int64)
     guaranteed = np.zeros((C, F, R), dtype=np.int64)
     lendable = np.zeros((C, F, R), dtype=np.int64)
+    configured = np.zeros((C, F, R), dtype=bool)
     cohort_id = np.zeros(C, dtype=np.int32)
     group_of_resource = np.full((C, R), -1, dtype=np.int32)
     slot_flavor = np.full((C, G, S), -1, dtype=np.int32)
@@ -187,6 +237,7 @@ def encode_cluster_queues(snapshot: Snapshot) -> CQEncoding:
                     continue
                 for rname, quota in fquotas.resources:
                     ri = resource_index[rname]
+                    configured[ci, fi, ri] = True
                     nominal[ci, fi, ri] = quota.nominal
                     if quota.borrowing_limit is not None:
                         borrow_limit[ci, fi, ri] = quota.borrowing_limit
@@ -207,6 +258,7 @@ def encode_cluster_queues(snapshot: Snapshot) -> CQEncoding:
         num_flavors=num_flavors, bwc_enabled=bwc_enabled,
         borrow_policy_is_borrow=borrow_is_borrow,
         preempt_policy_is_preempt=preempt_is_preempt,
+        configured=configured,
         num_cohorts=len(cohort_names), num_groups=G, num_slots=S,
     )
 
@@ -226,14 +278,86 @@ def encode_usage(snapshot: Snapshot, enc: CQEncoding) -> UsageTensors:
                 ri = enc.resource_index.get(rname)
                 if ri is not None:
                     usage[ci, fi, ri] = val
-    above_guaranteed = np.maximum(usage - enc.guaranteed, 0)
-    cohort_usage = np.zeros((enc.num_cohorts, F, R), dtype=np.int64)
-    np.add.at(cohort_usage, enc.cohort_id, above_guaranteed)
-    return UsageTensors(
-        usage=usage,
-        cohort_usage=cohort_usage,
-        cohort_requestable=enc.cohort_requestable(),
-    )
+    return UsageTensors(usage, enc)
+
+
+class UsageEncoder:
+    """Incremental [C,F,R] usage tensor keyed on cache usage versions.
+
+    The reference deep-copies every ClusterQueue's usage maps on every tick
+    (snapshot.go:95-129) — the scaling hazard SURVEY §6 calls out at 50k
+    workloads. Here the dense usage tensor persists across ticks and only
+    rows whose `usage_version` moved since the last refresh are re-read from
+    the snapshot; cohort aggregates are recomputed vectorized only when
+    something changed.
+
+    `apply_delta` is the scheduler's fast path: an admission's exact usage
+    delta (Assignment.usage) is applied to the row and the version advanced
+    in lockstep with the cache's single bump from assume/forget
+    (cache.go:498-546), so the next refresh sees a clean hit. Any drift
+    falls back to a full row re-read — versions, not trust, decide.
+    """
+
+    def __init__(self, enc: CQEncoding):
+        self.enc = enc
+        C, F, R = enc.nominal.shape
+        self.usage = np.zeros((C, F, R), dtype=np.int64)
+        self._versions: List[Optional[int]] = [None] * C
+
+    def refresh(self, snapshot: Snapshot) -> UsageTensors:
+        enc = self.enc
+        flavor_index = enc.flavor_index
+        resource_index = enc.resource_index
+        versions = self._versions
+        usage = self.usage
+        for ci, name in enumerate(enc.cq_names):
+            cq = snapshot.cluster_queues[name]
+            if cq.usage_version == versions[ci]:
+                continue
+            row = usage[ci]
+            row[:] = 0
+            for fname, resources in cq.usage.items():
+                fi = flavor_index.get(fname)
+                if fi is None:
+                    continue
+                frow = row[fi]
+                for rname, val in resources.items():
+                    ri = resource_index.get(rname)
+                    if ri is not None:
+                        frow[ri] = val
+            versions[ci] = cq.usage_version
+        return UsageTensors(usage, enc)
+
+    def apply_delta(self, cq_name: str, frq, sign: int = 1) -> None:
+        """Fold one workload's usage (Assignment.usage) into the tensor,
+        mirroring the cache mutation of assume/forget."""
+        enc = self.enc
+        ci = enc.cq_index.get(cq_name)
+        if ci is None:
+            return
+        row = self.usage[ci]
+        conf = enc.configured[ci]
+        for fname, resources in frq.items():
+            fi = enc.flavor_index.get(fname)
+            if fi is None:
+                continue
+            for rname, val in resources.items():
+                ri = enc.resource_index.get(rname)
+                # Only configured pairs are tracked (clusterqueue.go:473-485).
+                if ri is not None and conf[fi, ri]:
+                    row[fi, ri] += sign * val
+        if self._versions[ci] is not None:
+            self._versions[ci] += 1
+
+    def apply_batch(self, delta: np.ndarray, cq_indices: np.ndarray) -> None:
+        """Fold a whole tick's admitted usage (models/flavor_fit.py
+        fit_usage_delta) into the tensor: one vectorized add, one version
+        advance per touched ClusterQueue."""
+        self.usage += delta
+        versions = self._versions
+        for ci in cq_indices.tolist():
+            if versions[ci] is not None:
+                versions[ci] += 1
 
 
 def encode_workloads(workloads: Sequence[WorkloadInfo], snapshot: Snapshot,
