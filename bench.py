@@ -88,22 +88,26 @@ def main() -> None:
         wt = slice_wt(lo, hi)
         return lo, wt, solve_flavor_fit_async(enc, usage, wt, static=static)
 
-    cq_names = sorted(snapshot.cluster_queues)
+    folded = set()
 
     def collect(pending_tick):
         """Stage 2+3: fetch the in-flight solve, decode decisions, and fold
         the admitted usage back into the incremental encoder (the batched
-        mirror of the scheduler's assume fast path)."""
+        mirror of the scheduler's assume fast path). A wrapped-around slice
+        (ticks > backlog/heads) is re-solved but not re-folded: its
+        workloads were already admitted once."""
         lo, wt, handle = pending_tick
         out = fetch_outputs(handle)
         batch = pending[lo:lo + wt.num_real]
         assignments = decode_assignments(batch, snapshot, enc, out)
-        delta, touched = fit_usage_delta(out, wt, enc)
-        usage_enc.apply_batch(delta, touched)
-        for ci in touched.tolist():
-            # The cache's version bump from assume_workload; encoder and
-            # cache advance in lockstep (BatchSolver.note_admission).
-            snapshot.cluster_queues[cq_names[ci]].usage_version += 1
+        if lo not in folded:
+            folded.add(lo)
+            delta, touched = fit_usage_delta(out, wt, enc)
+            usage_enc.apply_batch(delta, touched)
+            for ci in touched.tolist():
+                # The cache's version bump from assume_workload; encoder and
+                # cache advance in lockstep (BatchSolver.note_admission).
+                snapshot.cluster_queues[enc.cq_names[ci]].usage_version += 1
         return out, assignments
 
     # The tick pipeline. A synchronized device round trip on a
@@ -122,6 +126,7 @@ def main() -> None:
     # (the snapshot's bumped versions force a full clean re-read).
     collect(dispatch(0))
     usage_enc = sch.UsageEncoder(enc)
+    folded.clear()
 
     # Long-running-scheduler GC discipline: the setup objects (50k encoded
     # workloads, the snapshot) are permanent; keep collector passes from

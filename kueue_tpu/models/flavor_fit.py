@@ -19,11 +19,14 @@ Integer semantics are exact (int64; TPU emulates i64 on the VPU).
 from __future__ import annotations
 
 import functools
+import os
 from typing import Dict, List, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from kueue_tpu.utils import native_decode
 
 from kueue_tpu import features
 from kueue_tpu.core.snapshot import Snapshot
@@ -374,12 +377,37 @@ def decode_assignments(workloads: Sequence[WorkloadInfo], snapshot: Snapshot,
     outputs (truncating at the first failed podset, like
     flavorassigner.go:323-327).
 
-    The assigned (workload, podset, resource) coordinates are extracted with
-    one vectorized pass over the output tensors; Python touches only the
-    entries that exist. At 1k heads/tick this decode sits on the critical
-    path between two device dispatches, so per-row nested loops would bound
-    the tick (see bench.py).
+    Dispatches to the native decoder (kueue_tpu/native/decode.cpp) when the
+    toolchain built it -- the decode sits on the critical path between two
+    device dispatches and is interpreter-bound otherwise -- with the
+    vectorized Python loop below as the always-available fallback.
     """
+    if not os.environ.get("KUEUE_NO_NATIVE_DECODE"):
+        mod = native_decode.load()
+        if mod is not None:
+            n = len(workloads)
+            P = out["ps_ok"].shape[1]
+            R = out["res_flavor"].shape[2]
+            G = out["group_tried"].shape[2]
+            c = np.ascontiguousarray
+            return mod.decode(
+                (Assignment, PodSetAssignmentResult, FlavorAssignment,
+                 AssignmentClusterQueueState),
+                list(workloads), snapshot.cluster_queues, enc.cq_index,
+                enc.flavor_names, enc.resource_names,
+                c(enc.group_of_resource),
+                c(out["ps_ok"][:n]), c(out["ps_mode"][:n]),
+                c(out["res_flavor"][:n]), c(out["res_mode"][:n]),
+                c(out["res_borrow"][:n]), c(out["group_tried"][:n]),
+                P, R, G)
+    return _decode_assignments_py(workloads, snapshot, enc, out)
+
+
+def _decode_assignments_py(workloads: Sequence[WorkloadInfo],
+                           snapshot: Snapshot, enc: sch.CQEncoding,
+                           out: Dict[str, np.ndarray]) -> List[Assignment]:
+    """Vectorized-coordinate Python decode (fallback + referee for the
+    native decoder's equivalence tests)."""
     n = len(workloads)
     ps_ok_np = out["ps_ok"][:n]                         # [n,P]
     P = ps_ok_np.shape[1]

@@ -1,0 +1,408 @@
+// Native decision decoder: kernel output tensors -> Assignment objects.
+//
+// The per-tick decode loop (kueue_tpu/models/flavor_fit.py
+// decode_assignments) materializes ~1k Assignment trees per scheduling
+// cycle. In CPython that loop is interpreter-bound (~13us/workload on the
+// bench host) and sits on the critical path between two device dispatches.
+// This extension runs the same loop at C speed against the raw output
+// buffers, constructing the exact same Python objects (the slots
+// dataclasses of kueue_tpu/solver/referee.py).
+//
+// The reference's entire scheduler is compiled (Go); this is the
+// native-runtime counterpart for the hot host-side glue around the TPU
+// solve (reference: scheduler.go:174-288 nominate/admit plumbing).
+//
+// Build: g++ -O2 -shared -fPIC -std=c++17 -I<python-include> decode.cpp
+//        -o _kueue_decode.so   (driven by kueue_tpu/utils/native_decode.py)
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include <cstdint>
+
+namespace {
+
+constexpr int kFit = 2;  // solver/modes.py FIT
+
+struct Buf {
+  Py_buffer view{};
+  bool ok = false;
+  ~Buf() {
+    if (ok) PyBuffer_Release(&view);
+  }
+  bool acquire(PyObject* obj, Py_ssize_t itemsize) {
+    if (PyObject_GetBuffer(obj, &view, PyBUF_C_CONTIGUOUS) != 0) return false;
+    ok = true;
+    if (view.itemsize != itemsize) {
+      PyErr_SetString(PyExc_TypeError, "unexpected buffer itemsize");
+      return false;
+    }
+    return true;
+  }
+  template <typename T>
+  const T* data() const {
+    return static_cast<const T*>(view.buf);
+  }
+};
+
+// Interned attribute names + shared constants, created once at module init.
+struct Names {
+  PyObject* cluster_queue;
+  PyObject* allocatable_generation;
+  PyObject* cohort;
+  PyObject* rg_by_resource;
+  PyObject* total_requests;
+  PyObject* name;
+  PyObject* requests;
+  PyObject* count;
+  PyObject* pod_sets;
+  PyObject* borrowing;
+  PyObject* usage;
+  PyObject* last_state;
+  PyObject* flavors;
+  PyObject* reasons;
+  PyObject* error;
+  PyObject* mode;
+  PyObject* tried_flavor_idx;
+  PyObject* borrow;
+  PyObject* last_tried_flavor_idx;
+  PyObject* cluster_queue_generation;
+  PyObject* cohort_generation;
+  PyObject* pods;           // "pods" resource name
+  PyObject* msg_no_quota;   // "insufficient unused quota"
+  PyObject* msg_no_fit;     // "insufficient quota or no eligible flavor"
+};
+Names N;
+
+// Construct an instance of a slots dataclass without running its (Python)
+// __init__: object.__new__(cls) + per-slot SetAttr.
+PyObject* bare_new(PyObject* cls) {
+  PyTypeObject* tp = reinterpret_cast<PyTypeObject*>(cls);
+  return tp->tp_alloc(tp, 0);
+}
+
+bool set_steal(PyObject* obj, PyObject* attr, PyObject* value) {
+  // SetAttr + drop our reference to value; false on error (value released).
+  if (value == nullptr) return false;
+  int rc = PyObject_SetAttr(obj, attr, value);
+  Py_DECREF(value);
+  return rc == 0;
+}
+
+bool set_keep(PyObject* obj, PyObject* attr, PyObject* value) {
+  return value != nullptr && PyObject_SetAttr(obj, attr, value) == 0;
+}
+
+// decode(classes, workloads, snapshot_cqs, cq_index, flavor_names,
+//        resource_names, group_of_resource, ps_ok, ps_mode, res_flavor,
+//        res_mode, res_borrow, group_tried, P, R, G)
+//
+// classes = (Assignment, PodSetAssignmentResult, FlavorAssignment,
+//            AssignmentClusterQueueState)
+// Buffers are C-contiguous: ps_ok/res_borrow u8, ps_mode/res_mode i8,
+// res_flavor/group_tried i16, group_of_resource i32 with shape [C,R].
+PyObject* decode(PyObject*, PyObject* args) {
+  PyObject *classes, *workloads, *snapshot_cqs, *cq_index, *flavor_names,
+      *resource_names;
+  PyObject *gor_o, *ps_ok_o, *ps_mode_o, *res_flavor_o, *res_mode_o,
+      *res_borrow_o, *group_tried_o;
+  int P, R, G;
+  if (!PyArg_ParseTuple(args, "OOOOOOOOOOOOOiii", &classes, &workloads,
+                        &snapshot_cqs, &cq_index, &flavor_names,
+                        &resource_names, &gor_o, &ps_ok_o, &ps_mode_o,
+                        &res_flavor_o, &res_mode_o, &res_borrow_o,
+                        &group_tried_o, &P, &R, &G))
+    return nullptr;
+
+  PyObject* cls_assignment = PyTuple_GetItem(classes, 0);
+  PyObject* cls_psa = PyTuple_GetItem(classes, 1);
+  PyObject* cls_fa = PyTuple_GetItem(classes, 2);
+  PyObject* cls_acqs = PyTuple_GetItem(classes, 3);
+  if (cls_acqs == nullptr) return nullptr;
+
+  Buf gor, ps_ok, ps_mode, res_flavor, res_mode, res_borrow, group_tried;
+  if (!gor.acquire(gor_o, 4) || !ps_ok.acquire(ps_ok_o, 1) ||
+      !ps_mode.acquire(ps_mode_o, 1) || !res_flavor.acquire(res_flavor_o, 2) ||
+      !res_mode.acquire(res_mode_o, 1) || !res_borrow.acquire(res_borrow_o, 1) ||
+      !group_tried.acquire(group_tried_o, 2))
+    return nullptr;
+  const int32_t* gor_d = gor.data<int32_t>();
+  const uint8_t* ok_d = ps_ok.data<uint8_t>();
+  const int8_t* pm_d = ps_mode.data<int8_t>();
+  const int16_t* rf_d = res_flavor.data<int16_t>();
+  const int8_t* rm_d = res_mode.data<int8_t>();
+  const uint8_t* rb_d = res_borrow.data<uint8_t>();
+  const int16_t* gt_d = group_tried.data<int16_t>();
+
+  Py_ssize_t n = PyList_Size(workloads);
+  if (n < 0) return nullptr;
+  PyObject* result = PyList_New(n);
+  if (result == nullptr) return nullptr;
+
+  for (Py_ssize_t w = 0; w < n; ++w) {
+    PyObject* wi = PyList_GET_ITEM(workloads, w);  // borrowed
+    PyObject* cq_name = PyObject_GetAttr(wi, N.cluster_queue);
+    if (cq_name == nullptr) goto fail;
+    PyObject* cq = PyDict_GetItem(snapshot_cqs, cq_name);  // borrowed
+    PyObject* ci_o = PyDict_GetItem(cq_index, cq_name);    // borrowed
+    Py_DECREF(cq_name);
+    if (cq == nullptr || ci_o == nullptr) {
+      PyErr_SetString(PyExc_KeyError, "workload ClusterQueue not in snapshot");
+      goto fail;
+    }
+    long ci = PyLong_AsLong(ci_o);
+
+    // last_state = AssignmentClusterQueueState(...)
+    PyObject* acqs = bare_new(cls_acqs);
+    if (acqs == nullptr) goto fail;
+    PyObject* lti = PyList_New(0);
+    if (!set_keep(acqs, N.last_tried_flavor_idx, lti)) {
+      Py_XDECREF(lti);
+      Py_DECREF(acqs);
+      goto fail;
+    }
+    {
+      PyObject* cq_gen = PyObject_GetAttr(cq, N.allocatable_generation);
+      bool ok1 = set_steal(acqs, N.cluster_queue_generation, cq_gen);
+      PyObject* cohort = ok1 ? PyObject_GetAttr(cq, N.cohort) : nullptr;
+      bool ok2 = false;
+      if (cohort != nullptr) {
+        PyObject* cg = (cohort == Py_None)
+                           ? PyLong_FromLong(0)
+                           : PyObject_GetAttr(cohort, N.allocatable_generation);
+        Py_DECREF(cohort);
+        ok2 = set_steal(acqs, N.cohort_generation, cg);
+      }
+      if (!ok1 || !ok2) {
+        Py_DECREF(lti);
+        Py_DECREF(acqs);
+        goto fail;
+      }
+    }
+
+    // a = Assignment(...)
+    PyObject* a = bare_new(cls_assignment);
+    PyObject* pod_sets = a ? PyList_New(0) : nullptr;
+    PyObject* usage = pod_sets ? PyDict_New() : nullptr;
+    if (usage == nullptr || !set_keep(a, N.pod_sets, pod_sets) ||
+        !set_keep(a, N.usage, usage) ||
+        !set_keep(a, N.borrowing, Py_False) ||
+        !set_keep(a, N.last_state, acqs)) {
+      Py_XDECREF(usage);
+      Py_XDECREF(pod_sets);
+      Py_XDECREF(a);
+      Py_DECREF(lti);
+      Py_DECREF(acqs);
+      goto fail;
+    }
+    Py_DECREF(acqs);
+    bool a_borrowing = false;
+
+    PyObject* rg_by_resource = PyObject_GetAttr(cq, N.rg_by_resource);
+    int track_pods =
+        rg_by_resource ? PyDict_Contains(rg_by_resource, N.pods) : -1;
+    Py_XDECREF(rg_by_resource);
+    PyObject* totals =
+        track_pods >= 0 ? PyObject_GetAttr(wi, N.total_requests) : nullptr;
+    if (totals == nullptr) {
+      Py_DECREF(a);
+      Py_DECREF(lti);
+      Py_DECREF(pod_sets);
+      Py_DECREF(usage);
+      goto fail;
+    }
+
+    // first failing podset (ps_ok is False on padding rows).
+    const uint8_t* ok_row = ok_d + w * P;
+    long first_fail = P;
+    for (long p = 0; p < P; ++p) {
+      if (!ok_row[p]) {
+        first_fail = p;
+        break;
+      }
+    }
+
+    Py_ssize_t n_ps = PySequence_Size(totals);
+    bool wl_ok = n_ps >= 0;
+    for (Py_ssize_t p = 0; wl_ok && p < n_ps && p <= first_fail; ++p) {
+      PyObject* ps = PySequence_GetItem(totals, p);
+      if (ps == nullptr) {
+        wl_ok = false;
+        break;
+      }
+      PyObject* ps_requests = PyObject_GetAttr(ps, N.requests);
+      PyObject* requests = ps_requests ? PyDict_Copy(ps_requests) : nullptr;
+      Py_XDECREF(ps_requests);
+      PyObject* count = requests ? PyObject_GetAttr(ps, N.count) : nullptr;
+      if (count != nullptr && track_pods == 1)
+        if (PyDict_SetItem(requests, N.pods, count) != 0) {
+          Py_DECREF(count);
+          count = nullptr;
+        }
+
+      // psa = PodSetAssignmentResult(...)
+      PyObject* psa = count ? bare_new(cls_psa) : nullptr;
+      PyObject* flavors = psa ? PyDict_New() : nullptr;
+      PyObject* ps_name = flavors ? PyObject_GetAttr(ps, N.name) : nullptr;
+      Py_DECREF(ps);
+      bool ok_psa = ps_name != nullptr && set_steal(psa, N.name, ps_name) &&
+                    set_keep(psa, N.flavors, flavors) &&
+                    set_keep(psa, N.requests, requests) &&
+                    set_steal(psa, N.count, count) &&
+                    set_keep(psa, N.error, Py_None);
+      bool ok_here = ok_row[p] != 0;
+      if (ok_psa) {
+        PyObject* reason = nullptr;  // shared constant, or none
+        if (!ok_here)
+          reason = N.msg_no_fit;
+        else if (pm_d[w * P + p] < kFit)
+          reason = N.msg_no_quota;
+        PyObject* reasons = PyList_New(reason ? 1 : 0);
+        if (reasons != nullptr && reason != nullptr) {
+          Py_INCREF(reason);
+          PyList_SET_ITEM(reasons, 0, reason);
+        }
+        ok_psa = set_steal(psa, N.reasons, reasons);
+      }
+      PyObject* lti_dict = ok_psa ? PyDict_New() : nullptr;
+      if (lti_dict == nullptr || PyList_Append(lti, lti_dict) != 0 ||
+          PyList_Append(pod_sets, psa) != 0) {
+        Py_XDECREF(lti_dict);
+        Py_XDECREF(flavors);
+        Py_XDECREF(requests);
+        Py_XDECREF(psa);
+        wl_ok = false;
+        break;
+      }
+
+      if (ok_here) {
+        const int16_t* rf_row = rf_d + (w * P + p) * R;
+        const int8_t* rm_row = rm_d + (w * P + p) * R;
+        const uint8_t* rb_row = rb_d + (w * P + p) * R;
+        const int16_t* gt_row = gt_d + (w * P + p) * G;
+        const int32_t* gor_row = gor_d + ci * R;
+        for (long r = 0; wl_ok && r < R; ++r) {
+          int f = rf_row[r];
+          if (f < 0) continue;
+          PyObject* rname = PyList_GET_ITEM(resource_names, r);  // borrowed
+          PyObject* fname = PyList_GET_ITEM(flavor_names, f);    // borrowed
+          long tried = gt_row[gor_row[r]];
+          bool borrow = rb_row[r] != 0;
+
+          PyObject* fa = bare_new(cls_fa);
+          PyObject* tried_o = fa ? PyLong_FromLong(tried) : nullptr;
+          bool ok_fa =
+              tried_o != nullptr && set_keep(fa, N.name, fname) &&
+              set_steal(fa, N.mode, PyLong_FromLong(rm_row[r])) &&
+              set_keep(fa, N.tried_flavor_idx, tried_o) &&
+              set_keep(fa, N.borrow, borrow ? Py_True : Py_False) &&
+              PyDict_SetItem(flavors, rname, fa) == 0;
+          Py_XDECREF(fa);
+          if (!ok_fa) {
+            Py_XDECREF(tried_o);
+            wl_ok = false;
+            break;
+          }
+          if (borrow) a_borrowing = true;
+
+          // a.usage[fname][rname] += requests[rname]
+          PyObject* fusage = PyDict_GetItem(usage, fname);  // borrowed
+          if (fusage == nullptr) {
+            PyObject* d = PyDict_New();
+            if (d == nullptr || PyDict_SetItem(usage, fname, d) != 0) {
+              Py_XDECREF(d);
+              Py_DECREF(tried_o);
+              wl_ok = false;
+              break;
+            }
+            fusage = d;  // borrowed after SetItem
+            Py_DECREF(d);
+          }
+          PyObject* val = PyDict_GetItem(requests, rname);  // borrowed
+          PyObject* prev = PyDict_GetItem(fusage, rname);   // borrowed
+          if (val == nullptr) {
+            PyErr_SetString(PyExc_KeyError, "assigned resource not requested");
+            Py_DECREF(tried_o);
+            wl_ok = false;
+            break;
+          }
+          if (prev == nullptr) {
+            wl_ok = PyDict_SetItem(fusage, rname, val) == 0;
+          } else {
+            PyObject* sum = PyNumber_Add(prev, val);
+            wl_ok = sum != nullptr && PyDict_SetItem(fusage, rname, sum) == 0;
+            Py_XDECREF(sum);
+          }
+          // last_tried_flavor_idx[p][rname] = tried
+          if (wl_ok) wl_ok = PyDict_SetItem(lti_dict, rname, tried_o) == 0;
+          Py_DECREF(tried_o);
+        }
+      }
+      Py_DECREF(lti_dict);
+      Py_DECREF(flavors);
+      Py_DECREF(requests);
+      Py_DECREF(psa);
+    }
+    Py_DECREF(totals);
+    Py_DECREF(lti);
+    Py_DECREF(pod_sets);
+    Py_DECREF(usage);
+    if (!wl_ok) {
+      Py_DECREF(a);
+      goto fail;
+    }
+    if (a_borrowing && PyObject_SetAttr(a, N.borrowing, Py_True) != 0) {
+      Py_DECREF(a);
+      goto fail;
+    }
+    PyList_SET_ITEM(result, w, a);  // steals
+  }
+  return result;
+
+fail:
+  Py_DECREF(result);
+  return nullptr;
+}
+
+PyMethodDef methods[] = {
+    {"decode", decode, METH_VARARGS,
+     "Decode solver output tensors into Assignment objects."},
+    {nullptr, nullptr, 0, nullptr},
+};
+
+PyModuleDef moduledef = {
+    PyModuleDef_HEAD_INIT, "_kueue_decode",
+    "Native decision decoder for the batched admission solve.", -1, methods,
+};
+
+}  // namespace
+
+PyMODINIT_FUNC PyInit__kueue_decode(void) {
+  N.cluster_queue = PyUnicode_InternFromString("cluster_queue");
+  N.allocatable_generation = PyUnicode_InternFromString("allocatable_generation");
+  N.cohort = PyUnicode_InternFromString("cohort");
+  N.rg_by_resource = PyUnicode_InternFromString("rg_by_resource");
+  N.total_requests = PyUnicode_InternFromString("total_requests");
+  N.name = PyUnicode_InternFromString("name");
+  N.requests = PyUnicode_InternFromString("requests");
+  N.count = PyUnicode_InternFromString("count");
+  N.pod_sets = PyUnicode_InternFromString("pod_sets");
+  N.borrowing = PyUnicode_InternFromString("borrowing");
+  N.usage = PyUnicode_InternFromString("usage");
+  N.last_state = PyUnicode_InternFromString("last_state");
+  N.flavors = PyUnicode_InternFromString("flavors");
+  N.reasons = PyUnicode_InternFromString("reasons");
+  N.error = PyUnicode_InternFromString("error");
+  N.mode = PyUnicode_InternFromString("mode");
+  N.tried_flavor_idx = PyUnicode_InternFromString("tried_flavor_idx");
+  N.borrow = PyUnicode_InternFromString("borrow");
+  N.last_tried_flavor_idx = PyUnicode_InternFromString("last_tried_flavor_idx");
+  N.cluster_queue_generation =
+      PyUnicode_InternFromString("cluster_queue_generation");
+  N.cohort_generation = PyUnicode_InternFromString("cohort_generation");
+  N.pods = PyUnicode_InternFromString("pods");
+  N.msg_no_quota = PyUnicode_InternFromString("insufficient unused quota");
+  N.msg_no_fit =
+      PyUnicode_InternFromString("insufficient quota or no eligible flavor");
+  return PyModule_Create(&moduledef);
+}

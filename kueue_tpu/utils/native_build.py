@@ -1,0 +1,50 @@
+"""Shared build-and-cache helper for the native (C++) components.
+
+Compiles a source under kueue_tpu/native/ with the toolchain's g++ on first
+use, caching the .so next to it; returns None when the toolchain or the
+build is unavailable so callers fall back to their pure-Python twins.
+Used by utils/native_heap.py (ctypes library) and utils/native_decode.py
+(CPython extension).
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sysconfig
+import threading
+from typing import List, Optional
+
+NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "native")
+
+_lock = threading.Lock()
+
+
+def build(src_name: str, lib_name: str,
+          python_ext: bool = False) -> Optional[str]:
+    """Compile native/<src_name> into native/<lib_name> if stale.
+
+    Returns the library path, or None when the build is unavailable. Safe
+    under concurrent callers: the compile goes to a pid-suffixed temp file
+    and lands with an atomic rename.
+    """
+    src = os.path.join(NATIVE_DIR, src_name)
+    lib = os.path.join(NATIVE_DIR, lib_name)
+    with _lock:
+        try:
+            if (os.path.exists(lib)
+                    and os.path.getmtime(lib) >= os.path.getmtime(src)):
+                return lib
+            cmd: List[str] = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17"]
+            if python_ext:
+                cmd.append(f"-I{sysconfig.get_paths()['include']}")
+            tmp = f"{lib}.{os.getpid()}.tmp"
+            cmd += ["-o", tmp, src]
+            result = subprocess.run(cmd, capture_output=True, timeout=180)
+            if result.returncode != 0:
+                return None
+            os.replace(tmp, lib)
+            return lib
+        except (OSError, subprocess.SubprocessError, KeyError):
+            return None

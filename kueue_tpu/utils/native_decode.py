@@ -1,0 +1,48 @@
+"""Loader for the native decision decoder (kueue_tpu/native/decode.cpp).
+
+Builds the CPython extension with the toolchain's g++ on first use and
+caches the .so next to the source (same discipline as native_heap.py).
+`decode_available()` gates use; callers fall back to the pure-Python
+decode loop in `kueue_tpu.models.flavor_fit` when the toolchain or the
+build is unavailable.
+"""
+
+from __future__ import annotations
+
+import importlib.machinery
+import importlib.util
+import threading
+from typing import Optional
+
+from kueue_tpu.utils import native_build
+
+_lock = threading.Lock()
+_mod = None
+_tried = False
+
+
+def load() -> Optional[object]:
+    """The `_kueue_decode` extension module, or None."""
+    global _mod, _tried
+    with _lock:
+        if _tried:
+            return _mod
+        _tried = True
+        lib = native_build.build("decode.cpp", "_kueue_decode.so",
+                                python_ext=True)
+        if lib is None:
+            return None
+        try:
+            loader = importlib.machinery.ExtensionFileLoader(
+                "_kueue_decode", lib)
+            spec = importlib.util.spec_from_loader("_kueue_decode", loader)
+            mod = importlib.util.module_from_spec(spec)
+            loader.exec_module(mod)
+        except (ImportError, OSError):
+            return None
+        _mod = mod
+        return _mod
+
+
+def decode_available() -> bool:
+    return load() is not None

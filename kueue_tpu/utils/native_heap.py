@@ -13,38 +13,16 @@ Python heap.
 from __future__ import annotations
 
 import ctypes
-import os
-import subprocess
 import threading
 from typing import Callable, Dict, Generic, List, Optional, Sequence, TypeVar
 
-T = TypeVar("T")
+from kueue_tpu.utils import native_build
 
-_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
-    os.path.abspath(__file__))), "native")
-_SRC = os.path.join(_NATIVE_DIR, "heap.cpp")
-_LIB = os.path.join(_NATIVE_DIR, "_libkueue_heap.so")
+T = TypeVar("T")
 
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
 _tried = False
-
-
-def _build() -> bool:
-    try:
-        if (os.path.exists(_LIB)
-                and os.path.getmtime(_LIB) >= os.path.getmtime(_SRC)):
-            return True
-        result = subprocess.run(
-            ["g++", "-O2", "-shared", "-fPIC", "-std=c++17",
-             "-o", _LIB + ".tmp", _SRC],
-            capture_output=True, timeout=120)
-        if result.returncode != 0:
-            return False
-        os.replace(_LIB + ".tmp", _LIB)
-        return True
-    except (OSError, subprocess.SubprocessError):
-        return False
 
 
 def _load() -> Optional[ctypes.CDLL]:
@@ -53,10 +31,11 @@ def _load() -> Optional[ctypes.CDLL]:
         if _tried:
             return _lib
         _tried = True
-        if not _build():
+        path = native_build.build("heap.cpp", "_libkueue_heap.so")
+        if path is None:
             return None
         try:
-            lib = ctypes.CDLL(_LIB)
+            lib = ctypes.CDLL(path)
         except OSError:
             return None
         lib.kh_new.restype = ctypes.c_void_p

@@ -1,0 +1,68 @@
+"""The native decision decoder (kueue_tpu/native/decode.cpp) must produce
+object trees identical to the pure-Python decode on randomized problems —
+same Assignment/PodSetAssignmentResult/FlavorAssignment fields, same usage
+maps, same resume state."""
+
+import pytest
+
+from kueue_tpu.models.flavor_fit import (
+    BatchSolver,
+    _decode_assignments_py,
+    decode_assignments,
+    device_static,
+    solve_flavor_fit,
+)
+from kueue_tpu.solver import schema as sch
+from kueue_tpu.utils import native_decode
+
+from tests.test_solver_equivalence import random_problem
+
+pytestmark = pytest.mark.skipif(
+    not native_decode.decode_available(),
+    reason="native decoder unavailable (no toolchain)")
+
+
+def _norm(a):
+    return (
+        [(ps.name, dict(ps.requests), ps.count, list(ps.reasons), ps.error,
+          {r: (fa.name, fa.mode, fa.tried_flavor_idx, fa.borrow)
+           for r, fa in ps.flavors.items()})
+         for ps in a.pod_sets],
+        a.borrowing,
+        a.usage,
+        (a.last_state.cluster_queue_generation,
+         a.last_state.cohort_generation,
+         a.last_state.last_tried_flavor_idx),
+    )
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_native_matches_python_decode(seed):
+    cache, pending = random_problem(seed, num_cqs=5, num_flavors=3,
+                                    num_wls=32)
+    snapshot = cache.snapshot()
+    enc = sch.encode_cluster_queues(snapshot)
+    usage = sch.encode_usage(snapshot, enc)
+    wt = sch.encode_workloads(pending, snapshot, enc)
+    out = solve_flavor_fit(enc, usage, wt, static=device_static(enc))
+
+    native = decode_assignments(pending, snapshot, enc, out)
+    python = _decode_assignments_py(pending, snapshot, enc, out)
+    assert len(native) == len(python) == len(pending)
+    for i, (x, y) in enumerate(zip(native, python)):
+        assert _norm(x) == _norm(y), f"workload {i} (seed {seed})"
+
+
+def test_native_decode_objects_survive_gc():
+    import gc
+    cache, pending = random_problem(3, num_cqs=3, num_flavors=2, num_wls=16)
+    snapshot = cache.snapshot()
+    assignments = BatchSolver().solve(pending, snapshot)
+    gc.collect()
+    # Objects built by the extension must be fully initialized, GC-tracked
+    # Python objects: attribute access and mutation behave normally.
+    for a in assignments:
+        for ps in a.pod_sets:
+            ps.reasons = list(ps.reasons)
+        assert a.representative_mode in (0, 1, 2)
+    gc.collect()

@@ -104,3 +104,51 @@ def test_batched_vs_referee_full_drain(seed):
     ref_admitted = build(None)
     jax_admitted = build(BatchSolver())
     assert jax_admitted == ref_admitted
+
+
+def test_usage_encoder_lockstep_with_cache():
+    """The incremental UsageEncoder's fast path (refresh skipping
+    version-matched rows + note_admission deltas) must serve exactly the
+    tensors a full re-encode of a fresh snapshot would produce, across
+    admissions, evictions, and requeues (solver/schema.py UsageEncoder)."""
+    import numpy as np
+
+    from kueue_tpu.solver import schema as sch
+
+    solver = BatchSolver()
+    fw = Framework(batch_solver=solver)
+    fw.create_resource_flavor(make_flavor("default"))
+    fw.create_resource_flavor(make_flavor("spot"))
+    fw.create_cluster_queue(make_cq(
+        "cq-a", rg("cpu", fq("default", cpu=6), fq("spot", cpu=6)),
+        cohort="co"))
+    fw.create_cluster_queue(make_cq(
+        "cq-b", rg("cpu", fq("default", cpu=2)), cohort="co"))
+    fw.create_local_queue(make_lq("qa", cq="cq-a"))
+    fw.create_local_queue(make_lq("qb", cq="cq-b"))
+
+    def check():
+        snap = fw.cache.snapshot()
+        got = solver._usage_enc.refresh(snap)
+        want = sch.encode_usage(snap, solver._enc)
+        np.testing.assert_array_equal(got.usage, want.usage)
+        np.testing.assert_array_equal(got.cohort_usage, want.cohort_usage)
+
+    for i in range(5):
+        fw.submit(make_wl(f"a{i}", "qa", cpu=2, creation_time=float(i)))
+    fw.submit(make_wl("b0", "qb", cpu=4, creation_time=9.0))  # borrows
+    fw.run_until_settled()
+    assert solver._usage_enc is not None
+    check()
+
+    # Finishing a workload frees usage and bumps the allocatable
+    # generation; the next solve rebuilds the encoding, and the fresh
+    # encoder must still match.
+    fw.finish(fw.workloads["default/a0"])
+    fw.run_until_settled()
+    check()
+
+    # More churn through the delta fast path.
+    fw.submit(make_wl("a9", "qa", cpu=1, creation_time=20.0))
+    fw.run_until_settled()
+    check()
