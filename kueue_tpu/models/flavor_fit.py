@@ -261,16 +261,25 @@ def _solve_kernel_packed(
     nominal, borrow_limit, guaranteed, lendable, cohort_id,
     group_of_resource, slot_flavor, num_flavors,
     bwc_enabled, borrow_policy_is_borrow, preempt_policy_is_preempt,
-    buf_i64, buf_i32, buf_u8, *, num_slots: int, shapes,
+    buf, *, num_slots: int, shapes,
     fungibility_enabled: bool = True,
 ):
     """Transfer-minimal entry: statics live on device across ticks; the
-    dynamic side arrives as three packed buffers (i64: usage+requests,
-    i32: cq index+resume slots, u8: masks) and cohort aggregates are
-    computed on device. Device->host RPCs, not FLOPs, bound the tick."""
+    whole dynamic side arrives as ONE byte buffer (i64 usage+requests,
+    i32 cq index+resume slots, u8 masks — bitcast apart on device) and
+    cohort aggregates are computed on device. Device->host RPCs, not
+    FLOPs, bound the tick, so the tick ships exactly one transfer."""
     W, P, R, G, K = shapes
     C, F = nominal.shape[0], nominal.shape[1]
     S = num_slots
+
+    nb64 = (C * F * R + W * P * R) * 8
+    nb32 = (W + W * P * G) * 4
+    buf_i64 = jax.lax.bitcast_convert_type(
+        buf[:nb64].reshape(-1, 8), jnp.int64)
+    buf_i32 = jax.lax.bitcast_convert_type(
+        buf[nb64:nb64 + nb32].reshape(-1, 4), jnp.int32)
+    buf_u8 = buf[nb64 + nb32:]
 
     usage = buf_i64[:C * F * R].reshape(C, F, R)
     req = buf_i64[C * F * R:].reshape(W, P, R)
@@ -311,16 +320,21 @@ def device_static(enc: sch.CQEncoding) -> tuple:
         enc.preempt_policy_is_preempt))
 
 
-def pack_dynamic(usage_cfr: np.ndarray, wl: sch.WorkloadTensors):
-    """Pack the per-tick dynamic tensors into three typed buffers: every
-    host->device transfer is a round trip on remote-attached TPUs, so the
-    tick ships exactly three."""
-    buf_i64 = np.concatenate([usage_cfr.ravel(), wl.req.ravel()])
-    buf_i32 = np.concatenate([wl.wl_cq.ravel(), wl.resume_slot.ravel()])
-    buf_u8 = np.concatenate([
-        wl.has_req.ravel(), wl.podset_valid.ravel(),
-        wl.podset_unsat.ravel(), wl.elig.ravel()]).astype(np.uint8)
-    return buf_i64, buf_i32, buf_u8
+def pack_dynamic(usage_cfr: np.ndarray, wl: sch.WorkloadTensors) -> np.ndarray:
+    """Pack the per-tick dynamic tensors into ONE byte buffer (i64 section,
+    i32 section, u8 masks): every host->device transfer is a round trip on
+    remote-attached TPUs, so the tick ships exactly one. The device side
+    bitcasts the sections apart (host and TPU are both little-endian)."""
+    return np.concatenate([
+        np.ascontiguousarray(usage_cfr).view(np.uint8).ravel(),
+        np.ascontiguousarray(wl.req).view(np.uint8).ravel(),
+        np.ascontiguousarray(wl.wl_cq).view(np.uint8).ravel(),
+        np.ascontiguousarray(wl.resume_slot).view(np.uint8).ravel(),
+        wl.has_req.ravel().view(np.uint8),
+        wl.podset_valid.ravel().view(np.uint8),
+        wl.podset_unsat.ravel().view(np.uint8),
+        wl.elig.ravel().view(np.uint8),
+    ])
 
 
 def solve_flavor_fit_async(enc: sch.CQEncoding, usage: sch.UsageTensors,
@@ -341,10 +355,9 @@ def solve_flavor_fit_async(enc: sch.CQEncoding, usage: sch.UsageTensors,
         static = device_static(enc)
     W, P, R = wl.req.shape
     G = wl.resume_slot.shape[2]
-    buf_i64, buf_i32, buf_u8 = pack_dynamic(usage.usage, wl)
+    buf = pack_dynamic(usage.usage, wl)
     out = _solve_kernel_packed(
-        *static,
-        jnp.asarray(buf_i64), jnp.asarray(buf_i32), jnp.asarray(buf_u8),
+        *static, jnp.asarray(buf),
         num_slots=enc.num_slots,
         shapes=(W, P, R, G, enc.num_cohorts),
         fungibility_enabled=features.enabled(features.FLAVOR_FUNGIBILITY),
