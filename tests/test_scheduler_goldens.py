@@ -1,0 +1,518 @@
+"""Golden scheduling scenarios transliterated from the reference's
+TestSchedule table (pkg/scheduler/scheduler_test.go:60-1360): same fixture
+(sales / eng-alpha / eng-beta / eng-gamma / lend cohorts), same workloads,
+same expected admissions, preemptions, and queue placement after one cycle.
+
+These pin decision-equivalence of the whole tick — entry ordering, cohort
+cycle bookkeeping, borrowing rules, preemption targeting — not just the
+flavor assigner. Each scenario runs under both the referee and the batched
+device solver."""
+
+import pytest
+
+from kueue_tpu import features
+from kueue_tpu.api.resources import resource_value
+from kueue_tpu.api.types import (
+    Admission,
+    ClusterQueuePreemption,
+    FlavorQuotas,
+    LabelSelector,
+    MatchExpression,
+    PodSet,
+    PodSetAssignment,
+    ResourceQuota,
+    Workload,
+)
+from kueue_tpu.controllers.runtime import Framework
+from kueue_tpu.models.flavor_fit import BatchSolver
+
+from tests.util import fq, make_cq, make_flavor, make_lq, rg
+
+
+def cpu(v):
+    return resource_value("cpu", v)
+
+
+GPU = "example.com/gpu"
+
+
+def dep_selector(value):
+    return LabelSelector(
+        match_expressions=(MatchExpression("dep", "In", (value,)),))
+
+
+def fqr(flavor, *resources):
+    """FlavorQuotas from (resource, nominal, borrowing[, lending]) rows —
+    for resource names that are not Python identifiers."""
+    return FlavorQuotas(name=flavor, resources=tuple(
+        (r[0], ResourceQuota.make(r[0], *r[1:])) for r in resources))
+
+
+def wl(name, namespace, queue, pod_sets, priority=0, creation=None):
+    return Workload(name=name, namespace=namespace, queue_name=queue,
+                    pod_sets=list(pod_sets), priority=priority,
+                    creation_time=creation if creation is not None else 100.0)
+
+
+def ps(name, count, requests, min_count=None):
+    return PodSet(name=name, count=count, requests=dict(requests),
+                  min_count=min_count)
+
+
+def preadmit(fw, workload, cq_name, flavors_per_podset):
+    """A workload already holding quota (wrappers.go ReserveQuota)."""
+    workload.admission = Admission(
+        cluster_queue=cq_name,
+        pod_set_assignments=[
+            PodSetAssignment(
+                name=p.name, flavors=dict(fmap),
+                resource_usage={r: v * p.count for r, v in p.requests.items()},
+                count=p.count)
+            for p, fmap in zip(workload.pod_sets, flavors_per_podset)
+        ])
+    workload.set_condition("QuotaReserved", True)
+    workload.set_condition("Admitted", True)
+    fw.workloads[workload.key] = workload
+    fw.cache.add_or_update_workload(workload)
+    return workload
+
+
+def build(batch):
+    fw = Framework(batch_solver=BatchSolver() if batch else None)
+    for ns, dep in (("sales", "sales"), ("eng-alpha", "eng"),
+                    ("eng-beta", "eng"), ("lend", "lend")):
+        fw.create_namespace(ns, labels={"dep": dep})
+    for f in ("default", "on-demand", "spot", "model-a"):
+        fw.create_resource_flavor(make_flavor(f))
+
+    # The reference fixture gives sales an explicit borrowingLimit of 0; a
+    # cohort-less CQ cannot borrow anyway, and the webhook (like the
+    # reference's, which the Go unit test bypasses) rejects a limit without
+    # a cohort — so plain nominal quota here, same semantics.
+    fw.create_cluster_queue(make_cq(
+        "sales", rg("cpu", fq("default", cpu=50)),
+        strategy="StrictFIFO", namespace_selector=dep_selector("sales")))
+    fw.create_cluster_queue(make_cq(
+        "eng-alpha",
+        rg("cpu", fq("on-demand", cpu=(50, 50)), fq("spot", cpu=(100, 0))),
+        cohort="eng", strategy="StrictFIFO",
+        namespace_selector=dep_selector("eng")))
+    fw.create_cluster_queue(make_cq(
+        "eng-beta",
+        rg("cpu", fq("on-demand", cpu=(50, 10)), fq("spot", cpu=(0, 100))),
+        rg((GPU,), fqr("model-a", (GPU, 20, 0))),
+        cohort="eng", strategy="StrictFIFO",
+        namespace_selector=dep_selector("eng"),
+        preemption=ClusterQueuePreemption(
+            reclaim_within_cohort="Any",
+            within_cluster_queue="LowerPriority")))
+    fw.create_cluster_queue(make_cq(
+        "flavor-nonexistent-cq",
+        rg("cpu", fq("nonexistent-flavor", cpu=50)), strategy="StrictFIFO"))
+    fw.create_cluster_queue(make_cq(
+        "lend-a", rg("cpu", fq("default", cpu=(3, None, 2))), cohort="lend",
+        namespace_selector=dep_selector("lend")))
+    fw.create_cluster_queue(make_cq(
+        "lend-b", rg("cpu", fq("default", cpu=(2, None, 2))), cohort="lend",
+        namespace_selector=dep_selector("lend")))
+
+    fw.create_local_queue(make_lq("main", "sales", cq="sales"))
+    fw.create_local_queue(make_lq("blocked", "sales", cq="eng-alpha"))
+    fw.create_local_queue(make_lq("main", "eng-alpha", cq="eng-alpha"))
+    fw.create_local_queue(make_lq("main", "eng-beta", cq="eng-beta"))
+    fw.create_local_queue(make_lq("flavor-nonexistent-queue", "sales",
+                                  cq="flavor-nonexistent-cq"))
+    fw.create_local_queue(make_lq("lend-a-queue", "lend", cq="lend-a"))
+    fw.create_local_queue(make_lq("lend-b-queue", "lend", cq="lend-b"))
+    return fw
+
+
+@pytest.fixture(params=["referee", "batch"])
+def golden(request):
+    return build(batch=request.param == "batch")
+
+
+def heap_keys(fw, cq):
+    return {wi.key for wi in fw.queues.cluster_queues[cq].heap.items()}
+
+
+def inadmissible_keys(fw, cq):
+    return set(fw.queues.cluster_queues[cq].inadmissible)
+
+
+def assert_admission(fw, key, cq_name, podsets):
+    """podsets: [(name, {resource: flavor}, {resource: usage}, count)]."""
+    w = fw.workloads[key]
+    assert w.admission is not None, f"{key} not admitted"
+    assert w.admission.cluster_queue == cq_name
+    got = [(a.name, dict(a.flavors), dict(a.resource_usage), a.count)
+           for a in w.admission.pod_set_assignments]
+    assert got == list(podsets), f"{key}: {got}"
+
+
+def not_admitted(fw, key):
+    assert fw.workloads[key].admission is None, key
+
+
+# scheduler_test.go "workload fits in single clusterQueue"
+def test_fits_in_single_cluster_queue(golden):
+    fw = golden
+    fw.submit(wl("foo", "sales", "main", [ps("one", 10, {"cpu": cpu(1)})]))
+    fw.tick()
+    assert_admission(fw, "sales/foo", "sales",
+                     [("one", {"cpu": "default"}, {"cpu": cpu(10)}, 10)])
+
+
+# "single clusterQueue full": the head stays in the heap (StrictFIFO)
+def test_single_cluster_queue_full(golden):
+    fw = golden
+    assigned = wl("assigned", "sales", "main", [ps("one", 40, {"cpu": cpu(1)})])
+    preadmit(fw, assigned, "sales", [{"cpu": "default"}])
+    fw.submit(wl("new", "sales", "main", [ps("one", 11, {"cpu": cpu(1)})]))
+    fw.tick()
+    not_admitted(fw, "sales/new")
+    assert heap_keys(fw, "sales") == {"sales/new"}
+
+
+# "failed to match clusterQueue selector": inadmissible on eng-alpha
+def test_namespace_selector_mismatch(golden):
+    fw = golden
+    fw.submit(wl("new", "sales", "blocked", [ps("one", 1, {"cpu": cpu(1)})]))
+    fw.tick()
+    not_admitted(fw, "sales/new")
+    assert inadmissible_keys(fw, "eng-alpha") == {"sales/new"}
+
+
+# "admit in different cohorts"
+def test_admit_in_different_cohorts(golden):
+    fw = golden
+    fw.submit(wl("new", "sales", "main", [ps("one", 1, {"cpu": cpu(1)})]))
+    fw.submit(wl("new", "eng-alpha", "main",
+                 [ps("one", 51, {"cpu": cpu(1)})]))  # borrows
+    fw.tick()
+    assert_admission(fw, "sales/new", "sales",
+                     [("one", {"cpu": "default"}, {"cpu": cpu(1)}, 1)])
+    assert_admission(fw, "eng-alpha/new", "eng-alpha",
+                     [("one", {"cpu": "on-demand"}, {"cpu": cpu(51)}, 51)])
+
+
+# "admit in same cohort with no borrowing"
+def test_admit_in_same_cohort_no_borrowing(golden):
+    fw = golden
+    fw.submit(wl("new", "eng-alpha", "main", [ps("one", 40, {"cpu": cpu(1)})],
+                 creation=10.0))
+    fw.submit(wl("new", "eng-beta", "main", [ps("one", 40, {"cpu": cpu(1)})],
+                 creation=11.0))
+    fw.tick()
+    assert_admission(fw, "eng-alpha/new", "eng-alpha",
+                     [("one", {"cpu": "on-demand"}, {"cpu": cpu(40)}, 40)])
+    assert_admission(fw, "eng-beta/new", "eng-beta",
+                     [("one", {"cpu": "on-demand"}, {"cpu": cpu(40)}, 40)])
+
+
+# "assign multiple resources and flavors"
+def test_assign_multiple_resources_and_flavors(golden):
+    fw = golden
+    fw.submit(wl("new", "eng-beta", "main", [
+        ps("one", 10, {"cpu": cpu(6), GPU: 1}),
+        ps("two", 40, {"cpu": cpu(1)}),
+    ]))
+    fw.tick()
+    assert_admission(fw, "eng-beta/new", "eng-beta", [
+        ("one", {"cpu": "on-demand", GPU: "model-a"},
+         {"cpu": cpu(60), GPU: 10}, 10),
+        ("two", {"cpu": "spot"}, {"cpu": cpu(40)}, 40),
+    ])
+
+
+# "cannot borrow if cohort was assigned and would result in overadmission"
+def test_cannot_borrow_when_cohort_assigned_overadmission(golden):
+    fw = golden
+    fw.submit(wl("new", "eng-alpha", "main", [ps("one", 45, {"cpu": cpu(1)})],
+                 creation=10.0))
+    fw.submit(wl("new", "eng-beta", "main", [ps("one", 56, {"cpu": cpu(1)})],
+                 creation=11.0))
+    fw.tick()
+    assert_admission(fw, "eng-alpha/new", "eng-alpha",
+                     [("one", {"cpu": "on-demand"}, {"cpu": cpu(45)}, 45)])
+    not_admitted(fw, "eng-beta/new")
+    assert heap_keys(fw, "eng-beta") == {"eng-beta/new"}
+
+
+# "can borrow if cohort was assigned and will not result in overadmission"
+def test_can_borrow_when_cohort_assigned_no_overadmission(golden):
+    fw = golden
+    fw.submit(wl("new", "eng-alpha", "main", [ps("one", 45, {"cpu": cpu(1)})],
+                 creation=10.0))
+    fw.submit(wl("new", "eng-beta", "main", [ps("one", 55, {"cpu": cpu(1)})],
+                 creation=11.0))
+    fw.tick()
+    assert_admission(fw, "eng-alpha/new", "eng-alpha",
+                     [("one", {"cpu": "on-demand"}, {"cpu": cpu(45)}, 45)])
+    assert_admission(fw, "eng-beta/new", "eng-beta",
+                     [("one", {"cpu": "on-demand"}, {"cpu": cpu(55)}, 55)])
+
+
+# "can borrow if needs reclaim from cohort in different flavor"
+def test_borrow_beats_reclaim_pending_in_other_cq(golden):
+    fw = golden
+    fw.submit(wl("can-reclaim", "eng-alpha", "main",
+                 [ps("main", 1, {"cpu": cpu(100)})], creation=10.0))
+    fw.submit(wl("needs-to-borrow", "eng-beta", "main",
+                 [ps("main", 1, {"cpu": cpu(1)})], creation=11.0))
+    preadmit(fw, wl("user-on-demand", "eng-beta", "",
+                    [ps("main", 1, {"cpu": cpu(50)})]),
+             "eng-beta", [{"cpu": "on-demand"}])
+    preadmit(fw, wl("user-spot", "eng-beta", "",
+                    [ps("main", 1, {"cpu": cpu(1)})]),
+             "eng-beta", [{"cpu": "spot"}])
+    fw.scheduler.schedule(timeout=0.0)
+    assert_admission(fw, "eng-beta/needs-to-borrow", "eng-beta",
+                     [("main", {"cpu": "on-demand"}, {"cpu": cpu(1)}, 1)])
+    not_admitted(fw, "eng-alpha/can-reclaim")
+    assert heap_keys(fw, "eng-alpha") == {"eng-alpha/can-reclaim"}
+
+
+# "workload exceeds lending limit when borrow in cohort"
+def test_lending_limit_blocks_borrowing(golden):
+    fw = golden
+    features.set_enabled(features.LENDING_LIMIT, True)
+    preadmit(fw, wl("a", "lend", "",
+                    [ps("main", 1, {"cpu": cpu(2)})]),
+             "lend-b", [{"cpu": "default"}])
+    fw.submit(wl("b", "lend", "lend-b-queue",
+                 [ps("main", 1, {"cpu": cpu(3)})]))
+    fw.tick()
+    not_admitted(fw, "lend/b")
+    assert inadmissible_keys(fw, "lend-b") == {"lend/b"}
+
+
+# "preempt workloads in ClusterQueue and cohort"
+def test_preempt_in_cluster_queue_and_cohort(golden):
+    fw = golden
+    fw.submit(wl("preemptor", "eng-beta", "main",
+                 [ps("main", 1, {"cpu": cpu(20)})]))
+    preadmit(fw, wl("use-all-spot", "eng-alpha", "",
+                    [ps("main", 1, {"cpu": cpu(100)})]),
+             "eng-alpha", [{"cpu": "spot"}])
+    low1 = preadmit(fw, wl("low-1", "eng-beta", "",
+                           [ps("main", 1, {"cpu": cpu(30)})], priority=-1),
+                    "eng-beta", [{"cpu": "on-demand"}])
+    low2 = preadmit(fw, wl("low-2", "eng-beta", "",
+                           [ps("main", 1, {"cpu": cpu(10)})], priority=-2),
+                    "eng-beta", [{"cpu": "on-demand"}])
+    borrower = preadmit(fw, wl("borrower", "eng-alpha", "",
+                               [ps("main", 1, {"cpu": cpu(60)})]),
+                        "eng-alpha", [{"cpu": "on-demand"}])
+    fw.scheduler.schedule(timeout=0.0)
+    not_admitted(fw, "eng-beta/preemptor")
+    assert heap_keys(fw, "eng-beta") == {"eng-beta/preemptor"}
+    evicted = {w.key for w in (low1, low2, borrower) if w.is_evicted}
+    assert evicted == {"eng-beta/low-2", "eng-alpha/borrower"}
+    assert not fw.workloads["eng-alpha/use-all-spot"].is_evicted
+    assert not low1.is_evicted
+
+
+# "cannot borrow resource not listed in clusterQueue"
+def test_cannot_borrow_resource_not_listed(golden):
+    fw = golden
+    fw.submit(wl("new", "eng-alpha", "main", [ps("main", 1, {GPU: 1})]))
+    fw.tick()
+    not_admitted(fw, "eng-alpha/new")
+    assert heap_keys(fw, "eng-alpha") == {"eng-alpha/new"}
+
+
+# "not enough resources to borrow, fallback to next flavor"
+def test_borrow_fallback_to_next_flavor(golden):
+    fw = golden
+    fw.submit(wl("new", "eng-alpha", "main",
+                 [ps("one", 60, {"cpu": cpu(1)})]))
+    preadmit(fw, wl("existing", "eng-beta", "",
+                    [ps("one", 45, {"cpu": cpu(1)})]),
+             "eng-beta", [{"cpu": "on-demand"}])
+    fw.tick()
+    assert_admission(fw, "eng-alpha/new", "eng-alpha",
+                     [("one", {"cpu": "spot"}, {"cpu": cpu(60)}, 60)])
+
+
+# "workload should not fit in clusterQueue with nonexistent flavor"
+def test_nonexistent_flavor_cluster_queue(golden):
+    fw = golden
+    fw.submit(wl("foo", "sales", "flavor-nonexistent-queue",
+                 [ps("main", 1, {"cpu": cpu(1)})]))
+    fw.tick()
+    not_admitted(fw, "sales/foo")
+    assert heap_keys(fw, "flavor-nonexistent-cq") == {"sales/foo"}
+
+
+# "partial admission single variable pod set": 50 pods, min 20 -> 25 fit
+def test_partial_admission_single_variable_podset(golden):
+    fw = golden
+    fw.submit(wl("new", "sales", "main",
+                 [ps("one", 50, {"cpu": cpu(2)}, min_count=20)]))
+    fw.tick()
+    assert_admission(fw, "sales/new", "sales",
+                     [("one", {"cpu": "default"}, {"cpu": cpu(50)}, 25)])
+
+
+def submit_unvalidated(fw, workload):
+    """Inject below the webhook layer (the reference unit test talks to the
+    queues directly; its webhook also caps minCount at one podSet)."""
+    fw.workloads[workload.key] = workload
+    fw.queues.add_or_update_workload(workload)
+
+
+# "partial admission multiple variable pod sets"
+def test_partial_admission_multiple_variable_podsets(golden):
+    fw = golden
+    submit_unvalidated(fw, wl("new", "sales", "main", [
+        ps("one", 20, {"cpu": cpu(1)}),
+        ps("two", 30, {"cpu": cpu(1)}, min_count=10),
+        ps("three", 15, {"cpu": cpu(1)}, min_count=5),
+    ]))
+    fw.tick()
+    assert_admission(fw, "sales/new", "sales", [
+        ("one", {"cpu": "default"}, {"cpu": cpu(20)}, 20),
+        ("two", {"cpu": "default"}, {"cpu": cpu(20)}, 20),
+        ("three", {"cpu": "default"}, {"cpu": cpu(10)}, 10),
+    ])
+
+
+# "partial admission disabled, multiple variable pod sets"
+def test_partial_admission_disabled(golden):
+    fw = golden
+    features.set_enabled(features.PARTIAL_ADMISSION, False)
+    submit_unvalidated(fw, wl("new", "sales", "main", [
+        ps("one", 20, {"cpu": cpu(1)}),
+        ps("two", 30, {"cpu": cpu(1)}, min_count=10),
+        ps("three", 15, {"cpu": cpu(1)}, min_count=5),
+    ]))
+    fw.tick()
+    not_admitted(fw, "sales/new")
+    assert heap_keys(fw, "sales") == {"sales/new"}
+
+
+def _same_cycle_borrow_fixture(fw):
+    preemption = ClusterQueuePreemption(
+        reclaim_within_cohort="Any", within_cluster_queue="LowerPriority")
+    for name in ("cq1", "cq2", "cq3"):
+        fw.create_cluster_queue(make_cq(
+            name, rg(("r1", "r2"), fqr("default", ("r1", 10, 10),
+                                       ("r2", 10, 10))),
+            cohort="co", preemption=preemption))
+    for i in (1, 2, 3):
+        fw.create_local_queue(make_lq(f"lq{i}", "sales", cq=f"cq{i}"))
+
+
+# "two workloads can borrow different resources from the same flavor in the
+# same cycle"
+def test_same_cycle_borrow_different_resources(golden):
+    fw = golden
+    _same_cycle_borrow_fixture(fw)
+    fw.submit(wl("wl1", "sales", "lq1", [ps("main", 1, {"r1": 16})],
+                 priority=-1))
+    fw.submit(wl("wl2", "sales", "lq2", [ps("main", 1, {"r2": 16})],
+                 priority=-2))
+    fw.tick()
+    assert_admission(fw, "sales/wl1", "cq1",
+                     [("main", {"r1": "default"}, {"r1": 16}, 1)])
+    assert_admission(fw, "sales/wl2", "cq2",
+                     [("main", {"r2": "default"}, {"r2": 16}, 1)])
+
+
+# "two workloads can borrow the same resources ... if fits in cohort quota"
+def test_same_cycle_borrow_same_resource_fits(golden):
+    fw = golden
+    _same_cycle_borrow_fixture(fw)
+    fw.submit(wl("wl1", "sales", "lq1", [ps("main", 1, {"r1": 16})],
+                 priority=-1))
+    fw.submit(wl("wl2", "sales", "lq2", [ps("main", 1, {"r1": 14})],
+                 priority=-2))
+    fw.tick()
+    assert_admission(fw, "sales/wl1", "cq1",
+                     [("main", {"r1": "default"}, {"r1": 16}, 1)])
+    assert_admission(fw, "sales/wl2", "cq2",
+                     [("main", {"r1": "default"}, {"r1": 14}, 1)])
+
+
+# "only one workload can borrow ... if cohort quota cannot fit"
+def test_same_cycle_borrow_same_resource_does_not_fit(golden):
+    fw = golden
+    _same_cycle_borrow_fixture(fw)
+    fw.submit(wl("wl1", "sales", "lq1", [ps("main", 1, {"r1": 16})],
+                 priority=-1))
+    fw.submit(wl("wl2", "sales", "lq2", [ps("main", 1, {"r1": 16})],
+                 priority=-2))
+    fw.tick()
+    assert_admission(fw, "sales/wl1", "cq1",
+                     [("main", {"r1": "default"}, {"r1": 16}, 1)])
+    not_admitted(fw, "sales/wl2")
+    assert heap_keys(fw, "cq2") == {"sales/wl2"}
+
+
+# "no overadmission while borrowing": eng-gamma already borrows on-demand;
+# beta (earliest) and alpha (1 cpu) admit, gamma's new workload must wait.
+def test_no_overadmission_while_borrowing(golden):
+    fw = golden
+    fw.create_cluster_queue(make_cq(
+        "eng-gamma",
+        rg("cpu", fq("on-demand", cpu=(50, 10)), fq("spot", cpu=(0, 100))),
+        cohort="eng", namespace_selector=dep_selector("eng"),
+        preemption=ClusterQueuePreemption(
+            reclaim_within_cohort="Any",
+            within_cluster_queue="LowerPriority")))
+    fw.create_namespace("eng-gamma", labels={"dep": "eng"})
+    fw.create_local_queue(make_lq("main", "eng-gamma", cq="eng-gamma"))
+
+    preadmit(fw, wl("existing", "eng-gamma", "", [
+        ps("borrow-on-demand", 51, {"cpu": cpu(1)}),
+        ps("use-all-spot", 100, {"cpu": cpu(1)}),
+    ]), "eng-gamma", [{"cpu": "on-demand"}, {"cpu": "spot"}])
+
+    fw.submit(wl("new", "eng-beta", "main", [ps("one", 50, {"cpu": cpu(1)})],
+                 creation=98.0))
+    fw.submit(wl("new-alpha", "eng-alpha", "main",
+                 [ps("one", 1, {"cpu": cpu(1)})], creation=99.0))
+    fw.submit(wl("new-gamma", "eng-gamma", "main",
+                 [ps("one", 50, {"cpu": cpu(1)})], creation=100.0))
+    fw.scheduler.schedule(timeout=0.0)
+    assert_admission(fw, "eng-beta/new", "eng-beta",
+                     [("one", {"cpu": "on-demand"}, {"cpu": cpu(50)}, 50)])
+    assert_admission(fw, "eng-alpha/new-alpha", "eng-alpha",
+                     [("one", {"cpu": "on-demand"}, {"cpu": cpu(1)}, 1)])
+    not_admitted(fw, "eng-gamma/new-gamma")
+    assert heap_keys(fw, "eng-gamma") == {"eng-gamma/new-gamma"}
+
+
+# "preemption while borrowing, workload waiting for preemption should not
+# block a borrowing workload in another CQ"
+def test_preemption_wait_does_not_block_borrower(golden):
+    fw = golden
+    from kueue_tpu.api.types import BorrowWithinCohort
+    preemption = ClusterQueuePreemption(
+        reclaim_within_cohort="LowerPriority",
+        borrow_within_cohort=BorrowWithinCohort(policy="LowerPriority"))
+    fw.create_cluster_queue(make_cq(
+        "cq-shared", rg("cpu", fq("default", cpu=(4, 0))),
+        cohort="preemption-while-borrowing"))
+    fw.create_cluster_queue(make_cq(
+        "cq-a", rg("cpu", fq("default", cpu=(0, 3))),
+        cohort="preemption-while-borrowing", preemption=preemption))
+    fw.create_cluster_queue(make_cq(
+        "cq-b", rg("cpu", fq("default", cpu=0)),
+        cohort="preemption-while-borrowing", preemption=preemption))
+    fw.create_local_queue(make_lq("lq-a", "eng-alpha", cq="cq-a"))
+    fw.create_local_queue(make_lq("lq-b", "eng-beta", cq="cq-b"))
+
+    preadmit(fw, wl("admitted-a", "eng-alpha", "lq-a",
+                    [ps("main", 1, {"cpu": cpu(2)})]),
+             "cq-a", [{"cpu": "default"}])
+    fw.submit(wl("a", "eng-alpha", "lq-a", [ps("main", 1, {"cpu": cpu(3)})],
+                 creation=101.0))
+    fw.submit(wl("b", "eng-beta", "lq-b", [ps("main", 1, {"cpu": cpu(1)})],
+                 creation=102.0))
+    fw.scheduler.schedule(timeout=0.0)
+    assert_admission(fw, "eng-beta/b", "cq-b",
+                     [("main", {"cpu": "default"}, {"cpu": cpu(1)}, 1)])
+    not_admitted(fw, "eng-alpha/a")
+    assert inadmissible_keys(fw, "cq-a") == {"eng-alpha/a"}
