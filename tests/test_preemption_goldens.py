@@ -1,0 +1,378 @@
+"""Golden preemption-victim scenarios transliterated from the reference's
+TestPreemption table (pkg/scheduler/preemption/preemption_test.go:58-1120):
+same ClusterQueue fixture (standalone / cohort / cohort-no-limits /
+preventStarvation / with_shared_cq / cohort-lend), same admitted state, same
+incoming workload and assignment, same expected victim sets — and the
+snapshot must come back unmodified.
+
+Each scenario runs under both the host referee engine and the device scan
+engine (ops/preemption_scan, engine="jax")."""
+
+import pytest
+
+from kueue_tpu import features
+from kueue_tpu.api.resources import resource_value
+from kueue_tpu.api.types import (
+    Admission,
+    BorrowWithinCohort,
+    ClusterQueuePreemption,
+    PodSet,
+    PodSetAssignment,
+    Workload,
+)
+from kueue_tpu.core.cache import Cache
+from kueue_tpu.core.workload import WorkloadInfo, WorkloadOrdering
+from kueue_tpu.scheduler.preemption import get_targets
+from kueue_tpu.solver.modes import FIT, PREEMPT
+from kueue_tpu.solver.referee import (
+    Assignment,
+    FlavorAssignment,
+    PodSetAssignmentResult,
+)
+
+from tests.util import fq, make_cq, make_flavor, rg
+
+ORD = WorkloadOrdering()
+NOW = 1_000_000.0
+
+
+def cpu(v):
+    return resource_value("cpu", v)
+
+
+def mem(v):
+    return resource_value("memory", v)
+
+
+def build_cache():
+    """The TestPreemption ClusterQueue fixture (preemption_test.go:58-230)."""
+    cache = Cache()
+    for f in ("default", "alpha", "beta"):
+        cache.add_or_update_resource_flavor(make_flavor(f))
+
+    lower = ClusterQueuePreemption(within_cluster_queue="LowerPriority")
+    lower_reclaim_lower = ClusterQueuePreemption(
+        within_cluster_queue="LowerPriority",
+        reclaim_within_cohort="LowerPriority")
+    never_reclaim_any = ClusterQueuePreemption(
+        within_cluster_queue="Never", reclaim_within_cohort="Any")
+    bwc_standard = ClusterQueuePreemption(
+        within_cluster_queue="Never", reclaim_within_cohort="LowerPriority",
+        borrow_within_cohort=BorrowWithinCohort(
+            policy="LowerPriority", max_priority_threshold=0))
+
+    cache.add_cluster_queue(make_cq(
+        "standalone",
+        rg("cpu", fq("default", cpu=6)),
+        rg("memory", fq("alpha", memory="3Gi"), fq("beta", memory="3Gi")),
+        preemption=lower))
+    cache.add_cluster_queue(make_cq(
+        "c1", rg(("cpu", "memory"),
+                 fq("default", cpu=(6, 12), memory=("3Gi", "6Gi"))),
+        cohort="cohort", preemption=lower_reclaim_lower))
+    cache.add_cluster_queue(make_cq(
+        "c2", rg(("cpu", "memory"),
+                 fq("default", cpu=(6, 12), memory=("3Gi", "6Gi"))),
+        cohort="cohort", preemption=never_reclaim_any))
+    cache.add_cluster_queue(make_cq(
+        "d1", rg(("cpu", "memory"), fq("default", cpu=6, memory="3Gi")),
+        cohort="cohort-no-limits", preemption=lower_reclaim_lower))
+    cache.add_cluster_queue(make_cq(
+        "d2", rg(("cpu", "memory"), fq("default", cpu=6, memory="3Gi")),
+        cohort="cohort-no-limits", preemption=never_reclaim_any))
+    cache.add_cluster_queue(make_cq(
+        "preventStarvation", rg("cpu", fq("default", cpu=6)),
+        preemption=ClusterQueuePreemption(
+            within_cluster_queue="LowerOrNewerEqualPriority")))
+    cache.add_cluster_queue(make_cq(
+        "a_standard", rg("cpu", fq("default", cpu=(1, 12))),
+        cohort="with_shared_cq", preemption=bwc_standard))
+    cache.add_cluster_queue(make_cq(
+        "b_standard", rg("cpu", fq("default", cpu=(1, 12))),
+        cohort="with_shared_cq", preemption=bwc_standard))
+    cache.add_cluster_queue(make_cq(
+        "a_best_effort", rg("cpu", fq("default", cpu=(1, 12))),
+        cohort="with_shared_cq", preemption=bwc_standard))
+    cache.add_cluster_queue(make_cq(
+        "shared", rg("cpu", fq("default", cpu=10)), cohort="with_shared_cq"))
+    cache.add_cluster_queue(make_cq(
+        "lend1", rg("cpu", fq("default", cpu=(6, None, 4))),
+        cohort="cohort-lend", preemption=lower_reclaim_lower))
+    cache.add_cluster_queue(make_cq(
+        "lend2", rg("cpu", fq("default", cpu=(6, None, 2))),
+        cohort="cohort-lend", preemption=lower_reclaim_lower))
+    return cache
+
+
+_seq = [0]
+
+
+def wl(name, priority=0, creation=None, **requests):
+    _seq[0] += 1
+    reqs = {r: resource_value(r, q) for r, q in requests.items()}
+    return Workload(
+        name=name, namespace="", queue_name="",
+        pod_sets=[PodSet(name="main", count=1, requests=reqs)],
+        priority=priority,
+        creation_time=creation if creation is not None else NOW - 60 + _seq[0])
+
+
+def padmit(cache, w, cq_name, flavor, reserved_at=NOW - 30):
+    """ReserveQuota: admit into the cache with the given flavor."""
+    w.admission = Admission(
+        cluster_queue=cq_name,
+        pod_set_assignments=[
+            PodSetAssignment(
+                name=p.name, flavors={r: flavor for r in p.requests},
+                resource_usage={r: v * p.count for r, v in p.requests.items()},
+                count=p.count)
+            for p in w.pod_sets
+        ])
+    w.set_condition("QuotaReserved", True, now=reserved_at)
+    w.set_condition("Admitted", True, now=reserved_at)
+    cache.add_or_update_workload(w)
+    return w
+
+
+def assignment_for(wi, flavors_modes):
+    """singlePodSetAssignment: {resource: (flavor, mode)} for podset main."""
+    a = Assignment(usage={})
+    for p in wi.total_requests:
+        psa = PodSetAssignmentResult(
+            name=p.name, requests=dict(p.requests), count=p.count)
+        for res, (fname, mode) in flavors_modes.items():
+            if res in p.requests:
+                psa.flavors[res] = FlavorAssignment(name=fname, mode=mode)
+        a.pod_sets.append(psa)
+    return a
+
+
+@pytest.fixture(params=[None, "jax"], ids=["host", "device"])
+def engine(request):
+    return request.param
+
+
+def run_case(cache, incoming, target_cq, flavors_modes, engine):
+    snap = cache.snapshot()
+    before = {name: {f: dict(r) for f, r in cq.usage.items()}
+              for name, cq in snap.cluster_queues.items()}
+    wi = WorkloadInfo(incoming, cluster_queue=target_cq)
+    targets = get_targets(wi, assignment_for(wi, flavors_modes), snap, ORD,
+                          NOW, engine=engine)
+    after = {name: {f: dict(r) for f, r in cq.usage.items()}
+             for name, cq in snap.cluster_queues.items()}
+    assert after == before, "snapshot was modified"
+    return {t.obj.name for t in targets}
+
+
+def test_preempt_lowest_priority(engine):
+    cache = build_cache()
+    padmit(cache, wl("low", priority=-1, cpu=2), "standalone", "default")
+    padmit(cache, wl("mid", cpu=2), "standalone", "default")
+    padmit(cache, wl("high", priority=1, cpu=2), "standalone", "default")
+    got = run_case(cache, wl("in", priority=1, cpu=2), "standalone",
+                   {"cpu": ("default", PREEMPT)}, engine)
+    assert got == {"low"}
+
+
+def test_preempt_multiple(engine):
+    cache = build_cache()
+    padmit(cache, wl("low", priority=-1, cpu=2), "standalone", "default")
+    padmit(cache, wl("mid", cpu=2), "standalone", "default")
+    padmit(cache, wl("high", priority=1, cpu=2), "standalone", "default")
+    got = run_case(cache, wl("in", priority=1, cpu=3), "standalone",
+                   {"cpu": ("default", PREEMPT)}, engine)
+    assert got == {"low", "mid"}
+
+
+def test_no_preemption_for_low_priority(engine):
+    cache = build_cache()
+    padmit(cache, wl("low", priority=-1, cpu=3), "standalone", "default")
+    padmit(cache, wl("mid", cpu=3), "standalone", "default")
+    got = run_case(cache, wl("in", priority=-1, cpu=1), "standalone",
+                   {"cpu": ("default", PREEMPT)}, engine)
+    assert got == set()
+
+
+def test_not_enough_low_priority_workloads(engine):
+    cache = build_cache()
+    padmit(cache, wl("low", priority=-1, cpu=3), "standalone", "default")
+    padmit(cache, wl("mid", cpu=3), "standalone", "default")
+    got = run_case(cache, wl("in", cpu=4), "standalone",
+                   {"cpu": ("default", PREEMPT)}, engine)
+    assert got == set()
+
+
+def test_some_free_quota_preempt_low_priority(engine):
+    cache = build_cache()
+    padmit(cache, wl("low", priority=-1, cpu=1), "standalone", "default")
+    padmit(cache, wl("mid", cpu=1), "standalone", "default")
+    padmit(cache, wl("high", priority=1, cpu=3), "standalone", "default")
+    got = run_case(cache, wl("in", priority=1, cpu=2), "standalone",
+                   {"cpu": ("default", PREEMPT)}, engine)
+    assert got == {"low"}
+
+
+def test_minimal_set_excludes_low_priority(engine):
+    cache = build_cache()
+    padmit(cache, wl("low", priority=-1, cpu=1), "standalone", "default")
+    padmit(cache, wl("mid", cpu=2), "standalone", "default")
+    padmit(cache, wl("high", priority=1, cpu=3), "standalone", "default")
+    got = run_case(cache, wl("in", priority=1, cpu=2), "standalone",
+                   {"cpu": ("default", PREEMPT)}, engine)
+    assert got == {"mid"}
+
+
+def test_only_preempt_workloads_using_chosen_flavor(engine):
+    cache = build_cache()
+    padmit(cache, wl("low", priority=-1, memory="2Gi"), "standalone", "alpha")
+    padmit(cache, wl("mid", memory="1Gi"), "standalone", "beta")
+    padmit(cache, wl("high", priority=1, memory="1Gi"), "standalone", "beta")
+    got = run_case(cache, wl("in", priority=1, cpu=1, memory="2Gi"),
+                   "standalone",
+                   {"cpu": ("default", FIT), "memory": ("beta", PREEMPT)},
+                   engine)
+    assert got == {"mid"}
+
+
+def test_reclaim_quota_from_borrower(engine):
+    cache = build_cache()
+    padmit(cache, wl("c1-low", priority=-1, cpu=3), "c1", "default")
+    padmit(cache, wl("c2-mid", cpu=3), "c2", "default")
+    padmit(cache, wl("c2-high", priority=1, cpu=6), "c2", "default")
+    got = run_case(cache, wl("in", priority=1, cpu=3), "c1",
+                   {"cpu": ("default", PREEMPT)}, engine)
+    assert got == {"c2-mid"}
+
+
+def test_no_workloads_borrowing(engine):
+    cache = build_cache()
+    padmit(cache, wl("c1-high", priority=1, cpu=4), "c1", "default")
+    padmit(cache, wl("c2-low-1", priority=-1, cpu=4), "c2", "default")
+    got = run_case(cache, wl("in", priority=1, cpu=4), "c1",
+                   {"cpu": ("default", PREEMPT)}, engine)
+    assert got == set()
+
+
+def test_not_enough_workloads_borrowing(engine):
+    cache = build_cache()
+    padmit(cache, wl("c1-high", priority=1, cpu=4), "c1", "default")
+    padmit(cache, wl("c2-low-1", priority=-1, cpu=4), "c2", "default")
+    padmit(cache, wl("c2-low-2", priority=-1, cpu=4), "c2", "default")
+    got = run_case(cache, wl("in", priority=1, cpu=4), "c1",
+                   {"cpu": ("default", PREEMPT)}, engine)
+    assert got == set()
+
+
+def test_preempt_locally_and_borrow_other_resources_no_cohort_candidates(
+        engine):
+    cache = build_cache()
+    padmit(cache, wl("c1-low", priority=-1, cpu=4), "c1", "default")
+    padmit(cache, wl("c2-low-1", priority=-1, cpu=4), "c2", "default")
+    padmit(cache, wl("c2-high-2", priority=1, cpu=4), "c2", "default")
+    got = run_case(cache, wl("in", priority=1, cpu=4, memory="5Gi"), "c1",
+                   {"cpu": ("default", PREEMPT),
+                    "memory": ("default", PREEMPT)}, engine)
+    assert got == {"c1-low"}
+
+
+def test_preempt_from_all_cluster_queues_in_cohort(engine):
+    cache = build_cache()
+    padmit(cache, wl("c1-low", priority=-1, cpu=3), "c1", "default")
+    padmit(cache, wl("c1-mid", cpu=2), "c1", "default")
+    padmit(cache, wl("c2-low", priority=-1, cpu=3), "c2", "default")
+    padmit(cache, wl("c2-mid", cpu=4), "c2", "default")
+    got = run_case(cache, wl("in", cpu=4), "c1",
+                   {"cpu": ("default", PREEMPT)}, engine)
+    assert got == {"c1-low", "c2-low"}
+
+
+def test_cannot_preempt_within_cq_when_policy_never(engine):
+    cache = build_cache()
+    padmit(cache, wl("c2-low", priority=-1, cpu=3), "c2", "default")
+    got = run_case(cache, wl("in", priority=1, cpu=4), "c2",
+                   {"cpu": ("default", PREEMPT)}, engine)
+    assert got == set()
+
+
+def test_preempt_newer_workloads_with_same_priority(engine):
+    cache = build_cache()
+    padmit(cache, wl("wl1", priority=2, cpu=2), "preventStarvation",
+           "default")
+    padmit(cache, wl("wl2", priority=1, cpu=2, creation=NOW),
+           "preventStarvation", "default", reserved_at=NOW + 1)
+    padmit(cache, wl("wl3", priority=1, cpu=2, creation=NOW),
+           "preventStarvation", "default", reserved_at=NOW)
+    got = run_case(cache, wl("in", priority=1, cpu=2, creation=NOW - 15),
+                   "preventStarvation", {"cpu": ("default", PREEMPT)}, engine)
+    assert got == {"wl2"}
+
+
+def test_bwc_preempt_lower_priority_in_other_cq_while_borrowing(engine):
+    cache = build_cache()
+    padmit(cache, wl("a_best_effort_low", priority=-1, cpu=10),
+           "a_best_effort", "default")
+    padmit(cache, wl("b_best_effort_low", priority=-1, cpu=1),
+           "b_best_effort", "default")
+    got = run_case(cache, wl("in", cpu=10), "a_standard",
+                   {"cpu": ("default", PREEMPT)}, engine)
+    assert got == {"a_best_effort_low"}
+
+
+def test_bwc_threshold_blocks_when_still_borrowing_after_preemption(engine):
+    cache = build_cache()
+    padmit(cache, wl("b_standard", priority=1, cpu=10), "b_standard",
+           "default")
+    got = run_case(cache, wl("in", priority=2, cpu=10), "a_standard",
+                   {"cpu": ("default", PREEMPT)}, engine)
+    assert got == set()
+
+
+def test_bwc_above_threshold_ok_when_not_borrowing_after_preemption(engine):
+    cache = build_cache()
+    padmit(cache, wl("b_standard", priority=1, cpu=13), "b_standard",
+           "default")
+    got = run_case(cache, wl("in", priority=2, cpu=1), "a_standard",
+                   {"cpu": ("default", PREEMPT)}, engine)
+    assert got == {"b_standard"}
+
+
+def test_bwc_does_not_apply_within_same_cluster_queue(engine):
+    cache = build_cache()
+    padmit(cache, wl("a_standard", priority=1, cpu=13), "a_standard",
+           "default")
+    got = run_case(cache, wl("in", priority=2, cpu=1), "a_standard",
+                   {"cpu": ("default", PREEMPT)}, engine)
+    assert got == set()
+
+
+def test_reclaim_quota_from_lender(engine):
+    features.set_enabled(features.LENDING_LIMIT, True)
+    cache = build_cache()
+    padmit(cache, wl("lend1-low", priority=-1, cpu=3), "lend1", "default")
+    padmit(cache, wl("lend2-mid", cpu=3), "lend2", "default")
+    padmit(cache, wl("lend2-high", priority=1, cpu=4), "lend2", "default")
+    got = run_case(cache, wl("in", priority=1, cpu=3), "lend1",
+                   {"cpu": ("default", PREEMPT)}, engine)
+    assert got == {"lend2-mid"}
+
+
+def test_preempt_from_all_cluster_queues_in_cohort_lend(engine):
+    features.set_enabled(features.LENDING_LIMIT, True)
+    cache = build_cache()
+    padmit(cache, wl("lend1-low", priority=-1, cpu=3), "lend1", "default")
+    padmit(cache, wl("lend1-mid", cpu=2), "lend1", "default")
+    padmit(cache, wl("lend2-low", priority=-1, cpu=3), "lend2", "default")
+    padmit(cache, wl("lend2-mid", cpu=4), "lend2", "default")
+    got = run_case(cache, wl("in", cpu=4), "lend1",
+                   {"cpu": ("default", PREEMPT)}, engine)
+    assert got == {"lend1-low", "lend2-low"}
+
+
+def test_cannot_preempt_beyond_lending_limited_requestable_quota(engine):
+    features.set_enabled(features.LENDING_LIMIT, True)
+    cache = build_cache()
+    padmit(cache, wl("lend2-low", priority=-1, cpu=10), "lend2", "default")
+    got = run_case(cache, wl("in", cpu=9), "lend1",
+                   {"cpu": ("default", PREEMPT)}, engine)
+    assert got == set()
