@@ -209,7 +209,9 @@ def decode_admission_check(doc: Mapping[str, Any]) -> AdmissionCheck:
 
 def decode_workload(doc: Mapping[str, Any]) -> Workload:
     name, namespace = _meta(doc)
-    labels = dict((doc.get("metadata") or {}).get("labels") or {})
+    metadata = doc.get("metadata") or {}
+    labels = dict(metadata.get("labels") or {})
+    annotations = dict(metadata.get("annotations") or {})
     spec = doc.get("spec") or {}
     pod_sets = []
     for ps in spec.get("podSets") or ():
@@ -229,6 +231,7 @@ def decode_workload(doc: Mapping[str, Any]) -> Workload:
         name=name, namespace=namespace,
         queue_name=spec.get("queueName", ""),
         labels=labels,
+        annotations=annotations,
         pod_sets=pod_sets,
         priority=int(spec.get("priority", 0)),
         priority_class=spec.get("priorityClassName", ""),

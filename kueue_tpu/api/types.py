@@ -490,6 +490,9 @@ class Workload:
     queue_name: str = ""  # LocalQueue name
     # metadata.labels analog (e.g. the MultiKueue origin label on mirrors).
     labels: Dict[str, str] = field(default_factory=dict)
+    # metadata.annotations analog (e.g. provreq.kueue.x-k8s.io/* parameters
+    # passed through to ProvisioningRequests).
+    annotations: Dict[str, str] = field(default_factory=dict)
     pod_sets: List[PodSet] = field(default_factory=list)
     priority: int = 0
     priority_class: str = ""

@@ -25,6 +25,7 @@ from kueue_tpu.api.types import (
     ResourceFlavor,
     Workload,
 )
+from kueue_tpu.controllers.provisioning import PROV_REQ_ANNOTATION_PREFIX
 
 
 @dataclass
@@ -68,6 +69,12 @@ class GenericJob(abc.ABC):
     @property
     def namespace(self) -> str:
         return "default"
+
+    @property
+    def annotations(self) -> Dict[str, str]:
+        """Object metadata annotations; provreq.kueue.x-k8s.io/* entries are
+        copied onto the Workload (reconciler.go:808)."""
+        return {}
 
     @property
     @abc.abstractmethod
@@ -189,6 +196,10 @@ class JobReconciler:
             name=f"job-{job.name}",
             namespace=job.namespace,
             queue_name=job.queue_name,
+            # FilterProvReqAnnotations (reconciler.go:808): only the
+            # provisioning-parameter annotations flow onto the Workload.
+            annotations={k: v for k, v in job.annotations.items()
+                         if k.startswith(PROV_REQ_ANNOTATION_PREFIX)},
             pod_sets=list(job.pod_sets()),
             priority=job.priority(),
             priority_class=job.priority_class(),

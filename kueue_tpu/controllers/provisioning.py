@@ -3,21 +3,54 @@
 Counterpart of reference pkg/controller/admissionchecks/provisioning/: for
 every workload with QuotaReserved whose ClusterQueue carries a provisioning
 AdmissionCheck, create a ProvisioningRequest against a capacity provider
-(the cluster-autoscaler analog -- here a pluggable callback that brings up
-TPU slices/nodepools), track its outcome with bounded retries
-(controller.go:793+), flip the check state, and inject the provisioned
-placement into the workload's PodSetUpdates (controller.go:549-560).
+(the cluster-autoscaler analog — here a pluggable callback that brings up
+TPU slices/nodepools), track its outcome with bounded retries and
+exponential backoff (controller.go:220-320,788-806), flip the check state
+(syncCheckStates, controller.go:465-546), and inject the provisioned
+placement into the workload's PodSetUpdates (podSetUpdates,
+controller.go:549-560).
+
+Semantics carried over:
+- managedResources filtering: only pod sets requesting a managed resource
+  need provisioning; when none do, the check is Ready with
+  "the provisioning request is not needed" (reqIsNeeded/requiredPodSets,
+  controller.go:389-417).
+- request naming `<workload>-<check>-<attempt>` with the attempt suffix as
+  the retry counter (GetProvisioningRequestName, controller.go:738-744).
+- retry: a Failed request is retried up to MaxRetries(3) times after an
+  exponential backoff of MinBackoffSeconds(60)*2^(attempt-1) capped at
+  30min; past that the check is Rejected with the failure message. Like the
+  reference snapshot (syncCheckStates sets Pending "Retrying after
+  failure", controller.go:496-507), the workload keeps its quota
+  reservation through the backoff window rather than being evicted.
+- workload annotations `provreq.kueue.x-k8s.io/<param>` are passed into the
+  request parameters (passProvReqParams, controller.go:455-463).
+- an inactive check (no config) reports Pending
+  "the check is not active" (CheckInactiveMessage).
+- Ready checks carry PodSetUpdates annotating each pod set with
+  `cluster-autoscaler.kubernetes.io/consume-provisioning-request`.
+- requests of finished/evicted workloads, and superseded attempts, are
+  garbage-collected (deleteUnusedProvisioningRequests, controller.go:189+).
 """
 
 from __future__ import annotations
 
-import itertools
+import time as _time
 from dataclasses import dataclass, field
-from typing import Callable, Dict, List, Optional
+from typing import Callable, Dict, List, Optional, Tuple
 
 from kueue_tpu.api.types import AdmissionCheckState, Workload
+from kueue_tpu.events import EventRecorder
 
 PROVISIONING_CHECK_CONTROLLER = "kueue.x-k8s.io/provisioning-request"
+PROV_REQ_ANNOTATION_PREFIX = "provreq.kueue.x-k8s.io/"
+CONSUMES_ANNOTATION_KEY = \
+    "cluster-autoscaler.kubernetes.io/consume-provisioning-request"
+CHECK_INACTIVE_MESSAGE = "the check is not active"
+NO_REQUEST_NEEDED = "the provisioning request is not needed"
+MAX_RETRIES = 3
+MIN_BACKOFF_SECONDS = 60
+MAX_BACKOFF_SECONDS = 30 * 60
 
 
 @dataclass
@@ -27,47 +60,95 @@ class ProvisioningRequestConfig:
     name: str
     provisioning_class: str = "queued-provisioning.gke.io"
     parameters: Dict[str, str] = field(default_factory=dict)
-    max_retries: int = 3
+    # Only pod sets requesting one of these resources are provisioned; empty
+    # means all pod sets.
+    managed_resources: Tuple[str, ...] = ()
 
 
 @dataclass
 class ProvisioningRequest:
     name: str
     workload_key: str
+    check_name: str
     provisioning_class: str
     parameters: Dict[str, str]
     pod_sets: List[dict]
     state: str = "Pending"  # Pending | Provisioned | Failed
     attempt: int = 1
+    failure_message: str = ""
+    failed_at: float = 0.0
+    # Provider extension: node placement for the provisioned capacity.
     node_selector: Dict[str, str] = field(default_factory=dict)
+
+
+def backoff_seconds(attempt: int) -> float:
+    """MinBackoffSeconds * 2^(attempt-1), capped (controller.go:788-806)."""
+    d = MIN_BACKOFF_SECONDS
+    for _ in range(1, attempt):
+        d *= 2
+        if d >= MAX_BACKOFF_SECONDS:
+            return MAX_BACKOFF_SECONDS
+    return d
 
 
 class ProvisioningController:
     """Drives check states for provisioning-type AdmissionChecks."""
 
     def __init__(self, framework,
-                 provider: Optional[Callable[[ProvisioningRequest], None]] = None):
+                 provider: Optional[Callable[[ProvisioningRequest], None]] = None,
+                 clock: Callable[[], float] = _time.time,
+                 recorder: Optional[EventRecorder] = None):
         self.fw = framework
         # The capacity provider observes requests and flips their state
         # (cluster-autoscaler analog). Default provider provisions
         # instantly.
         self.provider = provider or self._instant_provider
+        self.clock = clock
+        self.recorder = recorder or getattr(framework, "events", None) \
+            or EventRecorder()
         self.configs: Dict[str, ProvisioningRequestConfig] = {}
         # check name -> config name
         self.checks: Dict[str, str] = {}
+        # request name -> request
         self.requests: Dict[str, ProvisioningRequest] = {}
-        self._seq = itertools.count(1)
 
     @staticmethod
     def _instant_provider(req: ProvisioningRequest) -> None:
         req.state = "Provisioned"
 
     def register_check(self, check_name: str,
-                       config: ProvisioningRequestConfig) -> None:
+                       config: Optional[ProvisioningRequestConfig] = None
+                       ) -> None:
+        """An AdmissionCheck handled by this controller; without a config it
+        is inactive (reports CheckInactiveMessage)."""
+        if config is not None:
+            self.configs[config.name] = config
+            self.checks[check_name] = config.name
+        else:
+            self.checks[check_name] = ""
+
+    def update_config(self, config: ProvisioningRequestConfig) -> None:
         self.configs[config.name] = config
-        self.checks[check_name] = config.name
+
+    # -- naming (controller.go:738-744) -------------------------------------
+
+    @staticmethod
+    def request_name(wl: Workload, check_name: str, attempt: int) -> str:
+        return f"{wl.name}-{check_name}-{attempt}"
+
+    def _latest_request(self, wl: Workload,
+                        check_name: str) -> Optional[ProvisioningRequest]:
+        best = None
+        for req in self.requests.values():
+            if req.workload_key == wl.key and req.check_name == check_name:
+                if best is None or req.attempt > best.attempt:
+                    best = req
+        return best
+
+    # -- reconcile -----------------------------------------------------------
 
     def reconcile(self) -> None:
+        live_keys = set()
         for wl in list(self.fw.workloads.values()):
             if not wl.has_quota_reservation or wl.is_finished or wl.is_evicted:
                 continue
@@ -75,52 +156,141 @@ class ProvisioningController:
                 wl.admission.cluster_queue if wl.admission else "")
             if cq is None:
                 continue
+            live_keys.add(wl.key)
             for check_name in cq.admission_checks:
                 if check_name not in self.checks:
                     continue
                 self._reconcile_check(wl, check_name)
+        # GC requests owned by workloads no longer holding quota
+        # (deleteUnusedProvisioningRequests analog).
+        for name in [n for n, r in self.requests.items()
+                     if r.workload_key not in live_keys]:
+            del self.requests[name]
+
+    def _required_podsets(self, wl: Workload,
+                          config: ProvisioningRequestConfig) -> List[str]:
+        """Pod sets that request a managed resource (controller.go:393-407)."""
+        if not config.managed_resources:
+            return [ps.name for ps in wl.pod_sets]
+        managed = set(config.managed_resources)
+        return [ps.name for ps in wl.pod_sets
+                if managed.intersection(ps.requests)]
+
+    def _set_state(self, wl: Workload, check_name: str, state: str,
+                   message: str, pod_set_updates=None) -> None:
+        prev = wl.admission_check_states.get(check_name)
+        if prev is not None and prev.state == state \
+                and prev.message == message:
+            return
+        wl.admission_check_states[check_name] = AdmissionCheckState(
+            name=check_name, state=state, message=message,
+            pod_set_updates=pod_set_updates)
+        if prev is not None and prev.state != state:
+            self.recorder.event(
+                wl.key, "Normal", "AdmissionCheckUpdated",
+                f"Admission check {check_name} updated state from "
+                f"{prev.state} to {state}" + (
+                    f" with message {message}" if message else ""))
 
     def _reconcile_check(self, wl: Workload, check_name: str) -> None:
-        config = self.configs[self.checks[check_name]]
+        config = self.configs.get(self.checks.get(check_name, ""))
+        if config is None:
+            # Inactive check (controller.go:474-479).
+            self._set_state(wl, check_name, "Pending", CHECK_INACTIVE_MESSAGE)
+            return
+        required = self._required_podsets(wl, config)
         state = wl.admission_check_states.get(check_name)
+        if not required:
+            # No managed resources requested (controller.go:480-486); like
+            # the reference, only a non-Ready state is rewritten, so a Ready
+            # check keeps its PodSetUpdates across config changes.
+            if state is None or state.state != "Ready":
+                self._set_state(wl, check_name, "Ready", NO_REQUEST_NEEDED)
+            return
         if state is not None and state.state in ("Ready", "Rejected"):
             return
-        key = f"{wl.key}/{check_name}"
-        req = self.requests.get(key)
-        if req is None:
-            req = ProvisioningRequest(
-                name=f"prov-{next(self._seq):06d}",
-                workload_key=wl.key,
-                provisioning_class=config.provisioning_class,
-                parameters=dict(config.parameters),
-                pod_sets=[{"name": psa.name, "count": psa.count,
-                           "requests": dict(psa.resource_usage)}
-                          for psa in wl.admission.pod_set_assignments],
-            )
-            self.requests[key] = req
-            wl.admission_check_states[check_name] = AdmissionCheckState(
-                name=check_name, state="Pending",
-                message=f"Created ProvisioningRequest {req.name}")
-        self.provider(req)
-        if req.state == "Provisioned":
-            updates = [{"name": ps["name"],
-                        "nodeSelector": dict(req.node_selector)}
-                       for ps in req.pod_sets]
-            wl.admission_check_states[check_name] = AdmissionCheckState(
-                name=check_name, state="Ready",
-                message=f"ProvisioningRequest {req.name} provisioned",
-                pod_set_updates=updates)
-        elif req.state == "Failed":
-            if req.attempt >= config.max_retries:
-                wl.admission_check_states[check_name] = AdmissionCheckState(
-                    name=check_name, state="Rejected",
-                    message=f"ProvisioningRequest {req.name} failed "
-                            f"after {req.attempt} attempts")
+
+        req = self._latest_request(wl, check_name)
+        should_create = req is None
+        attempt = req.attempt if req is not None else 1
+        if req is not None and req.state == "Failed" \
+                and attempt <= MAX_RETRIES:
+            if self.clock() - req.failed_at >= backoff_seconds(attempt):
+                should_create = True
+                attempt += 1
+        if should_create:
+            req = self._create_request(wl, check_name, config, required,
+                                       attempt)
+
+        # Only in-flight requests are shown to the provider: a recorded
+        # Failed/Provisioned attempt is immutable, so the backoff clock and
+        # the attempt history can't be bypassed by a re-drive.
+        if req.state == "Pending":
+            self.provider(req)
+            if req.state == "Failed" and not req.failed_at:
+                req.failed_at = self.clock()
+
+        # syncCheckStates (controller.go:465-546).
+        if req.state == "Failed":
+            if req.attempt <= MAX_RETRIES:
+                self._set_state(
+                    wl, check_name, "Pending",
+                    f"Retrying after failure: {req.failure_message}")
             else:
-                # Retry with a fresh request (controller.go backoff+retry).
-                req.attempt += 1
-                req.state = "Pending"
-                wl.admission_check_states[check_name] = AdmissionCheckState(
-                    name=check_name, state="Retry",
-                    message=f"ProvisioningRequest {req.name} failed; "
-                            f"attempt {req.attempt}")
+                self._set_state(wl, check_name, "Rejected",
+                                req.failure_message)
+        elif req.state == "Provisioned":
+            updates = []
+            for ps in req.pod_sets:
+                update = {"name": ps["name"],
+                          "annotations": {CONSUMES_ANNOTATION_KEY: req.name}}
+                if req.node_selector:
+                    update["nodeSelector"] = dict(req.node_selector)
+                updates.append(update)
+            self._set_state(
+                wl, check_name, "Ready",
+                f"ProvisioningRequest {req.name} provisioned",
+                pod_set_updates=updates)
+        else:
+            self._set_state(wl, check_name, "Pending",
+                            f"Waiting for ProvisioningRequest {req.name}")
+
+    def _create_request(self, wl: Workload, check_name: str,
+                        config: ProvisioningRequestConfig,
+                        required: List[str],
+                        attempt: int) -> ProvisioningRequest:
+        parameters = dict(config.parameters)
+        # passProvReqParams (controller.go:455-463).
+        for key, val in wl.annotations.items():
+            if key.startswith(PROV_REQ_ANNOTATION_PREFIX):
+                parameters[key[len(PROV_REQ_ANNOTATION_PREFIX):]] = val
+        psa_by_name = {psa.name: psa
+                       for psa in wl.admission.pod_set_assignments}
+        pod_sets = []
+        for ps in wl.pod_sets:
+            if ps.name not in required:
+                continue
+            psa = psa_by_name.get(ps.name)
+            pod_sets.append({
+                "name": ps.name,
+                "count": psa.count if psa is not None else ps.count,
+                "requests": dict(psa.resource_usage) if psa is not None
+                else dict(ps.requests),
+            })
+        # Superseded attempts are deleted, keeping only the active/last
+        # request per (workload, check) — deleteUnusedProvisioningRequests
+        # (controller.go:189-215).
+        for old in [n for n, r in self.requests.items()
+                    if r.workload_key == wl.key
+                    and r.check_name == check_name]:
+            del self.requests[old]
+        name = self.request_name(wl, check_name, attempt)
+        req = ProvisioningRequest(
+            name=name, workload_key=wl.key, check_name=check_name,
+            provisioning_class=config.provisioning_class,
+            parameters=parameters, pod_sets=pod_sets, attempt=attempt)
+        self.requests[name] = req
+        self.recorder.event(
+            wl.key, "Normal", "ProvisioningRequestCreated",
+            f'Created ProvisioningRequest: "{name}"')
+        return req

@@ -26,10 +26,12 @@ class BatchJob(GenericJob):
                  min_parallelism: Optional[int] = None,
                  namespace: str = "default",
                  priority: int = 0,
+                 annotations: Optional[Dict[str, str]] = None,
                  on_run: Optional[Callable[["BatchJob"], None]] = None,
                  **podset_kwargs):
         self._name = name
         self._namespace = namespace
+        self._annotations = dict(annotations or {})
         self._queue_name = queue_name
         self.parallelism = parallelism
         self.original_parallelism = parallelism
@@ -54,6 +56,10 @@ class BatchJob(GenericJob):
     @property
     def namespace(self) -> str:
         return self._namespace
+
+    @property
+    def annotations(self) -> Dict[str, str]:
+        return self._annotations
 
     @property
     def queue_name(self) -> str:

@@ -41,34 +41,131 @@ def test_provisioning_success_admits():
 
 
 def test_provisioning_retry_then_reject():
+    """A Failed request is retried with exponential backoff (fresh request,
+    attempt suffix incremented) up to MaxRetries(3); then the check is
+    Rejected with the failure message and the workload is deactivated
+    (controller.go:240-258,496-513)."""
+    from kueue_tpu.controllers import provisioning as prov_mod
+
     fw = checked_framework()
-    outcomes = iter(["Failed", "Failed"])
+    now = [1000.0]
 
-    def flaky_provider(req):
+    def failing_provider(req):
         if req.state == "Pending":
-            req.state = next(outcomes, "Failed")
+            req.state = "Failed"
+            req.failure_message = "nodes unavailable"
 
-    ctrl = ProvisioningController(fw, provider=flaky_provider)
+    ctrl = ProvisioningController(fw, provider=failing_provider,
+                                  clock=lambda: now[0])
+    ctrl.register_check("prov", ProvisioningRequestConfig(name="p"))
+    wl = make_wl("w", cpu=2)
+    fw.submit(wl)
+    fw.run_until_settled()
+    assert wl.has_quota_reservation
+
+    ctrl.reconcile()  # attempt 1 fails
+    st = wl.admission_check_states["prov"]
+    assert st.state == "Pending"
+    assert "Retrying after failure: nodes unavailable" in st.message
+    assert ctrl._latest_request(wl, "prov").attempt == 1
+
+    # Before the backoff elapses no new attempt is made.
+    now[0] += 10
+    ctrl.reconcile()
+    assert ctrl._latest_request(wl, "prov").attempt == 1
+
+    # Each elapsed backoff yields a fresh request with the next attempt
+    # suffix: 60s, 120s, 240s (MinBackoffSeconds * 2^(attempt-1)).
+    for attempt, backoff in ((2, 60), (3, 120), (4, 240)):
+        now[0] += backoff
+        ctrl.reconcile()
+        req = ctrl._latest_request(wl, "prov")
+        assert req.attempt == attempt
+        assert req.name == f"w-prov-{attempt}"
+
+    # attempt 4 > MaxRetries(3): Rejected with the raw failure message.
+    now[0] += 1000
+    ctrl.reconcile()
+    assert wl.admission_check_states["prov"].state == "Rejected"
+    assert wl.admission_check_states["prov"].message == "nodes unavailable"
+    fw.reconcile()
+    fw.reconcile()
+    assert not wl.active
+    assert prov_mod.backoff_seconds(10) == prov_mod.MAX_BACKOFF_SECONDS
+
+
+def test_provisioning_managed_resources_and_annotations():
+    """Pod sets not requesting a managed resource are excluded; with no
+    relevant pod sets the check is Ready with NoRequestNeeded. Workload
+    provreq.kueue.x-k8s.io/* annotations become request parameters."""
+    from kueue_tpu.api.types import PodSet
+    from kueue_tpu.controllers.provisioning import (
+        CONSUMES_ANNOTATION_KEY,
+        NO_REQUEST_NEEDED,
+    )
+
+    fw = Framework()
+    fw.create_resource_flavor(make_flavor("default"))
+    fw.create_cluster_queue(make_cq(
+        "cq", rg(("cpu", "tpu"), fq("default", cpu=8, tpu=8)),
+        admission_checks=("prov",)))
+    fw.create_local_queue(make_lq("main", cq="cq"))
+    ctrl = ProvisioningController(fw)
     ctrl.register_check("prov", ProvisioningRequestConfig(
-        name="p", max_retries=2))
+        name="p", parameters={"zone": "us-central2"},
+        managed_resources=("tpu",)))
+
+    # No pod set requests "tpu": Ready without a request.
+    wl = make_wl("plain", cpu=2)
+    fw.submit(wl)
+    fw.run_until_settled()
+    ctrl.reconcile()
+    assert wl.admission_check_states["prov"].state == "Ready"
+    assert wl.admission_check_states["prov"].message == NO_REQUEST_NEEDED
+    assert not ctrl.requests
+
+    # Mixed workload: only the tpu pod set lands in the request; annotation
+    # parameters override/extend the config's.
+    wl2 = make_wl("mixed", pod_sets=[
+        PodSet(name="driver", count=1, requests={"cpu": 1000}),
+        PodSet(name="workers", count=2, requests={"cpu": 1000, "tpu": 4}),
+    ])
+    wl2.annotations["provreq.kueue.x-k8s.io/priority"] = "high"
+    fw.submit(wl2)
+    fw.run_until_settled()
+    ctrl.reconcile()
+    (req,) = ctrl.requests.values()
+    assert [ps["name"] for ps in req.pod_sets] == ["workers"]
+    assert req.parameters == {"zone": "us-central2", "priority": "high"}
+    st = wl2.admission_check_states["prov"]
+    assert st.state == "Ready"
+    assert st.pod_set_updates == [
+        {"name": "workers",
+         "annotations": {CONSUMES_ANNOTATION_KEY: "mixed-prov-1"}}]
+
+
+def test_provisioning_inactive_check_and_gc():
+    """A check with no config reports 'the check is not active'; requests of
+    workloads that lost their quota are garbage-collected."""
+    from kueue_tpu.controllers.provisioning import CHECK_INACTIVE_MESSAGE
+
+    fw = checked_framework()
+    ctrl = ProvisioningController(fw)
+    ctrl.register_check("prov")  # no config -> inactive
     wl = make_wl("w", cpu=2)
     fw.submit(wl)
     fw.run_until_settled()
     ctrl.reconcile()
-    assert wl.admission_check_states["prov"].state == "Retry"
-    # Retry evicts and releases quota; the check resets to Pending.
-    fw.reconcile()
-    fw.reconcile()
-    assert not wl.has_quota_reservation
     assert wl.admission_check_states["prov"].state == "Pending"
-    # Re-reserve; second attempt fails and exhausts retries -> Rejected.
-    fw.run_until_settled()
-    assert wl.has_quota_reservation
+    assert wl.admission_check_states["prov"].message == CHECK_INACTIVE_MESSAGE
+
+    ctrl.register_check("prov", ProvisioningRequestConfig(name="p"))
     ctrl.reconcile()
-    assert wl.admission_check_states["prov"].state == "Rejected"
-    fw.reconcile()
-    fw.reconcile()
-    assert not wl.active
+    assert wl.admission_check_states["prov"].state == "Ready"
+    assert len(ctrl.requests) == 1
+    fw.finish(wl)
+    ctrl.reconcile()
+    assert not ctrl.requests
 
 
 def make_worker(name="worker"):
@@ -145,3 +242,27 @@ def test_multikueue_worker_lost_retries():
     manager.reconcile()
     manager.reconcile()
     assert not wl.has_quota_reservation
+
+
+def test_workload_manifest_annotations_reach_provisioning():
+    """provreq.kueue.x-k8s.io/* annotations survive manifest decoding and
+    job->workload construction (reconciler.go:808)."""
+    from kueue_tpu.api.serialization import decode_workload
+    from kueue_tpu.jobs.batch_job import BatchJob
+
+    wl = decode_workload({
+        "apiVersion": "kueue.x-k8s.io/v1beta1", "kind": "Workload",
+        "metadata": {"name": "w", "namespace": "ns", "annotations": {
+            "provreq.kueue.x-k8s.io/priority": "high"}},
+        "spec": {"queueName": "main", "podSets": [
+            {"name": "main", "count": 1}]},
+    })
+    assert wl.annotations == {"provreq.kueue.x-k8s.io/priority": "high"}
+
+    fw = checked_framework()
+    job = BatchJob(name="j", queue_name="main", parallelism=1,
+                   requests={"cpu": 1000},
+                   annotations={"provreq.kueue.x-k8s.io/zone": "z",
+                                "other": "ignored"})
+    jwl = fw.submit_job(job)
+    assert jwl.annotations == {"provreq.kueue.x-k8s.io/zone": "z"}

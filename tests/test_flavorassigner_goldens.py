@@ -14,6 +14,7 @@ from kueue_tpu.api.types import (
     FlavorQuotas,
     MatchExpression,
     PodSet,
+    ResourceFlavor,
     ResourceQuota,
     Taint,
     Toleration,
@@ -53,8 +54,8 @@ def flavors():
         make_flavor("two", type="two"),
         make_flavor("b_one", b_type="one"),
         make_flavor("b_two", b_type="two"),
-        make_flavor("tainted").__class__.make(
-            "tainted", node_taints=[Taint(key="instance", value="spot")]),
+        ResourceFlavor.make("tainted",
+                            node_taints=[Taint(key="instance", value="spot")]),
     ]
 
 
