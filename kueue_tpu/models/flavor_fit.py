@@ -558,11 +558,22 @@ class BatchSolver:
     flavor set.
     """
 
+    _profiler_started = False
+
     def __init__(self):
         self._key = None
         self._enc: Optional[sch.CQEncoding] = None
         self._static: Optional[tuple] = None
         self._usage_enc: Optional[sch.UsageEncoder] = None
+        # Optional XLA profiler hook (SURVEY §5): point TensorBoard at this
+        # port to trace the device solves.
+        port = os.environ.get("KUEUE_XLA_PROFILER_PORT")
+        if port and not BatchSolver._profiler_started:
+            try:
+                jax.profiler.start_server(int(port))
+                BatchSolver._profiler_started = True
+            except Exception:
+                pass
 
     def _encoding_for(self, snapshot: Snapshot) -> sch.CQEncoding:
         key = (
@@ -585,11 +596,22 @@ class BatchSolver:
 
     def solve(self, workloads: Sequence[WorkloadInfo],
               snapshot: Snapshot) -> List[Assignment]:
+        import time as _t
+
+        from kueue_tpu.metrics import REGISTRY
+        phases = REGISTRY.tick_phase_seconds
+        t0 = _t.perf_counter()
         enc = self._encoding_for(snapshot)
         usage = self._usage_enc.refresh(snapshot)
         wt = sch.encode_workloads(workloads, snapshot, enc)
+        t1 = _t.perf_counter()
+        phases.observe("tensorize", value=t1 - t0)
         out = solve_flavor_fit(enc, usage, wt, static=self._static)
-        return decode_assignments(workloads, snapshot, enc, out)
+        t2 = _t.perf_counter()
+        phases.observe("device_solve", value=t2 - t1)
+        assignments = decode_assignments(workloads, snapshot, enc, out)
+        phases.observe("decode", value=_t.perf_counter() - t2)
+        return assignments
 
     # Scheduler admit/forget fast path (see UsageEncoder.apply_delta): keeps
     # the persistent usage tensor in lockstep with cache.assume/forget so the

@@ -105,18 +105,32 @@ class Scheduler:
     # -- one tick -----------------------------------------------------------
 
     def schedule(self, timeout: Optional[float] = 0.0) -> int:
-        """Run one scheduling cycle; returns the number of admissions."""
+        """Run one scheduling cycle; returns the number of admissions.
+
+        Phase timings (snapshot / nominate incl. the device solve / admit /
+        requeue) land in the kueue_tick_phase_seconds histogram — the
+        TPU-build observability addition SURVEY §5 calls for on top of the
+        reference's whole-tick histogram (metrics.go:70-79)."""
         heads = self.queues.heads(timeout=timeout)
         if not heads:
             return 0
         start = self.clock()
+        phases = REGISTRY.tick_phase_seconds
+        t0 = _time.perf_counter()
         snapshot = self.cache.snapshot()
+        t1 = _time.perf_counter()
+        phases.observe("snapshot", value=t1 - t0)
         entries = self._nominate(heads, snapshot)
         entries.sort(key=self._entry_sort_key)
+        t2 = _time.perf_counter()
+        phases.observe("nominate", value=t2 - t1)
         admitted = self._admission_cycle(entries, snapshot)
+        t3 = _time.perf_counter()
+        phases.observe("admit", value=t3 - t2)
         for e in entries:
             if e.status != ASSUMED:
                 self._requeue_and_update(e)
+        phases.observe("requeue", value=_time.perf_counter() - t3)
         self.metrics.admission_attempts += 1
         self.metrics.last_tick_seconds = self.clock() - start
         result = "success" if admitted else "inadmissible"

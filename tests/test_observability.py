@@ -242,3 +242,22 @@ def test_multikueue_gc_interval_and_origin_label():
     clock[0] += 30.0
     ctl.reconcile()
     assert "default/orphan2" not in worker.workloads
+
+
+def test_tick_phase_histogram_observed():
+    """Every tick records snapshot/nominate/admit/requeue phase timings;
+    the batched solver additionally records tensorize/device_solve/decode
+    (SURVEY §5 TPU-build observability additions)."""
+    from kueue_tpu.metrics import REGISTRY
+    from kueue_tpu.models.flavor_fit import BatchSolver
+
+    fw = Framework(batch_solver=BatchSolver())
+    fw.create_resource_flavor(make_flavor("default"))
+    fw.create_cluster_queue(make_cq("cq", rg("cpu", fq("default", cpu=4))))
+    fw.create_local_queue(make_lq("main", cq="cq"))
+    fw.submit(make_wl("w", cpu=1))
+    fw.tick()
+    phases = {labels[0] for labels in REGISTRY.tick_phase_seconds.totals}
+    assert {"snapshot", "nominate", "admit", "requeue",
+            "tensorize", "device_solve", "decode"} <= phases
+    assert "kueue_tick_phase_seconds" in REGISTRY.export_text()

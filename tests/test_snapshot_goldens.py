@@ -1,0 +1,222 @@
+"""Golden snapshot scenarios transliterated from the reference's
+TestSnapshot / TestSnapshotAddRemoveWorkload tables
+(pkg/cache/snapshot_test.go:45-626,628-900): same ClusterQueues, flavors
+and admitted workloads, same expected cohort RequestableResources / Usage
+accumulation (plain and lending-limited) and per-CQ usage — plus the
+add/remove-workload simulation primitive used by preemption."""
+
+from kueue_tpu import features
+from kueue_tpu.api.types import (
+    Admission,
+    FlavorQuotas,
+    PodSet,
+    PodSetAssignment,
+    ResourceQuota,
+    Workload,
+)
+from kueue_tpu.core.cache import Cache
+from kueue_tpu.core.workload import WorkloadInfo
+
+from tests.util import fq, make_cq, make_flavor, rg
+
+GPU = "example.com/gpu"
+Gi = 1024 * 1024 * 1024
+
+
+def wl(name, requests, cq=None, flavors=None, count=1):
+    """A workload; admitted with per-resource flavors when cq is given."""
+    w = Workload(name=name, namespace="", queue_name="",
+                 pod_sets=[PodSet(name="main", count=count,
+                                  requests=dict(requests))],
+                 creation_time=1.0)
+    if cq is not None:
+        w.admission = Admission(
+            cluster_queue=cq,
+            pod_set_assignments=[PodSetAssignment(
+                name="main", flavors=dict(flavors),
+                resource_usage={r: v * count for r, v in requests.items()},
+                count=count)])
+        w.set_condition("QuotaReserved", True)
+        w.set_condition("Admitted", True)
+    return w
+
+
+# snapshot_test.go "independent clusterQueues"
+def test_independent_cluster_queues():
+    cache = Cache()
+    cache.add_or_update_resource_flavor(make_flavor("default"))
+    cache.add_cluster_queue(make_cq("a", rg("cpu", fq("default", cpu=100))))
+    cache.add_cluster_queue(make_cq("b", rg("cpu", fq("default", cpu=100))))
+    cache.add_or_update_workload(
+        wl("alpha", {"cpu": 2000}, cq="a", flavors={"cpu": "default"}))
+    cache.add_or_update_workload(
+        wl("beta", {"cpu": 1000}, cq="b", flavors={"cpu": "default"}))
+    snap = cache.snapshot()
+    assert snap.cluster_queues["a"].cohort is None
+    assert snap.cluster_queues["a"].usage == {"default": {"cpu": 2000}}
+    assert snap.cluster_queues["b"].usage == {"default": {"cpu": 1000}}
+    assert sorted(snap.cluster_queues["a"].workloads) == ["/alpha"]
+
+
+# "inactive clusterQueues" — a CQ with a missing flavor is excluded
+def test_inactive_cluster_queues():
+    cache = Cache()
+    cache.add_cluster_queue(make_cq(
+        "flavor-nonexistent-cq", rg("cpu", fq("nonexistent", cpu=100))))
+    snap = cache.snapshot()
+    assert snap.cluster_queues == {}
+    assert snap.inactive_cluster_queues == {"flavor-nonexistent-cq"}
+
+
+# "cohort": accumulation of requestable resources + usage over members
+def test_cohort_accumulation():
+    cache = Cache()
+    for name, labels in (("demand", {"instance": "demand"}),
+                         ("spot", {"instance": "spot"}), ("default", {})):
+        cache.add_or_update_resource_flavor(make_flavor(name, **labels))
+    cache.add_cluster_queue(make_cq(
+        "a", rg("cpu", fq("demand", cpu=100), fq("spot", cpu=200)),
+        cohort="borrowing"))
+    cache.add_cluster_queue(make_cq(
+        "b", rg("cpu", fq("spot", cpu=100)),
+        rg((GPU,), FlavorQuotas(name="default", resources=(
+            (GPU, ResourceQuota(nominal=50)),))),
+        cohort="borrowing"))
+    cache.add_cluster_queue(make_cq(
+        "c", rg("cpu", fq("default", cpu=100))))
+
+    cache.add_or_update_workload(wl(
+        "alpha", {"cpu": 2000}, count=5, cq="a",
+        flavors={"cpu": "demand"}))
+    cache.add_or_update_workload(wl(
+        "beta", {"cpu": 1000, GPU: 2}, count=5, cq="b",
+        flavors={"cpu": "spot", GPU: "default"}))
+    cache.add_or_update_workload(wl(
+        "gamma", {"cpu": 1000, GPU: 1}, count=5, cq="b",
+        flavors={"cpu": "spot", GPU: "default"}))
+    cache.add_or_update_workload(wl("sigma", {"cpu": 1000}, count=5))
+
+    snap = cache.snapshot()
+    cohort = snap.cluster_queues["a"].cohort
+    assert cohort is snap.cluster_queues["b"].cohort
+    assert cohort.requestable_resources == {
+        "demand": {"cpu": 100_000},
+        "spot": {"cpu": 300_000},
+        "default": {GPU: 50},
+    }
+    assert cohort.usage == {
+        "demand": {"cpu": 10_000},
+        "spot": {"cpu": 10_000},
+        "default": {GPU: 15},
+    }
+    assert snap.cluster_queues["c"].cohort is None
+    # sigma holds no quota: not in any CQ.
+    for cq in snap.cluster_queues.values():
+        assert "/sigma" not in cq.workloads
+
+
+# "lendingLimit with 2 clusterQueues and 2 flavors": requestable counts
+# only the lendable part; cohort usage only the above-guaranteed part
+def test_lending_limit_cohort_accumulation():
+    features.set_enabled(features.LENDING_LIMIT, True)
+    cache = Cache()
+    cache.add_or_update_resource_flavor(make_flavor("arm", arch="arm"))
+    cache.add_or_update_resource_flavor(make_flavor("x86", arch="x86"))
+    for name in ("a", "b"):
+        cache.add_cluster_queue(make_cq(
+            name, rg("cpu", fq("arm", cpu=(10, None, 5)),
+                     fq("x86", cpu=(20, None, 10))),
+            cohort="lending"))
+    cache.add_or_update_workload(wl(
+        "alpha", {"cpu": 2000}, count=5, cq="a", flavors={"cpu": "arm"}))
+    cache.add_or_update_workload(wl(
+        "beta", {"cpu": 1000}, count=5, cq="a", flavors={"cpu": "arm"}))
+    cache.add_or_update_workload(wl(
+        "gamma", {"cpu": 2000}, count=5, cq="a", flavors={"cpu": "x86"}))
+
+    snap = cache.snapshot()
+    a = snap.cluster_queues["a"]
+    # Requestable = sum of lendingLimits (5+5, 10+10).
+    assert a.cohort.requestable_resources == {
+        "arm": {"cpu": 10_000}, "x86": {"cpu": 20_000}}
+    # Cohort usage = max(0, used - guaranteed): arm 15-5=10, x86 10-10=0.
+    assert a.cohort.usage == {"arm": {"cpu": 10_000}, "x86": {"cpu": 0}}
+    assert a.usage == {"arm": {"cpu": 15_000}, "x86": {"cpu": 10_000}}
+    # Guaranteed quota = nominal - lendingLimit (clusterqueue.go:211-229).
+    assert a._guaranteed("arm", "cpu") == 5_000
+    assert a._guaranteed("x86", "cpu") == 10_000
+
+
+def _add_remove_fixture():
+    cache = Cache()
+    for f in ("default", "alpha", "beta"):
+        cache.add_or_update_resource_flavor(make_flavor(f))
+    cache.add_cluster_queue(make_cq(
+        "c1", rg("cpu", fq("default", cpu=6)),
+        rg("memory", fq("alpha", memory="6Gi"), fq("beta", memory="6Gi")),
+        cohort="cohort"))
+    cache.add_cluster_queue(make_cq(
+        "c2", rg("cpu", fq("default", cpu=6)), cohort="cohort"))
+    wls = {
+        "/c1-cpu": wl("c1-cpu", {"cpu": 1000}, cq="c1",
+                      flavors={"cpu": "default"}),
+        "/c1-memory-alpha": wl("c1-memory-alpha", {"memory": Gi}, cq="c1",
+                               flavors={"memory": "alpha"}),
+        "/c1-memory-beta": wl("c1-memory-beta", {"memory": Gi}, cq="c1",
+                              flavors={"memory": "beta"}),
+        "/c2-cpu-1": wl("c2-cpu-1", {"cpu": 1000}, cq="c2",
+                        flavors={"cpu": "default"}),
+        "/c2-cpu-2": wl("c2-cpu-2", {"cpu": 1000}, cq="c2",
+                        flavors={"cpu": "default"}),
+    }
+    for w in wls.values():
+        cache.add_or_update_workload(w)
+    return cache, wls
+
+
+def _usage_state(snap):
+    return ({name: {f: dict(r) for f, r in cq.usage.items()}
+             for name, cq in snap.cluster_queues.items()},
+            {f: dict(r) for f, r in
+             snap.cluster_queues["c1"].cohort.usage.items()})
+
+
+# TestSnapshotAddRemoveWorkload "no-op remove add"
+def test_snapshot_remove_add_roundtrip():
+    cache, wls = _add_remove_fixture()
+    snap = cache.snapshot()
+    initial = _usage_state(snap)
+    for key in ("/c1-cpu", "/c2-cpu-1"):
+        snap.remove_workload(WorkloadInfo(
+            wls[key], cluster_queue=wls[key].admission.cluster_queue))
+    for key in ("/c1-cpu", "/c2-cpu-1"):
+        snap.add_workload(WorkloadInfo(
+            wls[key], cluster_queue=wls[key].admission.cluster_queue))
+    assert _usage_state(snap) == initial
+
+
+# "remove c1-memory-alpha": cohort drops only the alpha usage
+def test_snapshot_remove_one_flavor_usage():
+    cache, wls = _add_remove_fixture()
+    snap = cache.snapshot()
+    w = wls["/c1-memory-alpha"]
+    snap.remove_workload(WorkloadInfo(w, cluster_queue="c1"))
+    assert snap.cluster_queues["c1"].usage["alpha"]["memory"] == 0
+    assert snap.cluster_queues["c1"].usage["beta"]["memory"] == Gi
+    assert snap.cluster_queues["c1"].cohort.usage["alpha"]["memory"] == 0
+    assert snap.cluster_queues["c1"].cohort.usage["beta"]["memory"] == Gi
+
+
+# "remove all"
+def test_snapshot_remove_all():
+    cache, wls = _add_remove_fixture()
+    snap = cache.snapshot()
+    for key, w in wls.items():
+        snap.remove_workload(
+            WorkloadInfo(w, cluster_queue=w.admission.cluster_queue))
+    assert snap.cluster_queues["c1"].usage == {
+        "default": {"cpu": 0}, "alpha": {"memory": 0}, "beta": {"memory": 0}}
+    assert snap.cluster_queues["c2"].usage == {"default": {"cpu": 0}}
+    cohort_usage = snap.cluster_queues["c1"].cohort.usage
+    assert all(v == 0 for res in cohort_usage.values()
+               for v in res.values())
