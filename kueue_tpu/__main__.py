@@ -43,7 +43,7 @@ from kueue_tpu.metrics import REGISTRY
 # reference's informer start ordering guarantees.
 _APPLY_ORDER = [
     KIND_RESOURCE_FLAVOR, KIND_WORKLOAD_PRIORITY_CLASS, KIND_ADMISSION_CHECK,
-    KIND_CLUSTER_QUEUE, KIND_LOCAL_QUEUE, KIND_WORKLOAD, "Job",
+    "Cohort", KIND_CLUSTER_QUEUE, KIND_LOCAL_QUEUE, KIND_WORKLOAD, "Job",
 ]
 
 

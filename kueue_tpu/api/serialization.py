@@ -259,8 +259,24 @@ def decode_batch_job(doc: Mapping[str, Any]):
         requests=requests)
 
 
+def decode_cohort(doc: Mapping[str, Any]):
+    """kueue.x-k8s.io/v1alpha1 Cohort (KEP-79)."""
+    from kueue_tpu.api.types import CohortSpec
+
+    name, _ = _meta(doc)
+    spec = doc.get("spec") or {}
+    groups = tuple(
+        ResourceGroup(
+            covered_resources=tuple(g.get("coveredResources") or ()),
+            flavors=tuple(_flavor_quotas(f) for f in g.get("flavors") or ()))
+        for g in spec.get("resourceGroups") or ())
+    return CohortSpec(name=name, parent=spec.get("parent") or "",
+                      resource_groups=groups)
+
+
 _DECODERS = {
     "ResourceFlavor": decode_resource_flavor,
+    "Cohort": decode_cohort,
     "ClusterQueue": decode_cluster_queue,
     "LocalQueue": decode_local_queue,
     "WorkloadPriorityClass": decode_workload_priority_class,

@@ -287,6 +287,25 @@ class ResourceGroup:
     flavors: Tuple[FlavorQuotas, ...]
 
 
+@dataclass(frozen=True)
+class CohortSpec:
+    """Hierarchical-cohort node (KEP-79, implemented natively from the KEP;
+    the reference snapshot only designs it).
+
+    A Cohort named by `ClusterQueue.cohort` need not have a spec — then it
+    provides no quota, has no parent, and behaves exactly like the flat
+    2-level cohort. With a spec it may carry its own shareable quota
+    (`resource_groups`, nominal shared with the whole subtree), a `parent`
+    forming the tree, and per-(flavor,resource) borrowing/lending limits:
+    borrowingLimit caps how much the whole subtree may borrow from outside
+    it; lendingLimit caps how much the rest of the tree may borrow from the
+    subtree (keps/79-hierarchical-cohorts/README.md "Design Details")."""
+
+    name: str
+    parent: str = ""
+    resource_groups: Tuple[ResourceGroup, ...] = ()
+
+
 @dataclass
 class ClusterQueue:
     name: str

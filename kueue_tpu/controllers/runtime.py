@@ -222,6 +222,25 @@ class Framework:
         self.cache.update_cluster_queue(spec)
         self.queues.update_cluster_queue(spec)
 
+    def create_cohort(self, spec) -> None:
+        """Hierarchical-cohort node (KEP-79): shared quota, limits, parent.
+
+        Structure changes can make parked workloads admissible anywhere in
+        the tree, so all inadmissible workloads are requeued."""
+        errs = webhooks.validate_cohort(spec)
+        if errs:
+            raise webhooks.ValidationError(errs)
+        self.cache.add_or_update_cohort_spec(spec)
+        self.queues.queue_inadmissible_workloads(
+            list(self.queues.cluster_queues))
+
+    update_cohort = create_cohort
+
+    def delete_cohort(self, name: str) -> None:
+        self.cache.delete_cohort_spec(name)
+        self.queues.queue_inadmissible_workloads(
+            list(self.queues.cluster_queues))
+
     def delete_cluster_queue(self, name: str) -> None:
         self.cluster_queue_specs.pop(name, None)
         self.cache.delete_cluster_queue(name)

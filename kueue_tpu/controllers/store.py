@@ -38,6 +38,7 @@ KIND_RESOURCE_FLAVOR = "ResourceFlavor"
 KIND_WORKLOAD = "Workload"
 KIND_WORKLOAD_PRIORITY_CLASS = "WorkloadPriorityClass"
 KIND_ADMISSION_CHECK = "AdmissionCheck"
+KIND_COHORT = "Cohort"
 
 ADDED = "ADDED"
 MODIFIED = "MODIFIED"
@@ -45,7 +46,7 @@ DELETED = "DELETED"
 
 _CLUSTER_SCOPED = {
     KIND_CLUSTER_QUEUE, KIND_RESOURCE_FLAVOR,
-    KIND_WORKLOAD_PRIORITY_CLASS, KIND_ADMISSION_CHECK,
+    KIND_WORKLOAD_PRIORITY_CLASS, KIND_ADMISSION_CHECK, KIND_COHORT,
 }
 
 _VALIDATORS: Dict[str, Tuple[Optional[Callable], Optional[Callable]]] = {
@@ -60,6 +61,8 @@ _VALIDATORS: Dict[str, Tuple[Optional[Callable], Optional[Callable]]] = {
     KIND_ADMISSION_CHECK: (webhooks.validate_admission_check,
                            webhooks.validate_admission_check_update),
     KIND_WORKLOAD_PRIORITY_CLASS: (None, None),
+    KIND_COHORT: (webhooks.validate_cohort,
+                  lambda new, old: webhooks.validate_cohort(new)),
 }
 
 _DEFAULTERS: Dict[str, Callable] = {
@@ -205,6 +208,7 @@ class StoreAdapter:
         store.watch(KIND_LOCAL_QUEUE, self._on_local_queue)
         store.watch(KIND_WORKLOAD_PRIORITY_CLASS, self._on_priority_class)
         store.watch(KIND_ADMISSION_CHECK, self._on_admission_check)
+        store.watch(KIND_COHORT, self._on_cohort)
         store.watch(KIND_WORKLOAD, self._on_workload)
 
     def _on_flavor(self, ev: Event) -> None:
@@ -226,6 +230,12 @@ class StoreAdapter:
             self.fw.update_local_queue(ev.obj)
         else:
             self.fw.delete_local_queue(ev.obj)
+
+    def _on_cohort(self, ev: Event) -> None:
+        if ev.type in (ADDED, MODIFIED):
+            self.fw.create_cohort(ev.obj)
+        else:
+            self.fw.delete_cohort(ev.obj.name)
 
     def _on_priority_class(self, ev: Event) -> None:
         if ev.type in (ADDED, MODIFIED):

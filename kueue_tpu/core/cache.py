@@ -46,17 +46,64 @@ class Cohort:
 
     `requestable_resources` / `usage` are populated only on snapshots
     (reference: pkg/cache/clusterqueue.go:78-90).
+
+    With hierarchical cohorts (KEP-79) a cohort may carry a spec: its own
+    shareable quota, per-(flavor,resource) borrowing/lending limits, and a
+    parent link forming a tree; `parent`/`children` are populated on
+    snapshots. A spec-less cohort is a flat 2-level cohort, byte-identical
+    to the reference's semantics.
     """
 
     __slots__ = ("name", "members", "requestable_resources", "usage",
-                 "allocatable_generation")
+                 "allocatable_generation", "spec", "parent", "children")
 
-    def __init__(self, name: str):
+    def __init__(self, name: str, spec=None):
         self.name = name
         self.members: Set["CachedClusterQueue"] = set()
         self.requestable_resources: FlavorResourceQuantities = {}
         self.usage: FlavorResourceQuantities = {}
         self.allocatable_generation = 0
+        self.spec = spec  # Optional[CohortSpec]
+        self.parent: Optional["Cohort"] = None
+        self.children: List["Cohort"] = []
+
+    # -- hierarchy helpers (KEP-79) -----------------------------------------
+
+    def root(self) -> "Cohort":
+        node = self
+        while node.parent is not None:
+            node = node.parent
+        return node
+
+    def is_hierarchical(self) -> bool:
+        """True when the tree extends beyond a flat 2-level cohort."""
+        node = self.root()
+        return (node is not self or bool(self.children)
+                or (self.spec is not None
+                    and bool(self.spec.resource_groups)))
+
+    def tree_cluster_queues(self) -> List["CachedClusterQueue"]:
+        """All member CQs in the subtree rooted here (preemption and
+        reclaim act across the whole structure)."""
+        out: List["CachedClusterQueue"] = []
+        stack = [self]
+        while stack:
+            node = stack.pop()
+            out.extend(node.members)
+            stack.extend(node.children)
+        return out
+
+    def own_quota(self, flavor: str, resource: str):
+        """The cohort-level ResourceQuota for (flavor, resource), or None."""
+        if self.spec is None:
+            return None
+        for rg in self.spec.resource_groups:
+            if resource not in rg.covered_resources:
+                continue
+            for fq in rg.flavors:
+                if fq.name == flavor:
+                    return fq.resources_dict.get(resource)
+        return None
 
 
 class CachedClusterQueue:
@@ -182,7 +229,11 @@ class CachedClusterQueue:
         return used
 
     def fit_in_cohort(self, q: FlavorResourceQuantities) -> bool:
-        """reference: clusterqueue.go:130-144."""
+        """reference: clusterqueue.go:130-144; hierarchical trees use the
+        KEP-79 T-invariant walk instead of the flat capacity arithmetic."""
+        if self.cohort is not None and self.cohort.is_hierarchical():
+            from kueue_tpu.core.hierarchy import fits_in_hierarchy
+            return fits_in_hierarchy(self, q)
         for flavor, resources in q.items():
             if self.cohort is None or flavor not in self.cohort.requestable_resources:
                 return False
@@ -270,9 +321,30 @@ class Cache:
         self._lock = threading.RLock()
         self.cluster_queues: Dict[str, CachedClusterQueue] = {}
         self.cohorts: Dict[str, Cohort] = {}
+        # Hierarchical-cohort specs (KEP-79); cohorts named only by
+        # ClusterQueue.cohort need no spec and stay flat.
+        self.cohort_specs: Dict[str, "CohortSpec"] = {}
         self.resource_flavors: Dict[str, ResourceFlavor] = {}
         self.local_queues: Dict[str, LocalQueue] = {}
         self.assumed_workloads: Dict[str, str] = {}  # wl key -> cq name
+
+    # -- hierarchical cohorts (KEP-79) --------------------------------------
+
+    def add_or_update_cohort_spec(self, spec) -> None:
+        with self._lock:
+            self.cohort_specs[spec.name] = spec
+            self._invalidate_allocatable()
+
+    def delete_cohort_spec(self, name: str) -> None:
+        with self._lock:
+            if self.cohort_specs.pop(name, None) is not None:
+                self._invalidate_allocatable()
+
+    def _invalidate_allocatable(self) -> None:
+        # Tree structure changed: every flavor-search resume state and
+        # every cached encoding keyed on allocatable generations is stale.
+        for cq in self.cluster_queues.values():
+            cq.allocatable_generation += 1
 
     # -- flavors ------------------------------------------------------------
 

@@ -1,0 +1,152 @@
+"""Hierarchical-cohort feasibility (KEP-79, implemented from the KEP —
+the reference snapshot designs but does not implement it).
+
+The cohort structure is a tree: ClusterQueues are leaves, Cohorts inner
+nodes; a Cohort may carry its own shareable quota and per-(flavor,resource)
+borrowing/lending limits. Admission keeps the balance function
+
+    T(cq, r)     = quota(cq, r) - usage(cq, r)
+    T(cohort, r) = quota(cohort, r)
+                   + sum over children c of min(lendingLimit(c, r), T(c, r))
+
+within bounds: a workload may be admitted iff, after adding its usage,
+`T(x, r) >= -borrowingLimit(x, r)` holds at every node x of the hierarchy
+(keps/79-hierarchical-cohorts/README.md "Design Details"). Only the
+admitting ClusterQueue's ancestor path can change, so the check walks that
+path, propagating the (lending-clamped) delta upward.
+
+A cycle in the tree stops all admissions within the affected structure
+(the snapshot marks its ClusterQueues inactive; see core/snapshot.py).
+
+Lending/borrowing limits at the ClusterQueue level participate in the tree
+math whenever the tree is hierarchical; the flat 2-level path keeps the
+reference's LendingLimit feature-gate semantics untouched.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+from kueue_tpu.core.cache import CachedClusterQueue, Cohort
+
+
+def _cq_quota(cq: CachedClusterQueue, flavor: str, resource: str):
+    rg = cq.rg_by_resource.get(resource)
+    if rg is None:
+        return None
+    for fq in rg.flavors:
+        if fq.name == flavor:
+            return fq.resources_dict.get(resource)
+    return None
+
+
+def _clamp(limit: Optional[int], t: int) -> int:
+    """min(lendingLimit, T); no limit lets the whole balance through."""
+    return t if limit is None else min(limit, t)
+
+
+def _cq_t(cq: CachedClusterQueue, flavor: str, resource: str,
+          ignore_usage: bool) -> Tuple[int, Optional[int]]:
+    """(T, lendingLimit) of a leaf ClusterQueue."""
+    quota = _cq_quota(cq, flavor, resource)
+    if quota is None:
+        return 0, 0  # nothing of this (flavor, resource) to lend
+    used = 0 if ignore_usage else cq.usage.get(flavor, {}).get(resource, 0)
+    return quota.nominal - used, quota.lending_limit
+
+
+def subtree_t(cohort: Cohort, flavor: str, resource: str,
+              ignore_usage: bool = False) -> int:
+    """T(cohort): the balance the subtree can deliver (negative = its
+    debt to the rest of the hierarchy)."""
+    own = cohort.own_quota(flavor, resource)
+    total = own.nominal if own is not None else 0
+    for member in cohort.members:
+        t, lend = _cq_t(member, flavor, resource, ignore_usage)
+        total += _clamp(lend, t)
+    for child in cohort.children:
+        t = subtree_t(child, flavor, resource, ignore_usage)
+        child_own = child.own_quota(flavor, resource)
+        lend = child_own.lending_limit if child_own is not None else None
+        total += _clamp(lend, t)
+    return total
+
+
+def _node_limits(node: Cohort, flavor: str,
+                 resource: str) -> Tuple[Optional[int], Optional[int]]:
+    """(borrowingLimit, lendingLimit) of a cohort node; None = unlimited.
+    A root node's borrowingLimit is always 0 — there is nobody above to
+    borrow from (KEP-79 API comments)."""
+    own = node.own_quota(flavor, resource)
+    blim = own.borrowing_limit if own is not None else None
+    lend = own.lending_limit if own is not None else None
+    if node.parent is None:
+        blim = 0
+    return blim, lend
+
+
+def hierarchical_lack(cq: CachedClusterQueue, flavor: str, resource: str,
+                      val: int, ignore_usage: bool = False) -> int:
+    """Largest T-invariant shortfall along cq's ancestor path after adding
+    `val` of (flavor, resource) to it; 0 means the admission keeps every
+    balance. With ignore_usage the check runs against an empty tree — the
+    ceiling preemptions could ever free (the borrowWithinCohort bound)."""
+    quota = _cq_quota(cq, flavor, resource)
+    nominal = quota.nominal if quota is not None else 0
+    lend = quota.lending_limit if quota is not None else None
+    used = 0 if ignore_usage else cq.usage.get(flavor, {}).get(resource, 0)
+    t_old = nominal - used
+    delta = _clamp(lend, t_old) - _clamp(lend, t_old - val)
+
+    lack = 0
+    node = cq.cohort
+    while node is not None:
+        t = subtree_t(node, flavor, resource, ignore_usage)
+        t_new = t - delta
+        blim, node_lend = _node_limits(node, flavor, resource)
+        if blim is not None and t_new < -blim:
+            lack = max(lack, -blim - t_new)
+        delta = _clamp(node_lend, t) - _clamp(node_lend, t_new)
+        node = node.parent
+    return lack
+
+
+def tree_capacity(root: Cohort) -> dict:
+    """{flavor: {resource: lendable}} of the whole structure — cohort-level
+    nominal quota plus every member ClusterQueue's lendable quota. The
+    fair-sharing denominator (KEP-1714 share value) for hierarchical trees."""
+    out: dict = {}
+
+    def add(flavor, resource, v):
+        out.setdefault(flavor, {})
+        out[flavor][resource] = out[flavor].get(resource, 0) + v
+
+    stack = [root]
+    while stack:
+        node = stack.pop()
+        if node.spec is not None:
+            for rg in node.spec.resource_groups:
+                for fq in rg.flavors:
+                    for rname, quota in fq.resources:
+                        add(fq.name, rname, quota.nominal)
+        for member in node.members:
+            for rg in member.resource_groups:
+                for fq in rg.flavors:
+                    for rname, quota in fq.resources:
+                        add(fq.name, rname,
+                            quota.lending_limit
+                            if quota.lending_limit is not None
+                            else quota.nominal)
+        stack.extend(node.children)
+    return out
+
+
+def fits_in_hierarchy(cq: CachedClusterQueue, usage, *,
+                      ignore_usage: bool = False) -> bool:
+    """All balances hold after adding a {flavor: {resource: val}} map."""
+    for flavor, resources in usage.items():
+        for resource, val in resources.items():
+            if hierarchical_lack(cq, flavor, resource, val,
+                                 ignore_usage=ignore_usage) > 0:
+                return False
+    return True

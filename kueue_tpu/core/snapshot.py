@@ -40,8 +40,11 @@ class Snapshot:
                 snap.inactive_cluster_queues.add(name)
                 continue
             snap.cluster_queues[name] = _snapshot_cq(cq)
+        cohort_copies: Dict[str, Cohort] = {}
         for cohort in cache.cohorts.values():
-            cohort_copy = Cohort(cohort.name)
+            cohort_copy = Cohort(cohort.name,
+                                 spec=cache.cohort_specs.get(cohort.name))
+            cohort_copies[cohort.name] = cohort_copy
             for member in cohort.members:
                 if not member.active():
                     continue
@@ -50,6 +53,8 @@ class Snapshot:
                 cq_copy.cohort = cohort_copy
                 cohort_copy.members.add(cq_copy)
                 cohort_copy.allocatable_generation += cq_copy.allocatable_generation
+        if cache.cohort_specs:
+            _build_hierarchy(snap, cache, cohort_copies)
         return snap
 
     # Preemption simulation primitives (reference: snapshot.go:41-67).
@@ -84,6 +89,61 @@ def _snapshot_cq(cq: CachedClusterQueue) -> CachedClusterQueue:
     cc.has_missing_flavors = cq.has_missing_flavors
     cc.is_stopped = cq.is_stopped
     return cc
+
+
+def _build_hierarchy(snap: "Snapshot", cache: Cache,
+                     nodes: Dict[str, Cohort]) -> None:
+    """Link the cohort tree (KEP-79): create nodes for spec-only cohorts
+    and parent chains, wire parent/children, and deactivate every
+    ClusterQueue in a structure that contains a cycle (the KEP's mandated
+    failure mode: stop all new admissions in the affected tree)."""
+    def get_node(name: str) -> Cohort:
+        node = nodes.get(name)
+        if node is None:
+            node = Cohort(name, spec=cache.cohort_specs.get(name))
+            nodes[name] = node
+        return node
+
+    # Materialize spec cohorts and their parent chains.
+    pending = list(cache.cohort_specs)
+    while pending:
+        name = pending.pop()
+        node = get_node(name)
+        spec = node.spec
+        if spec is not None and spec.parent and spec.parent not in nodes:
+            pending.append(spec.parent)
+            get_node(spec.parent)
+
+    for node in nodes.values():
+        if node.spec is not None and node.spec.parent:
+            parent = nodes[node.spec.parent]
+            node.parent = parent
+            parent.children.append(node)
+
+    # Cycle detection: each node has at most one parent, so walking up with
+    # a visited set finds any rho-shaped structure.
+    broken: set = set()
+    for node in nodes.values():
+        seen = []
+        cur = node
+        while cur is not None and cur.name not in broken:
+            if cur in seen:
+                broken.update(n.name for n in seen)
+                break
+            seen.append(cur)
+            cur = cur.parent
+        else:
+            if cur is not None:  # reached an already-broken node
+                broken.update(n.name for n in seen)
+
+    if broken:
+        for name in broken:
+            for member in list(nodes[name].members):
+                snap.inactive_cluster_queues.add(member.name)
+                del snap.cluster_queues[member.name]
+            nodes[name].members.clear()
+            nodes[name].parent = None
+            nodes[name].children = []
 
 
 def _accumulate(cq: CachedClusterQueue, cohort: Cohort) -> None:

@@ -599,6 +599,20 @@ class BatchSolver:
         import time as _t
 
         from kueue_tpu.metrics import REGISTRY
+
+        if any(cq.cohort is not None and cq.cohort.is_hierarchical()
+               for cq in snapshot.cluster_queues.values()):
+            # Hierarchical cohort trees (KEP-79) need the per-ancestor
+            # T-invariant; the dense kernel models flat cohorts, so these
+            # snapshots solve on the host referee. (Tree-path feasibility
+            # as a device kernel is the planned extension; the scheduler's
+            # semantics are identical either way.)
+            from kueue_tpu.solver.referee import assign_flavors
+            return [assign_flavors(wi,
+                                   snapshot.cluster_queues[wi.cluster_queue],
+                                   snapshot.resource_flavors)
+                    for wi in workloads]
+
         phases = REGISTRY.tick_phase_seconds
         t0 = _t.perf_counter()
         enc = self._encoding_for(snapshot)

@@ -125,7 +125,9 @@ def _find_candidates(wi: WorkloadInfo, ordering: WorkloadOrdering,
     if cq.cohort is not None \
             and cq.preemption.reclaim_within_cohort != PreemptionPolicy.NEVER:
         only_lower_prio = cq.preemption.reclaim_within_cohort != PreemptionPolicy.ANY
-        for cohort_cq in cq.cohort.members:
+        # Reclaim acts across the whole cohort structure — for hierarchical
+        # trees (KEP-79) that is every ClusterQueue under the root.
+        for cohort_cq in cq.cohort.root().tree_cluster_queues():
             if cohort_cq is cq or not _cq_is_borrowing(cohort_cq, res_per_flv):
                 continue
             for cand in cohort_cq.workloads.values():
@@ -271,7 +273,7 @@ def _fair_preemptions(wi: WorkloadInfo, assignment: Assignment,
     reclaim = cq.preemption.reclaim_within_cohort
     if reclaim != PreemptionPolicy.NEVER:
         only_lower = reclaim != PreemptionPolicy.ANY
-        for member in cq.cohort.members:
+        for member in cq.cohort.root().tree_cluster_queues():
             if member is cq:
                 continue
             cands = [c for c in member.workloads.values()
@@ -351,6 +353,7 @@ def _fair_preemptions(wi: WorkloadInfo, assignment: Assignment,
 def _workload_fits(wl_req: FlavorResourceQuantities, cq: CachedClusterQueue,
                    allow_borrowing: bool) -> bool:
     """preemption.go:352-389."""
+    hierarchical = cq.cohort is not None and cq.cohort.is_hierarchical()
     for rg in cq.resource_groups:
         for fq in rg.flavors:
             flv_req = wl_req.get(fq.name)
@@ -368,7 +371,11 @@ def _workload_fits(wl_req: FlavorResourceQuantities, cq: CachedClusterQueue,
                 elif quota.borrowing_limit is not None:
                     if cq_usage.get(rname, 0) + req > quota.nominal + quota.borrowing_limit:
                         return False
-                if cq.cohort is not None:
+                if hierarchical:
+                    from kueue_tpu.core.hierarchy import hierarchical_lack
+                    if hierarchical_lack(cq, fq.name, rname, req) > 0:
+                        return False
+                elif cq.cohort is not None:
                     cohort_used = cq.used_cohort_quota(fq.name, rname)
                     requestable = cq.requestable_cohort_quota(fq.name, rname)
                     if cohort_used + req > requestable:

@@ -262,7 +262,10 @@ class Scheduler:
                 continue
             cq = snapshot.cluster_queues[e.info.cluster_queue]
             if cq.cohort is not None:
-                cohort = cq.cohort.name
+                # Cycle bookkeeping spans the whole structure: for
+                # hierarchical trees (KEP-79) two subtrees share capacity,
+                # so the guard keys on the root (root() is self when flat).
+                cohort = cq.cohort.root().name
                 if _has_common_flavor_resources(
                         cycle_cohorts_usage.get(cohort), e.assignment.usage):
                     total = _common_usage_sum(
@@ -299,13 +302,13 @@ class Scheduler:
                             f". Pending the preemption of {preempted} workload(s)"
                         e.requeue_reason = RequeueReason.PENDING_PREEMPTION
                     if cq.cohort is not None:
-                        cycle_cohorts_skip_preemption.add(cq.cohort.name)
+                        cycle_cohorts_skip_preemption.add(cq.cohort.root().name)
                 continue
             e.status = NOMINATED
             if self._admit(e, cq):
                 admitted += 1
             if cq.cohort is not None:
-                cycle_cohorts_skip_preemption.add(cq.cohort.name)
+                cycle_cohorts_skip_preemption.add(cq.cohort.root().name)
         return admitted
 
     def _issue_preemptions(self, e: Entry, cq: CachedClusterQueue) -> int:

@@ -44,9 +44,15 @@ def dominant_resource_share(cq: CachedClusterQueue,
                 if used > quota.nominal:
                     above[rname] = above.get(rname, 0) + used - quota.nominal
 
-    # Lendable capacity per resource across the cohort.
+    # Lendable capacity per resource across the cohort — for hierarchical
+    # trees (KEP-79), across the whole structure under the root.
     lendable: Dict[str, int] = {}
-    for fname, resources in cq.cohort.requestable_resources.items():
+    if cq.cohort.is_hierarchical():
+        from kueue_tpu.core.hierarchy import tree_capacity
+        requestable = tree_capacity(cq.cohort.root())
+    else:
+        requestable = cq.cohort.requestable_resources
+    for fname, resources in requestable.items():
         for rname, val in resources.items():
             lendable[rname] = lendable.get(rname, 0) + val
 

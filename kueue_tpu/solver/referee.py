@@ -262,11 +262,15 @@ def _should_try_next_flavor(representative_mode: int, fungibility,
 def _fits_resource_quota(cq: CachedClusterQueue, flavor: str, resource: str,
                          val: int, quota) -> Tuple[int, bool, Optional[str]]:
     """Mode for one (flavor, resource) given CQ and cohort state
-    (flavorassigner.go:550-600)."""
+    (flavorassigner.go:550-600). Hierarchical cohort trees (KEP-79) swap
+    the flat cohort-capacity arithmetic for the tree's T-invariant walk
+    (core/hierarchy.py); flat 2-level cohorts keep the reference's exact
+    seat-based math."""
     borrow = False
     used = cq.usage.get(flavor, {}).get(resource, 0)
     nominal = quota.nominal if quota is not None else 0
     borrowing_limit = quota.borrowing_limit if quota is not None else None
+    hierarchical = cq.cohort is not None and cq.cohort.is_hierarchical()
 
     mode = NO_FIT
     if val <= nominal:
@@ -274,9 +278,10 @@ def _fits_resource_quota(cq: CachedClusterQueue, flavor: str, resource: str,
         # are preempted.
         mode = PREEMPT
 
-    cohort_available = nominal
-    if cq.cohort is not None:
-        cohort_available = cq.requestable_cohort_quota(flavor, resource)
+    if not hierarchical:
+        cohort_available = nominal
+        if cq.cohort is not None:
+            cohort_available = cq.requestable_cohort_quota(flavor, resource)
 
     bwc = cq.preemption.borrow_within_cohort
     if (bwc is not None and bwc.policy != BorrowWithinCohortPolicy.NEVER) \
@@ -284,8 +289,14 @@ def _fits_resource_quota(cq: CachedClusterQueue, flavor: str, resource: str,
         # Preemption-with-borrowing can admit beyond nominal quota; fair
         # sharing (KEP-1714) implies it globally, since share-based
         # preemption targets borrowers to make room for borrowing requests.
+        if hierarchical:
+            from kueue_tpu.core.hierarchy import hierarchical_lack
+            could_ever_fit = hierarchical_lack(
+                cq, flavor, resource, val, ignore_usage=True) <= 0
+        else:
+            could_ever_fit = val <= cohort_available
         if (borrowing_limit is None or val <= nominal + borrowing_limit) \
-                and val <= cohort_available:
+                and could_ever_fit:
             mode = PREEMPT
             borrow = val > nominal
 
@@ -293,11 +304,14 @@ def _fits_resource_quota(cq: CachedClusterQueue, flavor: str, resource: str,
         return mode, borrow, (f"borrowing limit for {resource} in flavor "
                               f"{flavor} exceeded")
 
-    cohort_used = used
-    if cq.cohort is not None:
-        cohort_used = cq.used_cohort_quota(flavor, resource)
-
-    lack = cohort_used + val - cohort_available
+    if hierarchical:
+        from kueue_tpu.core.hierarchy import hierarchical_lack
+        lack = hierarchical_lack(cq, flavor, resource, val)
+    else:
+        cohort_used = used
+        if cq.cohort is not None:
+            cohort_used = cq.used_cohort_quota(flavor, resource)
+        lack = cohort_used + val - cohort_available
     if lack <= 0:
         return FIT, used + val > nominal, None
 

@@ -19,6 +19,7 @@ from kueue_tpu.webhooks.validation import (
     validate_admission_check_update,
     validate_cluster_queue,
     validate_cluster_queue_update,
+    validate_cohort,
     validate_local_queue,
     validate_local_queue_update,
     validate_resource_flavor,
@@ -34,6 +35,7 @@ __all__ = [
     "validate_admission_check_update",
     "validate_cluster_queue",
     "validate_cluster_queue_update",
+    "validate_cohort",
     "validate_local_queue",
     "validate_local_queue_update",
     "validate_resource_flavor",

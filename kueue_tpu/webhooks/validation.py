@@ -146,6 +146,30 @@ def validate_cluster_queue_update(new: ClusterQueue,
 # ---------------------------------------------------------------------------
 
 
+def validate_cohort(spec) -> List[str]:
+    """Hierarchical-cohort spec (KEP-79): DNS names, parent != self, and
+    quota sanity at the cohort level."""
+    errs = _name_reference(spec.name, "metadata.name")
+    if spec.parent:
+        errs += _name_reference(spec.parent, "spec.parent")
+        if spec.parent == spec.name:
+            errs.append("spec.parent: a Cohort cannot be its own parent")
+    for gi, rg in enumerate(spec.resource_groups):
+        path = f"spec.resourceGroups[{gi}]"
+        for fi, fq in enumerate(rg.flavors):
+            for rname, quota in fq.resources:
+                qpath = f"{path}.flavors[{fi}].resources[{rname}]"
+                if quota.nominal < 0:
+                    errs.append(f"{qpath}.nominalQuota: must be >= 0")
+                if quota.borrowing_limit is not None \
+                        and quota.borrowing_limit < 0:
+                    errs.append(f"{qpath}.borrowingLimit: must be >= 0")
+                if quota.lending_limit is not None \
+                        and quota.lending_limit < 0:
+                    errs.append(f"{qpath}.lendingLimit: must be >= 0")
+    return errs
+
+
 def validate_workload(wl: Workload) -> List[str]:
     errs: List[str] = []
     variable_count = 0

@@ -1,0 +1,251 @@
+"""Hierarchical cohorts (KEP-79), implemented natively from the KEP.
+
+Covers the KEP's own test plan (keps/79-hierarchical-cohorts "Unit Tests"):
+existing functionality at 2 levels, long-distance borrowing on multi-level
+hierarchies, lending/borrowing limits placed on many levels, preemptions
+across the hierarchy — plus both KEP user stories, cohort-level quota, and
+the cycle failure mode (all admissions in the broken tree stop)."""
+
+import pytest
+
+from kueue_tpu.api.types import ClusterQueuePreemption, CohortSpec
+from kueue_tpu.controllers.runtime import Framework
+from kueue_tpu.core.hierarchy import hierarchical_lack, subtree_t
+from kueue_tpu.models.flavor_fit import BatchSolver
+
+from tests.util import fq, make_cq, make_flavor, make_lq, make_wl, rg
+
+
+def cohort(name, parent="", *groups):
+    return CohortSpec(name=name, parent=parent,
+                      resource_groups=tuple(groups))
+
+
+def framework(batch=False):
+    fw = Framework(batch_solver=BatchSolver() if batch else None)
+    fw.create_resource_flavor(make_flavor("default"))
+    return fw
+
+
+def add_cq(fw, name, cpu, cohort_name, lq=None, borrow=None, lend=None,
+           preemption=None):
+    fw.create_cluster_queue(make_cq(
+        name, rg("cpu", fq("default", cpu=(cpu, borrow, lend))),
+        cohort=cohort_name, preemption=preemption))
+    fw.create_local_queue(make_lq(lq or f"lq-{name}", cq=name))
+
+
+# -- 2-level compatibility ---------------------------------------------------
+
+
+@pytest.mark.parametrize("batch", [False, True], ids=["referee", "batch"])
+def test_flat_two_level_unchanged(batch):
+    """A spec-less cohort stays on the flat code path and behaves exactly
+    as before (borrowing within the cohort, capacity capped)."""
+    fw = framework(batch)
+    add_cq(fw, "a", 4, "co")
+    add_cq(fw, "b", 4, "co")
+    fw.submit(make_wl("w1", "lq-a", cpu=6, creation_time=1.0))  # borrows 2
+    fw.run_until_settled()
+    assert fw.admitted_workloads("a") == ["default/w1"]
+    fw.submit(make_wl("w2", "lq-b", cpu=3, creation_time=2.0))
+    fw.run_until_settled()
+    assert fw.pending_workloads("b") == 1  # 6+3 > 8
+
+
+def test_flat_decisions_identical_under_t_invariant():
+    """On a flat tree the hierarchical T-invariant agrees with the flat
+    capacity check for every reachable state (the 2-level special case of
+    the KEP formula)."""
+    fw = framework()
+    add_cq(fw, "a", 4, "co")
+    add_cq(fw, "b", 4, "co")
+    fw.submit(make_wl("w1", "lq-a", cpu=6, creation_time=1.0))
+    fw.run_until_settled()
+    snap = fw.cache.snapshot()
+    cq_b = snap.cluster_queues["b"]
+    # Flat path objects report no hierarchy...
+    assert not cq_b.cohort.is_hierarchical()
+    # ...but the T math still gives the same verdicts: 2 more cpu fit,
+    # 3 do not (6 used of 8).
+    assert hierarchical_lack(cq_b, "default", "cpu", 2000) == 0
+    assert hierarchical_lack(cq_b, "default", "cpu", 3000) == 1000
+
+
+# -- long-distance borrowing -------------------------------------------------
+
+
+@pytest.mark.parametrize("batch", [False, True], ids=["referee", "batch"])
+def test_long_distance_borrowing(batch):
+    """A ClusterQueue borrows capacity from a sibling subtree two levels
+    away: root -> {left -> cq-a, right -> cq-b}."""
+    fw = framework(batch)
+    fw.create_cohort(cohort("root"))
+    fw.create_cohort(cohort("left", "root"))
+    fw.create_cohort(cohort("right", "root"))
+    add_cq(fw, "a", 2, "left", borrow=100)
+    add_cq(fw, "b", 6, "right")
+    fw.submit(make_wl("big", "lq-a", cpu=8))  # needs 6 borrowed via root
+    fw.run_until_settled()
+    assert fw.admitted_workloads("a") == ["default/big"]
+
+    # The lender's subtree balance went negative nowhere; the borrower's
+    # subtree carries the debt.
+    snap = fw.cache.snapshot()
+    left = snap.cluster_queues["a"].cohort
+    assert left.name == "left"
+    assert subtree_t(left, "default", "cpu") == -6000
+    assert subtree_t(left.root(), "default", "cpu") == 0
+
+
+@pytest.mark.parametrize("batch", [False, True], ids=["referee", "batch"])
+def test_cohort_level_quota_shared_with_subtree(batch):
+    """Nominal quota at a Cohort level has no owning CQ and is shared with
+    the whole subtree (KEP proposal bullet 3)."""
+    fw = framework(batch)
+    fw.create_cohort(cohort("org", "", rg("cpu", fq("default", cpu=10))))
+    add_cq(fw, "a", 0, "org", borrow=100)
+    fw.submit(make_wl("w", "lq-a", cpu=10))
+    fw.run_until_settled()
+    assert fw.admitted_workloads("a") == ["default/w"]
+    fw.submit(make_wl("over", "lq-a", cpu=1))
+    fw.run_until_settled()
+    assert fw.pending_workloads("a") == 1
+
+
+# -- limits at many levels ---------------------------------------------------
+
+
+def test_story1_research_cannot_borrow_production_can():
+    """KEP Story 1: production may borrow research quota, not vice versa —
+    research org's top cohort sets borrowingLimit 0."""
+    fw = framework()
+    fw.create_cohort(cohort("company"))
+    fw.create_cohort(cohort(
+        "research", "company",
+        rg("cpu", fq("default", cpu=(0, 0)))))  # borrowingLimit 0
+    fw.create_cohort(cohort("production", "company"))
+    add_cq(fw, "res-team", 4, "research", borrow=100)
+    add_cq(fw, "prod-team", 4, "production", borrow=100)
+
+    fw.submit(make_wl("prod-big", "lq-prod-team", cpu=8, creation_time=1.0))
+    fw.run_until_settled()
+    assert fw.admitted_workloads("prod-team") == ["default/prod-big"]
+
+    fw.submit(make_wl("res-big", "lq-res-team", cpu=5, creation_time=2.0))
+    fw.run_until_settled()
+    # research subtree may not go negative: 5 > its own 4.
+    assert fw.admitted_workloads("res-team") == []
+
+
+def test_story2_special_queue_borrows_from_sealed_orgs():
+    """KEP Story 2: organizations don't borrow from each other
+    (borrowingLimit 0 at their cohorts), but a special low-priority queue
+    under the top cohort can borrow everyone's unused capacity."""
+    fw = framework()
+    fw.create_cohort(cohort("top"))
+    fw.create_cohort(cohort("org1", "top",
+                            rg("cpu", fq("default", cpu=(0, 0)))))
+    fw.create_cohort(cohort("org2", "top",
+                            rg("cpu", fq("default", cpu=(0, 0)))))
+    add_cq(fw, "team1", 4, "org1", borrow=100)
+    add_cq(fw, "team2", 4, "org2", borrow=100)
+    add_cq(fw, "special", 0, "top", borrow=100)
+
+    fw.submit(make_wl("sp", "lq-special", cpu=8, creation_time=1.0))
+    fw.run_until_settled()
+    assert fw.admitted_workloads("special") == ["default/sp"]
+
+    # team1 can no longer use even its own quota's worth of borrowing
+    # room... but its own nominal is untouched:
+    fw.submit(make_wl("t1", "lq-team1", cpu=4, creation_time=2.0))
+    fw.run_until_settled()
+    assert fw.admitted_workloads("team1") == []  # capacity all consumed
+    # org borrowing seal: even with free capacity, crossing orgs is barred.
+    fw2 = framework()
+    fw2.create_cohort(cohort("top"))
+    fw2.create_cohort(cohort("org1", "top",
+                             rg("cpu", fq("default", cpu=(0, 0)))))
+    fw2.create_cohort(cohort("org2", "top",
+                             rg("cpu", fq("default", cpu=(0, 0)))))
+    add_cq(fw2, "team1", 4, "org1", borrow=100)
+    add_cq(fw2, "team2", 4, "org2", borrow=100)
+    fw2.submit(make_wl("t1", "lq-team1", cpu=6))
+    fw2.run_until_settled()
+    assert fw2.admitted_workloads("team1") == []
+
+
+def test_lending_limit_at_cohort_level():
+    """lendingLimit on a cohort caps what the rest of the tree can take
+    from its subtree."""
+    fw = framework()
+    fw.create_cohort(cohort("root"))
+    fw.create_cohort(cohort(
+        "givers", "root",
+        rg("cpu", fq("default", cpu=(0, None, 2)))))  # lend at most 2
+    fw.create_cohort(cohort("takers", "root"))
+    add_cq(fw, "g", 8, "givers")
+    add_cq(fw, "t", 0, "takers", borrow=100)
+    fw.submit(make_wl("w3", "lq-t", cpu=3))
+    fw.run_until_settled()
+    assert fw.admitted_workloads("t") == []  # 3 > lending cap 2
+    fw.submit(make_wl("w2", "lq-t", cpu=2))
+    fw.run_until_settled()
+    assert fw.admitted_workloads("t") == ["default/w2"]
+
+
+# -- preemption across the hierarchy ----------------------------------------
+
+
+def test_reclaim_across_subtrees():
+    """A ClusterQueue reclaims its nominal quota from a borrower in a
+    different subtree (preemption acts on the whole structure)."""
+    fw = framework()
+    fw.create_cohort(cohort("root"))
+    fw.create_cohort(cohort("left", "root"))
+    fw.create_cohort(cohort("right", "root"))
+    add_cq(fw, "a", 4, "left", borrow=100,
+           preemption=ClusterQueuePreemption(reclaim_within_cohort="Any"))
+    add_cq(fw, "b", 4, "right", borrow=100)
+    fw.submit(make_wl("borrower", "lq-b", cpu=8, creation_time=1.0))
+    fw.run_until_settled()
+    assert fw.admitted_workloads("b") == ["default/borrower"]
+
+    fw.submit(make_wl("reclaimer", "lq-a", cpu=4, creation_time=2.0))
+    fw.run_until_settled()
+    assert fw.admitted_workloads("a") == ["default/reclaimer"]
+    assert fw.admitted_workloads("b") == []
+
+
+# -- cycles ------------------------------------------------------------------
+
+
+def test_cycle_stops_admissions_in_tree():
+    """A parent cycle deactivates every ClusterQueue in the structure;
+    an unrelated tree keeps admitting (KEP Risks and Mitigations)."""
+    fw = framework()
+    fw.create_cohort(cohort("x", "y"))
+    fw.create_cohort(cohort("y", "x"))
+    add_cq(fw, "broken", 4, "x")
+    add_cq(fw, "fine", 4, "healthy")
+    fw.submit(make_wl("w1", "lq-broken", cpu=1))
+    fw.submit(make_wl("w2", "lq-fine", cpu=1))
+    fw.run_until_settled()
+    assert fw.admitted_workloads("broken") == []
+    assert fw.admitted_workloads("fine") == ["default/w2"]
+
+    # Breaking the cycle reactivates the tree.
+    fw.update_cohort(cohort("y", ""))
+    fw.run_until_settled()
+    assert fw.admitted_workloads("broken") == ["default/w1"]
+
+
+def test_self_parent_rejected():
+    import pytest as _pytest
+
+    from kueue_tpu.webhooks.validation import validate_cohort
+    errs = validate_cohort(cohort("a", "a"))
+    assert any("own parent" in e for e in errs)
+    fw = framework()
+    with _pytest.raises(Exception):
+        fw.create_cohort(cohort("a", "a"))
