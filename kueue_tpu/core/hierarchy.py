@@ -56,19 +56,25 @@ def _cq_t(cq: CachedClusterQueue, flavor: str, resource: str,
 
 
 def subtree_t(cohort: Cohort, flavor: str, resource: str,
-              ignore_usage: bool = False) -> int:
+              ignore_usage: bool = False,
+              memo: Optional[dict] = None) -> int:
     """T(cohort): the balance the subtree can deliver (negative = its
-    debt to the rest of the hierarchy)."""
+    debt to the rest of the hierarchy). With `memo`, each node is computed
+    once — callers walking several ancestors share one full-tree pass."""
+    if memo is not None and id(cohort) in memo:
+        return memo[id(cohort)]
     own = cohort.own_quota(flavor, resource)
     total = own.nominal if own is not None else 0
     for member in cohort.members:
         t, lend = _cq_t(member, flavor, resource, ignore_usage)
         total += _clamp(lend, t)
     for child in cohort.children:
-        t = subtree_t(child, flavor, resource, ignore_usage)
+        t = subtree_t(child, flavor, resource, ignore_usage, memo)
         child_own = child.own_quota(flavor, resource)
         lend = child_own.lending_limit if child_own is not None else None
         total += _clamp(lend, t)
+    if memo is not None:
+        memo[id(cohort)] = total
     return total
 
 
@@ -100,8 +106,11 @@ def hierarchical_lack(cq: CachedClusterQueue, flavor: str, resource: str,
 
     lack = 0
     node = cq.cohort
+    # One shared memo: every subtree below the path is walked exactly once
+    # for the whole ancestor loop (an ancestor's T reuses its children's).
+    memo: dict = {}
     while node is not None:
-        t = subtree_t(node, flavor, resource, ignore_usage)
+        t = subtree_t(node, flavor, resource, ignore_usage, memo)
         t_new = t - delta
         blim, node_lend = _node_limits(node, flavor, resource)
         if blim is not None and t_new < -blim:

@@ -54,8 +54,15 @@ def solve_core(
     wl_cq, req, has_req, podset_valid, podset_unsat, elig, resume_slot,
     num_slots: int,
     fungibility_enabled: bool = True,
+    hier=None,
 ):
-    """Returns per-(W,P) assignment tensors; see outputs dict at the end."""
+    """Returns per-(W,P) assignment tensors; see outputs dict at the end.
+
+    `hier` (optional) carries the dense cohort-forest tensors for
+    hierarchical cohorts (KEP-79): per-node T balances are aggregated on
+    device (segment-sum of lending-clamped leaf balances, then one clamped
+    scatter-add per tree level), and each candidate value runs the
+    ancestor-path delta walk of core/hierarchy.py fully vectorized."""
     W = wl_cq.shape[0]
     P = req.shape[1]
     F = nominal.shape[1]
@@ -105,6 +112,52 @@ def solve_core(
     # member: [W,P,G,R] -- resource r belongs to group g and is requested.
     group_has_req = member.any(axis=3)                 # [W,P,G]
 
+    # --- hierarchical cohort forest: per-tick T balances (KEP-79) ---------
+    if hier is not None:
+        (h_own, h_blim, h_lend, h_cq_node, h_cq_lend, h_cq_hier,
+         h_cq_path, h_levels) = hier
+        K2 = h_own.shape[0]
+        D = h_cq_path.shape[1]
+
+        def aggregate_t(t_cq):
+            """[C,F,R] leaf balances -> [K2,F,R] per-node T, bottom-up."""
+            seg = jnp.where(h_cq_node >= 0, h_cq_node, K2)
+            contrib = jnp.minimum(h_cq_lend, t_cq)
+            m = jax.ops.segment_sum(contrib, seg, num_segments=K2 + 1)[:K2]
+            t_node = h_own + m
+            for nodes, parents in h_levels:
+                vals = jnp.minimum(h_lend[nodes], t_node[nodes])
+                t_node = t_node.at[parents].add(vals)
+            return t_node
+
+        T_node = aggregate_t(nominal - usage)
+        T0_node = aggregate_t(nominal)       # empty tree: preemption ceiling
+        tcq_s = gather_fr((nominal - usage)[wl_cq])       # [W,G,S,R]
+        t0cq_s = nom_s
+        cq_lend_s = gather_fr(h_cq_lend[wl_cq])
+        pathW = h_cq_path[wl_cq]                          # [W,D]
+        hier_mask = h_cq_hier[wl_cq][:, None, None, None]
+
+        def hier_ok(t_node, t_old_s, val):
+            """The ancestor-path T-invariant walk, per candidate value."""
+            delta = (jnp.minimum(cq_lend_s, t_old_s)
+                     - jnp.minimum(cq_lend_s, t_old_s - val))
+            ok = jnp.ones(val.shape, dtype=bool)
+            for d in range(D):
+                nodeW = pathW[:, d]
+                valid = (nodeW >= 0)[:, None, None, None]
+                ns_node = jnp.maximum(nodeW, 0)
+                t_n = t_node[ns_node][wix[:, None, None], sf, :]
+                blim_n = h_blim[ns_node][wix[:, None, None], sf, :]
+                lend_n = h_lend[ns_node][wix[:, None, None], sf, :]
+                t_new = t_n - delta
+                ok &= jnp.where(valid, t_new >= -blim_n, True)
+                delta = jnp.where(
+                    valid,
+                    jnp.minimum(lend_n, t_n) - jnp.minimum(lend_n, t_new),
+                    delta)
+            return ok
+
     arangeS = jnp.arange(S)
 
     def podset_step(carry_usage, p):
@@ -128,13 +181,24 @@ def solve_core(
 
         # --- fitsResourceQuota, vectorized (flavorassigner.go:550-600) ---
         mode = jnp.where(val <= nom_s, PREEMPT, NO_FIT)
+        if hier is not None:
+            bwc_cohort_ok = jnp.where(hier_mask,
+                                      hier_ok(T0_node, t0cq_s, val),
+                                      val <= cav_s)
+        else:
+            bwc_cohort_ok = val <= cav_s
         bwc_ok = (bwcW[:, None, None, None]
-                  & (val <= nom_s + blim_s) & (val <= cav_s))
+                  & (val <= nom_s + blim_s) & bwc_cohort_ok)
         mode = jnp.where(bwc_ok, PREEMPT, mode)
         borrow = bwc_ok & (val > nom_s)
         over_blim = used_s + val > nom_s + blim_s
         lack = cus_s + val - cav_s
-        fit = (~over_blim) & (lack <= 0)
+        cohort_fits = lack <= 0
+        if hier is not None:
+            cohort_fits = jnp.where(hier_mask,
+                                    hier_ok(T_node, tcq_s, val),
+                                    cohort_fits)
+        fit = (~over_blim) & cohort_fits
         mode = jnp.where(fit, FIT, mode)
         borrow = jnp.where(fit, used_s + val > nom_s, borrow)
 
@@ -261,7 +325,7 @@ def _solve_kernel_packed(
     nominal, borrow_limit, guaranteed, lendable, cohort_id,
     group_of_resource, slot_flavor, num_flavors,
     bwc_enabled, borrow_policy_is_borrow, preempt_policy_is_preempt,
-    buf, *, num_slots: int, shapes,
+    hier, buf, *, num_slots: int, shapes,
     fungibility_enabled: bool = True,
 ):
     """Transfer-minimal entry: statics live on device across ticks; the
@@ -306,18 +370,30 @@ def _solve_kernel_packed(
         group_of_resource, slot_flavor, num_flavors,
         bwc_enabled, borrow_policy_is_borrow, preempt_policy_is_preempt,
         wl_cq, req, has_req, podset_valid, podset_unsat, elig, resume_slot,
-        num_slots=num_slots, fungibility_enabled=fungibility_enabled)
+        num_slots=num_slots, fungibility_enabled=fungibility_enabled,
+        hier=hier)
 
 
 def device_static(enc: sch.CQEncoding) -> tuple:
     """Move the generation-stable CQ-side tensors to the device once; they
     are reused across ticks (the snapshot-copy avoidance called out in
-    SURVEY §7: incremental re-encoding keyed on allocatable generations)."""
-    return tuple(jnp.asarray(x) for x in (
+    SURVEY §7: incremental re-encoding keyed on allocatable generations).
+    The last element is the hierarchical cohort-forest pytree, or None when
+    every cohort is flat."""
+    base = tuple(jnp.asarray(x) for x in (
         enc.nominal, enc.borrow_limit, enc.guaranteed, enc.lendable,
         enc.cohort_id, enc.group_of_resource, enc.slot_flavor,
         enc.num_flavors, enc.bwc_enabled, enc.borrow_policy_is_borrow,
         enc.preempt_policy_is_preempt))
+    h = enc.hier
+    if h is None:
+        return base + (None,)
+    hier = (jnp.asarray(h.node_own_nominal), jnp.asarray(h.node_blim),
+            jnp.asarray(h.node_lend), jnp.asarray(h.cq_node),
+            jnp.asarray(h.cq_lend), jnp.asarray(h.cq_hier),
+            jnp.asarray(h.cq_path),
+            tuple((jnp.asarray(n), jnp.asarray(p)) for n, p in h.levels))
+    return base + (hier,)
 
 
 def pack_dynamic(usage_cfr: np.ndarray, wl: sch.WorkloadTensors) -> np.ndarray:
@@ -599,19 +675,6 @@ class BatchSolver:
         import time as _t
 
         from kueue_tpu.metrics import REGISTRY
-
-        if any(cq.cohort is not None and cq.cohort.is_hierarchical()
-               for cq in snapshot.cluster_queues.values()):
-            # Hierarchical cohort trees (KEP-79) need the per-ancestor
-            # T-invariant; the dense kernel models flat cohorts, so these
-            # snapshots solve on the host referee. (Tree-path feasibility
-            # as a device kernel is the planned extension; the scheduler's
-            # semantics are identical either way.)
-            from kueue_tpu.solver.referee import assign_flavors
-            return [assign_flavors(wi,
-                                   snapshot.cluster_queues[wi.cluster_queue],
-                                   snapshot.resource_flavors)
-                    for wi in workloads]
 
         phases = REGISTRY.tick_phase_seconds
         t0 = _t.perf_counter()

@@ -51,6 +51,31 @@ PODS_RESOURCE = "pods"
 NO_LIMIT = np.int64(1) << 62
 
 
+@dataclass
+class HierarchyEncoding:
+    """Dense encoding of a hierarchical cohort forest (KEP-79).
+
+    Nodes are every cohort reachable from a member ClusterQueue (including
+    spec-only ancestors). The per-tick T values are computed ON DEVICE from
+    the usage tensor: leaf contributions via one segment-sum, then one
+    clamped scatter-add per tree level (deepest first); the per-workload
+    feasibility is a D-step delta walk along `cq_path`
+    (core/hierarchy.py is the host referee for these semantics).
+    """
+
+    node_names: List[str]
+    node_own_nominal: np.ndarray   # [K2,F,R] i64
+    node_blim: np.ndarray          # [K2,F,R] i64 (NO_LIMIT; 0 at roots)
+    node_lend: np.ndarray          # [K2,F,R] i64 (NO_LIMIT when unset)
+    cq_node: np.ndarray            # [C] i32: direct cohort node, -1 none
+    cq_lend: np.ndarray            # [C,F,R] i64 (NO_LIMIT when unset)
+    cq_hier: np.ndarray            # [C] bool: CQ is in a hierarchical tree
+    cq_path: np.ndarray            # [C,D] i32 ancestor nodes, -1 padded
+    # Per tree level, deepest first: (nodes, parents) index arrays for the
+    # bottom-up T aggregation.
+    levels: List[Tuple[np.ndarray, np.ndarray]]
+
+
 def _pad_pow2(n: int, floor: int = 8) -> int:
     out = floor
     while out < n:
@@ -83,6 +108,8 @@ class CQEncoding:
     preempt_policy_is_preempt: np.ndarray  # [C] bool (whenCanPreempt == Preempt)
     configured: np.ndarray     # [C,F,R] bool: the (flavor,resource) pairs the
     #                            CQ tracks usage for (clusterqueue.go:473-485)
+    # Hierarchical cohort forest (None when every cohort is flat).
+    hier: Optional["HierarchyEncoding"]
 
     num_cohorts: int
     num_groups: int
@@ -259,8 +286,117 @@ def encode_cluster_queues(snapshot: Snapshot) -> CQEncoding:
         borrow_policy_is_borrow=borrow_is_borrow,
         preempt_policy_is_preempt=preempt_is_preempt,
         configured=configured,
+        hier=_encode_hierarchy(snapshot, cq_names, flavor_index,
+                               resource_index, F, R),
         num_cohorts=len(cohort_names), num_groups=G, num_slots=S,
     )
+
+
+def _encode_hierarchy(snapshot: Snapshot, cq_names: List[str],
+                      flavor_index: Dict[str, int],
+                      resource_index: Dict[str, int],
+                      F: int, R: int) -> Optional[HierarchyEncoding]:
+    """Dense cohort-forest encoding; None when every cohort is flat."""
+    cohorts = {}
+    hier_cqs = []
+    roots = {}
+    for name in cq_names:
+        cohort = snapshot.cluster_queues[name].cohort
+        if cohort is None:
+            continue
+        if cohort.is_hierarchical():
+            hier_cqs.append(name)
+        root = cohort.root()
+        roots.setdefault(root.name, root)
+    if not hier_cqs:
+        return None
+    # Whole trees, downward from each root: spec-only subtrees carrying
+    # quota but no member CQs still contribute to the T aggregation.
+    stack = list(roots.values())
+    while stack:
+        node = stack.pop()
+        cohorts.setdefault(node.name, node)
+        stack.extend(node.children)
+
+    node_names = sorted(cohorts)
+    node_index = {n: i for i, n in enumerate(node_names)}
+    K2 = len(node_names)
+    own_nominal = np.zeros((K2, F, R), dtype=np.int64)
+    blim = np.full((K2, F, R), NO_LIMIT, dtype=np.int64)
+    lend = np.full((K2, F, R), NO_LIMIT, dtype=np.int64)
+    depth = np.zeros(K2, dtype=np.int32)
+    parent = np.full(K2, -1, dtype=np.int32)
+    for ni, name in enumerate(node_names):
+        node = cohorts[name]
+        if node.parent is not None:
+            parent[ni] = node_index[node.parent.name]
+        d = 0
+        p = node.parent
+        while p is not None:
+            d += 1
+            p = p.parent
+        depth[ni] = d
+        if node.spec is not None:
+            for rg in node.spec.resource_groups:
+                for fq in rg.flavors:
+                    fi = flavor_index.get(fq.name)
+                    if fi is None:
+                        continue
+                    for rname, quota in fq.resources:
+                        ri = resource_index.get(rname)
+                        if ri is None:
+                            continue
+                        own_nominal[ni, fi, ri] = quota.nominal
+                        if quota.borrowing_limit is not None:
+                            blim[ni, fi, ri] = quota.borrowing_limit
+                        if quota.lending_limit is not None:
+                            lend[ni, fi, ri] = quota.lending_limit
+        if node.parent is None:
+            # A root cannot borrow from anyone above (KEP-79 API comment).
+            blim[ni] = 0
+
+    C = len(cq_names)
+    cq_node = np.full(C, -1, dtype=np.int32)
+    cq_lend = np.full((C, F, R), NO_LIMIT, dtype=np.int64)
+    cq_hier = np.zeros(C, dtype=bool)
+    max_depth = int(depth.max()) + 1
+    cq_path = np.full((C, max_depth), -1, dtype=np.int32)
+    for ci, name in enumerate(cq_names):
+        cq = snapshot.cluster_queues[name]
+        if cq.cohort is None:
+            continue
+        cq_node[ci] = node_index[cq.cohort.name]
+        cq_hier[ci] = cq.cohort.is_hierarchical()
+        node = cq.cohort
+        d = 0
+        while node is not None:
+            cq_path[ci, d] = node_index[node.name]
+            node = node.parent
+            d += 1
+        if not cq_hier[ci]:
+            continue
+        # CQ-level lending limits participate in the tree math whenever the
+        # tree is hierarchical (core/hierarchy.py _cq_t).
+        for rg in cq.resource_groups:
+            for fq in rg.flavors:
+                fi = flavor_index.get(fq.name)
+                if fi is None:
+                    continue
+                for rname, quota in fq.resources:
+                    ri = resource_index.get(rname)
+                    if ri is not None and quota.lending_limit is not None:
+                        cq_lend[ci, fi, ri] = quota.lending_limit
+
+    levels: List[Tuple[np.ndarray, np.ndarray]] = []
+    for d in range(max_depth - 1, 0, -1):
+        nodes = np.nonzero(depth == d)[0].astype(np.int32)
+        if len(nodes):
+            levels.append((nodes, parent[nodes]))
+
+    return HierarchyEncoding(
+        node_names=node_names, node_own_nominal=own_nominal,
+        node_blim=blim, node_lend=lend, cq_node=cq_node, cq_lend=cq_lend,
+        cq_hier=cq_hier, cq_path=cq_path, levels=levels)
 
 
 def encode_usage(snapshot: Snapshot, enc: CQEncoding) -> UsageTensors:

@@ -249,3 +249,91 @@ def test_self_parent_rejected():
     fw = framework()
     with _pytest.raises(Exception):
         fw.create_cohort(cohort("a", "a"))
+
+
+# -- randomized device-vs-referee equivalence --------------------------------
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_hierarchical_device_equivalence(seed):
+    """The device kernel's ancestor-path walk must reproduce the referee's
+    hierarchical decisions exactly on randomized trees (random depths,
+    cohort quotas, limits, usage)."""
+    import random
+
+    from kueue_tpu.core.cache import Cache
+    from kueue_tpu.core.workload import WorkloadInfo
+    from kueue_tpu.solver.referee import assign_flavors
+    from tests.test_cache import admit
+    from tests.test_solver_equivalence import assert_assignment_equal
+
+    rnd = random.Random(seed)
+    cache = Cache()
+    cache.add_or_update_resource_flavor(make_flavor("default"))
+    cache.add_or_update_resource_flavor(make_flavor("spot"))
+
+    # Random forest: root + two mid cohorts (random quota/limits), CQs
+    # attached at random levels.
+    def maybe_limits():
+        return (rnd.choice([0, rnd.randint(0, 8)]),
+                rnd.choice([None, rnd.randint(0, 8)]),
+                rnd.choice([None, rnd.randint(0, 4)]))
+
+    cache.add_or_update_cohort_spec(CohortSpec(name="root"))
+    for mid in ("m1", "m2"):
+        n, b, l = maybe_limits()
+        groups = ()
+        if rnd.random() < 0.7:
+            groups = (rg("cpu", fq("default", cpu=(n, b, l))),)
+        cache.add_or_update_cohort_spec(
+            CohortSpec(name=mid, parent="root", resource_groups=groups))
+
+    num_cqs = 4
+    for i in range(num_cqs):
+        lend = rnd.choice([None, rnd.randint(0, 4)])
+        cache.add_cluster_queue(make_cq(
+            f"cq{i}",
+            rg("cpu", fq("default", cpu=(rnd.randint(0, 8),
+                                         rnd.choice([None, 100]), lend)),
+               fq("spot", cpu=rnd.randint(0, 6))),
+            cohort=rnd.choice(["m1", "m2", "root"])))
+        cache.add_local_queue(make_lq(f"lq{i}", cq=f"cq{i}"))
+
+    for i in range(6):
+        c = rnd.randrange(num_cqs)
+        cache.add_or_update_workload(admit(
+            make_wl(f"adm{i}", f"lq{c}", cpu=rnd.randint(1, 4)),
+            f"cq{c}", rnd.choice(["default", "spot"])))
+
+    snap = cache.snapshot()
+    pending = []
+    for i in range(16):
+        c = rnd.randrange(num_cqs)
+        pending.append(WorkloadInfo(
+            make_wl(f"p{i}", f"lq{c}", cpu=rnd.randint(1, 8)),
+            cluster_queue=f"cq{c}"))
+
+    solver = BatchSolver()
+    got = solver.solve(pending, snap)
+    for i, wi in enumerate(pending):
+        cq = snap.cluster_queues[wi.cluster_queue]
+        want = assign_flavors(
+            WorkloadInfo(wi.obj, cluster_queue=wi.cluster_queue), cq,
+            snap.resource_flavors)
+        assert_assignment_equal(want, got[i], f"seed {seed} wl {i}")
+
+
+@pytest.mark.parametrize("batch", [False, True], ids=["referee", "batch"])
+def test_spec_only_subtree_quota_counts(batch):
+    """A spec-only cohort subtree with quota but no member ClusterQueues
+    still lends its capacity to the rest of the tree — on both solver
+    paths (regression: the device encoding must walk trees downward from
+    the roots, not only up from member CQs)."""
+    fw = framework(batch)
+    fw.create_cohort(cohort("root"))
+    fw.create_cohort(cohort("reserve", "root",
+                            rg("cpu", fq("default", cpu=10))))
+    add_cq(fw, "a", 0, "root", borrow=100)
+    fw.submit(make_wl("w", "lq-a", cpu=10))
+    fw.run_until_settled()
+    assert fw.admitted_workloads("a") == ["default/w"]
