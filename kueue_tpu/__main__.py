@@ -89,6 +89,12 @@ def build_parser() -> argparse.ArgumentParser:
                         help="run exactly N scheduling ticks")
     parser.add_argument("--serve", action="store_true",
                         help="keep running, ticking at --tick-interval")
+    parser.add_argument("--port", type=int, default=None,
+                        help="serve the HTTP API (object store, watch, "
+                        "visibility, /metrics) on this port (0 = ephemeral; "
+                        "prints the bound port to stderr)")
+    parser.add_argument("--host", default="127.0.0.1",
+                        help="bind address for --port")
     parser.add_argument("--tick-interval", type=float, default=0.1,
                         help="seconds between ticks with --serve")
     parser.add_argument("--batch-solver", action="store_true",
@@ -117,6 +123,23 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     fw = Framework(batch_solver=batch_solver, config=cfg)
     store = Store()
     adapter = StoreAdapter(store, fw)
+
+    server = None
+    runtime_lock = None
+    if args.port is not None:
+        import threading
+
+        from kueue_tpu.controllers.visibility import VisibilityServer
+        from kueue_tpu.server import APIServer
+
+        runtime_lock = threading.RLock()
+        server = APIServer(store, fw,
+                           visibility=VisibilityServer(fw.queues),
+                           host=args.host, port=args.port,
+                           runtime_lock=runtime_lock,
+                           sync_status=adapter.sync_status)
+        server.start()
+        print(f"serving HTTP API on {server.url}", file=sys.stderr, flush=True)
 
     dumper = Dumper(fw.cache, fw.queues)
     dumper.listen_for_signal()  # SIGUSR2, like debugger.go:41-48
@@ -158,6 +181,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             elector.step()
             if not elector.is_leader():
                 return 0  # hot standby: reconcile nothing (leader_aware)
+        if runtime_lock is not None:
+            with runtime_lock:
+                return adapter.tick()
         return adapter.tick()
 
     if args.serve:
@@ -192,6 +218,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     }
     print(json.dumps(summary, indent=2 if args.verbosity else None))
 
+    if server is not None:
+        server.stop()
     if args.dump_state:
         print(dumper.dump_json(), file=sys.stderr)
     if args.metrics:

@@ -306,3 +306,272 @@ def load_manifests(path: str) -> List[Tuple[str, object]]:
                 continue
             out.append(decode(doc))
     return out
+
+
+# -- encoding (object model -> manifest documents) ---------------------------
+#
+# The reference serves its objects as JSON from the apiserver; the API
+# server (kueue_tpu/server/) and the MultiKueue HTTP remote need the same
+# wire form, so every kind decodes AND encodes through this module.
+# Encodings round-trip: decode(encode(kind, obj)) reproduces the object.
+
+API_VERSION = "kueue.x-k8s.io/v1beta1"
+
+
+def _quantity(resource: str, value: int):
+    """Canonical integer back to a manifest quantity. cpu is tracked in
+    milliCPU (workload.go:245-296), so it round-trips in suffix form."""
+    return f"{value}m" if resource == "cpu" else value
+
+
+def _encode_requests(requests: Mapping[str, int]) -> Dict[str, Any]:
+    return {r: _quantity(r, v) for r, v in requests.items()}
+
+
+def _encode_tolerations(tols) -> List[Dict[str, Any]]:
+    return [{"key": t.key, "operator": t.operator, "value": t.value,
+             "effect": t.effect} for t in tols]
+
+
+def _encode_match_expressions(exprs) -> List[Dict[str, Any]]:
+    return [{"key": e.key, "operator": e.operator, "values": list(e.values)}
+            for e in exprs]
+
+
+def encode_resource_flavor(rf: ResourceFlavor) -> Dict[str, Any]:
+    return {
+        "apiVersion": API_VERSION, "kind": "ResourceFlavor",
+        "metadata": {"name": rf.name},
+        "spec": {
+            "nodeLabels": dict(rf.node_labels),
+            "nodeTaints": [{"key": t.key, "value": t.value,
+                            "effect": t.effect} for t in rf.node_taints],
+            "tolerations": _encode_tolerations(rf.tolerations),
+        },
+    }
+
+
+def _encode_resource_groups(groups) -> List[Dict[str, Any]]:
+    out = []
+    for g in groups:
+        flavors = []
+        for f in g.flavors:
+            resources = []
+            for rname, q in f.resources:
+                entry: Dict[str, Any] = {
+                    "name": rname, "nominalQuota": _quantity(rname, q.nominal)}
+                if q.borrowing_limit is not None:
+                    entry["borrowingLimit"] = _quantity(rname, q.borrowing_limit)
+                if q.lending_limit is not None:
+                    entry["lendingLimit"] = _quantity(rname, q.lending_limit)
+                resources.append(entry)
+            flavors.append({"name": f.name, "resources": resources})
+        out.append({"coveredResources": list(g.covered_resources),
+                    "flavors": flavors})
+    return out
+
+
+def encode_cluster_queue(cq: ClusterQueue) -> Dict[str, Any]:
+    spec: Dict[str, Any] = {
+        "resourceGroups": _encode_resource_groups(cq.resource_groups),
+        "queueingStrategy": cq.queueing_strategy,
+        "stopPolicy": cq.stop_policy,
+    }
+    if cq.cohort:
+        spec["cohort"] = cq.cohort
+    sel = cq.namespace_selector
+    if sel.match_labels or sel.match_expressions:
+        spec["namespaceSelector"] = {
+            "matchLabels": dict(sel.match_labels),
+            "matchExpressions": _encode_match_expressions(sel.match_expressions),
+        }
+    if cq.admission_checks:
+        spec["admissionChecks"] = list(cq.admission_checks)
+    p = cq.preemption
+    preemption: Dict[str, Any] = {
+        "reclaimWithinCohort": p.reclaim_within_cohort,
+        "withinClusterQueue": p.within_cluster_queue,
+    }
+    if p.borrow_within_cohort is not None:
+        preemption["borrowWithinCohort"] = {
+            "policy": p.borrow_within_cohort.policy,
+            "maxPriorityThreshold": p.borrow_within_cohort.max_priority_threshold,
+        }
+    spec["preemption"] = preemption
+    spec["flavorFungibility"] = {
+        "whenCanBorrow": cq.flavor_fungibility.when_can_borrow,
+        "whenCanPreempt": cq.flavor_fungibility.when_can_preempt,
+    }
+    if cq.fair_sharing is not None:
+        spec["fairSharing"] = {"weight": cq.fair_sharing.weight}
+    return {"apiVersion": API_VERSION, "kind": "ClusterQueue",
+            "metadata": {"name": cq.name}, "spec": spec}
+
+
+def encode_local_queue(lq: LocalQueue) -> Dict[str, Any]:
+    return {"apiVersion": API_VERSION, "kind": "LocalQueue",
+            "metadata": {"name": lq.name, "namespace": lq.namespace},
+            "spec": {"clusterQueue": lq.cluster_queue}}
+
+
+def encode_workload_priority_class(pc: WorkloadPriorityClass) -> Dict[str, Any]:
+    return {"apiVersion": API_VERSION, "kind": "WorkloadPriorityClass",
+            "metadata": {"name": pc.name}, "value": pc.value}
+
+
+def encode_admission_check(ac: AdmissionCheck) -> Dict[str, Any]:
+    spec: Dict[str, Any] = {"controllerName": ac.controller_name}
+    if ac.parameters is not None:
+        spec["parameters"] = {"apiGroup": ac.parameters[0],
+                              "kind": ac.parameters[1],
+                              "name": ac.parameters[2]}
+    return {"apiVersion": API_VERSION, "kind": "AdmissionCheck",
+            "metadata": {"name": ac.name}, "spec": spec}
+
+
+def encode_cohort(cohort) -> Dict[str, Any]:
+    return {"apiVersion": "kueue.x-k8s.io/v1alpha1", "kind": "Cohort",
+            "metadata": {"name": cohort.name},
+            "spec": {"parent": cohort.parent,
+                     "resourceGroups": _encode_resource_groups(
+                         cohort.resource_groups)}}
+
+
+def _encode_pod_set(ps: PodSet) -> Dict[str, Any]:
+    # The per-pod totals ride in a single synthetic container so the
+    # template round-trips through decode_workload's total_requests().
+    spec: Dict[str, Any] = {
+        "containers": [{"name": "main",
+                        "resources": {"requests": _encode_requests(ps.requests)}}],
+    }
+    if ps.node_selector:
+        spec["nodeSelector"] = dict(ps.node_selector)
+    if ps.tolerations:
+        spec["tolerations"] = _encode_tolerations(ps.tolerations)
+    if ps.affinity_terms:
+        spec["affinity"] = {"nodeAffinity": {
+            "requiredDuringSchedulingIgnoredDuringExecution": {
+                "nodeSelectorTerms": [
+                    {"matchExpressions": _encode_match_expressions(term)}
+                    for term in ps.affinity_terms]}}}
+    out: Dict[str, Any] = {"name": ps.name, "count": ps.count,
+                           "template": {"spec": spec}}
+    if ps.min_count is not None:
+        out["minCount"] = ps.min_count
+    return out
+
+
+def _encode_conditions(conditions) -> List[Dict[str, Any]]:
+    return [{"type": c.type, "status": "True" if c.status else "False",
+             "reason": c.reason, "message": c.message,
+             "lastTransitionTime": c.last_transition_time}
+            for c in conditions]
+
+
+def encode_workload_status(wl: Workload) -> Dict[str, Any]:
+    status: Dict[str, Any] = {"conditions": _encode_conditions(wl.conditions)}
+    if wl.admission is not None:
+        status["admission"] = {
+            "clusterQueue": wl.admission.cluster_queue,
+            "podSetAssignments": [
+                {"name": a.name, "flavors": dict(a.flavors),
+                 "resourceUsage": _encode_requests(a.resource_usage),
+                 "count": a.count}
+                for a in wl.admission.pod_set_assignments],
+        }
+    if wl.admission_check_states:
+        status["admissionChecks"] = [
+            {"name": s.name, "state": s.state, "message": s.message,
+             "podSetUpdates": list(s.pod_set_updates)}
+            for s in wl.admission_check_states.values()]
+    if wl.reclaimable_pods:
+        status["reclaimablePods"] = [
+            {"name": n, "count": c} for n, c in wl.reclaimable_pods.items()]
+    if wl.requeue_state is not None:
+        status["requeueState"] = {"count": wl.requeue_state.count,
+                                  "requeueAt": wl.requeue_state.requeue_at}
+    return status
+
+
+def encode_workload(wl: Workload, with_status: bool = True) -> Dict[str, Any]:
+    doc: Dict[str, Any] = {
+        "apiVersion": API_VERSION, "kind": "Workload",
+        "metadata": {"name": wl.name, "namespace": wl.namespace,
+                     "labels": dict(wl.labels),
+                     "annotations": dict(wl.annotations),
+                     "uid": wl.uid,
+                     "creationTimestamp": wl.creation_time},
+        "spec": {"queueName": wl.queue_name,
+                 "podSets": [_encode_pod_set(ps) for ps in wl.pod_sets],
+                 "priority": wl.priority,
+                 "priorityClassName": wl.priority_class,
+                 "active": wl.active},
+    }
+    if with_status:
+        doc["status"] = encode_workload_status(wl)
+    return doc
+
+
+def decode_workload_status(doc: Mapping[str, Any], wl: Workload) -> Workload:
+    """Fold a status stanza back onto a decoded workload (the watch/GET
+    client side of encode_workload_status)."""
+    from kueue_tpu.api.types import (
+        Admission, AdmissionCheckState, Condition, PodSetAssignment,
+        RequeueState)
+
+    status = doc.get("status") or {}
+    wl.conditions = [
+        Condition(type=c["type"], status=c.get("status") == "True",
+                  reason=c.get("reason", ""), message=c.get("message", ""),
+                  last_transition_time=float(c.get("lastTransitionTime", 0)))
+        for c in status.get("conditions") or ()]
+    adm = status.get("admission")
+    if adm is not None:
+        wl.admission = Admission(
+            cluster_queue=adm.get("clusterQueue", ""),
+            pod_set_assignments=[
+                PodSetAssignment(
+                    name=a.get("name", "main"),
+                    flavors=dict(a.get("flavors") or {}),
+                    resource_usage=_requests(a.get("resourceUsage")),
+                    count=int(a.get("count", 0)))
+                for a in adm.get("podSetAssignments") or ()])
+    wl.admission_check_states = {
+        s["name"]: AdmissionCheckState(
+            name=s["name"], state=s.get("state", "Pending"),
+            message=s.get("message", ""),
+            pod_set_updates=list(s.get("podSetUpdates") or ()))
+        for s in status.get("admissionChecks") or ()}
+    wl.reclaimable_pods = {r["name"]: int(r["count"])
+                           for r in status.get("reclaimablePods") or ()}
+    rq = status.get("requeueState")
+    if rq is not None:
+        wl.requeue_state = RequeueState(count=int(rq.get("count", 0)),
+                                        requeue_at=rq.get("requeueAt"))
+    meta = doc.get("metadata") or {}
+    if meta.get("uid"):
+        wl.uid = meta["uid"]
+    if meta.get("creationTimestamp") is not None:
+        try:
+            wl.creation_time = float(meta["creationTimestamp"])
+        except (TypeError, ValueError):
+            pass
+    return wl
+
+
+_ENCODERS = {
+    "ResourceFlavor": encode_resource_flavor,
+    "Cohort": encode_cohort,
+    "ClusterQueue": encode_cluster_queue,
+    "LocalQueue": encode_local_queue,
+    "WorkloadPriorityClass": encode_workload_priority_class,
+    "AdmissionCheck": encode_admission_check,
+    "Workload": encode_workload,
+}
+
+
+def encode(kind: str, obj) -> Dict[str, Any]:
+    """Encode one object back into its manifest document."""
+    if kind not in _ENCODERS:
+        raise DecodeError(f"unsupported kind {kind!r} for encoding")
+    return _ENCODERS[kind](obj)

@@ -24,6 +24,10 @@ from typing import Dict, List, Optional, Tuple
 from kueue_tpu.api.types import AdmissionCheckState, Workload
 
 MULTIKUEUE_CHECK_CONTROLLER = "kueue.x-k8s.io/multikueue"
+# Binds a remote job to its already-mirrored workload instead of creating
+# a second one (the reference's prebuilt-workload jobframework support).
+PREBUILT_WORKLOAD_LABEL = "kueue.x-k8s.io/prebuilt-workload-name"
+QUEUE_NAME_LABEL = "kueue.x-k8s.io/queue-name"
 DEFAULT_WORKER_LOST_TIMEOUT = 15 * 60.0
 DEFAULT_GC_INTERVAL = 60.0
 DEFAULT_ORIGIN = "multikueue"
@@ -66,6 +70,13 @@ class MultiKueueCluster:
     next_reconnect_at: Optional[float] = None
 
 
+class RemoteError(Exception):
+    """A transient remote failure (worker unreachable, timeout, 5xx).
+    Reconcile passes catch it and retry the workload next pass — one bad
+    worker must not crash the manager's tick loop (the reference records
+    per-cluster errors and requeues, multikueuecluster.go:139-188)."""
+
+
 class RemoteClient(abc.ABC):
     """A connection to one worker cluster."""
 
@@ -86,6 +97,16 @@ class RemoteClient(abc.ABC):
     def list_workload_keys(self) -> List[str]:
         """Keys of remote workloads this manager created (GC support)."""
         return []
+
+    # Job-adapter seam (reference: multikueue jobAdapter): create the job
+    # object on the worker next to the mirrored workload, read its status
+    # back. Manifest-shaped so it carries over any transport.
+    def create_job(self, manifest: dict, wl: Workload) -> None:
+        raise NotImplementedError
+
+    def get_job(self, namespace: str, name: str) -> Optional[dict]:
+        """{'ready': int, 'succeeded': int, 'failed': any} or None."""
+        return None
 
 
 class JobAdapter(abc.ABC):
@@ -166,41 +187,73 @@ class InProcessRemote(RemoteClient):
         return sorted(by_label | {k for k in self._created
                                   if k in self.fw.workloads})
 
+    def create_job(self, manifest: dict, wl: Workload) -> None:
+        """Decode the job manifest into this worker's runtime and bind it
+        to the already-mirrored workload (the prebuilt-workload binding the
+        HTTP server does for out-of-process workers)."""
+        from kueue_tpu.api import serialization
+        _, job = serialization.decode(manifest)
+        key = f"{job.namespace}/{job.name}"
+        if key in self.jobs:
+            return
+        self.jobs[key] = job
+        # The remote job reuses the mirrored workload rather than creating
+        # a second one (managed-by semantics, workload.go:232-300).
+        self.fw.job_reconciler.jobs[key] = (job, wl.key)
+
+    def get_job(self, namespace: str, name: str) -> Optional[dict]:
+        remote = self.jobs.get(f"{namespace}/{name}")
+        if remote is None:
+            return None
+        return {"ready": remote.ready_pods, "succeeded": remote.succeeded,
+                "failed": remote.failed}
+
 
 class BatchJobAdapter(JobAdapter):
     """batch/Job adapter (reference: multikueue/batchjob_adapter.go): mirrors
-    a local BatchJob onto the worker and copies remote counters back."""
+    a local BatchJob onto the worker as a batch/v1 manifest and copies
+    remote counters back. Transport-agnostic: works against any
+    RemoteClient implementing the create_job/get_job seam (in-process or
+    HTTP)."""
 
     @staticmethod
     def _job_key(local_job) -> str:
         return f"{local_job.namespace}/{local_job.name}"
 
     def sync_job(self, client: RemoteClient, local_job, wl: Workload) -> None:
-        if not isinstance(client, InProcessRemote):
-            raise NotImplementedError("adapter requires an InProcessRemote")
-        key = self._job_key(local_job)
-        if key in client.jobs:
-            return
-        from kueue_tpu.jobs.batch_job import BatchJob
-        remote = BatchJob(
-            name=local_job.name, queue_name=client.queue_name,
-            parallelism=local_job.original_parallelism,
-            completions=local_job.completions,
-            requests=dict(local_job._requests),
-            namespace=local_job.namespace)
-        client.jobs[key] = remote
-        # The remote job reuses the mirrored workload rather than creating
-        # a second one (managed-by semantics, workload.go:232-300).
-        client.fw.job_reconciler.jobs[key] = (remote, wl.key)
+        from kueue_tpu.api.serialization import _encode_requests
+
+        queue = getattr(client, "queue_name", "main")
+        requests = wl.pod_sets[0].requests if wl.pod_sets else {}
+        manifest = {
+            "apiVersion": "batch/v1", "kind": "Job",
+            "metadata": {
+                "name": local_job.name, "namespace": local_job.namespace,
+                "labels": {
+                    QUEUE_NAME_LABEL: queue,
+                    # Bind to the mirrored workload instead of creating a
+                    # second one (prebuilt-workload semantics).
+                    PREBUILT_WORKLOAD_LABEL: wl.name,
+                },
+            },
+            "spec": {
+                "parallelism": local_job.original_parallelism,
+                "completions": local_job.completions,
+                "template": {"spec": {"containers": [{
+                    "name": "main",
+                    "resources": {"requests": _encode_requests(requests)}}]}},
+            },
+        }
+        client.create_job(manifest, wl)
 
     def copy_status_remote_to_local(self, client: RemoteClient, local_job,
                                     wl: Workload) -> None:
-        remote = getattr(client, "jobs", {}).get(self._job_key(local_job))
-        if remote is None:
+        status = client.get_job(local_job.namespace, local_job.name)
+        if status is None:
             return
-        local_job.ready_pods = remote.ready_pods
-        local_job.succeeded = remote.succeeded
-        local_job.failed = remote.failed
+        local_job.ready_pods = status["ready"]
+        local_job.succeeded = status["succeeded"]
+        local_job.failed = status["failed"]
 
 
 @dataclass
@@ -208,6 +261,10 @@ class _Dispatch:
     created_on: List[str] = field(default_factory=list)
     kept_on: Optional[str] = None
     lost_since: Optional[float] = None
+    # Remote job status is polled (jobs have no watch stream), so throttle
+    # it — otherwise every reconcile pass costs one round-trip per running
+    # job and a slow worker stalls the tick loop.
+    next_job_poll_at: float = 0.0
 
 
 class MultiKueueController:
@@ -347,7 +404,10 @@ class MultiKueueController:
                 continue
             if not wl.has_quota_reservation:
                 continue
-            self._reconcile_workload(wl, now, jobs_by_wl)
+            try:
+                self._reconcile_workload(wl, now, jobs_by_wl)
+            except RemoteError:
+                continue  # transient worker failure; retry next pass
         # GC dispatches whose local workload disappeared (part of the
         # normal reconcile, like wlReconciler's not-found branch) ...
         for key in list(self._dispatches):
@@ -362,9 +422,12 @@ class MultiKueueController:
             for client in self.clusters.values():
                 if not client.connected():
                     continue
-                for key in client.list_workload_keys():
-                    if key not in owned:
-                        client.delete_workload(key)
+                try:
+                    for key in client.list_workload_keys():
+                        if key not in owned:
+                            client.delete_workload(key)
+                except RemoteError:
+                    continue  # next GC sweep retries
 
 
     def _reconcile_workload(self, wl: Workload, now: float,
@@ -428,9 +491,14 @@ class MultiKueueController:
                                         message="Reserving remote lost")
             return
         d.lost_since = None
-        if adapter is not None and local_job is not None:
+        if adapter is not None and local_job is not None \
+                and now >= d.next_job_poll_at:
             # Remote job status flows back while the remote runs
-            # (jobAdapter.CopyStatusRemoteObject).
+            # (jobAdapter.CopyStatusRemoteObject). The poll cadence is the
+            # transport's call: free for in-process workers, throttled for
+            # HTTP ones.
+            d.next_job_poll_at = now + getattr(
+                client, "job_status_poll_interval", 0.0)
             adapter.copy_status_remote_to_local(client, local_job, wl)
         if status["finished"]:
             self.fw.finish(wl)
@@ -443,4 +511,7 @@ class MultiKueueController:
         for name in d.created_on:
             client = self.clusters.get(name)
             if client is not None and client.connected():
-                client.delete_workload(key)
+                try:
+                    client.delete_workload(key)
+                except RemoteError:
+                    pass  # orphan; the periodic GC sweep catches it

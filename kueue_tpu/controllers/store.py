@@ -109,8 +109,16 @@ class Store:
                     callback(Event(ADDED, kind, key, obj,
                                    self._versions[(kind, key)]))
 
+    def unwatch(self, kind: str, callback: Callable[[Event], None]) -> None:
+        """Deregister a watcher (watch-connection teardown)."""
+        with self._lock:
+            try:
+                self._watchers.get(kind, []).remove(callback)
+            except ValueError:
+                pass
+
     def _notify(self, event: Event) -> None:
-        for cb in self._watchers.get(event.kind, []):
+        for cb in list(self._watchers.get(event.kind, [])):
             cb(event)
 
     # -- CRUD (webhooked, like apiserver admission) ------------------------

@@ -1,0 +1,3 @@
+from kueue_tpu.server.api_server import APIServer
+
+__all__ = ["APIServer"]

@@ -1,0 +1,465 @@
+"""HTTP API server: the framework's out-of-process surface.
+
+The reference is a *server*: the apiserver talks to it over HTTPS webhooks,
+it embeds a visibility apiserver serving pending-workload listings
+(pkg/visibility/server.go:49-68), it exposes Prometheus metrics, and
+MultiKueue managers reach worker clusters through their apiservers with
+watches (multikueuecluster.go:73-260). This module is that boundary for
+the TPU-native runtime: one HTTP listener serving
+
+  - the object API (create/get/list/delete + status) for every kueue kind,
+    JSON documents in the same manifest format `api/serialization` decodes,
+    so `kubectl get -o json`-shaped payloads round-trip;
+  - a chunked watch stream (`/apis/.../watch/workloads`) with JSON-lines
+    events — the informer protocol analog, used by the MultiKueue HTTP
+    remote for watch-based mirroring;
+  - batch/v1 Jobs (create + status + finish), so a remote manager can run
+    a job adapter against this process like the reference's jobAdapter
+    drives a worker cluster;
+  - the visibility API (`/apis/visibility.kueue.x-k8s.io/v1alpha1/...`)
+    straight from the queue manager's heap snapshots
+    (pkg/visibility/api/rest/pending_workloads_cq.go:60-91);
+  - Prometheus text `/metrics` and `/healthz`/`/readyz`.
+
+Concurrency: mutating routes and the scheduler tick share one runtime
+lock (the reference's two big RWMutexes, cache.go:73 / manager.go:64, are
+the same discipline); reads of the Store are internally locked.
+"""
+
+from __future__ import annotations
+
+import json
+import queue as queue_mod
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, Optional, Tuple
+from urllib.parse import parse_qs, urlparse
+
+from kueue_tpu.api import serialization
+from kueue_tpu.controllers.store import (
+    DELETED,
+    KIND_ADMISSION_CHECK,
+    KIND_CLUSTER_QUEUE,
+    KIND_COHORT,
+    KIND_LOCAL_QUEUE,
+    KIND_RESOURCE_FLAVOR,
+    KIND_WORKLOAD,
+    KIND_WORKLOAD_PRIORITY_CLASS,
+    Event,
+    Store,
+)
+from kueue_tpu.controllers.multikueue import PREBUILT_WORKLOAD_LABEL
+from kueue_tpu.metrics import REGISTRY
+from kueue_tpu.webhooks import ValidationError
+
+GROUP_PREFIX = "/apis/kueue.x-k8s.io/v1beta1"
+COHORT_PREFIX = "/apis/kueue.x-k8s.io/v1alpha1"
+VISIBILITY_PREFIX = "/apis/visibility.kueue.x-k8s.io/v1alpha1"
+BATCH_PREFIX = "/apis/batch/v1"
+
+# plural resource name <-> kind (the discovery mapping)
+PLURALS: Dict[str, str] = {
+    "clusterqueues": KIND_CLUSTER_QUEUE,
+    "localqueues": KIND_LOCAL_QUEUE,
+    "resourceflavors": KIND_RESOURCE_FLAVOR,
+    "workloads": KIND_WORKLOAD,
+    "workloadpriorityclasses": KIND_WORKLOAD_PRIORITY_CLASS,
+    "admissionchecks": KIND_ADMISSION_CHECK,
+    "cohorts": KIND_COHORT,
+}
+NAMESPACED = {KIND_WORKLOAD, KIND_LOCAL_QUEUE}
+
+
+def _match_label_selector(selector: str, labels: Dict[str, str]) -> bool:
+    """k8s `labelSelector=k=v,k2=v2` equality clauses."""
+    for clause in selector.split(","):
+        clause = clause.strip()
+        if not clause:
+            continue
+        key, _, value = clause.partition("=")
+        if labels.get(key.strip()) != value.strip():
+            return False
+    return True
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    server_version = "kueue-tpu"
+
+    # Set by APIServer via the server object.
+    @property
+    def api(self) -> "APIServer":
+        return self.server.api  # type: ignore[attr-defined]
+
+    def log_message(self, fmt, *args):  # quiet by default
+        if self.api.verbose:
+            super().log_message(fmt, *args)
+
+    # -- helpers -----------------------------------------------------------
+
+    def _send_json(self, obj, code: int = 200) -> None:
+        body = json.dumps(obj).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _send_text(self, text: str, code: int = 200,
+                   content_type: str = "text/plain; charset=utf-8") -> None:
+        body = text.encode()
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _error(self, code: int, message: str) -> None:
+        self._send_json({"kind": "Status", "status": "Failure",
+                         "code": code, "message": message}, code)
+
+    def _read_body(self) -> dict:
+        length = int(self.headers.get("Content-Length", 0))
+        raw = self.rfile.read(length) if length else b"{}"
+        return json.loads(raw or b"{}")
+
+    def _route(self, path: str) -> Optional[Tuple[str, Optional[str], Optional[str]]]:
+        """Resolve an object-API path to (kind, namespace, name)."""
+        for prefix in (GROUP_PREFIX, COHORT_PREFIX):
+            if path.startswith(prefix + "/"):
+                rest = path[len(prefix) + 1:].strip("/")
+                break
+        else:
+            return None
+        parts = [p for p in rest.split("/") if p]
+        ns: Optional[str] = None
+        if parts and parts[0] == "namespaces" and len(parts) >= 3:
+            ns = parts[1]
+            parts = parts[2:]
+        if not parts or parts[0] not in PLURALS:
+            return None
+        kind = PLURALS[parts[0]]
+        name = parts[1] if len(parts) > 1 else None
+        return kind, ns, name
+
+    @staticmethod
+    def _key(kind: str, ns: Optional[str], name: str) -> str:
+        if kind in NAMESPACED:
+            return f"{ns or 'default'}/{name}"
+        return name
+
+    # -- GET ---------------------------------------------------------------
+
+    def do_GET(self) -> None:  # noqa: N802 (stdlib casing)
+        parsed = urlparse(self.path)
+        path, params = parsed.path.rstrip("/"), parse_qs(parsed.query)
+        try:
+            if path in ("/healthz", "/readyz"):
+                self._send_text("ok")
+            elif path == "/metrics":
+                self._send_text(REGISTRY.export_text(),
+                                content_type="text/plain; version=0.0.4")
+            elif path.startswith(VISIBILITY_PREFIX):
+                self._get_visibility(path, params)
+            elif path.startswith(BATCH_PREFIX):
+                self._get_job(path)
+            elif "/watch/" in path:
+                self._watch(path)
+            else:
+                route = self._route(path)
+                if route is None:
+                    self._error(404, f"unknown path {path}")
+                    return
+                kind, ns, name = route
+                if name is None:
+                    self._list(kind, ns, params)
+                else:
+                    # Encode under the runtime lock: the store hands out
+                    # live objects the scheduler tick mutates in place.
+                    with self.api.runtime_lock:
+                        obj = self.api.store.get(
+                            kind, self._key(kind, ns, name))
+                        doc = (None if obj is None
+                               else serialization.encode(kind, obj))
+                    if doc is None:
+                        self._error(404, f"{kind} {name} not found")
+                    else:
+                        self._send_json(doc)
+        except BrokenPipeError:
+            pass
+        except Exception as exc:  # pragma: no cover - defensive
+            self._error(500, f"{type(exc).__name__}: {exc}")
+
+    def _list(self, kind: str, ns: Optional[str], params) -> None:
+        selector = (params.get("labelSelector") or [None])[0]
+        with self.api.runtime_lock:  # live objects; see do_GET
+            objs = self.api.store.list(kind, namespace=ns)
+            if selector:
+                objs = [o for o in objs
+                        if _match_label_selector(selector,
+                                                 getattr(o, "labels", {}))]
+            items = [serialization.encode(kind, o) for o in objs]
+        self._send_json({"kind": f"{kind}List", "items": items})
+
+    def _get_visibility(self, path: str, params) -> None:
+        """GET .../clusterqueues/<cq>/pendingworkloads and
+        .../namespaces/<ns>/localqueues/<lq>/pendingworkloads
+        (pending_workloads_cq.go:60-91)."""
+        rest = [p for p in path[len(VISIBILITY_PREFIX):].split("/") if p]
+        limit = int((params.get("limit") or [1000])[0])
+        offset = int((params.get("offset") or [0])[0])
+        vis = self.api.visibility
+        if vis is None:
+            self._error(503, "visibility not enabled")
+            return
+        if len(rest) == 3 and rest[0] == "clusterqueues" \
+                and rest[2] == "pendingworkloads":
+            with self.api.runtime_lock:  # heap snapshot races ticks
+                infos = vis.pending_workloads_in_cq(rest[1], offset=offset,
+                                                    limit=limit)
+        elif len(rest) == 5 and rest[0] == "namespaces" \
+                and rest[2] == "localqueues" and rest[4] == "pendingworkloads":
+            with self.api.runtime_lock:
+                infos = vis.pending_workloads_in_lq(rest[1], rest[3],
+                                                    offset=offset, limit=limit)
+        else:
+            self._error(404, f"unknown visibility path {path}")
+            return
+        self._send_json({"kind": "PendingWorkloadsSummary", "items": [
+            {"name": i.name, "namespace": i.namespace,
+             "localQueueName": i.local_queue,
+             "priority": i.priority,
+             "positionInClusterQueue": i.position_in_cluster_queue,
+             "positionInLocalQueue": i.position_in_local_queue}
+            for i in infos]})
+
+    def _get_job(self, path: str) -> None:
+        rest = [p for p in path[len(BATCH_PREFIX):].split("/") if p]
+        if len(rest) != 4 or rest[0] != "namespaces" or rest[2] != "jobs":
+            self._error(404, f"unknown path {path}")
+            return
+        ns, name = rest[1], rest[3]
+        with self.api.runtime_lock:
+            entry = self.api.fw.job_reconciler.jobs.get(f"{ns}/{name}")
+            if entry is None:
+                self._error(404, f"job {ns}/{name} not found")
+                return
+            job, wl_key = entry
+            self._send_json({
+                "kind": "Job",
+                "metadata": {"name": name, "namespace": ns},
+                "spec": {"parallelism": getattr(job, "parallelism", None),
+                         "suspend": job.is_suspended()},
+                "status": {"ready": getattr(job, "ready_pods", 0),
+                           "succeeded": getattr(job, "succeeded", 0),
+                           "failed": getattr(job, "failed", 0)},
+                "workloadKey": wl_key})
+
+    def _watch(self, path: str) -> None:
+        """Chunked JSON-lines watch stream (the informer list+watch
+        protocol analog). Replays current objects as ADDED, then streams.
+
+        Events are encoded inside the watcher callback: it fires while the
+        mutator holds the runtime lock, so the object can't be mutated
+        mid-encode by a concurrent scheduler tick."""
+        plural = path.rsplit("/", 1)[-1]
+        kind = PLURALS.get(plural)
+        if kind is None:
+            self._error(404, f"cannot watch {plural}")
+            return
+        lines: "queue_mod.Queue[bytes]" = queue_mod.Queue()
+
+        def on_event(ev: Event) -> None:
+            doc = {"type": ev.type, "resourceVersion": ev.resource_version,
+                   "object": serialization.encode(ev.kind, ev.obj)}
+            lines.put((json.dumps(doc) + "\n").encode())
+
+        with self.api.runtime_lock:  # initial replay races ticks otherwise
+            self.api.store.watch(kind, on_event, send_initial=True)
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Transfer-Encoding", "chunked")
+        self.end_headers()
+
+        def write_chunk(data: bytes) -> None:
+            self.wfile.write(f"{len(data):x}\r\n".encode() + data + b"\r\n")
+            self.wfile.flush()
+
+        try:
+            while not self.api.stopping.is_set():
+                try:
+                    line = lines.get(timeout=1.0)
+                except queue_mod.Empty:
+                    write_chunk(b"\n")  # heartbeat flushes out dead pipes
+                    continue
+                write_chunk(line)
+        except (BrokenPipeError, ConnectionResetError, OSError):
+            pass
+        finally:
+            self.api.store.unwatch(kind, on_event)
+
+    # -- POST / PUT / DELETE ----------------------------------------------
+
+    def do_POST(self) -> None:  # noqa: N802
+        path = urlparse(self.path).path.rstrip("/")
+        try:
+            body = self._read_body()
+        except json.JSONDecodeError as exc:
+            self._error(400, f"invalid JSON: {exc}")
+            return
+        try:
+            if path.startswith(BATCH_PREFIX):
+                self._post_job(path, body)
+                return
+            if path.endswith("/finish"):
+                self._finish_workload(path)
+                return
+            route = self._route(path)
+            if route is None:
+                self._error(404, f"unknown path {path}")
+                return
+            kind, ns, _ = route
+            decoded_kind, obj = serialization.decode(body)
+            if decoded_kind != kind:
+                self._error(400, f"kind mismatch: path says {kind}, "
+                                 f"body says {decoded_kind}")
+                return
+            if kind == KIND_WORKLOAD:
+                serialization.decode_workload_status(body, obj)
+            with self.api.runtime_lock:
+                self.api.store.create(kind, obj)
+            self._send_json(serialization.encode(kind, obj), 201)
+        except ValidationError as exc:
+            self._error(422, str(exc))
+        except serialization.DecodeError as exc:
+            # Before ValueError: DecodeError subclasses it.
+            self._error(400, str(exc))
+        except ValueError as exc:
+            self._error(409, str(exc))
+
+    def _post_job(self, path: str, body: dict) -> None:
+        rest = [p for p in path[len(BATCH_PREFIX):].split("/") if p]
+        # POST /apis/batch/v1/namespaces/<ns>/jobs — create + submit
+        if len(rest) == 3 and rest[0] == "namespaces" and rest[2] == "jobs":
+            body.setdefault("metadata", {}).setdefault("namespace", rest[1])
+            _, job = serialization.decode(body)
+            labels = (body.get("metadata") or {}).get("labels") or {}
+            prebuilt = labels.get(PREBUILT_WORKLOAD_LABEL)
+            with self.api.runtime_lock:
+                if prebuilt:
+                    # Bind to an existing (mirrored) workload instead of
+                    # creating a second one — the reference's
+                    # prebuilt-workload-name jobframework support that
+                    # MultiKueue workers rely on.
+                    wl_key = f"{job.namespace}/{prebuilt}"
+                    if wl_key not in self.api.fw.workloads:
+                        self._error(404, f"prebuilt workload {wl_key} "
+                                         "not found")
+                        return
+                    job_key = f"{job.namespace}/{job.name}"
+                    self.api.fw.job_reconciler.jobs.setdefault(
+                        job_key, (job, wl_key))
+                else:
+                    self.api.fw.submit_job(job)
+            self._send_json({"kind": "Job", "metadata": {
+                "name": job.name, "namespace": job.namespace}}, 201)
+            return
+        # POST .../jobs/<name>/complete — the remote job ran to completion
+        # (the analog of the worker cluster's kubelet finishing the pods).
+        if len(rest) == 5 and rest[0] == "namespaces" and rest[2] == "jobs" \
+                and rest[4] == "complete":
+            ns, name = rest[1], rest[3]
+            with self.api.runtime_lock:
+                entry = self.api.fw.job_reconciler.jobs.get(f"{ns}/{name}")
+                if entry is None:
+                    self._error(404, f"job {ns}/{name} not found")
+                    return
+                job, wl_key = entry
+                job.succeeded = getattr(job, "completions", 1)
+                wl = self.api.fw.workloads.get(wl_key)
+                if wl is not None:
+                    self.api.fw.finish(wl)
+                self.api.sync_status()
+            self._send_json({"status": "Success"})
+            return
+        self._error(404, f"unknown path {path}")
+
+    def _finish_workload(self, path: str) -> None:
+        """POST .../workloads/<name>/finish — mark the workload Finished
+        (the status write a worker cluster's own controllers would make)."""
+        route = self._route(path[: -len("/finish")])
+        if route is None or route[0] != KIND_WORKLOAD or route[2] is None:
+            self._error(404, f"unknown path {path}")
+            return
+        kind, ns, name = route
+        with self.api.runtime_lock:
+            wl = self.api.fw.workloads.get(self._key(kind, ns, name))
+            if wl is None:
+                self._error(404, f"workload {name} not found")
+                return
+            self.api.fw.finish(wl)
+            self.api.sync_status()
+        self._send_json({"status": "Success"})
+
+    def do_DELETE(self) -> None:  # noqa: N802
+        path = urlparse(self.path).path.rstrip("/")
+        route = self._route(path)
+        if route is None or route[2] is None:
+            self._error(404, f"unknown path {path}")
+            return
+        kind, ns, name = route
+        with self.api.runtime_lock:
+            obj = self.api.store.delete(kind, self._key(kind, ns, name))
+        if obj is None:
+            self._error(404, f"{kind} {name} not found")
+        else:
+            self._send_json({"status": "Success"})
+
+
+class APIServer:
+    """Thread-hosted HTTP server wrapping a Store + Framework."""
+
+    def __init__(self, store: Store, framework, visibility=None,
+                 host: str = "127.0.0.1", port: int = 0,
+                 runtime_lock: Optional[threading.RLock] = None,
+                 sync_status=None, verbose: bool = False):
+        self.store = store
+        self.fw = framework
+        self.visibility = visibility
+        self.runtime_lock = runtime_lock or threading.RLock()
+        self.verbose = verbose
+        self.stopping = threading.Event()
+        # Publishes workload status to the store after mutations so GET
+        # reflects the runtime's view (StoreAdapter.sync_status).
+        self._sync_status = sync_status
+        self._httpd = ThreadingHTTPServer((host, port), _Handler)
+        self._httpd.daemon_threads = True
+        self._httpd.api = self  # type: ignore[attr-defined]
+        self._thread: Optional[threading.Thread] = None
+
+    def sync_status(self) -> None:
+        if self._sync_status is not None:
+            self._sync_status()
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    @property
+    def url(self) -> str:
+        host, port = self._httpd.server_address[:2]
+        return f"http://{host}:{port}"
+
+    def start(self) -> "APIServer":
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        kwargs={"poll_interval": 0.1},
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self.stopping.set()
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)

@@ -174,6 +174,10 @@ def validate_workload(wl: Workload) -> List[str]:
     errs: List[str] = []
     variable_count = 0
     names = set()
+    # 1..8 podSets (workload_types.go PodSets kubebuilder MinItems/MaxItems).
+    if not 1 <= len(wl.pod_sets) <= 8:
+        errs.append("spec.podSets: must contain between 1 and 8 podSets, "
+                    f"got {len(wl.pod_sets)}")
     for i, ps in enumerate(wl.pod_sets):
         path = f"spec.podSets[{i}]"
         if not is_dns1123_label(ps.name):

@@ -1,0 +1,262 @@
+"""HTTP API server tests: the out-of-process surface.
+
+Covers the object API (CRUD + webhook rejection + labelSelector), the
+visibility endpoints, Prometheus /metrics, the chunked watch stream, and
+batch/v1 job creation incl. prebuilt-workload binding — the reference's
+apiserver-facing behaviors (pkg/visibility/server.go, webhooks, metrics
+endpoint) on one listener.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from kueue_tpu.api.types import (
+    ClusterQueue,
+    FlavorQuotas,
+    LocalQueue,
+    PodSet,
+    ResourceFlavor,
+    ResourceGroup,
+    Workload,
+)
+from kueue_tpu.controllers.runtime import Framework
+from kueue_tpu.controllers.store import (
+    KIND_CLUSTER_QUEUE,
+    KIND_LOCAL_QUEUE,
+    KIND_RESOURCE_FLAVOR,
+    Store,
+    StoreAdapter,
+)
+from kueue_tpu.controllers.visibility import VisibilityServer
+from kueue_tpu.server import APIServer
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=5) as resp:
+        return json.loads(resp.read())
+
+
+def _post(url, body):
+    req = urllib.request.Request(
+        url, data=json.dumps(body).encode(), method="POST",
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=5) as resp:
+        return json.loads(resp.read())
+
+
+def _delete(url):
+    req = urllib.request.Request(url, method="DELETE")
+    with urllib.request.urlopen(req, timeout=5) as resp:
+        return json.loads(resp.read())
+
+
+@pytest.fixture()
+def served():
+    fw = Framework()
+    store = Store()
+    adapter = StoreAdapter(store, fw)
+    server = APIServer(store, fw, visibility=VisibilityServer(fw.queues),
+                       sync_status=adapter.sync_status).start()
+    store.create(KIND_RESOURCE_FLAVOR, ResourceFlavor.make("default"))
+    store.create(KIND_CLUSTER_QUEUE, ClusterQueue(
+        name="cq",
+        resource_groups=(ResourceGroup(
+            covered_resources=("cpu",),
+            flavors=(FlavorQuotas.make("default", cpu=4),)),)))
+    store.create(KIND_LOCAL_QUEUE, LocalQueue(
+        name="main", namespace="default", cluster_queue="cq"))
+    try:
+        yield server, fw, store, adapter
+    finally:
+        server.stop()
+
+
+WL_DOC = {
+    "apiVersion": "kueue.x-k8s.io/v1beta1", "kind": "Workload",
+    "metadata": {"name": "wl1", "namespace": "default"},
+    "spec": {"queueName": "main", "podSets": [
+        {"name": "main", "count": 2, "template": {"spec": {"containers": [
+            {"name": "c", "resources": {"requests": {"cpu": "1"}}}]}}}]},
+}
+
+
+class TestObjectAPI:
+    def test_health_and_metrics(self, served):
+        server, *_ = served
+        with urllib.request.urlopen(server.url + "/healthz") as resp:
+            assert resp.read() == b"ok"
+        with urllib.request.urlopen(server.url + "/metrics") as resp:
+            text = resp.read().decode()
+        assert "kueue_pending_workloads" in text
+
+    def test_crud_and_schedule(self, served):
+        server, fw, store, adapter = served
+        base = server.url + "/apis/kueue.x-k8s.io/v1beta1"
+        created = _post(base + "/namespaces/default/workloads", WL_DOC)
+        assert created["metadata"]["name"] == "wl1"
+
+        adapter.tick()
+        doc = _get(base + "/namespaces/default/workloads/wl1")
+        conds = {c["type"]: c["status"] for c in doc["status"]["conditions"]}
+        assert conds["Admitted"] == "True"
+        adm = doc["status"]["admission"]
+        assert adm["clusterQueue"] == "cq"
+        assert adm["podSetAssignments"][0]["flavors"] == {"cpu": "default"}
+
+        listing = _get(base + "/workloads")
+        assert [i["metadata"]["name"] for i in listing["items"]] == ["wl1"]
+
+        _delete(base + "/namespaces/default/workloads/wl1")
+        with pytest.raises(urllib.error.HTTPError) as err:
+            _get(base + "/namespaces/default/workloads/wl1")
+        assert err.value.code == 404
+        assert "default/wl1" not in fw.workloads
+
+    def test_webhook_rejection_is_422(self, served):
+        server, *_ = served
+        bad = json.loads(json.dumps(WL_DOC))
+        bad["metadata"]["name"] = "bad"
+        bad["spec"]["podSets"] = []  # workload must have 1..8 podsets
+        with pytest.raises(urllib.error.HTTPError) as err:
+            _post(server.url + "/apis/kueue.x-k8s.io/v1beta1"
+                  "/namespaces/default/workloads", bad)
+        assert err.value.code == 422
+
+    def test_duplicate_create_is_409(self, served):
+        server, *_ = served
+        base = server.url + "/apis/kueue.x-k8s.io/v1beta1"
+        _post(base + "/namespaces/default/workloads", WL_DOC)
+        with pytest.raises(urllib.error.HTTPError) as err:
+            _post(base + "/namespaces/default/workloads", WL_DOC)
+        assert err.value.code == 409
+
+    def test_label_selector_filtering(self, served):
+        server, *_ = served
+        base = server.url + "/apis/kueue.x-k8s.io/v1beta1"
+        labeled = json.loads(json.dumps(WL_DOC))
+        labeled["metadata"]["name"] = "labeled"
+        labeled["metadata"]["labels"] = {"origin": "mk"}
+        _post(base + "/namespaces/default/workloads", WL_DOC)
+        _post(base + "/namespaces/default/workloads", labeled)
+        listing = _get(base + "/workloads?labelSelector=origin%3Dmk")
+        assert [i["metadata"]["name"] for i in listing["items"]] == ["labeled"]
+
+    def test_visibility_pending_workloads(self, served):
+        server, fw, store, adapter = served
+        base = server.url + "/apis/kueue.x-k8s.io/v1beta1"
+        # 4 cpu quota; each workload wants 2 -> third stays pending.
+        for i in range(3):
+            doc = json.loads(json.dumps(WL_DOC))
+            doc["metadata"]["name"] = f"wl{i}"
+            _post(base + "/namespaces/default/workloads", doc)
+        adapter.tick()
+        adapter.tick()
+        summary = _get(server.url
+                       + "/apis/visibility.kueue.x-k8s.io/v1alpha1"
+                       "/clusterqueues/cq/pendingworkloads")
+        assert [i["name"] for i in summary["items"]] == ["wl2"]
+        assert summary["items"][0]["positionInClusterQueue"] == 0
+        by_lq = _get(server.url
+                     + "/apis/visibility.kueue.x-k8s.io/v1alpha1"
+                     "/namespaces/default/localqueues/main/pendingworkloads")
+        assert [i["name"] for i in by_lq["items"]] == ["wl2"]
+
+    def test_finish_endpoint(self, served):
+        server, fw, store, adapter = served
+        base = server.url + "/apis/kueue.x-k8s.io/v1beta1"
+        _post(base + "/namespaces/default/workloads", WL_DOC)
+        adapter.tick()
+        _post(base + "/namespaces/default/workloads/wl1/finish", {})
+        doc = _get(base + "/namespaces/default/workloads/wl1")
+        conds = {c["type"]: c["status"] for c in doc["status"]["conditions"]}
+        assert conds["Finished"] == "True"
+
+
+class TestJobsAPI:
+    JOB_DOC = {
+        "apiVersion": "batch/v1", "kind": "Job",
+        "metadata": {"name": "j1", "namespace": "default",
+                     "labels": {"kueue.x-k8s.io/queue-name": "main"}},
+        "spec": {"parallelism": 2, "completions": 2,
+                 "template": {"spec": {"containers": [
+                     {"name": "c",
+                      "resources": {"requests": {"cpu": "1"}}}]}}},
+    }
+
+    def test_job_create_schedule_complete(self, served):
+        server, fw, store, adapter = served
+        _post(server.url + "/apis/batch/v1/namespaces/default/jobs",
+              self.JOB_DOC)
+        adapter.tick()
+        doc = _get(server.url + "/apis/batch/v1/namespaces/default/jobs/j1")
+        assert doc["spec"]["suspend"] is False
+        _post(server.url
+              + "/apis/batch/v1/namespaces/default/jobs/j1/complete", {})
+        doc = _get(server.url + "/apis/batch/v1/namespaces/default/jobs/j1")
+        assert doc["status"]["succeeded"] == 2
+        wl = fw.workloads[doc["workloadKey"]]
+        assert wl.is_finished
+
+    def test_prebuilt_workload_binding(self, served):
+        """A job posted with the prebuilt-workload-name label binds to the
+        existing workload instead of creating a second one (the MultiKueue
+        worker-side contract)."""
+        server, fw, store, adapter = served
+        base = server.url + "/apis/kueue.x-k8s.io/v1beta1"
+        _post(base + "/namespaces/default/workloads", WL_DOC)
+        job = json.loads(json.dumps(self.JOB_DOC))
+        job["metadata"]["labels"]["kueue.x-k8s.io/prebuilt-workload-name"] = \
+            "wl1"
+        _post(server.url + "/apis/batch/v1/namespaces/default/jobs", job)
+        assert len(fw.workloads) == 1
+        assert fw.job_reconciler.jobs["default/j1"][1] == "default/wl1"
+
+    def test_prebuilt_missing_is_404(self, served):
+        server, *_ = served
+        job = json.loads(json.dumps(self.JOB_DOC))
+        job["metadata"]["labels"]["kueue.x-k8s.io/prebuilt-workload-name"] = \
+            "ghost"
+        with pytest.raises(urllib.error.HTTPError) as err:
+            _post(server.url + "/apis/batch/v1/namespaces/default/jobs", job)
+        assert err.value.code == 404
+
+
+class TestWatch:
+    def test_watch_streams_initial_and_live_events(self, served):
+        server, fw, store, adapter = served
+        base = server.url + "/apis/kueue.x-k8s.io/v1beta1"
+        _post(base + "/namespaces/default/workloads", WL_DOC)
+
+        events = []
+        ready = threading.Event()
+
+        def consume():
+            req = urllib.request.Request(base + "/watch/workloads")
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                for raw in resp:
+                    line = raw.strip()
+                    if not line:
+                        continue
+                    events.append(json.loads(line))
+                    ready.set()
+                    if len(events) >= 2:
+                        return
+
+        t = threading.Thread(target=consume, daemon=True)
+        t.start()
+        assert ready.wait(5), "no initial replay event"
+        assert events[0]["type"] == "ADDED"
+        assert events[0]["object"]["metadata"]["name"] == "wl1"
+
+        adapter.tick()  # admission -> status sync -> MODIFIED event
+        t.join(timeout=5)
+        assert len(events) >= 2
+        assert events[1]["type"] == "MODIFIED"
+        conds = {c["type"]: c["status"]
+                 for c in events[1]["object"]["status"]["conditions"]}
+        assert conds["Admitted"] == "True"

@@ -1,0 +1,202 @@
+"""Cross-process MultiKueue: manager dispatches to a worker SUBPROCESS.
+
+The round-1 gap was that MultiKueue only worked against in-process
+remotes. This test is the reference's two-cluster integration scenario
+(test/integration/multikueue/) with a real process boundary: the worker
+is `python -m kueue_tpu --serve --port 0` in its own interpreter, the
+manager talks to it through `HTTPRemote` (watch-based mirroring over the
+chunked watch stream), the batch job is synced through the wire with the
+prebuilt-workload binding, remote completion flows back, and the remote
+mirror is garbage-collected.
+"""
+
+import re
+import subprocess
+import sys
+import time
+
+import pytest
+
+from kueue_tpu.api.types import (
+    AdmissionCheck,
+    ClusterQueue,
+    FlavorQuotas,
+    LocalQueue,
+    ResourceFlavor,
+    ResourceGroup,
+)
+from kueue_tpu.controllers.multikueue import (
+    BatchJobAdapter,
+    MultiKueueController,
+)
+from kueue_tpu.controllers.multikueue_remote import HTTPRemote
+from kueue_tpu.controllers.runtime import Framework
+from kueue_tpu.jobs.batch_job import BatchJob
+
+WORKER_SETUP = """\
+apiVersion: kueue.x-k8s.io/v1beta1
+kind: ResourceFlavor
+metadata:
+  name: default
+---
+apiVersion: kueue.x-k8s.io/v1beta1
+kind: ClusterQueue
+metadata:
+  name: worker-cq
+spec:
+  namespaceSelector: {}
+  resourceGroups:
+  - coveredResources: ["cpu"]
+    flavors:
+    - name: default
+      resources:
+      - name: cpu
+        nominalQuota: 8
+---
+apiVersion: kueue.x-k8s.io/v1beta1
+kind: LocalQueue
+metadata:
+  name: main
+  namespace: default
+spec:
+  clusterQueue: worker-cq
+"""
+
+
+@pytest.fixture(scope="module")
+def worker():
+    """Spawn a worker cluster as a separate interpreter."""
+    import tempfile
+    with tempfile.NamedTemporaryFile("w", suffix=".yaml",
+                                     delete=False) as fh:
+        fh.write(WORKER_SETUP)
+        setup_path = fh.name
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "kueue_tpu", "--serve", "--port", "0",
+         "--tick-interval", "0.05", "--objects", setup_path],
+        stderr=subprocess.PIPE, stdout=subprocess.DEVNULL, text=True)
+    url = None
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        line = proc.stderr.readline()
+        m = re.search(r"serving HTTP API on (http://\S+)", line or "")
+        if m:
+            url = m.group(1)
+            break
+        if proc.poll() is not None:
+            raise RuntimeError("worker subprocess died during startup")
+    assert url, "worker never reported its URL"
+    try:
+        yield url
+    finally:
+        proc.terminate()
+        proc.wait(timeout=10)
+
+
+def make_manager():
+    mgr = Framework()
+    mgr.create_resource_flavor(ResourceFlavor.make("default"))
+    mgr.create_admission_check(AdmissionCheck(
+        name="mk", controller_name="kueue.x-k8s.io/multikueue"))
+    mgr.create_cluster_queue(ClusterQueue(
+        name="cq",
+        resource_groups=(ResourceGroup(
+            covered_resources=("cpu",),
+            flavors=(FlavorQuotas.make("default", cpu=100),)),),
+        admission_checks=("mk",)))
+    mgr.create_local_queue(LocalQueue(
+        name="main", namespace="default", cluster_queue="cq"))
+    return mgr
+
+
+def spin(mgr, ctl, predicate, timeout=30.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        mgr.tick()
+        ctl.reconcile()
+        if predicate():
+            return True
+        time.sleep(0.05)
+    return False
+
+
+class TestCrossProcessDispatch:
+    def test_dispatch_run_finish_gc(self, worker):
+        mgr = make_manager()
+        ctl = MultiKueueController(mgr, check_name="mk")
+        client = HTTPRemote(worker, queue_name="main")
+        ctl.add_cluster("w1", client)
+        ctl.register_adapter("batch", BatchJobAdapter())
+
+        job = BatchJob(name="xjob", queue_name="main", parallelism=2,
+                       requests={"cpu": 1})
+        wl = mgr.submit_job(job)
+        assert wl is not None
+
+        # Quota reserved locally, mirrored remotely, remote reserves ->
+        # check flips Ready -> local workload admitted.
+        assert spin(mgr, ctl, lambda: wl.is_admitted), \
+            "workload never got admitted via the remote reservation"
+        state = wl.admission_check_states["mk"]
+        assert state.state == "Ready"
+        assert 'reservation on "w1"' in state.message
+
+        # The job was synced through the wire and bound to the mirror.
+        assert client.get_job("default", "xjob") is not None
+
+        # Remote completion flows back: complete the remote job over HTTP.
+        client._request(
+            "POST", "/apis/batch/v1/namespaces/default/jobs/xjob/complete",
+            {})
+        assert spin(mgr, ctl, lambda: wl.is_finished), \
+            "remote completion never propagated"
+
+        # GC: the remote mirror is deleted once the dispatch is done.
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            if client.get_status(wl.key) is None \
+                    and not client.list_workload_keys():
+                break
+            ctl.reconcile()
+            time.sleep(0.05)
+        assert client.get_status(wl.key) is None
+        client.close()
+
+    def test_watch_mirror_is_live(self, worker):
+        """get_status is served from the watch mirror (not a per-call GET)
+        once the stream connects — the reference's watch-based mirroring."""
+        client = HTTPRemote(worker, queue_name="main")
+        assert client.connected()
+        deadline = time.time() + 10
+        while time.time() < deadline and not client._watch_live.is_set():
+            time.sleep(0.05)
+        assert client._watch_live.is_set()
+        client.close()
+
+    def test_worker_lost_then_requeued(self, worker):
+        """An unreachable worker trips the lost-timeout path and resets
+        the dispatch with a Retry check state
+        (multikueuecluster.go workerLostTimeout)."""
+        clock = [1000.0]
+        mgr = make_manager()
+        mgr.clock = lambda: clock[0]
+        ctl = MultiKueueController(mgr, check_name="mk",
+                                   worker_lost_timeout=60.0)
+        dead = HTTPRemote("http://127.0.0.1:1", watch=False, timeout=0.2)
+        live = HTTPRemote(worker, queue_name="main")
+        ctl.add_cluster("w1", live)
+
+        job = BatchJob(name="lostjob", queue_name="main", parallelism=1,
+                       requests={"cpu": 1})
+        wl = mgr.submit_job(job)
+        assert spin(mgr, ctl, lambda: wl.is_admitted)
+
+        # Swap the live client for a dead one: worker lost.
+        ctl.clusters["w1"] = dead
+        ctl.reconcile()
+        clock[0] += 61.0
+        ctl.reconcile()
+        assert wl.admission_check_states["mk"].state == "Retry"
+        live.delete_workload(wl.key)
+        live.close()
+        dead.close()

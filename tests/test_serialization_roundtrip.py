@@ -1,0 +1,147 @@
+"""Manifest encode/decode round-trips for every kind served by the API
+server — the wire-format contract of the process boundary."""
+
+from kueue_tpu.api import serialization
+from kueue_tpu.api.types import (
+    AdmissionCheck,
+    Admission,
+    BorrowWithinCohort,
+    ClusterQueue,
+    ClusterQueuePreemption,
+    CohortSpec,
+    FairSharing,
+    FlavorFungibility,
+    FlavorQuotas,
+    LabelSelector,
+    LocalQueue,
+    MatchExpression,
+    PodSet,
+    PodSetAssignment,
+    ResourceFlavor,
+    ResourceGroup,
+    ResourceQuota,
+    Taint,
+    Toleration,
+    Workload,
+    WorkloadPriorityClass,
+)
+
+
+def roundtrip(kind, obj):
+    doc = serialization.encode(kind, obj)
+    kind2, back = serialization.decode(doc)
+    assert kind2 == kind
+    return doc, back
+
+
+class TestRoundTrips:
+    def test_resource_flavor(self):
+        rf = ResourceFlavor.make(
+            "gpu", node_labels={"type": "a100"},
+            node_taints=(Taint(key="gpu", value="yes", effect="NoSchedule"),),
+            tolerations=(Toleration(key="gpu", operator="Exists"),))
+        _, back = roundtrip("ResourceFlavor", rf)
+        assert back == rf
+
+    def test_cluster_queue(self):
+        cq = ClusterQueue(
+            name="cq",
+            cohort="pool",
+            resource_groups=(ResourceGroup(
+                covered_resources=("cpu", "memory"),
+                flavors=(FlavorQuotas(
+                    name="default",
+                    resources=(("cpu", ResourceQuota(nominal=8000,
+                                                     borrowing_limit=2000,
+                                                     lending_limit=1000)),
+                               ("memory", ResourceQuota(nominal=1 << 30)))),
+                         )),),
+            queueing_strategy="StrictFIFO",
+            namespace_selector=LabelSelector(
+                match_labels=(("team", "ml"),),
+                match_expressions=(MatchExpression(
+                    key="env", operator="In", values=("prod",)),)),
+            preemption=ClusterQueuePreemption(
+                within_cluster_queue="LowerPriority",
+                reclaim_within_cohort="Any",
+                borrow_within_cohort=BorrowWithinCohort(
+                    policy="LowerPriority", max_priority_threshold=100)),
+            flavor_fungibility=FlavorFungibility(
+                when_can_borrow="TryNextFlavor", when_can_preempt="Preempt"),
+            admission_checks=("prov",),
+            fair_sharing=FairSharing(weight=2.0))
+        _, back = roundtrip("ClusterQueue", cq)
+        assert back == cq
+
+    def test_local_queue(self):
+        lq = LocalQueue(name="main", namespace="team-a", cluster_queue="cq")
+        _, back = roundtrip("LocalQueue", lq)
+        assert back == lq
+
+    def test_admission_check(self):
+        ac = AdmissionCheck(name="prov",
+                            controller_name="kueue.x-k8s.io/provisioning",
+                            parameters=("kueue.x-k8s.io",
+                                        "ProvisioningRequestConfig", "cfg"))
+        _, back = roundtrip("AdmissionCheck", ac)
+        assert back == ac
+
+    def test_priority_class(self):
+        pc = WorkloadPriorityClass(name="high", value=1000)
+        _, back = roundtrip("WorkloadPriorityClass", pc)
+        assert back == pc
+
+    def test_cohort(self):
+        cohort = CohortSpec(
+            name="pool", parent="root",
+            resource_groups=(ResourceGroup(
+                covered_resources=("cpu",),
+                flavors=(FlavorQuotas(
+                    name="default",
+                    resources=(("cpu", ResourceQuota(nominal=4000)),)),)),))
+        _, back = roundtrip("Cohort", cohort)
+        assert back == cohort
+
+    def test_workload_spec_and_status(self):
+        wl = Workload(
+            name="wl", namespace="ns", queue_name="main",
+            labels={"a": "b"}, annotations={"k": "v"},
+            pod_sets=[PodSet(
+                name="driver", count=1, requests={"cpu": 500, "memory": 1024},
+                node_selector=(("zone", "z1"),),
+                tolerations=(Toleration(key="gpu", operator="Exists"),),
+                affinity_terms=((MatchExpression(
+                    key="type", operator="In", values=("a100",)),),)),
+                PodSet(name="worker", count=4, min_count=2,
+                       requests={"cpu": 1000})],
+            priority=7, priority_class="high")
+        wl.set_condition("QuotaReserved", True, reason="QuotaReserved", now=5.0)
+        wl.admission = Admission(
+            cluster_queue="cq",
+            pod_set_assignments=[PodSetAssignment(
+                name="driver", flavors={"cpu": "default"},
+                resource_usage={"cpu": 500}, count=1)])
+        wl.reclaimable_pods = {"worker": 1}
+
+        doc = serialization.encode("Workload", wl)
+        _, back = serialization.decode(doc)
+        serialization.decode_workload_status(doc, back)
+
+        assert back.name == wl.name and back.namespace == wl.namespace
+        assert back.labels == wl.labels and back.annotations == wl.annotations
+        assert back.priority == 7 and back.priority_class == "high"
+        assert back.uid == wl.uid
+        assert back.creation_time == wl.creation_time
+        assert len(back.pod_sets) == 2
+        for got, want in zip(back.pod_sets, wl.pod_sets):
+            assert got.name == want.name and got.count == want.count
+            assert got.min_count == want.min_count
+            assert got.requests == want.requests
+            assert got.node_selector == want.node_selector
+            assert got.tolerations == want.tolerations
+            assert got.affinity_terms == want.affinity_terms
+        assert back.has_quota_reservation
+        assert back.admission.cluster_queue == "cq"
+        assert back.admission.pod_set_assignments[0].resource_usage == \
+            {"cpu": 500}
+        assert back.reclaimable_pods == {"worker": 1}
