@@ -450,6 +450,7 @@ class MultiKueueController:
                 wl.admission_check_states[self.check_name] = \
                     AdmissionCheckState(name=self.check_name, state="Pending",
                                         message="dispatched to workers")
+                self._note_check_changed(wl)
 
         # First worker to reserve quota wins (workload.go:94-148).
         statuses = {}
@@ -474,6 +475,7 @@ class MultiKueueController:
                     AdmissionCheckState(
                         name=self.check_name, state="Ready",
                         message=f'The workload got reservation on "{winner}"')
+                self._note_check_changed(wl)
             return
 
         # Kept worker: watch status (remote watch analog).
@@ -489,6 +491,7 @@ class MultiKueueController:
                 wl.admission_check_states[self.check_name] = \
                     AdmissionCheckState(name=self.check_name, state="Retry",
                                         message="Reserving remote lost")
+                self._note_check_changed(wl)
             return
         d.lost_since = None
         if adapter is not None and local_job is not None \
@@ -503,6 +506,11 @@ class MultiKueueController:
         if status["finished"]:
             self.fw.finish(wl)
             self._gc(wl.key)
+
+    def _note_check_changed(self, wl) -> None:
+        note = getattr(self.fw, "note_check_state_changed", None)
+        if note is not None:
+            note(wl)
 
     def _gc(self, key: str) -> None:
         d = self._dispatches.pop(key, None)

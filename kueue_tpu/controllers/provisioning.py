@@ -185,6 +185,9 @@ class ProvisioningController:
         wl.admission_check_states[check_name] = AdmissionCheckState(
             name=check_name, state=state, message=message,
             pod_set_updates=pod_set_updates)
+        note = getattr(self.fw, "note_check_state_changed", None)
+        if note is not None:
+            note(wl)
         if prev is not None and prev.state != state:
             self.recorder.event(
                 wl.key, "Normal", "AdmissionCheckUpdated",

@@ -53,8 +53,16 @@ class Framework:
     def __init__(self, batch_solver=None,
                  config: Optional[Configuration] = None,
                  ordering: Optional[WorkloadOrdering] = None,
+                 pipeline_depth: int = 1,
                  clock: Callable[[], float] = _time.time):
         self.clock = clock
+        # Pipelined scheduling (depth > 1): keep up to depth-1 ticks'
+        # device solves in flight while completing older ticks host-side.
+        # Decisions stay admission-safe via the scheduler's staleness
+        # re-validation; depth 1 is the reference-equivalent synchronous
+        # mode.
+        self.pipeline_depth = max(1, pipeline_depth)
+        self._inflight_ticks: List = []
         self.config = config or Configuration()
         wfpr = self.config.wait_for_pods_ready
         if ordering is None:
@@ -100,6 +108,11 @@ class Framework:
             workload_validator=self._validate_workload_resources,
             clock=clock)
         self._evicted_dirty: List[Workload] = []
+        # Workloads whose admission-check state machine needs attention
+        # (QuotaReserved set, a check state written, eviction handling).
+        # The reference's workload reconciler is event-driven; a full scan
+        # over 50k workloads per tick is the scaling hazard this avoids.
+        self._check_sync_pending: Dict[str, Workload] = {}
         from kueue_tpu.controllers.jobframework import JobReconciler
         self.job_reconciler = JobReconciler(self)
         # QueueVisibility snapshot workers (clusterqueue_controller.go:685):
@@ -357,11 +370,19 @@ class Framework:
         from kueue_tpu.api.types import AdmissionCheckState
         wl.admission_check_states[check] = AdmissionCheckState(
             name=check, state=state, message=message)
+        self.note_check_state_changed(wl)
+
+    def note_check_state_changed(self, wl: Workload) -> None:
+        """Queue the workload for the next reconcile's check-state sync
+        (the event that would wake the reference's workload reconciler).
+        Admission-check controllers writing states directly call this."""
+        self._check_sync_pending[wl.key] = wl
 
     # -- scheduler callbacks -------------------------------------------------
 
     def _apply_admission(self, wl: Workload) -> bool:
         # The API write is in-memory: nothing can fail here.
+        self._check_sync_pending[wl.key] = wl
         cq = wl.admission.cluster_queue if wl.admission else ""
         self.events.event(
             wl.key, events_mod.NORMAL, events_mod.REASON_QUOTA_RESERVED,
@@ -448,12 +469,17 @@ class Framework:
                 self.queues.add_or_update_workload(wl)
         # Two-phase admission: flip Admitted once every check is Ready;
         # Retry/Rejected checks evict (workload_controller.go:175-184,
-        # :244-253).
-        for wl in list(self.workloads.values()):
-            if not wl.has_quota_reservation or wl.admission is None:
+        # :244-253). Event-driven: only workloads queued by an admission,
+        # a check-state write, or an eviction are visited — the reference's
+        # watch-triggered reconciles, not a full scan.
+        for key, wl in list(self._check_sync_pending.items()):
+            if self.workloads.get(key) is not wl \
+                    or not wl.has_quota_reservation or wl.admission is None:
+                del self._check_sync_pending[key]
                 continue
             cq = self.cache.cluster_queues.get(wl.admission.cluster_queue)
             if cq is None:
+                del self._check_sync_pending[key]
                 continue
             checks = cq.admission_checks
             states = [wl.admission_check_states.get(c) for c in checks]
@@ -471,12 +497,16 @@ class Framework:
                         now=self.clock())
                     self._count_eviction(wl, "AdmissionCheck")
                     self._evicted_dirty.append(wl)
+                del self._check_sync_pending[key]
                 continue
             if not wl.is_admitted and checks and all(
                     s is not None and s.state == "Ready" for s in states):
                 wl.set_condition(CONDITION_ADMITTED, True, reason="Admitted",
                                  now=self.clock())
                 self.cache.add_or_update_workload(wl)
+            if wl.is_admitted:
+                # Settled; a later check-state write re-queues it.
+                del self._check_sync_pending[key]
 
     def _reconcile_not_ready_timeouts(self) -> None:
         """Evict admitted workloads that exceeded the PodsReady timeout, with
@@ -519,7 +549,19 @@ class Framework:
     def tick(self) -> int:
         """One scheduling cycle plus the reconcile pass; returns admissions."""
         self.queues.flush_expired_backoffs()
-        admitted = self.scheduler.schedule(timeout=0.0)
+        if self.pipeline_depth <= 1:
+            admitted = self.scheduler.schedule(timeout=0.0)
+        else:
+            tick = self.scheduler.schedule_async(timeout=0.0)
+            if tick is not None:
+                self._inflight_ticks.append(tick)
+            admitted = 0
+            # Complete the oldest tick(s): all of them when the queue ran
+            # dry (drain), else enough to keep depth-1 in flight.
+            keep = self.pipeline_depth - 1 if tick is not None else 0
+            while len(self._inflight_ticks) > keep:
+                admitted += self.scheduler.schedule_finish(
+                    self._inflight_ticks.pop(0))
         self.reconcile()
         self.job_reconciler.reconcile()
         if features.enabled(features.QUEUE_VISIBILITY):
@@ -533,7 +575,9 @@ class Framework:
         for _ in range(max_ticks):
             n = self.tick()
             total += n
-            if n == 0:
+            # A dispatch-only tick (solves still in flight) is progress,
+            # not idleness — the pipeline needs draining before settling.
+            if n == 0 and not self._inflight_ticks:
                 idle += 1
                 if idle >= 2:
                     break

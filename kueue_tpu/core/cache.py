@@ -327,17 +327,24 @@ class Cache:
         self.resource_flavors: Dict[str, ResourceFlavor] = {}
         self.local_queues: Dict[str, LocalQueue] = {}
         self.assumed_workloads: Dict[str, str] = {}  # wl key -> cq name
+        # Bumped on every *structural* change (ClusterQueue specs, cohort
+        # specs, flavors) but NOT on workload churn. The batched solver's
+        # ClusterQueue encoding and the incremental snapshot key on this
+        # instead of recomputing a per-CQ generation tuple each tick.
+        self.structure_version = 1
 
     # -- hierarchical cohorts (KEP-79) --------------------------------------
 
     def add_or_update_cohort_spec(self, spec) -> None:
         with self._lock:
             self.cohort_specs[spec.name] = spec
+            self.structure_version += 1
             self._invalidate_allocatable()
 
     def delete_cohort_spec(self, name: str) -> None:
         with self._lock:
             if self.cohort_specs.pop(name, None) is not None:
+                self.structure_version += 1
                 self._invalidate_allocatable()
 
     def _invalidate_allocatable(self) -> None:
@@ -350,12 +357,14 @@ class Cache:
 
     def add_or_update_resource_flavor(self, flavor: ResourceFlavor) -> None:
         with self._lock:
+            self.structure_version += 1
             self.resource_flavors[flavor.name] = flavor
             for cq in self.cluster_queues.values():
                 cq.update_with_flavors(self.resource_flavors)
 
     def delete_resource_flavor(self, name: str) -> None:
         with self._lock:
+            self.structure_version += 1
             self.resource_flavors.pop(name, None)
             for cq in self.cluster_queues.values():
                 cq.update_with_flavors(self.resource_flavors)
@@ -368,6 +377,7 @@ class Cache:
                 raise ValueError(f"ClusterQueue {spec.name} already exists")
             cq = CachedClusterQueue(spec, self.resource_flavors)
             self.cluster_queues[spec.name] = cq
+            self.structure_version += 1
             self._update_cohort_membership(cq)
             return cq
 
@@ -375,6 +385,7 @@ class Cache:
         with self._lock:
             cq = self.cluster_queues[spec.name]
             cq.update(spec, self.resource_flavors)
+            self.structure_version += 1
             self._update_cohort_membership(cq)
 
     def delete_cluster_queue(self, name: str) -> None:
@@ -382,6 +393,7 @@ class Cache:
             cq = self.cluster_queues.pop(name, None)
             if cq is None:
                 return
+            self.structure_version += 1
             if cq.cohort is not None:
                 cq.cohort.members.discard(cq)
                 if not cq.cohort.members:

@@ -9,7 +9,7 @@ add/remove-workload simulation primitive used by preemption
 
 from __future__ import annotations
 
-from typing import Dict, Optional, Set
+from typing import Dict, List, Optional, Set, Tuple
 
 from kueue_tpu import features
 from kueue_tpu.api.types import ResourceFlavor
@@ -24,16 +24,21 @@ from kueue_tpu.core.workload import WorkloadInfo
 
 
 class Snapshot:
-    __slots__ = ("cluster_queues", "resource_flavors", "inactive_cluster_queues")
+    __slots__ = ("cluster_queues", "resource_flavors",
+                 "inactive_cluster_queues", "structure_version")
 
     def __init__(self):
         self.cluster_queues: Dict[str, CachedClusterQueue] = {}
         self.resource_flavors: Dict[str, ResourceFlavor] = {}
         self.inactive_cluster_queues: Set[str] = set()
+        # Cache.structure_version at build time: the cheap invalidation key
+        # for anything derived from specs (e.g. the solver's CQ encoding).
+        self.structure_version = 0
 
     @staticmethod
     def build(cache: Cache) -> "Snapshot":
         snap = Snapshot()
+        snap.structure_version = cache.structure_version
         snap.resource_flavors = dict(cache.resource_flavors)
         for name, cq in cache.cluster_queues.items():
             if not cq.active():
@@ -144,6 +149,156 @@ def _build_hierarchy(snap: "Snapshot", cache: Cache,
             nodes[name].members.clear()
             nodes[name].parent = None
             nodes[name].children = []
+
+
+class SnapshotMirror:
+    """Incrementally maintained tick snapshot.
+
+    The reference deep-copies the whole cache every tick
+    (snapshot.go:95-129) — O(CQs x flavors x workloads), the scaling hazard
+    SURVEY §3.2 flags at north-star scale. The mirror keeps ONE persistent
+    Snapshot across ticks and re-clones only ClusterQueues whose cache
+    `usage_version` moved since they were last mirrored, rebuilding cohort
+    aggregates only for cohorts with a re-cloned member.
+
+    Lockstep fast path: the scheduler mirrors every assume/forget it makes
+    (`note_admission`/`note_removal`) using the *same* mutation functions
+    the cache uses, so in the steady state a refresh is pure version
+    comparison. External mutations (evictions, workload deletes, CQ spec
+    updates) are caught by the version checks; structural changes
+    (`Cache.structure_version`) or hierarchical cohort trees fall back to
+    a full rebuild.
+
+    Preemption-target search mutates the snapshot but restores it exactly
+    (preemption.py _minimal_preemptions), so sim traffic needs no special
+    handling — the mirrored state stays equal to the versions it recorded.
+    """
+
+    def __init__(self, cache: Cache):
+        self.cache = cache
+        self._snap: Optional[Snapshot] = None
+        self._base: Dict[str, int] = {}   # cq name -> mirrored usage_version
+        self._key = None
+        # Deferred lockstep mutations: the snapshot must stay FROZEN for
+        # the duration of a tick (the admission cycle's cohort bookkeeping
+        # counts this cycle's admissions separately, scheduler.go:204-275),
+        # so note_admission/note_removal queue here and apply at the next
+        # refresh.
+        self._pending: List[Tuple[int, object, int, int, bool]] = []
+        # Monotonic count of snapshot mutations (lockstep applies and
+        # re-clones). A pipelined tick records it at dispatch; a different
+        # value at completion means the snapshot moved under the in-flight
+        # solve and FIT decisions must be re-validated.
+        self.mutation_count = 0
+
+    def refresh(self) -> Snapshot:
+        cache = self.cache
+        key = (cache.structure_version,
+               features.enabled(features.LENDING_LIMIT),
+               features.enabled(features.FAIR_SHARING))
+        # Hierarchical trees rebuild wholesale: their aggregate walk is
+        # tree-global and cheap relative to tree sizes seen in practice.
+        if self._snap is None or key != self._key or cache.cohort_specs:
+            self._pending.clear()
+            self.mutation_count += 1
+            self._snap = Snapshot.build(cache)
+            self._key = key
+            self._base = {name: cq.usage_version
+                          for name, cq in cache.cluster_queues.items()}
+            return self._snap
+
+        snap = self._snap
+        self.flush_pending()
+        dirty_cohorts: Dict[str, Cohort] = {}
+        for name, cq in cache.cluster_queues.items():
+            if self._base.get(name) == cq.usage_version:
+                continue
+            self.mutation_count += 1
+            self._base[name] = cq.usage_version
+            old = snap.cluster_queues.get(name)
+            fresh = _snapshot_cq(cq)
+            snap.cluster_queues[name] = fresh
+            cohort = old.cohort if old is not None else None
+            if cohort is None and cq.cohort is not None:
+                cohort = next((c.cohort for c in snap.cluster_queues.values()
+                               if c.cohort is not None
+                               and c.cohort.name == cq.cohort.name), None)
+            if cohort is not None:
+                if old is not None:
+                    cohort.members.discard(old)
+                cohort.members.add(fresh)
+                fresh.cohort = cohort
+                dirty_cohorts[cohort.name] = cohort
+
+        for cohort in dirty_cohorts.values():
+            cohort.requestable_resources = {}
+            cohort.usage = {}
+            cohort.allocatable_generation = 0
+            for member in cohort.members:
+                _accumulate(member, cohort)
+                cohort.allocatable_generation += member.allocatable_generation
+        return snap
+
+    # -- lockstep fast path (mirrors cache.assume/forget) -------------------
+
+    def note_admission(self, wl) -> None:
+        """Record a just-assumed workload (call right after
+        cache.assume_workload). The cache version captured here is the
+        assume bump itself; any later external mutation moves the cache
+        version past it and forces a re-clone — versions, not trust,
+        decide (same contract as UsageEncoder.apply_delta)."""
+        if self._snap is None or wl.admission is None:
+            return
+        cache_cq = self.cache.cluster_queues.get(wl.admission.cluster_queue)
+        if cache_cq is None:
+            return
+        self._pending.append((1, wl, cache_cq.usage_version,
+                              cache_cq.allocatable_generation,
+                              wl.is_admitted))
+
+    def note_removal(self, wl) -> None:
+        """Mirror of cache.forget_workload / delete after an apply failure
+        (call right after the cache mutation)."""
+        if self._snap is None or wl.admission is None:
+            return
+        cache_cq = self.cache.cluster_queues.get(wl.admission.cluster_queue)
+        if cache_cq is None:
+            return
+        self._pending.append((-1, wl, cache_cq.usage_version,
+                              cache_cq.allocatable_generation,
+                              wl.is_admitted))
+
+    def flush_pending(self) -> None:
+        """Apply queued lockstep mutations to the snapshot. Called at every
+        tick boundary (refresh) and, when ticks are pipelined, at the start
+        of a tick's completion phase — so a finishing tick validates
+        against state that includes every previously finished admission."""
+        if self._snap is None or not self._pending:
+            return
+        pending, self._pending = self._pending, []
+        self.mutation_count += len(pending)
+        for sign, wl, version, alloc_gen, admitted in pending:
+            self._apply(self._snap, sign, wl, version, alloc_gen, admitted)
+
+    def _apply(self, snap: Snapshot, sign: int, wl, version: int,
+               alloc_gen: int, admitted: bool) -> None:
+        cq = snap.cluster_queues.get(wl.admission.cluster_queue
+                                     if wl.admission else "")
+        if cq is None:
+            return
+        if sign > 0:
+            wi = WorkloadInfo(wl, cluster_queue=cq.name)
+            cq.add_workload_usage(wi, cohort_too=True, admitted=admitted)
+        else:
+            wi = cq.workloads.get(wl.key)
+            if wi is None:
+                return
+            cq.remove_workload_usage(wi, cohort_too=True,
+                                     admitted=admitted)
+            # The cache bumped allocatable_generation on the delete; the
+            # mirrored clone must track it for resume-state invalidation.
+            cq.allocatable_generation = alloc_gen
+        self._base[cq.name] = version
 
 
 def _accumulate(cq: CachedClusterQueue, cohort: Cohort) -> None:

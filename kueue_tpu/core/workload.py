@@ -95,13 +95,44 @@ class WorkloadInfo:
     reference: pkg/workload/workload.go:94-112 (Info).
     """
 
-    __slots__ = ("obj", "cluster_queue", "total_requests", "last_assignment")
+    __slots__ = ("obj", "cluster_queue", "_total_requests", "_usage_triples",
+                 "last_assignment")
 
     def __init__(self, obj: Workload, cluster_queue: str = ""):
         self.obj = obj
         self.cluster_queue = cluster_queue
-        self.total_requests: List[PodSetResources] = self._compute_totals(obj)
+        # Computed on first use: WorkloadInfos are also created on hot
+        # bookkeeping paths (assume/forget, snapshot-mirror lockstep) that
+        # never read the totals.
+        self._total_requests: Optional[List[PodSetResources]] = None
+        self._usage_triples = None
         self.last_assignment: Optional[AssignmentClusterQueueState] = None
+
+    @property
+    def total_requests(self) -> List[PodSetResources]:
+        totals = self._total_requests
+        if totals is None:
+            totals = self._total_requests = self._compute_totals(self.obj)
+            self._usage_triples = None
+        return totals
+
+    @property
+    def usage_triples(self):
+        """Flat [(flavor, resource, value)] of this workload's admitted
+        usage — the hot shape for usage accounting: preemption simulation
+        removes/adds workloads thousands of times per tick and the nested
+        podset/dict walk dominates otherwise."""
+        triples = self._usage_triples
+        if triples is None:
+            triples = []
+            for ps in self.total_requests:
+                flavors = ps.flavors
+                for res, q in ps.requests.items():
+                    flv = flavors.get(res)
+                    if flv is not None:
+                        triples.append((flv, res, q))
+            self._usage_triples = triples
+        return triples
 
     @staticmethod
     def _compute_totals(wl: Workload) -> List[PodSetResources]:
@@ -164,6 +195,6 @@ class WorkloadInfo:
         c = WorkloadInfo.__new__(WorkloadInfo)
         c.obj = self.obj
         c.cluster_queue = self.cluster_queue
-        c.total_requests = copy.deepcopy(self.total_requests)
+        c._total_requests = copy.deepcopy(self.total_requests)
         c.last_assignment = self.last_assignment
         return c

@@ -641,6 +641,7 @@ class BatchSolver:
         self._enc: Optional[sch.CQEncoding] = None
         self._static: Optional[tuple] = None
         self._usage_enc: Optional[sch.UsageEncoder] = None
+        self._row_cache: Optional[sch.WorkloadRowCache] = None
         # Optional XLA profiler hook (SURVEY §5): point TensorBoard at this
         # port to trace the device solves.
         port = os.environ.get("KUEUE_XLA_PROFILER_PORT")
@@ -653,11 +654,11 @@ class BatchSolver:
 
     def _encoding_for(self, snapshot: Snapshot) -> sch.CQEncoding:
         key = (
-            tuple(sorted(
-                (name, cq.allocatable_generation, cq.cohort_name,
-                 cq.preemption, cq.flavor_fungibility)
-                for name, cq in snapshot.cluster_queues.items())),
-            tuple(sorted(snapshot.resource_flavors.items())),
+            # Specs/cohorts/flavors identity: bumped by the cache on every
+            # structural mutation (Cache.structure_version) — and NOT by
+            # workload churn, so admissions/evictions never force the
+            # O(CQs x flavors) re-encode.
+            snapshot.structure_version,
             # The encoding bakes in gate-dependent quota splits and the
             # fair-sharing preempt-while-borrowing flag.
             features.enabled(features.LENDING_LIMIT),
@@ -667,11 +668,19 @@ class BatchSolver:
             self._enc = sch.encode_cluster_queues(snapshot)
             self._static = device_static(self._enc)
             self._usage_enc = sch.UsageEncoder(self._enc)
+            # Row cache indices/eligibility are relative to the encoding.
+            self._row_cache = sch.WorkloadRowCache()
             self._key = key
         return self._enc
 
-    def solve(self, workloads: Sequence[WorkloadInfo],
-              snapshot: Snapshot) -> List[Assignment]:
+    def solve_async(self, workloads: Sequence[WorkloadInfo],
+                    snapshot: Snapshot) -> dict:
+        """Dispatch the tick's batched solve; returns an in-flight handle.
+
+        The device program runs while the caller does host-side work
+        (admission cycle of the previous tick, preemption search);
+        `collect` fetches and decodes. This is the production pipelining
+        path — dispatch tick i+1 while tick i is completed host-side."""
         import time as _t
 
         from kueue_tpu.metrics import REGISTRY
@@ -680,15 +689,33 @@ class BatchSolver:
         t0 = _t.perf_counter()
         enc = self._encoding_for(snapshot)
         usage = self._usage_enc.refresh(snapshot)
-        wt = sch.encode_workloads(workloads, snapshot, enc)
+        wt = sch.encode_workloads(workloads, snapshot, enc,
+                                  row_cache=self._row_cache)
+        handle = solve_flavor_fit_async(enc, usage, wt, static=self._static)
         t1 = _t.perf_counter()
         phases.observe("tensorize", value=t1 - t0)
-        out = solve_flavor_fit(enc, usage, wt, static=self._static)
+        return {"workloads": list(workloads), "snapshot": snapshot,
+                "enc": enc, "wt": wt, "handle": handle, "dispatched": t1}
+
+    def collect(self, inflight: dict) -> List[Assignment]:
+        """Fetch + decode a solve dispatched by solve_async."""
+        import time as _t
+
+        from kueue_tpu.metrics import REGISTRY
+
+        phases = REGISTRY.tick_phase_seconds
+        t1 = _t.perf_counter()
+        out = fetch_outputs(inflight["handle"])
         t2 = _t.perf_counter()
         phases.observe("device_solve", value=t2 - t1)
-        assignments = decode_assignments(workloads, snapshot, enc, out)
+        assignments = decode_assignments(
+            inflight["workloads"], inflight["snapshot"], inflight["enc"], out)
         phases.observe("decode", value=_t.perf_counter() - t2)
         return assignments
+
+    def solve(self, workloads: Sequence[WorkloadInfo],
+              snapshot: Snapshot) -> List[Assignment]:
+        return self.collect(self.solve_async(workloads, snapshot))
 
     # Scheduler admit/forget fast path (see UsageEncoder.apply_delta): keeps
     # the persistent usage tensor in lockstep with cache.assume/forget so the

@@ -27,7 +27,7 @@ from kueue_tpu.core.cache import (
     FlavorResourceQuantities,
     frq_add,
 )
-from kueue_tpu.core.snapshot import Snapshot
+from kueue_tpu.core.snapshot import Snapshot, SnapshotMirror
 from kueue_tpu.core.workload import WorkloadInfo, WorkloadOrdering
 from kueue_tpu.queue.manager import Manager, RequeueReason
 from kueue_tpu.scheduler import preemption as preemption_mod
@@ -53,6 +53,24 @@ class Entry:
     preemption_targets: List[WorkloadInfo] = field(default_factory=list)
     # ClusterQueue share value at nomination time (KEP-1714 fair sharing).
     share: float = 0.0
+
+
+@dataclass
+class TickInFlight:
+    """A dispatched-but-not-completed scheduling tick (pipelined mode).
+
+    Holds the popped heads (as prepped entries), the solver's in-flight
+    device handle, and the snapshot the solve was encoded against. The
+    completion phase (`Scheduler.schedule_finish`) fetches the solve,
+    searches preemption targets, runs the admission cycle with staleness
+    re-validation, and requeues losers."""
+
+    start: float
+    entries: List[Entry]
+    solvable: List[Entry]
+    handle: Optional[dict]
+    snapshot: Snapshot
+    dispatched_at: float = 0.0
 
 
 @dataclass
@@ -101,30 +119,69 @@ class Scheduler:
         self.preemption_engine = preemption_engine
         self.clock = clock
         self.metrics = SchedulerMetrics()
+        # Incremental tick snapshot: re-clones only ClusterQueues whose
+        # usage moved outside the scheduler's own assume/forget lockstep
+        # (replaces the reference's per-tick deep copy, snapshot.go:95-129).
+        self._mirror = SnapshotMirror(cache)
 
     # -- one tick -----------------------------------------------------------
 
     def schedule(self, timeout: Optional[float] = 0.0) -> int:
-        """Run one scheduling cycle; returns the number of admissions.
+        """Run one scheduling cycle synchronously; returns admissions.
 
         Phase timings (snapshot / nominate incl. the device solve / admit /
         requeue) land in the kueue_tick_phase_seconds histogram — the
         TPU-build observability addition SURVEY §5 calls for on top of the
         reference's whole-tick histogram (metrics.go:70-79)."""
+        tick = self.schedule_async(timeout=timeout)
+        if tick is None:
+            return 0
+        return self.schedule_finish(tick)
+
+    def schedule_async(self, timeout: Optional[float] = 0.0,
+                       ) -> Optional[TickInFlight]:
+        """Dispatch phase of a tick: pop heads, refresh the snapshot, gate
+        entries, and launch the batched device solve without blocking on
+        it. With pipeline depth N, up to N ticks run dispatch-overlapped:
+        tick i+1's solve crosses the interconnect while tick i's admission
+        cycle runs host-side — the production version of the depth-k
+        pipeline the round-1 bench only simulated."""
         heads = self.queues.heads(timeout=timeout)
         if not heads:
-            return 0
+            return None
         start = self.clock()
         phases = REGISTRY.tick_phase_seconds
         t0 = _time.perf_counter()
-        snapshot = self.cache.snapshot()
+        snapshot = self._mirror.refresh()
         t1 = _time.perf_counter()
         phases.observe("snapshot", value=t1 - t0)
-        entries = self._nominate(heads, snapshot)
+        entries, solvable = self._prep_entries(heads, snapshot)
+        handle = None
+        if self.batch_solver is not None and solvable:
+            handle = self.batch_solver.solve_async(
+                [e.info for e in solvable], snapshot)
+        return TickInFlight(start=start, entries=entries, solvable=solvable,
+                            handle=handle, snapshot=snapshot,
+                            dispatched_at=self._mirror.mutation_count)
+
+    def schedule_finish(self, tick: TickInFlight) -> int:
+        """Completion phase: collect the solve, search preemption targets,
+        order entries, run the admission cycle (with staleness
+        re-validation when the snapshot moved since dispatch), requeue."""
+        # Later finishes must see earlier finishes' admissions: apply any
+        # queued lockstep mutations before validating against the snapshot.
+        self._mirror.flush_pending()
+        stale = self._mirror.mutation_count != tick.dispatched_at
+        snapshot = tick.snapshot
+        t1 = _time.perf_counter()
+        phases = REGISTRY.tick_phase_seconds
+        self._resolve(tick)
+        entries = tick.entries
         entries.sort(key=self._entry_sort_key)
         t2 = _time.perf_counter()
         phases.observe("nominate", value=t2 - t1)
-        admitted = self._admission_cycle(entries, snapshot)
+        admitted = self._admission_cycle(entries, snapshot,
+                                         revalidate=stale)
         t3 = _time.perf_counter()
         phases.observe("admit", value=t3 - t2)
         for e in entries:
@@ -132,7 +189,7 @@ class Scheduler:
                 self._requeue_and_update(e)
         phases.observe("requeue", value=_time.perf_counter() - t3)
         self.metrics.admission_attempts += 1
-        self.metrics.last_tick_seconds = self.clock() - start
+        self.metrics.last_tick_seconds = self.clock() - tick.start
         result = "success" if admitted else "inadmissible"
         REGISTRY.admission_attempts_total.inc(result)
         REGISTRY.admission_attempt_duration_seconds.observe(
@@ -141,8 +198,8 @@ class Scheduler:
 
     # -- nomination (scheduler.go:317-351) ----------------------------------
 
-    def _nominate(self, heads: Sequence[WorkloadInfo],
-                  snapshot: Snapshot) -> List[Entry]:
+    def _prep_entries(self, heads: Sequence[WorkloadInfo],
+                      snapshot: Snapshot):
         entries: List[Entry] = []
         solvable: List[Entry] = []
         for wi in heads:
@@ -171,15 +228,15 @@ class Scheduler:
                     else:
                         solvable.append(e)
             entries.append(e)
+        return entries, solvable
 
-        self._solve(solvable, snapshot)
-        return entries
-
-    def _solve(self, entries: List[Entry], snapshot: Snapshot) -> None:
-        """Flavor-assign all nominable entries, batched when possible."""
-        if self.batch_solver is not None and entries:
-            assignments = self.batch_solver.solve(
-                [e.info for e in entries], snapshot)
+    def _resolve(self, tick: TickInFlight) -> None:
+        """Flavor-assign all nominable entries: collect the batched device
+        solve when one is in flight, else run the sequential referee."""
+        entries = tick.solvable
+        snapshot = tick.snapshot
+        if tick.handle is not None:
+            assignments = self.batch_solver.collect(tick.handle)
         else:
             assignments = None
         fair = features.enabled(features.FAIR_SHARING)
@@ -250,7 +307,8 @@ class Scheduler:
 
     # -- admission cycle (scheduler.go:204-275) ------------------------------
 
-    def _admission_cycle(self, entries: List[Entry], snapshot: Snapshot) -> int:
+    def _admission_cycle(self, entries: List[Entry], snapshot: Snapshot,
+                         revalidate: bool = False) -> int:
         cycle_cohorts_usage: Dict[str, FlavorResourceQuantities] = {}
         cycle_cohorts_skip_preemption: Set[str] = set()
         admitted = 0
@@ -261,6 +319,20 @@ class Scheduler:
             if mode == NO_FIT:
                 continue
             cq = snapshot.cluster_queues[e.info.cluster_queue]
+            if revalidate and mode == FIT \
+                    and not _assignment_still_fits(e.assignment, cq):
+                # Pipelined staleness: the solve ran against usage from
+                # dispatch time and another in-flight tick's admissions
+                # landed since. Never overadmit — requeue and re-solve
+                # with fresh usage next tick (optimistic concurrency, the
+                # assume/forget discipline of cache.go:498-546 applied to
+                # the solve itself).
+                e.status = SKIPPED
+                e.inadmissible_msg = ("admission solve became stale; "
+                                      "re-solving with fresh usage")
+                e.info.last_assignment = None
+                self.metrics.skipped += 1
+                continue
             if cq.cohort is not None:
                 # Cycle bookkeeping spans the whole structure: for
                 # hierarchical trees (KEP-79) two subtrees share capacity,
@@ -364,6 +436,7 @@ class Scheduler:
         note_forget = getattr(self.batch_solver, "note_removal", None)
         try:
             self.cache.assume_workload(wl)
+            self._mirror.note_admission(wl)
             if note_admit is not None:
                 note_admit(e.info.cluster_queue, e.assignment.usage)
         except ValueError as err:
@@ -376,6 +449,7 @@ class Scheduler:
         ok = self.apply_admission(wl)
         if not ok:
             self.cache.forget_workload(wl)
+            self._mirror.note_removal(wl)
             if note_forget is not None:
                 note_forget(e.info.cluster_queue, e.assignment.usage)
             # Roll the reservation back off the object so it can requeue
@@ -405,6 +479,28 @@ class Scheduler:
                 wl.set_condition("QuotaReserved", False, reason="Pending",
                                  message=e.inadmissible_msg, now=self.clock())
             self.metrics.inadmissible += 1
+
+
+def _assignment_still_fits(assignment: Assignment, cq: CachedClusterQueue,
+                           ) -> bool:
+    """Re-validate a FIT assignment against current snapshot state using
+    the referee's own quota arithmetic (_fits_resource_quota), including
+    cohort, borrowing-limit, lending and hierarchical paths."""
+    from kueue_tpu.solver.referee import _fits_resource_quota
+
+    for flavor, resources in assignment.usage.items():
+        for resource, val in resources.items():
+            rg = cq.rg_by_resource.get(resource)
+            quota = None
+            if rg is not None:
+                for fq in rg.flavors:
+                    if fq.name == flavor:
+                        quota = fq.resources_dict.get(resource)
+                        break
+            mode, _, _ = _fits_resource_quota(cq, flavor, resource, val, quota)
+            if mode != FIT:
+                return False
+    return True
 
 
 # -- cohort cycle-usage helpers (scheduler.go:134-173) -----------------------

@@ -496,26 +496,117 @@ class UsageEncoder:
                 versions[ci] += 1
 
 
+class _Row:
+    """One workload's usage-independent encoded columns (cacheable)."""
+
+    __slots__ = ("wi_id", "ci", "req", "has_req", "unsat", "elig",
+                 "requests_per_podset")
+
+    def __init__(self, wi_id, ci, req, has_req, unsat, elig,
+                 requests_per_podset):
+        self.wi_id = wi_id
+        self.ci = ci
+        self.req = req                      # [p, R] int64
+        self.has_req = has_req              # [p, R] bool
+        self.unsat = unsat                  # [p] bool
+        self.elig = elig                    # [p, G, S] bool
+        # resource-name presence per podset, for the resume-slot walk
+        self.requests_per_podset = requests_per_podset
+
+
+def _encode_row(wi: WorkloadInfo, cq, snapshot: Snapshot, enc: CQEncoding,
+                totals) -> _Row:
+    R = len(enc.resource_names)
+    G = enc.num_groups
+    S = enc.num_slots
+    p_count = len(totals)
+    req = np.zeros((p_count, R), dtype=np.int64)
+    has_req = np.zeros((p_count, R), dtype=bool)
+    unsat = np.zeros(p_count, dtype=bool)
+    elig = np.zeros((p_count, G, S), dtype=bool)
+    requests_per_podset = []
+
+    group_keys = [cq.label_keys(rg, snapshot.resource_flavors)
+                  for rg in cq.resource_groups]
+    for p, ps in enumerate(totals):
+        requests = dict(ps.requests)
+        if PODS_RESOURCE in cq.rg_by_resource:
+            requests[PODS_RESOURCE] = ps.count
+        requests_per_podset.append(frozenset(requests))
+        for rname, val in requests.items():
+            ri = enc.resource_index.get(rname)
+            if ri is None:
+                # A resource outside the global vocabulary is covered by
+                # no CQ: the podset can never be satisfied.
+                unsat[p] = True
+                continue
+            req[p, ri] = val
+            has_req[p, ri] = True
+
+        # Eligibility per (group, slot): each group's label keys scope
+        # the affinity match.
+        podset = wi.obj.pod_sets[p]
+        for gi, rg in enumerate(cq.resource_groups):
+            for si, fquotas in enumerate(rg.flavors):
+                flavor = snapshot.resource_flavors.get(fquotas.name)
+                if flavor is None:
+                    continue
+                ok, _ = flavor_eligible(podset, flavor, group_keys[gi])
+                elig[p, gi, si] = ok
+    return _Row(id(wi), enc.cq_index[wi.cluster_queue], req, has_req, unsat,
+                elig, requests_per_podset)
+
+
+class WorkloadRowCache:
+    """Per-workload encoded rows keyed by Workload uid.
+
+    The eligibility columns are host-side string matching
+    (taints/affinity x flavors) — the expensive part of encode_workloads.
+    They depend only on the workload's podsets and the CQ structure, both
+    stable across requeues, so a backlog workload is string-matched once
+    per CQ-encoding generation instead of once per tick it heads.
+    Identity is double-checked via id(wi): a resubmitted workload (fresh
+    WorkloadInfo under the same uid) re-encodes.
+    """
+
+    MAX_ENTRIES = 200_000  # backstop; ~100B/row, cleared wholesale
+
+    def __init__(self):
+        self._rows: dict = {}
+
+    def get(self, wi: WorkloadInfo) -> Optional[_Row]:
+        row = self._rows.get(wi.obj.uid)
+        if row is not None and row.wi_id == id(wi):
+            return row
+        return None
+
+    def put(self, wi: WorkloadInfo, row: _Row) -> None:
+        if len(self._rows) >= self.MAX_ENTRIES:
+            self._rows.clear()
+        self._rows[wi.obj.uid] = row
+
+
 def encode_workloads(workloads: Sequence[WorkloadInfo], snapshot: Snapshot,
                      enc: CQEncoding,
                      counts: Optional[Sequence[Optional[Sequence[int]]]] = None,
-                     pad_to: Optional[int] = None) -> WorkloadTensors:
+                     pad_to: Optional[int] = None,
+                     row_cache: Optional[WorkloadRowCache] = None,
+                     ) -> WorkloadTensors:
     """Encode pending workloads against the CQ encoding.
 
     Taint/affinity eligibility and the resume-from-last-flavor slot are
     computed here, host-side. `counts` optionally overrides pod counts per
-    workload (partial admission).
+    workload (partial admission; bypasses the row cache).
     """
     n = len(workloads)
     W = pad_to if pad_to is not None else _pad_pow2(max(n, 1))
     P = 1
     for wi in workloads:
         P = max(P, len(wi.total_requests))
-    F = len(enc.flavor_names)
     R = len(enc.resource_names)
     G = enc.num_groups
-
     S = enc.num_slots
+
     wl_cq = np.zeros(W, dtype=np.int32)
     req = np.zeros((W, P, R), dtype=np.int64)
     has_req = np.zeros((W, P, R), dtype=bool)
@@ -527,9 +618,25 @@ def encode_workloads(workloads: Sequence[WorkloadInfo], snapshot: Snapshot,
 
     for w, wi in enumerate(workloads):
         cq = snapshot.cluster_queues[wi.cluster_queue]
-        ci = enc.cq_index[wi.cluster_queue]
-        wl_cq[w] = ci
         wl_valid[w] = True
+
+        totals = wi.total_requests
+        scaled = counts is not None and counts[w] is not None
+        if scaled:
+            totals = [totals[i].scaled_to(c) for i, c in enumerate(counts[w])]
+
+        row = None if scaled or row_cache is None else row_cache.get(wi)
+        if row is None:
+            row = _encode_row(wi, cq, snapshot, enc, totals)
+            if not scaled and row_cache is not None:
+                row_cache.put(wi, row)
+        p_count = len(totals)
+        wl_cq[w] = row.ci
+        req[w, :p_count] = row.req
+        has_req[w, :p_count] = row.has_req
+        podset_valid[w, :p_count] = True
+        podset_unsat[w, :p_count] = row.unsat
+        elig[w, :p_count] = row.elig
 
         # Stale resume state is dropped exactly like the referee
         # (flavorassigner.go:244-247).
@@ -541,44 +648,14 @@ def encode_workloads(workloads: Sequence[WorkloadInfo], snapshot: Snapshot,
                             > last.cohort_generation))
             if outdated:
                 last = None
-
-        totals = wi.total_requests
-        if counts is not None and counts[w] is not None:
-            totals = [totals[i].scaled_to(c) for i, c in enumerate(counts[w])]
-
-        group_keys = [cq.label_keys(rg, snapshot.resource_flavors)
-                      for rg in cq.resource_groups]
-
-        for p, ps in enumerate(totals):
-            podset_valid[w, p] = True
-            requests = dict(ps.requests)
-            if PODS_RESOURCE in cq.rg_by_resource:
-                requests[PODS_RESOURCE] = ps.count
-            for rname, val in requests.items():
-                ri = enc.resource_index.get(rname)
-                if ri is None:
-                    # A resource outside the global vocabulary is covered by
-                    # no CQ: the podset can never be satisfied.
-                    podset_unsat[w, p] = True
-                    continue
-                req[w, p, ri] = val
-                has_req[w, p, ri] = True
-
-            # Eligibility per (group, slot): each group's label keys scope
-            # the affinity match.
-            podset = wi.obj.pod_sets[p]
-            for gi, rg in enumerate(cq.resource_groups):
-                for si, fquotas in enumerate(rg.flavors):
-                    flavor = snapshot.resource_flavors.get(fquotas.name)
-                    if flavor is None:
-                        continue
-                    ok, _ = flavor_eligible(podset, flavor, group_keys[gi])
-                    elig[w, p, gi, si] = ok
-                # Resume slot for this group: any covered requested
-                # resource carries the group's shared index.
-                if last is not None:
+        if last is not None:
+            for p in range(p_count):
+                requested = row.requests_per_podset[p]
+                for gi, rg in enumerate(cq.resource_groups):
+                    # Resume slot for this group: any covered requested
+                    # resource carries the group's shared index.
                     for rname in rg.covered_resources:
-                        if rname in requests:
+                        if rname in requested:
                             resume_slot[w, p, gi] = \
                                 last.next_flavor_to_try(p, rname)
                             break

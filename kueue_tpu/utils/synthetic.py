@@ -7,7 +7,7 @@ Workloads x 1k ClusterQueues x 100 cohorts x 8 ResourceFlavors.
 from __future__ import annotations
 
 import random
-from typing import List, Tuple
+from typing import List, Optional, Tuple
 
 from kueue_tpu.api.types import (
     Admission,
@@ -26,27 +26,23 @@ from kueue_tpu.core.snapshot import Snapshot
 from kueue_tpu.core.workload import WorkloadInfo
 
 
-def synthetic_problem(
+def synthetic_objects(
     num_cqs: int = 1000,
     num_cohorts: int = 100,
     num_flavors: int = 8,
     num_pending: int = 1000,
     usage_fill: float = 0.5,
     seed: int = 0,
-) -> Tuple[Cache, List[WorkloadInfo]]:
-    """Build a cache (with admitted usage) plus pending workloads.
-
-    `num_pending` is the batch handed to the solver in one tick: the
-    reference admits one head per ClusterQueue per cycle
-    (manager.go:489-508), so a 1k-CQ cluster solves <=1k heads/tick
-    regardless of the 50k-deep backlog.
-    """
+):
+    """Generate the raw API objects of a north-star-scale cluster:
+    (flavors, cluster_queues, local_queues, admitted workloads with their
+    Admission pre-set, pending workloads)."""
     rnd = random.Random(seed)
-    cache = Cache()
 
-    for f in range(num_flavors):
-        cache.add_or_update_resource_flavor(ResourceFlavor.make(f"flavor-{f}"))
+    flavors = [ResourceFlavor.make(f"flavor-{f}") for f in range(num_flavors)]
 
+    cqs: List[ClusterQueue] = []
+    lqs: List[LocalQueue] = []
     for c in range(num_cqs):
         n_flavors = rnd.randint(2, min(4, num_flavors))
         chosen = rnd.sample(range(num_flavors), n_flavors)
@@ -58,7 +54,7 @@ def synthetic_problem(
             )
             for fi in chosen
         )
-        cache.add_cluster_queue(ClusterQueue(
+        cqs.append(ClusterQueue(
             name=f"cq-{c}",
             resource_groups=(ResourceGroup(("cpu", "memory"), fqs),),
             cohort=f"cohort-{c % num_cohorts}",
@@ -66,13 +62,13 @@ def synthetic_problem(
                 within_cluster_queue="LowerPriority",
                 reclaim_within_cohort="Any"),
         ))
-        cache.add_local_queue(LocalQueue(
+        lqs.append(LocalQueue(
             name=f"lq-{c}", namespace="default", cluster_queue=f"cq-{c}"))
 
     # Admitted usage: fill roughly `usage_fill` of each CQ's first flavor.
+    admitted: List[Workload] = []
     for c in range(num_cqs):
-        cq = cache.cluster_queues[f"cq-{c}"]
-        fq0 = cq.resource_groups[0].flavors[0]
+        fq0 = cqs[c].resource_groups[0].flavors[0]
         quota = fq0.resources_dict["cpu"].nominal
         target = int(quota * usage_fill)
         if target <= 0:
@@ -91,9 +87,9 @@ def synthetic_problem(
                 count=1)])
         wl.set_condition("QuotaReserved", True, now=float(c))
         wl.set_condition("Admitted", True, now=float(c))
-        cache.add_or_update_workload(wl)
+        admitted.append(wl)
 
-    pending: List[WorkloadInfo] = []
+    pending: List[Workload] = []
     for i in range(num_pending):
         c = i % num_cqs
         n_podsets = rnd.randint(1, 2)
@@ -104,9 +100,76 @@ def synthetic_problem(
                 memory=f"{rnd.randint(1, 16)}Gi")
             for p in range(n_podsets)
         ]
-        wl = Workload(
+        pending.append(Workload(
             name=f"pend-{i}", namespace="default", queue_name=f"lq-{c}",
             priority=rnd.randint(-2, 2), creation_time=float(i),
-            pod_sets=pod_sets)
-        pending.append(WorkloadInfo(wl, cluster_queue=f"cq-{c}"))
-    return cache, pending
+            pod_sets=pod_sets))
+    return flavors, cqs, lqs, admitted, pending
+
+
+def synthetic_problem(
+    num_cqs: int = 1000,
+    num_cohorts: int = 100,
+    num_flavors: int = 8,
+    num_pending: int = 1000,
+    usage_fill: float = 0.5,
+    seed: int = 0,
+) -> Tuple[Cache, List[WorkloadInfo]]:
+    """Build a cache (with admitted usage) plus pending workloads.
+
+    `num_pending` is the batch handed to the solver in one tick: the
+    reference admits one head per ClusterQueue per cycle
+    (manager.go:489-508), so a 1k-CQ cluster solves <=1k heads/tick
+    regardless of the 50k-deep backlog.
+    """
+    flavors, cqs, lqs, admitted, pending = synthetic_objects(
+        num_cqs=num_cqs, num_cohorts=num_cohorts, num_flavors=num_flavors,
+        num_pending=num_pending, usage_fill=usage_fill, seed=seed)
+    cache = Cache()
+    for rf in flavors:
+        cache.add_or_update_resource_flavor(rf)
+    for cq in cqs:
+        cache.add_cluster_queue(cq)
+    for lq in lqs:
+        cache.add_local_queue(lq)
+    for wl in admitted:
+        cache.add_or_update_workload(wl)
+    infos = [WorkloadInfo(wl, cluster_queue=wl.queue_name.replace("lq-", "cq-"))
+             for wl in pending]
+    return cache, infos
+
+
+def synthetic_framework(
+    num_cqs: int = 1000,
+    num_cohorts: int = 100,
+    num_flavors: int = 8,
+    num_pending: int = 1000,
+    usage_fill: float = 0.5,
+    seed: int = 0,
+    batch_solver=None,
+    **framework_kwargs,
+):
+    """Build a full Framework loaded with the synthetic cluster — the
+    end-to-end bench target: real queue manager, cache, scheduler, and
+    reconcile passes, not just the solver kernel."""
+    from kueue_tpu.controllers.runtime import Framework
+
+    flavors, cqs, lqs, admitted, pending = synthetic_objects(
+        num_cqs=num_cqs, num_cohorts=num_cohorts, num_flavors=num_flavors,
+        num_pending=num_pending, usage_fill=usage_fill, seed=seed)
+    fw = Framework(batch_solver=batch_solver, **framework_kwargs)
+    for rf in flavors:
+        fw.create_resource_flavor(rf)
+    for cq in cqs:
+        fw.create_cluster_queue(cq)
+    for lq in lqs:
+        fw.create_local_queue(lq)
+    for wl in admitted:
+        # Pre-admitted background load: straight into the cache, like the
+        # reference rebuilding admitted state from the apiserver on startup
+        # (cache.go:295-328).
+        fw.workloads[wl.key] = wl
+        fw.cache.add_or_update_workload(wl)
+    for wl in pending:
+        fw.submit(wl)
+    return fw
