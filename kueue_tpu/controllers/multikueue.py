@@ -77,6 +77,14 @@ class RemoteError(Exception):
     per-cluster errors and requeues, multikueuecluster.go:139-188)."""
 
 
+class RemoteRejected(Exception):
+    """A permanent remote rejection (4xx other than 409-conflict, e.g. a
+    worker-side webhook validation failure): re-POSTing the same payload
+    can never succeed, so the controller records the rejection per worker
+    instead of retrying every pass; when every worker rejects, the
+    admission check goes Rejected with the server's message."""
+
+
 class RemoteClient(abc.ABC):
     """A connection to one worker cluster."""
 
@@ -259,6 +267,9 @@ class BatchJobAdapter(JobAdapter):
 @dataclass
 class _Dispatch:
     created_on: List[str] = field(default_factory=list)
+    # worker name -> rejection message for permanent create failures
+    # (never re-POSTed; see RemoteRejected).
+    rejected_on: Dict[str, str] = field(default_factory=dict)
     kept_on: Optional[str] = None
     lost_since: Optional[float] = None
     # Remote job status is polled (jobs have no watch stream), so throttle
@@ -344,6 +355,20 @@ class MultiKueueController:
             return {}
         return {n: c for n, c in self.clusters.items()
                 if n in config.clusters}
+
+    def _configured_cluster_names(self) -> set:
+        """Every worker the check is configured to dispatch to, connected
+        or not — the denominator for "all workers rejected". Using the
+        live-connection dict would let one rejecting worker + one
+        transiently disconnected worker permanently deactivate a workload
+        the disconnected worker would have accepted."""
+        config_name = self.check_configs.get(self.check_name)
+        if config_name is None:
+            return set(self.cluster_specs) | set(self.clusters)
+        config = self.configs.get(config_name)
+        if config is None:
+            return set()
+        return set(config.clusters)
 
     def reconcile_clusters(self) -> None:
         """Connection lifecycle for spec-registered workers: try the
@@ -441,11 +466,31 @@ class MultiKueueController:
         # connected worker (workload.go:232-300).
         if d.kept_on is None:
             for name, client in workers.items():
-                if name not in d.created_on and client.connected():
+                if name in d.created_on or name in d.rejected_on \
+                        or not client.connected():
+                    continue
+                try:
                     client.create_workload(wl)
-                    if adapter is not None and local_job is not None:
-                        adapter.sync_job(client, local_job, wl)
-                    d.created_on.append(name)
+                except RemoteRejected as exc:
+                    d.rejected_on[name] = str(exc)
+                    continue
+                if adapter is not None and local_job is not None:
+                    adapter.sync_job(client, local_job, wl)
+                d.created_on.append(name)
+            configured = self._configured_cluster_names()
+            if configured and not d.created_on \
+                    and configured <= set(d.rejected_on):
+                # Every worker permanently rejected the mirror: surface the
+                # rejection on the check instead of silently re-POSTing
+                # forever (ADVICE r2: 422-style webhook rejections).
+                state = wl.admission_check_states.get(self.check_name)
+                if state is None or state.state != "Rejected":
+                    wl.admission_check_states[self.check_name] = \
+                        AdmissionCheckState(
+                            name=self.check_name, state="Rejected",
+                            message=next(iter(d.rejected_on.values())))
+                    self._note_check_changed(wl)
+                return
             if not wl.admission_check_states.get(self.check_name):
                 wl.admission_check_states[self.check_name] = \
                     AdmissionCheckState(name=self.check_name, state="Pending",

@@ -34,6 +34,7 @@ from kueue_tpu.controllers.multikueue import (
     ORIGIN_LABEL,
     RemoteClient,
     RemoteError,
+    RemoteRejected,
 )
 
 WORKLOADS_PATH = "/apis/kueue.x-k8s.io/v1beta1/namespaces/{ns}/workloads"
@@ -119,9 +120,17 @@ class HTTPRemote(RemoteClient):
                           mirror)
         except urllib.error.HTTPError as exc:
             if exc.code != 409:  # 409 = already mirrored
-                # 4xx (e.g. worker-side webhook rejection) retries next
-                # pass like any other remote failure — don't crash the tick.
-                raise RemoteError(f"create workload {wl.key}: {exc}") from exc
+                # Non-conflict 4xx (e.g. a worker-side webhook rejection)
+                # is permanent: the same payload can never succeed, so the
+                # controller must stop re-POSTing and surface the message.
+                try:
+                    body = json.loads(exc.read() or b"{}")
+                    detail = (body.get("message")
+                              if isinstance(body, dict) else None) or str(exc)
+                except Exception:
+                    detail = str(exc)
+                raise RemoteRejected(
+                    f"create workload {wl.key}: {detail}") from exc
         self._created.add(wl.key)
 
     def delete_workload(self, key: str) -> None:
@@ -212,10 +221,14 @@ class HTTPRemote(RemoteClient):
                     self.base_url
                     + "/apis/kueue.x-k8s.io/v1beta1/watch/workloads")
                 with urllib.request.urlopen(req, timeout=30) as resp:
-                    # The initial replay re-lists everything; drop mirror
-                    # entries the replay doesn't refresh via versioning.
-                    self._mirror.clear()
-                    self._watch_live.set()
+                    # The initial ADDED replay is staged and only swapped
+                    # into the live mirror at the server's BOOKMARK marker:
+                    # going live mid-replay would serve mirror-misses for
+                    # workloads that exist on the worker and spuriously
+                    # start the lost_since timer after every reconnect.
+                    # If the server never sends a BOOKMARK, get_status
+                    # falls back to per-key GETs — correct, just unmirrored.
+                    staging: Dict[str, dict] = {}
                     backoff = 0.2
                     for raw in resp:
                         if self._closed.is_set():
@@ -224,14 +237,18 @@ class HTTPRemote(RemoteClient):
                         if not line:
                             continue  # heartbeat
                         ev = json.loads(line)
+                        if ev.get("type") == "BOOKMARK":
+                            self._mirror = staging  # staging IS live now
+                            self._watch_live.set()
+                            continue
                         obj = ev.get("object") or {}
                         meta = obj.get("metadata") or {}
                         key = (f"{meta.get('namespace', 'default')}"
                                f"/{meta.get('name')}")
                         if ev.get("type") == "DELETED":
-                            self._mirror.pop(key, None)
+                            staging.pop(key, None)
                         else:
-                            self._mirror[key] = self._status_from_doc(obj)
+                            staging[key] = self._status_from_doc(obj)
             except (urllib.error.URLError, OSError, ValueError):
                 pass
             self._watch_live.clear()

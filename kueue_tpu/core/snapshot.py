@@ -213,6 +213,13 @@ class SnapshotMirror:
         for name, cq in cache.cluster_queues.items():
             if self._base.get(name) == cq.usage_version:
                 continue
+            if not cq.active():
+                # Snapshot.build excludes inactive CQs entirely (the
+                # reference skips them in snapshot.go); a usage-only change
+                # on a stopped/broken CQ must not re-insert it — just track
+                # the version so we don't revisit every refresh.
+                self._base[name] = cq.usage_version
+                continue
             self.mutation_count += 1
             self._base[name] = cq.usage_version
             old = snap.cluster_queues.get(name)

@@ -10,6 +10,7 @@ timestamp rule (eviction vs creation, workload.go Ordering).
 from __future__ import annotations
 
 import copy
+import itertools
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
@@ -96,7 +97,12 @@ class WorkloadInfo:
     """
 
     __slots__ = ("obj", "cluster_queue", "_total_requests", "_usage_triples",
-                 "last_assignment")
+                 "last_assignment", "rev")
+
+    # Monotonic instance stamp: a process-unique identity that, unlike
+    # id(), is never recycled after GC — the solver's row cache keys
+    # validity on it without pinning the WorkloadInfo alive.
+    _rev_counter = itertools.count(1)
 
     def __init__(self, obj: Workload, cluster_queue: str = ""):
         self.obj = obj
@@ -107,6 +113,7 @@ class WorkloadInfo:
         self._total_requests: Optional[List[PodSetResources]] = None
         self._usage_triples = None
         self.last_assignment: Optional[AssignmentClusterQueueState] = None
+        self.rev = next(WorkloadInfo._rev_counter)
 
     @property
     def total_requests(self) -> List[PodSetResources]:
@@ -196,5 +203,7 @@ class WorkloadInfo:
         c.obj = self.obj
         c.cluster_queue = self.cluster_queue
         c._total_requests = copy.deepcopy(self.total_requests)
+        c._usage_triples = None
         c.last_assignment = self.last_assignment
+        c.rev = next(WorkloadInfo._rev_counter)
         return c

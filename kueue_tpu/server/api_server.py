@@ -276,6 +276,11 @@ class _Handler(BaseHTTPRequestHandler):
 
         with self.api.runtime_lock:  # initial replay races ticks otherwise
             self.api.store.watch(kind, on_event, send_initial=True)
+            # End-of-replay marker (k8s watch bookmark analog): enqueued
+            # under the same lock, so it lands exactly after the ADDED
+            # replay and before any live event. Clients stage the replay
+            # and only serve from their mirror once this arrives.
+            lines.put(b'{"type": "BOOKMARK"}\n')
         self.send_response(200)
         self.send_header("Content-Type", "application/json")
         self.send_header("Transfer-Encoding", "chunked")

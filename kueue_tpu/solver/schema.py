@@ -499,12 +499,16 @@ class UsageEncoder:
 class _Row:
     """One workload's usage-independent encoded columns (cacheable)."""
 
-    __slots__ = ("wi_id", "ci", "req", "has_req", "unsat", "elig",
+    __slots__ = ("wi_rev", "ci", "req", "has_req", "unsat", "elig",
                  "requests_per_podset")
 
-    def __init__(self, wi_id, ci, req, has_req, unsat, elig,
+    def __init__(self, wi_rev, ci, req, has_req, unsat, elig,
                  requests_per_podset):
-        self.wi_id = wi_id
+        # WorkloadInfo.rev of the encoded info: a never-recycled monotonic
+        # stamp (unlike id(), which the allocator reuses after GC, and
+        # unlike a strong reference, which would pin finished workloads in
+        # the cache until the wholesale clear).
+        self.wi_rev = wi_rev
         self.ci = ci
         self.req = req                      # [p, R] int64
         self.has_req = has_req              # [p, R] bool
@@ -553,7 +557,7 @@ def _encode_row(wi: WorkloadInfo, cq, snapshot: Snapshot, enc: CQEncoding,
                     continue
                 ok, _ = flavor_eligible(podset, flavor, group_keys[gi])
                 elig[p, gi, si] = ok
-    return _Row(id(wi), enc.cq_index[wi.cluster_queue], req, has_req, unsat,
+    return _Row(wi.rev, enc.cq_index[wi.cluster_queue], req, has_req, unsat,
                 elig, requests_per_podset)
 
 
@@ -565,8 +569,11 @@ class WorkloadRowCache:
     They depend only on the workload's podsets and the CQ structure, both
     stable across requeues, so a backlog workload is string-matched once
     per CQ-encoding generation instead of once per tick it heads.
-    Identity is double-checked via id(wi): a resubmitted workload (fresh
-    WorkloadInfo under the same uid) re-encodes.
+    Identity is double-checked via WorkloadInfo.rev, a never-recycled
+    monotonic stamp: a resubmitted workload (fresh WorkloadInfo under the
+    same uid) re-encodes. id() is unsuitable (addresses are recycled after
+    GC → stale rows for updated workloads) and a strong reference would
+    pin finished workloads' objects until the wholesale clear.
     """
 
     MAX_ENTRIES = 200_000  # backstop; ~100B/row, cleared wholesale
@@ -576,7 +583,7 @@ class WorkloadRowCache:
 
     def get(self, wi: WorkloadInfo) -> Optional[_Row]:
         row = self._rows.get(wi.obj.uid)
-        if row is not None and row.wi_id == id(wi):
+        if row is not None and row.wi_rev == wi.rev:
             return row
         return None
 

@@ -244,7 +244,7 @@ class TestWatch:
                         continue
                     events.append(json.loads(line))
                     ready.set()
-                    if len(events) >= 2:
+                    if len(events) >= 3:
                         return
 
         t = threading.Thread(target=consume, daemon=True)
@@ -255,8 +255,11 @@ class TestWatch:
 
         adapter.tick()  # admission -> status sync -> MODIFIED event
         t.join(timeout=5)
-        assert len(events) >= 2
-        assert events[1]["type"] == "MODIFIED"
+        assert len(events) >= 3
+        # End-of-replay bookmark separates the ADDED replay from live
+        # events (clients stage the replay until they see it).
+        assert events[1]["type"] == "BOOKMARK"
+        assert events[2]["type"] == "MODIFIED"
         conds = {c["type"]: c["status"]
-                 for c in events[1]["object"]["status"]["conditions"]}
+                 for c in events[2]["object"]["status"]["conditions"]}
         assert conds["Admitted"] == "True"

@@ -168,3 +168,97 @@ class TestOrphanGC:
         mgr.delete_workload(wl)
         ctl.reconcile()
         assert "default/w" not in worker.workloads
+
+
+class TestPermanentRejection:
+    def test_all_workers_reject_sets_check_rejected(self):
+        """A permanent 4xx-style rejection (RemoteRejected) must not be
+        retried every pass; once every worker rejects, the check goes
+        Rejected with the worker's message (ADVICE r2, low #4)."""
+        from kueue_tpu.controllers.multikueue import RemoteRejected
+
+        class RejectingRemote(InProcessRemote):
+            def __init__(self, fw):
+                super().__init__(fw)
+                self.create_calls = 0
+
+            def create_workload(self, wl):
+                self.create_calls += 1
+                raise RemoteRejected("webhook denied: podSets invalid")
+
+        mgr = make_manager()
+        worker = make_cluster_fw()
+        remote = RejectingRemote(worker)
+        ctl = MultiKueueController(mgr, check_name="mk")
+        ctl.add_cluster("w1", remote)
+
+        wl = Workload(name="w", queue_name="main",
+                      pod_sets=[PodSet.make("main", 1, cpu=2)])
+        mgr.submit(wl)
+        mgr.run_until_settled()
+        ctl.reconcile()
+        state = wl.admission_check_states["mk"]
+        assert state.state == "Rejected"
+        assert "webhook denied" in state.message
+        assert remote.create_calls == 1
+
+        # Further passes must not re-POST the permanently-invalid mirror.
+        ctl.reconcile()
+        ctl.reconcile()
+        assert remote.create_calls == 1
+
+    def test_one_worker_rejects_other_wins(self):
+        """A rejection on one worker doesn't block dispatch to others."""
+        from kueue_tpu.controllers.multikueue import RemoteRejected
+
+        class RejectingRemote(InProcessRemote):
+            def create_workload(self, wl):
+                raise RemoteRejected("denied")
+
+        mgr = make_manager()
+        w1, w2 = make_cluster_fw(), make_cluster_fw()
+        ctl = MultiKueueController(mgr, check_name="mk")
+        ctl.add_cluster("w1", RejectingRemote(w1))
+        ctl.add_cluster("w2", InProcessRemote(w2))
+
+        wl = Workload(name="w", queue_name="main",
+                      pod_sets=[PodSet.make("main", 1, cpu=2)])
+        mgr.submit(wl)
+        mgr.run_until_settled()
+        ctl.reconcile()
+        w2.run_until_settled()
+        ctl.reconcile()
+        assert wl.admission_check_states["mk"].state == "Ready"
+
+    def test_rejection_with_disconnected_worker_not_permanent(self):
+        """One rejecting worker + one transiently disconnected worker must
+        NOT mark the check Rejected: the disconnected worker might accept
+        after its reconnect (denominator = configured set, not live set)."""
+        from kueue_tpu.controllers.multikueue import RemoteRejected
+
+        class RejectingRemote(InProcessRemote):
+            def create_workload(self, wl):
+                raise RemoteRejected("denied")
+
+        mgr = make_manager()
+        w1, w2 = make_cluster_fw(), make_cluster_fw()
+        down = InProcessRemote(w2)
+        down.set_connected(False)
+        ctl = MultiKueueController(mgr, check_name="mk")
+        ctl.add_cluster("w1", RejectingRemote(w1))
+        ctl.add_cluster("w2", down)
+
+        wl = Workload(name="w", queue_name="main",
+                      pod_sets=[PodSet.make("main", 1, cpu=2)])
+        mgr.submit(wl)
+        mgr.run_until_settled()
+        ctl.reconcile()
+        state = wl.admission_check_states.get("mk")
+        assert state is None or state.state != "Rejected"
+
+        # w2 comes back: dispatch proceeds and the check goes Ready.
+        down.set_connected(True)
+        ctl.reconcile()
+        w2.run_until_settled()
+        ctl.reconcile()
+        assert wl.admission_check_states["mk"].state == "Ready"

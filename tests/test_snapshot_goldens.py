@@ -220,3 +220,44 @@ def test_snapshot_remove_all():
     cohort_usage = snap.cluster_queues["c1"].cohort.usage
     assert all(v == 0 for res in cohort_usage.values()
                for v in res.values())
+
+
+# Regression (advisor round 2, high): a usage-only change on a stopped CQ
+# must not re-insert it into the incremental mirror — the reference's
+# snapshot skips inactive CQs entirely (snapshot.go), so cohort requestable
+# capacity must not bounce back after a workload delete on a drained CQ.
+def test_mirror_skips_inactive_cq_on_usage_change():
+    import dataclasses
+
+    from kueue_tpu.api.types import StopPolicy
+    from kueue_tpu.core.snapshot import SnapshotMirror
+
+    cache = Cache()
+    cache.add_or_update_resource_flavor(make_flavor("default"))
+    spec_a = make_cq("a", rg("cpu", fq("default", cpu=10000)), cohort="co")
+    cache.add_cluster_queue(spec_a)
+    cache.add_cluster_queue(
+        make_cq("b", rg("cpu", fq("default", cpu=10000)), cohort="co"))
+    w = wl("alpha", {"cpu": 2000}, cq="a", flavors={"cpu": "default"})
+    cache.add_or_update_workload(w)
+
+    mirror = SnapshotMirror(cache)
+    snap = mirror.refresh()
+    assert snap.cluster_queues["b"].cohort.requestable_resources == {
+        "default": {"cpu": 20000000}}
+
+    # Stop CQ a: structure bump → full rebuild excludes it.
+    cache.update_cluster_queue(
+        dataclasses.replace(spec_a, stop_policy=StopPolicy.HOLD))
+    snap = mirror.refresh()
+    assert "a" not in snap.cluster_queues
+    assert snap.cluster_queues["b"].cohort.requestable_resources == {
+        "default": {"cpu": 10000000}}
+
+    # Delete the workload on the stopped CQ: usage_version bump only.
+    cache.delete_workload(w)
+    snap = mirror.refresh()
+    assert "a" not in snap.cluster_queues
+    assert snap.cluster_queues["b"].cohort.requestable_resources == {
+        "default": {"cpu": 10000000}}
+    assert snap.cluster_queues["b"].cohort.usage["default"]["cpu"] == 0
