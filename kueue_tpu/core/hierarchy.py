@@ -57,19 +57,28 @@ def _cq_t(cq: CachedClusterQueue, flavor: str, resource: str,
 
 def subtree_t(cohort: Cohort, flavor: str, resource: str,
               ignore_usage: bool = False,
-              memo: Optional[dict] = None) -> int:
+              memo: Optional[dict] = None,
+              extra: Optional[dict] = None) -> int:
     """T(cohort): the balance the subtree can deliver (negative = its
     debt to the rest of the hierarchy). With `memo`, each node is computed
-    once — callers walking several ancestors share one full-tree pass."""
+    once — callers walking several ancestors share one full-tree pass.
+
+    `extra` is {cohort name: {flavor: {resource: val}}} of usage reserved
+    inside each node's subtree but not yet visible in the snapshot (the
+    admission cycle's same-tick bookkeeping, scheduler.go:204-275):
+    subtracted at the node where it was recorded, it propagates upward
+    through the lending clamps like real usage would."""
     if memo is not None and id(cohort) in memo:
         return memo[id(cohort)]
     own = cohort.own_quota(flavor, resource)
     total = own.nominal if own is not None else 0
+    if extra is not None:
+        total -= extra.get(cohort.name, {}).get(flavor, {}).get(resource, 0)
     for member in cohort.members:
         t, lend = _cq_t(member, flavor, resource, ignore_usage)
         total += _clamp(lend, t)
     for child in cohort.children:
-        t = subtree_t(child, flavor, resource, ignore_usage, memo)
+        t = subtree_t(child, flavor, resource, ignore_usage, memo, extra)
         child_own = child.own_quota(flavor, resource)
         lend = child_own.lending_limit if child_own is not None else None
         total += _clamp(lend, t)
@@ -92,11 +101,13 @@ def _node_limits(node: Cohort, flavor: str,
 
 
 def hierarchical_lack(cq: CachedClusterQueue, flavor: str, resource: str,
-                      val: int, ignore_usage: bool = False) -> int:
+                      val: int, ignore_usage: bool = False,
+                      extra: Optional[dict] = None) -> int:
     """Largest T-invariant shortfall along cq's ancestor path after adding
     `val` of (flavor, resource) to it; 0 means the admission keeps every
     balance. With ignore_usage the check runs against an empty tree — the
-    ceiling preemptions could ever free (the borrowWithinCohort bound)."""
+    ceiling preemptions could ever free (the borrowWithinCohort bound).
+    `extra` is per-node same-cycle reserved usage (see subtree_t)."""
     quota = _cq_quota(cq, flavor, resource)
     nominal = quota.nominal if quota is not None else 0
     lend = quota.lending_limit if quota is not None else None
@@ -110,7 +121,7 @@ def hierarchical_lack(cq: CachedClusterQueue, flavor: str, resource: str,
     # for the whole ancestor loop (an ancestor's T reuses its children's).
     memo: dict = {}
     while node is not None:
-        t = subtree_t(node, flavor, resource, ignore_usage, memo)
+        t = subtree_t(node, flavor, resource, ignore_usage, memo, extra)
         t_new = t - delta
         blim, node_lend = _node_limits(node, flavor, resource)
         if blim is not None and t_new < -blim:
@@ -151,11 +162,14 @@ def tree_capacity(root: Cohort) -> dict:
 
 
 def fits_in_hierarchy(cq: CachedClusterQueue, usage, *,
-                      ignore_usage: bool = False) -> bool:
-    """All balances hold after adding a {flavor: {resource: val}} map."""
+                      ignore_usage: bool = False,
+                      extra: Optional[dict] = None) -> bool:
+    """All balances hold after adding a {flavor: {resource: val}} map.
+    `extra` charges per-node same-cycle reservations (see subtree_t)."""
     for flavor, resources in usage.items():
         for resource, val in resources.items():
             if hierarchical_lack(cq, flavor, resource, val,
-                                 ignore_usage=ignore_usage) > 0:
+                                 ignore_usage=ignore_usage,
+                                 extra=extra) > 0:
                 return False
     return True

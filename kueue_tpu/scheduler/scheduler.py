@@ -27,6 +27,7 @@ from kueue_tpu.core.cache import (
     FlavorResourceQuantities,
     frq_add,
 )
+from kueue_tpu.core.hierarchy import fits_in_hierarchy
 from kueue_tpu.core.snapshot import Snapshot, SnapshotMirror
 from kueue_tpu.core.workload import WorkloadInfo, WorkloadOrdering
 from kueue_tpu.queue.manager import Manager, RequeueReason
@@ -334,25 +335,40 @@ class Scheduler:
                 self.metrics.skipped += 1
                 continue
             if cq.cohort is not None:
-                # Cycle bookkeeping spans the whole structure: for
-                # hierarchical trees (KEP-79) two subtrees share capacity,
-                # so the guard keys on the root (root() is self when flat).
-                cohort = cq.cohort.root().name
-                if _has_common_flavor_resources(
-                        cycle_cohorts_usage.get(cohort), e.assignment.usage):
-                    total = _common_usage_sum(
-                        cycle_cohorts_usage[cohort], e.assignment.usage)
-                    if (mode == FIT and not cq.fit_in_cohort(total)) or (
-                            mode == PREEMPT
-                            and cohort in cycle_cohorts_skip_preemption):
-                        e.status = SKIPPED
-                        e.inadmissible_msg = \
-                            "other workloads in the cohort were prioritized"
-                        # Do not skip flavors on the retry (scheduler.go:225-229).
-                        e.info.last_assignment = None
-                        self.metrics.skipped += 1
-                        continue
-                frq_add(cycle_cohorts_usage.setdefault(cohort, {}),
+                # Cycle bookkeeping: this cycle's reservations are not in
+                # the snapshot yet, so track them on the side and re-check
+                # fit against them (scheduler.go:204-275 cohortsUsage).
+                # For hierarchical trees (KEP-79) usage is recorded at the
+                # admitting CQ's own cohort node and charged through the
+                # tree's lending clamps, so an admission in one subtree
+                # only defers siblings where a shared ancestor's capacity
+                # is genuinely consumed — not root-wide. The skip guard
+                # keys on the root (root() is self when flat).
+                root_name = cq.cohort.root().name
+                blocked = (mode == PREEMPT
+                           and root_name in cycle_cohorts_skip_preemption)
+                if not blocked and mode == FIT:
+                    if cq.cohort.is_hierarchical():
+                        if cycle_cohorts_usage and not fits_in_hierarchy(
+                                cq, e.assignment.usage,
+                                extra=cycle_cohorts_usage):
+                            blocked = True
+                    elif _has_common_flavor_resources(
+                            cycle_cohorts_usage.get(root_name),
+                            e.assignment.usage):
+                        total = _common_usage_sum(
+                            cycle_cohorts_usage[root_name],
+                            e.assignment.usage)
+                        blocked = not cq.fit_in_cohort(total)
+                if blocked:
+                    e.status = SKIPPED
+                    e.inadmissible_msg = \
+                        "other workloads in the cohort were prioritized"
+                    # Do not skip flavors on the retry (scheduler.go:225-229).
+                    e.info.last_assignment = None
+                    self.metrics.skipped += 1
+                    continue
+                frq_add(cycle_cohorts_usage.setdefault(cq.cohort.name, {}),
                         _resources_to_reserve(e, cq))
             if mode == FIT and self.pods_ready_gate is not None \
                     and not self.pods_ready_gate():

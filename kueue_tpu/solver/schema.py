@@ -33,6 +33,8 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
 
+import os
+
 import numpy as np
 
 from kueue_tpu import features
@@ -434,11 +436,30 @@ class UsageEncoder:
     falls back to a full row re-read — versions, not trust, decide.
     """
 
+    # When true (KUEUE_TPU_DEBUG_DRIFT=1, or set per-instance), every
+    # refresh re-reads ALL rows and asserts the incrementally-maintained
+    # tensor matches — catches any apply_delta/version drift at the cost
+    # of the full encode this class exists to avoid. Debug builds only.
+    debug_verify = os.environ.get("KUEUE_TPU_DEBUG_DRIFT", "") == "1"
+
     def __init__(self, enc: CQEncoding):
         self.enc = enc
         C, F, R = enc.nominal.shape
         self.usage = np.zeros((C, F, R), dtype=np.int64)
         self._versions: List[Optional[int]] = [None] * C
+
+    def verify(self, snapshot: Snapshot) -> None:
+        """Assert the incremental tensor equals a from-scratch encode.
+        Raises AssertionError naming the drifted ClusterQueues."""
+        fresh = encode_usage(snapshot, self.enc).usage
+        if np.array_equal(fresh, self.usage):
+            return
+        bad = [self.enc.cq_names[ci]
+               for ci in np.nonzero((fresh != self.usage).any(axis=(1, 2)))[0]]
+        raise AssertionError(
+            f"UsageEncoder drift: incremental usage rows for {bad} do not "
+            "match the snapshot (apply_delta out of lockstep with the "
+            "cache version bump)")
 
     def refresh(self, snapshot: Snapshot) -> UsageTensors:
         enc = self.enc
@@ -462,6 +483,10 @@ class UsageEncoder:
                     if ri is not None:
                         frow[ri] = val
             versions[ci] = cq.usage_version
+        if self.debug_verify:
+            # After the loop every row claims to be current; any mismatch
+            # is a version-skipped row that drifted (apply_delta bug).
+            self.verify(snapshot)
         return UsageTensors(usage, enc)
 
     def apply_delta(self, cq_name: str, frq, sign: int = 1) -> None:

@@ -87,11 +87,33 @@ def _validate_preemption(cq: ClusterQueue) -> List[str]:
     return errs
 
 
+# kubebuilder MaxItems on spec.resourceGroups (clusterqueue_types.go).
+_MAX_RESOURCE_GROUPS = 16
+
+
 def _validate_resource_groups(cq: ClusterQueue) -> List[str]:
+    return _resource_group_structure(
+        cq.resource_groups, in_cohort=bool(cq.cohort),
+        no_parent_msg="when cohort is empty")
+
+
+def _resource_group_structure(resource_groups, in_cohort: bool,
+                              no_parent_msg: str,
+                              lending_within_nominal: bool = True
+                              ) -> List[str]:
+    """Shared structural rules for ClusterQueue and Cohort resource groups
+    (clusterqueue_webhook.go:116-236; cohorts reuse the same rule set):
+    group cap, unique resources/flavors, quotas matching coveredResources
+    in order, and borrowing/lending limits only where there is somewhere
+    to borrow from / lend to."""
     errs: List[str] = []
+    if len(resource_groups) > _MAX_RESOURCE_GROUPS:
+        errs.append(f"spec.resourceGroups: must have at most "
+                    f"{_MAX_RESOURCE_GROUPS} groups, got "
+                    f"{len(resource_groups)}")
     seen_resources: set = set()
     seen_flavors: set = set()
-    for gi, rg in enumerate(cq.resource_groups):
+    for gi, rg in enumerate(resource_groups):
         path = f"spec.resourceGroups[{gi}]"
         for res in rg.covered_resources:
             if not _QUALIFIED_NAME.match(res):
@@ -118,16 +140,17 @@ def _validate_resource_groups(cq: ClusterQueue) -> List[str]:
                 if quota.borrowing_limit is not None:
                     if quota.borrowing_limit < 0:
                         errs.append(f"{qpath}.borrowingLimit: must be >= 0")
-                    if not cq.cohort:
+                    if not in_cohort:
                         errs.append(f"{qpath}.borrowingLimit: must be empty "
-                                    "when cohort is empty")
+                                    f"{no_parent_msg}")
                 if quota.lending_limit is not None:
                     if quota.lending_limit < 0:
                         errs.append(f"{qpath}.lendingLimit: must be >= 0")
-                    if not cq.cohort:
+                    if not in_cohort:
                         errs.append(f"{qpath}.lendingLimit: must be empty "
-                                    "when cohort is empty")
-                    elif quota.lending_limit > quota.nominal:
+                                    f"{no_parent_msg}")
+                    elif lending_within_nominal \
+                            and quota.lending_limit > quota.nominal:
                         errs.append(f"{qpath}.lendingLimit: must be <= "
                                     "nominalQuota")
     return errs
@@ -147,26 +170,23 @@ def validate_cluster_queue_update(new: ClusterQueue,
 
 
 def validate_cohort(spec) -> List[str]:
-    """Hierarchical-cohort spec (KEP-79): DNS names, parent != self, and
-    quota sanity at the cohort level."""
+    """Hierarchical-cohort spec (KEP-79): DNS names, parent != self, the
+    same structural resource-group rules as ClusterQueues (group cap,
+    unique flavors/resources, quotas matching coveredResources), and no
+    borrowing/lending limits on a root cohort — a cohort without a parent
+    has nobody to borrow from or lend to."""
     errs = _name_reference(spec.name, "metadata.name")
     if spec.parent:
         errs += _name_reference(spec.parent, "spec.parent")
         if spec.parent == spec.name:
             errs.append("spec.parent: a Cohort cannot be its own parent")
-    for gi, rg in enumerate(spec.resource_groups):
-        path = f"spec.resourceGroups[{gi}]"
-        for fi, fq in enumerate(rg.flavors):
-            for rname, quota in fq.resources:
-                qpath = f"{path}.flavors[{fi}].resources[{rname}]"
-                if quota.nominal < 0:
-                    errs.append(f"{qpath}.nominalQuota: must be >= 0")
-                if quota.borrowing_limit is not None \
-                        and quota.borrowing_limit < 0:
-                    errs.append(f"{qpath}.borrowingLimit: must be >= 0")
-                if quota.lending_limit is not None \
-                        and quota.lending_limit < 0:
-                    errs.append(f"{qpath}.lendingLimit: must be >= 0")
+    # A cohort's lendingLimit caps the whole subtree's outflow (which can
+    # exceed the cohort's own nominal quota), so <= nominal is a
+    # ClusterQueue-only rule.
+    errs += _resource_group_structure(
+        spec.resource_groups, in_cohort=bool(spec.parent),
+        no_parent_msg="on a root Cohort (no parent)",
+        lending_within_nominal=False)
     return errs
 
 

@@ -17,6 +17,12 @@ jax.config.update("jax_platforms", "cpu")
 import pytest
 
 from kueue_tpu import features
+from kueue_tpu.solver.schema import UsageEncoder
+
+# Every refresh in the test suite cross-checks the incremental usage
+# tensor against a from-scratch encode (cheap at test scale; would defeat
+# the encoder's purpose in production).
+UsageEncoder.debug_verify = True
 
 
 @pytest.fixture(autouse=True)

@@ -337,3 +337,48 @@ def test_spec_only_subtree_quota_counts(batch):
     fw.submit(make_wl("w", "lq-a", cpu=10))
     fw.run_until_settled()
     assert fw.admitted_workloads("a") == ["default/w"]
+
+
+def test_sibling_subtrees_admit_same_tick():
+    """The admission-cycle guard charges same-tick reservations to the
+    admitting CQ's own cohort node, not root-wide: an admission in one
+    subtree must not defer an independent sibling subtree (only genuinely
+    shared ancestor capacity defers). Regression for the r1/r2 advisor
+    finding on the per-ancestor-path cycle guard."""
+    fw = framework()
+    fw.create_cohort(cohort("root"))
+    # left cannot lend anything out of its subtree; right is independent.
+    fw.create_cohort(cohort("left", "root",
+                            rg("cpu", fq("default", cpu=(0, None, 0)))))
+    fw.create_cohort(cohort("right", "root",
+                            rg("cpu", fq("default", cpu=(0, None, 0)))))
+    add_cq(fw, "l", 4, "left")
+    add_cq(fw, "r", 4, "right")
+    # Same tick: one head per CQ. Both fit within their own subtrees.
+    fw.submit(make_wl("wl-left", "lq-l", cpu=4, creation_time=1.0))
+    fw.submit(make_wl("wl-right", "lq-r", cpu=4, creation_time=2.0))
+    n = fw.tick()
+    assert n == 2, "sibling subtrees must both admit in one tick"
+    assert fw.admitted_workloads("l") == ["default/wl-left"]
+    assert fw.admitted_workloads("r") == ["default/wl-right"]
+
+
+def test_shared_ancestor_capacity_still_guarded_same_tick():
+    """Two same-tick candidates that both need the SAME ancestor's
+    capacity: the first reserves it, the second must be deferred —
+    the per-node charge still propagates up through lending clamps."""
+    fw = framework()
+    # All capacity lives at the root cohort; both leaves borrow from it.
+    fw.create_cohort(cohort("root", "",
+                            rg("cpu", fq("default", cpu=4))))
+    fw.create_cohort(cohort("left", "root"))
+    fw.create_cohort(cohort("right", "root"))
+    add_cq(fw, "l", 0, "left")
+    add_cq(fw, "r", 0, "right")
+    fw.submit(make_wl("wl-left", "lq-l", cpu=4, creation_time=1.0))
+    fw.submit(make_wl("wl-right", "lq-r", cpu=4, creation_time=2.0))
+    n = fw.tick()
+    assert n == 1, "root capacity admits only one of the two"
+    fw.run_until_settled()
+    total = len(fw.admitted_workloads("l")) + len(fw.admitted_workloads("r"))
+    assert total == 1  # 4 cpu total can't hold both

@@ -179,3 +179,66 @@ class TestOtherKinds:
         new = AdmissionCheck(name="ac", controller_name="b")
         errs = webhooks.validate_admission_check_update(new, old)
         assert any("immutable" in e for e in errs)
+
+
+class TestCohortValidation:
+    """Cohort structural rules (KEP-79; same rule set as ClusterQueues)."""
+
+    def _cohort(self, name="co", parent="", groups=()):
+        from kueue_tpu.api.types import CohortSpec
+        return CohortSpec(name=name, parent=parent,
+                          resource_groups=tuple(groups))
+
+    def test_duplicate_flavor_rejected(self):
+        from kueue_tpu.webhooks.validation import validate_cohort
+        from tests.util import fq, rg
+        spec = self._cohort(parent="root", groups=[
+            rg("cpu", fq("f1", cpu=1), fq("f1", cpu=2))])
+        assert any("duplicate flavor" in e for e in validate_cohort(spec))
+
+    def test_duplicate_resource_rejected(self):
+        from kueue_tpu.webhooks.validation import validate_cohort
+        from tests.util import fq, rg
+        spec = self._cohort(parent="root", groups=[
+            rg("cpu", fq("f1", cpu=1)), rg("cpu", fq("f2", cpu=2))])
+        assert any("duplicate 'cpu'" in e for e in validate_cohort(spec))
+
+    def test_group_cap(self):
+        from kueue_tpu.webhooks.validation import validate_cohort
+        from tests.util import fq, rg
+        groups = [rg(f"res{i}", fq(f"f{i}", **{f"res{i}": 1}))
+                  for i in range(17)]
+        spec = self._cohort(parent="root", groups=groups)
+        assert any("at most 16" in e for e in validate_cohort(spec))
+
+    def test_root_cohort_borrowing_limit_rejected(self):
+        from kueue_tpu.api.types import FlavorQuotas, ResourceQuota
+        from kueue_tpu.webhooks.validation import validate_cohort
+        from tests.util import rg
+        f = FlavorQuotas(name="f1", resources=(
+            ("cpu", ResourceQuota(nominal=1000, borrowing_limit=500)),))
+        spec = self._cohort(groups=[rg("cpu", f)])  # no parent = root
+        assert any("borrowingLimit" in e and "root Cohort" in e
+                   for e in validate_cohort(spec))
+        # With a parent the same spec is fine.
+        spec = self._cohort(parent="root", groups=[rg("cpu", f)])
+        assert validate_cohort(spec) == []
+
+    def test_cohort_lending_limit_may_exceed_nominal(self):
+        from kueue_tpu.api.types import FlavorQuotas, ResourceQuota
+        from kueue_tpu.webhooks.validation import validate_cohort
+        from tests.util import rg
+        f = FlavorQuotas(name="f1", resources=(
+            ("cpu", ResourceQuota(nominal=0, lending_limit=2000)),))
+        spec = self._cohort(parent="root", groups=[rg("cpu", f)])
+        assert validate_cohort(spec) == []
+
+
+class TestClusterQueueGroupCap:
+    def test_group_cap(self):
+        from kueue_tpu.webhooks.validation import validate_cluster_queue
+        from tests.util import fq, make_cq, rg
+        groups = [rg(f"res{i}", fq(f"f{i}", **{f"res{i}": 1}))
+                  for i in range(17)]
+        cq = make_cq("cq", *groups)
+        assert any("at most 16" in e for e in validate_cluster_queue(cq))
