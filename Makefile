@@ -1,0 +1,39 @@
+# Developer entry points (reference: Makefile test/test-integration/bench).
+
+PYTHON ?= python
+
+.PHONY: test test-fast bench bench-smoke native lint install serve dryrun
+
+test:
+	$(PYTHON) -m pytest tests/ -q
+
+test-fast:
+	$(PYTHON) -m pytest tests/ -q -x
+
+# Full-scale benchmark (50k x 1k x 8 north-star shape); runs on whatever
+# jax backend is available. One JSON line per metric on stdout.
+bench:
+	$(PYTHON) bench.py
+
+# Small-shape smoke variant for CI / laptops.
+bench-smoke:
+	KUEUE_BENCH_SMOKE=1 $(PYTHON) bench.py
+
+# Build the C++ runtime pieces (keyed heap, admission decoder) explicitly;
+# they are also built lazily on first import.
+native:
+	$(PYTHON) -c "from kueue_tpu.utils import native_heap, native_decode; \
+	  print('heap:', native_heap.native_available(), \
+	        'decode:', native_decode.decode_available())"
+
+install:
+	$(PYTHON) -m pip install -e .
+
+serve:
+	$(PYTHON) -m kueue_tpu --serve --port 8082
+
+# Compile-check the flagship jit path single-chip and on a virtual
+# 8-device mesh.
+dryrun:
+	$(PYTHON) -c "import __graft_entry__ as g; fn, a = g.entry(); fn(*a); print('entry OK')"
+	$(PYTHON) __graft_entry__.py
