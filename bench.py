@@ -1,22 +1,31 @@
-"""Admission-solve benchmark.
+"""End-to-end scheduling-tick benchmark.
 
-Shape: the north-star target from BASELINE.md -- 1k ClusterQueues x 100
-cohorts x 8 ResourceFlavors with a 50k-deep pending backlog. The reference
-admits one head per ClusterQueue per scheduling cycle (manager.go:489-508),
-so each tick nominates <=1k workloads; the backlog drains across ticks.
+Unlike the round-1/2 proxy (which timed the solver kernel on a hand-rolled
+harness), this drives the REAL product: `Framework.tick()` — heap pops,
+incremental snapshot mirror, batched device solve (pipelined, depth 8),
+preemption-target search, entry ordering, the one-borrow-per-cohort
+admission cycle with staleness re-validation, assume/apply, requeues and
+the reconcile pass — at the north-star shape from BASELINE.md:
+50k pending Workloads x 1k ClusterQueues x 100 cohorts x 8 flavors.
 
-The timed region is one tick's nomination solve -- what the reference does
-sequentially in nominate()/flavorassigner.Assign (scheduler.go:317-351) --
-here as: usage tensor refresh + batched device solve + decision decode.
-The ClusterQueue-side encoding is static across ticks (keyed on allocatable
-generations) and the backlog is pre-encoded once, modeling the incremental
-encoder of the production scheduler.
+Two configs run:
+  1. BASELINE config #3 (preemption-heavy): reclaimWithinCohort=Any +
+     borrowWithinCohort=LowerPriority + priority classes; most nominations
+     preempt victims (preemption.go:81-231 path).
+  2. North-star admission mix (config #5 shape): the headline metric.
 
-Prints ONE JSON line:
-  {"metric": "p99_tick_solve_ms", "value": ..., "unit": "ms",
+Steady-state churn: workloads admitted N ticks ago finish (releasing quota
+and flushing cohort parking lots) and a fresh workload is submitted per
+finish — the reference perf harness's arrival/completion flux
+(test/performance/config.yaml) at north-star scale, so the backlog stays
+deep and every tick does real admission work.
+
+Prints one JSON line per config; the LAST line is the headline metric:
+  {"metric": "p99_e2e_tick_ms", "value": ..., "unit": "ms",
    "vs_baseline": <north-star 100ms / value>}
 
-Env knobs: KUEUE_BENCH_SMOKE=1 (tiny shapes), KUEUE_BENCH_TICKS=N.
+Env knobs: KUEUE_BENCH_SMOKE=1 (tiny shapes), KUEUE_BENCH_TICKS=N,
+KUEUE_BENCH_DEPTH=N (pipeline depth, default 8).
 """
 
 from __future__ import annotations
@@ -24,172 +33,148 @@ from __future__ import annotations
 import gc
 import json
 import os
+import random
 import sys
 import time
+from collections import deque
 
 import numpy as np
 
+# How many ticks an admitted workload runs before the churn loop finishes
+# it (quota release + cohort flush + replacement submission).
+LINGER_TICKS = 5
 
-def main() -> None:
-    smoke = os.environ.get("KUEUE_BENCH_SMOKE") == "1"
-    if smoke:
-        num_cqs, num_cohorts, num_flavors = 32, 8, 4
-        backlog, ticks = 256, 12
-    else:
-        num_cqs, num_cohorts, num_flavors = 1000, 100, 8
-        backlog, ticks = 50_000, int(os.environ.get("KUEUE_BENCH_TICKS", "50"))
-    heads_per_tick = num_cqs
 
-    from kueue_tpu.models.flavor_fit import (
-        decode_assignments,
-        device_static,
-        fetch_outputs,
-        fit_usage_delta,
-        solve_flavor_fit_async,
-    )
-    from kueue_tpu.solver import schema as sch
-    from kueue_tpu.utils.synthetic import synthetic_problem
-
-    import jax
+def run_config(*, label, num_cqs, num_cohorts, num_flavors, backlog, ticks,
+               usage_fill, depth, preemption_heavy, seed=42):
+    from kueue_tpu.models.flavor_fit import BatchSolver
+    from kueue_tpu.api.types import PodSet, Workload
+    from kueue_tpu.utils.synthetic import synthetic_framework
 
     t0 = time.perf_counter()
-    cache, pending = synthetic_problem(
+    fw = synthetic_framework(
         num_cqs=num_cqs, num_cohorts=num_cohorts, num_flavors=num_flavors,
-        num_pending=backlog, usage_fill=0.7, seed=42)
-    snapshot = cache.snapshot()
-    t_gen = time.perf_counter() - t0
+        num_pending=backlog, usage_fill=usage_fill, seed=seed,
+        preemption_heavy=preemption_heavy,
+        batch_solver=BatchSolver(), pipeline_depth=depth)
+    t_setup = time.perf_counter() - t0
 
-    t0 = time.perf_counter()
-    enc = sch.encode_cluster_queues(snapshot)
-    static = device_static(enc)
-    # Pre-encode the whole backlog once (incremental-encoder model).
-    wt_all = sch.encode_workloads(pending, snapshot, enc,
-                                  pad_to=len(pending))
-    t_enc = time.perf_counter() - t0
+    # Track admissions as they apply so churn can finish them later
+    # without scanning the 50k-workload map per tick.
+    admitted_log: deque = deque()
+    tick_no = [0]
+    orig_apply = fw.scheduler.apply_admission
 
-    usage_enc = sch.UsageEncoder(enc)
+    def apply_admission(wl):
+        ok = orig_apply(wl)
+        if ok:
+            admitted_log.append((tick_no[0], wl))
+        return ok
 
-    def slice_wt(lo: int, hi: int) -> sch.WorkloadTensors:
-        return sch.WorkloadTensors(
-            wl_cq=wt_all.wl_cq[lo:hi], req=wt_all.req[lo:hi],
-            has_req=wt_all.has_req[lo:hi],
-            podset_valid=wt_all.podset_valid[lo:hi],
-            podset_unsat=wt_all.podset_unsat[lo:hi],
-            elig=wt_all.elig[lo:hi], resume_slot=wt_all.resume_slot[lo:hi],
-            wl_valid=wt_all.wl_valid[lo:hi], num_real=hi - lo)
+    fw.scheduler.apply_admission = apply_admission
 
-    def dispatch(i: int):
-        """Stage 1: per-tick usage refresh + encode + async device solve."""
-        lo = (i * heads_per_tick) % backlog
-        hi = min(lo + heads_per_tick, backlog)
-        # Incremental refresh: re-reads only rows whose usage_version moved
-        # (all hits in steady state -- admissions arrive via apply_batch).
-        usage = usage_enc.refresh(snapshot)
-        wt = slice_wt(lo, hi)
-        return lo, wt, solve_flavor_fit_async(enc, usage, wt, static=static)
+    rnd = random.Random(seed + 1)
+    submit_seq = [0]
 
-    folded = set()
+    def submit_replacement():
+        """A fresh arrival with the generator's distribution; in the
+        preemption config arrivals alternate low/high priority so the
+        preemption flux sustains (victims to preempt keep existing)."""
+        submit_seq[0] += 1
+        i = submit_seq[0]
+        c = rnd.randrange(num_cqs)
+        if preemption_heavy:
+            priority = rnd.randint(1, 5) if i % 2 else rnd.randint(-2, 0)
+        else:
+            priority = rnd.randint(-2, 2)
+        fw.submit(Workload(
+            name=f"churn-{label}-{i}", namespace="default",
+            queue_name=f"lq-{c}", priority=priority,
+            creation_time=float(100_000 + i),
+            pod_sets=[PodSet.make(
+                "ps0", count=rnd.randint(1, 8), cpu=rnd.randint(1, 8),
+                memory=f"{rnd.randint(1, 16)}Gi")]))
 
-    def collect(pending_tick):
-        """Stage 2+3: fetch the in-flight solve, decode decisions, and fold
-        the admitted usage back into the incremental encoder (the batched
-        mirror of the scheduler's assume fast path). A wrapped-around slice
-        (ticks > backlog/heads) is re-solved but not re-folded: its
-        workloads were already admitted once."""
-        lo, wt, handle = pending_tick
-        out = fetch_outputs(handle)
-        batch = pending[lo:lo + wt.num_real]
-        assignments = decode_assignments(batch, snapshot, enc, out)
-        if lo not in folded:
-            folded.add(lo)
-            delta, touched = fit_usage_delta(out, wt, enc)
-            usage_enc.apply_batch(delta, touched)
-            for ci in touched.tolist():
-                # The cache's version bump from assume_workload; encoder and
-                # cache advance in lockstep (BatchSolver.note_admission).
-                snapshot.cluster_queues[enc.cq_names[ci]].usage_version += 1
-        return out, assignments
+    def churn():
+        """Completion flux: finish workloads admitted LINGER_TICKS ago."""
+        while admitted_log and admitted_log[0][0] <= tick_no[0] - LINGER_TICKS:
+            _, wl = admitted_log.popleft()
+            if wl.is_admitted and not wl.is_finished:
+                fw.finish(wl)
+                submit_replacement()
 
-    # The tick pipeline. A synchronized device round trip on a
-    # remote-attached TPU costs ~100x the solve itself, so the scheduler
-    # keeps `depth` nomination solves in flight: while tick i's outputs
-    # cross back over the wire, ticks i+1..i+depth dispatch and tick i-1
-    # decodes. Depth 1 (KUEUE_BENCH_DEPTH=1) is the fully synchronous
-    # reference mode. Timing covers the steady state only: pipeline fill
-    # and drain are excluded from the samples (and from the decision
-    # counts, so decisions/s matches the timed window).
-    depth = max(1, int(os.environ.get("KUEUE_BENCH_DEPTH", "8")))
-    depth = min(depth, max(1, ticks - 1))
+    # Warmup: compile the solve for the steady-state head-count bucket and
+    # fill the pipeline.
+    warmup = depth + 6
+    preempted_before = fw.scheduler.metrics.preempted
+    for _ in range(warmup):
+        tick_no[0] += 1
+        fw.tick()
+        churn()
 
-    # Warmup (compile), then reset the encoder state so the warmup tick's
-    # admitted usage isn't double-counted when tick 0 runs again below
-    # (the snapshot's bumped versions force a full clean re-read).
-    collect(dispatch(0))
-    usage_enc = sch.UsageEncoder(enc)
-    folded.clear()
-
-    # Long-running-scheduler GC discipline: the setup objects (50k encoded
-    # workloads, the snapshot) are permanent; keep collector passes from
-    # stalling the tick loop. Per-tick garbage is acyclic and dies by
-    # refcount.
+    # Long-running-scheduler GC discipline: the permanent objects (50k
+    # workloads, the mirror) should not be re-traced by collector passes
+    # mid-tick; per-tick garbage is acyclic and dies by refcount.
     gc.collect()
     gc.freeze()
     gc.set_threshold(200_000, 100, 100)
 
     times = []
-    decisions = 0
-    fit_count = 0
-    if ticks <= depth:
-        # Degenerate run (e.g. KUEUE_BENCH_TICKS=1): synchronous timing.
-        for i in range(ticks):
-            t0 = time.perf_counter()
-            out, assignments = collect(dispatch(i))
-            times.append(time.perf_counter() - t0)
-            decisions += len(assignments)
-            fit_count += int((out["wl_mode"][:len(assignments)] == 2).sum())
-    else:
-        # Fill: the first `depth` solves go in flight untimed.
-        inflight = [dispatch(i) for i in range(depth)]
-        # Warmup: drain the fill backlog off the device queue untimed --
-        # the first few collects wait out solves that queued back-to-back
-        # during fill, which is startup transient, not steady-state tick
-        # latency.
-        warm = min(depth + 2, max(0, ticks - depth - 8))
-        for i in range(depth, depth + warm):
-            inflight.append(dispatch(i))
-            collect(inflight.pop(0))
-        # Steady state: each iteration dispatches one tick and collects the
-        # oldest in-flight one; collect-to-collect interval is the sample.
-        t_prev = time.perf_counter()
-        for i in range(depth + warm, ticks):
-            inflight.append(dispatch(i))
-            out, assignments = collect(inflight.pop(0))
-            decisions += len(assignments)
-            fit_count += int((out["wl_mode"][:len(assignments)] == 2).sum())
-            now = time.perf_counter()
-            times.append(now - t_prev)
-            t_prev = now
-        # Drain: completes the run but contributes no samples or counts.
-        for pending_tick in inflight:
-            collect(pending_tick)
+    admitted = 0
+    base_admitted = fw.scheduler.metrics.admitted
+    for _ in range(ticks):
+        tick_no[0] += 1
+        t = time.perf_counter()
+        fw.tick()
+        times.append(time.perf_counter() - t)
+        churn()
+    admitted = fw.scheduler.metrics.admitted - base_admitted
+    preempted = fw.scheduler.metrics.preempted - preempted_before
+    gc.unfreeze()
+    gc.set_threshold(700, 10, 10)
 
     times_ms = np.array(times) * 1000.0
     p50 = float(np.percentile(times_ms, 50))
     p99 = float(np.percentile(times_ms, 99))
-    decisions_per_sec = decisions / (sum(times) or 1e-9)
-
+    import jax
     print(
-        f"# shape: {num_cqs} CQs x {num_cohorts} cohorts x {num_flavors} "
-        f"flavors, backlog {backlog}, {heads_per_tick} heads/tick, "
-        f"{ticks} ticks on {jax.default_backend()}, pipeline depth {depth}\n"
-        f"# setup: generate {t_gen:.2f}s, encode {t_enc:.2f}s\n"
-        f"# tick solve: p50 {p50:.2f}ms  p99 {p99:.2f}ms  "
-        f"({decisions_per_sec:,.0f} decisions/s; {fit_count}/{decisions} Fit)",
+        f"# [{label}] {num_cqs} CQs x {num_cohorts} cohorts x {num_flavors} "
+        f"flavors, backlog {backlog}, {ticks} ticks on "
+        f"{jax.default_backend()}, depth {depth}, setup {t_setup:.1f}s\n"
+        f"# [{label}] e2e tick: p50 {p50:.2f}ms  p99 {p99:.2f}ms  "
+        f"({admitted} admitted, {preempted} preempted, "
+        f"{admitted / (sum(times) or 1e-9):,.0f} admissions/s)",
         file=sys.stderr)
+    return p50, p99
+
+
+def main() -> None:
+    smoke = os.environ.get("KUEUE_BENCH_SMOKE") == "1"
+    depth = max(1, int(os.environ.get("KUEUE_BENCH_DEPTH", "8")))
+    if smoke:
+        shape = dict(num_cqs=32, num_cohorts=8, num_flavors=4, backlog=512)
+        ticks = int(os.environ.get("KUEUE_BENCH_TICKS", "12"))
+    else:
+        shape = dict(num_cqs=1000, num_cohorts=100, num_flavors=8,
+                     backlog=50_000)
+        ticks = int(os.environ.get("KUEUE_BENCH_TICKS", "60"))
+
+    # BASELINE config #3: preemption-heavy.
+    _, p99_pre = run_config(
+        label="preempt", ticks=max(ticks // 2, 8), usage_fill=0.9,
+        depth=depth, preemption_heavy=True, **shape)
     print(json.dumps({
-        "metric": "p99_tick_solve_ms",
-        "value": round(p99, 3),
+        "metric": "p99_preemption_tick_ms", "value": round(p99_pre, 3),
         "unit": "ms",
+        "vs_baseline": round(100.0 / p99_pre, 3) if p99_pre > 0 else None,
+    }))
+
+    # North-star headline (config #5 shape): LAST line = parsed metric.
+    _, p99 = run_config(
+        label="northstar", ticks=ticks, usage_fill=0.7, depth=depth,
+        preemption_heavy=False, **shape)
+    print(json.dumps({
+        "metric": "p99_e2e_tick_ms", "value": round(p99, 3), "unit": "ms",
         "vs_baseline": round(100.0 / p99, 3) if p99 > 0 else None,
     }))
 

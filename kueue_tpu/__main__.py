@@ -100,6 +100,9 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--batch-solver", action="store_true",
                         help="solve each tick's nominations as one batched "
                         "device program (TPU path)")
+    parser.add_argument("--pipeline-depth", type=int, default=None,
+                        help="keep N ticks' device solves in flight "
+                        "(overrides tpuSolver.pipelineDepth; default 1)")
     parser.add_argument("--leader-elect", action="store_true",
                         help="join lease-based leader election")
     parser.add_argument("--dump-state", action="store_true",
@@ -120,7 +123,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         from kueue_tpu.models.flavor_fit import BatchSolver
         batch_solver = BatchSolver()
 
-    fw = Framework(batch_solver=batch_solver, config=cfg)
+    fw = Framework(batch_solver=batch_solver, config=cfg,
+                   pipeline_depth=args.pipeline_depth)
     store = Store()
     adapter = StoreAdapter(store, fw)
 

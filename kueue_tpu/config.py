@@ -120,6 +120,21 @@ class MultiKueueConfig:
 
 
 @dataclass(frozen=True)
+class TPUSolverConfig:
+    """TPU solve-path knobs — this build's extension to the reference
+    Configuration (the north-star gRPC/JAX boundary of SURVEY §2.5).
+
+    `pipeline_depth` > 1 keeps that many ticks' device solves in flight
+    while older ticks complete host-side (admission-safe via the
+    scheduler's staleness re-validation); 1 is the reference-equivalent
+    synchronous mode. `preemption_engine` selects the minimal-preemptions
+    engine: None = host referee, "jax"/"pallas" = device scan."""
+    enable: bool = False
+    pipeline_depth: int = 1
+    preemption_engine: Optional[str] = None
+
+
+@dataclass(frozen=True)
 class LeaderElectionConfig:
     """Lease-based leader election for HA replicas
     (configv1alpha1.LeaderElectionConfiguration; defaults.go:37-44)."""
@@ -142,6 +157,7 @@ class Configuration:
     integrations: Integrations = field(default_factory=Integrations)
     multikueue: MultiKueueConfig = field(default_factory=MultiKueueConfig)
     leader_election: LeaderElectionConfig = field(default_factory=LeaderElectionConfig)
+    tpu_solver: TPUSolverConfig = field(default_factory=TPUSolverConfig)
     # Transport-only reference knobs, carried opaquely (see module doc).
     extra: Dict[str, Any] = field(default_factory=dict)
 
@@ -284,6 +300,14 @@ def from_dict(doc: Mapping[str, Any]) -> Configuration:
                 DEFAULT_MULTIKUEUE_WORKER_LOST_TIMEOUT_SECONDS,
                 "multiKueue.workerLostTimeout"))
 
+    ts = TPUSolverConfig()
+    if doc.get("tpuSolver") is not None:
+        t = doc["tpuSolver"]
+        ts = TPUSolverConfig(
+            enable=bool(t.get("enable", False)),
+            pipeline_depth=int(t.get("pipelineDepth", 1)),
+            preemption_engine=t.get("preemptionEngine"))
+
     le = LeaderElectionConfig()
     if doc.get("leaderElection") is not None:
         l = doc["leaderElection"]
@@ -310,6 +334,7 @@ def from_dict(doc: Mapping[str, Any]) -> Configuration:
         integrations=integrations,
         multikueue=mk,
         leader_election=le,
+        tpu_solver=ts,
         extra={k: doc[k] for k in _TRANSPORT_KEYS if k in doc},
     )
     errors = validate_configuration(cfg)
@@ -414,6 +439,13 @@ def validate_configuration(cfg: Configuration) -> List[str]:
         errors.append("multiKueue.gcInterval: must not be negative")
     if cfg.multikueue.worker_lost_timeout_seconds < 0:
         errors.append("multiKueue.workerLostTimeout: must not be negative")
+
+    # tpuSolver
+    if cfg.tpu_solver.pipeline_depth < 1:
+        errors.append("tpuSolver.pipelineDepth: must be >= 1")
+    if cfg.tpu_solver.preemption_engine not in (None, "jax", "pallas"):
+        errors.append("tpuSolver.preemptionEngine: must be one of "
+                      "jax, pallas (or omitted for the host referee)")
 
     # leaderElection
     le = cfg.leader_election

@@ -53,17 +53,22 @@ class Framework:
     def __init__(self, batch_solver=None,
                  config: Optional[Configuration] = None,
                  ordering: Optional[WorkloadOrdering] = None,
-                 pipeline_depth: int = 1,
+                 pipeline_depth: Optional[int] = None,
                  clock: Callable[[], float] = _time.time):
         self.clock = clock
+        self.config = config or Configuration()
         # Pipelined scheduling (depth > 1): keep up to depth-1 ticks'
         # device solves in flight while completing older ticks host-side.
         # Decisions stay admission-safe via the scheduler's staleness
         # re-validation; depth 1 is the reference-equivalent synchronous
-        # mode.
+        # mode. Defaults from the Configuration's tpuSolver section.
+        if pipeline_depth is None:
+            pipeline_depth = self.config.tpu_solver.pipeline_depth
         self.pipeline_depth = max(1, pipeline_depth)
         self._inflight_ticks: List = []
-        self.config = config or Configuration()
+        if batch_solver is None and self.config.tpu_solver.enable:
+            from kueue_tpu.models.flavor_fit import BatchSolver
+            batch_solver = BatchSolver()
         wfpr = self.config.wait_for_pods_ready
         if ordering is None:
             ordering = WorkloadOrdering(
@@ -106,6 +111,7 @@ class Framework:
             pods_ready_gate=gate,
             fair_strategies=fair_strategies,
             workload_validator=self._validate_workload_resources,
+            preemption_engine=self.config.tpu_solver.preemption_engine,
             clock=clock)
         self._evicted_dirty: List[Workload] = []
         # Workloads whose admission-check state machine needs attention
@@ -355,15 +361,30 @@ class Framework:
         self.events.event(wl.key, events_mod.NORMAL,
                           events_mod.REASON_FINISHED, "Workload finished",
                           now=self.clock())
-        self.cache.delete_workload(wl)
+        if self.cache.delete_workload(wl):
+            self._note_quota_released(wl)
         self.queues.delete_workload(wl)
         self.queues.queue_associated_inadmissible_workloads(wl)
 
     def delete_workload(self, wl: Workload) -> None:
         self.workloads.pop(wl.key, None)
-        self.cache.delete_workload(wl)
+        if self.cache.delete_workload(wl):
+            self._note_quota_released(wl)
         self.queues.delete_workload(wl)
         self.queues.queue_associated_inadmissible_workloads(wl)
+
+    def _note_quota_released(self, wl: Workload) -> None:
+        """Lockstep-mirror a quota release (finish / delete / eviction)
+        into the scheduler's incremental snapshot and the solver's usage
+        tensor, so completion flux doesn't force per-CQ re-clones and
+        tensor row re-reads every tick (the same discipline _admit applies
+        on the admission side)."""
+        self.scheduler._mirror.note_removal(wl)
+        bs = self.scheduler.batch_solver
+        note = getattr(bs, "note_removal", None)
+        if note is not None and wl.admission is not None:
+            wi = WorkloadInfo(wl, cluster_queue=wl.admission.cluster_queue)
+            note(wl.admission.cluster_queue, wi.usage())
 
     def set_admission_check_state(self, wl: Workload, check: str, state: str,
                                   message: str = "") -> None:
@@ -453,7 +474,8 @@ class Framework:
         evicted, self._evicted_dirty = self._evicted_dirty, []
         for wl in evicted:
             if wl.has_quota_reservation:
-                self.cache.delete_workload(wl)
+                if self.cache.delete_workload(wl):
+                    self._note_quota_released(wl)
                 wl.admission = None
                 wl.set_condition(CONDITION_QUOTA_RESERVED, False,
                                  reason="Evicted", now=self.clock())
@@ -556,10 +578,14 @@ class Framework:
             if tick is not None:
                 self._inflight_ticks.append(tick)
             admitted = 0
-            # Complete the oldest tick(s): all of them when the queue ran
-            # dry (drain), else enough to keep depth-1 in flight.
-            keep = self.pipeline_depth - 1 if tick is not None else 0
-            while len(self._inflight_ticks) > keep:
+            # Complete the oldest tick; when the queue ran dry, drain one
+            # in-flight tick per call instead of all of them — a burst
+            # drain would multiply a single tick's latency by the pipeline
+            # depth (p99 spike), and progressive drain preserves the same
+            # eventual state across run_until_settled.
+            keep = self.pipeline_depth - 1 if tick is not None \
+                else len(self._inflight_ticks) - 1
+            while len(self._inflight_ticks) > max(keep, 0):
                 admitted += self.scheduler.schedule_finish(
                     self._inflight_ticks.pop(0))
         self.reconcile()

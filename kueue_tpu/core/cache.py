@@ -441,24 +441,30 @@ class Cache:
             cq.add_workload_usage(wi, admitted=wl.is_admitted)
             return True
 
-    def delete_workload(self, wl: Workload) -> None:
+    def delete_workload(self, wl: Workload) -> bool:
+        """Returns whether usage was actually released (the workload was
+        accounted) — callers mirroring the release into incremental
+        encoders must not subtract usage that was never added."""
         with self._lock:
-            self._delete_workload_locked(wl)
+            return self._delete_workload_locked(wl)
 
-    def _delete_workload_locked(self, wl: Workload) -> None:
+    def _delete_workload_locked(self, wl: Workload) -> bool:
         key = wl.key
         cq_name = self.assumed_workloads.get(key)
         if cq_name is None and wl.admission is not None:
             cq_name = wl.admission.cluster_queue
         if cq_name is None:
-            return
+            return False
+        released = False
         cq = self.cluster_queues.get(cq_name)
         if cq is not None and key in cq.workloads:
             wi = cq.workloads[key]
             cq.remove_workload_usage(wi, admitted=wl.is_admitted)
             # Quota was freed: resume states against this CQ are now stale.
             cq.allocatable_generation += 1
+            released = True
         self.assumed_workloads.pop(key, None)
+        return released
 
     def assume_workload(self, wl: Workload) -> None:
         """Optimistically account a just-admitted workload before the API

@@ -97,7 +97,7 @@ class WorkloadInfo:
     """
 
     __slots__ = ("obj", "cluster_queue", "_total_requests", "_usage_triples",
-                 "last_assignment", "rev")
+                 "last_assignment", "rev", "row_sig")
 
     # Monotonic instance stamp: a process-unique identity that, unlike
     # id(), is never recycled after GC — the solver's row cache keys
@@ -114,12 +114,37 @@ class WorkloadInfo:
         self._usage_triples = None
         self.last_assignment: Optional[AssignmentClusterQueueState] = None
         self.rev = next(WorkloadInfo._rev_counter)
+        # Lazily computed row-cache content signature (solver/schema.py
+        # WorkloadRowCache._sig); False = unhashable, None = not computed.
+        self.row_sig = None
 
     @property
     def total_requests(self) -> List[PodSetResources]:
         totals = self._total_requests
         if totals is None:
-            totals = self._total_requests = self._compute_totals(self.obj)
+            # Totals are memoized on the Workload object itself: the hot
+            # accounting paths (cache assume/forget, mirror lockstep)
+            # build a fresh WorkloadInfo per call, and recomputing the
+            # per-podset totals dominated the end-to-end tick at north-star
+            # scale. The memo basis pins the exact inputs of
+            # _compute_totals by identity (admission, pod_sets) and value
+            # (reclaimable counts, podset counts); any replacement or
+            # count change recomputes. The totals list is shared read-only
+            # across infos — nothing mutates PodSetResources in place
+            # (scaled_to returns new objects).
+            wl = self.obj
+            reclaim = tuple(sorted(wl.reclaimable_pods.items()))
+            counts = tuple(ps.count for ps in wl.pod_sets)
+            memo = getattr(wl, "_totals_memo", None)
+            if (memo is not None and memo[0] is wl.admission
+                    and memo[1] == reclaim and memo[2] is wl.pod_sets
+                    and memo[3] == counts):
+                totals = memo[4]
+            else:
+                totals = self._compute_totals(wl)
+                wl._totals_memo = (wl.admission, reclaim, wl.pod_sets,
+                                   counts, totals)
+            self._total_requests = totals
             self._usage_triples = None
         return totals
 
@@ -206,4 +231,5 @@ class WorkloadInfo:
         c._usage_triples = None
         c.last_assignment = self.last_assignment
         c.rev = next(WorkloadInfo._rev_counter)
+        c.row_sig = None
         return c

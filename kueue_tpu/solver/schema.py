@@ -118,6 +118,11 @@ class CQEncoding:
     num_slots: int
 
     # Lazy memos (the encoding is immutable once built).
+    # Per-CQ eligibility [G,S] for "trivial" podsets (no tolerations, node
+    # selectors or affinity terms) — the common case; _encode_row copies
+    # this instead of running the per-flavor string matching.
+    _trivial_elig: Dict[str, np.ndarray] = field(
+        default_factory=dict, repr=False, compare=False)
     _cohort_requestable: Optional[np.ndarray] = field(
         default=None, repr=False, compare=False)
     _cohort_perm: Optional[np.ndarray] = field(
@@ -524,16 +529,11 @@ class UsageEncoder:
 class _Row:
     """One workload's usage-independent encoded columns (cacheable)."""
 
-    __slots__ = ("wi_rev", "ci", "req", "has_req", "unsat", "elig",
+    __slots__ = ("ci", "req", "has_req", "unsat", "elig",
                  "requests_per_podset")
 
-    def __init__(self, wi_rev, ci, req, has_req, unsat, elig,
+    def __init__(self, ci, req, has_req, unsat, elig,
                  requests_per_podset):
-        # WorkloadInfo.rev of the encoded info: a never-recycled monotonic
-        # stamp (unlike id(), which the allocator reuses after GC, and
-        # unlike a strong reference, which would pin finished workloads in
-        # the cache until the wholesale clear).
-        self.wi_rev = wi_rev
         self.ci = ci
         self.req = req                      # [p, R] int64
         self.has_req = has_req              # [p, R] bool
@@ -555,8 +555,7 @@ def _encode_row(wi: WorkloadInfo, cq, snapshot: Snapshot, enc: CQEncoding,
     elig = np.zeros((p_count, G, S), dtype=bool)
     requests_per_podset = []
 
-    group_keys = [cq.label_keys(rg, snapshot.resource_flavors)
-                  for rg in cq.resource_groups]
+    group_keys = None
     for p, ps in enumerate(totals):
         requests = dict(ps.requests)
         if PODS_RESOURCE in cq.rg_by_resource:
@@ -573,8 +572,18 @@ def _encode_row(wi: WorkloadInfo, cq, snapshot: Snapshot, enc: CQEncoding,
             has_req[p, ri] = True
 
         # Eligibility per (group, slot): each group's label keys scope
-        # the affinity match.
+        # the affinity match. A podset with no tolerations / selectors /
+        # affinity (the common case) shares the CQ's precomputed trivial
+        # mask — only flavor taints matter for it, and those are
+        # podset-independent.
         podset = wi.obj.pod_sets[p]
+        if not (podset.tolerations or podset.node_selector
+                or podset.affinity_terms):
+            elig[p] = _trivial_elig(cq, snapshot, enc)
+            continue
+        if group_keys is None:
+            group_keys = [cq.label_keys(rg, snapshot.resource_flavors)
+                          for rg in cq.resource_groups]
         for gi, rg in enumerate(cq.resource_groups):
             for si, fquotas in enumerate(rg.flavors):
                 flavor = snapshot.resource_flavors.get(fquotas.name)
@@ -582,40 +591,98 @@ def _encode_row(wi: WorkloadInfo, cq, snapshot: Snapshot, enc: CQEncoding,
                     continue
                 ok, _ = flavor_eligible(podset, flavor, group_keys[gi])
                 elig[p, gi, si] = ok
-    return _Row(wi.rev, enc.cq_index[wi.cluster_queue], req, has_req, unsat,
+    return _Row(enc.cq_index[wi.cluster_queue], req, has_req, unsat,
                 elig, requests_per_podset)
 
 
+_EMPTY_PODSET = None
+
+
+def _trivial_elig(cq, snapshot: Snapshot, enc: CQEncoding) -> np.ndarray:
+    """Per-CQ [G,S] eligibility of a podset with no tolerations/selectors/
+    affinity: only the flavors' own taints can exclude it."""
+    m = enc._trivial_elig.get(cq.name)
+    if m is None:
+        global _EMPTY_PODSET
+        if _EMPTY_PODSET is None:
+            from kueue_tpu.api.types import PodSet
+            _EMPTY_PODSET = PodSet(name="", count=1)
+        m = np.zeros((enc.num_groups, enc.num_slots), dtype=bool)
+        for gi, rg in enumerate(cq.resource_groups):
+            keys = cq.label_keys(rg, snapshot.resource_flavors)
+            for si, fquotas in enumerate(rg.flavors):
+                flavor = snapshot.resource_flavors.get(fquotas.name)
+                if flavor is None:
+                    continue
+                ok, _ = flavor_eligible(_EMPTY_PODSET, flavor, keys)
+                m[gi, si] = ok
+        enc._trivial_elig[cq.name] = m
+    return m
+
+
 class WorkloadRowCache:
-    """Per-workload encoded rows keyed by Workload uid.
+    """Encoded rows keyed by workload identity AND content.
 
     The eligibility columns are host-side string matching
     (taints/affinity x flavors) — the expensive part of encode_workloads.
-    They depend only on the workload's podsets and the CQ structure, both
-    stable across requeues, so a backlog workload is string-matched once
-    per CQ-encoding generation instead of once per tick it heads.
-    Identity is double-checked via WorkloadInfo.rev, a never-recycled
-    monotonic stamp: a resubmitted workload (fresh WorkloadInfo under the
-    same uid) re-encodes. id() is unsuitable (addresses are recycled after
-    GC → stale rows for updated workloads) and a strong reference would
-    pin finished workloads' objects until the wholesale clear.
+    They depend only on the workload's podsets and the CQ structure, so:
+
+    - identity path: a backlog workload re-heading across ticks hits by
+      (uid, WorkloadInfo.rev) — rev is a never-recycled monotonic stamp
+      (id() addresses are recycled after GC; a strong reference would pin
+      finished workloads until the wholesale clear);
+    - content path: a NEW workload whose (ClusterQueue, per-podset totals,
+      node selectors, affinity, tolerations) signature was encoded before
+      shares the existing row — real clusters submit repeated job shapes,
+      so steady-state arrival flux encodes each distinct shape once
+      instead of once per workload.
+
+    Rows are read-only after construction (encode_workloads only copies
+    out of them), so sharing one row across workloads is safe. The cache
+    lives for one CQ-encoding generation (structural changes rebuild it).
     """
 
-    MAX_ENTRIES = 200_000  # backstop; ~100B/row, cleared wholesale
+    MAX_ENTRIES = 200_000  # backstop; cleared wholesale
 
     def __init__(self):
-        self._rows: dict = {}
+        self._by_wi: dict = {}       # uid -> (rev, row)
+        self._by_content: dict = {}  # content sig -> row
+
+    @staticmethod
+    def _sig(wi: WorkloadInfo):
+        sig = wi.row_sig
+        if sig is None:
+            try:
+                sig = (wi.cluster_queue, tuple(
+                    (t.count, tuple(sorted(t.requests.items())),
+                     ps.node_selector, ps.affinity_terms, ps.tolerations)
+                    for t, ps in zip(wi.total_requests, wi.obj.pod_sets)))
+            except TypeError:
+                sig = False  # unhashable custom field; identity path only
+            wi.row_sig = sig
+        return sig or None
 
     def get(self, wi: WorkloadInfo) -> Optional[_Row]:
-        row = self._rows.get(wi.obj.uid)
-        if row is not None and row.wi_rev == wi.rev:
-            return row
+        hit = self._by_wi.get(wi.obj.uid)
+        if hit is not None and hit[0] == wi.rev:
+            return hit[1]
+        sig = self._sig(wi)
+        if sig is not None:
+            row = self._by_content.get(sig)
+            if row is not None:
+                self._by_wi[wi.obj.uid] = (wi.rev, row)
+                return row
         return None
 
     def put(self, wi: WorkloadInfo, row: _Row) -> None:
-        if len(self._rows) >= self.MAX_ENTRIES:
-            self._rows.clear()
-        self._rows[wi.obj.uid] = row
+        if len(self._by_wi) >= self.MAX_ENTRIES:
+            self._by_wi.clear()
+        if len(self._by_content) >= self.MAX_ENTRIES:
+            self._by_content.clear()
+        self._by_wi[wi.obj.uid] = (wi.rev, row)
+        sig = self._sig(wi)
+        if sig is not None:
+            self._by_content[sig] = row
 
 
 def encode_workloads(workloads: Sequence[WorkloadInfo], snapshot: Snapshot,

@@ -33,11 +33,21 @@ def synthetic_objects(
     num_pending: int = 1000,
     usage_fill: float = 0.5,
     seed: int = 0,
+    pending_priority: Tuple[int, int] = (-2, 2),
+    preemption_heavy: bool = False,
 ):
     """Generate the raw API objects of a north-star-scale cluster:
     (flavors, cluster_queues, local_queues, admitted workloads with their
-    Admission pre-set, pending workloads)."""
+    Admission pre-set, pending workloads).
+
+    `preemption_heavy` builds BASELINE config #3: reclaimWithinCohort +
+    borrowWithinCohort(LowerPriority) + withinClusterQueue(LowerPriority)
+    on every CQ, low-priority admitted background load and high-priority
+    pending — most nominations resolve by preempting victims
+    (preemption.go:81-231 is the exercised path)."""
     rnd = random.Random(seed)
+    if preemption_heavy:
+        pending_priority = (1, 5)
 
     flavors = [ResourceFlavor.make(f"flavor-{f}") for f in range(num_flavors)]
 
@@ -54,40 +64,60 @@ def synthetic_objects(
             )
             for fi in chosen
         )
+        preemption = ClusterQueuePreemption(
+            within_cluster_queue="LowerPriority",
+            reclaim_within_cohort="Any")
+        if preemption_heavy:
+            from kueue_tpu.api.types import BorrowWithinCohort
+            preemption = ClusterQueuePreemption(
+                within_cluster_queue="LowerPriority",
+                reclaim_within_cohort="Any",
+                borrow_within_cohort=BorrowWithinCohort(
+                    policy="LowerPriority", max_priority_threshold=0))
         cqs.append(ClusterQueue(
             name=f"cq-{c}",
             resource_groups=(ResourceGroup(("cpu", "memory"), fqs),),
             cohort=f"cohort-{c % num_cohorts}",
-            preemption=ClusterQueuePreemption(
-                within_cluster_queue="LowerPriority",
-                reclaim_within_cohort="Any"),
+            preemption=preemption,
         ))
         lqs.append(LocalQueue(
             name=f"lq-{c}", namespace="default", cluster_queue=f"cq-{c}"))
 
-    # Admitted usage: fill roughly `usage_fill` of each CQ's first flavor.
+    # Admitted background usage. Default shape fills `usage_fill` of each
+    # CQ's first flavor with one workload; preemption_heavy fills EVERY
+    # flavor with several small priority-0 workloads, so high-priority
+    # arrivals can only start by preempting and minimalPreemptions has
+    # granular victims to choose among (preemption.go:172-231).
     admitted: List[Workload] = []
     for c in range(num_cqs):
-        fq0 = cqs[c].resource_groups[0].flavors[0]
-        quota = fq0.resources_dict["cpu"].nominal
-        target = int(quota * usage_fill)
-        if target <= 0:
-            continue
-        wl = Workload(
-            name=f"adm-{c}", namespace="default", queue_name=f"lq-{c}",
-            creation_time=float(c),
-            pod_sets=[PodSet.make("main", count=1)])
-        wl.admission = Admission(
-            cluster_queue=f"cq-{c}",
-            pod_set_assignments=[PodSetAssignment(
-                name="main",
-                flavors={"cpu": fq0.name, "memory": fq0.name},
-                resource_usage={"cpu": target,
-                                "memory": target * (1024 ** 2)},
-                count=1)])
-        wl.set_condition("QuotaReserved", True, now=float(c))
-        wl.set_condition("Admitted", True, now=float(c))
-        admitted.append(wl)
+        cq_flavors = cqs[c].resource_groups[0].flavors
+        fill_flavors = cq_flavors if preemption_heavy else cq_flavors[:1]
+        chunks = 4 if preemption_heavy else 1
+        for fq_obj in fill_flavors:
+            cpu_quota = fq_obj.resources_dict["cpu"].nominal
+            mem_quota = fq_obj.resources_dict["memory"].nominal
+            cpu_target = int(cpu_quota * usage_fill) // chunks
+            mem_target = int(mem_quota * usage_fill) // chunks
+            if cpu_target <= 0:
+                continue
+            for k in range(chunks):
+                wl = Workload(
+                    name=f"adm-{c}-{fq_obj.name}-{k}", namespace="default",
+                    queue_name=f"lq-{c}", creation_time=float(c),
+                    pod_sets=[PodSet.make("main", count=1)])
+                wl.admission = Admission(
+                    cluster_queue=f"cq-{c}",
+                    pod_set_assignments=[PodSetAssignment(
+                        name="main",
+                        flavors={"cpu": fq_obj.name, "memory": fq_obj.name},
+                        resource_usage={"cpu": cpu_target,
+                                        "memory": mem_target
+                                        if preemption_heavy
+                                        else cpu_target * (1024 ** 2)},
+                        count=1)])
+                wl.set_condition("QuotaReserved", True, now=float(c))
+                wl.set_condition("Admitted", True, now=float(c))
+                admitted.append(wl)
 
     pending: List[Workload] = []
     for i in range(num_pending):
@@ -102,7 +132,7 @@ def synthetic_objects(
         ]
         pending.append(Workload(
             name=f"pend-{i}", namespace="default", queue_name=f"lq-{c}",
-            priority=rnd.randint(-2, 2), creation_time=float(i),
+            priority=rnd.randint(*pending_priority), creation_time=float(i),
             pod_sets=pod_sets))
     return flavors, cqs, lqs, admitted, pending
 
@@ -114,6 +144,7 @@ def synthetic_problem(
     num_pending: int = 1000,
     usage_fill: float = 0.5,
     seed: int = 0,
+    **object_kwargs,
 ) -> Tuple[Cache, List[WorkloadInfo]]:
     """Build a cache (with admitted usage) plus pending workloads.
 
@@ -124,7 +155,8 @@ def synthetic_problem(
     """
     flavors, cqs, lqs, admitted, pending = synthetic_objects(
         num_cqs=num_cqs, num_cohorts=num_cohorts, num_flavors=num_flavors,
-        num_pending=num_pending, usage_fill=usage_fill, seed=seed)
+        num_pending=num_pending, usage_fill=usage_fill, seed=seed,
+        **object_kwargs)
     cache = Cache()
     for rf in flavors:
         cache.add_or_update_resource_flavor(rf)
@@ -147,6 +179,8 @@ def synthetic_framework(
     usage_fill: float = 0.5,
     seed: int = 0,
     batch_solver=None,
+    pending_priority: Tuple[int, int] = (-2, 2),
+    preemption_heavy: bool = False,
     **framework_kwargs,
 ):
     """Build a full Framework loaded with the synthetic cluster — the
@@ -156,7 +190,8 @@ def synthetic_framework(
 
     flavors, cqs, lqs, admitted, pending = synthetic_objects(
         num_cqs=num_cqs, num_cohorts=num_cohorts, num_flavors=num_flavors,
-        num_pending=num_pending, usage_fill=usage_fill, seed=seed)
+        num_pending=num_pending, usage_fill=usage_fill, seed=seed,
+        pending_priority=pending_priority, preemption_heavy=preemption_heavy)
     fw = Framework(batch_solver=batch_solver, **framework_kwargs)
     for rf in flavors:
         fw.create_resource_flavor(rf)
