@@ -11,6 +11,7 @@ examples/admin/single-clusterqueue-setup.yaml work unchanged.
 
 from __future__ import annotations
 
+import itertools
 from typing import Any, Dict, List, Mapping, Optional, Sequence, Tuple
 
 from kueue_tpu.api.resources import resource_value
@@ -44,11 +45,23 @@ class DecodeError(ValueError):
     pass
 
 
+_generated_names = itertools.count(1)
+
+
 def _meta(doc: Mapping[str, Any]) -> Tuple[str, str]:
     meta = doc.get("metadata") or {}
     name = meta.get("name")
     if not name:
-        raise DecodeError(f"{doc.get('kind', '?')}: metadata.name is required")
+        # metadata.generateName: the apiserver appends a random suffix
+        # (the reference's sample manifests use it, e.g.
+        # examples/jobs/sample-job.yaml); a monotonic suffix keeps decoded
+        # object names deterministic in-process.
+        prefix = meta.get("generateName")
+        if prefix:
+            return f"{prefix}{next(_generated_names):05d}", \
+                meta.get("namespace", "default")
+        raise DecodeError(f"{doc.get('kind', '?')}: metadata.name or "
+                          "metadata.generateName is required")
     return name, meta.get("namespace", "default")
 
 

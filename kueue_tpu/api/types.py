@@ -530,12 +530,15 @@ class Workload:
     def __post_init__(self) -> None:
         if not self.uid:
             self.uid = f"uid-{next(_uid_counter):08d}"
+        # name/namespace are identity (never reassigned); precompute the
+        # cache key once — it is read on every usage-accounting mutation.
+        self._key = f"{self.namespace}/{self.name}"
 
     # -- condition helpers (reference: pkg/workload/workload.go:369-505) ----
 
     @property
     def key(self) -> str:
-        return f"{self.namespace}/{self.name}"
+        return self._key
 
     def find_condition(self, ctype: str) -> Optional[Condition]:
         for c in self.conditions:

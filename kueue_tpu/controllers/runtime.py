@@ -361,29 +361,31 @@ class Framework:
         self.events.event(wl.key, events_mod.NORMAL,
                           events_mod.REASON_FINISHED, "Workload finished",
                           now=self.clock())
-        if self.cache.delete_workload(wl):
-            self._note_quota_released(wl)
+        released = self.cache.delete_workload(wl)
+        if released is not None:
+            self._note_quota_released(wl, released)
         self.queues.delete_workload(wl)
         self.queues.queue_associated_inadmissible_workloads(wl)
 
     def delete_workload(self, wl: Workload) -> None:
         self.workloads.pop(wl.key, None)
-        if self.cache.delete_workload(wl):
-            self._note_quota_released(wl)
+        released = self.cache.delete_workload(wl)
+        if released is not None:
+            self._note_quota_released(wl, released)
         self.queues.delete_workload(wl)
         self.queues.queue_associated_inadmissible_workloads(wl)
 
-    def _note_quota_released(self, wl: Workload) -> None:
+    def _note_quota_released(self, wl: Workload, wi: WorkloadInfo) -> None:
         """Lockstep-mirror a quota release (finish / delete / eviction)
         into the scheduler's incremental snapshot and the solver's usage
         tensor, so completion flux doesn't force per-CQ re-clones and
         tensor row re-reads every tick (the same discipline _admit applies
-        on the admission side)."""
+        on the admission side). `wi` is the info cache.delete_workload
+        released — its totals are exactly what the cache subtracted."""
         self.scheduler._mirror.note_removal(wl)
         bs = self.scheduler.batch_solver
         note = getattr(bs, "note_removal", None)
         if note is not None and wl.admission is not None:
-            wi = WorkloadInfo(wl, cluster_queue=wl.admission.cluster_queue)
             note(wl.admission.cluster_queue, wi.usage())
 
     def set_admission_check_state(self, wl: Workload, check: str, state: str,
@@ -474,8 +476,9 @@ class Framework:
         evicted, self._evicted_dirty = self._evicted_dirty, []
         for wl in evicted:
             if wl.has_quota_reservation:
-                if self.cache.delete_workload(wl):
-                    self._note_quota_released(wl)
+                released = self.cache.delete_workload(wl)
+                if released is not None:
+                    self._note_quota_released(wl, released)
                 wl.admission = None
                 wl.set_condition(CONDITION_QUOTA_RESERVED, False,
                                  reason="Evicted", now=self.clock())

@@ -262,30 +262,30 @@ class CachedClusterQueue:
     def _update_usage(self, wi: WorkloadInfo, usage: FlavorResourceQuantities,
                       m: int) -> None:
         # Only (flavor, resource) pairs configured on this CQ are tracked
-        # (reference: clusterqueue.go:473-485).
-        for ps in wi.total_requests:
-            for res, flv in ps.flavors.items():
-                v = ps.requests.get(res)
-                fusage = usage.get(flv)
-                if v is not None and fusage is not None and res in fusage:
-                    fusage[res] += v * m
+        # (reference: clusterqueue.go:473-485). The flat precomputed
+        # triples replace the nested podset/dict walk on this hottest of
+        # accounting paths.
+        for flv, res, v in wi.usage_triples:
+            fusage = usage.get(flv)
+            if fusage is not None and res in fusage:
+                fusage[res] += v * m
 
     def _update_cohort_usage(self, wi: WorkloadInfo, m: int) -> None:
         """Lending-aware cohort usage delta; must run after _update_usage
         (reference: clusterqueue.go:487-508)."""
         assert self.cohort is not None
-        for ps in wi.total_requests:
-            for res, flv in ps.flavors.items():
-                v = ps.requests.get(res)
-                fusage = self.cohort.usage.get(flv)
-                if v is None or fusage is None or res not in fusage:
-                    continue
-                after = self.usage.get(flv, {}).get(res, 0) - self._guaranteed(flv, res)
-                before = after - v * m
-                if before > 0:
-                    fusage[res] -= before
-                if after > 0:
-                    fusage[res] += after
+        cohort_usage = self.cohort.usage
+        own_usage = self.usage
+        for flv, res, v in wi.usage_triples:
+            fusage = cohort_usage.get(flv)
+            if fusage is None or res not in fusage:
+                continue
+            after = own_usage.get(flv, {}).get(res, 0) - self._guaranteed(flv, res)
+            before = after - v * m
+            if before > 0:
+                fusage[res] -= before
+            if after > 0:
+                fusage[res] += after
 
     def add_workload_usage(self, wi: WorkloadInfo, *, cohort_too: bool = False,
                            admitted: bool = False) -> None:
@@ -441,34 +441,36 @@ class Cache:
             cq.add_workload_usage(wi, admitted=wl.is_admitted)
             return True
 
-    def delete_workload(self, wl: Workload) -> bool:
-        """Returns whether usage was actually released (the workload was
-        accounted) — callers mirroring the release into incremental
-        encoders must not subtract usage that was never added."""
+    def delete_workload(self, wl: Workload) -> Optional[WorkloadInfo]:
+        """Returns the released WorkloadInfo when usage was actually
+        accounted (None otherwise) — callers mirroring the release into
+        incremental encoders must not subtract usage that was never added,
+        and can reuse the info's precomputed totals for the mirroring."""
         with self._lock:
             return self._delete_workload_locked(wl)
 
-    def _delete_workload_locked(self, wl: Workload) -> bool:
+    def _delete_workload_locked(self, wl: Workload) -> Optional[WorkloadInfo]:
         key = wl.key
         cq_name = self.assumed_workloads.get(key)
         if cq_name is None and wl.admission is not None:
             cq_name = wl.admission.cluster_queue
         if cq_name is None:
-            return False
-        released = False
+            return None
+        released = None
         cq = self.cluster_queues.get(cq_name)
         if cq is not None and key in cq.workloads:
             wi = cq.workloads[key]
             cq.remove_workload_usage(wi, admitted=wl.is_admitted)
             # Quota was freed: resume states against this CQ are now stale.
             cq.allocatable_generation += 1
-            released = True
+            released = wi
         self.assumed_workloads.pop(key, None)
         return released
 
-    def assume_workload(self, wl: Workload) -> None:
+    def assume_workload(self, wl: Workload) -> WorkloadInfo:
         """Optimistically account a just-admitted workload before the API
-        write lands (reference: cache.go:498-524)."""
+        write lands (reference: cache.go:498-524). Returns the accounted
+        info so callers can mirror the same totals without re-deriving."""
         with self._lock:
             if wl.admission is None:
                 raise ValueError("workload has no admission")
@@ -481,6 +483,7 @@ class Cache:
             wi = WorkloadInfo(wl, cluster_queue=cq.name)
             cq.add_workload_usage(wi, admitted=wl.is_admitted)
             self.assumed_workloads[key] = cq.name
+            return wi
 
     def forget_workload(self, wl: Workload) -> None:
         with self._lock:

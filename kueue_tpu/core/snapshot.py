@@ -184,7 +184,8 @@ class SnapshotMirror:
         # counts this cycle's admissions separately, scheduler.go:204-275),
         # so note_admission/note_removal queue here and apply at the next
         # refresh.
-        self._pending: List[Tuple[int, object, int, int, bool]] = []
+        self._pending: List[
+            Tuple[int, object, int, int, bool, Optional[WorkloadInfo]]] = []
         # Monotonic count of snapshot mutations (lockstep applies and
         # re-clones). A pipelined tick records it at dispatch; a different
         # value at completion means the snapshot moved under the in-flight
@@ -248,12 +249,13 @@ class SnapshotMirror:
 
     # -- lockstep fast path (mirrors cache.assume/forget) -------------------
 
-    def note_admission(self, wl) -> None:
+    def note_admission(self, wl, wi: Optional[WorkloadInfo] = None) -> None:
         """Record a just-assumed workload (call right after
         cache.assume_workload). The cache version captured here is the
         assume bump itself; any later external mutation moves the cache
         version past it and forces a re-clone — versions, not trust,
-        decide (same contract as UsageEncoder.apply_delta)."""
+        decide (same contract as UsageEncoder.apply_delta). Pass the info
+        returned by assume_workload to reuse its precomputed totals."""
         if self._snap is None or wl.admission is None:
             return
         cache_cq = self.cache.cluster_queues.get(wl.admission.cluster_queue)
@@ -261,7 +263,7 @@ class SnapshotMirror:
             return
         self._pending.append((1, wl, cache_cq.usage_version,
                               cache_cq.allocatable_generation,
-                              wl.is_admitted))
+                              wl.is_admitted, wi))
 
     def note_removal(self, wl) -> None:
         """Mirror of cache.forget_workload / delete after an apply failure
@@ -273,7 +275,7 @@ class SnapshotMirror:
             return
         self._pending.append((-1, wl, cache_cq.usage_version,
                               cache_cq.allocatable_generation,
-                              wl.is_admitted))
+                              wl.is_admitted, None))
 
     def flush_pending(self) -> None:
         """Apply queued lockstep mutations to the snapshot. Called at every
@@ -284,17 +286,19 @@ class SnapshotMirror:
             return
         pending, self._pending = self._pending, []
         self.mutation_count += len(pending)
-        for sign, wl, version, alloc_gen, admitted in pending:
-            self._apply(self._snap, sign, wl, version, alloc_gen, admitted)
+        for sign, wl, version, alloc_gen, admitted, wi in pending:
+            self._apply(self._snap, sign, wl, version, alloc_gen, admitted, wi)
 
     def _apply(self, snap: Snapshot, sign: int, wl, version: int,
-               alloc_gen: int, admitted: bool) -> None:
+               alloc_gen: int, admitted: bool,
+               wi: Optional[WorkloadInfo] = None) -> None:
         cq = snap.cluster_queues.get(wl.admission.cluster_queue
                                      if wl.admission else "")
         if cq is None:
             return
         if sign > 0:
-            wi = WorkloadInfo(wl, cluster_queue=cq.name)
+            if wi is None:
+                wi = WorkloadInfo(wl, cluster_queue=cq.name)
             cq.add_workload_usage(wi, cohort_too=True, admitted=admitted)
         else:
             wi = cq.workloads.get(wl.key)

@@ -156,13 +156,25 @@ class WorkloadInfo:
         podset/dict walk dominates otherwise."""
         triples = self._usage_triples
         if triples is None:
-            triples = []
-            for ps in self.total_requests:
-                flavors = ps.flavors
-                for res, q in ps.requests.items():
-                    flv = flavors.get(res)
-                    if flv is not None:
-                        triples.append((flv, res, q))
+            # Memoized on the Workload next to the totals they derive from
+            # (same identity basis): the accounting paths build a fresh
+            # WorkloadInfo per mutation (cache assume/forget, mirror
+            # lockstep, usage-encoder delta) and each walked the nested
+            # podset dicts otherwise.
+            totals = self.total_requests
+            wl = self.obj
+            memo = getattr(wl, "_triples_memo", None)
+            if memo is not None and memo[0] is totals:
+                triples = memo[1]
+            else:
+                triples = []
+                for ps in totals:
+                    flavors = ps.flavors
+                    for res, q in ps.requests.items():
+                        flv = flavors.get(res)
+                        if flv is not None:
+                            triples.append((flv, res, q))
+                wl._triples_memo = (totals, triples)
             self._usage_triples = triples
         return triples
 
@@ -214,13 +226,9 @@ class WorkloadInfo:
     def usage(self) -> Dict[str, Dict[str, int]]:
         """Flavor -> resource -> quantity used by this (admitted) workload."""
         out: Dict[str, Dict[str, int]] = {}
-        for ps in self.total_requests:
-            for res, q in ps.requests.items():
-                flv = ps.flavors.get(res)
-                if flv is None:
-                    continue
-                out.setdefault(flv, {}).setdefault(res, 0)
-                out[flv][res] += q
+        for flv, res, q in self.usage_triples:
+            fout = out.setdefault(flv, {})
+            fout[res] = fout.get(res, 0) + q
         return out
 
     def clone(self) -> "WorkloadInfo":

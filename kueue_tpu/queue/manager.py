@@ -211,6 +211,10 @@ class Manager:
         self._cond = threading.Condition()
         self.ordering = ordering or WorkloadOrdering()
         self.cluster_queues: Dict[str, PendingClusterQueue] = {}
+        # cohort name -> member queues; keeps cohort flushes O(members)
+        # instead of a full scan over every ClusterQueue (quota releases
+        # flush a cohort per finish/evict — manager.go:424-447).
+        self._cohort_members: Dict[str, Dict[str, PendingClusterQueue]] = {}
         self.local_queues: Dict[str, LocalQueue] = {}
         self._ns_lister = namespace_lister or (lambda name: {})
         self._clock = clock
@@ -225,6 +229,8 @@ class Manager:
                 raise ValueError(f"queue {spec.name} already exists")
             cq = PendingClusterQueue(spec, self.ordering, self._clock)
             self.cluster_queues[spec.name] = cq
+            if cq.cohort:
+                self._cohort_members.setdefault(cq.cohort, {})[cq.name] = cq
             # Re-adopt pending workloads that arrived before the CQ
             # (manager.go:121-134).
             for wl in pending:
@@ -241,12 +247,24 @@ class Manager:
             old_cohort = cq.cohort
             cq.update(spec)
             if cq.cohort != old_cohort:
+                self._drop_cohort_member(old_cohort, cq.name)
+                if cq.cohort:
+                    self._cohort_members.setdefault(cq.cohort, {})[cq.name] = cq
                 self._queue_cohort_inadmissible(cq.cohort)
             self._cond.notify_all()
 
     def delete_cluster_queue(self, name: str) -> None:
         with self._cond:
-            self.cluster_queues.pop(name, None)
+            cq = self.cluster_queues.pop(name, None)
+            if cq is not None:
+                self._drop_cohort_member(cq.cohort, name)
+
+    def _drop_cohort_member(self, cohort: str, name: str) -> None:
+        members = self._cohort_members.get(cohort or "")
+        if members is not None:
+            members.pop(name, None)
+            if not members:
+                del self._cohort_members[cohort]
 
     # -- local queues --------------------------------------------------------
 
@@ -363,9 +381,8 @@ class Manager:
 
     def _flush_cohort(self, cohort: str) -> bool:
         queued = False
-        for cq in self.cluster_queues.values():
-            if cq.cohort == cohort:
-                queued = cq.queue_inadmissible_workloads(self._ns_lister) or queued
+        for cq in self._cohort_members.get(cohort, {}).values():
+            queued = cq.queue_inadmissible_workloads(self._ns_lister) or queued
         return queued
 
     # -- heads ---------------------------------------------------------------

@@ -451,8 +451,8 @@ class Scheduler:
         note_admit = getattr(self.batch_solver, "note_admission", None)
         note_forget = getattr(self.batch_solver, "note_removal", None)
         try:
-            self.cache.assume_workload(wl)
-            self._mirror.note_admission(wl)
+            assumed = self.cache.assume_workload(wl)
+            self._mirror.note_admission(wl, assumed)
             if note_admit is not None:
                 note_admit(e.info.cluster_queue, e.assignment.usage)
         except ValueError as err:
