@@ -96,11 +96,15 @@ def run_config(*, label, num_cqs, num_cohorts, num_flavors, backlog, ticks,
                 memory=f"{rnd.randint(1, 16)}Gi")]))
 
     def churn():
-        """Completion flux: finish workloads admitted LINGER_TICKS ago."""
+        """Completion flux: finish workloads admitted LINGER_TICKS ago,
+        then delete them (the owning job's GC in the reference deletes the
+        Workload object; without it the object population would grow
+        unboundedly, which no real cluster does)."""
         while admitted_log and admitted_log[0][0] <= tick_no[0] - LINGER_TICKS:
             _, wl = admitted_log.popleft()
             if wl.is_admitted and not wl.is_finished:
                 fw.finish(wl)
+                fw.delete_workload(wl)
                 submit_replacement()
 
     # Warmup: compile the solve for the steady-state head-count bucket and
@@ -113,12 +117,17 @@ def run_config(*, label, num_cqs, num_cohorts, num_flavors, backlog, ticks,
         churn()
 
     # Long-running-scheduler GC discipline: the permanent objects (50k
-    # workloads, the mirror) should not be re-traced by collector passes
-    # mid-tick; per-tick garbage is acyclic and dies by refcount.
+    # workloads, the mirror) are frozen out of collector passes; the gen0
+    # threshold is kept SMALL so young-generation passes stay a few ms
+    # each instead of rare 100ms+ sweeps that would dominate tick p99.
     gc.collect()
     gc.freeze()
-    gc.set_threshold(200_000, 100, 100)
+    gc.set_threshold(25_000, 100, 100)
 
+    from kueue_tpu.metrics import REGISTRY
+
+    phases = REGISTRY.tick_phase_seconds
+    phase_base = dict(phases.sums)
     times = []
     admitted = 0
     base_admitted = fw.scheduler.metrics.admitted
@@ -130,6 +139,9 @@ def run_config(*, label, num_cqs, num_cohorts, num_flavors, backlog, ticks,
         churn()
     admitted = fw.scheduler.metrics.admitted - base_admitted
     preempted = fw.scheduler.metrics.preempted - preempted_before
+    phase_means = {
+        k[0]: 1000.0 * (phases.sums[k] - phase_base.get(k, 0.0)) / ticks
+        for k in sorted(phases.sums)}
     gc.unfreeze()
     gc.set_threshold(700, 10, 10)
 
@@ -143,14 +155,16 @@ def run_config(*, label, num_cqs, num_cohorts, num_flavors, backlog, ticks,
         f"{jax.default_backend()}, depth {depth}, setup {t_setup:.1f}s\n"
         f"# [{label}] e2e tick: p50 {p50:.2f}ms  p99 {p99:.2f}ms  "
         f"({admitted} admitted, {preempted} preempted, "
-        f"{admitted / (sum(times) or 1e-9):,.0f} admissions/s)",
+        f"{admitted / (sum(times) or 1e-9):,.0f} admissions/s)\n"
+        f"# [{label}] phase means/tick: "
+        + "  ".join(f"{k}={v:.1f}ms" for k, v in phase_means.items()),
         file=sys.stderr)
     return p50, p99
 
 
 def main() -> None:
     smoke = os.environ.get("KUEUE_BENCH_SMOKE") == "1"
-    depth = max(1, int(os.environ.get("KUEUE_BENCH_DEPTH", "8")))
+    depth = max(1, int(os.environ.get("KUEUE_BENCH_DEPTH", "4")))
     if smoke:
         shape = dict(num_cqs=32, num_cohorts=8, num_flavors=4, backlog=512)
         ticks = int(os.environ.get("KUEUE_BENCH_TICKS", "12"))

@@ -541,10 +541,17 @@ class Workload:
         return self._key
 
     def find_condition(self, ctype: str) -> Optional[Condition]:
-        for c in self.conditions:
-            if c.type == ctype:
-                return c
-        return None
+        # Dict index over the conditions list, rebuilt when the list is
+        # appended to or replaced wholesale (decode_workload_status):
+        # condition lookups run several times per admission on the hot
+        # path. Condition objects are mutated in place by set_condition,
+        # which keeps membership — and therefore the index — intact.
+        conds = self.conditions
+        memo = getattr(self, "_cond_memo", None)
+        if memo is None or memo[0] is not conds or memo[1] != len(conds):
+            memo = (conds, len(conds), {c.type: c for c in conds})
+            self._cond_memo = memo
+        return memo[2].get(ctype)
 
     def condition_true(self, ctype: str) -> bool:
         c = self.find_condition(ctype)

@@ -124,12 +124,19 @@ class TPUSolverConfig:
     """TPU solve-path knobs — this build's extension to the reference
     Configuration (the north-star gRPC/JAX boundary of SURVEY §2.5).
 
-    `pipeline_depth` > 1 keeps that many ticks' device solves in flight
-    while older ticks complete host-side (admission-safe via the
-    scheduler's staleness re-validation); 1 is the reference-equivalent
-    synchronous mode. `preemption_engine` selects the minimal-preemptions
-    engine: None = host referee, "jax"/"pallas" = device scan."""
-    enable: bool = False
+    `enable` None (the default) means auto: the device solve path turns on
+    when an accelerator backend is present and falls back to the pure host
+    referee on CPU-only hosts — the TPU path is the default of a
+    TPU-native framework, not an opt-in. `pipeline_depth` > 1 keeps that
+    many ticks' device solves in flight while older ticks complete
+    host-side (admission-safe via the scheduler's staleness
+    re-validation); 1 is the reference-equivalent synchronous mode.
+    `preemption_engine` selects the minimal-preemptions engine: None/
+    "auto" = the batched C++ scan whenever the solver runs (host referee
+    otherwise), "host" = force the per-entry host referee, "native" =
+    force the C++ batch engine, "jax"/"pallas" = one packed XLA dispatch
+    per round."""
+    enable: Optional[bool] = None
     pipeline_depth: int = 1
     preemption_engine: Optional[str] = None
 
@@ -303,8 +310,9 @@ def from_dict(doc: Mapping[str, Any]) -> Configuration:
     ts = TPUSolverConfig()
     if doc.get("tpuSolver") is not None:
         t = doc["tpuSolver"]
+        enable = t.get("enable")
         ts = TPUSolverConfig(
-            enable=bool(t.get("enable", False)),
+            enable=None if enable is None else bool(enable),
             pipeline_depth=int(t.get("pipelineDepth", 1)),
             preemption_engine=t.get("preemptionEngine"))
 
@@ -443,9 +451,10 @@ def validate_configuration(cfg: Configuration) -> List[str]:
     # tpuSolver
     if cfg.tpu_solver.pipeline_depth < 1:
         errors.append("tpuSolver.pipelineDepth: must be >= 1")
-    if cfg.tpu_solver.preemption_engine not in (None, "jax", "pallas"):
+    if cfg.tpu_solver.preemption_engine not in (None, "auto", "host",
+                                                "native", "jax", "pallas"):
         errors.append("tpuSolver.preemptionEngine: must be one of "
-                      "jax, pallas (or omitted for the host referee)")
+                      "auto, host, native, jax, pallas (or omitted for auto)")
 
     # leaderElection
     le = cfg.leader_election

@@ -1,0 +1,177 @@
+// Batched minimalPreemptions victim scan (native engine).
+//
+// Semantics mirror the host referee scheduler/preemption._minimal_preemptions
+// (itself golden against reference pkg/scheduler/preemption/preemption.go:
+// 172-231 minimalPreemptions + :352-389 workloadFits) and the jittable
+// device scan ops/preemption_scan._scan_core. The tick's independent victim
+// searches arrive as dense batch tensors (ops/preemption_batch builds them
+// from the ClusterQueue encoding and the lockstep usage tensor); this runs
+// the sequential remove-until-fits / add-back refinement per problem at
+// native speed. A remote-attached accelerator loses this race on link
+// round-trips and small-int64 sequential work — the scan is runtime, not
+// compute, so it belongs in C++ (the jax/pallas engines remain available
+// and decision-equivalent for locally-attached devices).
+//
+// Layout (row-major):
+//   usage0/nominal/guaranteed      [B][Y][FR] int64
+//   wl_req/blim/requestable        [B][FR]    int64
+//   cand_use                       [B][N][FR] int64
+//   cand_y/cand_prio               [B][N]     int32
+//   threshold                      [B]        int32
+//   q_def                          [B][Y][FR] uint8
+//   wl_req_mask/blim_def/res_mask  [B][FR]    uint8
+//   cand_valid                     [B][N]     uint8
+//   has_cohort/allow_b0/has_threshold [B]     uint8
+// Outputs: victim [B][N] uint8, fits [B] uint8.
+
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+namespace {
+
+struct Problem {
+    int64_t Y, FR, N;
+    const int64_t *usage0, *nominal, *guaranteed;
+    const int64_t *wl_req, *blim, *requestable;
+    const int64_t *cand_use;
+    const int32_t *cand_y, *cand_prio;
+    const uint8_t *q_def, *wl_req_mask, *blim_def, *res_mask, *cand_valid;
+    bool has_cohort, lending;
+    int32_t threshold;
+    bool has_threshold;
+};
+
+// workloadFits (preemption.go:352-389) over the dense pair grid.
+static bool fits(const Problem& p, const std::vector<int64_t>& U,
+                 bool allow_b) {
+    const int64_t FR = p.FR;
+    const uint8_t* t_def = p.q_def;  // row 0 = target
+    // Own-CQ cap: nominal, or nominal+borrowingLimit when borrowing.
+    const bool use_nominal = !p.has_cohort || !allow_b;
+    for (int64_t f = 0; f < FR; f++) {
+        if (!t_def[f] || !p.wl_req_mask[f]) continue;
+        const int64_t own = U[f] + p.wl_req[f];
+        if (use_nominal) {
+            if (own > p.nominal[f]) return false;
+        } else if (p.blim_def[f]) {
+            if (own > p.nominal[f] + p.blim[f]) return false;
+        }
+    }
+    if (!p.has_cohort) return true;
+    for (int64_t f = 0; f < FR; f++) {
+        if (!t_def[f] || !p.wl_req_mask[f]) continue;
+        int64_t above = 0;
+        for (int64_t y = 0; y < p.Y; y++) {
+            const int64_t d = U[y * FR + f] - p.guaranteed[y * FR + f];
+            if (d > 0) above += d;
+        }
+        int64_t cohort_used = above;
+        if (p.lending) {
+            const int64_t u0 = U[f];
+            const int64_t g0 = p.guaranteed[f];
+            cohort_used += (u0 < g0 ? u0 : g0);
+        }
+        if (cohort_used + p.wl_req[f] > p.requestable[f]) return false;
+    }
+    return true;
+}
+
+static void solve_one(const Problem& p, uint8_t* victim, uint8_t* fits_out) {
+    const int64_t FR = p.FR, N = p.N;
+    std::vector<int64_t> U(p.usage0, p.usage0 + p.Y * FR);
+    std::vector<uint8_t> taken(N, 0);
+    bool allow_b = *fits_out;  // caller stashes allow_b0 here
+    bool done = false;
+    int64_t stop_idx = -1;
+
+    for (int64_t i = 0; i < N && !done; i++) {
+        if (!p.cand_valid[i]) continue;
+        const int32_t y = p.cand_y[i];
+        const bool is_target = (y == 0);
+        if (!is_target) {
+            // Skip candidates whose CQ stopped borrowing (the dynamic
+            // re-check inside the loop, preemption.go:188-192).
+            bool borrowing = false;
+            for (int64_t f = 0; f < FR && !borrowing; f++) {
+                if (p.res_mask[f] && p.q_def[y * FR + f] &&
+                    U[y * FR + f] > p.nominal[y * FR + f])
+                    borrowing = true;
+            }
+            if (!borrowing) continue;
+            if (p.has_threshold && p.cand_prio[i] >= p.threshold)
+                allow_b = false;
+        }
+        for (int64_t f = 0; f < FR; f++)
+            U[y * FR + f] -= p.cand_use[i * FR + f];
+        taken[i] = 1;
+        if (fits(p, U, allow_b)) {
+            done = true;
+            stop_idx = i;
+        }
+    }
+
+    if (!done) {
+        *fits_out = 0;
+        std::memset(victim, 0, N);
+        return;
+    }
+
+    // Add-back refinement, reverse order, last-removed never re-added
+    // (preemption.go:214-224).
+    std::memset(victim, 0, N);
+    for (int64_t i = N - 1; i >= 0; i--) {
+        if (!taken[i] || i > stop_idx) continue;
+        if (i == stop_idx) {
+            victim[i] = 1;
+            continue;
+        }
+        for (int64_t f = 0; f < FR; f++)
+            U[p.cand_y[i] * FR + f] += p.cand_use[i * FR + f];
+        if (!fits(p, U, allow_b)) {
+            for (int64_t f = 0; f < FR; f++)
+                U[p.cand_y[i] * FR + f] -= p.cand_use[i * FR + f];
+            victim[i] = 1;
+        }
+    }
+    *fits_out = 1;
+}
+
+}  // namespace
+
+extern "C" void kueue_minimal_preemptions_batch(
+    int64_t B, int64_t Y, int64_t FR, int64_t N,
+    const int64_t* usage0, const int64_t* nominal, const int64_t* guaranteed,
+    const int64_t* wl_req, const int64_t* blim, const int64_t* requestable,
+    const int64_t* cand_use,
+    const int32_t* cand_y, const int32_t* cand_prio, const int32_t* threshold,
+    const uint8_t* q_def, const uint8_t* wl_req_mask, const uint8_t* blim_def,
+    const uint8_t* res_mask, const uint8_t* cand_valid,
+    const uint8_t* has_cohort, const uint8_t* allow_b0,
+    const uint8_t* has_threshold, uint8_t lending,
+    uint8_t* victim_out, uint8_t* fits_out) {
+    for (int64_t b = 0; b < B; b++) {
+        Problem p;
+        p.Y = Y; p.FR = FR; p.N = N;
+        p.usage0 = usage0 + b * Y * FR;
+        p.nominal = nominal + b * Y * FR;
+        p.guaranteed = guaranteed + b * Y * FR;
+        p.wl_req = wl_req + b * FR;
+        p.blim = blim + b * FR;
+        p.requestable = requestable + b * FR;
+        p.cand_use = cand_use + b * N * FR;
+        p.cand_y = cand_y + b * N;
+        p.cand_prio = cand_prio + b * N;
+        p.q_def = q_def + b * Y * FR;
+        p.wl_req_mask = wl_req_mask + b * FR;
+        p.blim_def = blim_def + b * FR;
+        p.res_mask = res_mask + b * FR;
+        p.cand_valid = cand_valid + b * N;
+        p.has_cohort = has_cohort[b];
+        p.lending = lending;
+        p.threshold = threshold[b];
+        p.has_threshold = has_threshold[b];
+        fits_out[b] = allow_b0[b];  // in/out: carries allow_b0 in
+        solve_one(p, victim_out + b * N, fits_out + b);
+    }
+}

@@ -205,12 +205,15 @@ def _fits(U, wl_req, wl_req_mask, t_def, nominal0, blim, blim_def,
     return own_ok & jnp.logical_or(~has_cohort, cohort_ok)
 
 
-@jax.jit
-def scan_kernel(usage0, nominal, q_def, guaranteed, wl_req, wl_req_mask,
-                blim, blim_def, requestable, res_mask,
-                cand_y, cand_use, cand_prio,
-                has_cohort, lending, allow_b0, has_threshold, threshold):
-    """Remove-until-fits + reverse add-back; returns (victim[N], fits)."""
+def _scan_core(usage0, nominal, q_def, guaranteed, wl_req, wl_req_mask,
+               blim, blim_def, requestable, res_mask,
+               cand_y, cand_use, cand_prio, cand_valid,
+               has_cohort, lending, allow_b0, has_threshold, threshold):
+    """Remove-until-fits + reverse add-back; returns (victim[N], fits).
+
+    `cand_valid` masks padding rows when problems are batched to a common
+    candidate count: a padded step must neither remove usage nor trigger a
+    fits check (the host checks fits only after an actual removal)."""
     t_def = q_def[0]
     fits_fn = functools.partial(
         _fits, wl_req=wl_req, wl_req_mask=wl_req_mask, t_def=t_def,
@@ -220,12 +223,12 @@ def scan_kernel(usage0, nominal, q_def, guaranteed, wl_req, wl_req_mask,
 
     def remove_step(carry, xs):
         U, allow_b, done = carry
-        y, use, prio = xs
+        y, use, prio, valid = xs
         is_target = y == 0
         row = U[y]
         borrowing = (res_mask & q_def[y] & (row > nominal[y])).any()
         skip = (~is_target) & ~borrowing
-        act = (~skip) & (~done)
+        act = (~skip) & (~done) & valid
         allow_b = jnp.where(
             act & (~is_target) & has_threshold & (prio >= threshold),
             False, allow_b)
@@ -238,7 +241,7 @@ def scan_kernel(usage0, nominal, q_def, guaranteed, wl_req, wl_req_mask,
 
     carry0 = (usage0, allow_b0, jnp.asarray(False))
     (U_end, allow_b_end, fits_any), (taken, done_seq) = jax.lax.scan(
-        remove_step, carry0, (cand_y, cand_use, cand_prio))
+        remove_step, carry0, (cand_y, cand_use, cand_prio, cand_valid))
 
     # Victims = taken candidates up to and including the stop index.
     N = cand_y.shape[0]
@@ -269,6 +272,20 @@ def scan_kernel(usage0, nominal, q_def, guaranteed, wl_req, wl_req_mask,
     victim = victim_rev[::-1]
     victim = jnp.where(fits_any, victim, False)
     return victim, fits_any
+
+
+@jax.jit
+def scan_kernel(usage0, nominal, q_def, guaranteed, wl_req, wl_req_mask,
+                blim, blim_def, requestable, res_mask,
+                cand_y, cand_use, cand_prio,
+                has_cohort, lending, allow_b0, has_threshold, threshold):
+    """Single-problem entry (all candidates valid)."""
+    return _scan_core(
+        usage0, nominal, q_def, guaranteed, wl_req, wl_req_mask,
+        blim, blim_def, requestable, res_mask,
+        cand_y, cand_use, cand_prio,
+        jnp.ones(cand_y.shape[0], dtype=bool),
+        has_cohort, lending, allow_b0, has_threshold, threshold)
 
 
 def minimal_preemptions_device(

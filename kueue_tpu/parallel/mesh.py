@@ -56,30 +56,40 @@ def _pad_axis(x: np.ndarray, axis: int, multiple: int) -> np.ndarray:
 
 
 def _build_program(mesh: Mesh, C: int, K: int, num_slots: int,
-                   fungibility_enabled: bool):
+                   fungibility_enabled: bool, has_hier: bool):
     sharded = P(AXIS)
     repl = P()
 
+    # The hierarchical cohort-forest tensors (KEP-79) are replicated: they
+    # are node/CQ-indexed statics, and solve_core's per-node T aggregation
+    # runs on the all_gather-rebuilt full usage view, so every device
+    # computes identical tree balances. P() broadcasts over the pytree.
+    in_specs = (sharded, sharded, sharded, sharded,   # usage/guar/lend/cohort_id (C axis)
+                repl, repl, repl, repl,               # nominal/blim/guar_full/cohort_id_full
+                repl, repl, repl, repl, repl, repl,   # group/slot/nf/policies
+                sharded, sharded, sharded, sharded, sharded, sharded, sharded)
+    if has_hier:
+        in_specs = in_specs + (repl,)
+
     @functools.partial(
         shard_map, mesh=mesh,
-        in_specs=(sharded, sharded, sharded, sharded,   # usage/guar/lend/cohort_id (C axis)
-                  repl, repl, repl, repl,               # nominal/blim/guar_full/cohort_id_full
-                  repl, repl, repl, repl, repl, repl,   # group/slot/nf/policies
-                  sharded, sharded, sharded, sharded, sharded, sharded, sharded),
+        in_specs=in_specs,
         out_specs=sharded,
         check_rep=False)
     def run(usage_shard, guar_shard, lend_shard, cid_shard,
             nominal, borrow_limit, guaranteed, cohort_id_full,
             group_of_resource, slot_flavor, num_flavors,
             bwc_enabled, borrow_pol, preempt_pol,
-            wl_cq, req, has_req, podset_valid, podset_unsat, elig, resume_slot):
+            wl_cq, req, has_req, podset_valid, podset_unsat, elig, resume_slot,
+            hier=None):
         # --- cohort aggregation over the sharded CQ axis (ICI psum) ---
         above = jnp.maximum(usage_shard - guar_shard, 0)
         part_cu = jax.ops.segment_sum(above, cid_shard, num_segments=K + 1)
         cohort_usage = jax.lax.psum(part_cu, AXIS)[:K]
         part_cr = jax.ops.segment_sum(lend_shard, cid_shard, num_segments=K + 1)
         cohort_requestable = jax.lax.psum(part_cr, AXIS)[:K]
-        # Rebuild the full usage view for the workload-side gathers.
+        # Rebuild the full usage view for the workload-side gathers AND the
+        # hierarchy aggregation (per-node T balances need every leaf).
         usage_full = jax.lax.all_gather(usage_shard, AXIS, axis=0, tiled=True)
 
         return solve_core(
@@ -89,7 +99,8 @@ def _build_program(mesh: Mesh, C: int, K: int, num_slots: int,
             group_of_resource, slot_flavor, num_flavors,
             bwc_enabled, borrow_pol, preempt_pol,
             wl_cq, req, has_req, podset_valid, podset_unsat, elig, resume_slot,
-            num_slots=num_slots, fungibility_enabled=fungibility_enabled)
+            num_slots=num_slots, fungibility_enabled=fungibility_enabled,
+            hier=hier)
 
     return jax.jit(run)
 
@@ -106,12 +117,17 @@ def sharded_flavor_fit(enc, usage_tensors, wt, mesh: Mesh) -> Dict[str, np.ndarr
     W = wt.wl_cq.shape[0]
     K = enc.num_cohorts
     fungible = features.enabled(features.FLAVOR_FUNGIBILITY)
+    h = enc.hier
+    hier_shape = None if h is None else (
+        h.node_own_nominal.shape, h.cq_path.shape,
+        tuple(len(n) for n, _ in h.levels))
 
     key = (id(mesh), n_dev, C, K, W, enc.num_slots, fungible,
-           wt.req.shape, wt.elig.shape)
+           wt.req.shape, wt.elig.shape, hier_shape)
     program = _PROGRAM_CACHE.get(key)
     if program is None:
-        program = _build_program(mesh, C, K, enc.num_slots, fungible)
+        program = _build_program(mesh, C, K, enc.num_slots, fungible,
+                                 h is not None)
         _PROGRAM_CACHE[key] = program
 
     # Pad the sharded axes to multiples of the mesh size.
@@ -122,7 +138,7 @@ def sharded_flavor_fit(enc, usage_tensors, wt, mesh: Mesh) -> Dict[str, np.ndarr
     cohort_id_p = _pad_axis(enc.cohort_id, 0, n_dev)
     cohort_id_p[C:] = K
 
-    out = program(
+    args = (
         jnp.asarray(usage), jnp.asarray(guaranteed_p), jnp.asarray(lendable_p),
         jnp.asarray(cohort_id_p),
         jnp.asarray(enc.nominal), jnp.asarray(enc.borrow_limit),
@@ -139,5 +155,15 @@ def sharded_flavor_fit(enc, usage_tensors, wt, mesh: Mesh) -> Dict[str, np.ndarr
         jnp.asarray(_pad_axis(wt.elig, 0, n_dev)),
         jnp.asarray(_pad_axis(wt.resume_slot, 0, n_dev)),
     )
+    if h is not None:
+        # KEP-79 forest, replicated across the mesh (same tensors the
+        # single-device packed kernel consumes via device_static).
+        args = args + ((
+            jnp.asarray(h.node_own_nominal), jnp.asarray(h.node_blim),
+            jnp.asarray(h.node_lend), jnp.asarray(h.cq_node),
+            jnp.asarray(h.cq_lend), jnp.asarray(h.cq_hier),
+            jnp.asarray(h.cq_path),
+            tuple((jnp.asarray(n), jnp.asarray(p)) for n, p in h.levels)),)
+    out = program(*args)
     return {k: np.asarray(v)[:W] if v.ndim >= 1 else np.asarray(v)
             for k, v in out.items()}

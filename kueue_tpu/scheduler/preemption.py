@@ -33,6 +33,29 @@ DEFAULT_FAIR_STRATEGIES = (
 )
 
 
+def _plan_rounds(wi: WorkloadInfo, cq: CachedClusterQueue,
+                 candidates: List[WorkloadInfo]):
+    """The policy decision of get_targets: which minimalPreemptions rounds
+    to run. Returns (round1, round2) as (candidates, allow_borrowing,
+    threshold) tuples; round2 is the retry when round1 finds nothing
+    (preemption.go:96-117)."""
+    same_queue = [c for c in candidates if c.cluster_queue == wi.cluster_queue]
+
+    if len(same_queue) == len(candidates):
+        # No cross-queue candidates: preempt within the CQ, borrowing allowed.
+        return (candidates, True, None), None
+
+    bwc = cq.preemption.borrow_within_cohort
+    if bwc is not None and bwc.policy != BorrowWithinCohortPolicy.NEVER:
+        threshold = wi.priority
+        if bwc.max_priority_threshold is not None \
+                and bwc.max_priority_threshold < threshold:
+            threshold = bwc.max_priority_threshold + 1
+        return (candidates, True, threshold), None
+
+    return (candidates, False, None), (same_queue, True, None)
+
+
 def get_targets(wi: WorkloadInfo, assignment: Assignment, snapshot: Snapshot,
                 ordering: WorkloadOrdering, now: float,
                 fair_strategies=DEFAULT_FAIR_STRATEGIES,
@@ -45,6 +68,8 @@ def get_targets(wi: WorkloadInfo, assignment: Assignment, snapshot: Snapshot,
     `engine` selects the minimalPreemptions implementation: None = the
     sequential host referee; "jax" / "pallas" = the device scan
     (ops/preemption_scan, ops/preemption_pallas — decision-equivalent).
+    Hierarchical trees always run the host referee: its workloadFits is the
+    only implementation of the KEP-79 ancestor walk.
     """
     res_per_flv = _resources_requiring_preemption(assignment)
     cq = snapshot.cluster_queues[wi.cluster_queue]
@@ -52,6 +77,9 @@ def get_targets(wi: WorkloadInfo, assignment: Assignment, snapshot: Snapshot,
     if features.enabled(features.FAIR_SHARING) and cq.cohort is not None:
         return _fair_preemptions(wi, assignment, snapshot, res_per_flv,
                                  ordering, now, fair_strategies)
+
+    if cq.cohort is not None and cq.cohort.is_hierarchical():
+        engine = None
 
     def minimal(cands, allow_borrowing, threshold):
         if engine in ("jax", "pallas"):
@@ -69,25 +97,90 @@ def get_targets(wi: WorkloadInfo, assignment: Assignment, snapshot: Snapshot,
         return []
     candidates.sort(key=lambda c: _candidate_sort_key(c, cq.name, now))
 
-    same_queue = [c for c in candidates if c.cluster_queue == wi.cluster_queue]
-
-    if len(same_queue) == len(candidates):
-        # No cross-queue candidates: preempt within the CQ, borrowing allowed.
-        return minimal(candidates, True, None)
-
-    bwc = cq.preemption.borrow_within_cohort
-    if bwc is not None and bwc.policy != BorrowWithinCohortPolicy.NEVER:
-        threshold = wi.priority
-        if bwc.max_priority_threshold is not None \
-                and bwc.max_priority_threshold < threshold:
-            threshold = bwc.max_priority_threshold + 1
-        return minimal(candidates, True, threshold)
-
-    targets = minimal(candidates, False, None)
-    if not targets:
-        # Second attempt: only same-queue candidates, with borrowing.
-        targets = minimal(same_queue, True, None)
+    round1, round2 = _plan_rounds(wi, cq, candidates)
+    targets = minimal(*round1)
+    if not targets and round2 is not None:
+        targets = minimal(*round2)
     return targets
+
+
+def get_targets_batch(items, snapshot: Snapshot, ordering: WorkloadOrdering,
+                      now: float, fair_strategies, ctx, usage,
+                      backend: str = "native",
+                      ) -> List[List[WorkloadInfo]]:
+    """Victim search for every PREEMPT-mode entry of a tick in (at most)
+    two batched engine calls (ops/preemption_batch).
+
+    `items` is a sequence of (WorkloadInfo, Assignment); `ctx`/`usage` come
+    from BatchSolver.preemption_context(). Entries the device kernel cannot
+    express (fair sharing, hierarchical trees, CQs outside the encoding)
+    fall back to the host path, preserving decision equivalence.
+    """
+    from kueue_tpu.ops.preemption_batch import PlannedSearch, run_batch
+
+    enc = ctx.enc
+    results: List[Optional[List[WorkloadInfo]]] = [None] * len(items)
+    searches: List[PlannedSearch] = []
+    search_meta = []   # (item_idx, wl_req, res_per_flv, round2 | None)
+    fair = features.enabled(features.FAIR_SHARING)
+
+    for idx, (wi, assignment) in enumerate(items):
+        res_per_flv = _resources_requiring_preemption(assignment)
+        cq = snapshot.cluster_queues[wi.cluster_queue]
+        hier = cq.cohort is not None and cq.cohort.is_hierarchical()
+        ci = enc.cq_index.get(wi.cluster_queue)
+        if (fair and cq.cohort is not None) or hier or ci is None:
+            results[idx] = get_targets(wi, assignment, snapshot, ordering,
+                                       now, fair_strategies, engine=None)
+            continue
+        candidates = _find_candidates(wi, ordering, cq, res_per_flv)
+        if not candidates:
+            results[idx] = []
+            continue
+        candidates.sort(key=lambda c: _candidate_sort_key(c, cq.name, now))
+        round1, round2 = _plan_rounds(wi, cq, candidates)
+        cands, allow_b, thr = round1
+        wl_req = _total_requests_for_assignment(wi, assignment)
+        searches.append(PlannedSearch(
+            target_ci=ci, has_cohort=cq.cohort is not None,
+            candidates=cands,
+            cand_cis=[enc.cq_index[c.cluster_queue] for c in cands],
+            allow_borrowing=allow_b, threshold=thr))
+        search_meta.append((idx, wl_req, res_per_flv, round2))
+
+    if searches:
+        out1 = run_batch(ctx, usage, searches,
+                         [m[1] for m in search_meta],
+                         [m[2] for m in search_meta], backend=backend)
+        retry_searches: List[PlannedSearch] = []
+        retry_meta = []
+        for (idx, wl_req, res_per_flv, round2), targets in zip(
+                search_meta, out1):
+            if targets or round2 is None:
+                results[idx] = targets
+                continue
+            cands, allow_b, thr = round2
+            if not cands:
+                results[idx] = []
+                continue
+            wi = items[idx][0]
+            ci = enc.cq_index[wi.cluster_queue]
+            retry_searches.append(PlannedSearch(
+                target_ci=ci,
+                has_cohort=snapshot.cluster_queues[
+                    wi.cluster_queue].cohort is not None,
+                candidates=cands,
+                cand_cis=[enc.cq_index[c.cluster_queue] for c in cands],
+                allow_borrowing=allow_b, threshold=thr))
+            retry_meta.append((idx, wl_req, res_per_flv))
+        if retry_searches:
+            out2 = run_batch(ctx, usage, retry_searches,
+                             [m[1] for m in retry_meta],
+                             [m[2] for m in retry_meta], backend=backend)
+            for (idx, _, _), targets in zip(retry_meta, out2):
+                results[idx] = targets
+
+    return results
 
 
 def _resources_requiring_preemption(assignment: Assignment) -> ResourcesPerFlavor:
@@ -145,9 +238,13 @@ def _cq_is_borrowing(cq: CachedClusterQueue,
         return False
     for rg in cq.resource_groups:
         for fq in rg.flavors:
-            fusage = cq.usage.get(fq.name, {})
+            if fq.name not in res_per_flv:
+                continue
+            fusage = cq.usage.get(fq.name)
+            if not fusage:
+                continue
             quotas = fq.resources_dict
-            for rname in res_per_flv.get(fq.name, ()):
+            for rname in res_per_flv[fq.name]:
                 quota = quotas.get(rname)
                 if quota is not None and fusage.get(rname, 0) > quota.nominal:
                     return True
@@ -155,10 +252,10 @@ def _cq_is_borrowing(cq: CachedClusterQueue,
 
 
 def _uses_resources(wi: WorkloadInfo, res_per_flv: ResourcesPerFlavor) -> bool:
-    for ps in wi.total_requests:
-        for res, flv in ps.flavors.items():
-            if res in res_per_flv.get(flv, ()):
-                return True
+    for flv, res, _ in wi.usage_triples:
+        rs = res_per_flv.get(flv)
+        if rs is not None and res in rs:
+            return True
     return False
 
 

@@ -241,10 +241,31 @@ class Scheduler:
         else:
             assignments = None
         fair = features.enabled(features.FAIR_SHARING)
+        # Batched device victim search: all PREEMPT-mode entries of the
+        # tick solved in at most two dispatches instead of one per entry
+        # (preemption.go runs these sequentially per head; the searches
+        # are independent against the frozen snapshot, so batching is
+        # decision-preserving).
+        batch_targets: Dict[int, List[WorkloadInfo]] = {}
+        if (assignments is not None
+                and self.preemption_engine in ("native", "jax", "pallas")):
+            ctx_fn = getattr(self.batch_solver, "preemption_context", None)
+            ctx_usage = ctx_fn() if ctx_fn is not None else None
+            if ctx_usage is not None:
+                pre_idx = [i for i, a in enumerate(assignments)
+                           if a.representative_mode == PREEMPT]
+                if pre_idx:
+                    targets_list = preemption_mod.get_targets_batch(
+                        [(entries[i].info, assignments[i]) for i in pre_idx],
+                        snapshot, self.ordering, self.clock(),
+                        self.fair_strategies, *ctx_usage,
+                        backend=self.preemption_engine)
+                    batch_targets = dict(zip(pre_idx, targets_list))
         shares: Dict[str, float] = {}
         for i, e in enumerate(entries):
             full = assignments[i] if assignments is not None else None
-            assignment, targets = self._get_assignment(e.info, snapshot, full)
+            assignment, targets = self._get_assignment(
+                e.info, snapshot, full, precomputed_targets=batch_targets.get(i))
             e.assignment = assignment
             e.preemption_targets = targets
             e.inadmissible_msg = assignment.message()
@@ -259,7 +280,8 @@ class Scheduler:
                 e.share = shares[cq_name]
 
     def _get_assignment(self, wi: WorkloadInfo, snap: Snapshot,
-                        precomputed: Optional[Assignment]):
+                        precomputed: Optional[Assignment],
+                        precomputed_targets: Optional[List[WorkloadInfo]] = None):
         """scheduler.go getAssignments (:390-429)."""
         cq = snap.cluster_queues[wi.cluster_queue]
         full = precomputed if precomputed is not None else \
@@ -269,10 +291,11 @@ class Scheduler:
             return full, []
         targets: List[WorkloadInfo] = []
         if mode == PREEMPT:
-            targets = preemption_mod.get_targets(
-                wi, full, snap, self.ordering, self.clock(),
-                fair_strategies=self.fair_strategies,
-                engine=self.preemption_engine)
+            targets = precomputed_targets if precomputed_targets is not None \
+                else preemption_mod.get_targets(
+                    wi, full, snap, self.ordering, self.clock(),
+                    fair_strategies=self.fair_strategies,
+                    engine=self.preemption_engine)
         if not features.enabled(features.PARTIAL_ADMISSION) or targets:
             return full, targets
         if wi.obj.can_be_partially_admitted():
@@ -313,6 +336,23 @@ class Scheduler:
         cycle_cohorts_usage: Dict[str, FlavorResourceQuantities] = {}
         cycle_cohorts_skip_preemption: Set[str] = set()
         admitted = 0
+        # Batched staleness re-validation: one vectorized pass over all
+        # in-doubt FIT entries against the solver's lockstep usage tensor
+        # (falls back to the per-entry referee walk when unavailable).
+        still_fits: Dict[int, bool] = {}
+        if revalidate and self.batch_solver is not None:
+            fit_entries = [
+                e for e in entries
+                if e.assignment is not None
+                and e.assignment.representative_mode == FIT]
+            if fit_entries:
+                reval = getattr(self.batch_solver, "revalidate_fits", None)
+                mask = reval([(e.info.cluster_queue, e.assignment.usage)
+                              for e in fit_entries]) \
+                    if reval is not None else None
+                if mask is not None:
+                    still_fits = {id(e): bool(ok)
+                                  for e, ok in zip(fit_entries, mask)}
         for e in entries:
             if e.assignment is None:
                 continue
@@ -320,20 +360,23 @@ class Scheduler:
             if mode == NO_FIT:
                 continue
             cq = snapshot.cluster_queues[e.info.cluster_queue]
-            if revalidate and mode == FIT \
-                    and not _assignment_still_fits(e.assignment, cq):
-                # Pipelined staleness: the solve ran against usage from
-                # dispatch time and another in-flight tick's admissions
-                # landed since. Never overadmit — requeue and re-solve
-                # with fresh usage next tick (optimistic concurrency, the
-                # assume/forget discipline of cache.go:498-546 applied to
-                # the solve itself).
-                e.status = SKIPPED
-                e.inadmissible_msg = ("admission solve became stale; "
-                                      "re-solving with fresh usage")
-                e.info.last_assignment = None
-                self.metrics.skipped += 1
-                continue
+            if revalidate and mode == FIT:
+                verdict = still_fits.get(id(e))
+                if verdict is None:
+                    verdict = _assignment_still_fits(e.assignment, cq)
+                if not verdict:
+                    # Pipelined staleness: the solve ran against usage from
+                    # dispatch time and another in-flight tick's admissions
+                    # landed since. Never overadmit — requeue and re-solve
+                    # with fresh usage next tick (optimistic concurrency, the
+                    # assume/forget discipline of cache.go:498-546 applied to
+                    # the solve itself).
+                    e.status = SKIPPED
+                    e.inadmissible_msg = ("admission solve became stale; "
+                                          "re-solving with fresh usage")
+                    e.info.last_assignment = None
+                    self.metrics.skipped += 1
+                    continue
             if cq.cohort is not None:
                 # Cycle bookkeeping: this cycle's reservations are not in
                 # the snapshot yet, so track them on the side and re-check

@@ -1,0 +1,148 @@
+"""Ad-hoc profiling of the e2e tick at north-star shape (not shipped)."""
+import cProfile
+import io
+import os
+import pstats
+import random
+import sys
+import time
+from collections import deque
+
+import numpy as np
+
+sys.argv = [sys.argv[0]]
+
+from kueue_tpu.models.flavor_fit import BatchSolver
+from kueue_tpu.api.types import PodSet, Workload
+from kueue_tpu.utils.synthetic import synthetic_framework
+from kueue_tpu.metrics import REGISTRY
+
+TICKS = int(os.environ.get("TICKS", "20"))
+PREEMPT = os.environ.get("PREEMPT") == "1"
+
+t0 = time.perf_counter()
+fw = synthetic_framework(
+    num_cqs=1000, num_cohorts=100, num_flavors=8,
+    num_pending=50_000, usage_fill=0.9 if PREEMPT else 0.7, seed=42,
+    preemption_heavy=PREEMPT,
+    batch_solver=BatchSolver(),
+    pipeline_depth=int(os.environ.get("DEPTH", "8")))
+print(f"setup {time.perf_counter()-t0:.1f}s", file=sys.stderr)
+
+admitted_log = deque()
+tick_no = [0]
+orig_apply = fw.scheduler.apply_admission
+
+
+def apply_admission(wl):
+    ok = orig_apply(wl)
+    if ok:
+        admitted_log.append((tick_no[0], wl))
+    return ok
+
+
+fw.scheduler.apply_admission = apply_admission
+rnd = random.Random(43)
+submit_seq = [0]
+
+
+def submit_replacement():
+    submit_seq[0] += 1
+    i = submit_seq[0]
+    c = rnd.randrange(1000)
+    if PREEMPT:
+        priority = rnd.randint(1, 5) if i % 2 else rnd.randint(-2, 0)
+    else:
+        priority = rnd.randint(-2, 2)
+    fw.submit(Workload(
+        name=f"churn-{i}", namespace="default",
+        queue_name=f"lq-{c}", priority=priority,
+        creation_time=float(100_000 + i),
+        pod_sets=[PodSet.make(
+            "ps0", count=rnd.randint(1, 8), cpu=rnd.randint(1, 8),
+            memory=f"{rnd.randint(1, 16)}Gi")]))
+
+
+def churn():
+    while admitted_log and admitted_log[0][0] <= tick_no[0] - 5:
+        _, wl = admitted_log.popleft()
+        if wl.is_admitted and not wl.is_finished:
+            fw.finish(wl)
+            fw.delete_workload(wl)
+            submit_replacement()
+
+
+for _ in range(14):
+    tick_no[0] += 1
+    fw.tick()
+    churn()
+
+import gc
+gc.collect()
+gc.freeze()
+if os.environ.get("GCOFF") == "1":
+    gc.disable()
+else:
+    g0 = int(os.environ.get("GC0", "200000"))
+    g1 = int(os.environ.get("GC1", "100"))
+    g2 = int(os.environ.get("GC2", "100"))
+    gc.set_threshold(g0, g1, g2)
+
+# Reset phase histograms after warmup.
+phases = REGISTRY.tick_phase_seconds
+phases.counts.clear()
+phases.sums.clear()
+phases.totals.clear()
+
+PROFILE = os.environ.get("PROFILE") == "1"
+pr = cProfile.Profile()
+times = []
+if PROFILE:
+    pr.enable()
+phase_rows = []
+for _ in range(TICKS):
+    tick_no[0] += 1
+    before = dict(phases.sums)
+    t = time.perf_counter()
+    fw.tick()
+    times.append(time.perf_counter() - t)
+    phase_rows.append({k[0]: phases.sums[k] - before.get(k, 0.0)
+                       for k in phases.sums})
+    churn()
+if PROFILE:
+    pr.disable()
+
+times_ms = np.array(times) * 1000
+print(f"p50 {np.percentile(times_ms,50):.1f}ms p99 {np.percentile(times_ms,99):.1f}ms mean {times_ms.mean():.1f}ms", file=sys.stderr)
+
+print("phase sums over run (s) / count / mean ms:", file=sys.stderr)
+for key in sorted(phases.sums):
+    s_, n_ = phases.sums[key], phases.totals[key]
+    print(f"  {key}: {s_:.3f}s  n={n_}  mean={1000*s_/max(n_,1):.1f}ms",
+          file=sys.stderr)
+
+print("per-tick ms:", " ".join(f"{t*1000:.0f}" for t in times),
+      file=sys.stderr)
+names = sorted(phase_rows[0])
+print("tick  " + "  ".join(f"{n[:8]:>8}" for n in names), file=sys.stderr)
+for i, row in enumerate(phase_rows):
+    if i < 6 or i >= len(phase_rows) - 6:
+        print(f"{i:4d}  " + "  ".join(f"{1000*row.get(n,0):8.1f}" for n in names),
+              file=sys.stderr)
+m = fw.scheduler.metrics
+print(f"admitted={m.admitted} skipped={m.skipped} "
+      f"inadmissible={m.inadmissible} preempted={m.preempted}",
+      file=sys.stderr)
+qm = fw.queues
+try:
+    heaps = sum(len(cq.heap) for cq in qm.cluster_queues.values())
+    parked = sum(len(cq.inadmissible) for cq in qm.cluster_queues.values())
+    print(f"heap total={heaps} parked={parked}", file=sys.stderr)
+except Exception as e:
+    print("introspect fail:", e,
+          {k: type(v).__name__ for k, v in vars(qm).items()}, file=sys.stderr)
+if PROFILE:
+    s = io.StringIO()
+    ps = pstats.Stats(pr, stream=s).sort_stats("cumulative")
+    ps.print_stats(45)
+    print(s.getvalue()[:7000])

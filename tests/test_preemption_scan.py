@@ -230,3 +230,101 @@ class TestFuzz:
                 mismatches.append((trial, host, device))
         assert not mismatches, mismatches
         assert preempt_cases > 5  # the sweep actually exercises preemption
+
+
+class TestBatchEngine:
+    """The whole-tick batched victim search (ops/preemption_batch via
+    preemption.get_targets_batch) must reproduce the host get_targets
+    per entry — including the two-round cross-CQ fallback, thresholds,
+    cohort membership and lending splits."""
+
+    @pytest.mark.parametrize("batch_backend", ["native", "jax"])
+    @pytest.mark.parametrize("lending", [False, True])
+    def test_randomized_batch_equivalence(self, lending, batch_backend):
+        from kueue_tpu.models.flavor_fit import BatchSolver
+        from kueue_tpu.ops.preemption_batch import _native_lib
+        from kueue_tpu.solver import schema as sch
+
+        if batch_backend == "native" and _native_lib() is None:
+            pytest.skip("native toolchain unavailable — C++ engine untestable")
+
+        if lending:
+            features.set_enabled(features.LENDING_LIMIT, True)
+        rnd = random.Random(7 + lending)
+        preempt_cases = 0
+        for trial in range(25):
+            cache = Cache()
+            cache.add_or_update_resource_flavor(make_flavor("default"))
+            n_cq = rnd.randint(1, 4)
+            cohort = "co" if n_cq > 1 else ""
+            for ci in range(n_cq):
+                lend = rnd.randint(0, 4) if (lending and cohort
+                                             and rnd.random() < 0.5) else None
+                bwc = None
+                if cohort and rnd.random() < 0.4:
+                    bwc = BorrowWithinCohort(
+                        policy="LowerPriority",
+                        max_priority_threshold=rnd.choice([None, 0, 2]))
+                cache.add_cluster_queue(make_cq(
+                    f"cq{ci}",
+                    rg("cpu", fq("default",
+                                 cpu=(rnd.randint(4, 10),
+                                      rnd.randint(0, 6), lend)
+                                 if (cohort and rnd.random() < 0.6)
+                                 else rnd.randint(4, 10))),
+                    cohort=cohort,
+                    preemption=ClusterQueuePreemption(
+                        within_cluster_queue=rnd.choice(
+                            ["LowerPriority", "Never"]),
+                        reclaim_within_cohort=rnd.choice(
+                            ["Any", "LowerPriority", "Never"]),
+                        borrow_within_cohort=bwc)))
+                cache.add_local_queue(make_lq(f"q{ci}", cq=f"cq{ci}"))
+            for wi_idx in range(rnd.randint(2, 10)):
+                ci = rnd.randrange(n_cq)
+                wl = make_wl(f"w{wi_idx}", f"q{ci}",
+                             priority=rnd.randint(-3, 3),
+                             cpu=rnd.randint(1, 4))
+                try:
+                    cache.add_or_update_workload(
+                        admit(wl, f"cq{ci}", "default"))
+                except Exception:
+                    continue
+            snap = cache.snapshot()
+
+            # A batch of incoming PREEMPT-mode entries.
+            items = []
+            for k in range(rnd.randint(1, 5)):
+                ci = rnd.randrange(n_cq)
+                wl = make_wl(f"in{k}", f"q{ci}",
+                             priority=rnd.randint(-1, 4),
+                             cpu=rnd.randint(2, 8))
+                wi = WorkloadInfo(wl, cluster_queue=f"cq{ci}")
+                a = assign_flavors(wi, snap.cluster_queues[f"cq{ci}"],
+                                   snap.resource_flavors)
+                if a.representative_mode == PREEMPT:
+                    items.append((wi, a))
+            if not items:
+                continue
+            preempt_cases += len(items)
+
+            solver = BatchSolver()
+            solver._enc = sch.encode_cluster_queues(snap)
+            solver._usage_enc = sch.UsageEncoder(solver._enc)
+            solver._usage_enc.refresh(snap)
+            ctx, usage = solver.preemption_context()
+
+            now = time.time()
+            batched = preemption.get_targets_batch(
+                items, snap, ORD, now, preemption.DEFAULT_FAIR_STRATEGIES,
+                ctx, usage, backend=batch_backend)
+            for (wi, a), got in zip(items, batched):
+                want = preemption.get_targets(
+                    wi, a, snap, ORD, now,
+                    preemption.DEFAULT_FAIR_STRATEGIES, engine=None)
+                assert ({t.obj.name for t in got}
+                        == {t.obj.name for t in want}), (
+                    f"trial={trial} wl={wi.key}: batched "
+                    f"{sorted(t.obj.name for t in got)} != host "
+                    f"{sorted(t.obj.name for t in want)}")
+        assert preempt_cases > 10

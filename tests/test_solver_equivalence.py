@@ -227,3 +227,52 @@ def test_fungibility_gate_off():
     assert ref.representative_mode == 2
     assert ref.pod_sets[0].flavors["cpu"].name == "f1"
     assert_assignment_equal(ref, got, "gate-off")
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_revalidate_fits_matches_referee(seed):
+    """The vectorized staleness re-validation (BatchSolver.revalidate_fits)
+    must agree with the per-entry referee walk
+    (scheduler._assignment_still_fits) on every FIT assignment, including
+    after usage moved under the solve (the pipelined-staleness scenario)."""
+    from kueue_tpu.scheduler.scheduler import _assignment_still_fits
+
+    cache, pending = random_problem(seed)
+    snap = cache.snapshot()
+    solver = BatchSolver()
+    assignments = solver.solve([wi.clone() for wi in pending], snap)
+
+    fit_items = [(wi, a) for wi, a in zip(pending, assignments)
+                 if a.representative_mode == 2]
+    if not fit_items:
+        return
+
+    # Staleness: land some of the FIT assignments as admissions, mirroring
+    # into the solver's usage tensor, then re-validate ALL of them against
+    # the moved usage.
+    from kueue_tpu.api.types import Admission, PodSetAssignment
+
+    rnd = random.Random(seed + 100)
+    for wi, a in fit_items:
+        if rnd.random() < 0.5:
+            wi.obj.admission = Admission(
+                cluster_queue=wi.cluster_queue,
+                pod_set_assignments=[
+                    PodSetAssignment(
+                        name=ps.name,
+                        flavors={r: fa.name for r, fa in ps.flavors.items()},
+                        resource_usage=dict(ps.requests), count=ps.count)
+                    for ps in a.pod_sets])
+            admitted_wi = WorkloadInfo(wi.obj, cluster_queue=wi.cluster_queue)
+            cq = snap.cluster_queues[wi.cluster_queue]
+            cq.add_workload_usage(admitted_wi, cohort_too=True)
+            solver.note_admission(wi.cluster_queue, a.usage)
+
+    mask = solver.revalidate_fits(
+        [(wi.cluster_queue, a.usage) for wi, a in fit_items])
+    assert mask is not None
+    for (wi, a), got in zip(fit_items, mask.tolist()):
+        cq = snap.cluster_queues[wi.cluster_queue]
+        want = _assignment_still_fits(a, cq)
+        assert got == want, (
+            f"seed={seed} wl={wi.key}: vectorized {got} != referee {want}")
