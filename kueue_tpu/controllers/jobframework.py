@@ -1,12 +1,16 @@
 """Job-integration framework: the generic job <-> Workload sync engine.
 
 Counterpart of reference pkg/controller/jobframework/: a `GenericJob`
-protocol (interface.go:32-114), an integration registry keyed by job type
-(integrationmanager.go:44-95), and the reconciler state machine
-(reconciler.go:159-440) that creates Workloads from job pod sets, starts
-jobs on admission (injecting the assigned flavors' node selectors and
-tolerations, pkg/podset), stops them on eviction (restoring templates), and
-propagates Finished / PodsReady / reclaimable-pod updates.
+protocol with optional capability seams (interface.go:32-114 —
+JobWithReclaimablePods, JobWithCustomStop, JobWithFinalize, JobWithSkip,
+JobWithPriorityClass, ComposableJob, prebuilt workloads), an integration
+registry keyed by job type (integrationmanager.go:44-95), and the
+reconciler state machine (reconciler.go:159-440) that guarantees a single
+matching Workload per job (ensureOneWorkload dedup + finish-stale,
+reconciler.go:478-579), creates Workloads from job pod sets, starts jobs
+on admission (injecting the assigned flavors' node selectors and
+tolerations, pkg/podset), stops them on eviction (restoring templates),
+and propagates Finished / PodsReady / reclaimable-pod updates.
 
 Jobs here are host-side orchestration objects (a TPU training run, a batch
 process); "running" means the framework invoked the job's `run` hook with
@@ -16,6 +20,7 @@ the admitted placement info.
 from __future__ import annotations
 
 import abc
+import enum
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Sequence, Tuple, Type
 
@@ -57,6 +62,15 @@ def podset_infos_from_admission(
             info.tolerations.extend(flavor.tolerations)
         infos.append(info)
     return infos
+
+
+class StopReason(enum.Enum):
+    """Why a job is being stopped (interface.go:66-73)."""
+
+    WORKLOAD_DELETED = "WorkloadDeleted"
+    WORKLOAD_EVICTED = "WorkloadEvicted"
+    NO_MATCHING_WORKLOAD = "NoMatchingWorkload"
+    NOT_ADMITTED = "NotAdmitted"
 
 
 class GenericJob(abc.ABC):
@@ -112,13 +126,66 @@ class GenericJob(abc.ABC):
     # Optional capabilities (interface.go:56-114).
 
     def reclaimable_pods(self) -> Dict[str, int]:
+        """JobWithReclaimablePods."""
         return {}
 
     def priority_class(self) -> str:
+        """JobWithPriorityClass."""
         return ""
 
     def priority(self) -> int:
         return 0
+
+    def prebuilt_workload(self) -> Optional[str]:
+        """Name of a pre-created Workload this job binds to instead of
+        constructing one (the kueue.x-k8s.io/prebuilt-workload-name label,
+        interface.go PrebuiltWorkloadFor); None = construct normally.
+        The default honors a `prebuilt_name` attribute so integrations can
+        carry the label value without overriding."""
+        return getattr(self, "prebuilt_name", None)
+
+
+class JobWithCustomStop(abc.ABC):
+    """Jobs with a custom stop procedure (interface.go:75-80). `stop` must
+    be idempotent and returns whether this call stopped the job."""
+
+    @abc.abstractmethod
+    def stop(self, podset_infos: Sequence[PodSetInfo], stop_reason: StopReason,
+             event_msg: str) -> bool: ...
+
+
+class JobWithFinalize(abc.ABC):
+    """Jobs needing custom finalization after they finish
+    (interface.go:82-87)."""
+
+    @abc.abstractmethod
+    def finalize(self) -> None: ...
+
+
+class JobWithSkip(abc.ABC):
+    """Jobs whose reconciliation is conditionally skipped
+    (interface.go:89-93)."""
+
+    @abc.abstractmethod
+    def skip(self) -> bool: ...
+
+
+class ComposableJob(abc.ABC):
+    """Jobs assembled out of multiple API objects (interface.go:99-114) —
+    the pod-group integration is the canonical implementation."""
+
+    @abc.abstractmethod
+    def construct_composable_workload(self) -> Optional[Workload]:
+        """Assemble the Workload from all members; None = not yet
+        constructable (e.g. the group is awaiting members)."""
+
+    @abc.abstractmethod
+    def find_matching_workloads(self, owned: Sequence[Workload],
+                                ) -> Tuple[Optional[Workload], List[Workload]]:
+        """(match, to_delete) among the job's owned workloads."""
+
+    def list_child_workloads(self, owned: Sequence[Workload]) -> List[Workload]:
+        return list(owned)
 
 
 # -- integration registry (integrationmanager.go) ---------------------------
@@ -152,24 +219,92 @@ def kind_of(job: GenericJob) -> Optional[str]:
     return None
 
 
+def _podset_shape(ps) -> Tuple:
+    return (ps.name, ps.count, tuple(sorted(ps.requests.items())))
+
+
+def equivalent_to_workload(job: GenericJob, wl: Workload) -> bool:
+    """Job <-> workload podset equivalence (reconciler.go
+    equivalentToWorkload): the workload's spec podsets must match the
+    job's, modulo partial admission — a started job may run with the
+    admission's reduced counts (expectedRunningPodSets)."""
+    jps = [_podset_shape(p) for p in job.pod_sets()]
+    wps = [_podset_shape(p) for p in wl.pod_sets]
+    if jps == wps:
+        return True
+    if wl.has_quota_reservation and wl.admission is not None:
+        admitted_counts = {psa.name: psa.count
+                          for psa in wl.admission.pod_set_assignments}
+        # Spec podset requests are per-pod; only counts scale under
+        # partial admission.
+        scaled = [(p.name, admitted_counts.get(p.name, p.count),
+                   tuple(sorted(p.requests.items())))
+                  for p in wl.pod_sets]
+        if jps == scaled:
+            return True
+    return False
+
+
+def find_matching_workloads_default(
+        job: GenericJob, owned: Sequence[Workload],
+) -> Tuple[Optional[Workload], List[Workload]]:
+    """First equivalent workload wins; the rest are duplicates to delete
+    (reconciler.go FindMatchingWorkloads :581-600). Shared by the
+    reconciler's non-composable branch and composable implementations that
+    want the default policy."""
+    match = None
+    to_delete = []
+    for w in owned:
+        if match is None and equivalent_to_workload(job, w):
+            match = w
+        else:
+            to_delete.append(w)
+    return match, to_delete
+
+
+@dataclass
+class _JobState:
+    job: GenericJob
+    owned: List[str] = field(default_factory=list)   # workload keys
+    finalized: bool = False
+
+
 class JobReconciler:
     """The job <-> workload state machine (reconciler.go:159-440).
 
     Driven by the runtime after every scheduling tick and on job events.
+    Guarantees the single-workload invariant per job: duplicate or
+    non-equivalent workloads are deleted (finish-stale), a running job
+    without a matching workload is stopped, and a suspended unreserved
+    workload is updated in place to match the job
+    (ensureOneWorkload, reconciler.go:478-579).
     """
 
     def __init__(self, framework):
         self.fw = framework
-        # job key -> (job, workload key)
-        self.jobs: Dict[str, Tuple[GenericJob, str]] = {}
+        self._states: Dict[str, _JobState] = {}
 
     @staticmethod
     def job_key(job: GenericJob) -> str:
         return f"{job.namespace}/{job.name}"
 
+    # Back-compat introspection used by tests/integrations. Read-only
+    # view — mutate through submit/adopt_workload/delete/forget.
+    @property
+    def jobs(self) -> Dict[str, Tuple[GenericJob, str]]:
+        return {k: (s.job, s.owned[0] if s.owned else "")
+                for k, s in self._states.items()}
+
+    def forget(self, job_key: str) -> None:
+        """Stop tracking a job WITHOUT deleting its workloads (the caller
+        already disposed of them — e.g. a MultiKueue worker garbage-
+        collecting a mirror and its bound remote job together)."""
+        self._states.pop(job_key, None)
+
     def submit(self, job: GenericJob) -> Optional[Workload]:
-        """Admit a job into the queueing system: default-suspend it and
-        create its Workload (reconciler.go handleJobWithNoWorkload).
+        """Admit a job into the queueing system: default-suspend it,
+        register it, and run one reconcile pass (which creates the
+        Workload — reconciler.go handleJobWithNoWorkload).
 
         Jobs of a non-enabled integration are rejected
         (integrationmanager.go:44-76: only configured integrations are set
@@ -192,80 +327,239 @@ class JobReconciler:
             return None
         if not job.is_suspended():
             job.suspend()
-        wl = Workload(
-            name=f"job-{job.name}",
-            namespace=job.namespace,
-            queue_name=job.queue_name,
-            # FilterProvReqAnnotations (reconciler.go:808): only the
-            # provisioning-parameter annotations flow onto the Workload.
-            annotations={k: v for k, v in job.annotations.items()
-                         if k.startswith(PROV_REQ_ANNOTATION_PREFIX)},
-            pod_sets=list(job.pod_sets()),
-            priority=job.priority(),
-            priority_class=job.priority_class(),
-        )
-        self.jobs[self.job_key(job)] = (job, wl.key)
-        self.fw.submit(wl)
-        return wl
+        state = self._states.setdefault(self.job_key(job), _JobState(job=job))
+        state.job = job
+        self.reconcile_job(job)
+        wl_key = state.owned[0] if state.owned else None
+        return self.fw.workloads.get(wl_key) if wl_key else None
+
+    def adopt_workload(self, job: GenericJob, wl: Workload) -> None:
+        """Register an externally created workload as owned by `job` (the
+        owner-reference indexing of reconciler.go FindMatchingWorkloads;
+        also how duplicates enter and get deduped)."""
+        state = self._states.setdefault(self.job_key(job), _JobState(job=job))
+        if wl.key not in state.owned:
+            state.owned.append(wl.key)
 
     def delete(self, job: GenericJob) -> None:
-        entry = self.jobs.pop(self.job_key(job), None)
-        if entry is None:
+        state = self._states.pop(self.job_key(job), None)
+        if state is None:
             return
-        wl = self.fw.workloads.get(entry[1])
-        if wl is not None:
-            self.fw.delete_workload(wl)
+        for key in state.owned:
+            wl = self.fw.workloads.get(key)
+            if wl is not None:
+                self.fw.delete_workload(wl)
+        self._finalize(state)
 
     def reconcile(self) -> None:
         """One pass of the job state machine over all tracked jobs."""
-        for job, wl_key in list(self.jobs.values()):
-            wl = self.fw.workloads.get(wl_key)
-            if wl is None:
-                continue
+        for state in list(self._states.values()):
+            self.reconcile_job(state.job)
 
-            # 1. Propagate Finished (reconciler.go step 2).
-            done, success = job.finished()
-            if done and not wl.is_finished:
-                self.fw.finish(wl)
-                continue
-            if wl.is_finished:
-                continue
+    # -- the per-job state machine (reconciler.go:159-440) ------------------
 
-            # 2. Sync reclaimable pods (step 4; KEP-78 dynamic reclaim).
-            # A rejected update (webhook: shrinking/out-of-range counts) is
-            # dropped, like a denied SSA patch in the reference.
-            reclaimable = job.reclaimable_pods()
-            if reclaimable and reclaimable != wl.reclaimable_pods:
-                from kueue_tpu.webhooks import ValidationError
-                try:
-                    self.fw.update_reclaimable_pods(wl, reclaimable)
-                except ValidationError:
-                    pass
+    def reconcile_job(self, job: GenericJob) -> None:
+        state = self._states.get(self.job_key(job))
+        if state is None:
+            return
 
-            # 3. PodsReady condition from the job (step 5).
-            if job.pods_ready() and not wl.condition_true("PodsReady"):
-                self.fw.mark_pods_ready(wl)
+        # 0. JobWithSkip: reconciliation conditionally skipped
+        #    (reconciler.go:177-181).
+        if isinstance(job, JobWithSkip) and job.skip():
+            return
 
-            # 4. Evicted -> stop the job (step 6).
-            if wl.is_evicted and not job.is_suspended():
-                self._stop_job(job, wl)
-                continue
+        # 1. Single-workload invariant (reconciler.go:270 ensureOneWorkload).
+        wl = self._ensure_one_workload(state, job)
 
-            # 5. Admitted -> start the job (step 7).
-            if wl.is_admitted and job.is_suspended():
-                infos = podset_infos_from_admission(
-                    wl, self.fw.cache.resource_flavors)
-                job.run(infos)
+        # 1.1 Workload finished -> finalize the job (reconciler.go:276-285).
+        if wl is not None and wl.is_finished:
+            self._finalize(state)
+            return
 
-            # 6. Job unsuspended without admission -> hold it (step 8).
-            if not job.is_suspended() and not wl.is_admitted \
-                    and not wl.has_quota_reservation:
-                self._stop_job(job, wl)
+        # 2. Job finished -> propagate onto the workload, finalize
+        #    (reconciler.go:300-317).
+        done, success = job.finished()
+        if done:
+            if wl is not None and not wl.is_finished:
+                self.fw.finish(wl, success=success)
+            self._finalize(state)
+            return
 
-    def _stop_job(self, job: GenericJob, wl: Workload) -> None:
-        infos = []
-        if wl.admission is not None:
+        # 3. No workload -> create one (reconciler.go:319-331).
+        if wl is None:
+            self._handle_no_workload(state, job)
+            return
+
+        # 4. Sync reclaimable pods (KEP-78 dynamic reclaim,
+        #    reconciler.go:333-350). A rejected update (webhook:
+        #    shrinking/out-of-range counts) is dropped, like a denied SSA
+        #    patch in the reference.
+        reclaimable = job.reclaimable_pods()
+        if reclaimable and reclaimable != wl.reclaimable_pods:
+            from kueue_tpu.webhooks import ValidationError
+            try:
+                self.fw.update_reclaimable_pods(wl, reclaimable)
+            except ValidationError:
+                pass
+
+        # 5. PodsReady condition from the job (reconciler.go:352-366).
+        if job.pods_ready() and not wl.condition_true("PodsReady"):
+            self.fw.mark_pods_ready(wl)
+
+        # 6. Evicted -> stop the job (reconciler.go:368-384).
+        if wl.is_evicted and not job.is_suspended():
+            evicted = wl.find_condition("Evicted")
+            self._stop_job(job, wl, StopReason.WORKLOAD_EVICTED,
+                           evicted.message if evicted else "")
+            return
+
+        # 7. Admitted -> start the job (reconciler.go:386-404).
+        if wl.is_admitted and job.is_suspended():
             infos = podset_infos_from_admission(
                 wl, self.fw.cache.resource_flavors)
-        job.suspend()
+            job.run(infos)
+            return
+
+        # 7.1 Queue change while suspended (reconciler.go:406-416).
+        if job.is_suspended() and not wl.has_quota_reservation \
+                and wl.queue_name != job.queue_name:
+            self.fw.move_workload_queue(wl, job.queue_name)
+            return
+
+        # 8. Deactivated workload -> evict (reconciler.go:419-426).
+        if not wl.active and not wl.is_evicted:
+            from kueue_tpu.api.types import EVICTED_BY_DEACTIVATION
+            self.fw.evict_workload(
+                wl, reason=EVICTED_BY_DEACTIVATION,
+                message="The workload is deactivated")
+            return
+
+        # 9. Job unsuspended without admission -> hold it
+        #    (reconciler.go:428-437).
+        if not job.is_suspended() and not wl.is_admitted \
+                and not wl.has_quota_reservation:
+            self._stop_job(job, wl, StopReason.NOT_ADMITTED,
+                           "Not admitted by cluster queue")
+
+    # -- ensureOneWorkload (reconciler.go:478-579) ---------------------------
+
+    def _ensure_one_workload(self, state: _JobState,
+                             job: GenericJob) -> Optional[Workload]:
+        prebuilt = job.prebuilt_workload()
+        if prebuilt is not None:
+            wl = self.fw.workloads.get(f"{job.namespace}/{prebuilt}")
+            if wl is None:
+                return None
+            if wl.key not in state.owned:
+                state.owned.append(wl.key)
+            if not equivalent_to_workload(job, wl) and not wl.is_finished:
+                # ensurePrebuiltWorkloadInSync: finish it, out of sync.
+                self.fw.finish(wl, success=False, reason="OutOfSync")
+                return None
+            return wl
+
+        owned = [self.fw.workloads[k] for k in state.owned
+                 if k in self.fw.workloads]
+        state.owned = [w.key for w in owned]
+        if isinstance(job, ComposableJob):
+            match, to_delete = job.find_matching_workloads(owned)
+        else:
+            match, to_delete = find_matching_workloads_default(job, owned)
+
+        to_update = None
+        if match is None and to_delete and job.is_suspended() \
+                and not to_delete[0].has_quota_reservation:
+            # A suspended job's unreserved stale workload is updated in
+            # place instead of recreated (reconciler.go:517-521).
+            to_update = to_delete.pop(0)
+
+        if match is None and not job.is_suspended() and not job.finished()[0]:
+            # Running with no matching workload: all bets are off — stop
+            # (reconciler.go:523-545).
+            w = to_delete[0] if len(to_delete) == 1 else None
+            msg = ("No matching Workload; restoring pod templates according "
+                   "to existent Workload") if w is not None else \
+                "Missing Workload; unable to restore pod templates"
+            self._stop_job(job, w, StopReason.NO_MATCHING_WORKLOAD, msg)
+
+        # Delete duplicate / non-equivalent workloads (finish-stale,
+        # reconciler.go:547-572).
+        for w in to_delete:
+            state.owned.remove(w.key)
+            self.fw.delete_workload(w)
+        if to_delete:
+            # The reference returns an error to requeue; the next reconcile
+            # pass recreates. Surface the same "nothing matched this pass".
+            return match
+
+        if to_update is not None:
+            return self._update_workload_to_match(state, job, to_update)
+        return match
+
+    def _update_workload_to_match(self, state: _JobState, job: GenericJob,
+                                  wl: Workload) -> Workload:
+        """updateWorkloadToMatchJob (reconciler.go:649-668): refresh the
+        suspended, unreserved workload's podsets to the job's, re-running
+        the same priority-class resolution and resource adjustment the
+        creation path applies (a refreshed workload must not diverge from
+        an identical freshly-submitted one)."""
+        wl.pod_sets = list(job.pod_sets())
+        wl.priority = job.priority()
+        wl.priority_class = job.priority_class()
+        self.fw.requeue_updated_workload(wl)
+        return wl
+
+    def _handle_no_workload(self, state: _JobState, job: GenericJob) -> None:
+        """Create the job's workload (reconciler.go handleJobWithNoWorkload).
+        ComposableJobs may defer (group awaiting members); prebuilt-bound
+        jobs never construct — they wait for their workload to appear
+        (reconciler.go:481-496)."""
+        if job.prebuilt_workload() is not None:
+            return
+        if isinstance(job, ComposableJob):
+            wl = job.construct_composable_workload()
+            if wl is None:
+                return
+        else:
+            wl = Workload(
+                name=f"job-{job.name}",
+                namespace=job.namespace,
+                queue_name=job.queue_name,
+                # FilterProvReqAnnotations (reconciler.go:808): only the
+                # provisioning-parameter annotations flow onto the Workload.
+                annotations={k: v for k, v in job.annotations.items()
+                             if k.startswith(PROV_REQ_ANNOTATION_PREFIX)},
+                pod_sets=list(job.pod_sets()),
+                priority=job.priority(),
+                priority_class=job.priority_class(),
+            )
+        if wl.key not in state.owned:
+            state.owned.append(wl.key)
+        self.fw.submit(wl)
+
+    # -- stop / finalize -----------------------------------------------------
+
+    def _stop_job(self, job: GenericJob, wl: Optional[Workload],
+                  reason: StopReason, message: str) -> None:
+        """stopJob (reconciler.go:670-713): JobWithCustomStop runs the
+        integration's own procedure; the default suspends and restores
+        placement info."""
+        infos: List[PodSetInfo] = []
+        if wl is not None and wl.admission is not None:
+            infos = podset_infos_from_admission(
+                wl, self.fw.cache.resource_flavors)
+        if isinstance(job, JobWithCustomStop):
+            job.stop(infos, reason, message)
+            return
+        if not job.is_suspended():
+            job.suspend()
         job.restore(infos)
+
+    def _finalize(self, state: _JobState) -> None:
+        """finalizeJob (reconciler.go:715-723): JobWithFinalize hook, once."""
+        if state.finalized:
+            return
+        job = state.job
+        if isinstance(job, JobWithFinalize):
+            job.finalize()
+        state.finalized = True

@@ -172,7 +172,7 @@ class InProcessRemote(RemoteClient):
         # (the remote job is owned by the mirrored workload).
         for job_key, (job, wl_key) in list(self.fw.job_reconciler.jobs.items()):
             if wl_key == key:
-                del self.fw.job_reconciler.jobs[job_key]
+                self.fw.job_reconciler.forget(job_key)
                 self.jobs.pop(job_key, None)
 
     def get_status(self, key: str) -> Optional[dict]:
@@ -206,8 +206,10 @@ class InProcessRemote(RemoteClient):
             return
         self.jobs[key] = job
         # The remote job reuses the mirrored workload rather than creating
-        # a second one (managed-by semantics, workload.go:232-300).
-        self.fw.job_reconciler.jobs[key] = (job, wl.key)
+        # a second one (managed-by semantics, workload.go:232-300), via the
+        # jobframework's prebuilt-workload seam (reconciler.go:481-496).
+        job.prebuilt_name = wl.name
+        self.fw.job_reconciler.submit(job)
 
     def get_job(self, namespace: str, name: str) -> Optional[dict]:
         remote = self.jobs.get(f"{namespace}/{name}")

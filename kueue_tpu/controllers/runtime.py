@@ -383,10 +383,13 @@ class Framework:
                     return False
         return True
 
-    def finish(self, wl: Workload) -> None:
+    def finish(self, wl: Workload, success: bool = True,
+               reason: str = "") -> None:
         """Mark a workload Finished and release its quota
         (core/workload_controller.go finished handling)."""
-        wl.set_condition(CONDITION_FINISHED, True, reason="JobFinished",
+        if not reason:
+            reason = "JobFinished" if success else "JobFailed"
+        wl.set_condition(CONDITION_FINISHED, True, reason=reason,
                          now=self.clock())
         self.events.event(wl.key, events_mod.NORMAL,
                           events_mod.REASON_FINISHED, "Workload finished",
@@ -404,6 +407,35 @@ class Framework:
             self._note_quota_released(wl, released)
         self.queues.delete_workload(wl)
         self.queues.queue_associated_inadmissible_workloads(wl)
+
+    def requeue_updated_workload(self, wl: Workload) -> None:
+        """Re-enqueue a pending workload whose spec changed in place (the
+        jobframework's updateWorkloadToMatchJob, reconciler.go:649-668),
+        re-applying the creation path's resource adjustment and
+        priority-class resolution so the refreshed workload matches a
+        freshly-submitted identical one."""
+        limitrange_mod.adjust_resources(
+            wl, self.limit_ranges.get(wl.namespace, []), self.runtime_classes)
+        if wl.priority_class and wl.priority_class in self.priority_classes:
+            wl.priority = self.priority_classes[wl.priority_class].value
+        self.queues.add_or_update_workload(wl)
+
+    def move_workload_queue(self, wl: Workload, new_queue: str) -> None:
+        """Move a pending workload to another LocalQueue (jobframework
+        step 7.1, reconciler.go:406-416): remove it from the old queue's
+        heap BEFORE renaming — queue resolution follows wl.queue_name."""
+        self.queues.delete_workload(wl)
+        wl.queue_name = new_queue
+        self.queues.add_or_update_workload(wl)
+
+    def evict_workload(self, wl: Workload, reason: str, message: str) -> None:
+        """Set the Evicted condition and queue the quota release for the
+        next reconcile pass (workload_controller.go eviction handling —
+        deactivation, stop policies, check-based evictions)."""
+        wl.set_condition(CONDITION_EVICTED, True, reason=reason,
+                         message=message, now=self.clock())
+        self._count_eviction(wl, reason)
+        self._evicted_dirty.append(wl)
 
     def _note_quota_released(self, wl: Workload, wi: WorkloadInfo) -> None:
         """Lockstep-mirror a quota release (finish / delete / eviction)

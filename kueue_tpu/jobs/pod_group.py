@@ -24,8 +24,9 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Sequence, Set, Tuple
 
-from kueue_tpu.api.types import PodSet
+from kueue_tpu.api.types import PodSet, Workload
 from kueue_tpu.controllers.jobframework import (
+    ComposableJob,
     GenericJob,
     PodSetInfo,
     register_integration,
@@ -72,7 +73,7 @@ class GroupedPod:
 
 
 @register_integration("podgroup")
-class PodGroup(GenericJob):
+class PodGroup(GenericJob, ComposableJob):
     def __init__(self, name: str, queue_name: str,
                  pods: Sequence[GroupedPod],
                  total_count: Optional[int] = None,
@@ -205,3 +206,25 @@ class PodGroup(GenericJob):
 
     def priority(self) -> int:
         return self._priority
+
+    # -- ComposableJob (interface.go:99-114; the pod integration is the
+    # reference's canonical composable job, pod_controller.go:588-1108) ----
+
+    def construct_composable_workload(self) -> Optional[Workload]:
+        """Assemble the group Workload once every expected member has
+        arrived (the reference defers workload creation until the group is
+        complete, pod_controller.go group assembly)."""
+        if not self.has_all_members():
+            return None
+        return Workload(
+            name=f"job-{self._name}",
+            namespace=self._namespace,
+            queue_name=self._queue_name,
+            pod_sets=self.pod_sets(),
+            priority=self._priority,
+        )
+
+    def find_matching_workloads(self, owned):
+        from kueue_tpu.controllers.jobframework import \
+            find_matching_workloads_default
+        return find_matching_workloads_default(self, owned)

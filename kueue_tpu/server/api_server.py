@@ -355,17 +355,15 @@ class _Handler(BaseHTTPRequestHandler):
                     # Bind to an existing (mirrored) workload instead of
                     # creating a second one — the reference's
                     # prebuilt-workload-name jobframework support that
-                    # MultiKueue workers rely on.
+                    # MultiKueue workers rely on (ensureOneWorkload's
+                    # prebuilt branch, reconciler.go:481-496).
                     wl_key = f"{job.namespace}/{prebuilt}"
                     if wl_key not in self.api.fw.workloads:
                         self._error(404, f"prebuilt workload {wl_key} "
                                          "not found")
                         return
-                    job_key = f"{job.namespace}/{job.name}"
-                    self.api.fw.job_reconciler.jobs.setdefault(
-                        job_key, (job, wl_key))
-                else:
-                    self.api.fw.submit_job(job)
+                    job.prebuilt_name = prebuilt
+                self.api.fw.submit_job(job)
             self._send_json({"kind": "Job", "metadata": {
                 "name": job.name, "namespace": job.namespace}}, 201)
             return

@@ -123,6 +123,12 @@ for key in sorted(phases.sums):
 
 print("per-tick ms:", " ".join(f"{t*1000:.0f}" for t in times),
       file=sys.stderr)
+if os.environ.get("GCOFF") == "1":
+    import resource
+    rss = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+    collected = gc.collect()
+    print(f"end-of-run gc.collect(): {collected} cyclic objects; "
+          f"peak RSS {rss/1e6:.0f}MB", file=sys.stderr)
 names = sorted(phase_rows[0])
 print("tick  " + "  ".join(f"{n[:8]:>8}" for n in names), file=sys.stderr)
 for i, row in enumerate(phase_rows):

@@ -1,0 +1,267 @@
+"""GenericJob optional capability seams, exercised through
+JobReconciler.reconcile (reference: jobframework/interface.go:56-114 —
+JobWithSkip, JobWithCustomStop, JobWithFinalize, ComposableJob, prebuilt
+workloads — and reconciler.go:478-579 ensureOneWorkload dedup /
+finish-stale / job<->workload equivalence)."""
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from kueue_tpu.api.types import PodSet, ResourceFlavor, Workload
+from kueue_tpu.controllers.jobframework import (
+    ComposableJob,
+    GenericJob,
+    JobWithCustomStop,
+    JobWithFinalize,
+    JobWithSkip,
+    StopReason,
+    equivalent_to_workload,
+)
+from kueue_tpu.controllers.runtime import Framework
+from kueue_tpu.jobs.pod_group import GroupedPod, PodGroup
+
+from tests.util import fq, make_cq, make_lq, rg
+
+
+def make_fw(cpu=8):
+    fw = Framework()
+    fw.create_resource_flavor(ResourceFlavor.make("default"))
+    fw.create_cluster_queue(make_cq("cq", rg("cpu", fq("default", cpu=cpu))))
+    fw.create_local_queue(make_lq("main", cq="cq"))
+    return fw
+
+
+class FakeJob(GenericJob):
+    """Minimal concrete job with togglable state."""
+
+    def __init__(self, name="j", queue="main", cpu=2, count=1):
+        self._name = name
+        self._queue = queue
+        self._suspended = True
+        self._pod_sets = [PodSet.make("main", count=count, cpu=cpu)]
+        self.done = False
+        self.success = True
+        self.run_calls: List[Sequence] = []
+        self.restore_calls: List[Sequence] = []
+
+    @property
+    def name(self):
+        return self._name
+
+    @property
+    def queue_name(self):
+        return self._queue
+
+    def is_suspended(self):
+        return self._suspended
+
+    def suspend(self):
+        self._suspended = True
+
+    def run(self, infos):
+        self._suspended = False
+        self.run_calls.append(infos)
+
+    def restore(self, infos):
+        self.restore_calls.append(infos)
+
+    def pod_sets(self):
+        return list(self._pod_sets)
+
+    def finished(self):
+        return self.done, self.success
+
+
+class SkippingJob(FakeJob, JobWithSkip):
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        self.skipping = True
+
+    def skip(self):
+        return self.skipping
+
+
+class CustomStopJob(FakeJob, JobWithCustomStop):
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        self.stop_calls: List[Tuple[StopReason, str]] = []
+
+    def stop(self, infos, stop_reason, event_msg):
+        was = not self._suspended
+        self._suspended = True
+        self.stop_calls.append((stop_reason, event_msg))
+        return was
+
+
+class FinalizingJob(FakeJob, JobWithFinalize):
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        self.finalized = 0
+
+    def finalize(self):
+        self.finalized += 1
+
+
+class TestSkip:
+    def test_skipped_job_gets_no_workload(self):
+        fw = make_fw()
+        job = SkippingJob()
+        wl = fw.submit_job(job)
+        assert wl is None
+        assert fw.workloads == {}
+        # Un-skip: the next reconcile pass creates the workload.
+        job.skipping = False
+        fw.job_reconciler.reconcile()
+        assert "default/job-j" in fw.workloads
+
+
+class TestCustomStop:
+    def test_eviction_routes_through_custom_stop(self):
+        fw = make_fw(cpu=4)
+        job = CustomStopJob(cpu=4)
+        wl = fw.submit_job(job)
+        fw.run_until_settled()
+        assert not job.is_suspended()
+        # Evict (deactivation path) — the stop must use the seam.
+        fw.evict_workload(wl, reason="Test", message="evicted for test")
+        fw.tick()
+        assert job.stop_calls
+        reason, msg = job.stop_calls[0]
+        assert reason == StopReason.WORKLOAD_EVICTED
+        assert "evicted" in msg
+        assert job.is_suspended()
+        # Default restore() was NOT used.
+        assert job.restore_calls == []
+
+    def test_no_matching_workload_stop_reason(self):
+        fw = make_fw()
+        job = CustomStopJob()
+        wl = fw.submit_job(job)
+        fw.run_until_settled()
+        assert not job.is_suspended()
+        # The job changes shape while running: its workload no longer
+        # matches -> stopped with NO_MATCHING_WORKLOAD and the stale
+        # workload deleted.
+        job._pod_sets = [PodSet.make("main", count=2, cpu=1)]
+        fw.job_reconciler.reconcile()
+        assert job.stop_calls[-1][0] == StopReason.NO_MATCHING_WORKLOAD
+        # The stale workload object is gone (quota released) and a fresh
+        # matching one was constructed.
+        recreated = fw.workloads.get("default/job-j")
+        assert recreated is not None and recreated is not wl
+        assert recreated.admission is None
+        assert equivalent_to_workload(job, recreated)
+
+
+class TestFinalize:
+    def test_finalize_called_once_after_finish(self):
+        fw = make_fw()
+        job = FinalizingJob()
+        fw.submit_job(job)
+        fw.run_until_settled()
+        job.done = True
+        fw.tick()
+        assert job.finalized == 1
+        fw.tick()
+        fw.tick()
+        assert job.finalized == 1
+
+
+class TestEnsureOneWorkload:
+    def test_duplicate_workloads_deduped(self):
+        fw = make_fw()
+        job = FakeJob()
+        wl = fw.submit_job(job)
+        # A duplicate enters (e.g. two replicas raced); adopt it.
+        dup = Workload(name="job-j-dup", queue_name="main",
+                       pod_sets=[PodSet.make("main", count=1, cpu=2)])
+        fw.submit(dup)
+        fw.job_reconciler.adopt_workload(job, dup)
+        fw.job_reconciler.reconcile()
+        # The matching one survives; the duplicate is deleted.
+        assert wl.key in fw.workloads
+        assert dup.key not in fw.workloads
+
+    def test_stale_suspended_workload_updated_in_place(self):
+        fw = make_fw(cpu=1)   # nothing fits: stays pending/suspended
+        job = FakeJob(cpu=2)
+        wl = fw.submit_job(job)
+        fw.run_until_settled()
+        assert not wl.has_quota_reservation
+        # The suspended job's shape changes: the unreserved workload is
+        # updated in place, not recreated (reconciler.go:517-521).
+        job._pod_sets = [PodSet.make("main", count=3, cpu=1)]
+        fw.job_reconciler.reconcile()
+        assert wl.key in fw.workloads
+        assert [ps.count for ps in wl.pod_sets] == [3]
+        assert equivalent_to_workload(job, wl)
+
+    def test_equivalence_tolerates_partial_admission_counts(self):
+        fw = make_fw(cpu=2)
+        job = FakeJob(cpu=1, count=4)
+        job._pod_sets = [PodSet.make("main", count=4, min_count=1, cpu=1)]
+        wl = fw.submit_job(job)
+        fw.run_until_settled()
+        assert wl.is_admitted
+        admitted_count = wl.admission.pod_set_assignments[0].count
+        assert admitted_count == 2
+        # The job now reports the reduced count (partial admission rewrote
+        # its parallelism); still equivalent to the workload.
+        job._pod_sets = [PodSet.make("main", count=admitted_count, cpu=1)]
+        assert equivalent_to_workload(job, wl)
+        fw.job_reconciler.reconcile()
+        assert wl.key in fw.workloads
+
+
+class TestPrebuilt:
+    def test_binds_to_prebuilt_workload(self):
+        fw = make_fw()
+
+        class PrebuiltJob(FakeJob):
+            def prebuilt_workload(self):
+                return "pre"
+
+        pre = Workload(name="pre", queue_name="main",
+                       pod_sets=[PodSet.make("main", count=1, cpu=2)])
+        fw.submit(pre)
+        job = PrebuiltJob()
+        fw.submit_job(job)
+        fw.run_until_settled()
+        assert pre.is_admitted
+        assert not job.is_suspended()
+        # No second workload was constructed.
+        assert list(fw.workloads) == [pre.key]
+
+    def test_out_of_sync_prebuilt_is_finished(self):
+        fw = make_fw()
+
+        class PrebuiltJob(FakeJob):
+            def prebuilt_workload(self):
+                return "pre"
+
+        pre = Workload(name="pre", queue_name="main",
+                       pod_sets=[PodSet.make("main", count=9, cpu=1)])
+        fw.submit(pre)
+        job = PrebuiltJob()   # wants count=1 cpu=2: out of sync
+        fw.submit_job(job)
+        assert pre.is_finished
+        cond = pre.find_condition("Finished")
+        assert cond.reason == "OutOfSync"
+
+
+class TestComposable:
+    def test_incomplete_group_defers_workload(self):
+        fw = make_fw()
+        group = PodGroup("g", "main",
+                         [GroupedPod("p0", {"cpu": 1}, group="g")],
+                         total_count=2)
+        wl = fw.submit_job(group)
+        assert wl is None
+        assert fw.workloads == {}
+        # The missing member arrives: the next pass constructs the group
+        # workload atomically.
+        group.add_pod(GroupedPod("p1", {"cpu": 1}, group="g"))
+        fw.job_reconciler.reconcile()
+        fw.run_until_settled()
+        [(key, wl)] = list(fw.workloads.items())
+        assert wl.is_admitted
+        assert sum(ps.count for ps in wl.pod_sets) == 2
