@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import time
 import uuid
@@ -105,6 +106,15 @@ def build_parser() -> argparse.ArgumentParser:
                         "(overrides tpuSolver.pipelineDepth; default 1)")
     parser.add_argument("--leader-elect", action="store_true",
                         help="join lease-based leader election")
+    parser.add_argument("--lease-file", default=None,
+                        help="shared lease file for cross-process leader "
+                        "election (defaults to <state-dir>/leases.json; "
+                        "put it on the mount all replicas share)")
+    parser.add_argument("--state-dir", default=None,
+                        help="directory for the durable state journal; the "
+                        "process recovers admitted/pending workloads from "
+                        "it on restart (the apiserver-externalization "
+                        "analog)")
     parser.add_argument("--dump-state", action="store_true",
                         help="print the debugger state dump on exit")
     parser.add_argument("--metrics", action="store_true",
@@ -126,7 +136,20 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     fw = Framework(batch_solver=batch_solver, config=cfg,
                    pipeline_depth=args.pipeline_depth)
     store = Store()
+    restored = 0
+    if args.state_dir:
+        # Durable journal: replay BEFORE the controllers attach so their
+        # initial watch replay rebuilds the runtime (admitted workloads
+        # keep quota, pending ones re-queue).
+        from kueue_tpu.controllers.durable import Journal
+
+        os.makedirs(args.state_dir, exist_ok=True)
+        journal = Journal(os.path.join(args.state_dir, "journal.jsonl"))
+        restored = journal.attach(store)
     adapter = StoreAdapter(store, fw)
+    if restored and args.verbosity >= 0:
+        print(f"restored {restored} objects from the state journal",
+              file=sys.stderr, flush=True)
 
     server = None
     runtime_lock = None
@@ -150,7 +173,17 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
 
     elector = None
     if args.leader_elect or cfg.leader_election.enable:
-        elector = LeaderElector(LeaseStore(), identity=str(uuid.uuid4()),
+        lease_path = args.lease_file or (
+            os.path.join(args.state_dir, "leases.json")
+            if args.state_dir else None)
+        if lease_path:
+            # Cross-process election: the lease lives on a shared mount
+            # (the etcd analog), so a standby replica actually defers.
+            from kueue_tpu.controllers.leaderelection import FileLeaseStore
+            lease_store = FileLeaseStore(lease_path)
+        else:
+            lease_store = LeaseStore()
+        elector = LeaderElector(lease_store, identity=str(uuid.uuid4()),
                                 config=cfg.leader_election)
         elector.step()
 

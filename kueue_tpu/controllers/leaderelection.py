@@ -78,6 +78,86 @@ class LeaseStore:
             return lease.holder if lease is not None else ""
 
 
+class FileLeaseStore:
+    """Cross-process lease records in a shared state directory.
+
+    The reference's lease lives in the apiserver (etcd) — the substrate
+    every HA replica shares. This build's shared substrate is the durable
+    state directory (controllers/durable.py), so the lease is a JSON file
+    there: the compare-and-swap runs under an fcntl lock and lands with an
+    atomic rename, giving the same kube semantics (take when unheld or
+    expired, renew by holder, abdicate by zeroing) across processes on the
+    shared mount. Same interface as LeaseStore."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._lockpath = path + ".lock"
+
+    def _rmw(self, fn):
+        """Read-modify-write the lease file under an exclusive flock;
+        `fn(leases: dict) -> (result, dirty)` may mutate the dict in
+        place — the file is rewritten only when dirty (a standby's failed
+        acquire and pure reads must not generate write traffic on the
+        shared mount)."""
+        import fcntl
+        import json
+        import os
+
+        os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+        with open(self._lockpath, "a+") as lockf:
+            fcntl.flock(lockf.fileno(), fcntl.LOCK_EX)
+            leases: Dict[str, dict] = {}
+            try:
+                with open(self.path, "r", encoding="utf-8") as f:
+                    leases = json.load(f)
+            except (OSError, ValueError):
+                pass
+            result, dirty = fn(leases)
+            if dirty:
+                tmp = f"{self.path}.{os.getpid()}.tmp"
+                with open(tmp, "w", encoding="utf-8") as f:
+                    json.dump(leases, f)
+                    f.flush()
+                    os.fsync(f.fileno())
+                os.replace(tmp, self.path)
+            return result
+
+    def try_acquire_or_renew(self, name: str, identity: str,
+                             lease_duration: float, now: float) -> bool:
+        def cas(leases):
+            lease = leases.setdefault(name, {
+                "holder": "", "acquire_time": 0.0, "renew_time": 0.0,
+                "lease_duration_seconds": lease_duration, "transitions": 0})
+            if lease["holder"] == identity:
+                lease["renew_time"] = now
+                lease["lease_duration_seconds"] = lease_duration
+                return True, True
+            expired = (not lease["holder"] or now >= lease["renew_time"]
+                       + lease["lease_duration_seconds"])
+            if not expired:
+                return False, False
+            lease.update(holder=identity, acquire_time=now, renew_time=now,
+                         lease_duration_seconds=lease_duration,
+                         transitions=lease["transitions"] + 1)
+            return True, True
+        return self._rmw(cas)
+
+    def release(self, name: str, identity: str) -> None:
+        def rel(leases):
+            lease = leases.get(name)
+            if lease is not None and lease["holder"] == identity:
+                lease["holder"] = ""
+                return None, True
+            return None, False
+        self._rmw(rel)
+
+    def holder(self, name: str) -> str:
+        def read(leases):
+            lease = leases.get(name)
+            return (lease["holder"] if lease is not None else ""), False
+        return self._rmw(read)
+
+
 class LeaderElector:
     """One replica's view of the election.
 

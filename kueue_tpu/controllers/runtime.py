@@ -331,6 +331,24 @@ class Framework:
         self.workloads[wl.key] = wl
         self.queues.add_or_update_workload(wl)
 
+    def restore_workload(self, wl: Workload) -> None:
+        """Rebuild runtime state for a workload recovered from durable
+        storage: admitted/reserved workloads re-account their quota into
+        the cache (the reference's cache rebuild from the apiserver List,
+        cache.go:295-328); pending ones go back through submit
+        (queue/manager.go:121-134 re-adoption); finished ones are only
+        recorded."""
+        if wl.is_finished:
+            self.workloads[wl.key] = wl
+            return
+        if wl.has_quota_reservation and wl.admission is not None:
+            self.workloads[wl.key] = wl
+            self.cache.add_or_update_workload(wl)
+            # Two-phase admission state machines resume where they were.
+            self._check_sync_pending[wl.key] = wl
+            return
+        self.submit(wl)
+
     def submit_job(self, job) -> Optional[Workload]:
         """Run a GenericJob through the queueing system (jobframework).
 

@@ -174,13 +174,11 @@ class _Handler(BaseHTTPRequestHandler):
                 if name is None:
                     self._list(kind, ns, params)
                 else:
-                    # Encode under the runtime lock: the store hands out
-                    # live objects the scheduler tick mutates in place.
-                    with self.api.runtime_lock:
-                        obj = self.api.store.get(
-                            kind, self._key(kind, ns, name))
-                        doc = (None if obj is None
-                               else serialization.encode(kind, obj))
+                    # Copy-on-write read view: the store publishes an
+                    # encoded doc at write time, so reads never wait on
+                    # the runtime lock (or see a mid-tick mutation).
+                    doc = self.api.store.encoded_get(
+                        kind, self._key(kind, ns, name))
                     if doc is None:
                         self._error(404, f"{kind} {name} not found")
                     else:
@@ -192,13 +190,13 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _list(self, kind: str, ns: Optional[str], params) -> None:
         selector = (params.get("labelSelector") or [None])[0]
-        with self.api.runtime_lock:  # live objects; see do_GET
-            objs = self.api.store.list(kind, namespace=ns)
-            if selector:
-                objs = [o for o in objs
-                        if _match_label_selector(selector,
-                                                 getattr(o, "labels", {}))]
-            items = [serialization.encode(kind, o) for o in objs]
+        # Copy-on-write read view; see do_GET.
+        items = self.api.store.encoded_list(kind, namespace=ns)
+        if selector:
+            items = [d for d in items
+                     if _match_label_selector(
+                         selector, (d.get("metadata") or {}).get("labels")
+                         or {})]
         self._send_json({"kind": f"{kind}List", "items": items})
 
     def _get_visibility(self, path: str, params) -> None:

@@ -1,0 +1,233 @@
+"""Durable state + restart recovery (controllers/durable.py).
+
+The reference externalizes every decision to etcd and rebuilds caches on
+startup (cache.go:295-328, queue/manager.go:121-134). These tests cover
+the journal analog: an in-process rebuild, journal compaction, and the
+VERDICT-mandated process-kill scenario — a `--serve` process is killed
+mid-load (SIGKILL, no shutdown path), restarted on the same state dir,
+and admitted workloads keep their quota while pending ones re-queue.
+"""
+
+import json
+import os
+import re
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.request
+
+import pytest
+
+from kueue_tpu.api.types import PodSet, Workload
+from kueue_tpu.controllers.durable import Journal
+from kueue_tpu.controllers.runtime import Framework
+from kueue_tpu.controllers.store import (
+    KIND_CLUSTER_QUEUE,
+    KIND_LOCAL_QUEUE,
+    KIND_RESOURCE_FLAVOR,
+    KIND_WORKLOAD,
+    Store,
+    StoreAdapter,
+)
+
+from tests.util import fq, make_cq, make_flavor, make_lq, rg
+
+
+def build_world(state_path):
+    """A store+framework with a journal attached, 4-cpu single queue."""
+    store = Store()
+    journal = Journal(state_path)
+    restored = journal.attach(store)
+    fw = Framework()
+    adapter = StoreAdapter(store, fw)
+    return store, journal, fw, adapter, restored
+
+
+def test_in_process_restart_recovers_admissions(tmp_path):
+    path = str(tmp_path / "journal.jsonl")
+    store, journal, fw, adapter, restored = build_world(path)
+    assert restored == 0
+    store.create(KIND_RESOURCE_FLAVOR, make_flavor("default"))
+    store.create(KIND_CLUSTER_QUEUE,
+                 make_cq("cq", rg("cpu", fq("default", cpu=4))))
+    store.create(KIND_LOCAL_QUEUE, make_lq("main", cq="cq"))
+    store.create(KIND_WORKLOAD, Workload(
+        name="fits", queue_name="main",
+        pod_sets=[PodSet.make("m", 1, cpu=3)]))
+    store.create(KIND_WORKLOAD, Workload(
+        name="waits", queue_name="main",
+        pod_sets=[PodSet.make("m", 1, cpu=3)]))
+    for _ in range(4):
+        adapter.tick()
+    assert fw.workloads["default/fits"].is_admitted
+    assert not fw.workloads["default/waits"].has_quota_reservation
+    journal.close()
+
+    # "Restart": a brand-new store/framework on the same journal.
+    store2, journal2, fw2, adapter2, restored2 = build_world(path)
+    assert restored2 == 5
+    wl = fw2.workloads["default/fits"]
+    assert wl.is_admitted
+    # The quota is re-accounted, NOT re-admitted through the scheduler.
+    assert fw2.cache.usage("cq")["default"]["cpu"] == 3000
+    assert fw2.pending_workloads("cq") == 1
+    # The pending one stays pending (no quota) across further ticks...
+    adapter2.tick()
+    assert not fw2.workloads["default/waits"].has_quota_reservation
+    # ...until the recovered admission releases its quota.
+    fw2.finish(fw2.workloads["default/fits"])
+    for _ in range(4):
+        adapter2.tick()
+    assert fw2.workloads["default/waits"].is_admitted
+
+
+def test_journal_compacts_dead_events(tmp_path):
+    path = str(tmp_path / "journal.jsonl")
+    store, journal, fw, adapter, _ = build_world(path)
+    store.create(KIND_RESOURCE_FLAVOR, make_flavor("default"))
+    store.create(KIND_CLUSTER_QUEUE,
+                 make_cq("cq", rg("cpu", fq("default", cpu=4))))
+    store.create(KIND_LOCAL_QUEUE, make_lq("main", cq="cq"))
+    for i in range(20):
+        store.create(KIND_WORKLOAD, Workload(
+            name=f"w{i}", queue_name="main",
+            pod_sets=[PodSet.make("m", 1, cpu=1)]))
+        adapter.tick()
+        wl = fw.workloads[f"default/w{i}"]
+        if wl.is_admitted:
+            fw.finish(wl)
+            fw.delete_workload(wl)
+            store.delete(KIND_WORKLOAD, f"default/w{i}")
+    journal.close()
+    lines_before = sum(1 for _ in open(path))
+    # Re-attach: replay + compaction rewrites to live state only.
+    store2, journal2, fw2, _, restored = build_world(path)
+    journal2.close()
+    lines_after = sum(1 for _ in open(path))
+    assert lines_after == restored <= 4 + 20
+    assert lines_after < lines_before
+
+
+SETUP_YAML = """\
+apiVersion: kueue.x-k8s.io/v1beta1
+kind: ResourceFlavor
+metadata:
+  name: default
+---
+apiVersion: kueue.x-k8s.io/v1beta1
+kind: ClusterQueue
+metadata:
+  name: cq
+spec:
+  namespaceSelector: {}
+  resourceGroups:
+  - coveredResources: ["cpu"]
+    flavors:
+    - name: default
+      resources:
+      - name: cpu
+        nominalQuota: 4
+---
+apiVersion: kueue.x-k8s.io/v1beta1
+kind: LocalQueue
+metadata:
+  name: main
+  namespace: default
+spec:
+  clusterQueue: cq
+"""
+
+WL_FITS = {
+    "apiVersion": "kueue.x-k8s.io/v1beta1", "kind": "Workload",
+    "metadata": {"name": "fits", "namespace": "default"},
+    "spec": {"queueName": "main", "podSets": [{
+        "name": "m", "count": 1, "template": {"spec": {"containers": [
+            {"name": "c", "resources": {"requests": {"cpu": "3"}}}]}}}]},
+}
+WL_WAITS = {
+    "apiVersion": "kueue.x-k8s.io/v1beta1", "kind": "Workload",
+    "metadata": {"name": "waits", "namespace": "default"},
+    "spec": {"queueName": "main", "podSets": [{
+        "name": "m", "count": 1, "template": {"spec": {"containers": [
+            {"name": "c", "resources": {"requests": {"cpu": "3"}}}]}}}]},
+}
+
+
+def _spawn(state_dir, setup_path):
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "kueue_tpu", "--serve", "--port", "0",
+         "--tick-interval", "0.05", "--state-dir", state_dir,
+         "--objects", setup_path],
+        stderr=subprocess.PIPE, stdout=subprocess.DEVNULL, text=True)
+    url = None
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        line = proc.stderr.readline()
+        m = re.search(r"serving HTTP API on (http://\S+)", line or "")
+        if m:
+            url = m.group(1)
+            break
+        if proc.poll() is not None:
+            raise RuntimeError("serve subprocess died during startup")
+    assert url, "server never reported its URL"
+    return proc, url
+
+
+def _get_status(url, name):
+    base = f"{url}/apis/kueue.x-k8s.io/v1beta1/namespaces/default/workloads"
+    with urllib.request.urlopen(f"{base}/{name}", timeout=5) as resp:
+        doc = json.load(resp)
+    conds = {c["type"]: c.get("status") == "True"
+             for c in (doc.get("status") or {}).get("conditions") or ()}
+    return conds
+
+
+def _post(url, doc):
+    req = urllib.request.Request(
+        url, data=json.dumps(doc).encode(), method="POST",
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=5):
+        pass
+
+
+def test_serve_process_kill_and_recover(tmp_path):
+    """Kill -9 a --serve process mid-load; the restarted process keeps
+    admitted quota and re-queues pending workloads."""
+    state_dir = str(tmp_path / "state")
+    setup = tmp_path / "setup.yaml"
+    setup.write_text(SETUP_YAML)
+
+    proc, url = _spawn(state_dir, str(setup))
+    try:
+        wl_base = (f"{url}/apis/kueue.x-k8s.io/v1beta1/"
+                   "namespaces/default/workloads")
+        _post(wl_base, WL_FITS)
+        _post(wl_base, WL_WAITS)
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            if _get_status(url, "fits").get("Admitted"):
+                break
+            time.sleep(0.1)
+        assert _get_status(url, "fits").get("Admitted")
+        assert not _get_status(url, "waits").get("QuotaReserved")
+    finally:
+        # Hard kill: no graceful shutdown path runs.
+        proc.send_signal(signal.SIGKILL)
+        proc.wait(timeout=10)
+
+    # Restart on the same state dir; the setup manifests re-apply
+    # idempotently (create errors are surfaced, not fatal).
+    proc2, url2 = _spawn(state_dir, str(setup))
+    try:
+        status = _get_status(url2, "fits")
+        assert status.get("Admitted"), status
+        # The pending workload survived as pending and must NOT have been
+        # admitted (quota is still held by the recovered admission).
+        for _ in range(10):
+            time.sleep(0.05)
+            assert not _get_status(url2, "waits").get("QuotaReserved")
+    finally:
+        proc2.send_signal(signal.SIGKILL)
+        proc2.wait(timeout=10)
