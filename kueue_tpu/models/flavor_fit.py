@@ -461,7 +461,9 @@ def solve_flavor_fit(enc: sch.CQEncoding, usage: sch.UsageTensors,
 
 def decode_assignments(workloads: Sequence[WorkloadInfo], snapshot: Snapshot,
                        enc: sch.CQEncoding,
-                       out: Dict[str, np.ndarray]) -> List[Assignment]:
+                       out: Dict[str, np.ndarray],
+                       counts: Optional[Sequence[Sequence[int]]] = None,
+                       ) -> List[Assignment]:
     """Materialize referee-compatible Assignment objects from the kernel
     outputs (truncating at the first failed podset, like
     flavorassigner.go:323-327).
@@ -470,7 +472,12 @@ def decode_assignments(workloads: Sequence[WorkloadInfo], snapshot: Snapshot,
     toolchain built it -- the decode sits on the critical path between two
     device dispatches and is interpreter-bound otherwise -- with the
     vectorized Python loop below as the always-available fallback.
+    `counts` (partial-admission probes) scales the decoded totals and
+    always takes the Python path.
     """
+    if counts is not None:
+        return _decode_assignments_py(workloads, snapshot, enc, out,
+                                      counts=counts)
     if not os.environ.get("KUEUE_NO_NATIVE_DECODE"):
         mod = native_decode.load()
         if mod is not None:
@@ -494,7 +501,9 @@ def decode_assignments(workloads: Sequence[WorkloadInfo], snapshot: Snapshot,
 
 def _decode_assignments_py(workloads: Sequence[WorkloadInfo],
                            snapshot: Snapshot, enc: sch.CQEncoding,
-                           out: Dict[str, np.ndarray]) -> List[Assignment]:
+                           out: Dict[str, np.ndarray],
+                           counts: Optional[Sequence[Sequence[int]]] = None,
+                           ) -> List[Assignment]:
     """Vectorized-coordinate Python decode (fallback + referee for the
     native decoder's equivalence tests)."""
     n = len(workloads)
@@ -551,7 +560,10 @@ def _decode_assignments_py(workloads: Sequence[WorkloadInfo],
         ok_row = ps_ok_l[w]
         pm_row = ps_mode_l[w]
         lti = a.last_state.last_tried_flavor_idx
-        for p, ps in enumerate(wi.total_requests):
+        totals = wi.total_requests
+        if counts is not None and counts[w] is not None:
+            totals = [t.scaled_to(c) for t, c in zip(totals, counts[w])]
+        for p, ps in enumerate(totals):
             if p > cut:
                 break
             requests = dict(ps.requests)
@@ -732,6 +744,22 @@ class BatchSolver:
     def solve(self, workloads: Sequence[WorkloadInfo],
               snapshot: Snapshot) -> List[Assignment]:
         return self.collect(self.solve_async(workloads, snapshot))
+
+    def solve_with_counts(self, workloads: Sequence[WorkloadInfo],
+                          snapshot: Snapshot,
+                          counts: Sequence[Sequence[int]],
+                          ) -> List[Assignment]:
+        """Synchronous batched solve with per-workload podset-count
+        overrides — one device dispatch per partial-admission search ROUND
+        for every searching workload at once, instead of one referee run
+        per probe per workload (podset_reducer.go:86; scheduler
+        _batch_partial_admission)."""
+        enc = self._encoding_for(snapshot)
+        usage = self._usage_enc.refresh(snapshot)
+        wt = sch.encode_workloads(workloads, snapshot, enc, counts=counts)
+        out = solve_flavor_fit(enc, usage, wt, static=self._static)
+        return decode_assignments(workloads, snapshot, enc, out,
+                                  counts=counts)
 
     # Scheduler admit/forget fast path (see UsageEncoder.apply_delta): keeps
     # the persistent usage tensor in lockstep with cache.assume/forget so the

@@ -246,30 +246,33 @@ class Scheduler:
         # (preemption.go runs these sequentially per head; the searches
         # are independent against the frozen snapshot, so batching is
         # decision-preserving).
-        batch_targets: Dict[int, List[WorkloadInfo]] = {}
-        if (assignments is not None
-                and self.preemption_engine in ("native", "jax", "pallas")):
-            ctx_fn = getattr(self.batch_solver, "preemption_context", None)
-            ctx_usage = ctx_fn() if ctx_fn is not None else None
-            if ctx_usage is not None:
-                pre_idx = [i for i, a in enumerate(assignments)
-                           if a.representative_mode == PREEMPT]
-                if pre_idx:
-                    targets_list = preemption_mod.get_targets_batch(
-                        [(entries[i].info, assignments[i]) for i in pre_idx],
-                        snapshot, self.ordering, self.clock(),
-                        self.fair_strategies, *ctx_usage,
-                        backend=self.preemption_engine)
-                    batch_targets = dict(zip(pre_idx, targets_list))
+        pre_pairs = [] if assignments is None else [
+            (i, entries[i].info, a) for i, a in enumerate(assignments)
+            if a.representative_mode == PREEMPT]
+        batch_targets = self._batched_targets(pre_pairs, snapshot)
         shares: Dict[str, float] = {}
+        partial_pending: List[Entry] = []
         for i, e in enumerate(entries):
             full = assignments[i] if assignments is not None else None
             assignment, targets = self._get_assignment(
-                e.info, snapshot, full, precomputed_targets=batch_targets.get(i))
+                e.info, snapshot, full,
+                precomputed_targets=batch_targets.get(i),
+                allow_partial=assignments is None)
             e.assignment = assignment
             e.preemption_targets = targets
+            needs_partial = (assignments is not None and not targets
+                             and assignment.representative_mode != FIT
+                             and features.enabled(features.PARTIAL_ADMISSION)
+                             and e.info.obj.can_be_partially_admitted())
             e.inadmissible_msg = assignment.message()
-            e.info.last_assignment = assignment.last_state
+            if needs_partial:
+                # Defer the resume-state update: the reducer's probes must
+                # resume from the PREVIOUS attempt's flavor state, exactly
+                # like the sequential path whose probes run before the
+                # caller overwrites last_assignment.
+                partial_pending.append(e)
+            else:
+                e.info.last_assignment = assignment.last_state
             if fair:
                 cq_name = e.info.cluster_queue
                 if cq_name not in shares:
@@ -278,11 +281,16 @@ class Scheduler:
                         fair_share.dominant_resource_share(cq)[0]
                         if cq is not None else 0.0)
                 e.share = shares[cq_name]
+        if partial_pending:
+            self._batch_partial_admission(partial_pending, snapshot)
 
     def _get_assignment(self, wi: WorkloadInfo, snap: Snapshot,
                         precomputed: Optional[Assignment],
-                        precomputed_targets: Optional[List[WorkloadInfo]] = None):
-        """scheduler.go getAssignments (:390-429)."""
+                        precomputed_targets: Optional[List[WorkloadInfo]] = None,
+                        allow_partial: bool = True):
+        """scheduler.go getAssignments (:390-429). With `allow_partial`
+        False the caller runs partial admission itself (the batched
+        device rounds of _batch_partial_admission)."""
         cq = snap.cluster_queues[wi.cluster_queue]
         full = precomputed if precomputed is not None else \
             assign_flavors(wi, cq, snap.resource_flavors)
@@ -296,7 +304,8 @@ class Scheduler:
                     wi, full, snap, self.ordering, self.clock(),
                     fair_strategies=self.fair_strategies,
                     engine=self.preemption_engine)
-        if not features.enabled(features.PARTIAL_ADMISSION) or targets:
+        if not allow_partial \
+                or not features.enabled(features.PARTIAL_ADMISSION) or targets:
             return full, targets
         if wi.obj.can_be_partially_admitted():
             def fits(counts):
@@ -315,6 +324,75 @@ class Scheduler:
             if found:
                 return result
         return full, []
+
+    def _batched_targets(self, pairs, snapshot: Snapshot,
+                         ) -> Dict[int, List[WorkloadInfo]]:
+        """Victim search for PREEMPT-mode (key, info, assignment) pairs in
+        one batched engine call when the configured engine supports it,
+        else one per-entry host/engine search each. Returns {key: targets}
+        for every pair."""
+        if not pairs:
+            return {}
+        ctx_fn = getattr(self.batch_solver, "preemption_context", None)
+        ctx_usage = ctx_fn() if ctx_fn is not None else None
+        if ctx_usage is not None and self.preemption_engine in (
+                "native", "jax", "pallas"):
+            targets_list = preemption_mod.get_targets_batch(
+                [(wi, a) for _, wi, a in pairs],
+                snapshot, self.ordering, self.clock(),
+                self.fair_strategies, *ctx_usage,
+                backend=self.preemption_engine)
+            return {key: t for (key, _, _), t in zip(pairs, targets_list)}
+        return {key: preemption_mod.get_targets(
+                    wi, a, snapshot, self.ordering, self.clock(),
+                    fair_strategies=self.fair_strategies,
+                    engine=self.preemption_engine)
+                for key, wi, a in pairs}
+
+    def _batch_partial_admission(self, entries: List[Entry],
+                                 snapshot: Snapshot) -> None:
+        """Partial admission in batch mode: every searching workload's
+        binary search (podset_reducer.SearchState — the same stepper the
+        sequential reducer runs) advances in LOCKSTEP rounds, each round
+        solving all active probes as ONE batched device dispatch instead
+        of one referee run per probe per workload (podset_reducer.go:86
+        via scheduler.go:410-427). Preemption probes batch through the
+        same victim-search engine as the main path."""
+        searches: List[tuple] = []
+        for e in entries:
+            state = podset_reducer.SearchState(e.info.obj.pod_sets)
+            if state.searchable():
+                searches.append((e, state))
+
+        while True:
+            active = [(e, s) for e, s in searches if s.active()]
+            if not active:
+                break
+            probes = [s.probe() for _, s in active]
+            assignments = self.batch_solver.solve_with_counts(
+                [e.info for e, _ in active], snapshot, probes)
+            # Preempt-mode probes need victim sets to count as fitting
+            # (the reducer's fits() tries preemption too).
+            targets_by_idx = self._batched_targets(
+                [(i, active[i][0].info, a) for i, a in enumerate(assignments)
+                 if a.representative_mode == PREEMPT], snapshot)
+            for i, (e, s) in enumerate(active):
+                a = assignments[i]
+                targets = targets_by_idx.get(i, [])
+                ok = a.representative_mode == FIT or bool(targets)
+                s.advance((a, targets) if ok else None, ok)
+
+        for e, s in searches:
+            result, found = s.result()
+            if found and result is not None:
+                assignment, targets = result
+                e.assignment = assignment
+                e.preemption_targets = targets
+                e.inadmissible_msg = assignment.message()
+        # The deferred resume-state update (the sequential path applies it
+        # after the reducer returns, whether or not a reduction was found).
+        for e in entries:
+            e.info.last_assignment = e.assignment.last_state
 
     # -- ordering (scheduler.go:564-588) ------------------------------------
 
@@ -489,7 +567,13 @@ class Scheduler:
             # A readmitted workload is no longer evicted.
             wl.set_condition("Evicted", False, reason="QuotaReserved",
                              now=self.clock())
-        if not cq.admission_checks:
+        # Admitted syncs at admit time when the workload carries every
+        # check the CQ requires AND all of its recorded check states are
+        # Ready (scheduler.go:502-505 HasAllChecks + SyncAdmittedCondition
+        # — a Pending state blocks Admitted even on a checkless CQ).
+        if cq.admission_checks <= set(wl.admission_check_states) and all(
+                s.state == "Ready"
+                for s in wl.admission_check_states.values()):
             wl.set_condition("Admitted", True, reason="Admitted", now=self.clock())
         note_admit = getattr(self.batch_solver, "note_admission", None)
         note_forget = getattr(self.batch_solver, "note_removal", None)
@@ -497,7 +581,11 @@ class Scheduler:
             assumed = self.cache.assume_workload(wl)
             self._mirror.note_admission(wl, assumed)
             if note_admit is not None:
-                note_admit(e.info.cluster_queue, e.assignment.usage)
+                # Mirror EXACTLY what the cache accounted: for partial
+                # admission that is the spec-count totals (scaled back up,
+                # workload.go:230-234 — the job integration later reclaims
+                # the difference), not the reduced assignment usage.
+                note_admit(e.info.cluster_queue, assumed.usage())
         except ValueError as err:
             wl.admission = None
             wl.set_condition("QuotaReserved", False, reason="Pending",
@@ -510,7 +598,7 @@ class Scheduler:
             self.cache.forget_workload(wl)
             self._mirror.note_removal(wl)
             if note_forget is not None:
-                note_forget(e.info.cluster_queue, e.assignment.usage)
+                note_forget(e.info.cluster_queue, assumed.usage())
             # Roll the reservation back off the object so it can requeue
             # (the reference applies admission to a deep copy instead).
             wl.admission = None

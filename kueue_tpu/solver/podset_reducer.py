@@ -14,33 +14,63 @@ from kueue_tpu.api.types import PodSet
 R = TypeVar("R")
 
 
+class SearchState:
+    """One binary search over proportional count reductions, steppable
+    from outside (Go sort.Search over [0, total_delta]; i==0 is the full
+    count). The sequential `search` below and the scheduler's batched
+    lockstep rounds (scheduler._batch_partial_admission) drive the SAME
+    probe sequence and found-semantics through this object, so the two
+    paths cannot drift."""
+
+    __slots__ = ("full_counts", "deltas", "total_delta", "lo", "hi",
+                 "last_good_idx", "last_r", "mid")
+
+    def __init__(self, pod_sets: Sequence[PodSet]):
+        self.full_counts = [ps.count for ps in pod_sets]
+        self.deltas = [
+            ps.count - (ps.min_count if ps.min_count is not None else ps.count)
+            for ps in pod_sets]
+        self.total_delta = sum(self.deltas)
+        self.lo = 0
+        self.hi = self.total_delta + 1
+        self.last_good_idx = 0
+        self.last_r: Optional[R] = None
+        self.mid = 0
+
+    def searchable(self) -> bool:
+        return self.total_delta > 0
+
+    def counts_for(self, i: int) -> List[int]:
+        return [self.full_counts[k] - (self.deltas[k] * i) // self.total_delta
+                for k in range(len(self.deltas))]
+
+    def active(self) -> bool:
+        return self.lo < self.hi
+
+    def probe(self) -> List[int]:
+        """The next probe's counts; call exactly once per advance."""
+        self.mid = (self.lo + self.hi) // 2
+        return self.counts_for(self.mid)
+
+    def advance(self, r: Optional[R], ok: bool) -> None:
+        if ok:
+            self.last_good_idx = self.mid
+            self.last_r = r
+            self.hi = self.mid
+        else:
+            self.lo = self.mid + 1
+
+    def result(self) -> Tuple[Optional[R], bool]:
+        return self.last_r, self.lo == self.last_good_idx
+
+
 def search(pod_sets: Sequence[PodSet],
            fits: Callable[[List[int]], Tuple[Optional[R], bool]],
            ) -> Tuple[Optional[R], bool]:
-    full_counts = [ps.count for ps in pod_sets]
-    deltas = [ps.count - (ps.min_count if ps.min_count is not None else ps.count)
-              for ps in pod_sets]
-    total_delta = sum(deltas)
-    if total_delta == 0:
+    state = SearchState(pod_sets)
+    if not state.searchable():
         return None, False
-
-    def counts_for(i: int) -> List[int]:
-        return [full_counts[k] - (deltas[k] * i) // total_delta
-                for k in range(len(deltas))]
-
-    last_good_idx = 0
-    last_r: Optional[R] = None
-
-    # Smallest i in [0, total_delta] with fits(counts_for(i)) true
-    # (Go sort.Search semantics; i==0 is the full count).
-    lo, hi = 0, total_delta + 1
-    while lo < hi:
-        mid = (lo + hi) // 2
-        r, ok = fits(counts_for(mid))
-        if ok:
-            last_good_idx = mid
-            last_r = r
-            hi = mid
-        else:
-            lo = mid + 1
-    return last_r, lo == last_good_idx
+    while state.active():
+        r, ok = fits(state.probe())
+        state.advance(r, ok)
+    return state.result()

@@ -438,3 +438,281 @@ def test_with_reclaimable_pods(solve):
     assert got_flavors(a) == [
         {"cpu": ("default", FIT), "pods": ("default", FIT)}]
     assert a.usage == {"default": {"cpu": 3000, "pods": 3}}
+
+
+# -- round-4 expansion: the remaining TestAssignFlavors cases ----------------
+
+from kueue_tpu import features
+from kueue_tpu.api.types import (
+    BorrowWithinCohort,
+    ClusterQueuePreemption,
+    FlavorFungibility,
+)
+
+
+def mk_wl_tolerating(count, cpu_v):
+    return mk_wl([PodSet.make(
+        "main", count, cpu=cpu_v,
+        tolerations=[Toleration(key="instance", operator="Equal",
+                                value="spot", effect="NoSchedule")])])
+
+
+# "single flavor, fits tainted flavor"
+def test_single_flavor_fits_tainted_flavor(solve):
+    snap, cq = build(make_cq("cq", rg("cpu", fq("tainted", cpu=4))))
+    a = solve(snap, cq, mk_wl_tolerating(1, 1))
+    assert a.representative_mode == FIT
+    assert got_flavors(a) == [{"cpu": ("tainted", FIT)}]
+    assert a.usage == {"tainted": {"cpu": 1000}}
+
+
+# "multiple resources in a group, doesn't fit"
+def test_multiple_resources_in_group_dont_fit(solve):
+    snap, cq = build(make_cq(
+        "cq", rg(("cpu", "memory"),
+                 fq("one", cpu=2, memory="1Gi"),
+                 fq("two", cpu=4, memory="5Mi"))))
+    a = solve(snap, cq, mk_wl([PodSet.make("main", 1, cpu=3, memory="10Mi")]))
+    assert a.representative_mode == NO_FIT
+    assert a.usage == {}
+
+
+def _two_flavor_pods_cq(fungibility, one_quota=None, two_quota=None):
+    return make_cq(
+        "cq",
+        rg(("cpu", "pods"),
+           fq("one", cpu=one_quota if one_quota is not None else 10, pods=10),
+           fq("two", cpu=two_quota if two_quota is not None else 10, pods=10)),
+        fungibility=fungibility)
+
+
+# "preempt before try next flavor": WhenCanPreempt=Preempt stops at the
+# first flavor's Preempt instead of scanning to a Fit on flavor two.
+def test_preempt_before_try_next_flavor(solve):
+    snap, cq = build(
+        _two_flavor_pods_cq(FlavorFungibility(
+            when_can_borrow="Borrow", when_can_preempt="Preempt")),
+        usage={"one": {"cpu": 2000}})
+    a = solve(snap, cq, mk_wl([PodSet.make("main", 1, cpu=9)]))
+    assert a.representative_mode == PREEMPT
+    assert got_flavors(a) == [
+        {"cpu": ("one", PREEMPT), "pods": ("one", FIT)}]
+    assert a.usage == {"one": {"cpu": 9000, "pods": 1}}
+
+
+# "preempt try next flavor": the default rule scans to flavor two's Fit.
+def test_preempt_try_next_flavor(solve):
+    snap, cq = build(_two_flavor_pods_cq(None),
+                     usage={"one": {"cpu": 2000}})
+    a = solve(snap, cq, mk_wl([PodSet.make("main", 1, cpu=9)]))
+    assert a.representative_mode == FIT
+    assert got_flavors(a) == [{"cpu": ("two", FIT), "pods": ("two", FIT)}]
+    assert a.usage == {"two": {"cpu": 9000, "pods": 1}}
+
+
+# "borrow try next flavor, found the first flavor": trying past the
+# borrowing Fit on flavor one finds nothing better (flavor two can never
+# hold the request), so flavor one's borrowing Fit is chosen.
+def test_borrow_try_next_flavor_found_first(solve):
+    snap, cq = build(
+        make_cq("cq",
+                rg(("cpu", "pods"),
+                   fq("one", cpu=(10, 1), pods=10),
+                   fq("two", cpu=1, pods=10)),
+                cohort="co",
+                fungibility=FlavorFungibility(
+                    when_can_borrow="TryNextFlavor",
+                    when_can_preempt="TryNextFlavor")),
+        usage={"one": {"cpu": 2000}},
+        extra=[(make_cq("cq-other", rg("cpu", fq("one", cpu=1)),
+                        cohort="co"), None)])
+    a = solve(snap, cq, mk_wl([PodSet.make("main", 1, cpu=9)]))
+    assert a.representative_mode == FIT
+    assert a.borrowing
+    assert got_flavors(a) == [{"cpu": ("one", FIT), "pods": ("one", FIT)}]
+    assert a.usage == {"one": {"cpu": 9000, "pods": 1}}
+
+
+# "borrow try next flavor, found the second flavor": flavor two fits
+# without borrowing, so trying past flavor one's borrowing Fit wins.
+def test_borrow_try_next_flavor_found_second(solve):
+    snap, cq = build(
+        make_cq("cq",
+                rg(("cpu", "pods"),
+                   fq("one", cpu=(10, 1), pods=10),
+                   fq("two", cpu=10, pods=10)),
+                cohort="co",
+                fungibility=FlavorFungibility(
+                    when_can_borrow="TryNextFlavor",
+                    when_can_preempt="TryNextFlavor")),
+        usage={"one": {"cpu": 2000}},
+        extra=[(make_cq("cq-other", rg("cpu", fq("one", cpu=1)),
+                        cohort="co"), None)])
+    a = solve(snap, cq, mk_wl([PodSet.make("main", 1, cpu=9)]))
+    assert a.representative_mode == FIT
+    assert not a.borrowing
+    assert got_flavors(a) == [{"cpu": ("two", FIT), "pods": ("two", FIT)}]
+    assert a.usage == {"two": {"cpu": 9000, "pods": 1}}
+
+
+# "borrow before try next flavor": the default WhenCanBorrow=Borrow stops
+# at flavor one's borrowing Fit.
+def test_borrow_before_try_next_flavor(solve):
+    snap, cq = build(
+        make_cq("cq",
+                rg(("cpu", "pods"),
+                   fq("one", cpu=(10, 1), pods=10),
+                   fq("two", cpu=10, pods=10)),
+                cohort="co"),
+        usage={"one": {"cpu": 2000}},
+        extra=[(make_cq("cq-other", rg("cpu", fq("one", cpu=1)),
+                        cohort="co"), None)])
+    a = solve(snap, cq, mk_wl([PodSet.make("main", 1, cpu=9)]))
+    assert a.representative_mode == FIT
+    assert a.borrowing
+    assert got_flavors(a) == [{"cpu": ("one", FIT), "pods": ("one", FIT)}]
+    assert a.usage == {"one": {"cpu": 9000, "pods": 1}}
+
+
+def _bwc_cq(fungibility, one_cpu, cohort="co"):
+    return make_cq(
+        "cq", rg("cpu", fq("one", cpu=one_cpu), fq("two", cpu=12)),
+        cohort=cohort,
+        preemption=ClusterQueuePreemption(
+            reclaim_within_cohort="LowerPriority",
+            borrow_within_cohort=BorrowWithinCohort(policy="LowerPriority")),
+        fungibility=fungibility)
+
+
+# "when borrowing while preemption is needed for flavor one;
+# WhenCanBorrow=Borrow": borrowWithinCohort turns the over-cohort-usage
+# case into Preempt-with-borrowing, and WhenCanPreempt=Preempt stops there.
+def test_borrow_with_preemption_needed_borrow(solve):
+    snap, cq = build(
+        _bwc_cq(FlavorFungibility(when_can_borrow="Borrow",
+                                  when_can_preempt="Preempt"),
+                one_cpu=(0, 12)),
+        extra=[(make_cq("cq-other", rg("cpu", fq("one", cpu=12)),
+                        cohort="co"), {"one": {"cpu": 10000}})])
+    a = solve(snap, cq, mk_wl([PodSet.make("main", 1, cpu=12)]))
+    assert a.representative_mode == PREEMPT
+    assert a.borrowing
+    assert got_flavors(a) == [{"cpu": ("one", PREEMPT)}]
+    assert a.usage == {"one": {"cpu": 12000}}
+
+
+# Same without a borrowingLimit on flavor one.
+def test_borrow_with_preemption_needed_no_limit(solve):
+    snap, cq = build(
+        _bwc_cq(FlavorFungibility(when_can_borrow="Borrow",
+                                  when_can_preempt="Preempt"),
+                one_cpu=0),
+        extra=[(make_cq("cq-other", rg("cpu", fq("one", cpu=12)),
+                        cohort="co"), {"one": {"cpu": 10000}})])
+    a = solve(snap, cq, mk_wl([PodSet.make("main", 1, cpu=12)]))
+    assert a.representative_mode == PREEMPT
+    assert a.borrowing
+    assert got_flavors(a) == [{"cpu": ("one", PREEMPT)}]
+    assert a.usage == {"one": {"cpu": 12000}}
+
+
+# Same but WhenCanBorrow=TryNextFlavor: skip to flavor two's clean Fit.
+def test_borrow_with_preemption_needed_try_next(solve):
+    snap, cq = build(
+        _bwc_cq(FlavorFungibility(when_can_borrow="TryNextFlavor",
+                                  when_can_preempt="Preempt"),
+                one_cpu=(0, 12)),
+        extra=[(make_cq("cq-other", rg("cpu", fq("one", cpu=12)),
+                        cohort="co"), {"one": {"cpu": 10000}})])
+    a = solve(snap, cq, mk_wl([PodSet.make("main", 1, cpu=12)]))
+    assert a.representative_mode == FIT
+    assert not a.borrowing
+    assert got_flavors(a) == [{"cpu": ("two", FIT)}]
+    assert a.usage == {"two": {"cpu": 12000}}
+
+
+# "when borrowing while preemption is needed, but borrowingLimit exceeds
+# the quota available in the cohort": nothing can make the request fit.
+def test_borrowing_limit_exceeds_cohort_quota(solve):
+    snap, cq = build(
+        make_cq("cq", rg("cpu", fq("one", cpu=(0, 12))), cohort="co",
+                preemption=ClusterQueuePreemption(
+                    reclaim_within_cohort="LowerPriority",
+                    borrow_within_cohort=BorrowWithinCohort(
+                        policy="LowerPriority"))),
+        extra=[(make_cq("cq-other", rg("cpu", fq("one", cpu=11)),
+                        cohort="co"), {"one": {"cpu": 10000}})])
+    a = solve(snap, cq, mk_wl([PodSet.make("main", 1, cpu=12)]))
+    assert a.representative_mode == NO_FIT
+    assert a.usage == {}
+
+
+# "lend try next flavor, found the second flavor"
+def test_lend_try_next_flavor_found_second(solve):
+    features.set_enabled(features.LENDING_LIMIT, True)
+    snap, cq = build(
+        make_cq("cq",
+                rg(("cpu", "pods"),
+                   fq("one", cpu=(10, None, 1), pods=10),
+                   fq("two", cpu=(10, None, 0), pods=10)),
+                cohort="co",
+                fungibility=FlavorFungibility(
+                    when_can_borrow="TryNextFlavor",
+                    when_can_preempt="TryNextFlavor")),
+        usage={"one": {"cpu": 2000}},
+        extra=[(make_cq("cq-other", rg("cpu", fq("one", cpu=10),
+                                       fq("two", cpu=10)),
+                        cohort="co"), {"one": {"cpu": 2000}})])
+    a = solve(snap, cq, mk_wl([PodSet.make("main", 1, cpu=9)]))
+    assert a.representative_mode == FIT
+    assert not a.borrowing
+    assert got_flavors(a) == [{"cpu": ("two", FIT), "pods": ("two", FIT)}]
+    assert a.usage == {"two": {"cpu": 9000, "pods": 1}}
+
+
+# "lend try next flavor, found the first flavor"
+def test_lend_try_next_flavor_found_first(solve):
+    features.set_enabled(features.LENDING_LIMIT, True)
+    snap, cq = build(
+        make_cq("cq",
+                rg(("cpu", "pods"),
+                   fq("one", cpu=(10, None, 1), pods=10),
+                   fq("two", cpu=(1, None, 0), pods=10)),
+                cohort="co",
+                fungibility=FlavorFungibility(
+                    when_can_borrow="TryNextFlavor",
+                    when_can_preempt="TryNextFlavor")),
+        usage={"one": {"cpu": 2000}},
+        extra=[(make_cq("cq-other", rg("cpu", fq("one", cpu=10),
+                                       fq("two", cpu=1)),
+                        cohort="co"), {"one": {"cpu": 2000}})])
+    a = solve(snap, cq, mk_wl([PodSet.make("main", 1, cpu=9)]))
+    assert a.representative_mode == FIT
+    assert a.borrowing
+    assert got_flavors(a) == [{"cpu": ("one", FIT), "pods": ("one", FIT)}]
+    assert a.usage == {"one": {"cpu": 9000, "pods": 1}}
+
+
+# "lendingLimit exceeded, but can preempt in cohort and ClusterQueue".
+# The reference case writes internal cohort fields that its own production
+# accumulation would not produce (GuaranteedQuota omitted while
+# lendingLimit=0); here the same intent — the lendable pool is exhausted
+# by above-guarantee usage, so the request needs cohort preemption — is
+# realized with derived aggregates: the member's above-guarantee usage
+# (10 used vs 9 guaranteed) eats its own 1-cpu lending pool.
+def test_lending_limit_exceeded_can_preempt(solve):
+    features.set_enabled(features.LENDING_LIMIT, True)
+    snap, cq = build(
+        make_cq("cq",
+                rg(("cpu", "pods"),
+                   fq("one", cpu=(10, None, 0), pods=10)),
+                cohort="co"),
+        usage={"one": {"cpu": 2000}},
+        extra=[(make_cq("cq-other",
+                        rg("cpu", fq("one", cpu=(10, None, 1))),
+                        cohort="co"), {"one": {"cpu": 10000}})])
+    a = solve(snap, cq, mk_wl([PodSet.make("main", 1, cpu=9)]))
+    assert a.representative_mode == PREEMPT
+    assert not a.borrowing
+    assert got_flavors(a) == [{"cpu": ("one", PREEMPT), "pods": ("one", FIT)}]
+    assert a.usage == {"one": {"cpu": 9000, "pods": 1}}

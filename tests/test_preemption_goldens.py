@@ -81,6 +81,10 @@ def build_cache():
         "d2", rg(("cpu", "memory"), fq("default", cpu=6, memory="3Gi")),
         cohort="cohort-no-limits", preemption=never_reclaim_any))
     cache.add_cluster_queue(make_cq(
+        "l1", rg(("cpu", "memory"),
+                 fq("default", cpu=(6, 12), memory=("3Gi", "6Gi"))),
+        cohort="legion", preemption=lower_reclaim_lower))
+    cache.add_cluster_queue(make_cq(
         "preventStarvation", rg("cpu", fq("default", cpu=6)),
         preemption=ClusterQueuePreemption(
             within_cluster_queue="LowerOrNewerEqualPriority")))
@@ -376,3 +380,108 @@ def test_cannot_preempt_beyond_lending_limited_requestable_quota(engine):
     got = run_case(cache, wl("in", cpu=9), "lend1",
                    {"cpu": ("default", PREEMPT)}, engine)
     assert got == set()
+
+
+# -- round-4 expansion: the remaining TestPreemption cases -------------------
+
+
+# "preempting locally and borrowing same resource in cohort": when the
+# preemptor borrows the pending resource itself, only same-CQ victims are
+# taken (the borrowing-fallback round).
+def test_preempt_locally_borrowing_same_resource(engine):
+    cache = build_cache()
+    padmit(cache, wl("c1-med", cpu=4), "c1", "default")
+    padmit(cache, wl("c1-low", priority=-1, cpu=4), "c1", "default")
+    padmit(cache, wl("c2-low-1", priority=-1, cpu=4), "c2", "default")
+    got = run_case(cache, wl("in", priority=1, cpu=4), "c1",
+                   {"cpu": ("default", PREEMPT)}, engine)
+    assert got == {"c1-low"}
+
+
+# Same in a cohort with no borrowing limits (cohort-no-limits).
+def test_preempt_locally_borrowing_same_resource_no_limits(engine):
+    cache = build_cache()
+    padmit(cache, wl("d1-med", cpu=4), "d1", "default")
+    padmit(cache, wl("d1-low", priority=-1, cpu=4), "d1", "default")
+    padmit(cache, wl("d2-low-1", priority=-1, cpu=4), "d2", "default")
+    got = run_case(cache, wl("in", priority=1, cpu=4), "d1",
+                   {"cpu": ("default", PREEMPT)}, engine)
+    assert got == {"d1-low"}
+
+
+# "preempting locally and borrowing other resources in cohort, with
+# cohort candidates": cross-CQ candidates exist but the first round
+# (no borrowing) can succeed with the same-CQ victim alone.
+def test_preempt_locally_borrow_other_resources_with_cohort_candidates(engine):
+    cache = build_cache()
+    padmit(cache, wl("c1-med", cpu=4), "c1", "default")
+    padmit(cache, wl("c2-low-1", priority=-1, cpu=5), "c2", "default")
+    padmit(cache, wl("c2-low-2", priority=-1, cpu=1), "c2", "default")
+    padmit(cache, wl("c2-low-3", priority=-1, cpu=1), "c2", "default")
+    got = run_case(cache, wl("in", priority=1, cpu=2, memory="5Gi"), "c1",
+                   {"cpu": ("default", PREEMPT),
+                    "memory": ("default", PREEMPT)}, engine)
+    assert got == {"c1-med"}
+
+
+# "preempting locally and not borrowing same resource in 1-queue cohort":
+# with no other member to borrow from, the within-CQ round applies and the
+# newest-first minimality picks the mid-priority victim.
+def test_preempt_locally_one_queue_cohort(engine):
+    cache = build_cache()
+    padmit(cache, wl("l1-med", cpu=4), "l1", "default")
+    padmit(cache, wl("l1-low", priority=-1, cpu=2), "l1", "default")
+    got = run_case(cache, wl("in", priority=1, cpu=4), "l1",
+                   {"cpu": ("default", PREEMPT)}, engine)
+    assert got == {"l1-med"}
+
+
+# "do not reclaim borrowed quota from same priority for
+# withinCohort=ReclaimFromLowerPriority"
+def test_no_reclaim_same_priority_lower_priority_policy(engine):
+    cache = build_cache()
+    padmit(cache, wl("c1", cpu=2), "c1", "default")
+    padmit(cache, wl("c2-1", cpu=4), "c2", "default")
+    padmit(cache, wl("c2-2", cpu=4), "c2", "default")
+    got = run_case(cache, wl("in", cpu=4), "c1",
+                   {"cpu": ("default", PREEMPT)}, engine)
+    assert got == set()
+
+
+# "reclaim borrowed quota from same priority for withinCohort=ReclaimFromAny"
+def test_reclaim_same_priority_any_policy(engine):
+    cache = build_cache()
+    padmit(cache, wl("c1-1", cpu=4), "c1", "default")
+    padmit(cache, wl("c1-2", priority=1, cpu=4), "c1", "default")
+    padmit(cache, wl("c2", cpu=2), "c2", "default")
+    got = run_case(cache, wl("in", cpu=4), "c2",
+                   {"cpu": ("default", PREEMPT)}, engine)
+    assert got == {"c1-1"}
+
+
+# "each podset preempts a different flavor"
+def test_each_podset_preempts_different_flavor_targets(engine):
+    cache = build_cache()
+    padmit(cache, wl("low-alpha", priority=-1, memory="2Gi"),
+           "standalone", "alpha")
+    padmit(cache, wl("low-beta", priority=-1, memory="2Gi"),
+           "standalone", "beta")
+    incoming = Workload(
+        name="in", namespace="", queue_name="",
+        pod_sets=[
+            PodSet(name="launcher", count=1,
+                   requests={"memory": mem("2Gi")}),
+            PodSet(name="workers", count=2,
+                   requests={"memory": mem("1Gi")}),
+        ],
+        creation_time=NOW - 10)
+    snap = cache.snapshot()
+    wi = WorkloadInfo(incoming, cluster_queue="standalone")
+    a = Assignment(usage={})
+    for p, fname in zip(wi.total_requests, ("alpha", "beta")):
+        psa = PodSetAssignmentResult(
+            name=p.name, requests=dict(p.requests), count=p.count)
+        psa.flavors["memory"] = FlavorAssignment(name=fname, mode=PREEMPT)
+        a.pod_sets.append(psa)
+    targets = get_targets(wi, a, snap, ORD, NOW, engine=engine)
+    assert {t.obj.name for t in targets} == {"low-alpha", "low-beta"}

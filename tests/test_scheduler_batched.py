@@ -152,3 +152,71 @@ def test_usage_encoder_lockstep_with_cache():
     fw.submit(make_wl("a9", "qa", cpu=1, creation_time=20.0))
     fw.run_until_settled()
     check()
+
+
+def test_batched_partial_no_referee_calls(monkeypatch):
+    """VERDICT r3 task 7 done-criterion: the batch path must not run the
+    sequential referee for partial-admission probes — the min_count binary
+    search rounds go through the batched device solve."""
+    import kueue_tpu.scheduler.scheduler as sched_mod
+
+    def boom(*a, **k):
+        raise AssertionError("assign_flavors must not run in batch mode")
+
+    monkeypatch.setattr(sched_mod, "assign_flavors", boom)
+    fw = batched_framework(quota_cpu=4)
+    wl = make_wl("w", pod_sets=[PodSet.make("main", count=8, min_count=2,
+                                            cpu=1)])
+    fw.submit(wl)
+    fw.run_until_settled()
+    assert wl.admission.pod_set_assignments[0].count == 4
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_batched_partial_equivalence(seed):
+    """Randomized min_count workloads: batch-mode lockstep search admits
+    the same workloads at the same reduced counts as the referee path."""
+    import random
+
+    def build(batch):
+        from kueue_tpu.api.types import FlavorFungibility
+
+        rnd = random.Random(seed)
+        fw = Framework(batch_solver=BatchSolver() if batch else None)
+        fw.create_resource_flavor(make_flavor("default"))
+        fw.create_resource_flavor(make_flavor("second"))
+        for c in range(3):
+            # Mixed one- and two-flavor CQs with varying fungibility: the
+            # probes' flavor-resume state must match the sequential
+            # reducer's (it resumes from the PREVIOUS attempt, not from
+            # this tick's full-count solve).
+            flavors = [fq("default", cpu=rnd.randint(3, 10))]
+            if rnd.random() < 0.6:
+                flavors.append(fq("second", cpu=rnd.randint(3, 10)))
+            fung = FlavorFungibility(
+                when_can_borrow=rnd.choice(["Borrow", "TryNextFlavor"]),
+                when_can_preempt=rnd.choice(["Preempt", "TryNextFlavor"]))
+            fw.create_cluster_queue(make_cq(
+                f"cq{c}", rg("cpu", *flavors),
+                cohort="co" if rnd.random() < 0.5 else "",
+                fungibility=fung))
+            fw.create_local_queue(make_lq(f"q{c}", cq=f"cq{c}"))
+        for i in range(10):
+            c = rnd.randrange(3)
+            count = rnd.randint(2, 9)
+            min_count = rnd.randint(1, count) if rnd.random() < 0.7 else None
+            fw.submit(make_wl(
+                f"w{i}", f"q{c}", priority=rnd.randint(-1, 2),
+                creation_time=float(i),
+                pod_sets=[PodSet.make("main", count=count,
+                                      min_count=min_count, cpu=1)]))
+        fw.run_until_settled(max_ticks=60)
+        return {
+            key: (wl.admission.pod_set_assignments[0].count,
+                  dict(wl.admission.pod_set_assignments[0].flavors))
+            for key, wl in fw.workloads.items() if wl.is_admitted
+        }
+
+    ref = build(batch=False)
+    got = build(batch=True)
+    assert got == ref, f"seed={seed}: batch {got} != referee {ref}"

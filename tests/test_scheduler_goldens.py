@@ -516,3 +516,129 @@ def test_preemption_wait_does_not_block_borrower(golden):
                      [("main", {"cpu": "default"}, {"cpu": cpu(1)}, 1)])
     not_admitted(fw, "eng-alpha/a")
     assert inadmissible_keys(fw, "cq-a") == {"eng-alpha/a"}
+
+
+# "workload fits in single clusterQueue, with check state ready": Admitted
+# syncs at admit time because every recorded check state is Ready.
+def test_fits_with_check_state_ready(golden):
+    from kueue_tpu.api.types import AdmissionCheckState
+    fw = golden
+    w = wl("foo", "sales", "main", [ps("one", 10, {"cpu": cpu(1)})])
+    w.admission_check_states["check"] = AdmissionCheckState(
+        name="check", state="Ready")
+    fw.submit(w)
+    fw.tick()
+    assert_admission(fw, "sales/foo", "sales",
+                     [("one", {"cpu": "default"}, {"cpu": cpu(10)}, 10)])
+    assert w.is_admitted
+
+
+# "workload fits in single clusterQueue, with check state pending": quota
+# reserved, but a Pending check state blocks Admitted at admit time.
+def test_fits_with_check_state_pending(golden):
+    from kueue_tpu.api.types import AdmissionCheckState
+    fw = golden
+    w = wl("foo", "sales", "main", [ps("one", 10, {"cpu": cpu(1)})])
+    w.admission_check_states["check"] = AdmissionCheckState(
+        name="check", state="Pending")
+    fw.submit(w)
+    fw.scheduler.schedule(timeout=0.0)
+    assert_admission(fw, "sales/foo", "sales",
+                     [("one", {"cpu": "default"}, {"cpu": cpu(10)}, 10)])
+    assert w.has_quota_reservation and not w.is_admitted
+
+
+# "error during admission": the apply fails, the assumption rolls back and
+# the head goes back to its heap.
+def test_error_during_admission(golden):
+    fw = golden
+    fw.scheduler.apply_admission = lambda _wl: False
+    fw.submit(wl("foo", "sales", "main", [ps("one", 10, {"cpu": cpu(1)})]))
+    fw.scheduler.schedule(timeout=0.0)
+    not_admitted(fw, "sales/foo")
+    assert heap_keys(fw, "sales") == {"sales/foo"}
+    assert fw.cache.usage("sales")["default"]["cpu"] == 0
+
+
+# "can borrow if needs reclaim from cohort in different flavor": alpha's
+# reclaim pends on on-demand, but beta's borrow rides the same cycle
+# because the pending preemption holds a different... (scheduler_test.go:631)
+def test_can_borrow_when_reclaim_needs_different_flavor(golden):
+    fw = golden
+    preadmit(fw, wl("user-on-demand", "eng-beta", "main",
+                    [ps("main", 1, {"cpu": cpu(50)})]),
+             "eng-beta", [{"cpu": "on-demand"}])
+    preadmit(fw, wl("user-spot", "eng-beta", "main",
+                    [ps("main", 1, {"cpu": cpu(1)})]),
+             "eng-beta", [{"cpu": "spot"}])
+    fw.submit(wl("can-reclaim", "eng-alpha", "main",
+                 [ps("main", 1, {"cpu": cpu(100)})], creation=101.0))
+    fw.submit(wl("needs-to-borrow", "eng-beta", "main",
+                 [ps("main", 1, {"cpu": cpu(1)})], creation=102.0))
+    fw.scheduler.schedule(timeout=0.0)
+    assert_admission(fw, "eng-beta/needs-to-borrow", "eng-beta",
+                     [("main", {"cpu": "on-demand"}, {"cpu": cpu(1)}, 1)])
+    not_admitted(fw, "eng-alpha/can-reclaim")
+    assert heap_keys(fw, "eng-alpha") == {"eng-alpha/can-reclaim"}
+
+
+# "multiple CQs need preemption": a preemption pending in one cohort must
+# not block the other cohort's preemptor from issuing its own.
+def test_multiple_cqs_need_preemption(golden):
+    fw = golden
+    fw.create_cluster_queue(make_cq(
+        "other-alpha", rg("cpu", fq("on-demand", cpu=(50, 50))),
+        cohort="other"))
+    fw.create_cluster_queue(make_cq(
+        "other-beta", rg("cpu", fq("on-demand", cpu=(50, 10))),
+        cohort="other",
+        preemption=ClusterQueuePreemption(
+            reclaim_within_cohort="Any",
+            within_cluster_queue="LowerPriority")))
+    fw.create_local_queue(make_lq("other", "eng-alpha", cq="other-alpha"))
+    fw.create_local_queue(make_lq("other", "eng-beta", cq="other-beta"))
+    use_all = wl("use-all", "eng-alpha", "other",
+                 [ps("main", 1, {"cpu": cpu(100)})])
+    preadmit(fw, use_all, "other-alpha", [{"cpu": "on-demand"}])
+    fw.submit(wl("preemptor", "eng-beta", "other",
+                 [ps("main", 1, {"cpu": cpu(1)})], priority=-1,
+                 creation=101.0))
+    fw.submit(wl("pending", "eng-alpha", "other",
+                 [ps("main", 1, {"cpu": cpu(1)})], priority=1,
+                 creation=102.0))
+    fw.scheduler.schedule(timeout=0.0)
+    # The preemptor issued its reclaim and waits; the borrowing victim is
+    # evicted; the other CQ's head is inadmissible this cycle.
+    assert use_all.is_evicted
+    not_admitted(fw, "eng-beta/preemptor")
+    assert heap_keys(fw, "other-beta") == {"eng-beta/preemptor"}
+    assert inadmissible_keys(fw, "other-alpha") == {"eng-alpha/pending"}
+
+
+# "workload should not fit in nonexistent clusterQueue"
+def test_nonexistent_cluster_queue(golden):
+    fw = golden
+    fw.submit(wl("foo", "sales", "cq-nonexistent-queue",
+                 [ps("main", 1, {"cpu": cpu(1)})]))
+    fw.tick()
+    not_admitted(fw, "sales/foo")
+    # Never enqueued anywhere: the LocalQueue doesn't exist.
+    assert all("sales/foo" not in heap_keys(fw, name)
+               for name in fw.queues.cluster_queues)
+
+
+# "partial admission single variable pod set, preempt first": the reducer
+# stops at the first count whose preemption can succeed — no reduction
+# below what eviction frees.
+def test_partial_admission_preempt_first(golden):
+    fw = golden
+    old = wl("old", "eng-beta", "main", [ps("one", 10, {GPU: 1})],
+             priority=-4)
+    preadmit(fw, old, "eng-beta", [{GPU: "model-a"}])
+    fw.submit(wl("new", "eng-beta", "main",
+                 [ps("one", 20, {GPU: 1}, min_count=10)], priority=4,
+                 creation=101.0))
+    fw.scheduler.schedule(timeout=0.0)
+    assert old.is_evicted
+    not_admitted(fw, "eng-beta/new")
+    assert heap_keys(fw, "eng-beta") == {"eng-beta/new"}

@@ -79,9 +79,11 @@ class TestReadsDontStallBehindTicks:
             release.set()
             t.join()
             p99 = float(np.percentile(np.array(lat) * 1000, 99))
-            # Reads completed DURING the lock hold, far under its 1.5s.
+            # Reads completed DURING the lock hold, far under its 1.5s
+            # (generous bound: shared CI hosts jitter, but a read that
+            # waited for the lock would take the full 1.5s).
             assert len(lat) > 10
-            assert p99 < 200, f"read p99 {p99:.0f}ms stalled behind the tick"
+            assert p99 < 500, f"read p99 {p99:.0f}ms stalled behind the tick"
         finally:
             server.stop()
 
