@@ -262,11 +262,73 @@ def find_matching_workloads_default(
     return match, to_delete
 
 
+# -- per-job webhook validation (jobframework/validation.go + the
+# per-framework *_webhook.go files) ------------------------------------------
+
+
+def _dns1123_errors(value: str, what: str) -> List[str]:
+    from kueue_tpu.webhooks.validation import is_dns1123_subdomain
+    if not is_dns1123_subdomain(value):
+        return [f"{what}: {value!r} must be a DNS-1123 subdomain"]
+    return []
+
+
+def validate_job_create(job: GenericJob) -> List[str]:
+    """Create-time rules (jobframework/validation.go
+    ValidateCreateForQueueName): queue name and prebuilt-workload name
+    must be valid CRD names."""
+    errs: List[str] = []
+    if job.queue_name:
+        errs += _dns1123_errors(job.queue_name,
+                                "metadata.labels[kueue.x-k8s.io/queue-name]")
+    prebuilt = job.prebuilt_workload()
+    if prebuilt:
+        errs += _dns1123_errors(
+            prebuilt,
+            "metadata.labels[kueue.x-k8s.io/prebuilt-workload-name]")
+    return errs
+
+
+def job_update_guard(job: GenericJob) -> dict:
+    """The fields the update webhooks pin (captured at submit time)."""
+    return {
+        "queue_name": job.queue_name,
+        "prebuilt": job.prebuilt_workload(),
+        "priority_class": job.priority_class(),
+    }
+
+
+def validate_job_update(guard: dict, job: GenericJob) -> List[str]:
+    """Update-time rules (jobframework/validation.go
+    ValidateUpdateForQueueName / ...ForWorkloadPriorityClassName, plus the
+    per-framework `validate_update` hook — e.g. batch/Job forbids
+    parallelism changes of an unsuspended partial-admission job,
+    job_webhook.go:147-160): returns reasons, empty == allowed. `guard`
+    is the last-admitted state from job_update_guard."""
+    errs: List[str] = []
+    if not job.is_suspended() and job.queue_name != guard["queue_name"]:
+        errs.append("metadata.labels[kueue.x-k8s.io/queue-name]: "
+                    "immutable while the job is not suspended")
+    if job.prebuilt_workload() != guard["prebuilt"]:
+        errs.append("metadata.labels[kueue.x-k8s.io/prebuilt-workload-name]: "
+                    "field is immutable")
+    if job.priority_class() != guard["priority_class"]:
+        errs.append(
+            "metadata.labels[kueue.x-k8s.io/workload-priority-class]: "
+            "field is immutable")
+    hook = getattr(job, "validate_update", None)
+    if hook is not None:
+        errs += hook(guard)
+    return errs
+
+
 @dataclass
 class _JobState:
     job: GenericJob
     owned: List[str] = field(default_factory=list)   # workload keys
     finalized: bool = False
+    guard: Optional[dict] = None
+    last_rejection: Optional[str] = None
 
 
 class JobReconciler:
@@ -325,10 +387,15 @@ class JobReconciler:
             if not job.is_suspended():
                 job.suspend()
             return None
+        errs = validate_job_create(job)
+        if errs:
+            from kueue_tpu.webhooks import ValidationError
+            raise ValidationError(errs)
         if not job.is_suspended():
             job.suspend()
         state = self._states.setdefault(self.job_key(job), _JobState(job=job))
         state.job = job
+        state.guard = job_update_guard(job)
         self.reconcile_job(job)
         wl_key = state.owned[0] if state.owned else None
         return self.fw.workloads.get(wl_key) if wl_key else None
@@ -367,6 +434,38 @@ class JobReconciler:
         #    (reconciler.go:177-181).
         if isinstance(job, JobWithSkip) and job.skip():
             return
+
+        # 0.1 Per-job update webhook (jobframework/validation.go + the
+        # per-framework *_webhook.go rules): an invalid mutation is the
+        # analog of a denied apiserver write — surface it (once per
+        # distinct rejection) and do not act on the new state. Completion
+        # still proceeds: a denied write must not wedge finalization.
+        # A legal mutation refreshes the guard.
+        if state.guard is not None:
+            errs = validate_job_update(state.guard, job)
+            if errs:
+                message = "; ".join(errs)
+                if message != state.last_rejection:
+                    state.last_rejection = message
+                    events = getattr(self.fw, "events", None)
+                    if events is not None:
+                        from kueue_tpu import events as events_mod
+                        events.event(
+                            self.job_key(job), events_mod.WARNING,
+                            "UpdateRejected", message, now=self.fw.clock())
+                wl = next((self.fw.workloads[k] for k in state.owned
+                           if k in self.fw.workloads), None)
+                if wl is not None and wl.is_finished:
+                    self._finalize(state)
+                    return
+                done, success = job.finished()
+                if done:
+                    if wl is not None and not wl.is_finished:
+                        self.fw.finish(wl, success=success)
+                    self._finalize(state)
+                return
+            state.last_rejection = None
+            state.guard = job_update_guard(job)
 
         # 1. Single-workload invariant (reconciler.go:270 ensureOneWorkload).
         wl = self._ensure_one_workload(state, job)

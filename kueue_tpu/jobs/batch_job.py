@@ -76,6 +76,7 @@ class BatchJob(GenericJob):
         info = podset_infos[0]
         # Partial admission rewrites parallelism (job.go RunWithPodSetsInfo).
         self.parallelism = info.count
+        self._applied_parallelism = info.count
         self.podset_info = info
         self._suspended = False
         if self._on_run is not None:
@@ -83,7 +84,19 @@ class BatchJob(GenericJob):
 
     def restore(self, podset_infos: Sequence[PodSetInfo]) -> None:
         self.parallelism = self.original_parallelism
+        self._applied_parallelism = None
         self.podset_info = None
+
+    def validate_update(self, guard: dict):
+        """Per-framework update webhook (job_webhook.go:147-160): with
+        partial admission enabled, parallelism cannot change while the
+        job is running (the admitted count is authoritative)."""
+        applied = getattr(self, "_applied_parallelism", None)
+        if (self.min_parallelism is not None and not self.is_suspended()
+                and applied is not None and self.parallelism != applied):
+            return ["spec.parallelism: cannot change when partial admission "
+                    "is enabled and the job is not suspended"]
+        return []
 
     def pod_sets(self) -> List[PodSet]:
         return [PodSet.make(

@@ -216,6 +216,7 @@ class PodGroup(GenericJob, ComposableJob):
         complete, pod_controller.go group assembly)."""
         if not self.has_all_members():
             return None
+        self._applied_total = self.total_count
         return Workload(
             name=f"job-{self._name}",
             namespace=self._namespace,
@@ -223,6 +224,17 @@ class PodGroup(GenericJob, ComposableJob):
             pod_sets=self.pod_sets(),
             priority=self._priority,
         )
+
+    def validate_update(self, guard: dict):
+        """Per-framework update webhook (pod_webhook.go group rules): the
+        expected group total is immutable once the group workload was
+        constructed and the group is running."""
+        applied = getattr(self, "_applied_total", None)
+        if (applied is not None and not self.is_suspended()
+                and self.total_count != applied):
+            return ["metadata.annotations[kueue.x-k8s.io/pod-group-total-"
+                    "count]: immutable while the pod group is running"]
+        return []
 
     def find_matching_workloads(self, owned):
         from kueue_tpu.controllers.jobframework import \

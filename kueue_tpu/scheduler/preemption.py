@@ -123,6 +123,7 @@ def get_targets_batch(items, snapshot: Snapshot, ordering: WorkloadOrdering,
     searches: List[PlannedSearch] = []
     search_meta = []   # (item_idx, wl_req, res_per_flv, round2 | None)
     fair = features.enabled(features.FAIR_SHARING)
+    key_memo: dict = {}
 
     for idx, (wi, assignment) in enumerate(items):
         res_per_flv = _resources_requiring_preemption(assignment)
@@ -137,7 +138,8 @@ def get_targets_batch(items, snapshot: Snapshot, ordering: WorkloadOrdering,
         if not candidates:
             results[idx] = []
             continue
-        candidates.sort(key=lambda c: _candidate_sort_key(c, cq.name, now))
+        candidates.sort(key=lambda c: _candidate_sort_key(c, cq.name, now,
+                                                          key_memo))
         round1, round2 = _plan_rounds(wi, cq, candidates)
         cands, allow_b, thr = round1
         wl_req = _total_requests_for_assignment(wi, assignment)
@@ -259,16 +261,25 @@ def _uses_resources(wi: WorkloadInfo, res_per_flv: ResourcesPerFlavor) -> bool:
     return False
 
 
-def _candidate_sort_key(c: WorkloadInfo, cq_name: str, now: float):
+def _candidate_sort_key(c: WorkloadInfo, cq_name: str, now: float,
+                        memo: Optional[dict] = None):
     """Evicted first, other-CQ first, lowest priority, newest admission,
-    UID tiebreak (preemption.go:397-424)."""
-    return (
-        not c.obj.condition_true(CONDITION_EVICTED),
-        c.cluster_queue == cq_name,
-        c.obj.priority,
-        -c.obj.quota_reserved_time(now),
-        c.obj.uid,
-    )
+    UID tiebreak (preemption.go:397-424).
+
+    `memo` caches the search-independent parts per candidate: cohort mates
+    are re-sorted by every searching entry of a tick, and the condition
+    lookups dominate the sort otherwise."""
+    parts = memo.get(id(c)) if memo is not None else None
+    if parts is None:
+        parts = (
+            not c.obj.condition_true(CONDITION_EVICTED),
+            c.obj.priority,
+            -c.obj.quota_reserved_time(now),
+            c.obj.uid,
+        )
+        if memo is not None:
+            memo[id(c)] = parts
+    return (parts[0], c.cluster_queue == cq_name) + parts[1:]
 
 
 def _total_requests_for_assignment(wi: WorkloadInfo,

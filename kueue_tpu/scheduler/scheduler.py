@@ -333,10 +333,11 @@ class Scheduler:
         for every pair."""
         if not pairs:
             return {}
-        ctx_fn = getattr(self.batch_solver, "preemption_context", None)
-        ctx_usage = ctx_fn() if ctx_fn is not None else None
-        if ctx_usage is not None and self.preemption_engine in (
-                "native", "jax", "pallas"):
+        ctx_usage = None
+        if self.preemption_engine in ("native", "jax", "pallas"):
+            ctx_fn = getattr(self.batch_solver, "preemption_context", None)
+            ctx_usage = ctx_fn() if ctx_fn is not None else None
+        if ctx_usage is not None:
             targets_list = preemption_mod.get_targets_batch(
                 [(wi, a) for _, wi, a in pairs],
                 snapshot, self.ordering, self.clock(),
@@ -371,11 +372,13 @@ class Scheduler:
             probes = [s.probe() for _, s in active]
             assignments = self.batch_solver.solve_with_counts(
                 [e.info for e, _ in active], snapshot, probes)
-            # Preempt-mode probes need victim sets to count as fitting
-            # (the reducer's fits() tries preemption too).
+            # Non-Fit probes need victim sets to count as fitting — the
+            # reducer's fits() tries preemption on ANY non-Fit probe
+            # (even a NoFit-representative truncated assignment can carry
+            # Preempt podsets whose victims free enough quota).
             targets_by_idx = self._batched_targets(
                 [(i, active[i][0].info, a) for i, a in enumerate(assignments)
-                 if a.representative_mode == PREEMPT], snapshot)
+                 if a.representative_mode != FIT], snapshot)
             for i, (e, s) in enumerate(active):
                 a = assignments[i]
                 targets = targets_by_idx.get(i, [])

@@ -714,11 +714,12 @@ def encode_workloads(workloads: Sequence[WorkloadInfo], snapshot: Snapshot,
     elig = np.zeros((W, P, G, S), dtype=bool)
     resume_slot = np.zeros((W, P, G), dtype=np.int32)
     wl_valid = np.zeros(W, dtype=bool)
+    wl_valid[:n] = True
 
+    rows: List[_Row] = []
+    p_counts: List[int] = []
     for w, wi in enumerate(workloads):
         cq = snapshot.cluster_queues[wi.cluster_queue]
-        wl_valid[w] = True
-
         totals = wi.total_requests
         scaled = counts is not None and counts[w] is not None
         if scaled:
@@ -729,13 +730,8 @@ def encode_workloads(workloads: Sequence[WorkloadInfo], snapshot: Snapshot,
             row = _encode_row(wi, cq, snapshot, enc, totals)
             if not scaled and row_cache is not None:
                 row_cache.put(wi, row)
-        p_count = len(totals)
-        wl_cq[w] = row.ci
-        req[w, :p_count] = row.req
-        has_req[w, :p_count] = row.has_req
-        podset_valid[w, :p_count] = True
-        podset_unsat[w, :p_count] = row.unsat
-        elig[w, :p_count] = row.elig
+        rows.append(row)
+        p_counts.append(len(totals))
 
         # Stale resume state is dropped exactly like the referee
         # (flavorassigner.go:244-247).
@@ -748,7 +744,7 @@ def encode_workloads(workloads: Sequence[WorkloadInfo], snapshot: Snapshot,
             if outdated:
                 last = None
         if last is not None:
-            for p in range(p_count):
+            for p in range(p_counts[-1]):
                 requested = row.requests_per_podset[p]
                 for gi, rg in enumerate(cq.resource_groups):
                     # Resume slot for this group: any covered requested
@@ -758,6 +754,27 @@ def encode_workloads(workloads: Sequence[WorkloadInfo], snapshot: Snapshot,
                             resume_slot[w, p, gi] = \
                                 last.next_flavor_to_try(p, rname)
                             break
+
+    # Batched assembly. The common case — every workload a single podset —
+    # is one np.stack per field instead of six indexed assignments per
+    # workload (~6k tiny numpy ops per tick at north-star scale).
+    if P == 1 and all(c == 1 for c in p_counts):
+        wl_cq[:n] = [row.ci for row in rows]
+        if n:
+            req[:n, 0] = np.stack([row.req[0] for row in rows])
+            has_req[:n, 0] = np.stack([row.has_req[0] for row in rows])
+            podset_valid[:n, 0] = True
+            podset_unsat[:n, 0] = [row.unsat[0] for row in rows]
+            elig[:n, 0] = np.stack([row.elig[0] for row in rows])
+    else:
+        for w, row in enumerate(rows):
+            p_count = p_counts[w]
+            wl_cq[w] = row.ci
+            req[w, :p_count] = row.req
+            has_req[w, :p_count] = row.has_req
+            podset_valid[w, :p_count] = True
+            podset_unsat[w, :p_count] = row.unsat
+            elig[w, :p_count] = row.elig
 
     return WorkloadTensors(
         wl_cq=wl_cq, req=req, has_req=has_req, podset_valid=podset_valid,

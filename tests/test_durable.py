@@ -172,6 +172,9 @@ def _spawn(state_dir, setup_path):
         if proc.poll() is not None:
             raise RuntimeError("serve subprocess died during startup")
     assert url, "server never reported its URL"
+    # Keep draining stderr: a full pipe would block the server.
+    import threading
+    threading.Thread(target=lambda: proc.stderr.read(), daemon=True).start()
     return proc, url
 
 

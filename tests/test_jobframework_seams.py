@@ -265,3 +265,71 @@ class TestComposable:
         [(key, wl)] = list(fw.workloads.items())
         assert wl.is_admitted
         assert sum(ps.count for ps in wl.pod_sets) == 2
+
+
+class TestPerJobWebhooks:
+    """Per-job webhook validation breadth (jobframework/validation.go +
+    per-framework *_webhook.go): create-time name rules, update-time
+    immutability, and per-framework invariants, enforced through the
+    reconcile pass (the denied-apiserver-write analog)."""
+
+    def test_create_rejects_invalid_queue_name(self):
+        from kueue_tpu.webhooks import ValidationError
+        fw = make_fw()
+        job = FakeJob(queue="Not_A_Valid_Name!")
+        try:
+            fw.submit_job(job)
+            assert False, "expected ValidationError"
+        except ValidationError as e:
+            assert "queue-name" in str(e)
+
+    def test_queue_change_while_running_rejected(self):
+        fw = make_fw()
+        fw.create_local_queue(__import__(
+            "tests.util", fromlist=["make_lq"]).make_lq("other", cq="cq"))
+        job = FakeJob()
+        wl = fw.submit_job(job)
+        fw.run_until_settled()
+        assert not job.is_suspended()
+        # Mutate the queue while running: the webhook analog rejects it.
+        job._queue = "other"
+        fw.job_reconciler.reconcile()
+        assert wl.queue_name == "main"
+        rejected = fw.events.for_object("default/j", reason="UpdateRejected")
+        assert rejected and "queue-name" in rejected[-1].message
+        # Reverting the mutation clears the rejection.
+        job._queue = "main"
+        before = len(fw.events.for_object("default/j",
+                                          reason="UpdateRejected"))
+        fw.job_reconciler.reconcile()
+        assert len(fw.events.for_object(
+            "default/j", reason="UpdateRejected")) == before
+
+    def test_priority_class_immutable(self):
+        fw = make_fw()
+
+        class PCJob(FakeJob):
+            pc = ""
+
+            def priority_class(self):
+                return self.pc
+
+        job = PCJob()
+        fw.submit_job(job)
+        fw.run_until_settled()
+        job.pc = "high"
+        fw.job_reconciler.reconcile()
+        assert fw.events.for_object("default/j", reason="UpdateRejected")
+
+    def test_batch_job_parallelism_frozen_under_partial_admission(self):
+        from kueue_tpu.jobs.batch_job import BatchJob
+        fw = make_fw(cpu=2)
+        job = BatchJob("bj", "main", parallelism=4, min_parallelism=1,
+                       requests={"cpu": 1})
+        wl = fw.submit_job(job)
+        fw.run_until_settled()
+        assert not job.is_suspended()
+        assert job.parallelism == 2  # partially admitted
+        job.parallelism = 4          # forbidden while running
+        fw.job_reconciler.reconcile()
+        assert fw.events.for_object("default/bj", reason="UpdateRejected")

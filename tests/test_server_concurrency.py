@@ -213,6 +213,8 @@ def _spawn_replica(state_dir, setup, cfg, lease_file):
         if proc.poll() is not None:
             raise RuntimeError("replica died during startup")
     assert url
+    # Keep draining stderr: a full pipe would block the replica.
+    threading.Thread(target=lambda: proc.stderr.read(), daemon=True).start()
     return proc, url
 
 
