@@ -463,6 +463,21 @@ class JobReconciler:
                     if wl is not None and not wl.is_finished:
                         self.fw.finish(wl, success=success)
                     self._finalize(state)
+                    return
+                # Quota-safety transitions still run on the (old) state —
+                # the reference's denied write leaves reconciliation
+                # operating normally: an evicted or reservation-less
+                # running job must still be stopped.
+                if wl is not None and wl.is_evicted \
+                        and not job.is_suspended():
+                    evicted = wl.find_condition("Evicted")
+                    self._stop_job(job, wl, StopReason.WORKLOAD_EVICTED,
+                                   evicted.message if evicted else "")
+                elif not job.is_suspended() and (
+                        wl is None or (not wl.is_admitted
+                                       and not wl.has_quota_reservation)):
+                    self._stop_job(job, wl, StopReason.NOT_ADMITTED,
+                                   "Not admitted by cluster queue")
                 return
             state.last_rejection = None
             state.guard = job_update_guard(job)

@@ -49,13 +49,43 @@ from kueue_tpu import events as events_mod
 from kueue_tpu import webhooks
 
 
+_ACCEL_PROBE: List = []
+
+
 def _accelerator_present() -> bool:
-    """True when jax's default backend is an accelerator (TPU/GPU)."""
-    try:
-        import jax
-        return jax.default_backend() not in ("cpu",)
-    except Exception:
-        return False
+    """True when jax's default backend is an accelerator (TPU/GPU).
+
+    The probe must never hang the control plane: initializing an
+    accelerator backend can block indefinitely when the device link is
+    down, so detection runs in a SUBPROCESS with a timeout (an
+    unreachable accelerator degrades to the host referee instead of
+    wedging startup). A JAX_PLATFORMS=cpu environment short-circuits.
+    The verdict is cached for the process lifetime."""
+    if _ACCEL_PROBE:
+        return _ACCEL_PROBE[0]
+    import os
+    import subprocess
+    import sys
+
+    result = False
+    if os.environ.get("JAX_PLATFORMS", "").split(",")[0] == "cpu":
+        result = False
+    else:
+        try:
+            out = subprocess.run(
+                [sys.executable, "-c",
+                 "import jax; print('backend=' + jax.default_backend())"],
+                capture_output=True, timeout=45, text=True)
+            # Parse the sentinel line only: site hooks may print banners.
+            backends = [line[len("backend="):]
+                        for line in out.stdout.splitlines()
+                        if line.startswith("backend=")]
+            result = (out.returncode == 0 and bool(backends)
+                      and backends[-1] != "cpu")
+        except Exception:
+            result = False
+    _ACCEL_PROBE.append(result)
+    return result
 
 
 class Framework:
@@ -75,16 +105,20 @@ class Framework:
             pipeline_depth = self.config.tpu_solver.pipeline_depth
         self.pipeline_depth = max(1, pipeline_depth)
         self._inflight_ticks: List = []
-        solver_enable = self.config.tpu_solver.enable
-        if solver_enable is None:
-            # Auto: the device solve path is the default whenever an
-            # accelerator backend is present (VERDICT r3 Weak #7 — a
-            # TPU-native framework defaults to its TPU path); CPU-only
-            # hosts (CI) keep the reference-equivalent host referee.
-            solver_enable = _accelerator_present()
-        if batch_solver is None and solver_enable:
-            from kueue_tpu.models.flavor_fit import BatchSolver
-            batch_solver = BatchSolver()
+        if batch_solver is None:
+            solver_enable = self.config.tpu_solver.enable
+            if solver_enable is None:
+                # Auto: the device solve path is the default whenever an
+                # accelerator backend is present (a TPU-native framework
+                # defaults to its TPU path); CPU-only hosts (CI) keep the
+                # reference-equivalent host referee. Only probed when no
+                # solver was handed in — the probe initializes the jax
+                # backend, which callers that bring their own solver may
+                # not want (or be able) to touch yet.
+                solver_enable = _accelerator_present()
+            if solver_enable:
+                from kueue_tpu.models.flavor_fit import BatchSolver
+                batch_solver = BatchSolver()
         wfpr = self.config.wait_for_pods_ready
         if ordering is None:
             ordering = WorkloadOrdering(

@@ -81,7 +81,10 @@ def _snapshot_cq(cq: CachedClusterQueue) -> CachedClusterQueue:
     cc.resource_groups = cq.resource_groups  # immutable per tick
     cc.rg_by_resource = cq.rg_by_resource
     cc.usage = frq_clone(cq.usage)
-    cc.admitted_usage = frq_clone(cq.admitted_usage)
+    # Snapshot consumers (solver, preemption sim, cohort aggregation) only
+    # read reserving usage; the admitted split stays cache-side (it feeds
+    # LocalQueue status, not the tick).
+    cc.admitted_usage = {}
     cc.workloads = dict(cq.workloads)
     cc.namespace_selector = cq.namespace_selector
     cc.preemption = cq.preemption
@@ -185,7 +188,7 @@ class SnapshotMirror:
         # so note_admission/note_removal queue here and apply at the next
         # refresh.
         self._pending: List[
-            Tuple[int, object, int, int, bool, Optional[WorkloadInfo]]] = []
+            Tuple[int, object, int, int, Optional[WorkloadInfo]]] = []
         # Monotonic count of snapshot mutations (lockstep applies and
         # re-clones). A pipelined tick records it at dispatch; a different
         # value at completion means the snapshot moved under the in-flight
@@ -262,8 +265,7 @@ class SnapshotMirror:
         if cache_cq is None:
             return
         self._pending.append((1, wl, cache_cq.usage_version,
-                              cache_cq.allocatable_generation,
-                              wl.is_admitted, wi))
+                              cache_cq.allocatable_generation, wi))
 
     def note_removal(self, wl) -> None:
         """Mirror of cache.forget_workload / delete after an apply failure
@@ -274,8 +276,7 @@ class SnapshotMirror:
         if cache_cq is None:
             return
         self._pending.append((-1, wl, cache_cq.usage_version,
-                              cache_cq.allocatable_generation,
-                              wl.is_admitted, None))
+                              cache_cq.allocatable_generation, None))
 
     def flush_pending(self) -> None:
         """Apply queued lockstep mutations to the snapshot. Called at every
@@ -286,12 +287,11 @@ class SnapshotMirror:
             return
         pending, self._pending = self._pending, []
         self.mutation_count += len(pending)
-        for sign, wl, version, alloc_gen, admitted, wi in pending:
-            self._apply(self._snap, sign, wl, version, alloc_gen, admitted, wi)
+        for sign, wl, version, alloc_gen, wi in pending:
+            self._apply(self._snap, sign, wl, version, alloc_gen, wi)
 
     def _apply(self, snap: Snapshot, sign: int, wl, version: int,
-               alloc_gen: int, admitted: bool,
-               wi: Optional[WorkloadInfo] = None) -> None:
+               alloc_gen: int, wi: Optional[WorkloadInfo] = None) -> None:
         cq = snap.cluster_queues.get(wl.admission.cluster_queue
                                      if wl.admission else "")
         if cq is None:
@@ -299,13 +299,12 @@ class SnapshotMirror:
         if sign > 0:
             if wi is None:
                 wi = WorkloadInfo(wl, cluster_queue=cq.name)
-            cq.add_workload_usage(wi, cohort_too=True, admitted=admitted)
+            cq.add_workload_usage(wi, cohort_too=True)
         else:
             wi = cq.workloads.get(wl.key)
             if wi is None:
                 return
-            cq.remove_workload_usage(wi, cohort_too=True,
-                                     admitted=admitted)
+            cq.remove_workload_usage(wi, cohort_too=True)
             # The cache bumped allocatable_generation on the delete; the
             # mirrored clone must track it for resume-state invalidation.
             cq.allocatable_generation = alloc_gen

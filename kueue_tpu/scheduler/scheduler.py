@@ -51,7 +51,13 @@ class Entry:
     status: str = NOT_NOMINATED
     inadmissible_msg: str = ""
     requeue_reason: str = RequeueReason.GENERIC
-    preemption_targets: List[WorkloadInfo] = field(default_factory=list)
+    # None = victim search deferred to the admission cycle (batch mode):
+    # the cycle issues at most one preemption round per cohort root per
+    # cycle, so most PREEMPT entries never need their victim set, and the
+    # snapshot is frozen between nominate and the cycle so a deferred
+    # search returns exactly what an eager one would.
+    preemption_targets: Optional[List[WorkloadInfo]] = field(
+        default_factory=list)
     # ClusterQueue share value at nomination time (KEP-1714 fair sharing).
     share: float = 0.0
 
@@ -246,23 +252,33 @@ class Scheduler:
         # (preemption.go runs these sequentially per head; the searches
         # are independent against the frozen snapshot, so batching is
         # decision-preserving).
+        partial_feature = features.enabled(features.PARTIAL_ADMISSION)
+        # Only partial-admission-eligible PREEMPT entries need their victim
+        # set at nomination time (the reducer's decision depends on it);
+        # everyone else's search defers to the admission cycle.
         pre_pairs = [] if assignments is None else [
             (i, entries[i].info, a) for i, a in enumerate(assignments)
-            if a.representative_mode == PREEMPT]
+            if a.representative_mode == PREEMPT
+            and partial_feature
+            and entries[i].info.obj.can_be_partially_admitted()]
         batch_targets = self._batched_targets(pre_pairs, snapshot)
         shares: Dict[str, float] = {}
         partial_pending: List[Entry] = []
         for i, e in enumerate(entries):
             full = assignments[i] if assignments is not None else None
-            assignment, targets = self._get_assignment(
-                e.info, snapshot, full,
-                precomputed_targets=batch_targets.get(i),
-                allow_partial=assignments is None)
+            if (full is not None and full.representative_mode == PREEMPT
+                    and i not in batch_targets):
+                assignment, targets = full, None   # deferred victim search
+            else:
+                assignment, targets = self._get_assignment(
+                    e.info, snapshot, full,
+                    precomputed_targets=batch_targets.get(i),
+                    allow_partial=assignments is None)
             e.assignment = assignment
             e.preemption_targets = targets
             needs_partial = (assignments is not None and not targets
                              and assignment.representative_mode != FIT
-                             and features.enabled(features.PARTIAL_ADMISSION)
+                             and partial_feature
                              and e.info.obj.can_be_partially_admitted())
             e.inadmissible_msg = assignment.message()
             if needs_partial:
@@ -415,8 +431,38 @@ class Scheduler:
     def _admission_cycle(self, entries: List[Entry], snapshot: Snapshot,
                          revalidate: bool = False) -> int:
         cycle_cohorts_usage: Dict[str, FlavorResourceQuantities] = {}
+        # Root-merged view of the same reservations: the preempt skip gate
+        # compares against the whole tree's cycle usage (for flat cohorts
+        # node == root and the two dicts coincide).
+        cycle_root_usage: Dict[str, FlavorResourceQuantities] = {}
         cycle_cohorts_skip_preemption: Set[str] = set()
+        preempting: List = []
         admitted = 0
+        # Deferred victim searches, pre-batched for the entries most likely
+        # to reach the issue branch — the first PREEMPT entry per cohort
+        # root (and every cohortless one) in cycle order. The snapshot is
+        # frozen for the whole cycle, so pre-computing is decision-
+        # identical to computing at the branch; stragglers (reachable only
+        # when an earlier root-mate was skipped on other grounds) still
+        # fall back to the lazy per-entry search.
+        first_per_root: Dict[str, Entry] = {}
+        prebatch: List[Entry] = []
+        for e in entries:
+            if e.assignment is None or e.preemption_targets is not None \
+                    or e.assignment.representative_mode != PREEMPT:
+                continue
+            cq = snapshot.cluster_queues.get(e.info.cluster_queue)
+            if cq is None:
+                continue
+            if cq.cohort is None:
+                prebatch.append(e)
+            elif first_per_root.setdefault(cq.cohort.root().name, e) is e:
+                prebatch.append(e)
+        if prebatch:
+            pre_targets = self._batched_targets(
+                [(id(e), e.info, e.assignment) for e in prebatch], snapshot)
+            for e in prebatch:
+                e.preemption_targets = pre_targets.get(id(e))
         # Batched staleness re-validation: one vectorized pass over all
         # in-doubt FIT entries against the solver's lockstep usage tensor
         # (falls back to the per-entry referee walk when unavailable).
@@ -469,8 +515,14 @@ class Scheduler:
                 # is genuinely consumed — not root-wide. The skip guard
                 # keys on the root (root() is self when flat).
                 root_name = cq.cohort.root().name
+                # A pending preemption invalidates later preemption
+                # calculations only where this cycle actually reserved
+                # common flavor-resources (scheduler.go:218-222).
                 blocked = (mode == PREEMPT
-                           and root_name in cycle_cohorts_skip_preemption)
+                           and root_name in cycle_cohorts_skip_preemption
+                           and _has_common_flavor_resources(
+                               cycle_root_usage.get(root_name),
+                               e.assignment.usage))
                 if not blocked and mode == FIT:
                     if cq.cohort.is_hierarchical():
                         if cycle_cohorts_usage and not fits_in_hierarchy(
@@ -492,8 +544,10 @@ class Scheduler:
                     e.info.last_assignment = None
                     self.metrics.skipped += 1
                     continue
+                reserve = _resources_to_reserve(e, cq)
                 frq_add(cycle_cohorts_usage.setdefault(cq.cohort.name, {}),
-                        _resources_to_reserve(e, cq))
+                        reserve)
+                frq_add(cycle_root_usage.setdefault(root_name, {}), reserve)
             if mode == FIT and self.pods_ready_gate is not None \
                     and not self.pods_ready_gate():
                 # Admission blocked until all admitted workloads are ready
@@ -505,14 +559,26 @@ class Scheduler:
                                       "be in the PodsReady condition")
                 continue
             if mode != FIT:
+                if e.preemption_targets is None:
+                    # Deferred victim search (see Entry.preemption_targets):
+                    # runs only for the one entry per cohort root that
+                    # reaches this branch. The evictions themselves apply
+                    # AFTER the cycle (see below), so a deferred search
+                    # sees exactly the pre-cycle eviction state an eager
+                    # (reference-timed, pre-cycle) search saw.
+                    e.preemption_targets = preemption_mod.get_targets(
+                        e.info, e.assignment, snapshot, self.ordering,
+                        self.clock(), fair_strategies=self.fair_strategies,
+                        engine=self.preemption_engine)
                 if e.preemption_targets:
                     # Next attempt should try all flavors (scheduler.go:240).
                     e.info.last_assignment = None
-                    preempted = self._issue_preemptions(e, cq)
-                    if preempted:
-                        e.inadmissible_msg += \
-                            f". Pending the preemption of {preempted} workload(s)"
-                        e.requeue_reason = RequeueReason.PENDING_PREEMPTION
+                    preempting.append((e, cq))
+                    count = len(e.preemption_targets)
+                    self.metrics.preempted += count
+                    e.inadmissible_msg += \
+                        f". Pending the preemption of {count} workload(s)"
+                    e.requeue_reason = RequeueReason.PENDING_PREEMPTION
                     if cq.cohort is not None:
                         cycle_cohorts_skip_preemption.add(cq.cohort.root().name)
                 continue
@@ -521,11 +587,16 @@ class Scheduler:
                 admitted += 1
             if cq.cohort is not None:
                 cycle_cohorts_skip_preemption.add(cq.cohort.root().name)
+        for e, cq in preempting:
+            self._issue_preemptions(e, cq)
         return admitted
 
-    def _issue_preemptions(self, e: Entry, cq: CachedClusterQueue) -> int:
+    def _issue_preemptions(self, e: Entry, cq: CachedClusterQueue) -> None:
         """IssuePreemptions (preemption.go:129-156): evictions applied with
-        bounded fan-out — the apply callback may cross a network boundary."""
+        bounded fan-out — the apply callback may cross a network boundary.
+        Runs after the admission cycle so deferred victim searches never
+        observe this cycle's own evictions (the reference picks every
+        target before its cycle starts)."""
         targets = [t for t in e.preemption_targets if not t.obj.is_evicted]
 
         def evict(target: WorkloadInfo) -> None:
@@ -538,9 +609,6 @@ class Scheduler:
         err = parallelize.for_each(targets, evict)
         if err is not None:
             raise err
-        count = len(e.preemption_targets)
-        self.metrics.preempted += count
-        return count
 
     def _admit(self, e: Entry, cq: CachedClusterQueue) -> bool:
         """scheduler.go admit (:493-541): assume in cache, then apply."""

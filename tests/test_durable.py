@@ -160,6 +160,7 @@ def _spawn(state_dir, setup_path):
         [sys.executable, "-m", "kueue_tpu", "--serve", "--port", "0",
          "--tick-interval", "0.05", "--state-dir", state_dir,
          "--objects", setup_path],
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
         stderr=subprocess.PIPE, stdout=subprocess.DEVNULL, text=True)
     url = None
     deadline = time.time() + 60

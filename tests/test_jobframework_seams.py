@@ -333,3 +333,27 @@ class TestPerJobWebhooks:
         job.parallelism = 4          # forbidden while running
         fw.job_reconciler.reconcile()
         assert fw.events.for_object("default/bj", reason="UpdateRejected")
+
+    def test_rejected_update_does_not_wedge_eviction(self):
+        """A persistent invalid mutation must not block the quota-safety
+        path: an evicted workload's job still stops."""
+        fw = make_fw(cpu=4)
+        job = FakeJob(cpu=4)
+
+        class PC(type(job)):
+            pass
+
+        wl = fw.submit_job(job)
+        fw.run_until_settled()
+        assert not job.is_suspended()
+        # Invalid mutation: queue change while running.
+        fw.create_local_queue(__import__(
+            "tests.util", fromlist=["make_lq"]).make_lq("other2", cq="cq"))
+        job._queue = "other2"
+        fw.job_reconciler.reconcile()
+        assert fw.events.for_object("default/j", reason="UpdateRejected")
+        # The workload is evicted while the rejection persists: the job
+        # must still be stopped.
+        fw.evict_workload(wl, reason="Test", message="evicted")
+        fw.tick()
+        assert job.is_suspended()

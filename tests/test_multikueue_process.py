@@ -71,9 +71,11 @@ def worker():
                                      delete=False) as fh:
         fh.write(WORKER_SETUP)
         setup_path = fh.name
+    import os
     proc = subprocess.Popen(
         [sys.executable, "-m", "kueue_tpu", "--serve", "--port", "0",
          "--tick-interval", "0.05", "--objects", setup_path],
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
         stderr=subprocess.PIPE, stdout=subprocess.DEVNULL, text=True)
     url = None
     deadline = time.time() + 60

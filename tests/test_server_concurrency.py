@@ -201,6 +201,7 @@ def _spawn_replica(state_dir, setup, cfg, lease_file):
          "--tick-interval", "0.05", "--state-dir", state_dir,
          "--lease-file", lease_file,
          "--config", cfg, "--objects", setup],
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
         stderr=subprocess.PIPE, stdout=subprocess.DEVNULL, text=True)
     url = None
     deadline = time.time() + 60
