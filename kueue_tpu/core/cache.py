@@ -326,6 +326,11 @@ class Cache:
         self.cohort_specs: Dict[str, "CohortSpec"] = {}
         self.resource_flavors: Dict[str, ResourceFlavor] = {}
         self.local_queues: Dict[str, LocalQueue] = {}
+        # Per-LocalQueue usage stats, maintained incrementally on every
+        # workload add/delete (cache.go:607-658 keeps LocalQueueUsage the
+        # same way) so LocalQueue status reads are O(1) instead of a
+        # workload scan under the cache lock.
+        self._lq_stats: Dict[str, dict] = {}
         self.assumed_workloads: Dict[str, str] = {}  # wl key -> cq name
         # Bumped on every *structural* change (ClusterQueue specs, cohort
         # specs, flavors) but NOT on workload churn. The batched solver's
@@ -418,10 +423,57 @@ class Cache:
     def add_local_queue(self, lq: LocalQueue) -> None:
         with self._lock:
             self.local_queues[lq.key] = lq
+            # Adopt already-accounted workloads into the stats (one scan
+            # at LQ creation; afterwards maintenance is incremental).
+            stats = self._fresh_lq_stats()
+            self._lq_stats[lq.key] = stats
+            cq = self.cluster_queues.get(lq.cluster_queue)
+            if cq is not None:
+                for wi in cq.workloads.values():
+                    if wi.obj.namespace == lq.namespace \
+                            and wi.obj.queue_name == lq.name:
+                        self._lq_apply(stats, wi, 1)
 
     def delete_local_queue(self, lq: LocalQueue) -> None:
         with self._lock:
             self.local_queues.pop(lq.key, None)
+            self._lq_stats.pop(lq.key, None)
+
+    @staticmethod
+    def _fresh_lq_stats() -> dict:
+        return {"reserving": 0, "admitted": 0,
+                "reservation": {}, "admitted_usage": {},
+                "admitted_keys": set()}
+
+    @staticmethod
+    def _lq_apply(stats: dict, wi: WorkloadInfo, sign: int) -> None:
+        stats["reserving"] += sign
+        for flv, res, v in wi.usage_triples:
+            f = stats["reservation"].setdefault(flv, {})
+            f[res] = f.get(res, 0) + sign * v
+        # The admitted split is keyed: a workload whose Admitted condition
+        # flips between accounting and release must subtract exactly what
+        # it added.
+        key = wi.key
+        if sign > 0:
+            counted = wi.obj.is_admitted
+            if counted:
+                stats["admitted_keys"].add(key)
+        else:
+            counted = key in stats["admitted_keys"]
+            if counted:
+                stats["admitted_keys"].discard(key)
+        if counted:
+            stats["admitted"] += sign
+            for flv, res, v in wi.usage_triples:
+                f = stats["admitted_usage"].setdefault(flv, {})
+                f[res] = f.get(res, 0) + sign * v
+
+    def _lq_note(self, wi: WorkloadInfo, sign: int) -> None:
+        key = f"{wi.obj.namespace}/{wi.obj.queue_name}"
+        stats = self._lq_stats.get(key)
+        if stats is not None:
+            self._lq_apply(stats, wi, sign)
 
     def cluster_queue_for(self, wl: Workload) -> Optional[str]:
         lq = self.local_queues.get(f"{wl.namespace}/{wl.queue_name}")
@@ -439,6 +491,7 @@ class Cache:
                 return False
             wi = WorkloadInfo(wl, cluster_queue=cq.name)
             cq.add_workload_usage(wi, admitted=wl.is_admitted)
+            self._lq_note(wi, 1)
             return True
 
     def delete_workload(self, wl: Workload) -> Optional[WorkloadInfo]:
@@ -461,6 +514,7 @@ class Cache:
         if cq is not None and key in cq.workloads:
             wi = cq.workloads[key]
             cq.remove_workload_usage(wi, admitted=wl.is_admitted)
+            self._lq_note(wi, -1)
             # Quota was freed: resume states against this CQ are now stale.
             cq.allocatable_generation += 1
             released = wi
@@ -482,6 +536,7 @@ class Cache:
                 raise ValueError(f"ClusterQueue {wl.admission.cluster_queue} not found")
             wi = WorkloadInfo(wl, cluster_queue=cq.name)
             cq.add_workload_usage(wi, admitted=wl.is_admitted)
+            self._lq_note(wi, 1)
             self.assumed_workloads[key] = cq.name
             return wi
 
@@ -503,6 +558,25 @@ class Cache:
     def usage(self, cq_name: str) -> FlavorResourceQuantities:
         with self._lock:
             return frq_clone(self.cluster_queues[cq_name].usage)
+
+    def local_queue_status(self, lq_key: str) -> Optional[dict]:
+        """Per-LocalQueue usage stats for the LQ reconciler's status
+        (reference: cache.go:607-658 LocalQueueUsage — reserving/admitted
+        workload counts plus per-flavor reservation and admitted usage).
+        O(flavors) — maintained incrementally on workload add/delete, so
+        status reads never scan workloads under the cache lock."""
+        with self._lock:
+            if lq_key not in self.local_queues:
+                return None
+            stats = self._lq_stats.get(lq_key)
+            if stats is None:
+                stats = self._fresh_lq_stats()
+            return {
+                "reservingWorkloads": stats["reserving"],
+                "admittedWorkloads": stats["admitted"],
+                "flavorsReservation": frq_clone(stats["reservation"]),
+                "flavorUsage": frq_clone(stats["admitted_usage"]),
+            }
 
     # -- snapshot ------------------------------------------------------------
 

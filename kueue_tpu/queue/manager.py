@@ -425,3 +425,19 @@ class Manager:
         with self._cond:
             cq = self.cluster_queues.get(cq_name)
             return cq.pending if cq else 0
+
+    def pending_in_local_queue(self, namespace: str, name: str) -> int:
+        """Pending count scoped to one LocalQueue (the LQ status's
+        pendingWorkloads, localqueue_controller.go status sync)."""
+        with self._cond:
+            lq = self.local_queues.get(f"{namespace}/{name}")
+            if lq is None:
+                return 0
+            cq = self.cluster_queues.get(lq.cluster_queue)
+            if cq is None:
+                return 0
+            return sum(
+                1
+                for wi in list(cq.heap.items()) + list(cq.inadmissible.values())
+                if wi.obj.namespace == namespace
+                and wi.obj.queue_name == name)

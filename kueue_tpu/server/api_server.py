@@ -182,6 +182,21 @@ class _Handler(BaseHTTPRequestHandler):
                     if doc is None:
                         self._error(404, f"{kind} {name} not found")
                     else:
+                        if kind == "LocalQueue":
+                            # LocalQueue status derives from workload
+                            # churn, not LQ writes — enrich on read from
+                            # the cache (its own lock; no runtime-lock
+                            # wait). reference: localqueue_controller.go
+                            # status sync from cache.go:607-658.
+                            lq_ns = ns or "default"
+                            status = self.api.fw.cache.local_queue_status(
+                                f"{lq_ns}/{name}")
+                            if status is not None:
+                                doc = dict(doc)
+                                status["pendingWorkloads"] = \
+                                    self.api.fw.queues.pending_in_local_queue(
+                                        lq_ns, name)
+                                doc["status"] = status
                         self._send_json(doc)
         except BrokenPipeError:
             pass

@@ -263,3 +263,21 @@ class TestWatch:
         conds = {c["type"]: c["status"]
                  for c in events[2]["object"]["status"]["conditions"]}
         assert conds["Admitted"] == "True"
+
+
+class TestLocalQueueStatus:
+    def test_lq_get_reports_usage_and_counts(self, served):
+        """LocalQueue GET carries the reconciler-maintained status
+        (cache.go:607-658: reserving/admitted counts, flavor usage,
+        pending count)."""
+        server, fw, store, adapter = served
+        _post(server.url + "/apis/kueue.x-k8s.io/v1beta1"
+              "/namespaces/default/workloads", WL_DOC)
+        adapter.tick()
+        doc = _get(server.url + "/apis/kueue.x-k8s.io/v1beta1"
+                   "/namespaces/default/localqueues/main")
+        status = doc["status"]
+        assert status["reservingWorkloads"] == 1
+        assert status["admittedWorkloads"] == 1
+        assert status["pendingWorkloads"] == 0
+        assert status["flavorUsage"]["default"]["cpu"] > 0

@@ -133,3 +133,49 @@ def test_lending_limit_cohort_usage():
     assert cqa.used_cohort_quota("default", "cpu") == 8000
     cqb = snap.cluster_queues["cq-b"]
     assert cqb.used_cohort_quota("default", "cpu") == 2000
+
+
+def test_local_queue_status_incremental():
+    """Per-LQ stats stay exact across assume -> admitted-flip -> release
+    (the keyed admitted split of Cache._lq_apply)."""
+    from tests.util import fq, make_cq, make_flavor, make_lq
+
+    cache = Cache()
+    cache.add_or_update_resource_flavor(make_flavor("default"))
+    cache.add_cluster_queue(make_cq("cq", rg("cpu", fq("default", cpu=8))))
+    cache.add_local_queue(make_lq("main", cq="cq"))
+
+    wl = make_wl("w", "main", cpu=2)
+    wl.admission = Admission(
+        cluster_queue="cq",
+        pod_set_assignments=[PodSetAssignment(
+            name="main", flavors={"cpu": "default"},
+            resource_usage={"cpu": 2000}, count=1)])
+    wl.set_condition("QuotaReserved", True)
+    cache.assume_workload(wl)          # reserved, NOT admitted yet
+    st = cache.local_queue_status("default/main")
+    assert st["reservingWorkloads"] == 1 and st["admittedWorkloads"] == 0
+    assert st["flavorsReservation"] == {"default": {"cpu": 2000}}
+    assert st["flavorUsage"] == {}
+
+    # Admitted flips AFTER accounting; the release must still subtract
+    # exactly what was added (no negative admitted counts).
+    wl.set_condition("Admitted", True)
+    assert cache.delete_workload(wl) is not None
+    st = cache.local_queue_status("default/main")
+    assert st["reservingWorkloads"] == 0 and st["admittedWorkloads"] == 0
+    assert st["flavorsReservation"] == {"default": {"cpu": 0}}
+
+    # Late-created LQ adopts existing accounted workloads.
+    wl2 = make_wl("w2", "late", cpu=1)
+    wl2.admission = Admission(
+        cluster_queue="cq",
+        pod_set_assignments=[PodSetAssignment(
+            name="main", flavors={"cpu": "default"},
+            resource_usage={"cpu": 1000}, count=1)])
+    wl2.set_condition("QuotaReserved", True)
+    wl2.set_condition("Admitted", True)
+    cache.add_or_update_workload(wl2)
+    cache.add_local_queue(make_lq("late", cq="cq"))
+    st = cache.local_queue_status("default/late")
+    assert st["reservingWorkloads"] == 1 and st["admittedWorkloads"] == 1
