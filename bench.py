@@ -133,14 +133,21 @@ def run_config(*, label, num_cqs, num_cohorts, num_flavors, backlog, ticks,
 
     phases = REGISTRY.tick_phase_seconds
     phase_base = dict(phases.sums)
+    verbose = os.environ.get("KUEUE_BENCH_VERBOSE") == "1"
     times = []
+    tick_phases = []
     admitted = 0
     base_admitted = fw.scheduler.metrics.admitted
     for _ in range(ticks):
         tick_no[0] += 1
+        if verbose:
+            before = dict(phases.sums)
         t = time.perf_counter()
         fw.tick()
         times.append(time.perf_counter() - t)
+        if verbose:
+            tick_phases.append({k[0]: phases.sums[k] - before.get(k, 0.0)
+                                for k in phases.sums})
         churn()
         if tick_no[0] % 20 == 0:
             gc.collect()   # idle-window cycle reaping (untimed)
@@ -167,6 +174,12 @@ def run_config(*, label, num_cqs, num_cohorts, num_flavors, backlog, ticks,
         f"# [{label}] phase means/tick: "
         + "  ".join(f"{k}={v:.1f}ms" for k, v in phase_means.items()),
         file=sys.stderr)
+    if verbose:
+        for i, (ms, row) in enumerate(zip(times_ms, tick_phases)):
+            print(f"# [{label}] tick {i:3d} {ms:7.1f}ms  "
+                  + "  ".join(f"{k}={v * 1000:.1f}"
+                              for k, v in sorted(row.items())),
+                  file=sys.stderr)
     return p50, p99
 
 

@@ -224,6 +224,10 @@ class Framework:
             limitrange_mod.adjust_resources(
                 wl, self.limit_ranges.get(wl.namespace, []),
                 self.runtime_classes)
+            # adjust_resources mutates pod templates in place (overhead,
+            # folded defaults) without replacing wl.pod_sets — drop the
+            # validation memo so the next nomination re-validates.
+            wl._resval_memo = None
             self.queues.add_or_update_workload(wl)
         self.queues.queue_inadmissible_workloads(
             list(self.queues.cluster_queues))
@@ -238,15 +242,25 @@ class Framework:
 
     def _validate_workload_resources(self, wl: Workload) -> List[str]:
         """Nomination-time gate (scheduler.go validateResources +
-        validateLimitRange)."""
-        reasons = limitrange_mod.validate_limits_fit_requests(wl)
+        validateLimitRange).
+
+        Memoized per workload: a parked head re-validates every tick at
+        north-star scale, but the outcome only depends on the pod-set
+        specs (replaced wholesale on API updates — the memo keys on list
+        identity) and the namespace's folded LimitRange summary (replaced
+        on LimitRange writes — identity again)."""
         summary = self._ns_summary(wl.namespace)
+        memo = getattr(wl, "_resval_memo", None)
+        if memo is not None and memo[0] is wl.pod_sets and memo[1] is summary:
+            return memo[2]
+        reasons = limitrange_mod.validate_limits_fit_requests(wl)
         if summary:
             for i, ps in enumerate(wl.pod_sets):
                 if ps.template is None:
                     continue
                 reasons += summary.validate_pod_template(
                     ps.template, path=f"podSets[{i}].template")
+        wl._resval_memo = (wl.pod_sets, summary, reasons)
         return reasons
 
     def create_admission_check(self, ac: "AdmissionCheck") -> None:

@@ -287,31 +287,58 @@ class CachedClusterQueue:
             if after > 0:
                 fusage[res] += after
 
+    def _apply_usage(self, wi: WorkloadInfo, m: int, cohort_too: bool,
+                     admitted: bool) -> None:
+        """One fused walk over the workload's usage triples updating the
+        CQ usage, the admitted split, and (non-lending) the cohort usage
+        together — this runs once per assume/forget/preemption-simulation
+        step and the separate walks dominated the admit phase otherwise.
+        The lending-limit cohort path stays a second walk because its
+        before/after clamps must observe the fully-updated own usage
+        (clusterqueue.go:487-508)."""
+        triples = wi.usage_triples
+        usage = self.usage
+        adm = self.admitted_usage if admitted else None
+        cohort = self.cohort if cohort_too else None
+        if cohort is not None and features.enabled(features.LENDING_LIMIT):
+            for flv, res, v in triples:
+                fus = usage.get(flv)
+                if fus is not None and res in fus:
+                    fus[res] += v * m
+                if adm is not None:
+                    f2 = adm.get(flv)
+                    if f2 is not None and res in f2:
+                        f2[res] += v * m
+            self._update_cohort_usage(wi, m)
+            return
+        cus = cohort.usage if cohort is not None else None
+        for flv, res, v in triples:
+            d = v * m
+            fus = usage.get(flv)
+            if fus is not None and res in fus:
+                fus[res] += d
+            if adm is not None:
+                f2 = adm.get(flv)
+                if f2 is not None and res in f2:
+                    f2[res] += d
+            if cus is not None:
+                f3 = cus.get(flv)
+                if f3 is not None and res in f3:
+                    f3[res] += d
+
     def add_workload_usage(self, wi: WorkloadInfo, *, cohort_too: bool = False,
                            admitted: bool = False) -> None:
         self.workloads[wi.key] = wi
         self.usage_version += 1
-        self._update_usage(wi, self.usage, 1)
-        if admitted:
-            self._update_usage(wi, self.admitted_usage, 1)
-        if cohort_too and self.cohort is not None:
-            if features.enabled(features.LENDING_LIMIT):
-                self._update_cohort_usage(wi, 1)
-            else:
-                self._update_usage(wi, self.cohort.usage, 1)
+        self._apply_usage(wi, 1, cohort_too and self.cohort is not None,
+                          admitted)
 
     def remove_workload_usage(self, wi: WorkloadInfo, *, cohort_too: bool = False,
                               admitted: bool = False) -> None:
         self.workloads.pop(wi.key, None)
         self.usage_version += 1
-        self._update_usage(wi, self.usage, -1)
-        if admitted:
-            self._update_usage(wi, self.admitted_usage, -1)
-        if cohort_too and self.cohort is not None:
-            if features.enabled(features.LENDING_LIMIT):
-                self._update_cohort_usage(wi, -1)
-            else:
-                self._update_usage(wi, self.cohort.usage, -1)
+        self._apply_usage(wi, -1, cohort_too and self.cohort is not None,
+                          admitted)
 
 
 class Cache:
@@ -399,6 +426,12 @@ class Cache:
             if cq is None:
                 return
             self.structure_version += 1
+            # Release the accounted workloads from their LocalQueue stats:
+            # with the CQ gone, a later delete_workload can no longer find
+            # them to subtract (the reference recomputes LQ usage from the
+            # live cache, cache.go:607-658).
+            for wi in cq.workloads.values():
+                self._lq_note(wi, -1)
             if cq.cohort is not None:
                 cq.cohort.members.discard(cq)
                 if not cq.cohort.members:
@@ -472,8 +505,17 @@ class Cache:
     def _lq_note(self, wi: WorkloadInfo, sign: int) -> None:
         key = f"{wi.obj.namespace}/{wi.obj.queue_name}"
         stats = self._lq_stats.get(key)
-        if stats is not None:
-            self._lq_apply(stats, wi, sign)
+        if stats is None:
+            return
+        # Only workloads accounted in the LQ's own ClusterQueue count:
+        # adoption (add_local_queue) scans that CQ alone, so adds and
+        # subtracts must apply the same filter or a delete-and-recreate
+        # pointing at a new CQ would go negative when an old-CQ workload
+        # releases (cache.go:607-658 recomputes from the LQ's CQ).
+        lq = self.local_queues.get(key)
+        if lq is None or lq.cluster_queue != wi.cluster_queue:
+            return
+        self._lq_apply(stats, wi, sign)
 
     def cluster_queue_for(self, wl: Workload) -> Optional[str]:
         lq = self.local_queues.get(f"{wl.namespace}/{wl.queue_name}")
@@ -539,6 +581,35 @@ class Cache:
             self._lq_note(wi, 1)
             self.assumed_workloads[key] = cq.name
             return wi
+
+    def assume_workloads(self, wls) -> list:
+        """Bulk assume under ONE lock acquisition: the admission cycle
+        commits all of a tick's admissions at cycle end (the cycle's fit
+        math runs against the frozen snapshot plus its own side-tracked
+        reservations, so nothing in-cycle reads the cache — see
+        scheduler._flush_assumes). Returns one entry per workload: the
+        accounted WorkloadInfo on success, an error string otherwise."""
+        out = []
+        with self._lock:
+            for wl in wls:
+                if wl.admission is None:
+                    out.append("workload has no admission")
+                    continue
+                key = wl.key
+                if key in self.assumed_workloads:
+                    out.append(f"workload {key} already assumed")
+                    continue
+                cq = self.cluster_queues.get(wl.admission.cluster_queue)
+                if cq is None:
+                    out.append(
+                        f"ClusterQueue {wl.admission.cluster_queue} not found")
+                    continue
+                wi = WorkloadInfo(wl, cluster_queue=cq.name)
+                cq.add_workload_usage(wi, admitted=wl.is_admitted)
+                self._lq_note(wi, 1)
+                self.assumed_workloads[key] = cq.name
+                out.append(wi)
+        return out
 
     def forget_workload(self, wl: Workload) -> None:
         with self._lock:

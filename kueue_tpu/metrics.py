@@ -9,6 +9,7 @@ metric names and label sets mirror the reference
 
 from __future__ import annotations
 
+import bisect
 import threading
 from collections import defaultdict
 from typing import Dict, Iterable, List, Optional, Tuple
@@ -81,12 +82,10 @@ class Histogram(_Metric):
     def observe(self, *labels, value: float) -> None:
         key = tuple(labels)
         with self._lock:
-            if key not in self.counts:
-                self.counts[key] = [0] * (len(self.buckets) + 1)
-            i = 0
-            while i < len(self.buckets) and value > self.buckets[i]:
-                i += 1
-            self.counts[key][i] += 1
+            counts = self.counts.get(key)
+            if counts is None:
+                counts = self.counts[key] = [0] * (len(self.buckets) + 1)
+            counts[bisect.bisect_left(self.buckets, value)] += 1
             self.sums[key] += value
             self.totals[key] += 1
 

@@ -768,6 +768,12 @@ class BatchSolver:
         if self._usage_enc is not None:
             self._usage_enc.apply_delta(cq_name, usage_frq, 1)
 
+    def note_admissions(self, items) -> None:
+        """Bulk twin of note_admission for the end-of-cycle commit:
+        [(cq_name, usage_frq)] folded in one scatter-add."""
+        if self._usage_enc is not None:
+            self._usage_enc.apply_delta_batch(items, 1)
+
     def note_removal(self, cq_name: str, usage_frq) -> None:
         if self._usage_enc is not None:
             self._usage_enc.apply_delta(cq_name, usage_frq, -1)

@@ -71,6 +71,7 @@ struct Names {
   PyObject* pods;           // "pods" resource name
   PyObject* msg_no_quota;   // "insufficient unused quota"
   PyObject* msg_no_fit;     // "insufficient quota or no eligible flavor"
+  PyObject* mode_memo;      // "_mode" lazy representative_mode memo slot
 };
 Names N;
 
@@ -187,6 +188,7 @@ PyObject* decode(PyObject*, PyObject* args) {
     if (usage == nullptr || !set_keep(a, N.pod_sets, pod_sets) ||
         !set_keep(a, N.usage, usage) ||
         !set_keep(a, N.borrowing, Py_False) ||
+        !set_keep(a, N.mode_memo, Py_None) ||
         !set_keep(a, N.last_state, acqs)) {
       Py_XDECREF(usage);
       Py_XDECREF(pod_sets);
@@ -249,6 +251,7 @@ PyObject* decode(PyObject*, PyObject* args) {
                     set_keep(psa, N.flavors, flavors) &&
                     set_keep(psa, N.requests, requests) &&
                     set_steal(psa, N.count, count) &&
+                    set_keep(psa, N.mode_memo, Py_None) &&
                     set_keep(psa, N.error, Py_None);
       bool ok_here = ok_row[p] != 0;
       if (ok_psa) {
@@ -394,6 +397,7 @@ PyMODINIT_FUNC PyInit__kueue_decode(void) {
   N.reasons = PyUnicode_InternFromString("reasons");
   N.error = PyUnicode_InternFromString("error");
   N.mode = PyUnicode_InternFromString("mode");
+  N.mode_memo = PyUnicode_InternFromString("_mode");
   N.tried_flavor_idx = PyUnicode_InternFromString("tried_flavor_idx");
   N.borrow = PyUnicode_InternFromString("borrow");
   N.last_tried_flavor_idx = PyUnicode_InternFromString("last_tried_flavor_idx");

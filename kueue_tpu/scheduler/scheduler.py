@@ -437,7 +437,7 @@ class Scheduler:
         cycle_root_usage: Dict[str, FlavorResourceQuantities] = {}
         cycle_cohorts_skip_preemption: Set[str] = set()
         preempting: List = []
-        admitted = 0
+        pending_assumes: List = []
         # Deferred victim searches, pre-batched for the entries most likely
         # to reach the issue branch — the first PREEMPT entry per cohort
         # root (and every cohortless one) in cycle order. The snapshot is
@@ -583,10 +583,10 @@ class Scheduler:
                         cycle_cohorts_skip_preemption.add(cq.cohort.root().name)
                 continue
             e.status = NOMINATED
-            if self._admit(e, cq):
-                admitted += 1
+            self._admit(e, cq, pending_assumes)
             if cq.cohort is not None:
                 cycle_cohorts_skip_preemption.add(cq.cohort.root().name)
+        admitted = self._flush_assumes(pending_assumes)
         for e, cq in preempting:
             self._issue_preemptions(e, cq)
         return admitted
@@ -610,8 +610,13 @@ class Scheduler:
         if err is not None:
             raise err
 
-    def _admit(self, e: Entry, cq: CachedClusterQueue) -> bool:
-        """scheduler.go admit (:493-541): assume in cache, then apply."""
+    def _admit(self, e: Entry, cq: CachedClusterQueue, pending: list) -> bool:
+        """scheduler.go admit (:493-541), split for the batched commit:
+        the per-entry phase reserves on the workload object (admission +
+        conditions) and runs the apply callback; the cache/mirror/solver
+        accounting is deferred to ONE bulk commit at cycle end
+        (_flush_assumes) — sound because nothing in-cycle reads the cache
+        (fit math runs on the frozen snapshot plus cycle_cohorts_usage)."""
         wl = e.info.obj
         admission = Admission(
             cluster_queue=e.info.cluster_queue,
@@ -632,12 +637,13 @@ class Scheduler:
         if evicted_cond is not None and evicted_cond.status:
             wait_started = evicted_cond.last_transition_time
         wl.admission = admission
+        now = self.clock()
         wl.set_condition("QuotaReserved", True, reason="QuotaReserved",
-                         now=self.clock())
-        if wl.is_evicted:
+                         now=now)
+        if evicted_cond is not None and evicted_cond.status:
             # A readmitted workload is no longer evicted.
             wl.set_condition("Evicted", False, reason="QuotaReserved",
-                             now=self.clock())
+                             now=now)
         # Admitted syncs at admit time when the workload carries every
         # check the CQ requires AND all of its recorded check states are
         # Ready (scheduler.go:502-505 HasAllChecks + SyncAdmittedCondition
@@ -645,44 +651,69 @@ class Scheduler:
         if cq.admission_checks <= set(wl.admission_check_states) and all(
                 s.state == "Ready"
                 for s in wl.admission_check_states.values()):
-            wl.set_condition("Admitted", True, reason="Admitted", now=self.clock())
-        note_admit = getattr(self.batch_solver, "note_admission", None)
-        note_forget = getattr(self.batch_solver, "note_removal", None)
-        try:
-            assumed = self.cache.assume_workload(wl)
-            self._mirror.note_admission(wl, assumed)
-            if note_admit is not None:
-                # Mirror EXACTLY what the cache accounted: for partial
-                # admission that is the spec-count totals (scaled back up,
-                # workload.go:230-234 — the job integration later reclaims
-                # the difference), not the reduced assignment usage.
-                note_admit(e.info.cluster_queue, assumed.usage())
-        except ValueError as err:
-            wl.admission = None
-            wl.set_condition("QuotaReserved", False, reason="Pending",
-                             message=str(err), now=self.clock())
-            e.inadmissible_msg = f"Failed to admit workload: {err}"
-            return False
-        e.status = ASSUMED
-        ok = self.apply_admission(wl)
-        if not ok:
-            self.cache.forget_workload(wl)
-            self._mirror.note_removal(wl)
-            if note_forget is not None:
-                note_forget(e.info.cluster_queue, assumed.usage())
-            # Roll the reservation back off the object so it can requeue
-            # (the reference applies admission to a deep copy instead).
-            wl.admission = None
-            wl.set_condition("QuotaReserved", False, reason="Pending",
-                             message="admission apply failed", now=self.clock())
-            e.status = NOMINATED
-            self._requeue_and_update(e)
-            return False
-        self.metrics.admitted += 1
-        REGISTRY.admitted_workloads_total.inc(e.info.cluster_queue)
-        REGISTRY.admission_wait_time_seconds.observe(
-            e.info.cluster_queue, value=max(0.0, self.clock() - wait_started))
+            wl.set_condition("Admitted", True, reason="Admitted", now=now)
+        pending.append((e, wait_started))
         return True
+
+    def _flush_assumes(self, pending: list) -> int:
+        """End-of-cycle bulk commit of every reserved entry: one locked
+        cache pass, then the apply callback per success (assume-before-
+        apply, exactly the reference's admit() order), queued mirror
+        deltas, one scatter-add into the solver usage tensor, metrics.
+        Returns how many actually assumed."""
+        if not pending:
+            return 0
+        results = self.cache.assume_workloads(
+            [e.info.obj for e, _ in pending])
+        now = self.clock()
+        note_items = []
+        admitted = 0
+        wait_hist = REGISTRY.admission_wait_time_seconds
+        admitted_ctr = REGISTRY.admitted_workloads_total
+        for (e, wait_started), assumed in zip(pending, results):
+            wl = e.info.obj
+            if isinstance(assumed, str):
+                # Defensive (duplicate assume / CQ deleted mid-tick):
+                # identical rollback to the old per-entry assume failure.
+                wl.admission = None
+                wl.set_condition("QuotaReserved", False, reason="Pending",
+                                 message=assumed, now=now)
+                e.status = NOMINATED
+                e.inadmissible_msg = f"Failed to admit workload: {assumed}"
+                continue
+            if not self.apply_admission(wl):
+                # Roll the assume and the reservation back so it can
+                # requeue (the reference applies admission to a deep copy
+                # instead); the mirror/solver never saw this admission.
+                self.cache.forget_workload(wl)
+                wl.admission = None
+                wl.set_condition("QuotaReserved", False, reason="Pending",
+                                 message="admission apply failed", now=now)
+                e.status = NOMINATED
+                self._requeue_and_update(e)
+                continue
+            e.status = ASSUMED
+            self._mirror.note_admission(wl, assumed)
+            # Mirror EXACTLY what the cache accounted: for partial
+            # admission that is the spec-count totals (scaled back up,
+            # workload.go:230-234 — the job integration later reclaims
+            # the difference), not the reduced assignment usage.
+            note_items.append((e.info.cluster_queue, assumed.usage()))
+            admitted += 1
+            self.metrics.admitted += 1
+            admitted_ctr.inc(e.info.cluster_queue)
+            wait_hist.observe(e.info.cluster_queue,
+                              value=max(0.0, now - wait_started))
+        if note_items:
+            bulk = getattr(self.batch_solver, "note_admissions", None)
+            if bulk is not None:
+                bulk(note_items)
+            else:
+                single = getattr(self.batch_solver, "note_admission", None)
+                if single is not None:
+                    for cq_name, frq in note_items:
+                        single(cq_name, frq)
+        return admitted
 
     # -- requeue (scheduler.go:590-607) --------------------------------------
 

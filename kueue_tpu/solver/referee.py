@@ -42,14 +42,24 @@ class PodSetAssignmentResult:
     error: Optional[str] = None
     requests: Dict[str, int] = field(default_factory=dict)
     count: int = 0
+    # Lazily memoized representative_mode: assigners (referee /
+    # flavor_fit decode) finish mutating before any property read, and
+    # nothing mutates a result afterwards — the scheduler reads the mode
+    # several times per entry per tick on the hot path.
+    _mode: Optional[int] = field(default=None, init=False, repr=False)
 
     @property
     def representative_mode(self) -> int:
-        if self.error is None and not self.reasons:
-            return FIT
-        if not self.flavors:
-            return NO_FIT
-        return min(fa.mode for fa in self.flavors.values())
+        mode = self._mode
+        if mode is None:
+            if self.error is None and not self.reasons:
+                mode = FIT
+            elif not self.flavors:
+                mode = NO_FIT
+            else:
+                mode = min(fa.mode for fa in self.flavors.values())
+            self._mode = mode
+        return mode
 
 
 @dataclass(slots=True)
@@ -58,13 +68,19 @@ class Assignment:
     borrowing: bool = False
     usage: FlavorResourceQuantities = field(default_factory=dict)
     last_state: Optional[AssignmentClusterQueueState] = None
+    _mode: Optional[int] = field(default=None, init=False, repr=False)
 
     @property
     def representative_mode(self) -> int:
         """Worst mode across pod sets (flavorassigner.go:61-78)."""
-        if not self.pod_sets:
-            return NO_FIT
-        return min(ps.representative_mode for ps in self.pod_sets)
+        mode = self._mode
+        if mode is None:
+            if not self.pod_sets:
+                mode = NO_FIT
+            else:
+                mode = min(ps.representative_mode for ps in self.pod_sets)
+            self._mode = mode
+        return mode
 
     def message(self) -> str:
         parts = []

@@ -515,6 +515,44 @@ class UsageEncoder:
         if self._versions[ci] is not None:
             self._versions[ci] += 1
 
+    def apply_delta_batch(self, items, sign: int = 1) -> None:
+        """Fold a whole cycle's workload usages ([(cq_name, frq)]) into
+        the tensor with ONE scatter-add — the bulk twin of apply_delta
+        for the end-of-cycle admission commit."""
+        enc = self.enc
+        cq_index = enc.cq_index
+        f_index = enc.flavor_index
+        r_index = enc.resource_index
+        configured = enc.configured
+        cis: list = []
+        fis: list = []
+        ris: list = []
+        vals: list = []
+        versions = self._versions
+        for cq_name, frq in items:
+            ci = cq_index.get(cq_name)
+            if ci is None:
+                continue
+            conf = configured[ci]
+            # One version bump per workload, matching the cache's
+            # usage_version bump per assume — the refresh compares the
+            # two for the row-skip fast path.
+            if versions[ci] is not None:
+                versions[ci] += 1
+            for fname, resources in frq.items():
+                fi = f_index.get(fname)
+                if fi is None:
+                    continue
+                for rname, val in resources.items():
+                    ri = r_index.get(rname)
+                    if ri is not None and conf[fi, ri]:
+                        cis.append(ci)
+                        fis.append(fi)
+                        ris.append(ri)
+                        vals.append(sign * val)
+        if cis:
+            np.add.at(self.usage, (cis, fis, ris), vals)
+
     def apply_batch(self, delta: np.ndarray, cq_indices: np.ndarray) -> None:
         """Fold a whole tick's admitted usage (models/flavor_fit.py
         fit_usage_delta) into the tensor: one vectorized add, one version

@@ -95,21 +95,26 @@ phases.sums.clear()
 phases.totals.clear()
 
 PROFILE = os.environ.get("PROFILE") == "1"
+TICK_ONLY = os.environ.get("TICK_ONLY") == "1"
 pr = cProfile.Profile()
 times = []
-if PROFILE:
+if PROFILE and not TICK_ONLY:
     pr.enable()
 phase_rows = []
 for _ in range(TICKS):
     tick_no[0] += 1
     before = dict(phases.sums)
+    if PROFILE and TICK_ONLY:
+        pr.enable()
     t = time.perf_counter()
     fw.tick()
     times.append(time.perf_counter() - t)
+    if PROFILE and TICK_ONLY:
+        pr.disable()
     phase_rows.append({k[0]: phases.sums[k] - before.get(k, 0.0)
                        for k in phases.sums})
     churn()
-if PROFILE:
+if PROFILE and not TICK_ONLY:
     pr.disable()
 
 times_ms = np.array(times) * 1000
@@ -148,6 +153,7 @@ except Exception as e:
     print("introspect fail:", e,
           {k: type(v).__name__ for k, v in vars(qm).items()}, file=sys.stderr)
 if PROFILE:
+    pr.dump_stats("/tmp/tick.prof")
     s = io.StringIO()
     ps = pstats.Stats(pr, stream=s).sort_stats("cumulative")
     ps.print_stats(45)

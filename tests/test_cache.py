@@ -179,3 +179,57 @@ def test_local_queue_status_incremental():
     cache.add_local_queue(make_lq("late", cq="cq"))
     st = cache.local_queue_status("default/late")
     assert st["reservingWorkloads"] == 1 and st["admittedWorkloads"] == 1
+
+
+def test_lq_stats_released_on_cluster_queue_delete():
+    """Deleting a ClusterQueue releases its accounted workloads from the
+    per-LQ stats — a later delete_workload can no longer find the CQ to
+    subtract them (cache.go:607-658 recomputes from the live cache)."""
+    cache = Cache()
+    cache.add_or_update_resource_flavor(make_flavor("default"))
+    cache.add_cluster_queue(make_cq("cq", rg("cpu", fq("default", cpu=8))))
+    cache.add_local_queue(make_lq("main", cq="cq"))
+
+    wl = admit(make_wl("w", "main", cpu=2), "cq", "default")
+    cache.add_or_update_workload(wl)
+    st = cache.local_queue_status("default/main")
+    assert st["reservingWorkloads"] == 1 and st["admittedWorkloads"] == 1
+
+    cache.delete_cluster_queue("cq")
+    st = cache.local_queue_status("default/main")
+    assert st["reservingWorkloads"] == 0 and st["admittedWorkloads"] == 0
+    assert st["flavorsReservation"] == {"default": {"cpu": 0}}
+
+    # The (now CQ-less) workload delete must not double-subtract.
+    cache.delete_workload(wl)
+    st = cache.local_queue_status("default/main")
+    assert st["reservingWorkloads"] == 0 and st["admittedWorkloads"] == 0
+
+
+def test_lq_stats_survive_delete_recreate_to_new_cq():
+    """A LocalQueue deleted and recreated against a DIFFERENT ClusterQueue
+    must not count (or release) workloads accounted in the old CQ — adds
+    and subtracts apply the same owning-CQ filter, so stats never go
+    negative."""
+    from tests.util import make_lq
+
+    cache = Cache()
+    cache.add_or_update_resource_flavor(make_flavor("default"))
+    cache.add_cluster_queue(make_cq("cq-old", rg("cpu", fq("default", cpu=8))))
+    cache.add_cluster_queue(make_cq("cq-new", rg("cpu", fq("default", cpu=8))))
+    cache.add_local_queue(make_lq("main", cq="cq-old"))
+
+    wl = admit(make_wl("w", "main", cpu=2), "cq-old", "default")
+    cache.add_or_update_workload(wl)
+    assert cache.local_queue_status("default/main")["reservingWorkloads"] == 1
+
+    lq_old = cache.local_queues["default/main"]
+    cache.delete_local_queue(lq_old)
+    cache.add_local_queue(make_lq("main", cq="cq-new"))
+    st = cache.local_queue_status("default/main")
+    assert st["reservingWorkloads"] == 0
+
+    # The old-CQ workload releasing must not drive the new stats negative.
+    cache.delete_workload(wl)
+    st = cache.local_queue_status("default/main")
+    assert st["reservingWorkloads"] == 0 and st["admittedWorkloads"] == 0

@@ -205,3 +205,27 @@ class TestEndToEnd:
         assert wl.has_quota_reservation
         assert wl.admission.pod_set_assignments[0].resource_usage[CPU] \
             == cpuq(1)
+
+    def test_late_runtime_class_revalidates_parked_workload(self):
+        """A RuntimeClass created after submit mutates pod templates in
+        place (overhead); the nomination-time validation memo must not
+        keep serving the pre-overhead verdict (scheduler.go
+        validateLimitRange would now reject the pod total)."""
+        fw = self._fw()
+        fw.create_limit_range(LimitRange(
+            namespace="default",
+            items=[LimitRangeItem(type="Pod", max={CPU: cpuq(2)})]))
+        pt = PodTemplate(containers=[Container.make(requests={CPU: 2})],
+                         runtime_class_name="gvisor")
+        wl = Workload(name="w", queue_name="lq",
+                      pod_sets=[PodSet(name="main", count=1, template=pt)])
+        fw.submit(wl)
+        # First nomination: pod total 2 <= max 2 — validation passes (and
+        # memoizes); keep it pending by oversubscribing the request later.
+        assert fw._validate_workload_resources(wl) == []
+        # Overhead pushes the pod total to 2.25 > max 2.
+        fw.create_runtime_class("gvisor", {CPU: cpuq("250m")})
+        reasons = fw._validate_workload_resources(wl)
+        assert reasons, "overhead must re-trigger the LimitRange max gate"
+        fw.run_until_settled()
+        assert not wl.has_quota_reservation
