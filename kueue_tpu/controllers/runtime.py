@@ -719,10 +719,13 @@ class Framework:
             while len(self._inflight_ticks) > max(keep, 0):
                 admitted += self.scheduler.schedule_finish(
                     self._inflight_ticks.pop(0))
+        t_r = _time.perf_counter()
         self.reconcile()
         self.job_reconciler.reconcile()
         if features.enabled(features.QUEUE_VISIBILITY):
             self.queue_visibility.maybe_update(self.clock())
+        REGISTRY.tick_phase_seconds.observe(
+            "reconcile", value=_time.perf_counter() - t_r)
         return admitted
 
     def run_until_settled(self, max_ticks: int = 100) -> int:

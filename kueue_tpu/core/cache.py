@@ -26,6 +26,11 @@ from kueue_tpu.api.types import (
     Workload,
 )
 from kueue_tpu.core.workload import WorkloadInfo
+from kueue_tpu.utils import native_ledger
+
+# Native fused-walk twin of _apply_usage/_lq_apply (kueue_tpu/native/
+# ledger.cpp); None falls back to the pure-Python walks below.
+_ledger = native_ledger.load()
 
 FlavorResourceQuantities = Dict[str, Dict[str, int]]
 
@@ -301,17 +306,23 @@ class CachedClusterQueue:
         adm = self.admitted_usage if admitted else None
         cohort = self.cohort if cohort_too else None
         if cohort is not None and features.enabled(features.LENDING_LIMIT):
-            for flv, res, v in triples:
-                fus = usage.get(flv)
-                if fus is not None and res in fus:
-                    fus[res] += v * m
-                if adm is not None:
-                    f2 = adm.get(flv)
-                    if f2 is not None and res in f2:
-                        f2[res] += v * m
+            if _ledger is not None:
+                _ledger.apply_triples(usage, adm, None, triples, m)
+            else:
+                for flv, res, v in triples:
+                    fus = usage.get(flv)
+                    if fus is not None and res in fus:
+                        fus[res] += v * m
+                    if adm is not None:
+                        f2 = adm.get(flv)
+                        if f2 is not None and res in f2:
+                            f2[res] += v * m
             self._update_cohort_usage(wi, m)
             return
         cus = cohort.usage if cohort is not None else None
+        if _ledger is not None:
+            _ledger.apply_triples(usage, adm, cus, triples, m)
+            return
         for flv, res, v in triples:
             d = v * m
             fus = usage.get(flv)
@@ -481,9 +492,6 @@ class Cache:
     @staticmethod
     def _lq_apply(stats: dict, wi: WorkloadInfo, sign: int) -> None:
         stats["reserving"] += sign
-        for flv, res, v in wi.usage_triples:
-            f = stats["reservation"].setdefault(flv, {})
-            f[res] = f.get(res, 0) + sign * v
         # The admitted split is keyed: a workload whose Admitted condition
         # flips between accounting and release must subtract exactly what
         # it added.
@@ -498,7 +506,17 @@ class Cache:
                 stats["admitted_keys"].discard(key)
         if counted:
             stats["admitted"] += sign
-            for flv, res, v in wi.usage_triples:
+        triples = wi.usage_triples
+        if _ledger is not None:
+            _ledger.lq_apply(stats["reservation"],
+                             stats["admitted_usage"] if counted else None,
+                             triples, sign)
+            return
+        for flv, res, v in triples:
+            f = stats["reservation"].setdefault(flv, {})
+            f[res] = f.get(res, 0) + sign * v
+        if counted:
+            for flv, res, v in triples:
                 f = stats["admitted_usage"].setdefault(flv, {})
                 f[res] = f.get(res, 0) + sign * v
 
@@ -582,16 +600,18 @@ class Cache:
             self.assumed_workloads[key] = cq.name
             return wi
 
-    def assume_workloads(self, wls) -> list:
+    def assume_workloads(self, items) -> list:
         """Bulk assume under ONE lock acquisition: the admission cycle
         commits all of a tick's admissions at cycle end (the cycle's fit
         math runs against the frozen snapshot plus its own side-tracked
         reservations, so nothing in-cycle reads the cache — see
-        scheduler._flush_assumes). Returns one entry per workload: the
-        accounted WorkloadInfo on success, an error string otherwise."""
+        scheduler._flush_assumes). `items` is [(workload, triples)] where
+        triples is the precomputed admission usage flattening (or None to
+        derive lazily). Returns one entry per workload: the accounted
+        WorkloadInfo on success, an error string otherwise."""
         out = []
         with self._lock:
-            for wl in wls:
+            for wl, triples in items:
                 if wl.admission is None:
                     out.append("workload has no admission")
                     continue
@@ -605,6 +625,8 @@ class Cache:
                         f"ClusterQueue {wl.admission.cluster_queue} not found")
                     continue
                 wi = WorkloadInfo(wl, cluster_queue=cq.name)
+                if triples is not None:
+                    wi._usage_triples = triples
                 cq.add_workload_usage(wi, admitted=wl.is_admitted)
                 self._lq_note(wi, 1)
                 self.assumed_workloads[key] = cq.name

@@ -717,11 +717,16 @@ class BatchSolver:
         t0 = _t.perf_counter()
         enc = self._encoding_for(snapshot)
         usage = self._usage_enc.refresh(snapshot)
+        ta = _t.perf_counter()
         wt = sch.encode_workloads(workloads, snapshot, enc,
                                   row_cache=self._row_cache)
+        tb = _t.perf_counter()
         handle = solve_flavor_fit_async(enc, usage, wt, static=self._static)
         t1 = _t.perf_counter()
         phases.observe("tensorize", value=t1 - t0)
+        phases.observe("tensorize.refresh", value=ta - t0)
+        phases.observe("tensorize.encode", value=tb - ta)
+        phases.observe("tensorize.dispatch", value=t1 - tb)
         return {"workloads": list(workloads), "snapshot": snapshot,
                 "enc": enc, "wt": wt, "handle": handle, "dispatched": t1}
 

@@ -586,7 +586,10 @@ class Scheduler:
             self._admit(e, cq, pending_assumes)
             if cq.cohort is not None:
                 cycle_cohorts_skip_preemption.add(cq.cohort.root().name)
+        t_flush = _time.perf_counter()
         admitted = self._flush_assumes(pending_assumes)
+        REGISTRY.tick_phase_seconds.observe(
+            "admit.flush", value=_time.perf_counter() - t_flush)
         for e, cq in preempting:
             self._issue_preemptions(e, cq)
         return admitted
@@ -618,18 +621,30 @@ class Scheduler:
         (_flush_assumes) — sound because nothing in-cycle reads the cache
         (fit math runs on the frozen snapshot plus cycle_cohorts_usage)."""
         wl = e.info.obj
-        admission = Admission(
-            cluster_queue=e.info.cluster_queue,
-            pod_set_assignments=[
-                PodSetAssignment(
-                    name=ps.name,
-                    flavors={r: fa.name for r, fa in ps.flavors.items()},
-                    resource_usage=dict(ps.requests),
-                    count=ps.count,
-                )
-                for ps in e.assignment.pod_sets
-            ],
-        )
+        psas = []
+        # Plant the admission usage flattening only when it matches what
+        # WorkloadInfo._compute_totals would derive: no reclaim scaling
+        # AND no partial-admission count reduction (the cache accounts
+        # SPEC-count totals scaled back up, workload.go:230-234 — the
+        # reduced assignment usage would under-count held quota).
+        spec_counts = {ps.name: ps.count for ps in wl.pod_sets}
+        triples: Optional[list] = [] if not wl.reclaimable_pods else None
+        for ps in e.assignment.pod_sets:
+            flavors = {r: fa.name for r, fa in ps.flavors.items()}
+            requests = dict(ps.requests)
+            psas.append(PodSetAssignment(
+                name=ps.name, flavors=flavors,
+                resource_usage=requests, count=ps.count))
+            if triples is not None:
+                if ps.count != spec_counts.get(ps.name, ps.count):
+                    triples = None
+                    continue
+                for r, q in requests.items():
+                    flv = flavors.get(r)
+                    if flv is not None:
+                        triples.append((flv, r, q))
+        admission = Admission(cluster_queue=e.info.cluster_queue,
+                              pod_set_assignments=psas)
         # Wait time runs from creation, or from the eviction being recovered
         # from (scheduler.go:516-520); capture before clearing Evicted.
         wait_started = wl.creation_time
@@ -652,7 +667,7 @@ class Scheduler:
                 s.state == "Ready"
                 for s in wl.admission_check_states.values()):
             wl.set_condition("Admitted", True, reason="Admitted", now=now)
-        pending.append((e, wait_started))
+        pending.append((e, wait_started, triples))
         return True
 
     def _flush_assumes(self, pending: list) -> int:
@@ -663,14 +678,17 @@ class Scheduler:
         Returns how many actually assumed."""
         if not pending:
             return 0
+        t_a = _time.perf_counter()
         results = self.cache.assume_workloads(
-            [e.info.obj for e, _ in pending])
+            [(e.info.obj, triples) for e, _, triples in pending])
+        REGISTRY.tick_phase_seconds.observe(
+            "admit.flush.assume", value=_time.perf_counter() - t_a)
         now = self.clock()
         note_items = []
         admitted = 0
         wait_hist = REGISTRY.admission_wait_time_seconds
         admitted_ctr = REGISTRY.admitted_workloads_total
-        for (e, wait_started), assumed in zip(pending, results):
+        for (e, wait_started, _), assumed in zip(pending, results):
             wl = e.info.obj
             if isinstance(assumed, str):
                 # Defensive (duplicate assume / CQ deleted mid-tick):

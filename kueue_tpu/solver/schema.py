@@ -737,9 +737,13 @@ def encode_workloads(workloads: Sequence[WorkloadInfo], snapshot: Snapshot,
     """
     n = len(workloads)
     W = pad_to if pad_to is not None else _pad_pow2(max(n, 1))
+    # One pass resolves every workload's totals (memoized property — hoist
+    # so the main loop reads the list, not the property again).
+    all_totals = [wi.total_requests for wi in workloads]
     P = 1
-    for wi in workloads:
-        P = max(P, len(wi.total_requests))
+    for t in all_totals:
+        if len(t) > P:
+            P = len(t)
     R = len(enc.resource_names)
     G = enc.num_groups
     S = enc.num_slots
@@ -754,35 +758,41 @@ def encode_workloads(workloads: Sequence[WorkloadInfo], snapshot: Snapshot,
     wl_valid = np.zeros(W, dtype=bool)
     wl_valid[:n] = True
 
+    cqs_by_name = snapshot.cluster_queues
+    cache_hit = None if row_cache is None else row_cache.get
+    cache_put = None if row_cache is None else row_cache.put
     rows: List[_Row] = []
+    rows_append = rows.append
     p_counts: List[int] = []
+    pc_append = p_counts.append
     for w, wi in enumerate(workloads):
-        cq = snapshot.cluster_queues[wi.cluster_queue]
-        totals = wi.total_requests
+        cq = cqs_by_name[wi.cluster_queue]
+        totals = all_totals[w]
         scaled = counts is not None and counts[w] is not None
         if scaled:
             totals = [totals[i].scaled_to(c) for i, c in enumerate(counts[w])]
 
-        row = None if scaled or row_cache is None else row_cache.get(wi)
+        row = None if scaled or cache_hit is None else cache_hit(wi)
         if row is None:
             row = _encode_row(wi, cq, snapshot, enc, totals)
-            if not scaled and row_cache is not None:
-                row_cache.put(wi, row)
-        rows.append(row)
-        p_counts.append(len(totals))
+            if not scaled and cache_put is not None:
+                cache_put(wi, row)
+        rows_append(row)
+        p_count = len(totals)
+        pc_append(p_count)
 
         # Stale resume state is dropped exactly like the referee
         # (flavorassigner.go:244-247).
         last = wi.last_assignment
         if last is not None:
-            outdated = (cq.allocatable_generation > last.cluster_queue_generation
-                        or (cq.cohort is not None
-                            and cq.cohort.allocatable_generation
-                            > last.cohort_generation))
-            if outdated:
+            cohort = cq.cohort
+            if (cq.allocatable_generation > last.cluster_queue_generation
+                    or (cohort is not None
+                        and cohort.allocatable_generation
+                        > last.cohort_generation)):
                 last = None
         if last is not None:
-            for p in range(p_counts[-1]):
+            for p in range(p_count):
                 requested = row.requests_per_podset[p]
                 for gi, rg in enumerate(cq.resource_groups):
                     # Resume slot for this group: any covered requested

@@ -1,0 +1,46 @@
+"""Loader for the native usage-ledger walks (kueue_tpu/native/ledger.cpp).
+
+Same build-and-cache discipline as native_decode.py; callers fall back to
+the pure-Python walks in kueue_tpu.core.cache when the toolchain or the
+build is unavailable.
+"""
+
+from __future__ import annotations
+
+import importlib.machinery
+import importlib.util
+import threading
+from typing import Optional
+
+from kueue_tpu.utils import native_build
+
+_lock = threading.Lock()
+_mod = None
+_tried = False
+
+
+def load() -> Optional[object]:
+    """The `_kueue_ledger` extension module, or None."""
+    global _mod, _tried
+    with _lock:
+        if _tried:
+            return _mod
+        _tried = True
+        lib = native_build.build("ledger.cpp", "_kueue_ledger.so",
+                                python_ext=True)
+        if lib is None:
+            return None
+        try:
+            loader = importlib.machinery.ExtensionFileLoader(
+                "_kueue_ledger", lib)
+            spec = importlib.util.spec_from_loader("_kueue_ledger", loader)
+            mod = importlib.util.module_from_spec(spec)
+            loader.exec_module(mod)
+        except (ImportError, OSError):
+            return None
+        _mod = mod
+        return _mod
+
+
+def ledger_available() -> bool:
+    return load() is not None

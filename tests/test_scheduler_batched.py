@@ -68,6 +68,10 @@ def test_batched_partial_admission():
     fw.run_until_settled()
     assert fw.admitted_workloads("cq") == ["default/w"]
     assert wl.admission.pod_set_assignments[0].count == 4
+    # The cache accounts SPEC-count totals scaled back up (workload.go:
+    # 230-234) — the job integration reclaims the difference later; the
+    # reduced assignment usage (4000) would under-count held quota.
+    assert fw.cache.usage("cq") == {"default": {"cpu": 8000}}
 
 
 @pytest.mark.parametrize("seed", [3, 11])
