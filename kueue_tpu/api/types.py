@@ -540,7 +540,7 @@ class Workload:
     def key(self) -> str:
         return self._key
 
-    def find_condition(self, ctype: str) -> Optional[Condition]:
+    def _cond_map(self) -> dict:
         # Dict index over the conditions list, rebuilt when the list is
         # appended to or replaced wholesale (decode_workload_status):
         # condition lookups run several times per admission on the hot
@@ -551,10 +551,13 @@ class Workload:
         if memo is None or memo[0] is not conds or memo[1] != len(conds):
             memo = (conds, len(conds), {c.type: c for c in conds})
             self._cond_memo = memo
-        return memo[2].get(ctype)
+        return memo[2]
+
+    def find_condition(self, ctype: str) -> Optional[Condition]:
+        return self._cond_map().get(ctype)
 
     def condition_true(self, ctype: str) -> bool:
-        c = self.find_condition(ctype)
+        c = self._cond_map().get(ctype)
         return c is not None and c.status
 
     def set_condition(self, ctype: str, status: bool, reason: str = "",

@@ -138,6 +138,11 @@ class CachedClusterQueue:
         # moved, replacing the reference's full per-tick snapshot copy cost
         # (snapshot.go:95-129).
         self.usage_version = 0
+        # Mirror dirty sinks (set by the owning Cache; None on snapshot
+        # clones): every usage_version bump records this CQ's name so
+        # SnapshotMirror.refresh visits only moved CQs instead of
+        # version-scanning all of them.
+        self._dirty_sinks = None
         self.has_missing_flavors = False
         self.is_stopped = False
         self.update(spec, resource_flavors)
@@ -178,6 +183,8 @@ class CachedClusterQueue:
         self.usage = new_usage
         self.admitted_usage = new_admitted
         self.usage_version += 1
+        if self._dirty_sinks is not None:
+            self._mark_dirty()
 
         self.update_with_flavors(resource_flavors)
 
@@ -337,10 +344,18 @@ class CachedClusterQueue:
                 if f3 is not None and res in f3:
                     f3[res] += d
 
+    def _mark_dirty(self) -> None:
+        sinks = self._dirty_sinks
+        if sinks is not None:
+            name = self.name
+            for s in sinks:
+                s.add(name)
+
     def add_workload_usage(self, wi: WorkloadInfo, *, cohort_too: bool = False,
                            admitted: bool = False) -> None:
         self.workloads[wi.key] = wi
         self.usage_version += 1
+        self._mark_dirty()
         self._apply_usage(wi, 1, cohort_too and self.cohort is not None,
                           admitted)
 
@@ -348,6 +363,7 @@ class CachedClusterQueue:
                               admitted: bool = False) -> None:
         self.workloads.pop(wi.key, None)
         self.usage_version += 1
+        self._mark_dirty()
         self._apply_usage(wi, -1, cohort_too and self.cohort is not None,
                           admitted)
 
@@ -358,6 +374,9 @@ class Cache:
     def __init__(self):
         self._lock = threading.RLock()
         self.cluster_queues: Dict[str, CachedClusterQueue] = {}
+        # One dirty-name set per registered SnapshotMirror (see
+        # CachedClusterQueue._mark_dirty).
+        self._mirror_dirty_sinks: List[set] = []
         self.cohorts: Dict[str, Cohort] = {}
         # Hierarchical-cohort specs (KEP-79); cohorts named only by
         # ClusterQueue.cohort need no spec and stay flat.
@@ -412,6 +431,16 @@ class Cache:
             for cq in self.cluster_queues.values():
                 cq.update_with_flavors(self.resource_flavors)
 
+    def register_dirty_sink(self, sink: set) -> None:
+        """Subscribe a SnapshotMirror's dirty-name set: every CQ usage
+        mutation adds the CQ's name, replacing the mirror's full version
+        scan with a visit of just the moved CQs."""
+        with self._lock:
+            self._mirror_dirty_sinks.append(sink)
+            for cq in self.cluster_queues.values():
+                cq._dirty_sinks = self._mirror_dirty_sinks
+                sink.add(cq.name)
+
     # -- cluster queues ------------------------------------------------------
 
     def add_cluster_queue(self, spec: ClusterQueue) -> CachedClusterQueue:
@@ -419,6 +448,7 @@ class Cache:
             if spec.name in self.cluster_queues:
                 raise ValueError(f"ClusterQueue {spec.name} already exists")
             cq = CachedClusterQueue(spec, self.resource_flavors)
+            cq._dirty_sinks = self._mirror_dirty_sinks
             self.cluster_queues[spec.name] = cq
             self.structure_version += 1
             self._update_cohort_membership(cq)
@@ -490,14 +520,15 @@ class Cache:
                 "admitted_keys": set()}
 
     @staticmethod
-    def _lq_apply(stats: dict, wi: WorkloadInfo, sign: int) -> None:
+    def _lq_apply(stats: dict, wi: WorkloadInfo, sign: int,
+                  admitted: Optional[bool] = None) -> None:
         stats["reserving"] += sign
         # The admitted split is keyed: a workload whose Admitted condition
         # flips between accounting and release must subtract exactly what
         # it added.
         key = wi.key
         if sign > 0:
-            counted = wi.obj.is_admitted
+            counted = wi.obj.is_admitted if admitted is None else admitted
             if counted:
                 stats["admitted_keys"].add(key)
         else:
@@ -520,7 +551,8 @@ class Cache:
                 f = stats["admitted_usage"].setdefault(flv, {})
                 f[res] = f.get(res, 0) + sign * v
 
-    def _lq_note(self, wi: WorkloadInfo, sign: int) -> None:
+    def _lq_note(self, wi: WorkloadInfo, sign: int,
+                 admitted: Optional[bool] = None) -> None:
         key = f"{wi.obj.namespace}/{wi.obj.queue_name}"
         stats = self._lq_stats.get(key)
         if stats is None:
@@ -533,7 +565,7 @@ class Cache:
         lq = self.local_queues.get(key)
         if lq is None or lq.cluster_queue != wi.cluster_queue:
             return
-        self._lq_apply(stats, wi, sign)
+        self._lq_apply(stats, wi, sign, admitted)
 
     def cluster_queue_for(self, wl: Workload) -> Optional[str]:
         lq = self.local_queues.get(f"{wl.namespace}/{wl.queue_name}")
@@ -595,8 +627,9 @@ class Cache:
             if cq is None:
                 raise ValueError(f"ClusterQueue {wl.admission.cluster_queue} not found")
             wi = WorkloadInfo(wl, cluster_queue=cq.name)
-            cq.add_workload_usage(wi, admitted=wl.is_admitted)
-            self._lq_note(wi, 1)
+            adm = wl.is_admitted
+            cq.add_workload_usage(wi, admitted=adm)
+            self._lq_note(wi, 1, adm)
             self.assumed_workloads[key] = cq.name
             return wi
 
@@ -627,8 +660,9 @@ class Cache:
                 wi = WorkloadInfo(wl, cluster_queue=cq.name)
                 if triples is not None:
                     wi._usage_triples = triples
-                cq.add_workload_usage(wi, admitted=wl.is_admitted)
-                self._lq_note(wi, 1)
+                adm = wl.is_admitted
+                cq.add_workload_usage(wi, admitted=adm)
+                self._lq_note(wi, 1, adm)
                 self.assumed_workloads[key] = cq.name
                 out.append(wi)
         return out

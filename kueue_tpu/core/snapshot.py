@@ -94,6 +94,7 @@ def _snapshot_cq(cq: CachedClusterQueue) -> CachedClusterQueue:
     cc.guaranteed_quota = cq.guaranteed_quota if features.enabled(features.LENDING_LIMIT) else {}
     cc.allocatable_generation = cq.allocatable_generation
     cc.usage_version = cq.usage_version
+    cc._dirty_sinks = None  # snapshot sim mutations never dirty the cache
     cc.has_missing_flavors = cq.has_missing_flavors
     cc.is_stopped = cq.is_stopped
     return cc
@@ -182,6 +183,10 @@ class SnapshotMirror:
         self._snap: Optional[Snapshot] = None
         self._base: Dict[str, int] = {}   # cq name -> mirrored usage_version
         self._key = None
+        # CQ names whose usage moved since the last refresh (fed by the
+        # cache's dirty-sink hook) — the refresh visits only these.
+        self._dirty: set = set()
+        cache.register_dirty_sink(self._dirty)
         # Deferred lockstep mutations: the snapshot must stay FROZEN for
         # the duration of a tick (the admission cycle's cohort bookkeeping
         # counts this cycle's admissions separately, scheduler.go:204-275),
@@ -204,6 +209,7 @@ class SnapshotMirror:
         # tree-global and cheap relative to tree sizes seen in practice.
         if self._snap is None or key != self._key or cache.cohort_specs:
             self._pending.clear()
+            self._dirty.clear()
             self.mutation_count += 1
             self._snap = Snapshot.build(cache)
             self._key = key
@@ -214,8 +220,20 @@ class SnapshotMirror:
         snap = self._snap
         self.flush_pending()
         dirty_cohorts: Dict[str, Cohort] = {}
-        for name, cq in cache.cluster_queues.items():
-            if self._base.get(name) == cq.usage_version:
+        dirty_names = self._dirty
+        if not dirty_names:
+            return snap
+        while dirty_names:
+            # Atomic pop-drain: a concurrent mutator thread re-adding a
+            # name AFTER the pop is preserved for this loop or the next
+            # refresh — list()+clear() could drop a mark added between
+            # the two and leave that CQ permanently stale.
+            try:
+                name = dirty_names.pop()
+            except KeyError:
+                break
+            cq = cache.cluster_queues.get(name)
+            if cq is None or self._base.get(name) == cq.usage_version:
                 continue
             if not cq.active():
                 # Snapshot.build excludes inactive CQs entirely (the

@@ -35,22 +35,25 @@ class Event:
 
 
 class EventRecorder:
-    """Bounded event sink (newest kept, like the apiserver's event TTL)."""
+    """Bounded event sink (newest kept, like the apiserver's event TTL).
+
+    Events are stored as plain tuples and materialized into Event objects
+    only on read: the scheduler emits one per admission/preemption on the
+    hot path, while reads are rare debugging/API traffic."""
 
     def __init__(self, capacity: int = 10_000):
-        self._events: Deque[Event] = deque(maxlen=capacity)
+        self._events: Deque[tuple] = deque(maxlen=capacity)
 
     def event(self, object_key: str, etype: str, reason: str,
               message: str, now: float = 0.0) -> None:
         # Messages are truncated like util/api's event-message cap.
-        self._events.append(Event(etype, reason, message[:1024],
-                                  object_key, now))
+        self._events.append((etype, reason, message[:1024], object_key, now))
 
     def for_object(self, object_key: str,
                    reason: Optional[str] = None) -> List[Event]:
-        return [e for e in self._events
-                if e.object_key == object_key
-                and (reason is None or e.reason == reason)]
+        return [Event(*t) for t in self._events
+                if t[3] == object_key
+                and (reason is None or t[1] == reason)]
 
     def all(self) -> List[Event]:
-        return list(self._events)
+        return [Event(*t) for t in self._events]

@@ -312,16 +312,28 @@ class Manager:
 
     def requeue_workload(self, wi: WorkloadInfo, reason: str) -> bool:
         """manager.go RequeueWorkload; caller must pass a still-pending info."""
+        return self.requeue_workloads([(wi, reason)]) == 1
+
+    def requeue_workloads(self, items) -> int:
+        """Bulk requeue ([(info, reason)]) under one lock with one wakeup —
+        the scheduler's post-cycle sweep returns a few hundred losers per
+        tick at scale."""
+        added = 0
         with self._cond:
-            if wi.obj.has_quota_reservation or wi.obj.is_finished or not wi.obj.active:
-                return False
-            cq = self.cluster_queues.get(wi.cluster_queue)
-            if cq is None:
-                return False
-            added = cq.requeue_if_not_present(wi, reason)
+            cqs = self.cluster_queues
+            for wi, reason in items:
+                wl = wi.obj
+                if wl.has_quota_reservation or wl.is_finished \
+                        or not wl.active:
+                    continue
+                cq = cqs.get(wi.cluster_queue)
+                if cq is None:
+                    continue
+                if cq.requeue_if_not_present(wi, reason):
+                    added += 1
             if added:
                 self._cond.notify_all()
-            return added
+        return added
 
     # -- inadmissible flushes ------------------------------------------------
 

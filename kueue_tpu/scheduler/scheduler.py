@@ -44,7 +44,7 @@ SKIPPED = "skipped"
 ASSUMED = "assumed"
 
 
-@dataclass
+@dataclass(slots=True)
 class Entry:
     info: WorkloadInfo
     assignment: Optional[Assignment] = None
@@ -191,9 +191,7 @@ class Scheduler:
                                          revalidate=stale)
         t3 = _time.perf_counter()
         phases.observe("admit", value=t3 - t2)
-        for e in entries:
-            if e.status != ASSUMED:
-                self._requeue_and_update(e)
+        self._requeue_sweep([e for e in entries if e.status != ASSUMED])
         phases.observe("requeue", value=_time.perf_counter() - t3)
         self.metrics.admission_attempts += 1
         self.metrics.last_tick_seconds = self.clock() - tick.start
@@ -626,8 +624,13 @@ class Scheduler:
         # WorkloadInfo._compute_totals would derive: no reclaim scaling
         # AND no partial-admission count reduction (the cache accounts
         # SPEC-count totals scaled back up, workload.go:230-234 — the
-        # reduced assignment usage would under-count held quota).
-        spec_counts = {ps.name: ps.count for ps in wl.pod_sets}
+        # reduced assignment usage would under-count held quota). The
+        # single-podset common case compares counts directly instead of
+        # building a name map.
+        spec_sets = wl.pod_sets
+        single = len(spec_sets) == 1
+        spec_counts = None if single else {ps.name: ps.count
+                                           for ps in spec_sets}
         triples: Optional[list] = [] if not wl.reclaimable_pods else None
         for ps in e.assignment.pod_sets:
             flavors = {r: fa.name for r, fa in ps.flavors.items()}
@@ -636,7 +639,9 @@ class Scheduler:
                 name=ps.name, flavors=flavors,
                 resource_usage=requests, count=ps.count))
             if triples is not None:
-                if ps.count != spec_counts.get(ps.name, ps.count):
+                spec_count = spec_sets[0].count if single \
+                    else spec_counts.get(ps.name, ps.count)
+                if ps.count != spec_count:
                     triples = None
                     continue
                 for r, q in requests.items():
@@ -663,9 +668,12 @@ class Scheduler:
         # check the CQ requires AND all of its recorded check states are
         # Ready (scheduler.go:502-505 HasAllChecks + SyncAdmittedCondition
         # — a Pending state blocks Admitted even on a checkless CQ).
-        if cq.admission_checks <= set(wl.admission_check_states) and all(
-                s.state == "Ready"
-                for s in wl.admission_check_states.values()):
+        states = wl.admission_check_states
+        if not states:
+            if not cq.admission_checks:
+                wl.set_condition("Admitted", True, reason="Admitted", now=now)
+        elif cq.admission_checks <= states.keys() and all(
+                s.state == "Ready" for s in states.values()):
             wl.set_condition("Admitted", True, reason="Admitted", now=now)
         pending.append((e, wait_started, triples))
         return True
@@ -736,16 +744,33 @@ class Scheduler:
     # -- requeue (scheduler.go:590-607) --------------------------------------
 
     def _requeue_and_update(self, e: Entry) -> None:
-        if e.status != NOT_NOMINATED and e.requeue_reason == RequeueReason.GENERIC:
-            e.requeue_reason = RequeueReason.FAILED_AFTER_NOMINATION
-        self.queues.requeue_workload(e.info, e.requeue_reason)
-        if e.status in (NOT_NOMINATED, SKIPPED):
-            wl = e.info.obj
-            if wl.has_quota_reservation:
-                wl.admission = None
-                wl.set_condition("QuotaReserved", False, reason="Pending",
-                                 message=e.inadmissible_msg, now=self.clock())
-            self.metrics.inadmissible += 1
+        self._requeue_sweep((e,))
+
+    def _requeue_sweep(self, entries) -> None:
+        """Requeue losers, then strip dangling reservations — the
+        reference's order (requeueAndUpdate): the queue manager's
+        has_quota_reservation guard must observe the reservation still
+        set, so a reserved entry is deliberately NOT re-inserted. Batched
+        under one queue-manager lock for the post-cycle sweep."""
+        to_requeue = []
+        for e in entries:
+            if e.status != NOT_NOMINATED \
+                    and e.requeue_reason == RequeueReason.GENERIC:
+                e.requeue_reason = RequeueReason.FAILED_AFTER_NOMINATION
+            to_requeue.append((e.info, e.requeue_reason))
+        if to_requeue:
+            self.queues.requeue_workloads(to_requeue)
+        now = None
+        for e in entries:
+            if e.status in (NOT_NOMINATED, SKIPPED):
+                wl = e.info.obj
+                if wl.has_quota_reservation:
+                    if now is None:
+                        now = self.clock()
+                    wl.admission = None
+                    wl.set_condition("QuotaReserved", False, reason="Pending",
+                                     message=e.inadmissible_msg, now=now)
+                self.metrics.inadmissible += 1
 
 
 def _assignment_still_fits(assignment: Assignment, cq: CachedClusterQueue,
