@@ -441,6 +441,16 @@ class Cache:
                 cq._dirty_sinks = self._mirror_dirty_sinks
                 sink.add(cq.name)
 
+    def unregister_dirty_sink(self, sink: set) -> None:
+        """Detach a retired mirror's sink so abandoned mirrors neither
+        pin their dirty sets nor add per-mutation overhead (a scheduler
+        replacement over a long-lived cache re-registers its new one)."""
+        with self._lock:
+            try:
+                self._mirror_dirty_sinks.remove(sink)
+            except ValueError:
+                pass
+
     # -- cluster queues ------------------------------------------------------
 
     def add_cluster_queue(self, spec: ClusterQueue) -> CachedClusterQueue:

@@ -200,6 +200,12 @@ class SnapshotMirror:
         # solve and FIT decisions must be re-validated.
         self.mutation_count = 0
 
+    def detach(self) -> None:
+        """Unsubscribe from the cache's dirty marks. Call when retiring a
+        mirror whose cache lives on (scheduler replacement) — otherwise
+        the abandoned sink keeps accumulating names on every mutation."""
+        self.cache.unregister_dirty_sink(self._dirty)
+
     def refresh(self) -> Snapshot:
         cache = self.cache
         key = (cache.structure_version,

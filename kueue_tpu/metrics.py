@@ -35,6 +35,13 @@ class Counter(_Metric):
         with self._lock:
             self.values[tuple(labels)] += by
 
+    def inc_bulk(self, items) -> None:
+        """`[(label_tuple, delta)]` folded under one lock acquisition."""
+        with self._lock:
+            values = self.values
+            for key, by in items:
+                values[key] += by
+
     def get(self, *labels) -> float:
         return self.values.get(tuple(labels), 0.0)
 
@@ -88,6 +95,23 @@ class Histogram(_Metric):
             counts[bisect.bisect_left(self.buckets, value)] += 1
             self.sums[key] += value
             self.totals[key] += 1
+
+    def observe_bulk(self, items) -> None:
+        """Fold many observations (`[(label_tuple, value)]`) under ONE
+        lock acquisition — the admission commit records a wait-time sample
+        per admitted workload and per-sample locking showed up at
+        north-star scale."""
+        with self._lock:
+            bisect_left = bisect.bisect_left
+            buckets = self.buckets
+            n_counts = len(buckets) + 1
+            for key, value in items:
+                counts = self.counts.get(key)
+                if counts is None:
+                    counts = self.counts[key] = [0] * n_counts
+                counts[bisect_left(buckets, value)] += 1
+                self.sums[key] += value
+                self.totals[key] += 1
 
     def percentile(self, q: float, *labels) -> float:
         """Approximate percentile from bucket boundaries."""

@@ -183,10 +183,12 @@ class Scheduler:
         t1 = _time.perf_counter()
         phases = REGISTRY.tick_phase_seconds
         self._resolve(tick)
+        ts = _time.perf_counter()
         entries = tick.entries
         entries.sort(key=self._entry_sort_key)
         t2 = _time.perf_counter()
         phases.observe("nominate", value=t2 - t1)
+        phases.observe("nominate.sort", value=t2 - ts)
         admitted = self._admission_cycle(entries, snapshot,
                                          revalidate=stale)
         t3 = _time.perf_counter()
@@ -466,6 +468,7 @@ class Scheduler:
         # (falls back to the per-entry referee walk when unavailable).
         still_fits: Dict[int, bool] = {}
         if revalidate and self.batch_solver is not None:
+            t_rv = _time.perf_counter()
             fit_entries = [
                 e for e in entries
                 if e.assignment is not None
@@ -478,6 +481,8 @@ class Scheduler:
                 if mask is not None:
                     still_fits = {id(e): bool(ok)
                                   for e, ok in zip(fit_entries, mask)}
+            REGISTRY.tick_phase_seconds.observe(
+                "admit.reval", value=_time.perf_counter() - t_rv)
         for e in entries:
             if e.assignment is None:
                 continue
@@ -634,7 +639,10 @@ class Scheduler:
         triples: Optional[list] = [] if not wl.reclaimable_pods else None
         for ps in e.assignment.pod_sets:
             flavors = {r: fa.name for r, fa in ps.flavors.items()}
-            requests = dict(ps.requests)
+            # ps.requests is freshly built per solve and never mutated
+            # after decode — alias it instead of copying (readers that
+            # need a private dict copy on their side, workload.py:194).
+            requests = ps.requests
             psas.append(PodSetAssignment(
                 name=ps.name, flavors=flavors,
                 resource_usage=requests, count=ps.count))
@@ -694,8 +702,8 @@ class Scheduler:
         now = self.clock()
         note_items = []
         admitted = 0
-        wait_hist = REGISTRY.admission_wait_time_seconds
-        admitted_ctr = REGISTRY.admitted_workloads_total
+        wait_samples = []
+        admit_counts: Dict[tuple, int] = {}
         for (e, wait_started, _), assumed in zip(pending, results):
             wl = e.info.obj
             if isinstance(assumed, str):
@@ -727,9 +735,12 @@ class Scheduler:
             note_items.append((e.info.cluster_queue, assumed.usage()))
             admitted += 1
             self.metrics.admitted += 1
-            admitted_ctr.inc(e.info.cluster_queue)
-            wait_hist.observe(e.info.cluster_queue,
-                              value=max(0.0, now - wait_started))
+            key = (e.info.cluster_queue,)
+            admit_counts[key] = admit_counts.get(key, 0) + 1
+            wait_samples.append((key, max(0.0, now - wait_started)))
+        if admit_counts:
+            REGISTRY.admitted_workloads_total.inc_bulk(admit_counts.items())
+            REGISTRY.admission_wait_time_seconds.observe_bulk(wait_samples)
         if note_items:
             bulk = getattr(self.batch_solver, "note_admissions", None)
             if bulk is not None:

@@ -123,6 +123,13 @@ class CQEncoding:
     # this instead of running the per-flavor string matching.
     _trivial_elig: Dict[str, np.ndarray] = field(
         default_factory=dict, repr=False, compare=False)
+    # Stacked [C,G,S] view of the trivial masks (lazily filled row by row
+    # alongside _trivial_elig) + per-CQ fill flags: encode_workloads
+    # gathers all simple workloads' eligibility in ONE fancy-index read.
+    _trivial_stack: Optional[np.ndarray] = field(
+        default=None, repr=False, compare=False)
+    _trivial_filled: Optional[np.ndarray] = field(
+        default=None, repr=False, compare=False)
     _cohort_requestable: Optional[np.ndarray] = field(
         default=None, repr=False, compare=False)
     _cohort_perm: Optional[np.ndarray] = field(
@@ -655,6 +662,15 @@ def _trivial_elig(cq, snapshot: Snapshot, enc: CQEncoding) -> np.ndarray:
                 ok, _ = flavor_eligible(_EMPTY_PODSET, flavor, keys)
                 m[gi, si] = ok
         enc._trivial_elig[cq.name] = m
+        ci = enc.cq_index.get(cq.name)
+        if ci is not None:
+            if enc._trivial_stack is None:
+                enc._trivial_stack = np.zeros(
+                    (len(enc.cq_names), enc.num_groups, enc.num_slots),
+                    dtype=bool)
+                enc._trivial_filled = np.zeros(len(enc.cq_names), dtype=bool)
+            enc._trivial_stack[ci] = m
+            enc._trivial_filled[ci] = True
     return m
 
 
@@ -761,6 +777,20 @@ def encode_workloads(workloads: Sequence[WorkloadInfo], snapshot: Snapshot,
     cqs_by_name = snapshot.cluster_queues
     cache_hit = None if row_cache is None else row_cache.get
     cache_put = None if row_cache is None else row_cache.put
+    cq_index = enc.cq_index
+    r_index = enc.resource_index
+    # Fast path (the dominant shape at scale): a single-podset workload
+    # with no tolerations / node selectors / affinity writes straight
+    # into the batch tensors — no per-row numpy allocations, no cache
+    # signature — its eligibility is the CQ's cached trivial mask and its
+    # requests are 2-3 scalars folded below by ONE fancy-index store.
+    fast_ws: List[int] = []
+    fast_cis: List[int] = []
+    trivial_filled = enc._trivial_filled
+    t_ws: List[int] = []
+    t_ris: List[int] = []
+    t_vals: List[int] = []
+    row_ws: List[int] = []
     rows: List[_Row] = []
     rows_append = rows.append
     p_counts: List[int] = []
@@ -772,15 +802,6 @@ def encode_workloads(workloads: Sequence[WorkloadInfo], snapshot: Snapshot,
         if scaled:
             totals = [totals[i].scaled_to(c) for i, c in enumerate(counts[w])]
 
-        row = None if scaled or cache_hit is None else cache_hit(wi)
-        if row is None:
-            row = _encode_row(wi, cq, snapshot, enc, totals)
-            if not scaled and cache_put is not None:
-                cache_put(wi, row)
-        rows_append(row)
-        p_count = len(totals)
-        pc_append(p_count)
-
         # Stale resume state is dropped exactly like the referee
         # (flavorassigner.go:244-247).
         last = wi.last_assignment
@@ -791,6 +812,56 @@ def encode_workloads(workloads: Sequence[WorkloadInfo], snapshot: Snapshot,
                         and cohort.allocatable_generation
                         > last.cohort_generation)):
                 last = None
+
+        if not scaled and len(totals) == 1:
+            ps0 = wi.obj.pod_sets[0]
+            if not (ps0.tolerations or ps0.node_selector
+                    or ps0.affinity_terms):
+                t0 = totals[0]
+                requests = t0.requests
+                ci = cq_index[wi.cluster_queue]
+                fast_ws.append(w)
+                fast_cis.append(ci)
+                if trivial_filled is None or not trivial_filled[ci]:
+                    _trivial_elig(cq, snapshot, enc)  # fills the stack row
+                    trivial_filled = enc._trivial_filled
+                track_pods = PODS_RESOURCE in cq.rg_by_resource
+                for rname, val in requests.items():
+                    ri = r_index.get(rname)
+                    if ri is None:
+                        podset_unsat[w, 0] = True
+                        continue
+                    t_ws.append(w)
+                    t_ris.append(ri)
+                    t_vals.append(val)
+                if track_pods:
+                    ri = r_index.get(PODS_RESOURCE)
+                    if ri is None:
+                        podset_unsat[w, 0] = True
+                    else:
+                        t_ws.append(w)
+                        t_ris.append(ri)
+                        t_vals.append(t0.count)
+                if last is not None:
+                    for gi, rg in enumerate(cq.resource_groups):
+                        for rname in rg.covered_resources:
+                            if rname in requests or (
+                                    track_pods and rname == PODS_RESOURCE):
+                                resume_slot[w, 0, gi] = \
+                                    last.next_flavor_to_try(0, rname)
+                                break
+                continue
+
+        row = None if scaled or cache_hit is None else cache_hit(wi)
+        if row is None:
+            row = _encode_row(wi, cq, snapshot, enc, totals)
+            if not scaled and cache_put is not None:
+                cache_put(wi, row)
+        row_ws.append(w)
+        rows_append(row)
+        p_count = len(totals)
+        pc_append(p_count)
+
         if last is not None:
             for p in range(p_count):
                 requested = row.requests_per_podset[p]
@@ -803,26 +874,38 @@ def encode_workloads(workloads: Sequence[WorkloadInfo], snapshot: Snapshot,
                                 last.next_flavor_to_try(p, rname)
                             break
 
-    # Batched assembly. The common case — every workload a single podset —
-    # is one np.stack per field instead of six indexed assignments per
-    # workload (~6k tiny numpy ops per tick at north-star scale).
-    if P == 1 and all(c == 1 for c in p_counts):
-        wl_cq[:n] = [row.ci for row in rows]
-        if n:
-            req[:n, 0] = np.stack([row.req[0] for row in rows])
-            has_req[:n, 0] = np.stack([row.has_req[0] for row in rows])
-            podset_valid[:n, 0] = True
-            podset_unsat[:n, 0] = [row.unsat[0] for row in rows]
-            elig[:n, 0] = np.stack([row.elig[0] for row in rows])
-    else:
-        for w, row in enumerate(rows):
-            p_count = p_counts[w]
-            wl_cq[w] = row.ci
-            req[w, :p_count] = row.req
-            has_req[w, :p_count] = row.has_req
-            podset_valid[w, :p_count] = True
-            podset_unsat[w, :p_count] = row.unsat
-            elig[w, :p_count] = row.elig
+    if fast_ws:
+        fw_idx = np.asarray(fast_ws)
+        fc_idx = np.asarray(fast_cis)
+        wl_cq[fw_idx] = fc_idx
+        podset_valid[fw_idx, 0] = True
+        elig[fw_idx, 0] = enc._trivial_stack[fc_idx]
+        if t_ws:
+            tw = np.asarray(t_ws)
+            tr = np.asarray(t_ris)
+            req[tw, 0, tr] = t_vals
+            has_req[tw, 0, tr] = True
+
+    # Batched assembly of the cached/slow rows. The common case — every
+    # row a single podset — is one np.stack per field instead of six
+    # indexed assignments per workload.
+    if rows:
+        if P == 1 and all(c == 1 for c in p_counts):
+            idx = np.asarray(row_ws)
+            wl_cq[idx] = [row.ci for row in rows]
+            req[idx, 0] = np.stack([row.req[0] for row in rows])
+            has_req[idx, 0] = np.stack([row.has_req[0] for row in rows])
+            podset_valid[idx, 0] = True
+            podset_unsat[idx, 0] = [row.unsat[0] for row in rows]
+            elig[idx, 0] = np.stack([row.elig[0] for row in rows])
+        else:
+            for w, row, p_count in zip(row_ws, rows, p_counts):
+                wl_cq[w] = row.ci
+                req[w, :p_count] = row.req
+                has_req[w, :p_count] = row.has_req
+                podset_valid[w, :p_count] = True
+                podset_unsat[w, :p_count] = row.unsat
+                elig[w, :p_count] = row.elig
 
     return WorkloadTensors(
         wl_cq=wl_cq, req=req, has_req=has_req, podset_valid=podset_valid,
