@@ -692,6 +692,25 @@ class Cache:
             cq = self.cluster_queues.get(wl.admission.cluster_queue)
             return cq is not None and wl.key in cq.workloads
 
+    def assumed_or_admitted_bulk(self, wls) -> List[bool]:
+        """is_assumed_or_admitted over many workloads under ONE lock
+        acquisition (the tick gates every popped head through this)."""
+        out = []
+        with self._lock:
+            assumed = self.assumed_workloads
+            cqs = self.cluster_queues
+            for wl in wls:
+                if wl.key in assumed:
+                    out.append(True)
+                    continue
+                adm = wl.admission
+                if adm is None:
+                    out.append(False)
+                    continue
+                cq = cqs.get(adm.cluster_queue)
+                out.append(cq is not None and wl.key in cq.workloads)
+        return out
+
     def usage(self, cq_name: str) -> FlavorResourceQuantities:
         with self._lock:
             return frq_clone(self.cluster_queues[cq_name].usage)

@@ -587,20 +587,34 @@ def _decode_assignments_py(workloads: Sequence[WorkloadInfo],
         assignments.append(a)
 
     # Fill pass: one flat loop over the assigned entries.
+    for a in assignments:
+        a.usage_idx = ([], [], [])
     for i in range(len(ws_l)):
         w = ws_l[i]
         a = assignments[w]
         psa = psa_rows[w][pp_l[i]]
-        rname = resource_names[rr_l[i]]
-        fname = flavor_names[flav_l[i]]
+        ri = rr_l[i]
+        fi = flav_l[i]
+        rname = resource_names[ri]
+        fname = flavor_names[fi]
         tried = tried_l[i]
         fa = FlavorAssignment(name=fname, mode=mode_l[i], borrow=borrow_l[i],
                               tried_flavor_idx=tried)
         psa.flavors[rname] = fa
         if fa.borrow:
             a.borrowing = True
+        val = psa.requests[rname]
         fusage = a.usage.setdefault(fname, {})
-        fusage[rname] = fusage.get(rname, 0) + psa.requests[rname]
+        fusage[rname] = fusage.get(rname, 0) + val
+        u_f, u_r, u_v = a.usage_idx
+        for t in range(len(u_f)):
+            if u_f[t] == fi and u_r[t] == ri:
+                u_v[t] += val
+                break
+        else:
+            u_f.append(fi)
+            u_r.append(ri)
+            u_v.append(val)
         a.last_state.last_tried_flavor_idx[pp_l[i]][rname] = tried
     return assignments
 
@@ -786,11 +800,15 @@ class BatchSolver:
     def revalidate_fits(self, items) -> Optional[np.ndarray]:
         """Batched staleness re-validation of FIT assignments.
 
-        `items`: sequence of (cq_name, usage_frq) — one per in-doubt FIT
-        entry. Returns a [n] bool mask (True = still fits against current
-        usage), or None when the vectorized path cannot answer (no
-        encoding yet, hierarchical cohorts, or an unknown CQ/flavor/
-        resource) and the caller must fall back to the per-entry referee.
+        `items`: sequence of (cq_name, assignment) — one per in-doubt FIT
+        entry. Assignments decoded from this solver carry integer usage
+        coordinates (`usage_idx`, filled by decode_assignments) that skip
+        the name→index dict walks; referee-built ones fall back to the
+        usage-dict walk. Returns a [n] bool mask (True = still fits
+        against current usage), or None when the vectorized path cannot
+        answer (no encoding yet, hierarchical cohorts, or an unknown
+        CQ/flavor/resource) and the caller must fall back to the
+        per-entry referee.
 
         This replaces ~one referee walk per admitted head per tick in
         pipelined mode (scheduler._assignment_still_fits) with one
@@ -808,11 +826,21 @@ class BatchSolver:
         cq_index = enc.cq_index
         f_index = enc.flavor_index
         r_index = enc.resource_index
-        for i, (cq_name, frq) in enumerate(items):
+        for i, (cq_name, assignment) in enumerate(items):
             ci = cq_index.get(cq_name)
             if ci is None:
                 return None
-            for fname, resources in frq.items():
+            idx = getattr(assignment, "usage_idx", None)
+            if idx is not None:
+                i_f, i_r, i_v = idx
+                k = len(i_f)
+                ent.extend([i] * k)
+                cis.extend([ci] * k)
+                fis.extend(i_f)
+                ris.extend(i_r)
+                vals.extend(i_v)
+                continue
+            for fname, resources in assignment.usage.items():
                 fi = f_index.get(fname)
                 if fi is None:
                     return None

@@ -19,6 +19,7 @@
 #include <Python.h>
 
 #include <cstdint>
+#include <vector>
 
 namespace {
 
@@ -72,6 +73,7 @@ struct Names {
   PyObject* msg_no_quota;   // "insufficient unused quota"
   PyObject* msg_no_fit;     // "insufficient quota or no eligible flavor"
   PyObject* mode_memo;      // "_mode" lazy representative_mode memo slot
+  PyObject* usage_idx;      // integer-coordinate usage twin
 };
 Names N;
 
@@ -199,6 +201,11 @@ PyObject* decode(PyObject*, PyObject* args) {
     }
     Py_DECREF(acqs);
     bool a_borrowing = false;
+    // Integer usage coordinates ((f,r) deduped across podsets, values
+    // summed) — the index-space twin of a.usage for revalidate/scatter
+    // consumers. Tiny per workload (≤ requested resources), linear scan.
+    std::vector<long> u_f, u_r;
+    std::vector<long long> u_v;
 
     PyObject* rg_by_resource = PyObject_GetAttr(cq, N.rg_by_resource);
     int track_pods =
@@ -336,6 +343,26 @@ PyObject* decode(PyObject*, PyObject* args) {
             wl_ok = sum != nullptr && PyDict_SetItem(fusage, rname, sum) == 0;
             Py_XDECREF(sum);
           }
+          if (wl_ok) {
+            long long v = PyLong_AsLongLong(val);
+            if (v == -1 && PyErr_Occurred()) {
+              wl_ok = false;
+            } else {
+              bool merged = false;
+              for (size_t t = 0; t < u_f.size(); ++t) {
+                if (u_f[t] == f && u_r[t] == r) {
+                  u_v[t] += v;
+                  merged = true;
+                  break;
+                }
+              }
+              if (!merged) {
+                u_f.push_back(f);
+                u_r.push_back(r);
+                u_v.push_back(v);
+              }
+            }
+          }
           // last_tried_flavor_idx[p][rname] = tried
           if (wl_ok) wl_ok = PyDict_SetItem(lti_dict, rname, tried_o) == 0;
           Py_DECREF(tried_o);
@@ -357,6 +384,36 @@ PyObject* decode(PyObject*, PyObject* args) {
     if (a_borrowing && PyObject_SetAttr(a, N.borrowing, Py_True) != 0) {
       Py_DECREF(a);
       goto fail;
+    }
+    {
+      size_t m = u_f.size();
+      PyObject* l_f = PyList_New(m);
+      PyObject* l_r = l_f ? PyList_New(m) : nullptr;
+      PyObject* l_v = l_r ? PyList_New(m) : nullptr;
+      bool ok_idx = l_v != nullptr;
+      for (size_t t = 0; ok_idx && t < m; ++t) {
+        PyObject* o_f = PyLong_FromLong(u_f[t]);
+        PyObject* o_r = PyLong_FromLong(u_r[t]);
+        PyObject* o_v = PyLong_FromLongLong(u_v[t]);
+        if (o_f == nullptr || o_r == nullptr || o_v == nullptr) {
+          Py_XDECREF(o_f);
+          Py_XDECREF(o_r);
+          Py_XDECREF(o_v);
+          ok_idx = false;
+          break;
+        }
+        PyList_SET_ITEM(l_f, t, o_f);
+        PyList_SET_ITEM(l_r, t, o_r);
+        PyList_SET_ITEM(l_v, t, o_v);
+      }
+      PyObject* tup = ok_idx ? PyTuple_Pack(3, l_f, l_r, l_v) : nullptr;
+      Py_XDECREF(l_f);
+      Py_XDECREF(l_r);
+      Py_XDECREF(l_v);
+      if (!set_steal(a, N.usage_idx, tup)) {
+        Py_DECREF(a);
+        goto fail;
+      }
     }
     PyList_SET_ITEM(result, w, a);  // steals
   }
@@ -405,6 +462,7 @@ PyMODINIT_FUNC PyInit__kueue_decode(void) {
       PyUnicode_InternFromString("cluster_queue_generation");
   N.cohort_generation = PyUnicode_InternFromString("cohort_generation");
   N.pods = PyUnicode_InternFromString("pods");
+  N.usage_idx = PyUnicode_InternFromString("usage_idx");
   N.msg_no_quota = PyUnicode_InternFromString("insufficient unused quota");
   N.msg_no_fit =
       PyUnicode_InternFromString("insufficient quota or no eligible flavor");

@@ -19,7 +19,12 @@ from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Sequence, Set
 
 from kueue_tpu import features
-from kueue_tpu.api.types import Admission, PodSetAssignment, Workload
+from kueue_tpu.api.types import (
+    Admission,
+    Condition,
+    PodSetAssignment,
+    Workload,
+)
 from kueue_tpu.metrics import REGISTRY
 from kueue_tpu.core.cache import (
     Cache,
@@ -60,6 +65,9 @@ class Entry:
         default_factory=list)
     # ClusterQueue share value at nomination time (KEP-1714 fair sharing).
     share: float = 0.0
+    # Batched staleness re-validation verdict (None = not validated; the
+    # admission cycle falls back to the per-entry referee walk).
+    reval_ok: Optional[bool] = None
 
 
 @dataclass
@@ -130,6 +138,12 @@ class Scheduler:
         # usage moved outside the scheduler's own assume/forget lockstep
         # (replaces the reference's per-tick deep copy, snapshot.go:95-129).
         self._mirror = SnapshotMirror(cache)
+
+    def close(self) -> None:
+        """Release cache subscriptions. Call when retiring this scheduler
+        while its cache lives on (e.g. config-reload replacement) — the
+        mirror's dirty sink would otherwise stay registered forever."""
+        self._mirror.detach()
 
     # -- one tick -----------------------------------------------------------
 
@@ -209,14 +223,19 @@ class Scheduler:
                       snapshot: Snapshot):
         entries: List[Entry] = []
         solvable: List[Entry] = []
-        for wi in heads:
-            e = Entry(info=wi)
-            cq = snapshot.cluster_queues.get(wi.cluster_queue)
-            if self.cache.is_assumed_or_admitted(wi.obj):
+        already = self.cache.assumed_or_admitted_bulk(
+            [wi.obj for wi in heads])
+        cqs_by_name = snapshot.cluster_queues
+        inactive = snapshot.inactive_cluster_queues
+        for wi, skip in zip(heads, already):
+            if skip:
                 continue
-            if _has_retry_or_rejected_checks(wi.obj):
+            e = Entry(info=wi)
+            cq = cqs_by_name.get(wi.cluster_queue)
+            if wi.obj.admission_check_states \
+                    and _has_retry_or_rejected_checks(wi.obj):
                 e.inadmissible_msg = "The workload has failed admission checks"
-            elif wi.cluster_queue in snapshot.inactive_cluster_queues:
+            elif wi.cluster_queue in inactive:
                 e.inadmissible_msg = f"ClusterQueue {wi.cluster_queue} is inactive"
             elif cq is None:
                 e.inadmissible_msg = f"ClusterQueue {wi.cluster_queue} not found"
@@ -247,6 +266,16 @@ class Scheduler:
         else:
             assignments = None
         fair = features.enabled(features.FAIR_SHARING)
+        shares: Dict[str, float] = {}
+
+        def share_of(cq_name: str) -> float:
+            s = shares.get(cq_name)
+            if s is None:
+                cq = snapshot.cluster_queues.get(cq_name)
+                s = shares[cq_name] = (
+                    fair_share.dominant_resource_share(cq)[0]
+                    if cq is not None else 0.0)
+            return s
         # Batched device victim search: all PREEMPT-mode entries of the
         # tick solved in at most two dispatches instead of one per entry
         # (preemption.go runs these sequentially per head; the searches
@@ -262,10 +291,19 @@ class Scheduler:
             and partial_feature
             and entries[i].info.obj.can_be_partially_admitted()]
         batch_targets = self._batched_targets(pre_pairs, snapshot)
-        shares: Dict[str, float] = {}
         partial_pending: List[Entry] = []
         for i, e in enumerate(entries):
             full = assignments[i] if assignments is not None else None
+            if full is not None and full.representative_mode == FIT:
+                # Batched-solve FIT fast path: nothing to search, no
+                # message to build (a FIT assignment has no reasons).
+                e.assignment = full
+                e.preemption_targets = []
+                e.inadmissible_msg = ""
+                e.info.last_assignment = full.last_state
+                if fair:
+                    e.share = share_of(e.info.cluster_queue)
+                continue
             if (full is not None and full.representative_mode == PREEMPT
                     and i not in batch_targets):
                 assignment, targets = full, None   # deferred victim search
@@ -290,13 +328,7 @@ class Scheduler:
             else:
                 e.info.last_assignment = assignment.last_state
             if fair:
-                cq_name = e.info.cluster_queue
-                if cq_name not in shares:
-                    cq = snapshot.cluster_queues.get(cq_name)
-                    shares[cq_name] = (
-                        fair_share.dominant_resource_share(cq)[0]
-                        if cq is not None else 0.0)
-                e.share = shares[cq_name]
+                e.share = share_of(e.info.cluster_queue)
         if partial_pending:
             self._batch_partial_admission(partial_pending, snapshot)
 
@@ -466,7 +498,6 @@ class Scheduler:
         # Batched staleness re-validation: one vectorized pass over all
         # in-doubt FIT entries against the solver's lockstep usage tensor
         # (falls back to the per-entry referee walk when unavailable).
-        still_fits: Dict[int, bool] = {}
         if revalidate and self.batch_solver is not None:
             t_rv = _time.perf_counter()
             fit_entries = [
@@ -475,12 +506,12 @@ class Scheduler:
                 and e.assignment.representative_mode == FIT]
             if fit_entries:
                 reval = getattr(self.batch_solver, "revalidate_fits", None)
-                mask = reval([(e.info.cluster_queue, e.assignment.usage)
+                mask = reval([(e.info.cluster_queue, e.assignment)
                               for e in fit_entries]) \
                     if reval is not None else None
                 if mask is not None:
-                    still_fits = {id(e): bool(ok)
-                                  for e, ok in zip(fit_entries, mask)}
+                    for e, ok in zip(fit_entries, mask):
+                        e.reval_ok = bool(ok)
             REGISTRY.tick_phase_seconds.observe(
                 "admit.reval", value=_time.perf_counter() - t_rv)
         for e in entries:
@@ -491,7 +522,7 @@ class Scheduler:
                 continue
             cq = snapshot.cluster_queues[e.info.cluster_queue]
             if revalidate and mode == FIT:
-                verdict = still_fits.get(id(e))
+                verdict = e.reval_ok
                 if verdict is None:
                     verdict = _assignment_still_fits(e.assignment, cq)
                 if not verdict:
@@ -517,6 +548,7 @@ class Scheduler:
                 # only defers siblings where a shared ancestor's capacity
                 # is genuinely consumed — not root-wide. The skip guard
                 # keys on the root (root() is self when flat).
+                hier = cq.cohort.is_hierarchical()
                 root_name = cq.cohort.root().name
                 # A pending preemption invalidates later preemption
                 # calculations only where this cycle actually reserved
@@ -527,7 +559,7 @@ class Scheduler:
                                cycle_root_usage.get(root_name),
                                e.assignment.usage))
                 if not blocked and mode == FIT:
-                    if cq.cohort.is_hierarchical():
+                    if hier:
                         if cycle_cohorts_usage and not fits_in_hierarchy(
                                 cq, e.assignment.usage,
                                 extra=cycle_cohorts_usage):
@@ -547,10 +579,21 @@ class Scheduler:
                     e.info.last_assignment = None
                     self.metrics.skipped += 1
                     continue
-                reserve = _resources_to_reserve(e, cq)
-                frq_add(cycle_cohorts_usage.setdefault(cq.cohort.name, {}),
-                        reserve)
-                frq_add(cycle_root_usage.setdefault(root_name, {}), reserve)
+                reserve = e.assignment.usage if mode != PREEMPT \
+                    else _resources_to_reserve(e, cq)
+                if hier:
+                    frq_add(cycle_cohorts_usage.setdefault(
+                        cq.cohort.name, {}), reserve)
+                    frq_add(cycle_root_usage.setdefault(root_name, {}),
+                            reserve)
+                else:
+                    # Flat cohort: node == root; share ONE dict so the
+                    # reservation folds once and both views read it.
+                    node = cycle_cohorts_usage.get(root_name)
+                    if node is None:
+                        node = cycle_cohorts_usage[root_name] = {}
+                        cycle_root_usage[root_name] = node
+                    frq_add(node, reserve)
             if mode == FIT and self.pods_ready_gate is not None \
                     and not self.pods_ready_gate():
                 # Admission blocked until all admitted workloads are ready
@@ -658,20 +701,28 @@ class Scheduler:
                         triples.append((flv, r, q))
         admission = Admission(cluster_queue=e.info.cluster_queue,
                               pod_set_assignments=psas)
+        # One condition-map read covers every lookup below; in-place
+        # Condition updates keep it valid, appends invalidate it by length
+        # (set_condition semantics, unrolled — this runs per admission).
+        cmap = wl._cond_map()
         # Wait time runs from creation, or from the eviction being recovered
         # from (scheduler.go:516-520); capture before clearing Evicted.
         wait_started = wl.creation_time
-        evicted_cond = wl.find_condition("Evicted")
-        if evicted_cond is not None and evicted_cond.status:
+        evicted_cond = cmap.get("Evicted")
+        was_evicted = evicted_cond is not None and evicted_cond.status
+        if was_evicted:
             wait_started = evicted_cond.last_transition_time
         wl.admission = admission
         now = self.clock()
-        wl.set_condition("QuotaReserved", True, reason="QuotaReserved",
-                         now=now)
-        if evicted_cond is not None and evicted_cond.status:
-            # A readmitted workload is no longer evicted.
-            wl.set_condition("Evicted", False, reason="QuotaReserved",
-                             now=now)
+        _set_condition_via(cmap, wl, "QuotaReserved", True, "QuotaReserved",
+                           now)
+        if was_evicted:
+            # A readmitted workload is no longer evicted (status flips,
+            # so the transition time moves).
+            evicted_cond.last_transition_time = now
+            evicted_cond.status = False
+            evicted_cond.reason = "QuotaReserved"
+            evicted_cond.message = ""
         # Admitted syncs at admit time when the workload carries every
         # check the CQ requires AND all of its recorded check states are
         # Ready (scheduler.go:502-505 HasAllChecks + SyncAdmittedCondition
@@ -679,10 +730,11 @@ class Scheduler:
         states = wl.admission_check_states
         if not states:
             if not cq.admission_checks:
-                wl.set_condition("Admitted", True, reason="Admitted", now=now)
+                _set_condition_via(cmap, wl, "Admitted", True, "Admitted",
+                                   now)
         elif cq.admission_checks <= states.keys() and all(
                 s.state == "Ready" for s in states.values()):
-            wl.set_condition("Admitted", True, reason="Admitted", now=now)
+            _set_condition_via(cmap, wl, "Admitted", True, "Admitted", now)
         pending.append((e, wait_started, triples))
         return True
 
@@ -701,10 +753,11 @@ class Scheduler:
             "admit.flush.assume", value=_time.perf_counter() - t_a)
         now = self.clock()
         note_items = []
+        note_bulk = getattr(self.batch_solver, "note_admissions", None)
         admitted = 0
         wait_samples = []
         admit_counts: Dict[tuple, int] = {}
-        for (e, wait_started, _), assumed in zip(pending, results):
+        for (e, wait_started, triples), assumed in zip(pending, results):
             wl = e.info.obj
             if isinstance(assumed, str):
                 # Defensive (duplicate assume / CQ deleted mid-tick):
@@ -731,8 +784,15 @@ class Scheduler:
             # Mirror EXACTLY what the cache accounted: for partial
             # admission that is the spec-count totals (scaled back up,
             # workload.go:230-234 — the job integration later reclaims
-            # the difference), not the reduced assignment usage.
-            note_items.append((e.info.cluster_queue, assumed.usage()))
+            # the difference), not the reduced assignment usage. When the
+            # flattened triples exist (no reclaim, spec counts — the
+            # accounted usage IS the assignment usage) pass the decode's
+            # integer coordinates so the solver skips the dict walk.
+            idx = e.assignment.usage_idx \
+                if triples is not None and note_bulk is not None else None
+            note_items.append((
+                e.info.cluster_queue,
+                None if idx is not None else assumed.usage(), idx))
             admitted += 1
             self.metrics.admitted += 1
             key = (e.info.cluster_queue,)
@@ -742,13 +802,12 @@ class Scheduler:
             REGISTRY.admitted_workloads_total.inc_bulk(admit_counts.items())
             REGISTRY.admission_wait_time_seconds.observe_bulk(wait_samples)
         if note_items:
-            bulk = getattr(self.batch_solver, "note_admissions", None)
-            if bulk is not None:
-                bulk(note_items)
+            if note_bulk is not None:
+                note_bulk(note_items)
             else:
                 single = getattr(self.batch_solver, "note_admission", None)
                 if single is not None:
-                    for cq_name, frq in note_items:
+                    for cq_name, frq, _ in note_items:
                         single(cq_name, frq)
         return admitted
 
@@ -782,6 +841,22 @@ class Scheduler:
                     wl.set_condition("QuotaReserved", False, reason="Pending",
                                      message=e.inadmissible_msg, now=now)
                 self.metrics.inadmissible += 1
+
+
+def _set_condition_via(cmap: dict, wl: Workload, ctype: str, status: bool,
+                       reason: str, now: float) -> None:
+    """Workload.set_condition with the condition map already in hand
+    (admission hot path — one map read serves several condition writes).
+    In-place updates keep `cmap` valid; appends invalidate it by length,
+    exactly like set_condition itself."""
+    c = cmap.get(ctype)
+    if c is None:
+        wl.conditions.append(
+            Condition(ctype, status, reason, "", last_transition_time=now))
+    else:
+        if c.status != status:
+            c.last_transition_time = now
+        c.status, c.reason, c.message = status, reason, ""
 
 
 def _assignment_still_fits(assignment: Assignment, cq: CachedClusterQueue,

@@ -68,6 +68,12 @@ class Assignment:
     borrowing: bool = False
     usage: FlavorResourceQuantities = field(default_factory=dict)
     last_state: Optional[AssignmentClusterQueueState] = None
+    # Integer twin of `usage` in solver-encoding coordinates —
+    # ([flavor_idx], [resource_idx], [value]) lists, filled by the batched
+    # decode so index-space consumers (staleness re-validation, the usage
+    # tensor scatter) skip the name→index dict walks. None on
+    # referee-built assignments.
+    usage_idx: Optional[tuple] = field(default=None, repr=False)
     _mode: Optional[int] = field(default=None, init=False, repr=False)
 
     @property

@@ -523,9 +523,12 @@ class UsageEncoder:
             self._versions[ci] += 1
 
     def apply_delta_batch(self, items, sign: int = 1) -> None:
-        """Fold a whole cycle's workload usages ([(cq_name, frq)]) into
-        the tensor with ONE scatter-add — the bulk twin of apply_delta
-        for the end-of-cycle admission commit."""
+        """Fold a whole cycle's workload usages into the tensor with ONE
+        scatter-add — the bulk twin of apply_delta for the end-of-cycle
+        admission commit. `items` rows are (cq_name, frq) or
+        (cq_name, frq, usage_idx): index-carrying rows (the batched
+        decode's integer coordinates) skip the name→index walks; their
+        frq may be None."""
         enc = self.enc
         cq_index = enc.cq_index
         f_index = enc.flavor_index
@@ -536,17 +539,27 @@ class UsageEncoder:
         ris: list = []
         vals: list = []
         versions = self._versions
-        for cq_name, frq in items:
+        for item in items:
+            idx = item[2] if len(item) > 2 else None
+            cq_name = item[0]
             ci = cq_index.get(cq_name)
             if ci is None:
                 continue
-            conf = configured[ci]
             # One version bump per workload, matching the cache's
             # usage_version bump per assume — the refresh compares the
             # two for the row-skip fast path.
             if versions[ci] is not None:
                 versions[ci] += 1
-            for fname, resources in frq.items():
+            if idx is not None:
+                i_f, i_r, i_v = idx
+                k = len(i_f)
+                cis.extend([ci] * k)
+                fis.extend(i_f)
+                ris.extend(i_r)
+                vals.extend(i_v if sign == 1 else [sign * v for v in i_v])
+                continue
+            conf = configured[ci]
+            for fname, resources in item[1].items():
                 fi = f_index.get(fname)
                 if fi is None:
                     continue
@@ -558,7 +571,18 @@ class UsageEncoder:
                         ris.append(ri)
                         vals.append(sign * val)
         if cis:
-            np.add.at(self.usage, (cis, fis, ris), vals)
+            ci_a = np.asarray(cis)
+            fi_a = np.asarray(fis)
+            ri_a = np.asarray(ris)
+            # Only configured (flavor,resource) pairs are tracked
+            # (clusterqueue.go:473-485); dict-walk rows were gated inline
+            # and pass trivially.
+            m = configured[ci_a, fi_a, ri_a]
+            if m.all():
+                np.add.at(self.usage, (ci_a, fi_a, ri_a), vals)
+            else:
+                np.add.at(self.usage, (ci_a[m], fi_a[m], ri_a[m]),
+                          np.asarray(vals)[m])
 
     def apply_batch(self, delta: np.ndarray, cq_indices: np.ndarray) -> None:
         """Fold a whole tick's admitted usage (models/flavor_fit.py

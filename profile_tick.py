@@ -101,13 +101,16 @@ times = []
 if PROFILE and not TICK_ONLY:
     pr.enable()
 phase_rows = []
+cpu_times = []
 for _ in range(TICKS):
     tick_no[0] += 1
     before = dict(phases.sums)
     if PROFILE and TICK_ONLY:
         pr.enable()
     t = time.perf_counter()
+    tc = time.process_time()
     fw.tick()
+    cpu_times.append(time.process_time() - tc)
     times.append(time.perf_counter() - t)
     if PROFILE and TICK_ONLY:
         pr.disable()
@@ -118,7 +121,9 @@ if PROFILE and not TICK_ONLY:
     pr.disable()
 
 times_ms = np.array(times) * 1000
-print(f"p50 {np.percentile(times_ms,50):.1f}ms p99 {np.percentile(times_ms,99):.1f}ms mean {times_ms.mean():.1f}ms", file=sys.stderr)
+cpu_ms = np.array(cpu_times) * 1000
+print(f"p50 {np.percentile(times_ms,50):.1f}ms p99 {np.percentile(times_ms,99):.1f}ms mean {times_ms.mean():.1f}ms "
+      f"| cpu p50 {np.percentile(cpu_ms,50):.1f}ms mean {cpu_ms.mean():.1f}ms", file=sys.stderr)
 
 print("phase sums over run (s) / count / mean ms:", file=sys.stderr)
 for key in sorted(phases.sums):

@@ -189,3 +189,20 @@ def test_apply_admission_failure_requeues_cleanly():
     assert wl.admission is None
     fw.run_until_settled()
     assert fw.admitted_workloads("cq") == ["default/w"]
+
+
+def test_scheduler_close_detaches_mirror_sink():
+    """A retired scheduler's snapshot mirror must stop receiving dirty
+    marks (Cache.unregister_dirty_sink) so a replacement scheduler over a
+    long-lived cache doesn't leave the old sink accumulating names."""
+    fw = single_cq_framework(quota_cpu=4)
+    retired_sink = fw.scheduler._mirror._dirty
+    fw.scheduler.close()
+    retired_sink.clear()
+    wl = make_wl("w", cpu=2)
+    fw.submit(wl)
+    fw.run_until_settled()
+    assert fw.admitted_workloads("cq") == ["default/w"]
+    # The cache mutated (admission accounted) but the detached sink saw
+    # nothing.
+    assert not retired_sink

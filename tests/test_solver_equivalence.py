@@ -269,7 +269,7 @@ def test_revalidate_fits_matches_referee(seed):
             solver.note_admission(wi.cluster_queue, a.usage)
 
     mask = solver.revalidate_fits(
-        [(wi.cluster_queue, a.usage) for wi, a in fit_items])
+        [(wi.cluster_queue, a) for wi, a in fit_items])
     assert mask is not None
     for (wi, a), got in zip(fit_items, mask.tolist()):
         cq = snap.cluster_queues[wi.cluster_queue]
