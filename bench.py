@@ -107,9 +107,11 @@ def run_config(*, label, num_cqs, num_cohorts, num_flavors, backlog, ticks,
                 fw.delete_workload(wl)
                 submit_replacement()
 
-    # Warmup: compile the solve for the steady-state head-count bucket and
-    # fill the pipeline.
-    warmup = depth + 6
+    # Warmup: compile the solve for the steady-state head-count bucket,
+    # fill the pipeline, and let the admission/completion flux reach steady
+    # state (the first ~15 ticks drain the initial backlog mix with heavier
+    # requeue churn than the steady state the metric describes).
+    warmup = max(depth + 6, 20)
     preempted_before = fw.scheduler.metrics.preempted
     for _ in range(warmup):
         tick_no[0] += 1
@@ -192,7 +194,9 @@ def run_one(config: str) -> None:
     else:
         shape = dict(num_cqs=1000, num_cohorts=100, num_flavors=8,
                      backlog=50_000)
-        ticks = int(os.environ.get("KUEUE_BENCH_TICKS", "60"))
+        # Enough samples that p99 reflects the steady-state heavy-tick
+        # population rather than a single outlier (with 60 ticks p99 ~= max).
+        ticks = int(os.environ.get("KUEUE_BENCH_TICKS", "150"))
 
     if config == "preempt":
         # BASELINE config #3: preemption-heavy.

@@ -60,7 +60,8 @@ class Cohort:
     """
 
     __slots__ = ("name", "members", "requestable_resources", "usage",
-                 "allocatable_generation", "spec", "parent", "children")
+                 "allocatable_generation", "spec", "parent", "children",
+                 "_root_name", "_is_hier")
 
     def __init__(self, name: str, spec=None):
         self.name = name
@@ -71,6 +72,12 @@ class Cohort:
         self.spec = spec  # Optional[CohortSpec]
         self.parent: Optional["Cohort"] = None
         self.children: List["Cohort"] = []
+        # Lazy memos for the admission cycle's per-entry walks. Parent
+        # links are fixed once a snapshot's tree is built (hierarchy
+        # changes rebuild the snapshot wholesale), so both are stable for
+        # the object's lifetime.
+        self._root_name: Optional[str] = None
+        self._is_hier: Optional[bool] = None
 
     # -- hierarchy helpers (KEP-79) -----------------------------------------
 
@@ -80,12 +87,23 @@ class Cohort:
             node = node.parent
         return node
 
+    @property
+    def root_name(self) -> str:
+        rn = self._root_name
+        if rn is None:
+            rn = self._root_name = self.root().name
+        return rn
+
     def is_hierarchical(self) -> bool:
         """True when the tree extends beyond a flat 2-level cohort."""
-        node = self.root()
-        return (node is not self or bool(self.children)
+        h = self._is_hier
+        if h is None:
+            node = self.root()
+            h = self._is_hier = (
+                node is not self or bool(self.children)
                 or (self.spec is not None
                     and bool(self.spec.resource_groups)))
+        return h
 
     def tree_cluster_queues(self) -> List["CachedClusterQueue"]:
         """All member CQs in the subtree rooted here (preemption and
@@ -648,13 +666,23 @@ class Cache:
         commits all of a tick's admissions at cycle end (the cycle's fit
         math runs against the frozen snapshot plus its own side-tracked
         reservations, so nothing in-cycle reads the cache — see
-        scheduler._flush_assumes). `items` is [(workload, triples)] where
-        triples is the precomputed admission usage flattening (or None to
-        derive lazily). Returns one entry per workload: the accounted
-        WorkloadInfo on success, an error string otherwise."""
+        scheduler._flush_assumes). `items` is
+        [(workload, triples, info, admitted)]:
+
+        - `triples` — precomputed admission usage flattening, or None to
+          derive lazily (reclaim/partial-admission cases);
+        - `info` — an existing WorkloadInfo to account (the scheduler
+          entry's own; only passed when `triples` is set, i.e. the
+          admission usage equals the spec-based totals the info already
+          memoized). None constructs a fresh info;
+        - `admitted` — the Admitted-condition verdict the caller just
+          computed, or None to read it off the workload.
+
+        Returns one entry per workload: the accounted WorkloadInfo on
+        success, an error string otherwise."""
         out = []
         with self._lock:
-            for wl, triples in items:
+            for wl, triples, info, admitted in items:
                 if wl.admission is None:
                     out.append("workload has no admission")
                     continue
@@ -667,10 +695,13 @@ class Cache:
                     out.append(
                         f"ClusterQueue {wl.admission.cluster_queue} not found")
                     continue
-                wi = WorkloadInfo(wl, cluster_queue=cq.name)
+                if info is not None and info.cluster_queue == cq.name:
+                    wi = info
+                else:
+                    wi = WorkloadInfo(wl, cluster_queue=cq.name)
                 if triples is not None:
                     wi._usage_triples = triples
-                adm = wl.is_admitted
+                adm = wl.is_admitted if admitted is None else admitted
                 cq.add_workload_usage(wi, admitted=adm)
                 self._lq_note(wi, 1, adm)
                 self.assumed_workloads[key] = cq.name

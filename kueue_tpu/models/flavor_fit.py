@@ -701,13 +701,31 @@ class BatchSolver:
             self._key = key
         return self._enc
 
-    def preemption_context(self):
+    def encoding_matches(self, snapshot: Snapshot) -> bool:
+        """True when the solver's current encoding was built from exactly
+        this snapshot's structure (and feature bits). Index-space state
+        minted against an encoding (Assignment.usage_idx, BatchContext
+        tensors) is only valid while this holds — in pipelined mode a
+        structural change (CQ/flavor/cohort mutation) can rotate the
+        encoding between a tick's dispatch and its finish, permuting
+        flavor/resource indices. Consumers must fall back to the
+        name-based walks when this returns False."""
+        return self._enc is not None and self._key == (
+            snapshot.structure_version,
+            features.enabled(features.LENDING_LIMIT),
+            features.enabled(features.FAIR_SHARING),
+        )
+
+    def preemption_context(self, snapshot: Optional[Snapshot] = None):
         """(BatchContext, usage tensor) for the batched device victim
         search (ops/preemption_batch), or None when unavailable (no
-        encoding yet, or hierarchical cohorts — the tree walk lives only
-        in the host referee)."""
+        encoding yet, a stale encoding relative to the caller's snapshot,
+        or hierarchical cohorts — the tree walk lives only in the host
+        referee)."""
         enc = self._enc
         if enc is None or self._usage_enc is None or enc.hier is not None:
+            return None
+        if snapshot is not None and not self.encoding_matches(snapshot):
             return None
         if self._preempt_ctx is None:
             from kueue_tpu.ops.preemption_batch import BatchContext
@@ -797,7 +815,9 @@ class BatchSolver:
         if self._usage_enc is not None:
             self._usage_enc.apply_delta(cq_name, usage_frq, -1)
 
-    def revalidate_fits(self, items) -> Optional[np.ndarray]:
+    def revalidate_fits(self, items,
+                        snapshot: Optional[Snapshot] = None,
+                        ) -> Optional[np.ndarray]:
         """Batched staleness re-validation of FIT assignments.
 
         `items`: sequence of (cq_name, assignment) — one per in-doubt FIT
@@ -821,6 +841,11 @@ class BatchSolver:
         enc = self._enc
         ue = self._usage_enc
         if enc is None or ue is None or enc.hier is not None:
+            return None
+        if snapshot is not None and not self.encoding_matches(snapshot):
+            # The encoding rotated under an in-flight tick (structural
+            # mutation mid-pipeline): the items' usage_idx coordinates are
+            # in the OLD index space. Fall back to the referee walk.
             return None
         ent, cis, fis, ris, vals = [], [], [], [], []
         cq_index = enc.cq_index

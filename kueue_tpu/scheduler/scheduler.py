@@ -199,7 +199,7 @@ class Scheduler:
         self._resolve(tick)
         ts = _time.perf_counter()
         entries = tick.entries
-        entries.sort(key=self._entry_sort_key)
+        self._sort_entries(entries)
         t2 = _time.perf_counter()
         phases.observe("nominate", value=t2 - t1)
         phases.observe("nominate.sort", value=t2 - ts)
@@ -384,7 +384,7 @@ class Scheduler:
         ctx_usage = None
         if self.preemption_engine in ("native", "jax", "pallas"):
             ctx_fn = getattr(self.batch_solver, "preemption_context", None)
-            ctx_usage = ctx_fn() if ctx_fn is not None else None
+            ctx_usage = ctx_fn(snapshot) if ctx_fn is not None else None
         if ctx_usage is not None:
             targets_list = preemption_mod.get_targets_batch(
                 [(wi, a) for _, wi, a in pairs],
@@ -458,6 +458,33 @@ class Scheduler:
         key.append(self.ordering.queue_order_time(e.info.obj))
         return tuple(key)
 
+    def _sort_entries(self, entries: List[Entry]) -> None:
+        """entryOrdering sort. Large ticks go through a stable lexsort over
+        per-component key arrays — same ordering as sorting on
+        `_entry_sort_key` tuples (both sorts are stable, components are
+        compared in the same significance order), without a thousand tuple
+        allocations and log-depth tuple comparisons on the hot path."""
+        n = len(entries)
+        if n < 64:
+            entries.sort(key=self._entry_sort_key)
+            return
+        import numpy as np
+        qot = self.ordering.queue_order_time
+        # np.lexsort keys run least-significant first.
+        keys = [np.fromiter((qot(e.info.obj) for e in entries),
+                            np.float64, count=n)]
+        if features.enabled(features.PRIORITY_SORTING_WITHIN_COHORT):
+            keys.append(np.fromiter((-e.info.obj.priority for e in entries),
+                                    np.int64, count=n))
+        if features.enabled(features.FAIR_SHARING):
+            keys.append(np.fromiter((e.share for e in entries),
+                                    np.float64, count=n))
+        keys.append(np.fromiter(
+            (e.assignment is not None and e.assignment.borrowing
+             for e in entries), bool, count=n))
+        order = np.lexsort(keys)
+        entries[:] = [entries[i] for i in order.tolist()]
+
     # -- admission cycle (scheduler.go:204-275) ------------------------------
 
     def _admission_cycle(self, entries: List[Entry], snapshot: Snapshot,
@@ -488,7 +515,7 @@ class Scheduler:
                 continue
             if cq.cohort is None:
                 prebatch.append(e)
-            elif first_per_root.setdefault(cq.cohort.root().name, e) is e:
+            elif first_per_root.setdefault(cq.cohort.root_name, e) is e:
                 prebatch.append(e)
         if prebatch:
             pre_targets = self._batched_targets(
@@ -507,7 +534,7 @@ class Scheduler:
             if fit_entries:
                 reval = getattr(self.batch_solver, "revalidate_fits", None)
                 mask = reval([(e.info.cluster_queue, e.assignment)
-                              for e in fit_entries]) \
+                              for e in fit_entries], snapshot=snapshot) \
                     if reval is not None else None
                 if mask is not None:
                     for e, ok in zip(fit_entries, mask):
@@ -549,7 +576,7 @@ class Scheduler:
                 # is genuinely consumed — not root-wide. The skip guard
                 # keys on the root (root() is self when flat).
                 hier = cq.cohort.is_hierarchical()
-                root_name = cq.cohort.root().name
+                root_name = cq.cohort.root_name
                 # A pending preemption invalidates later preemption
                 # calculations only where this cycle actually reserved
                 # common flavor-resources (scheduler.go:218-222).
@@ -626,14 +653,14 @@ class Scheduler:
                         f". Pending the preemption of {count} workload(s)"
                     e.requeue_reason = RequeueReason.PENDING_PREEMPTION
                     if cq.cohort is not None:
-                        cycle_cohorts_skip_preemption.add(cq.cohort.root().name)
+                        cycle_cohorts_skip_preemption.add(cq.cohort.root_name)
                 continue
             e.status = NOMINATED
             self._admit(e, cq, pending_assumes)
             if cq.cohort is not None:
-                cycle_cohorts_skip_preemption.add(cq.cohort.root().name)
+                cycle_cohorts_skip_preemption.add(cq.cohort.root_name)
         t_flush = _time.perf_counter()
-        admitted = self._flush_assumes(pending_assumes)
+        admitted = self._flush_assumes(pending_assumes, snapshot)
         REGISTRY.tick_phase_seconds.observe(
             "admit.flush", value=_time.perf_counter() - t_flush)
         for e, cq in preempting:
@@ -719,26 +746,28 @@ class Scheduler:
         if was_evicted:
             # A readmitted workload is no longer evicted (status flips,
             # so the transition time moves).
-            evicted_cond.last_transition_time = now
-            evicted_cond.status = False
-            evicted_cond.reason = "QuotaReserved"
-            evicted_cond.message = ""
+            _set_condition_via(cmap, wl, "Evicted", False, "QuotaReserved",
+                               now)
         # Admitted syncs at admit time when the workload carries every
         # check the CQ requires AND all of its recorded check states are
         # Ready (scheduler.go:502-505 HasAllChecks + SyncAdmittedCondition
         # — a Pending state blocks Admitted even on a checkless CQ).
         states = wl.admission_check_states
+        admitted_now = False
         if not states:
             if not cq.admission_checks:
                 _set_condition_via(cmap, wl, "Admitted", True, "Admitted",
                                    now)
+                admitted_now = True
         elif cq.admission_checks <= states.keys() and all(
                 s.state == "Ready" for s in states.values()):
             _set_condition_via(cmap, wl, "Admitted", True, "Admitted", now)
-        pending.append((e, wait_started, triples))
+            admitted_now = True
+        pending.append((e, wait_started, triples, admitted_now))
         return True
 
-    def _flush_assumes(self, pending: list) -> int:
+    def _flush_assumes(self, pending: list,
+                       snapshot: Optional[Snapshot] = None) -> int:
         """End-of-cycle bulk commit of every reserved entry: one locked
         cache pass, then the apply callback per success (assume-before-
         apply, exactly the reference's admit() order), queued mirror
@@ -747,17 +776,29 @@ class Scheduler:
         if not pending:
             return 0
         t_a = _time.perf_counter()
+        # Pass the entry's own info when the flattened triples exist — in
+        # exactly that case (no reclaim scaling, spec counts) the admission
+        # usage equals the spec-based totals the info already memoized, so
+        # the cache can account it without constructing a fresh info.
         results = self.cache.assume_workloads(
-            [(e.info.obj, triples) for e, _, triples in pending])
+            [(e.info.obj, triples, e.info if triples is not None else None,
+              admitted_now)
+             for e, _, triples, admitted_now in pending])
         REGISTRY.tick_phase_seconds.observe(
             "admit.flush.assume", value=_time.perf_counter() - t_a)
         now = self.clock()
         note_items = []
         note_bulk = getattr(self.batch_solver, "note_admissions", None)
+        # usage_idx coordinates are only valid in the encoding they were
+        # decoded against; after a mid-pipeline structural change the
+        # solver's encoding (and usage tensor) rotated to a new index
+        # space — fall back to the name-keyed usage dicts then.
+        idx_ok = note_bulk is not None and snapshot is not None and getattr(
+            self.batch_solver, "encoding_matches", lambda s: False)(snapshot)
         admitted = 0
         wait_samples = []
         admit_counts: Dict[tuple, int] = {}
-        for (e, wait_started, triples), assumed in zip(pending, results):
+        for (e, wait_started, triples, _adm), assumed in zip(pending, results):
             wl = e.info.obj
             if isinstance(assumed, str):
                 # Defensive (duplicate assume / CQ deleted mid-tick):
@@ -788,8 +829,8 @@ class Scheduler:
             # flattened triples exist (no reclaim, spec counts — the
             # accounted usage IS the assignment usage) pass the decode's
             # integer coordinates so the solver skips the dict walk.
-            idx = e.assignment.usage_idx \
-                if triples is not None and note_bulk is not None else None
+            idx = e.assignment.usage_idx if triples is not None and idx_ok \
+                else None
             note_items.append((
                 e.info.cluster_queue,
                 None if idx is not None else assumed.usage(), idx))

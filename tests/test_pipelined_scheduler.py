@@ -92,3 +92,38 @@ class TestPipelinedEquivalence:
         assert not fw._inflight_ticks
         assert sum(len(fw.admitted_workloads(f"cq-{c}"))
                    for c in range(4)) == 4
+
+    def test_structural_change_mid_pipeline(self):
+        """A structural mutation (new CQ + flavor) landing between a
+        tick's dispatch and its finish rotates the solver's encoding to a
+        new flavor/resource index space. In-flight assignments carry
+        usage_idx coordinates in the OLD space — the finish must detect
+        the rotation (BatchSolver.encoding_matches) and fall back to the
+        name-keyed walks instead of scattering into the wrong cells: no
+        crash, no overadmission, correct usage accounting."""
+        fw = build_fw(4)
+        submit_backlog(fw, per_cq=10)
+        # Dispatch a first tick (in flight, not finished at depth 4).
+        fw.tick()
+        assert fw._inflight_ticks
+        # Structural mutation: a new flavor sorted BEFORE "default" plus a
+        # CQ using it — the rebuilt encoding permutes flavor indices.
+        fw.create_resource_flavor(ResourceFlavor.make("aaa-first"))
+        fw.create_cluster_queue(ClusterQueue(
+            name="cq-new",
+            resource_groups=(ResourceGroup(
+                ("cpu",), (FlavorQuotas.make("aaa-first", cpu=8),)),)))
+        fw.create_local_queue(LocalQueue(
+            name="lq-new", namespace="default", cluster_queue="cq-new"))
+        fw.run_until_settled(max_ticks=80)
+        for c in range(4):
+            assert usage_cpu(fw, f"cq-{c}") <= 8000
+            assert len(fw.admitted_workloads(f"cq-{c}")) == 4
+        # The solver usage tensor stayed in lockstep with the cache: one
+        # more tick's worth of solves must still see correct remaining
+        # quota (a wrong-cell scatter would shift later decisions).
+        fw.submit(Workload(
+            name="probe", queue_name="lq-0", creation_time=999.0,
+            pod_sets=[PodSet.make("main", count=1, cpu=2)]))
+        fw.run_until_settled(max_ticks=20)
+        assert usage_cpu(fw, "cq-0") <= 8000
