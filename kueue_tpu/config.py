@@ -139,6 +139,11 @@ class TPUSolverConfig:
     enable: Optional[bool] = None
     pipeline_depth: int = 1
     preemption_engine: Optional[str] = None
+    # Multi-chip scale-out (parallel/mesh.py): shard every solve over a
+    # jax.sharding.Mesh of this many devices (CQ usage partitioned with
+    # on-device cohort psum/all_gather over ICI; workload batch
+    # data-parallel). 0/1 = single-device; -1 = all visible devices.
+    shard_devices: int = 0
 
 
 @dataclass(frozen=True)
@@ -314,7 +319,8 @@ def from_dict(doc: Mapping[str, Any]) -> Configuration:
         ts = TPUSolverConfig(
             enable=None if enable is None else bool(enable),
             pipeline_depth=int(t.get("pipelineDepth", 1)),
-            preemption_engine=t.get("preemptionEngine"))
+            preemption_engine=t.get("preemptionEngine"),
+            shard_devices=int(t.get("shardDevices", 0)))
 
     le = LeaderElectionConfig()
     if doc.get("leaderElection") is not None:
@@ -455,6 +461,9 @@ def validate_configuration(cfg: Configuration) -> List[str]:
                                                 "native", "jax", "pallas"):
         errors.append("tpuSolver.preemptionEngine: must be one of "
                       "auto, host, native, jax, pallas (or omitted for auto)")
+    if cfg.tpu_solver.shard_devices < -1:
+        errors.append("tpuSolver.shardDevices: must be -1 (all devices), "
+                      "0/1 (single device), or a positive device count")
 
     # leaderElection
     le = cfg.leader_election

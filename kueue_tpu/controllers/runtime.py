@@ -118,7 +118,21 @@ class Framework:
                 solver_enable = _accelerator_present()
             if solver_enable:
                 from kueue_tpu.models.flavor_fit import BatchSolver
-                batch_solver = BatchSolver()
+                shard = self.config.tpu_solver.shard_devices
+                mesh = None
+                if shard == -1 or shard > 1:
+                    # Multi-chip: shard the solve over the device mesh
+                    # (parallel/mesh.py — CQ axis partitioned, cohort
+                    # aggregation via ICI collectives).
+                    from kueue_tpu.parallel.mesh import make_mesh
+                    mesh = make_mesh(None if shard == -1 else shard)
+                batch_solver = BatchSolver(mesh=mesh)
+        if getattr(batch_solver, "_mesh", None) is not None:
+            # The sharded program runs to completion at dispatch (its
+            # collectives ride ICI; there is no host-link round trip to
+            # overlap), so depth > 1 would add pipelining's staleness
+            # costs while hiding zero latency.
+            self.pipeline_depth = 1
         wfpr = self.config.wait_for_pods_ready
         if ordering is None:
             ordering = WorkloadOrdering(

@@ -258,6 +258,52 @@ class CachedClusterQueue:
             used += min(cq_used, self._guaranteed(flavor, resource))
         return used
 
+    def fit_in_cohort_fused(self, cycle_usage: FlavorResourceQuantities,
+                            assignment_usage: FlavorResourceQuantities,
+                            lending: bool):
+        """Admission-cycle gate for flat cohorts, fused into one walk over
+        the assignment's (flavor, resource) pairs. Returns (has_common,
+        fits): `has_common` mirrors scheduler._has_common_flavor_resources
+        (a pair is common when the cycle dict holds it, regardless of
+        value), `fits` mirrors fit_in_cohort(_common_usage_sum(...)) —
+        only common pairs are capacity-checked, against the same
+        requestable/used cohort pools (clusterqueue.go:130-144,
+        scheduler.go:213-233). `lending` is the caller-hoisted
+        LendingLimit gate (one feature lookup per cycle, not per pair)."""
+        has_common = False
+        fits = True
+        cohort = self.cohort
+        creq = cohort.requestable_resources
+        cuse = cohort.usage
+        for flavor, resources in assignment_usage.items():
+            cyc_f = cycle_usage.get(flavor)
+            if cyc_f is None:
+                continue
+            creq_f = creq.get(flavor)
+            cuse_f = cuse.get(flavor)
+            for resource, value in resources.items():
+                cv = cyc_f.get(resource)
+                if cv is None:
+                    continue
+                has_common = True
+                if not fits:
+                    continue
+                if creq_f is None:
+                    # flavor not requestable in the cohort at all
+                    # (fit_in_cohort's membership check).
+                    fits = False
+                    continue
+                g = self.guaranteed_quota.get(flavor, {}).get(resource, 0) \
+                    if lending else 0
+                avail = creq_f.get(resource, 0) + g
+                used = cuse_f.get(resource, 0) if cuse_f is not None else 0
+                if lending:
+                    used += min(
+                        self.usage.get(flavor, {}).get(resource, 0), g)
+                if avail - used < value + cv:
+                    fits = False
+        return has_common, fits
+
     def fit_in_cohort(self, q: FlavorResourceQuantities) -> bool:
         """reference: clusterqueue.go:130-144; hierarchical trees use the
         KEP-79 T-invariant walk instead of the flat capacity arithmetic."""

@@ -662,13 +662,21 @@ class BatchSolver:
 
     _profiler_started = False
 
-    def __init__(self):
+    def __init__(self, mesh=None):
+        """`mesh` (a jax.sharding.Mesh, e.g. parallel.mesh.make_mesh())
+        shards every solve over the mesh's devices: ClusterQueue usage is
+        partitioned on the CQ axis with on-device cohort aggregation
+        (psum/all_gather over ICI) and the workload batch is
+        data-parallel — the multi-chip scale-out path of
+        kueue_tpu.parallel.mesh, selected in production via
+        Configuration.tpuSolver.shardDevices. None = single-device."""
         self._key = None
         self._enc: Optional[sch.CQEncoding] = None
         self._static: Optional[tuple] = None
         self._usage_enc: Optional[sch.UsageEncoder] = None
         self._row_cache: Optional[sch.WorkloadRowCache] = None
         self._preempt_ctx = None
+        self._mesh = mesh
         # Optional XLA profiler hook (SURVEY §5): point TensorBoard at this
         # port to trace the device solves.
         port = os.environ.get("KUEUE_XLA_PROFILER_PORT")
@@ -753,14 +761,26 @@ class BatchSolver:
         wt = sch.encode_workloads(workloads, snapshot, enc,
                                   row_cache=self._row_cache)
         tb = _t.perf_counter()
-        handle = solve_flavor_fit_async(enc, usage, wt, static=self._static)
+        if self._mesh is not None:
+            # Multi-chip: the sharded program runs to completion here
+            # (its collectives ride ICI, not the host link, so there is
+            # no tunnel round trip to hide; the workload batch is
+            # data-parallel over the mesh).
+            from kueue_tpu.parallel.mesh import sharded_flavor_fit
+            out = sharded_flavor_fit(enc, usage, wt, self._mesh)
+            handle = None
+        else:
+            out = None
+            handle = solve_flavor_fit_async(enc, usage, wt,
+                                            static=self._static)
         t1 = _t.perf_counter()
         phases.observe("tensorize", value=t1 - t0)
         phases.observe("tensorize.refresh", value=ta - t0)
         phases.observe("tensorize.encode", value=tb - ta)
         phases.observe("tensorize.dispatch", value=t1 - tb)
         return {"workloads": list(workloads), "snapshot": snapshot,
-                "enc": enc, "wt": wt, "handle": handle, "dispatched": t1}
+                "enc": enc, "wt": wt, "handle": handle, "out": out,
+                "dispatched": t1}
 
     def collect(self, inflight: dict) -> List[Assignment]:
         """Fetch + decode a solve dispatched by solve_async."""
@@ -770,7 +790,8 @@ class BatchSolver:
 
         phases = REGISTRY.tick_phase_seconds
         t1 = _t.perf_counter()
-        out = fetch_outputs(inflight["handle"])
+        out = inflight["out"] if inflight.get("out") is not None \
+            else fetch_outputs(inflight["handle"])
         t2 = _t.perf_counter()
         phases.observe("device_solve", value=t2 - t1)
         assignments = decode_assignments(

@@ -41,6 +41,12 @@ _PROGRAM_CACHE: Dict[Tuple, "jax.stages.Wrapped"] = {}
 def make_mesh(n_devices: Optional[int] = None, axis: str = AXIS) -> Mesh:
     devices = jax.devices()
     if n_devices is not None:
+        if n_devices > len(devices):
+            # Fail loudly: silently running on fewer chips than configured
+            # would leave the operator believing N-way sharding is active.
+            raise ValueError(
+                f"requested a {n_devices}-device mesh but only "
+                f"{len(devices)} device(s) are visible")
         devices = devices[:n_devices]
     return Mesh(np.array(devices), (axis,))
 

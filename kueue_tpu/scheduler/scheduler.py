@@ -495,6 +495,9 @@ class Scheduler:
         # node == root and the two dicts coincide).
         cycle_root_usage: Dict[str, FlavorResourceQuantities] = {}
         cycle_cohorts_skip_preemption: Set[str] = set()
+        # Hoisted once per cycle for the fused cohort gate (the per-pair
+        # helpers each re-read the gate otherwise).
+        lending = features.enabled(features.LENDING_LIMIT)
         preempting: List = []
         pending_assumes: List = []
         # Deferred victim searches, pre-batched for the entries most likely
@@ -591,13 +594,16 @@ class Scheduler:
                                 cq, e.assignment.usage,
                                 extra=cycle_cohorts_usage):
                             blocked = True
-                    elif _has_common_flavor_resources(
-                            cycle_cohorts_usage.get(root_name),
-                            e.assignment.usage):
-                        total = _common_usage_sum(
-                            cycle_cohorts_usage[root_name],
-                            e.assignment.usage)
-                        blocked = not cq.fit_in_cohort(total)
+                    else:
+                        node = cycle_cohorts_usage.get(root_name)
+                        if node:
+                            # Fused common-pair + capacity walk — same
+                            # verdict as _has_common_flavor_resources +
+                            # _common_usage_sum + fit_in_cohort in one
+                            # pass over the assignment's pairs.
+                            common, ok = cq.fit_in_cohort_fused(
+                                node, e.assignment.usage, lending)
+                            blocked = common and not ok
                 if blocked:
                     e.status = SKIPPED
                     e.inadmissible_msg = \

@@ -17,6 +17,21 @@ T = TypeVar("T")
 
 DEFAULT_WORKERS = 8
 
+# One long-lived pool for the default fan-out: the scheduler issues one
+# for_each per preempting entry per cycle (~100/tick at preemption-heavy
+# scale), and constructing/tearing down a ThreadPoolExecutor per call
+# costs more than the apply work it parallelizes. Lazily created;
+# never shut down (daemonic usage pattern, same lifetime as the process).
+_SHARED_POOL: Optional[ThreadPoolExecutor] = None
+
+
+def _shared_pool() -> ThreadPoolExecutor:
+    global _SHARED_POOL
+    if _SHARED_POOL is None:
+        _SHARED_POOL = ThreadPoolExecutor(
+            max_workers=DEFAULT_WORKERS, thread_name_prefix="kueue-par")
+    return _SHARED_POOL
+
 
 def until(n: int, fn: Callable[[int], None],
           workers: int = DEFAULT_WORKERS) -> Optional[BaseException]:
@@ -33,6 +48,14 @@ def until(n: int, fn: Callable[[int], None],
             return exc
         return None
     first: list = [None]
+    if workers == DEFAULT_WORKERS:
+        pool = _shared_pool()
+        futures = [pool.submit(fn, i) for i in range(n)]
+        for f in futures:
+            exc = f.exception()
+            if exc is not None and first[0] is None:
+                first[0] = exc
+        return first[0]
     with ThreadPoolExecutor(max_workers=min(workers, n)) as pool:
         futures = [pool.submit(fn, i) for i in range(n)]
         for f in futures:

@@ -1,5 +1,6 @@
 from kueue_tpu import features
-from kueue_tpu.api.types import Admission, PodSetAssignment
+from kueue_tpu.api.types import (Admission, FlavorQuotas,
+                                 PodSetAssignment, ResourceQuota)
 from kueue_tpu.core.cache import Cache
 
 from tests.util import fq, make_cq, make_flavor, make_lq, make_wl, rg
@@ -233,3 +234,82 @@ def test_lq_stats_survive_delete_recreate_to_new_cq():
     cache.delete_workload(wl)
     st = cache.local_queue_status("default/main")
     assert st["reservingWorkloads"] == 0 and st["admittedWorkloads"] == 0
+
+
+def test_fit_in_cohort_fused_matches_split_path():
+    """The admission cycle's fused cohort gate must agree with the
+    three-step reference path (_has_common_flavor_resources +
+    _common_usage_sum + fit_in_cohort) on randomized cycle/assignment
+    usage — with and without LendingLimit quota splits. Pins the
+    hand-inlined quota arithmetic of fit_in_cohort_fused to the shared
+    helpers it duplicates."""
+    import random
+
+    from kueue_tpu.scheduler.scheduler import (
+        _common_usage_sum,
+        _has_common_flavor_resources,
+    )
+
+    rnd = random.Random(7)
+    flavors = ["f0", "f1", "f2"]
+    resources = ["cpu", "memory"]
+
+    for lending in (False, True):
+        features.set_enabled("LendingLimit", lending)
+        for trial in range(200):
+            cache = Cache()
+            for f in flavors:
+                cache.add_or_update_resource_flavor(make_flavor(f))
+            for c in range(3):
+                quotas = []
+                for f in flavors:
+                    kw = {r: rnd.randint(1, 8) for r in resources}
+                    q = fq(f, **kw)
+                    if lending and rnd.random() < 0.5:
+                        q = FlavorQuotas(name=f, resources=tuple(
+                            (rn, ResourceQuota(
+                                nominal=rq.nominal,
+                                lending_limit=rnd.randint(
+                                    0, rq.nominal // resource_scale(rn))
+                                * resource_scale(rn)))
+                            for rn, rq in q.resources))
+                    quotas.append(q)
+                cache.add_cluster_queue(make_cq(
+                    f"cq-{c}", rg(tuple(resources), *quotas), cohort="pool"))
+            snap = cache.snapshot()
+            cq = snap.cluster_queues["cq-0"]
+            # Random admitted usage on cq-0 so the lending min() path sees
+            # nonzero own usage.
+            for f in flavors:
+                for r in resources:
+                    if rnd.random() < 0.5:
+                        cq.usage.setdefault(f, {})[r] = \
+                            rnd.randint(0, 6) * resource_scale(r)
+
+            def rand_frq(p=0.5):
+                out = {}
+                for f in flavors:
+                    for r in resources:
+                        if rnd.random() < p:
+                            out.setdefault(f, {})[r] = \
+                                rnd.randint(0, 5) * resource_scale(r)
+                return out
+
+            cycle = rand_frq()
+            assignment = rand_frq(0.7)
+            if not assignment:
+                continue
+
+            common_ref = _has_common_flavor_resources(cycle, assignment)
+            fits_ref = True
+            if common_ref:
+                fits_ref = cq.fit_in_cohort(
+                    _common_usage_sum(cycle, assignment))
+            common, fits = cq.fit_in_cohort_fused(cycle, assignment, lending)
+            assert common == common_ref, (trial, lending, cycle, assignment)
+            if common:
+                assert fits == fits_ref, (trial, lending, cycle, assignment)
+
+
+def resource_scale(r):
+    return 1000 if r == "cpu" else 1
