@@ -9,6 +9,7 @@ add/remove-workload simulation primitive used by preemption
 
 from __future__ import annotations
 
+import time as _time
 from typing import Dict, List, Optional, Set, Tuple
 
 from kueue_tpu import features
@@ -21,6 +22,10 @@ from kueue_tpu.core.cache import (
     frq_clone,
 )
 from kueue_tpu.core.workload import WorkloadInfo
+from kueue_tpu.metrics import REGISTRY
+from kueue_tpu.utils import native_ledger
+
+_ledger = native_ledger.load()
 
 
 class Snapshot:
@@ -285,10 +290,11 @@ class SnapshotMirror:
         returned by assume_workload to reuse its precomputed totals."""
         if self._snap is None or wl.admission is None:
             return
-        cache_cq = self.cache.cluster_queues.get(wl.admission.cluster_queue)
+        cq_name = wl.admission.cluster_queue
+        cache_cq = self.cache.cluster_queues.get(cq_name)
         if cache_cq is None:
             return
-        self._pending.append((1, wl, cache_cq.usage_version,
+        self._pending.append((1, wl, cq_name, cache_cq.usage_version,
                               cache_cq.allocatable_generation, wi))
 
     def note_removal(self, wl) -> None:
@@ -296,10 +302,17 @@ class SnapshotMirror:
         (call right after the cache mutation)."""
         if self._snap is None or wl.admission is None:
             return
-        cache_cq = self.cache.cluster_queues.get(wl.admission.cluster_queue)
+        cq_name = wl.admission.cluster_queue
+        cache_cq = self.cache.cluster_queues.get(cq_name)
         if cache_cq is None:
             return
-        self._pending.append((-1, wl, cache_cq.usage_version,
+        # The ClusterQueue name is captured NOW: eviction reconciling
+        # clears wl.admission right after noting the removal, so deriving
+        # the queue at flush time would silently drop the mutation — and
+        # when a later same-CQ admission in the same batch records a newer
+        # base version, the dirty-walk re-clone that would otherwise heal
+        # the drop is masked, leaving the mirror overcounting usage.
+        self._pending.append((-1, wl, cq_name, cache_cq.usage_version,
                               cache_cq.allocatable_generation, None))
 
     def flush_pending(self) -> None:
@@ -313,13 +326,30 @@ class SnapshotMirror:
         scale this loop folds ~2k completion/admission mutations per tick."""
         if self._snap is None or not self._pending:
             return
+        t0 = _time.perf_counter()
         pending, self._pending = self._pending, []
         self.mutation_count += len(pending)
         snap_cqs = self._snap.cluster_queues
         base = self._base
-        for sign, wl, version, alloc_gen, wi in pending:
-            cq = snap_cqs.get(wl.admission.cluster_queue
-                              if wl.admission else "")
+        try:
+            self._flush_items(pending, snap_cqs, base)
+        finally:
+            REGISTRY.tick_phase_seconds.observe(
+                "snapshot.flush", value=_time.perf_counter() - t0)
+
+    def _flush_items(self, pending, snap_cqs, base) -> None:
+        if (_ledger is not None
+                and not features.enabled(features.LENDING_LIMIT)
+                and all(item[5] is not None or item[0] < 0
+                        for item in pending)):
+            # Native walk (ledger.cpp flush_mirror): identical add/remove +
+            # usage/cohort-usage/version bookkeeping; the Python loop below
+            # stays the LendingLimit-path (guaranteed-quota clamps) and
+            # info-less-addition implementation.
+            _ledger.flush_mirror(snap_cqs, base, pending)
+            return
+        for sign, wl, cq_name, version, alloc_gen, wi in pending:
+            cq = snap_cqs.get(cq_name)
             if cq is None:
                 continue
             if sign > 0:

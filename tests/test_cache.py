@@ -313,3 +313,129 @@ def test_fit_in_cohort_fused_matches_split_path():
 
 def resource_scale(r):
     return 1000 if r == "cpu" else 1
+
+
+def test_flush_mirror_native_matches_python(monkeypatch):
+    """The native SnapshotMirror flush (ledger.cpp flush_mirror) must leave
+    the mirrored snapshot byte-identical to the Python loop over the same
+    randomized admission/removal stream."""
+    import random
+
+    from kueue_tpu.api.types import PodSet, Workload
+    from kueue_tpu.core import snapshot as snapshot_mod
+    from kueue_tpu.core.snapshot import SnapshotMirror
+    from kueue_tpu.core.workload import WorkloadInfo
+
+    if snapshot_mod._ledger is None:
+        import pytest as _pytest
+        _pytest.skip("native ledger unavailable")
+
+    def build_cache():
+        cache = Cache()
+        cache.add_or_update_resource_flavor(make_flavor("default"))
+        for c in range(4):
+            cache.add_cluster_queue(make_cq(
+                f"cq-{c}", rg(("cpu", "memory"),
+                              fq("default", cpu=64, memory="64Gi")),
+                cohort="pool" if c % 2 else ""))
+            cache.add_local_queue(make_lq(f"lq-{c}", cq=f"cq-{c}"))
+        return cache
+
+    def run(native: bool):
+        if not native:
+            monkeypatch.setattr(snapshot_mod, "_ledger", None)
+        cache = build_cache()
+        mirror = SnapshotMirror(cache)
+        mirror.refresh()
+        rnd = random.Random(11)
+        live = []
+        for step in range(300):
+            if live and rnd.random() < 0.4:
+                wl, wi = live.pop(rnd.randrange(len(live)))
+                cache.delete_workload(wl)
+                mirror.note_removal(wl)
+            else:
+                i = len(live) + step
+                c = rnd.randrange(4)
+                wl = Workload(
+                    name=f"w{step}-{i}", queue_name=f"lq-{c}",
+                    creation_time=float(step),
+                    pod_sets=[PodSet.make("m", rnd.randint(1, 3),
+                                          cpu=rnd.randint(1, 4),
+                                          memory="1Gi")])
+                from kueue_tpu.api.types import (Admission,
+                                                 PodSetAssignment)
+                ps = wl.pod_sets[0]
+                wl.admission = Admission(
+                    cluster_queue=f"cq-{c}",
+                    pod_set_assignments=[PodSetAssignment(
+                        name="m", flavors={"cpu": "default",
+                                           "memory": "default"},
+                        resource_usage={"cpu": 1000 * ps.count,
+                                        "memory": 1024**3 * ps.count},
+                        count=ps.count)])
+                wl.set_condition("QuotaReserved", True, now=1.0)
+                wi = cache.assume_workload(wl)
+                mirror.note_admission(wl, wi)
+                live.append((wl, wi))
+            if step % 37 == 0:
+                mirror.refresh()
+        snap = mirror.refresh()
+        return {
+            name: (dict(cq.usage),
+                   sorted(cq.workloads),
+                   cq.usage_version,
+                   dict(cq.cohort.usage) if cq.cohort else None)
+            for name, cq in snap.cluster_queues.items()}
+
+    native_state = run(True)
+    python_state = run(False)
+    assert native_state == python_state
+
+
+def test_mirror_removal_not_masked_by_same_batch_admission():
+    """Eviction reconciling clears wl.admission right after noting the
+    removal. The mirror must still apply that removal at the next flush —
+    and a later same-CQ admission in the same pending batch (recording a
+    newer base version) must not mask the drop. Regression for the
+    flush-time admission re-derivation bug: the mirrored clone would keep
+    counting the evicted workload's usage forever."""
+    from kueue_tpu.api.types import Admission, PodSet, PodSetAssignment, Workload
+    from kueue_tpu.core.snapshot import SnapshotMirror
+
+    cache = Cache()
+    cache.add_or_update_resource_flavor(make_flavor("default"))
+    cache.add_cluster_queue(make_cq(
+        "cq", rg("cpu", fq("default", cpu=8))))
+    cache.add_local_queue(make_lq("lq", cq="cq"))
+    mirror = SnapshotMirror(cache)
+    mirror.refresh()
+
+    def admit(name):
+        wl = Workload(name=name, queue_name="lq", creation_time=1.0,
+                      pod_sets=[PodSet.make("m", 1, cpu=2)])
+        wl.admission = Admission(cluster_queue="cq", pod_set_assignments=[
+            PodSetAssignment(name="m", flavors={"cpu": "default"},
+                             resource_usage={"cpu": 2000}, count=1)])
+        wl.set_condition("QuotaReserved", True, now=1.0)
+        wi = cache.assume_workload(wl)
+        mirror.note_admission(wl, wi)
+        return wl
+
+    victim = admit("victim")
+    mirror.refresh()
+
+    # Eviction flow (runtime.reconcile order): release from the cache,
+    # note the removal, THEN clear the admission.
+    cache.delete_workload(victim)
+    mirror.note_removal(victim)
+    victim.admission = None
+    # Same-batch later admission on the same ClusterQueue.
+    admit("winner")
+
+    snap = mirror.refresh()
+    cq = snap.cluster_queues["cq"]
+    assert cq.usage.get("default", {}).get("cpu", 0) == 2000, \
+        "mirror must reflect the eviction (only the winner's 2 cpu)"
+    assert "default/victim" not in cq.workloads
+    assert "default/winner" in cq.workloads
