@@ -547,7 +547,13 @@ class Framework:
 
     def _apply_admission(self, wl: Workload) -> bool:
         # The API write is in-memory: nothing can fail here.
-        self._check_sync_pending[wl.key] = wl
+        if not wl.is_admitted:
+            # Two-phase admission: queue for the reconcile pass's
+            # check-state sync. A workload already Admitted at apply time
+            # (checkless ClusterQueue — the admit path set the condition)
+            # has nothing to sync; reconcile would visit and immediately
+            # drop it.
+            self._check_sync_pending[wl.key] = wl
         cq = wl.admission.cluster_queue if wl.admission else ""
         self.events.event(
             wl.key, events_mod.NORMAL, events_mod.REASON_QUOTA_RESERVED,

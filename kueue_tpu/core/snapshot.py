@@ -198,7 +198,7 @@ class SnapshotMirror:
         # so note_admission/note_removal queue here and apply at the next
         # refresh.
         self._pending: List[
-            Tuple[int, object, int, int, Optional[WorkloadInfo]]] = []
+            Tuple[int, object, str, int, int, Optional[WorkloadInfo]]] = []
         # Monotonic count of snapshot mutations (lockstep applies and
         # re-clones). A pipelined tick records it at dispatch; a different
         # value at completion means the snapshot moved under the in-flight
