@@ -186,6 +186,14 @@ def run_config(*, label, num_cqs, num_cohorts, num_flavors, backlog, ticks,
 
 
 def run_one(config: str) -> None:
+    if os.environ.get("KUEUE_BENCH_FORCE_CPU") == "1":
+        # The parent's device probe found the accelerator unreachable
+        # (e.g. a remote-attachment outage). Pin the CPU backend through
+        # jax.config — the platform plugin ignores JAX_PLATFORMS alone —
+        # so the run still produces a measurement instead of hanging.
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
     smoke = os.environ.get("KUEUE_BENCH_SMOKE") == "1"
     depth = max(1, int(os.environ.get("KUEUE_BENCH_DEPTH", "4")))
     if smoke:
@@ -219,6 +227,24 @@ def run_one(config: str) -> None:
         }), flush=True)
 
 
+def _probe_device(timeout_s: float = 120.0) -> bool:
+    """True when the accelerator backend initializes within the budget.
+
+    Runs in a subprocess so a hung remote attachment (device tunnel
+    outage) can be killed instead of hanging the whole benchmark; the
+    caller falls back to the CPU backend in that case.
+    """
+    import subprocess
+    try:
+        res = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; assert jax.devices()"],
+            timeout=timeout_s, capture_output=True)
+        return res.returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
+
+
 def main() -> None:
     config = os.environ.get("KUEUE_BENCH_CONFIG")
     if config:
@@ -228,8 +254,13 @@ def main() -> None:
     # ONE cluster, and the first config's 50k-object heap would otherwise
     # fragment the allocator under the second's measurement.
     import subprocess
+    env_extra = {}
+    if not _probe_device():
+        print("# accelerator backend unreachable; falling back to the CPU "
+              "backend for this run", file=sys.stderr)
+        env_extra["KUEUE_BENCH_FORCE_CPU"] = "1"
     for config in ("preempt", "northstar"):
-        env = dict(os.environ, KUEUE_BENCH_CONFIG=config)
+        env = dict(os.environ, KUEUE_BENCH_CONFIG=config, **env_extra)
         res = subprocess.run([sys.executable, os.path.abspath(__file__)],
                              env=env, stdout=subprocess.PIPE)
         sys.stdout.buffer.write(res.stdout)
