@@ -46,16 +46,20 @@ LINGER_TICKS = 5
 
 
 def run_config(*, label, num_cqs, num_cohorts, num_flavors, backlog, ticks,
-               usage_fill, depth, preemption_heavy, seed=42):
+               usage_fill, depth, preemption_heavy, fair_hierarchy=False,
+               seed=42):
     from kueue_tpu.models.flavor_fit import BatchSolver
     from kueue_tpu.api.types import PodSet, Workload
     from kueue_tpu.utils.synthetic import synthetic_framework
 
+    if fair_hierarchy:
+        from kueue_tpu import features
+        features.set_enabled(features.FAIR_SHARING, True)
     t0 = time.perf_counter()
     fw = synthetic_framework(
         num_cqs=num_cqs, num_cohorts=num_cohorts, num_flavors=num_flavors,
         num_pending=backlog, usage_fill=usage_fill, seed=seed,
-        preemption_heavy=preemption_heavy,
+        preemption_heavy=preemption_heavy, fair_hierarchy=fair_hierarchy,
         batch_solver=BatchSolver(), pipeline_depth=depth)
     t_setup = time.perf_counter() - t0
 
@@ -216,6 +220,20 @@ def run_one(config: str) -> None:
             "unit": "ms",
             "vs_baseline": round(100.0 / p99_pre, 3) if p99_pre > 0 else None,
         }), flush=True)
+    elif config == "fair":
+        # BASELINE config #4: weighted-DRF fair sharing over a KEP-79
+        # hierarchical cohort tree (leaf cohorts -> mids -> root) — the
+        # greenfield feature pair, at the same scale as the headline.
+        _, p99_fair = run_config(
+            label="fair", ticks=max(ticks // 2, 8), usage_fill=0.7,
+            depth=depth, preemption_heavy=False, fair_hierarchy=True,
+            **shape)
+        print(json.dumps({
+            "metric": "p99_fair_hier_tick_ms", "value": round(p99_fair, 3),
+            "unit": "ms",
+            "vs_baseline": round(100.0 / p99_fair, 3) if p99_fair > 0
+            else None,
+        }), flush=True)
     else:
         # North-star headline (config #5 shape): LAST line = parsed metric.
         _, p99 = run_config(
@@ -259,7 +277,7 @@ def main() -> None:
         print("# accelerator backend unreachable; falling back to the CPU "
               "backend for this run", file=sys.stderr)
         env_extra["KUEUE_BENCH_FORCE_CPU"] = "1"
-    for config in ("preempt", "northstar"):
+    for config in ("preempt", "fair", "northstar"):
         env = dict(os.environ, KUEUE_BENCH_CONFIG=config, **env_extra)
         res = subprocess.run([sys.executable, os.path.abspath(__file__)],
                              env=env, stdout=subprocess.PIPE)

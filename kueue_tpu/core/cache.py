@@ -61,7 +61,7 @@ class Cohort:
 
     __slots__ = ("name", "members", "requestable_resources", "usage",
                  "allocatable_generation", "spec", "parent", "children",
-                 "_root_name", "_is_hier")
+                 "_root_name", "_is_hier", "_tree_cap")
 
     def __init__(self, name: str, spec=None):
         self.name = name
@@ -78,6 +78,10 @@ class Cohort:
         # the object's lifetime.
         self._root_name: Optional[str] = None
         self._is_hier: Optional[bool] = None
+        # Whole-structure lendable capacity (hierarchy.tree_capacity),
+        # memoized on roots: it depends only on specs and member quotas,
+        # both structural (changes rebuild the snapshot's cohorts).
+        self._tree_cap: Optional[dict] = None
 
     # -- hierarchy helpers (KEP-79) -----------------------------------------
 

@@ -216,9 +216,14 @@ class SnapshotMirror:
         key = (cache.structure_version,
                features.enabled(features.LENDING_LIMIT),
                features.enabled(features.FAIR_SHARING))
-        # Hierarchical trees rebuild wholesale: their aggregate walk is
-        # tree-global and cheap relative to tree sizes seen in practice.
-        if self._snap is None or key != self._key or cache.cohort_specs:
+        # Hierarchical trees refresh incrementally too: the tree WIRING
+        # (parents/children, spec quotas, cycle-breaking) is structural —
+        # any change bumps structure_version and rebuilds wholesale — while
+        # usage churn only moves member ClusterQueues, and the KEP-79
+        # feasibility walk (core/hierarchy.py) reads member CQs through
+        # cohort.members rather than pre-accumulated node fields, so the
+        # dirty-CQ re-clone below keeps the tree view exact.
+        if self._snap is None or key != self._key:
             self._pending.clear()
             self._dirty.clear()
             self.mutation_count += 1
@@ -246,11 +251,15 @@ class SnapshotMirror:
             cq = cache.cluster_queues.get(name)
             if cq is None or self._base.get(name) == cq.usage_version:
                 continue
-            if not cq.active():
+            if not cq.active() or name in snap.inactive_cluster_queues:
                 # Snapshot.build excludes inactive CQs entirely (the
                 # reference skips them in snapshot.go); a usage-only change
                 # on a stopped/broken CQ must not re-insert it — just track
-                # the version so we don't revisit every refresh.
+                # the version so we don't revisit every refresh. The
+                # snapshot-side exclusion check matters for cohort-cycle
+                # deactivation (KEP-79): the cache-side active() cannot see
+                # it, and re-inserting would leave a phantom cohortless CQ
+                # that a from-scratch build excludes.
                 self._base[name] = cq.usage_version
                 continue
             self.mutation_count += 1

@@ -724,6 +724,20 @@ class BatchSolver:
             features.enabled(features.FAIR_SHARING),
         )
 
+    def hier_cycle_state(self, snapshot: Snapshot):
+        """Admission-cycle bookkeeping for hierarchical cohorts
+        (ops/hier_cycle.HierCycleState) built on this solver's dense
+        tensors, or None when unavailable (no hierarchy, no encoding, or
+        a stale encoding — the scheduler falls back to the per-entry
+        fits_in_hierarchy dict walk)."""
+        enc = self._enc
+        if enc is None or enc.hier is None or self._usage_enc is None:
+            return None
+        if not self.encoding_matches(snapshot):
+            return None
+        from kueue_tpu.ops.hier_cycle import HierCycleState
+        return HierCycleState(enc, self._usage_enc.usage)
+
     def preemption_context(self, snapshot: Optional[Snapshot] = None):
         """(BatchContext, usage tensor) for the batched device victim
         search (ops/preemption_batch), or None when unavailable (no
@@ -838,6 +852,7 @@ class BatchSolver:
 
     def revalidate_fits(self, items,
                         snapshot: Optional[Snapshot] = None,
+                        hier_state=None,
                         ) -> Optional[np.ndarray]:
         """Batched staleness re-validation of FIT assignments.
 
@@ -847,9 +862,10 @@ class BatchSolver:
         the name→index dict walks; referee-built ones fall back to the
         usage-dict walk. Returns a [n] bool mask (True = still fits
         against current usage), or None when the vectorized path cannot
-        answer (no encoding yet, hierarchical cohorts, or an unknown
+        answer (no encoding yet, a stale encoding, or an unknown
         CQ/flavor/resource) and the caller must fall back to the
-        per-entry referee.
+        per-entry referee. Hierarchical rows run the KEP-79 ancestor
+        walk on the dense node balances (ops/hier_cycle).
 
         This replaces ~one referee walk per admitted head per tick in
         pipelined mode (scheduler._assignment_still_fits) with one
@@ -861,7 +877,7 @@ class BatchSolver:
         matches the referee on the snapshot dicts."""
         enc = self._enc
         ue = self._usage_enc
-        if enc is None or ue is None or enc.hier is not None:
+        if enc is None or ue is None:
             return None
         if snapshot is not None and not self.encoding_matches(snapshot):
             # The encoding rotated under an in-flight tick (structural
@@ -919,7 +935,27 @@ class BatchSolver:
         cohort_req = enc.cohort_requestable()
         cohort_avail = cohort_req[k, fi, ri] + guar
         cohort_used = cohort_usage[k, fi, ri] + np.minimum(used, guar)
-        fits = (used + val <= nom + blim) \
-            & (cohort_used + val <= cohort_avail)
+        cohort_ok = cohort_used + val <= cohort_avail
+        if enc.hier is not None:
+            # Hierarchical rows: the flat pool arithmetic does not model
+            # the tree; run the KEP-79 ancestor walk on the dense node
+            # balances instead (O(depth) per pair — the per-entry dict
+            # referee was O(tree) per pair and dominated pipelined fair-
+            # sharing ticks).
+            hmask = enc.hier.cq_hier[ci]
+            rows = np.nonzero(hmask)[0]
+            if rows.size:
+                # `hier_state` (a fold-free HierCycleState the caller will
+                # reuse for the admission cycle) avoids rebuilding the
+                # node balances twice per tick.
+                state = hier_state
+                if state is None or state.folds:
+                    from kueue_tpu.ops.hier_cycle import HierCycleState
+                    state = HierCycleState(enc, U)
+                for j in rows.tolist():
+                    cohort_ok[j] = state.fits(
+                        int(ci[j]), ((int(fi[j]), int(ri[j]),
+                                      int(val[j])),))
+        fits = (used + val <= nom + blim) & cohort_ok
         np.logical_and.at(ok, ent, fits)
         return ok

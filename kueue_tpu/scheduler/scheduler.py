@@ -458,6 +458,24 @@ class Scheduler:
         key.append(self.ordering.queue_order_time(e.info.obj))
         return tuple(key)
 
+    @staticmethod
+    def _hier_fits(state, cq: CachedClusterQueue, assignment,
+                   cycle_usage: Dict[str, FlavorResourceQuantities]) -> bool:
+        """Hierarchical cycle gate through the dense state; falls back to
+        the dict walk (the dicts fold every reservation the state folds,
+        so both give the same verdict) for coordinates outside the
+        encoding."""
+        ci = state.enc.cq_index.get(cq.name)
+        if ci is not None:
+            idx = assignment.usage_idx
+            if idx is not None:
+                return state.fits(ci, list(zip(*idx)))
+            try:
+                return state.fits(ci, state.coords(assignment.usage))
+            except KeyError:
+                pass
+        return fits_in_hierarchy(cq, assignment.usage, extra=cycle_usage)
+
     def _sort_entries(self, entries: List[Entry]) -> None:
         """entryOrdering sort. Large ticks go through a stable lexsort over
         per-component key arrays — same ordering as sorting on
@@ -498,6 +516,19 @@ class Scheduler:
         # Hoisted once per cycle for the fused cohort gate (the per-pair
         # helpers each re-read the gate otherwise).
         lending = features.enabled(features.LENDING_LIMIT)
+        # Hierarchical-cohort cycle bookkeeping on the solver's dense
+        # tensors (ops/hier_cycle): O(depth) per entry instead of a
+        # full-subtree dict walk per entry. Lazily created on the first
+        # hierarchical entry; None falls back to fits_in_hierarchy.
+        hier_box: List = [None, False]   # [state, tried]
+
+        def ensure_hier_state():
+            if not hier_box[1]:
+                hier_box[1] = True
+                fn = getattr(self.batch_solver, "hier_cycle_state", None)
+                if fn is not None:
+                    hier_box[0] = fn(snapshot)
+            return hier_box[0]
         preempting: List = []
         pending_assumes: List = []
         # Deferred victim searches, pre-batched for the entries most likely
@@ -536,8 +567,11 @@ class Scheduler:
                 and e.assignment.representative_mode == FIT]
             if fit_entries:
                 reval = getattr(self.batch_solver, "revalidate_fits", None)
+                # Build the tree state once; the revalidation uses it
+                # fold-free and the admission loop below reuses it.
                 mask = reval([(e.info.cluster_queue, e.assignment)
-                              for e in fit_entries], snapshot=snapshot) \
+                              for e in fit_entries], snapshot=snapshot,
+                             hier_state=ensure_hier_state()) \
                     if reval is not None else None
                 if mask is not None:
                     for e, ok in zip(fit_entries, mask):
@@ -590,7 +624,13 @@ class Scheduler:
                                e.assignment.usage))
                 if not blocked and mode == FIT:
                     if hier:
-                        if cycle_cohorts_usage and not fits_in_hierarchy(
+                        hier_state = ensure_hier_state()
+                        if hier_state is not None:
+                            if hier_state.folds:
+                                blocked = not self._hier_fits(
+                                    hier_state, cq, e.assignment,
+                                    cycle_cohorts_usage)
+                        elif cycle_cohorts_usage and not fits_in_hierarchy(
                                 cq, e.assignment.usage,
                                 extra=cycle_cohorts_usage):
                             blocked = True
@@ -615,6 +655,24 @@ class Scheduler:
                 reserve = e.assignment.usage if mode != PREEMPT \
                     else _resources_to_reserve(e, cq)
                 if hier:
+                    # The first hierarchical entry may be a fold (not a
+                    # FIT gate): the state must exist before the fold or
+                    # later gates would miss this reservation.
+                    hier_state = ensure_hier_state()
+                    if hier_state is not None:
+                        ci = hier_state.enc.cq_index.get(cq.name)
+                        try:
+                            coords = None if ci is None \
+                                else hier_state.coords(reserve)
+                        except KeyError:
+                            coords = None
+                        if coords is None:
+                            # Unknown CQ/flavor/resource: the dicts below
+                            # hold every reservation, so the dict walk
+                            # takes over for the rest of the cycle.
+                            hier_box[0] = None
+                        else:
+                            hier_state.fold(ci, coords)
                     frq_add(cycle_cohorts_usage.setdefault(
                         cq.cohort.name, {}), reserve)
                     frq_add(cycle_root_usage.setdefault(root_name, {}),

@@ -49,7 +49,14 @@ def dominant_resource_share(cq: CachedClusterQueue,
     lendable: Dict[str, int] = {}
     if cq.cohort.is_hierarchical():
         from kueue_tpu.core.hierarchy import tree_capacity
-        requestable = tree_capacity(cq.cohort.root())
+        root = cq.cohort.root()
+        # Structural-only derivation — memoized on the root for the
+        # cohort object's lifetime (share_of runs per entry per tick; an
+        # uncached full-tree walk per ClusterQueue dominated nomination
+        # at 1k-CQ scale).
+        requestable = root._tree_cap
+        if requestable is None:
+            requestable = root._tree_cap = tree_capacity(root)
     else:
         requestable = cq.cohort.requestable_resources
     for fname, resources in requestable.items():

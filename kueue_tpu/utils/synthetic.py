@@ -13,6 +13,7 @@ from kueue_tpu.api.types import (
     Admission,
     ClusterQueue,
     ClusterQueuePreemption,
+    FairSharing,
     FlavorQuotas,
     LocalQueue,
     PodSet,
@@ -35,19 +36,36 @@ def synthetic_objects(
     seed: int = 0,
     pending_priority: Tuple[int, int] = (-2, 2),
     preemption_heavy: bool = False,
+    fair_hierarchy: bool = False,
 ):
     """Generate the raw API objects of a north-star-scale cluster:
     (flavors, cluster_queues, local_queues, admitted workloads with their
-    Admission pre-set, pending workloads).
+    Admission pre-set, pending workloads, cohort_specs).
 
     `preemption_heavy` builds BASELINE config #3: reclaimWithinCohort +
     borrowWithinCohort(LowerPriority) + withinClusterQueue(LowerPriority)
     on every CQ, low-priority admitted background load and high-priority
     pending — most nominations resolve by preempting victims
-    (preemption.go:81-231 is the exercised path)."""
+    (preemption.go:81-231 is the exercised path).
+
+    `fair_hierarchy` builds BASELINE config #4 (KEP-1714 over KEP-79): the
+    flat cohorts become leaves of a 3-level tree (leaf cohorts → 10 mid
+    cohorts → one root) and every ClusterQueue carries a fair-sharing
+    weight; enable the FairSharing gate to exercise the DRF ordering."""
     rnd = random.Random(seed)
     if preemption_heavy:
         pending_priority = (1, 5)
+
+    cohort_specs: List = []
+    if fair_hierarchy:
+        from kueue_tpu.api.types import CohortSpec
+        cohort_specs.append(CohortSpec(name="root"))
+        n_mids = min(10, max(1, num_cohorts // 10))
+        for m in range(n_mids):
+            cohort_specs.append(CohortSpec(name=f"mid-{m}", parent="root"))
+        for k in range(num_cohorts):
+            cohort_specs.append(CohortSpec(
+                name=f"cohort-{k}", parent=f"mid-{k % n_mids}"))
 
     flavors = [ResourceFlavor.make(f"flavor-{f}") for f in range(num_flavors)]
 
@@ -74,11 +92,15 @@ def synthetic_objects(
                 reclaim_within_cohort="Any",
                 borrow_within_cohort=BorrowWithinCohort(
                     policy="LowerPriority", max_priority_threshold=0))
+        fair = None
+        if fair_hierarchy:
+            fair = FairSharing(weight=float(rnd.randint(1, 4)))
         cqs.append(ClusterQueue(
             name=f"cq-{c}",
             resource_groups=(ResourceGroup(("cpu", "memory"), fqs),),
             cohort=f"cohort-{c % num_cohorts}",
             preemption=preemption,
+            fair_sharing=fair,
         ))
         lqs.append(LocalQueue(
             name=f"lq-{c}", namespace="default", cluster_queue=f"cq-{c}"))
@@ -134,7 +156,7 @@ def synthetic_objects(
             name=f"pend-{i}", namespace="default", queue_name=f"lq-{c}",
             priority=rnd.randint(*pending_priority), creation_time=float(i),
             pod_sets=pod_sets))
-    return flavors, cqs, lqs, admitted, pending
+    return flavors, cqs, lqs, admitted, pending, cohort_specs
 
 
 def synthetic_problem(
@@ -153,13 +175,15 @@ def synthetic_problem(
     (manager.go:489-508), so a 1k-CQ cluster solves <=1k heads/tick
     regardless of the 50k-deep backlog.
     """
-    flavors, cqs, lqs, admitted, pending = synthetic_objects(
+    flavors, cqs, lqs, admitted, pending, cohort_specs = synthetic_objects(
         num_cqs=num_cqs, num_cohorts=num_cohorts, num_flavors=num_flavors,
         num_pending=num_pending, usage_fill=usage_fill, seed=seed,
         **object_kwargs)
     cache = Cache()
     for rf in flavors:
         cache.add_or_update_resource_flavor(rf)
+    for spec in cohort_specs:
+        cache.add_or_update_cohort_spec(spec)
     for cq in cqs:
         cache.add_cluster_queue(cq)
     for lq in lqs:
@@ -181,6 +205,7 @@ def synthetic_framework(
     batch_solver=None,
     pending_priority: Tuple[int, int] = (-2, 2),
     preemption_heavy: bool = False,
+    fair_hierarchy: bool = False,
     **framework_kwargs,
 ):
     """Build a full Framework loaded with the synthetic cluster — the
@@ -188,13 +213,16 @@ def synthetic_framework(
     reconcile passes, not just the solver kernel."""
     from kueue_tpu.controllers.runtime import Framework
 
-    flavors, cqs, lqs, admitted, pending = synthetic_objects(
+    flavors, cqs, lqs, admitted, pending, cohort_specs = synthetic_objects(
         num_cqs=num_cqs, num_cohorts=num_cohorts, num_flavors=num_flavors,
         num_pending=num_pending, usage_fill=usage_fill, seed=seed,
-        pending_priority=pending_priority, preemption_heavy=preemption_heavy)
+        pending_priority=pending_priority, preemption_heavy=preemption_heavy,
+        fair_hierarchy=fair_hierarchy)
     fw = Framework(batch_solver=batch_solver, **framework_kwargs)
     for rf in flavors:
         fw.create_resource_flavor(rf)
+    for spec in cohort_specs:
+        fw.create_cohort(spec)
     for cq in cqs:
         fw.create_cluster_queue(cq)
     for lq in lqs:

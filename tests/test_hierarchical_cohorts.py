@@ -382,3 +382,164 @@ def test_shared_ancestor_capacity_still_guarded_same_tick():
     fw.run_until_settled()
     total = len(fw.admitted_workloads("l")) + len(fw.admitted_workloads("r"))
     assert total == 1  # 4 cpu total can't hold both
+
+
+def test_mirror_incremental_refresh_matches_rebuild_on_tree():
+    """The incremental snapshot mirror now serves hierarchical trees too
+    (usage churn re-clones member CQs; the tree wiring is structural and
+    only rebuilds on structure_version bumps). After admission/finish
+    churn on a 3-level tree, the mirrored snapshot must match a
+    from-scratch Snapshot.build on every CQ's usage, the tree wiring, and
+    the feasibility verdicts the hierarchy walk derives from it."""
+    import random
+
+    from kueue_tpu.core.hierarchy import tree_capacity
+    from kueue_tpu.core.snapshot import Snapshot
+
+    fw = framework(batch=True)
+    fw.create_cohort(cohort("root"))
+    for mid in ("west", "east"):
+        fw.create_cohort(cohort(mid, "root"))
+    for i in range(8):
+        add_cq(fw, f"cq-{i}", 8, "west" if i % 2 else "east")
+
+    rnd = random.Random(5)
+    live = []
+    seq = [0]
+
+    def submit():
+        seq[0] += 1
+        wl = make_wl(f"w-{seq[0]}", f"lq-cq-{rnd.randrange(8)}",
+                     cpu=rnd.randint(1, 4), creation_time=float(seq[0]))
+        fw.submit(wl)
+        return wl
+
+    for step in range(12):
+        for _ in range(4):
+            live.append(submit())
+        fw.run_until_settled(max_ticks=20)
+        done = [wl for wl in live if wl.is_admitted][:2]
+        for wl in done:
+            fw.finish(wl)
+            fw.delete_workload(wl)
+            live.remove(wl)
+
+        mirror_snap = fw.scheduler._mirror.refresh()
+        rebuilt = Snapshot.build(fw.cache)
+        assert set(mirror_snap.cluster_queues) == set(rebuilt.cluster_queues)
+        for name, m_cq in mirror_snap.cluster_queues.items():
+            r_cq = rebuilt.cluster_queues[name]
+            assert m_cq.usage == r_cq.usage, (step, name)
+            assert sorted(m_cq.workloads) == sorted(r_cq.workloads)
+            assert (m_cq.cohort.name if m_cq.cohort else None) == \
+                (r_cq.cohort.name if r_cq.cohort else None)
+        # Tree wiring + feasibility view agree.
+        m_root = next(iter(mirror_snap.cluster_queues.values())).cohort.root()
+        r_root = next(iter(rebuilt.cluster_queues.values())).cohort.root()
+        assert tree_capacity(m_root) == tree_capacity(r_root), step
+
+
+def test_hier_cycle_state_matches_dict_walk():
+    """ops/hier_cycle.HierCycleState (the dense per-cycle tree
+    bookkeeping) must agree with fits_in_hierarchy(..., extra=...) — the
+    dict referee — on randomized trees, reservations, and probes: same
+    fits verdicts after every fold."""
+    import random
+
+    from kueue_tpu.core.hierarchy import fits_in_hierarchy
+    from kueue_tpu.core.workload import WorkloadInfo
+    from kueue_tpu.ops.hier_cycle import HierCycleState
+    from kueue_tpu.solver import schema as sch
+
+    for seed in range(6):
+        rnd = random.Random(seed)
+        fw = framework(batch=True)
+        fw.create_cohort(cohort("root"))
+        n_mids = rnd.randint(1, 3)
+        for m in range(n_mids):
+            # Mid cohorts sometimes carry their own quota and limits.
+            groups = ()
+            if rnd.random() < 0.5:
+                nom = rnd.randint(0, 8)
+                groups = (rg("cpu", fq("default", cpu=(
+                    nom,
+                    rnd.choice([None, rnd.randint(0, 8)]),
+                    rnd.choice([None, rnd.randint(0, nom)])))),)
+            fw.create_cohort(cohort(f"mid-{m}", "root", *groups))
+        n_cqs = rnd.randint(4, 10)
+        for i in range(n_cqs):
+            nom = rnd.randint(2, 10)
+            add_cq(fw, f"cq-{i}", nom,
+                   f"mid-{rnd.randrange(n_mids)}",
+                   borrow=rnd.choice([None, rnd.randint(0, 6)]),
+                   lend=rnd.choice([None, rnd.randint(0, nom)]))
+        # Random admitted usage.
+        for i in range(n_cqs):
+            if rnd.random() < 0.6:
+                wl = make_wl(f"bg-{i}", f"lq-cq-{i}",
+                             cpu=rnd.randint(1, 4), creation_time=float(i))
+                fw.submit(wl)
+        fw.run_until_settled(max_ticks=30)
+
+        snapshot = fw.cache.snapshot()
+        enc = sch.encode_cluster_queues(snapshot)
+        usage = sch.encode_usage(snapshot, enc)
+        if enc.hier is None:
+            continue
+        state = HierCycleState(enc, usage.usage)
+
+        cycle_usage: dict = {}
+        for step in range(30):
+            name = f"cq-{rnd.randrange(n_cqs)}"
+            cq = snapshot.cluster_queues.get(name)
+            if cq is None:
+                continue
+            val = rnd.randint(1, 5) * 1000
+            frq = {"default": {"cpu": val}}
+            ci = enc.cq_index[name]
+            want = fits_in_hierarchy(cq, frq, extra=cycle_usage)
+            got = state.fits(ci, state.coords(frq))
+            assert got == want, (seed, step, name, val, cycle_usage)
+            if rnd.random() < 0.6:
+                # Fold the reservation into both bookkeepers.
+                state.fold(ci, state.coords(frq))
+                node = cq.cohort.name
+                cycle_usage.setdefault(node, {}).setdefault(
+                    "default", {})
+                cycle_usage[node]["default"]["cpu"] = \
+                    cycle_usage[node]["default"].get("cpu", 0) + val
+
+
+def test_mirror_keeps_cycle_deactivated_cqs_excluded_on_churn():
+    """Regression: a cohort cycle deactivates its tree's ClusterQueues in
+    the snapshot (cache-side active() cannot see this). Usage-only churn
+    on such a CQ (an admitted workload finishing) must NOT make the
+    incremental mirror re-insert it as a phantom cohortless entry — the
+    mirrored snapshot must keep matching a from-scratch build."""
+    from kueue_tpu.api.types import CohortSpec
+    from kueue_tpu.core.snapshot import Snapshot
+
+    fw = framework(batch=True)
+    fw.create_cohort(cohort("a"))
+    add_cq(fw, "cq-0", 8, "a")
+    wl = make_wl("w1", "lq-cq-0", cpu=2, creation_time=1.0)
+    fw.submit(wl)
+    fw.run_until_settled(max_ticks=10)
+    assert wl.is_admitted
+
+    # Introduce a cycle a -> b -> a: the tree's CQs deactivate.
+    fw.cache.add_or_update_cohort_spec(CohortSpec(name="b", parent="a"))
+    fw.cache.add_or_update_cohort_spec(CohortSpec(name="a", parent="b"))
+    snap = fw.scheduler._mirror.refresh()
+    assert "cq-0" in snap.inactive_cluster_queues
+    assert "cq-0" not in snap.cluster_queues
+
+    # Usage-only churn on the deactivated CQ.
+    fw.finish(wl)
+    fw.delete_workload(wl)
+    snap = fw.scheduler._mirror.refresh()
+    rebuilt = Snapshot.build(fw.cache)
+    assert "cq-0" not in snap.cluster_queues, \
+        "cycle-deactivated CQ must not be re-inserted by usage churn"
+    assert set(snap.cluster_queues) == set(rebuilt.cluster_queues)
+    assert snap.inactive_cluster_queues == rebuilt.inactive_cluster_queues
