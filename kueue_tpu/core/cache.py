@@ -98,6 +98,20 @@ class Cohort:
             rn = self._root_name = self.root().name
         return rn
 
+    def tree_cap(self) -> dict:
+        """Whole-structure lendable capacity of this cohort's tree
+        (hierarchy.tree_capacity), memoized on the root: it depends only
+        on specs and member quotas, both structural — any change rebuilds
+        the snapshot's cohorts, so the memo lives as long as it is
+        valid. This is the single home of that invalidation contract
+        (KEP-1714 share denominators read it from several places)."""
+        root = self.root()
+        cap = root._tree_cap
+        if cap is None:
+            from kueue_tpu.core.hierarchy import tree_capacity
+            cap = root._tree_cap = tree_capacity(root)
+        return cap
+
     def is_hierarchical(self) -> bool:
         """True when the tree extends beyond a flat 2-level cohort."""
         h = self._is_hier

@@ -724,6 +724,63 @@ class BatchSolver:
             features.enabled(features.FAIR_SHARING),
         )
 
+    def fair_shares(self, snapshot: Snapshot) -> Optional[dict]:
+        """{cq name: share value} for every ClusterQueue, vectorized
+        (KEP-1714 weighted DRF; dominant_resource_share is the dict
+        referee). None when no current encoding matches the snapshot.
+
+        Capacity denominators are structural: flat cohorts sum member
+        lendable quota (enc.lendable); hierarchical trees use the whole
+        structure under the root (hierarchy.tree_capacity), both cached
+        for the encoding's lifetime. The per-tick part is three numpy
+        ops over the lockstep usage tensor."""
+        enc = self._enc
+        ue = self._usage_enc
+        if enc is None or ue is None or not self.encoding_matches(snapshot):
+            return None
+        cached = getattr(enc, "_fair_cache", None)
+        if cached is None:
+            C, F, R = enc.nominal.shape
+            cap = np.zeros((C, R), dtype=np.int64)
+            weight = np.zeros(C, dtype=np.float64)
+            cohorted = np.zeros(C, dtype=bool)
+            # Flat-cohort capacity: lendable summed over flavors, pooled
+            # per cohort.
+            lend_r = enc.lendable.sum(axis=1)              # [C,R]
+            pool = np.zeros((enc.num_cohorts + 1, R), dtype=np.int64)
+            np.add.at(pool, enc.cohort_id, lend_r)
+            cap_flat = pool[enc.cohort_id]
+            r_index = enc.resource_index
+            for i, name in enumerate(enc.cq_names):
+                cq = snapshot.cluster_queues.get(name)
+                if cq is None or cq.cohort is None:
+                    continue
+                cohorted[i] = True
+                weight[i] = cq.fair_weight
+                if cq.cohort.is_hierarchical():
+                    tc = cq.cohort.tree_cap()
+                    for resources in tc.values():
+                        for rname, val in resources.items():
+                            ri = r_index.get(rname)
+                            if ri is not None:
+                                cap[i, ri] += val
+                else:
+                    cap[i] = cap_flat[i]
+            cached = enc._fair_cache = (cap, weight, cohorted)
+        cap, weight, cohorted = cached
+        from kueue_tpu.solver.fair_share import SHARE_SCALE
+        above = np.maximum(ue.usage - enc.nominal, 0).sum(axis=1)  # [C,R]
+        with np.errstate(divide="ignore"):
+            ratio = np.where(cap > 0, (above * SHARE_SCALE) // np.maximum(
+                cap, 1), 0).astype(np.float64)
+        ratio[(cap <= 0) & (above > 0)] = np.inf
+        share = ratio.max(axis=1)
+        out = np.where(share == 0.0, 0.0,
+                       np.where(weight > 0, share / np.maximum(weight, 1e-9),
+                                np.inf))
+        out = np.where(cohorted, out, 0.0)
+        return {name: float(out[i]) for i, name in enumerate(enc.cq_names)}
+
     def hier_cycle_state(self, snapshot: Snapshot):
         """Admission-cycle bookkeeping for hierarchical cohorts
         (ops/hier_cycle.HierCycleState) built on this solver's dense

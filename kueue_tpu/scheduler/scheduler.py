@@ -267,6 +267,14 @@ class Scheduler:
             assignments = None
         fair = features.enabled(features.FAIR_SHARING)
         shares: Dict[str, float] = {}
+        if fair:
+            # One vectorized pass over the lockstep usage tensor instead
+            # of a dict DRF walk per ClusterQueue (KEP-1714 at 1k-CQ
+            # scale); falls back to the per-CQ referee when the solver
+            # has no matching encoding.
+            bulk = getattr(self.batch_solver, "fair_shares", None)
+            if bulk is not None:
+                shares = bulk(snapshot) or {}
 
         def share_of(cq_name: str) -> float:
             s = shares.get(cq_name)
@@ -661,9 +669,18 @@ class Scheduler:
                     hier_state = ensure_hier_state()
                     if hier_state is not None:
                         ci = hier_state.enc.cq_index.get(cq.name)
+                        idx = e.assignment.usage_idx \
+                            if reserve is e.assignment.usage else None
                         try:
-                            coords = None if ci is None \
-                                else hier_state.coords(reserve)
+                            if ci is None:
+                                coords = None
+                            elif idx is not None:
+                                # Non-preempting reserve == the assignment
+                                # usage: reuse its decoded integer
+                                # coordinates, no name->index dict walk.
+                                coords = list(zip(*idx))
+                            else:
+                                coords = hier_state.coords(reserve)
                         except KeyError:
                             coords = None
                         if coords is None:

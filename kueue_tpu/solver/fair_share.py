@@ -48,15 +48,7 @@ def dominant_resource_share(cq: CachedClusterQueue,
     # trees (KEP-79), across the whole structure under the root.
     lendable: Dict[str, int] = {}
     if cq.cohort.is_hierarchical():
-        from kueue_tpu.core.hierarchy import tree_capacity
-        root = cq.cohort.root()
-        # Structural-only derivation — memoized on the root for the
-        # cohort object's lifetime (share_of runs per entry per tick; an
-        # uncached full-tree walk per ClusterQueue dominated nomination
-        # at 1k-CQ scale).
-        requestable = root._tree_cap
-        if requestable is None:
-            requestable = root._tree_cap = tree_capacity(root)
+        requestable = cq.cohort.tree_cap()
     else:
         requestable = cq.cohort.requestable_resources
     for fname, resources in requestable.items():

@@ -219,3 +219,48 @@ def test_fair_preemption_scans_past_strategy_failing_head():
     assert fw.admitted_workloads("x") == ["default/x0"]
     evicted = sorted(w.name for w in fw.workloads.values() if w.is_evicted)
     assert evicted == ["y-small1", "y-small2"]
+
+
+def test_batch_solver_fair_shares_match_referee():
+    """BatchSolver.fair_shares (the scheduler's vectorized share source)
+    must equal dominant_resource_share for every ClusterQueue — on flat
+    cohorts, cohortless CQs, and hierarchical trees (where the capacity
+    denominator is the whole structure under the root)."""
+    import random
+
+    from kueue_tpu.api.types import CohortSpec, FairSharing
+    from kueue_tpu.controllers.runtime import Framework
+    from kueue_tpu.models.flavor_fit import BatchSolver
+    from tests.util import fq, make_cq, make_flavor, make_lq, rg
+
+    rnd = random.Random(3)
+    fw = Framework(batch_solver=BatchSolver())
+    fw.create_resource_flavor(make_flavor("default"))
+    fw.create_resource_flavor(make_flavor("spot"))
+    fw.create_cohort(CohortSpec(name="root"))
+    fw.create_cohort(CohortSpec(name="mid", parent="root"))
+    for i in range(9):
+        cohort_name = ("" if i % 3 == 0
+                       else "flatpool" if i % 3 == 1 else "mid")
+        cq = make_cq(
+            f"cq-{i}",
+            rg(("cpu",), fq("default", cpu=8), fq("spot", cpu=4)),
+            cohort=cohort_name)
+        cq.fair_sharing = FairSharing(weight=float(rnd.choice([0, 1, 2, 4])))
+        fw.create_cluster_queue(cq)
+        fw.create_local_queue(make_lq(f"lq-{i}", cq=f"cq-{i}"))
+    for i in range(9):
+        for j in range(rnd.randint(0, 3)):
+            fw.submit(make_wl(f"w-{i}-{j}", f"lq-{i}", cpu=rnd.randint(2, 6),
+                              creation_time=float(i * 10 + j)))
+    fw.run_until_settled(max_ticks=40)
+
+    snapshot = fw.scheduler._mirror.refresh()
+    # Force the encoding to exist (a tick may not have run the solver).
+    fw.scheduler.batch_solver._encoding_for(snapshot)
+    fw.scheduler.batch_solver._usage_enc.refresh(snapshot)
+    shares = fw.scheduler.batch_solver.fair_shares(snapshot)
+    assert shares is not None
+    for name, cq in snapshot.cluster_queues.items():
+        want = dominant_resource_share(cq)[0]
+        assert shares[name] == want, (name, shares[name], want)
