@@ -153,11 +153,10 @@ class PendingClusterQueue:
             immediate = reason in (RequeueReason.FAILED_AFTER_NOMINATION,
                                    RequeueReason.PENDING_PREEMPTION)
         key = wi.key
-        pending_flavors = (wi.last_assignment is not None
-                           and wi.last_assignment.pending_flavors())
         if self._backoff_expired(wi) and (
                 immediate or self.queue_inadmissible_cycle >= self.pop_cycle
-                or pending_flavors):
+                or (wi.last_assignment is not None
+                    and wi.last_assignment.pending_flavors())):
             parked = self._unpark(key)
             if parked is not None:
                 wi = parked
