@@ -540,13 +540,14 @@ class Scheduler:
         preempting: List = []
         pending_assumes: List = []
         # Deferred victim searches, pre-batched for the entries most likely
-        # to reach the issue branch — the first PREEMPT entry per cohort
-        # root (and every cohortless one) in cycle order. The snapshot is
-        # frozen for the whole cycle, so pre-computing is decision-
-        # identical to computing at the branch; stragglers (reachable only
-        # when an earlier root-mate was skipped on other grounds) still
-        # fall back to the lazy per-entry search.
-        first_per_root: Dict[str, Entry] = {}
+        # to reach the issue branch — the first TWO PREEMPT entries per
+        # cohort root (and every cohortless one) in cycle order: a FIT
+        # admission earlier in the root often blocks the first preempting
+        # entry on common resources, letting the next root-mate reach the
+        # branch. The snapshot is frozen for the whole cycle, so
+        # pre-computing is decision-identical to computing at the branch;
+        # deeper stragglers still fall back to the lazy per-entry search.
+        per_root_count: Dict[str, int] = {}
         prebatch: List[Entry] = []
         for e in entries:
             if e.assignment is None or e.preemption_targets is not None \
@@ -557,8 +558,12 @@ class Scheduler:
                 continue
             if cq.cohort is None:
                 prebatch.append(e)
-            elif first_per_root.setdefault(cq.cohort.root_name, e) is e:
-                prebatch.append(e)
+            else:
+                root = cq.cohort.root_name
+                seen = per_root_count.get(root, 0)
+                if seen < 2:
+                    per_root_count[root] = seen + 1
+                    prebatch.append(e)
         if prebatch:
             pre_targets = self._batched_targets(
                 [(id(e), e.info, e.assignment) for e in prebatch], snapshot)
