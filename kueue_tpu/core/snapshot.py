@@ -239,6 +239,8 @@ class SnapshotMirror:
         dirty_names = self._dirty
         if not dirty_names:
             return snap
+        t_d = _time.perf_counter()
+        reclones = 0
         while dirty_names:
             # Atomic pop-drain: a concurrent mutator thread re-adding a
             # name AFTER the pop is preserved for this loop or the next
@@ -263,6 +265,7 @@ class SnapshotMirror:
                 self._base[name] = cq.usage_version
                 continue
             self.mutation_count += 1
+            reclones += 1
             self._base[name] = cq.usage_version
             old = snap.cluster_queues.get(name)
             fresh = _snapshot_cq(cq)
@@ -286,6 +289,11 @@ class SnapshotMirror:
             for member in cohort.members:
                 _accumulate(member, cohort)
                 cohort.allocatable_generation += member.allocatable_generation
+        REGISTRY.tick_phase_seconds.observe(
+            "snapshot.dirty", value=_time.perf_counter() - t_d)
+        if reclones:
+            REGISTRY.tick_phase_seconds.observe(
+                "snapshot.reclones", value=float(reclones))
         return snap
 
     # -- lockstep fast path (mirrors cache.assume/forget) -------------------
