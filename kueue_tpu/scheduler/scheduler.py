@@ -961,12 +961,16 @@ class Scheduler:
         for e in entries:
             if e.status in (NOT_NOMINATED, SKIPPED):
                 wl = e.info.obj
+                if now is None:
+                    now = self.clock()
+                # UnsetQuotaReservationWithCondition (scheduler.go:594-600):
+                # the Pending condition carries the inadmissible message
+                # whether or not a reservation existed — it is the status
+                # surface explaining WHY the workload is not admitted.
                 if wl.has_quota_reservation:
-                    if now is None:
-                        now = self.clock()
                     wl.admission = None
-                    wl.set_condition("QuotaReserved", False, reason="Pending",
-                                     message=e.inadmissible_msg, now=now)
+                wl.set_condition("QuotaReserved", False, reason="Pending",
+                                 message=e.inadmissible_msg, now=now)
                 self.metrics.inadmissible += 1
 
 

@@ -1,0 +1,469 @@
+"""Secondary decision tables transliterated from the reference.
+
+The three big tables (TestSchedule / TestAssignFlavors / TestPreemption)
+live in test_{scheduler,flavorassigner,preemption}_goldens.py; this file
+carries the remaining reference suites that pin the tick's supporting
+decisions:
+
+- TestEntryOrdering (scheduler_test.go:1483) — the admission sort under
+  PrioritySortingWithinCohort x pods-ready requeuing-timestamp configs.
+- TestResourcesToReserve (scheduler_test.go:2196) — how much of a
+  preempting assignment's usage reserves cohort quota in the cycle.
+- TestLastAssignmentOutdated (flavorassigner_test.go:2302) — when
+  flavor-fungibility resume state is dropped on allocatable-generation
+  movement.
+- TestRequeueAndUpdate (scheduler_test.go:2056) — requeue destination
+  (heap vs inadmissible parking) and the Pending status surface per
+  entry status.
+"""
+
+from kueue_tpu import features
+from kueue_tpu.api.types import Condition, ResourceQuota, Workload
+from kueue_tpu.core.cache import Cache
+from kueue_tpu.core.workload import (
+    AssignmentClusterQueueState,
+    WorkloadInfo,
+    WorkloadOrdering,
+)
+from kueue_tpu.queue.manager import Manager, RequeueReason
+from kueue_tpu.scheduler import scheduler as scheduler_mod
+from kueue_tpu.scheduler.scheduler import (
+    ASSUMED,
+    NOMINATED,
+    NOT_NOMINATED,
+    SKIPPED,
+    Entry,
+    Scheduler,
+    _resources_to_reserve,
+)
+from kueue_tpu.solver.modes import FIT, PREEMPT
+from kueue_tpu.solver.referee import (
+    Assignment,
+    FlavorAssignment,
+    PodSetAssignmentResult,
+)
+
+from tests.util import fq, make_cq, make_flavor, make_lq, make_wl, rg
+
+NOW = 1_000_000.0
+
+
+# -- TestEntryOrdering (scheduler_test.go:1483-1637) -------------------------
+
+
+def _entry(name, creation, *, priority=0, borrowing=False, evicted_at=None):
+    wl = Workload(name=name, namespace="ns", queue_name="q",
+                  priority=priority, creation_time=creation, pod_sets=[])
+    if evicted_at is not None:
+        wl.conditions.append(Condition(
+            "Evicted", True, "PodsReadyTimeout", "",
+            last_transition_time=evicted_at))
+    a = Assignment(borrowing=borrowing)
+    return Entry(info=WorkloadInfo(wl, cluster_queue="cq"), assignment=a)
+
+
+def _ordering_input():
+    return [
+        _entry("old_borrowing", NOW, borrowing=True),
+        _entry("old", NOW + 1),
+        _entry("new", NOW + 3),
+        _entry("high_pri_borrowing", NOW + 3, priority=1, borrowing=True),
+        _entry("new_high_pri", NOW + 4, priority=1),
+        _entry("new_borrowing", NOW + 3, borrowing=True),
+        _entry("evicted_borrowing", NOW + 1, borrowing=True,
+               evicted_at=NOW + 2),
+        _entry("recently_evicted", NOW, evicted_at=NOW + 2),
+    ]
+
+
+ORDERING_CASES = [
+    # (priority_sorting, requeuing_timestamp, want order)
+    (True, "Eviction",
+     ["new_high_pri", "old", "recently_evicted", "new",
+      "high_pri_borrowing", "old_borrowing", "evicted_borrowing",
+      "new_borrowing"]),
+    (True, "Creation",
+     ["new_high_pri", "recently_evicted", "old", "new",
+      "high_pri_borrowing", "old_borrowing", "evicted_borrowing",
+      "new_borrowing"]),
+    (False, "Eviction",
+     ["old", "recently_evicted", "new", "new_high_pri",
+      "old_borrowing", "evicted_borrowing", "high_pri_borrowing",
+      "new_borrowing"]),
+    (False, "Creation",
+     ["recently_evicted", "old", "new", "new_high_pri",
+      "old_borrowing", "evicted_borrowing", "high_pri_borrowing",
+      "new_borrowing"]),
+]
+
+
+def test_entry_ordering_table():
+    for priority_sorting, ts, want in ORDERING_CASES:
+        features.set_enabled(features.PRIORITY_SORTING_WITHIN_COHORT,
+                             priority_sorting)
+        sched = Scheduler(
+            Manager(), Cache(),
+            ordering=WorkloadOrdering(pods_ready_requeuing_timestamp=ts))
+        entries = _ordering_input()
+        sched._sort_entries(entries)
+        got = [e.info.obj.name for e in entries]
+        assert got == want, (priority_sorting, ts)
+        # The vectorized lexsort path and the tuple-key sort must agree.
+        small = _ordering_input()
+        small.sort(key=sched._entry_sort_key)
+        assert [e.info.obj.name for e in small] == want, \
+            (priority_sorting, ts, "tuple-key path")
+
+
+# -- TestResourcesToReserve (scheduler_test.go:2196-2331) --------------------
+
+
+def _reserve_cq(cq_usage):
+    cache = Cache()
+    for f in ("on-demand", "spot", "model-a", "model-b"):
+        cache.add_or_update_resource_flavor(make_flavor(f))
+    cache.add_cluster_queue(make_cq(
+        "cq",
+        rg(("memory",),
+           fq("on-demand", memory=100),
+           fq("spot", memory=(0, 100))),
+        rg(("gpu",),
+           fq("model-a", gpu=(10, 0)),
+           fq("model-b", gpu=(10, 5))),
+        cohort="eng"))
+    snap = cache.snapshot()
+    cq = snap.cluster_queues["cq"]
+    for fname, res in cq_usage.items():
+        for rname, val in res.items():
+            cq.usage.setdefault(fname, {})[rname] = val
+    return cq
+
+
+def _reserve_entry(mode, borrowing, usage):
+    pod_sets = []
+    for ps_name, rname in (("memory", "memory"), ("gpu", "gpu")):
+        psa = PodSetAssignmentResult(
+            name=ps_name,
+            flavors={rname: FlavorAssignment(name="", mode=mode)})
+        if mode != FIT:
+            psa.reasons = ["preempt"]
+        pod_sets.append(psa)
+    a = Assignment(pod_sets=pod_sets, borrowing=borrowing, usage=usage)
+    wl = Workload(name="w", namespace="ns", queue_name="q", pod_sets=[])
+    return Entry(info=WorkloadInfo(wl, cluster_queue="cq"), assignment=a)
+
+
+RESERVE_CASES = [
+    # (mode, borrowing, assignment usage, cq usage, want reserved)
+    (PREEMPT, False,
+     {"on-demand": {"memory": 50}, "model-a": {"gpu": 6}},
+     {"on-demand": {"memory": 60}, "spot": {"memory": 50},
+      "model-a": {"gpu": 6}, "model-b": {"gpu": 2}},
+     {"on-demand": {"memory": 40}, "model-a": {"gpu": 4}}),
+    (PREEMPT, False,
+     {"on-demand": {"memory": 30}, "model-a": {"gpu": 2}},
+     {"on-demand": {"memory": 60}, "spot": {"memory": 50},
+      "model-a": {"gpu": 2}, "model-b": {"gpu": 2}},
+     {"on-demand": {"memory": 30}, "model-a": {"gpu": 2}}),
+    (FIT, False,
+     {"on-demand": {"memory": 50}, "model-a": {"gpu": 2}},
+     {"on-demand": {"memory": 60}, "spot": {"memory": 50},
+      "model-a": {"gpu": 2}, "model-b": {"gpu": 2}},
+     {"on-demand": {"memory": 50}, "model-a": {"gpu": 2}}),
+    (PREEMPT, False,
+     {"spot": {"memory": 50}, "model-b": {"gpu": 2}},
+     {"on-demand": {"memory": 60}, "spot": {"memory": 60},
+      "model-a": {"gpu": 2}, "model-b": {"gpu": 10}},
+     {"spot": {"memory": 0}, "model-b": {"gpu": 0}}),
+    (PREEMPT, True,
+     {"spot": {"memory": 50}, "model-b": {"gpu": 2}},
+     {"on-demand": {"memory": 60}, "spot": {"memory": 60},
+      "model-a": {"gpu": 2}, "model-b": {"gpu": 10}},
+     {"spot": {"memory": 40}, "model-b": {"gpu": 2}}),
+    (PREEMPT, True,
+     {"on-demand": {"memory": 50}, "model-b": {"gpu": 2}},
+     {"on-demand": {"memory": 60}, "spot": {"memory": 60},
+      "model-a": {"gpu": 2}, "model-b": {"gpu": 10}},
+     {"on-demand": {"memory": 50}, "model-b": {"gpu": 2}}),
+]
+
+
+def test_resources_to_reserve_table():
+    for i, (mode, borrowing, a_usage, cq_usage, want) in \
+            enumerate(RESERVE_CASES):
+        cq = _reserve_cq(cq_usage)
+        e = _reserve_entry(mode, borrowing, a_usage)
+        got = _resources_to_reserve(e, cq)
+        assert got == want, (i, got, want)
+
+
+# -- TestLastAssignmentOutdated (flavorassigner_test.go:2302-2371) -----------
+
+
+def test_last_assignment_outdated_table():
+    """The resume-state staleness predicate, exercised through the
+    referee's resume path: a stale generation means the search restarts
+    from the first flavor (the state is dropped)."""
+    from kueue_tpu.solver.referee import assign_flavors
+
+    def build(cohort=""):
+        cache = Cache()
+        cache.add_or_update_resource_flavor(make_flavor("f0"))
+        cache.add_or_update_resource_flavor(make_flavor("f1"))
+        cache.add_cluster_queue(make_cq(
+            "cq", rg(("cpu",), fq("f0", cpu=4), fq("f1", cpu=4)),
+            cohort=cohort))
+        return cache.snapshot()
+
+    cases = [
+        # (cq gen bump, cohort gen bump, has cohort, want outdated)
+        (1, 0, False, True),    # CQ generation increased
+        (0, 1, True, True),     # cohort generation increased
+        (0, 0, True, False),    # nothing moved
+    ]
+    for cq_bump, cohort_bump, has_cohort, want_outdated in cases:
+        snap = build(cohort="pool" if has_cohort else "")
+        cq = snap.cluster_queues["cq"]
+        cq.allocatable_generation += cq_bump
+        if has_cohort:
+            cq.cohort.allocatable_generation += cohort_bump
+        wl = make_wl("w", "lq", cpu=2, creation_time=1.0)
+        wi = WorkloadInfo(wl, cluster_queue="cq")
+        # Resume state says: next time skip to flavor index 1.
+        wi.last_assignment = AssignmentClusterQueueState(
+            last_tried_flavor_idx=[{"cpu": 0}],
+            cluster_queue_generation=cq.allocatable_generation - cq_bump,
+            cohort_generation=(cq.cohort.allocatable_generation - cohort_bump
+                               if has_cohort else 0))
+        a = assign_flavors(wi, cq, snap.resource_flavors)
+        got_flavor = a.pod_sets[0].flavors["cpu"].name
+        if want_outdated:
+            # State dropped: the search restarts at f0.
+            assert got_flavor == "f0", (cq_bump, cohort_bump, got_flavor)
+        else:
+            # State honored: the search resumes at f1.
+            assert got_flavor == "f1", (cq_bump, cohort_bump, got_flavor)
+
+
+# -- TestRequeueAndUpdate (scheduler_test.go:2056-2194) ----------------------
+
+
+def _requeue_fixture():
+    cache = Cache()
+    cache.add_or_update_resource_flavor(make_flavor("default"))
+    cache.add_cluster_queue(make_cq(
+        "cq", rg(("cpu",), fq("default", cpu=8))))
+    qm = Manager()
+    qm.add_cluster_queue(make_cq("cq", rg(("cpu",), fq("default", cpu=8))))
+    qm.add_local_queue(make_lq("q1", cq="cq", namespace="ns1"))
+    cache.add_local_queue(make_lq("q1", cq="cq", namespace="ns1"))
+    wl = Workload(name="w1", namespace="ns1", queue_name="q1",
+                  creation_time=1.0,
+                  pod_sets=[make_wl("t", "q1", cpu=1).pod_sets[0]])
+    qm.add_or_update_workload(wl)
+    heads = qm.heads(timeout=0)
+    assert len(heads) == 1
+    sched = Scheduler(qm, cache)
+    return sched, qm, heads[0], wl
+
+
+REQUEUE_CASES = [
+    # (status, inadmissible_msg, want location, want pending condition)
+    (NOT_NOMINATED, "didn't fit", "inadmissible", True),
+    (ASSUMED, "", "none", False),
+    (NOMINATED, "failed to admit workload", "heap", False),
+    (SKIPPED, "cohort used in this cycle", "heap", True),
+]
+
+
+def test_requeue_and_update_table():
+    for status, msg, want_loc, want_condition in REQUEUE_CASES:
+        sched, qm, wi, wl = _requeue_fixture()
+        e = Entry(info=wi, status=status, inadmissible_msg=msg)
+        if status == ASSUMED:
+            # The sweep's caller filters assumed entries out; the
+            # reference's requeueAndUpdate no-ops on them likewise.
+            continue
+        sched._requeue_sweep([e])
+        cq = qm.cluster_queues["cq"]
+        in_heap = cq.heap.get_by_key(wl.key) is not None
+        parked = wl.key in cq.inadmissible
+        if want_loc == "heap":
+            assert in_heap and not parked, (status, want_loc)
+        elif want_loc == "inadmissible":
+            assert parked and not in_heap, (status, want_loc)
+        cond = wl.find_condition("QuotaReserved")
+        if want_condition:
+            assert cond is not None and not cond.status
+            assert cond.reason == "Pending"
+            assert cond.message == msg, (status, cond.message)
+        else:
+            assert cond is None, status
+
+
+# -- TestLastSchedulingContext (scheduler_test.go:1639-2054) -----------------
+# Two schedule() cycles with flavor-fungibility resume context carried
+# between them: preempt-vs-next-flavor, deletes invalidating the context,
+# borrow-before/after-next-flavor, borrow/preempt on the first flavor when
+# the next is full.
+
+import pytest
+
+from kueue_tpu.api.types import (
+    Admission,
+    ClusterQueuePreemption,
+    FlavorFungibility,
+    PodSet,
+    PodSetAssignment,
+)
+from kueue_tpu.controllers.runtime import Framework
+from kueue_tpu.models.flavor_fit import BatchSolver
+
+
+def _ctx_fw(batch, cohort_trio):
+    fw = Framework(batch_solver=BatchSolver() if batch else None)
+    for f in ("on-demand", "spot"):
+        fw.create_resource_flavor(make_flavor(f))
+    if not cohort_trio:
+        # eng-alpha standalone: BestEffortFIFO, preempt lower-priority
+        # within the CQ, WhenCanPreempt=Preempt. (The reference gives it
+        # a borrowingLimit without a cohort, which the webhook rejects
+        # like the reference's would — cohortless quota is equivalent.)
+        fw.create_cluster_queue(make_cq(
+            "eng-alpha",
+            rg(("cpu",), fq("on-demand", cpu=50), fq("spot", cpu=100)),
+            preemption=ClusterQueuePreemption(
+                within_cluster_queue="LowerPriority"),
+            fungibility=FlavorFungibility(when_can_preempt="Preempt")))
+        fw.create_local_queue(make_lq("main", cq="eng-alpha"))
+    else:
+        for name, preempt_pol, borrow_pol in (
+                ("eng-cohort-alpha", "Preempt", "Borrow"),
+                ("eng-cohort-beta", "Preempt", "Borrow"),
+                ("eng-cohort-theta", "TryNextFlavor", "TryNextFlavor")):
+            fw.create_cluster_queue(make_cq(
+                name,
+                rg(("cpu",), fq("on-demand", cpu=(50, 50)),
+                   fq("spot", cpu=(100, 0))),
+                cohort="cohort", strategy="StrictFIFO",
+                preemption=ClusterQueuePreemption(
+                    within_cluster_queue="Never",
+                    reclaim_within_cohort="LowerPriority"),
+                fungibility=FlavorFungibility(
+                    when_can_preempt=preempt_pol,
+                    when_can_borrow=borrow_pol)))
+        fw.create_local_queue(make_lq("main-alpha", cq="eng-cohort-alpha"))
+        fw.create_local_queue(make_lq("main-beta", cq="eng-cohort-beta"))
+        fw.create_local_queue(make_lq("main-theta", cq="eng-cohort-theta"))
+    return fw
+
+
+def _preadmit(fw, name, cq_name, flavor, cpu_v, priority=0):
+    w = Workload(name=name, namespace="default", queue_name="",
+                 priority=priority, creation_time=1.0,
+                 pod_sets=[PodSet.make("main", 1, cpu=cpu_v)])
+    w.admission = Admission(cluster_queue=cq_name, pod_set_assignments=[
+        PodSetAssignment(name="main", flavors={"cpu": flavor},
+                         resource_usage={"cpu": cpu_v * 1000}, count=1)])
+    w.set_condition("QuotaReserved", True, now=1.0)
+    w.set_condition("Admitted", True, now=1.0)
+    fw.workloads[w.key] = w
+    fw.cache.add_or_update_workload(w)
+    return w
+
+
+def _admission_flavor(fw, key):
+    w = fw.workloads.get(key)
+    if w is None or w.admission is None:
+        return None
+    return (w.admission.cluster_queue,
+            w.admission.pod_set_assignments[0].flavors["cpu"])
+
+
+@pytest.fixture(params=["referee", "batch"])
+def ctx_batch(request):
+    return request.param == "batch"
+
+
+def test_ctx_use_next_flavor_if_cant_preempt(ctx_batch):
+    fw = _ctx_fw(ctx_batch, cohort_trio=False)
+    _preadmit(fw, "low-1", "eng-alpha", "on-demand", 50)
+    fw.submit(make_wl("new", "main", cpu=20, creation_time=10.0))
+    fw.tick()
+    assert _admission_flavor(fw, "default/new") is None
+    fw.tick()
+    assert _admission_flavor(fw, "default/new") == ("eng-alpha", "spot")
+    assert _admission_flavor(fw, "default/low-1") == \
+        ("eng-alpha", "on-demand")
+
+
+def test_ctx_some_workloads_were_deleted(ctx_batch):
+    fw = _ctx_fw(ctx_batch, cohort_trio=False)
+    low1 = _preadmit(fw, "low-1", "eng-alpha", "on-demand", 50)
+    fw.submit(make_wl("preemptor", "main", cpu=20, creation_time=10.0))
+    fw.tick()
+    assert _admission_flavor(fw, "default/preemptor") is None
+    fw.delete_workload(low1)
+    fw.tick()
+    assert _admission_flavor(fw, "default/preemptor") == \
+        ("eng-alpha", "on-demand")
+
+
+def test_ctx_borrow_before_next_flavor(ctx_batch):
+    fw = _ctx_fw(ctx_batch, cohort_trio=True)
+    _preadmit(fw, "placeholder", "eng-cohort-alpha", "on-demand", 50)
+    fw.submit(make_wl("borrower", "main-alpha", cpu=20, creation_time=10.0))
+    fw.submit(make_wl("workload1", "main-beta", cpu=20, creation_time=11.0))
+    fw.tick()
+    assert _admission_flavor(fw, "default/borrower") == \
+        ("eng-cohort-alpha", "on-demand")
+    assert _admission_flavor(fw, "default/workload1") == \
+        ("eng-cohort-beta", "on-demand")
+    fw.tick()
+    assert _admission_flavor(fw, "default/placeholder") == \
+        ("eng-cohort-alpha", "on-demand")
+
+
+def test_ctx_borrow_after_all_flavors(ctx_batch):
+    fw = _ctx_fw(ctx_batch, cohort_trio=True)
+    _preadmit(fw, "placeholder", "eng-cohort-alpha", "on-demand", 50)
+    _preadmit(fw, "placeholder1", "eng-cohort-theta", "on-demand", 50)
+    fw.submit(make_wl("workload", "main-theta", cpu=20, creation_time=10.0))
+    fw.tick()
+    assert _admission_flavor(fw, "default/workload") == \
+        ("eng-cohort-theta", "spot")
+    fw.tick()
+    assert _admission_flavor(fw, "default/workload") == \
+        ("eng-cohort-theta", "spot")
+
+
+def test_ctx_next_flavor_full_but_can_borrow_on_first(ctx_batch):
+    fw = _ctx_fw(ctx_batch, cohort_trio=True)
+    _preadmit(fw, "placeholder", "eng-cohort-alpha", "on-demand", 40)
+    _preadmit(fw, "placeholder1", "eng-cohort-theta", "on-demand", 40)
+    _preadmit(fw, "placeholder2", "eng-cohort-theta", "spot", 100)
+    fw.submit(make_wl("workload", "main-theta", cpu=20, creation_time=10.0))
+    fw.tick()
+    assert _admission_flavor(fw, "default/workload") == \
+        ("eng-cohort-theta", "on-demand")
+    fw.tick()
+    assert _admission_flavor(fw, "default/workload") == \
+        ("eng-cohort-theta", "on-demand")
+
+
+def test_ctx_next_flavor_full_but_can_preempt_on_first(ctx_batch):
+    fw = _ctx_fw(ctx_batch, cohort_trio=True)
+    alpha = _preadmit(fw, "placeholder-alpha", "eng-cohort-alpha",
+                      "on-demand", 150, priority=-1)
+    _preadmit(fw, "placeholder-theta-spot", "eng-cohort-theta", "spot", 100)
+    fw.submit(make_wl("new", "main-theta", cpu=20, creation_time=10.0))
+    fw.tick()
+    assert fw.workloads["default/placeholder-alpha"].is_evicted, \
+        "reclaim preemption must target the lower-priority borrower"
+    assert _admission_flavor(fw, "default/new") is None
+    fw.delete_workload(alpha)
+    fw.tick()
+    assert _admission_flavor(fw, "default/new") == \
+        ("eng-cohort-theta", "on-demand")
+    assert _admission_flavor(fw, "default/placeholder-theta-spot") == \
+        ("eng-cohort-theta", "spot")
