@@ -467,3 +467,42 @@ def test_ctx_next_flavor_full_but_can_preempt_on_first(ctx_batch):
         ("eng-cohort-theta", "on-demand")
     assert _admission_flavor(fw, "default/placeholder-theta-spot") == \
         ("eng-cohort-theta", "spot")
+
+
+# -- TestCandidatesOrdering (preemption_test.go:1121-1168) -------------------
+
+
+def test_candidates_ordering_table():
+    """Victim ordering: evicted first, other-ClusterQueue first, lowest
+    priority, newest quota reservation, UID tiebreak."""
+    from kueue_tpu.scheduler.preemption import _candidate_sort_key
+
+    now = NOW
+
+    def cand(name, cq="self", priority=0, evicted=False,
+             reserved_at=None, uid=None):
+        w = Workload(name=name, namespace="", queue_name="",
+                     priority=priority, creation_time=1.0, pod_sets=[])
+        if uid is not None:
+            w.uid = uid
+        if evicted:
+            w.set_condition("Evicted", True, now=now)
+        else:
+            w.set_condition("QuotaReserved", True,
+                            now=reserved_at if reserved_at is not None
+                            else now)
+        return WorkloadInfo(w, cluster_queue=cq)
+
+    candidates = [
+        cand("high", priority=10),
+        cand("low", priority=-10),
+        cand("other", cq="other", priority=10),
+        cand("evicted", evicted=True),
+        cand("old-a", reserved_at=now, uid="old-a"),
+        cand("old-b", reserved_at=now, uid="old-b"),
+        cand("current", reserved_at=now + 1),
+    ]
+    candidates.sort(key=lambda c: _candidate_sort_key(c, "self", now))
+    got = [c.obj.name for c in candidates]
+    assert got == ["evicted", "other", "low", "current",
+                   "old-a", "old-b", "high"], got
