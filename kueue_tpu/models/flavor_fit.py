@@ -1009,10 +1009,8 @@ class BatchSolver:
                 if state is None or state.folds:
                     from kueue_tpu.ops.hier_cycle import HierCycleState
                     state = HierCycleState(enc, U)
-                for j in rows.tolist():
-                    cohort_ok[j] = state.fits(
-                        int(ci[j]), ((int(fi[j]), int(ri[j]),
-                                      int(val[j])),))
+                cohort_ok[rows] = state.fits_many(
+                    ci[rows], fi[rows], ri[rows], val[rows])
         fits = (used + val <= nom + blim) & cohort_ok
         np.logical_and.at(ok, ent, fits)
         return ok

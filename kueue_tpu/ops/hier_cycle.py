@@ -40,7 +40,7 @@ class HierCycleState:
     """
 
     __slots__ = ("enc", "h", "t", "_blim", "_lend", "_paths",
-                 "_nominal", "_usage", "_cq_lend", "_fr", "folds")
+                 "_nominal", "_usage", "_cq_lend", "_fr", "_t_np", "folds")
 
     def __init__(self, enc, usage: np.ndarray):
         """`enc`: the solver CQEncoding (with .hier); `usage`: the
@@ -65,6 +65,9 @@ class HierCycleState:
         _, F, R = t_cq.shape
         self._fr = F * R
         self.t = t_node.ravel().tolist()
+        # Dense copy for the vectorized fold-free batch check (fits_many);
+        # diverges from the list once folds run, hence the folds guard.
+        self._t_np = t_node
         self._blim = h.node_blim.ravel().tolist()
         self._lend = h.node_lend.ravel().tolist()
         self._paths = h.cq_path.tolist()
@@ -103,6 +106,37 @@ class HierCycleState:
                 lend = lend_l[j]
                 delta = min(lend, t) - min(lend, t_new)
         return True
+
+    def fits_many(self, cis, fis, ris, vals) -> np.ndarray:
+        """Vectorized `fits` over independent (cq, flavor, resource, val)
+        rows — the staleness-revalidation batch. Only valid on a
+        FOLD-FREE state (the dense copy does not track folds); mirrors
+        the device kernel's hier_ok walk (models/flavor_fit.py)."""
+        if self.folds:
+            raise ValueError("fits_many requires a fold-free state")
+        h = self.h
+        t = self._t_np
+        ci = np.asarray(cis)
+        fi = np.asarray(fis)
+        ri = np.asarray(ris)
+        val = np.asarray(vals, dtype=np.int64)
+        t_old = self._nominal[ci, fi, ri] - self._usage[ci, fi, ri]
+        lend_cq = h.cq_lend[ci, fi, ri]
+        delta = np.minimum(lend_cq, t_old) - np.minimum(lend_cq, t_old - val)
+        ok = np.ones(ci.shape[0], dtype=bool)
+        paths = h.cq_path[ci]                               # [n, D]
+        for d in range(paths.shape[1]):
+            node = paths[:, d]
+            valid = node >= 0
+            ns = np.maximum(node, 0)
+            t_n = t[ns, fi, ri]
+            t_new = t_n - delta
+            ok &= np.where(valid, t_new >= -h.node_blim[ns, fi, ri], True)
+            lend = h.node_lend[ns, fi, ri]
+            delta = np.where(
+                valid,
+                np.minimum(lend, t_n) - np.minimum(lend, t_new), delta)
+        return ok
 
     def fold(self, ci: int, items: Sequence[Tuple[int, int, int]]) -> None:
         """Reserve `items` at ClusterQueue `ci`'s direct cohort node and

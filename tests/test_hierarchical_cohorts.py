@@ -500,6 +500,11 @@ def test_hier_cycle_state_matches_dict_walk():
             want = fits_in_hierarchy(cq, frq, extra=cycle_usage)
             got = state.fits(ci, state.coords(frq))
             assert got == want, (seed, step, name, val, cycle_usage)
+            if not state.folds:
+                # The vectorized fold-free batch check must agree too.
+                (fi, ri, v), = state.coords(frq)
+                got_v = bool(state.fits_many([ci], [fi], [ri], [v])[0])
+                assert got_v == want, (seed, step, name, "fits_many")
             if rnd.random() < 0.6:
                 # Fold the reservation into both bookkeepers.
                 state.fold(ci, state.coords(frq))
