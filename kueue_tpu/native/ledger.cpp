@@ -345,6 +345,70 @@ PyObject* flush_mirror(PyObject*, PyObject* args) {
   return PyLong_FromLong(applied);
 }
 
+// hier_entry(t, blim, lend, path, pairs, fold) -> bool
+//
+// The HierCycleState per-entry ancestor walk (ops/hier_cycle.py
+// fits/fold) in native form. `t`/`blim`/`lend` are the state's flat
+// Python-int lists indexed node*FR+offset; `path` is the entry's
+// ancestor node list PRE-MULTIPLIED by FR (-FR-padded sentinels stay
+// negative); `pairs` is [(offset, delta)] where offset = fi*R + ri and
+// delta is the leaf-level delta (the CQ lending clamp applied
+// host-side for checks; the raw reserve value for folds). With fold=0
+// this checks every balance against the borrowing limit and mutates
+// nothing; with fold=1 it charges the delta at each node and writes the
+// new balances back. All arithmetic is long long — values are bounded
+// by the NO_LIMIT sentinel (2^62).
+PyObject* hier_entry(PyObject*, PyObject* args) {
+  PyObject *t_l, *blim_l, *lend_l, *path, *pairs;
+  int fold;
+  if (!PyArg_ParseTuple(args, "OOOOOi", &t_l, &blim_l, &lend_l, &path,
+                        &pairs, &fold))
+    return nullptr;
+  if (!PyList_Check(t_l) || !PyList_Check(blim_l) || !PyList_Check(lend_l) ||
+      !PyList_Check(path) || !PyList_Check(pairs)) {
+    PyErr_SetString(PyExc_TypeError, "hier_entry(list x5, int)");
+    return nullptr;
+  }
+  Py_ssize_t depth = PyList_GET_SIZE(path);
+  Py_ssize_t np_ = PyList_GET_SIZE(pairs);
+  for (Py_ssize_t p = 0; p < np_; ++p) {
+    PyObject* pr = PyList_GET_ITEM(pairs, p);
+    if (!PyTuple_Check(pr) || PyTuple_GET_SIZE(pr) != 2) {
+      PyErr_SetString(PyExc_TypeError, "pair must be (offset, delta)");
+      return nullptr;
+    }
+    long long off = PyLong_AsLongLong(PyTuple_GET_ITEM(pr, 0));
+    long long delta = PyLong_AsLongLong(PyTuple_GET_ITEM(pr, 1));
+    if (PyErr_Occurred()) return nullptr;
+    for (Py_ssize_t d = 0; d < depth; ++d) {
+      // `path` holds node*FR (pre-multiplied by the caller), so the flat
+      // index is just +offset (= fi*R + ri).
+      long long node = PyLong_AsLongLong(PyList_GET_ITEM(path, d));
+      if (PyErr_Occurred()) return nullptr;
+      if (node < 0 || (fold && delta == 0)) break;
+      Py_ssize_t idx = (Py_ssize_t)(node + off);
+      long long t = PyLong_AsLongLong(PyList_GET_ITEM(t_l, idx));
+      if (PyErr_Occurred()) return nullptr;
+      long long t_new = t - delta;
+      if (!fold) {
+        long long blim = PyLong_AsLongLong(PyList_GET_ITEM(blim_l, idx));
+        if (PyErr_Occurred()) return nullptr;
+        if (t_new < -blim) Py_RETURN_FALSE;
+      } else {
+        PyObject* nv = PyLong_FromLongLong(t_new);
+        if (nv == nullptr) return nullptr;
+        if (PyList_SetItem(t_l, idx, nv) != 0) return nullptr;  // steals nv
+      }
+      long long lend = PyLong_AsLongLong(PyList_GET_ITEM(lend_l, idx));
+      if (PyErr_Occurred()) return nullptr;
+      long long c_old = lend < t ? lend : t;
+      long long c_new = lend < t_new ? lend : t_new;
+      delta = c_old - c_new;
+    }
+  }
+  Py_RETURN_TRUE;
+}
+
 PyMethodDef methods[] = {
     {"apply_triples", apply_triples, METH_VARARGS,
      "Fused tracked-pair usage walk (cache/_apply_usage semantics)."},
@@ -352,6 +416,8 @@ PyMethodDef methods[] = {
      "Setdefault-style LocalQueue stats walk (Cache._lq_apply semantics)."},
     {"flush_mirror", flush_mirror, METH_VARARGS,
      "SnapshotMirror.flush_pending loop (lockstep add/remove walk)."},
+    {"hier_entry", hier_entry, METH_VARARGS,
+     "HierCycleState per-entry ancestor walk (check or fold)."},
     {nullptr, nullptr, 0, nullptr}};
 
 PyModuleDef moduledef = {PyModuleDef_HEAD_INIT, "_kueue_ledger",

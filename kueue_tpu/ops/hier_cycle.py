@@ -24,6 +24,11 @@ from typing import List, Sequence, Tuple
 
 import numpy as np
 
+from kueue_tpu.utils import native_ledger
+
+_ledger = native_ledger.load()
+_HIER_ENTRY = getattr(_ledger, "hier_entry", None)
+
 
 class HierCycleState:
     """T balances of every cohort node, updated as the cycle reserves.
@@ -40,7 +45,7 @@ class HierCycleState:
     """
 
     __slots__ = ("enc", "h", "t", "_blim", "_lend", "_paths",
-                 "_nominal", "_usage", "_cq_lend", "_fr", "_t_np", "folds")
+                 "_nominal", "_usage", "_cq_lend", "_t_np", "folds")
 
     def __init__(self, enc, usage: np.ndarray):
         """`enc`: the solver CQEncoding (with .hier); `usage`: the
@@ -63,14 +68,16 @@ class HierCycleState:
         # numpy scalar indexing. The flattening is O(nodes x F x R) once
         # per cycle — small next to one entry's former full-tree walk.
         _, F, R = t_cq.shape
-        self._fr = F * R
         self.t = t_node.ravel().tolist()
         # Dense copy for the vectorized fold-free batch check (fits_many);
         # diverges from the list once folds run, hence the folds guard.
         self._t_np = t_node
         self._blim = h.node_blim.ravel().tolist()
         self._lend = h.node_lend.ravel().tolist()
-        self._paths = h.cq_path.tolist()
+        # Paths pre-multiplied by F*R: the flat index of (node, fi, ri)
+        # is path[d] + fi*R + ri (the C walk's contract; sentinels stay
+        # negative).
+        self._paths = (h.cq_path.astype(np.int64) * (F * R)).tolist()
         self._nominal = enc.nominal
         self._usage = usage
         self._cq_lend = h.cq_lend
@@ -83,12 +90,22 @@ class HierCycleState:
         ClusterQueue `ci` keeps every ancestor balance within its
         borrowing limit — `hierarchical_lack(...) == 0` for each pair,
         against the snapshot state minus this cycle's folds."""
+        R = self._nominal.shape[2]
+        if _HIER_ENTRY is not None:
+            pairs = []
+            for fi, ri, val in items:
+                t_old = int(self._nominal[ci, fi, ri]) \
+                    - int(self._usage[ci, fi, ri])
+                lend_cq = int(self._cq_lend[ci, fi, ri])
+                pairs.append((fi * R + ri,
+                              min(lend_cq, t_old)
+                              - min(lend_cq, t_old - int(val))))
+            return _HIER_ENTRY(self.t, self._blim, self._lend,
+                               self._paths[ci], pairs, 0)
         t_l = self.t
         blim_l = self._blim
         lend_l = self._lend
-        fr = self._fr
         path = self._paths[ci]
-        R = self._nominal.shape[2]
         for fi, ri, val in items:
             off = fi * R + ri
             t_old = int(self._nominal[ci, fi, ri]) \
@@ -98,7 +115,7 @@ class HierCycleState:
             for node in path:
                 if node < 0:
                     break
-                j = node * fr + off
+                j = node + off
                 t = t_l[j]
                 t_new = t - delta
                 if t_new < -blim_l[j]:
@@ -142,24 +159,28 @@ class HierCycleState:
         """Reserve `items` at ClusterQueue `ci`'s direct cohort node and
         propagate the clamped delta up the ancestor chain (the cycle's
         cohortsUsage fold, subtree_t `extra` semantics)."""
+        R = self._nominal.shape[2]
+        self.folds += 1
+        if _HIER_ENTRY is not None:
+            _HIER_ENTRY(self.t, self._blim, self._lend, self._paths[ci],
+                        [(fi * R + ri, int(val)) for fi, ri, val in items],
+                        1)
+            return
         t_l = self.t
         lend_l = self._lend
-        fr = self._fr
         path = self._paths[ci]
-        R = self._nominal.shape[2]
         for fi, ri, val in items:
             off = fi * R + ri
             delta = int(val)
             for node in path:
                 if node < 0 or delta == 0:
                     break
-                j = node * fr + off
+                j = node + off
                 t = t_l[j]
                 t_new = t - delta
                 t_l[j] = t_new
                 lend = lend_l[j]
                 delta = min(lend, t) - min(lend, t_new)
-        self.folds += 1
 
     # -- coordinate helpers -------------------------------------------------
 
