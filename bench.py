@@ -41,8 +41,10 @@ from collections import deque
 import numpy as np
 
 # How many ticks an admitted workload runs before the churn loop finishes
-# it (quota release + cohort flush + replacement submission).
-LINGER_TICKS = 5
+# it (quota release + cohort flush + replacement submission). Varied per
+# workload (4..6) like real job runtimes — a constant linger synchronizes
+# completion waves into artificial once-every-N-ticks churn bursts.
+LINGER_TICKS = (4, 5, 6)
 
 
 def run_config(*, label, num_cqs, num_cohorts, num_flavors, backlog, ticks,
@@ -64,15 +66,19 @@ def run_config(*, label, num_cqs, num_cohorts, num_flavors, backlog, ticks,
     t_setup = time.perf_counter() - t0
 
     # Track admissions as they apply so churn can finish them later
-    # without scanning the 50k-workload map per tick.
-    admitted_log: deque = deque()
+    # without scanning the 50k-workload map per tick. One expiry-ordered
+    # deque per linger class.
+    admitted_logs = [deque() for _ in LINGER_TICKS]
+    admit_seq = [0]
     tick_no = [0]
     orig_apply = fw.scheduler.apply_admission
 
     def apply_admission(wl):
         ok = orig_apply(wl)
         if ok:
-            admitted_log.append((tick_no[0], wl))
+            i = admit_seq[0] % len(LINGER_TICKS)
+            admit_seq[0] += 1
+            admitted_logs[i].append((tick_no[0] + LINGER_TICKS[i], wl))
         return ok
 
     fw.scheduler.apply_admission = apply_admission
@@ -100,16 +106,17 @@ def run_config(*, label, num_cqs, num_cohorts, num_flavors, backlog, ticks,
                 memory=f"{rnd.randint(1, 16)}Gi")]))
 
     def churn():
-        """Completion flux: finish workloads admitted LINGER_TICKS ago,
-        then delete them (the owning job's GC in the reference deletes the
+        """Completion flux: finish workloads whose linger expired, then
+        delete them (the owning job's GC in the reference deletes the
         Workload object; without it the object population would grow
         unboundedly, which no real cluster does)."""
-        while admitted_log and admitted_log[0][0] <= tick_no[0] - LINGER_TICKS:
-            _, wl = admitted_log.popleft()
-            if wl.is_admitted and not wl.is_finished:
-                fw.finish(wl)
-                fw.delete_workload(wl)
-                submit_replacement()
+        for log in admitted_logs:
+            while log and log[0][0] <= tick_no[0]:
+                _, wl = log.popleft()
+                if wl.is_admitted and not wl.is_finished:
+                    fw.finish(wl)
+                    fw.delete_workload(wl)
+                    submit_replacement()
 
     # Warmup: compile the solve for the steady-state head-count bucket,
     # fill the pipeline, and let the admission/completion flux reach steady
