@@ -286,8 +286,21 @@ def main() -> None:
         env_extra["KUEUE_BENCH_FORCE_CPU"] = "1"
     for config in ("preempt", "fair", "northstar"):
         env = dict(os.environ, KUEUE_BENCH_CONFIG=config, **env_extra)
-        res = subprocess.run([sys.executable, os.path.abspath(__file__)],
-                             env=env, stdout=subprocess.PIPE)
+        try:
+            # Generous ceiling: a healthy config finishes in minutes; a
+            # device attachment dying MID-RUN (after the probe passed)
+            # hangs forever otherwise.
+            res = subprocess.run([sys.executable, os.path.abspath(__file__)],
+                                 env=env, stdout=subprocess.PIPE,
+                                 timeout=1800)
+        except subprocess.TimeoutExpired:
+            print(f"# {config}: run hung (device lost mid-run?); "
+                  "retrying on the CPU backend", file=sys.stderr)
+            env["KUEUE_BENCH_FORCE_CPU"] = "1"
+            env_extra["KUEUE_BENCH_FORCE_CPU"] = "1"
+            res = subprocess.run([sys.executable, os.path.abspath(__file__)],
+                                 env=env, stdout=subprocess.PIPE,
+                                 timeout=1800)
         sys.stdout.buffer.write(res.stdout)
         sys.stdout.flush()
         if res.returncode != 0:
