@@ -803,17 +803,24 @@ def encode_workloads(workloads: Sequence[WorkloadInfo], snapshot: Snapshot,
     cache_put = None if row_cache is None else row_cache.put
     cq_index = enc.cq_index
     r_index = enc.resource_index
-    # Fast path (the dominant shape at scale): a single-podset workload
-    # with no tolerations / node selectors / affinity writes straight
+    # Fast path (the dominant shape at scale): a workload whose podsets
+    # carry no tolerations / node selectors / affinity writes straight
     # into the batch tensors — no per-row numpy allocations, no cache
-    # signature — its eligibility is the CQ's cached trivial mask and its
-    # requests are 2-3 scalars folded below by ONE fancy-index store.
+    # signature — each podset's eligibility is the CQ's cached trivial
+    # mask and its requests are 2-3 scalars folded below by ONE
+    # fancy-index store. Covers any podset count (real clusters submit
+    # mostly selector-free jobs; multi-podset PyTorchJob/JobSet shapes
+    # included).
     fast_ws: List[int] = []
     fast_cis: List[int] = []
     trivial_filled = enc._trivial_filled
     t_ws: List[int] = []
+    t_ps: List[int] = []
     t_ris: List[int] = []
     t_vals: List[int] = []
+    e_ws: List[int] = []
+    e_ps: List[int] = []
+    e_cis: List[int] = []
     row_ws: List[int] = []
     rows: List[_Row] = []
     rows_append = rows.append
@@ -837,12 +844,12 @@ def encode_workloads(workloads: Sequence[WorkloadInfo], snapshot: Snapshot,
                         > last.cohort_generation)):
                 last = None
 
-        if not scaled and len(totals) == 1:
-            ps0 = wi.obj.pod_sets[0]
-            if not (ps0.tolerations or ps0.node_selector
-                    or ps0.affinity_terms):
-                t0 = totals[0]
-                requests = t0.requests
+        if not scaled:
+            pod_sets = wi.obj.pod_sets
+            for ps in pod_sets:
+                if ps.tolerations or ps.node_selector or ps.affinity_terms:
+                    break
+            else:
                 ci = cq_index[wi.cluster_queue]
                 fast_ws.append(w)
                 fast_cis.append(ci)
@@ -850,30 +857,39 @@ def encode_workloads(workloads: Sequence[WorkloadInfo], snapshot: Snapshot,
                     _trivial_elig(cq, snapshot, enc)  # fills the stack row
                     trivial_filled = enc._trivial_filled
                 track_pods = PODS_RESOURCE in cq.rg_by_resource
-                for rname, val in requests.items():
-                    ri = r_index.get(rname)
-                    if ri is None:
-                        podset_unsat[w, 0] = True
-                        continue
-                    t_ws.append(w)
-                    t_ris.append(ri)
-                    t_vals.append(val)
-                if track_pods:
-                    ri = r_index.get(PODS_RESOURCE)
-                    if ri is None:
-                        podset_unsat[w, 0] = True
-                    else:
+                groups = cq.resource_groups if last is not None else None
+                for p, tp in enumerate(totals):
+                    requests = tp.requests
+                    e_ws.append(w)
+                    e_ps.append(p)
+                    e_cis.append(ci)
+                    for rname, val in requests.items():
+                        ri = r_index.get(rname)
+                        if ri is None:
+                            podset_unsat[w, p] = True
+                            continue
                         t_ws.append(w)
+                        t_ps.append(p)
                         t_ris.append(ri)
-                        t_vals.append(t0.count)
-                if last is not None:
-                    for gi, rg in enumerate(cq.resource_groups):
-                        for rname in rg.covered_resources:
-                            if rname in requests or (
-                                    track_pods and rname == PODS_RESOURCE):
-                                resume_slot[w, 0, gi] = \
-                                    last.next_flavor_to_try(0, rname)
-                                break
+                        t_vals.append(val)
+                    if track_pods:
+                        ri = r_index.get(PODS_RESOURCE)
+                        if ri is None:
+                            podset_unsat[w, p] = True
+                        else:
+                            t_ws.append(w)
+                            t_ps.append(p)
+                            t_ris.append(ri)
+                            t_vals.append(tp.count)
+                    if groups is not None:
+                        for gi, rg in enumerate(groups):
+                            for rname in rg.covered_resources:
+                                if rname in requests or (
+                                        track_pods
+                                        and rname == PODS_RESOURCE):
+                                    resume_slot[w, p, gi] = \
+                                        last.next_flavor_to_try(p, rname)
+                                    break
                 continue
 
         row = None if scaled or cache_hit is None else cache_hit(wi)
@@ -899,16 +915,21 @@ def encode_workloads(workloads: Sequence[WorkloadInfo], snapshot: Snapshot,
                             break
 
     if fast_ws:
-        fw_idx = np.asarray(fast_ws)
-        fc_idx = np.asarray(fast_cis)
-        wl_cq[fw_idx] = fc_idx
-        podset_valid[fw_idx, 0] = True
-        elig[fw_idx, 0] = enc._trivial_stack[fc_idx]
+        wl_cq[np.asarray(fast_ws)] = fast_cis
+        if e_ws:
+            # Guarded separately: a zero-podset workload contributes to
+            # fast_ws but no (w, p) rows, and an all-empty batch would
+            # fancy-index with float64 arrays.
+            ew = np.asarray(e_ws)
+            ep = np.asarray(e_ps)
+            podset_valid[ew, ep] = True
+            elig[ew, ep] = enc._trivial_stack[np.asarray(e_cis)]
         if t_ws:
             tw = np.asarray(t_ws)
+            tp_ = np.asarray(t_ps)
             tr = np.asarray(t_ris)
-            req[tw, 0, tr] = t_vals
-            has_req[tw, 0, tr] = True
+            req[tw, tp_, tr] = t_vals
+            has_req[tw, tp_, tr] = True
 
     # Batched assembly of the cached/slow rows. The common case — every
     # row a single podset — is one np.stack per field instead of six

@@ -12,6 +12,13 @@ import numpy as np
 
 sys.argv = [sys.argv[0]]
 
+if os.environ.get("JAX_PLATFORMS") == "cpu":
+    # The remote-attachment plugin ignores the env var alone; pin the
+    # backend through jax.config before any array op (see bench.py).
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
 from kueue_tpu.models.flavor_fit import BatchSolver
 from kueue_tpu.api.types import PodSet, Workload
 from kueue_tpu.utils.synthetic import synthetic_framework
@@ -19,12 +26,16 @@ from kueue_tpu.metrics import REGISTRY
 
 TICKS = int(os.environ.get("TICKS", "20"))
 PREEMPT = os.environ.get("PREEMPT") == "1"
+FAIR = os.environ.get("FAIR") == "1"
+if FAIR:
+    from kueue_tpu import features
+    features.set_enabled(features.FAIR_SHARING, True)
 
 t0 = time.perf_counter()
 fw = synthetic_framework(
     num_cqs=1000, num_cohorts=100, num_flavors=8,
     num_pending=50_000, usage_fill=0.9 if PREEMPT else 0.7, seed=42,
-    preemption_heavy=PREEMPT,
+    preemption_heavy=PREEMPT, fair_hierarchy=FAIR,
     batch_solver=BatchSolver(),
     pipeline_depth=int(os.environ.get("DEPTH", "8")))
 print(f"setup {time.perf_counter()-t0:.1f}s", file=sys.stderr)

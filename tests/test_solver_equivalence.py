@@ -276,3 +276,52 @@ def test_revalidate_fits_matches_referee(seed):
         want = _assignment_still_fits(a, cq)
         assert got == want, (
             f"seed={seed} wl={wi.key}: vectorized {got} != referee {want}")
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_fast_path_encode_matches_slow_path(seed):
+    """The selector-free fast path in encode_workloads (any podset count)
+    must produce bit-identical tensors to the generic _encode_row path.
+    Forcing `counts` to the spec counts routes every workload down the
+    slow path without changing the encoded problem (scaled_to(count) with
+    the spec count is the identity)."""
+    import numpy as np
+
+    from kueue_tpu.solver import schema as sch
+
+    rnd = random.Random(seed)
+    cache, _ = random_problem(seed, num_wls=0)
+    pending = []
+    for i in range(24):
+        c = rnd.randrange(4)
+        pod_sets = [
+            PodSet.make(f"ps{p}", count=rnd.randint(1, 3),
+                        cpu=rnd.randint(0, 5), memory=rnd.randint(0, 5))
+            for p in range(rnd.randint(1, 3))]
+        wl = make_wl(f"mp{i}", f"lq{c}", pod_sets=pod_sets)
+        pending.append(WorkloadInfo(wl, cluster_queue=f"cq{c}"))
+    snap = cache.snapshot()
+    enc = sch.encode_cluster_queues(snap)
+    fast = sch.encode_workloads(pending, snap, enc)
+    slow = sch.encode_workloads(
+        pending, snap, enc,
+        counts=[[ps.count for ps in wi.obj.pod_sets] for wi in pending])
+    for field in ("wl_cq", "req", "has_req", "podset_valid", "podset_unsat",
+                  "elig", "resume_slot", "wl_valid"):
+        np.testing.assert_array_equal(
+            getattr(fast, field), getattr(slow, field),
+            err_msg=f"seed={seed} field={field}")
+
+
+def test_encode_zero_podset_workload():
+    """A workload with pod_sets=[] rides the fast path without rows; the
+    empty fancy-index must not crash (float64 empty-array index)."""
+    from kueue_tpu.solver import schema as sch
+
+    cache, _ = random_problem(0, num_wls=0)
+    wl = make_wl("empty", "lq0", pod_sets=[])
+    pending = [WorkloadInfo(wl, cluster_queue="cq0")]
+    snap = cache.snapshot()
+    enc = sch.encode_cluster_queues(snap)
+    wt = sch.encode_workloads(pending, snap, enc)
+    assert not wt.podset_valid[0].any()
