@@ -197,6 +197,7 @@ class Framework:
         # The reference's workload reconciler is event-driven; a full scan
         # over 50k workloads per tick is the scaling hazard this avoids.
         self._check_sync_pending: Dict[str, Workload] = {}
+        self._quota_reserved_msgs: Dict[str, str] = {}
         from kueue_tpu.controllers.jobframework import JobReconciler
         self.job_reconciler = JobReconciler(self)
         # QueueVisibility snapshot workers (clusterqueue_controller.go:685):
@@ -356,6 +357,7 @@ class Framework:
         self.cluster_queue_specs.pop(name, None)
         self.cache.delete_cluster_queue(name)
         self.queues.delete_cluster_queue(name)
+        self._quota_reserved_msgs.pop(name, None)
         self.update_metrics_gauges()
 
     def create_local_queue(self, lq: LocalQueue) -> None:
@@ -555,9 +557,14 @@ class Framework:
             # drop it.
             self._check_sync_pending[wl.key] = wl
         cq = wl.admission.cluster_queue if wl.admission else ""
+        # One message string per ClusterQueue (this runs per admission).
+        msg = self._quota_reserved_msgs.get(cq)
+        if msg is None:
+            msg = self._quota_reserved_msgs[cq] = \
+                f"Quota reserved in ClusterQueue {cq}"
         self.events.event(
             wl.key, events_mod.NORMAL, events_mod.REASON_QUOTA_RESERVED,
-            f"Quota reserved in ClusterQueue {cq}", now=self.clock())
+            msg, now=self.clock())
         return True
 
     def _apply_preemption(self, wl: Workload, message: str) -> None:

@@ -725,7 +725,7 @@ class Cache:
             self.assumed_workloads[key] = cq.name
             return wi
 
-    def assume_workloads(self, items) -> list:
+    def assume_workloads(self, items, fast: bool = False) -> list:
         """Bulk assume under ONE lock acquisition: the admission cycle
         commits all of a tick's admissions at cycle end (the cycle's fit
         math runs against the frozen snapshot plus its own side-tracked
@@ -742,10 +742,22 @@ class Cache:
         - `admitted` — the Admitted-condition verdict the caller just
           computed, or None to read it off the workload.
 
+        `fast=True` asserts every item carries non-None triples/info/
+        admitted AND info.cluster_queue == workload.admission.cluster_queue
+        (the scheduler's flush guarantees this by construction) — the
+        commit loop then runs in ONE native call (ledger.cpp assume_batch).
+
         Returns one entry per workload: the accounted WorkloadInfo on
         success, an error string otherwise."""
         out = []
         with self._lock:
+            if fast and _ledger is not None \
+                    and getattr(_ledger, "assume_batch", None) is not None:
+                _ledger.assume_batch(
+                    self.cluster_queues, self.assumed_workloads,
+                    self.local_queues, self._lq_stats,
+                    items if isinstance(items, list) else list(items), out)
+                return out
             for wl, triples, info, admitted in items:
                 if wl.admission is None:
                     out.append("workload has no admission")

@@ -345,67 +345,444 @@ PyObject* flush_mirror(PyObject*, PyObject* args) {
   return PyLong_FromLong(applied);
 }
 
-// hier_entry(t, blim, lend, path, pairs, fold) -> bool
+// assume_batch(cluster_queues, assumed, local_queues, lq_stats, items,
+//              out) -> None
 //
-// The HierCycleState per-entry ancestor walk (ops/hier_cycle.py
-// fits/fold) in native form. `t`/`blim`/`lend` are the state's flat
-// Python-int lists indexed node*FR+offset; `path` is the entry's
-// ancestor node list PRE-MULTIPLIED by FR (-FR-padded sentinels stay
-// negative); `pairs` is [(offset, delta)] where offset = fi*R + ri and
-// delta is the leaf-level delta (the CQ lending clamp applied
-// host-side for checks; the raw reserve value for folds). With fold=0
-// this checks every balance against the borrowing limit and mutates
-// nothing; with fold=1 it charges the delta at each node and writes the
-// new balances back. All arithmetic is long long — values are bounded
-// by the NO_LIMIT sentinel (2^62).
-PyObject* hier_entry(PyObject*, PyObject* args) {
-  PyObject *t_l, *blim_l, *lend_l, *path, *pairs;
-  int fold;
-  if (!PyArg_ParseTuple(args, "OOOOOi", &t_l, &blim_l, &lend_l, &path,
-                        &pairs, &fold))
+// Cache.assume_workloads' per-item walk (cache.py) in native form —
+// caller holds the cache lock and has verified every item carries
+// (wl, triples!=None, info!=None, admitted!=None); mixed batches stay on
+// the Python twin. Per item: duplicate/missing-CQ checks (error strings
+// appended exactly like the Python loop), plant the precomputed triples
+// on the info, insert into cq.workloads, bump usage_version, fan dirty
+// marks to the registered sinks, walk the triples into cq.usage (+ the
+// admitted split), apply the LocalQueue stats (reservation/admitted
+// usage, keyed admitted set), and record the assumption. At north-star
+// scale this commits ~1k admissions/tick and the interpreter overhead of
+// the Python twin dominated the flush phase.
+PyObject* assume_batch(PyObject*, PyObject* args) {
+  PyObject *cluster_queues, *assumed, *local_queues, *lq_stats, *items, *out;
+  if (!PyArg_ParseTuple(args, "OOOOOO", &cluster_queues, &assumed,
+                        &local_queues, &lq_stats, &items, &out))
     return nullptr;
-  if (!PyList_Check(t_l) || !PyList_Check(blim_l) || !PyList_Check(lend_l) ||
-      !PyList_Check(path) || !PyList_Check(pairs)) {
-    PyErr_SetString(PyExc_TypeError, "hier_entry(list x5, int)");
+  if (!PyDict_Check(cluster_queues) || !PyDict_Check(assumed) ||
+      !PyDict_Check(local_queues) || !PyDict_Check(lq_stats) ||
+      !PyList_Check(items) || !PyList_Check(out)) {
+    PyErr_SetString(PyExc_TypeError,
+                    "assume_batch(dict, dict, dict, dict, list, list)");
     return nullptr;
   }
-  Py_ssize_t depth = PyList_GET_SIZE(path);
-  Py_ssize_t np_ = PyList_GET_SIZE(pairs);
-  for (Py_ssize_t p = 0; p < np_; ++p) {
-    PyObject* pr = PyList_GET_ITEM(pairs, p);
-    if (!PyTuple_Check(pr) || PyTuple_GET_SIZE(pr) != 2) {
-      PyErr_SetString(PyExc_TypeError, "pair must be (offset, delta)");
+  static PyObject *s_admission, *s_key, *s_cluster_queue, *s_workloads,
+      *s_usage_version, *s_usage, *s_admitted_usage, *s_dirty_sinks, *s_name,
+      *s_namespace, *s_queue_name, *s_usage_triples_priv, *s_reserving,
+      *s_admitted, *s_admitted_keys, *s_reservation, *s_admitted_usage_key,
+      *s_no_admission;
+  if (s_admission == nullptr) {
+    s_admission = PyUnicode_InternFromString("admission");
+    s_key = PyUnicode_InternFromString("key");
+    s_cluster_queue = PyUnicode_InternFromString("cluster_queue");
+    s_workloads = PyUnicode_InternFromString("workloads");
+    s_usage_version = PyUnicode_InternFromString("usage_version");
+    s_usage = PyUnicode_InternFromString("usage");
+    s_admitted_usage = PyUnicode_InternFromString("admitted_usage");
+    s_dirty_sinks = PyUnicode_InternFromString("_dirty_sinks");
+    s_name = PyUnicode_InternFromString("name");
+    s_namespace = PyUnicode_InternFromString("namespace");
+    s_queue_name = PyUnicode_InternFromString("queue_name");
+    s_usage_triples_priv = PyUnicode_InternFromString("_usage_triples");
+    s_reserving = PyUnicode_InternFromString("reserving");
+    s_admitted = PyUnicode_InternFromString("admitted");
+    s_admitted_keys = PyUnicode_InternFromString("admitted_keys");
+    s_reservation = PyUnicode_InternFromString("reservation");
+    s_admitted_usage_key = PyUnicode_InternFromString("admitted_usage");
+    s_no_admission = PyUnicode_InternFromString("workload has no admission");
+  }
+  Py_ssize_t n = PyList_GET_SIZE(items);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject* item = PyList_GET_ITEM(items, i);
+    if (!PyTuple_Check(item) || PyTuple_GET_SIZE(item) != 4) {
+      PyErr_SetString(PyExc_TypeError,
+                      "item must be (wl, triples, info, admitted)");
       return nullptr;
     }
-    long long off = PyLong_AsLongLong(PyTuple_GET_ITEM(pr, 0));
-    long long delta = PyLong_AsLongLong(PyTuple_GET_ITEM(pr, 1));
-    if (PyErr_Occurred()) return nullptr;
-    for (Py_ssize_t d = 0; d < depth; ++d) {
-      // `path` holds node*FR (pre-multiplied by the caller), so the flat
-      // index is just +offset (= fi*R + ri).
-      long long node = PyLong_AsLongLong(PyList_GET_ITEM(path, d));
-      if (PyErr_Occurred()) return nullptr;
-      if (node < 0 || (fold && delta == 0)) break;
-      Py_ssize_t idx = (Py_ssize_t)(node + off);
-      long long t = PyLong_AsLongLong(PyList_GET_ITEM(t_l, idx));
-      if (PyErr_Occurred()) return nullptr;
-      long long t_new = t - delta;
-      if (!fold) {
-        long long blim = PyLong_AsLongLong(PyList_GET_ITEM(blim_l, idx));
-        if (PyErr_Occurred()) return nullptr;
-        if (t_new < -blim) Py_RETURN_FALSE;
-      } else {
-        PyObject* nv = PyLong_FromLongLong(t_new);
-        if (nv == nullptr) return nullptr;
-        if (PyList_SetItem(t_l, idx, nv) != 0) return nullptr;  // steals nv
+    PyObject* wl = PyTuple_GET_ITEM(item, 0);
+    PyObject* triples = PyTuple_GET_ITEM(item, 1);
+    PyObject* info = PyTuple_GET_ITEM(item, 2);
+    PyObject* adm_o = PyTuple_GET_ITEM(item, 3);
+
+    PyObject* admission = PyObject_GetAttr(wl, s_admission);
+    if (admission == nullptr) return nullptr;
+    if (admission == Py_None) {
+      Py_DECREF(admission);
+      if (PyList_Append(out, s_no_admission) != 0) return nullptr;
+      continue;
+    }
+    PyObject* key = PyObject_GetAttr(wl, s_key);
+    if (key == nullptr) {
+      Py_DECREF(admission);
+      return nullptr;
+    }
+    int dup = PyDict_Contains(assumed, key);
+    if (dup != 0) {
+      Py_DECREF(admission);
+      if (dup < 0) {
+        Py_DECREF(key);
+        return nullptr;
       }
-      long long lend = PyLong_AsLongLong(PyList_GET_ITEM(lend_l, idx));
-      if (PyErr_Occurred()) return nullptr;
-      long long c_old = lend < t ? lend : t;
-      long long c_new = lend < t_new ? lend : t_new;
-      delta = c_old - c_new;
+      PyObject* msg =
+          PyUnicode_FromFormat("workload %U already assumed", key);
+      Py_DECREF(key);
+      if (msg == nullptr || PyList_Append(out, msg) != 0) {
+        Py_XDECREF(msg);
+        return nullptr;
+      }
+      Py_DECREF(msg);
+      continue;
+    }
+    PyObject* cq_name = PyObject_GetAttr(admission, s_cluster_queue);
+    Py_DECREF(admission);
+    if (cq_name == nullptr) {
+      Py_DECREF(key);
+      return nullptr;
+    }
+    PyObject* cq = PyDict_GetItemWithError(cluster_queues, cq_name);
+    if (cq == nullptr) {
+      if (PyErr_Occurred()) {
+        Py_DECREF(key);
+        Py_DECREF(cq_name);
+        return nullptr;
+      }
+      PyObject* msg =
+          PyUnicode_FromFormat("ClusterQueue %U not found", cq_name);
+      Py_DECREF(key);
+      Py_DECREF(cq_name);
+      if (msg == nullptr || PyList_Append(out, msg) != 0) {
+        Py_XDECREF(msg);
+        return nullptr;
+      }
+      Py_DECREF(msg);
+      continue;
+    }
+    // The caller guarantees info.cluster_queue == admission.cluster_queue
+    // (assume_workloads only passes the entry's own info); plant the
+    // precomputed flattened triples exactly like the Python loop.
+    if (PyObject_SetAttr(info, s_usage_triples_priv, triples) != 0) {
+      Py_DECREF(key);
+      Py_DECREF(cq_name);
+      return nullptr;
+    }
+    int adm = PyObject_IsTrue(adm_o);
+    if (adm < 0) {
+      Py_DECREF(key);
+      Py_DECREF(cq_name);
+      return nullptr;
+    }
+
+    // cq.add_workload_usage(wi, admitted=adm), inlined:
+    // workloads[key] = wi; usage_version += 1; dirty marks; usage walk.
+    PyObject* workloads = PyObject_GetAttr(cq, s_workloads);
+    int failed = workloads == nullptr || !PyDict_Check(workloads) ||
+                 PyDict_SetItem(workloads, key, info) != 0;
+    Py_XDECREF(workloads);
+    if (!failed) {
+      PyObject* uv = PyObject_GetAttr(cq, s_usage_version);
+      if (uv != nullptr) {
+        PyObject* one = PyLong_FromLong(1);
+        PyObject* uv2 = one ? PyNumber_Add(uv, one) : nullptr;
+        Py_XDECREF(one);
+        failed = uv2 == nullptr ||
+                 PyObject_SetAttr(cq, s_usage_version, uv2) != 0;
+        Py_XDECREF(uv2);
+        Py_DECREF(uv);
+      } else {
+        failed = 1;
+      }
+    }
+    if (!failed) {
+      PyObject* sinks = PyObject_GetAttr(cq, s_dirty_sinks);
+      if (sinks == nullptr) {
+        failed = 1;
+      } else if (sinks != Py_None) {
+        PyObject* name = PyObject_GetAttr(cq, s_name);
+        if (name == nullptr) {
+          failed = 1;
+        } else {
+          PyObject* it = PyObject_GetIter(sinks);
+          if (it == nullptr) {
+            failed = 1;
+          } else {
+            PyObject* sink;
+            while (!failed && (sink = PyIter_Next(it)) != nullptr) {
+              failed = PySet_Add(sink, name) != 0;
+              Py_DECREF(sink);
+            }
+            if (PyErr_Occurred()) failed = 1;
+            Py_DECREF(it);
+          }
+          Py_DECREF(name);
+        }
+      }
+      Py_XDECREF(sinks);
+    }
+    if (!failed) {
+      // _apply_usage(wi, +1, cohort_too=False, admitted=adm): own usage
+      // + admitted split, tracked pairs only (no cohort walk here).
+      PyObject* usage = PyObject_GetAttr(cq, s_usage);
+      PyObject* adm_usage =
+          adm ? PyObject_GetAttr(cq, s_admitted_usage) : nullptr;
+      if (usage == nullptr || (adm && adm_usage == nullptr)) {
+        failed = 1;
+      } else if (PyList_Check(triples)) {
+        Py_ssize_t nt = PyList_GET_SIZE(triples);
+        for (Py_ssize_t k = 0; !failed && k < nt; ++k) {
+          PyObject* t = PyList_GET_ITEM(triples, k);
+          if (!PyTuple_Check(t) || PyTuple_GET_SIZE(t) != 3) {
+            PyErr_SetString(PyExc_TypeError, "triple must be (flv, res, v)");
+            failed = 1;
+            break;
+          }
+          PyObject* flv = PyTuple_GET_ITEM(t, 0);
+          PyObject* res = PyTuple_GET_ITEM(t, 1);
+          PyObject* v = PyTuple_GET_ITEM(t, 2);
+          if (bump_tracked(usage, flv, res, v, 1) != 0 ||
+              (adm_usage != nullptr &&
+               bump_tracked(adm_usage, flv, res, v, 1) != 0))
+            failed = 1;
+        }
+      } else {
+        PyErr_SetString(PyExc_TypeError, "triples must be a list");
+        failed = 1;
+      }
+      Py_XDECREF(usage);
+      Py_XDECREF(adm_usage);
+    }
+    if (!failed) {
+      // _lq_note(wi, +1, adm): stats keyed "namespace/queue_name",
+      // gated on the LocalQueue pointing at this same ClusterQueue.
+      PyObject* ns = PyObject_GetAttr(wl, s_namespace);
+      PyObject* qn = ns ? PyObject_GetAttr(wl, s_queue_name) : nullptr;
+      PyObject* lq_key = qn ? PyUnicode_FromFormat("%U/%U", ns, qn) : nullptr;
+      Py_XDECREF(ns);
+      Py_XDECREF(qn);
+      if (lq_key == nullptr) {
+        failed = 1;
+      } else {
+        PyObject* stats = PyDict_GetItemWithError(lq_stats, lq_key);
+        PyObject* lq = stats != nullptr
+                           ? PyDict_GetItemWithError(local_queues, lq_key)
+                           : nullptr;
+        if (PyErr_Occurred()) failed = 1;
+        if (!failed && stats != nullptr && lq != nullptr) {
+          PyObject* lq_cq = PyObject_GetAttr(lq, s_cluster_queue);
+          if (lq_cq == nullptr) {
+            failed = 1;
+          } else {
+            int same = PyObject_RichCompareBool(lq_cq, cq_name, Py_EQ);
+            Py_DECREF(lq_cq);
+            if (same < 0) failed = 1;
+            if (!failed && same == 1) {
+              PyObject* resv = PyDict_GetItem(stats, s_reserving);
+              PyObject* one = PyLong_FromLong(1);
+              PyObject* r2 =
+                  (resv && one) ? PyNumber_Add(resv, one) : nullptr;
+              failed = r2 == nullptr ||
+                       PyDict_SetItem(stats, s_reserving, r2) != 0;
+              Py_XDECREF(r2);
+              if (!failed && adm) {
+                PyObject* keys = PyDict_GetItem(stats, s_admitted_keys);
+                failed = keys == nullptr || PySet_Add(keys, key) != 0;
+                if (!failed) {
+                  PyObject* a = PyDict_GetItem(stats, s_admitted);
+                  PyObject* a2 = a ? PyNumber_Add(a, one) : nullptr;
+                  failed = a2 == nullptr ||
+                           PyDict_SetItem(stats, s_admitted, a2) != 0;
+                  Py_XDECREF(a2);
+                }
+              }
+              Py_XDECREF(one);
+              if (!failed) {
+                PyObject* resd = PyDict_GetItem(stats, s_reservation);
+                PyObject* admd =
+                    adm ? PyDict_GetItem(stats, s_admitted_usage_key)
+                        : nullptr;
+                if (resd == nullptr) {
+                  failed = 1;
+                } else {
+                  Py_ssize_t nt = PyList_GET_SIZE(triples);
+                  for (Py_ssize_t k = 0; !failed && k < nt; ++k) {
+                    PyObject* t = PyList_GET_ITEM(triples, k);
+                    PyObject* flv = PyTuple_GET_ITEM(t, 0);
+                    PyObject* res = PyTuple_GET_ITEM(t, 1);
+                    PyObject* v = PyTuple_GET_ITEM(t, 2);
+                    if (bump_create(resd, flv, res, v, 1) != 0 ||
+                        (admd != nullptr &&
+                         bump_create(admd, flv, res, v, 1) != 0))
+                      failed = 1;
+                  }
+                }
+              }
+            }
+          }
+        }
+        Py_DECREF(lq_key);
+      }
+    }
+    if (!failed) failed = PyDict_SetItem(assumed, key, cq_name) != 0;
+    if (!failed) failed = PyList_Append(out, info) != 0;
+    Py_DECREF(key);
+    Py_DECREF(cq_name);
+    if (failed) {
+      // Borrowed-reference misses (a malformed _lq_stats entry) reach
+      // here without an exception set; never return NULL bare.
+      if (!PyErr_Occurred())
+        PyErr_SetString(PyExc_KeyError,
+                        "LocalQueue stats entry missing a required field");
+      return nullptr;
     }
   }
+  Py_RETURN_NONE;
+}
+
+// RAII int64 buffer view (PyBUF_ND keeps the shape available; PyBUF_FORMAT
+// lets the dtype actually be verified — itemsize alone would admit
+// float64/uint64 and silently reinterpret their bits).
+struct NdBuf {
+  Py_buffer view{};
+  bool ok = false;
+  NdBuf(PyObject* o, bool writable) {
+    if (PyObject_GetBuffer(o, &view,
+                           PyBUF_ND | PyBUF_FORMAT |
+                               (writable ? PyBUF_WRITABLE : 0)) == 0) {
+      const char* f = view.format;
+      if (view.itemsize == 8 && f != nullptr &&
+          (f[0] == 'q' || f[0] == 'l') && f[1] == '\0') {
+        ok = true;
+      } else {
+        PyBuffer_Release(&view);
+        PyErr_SetString(PyExc_TypeError, "expected an int64 array");
+      }
+    }
+  }
+  ~NdBuf() {
+    if (ok) PyBuffer_Release(&view);
+  }
+  const long long* data() const { return (const long long*)view.buf; }
+  long long* wdata() const { return (long long*)view.buf; }
+};
+
+// hier_gate_fold(t, blim, lend, paths, nominal, usage, cq_lend,
+//                ci, fis, ris, vals, do_gate, do_fold) -> bool
+//
+// Fused HierCycleState per-entry operation reading the solver's dense
+// int64 tensors directly (no per-item Python scalar indexing):
+//   gate  — the admission-cycle feasibility walk: each item's delta is
+//           clamped through the ClusterQueue's own lending limit
+//           (min(lend_cq, t_old) - min(lend_cq, t_old - val)), then
+//           propagated up `paths[ci]` checking every ancestor balance
+//           against its borrowing limit. Returns False on the first
+//           violated node WITHOUT mutating anything.
+//   fold  — the reservation charge: the raw value lands at the direct
+//           cohort node (deliberately NOT through the CQ clamp — the
+//           cycle's cohortsUsage semantics, see core/hierarchy.py) and
+//           propagates up through each node's lending clamp, mutating t.
+// With both flags set the fold only runs when the gate passes — the
+// scheduler's FIT-entry sequence (gate, then reserve) in ONE call.
+//
+// t: flat [K2*F*R] writable; blim/lend: flat [K2*F*R]; paths: [C,D]
+// (raw node ids, -1 padded); nominal/usage/cq_lend: [C,F,R]. All int64.
+PyObject* hier_gate_fold(PyObject*, PyObject* args) {
+  PyObject *t_o, *blim_o, *lend_o, *paths_o, *nom_o, *use_o, *cql_o;
+  PyObject *fis_o, *ris_o, *vals_o;
+  int ci, do_gate, do_fold;
+  if (!PyArg_ParseTuple(args, "OOOOOOOiOOOpp", &t_o, &blim_o, &lend_o,
+                        &paths_o, &nom_o, &use_o, &cql_o, &ci, &fis_o,
+                        &ris_o, &vals_o, &do_gate, &do_fold))
+    return nullptr;
+  NdBuf t(t_o, true), blim(blim_o, false), lend(lend_o, false),
+      paths(paths_o, false), nom(nom_o, false), use(use_o, false),
+      cql(cql_o, false);
+  if (!t.ok || !blim.ok || !lend.ok || !paths.ok || !nom.ok || !use.ok ||
+      !cql.ok)
+    return nullptr;
+  if (nom.view.ndim != 3 || paths.view.ndim != 2) {
+    PyErr_SetString(PyExc_TypeError,
+                    "hier_gate_fold: nominal must be [C,F,R], paths [C,D]");
+    return nullptr;
+  }
+  const Py_ssize_t R = nom.view.shape[2];
+  const Py_ssize_t FR = nom.view.shape[1] * R;
+  const Py_ssize_t D = paths.view.shape[1];
+  const long long* path = paths.data() + (Py_ssize_t)ci * D;
+  PyObject* fis = PySequence_Fast(fis_o, "fis must be a sequence");
+  PyObject* ris = fis ? PySequence_Fast(ris_o, "ris must be a sequence")
+                      : nullptr;
+  PyObject* vals = ris ? PySequence_Fast(vals_o, "vals must be a sequence")
+                       : nullptr;
+  if (vals == nullptr) {
+    Py_XDECREF(fis);
+    Py_XDECREF(ris);
+    return nullptr;
+  }
+  Py_ssize_t n = PySequence_Fast_GET_SIZE(fis);
+  if (PySequence_Fast_GET_SIZE(ris) != n ||
+      PySequence_Fast_GET_SIZE(vals) != n) {
+    PyErr_SetString(PyExc_ValueError, "fis/ris/vals length mismatch");
+    n = -1;
+  }
+  const long long* td = t.data();
+  long long* tw = t.wdata();
+  const long long* blimd = blim.data();
+  const long long* lendd = lend.data();
+  const long long* nomd = nom.data();
+  const long long* used = use.data();
+  const long long* cqld = cql.data();
+  bool fail = n < 0;
+  bool blocked = false;
+  for (int phase = 0; !fail && !blocked && phase < 2; ++phase) {
+    if (phase == 0 ? !do_gate : (!do_fold)) continue;
+    for (Py_ssize_t i = 0; !fail && i < n; ++i) {
+      long long fi = PyLong_AsLongLong(PySequence_Fast_GET_ITEM(fis, i));
+      long long ri = PyLong_AsLongLong(PySequence_Fast_GET_ITEM(ris, i));
+      long long val = PyLong_AsLongLong(PySequence_Fast_GET_ITEM(vals, i));
+      if (PyErr_Occurred()) {
+        fail = true;
+        break;
+      }
+      const Py_ssize_t off = (Py_ssize_t)(fi * R + ri);
+      long long delta;
+      if (phase == 0) {
+        const Py_ssize_t base = (Py_ssize_t)ci * FR + off;
+        const long long t_old = nomd[base] - used[base];
+        const long long lcq = cqld[base];
+        delta = (lcq < t_old ? lcq : t_old) -
+                (lcq < t_old - val ? lcq : t_old - val);
+      } else {
+        delta = val;
+      }
+      for (Py_ssize_t d = 0; d < D; ++d) {
+        const long long node = path[d];
+        if (node < 0 || (phase == 1 && delta == 0)) break;
+        const Py_ssize_t idx = (Py_ssize_t)node * FR + off;
+        const long long tv = td[idx];
+        const long long tn = tv - delta;
+        if (phase == 0) {
+          if (tn < -blimd[idx]) {
+            blocked = true;
+            break;
+          }
+        } else {
+          tw[idx] = tn;
+        }
+        const long long l = lendd[idx];
+        delta = (l < tv ? l : tv) - (l < tn ? l : tn);
+      }
+      if (blocked) break;
+    }
+  }
+  Py_DECREF(fis);
+  Py_DECREF(ris);
+  Py_DECREF(vals);
+  if (fail) return nullptr;
+  if (blocked) Py_RETURN_FALSE;
   Py_RETURN_TRUE;
 }
 
@@ -416,8 +793,10 @@ PyMethodDef methods[] = {
      "Setdefault-style LocalQueue stats walk (Cache._lq_apply semantics)."},
     {"flush_mirror", flush_mirror, METH_VARARGS,
      "SnapshotMirror.flush_pending loop (lockstep add/remove walk)."},
-    {"hier_entry", hier_entry, METH_VARARGS,
-     "HierCycleState per-entry ancestor walk (check or fold)."},
+    {"hier_gate_fold", hier_gate_fold, METH_VARARGS,
+     "Fused HierCycleState gate+fold on dense int64 tensors."},
+    {"assume_batch", assume_batch, METH_VARARGS,
+     "Cache.assume_workloads commit loop (caller holds the cache lock)."},
     {nullptr, nullptr, 0, nullptr}};
 
 PyModuleDef moduledef = {PyModuleDef_HEAD_INIT, "_kueue_ledger",

@@ -10,8 +10,11 @@ at north-star scale (1k ClusterQueues solved 9+ seconds/tick).
 (models/flavor_fit.py aggregate_t / hier_ok) run host-side on the solver's
 dense tensors: one vectorized bottom-up T aggregation per cycle, then
 O(depth) integer walks per entry for both the feasibility check and the
-reservation fold. Semantics are pinned to the dict referee
-(core/hierarchy.py) by a randomized equivalence test.
+reservation fold. The walks run in ONE native call per entry
+(native/ledger.cpp hier_gate_fold) reading the int64 tensors directly —
+the scheduler's FIT sequence (gate, then reserve) is a single fused call.
+Semantics are pinned to the dict referee (core/hierarchy.py) by a
+randomized equivalence test.
 
 Only valid while the solver encoding matches the snapshot the cycle runs
 against (BatchSolver.encoding_matches) — the scheduler falls back to the
@@ -27,7 +30,7 @@ import numpy as np
 from kueue_tpu.utils import native_ledger
 
 _ledger = native_ledger.load()
-_HIER_ENTRY = getattr(_ledger, "hier_entry", None)
+_GATE_FOLD = getattr(_ledger, "hier_gate_fold", None)
 
 
 class HierCycleState:
@@ -44,8 +47,8 @@ class HierCycleState:
     through the lending clamps (subtree_t's `extra` semantics).
     """
 
-    __slots__ = ("enc", "h", "t", "_blim", "_lend", "_paths",
-                 "_nominal", "_usage", "_cq_lend", "_t_np", "folds")
+    __slots__ = ("enc", "h", "t", "_t3", "_blim", "_lend", "_paths",
+                 "_nominal", "_usage", "_cq_lend", "folds")
 
     def __init__(self, enc, usage: np.ndarray):
         """`enc`: the solver CQEncoding (with .hier); `usage`: the
@@ -63,50 +66,81 @@ class HierCycleState:
                       np.minimum(h.node_lend[nodes], t_node[nodes]))
         self.enc = enc
         self.h = h
-        # Node-side tensors as flat Python lists: the per-entry walks read
-        # a handful of scalars each, and list indexing is ~7x cheaper than
-        # numpy scalar indexing. The flattening is O(nodes x F x R) once
-        # per cycle — small next to one entry's former full-tree walk.
-        _, F, R = t_cq.shape
-        self.t = t_node.ravel().tolist()
-        # Dense copy for the vectorized fold-free batch check (fits_many);
-        # diverges from the list once folds run, hence the folds guard.
-        self._t_np = t_node
-        self._blim = h.node_blim.ravel().tolist()
-        self._lend = h.node_lend.ravel().tolist()
-        # Paths pre-multiplied by F*R: the flat index of (node, fi, ri)
-        # is path[d] + fi*R + ri (the C walk's contract; sentinels stay
-        # negative).
-        self._paths = (h.cq_path.astype(np.int64) * (F * R)).tolist()
+        # Balances stay a contiguous int64 tensor: `t` is the flat view
+        # the native walk indexes (node*F*R + fi*R + ri), `_t3` the same
+        # memory shaped [K2,F,R] for the vectorized fold-free batch check
+        # (fits_many).
+        t_node = np.ascontiguousarray(t_node)
+        self._t3 = t_node
+        self.t = t_node.reshape(-1)
+        self._blim = h.node_blim.reshape(-1)
+        self._lend = h.node_lend.reshape(-1)
+        # Raw ancestor node ids as int64 (the native call's dtype),
+        # cached per encoding — cq_path itself is i32.
+        paths = getattr(h, "_paths64", None)
+        if paths is None:
+            paths = np.ascontiguousarray(h.cq_path, dtype=np.int64)
+            h._paths64 = paths
+        self._paths = paths
         self._nominal = enc.nominal
         self._usage = usage
         self._cq_lend = h.cq_lend
         self.folds = 0
 
-    # -- per-entry operations (plain-int walks, O(depth x pairs)) ----------
+    # -- per-entry operations (one native call, O(depth x pairs)) ----------
+
+    def gate_fold(self, ci: int, fis: Sequence[int], ris: Sequence[int],
+                  vals: Sequence[int], do_gate: bool = True,
+                  do_fold: bool = True) -> bool:
+        """Fused admission-cycle step for one entry: feasibility walk
+        (each pair's delta clamped through the CQ's own lending limit,
+        checked against every ancestor's borrowing limit), then — only
+        when the gate passes — the reservation fold (raw values charged
+        at the direct cohort node, propagated through the node lending
+        clamps). Returns False when gated; mutates nothing in that case."""
+        if _GATE_FOLD is not None:
+            ok = _GATE_FOLD(self.t, self._blim, self._lend, self._paths,
+                            self._nominal, self._usage, self._cq_lend,
+                            ci, fis, ris, vals, do_gate, do_fold)
+        else:
+            ok = not do_gate or self._fits_py(ci, fis, ris, vals)
+            if ok and do_fold:
+                self._fold_py(ci, fis, ris, vals)
+        if ok and do_fold:
+            self.folds += 1
+        return ok
 
     def fits(self, ci: int, items: Sequence[Tuple[int, int, int]]) -> bool:
         """True when adding `items` ([(flavor_idx, resource_idx, val)]) to
         ClusterQueue `ci` keeps every ancestor balance within its
         borrowing limit — `hierarchical_lack(...) == 0` for each pair,
         against the snapshot state minus this cycle's folds."""
+        if not items:
+            return True
+        fis, ris, vals = zip(*items)
+        return self.gate_fold(ci, fis, ris, vals, do_gate=True,
+                              do_fold=False)
+
+    def fold(self, ci: int, items: Sequence[Tuple[int, int, int]]) -> None:
+        """Reserve `items` at ClusterQueue `ci`'s direct cohort node and
+        propagate the clamped delta up the ancestor chain (the cycle's
+        cohortsUsage fold, subtree_t `extra` semantics)."""
+        if not items:
+            self.folds += 1
+            return
+        fis, ris, vals = zip(*items)
+        self.gate_fold(ci, fis, ris, vals, do_gate=False, do_fold=True)
+
+    # -- pure-Python fallback walks (no native toolchain) -------------------
+
+    def _fits_py(self, ci, fis, ris, vals) -> bool:
         R = self._nominal.shape[2]
-        if _HIER_ENTRY is not None:
-            pairs = []
-            for fi, ri, val in items:
-                t_old = int(self._nominal[ci, fi, ri]) \
-                    - int(self._usage[ci, fi, ri])
-                lend_cq = int(self._cq_lend[ci, fi, ri])
-                pairs.append((fi * R + ri,
-                              min(lend_cq, t_old)
-                              - min(lend_cq, t_old - int(val))))
-            return _HIER_ENTRY(self.t, self._blim, self._lend,
-                               self._paths[ci], pairs, 0)
+        FR = self._nominal.shape[1] * R
         t_l = self.t
         blim_l = self._blim
         lend_l = self._lend
         path = self._paths[ci]
-        for fi, ri, val in items:
+        for fi, ri, val in zip(fis, ris, vals):
             off = fi * R + ri
             t_old = int(self._nominal[ci, fi, ri]) \
                 - int(self._usage[ci, fi, ri])
@@ -115,14 +149,33 @@ class HierCycleState:
             for node in path:
                 if node < 0:
                     break
-                j = node + off
-                t = t_l[j]
+                j = int(node) * FR + off
+                t = int(t_l[j])
                 t_new = t - delta
-                if t_new < -blim_l[j]:
+                if t_new < -int(blim_l[j]):
                     return False
-                lend = lend_l[j]
+                lend = int(lend_l[j])
                 delta = min(lend, t) - min(lend, t_new)
         return True
+
+    def _fold_py(self, ci, fis, ris, vals) -> None:
+        R = self._nominal.shape[2]
+        FR = self._nominal.shape[1] * R
+        t_l = self.t
+        lend_l = self._lend
+        path = self._paths[ci]
+        for fi, ri, val in zip(fis, ris, vals):
+            off = fi * R + ri
+            delta = int(val)
+            for node in path:
+                if node < 0 or delta == 0:
+                    break
+                j = int(node) * FR + off
+                t = int(t_l[j])
+                t_new = t - delta
+                t_l[j] = t_new
+                lend = int(lend_l[j])
+                delta = min(lend, t) - min(lend, t_new)
 
     def fits_many(self, cis, fis, ris, vals) -> np.ndarray:
         """Vectorized `fits` over independent (cq, flavor, resource, val)
@@ -132,7 +185,7 @@ class HierCycleState:
         if self.folds:
             raise ValueError("fits_many requires a fold-free state")
         h = self.h
-        t = self._t_np
+        t = self._t3
         ci = np.asarray(cis)
         fi = np.asarray(fis)
         ri = np.asarray(ris)
@@ -154,33 +207,6 @@ class HierCycleState:
                 valid,
                 np.minimum(lend, t_n) - np.minimum(lend, t_new), delta)
         return ok
-
-    def fold(self, ci: int, items: Sequence[Tuple[int, int, int]]) -> None:
-        """Reserve `items` at ClusterQueue `ci`'s direct cohort node and
-        propagate the clamped delta up the ancestor chain (the cycle's
-        cohortsUsage fold, subtree_t `extra` semantics)."""
-        R = self._nominal.shape[2]
-        self.folds += 1
-        if _HIER_ENTRY is not None:
-            _HIER_ENTRY(self.t, self._blim, self._lend, self._paths[ci],
-                        [(fi * R + ri, int(val)) for fi, ri, val in items],
-                        1)
-            return
-        t_l = self.t
-        lend_l = self._lend
-        path = self._paths[ci]
-        for fi, ri, val in items:
-            off = fi * R + ri
-            delta = int(val)
-            for node in path:
-                if node < 0 or delta == 0:
-                    break
-                j = node + off
-                t = t_l[j]
-                t_new = t - delta
-                t_l[j] = t_new
-                lend = lend_l[j]
-                delta = min(lend, t) - min(lend, t_new)
 
     # -- coordinate helpers -------------------------------------------------
 

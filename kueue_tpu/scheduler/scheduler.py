@@ -537,6 +537,26 @@ class Scheduler:
                 if fn is not None:
                     hier_box[0] = fn(snapshot)
             return hier_box[0]
+
+        # While the dense tree state is alive, hierarchical reservations
+        # defer their dict bookkeeping to a flat log — the dicts are only
+        # read by fallback paths (state death, out-of-encoding gates, the
+        # preempt common-resource check), so the common all-FIT cycle
+        # skips ~2 dict walks per admission. Materialization replays the
+        # log once and switches back to eager mode; flat cohorts (disjoint
+        # key space) stay eager throughout.
+        hier_lazy = [True]
+        hier_fold_log: List[tuple] = []
+
+        def materialize_cycle_dicts():
+            if hier_lazy[0]:
+                hier_lazy[0] = False
+                for node_name, root_n, reserve_ in hier_fold_log:
+                    frq_add(cycle_cohorts_usage.setdefault(node_name, {}),
+                            reserve_)
+                    frq_add(cycle_root_usage.setdefault(root_n, {}),
+                            reserve_)
+                hier_fold_log.clear()
         preempting: List = []
         pending_assumes: List = []
         # Deferred victim searches, pre-batched for the entries most likely
@@ -630,16 +650,33 @@ class Scheduler:
                 # A pending preemption invalidates later preemption
                 # calculations only where this cycle actually reserved
                 # common flavor-resources (scheduler.go:218-222).
-                blocked = (mode == PREEMPT
-                           and root_name in cycle_cohorts_skip_preemption
-                           and _has_common_flavor_resources(
-                               cycle_root_usage.get(root_name),
-                               e.assignment.usage))
+                blocked = False
+                if mode == PREEMPT \
+                        and root_name in cycle_cohorts_skip_preemption:
+                    if hier:
+                        materialize_cycle_dicts()
+                    blocked = _has_common_flavor_resources(
+                        cycle_root_usage.get(root_name),
+                        e.assignment.usage)
+                fused_folded = False
                 if not blocked and mode == FIT:
                     if hier:
                         hier_state = ensure_hier_state()
                         if hier_state is not None:
-                            if hier_state.folds:
+                            idx = e.assignment.usage_idx
+                            ci = hier_state.enc.cq_index.get(cq.name)
+                            if idx is not None and ci is not None:
+                                # Fused gate+reserve: ONE native ancestor
+                                # walk checks feasibility and, only when
+                                # it passes, charges the reservation —
+                                # the FIT entry's whole tree interaction.
+                                blocked = not hier_state.gate_fold(
+                                    ci, idx[0], idx[1], idx[2],
+                                    do_gate=bool(hier_state.folds),
+                                    do_fold=True)
+                                fused_folded = not blocked
+                            elif hier_state.folds:
+                                materialize_cycle_dicts()
                                 blocked = not self._hier_fits(
                                     hier_state, cq, e.assignment,
                                     cycle_cohorts_usage)
@@ -672,7 +709,8 @@ class Scheduler:
                     # FIT gate): the state must exist before the fold or
                     # later gates would miss this reservation.
                     hier_state = ensure_hier_state()
-                    if hier_state is not None:
+                    folded = fused_folded and hier_state is not None
+                    if hier_state is not None and not folded:
                         ci = hier_state.enc.cq_index.get(cq.name)
                         idx = e.assignment.usage_idx \
                             if reserve is e.assignment.usage else None
@@ -693,12 +731,18 @@ class Scheduler:
                             # hold every reservation, so the dict walk
                             # takes over for the rest of the cycle.
                             hier_box[0] = None
+                            materialize_cycle_dicts()
                         else:
                             hier_state.fold(ci, coords)
-                    frq_add(cycle_cohorts_usage.setdefault(
-                        cq.cohort.name, {}), reserve)
-                    frq_add(cycle_root_usage.setdefault(root_name, {}),
-                            reserve)
+                            folded = True
+                    if folded and hier_lazy[0]:
+                        hier_fold_log.append(
+                            (cq.cohort.name, root_name, reserve))
+                    else:
+                        frq_add(cycle_cohorts_usage.setdefault(
+                            cq.cohort.name, {}), reserve)
+                        frq_add(cycle_root_usage.setdefault(root_name, {}),
+                                reserve)
                 else:
                     # Flat cohort: node == root; share ONE dict so the
                     # reservation folds once and both views read it.
@@ -866,10 +910,18 @@ class Scheduler:
         # exactly that case (no reclaim scaling, spec counts) the admission
         # usage equals the spec-based totals the info already memoized, so
         # the cache can account it without constructing a fresh info.
-        results = self.cache.assume_workloads(
-            [(e.info.obj, triples, e.info if triples is not None else None,
-              admitted_now)
-             for e, _, triples, admitted_now in pending])
+        # All-fast batches (every admission flattened; the common shape)
+        # additionally satisfy the native commit loop's contract — the
+        # info IS the entry whose cluster_queue the admission names.
+        items = []
+        all_fast = True
+        for e, _, triples, admitted_now in pending:
+            if triples is None:
+                all_fast = False
+                items.append((e.info.obj, triples, None, admitted_now))
+            else:
+                items.append((e.info.obj, triples, e.info, admitted_now))
+        results = self.cache.assume_workloads(items, fast=all_fast)
         REGISTRY.tick_phase_seconds.observe(
             "admit.flush.assume", value=_time.perf_counter() - t_a)
         now = self.clock()

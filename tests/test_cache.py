@@ -439,3 +439,65 @@ def test_mirror_removal_not_masked_by_same_batch_admission():
         "mirror must reflect the eviction (only the winner's 2 cpu)"
     assert "default/victim" not in cq.workloads
     assert "default/winner" in cq.workloads
+
+
+def test_assume_workloads_fast_matches_python():
+    """The native bulk-commit loop (ledger.cpp assume_batch, fast=True)
+    must leave the cache bit-identical to the Python twin: usage,
+    admitted split, LocalQueue stats, assumed map, dirty marks, and the
+    duplicate/missing-CQ error strings."""
+    import copy
+
+    from kueue_tpu.core.workload import WorkloadInfo
+
+    def build_items(cache):
+        items = []
+        for i in range(12):
+            cq = "cq-a" if i % 3 else "cq-b"
+            admitted = i % 4 != 0
+            wl = admit(make_wl(f"bulk{i}", cpu=1 + i % 3, memory="1Gi"),
+                       cq, "default", admitted=admitted)
+            wi = WorkloadInfo(wl, cluster_queue=cq)
+            triples = [(flv, res, v)
+                       for flv, res_map in _wl_usage(wl).items()
+                       for res, v in res_map.items()]
+            items.append((wl, triples, wi, admitted))
+        # A duplicate (same key assumed twice) and a missing CQ exercise
+        # the error strings.
+        dup_wl, dup_t, dup_wi, dup_adm = items[0]
+        items.append((dup_wl, dup_t, WorkloadInfo(
+            dup_wl, cluster_queue="cq-a"), dup_adm))
+        ghost = admit(make_wl("ghost", cpu=1), "cq-gone", "default")
+        items.append((ghost, [("default", "cpu", 1000)],
+                      WorkloadInfo(ghost, cluster_queue="cq-gone"), True))
+        return items
+
+    def _wl_usage(wl):
+        out = {}
+        for psa in wl.admission.pod_set_assignments:
+            for res, v in psa.resource_usage.items():
+                flv = psa.flavors[res]
+                out.setdefault(flv, {})[res] = \
+                    out.setdefault(flv, {}).get(res, 0) + v
+        return out
+
+    def state(cache):
+        return (
+            {n: copy.deepcopy(cq.usage)
+             for n, cq in cache.cluster_queues.items()},
+            {n: copy.deepcopy(cq.admitted_usage)
+             for n, cq in cache.cluster_queues.items()},
+            {n: sorted(cq.workloads) for n, cq in
+             cache.cluster_queues.items()},
+            dict(cache.assumed_workloads),
+            copy.deepcopy(cache._lq_stats),
+        )
+
+    fast_cache = build_cache()
+    slow_cache = build_cache()
+    fast_out = fast_cache.assume_workloads(build_items(fast_cache),
+                                           fast=True)
+    slow_out = slow_cache.assume_workloads(build_items(slow_cache))
+    assert [o if isinstance(o, str) else o.key for o in fast_out] \
+        == [o if isinstance(o, str) else o.key for o in slow_out]
+    assert state(fast_cache) == state(slow_cache)
