@@ -49,21 +49,46 @@ LINGER_TICKS = (4, 5, 6)
 
 def run_config(*, label, num_cqs, num_cohorts, num_flavors, backlog, ticks,
                usage_fill, depth, preemption_heavy, fair_hierarchy=False,
-               seed=42):
+               lending=False, seed=42):
     from kueue_tpu.models.flavor_fit import BatchSolver
     from kueue_tpu.api.types import PodSet, Workload
     from kueue_tpu.utils.synthetic import synthetic_framework
 
+    from kueue_tpu import features
+
     if fair_hierarchy:
-        from kueue_tpu import features
         features.set_enabled(features.FAIR_SHARING, True)
+    if lending:
+        features.set_enabled(features.LENDING_LIMIT, True)
     t0 = time.perf_counter()
     fw = synthetic_framework(
         num_cqs=num_cqs, num_cohorts=num_cohorts, num_flavors=num_flavors,
         num_pending=backlog, usage_fill=usage_fill, seed=seed,
         preemption_heavy=preemption_heavy, fair_hierarchy=fair_hierarchy,
-        batch_solver=BatchSolver(), pipeline_depth=depth)
+        lending=lending, batch_solver=BatchSolver(), pipeline_depth=depth)
     t_setup = time.perf_counter() - t0
+
+    inject_ms = float(os.environ.get("KUEUE_BENCH_INJECT_MS", "0") or 0)
+    if inject_ms:
+        # Transfer-latency injection: replay a measured device round-trip
+        # (the round-1/2 microbench saw ~9-12 ms per dispatch over the
+        # attachment link) into the pipeline — collect() blocks until the
+        # dispatch is at least `inject_ms` old, exactly like waiting on a
+        # remote device. Shows whether depth-k pipelining hides real
+        # transfer latency without the device being reachable.
+        solver = fw.scheduler.batch_solver
+        orig_collect = solver.collect
+
+        def delayed_collect(inflight):
+            dispatched = inflight.get("dispatched")
+            if dispatched is not None:
+                remaining = inject_ms / 1000.0 \
+                    - (time.perf_counter() - dispatched)
+                if remaining > 0:
+                    time.sleep(remaining)
+            return orig_collect(inflight)
+
+        solver.collect = delayed_collect
 
     # Track admissions as they apply so churn can finish them later
     # without scanning the 50k-workload map per tick. One expiry-ordered
@@ -177,10 +202,26 @@ def run_config(*, label, num_cqs, num_cohorts, num_flavors, backlog, ticks,
     p50 = float(np.percentile(times_ms, 50))
     p99 = float(np.percentile(times_ms, 99))
     import jax
+    backend = jax.default_backend()
+    inject_ms = float(os.environ.get("KUEUE_BENCH_INJECT_MS", "0") or 0)
+    if inject_ms:
+        backend = f"{backend}+inject{inject_ms:g}ms"
+    stats = {
+        "backend": backend,
+        "ticks": ticks,
+        "p50_ms": round(p50, 3),
+        "p99_ms": round(p99, 3),
+        "mean_ms": round(float(times_ms.mean()), 3),
+        "admitted": admitted,
+        "preempted": preempted,
+        "admissions_per_s": round(admitted / (sum(times) or 1e-9), 1),
+        "phase_means_ms": {k: round(v, 2) for k, v in phase_means.items()
+                           if v >= 0.05},
+    }
     print(
         f"# [{label}] {num_cqs} CQs x {num_cohorts} cohorts x {num_flavors} "
         f"flavors, backlog {backlog}, {ticks} ticks on "
-        f"{jax.default_backend()}, depth {depth}, setup {t_setup:.1f}s\n"
+        f"{backend}, depth {depth}, setup {t_setup:.1f}s\n"
         f"# [{label}] e2e tick: p50 {p50:.2f}ms  p99 {p99:.2f}ms  "
         f"({admitted} admitted, {preempted} preempted, "
         f"{admitted / (sum(times) or 1e-9):,.0f} admissions/s)\n"
@@ -193,7 +234,16 @@ def run_config(*, label, num_cqs, num_cohorts, num_flavors, backlog, ticks,
                   + "  ".join(f"{k}={v * 1000:.1f}"
                               for k, v in sorted(row.items())),
                   file=sys.stderr)
-    return p50, p99
+    return stats
+
+
+METRIC_NAMES = {
+    "single": "p99_single_cq_tick_ms",
+    "cohortlend": "p99_cohort_lending_tick_ms",
+    "preempt": "p99_preemption_tick_ms",
+    "fair": "p99_fair_hier_tick_ms",
+    "northstar": "p99_e2e_tick_ms",
+}
 
 
 def run_one(config: str) -> None:
@@ -217,39 +267,51 @@ def run_one(config: str) -> None:
         # population rather than a single outlier (with 60 ticks p99 ~= max).
         ticks = int(os.environ.get("KUEUE_BENCH_TICKS", "150"))
 
+    def emit(metric, stats, target_ms=100.0):
+        p99 = stats["p99_ms"]
+        line = {
+            "metric": metric, "value": p99, "unit": "ms",
+            "vs_baseline": round(target_ms / p99, 3) if p99 > 0 else None,
+        }
+        line.update(stats)
+        print(json.dumps(line), flush=True)
+
     if config == "preempt":
         # BASELINE config #3: preemption-heavy.
-        _, p99_pre = run_config(
+        emit(METRIC_NAMES[config], run_config(
             label="preempt", ticks=max(ticks // 2, 8), usage_fill=0.9,
-            depth=depth, preemption_heavy=True, **shape)
-        print(json.dumps({
-            "metric": "p99_preemption_tick_ms", "value": round(p99_pre, 3),
-            "unit": "ms",
-            "vs_baseline": round(100.0 / p99_pre, 3) if p99_pre > 0 else None,
-        }), flush=True)
+            depth=depth, preemption_heavy=True, **shape))
     elif config == "fair":
         # BASELINE config #4: weighted-DRF fair sharing over a KEP-79
         # hierarchical cohort tree (leaf cohorts -> mids -> root) — the
         # greenfield feature pair, at the same scale as the headline.
-        _, p99_fair = run_config(
+        emit(METRIC_NAMES[config], run_config(
             label="fair", ticks=max(ticks // 2, 8), usage_fill=0.7,
             depth=depth, preemption_heavy=False, fair_hierarchy=True,
-            **shape)
-        print(json.dumps({
-            "metric": "p99_fair_hier_tick_ms", "value": round(p99_fair, 3),
-            "unit": "ms",
-            "vs_baseline": round(100.0 / p99_fair, 3) if p99_fair > 0
-            else None,
-        }), flush=True)
+            **shape))
+    elif config == "single":
+        # BASELINE config #1: one BestEffortFIFO ClusterQueue, cpu+memory
+        # flavors, no cohort (examples/admin/single-clusterqueue-setup.yaml
+        # shape scaled to a steady arrival flux).
+        emit(METRIC_NAMES[config], run_config(
+            label="single", num_cqs=1, num_cohorts=0,
+            num_flavors=2,
+            backlog=min(2000, shape["backlog"]),
+            ticks=max(ticks // 2, 8), usage_fill=0.5, depth=depth,
+            preemption_heavy=False))
+    elif config == "cohortlend":
+        # BASELINE config #2: 10 ClusterQueues in one cohort, borrowing
+        # with lendingLimit clamps (clusterqueue.go:583-629 semantics).
+        emit(METRIC_NAMES[config], run_config(
+            label="cohortlend", num_cqs=10, num_cohorts=1, num_flavors=4,
+            backlog=min(5000, shape["backlog"]),
+            ticks=max(ticks // 2, 8), usage_fill=0.7, depth=depth,
+            preemption_heavy=False, lending=True))
     else:
         # North-star headline (config #5 shape): LAST line = parsed metric.
-        _, p99 = run_config(
+        emit(METRIC_NAMES["northstar"], run_config(
             label="northstar", ticks=ticks, usage_fill=0.7, depth=depth,
-            preemption_heavy=False, **shape)
-        print(json.dumps({
-            "metric": "p99_e2e_tick_ms", "value": round(p99, 3), "unit": "ms",
-            "vs_baseline": round(100.0 / p99, 3) if p99 > 0 else None,
-        }), flush=True)
+            preemption_heavy=False, **shape))
 
 
 def _probe_device(timeout_s: float = 120.0) -> bool:
@@ -284,7 +346,7 @@ def main() -> None:
         print("# accelerator backend unreachable; falling back to the CPU "
               "backend for this run", file=sys.stderr)
         env_extra["KUEUE_BENCH_FORCE_CPU"] = "1"
-    for config in ("preempt", "fair", "northstar"):
+    for config in ("single", "cohortlend", "preempt", "fair", "northstar"):
         env = dict(os.environ, KUEUE_BENCH_CONFIG=config, **env_extra)
         try:
             # Generous ceiling: a healthy config finishes in minutes; a
@@ -298,9 +360,18 @@ def main() -> None:
                   "retrying on the CPU backend", file=sys.stderr)
             env["KUEUE_BENCH_FORCE_CPU"] = "1"
             env_extra["KUEUE_BENCH_FORCE_CPU"] = "1"
-            res = subprocess.run([sys.executable, os.path.abspath(__file__)],
-                                 env=env, stdout=subprocess.PIPE,
-                                 timeout=1800)
+            try:
+                res = subprocess.run(
+                    [sys.executable, os.path.abspath(__file__)],
+                    env=env, stdout=subprocess.PIPE, timeout=1800)
+            except subprocess.TimeoutExpired:
+                # Even the CPU retry hung: report the failed config and
+                # keep measuring the rest instead of crashing the driver.
+                print(json.dumps({
+                    "metric": METRIC_NAMES[config], "value": None,
+                    "unit": "ms", "vs_baseline": None,
+                    "error": "run timed out on both backends"}), flush=True)
+                continue
         sys.stdout.buffer.write(res.stdout)
         sys.stdout.flush()
         if res.returncode != 0:

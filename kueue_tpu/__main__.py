@@ -224,9 +224,21 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         return adapter.tick()
 
     if args.serve:
+        # Gauge refresh rides the serve loop (the reference's CQ
+        # reconciler re-reports on events), throttled so the O(workloads)
+        # walk never lands on every tick — scrapes just export.
+        last_gauges = 0.0
         try:
             while True:
                 total_admitted += tick_once()
+                now = time.monotonic()
+                if now - last_gauges >= 5.0:
+                    last_gauges = now
+                    if runtime_lock is not None:
+                        with runtime_lock:
+                            fw.update_metrics_gauges()
+                    else:
+                        fw.update_metrics_gauges()
                 time.sleep(args.tick_interval)
         except KeyboardInterrupt:
             pass

@@ -158,6 +158,16 @@ class LeaderElectionConfig:
 
 
 @dataclass(frozen=True)
+class MetricsConfig:
+    """controller-runtime metrics options we honor (the bind address is
+    transport config the embedded build has no server for; the reference
+    knob enableClusterQueueResources gates the optional per-CQ quota
+    gauges, configuration_types.go:135-138)."""
+
+    enable_cluster_queue_resources: bool = False
+
+
+@dataclass(frozen=True)
 class Configuration:
     namespace: str = DEFAULT_NAMESPACE
     # Reconcile jobs submitted with no queue name: suspended until queued
@@ -170,6 +180,7 @@ class Configuration:
     multikueue: MultiKueueConfig = field(default_factory=MultiKueueConfig)
     leader_election: LeaderElectionConfig = field(default_factory=LeaderElectionConfig)
     tpu_solver: TPUSolverConfig = field(default_factory=TPUSolverConfig)
+    metrics: MetricsConfig = field(default_factory=MetricsConfig)
     # Transport-only reference knobs, carried opaquely (see module doc).
     extra: Dict[str, Any] = field(default_factory=dict)
 
@@ -322,6 +333,11 @@ def from_dict(doc: Mapping[str, Any]) -> Configuration:
             preemption_engine=t.get("preemptionEngine"),
             shard_devices=int(t.get("shardDevices", 0)))
 
+    mc = MetricsConfig()
+    if isinstance(doc.get("metrics"), dict):
+        mc = MetricsConfig(enable_cluster_queue_resources=bool(
+            doc["metrics"].get("enableClusterQueueResources", False)))
+
     le = LeaderElectionConfig()
     if doc.get("leaderElection") is not None:
         l = doc["leaderElection"]
@@ -349,6 +365,7 @@ def from_dict(doc: Mapping[str, Any]) -> Configuration:
         multikueue=mk,
         leader_election=le,
         tpu_solver=ts,
+        metrics=mc,
         extra={k: doc[k] for k in _TRANSPORT_KEYS if k in doc},
     )
     errors = validate_configuration(cfg)

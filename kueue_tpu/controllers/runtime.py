@@ -132,6 +132,14 @@ class Framework:
             # collectives ride ICI; there is no host-link round trip to
             # overlap), so depth > 1 would add pipelining's staleness
             # costs while hiding zero latency.
+            if self.pipeline_depth > 1:
+                import logging
+
+                logging.getLogger("kueue_tpu").warning(
+                    "tpuSolver: pipelineDepth=%d is ignored with a sharded "
+                    "solver (shardDevices>1) — the sharded program has no "
+                    "host-link latency to pipeline; forcing depth 1",
+                    self.pipeline_depth)
             self.pipeline_depth = 1
         wfpr = self.config.wait_for_pods_ready
         if ordering is None:
@@ -622,6 +630,46 @@ class Framework:
             for name, cq in snap.cluster_queues.items():
                 REGISTRY.cluster_queue_fair_share.set(
                     name, value=dominant_resource_share(cq)[0])
+        if self.config.metrics.enable_cluster_queue_resources:
+            self._record_resource_metrics()
+
+    def _record_resource_metrics(self) -> None:
+        """Optional per-CQ quota gauges (metrics.enableClusterQueueResources;
+        clusterqueue_controller.go recordResourceMetrics): borrowing/lending
+        limits from the spec quotas (lending only under the LendingLimit
+        gate, metrics.go:219-225) and the reservation totals from the
+        cache's reserved usage. Stale series prune like the reference's
+        ClearClusterQueueResourceMetrics."""
+        lending = features.enabled(features.LENDING_LIMIT)
+        quota_keys = set()
+        usage_keys = set()
+        for name, cq in self.cache.cluster_queues.items():
+            cohort = cq.cohort_name or ""
+            for rg in cq.resource_groups:
+                for fq in rg.flavors:
+                    for rname, quota in fq.resources:
+                        key = (cohort, name, fq.name, rname)
+                        quota_keys.add(key)
+                        REGISTRY.cluster_queue_borrowing_limit.set(
+                            *key, value=float(quota.borrowing_limit or 0))
+                        if lending:
+                            REGISTRY.cluster_queue_lending_limit.set(
+                                *key, value=float(quota.lending_limit or 0))
+            for fname, resources in cq.usage.items():
+                for rname, used in resources.items():
+                    key = (cohort, name, fname, rname)
+                    usage_keys.add(key)
+                    REGISTRY.cluster_queue_resource_reservation.set(
+                        *key, value=float(used))
+        # Exact-set prune: a live CQ that moved cohorts or dropped a
+        # flavor must not keep exporting the old series
+        # (ClearClusterQueueResourceMetrics semantics).
+        REGISTRY.cluster_queue_borrowing_limit.prune(
+            lambda key: key in quota_keys)
+        REGISTRY.cluster_queue_lending_limit.prune(
+            lambda key: key in quota_keys)
+        REGISTRY.cluster_queue_resource_reservation.prune(
+            lambda key: key in usage_keys)
 
     # -- reconcile pass ------------------------------------------------------
 

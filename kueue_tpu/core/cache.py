@@ -91,6 +91,18 @@ class Cohort:
             node = node.parent
         return node
 
+    def invalidate_memos(self) -> None:
+        """Reset the lazy walk memos. Cache-side Cohort objects mutate in
+        place on membership/spec updates (snapshot-side clones are
+        rebuilt wholesale instead), so every cache-side mutation path
+        must call this or later readers would see stale roots/caps."""
+        self._root_name = None
+        self._is_hier = None
+        self._tree_cap = None
+        root = self.root()
+        if root is not self:
+            root._tree_cap = None
+
     @property
     def root_name(self) -> str:
         rn = self._root_name
@@ -567,12 +579,14 @@ class Cache:
                 self._lq_note(wi, -1)
             if cq.cohort is not None:
                 cq.cohort.members.discard(cq)
+                cq.cohort.invalidate_memos()
                 if not cq.cohort.members:
                     self.cohorts.pop(cq.cohort.name, None)
 
     def _update_cohort_membership(self, cq: CachedClusterQueue) -> None:
         if cq.cohort is not None and cq.cohort.name != cq.cohort_name:
             cq.cohort.members.discard(cq)
+            cq.cohort.invalidate_memos()
             if not cq.cohort.members:
                 self.cohorts.pop(cq.cohort.name, None)
             cq.cohort = None
@@ -582,6 +596,7 @@ class Cache:
                 cohort = Cohort(cq.cohort_name)
                 self.cohorts[cq.cohort_name] = cohort
             cohort.members.add(cq)
+            cohort.invalidate_memos()
             cq.cohort = cohort
 
     # -- local queues --------------------------------------------------------

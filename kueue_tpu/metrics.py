@@ -184,6 +184,21 @@ class Registry:
         self.cluster_queue_fair_share = Gauge(
             p + "cluster_queue_fair_sharing_weighted_share",
             "Fair-sharing share value", ("cluster_queue",))
+        # Optional per-CQ quota gauges (metrics.go:137-177), reported only
+        # with metrics.enableClusterQueueResources — reference label order
+        # (cohort first).
+        self.cluster_queue_resource_reservation = Gauge(
+            p + "cluster_queue_resource_reservation",
+            "Total resource reservation per CQ and flavor",
+            ("cohort", "cluster_queue", "flavor", "resource"))
+        self.cluster_queue_borrowing_limit = Gauge(
+            p + "cluster_queue_borrowing_limit",
+            "Resource borrowing limit per CQ and flavor",
+            ("cohort", "cluster_queue", "flavor", "resource"))
+        self.cluster_queue_lending_limit = Gauge(
+            p + "cluster_queue_lending_limit",
+            "Resource lending limit per CQ and flavor",
+            ("cohort", "cluster_queue", "flavor", "resource"))
         # TPU-build additions: per-tick phase timings.
         self.tick_phase_seconds = Histogram(
             p + "tick_phase_seconds",

@@ -157,6 +157,10 @@ class _Handler(BaseHTTPRequestHandler):
             if path in ("/healthz", "/readyz"):
                 self._send_text("ok")
             elif path == "/metrics":
+                # Export only — gauges are refreshed by the serve loop on
+                # a throttle (__main__), never under a scrape: a scrape
+                # racing a tick must not stall the scheduler for the
+                # O(workloads) gauge walk.
                 self._send_text(REGISTRY.export_text(),
                                 content_type="text/plain; version=0.0.4")
             elif path.startswith(VISIBILITY_PREFIX):

@@ -10,6 +10,7 @@ boundary (store-backed or gRPC deployments).
 
 from __future__ import annotations
 
+import threading
 from concurrent.futures import ThreadPoolExecutor
 from typing import Callable, Optional, Sequence, TypeVar
 
@@ -49,6 +50,16 @@ def until(n: int, fn: Callable[[int], None],
         return None
     first: list = [None]
     if workers == DEFAULT_WORKERS:
+        if threading.current_thread().name.startswith("kueue-par"):
+            # Nested fan-out from inside the shared pool would deadlock
+            # (outer tasks waiting on futures that can only run on the
+            # same saturated pool) — run inline instead.
+            try:
+                for i in range(n):
+                    fn(i)
+            except BaseException as exc:  # noqa: BLE001
+                return exc
+            return None
         pool = _shared_pool()
         futures = [pool.submit(fn, i) for i in range(n)]
         for f in futures:

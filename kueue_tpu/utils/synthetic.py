@@ -37,6 +37,7 @@ def synthetic_objects(
     pending_priority: Tuple[int, int] = (-2, 2),
     preemption_heavy: bool = False,
     fair_hierarchy: bool = False,
+    lending: bool = False,
 ):
     """Generate the raw API objects of a north-star-scale cluster:
     (flavors, cluster_queues, local_queues, admitted workloads with their
@@ -74,14 +75,29 @@ def synthetic_objects(
     for c in range(num_cqs):
         n_flavors = rnd.randint(2, min(4, num_flavors))
         chosen = rnd.sample(range(num_flavors), n_flavors)
-        fqs = tuple(
-            FlavorQuotas.make(
-                f"flavor-{fi}",
-                cpu=rnd.randint(16, 128),
-                memory=f"{rnd.randint(64, 512)}Gi",
+        if lending:
+            # BASELINE config #2 quotas: borrowing allowed, lending
+            # clamped below nominal (clusterqueue.go:583-629 semantics).
+            def _q(nom, unit=1):
+                return (nom * unit, (nom // 2) * unit,
+                        max(1, (3 * nom) // 4) * unit)
+            fqs = tuple(
+                FlavorQuotas.make(
+                    f"flavor-{fi}",
+                    cpu=_q(rnd.randint(16, 128)),
+                    memory=_q(rnd.randint(64, 512), unit=1024 ** 3),
+                )
+                for fi in chosen
             )
-            for fi in chosen
-        )
+        else:
+            fqs = tuple(
+                FlavorQuotas.make(
+                    f"flavor-{fi}",
+                    cpu=rnd.randint(16, 128),
+                    memory=f"{rnd.randint(64, 512)}Gi",
+                )
+                for fi in chosen
+            )
         preemption = ClusterQueuePreemption(
             within_cluster_queue="LowerPriority",
             reclaim_within_cohort="Any")
@@ -98,7 +114,8 @@ def synthetic_objects(
         cqs.append(ClusterQueue(
             name=f"cq-{c}",
             resource_groups=(ResourceGroup(("cpu", "memory"), fqs),),
-            cohort=f"cohort-{c % num_cohorts}",
+            cohort=f"cohort-{c % num_cohorts}" if num_cohorts > 0
+            else None,
             preemption=preemption,
             fair_sharing=fair,
         ))
@@ -206,6 +223,7 @@ def synthetic_framework(
     pending_priority: Tuple[int, int] = (-2, 2),
     preemption_heavy: bool = False,
     fair_hierarchy: bool = False,
+    lending: bool = False,
     **framework_kwargs,
 ):
     """Build a full Framework loaded with the synthetic cluster — the
@@ -217,7 +235,7 @@ def synthetic_framework(
         num_cqs=num_cqs, num_cohorts=num_cohorts, num_flavors=num_flavors,
         num_pending=num_pending, usage_fill=usage_fill, seed=seed,
         pending_priority=pending_priority, preemption_heavy=preemption_heavy,
-        fair_hierarchy=fair_hierarchy)
+        fair_hierarchy=fair_hierarchy, lending=lending)
     fw = Framework(batch_solver=batch_solver, **framework_kwargs)
     for rf in flavors:
         fw.create_resource_flavor(rf)

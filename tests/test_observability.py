@@ -261,3 +261,42 @@ def test_tick_phase_histogram_observed():
     assert {"snapshot", "nominate", "admit", "requeue",
             "tensorize", "device_solve", "decode"} <= phases
     assert "kueue_tick_phase_seconds" in REGISTRY.export_text()
+
+
+def test_optional_quota_gauges():
+    """metrics.enableClusterQueueResources gates the three per-CQ quota
+    gauges (reference metrics.go:137-177): borrowing/lending limits from
+    the spec, reservation from reserved usage, reference label order
+    (cohort, cq, flavor, resource); lending only under the feature gate."""
+    from kueue_tpu import features
+    from kueue_tpu.config import Configuration, MetricsConfig
+
+    fw = Framework(config=Configuration(
+        metrics=MetricsConfig(enable_cluster_queue_resources=True)))
+    fw.create_resource_flavor(make_flavor("default"))
+    fw.create_cluster_queue(make_cq(
+        "cq", rg("cpu", fq("default", cpu=(4, 2, 3))), cohort="co"))
+    fw.create_local_queue(make_lq("main", cq="cq"))
+    fw.submit(make_wl("w0", cpu=1))
+    fw.run_until_settled()
+    fw.update_metrics_gauges()
+    assert REGISTRY.cluster_queue_borrowing_limit.get(
+        "co", "cq", "default", "cpu") == 2000
+    assert REGISTRY.cluster_queue_resource_reservation.get(
+        "co", "cq", "default", "cpu") == 1000
+    if features.enabled(features.LENDING_LIMIT):
+        assert REGISTRY.cluster_queue_lending_limit.get(
+            "co", "cq", "default", "cpu") == 3000
+    # Gauges prune when the ClusterQueue goes away.
+    fw.delete_cluster_queue("cq")
+    fw.update_metrics_gauges()
+    assert REGISTRY.cluster_queue_borrowing_limit.get(
+        "co", "cq", "default", "cpu") in (None, 0)
+
+
+def test_quota_gauges_absent_without_knob():
+    fw = small_framework()
+    fw.submit(make_wl("wq", cpu=1))
+    fw.run_until_settled()
+    fw.update_metrics_gauges()
+    assert not REGISTRY.cluster_queue_resource_reservation.values
