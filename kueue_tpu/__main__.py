@@ -137,15 +137,24 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                    pipeline_depth=args.pipeline_depth)
     store = Store()
     restored = 0
+    # With leader election, the journal attach (an exclusive flock) is
+    # DEFERRED until this replica actually leads: replicas share ONE
+    # state dir (the etcd analog) and the standby replays the leader's
+    # journal at takeover, exactly like the reference rebuilding its
+    # caches from the apiserver on becoming leader (cache.go:295-328).
+    pending_journal = [None]
     if args.state_dir:
-        # Durable journal: replay BEFORE the controllers attach so their
-        # initial watch replay rebuilds the runtime (admitted workloads
-        # keep quota, pending ones re-queue).
         from kueue_tpu.controllers.durable import Journal
 
         os.makedirs(args.state_dir, exist_ok=True)
         journal = Journal(os.path.join(args.state_dir, "journal.jsonl"))
-        restored = journal.attach(store)
+        if args.leader_elect or cfg.leader_election.enable:
+            pending_journal[0] = journal
+        else:
+            # No election: replay BEFORE the controllers attach so their
+            # initial watch replay rebuilds the runtime (admitted
+            # workloads keep quota, pending ones re-queue).
+            restored = journal.attach(store)
     adapter = StoreAdapter(store, fw)
     if restored and args.verbosity >= 0:
         print(f"restored {restored} objects from the state journal",

@@ -21,6 +21,9 @@ from kueue_tpu.api.types import (
 )
 
 
+PODS_RESOURCE = "pods"
+
+
 class ValidationError(ValueError):
     """Raised by the runtime when a webhook rejects an object."""
 
@@ -33,6 +36,8 @@ _DNS1123_LABEL = re.compile(r"^[a-z0-9]([-a-z0-9]*[a-z0-9])?$")
 _QUALIFIED_NAME = re.compile(
     r"^([a-z0-9A-Z]([-a-z0-9A-Z_.]*[a-z0-9A-Z])?/)?"
     r"[a-z0-9A-Z]([-a-z0-9A-Z_.]*[a-z0-9A-Z])?$")
+
+_LABEL_VALUE = re.compile(r"^[a-z0-9A-Z]([-a-z0-9A-Z_.]*[a-z0-9A-Z])?$")
 
 _TAINT_EFFECTS = ("NoSchedule", "PreferNoSchedule", "NoExecute")
 _PREEMPTION_POLICIES = (
@@ -67,8 +72,33 @@ def validate_cluster_queue(cq: ClusterQueue) -> List[str]:
     if cq.queueing_strategy not in (
             QueueingStrategy.STRICT_FIFO, QueueingStrategy.BEST_EFFORT_FIFO):
         errs.append(f"spec.queueingStrategy: unknown {cq.queueing_strategy!r}")
+    errs += _validate_namespace_selector(cq)
     errs += _validate_resource_groups(cq)
     errs += _validate_preemption(cq)
+    return errs
+
+
+def _validate_namespace_selector(cq: ClusterQueue) -> List[str]:
+    """metav1.LabelSelector validation (clusterqueue_webhook.go validates
+    spec.namespaceSelector through apimachinery's selector rules): label
+    keys must be qualified names, values label-values, and In/NotIn
+    expressions need at least one value."""
+    errs: List[str] = []
+    sel = cq.namespace_selector
+    for k, v in sel.match_labels:
+        if not _QUALIFIED_NAME.match(k):
+            errs.append(
+                f"spec.namespaceSelector.matchLabels: invalid key {k!r}")
+        if v and not _LABEL_VALUE.match(v):
+            errs.append(
+                f"spec.namespaceSelector.matchLabels: invalid value {v!r}")
+    for i, e in enumerate(sel.match_expressions):
+        path = f"spec.namespaceSelector.matchExpressions[{i}]"
+        if e.key != "__none__" and not _QUALIFIED_NAME.match(e.key):
+            errs.append(f"{path}.key: invalid key {e.key!r}")
+        if e.operator in ("In", "NotIn") and not e.values:
+            errs.append(f"{path}.values: must be specified when operator is "
+                        f"{e.operator}")
     return errs
 
 
@@ -211,6 +241,12 @@ def validate_workload(wl: Workload) -> List[str]:
             variable_count += 1
             if not 0 < ps.min_count <= ps.count:
                 errs.append(f"{path}.minCount: must be in [1, count]")
+        if PODS_RESOURCE in ps.requests:
+            # The pods resource is implicit (one per pod); requesting it
+            # explicitly is rejected (workload_webhook.go container
+            # requests rule).
+            errs.append(f"{path}.requests: must not contain the "
+                        f"{PODS_RESOURCE!r} resource")
     if variable_count > 1:
         errs.append("spec.podSets: at most one podSet can use minCount")
     if wl.priority_class:
@@ -218,13 +254,57 @@ def validate_workload(wl: Workload) -> List[str]:
     if wl.queue_name:
         errs += _name_reference(wl.queue_name, "spec.queueName")
     errs += _validate_reclaimable(wl)
+    errs += _validate_pod_set_updates(wl)
     if wl.has_quota_reservation and wl.admission is None:
         errs.append("status.admission: must be set when QuotaReserved")
     if wl.admission is not None:
+        errs += _name_reference(wl.admission.cluster_queue,
+                                "status.admission.clusterQueue")
         psa_names = [a.name for a in wl.admission.pod_set_assignments]
         if sorted(psa_names) != sorted(ps.name for ps in wl.pod_sets):
             errs.append("status.admission.podSetAssignments: must have "
                         "assignments for all podsets")
+        for ai, psa in enumerate(wl.admission.pod_set_assignments):
+            for rname, v in psa.resource_usage.items():
+                # Per-pod value must be integral (workload_webhook.go
+                # resourceUsage divisibility by the assigned count).
+                if psa.count and v % psa.count:
+                    errs.append(
+                        f"status.admission.podSetAssignments[{ai}]"
+                        f".resourceUsage[{rname}]: {v} is not divisible by "
+                        f"the assigned count {psa.count}")
+    return errs
+
+
+def _validate_pod_set_updates(wl: Workload) -> List[str]:
+    """AdmissionCheckState.podSetUpdates rules (workload_webhook.go
+    validateAdmissionChecks): empty is fine; otherwise one update per
+    podSet, names drawn from the podSets, and label/annotation/
+    nodeSelector maps carrying valid keys and values."""
+    errs: List[str] = []
+    ps_names = {ps.name for ps in wl.pod_sets}
+    for check_name, state in sorted(wl.admission_check_states.items()):
+        updates = state.pod_set_updates
+        if not updates:
+            continue
+        base = f"status.admissionChecks[{check_name}].podSetUpdates"
+        if len(updates) != len(wl.pod_sets):
+            errs.append(f"{base}: must have the same number of podSetUpdates "
+                        "as the podSets")
+        for ui, upd in enumerate(updates):
+            upath = f"{base}[{ui}]"
+            name = upd.get("name", "")
+            if name not in ps_names:
+                errs.append(f"{upath}.name: no podSet named {name!r}")
+            for fld in ("labels", "nodeSelector"):
+                for k, v in (upd.get(fld) or {}).items():
+                    if not _QUALIFIED_NAME.match(k):
+                        errs.append(f"{upath}.{fld}: invalid key {k!r}")
+                    elif fld == "labels" and v and not _LABEL_VALUE.match(v):
+                        errs.append(f"{upath}.{fld}: invalid value {v!r}")
+            for k in (upd.get("annotations") or {}):
+                if not _QUALIFIED_NAME.match(k):
+                    errs.append(f"{upath}.annotations: invalid key {k!r}")
     return errs
 
 
@@ -251,6 +331,20 @@ def validate_workload_update(new: Workload, old: Workload) -> List[str]:
         if new.priority_class != old.priority_class:
             errs.append("spec.priorityClassName: field is immutable after "
                         "quota reservation")
+        if new.priority_class_source != old.priority_class_source:
+            errs.append("spec.priorityClassSource: field is immutable after "
+                        "quota reservation")
+    # podSetUpdates freeze once their check reports Ready
+    # (workload_webhook.go validateAdmissionChecksUpdate).
+    for check_name, old_state in old.admission_check_states.items():
+        if old_state.state != "Ready":
+            continue
+        new_state = new.admission_check_states.get(check_name)
+        if new_state is not None \
+                and new_state.pod_set_updates != old_state.pod_set_updates:
+            errs.append(f"status.admissionChecks[{check_name}]"
+                        ".podSetUpdates: field is immutable once the check "
+                        "is Ready")
     if new.has_quota_reservation and old.has_quota_reservation:
         if new.queue_name != old.queue_name:
             errs.append("spec.queueName: field is immutable while quota is "
