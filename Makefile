@@ -1,4 +1,11 @@
 # Developer entry points (reference: Makefile test/test-integration/bench).
+#
+#   make test        run the full pytest suite
+#   make lint        kueuelint static analysis (jit purity, lock discipline,
+#                    retrace hygiene, API hygiene) + ruff when installed
+#   make bench       full-scale benchmark; bench-smoke for CI shapes
+#   make native      build the C++ runtime pieces
+#   make dryrun      compile-check the flagship jit path
 
 PYTHON ?= python
 
@@ -25,6 +32,17 @@ native:
 	$(PYTHON) -c "from kueue_tpu.utils import native_heap, native_decode; \
 	  print('heap:', native_heap.native_available(), \
 	        'decode:', native_decode.decode_available())"
+
+# Codebase-specific static analysis (kueue_tpu/analysis): fails on any
+# error-severity finding, same gate as tests/test_kueuelint.py and CI.
+# Runs ruff too when it is installed (dev extra), but does not require it.
+lint:
+	$(PYTHON) -m kueue_tpu.analysis kueue_tpu/
+	@if $(PYTHON) -c "import ruff" 2>/dev/null; then \
+	  $(PYTHON) -m ruff check kueue_tpu/; \
+	else \
+	  echo "ruff not installed; skipped (pip install -e .[dev])"; \
+	fi
 
 install:
 	$(PYTHON) -m pip install -e .

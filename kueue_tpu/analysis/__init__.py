@@ -1,0 +1,24 @@
+"""kueuelint — codebase-specific static analysis for kueue-tpu.
+
+Rule families (see `python -m kueue_tpu.analysis --list-rules`):
+
+  JIT01-03  jit purity: host syncs, traced control flow, closure mutation
+  RET01-02  retrace hygiene: static-arg hazards, closure captures
+  LOCK01-02 lock discipline: blocking under a lock, inconsistent guarding
+  API01-03  API hygiene: mutable defaults, freezable dataclasses,
+            serialization roundtrip coverage
+
+Suppress a finding on its line with `# kueuelint: disable=RULE` (several:
+`disable=RULE1,RULE2`; everything: bare `disable`); suppress a whole file
+with `# kueuelint: skip-file`.
+"""
+
+from kueue_tpu.analysis.core import (  # noqa: F401
+    Finding, Rule, Severity, all_rules, run_analysis)
+# Rule modules register themselves into the registry on import.
+from kueue_tpu.analysis import api_rules, jit_rules, lock_rules  # noqa: F401
+from kueue_tpu.analysis.reporters import (  # noqa: F401
+    render_json, render_text)
+
+__all__ = ["Finding", "Rule", "Severity", "all_rules", "run_analysis",
+           "render_json", "render_text"]

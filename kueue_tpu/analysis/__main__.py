@@ -1,0 +1,81 @@
+"""kueuelint CLI: `python -m kueue_tpu.analysis [paths...]`.
+
+Exit codes: 0 clean (no findings at/above --fail-on), 1 findings, 2 usage
+error. Pure-AST — never imports the code under analysis, needs no jax.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+from typing import Optional, Sequence
+
+from kueue_tpu.analysis.core import Severity, run_analysis
+from kueue_tpu.analysis.reporters import (render_json, render_rule_list,
+                                          render_text)
+
+
+def _default_paths() -> list:
+    # Analyze the installed package when invoked bare.
+    return [str(Path(__file__).resolve().parent.parent)]
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="kueuelint",
+        description="Codebase-specific static analysis for kueue-tpu: "
+                    "jit purity, retrace hygiene, lock discipline, API "
+                    "hygiene.")
+    parser.add_argument("paths", nargs="*",
+                        help="files or directories to analyze "
+                             "(default: the kueue_tpu package)")
+    parser.add_argument("--format", "-f", choices=("text", "json"),
+                        default="text")
+    parser.add_argument("--fail-on", choices=("error", "warning"),
+                        default="error",
+                        help="lowest severity that makes the exit code "
+                             "non-zero (default: error)")
+    parser.add_argument("--select", action="append", default=None,
+                        metavar="RULE", help="run only these rule ids")
+    parser.add_argument("--disable", action="append", default=None,
+                        metavar="RULE", help="skip these rule ids")
+    parser.add_argument("--list-rules", action="store_true",
+                        help="print the rule registry and exit")
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        print(render_rule_list())
+        return 0
+
+    # A typo'd --select would otherwise filter the registry to nothing and
+    # report a clean run — fail fast on unknown ids instead.
+    from kueue_tpu.analysis.core import all_rules
+    known = {r.id for r in all_rules()}
+    for opt, ids in (("--select", args.select), ("--disable", args.disable)):
+        unknown = sorted(set(ids or ()) - known)
+        if unknown:
+            print(f"kueuelint: unknown rule id(s) for {opt}: "
+                  f"{', '.join(unknown)} (see --list-rules)",
+                  file=sys.stderr)
+            return 2
+
+    paths = args.paths or _default_paths()
+    for p in paths:
+        if not Path(p).exists():
+            print(f"kueuelint: path does not exist: {p}", file=sys.stderr)
+            return 2
+
+    findings = run_analysis(paths, select=args.select, disable=args.disable)
+    if args.format == "json":
+        print(render_json(findings))
+    else:
+        print(render_text(findings))
+
+    threshold = Severity.ERROR if args.fail_on == "error" else Severity.WARNING
+    gating = [f for f in findings if f.severity >= threshold]
+    return 1 if gating else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

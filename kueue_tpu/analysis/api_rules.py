@@ -1,0 +1,251 @@
+"""API-hygiene rules (API01-03).
+
+API01 — mutable default arguments anywhere in the package: the shared
+list/dict/set outlives the call and aliases across callers; in a scheduler
+that reuses Workload/PodSet objects across ticks this shows up as quota
+leaking between unrelated workloads.
+
+API02 — non-frozen dataclasses in `api/types.py` whose fields are all
+immutable-typed: spec objects are hashed into snapshot/solver memo keys and
+shared across threads, so anything that *can* be frozen should be. Status
+objects that are mutated in place (Workload, Condition, ...) either carry
+mutable-typed fields (excluded automatically) or an explicit
+`# kueuelint: disable=API02` stating why.
+
+API03 — serialization roundtrip coverage: for every dataclass from a
+`types.py` that the sibling `serialization.py` constructs, each field must
+appear somewhere in the serialization module (constructor kwarg, attribute
+read on the encode side, or a snake/camelCase key string). A field that
+never appears is silently dropped by encode/decode and corrupts MultiKueue
+mirrors and the durable store on the next roundtrip.
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import PurePosixPath
+from typing import Dict, List, Optional, Set
+
+from kueue_tpu.analysis.core import (
+    AnalysisContext, Rule, Severity, SourceFile, dotted_name, finding,
+    register)
+
+_IMMUTABLE_NAMES = {"str", "int", "float", "bool", "bytes", "complex",
+                    "None", "Tuple", "tuple", "FrozenSet", "frozenset",
+                    "Optional", "Union", "Literal", "IntEnum", "Enum"}
+
+
+def _mutable_default(node: ast.AST) -> bool:
+    if isinstance(node, (ast.List, ast.Dict, ast.Set)):
+        return True
+    if isinstance(node, ast.Call):
+        return dotted_name(node.func) in ("list", "dict", "set", "bytearray")
+    return False
+
+
+def _check_api01(f: SourceFile, ctx: AnalysisContext):
+    for node in ast.walk(f.tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+            continue
+        args = node.args
+        for default in list(args.defaults) + list(args.kw_defaults):
+            if default is not None and _mutable_default(default):
+                yield finding(
+                    API01, f, default,
+                    "mutable default argument is shared across every call; "
+                    "use None (or a dataclass field default_factory) and "
+                    "construct inside the function")
+
+
+# ---------------------------------------------------------------------------
+# API02 — freezable dataclasses left mutable
+# ---------------------------------------------------------------------------
+
+
+def _dataclass_decorator(cls: ast.ClassDef) -> Optional[ast.AST]:
+    for dec in cls.decorator_list:
+        name = dotted_name(dec)
+        if name in ("dataclass", "dataclasses.dataclass"):
+            return dec
+        if isinstance(dec, ast.Call) and dotted_name(dec.func) in (
+                "dataclass", "dataclasses.dataclass"):
+            return dec
+    return None
+
+
+def _is_frozen(dec: ast.AST) -> bool:
+    if isinstance(dec, ast.Call):
+        for kw in dec.keywords:
+            if kw.arg == "frozen" and isinstance(kw.value, ast.Constant):
+                return bool(kw.value.value)
+    return False
+
+
+def _anno_immutable(anno: ast.AST, frozen_classes: Set[str]) -> bool:
+    if isinstance(anno, ast.Constant):
+        # string annotation — only trust obvious scalar names
+        return str(anno.value) in _IMMUTABLE_NAMES | frozen_classes
+    name = dotted_name(anno)
+    if name is not None:
+        leaf = name.rsplit(".", 1)[-1]
+        return leaf in _IMMUTABLE_NAMES or leaf in frozen_classes
+    if isinstance(anno, ast.Subscript):
+        head = dotted_name(anno.value)
+        leaf = head.rsplit(".", 1)[-1] if head else ""
+        if leaf not in ("Tuple", "tuple", "Optional", "Union", "Literal",
+                        "FrozenSet", "frozenset"):
+            return False
+        inner = anno.slice
+        elts = inner.elts if isinstance(inner, ast.Tuple) else [inner]
+        return all(
+            (isinstance(e, ast.Constant) and e.value in (None, Ellipsis))
+            or _anno_immutable(e, frozen_classes)
+            for e in elts)
+    return False
+
+
+def _check_api02(f: SourceFile, ctx: AnalysisContext):
+    frozen_classes: Set[str] = set()
+    for node in ast.walk(f.tree):
+        if isinstance(node, ast.ClassDef):
+            dec = _dataclass_decorator(node)
+            if dec is not None and _is_frozen(dec):
+                frozen_classes.add(node.name)
+    for node in ast.walk(f.tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        dec = _dataclass_decorator(node)
+        if dec is None or _is_frozen(dec):
+            continue
+        annos = [s.annotation for s in node.body
+                 if isinstance(s, ast.AnnAssign) and s.annotation is not None]
+        if not annos:
+            continue
+        if all(_anno_immutable(a, frozen_classes) for a in annos):
+            yield finding(
+                API02, f, node,
+                f"dataclass `{node.name}` has only immutable-typed fields "
+                "but is not frozen=True; spec objects are shared across "
+                "threads and used in memo keys — freeze it (or suppress "
+                "with a comment stating why in-place mutation is needed)")
+
+
+# ---------------------------------------------------------------------------
+# API03 — serialization roundtrip coverage
+# ---------------------------------------------------------------------------
+
+
+def _snake_to_camel(name: str) -> str:
+    head, *rest = name.split("_")
+    return head + "".join(w.capitalize() for w in rest)
+
+
+def _dataclass_fields(cls: ast.ClassDef) -> List[str]:
+    out = []
+    for stmt in cls.body:
+        if isinstance(stmt, ast.AnnAssign) and isinstance(stmt.target,
+                                                          ast.Name):
+            name = stmt.target.id
+            if name.startswith("_"):
+                continue
+            anno = dotted_name(stmt.annotation)
+            if anno and anno.rsplit(".", 1)[-1] == "ClassVar":
+                continue
+            if isinstance(stmt.annotation, ast.Subscript):
+                head = dotted_name(stmt.annotation.value)
+                if head and head.rsplit(".", 1)[-1] == "ClassVar":
+                    continue
+            out.append(name)
+    return out
+
+
+def _check_api03(ctx: AnalysisContext):
+    # Pair every serialization.py with a types.py in the same directory.
+    for ser in ctx.files:
+        p = PurePosixPath(ser.display_path)
+        if "serialization" not in p.name or ser.tree is None:
+            continue
+        types_path = str(p.parent / "types.py")
+        types_file = ctx.by_path.get(types_path)
+        if types_file is None or types_file.tree is None:
+            continue
+
+        classes: Dict[str, ast.ClassDef] = {}
+        for node in ast.walk(types_file.tree):
+            if isinstance(node, ast.ClassDef) \
+                    and _dataclass_decorator(node) is not None:
+                classes[node.name] = node
+
+        # Evidence of a field being carried through serialization:
+        kwargs_by_class: Dict[str, Set[str]] = {}
+        pos_arity: Dict[str, int] = {}
+        strings: Set[str] = set()
+        attr_reads: Set[str] = set()
+        constructed: List[ast.Call] = []
+        for node in ast.walk(ser.tree):
+            if isinstance(node, ast.Constant) and isinstance(node.value, str):
+                strings.add(node.value)
+            elif isinstance(node, ast.Attribute) \
+                    and isinstance(node.ctx, ast.Load):
+                attr_reads.add(node.attr)
+            elif isinstance(node, ast.Call):
+                name = dotted_name(node.func)
+                leaf = name.rsplit(".", 1)[-1] if name else None
+                if leaf in classes:
+                    constructed.append(node)
+                    kws = kwargs_by_class.setdefault(leaf, set())
+                    for kw in node.keywords:
+                        if kw.arg is not None:
+                            kws.add(kw.arg)
+                        else:
+                            # **kwargs splat: assume full coverage
+                            kws.add("*")
+                    pos_arity[leaf] = max(pos_arity.get(leaf, 0),
+                                          len(node.args))
+
+        for cls_name, kws in sorted(kwargs_by_class.items()):
+            if "*" in kws:
+                continue
+            cls = classes[cls_name]
+            fields = _dataclass_fields(cls)
+            for i, field_name in enumerate(fields):
+                if field_name in kws:
+                    continue
+                if i < pos_arity.get(cls_name, 0):
+                    continue
+                if field_name in strings \
+                        or _snake_to_camel(field_name) in strings:
+                    continue
+                if field_name in attr_reads:
+                    continue
+                yield finding(
+                    API03, ser, _first_ctor(constructed, cls_name),
+                    f"field `{cls_name}.{field_name}` never appears in "
+                    f"{p.name} (no kwarg, key string, or attribute read) — "
+                    "an encode/decode roundtrip silently drops it")
+
+
+def _first_ctor(calls: List[ast.Call], cls_name: str) -> ast.AST:
+    for c in calls:
+        name = dotted_name(c.func)
+        if name and name.rsplit(".", 1)[-1] == cls_name:
+            return c
+    return calls[0]
+
+
+API01 = register(Rule(
+    id="API01", severity=Severity.ERROR,
+    summary="mutable default argument",
+    check=_check_api01))
+
+API02 = register(Rule(
+    id="API02", severity=Severity.ERROR,
+    summary="freezable dataclass in api/types.py left non-frozen",
+    check=_check_api02,
+    path_fragments=("api/types.py", "fixtures/lint/")))
+
+API03 = register(Rule(
+    id="API03", severity=Severity.ERROR,
+    summary="dataclass field missing from the serialization roundtrip",
+    check=_check_api03, project=True))

@@ -1,0 +1,239 @@
+"""kueuelint core: findings, rule registry, suppressions, analysis driver.
+
+The analyzer is pure-AST (never imports the code under analysis), so it runs
+in milliseconds, without jax, and is safe on broken trees: a file that does
+not parse is itself reported as a finding (PARSE) instead of aborting.
+
+Rule IDs are stable strings (JIT01, LOCK01, ...) so that per-line
+suppressions (`# kueuelint: disable=RULE[,RULE...]`) and CI configs never
+break when messages are reworded.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import enum
+import re
+from pathlib import Path
+from typing import Callable, Dict, Iterable, List, Optional, Sequence, Tuple
+
+
+class Severity(enum.IntEnum):
+    """Ordered so that max() picks the gating severity."""
+
+    WARNING = 1
+    ERROR = 2
+
+    @property
+    def label(self) -> str:
+        return self.name.lower()
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    rule: str
+    severity: Severity
+    path: str
+    line: int
+    col: int
+    message: str
+
+    def to_dict(self) -> dict:
+        return {
+            "rule": self.rule,
+            "severity": self.severity.label,
+            "path": self.path,
+            "line": self.line,
+            "col": self.col,
+            "message": self.message,
+        }
+
+    def render(self) -> str:
+        return (f"{self.path}:{self.line}:{self.col}: "
+                f"{self.rule} [{self.severity.label}] {self.message}")
+
+
+# `# kueuelint: disable=JIT01` or `# kueuelint: disable=JIT01,LOCK02` on the
+# finding line suppresses those rules there; bare `disable` suppresses every
+# rule on the line. `# kueuelint: skip-file` anywhere suppresses the file.
+_DISABLE_RE = re.compile(
+    r"#\s*kueuelint:\s*disable(?:=(?P<rules>[A-Za-z0-9_,\s]+))?")
+_SKIP_FILE_RE = re.compile(r"#\s*kueuelint:\s*skip-file")
+
+_ALL = "__all__"
+
+
+class SourceFile:
+    """One parsed module plus its suppression map."""
+
+    def __init__(self, path: Path, text: str, display_path: str):
+        self.path = path
+        self.display_path = display_path
+        self.text = text
+        self.lines = text.splitlines()
+        self.tree: Optional[ast.Module] = None
+        self.parse_error: Optional[SyntaxError] = None
+        try:
+            self.tree = ast.parse(text, filename=str(path))
+        except SyntaxError as exc:
+            self.parse_error = exc
+        self.skip_file = bool(_SKIP_FILE_RE.search(text))
+        # line number -> set of suppressed rule ids (or _ALL)
+        self.suppressions: Dict[int, set] = {}
+        for i, line in enumerate(self.lines, start=1):
+            m = _DISABLE_RE.search(line)
+            if not m:
+                continue
+            rules = m.group("rules")
+            if rules is None:
+                self.suppressions.setdefault(i, set()).add(_ALL)
+            else:
+                for r in rules.replace(",", " ").split():
+                    self.suppressions.setdefault(i, set()).add(r.strip())
+
+    def suppressed(self, rule: str, line: int) -> bool:
+        if self.skip_file:
+            return True
+        s = self.suppressions.get(line)
+        return bool(s) and (_ALL in s or rule in s)
+
+
+class AnalysisContext:
+    """Everything a rule may look at: the full set of analyzed files."""
+
+    def __init__(self, files: Sequence[SourceFile]):
+        self.files = list(files)
+        self.by_path: Dict[str, SourceFile] = {
+            f.display_path: f for f in files}
+
+
+@dataclasses.dataclass(frozen=True)
+class Rule:
+    """A registered check.
+
+    `path_fragments` limits where the rule applies: a file participates when
+    any fragment occurs in its posix path. None means every file. Project
+    rules (`project=True`) receive the whole context once instead of being
+    called per file.
+    """
+
+    id: str
+    severity: Severity
+    summary: str
+    check: Callable[..., Iterable[Finding]]
+    path_fragments: Optional[Tuple[str, ...]] = None
+    project: bool = False
+
+    def applies_to(self, f: SourceFile) -> bool:
+        if self.path_fragments is None:
+            return True
+        posix = f.path.as_posix()
+        return any(frag in posix for frag in self.path_fragments)
+
+
+_REGISTRY: Dict[str, Rule] = {}
+
+
+def register(rule: Rule) -> Rule:
+    if rule.id in _REGISTRY:
+        raise ValueError(f"duplicate rule id {rule.id}")
+    _REGISTRY[rule.id] = rule
+    return rule
+
+
+def all_rules() -> List[Rule]:
+    return sorted(_REGISTRY.values(), key=lambda r: r.id)
+
+
+def collect_files(paths: Sequence[str]) -> List[SourceFile]:
+    out: List[SourceFile] = []
+    seen = set()
+    for raw in paths:
+        p = Path(raw)
+        candidates = sorted(p.rglob("*.py")) if p.is_dir() else [p]
+        for c in candidates:
+            key = c.resolve()
+            if key in seen:
+                continue
+            seen.add(key)
+            try:
+                text = c.read_text(encoding="utf-8")
+            except (OSError, UnicodeDecodeError):
+                continue
+            out.append(SourceFile(c, text, c.as_posix()))
+    return out
+
+
+def run_analysis(paths: Sequence[str],
+                 select: Optional[Sequence[str]] = None,
+                 disable: Optional[Sequence[str]] = None) -> List[Finding]:
+    """Analyze `paths` (files or directories) and return active findings,
+    with per-line suppressions already applied."""
+    # Rule modules register on import; pulled in here to avoid import cycles.
+    from kueue_tpu.analysis import api_rules, jit_rules, lock_rules  # noqa: F401
+
+    files = collect_files(paths)
+    ctx = AnalysisContext(files)
+    rules = all_rules()
+    if select:
+        wanted = set(select)
+        rules = [r for r in rules if r.id in wanted]
+    if disable:
+        dropped = set(disable)
+        rules = [r for r in rules if r.id not in dropped]
+
+    findings: List[Finding] = []
+    for f in files:
+        if f.parse_error is not None:
+            findings.append(Finding(
+                rule="PARSE", severity=Severity.ERROR,
+                path=f.display_path,
+                line=f.parse_error.lineno or 1,
+                col=f.parse_error.offset or 0,
+                message=f"syntax error: {f.parse_error.msg}"))
+    for rule in rules:
+        if rule.project:
+            findings.extend(rule.check(ctx))
+            continue
+        for f in files:
+            if f.tree is None or not rule.applies_to(f):
+                continue
+            findings.extend(rule.check(f, ctx))
+
+    active = []
+    for fin in findings:
+        src = ctx.by_path.get(fin.path)
+        if src is not None and src.suppressed(fin.rule, fin.line):
+            continue
+        active.append(fin)
+    active.sort(key=lambda x: (x.path, x.line, x.col, x.rule))
+    return active
+
+
+# ---------------------------------------------------------------------------
+# Shared AST helpers used by several rule modules
+# ---------------------------------------------------------------------------
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """'jax.jit' for Attribute/Name chains, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def finding(rule: Rule, f: SourceFile, node: ast.AST, message: str,
+            severity: Optional[Severity] = None) -> Finding:
+    return Finding(
+        rule=rule.id,
+        severity=rule.severity if severity is None else severity,
+        path=f.display_path,
+        line=getattr(node, "lineno", 1),
+        col=getattr(node, "col_offset", 0),
+        message=message)

@@ -1,0 +1,749 @@
+"""jit-purity (JIT01-03) and retrace-hygiene (RET01-02) rules.
+
+The hot path of this scheduler is a handful of jitted kernels under
+`models/`, `ops/`, `solver/` and `parallel/`. Two silent failure modes
+repeatedly cost real debugging time:
+
+  * host syncs inside traced code (`.item()`, `float(tracer)`, `np.*` on a
+    tracer, `print`) — each one stalls the device pipeline for a full
+    device->host round trip, which at tick rate dominates the solve;
+  * retraces — unhashable/per-tick-varying statics or Python scalars
+    captured into a jitted closure recompile the kernel every tick.
+
+These rules build a jit *reachability* set: functions decorated with
+`jax.jit` / `functools.partial(jax.jit, ...)`, functions wrapped by a
+`jax.jit(f)` call, and everything those functions call (including callbacks
+handed to `lax.scan` / `lax.cond` / `shard_map`), across module boundaries
+within the analyzed set. Purity checks then run only inside that set, with
+a light taint analysis (parameters are tracers; `.shape`/`.dtype`/`len()`
+results are static) to keep false positives near zero.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from kueue_tpu.analysis.core import (
+    AnalysisContext, Finding, Rule, Severity, SourceFile, dotted_name,
+    finding, register)
+
+_JIT_PATHS = ("models/", "ops/", "solver/", "parallel/", "fixtures/lint/")
+
+# Names whose call result is host-side static even when fed a tracer.
+_UNTAINT_CALLS = {"len", "isinstance", "type", "getattr", "hasattr"}
+# Attributes that are static metadata on a tracer.
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "sharding", "aval"}
+# Host-sync builtins when applied to traced values.
+_HOST_CAST_CALLS = {"float", "int", "bool", "complex"}
+# Receiver methods that mutate the receiver in place.
+_MUTATING_METHODS = {"append", "extend", "insert", "add", "update", "pop",
+                     "remove", "clear", "setdefault", "popitem"}
+
+
+# ---------------------------------------------------------------------------
+# Module model: functions, imports, jit roots
+# ---------------------------------------------------------------------------
+
+
+class _FuncInfo:
+    def __init__(self, qualname: str, node: ast.AST, src: SourceFile,
+                 parent: Optional["_FuncInfo"]):
+        self.qualname = qualname
+        self.node = node
+        self.src = src
+        self.parent = parent
+        self.jit_reachable = False
+        # static_argnames/nums attached when this function is a jit root
+        self.static_names: Set[str] = set()
+        self.static_nums: Set[int] = set()
+
+
+class _Module:
+    """Per-file index: function defs by (qual)name, imports, numpy aliases."""
+
+    def __init__(self, src: SourceFile):
+        self.src = src
+        self.funcs: Dict[str, _FuncInfo] = {}
+        # local name -> (module path, original name) for `from X import Y`
+        self.from_imports: Dict[str, Tuple[str, str]] = {}
+        self.np_aliases: Set[str] = set()
+        self.module_aliases: Dict[str, str] = {}  # local alias -> module path
+        self._index()
+
+    def _index(self) -> None:
+        tree = self.src.tree
+        assert tree is not None
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    local = a.asname or a.name.split(".")[0]
+                    self.module_aliases[local] = a.name
+                    if a.name == "numpy":
+                        self.np_aliases.add(local)
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                for a in node.names:
+                    local = a.asname or a.name
+                    self.from_imports[local] = (node.module, a.name)
+                    if node.module == "numpy":
+                        # `from numpy import X` — treat X as a numpy call.
+                        self.np_aliases.add(local)
+
+        def visit(body: Sequence[ast.stmt], prefix: str,
+                  parent: Optional[_FuncInfo]) -> None:
+            for stmt in body:
+                if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    qn = f"{prefix}{stmt.name}"
+                    info = _FuncInfo(qn, stmt, self.src, parent)
+                    self.funcs[qn] = info
+                    # Innermost definition wins for bare-name lookup; only
+                    # set the short name if unset so module-level defs keep
+                    # priority for cross-function resolution.
+                    self.funcs.setdefault(stmt.name, info)
+                    visit(stmt.body, qn + ".", info)
+                elif isinstance(stmt, ast.ClassDef):
+                    visit(stmt.body, f"{prefix}{stmt.name}.", parent)
+                elif isinstance(stmt, (ast.If, ast.Try, ast.With, ast.For,
+                                       ast.While)):
+                    for field in ("body", "orelse", "finalbody", "handlers"):
+                        sub = getattr(stmt, field, None)
+                        if not sub:
+                            continue
+                        for item in sub:
+                            if isinstance(item, ast.ExceptHandler):
+                                visit(item.body, prefix, parent)
+                            else:
+                                visit([item], prefix, parent)
+
+        visit(tree.body, "", None)
+
+
+def _is_jax_jit(node: ast.AST, mod: _Module) -> bool:
+    """True when `node` denotes jax.jit (possibly via `from jax import jit`)."""
+    name = dotted_name(node)
+    if name is None:
+        return False
+    if name in ("jax.jit", "jit"):
+        if name == "jit":
+            imp = mod.from_imports.get("jit")
+            return imp is not None and imp[0] == "jax"
+        return True
+    return False
+
+
+def _partial_of_jit(call: ast.Call, mod: _Module) -> bool:
+    fn = dotted_name(call.func)
+    if fn not in ("functools.partial", "partial"):
+        return False
+    return bool(call.args) and _is_jax_jit(call.args[0], mod)
+
+
+def _extract_statics(call: ast.Call) -> Tuple[Set[str], Set[int]]:
+    names: Set[str] = set()
+    nums: Set[int] = set()
+    for kw in call.keywords:
+        if kw.arg == "static_argnames":
+            for lit in ast.walk(kw.value):
+                if isinstance(lit, ast.Constant) and isinstance(lit.value, str):
+                    names.add(lit.value)
+        elif kw.arg == "static_argnums":
+            for lit in ast.walk(kw.value):
+                if isinstance(lit, ast.Constant) and isinstance(lit.value, int):
+                    nums.add(lit.value)
+    return names, nums
+
+
+def _param_names(fn: ast.AST) -> List[str]:
+    a = fn.args
+    return ([p.arg for p in a.posonlyargs] + [p.arg for p in a.args]
+            + [p.arg for p in a.kwonlyargs])
+
+
+class _Program:
+    """Whole-analysis view: all modules, jit roots, reachability closure."""
+
+    def __init__(self, ctx: AnalysisContext):
+        self.modules: Dict[str, _Module] = {}
+        for f in ctx.files:
+            if f.tree is not None:
+                self.modules[f.display_path] = _Module(f)
+        self._mark_roots()
+        self._propagate()
+
+    # -- root discovery ------------------------------------------------------
+
+    def _mark_roots(self) -> None:
+        self.roots: List[_FuncInfo] = []
+        for mod in self.modules.values():
+            tree = mod.src.tree
+            for node in ast.walk(tree):
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    for dec in node.decorator_list:
+                        statics = self._jit_statics_of(dec, mod)
+                        if statics is not None:
+                            self._root(mod, node.name, *statics)
+                elif isinstance(node, ast.Call):
+                    # jax.jit(f, static_*=...): statics live on THIS call;
+                    # partial(jax.jit, static_*=...)(f): on the inner call.
+                    if _is_jax_jit(node.func, mod):
+                        statics = _extract_statics(node)
+                    else:
+                        statics = self._jit_statics_of(node.func, mod)
+                    if statics is not None and node.args:
+                        target = node.args[0]
+                        if isinstance(target, ast.Name):
+                            self._root(mod, target.id, *statics)
+
+    def _jit_statics_of(self, expr: ast.AST, mod: _Module
+                        ) -> Optional[Tuple[Set[str], Set[int]]]:
+        """statics if `expr` evaluates to a jit transform, else None."""
+        if _is_jax_jit(expr, mod):
+            return set(), set()
+        if isinstance(expr, ast.Call):
+            if _partial_of_jit(expr, mod):
+                return _extract_statics(expr)
+            if _is_jax_jit(expr.func, mod):
+                return _extract_statics(expr)
+        return None
+
+    def _root(self, mod: _Module, name: str,
+              static_names: Set[str], static_nums: Set[int]) -> None:
+        info = mod.funcs.get(name)
+        if info is None:
+            return
+        info.jit_reachable = True
+        info.static_names |= static_names
+        info.static_nums |= static_nums
+        self.roots.append(info)
+
+    # -- reachability --------------------------------------------------------
+
+    def _callees(self, info: _FuncInfo) -> List[_FuncInfo]:
+        """Functions referenced by name inside `info` (calls and callbacks),
+        resolved locally then through `from` imports."""
+        mod = self._module_of(info)
+        out: List[_FuncInfo] = []
+        refs: Set[str] = set()
+        for node in ast.walk(info.node):
+            if isinstance(node, ast.Call):
+                if isinstance(node.func, ast.Name):
+                    refs.add(node.func.id)
+                # Callback position: lax.scan(step, ...), vmap(f), cond(p, f, g)
+                for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                    if isinstance(arg, ast.Name):
+                        refs.add(arg.id)
+        for name in refs:
+            target = mod.funcs.get(name)
+            if target is not None:
+                out.append(target)
+                continue
+            imp = mod.from_imports.get(name)
+            if imp is None:
+                continue
+            target_mod = self._find_module(imp[0])
+            if target_mod is not None:
+                target = target_mod.funcs.get(imp[1])
+                if target is not None:
+                    out.append(target)
+        # Nested defs trace with their parent (lax.scan bodies etc.).
+        for fn in mod.funcs.values():
+            if fn.parent is info:
+                out.append(fn)
+        return out
+
+    def _module_of(self, info: _FuncInfo) -> _Module:
+        return self.modules[info.src.display_path]
+
+    def _find_module(self, dotted: str) -> Optional[_Module]:
+        tail = dotted.replace(".", "/") + ".py"
+        for path, mod in self.modules.items():
+            if path.endswith(tail):
+                return mod
+        return None
+
+    def _propagate(self) -> None:
+        work = list(self.roots)
+        while work:
+            info = work.pop()
+            for callee in self._callees(info):
+                if not callee.jit_reachable:
+                    callee.jit_reachable = True
+                    work.append(callee)
+
+    def reachable(self) -> List[_FuncInfo]:
+        out = []
+        for mod in self.modules.values():
+            for qn, info in mod.funcs.items():
+                if qn == info.qualname and info.jit_reachable:
+                    out.append(info)
+        return out
+
+
+def _program(ctx: AnalysisContext) -> _Program:
+    prog = getattr(ctx, "_jit_program", None)
+    if prog is None:
+        prog = _Program(ctx)
+        ctx._jit_program = prog
+    return prog
+
+
+# ---------------------------------------------------------------------------
+# Taint analysis inside one traced function
+# ---------------------------------------------------------------------------
+
+
+class _Taint:
+    """Single forward pass: which local names are tracer-derived."""
+
+    def __init__(self, info: _FuncInfo, mod: _Module):
+        self.mod = mod
+        statics = set(info.static_names)
+        params = _param_names(info.node)
+        for i in info.static_nums:
+            if i < len(params):
+                statics.add(params[i])
+        self.tainted: Set[str] = {p for p in params if p not in statics}
+
+    def expr(self, node: ast.AST) -> bool:
+        if isinstance(node, ast.Name):
+            return node.id in self.tainted
+        if isinstance(node, ast.Attribute):
+            if node.attr in _STATIC_ATTRS:
+                return False
+            return self.expr(node.value)
+        if isinstance(node, ast.Call):
+            fn = dotted_name(node.func)
+            if fn in _UNTAINT_CALLS:
+                return False
+            # jnp/jax/lax calls on traced args yield tracers; a method call
+            # like x.astype(...) keeps the receiver's taint.
+            args = list(node.args) + [kw.value for kw in node.keywords]
+            base = (self.expr(node.func.value)
+                    if isinstance(node.func, ast.Attribute) else False)
+            return base or any(self.expr(a) for a in args)
+        if isinstance(node, ast.Subscript):
+            return self.expr(node.value) or self.expr(node.slice)
+        if isinstance(node, (ast.BinOp,)):
+            return self.expr(node.left) or self.expr(node.right)
+        if isinstance(node, ast.UnaryOp):
+            return self.expr(node.operand)
+        if isinstance(node, ast.BoolOp):
+            return any(self.expr(v) for v in node.values)
+        if isinstance(node, ast.Compare):
+            return self.expr(node.left) or any(self.expr(c)
+                                               for c in node.comparators)
+        if isinstance(node, ast.IfExp):
+            return (self.expr(node.test) or self.expr(node.body)
+                    or self.expr(node.orelse))
+        if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+            return any(self.expr(e) for e in node.elts)
+        if isinstance(node, ast.Dict):
+            return any(self.expr(v) for v in node.values if v is not None)
+        if isinstance(node, ast.Starred):
+            return self.expr(node.value)
+        if isinstance(node, ast.Slice):
+            return any(self.expr(p) for p in
+                       (node.lower, node.upper, node.step) if p is not None)
+        return False
+
+    def assign(self, target: ast.AST, value_tainted: bool) -> None:
+        if isinstance(target, ast.Name):
+            if value_tainted:
+                self.tainted.add(target.id)
+            else:
+                self.tainted.discard(target.id)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for e in target.elts:
+                self.assign(e, value_tainted)
+        elif isinstance(target, ast.Starred):
+            self.assign(target.value, value_tainted)
+
+
+def _is_none_check(test: ast.AST) -> bool:
+    """`x is None` / `x is not None` — pytree-structure checks, static at
+    trace time."""
+    if isinstance(test, ast.Compare) and len(test.ops) == 1 \
+            and isinstance(test.ops[0], (ast.Is, ast.IsNot)):
+        comp = test.comparators[0]
+        return isinstance(comp, ast.Constant) and comp.value is None
+    if isinstance(test, ast.BoolOp):
+        return all(_is_none_check(v) for v in test.values)
+    if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+        return _is_none_check(test.operand)
+    return False
+
+
+def _local_names(fn: ast.AST) -> Set[str]:
+    """Parameters plus every name bound inside the function body."""
+    names = set(_param_names(fn))
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Store):
+            names.add(node.id)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and node is not fn:
+            names.add(node.name)
+        elif isinstance(node, (ast.For,)):
+            for t in ast.walk(node.target):
+                if isinstance(t, ast.Name):
+                    names.add(t.id)
+        elif isinstance(node, ast.comprehension):
+            for t in ast.walk(node.target):
+                if isinstance(t, ast.Name):
+                    names.add(t.id)
+    return names
+
+
+def _walk_own(fn: ast.AST):
+    """Walk a function body without descending into nested defs (nested
+    traced functions are analyzed as their own reachable entries)."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _walk_own_body(fn: ast.AST):
+    """Like _walk_own but skips the decorator list: decorators evaluate
+    once at definition time (they configure the transform, e.g. shard_map
+    mesh/in_specs) rather than being captured into the trace."""
+    stack = list(fn.body)
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _statements_in_order(fn: ast.AST):
+    """Own statements of fn in source order (no nested defs)."""
+    out = []
+
+    def rec(body):
+        for stmt in body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            out.append(stmt)
+            for field in ("body", "orelse", "finalbody"):
+                sub = getattr(stmt, field, None)
+                if sub:
+                    rec(sub)
+            for h in getattr(stmt, "handlers", ()) or ():
+                rec(h.body)
+
+    rec(fn.body)
+    out.sort(key=lambda s: (s.lineno, s.col_offset))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# JIT01 — host syncs inside traced code
+# ---------------------------------------------------------------------------
+
+
+def _check_jit01(f: SourceFile, ctx: AnalysisContext):
+    prog = _program(ctx)
+    mod = prog.modules.get(f.display_path)
+    if mod is None:
+        return
+    for info in prog.reachable():
+        if info.src is not f:
+            continue
+        taint = _run_taint(info, mod)
+        for node in _walk_own(info.node):
+            if not isinstance(node, ast.Call):
+                continue
+            fn_name = dotted_name(node.func)
+            if isinstance(node.func, ast.Attribute) and \
+                    node.func.attr == "item" and not node.args:
+                yield finding(JIT01, f, node,
+                              "`.item()` forces a device->host sync inside "
+                              "jit-traced code; keep the value on device "
+                              "(jnp.where / arithmetic) or return it")
+                continue
+            if fn_name == "print":
+                yield finding(JIT01, f, node,
+                              "`print` inside jit-traced code runs at trace "
+                              "time only (or syncs under debug callbacks); "
+                              "use jax.debug.print if output is needed")
+                continue
+            args = list(node.args) + [kw.value for kw in node.keywords]
+            if fn_name in _HOST_CAST_CALLS and args \
+                    and any(taint.expr(a) for a in args):
+                yield finding(JIT01, f, node,
+                              f"`{fn_name}()` on a traced value forces a "
+                              "host sync and a concretization error under "
+                              "jit; use jnp casts/astype instead")
+                continue
+            if fn_name is not None and "." in fn_name:
+                head = fn_name.split(".")[0]
+                if head in mod.np_aliases and any(taint.expr(a) for a in args):
+                    yield finding(JIT01, f, node,
+                                  f"`{fn_name}` (host numpy) applied to a "
+                                  "traced value materializes it on host; "
+                                  "use jax.numpy inside jitted code")
+
+
+def _run_taint(info: _FuncInfo, mod: _Module) -> _Taint:
+    taint = _Taint(info, mod)
+    for stmt in _statements_in_order(info.node):
+        if isinstance(stmt, ast.Assign):
+            v = taint.expr(stmt.value)
+            for t in stmt.targets:
+                taint.assign(t, v)
+        elif isinstance(stmt, ast.AugAssign):
+            v = taint.expr(stmt.value) or taint.expr(stmt.target)
+            taint.assign(stmt.target, v)
+        elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+            taint.assign(stmt.target, taint.expr(stmt.value))
+        elif isinstance(stmt, ast.For):
+            taint.assign(stmt.target, taint.expr(stmt.iter))
+    return taint
+
+
+# ---------------------------------------------------------------------------
+# JIT02 — Python control flow on traced values
+# ---------------------------------------------------------------------------
+
+
+def _check_jit02(f: SourceFile, ctx: AnalysisContext):
+    prog = _program(ctx)
+    mod = prog.modules.get(f.display_path)
+    if mod is None:
+        return
+    for info in prog.reachable():
+        if info.src is not f:
+            continue
+        taint = _run_taint(info, mod)
+        for node in _walk_own(info.node):
+            if isinstance(node, (ast.If, ast.While)) \
+                    and not _is_none_check(node.test) \
+                    and taint.expr(node.test):
+                kind = "if" if isinstance(node, ast.If) else "while"
+                yield finding(
+                    JIT02, f, node,
+                    f"Python `{kind}` on a traced value inside jitted code "
+                    "raises a ConcretizationTypeError (or silently "
+                    "specializes at trace time); use jnp.where / "
+                    "lax.cond / lax.while_loop")
+            elif isinstance(node, ast.Assert) and taint.expr(node.test):
+                yield finding(
+                    JIT02, f, node,
+                    "assert on a traced value inside jitted code forces "
+                    "concretization; move the check host-side or use "
+                    "checkify")
+
+
+# ---------------------------------------------------------------------------
+# JIT03 — mutation of closed-over / global state while tracing
+# ---------------------------------------------------------------------------
+
+
+def _check_jit03(f: SourceFile, ctx: AnalysisContext):
+    # Two deliberate exclusions keep this near-zero-FP: `nonlocal` counters
+    # over static Python ints (buffer-unpacking helpers advance an offset
+    # at trace time — pure metaprogramming), and pallas kernels (ref stores
+    # into closed-over/parameter Refs are the pallas output mechanism).
+    # What remains — leaking *traced* values into enclosing state — is the
+    # bug class: the leaked tracer escapes its trace and either errors or
+    # pins the first trace's value forever.
+    prog = _program(ctx)
+    mod = prog.modules.get(f.display_path)
+    if mod is None:
+        return
+    for info in prog.reachable():
+        if info.src is not f:
+            continue
+        local = _local_names(info.node)
+        taint = _run_taint(info, mod)
+        for node in _walk_own(info.node):
+            if isinstance(node, ast.Global):
+                yield finding(
+                    JIT03, f, node,
+                    f"`global {', '.join(node.names)}` inside jit-traced "
+                    "code runs once at trace time, not per call — traced "
+                    "functions must be pure")
+            elif isinstance(node, (ast.Assign, ast.AugAssign)):
+                targets = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                v_tainted = taint.expr(node.value)
+                for t in targets:
+                    base = t
+                    while isinstance(base, (ast.Subscript, ast.Attribute)):
+                        base = base.value
+                    if isinstance(base, ast.Name) and base.id not in local \
+                            and base is not t and v_tainted:
+                        yield finding(
+                            JIT03, f, t,
+                            f"traced value stored into closed-over "
+                            f"`{base.id}` inside jit-traced code: the "
+                            "tracer escapes its trace (leaked-tracer "
+                            "error, or a stale first-trace value); thread "
+                            "state through the function instead")
+            elif isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in _MUTATING_METHODS:
+                base = node.func.value
+                while isinstance(base, (ast.Subscript, ast.Attribute)):
+                    base = base.value
+                args = list(node.args) + [kw.value for kw in node.keywords]
+                if isinstance(base, ast.Name) and base.id not in local \
+                        and any(taint.expr(a) for a in args):
+                    yield finding(
+                        JIT03, f, node,
+                        f"`.{node.func.attr}()` stores a traced value into "
+                        f"closed-over `{base.id}` during tracing — traced "
+                        "functions must not mutate external state")
+
+
+# ---------------------------------------------------------------------------
+# RET01 — static_argnames/static_argnums hazards
+# ---------------------------------------------------------------------------
+
+_UNHASHABLE_ANNOS = {"list", "List", "dict", "Dict", "set", "Set",
+                     "ndarray", "Array", "bytearray"}
+
+
+def _check_ret01(f: SourceFile, ctx: AnalysisContext):
+    prog = _program(ctx)
+    mod = prog.modules.get(f.display_path)
+    if mod is None:
+        return
+    for info in prog.roots:
+        if info.src is not f:
+            continue
+        params = _param_names(info.node)
+        has_kwargs = info.node.args.kwarg is not None
+        for name in sorted(info.static_names):
+            if name not in params and not has_kwargs:
+                yield finding(
+                    RET01, f, info.node,
+                    f"static_argnames names `{name}` but "
+                    f"`{info.qualname}` has no such parameter — jax raises "
+                    "at call time (or silently ignores it on older "
+                    "versions)")
+        has_vararg = info.node.args.vararg is not None
+        for num in sorted(info.static_nums):
+            if num >= len(params) and not has_vararg:
+                yield finding(
+                    RET01, f, info.node,
+                    f"static_argnums index {num} is out of range for "
+                    f"`{info.qualname}` ({len(params)} parameters)")
+        by_name = {}
+        a = info.node.args
+        for p in a.posonlyargs + a.args + a.kwonlyargs:
+            by_name[p.arg] = p
+        static_params = set(info.static_names)
+        for i in info.static_nums:
+            if i < len(params):
+                static_params.add(params[i])
+        for name in sorted(static_params):
+            p = by_name.get(name)
+            if p is None or p.annotation is None:
+                continue
+            anno = p.annotation
+            anno_name = dotted_name(anno)
+            tail = anno_name.rsplit(".", 1)[-1] if anno_name else None
+            if isinstance(anno, ast.Subscript):
+                head = dotted_name(anno.value)
+                tail = head.rsplit(".", 1)[-1] if head else None
+            if tail in _UNHASHABLE_ANNOS:
+                yield finding(
+                    RET01, f, p,
+                    f"static argument `{name}` is annotated `{tail}`: "
+                    "unhashable statics raise at call time, and statics "
+                    "that vary per tick retrace the kernel every call — "
+                    "pass arrays as traced args or use hashable tuples")
+
+
+# ---------------------------------------------------------------------------
+# RET02 — Python scalars captured into jitted closures
+# ---------------------------------------------------------------------------
+
+
+def _check_ret02(f: SourceFile, ctx: AnalysisContext):
+    prog = _program(ctx)
+    mod = prog.modules.get(f.display_path)
+    if mod is None:
+        return
+    module_names = set(mod.module_aliases) | set(mod.from_imports)
+    top_level: Set[str] = set()
+    for node in mod.src.tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            top_level.add(node.name)
+        elif isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            for t in targets:
+                for sub in ast.walk(t):
+                    if isinstance(sub, ast.Name):
+                        top_level.add(sub.id)
+        elif isinstance(node, (ast.If, ast.Try)):
+            # try/except import fallbacks and TYPE_CHECKING blocks
+            for t in ast.walk(node):
+                if isinstance(t, ast.Name) and isinstance(t.ctx, ast.Store):
+                    top_level.add(t.id)
+    import builtins
+    builtin_names = set(dir(builtins))
+    for info in prog.roots:
+        if info.src is not f or info.parent is None:
+            continue
+        # A jit root defined inside another function: loads of names local
+        # to the enclosing scope are closure captures baked in at trace
+        # time; if the enclosing function runs per tick with varying
+        # values, every tick retraces.
+        local = _local_names(info.node)
+        enclosing_locals = _local_names(info.parent.node)
+        first_use: Dict[str, ast.Name] = {}
+        for node in _walk_own_body(info.node):
+            if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+                nm = node.id
+                if nm in local or nm in module_names or nm in top_level \
+                        or nm in builtin_names or nm not in enclosing_locals:
+                    continue
+                prev = first_use.get(nm)
+                if prev is None or (node.lineno, node.col_offset) < \
+                        (prev.lineno, prev.col_offset):
+                    first_use[nm] = node
+        for nm, node in sorted(first_use.items(),
+                               key=lambda kv: (kv[1].lineno,
+                                               kv[1].col_offset)):
+            yield finding(
+                RET02, f, node,
+                f"jitted closure captures `{nm}` from the enclosing "
+                "scope; a different value on a later call silently "
+                "retraces — make sure the compiled program is "
+                "cached per capture, or pass it as a (static) "
+                "argument")
+
+
+JIT01 = register(Rule(
+    id="JIT01", severity=Severity.ERROR,
+    summary="host sync (.item()/float()/np.*/print) inside jit-traced code",
+    check=_check_jit01, path_fragments=_JIT_PATHS))
+
+JIT02 = register(Rule(
+    id="JIT02", severity=Severity.ERROR,
+    summary="Python if/while/assert on traced values inside jitted code",
+    check=_check_jit02, path_fragments=_JIT_PATHS))
+
+JIT03 = register(Rule(
+    id="JIT03", severity=Severity.ERROR,
+    summary="mutation of closed-over/global state inside jit-traced code",
+    check=_check_jit03, path_fragments=_JIT_PATHS))
+
+RET01 = register(Rule(
+    id="RET01", severity=Severity.ERROR,
+    summary="static_argnames/static_argnums hazards (missing/unhashable)",
+    check=_check_ret01, path_fragments=_JIT_PATHS))
+
+RET02 = register(Rule(
+    id="RET02", severity=Severity.WARNING,
+    summary="Python values captured into a jitted closure (retrace risk)",
+    check=_check_ret02, path_fragments=_JIT_PATHS))

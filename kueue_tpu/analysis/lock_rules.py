@@ -1,0 +1,234 @@
+"""Lock-discipline rules (LOCK01, LOCK02) for the controller runtime.
+
+The controller side of this scheduler (cache, queue manager, controllers,
+API server) is classic multi-threaded Python. Two hazards have bitten in
+past rounds:
+
+  * blocking while holding a lock — a `parallelize` fan-out, subprocess,
+    socket/file I/O or an untimed `Condition.wait` inside `with self._lock`
+    serializes every other thread behind host-side latency (and the nested
+    `parallelize` case can deadlock the shared pool outright);
+  * inconsistent guarding — an attribute written under the lock in most
+    methods but bare in one is a data race that only shows under load.
+
+LOCK01 walks every `with` block whose context manager looks like a lock
+(name contains "lock"/"cond"/"mutex") and flags blocking calls made while
+it is held. It does not descend into nested function definitions: those run
+later, usually after release.
+
+LOCK02 collects, per class, the set of `self.X` attributes ever assigned
+inside a lock block, then flags assignments to the same attributes outside
+any lock in other methods. `__init__`/`__post_init__`/`__new__` and methods
+whose name ends in `_locked` (the repo convention for "caller holds the
+lock") are exempt. Warning severity: private helpers called under the
+caller's lock are common and legitimate.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from kueue_tpu.analysis.core import (
+    AnalysisContext, Rule, Severity, SourceFile, dotted_name, finding,
+    register)
+
+_LOCK_PATHS = ("scheduler/", "core/", "queue/", "controllers/", "server/",
+               "metrics.py", "__main__.py", "fixtures/lint/")
+
+_LOCKY = ("lock", "cond", "mutex", "sem")
+
+# Module-qualified calls that block the calling thread.
+_BLOCKING_PREFIXES = ("subprocess.", "socket.", "requests.", "urllib.",
+                      "shutil.", "http.client.")
+_BLOCKING_CALLS = {"time.sleep", "open", "parallelize.until",
+                   "parallelize.for_each", "os.system", "input"}
+# Bare names that block when imported directly (from ... import until).
+_BLOCKING_FROM = {("kueue_tpu.utils.parallelize", "until"),
+                  ("kueue_tpu.utils.parallelize", "for_each")}
+
+
+def _looks_like_lock(expr: ast.AST) -> Optional[str]:
+    """Name of the lock-ish context manager, or None."""
+    name = dotted_name(expr)
+    if isinstance(expr, ast.Call):
+        # with self._lock.acquire_timeout(...) or threading.Lock() inline
+        name = dotted_name(expr.func)
+    if name is None:
+        return None
+    leaf = name.rsplit(".", 1)[-1].lower()
+    if any(k in leaf for k in _LOCKY):
+        return name
+    return None
+
+
+def _walk_stopping_at_defs(nodes):
+    stack = list(nodes)
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _blocking_reason(call: ast.Call, from_imports: Dict[str, Tuple[str, str]]
+                     ) -> Optional[str]:
+    name = dotted_name(call.func)
+    if name is None:
+        # method call: cond.wait() with no timeout argument
+        if isinstance(call.func, ast.Attribute) and call.func.attr == "wait" \
+                and not call.args and not call.keywords:
+            recv = dotted_name(call.func.value) or "<expr>"
+            return (f"`{recv}.wait()` with no timeout blocks forever while "
+                    "the lock of any outer `with` is held")
+        return None
+    if isinstance(call.func, ast.Attribute) and call.func.attr == "wait" \
+            and not call.args and not call.keywords:
+        return (f"`{name}.wait()` with no timeout blocks forever while "
+                "an outer lock is held")
+    if name in _BLOCKING_CALLS:
+        return f"`{name}(...)` blocks (I/O or thread fan-out)"
+    for prefix in _BLOCKING_PREFIXES:
+        if name.startswith(prefix):
+            return f"`{name}(...)` blocks on I/O"
+    head = name.split(".")[0]
+    imp = from_imports.get(head) or from_imports.get(name)
+    if imp in _BLOCKING_FROM:
+        return f"`{name}(...)` is a parallelize fan-out"
+    return None
+
+
+def _from_imports(tree: ast.Module) -> Dict[str, Tuple[str, str]]:
+    out: Dict[str, Tuple[str, str]] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module:
+            for a in node.names:
+                out[a.asname or a.name] = (node.module, a.name)
+    return out
+
+
+def _check_lock01(f: SourceFile, ctx: AnalysisContext):
+    imports = _from_imports(f.tree)
+    for node in ast.walk(f.tree):
+        if not isinstance(node, (ast.With, ast.AsyncWith)):
+            continue
+        lock_name = None
+        for item in node.items:
+            lock_name = _looks_like_lock(item.context_expr)
+            if lock_name:
+                break
+        if not lock_name:
+            continue
+        for inner in _walk_stopping_at_defs(node.body):
+            if not isinstance(inner, ast.Call):
+                continue
+            # The lock's own wait IS the release-and-block primitive:
+            # `with self._cond: self._cond.wait()` releases while waiting.
+            # Only untimed waits on *other* objects are flagged; untimed
+            # waits on the held condition get a dedicated message because
+            # they still starve the wake-up path if no one ever notifies.
+            reason = _blocking_reason(inner, imports)
+            if reason is None:
+                continue
+            recv = None
+            if isinstance(inner.func, ast.Attribute):
+                recv = dotted_name(inner.func.value)
+            if recv is not None and recv == lock_name \
+                    and inner.func.attr == "wait":
+                yield finding(
+                    LOCK01, f, inner,
+                    f"untimed `{recv}.wait()` under `with {lock_name}`: "
+                    "a missed notify hangs this thread forever — pass a "
+                    "timeout and re-check the predicate",
+                    severity=Severity.WARNING)
+                continue
+            yield finding(
+                LOCK01, f, inner,
+                f"{reason} while `with {lock_name}` is held — move the "
+                "blocking call outside the critical section (collect under "
+                "the lock, apply after release)")
+
+
+# ---------------------------------------------------------------------------
+# LOCK02 — attributes guarded in some methods, bare in others
+# ---------------------------------------------------------------------------
+
+_EXEMPT_METHODS = {"__init__", "__post_init__", "__new__", "__enter__",
+                   "__exit__"}
+
+
+def _self_attr_writes(fn: ast.AST, self_name: str):
+    """(attr, node) for every `self.X = ...` / `self.X op= ...` in fn."""
+    for node in _walk_stopping_at_defs(fn.body):
+        targets: List[ast.AST] = []
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+            targets = [node.target]
+        for t in targets:
+            for sub in ast.walk(t):
+                if isinstance(sub, ast.Attribute) \
+                        and isinstance(sub.value, ast.Name) \
+                        and sub.value.id == self_name \
+                        and isinstance(sub.ctx, ast.Store):
+                    yield sub.attr, sub
+
+
+def _lock_spans(fn: ast.AST) -> List[Tuple[int, int]]:
+    spans = []
+    for node in ast.walk(fn):
+        if isinstance(node, (ast.With, ast.AsyncWith)) and any(
+                _looks_like_lock(i.context_expr) for i in node.items):
+            spans.append((node.lineno, node.end_lineno or node.lineno))
+    return spans
+
+
+def _in_spans(line: int, spans: List[Tuple[int, int]]) -> bool:
+    return any(lo <= line <= hi for lo, hi in spans)
+
+
+def _check_lock02(f: SourceFile, ctx: AnalysisContext):
+    for cls in ast.walk(f.tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        methods = [n for n in cls.body
+                   if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+        guarded: Set[str] = set()
+        per_method: List[Tuple[ast.AST, List[Tuple[str, ast.AST]],
+                               List[Tuple[int, int]]]] = []
+        for m in methods:
+            if not m.args.args:
+                continue
+            self_name = m.args.args[0].arg
+            spans = _lock_spans(m)
+            writes = list(_self_attr_writes(m, self_name))
+            per_method.append((m, writes, spans))
+            for attr, node in writes:
+                if _in_spans(node.lineno, spans):
+                    guarded.add(attr)
+        if not guarded:
+            continue
+        for m, writes, spans in per_method:
+            if m.name in _EXEMPT_METHODS or m.name.endswith("_locked"):
+                continue
+            for attr, node in writes:
+                if attr in guarded and not _in_spans(node.lineno, spans):
+                    yield finding(
+                        LOCK02, f, node,
+                        f"`self.{attr}` is written under a lock elsewhere "
+                        f"in `{cls.name}` but bare in `{m.name}` — either "
+                        "take the lock here or rename the method "
+                        "`*_locked` to document that the caller holds it")
+
+
+LOCK01 = register(Rule(
+    id="LOCK01", severity=Severity.ERROR,
+    summary="blocking call (I/O, parallelize, untimed wait) under a held lock",
+    check=_check_lock01, path_fragments=_LOCK_PATHS))
+
+LOCK02 = register(Rule(
+    id="LOCK02", severity=Severity.WARNING,
+    summary="attribute guarded by a lock in some methods but written bare",
+    check=_check_lock02, path_fragments=_LOCK_PATHS))

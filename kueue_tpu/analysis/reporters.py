@@ -1,0 +1,43 @@
+"""Finding reporters: human text and machine JSON."""
+
+from __future__ import annotations
+
+import json
+from collections import Counter
+from typing import List, Sequence
+
+from kueue_tpu.analysis.core import Finding, Severity, all_rules
+
+
+def render_text(findings: Sequence[Finding]) -> str:
+    lines: List[str] = [f.render() for f in findings]
+    by_sev = Counter(f.severity for f in findings)
+    errors = by_sev.get(Severity.ERROR, 0)
+    warnings = by_sev.get(Severity.WARNING, 0)
+    if findings:
+        lines.append("")
+    lines.append(f"kueuelint: {errors} error(s), {warnings} warning(s)")
+    return "\n".join(lines)
+
+
+def render_json(findings: Sequence[Finding]) -> str:
+    by_sev = Counter(f.severity for f in findings)
+    doc = {
+        "tool": "kueuelint",
+        "findings": [f.to_dict() for f in findings],
+        "counts": {
+            "error": by_sev.get(Severity.ERROR, 0),
+            "warning": by_sev.get(Severity.WARNING, 0),
+        },
+    }
+    return json.dumps(doc, indent=2, sort_keys=True)
+
+
+def render_rule_list() -> str:
+    lines = []
+    for rule in all_rules():
+        scope = ("all files" if rule.path_fragments is None
+                 else ", ".join(rule.path_fragments))
+        lines.append(f"{rule.id}  [{rule.severity.label:7s}] {rule.summary}")
+        lines.append(f"        scope: {scope}")
+    return "\n".join(lines)

@@ -163,16 +163,11 @@ def decode_cluster_queue(doc: Mapping[str, Any]) -> ClusterQueue:
             covered_resources=tuple(g.get("coveredResources") or ()),
             flavors=tuple(_flavor_quotas(f) for f in g.get("flavors") or ()))
         for g in spec.get("resourceGroups") or ())
-    cq = ClusterQueue(
-        name=name,
-        resource_groups=groups,
-        cohort=spec.get("cohort", ""),
-        namespace_selector=_label_selector(spec.get("namespaceSelector")),
-        admission_checks=tuple(spec.get("admissionChecks") or ()),
-        stop_policy=spec.get("stopPolicy", "None"),
-    )
+    # ClusterQueue is frozen: collect every optional section first and
+    # construct exactly once.
+    extra: Dict[str, Any] = {}
     if spec.get("queueingStrategy"):
-        cq.queueing_strategy = spec["queueingStrategy"]
+        extra["queueing_strategy"] = spec["queueingStrategy"]
     p = spec.get("preemption")
     if p:
         bwc = None
@@ -181,19 +176,27 @@ def decode_cluster_queue(doc: Mapping[str, Any]) -> ClusterQueue:
             bwc = BorrowWithinCohort(
                 policy=b.get("policy", "Never"),
                 max_priority_threshold=b.get("maxPriorityThreshold"))
-        cq.preemption = ClusterQueuePreemption(
+        extra["preemption"] = ClusterQueuePreemption(
             reclaim_within_cohort=p.get("reclaimWithinCohort", "Never"),
             within_cluster_queue=p.get("withinClusterQueue", "Never"),
             borrow_within_cohort=bwc)
     ff = spec.get("flavorFungibility")
     if ff:
-        cq.flavor_fungibility = FlavorFungibility(
+        extra["flavor_fungibility"] = FlavorFungibility(
             when_can_borrow=ff.get("whenCanBorrow", "Borrow"),
             when_can_preempt=ff.get("whenCanPreempt", "TryNextFlavor"))
     fs = spec.get("fairSharing")
     if fs:
-        cq.fair_sharing = FairSharing(weight=float(fs.get("weight", 1)))
-    return cq
+        extra["fair_sharing"] = FairSharing(weight=float(fs.get("weight", 1)))
+    return ClusterQueue(
+        name=name,
+        resource_groups=groups,
+        cohort=spec.get("cohort", ""),
+        namespace_selector=_label_selector(spec.get("namespaceSelector")),
+        admission_checks=tuple(spec.get("admissionChecks") or ()),
+        stop_policy=spec.get("stopPolicy", "None"),
+        **extra,
+    )
 
 
 def decode_local_queue(doc: Mapping[str, Any]) -> LocalQueue:
@@ -248,6 +251,7 @@ def decode_workload(doc: Mapping[str, Any]) -> Workload:
         pod_sets=pod_sets,
         priority=int(spec.get("priority", 0)),
         priority_class=spec.get("priorityClassName", ""),
+        priority_class_source=spec.get("priorityClassSource", ""),
         active=bool(spec.get("active", True)))
 
 
@@ -518,6 +522,7 @@ def encode_workload(wl: Workload, with_status: bool = True) -> Dict[str, Any]:
                  "podSets": [_encode_pod_set(ps) for ps in wl.pod_sets],
                  "priority": wl.priority,
                  "priorityClassName": wl.priority_class,
+                 "priorityClassSource": wl.priority_class_source,
                  "active": wl.active},
     }
     if with_status:

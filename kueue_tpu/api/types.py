@@ -306,7 +306,7 @@ class CohortSpec:
     resource_groups: Tuple[ResourceGroup, ...] = ()
 
 
-@dataclass
+@dataclass(frozen=True)
 class ClusterQueue:
     name: str
     resource_groups: Tuple[ResourceGroup, ...] = ()
@@ -320,7 +320,7 @@ class ClusterQueue:
     fair_sharing: Optional[FairSharing] = None
 
 
-@dataclass
+@dataclass(frozen=True)
 class LocalQueue:
     name: str
     namespace: str
@@ -462,8 +462,10 @@ EVICTED_BY_CLUSTER_QUEUE_STOPPED = "ClusterQueueStopped"
 EVICTED_BY_DEACTIVATION = "InactiveWorkload"
 
 
+# Conditions are mutated in place by set_condition so that Workload's
+# _cond_memo index (built on object identity) stays valid across updates.
 @dataclass
-class Condition:
+class Condition:  # kueuelint: disable=API02
     type: str
     status: bool
     reason: str = ""
@@ -493,7 +495,7 @@ class AdmissionCheckState:
     pod_set_updates: List[dict] = field(default_factory=list)
 
 
-@dataclass
+@dataclass(frozen=True)
 class RequeueState:
     count: int = 0
     requeue_at: Optional[float] = None

@@ -70,17 +70,20 @@ class Journal:
         import fcntl
 
         os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
-        self._owner_lock_file = open(self.path + ".owner", "a+")
+        # Acquire the flock on a local handle first: the open+flock I/O
+        # happens with no lock held, and the attribute publish (which
+        # close() reads under self._lock) is guarded.
+        owner = open(self.path + ".owner", "a+")
         try:
-            fcntl.flock(self._owner_lock_file.fileno(),
-                        fcntl.LOCK_EX | fcntl.LOCK_NB)
+            fcntl.flock(owner.fileno(), fcntl.LOCK_EX | fcntl.LOCK_NB)
         except OSError:
-            self._owner_lock_file.close()
-            self._owner_lock_file = None
+            owner.close()
             raise RuntimeError(
                 f"state journal {self.path} is owned by another process "
                 "(journals are single-writer; give each replica its own "
                 "--state-dir and share only --lease-file)")
+        with self._lock:
+            self._owner_lock_file = owner
         self._store = store
         restored = self._replay(store)
         self._compact(store)
@@ -135,7 +138,10 @@ class Journal:
         line = json.dumps(entry, separators=(",", ":"))
         with self._lock:
             if self._file is None:
-                self._file = open(self.path, "a", encoding="utf-8")
+                # Serializing append I/O is this lock's purpose: entries
+                # must hit the journal in event order.
+                self._file = open(  # kueuelint: disable=LOCK01
+                    self.path, "a", encoding="utf-8")
             self._file.write(line + "\n")
             self._file.flush()
             if self.fsync:

@@ -89,8 +89,13 @@ def _build_program(mesh: Mesh, C: int, K: int, num_slots: int,
             wl_cq, req, has_req, podset_valid, podset_unsat, elig, resume_slot,
             hier=None):
         # --- cohort aggregation over the sharded CQ axis (ICI psum) ---
+        # The closure captures below (K, C, num_slots, fungibility_enabled)
+        # are safe: every captured value is part of the _PROGRAM_CACHE key,
+        # so a different value builds (and caches) a fresh program instead
+        # of silently retracing this one.
         above = jnp.maximum(usage_shard - guar_shard, 0)
-        part_cu = jax.ops.segment_sum(above, cid_shard, num_segments=K + 1)
+        part_cu = jax.ops.segment_sum(
+            above, cid_shard, num_segments=K + 1)  # kueuelint: disable=RET02
         cohort_usage = jax.lax.psum(part_cu, AXIS)[:K]
         part_cr = jax.ops.segment_sum(lend_shard, cid_shard, num_segments=K + 1)
         cohort_requestable = jax.lax.psum(part_cr, AXIS)[:K]
@@ -100,12 +105,13 @@ def _build_program(mesh: Mesh, C: int, K: int, num_slots: int,
 
         return solve_core(
             nominal, borrow_limit, guaranteed,
-            usage_full[:C],
+            usage_full[:C],  # kueuelint: disable=RET02
             cohort_requestable, cohort_usage, cohort_id_full,
             group_of_resource, slot_flavor, num_flavors,
             bwc_enabled, borrow_pol, preempt_pol,
             wl_cq, req, has_req, podset_valid, podset_unsat, elig, resume_slot,
-            num_slots=num_slots, fungibility_enabled=fungibility_enabled,
+            num_slots=num_slots,  # kueuelint: disable=RET02
+            fungibility_enabled=fungibility_enabled,  # kueuelint: disable=RET02
             hier=hier)
 
     return jax.jit(run)

@@ -1,0 +1,21 @@
+# Bad fixture: API-hygiene violations (API01/API02).
+from dataclasses import dataclass
+from typing import Optional
+
+
+def enqueue(item, batch=[]):  # API01: mutable default argument
+    batch.append(item)
+    return batch
+
+
+def configure(name, opts={}):  # API01: mutable default argument
+    opts.setdefault("retries", 3)
+    return name, opts
+
+
+@dataclass
+class FlavorRef:  # API02: all fields immutable-typed, should be frozen
+    name: str
+    resource: str
+    weight: float = 1.0
+    parent: Optional[str] = None

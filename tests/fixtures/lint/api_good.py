@@ -1,0 +1,22 @@
+# Good fixture: API-hygiene counterparts — zero findings.
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+
+def enqueue(item, batch: Optional[List] = None):
+    batch = [] if batch is None else batch
+    batch.append(item)
+    return batch
+
+
+@dataclass(frozen=True)
+class FlavorRef:
+    name: str
+    resource: str
+    weight: float = 1.0
+    parent: Optional[str] = None
+
+
+@dataclass
+class MutableStatus:  # fine: carries mutable state, not freezable
+    counts: Dict[str, int] = field(default_factory=dict)

@@ -1,0 +1,59 @@
+# Bad fixture: every jit-purity violation family (JIT01/JIT02/JIT03).
+# Analyzed statically by kueuelint — never imported or executed.
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_HOST_STATE = []
+
+
+@jax.jit
+def host_sync_item(x):
+    total = jnp.sum(x)
+    return total.item()  # JIT01: .item() host sync
+
+
+@jax.jit
+def host_cast(x):
+    return float(x) + 1.0  # JIT01: float() on a traced value
+
+
+@jax.jit
+def host_numpy(x):
+    return np.log(x)  # JIT01: host numpy on a traced value
+
+
+@jax.jit
+def trace_print(x):
+    print(x)  # JIT01: print inside traced code
+    return x
+
+
+@functools.partial(jax.jit, static_argnames=("n",))
+def traced_branch(x, n):
+    if x > 0:  # JIT02: Python `if` on a traced value
+        return x * n
+    return x
+
+
+@jax.jit
+def traced_loop(x):
+    while x < 10:  # JIT02: Python `while` on a traced value
+        x = x + 1
+    return x
+
+
+@jax.jit
+def leaks_tracer(x):
+    y = x * 2
+    _HOST_STATE.append(y)  # JIT03: traced value into closed-over state
+    return y
+
+
+@jax.jit
+def global_mutation(x):
+    global _COUNTER  # JIT03: global inside traced code
+    _COUNTER = 1
+    return x

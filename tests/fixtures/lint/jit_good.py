@@ -1,0 +1,38 @@
+# Good fixture: the same computations written trace-safely — zero findings.
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def device_sum(x):
+    return jnp.sum(x)  # stays on device; caller syncs when it chooses
+
+
+@functools.partial(jax.jit, static_argnames=("n",))
+def static_branch(x, n):
+    if n > 4:  # fine: `n` is a static argument, resolved at trace time
+        return x * n
+    W, = x.shape
+    if W == 0:  # fine: shapes are static under jit
+        return x
+    return jnp.where(x > 0, x * n, x)  # traced select stays on device
+
+
+@jax.jit
+def bounded_loop(x):
+    return jax.lax.while_loop(lambda v: jnp.all(v < 10), lambda v: v + 1, x)
+
+
+@jax.jit
+def structure_check(x, bias=None):
+    if bias is None:  # fine: pytree-structure check, static at trace time
+        return x
+    return x + bias
+
+
+def host_driver(batch):
+    # Host-side code may sync freely — it is not jit-reachable.
+    out = device_sum(jnp.asarray(batch))
+    return float(out)

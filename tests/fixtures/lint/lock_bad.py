@@ -1,0 +1,38 @@
+# Bad fixture: lock-discipline hazards (LOCK01/LOCK02).
+import subprocess
+import threading
+import time
+
+from kueue_tpu.utils.parallelize import for_each
+
+
+class Controller:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cond = threading.Condition()
+        self._state = {}
+        self._applied = 0
+
+    def apply_all(self, items, fn):
+        with self._lock:
+            # LOCK01: thread fan-out while holding the lock — workers that
+            # call back into this controller deadlock on self._lock.
+            for_each(items, fn)
+            self._applied += len(items)
+
+    def reconcile(self, key):
+        with self._lock:
+            time.sleep(0.1)  # LOCK01: sleeping while holding the lock
+            self._state[key] = "ready"
+
+    def run_hook(self, cmd):
+        with self._lock:
+            subprocess.run(cmd)  # LOCK01: subprocess under the lock
+
+    def wait_forever(self):
+        with self._cond:
+            self._cond.wait()  # LOCK01: untimed wait — missed notify hangs
+
+    def fast_path_write(self, n):
+        # LOCK02: `_applied` is lock-guarded in apply_all but bare here.
+        self._applied = n

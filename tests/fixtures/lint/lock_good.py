@@ -1,0 +1,46 @@
+# Good fixture: lock-disciplined counterparts — zero findings.
+import subprocess
+import threading
+import time
+
+from kueue_tpu.utils.parallelize import for_each
+
+
+class Controller:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cond = threading.Condition()
+        self._state = {}
+        self._applied = 0
+
+    def apply_all(self, items, fn):
+        # Collect under the lock, fan out after release.
+        with self._lock:
+            batch = list(items)
+        for_each(batch, fn)
+        with self._lock:
+            self._applied += len(batch)
+
+    def reconcile(self, key):
+        time.sleep(0.1)  # backoff happens outside the critical section
+        with self._lock:
+            self._state[key] = "ready"
+
+    def run_hook(self, cmd):
+        subprocess.run(cmd)
+        with self._lock:
+            self._state["hook"] = "done"
+
+    def wait_ready(self, timeout=5.0):
+        deadline = time.monotonic() + timeout
+        with self._cond:
+            while not self._state.get("ready"):
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._cond.wait(remaining)  # timed wait, predicate re-checked
+        return True
+
+    def _bump_locked(self, n):
+        # `*_locked` suffix documents that the caller holds self._lock.
+        self._applied = n

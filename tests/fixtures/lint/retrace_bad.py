@@ -1,0 +1,39 @@
+# Bad fixture: retrace-hygiene hazards (RET01/RET02).
+import functools
+from typing import List
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.partial(jax.jit, static_argnames=("shape", "missing"))
+def typo_static(x, shape):  # RET01: `missing` is not a parameter
+    return jnp.zeros(shape) + x
+
+
+@functools.partial(jax.jit, static_argnums=(5,))
+def out_of_range(x, y):  # RET01: static_argnums index 5 out of range
+    return x + y
+
+
+@functools.partial(jax.jit, static_argnames=("sizes",))
+def unhashable_static(x, sizes: List[int]):  # RET01: list static arg
+    return x[: sizes[0]]
+
+
+def _direct_impl(x, flags):
+    return x
+
+
+# RET01: statics declared on a direct jax.jit(...) call are checked too.
+direct_call_typo = jax.jit(_direct_impl, static_argnames=("flag",))
+
+
+def build_step(scale, offset):
+    @jax.jit
+    def step(x):
+        # RET02: `scale`/`offset` captured from the enclosing scope; a new
+        # build_step call with different values silently retraces.
+        return x * scale + offset
+
+    return step

@@ -1,0 +1,31 @@
+# Good fixture: retrace-safe patterns — zero findings.
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.partial(jax.jit, static_argnames=("shape",))
+def named_static(x, shape: Tuple[int, ...]):  # hashable tuple static
+    return jnp.zeros(shape) + x
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def indexed_static(x, n: int):
+    return x * n
+
+
+@jax.jit
+def scale_as_arg(x, scale, offset):
+    # Per-call values ride as traced arguments: one trace serves them all.
+    return x * scale + offset
+
+
+def _branch_impl(x, n):
+    if n > 2:  # fine: `n` is static via the direct jax.jit(...) call below
+        return x * n
+    return x
+
+
+direct_call_static = jax.jit(_branch_impl, static_argnums=(1,))

@@ -1,0 +1,14 @@
+# Bad fixture (API03): decode/encode forget JobSpec.retries.
+from .types import JobSpec
+
+
+def decode_job_spec(doc):
+    return JobSpec(
+        name=doc["name"],
+        queue=doc.get("queue", ""),
+        priority=int(doc.get("priority", 0)))
+
+
+def encode_job_spec(spec):
+    return {"name": spec.name, "queue": spec.queue,
+            "priority": spec.priority}

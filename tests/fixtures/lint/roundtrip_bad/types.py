@@ -1,0 +1,11 @@
+# Bad fixture (API03): `retries` never appears in the sibling
+# serialization.py, so an encode/decode roundtrip silently drops it.
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class JobSpec:
+    name: str
+    queue: str
+    priority: int = 0
+    retries: int = 0

@@ -1,0 +1,15 @@
+# Good fixture (API03): complete roundtrip — zero findings.
+from .types import JobSpec
+
+
+def decode_job_spec(doc):
+    return JobSpec(
+        name=doc["name"],
+        queue=doc.get("queue", ""),
+        priority=int(doc.get("priority", 0)),
+        retries=int(doc.get("retries", 0)))
+
+
+def encode_job_spec(spec):
+    return {"name": spec.name, "queue": spec.queue,
+            "priority": spec.priority, "retries": spec.retries}

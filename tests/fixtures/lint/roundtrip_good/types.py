@@ -1,0 +1,10 @@
+# Good fixture (API03): every field round-trips.
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class JobSpec:
+    name: str
+    queue: str
+    priority: int = 0
+    retries: int = 0

@@ -1,0 +1,25 @@
+# Fixture: violations silenced by per-line suppression comments.
+# kueuelint must report ZERO findings here.
+import threading
+import time
+
+import jax
+
+
+@jax.jit
+def checked_sync(x):
+    # Deliberate: this kernel is only called from the debug CLI.
+    return x.item()  # kueuelint: disable=JIT01
+
+
+class Controller:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def reconcile(self):
+        with self._lock:
+            time.sleep(0.01)  # kueuelint: disable=LOCK01
+
+
+def legacy(batch=[]):  # kueuelint: disable
+    return batch

@@ -1,6 +1,8 @@
 """Fair sharing (KEP-1714): share values, fair admission ordering, fair
 preemption strategies."""
 
+import dataclasses
+
 import pytest
 
 from kueue_tpu import features
@@ -31,7 +33,8 @@ def fair_cq(name, cohort="co", cpu=4, weight=None, preemption=None):
                        reclaim_within_cohort="Any",
                        within_cluster_queue="LowerPriority"))
     if weight is not None:
-        spec.fair_sharing = FairSharing(weight=weight)
+        spec = dataclasses.replace(spec,
+                                   fair_sharing=FairSharing(weight=weight))
     return spec
 
 
@@ -246,7 +249,8 @@ def test_batch_solver_fair_shares_match_referee():
             f"cq-{i}",
             rg(("cpu",), fq("default", cpu=8), fq("spot", cpu=4)),
             cohort=cohort_name)
-        cq.fair_sharing = FairSharing(weight=float(rnd.choice([0, 1, 2, 4])))
+        cq = dataclasses.replace(cq, fair_sharing=FairSharing(
+            weight=float(rnd.choice([0, 1, 2, 4]))))
         fw.create_cluster_queue(cq)
         fw.create_local_queue(make_lq(f"lq-{i}", cq=f"cq-{i}"))
     for i in range(9):

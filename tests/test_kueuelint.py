@@ -1,0 +1,244 @@
+"""kueuelint (kueue_tpu.analysis) — tier-1 gate and analyzer unit tests.
+
+The headline test runs the analyzer over the kueue_tpu package itself and
+asserts zero error-severity findings: any PR that introduces a host sync in
+a jitted kernel, a blocking call under a lock, a retrace hazard, or an API
+hygiene violation fails tier-1 with a precise file:line report.
+"""
+
+from __future__ import annotations
+
+import json
+import shutil
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from kueue_tpu.analysis import Severity, all_rules, run_analysis
+from kueue_tpu.analysis.reporters import render_json, render_text
+
+REPO = Path(__file__).resolve().parent.parent
+PACKAGE = REPO / "kueue_tpu"
+FIXTURES = Path(__file__).resolve().parent / "fixtures" / "lint"
+
+
+def _rules_of(findings):
+    return {f.rule for f in findings}
+
+
+def _errors(findings):
+    return [f for f in findings if f.severity == Severity.ERROR]
+
+
+# ---------------------------------------------------------------------------
+# The gate: the package itself must be clean
+# ---------------------------------------------------------------------------
+
+
+def test_package_has_zero_error_findings():
+    findings = run_analysis([str(PACKAGE)])
+    errors = _errors(findings)
+    report = "\n".join(f.render() for f in errors)
+    assert not errors, f"kueuelint errors in kueue_tpu/:\n{report}"
+
+
+def test_cli_exits_zero_on_package():
+    proc = subprocess.run(
+        [sys.executable, "-m", "kueue_tpu.analysis", str(PACKAGE)],
+        cwd=str(REPO), capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "kueuelint:" in proc.stdout
+
+
+def test_cli_fails_on_introduced_violation(tmp_path):
+    # Simulate a PR dropping a host sync into a jitted kernel under models/.
+    bad_dir = tmp_path / "models"
+    bad_dir.mkdir()
+    shutil.copy(FIXTURES / "jit_bad.py", bad_dir / "new_kernel.py")
+    proc = subprocess.run(
+        [sys.executable, "-m", "kueue_tpu.analysis", str(tmp_path)],
+        cwd=str(REPO), capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 1
+    # Precise file:line:col report naming the rule.
+    assert "new_kernel.py:" in proc.stdout
+    assert "JIT01" in proc.stdout
+
+
+# ---------------------------------------------------------------------------
+# Rule families on good/bad fixture pairs
+# ---------------------------------------------------------------------------
+
+
+def test_jit_purity_bad_fixture():
+    findings = run_analysis([str(FIXTURES / "jit_bad.py")])
+    rules = _rules_of(findings)
+    assert {"JIT01", "JIT02", "JIT03"} <= rules
+    # Each family fires on the expected construct.
+    msgs = {f.rule: [] for f in findings}
+    for f in findings:
+        msgs[f.rule].append(f.message)
+    assert any(".item()" in m for m in msgs["JIT01"])
+    assert any("float" in m for m in msgs["JIT01"])
+    assert any("numpy" in m for m in msgs["JIT01"])
+    assert any("print" in m for m in msgs["JIT01"])
+    assert any("`if`" in m for m in msgs["JIT02"])
+    assert any("`while`" in m for m in msgs["JIT02"])
+    assert all(f.severity == Severity.ERROR for f in findings)
+
+
+def test_jit_purity_good_fixture():
+    assert run_analysis([str(FIXTURES / "jit_good.py")]) == []
+
+
+def test_retrace_bad_fixture():
+    findings = run_analysis([str(FIXTURES / "retrace_bad.py")])
+    rules = _rules_of(findings)
+    assert {"RET01", "RET02"} <= rules
+    ret01 = [f for f in findings if f.rule == "RET01"]
+    assert any("missing" in f.message for f in ret01)
+    assert any("out of range" in f.message for f in ret01)
+    assert any("list" in f.message.lower() for f in ret01)
+    # statics declared on a direct jax.jit(f, ...) call are seen too
+    assert any("`flag`" in f.message for f in ret01)
+    ret02 = [f for f in findings if f.rule == "RET02"]
+    captured = {f.message.split("`")[1] for f in ret02}
+    assert captured == {"scale", "offset"}
+    assert all(f.severity == Severity.WARNING for f in ret02)
+
+
+def test_retrace_good_fixture():
+    assert run_analysis([str(FIXTURES / "retrace_good.py")]) == []
+
+
+def test_lock_bad_fixture():
+    findings = run_analysis([str(FIXTURES / "lock_bad.py")])
+    rules = _rules_of(findings)
+    assert {"LOCK01", "LOCK02"} <= rules
+    lock01 = [f for f in findings if f.rule == "LOCK01"]
+    joined = " ".join(f.message for f in lock01)
+    assert "for_each" in joined          # parallelize fan-out under lock
+    assert "time.sleep" in joined
+    assert "subprocess" in joined
+    assert "wait()" in joined            # untimed Condition.wait
+    lock02 = [f for f in findings if f.rule == "LOCK02"]
+    assert any("_applied" in f.message for f in lock02)
+
+
+def test_lock_good_fixture():
+    assert run_analysis([str(FIXTURES / "lock_good.py")]) == []
+
+
+def test_api_bad_fixture():
+    findings = run_analysis([str(FIXTURES / "api_bad.py")])
+    rules = _rules_of(findings)
+    assert {"API01", "API02"} <= rules
+    api01 = [f for f in findings if f.rule == "API01"]
+    assert len(api01) == 2  # enqueue(batch=[]) and configure(opts={})
+    api02 = [f for f in findings if f.rule == "API02"]
+    assert any("FlavorRef" in f.message for f in api02)
+
+
+def test_api_good_fixture():
+    assert run_analysis([str(FIXTURES / "api_good.py")]) == []
+
+
+def test_roundtrip_fixture_pair():
+    bad = run_analysis([str(FIXTURES / "roundtrip_bad")])
+    assert _rules_of(bad) == {"API03"}
+    assert any("retries" in f.message for f in bad)
+    assert run_analysis([str(FIXTURES / "roundtrip_good")]) == []
+
+
+# ---------------------------------------------------------------------------
+# Suppressions, reporters, CLI plumbing
+# ---------------------------------------------------------------------------
+
+
+def test_suppression_comments_silence_findings():
+    assert run_analysis([str(FIXTURES / "suppressed.py")]) == []
+
+
+def test_suppression_is_rule_specific(tmp_path):
+    # A disable comment for a DIFFERENT rule must not silence the finding.
+    src = FIXTURES / "suppressed.py"
+    patched = src.read_text().replace("disable=JIT01", "disable=LOCK01")
+    target = tmp_path / "fixtures" / "lint" / "suppressed.py"
+    target.parent.mkdir(parents=True)
+    target.write_text(patched)
+    findings = run_analysis([str(target)])
+    assert "JIT01" in _rules_of(findings)
+
+
+def test_select_and_disable_filters():
+    bad = str(FIXTURES / "lock_bad.py")
+    only_lock01 = run_analysis([bad], select=["LOCK01"])
+    assert _rules_of(only_lock01) == {"LOCK01"}
+    no_lock01 = run_analysis([bad], disable=["LOCK01"])
+    assert "LOCK01" not in _rules_of(no_lock01)
+
+
+def test_json_reporter_schema():
+    findings = run_analysis([str(FIXTURES / "jit_bad.py")])
+    doc = json.loads(render_json(findings))
+    assert doc["tool"] == "kueuelint"
+    assert doc["counts"]["error"] == len(findings)
+    for item in doc["findings"]:
+        assert set(item) == {"rule", "severity", "path", "line", "col",
+                             "message"}
+        assert item["severity"] in ("error", "warning")
+        assert item["line"] >= 1
+
+
+def test_json_cli_roundtrip():
+    proc = subprocess.run(
+        [sys.executable, "-m", "kueue_tpu.analysis", "--format", "json",
+         str(FIXTURES / "api_bad.py")],
+        cwd=str(REPO), capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 1
+    doc = json.loads(proc.stdout)
+    assert doc["counts"]["error"] >= 1
+
+
+def test_text_reporter_format():
+    findings = run_analysis([str(FIXTURES / "api_bad.py")])
+    text = render_text(findings)
+    first = text.splitlines()[0]
+    # path:line:col: RULE [severity] message
+    assert first.count(":") >= 3
+    assert "[error]" in first
+    assert text.splitlines()[-1].startswith("kueuelint:")
+
+
+def test_fail_on_warning_escalates():
+    # retrace_bad has RET02 warnings; --fail-on warning must gate on them.
+    proc = subprocess.run(
+        [sys.executable, "-m", "kueue_tpu.analysis", "--fail-on", "warning",
+         "--select", "RET02", str(FIXTURES / "retrace_bad.py")],
+        cwd=str(REPO), capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 1
+
+
+def test_unknown_select_id_is_a_usage_error():
+    # A typo'd --select must NOT produce a clean exit-0 run.
+    proc = subprocess.run(
+        [sys.executable, "-m", "kueue_tpu.analysis", "--select", "LOCK1",
+         str(FIXTURES / "lock_bad.py")],
+        cwd=str(REPO), capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 2
+    assert "unknown rule id" in proc.stderr
+
+
+def test_rule_registry_covers_all_families():
+    ids = {r.id for r in all_rules()}
+    assert {"JIT01", "JIT02", "JIT03", "RET01", "RET02",
+            "LOCK01", "LOCK02", "API01", "API02", "API03"} <= ids
+
+
+def test_parse_error_is_reported(tmp_path):
+    broken = tmp_path / "broken.py"
+    broken.write_text("def oops(:\n")
+    findings = run_analysis([str(broken)])
+    assert _rules_of(findings) == {"PARSE"}
+    assert findings[0].severity == Severity.ERROR

@@ -2,6 +2,8 @@
 reference: controller-runtime informer plumbing + envtest-style integration
 suites in test/integration/controller/core/)."""
 
+import dataclasses
+
 import pytest
 
 from kueue_tpu import webhooks
@@ -74,8 +76,7 @@ class TestStore:
     def test_update_immutability(self):
         s = Store()
         s.create(KIND_CLUSTER_QUEUE, cq_obj())
-        changed = cq_obj()
-        changed.queueing_strategy = "StrictFIFO"
+        changed = dataclasses.replace(cq_obj(), queueing_strategy="StrictFIFO")
         with pytest.raises(webhooks.ValidationError):
             s.update(KIND_CLUSTER_QUEUE, changed)
 
