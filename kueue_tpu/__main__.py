@@ -227,6 +227,28 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             elector.step()
             if not elector.is_leader():
                 return 0  # hot standby: reconcile nothing (leader_aware)
+            if pending_journal[0] is not None:
+                # Deferred journal attach: replicas share ONE state dir,
+                # and the standby replays the (dead) leader's journal the
+                # moment it takes the lease — the reference rebuilding its
+                # caches from the apiserver on becoming leader
+                # (cache.go:295-328). The journal's exclusive flock may
+                # outlive a SIGKILLed leader for a moment; retry next tick
+                # rather than leading without state.
+                journal = pending_journal[0]
+                try:
+                    if runtime_lock is not None:
+                        with runtime_lock:
+                            replayed = journal.attach(store)
+                    else:
+                        replayed = journal.attach(store)
+                except RuntimeError as exc:
+                    print(f"journal attach deferred: {exc}",
+                          file=sys.stderr, flush=True)
+                    return 0
+                pending_journal[0] = None
+                print(f"took leadership; replayed {replayed} objects from "
+                      "the shared journal", file=sys.stderr, flush=True)
         if runtime_lock is not None:
             with runtime_lock:
                 return adapter.tick()
@@ -240,6 +262,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         try:
             while True:
                 total_admitted += tick_once()
+                # Idle-window bucket prewarm: imminent head-count bucket
+                # rotations compile here, never inside the tick.
+                fw.prewarm_idle()
                 now = time.monotonic()
                 if now - last_gauges >= 5.0:
                     last_gauges = now

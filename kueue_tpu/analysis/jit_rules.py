@@ -28,7 +28,8 @@ from kueue_tpu.analysis.core import (
     AnalysisContext, Finding, Rule, Severity, SourceFile, dotted_name,
     finding, register)
 
-_JIT_PATHS = ("models/", "ops/", "solver/", "parallel/", "fixtures/lint/")
+_JIT_PATHS = ("models/", "ops/", "solver/", "parallel/", "topology/",
+              "fixtures/lint/")
 
 # Names whose call result is host-side static even when fed a tracer.
 _UNTAINT_CALLS = {"len", "isinstance", "type", "getattr", "hasattr"}

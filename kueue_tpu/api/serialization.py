@@ -132,6 +132,17 @@ def _node_affinity_terms(spec: Mapping[str, Any]) -> Tuple[Tuple[MatchExpression
 
 # -- kueue kinds -------------------------------------------------------------
 
+def _topology_spec(doc: Optional[Mapping[str, Any]]):
+    if doc is None:
+        return None
+    from kueue_tpu.api.types import TopologyLeaf, TopologySpec
+    return TopologySpec(
+        levels=tuple(doc.get("levels") or ()),
+        leaves=tuple(TopologyLeaf(path=tuple(l.get("path") or ()),
+                                  capacity=int(l.get("capacity", 1)))
+                     for l in doc.get("leaves") or ()))
+
+
 def decode_resource_flavor(doc: Mapping[str, Any]) -> ResourceFlavor:
     name, _ = _meta(doc)
     spec = doc.get("spec") or {}
@@ -139,7 +150,8 @@ def decode_resource_flavor(doc: Mapping[str, Any]) -> ResourceFlavor:
         name,
         node_labels=spec.get("nodeLabels"),
         node_taints=_taints(spec.get("nodeTaints")),
-        tolerations=_tolerations(spec.get("tolerations")))
+        tolerations=_tolerations(spec.get("tolerations")),
+        topology=_topology_spec(spec.get("topologySpec")))
 
 
 def _flavor_quotas(doc: Mapping[str, Any]) -> FlavorQuotas:
@@ -233,6 +245,7 @@ def decode_workload(doc: Mapping[str, Any]) -> Workload:
     for ps in spec.get("podSets") or ():
         template = _pod_template(ps.get("template"))
         ps_spec = (ps.get("template") or {}).get("spec") or {}
+        topo_req = ps.get("topologyRequest") or {}
         pod_sets.append(PodSet(
             name=ps.get("name", "main"),
             count=int(ps.get("count", 1)),
@@ -242,6 +255,8 @@ def decode_workload(doc: Mapping[str, Any]) -> Workload:
                 (ps_spec.get("nodeSelector") or {}).items())),
             affinity_terms=_node_affinity_terms(ps_spec),
             tolerations=_tolerations(ps_spec.get("tolerations")),
+            topology_required=topo_req.get("required"),
+            topology_preferred=topo_req.get("preferred"),
             template=template))
     return Workload(
         name=name, namespace=namespace,
@@ -356,15 +371,22 @@ def _encode_match_expressions(exprs) -> List[Dict[str, Any]]:
 
 
 def encode_resource_flavor(rf: ResourceFlavor) -> Dict[str, Any]:
+    spec: Dict[str, Any] = {
+        "nodeLabels": dict(rf.node_labels),
+        "nodeTaints": [{"key": t.key, "value": t.value,
+                        "effect": t.effect} for t in rf.node_taints],
+        "tolerations": _encode_tolerations(rf.tolerations),
+    }
+    if rf.topology is not None:
+        spec["topologySpec"] = {
+            "levels": list(rf.topology.levels),
+            "leaves": [{"path": list(leaf.path), "capacity": leaf.capacity}
+                       for leaf in rf.topology.leaves],
+        }
     return {
         "apiVersion": API_VERSION, "kind": "ResourceFlavor",
         "metadata": {"name": rf.name},
-        "spec": {
-            "nodeLabels": dict(rf.node_labels),
-            "nodeTaints": [{"key": t.key, "value": t.value,
-                            "effect": t.effect} for t in rf.node_taints],
-            "tolerations": _encode_tolerations(rf.tolerations),
-        },
+        "spec": spec,
     }
 
 
@@ -475,6 +497,10 @@ def _encode_pod_set(ps: PodSet) -> Dict[str, Any]:
                            "template": {"spec": spec}}
     if ps.min_count is not None:
         out["minCount"] = ps.min_count
+    if ps.topology_required is not None:
+        out["topologyRequest"] = {"required": ps.topology_required}
+    elif ps.topology_preferred is not None:
+        out["topologyRequest"] = {"preferred": ps.topology_preferred}
     return out
 
 
@@ -485,16 +511,27 @@ def _encode_conditions(conditions) -> List[Dict[str, Any]]:
             for c in conditions]
 
 
+def _encode_psa(a) -> Dict[str, Any]:
+    out: Dict[str, Any] = {
+        "name": a.name, "flavors": dict(a.flavors),
+        "resourceUsage": _encode_requests(a.resource_usage),
+        "count": a.count}
+    ta = a.topology_assignment
+    if ta is not None:
+        out["topologyAssignment"] = {
+            "flavor": ta.flavor, "levels": list(ta.levels),
+            "domain": list(ta.domain),
+            "counts": [[i, n] for i, n in ta.counts]}
+    return out
+
+
 def encode_workload_status(wl: Workload) -> Dict[str, Any]:
     status: Dict[str, Any] = {"conditions": _encode_conditions(wl.conditions)}
     if wl.admission is not None:
         status["admission"] = {
             "clusterQueue": wl.admission.cluster_queue,
             "podSetAssignments": [
-                {"name": a.name, "flavors": dict(a.flavors),
-                 "resourceUsage": _encode_requests(a.resource_usage),
-                 "count": a.count}
-                for a in wl.admission.pod_set_assignments],
+                _encode_psa(a) for a in wl.admission.pod_set_assignments],
         }
     if wl.admission_check_states:
         status["admissionChecks"] = [
@@ -535,7 +572,16 @@ def decode_workload_status(doc: Mapping[str, Any], wl: Workload) -> Workload:
     client side of encode_workload_status)."""
     from kueue_tpu.api.types import (
         Admission, AdmissionCheckState, Condition, PodSetAssignment,
-        RequeueState)
+        RequeueState, TopologyAssignment)
+
+    def _topology_assignment(d):
+        if not d:
+            return None
+        return TopologyAssignment(
+            flavor=d.get("flavor", ""),
+            levels=tuple(d.get("levels") or ()),
+            domain=tuple(d.get("domain") or ()),
+            counts=tuple((int(i), int(n)) for i, n in d.get("counts") or ()))
 
     status = doc.get("status") or {}
     wl.conditions = [
@@ -552,7 +598,9 @@ def decode_workload_status(doc: Mapping[str, Any], wl: Workload) -> Workload:
                     name=a.get("name", "main"),
                     flavors=dict(a.get("flavors") or {}),
                     resource_usage=_requests(a.get("resourceUsage")),
-                    count=int(a.get("count", 0)))
+                    count=int(a.get("count", 0)),
+                    topology_assignment=_topology_assignment(
+                        a.get("topologyAssignment")))
                 for a in adm.get("podSetAssignments") or ()])
     wl.admission_check_states = {
         s["name"]: AdmissionCheckState(

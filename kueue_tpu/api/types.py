@@ -198,8 +198,67 @@ class Toleration:
 
 
 # ---------------------------------------------------------------------------
-# ResourceFlavor
+# ResourceFlavor + topology (slice/rack/host placement hierarchy)
 # ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class TopologyLeaf:
+    """One lowest-level topology domain (e.g. a host): its path through the
+    levels (one value per level, top -> bottom) and its pod-slot capacity."""
+
+    path: Tuple[str, ...]
+    capacity: int
+
+
+@dataclass(frozen=True)
+class TopologySpec:
+    """Per-flavor placement hierarchy (Kueue Topology-Aware Scheduling).
+
+    `levels` names the domain levels top -> bottom (e.g. ("block", "rack",
+    "host")); `leaves` enumerate the lowest-level domains with per-leaf pod
+    capacity. A domain at level l is the set of leaves sharing path[:l+1].
+    TPU pods are only fast when a PodSet lands inside one contiguous
+    domain, which is what `PodSet.topology_required/preferred` ask for.
+    """
+
+    levels: Tuple[str, ...]
+    leaves: Tuple[TopologyLeaf, ...] = ()
+
+    @staticmethod
+    def uniform(levels: Sequence[str], counts: Sequence[int],
+                leaf_capacity: int) -> "TopologySpec":
+        """A regular tree: counts[i] children per node at level i.
+        uniform(("block","rack","host"), (2,2,4), 8) -> 16 hosts of 8 slots."""
+        if len(levels) != len(counts):
+            raise ValueError("levels and counts must have the same length")
+        paths = [()]
+        for level, n in zip(levels, counts):
+            paths = [p + (f"{level}{i}",) for p in paths for i in range(n)]
+        return TopologySpec(
+            levels=tuple(levels),
+            leaves=tuple(TopologyLeaf(path=p, capacity=leaf_capacity)
+                         for p in paths))
+
+    def level_index(self, name: str) -> Optional[int]:
+        try:
+            return self.levels.index(name)
+        except ValueError:
+            return None
+
+    def domain_free(self, used: Sequence[int],
+                    level: int) -> Dict[Tuple[str, ...], int]:
+        """Free pod-slot capacity per domain at `level`, given per-leaf
+        occupancy (spec.leaves order; missing/short sequences read as
+        empty). The ONE string-world home of leaf->domain aggregation —
+        metrics and the preemption victim preference both read it (the
+        solver path has its own dense-tensor twin in topology/fit.py)."""
+        out: Dict[Tuple[str, ...], int] = {}
+        for i, leaf in enumerate(self.leaves):
+            u = int(used[i]) if i < len(used) else 0
+            key = leaf.path[:level + 1]
+            out[key] = out.get(key, 0) + max(leaf.capacity - u, 0)
+        return out
 
 
 @dataclass(frozen=True)
@@ -208,16 +267,21 @@ class ResourceFlavor:
     node_labels: Tuple[Tuple[str, str], ...] = ()
     node_taints: Tuple[Taint, ...] = ()
     tolerations: Tuple[Toleration, ...] = ()
+    # Optional placement hierarchy; None = topology-blind flavor (every
+    # existing code path is then byte-identical to the pre-topology build).
+    topology: Optional[TopologySpec] = None
 
     @staticmethod
     def make(name: str, node_labels: Optional[Mapping[str, str]] = None,
              node_taints: Sequence[Taint] = (),
-             tolerations: Sequence[Toleration] = ()) -> "ResourceFlavor":
+             tolerations: Sequence[Toleration] = (),
+             topology: Optional[TopologySpec] = None) -> "ResourceFlavor":
         return ResourceFlavor(
             name=name,
             node_labels=tuple(sorted((node_labels or {}).items())),
             node_taints=tuple(node_taints),
             tolerations=tuple(tolerations),
+            topology=topology,
         )
 
     @property
@@ -373,6 +437,12 @@ class PodSet:
     # Required node-affinity terms: OR of terms, each term an AND of expressions.
     affinity_terms: Tuple[Tuple[MatchExpression, ...], ...] = ()
     tolerations: Tuple[Toleration, ...] = ()
+    # Topology request (TAS): all pods must land within ONE domain at this
+    # level of the assigned flavor's topology (`topology_required`), or
+    # best-effort pack there, falling back up the hierarchy and finally to
+    # unconstrained placement (`topology_preferred`). At most one is set.
+    topology_required: Optional[str] = None
+    topology_preferred: Optional[str] = None
     # Optional full template; when set, `requests` is derived from it by
     # workload.adjust_resources (pkg/workload/resources.go).
     template: Optional[PodTemplate] = None
@@ -382,6 +452,8 @@ class PodSet:
              node_selector: Optional[Mapping[str, str]] = None,
              affinity_terms: Sequence[Sequence[MatchExpression]] = (),
              tolerations: Sequence[Toleration] = (),
+             topology_required: Optional[str] = None,
+             topology_preferred: Optional[str] = None,
              **requests: Quantity) -> "PodSet":
         reqs = {r.replace("_", "-"): resource_value(r.replace("_", "-"), q)
                 for r, q in requests.items()}
@@ -390,6 +462,8 @@ class PodSet:
             node_selector=tuple(sorted((node_selector or {}).items())),
             affinity_terms=tuple(tuple(t) for t in affinity_terms),
             tolerations=tuple(tolerations),
+            topology_required=topology_required,
+            topology_preferred=topology_preferred,
         )
 
 
@@ -473,12 +547,31 @@ class Condition:  # kueuelint: disable=API02
     last_transition_time: float = 0.0
 
 
+@dataclass(frozen=True)
+class TopologyAssignment:
+    """The topology domain a PodSet was packed into at admission.
+
+    `levels`/`domain` identify the chosen domain (a prefix of the flavor's
+    topology levels and the matching path values); `counts` records the
+    per-leaf pod distribution as (leaf index into the flavor's
+    TopologySpec.leaves, pods) pairs — what the ledger charges and
+    releases."""
+
+    flavor: str
+    levels: Tuple[str, ...]
+    domain: Tuple[str, ...]
+    counts: Tuple[Tuple[int, int], ...]
+
+
 @dataclass
 class PodSetAssignment:
     name: str
     flavors: Dict[str, str]  # resource -> flavor name
     resource_usage: Dict[str, int]  # per-pod-set totals
     count: int
+    # Set when the podset carried a topology request and the assigned
+    # flavor declares a topology (None otherwise).
+    topology_assignment: Optional[TopologyAssignment] = None
 
 
 @dataclass

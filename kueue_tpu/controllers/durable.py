@@ -65,8 +65,10 @@ class Journal:
 
         The journal is SINGLE-WRITER: an exclusive flock is held for its
         lifetime, so a second process attaching the same path fails fast
-        instead of corrupting it (HA replicas use per-replica state dirs
-        and share only the lease file — see --lease-file)."""
+        instead of corrupting it. HA replicas share ONE state dir (the
+        etcd analog) but DEFER the attach until they hold the leader
+        lease (__main__.tick_once) — the standby replays the dead
+        leader's journal at takeover and only then becomes the writer."""
         import fcntl
 
         os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
@@ -80,8 +82,9 @@ class Journal:
             owner.close()
             raise RuntimeError(
                 f"state journal {self.path} is owned by another process "
-                "(journals are single-writer; give each replica its own "
-                "--state-dir and share only --lease-file)")
+                "(journals are single-writer; an elected replica attaches "
+                "only after taking the lease, so this clears once the "
+                "previous owner exits)")
         with self._lock:
             self._owner_lock_file = owner
         self._store = store

@@ -630,8 +630,29 @@ class Framework:
             for name, cq in snap.cluster_queues.items():
                 REGISTRY.cluster_queue_fair_share.set(
                     name, value=dominant_resource_share(cq)[0])
+        self._record_topology_metrics()
         if self.config.metrics.enable_cluster_queue_resources:
             self._record_resource_metrics()
+
+    def _record_topology_metrics(self) -> None:
+        """topology_fragmentation per (flavor, level): how shredded the
+        free pod-slot capacity is across that level's domains. Stale
+        series (flavor deleted / topology dropped) prune away."""
+        ledger = self.cache.topology
+        live = set()
+        for fname, used in ledger.flavors.items():
+            rf = self.cache.resource_flavors.get(fname)
+            spec = rf.topology if rf is not None else None
+            if spec is None:
+                continue
+            for li, level in enumerate(spec.levels):
+                dom_free = spec.domain_free(used, li)
+                total = sum(dom_free.values())
+                frag = 0.0 if total <= 0 \
+                    else 1.0 - max(dom_free.values()) / total
+                REGISTRY.topology_fragmentation.set(fname, level, value=frag)
+                live.add((fname, level))
+        REGISTRY.topology_fragmentation.prune(lambda key: key in live)
 
     def _record_resource_metrics(self) -> None:
         """Optional per-CQ quota gauges (metrics.enableClusterQueueResources;
@@ -802,6 +823,12 @@ class Framework:
         REGISTRY.tick_phase_seconds.observe(
             "reconcile", value=_time.perf_counter() - t_r)
         return admitted
+
+    def prewarm_idle(self) -> int:
+        """Compile any imminent head-count-bucket rotations NOW — call in
+        the idle gap between ticks (the serve loop does; so does the
+        bench's completion-flux slot). Keeps XLA compiles out of ticks."""
+        return self.scheduler.prewarm_idle()
 
     def run_until_settled(self, max_ticks: int = 100) -> int:
         """Tick until no progress is made; returns total admissions."""

@@ -318,6 +318,28 @@ class StoreAdapter:
                 self.fw.restore_workload(ev.obj)
             else:
                 self.fw.submit(ev.obj)
+        elif ev.type == MODIFIED:
+            cur = self.fw.workloads.get(ev.key)
+            if cur is ev.obj:
+                return  # our own status publish round-tripping
+            # Shared-journal takeover replay (the standby attaching the
+            # dead leader's journal) — the only source of MODIFIED events
+            # carrying a DIFFERENT object (live status syncs publish the
+            # framework's own instance, caught above). The recorded state
+            # supersedes whatever this replica holds: REBUILD from it
+            # (cache.go:295-328 semantics), never re-admit through the
+            # scheduler. This must also process finish/evict transitions —
+            # a replayed admitted-then-finished history would otherwise
+            # leave the finished workload charging quota (and topology
+            # slots) forever on the standby.
+            if cur is not None:
+                self.fw.delete_workload(cur)
+            if ev.obj.is_finished or ev.obj.has_quota_reservation:
+                self.fw.restore_workload(ev.obj)
+            elif ev.obj.active:
+                self.fw.submit(ev.obj)
+            else:
+                self.fw.workloads[ev.key] = ev.obj  # deactivated: record
         elif ev.type == DELETED:
             self.fw.delete_workload(ev.obj)
 

@@ -483,6 +483,11 @@ class Cache:
         # workload scan under the cache lock.
         self._lq_stats: Dict[str, dict] = {}
         self.assumed_workloads: Dict[str, str] = {}  # wl key -> cq name
+        # Topology leaf occupancy (kueue_tpu/topology): empty (and
+        # zero-overhead on every path below) until a ResourceFlavor
+        # declares a TopologySpec.
+        from kueue_tpu.topology.state import TopologyLedger
+        self.topology = TopologyLedger()
         # Bumped on every *structural* change (ClusterQueue specs, cohort
         # specs, flavors) but NOT on workload churn. The batched solver's
         # ClusterQueue encoding and the incremental snapshot key on this
@@ -515,6 +520,7 @@ class Cache:
         with self._lock:
             self.structure_version += 1
             self.resource_flavors[flavor.name] = flavor
+            self.topology.set_flavor(flavor)
             for cq in self.cluster_queues.values():
                 cq.update_with_flavors(self.resource_flavors)
 
@@ -522,6 +528,7 @@ class Cache:
         with self._lock:
             self.structure_version += 1
             self.resource_flavors.pop(name, None)
+            self.topology.drop_flavor(name)
             for cq in self.cluster_queues.values():
                 cq.update_with_flavors(self.resource_flavors)
 
@@ -691,6 +698,8 @@ class Cache:
             wi = WorkloadInfo(wl, cluster_queue=cq.name)
             cq.add_workload_usage(wi, admitted=wl.is_admitted)
             self._lq_note(wi, 1)
+            if self.topology.flavors:
+                self.topology.charge(wl.admission, 1)
             return True
 
     def delete_workload(self, wl: Workload) -> Optional[WorkloadInfo]:
@@ -714,6 +723,8 @@ class Cache:
             wi = cq.workloads[key]
             cq.remove_workload_usage(wi, admitted=wl.is_admitted)
             self._lq_note(wi, -1)
+            if self.topology.flavors:
+                self.topology.charge(wl.admission, -1)
             # Quota was freed: resume states against this CQ are now stale.
             cq.allocatable_generation += 1
             released = wi
@@ -738,6 +749,8 @@ class Cache:
             cq.add_workload_usage(wi, admitted=adm)
             self._lq_note(wi, 1, adm)
             self.assumed_workloads[key] = cq.name
+            if self.topology.flavors:
+                self.topology.charge(wl.admission, 1)
             return wi
 
     def assume_workloads(self, items, fast: bool = False) -> list:
@@ -768,11 +781,16 @@ class Cache:
         with self._lock:
             if fast and _ledger is not None \
                     and getattr(_ledger, "assume_batch", None) is not None:
+                items = items if isinstance(items, list) else list(items)
                 _ledger.assume_batch(
                     self.cluster_queues, self.assumed_workloads,
-                    self.local_queues, self._lq_stats,
-                    items if isinstance(items, list) else list(items), out)
+                    self.local_queues, self._lq_stats, items, out)
+                if self.topology.flavors:
+                    for (wl, _, _, _), res in zip(items, out):
+                        if not isinstance(res, str):
+                            self.topology.charge(wl.admission, 1)
                 return out
+            charge_topo = bool(self.topology.flavors)
             for wl, triples, info, admitted in items:
                 if wl.admission is None:
                     out.append("workload has no admission")
@@ -796,6 +814,8 @@ class Cache:
                 cq.add_workload_usage(wi, admitted=adm)
                 self._lq_note(wi, 1, adm)
                 self.assumed_workloads[key] = cq.name
+                if charge_topo:
+                    self.topology.charge(wl.admission, 1)
                 out.append(wi)
         return out
 

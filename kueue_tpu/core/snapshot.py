@@ -30,7 +30,7 @@ _ledger = native_ledger.load()
 
 class Snapshot:
     __slots__ = ("cluster_queues", "resource_flavors",
-                 "inactive_cluster_queues", "structure_version")
+                 "inactive_cluster_queues", "structure_version", "topology")
 
     def __init__(self):
         self.cluster_queues: Dict[str, CachedClusterQueue] = {}
@@ -39,12 +39,17 @@ class Snapshot:
         # Cache.structure_version at build time: the cheap invalidation key
         # for anything derived from specs (e.g. the solver's CQ encoding).
         self.structure_version = 0
+        # Frozen topology leaf occupancy ({flavor: leaf_used}) when any
+        # flavor declares a TopologySpec; None otherwise (the no-op gate).
+        self.topology = None
 
     @staticmethod
     def build(cache: Cache) -> "Snapshot":
         snap = Snapshot()
         snap.structure_version = cache.structure_version
         snap.resource_flavors = dict(cache.resource_flavors)
+        if cache.topology.flavors:
+            snap.topology = cache.topology.view()
         for name, cq in cache.cluster_queues.items():
             if not cq.active():
                 snap.inactive_cluster_queues.add(name)
@@ -204,6 +209,8 @@ class SnapshotMirror:
         # value at completion means the snapshot moved under the in-flight
         # solve and FIT decisions must be re-validated.
         self.mutation_count = 0
+        # Ledger version last mirrored into the snapshot's topology view.
+        self._topo_version: Optional[int] = None
 
     def detach(self) -> None:
         """Unsubscribe from the cache's dirty marks. Call when retiring a
@@ -231,9 +238,18 @@ class SnapshotMirror:
             self._key = key
             self._base = {name: cq.usage_version
                           for name, cq in cache.cluster_queues.items()}
+            self._topo_version = cache.topology.version
             return self._snap
 
         snap = self._snap
+        if cache.topology.flavors or snap.topology is not None:
+            # Topology leaf occupancy re-copies only when the ledger moved
+            # (admissions/releases bearing topology assignments); the view
+            # is a handful of small arrays.
+            if self._topo_version != cache.topology.version:
+                snap.topology = (cache.topology.view()
+                                 if cache.topology.flavors else None)
+                self._topo_version = cache.topology.version
         self.flush_pending()
         dirty_cohorts: Dict[str, Cohort] = {}
         dirty_names = self._dirty

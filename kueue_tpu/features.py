@@ -18,6 +18,10 @@ MULTI_KUEUE = "MultiKueue"
 LENDING_LIMIT = "LendingLimit"
 # Greenfield (KEP-1714 / KEP-79): implemented natively by this framework.
 FAIR_SHARING = "FairSharing"
+# Topology-aware scheduling (slice/rack-packed admission): active only
+# when a ResourceFlavor declares a TopologySpec, so the default-on gate
+# is still a provable no-op on topology-free clusters.
+TOPOLOGY_AWARE_SCHEDULING = "TopologyAwareScheduling"
 
 _DEFAULTS: Dict[str, bool] = {
     PARTIAL_ADMISSION: True,
@@ -29,6 +33,7 @@ _DEFAULTS: Dict[str, bool] = {
     MULTI_KUEUE: False,
     LENDING_LIMIT: False,
     FAIR_SHARING: False,
+    TOPOLOGY_AWARE_SCHEDULING: True,
 }
 
 _gates: Dict[str, bool] = dict(_DEFAULTS)

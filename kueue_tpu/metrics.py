@@ -204,6 +204,14 @@ class Registry:
             p + "tick_phase_seconds",
             "Per-phase tick latency (snapshot/tensorize/solve/apply)",
             ("phase",))
+        # Topology-aware scheduling: free-capacity fragmentation per
+        # (flavor, level) — 1 - largest free domain / total free slots.
+        # 0 = all free capacity sits in one domain (any fitting podset can
+        # pack); ->1 = free slots are shredded across domains.
+        self.topology_fragmentation = Gauge(
+            p + "topology_fragmentation",
+            "Free-slot fragmentation per flavor topology level",
+            ("flavor", "level"))
 
     def all_metrics(self) -> Iterable[_Metric]:
         return [v for v in vars(self).values() if isinstance(v, _Metric)]

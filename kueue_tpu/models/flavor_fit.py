@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import functools
 import os
+import threading
 from typing import Dict, List, Optional, Sequence
 
 import jax
@@ -677,6 +678,22 @@ class BatchSolver:
         self._row_cache: Optional[sch.WorkloadRowCache] = None
         self._preempt_ctx = None
         self._mesh = mesh
+        # Compile-proofing (VERDICT r5 Weak #2): every padded solve shape
+        # compiles once; a head-count bucket rotation mid-run must not
+        # land that compile inside a measured tick. `_warm_keys` tracks
+        # shapes already compiled (cold_dispatches counts the misses — the
+        # regression test's assertion). When the live head count drifts
+        # within 1/8 bucket of a rotation boundary, `_maybe_prewarm`
+        # QUEUES the neighbor bucket and `prewarm_idle()` (called from the
+        # scheduler's idle window — the serve loop's inter-tick gap, the
+        # bench's churn slot) compiles it synchronously OFF the measured
+        # path. No background thread: on small hosts a concurrent XLA
+        # compile contends with the measured tick and moves the very p99
+        # this exists to protect.
+        self._warm_keys: set = set()
+        self._warm_lock = threading.Lock()
+        self._prewarm_pending: set = set()
+        self.cold_dispatches = 0
         # Optional XLA profiler hook (SURVEY §5): point TensorBoard at this
         # port to trace the device solves.
         port = os.environ.get("KUEUE_XLA_PROFILER_PORT")
@@ -706,6 +723,13 @@ class BatchSolver:
             # Row cache indices/eligibility are relative to the encoding.
             self._row_cache = sch.WorkloadRowCache()
             self._preempt_ctx = None
+            # The jit cache keys on the static arrays' SHAPES too ([C,F,R]
+            # etc.): a structural change can rotate those, so every
+            # previously-warm bucket may recompile — reset the warm set so
+            # cold_dispatches stays truthful and prewarm re-queues.
+            with self._warm_lock:
+                self._warm_keys.clear()
+                self._prewarm_pending.clear()
             self._key = key
         return self._enc
 
@@ -844,6 +868,16 @@ class BatchSolver:
             out = None
             handle = solve_flavor_fit_async(enc, usage, wt,
                                             static=self._static)
+            W, P, R = wt.req.shape
+            C, F = enc.nominal.shape[0], enc.nominal.shape[1]
+            key = (W, P, R, wt.resume_slot.shape[2], enc.num_cohorts,
+                   enc.num_slots,
+                   features.enabled(features.FLAVOR_FUNGIBILITY), C, F)
+            with self._warm_lock:
+                if key not in self._warm_keys:
+                    self.cold_dispatches += 1
+                    self._warm_keys.add(key)
+            self._maybe_prewarm(key, wt.num_real)
         t1 = _t.perf_counter()
         phases.observe("tensorize", value=t1 - t0)
         phases.observe("tensorize.refresh", value=ta - t0)
@@ -852,6 +886,96 @@ class BatchSolver:
         return {"workloads": list(workloads), "snapshot": snapshot,
                 "enc": enc, "wt": wt, "handle": handle, "out": out,
                 "dispatched": t1}
+
+    # -- bucket prewarm (compile-proof ticks) -------------------------------
+
+    # Auto-prewarm only buckets up to this width (KUEUE_PREWARM_MAX_BUCKET
+    # overrides). Rotation compile cliffs hurt most at small/medium shapes
+    # (the smoke-shape p99 was 300x p50 on a rotation); very wide buckets
+    # are half-a-bucket wide and rarely rotate, while their background
+    # compile is expensive enough to contend with measured ticks on small
+    # hosts. Explicit Scheduler.prewarm covers known large shapes.
+    PREWARM_MAX_BUCKET = int(
+        os.environ.get("KUEUE_PREWARM_MAX_BUCKET", "512"))
+
+    def _maybe_prewarm(self, key: tuple, n_real: int) -> None:
+        """Queue neighbor head-count buckets for idle compilation when a
+        rotation is imminent: n within 1/8 bucket of the grow boundary (W)
+        or of the shrink boundary (W/2)."""
+        W = key[0]
+        targets = []
+        if n_real >= W - max(1, W // 8) and W * 2 <= self.PREWARM_MAX_BUCKET:
+            targets.append(W * 2)
+        if W > 8 and n_real <= W // 2 + max(1, W // 8):
+            targets.append(W // 2)
+        for Wn in targets:
+            nkey = (Wn,) + key[1:]
+            with self._warm_lock:
+                if nkey not in self._warm_keys:
+                    self._prewarm_pending.add(nkey)
+
+    def prewarm_idle(self) -> int:
+        """Compile any queued neighbor buckets NOW (synchronously) — call
+        from the idle window between ticks (Scheduler.prewarm_idle /
+        Framework.prewarm_idle), so the compile lands in the jit cache
+        before the rotated tick dispatches and never inside a measured
+        tick. Returns how many shapes were compiled."""
+        done = 0
+        while True:
+            with self._warm_lock:
+                if not self._prewarm_pending:
+                    return done
+                nkey = self._prewarm_pending.pop()
+                if nkey in self._warm_keys:
+                    continue
+            self._prewarm_one(nkey)
+            done += 1
+
+    def _prewarm_one(self, nkey: tuple) -> None:
+        """Compile the packed solve kernel for one bucket shape (an
+        all-zeros buffer — compilation depends only on shapes/dtypes).
+        A failed compile does NOT mark the shape warm — the real dispatch
+        would compile in-tick, and cold_dispatches must say so."""
+        try:
+            W, P, R, G, K, S, fung = nkey[:7]
+            static = self._static
+            C, F = static[0].shape[0], static[0].shape[1]
+            nb = ((C * F * R + W * P * R) * 8 + (W + W * P * G) * 4
+                  + W * P * R + 2 * W * P + W * P * G * S)
+            out = _solve_kernel_packed(
+                *static, jnp.zeros(nb, dtype=jnp.uint8),
+                num_slots=S, shapes=(W, P, R, G, K),
+                fungibility_enabled=fung)
+            jax.block_until_ready(out)
+        except Exception:
+            return
+        with self._warm_lock:
+            self._warm_keys.add(nkey)
+
+    def warmup(self, snapshot: Snapshot, head_counts: Sequence[int],
+               podsets: int = 1) -> None:
+        """Synchronously compile the solve for the given head-count
+        buckets against this snapshot's structure — the scheduler warmup
+        hook (Scheduler.prewarm) calls this at attach/startup so the first
+        real ticks of each expected bucket are compile-free."""
+        if self._mesh is not None:
+            return
+        enc = self._encoding_for(snapshot)
+        fung = features.enabled(features.FLAVOR_FUNGIBILITY)
+        R = len(enc.resource_names)
+        C, F = enc.nominal.shape[0], enc.nominal.shape[1]
+        done = set()
+        for hc in head_counts:
+            W = sch._pad_pow2(max(int(hc), 1))
+            key = (W, max(podsets, 1), R, enc.num_groups, enc.num_cohorts,
+                   enc.num_slots, fung, C, F)
+            if key in done:
+                continue
+            done.add(key)
+            with self._warm_lock:
+                if key in self._warm_keys:
+                    continue
+            self._prewarm_one(key)
 
     def collect(self, inflight: dict) -> List[Assignment]:
         """Fetch + decode a solve dispatched by solve_async."""

@@ -68,10 +68,15 @@ def _rescale_int32(p: ps.Problem):
 
     def scale(a, sentinel_mask=None):
         out = a // g
+        # Range-check only the REAL entries: the sentinel itself is 2^30,
+        # so checking after masking rejected every problem carrying an
+        # undefined quota/limit — which made the Pallas path unreachable
+        # dead code (every call fell back to the XLA scan).
+        real = out if sentinel_mask is None else out[~sentinel_mask]
+        if real.max(initial=0) >= 2**30:
+            return None
         if sentinel_mask is not None:
             out = np.where(sentinel_mask, I32_SENTINEL, out)
-        if out.max(initial=0) >= 2**30:
-            return None
         return out.astype(np.int32)
 
     usage0 = scale(p.usage0)
@@ -107,10 +112,13 @@ def _kernel(cand_y, cand_prio, scalars,          # scalar-prefetch (SMEM)
     @pl.when(s == 0)
     def _init():
         U[:, :] = usage0[:, :]
+        # Literal writes must be int32: under x64 a bare Python int traces
+        # as (weak) int64, and the SMEM ref discharge rejects the mixed
+        # dtypes.
         flags[0] = allow_b0
-        flags[1] = 0
+        flags[1] = jnp.int32(0)
         flags[2] = n
-        flags[3] = 0
+        flags[3] = jnp.int32(0)
 
     y = cand_y[i]
     prio = cand_prio[i]
@@ -133,9 +141,23 @@ def _kernel(cand_y, cand_prio, scalars,          # scalar-prefetch (SMEM)
             True).all()
         return own_ok & jnp.logical_or(has_cohort == 0, cohort_ok)
 
-    row = pl.load(U, (pl.ds(y, 1), slice(None)))           # [1,128]
-    nom_row = pl.load(nominal, (pl.ds(y, 1), slice(None)))
-    qd_row = pl.load(q_def, (pl.ds(y, 1), slice(None)))
+    # Dynamic row select/update as one-hot masked ops over the (<=8-row)
+    # member axis: a traced-int32 pl.ds start mixes with literal int64
+    # starts in x64 interpret mode, and a full-array VPU select is at
+    # least as fast at these shapes on real hardware anyway.
+    ypad = U.shape[0]
+    row_ids = jax.lax.broadcasted_iota(jnp.int32, (ypad, LANES), 0)
+    sel = row_ids == y                                      # [ypad,128]
+
+    def row_of(arr):
+        # dtype pinned: under x64 an int32 sum would promote to int64 and
+        # poison every downstream ref write.
+        return jnp.where(sel, arr[:, :], 0).sum(
+            axis=0, keepdims=True, dtype=jnp.int32)
+
+    row = row_of(U)                                         # [1,128]
+    nom_row = row_of(nominal)
+    qd_row = row_of(q_def)
     use_row = cand_use[:, :]                                # block [1,128]
 
     @pl.when(jnp.logical_not(phase2))
@@ -150,7 +172,7 @@ def _kernel(cand_y, cand_prio, scalars,          # scalar-prefetch (SMEM)
                 & (prio >= threshold))
         flags[0] = jnp.where(flip, 0, flags[0])
         new_row = row - jnp.where(act, use_row, 0)
-        pl.store(U, (pl.ds(y, 1), slice(None)), new_row)
+        U[:, :] = jnp.where(sel, new_row, U[:, :])
         taken[i] = act.astype(jnp.int32)
         # Host semantics: fits is only checked right after an actual removal.
         fits = fits_now(flags[0]) & act
@@ -166,15 +188,15 @@ def _kernel(cand_y, cand_prio, scalars,          # scalar-prefetch (SMEM)
         stop_idx = flags[2]
         removed = (taken[i] != 0) & (i <= stop_idx) & fits_any
         tentative = removed & (i != stop_idx)
-        row_now = pl.load(U, (pl.ds(y, 1), slice(None)))
+        row_now = row_of(U)
         row_try = row_now + jnp.where(tentative, use_row, 0)
-        pl.store(U, (pl.ds(y, 1), slice(None)), row_try)
+        U[:, :] = jnp.where(sel, row_try, U[:, :])
         fits = fits_now(flags[0])
         keep_added = tentative & fits
         # Roll back the tentative add when the preemptor no longer fits.
         rollback = tentative & jnp.logical_not(keep_added)
-        pl.store(U, (pl.ds(y, 1), slice(None)),
-                 row_try - jnp.where(rollback, use_row, 0))
+        U[:, :] = jnp.where(sel, row_try - jnp.where(rollback, use_row, 0),
+                            U[:, :])
         victim = removed & jnp.logical_not(keep_added)
         victim_out[:, :] = jnp.full((1, LANES), 1, jnp.int32) \
             * victim.astype(jnp.int32)

@@ -80,6 +80,13 @@ def get_targets(wi: WorkloadInfo, assignment: Assignment, snapshot: Snapshot,
 
     if cq.cohort is not None and cq.cohort.is_hierarchical():
         engine = None
+    # getattr: native-decoded Assignments bypass __init__, so the slot may
+    # be unset on topology-free ticks.
+    hint = getattr(assignment, "topology_hint", None)
+    if hint is not None:
+        # Topology-steered victim selection runs the host referee: the
+        # candidate reorder below is the whole mechanism.
+        engine = None
 
     def minimal(cands, allow_borrowing, threshold):
         if engine in ("jax", "pallas"):
@@ -96,6 +103,8 @@ def get_targets(wi: WorkloadInfo, assignment: Assignment, snapshot: Snapshot,
     if not candidates:
         return []
     candidates.sort(key=lambda c: _candidate_sort_key(c, cq.name, now))
+    if hint is not None:
+        candidates = _topology_prefer(candidates, hint, snapshot)
 
     round1, round2 = _plan_rounds(wi, cq, candidates)
     targets = minimal(*round1)
@@ -130,7 +139,8 @@ def get_targets_batch(items, snapshot: Snapshot, ordering: WorkloadOrdering,
         cq = snapshot.cluster_queues[wi.cluster_queue]
         hier = cq.cohort is not None and cq.cohort.is_hierarchical()
         ci = enc.cq_index.get(wi.cluster_queue)
-        if (fair and cq.cohort is not None) or hier or ci is None:
+        if (fair and cq.cohort is not None) or hier or ci is None \
+                or getattr(assignment, "topology_hint", None) is not None:
             results[idx] = get_targets(wi, assignment, snapshot, ordering,
                                        now, fair_strategies, engine=None)
             continue
@@ -280,6 +290,55 @@ def _candidate_sort_key(c: WorkloadInfo, cq_name: str, now: float,
         if memo is not None:
             memo[id(c)] = parts
     return (parts[0], c.cluster_queue == cq_name) + parts[1:]
+
+
+def _topology_prefer(candidates: List[WorkloadInfo], hint,
+                     snapshot: Snapshot) -> List[WorkloadInfo]:
+    """Fragmentation-reducing victim preference (topology-aware
+    scheduling): when the preemptor needs one contiguous domain at
+    `hint`'s level, stably move the candidates occupying the most
+    promising domain — the one where (current free + slots the candidates
+    would release) is largest — to the front, so minimalPreemptions'
+    greedy remove-until-fits empties ONE domain instead of nibbling
+    slots across many. A pure reorder: the victim-set legality rules
+    (priority, borrowing, policies) are untouched, and without a hint the
+    ordering is byte-identical to the reference's."""
+    flavor, level_name, _count = hint
+    topo = getattr(snapshot, "topology", None)
+    rf = snapshot.resource_flavors.get(flavor)
+    spec = rf.topology if rf is not None else None
+    if topo is None or spec is None:
+        return candidates
+    lvl = spec.level_index(level_name)
+    if lvl is None:
+        return candidates
+    free = spec.domain_free(topo.get(flavor, ()), lvl)
+    freed: Dict[tuple, int] = {}
+    cand_domain = []
+    for c in candidates:
+        dom = None
+        adm = c.obj.admission
+        if adm is not None:
+            # EVERY placed podset contributes to the freed totals (a
+            # multi-podset victim can release slots in several domains);
+            # the candidate groups under its first placed podset's domain
+            # (a workload is evicted whole, so it needs one group).
+            for psa in adm.pod_set_assignments:
+                ta = psa.topology_assignment
+                if ta is not None and ta.flavor == flavor \
+                        and len(ta.domain) > lvl:
+                    d = ta.domain[:lvl + 1]
+                    freed[d] = freed.get(d, 0) \
+                        + sum(n for _, n in ta.counts)
+                    if dom is None:
+                        dom = d
+        cand_domain.append(dom)
+    if not freed:
+        return candidates
+    best = min(freed, key=lambda d: (-(free.get(d, 0) + freed[d]), d))
+    in_best = [c for c, d in zip(candidates, cand_domain) if d == best]
+    rest = [c for c, d in zip(candidates, cand_domain) if d != best]
+    return in_best + rest
 
 
 def _total_requests_for_assignment(wi: WorkloadInfo,

@@ -138,12 +138,41 @@ class Scheduler:
         # usage moved outside the scheduler's own assume/forget lockstep
         # (replaces the reference's per-tick deep copy, snapshot.go:95-129).
         self._mirror = SnapshotMirror(cache)
+        # Topology-aware stage (kueue_tpu/topology), built lazily from the
+        # snapshot's flavor set and keyed on its structure version; stays
+        # None on topology-free clusters (the provable no-op).
+        self._topo_key = None
+        self._topo_stage = None
 
     def close(self) -> None:
         """Release cache subscriptions. Call when retiring this scheduler
         while its cache lives on (e.g. config-reload replacement) — the
         mirror's dirty sink would otherwise stay registered forever."""
         self._mirror.detach()
+
+    def prewarm(self, head_counts: Sequence[int], podsets: int = 1) -> None:
+        """Warmup hook: compile the batched solve for the given head-count
+        buckets NOW (off the measured path), so no XLA compile lands
+        inside a scheduling tick. The solver also auto-prewarms neighbor
+        buckets when the live head count drifts toward a rotation
+        (BatchSolver._maybe_prewarm); this hook covers startup and
+        operator-known arrival shapes."""
+        bs = self.batch_solver
+        warm = getattr(bs, "warmup", None)
+        if warm is not None:
+            warm(self._mirror.refresh(), head_counts, podsets)
+
+    def prewarm_idle(self) -> int:
+        """Drain queued neighbor-bucket compiles in the idle window
+        between ticks (BatchSolver.prewarm_idle, plus the topology fit
+        kernel's item buckets); returns how many shapes were compiled.
+        The serve loop and the bench's churn slot call this so a bucket
+        rotation never compiles inside a measured tick."""
+        fn = getattr(self.batch_solver, "prewarm_idle", None)
+        done = fn() if fn is not None else 0
+        if self._topo_stage is not None and self.batch_solver is not None:
+            done += self._topo_stage.prewarm_idle()
+        return done
 
     # -- one tick -----------------------------------------------------------
 
@@ -256,6 +285,27 @@ class Scheduler:
             entries.append(e)
         return entries, solvable
 
+    def _topology_stage(self, snapshot: Snapshot):
+        """The topology-aware placement stage for this snapshot, or None
+        when no flavor declares a topology (or the gate is off)."""
+        if snapshot.topology is None \
+                or not features.enabled(features.TOPOLOGY_AWARE_SCHEDULING):
+            return None
+        if self._topo_key != snapshot.structure_version:
+            from kueue_tpu.topology import (
+                TopologyStage, build_topology_encoding)
+            enc = build_topology_encoding(snapshot.resource_flavors)
+            self._topo_stage = TopologyStage(enc) if enc is not None else None
+            self._topo_key = snapshot.structure_version
+        return self._topo_stage
+
+    def _topology_pair(self, snapshot: Snapshot):
+        """(stage, leaf-occupancy view) for the referee path, or None."""
+        stage = self._topology_stage(snapshot)
+        if stage is None:
+            return None
+        return stage, snapshot.topology
+
     def _resolve(self, tick: TickInFlight) -> None:
         """Flavor-assign all nominable entries: collect the batched device
         solve when one is in flight, else run the sequential referee."""
@@ -263,6 +313,13 @@ class Scheduler:
         snapshot = tick.snapshot
         if tick.handle is not None:
             assignments = self.batch_solver.collect(tick.handle)
+            topo_stage = self._topology_stage(snapshot)
+            if topo_stage is not None:
+                # Topology stage over the whole batch: one vectorized
+                # best-fit-level search on the device path (the referee
+                # path runs its host twin inside assign_flavors).
+                topo_stage.apply([e.info for e in entries], assignments,
+                                 snapshot.topology, use_device=True)
         else:
             assignments = None
         fair = features.enabled(features.FAIR_SHARING)
@@ -349,7 +406,8 @@ class Scheduler:
         device rounds of _batch_partial_admission)."""
         cq = snap.cluster_queues[wi.cluster_queue]
         full = precomputed if precomputed is not None else \
-            assign_flavors(wi, cq, snap.resource_flavors)
+            assign_flavors(wi, cq, snap.resource_flavors,
+                           topology=self._topology_pair(snap))
         mode = full.representative_mode
         if mode == FIT:
             return full, []
@@ -365,7 +423,9 @@ class Scheduler:
             return full, targets
         if wi.obj.can_be_partially_admitted():
             def fits(counts):
-                assignment = assign_flavors(wi, cq, snap.resource_flavors, counts)
+                assignment = assign_flavors(
+                    wi, cq, snap.resource_flavors, counts,
+                    topology=self._topology_pair(snap))
                 if assignment.representative_mode == FIT:
                     return (assignment, []), True
                 t = preemption_mod.get_targets(
@@ -428,6 +488,10 @@ class Scheduler:
             probes = [s.probe() for _, s in active]
             assignments = self.batch_solver.solve_with_counts(
                 [e.info for e, _ in active], snapshot, probes)
+            topo_stage = self._topology_stage(snapshot)
+            if topo_stage is not None:
+                topo_stage.apply([e.info for e, _ in active], assignments,
+                                 snapshot.topology, use_device=True)
             # Non-Fit probes need victim sets to count as fitting — the
             # reducer's fits() tries preemption on ANY non-Fit probe
             # (even a NoFit-representative truncated assignment can carry
@@ -559,6 +623,12 @@ class Scheduler:
                 hier_fold_log.clear()
         preempting: List = []
         pending_assumes: List = []
+        # Topology admission bookkeeping: the cycle's own leaf-occupancy
+        # copy (built from the LIVE ledger, so pipelined staleness is
+        # covered), charged per admission so two admissions in one cycle
+        # cannot pack into the same free slots.
+        topo_stage = self._topology_stage(snapshot)
+        topo_cycle = None
         # Deferred victim searches, pre-batched for the entries most likely
         # to reach the issue branch — the first TWO PREEMPT entries per
         # cohort root (and every cohortless one) in cycle order: a FIT
@@ -785,8 +855,29 @@ class Scheduler:
                     if cq.cohort is not None:
                         cycle_cohorts_skip_preemption.add(cq.cohort.root_name)
                 continue
+            topo_assignments = None
+            if topo_stage is not None \
+                    and getattr(e.assignment, "topology", None):
+                if topo_cycle is None:
+                    from kueue_tpu.topology import TopologyCycle
+                    topo_cycle = TopologyCycle(self.cache.topology)
+                topo_assignments, ok = self._charge_topology(
+                    topo_stage, topo_cycle, e.assignment)
+                if not ok:
+                    # A domain that fit at solve time was consumed (by an
+                    # earlier admission this cycle, or — pipelined — by a
+                    # tick that finished since dispatch). Never place a
+                    # required podset across domains: requeue and re-solve
+                    # against fresh occupancy next tick.
+                    e.status = SKIPPED
+                    e.inadmissible_msg = ("topology domain no longer fits; "
+                                          "other workloads were prioritized")
+                    e.info.last_assignment = None
+                    self.metrics.skipped += 1
+                    continue
             e.status = NOMINATED
-            self._admit(e, cq, pending_assumes)
+            self._admit(e, cq, pending_assumes,
+                        topo_assignments=topo_assignments)
             if cq.cohort is not None:
                 cycle_cohorts_skip_preemption.add(cq.cohort.root_name)
         t_flush = _time.perf_counter()
@@ -796,6 +887,34 @@ class Scheduler:
         for e, cq in preempting:
             self._issue_preemptions(e, cq)
         return admitted
+
+    @staticmethod
+    def _charge_topology(stage, topo_cycle, assignment):
+        """Re-validate and charge every topology candidate of a FIT entry
+        against the cycle occupancy. All-or-nothing: a failing podset
+        rolls back the earlier podsets' charges (flavor arrays are tiny,
+        so a per-entry backup of the touched flavors is cheap). Returns
+        (per-podset TopologyAssignment list, ok)."""
+        cands = assignment.topology
+        touched = {c.flavor for c in cands if c is not None}
+        backup = {f: topo_cycle.used[f].copy()
+                  for f in touched if f in topo_cycle.used}
+        created = touched - set(backup)
+        out = []
+        for p, psa in enumerate(assignment.pod_sets):
+            cand = cands[p] if p < len(cands) else None
+            if cand is None:
+                out.append(None)
+                continue
+            ta, ok = stage.charge(topo_cycle.used, cand, psa.name)
+            if not ok:
+                for f, arr in backup.items():
+                    topo_cycle.used[f] = arr
+                for f in created:
+                    topo_cycle.used.pop(f, None)
+                return None, False
+            out.append(ta)
+        return out, True
 
     def _issue_preemptions(self, e: Entry, cq: CachedClusterQueue) -> None:
         """IssuePreemptions (preemption.go:129-156): evictions applied with
@@ -816,7 +935,8 @@ class Scheduler:
         if err is not None:
             raise err
 
-    def _admit(self, e: Entry, cq: CachedClusterQueue, pending: list) -> bool:
+    def _admit(self, e: Entry, cq: CachedClusterQueue, pending: list,
+               topo_assignments: Optional[list] = None) -> bool:
         """scheduler.go admit (:493-541), split for the batched commit:
         the per-entry phase reserves on the workload object (admission +
         conditions) and runs the apply callback; the cache/mirror/solver
@@ -837,7 +957,7 @@ class Scheduler:
         spec_counts = None if single else {ps.name: ps.count
                                            for ps in spec_sets}
         triples: Optional[list] = [] if not wl.reclaimable_pods else None
-        for ps in e.assignment.pod_sets:
+        for pi, ps in enumerate(e.assignment.pod_sets):
             flavors = {r: fa.name for r, fa in ps.flavors.items()}
             # ps.requests is freshly built per solve and never mutated
             # after decode — alias it instead of copying (readers that
@@ -845,7 +965,11 @@ class Scheduler:
             requests = ps.requests
             psas.append(PodSetAssignment(
                 name=ps.name, flavors=flavors,
-                resource_usage=requests, count=ps.count))
+                resource_usage=requests, count=ps.count,
+                topology_assignment=(topo_assignments[pi]
+                                     if topo_assignments is not None
+                                     and pi < len(topo_assignments)
+                                     else None)))
             if triples is not None:
                 spec_count = spec_sets[0].count if single \
                     else spec_counts.get(ps.name, ps.count)

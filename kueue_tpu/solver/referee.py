@@ -74,6 +74,14 @@ class Assignment:
     # tensor scatter) skip the name→index dict walks. None on
     # referee-built assignments.
     usage_idx: Optional[tuple] = field(default=None, repr=False)
+    # Topology-aware scheduling (kueue_tpu/topology): per-podset
+    # TopologyCandidate verdicts, filled by the topology stage; None when
+    # no podset carries a topology request (the no-topology no-op).
+    topology: Optional[list] = field(default=None, repr=False)
+    # (flavor, level name, pods) when a required-topology podset needs
+    # preemption — steers victim selection toward freeing one contiguous
+    # domain (scheduler/preemption.py).
+    topology_hint: Optional[tuple] = field(default=None, repr=False)
     _mode: Optional[int] = field(default=None, init=False, repr=False)
 
     @property
@@ -101,12 +109,17 @@ class Assignment:
 
 def assign_flavors(wi: WorkloadInfo, cq: CachedClusterQueue,
                    resource_flavors: Dict[str, "ResourceFlavor"],
-                   counts: Optional[List[int]] = None) -> Assignment:
+                   counts: Optional[List[int]] = None,
+                   topology=None) -> Assignment:
     """Assign a flavor to every requested resource of every pod set.
 
     Mirrors FlavorAssigner.Assign (flavorassigner.go:253-329), including the
     resume-from-last-flavor state keyed on allocatable generations
     (flavorassigner.go:244-247).
+
+    `topology` (a (TopologyStage, leaf-occupancy view) pair, or None) runs
+    the topology-aware placement stage over the finished assignment — the
+    sequential-path twin of the scheduler's batched stage invocation.
     """
     if wi.last_assignment is not None and _last_assignment_outdated(wi, cq):
         wi.last_assignment = None
@@ -150,7 +163,10 @@ def assign_flavors(wi: WorkloadInfo, cq: CachedClusterQueue,
 
         _append_podset(assignment, ps_requests, psa)
         if psa.error is not None or (ps_requests and not psa.flavors):
-            return assignment
+            break
+    if topology is not None:
+        stage, used_by_flavor = topology
+        stage.apply([wi], [assignment], used_by_flavor, use_device=False)
     return assignment
 
 

@@ -1,0 +1,31 @@
+"""Topology-aware scheduling (slice/rack-packed admission).
+
+Models a per-flavor placement hierarchy (block -> rack -> host levels with
+per-leaf pod capacity, `api.types.TopologySpec`), encodes it into padded
+dense tensors alongside the solver's CQEncoding (`topology.encoding`), and
+assigns each admissible PodSet the lowest topology domain that fits its
+pods (`topology.fit` — a vectorized best-fit-level search with a host
+referee twin). Leaf occupancy lives in `topology.state.TopologyLedger`,
+owned by the admitted-workload cache and charged/released on the same
+assume/forget/delete transitions as quota.
+
+When no ResourceFlavor declares a topology, every entry point returns
+None/no-ops and the scheduler's existing code paths are byte-identical.
+"""
+
+import jax
+
+# Integer slot arithmetic is exact int64, like models/ and ops/.
+jax.config.update("jax_enable_x64", True)
+
+from kueue_tpu.topology.encoding import TopologyEncoding, build_topology_encoding
+from kueue_tpu.topology.fit import TopologyStage
+from kueue_tpu.topology.state import TopologyCycle, TopologyLedger
+
+__all__ = [
+    "TopologyEncoding",
+    "build_topology_encoding",
+    "TopologyStage",
+    "TopologyCycle",
+    "TopologyLedger",
+]

@@ -1,0 +1,140 @@
+"""Dense tensor encoding of per-flavor topology trees.
+
+The string-world TopologySpec (levels + leaf paths) is folded on the host
+into integer tensors the vectorized fit search consumes, exactly like
+`solver/schema.py` folds taints/affinity into the eligibility mask:
+
+  T  topology-declaring flavors (a subset of the global flavor vocabulary)
+  L  levels (padded to the deepest flavor)
+  E  leaves per flavor (padded)
+  D  domains per (flavor, level) (padded)
+
+A domain at level l is the set of leaves sharing path[:l+1]; domain
+indices at each level are assigned in sorted-path order, so the encoding
+(and therefore every tie-break downstream) is deterministic. The encoding
+is immutable once built and keyed on the snapshot's structure version by
+its consumers.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from kueue_tpu.api.types import ResourceFlavor, TopologySpec
+
+
+class TopologyEncoding:
+    """Padded dense view of every topology-declaring flavor."""
+
+    __slots__ = ("flavor_names", "flavor_index", "specs", "L", "E", "D",
+                 "num_levels", "leaf_valid", "leaf_cap", "leaf_domain",
+                 "num_domains", "domain_paths")
+
+    def __init__(self, flavor_names: List[str], specs: List[TopologySpec]):
+        self.flavor_names = flavor_names
+        self.flavor_index = {n: i for i, n in enumerate(flavor_names)}
+        self.specs = specs
+        T = len(flavor_names)
+        L = max(len(s.levels) for s in specs)
+        E = max(len(s.leaves) for s in specs)
+        self.L, self.E = L, E
+
+        num_levels = np.zeros(T, dtype=np.int32)
+        leaf_valid = np.zeros((T, E), dtype=bool)
+        leaf_cap = np.zeros((T, E), dtype=np.int64)
+        # [t][l][d] -> the domain's path prefix (for decode/events).
+        domain_paths: List[List[List[Tuple[str, ...]]]] = []
+        # Two passes: domain counts first (for the padded D), then ids.
+        per_level_domains: List[List[Dict[Tuple[str, ...], int]]] = []
+        D = 1
+        for t, spec in enumerate(specs):
+            num_levels[t] = len(spec.levels)
+            levels_doms: List[Dict[Tuple[str, ...], int]] = []
+            paths_t: List[List[Tuple[str, ...]]] = []
+            for li in range(len(spec.levels)):
+                prefixes = sorted({leaf.path[:li + 1] for leaf in spec.leaves
+                                   if len(leaf.path) > li})
+                levels_doms.append({p: d for d, p in enumerate(prefixes)})
+                paths_t.append(prefixes)
+                D = max(D, len(prefixes))
+            per_level_domains.append(levels_doms)
+            domain_paths.append(paths_t)
+            for e, leaf in enumerate(spec.leaves):
+                leaf_valid[t, e] = True
+                leaf_cap[t, e] = leaf.capacity
+        self.D = D
+
+        leaf_domain = np.full((T, L, E), -1, dtype=np.int32)
+        num_domains = np.zeros((T, L), dtype=np.int32)
+        for t, spec in enumerate(specs):
+            for li in range(len(spec.levels)):
+                doms = per_level_domains[t][li]
+                num_domains[t, li] = len(doms)
+                for e, leaf in enumerate(spec.leaves):
+                    if len(leaf.path) > li:
+                        leaf_domain[t, li, e] = doms[leaf.path[:li + 1]]
+
+        self.num_levels = num_levels
+        self.leaf_valid = leaf_valid
+        self.leaf_cap = leaf_cap
+        self.leaf_domain = leaf_domain
+        self.num_domains = num_domains
+        self.domain_paths = domain_paths
+
+    # -- helpers ------------------------------------------------------------
+
+    def stack_used(self, used_by_flavor: Dict[str, np.ndarray]) -> np.ndarray:
+        """[T, E] i64 leaf occupancy padded from the ledger view; missing
+        flavors read as empty."""
+        out = np.zeros((len(self.flavor_names), self.E), dtype=np.int64)
+        for t, name in enumerate(self.flavor_names):
+            arr = used_by_flavor.get(name)
+            if arr is not None:
+                n = min(len(arr), self.E)
+                out[t, :n] = arr[:n]
+        return out
+
+    def domain_leaf_indices(self, t: int, level: int,
+                            domain: int) -> np.ndarray:
+        """Leaf indices (into the flavor's spec.leaves) of one domain."""
+        return np.nonzero(self.leaf_domain[t, level] == domain)[0]
+
+    def domain_path(self, t: int, level: int,
+                    domain: int) -> Tuple[str, ...]:
+        return self.domain_paths[t][level][domain]
+
+    def domain_index(self, t: int, level: int,
+                     path: Tuple[str, ...]) -> Optional[int]:
+        """Domain index at `level` for a path prefix; None when unknown."""
+        try:
+            paths = self.domain_paths[t][level]
+        except IndexError:
+            return None
+        lo = 0
+        hi = len(paths)
+        # paths are sorted; binary search keeps this O(log D).
+        while lo < hi:
+            mid = (lo + hi) // 2
+            if paths[mid] < path:
+                lo = mid + 1
+            else:
+                hi = mid
+        if lo < len(paths) and paths[lo] == path:
+            return lo
+        return None
+
+
+def build_topology_encoding(
+        resource_flavors: Dict[str, ResourceFlavor],
+) -> Optional[TopologyEncoding]:
+    """The dense encoding of every topology-declaring flavor, or None when
+    no flavor declares one (the provable no-op gate: with None, the
+    scheduler never constructs a stage and no existing code path moves)."""
+    names = sorted(n for n, rf in resource_flavors.items()
+                   if rf.topology is not None and rf.topology.leaves)
+    if not names:
+        return None
+    return TopologyEncoding(
+        names, [resource_flavors[n].topology for n in names])

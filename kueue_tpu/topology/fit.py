@@ -1,0 +1,409 @@
+"""Vectorized best-fit-level search over per-flavor topology trees.
+
+Given each PodSet's assigned flavor and pod count, find the LOWEST (deepest)
+topology domain whose free pod-slot capacity fits the whole PodSet:
+
+  * `topology_required: <level>` — every pod must land within ONE domain at
+    the requested level (or deeper, which is contained in it). No such
+    domain at all (even empty) => the PodSet can never fit (NO_FIT); a
+    domain exists but none is currently free enough => inadmissible this
+    tick (or preemption-eligible when the quota solve already said PREEMPT).
+  * `topology_preferred: <level>` — best effort: try the requested level
+    and deeper, fall back up the hierarchy, and finally place unconstrained.
+
+The batched search is one jitted program following the `models/flavor_fit`
+masking idiom — no data-dependent branching, all mask/reduction — so the
+whole tick's topology-requesting PodSets solve in one dispatch on the
+device path. `fit_host` is the sequential referee twin (numpy, identical
+tie-breaks) used by the referee solver path and the admission cycle's
+re-validation, and the two are pinned decision-equivalent by the goldens.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kueue_tpu.api.types import TopologyAssignment
+from kueue_tpu.solver.modes import NO_FIT, PREEMPT
+from kueue_tpu.topology.encoding import TopologyEncoding
+
+_BIG = np.int64(1) << 62
+
+
+def _pad_pow2(n: int, floor: int = 4) -> int:
+    out = floor
+    while out < n:
+        out *= 2
+    return out
+
+
+def solve_topology_core(leaf_cap, leaf_valid, leaf_domain, num_domains,
+                        num_levels, leaf_used, ti, count, req_level,
+                        required, item_valid, *, shapes):
+    """Batched best-fit-level search; returns (level, domain, ok_now,
+    could_ever) per item. level/domain are -1 for "no domain" (which for
+    `preferred` items means unconstrained placement, for `required` items
+    a failure)."""
+    T, L, E, D, N = shapes
+
+    free = jnp.where(leaf_valid, jnp.maximum(leaf_cap - leaf_used, 0), 0)
+    cap = jnp.where(leaf_valid, leaf_cap, 0)
+
+    # Per-(flavor, level) domain totals via one flat segment-sum: leaf e of
+    # flavor t contributes to segment (t*L + l)*(D+1) + domain, with padded
+    # leaves routed to the dead segment D.
+    dom = jnp.where(leaf_domain >= 0, leaf_domain, D)            # [T,L,E]
+    base = (jnp.arange(T)[:, None, None] * L
+            + jnp.arange(L)[None, :, None]) * (D + 1)
+    seg = (base + dom).reshape(-1)
+    freeB = jnp.broadcast_to(free[:, None, :], (T, L, E)).reshape(-1)
+    capB = jnp.broadcast_to(cap[:, None, :], (T, L, E)).reshape(-1)
+    dom_free = jax.ops.segment_sum(
+        freeB, seg, num_segments=T * L * (D + 1)).reshape(T, L, D + 1)[..., :D]
+    dom_cap = jax.ops.segment_sum(
+        capB, seg, num_segments=T * L * (D + 1)).reshape(T, L, D + 1)[..., :D]
+    dom_valid = (jnp.arange(D)[None, None, :]
+                 < num_domains[:, :, None])                      # [T,L,D]
+
+    ts = jnp.maximum(ti, 0)
+    f_free = dom_free[ts]                                        # [N,L,D]
+    f_cap = dom_cap[ts]
+    f_valid = dom_valid[ts] & item_valid[:, None, None] & (ti >= 0)[:, None, None]
+    nl = num_levels[ts]                                          # [N]
+
+    lix = jnp.arange(L)[None, :]
+    need = count[:, None, None]
+    fits_now = f_valid & (f_free >= need)                        # [N,L,D]
+    fits_cap = f_valid & (f_cap >= need)
+    level_fit = fits_now.any(axis=2)                             # [N,L]
+    level_cap = fits_cap.any(axis=2)
+
+    # Levels at/below (deeper than) the requested one; a fit in a deeper
+    # domain also satisfies the requested level (containment).
+    allowed_req = (lix >= req_level[:, None]) & (lix < nl[:, None])
+    allowed_any = lix < nl[:, None]
+    lvl_req = jnp.where(level_fit & allowed_req, lix, -1).max(axis=1)
+    lvl_any = jnp.where(level_fit & allowed_any, lix, -1).max(axis=1)
+    level = jnp.where(lvl_req >= 0, lvl_req,
+                      jnp.where(required, -1, lvl_any))          # [N]
+    could_ever = (level_cap & allowed_req).any(axis=1)
+
+    # Best-fit domain at the chosen level: the FITTING domain with the least
+    # free capacity (ties -> lowest index, i.e. lexicographically first
+    # path — the deterministic tie-break the host twin mirrors).
+    lvl_safe = jnp.maximum(level, 0)
+    free_at = jnp.take_along_axis(
+        f_free, lvl_safe[:, None, None], axis=1)[:, 0, :]        # [N,D]
+    fits_at = jnp.take_along_axis(
+        fits_now, lvl_safe[:, None, None], axis=1)[:, 0, :]
+    score = jnp.where(fits_at, free_at, _BIG)
+    domain = jnp.argmin(score, axis=1).astype(jnp.int32)
+    domain = jnp.where(level >= 0, domain, -1)
+    ok_now = level >= 0
+    return (level.astype(jnp.int32), domain, ok_now,
+            could_ever & item_valid & (ti >= 0))
+
+
+_topology_kernel = functools.partial(
+    jax.jit, static_argnames=("shapes",))(solve_topology_core)
+
+
+def fit_host(enc: TopologyEncoding, used: np.ndarray, ti: int, count: int,
+             req_level: int, required: bool,
+             ) -> Tuple[int, int, bool, bool]:
+    """Sequential referee twin of solve_topology_core for ONE item.
+    Identical decision semantics and tie-breaks (deepest fitting level,
+    then least-free fitting domain, then lowest domain index)."""
+    nl = int(enc.num_levels[ti])
+    free = np.where(enc.leaf_valid[ti],
+                    np.maximum(enc.leaf_cap[ti] - used[ti], 0), 0)
+    cap = np.where(enc.leaf_valid[ti], enc.leaf_cap[ti], 0)
+    def _domain_sum(values: np.ndarray, li: int) -> np.ndarray:
+        nd = int(enc.num_domains[ti, li])
+        dom = enc.leaf_domain[ti, li]
+        out = np.zeros(nd, dtype=np.int64)
+        m = dom >= 0
+        np.add.at(out, dom[m], values[m])
+        return out
+
+    could_ever = False
+    # Could any domain at an allowed (required-or-deeper) level fit the
+    # PodSet even empty? False => permanent NO_FIT for `required`.
+    for li in range(nl - 1, req_level - 1, -1):
+        if (_domain_sum(cap, li) >= count).any():
+            could_ever = True
+            break
+    search = list(range(nl - 1, req_level - 1, -1))
+    if not required:
+        search += list(range(req_level - 1, -1, -1))
+    for li in search:
+        dom_free = _domain_sum(free, li)
+        fitting = dom_free >= count
+        if fitting.any():
+            score = np.where(fitting, dom_free, _BIG)
+            return li, int(np.argmin(score)), True, could_ever
+    return -1, -1, False, could_ever
+
+
+def pack_leaves(enc: TopologyEncoding, used: np.ndarray, ti: int, level: int,
+                domain: int, count: int) -> List[Tuple[int, int]]:
+    """Greedy best-fit packing of `count` pods onto the domain's leaves:
+    most-loaded (least free, but non-full) leaves first, then leaf index —
+    concentrates pods and leaves the largest contiguous holes elsewhere
+    (the fragmentation-reducing policy the gauge tracks). Returns
+    [(leaf index, pods)] and does NOT mutate `used`."""
+    leaves = enc.domain_leaf_indices(ti, level, domain)
+    free = np.maximum(enc.leaf_cap[ti, leaves] - used[ti, leaves], 0)
+    order = np.lexsort((leaves, free))       # free asc, then index asc
+    out: List[Tuple[int, int]] = []
+    remaining = count
+    for k in order:
+        if remaining <= 0:
+            break
+        f = int(free[k])
+        if f <= 0:
+            continue
+        take = min(f, remaining)
+        out.append((int(leaves[k]), take))
+        remaining -= take
+    if remaining > 0:
+        return []  # caller re-checked fit, so this only races cycle charges
+    return out
+
+
+@dataclass(slots=True)
+class TopologyCandidate:
+    """One PodSet's topology verdict from the fit stage (device or host).
+
+    `level`/`domain` index the encoding (-1 = unconstrained placement —
+    only reachable for `preferred` requests); `ok_now` is whether a domain
+    currently fits; `could_ever` whether any allowed domain could fit the
+    PodSet even empty (False => permanent NO_FIT for `required`)."""
+
+    ti: int
+    flavor: str
+    req_level: int
+    required: bool
+    count: int
+    level: int
+    domain: int
+    ok_now: bool
+    could_ever: bool
+
+
+class TopologyStage:
+    """The topology pass over solved assignments — the stage `referee.py`
+    (host path) and the scheduler's batched path invoke after flavor
+    assignment. Mutates assignments in place: attaches per-podset
+    `TopologyCandidate`s and downgrades modes per the contract above."""
+
+    def __init__(self, enc: TopologyEncoding):
+        self.enc = enc
+        self._device_static = None
+        # Compile-proof ticks for THIS kernel too: item counts pad to
+        # pow2 buckets, so a churn-driven bucket rotation would compile
+        # inside a measured tick. Imminent neighbor buckets queue here and
+        # Scheduler.prewarm_idle compiles them between ticks.
+        self._warm_n: set = set()
+        self._pending_n: set = set()
+
+    # -- batched (device) path ---------------------------------------------
+
+    def _device_arrays(self):
+        if self._device_static is None:
+            e = self.enc
+            self._device_static = tuple(jnp.asarray(x) for x in (
+                e.leaf_cap, e.leaf_valid, e.leaf_domain, e.num_domains,
+                e.num_levels))
+        return self._device_static
+
+    def _solve_items(self, items: List[tuple], used: np.ndarray,
+                     use_device: bool) -> List[Tuple[int, int, bool, bool]]:
+        """items: [(ti, count, req_level, required)]."""
+        if not use_device or not items:
+            return [fit_host(self.enc, used, ti, count, lvl, req)
+                    for ti, count, lvl, req in items]
+        n = len(items)
+        N = _pad_pow2(n)
+        self._warm_n.add(N)
+        if n >= N - max(1, N // 8):
+            if N * 2 not in self._warm_n:
+                self._pending_n.add(N * 2)
+        if N > 4 and n <= N // 2 + max(1, N // 8):
+            if N // 2 not in self._warm_n:
+                self._pending_n.add(N // 2)
+        ti = np.full(N, -1, dtype=np.int32)
+        count = np.zeros(N, dtype=np.int64)
+        req_level = np.zeros(N, dtype=np.int32)
+        required = np.zeros(N, dtype=bool)
+        valid = np.zeros(N, dtype=bool)
+        for i, (t, c, l, r) in enumerate(items):
+            ti[i], count[i], req_level[i], required[i] = t, c, l, r
+            valid[i] = True
+        e = self.enc
+        out = _topology_kernel(
+            *self._device_arrays(), jnp.asarray(used),
+            jnp.asarray(ti), jnp.asarray(count), jnp.asarray(req_level),
+            jnp.asarray(required), jnp.asarray(valid),
+            shapes=(len(e.flavor_names), e.L, e.E, e.D, N))
+        level, domain, ok_now, could_ever = (np.asarray(x) for x in out)
+        return [(int(level[i]), int(domain[i]), bool(ok_now[i]),
+                 bool(could_ever[i])) for i in range(n)]
+
+    def prewarm_idle(self) -> int:
+        """Compile queued neighbor item-count buckets (all-zero inputs —
+        compilation depends only on shapes). Call between ticks."""
+        done = 0
+        while self._pending_n:
+            N = self._pending_n.pop()
+            if N in self._warm_n:
+                continue
+            e = self.enc
+            T = len(e.flavor_names)
+            out = _topology_kernel(
+                *self._device_arrays(),
+                jnp.zeros((T, e.E), dtype=jnp.int64),
+                jnp.full(N, -1, dtype=jnp.int32),
+                jnp.zeros(N, dtype=jnp.int64),
+                jnp.zeros(N, dtype=jnp.int32),
+                jnp.zeros(N, dtype=bool), jnp.zeros(N, dtype=bool),
+                shapes=(T, e.L, e.E, e.D, N))
+            jax.block_until_ready(out)
+            self._warm_n.add(N)
+            done += 1
+        return done
+
+    # -- the stage -----------------------------------------------------------
+
+    def placement_flavor(self, psa) -> Optional[str]:
+        """The flavor whose nodes host this PodSet's pods: the first
+        (sorted-resource order) assigned flavor that declares a topology."""
+        index = self.enc.flavor_index
+        for res in sorted(psa.flavors):
+            fa = psa.flavors[res]
+            name = fa.name if hasattr(fa, "name") else fa
+            if name in index:
+                return name
+        return None
+
+    def apply(self, workloads: Sequence, assignments: Sequence,
+              used_by_flavor: Dict[str, np.ndarray],
+              use_device: bool = False) -> None:
+        """Run the fit search for every topology-requesting PodSet of the
+        batch and fold the verdicts into the assignments."""
+        used = self.enc.stack_used(used_by_flavor)
+        items: List[tuple] = []
+        slots: List[tuple] = []  # (assignment, podset idx, candidate seed)
+        for wi, a in zip(workloads, assignments):
+            pod_sets = wi.obj.pod_sets
+            for p, psa in enumerate(a.pod_sets):
+                if p >= len(pod_sets):
+                    continue
+                ps = pod_sets[p]
+                req = ps.topology_required or ps.topology_preferred
+                if req is None:
+                    continue
+                required = ps.topology_required is not None
+                if psa.representative_mode == NO_FIT:
+                    continue
+                flavor = self.placement_flavor(psa)
+                if flavor is None:
+                    if required:
+                        self._fail(a, psa,
+                                   f"podset {psa.name}: no assigned flavor "
+                                   f"declares a topology for required level "
+                                   f"{req!r}")
+                    continue
+                ti = self.enc.flavor_index[flavor]
+                lvl = self.enc.specs[ti].level_index(req)
+                if lvl is None:
+                    if required:
+                        self._fail(a, psa,
+                                   f"podset {psa.name}: flavor {flavor} has "
+                                   f"no topology level {req!r}")
+                    continue
+                items.append((ti, psa.count, lvl, required))
+                slots.append((wi, a, p, psa, ti, flavor, lvl, required))
+
+        if not items:
+            return
+        results = self._solve_items(items, used, use_device)
+        for (wi, a, p, psa, ti, flavor, lvl, required), \
+                (level, domain, ok_now, could_ever) in zip(slots, results):
+            cand = TopologyCandidate(
+                ti=ti, flavor=flavor, req_level=lvl, required=required,
+                count=psa.count, level=level, domain=domain, ok_now=ok_now,
+                could_ever=could_ever)
+            # getattr: native-decoded Assignments bypass __init__, leaving
+            # the slot unset until the stage fills it.
+            if getattr(a, "topology", None) is None:
+                a.topology = [None] * len(a.pod_sets)
+            while len(a.topology) < len(a.pod_sets):
+                a.topology.append(None)
+            a.topology[p] = cand
+            if not required or ok_now:
+                continue
+            req_name = self.enc.specs[ti].levels[lvl]
+            if not could_ever:
+                self._fail(a, psa,
+                           f"podset {psa.name}: no {req_name!r} domain of "
+                           f"flavor {flavor} can ever fit {psa.count} pods")
+            elif psa.representative_mode == PREEMPT:
+                # Quota already demands preemption: keep PREEMPT and steer
+                # the victim search toward freeing one contiguous domain.
+                a.topology_hint = (flavor, req_name, psa.count)
+            else:
+                self._fail(
+                    a, psa,
+                    f"podset {psa.name}: insufficient free capacity in any "
+                    f"{req_name!r} domain of flavor {flavor} "
+                    f"({psa.count} pods)", mode=NO_FIT)
+
+    @staticmethod
+    def _fail(a, psa, reason: str, mode: int = NO_FIT) -> None:
+        psa.reasons.append(reason)
+        psa._mode = mode
+        a._mode = None  # drop the memoized representative mode
+
+    # -- admission-time re-check + leaf packing ------------------------------
+
+    def charge(self, cycle_used: Dict[str, np.ndarray], cand,
+               ps_name: str) -> Tuple[Optional[TopologyAssignment], bool]:
+        """Re-validate a candidate against the cycle's leaf occupancy (an
+        earlier admission this cycle may have consumed the domain), pack
+        the pods onto leaves, and charge the cycle state. Returns
+        (assignment-or-None, ok): (None, True) is a `preferred` PodSet
+        placed unconstrained; (None, False) means the entry must be
+        skipped this cycle."""
+        enc = self.enc
+        flavor = cand.flavor
+        ti = cand.ti
+        arr = cycle_used.get(flavor)
+        if arr is None:
+            arr = cycle_used[flavor] = np.zeros(
+                len(enc.specs[ti].leaves), dtype=np.int64)
+        used = np.zeros((len(enc.flavor_names), enc.E), dtype=np.int64)
+        used[ti, :len(arr)] = arr
+        level, domain, ok_now, _ = fit_host(
+            enc, used, ti, cand.count, cand.req_level, cand.required)
+        if not ok_now:
+            if cand.required:
+                return None, False
+            return None, True  # preferred: place unconstrained, no charge
+        counts = pack_leaves(enc, used, ti, level, domain, cand.count)
+        if not counts and cand.count > 0:
+            return (None, False) if cand.required else (None, True)
+        for leaf, pods in counts:
+            arr[leaf] += pods
+        spec = enc.specs[ti]
+        return TopologyAssignment(
+            flavor=flavor,
+            levels=spec.levels[:level + 1],
+            domain=enc.domain_path(ti, level, domain),
+            counts=tuple(counts)), True

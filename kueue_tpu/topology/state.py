@@ -1,0 +1,93 @@
+"""Topology leaf-occupancy ledger.
+
+The quota books are per-(ClusterQueue, flavor, resource); topology slots
+are per-flavor leaves shared by every ClusterQueue whose quota rides that
+flavor (one node pool, many queues). The ledger is owned by the
+admitted-workload cache and charged/released on exactly the same
+transitions as quota (assume / add / forget / delete), reading each
+admission's recorded `PodSetAssignment.topology_assignment` — so HA
+journal replay, eviction, finish and MultiKueue mirrors all rebuild leaf
+state for free through the cache paths they already traverse.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import numpy as np
+
+from kueue_tpu.api.types import Admission, ResourceFlavor
+
+
+class TopologyLedger:
+    """Per-flavor leaf occupancy (pods per leaf, spec.leaves order)."""
+
+    __slots__ = ("flavors", "version")
+
+    def __init__(self):
+        self.flavors: Dict[str, np.ndarray] = {}
+        self.version = 0
+
+    def __bool__(self) -> bool:
+        return bool(self.flavors)
+
+    def set_flavor(self, rf: ResourceFlavor) -> None:
+        """(Re)register a flavor. A topology-spec change resizes the leaf
+        array; occupancy restarts from the admissions' recorded counts at
+        the next cache rebuild (a structural change, like a CQ resource
+        group rewrite, already invalidates resume state wholesale)."""
+        spec = rf.topology
+        if spec is None or not spec.leaves:
+            if self.flavors.pop(rf.name, None) is not None:
+                self.version += 1
+            return
+        cur = self.flavors.get(rf.name)
+        n = len(spec.leaves)
+        if cur is None or len(cur) != n:
+            fresh = np.zeros(n, dtype=np.int64)
+            if cur is not None:
+                fresh[:min(len(cur), n)] = cur[:min(len(cur), n)]
+            self.flavors[rf.name] = fresh
+            self.version += 1
+
+    def drop_flavor(self, name: str) -> None:
+        if self.flavors.pop(name, None) is not None:
+            self.version += 1
+
+    def charge(self, admission: Optional[Admission], sign: int) -> None:
+        """Fold one admission's topology assignments into the occupancy
+        (sign=+1 on assume/add, -1 on forget/delete). No-op for
+        assignments without topology placements."""
+        if admission is None:
+            return
+        touched = False
+        for psa in admission.pod_set_assignments:
+            ta = psa.topology_assignment
+            if ta is None:
+                continue
+            arr = self.flavors.get(ta.flavor)
+            if arr is None:
+                continue
+            for leaf, pods in ta.counts:
+                if 0 <= leaf < len(arr):
+                    arr[leaf] += sign * pods
+            touched = True
+        if touched:
+            self.version += 1
+
+    def view(self) -> Dict[str, np.ndarray]:
+        """Frozen copy for a tick snapshot."""
+        return {name: arr.copy() for name, arr in self.flavors.items()}
+
+
+class TopologyCycle:
+    """The admission cycle's side-tracked leaf occupancy: a lazy copy of
+    the live ledger that this cycle's charges mutate, so two admissions in
+    one cycle cannot pack into the same free slots (the topology twin of
+    `cycle_cohorts_usage`)."""
+
+    __slots__ = ("used",)
+
+    def __init__(self, ledger: TopologyLedger):
+        self.used: Dict[str, np.ndarray] = {
+            name: arr.copy() for name, arr in ledger.flavors.items()}

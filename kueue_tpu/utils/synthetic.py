@@ -38,6 +38,7 @@ def synthetic_objects(
     preemption_heavy: bool = False,
     fair_hierarchy: bool = False,
     lending: bool = False,
+    topology: bool = False,
 ):
     """Generate the raw API objects of a north-star-scale cluster:
     (flavors, cluster_queues, local_queues, admitted workloads with their
@@ -52,7 +53,14 @@ def synthetic_objects(
     `fair_hierarchy` builds BASELINE config #4 (KEP-1714 over KEP-79): the
     flat cohorts become leaves of a 3-level tree (leaf cohorts → 10 mid
     cohorts → one root) and every ClusterQueue carries a fair-sharing
-    weight; enable the FairSharing gate to exercise the DRF ordering."""
+    weight; enable the FairSharing gate to exercise the DRF ordering.
+
+    `topology` builds the topology-aware bench config: every flavor
+    declares a block→rack→host TopologySpec (2x2x4 hosts of 8 pod slots)
+    and every pending workload's podsets request slice packing — each
+    fourth workload `required: rack`, the rest `preferred: rack` — so the
+    whole topology stage (batched fit, cycle charging, ledger) runs on
+    every tick."""
     rnd = random.Random(seed)
     if preemption_heavy:
         pending_priority = (1, 5)
@@ -68,7 +76,13 @@ def synthetic_objects(
             cohort_specs.append(CohortSpec(
                 name=f"cohort-{k}", parent=f"mid-{k % n_mids}"))
 
-    flavors = [ResourceFlavor.make(f"flavor-{f}") for f in range(num_flavors)]
+    topo_spec = None
+    if topology:
+        from kueue_tpu.api.types import TopologySpec
+        topo_spec = TopologySpec.uniform(
+            ("block", "rack", "host"), (2, 2, 4), leaf_capacity=8)
+    flavors = [ResourceFlavor.make(f"flavor-{f}", topology=topo_spec)
+               for f in range(num_flavors)]
 
     cqs: List[ClusterQueue] = []
     lqs: List[LocalQueue] = []
@@ -162,11 +176,15 @@ def synthetic_objects(
     for i in range(num_pending):
         c = i % num_cqs
         n_podsets = rnd.randint(1, 2)
+        topo_kw = {}
+        if topology:
+            topo_kw = ({"topology_required": "rack"} if i % 4 == 0
+                       else {"topology_preferred": "rack"})
         pod_sets = [
             PodSet.make(
                 f"ps{p}", count=rnd.randint(1, 8),
                 cpu=rnd.randint(1, 8),
-                memory=f"{rnd.randint(1, 16)}Gi")
+                memory=f"{rnd.randint(1, 16)}Gi", **topo_kw)
             for p in range(n_podsets)
         ]
         pending.append(Workload(
@@ -224,6 +242,7 @@ def synthetic_framework(
     preemption_heavy: bool = False,
     fair_hierarchy: bool = False,
     lending: bool = False,
+    topology: bool = False,
     **framework_kwargs,
 ):
     """Build a full Framework loaded with the synthetic cluster — the
@@ -235,7 +254,7 @@ def synthetic_framework(
         num_cqs=num_cqs, num_cohorts=num_cohorts, num_flavors=num_flavors,
         num_pending=num_pending, usage_fill=usage_fill, seed=seed,
         pending_priority=pending_priority, preemption_heavy=preemption_heavy,
-        fair_hierarchy=fair_hierarchy, lending=lending)
+        fair_hierarchy=fair_hierarchy, lending=lending, topology=topology)
     fw = Framework(batch_solver=batch_solver, **framework_kwargs)
     for rf in flavors:
         fw.create_resource_flavor(rf)

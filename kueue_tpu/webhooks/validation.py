@@ -247,6 +247,15 @@ def validate_workload(wl: Workload) -> List[str]:
             # requests rule).
             errs.append(f"{path}.requests: must not contain the "
                         f"{PODS_RESOURCE!r} resource")
+        if ps.topology_required is not None \
+                and ps.topology_preferred is not None:
+            errs.append(f"{path}.topologyRequest: required and preferred "
+                        "are mutually exclusive")
+        for fld, val in (("required", ps.topology_required),
+                         ("preferred", ps.topology_preferred)):
+            if val is not None and (not val or not _QUALIFIED_NAME.match(val)):
+                errs.append(f"{path}.topologyRequest.{fld}: invalid level "
+                            f"name {val!r}")
     if variable_count > 1:
         errs.append("spec.podSets: at most one podSet can use minCount")
     if wl.priority_class:
@@ -394,6 +403,52 @@ def validate_resource_flavor(rf: ResourceFlavor) -> List[str]:
         if taint.effect not in _TAINT_EFFECTS:
             errs.append(f"{path}.effect: must be one of "
                         f"{list(_TAINT_EFFECTS)}")
+    errs += _validate_topology_spec(rf)
+    return errs
+
+
+# kubebuilder-style caps on the topology tree (keeps the dense encoding's
+# padded tensors bounded: levels x leaves per flavor).
+_MAX_TOPOLOGY_LEVELS = 8
+_MAX_TOPOLOGY_LEAVES = 4096
+
+
+def _validate_topology_spec(rf: ResourceFlavor) -> List[str]:
+    """TopologySpec structural rules: named unique levels, every leaf path
+    exactly one value per level, positive capacities, unique leaf paths."""
+    spec = rf.topology
+    if spec is None:
+        return []
+    errs: List[str] = []
+    if not spec.levels:
+        errs.append("spec.topologySpec.levels: must name at least one level")
+    if len(spec.levels) > _MAX_TOPOLOGY_LEVELS:
+        errs.append(f"spec.topologySpec.levels: at most "
+                    f"{_MAX_TOPOLOGY_LEVELS} levels")
+    seen_levels = set()
+    for level in spec.levels:
+        if not level or not _QUALIFIED_NAME.match(level):
+            errs.append(f"spec.topologySpec.levels: invalid level {level!r}")
+        if level in seen_levels:
+            errs.append(f"spec.topologySpec.levels: duplicate {level!r}")
+        seen_levels.add(level)
+    if not spec.leaves:
+        errs.append("spec.topologySpec.leaves: must enumerate at least one "
+                    "leaf domain")
+    if len(spec.leaves) > _MAX_TOPOLOGY_LEAVES:
+        errs.append(f"spec.topologySpec.leaves: at most "
+                    f"{_MAX_TOPOLOGY_LEAVES} leaves")
+    seen_paths = set()
+    for i, leaf in enumerate(spec.leaves):
+        path = f"spec.topologySpec.leaves[{i}]"
+        if len(leaf.path) != len(spec.levels):
+            errs.append(f"{path}.path: must have one value per level "
+                        f"({len(spec.levels)}), got {len(leaf.path)}")
+        if leaf.capacity < 1:
+            errs.append(f"{path}.capacity: must be >= 1")
+        if leaf.path in seen_paths:
+            errs.append(f"{path}.path: duplicate leaf {'/'.join(leaf.path)!r}")
+        seen_paths.add(leaf.path)
     return errs
 
 

@@ -1,0 +1,23 @@
+# Bad fixture: jit-purity violations in a topology-style fit kernel.
+# Analyzed statically by kueuelint — never imported or executed.
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_DOMAIN_LOG = []
+
+
+@jax.jit
+def leaky_domain_fit(leaf_cap, leaf_used, count):
+    free = jnp.maximum(leaf_cap - leaf_used, 0)
+    total = jnp.sum(free)
+    if total < count:  # JIT02: Python `if` on a traced value
+        return -1
+    best = jnp.argmax(free)
+    _DOMAIN_LOG.append(best)  # JIT03: traced value into closed-over state
+    return int(best)  # JIT01: int() host cast on a traced value
+
+
+@jax.jit
+def host_numpy_fit(leaf_free):
+    return np.argmin(leaf_free)  # JIT01: host numpy on a traced value

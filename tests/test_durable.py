@@ -110,6 +110,57 @@ def test_journal_compacts_dead_events(tmp_path):
     assert lines_after < lines_before
 
 
+def test_takeover_replay_processes_finish_transitions(tmp_path):
+    """A standby whose store already holds a workload (applied via
+    --objects) must process the journal's FULL history at takeover —
+    including the finish after the admission. Dropping the finished
+    record would leave the workload charging quota forever on the new
+    leader (the MODIFIED-replay transition gap)."""
+    path = str(tmp_path / "journal.jsonl")
+    store, journal, fw, adapter, _ = build_world(path)
+    store.create(KIND_RESOURCE_FLAVOR, make_flavor("default"))
+    store.create(KIND_CLUSTER_QUEUE,
+                 make_cq("cq", rg("cpu", fq("default", cpu=4))))
+    store.create(KIND_LOCAL_QUEUE, make_lq("main", cq="cq"))
+    wl = Workload(name="job", queue_name="main",
+                  pod_sets=[PodSet.make("m", 1, cpu=3)])
+    store.create(KIND_WORKLOAD, wl)
+    for _ in range(3):
+        adapter.tick()
+    assert fw.workloads["default/job"].is_admitted
+    fw.finish(fw.workloads["default/job"])
+    adapter.tick()  # publishes the Finished status into the journal
+    journal.close()
+
+    # Standby: the SAME spec objects pre-exist in its store (the
+    # --objects manifests), so the replay folds status via MODIFIED
+    # events — admitted first, then finished.
+    store2 = Store()
+    fw2 = Framework()
+    adapter2 = StoreAdapter(store2, fw2)
+    store2.create(KIND_RESOURCE_FLAVOR, make_flavor("default"))
+    store2.create(KIND_CLUSTER_QUEUE,
+                  make_cq("cq", rg("cpu", fq("default", cpu=4))))
+    store2.create(KIND_LOCAL_QUEUE, make_lq("main", cq="cq"))
+    store2.create(KIND_WORKLOAD, Workload(
+        name="job", queue_name="main",
+        pod_sets=[PodSet.make("m", 1, cpu=3)]))
+    journal2 = Journal(path)
+    journal2.attach(store2)
+    wl2 = fw2.workloads["default/job"]
+    assert wl2.is_finished
+    # The finished workload must NOT hold quota: a fresh 3-cpu workload
+    # fits immediately.
+    assert fw2.cache.usage("cq")["default"]["cpu"] == 0
+    store2.create(KIND_WORKLOAD, Workload(
+        name="next", queue_name="main",
+        pod_sets=[PodSet.make("m", 1, cpu=3)]))
+    for _ in range(3):
+        adapter2.tick()
+    assert fw2.workloads["default/next"].is_admitted
+    journal2.close()
+
+
 SETUP_YAML = """\
 apiVersion: kueue.x-k8s.io/v1beta1
 kind: ResourceFlavor
@@ -155,11 +206,11 @@ WL_WAITS = {
 }
 
 
-def _spawn(state_dir, setup_path):
+def _spawn(state_dir, setup_path, extra_args=()):
     proc = subprocess.Popen(
         [sys.executable, "-m", "kueue_tpu", "--serve", "--port", "0",
          "--tick-interval", "0.05", "--state-dir", state_dir,
-         "--objects", setup_path],
+         "--objects", setup_path, *extra_args],
         env=dict(os.environ, JAX_PLATFORMS="cpu"),
         stderr=subprocess.PIPE, stdout=subprocess.DEVNULL, text=True)
     url = None
@@ -173,10 +224,17 @@ def _spawn(state_dir, setup_path):
         if proc.poll() is not None:
             raise RuntimeError("serve subprocess died during startup")
     assert url, "server never reported its URL"
-    # Keep draining stderr: a full pipe would block the server.
+    # Keep draining stderr (a full pipe would block the server), capturing
+    # the lines so tests can assert on the takeover-replay log.
     import threading
-    threading.Thread(target=lambda: proc.stderr.read(), daemon=True).start()
-    return proc, url
+    captured = []
+
+    def _drain():
+        for line in proc.stderr:
+            captured.append(line)
+
+    threading.Thread(target=_drain, daemon=True).start()
+    return proc, url, captured
 
 
 def _get_status(url, name):
@@ -203,7 +261,7 @@ def test_serve_process_kill_and_recover(tmp_path):
     setup = tmp_path / "setup.yaml"
     setup.write_text(SETUP_YAML)
 
-    proc, url = _spawn(state_dir, str(setup))
+    proc, url, _ = _spawn(state_dir, str(setup))
     try:
         wl_base = (f"{url}/apis/kueue.x-k8s.io/v1beta1/"
                    "namespaces/default/workloads")
@@ -223,7 +281,7 @@ def test_serve_process_kill_and_recover(tmp_path):
 
     # Restart on the same state dir; the setup manifests re-apply
     # idempotently (create errors are surfaced, not fatal).
-    proc2, url2 = _spawn(state_dir, str(setup))
+    proc2, url2, _ = _spawn(state_dir, str(setup))
     try:
         status = _get_status(url2, "fits")
         assert status.get("Admitted"), status
@@ -235,3 +293,91 @@ def test_serve_process_kill_and_recover(tmp_path):
     finally:
         proc2.send_signal(signal.SIGKILL)
         proc2.wait(timeout=10)
+
+
+LEADER_CFG = """\
+apiVersion: config.kueue.x-k8s.io/v1beta1
+kind: Configuration
+leaderElection:
+  leaderElect: true
+  leaseDuration: 2s
+  renewDeadline: 1s
+  retryPeriod: 200ms
+"""
+
+
+def test_ha_takeover_replays_shared_journal(tmp_path):
+    """HA takeover with ONE shared journal across both replicas (the
+    deferred-attach replay path): replicas share the state dir AND the
+    lease; the journal attach is deferred until a replica actually leads,
+    so the standby replays the dead leader's journal at takeover — the
+    admitted workload stays admitted exactly once (its quota is restored
+    by REPLAY, not re-admission, and the pending one must keep waiting)."""
+    state_dir = str(tmp_path / "state")
+    os.makedirs(state_dir)
+    setup = tmp_path / "setup.yaml"
+    setup.write_text(SETUP_YAML)
+    cfg = tmp_path / "config.yaml"
+    cfg.write_text(LEADER_CFG)
+    lease = os.path.join(state_dir, "leases.json")
+    ha_args = ("--config", str(cfg), "--lease-file", lease)
+
+    proc_a, url_a, _ = _spawn(state_dir, str(setup), ha_args)
+    proc_b = None
+    try:
+        wl_base = (f"{url_a}/apis/kueue.x-k8s.io/v1beta1/"
+                   "namespaces/default/workloads")
+        _post(wl_base, WL_FITS)
+        _post(wl_base, WL_WAITS)
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            if _get_status(url_a, "fits").get("Admitted"):
+                break
+            time.sleep(0.1)
+        assert _get_status(url_a, "fits").get("Admitted")
+        assert not _get_status(url_a, "waits").get("QuotaReserved")
+
+        # Standby on the SAME state dir: defers (no journal attach, no
+        # reconcile) while A leads — its store knows only the setup
+        # objects, not the POSTed workloads.
+        proc_b, url_b, captured_b = _spawn(state_dir, str(setup), ha_args)
+        time.sleep(1.0)
+        assert proc_b.poll() is None, "standby died (journal flock clash?)"
+
+        # Kill the leader; B takes the lease and replays A's journal.
+        proc_a.send_signal(signal.SIGKILL)
+        proc_a.wait(timeout=10)
+
+        def _try_status(url, name):
+            # 404 until the replay materializes the workload in B's store.
+            try:
+                return _get_status(url, name)
+            except Exception:
+                return {}
+
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            if _try_status(url_b, "fits").get("Admitted"):
+                break
+            time.sleep(0.1)
+        status = _try_status(url_b, "fits")
+        assert status.get("Admitted"), status
+        # The replay path (not a fresh scheduler admission) restored it.
+        # The drain thread delivers stderr asynchronously — poll briefly.
+        deadline = time.time() + 5
+        while time.time() < deadline and not any(
+                "replayed" in line and "shared journal" in line
+                for line in captured_b):
+            time.sleep(0.05)
+        assert any("replayed" in line and "shared journal" in line
+                   for line in captured_b), captured_b
+        # Exactly-once: the recovered admission still holds the quota, so
+        # the pending workload must NOT gain a reservation.
+        for _ in range(10):
+            time.sleep(0.05)
+            assert not _get_status(url_b, "waits").get("QuotaReserved")
+    finally:
+        for p in (proc_a, proc_b):
+            if p is not None and p.poll() is None:
+                p.send_signal(signal.SIGKILL)
+                p.wait(timeout=10)

@@ -92,6 +92,31 @@ def test_jit_purity_good_fixture():
     assert run_analysis([str(FIXTURES / "jit_good.py")]) == []
 
 
+def test_topology_bad_fixture():
+    """The jit-purity scan covers kueue_tpu/topology/: a topology-style
+    fit kernel carrying host syncs / traced branches / closure leaks
+    fires the same JIT rule family there."""
+    findings = run_analysis([str(FIXTURES / "topology_bad.py")])
+    rules = _rules_of(findings)
+    assert {"JIT01", "JIT02", "JIT03"} <= rules
+    msgs = [f.message for f in findings if f.rule == "JIT01"]
+    assert any("int" in m or "numpy" in m for m in msgs)
+
+
+def test_topology_good_fixture():
+    assert run_analysis([str(FIXTURES / "topology_good.py")]) == []
+
+
+def test_topology_module_in_jit_roster(tmp_path):
+    """Files under a topology/ directory are jit-purity scanned (the
+    roster gate for the kueue_tpu/topology subsystem)."""
+    bad_dir = tmp_path / "topology"
+    bad_dir.mkdir()
+    shutil.copy(FIXTURES / "topology_bad.py", bad_dir / "fit.py")
+    findings = run_analysis([str(tmp_path)])
+    assert "JIT01" in _rules_of(findings)
+
+
 def test_retrace_bad_fixture():
     findings = run_analysis([str(FIXTURES / "retrace_bad.py")])
     rules = _rules_of(findings)

@@ -5,8 +5,12 @@ preventStarvation / with_shared_cq / cohort-lend), same admitted state, same
 incoming workload and assignment, same expected victim sets — and the
 snapshot must come back unmodified.
 
-Each scenario runs under both the host referee engine and the device scan
-engine (ops/preemption_scan, engine="jax")."""
+Engine equivalence: every scenario is parametrized across ALL victim-search
+engines — the host referee, the per-problem lax.scan device kernel
+(ops/preemption_scan), the Pallas kernel where importable, and the batched
+engines (ops/preemption_batch: C++ native and the packed-XLA dispatch) —
+asserting identical victim sets, so no engine can drift from the
+reference's minimalPreemptions semantics unnoticed."""
 
 import pytest
 
@@ -151,9 +155,38 @@ def assignment_for(wi, flavors_modes):
     return a
 
 
-@pytest.fixture(params=[None, "jax"], ids=["host", "device"])
+def _pallas_importable() -> bool:
+    try:
+        from kueue_tpu.ops import preemption_pallas  # noqa: F401
+        return True
+    except Exception:
+        return False
+
+
+ENGINES = ["host", "scan-jax", "batch-native", "batch-jax"]
+if _pallas_importable():
+    ENGINES.insert(2, "scan-pallas")
+
+
+@pytest.fixture(params=ENGINES)
 def engine(request):
     return request.param
+
+
+def _run_batch_engine(wi, assignment, snap, backend):
+    """Victim search through the batched engine entry (one-item batch):
+    the path the scheduler takes with preemptionEngine native/jax."""
+    from kueue_tpu.ops.preemption_batch import BatchContext
+    from kueue_tpu.scheduler.preemption import (
+        DEFAULT_FAIR_STRATEGIES, get_targets_batch)
+    from kueue_tpu.solver import schema as sch
+
+    enc = sch.encode_cluster_queues(snap)
+    usage = sch.encode_usage(snap, enc).usage
+    ctx = BatchContext(enc, features.enabled(features.LENDING_LIMIT))
+    return get_targets_batch([(wi, assignment)], snap, ORD, NOW,
+                             DEFAULT_FAIR_STRATEGIES, ctx, usage,
+                             backend=backend)[0]
 
 
 def run_case(cache, incoming, target_cq, flavors_modes, engine):
@@ -161,8 +194,14 @@ def run_case(cache, incoming, target_cq, flavors_modes, engine):
     before = {name: {f: dict(r) for f, r in cq.usage.items()}
               for name, cq in snap.cluster_queues.items()}
     wi = WorkloadInfo(incoming, cluster_queue=target_cq)
-    targets = get_targets(wi, assignment_for(wi, flavors_modes), snap, ORD,
-                          NOW, engine=engine)
+    assignment = assignment_for(wi, flavors_modes)
+    if engine.startswith("batch-"):
+        targets = _run_batch_engine(wi, assignment, snap,
+                                    engine.split("-", 1)[1])
+    else:
+        eng = {"host": None, "scan-jax": "jax",
+               "scan-pallas": "pallas"}[engine]
+        targets = get_targets(wi, assignment, snap, ORD, NOW, engine=eng)
     after = {name: {f: dict(r) for f, r in cq.usage.items()}
              for name, cq in snap.cluster_queues.items()}
     assert after == before, "snapshot was modified"

@@ -239,21 +239,19 @@ class TestTwoProcessElection:
         cfg = tmp_path / "config.yaml"
         cfg.write_text(LEADER_CFG)
 
-        # Each replica keeps its own journal (separate API endpoints);
-        # only the LEASE is shared — exactly the reference's split of
-        # per-replica caches vs the shared apiserver lease.
-        a_dir, b_dir = os.path.join(state, "a"), os.path.join(state, "b")
+        # Replicas share ONE state dir (journal + lease) — the etcd
+        # analog. The journal attach is deferred until a replica leads
+        # (__main__.tick_once), so the standby replays the leader's
+        # journal at takeover instead of keeping a private copy.
         lease = os.path.join(state, "leases.json")
-        os.makedirs(a_dir)
-        os.makedirs(b_dir)
-        proc_a, url_a = _spawn_replica(a_dir, str(setup), str(cfg), lease)
+        proc_a, url_a = _spawn_replica(state, str(setup), str(cfg), lease)
         try:
             deadline = time.time() + 20
             while time.time() < deadline and not _admitted(url_a, "wl1"):
                 time.sleep(0.1)
             assert _admitted(url_a, "wl1"), "leader A never admitted"
 
-            proc_b, url_b = _spawn_replica(b_dir, str(setup), str(cfg), lease)
+            proc_b, url_b = _spawn_replica(state, str(setup), str(cfg), lease)
             try:
                 # B holds wl1 pending: it defers while A leads.
                 time.sleep(1.5)

@@ -1,0 +1,370 @@
+"""Topology-aware scheduling goldens (kueue_tpu/topology).
+
+Acceptance scenarios from the subsystem's contract, each run under BOTH
+the sequential referee and the batched device solver with identical
+results: required lowest-level packing, preferred fallback across levels,
+NO_FIT when no single domain can ever fit, same-tick cycle charging, the
+ledger release on finish, and the fragmentation-reducing victim
+preference under preemption. Plus device/host fit-kernel equivalence on
+randomized instances, serialization roundtrips, and the no-op guarantee
+for topology-free clusters.
+"""
+
+import numpy as np
+import pytest
+
+from kueue_tpu.api import serialization
+from kueue_tpu.api.types import (
+    Admission,
+    ClusterQueuePreemption,
+    PodSet,
+    PodSetAssignment,
+    ResourceFlavor,
+    TopologyAssignment,
+    TopologySpec,
+    Workload,
+)
+from kueue_tpu.controllers.runtime import Framework
+from kueue_tpu.models.flavor_fit import BatchSolver
+
+from tests.util import fq, make_cq, make_flavor, make_lq, rg
+
+
+@pytest.fixture(params=[False, True], ids=["referee", "batch"])
+def batch(request):
+    return request.param
+
+
+def topo_flavor(name="tpu", counts=(1, 2, 2), leaf_capacity=2):
+    return ResourceFlavor.make(
+        name,
+        topology=TopologySpec.uniform(("block", "rack", "host"),
+                                      counts, leaf_capacity))
+
+
+def build_fw(batch, cpu=100, counts=(1, 2, 2), leaf_capacity=2,
+             preemption=None):
+    fw = Framework(batch_solver=BatchSolver() if batch else None)
+    fw.create_resource_flavor(topo_flavor(counts=counts,
+                                          leaf_capacity=leaf_capacity))
+    fw.create_cluster_queue(
+        make_cq("cq", rg("cpu", fq("tpu", cpu=cpu)), preemption=preemption))
+    fw.create_local_queue(make_lq("main", cq="cq"))
+    return fw
+
+
+def wl(name, count, required=None, preferred=None, priority=0,
+       creation=100.0, cpu=1):
+    return Workload(
+        name=name, queue_name="main", priority=priority,
+        creation_time=creation,
+        pod_sets=[PodSet.make("main", count, topology_required=required,
+                              topology_preferred=preferred, cpu=cpu)])
+
+
+def ta_of(fw, name):
+    w = fw.workloads[f"default/{name}"]
+    assert w.admission is not None, f"{name} not admitted"
+    return w.admission.pod_set_assignments[0].topology_assignment
+
+
+# ---------------------------------------------------------------------------
+# required: lowest-level (deepest) packing
+# ---------------------------------------------------------------------------
+
+
+def test_required_packs_lowest_fitting_level(batch):
+    # host capacity 4: a 3-pod rack-required podset packs a single HOST
+    # (the lowest domain that fits), not just any rack.
+    fw = build_fw(batch, counts=(1, 2, 2), leaf_capacity=4)
+    fw.submit(wl("a", 3, required="rack"))
+    assert fw.run_until_settled() == 1
+    ta = ta_of(fw, "a")
+    assert ta.flavor == "tpu"
+    assert ta.levels == ("block", "rack", "host")
+    assert len(ta.domain) == 3
+    assert sum(n for _, n in ta.counts) == 3
+    assert len(ta.counts) == 1  # one host holds all three pods
+
+
+def test_required_spreads_within_one_domain_when_no_leaf_fits(batch):
+    # 3 pods, host capacity 2: no single host fits, but rack0 (4 slots)
+    # does — pods pack hosts of ONE rack.
+    fw = build_fw(batch, counts=(1, 2, 2), leaf_capacity=2)
+    fw.submit(wl("a", 3, required="rack"))
+    assert fw.run_until_settled() == 1
+    ta = ta_of(fw, "a")
+    assert ta.levels == ("block", "rack")
+    assert sum(n for _, n in ta.counts) == 3
+    leaves = [i for i, _ in ta.counts]
+    assert leaves == sorted(leaves) and max(leaves) <= 1  # rack0 = leaves 0,1
+
+
+# ---------------------------------------------------------------------------
+# preferred: fallback across levels, then unconstrained
+# ---------------------------------------------------------------------------
+
+
+def test_preferred_falls_back_up_the_hierarchy(batch):
+    # 6 pods preferred rack: racks hold 4, the block holds 8 — falls back
+    # to the block domain instead of failing.
+    fw = build_fw(batch, counts=(1, 2, 2), leaf_capacity=2)
+    fw.submit(wl("a", 6, preferred="rack"))
+    assert fw.run_until_settled() == 1
+    ta = ta_of(fw, "a")
+    assert ta.levels == ("block",)
+    assert ta.domain == ("block0",)
+    assert sum(n for _, n in ta.counts) == 6
+
+
+def test_preferred_places_unconstrained_when_nothing_fits(batch):
+    # 9 pods > whole tree (8 slots): preferred degrades to unconstrained
+    # placement (admitted, no topology assignment, no ledger charge).
+    fw = build_fw(batch, counts=(1, 2, 2), leaf_capacity=2)
+    fw.submit(wl("a", 9, preferred="rack"))
+    assert fw.run_until_settled() == 1
+    assert ta_of(fw, "a") is None
+    assert not fw.cache.topology.flavors["tpu"].any()
+
+
+# ---------------------------------------------------------------------------
+# required: NO_FIT / requeue semantics
+# ---------------------------------------------------------------------------
+
+
+def test_required_no_fit_when_no_domain_can_ever_fit(batch):
+    # 5 pods required rack, rack capacity 4: permanent NO_FIT.
+    fw = build_fw(batch, counts=(1, 2, 2), leaf_capacity=2)
+    fw.submit(wl("a", 5, required="rack"))
+    assert fw.run_until_settled() == 0
+    w = fw.workloads["default/a"]
+    assert not w.has_quota_reservation
+    cond = w.find_condition("QuotaReserved")
+    assert cond is not None and "can ever fit" in cond.message
+
+
+def test_required_blocked_by_occupancy_admits_after_release(batch):
+    fw = build_fw(batch, counts=(1, 2, 2), leaf_capacity=2)
+    fw.submit(wl("a", 3, required="rack"))
+    assert fw.run_until_settled() == 1
+    # rack0 now has 1 free slot, rack1 has 4: a 2-pod required podset
+    # best-fits rack1 (rack0 cannot hold it).
+    fw.submit(wl("b", 2, required="rack"))
+    assert fw.run_until_settled() == 1
+    assert ta_of(fw, "b").domain[:2] == ("block0", "rack1")
+    # A 4-pod required podset is blocked by occupancy (rack capacity 4
+    # exists, so NOT a permanent NO_FIT) ...
+    fw.submit(wl("c", 4, required="rack"))
+    assert fw.run_until_settled() == 0
+    w = fw.workloads["default/c"]
+    assert not w.has_quota_reservation
+    assert "insufficient free capacity" in w.find_condition(
+        "QuotaReserved").message
+    # ... until a release frees a contiguous rack.
+    fw.finish(fw.workloads["default/a"])
+    fw.finish(fw.workloads["default/b"])
+    assert fw.run_until_settled() == 1
+    assert ta_of(fw, "c") is not None
+
+
+def test_same_tick_admissions_share_occupancy(batch):
+    # Two 3-pod rack-required podsets in ONE tick: both solve against the
+    # same empty snapshot, but the admission cycle's side-tracked charge
+    # must route them to different racks.
+    fw = build_fw(batch, counts=(1, 2, 2), leaf_capacity=2)
+    fw.submit(wl("a", 3, required="rack", creation=1.0))
+    fw.submit(wl("b", 3, required="rack", creation=2.0))
+    assert fw.run_until_settled() == 2
+    doms = {ta_of(fw, "a").domain[:2], ta_of(fw, "b").domain[:2]}
+    assert doms == {("block0", "rack0"), ("block0", "rack1")}
+    assert int(fw.cache.topology.flavors["tpu"].sum()) == 6
+
+
+# ---------------------------------------------------------------------------
+# preemption: fragmentation-reducing victim preference
+# ---------------------------------------------------------------------------
+
+
+def _admit_with_topology(fw, name, leaf, rack, priority=0, creation=10.0):
+    """Directly admit a 2-pod background workload occupying one host."""
+    w = Workload(
+        name=name, queue_name="main", priority=priority,
+        creation_time=creation,
+        pod_sets=[PodSet.make("main", 2, cpu=1)])
+    w.admission = Admission(
+        cluster_queue="cq",
+        pod_set_assignments=[PodSetAssignment(
+            name="main", flavors={"cpu": "tpu"},
+            resource_usage={"cpu": 2000}, count=2,
+            topology_assignment=TopologyAssignment(
+                flavor="tpu", levels=("block", "rack"),
+                domain=("block0", rack), counts=((leaf, 2),)))])
+    w.set_condition("QuotaReserved", True, now=creation)
+    w.set_condition("Admitted", True, now=creation)
+    fw.workloads[w.key] = w
+    fw.cache.add_or_update_workload(w)
+    return w
+
+
+def test_preemption_prefers_victims_freeing_one_domain(batch):
+    # Quota full (8 cpu) and topology full (8 slots) with four 2-pod
+    # low-priority workloads, two per rack, admission times INTERLEAVED
+    # across racks — the reference ordering alone would evict the two
+    # newest (one from each rack). The topology hint must steer eviction
+    # to empty ONE rack instead.
+    fw = build_fw(
+        batch, cpu=8, counts=(1, 2, 2), leaf_capacity=2,
+        preemption=ClusterQueuePreemption(
+            within_cluster_queue="LowerPriority"))
+    a = _admit_with_topology(fw, "a", leaf=0, rack="rack0", creation=10.0)
+    b = _admit_with_topology(fw, "b", leaf=2, rack="rack1", creation=11.0)
+    c = _admit_with_topology(fw, "c", leaf=1, rack="rack0", creation=12.0)
+    d = _admit_with_topology(fw, "d", leaf=3, rack="rack1", creation=13.0)
+    assert int(fw.cache.topology.flavors["tpu"].sum()) == 8
+
+    fw.submit(wl("in", 4, required="rack", priority=5, cpu=1,
+                 creation=100.0))
+    fw.run_until_settled()
+    evicted = {name for name in "abcd"
+               if fw.workloads[f"default/{name}"].condition_true("Evicted")}
+    # Without the preference the newest-first order would pick {c, d}
+    # (one per rack); the hint groups rack0's occupants first.
+    assert evicted == {"a", "c"}, evicted
+    ta = ta_of(fw, "in")
+    assert ta is not None and ta.domain[:2] == ("block0", "rack0")
+
+
+# ---------------------------------------------------------------------------
+# device/host fit equivalence on randomized instances
+# ---------------------------------------------------------------------------
+
+
+def test_fit_kernel_matches_host_referee_randomized():
+    from kueue_tpu.topology import TopologyStage, build_topology_encoding
+    from kueue_tpu.api.types import TopologyLeaf
+
+    rng = np.random.RandomState(7)
+    flavors = {
+        "t1": topo_flavor("t1", counts=(2, 2, 2), leaf_capacity=4),
+        "t2": topo_flavor("t2", counts=(1, 3, 2), leaf_capacity=3),
+        # Irregular tree: hand-built leaves with mixed capacities.
+        "t3": ResourceFlavor.make("t3", topology=TopologySpec(
+            levels=("rack", "host"),
+            leaves=(TopologyLeaf(("r0", "h0"), 5),
+                    TopologyLeaf(("r0", "h1"), 1),
+                    TopologyLeaf(("r1", "h0"), 2)))),
+    }
+    enc = build_topology_encoding(flavors)
+    stage = TopologyStage(enc)
+    T, E = len(enc.flavor_names), enc.E
+    for trial in range(20):
+        used = rng.randint(0, 5, size=(T, E)).astype(np.int64)
+        items = []
+        for _ in range(17):
+            ti = int(rng.randint(T))
+            nl = int(enc.num_levels[ti])
+            items.append((ti, int(rng.randint(1, 10)),
+                          int(rng.randint(nl)), bool(rng.randint(2))))
+        host = stage._solve_items(items, used, use_device=False)
+        dev = stage._solve_items(items, used, use_device=True)
+        assert host == dev, f"trial {trial}: {host} != {dev}"
+
+
+# ---------------------------------------------------------------------------
+# serialization + ledger + gauges + no-op
+# ---------------------------------------------------------------------------
+
+
+def test_topology_serialization_roundtrips():
+    rf = ResourceFlavor.make("tpu", topology=TopologySpec.uniform(
+        ("rack", "host"), (2, 2), 3))
+    doc = serialization.encode("ResourceFlavor", rf)
+    _, back = serialization.decode(doc)
+    assert back == rf
+
+    w = wl("w", 3, required="rack")
+    w.admission = Admission(
+        cluster_queue="cq",
+        pod_set_assignments=[PodSetAssignment(
+            name="main", flavors={"cpu": "tpu"},
+            resource_usage={"cpu": 3000}, count=3,
+            topology_assignment=TopologyAssignment(
+                flavor="tpu", levels=("rack",), domain=("rack0",),
+                counts=((0, 2), (1, 1))))])
+    doc = serialization.encode("Workload", w)
+    _, back = serialization.decode(doc)
+    serialization.decode_workload_status(doc, back)
+    assert back.pod_sets[0].topology_required == "rack"
+    assert back.admission.pod_set_assignments[0].topology_assignment \
+        == w.admission.pod_set_assignments[0].topology_assignment
+    # preferred roundtrips through the same stanza
+    w2 = wl("w2", 3, preferred="host")
+    _, back2 = serialization.decode(serialization.encode("Workload", w2))
+    assert back2.pod_sets[0].topology_preferred == "host"
+    assert back2.pod_sets[0].topology_required is None
+
+
+def test_topology_webhook_rules():
+    import kueue_tpu.webhooks as webhooks
+    from kueue_tpu.api.types import TopologyLeaf
+
+    bad = ResourceFlavor.make("f", topology=TopologySpec(
+        levels=("rack", "rack"),
+        leaves=(TopologyLeaf(("r0",), 0), TopologyLeaf(("r0",), 1))))
+    errs = webhooks.validate_resource_flavor(bad)
+    assert any("duplicate 'rack'" in e for e in errs)
+    assert any("one value per level" in e for e in errs)
+    assert any("capacity" in e for e in errs)
+    assert any("duplicate leaf" in e for e in errs)
+
+    both = wl("w", 1, required="rack")
+    both.pod_sets[0].topology_preferred = "host"
+    errs = webhooks.validate_workload(both)
+    assert any("mutually exclusive" in e for e in errs)
+
+
+def test_ledger_charges_and_releases_through_cache_rebuild():
+    fw = build_fw(False, counts=(1, 2, 2), leaf_capacity=2)
+    fw.submit(wl("a", 3, required="rack"))
+    assert fw.run_until_settled() == 1
+    assert int(fw.cache.topology.flavors["tpu"].sum()) == 3
+    # A rebuilt cache (HA replay / restore path) re-accounts leaf state
+    # from the recorded admissions.
+    fw2 = build_fw(False, counts=(1, 2, 2), leaf_capacity=2)
+    fw2.restore_workload(fw.workloads["default/a"])
+    assert int(fw2.cache.topology.flavors["tpu"].sum()) == 3
+    # Eviction / finish releases.
+    fw.finish(fw.workloads["default/a"])
+    assert int(fw.cache.topology.flavors["tpu"].sum()) == 0
+
+
+def test_fragmentation_gauge_reports_per_level():
+    from kueue_tpu.metrics import REGISTRY
+
+    fw = build_fw(False, counts=(1, 2, 2), leaf_capacity=2)
+    fw.submit(wl("a", 3, required="rack"))
+    assert fw.run_until_settled() == 1
+    fw.update_metrics_gauges()
+    # rack level: rack0 has 1 free, rack1 has 4 -> frag = 1 - 4/5.
+    assert REGISTRY.topology_fragmentation.get("tpu", "rack") \
+        == pytest.approx(1.0 - 4.0 / 5.0)
+    # block level: one block holds all free slots -> 0 fragmentation.
+    assert REGISTRY.topology_fragmentation.get("tpu", "block") == 0.0
+
+
+def test_topology_free_cluster_is_a_no_op(batch):
+    """No flavor declares a topology: the snapshot view stays None, no
+    stage is built, and topology-requesting workloads (preferred) admit
+    unconstrained exactly like before the subsystem existed."""
+    fw = Framework(batch_solver=BatchSolver() if batch else None)
+    fw.create_resource_flavor(make_flavor("default"))
+    fw.create_cluster_queue(make_cq("cq", rg("cpu", fq("default", cpu=8))))
+    fw.create_local_queue(make_lq("main", cq="cq"))
+    fw.submit(Workload(name="plain", queue_name="main",
+                       pod_sets=[PodSet.make("m", 2, cpu=1)]))
+    assert fw.run_until_settled() == 1
+    assert fw.scheduler._mirror.refresh().topology is None
+    assert fw.scheduler._topo_stage is None
+    assert not fw.cache.topology.flavors
+    psa = fw.workloads["default/plain"].admission.pod_set_assignments[0]
+    assert psa.topology_assignment is None
