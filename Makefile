@@ -9,7 +9,23 @@
 
 PYTHON ?= python
 
-.PHONY: test test-fast bench bench-smoke native lint install serve dryrun
+.PHONY: help test test-fast bench bench-smoke native lint verify-static \
+	install serve dryrun
+
+help:
+	@echo "kueue-tpu developer targets:"
+	@echo "  make test           full pytest suite"
+	@echo "  make test-fast      pytest, stop at first failure"
+	@echo "  make lint           kueuelint ast engine (jit purity, locks,"
+	@echo "                      retrace, API hygiene) + ruff if installed"
+	@echo "  make verify-static  ALL analysis engines: ast + flow (lock"
+	@echo "                      graph, ledger flow) + trace (kueueverify"
+	@echo "                      jaxpr rules TRC01-04; needs jax)"
+	@echo "  make bench          full-scale benchmark (north-star shapes)"
+	@echo "  make bench-smoke    tiny-shape bench for CI/laptops"
+	@echo "  make native         build the C++ runtime pieces"
+	@echo "  make serve          run the API server"
+	@echo "  make dryrun         compile-check the flagship jit path"
 
 test:
 	$(PYTHON) -m pytest tests/ -q
@@ -45,6 +61,12 @@ lint:
 	else \
 	  echo "ruff not installed; skipped (pip install -e .[dev])"; \
 	fi
+
+# Every analysis engine at the CI gate severity: ast + flow + trace
+# (kueueverify lowers the registered solver kernels to jaxprs — needs jax,
+# unlike `make lint` which stays import-free).
+verify-static:
+	$(PYTHON) -m kueue_tpu.analysis --engine all --fail-on error kueue_tpu/
 
 install:
 	$(PYTHON) -m pip install -e .

@@ -181,6 +181,12 @@ def run_config(*, label, num_cqs, num_cohorts, num_flavors, backlog, ticks,
     phases = REGISTRY.tick_phase_seconds
     phase_base = dict(phases.sums)
     verbose = os.environ.get("KUEUE_BENCH_VERBOSE") == "1"
+    # Compile-proof ticks, verified on EVERY bench run (not just in
+    # tests/test_prewarm.py): any XLA compile landing inside the measured
+    # window means a bucket rotation escaped the idle-window prewarm and
+    # the p99 below is a compile cliff, not a scheduling number.
+    solver = getattr(fw.scheduler, "batch_solver", None)
+    cold_before = getattr(solver, "cold_dispatches", 0) if solver else 0
     times = []
     tick_phases = []
     admitted = 0
@@ -200,6 +206,15 @@ def run_config(*, label, num_cqs, num_cohorts, num_flavors, backlog, ticks,
             gc.collect()   # idle-window cycle reaping (untimed)
     admitted = fw.scheduler.metrics.admitted - base_admitted
     preempted = fw.scheduler.metrics.preempted - preempted_before
+    cold_during = (getattr(solver, "cold_dispatches", 0) - cold_before
+                   if solver else 0)
+    if cold_during:
+        raise RuntimeError(
+            f"[{label}] {cold_during} cold dispatch(es) inside the measured "
+            f"window: a head-count bucket rotation compiled in-tick, so the "
+            "reported p99 is an XLA compile cliff. Fix the prewarm path "
+            "(BatchSolver._maybe_prewarm / prewarm_idle) or raise "
+            "KUEUE_PREWARM_MAX_BUCKET before trusting this run.")
     phase_means = {
         k[0]: 1000.0 * (phases.sums[k] - phase_base.get(k, 0.0)) / ticks
         for k in sorted(phases.sums)}
@@ -223,6 +238,11 @@ def run_config(*, label, num_cqs, num_cohorts, num_flavors, backlog, ticks,
         "mean_ms": round(float(times_ms.mean()), 3),
         "admitted": admitted,
         "preempted": preempted,
+        # Compile-proof-tick evidence: cold XLA dispatches during the
+        # measured window (asserted zero above) and over the whole run.
+        "cold_dispatches": cold_during,
+        "cold_dispatches_total": getattr(solver, "cold_dispatches", 0)
+        if solver else 0,
         "admissions_per_s": round(admitted / (sum(times) or 1e-9), 1),
         "phase_means_ms": {k: round(v, 2) for k, v in phase_means.items()
                            if v >= 0.05},

@@ -2,21 +2,38 @@
 
 Rule families (see `python -m kueue_tpu.analysis --list-rules`):
 
-  JIT01-03  jit purity: host syncs, traced control flow, closure mutation
-  RET01-02  retrace hygiene: static-arg hazards, closure captures
-  LOCK01-02 lock discipline: blocking under a lock, inconsistent guarding
-  API01-03  API hygiene: mutable defaults, freezable dataclasses,
-            serialization roundtrip coverage
+  ast engine (default; pure-AST, import-free)
+    JIT01-03  jit purity: host syncs, traced control flow, closure mutation
+    RET01-02  retrace hygiene: static-arg hazards, closure captures
+    LOCK01-02 lock discipline: blocking under a lock, inconsistent guarding
+    API01-03  API hygiene: mutable defaults, freezable dataclasses,
+              serialization roundtrip coverage
+    W001      stale `# kueuelint: disable=RULE` suppressions
 
-Suppress a finding on its line with `# kueuelint: disable=RULE` (several:
-`disable=RULE1,RULE2`; everything: bare `disable`); suppress a whole file
-with `# kueuelint: skip-file`.
+  flow engine (`--engine flow`; whole-program AST flow analysis)
+    LOCK03    lock-acquisition order cycles (potential deadlocks)
+    LED01     ledger charge without release on a forget/delete/error path
+
+  trace engine (`--engine trace`; kueueverify — lowers every registered
+  solver kernel to a jaxpr and interprets the equations; needs jax)
+    TRC01     dtype-promotion hazards (mixed-dtype writes, silent casts)
+    TRC02     sentinel/interval overflow through the kernel arithmetic
+    TRC03     recompile hazards: jaxpr structure must match across
+              adjacent head-count buckets (one XLA compile per bucket)
+    TRC04     forbidden effects (callbacks/debug prints) in jitted kernels
+
+`--engine all` runs every engine. Suppress a finding on its line with
+`# kueuelint: disable=RULE` (several: `disable=RULE1,RULE2`; everything:
+bare `disable`); suppress a whole file with `# kueuelint: skip-file`.
 """
 
 from kueue_tpu.analysis.core import (  # noqa: F401
     Finding, Rule, Severity, all_rules, run_analysis)
-# Rule modules register themselves into the registry on import.
+# Rule modules register themselves into the registry on import. The trace
+# module defers its jax import to rule execution, so importing the package
+# stays jax-free (the ast/flow engines never need it).
 from kueue_tpu.analysis import api_rules, jit_rules, lock_rules  # noqa: F401
+from kueue_tpu.analysis import flow_rules, trace_rules  # noqa: F401
 from kueue_tpu.analysis.reporters import (  # noqa: F401
     render_json, render_text)
 

@@ -26,10 +26,17 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         prog="kueuelint",
         description="Codebase-specific static analysis for kueue-tpu: "
                     "jit purity, retrace hygiene, lock discipline, API "
-                    "hygiene.")
+                    "hygiene (ast engine); lock-order/ledger-flow analysis "
+                    "(flow engine); trace-level jaxpr verification of the "
+                    "solver kernels — kueueverify (trace engine).")
     parser.add_argument("paths", nargs="*",
                         help="files or directories to analyze "
                              "(default: the kueue_tpu package)")
+    parser.add_argument("--engine", choices=("ast", "flow", "trace", "all"),
+                        default="ast",
+                        help="analysis engine: ast (default, import-free), "
+                             "flow (lock graph + ledger flow), trace "
+                             "(jaxpr verification; imports jax), or all")
     parser.add_argument("--format", "-f", choices=("text", "json"),
                         default="text")
     parser.add_argument("--fail-on", choices=("error", "warning"),
@@ -49,7 +56,10 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         return 0
 
     # A typo'd --select would otherwise filter the registry to nothing and
-    # report a clean run — fail fast on unknown ids instead.
+    # report a clean run — fail fast on unknown ids instead. Likewise a
+    # --select naming a rule of an engine that is not active (e.g.
+    # `--select TRC02` without `--engine trace`) would run nothing and
+    # exit 0, reading as "clean" when the rule never executed.
     from kueue_tpu.analysis.core import all_rules
     known = {r.id for r in all_rules()}
     for opt, ids in (("--select", args.select), ("--disable", args.disable)):
@@ -59,6 +69,25 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                   f"{', '.join(unknown)} (see --list-rules)",
                   file=sys.stderr)
             return 2
+    if args.select and args.engine != "all":
+        engine_of = {r.id: r.engine for r in all_rules()}
+        inactive = sorted(rid for rid in set(args.select)
+                          if engine_of[rid] != args.engine)
+        if inactive:
+            needed = sorted({engine_of[rid] for rid in inactive})
+            print(f"kueuelint: --select {', '.join(inactive)} needs "
+                  f"--engine {'/'.join(needed)} (or --engine all); the "
+                  f"{args.engine} engine would never run it",
+                  file=sys.stderr)
+            return 2
+    if args.select and set(args.select) == {"W001"}:
+        # W001 judges the suppressions of the rules that RAN; alone it
+        # has nothing to judge and would report a misleading clean run.
+        print("kueuelint: --select W001 alone runs no other rules, so no "
+              "suppression can be judged stale; run without --select (or "
+              "select W001 together with the rules to audit)",
+              file=sys.stderr)
+        return 2
 
     paths = args.paths or _default_paths()
     for p in paths:
@@ -66,9 +95,10 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             print(f"kueuelint: path does not exist: {p}", file=sys.stderr)
             return 2
 
-    findings = run_analysis(paths, select=args.select, disable=args.disable)
+    findings = run_analysis(paths, select=args.select, disable=args.disable,
+                            engine=args.engine)
     if args.format == "json":
-        print(render_json(findings))
+        print(render_json(findings, engine=args.engine))
     else:
         print(render_text(findings))
 

@@ -14,7 +14,9 @@ from __future__ import annotations
 import ast
 import dataclasses
 import enum
+import io
 import re
+import tokenize
 from pathlib import Path
 from typing import Callable, Dict, Iterable, List, Optional, Sequence, Tuple
 
@@ -78,10 +80,17 @@ class SourceFile:
             self.tree = ast.parse(text, filename=str(path))
         except SyntaxError as exc:
             self.parse_error = exc
-        self.skip_file = bool(_SKIP_FILE_RE.search(text))
+        # Directives are honored only in REAL `#` comments (tokenized), so
+        # a docstring that merely MENTIONS the syntax neither suppresses
+        # anything nor reads as a stale suppression to W001. Unparseable
+        # files fall back to the line scan — a suppression must keep
+        # working while its file is mid-edit.
+        comments = self._comment_lines()
+        self.skip_file = any(
+            _SKIP_FILE_RE.search(c) for c in comments.values())
         # line number -> set of suppressed rule ids (or _ALL)
         self.suppressions: Dict[int, set] = {}
-        for i, line in enumerate(self.lines, start=1):
+        for i, line in comments.items():
             m = _DISABLE_RE.search(line)
             if not m:
                 continue
@@ -91,6 +100,18 @@ class SourceFile:
             else:
                 for r in rules.replace(",", " ").split():
                     self.suppressions.setdefault(i, set()).add(r.strip())
+
+    def _comment_lines(self) -> Dict[int, str]:
+        """line number -> comment text, for real COMMENT tokens only."""
+        try:
+            return {
+                tok.start[0]: tok.string
+                for tok in tokenize.generate_tokens(
+                    io.StringIO(self.text).readline)
+                if tok.type == tokenize.COMMENT}
+        except (tokenize.TokenError, IndentationError, SyntaxError):
+            return {i: line for i, line in enumerate(self.lines, start=1)
+                    if "#" in line}
 
     def suppressed(self, rule: str, line: int) -> bool:
         if self.skip_file:
@@ -116,6 +137,15 @@ class Rule:
     any fragment occurs in its posix path. None means every file. Project
     rules (`project=True`) receive the whole context once instead of being
     called per file.
+
+    `engine` selects which analysis engine runs the rule:
+
+      ast    pure-AST, no imports, milliseconds (the default engine);
+      flow   whole-program flow analysis over the ASTs (lock-order graph,
+             ledger charge/release pairing) — still import-free;
+      trace  jaxpr-level verification (kueueverify): lowers the registered
+             solver kernels with jax.make_jaxpr and interprets the
+             equations — needs jax, runs in seconds.
     """
 
     id: str
@@ -124,6 +154,7 @@ class Rule:
     check: Callable[..., Iterable[Finding]]
     path_fragments: Optional[Tuple[str, ...]] = None
     project: bool = False
+    engine: str = "ast"
 
     def applies_to(self, f: SourceFile) -> bool:
         if self.path_fragments is None:
@@ -165,17 +196,30 @@ def collect_files(paths: Sequence[str]) -> List[SourceFile]:
     return out
 
 
+ENGINES = ("ast", "flow", "trace")
+
+
 def run_analysis(paths: Sequence[str],
                  select: Optional[Sequence[str]] = None,
-                 disable: Optional[Sequence[str]] = None) -> List[Finding]:
+                 disable: Optional[Sequence[str]] = None,
+                 engine: str = "ast") -> List[Finding]:
     """Analyze `paths` (files or directories) and return active findings,
-    with per-line suppressions already applied."""
+    with per-line suppressions already applied.
+
+    `engine` selects the analysis engine(s): "ast" (default), "flow",
+    "trace", or "all". The trace engine imports jax; the others never
+    import anything."""
+    if engine != "all" and engine not in ENGINES:
+        raise ValueError(f"unknown engine {engine!r} "
+                         f"(choose from {ENGINES + ('all',)})")
+    engines = set(ENGINES) if engine == "all" else {engine}
     # Rule modules register on import; pulled in here to avoid import cycles.
     from kueue_tpu.analysis import api_rules, jit_rules, lock_rules  # noqa: F401
+    from kueue_tpu.analysis import flow_rules, trace_rules  # noqa: F401
 
     files = collect_files(paths)
     ctx = AnalysisContext(files)
-    rules = all_rules()
+    rules = [r for r in all_rules() if r.engine in engines]
     if select:
         wanted = set(select)
         rules = [r for r in rules if r.id in wanted]
@@ -193,6 +237,8 @@ def run_analysis(paths: Sequence[str],
                 col=f.parse_error.offset or 0,
                 message=f"syntax error: {f.parse_error.msg}"))
     for rule in rules:
+        if rule.id == W001_ID:
+            continue  # runs last, over the raw findings (below)
         if rule.project:
             findings.extend(rule.check(ctx))
             continue
@@ -201,14 +247,69 @@ def run_analysis(paths: Sequence[str],
                 continue
             findings.extend(rule.check(f, ctx))
 
+    if any(r.id == W001_ID for r in rules):
+        findings.extend(_stale_suppressions(ctx, rules, findings))
+
     active = []
+    # Findings are frozen (hashable): identical findings reported through
+    # several rules (e.g. a kernel-lowering failure surfaced by every
+    # trace rule so --select cannot drop it) collapse to one.
+    seen = set()
     for fin in findings:
+        if fin in seen:
+            continue
+        seen.add(fin)
         src = ctx.by_path.get(fin.path)
         if src is not None and src.suppressed(fin.rule, fin.line):
             continue
         active.append(fin)
     active.sort(key=lambda x: (x.path, x.line, x.col, x.rule))
     return active
+
+
+# ---------------------------------------------------------------------------
+# W001 — stale suppressions
+# ---------------------------------------------------------------------------
+
+W001_ID = "W001"
+
+
+def _stale_suppressions(ctx: AnalysisContext, rules: Sequence[Rule],
+                        raw: Sequence[Finding]) -> List[Finding]:
+    """A `# kueuelint: disable=RULE` comment whose rule did not fire on
+    that line is dead weight — it either outlived the code it excused or
+    names the wrong line, and both silently mask a future regression.
+
+    Only rules that actually RAN are considered (a TRC suppression is not
+    stale in an ast-only run), bare `disable` / `skip-file` are exempt
+    (they make no per-rule claim), and W001 never judges itself."""
+    ran = {r.id for r in rules}
+    fired = {(f.path, f.line, f.rule) for f in raw}
+    out: List[Finding] = []
+    for f in ctx.files:
+        # A file that failed to parse ran no rules at all, so none of its
+        # suppressions had a chance to fire — they are not stale (the
+        # suppression must keep working while the file is mid-edit).
+        if f.skip_file or f.parse_error is not None:
+            continue
+        for line, ruleset in sorted(f.suppressions.items()):
+            for rid in sorted(r for r in ruleset if r is not _ALL):
+                if rid == W001_ID or rid not in ran:
+                    continue
+                if (f.display_path, line, rid) not in fired:
+                    out.append(Finding(
+                        rule=W001_ID, severity=Severity.WARNING,
+                        path=f.display_path, line=line, col=0,
+                        message=f"stale suppression: {rid} no longer fires "
+                                "on this line — remove the disable comment "
+                                "(or move it to the line that needs it)"))
+    return out
+
+
+register(Rule(
+    id=W001_ID, severity=Severity.WARNING,
+    summary="stale suppression: the named rule no longer fires on the line",
+    check=lambda ctx: (), project=True))
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,414 @@
+"""Flow engine: whole-program lock-order and ledger-flow analysis.
+
+LOCK01/LOCK02 (ast engine) reason about one `with` block at a time; these
+rules reason about the PROGRAM:
+
+  LOCK03  builds the lock-acquisition order graph across the controller
+          runtime (scheduler/, queue/, core/, controllers/, server/):
+          nodes are lock identities (`Cache._lock`, `Manager._cond`, a
+          module-level lock), an edge A→B means some code path acquires B
+          while holding A — either a nested `with`, or a call (resolved
+          through `self.X = Class()` attribute types, `self` methods and
+          module functions, transitively) into code that acquires B.
+          Any cycle in that graph is a potential deadlock the moment two
+          threads take the locks from opposite ends; each cycle is
+          reported once, naming the acquisition sites. Self-edges are
+          ignored (the repo's locks on reentrant paths are RLocks).
+
+  LED01   pairs ledger charges with releases. A "charge site" is a call
+          like `X.charge(adm, 1)` / `X.charge(adm, -1)` (the
+          TopologyLedger protocol) or the quota twin
+          `add_workload_usage` / `remove_workload_usage`. Two checks:
+
+            * balance: a ledger charged (+) somewhere in a class/file
+              must be released (-) somewhere in it — an assume/add path
+              without the forget/delete twin leaks occupancy forever
+              (HA replay then rebuilds wrong leaf state);
+            * error exits: inside one function, a positive charge
+              followed by a lexically reachable `raise` leaks unless the
+              charge sits in a `try` whose handler/finally releases it —
+              the cache mutation and the charge must commit atomically.
+
+Both rules are pure-AST (no imports), like the ast engine; they live in a
+separate engine because the whole-program fixed point is quadratic-ish
+and the ast engine promises per-file millisecond runs.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from kueue_tpu.analysis.core import (
+    AnalysisContext, Finding, Rule, Severity, SourceFile, dotted_name,
+    finding, register)
+from kueue_tpu.analysis.lock_rules import _looks_like_lock
+
+_FLOW_PATHS = ("scheduler/", "core/", "queue/", "controllers/", "server/",
+               "topology/", "metrics.py", "__main__.py", "fixtures/lint/")
+
+
+def _in_scope(f: SourceFile) -> bool:
+    posix = f.path.as_posix()
+    return f.tree is not None and any(p in posix for p in _FLOW_PATHS)
+
+
+# ---------------------------------------------------------------------------
+# Program model: classes, methods, attribute types, module functions
+# ---------------------------------------------------------------------------
+
+
+class _Func:
+    """One function/method with its lock behavior."""
+
+    def __init__(self, qualname: str, node: ast.AST, src: SourceFile,
+                 cls: Optional[str], self_name: Optional[str]):
+        self.qualname = qualname
+        self.node = node
+        self.src = src
+        self.cls = cls
+        self.self_name = self_name
+        # lock ids acquired anywhere in this function (directly), and the
+        # transitive closure after the fixed point.
+        self.direct_locks: Set[str] = set()
+        self.all_locks: Set[str] = set()
+        # unresolved calls as (kind, name) for the fixed point
+        self.calls: List[Tuple[str, str, ast.Call]] = []
+
+
+class _Program:
+    def __init__(self, files: Sequence[SourceFile]):
+        self.funcs: Dict[str, _Func] = {}           # qualname -> func
+        self.methods: Dict[str, List[str]] = {}     # method name -> quals
+        self.attr_types: Dict[Tuple[str, str], str] = {}  # (cls, attr) -> cls
+        self.classes: Set[str] = set()
+        for f in files:
+            self._index(f)
+
+    def _index(self, f: SourceFile) -> None:
+        for node in f.tree.body:
+            if isinstance(node, ast.ClassDef):
+                self.classes.add(node.name)
+                for item in node.body:
+                    if isinstance(item, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                        self._add(f, item, node.name)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._add(f, node, None)
+        # attribute types: `self.X = Class(...)` anywhere in the class
+        for cls in ast.walk(f.tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            for node in ast.walk(cls):
+                if not isinstance(node, ast.Assign):
+                    continue
+                # First class-looking constructor call anywhere in the
+                # assigned expression (covers `x = C()`, `x = C() if cond
+                # else y`, `x = wrap(C())`).
+                ctor = None
+                for sub in ast.walk(node.value):
+                    if isinstance(sub, ast.Call):
+                        name = dotted_name(sub.func)
+                        if name is None:
+                            continue
+                        leaf = name.rsplit(".", 1)[-1]
+                        if leaf[:1].isupper():
+                            ctor = leaf
+                            break
+                if ctor is None:
+                    continue
+                for t in node.targets:
+                    if isinstance(t, ast.Attribute) \
+                            and isinstance(t.value, ast.Name) \
+                            and t.value.id in ("self", "cls"):
+                        self.attr_types[(cls.name, t.attr)] = ctor
+
+    def _add(self, f: SourceFile, node, cls: Optional[str]) -> None:
+        qual = f"{cls}.{node.name}" if cls else \
+            f"{f.path.stem}:{node.name}"
+        self_name = None
+        if cls and node.args.args:
+            self_name = node.args.args[0].arg
+        fn = _Func(qual, node, f, cls, self_name)
+        self.funcs[qual] = fn
+        self.methods.setdefault(node.name, []).append(qual)
+
+    # -- resolution ----------------------------------------------------------
+
+    def resolve_call(self, caller: _Func, call: ast.Call) -> List[_Func]:
+        """Callees of `call` within the analyzed program (best effort)."""
+        name = dotted_name(call.func)
+        if name is None:
+            return []
+        parts = name.split(".")
+        out: List[_Func] = []
+        if caller.self_name and parts[0] == caller.self_name:
+            if len(parts) == 2:                      # self.m()
+                q = f"{caller.cls}.{parts[1]}"
+                if q in self.funcs:
+                    out.append(self.funcs[q])
+            elif len(parts) == 3:                    # self.attr.m()
+                target_cls = self.attr_types.get((caller.cls, parts[1]))
+                if target_cls:
+                    q = f"{target_cls}.{parts[2]}"
+                    if q in self.funcs:
+                        out.append(self.funcs[q])
+        elif len(parts) == 1:                        # module function f()
+            for q in self.methods.get(parts[0], []):
+                fn = self.funcs[q]
+                if fn.cls is None and fn.src is caller.src:
+                    out.append(fn)
+        elif len(parts) == 2 and parts[0] in self.classes:
+            q = name                                 # Class.m() / ctor chain
+            if q in self.funcs:
+                out.append(self.funcs[q])
+        return out
+
+
+def _lock_id(fn: _Func, expr: ast.AST) -> Optional[str]:
+    """Stable identity of a lock-ish context manager expression."""
+    name = _looks_like_lock(expr)
+    if name is None:
+        return None
+    parts = name.split(".")
+    if fn.self_name and parts[0] == fn.self_name and len(parts) >= 2:
+        return f"{fn.cls}.{parts[-1]}"
+    return f"{fn.src.path.stem}:{name}"
+
+
+# ---------------------------------------------------------------------------
+# LOCK03 — lock-acquisition order cycles
+# ---------------------------------------------------------------------------
+
+
+def _check_lock03(ctx: AnalysisContext):
+    files = [f for f in ctx.files if _in_scope(f)]
+    if not files:
+        return []
+    prog = _Program(files)
+
+    # Pass 1: direct acquisitions per function.
+    for fn in prog.funcs.values():
+        for node in ast.walk(fn.node):
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    lid = _lock_id(fn, item.context_expr)
+                    if lid:
+                        fn.direct_locks.add(lid)
+        fn.all_locks = set(fn.direct_locks)
+
+    # Pass 2: transitive closure of "locks this function may acquire".
+    changed = True
+    rounds = 0
+    while changed and rounds < 50:
+        changed = False
+        rounds += 1
+        for fn in prog.funcs.values():
+            for node in ast.walk(fn.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                for callee in prog.resolve_call(fn, node):
+                    extra = callee.all_locks - fn.all_locks
+                    if extra:
+                        fn.all_locks |= extra
+                        changed = True
+
+    # Pass 3: edges — while holding L, what gets acquired?
+    edges: Dict[Tuple[str, str], Tuple[SourceFile, ast.AST, str]] = {}
+    for fn in prog.funcs.values():
+        for node in ast.walk(fn.node):
+            if not isinstance(node, (ast.With, ast.AsyncWith)):
+                continue
+            held = [
+                lid for item in node.items
+                for lid in [_lock_id(fn, item.context_expr)] if lid]
+            if not held:
+                continue
+            for inner in ast.walk(node):
+                if isinstance(inner, (ast.With, ast.AsyncWith)) \
+                        and inner is not node:
+                    for item in inner.items:
+                        lid = _lock_id(fn, item.context_expr)
+                        for h in held:
+                            if lid and lid != h:
+                                edges.setdefault(
+                                    (h, lid),
+                                    (fn.src, inner,
+                                     f"nested `with` in {fn.qualname}"))
+                elif isinstance(inner, ast.Call):
+                    for callee in prog.resolve_call(fn, inner):
+                        for lid in callee.all_locks:
+                            for h in held:
+                                if lid != h:
+                                    edges.setdefault(
+                                        (h, lid),
+                                        (fn.src, inner,
+                                         f"{fn.qualname} calls "
+                                         f"{callee.qualname}"))
+
+    # Pass 4: cycles. DFS over the edge graph; report each cycle once.
+    graph: Dict[str, List[str]] = {}
+    for a, b in edges:
+        graph.setdefault(a, []).append(b)
+    reported: Set[frozenset] = set()
+
+    def dfs(start: str, node: str, path: List[str], seen: Set[str]):
+        for nxt in graph.get(node, ()):
+            if nxt == start and len(path) >= 1:
+                cyc = path + [start]
+                key = frozenset(cyc)
+                if key in reported:
+                    continue
+                reported.add(key)
+                yield cyc
+            elif nxt not in seen:
+                seen.add(nxt)
+                yield from dfs(start, nxt, path + [nxt], seen)
+
+    out: List[Finding] = []
+    for start in sorted(graph):
+        for cyc in dfs(start, start, [start], {start}):
+            order = " -> ".join(cyc)
+            src, node, how = edges[(cyc[0], cyc[1])]
+            sites = "; ".join(
+                f"{edges[(a, b)][2]} at "
+                f"{edges[(a, b)][0].display_path}:"
+                f"{edges[(a, b)][1].lineno}"
+                for a, b in zip(cyc, cyc[1:]))
+            out.append(finding(
+                LOCK03, src, node,
+                f"lock-order cycle {order}: two threads entering from "
+                f"opposite ends deadlock ({sites}) — impose one global "
+                "acquisition order or narrow one critical section"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# LED01 — ledger charges without releases
+# ---------------------------------------------------------------------------
+
+_CHARGE_PAIRS = {
+    # method name -> (ledger family, sign); receiver refines the family
+    "add_workload_usage": ("workload_usage", +1),
+    "remove_workload_usage": ("workload_usage", -1),
+}
+
+
+def _charge_sign(call: ast.Call) -> Optional[int]:
+    """Sign of an explicit `X.charge(obj, sign)` call (the TopologyLedger
+    protocol), resolved for literal +1/-1 (also `sign=...` keywords)."""
+    args = list(call.args)
+    for kw in call.keywords:
+        if kw.arg == "sign":
+            args.append(kw.value)
+    if not args:
+        return None
+    node = args[-1]
+    if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.USub):
+        node = node.operand
+        neg = True
+    else:
+        neg = False
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return -node.value if neg else node.value
+    return None
+
+
+def _ledger_sites(f: SourceFile):
+    """(ledger key, sign, call node, enclosing function node) for every
+    charge/release site in the file. The key is class-qualified so two
+    unrelated ledgers never pair."""
+    funcs: List[Tuple[Optional[str], ast.AST]] = []
+    for node in f.tree.body:
+        if isinstance(node, ast.ClassDef):
+            for item in node.body:
+                if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    funcs.append((node.name, item))
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            funcs.append((None, node))
+    for cls, fn in funcs:
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call) \
+                    or not isinstance(node.func, ast.Attribute):
+                continue
+            method = node.func.attr
+            recv = dotted_name(node.func.value) or "<expr>"
+            scope = cls or f.path.stem
+            if method == "charge":
+                sign = _charge_sign(node)
+                if sign in (1, -1):
+                    # normalize `self.x.charge` and `x.charge` receivers
+                    leaf = recv.split(".", 1)[-1] if recv.startswith(
+                        ("self.", "cls.")) else recv
+                    yield f"{scope}:{leaf}.charge", sign, node, fn
+            elif method in _CHARGE_PAIRS:
+                family, sign = _CHARGE_PAIRS[method]
+                yield f"{scope}:{family}", sign, node, fn
+
+
+def _raise_after(fn: ast.AST, charge: ast.Call) -> Optional[ast.Raise]:
+    """A `raise` statement lexically after the charge inside the same
+    function body — the error exit that leaves the ledger charged. A
+    charge wrapped in a `try` with a handler or finally is exempt (the
+    rollback lives there)."""
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Try) \
+                and node.lineno <= charge.lineno \
+                and (node.end_lineno or node.lineno) >= charge.lineno \
+                and (node.handlers or node.finalbody):
+            return None
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Raise) and node.lineno > charge.lineno:
+            return node
+    return None
+
+
+def _check_led01(ctx: AnalysisContext):
+    out: List[Finding] = []
+    for f in ctx.files:
+        if not _in_scope(f):
+            continue
+        sites = list(_ledger_sites(f))
+        by_key: Dict[str, Dict[int, List]] = {}
+        for key, sign, node, fn in sites:
+            by_key.setdefault(key, {}).setdefault(sign, []).append(
+                (node, fn))
+        for key, signs in sorted(by_key.items()):
+            if +1 in signs and -1 not in signs:
+                node, _fn = signs[+1][0]
+                out.append(finding(
+                    LED01, f, node,
+                    f"ledger `{key.split(':', 1)[1]}` is charged here but "
+                    "never released in this scope — every assume/add "
+                    "charge needs the forget/delete twin, or occupancy "
+                    "leaks forever (and HA replay rebuilds it wrong)"))
+            if -1 in signs and +1 not in signs:
+                node, _fn = signs[-1][0]
+                out.append(finding(
+                    LED01, f, node,
+                    f"ledger `{key.split(':', 1)[1]}` is released here but "
+                    "never charged in this scope — a double-release goes "
+                    "negative silently"))
+            for node, fn in signs.get(+1, ()):
+                r = _raise_after(fn, node)
+                if r is not None:
+                    out.append(finding(
+                        LED01, f, node,
+                        f"ledger charge can leak on the error exit at "
+                        f"line {r.lineno}: the later `raise` leaves the "
+                        "charge applied — release in a try/finally or "
+                        "charge after the last failure point"))
+    return out
+
+
+LOCK03 = register(Rule(
+    id="LOCK03", severity=Severity.ERROR,
+    summary="lock-acquisition order cycle (potential deadlock) across the "
+            "controller runtime",
+    check=_check_lock03, project=True, engine="flow"))
+
+LED01 = register(Rule(
+    id="LED01", severity=Severity.ERROR,
+    summary="ledger charge without a matching release (scope imbalance or "
+            "error-path leak)",
+    check=_check_led01, project=True, engine="flow"))

@@ -1,0 +1,420 @@
+"""Jaxpr-level analysis primitives for the kueueverify trace engine.
+
+Three capabilities, all operating on the ClosedJaxpr a kernel lowers to:
+
+  * recursive equation iteration (descending into scan/cond/pjit/pallas
+    sub-jaxprs) with source-line attribution, so findings anchor to the
+    kernel's own file:line and per-line suppressions keep working;
+  * a structural signature that is invariant under shape changes —
+    primitive sequence, parameter structure (ints stripped), operand
+    dtypes — used by TRC03 to prove that two adjacent head-count buckets
+    lower to the SAME program (one XLA compile per bucket, no
+    shape-specialized divergence);
+  * an interval abstract interpreter over the integer equations: every
+    value gets a [lo, hi] range seeded from the kernel's input contract
+    (sentinels like NO_LIMIT/BIG are 2^62, real quotas are bounded by the
+    canonical-unit ceiling), propagated through the arithmetic, and any
+    add/sub/mul/sum whose exact result range exceeds the operand dtype is
+    an overflow hazard (TRC02). Scan carries are widened linearly by trip
+    count, which keeps monotone accumulators finite and sound.
+
+This module imports jax lazily inside functions: the analysis package
+itself must stay importable (and the ast/flow engines runnable) on hosts
+without jax.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Callable, Dict, Iterable, List, Optional, Tuple
+
+INT64_MAX = 2**63 - 1
+INT64_MIN = -(2**63)
+
+
+def _jaxpr_types():
+    from jax.core import ClosedJaxpr, Jaxpr
+    return Jaxpr, ClosedJaxpr
+
+
+def sub_jaxprs(eqn) -> Iterable:
+    """The raw Jaxprs nested in an equation's params (scan/cond/pjit/
+    pallas_call bodies), in a stable order."""
+    Jaxpr, ClosedJaxpr = _jaxpr_types()
+    for key in sorted(eqn.params, key=str):
+        val = eqn.params[key]
+        vals = val if isinstance(val, (list, tuple)) else [val]
+        for x in vals:
+            if isinstance(x, ClosedJaxpr):
+                yield x.jaxpr
+            elif isinstance(x, Jaxpr):
+                yield x
+
+
+def iter_eqns(jaxpr) -> Iterable:
+    """Every equation, depth-first through sub-jaxprs."""
+    for eqn in jaxpr.eqns:
+        yield eqn
+        for sub in sub_jaxprs(eqn):
+            yield from iter_eqns(sub)
+
+
+def eqn_location(eqn) -> Optional[Tuple[str, int]]:
+    """(file, line) of the user frame that emitted the equation, or None
+    when jax provides no usable traceback."""
+    try:
+        from jax._src import source_info_util
+
+        frame = source_info_util.user_frame(eqn.source_info)
+        if frame is None:
+            return None
+        return frame.file_name, frame.start_line
+    except Exception:
+        return None
+
+
+# ---------------------------------------------------------------------------
+# Structural signature (TRC03)
+# ---------------------------------------------------------------------------
+
+_DIGITS = re.compile(r"\d+")
+
+
+def _canon_param(x):
+    if isinstance(x, (bool, str, type(None))):
+        return x
+    if isinstance(x, (int, float)):
+        return "#"
+    if isinstance(x, (tuple, list)):
+        return tuple(_canon_param(i) for i in x)
+    Jaxpr, ClosedJaxpr = _jaxpr_types()
+    if isinstance(x, ClosedJaxpr):
+        return structural_signature(x.jaxpr)
+    if isinstance(x, Jaxpr):
+        return structural_signature(x)
+    # Opaque param objects (dimension numbers, gather specs, dtypes):
+    # their repr carries the structure; concrete sizes are stripped.
+    return _DIGITS.sub("#", repr(x))
+
+
+def structural_signature(jaxpr) -> tuple:
+    """Shape-free fingerprint of a jaxpr: the primitive sequence, each
+    equation's parameter structure with every integer (shape, length,
+    axis size) canonicalized away, and the operand/output dtypes. Two
+    lowerings of the same kernel at different padded bucket shapes must
+    produce EQUAL signatures — anything else means the Python trace took
+    a shape-dependent path and the one-compile-per-bucket contract that
+    prewarm_idle relies on is broken."""
+    out = []
+    for eqn in jaxpr.eqns:
+        out.append((
+            eqn.primitive.name,
+            tuple(sorted((str(k), _canon_param(v))
+                         for k, v in eqn.params.items())),
+            tuple(str(getattr(v.aval, "dtype", "?")) for v in eqn.invars),
+            tuple(str(getattr(v.aval, "dtype", "?")) for v in eqn.outvars),
+        ))
+    return tuple(out)
+
+
+def first_divergence(sig_a: tuple, sig_b: tuple) -> Optional[Tuple[int, str]]:
+    """(index, description) of the first differing equation, or None."""
+    for i, (a, b) in enumerate(zip(sig_a, sig_b)):
+        if a != b:
+            return i, f"equation {i}: {a[0]} vs {b[0]}"
+    if len(sig_a) != len(sig_b):
+        i = min(len(sig_a), len(sig_b))
+        longer = sig_a if len(sig_a) > len(sig_b) else sig_b
+        return i, (f"equation count {len(sig_a)} vs {len(sig_b)} "
+                   f"(first extra: {longer[i][0]})")
+    return None
+
+
+# ---------------------------------------------------------------------------
+# Interval abstract interpretation (TRC02)
+# ---------------------------------------------------------------------------
+
+
+class Interval:
+    """[lo, hi] over exact Python ints; None bounds = unknown value."""
+
+    __slots__ = ("lo", "hi")
+
+    def __init__(self, lo: Optional[int], hi: Optional[int]):
+        self.lo = lo
+        self.hi = hi
+
+    @property
+    def known(self) -> bool:
+        return self.lo is not None and self.hi is not None
+
+    def union(self, other: "Interval") -> "Interval":
+        if not (self.known and other.known):
+            return UNKNOWN
+        return Interval(min(self.lo, other.lo), max(self.hi, other.hi))
+
+    def __repr__(self):
+        return f"[{self.lo}, {self.hi}]"
+
+
+UNKNOWN = Interval(None, None)
+
+
+def _dtype_range(dtype) -> Optional[Tuple[int, int]]:
+    import numpy as np
+
+    try:
+        if np.issubdtype(dtype, np.bool_):
+            return (0, 1)
+        if np.issubdtype(dtype, np.integer):
+            info = np.iinfo(dtype)
+            return (int(info.min), int(info.max))
+    except Exception:
+        pass
+    return None  # floats and exotics: not interval-tracked
+
+
+def default_seed(aval) -> Interval:
+    """Input contract when the kernel spec declares nothing: quantities
+    are canonical-unit integers well inside the dtype (the schema's
+    NO_LIMIT/BIG sentinels must be seeded explicitly by the spec)."""
+    import numpy as np
+
+    dtype = getattr(aval, "dtype", None)
+    if dtype is None:
+        return UNKNOWN
+    if np.issubdtype(dtype, np.bool_):
+        return Interval(0, 1)
+    if np.issubdtype(dtype, np.integer):
+        bits = np.iinfo(dtype).bits
+        if bits >= 64:
+            return Interval(-(2**50), 2**50)
+        if bits >= 32:
+            return Interval(-(2**28), 2**28)
+        return Interval(int(np.iinfo(dtype).min), int(np.iinfo(dtype).max))
+    return UNKNOWN
+
+
+class Overflow:
+    """One overflow hazard: the equation whose exact result interval
+    escapes its output dtype."""
+
+    __slots__ = ("eqn", "prim", "lo", "hi", "dtype", "location")
+
+    def __init__(self, eqn, prim, lo, hi, dtype, location):
+        self.eqn = eqn
+        self.prim = prim
+        self.lo = lo
+        self.hi = hi
+        self.dtype = dtype
+        self.location = location
+
+
+def _shape_size(shape) -> int:
+    n = 1
+    for d in shape:
+        n *= int(d)
+    return max(n, 1)
+
+
+def _reduced_count(eqn) -> int:
+    """How many elements fold into each output element of a reduction."""
+    in_shape = getattr(eqn.invars[0].aval, "shape", ())
+    out_shape = getattr(eqn.outvars[0].aval, "shape", ())
+    return max(_shape_size(in_shape) // _shape_size(out_shape), 1)
+
+
+class IntervalAnalysis:
+    """One pass of abstract interpretation over a closed jaxpr."""
+
+    def __init__(self, on_overflow: Callable[[Overflow], None]):
+        self.on_overflow = on_overflow
+        self._reported: set = set()
+
+    # -- environment --------------------------------------------------------
+
+    @staticmethod
+    def _read(env: Dict, v) -> Interval:
+        from jax.core import Literal
+
+        if isinstance(v, Literal):
+            try:
+                val = int(v.val)
+                return Interval(val, val)
+            except (TypeError, ValueError, OverflowError):
+                return UNKNOWN
+        return env.get(v, UNKNOWN)
+
+    def _check(self, eqn, lo: int, hi: int) -> Interval:
+        """Flag the equation when [lo, hi] escapes the output dtype; the
+        returned interval is clamped so one hazard does not cascade into
+        a finding on every downstream consumer."""
+        rng = _dtype_range(getattr(eqn.outvars[0].aval, "dtype", None))
+        if rng is None:
+            return Interval(lo, hi)
+        dlo, dhi = rng
+        if lo < dlo or hi > dhi:
+            key = id(eqn)
+            if key not in self._reported:
+                self._reported.add(key)
+                self.on_overflow(Overflow(
+                    eqn, eqn.primitive.name, lo, hi,
+                    str(eqn.outvars[0].aval.dtype), eqn_location(eqn)))
+            return Interval(max(lo, dlo), min(hi, dhi))
+        return Interval(lo, hi)
+
+    # -- the interpreter -----------------------------------------------------
+
+    def run(self, jaxpr, consts: List[Interval],
+            args: List[Interval]) -> List[Interval]:
+        env: Dict = {}
+        for v, iv in zip(jaxpr.constvars, consts):
+            env[v] = iv
+        for v, iv in zip(jaxpr.invars, args):
+            env[v] = iv
+        for eqn in jaxpr.eqns:
+            outs = self._eqn(eqn, [self._read(env, v) for v in eqn.invars])
+            for v, iv in zip(eqn.outvars, outs):
+                from jax.core import DropVar
+
+                if not isinstance(v, DropVar):
+                    env[v] = iv
+        return [self._read(env, v) for v in jaxpr.outvars]
+
+    def _eqn(self, eqn, ins: List[Interval]) -> List[Interval]:
+        prim = eqn.primitive.name
+        n_out = len(eqn.outvars)
+
+        def allk(*ivs):
+            return all(iv.known for iv in ivs)
+
+        if prim in ("add", "sub", "mul"):
+            a, b = ins
+            if not allk(a, b):
+                return [UNKNOWN]
+            if prim == "add":
+                lo, hi = a.lo + b.lo, a.hi + b.hi
+            elif prim == "sub":
+                lo, hi = a.lo - b.hi, a.hi - b.lo
+            else:
+                prods = (a.lo * b.lo, a.lo * b.hi, a.hi * b.lo, a.hi * b.hi)
+                lo, hi = min(prods), max(prods)
+            return [self._check(eqn, lo, hi)]
+        if prim == "neg":
+            a = ins[0]
+            return [Interval(-a.hi, -a.lo) if a.known else UNKNOWN]
+        if prim in ("max", "min"):
+            a, b = ins
+            if not allk(a, b):
+                return [UNKNOWN]
+            f = max if prim == "max" else min
+            return [Interval(f(a.lo, b.lo), f(a.hi, b.hi))]
+        if prim in ("reduce_sum", "cumsum"):
+            a = ins[0]
+            if not a.known:
+                return [UNKNOWN]
+            k = _reduced_count(eqn) if prim == "reduce_sum" else \
+                _shape_size(getattr(eqn.invars[0].aval, "shape", ()))
+            return [self._check(eqn, min(a.lo * k, a.lo),
+                                max(a.hi * k, a.hi))]
+        if prim in ("reduce_max", "reduce_min"):
+            return [ins[0]]
+        if prim in ("reduce_and", "reduce_or", "and", "or", "not", "xor",
+                    "eq", "ne", "lt", "le", "gt", "ge", "is_finite"):
+            return [Interval(0, 1)] * n_out
+        if prim == "select_n":
+            cases = ins[1:]
+            out = cases[0]
+            for c in cases[1:]:
+                out = out.union(c)
+            return [out]
+        if prim in ("broadcast_in_dim", "reshape", "squeeze", "transpose",
+                    "rev", "slice", "copy", "stop_gradient", "expand_dims",
+                    "gather", "dynamic_slice", "reduce_precision"):
+            # Value-preserving data movement: the data operand is first;
+            # index operands do not affect the value range.
+            return [ins[0]] * n_out
+        if prim == "dynamic_update_slice":
+            return [ins[0].union(ins[1])]
+        if prim == "concatenate":
+            out = ins[0]
+            for iv in ins[1:]:
+                out = out.union(iv)
+            return [out]
+        if prim == "pad":
+            return [ins[0].union(ins[1])]
+        if prim == "iota":
+            size = _shape_size(getattr(eqn.outvars[0].aval, "shape", (1,)))
+            return [Interval(0, size - 1)]
+        if prim in ("argmax", "argmin"):
+            size = _shape_size(getattr(eqn.invars[0].aval, "shape", (1,)))
+            return [Interval(0, max(size - 1, 0))]
+        if prim == "convert_element_type":
+            a = ins[0]
+            rng = _dtype_range(getattr(eqn.outvars[0].aval, "dtype", None))
+            if rng is None or not a.known:
+                return [UNKNOWN if rng is None else Interval(*rng)]
+            # Out-of-range conversions wrap; TRC01 owns flagging those.
+            return [Interval(max(a.lo, rng[0]), min(a.hi, rng[1]))]
+        if prim.startswith("scatter"):
+            op, _idx, upd = ins[0], ins[1], ins[2]
+            if prim == "scatter-add":
+                if not allk(op, upd):
+                    return [UNKNOWN]
+                k = _shape_size(getattr(eqn.invars[2].aval, "shape", (1,)))
+                return [self._check(
+                    eqn, op.lo + min(0, upd.lo) * k,
+                    op.hi + max(0, upd.hi) * k)]
+            return [op.union(upd)]
+        if prim == "pjit" or prim == "closed_call" or prim == "core_call":
+            sub = eqn.params.get("jaxpr")
+            if sub is None:
+                return [UNKNOWN] * n_out
+            consts = [UNKNOWN] * len(sub.jaxpr.constvars)
+            return self.run(sub.jaxpr, consts, ins)
+        if prim == "scan":
+            return self._scan(eqn, ins)
+        if prim == "cond":
+            branches = eqn.params.get("branches", ())
+            outs = None
+            for br in branches:
+                res = self.run(br.jaxpr, [UNKNOWN] * len(br.jaxpr.constvars),
+                               ins[1:])
+                outs = res if outs is None else [
+                    a.union(b) for a, b in zip(outs, res)]
+            return outs if outs is not None else [UNKNOWN] * n_out
+        if prim == "while":
+            return [UNKNOWN] * n_out
+        return [UNKNOWN] * n_out
+
+    def _scan(self, eqn, ins: List[Interval]) -> List[Interval]:
+        """Linear widening: run the body once from the initial carry, then
+        extrapolate each carry bound by the trip count and run once more
+        for the per-equation overflow checks and the stacked outputs.
+        Sound for the kernels' monotone accumulators (usage +=/-= one
+        candidate per step bounds total drift by N * per-step range)."""
+        p = eqn.params
+        length = int(p.get("length", 1))
+        num_consts = int(p.get("num_consts", 0))
+        num_carry = int(p.get("num_carry", 0))
+        body = p["jaxpr"].jaxpr
+        consts = ins[:num_consts]
+        carry0 = ins[num_consts:num_consts + num_carry]
+        xs = ins[num_consts + num_carry:]
+        # xs arrive stacked [T, ...]; each step sees one slice with the
+        # same value range.
+        body_in = consts + carry0 + xs
+        silent = IntervalAnalysis(lambda o: None)
+        out1 = silent.run(body, [UNKNOWN] * len(body.constvars), body_in)
+        carry1 = out1[:num_carry]
+        widened: List[Interval] = []
+        for c0, c1 in zip(carry0, carry1):
+            if not (c0.known and c1.known):
+                widened.append(UNKNOWN)
+                continue
+            grow_lo = min(c1.lo - c0.lo, 0) * length
+            grow_hi = max(c1.hi - c0.hi, 0) * length
+            widened.append(Interval(c0.lo + grow_lo, c0.hi + grow_hi))
+        out2 = self.run(body, [UNKNOWN] * len(body.constvars),
+                        consts + widened + xs)
+        return out2[:num_carry] + out2[num_carry:]

@@ -20,10 +20,11 @@ def render_text(findings: Sequence[Finding]) -> str:
     return "\n".join(lines)
 
 
-def render_json(findings: Sequence[Finding]) -> str:
+def render_json(findings: Sequence[Finding], engine: str = "ast") -> str:
     by_sev = Counter(f.severity for f in findings)
     doc = {
         "tool": "kueuelint",
+        "engine": engine,
         "findings": [f.to_dict() for f in findings],
         "counts": {
             "error": by_sev.get(Severity.ERROR, 0),
@@ -38,6 +39,7 @@ def render_rule_list() -> str:
     for rule in all_rules():
         scope = ("all files" if rule.path_fragments is None
                  else ", ".join(rule.path_fragments))
-        lines.append(f"{rule.id}  [{rule.severity.label:7s}] {rule.summary}")
+        lines.append(f"{rule.id}  [{rule.severity.label:7s}] "
+                     f"({rule.engine}) {rule.summary}")
         lines.append(f"        scope: {scope}")
     return "\n".join(lines)

@@ -1,0 +1,673 @@
+"""kueueverify — trace-level jaxpr verification (TRC01-04).
+
+The ast engine reasons about source text; this engine reasons about what
+the kernels actually lower to. Every registered solver kernel (the
+traceable preemption engines from `solver/modes.ENGINES`, the batched
+flavor-fit solve, and the topology fit search — the host referee and the
+C++ batch scan have no jaxpr and are golden-tested instead) is lowered
+with `jax.make_jaxpr` at canonical padded bucket shapes and four rule
+families run over the equations:
+
+  TRC01  dtype-promotion hazards: a value widened (i32→i64) only to be
+         silently truncated back by a scatter/dynamic-update write (the
+         `.at[i].set(int64)` on an int32 buffer pattern), a 64-bit
+         literal widening a 32-bit tensor, a ref write whose value dtype
+         differs from the ref, a sum that promotes its accumulator —
+         the exact bug shapes the PR 2 all-engine goldens caught at
+         runtime in the Pallas kernel.
+  TRC02  sentinel overflow: interval analysis seeds every input from its
+         contract (NO_LIMIT/BIG sentinels are 2^62, real quotas bounded
+         by the canonical-unit ceiling) and propagates exact ranges
+         through the arithmetic; any add/sub/mul/sum whose result range
+         escapes the output dtype can wrap on real inputs and silently
+         diverge from the host referee.
+  TRC03  recompile hazards: the same kernel lowered at two ADJACENT
+         head-count buckets must produce structurally equal jaxprs
+         (modulo shapes) — the one-XLA-compile-per-bucket contract that
+         `prewarm_idle` assumes; a shape-dependent Python branch breaks
+         it and lands a compile inside a measured tick.
+  TRC04  forbidden effects: no io_callback / pure_callback / debug
+         callbacks inside a jitted kernel (each is a host round trip on
+         the solve's critical path).
+
+Scope: when the analyzed set contains the package's kernel modules, the
+built-in roster below runs; any analyzed file (e.g. a test fixture) may
+additionally declare its own kernels via a module-level
+`KUEUEVERIFY_KERNELS` manifest — a list of dicts with keys `name`,
+`build` (bucket -> (fn, args)), and optionally `buckets`, `rules`,
+`seeds`. Manifest files are IMPORTED (this engine must execute the trace),
+unlike everything the ast/flow engines touch.
+
+jax is imported lazily at rule execution, never at module import.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import importlib
+import importlib.util
+from pathlib import Path
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from kueue_tpu.analysis.core import (
+    AnalysisContext, Finding, Rule, Severity, SourceFile, register)
+
+ALL_TRC = frozenset({"TRC01", "TRC02", "TRC03", "TRC04"})
+# Packed/byte-buffer wrappers and ref-based Pallas kernels carry no usable
+# input contract for interval analysis (a bitcast output ranges over the
+# whole dtype); their arithmetic cores are verified unpacked instead.
+NO_TRC02 = ALL_TRC - {"TRC02"}
+
+_FORBIDDEN_EFFECTS = {
+    "io_callback", "pure_callback", "debug_callback", "callback",
+    "debug_print", "host_callback_call", "outside_call",
+}
+
+
+@dataclasses.dataclass
+class KernelSpec:
+    """One kernel in the verification roster.
+
+    `build(bucket)` returns `(fn, args)`; the kernel is lowered as
+    `jax.make_jaxpr(fn)(*args)`. `buckets` are two ADJACENT padded sizes
+    of the kernel's dynamic axis (TRC03 compares their jaxprs).
+    `seeds` overrides the TRC02 input intervals by flat arg position
+    (defaults come from the dtype contract — see jaxpr_tools.default_seed).
+    `anchor` is the source file the kernel lives in; findings whose
+    equations carry no usable traceback anchor there."""
+
+    name: str
+    anchor: str
+    build: Callable[[int], tuple]
+    buckets: Tuple[int, int] = (8, 16)
+    rules: frozenset = ALL_TRC
+    seeds: Optional[Dict[int, Tuple[int, int]]] = None
+    optional: bool = False
+
+
+# ---------------------------------------------------------------------------
+# Built-in roster: the registered solver kernels at canonical padded shapes
+# ---------------------------------------------------------------------------
+
+
+def _module_file(module: str) -> str:
+    spec = importlib.util.find_spec(module)
+    return spec.origin if spec and spec.origin else module
+
+
+def _build_scan(n: int):
+    import numpy as np
+
+    import kueue_tpu.ops  # noqa: F401  (x64 before tracing)
+    from kueue_tpu.ops.preemption_scan import _scan_core
+
+    Y, FR = 8, 16
+    z64 = lambda s: np.zeros(s, np.int64)  # noqa: E731
+    zb = lambda s: np.zeros(s, bool)  # noqa: E731
+    args = (z64((Y, FR)), z64((Y, FR)), zb((Y, FR)), z64((Y, FR)),
+            z64(FR), zb(FR), z64(FR), zb(FR), z64(FR), zb(FR),
+            np.zeros(n, np.int32), z64((n, FR)), np.zeros(n, np.int32),
+            np.ones(n, bool),
+            np.asarray(True), np.asarray(True), np.asarray(True),
+            np.asarray(True), np.asarray(0, np.int32))
+    return _scan_core, args
+
+
+def _build_batch_packed(b: int):
+    import functools
+
+    import numpy as np
+
+    import kueue_tpu.ops  # noqa: F401
+    from kueue_tpu.ops.preemption_batch import _packed_batch_kernel
+
+    Y, FR, N = 8, 16, 8
+    n64 = (3 * b * Y * FR + 3 * b * FR + b * N * FR) * 8
+    n32 = (2 * b * N + b) * 4
+    n8 = b * Y * FR + 4 * b * FR + b * N + 3 * b
+    buf = np.zeros(n64 + n32 + n8, np.uint8)
+    fn = functools.partial(_packed_batch_kernel,
+                           shapes=(b, Y, FR, N), lending=True)
+    return fn, (buf,)
+
+
+def _build_pallas(n: int):
+    import functools
+
+    import numpy as np
+
+    import kueue_tpu.ops  # noqa: F401
+    from kueue_tpu.ops import preemption_pallas as pp
+
+    Y, FR, ypad = 4, 8, 8
+
+    def pad2(a, rows):
+        return pp._pad_axis(pp._pad_axis(np.atleast_2d(a), 1, pp.LANES),
+                            0, rows)
+
+    z = lambda s: np.zeros(s, np.int32)  # noqa: E731
+    scalars = np.asarray([n, 1, 1, 1, 0, 0], dtype=np.int32)
+    args = (z(n), z(n), scalars,
+            pad2(z((Y, FR)), ypad), pad2(z((Y, FR)), ypad),
+            pad2(z((Y, FR)), ypad), pad2(z((Y, FR)), ypad),
+            pad2(z(FR), 1), pad2(z(FR), 1), pad2(z(FR), 1),
+            pad2(z(FR), 1), pad2(z(FR), 1), pad2(z(FR), 1),
+            pp._pad_axis(z((n, FR)), 1, pp.LANES))
+    fn = functools.partial(pp._pallas_call, n=n, ypad=ypad, interpret=True)
+    return fn, args
+
+
+def _build_flavor_fit(w: int):
+    import functools
+
+    import numpy as np
+
+    import kueue_tpu.ops  # noqa: F401
+    from kueue_tpu.models.flavor_fit import solve_core
+
+    C, F, R, G, S, K, P = 4, 4, 3, 2, 2, 3, 2
+    z64 = lambda s: np.zeros(s, np.int64)  # noqa: E731
+    z32 = lambda s: np.zeros(s, np.int32)  # noqa: E731
+    zb = lambda s: np.zeros(s, bool)  # noqa: E731
+    args = (z64((C, F, R)), z64((C, F, R)), z64((C, F, R)), z64((C, F, R)),
+            z64((K, F, R)), z64((K, F, R)), z32(C),
+            z32((C, R)), z32((C, G, S)), z32((C, G)),
+            zb(C), zb(C), zb(C),
+            z32(w), z64((w, P, R)), zb((w, P, R)),
+            zb((w, P)), zb((w, P)), zb((w, P, G, S)), z32((w, P, G)))
+    fn = functools.partial(solve_core, num_slots=S)
+    return fn, args
+
+
+def _build_flavor_fit_packed(w: int):
+    import functools
+
+    import numpy as np
+
+    import kueue_tpu.ops  # noqa: F401
+    from kueue_tpu.models.flavor_fit import _solve_kernel_packed
+
+    C, F, R, G, S, K, P = 4, 4, 3, 2, 2, 3, 2
+    z64 = lambda s: np.zeros(s, np.int64)  # noqa: E731
+    z32 = lambda s: np.zeros(s, np.int32)  # noqa: E731
+    zb = lambda s: np.zeros(s, bool)  # noqa: E731
+    nb = ((C * F * R + w * P * R) * 8 + (w + w * P * G) * 4
+          + w * P * R + 2 * w * P + w * P * G * S)
+    statics = (z64((C, F, R)), z64((C, F, R)), z64((C, F, R)),
+               z64((C, F, R)), z32(C), z32((C, R)), z32((C, G, S)),
+               z32((C, G)), zb(C), zb(C), zb(C))
+    fn = functools.partial(_solve_kernel_packed, num_slots=S,
+                           shapes=(w, P, R, G, K), fungibility_enabled=True)
+    return fn, statics + (None, np.zeros(nb, np.uint8))
+
+
+def _build_topology(n: int):
+    import functools
+
+    import numpy as np
+
+    import kueue_tpu.ops  # noqa: F401
+    from kueue_tpu.topology.fit import solve_topology_core
+
+    T, L, E, D = 2, 2, 8, 4
+    args = (np.zeros((T, E), np.int64), np.zeros((T, E), bool),
+            np.zeros((T, L, E), np.int32), np.zeros((T, L), np.int32),
+            np.full(T, L, np.int32), np.zeros((T, E), np.int64),
+            np.zeros(n, np.int32), np.zeros(n, np.int64),
+            np.zeros(n, np.int32), np.zeros(n, bool), np.zeros(n, bool))
+    fn = functools.partial(solve_topology_core, shapes=(T, L, E, D, n))
+    return fn, args
+
+
+def package_roster() -> List[KernelSpec]:
+    """The built-in kernel roster. Preemption engines come from the
+    `solver/modes.ENGINES` registry (every `traceable` engine MUST appear
+    here — tests/test_engine_coverage.py enforces it); the flavor-fit and
+    topology entry points ride along with the same contract.
+
+    TRC02 seeds (by arg position): the nominal/borrow-limit tensors carry
+    the NO_LIMIT/BIG = 2^62 sentinel from solver/schema.py; everything
+    else defaults to the canonical-unit contract."""
+    sentinel = (0, 2**62)
+    return [
+        KernelSpec(
+            name="scan-jax",
+            anchor=_module_file("kueue_tpu.ops.preemption_scan"),
+            build=_build_scan, buckets=(8, 16),
+            seeds={1: sentinel, 6: sentinel}),
+        KernelSpec(
+            name="batch-jax",
+            anchor=_module_file("kueue_tpu.ops.preemption_batch"),
+            build=_build_batch_packed, buckets=(4, 8), rules=NO_TRC02),
+        KernelSpec(
+            name="scan-pallas",
+            anchor=_module_file("kueue_tpu.ops.preemption_pallas"),
+            build=_build_pallas, buckets=(4, 8), rules=NO_TRC02,
+            optional=True),
+        KernelSpec(
+            name="flavor-fit",
+            anchor=_module_file("kueue_tpu.models.flavor_fit"),
+            build=_build_flavor_fit, buckets=(8, 16),
+            seeds={1: sentinel}),
+        KernelSpec(
+            name="flavor-fit-packed",
+            anchor=_module_file("kueue_tpu.models.flavor_fit"),
+            build=_build_flavor_fit_packed, buckets=(8, 16),
+            rules=NO_TRC02),
+        KernelSpec(
+            name="topology-fit",
+            anchor=_module_file("kueue_tpu.topology.fit"),
+            build=_build_topology, buckets=(8, 16)),
+    ]
+
+
+# ---------------------------------------------------------------------------
+# Manifest kernels (fixtures/tests)
+# ---------------------------------------------------------------------------
+
+_MANIFEST = "KUEUEVERIFY_KERNELS"
+_manifest_seq = [0]
+
+
+def _manifest_specs(f: SourceFile) -> Tuple[List[KernelSpec], Optional[str]]:
+    """Import an analyzed file that declares KUEUEVERIFY_KERNELS and read
+    its kernel manifest. Returns (specs, import_error)."""
+    _manifest_seq[0] += 1
+    name = f"_kueueverify_manifest_{_manifest_seq[0]}"
+    try:
+        spec = importlib.util.spec_from_file_location(name, str(f.path))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+    except Exception as exc:  # a broken manifest is itself a finding
+        return [], f"{type(exc).__name__}: {exc}"
+    out = []
+    for entry in getattr(mod, _MANIFEST, []):
+        out.append(KernelSpec(
+            name=entry["name"],
+            anchor=str(f.path),
+            build=entry["build"],
+            buckets=tuple(entry.get("buckets", (8, 16))),
+            rules=frozenset(entry.get("rules", ALL_TRC)),
+            seeds=entry.get("seeds")))
+    return out, None
+
+
+# ---------------------------------------------------------------------------
+# Lowering + shared per-context cache
+# ---------------------------------------------------------------------------
+
+
+def _find_source(ctx: AnalysisContext, path: str) -> Optional[SourceFile]:
+    try:
+        resolved = Path(path).resolve()
+    except OSError:
+        return None
+    cache = getattr(ctx, "_resolved_paths", None)
+    if cache is None:
+        cache = {}
+        for f in ctx.files:
+            try:
+                cache[f.path.resolve()] = f
+            except OSError:
+                continue
+        ctx._resolved_paths = cache
+    return cache.get(resolved)
+
+
+def _finding(ctx: AnalysisContext, spec: KernelSpec, rule_id: str,
+             severity: Severity, loc: Optional[Tuple[str, int]],
+             message: str) -> Finding:
+    src = _find_source(ctx, loc[0]) if loc else None
+    if src is None:
+        src = _find_source(ctx, spec.anchor)
+    if src is not None:
+        path = src.display_path
+        line = loc[1] if loc and _find_source(ctx, loc[0]) is src else 1
+    else:
+        path, line = (loc if loc else (spec.anchor, 1))
+    return Finding(rule=rule_id, severity=severity, path=path,
+                   line=line, col=0,
+                   message=f"[{spec.name}] {message}")
+
+
+def _active_specs(ctx: AnalysisContext) -> Tuple[List[KernelSpec],
+                                                 List[Finding]]:
+    """Roster for this analysis run: package kernels whose source file is
+    in the analyzed set, plus manifests declared by analyzed files."""
+    specs: List[KernelSpec] = []
+    findings: List[Finding] = []
+    for spec in package_roster():
+        if _find_source(ctx, spec.anchor) is not None:
+            specs.append(spec)
+    for f in ctx.files:
+        if f.tree is None or _MANIFEST not in f.text:
+            continue
+        declares = any(
+            getattr(t, "id", None) == _MANIFEST
+            for node in f.tree.body if hasattr(node, "targets")
+            for t in node.targets)
+        if not declares:
+            continue
+        manifest, err = _manifest_specs(f)
+        if err is not None:
+            findings.append(Finding(
+                rule="PARSE", severity=Severity.ERROR,
+                path=f.display_path, line=1, col=0,
+                message=f"kernel manifest failed to import: {err}"))
+        specs.extend(manifest)
+    return specs, findings
+
+
+def _lower(spec: KernelSpec) -> Dict[int, object]:
+    import warnings
+
+    import jax
+
+    out = {}
+    for bucket in spec.buckets:
+        fn, args = spec.build(bucket)
+        with warnings.catch_warnings():
+            # The code under analysis may (deliberately, in bad fixtures)
+            # trip jax's own deprecation/cast warnings; the analyzer
+            # reports findings, not the tracee's warning stream.
+            warnings.simplefilter("ignore")
+            out[bucket] = jax.make_jaxpr(fn)(*args)
+    return out
+
+
+def _trace_findings(ctx: AnalysisContext) -> Dict[str, List[Finding]]:
+    """Lower every active kernel once and run all TRC rules; memoized on
+    the context so the four registered rules share one lowering pass."""
+    cached = getattr(ctx, "_trace_findings", None)
+    if cached is not None:
+        return cached
+    out: Dict[str, List[Finding]] = {
+        "TRC01": [], "TRC02": [], "TRC03": [], "TRC04": [], "PARSE": []}
+    specs, manifest_errors = _active_specs(ctx)
+    out["PARSE"].extend(manifest_errors)
+    for spec in specs:
+        try:
+            jaxprs = _lower(spec)
+        except ImportError:
+            if spec.optional:
+                continue
+            raise
+        except Exception as exc:
+            out["PARSE"].append(_finding(
+                ctx, spec, "PARSE", Severity.ERROR, None,
+                f"kernel failed to lower: {type(exc).__name__}: {exc}"))
+            continue
+        first = jaxprs[spec.buckets[0]]
+        if "TRC01" in spec.rules:
+            out["TRC01"].extend(_check_trc01(ctx, spec, first))
+        if "TRC02" in spec.rules:
+            out["TRC02"].extend(_check_trc02(ctx, spec, first))
+        if "TRC03" in spec.rules:
+            out["TRC03"].extend(_check_trc03(ctx, spec, jaxprs))
+        if "TRC04" in spec.rules:
+            out["TRC04"].extend(_check_trc04(ctx, spec, first))
+    for rule_id, findings in out.items():
+        # One source line can emit the same hazard from several lowering
+        # contexts (e.g. a helper inlined into both scan phases) — report
+        # each (line, message) once.
+        seen = set()
+        deduped = []
+        for fin in findings:
+            key = (fin.path, fin.line, fin.message)
+            if key not in seen:
+                seen.add(key)
+                deduped.append(fin)
+        out[rule_id] = deduped
+    ctx._trace_findings = out
+    return out
+
+
+# ---------------------------------------------------------------------------
+# TRC01 — dtype-promotion hazards
+# ---------------------------------------------------------------------------
+
+
+def _int_bits(aval) -> Optional[int]:
+    import numpy as np
+
+    dtype = getattr(aval, "dtype", None)
+    if dtype is None:
+        return None
+    try:
+        if np.issubdtype(dtype, np.bool_):
+            return None
+        if np.issubdtype(dtype, np.integer):
+            return np.iinfo(dtype).bits
+    except Exception:
+        pass
+    return None
+
+
+def _check_trc01(ctx, spec, closed) -> List[Finding]:
+    from jax.core import Literal
+
+    from kueue_tpu.analysis import jaxpr_tools as jt
+
+    findings: List[Finding] = []
+
+    def emit(eqn, msg):
+        findings.append(_finding(ctx, spec, "TRC01", Severity.ERROR,
+                                 jt.eqn_location(eqn), msg))
+
+    def walk(jaxpr):
+        producers = {}
+        for eqn in jaxpr.eqns:
+            for v in eqn.outvars:
+                producers[v] = eqn
+
+        def widening_convert(v):
+            """The producing convert_element_type when `v` is an integer
+            widened from a narrower integer (not bool)."""
+            src = producers.get(v)
+            if src is None or src.primitive.name != "convert_element_type":
+                return None
+            bi = _int_bits(src.invars[0].aval)
+            bo = _int_bits(src.outvars[0].aval)
+            if bi is not None and bo is not None and bo > bi:
+                return src
+            return None
+
+        for eqn in jaxpr.eqns:
+            prim = eqn.primitive.name
+            if prim == "convert_element_type":
+                # Narrowing write-back: i64 scatter/dus result cast down to
+                # the original i32 — the `.at[i].set(int64)` silent cast.
+                bi = _int_bits(eqn.invars[0].aval)
+                bo = _int_bits(eqn.outvars[0].aval)
+                src = producers.get(eqn.invars[0])
+                if (bi is not None and bo is not None and bo < bi
+                        and src is not None
+                        and (src.primitive.name.startswith("scatter")
+                             or src.primitive.name == "dynamic_update_slice")
+                        and widening_convert(src.invars[0]) is not None):
+                    emit(src, f"mixed-dtype write: int{bi} value stored "
+                              f"into an int{bo} buffer and silently cast "
+                              "back — pin the stored value's dtype "
+                              "(the PR 2 Pallas weak-int64 write shape)")
+            elif prim in ("add", "sub", "mul", "max", "min"):
+                for i, v in enumerate(eqn.invars):
+                    if isinstance(v, Literal):
+                        continue
+                    conv = widening_convert(v)
+                    if conv is None:
+                        continue
+                    other = eqn.invars[1 - i]
+                    if isinstance(other, Literal):
+                        bo = _int_bits(eqn.outvars[0].aval)
+                        bi = _int_bits(conv.invars[0].aval)
+                        emit(eqn, f"int{bi} tensor widened to int{bo} by a "
+                                  f"{bo}-bit literal in `{prim}` — pin the "
+                                  "literal's dtype to the tensor's (weak-"
+                                  "literal promotion recompiles and breaks "
+                                  "int32-pinned kernels)")
+            elif prim == "swap":
+                ref_bits = _int_bits(eqn.invars[0].aval)
+                val_bits = _int_bits(eqn.invars[1].aval)
+                if ref_bits is not None and val_bits is not None \
+                        and ref_bits != val_bits:
+                    emit(eqn, f"ref write dtype mismatch: int{val_bits} "
+                              f"value into an int{ref_bits} ref — the "
+                              "Pallas discharge rejects or truncates "
+                              "mixed-dtype stores")
+            elif prim in ("reduce_sum", "cumsum"):
+                bi = _int_bits(eqn.invars[0].aval)
+                bo = _int_bits(eqn.outvars[0].aval)
+                if bi is not None and bo is not None and bo > bi:
+                    emit(eqn, f"sum promotes int{bi} to int{bo} — pin the "
+                              "accumulator dtype (int64 sum promotion "
+                              "broke the Pallas interpret discharge)")
+            for sub in jt.sub_jaxprs(eqn):
+                walk(sub)
+
+    walk(closed.jaxpr)
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# TRC02 — sentinel/interval overflow
+# ---------------------------------------------------------------------------
+
+
+def _check_trc02(ctx, spec, closed) -> List[Finding]:
+    from kueue_tpu.analysis import jaxpr_tools as jt
+
+    findings: List[Finding] = []
+
+    def on_overflow(o: jt.Overflow):
+        findings.append(_finding(
+            ctx, spec, "TRC02", Severity.ERROR, o.location,
+            f"`{o.prim}` result range [{o.lo}, {o.hi}] exceeds {o.dtype} "
+            "— can wrap on sentinel-carrying inputs (NO_LIMIT/BIG = 2^62) "
+            "and silently diverge from the host referee; rewrite to avoid "
+            "the overflowing intermediate (e.g. compare via subtraction)"))
+
+    seeds = spec.seeds or {}
+    arg_ivs = []
+    for i, v in enumerate(closed.jaxpr.invars):
+        if i in seeds:
+            lo, hi = seeds[i]
+            arg_ivs.append(jt.Interval(lo, hi))
+        else:
+            arg_ivs.append(jt.default_seed(v.aval))
+    const_ivs = []
+    for v, val in zip(closed.jaxpr.constvars, closed.consts):
+        try:
+            import numpy as np
+
+            arr = np.asarray(val)
+            if arr.dtype.kind in "iub" and arr.size:
+                const_ivs.append(jt.Interval(int(arr.min()), int(arr.max())))
+            else:
+                const_ivs.append(jt.UNKNOWN)
+        except Exception:
+            const_ivs.append(jt.UNKNOWN)
+    jt.IntervalAnalysis(on_overflow).run(closed.jaxpr, const_ivs, arg_ivs)
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# TRC03 — one compile per bucket
+# ---------------------------------------------------------------------------
+
+
+def bucket_report(specs: Optional[Sequence[KernelSpec]] = None) -> List[dict]:
+    """Lower every roster kernel at both buckets and report structural
+    equality — the data behind TRC03, exposed for the regression tests
+    that pin the one-compile-per-bucket contract per engine."""
+    from kueue_tpu.analysis import jaxpr_tools as jt
+
+    out = []
+    for spec in (package_roster() if specs is None else specs):
+        try:
+            jaxprs = _lower(spec)
+        except ImportError:
+            if spec.optional:
+                continue
+            raise
+        a, b = (jt.structural_signature(jaxprs[n].jaxpr)
+                for n in spec.buckets)
+        out.append({"kernel": spec.name, "buckets": spec.buckets,
+                    "equal": a == b,
+                    "divergence": jt.first_divergence(a, b)})
+    return out
+
+
+def _check_trc03(ctx, spec, jaxprs) -> List[Finding]:
+    from kueue_tpu.analysis import jaxpr_tools as jt
+
+    b0, b1 = spec.buckets
+    sig0 = jt.structural_signature(jaxprs[b0].jaxpr)
+    sig1 = jt.structural_signature(jaxprs[b1].jaxpr)
+    div = jt.first_divergence(sig0, sig1)
+    if div is None:
+        return []
+    return [_finding(
+        ctx, spec, "TRC03", Severity.ERROR, None,
+        f"jaxpr structure differs between adjacent buckets {b0} and {b1} "
+        f"({div[1]}) — the trace takes a shape-dependent Python path, so "
+        "a bucket rotation compiles a DIFFERENT program and prewarm_idle's "
+        "one-compile-per-bucket contract is void")]
+
+
+# ---------------------------------------------------------------------------
+# TRC04 — forbidden effects
+# ---------------------------------------------------------------------------
+
+
+def _check_trc04(ctx, spec, closed) -> List[Finding]:
+    from kueue_tpu.analysis import jaxpr_tools as jt
+
+    findings = []
+    for eqn in jt.iter_eqns(closed.jaxpr):
+        if eqn.primitive.name in _FORBIDDEN_EFFECTS:
+            findings.append(_finding(
+                ctx, spec, "TRC04", Severity.ERROR, jt.eqn_location(eqn),
+                f"forbidden effect `{eqn.primitive.name}` inside a jitted "
+                "kernel — every callback is a host round trip on the "
+                "solve's critical path (and breaks AOT/serialization)"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# Registration
+# ---------------------------------------------------------------------------
+
+
+def _rule_check(rule_id: str):
+    def check(ctx: AnalysisContext):
+        found = _trace_findings(ctx)
+        # Lowering failures ride along with EVERY trace rule: a roster
+        # kernel that no longer lowers must fail the run even under
+        # `--select TRC02` / `--disable TRC01` (the driver dedupes the
+        # identical findings when several TRC rules run).
+        return list(found[rule_id]) + list(found["PARSE"])
+    return check
+
+
+TRC01 = register(Rule(
+    id="TRC01", severity=Severity.ERROR,
+    summary="jaxpr dtype-promotion hazard (mixed-dtype write, weak-literal "
+            "widening, promoted sum)",
+    check=_rule_check("TRC01"), project=True, engine="trace"))
+
+TRC02 = register(Rule(
+    id="TRC02", severity=Severity.ERROR,
+    summary="sentinel overflow: interval analysis proves an arithmetic "
+            "result can escape its dtype",
+    check=_rule_check("TRC02"), project=True, engine="trace"))
+
+TRC03 = register(Rule(
+    id="TRC03", severity=Severity.ERROR,
+    summary="recompile hazard: jaxpr structure differs across adjacent "
+            "head-count buckets",
+    check=_rule_check("TRC03"), project=True, engine="trace"))
+
+TRC04 = register(Rule(
+    id="TRC04", severity=Severity.ERROR,
+    summary="forbidden effect (io/pure/debug callback) in a jitted kernel",
+    check=_rule_check("TRC04"), project=True, engine="trace"))

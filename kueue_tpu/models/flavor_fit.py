@@ -693,6 +693,10 @@ class BatchSolver:
         self._warm_keys: set = set()
         self._warm_lock = threading.Lock()
         self._prewarm_pending: set = set()
+        # Largest podset count seen this encoding generation: the P axis
+        # is floored to it so batch composition (a tick without any
+        # multi-podset head) cannot rotate P downward and recompile.
+        self._p_floor = 1
         self.cold_dispatches = 0
         # Optional XLA profiler hook (SURVEY §5): point TensorBoard at this
         # port to trace the device solves.
@@ -723,6 +727,8 @@ class BatchSolver:
             # Row cache indices/eligibility are relative to the encoding.
             self._row_cache = sch.WorkloadRowCache()
             self._preempt_ctx = None
+            # P-axis stickiness restarts with the encoding generation.
+            self._p_floor = 1
             # The jit cache keys on the static arrays' SHAPES too ([C,F,R]
             # etc.): a structural change can rotate those, so every
             # previously-warm bucket may recompile — reset the warm set so
@@ -854,7 +860,9 @@ class BatchSolver:
         usage = self._usage_enc.refresh(snapshot)
         ta = _t.perf_counter()
         wt = sch.encode_workloads(workloads, snapshot, enc,
-                                  row_cache=self._row_cache)
+                                  row_cache=self._row_cache,
+                                  min_podsets=self._p_floor)
+        self._p_floor = max(self._p_floor, wt.req.shape[1])
         tb = _t.perf_counter()
         if self._mesh is not None:
             # Multi-chip: the sharded program runs to completion here
@@ -1009,7 +1017,9 @@ class BatchSolver:
         _batch_partial_admission)."""
         enc = self._encoding_for(snapshot)
         usage = self._usage_enc.refresh(snapshot)
-        wt = sch.encode_workloads(workloads, snapshot, enc, counts=counts)
+        wt = sch.encode_workloads(workloads, snapshot, enc, counts=counts,
+                                  min_podsets=self._p_floor)
+        self._p_floor = max(self._p_floor, wt.req.shape[1])
         out = solve_flavor_fit(enc, usage, wt, static=self._static)
         return decode_assignments(workloads, snapshot, enc, out,
                                   counts=counts)

@@ -55,7 +55,11 @@ static bool fits(const Problem& p, const std::vector<int64_t>& U,
         if (use_nominal) {
             if (own > p.nominal[f]) return false;
         } else if (p.blim_def[f]) {
-            if (own > p.nominal[f] + p.blim[f]) return false;
+            // Subtraction form: nominal carries the BIG 2^62 sentinel where
+            // undefined and user quotas reach 2^60+, so nominal + blim can
+            // pass INT64_MAX (signed overflow, UB). own >= 0 and blim >= 0
+            // keep own - blim in range. Mirrors the XLA scan's TRC02 fix.
+            if (own - p.blim[f] > p.nominal[f]) return false;
         }
     }
     if (!p.has_cohort) return true;

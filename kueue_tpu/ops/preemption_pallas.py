@@ -128,9 +128,12 @@ def _kernel(cand_y, cand_prio, scalars,          # scalar-prefetch (SMEM)
         check = (q_def[0, :] != 0) & (wl_req_mask[0, :] != 0)
         own = U[0, :] + wl_req[0, :]
         nominal_cap = jnp.where(check, own <= nominal[0, :], True).all()
+        # Subtraction form: nominal and blim both carry the I32_SENTINEL
+        # 2^30 where undefined, and 2^30 + 2^30 wraps int32 — same hazard
+        # (and same fix) as the int64 scan's TRC02 finding.
         blim_cap = jnp.where(
             check & (blim_def[0, :] != 0),
-            own <= nominal[0, :] + blim[0, :], True).all()
+            own - blim[0, :] <= nominal[0, :], True).all()
         use_nominal = jnp.logical_or(has_cohort == 0, allow_b == 0)
         own_ok = jnp.where(use_nominal, nominal_cap, blim_cap)
         above = jnp.maximum(U[:, :] - guaranteed[:, :], 0).sum(axis=0)

@@ -195,7 +195,12 @@ def _fits(U, wl_req, wl_req_mask, t_def, nominal0, blim, blim_def,
     check = t_def & wl_req_mask                       # [FR]
     own = U[0] + wl_req
     nominal_cap = jnp.where(check, own <= nominal0, True)
-    blim_cap = jnp.where(check & blim_def, own <= nominal0 + blim, True)
+    # `own <= nominal0 + blim` via subtraction: both operands can carry the
+    # BIG/NO_LIMIT 2^62 sentinel (and user quotas in canonical units reach
+    # 2^60+), so the sum can pass 2^63 and wrap — flipping the verdict
+    # against the host referee's exact arithmetic. `own - blim` stays in
+    # range (own >= 0, blim >= 0). Proven safe by kueueverify TRC02.
+    blim_cap = jnp.where(check & blim_def, own - blim <= nominal0, True)
     use_nominal = jnp.logical_or(~has_cohort, ~allow_b)
     own_ok = jnp.where(use_nominal, nominal_cap.all(), blim_cap.all())
 

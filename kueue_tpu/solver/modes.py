@@ -1,8 +1,80 @@
 """Flavor assignment modes, ordered by preference
-(reference: pkg/scheduler/flavorassigner/flavorassigner.go:199-209)."""
+(reference: pkg/scheduler/flavorassigner/flavorassigner.go:199-209),
+and the registry of preemption victim-search engines.
+
+Every implementation of `minimalPreemptions` (preemption.go:172-231) is
+registered here with enough metadata for the three consumers that must
+never drift out of sync:
+
+  * the preemption goldens (tests/test_preemption_goldens.py) parametrize
+    over EVERY registered engine — a new engine cannot land unverified;
+  * the kueueverify trace engine (kueue_tpu/analysis/trace_rules.py)
+    lowers every `traceable` engine's kernel to a jaxpr and runs the
+    TRC01-04 verification rules over the equations;
+  * tests/test_engine_coverage.py introspects this registry and fails when
+    either consumer is missing an engine.
+"""
+
+from dataclasses import dataclass
+from typing import Tuple
 
 NO_FIT = 0
 PREEMPT = 1
 FIT = 2
 
 MODE_NAMES = {NO_FIT: "NoFit", PREEMPT: "Preempt", FIT: "Fit"}
+
+
+@dataclass(frozen=True)
+class EngineSpec:
+    """One registered victim-search engine.
+
+    `kind`: "host" (pure-Python referee), "native" (C++ batch scan), or
+    "jax" (XLA/Pallas kernel). `batched` engines solve a whole tick's
+    searches in one call and are subject to head-count bucketing (the
+    TRC03 one-compile-per-bucket contract). `traceable` engines lower to
+    a jaxpr and join the kueueverify roster. `optional_import` marks
+    engines whose toolchain may be absent (the Pallas kernel on hosts
+    without jax.experimental.pallas) — consumers skip them when the
+    import fails, but must cover them whenever it succeeds."""
+
+    name: str
+    kind: str
+    module: str
+    entry: str
+    batched: bool = False
+    traceable: bool = False
+    optional_import: bool = False
+
+
+ENGINES: Tuple[EngineSpec, ...] = (
+    EngineSpec("host", "host",
+               "kueue_tpu.scheduler.preemption", "_minimal_preemptions"),
+    EngineSpec("scan-jax", "jax",
+               "kueue_tpu.ops.preemption_scan", "scan_kernel",
+               traceable=True),
+    EngineSpec("scan-pallas", "jax",
+               "kueue_tpu.ops.preemption_pallas", "scan_kernel_pallas",
+               traceable=True, optional_import=True),
+    EngineSpec("batch-native", "native",
+               "kueue_tpu.ops.preemption_batch", "run_batch",
+               batched=True),
+    EngineSpec("batch-jax", "jax",
+               "kueue_tpu.ops.preemption_batch", "_packed_batch_kernel",
+               batched=True, traceable=True),
+)
+
+
+def engine_importable(spec: EngineSpec) -> bool:
+    """Whether the engine's implementation module imports on this host —
+    the shared probe consumers use to decide if an `optional_import`
+    engine may be skipped (goldens parametrization, coverage meta-test).
+    Broad except by design: a Pallas toolchain failing at import time for
+    ANY reason means the engine cannot run here."""
+    import importlib
+
+    try:
+        importlib.import_module(spec.module)
+        return True
+    except Exception:
+        return False

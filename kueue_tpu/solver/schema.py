@@ -768,19 +768,25 @@ def encode_workloads(workloads: Sequence[WorkloadInfo], snapshot: Snapshot,
                      counts: Optional[Sequence[Optional[Sequence[int]]]] = None,
                      pad_to: Optional[int] = None,
                      row_cache: Optional[WorkloadRowCache] = None,
+                     min_podsets: int = 1,
                      ) -> WorkloadTensors:
     """Encode pending workloads against the CQ encoding.
 
     Taint/affinity eligibility and the resume-from-last-flavor slot are
     computed here, host-side. `counts` optionally overrides pod counts per
-    workload (partial admission; bypasses the row cache).
+    workload (partial admission; bypasses the row cache). `min_podsets`
+    floors the P axis: the solver passes the largest podset count it has
+    seen this encoding generation, so a tick whose batch happens to be
+    all single-podset does not shrink P and recompile the kernel (the
+    P-axis twin of the W-axis pow2 bucketing; caught by the bench's
+    cold-dispatch guard on the cohortlend mix).
     """
     n = len(workloads)
     W = pad_to if pad_to is not None else _pad_pow2(max(n, 1))
     # One pass resolves every workload's totals (memoized property — hoist
     # so the main loop reads the list, not the property again).
     all_totals = [wi.total_requests for wi in workloads]
-    P = 1
+    P = max(1, min_podsets)
     for t in all_totals:
         if len(t) > P:
             P = len(t)

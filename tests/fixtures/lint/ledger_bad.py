@@ -1,0 +1,37 @@
+# Fixture: ledger charge/release violations (LED01). The assume path
+# charges the topology ledger but no forget/delete path ever releases it,
+# and a validating path charges before it can still raise — both leak
+# occupancy that HA replay then rebuilds wrong.
+
+
+class LeakyCache:
+    def __init__(self):
+        self.ledger = object()
+        self.workloads = {}
+
+    def assume_workload(self, wl):
+        self.workloads[wl.key] = wl
+        # charged on assume, but NO method in this class ever calls
+        # self.ledger.charge(..., -1)
+        self.ledger.charge(wl.admission, 1)
+        return wl
+
+    def forget_workload(self, wl):
+        # release path forgot the ledger entirely
+        self.workloads.pop(wl.key, None)
+
+
+class ErrorPathCache:
+    def __init__(self):
+        self.books = object()
+
+    def assume(self, wl):
+        self.books.charge(wl.admission, 1)
+        if wl.key in ("dup",):
+            # error exit AFTER the charge: the ledger stays charged for a
+            # workload that was never accounted
+            raise ValueError("already assumed")
+        return wl
+
+    def forget(self, wl):
+        self.books.charge(wl.admission, -1)

@@ -1,0 +1,21 @@
+# Fixture: balanced ledger discipline — every assume/add charge has the
+# forget/delete release twin, and charges commit only after the last
+# failure point (the real Cache shape). Zero LED01 findings.
+
+
+class BalancedCache:
+    def __init__(self):
+        self.ledger = object()
+        self.workloads = {}
+
+    def assume_workload(self, wl):
+        if wl.key in self.workloads:
+            raise ValueError("already assumed")
+        # the charge is the LAST mutation: nothing after it can fail
+        self.workloads[wl.key] = wl
+        self.ledger.charge(wl.admission, 1)
+        return wl
+
+    def forget_workload(self, wl):
+        if self.workloads.pop(wl.key, None) is not None:
+            self.ledger.charge(wl.admission, -1)

@@ -1,0 +1,45 @@
+# Fixture: a lock-order cycle across two runtime classes. The cache takes
+# its lock and calls into the queue (which takes the queue lock); the
+# queue's flush path takes its lock and calls back into the cache (which
+# takes the cache lock). Two threads entering from opposite ends deadlock.
+import threading
+
+
+class CacheSide:
+    def __init__(self):
+        self._lock = threading.RLock()
+        self.queue = QueueSide(self)
+        self.items = {}
+
+    def admit(self, key):
+        with self._lock:
+            self.items[key] = True
+            # cache lock held -> queue lock acquired inside
+            self.queue.notify(key)
+
+    def usage_locked(self, key):
+        return self.items.get(key)
+
+    def read_usage(self, key):
+        with self._lock:
+            return self.items.get(key)
+
+
+class QueueSide:
+    def __init__(self, owner):
+        self._cond = threading.Condition()
+        self.owner = CacheSide() if owner is None else owner
+        self.pending = []
+
+    def notify(self, key):
+        with self._cond:
+            self.pending.append(key)
+            self._cond.notify_all()
+
+    def flush(self):
+        with self._cond:
+            # queue lock held -> cache lock acquired inside (opposite
+            # order to CacheSide.admit)
+            for key in self.pending:
+                self.owner.read_usage(key)
+            self.pending.clear()

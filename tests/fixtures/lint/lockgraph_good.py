@@ -1,0 +1,40 @@
+# Fixture: same two classes with a single global acquisition order — the
+# queue never calls back into the cache while holding its own lock (it
+# collects under the lock, applies after release). No cycle.
+import threading
+
+
+class CacheSide:
+    def __init__(self):
+        self._lock = threading.RLock()
+        self.queue = QueueSide(self)
+        self.items = {}
+
+    def admit(self, key):
+        with self._lock:
+            self.items[key] = True
+            self.queue.notify(key)
+
+    def read_usage(self, key):
+        with self._lock:
+            return self.items.get(key)
+
+
+class QueueSide:
+    def __init__(self, owner):
+        self._cond = threading.Condition()
+        self.owner = CacheSide() if owner is None else owner
+        self.pending = []
+
+    def notify(self, key):
+        with self._cond:
+            self.pending.append(key)
+            self._cond.notify_all()
+
+    def flush(self):
+        with self._cond:
+            batch = list(self.pending)
+            self.pending.clear()
+        # cache lock taken only AFTER the queue lock is released
+        for key in batch:
+            self.owner.read_usage(key)

@@ -1,0 +1,47 @@
+# Fixture: the PR 2 Pallas int32-rescale bug shape, reproduced for the
+# trace engine. The real bug: quota columns gcd-rescaled to int32 carry an
+# I32_SENTINEL = 2^30 for "no limit"; the kernel then (a) added two
+# sentinel-bearing columns — 2^30 + 2^30 wraps int32 and flips the
+# fits verdict (TRC02), and (b) wrote weak int64 values (bare Python ints
+# under x64) into int32 state, which the interpret-mode discharge rejects
+# or silently truncates (TRC01). The preemption goldens only caught this
+# at runtime, at the shapes they exercise; the jaxpr rules decide it
+# statically at every bucket shape.
+import jax.numpy as jnp
+import numpy as np
+
+import kueue_tpu.ops  # noqa: F401  (x64 before tracing)
+
+I32_SENTINEL = np.int32(2**30)
+
+
+def rescaled_fits(usage, wl_req, nominal, blim, blim_def):
+    # (a) sentinel + sentinel: nominal and blim both carry 2^30 where
+    # undefined; the int32 sum wraps negative and the masked comparison
+    # silently mis-decides (TRC02).
+    own = usage + wl_req
+    cap = jnp.where(blim_def, own <= nominal + blim, True)
+    return cap.all()
+
+
+def rescaled_state_write(state, taken):
+    # (b) weak-int64 write into the int32 scan state: a bare Python int
+    # traces as (weak) int64 under x64 and the store casts back (TRC01).
+    flags = state.at[0].set(taken[0] + jnp.int64(1))
+    return flags
+
+
+KUEUEVERIFY_KERNELS = [
+    dict(name="pallas-rescale-fits", buckets=(4, 8), rules=("TRC02",),
+         # real rescaled values stay below 2^30; nominal/blim carry the
+         # sentinel 2^30 itself where undefined
+         seeds={0: (0, 2**30 - 1), 1: (0, 2**30 - 1), 2: (0, 2**30),
+                3: (0, 2**30)},
+         build=lambda n: (rescaled_fits, (
+             np.zeros(n, np.int32), np.zeros(n, np.int32),
+             np.zeros(n, np.int32), np.zeros(n, np.int32),
+             np.zeros(n, bool)))),
+    dict(name="pallas-rescale-write", buckets=(4, 8), rules=("TRC01",),
+         build=lambda n: (rescaled_state_write, (
+             np.zeros(n, np.int32), np.zeros(n, np.int64)))),
+]

@@ -1,0 +1,62 @@
+# Fixture: kernels whose JAXPRS carry the hazards the kueueverify trace
+# engine (TRC01-04) exists to catch. Each manifest entry restricts itself
+# to the rule it demonstrates so the test can assert per-rule hits.
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import kueue_tpu.ops  # noqa: F401  (x64 before tracing)
+
+
+def mixed_dtype_write(buf, vals):
+    # int64 value stored into an int32 buffer: jax widens the buffer,
+    # scatters, and silently casts back (TRC01).
+    return buf.at[0].set(vals[0])
+
+
+def literal_widening(x):
+    # 64-bit literal widens the int32 tensor in an add (TRC01).
+    return x + jnp.int64(7)
+
+
+def sentinel_add(nominal, blim, own):
+    # Both operands carry a 2^62 "no limit" sentinel; the sum passes
+    # int64 max and wraps (TRC02) — the shape of the pre-fix
+    # `own <= nominal + blim` in the victim scan.
+    return own <= nominal + blim
+
+
+def shape_unrolled(x):
+    # Python-level unroll over the padded axis: every bucket lowers to a
+    # DIFFERENT jaxpr, so each rotation recompiles a new program (TRC03).
+    total = jnp.zeros((), dtype=x.dtype)
+    for i in range(x.shape[0]):
+        total = total + x[i]
+    return total
+
+
+def debug_printing(x):
+    # Host callback inside the kernel (TRC04).
+    jax.debug.print("solve state {}", x)
+    return x * 2
+
+
+def _args_i32_i64(n):
+    return mixed_dtype_write, (np.zeros(n, np.int32), np.zeros(n, np.int64))
+
+
+KUEUEVERIFY_KERNELS = [
+    dict(name="bad-write", buckets=(4, 8), rules=("TRC01",),
+         build=_args_i32_i64),
+    dict(name="bad-literal", buckets=(4, 8), rules=("TRC01",),
+         build=lambda n: (literal_widening, (np.zeros(n, np.int32),))),
+    dict(name="bad-sentinel", buckets=(4, 8), rules=("TRC02",),
+         seeds={0: (0, 2**62), 1: (0, 2**62)},
+         build=lambda n: (sentinel_add, (np.zeros(n, np.int64),
+                                         np.zeros(n, np.int64),
+                                         np.zeros(n, np.int64)))),
+    dict(name="bad-unroll", buckets=(4, 8), rules=("TRC03",),
+         build=lambda n: (shape_unrolled, (np.zeros(n, np.int64),))),
+    dict(name="bad-effect", buckets=(4, 8), rules=("TRC04",),
+         build=lambda n: (debug_printing, (np.zeros(n, np.int64),))),
+]

@@ -1,0 +1,29 @@
+# Fixture: a clean kernel — dtype-pinned writes, subtraction-form sentinel
+# comparisons, shape-stable trace, no host callbacks. The trace engine
+# must report ZERO findings here.
+import jax.numpy as jnp
+import numpy as np
+
+import kueue_tpu.ops  # noqa: F401  (x64 before tracing)
+
+
+def clean_kernel(nominal, blim, blim_def, own, buf, vals):
+    # Sentinel-safe: compare via subtraction, never add two sentinels.
+    cap_ok = jnp.where(blim_def, own - blim <= nominal, True)
+    # Dtype-pinned write: the stored value matches the buffer dtype.
+    buf = buf.at[0].set(vals[0].astype(buf.dtype))
+    # Shape-stable reduction (one jaxpr per bucket).
+    return cap_ok.all(), buf.sum(dtype=buf.dtype)
+
+
+KUEUEVERIFY_KERNELS = [
+    dict(name="good-kernel", buckets=(4, 8),
+         # nominal/blim carry the 2^62 sentinel; the write buffer and its
+         # source are small bookkeeping counters
+         seeds={0: (0, 2**62), 1: (0, 2**62), 4: (0, 1 << 20),
+                5: (0, 1 << 20)},
+         build=lambda n: (clean_kernel, (
+             np.zeros(n, np.int64), np.zeros(n, np.int64),
+             np.zeros(n, bool), np.zeros(n, np.int64),
+             np.zeros(n, np.int32), np.zeros(n, np.int64)))),
+]

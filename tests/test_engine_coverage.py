@@ -1,0 +1,64 @@
+"""Meta-test: the preemption-engine registry (solver/modes.ENGINES) is the
+single source of truth, and every consumer that must cover ALL engines
+provably does — so a future engine cannot land unverified:
+
+  * the preemption goldens parametrize over every registered engine
+    (modulo optional engines whose toolchain is absent);
+  * the kueueverify trace roster lowers every traceable engine's kernel;
+  * every registry entry points at an importable module/attribute.
+"""
+
+from __future__ import annotations
+
+import importlib
+
+from kueue_tpu.analysis import trace_rules
+from kueue_tpu.solver import modes
+
+
+_importable = modes.engine_importable
+
+
+def test_registry_is_well_formed():
+    names = [e.name for e in modes.ENGINES]
+    assert len(names) == len(set(names))
+    kinds = {e.kind for e in modes.ENGINES}
+    assert kinds == {"host", "native", "jax"}
+    # The reference semantics live in exactly one host referee.
+    assert sum(e.kind == "host" for e in modes.ENGINES) == 1
+
+
+def test_every_engine_entry_point_exists():
+    for spec in modes.ENGINES:
+        if spec.optional_import and not _importable(spec):
+            continue
+        mod = importlib.import_module(spec.module)
+        assert hasattr(mod, spec.entry), \
+            f"{spec.name}: {spec.module}.{spec.entry} does not exist"
+
+
+def test_goldens_parametrize_every_registered_engine():
+    """A registered engine missing from the preemption-golden
+    parametrization would ship decision semantics nobody pinned against
+    the reference — the exact gap that let the PR 2 Pallas bugs live."""
+    from tests import test_preemption_goldens as goldens
+
+    required = {e.name for e in modes.ENGINES
+                if not e.optional_import or _importable(e)}
+    assert required <= set(goldens.ENGINES), \
+        f"goldens miss engines: {required - set(goldens.ENGINES)}"
+
+
+def test_trace_roster_covers_every_traceable_engine():
+    roster = {spec.name for spec in trace_rules.package_roster()}
+    traceable = {e.name for e in modes.ENGINES if e.traceable}
+    assert traceable <= roster, \
+        f"kueueverify roster misses engines: {traceable - roster}"
+
+
+def test_optional_engines_are_skipped_only_when_unimportable():
+    from tests import test_preemption_goldens as goldens
+
+    for spec in modes.ENGINES:
+        if spec.optional_import and _importable(spec):
+            assert spec.name in goldens.ENGINES

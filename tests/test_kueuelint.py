@@ -267,3 +267,79 @@ def test_parse_error_is_reported(tmp_path):
     findings = run_analysis([str(broken)])
     assert _rules_of(findings) == {"PARSE"}
     assert findings[0].severity == Severity.ERROR
+
+
+# ---------------------------------------------------------------------------
+# W001 — stale suppressions
+# ---------------------------------------------------------------------------
+
+
+def test_stale_suppression_is_reported(tmp_path):
+    # A disable comment on a clean line is dead weight: it masks a future
+    # regression on that line without excusing anything today.
+    src = tmp_path / "stale.py"
+    src.write_text("def fine():\n"
+                   "    return 1  # kueuelint: disable=JIT01\n")
+    findings = run_analysis([str(src)])
+    assert _rules_of(findings) == {"W001"}
+    assert "JIT01" in findings[0].message
+    assert findings[0].severity == Severity.WARNING
+
+
+def test_live_suppression_is_not_stale():
+    # suppressed.py's disables all excuse real findings: zero W001.
+    assert run_analysis([str(FIXTURES / "suppressed.py")]) == []
+
+
+def test_w001_ignores_rules_that_did_not_run(tmp_path):
+    # A TRC suppression is not stale in an ast-only run (the trace engine
+    # did not execute, so the rule had no chance to fire).
+    src = tmp_path / "trace_suppr.py"
+    src.write_text("def fine():\n"
+                   "    return 1  # kueuelint: disable=TRC02\n")
+    assert run_analysis([str(src)], engine="ast") == []
+
+
+def test_w001_ignores_bare_disable(tmp_path):
+    # Bare `disable` makes no per-rule claim; W001 only judges named ones.
+    src = tmp_path / "bare.py"
+    src.write_text("def fine():\n"
+                   "    return 1  # kueuelint: disable\n")
+    assert run_analysis([str(src)]) == []
+
+
+def test_docstring_mention_is_not_a_suppression(tmp_path):
+    # Directives are tokenized: prose inside a docstring that MENTIONS
+    # `# kueuelint: disable=API01` neither suppresses nor goes stale.
+    src = tmp_path / "doc.py"
+    src.write_text('"""Use `# kueuelint: disable=API01` to suppress."""\n'
+                   "def bad(batch=[]):\n"
+                   "    return batch\n")
+    findings = run_analysis([str(src)])
+    assert _rules_of(findings) == {"API01"}
+
+
+def test_package_has_no_stale_suppressions():
+    findings = run_analysis([str(PACKAGE)])
+    stale = [f for f in findings if f.rule == "W001"]
+    assert not stale, "\n".join(f.render() for f in stale)
+
+
+def test_w001_skips_unparseable_files(tmp_path):
+    # A file mid-edit ran no rules, so its suppressions are not stale.
+    src = tmp_path / "midedit.py"
+    src.write_text("def broken(:\n"
+                   "    x = 1  # kueuelint: disable=JIT01\n")
+    findings = run_analysis([str(src)])
+    assert _rules_of(findings) == {"PARSE"}
+
+
+def test_select_w001_alone_is_a_usage_error():
+    # Alone, W001 has no rules to judge — a silent exit-0 would read as
+    # "no stale suppressions" when nothing was checked.
+    proc = subprocess.run(
+        [sys.executable, "-m", "kueue_tpu.analysis", "--select", "W001",
+         str(FIXTURES / "suppressed.py")],
+        cwd=str(REPO), capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 2
+    assert "W001" in proc.stderr

@@ -1,0 +1,195 @@
+"""kueueverify (trace engine) + flow engine — tier-1 gate and unit tests.
+
+The headline gate runs EVERY analysis engine over the package: the ast
+rules, the whole-program flow rules (lock-order graph, ledger pairing),
+and the trace rules (every registered solver kernel lowered to a jaxpr
+and verified — dtype hazards, sentinel overflow, bucket-stable structure,
+forbidden effects). A PR that reintroduces the PR 2 Pallas bug class, or
+adds arithmetic that can wrap on sentinel inputs, or makes a kernel's
+trace shape-dependent, fails here with a file:line report.
+"""
+
+from __future__ import annotations
+
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from kueue_tpu.analysis import Severity, run_analysis
+from kueue_tpu.analysis import trace_rules
+from kueue_tpu.solver import modes
+
+REPO = Path(__file__).resolve().parent.parent
+PACKAGE = REPO / "kueue_tpu"
+FIXTURES = Path(__file__).resolve().parent / "fixtures" / "lint"
+
+
+def _rules_of(findings):
+    return {f.rule for f in findings}
+
+
+def _errors(findings):
+    return [f for f in findings if f.severity == Severity.ERROR]
+
+
+# ---------------------------------------------------------------------------
+# The gate: all engines, zero errors on the package
+# ---------------------------------------------------------------------------
+
+
+def test_package_clean_under_all_engines():
+    findings = run_analysis([str(PACKAGE)], engine="all")
+    errors = _errors(findings)
+    report = "\n".join(f.render() for f in errors)
+    assert not errors, f"kueuelint --engine all errors in kueue_tpu/:\n{report}"
+
+
+def test_cli_engine_all_exits_zero_on_package():
+    proc = subprocess.run(
+        [sys.executable, "-m", "kueue_tpu.analysis", "--engine", "all",
+         "--fail-on", "error", str(PACKAGE)],
+        cwd=str(REPO), capture_output=True, text=True, timeout=600)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_unknown_engine_rejected():
+    with pytest.raises(ValueError):
+        run_analysis([str(FIXTURES / "trace_good.py")], engine="jaxpr")
+
+
+def test_trace_rules_do_not_run_under_ast_engine():
+    findings = run_analysis([str(FIXTURES / "trace_bad.py")], engine="ast")
+    assert not (_rules_of(findings)
+                & {"TRC01", "TRC02", "TRC03", "TRC04"})
+
+
+# ---------------------------------------------------------------------------
+# Trace engine on fixture manifests
+# ---------------------------------------------------------------------------
+
+
+def test_trace_bad_fixture_triggers_every_trc_rule():
+    findings = run_analysis([str(FIXTURES / "trace_bad.py")], engine="trace")
+    assert {"TRC01", "TRC02", "TRC03", "TRC04"} <= _rules_of(findings)
+    by_rule = {}
+    for f in findings:
+        by_rule.setdefault(f.rule, []).append(f.message)
+    assert any("mixed-dtype write" in m for m in by_rule["TRC01"])
+    assert any("literal" in m for m in by_rule["TRC01"])
+    assert any("exceeds int64" in m for m in by_rule["TRC02"])
+    assert any("adjacent buckets" in m for m in by_rule["TRC03"])
+    assert any("debug_callback" in m for m in by_rule["TRC04"])
+    assert all(f.severity == Severity.ERROR for f in findings)
+
+
+def test_trace_good_fixture_is_clean():
+    assert run_analysis([str(FIXTURES / "trace_good.py")],
+                        engine="trace") == []
+
+
+def test_pr2_pallas_rescale_repro_caught_statically():
+    """The PR 2 Pallas int32-rescale bug shape (sentinel-poisoned int32
+    arithmetic + weak-int64 state writes) — found at runtime by the
+    all-engine preemption goldens back then — must be decided statically
+    by TRC01/TRC02 from the jaxpr alone."""
+    findings = run_analysis([str(FIXTURES / "pallas_rescale_bad.py")],
+                            engine="trace")
+    rules = _rules_of(findings)
+    assert {"TRC01", "TRC02"} <= rules
+    trc02 = [f for f in findings if f.rule == "TRC02"]
+    assert any("exceeds int32" in f.message for f in trc02)
+
+
+def test_broken_manifest_reports_parse_finding(tmp_path):
+    bad = tmp_path / "manifest_broken.py"
+    bad.write_text("KUEUEVERIFY_KERNELS = undefined_name\n")
+    findings = run_analysis([str(bad)], engine="trace")
+    assert _rules_of(findings) == {"PARSE"}
+
+
+def test_trace_findings_anchor_to_kernel_source_lines():
+    findings = run_analysis([str(FIXTURES / "trace_bad.py")],
+                            engine="trace")
+    text = (FIXTURES / "trace_bad.py").read_text().splitlines()
+    f = next(f for f in findings if f.rule == "TRC02")
+    assert "nominal + blim" in text[f.line - 1]
+
+
+def test_trace_suppressions_work_on_kernel_lines(tmp_path):
+    src = (FIXTURES / "trace_bad.py").read_text()
+    patched = src.replace("return own <= nominal + blim",
+                          "return own <= nominal + blim  "
+                          "# kueuelint: disable=TRC02")
+    target = tmp_path / "trace_suppressed.py"
+    target.write_text(patched)
+    findings = run_analysis([str(target)], engine="trace")
+    assert "TRC02" not in _rules_of(findings)
+
+
+# ---------------------------------------------------------------------------
+# TRC03: the one-compile-per-bucket contract, per engine
+# ---------------------------------------------------------------------------
+
+
+def test_trc03_every_batched_kernel_is_bucket_stable():
+    """Regression-pin: every roster kernel lowers to a structurally
+    IDENTICAL jaxpr at two adjacent head-count buckets — the contract
+    prewarm_idle's neighbor-bucket compilation relies on (exactly one XLA
+    compile per bucket, nothing shape-specialized)."""
+    report = trace_rules.bucket_report()
+    assert report, "empty kernel roster"
+    bad = [r for r in report if not r["equal"]]
+    assert not bad, f"bucket-unstable kernels: {bad}"
+    covered = {r["kernel"] for r in report}
+    # Every traceable registered engine, plus the flavor-fit and topology
+    # entry points, prove the contract.
+    want = {e.name for e in modes.ENGINES if e.traceable and e.batched}
+    want |= {"flavor-fit", "flavor-fit-packed", "topology-fit", "scan-jax"}
+    assert want <= covered, f"missing from roster: {want - covered}"
+
+
+def test_roster_buckets_are_adjacent_powers():
+    for spec in trace_rules.package_roster():
+        b0, b1 = spec.buckets
+        assert b1 == 2 * b0, (spec.name, spec.buckets)
+
+
+# ---------------------------------------------------------------------------
+# Flow engine fixtures
+# ---------------------------------------------------------------------------
+
+
+def test_lockgraph_bad_fixture_reports_cycle():
+    findings = run_analysis([str(FIXTURES / "lockgraph_bad.py")],
+                            engine="flow")
+    assert _rules_of(findings) == {"LOCK03"}
+    msg = findings[0].message
+    assert "CacheSide._lock" in msg and "QueueSide._cond" in msg
+    assert "deadlock" in msg
+
+
+def test_lockgraph_good_fixture_is_clean():
+    assert run_analysis([str(FIXTURES / "lockgraph_good.py")],
+                        engine="flow") == []
+
+
+def test_ledger_bad_fixture_reports_imbalance_and_error_path():
+    findings = run_analysis([str(FIXTURES / "ledger_bad.py")],
+                            engine="flow")
+    assert _rules_of(findings) == {"LED01"}
+    msgs = [f.message for f in findings]
+    assert any("never released" in m for m in msgs)
+    assert any("error exit" in m for m in msgs)
+
+
+def test_ledger_good_fixture_is_clean():
+    assert run_analysis([str(FIXTURES / "ledger_good.py")],
+                        engine="flow") == []
+
+
+def test_flow_engine_clean_on_package():
+    findings = run_analysis([str(PACKAGE)], engine="flow")
+    assert _errors(findings) == [], \
+        "\n".join(f.render() for f in _errors(findings))

@@ -155,17 +155,14 @@ def assignment_for(wi, flavors_modes):
     return a
 
 
-def _pallas_importable() -> bool:
-    try:
-        from kueue_tpu.ops import preemption_pallas  # noqa: F401
-        return True
-    except Exception:
-        return False
+# Parametrization is derived from the registry (solver/modes.ENGINES), so a
+# newly registered engine is golden-verified automatically; only engines
+# declared optional_import may drop out, and only when their import fails
+# (tests/test_engine_coverage.py pins this contract).
+from kueue_tpu.solver import modes as _modes
 
-
-ENGINES = ["host", "scan-jax", "batch-native", "batch-jax"]
-if _pallas_importable():
-    ENGINES.insert(2, "scan-pallas")
+ENGINES = [e.name for e in _modes.ENGINES
+           if not e.optional_import or _modes.engine_importable(e)]
 
 
 @pytest.fixture(params=ENGINES)

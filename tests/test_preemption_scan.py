@@ -328,3 +328,70 @@ class TestBatchEngine:
                     f"{sorted(t.obj.name for t in got)} != host "
                     f"{sorted(t.obj.name for t in want)}")
         assert preempt_cases > 10
+
+
+class TestSentinelOverflowRegression:
+    """kueueverify TRC02 regression: `workloadFits` used to evaluate
+    `own <= nominal + blim`, and with nominal/blim near the BIG/NO_LIMIT
+    2^62 sentinel (or user quotas in canonical units — 4Ei of memory is
+    2^62 bytes) the sum passed 2^63 and wrapped negative, flipping the
+    borrowing-cap verdict against the host referee's exact Python
+    arithmetic. The subtraction form is algebraically identical and stays
+    in range."""
+
+    def test_blim_cap_exact_at_2pow62_quota(self):
+        import jax.numpy as jnp
+        import numpy as np
+
+        from kueue_tpu.ops import preemption_scan as ps
+
+        FR = 4
+        big = np.int64(1) << 62
+        U = jnp.zeros((1, FR), dtype=jnp.int64)
+        wl_req = jnp.full(FR, 10, dtype=jnp.int64)
+        mask = jnp.ones(FR, dtype=bool)
+        nominal0 = jnp.full(FR, big, dtype=jnp.int64)
+        blim = jnp.full(FR, big, dtype=jnp.int64)
+        ok = ps._fits(
+            U, wl_req=wl_req, wl_req_mask=mask, t_def=mask,
+            nominal0=nominal0, blim=blim, blim_def=mask,
+            guaranteed=jnp.zeros((1, FR), dtype=jnp.int64),
+            requestable=jnp.full(FR, big, dtype=jnp.int64),
+            has_cohort=jnp.asarray(True), lending=jnp.asarray(False),
+            allow_b=jnp.asarray(True))
+        # Exact arithmetic: 10 <= 2^62 + 2^62 is trivially true; the
+        # wrapped form said False and starved every borrowing preemptor.
+        assert bool(ok)
+
+    def test_scan_kernel_matches_exact_arithmetic_at_scale(self):
+        import jax.numpy as jnp
+        import numpy as np
+
+        from kueue_tpu.ops import preemption_scan as ps
+
+        # One borrowing candidate whose removal makes the preemptor fit;
+        # every quota rides at 2^62-magnitude values.
+        big = np.int64(1) << 62
+        FR = 2
+        usage0 = np.array([[big // 2, 0], [0, 0]], dtype=np.int64)
+        nominal = np.array([[big // 4, big], [big, big]], dtype=np.int64)
+        q_def = np.array([[True, False], [False, False]])
+        victim, fits = ps.scan_kernel(
+            jnp.asarray(usage0), jnp.asarray(nominal), jnp.asarray(q_def),
+            jnp.zeros((2, FR), dtype=jnp.int64),
+            jnp.asarray(np.array([big // 4, 0], dtype=np.int64)),
+            jnp.asarray(np.array([True, False])),
+            jnp.asarray(np.array([big, 0], dtype=np.int64)),
+            jnp.asarray(np.array([True, False])),
+            jnp.asarray(np.array([big, big], dtype=np.int64)),
+            jnp.asarray(np.array([True, False])),
+            jnp.asarray(np.zeros(1, dtype=np.int32)),
+            jnp.asarray(np.array([[big // 2, 0]], dtype=np.int64)),
+            jnp.asarray(np.zeros(1, dtype=np.int32)),
+            jnp.asarray(True), jnp.asarray(False), jnp.asarray(True),
+            jnp.asarray(False), jnp.asarray(0, dtype=jnp.int32))
+        # Exact semantics: after removing the candidate the target's own
+        # usage (big//4) is within nominal+blim (big//4 + big) and the
+        # cohort pool fits -> the candidate is the victim.
+        assert bool(fits)
+        assert np.asarray(victim).tolist() == [True]

@@ -100,3 +100,29 @@ def test_cold_dispatch_counter_counts_unwarmed_shapes():
     _submit(fw, 3)
     fw.tick()
     assert solver.cold_dispatches == 1
+
+
+def test_podset_axis_is_sticky_within_encoding_generation():
+    """The P axis must not rotate DOWN with batch composition: after a
+    tick whose batch held a multi-podset workload (P=2), a later
+    all-single-podset tick re-encodes at the floored P and hits the warm
+    kernel instead of compiling a (W, 1, ...) twin — the compile cliff
+    the bench's cold-dispatch guard caught on the cohortlend mix."""
+    fw, solver = _world(4)
+
+    # Tick 1: one 2-podset workload in the batch -> P=2 compiles.
+    _seq[0] += 1
+    fw.submit(Workload(
+        name=f"pw{_seq[0]}", queue_name="lq0",
+        pod_sets=[PodSet.make("driver", 1, cpu=1),
+                  PodSet.make("workers", 2, cpu=1)]))
+    _submit(fw, 3)
+    fw.tick()
+    cold_after_first = solver.cold_dispatches
+    assert cold_after_first >= 1
+
+    # Tick 2: all heads single-podset. Without the P floor this encoded
+    # P=1 — a brand-new shape — and compiled inside the tick.
+    _submit(fw, 4)
+    fw.tick()
+    assert solver.cold_dispatches == cold_after_first
