@@ -9,8 +9,8 @@
 
 PYTHON ?= python
 
-.PHONY: help test test-fast bench bench-smoke native lint verify-static \
-	install serve dryrun
+.PHONY: help test test-fast bench bench-smoke trace-smoke native lint \
+	verify-static install serve dryrun
 
 help:
 	@echo "kueue-tpu developer targets:"
@@ -23,6 +23,9 @@ help:
 	@echo "                      jaxpr rules TRC01-04; needs jax)"
 	@echo "  make bench          full-scale benchmark (north-star shapes)"
 	@echo "  make bench-smoke    tiny-shape bench for CI/laptops"
+	@echo "  make trace-smoke    end-to-end trace: run the CLI with"
+	@echo "                      --trace-out and schema-validate the"
+	@echo "                      Chrome trace-event export (Perfetto)"
 	@echo "  make native         build the C++ runtime pieces"
 	@echo "  make serve          run the API server"
 	@echo "  make dryrun         compile-check the flagship jit path"
@@ -43,6 +46,25 @@ bench:
 # pipelining, the topology stage, churn) can't silently break.
 bench-smoke:
 	KUEUE_BENCH_SMOKE=1 KUEUE_BENCH_TICKS=10 JAX_PLATFORMS=cpu $(PYTHON) bench.py
+
+# End-to-end tracing smoke: drive the real CLI with span tracing on,
+# then prove the exported file is valid Chrome trace-event JSON (the
+# Perfetto/chrome://tracing format) containing the tick pipeline's
+# phase spans. Runs in CI next to bench-smoke, so the trace surface
+# cannot silently rot.
+trace-smoke:
+	JAX_PLATFORMS=cpu $(PYTHON) -m kueue_tpu \
+	  --objects examples/single-clusterqueue-setup.yaml \
+	  --objects examples/sample-job.yaml --ticks 6 \
+	  --trace-out /tmp/kueue-trace-smoke.json
+	$(PYTHON) -c "import json; \
+	  from kueue_tpu.tracing import validate_chrome_trace; \
+	  doc = json.load(open('/tmp/kueue-trace-smoke.json')); \
+	  problems = validate_chrome_trace(doc); \
+	  assert not problems, problems; \
+	  names = {e['name'] for e in doc['traceEvents']}; \
+	  assert 'tick' in names and 'admit' in names, sorted(names); \
+	  print('trace-smoke OK:', len(doc['traceEvents']), 'events')"
 
 # Build the C++ runtime pieces (keyed heap, admission decoder) explicitly;
 # they are also built lazily on first import.

@@ -177,6 +177,7 @@ def run_config(*, label, num_cqs, num_cohorts, num_flavors, backlog, ticks,
     gc.disable()
 
     from kueue_tpu.metrics import REGISTRY
+    from kueue_tpu.tracing import TRACER, validate_chrome_trace
 
     phases = REGISTRY.tick_phase_seconds
     phase_base = dict(phases.sums)
@@ -187,25 +188,59 @@ def run_config(*, label, num_cqs, num_cohorts, num_flavors, backlog, ticks,
     # the p99 below is a compile cliff, not a scheduling number.
     solver = getattr(fw.scheduler, "batch_solver", None)
     cold_before = getattr(solver, "cold_dispatches", 0) if solver else 0
-    times = []
     tick_phases = []
-    admitted = 0
     base_admitted = fw.scheduler.metrics.admitted
-    for _ in range(ticks):
-        tick_no[0] += 1
-        if verbose:
-            before = dict(phases.sums)
-        t = time.perf_counter()
-        fw.tick()
-        times.append(time.perf_counter() - t)
-        if verbose:
-            tick_phases.append({k[0]: phases.sums[k] - before.get(k, 0.0)
-                                for k in phases.sums})
-        churn()
-        if tick_no[0] % 20 == 0:
-            gc.collect()   # idle-window cycle reaping (untimed)
+
+    def measure(n):
+        window = []
+        for _ in range(n):
+            tick_no[0] += 1
+            if verbose:
+                before = dict(phases.sums)
+            t = time.perf_counter()
+            fw.tick()
+            window.append(time.perf_counter() - t)
+            if verbose:
+                tick_phases.append(
+                    {k[0]: phases.sums[k] - before.get(k, 0.0)
+                     for k in phases.sums})
+            churn()
+            if tick_no[0] % 20 == 0:
+                gc.collect()   # idle-window cycle reaping (untimed)
+        return window
+
+    # The headline window runs with tracing ENABLED at default sampling —
+    # the production posture the overhead assertion below certifies, and
+    # the source of the slowest-tick trace artifact.
+    TRACER.reset()
+    TRACER.configure(enabled=True)
+    times = measure(ticks)
     admitted = fw.scheduler.metrics.admitted - base_admitted
     preempted = fw.scheduler.metrics.preempted - preempted_before
+    phase_means = {
+        k[0]: 1000.0 * (phases.sums[k] - phase_base.get(k, 0.0)) / ticks
+        for k in sorted(phases.sums)}
+    times_ms = np.array(times) * 1000.0
+    p50 = float(np.percentile(times_ms, 50))
+    p99 = float(np.percentile(times_ms, 99))
+
+    # Slowest-tick trace: head+tail sampling retained the worst tick of
+    # the window; export it as Chrome trace JSON (Perfetto-loadable) and
+    # point to it from the BENCH record, so the p99 outlier is a file an
+    # operator can open, not just a number.
+    slowest = TRACER.slowest_tick()
+    trace_doc = TRACER.export_chrome(slowest_only=True)
+    problems = validate_chrome_trace(trace_doc)
+    if problems:
+        raise RuntimeError(f"[{label}] invalid trace export: {problems[:3]}")
+    import tempfile
+    trace_path = os.environ.get("KUEUE_BENCH_TRACE_OUT") or os.path.join(
+        tempfile.gettempdir(), f"kueue_bench_{label}_slowest_tick.json")
+    with open(trace_path, "w", encoding="utf-8") as f:
+        json.dump(trace_doc, f)
+
+    # Compile-proof check for the measured (traced) window, BEFORE the
+    # overhead window runs, so a compile there cannot be blamed here.
     cold_during = (getattr(solver, "cold_dispatches", 0) - cold_before
                    if solver else 0)
     if cold_during:
@@ -215,16 +250,45 @@ def run_config(*, label, num_cqs, num_cohorts, num_flavors, backlog, ticks,
             "reported p99 is an XLA compile cliff. Fix the prewarm path "
             "(BatchSolver._maybe_prewarm / prewarm_idle) or raise "
             "KUEUE_PREWARM_MAX_BUCKET before trusting this run.")
-    phase_means = {
-        k[0]: 1000.0 * (phases.sums[k] - phase_base.get(k, 0.0)) / ticks
-        for k in sorted(phases.sums)}
+
+    # Tracer-overhead gate (north-star config): p99 with tracing at
+    # default sampling must sit within 2% of tracing-off — the no-op
+    # claim, measured on the real tick loop. A 0.5ms floor absorbs timer
+    # jitter. The HARD failure only arms with >= 50 samples per window:
+    # below that (bench-smoke's 10 ticks) "p99" is literally the single
+    # slowest tick and one OS preemption would flake CI — the numbers
+    # are still recorded in the BENCH json either way.
+    TRACER.configure(enabled=False)
+    overhead = None
+    if label == "northstar":
+        cold_before_off = getattr(solver, "cold_dispatches", 0) \
+            if solver else 0
+        p99_off = float(np.percentile(
+            np.array(measure(ticks)) * 1000.0, 99))
+        cold_off = (getattr(solver, "cold_dispatches", 0) - cold_before_off
+                    if solver else 0)
+        tol = max(0.02 * p99_off, 0.5)
+        gated = ticks >= 50 and cold_off == 0
+        overhead = {"p99_on_ms": round(p99, 3),
+                    "p99_off_ms": round(p99_off, 3),
+                    "tolerance_ms": round(tol, 3),
+                    "gated": gated}
+        if cold_off:
+            # A compile inside the untraced window pollutes p99_off (it
+            # would only LOOSEN the gate) — report, don't compare.
+            print(f"# [{label}] {cold_off} cold dispatch(es) in the "
+                  "untraced overhead window; overhead gate skipped",
+                  file=sys.stderr)
+        elif gated and p99 > p99_off + tol:
+            raise RuntimeError(
+                f"[{label}] tracer overhead above budget: p99 {p99:.2f}ms "
+                f"traced vs {p99_off:.2f}ms untraced (tolerance "
+                f"{tol:.2f}ms). The default-sampling tracer must be a "
+                "no-op on the tick hot path — profile the span ring "
+                "before trusting this run.")
     gc.enable()
     gc.unfreeze()
     gc.collect()
-
-    times_ms = np.array(times) * 1000.0
-    p50 = float(np.percentile(times_ms, 50))
-    p99 = float(np.percentile(times_ms, 99))
     import jax
     backend = jax.default_backend()
     inject_ms = float(os.environ.get("KUEUE_BENCH_INJECT_MS", "0") or 0)
@@ -244,9 +308,17 @@ def run_config(*, label, num_cqs, num_cohorts, num_flavors, backlog, ticks,
         "cold_dispatches_total": getattr(solver, "cold_dispatches", 0)
         if solver else 0,
         "admissions_per_s": round(admitted / (sum(times) or 1e-9), 1),
+        # Derived from tracer phase spans (the kueue_tick_phase_seconds
+        # histogram is fed exclusively by TRACER.phase — one measurement
+        # serves metrics, bench and the trace export).
         "phase_means_ms": {k: round(v, 2) for k, v in phase_means.items()
                            if v >= 0.05},
+        "slowest_tick_trace": trace_path,
+        "slowest_tick_ms": round(slowest.duration * 1000.0, 3)
+        if slowest is not None else None,
     }
+    if overhead is not None:
+        stats["tracer_overhead"] = overhead
     print(
         f"# [{label}] {num_cqs} CQs x {num_cohorts} cohorts x {num_flavors} "
         f"flavors, backlog {backlog}, {ticks} ticks on "

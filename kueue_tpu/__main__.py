@@ -119,6 +119,11 @@ def build_parser() -> argparse.ArgumentParser:
                         help="print the debugger state dump on exit")
     parser.add_argument("--metrics", action="store_true",
                         help="print the metrics registry on exit")
+    parser.add_argument("--trace-out", default=None, metavar="FILE",
+                        help="enable span tracing and write the retained "
+                        "ticks as Chrome trace-event JSON to FILE on exit "
+                        "(load in Perfetto / chrome://tracing; also served "
+                        "live at GET /debug/traces with --port)")
     return parser
 
 
@@ -127,6 +132,11 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
 
     cfg = config_mod.load(args.config) if args.config else config_mod.Configuration()
     _parse_feature_gates(args.feature_gates)
+
+    if args.trace_out:
+        from kueue_tpu.tracing import TRACER
+
+        TRACER.configure(enabled=True)
 
     batch_solver = None
     if args.batch_solver:
@@ -170,14 +180,16 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
 
         runtime_lock = threading.RLock()
         server = APIServer(store, fw,
-                           visibility=VisibilityServer(fw.queues),
+                           visibility=VisibilityServer(
+                               fw.queues, explain=fw.scheduler.explain),
                            host=args.host, port=args.port,
                            runtime_lock=runtime_lock,
                            sync_status=adapter.sync_status)
         server.start()
         print(f"serving HTTP API on {server.url}", file=sys.stderr, flush=True)
 
-    dumper = Dumper(fw.cache, fw.queues)
+    dumper = Dumper(fw.cache, fw.queues, events=fw.events,
+                    explain=fw.scheduler.explain)
     dumper.listen_for_signal()  # SIGUSR2, like debugger.go:41-48
 
     elector = None
@@ -303,6 +315,13 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
 
     if server is not None:
         server.stop()
+    if args.trace_out:
+        from kueue_tpu.tracing import TRACER
+
+        with open(args.trace_out, "w", encoding="utf-8") as f:
+            f.write(TRACER.export_json())
+        print(f"wrote trace to {args.trace_out} "
+              "(load in Perfetto / chrome://tracing)", file=sys.stderr)
     if args.dump_state:
         print(dumper.dump_json(), file=sys.stderr)
     if args.metrics:

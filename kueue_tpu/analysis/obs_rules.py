@@ -1,0 +1,83 @@
+"""Observability-hygiene rule (OBS01).
+
+The tick pipeline has exactly ONE timing source: the span tracer
+(`kueue_tpu.tracing.TRACER.phase/span/lock`, `trace_now` for raw
+timestamps on the tracer's timebase). The `kueue_tick_phase_seconds`
+histogram, bench.py's `phase_means_ms`, and the Chrome-trace export all
+derive from it — a raw `time.perf_counter()` / `time.monotonic()`
+measurement dropped into scheduler/solver/controller code would feed
+one consumer and silently drift from the other two (exactly the
+pre-tracer state this rule prevents regressing to).
+
+OBS01 flags, inside the tick-pipeline packages:
+
+  * attribute reads of `time.monotonic` / `time.perf_counter` (and the
+    `_ns` variants) through any alias of the `time` module — calls AND
+    aliasing assignments both surface as the Attribute node;
+  * `from time import perf_counter/monotonic [as ...]` imports.
+
+`time.time()` / `clock()` wall-clock reads are not timing measurements
+and stay unflagged. The tracer's own internals are the sanctioned
+consumer and carry explicit suppressions; non-measurement uses (e.g. a
+monotonic TTL anchor for a health cache) suppress with a justification,
+same as the LOCK01 discipline.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Set
+
+from kueue_tpu.analysis.core import (
+    AnalysisContext, Rule, Severity, SourceFile, finding, register)
+
+_OBS_PATHS = ("scheduler/", "solver/", "controllers/", "queue/", "core/",
+              "models/", "tracing/", "fixtures/lint/")
+
+_TIMING_FNS = {"monotonic", "perf_counter", "monotonic_ns",
+               "perf_counter_ns"}
+
+
+def _time_aliases(tree: ast.Module) -> Set[str]:
+    """Names the `time` module is bound to in this file."""
+    out: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "time":
+                    out.add(a.asname or "time")
+    return out
+
+
+def _check_obs01(f: SourceFile, ctx: AnalysisContext):
+    aliases = _time_aliases(f.tree)
+    for node in ast.walk(f.tree):
+        if isinstance(node, ast.ImportFrom) and node.module == "time":
+            for a in node.names:
+                if a.name in _TIMING_FNS:
+                    yield finding(
+                        OBS01, f, node,
+                        f"`from time import {a.name}` in the tick "
+                        "pipeline — route timing through "
+                        "kueue_tpu.tracing (TRACER.phase/span feed the "
+                        "phase histogram, bench and the trace export "
+                        "from one measurement; trace_now() for raw "
+                        "timestamps)")
+        elif isinstance(node, ast.Attribute) \
+                and isinstance(node.ctx, ast.Load) \
+                and node.attr in _TIMING_FNS \
+                and isinstance(node.value, ast.Name) \
+                and node.value.id in aliases:
+            yield finding(
+                OBS01, f, node,
+                f"raw `{node.value.id}.{node.attr}` timing in the tick "
+                "pipeline — use TRACER.phase(name) (metrics + bench + "
+                "trace export from one measurement) or TRACER.span/lock; "
+                "trace_now() for a raw timestamp on the tracer's "
+                "timebase")
+
+
+OBS01 = register(Rule(
+    id="OBS01", severity=Severity.ERROR,
+    summary="raw time.monotonic/perf_counter timing bypassing the tracer",
+    check=_check_obs01, path_fragments=_OBS_PATHS))

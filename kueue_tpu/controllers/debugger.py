@@ -16,9 +16,15 @@ from kueue_tpu.queue.manager import Manager
 
 
 class Dumper:
-    def __init__(self, cache: Cache, queues: Manager):
+    def __init__(self, cache: Cache, queues: Manager, events=None,
+                 explain=None):
         self.cache = cache
         self.queues = queues
+        # Optional extras: the Framework's EventRecorder (occupancy /
+        # drop accounting) and the scheduler's ExplainStore (last
+        # admission decision per workload).
+        self.events = events
+        self.explain = explain
 
     def dump(self) -> Dict:
         cache_dump = {}
@@ -37,7 +43,19 @@ class Dumper:
                 "inadmissible": sorted(cq.inadmissible),
                 "popCycle": cq.pop_cycle,
             }
-        return {"cache": cache_dump, "queues": queue_dump}
+        out = {"cache": cache_dump, "queues": queue_dump}
+        if self.events is not None:
+            out["events"] = {
+                "occupancy": self.events.occupancy,
+                "capacity": self.events.capacity,
+                "dropped": self.events.dropped,
+            }
+        if self.explain is not None:
+            out["explain"] = {
+                "workloads": self.explain.occupancy,
+                "lastDecisions": self.explain.snapshot(limit=100),
+            }
+        return out
 
     def dump_json(self) -> str:
         return json.dumps(self.dump(), indent=2, sort_keys=True)

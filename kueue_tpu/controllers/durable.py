@@ -26,6 +26,7 @@ from typing import Optional
 from kueue_tpu.api import serialization
 from kueue_tpu.controllers import store as store_mod
 from kueue_tpu.controllers.store import DELETED, Event, Store
+from kueue_tpu.tracing import TRACER
 
 # Replay/snapshot kind order: referenced-before-referencing (a workload's
 # admission names a ClusterQueue; a LocalQueue names a ClusterQueue...).
@@ -139,16 +140,19 @@ class Journal:
         if ev.type != DELETED:
             entry["object"] = serialization.encode(ev.kind, ev.obj)
         line = json.dumps(entry, separators=(",", ":"))
-        with self._lock:
+        with TRACER.lock(self._lock, "journal.lock_wait"):
             if self._file is None:
                 # Serializing append I/O is this lock's purpose: entries
                 # must hit the journal in event order.
                 self._file = open(  # kueuelint: disable=LOCK01
                     self.path, "a", encoding="utf-8")
-            self._file.write(line + "\n")
-            self._file.flush()
-            if self.fsync:
-                os.fsync(self._file.fileno())
+            with TRACER.span("journal.append") as sp:
+                self._file.write(line + "\n")
+                self._file.flush()
+                if self.fsync:
+                    with TRACER.span("journal.fsync"):
+                        os.fsync(self._file.fileno())
+                sp.set("bytes", len(line) + 1)
             self._lines += 1
             if self._lines >= COMPACT_MIN_LINES and self._store is not None:
                 live = sum(len(self._store.list(k)) for k in KIND_ORDER)

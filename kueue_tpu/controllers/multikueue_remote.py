@@ -99,7 +99,9 @@ class HTTPRemote(RemoteClient):
     # -- RemoteClient ------------------------------------------------------
 
     def connected(self) -> bool:
-        now = time.monotonic()
+        # TTL anchor for the health-probe cache, not a latency
+        # measurement — nothing for the tracer to aggregate.
+        now = time.monotonic()  # kueuelint: disable=OBS01
         if now - self._health_at < _HEALTH_CACHE_SECONDS:
             return self._health
         try:

@@ -43,6 +43,7 @@ from kueue_tpu.core.workload import WorkloadInfo, WorkloadOrdering
 from kueue_tpu.queue.manager import Manager, RequeueReason
 from kueue_tpu.scheduler.preemption import DEFAULT_FAIR_STRATEGIES
 from kueue_tpu.scheduler.scheduler import Scheduler
+from kueue_tpu.tracing import TRACER
 from kueue_tpu.utils import limitrange as limitrange_mod
 from kueue_tpu.utils.limitrange import LimitRange
 from kueue_tpu import events as events_mod
@@ -361,11 +362,37 @@ class Framework:
         self.queues.queue_inadmissible_workloads(
             list(self.queues.cluster_queues))
 
+    def delete_resource_flavor(self, name: str) -> None:
+        """Delete a ResourceFlavor: drop it from the cache (topology
+        ledger included) and prune every metric series labeled with it —
+        a deleted flavor must stop exporting, exactly like a deleted CQ
+        (metrics.ClearClusterQueueMetrics discipline). Without this the
+        `topology_fragmentation` and per-(cq,flavor) series of a retired
+        flavor lived until process exit."""
+        self.cache.delete_resource_flavor(name)
+        REGISTRY.topology_fragmentation.prune(
+            lambda key: not key or key[0] != name)
+        REGISTRY.cluster_queue_resource_usage.prune(
+            lambda key: len(key) < 2 or key[1] != name)
+        # Cohort-labeled quota gauges carry the flavor at index 2.
+        for gauge in (REGISTRY.cluster_queue_resource_reservation,
+                      REGISTRY.cluster_queue_borrowing_limit,
+                      REGISTRY.cluster_queue_lending_limit):
+            gauge.prune(lambda key: len(key) < 3 or key[2] != name)
+
     def delete_cluster_queue(self, name: str) -> None:
         self.cluster_queue_specs.pop(name, None)
         self.cache.delete_cluster_queue(name)
         self.queues.delete_cluster_queue(name)
         self._quota_reserved_msgs.pop(name, None)
+        # Stale-series prune for every per-CQ gauge, including the
+        # cohort-labeled quota trio that update_metrics_gauges only
+        # touches when metrics.enableClusterQueueResources is on (a
+        # series set while the knob was on must still die with its CQ).
+        for gauge in (REGISTRY.cluster_queue_resource_reservation,
+                      REGISTRY.cluster_queue_borrowing_limit,
+                      REGISTRY.cluster_queue_lending_limit):
+            gauge.prune(lambda key: len(key) < 2 or key[1] != name)
         self.update_metrics_gauges()
 
     def create_local_queue(self, lq: LocalQueue) -> None:
@@ -497,6 +524,10 @@ class Framework:
             self._note_quota_released(wl, released)
         self.queues.delete_workload(wl)
         self.queues.queue_associated_inadmissible_workloads(wl)
+        # A deleted object's admission story dies with it (the LRU would
+        # reap it eventually; doing it here keeps churn from crowding out
+        # live workloads' records).
+        self.scheduler.explain.forget(wl.key)
 
     def requeue_updated_workload(self, wl: Workload) -> None:
         """Re-enqueue a pending workload whose spec changed in place (the
@@ -796,32 +827,40 @@ class Framework:
     # -- driving -------------------------------------------------------------
 
     def tick(self) -> int:
-        """One scheduling cycle plus the reconcile pass; returns admissions."""
-        self.queues.flush_expired_backoffs()
-        if self.pipeline_depth <= 1:
-            admitted = self.scheduler.schedule(timeout=0.0)
-        else:
-            tick = self.scheduler.schedule_async(timeout=0.0)
-            if tick is not None:
-                self._inflight_ticks.append(tick)
-            admitted = 0
-            # Complete the oldest tick; when the queue ran dry, drain one
-            # in-flight tick per call instead of all of them — a burst
-            # drain would multiply a single tick's latency by the pipeline
-            # depth (p99 spike), and progressive drain preserves the same
-            # eventual state across run_until_settled.
-            keep = self.pipeline_depth - 1 if tick is not None \
-                else len(self._inflight_ticks) - 1
-            while len(self._inflight_ticks) > max(keep, 0):
-                admitted += self.scheduler.schedule_finish(
-                    self._inflight_ticks.pop(0))
-        t_r = _time.perf_counter()
-        self.reconcile()
-        self.job_reconciler.reconcile()
-        if features.enabled(features.QUEUE_VISIBILITY):
-            self.queue_visibility.maybe_update(self.clock())
-        REGISTRY.tick_phase_seconds.observe(
-            "reconcile", value=_time.perf_counter() - t_r)
+        """One scheduling cycle plus the reconcile pass; returns admissions.
+
+        The whole call is one tracer tick: every phase span recorded
+        below (snapshot/tensorize/device_solve/nominate/admit/requeue/
+        reconcile, the solver's dispatch attributes, lock waits, journal
+        fsyncs) groups under it in the exported trace, and the finished
+        tick enters the ring buffer — head+tail sampled so the slowest
+        ticks survive for `GET /debug/traces`."""
+        with TRACER.tick() as tick_span:
+            self.queues.flush_expired_backoffs()
+            if self.pipeline_depth <= 1:
+                admitted = self.scheduler.schedule(timeout=0.0)
+            else:
+                tick = self.scheduler.schedule_async(timeout=0.0)
+                if tick is not None:
+                    self._inflight_ticks.append(tick)
+                admitted = 0
+                # Complete the oldest tick; when the queue ran dry, drain
+                # one in-flight tick per call instead of all of them — a
+                # burst drain would multiply a single tick's latency by
+                # the pipeline depth (p99 spike), and progressive drain
+                # preserves the same eventual state across
+                # run_until_settled.
+                keep = self.pipeline_depth - 1 if tick is not None \
+                    else len(self._inflight_ticks) - 1
+                while len(self._inflight_ticks) > max(keep, 0):
+                    admitted += self.scheduler.schedule_finish(
+                        self._inflight_ticks.pop(0))
+            with TRACER.phase("reconcile"):
+                self.reconcile()
+                self.job_reconciler.reconcile()
+                if features.enabled(features.QUEUE_VISIBILITY):
+                    self.queue_visibility.maybe_update(self.clock())
+            tick_span.set("admitted", admitted)
         return admitted
 
     def prewarm_idle(self) -> int:

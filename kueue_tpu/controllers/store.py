@@ -275,6 +275,8 @@ class StoreAdapter:
     def _on_flavor(self, ev: Event) -> None:
         if ev.type in (ADDED, MODIFIED):
             self.fw.create_resource_flavor(ev.obj)
+        else:
+            self.fw.delete_resource_flavor(ev.obj.name)
 
     def _on_cluster_queue(self, ev: Event) -> None:
         if ev.type == ADDED:

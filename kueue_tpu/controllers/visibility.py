@@ -10,10 +10,11 @@ queue manager's heaps.
 
 from __future__ import annotations
 
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 from typing import List, Optional
 
 from kueue_tpu.queue.manager import Manager
+from kueue_tpu.tracing import ExplainStore
 
 
 @dataclass
@@ -24,15 +25,24 @@ class PendingWorkloadInfo:
     priority: int
     position_in_cluster_queue: int
     position_in_local_queue: int
+    # Admission explainability (?explain=true): the workload's recorded
+    # scheduling attempts — every flavor tried with its verdict, topology
+    # placement, final reason. None unless explain was requested.
+    decisions: Optional[List[dict]] = field(default=None)
 
 
 class VisibilityServer:
-    def __init__(self, queues: Manager, max_count: int = 4000):
+    def __init__(self, queues: Manager, max_count: int = 4000,
+                 explain: Optional[ExplainStore] = None):
         self.queues = queues
         self.max_count = max_count
+        # The scheduler's decision-record store (scheduler.explain);
+        # None = the explainability surface reports no history.
+        self.explain = explain
 
     def pending_workloads_in_cq(self, cq_name: str, offset: int = 0,
                                 limit: Optional[int] = None,
+                                explain: bool = False,
                                 ) -> List[PendingWorkloadInfo]:
         """Pending workloads of a ClusterQueue in admission order."""
         cq = self.queues.cluster_queues.get(cq_name)
@@ -54,16 +64,21 @@ class VisibilityServer:
             lq_positions[lq_key] = lq_pos + 1
             if pos < offset or len(out) >= limit:
                 continue
+            decisions = None
+            if explain and self.explain is not None:
+                decisions = self.explain.for_workload(wi.key)
             out.append(PendingWorkloadInfo(
                 name=wi.obj.name, namespace=wi.obj.namespace,
                 local_queue=wi.obj.queue_name, priority=wi.obj.priority,
                 position_in_cluster_queue=pos,
-                position_in_local_queue=lq_pos))
+                position_in_local_queue=lq_pos,
+                decisions=decisions))
         return out
 
     def pending_workloads_in_lq(self, namespace: str, lq_name: str,
                                 offset: int = 0,
                                 limit: Optional[int] = None,
+                                explain: bool = False,
                                 ) -> List[PendingWorkloadInfo]:
         lq = self.queues.local_queues.get(f"{namespace}/{lq_name}")
         if lq is None:
@@ -72,7 +87,16 @@ class VisibilityServer:
         mine = [p for p in all_cq
                 if p.namespace == namespace and p.local_queue == lq_name]
         limit = self.max_count if limit is None else limit
-        return mine[offset:offset + limit]
+        page = mine[offset:offset + limit]
+        if explain and self.explain is not None:
+            # Materialize decision records AFTER the LQ filter + paging:
+            # the owning CQ may hold thousands of rows this listing
+            # discards, and this runs under the API server's runtime
+            # lock (a scheduler tick waits on it).
+            for p in page:
+                p.decisions = self.explain.for_workload(
+                    f"{p.namespace}/{p.name}")
+        return page
 
 
 class QueueVisibilitySnapshotter:

@@ -9,7 +9,6 @@ add/remove-workload simulation primitive used by preemption
 
 from __future__ import annotations
 
-import time as _time
 from typing import Dict, List, Optional, Set, Tuple
 
 from kueue_tpu import features
@@ -23,6 +22,7 @@ from kueue_tpu.core.cache import (
 )
 from kueue_tpu.core.workload import WorkloadInfo
 from kueue_tpu.metrics import REGISTRY
+from kueue_tpu.tracing import TRACER
 from kueue_tpu.utils import native_ledger
 
 _ledger = native_ledger.load()
@@ -255,58 +255,60 @@ class SnapshotMirror:
         dirty_names = self._dirty
         if not dirty_names:
             return snap
-        t_d = _time.perf_counter()
         reclones = 0
-        while dirty_names:
-            # Atomic pop-drain: a concurrent mutator thread re-adding a
-            # name AFTER the pop is preserved for this loop or the next
-            # refresh — list()+clear() could drop a mark added between
-            # the two and leave that CQ permanently stale.
-            try:
-                name = dirty_names.pop()
-            except KeyError:
-                break
-            cq = cache.cluster_queues.get(name)
-            if cq is None or self._base.get(name) == cq.usage_version:
-                continue
-            if not cq.active() or name in snap.inactive_cluster_queues:
-                # Snapshot.build excludes inactive CQs entirely (the
-                # reference skips them in snapshot.go); a usage-only change
-                # on a stopped/broken CQ must not re-insert it — just track
-                # the version so we don't revisit every refresh. The
-                # snapshot-side exclusion check matters for cohort-cycle
-                # deactivation (KEP-79): the cache-side active() cannot see
-                # it, and re-inserting would leave a phantom cohortless CQ
-                # that a from-scratch build excludes.
+        with TRACER.phase("snapshot.dirty") as dirty_span:
+            while dirty_names:
+                # Atomic pop-drain: a concurrent mutator thread re-adding a
+                # name AFTER the pop is preserved for this loop or the next
+                # refresh — list()+clear() could drop a mark added between
+                # the two and leave that CQ permanently stale.
+                try:
+                    name = dirty_names.pop()
+                except KeyError:
+                    break
+                cq = cache.cluster_queues.get(name)
+                if cq is None or self._base.get(name) == cq.usage_version:
+                    continue
+                if not cq.active() or name in snap.inactive_cluster_queues:
+                    # Snapshot.build excludes inactive CQs entirely (the
+                    # reference skips them in snapshot.go); a usage-only
+                    # change on a stopped/broken CQ must not re-insert it —
+                    # just track the version so we don't revisit every
+                    # refresh. The snapshot-side exclusion check matters for
+                    # cohort-cycle deactivation (KEP-79): the cache-side
+                    # active() cannot see it, and re-inserting would leave a
+                    # phantom cohortless CQ that a from-scratch build
+                    # excludes.
+                    self._base[name] = cq.usage_version
+                    continue
+                self.mutation_count += 1
+                reclones += 1
                 self._base[name] = cq.usage_version
-                continue
-            self.mutation_count += 1
-            reclones += 1
-            self._base[name] = cq.usage_version
-            old = snap.cluster_queues.get(name)
-            fresh = _snapshot_cq(cq)
-            snap.cluster_queues[name] = fresh
-            cohort = old.cohort if old is not None else None
-            if cohort is None and cq.cohort is not None:
-                cohort = next((c.cohort for c in snap.cluster_queues.values()
-                               if c.cohort is not None
-                               and c.cohort.name == cq.cohort.name), None)
-            if cohort is not None:
-                if old is not None:
-                    cohort.members.discard(old)
-                cohort.members.add(fresh)
-                fresh.cohort = cohort
-                dirty_cohorts[cohort.name] = cohort
+                old = snap.cluster_queues.get(name)
+                fresh = _snapshot_cq(cq)
+                snap.cluster_queues[name] = fresh
+                cohort = old.cohort if old is not None else None
+                if cohort is None and cq.cohort is not None:
+                    cohort = next(
+                        (c.cohort for c in snap.cluster_queues.values()
+                         if c.cohort is not None
+                         and c.cohort.name == cq.cohort.name), None)
+                if cohort is not None:
+                    if old is not None:
+                        cohort.members.discard(old)
+                    cohort.members.add(fresh)
+                    fresh.cohort = cohort
+                    dirty_cohorts[cohort.name] = cohort
 
-        for cohort in dirty_cohorts.values():
-            cohort.requestable_resources = {}
-            cohort.usage = {}
-            cohort.allocatable_generation = 0
-            for member in cohort.members:
-                _accumulate(member, cohort)
-                cohort.allocatable_generation += member.allocatable_generation
-        REGISTRY.tick_phase_seconds.observe(
-            "snapshot.dirty", value=_time.perf_counter() - t_d)
+            for cohort in dirty_cohorts.values():
+                cohort.requestable_resources = {}
+                cohort.usage = {}
+                cohort.allocatable_generation = 0
+                for member in cohort.members:
+                    _accumulate(member, cohort)
+                    cohort.allocatable_generation += \
+                        member.allocatable_generation
+            dirty_span.set("reclones", reclones)
         if reclones:
             REGISTRY.tick_phase_seconds.observe(
                 "snapshot.reclones", value=float(reclones))
@@ -359,16 +361,12 @@ class SnapshotMirror:
         scale this loop folds ~2k completion/admission mutations per tick."""
         if self._snap is None or not self._pending:
             return
-        t0 = _time.perf_counter()
-        pending, self._pending = self._pending, []
-        self.mutation_count += len(pending)
-        snap_cqs = self._snap.cluster_queues
-        base = self._base
-        try:
+        with TRACER.phase("snapshot.flush"):
+            pending, self._pending = self._pending, []
+            self.mutation_count += len(pending)
+            snap_cqs = self._snap.cluster_queues
+            base = self._base
             self._flush_items(pending, snap_cqs, base)
-        finally:
-            REGISTRY.tick_phase_seconds.observe(
-                "snapshot.flush", value=_time.perf_counter() - t0)
 
     def _flush_items(self, pending, snap_cqs, base) -> None:
         if (_ledger is not None

@@ -9,9 +9,12 @@ analog of `kubectl get events`.
 
 from __future__ import annotations
 
+import threading
 from collections import deque
 from dataclasses import dataclass
 from typing import Deque, List, Optional
+
+from kueue_tpu.metrics import REGISTRY
 
 NORMAL = "Normal"
 WARNING = "Warning"
@@ -42,12 +45,31 @@ class EventRecorder:
     hot path, while reads are rare debugging/API traffic."""
 
     def __init__(self, capacity: int = 10_000):
+        self.capacity = capacity
+        self.dropped = 0
         self._events: Deque[tuple] = deque(maxlen=capacity)
+        # The bare deque.append was GIL-atomic; the occupancy check +
+        # dropped increment below is check-then-act, and emitters span
+        # the tick thread AND API-server handler threads (finish/delete
+        # endpoints), so the drop accounting needs its own lock.
+        self._lock = threading.Lock()
 
     def event(self, object_key: str, etype: str, reason: str,
               message: str, now: float = 0.0) -> None:
         # Messages are truncated like util/api's event-message cap.
-        self._events.append((etype, reason, message[:1024], object_key, now))
+        with self._lock:
+            if len(self._events) >= self.capacity:
+                # deque(maxlen) evicts silently; count the loss so
+                # capacity sizing is observable
+                # (kueue_events_dropped_total).
+                self.dropped += 1
+                REGISTRY.events_dropped_total.inc()
+            self._events.append(
+                (etype, reason, message[:1024], object_key, now))
+
+    @property
+    def occupancy(self) -> int:
+        return len(self._events)
 
     def for_object(self, object_key: str,
                    reason: Optional[str] = None) -> List[Event]:

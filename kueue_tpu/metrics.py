@@ -199,6 +199,12 @@ class Registry:
             p + "cluster_queue_lending_limit",
             "Resource lending limit per CQ and flavor",
             ("cohort", "cluster_queue", "flavor", "resource"))
+        # Bounded-recorder overflow: events evicted from the EventRecorder
+        # ring before anyone read them (capacity-sizing signal — a nonzero
+        # rate means the debugging surface is silently losing history).
+        self.events_dropped_total = Counter(
+            p + "events_dropped_total",
+            "Events dropped by the bounded recorder")
         # TPU-build additions: per-tick phase timings.
         self.tick_phase_seconds = Histogram(
             p + "tick_phase_seconds",

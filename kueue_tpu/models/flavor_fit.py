@@ -850,50 +850,55 @@ class BatchSolver:
         (admission cycle of the previous tick, preemption search);
         `collect` fetches and decodes. This is the production pipelining
         path — dispatch tick i+1 while tick i is completed host-side."""
-        import time as _t
+        from kueue_tpu.tracing import TRACER, trace_now
 
-        from kueue_tpu.metrics import REGISTRY
-
-        phases = REGISTRY.tick_phase_seconds
-        t0 = _t.perf_counter()
-        enc = self._encoding_for(snapshot)
-        usage = self._usage_enc.refresh(snapshot)
-        ta = _t.perf_counter()
-        wt = sch.encode_workloads(workloads, snapshot, enc,
-                                  row_cache=self._row_cache,
-                                  min_podsets=self._p_floor)
-        self._p_floor = max(self._p_floor, wt.req.shape[1])
-        tb = _t.perf_counter()
-        if self._mesh is not None:
-            # Multi-chip: the sharded program runs to completion here
-            # (its collectives ride ICI, not the host link, so there is
-            # no tunnel round trip to hide; the workload batch is
-            # data-parallel over the mesh).
-            from kueue_tpu.parallel.mesh import sharded_flavor_fit
-            out = sharded_flavor_fit(enc, usage, wt, self._mesh)
-            handle = None
-        else:
-            out = None
-            handle = solve_flavor_fit_async(enc, usage, wt,
-                                            static=self._static)
-            W, P, R = wt.req.shape
-            C, F = enc.nominal.shape[0], enc.nominal.shape[1]
-            key = (W, P, R, wt.resume_slot.shape[2], enc.num_cohorts,
-                   enc.num_slots,
-                   features.enabled(features.FLAVOR_FUNGIBILITY), C, F)
-            with self._warm_lock:
-                if key not in self._warm_keys:
-                    self.cold_dispatches += 1
-                    self._warm_keys.add(key)
-            self._maybe_prewarm(key, wt.num_real)
-        t1 = _t.perf_counter()
-        phases.observe("tensorize", value=t1 - t0)
-        phases.observe("tensorize.refresh", value=ta - t0)
-        phases.observe("tensorize.encode", value=tb - ta)
-        phases.observe("tensorize.dispatch", value=t1 - tb)
+        with TRACER.phase("tensorize") as sp:
+            with TRACER.phase("tensorize.refresh"):
+                enc = self._encoding_for(snapshot)
+                usage = self._usage_enc.refresh(snapshot)
+            with TRACER.phase("tensorize.encode"):
+                wt = sch.encode_workloads(workloads, snapshot, enc,
+                                          row_cache=self._row_cache,
+                                          min_podsets=self._p_floor)
+                self._p_floor = max(self._p_floor, wt.req.shape[1])
+            cold = False
+            with TRACER.phase("tensorize.dispatch"):
+                if self._mesh is not None:
+                    # Multi-chip: the sharded program runs to completion
+                    # here (its collectives ride ICI, not the host link,
+                    # so there is no tunnel round trip to hide; the
+                    # workload batch is data-parallel over the mesh).
+                    from kueue_tpu.parallel.mesh import sharded_flavor_fit
+                    out = sharded_flavor_fit(enc, usage, wt, self._mesh)
+                    handle = None
+                else:
+                    out = None
+                    handle = solve_flavor_fit_async(enc, usage, wt,
+                                                    static=self._static)
+                    W, P, R = wt.req.shape
+                    C, F = enc.nominal.shape[0], enc.nominal.shape[1]
+                    key = (W, P, R, wt.resume_slot.shape[2],
+                           enc.num_cohorts, enc.num_slots,
+                           features.enabled(features.FLAVOR_FUNGIBILITY),
+                           C, F)
+                    with self._warm_lock:
+                        if key not in self._warm_keys:
+                            cold = True
+                            self.cold_dispatches += 1
+                            self._warm_keys.add(key)
+                    self._maybe_prewarm(key, wt.num_real)
+            # Span attributes name the one-compile-per-bucket evidence:
+            # an operator reading a slow tick sees WHICH padded shape
+            # dispatched and whether it compiled in-tick.
+            sp.set("engine", "sharded-mesh" if self._mesh is not None
+                   else "batch-packed-xla")
+            sp.set("bucket", list(wt.req.shape))
+            sp.set("heads", wt.num_real)
+            sp.set("cold", cold)
+            sp.set("cold_dispatches", self.cold_dispatches)
         return {"workloads": list(workloads), "snapshot": snapshot,
                 "enc": enc, "wt": wt, "handle": handle, "out": out,
-                "dispatched": t1}
+                "dispatched": trace_now()}
 
     # -- bucket prewarm (compile-proof ticks) -------------------------------
 
@@ -944,19 +949,24 @@ class BatchSolver:
         all-zeros buffer — compilation depends only on shapes/dtypes).
         A failed compile does NOT mark the shape warm — the real dispatch
         would compile in-tick, and cold_dispatches must say so."""
-        try:
-            W, P, R, G, K, S, fung = nkey[:7]
-            static = self._static
-            C, F = static[0].shape[0], static[0].shape[1]
-            nb = ((C * F * R + W * P * R) * 8 + (W + W * P * G) * 4
-                  + W * P * R + 2 * W * P + W * P * G * S)
-            out = _solve_kernel_packed(
-                *static, jnp.zeros(nb, dtype=jnp.uint8),
-                num_slots=S, shapes=(W, P, R, G, K),
-                fungibility_enabled=fung)
-            jax.block_until_ready(out)
-        except Exception:
-            return
+        from kueue_tpu.tracing import TRACER
+
+        with TRACER.span("solver.prewarm_compile") as sp:
+            sp.set("bucket", list(nkey[:3]))
+            try:
+                W, P, R, G, K, S, fung = nkey[:7]
+                static = self._static
+                C, F = static[0].shape[0], static[0].shape[1]
+                nb = ((C * F * R + W * P * R) * 8 + (W + W * P * G) * 4
+                      + W * P * R + 2 * W * P + W * P * G * S)
+                out = _solve_kernel_packed(
+                    *static, jnp.zeros(nb, dtype=jnp.uint8),
+                    num_slots=S, shapes=(W, P, R, G, K),
+                    fungibility_enabled=fung)
+                jax.block_until_ready(out)
+            except Exception:
+                sp.set("failed", True)
+                return
         with self._warm_lock:
             self._warm_keys.add(nkey)
 
@@ -987,19 +997,15 @@ class BatchSolver:
 
     def collect(self, inflight: dict) -> List[Assignment]:
         """Fetch + decode a solve dispatched by solve_async."""
-        import time as _t
+        from kueue_tpu.tracing import TRACER
 
-        from kueue_tpu.metrics import REGISTRY
-
-        phases = REGISTRY.tick_phase_seconds
-        t1 = _t.perf_counter()
-        out = inflight["out"] if inflight.get("out") is not None \
-            else fetch_outputs(inflight["handle"])
-        t2 = _t.perf_counter()
-        phases.observe("device_solve", value=t2 - t1)
-        assignments = decode_assignments(
-            inflight["workloads"], inflight["snapshot"], inflight["enc"], out)
-        phases.observe("decode", value=_t.perf_counter() - t2)
+        with TRACER.phase("device_solve"):
+            out = inflight["out"] if inflight.get("out") is not None \
+                else fetch_outputs(inflight["handle"])
+        with TRACER.phase("decode"):
+            assignments = decode_assignments(
+                inflight["workloads"], inflight["snapshot"],
+                inflight["enc"], out)
         return assignments
 
     def solve(self, workloads: Sequence[WorkloadInfo],

@@ -25,6 +25,7 @@ from kueue_tpu.api.types import (
     Workload,
 )
 from kueue_tpu.core.workload import WorkloadInfo, WorkloadOrdering
+from kueue_tpu.tracing import TRACER
 from kueue_tpu.utils.heap import KeyedHeap
 
 
@@ -318,7 +319,11 @@ class Manager:
         the scheduler's post-cycle sweep returns a few hundred losers per
         tick at scale."""
         added = 0
-        with self._cond:
+        # tracer.lock: when tracing is enabled the queue lock's
+        # acquisition wait becomes a span (contention with API-server
+        # mutators is otherwise invisible inside the requeue phase);
+        # disabled it IS the plain `with self._cond:`.
+        with TRACER.lock(self._cond, "queue.lock_wait.requeue"):
             cqs = self.cluster_queues
             for wi, reason in items:
                 wl = wi.obj
@@ -402,7 +407,7 @@ class Manager:
         """Block until at least one CQ has a head, then pop one head per CQ
         (manager.go:470-508)."""
         deadline = None if timeout is None else self._clock() + timeout
-        with self._cond:
+        with TRACER.lock(self._cond, "queue.lock_wait.heads"):
             while not self._stopped:
                 out = self._heads_locked()
                 if out:

@@ -26,6 +26,7 @@ from kueue_tpu.api.types import (
     Workload,
 )
 from kueue_tpu.metrics import REGISTRY
+from kueue_tpu.tracing import TRACER, ExplainStore, build_record
 from kueue_tpu.core.cache import (
     Cache,
     CachedClusterQueue,
@@ -134,6 +135,10 @@ class Scheduler:
         self.preemption_engine = preemption_engine
         self.clock = clock
         self.metrics = SchedulerMetrics()
+        # Admission explainability: one compact decision record per
+        # scheduling attempt per workload, bounded (tracing/explain.py),
+        # surfaced via the visibility API (?explain=true) and the Dumper.
+        self.explain = ExplainStore()
         # Incremental tick snapshot: re-clones only ClusterQueues whose
         # usage moved outside the scheduler's own assume/forget lockstep
         # (replaces the reference's per-tick deep copy, snapshot.go:95-129).
@@ -200,11 +205,8 @@ class Scheduler:
         if not heads:
             return None
         start = self.clock()
-        phases = REGISTRY.tick_phase_seconds
-        t0 = _time.perf_counter()
-        snapshot = self._mirror.refresh()
-        t1 = _time.perf_counter()
-        phases.observe("snapshot", value=t1 - t0)
+        with TRACER.phase("snapshot"):
+            snapshot = self._mirror.refresh()
         entries, solvable = self._prep_entries(heads, snapshot)
         handle = None
         if self.batch_solver is not None and solvable:
@@ -223,28 +225,46 @@ class Scheduler:
         self._mirror.flush_pending()
         stale = self._mirror.mutation_count != tick.dispatched_at
         snapshot = tick.snapshot
-        t1 = _time.perf_counter()
-        phases = REGISTRY.tick_phase_seconds
-        self._resolve(tick)
-        ts = _time.perf_counter()
         entries = tick.entries
-        self._sort_entries(entries)
-        t2 = _time.perf_counter()
-        phases.observe("nominate", value=t2 - t1)
-        phases.observe("nominate.sort", value=t2 - ts)
-        admitted = self._admission_cycle(entries, snapshot,
-                                         revalidate=stale)
-        t3 = _time.perf_counter()
-        phases.observe("admit", value=t3 - t2)
-        self._requeue_sweep([e for e in entries if e.status != ASSUMED])
-        phases.observe("requeue", value=_time.perf_counter() - t3)
+        with TRACER.phase("nominate"):
+            self._resolve(tick)
+            with TRACER.phase("nominate.sort"):
+                self._sort_entries(entries)
+        with TRACER.phase("admit") as sp:
+            admitted = self._admission_cycle(entries, snapshot,
+                                             revalidate=stale)
+            sp.set("admitted", admitted)
+            sp.set("entries", len(entries))
+        with TRACER.phase("requeue"):
+            self._requeue_sweep([e for e in entries if e.status != ASSUMED])
         self.metrics.admission_attempts += 1
         self.metrics.last_tick_seconds = self.clock() - tick.start
+        self._record_decisions(entries)
         result = "success" if admitted else "inadmissible"
         REGISTRY.admission_attempts_total.inc(result)
         REGISTRY.admission_attempt_duration_seconds.observe(
             result, value=self.metrics.last_tick_seconds)
         return admitted
+
+    def _record_decisions(self, entries: List[Entry]) -> None:
+        """Append this attempt's decision record per workload (admission
+        explainability). Runs after the requeue sweep so each record
+        carries the final outcome + Pending message of the attempt."""
+        from kueue_tpu.tracing import explain as explain_mod
+
+        explain = self.explain
+        seq = self.metrics.admission_attempts
+        now = self.clock()
+        for e in entries:
+            if e.status == ASSUMED:
+                outcome = explain_mod.ADMITTED
+            elif e.status == SKIPPED:
+                outcome = explain_mod.SKIPPED
+            elif e.preemption_targets:
+                outcome = explain_mod.PREEMPTING
+            else:
+                outcome = explain_mod.INADMISSIBLE
+            explain.record(e.info.key, build_record(e, seq, now, outcome))
 
     # -- nomination (scheduler.go:317-351) ----------------------------------
 
@@ -663,24 +683,22 @@ class Scheduler:
         # in-doubt FIT entries against the solver's lockstep usage tensor
         # (falls back to the per-entry referee walk when unavailable).
         if revalidate and self.batch_solver is not None:
-            t_rv = _time.perf_counter()
-            fit_entries = [
-                e for e in entries
-                if e.assignment is not None
-                and e.assignment.representative_mode == FIT]
-            if fit_entries:
-                reval = getattr(self.batch_solver, "revalidate_fits", None)
-                # Build the tree state once; the revalidation uses it
-                # fold-free and the admission loop below reuses it.
-                mask = reval([(e.info.cluster_queue, e.assignment)
-                              for e in fit_entries], snapshot=snapshot,
-                             hier_state=ensure_hier_state()) \
-                    if reval is not None else None
-                if mask is not None:
-                    for e, ok in zip(fit_entries, mask):
-                        e.reval_ok = bool(ok)
-            REGISTRY.tick_phase_seconds.observe(
-                "admit.reval", value=_time.perf_counter() - t_rv)
+            with TRACER.phase("admit.reval"):
+                fit_entries = [
+                    e for e in entries
+                    if e.assignment is not None
+                    and e.assignment.representative_mode == FIT]
+                if fit_entries:
+                    reval = getattr(self.batch_solver, "revalidate_fits", None)
+                    # Build the tree state once; the revalidation uses it
+                    # fold-free and the admission loop below reuses it.
+                    mask = reval([(e.info.cluster_queue, e.assignment)
+                                  for e in fit_entries], snapshot=snapshot,
+                                 hier_state=ensure_hier_state()) \
+                        if reval is not None else None
+                    if mask is not None:
+                        for e, ok in zip(fit_entries, mask):
+                            e.reval_ok = bool(ok)
         for e in entries:
             if e.assignment is None:
                 continue
@@ -880,10 +898,8 @@ class Scheduler:
                         topo_assignments=topo_assignments)
             if cq.cohort is not None:
                 cycle_cohorts_skip_preemption.add(cq.cohort.root_name)
-        t_flush = _time.perf_counter()
-        admitted = self._flush_assumes(pending_assumes, snapshot)
-        REGISTRY.tick_phase_seconds.observe(
-            "admit.flush", value=_time.perf_counter() - t_flush)
+        with TRACER.phase("admit.flush"):
+            admitted = self._flush_assumes(pending_assumes, snapshot)
         for e, cq in preempting:
             self._issue_preemptions(e, cq)
         return admitted
@@ -1029,7 +1045,6 @@ class Scheduler:
         Returns how many actually assumed."""
         if not pending:
             return 0
-        t_a = _time.perf_counter()
         # Pass the entry's own info when the flattened triples exist — in
         # exactly that case (no reclaim scaling, spec counts) the admission
         # usage equals the spec-based totals the info already memoized, so
@@ -1045,9 +1060,8 @@ class Scheduler:
                 items.append((e.info.obj, triples, None, admitted_now))
             else:
                 items.append((e.info.obj, triples, e.info, admitted_now))
-        results = self.cache.assume_workloads(items, fast=all_fast)
-        REGISTRY.tick_phase_seconds.observe(
-            "admit.flush.assume", value=_time.perf_counter() - t_a)
+        with TRACER.phase("admit.flush.assume"):
+            results = self.cache.assume_workloads(items, fast=all_fast)
         now = self.clock()
         note_items = []
         note_bulk = getattr(self.batch_solver, "note_admissions", None)

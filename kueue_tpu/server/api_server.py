@@ -50,6 +50,7 @@ from kueue_tpu.controllers.store import (
 )
 from kueue_tpu.controllers.multikueue import PREBUILT_WORKLOAD_LABEL
 from kueue_tpu.metrics import REGISTRY
+from kueue_tpu.tracing import TRACER
 from kueue_tpu.webhooks import ValidationError
 
 GROUP_PREFIX = "/apis/kueue.x-k8s.io/v1beta1"
@@ -163,6 +164,15 @@ class _Handler(BaseHTTPRequestHandler):
                 # O(workloads) gauge walk.
                 self._send_text(REGISTRY.export_text(),
                                 content_type="text/plain; version=0.0.4")
+            elif path == "/debug/traces":
+                # Chrome-trace export of the tracer's retained ticks
+                # (ring + always-kept slowest set) — save the body to a
+                # file and load it in Perfetto / chrome://tracing. Reads
+                # the tracer's own lock only, never the runtime lock: a
+                # trace pull must not stall the scheduler. `?slowest=true`
+                # returns just the slowest retained tick.
+                slowest = (params.get("slowest") or ["false"])[0] == "true"
+                self._send_json(TRACER.export_chrome(slowest_only=slowest))
             elif path.startswith(VISIBILITY_PREFIX):
                 self._get_visibility(path, params)
             elif path.startswith(BATCH_PREFIX):
@@ -225,6 +235,10 @@ class _Handler(BaseHTTPRequestHandler):
         rest = [p for p in path[len(VISIBILITY_PREFIX):].split("/") if p]
         limit = int((params.get("limit") or [1000])[0])
         offset = int((params.get("offset") or [0])[0])
+        # Admission explainability: ?explain=true attaches each pending
+        # workload's recorded scheduling attempts (flavors tried with
+        # verdicts, topology placement, final reason) to the listing.
+        explain = (params.get("explain") or ["false"])[0] == "true"
         vis = self.api.visibility
         if vis is None:
             self._error(503, "visibility not enabled")
@@ -233,22 +247,29 @@ class _Handler(BaseHTTPRequestHandler):
                 and rest[2] == "pendingworkloads":
             with self.api.runtime_lock:  # heap snapshot races ticks
                 infos = vis.pending_workloads_in_cq(rest[1], offset=offset,
-                                                    limit=limit)
+                                                    limit=limit,
+                                                    explain=explain)
         elif len(rest) == 5 and rest[0] == "namespaces" \
                 and rest[2] == "localqueues" and rest[4] == "pendingworkloads":
             with self.api.runtime_lock:
                 infos = vis.pending_workloads_in_lq(rest[1], rest[3],
-                                                    offset=offset, limit=limit)
+                                                    offset=offset,
+                                                    limit=limit,
+                                                    explain=explain)
         else:
             self._error(404, f"unknown visibility path {path}")
             return
-        self._send_json({"kind": "PendingWorkloadsSummary", "items": [
-            {"name": i.name, "namespace": i.namespace,
-             "localQueueName": i.local_queue,
-             "priority": i.priority,
-             "positionInClusterQueue": i.position_in_cluster_queue,
-             "positionInLocalQueue": i.position_in_local_queue}
-            for i in infos]})
+        items = []
+        for i in infos:
+            item = {"name": i.name, "namespace": i.namespace,
+                    "localQueueName": i.local_queue,
+                    "priority": i.priority,
+                    "positionInClusterQueue": i.position_in_cluster_queue,
+                    "positionInLocalQueue": i.position_in_local_queue}
+            if i.decisions is not None:
+                item["decisions"] = i.decisions
+            items.append(item)
+        self._send_json({"kind": "PendingWorkloadsSummary", "items": items})
 
     def _get_job(self, path: str) -> None:
         rest = [p for p in path[len(BATCH_PREFIX):].split("/") if p]

@@ -1,0 +1,44 @@
+"""kueue_tpu.tracing: span-based tick tracing + admission explainability.
+
+One process-wide tracer (`TRACER`, the metrics-REGISTRY idiom) feeds
+three consumers from the same measurements: the
+`kueue_tick_phase_seconds` histogram, bench.py's `phase_means_ms`, and
+the Chrome-trace export served at `GET /debug/traces` / written by
+`--trace-out`. Disabled (the default) it compiles down to the plain
+histogram observations the pipeline always made — zero ring-buffer
+writes, byte-identical scheduling decisions (pinned by goldens).
+
+Enable with `KUEUE_TPU_TRACE=1`, the `--trace-out` CLI flag, or
+`TRACER.configure(enabled=True)`.
+"""
+
+from __future__ import annotations
+
+import os
+
+from kueue_tpu.tracing.tracer import (
+    NULL_SPAN,
+    TickTrace,
+    Tracer,
+    trace_now,
+    validate_chrome_trace,
+)
+
+# Defined BEFORE the explain import below: explain reaches into
+# solver/core modules whose import chain circles back to
+# `from kueue_tpu.tracing import TRACER` — by then this name must exist
+# on the partially initialized package.
+TRACER = Tracer(enabled=os.environ.get("KUEUE_TPU_TRACE") == "1")
+
+from kueue_tpu.tracing.explain import ExplainStore, build_record  # noqa: E402
+
+__all__ = [
+    "ExplainStore",
+    "NULL_SPAN",
+    "TRACER",
+    "TickTrace",
+    "Tracer",
+    "build_record",
+    "trace_now",
+    "validate_chrome_trace",
+]

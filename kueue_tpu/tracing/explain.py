@@ -1,0 +1,143 @@
+"""Per-workload admission explainability.
+
+Schedulers in the literature keep finding that per-decision records are
+what make policy bugs and stragglers debuggable at scale (Gavel,
+arxiv 2008.09213; topology-aware preemption for co-located LLM
+workloads, arxiv 2411.11560). The reference surfaces only the final
+Pending-condition message; this module retains the *story*: for every
+scheduling attempt of every workload, which flavors were tried, the
+fit/borrow/preempt verdict per (podSet, resource, flavor), the topology
+domain chosen (or the level it blocked at), and the final outcome.
+
+Records are stored as flat tuples on the hot path (the scheduler appends
+one per entry per tick — the EventRecorder discipline) and materialized
+into JSON-shaped dicts only on read, through the visibility API
+(`?explain=true`) and the state Dumper.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict, deque
+from typing import Dict, List, Optional
+
+from kueue_tpu.solver.modes import MODE_NAMES
+
+# Final outcomes of one scheduling attempt.
+ADMITTED = "Admitted"          # quota assumed this cycle
+PREEMPTING = "Preempting"      # victims evicted; requeued pending quota
+SKIPPED = "Skipped"            # lost an in-cycle race (cohort/topology/stale)
+INADMISSIBLE = "Inadmissible"  # no nomination (quota/validation/namespace)
+
+
+class ExplainStore:
+    """Bounded per-workload decision-record retention.
+
+    `per_workload` attempts are kept per workload key (newest win), at
+    most `max_workloads` keys total with LRU eviction — memory stays
+    O(max_workloads * per_workload) regardless of churn."""
+
+    def __init__(self, per_workload: int = 8, max_workloads: int = 10_000):
+        self.per_workload = per_workload
+        self.max_workloads = max_workloads
+        self._lock = threading.Lock()
+        self._records: "OrderedDict[str, deque]" = OrderedDict()
+
+    def record(self, key: str, rec: tuple) -> None:
+        with self._lock:
+            dq = self._records.get(key)
+            if dq is None:
+                dq = self._records[key] = deque(maxlen=self.per_workload)
+                if len(self._records) > self.max_workloads:
+                    self._records.popitem(last=False)
+            else:
+                self._records.move_to_end(key)
+            dq.append(rec)
+
+    def forget(self, key: str) -> None:
+        with self._lock:
+            self._records.pop(key, None)
+
+    def for_workload(self, key: str) -> List[dict]:
+        """Materialized decision records, oldest attempt first."""
+        with self._lock:
+            dq = self._records.get(key)
+            recs = list(dq) if dq is not None else []
+        return [_materialize(r) for r in recs]
+
+    def last_decision(self, key: str) -> Optional[dict]:
+        with self._lock:
+            dq = self._records.get(key)
+            rec = dq[-1] if dq else None
+        return _materialize(rec) if rec is not None else None
+
+    @property
+    def occupancy(self) -> int:
+        with self._lock:
+            return len(self._records)
+
+    def snapshot(self, limit: int = 1000) -> Dict[str, dict]:
+        """{workload key: last decision} for the Dumper (bounded)."""
+        with self._lock:
+            items = [(k, dq[-1]) for k, dq in self._records.items() if dq]
+        return {k: _materialize(r) for k, r in items[-limit:]}
+
+
+def build_record(entry, tick_seq: int, now: float, outcome: str) -> tuple:
+    """Compact decision tuple for a finished scheduler Entry; `outcome`
+    is one of the module constants (the scheduler maps its own entry
+    statuses — this module never imports it back, keeping the
+    tracing→scheduler edge one-directional).
+
+    Layout: (tick, time, cluster_queue, outcome, reason, flavors,
+             topology, preempted) where `flavors` is a tuple of
+    (pod_set, resource, flavor, verdict, borrow) and `topology` a tuple
+    of (pod_set, flavor, level, domain, ok) — or None each."""
+    a = entry.assignment
+    flavors: tuple = ()
+    topology = None
+    if a is not None:
+        tried = []
+        for ps in a.pod_sets:
+            for resource, fa in ps.flavors.items():
+                tried.append((ps.name, resource, fa.name,
+                              MODE_NAMES.get(fa.mode, str(fa.mode)),
+                              fa.borrow))
+        flavors = tuple(tried)
+        cands = getattr(a, "topology", None)
+        if cands:
+            topo = []
+            for p, cand in enumerate(cands):
+                if cand is None:
+                    continue
+                ps_name = a.pod_sets[p].name if p < len(a.pod_sets) else ""
+                topo.append((ps_name, cand.flavor, cand.level, cand.domain,
+                             cand.ok_now))
+            topology = tuple(topo) or None
+    preempted = len(entry.preemption_targets) \
+        if entry.preemption_targets else 0
+    return (tick_seq, now, entry.info.cluster_queue, outcome,
+            entry.inadmissible_msg, flavors, topology, preempted)
+
+
+def _materialize(rec: tuple) -> dict:
+    tick, now, cq, outcome, reason, flavors, topology, preempted = rec
+    out = {
+        "tick": tick,
+        "time": now,
+        "clusterQueue": cq,
+        "outcome": outcome,
+        "reason": reason,
+        "flavors": [
+            {"podSet": ps, "resource": r, "flavor": f, "verdict": v,
+             "borrow": b}
+            for ps, r, f, v, b in flavors],
+    }
+    if topology is not None:
+        out["topology"] = [
+            {"podSet": ps, "flavor": f, "level": lvl, "domain": dom,
+             "fits": ok}
+            for ps, f, lvl, dom, ok in topology]
+    if preempted:
+        out["preemptionTargets"] = preempted
+    return out

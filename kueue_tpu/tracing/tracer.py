@@ -1,0 +1,422 @@
+"""Span-based tick tracer (the operator-facing half of SURVEY §5).
+
+The reference exposes whole-tick latency histograms (metrics.go:70-79);
+this build's `kueue_tick_phase_seconds` histogram already splits the tick
+into host phases — but a histogram cannot show *one slow tick*, where
+lock-wait or fsync time hides inside a phase, or which bucket shape a
+dispatch compiled against. This module adds that lens: an OTel-shaped,
+dependency-free span tracer threaded through the tick pipeline
+(scheduler phases, solver dispatch/collect, snapshot maintenance,
+queue-manager lock waits, durable-journal fsyncs), exported in the
+Chrome trace-event JSON format, loadable in Perfetto / chrome://tracing.
+
+Design constraints, in order:
+
+  * DISABLED COSTS NOTHING. The default tracer is off; `span()` then
+    returns a shared no-op singleton (zero allocations, zero ring-buffer
+    writes) and `lock(lk)` returns the lock itself. Scheduling decisions
+    are byte-identical either way — pinned by goldens.
+  * ONE TIMING SOURCE. `phase(name)` both feeds the
+    `kueue_tick_phase_seconds` histogram AND (when enabled) records a
+    span, so metrics, bench.py's `phase_means_ms`, and exported traces
+    all derive from the same measurement and can never drift apart.
+    Raw `time.perf_counter()` phase timing in the pipeline is now a lint
+    violation (kueuelint OBS01).
+  * BOUNDED MEMORY, SLOWEST RETAINED. Finished ticks land in a ring
+    buffer (tail sampling: the most recent `ring_size` ticks) plus a
+    small always-kept set of the `keep_slowest` slowest ticks ever seen
+    (head sampling) — the tick an operator wants to look at is the p99
+    outlier, which a plain ring would have evicted long before the
+    export request arrives.
+
+Thread-safety: span *finish* appends under one lock; span timing itself
+is lock-free. Spans finished while a tick is open attach to that tick
+(whatever thread they ran on — API-server threads' lock waits show up in
+the tick that stalled on them); spans outside any tick go to a bounded
+"loose" buffer exported alongside.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time as _time
+from collections import deque
+from typing import Dict, List, Optional
+
+from kueue_tpu.metrics import REGISTRY
+
+# The tracer IS the pipeline's sanctioned perf_counter consumer (OBS01
+# makes every other raw use in scheduler/solver/controllers an error).
+_perf = _time.perf_counter  # kueuelint: disable=OBS01
+
+
+def trace_now() -> float:
+    """The tracer's monotonic clock (perf_counter). Pipeline code that
+    needs a raw timestamp on the tracer's timebase (e.g. the solver's
+    dispatch anchor that bench latency injection replays against) takes
+    it from here, so kueuelint OBS01 can insist every other raw
+    perf_counter in the tick pipeline goes through a phase span."""
+    return _perf()
+
+
+class _NullSpan:
+    """Shared do-nothing span: the disabled tracer's only product."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def set(self, key, value) -> None:
+        pass
+
+
+NULL_SPAN = _NullSpan()
+
+
+class _Span:
+    """One timed region. Context-manager; `set()` attaches attributes."""
+
+    __slots__ = ("tracer", "name", "attrs", "t0", "t1", "tid")
+
+    def __init__(self, tracer: "Tracer", name: str):
+        self.tracer = tracer
+        self.name = name
+        self.attrs: Optional[Dict] = None
+
+    def set(self, key, value) -> None:
+        if self.attrs is None:
+            self.attrs = {}
+        self.attrs[key] = value
+
+    def __enter__(self):
+        self.tid = threading.get_ident()
+        self.t0 = _perf()
+        return self
+
+    def __exit__(self, *exc):
+        self.t1 = _perf()
+        self.tracer._record(self)
+        return False
+
+
+class _PhaseSpan(_Span):
+    """A span that is also a `kueue_tick_phase_seconds` observation."""
+
+    __slots__ = ()
+
+    def __exit__(self, *exc):
+        t1 = self.t1 = _perf()
+        REGISTRY.tick_phase_seconds.observe(self.name, value=t1 - self.t0)
+        self.tracer._record(self)
+        return False
+
+
+class _PhaseTimer:
+    """The disabled-tracer phase: histogram observation only (exactly the
+    pre-tracer timing code), no span record."""
+
+    __slots__ = ("name", "t0")
+
+    def __init__(self, name: str):
+        self.name = name
+
+    def set(self, key, value) -> None:
+        pass
+
+    def __enter__(self):
+        self.t0 = _perf()
+        return self
+
+    def __exit__(self, *exc):
+        REGISTRY.tick_phase_seconds.observe(self.name, value=_perf() - self.t0)
+        return False
+
+
+class _LockSpan:
+    """Times the *acquisition wait* of a lock/condition, then holds it for
+    the with-block (release on exit). Only built when tracing is enabled —
+    the disabled path hands back the lock object itself."""
+
+    __slots__ = ("tracer", "name", "lk")
+
+    def __init__(self, tracer: "Tracer", lk, name: str):
+        self.tracer = tracer
+        self.lk = lk
+        self.name = name
+
+    def __enter__(self):
+        sp = _Span(self.tracer, self.name)
+        sp.tid = threading.get_ident()
+        sp.t0 = _perf()
+        self.lk.acquire()
+        sp.t1 = _perf()
+        self.tracer._record(sp)
+        return self.lk
+
+    def __exit__(self, *exc):
+        self.lk.release()
+        return False
+
+
+class TickTrace:
+    """One finished tick: its own span plus every span that closed while
+    it was open (any thread)."""
+
+    __slots__ = ("seq", "label", "t0", "duration", "wall", "spans")
+
+    def __init__(self, seq: int, label: str, t0: float, duration: float,
+                 wall: float, spans: List[_Span]):
+        self.seq = seq
+        self.label = label
+        self.t0 = t0
+        self.duration = duration
+        self.wall = wall
+        self.spans = spans
+
+
+class _TickCtx:
+    __slots__ = ("tracer", "span")
+
+    def __init__(self, tracer: "Tracer", label: str):
+        self.tracer = tracer
+        self.span = _Span(tracer, label)
+
+    def __enter__(self):
+        self.tracer._tick_open(self.span.name)
+        self.span.__enter__()
+        return self.span
+
+    def __exit__(self, *exc):
+        self.span.__exit__(*exc)
+        self.tracer._tick_close(self.span)
+        return False
+
+
+class Tracer:
+    """Thread-safe span recorder with head+tail tick sampling."""
+
+    def __init__(self, enabled: bool = False, ring_size: int = 256,
+                 keep_slowest: int = 32, loose_size: int = 2048):
+        self.enabled = enabled
+        self.ring_size = ring_size
+        self.keep_slowest = keep_slowest
+        self._lock = threading.Lock()
+        self._epoch = _perf()
+        self._epoch_wall = _time.time()
+        self._seq = 0
+        self._recent: deque = deque(maxlen=ring_size)
+        # (duration, seq, TickTrace) kept sorted ascending; index 0 is the
+        # fastest of the retained-slowest set (the eviction candidate).
+        self._slowest: List[tuple] = []
+        self._loose: deque = deque(maxlen=loose_size)
+        self._tick_spans: Optional[List[_Span]] = None
+        self._tick_label = ""
+
+    # -- configuration ------------------------------------------------------
+
+    def configure(self, enabled: Optional[bool] = None,
+                  ring_size: Optional[int] = None,
+                  keep_slowest: Optional[int] = None) -> None:
+        with self._lock:
+            if enabled is not None:
+                self.enabled = enabled
+            if ring_size is not None:
+                self.ring_size = ring_size
+                self._recent = deque(self._recent, maxlen=ring_size)
+            if keep_slowest is not None:
+                self.keep_slowest = keep_slowest
+                # Sorted ascending by duration: trim from the fast end.
+                excess = len(self._slowest) - keep_slowest
+                if excess > 0:
+                    del self._slowest[:excess]
+
+    def reset(self) -> None:
+        """Drop every recorded tick/span (test isolation)."""
+        with self._lock:
+            self._recent.clear()
+            self._slowest.clear()
+            self._loose.clear()
+            self._tick_spans = None
+            self._seq = 0
+
+    # -- span construction --------------------------------------------------
+
+    def span(self, name: str):
+        """A plain timed region; no-op singleton when disabled."""
+        if not self.enabled:
+            return NULL_SPAN
+        return _Span(self, name)
+
+    def phase(self, name: str):
+        """A tick-phase region: always observes
+        `kueue_tick_phase_seconds{phase=name}` on exit; records a span too
+        when tracing is enabled. The single timing source for scheduler /
+        solver / snapshot phase code (kueuelint OBS01)."""
+        if not self.enabled:
+            return _PhaseTimer(name)
+        return _PhaseSpan(self, name)
+
+    def lock(self, lk, name: str):
+        """`with tracer.lock(self._cond, "queue.lock_wait"):` — times the
+        acquisition wait as a span. Disabled: returns the lock itself, so
+        the instrumented code path is byte-for-byte the plain `with lk:`."""
+        if not self.enabled:
+            return lk
+        return _LockSpan(self, lk, name)
+
+    def tick(self, label: str = "tick"):
+        """Open a tick grouping: spans finished while it is open attach to
+        it, and the finished tick enters the ring/slowest buffers."""
+        if not self.enabled:
+            return NULL_SPAN
+        return _TickCtx(self, label)
+
+    # -- recording ----------------------------------------------------------
+
+    def _record(self, span: _Span) -> None:
+        with self._lock:
+            sink = self._tick_spans
+            if sink is not None:
+                sink.append(span)
+            else:
+                self._loose.append(span)
+
+    def _tick_open(self, label: str) -> None:
+        with self._lock:
+            # Nested/concurrent tick opens collapse into the outer tick
+            # (only reachable through misuse; never lose spans over it).
+            if self._tick_spans is None:
+                self._tick_spans = []
+                self._tick_label = label
+
+    def _tick_close(self, span: _Span) -> None:
+        with self._lock:
+            spans = self._tick_spans
+            if spans is None:
+                return
+            self._tick_spans = None
+            self._seq += 1
+            rec = TickTrace(self._seq, span.name, span.t0,
+                            span.t1 - span.t0,
+                            self._epoch_wall + (span.t0 - self._epoch), spans)
+            self._recent.append(rec)
+            slowest = self._slowest
+            if len(slowest) < self.keep_slowest:
+                slowest.append((rec.duration, rec.seq, rec))
+                slowest.sort(key=lambda t: t[:2])
+            elif slowest and rec.duration > slowest[0][0]:
+                slowest[0] = (rec.duration, rec.seq, rec)
+                slowest.sort(key=lambda t: t[:2])
+
+    # -- introspection ------------------------------------------------------
+
+    def ticks(self) -> List[TickTrace]:
+        """Retained ticks, oldest first, slowest-set merged in (dedup by
+        sequence number)."""
+        with self._lock:
+            by_seq = {rec.seq: rec for _, _, rec in self._slowest}
+            for rec in self._recent:
+                by_seq[rec.seq] = rec
+            return [by_seq[s] for s in sorted(by_seq)]
+
+    def slowest_tick(self) -> Optional[TickTrace]:
+        with self._lock:
+            if not self._slowest:
+                return None
+            return self._slowest[-1][2]  # sorted ascending by duration
+
+    # -- export -------------------------------------------------------------
+
+    def _event(self, span: _Span) -> dict:
+        ev = {
+            "name": span.name,
+            "ph": "X",
+            "ts": round((span.t0 - self._epoch) * 1e6, 3),
+            "dur": round((span.t1 - span.t0) * 1e6, 3),
+            "pid": 1,
+            "tid": span.tid,
+            "cat": "kueue",
+        }
+        if span.attrs:
+            ev["args"] = dict(span.attrs)
+        return ev
+
+    def export_chrome(self, slowest_only: bool = False) -> dict:
+        """The Chrome trace-event JSON object format
+        (https://docs.google.com/document/d/1CvAClvFfyA5R-PhYUmn5OOQtYMH4h6I0nSsKchNAySU):
+        `{"traceEvents": [...]}` with complete ("X") events — Perfetto and
+        chrome://tracing nest same-tid events by time containment, so
+        parent/child needs no explicit links. `slowest_only` exports just
+        the single slowest retained tick (bench.py's artifact)."""
+        with self._lock:
+            loose = list(self._loose)
+        if slowest_only:
+            slow = self.slowest_tick()
+            ticks, loose = ([slow] if slow is not None else []), []
+        else:
+            ticks = self.ticks()
+        events = [{"ph": "M", "name": "process_name", "pid": 1, "ts": 0,
+                   "args": {"name": "kueue-tpu"}}]
+        for rec in ticks:
+            for span in rec.spans:
+                ev = self._event(span)
+                ev.setdefault("args", {})["tick"] = rec.seq
+                events.append(ev)
+        for span in loose:
+            events.append(self._event(span))
+        return {
+            "traceEvents": events,
+            "displayTimeUnit": "ms",
+            "otherData": {
+                "tracer": "kueue-tpu",
+                "enabled": self.enabled,
+                "ticks_retained": len(ticks),
+                "epoch_unix": self._epoch_wall,
+            },
+        }
+
+    def export_json(self, slowest_only: bool = False) -> str:
+        return json.dumps(self.export_chrome(slowest_only=slowest_only))
+
+
+def validate_chrome_trace(doc) -> List[str]:
+    """Schema check for the Chrome trace-event JSON object format; returns
+    problem strings (empty == valid, loads in Perfetto). Dependency-free
+    twin of a JSON-schema validation, used by tests and `make trace-smoke`."""
+    problems: List[str] = []
+    if not isinstance(doc, dict):
+        return ["top level must be a JSON object"]
+    events = doc.get("traceEvents")
+    if not isinstance(events, list):
+        return ["traceEvents must be a list"]
+    for i, ev in enumerate(events):
+        where = f"traceEvents[{i}]"
+        if not isinstance(ev, dict):
+            problems.append(f"{where}: not an object")
+            continue
+        ph = ev.get("ph")
+        if not isinstance(ev.get("name"), str) or not ev["name"]:
+            problems.append(f"{where}: missing/empty name")
+        if ph not in ("X", "B", "E", "M", "i", "C"):
+            problems.append(f"{where}: unknown phase {ph!r}")
+        if not isinstance(ev.get("pid"), int):
+            problems.append(f"{where}: pid must be an int")
+        if ph in ("X", "B", "E", "i", "C"):
+            if not isinstance(ev.get("tid"), int):
+                problems.append(f"{where}: tid must be an int")
+            ts = ev.get("ts")
+            if not isinstance(ts, (int, float)) or ts < 0:
+                problems.append(f"{where}: ts must be a non-negative number")
+        if ph == "X":
+            dur = ev.get("dur")
+            if not isinstance(dur, (int, float)) or dur < 0:
+                problems.append(f"{where}: dur must be a non-negative number")
+        args = ev.get("args")
+        if args is not None and not isinstance(args, dict):
+            problems.append(f"{where}: args must be an object")
+    return problems

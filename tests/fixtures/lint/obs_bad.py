@@ -1,0 +1,29 @@
+"""OBS01 fixtures: raw timing in tick-pipeline code bypassing the tracer."""
+
+import time
+import time as _time
+from time import perf_counter  # OBS01: direct function import
+
+from kueue_tpu.metrics import REGISTRY
+
+
+def schedule_phase(entries):
+    t0 = time.perf_counter()  # OBS01: raw perf_counter measurement
+    for e in entries:
+        e.solve()
+    REGISTRY.tick_phase_seconds.observe(
+        "nominate", value=time.perf_counter() - t0)  # OBS01
+
+
+def aliased_module_timer():
+    start = _time.monotonic()  # OBS01: aliased module, monotonic
+    return start
+
+
+def from_import_timer():
+    return perf_counter()
+
+
+def wall_clock_ok():
+    # time.time() is a wall-clock read, not a timing measurement.
+    return time.time()

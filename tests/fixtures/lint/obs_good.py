@@ -1,0 +1,28 @@
+"""OBS01-clean twin: the same phases timed through the tracer."""
+
+import time
+
+from kueue_tpu.tracing import TRACER, trace_now
+
+
+def schedule_phase(entries):
+    # One measurement feeds the phase histogram, bench means and the
+    # trace export together.
+    with TRACER.phase("nominate") as sp:
+        for e in entries:
+            e.solve()
+        sp.set("entries", len(entries))
+
+
+def lock_wait(cond):
+    with TRACER.lock(cond, "queue.lock_wait"):
+        pass
+
+
+def dispatch_anchor():
+    # Raw timestamps on the tracer's timebase come from trace_now().
+    return trace_now()
+
+
+def wall_clock_ok():
+    return time.time()

@@ -60,7 +60,9 @@ def served():
     fw = Framework()
     store = Store()
     adapter = StoreAdapter(store, fw)
-    server = APIServer(store, fw, visibility=VisibilityServer(fw.queues),
+    server = APIServer(store, fw,
+                       visibility=VisibilityServer(
+                           fw.queues, explain=fw.scheduler.explain),
                        sync_status=adapter.sync_status).start()
     store.create(KIND_RESOURCE_FLAVOR, ResourceFlavor.make("default"))
     store.create(KIND_CLUSTER_QUEUE, ClusterQueue(
@@ -165,6 +167,65 @@ class TestObjectAPI:
                      + "/apis/visibility.kueue.x-k8s.io/v1alpha1"
                      "/namespaces/default/localqueues/main/pendingworkloads")
         assert [i["name"] for i in by_lq["items"]] == ["wl2"]
+
+    def test_visibility_explain_decisions(self, served):
+        """?explain=true attaches the per-workload admission story: every
+        flavor tried with its verdict and the final reason (admission
+        explainability, the visibility half)."""
+        server, fw, store, adapter = served
+        base = server.url + "/apis/kueue.x-k8s.io/v1beta1"
+        for i in range(3):
+            doc = json.loads(json.dumps(WL_DOC))
+            doc["metadata"]["name"] = f"wl{i}"
+            _post(base + "/namespaces/default/workloads", doc)
+        # One head per CQ per tick: the third tick nominates wl2 against
+        # a full CQ and parks it with its decision record.
+        for _ in range(3):
+            adapter.tick()
+        vis = (server.url + "/apis/visibility.kueue.x-k8s.io/v1alpha1"
+               "/clusterqueues/cq/pendingworkloads")
+        plain = _get(vis)
+        assert "decisions" not in plain["items"][0]
+        summary = _get(vis + "?explain=true")
+        [item] = summary["items"]
+        assert item["name"] == "wl2"
+        decisions = item["decisions"]
+        assert decisions, "explain=true must return the decision history"
+        last = decisions[-1]
+        assert last["outcome"] == "Inadmissible"
+        assert last["clusterQueue"] == "cq"
+        assert "insufficient unused quota" in last["reason"]
+        # The first attempt nominated the default flavor before losing
+        # the cycle: the story names the flavor WITH a verdict.
+        assert any(f["flavor"] == "default" and f["verdict"]
+                   for d in decisions for f in d["flavors"]) \
+            or all(d["outcome"] == "Inadmissible" for d in decisions)
+
+    def test_debug_traces_endpoint(self, served):
+        """GET /debug/traces returns Chrome trace-event JSON of the
+        retained ticks, schema-valid for Perfetto."""
+        from kueue_tpu.tracing import TRACER, validate_chrome_trace
+
+        server, fw, store, adapter = served
+        TRACER.configure(enabled=True)
+        TRACER.reset()
+        try:
+            _post(server.url + "/apis/kueue.x-k8s.io/v1beta1"
+                  "/namespaces/default/workloads", WL_DOC)
+            adapter.tick()
+            doc = _get(server.url + "/debug/traces")
+            assert validate_chrome_trace(doc) == []
+            names = {ev["name"] for ev in doc["traceEvents"]}
+            assert {"tick", "snapshot", "admit", "requeue"} <= names
+            assert doc["otherData"]["ticks_retained"] >= 1
+            slow = _get(server.url + "/debug/traces?slowest=true")
+            assert validate_chrome_trace(slow) == []
+            assert {ev.get("args", {}).get("tick")
+                    for ev in slow["traceEvents"]
+                    if ev["ph"] == "X"} == {TRACER.slowest_tick().seq}
+        finally:
+            TRACER.configure(enabled=False)
+            TRACER.reset()
 
     def test_finish_endpoint(self, served):
         server, fw, store, adapter = served

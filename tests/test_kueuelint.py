@@ -169,6 +169,30 @@ def test_api_good_fixture():
     assert run_analysis([str(FIXTURES / "api_good.py")]) == []
 
 
+def test_obs_bad_fixture():
+    findings = run_analysis([str(FIXTURES / "obs_bad.py")])
+    obs = [f for f in findings if f.rule == "OBS01"]
+    assert len(obs) == 4  # from-import + 2x perf_counter + monotonic alias
+    joined = " ".join(f.message for f in obs)
+    assert "TRACER.phase" in joined
+    assert "from time import perf_counter" in joined
+    assert "_time.monotonic" in joined
+    # time.time() wall-clock reads never fire.
+    assert "time.time" not in joined
+
+
+def test_obs_good_fixture():
+    assert run_analysis([str(FIXTURES / "obs_good.py")]) == []
+
+
+def test_obs_rule_scoped_to_tick_pipeline(tmp_path):
+    # The same raw timing OUTSIDE the pipeline paths is none of OBS01's
+    # business (CLI glue, benchmarks, tests keep their perf_counters).
+    other = tmp_path / "cli_tool.py"
+    other.write_text("import time\nt0 = time.perf_counter()\n")
+    assert run_analysis([str(other)]) == []
+
+
 def test_roundtrip_fixture_pair():
     bad = run_analysis([str(FIXTURES / "roundtrip_bad")])
     assert _rules_of(bad) == {"API03"}
@@ -258,7 +282,7 @@ def test_unknown_select_id_is_a_usage_error():
 def test_rule_registry_covers_all_families():
     ids = {r.id for r in all_rules()}
     assert {"JIT01", "JIT02", "JIT03", "RET01", "RET02",
-            "LOCK01", "LOCK02", "API01", "API02", "API03"} <= ids
+            "LOCK01", "LOCK02", "API01", "API02", "API03", "OBS01"} <= ids
 
 
 def test_parse_error_is_reported(tmp_path):

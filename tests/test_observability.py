@@ -97,6 +97,84 @@ def test_gauges_pruned_on_cq_delete():
     assert ("cq", "active") not in REGISTRY.pending_workloads.values
 
 
+def test_fragmentation_gauge_pruned_on_flavor_delete():
+    """A deleted ResourceFlavor's topology_fragmentation and per-(cq,
+    flavor) series must stop exporting — stale series previously lived
+    until process exit (the flavor delete path never pruned)."""
+    from kueue_tpu.api.types import ResourceFlavor, TopologySpec
+
+    fw = Framework()
+    tpu = ResourceFlavor.make("tpu", topology=TopologySpec.uniform(
+        ("block", "rack"), (1, 2), leaf_capacity=2))
+    fw.create_resource_flavor(tpu)
+    fw.create_cluster_queue(make_cq("cq", rg("cpu", fq("tpu", cpu=4))))
+    fw.create_local_queue(make_lq("main", cq="cq"))
+    fw.submit(make_wl("w", cpu=1))
+    fw.run_until_settled()
+    fw.update_metrics_gauges()
+    assert ("tpu", "block") in REGISTRY.topology_fragmentation.values
+    assert REGISTRY.cluster_queue_resource_usage.get("cq", "tpu", "cpu") \
+        == 1000
+    fw.delete_resource_flavor("tpu")
+    assert ("tpu", "block") not in REGISTRY.topology_fragmentation.values
+    assert ("tpu", "rack") not in REGISTRY.topology_fragmentation.values
+    assert ("cq", "tpu", "cpu") \
+        not in REGISTRY.cluster_queue_resource_usage.values
+
+
+def test_flavor_delete_via_store_prunes(tmp_path):
+    """The StoreAdapter routes a ResourceFlavor DELETE into the prune path
+    (it previously ignored flavor deletions entirely)."""
+    from kueue_tpu.controllers.store import KIND_RESOURCE_FLAVOR, Store, \
+        StoreAdapter
+    from tests.util import make_flavor as mf
+
+    fw = Framework()
+    store = Store()
+    StoreAdapter(store, fw)
+    store.create(KIND_RESOURCE_FLAVOR, mf("default"))
+    assert "default" in fw.cache.resource_flavors
+    store.delete(KIND_RESOURCE_FLAVOR, "default")
+    assert "default" not in fw.cache.resource_flavors
+
+
+def test_quota_gauges_pruned_on_cq_delete_even_without_knob():
+    """Series set while metrics.enableClusterQueueResources was on must
+    die with their CQ even if the knob is off at delete time."""
+    REGISTRY.cluster_queue_borrowing_limit.set(
+        "co", "doomed-cq", "default", "cpu", value=1.0)
+    REGISTRY.cluster_queue_resource_reservation.set(
+        "co", "doomed-cq", "default", "cpu", value=2.0)
+    fw = small_framework()
+    fw.delete_cluster_queue("cq")
+    # Unrelated CQ series survive a delete of another CQ.
+    assert REGISTRY.cluster_queue_borrowing_limit.get(
+        "co", "doomed-cq", "default", "cpu") == 1.0
+    fw.create_cluster_queue(make_cq(
+        "doomed-cq", rg("cpu", fq("default", cpu=1)), cohort="co"))
+    fw.delete_cluster_queue("doomed-cq")
+    assert ("co", "doomed-cq", "default", "cpu") \
+        not in REGISTRY.cluster_queue_borrowing_limit.values
+    assert ("co", "doomed-cq", "default", "cpu") \
+        not in REGISTRY.cluster_queue_resource_reservation.values
+
+
+def test_event_recorder_counts_drops_and_reports_occupancy():
+    from kueue_tpu.events import EventRecorder
+
+    rec = EventRecorder(capacity=3)
+    before = REGISTRY.events_dropped_total.get()
+    for i in range(5):
+        rec.event(f"default/w{i}", "Normal", "QuotaReserved", "m", now=1.0)
+    assert rec.occupancy == 3
+    assert rec.dropped == 2
+    assert REGISTRY.events_dropped_total.get() - before == 2
+    # Dumper surfaces the recorder's occupancy/drop accounting.
+    fw = small_framework()
+    dump = Dumper(fw.cache, fw.queues, events=rec).dump()
+    assert dump["events"] == {"occupancy": 3, "capacity": 3, "dropped": 2}
+
+
 def test_eviction_metrics_all_reasons():
     from kueue_tpu.config import Configuration, WaitForPodsReady
     from tests.test_pods_ready import FakeClock
