@@ -43,9 +43,26 @@ bench:
 
 # Small-shape smoke variant for CI / laptops: tiny shapes, ~10 ticks per
 # config — fast enough for every CI run, so perf wiring (solver dispatch,
-# pipelining, the topology stage, churn) can't silently break.
+# pipelining, the topology stage, churn) can't silently break. The arena
+# gate re-reads the emitted BENCH lines: the incremental workload arena
+# must REUSE rows inside the measured window (ratio > 0.9) with zero
+# full rebuilds, or the from-scratch encode silently came back.
 bench-smoke:
-	KUEUE_BENCH_SMOKE=1 KUEUE_BENCH_TICKS=10 JAX_PLATFORMS=cpu $(PYTHON) bench.py
+	KUEUE_BENCH_SMOKE=1 KUEUE_BENCH_TICKS=10 JAX_PLATFORMS=cpu \
+	  $(PYTHON) bench.py > /tmp/kueue-bench-smoke.jsonl
+	@cat /tmp/kueue-bench-smoke.jsonl
+	$(PYTHON) -c "import json; \
+	  from bench import METRIC_NAMES; \
+	  lines = [json.loads(l) for l in open('/tmp/kueue-bench-smoke.jsonl') \
+	           if l.strip().startswith('{')]; \
+	  ratios = {l['metric']: l.get('arena_reuse_ratio') for l in lines}; \
+	  missing = set(METRIC_NAMES.values()) - set(ratios); \
+	  assert not missing, f'configs missing from BENCH output: {missing}'; \
+	  bad = {m: r for m, r in ratios.items() if r is None or r <= 0.9}; \
+	  assert not bad, f'arena_reuse_ratio <= 0.9: {bad}'; \
+	  rebuilds = {l['metric']: l.get('arena_full_rebuilds') for l in lines}; \
+	  assert not any(rebuilds.values()), f'full rebuilds in window: {rebuilds}'; \
+	  print('bench-smoke arena gate OK:', ratios)"
 
 # End-to-end tracing smoke: drive the real CLI with span tracing on,
 # then prove the exported file is valid Chrome trace-event JSON (the

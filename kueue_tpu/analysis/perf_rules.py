@@ -1,0 +1,123 @@
+"""Host-pipeline performance rule (PERF01).
+
+The tick's host glue consumes the batched solver's OUTPUT TENSORS
+(`res_flavor`, `ps_ok`, `wl_mode`, ... — the dict `solve_core` returns
+and `fetch_outputs` materializes). Reading those element-wise from a
+per-workload Python loop is the interpreter-bound shape BENCH_r05
+measured at ~5-10us per workload per tensor touch: at the 1k-heads
+north-star tick it reintroduces milliseconds of decode/flush latency
+that the vectorized paths (np.nonzero / gathers / `batch_usage_csr` /
+`csr_gather`) exist to avoid.
+
+PERF01 flags, inside the solver-adjacent packages (scheduler/, solver/,
+models/):
+
+  * a `for`/`while` loop body subscripting a solver output tensor with
+    the loop variable — directly (`out["ps_ok"][w]`) or through a local
+    alias (`ps_ok = out["ps_ok"][:n]` ... `ps_ok[w]`);
+
+Whole-array reads OUTSIDE loops (fancy indexing, reductions) and
+`.tolist()` materializations iterated as plain lists are the sanctioned
+patterns and stay unflagged — the decode fallback's fill loop walks
+`tolist()`ed columns precisely so each tensor is touched once.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Set
+
+from kueue_tpu.analysis.core import (
+    AnalysisContext, Rule, Severity, SourceFile, finding, register)
+
+_PERF_PATHS = ("scheduler/", "solver/", "models/", "fixtures/lint/")
+
+# The batched solve's output pytree keys (models/flavor_fit.solve_core
+# `outputs` dict + the derived wl_mode).
+_OUTPUT_KEYS = {"res_flavor", "res_mode", "res_borrow", "group_chosen",
+                "group_tried", "ps_ok", "ps_mode", "wl_mode"}
+
+
+def _is_output_tensor_expr(node: ast.expr) -> bool:
+    """True for `X["res_flavor"]`-shaped reads (any dict name) and slice
+    chains over them (`out["ps_ok"][:n]`)."""
+    if not isinstance(node, ast.Subscript):
+        return False
+    sl = node.slice
+    if isinstance(sl, ast.Constant) and isinstance(sl.value, str) \
+            and sl.value in _OUTPUT_KEYS:
+        return True
+    # A slice over an output-tensor expression is still the tensor.
+    return _is_output_tensor_expr(node.value)
+
+
+def _loop_target_names(target: ast.expr) -> Set[str]:
+    return {n.id for n in ast.walk(target) if isinstance(n, ast.Name)}
+
+
+def _check_perf01(f: SourceFile, ctx: AnalysisContext):
+    for func in ast.walk(f.tree):
+        if not isinstance(func, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        # Local aliases bound (directly or transitively) to an output
+        # tensor: `ps_ok = out["ps_ok"][:n]`; `x = ps_ok` chains too.
+        # `.tolist()` / np.* calls break the chain (they leave the
+        # tensor world), which is exactly the sanctioned pattern.
+        aliases: Set[str] = set()
+        changed = True
+        while changed:
+            changed = False
+            for node in ast.walk(func):
+                if not isinstance(node, ast.Assign) \
+                        or len(node.targets) != 1 \
+                        or not isinstance(node.targets[0], ast.Name):
+                    continue
+                name = node.targets[0].id
+                if name in aliases:
+                    continue
+                value = node.value
+                is_alias = _is_output_tensor_expr(value) or (
+                    isinstance(value, ast.Name) and value.id in aliases) \
+                    or (isinstance(value, ast.Subscript)
+                        and isinstance(value.value, ast.Name)
+                        and value.value.id in aliases)
+                if is_alias:
+                    aliases.add(name)
+                    changed = True
+
+        for loop in ast.walk(func):
+            if isinstance(loop, ast.For):
+                loop_vars = _loop_target_names(loop.target)
+            elif isinstance(loop, ast.While):
+                # While loops index with a manually-advanced counter;
+                # flag any alias subscripted by a plain Name.
+                loop_vars = None
+            else:
+                continue
+            for sub in ast.walk(loop):
+                if not isinstance(sub, ast.Subscript):
+                    continue
+                base = sub.value
+                is_tensor = _is_output_tensor_expr(base) or (
+                    isinstance(base, ast.Name) and base.id in aliases)
+                if not is_tensor:
+                    continue
+                idx_names = {n.id for n in ast.walk(sub.slice)
+                             if isinstance(n, ast.Name)}
+                hit = bool(idx_names & loop_vars) if loop_vars is not None \
+                    else bool(idx_names)
+                if hit:
+                    yield finding(
+                        PERF01, f, sub,
+                        "per-workload Python loop reads a solver output "
+                        "tensor element-wise — gather/reduce it with "
+                        "numpy outside the loop (np.nonzero, fancy "
+                        "indexing, batch_usage_csr/csr_gather) or "
+                        "materialize once with .tolist() and iterate "
+                        "the list")
+
+
+PERF01 = register(Rule(
+    id="PERF01", severity=Severity.ERROR,
+    summary="per-workload Python loop over solver output tensors",
+    check=_check_perf01, path_fragments=_PERF_PATHS))

@@ -628,6 +628,12 @@ class Workload:
         # name/namespace are identity (never reassigned); precompute the
         # cache key once — it is read on every usage-accounting mutation.
         self._key = f"{self.namespace}/{self.name}"
+        # In-place condition mutation counter: set_condition (and the
+        # scheduler's unrolled twin) bump it, so memos derived from
+        # condition STATE (queue-ordering timestamp) can key on
+        # (conditions identity, len, this) — identity+len alone only
+        # detect wholesale replacement and appends.
+        self._cond_mut = 0
 
     # -- condition helpers (reference: pkg/workload/workload.go:369-505) ----
 
@@ -658,6 +664,7 @@ class Workload:
     def set_condition(self, ctype: str, status: bool, reason: str = "",
                       message: str = "", now: Optional[float] = None) -> None:
         now = _time.time() if now is None else now
+        self._cond_mut += 1
         c = self.find_condition(ctype)
         if c is None:
             self.conditions.append(

@@ -80,14 +80,27 @@ class WorkloadOrdering:
     pods_ready_requeuing_timestamp: str = "Eviction"  # "Eviction" | "Creation"
 
     def queue_order_time(self, wl: Workload) -> float:
+        # Memoized on the workload: the timestamp is read on every heap
+        # push AND per entry in the nomination sort, several thousand
+        # times per tick at scale, and only moves when the Evicted
+        # condition does. The key pins the exact inputs: the conditions
+        # list (identity + length catch wholesale replacement and
+        # appends), the in-place mutation counter (set_condition bumps
+        # it), and this ordering's timestamp mode.
+        conds = wl.conditions
+        memo = getattr(wl, "_qot_memo", None)
+        mode = self.pods_ready_requeuing_timestamp
+        if memo is not None and memo[0] is conds and memo[1] == len(conds) \
+                and memo[2] == wl._cond_mut and memo[3] == mode:
+            return memo[4]
         c = wl.find_condition(CONDITION_EVICTED)
         relevant = c is not None and c.status
-        if relevant and self.pods_ready_requeuing_timestamp == "Creation" \
+        if relevant and mode == "Creation" \
                 and c.reason == EVICTED_BY_PODS_READY_TIMEOUT:
             relevant = False
-        if relevant:
-            return c.last_transition_time
-        return wl.creation_time
+        value = c.last_transition_time if relevant else wl.creation_time
+        wl._qot_memo = (conds, len(conds), wl._cond_mut, mode, value)
+        return value
 
 
 class WorkloadInfo:

@@ -663,14 +663,18 @@ class BatchSolver:
 
     _profiler_started = False
 
-    def __init__(self, mesh=None):
+    def __init__(self, mesh=None, use_arena: Optional[bool] = None):
         """`mesh` (a jax.sharding.Mesh, e.g. parallel.mesh.make_mesh())
         shards every solve over the mesh's devices: ClusterQueue usage is
         partitioned on the CQ axis with on-device cohort aggregation
         (psum/all_gather over ICI) and the workload batch is
         data-parallel — the multi-chip scale-out path of
         kueue_tpu.parallel.mesh, selected in production via
-        Configuration.tpuSolver.shardDevices. None = single-device."""
+        Configuration.tpuSolver.shardDevices. None = single-device.
+
+        `use_arena` toggles the incremental workload tensor arena
+        (sch.WorkloadArena; default on, or KUEUE_TPU_NO_ARENA=1 to force
+        the from-scratch encode — the differential goldens drive both)."""
         self._key = None
         self._enc: Optional[sch.CQEncoding] = None
         self._static: Optional[tuple] = None
@@ -678,6 +682,18 @@ class BatchSolver:
         self._row_cache: Optional[sch.WorkloadRowCache] = None
         self._preempt_ctx = None
         self._mesh = mesh
+        # Incremental workload arena (the tensorize.encode fast path).
+        if use_arena is None:
+            use_arena = os.environ.get("KUEUE_TPU_NO_ARENA", "") != "1"
+        self._use_arena = use_arena
+        self._arena: Optional[sch.WorkloadArena] = None
+        self._arena_rebuilt = False
+        # Pending-backlog supplier + event plumbing, wired by the
+        # scheduler (bind_queues): arena rebuilds re-encode the whole
+        # pending backlog off the measured path, and queue add/update/
+        # delete events keep rows fresh between ticks.
+        self._queues = None
+        self.arena_full_rebuilds = 0
         # Compile-proofing (VERDICT r5 Weak #2): every padded solve shape
         # compiles once; a head-count bucket rotation mid-run must not
         # land that compile inside a measured tick. `_warm_keys` tracks
@@ -737,7 +753,83 @@ class BatchSolver:
                 self._warm_keys.clear()
                 self._prewarm_pending.clear()
             self._key = key
+            if self._use_arena:
+                self._rebuild_arena(snapshot)
         return self._enc
+
+    def _rebuild_arena(self, snapshot: Snapshot) -> None:
+        """Full arena rebuild (encoding-generation change): new pool, the
+        whole pending backlog re-encoded NOW so the following ticks'
+        gathers are pure row reuse. Counted in `arena_full_rebuilds` —
+        the bench asserts zero of these inside the measured window."""
+        infos = []
+        queues = self._queues
+        if queues is not None:
+            pending = getattr(queues, "pending_infos", None)
+            if pending is not None:
+                infos = pending()
+        self._arena = sch.WorkloadArena(
+            self._enc, snapshot,
+            capacity=sch._pad_pow2(max(len(infos), 1), floor=1024))
+        if infos:
+            self._arena.seed(infos)
+        self.arena_full_rebuilds += 1
+        self._arena_rebuilt = True
+
+    # -- queue-manager event plumbing (scheduler wires this) ----------------
+
+    def bind_queues(self, queues) -> None:
+        """Subscribe to the queue manager's pending-workload events and
+        remember it as the arena's backlog supplier. Idempotent."""
+        if self._queues is queues:
+            return
+        if self._queues is not None:
+            unreg = getattr(self._queues, "unregister_workload_sink", None)
+            if unreg is not None:
+                unreg(self)
+        self._queues = queues
+        reg = getattr(queues, "register_workload_sink", None)
+        if reg is not None:
+            reg(self)
+
+    def unbind_queues(self) -> None:
+        """Release the queue-manager subscription (scheduler retirement)."""
+        if self._queues is not None:
+            unreg = getattr(self._queues, "unregister_workload_sink", None)
+            if unreg is not None:
+                unreg(self)
+            self._queues = None
+
+    def note_pending_workload(self, wi: WorkloadInfo) -> None:
+        """Queue add/update event: (re-)encode the workload's arena row
+        off the measured tick path."""
+        arena = self._arena
+        if arena is not None:
+            arena.note(wi)
+
+    def forget_pending_workload(self, uid: str) -> None:
+        """Queue delete event: free the workload's arena row."""
+        arena = self._arena
+        if arena is not None:
+            arena.forget(uid)
+
+    @property
+    def arena_rows_reused(self) -> int:
+        arena = self._arena
+        return arena.rows_reused if arena is not None else 0
+
+    @property
+    def arena_rows_missed(self) -> int:
+        """Gather misses: rows (re-)encoded INSIDE a tick — the reuse
+        ratio's denominator counterpart (event/seed encodes run off the
+        measured path and are not misses)."""
+        arena = self._arena
+        return arena.rows_missed if arena is not None else 0
+
+    @property
+    def arena_rows_encoded(self) -> int:
+        arena = self._arena
+        return arena.rows_encoded if arena is not None else 0
 
     def encoding_matches(self, snapshot: Snapshot) -> bool:
         """True when the solver's current encoding was built from exactly
@@ -856,10 +948,21 @@ class BatchSolver:
             with TRACER.phase("tensorize.refresh"):
                 enc = self._encoding_for(snapshot)
                 usage = self._usage_enc.refresh(snapshot)
-            with TRACER.phase("tensorize.encode"):
-                wt = sch.encode_workloads(workloads, snapshot, enc,
-                                          row_cache=self._row_cache,
-                                          min_podsets=self._p_floor)
+            with TRACER.phase("tensorize.encode") as esp:
+                if self._arena is not None:
+                    wt, stats = self._arena.gather(
+                        workloads, snapshot, min_podsets=self._p_floor)
+                    esp.set("rows_dirty", stats["rows_dirty"])
+                    esp.set("rows_total", stats["rows_total"])
+                    esp.set("full_rebuild", self._arena_rebuilt)
+                    self._arena_rebuilt = False
+                else:
+                    wt = sch.encode_workloads(workloads, snapshot, enc,
+                                              row_cache=self._row_cache,
+                                              min_podsets=self._p_floor)
+                    esp.set("rows_dirty", wt.num_real)
+                    esp.set("rows_total", wt.num_real)
+                    esp.set("full_rebuild", True)
                 self._p_floor = max(self._p_floor, wt.req.shape[1])
             cold = False
             with TRACER.phase("tensorize.dispatch"):
@@ -1006,6 +1109,10 @@ class BatchSolver:
             assignments = decode_assignments(
                 inflight["workloads"], inflight["snapshot"],
                 inflight["enc"], out)
+            # Batch-level usage coordinates (CSR over the solve): the
+            # admission cycle's re-validation and usage commit consume
+            # array slices of these instead of per-workload list walks.
+            inflight["usage_csr"] = sch.batch_usage_csr(out, inflight["wt"])
         return assignments
 
     def solve(self, workloads: Sequence[WorkloadInfo],
@@ -1047,9 +1154,32 @@ class BatchSolver:
         if self._usage_enc is not None:
             self._usage_enc.apply_delta(cq_name, usage_frq, -1)
 
+    def note_admissions_csr(self, csr, rows, cq_names) -> None:
+        """Vectorized twin of note_admissions for decode-CSR batches: the
+        whole cycle's admitted usage lands in ONE scatter-add over the
+        solve's CSR coordinate slices (`rows` — solve rows of the
+        admitted entries), plus one version bump per admitted workload
+        (`cq_names`, duplicates included) — the same per-assume lockstep
+        contract as apply_delta_batch."""
+        ue = self._usage_enc
+        enc = self._enc
+        if ue is None or enc is None:
+            return
+        _, ci, fi, ri, val = sch.csr_gather(csr, np.asarray(rows,
+                                                            dtype=np.int64))
+        if len(ci):
+            np.add.at(ue.usage, (ci, fi, ri), val)
+        versions = ue._versions
+        cq_index = enc.cq_index
+        for name in cq_names:
+            ci_ = cq_index.get(name)
+            if ci_ is not None and versions[ci_] is not None:
+                versions[ci_] += 1
+
     def revalidate_fits(self, items,
                         snapshot: Optional[Snapshot] = None,
                         hier_state=None,
+                        coords=None,
                         ) -> Optional[np.ndarray]:
         """Batched staleness re-validation of FIT assignments.
 
@@ -1081,46 +1211,55 @@ class BatchSolver:
             # mutation mid-pipeline): the items' usage_idx coordinates are
             # in the OLD index space. Fall back to the referee walk.
             return None
-        ent, cis, fis, ris, vals = [], [], [], [], []
-        cq_index = enc.cq_index
-        f_index = enc.flavor_index
-        r_index = enc.resource_index
-        for i, (cq_name, assignment) in enumerate(items):
-            ci = cq_index.get(cq_name)
-            if ci is None:
-                return None
-            idx = getattr(assignment, "usage_idx", None)
-            if idx is not None:
-                i_f, i_r, i_v = idx
-                k = len(i_f)
-                ent.extend([i] * k)
-                cis.extend([ci] * k)
-                fis.extend(i_f)
-                ris.extend(i_r)
-                vals.extend(i_v)
-                continue
-            for fname, resources in assignment.usage.items():
-                fi = f_index.get(fname)
-                if fi is None:
-                    return None
-                for rname, val in resources.items():
-                    ri = r_index.get(rname)
-                    if ri is None:
-                        return None
-                    ent.append(i)
-                    cis.append(ci)
-                    fis.append(fi)
-                    ris.append(ri)
-                    vals.append(val)
         n = len(items)
-        ok = np.ones(n, dtype=bool)
-        if not ent:
-            return ok
-        ent = np.asarray(ent)
-        ci = np.asarray(cis)
-        fi = np.asarray(fis)
-        ri = np.asarray(ris)
-        val = np.asarray(vals, dtype=np.int64)
+        if coords is not None:
+            # Batch path: the scheduler pre-gathered every item's
+            # coordinates from the solve's CSR (csr_gather) — no
+            # per-item Python walk at all.
+            ent, ci, fi, ri, val = coords
+            ok = np.ones(n, dtype=bool)
+            if not len(ent):
+                return ok
+        else:
+            ent, cis, fis, ris, vals = [], [], [], [], []
+            cq_index = enc.cq_index
+            f_index = enc.flavor_index
+            r_index = enc.resource_index
+            for i, (cq_name, assignment) in enumerate(items):
+                ci = cq_index.get(cq_name)
+                if ci is None:
+                    return None
+                idx = getattr(assignment, "usage_idx", None)
+                if idx is not None:
+                    i_f, i_r, i_v = idx
+                    k = len(i_f)
+                    ent.extend([i] * k)
+                    cis.extend([ci] * k)
+                    fis.extend(i_f)
+                    ris.extend(i_r)
+                    vals.extend(i_v)
+                    continue
+                for fname, resources in assignment.usage.items():
+                    fi = f_index.get(fname)
+                    if fi is None:
+                        return None
+                    for rname, val in resources.items():
+                        ri = r_index.get(rname)
+                        if ri is None:
+                            return None
+                        ent.append(i)
+                        cis.append(ci)
+                        fis.append(fi)
+                        ris.append(ri)
+                        vals.append(val)
+            ok = np.ones(n, dtype=bool)
+            if not ent:
+                return ok
+            ent = np.asarray(ent)
+            ci = np.asarray(cis)
+            fi = np.asarray(fis)
+            ri = np.asarray(ris)
+            val = np.asarray(vals, dtype=np.int64)
         U = ue.usage
         used = U[ci, fi, ri]
         nom = enc.nominal[ci, fi, ri]

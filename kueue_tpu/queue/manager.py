@@ -18,6 +18,8 @@ from typing import Callable, Dict, List, Mapping, Optional
 
 from kueue_tpu.api.types import (
     CONDITION_EVICTED,
+    CONDITION_FINISHED,
+    CONDITION_QUOTA_RESERVED,
     EVICTED_BY_PODS_READY_TIMEOUT,
     ClusterQueue,
     LocalQueue,
@@ -219,6 +221,44 @@ class Manager:
         self._ns_lister = namespace_lister or (lambda name: {})
         self._clock = clock
         self._stopped = False
+        # Pending-workload event sinks (the solver's incremental tensor
+        # arena): note_pending_workload on every add/update entering a
+        # queue, forget_pending_workload on delete. Requeues of an
+        # unchanged info fire nothing — the subscriber's row stays valid.
+        self._workload_sinks: List = []
+
+    # -- pending-workload events (solver arena subscription) -----------------
+
+    def register_workload_sink(self, sink) -> None:
+        """Subscribe to pending-workload dirty events. `sink` implements
+        note_pending_workload(info) and forget_pending_workload(uid);
+        both are called under the manager lock (keep them O(row))."""
+        with self._cond:
+            if sink not in self._workload_sinks:
+                self._workload_sinks.append(sink)
+
+    def unregister_workload_sink(self, sink) -> None:
+        with self._cond:
+            if sink in self._workload_sinks:
+                self._workload_sinks.remove(sink)
+
+    def _note_sinks(self, wi: WorkloadInfo) -> None:
+        for sink in self._workload_sinks:
+            sink.note_pending_workload(wi)
+
+    def _forget_sinks(self, wl: Workload) -> None:
+        for sink in self._workload_sinks:
+            sink.forget_pending_workload(wl.uid)
+
+    def pending_infos(self) -> List[WorkloadInfo]:
+        """Every pending WorkloadInfo (heaps + parking lots) — the
+        solver arena's backlog supplier for full rebuilds."""
+        with self._cond:
+            out: List[WorkloadInfo] = []
+            for cq in self.cluster_queues.values():
+                out.extend(cq.heap.items())
+                out.extend(cq.inadmissible.values())
+            return out
 
     # -- cluster queues ------------------------------------------------------
 
@@ -238,7 +278,9 @@ class Manager:
                 if lq is not None and lq.cluster_queue == spec.name \
                         and not wl.has_quota_reservation and not wl.is_finished \
                         and wl.active:
-                    cq.push_or_update(WorkloadInfo(wl, cluster_queue=spec.name))
+                    wi = WorkloadInfo(wl, cluster_queue=spec.name)
+                    cq.push_or_update(wi)
+                    self._note_sinks(wi)
             self._cond.notify_all()
 
     def update_cluster_queue(self, spec: ClusterQueue) -> None:
@@ -277,7 +319,9 @@ class Manager:
                     if wl.namespace == lq.namespace and wl.queue_name == lq.name \
                             and not wl.has_quota_reservation and not wl.is_finished \
                             and wl.active:
-                        cq.push_or_update(WorkloadInfo(wl, cluster_queue=cq.name))
+                        wi = WorkloadInfo(wl, cluster_queue=cq.name)
+                        cq.push_or_update(wi)
+                        self._note_sinks(wi)
                 self._cond.notify_all()
 
     def delete_local_queue(self, lq: LocalQueue) -> None:
@@ -298,7 +342,9 @@ class Manager:
             cq = self.cluster_queues.get(cq_name)
             if cq is None:
                 return False
-            cq.push_or_update(WorkloadInfo(wl, cluster_queue=cq_name))
+            wi = WorkloadInfo(wl, cluster_queue=cq_name)
+            cq.push_or_update(wi)
+            self._note_sinks(wi)
             self._cond.notify_all()
             return True
 
@@ -309,6 +355,7 @@ class Manager:
                 cq = self.cluster_queues.get(cq_name)
                 if cq is not None:
                     cq.delete(wl)
+            self._forget_sinks(wl)
 
     def requeue_workload(self, wi: WorkloadInfo, reason: str) -> bool:
         """manager.go RequeueWorkload; caller must pass a still-pending info."""
@@ -317,7 +364,11 @@ class Manager:
     def requeue_workloads(self, items) -> int:
         """Bulk requeue ([(info, reason)]) under one lock with one wakeup —
         the scheduler's post-cycle sweep returns a few hundred losers per
-        tick at scale."""
+        tick at scale. The per-entry admission-state reads go through ONE
+        condition-map fetch per workload (the sweep previously re-walked
+        the same conditions through three property lookups each — the
+        per-entry re-lookup behind the requeue-phase regression the
+        BENCH_r05 northstar config exposed)."""
         added = 0
         # tracer.lock: when tracing is enabled the queue lock's
         # acquisition wait becomes a span (contention with API-server
@@ -327,8 +378,12 @@ class Manager:
             cqs = self.cluster_queues
             for wi, reason in items:
                 wl = wi.obj
-                if wl.has_quota_reservation or wl.is_finished \
-                        or not wl.active:
+                cmap = wl._cond_map()
+                c = cmap.get(CONDITION_QUOTA_RESERVED)
+                if c is not None and c.status:
+                    continue
+                c = cmap.get(CONDITION_FINISHED)
+                if (c is not None and c.status) or not wl.active:
                     continue
                 cq = cqs.get(wi.cluster_queue)
                 if cq is None:
@@ -360,6 +415,11 @@ class Manager:
         with self._cond:
             moved = False
             for cq in self.cluster_queues.values():
+                if not cq.inadmissible:
+                    # The common steady-state CQ parks nothing; skip the
+                    # per-CQ list materialization (this sweep runs at the
+                    # top of EVERY tick over every ClusterQueue).
+                    continue
                 for key, wi in list(cq.inadmissible.items()):
                     rs = wi.obj.requeue_state
                     if rs is not None and rs.requeue_at is not None \

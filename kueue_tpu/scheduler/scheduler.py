@@ -69,6 +69,11 @@ class Entry:
     # Batched staleness re-validation verdict (None = not validated; the
     # admission cycle falls back to the per-entry referee walk).
     reval_ok: Optional[bool] = None
+    # Row of this entry in the batched solve it was decoded from (-1 when
+    # the assignment was referee-built or replaced since): the admission
+    # cycle reads the solve's CSR usage coordinates by this row instead
+    # of walking the assignment's Python dicts/lists.
+    solve_row: int = -1
 
 
 @dataclass
@@ -118,6 +123,14 @@ class Scheduler:
         self.apply_preemption = apply_preemption or (lambda wl, msg: None)
         self._ns_lister = namespace_lister or (lambda name: {})
         self.batch_solver = batch_solver
+        # Incremental workload arena plumbing: the solver subscribes to
+        # the queue manager's pending-workload events (add/update/delete
+        # keep rows fresh between ticks) and uses it as the backlog
+        # supplier for full arena rebuilds.
+        if batch_solver is not None:
+            bind = getattr(batch_solver, "bind_queues", None)
+            if bind is not None:
+                bind(queues)
         self.ordering = ordering or WorkloadOrdering()
         # waitForPodsReady.blockAdmission (KEP-349): admission is withheld
         # while the gate reports not-ready. The reference blocks the loop on
@@ -150,10 +163,15 @@ class Scheduler:
         self._topo_stage = None
 
     def close(self) -> None:
-        """Release cache subscriptions. Call when retiring this scheduler
-        while its cache lives on (e.g. config-reload replacement) — the
-        mirror's dirty sink would otherwise stay registered forever."""
+        """Release cache/queue subscriptions. Call when retiring this
+        scheduler while its cache lives on (e.g. config-reload
+        replacement) — the mirror's dirty sink and the solver's queue
+        subscription would otherwise stay registered forever."""
         self._mirror.detach()
+        if self.batch_solver is not None:
+            unbind = getattr(self.batch_solver, "unbind_queues", None)
+            if unbind is not None:
+                unbind()
 
     def prewarm(self, head_counts: Sequence[int], podsets: int = 1) -> None:
         """Warmup hook: compile the batched solve for the given head-count
@@ -231,8 +249,11 @@ class Scheduler:
             with TRACER.phase("nominate.sort"):
                 self._sort_entries(entries)
         with TRACER.phase("admit") as sp:
+            usage_csr = tick.handle.get("usage_csr") \
+                if tick.handle is not None else None
             admitted = self._admission_cycle(entries, snapshot,
-                                             revalidate=stale)
+                                             revalidate=stale,
+                                             usage_csr=usage_csr)
             sp.set("admitted", admitted)
             sp.set("entries", len(entries))
         with TRACER.phase("requeue"):
@@ -252,9 +273,9 @@ class Scheduler:
         carries the final outcome + Pending message of the attempt."""
         from kueue_tpu.tracing import explain as explain_mod
 
-        explain = self.explain
         seq = self.metrics.admission_attempts
         now = self.clock()
+        items = []
         for e in entries:
             if e.status == ASSUMED:
                 outcome = explain_mod.ADMITTED
@@ -264,7 +285,8 @@ class Scheduler:
                 outcome = explain_mod.PREEMPTING
             else:
                 outcome = explain_mod.INADMISSIBLE
-            explain.record(e.info.key, build_record(e, seq, now, outcome))
+            items.append((e.info.key, build_record(e, seq, now, outcome)))
+        self.explain.record_bulk(items)
 
     # -- nomination (scheduler.go:317-351) ----------------------------------
 
@@ -276,6 +298,12 @@ class Scheduler:
             [wi.obj for wi in heads])
         cqs_by_name = snapshot.cluster_queues
         inactive = snapshot.inactive_cluster_queues
+        ns_lister = self._ns_lister
+        validator = self.workload_validator
+        # One namespace-labels fetch per namespace per tick (heads at
+        # scale share a handful of namespaces, and the lister may cross
+        # into informer/runtime state).
+        ns_cache: Dict[str, Optional[dict]] = {}
         for wi, skip in zip(heads, already):
             if skip:
                 continue
@@ -289,7 +317,11 @@ class Scheduler:
             elif cq is None:
                 e.inadmissible_msg = f"ClusterQueue {wi.cluster_queue} not found"
             else:
-                ns = self._ns_lister(wi.obj.namespace)
+                namespace = wi.obj.namespace
+                try:
+                    ns = ns_cache[namespace]
+                except KeyError:
+                    ns = ns_cache[namespace] = ns_lister(namespace)
                 if ns is None:
                     e.inadmissible_msg = "Could not obtain workload namespace"
                 elif not cq.namespace_selector.matches(ns):
@@ -297,7 +329,7 @@ class Scheduler:
                         "Workload namespace doesn't match ClusterQueue selector"
                     e.requeue_reason = RequeueReason.NAMESPACE_MISMATCH
                 else:
-                    reasons = self.workload_validator(wi.obj)
+                    reasons = validator(wi.obj)
                     if reasons:
                         e.inadmissible_msg = "; ".join(reasons)
                     else:
@@ -383,6 +415,7 @@ class Scheduler:
                 # Batched-solve FIT fast path: nothing to search, no
                 # message to build (a FIT assignment has no reasons).
                 e.assignment = full
+                e.solve_row = i
                 e.preemption_targets = []
                 e.inadmissible_msg = ""
                 e.info.last_assignment = full.last_state
@@ -573,7 +606,15 @@ class Scheduler:
         per-component key arrays — same ordering as sorting on
         `_entry_sort_key` tuples (both sorts are stable, components are
         compared in the same significance order), without a thousand tuple
-        allocations and log-depth tuple comparisons on the hot path."""
+        allocations and log-depth tuple comparisons on the hot path.
+
+        The queue-order timestamps come from the memoized
+        `queue_order_time` (they only move on Evicted transitions), and
+        without fair sharing the two adjacent integer components —
+        borrowing (most significant) and negated priority — are PACKED
+        into one int64 key (borrow in bit 62; priorities are far below
+        2^61), so the common config sorts with two argsort passes instead
+        of four `np.fromiter` generator walks plus three passes."""
         n = len(entries)
         if n < 64:
             entries.sort(key=self._entry_sort_key)
@@ -581,24 +622,36 @@ class Scheduler:
         import numpy as np
         qot = self.ordering.queue_order_time
         # np.lexsort keys run least-significant first.
-        keys = [np.fromiter((qot(e.info.obj) for e in entries),
-                            np.float64, count=n)]
-        if features.enabled(features.PRIORITY_SORTING_WITHIN_COHORT):
-            keys.append(np.fromiter((-e.info.obj.priority for e in entries),
-                                    np.int64, count=n))
-        if features.enabled(features.FAIR_SHARING):
-            keys.append(np.fromiter((e.share for e in entries),
-                                    np.float64, count=n))
-        keys.append(np.fromiter(
-            (e.assignment is not None and e.assignment.borrowing
-             for e in entries), bool, count=n))
+        keys = [np.array([qot(e.info.obj) for e in entries],
+                         dtype=np.float64)]
+        prio_on = features.enabled(features.PRIORITY_SORTING_WITHIN_COHORT)
+        fair = features.enabled(features.FAIR_SHARING)
+        borrow = np.array(
+            [e.assignment is not None and e.assignment.borrowing
+             for e in entries], dtype=np.int64)
+        if fair:
+            # Share sits between priority and borrowing in significance,
+            # so the components stay separate lexsort keys.
+            if prio_on:
+                keys.append(np.array([-e.info.obj.priority for e in entries],
+                                     dtype=np.int64))
+            keys.append(np.array([e.share for e in entries],
+                                 dtype=np.float64))
+            keys.append(borrow)
+        else:
+            packed = borrow << 62
+            if prio_on:
+                packed += np.array([-e.info.obj.priority for e in entries],
+                                   dtype=np.int64)
+            keys.append(packed)
         order = np.lexsort(keys)
         entries[:] = [entries[i] for i in order.tolist()]
 
     # -- admission cycle (scheduler.go:204-275) ------------------------------
 
     def _admission_cycle(self, entries: List[Entry], snapshot: Snapshot,
-                         revalidate: bool = False) -> int:
+                         revalidate: bool = False,
+                         usage_csr=None) -> int:
         cycle_cohorts_usage: Dict[str, FlavorResourceQuantities] = {}
         # Root-merged view of the same reservations: the preempt skip gate
         # compares against the whole tree's cycle usage (for flat cohorts
@@ -690,11 +743,23 @@ class Scheduler:
                     and e.assignment.representative_mode == FIT]
                 if fit_entries:
                     reval = getattr(self.batch_solver, "revalidate_fits", None)
+                    coords = None
+                    if usage_csr is not None and all(
+                            e.solve_row >= 0 for e in fit_entries):
+                        # Every in-doubt FIT came from this solve: gather
+                        # their usage coordinates from the decode's CSR in
+                        # one vectorized slice concat — no per-entry walk.
+                        from kueue_tpu.solver.schema import csr_gather
+                        import numpy as np
+                        coords = csr_gather(usage_csr, np.fromiter(
+                            (e.solve_row for e in fit_entries), np.int64,
+                            count=len(fit_entries)))
                     # Build the tree state once; the revalidation uses it
                     # fold-free and the admission loop below reuses it.
                     mask = reval([(e.info.cluster_queue, e.assignment)
                                   for e in fit_entries], snapshot=snapshot,
-                                 hier_state=ensure_hier_state()) \
+                                 hier_state=ensure_hier_state(),
+                                 coords=coords) \
                         if reval is not None else None
                     if mask is not None:
                         for e, ok in zip(fit_entries, mask):
@@ -899,7 +964,8 @@ class Scheduler:
             if cq.cohort is not None:
                 cycle_cohorts_skip_preemption.add(cq.cohort.root_name)
         with TRACER.phase("admit.flush"):
-            admitted = self._flush_assumes(pending_assumes, snapshot)
+            admitted = self._flush_assumes(pending_assumes, snapshot,
+                                           usage_csr=usage_csr)
         for e, cq in preempting:
             self._issue_preemptions(e, cq)
         return admitted
@@ -1037,7 +1103,8 @@ class Scheduler:
         return True
 
     def _flush_assumes(self, pending: list,
-                       snapshot: Optional[Snapshot] = None) -> int:
+                       snapshot: Optional[Snapshot] = None,
+                       usage_csr=None) -> int:
         """End-of-cycle bulk commit of every reserved entry: one locked
         cache pass, then the apply callback per success (assume-before-
         apply, exactly the reference's admit() order), queued mirror
@@ -1064,6 +1131,8 @@ class Scheduler:
             results = self.cache.assume_workloads(items, fast=all_fast)
         now = self.clock()
         note_items = []
+        csr_rows: List[int] = []
+        csr_cqs: List[str] = []
         note_bulk = getattr(self.batch_solver, "note_admissions", None)
         # usage_idx coordinates are only valid in the encoding they were
         # decoded against; after a mid-pipeline structural change the
@@ -1104,12 +1173,18 @@ class Scheduler:
             # the difference), not the reduced assignment usage. When the
             # flattened triples exist (no reclaim, spec counts — the
             # accounted usage IS the assignment usage) pass the decode's
+            # CSR row (one vectorized scatter-add for the whole cycle) or
             # integer coordinates so the solver skips the dict walk.
-            idx = e.assignment.usage_idx if triples is not None and idx_ok \
-                else None
-            note_items.append((
-                e.info.cluster_queue,
-                None if idx is not None else assumed.usage(), idx))
+            if triples is not None and idx_ok and usage_csr is not None \
+                    and e.solve_row >= 0:
+                csr_rows.append(e.solve_row)
+                csr_cqs.append(e.info.cluster_queue)
+            else:
+                idx = e.assignment.usage_idx \
+                    if triples is not None and idx_ok else None
+                note_items.append((
+                    e.info.cluster_queue,
+                    None if idx is not None else assumed.usage(), idx))
             admitted += 1
             self.metrics.admitted += 1
             key = (e.info.cluster_queue,)
@@ -1118,6 +1193,9 @@ class Scheduler:
         if admit_counts:
             REGISTRY.admitted_workloads_total.inc_bulk(admit_counts.items())
             REGISTRY.admission_wait_time_seconds.observe_bulk(wait_samples)
+        if csr_rows:
+            self.batch_solver.note_admissions_csr(usage_csr, csr_rows,
+                                                  csr_cqs)
         if note_items:
             if note_bulk is not None:
                 note_bulk(note_items)
@@ -1148,6 +1226,7 @@ class Scheduler:
         if to_requeue:
             self.queues.requeue_workloads(to_requeue)
         now = None
+        inadmissible = 0
         for e in entries:
             if e.status in (NOT_NOMINATED, SKIPPED):
                 wl = e.info.obj
@@ -1157,27 +1236,35 @@ class Scheduler:
                 # the Pending condition carries the inadmissible message
                 # whether or not a reservation existed — it is the status
                 # surface explaining WHY the workload is not admitted.
-                if wl.has_quota_reservation:
+                # One condition-map fetch serves the reservation read and
+                # the Pending write (this loop runs per loser per tick).
+                cmap = wl._cond_map()
+                c = cmap.get("QuotaReserved")
+                if c is not None and c.status:
                     wl.admission = None
-                wl.set_condition("QuotaReserved", False, reason="Pending",
-                                 message=e.inadmissible_msg, now=now)
-                self.metrics.inadmissible += 1
+                _set_condition_via(cmap, wl, "QuotaReserved", False,
+                                   "Pending", now,
+                                   message=e.inadmissible_msg)
+                inadmissible += 1
+        self.metrics.inadmissible += inadmissible
 
 
 def _set_condition_via(cmap: dict, wl: Workload, ctype: str, status: bool,
-                       reason: str, now: float) -> None:
+                       reason: str, now: float, message: str = "") -> None:
     """Workload.set_condition with the condition map already in hand
     (admission hot path — one map read serves several condition writes).
     In-place updates keep `cmap` valid; appends invalidate it by length,
     exactly like set_condition itself."""
+    wl._cond_mut += 1
     c = cmap.get(ctype)
     if c is None:
         wl.conditions.append(
-            Condition(ctype, status, reason, "", last_transition_time=now))
+            Condition(ctype, status, reason, message,
+                      last_transition_time=now))
     else:
         if c.status != status:
             c.last_transition_time = now
-        c.status, c.reason, c.message = status, reason, ""
+        c.status, c.reason, c.message = status, reason, message
 
 
 def _assignment_still_fits(assignment: Assignment, cq: CachedClusterQueue,

@@ -30,6 +30,7 @@ borrowingLimit.
 
 from __future__ import annotations
 
+import threading
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -962,3 +963,378 @@ def encode_workloads(workloads: Sequence[WorkloadInfo], snapshot: Snapshot,
         wl_cq=wl_cq, req=req, has_req=has_req, podset_valid=podset_valid,
         podset_unsat=podset_unsat, elig=elig, resume_slot=resume_slot,
         wl_valid=wl_valid, num_real=n)
+
+
+def batch_usage_csr(out: Dict[str, np.ndarray], wt: WorkloadTensors):
+    """Vectorized admission-usage coordinates of a whole solved batch.
+
+    One numpy pass over the solver's output tensors computes, for every
+    decoded workload, the deduplicated (cq, flavor, resource) -> value
+    usage coordinates that `decode_assignments` builds per-assignment as
+    `usage_idx` — in CSR form over the batch:
+
+        (indptr[n+1], ci, fi, ri, val)
+
+    where row w's pairs live at `indptr[w]:indptr[w+1]`. The admission
+    cycle's staleness re-validation and the end-of-cycle usage commit
+    consume slices of these arrays instead of walking per-workload Python
+    lists (the decode/flush loops BENCH_r05 showed interpreter-bound).
+    The mask mirrors the decode exactly: podsets past the first failure
+    are never counted (flavorassigner.go:323-327), and same-(flavor,
+    resource) pairs across podsets are summed like the per-assignment
+    dedup."""
+    n = wt.num_real
+    ps_ok = out["ps_ok"][:n]
+    P = ps_ok.shape[1]
+    not_ok = ~ps_ok
+    has_fail = not_ok.any(axis=1)
+    first_fail = np.where(has_fail, not_ok.argmax(axis=1), P)
+    res_flavor = out["res_flavor"][:n]
+    R = res_flavor.shape[2]
+    decode_mask = (ps_ok
+                   & (np.arange(P)[None, :] <= first_fail[:, None])
+                   )[:, :, None] & (res_flavor >= 0)
+    ws, pp, rr = np.nonzero(decode_mask)
+    if not len(ws):
+        return (np.zeros(n + 1, dtype=np.int64),
+                np.empty(0, dtype=np.int64), np.empty(0, dtype=np.int64),
+                np.empty(0, dtype=np.int64), np.empty(0, dtype=np.int64))
+    fi = res_flavor[ws, pp, rr].astype(np.int64)
+    vals = wt.req[:n][ws, pp, rr]
+    F = int(fi.max()) + 1
+    key = (ws.astype(np.int64) * F + fi) * R + rr
+    ukey, inv = np.unique(key, return_inverse=True)
+    # Integer-exact per-pair sum (bincount's float weights would round
+    # above 2^53; quantities are canonical int64 units).
+    uval = np.zeros(len(ukey), dtype=np.int64)
+    np.add.at(uval, inv, vals)
+    uw = ukey // (F * R)
+    ufi = (ukey // R) % F
+    uri = ukey % R
+    uci = wt.wl_cq[:n][uw].astype(np.int64)
+    indptr = np.zeros(n + 1, dtype=np.int64)
+    np.add.at(indptr, uw + 1, 1)
+    np.cumsum(indptr, out=indptr)
+    return indptr, uci, ufi, uri, uval
+
+
+def csr_gather(csr, rows):
+    """Concatenate the CSR slices of `rows` (vectorized): returns
+    (ent, ci, fi, ri, val) where `ent` maps each pair back to its
+    position in `rows`."""
+    indptr, ci, fi, ri, val = csr
+    rows = np.asarray(rows, dtype=np.int64)
+    starts = indptr[rows]
+    counts = indptr[rows + 1] - starts
+    total = int(counts.sum())
+    ent = np.repeat(np.arange(len(rows)), counts)
+    if total == 0:
+        z = np.empty(0, dtype=np.int64)
+        return ent, z, z, z, z
+    # Standard CSR multi-slice gather: per output element, its source
+    # index = the row's start + the offset within the row.
+    cum = np.concatenate(([0], np.cumsum(counts)[:-1]))
+    pos = np.repeat(starts - cum, counts) + np.arange(total)
+    return ent, ci[pos], fi[pos], ri[pos], val[pos]
+
+
+class WorkloadArena:
+    """Persistent workload tensor arena: the incremental twin of
+    `encode_workloads`.
+
+    The per-tick encode rebuilt every head's row from scratch even though
+    <1% of the backlog changes between ticks (BENCH_r05: tensorize.encode
+    6.7ms of a 60ms tick). The arena keeps one padded row per PENDING
+    workload alive across ticks in pooled `[cap,P,R]` request /
+    eligibility / cq-index tensors with a free-list of rows, and applies
+    per-workload dirty deltas driven by the queue manager's events
+    (add/update encode a row, delete frees it, requeue is a no-op — the
+    row persists). A tick's batch is then ONE vectorized gather of its
+    heads' rows into the canonical `[W,...]` bucket tensors, byte-identical
+    to a from-scratch `encode_workloads` (pinned by the differential
+    goldens and the `debug_verify` mode below).
+
+    Row validity keys on `(uid, WorkloadInfo.rev)` — the same
+    never-recycled identity contract as `WorkloadRowCache`; any
+    admission-relevant change flows through the queue manager, which
+    re-wraps the workload in a fresh info (new rev) and fires an update
+    event. A gather that meets an unknown/stale row simply re-encodes it
+    in place (counted in `rows_encoded`, never a correctness event).
+
+    The resume-from-last-flavor slots are per-tick state
+    (`wi.last_assignment` moves on every solve), so they are NOT pooled:
+    the gather recomputes them for exactly the heads that carry
+    non-stale resume state, from the per-row memoized requested-resource
+    sets.
+
+    Lifecycle: one arena per CQ-encoding generation. A structural change
+    (flavors/CQs/cohorts, feature-gate flip) rotates the encoding and
+    FULLY REBUILDS the arena (`full_rebuilds` counts these; bench.py
+    asserts zero inside the measured window). Bucket rotation (W growth/
+    shrink) does not touch the pool — the gather pads to whatever bucket
+    the tick needs.
+    """
+
+    # Debug mode (KUEUE_TPU_DEBUG_ARENA=1, or set per-instance): every
+    # gather ALSO runs the from-scratch encode and asserts tensor
+    # equality — the UsageEncoder.debug_verify discipline applied to the
+    # workload side.
+    debug_verify = os.environ.get("KUEUE_TPU_DEBUG_ARENA", "") == "1"
+
+    def __init__(self, enc: CQEncoding, snapshot: Snapshot,
+                 capacity: int = 1024):
+        self.enc = enc
+        # Structural read-only view for event-time encodes (resource
+        # groups / flavors / label keys only — usage staleness is
+        # irrelevant, and any structural change rotates the encoding and
+        # rebuilds this arena).
+        self._snapshot = snapshot
+        self._lock = threading.Lock()
+        R = len(enc.resource_names)
+        self.R = R
+        self.G = enc.num_groups
+        self.S = enc.num_slots
+        self.P = 1
+        self.cap = 0
+        self._rows: Dict[str, int] = {}      # uid -> row
+        self._free: List[int] = []
+        self._rev: List[int] = []            # row -> info rev
+        self._uid: List[Optional[str]] = []  # row -> uid
+        self._req_sets: List[tuple] = []     # row -> requests_per_podset
+        self._grow(max(8, capacity))
+        # Cumulative stats (BatchSolver folds them into BENCH json):
+        # `rows_reused` / `rows_missed` split the GATHER path (reuse vs
+        # in-tick re-encode — the reuse-ratio gate reads these);
+        # `rows_encoded` counts every row encode wherever it ran (seed,
+        # queue events, gather misses) — the dirty-delta volume.
+        self.rows_reused = 0
+        self.rows_missed = 0
+        self.rows_encoded = 0
+
+    # -- pool plumbing ------------------------------------------------------
+
+    def _grow(self, new_cap: int) -> None:
+        """Extend the row pool (never shrinks; rows keep their index)."""
+        old = self.cap
+        P, R, G, S = self.P, self.R, self.G, self.S
+        wl_cq = np.zeros(new_cap, dtype=np.int32)
+        req = np.zeros((new_cap, P, R), dtype=np.int64)
+        has_req = np.zeros((new_cap, P, R), dtype=bool)
+        unsat = np.zeros((new_cap, P), dtype=bool)
+        elig = np.zeros((new_cap, P, G, S), dtype=bool)
+        p_count = np.zeros(new_cap, dtype=np.int32)
+        if old:
+            wl_cq[:old] = self.wl_cq
+            req[:old] = self.req
+            has_req[:old] = self.has_req
+            unsat[:old] = self.unsat
+            elig[:old] = self.elig
+            p_count[:old] = self.p_count
+        self.wl_cq, self.req, self.has_req = wl_cq, req, has_req
+        self.unsat, self.elig, self.p_count = unsat, elig, p_count
+        self._free.extend(range(new_cap - 1, old - 1, -1))
+        self._rev.extend([-1] * (new_cap - old))
+        self._uid.extend([None] * (new_cap - old))
+        self._req_sets.extend([()] * (new_cap - old))
+        self.cap = new_cap
+
+    def _grow_podsets(self, new_p: int) -> None:
+        """Widen the pool's P axis in place (a multi-podset shape arrived);
+        existing rows keep their content — the new columns are the zero
+        padding a from-scratch encode would produce."""
+        P, R, G, S = self.P, self.R, self.G, self.S
+        cap = self.cap
+
+        def widen(a, shape):
+            out = np.zeros(shape, dtype=a.dtype)
+            out[:, :P] = a
+            return out
+
+        self.req = widen(self.req, (cap, new_p, R))
+        self.has_req = widen(self.has_req, (cap, new_p, R))
+        self.unsat = widen(self.unsat, (cap, new_p))
+        self.elig = widen(self.elig, (cap, new_p, G, S))
+        self.P = new_p
+
+    # -- dirty deltas (queue-manager events + gather misses) ----------------
+
+    def note(self, wi: WorkloadInfo) -> None:
+        """Encode (or refresh) one pending workload's row — the queue
+        manager's add/update event. Runs OFF the measured tick (submit /
+        requeue-update paths), so the tick's gather is all row reuse."""
+        with self._lock:
+            self._note_locked(wi, self._snapshot)
+
+    def forget(self, uid: str) -> None:
+        """Free a workload's row (queue-manager delete event)."""
+        with self._lock:
+            row = self._rows.pop(uid, None)
+            if row is not None:
+                self._rev[row] = -1
+                self._uid[row] = None
+                self._req_sets[row] = ()
+                self._free.append(row)
+
+    def seed(self, infos: Sequence[WorkloadInfo]) -> None:
+        """Bulk-encode a backlog (arena rebuild): every pending workload
+        gets a row NOW, off the measured path, so the next ticks' heads
+        are pure reuse even when admissions keep revealing
+        never-popped-before heap heads."""
+        with self._lock:
+            snapshot = self._snapshot
+            for wi in infos:
+                self._note_locked(wi, snapshot)
+
+    def _note_locked(self, wi: WorkloadInfo,
+                     snapshot: Snapshot) -> Optional[int]:
+        cq = snapshot.cluster_queues.get(wi.cluster_queue)
+        if cq is None:
+            # Unknown CQ: either inactive (the workload can never be a
+            # solvable head while it stays so) or newer than this
+            # encoding generation (the rotation will rebuild the arena).
+            return None
+        totals = wi.total_requests
+        p = len(totals)
+        if p > self.P:
+            self._grow_podsets(p)
+        uid = wi.obj.uid
+        row = self._rows.get(uid)
+        if row is None:
+            if not self._free:
+                self._grow(self.cap * 2)
+            row = self._free.pop()
+            self._rows[uid] = row
+        enc_row = _encode_row(wi, cq, snapshot, self.enc, totals)
+        self.wl_cq[row] = enc_row.ci
+        self.req[row] = 0
+        self.has_req[row] = False
+        self.unsat[row] = False
+        self.elig[row] = False
+        if p:
+            self.req[row, :p] = enc_row.req
+            self.has_req[row, :p] = enc_row.has_req
+            self.unsat[row, :p] = enc_row.unsat
+            self.elig[row, :p] = enc_row.elig
+        self.p_count[row] = p
+        self._rev[row] = wi.rev
+        self._uid[row] = uid
+        self._req_sets[row] = tuple(enc_row.requests_per_podset)
+        self.rows_encoded += 1
+        return row
+
+    # -- the tick's batch ---------------------------------------------------
+
+    def gather(self, workloads: Sequence[WorkloadInfo], snapshot: Snapshot,
+               min_podsets: int = 1):
+        """Assemble the padded batch tensors for this tick's heads from
+        the pooled rows. Returns (WorkloadTensors, stats) where stats
+        carries `rows_dirty` (rows (re-)encoded by this gather — misses),
+        and `rows_total`. Byte-identical to
+        `encode_workloads(workloads, snapshot, enc, min_podsets=...)`."""
+        n = len(workloads)
+        with self._lock:
+            # Event-time encodes use the arena's pinned structural view;
+            # gather-time misses must use the CALLER's snapshot (the one
+            # the tick solves against) exactly like encode_workloads.
+            self._snapshot = snapshot
+            dirty = 0
+            rows_py: List[int] = []
+            rows_append = rows_py.append
+            rows_map = self._rows
+            revs = self._rev
+            cqs_by_name = snapshot.cluster_queues
+            # Heads carrying live resume state, collected inline (the
+            # same staleness drop as encode_workloads /
+            # flavorassigner.go:244-247) so the second pass below walks
+            # only the few losers instead of the whole batch.
+            resume_entries: List[tuple] = []
+            for i, wi in enumerate(workloads):
+                row = rows_map.get(wi.obj.uid)
+                if row is None or revs[row] != wi.rev:
+                    row = self._note_locked(wi, snapshot)
+                    if row is None:
+                        # encode_workloads would KeyError on an unknown
+                        # CQ too; solvable heads always have one.
+                        raise KeyError(wi.cluster_queue)
+                    dirty += 1
+                rows_append(row)
+                last = wi.last_assignment
+                if last is not None:
+                    cq = cqs_by_name[wi.cluster_queue]
+                    cohort = cq.cohort
+                    if not (cq.allocatable_generation
+                            > last.cluster_queue_generation
+                            or (cohort is not None
+                                and cohort.allocatable_generation
+                                > last.cohort_generation)):
+                        resume_entries.append((i, row, cq, last))
+            self.rows_reused += n - dirty
+            self.rows_missed += dirty
+            rows = np.asarray(rows_py, dtype=np.int64)
+
+            W = _pad_pow2(max(n, 1))
+            P = max(1, min_podsets)
+            if n:
+                pc = self.p_count[rows]
+                p_max = int(pc.max()) if n else 0
+                if p_max > P:
+                    P = p_max
+            if P > self.P:
+                # The sticky P floor can outgrow the pool (a multi-podset
+                # shape seen only by the counts path, which bypasses the
+                # arena); widen so the slice below stays exact.
+                self._grow_podsets(P)
+            R, G, S = self.R, self.G, self.S
+
+            wl_cq = np.zeros(W, dtype=np.int32)
+            req = np.zeros((W, P, R), dtype=np.int64)
+            has_req = np.zeros((W, P, R), dtype=bool)
+            podset_valid = np.zeros((W, P), dtype=bool)
+            podset_unsat = np.zeros((W, P), dtype=bool)
+            elig = np.zeros((W, P, G, S), dtype=bool)
+            resume_slot = np.zeros((W, P, G), dtype=np.int32)
+            wl_valid = np.zeros(W, dtype=bool)
+            wl_valid[:n] = True
+            if n:
+                wl_cq[:n] = self.wl_cq[rows]
+                req[:n] = self.req[rows, :P]
+                has_req[:n] = self.has_req[rows, :P]
+                podset_unsat[:n] = self.unsat[rows, :P]
+                podset_valid[:n] = np.arange(P)[None, :] < pc[:, None]
+                elig[:n] = self.elig[rows, :P]
+
+            req_sets = self._req_sets
+            for i, row, cq, last in resume_entries:
+                for p, requested in enumerate(req_sets[row]):
+                    for gi, rg in enumerate(cq.resource_groups):
+                        for rname in rg.covered_resources:
+                            if rname in requested:
+                                resume_slot[i, p, gi] = \
+                                    last.next_flavor_to_try(p, rname)
+                                break
+
+        wt = WorkloadTensors(
+            wl_cq=wl_cq, req=req, has_req=has_req,
+            podset_valid=podset_valid, podset_unsat=podset_unsat,
+            elig=elig, resume_slot=resume_slot, wl_valid=wl_valid,
+            num_real=n)
+        if self.debug_verify:
+            self.verify(wt, workloads, snapshot, min_podsets)
+        return wt, {"rows_dirty": dirty, "rows_total": n}
+
+    def verify(self, wt: WorkloadTensors,
+               workloads: Sequence[WorkloadInfo], snapshot: Snapshot,
+               min_podsets: int) -> None:
+        """Assert a gathered batch equals the from-scratch encode; raises
+        AssertionError naming the first diverging tensor field."""
+        ref = encode_workloads(workloads, snapshot, self.enc,
+                               min_podsets=min_podsets)
+        for name in ("wl_cq", "req", "has_req", "podset_valid",
+                     "podset_unsat", "elig", "resume_slot", "wl_valid"):
+            a = getattr(wt, name)
+            b = getattr(ref, name)
+            if a.shape != b.shape or not np.array_equal(a, b):
+                raise AssertionError(
+                    f"WorkloadArena drift: gathered `{name}` does not "
+                    "match the from-scratch encode (event/row staleness "
+                    "bug — a queue mutation bypassed the arena events)")

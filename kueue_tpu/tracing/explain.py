@@ -44,15 +44,25 @@ class ExplainStore:
         self._records: "OrderedDict[str, deque]" = OrderedDict()
 
     def record(self, key: str, rec: tuple) -> None:
+        self.record_bulk(((key, rec),))
+
+    def record_bulk(self, items) -> None:
+        """Append [(key, rec)] under ONE lock acquisition — the scheduler
+        lands one record per entry per tick (a thousand at scale), and
+        the per-record lock/LRU churn dominated `record` otherwise."""
         with self._lock:
-            dq = self._records.get(key)
-            if dq is None:
-                dq = self._records[key] = deque(maxlen=self.per_workload)
-                if len(self._records) > self.max_workloads:
-                    self._records.popitem(last=False)
-            else:
-                self._records.move_to_end(key)
-            dq.append(rec)
+            records = self._records
+            per = self.per_workload
+            max_workloads = self.max_workloads
+            for key, rec in items:
+                dq = records.get(key)
+                if dq is None:
+                    dq = records[key] = deque(maxlen=per)
+                    if len(records) > max_workloads:
+                        records.popitem(last=False)
+                else:
+                    records.move_to_end(key)
+                dq.append(rec)
 
     def forget(self, key: str) -> None:
         with self._lock:

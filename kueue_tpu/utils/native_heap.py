@@ -95,6 +95,13 @@ class NativeKeyedHeap(Generic[T]):
         self._next_id = 0
         self._id_by_key: Dict[str, int] = {}
         self._obj_by_id: Dict[int, T] = {}
+        # Reverse map so pop/delete skip the key_fn property chain (the
+        # heads sweep pops one item per ClusterQueue per tick).
+        self._key_by_id: Dict[int, str] = {}
+        # Reusable key buffer: the C side copies the key on push, so one
+        # buffer per heap serves every call — constructing a fresh ctypes
+        # array per push dominated the requeue sweep at scale.
+        self._keybuf = (ctypes.c_int64 * (key_len + 1))()
 
     def __del__(self):
         try:
@@ -109,9 +116,16 @@ class NativeKeyedHeap(Generic[T]):
         return key in self._id_by_key
 
     def _ckey(self, item: T, item_id: int):
-        vec = tuple(self._sort_key_fn(item))
-        assert len(vec) == self._key_len
-        return (ctypes.c_int64 * (self._key_len + 1))(*vec, item_id)
+        vec = self._sort_key_fn(item)
+        buf = self._keybuf
+        i = 0
+        for v in vec:
+            buf[i] = v
+            i += 1
+        if i != self._key_len:
+            raise ValueError(f"sort key length {i} != {self._key_len}")
+        buf[i] = item_id
+        return buf
 
     def _id_for(self, key: str) -> int:
         i = self._id_by_key.get(key)
@@ -119,6 +133,7 @@ class NativeKeyedHeap(Generic[T]):
             i = self._next_id
             self._next_id += 1
             self._id_by_key[key] = i
+            self._key_by_id[i] = key
         return i
 
     def get_by_key(self, key: str) -> Optional[T]:
@@ -153,6 +168,7 @@ class NativeKeyedHeap(Generic[T]):
             return None
         obj = self._obj_by_id.pop(i)
         del self._id_by_key[key]
+        self._key_by_id.pop(i, None)
         return obj
 
     def peek(self) -> Optional[T]:
@@ -164,5 +180,5 @@ class NativeKeyedHeap(Generic[T]):
         if i == _EMPTY:
             return None
         obj = self._obj_by_id.pop(i)
-        del self._id_by_key[self._key_fn(obj)]
+        del self._id_by_key[self._key_by_id.pop(i)]
         return obj

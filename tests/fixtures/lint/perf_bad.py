@@ -1,0 +1,31 @@
+"""PERF01 fixtures: per-workload Python loops over solver output tensors."""
+
+import numpy as np
+
+
+def decode_slow(workloads, out):
+    # Direct element-wise read of an output tensor inside the loop.
+    modes = []
+    for w in range(len(workloads)):
+        modes.append(out["wl_mode"][w])  # finding: direct subscript
+    return modes
+
+
+def decode_alias_slow(workloads, out):
+    n = len(workloads)
+    ps_ok = out["ps_ok"][:n]
+    flavors = out["res_flavor"]
+    picked = []
+    for w, wi in enumerate(workloads):
+        if ps_ok[w].all():  # finding: aliased tensor, loop-var index
+            picked.append(flavors[w])  # finding
+    return picked
+
+
+def flush_slow(entries, out):
+    total = 0
+    i = 0
+    while i < len(entries):
+        total += int(out["ps_mode"][i])  # finding: while-loop counter
+        i += 1
+    return total

@@ -1,0 +1,31 @@
+"""PERF01 negative fixtures: the sanctioned vectorized/tolist patterns."""
+
+import numpy as np
+
+
+def decode_vectorized(workloads, out):
+    n = len(workloads)
+    ps_ok = out["ps_ok"][:n]
+    # Whole-tensor numpy work outside any loop: fine.
+    ws, pp = np.nonzero(ps_ok)
+    flavors = out["res_flavor"][:n][ws, pp]
+    return ws, flavors
+
+
+def decode_tolist(workloads, out):
+    n = len(workloads)
+    # One materialization, then plain-list iteration: fine.
+    modes_l = out["wl_mode"][:n].tolist()
+    picked = []
+    for w, mode in enumerate(modes_l):
+        if mode > 0:
+            picked.append((w, modes_l[w]))
+    return picked
+
+
+def unrelated_loop(rows, table):
+    # Subscripting non-tensor containers in a loop: fine.
+    out = []
+    for r in rows:
+        out.append(table[r])
+    return out

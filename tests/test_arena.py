@@ -1,0 +1,224 @@
+"""Differential goldens for the incremental host pipeline (WorkloadArena).
+
+Drives 200 randomized ticks of add/admit/preempt/delete churn through the
+REAL Framework twice — once with the persistent workload tensor arena
+(the incremental encode), once with the from-scratch `encode_workloads`
+path — and asserts the two produce IDENTICAL admission decisions tick by
+tick. The arena run additionally executes with `debug_verify` on, so
+every gather is tensor-compared against a from-scratch encode in-line:
+one scenario pins both halves of the contract ("identical tensors" and
+"identical decisions").
+
+The decision comparison is parametrized over every registered
+victim-search engine (solver/modes.ENGINES), mapped onto the scheduler's
+`preemption_engine` knob — host referee, lax.scan, Pallas-interpret, and
+the batched native/XLA engines all replay the same stream.
+"""
+
+import random
+
+import pytest
+
+from kueue_tpu.api.types import ClusterQueuePreemption, PodSet, Workload
+from kueue_tpu.config import Configuration, TPUSolverConfig
+from kueue_tpu.controllers.runtime import Framework
+from kueue_tpu.models.flavor_fit import BatchSolver
+from kueue_tpu.solver import modes as _modes
+from kueue_tpu.solver import schema as sch
+
+from tests.util import fq, make_cq, make_flavor, make_lq, rg
+
+TICKS = 200
+
+# Registered engine -> the scheduler's preemption_engine knob. The
+# coverage meta-test pins the registry; this map must name every entry
+# (test_registry_covered below fails when a new engine lands unmapped).
+_ENGINE_KNOB = {
+    "host": None,
+    "scan-jax": "jax",
+    "scan-pallas": "pallas",
+    "batch-native": "native",
+    "batch-jax": "jax",
+}
+
+_KNOBS = []
+for _spec in _modes.ENGINES:
+    if _spec.optional_import and not _modes.engine_importable(_spec):
+        continue
+    knob = _ENGINE_KNOB[_spec.name]
+    if knob not in _KNOBS:
+        _KNOBS.append(knob)
+
+
+def test_registry_covered():
+    assert set(_ENGINE_KNOB) == {e.name for e in _modes.ENGINES}, \
+        "new victim-search engine registered; map it onto a " \
+        "preemption_engine knob here so the arena differential runs it"
+
+
+def build(use_arena: bool, engine):
+    cfg = Configuration(tpu_solver=TPUSolverConfig(
+        preemption_engine="host" if engine is None else engine))
+    fw = Framework(batch_solver=BatchSolver(use_arena=use_arena),
+                   config=cfg)
+    fw.create_namespace("default", labels={})
+    fw.create_resource_flavor(make_flavor("on-demand", zone="a"))
+    fw.create_resource_flavor(make_flavor("spot", zone="b"))
+    for i in range(4):
+        fw.create_cluster_queue(make_cq(
+            f"cq-{i}",
+            rg("cpu", fq("on-demand", cpu=(16, 16)), fq("spot", cpu=(8, 8))),
+            cohort=f"cohort-{i % 2}",
+            preemption=ClusterQueuePreemption(
+                within_cluster_queue="LowerPriority",
+                reclaim_within_cohort="Any")))
+        fw.create_local_queue(make_lq(f"lq-{i}", "default", cq=f"cq-{i}"))
+    return fw
+
+
+def drive(use_arena: bool, engine, ticks: int = TICKS):
+    """Run the seeded churn stream; returns the per-tick decision trail."""
+    fw = build(use_arena, engine)
+    rnd = random.Random(1234)
+    seq = [0]
+    pending: dict = {}
+    admitted: dict = {}
+    trail = []
+
+    orig_admit = fw.scheduler.apply_admission
+    orig_preempt = fw.scheduler.apply_preemption
+    tick_admitted: list = []
+    tick_preempted: list = []
+
+    def apply_admission(wl):
+        ok = orig_admit(wl)
+        if ok:
+            tick_admitted.append(wl.key)
+            admitted[wl.key] = wl
+            pending.pop(wl.key, None)
+        return ok
+
+    def apply_preemption(wl, msg):
+        tick_preempted.append(wl.key)
+        return orig_preempt(wl, msg)
+
+    fw.scheduler.apply_admission = apply_admission
+    fw.scheduler.apply_preemption = apply_preemption
+
+    def submit_one():
+        seq[0] += 1
+        i = seq[0]
+        sel = {"zone": rnd.choice(["a", "b"])} if i % 5 == 0 else None
+        n_ps = 2 if i % 7 == 0 else 1
+        wl = Workload(
+            name=f"wl-{i}", namespace="default",
+            queue_name=f"lq-{rnd.randrange(4)}",
+            priority=rnd.randint(-2, 3),
+            creation_time=float(1000 + i),
+            pod_sets=[PodSet.make(f"ps{p}", count=rnd.randint(1, 3),
+                                  cpu=rnd.randint(1, 4),
+                                  node_selector=sel)
+                      for p in range(n_ps)])
+        pending[wl.key] = wl
+        fw.submit(wl)
+
+    for _ in range(40):
+        submit_one()
+
+    for tick in range(ticks):
+        tick_admitted.clear()
+        tick_preempted.clear()
+        fw.tick()
+        trail.append((tuple(sorted(tick_admitted)),
+                      tuple(sorted(tick_preempted))))
+        # Churn: arrivals, pending deletes, admitted finishes — seeded,
+        # so identical decisions keep the two streams identical.
+        for _ in range(rnd.randint(0, 3)):
+            submit_one()
+        if pending and rnd.random() < 0.3:
+            key = rnd.choice(sorted(pending))
+            wl = pending.pop(key)
+            if not wl.is_admitted:
+                fw.delete_workload(wl)
+            else:
+                pending.pop(key, None)
+        done = [k for k, w in sorted(admitted.items())
+                if w.is_admitted and not w.is_finished]
+        for key in done[:rnd.randint(0, 4)]:
+            wl = admitted.pop(key)
+            fw.finish(wl)
+            fw.delete_workload(wl)
+        # Preempted (evicted) workloads requeue through the reconcile
+        # pass; drop them from the admitted set so churn never finishes
+        # an evicted workload.
+        for key in list(admitted):
+            if not admitted[key].is_admitted:
+                wl = admitted.pop(key)
+                if not wl.is_finished:
+                    pending[key] = wl
+        fw.prewarm_idle()
+
+    trail.append(("pending", sum(fw.queues.pending(f"cq-{i}")
+                                 for i in range(4))))
+    return trail
+
+
+@pytest.mark.parametrize("engine", _KNOBS,
+                         ids=[str(k) for k in _KNOBS])
+def test_incremental_vs_fullrebuild_decisions_identical(engine,
+                                                        monkeypatch):
+    # The arena run verifies EVERY gather against a from-scratch encode
+    # (tensor identity), and the decision trails must match byte for
+    # byte across 200 randomized churn ticks.
+    monkeypatch.setattr(sch.WorkloadArena, "debug_verify", True)
+    with_arena = drive(True, engine)
+    monkeypatch.setattr(sch.WorkloadArena, "debug_verify", False)
+    without = drive(False, engine)
+    assert with_arena == without
+
+
+def test_arena_reuses_rows_across_ticks():
+    """Steady-state gathers are row reuse, not re-encodes (the >0.9
+    reuse contract the bench gates on, pinned at test scale)."""
+    fw = build(True, None)
+    rnd = random.Random(7)
+    for i in range(60):
+        fw.submit(Workload(
+            name=f"w-{i}", namespace="default",
+            queue_name=f"lq-{rnd.randrange(4)}",
+            priority=rnd.randint(-2, 3), creation_time=float(i),
+            pod_sets=[PodSet.make("ps0", count=1, cpu=1)]))
+    for _ in range(12):
+        fw.tick()
+    solver = fw.scheduler.batch_solver
+    reused0, missed0 = solver.arena_rows_reused, solver.arena_rows_missed
+    for _ in range(10):
+        fw.tick()
+    reused = solver.arena_rows_reused - reused0
+    missed = solver.arena_rows_missed - missed0
+    assert reused > 0
+    assert reused / max(reused + missed, 1) > 0.9
+    assert solver.arena_full_rebuilds == 1  # the initial build only
+
+
+def test_arena_full_rebuild_on_structure_change():
+    """A structural mutation (new CQ) rotates the encoding and rebuilds
+    the arena; decisions keep flowing and rows re-seed."""
+    fw = build(True, None)
+    for i in range(10):
+        fw.submit(Workload(
+            name=f"w-{i}", namespace="default", queue_name="lq-0",
+            priority=0, creation_time=float(i),
+            pod_sets=[PodSet.make("ps0", count=1, cpu=1)]))
+    fw.tick()
+    solver = fw.scheduler.batch_solver
+    assert solver.arena_full_rebuilds == 1
+    fw.create_cluster_queue(make_cq(
+        "cq-new", rg("cpu", fq("on-demand", cpu=4))))
+    fw.create_local_queue(make_lq("lq-new", "default", cq="cq-new"))
+    fw.submit(Workload(name="nw", namespace="default", queue_name="lq-new",
+                       priority=0, creation_time=99.0,
+                       pod_sets=[PodSet.make("ps0", count=1, cpu=1)]))
+    fw.tick()
+    assert solver.arena_full_rebuilds == 2
+    assert solver.arena_rows_encoded > 0

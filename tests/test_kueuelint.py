@@ -185,6 +185,33 @@ def test_obs_good_fixture():
     assert run_analysis([str(FIXTURES / "obs_good.py")]) == []
 
 
+def test_perf_bad_fixture():
+    findings = run_analysis([str(FIXTURES / "perf_bad.py")])
+    perf = [f for f in findings if f.rule == "PERF01"]
+    # direct subscript + 2 aliased reads + while-counter read
+    assert len(perf) == 4
+    assert all("solver output tensor" in f.message for f in perf)
+    assert all(f.severity.label == "error" for f in perf)
+
+
+def test_perf_good_fixture():
+    assert run_analysis([str(FIXTURES / "perf_good.py")]) == []
+
+
+def test_perf_rule_scoped_to_solver_packages(tmp_path):
+    # The same loop shape OUTSIDE scheduler//solver//models/ (analysis
+    # tooling, tests, benchmarks post-processing) is not PERF01's
+    # business.
+    other = tmp_path / "report_tool.py"
+    other.write_text(
+        "def summarize(out, n):\n"
+        "    rows = []\n"
+        "    for w in range(n):\n"
+        "        rows.append(out['wl_mode'][w])\n"
+        "    return rows\n")
+    assert run_analysis([str(other)]) == []
+
+
 def test_obs_rule_scoped_to_tick_pipeline(tmp_path):
     # The same raw timing OUTSIDE the pipeline paths is none of OBS01's
     # business (CLI glue, benchmarks, tests keep their perf_counters).

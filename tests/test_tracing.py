@@ -223,6 +223,28 @@ def test_traced_tick_contains_pipeline_phases():
         and isinstance(ev["args"]["bucket"], list)
         and isinstance(ev["args"]["cold_dispatches"], int)
         for ev in tens)
+    # The encode span carries the incremental-arena evidence: how many
+    # rows this tick's gather re-encoded vs its total, and whether the
+    # arena was rebuilt wholesale (encoding rotation).
+    enc = [ev for ev in doc["traceEvents"]
+           if ev["name"] == "tensorize.encode" and ev["ph"] == "X"]
+    assert enc and all(
+        isinstance(ev["args"]["rows_dirty"], int)
+        and isinstance(ev["args"]["rows_total"], int)
+        and isinstance(ev["args"]["full_rebuild"], bool)
+        and ev["args"]["rows_dirty"] <= ev["args"]["rows_total"]
+        for ev in enc)
+    # At least one gather ran against an already-seeded arena: pure reuse.
+    assert any(ev["args"]["rows_dirty"] == 0 and ev["args"]["rows_total"]
+               for ev in enc)
+    # The snapshot delta-flush span reports its ClusterQueue fan-out.
+    flushes = [ev for ev in doc["traceEvents"]
+               if ev["name"] == "snapshot.flush" and ev["ph"] == "X"]
+    assert flushes and all(
+        isinstance(ev["args"]["cqs_flushed"], int)
+        and isinstance(ev["args"]["items"], int)
+        and 0 < ev["args"]["cqs_flushed"] <= ev["args"]["items"]
+        for ev in flushes)
 
 
 # ---------------------------------------------------------------------------
