@@ -55,14 +55,26 @@ bench-smoke:
 	  from bench import METRIC_NAMES; \
 	  lines = [json.loads(l) for l in open('/tmp/kueue-bench-smoke.jsonl') \
 	           if l.strip().startswith('{')]; \
-	  ratios = {l['metric']: l.get('arena_reuse_ratio') for l in lines}; \
-	  missing = set(METRIC_NAMES.values()) - set(ratios); \
+	  by = {l['metric']: l for l in lines}; \
+	  missing = set(METRIC_NAMES.values()) - set(by); \
 	  assert not missing, f'configs missing from BENCH output: {missing}'; \
-	  bad = {m: r for m, r in ratios.items() if r is None or r <= 0.9}; \
+	  steady = METRIC_NAMES['steady']; \
+	  ratios = {m: l.get('arena_reuse_ratio') for m, l in by.items()}; \
+	  bad = {m: r for m, r in ratios.items() \
+	         if (r is None or r <= 0.9) and m != steady}; \
 	  assert not bad, f'arena_reuse_ratio <= 0.9: {bad}'; \
-	  rebuilds = {l['metric']: l.get('arena_full_rebuilds') for l in lines}; \
+	  rebuilds = {m: l.get('arena_full_rebuilds') for m, l in by.items()}; \
 	  assert not any(rebuilds.values()), f'full rebuilds in window: {rebuilds}'; \
-	  print('bench-smoke arena gate OK:', ratios)"
+	  hit = by[steady].get('nominate_cache_hit_ratio'); \
+	  assert hit is None or hit > 0.8, \
+	    f'steady-state nominate_cache_hit_ratio <= 0.8: {hit}'; \
+	  assert by[steady].get('solver_dispatches') == 0, \
+	    f'quiescent window dispatched solves: {by[steady]}'; \
+	  assert by[steady].get('quiescent_tick_ms') is not None, \
+	    'quiescent_tick_ms missing from the steady config'; \
+	  print('bench-smoke arena gate OK:', ratios); \
+	  print('bench-smoke steady gate OK: hit_ratio', hit, \
+	        'quiescent_tick_ms', by[steady].get('quiescent_tick_ms'))"
 
 # End-to-end tracing smoke: drive the real CLI with span tracing on,
 # then prove the exported file is valid Chrome trace-event JSON (the

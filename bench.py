@@ -49,7 +49,8 @@ LINGER_TICKS = (4, 5, 6)
 
 def run_config(*, label, num_cqs, num_cohorts, num_flavors, backlog, ticks,
                usage_fill, depth, preemption_heavy, fair_hierarchy=False,
-               lending=False, topology=False, seed=42):
+               lending=False, topology=False, strict_fifo=False,
+               no_preemption=False, churn_enabled=True, seed=42):
     from kueue_tpu.models.flavor_fit import BatchSolver
     from kueue_tpu.api.types import PodSet, Workload
     from kueue_tpu.utils.synthetic import synthetic_framework
@@ -65,7 +66,8 @@ def run_config(*, label, num_cqs, num_cohorts, num_flavors, backlog, ticks,
         num_cqs=num_cqs, num_cohorts=num_cohorts, num_flavors=num_flavors,
         num_pending=backlog, usage_fill=usage_fill, seed=seed,
         preemption_heavy=preemption_heavy, fair_hierarchy=fair_hierarchy,
-        lending=lending, topology=topology,
+        lending=lending, topology=topology, strict_fifo=strict_fifo,
+        no_preemption=no_preemption,
         batch_solver=BatchSolver(), pipeline_depth=depth)
     t_setup = time.perf_counter() - t0
 
@@ -139,14 +141,18 @@ def run_config(*, label, num_cqs, num_cohorts, num_flavors, backlog, ticks,
         """Completion flux: finish workloads whose linger expired, then
         delete them (the owning job's GC in the reference deletes the
         Workload object; without it the object population would grow
-        unboundedly, which no real cluster does)."""
-        for log in admitted_logs:
-            while log and log[0][0] <= tick_no[0]:
-                _, wl = log.popleft()
-                if wl.is_admitted and not wl.is_finished:
-                    fw.finish(wl)
-                    fw.delete_workload(wl)
-                    submit_replacement()
+        unboundedly, which no real cluster does). The steady-state
+        config runs with the flux off (churn_enabled=False): after the
+        warmup saturates the quotas, nothing changes between ticks and
+        every tick is quiescent."""
+        if churn_enabled:
+            for log in admitted_logs:
+                while log and log[0][0] <= tick_no[0]:
+                    _, wl = log.popleft()
+                    if wl.is_admitted and not wl.is_finished:
+                        fw.finish(wl)
+                        fw.delete_workload(wl)
+                        submit_replacement()
         # Idle-window bucket prewarm (untimed, like the production serve
         # loop's inter-tick gap): imminent head-count bucket rotations
         # compile here instead of inside a measured tick.
@@ -162,6 +168,31 @@ def run_config(*, label, num_cqs, num_cohorts, num_flavors, backlog, ticks,
         tick_no[0] += 1
         fw.tick()
         churn()
+    if not churn_enabled:
+        # Quiescent-window warmup: keep ticking until the backlog has
+        # saturated every quota and a whole tick dispatches no solve
+        # (every head replays its fingerprint-cached verdict). The
+        # measured window then certifies the "nothing-changed ticks cost
+        # nothing" contract.
+        solver0 = fw.scheduler.batch_solver
+        quiet = 0
+        for _ in range(300):
+            before_d = solver0.dispatches
+            tick_no[0] += 1
+            fw.tick()
+            churn()
+            # Require a full window of consecutive quiescent ticks: the
+            # resume-from-last-flavor protocol cycles each NoFit head
+            # through a short fingerprint loop, and every arm of the
+            # loop must be cached before the window is dispatch-free.
+            quiet = quiet + 1 if solver0.dispatches == before_d else 0
+            if quiet >= max(8, depth + 2):
+                break
+        else:
+            raise RuntimeError(
+                f"[{label}] the churn-free warmup never reached a "
+                "quiescent window (a solve kept dispatching): the "
+                "nominate cache is not replaying unchanged heads")
 
     # Long-running-scheduler GC discipline: the permanent objects (50k
     # workloads, the mirror) are frozen into the permanent generation and
@@ -201,6 +232,11 @@ def run_config(*, label, num_cqs, num_cohorts, num_flavors, backlog, ticks,
         if solver else 0
     arena_rebuilds_before = getattr(solver, "arena_full_rebuilds", 0) \
         if solver else 0
+    nom_hits_before = getattr(solver, "nominate_cache_hits", 0) \
+        if solver else 0
+    nom_misses_before = getattr(solver, "nominate_cache_misses", 0) \
+        if solver else 0
+    dispatches_before = getattr(solver, "dispatches", 0) if solver else 0
     tick_phases = []
     base_admitted = fw.scheduler.metrics.admitted
 
@@ -286,9 +322,35 @@ def run_config(*, label, num_cqs, num_cohorts, num_flavors, backlog, ticks,
     # Reuse ratio over the GATHER path: rows served from the arena vs
     # rows a tick had to re-encode in-line (misses). Event-time encodes
     # (churn arrivals, noted in the untimed completion-flux slot) are the
-    # design — they appear in encoded_rows_delta, not as misses.
+    # design — they appear in encoded_rows_delta, not as misses. A fully
+    # quiescent window gathers nothing at all (every head replayed its
+    # cached verdict), leaving the ratio None.
     arena_reuse_ratio = (arena_reused / (arena_reused + arena_missed)
                          if arena_reused + arena_missed else None)
+    # Fingerprinted-nominate evidence: heads replayed vs re-solved, and
+    # how many ticks actually dispatched a device solve.
+    nom_hits = (getattr(solver, "nominate_cache_hits", 0)
+                - nom_hits_before if solver else 0)
+    nom_misses = (getattr(solver, "nominate_cache_misses", 0)
+                  - nom_misses_before if solver else 0)
+    nominate_cache_hit_ratio = (nom_hits / (nom_hits + nom_misses)
+                                if nom_hits + nom_misses else None)
+    dispatches_during = (getattr(solver, "dispatches", 0)
+                         - dispatches_before if solver else 0)
+    quiescent_tick_ms = None
+    if not churn_enabled:
+        # Steady-state window: p50 IS the quiescent tick (the warmup
+        # asserted quiescence before measuring), and a dispatched solve
+        # inside the window means a fingerprint invalidated spuriously.
+        quiescent_tick_ms = p50
+        if dispatches_during:
+            raise RuntimeError(
+                f"[{label}] {dispatches_during} solve dispatch(es) inside "
+                "the quiescent measured window: nothing changed between "
+                "ticks, so every head must replay its fingerprint-cached "
+                "verdict without touching the device. A dispatch here "
+                "means a generation counter moved spuriously (or the "
+                "nominate cache dropped entries).")
 
     # Tracer-overhead gate (north-star config): p99 with tracing at
     # default sampling must sit within 2% of tracing-off — the no-op
@@ -355,6 +417,16 @@ def run_config(*, label, num_cqs, num_cohorts, num_flavors, backlog, ticks,
         "arena_full_rebuilds": arena_rebuilds,
         "arena_full_rebuilds_total": getattr(
             solver, "arena_full_rebuilds", 0) if solver else 0,
+        # Fingerprinted-nominate evidence (tentpole: unchanged heads skip
+        # tensorize/solve/decode; make bench-smoke gates the steady
+        # config's ratio > 0.8 and its window at zero dispatches).
+        "nominate_cache_hit_ratio": (round(nominate_cache_hit_ratio, 4)
+                                     if nominate_cache_hit_ratio is not None
+                                     else None),
+        "nominate_cache_hits": nom_hits,
+        "solver_dispatches": dispatches_during,
+        "quiescent_tick_ms": (round(quiescent_tick_ms, 3)
+                              if quiescent_tick_ms is not None else None),
         "admissions_per_s": round(admitted / (sum(times) or 1e-9), 1),
         # Derived from tracer phase spans (the kueue_tick_phase_seconds
         # histogram is fed exclusively by TRACER.phase — one measurement
@@ -392,6 +464,7 @@ METRIC_NAMES = {
     "preempt": "p99_preemption_tick_ms",
     "fair": "p99_fair_hier_tick_ms",
     "topo": "p99_topology_tick_ms",
+    "steady": "p99_steady_state_tick_ms",
     "northstar": "p99_e2e_tick_ms",
 }
 
@@ -465,6 +538,19 @@ def run_one(config: str) -> None:
             backlog=min(5000, shape["backlog"]),
             ticks=max(ticks // 2, 8), usage_fill=0.7, depth=depth,
             preemption_heavy=False, lending=True))
+    elif config == "steady":
+        # Steady-state northstar shape with the completion flux OFF and
+        # StrictFIFO queues: after warmup saturates the quotas the same
+        # heads re-pop every tick with nothing changed — the
+        # "nothing-changed ticks cost nothing" window. Gates: the
+        # measured window must dispatch zero solves (asserted inside
+        # run_config) and bench-smoke additionally requires
+        # nominate_cache_hit_ratio > 0.8.
+        emit(METRIC_NAMES[config], run_config(
+            label="steady", ticks=max(ticks // 2, 8), usage_fill=1.0,
+            depth=depth, preemption_heavy=False, strict_fifo=True,
+            no_preemption=True, churn_enabled=False, **shape),
+            target_ms=15.0)
     else:
         # North-star headline (config #5 shape): LAST line = parsed metric.
         emit(METRIC_NAMES["northstar"], run_config(
@@ -505,7 +591,7 @@ def main() -> None:
               "backend for this run", file=sys.stderr)
         env_extra["KUEUE_BENCH_FORCE_CPU"] = "1"
     for config in ("single", "cohortlend", "preempt", "fair", "topo",
-                   "northstar"):
+                   "steady", "northstar"):
         env = dict(os.environ, KUEUE_BENCH_CONFIG=config, **env_extra)
         try:
             # Generous ceiling: a healthy config finishes in minutes; a

@@ -10,7 +10,8 @@ that the vectorized paths (np.nonzero / gathers / `batch_usage_csr` /
 `csr_gather`) exist to avoid.
 
 PERF01 flags, inside the solver-adjacent packages (scheduler/, solver/,
-models/):
+models/) and the accounting files whose flush/assume paths now consume
+the solve's CSR coordinates (core/cache.py, core/snapshot.py):
 
   * a `for`/`while` loop body subscripting a solver output tensor with
     the loop variable — directly (`out["ps_ok"][w]`) or through a local
@@ -30,7 +31,8 @@ from typing import Set
 from kueue_tpu.analysis.core import (
     AnalysisContext, Rule, Severity, SourceFile, finding, register)
 
-_PERF_PATHS = ("scheduler/", "solver/", "models/", "fixtures/lint/")
+_PERF_PATHS = ("scheduler/", "solver/", "models/", "core/cache.py",
+               "core/snapshot.py", "fixtures/lint/")
 
 # The batched solve's output pytree keys (models/flavor_fit.solve_core
 # `outputs` dict + the derived wl_mode).

@@ -565,7 +565,7 @@ class Framework:
         tensor row re-reads every tick (the same discipline _admit applies
         on the admission side). `wi` is the info cache.delete_workload
         released — its totals are exactly what the cache subtracted."""
-        self.scheduler._mirror.note_removal(wl)
+        self.scheduler._mirror.note_removal(wl, wi)
         bs = self.scheduler.batch_solver
         note = getattr(bs, "note_removal", None)
         if note is not None and wl.admission is not None:

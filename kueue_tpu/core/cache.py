@@ -32,6 +32,16 @@ from kueue_tpu.utils import native_ledger
 # ledger.cpp); None falls back to the pure-Python walks below.
 _ledger = native_ledger.load()
 
+
+def native_assume_available() -> bool:
+    """True when the C++ bulk-assume walk is built. The scheduler's CSR
+    commit defaults to ON exactly when this is False (measured on the
+    northstar shape: the C++ per-triple walk beats Python-orchestrated
+    numpy aggregation at ~1k admissions/tick, while the aggregation
+    beats the pure-Python fallback); KUEUE_TPU_CSR_ASSUME=1/0 forces."""
+    return _ledger is not None \
+        and getattr(_ledger, "assume_batch", None) is not None
+
 FlavorResourceQuantities = Dict[str, Dict[str, int]]
 
 
@@ -471,6 +481,11 @@ class Cache:
         # One dirty-name set per registered SnapshotMirror (see
         # CachedClusterQueue._mark_dirty).
         self._mirror_dirty_sinks: List[set] = []
+        # Admitted-set event sinks (the solver's AdmittedArena): every
+        # workload that starts/stops holding quota fires
+        # note_admitted(info) / forget_admitted(key) under the cache
+        # lock, so subscribers mirror exactly what the cache accounted.
+        self._admitted_sinks: List = []
         self.cohorts: Dict[str, Cohort] = {}
         # Hierarchical-cohort specs (KEP-79); cohorts named only by
         # ClusterQueue.cohort need no spec and stay flat.
@@ -552,6 +567,29 @@ class Cache:
             except ValueError:
                 pass
 
+    def register_admitted_sink(self, sink) -> None:
+        """Subscribe to admitted-set events. `sink` implements
+        note_admitted(info) and forget_admitted(key); both run under the
+        cache lock (keep them O(row))."""
+        with self._lock:
+            if sink not in self._admitted_sinks:
+                self._admitted_sinks.append(sink)
+
+    def unregister_admitted_sink(self, sink) -> None:
+        with self._lock:
+            try:
+                self._admitted_sinks.remove(sink)
+            except ValueError:
+                pass
+
+    def _note_admitted_sinks(self, wi: WorkloadInfo) -> None:
+        for sink in self._admitted_sinks:
+            sink.note_admitted(wi)
+
+    def _forget_admitted_sinks(self, key: str) -> None:
+        for sink in self._admitted_sinks:
+            sink.forget_admitted(key)
+
     # -- cluster queues ------------------------------------------------------
 
     def add_cluster_queue(self, spec: ClusterQueue) -> CachedClusterQueue:
@@ -584,6 +622,8 @@ class Cache:
             # live cache, cache.go:607-658).
             for wi in cq.workloads.values():
                 self._lq_note(wi, -1)
+                if self._admitted_sinks:
+                    self._forget_admitted_sinks(wi.key)
             if cq.cohort is not None:
                 cq.cohort.members.discard(cq)
                 cq.cohort.invalidate_memos()
@@ -700,6 +740,8 @@ class Cache:
             self._lq_note(wi, 1)
             if self.topology.flavors:
                 self.topology.charge(wl.admission, 1)
+            if self._admitted_sinks:
+                self._note_admitted_sinks(wi)
             return True
 
     def delete_workload(self, wl: Workload) -> Optional[WorkloadInfo]:
@@ -727,6 +769,8 @@ class Cache:
                 self.topology.charge(wl.admission, -1)
             # Quota was freed: resume states against this CQ are now stale.
             cq.allocatable_generation += 1
+            if self._admitted_sinks:
+                self._forget_admitted_sinks(key)
             released = wi
         self.assumed_workloads.pop(key, None)
         return released
@@ -751,6 +795,8 @@ class Cache:
             self.assumed_workloads[key] = cq.name
             if self.topology.flavors:
                 self.topology.charge(wl.admission, 1)
+            if self._admitted_sinks:
+                self._note_admitted_sinks(wi)
             return wi
 
     def assume_workloads(self, items, fast: bool = False) -> list:
@@ -789,6 +835,10 @@ class Cache:
                     for (wl, _, _, _), res in zip(items, out):
                         if not isinstance(res, str):
                             self.topology.charge(wl.admission, 1)
+                if self._admitted_sinks:
+                    for res in out:
+                        if not isinstance(res, str):
+                            self._note_admitted_sinks(res)
                 return out
             charge_topo = bool(self.topology.flavors)
             for wl, triples, info, admitted in items:
@@ -816,7 +866,199 @@ class Cache:
                 self.assumed_workloads[key] = cq.name
                 if charge_topo:
                     self.topology.charge(wl.admission, 1)
+                if self._admitted_sinks:
+                    self._note_admitted_sinks(wi)
                 out.append(wi)
+        return out
+
+    def assume_workloads_csr(self, items, coords, cq_names,
+                             flavor_names, resource_names,
+                             arena=None) -> list:
+        """Bulk assume with the admission usage in CSR COORDINATE form —
+        the `batch_usage_csr` gather shape the admission cycle's
+        re-validation already consumes (scheduler admit.reval).
+
+        `items` is [(workload, triples, info, ci, admitted)] — every
+        row satisfies
+        the `fast=True` contract of assume_workloads (the precomputed
+        triples exist, the info IS the scheduler entry's own, and
+        info.cluster_queue matches the admission) — and `coords` is
+        (ent, fi, ri, val): item j's deduped integer usage coordinates
+        live at `ent == j`, valid in the caller's encoding whose
+        `cq_names`/`flavor_names`/`resource_names` map indices back to
+        this cache's dict keys (item j's CQ index is `ci` in its row).
+
+        The per-item work collapses to O(1) bookkeeping per workload
+        (membership, assumed set, LocalQueue counters) plus ONE
+        vectorized aggregation over the coordinate arrays: the whole
+        cycle's same-(cq, flavor, resource) contributions land in each
+        usage dict entry once (np.unique + np.add.at), instead of one
+        nested dict walk per workload — the interpreter-bound
+        admit.flush.assume shape BENCH_r05 measured. `arena` (an
+        AdmittedArena) ingests the same batch in one scatter-add.
+
+        Callers gate on `not self.topology.flavors` (topology charging
+        stays per-admission on the classic path). Returns the same
+        per-item result list as assume_workloads."""
+        import numpy as np
+
+        ent, fi, ri, val = coords
+        n = len(items)
+        out = []
+        keep = np.zeros(n, dtype=bool)
+        item_ci = np.full(n, -1, dtype=np.int64)
+        item_adm = np.zeros(n, dtype=bool)
+        item_gid = np.full(n, -1, dtype=np.int64)
+        lq_gid: Dict[str, int] = {}
+        lq_stats_by_gid: list = []
+        keys: List[str] = []
+        kept_cis: List[int] = []
+        F = len(flavor_names)
+        R = len(resource_names)
+        with self._lock:
+            cqs = self.cluster_queues
+            assumed = self.assumed_workloads
+            local_queues = self.local_queues
+            lq_stats = self._lq_stats
+            for j, (wl, triples, wi, ci_j, adm) in enumerate(items):
+                admission = wl.admission
+                if admission is None:
+                    out.append("workload has no admission")
+                    continue
+                key = wl.key
+                if key in assumed:
+                    out.append(f"workload {key} already assumed")
+                    continue
+                cq = cqs.get(admission.cluster_queue)
+                if cq is None:
+                    out.append(
+                        f"ClusterQueue {admission.cluster_queue} not found")
+                    continue
+                keep[j] = True
+                # The info was built from the pending spec (no flavor
+                # assignments); the accounted triples must ride it so a
+                # later delete/forget subtracts exactly what was added —
+                # same contract as the classic fast path.
+                wi._usage_triples = triples
+                item_ci[j] = ci_j
+                item_adm[j] = adm
+                cq.workloads[key] = wi
+                cq.usage_version += 1
+                cq._mark_dirty()
+                assumed[key] = cq.name
+                keys.append(key)
+                kept_cis.append(ci_j)
+                out.append(wi)
+                lq_key = f"{wl.namespace}/{wl.queue_name}"
+                stats = lq_stats.get(lq_key)
+                if stats is not None:
+                    lq = local_queues.get(lq_key)
+                    if lq is None or lq.cluster_queue != wi.cluster_queue:
+                        stats = None
+                if stats is not None:
+                    gid = lq_gid.get(lq_key)
+                    if gid is None:
+                        gid = lq_gid[lq_key] = len(lq_stats_by_gid)
+                        lq_stats_by_gid.append(stats)
+                    item_gid[j] = gid
+                    stats["reserving"] += 1
+                    if adm:
+                        stats["admitted"] += 1
+                        stats["admitted_keys"].add(key)
+
+            if len(ent):
+                cmask = keep[ent]
+                cent = ent[cmask]
+                cfi = fi[cmask]
+                cri = ri[cmask]
+                cval = val[cmask]
+                cci = item_ci[cent]
+                adm_w = item_adm[cent].astype(np.int64)
+                # ONE aggregation pass for the CQ-level dicts: unique
+                # (cq, flavor, resource) triples with the total and the
+                # admitted-split sums riding the same inverse index. The
+                # unique keys sort by cq first, so the store loop
+                # resolves each ClusterQueue once per run.
+                ukey, inv = np.unique((cci * F + cfi) * R + cri,
+                                      return_inverse=True)
+                usum = np.zeros(len(ukey), dtype=np.int64)
+                np.add.at(usum, inv, cval)
+                asum = np.zeros(len(ukey), dtype=np.int64)
+                np.add.at(asum, inv, cval * adm_w)
+                uci = (ukey // (F * R)).tolist()
+                ufi = ((ukey // R) % F).tolist()
+                uri = (ukey % R).tolist()
+                usum_l = usum.tolist()
+                asum_l = asum.tolist()
+                cur_ci = -1
+                cq = usage = admitted_usage = None
+                for t in range(len(ukey)):
+                    ci_t = uci[t]
+                    if ci_t != cur_ci:
+                        cur_ci = ci_t
+                        cq = cqs.get(cq_names[ci_t])
+                        usage = cq.usage if cq is not None else None
+                        admitted_usage = cq.admitted_usage \
+                            if cq is not None else None
+                    if usage is None:
+                        continue
+                    fname = flavor_names[ufi[t]]
+                    rname = resource_names[uri[t]]
+                    fus = usage.get(fname)
+                    if fus is not None and rname in fus:
+                        fus[rname] += usum_l[t]
+                        a_t = asum_l[t]
+                        if a_t:
+                            admitted_usage[fname][rname] += a_t
+                # Per-LQ reservation (and admitted) sums: same shape,
+                # grouped by the LQ id assigned in the item loop.
+                gids = item_gid[cent]
+                lmask = gids >= 0
+                if lmask.any():
+                    lkey = (gids[lmask] * F + cfi[lmask]) * R + cri[lmask]
+                    lukey, linv = np.unique(lkey, return_inverse=True)
+                    lsum = np.zeros(len(lukey), dtype=np.int64)
+                    np.add.at(lsum, linv, cval[lmask])
+                    lasum = np.zeros(len(lukey), dtype=np.int64)
+                    np.add.at(lasum, linv, (cval * adm_w)[lmask])
+                    lg = (lukey // (F * R)).tolist()
+                    lf = ((lukey // R) % F).tolist()
+                    lr = (lukey % R).tolist()
+                    lsum_l = lsum.tolist()
+                    lasum_l = lasum.tolist()
+                    cur_g = -1
+                    reservation = adm_res = None
+                    for t in range(len(lukey)):
+                        g_t = lg[t]
+                        if g_t != cur_g:
+                            cur_g = g_t
+                            stats = lq_stats_by_gid[g_t]
+                            reservation = stats["reservation"]
+                            adm_res = stats["admitted_usage"]
+                        fname = flavor_names[lf[t]]
+                        rname = resource_names[lr[t]]
+                        f3 = reservation.setdefault(fname, {})
+                        f3[rname] = f3.get(rname, 0) + lsum_l[t]
+                        la = lasum_l[t]
+                        if la:
+                            f4 = adm_res.setdefault(fname, {})
+                            f4[rname] = f4.get(rname, 0) + la
+            else:
+                cent = np.empty(0, dtype=np.int64)
+                cfi = cri = cval = cent
+
+            if arena is not None and keys:
+                remap = np.full(n, -1, dtype=np.int64)
+                remap[np.nonzero(keep)[0]] = np.arange(len(keys))
+                arena.note_batch(keys, kept_cis, remap[cent], cfi, cri,
+                                 cval)
+            if self._admitted_sinks:
+                for sink in self._admitted_sinks:
+                    if sink is arena:
+                        continue
+                    for res in out:
+                        if not isinstance(res, str):
+                            sink.note_admitted(res)
         return out
 
     def forget_workload(self, wl: Workload) -> None:

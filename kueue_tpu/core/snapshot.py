@@ -9,6 +9,7 @@ add/remove-workload simulation primitive used by preemption
 
 from __future__ import annotations
 
+import os
 from typing import Dict, List, Optional, Set, Tuple
 
 from kueue_tpu import features
@@ -193,6 +194,16 @@ class SnapshotMirror:
         self._snap: Optional[Snapshot] = None
         self._base: Dict[str, int] = {}   # cq name -> mirrored usage_version
         self._key = None
+        # Admitted-usage view provider (duck-typed; set by the scheduler
+        # when the solver keeps an AdmittedArena): a callable returning
+        # (enc, arena, structure_version) or None. When available and the
+        # generations line up, flush_pending rewrites each touched
+        # ClusterQueue's usage dict straight from the arena's committed
+        # per-CQ tensor — reading the clamped cohort delta off the
+        # arrays — instead of walking every pending item's usage dicts.
+        self._admitted_view = None
+        self._arena_flush_forced = \
+            os.environ.get("KUEUE_TPU_ARENA_FLUSH", "") == "1"
         # CQ names whose usage moved since the last refresh (fed by the
         # cache's dirty-sink hook) — the refresh visits only these.
         self._dirty: set = set()
@@ -217,6 +228,10 @@ class SnapshotMirror:
         mirror whose cache lives on (scheduler replacement) — otherwise
         the abandoned sink keeps accumulating names on every mutation."""
         self.cache.unregister_dirty_sink(self._dirty)
+
+    def bind_admitted_view(self, provider) -> None:
+        """Attach the admitted-usage view provider (see __init__)."""
+        self._admitted_view = provider
 
     def refresh(self) -> Snapshot:
         cache = self.cache
@@ -344,9 +359,11 @@ class SnapshotMirror:
         self._pending.append((1, wl, cq_name, cache_cq.usage_version,
                               cache_cq.allocatable_generation, wi))
 
-    def note_removal(self, wl) -> None:
+    def note_removal(self, wl, wi: Optional[WorkloadInfo] = None) -> None:
         """Mirror of cache.forget_workload / delete after an apply failure
-        (call right after the cache mutation)."""
+        (call right after the cache mutation). Pass the info the cache
+        released so the flush can subtract its exact accounted totals
+        without re-deriving them."""
         if self._snap is None or wl.admission is None:
             return
         cq_name = wl.admission.cluster_queue
@@ -360,7 +377,7 @@ class SnapshotMirror:
         # base version, the dirty-walk re-clone that would otherwise heal
         # the drop is masked, leaving the mirror overcounting usage.
         self._pending.append((-1, wl, cq_name, cache_cq.usage_version,
-                              cache_cq.allocatable_generation, None))
+                              cache_cq.allocatable_generation, wi))
 
     def flush_pending(self) -> None:
         """Apply queued lockstep mutations to the snapshot. Called at every
@@ -386,14 +403,26 @@ class SnapshotMirror:
             sp.set("items", len(pending))
 
     def _flush_items(self, pending, snap_cqs, base) -> None:
-        if (_ledger is not None
-                and not features.enabled(features.LENDING_LIMIT)
-                and all(item[5] is not None or item[0] < 0
-                        for item in pending)):
+        # Path order, measured on the northstar shape: the C++ per-item
+        # walk (flush_mirror) wins when built; the arena rewrite wins
+        # over the pure-Python walk everywhere it applies — including
+        # the LendingLimit path, which never had a native twin.
+        # KUEUE_TPU_ARENA_FLUSH=1 forces the arena path first (the
+        # differential goldens pin it decision-identical).
+        native_ok = (_ledger is not None
+                     and not features.enabled(features.LENDING_LIMIT)
+                     and all(item[5] is not None or item[0] < 0
+                             for item in pending))
+        if not native_ok or self._arena_flush_forced:
+            view = self._admitted_view() \
+                if self._admitted_view is not None else None
+            if view is not None and self._flush_items_arena(
+                    pending, snap_cqs, base, view):
+                return
+        if native_ok:
             # Native walk (ledger.cpp flush_mirror): identical add/remove +
             # usage/cohort-usage/version bookkeeping; the Python loop below
-            # stays the LendingLimit-path (guaranteed-quota clamps) and
-            # info-less-addition implementation.
+            # stays the info-less-addition implementation.
             _ledger.flush_mirror(snap_cqs, base, pending)
             return
         for sign, wl, cq_name, version, alloc_gen, wi in pending:
@@ -417,6 +446,94 @@ class SnapshotMirror:
                 # invalidation.
                 cq.allocatable_generation = alloc_gen
             base[cq.name] = version
+
+
+    def _flush_items_arena(self, pending, snap_cqs, base, view) -> bool:
+        """Arena-backed flush: per-item work shrinks to the membership
+        bookkeeping (one dict insert/remove each), and each touched
+        ClusterQueue's usage dict is rewritten ONCE from the
+        AdmittedArena's committed per-CQ tensor — the cache truth the
+        same assume/forget events maintain — with the lending-clamped
+        cohort delta folded per changed pair (the clamp deltas telescope,
+        so the aggregate equals the per-item sequence exactly). Returns
+        False when the view does not cover this snapshot (encoding
+        rotated, or a pending ClusterQueue sits outside the encoding) —
+        the caller falls back to the per-item walk.
+
+        The arena rows are read without its lock: a torn read can only
+        land values newer than the captured versions, which the dirty
+        walk's version comparison re-clones next refresh (the same heal
+        contract every lockstep path here relies on)."""
+        enc, arena, structure_version = view
+        snap = self._snap
+        if snap is None or snap.structure_version != structure_version:
+            return False
+        cq_index = enc.cq_index
+        # Atomicity pre-scan (nothing may be half-applied before a
+        # fallback): every pending ClusterQueue must sit inside the
+        # encoding or outside the snapshot entirely.
+        seen_ok = set()
+        for item in pending:
+            nm = item[2]
+            if nm not in seen_ok:
+                if nm not in cq_index and nm in snap_cqs:
+                    return False
+                seen_ok.add(nm)
+        touched: Dict[str, CachedClusterQueue] = {}
+        for sign, wl, cq_name, version, alloc_gen, wi in pending:
+            cq = snap_cqs.get(cq_name)
+            if cq is None:
+                continue
+            if sign > 0:
+                if wi is None:
+                    wi = WorkloadInfo(wl, cluster_queue=cq.name)
+                cq.workloads[wi.key] = wi
+            else:
+                if cq.workloads.pop(wl.key, None) is None:
+                    # Not mirrored (already removed): leave the version
+                    # mismatch in place so the dirty walk re-clones.
+                    continue
+                # The cache bumped allocatable_generation on the delete;
+                # the mirrored clone must track it for resume-state
+                # invalidation.
+                cq.allocatable_generation = alloc_gen
+            cq.usage_version += 1
+            base[cq_name] = version
+            touched[cq_name] = cq
+        if not touched:
+            return True
+        lending = features.enabled(features.LENDING_LIMIT)
+        for name, cq in touched.items():
+            ci = cq_index.get(name)
+            if ci is None:
+                continue
+            row = arena.cq_usage_row(ci)
+            cohort = cq.cohort
+            cuse = cohort.usage if cohort is not None else None
+            usage = cq.usage
+            for fname, rows in enc.flush_pairs(ci, cq):
+                resources = usage.get(fname)
+                if resources is None:
+                    continue
+                fres = cuse.get(fname) if cuse is not None else None
+                for rname, fr in rows:
+                    new = int(row[fr])
+                    old = resources.get(rname)
+                    if new == old or old is None:
+                        continue
+                    resources[rname] = new
+                    if fres is None:
+                        continue
+                    if lending:
+                        # Per-member lending clamp (max(0, used - g)):
+                        # the aggregated delta is the clamped movement.
+                        g = cq._guaranteed(fname, rname)
+                        d = max(0, new - g) - max(0, old - g)
+                    else:
+                        d = new - old
+                    if d and rname in fres:
+                        fres[rname] += d
+        return True
 
 
 def _accumulate_member_delta(old: CachedClusterQueue,

@@ -57,6 +57,20 @@ class AssignmentClusterQueueState:
     last_tried_flavor_idx: List[Dict[str, int]] = field(default_factory=list)
     cluster_queue_generation: int = 0
     cohort_generation: int = 0
+    # Memoized content signature of last_tried_flavor_idx (the nominate
+    # fingerprint's resume component): the index maps are filled during
+    # decode and never mutated afterwards — a new solve mints a new
+    # state object — so the tuple is computed once per object.
+    resume_sig: Optional[tuple] = field(default=None, compare=False)
+
+    def sig(self) -> tuple:
+        # getattr: the native decoder builds these objects bare (no
+        # __init__), so the slot may be unset on first read.
+        s = getattr(self, "resume_sig", None)
+        if s is None:
+            s = self.resume_sig = tuple(
+                tuple(d.items()) for d in self.last_tried_flavor_idx)
+        return s
 
     def next_flavor_to_try(self, podset_idx: int, resource: str) -> int:
         if podset_idx >= len(self.last_tried_flavor_idx):

@@ -73,6 +73,8 @@ struct Names {
   PyObject* msg_no_quota;   // "insufficient unused quota"
   PyObject* msg_no_fit;     // "insufficient quota or no eligible flavor"
   PyObject* mode_memo;      // "_mode" lazy representative_mode memo slot
+  PyObject* msg_memo;       // "_msg" lazy message() memo slot
+  PyObject* resume_sig;     // lazy resume-content signature slot
   PyObject* usage_idx;      // integer-coordinate usage twin
 };
 Names N;
@@ -159,7 +161,8 @@ PyObject* decode(PyObject*, PyObject* args) {
     PyObject* acqs = bare_new(cls_acqs);
     if (acqs == nullptr) goto fail;
     PyObject* lti = PyList_New(0);
-    if (!set_keep(acqs, N.last_tried_flavor_idx, lti)) {
+    if (!set_keep(acqs, N.resume_sig, Py_None) ||
+        !set_keep(acqs, N.last_tried_flavor_idx, lti)) {
       Py_XDECREF(lti);
       Py_DECREF(acqs);
       goto fail;
@@ -191,6 +194,7 @@ PyObject* decode(PyObject*, PyObject* args) {
         !set_keep(a, N.usage, usage) ||
         !set_keep(a, N.borrowing, Py_False) ||
         !set_keep(a, N.mode_memo, Py_None) ||
+        !set_keep(a, N.msg_memo, Py_None) ||
         !set_keep(a, N.last_state, acqs)) {
       Py_XDECREF(usage);
       Py_XDECREF(pod_sets);
@@ -455,6 +459,8 @@ PyMODINIT_FUNC PyInit__kueue_decode(void) {
   N.error = PyUnicode_InternFromString("error");
   N.mode = PyUnicode_InternFromString("mode");
   N.mode_memo = PyUnicode_InternFromString("_mode");
+  N.msg_memo = PyUnicode_InternFromString("_msg");
+  N.resume_sig = PyUnicode_InternFromString("resume_sig");
   N.tried_flavor_idx = PyUnicode_InternFromString("tried_flavor_idx");
   N.borrow = PyUnicode_InternFromString("borrow");
   N.last_tried_flavor_idx = PyUnicode_InternFromString("last_tried_flavor_idx");

@@ -149,6 +149,18 @@ uint64_t kh_pop(void* h) {
     return hp->remove_at(0);
 }
 
+// Pops the top of MANY heaps in one call: out[i] receives heap i's popped
+// id, or UINT64_MAX when that heap is empty. The scheduler's heads sweep
+// pops one item per ClusterQueue per tick — at 1k queues the per-pop
+// interpreter/ctypes crossing dominated the sweep, so the whole tick now
+// crosses once.
+void kh_pop_many(void** heaps, int64_t n, uint64_t* out) {
+    for (int64_t i = 0; i < n; ++i) {
+        Heap* hp = static_cast<Heap*>(heaps[i]);
+        out[i] = hp->ids.empty() ? UINT64_MAX : hp->remove_at(0);
+    }
+}
+
 uint64_t kh_peek(void* h) {
     Heap* hp = static_cast<Heap*>(h);
     if (hp->ids.empty()) return UINT64_MAX;

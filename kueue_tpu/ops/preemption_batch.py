@@ -114,6 +114,13 @@ class BatchContext:
         starts = np.searchsorted(sorted_ids, np.arange(enc.num_cohorts + 1))
         self.members_by_k = [perm[starts[k]:starts[k + 1]]
                              for k in range(enc.num_cohorts)]
+        # Optional AdmittedArena (solver/schema): pooled committed-usage
+        # rows keyed by workload, refreshed by BatchSolver per call. When
+        # set, run_batch gathers candidate usage with one fancy-index
+        # read per search instead of one usage_triples walk per
+        # candidate (the rows carry the same configured-pair filter the
+        # walk applies).
+        self.admitted_arena = None
 
     def pair_index(self, fname: str, rname: str) -> Optional[int]:
         fi = self.enc.flavor_index.get(fname)
@@ -271,17 +278,29 @@ def run_batch(ctx: BatchContext, usage: np.ndarray,
                 if fi is not None:
                     res_mask[b, fi] = True
         pos = {ci: y for y, ci in enumerate(rows.tolist())}
-        for i, (cand, cci) in enumerate(zip(s.candidates, s.cand_cis)):
-            cand_y[b, i] = pos[cci]
-            conf_row = ctx.q_def[cci]
-            for fname, rname, v in cand.usage_triples:
-                fi = ctx.pair_index(fname, rname)
-                # Only pairs the candidate's own CQ tracks count
-                # (clusterqueue.go:473-485).
-                if fi is not None and conf_row[fi]:
-                    cand_use[b, i, fi] += v
-            cand_prio[b, i] = cand.obj.priority
-            cand_valid[b, i] = True
+        N = len(s.candidates)
+        arena = ctx.admitted_arena
+        arows = arena.rows_for(s.candidates) if arena is not None else None
+        if arows is not None:
+            # Admitted-arena fast path: every candidate's committed
+            # (configured-pair filtered) usage row in ONE gather.
+            cand_use[b, :N] = arena.use_fr[arows]
+            cand_y[b, :N] = [pos[cci] for cci in s.cand_cis]
+            cand_prio[b, :N] = [c.obj.priority for c in s.candidates]
+            cand_valid[b, :N] = True
+        else:
+            for i, (cand, cci) in enumerate(zip(s.candidates,
+                                                s.cand_cis)):
+                cand_y[b, i] = pos[cci]
+                conf_row = ctx.q_def[cci]
+                for fname, rname, v in cand.usage_triples:
+                    fi = ctx.pair_index(fname, rname)
+                    # Only pairs the candidate's own CQ tracks count
+                    # (clusterqueue.go:473-485).
+                    if fi is not None and conf_row[fi]:
+                        cand_use[b, i, fi] += v
+                cand_prio[b, i] = cand.obj.priority
+                cand_valid[b, i] = True
         has_cohort[b] = s.has_cohort
         allow_b0[b] = s.allow_borrowing
         has_threshold[b] = s.threshold is not None

@@ -226,6 +226,13 @@ class Manager:
         # queue, forget_pending_workload on delete. Requeues of an
         # unchanged info fire nothing — the subscriber's row stays valid.
         self._workload_sinks: List = []
+        # Batched heads sweep: the native heaps' top pops ride ONE C call
+        # per tick (utils/native_heap.PopGroup). The plan (CQ order +
+        # handle buffer) is cached and keyed on the ClusterQueue-set
+        # version, so steady-state sweeps never rebuild it.
+        self._cq_version = 0
+        self._pop_plan = None
+        self._pop_plan_version = -1
 
     # -- pending-workload events (solver arena subscription) -----------------
 
@@ -269,6 +276,7 @@ class Manager:
                 raise ValueError(f"queue {spec.name} already exists")
             cq = PendingClusterQueue(spec, self.ordering, self._clock)
             self.cluster_queues[spec.name] = cq
+            self._cq_version += 1
             if cq.cohort:
                 self._cohort_members.setdefault(cq.cohort, {})[cq.name] = cq
             # Re-adopt pending workloads that arrived before the CQ
@@ -299,6 +307,7 @@ class Manager:
         with self._cond:
             cq = self.cluster_queues.pop(name, None)
             if cq is not None:
+                self._cq_version += 1
                 self._drop_cohort_member(cq.cohort, name)
 
     def _drop_cohort_member(self, cohort: str, name: str) -> None:
@@ -480,12 +489,40 @@ class Manager:
                 self._cond.wait(remaining)
             return []
 
-    def _heads_locked(self) -> List[WorkloadInfo]:
-        out: List[WorkloadInfo] = []
+    def _build_pop_plan(self) -> None:
+        """(Re)build the batched heads-sweep plan: the active CQs in
+        dict order (the entry sort is stable, so sweep order is part of
+        the decision contract) with every native heap grouped into one
+        PopGroup. `PendingClusterQueue.active` is write-once True today;
+        a future deactivation path must bump `_cq_version`."""
+        from kueue_tpu.utils import native_heap as nh
+        plan = []                       # (cq, index into group | -1)
+        native: List[PendingClusterQueue] = []
+        batched = nh.pop_many_available()
         for cq in self.cluster_queues.values():
             if not cq.active:
                 continue
-            wi = cq.pop()
+            if batched and isinstance(cq.heap, nh.NativeKeyedHeap):
+                plan.append((cq, len(native)))
+                native.append(cq)
+            else:
+                plan.append((cq, -1))
+        group = nh.PopGroup([cq.heap for cq in native]) if native else None
+        self._pop_plan = (plan, group)
+        self._pop_plan_version = self._cq_version
+
+    def _heads_locked(self) -> List[WorkloadInfo]:
+        if self._pop_plan_version != self._cq_version:
+            self._build_pop_plan()
+        plan, group = self._pop_plan
+        popped = group.pop_each() if group is not None else None
+        out: List[WorkloadInfo] = []
+        for cq, gi in plan:
+            # pop() semantics inlined: the popCycle advances for every
+            # active CQ per sweep, empty or not (the popCycle /
+            # queueInadmissibleCycle race guard counts sweeps).
+            cq.pop_cycle += 1
+            wi = popped[gi] if gi >= 0 else cq.heap.pop()
             if wi is not None:
                 out.append(wi)
         return out

@@ -14,6 +14,7 @@ preemption-target search runs host-side on the snapshot.
 
 from __future__ import annotations
 
+import os
 import time as _time
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Sequence, Set
@@ -131,6 +132,13 @@ class Scheduler:
             bind = getattr(batch_solver, "bind_queues", None)
             if bind is not None:
                 bind(queues)
+            # Admitted-set arena plumbing: the solver subscribes to the
+            # cache's assume/add/forget/delete events so committed usage
+            # stays arena-resident (preemption candidate rows, mirror
+            # flush) across ticks.
+            bind_cache = getattr(batch_solver, "bind_cache", None)
+            if bind_cache is not None:
+                bind_cache(cache)
         self.ordering = ordering or WorkloadOrdering()
         # waitForPodsReady.blockAdmission (KEP-349): admission is withheld
         # while the gate reports not-ready. The reference blocks the loop on
@@ -156,11 +164,26 @@ class Scheduler:
         # usage moved outside the scheduler's own assume/forget lockstep
         # (replaces the reference's per-tick deep copy, snapshot.go:95-129).
         self._mirror = SnapshotMirror(cache)
+        if batch_solver is not None:
+            view = getattr(batch_solver, "admitted_view", None)
+            if view is not None:
+                # Mirror flush fast path: touched ClusterQueues read
+                # their usage (and clamped cohort deltas) straight from
+                # the admitted arena instead of walking pending items.
+                self._mirror.bind_admitted_view(view)
         # Topology-aware stage (kueue_tpu/topology), built lazily from the
         # snapshot's flavor set and keyed on its structure version; stays
         # None on topology-free clusters (the provable no-op).
         self._topo_key = None
         self._topo_stage = None
+        # CSR admission commit: "1" forces it, "0" forces the classic
+        # walk, unset = on exactly when the native bulk-assume is not
+        # built (cache.native_assume_available — the C++ walk wins when
+        # present, the aggregation wins over the Python fallback).
+        knob = os.environ.get("KUEUE_TPU_CSR_ASSUME", "")
+        from kueue_tpu.core import cache as cache_mod
+        self._csr_assume = knob == "1" or (
+            knob != "0" and not cache_mod.native_assume_available())
 
     def close(self) -> None:
         """Release cache/queue subscriptions. Call when retiring this
@@ -172,6 +195,9 @@ class Scheduler:
             unbind = getattr(self.batch_solver, "unbind_queues", None)
             if unbind is not None:
                 unbind()
+            unbind_cache = getattr(self.batch_solver, "unbind_cache", None)
+            if unbind_cache is not None:
+                unbind_cache()
 
     def prewarm(self, head_counts: Sequence[int], podsets: int = 1) -> None:
         """Warmup hook: compile the batched solve for the given head-count
@@ -244,8 +270,15 @@ class Scheduler:
         stale = self._mirror.mutation_count != tick.dispatched_at
         snapshot = tick.snapshot
         entries = tick.entries
-        with TRACER.phase("nominate"):
+        with TRACER.phase("nominate") as nsp:
             self._resolve(tick)
+            if tick.handle is not None:
+                cached = tick.handle.get("cached")
+                if cached is not None:
+                    # Nominate-cache evidence: how many heads replayed a
+                    # fingerprint-unchanged verdict vs solved fresh.
+                    nsp.set("heads_cached", len(cached))
+                    nsp.set("heads_total", len(tick.handle["workloads"]))
             with TRACER.phase("nominate.sort"):
                 self._sort_entries(entries)
         with TRACER.phase("admit") as sp:
@@ -363,8 +396,12 @@ class Scheduler:
         solve when one is in flight, else run the sequential referee."""
         entries = tick.solvable
         snapshot = tick.snapshot
+        solve_rows = None
         if tick.handle is not None:
             assignments = self.batch_solver.collect(tick.handle)
+            # Entry index -> row in the (miss-only) solve batch; None
+            # when the nominate cache is off (identity mapping then).
+            solve_rows = tick.handle.get("solve_rows")
             topo_stage = self._topology_stage(snapshot)
             if topo_stage is not None:
                 # Topology stage over the whole batch: one vectorized
@@ -415,7 +452,7 @@ class Scheduler:
                 # Batched-solve FIT fast path: nothing to search, no
                 # message to build (a FIT assignment has no reasons).
                 e.assignment = full
-                e.solve_row = i
+                e.solve_row = i if solve_rows is None else int(solve_rows[i])
                 e.preemption_targets = []
                 e.inadmissible_msg = ""
                 e.info.last_assignment = full.last_state
@@ -1127,12 +1164,6 @@ class Scheduler:
                 items.append((e.info.obj, triples, None, admitted_now))
             else:
                 items.append((e.info.obj, triples, e.info, admitted_now))
-        with TRACER.phase("admit.flush.assume"):
-            results = self.cache.assume_workloads(items, fast=all_fast)
-        now = self.clock()
-        note_items = []
-        csr_rows: List[int] = []
-        csr_cqs: List[str] = []
         note_bulk = getattr(self.batch_solver, "note_admissions", None)
         # usage_idx coordinates are only valid in the encoding they were
         # decoded against; after a mid-pipeline structural change the
@@ -1140,6 +1171,52 @@ class Scheduler:
         # space — fall back to the name-keyed usage dicts then.
         idx_ok = note_bulk is not None and snapshot is not None and getattr(
             self.batch_solver, "encoding_matches", lambda s: False)(snapshot)
+        # CSR commit: when every reserved entry rode THIS solve (fast
+        # triples + a live CSR row) and no topology ledger needs
+        # per-admission charging, the whole cycle's usage lands in the
+        # cache as ONE aggregated coordinate pass (and one arena
+        # scatter-add) instead of a nested dict walk per workload.
+        csr_items = None
+        names = None
+        if (self._csr_assume and all_fast and idx_ok
+                and usage_csr is not None
+                and not self.cache.topology.flavors
+                and hasattr(self.cache, "assume_workloads_csr")):
+            names = getattr(self.batch_solver, "encoding_names",
+                            lambda: None)()
+        if names is not None:
+            cq_names, flavor_names, resource_names, cq_index = names
+            csr_items = []
+            for e, _, triples, admitted_now in pending:
+                ci = cq_index.get(e.info.cluster_queue)
+                if ci is None or e.solve_row < 0:
+                    csr_items = None
+                    break
+                csr_items.append((e.info.obj, triples, e.info, ci,
+                                  admitted_now))
+        with TRACER.phase("admit.flush.assume") as asp:
+            if csr_items is not None:
+                import numpy as np
+                from kueue_tpu.solver.schema import csr_gather
+                rows = np.fromiter(
+                    (e.solve_row for e, _, _, _ in pending),
+                    np.int64, count=len(pending))
+                ent, _ci, fi, ri, val = csr_gather(usage_csr, rows)
+                results = self.cache.assume_workloads_csr(
+                    csr_items, (ent, fi, ri, val), cq_names,
+                    flavor_names, resource_names,
+                    arena=getattr(self.batch_solver, "admit_arena", None))
+                asp.set("entries", len(pending))
+                asp.set("csr_rows", int(len(ent)))
+            else:
+                results = self.cache.assume_workloads(items, fast=all_fast)
+                asp.set("entries", len(pending))
+                asp.set("csr_rows", 0)
+        now = self.clock()
+        note_items = []
+        csr_rows: List[int] = []
+        csr_cqs: List[str] = []
+        forget_verdict = getattr(self.batch_solver, "forget_verdict", None)
         admitted = 0
         wait_samples = []
         admit_counts: Dict[tuple, int] = {}
@@ -1166,6 +1243,10 @@ class Scheduler:
                 self._requeue_and_update(e)
                 continue
             e.status = ASSUMED
+            if forget_verdict is not None:
+                # The head left the queue: its cached verdicts are dead
+                # weight (and would pin the Assignment objects).
+                forget_verdict(wl.uid)
             self._mirror.note_admission(wl, assumed)
             # Mirror EXACTLY what the cache accounted: for partial
             # admission that is the spec-count totals (scaled back up,

@@ -83,6 +83,7 @@ class Assignment:
     # domain (scheduler/preemption.py).
     topology_hint: Optional[tuple] = field(default=None, repr=False)
     _mode: Optional[int] = field(default=None, init=False, repr=False)
+    _msg: Optional[str] = field(default=None, init=False, repr=False)
 
     @property
     def representative_mode(self) -> int:
@@ -97,14 +98,21 @@ class Assignment:
         return mode
 
     def message(self) -> str:
-        parts = []
-        for ps in self.pod_sets:
-            if ps.error is not None:
-                return f"failed to assign flavors to pod set {ps.name}: {ps.error}"
-            if ps.reasons:
-                parts.append("couldn't assign flavors to pod set %s: %s"
-                             % (ps.name, ", ".join(sorted(ps.reasons))))
-        return "; ".join(parts)
+        # Memoized under the representative_mode contract (assigners
+        # finish mutating reasons before the scheduler's first read): a
+        # replayed NoFit verdict re-reads its message every tick.
+        msg = self._msg
+        if msg is None:
+            parts = []
+            for ps in self.pod_sets:
+                if ps.error is not None:
+                    return (f"failed to assign flavors to pod set "
+                            f"{ps.name}: {ps.error}")
+                if ps.reasons:
+                    parts.append("couldn't assign flavors to pod set %s: %s"
+                                 % (ps.name, ", ".join(sorted(ps.reasons))))
+            msg = self._msg = "; ".join(parts)
+        return msg
 
 
 def assign_flavors(wi: WorkloadInfo, cq: CachedClusterQueue,

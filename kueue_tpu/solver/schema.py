@@ -137,6 +137,30 @@ class CQEncoding:
         default=None, repr=False, compare=False)
     _cohort_starts: Optional[np.ndarray] = field(
         default=None, repr=False, compare=False)
+    # Per-CQ (flavor, [(resource, flat fr index)]) walk plan for the
+    # mirror's arena flush: the usage-dict KEY SET is fixed per
+    # structure (CachedClusterQueue.update materializes every configured
+    # pair; accounting only mutates values), so the name->index
+    # resolution is done once per CQ per encoding generation.
+    _flush_pairs: Dict[int, list] = field(
+        default_factory=dict, repr=False, compare=False)
+
+    def flush_pairs(self, ci: int, cq) -> list:
+        pairs = self._flush_pairs.get(ci)
+        if pairs is None:
+            R = len(self.resource_names)
+            pairs = []
+            for fname, resources in cq.usage.items():
+                fi = self.flavor_index.get(fname)
+                if fi is None:
+                    continue
+                row = [(rname, fi * R + self.resource_index[rname])
+                       for rname in resources
+                       if rname in self.resource_index]
+                if row:
+                    pairs.append((fname, row))
+            self._flush_pairs[ci] = pairs
+        return pairs
 
     def _cohort_sort(self):
         """Members sorted by cohort id, for C-speed segment reductions."""
@@ -460,6 +484,18 @@ class UsageEncoder:
         C, F, R = enc.nominal.shape
         self.usage = np.zeros((C, F, R), dtype=np.int64)
         self._versions: List[Optional[int]] = [None] * C
+        # Usage-dependency generations for the fingerprinted nominate
+        # cache: one counter per cohort (a head's fit can read every
+        # member row of its cohort — the device kernel segment-sums them)
+        # bumped on ANY member-row movement, plus one global counter for
+        # hierarchical trees (a tree walk can read nodes across the
+        # forest, so hier heads key on everything moving or nothing).
+        self.cohort_gens = np.zeros(enc.num_cohorts + 1, dtype=np.int64)
+        self.global_gen = 0
+
+    def _bump_gen(self, ci: int) -> None:
+        self.cohort_gens[self.enc.cohort_id[ci]] += 1
+        self.global_gen += 1
 
     def verify(self, snapshot: Snapshot) -> None:
         """Assert the incremental tensor equals a from-scratch encode.
@@ -485,6 +521,7 @@ class UsageEncoder:
             if cq.usage_version == versions[ci]:
                 continue
             row = usage[ci]
+            old_row = row.copy()
             row[:] = 0
             for fname, resources in cq.usage.items():
                 fi = flavor_index.get(fname)
@@ -495,6 +532,13 @@ class UsageEncoder:
                     ri = resource_index.get(rname)
                     if ri is not None:
                         frow[ri] = val
+            if not np.array_equal(row, old_row):
+                # Generations track usage VALUES, not version churn: the
+                # preemption simulation's remove/add pairs (and any other
+                # restore-exactly mutation) bump versions while leaving
+                # the row intact — a head's fit verdict only depends on
+                # the values, so its fingerprint must not move.
+                self._bump_gen(ci)
             versions[ci] = cq.usage_version
         if self.debug_verify:
             # After the loop every row claims to be current; any mismatch
@@ -509,6 +553,7 @@ class UsageEncoder:
         ci = enc.cq_index.get(cq_name)
         if ci is None:
             return
+        self._bump_gen(ci)
         row = self.usage[ci]
         conf = enc.configured[ci]
         for fname, resources in frq.items():
@@ -551,6 +596,7 @@ class UsageEncoder:
             # two for the row-skip fast path.
             if versions[ci] is not None:
                 versions[ci] += 1
+            self._bump_gen(ci)
             if idx is not None:
                 i_f, i_r, i_v = idx
                 k = len(i_f)
@@ -594,6 +640,7 @@ class UsageEncoder:
         for ci in cq_indices.tolist():
             if versions[ci] is not None:
                 versions[ci] += 1
+            self._bump_gen(ci)
 
 
 class _Row:
@@ -1338,3 +1385,198 @@ class WorkloadArena:
                     f"WorkloadArena drift: gathered `{name}` does not "
                     "match the from-scratch encode (event/row staleness "
                     "bug — a queue mutation bypassed the arena events)")
+
+
+class AdmittedArena:
+    """Persistent admitted-set tensor arena: one pooled usage row per
+    workload currently HOLDING quota (assumed or admitted).
+
+    The admitted set was the last per-tick dict-walk surface after PR 5
+    made the pending side arena-resident: the batched preemption victim
+    search re-derived every candidate's usage vector from its
+    `usage_triples` per search per tick, and the snapshot mirror's
+    lockstep flush re-applied per-workload usage dicts item by item.
+    This arena keeps each quota-holder's committed (cq, flavor, resource,
+    value) usage as one dense `[cap, F*R]` int64 row (restricted to the
+    pairs its ClusterQueue is configured to track — exactly what the
+    cache accounts, clusterqueue.go:473-485) plus the per-ClusterQueue
+    sum `usage_cfr [C,F,R]`, both maintained incrementally from the
+    cache's assume/add/forget/delete events
+    (`Cache.register_admitted_sink`).
+
+    Consumers:
+      * `ops/preemption_batch.run_batch` gathers candidate usage rows
+        with one fancy-index read instead of a triples walk per
+        candidate;
+      * `SnapshotMirror` rewrites a flushed ClusterQueue's usage dict
+        from `usage_cfr` (and folds the lending-clamped cohort delta)
+        instead of walking every pending item's triples.
+
+    Lifecycle mirrors `WorkloadArena`: one arena per CQ-encoding
+    generation, fully re-seeded from the cache on encoding rotation.
+    Kill switch: `KUEUE_TPU_NO_ADMIT_ARENA=1` (or
+    `BatchSolver(use_admit_arena=False)`) restores the dict walks.
+    Debug: `KUEUE_TPU_DEBUG_ADMIT_ARENA=1` re-derives `usage_cfr` from
+    the cache dicts after every mutation batch and asserts equality.
+    """
+
+    debug_verify = os.environ.get("KUEUE_TPU_DEBUG_ADMIT_ARENA", "") == "1"
+
+    def __init__(self, enc: CQEncoding, capacity: int = 1024):
+        self.enc = enc
+        C, F, R = enc.nominal.shape
+        self.FR = F * R
+        self.R = R
+        self._lock = threading.Lock()
+        self._rows: Dict[str, int] = {}     # workload key -> row
+        self._free: List[int] = []
+        self.cap = 0
+        self.use_fr = np.zeros((0, self.FR), dtype=np.int64)
+        self.row_ci = np.zeros(0, dtype=np.int32)
+        self.usage_cfr = np.zeros((C, F, R), dtype=np.int64)
+        self._cfr_flat = self.usage_cfr.reshape(C, self.FR)
+        self._grow(max(8, capacity))
+        self.rows_noted = 0
+
+    def _grow(self, new_cap: int) -> None:
+        old = self.cap
+        use_fr = np.zeros((new_cap, self.FR), dtype=np.int64)
+        row_ci = np.full(new_cap, -1, dtype=np.int32)
+        if old:
+            use_fr[:old] = self.use_fr
+            row_ci[:old] = self.row_ci
+        self.use_fr, self.row_ci = use_fr, row_ci
+        self._free.extend(range(new_cap - 1, old - 1, -1))
+        self.cap = new_cap
+
+    def _alloc(self, key: str) -> int:
+        if not self._free:
+            self._grow(self.cap * 2)
+        row = self._free.pop()
+        self._rows[key] = row
+        return row
+
+    # -- cache events (called under the cache lock; keep O(row)) ------------
+
+    def note_admitted(self, wi) -> None:
+        """One workload began holding quota (assume/add). Re-noting an
+        existing key replaces its row (delete+add update shape)."""
+        enc = self.enc
+        ci = enc.cq_index.get(wi.cluster_queue)
+        if ci is None:
+            # Newer than this encoding generation; the rotation reseeds.
+            return
+        f_index = enc.flavor_index
+        r_index = enc.resource_index
+        conf = enc.configured[ci]
+        R = self.R
+        with self._lock:
+            key = wi.key
+            row = self._rows.get(key)
+            if row is None:
+                row = self._alloc(key)
+            else:
+                self._cfr_flat[self.row_ci[row]] -= self.use_fr[row]
+            rowv = self.use_fr[row]
+            rowv[:] = 0
+            for fname, rname, v in wi.usage_triples:
+                fi = f_index.get(fname)
+                if fi is None:
+                    continue
+                ri = r_index.get(rname)
+                if ri is not None and conf[fi, ri]:
+                    rowv[fi * R + ri] += v
+            self.row_ci[row] = ci
+            self._cfr_flat[ci] += rowv
+            self.rows_noted += 1
+
+    def note_batch(self, keys: Sequence[str], cis: Sequence[int],
+                   ent: np.ndarray, fi: np.ndarray, ri: np.ndarray,
+                   val: np.ndarray) -> None:
+        """Bulk twin of note_admitted for the admission cycle's CSR
+        commit: `keys[j]` holds the coordinate slice `ent == j` of the
+        (deduped, configured-by-construction) decode coordinates — the
+        whole cycle's admitted usage lands in ONE scatter-add."""
+        R = self.R
+        with self._lock:
+            rows = np.empty(len(keys), dtype=np.int64)
+            for j, key in enumerate(keys):
+                row = self._rows.get(key)
+                if row is None:
+                    row = self._alloc(key)
+                else:
+                    self._cfr_flat[self.row_ci[row]] -= self.use_fr[row]
+                self.use_fr[row] = 0
+                self.row_ci[row] = cis[j]
+                rows[j] = row
+            if len(ent):
+                fr = fi * R + ri
+                np.add.at(self.use_fr, (rows[ent], fr), val)
+                np.add.at(self._cfr_flat,
+                          (np.asarray(cis, dtype=np.int64)[ent], fr), val)
+            self.rows_noted += len(keys)
+
+    def forget_admitted(self, key: str) -> None:
+        """The workload released its quota (forget/delete)."""
+        with self._lock:
+            row = self._rows.pop(key, None)
+            if row is None:
+                return
+            ci = self.row_ci[row]
+            self._cfr_flat[ci] -= self.use_fr[row]
+            self.use_fr[row] = 0
+            self.row_ci[row] = -1
+            self._free.append(row)
+
+    def seed(self, cluster_queues: Dict[str, CachedClusterQueue]) -> None:
+        """Re-seed the whole admitted set from the cache (arena rebuild
+        on encoding rotation; runs off the measured tick path)."""
+        for cq in cluster_queues.values():
+            for wi in cq.workloads.values():
+                self.note_admitted(wi)
+
+    # -- consumers ----------------------------------------------------------
+
+    def rows_for(self, infos) -> Optional[np.ndarray]:
+        """Pooled row indices of `infos` (preemption candidates), or None
+        when any candidate has no row (caller falls back to the triples
+        walk — a correctness no-op, the rows are an accelerator)."""
+        rows_map = self._rows
+        with self._lock:
+            out = np.empty(len(infos), dtype=np.int64)
+            for i, wi in enumerate(infos):
+                row = rows_map.get(wi.key)
+                if row is None:
+                    return None
+                out[i] = row
+        return out
+
+    def cq_usage_row(self, ci: int) -> np.ndarray:
+        """The [F*R] committed-usage sum of one ClusterQueue (a live
+        view; copy before holding across mutations)."""
+        return self._cfr_flat[ci]
+
+    def verify(self, cluster_queues: Dict[str, CachedClusterQueue]) -> None:
+        """Assert usage_cfr equals a from-scratch re-derivation of the
+        cache's accounted usage (debug mode)."""
+        enc = self.enc
+        fresh = np.zeros_like(self.usage_cfr)
+        for name, cq in cluster_queues.items():
+            ci = enc.cq_index.get(name)
+            if ci is None:
+                continue
+            for fname, resources in cq.usage.items():
+                fi = enc.flavor_index.get(fname)
+                if fi is None:
+                    continue
+                for rname, v in resources.items():
+                    ri = enc.resource_index.get(rname)
+                    if ri is not None:
+                        fresh[ci, fi, ri] = v
+        if not np.array_equal(fresh, self.usage_cfr):
+            bad = [enc.cq_names[ci] for ci in np.nonzero(
+                (fresh != self.usage_cfr).any(axis=(1, 2)))[0]]
+            raise AssertionError(
+                f"AdmittedArena drift: usage rows for {bad} do not match "
+                "the cache dicts (a cache mutation bypassed the admitted "
+                "sink events)")

@@ -60,12 +60,60 @@ def _load() -> Optional[ctypes.CDLL]:
         lib.kh_items.argtypes = [ctypes.c_void_p,
                                  ctypes.POINTER(ctypes.c_uint64),
                                  ctypes.c_int64]
+        # Older cached builds may predate kh_pop_many; callers probe via
+        # pop_many_available().
+        if hasattr(lib, "kh_pop_many"):
+            lib.kh_pop_many.restype = None
+            lib.kh_pop_many.argtypes = [ctypes.POINTER(ctypes.c_void_p),
+                                        ctypes.c_int64,
+                                        ctypes.POINTER(ctypes.c_uint64)]
         _lib = lib
         return _lib
 
 
 def native_available() -> bool:
     return _load() is not None
+
+
+def pop_many_available() -> bool:
+    lib = _load()
+    return lib is not None and hasattr(lib, "kh_pop_many")
+
+
+class PopGroup:
+    """Reusable batched-pop plan over a fixed set of NativeKeyedHeaps.
+
+    One `kh_pop_many` call pops the head of every heap in the group —
+    one Python/C crossing per TICK instead of one per ClusterQueue
+    (manager.heads at 1k queues). The ctypes handle/result buffers are
+    built once and reused; rebuild the group whenever the heap set
+    changes (the queue manager keys it on its ClusterQueue-set
+    version)."""
+
+    __slots__ = ("heaps", "_handles", "_out", "_n", "_lib")
+
+    def __init__(self, heaps: Sequence["NativeKeyedHeap"]):
+        lib = _load()
+        if lib is None or not hasattr(lib, "kh_pop_many"):
+            raise RuntimeError("native pop_many unavailable")
+        self._lib = lib
+        self.heaps = list(heaps)
+        n = len(self.heaps)
+        self._n = n
+        self._handles = (ctypes.c_void_p * n)(
+            *[h._h for h in self.heaps])
+        self._out = (ctypes.c_uint64 * n)()
+
+    def pop_each(self) -> List[Optional[T]]:
+        """Pop the top item of every heap (None where empty)."""
+        out = self._out
+        self._lib.kh_pop_many(self._handles, self._n, out)
+        results: List[Optional[T]] = []
+        append = results.append
+        for i, heap in enumerate(self.heaps):
+            iid = out[i]
+            append(None if iid == _EMPTY else heap._claim(iid))
+        return results
 
 
 _EMPTY = 2**64 - 1
@@ -175,10 +223,16 @@ class NativeKeyedHeap(Generic[T]):
         i = self._libref.kh_peek(self._h)
         return None if i == _EMPTY else self._obj_by_id[i]
 
+    def _claim(self, iid: int) -> T:
+        """Unwind the Python-side bookkeeping of an id the C heap just
+        popped — shared by pop() and PopGroup.pop_each so the batched
+        sweep can never diverge from the single-pop path."""
+        obj = self._obj_by_id.pop(iid)
+        del self._id_by_key[self._key_by_id.pop(iid)]
+        return obj
+
     def pop(self) -> Optional[T]:
         i = self._libref.kh_pop(self._h)
         if i == _EMPTY:
             return None
-        obj = self._obj_by_id.pop(i)
-        del self._id_by_key[self._key_by_id.pop(i)]
-        return obj
+        return self._claim(i)

@@ -39,6 +39,8 @@ def synthetic_objects(
     fair_hierarchy: bool = False,
     lending: bool = False,
     topology: bool = False,
+    strict_fifo: bool = False,
+    no_preemption: bool = False,
 ):
     """Generate the raw API objects of a north-star-scale cluster:
     (flavors, cluster_queues, local_queues, admitted workloads with their
@@ -115,6 +117,11 @@ def synthetic_objects(
         preemption = ClusterQueuePreemption(
             within_cluster_queue="LowerPriority",
             reclaim_within_cohort="Any")
+        if no_preemption:
+            # Steady-state shape: once the quotas saturate nothing can
+            # move (no victim searches, no eviction churn), so every
+            # subsequent tick is genuinely quiescent.
+            preemption = ClusterQueuePreemption()
         if preemption_heavy:
             from kueue_tpu.api.types import BorrowWithinCohort
             preemption = ClusterQueuePreemption(
@@ -132,6 +139,10 @@ def synthetic_objects(
             else None,
             preemption=preemption,
             fair_sharing=fair,
+            # StrictFIFO requeues NoFit losers straight back to the heap
+            # (no parking lot), so every tick re-pops the same heads —
+            # the steady-state/quiescent bench shape.
+            **({"queueing_strategy": "StrictFIFO"} if strict_fifo else {}),
         ))
         lqs.append(LocalQueue(
             name=f"lq-{c}", namespace="default", cluster_queue=f"cq-{c}"))
@@ -243,6 +254,8 @@ def synthetic_framework(
     fair_hierarchy: bool = False,
     lending: bool = False,
     topology: bool = False,
+    strict_fifo: bool = False,
+    no_preemption: bool = False,
     **framework_kwargs,
 ):
     """Build a full Framework loaded with the synthetic cluster — the
@@ -254,7 +267,8 @@ def synthetic_framework(
         num_cqs=num_cqs, num_cohorts=num_cohorts, num_flavors=num_flavors,
         num_pending=num_pending, usage_fill=usage_fill, seed=seed,
         pending_priority=pending_priority, preemption_heavy=preemption_heavy,
-        fair_hierarchy=fair_hierarchy, lending=lending, topology=topology)
+        fair_hierarchy=fair_hierarchy, lending=lending, topology=topology,
+        strict_fifo=strict_fifo, no_preemption=no_preemption)
     fw = Framework(batch_solver=batch_solver, **framework_kwargs)
     for rf in flavors:
         fw.create_resource_flavor(rf)

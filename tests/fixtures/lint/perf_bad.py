@@ -29,3 +29,13 @@ def flush_slow(entries, out):
         total += int(out["ps_mode"][i])  # finding: while-loop counter
         i += 1
     return total
+
+
+def flush_assume_slow(entries, out):
+    # The admission-commit shape PERF01 now polices in core/cache.py and
+    # core/snapshot.py too: walking the solve's usage coordinates one
+    # entry at a time instead of one aggregated np pass.
+    total = {}
+    for j, entry in enumerate(entries):
+        total[entry] = int(out["res_mode"][j].sum())  # finding
+    return total

@@ -29,3 +29,16 @@ def unrelated_loop(rows, table):
     for r in rows:
         out.append(table[r])
     return out
+
+
+def flush_assume_aggregated(entries, out):
+    # The sanctioned commit shape: ONE aggregation over the whole
+    # cycle's coordinates (np.unique + np.add.at), then plain-dict
+    # stores over the deduped triples.
+    n = len(entries)
+    modes = out["res_mode"][:n]
+    key = modes.reshape(n, -1).argmax(axis=1)
+    ukey, inv = np.unique(key, return_inverse=True)
+    sums = np.zeros(len(ukey), dtype=np.int64)
+    np.add.at(sums, inv, 1)
+    return dict(zip(ukey.tolist(), sums.tolist()))

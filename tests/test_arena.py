@@ -56,11 +56,17 @@ def test_registry_covered():
         "preemption_engine knob here so the arena differential runs it"
 
 
-def build(use_arena: bool, engine):
+def build(incremental: bool, engine):
+    """`incremental` toggles ALL the cross-tick fast paths at once: the
+    pending workload arena, the admitted-set arena (mirror flush + victim
+    rows), and the fingerprinted nominate cache — exactly what the two
+    kill switches (KUEUE_TPU_NO_ADMIT_ARENA / KUEUE_TPU_NO_NOMINATE_CACHE
+    plus KUEUE_TPU_NO_ARENA) restore in production."""
     cfg = Configuration(tpu_solver=TPUSolverConfig(
         preemption_engine="host" if engine is None else engine))
-    fw = Framework(batch_solver=BatchSolver(use_arena=use_arena),
-                   config=cfg)
+    fw = Framework(batch_solver=BatchSolver(
+        use_arena=incremental, use_admit_arena=incremental,
+        use_nominate_cache=incremental), config=cfg)
     fw.create_namespace("default", labels={})
     fw.create_resource_flavor(make_flavor("on-demand", zone="a"))
     fw.create_resource_flavor(make_flavor("spot", zone="b"))
@@ -76,9 +82,9 @@ def build(use_arena: bool, engine):
     return fw
 
 
-def drive(use_arena: bool, engine, ticks: int = TICKS):
+def drive(incremental: bool, engine, ticks: int = TICKS):
     """Run the seeded churn stream; returns the per-tick decision trail."""
-    fw = build(use_arena, engine)
+    fw = build(incremental, engine)
     rnd = random.Random(1234)
     seq = [0]
     pending: dict = {}
@@ -167,12 +173,24 @@ def drive(use_arena: bool, engine, ticks: int = TICKS):
                          ids=[str(k) for k in _KNOBS])
 def test_incremental_vs_fullrebuild_decisions_identical(engine,
                                                         monkeypatch):
-    # The arena run verifies EVERY gather against a from-scratch encode
-    # (tensor identity), and the decision trails must match byte for
-    # byte across 200 randomized churn ticks.
+    # The incremental run verifies EVERY workload-arena gather against a
+    # from-scratch encode (tensor identity) AND the admitted arena
+    # against the cache dicts on every mirror flush, and the decision
+    # trails — workload arena + admitted arena + nominate cache all ON
+    # vs ALL off (the kill-switch path) — must match byte for byte
+    # across 200 randomized churn ticks.
     monkeypatch.setattr(sch.WorkloadArena, "debug_verify", True)
+    monkeypatch.setattr(sch.AdmittedArena, "debug_verify", True)
+    # Force the CSR commit + arena mirror-flush (auto mode prefers the
+    # native ledger walks when the toolchain built them) so the
+    # differential always covers the aggregated paths.
+    monkeypatch.setenv("KUEUE_TPU_CSR_ASSUME", "1")
+    monkeypatch.setenv("KUEUE_TPU_ARENA_FLUSH", "1")
     with_arena = drive(True, engine)
     monkeypatch.setattr(sch.WorkloadArena, "debug_verify", False)
+    monkeypatch.setattr(sch.AdmittedArena, "debug_verify", False)
+    monkeypatch.setenv("KUEUE_TPU_CSR_ASSUME", "0")
+    monkeypatch.delenv("KUEUE_TPU_ARENA_FLUSH")
     without = drive(False, engine)
     assert with_arena == without
 
@@ -199,6 +217,56 @@ def test_arena_reuses_rows_across_ticks():
     assert reused > 0
     assert reused / max(reused + missed, 1) > 0.9
     assert solver.arena_full_rebuilds == 1  # the initial build only
+
+
+def test_quiescent_tick_zero_encode_and_solve_work():
+    """When no dirty events arrive between ticks, every head replays its
+    fingerprint-cached verdict: no gather, no device dispatch, no decode
+    — the 'nothing-changed ticks cost nothing' contract. StrictFIFO
+    keeps the NoFit heads re-popping every tick (BestEffortFIFO would
+    park them, which trivially empties the tick)."""
+
+    fw = Framework(batch_solver=BatchSolver())
+    fw.create_namespace("default", labels={})
+    fw.create_resource_flavor(make_flavor("on-demand"))
+    for i in range(3):
+        fw.create_cluster_queue(make_cq(
+            f"cq-{i}", rg("cpu", fq("on-demand", cpu=4)),
+            strategy="StrictFIFO"))
+        fw.create_local_queue(make_lq(f"lq-{i}", "default", cq=f"cq-{i}"))
+    # One admissible head per CQ fills the quota; the rest stay NoFit
+    # forever (nothing releases quota).
+    for i in range(3):
+        for j in range(3):
+            fw.submit(Workload(
+                name=f"w-{i}-{j}", namespace="default",
+                queue_name=f"lq-{i}", priority=0,
+                creation_time=float(10 * i + j),
+                pod_sets=[PodSet.make("ps0", count=1, cpu=4)]))
+    solver = fw.scheduler.batch_solver
+    for _ in range(12):
+        fw.tick()
+    # Steady state reached: the same NoFit heads re-pop with unchanged
+    # fingerprints — further ticks must do ZERO encode/solve work.
+    d0 = solver.dispatches
+    reused0 = solver.arena_rows_reused
+    missed0 = solver.arena_rows_missed
+    hits0 = solver.nominate_cache_hits
+    for _ in range(5):
+        fw.tick()
+    assert solver.dispatches == d0, "quiescent tick dispatched a solve"
+    assert solver.arena_rows_reused == reused0
+    assert solver.arena_rows_missed == missed0, \
+        "quiescent tick re-encoded arena rows"
+    assert solver.nominate_cache_hits - hits0 == 5 * 3
+    # The backlog is still live: releasing quota un-quiesces the system
+    # and the next head admits (the cache replays only while its
+    # fingerprint holds).
+    victim = fw.workloads["default/w-0-0"]
+    fw.finish(victim)
+    fw.delete_workload(victim)
+    fw.run_until_settled()
+    assert "default/w-0-1" in fw.admitted_workloads("cq-0")
 
 
 def test_arena_full_rebuild_on_structure_change():

@@ -188,8 +188,9 @@ def test_obs_good_fixture():
 def test_perf_bad_fixture():
     findings = run_analysis([str(FIXTURES / "perf_bad.py")])
     perf = [f for f in findings if f.rule == "PERF01"]
-    # direct subscript + 2 aliased reads + while-counter read
-    assert len(perf) == 4
+    # direct subscript + 2 aliased reads + while-counter read +
+    # per-entry flush walk
+    assert len(perf) == 5
     assert all("solver output tensor" in f.message for f in perf)
     assert all(f.severity.label == "error" for f in perf)
 

@@ -1,0 +1,119 @@
+"""Fingerprinted nominate cache: replay correctness + invalidation.
+
+The solver caches each head's verdict keyed on a usage-dependency
+fingerprint (BatchSolver._fingerprints); a head whose fingerprint is
+unchanged skips tensorize+solve+decode and replays. These tests pin the
+invalidation edge cases the fingerprint must catch — every event below
+must force a re-solve (and the re-solve must land the NEW decision):
+
+  * quota release in the head's cohort (usage generation),
+  * ClusterQueue quota edit (structural rotation),
+  * cohort membership change (structural rotation),
+  * delete_resource_flavor (structural rotation -> CQ inactive).
+
+The 200-tick randomized churn differential in tests/test_arena.py pins
+cache-vs-recompute decision-trail identity wholesale; these are the
+targeted per-event regressions.
+"""
+
+from kueue_tpu.api.types import PodSet, Workload
+from kueue_tpu.controllers.runtime import Framework
+from kueue_tpu.models.flavor_fit import BatchSolver
+
+from tests.util import fq, make_cq, make_flavor, make_lq, rg
+
+
+def _fw(*cqs):
+    fw = Framework(batch_solver=BatchSolver())
+    fw.create_namespace("default", labels={})
+    fw.create_resource_flavor(make_flavor("on-demand"))
+    for name, groups, cohort in cqs:
+        fw.create_cluster_queue(make_cq(name, *groups, cohort=cohort,
+                                        strategy="StrictFIFO"))
+        fw.create_local_queue(make_lq(f"lq-{name}", "default", cq=name))
+    return fw
+
+
+def _pend(fw, name, lq, cpu, **kw):
+    wl = Workload(name=name, namespace="default", queue_name=lq,
+                  priority=0, creation_time=kw.pop("creation_time", 1.0),
+                  pod_sets=[PodSet.make("ps0", count=1, cpu=cpu)])
+    fw.submit(wl)
+    return wl
+
+
+def _settle_cached(fw, ticks=6):
+    """Tick until the head replays from the cache; returns the solver."""
+    solver = fw.scheduler.batch_solver
+    for _ in range(ticks):
+        fw.tick()
+    h0 = solver.nominate_cache_hits
+    fw.tick()
+    assert solver.nominate_cache_hits > h0, \
+        "head never reached the replay steady state"
+    return solver
+
+
+def test_cache_replays_and_usage_release_invalidates():
+    fw = _fw(("cq", [rg("cpu", fq("on-demand", cpu=4))], ""))
+    blocker = _pend(fw, "blocker", "lq-cq", cpu=4)
+    fw.run_until_settled()
+    assert fw.admitted_workloads("cq") == ["default/blocker"]
+    waiter = _pend(fw, "waiter", "lq-cq", cpu=4, creation_time=2.0)
+    solver = _settle_cached(fw)
+    m0 = solver.nominate_cache_misses
+    # Quota release bumps the cohort usage generation: the waiter must
+    # re-solve (miss) and admit.
+    fw.finish(blocker)
+    fw.delete_workload(blocker)
+    fw.run_until_settled()
+    assert solver.nominate_cache_misses > m0
+    assert waiter.is_admitted
+
+
+def test_cluster_queue_quota_edit_invalidates():
+    fw = _fw(("cq", [rg("cpu", fq("on-demand", cpu=2))], ""))
+    waiter = _pend(fw, "waiter", "lq-cq", cpu=4)
+    solver = _settle_cached(fw)
+    m0 = solver.nominate_cache_misses
+    # Quota edit: structural mutation -> encoding rotation -> the cached
+    # NoFit verdict must NOT replay against the larger quota.
+    fw.update_cluster_queue(make_cq(
+        "cq", rg("cpu", fq("on-demand", cpu=8)), strategy="StrictFIFO"))
+    fw.run_until_settled()
+    assert solver.nominate_cache_misses > m0
+    assert waiter.is_admitted
+
+
+def test_cohort_membership_change_invalidates():
+    fw = _fw(
+        ("cq-a", [rg("cpu", fq("on-demand", cpu=2))], ""),
+        ("cq-b", [rg("cpu", fq("on-demand", cpu=8))], "co"),
+    )
+    waiter = _pend(fw, "waiter", "lq-cq-a", cpu=4)
+    solver = _settle_cached(fw)
+    m0 = solver.nominate_cache_misses
+    # Joining the cohort opens borrowing from cq-b's idle quota: the
+    # cached solo-CQ NoFit must not replay.
+    fw.update_cluster_queue(make_cq(
+        "cq-a", rg("cpu", fq("on-demand", cpu=2)), cohort="co",
+        strategy="StrictFIFO"))
+    fw.run_until_settled()
+    assert solver.nominate_cache_misses > m0
+    assert waiter.is_admitted
+    assert waiter.admission.cluster_queue == "cq-a"
+
+
+def test_delete_resource_flavor_invalidates():
+    fw = _fw(("cq", [rg("cpu", fq("on-demand", cpu=2))], ""))
+    waiter = _pend(fw, "waiter", "lq-cq", cpu=4)
+    _settle_cached(fw)
+    # Deleting the flavor deactivates the CQ (missing flavor): the next
+    # attempt must surface the inactive verdict, not the cached
+    # insufficient-quota one.
+    fw.delete_resource_flavor("on-demand")
+    for _ in range(3):
+        fw.tick()
+    cond = waiter.find_condition("QuotaReserved")
+    assert cond is not None and not cond.status
+    assert "inactive" in cond.message

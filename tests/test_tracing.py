@@ -206,7 +206,10 @@ def test_disabled_run_writes_nothing_to_ring():
     assert len(TRACER._loose) == 0
 
 
-def test_traced_tick_contains_pipeline_phases():
+def test_traced_tick_contains_pipeline_phases(monkeypatch):
+    # Force the CSR commit so the csr_rows span attribute is exercised
+    # regardless of whether the native ledger walk is built.
+    monkeypatch.setenv("KUEUE_TPU_CSR_ASSUME", "1")
     TRACER.configure(enabled=True)
     _scenario(batch=True)
     names = {s.name for rec in TRACER.ticks() for s in rec.spans}
@@ -245,6 +248,28 @@ def test_traced_tick_contains_pipeline_phases():
         and isinstance(ev["args"]["items"], int)
         and 0 < ev["args"]["cqs_flushed"] <= ev["args"]["items"]
         for ev in flushes)
+    # The nominate span carries the fingerprint-cache split: replayed
+    # heads vs the tick's total.
+    noms = [ev for ev in doc["traceEvents"]
+            if ev["name"] == "nominate" and ev["ph"] == "X"
+            and "heads_total" in ev.get("args", {})]
+    assert noms and all(
+        isinstance(ev["args"]["heads_cached"], int)
+        and isinstance(ev["args"]["heads_total"], int)
+        and 0 <= ev["args"]["heads_cached"] <= ev["args"]["heads_total"]
+        for ev in noms)
+    # The bulk-assume span names its commit shape: how many entries the
+    # cycle reserved and how many CSR coordinate rows the aggregated
+    # commit consumed (0 = the classic per-entry walk ran).
+    assumes = [ev for ev in doc["traceEvents"]
+               if ev["name"] == "admit.flush.assume" and ev["ph"] == "X"]
+    assert assumes and all(
+        isinstance(ev["args"]["entries"], int)
+        and isinstance(ev["args"]["csr_rows"], int)
+        and ev["args"]["entries"] > 0
+        for ev in assumes)
+    assert any(ev["args"]["csr_rows"] > 0 for ev in assumes), \
+        "no flush took the CSR commit path in the batched scenario"
 
 
 # ---------------------------------------------------------------------------
