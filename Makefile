@@ -9,8 +9,8 @@
 
 PYTHON ?= python
 
-.PHONY: help test test-fast bench bench-smoke trace-smoke native lint \
-	verify-static install serve dryrun
+.PHONY: help test test-fast bench bench-smoke trace-smoke multichip-smoke \
+	native lint verify-static install serve dryrun
 
 help:
 	@echo "kueue-tpu developer targets:"
@@ -26,6 +26,8 @@ help:
 	@echo "  make trace-smoke    end-to-end trace: run the CLI with"
 	@echo "                      --trace-out and schema-validate the"
 	@echo "                      Chrome trace-event export (Perfetto)"
+	@echo "  make multichip-smoke  8-shard cohort-mesh dryrun + sharded"
+	@echo "                      differential goldens on CPU host devices"
 	@echo "  make native         build the C++ runtime pieces"
 	@echo "  make serve          run the API server"
 	@echo "  make dryrun         compile-check the flagship jit path"
@@ -70,11 +72,28 @@ bench-smoke:
 	    f'steady-state nominate_cache_hit_ratio <= 0.8: {hit}'; \
 	  assert by[steady].get('solver_dispatches') == 0, \
 	    f'quiescent window dispatched solves: {by[steady]}'; \
-	  assert by[steady].get('quiescent_tick_ms') is not None, \
+	  q = by[steady].get('quiescent_tick_ms'); \
+	  assert q is not None, \
 	    'quiescent_tick_ms missing from the steady config'; \
+	  import os; \
+	  budget = float(os.environ.get('KUEUE_QUIESCENT_BUDGET_MS', '50')); \
+	  assert q <= budget, \
+	    f'quiescent tick {q}ms over the {budget}ms budget (the ' \
+	    f'nothing-changed fast path regressed)'; \
+	  assert by[steady].get('quiescent_ticks_replayed', 0) > 0, \
+	    'steady window never took the quiescent-tick replay path'; \
+	  shard = by[METRIC_NAMES['shard']]; \
+	  assert shard.get('shard_dispatches', 0) > 0 \
+	    and shard.get('shard_imbalance_ratio') is not None \
+	    and shard.get('reconcile_revocations') is not None, \
+	    f'shard config missing per-shard evidence: {shard}'; \
 	  print('bench-smoke arena gate OK:', ratios); \
 	  print('bench-smoke steady gate OK: hit_ratio', hit, \
-	        'quiescent_tick_ms', by[steady].get('quiescent_tick_ms'))"
+	        'quiescent_tick_ms', q, \
+	        'replayed', by[steady].get('quiescent_ticks_replayed')); \
+	  print('bench-smoke shard gate OK: imbalance', \
+	        shard.get('shard_imbalance_ratio'), 'scaling', \
+	        shard.get('p99_scaling_ratio'))"
 
 # End-to-end tracing smoke: drive the real CLI with span tracing on,
 # then prove the exported file is valid Chrome trace-event JSON (the
@@ -94,6 +113,18 @@ trace-smoke:
 	  names = {e['name'] for e in doc['traceEvents']}; \
 	  assert 'tick' in names and 'admit' in names, sorted(names); \
 	  print('trace-smoke OK:', len(doc['traceEvents']), 'events')"
+
+# Cohort-mesh smoke on CPU host devices: the 8-shard dryrun (sharded
+# solve bitwise-equal to single-device, hierarchy + lending-clamp probes
+# included) plus the sharded differential goldens and reconcile tests.
+# Runs in CI next to bench-smoke so the scale-out seam cannot rot on
+# hosts without an attached mesh.
+multichip-smoke:
+	JAX_PLATFORMS=cpu \
+	  XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+	  $(PYTHON) __graft_entry__.py
+	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_shard.py \
+	  tests/test_sharded_solve.py -q
 
 # Build the C++ runtime pieces (keyed heap, admission decoder) explicitly;
 # they are also built lazily on first import.

@@ -50,7 +50,8 @@ LINGER_TICKS = (4, 5, 6)
 def run_config(*, label, num_cqs, num_cohorts, num_flavors, backlog, ticks,
                usage_fill, depth, preemption_heavy, fair_hierarchy=False,
                lending=False, topology=False, strict_fifo=False,
-               no_preemption=False, churn_enabled=True, seed=42):
+               no_preemption=False, churn_enabled=True, seed=42,
+               shards=None):
     from kueue_tpu.models.flavor_fit import BatchSolver
     from kueue_tpu.api.types import PodSet, Workload
     from kueue_tpu.utils.synthetic import synthetic_framework
@@ -68,7 +69,7 @@ def run_config(*, label, num_cqs, num_cohorts, num_flavors, backlog, ticks,
         preemption_heavy=preemption_heavy, fair_hierarchy=fair_hierarchy,
         lending=lending, topology=topology, strict_fifo=strict_fifo,
         no_preemption=no_preemption,
-        batch_solver=BatchSolver(), pipeline_depth=depth)
+        batch_solver=BatchSolver(shards=shards), pipeline_depth=depth)
     t_setup = time.perf_counter() - t0
 
     inject_ms = float(os.environ.get("KUEUE_BENCH_INJECT_MS", "0") or 0)
@@ -237,6 +238,11 @@ def run_config(*, label, num_cqs, num_cohorts, num_flavors, backlog, ticks,
     nom_misses_before = getattr(solver, "nominate_cache_misses", 0) \
         if solver else 0
     dispatches_before = getattr(solver, "dispatches", 0) if solver else 0
+    # Cohort-shard evidence: per-shard head sums / imbalance-ratio sums
+    # over the window, plus the reconcile pass's revocation count.
+    shard_before = solver.shard_stats() if solver and shards else None
+    revoked_before = fw.scheduler.metrics.reconcile_revocations
+    quiescent_before = fw.scheduler.metrics.quiescent_ticks
     tick_phases = []
     base_admitted = fw.scheduler.metrics.admitted
 
@@ -428,6 +434,11 @@ def run_config(*, label, num_cqs, num_cohorts, num_flavors, backlog, ticks,
         "quiescent_tick_ms": (round(quiescent_tick_ms, 3)
                               if quiescent_tick_ms is not None else None),
         "admissions_per_s": round(admitted / (sum(times) or 1e-9), 1),
+        # Quiescent-tick fast-path evidence: how many measured ticks
+        # replayed the previous provably-identical outcome instead of
+        # recomputing sort/admit/requeue bookkeeping.
+        "quiescent_ticks_replayed": (
+            fw.scheduler.metrics.quiescent_ticks - quiescent_before),
         # Derived from tracer phase spans (the kueue_tick_phase_seconds
         # histogram is fed exclusively by TRACER.phase — one measurement
         # serves metrics, bench and the trace export).
@@ -439,6 +450,34 @@ def run_config(*, label, num_cqs, num_cohorts, num_flavors, backlog, ticks,
     }
     if overhead is not None:
         stats["tracer_overhead"] = overhead
+    if shard_before is not None:
+        sa = solver.shard_stats()
+        d = sa["shard_dispatches"] - shard_before["shard_dispatches"]
+        h1 = sa["shard_heads_sum"]
+        h0 = shard_before["shard_heads_sum"]
+        h0 = h0 + [0] * (len(h1) - len(h0))
+        heads_delta = [a - b for a, b in zip(h1, h0)]
+        stats.update({
+            # Per-shard dispatch evidence for the measured window: mean
+            # heads per shard per dispatch, the mean per-dispatch
+            # imbalance ratio (max/mean shard load), the last per-shard
+            # padded bucket, the dispatch/solve phase means the sharded
+            # program rode, and the reconcile pass's revocations.
+            "shard_dispatches": d,
+            "shard_heads_mean": ([round(h / d, 2) for h in heads_delta]
+                                 if d else heads_delta),
+            "shard_imbalance_ratio": (round(
+                (sa["shard_imbalance_sum"]
+                 - shard_before["shard_imbalance_sum"]) / d, 3)
+                if d else None),
+            "shard_bucket": sa["shard_bucket_last"],
+            "shard_phase_means_ms": {
+                k: round(phase_means.get(k, 0.0), 3)
+                for k in ("tensorize.dispatch", "device_solve")},
+            "reconcile_revocations": (
+                fw.scheduler.metrics.reconcile_revocations
+                - revoked_before),
+        })
     print(
         f"# [{label}] {num_cqs} CQs x {num_cohorts} cohorts x {num_flavors} "
         f"flavors, backlog {backlog}, {ticks} ticks on "
@@ -465,11 +504,66 @@ METRIC_NAMES = {
     "fair": "p99_fair_hier_tick_ms",
     "topo": "p99_topology_tick_ms",
     "steady": "p99_steady_state_tick_ms",
+    "shard": "p99_sharded_tick_ms",
     "northstar": "p99_e2e_tick_ms",
 }
 
 
+def _shard_identity_gate(n_shards: int, ticks: int = 25) -> int:
+    """Drive the golden seed through shards=N and shards=1 and FAIL the
+    bench if they admit different workload sets — the decision-identity
+    contract the differential goldens pin at test scale, re-checked on
+    every bench run at bench scale. Returns the admitted count."""
+    from kueue_tpu.models.flavor_fit import BatchSolver
+    from kueue_tpu.utils.synthetic import synthetic_framework
+
+    def admitted_set(shards):
+        fw = synthetic_framework(
+            num_cqs=24, num_cohorts=6, num_flavors=4, num_pending=256,
+            usage_fill=0.7, seed=7, preemption_heavy=False,
+            batch_solver=BatchSolver(shards=shards), pipeline_depth=2)
+        keys = set()
+        orig = fw.scheduler.apply_admission
+
+        def hook(wl):
+            ok = orig(wl)
+            if ok:
+                keys.add(wl.key)
+            return ok
+
+        fw.scheduler.apply_admission = hook
+        for _ in range(ticks):
+            fw.tick()
+            fw.prewarm_idle()
+        return keys
+
+    sharded = admitted_set(n_shards)
+    single = admitted_set(1)
+    if sharded != single:
+        raise RuntimeError(
+            f"[shard] shards={n_shards} and shards=1 admitted DIFFERENT "
+            f"workload sets on the golden seed "
+            f"(only-sharded={sorted(sharded - single)[:5]}, "
+            f"only-single={sorted(single - sharded)[:5]}) — the "
+            "cohort-sharded solve or the two-phase reconcile broke "
+            "decision identity; do not trust this run.")
+    return len(sharded)
+
+
 def run_one(config: str) -> None:
+    if config == "shard":
+        # The cohort mesh needs its devices BEFORE the backend
+        # initializes; on the CPU backend that is the
+        # host-platform-device-count trick (same as conftest.py and the
+        # multichip dryrun).
+        n_sh = int(os.environ.get("KUEUE_TPU_SHARDS", "8") or 8)
+        if os.environ.get("KUEUE_BENCH_FORCE_CPU") == "1" \
+                or os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
+            xf = os.environ.get("XLA_FLAGS", "")
+            if "host_platform_device_count" not in xf:
+                os.environ["XLA_FLAGS"] = (
+                    xf + " --xla_force_host_platform_device_count"
+                    f"={n_sh}").strip()
     if os.environ.get("KUEUE_BENCH_FORCE_CPU") == "1":
         # The parent's device probe found the accelerator unreachable
         # (e.g. a remote-attachment outage). Pin the CPU backend through
@@ -551,6 +645,60 @@ def run_one(config: str) -> None:
             depth=depth, preemption_heavy=False, strict_fifo=True,
             no_preemption=True, churn_enabled=False, **shape),
             target_ms=15.0)
+    elif config == "shard":
+        # Cohort-sharded scale axis (ROADMAP item 1): the same admission
+        # mix at the northstar-ish backlog and again at 4x backlog /
+        # more CQs, both on the cohort mesh — near-flat p99 across the
+        # two windows is the tentpole's scaling contract. The identity
+        # gate re-proves shards=N == shards=1 decisions on every run.
+        n_sh = int(os.environ.get("KUEUE_TPU_SHARDS", "8") or 8)
+        identity_admitted = _shard_identity_gate(n_sh)
+        if smoke:
+            small = dict(num_cqs=32, num_cohorts=8, num_flavors=4,
+                         backlog=512)
+            large = dict(num_cqs=64, num_cohorts=16, num_flavors=4,
+                         backlog=2048)
+        else:
+            small = dict(num_cqs=1000, num_cohorts=100, num_flavors=8,
+                         backlog=50_000)
+            large = dict(num_cqs=2000, num_cohorts=200, num_flavors=8,
+                         backlog=200_000)
+        w_ticks = max(ticks // 2, 8)
+        s_small = run_config(label="shard", ticks=w_ticks, usage_fill=0.7,
+                             depth=depth, preemption_heavy=False,
+                             shards=n_sh, **small)
+        s_large = run_config(label="shard4x", ticks=w_ticks,
+                             usage_fill=0.7, depth=depth,
+                             preemption_heavy=False, shards=n_sh, **large)
+        backlog_ratio = large["backlog"] / small["backlog"]
+        p99_ratio = (s_large["p99_ms"] / s_small["p99_ms"]
+                     if s_small["p99_ms"] else None)
+        s_large.update({
+            "n_shards": n_sh,
+            "identity_gate_admitted": identity_admitted,
+            "small_window": {"backlog": small["backlog"],
+                             "num_cqs": small["num_cqs"],
+                             "p50_ms": s_small["p50_ms"],
+                             "p99_ms": s_small["p99_ms"],
+                             "shard_imbalance_ratio":
+                                 s_small.get("shard_imbalance_ratio"),
+                             "reconcile_revocations":
+                                 s_small.get("reconcile_revocations")},
+            "backlog_ratio": backlog_ratio,
+            "p99_scaling_ratio": (round(p99_ratio, 3)
+                                  if p99_ratio is not None else None),
+        })
+        # Sublinear-scaling gate (full scale only: smoke shapes are too
+        # small for stable percentiles): 4x backlog must cost < 4x p99.
+        if not smoke and p99_ratio is not None \
+                and p99_ratio >= backlog_ratio:
+            raise RuntimeError(
+                f"[shard] p99 scaled superlinearly with backlog: "
+                f"{s_small['p99_ms']:.1f}ms -> {s_large['p99_ms']:.1f}ms "
+                f"(x{p99_ratio:.2f} for x{backlog_ratio:.0f} backlog) — "
+                "the cohort-sharded solve is not absorbing the scale "
+                "axis it exists for.")
+        emit(METRIC_NAMES[config], s_large)
     else:
         # North-star headline (config #5 shape): LAST line = parsed metric.
         emit(METRIC_NAMES["northstar"], run_config(
@@ -591,7 +739,7 @@ def main() -> None:
               "backend for this run", file=sys.stderr)
         env_extra["KUEUE_BENCH_FORCE_CPU"] = "1"
     for config in ("single", "cohortlend", "preempt", "fair", "topo",
-                   "steady", "northstar"):
+                   "steady", "shard", "northstar"):
         env = dict(os.environ, KUEUE_BENCH_CONFIG=config, **env_extra)
         try:
             # Generous ceiling: a healthy config finishes in minutes; a

@@ -201,6 +201,29 @@ def _build_flavor_fit_packed(w: int):
     return fn, statics + (None, np.zeros(nb, np.uint8))
 
 
+def _build_cohort_shard(w: int):
+    import functools
+
+    import numpy as np
+
+    import kueue_tpu.ops  # noqa: F401
+    from kueue_tpu.parallel.mesh import shard_solve_body
+
+    C, F, R, G, S, K, P = 4, 4, 3, 2, 2, 3, 2
+    z64 = lambda s: np.zeros(s, np.int64)  # noqa: E731
+    z32 = lambda s: np.zeros(s, np.int32)  # noqa: E731
+    zb = lambda s: np.zeros(s, bool)  # noqa: E731
+    args = (z64((C, F, R)), z64((C, F, R)), z64((C, F, R)), z64((C, F, R)),
+            z32(C), z32((C, R)), z32((C, G, S)), z32((C, G)),
+            zb(C), zb(C), zb(C),
+            None, z64((C, F, R)),
+            z32(w), z64((w, P, R)), zb((w, P, R)),
+            zb((w, P)), zb((w, P)), zb((w, P, G, S)), z32((w, P, G)))
+    fn = functools.partial(shard_solve_body, num_slots=S, num_cohorts=K,
+                           fungibility_enabled=True)
+    return fn, args
+
+
 def _build_topology(n: int):
     import functools
 
@@ -254,6 +277,16 @@ def package_roster() -> List[KernelSpec]:
             anchor=_module_file("kueue_tpu.models.flavor_fit"),
             build=_build_flavor_fit_packed, buckets=(8, 16),
             rules=NO_TRC02),
+        KernelSpec(
+            # The cohort-sharded per-shard body (parallel/mesh): one
+            # shard's compacted block at its per-shard padded bucket —
+            # TRC03 across its buckets pins the one-compile-per-bucket
+            # contract PER SHARD, and tests/test_shard.py additionally
+            # pins that the lowered body is shard-count-independent.
+            name="cohort-shard-solve",
+            anchor=_module_file("kueue_tpu.parallel.mesh"),
+            build=_build_cohort_shard, buckets=(8, 16),
+            seeds={1: sentinel}),
         KernelSpec(
             name="topology-fit",
             anchor=_module_file("kueue_tpu.topology.fit"),

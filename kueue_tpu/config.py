@@ -144,6 +144,14 @@ class TPUSolverConfig:
     # on-device cohort psum/all_gather over ICI; workload batch
     # data-parallel). 0/1 = single-device; -1 = all visible devices.
     shard_devices: int = 0
+    # Cohort-sharded solve (parallel/mesh.CohortMesh — the production
+    # scale-out path): the batch is partitioned by cohort hash into
+    # per-shard compacted blocks, one device each, with NO collectives;
+    # the admit cycle goes two-phase (optimistic per-shard, global
+    # lending-clamp reconcile) for hierarchical trees the hash splits.
+    # 0/1 = single-device; -1 = all visible devices. Kill switch:
+    # KUEUE_TPU_NO_SHARD=1.
+    cohort_shards: int = 0
 
 
 @dataclass(frozen=True)
@@ -331,7 +339,8 @@ def from_dict(doc: Mapping[str, Any]) -> Configuration:
             enable=None if enable is None else bool(enable),
             pipeline_depth=int(t.get("pipelineDepth", 1)),
             preemption_engine=t.get("preemptionEngine"),
-            shard_devices=int(t.get("shardDevices", 0)))
+            shard_devices=int(t.get("shardDevices", 0)),
+            cohort_shards=int(t.get("cohortShards", 0)))
 
     mc = MetricsConfig()
     if isinstance(doc.get("metrics"), dict):
@@ -481,6 +490,13 @@ def validate_configuration(cfg: Configuration) -> List[str]:
     if cfg.tpu_solver.shard_devices < -1:
         errors.append("tpuSolver.shardDevices: must be -1 (all devices), "
                       "0/1 (single device), or a positive device count")
+    if cfg.tpu_solver.cohort_shards < -1:
+        errors.append("tpuSolver.cohortShards: must be -1 (all devices), "
+                      "0/1 (single device), or a positive shard count")
+    if cfg.tpu_solver.cohort_shards not in (0, 1) \
+            and cfg.tpu_solver.shard_devices not in (0, 1):
+        errors.append("tpuSolver.cohortShards and tpuSolver.shardDevices "
+                      "are mutually exclusive sharding modes")
 
     # leaderElection
     le = cfg.leader_election

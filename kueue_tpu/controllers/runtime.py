@@ -127,7 +127,9 @@ class Framework:
                     # aggregation via ICI collectives).
                     from kueue_tpu.parallel.mesh import make_mesh
                     mesh = make_mesh(None if shard == -1 else shard)
-                batch_solver = BatchSolver(mesh=mesh)
+                batch_solver = BatchSolver(
+                    mesh=mesh,
+                    shards=self.config.tpu_solver.cohort_shards)
         if getattr(batch_solver, "_mesh", None) is not None:
             # The sharded program runs to completion at dispatch (its
             # collectives ride ICI; there is no host-link round trip to

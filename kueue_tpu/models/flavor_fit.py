@@ -665,7 +665,8 @@ class BatchSolver:
 
     def __init__(self, mesh=None, use_arena: Optional[bool] = None,
                  use_admit_arena: Optional[bool] = None,
-                 use_nominate_cache: Optional[bool] = None):
+                 use_nominate_cache: Optional[bool] = None,
+                 shards: Optional[int] = None):
         """`mesh` (a jax.sharding.Mesh, e.g. parallel.mesh.make_mesh())
         shards every solve over the mesh's devices: ClusterQueue usage is
         partitioned on the CQ axis with on-device cohort aggregation
@@ -687,7 +688,16 @@ class BatchSolver:
         `use_nominate_cache` toggles the fingerprinted nominate cache
         (default on, or KUEUE_TPU_NO_NOMINATE_CACHE=1): a head whose
         usage-dependency fingerprint is unchanged since its last solve
-        skips tensorize+solve+decode and replays its cached verdict."""
+        skips tensorize+solve+decode and replays its cached verdict.
+
+        `shards` activates the cohort-sharded solve (parallel/mesh.
+        CohortMesh): every solve runs as per-shard compacted blocks over
+        a cohort-hash device mesh (no collectives — cohorts never split),
+        and the scheduler's admit cycle goes two-phase for the
+        hierarchical trees that DO span shards (optimistic per-shard
+        solve, then the lending-clamp reconcile). -1 = all visible
+        devices; 0/1/None = single-device. Env: KUEUE_TPU_SHARDS sets a
+        default, KUEUE_TPU_NO_SHARD=1 kills the path entirely."""
         self._key = None
         self._enc: Optional[sch.CQEncoding] = None
         self._static: Optional[tuple] = None
@@ -695,6 +705,37 @@ class BatchSolver:
         self._row_cache: Optional[sch.WorkloadRowCache] = None
         self._preempt_ctx = None
         self._mesh = mesh
+        # Cohort-sharded solve (the production scale-out path). Built
+        # eagerly so a misconfigured shard count fails at construction,
+        # not inside the first tick.
+        if not shards and mesh is None:
+            # Unset (None/0) falls back to the env default, so operators
+            # can turn the mesh on without a config edit — but never
+            # behind an explicitly configured legacy `mesh`: the two
+            # sharding modes are mutually exclusive (the config layer
+            # rejects the pair, and a stray bench env var must not
+            # silently flip the engine).
+            env = os.environ.get("KUEUE_TPU_SHARDS", "")
+            shards = int(env) if env else 0
+        if os.environ.get("KUEUE_TPU_NO_SHARD", "") == "1":
+            shards = 0
+        self._cohort_mesh = None
+        if shards == -1 or shards > 1:
+            if mesh is not None:
+                raise ValueError(
+                    "cohort shards and a wl-axis mesh are mutually "
+                    "exclusive sharding modes — pass one of them")
+            from kueue_tpu.parallel.mesh import CohortMesh
+            self._cohort_mesh = CohortMesh(
+                None if shards == -1 else shards)
+        # Per-shard dispatch evidence (the `shard` bench config reads the
+        # deltas per window): dispatch count, per-shard head sums, and
+        # the running sum of per-dispatch imbalance ratios
+        # (max_shard_heads / mean_shard_heads).
+        self.shard_dispatches = 0
+        self.shard_heads_sum: Optional[np.ndarray] = None
+        self.shard_imbalance_sum = 0.0
+        self.shard_bucket_last = 0
         # Incremental workload arena (the tensorize.encode fast path).
         if use_arena is None:
             use_arena = os.environ.get("KUEUE_TPU_NO_ARENA", "") != "1"
@@ -793,6 +834,16 @@ class BatchSolver:
                 self._rebuild_arena(snapshot)
             if self._use_admit_arena:
                 self._rebuild_admit_arena()
+            if self._cohort_mesh is not None:
+                # One shard assignment per encoding generation; both
+                # arenas maintain per-shard views off the same sink
+                # events from here on.
+                a = self._cohort_mesh.assignment(self._enc)
+                if self._arena is not None:
+                    self._arena.bind_shards(a.shard_of_cq, a.n_shards)
+                if self._admit_arena is not None:
+                    self._admit_arena.bind_shards(a.shard_of_cq,
+                                                  a.n_shards)
         return self._enc
 
     def _rebuild_admit_arena(self) -> None:
@@ -1052,7 +1103,40 @@ class BatchSolver:
         # candidate; refreshed here because the arena rotates with the
         # encoding while the context may be cached across calls.
         self._preempt_ctx.admitted_arena = self._admit_arena
+        # Cohort-mesh victim search: the packed-XLA batch scan shards
+        # over the same cohort-hash mesh (a search's whole member/
+        # candidate set lives in its target's cohort, hence one shard).
+        self._preempt_ctx.cohort_mesh = self._cohort_mesh
+        self._preempt_ctx.shard_assignment = (
+            self._cohort_mesh.assignment(enc)
+            if self._cohort_mesh is not None else None)
         return self._preempt_ctx, self._usage_enc.usage
+
+    def shard_view(self, snapshot: Snapshot):
+        """(ShardAssignment, cq_index) for the admit cycle's two-phase
+        reconcile, or None when the cohort mesh is off, the encoding does
+        not match this snapshot, or topology is active (the topology
+        cycle ledger charges in strict entry order, so those snapshots
+        keep the single-phase cycle)."""
+        cm = self._cohort_mesh
+        enc = self._enc
+        if cm is None or enc is None or snapshot.topology is not None:
+            return None
+        if not self.encoding_matches(snapshot):
+            return None
+        return cm.assignment(enc), enc.cq_index
+
+    def shard_stats(self) -> dict:
+        """Cumulative per-shard dispatch evidence for the bench (window
+        deltas are the caller's job)."""
+        heads = self.shard_heads_sum
+        return {
+            "shard_dispatches": self.shard_dispatches,
+            "shard_heads_sum": ([] if heads is None
+                                else heads.tolist()),
+            "shard_imbalance_sum": self.shard_imbalance_sum,
+            "shard_bucket_last": self.shard_bucket_last,
+        }
 
     # Nominate-cache backstop (cleared wholesale, the row-cache
     # discipline); entries are also pruned by queue delete events.
@@ -1199,7 +1283,40 @@ class BatchSolver:
                     self._p_floor = max(self._p_floor, wt.req.shape[1])
                 with TRACER.phase("tensorize.dispatch"):
                     self.dispatches += 1
-                    if self._mesh is not None:
+                    if self._cohort_mesh is not None:
+                        # Cohort-sharded: per-shard compacted blocks over
+                        # the cohort-hash mesh (no collectives; outputs
+                        # return in original row order, so everything
+                        # downstream is byte-identical).
+                        from kueue_tpu.parallel.mesh import \
+                            cohort_sharded_solve
+                        out, sstats = cohort_sharded_solve(
+                            enc, usage, wt, self._cohort_mesh)
+                        counts = sstats["shard_heads"]
+                        Ws = sstats["shard_bucket"]
+                        self.shard_dispatches += 1
+                        if self.shard_heads_sum is None or \
+                                len(self.shard_heads_sum) != len(counts):
+                            self.shard_heads_sum = np.zeros(
+                                len(counts), dtype=np.int64)
+                        self.shard_heads_sum += counts
+                        total = int(counts.sum())
+                        if total:
+                            self.shard_imbalance_sum += float(
+                                counts.max() * len(counts)) / total
+                        self.shard_bucket_last = Ws
+                        key = ("cs", sstats["n_shards"], Ws,
+                               wt.req.shape[1],
+                               features.enabled(
+                                   features.FLAVOR_FUNGIBILITY))
+                        with self._warm_lock:
+                            if key not in self._warm_keys:
+                                cold = True
+                                self.cold_dispatches += 1
+                                self._warm_keys.add(key)
+                        self._maybe_prewarm_sharded(
+                            key, int(counts.max()))
+                    elif self._mesh is not None:
                         # Multi-chip: the sharded program runs to
                         # completion here (its collectives ride ICI, not
                         # the host link, so there is no tunnel round trip
@@ -1229,8 +1346,12 @@ class BatchSolver:
             # an operator reading a slow tick sees WHICH padded shape
             # dispatched and whether it compiled in-tick — plus the
             # nominate-cache split (hit heads never reached the device).
-            sp.set("engine", "sharded-mesh" if self._mesh is not None
+            sp.set("engine", "cohort-shard"
+                   if self._cohort_mesh is not None
+                   else "sharded-mesh" if self._mesh is not None
                    else "batch-packed-xla")
+            if self._cohort_mesh is not None and wt is not None:
+                sp.set("shard_bucket", self.shard_bucket_last)
             sp.set("bucket", list(wt.req.shape) if wt is not None else [])
             sp.set("heads", len(miss_workloads))
             sp.set("heads_cached",
@@ -1269,6 +1390,23 @@ class BatchSolver:
                 if nkey not in self._warm_keys:
                     self._prewarm_pending.add(nkey)
 
+    def _maybe_prewarm_sharded(self, key: tuple, max_shard_n: int) -> None:
+        """The cohort-sharded twin of `_maybe_prewarm`: queue neighbor
+        PER-SHARD buckets when the largest shard's head count drifts
+        within 1/8 bucket of a rotation boundary."""
+        Ws = key[2]
+        targets = []
+        if max_shard_n >= Ws - max(1, Ws // 8) \
+                and Ws * 2 <= self.PREWARM_MAX_BUCKET:
+            targets.append(Ws * 2)
+        if Ws > 8 and max_shard_n <= Ws // 2 + max(1, Ws // 8):
+            targets.append(Ws // 2)
+        for Wn in targets:
+            nkey = key[:2] + (Wn,) + key[3:]
+            with self._warm_lock:
+                if nkey not in self._warm_keys:
+                    self._prewarm_pending.add(nkey)
+
     def prewarm_idle(self) -> int:
         """Compile any queued neighbor buckets NOW (synchronously) — call
         from the idle window between ticks (Scheduler.prewarm_idle /
@@ -1294,6 +1432,20 @@ class BatchSolver:
         from kueue_tpu.tracing import TRACER
 
         with TRACER.span("solver.prewarm_compile") as sp:
+            if nkey[0] == "cs":
+                # Cohort-sharded bucket: ("cs", n_shards, Ws, P, fung).
+                sp.set("bucket", list(nkey[1:4]))
+                try:
+                    from kueue_tpu.parallel.mesh import \
+                        prewarm_cohort_program
+                    prewarm_cohort_program(self._enc, self._cohort_mesh,
+                                           nkey[2], nkey[3], nkey[4])
+                except Exception:
+                    sp.set("failed", True)
+                    return
+                with self._warm_lock:
+                    self._warm_keys.add(nkey)
+                return
             sp.set("bucket", list(nkey[:3]))
             try:
                 W, P, R, G, K, S, fung = nkey[:7]
@@ -1322,6 +1474,23 @@ class BatchSolver:
             return
         enc = self._encoding_for(snapshot)
         fung = features.enabled(features.FLAVOR_FUNGIBILITY)
+        if self._cohort_mesh is not None:
+            # Per-shard buckets: an even split is the best startup guess
+            # (the real bucket is pow2 of the LARGEST shard's heads; the
+            # first warm ticks and _maybe_prewarm_sharded cover drift).
+            n_sh = self._cohort_mesh.n_shards
+            done_s = set()
+            for hc in head_counts:
+                Ws = sch._pad_pow2(max((int(hc) + n_sh - 1) // n_sh, 1))
+                key = ("cs", n_sh, Ws, max(podsets, 1), fung)
+                if key in done_s:
+                    continue
+                done_s.add(key)
+                with self._warm_lock:
+                    if key in self._warm_keys:
+                        continue
+                self._prewarm_one(key)
+            return
         R = len(enc.resource_names)
         C, F = enc.nominal.shape[0], enc.nominal.shape[1]
         done = set()

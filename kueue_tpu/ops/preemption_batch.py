@@ -121,6 +121,15 @@ class BatchContext:
         # candidate (the rows carry the same configured-pair filter the
         # walk applies).
         self.admitted_arena = None
+        # Optional cohort mesh (parallel/mesh.CohortMesh) + its
+        # ShardAssignment, refreshed by BatchSolver per call: a victim
+        # search reads only its target's cohort (members + candidates),
+        # so the packed-XLA batch shards over the same cohort-hash mesh
+        # as the flavor-fit solve — per-shard compacted search blocks,
+        # no collectives. The native C++ engine ignores these (it has no
+        # device to shard over).
+        self.cohort_mesh = None
+        self.shard_assignment = None
 
     def pair_index(self, fname: str, rname: str) -> Optional[int]:
         fi = self.enc.flavor_index.get(fname)
@@ -185,6 +194,43 @@ def _packed_batch_kernel(buf, *, shapes, lending):
         blim, blim_def, requestable, res_mask,
         cand_y, cand_use, cand_prio, cand_valid,
         has_cohort, lending_b, allow_b0, has_threshold, threshold)
+
+
+_SHARDED_SCAN_CACHE: Dict[Tuple, object] = {}
+
+
+def _sharded_scan_program(cmesh, lending: bool):
+    """The cohort-sharded packed victim scan: shard_map over the search
+    axis (each device runs the vmapped `_scan_core` on its shard's
+    compacted block). Cached per (mesh, lending) — shapes re-trace under
+    the jit like the single-device kernel."""
+    key = (id(cmesh.mesh), cmesh.n_shards, lending)
+    program = _SHARDED_SCAN_CACHE.get(key)
+    if program is not None:
+        return program
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from kueue_tpu.parallel.mesh import SHARD_AXIS
+
+    sharded = P(SHARD_AXIS)
+
+    def run(usage0, nominal, q_def, guaranteed, wl_req, wl_req_mask,
+            blim, blim_def, requestable, res_mask,
+            cand_y, cand_use, cand_prio, cand_valid,
+            has_cohort, allow_b0, has_threshold, threshold):
+        lending_b = jnp.full(usage0.shape[0], lending)
+        return jax.vmap(_scan_core)(
+            usage0, nominal, q_def, guaranteed, wl_req, wl_req_mask,
+            blim, blim_def, requestable, res_mask,
+            cand_y, cand_use, cand_prio, cand_valid,
+            has_cohort, lending_b, allow_b0, has_threshold, threshold)
+
+    program = jax.jit(shard_map(
+        run, mesh=cmesh.mesh, in_specs=(sharded,) * 18,
+        out_specs=sharded, check_rep=False))
+    _SHARDED_SCAN_CACHE[key] = program
+    return program
 
 
 def run_batch(ctx: BatchContext, usage: np.ndarray,
@@ -346,6 +392,45 @@ def run_batch(ctx: BatchContext, usage: np.ndarray,
             out_native.append(
                 [cand for i, cand in enumerate(s.candidates) if mask[i]])
         return out_native
+
+    cmesh = ctx.cohort_mesh
+    if cmesh is not None and ctx.shard_assignment is not None \
+            and cmesh.n_shards > 1 and B_real >= cmesh.n_shards:
+        # Cohort-sharded dispatch: searches grouped by their target's
+        # shard into per-shard compacted blocks — the SAME plan the
+        # flavor-fit solve uses (parallel/mesh.plan_shards), with the
+        # search's target CQ as the row — results mapped back to search
+        # order.
+        from kueue_tpu.parallel.mesh import plan_shards
+        target_cis = np.fromiter((s.target_ci for s in searches),
+                                 dtype=np.int32, count=B_real)
+        rows, _counts, Bs = plan_shards(ctx.shard_assignment, target_cis,
+                                        B_real, min_bucket=1)
+        SB = cmesh.n_shards * Bs
+
+        def scat(a):
+            out = np.zeros((SB,) + a.shape[1:], dtype=a.dtype)
+            out[rows] = a[:B_real]
+            return out
+
+        program = _sharded_scan_program(cmesh, ctx.lending)
+        victim, fits = program(*(jnp.asarray(scat(a)) for a in (
+            usage0, nominal, q_def, guaranteed, wl_req, wl_req_mask,
+            blim, blim_def, requestable, res_mask,
+            cand_y, cand_use, cand_prio, cand_valid,
+            has_cohort, allow_b0, has_threshold, threshold)))
+        victim, fits = jax.device_get((victim, fits))
+        victim = victim[rows]
+        fits = fits[rows]
+        out_sharded: List[Optional[List[WorkloadInfo]]] = []
+        for b, s in enumerate(searches):
+            if not fits[b]:
+                out_sharded.append([])
+                continue
+            mask = victim[b]
+            out_sharded.append(
+                [c for i, c in enumerate(s.candidates) if mask[i]])
+        return out_sharded
 
     # ONE host->device transfer: every section packed into a byte buffer
     # and bitcast apart on device — per-array transfers are round trips on

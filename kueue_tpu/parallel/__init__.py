@@ -1,3 +1,10 @@
 """Device-mesh sharding of the admission solve."""
 
-from kueue_tpu.parallel.mesh import make_mesh, sharded_flavor_fit
+from kueue_tpu.parallel.mesh import (
+    CohortMesh,
+    ShardAssignment,
+    assign_shards,
+    cohort_sharded_solve,
+    make_mesh,
+    sharded_flavor_fit,
+)

@@ -22,7 +22,9 @@ re-dispatch without re-tracing.
 from __future__ import annotations
 
 import functools
-from typing import Dict, Optional, Tuple
+import zlib
+from dataclasses import dataclass
+from typing import Dict, FrozenSet, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -34,6 +36,7 @@ from kueue_tpu import features
 from kueue_tpu.models.flavor_fit import solve_core
 
 AXIS = "wl"
+SHARD_AXIS = "shard"
 
 _PROGRAM_CACHE: Dict[Tuple, "jax.stages.Wrapped"] = {}
 
@@ -179,3 +182,337 @@ def sharded_flavor_fit(enc, usage_tensors, wt, mesh: Mesh) -> Dict[str, np.ndarr
     out = program(*args)
     return {k: np.asarray(v)[:W] if v.ndim >= 1 else np.asarray(v)
             for k, v in out.items()}
+
+
+# ---------------------------------------------------------------------------
+# Cohort-sharded solve: shard_map over a cohort-hash device mesh
+# ---------------------------------------------------------------------------
+#
+# The production scale-out seam (ROADMAP item 1): the admission problem
+# partitions cleanly by cohort — a workload's fit reads only its own
+# ClusterQueue's row and its cohort's member rows, never another cohort's
+# — so hashing cohorts onto a device mesh makes the whole batched solve
+# embarrassingly parallel: each shard solves its own cohorts' workloads as
+# a compacted, per-shard-padded block, with NO collectives at all (the
+# `wl`-axis mesh above needed psum/all_gather because it split cohorts
+# mid-aggregate; the cohort hash never does). The only cross-shard step
+# left is the host-side lending-clamp reconcile of the admission cycle
+# (scheduler._admission_cycle phase B), which is O(deferred entries), not
+# O(backlog).
+#
+# Hierarchical cohort forests (KEP-79) hash by DIRECT cohort name, so one
+# tree's subtrees may land on different shards. That is deliberate: the
+# tree is the one structure whose quota math spans cohorts, and the
+# two-phase admit cycle (optimistic per-shard solve, then a global clamp
+# pass that revokes over-borrowed admissions) is exactly Aryl's
+# cluster-level capacity-loaning loop mapped onto the mesh. `split_roots`
+# names the trees that need it.
+
+
+def _crc_shard(name: str, n_shards: int) -> int:
+    """Stable cohort-name hash (process-independent: two scheduler
+    replicas must agree on the shard of every cohort)."""
+    return zlib.crc32(name.encode("utf-8")) % n_shards
+
+
+@dataclass(frozen=True)
+class ShardAssignment:
+    """Cohort-hash shard assignment for one CQ-encoding generation."""
+
+    n_shards: int
+    shard_of_cohort: np.ndarray        # [K] i32
+    shard_of_cq: np.ndarray            # [C] i32
+    # Hierarchical cohort roots whose member CQs span >1 shard: the only
+    # structures whose admission bookkeeping crosses shards, hence the
+    # only entries the admit cycle routes through the reconcile pass.
+    split_roots: FrozenSet[str]
+
+
+def assign_shards(enc, n_shards: int) -> ShardAssignment:
+    """Hash the encoding's cohorts onto `n_shards` shards.
+
+    Flat cohorts (including the `__solo__/` singletons of cohort-less
+    ClusterQueues) are self-contained — every CQ a workload's fit can
+    read lives on its own shard. Hierarchical trees hash by direct
+    cohort, so subtrees may split; the roots that do are reported in
+    `split_roots` for the admit cycle's two-phase reconcile."""
+    shard_of_cohort = np.fromiter(
+        (_crc_shard(name, n_shards) for name in enc.cohort_names),
+        dtype=np.int32, count=len(enc.cohort_names))
+    shard_of_cq = shard_of_cohort[enc.cohort_id]
+    split: set = set()
+    h = enc.hier
+    if h is not None and n_shards > 1:
+        root_shards: Dict[int, set] = {}
+        for ci in np.nonzero(h.cq_hier)[0]:
+            path = h.cq_path[ci]
+            valid = path[path >= 0]
+            if not len(valid):
+                continue
+            root = int(valid[-1])
+            root_shards.setdefault(root, set()).add(int(shard_of_cq[ci]))
+        for root, shards in root_shards.items():
+            if len(shards) > 1:
+                split.add(h.node_names[root])
+    return ShardAssignment(
+        n_shards=n_shards, shard_of_cohort=shard_of_cohort,
+        shard_of_cq=shard_of_cq, split_roots=frozenset(split))
+
+
+class CohortMesh:
+    """An n-shard device mesh partitioned by cohort hash.
+
+    Owns the jax Mesh plus the per-encoding shard-assignment cache; the
+    solver asks `assignment(enc)` once per encoding generation and the
+    scheduler reads the same object's `split_roots` for the two-phase
+    admit cycle."""
+
+    def __init__(self, n_shards: Optional[int] = None,
+                 devices: Optional[list] = None):
+        if devices is None:
+            devices = jax.devices()
+        if n_shards is None:
+            n_shards = len(devices)
+        if n_shards < 1:
+            raise ValueError(f"n_shards must be >= 1, got {n_shards}")
+        if n_shards > len(devices):
+            # Fail loudly, like make_mesh: silently running on fewer
+            # chips than configured would misreport the sharding factor.
+            raise ValueError(
+                f"requested a {n_shards}-shard cohort mesh but only "
+                f"{len(devices)} device(s) are visible")
+        self.n_shards = n_shards
+        self.mesh = Mesh(np.array(devices[:n_shards]), (SHARD_AXIS,))
+        # enc identity -> (enc, ShardAssignment). The encoding ref is
+        # HELD in the value: cached entries keep their encodings alive,
+        # so an id() can never be recycled onto a different live
+        # encoding and return a stale assignment (identity re-checked on
+        # hit regardless).
+        self._assignments: Dict[int, tuple] = {}
+
+    def assignment(self, enc) -> ShardAssignment:
+        hit = self._assignments.get(id(enc))
+        if hit is not None and hit[0] is enc:
+            return hit[1]
+        if len(self._assignments) > 8:
+            self._assignments.clear()
+        a = assign_shards(enc, self.n_shards)
+        self._assignments[id(enc)] = (enc, a)
+        return a
+
+
+def shard_solve_body(
+    nominal, borrow_limit, guaranteed, lendable, cohort_id,
+    group_of_resource, slot_flavor, num_flavors,
+    bwc_enabled, borrow_policy_is_borrow, preempt_policy_is_preempt,
+    hier, usage,
+    wl_cq, req, has_req, podset_valid, podset_unsat, elig, resume_slot,
+    *, num_slots: int, num_cohorts: int, fungibility_enabled: bool,
+):
+    """One shard's solve: the exact per-shard program `shard_map` runs on
+    each device — cohort aggregation from the broadcast usage view, then
+    `solve_core` over the shard's compacted workload block. Kept as a
+    standalone traceable function so kueueverify lowers it like every
+    other registered kernel (TRC01-04), and so the TRC03-across-shard-
+    counts test can pin that the per-shard jaxpr depends only on the
+    padded bucket, never on the shard count (the one-compile-per-bucket
+    contract, per shard).
+
+    Identical arithmetic to `_solve_kernel_packed`'s aggregation: the
+    sharded outputs are bitwise equal to the single-device kernel's on
+    the same rows."""
+    above = jnp.maximum(usage - guaranteed, 0)
+    cohort_usage = jax.ops.segment_sum(
+        above, cohort_id, num_segments=num_cohorts)
+    cohort_requestable = jax.ops.segment_sum(
+        lendable, cohort_id, num_segments=num_cohorts)
+    return solve_core(
+        nominal, borrow_limit, guaranteed, usage,
+        cohort_requestable, cohort_usage, cohort_id,
+        group_of_resource, slot_flavor, num_flavors,
+        bwc_enabled, borrow_policy_is_borrow, preempt_policy_is_preempt,
+        wl_cq, req, has_req, podset_valid, podset_unsat, elig, resume_slot,
+        num_slots=num_slots, fungibility_enabled=fungibility_enabled,
+        hier=hier)
+
+
+def _build_cohort_program(cmesh: CohortMesh, num_slots: int,
+                          num_cohorts: int, fungibility_enabled: bool,
+                          has_hier: bool):
+    repl = P()
+    sharded = P(SHARD_AXIS)
+    # CQ statics + usage broadcast (each shard READS only its own
+    # cohorts' rows — the gathers are wl_cq-indexed — but the tensor is
+    # replicated so the layout matches the single-device kernel exactly);
+    # the 7 workload tensors are block-sharded on the leading axis.
+    in_specs = (repl,) * 11 + ((repl,) if has_hier else ()) + (repl,) \
+        + (sharded,) * 7
+
+    def run(nominal, borrow_limit, guaranteed, lendable, cohort_id,
+            group_of_resource, slot_flavor, num_flavors,
+            bwc_enabled, borrow_policy_is_borrow, preempt_policy_is_preempt,
+            *rest):
+        if has_hier:
+            hier, usage = rest[0], rest[1]
+            wl = rest[2:]
+        else:
+            hier, usage = None, rest[0]
+            wl = rest[1:]
+        # Closure captures (num_slots/num_cohorts/fungibility) are safe:
+        # every captured value is part of the _PROGRAM_CACHE key, so a
+        # different value builds a fresh program instead of retracing.
+        return shard_solve_body(
+            nominal, borrow_limit, guaranteed, lendable, cohort_id,
+            group_of_resource, slot_flavor, num_flavors,
+            bwc_enabled, borrow_policy_is_borrow,
+            preempt_policy_is_preempt, hier, usage, *wl,
+            num_slots=num_slots, num_cohorts=num_cohorts,
+            fungibility_enabled=fungibility_enabled)
+
+    run = shard_map(run, mesh=cmesh.mesh, in_specs=in_specs,
+                    out_specs=sharded, check_rep=False)
+    return jax.jit(run)
+
+
+def plan_shards(assignment: ShardAssignment, wl_cq: np.ndarray, n: int,
+                min_bucket: int = 8):
+    """Per-shard compaction plan for a batch of `n` workloads.
+
+    Returns (dest, counts, Ws): `dest[i]` is row i's slot in the stacked
+    `[n_shards * Ws]` layout (shard-major, compacted within shard in
+    batch order — decision order inside a shard is preserved), `counts`
+    the per-shard real row counts, `Ws` the shared per-shard padded
+    bucket (pow2 of the largest shard's count — the per-shard twin of
+    the W-axis bucketing, so steady ticks reuse one compiled program)."""
+    from kueue_tpu.solver.schema import _pad_pow2
+
+    shards = assignment.shard_of_cq[wl_cq[:n]]
+    counts = np.bincount(shards, minlength=assignment.n_shards)
+    Ws = _pad_pow2(int(counts.max()) if n else 1, floor=min_bucket)
+    # Rank within shard, preserving batch order: stable argsort by shard
+    # then positions within each shard run.
+    order = np.argsort(shards, kind="stable")
+    rank = np.empty(n, dtype=np.int64)
+    rank[order] = np.arange(n) - np.repeat(
+        np.concatenate(([0], np.cumsum(counts)[:-1])), counts)
+    dest = shards.astype(np.int64) * Ws + rank
+    return dest, counts, Ws
+
+
+def _cohort_program_key(cmesh: CohortMesh, enc, Ws: int, P_: int,
+                        fungible: bool):
+    h = enc.hier
+    hier_shape = None if h is None else (
+        h.node_own_nominal.shape, h.cq_path.shape,
+        tuple(len(n) for n, _ in h.levels))
+    C, F, R = enc.nominal.shape
+    return ("cohort-shard", id(cmesh.mesh), cmesh.n_shards, Ws, P_, R,
+            enc.num_groups, enc.num_slots, C, F, enc.num_cohorts,
+            fungible, hier_shape)
+
+
+def _cohort_program(cmesh: CohortMesh, enc, Ws: int, P_: int,
+                    fungible: bool):
+    key = _cohort_program_key(cmesh, enc, Ws, P_, fungible)
+    program = _PROGRAM_CACHE.get(key)
+    if program is None:
+        program = _build_cohort_program(
+            cmesh, enc.num_slots, enc.num_cohorts, fungible,
+            enc.hier is not None)
+        _PROGRAM_CACHE[key] = program
+    return program
+
+
+def _static_args(enc) -> tuple:
+    base = tuple(jnp.asarray(x) for x in (
+        enc.nominal, enc.borrow_limit, enc.guaranteed, enc.lendable,
+        enc.cohort_id, enc.group_of_resource, enc.slot_flavor,
+        enc.num_flavors, enc.bwc_enabled, enc.borrow_policy_is_borrow,
+        enc.preempt_policy_is_preempt))
+    h = enc.hier
+    if h is None:
+        return base
+    return base + ((
+        jnp.asarray(h.node_own_nominal), jnp.asarray(h.node_blim),
+        jnp.asarray(h.node_lend), jnp.asarray(h.cq_node),
+        jnp.asarray(h.cq_lend), jnp.asarray(h.cq_hier),
+        jnp.asarray(h.cq_path),
+        tuple((jnp.asarray(n), jnp.asarray(p)) for n, p in h.levels)),)
+
+
+def cohort_sharded_solve(enc, usage_tensors, wt, cmesh: CohortMesh,
+                         ) -> Tuple[Dict[str, np.ndarray], dict]:
+    """Run the batched flavor-fit solve cohort-sharded over `cmesh`.
+
+    Each shard solves its own cohorts' workloads as one compacted
+    `[Ws, ...]` block (per-shard padded bucket); no collectives cross
+    shards. Returns `(outputs, stats)` where outputs are in the batch's
+    ORIGINAL row order truncated to the real row count (decision order is
+    untouched — downstream decode/CSR consume them exactly like the
+    single-device kernel's), and stats carries the per-shard head counts
+    and the padded bucket for the bench's imbalance metrics."""
+    assignment = cmesh.assignment(enc)
+    n = wt.num_real
+    dest, counts, Ws = plan_shards(assignment, wt.wl_cq, n)
+    S = assignment.n_shards
+    WsS = S * Ws
+    P_ = wt.req.shape[1]
+    R = wt.req.shape[2]
+    G = wt.resume_slot.shape[2]
+
+    wl_cq = np.zeros(WsS, dtype=np.int32)
+    req = np.zeros((WsS, P_, R), dtype=np.int64)
+    has_req = np.zeros((WsS, P_, R), dtype=bool)
+    podset_valid = np.zeros((WsS, P_), dtype=bool)
+    podset_unsat = np.zeros((WsS, P_), dtype=bool)
+    elig = np.zeros((WsS,) + wt.elig.shape[1:], dtype=bool)
+    resume_slot = np.zeros((WsS, P_, G), dtype=np.int32)
+    if n:
+        wl_cq[dest] = wt.wl_cq[:n]
+        req[dest] = wt.req[:n]
+        has_req[dest] = wt.has_req[:n]
+        podset_valid[dest] = wt.podset_valid[:n]
+        podset_unsat[dest] = wt.podset_unsat[:n]
+        elig[dest] = wt.elig[:n]
+        resume_slot[dest] = wt.resume_slot[:n]
+
+    fungible = features.enabled(features.FLAVOR_FUNGIBILITY)
+    program = _cohort_program(cmesh, enc, Ws, P_, fungible)
+    args = _static_args(enc) + (
+        jnp.asarray(usage_tensors.usage),
+        jnp.asarray(wl_cq), jnp.asarray(req), jnp.asarray(has_req),
+        jnp.asarray(podset_valid), jnp.asarray(podset_unsat),
+        jnp.asarray(elig), jnp.asarray(resume_slot))
+    out = program(*args)
+    out = jax.device_get(out)
+    stats = {"shard_heads": counts, "shard_bucket": Ws,
+             "n_shards": S}
+    if n:
+        out = {k: np.asarray(v)[dest] for k, v in out.items()}
+    else:
+        out = {k: np.asarray(v)[:0] for k, v in out.items()}
+    return out, stats
+
+
+def prewarm_cohort_program(enc, cmesh: CohortMesh, Ws: int, P_: int,
+                           fungible: bool) -> None:
+    """Compile the cohort-sharded program for one per-shard bucket NOW
+    (all-zeros inputs; compilation depends only on shapes/dtypes) — the
+    sharded twin of BatchSolver._prewarm_one, called from the idle
+    window so a per-shard bucket rotation never compiles in-tick."""
+    S = cmesh.n_shards
+    WsS = S * Ws
+    R = len(enc.resource_names)
+    G = enc.num_groups
+    S_slots = enc.num_slots
+    program = _cohort_program(cmesh, enc, Ws, P_, fungible)
+    args = _static_args(enc) + (
+        jnp.zeros(enc.nominal.shape, dtype=jnp.int64),
+        jnp.zeros(WsS, dtype=jnp.int32),
+        jnp.zeros((WsS, P_, R), dtype=jnp.int64),
+        jnp.zeros((WsS, P_, R), dtype=bool),
+        jnp.zeros((WsS, P_), dtype=bool),
+        jnp.zeros((WsS, P_), dtype=bool),
+        jnp.zeros((WsS, P_, G, S_slots), dtype=bool),
+        jnp.zeros((WsS, P_, G), dtype=jnp.int32))
+    jax.block_until_ready(program(*args))

@@ -66,6 +66,11 @@ class PendingClusterQueue:
         # (cluster_queue_impl.go:49-57).
         self.pop_cycle = 0
         self.queue_inadmissible_cycle = -1
+        # Earliest pods-ready requeue_at among parked workloads, or +inf
+        # when none; None = recompute lazily (backoff_deadline). Lets the
+        # per-tick flush_expired_backoffs sweep skip a parked-but-not-due
+        # ClusterQueue in O(1) instead of walking its whole parking lot.
+        self._backoff_deadline: Optional[float] = float("inf")
 
     def _less(self, a: WorkloadInfo, b: WorkloadInfo) -> bool:
         """Priority desc, then queue-order timestamp asc
@@ -121,13 +126,47 @@ class PendingClusterQueue:
             if evicted else None,
         )
 
+    def backoff_deadline(self) -> float:
+        """Earliest clock at which the flush sweep could move something
+        out of this parking lot (+inf when nothing is clock-gated). A
+        parked workload with a requeue_at whose eviction is NOT
+        PodsReadyTimeout has an already-expired backoff
+        (`_backoff_expired` ignores the timestamp then) and the sweep
+        moves it on the next tick — it contributes "due now", exactly
+        like the pre-deadline sweep treated it."""
+        d = self._backoff_deadline
+        if d is None:
+            d = float("inf")
+            for wi in self.inadmissible.values():
+                rs = wi.obj.requeue_state
+                if rs is None or rs.requeue_at is None:
+                    continue
+                if _evicted_by_pods_ready_timeout(wi.obj):
+                    d = min(d, rs.requeue_at)
+                else:
+                    d = 0.0
+                    break
+            self._backoff_deadline = d
+        return d
+
     def _park(self, key: str, wi: WorkloadInfo) -> None:
         self.inadmissible[key] = wi
         self._parked_fingerprint[key] = self._fingerprint(wi)
+        rs = wi.obj.requeue_state
+        if rs is not None and rs.requeue_at is not None \
+                and self._backoff_deadline is not None:
+            due = rs.requeue_at \
+                if _evicted_by_pods_ready_timeout(wi.obj) else 0.0
+            self._backoff_deadline = min(self._backoff_deadline, due)
 
     def _unpark(self, key: str) -> Optional[WorkloadInfo]:
         self._parked_fingerprint.pop(key, None)
-        return self.inadmissible.pop(key, None)
+        out = self.inadmissible.pop(key, None)
+        if out is not None:
+            # The removed entry may have carried the minimum deadline;
+            # recompute lazily on the next sweep that needs it.
+            self._backoff_deadline = None
+        return out
 
     def push_or_update(self, wi: WorkloadInfo) -> None:
         key = wi.key
@@ -136,6 +175,9 @@ class PendingClusterQueue:
             # (cluster_queue_impl.go:113-131).
             if self._parked_fingerprint.get(key) == self._fingerprint(wi):
                 self.inadmissible[key] = wi
+                # requeue_state is outside the fingerprint; the update
+                # may have moved this entry's backoff deadline.
+                self._backoff_deadline = None
                 return
             self._unpark(key)
         if self.heap.get_by_key(key) is None and not self._backoff_expired(wi):
@@ -423,11 +465,18 @@ class Manager:
         timers, workload_controller.go:352-356)."""
         with self._cond:
             moved = False
+            now = self._clock()
             for cq in self.cluster_queues.values():
                 if not cq.inadmissible:
                     # The common steady-state CQ parks nothing; skip the
                     # per-CQ list materialization (this sweep runs at the
                     # top of EVERY tick over every ClusterQueue).
+                    continue
+                if cq.backoff_deadline() > now:
+                    # Parked, but no backoff is due yet: nothing in this
+                    # lot can move (generic parks wait for a quota
+                    # release flush, not the clock) — O(1) instead of a
+                    # whole-lot walk per tick.
                     continue
                 for key, wi in list(cq.inadmissible.items()):
                     rs = wi.obj.requeue_state

@@ -47,6 +47,35 @@ class EngineSpec:
     optional_import: bool = False
 
 
+@dataclass(frozen=True)
+class SolveEntrySpec:
+    """One batched flavor-fit solve entry point.
+
+    The victim-search engines above have a registry because three
+    consumers must stay in sync; the SOLVE side now has the same shape
+    problem — single-device `solve_core`, the packed byte-buffer kernel,
+    the cohort-sharded per-shard body, and the topology fit all lower to
+    jaxprs in the kueueverify roster, and
+    tests/test_engine_coverage.py::test_trace_roster_covers_every_solve_entry
+    fails when a new entry point lands untraced."""
+
+    name: str
+    module: str
+    entry: str
+
+
+SOLVE_ENTRYPOINTS: Tuple[SolveEntrySpec, ...] = (
+    SolveEntrySpec("flavor-fit",
+                   "kueue_tpu.models.flavor_fit", "solve_core"),
+    SolveEntrySpec("flavor-fit-packed",
+                   "kueue_tpu.models.flavor_fit", "_solve_kernel_packed"),
+    SolveEntrySpec("cohort-shard-solve",
+                   "kueue_tpu.parallel.mesh", "shard_solve_body"),
+    SolveEntrySpec("topology-fit",
+                   "kueue_tpu.topology.fit", "solve_topology_core"),
+)
+
+
 ENGINES: Tuple[EngineSpec, ...] = (
     EngineSpec("host", "host",
                "kueue_tpu.scheduler.preemption", "_minimal_preemptions"),

@@ -1148,6 +1148,12 @@ class WorkloadArena:
         self._rev: List[int] = []            # row -> info rev
         self._uid: List[Optional[str]] = []  # row -> uid
         self._req_sets: List[tuple] = []     # row -> requests_per_podset
+        # Cohort-mesh shard view (parallel/mesh.ShardAssignment): when
+        # bound, the same note/forget events that keep rows fresh also
+        # maintain the per-shard pending-row counts — the backlog-balance
+        # evidence the shard bench reads without scanning the pool.
+        self._shard_of_cq: Optional[np.ndarray] = None
+        self.shard_counts: Optional[np.ndarray] = None
         self._grow(max(8, capacity))
         # Cumulative stats (BatchSolver folds them into BENCH json):
         # `rows_reused` / `rows_missed` split the GATHER path (reuse vs
@@ -1212,11 +1218,25 @@ class WorkloadArena:
         with self._lock:
             self._note_locked(wi, self._snapshot)
 
+    def bind_shards(self, shard_of_cq: np.ndarray, n_shards: int) -> None:
+        """Attach a cohort-mesh shard assignment: per-shard pending-row
+        counts are (re)derived now and maintained incrementally by every
+        note/forget event from here on."""
+        with self._lock:
+            self._shard_of_cq = shard_of_cq
+            counts = np.zeros(n_shards, dtype=np.int64)
+            for row in self._rows.values():
+                counts[shard_of_cq[self.wl_cq[row]]] += 1
+            self.shard_counts = counts
+
     def forget(self, uid: str) -> None:
         """Free a workload's row (queue-manager delete event)."""
         with self._lock:
             row = self._rows.pop(uid, None)
             if row is not None:
+                if self.shard_counts is not None:
+                    self.shard_counts[
+                        self._shard_of_cq[self.wl_cq[row]]] -= 1
                 self._rev[row] = -1
                 self._uid[row] = None
                 self._req_sets[row] = ()
@@ -1246,12 +1266,18 @@ class WorkloadArena:
             self._grow_podsets(p)
         uid = wi.obj.uid
         row = self._rows.get(uid)
+        counts = self.shard_counts
         if row is None:
             if not self._free:
                 self._grow(self.cap * 2)
             row = self._free.pop()
             self._rows[uid] = row
+        elif counts is not None:
+            # Refresh of an existing row: its CQ (hence shard) may move.
+            counts[self._shard_of_cq[self.wl_cq[row]]] -= 1
         enc_row = _encode_row(wi, cq, snapshot, self.enc, totals)
+        if counts is not None:
+            counts[self._shard_of_cq[enc_row.ci]] += 1
         self.wl_cq[row] = enc_row.ci
         self.req[row] = 0
         self.has_req[row] = False
@@ -1435,8 +1461,35 @@ class AdmittedArena:
         self.row_ci = np.zeros(0, dtype=np.int32)
         self.usage_cfr = np.zeros((C, F, R), dtype=np.int64)
         self._cfr_flat = self.usage_cfr.reshape(C, self.FR)
+        # Cohort-mesh shard view: per-shard admitted-row counts kept in
+        # lockstep with the same assume/add/forget/delete sink events
+        # that feed the usage rows (the admitted-balance evidence of the
+        # shard bench); per-shard usage sums derive from usage_cfr on
+        # demand (shard_usage).
+        self._shard_of_cq: Optional[np.ndarray] = None
+        self.shard_counts: Optional[np.ndarray] = None
         self._grow(max(8, capacity))
         self.rows_noted = 0
+
+    def bind_shards(self, shard_of_cq: np.ndarray, n_shards: int) -> None:
+        with self._lock:
+            self._shard_of_cq = shard_of_cq
+            counts = np.zeros(n_shards, dtype=np.int64)
+            for row in self._rows.values():
+                counts[shard_of_cq[self.row_ci[row]]] += 1
+            self.shard_counts = counts
+
+    def shard_usage(self) -> Optional[np.ndarray]:
+        """[n_shards, F*R] committed usage summed per shard (derived from
+        the per-CQ sums — one segment add, read once per bench window)."""
+        if self._shard_of_cq is None or self.shard_counts is None:
+            return None
+        with self._lock:
+            out = np.zeros((len(self.shard_counts), self.FR),
+                           dtype=np.int64)
+            np.add.at(out, self._shard_of_cq[:len(self._cfr_flat)],
+                      self._cfr_flat)
+        return out
 
     def _grow(self, new_cap: int) -> None:
         old = self.cap
@@ -1473,10 +1526,16 @@ class AdmittedArena:
         with self._lock:
             key = wi.key
             row = self._rows.get(key)
+            counts = self.shard_counts
             if row is None:
                 row = self._alloc(key)
+                if counts is not None:
+                    counts[self._shard_of_cq[ci]] += 1
             else:
                 self._cfr_flat[self.row_ci[row]] -= self.use_fr[row]
+                if counts is not None:
+                    counts[self._shard_of_cq[self.row_ci[row]]] -= 1
+                    counts[self._shard_of_cq[ci]] += 1
             rowv = self.use_fr[row]
             rowv[:] = 0
             for fname, rname, v in wi.usage_triples:
@@ -1500,12 +1559,19 @@ class AdmittedArena:
         R = self.R
         with self._lock:
             rows = np.empty(len(keys), dtype=np.int64)
+            counts = self.shard_counts
+            shard_of = self._shard_of_cq
             for j, key in enumerate(keys):
                 row = self._rows.get(key)
                 if row is None:
                     row = self._alloc(key)
+                    if counts is not None:
+                        counts[shard_of[cis[j]]] += 1
                 else:
                     self._cfr_flat[self.row_ci[row]] -= self.use_fr[row]
+                    if counts is not None:
+                        counts[shard_of[self.row_ci[row]]] -= 1
+                        counts[shard_of[cis[j]]] += 1
                 self.use_fr[row] = 0
                 self.row_ci[row] = cis[j]
                 rows[j] = row
@@ -1523,6 +1589,8 @@ class AdmittedArena:
             if row is None:
                 return
             ci = self.row_ci[row]
+            if self.shard_counts is not None:
+                self.shard_counts[self._shard_of_cq[ci]] -= 1
             self._cfr_flat[ci] -= self.use_fr[row]
             self.use_fr[row] = 0
             self.row_ci[row] = -1

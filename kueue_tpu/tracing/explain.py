@@ -64,6 +64,24 @@ class ExplainStore:
                     records.move_to_end(key)
                 dq.append(rec)
 
+    def record_repeats(self, keys, tick_seq: int, now: float) -> None:
+        """Quiescent-tick collapse: the scheduler proved this attempt's
+        outcome identical to each workload's previous one, so instead of
+        rebuilding an identical record per head, the LAST record's
+        tick/time advance and its repeat counter bumps (surfaced as
+        `repeats` — "this exact decision held for N attempts"). Keys
+        with no prior record (shouldn't happen on a quiescent tick) are
+        ignored."""
+        with self._lock:
+            records = self._records
+            for key in keys:
+                dq = records.get(key)
+                if not dq:
+                    continue
+                rec = dq[-1]
+                reps = rec[8] if len(rec) > 8 else 1
+                dq[-1] = (tick_seq, now) + tuple(rec[2:8]) + (reps + 1,)
+
     def forget(self, key: str) -> None:
         with self._lock:
             self._records.pop(key, None)
@@ -131,7 +149,8 @@ def build_record(entry, tick_seq: int, now: float, outcome: str) -> tuple:
 
 
 def _materialize(rec: tuple) -> dict:
-    tick, now, cq, outcome, reason, flavors, topology, preempted = rec
+    tick, now, cq, outcome, reason, flavors, topology, preempted = rec[:8]
+    repeats = rec[8] if len(rec) > 8 else 1
     out = {
         "tick": tick,
         "time": now,
@@ -150,4 +169,6 @@ def _materialize(rec: tuple) -> dict:
             for ps, f, lvl, dom, ok in topology]
     if preempted:
         out["preemptionTargets"] = preempted
+    if repeats > 1:
+        out["repeats"] = repeats
     return out

@@ -259,6 +259,9 @@ def test_quiescent_tick_zero_encode_and_solve_work():
     assert solver.arena_rows_missed == missed0, \
         "quiescent tick re-encoded arena rows"
     assert solver.nominate_cache_hits - hits0 == 5 * 3
+    # The scheduler-side fast path engaged too: sort/admit/requeue
+    # bookkeeping replayed instead of recomputing.
+    assert fw.scheduler.metrics.quiescent_ticks > 0
     # The backlog is still live: releasing quota un-quiesces the system
     # and the next head admits (the cache replays only while its
     # fingerprint holds).
@@ -267,6 +270,18 @@ def test_quiescent_tick_zero_encode_and_solve_work():
     fw.delete_workload(victim)
     fw.run_until_settled()
     assert "default/w-0-1" in fw.admitted_workloads("cq-0")
+
+
+def test_quiescent_fast_path_decisions_identical(monkeypatch):
+    """The quiescent-tick replay (sort-order reuse, admit-cycle outcome
+    replay, requeue condition-write skip) must be decision-invisible:
+    the same churn stream with KUEUE_TPU_NO_QUIET_TICK=1 produces the
+    identical trail."""
+    monkeypatch.setenv("KUEUE_TPU_NO_QUIET_TICK", "1")
+    without = drive(True, None, ticks=120)
+    monkeypatch.delenv("KUEUE_TPU_NO_QUIET_TICK")
+    with_quiet = drive(True, None, ticks=120)
+    assert with_quiet == without
 
 
 def test_arena_full_rebuild_on_structure_change():

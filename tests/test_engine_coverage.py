@@ -56,6 +56,23 @@ def test_trace_roster_covers_every_traceable_engine():
         f"kueueverify roster misses engines: {traceable - roster}"
 
 
+def test_trace_roster_covers_every_solve_entry():
+    """The flavor-fit solve entry points (single-device, packed,
+    cohort-sharded, topology) carry the same cannot-land-unverified
+    contract as the victim-search engines."""
+    roster = {spec.name for spec in trace_rules.package_roster()}
+    solves = {s.name for s in modes.SOLVE_ENTRYPOINTS}
+    assert solves <= roster, \
+        f"kueueverify roster misses solve entry points: {solves - roster}"
+
+
+def test_every_solve_entry_point_exists():
+    for spec in modes.SOLVE_ENTRYPOINTS:
+        mod = importlib.import_module(spec.module)
+        assert hasattr(mod, spec.entry), \
+            f"{spec.name}: {spec.module}.{spec.entry} does not exist"
+
+
 def test_optional_engines_are_skipped_only_when_unimportable():
     from tests import test_preemption_goldens as goldens
 

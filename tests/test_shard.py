@@ -1,0 +1,451 @@
+"""Cohort-sharded solve differential goldens + two-phase reconcile.
+
+The cohort mesh (kueue_tpu/parallel/mesh.CohortMesh) must be decision-
+INVISIBLE: for any shard count, the sharded solve + two-phase admit cycle
+(optimistic per-shard pass, then the cross-shard lending-clamp reconcile)
+produces byte-identical admission decisions to the single-device,
+single-phase path. Pinned three ways:
+
+  * 200-tick randomized churn (the tests/test_arena.py harness shape)
+    over a MIXED topology — flat cohorts plus a hierarchical tree whose
+    subtree cohorts hash to different shards (so the reconcile pass runs
+    live during churn) — at shards in {1, 2, 8}, across every registered
+    victim-search engine, against the unsharded trail;
+  * a deterministic cross-cohort LendingLimit scenario where two
+    same-tick heads on different shards both fit their shard-local
+    optimistic view but only one fits the shared clamp — the reconcile
+    MUST revoke exactly one and match the unsharded decision;
+  * jaxpr structure: the per-shard program depends only on the padded
+    per-shard bucket, never on the shard count (the TRC03
+    one-compile-per-bucket contract, per shard).
+
+Runs on the virtual 8-device CPU mesh from conftest.py.
+"""
+
+import random
+import zlib
+
+import numpy as np
+import pytest
+
+from kueue_tpu import features
+from kueue_tpu.api.types import (
+    ClusterQueuePreemption,
+    CohortSpec,
+    PodSet,
+    Workload,
+)
+from kueue_tpu.config import Configuration, TPUSolverConfig
+from kueue_tpu.controllers.runtime import Framework
+from kueue_tpu.models.flavor_fit import BatchSolver
+from kueue_tpu.parallel.mesh import (
+    CohortMesh,
+    assign_shards,
+    plan_shards,
+)
+from kueue_tpu.solver import modes as _modes
+from kueue_tpu.solver import schema as sch
+
+from tests.util import fq, make_cq, make_flavor, make_lq, make_wl, rg
+
+TICKS = 200
+
+_ENGINE_KNOB = {
+    "host": None,
+    "scan-jax": "jax",
+    "scan-pallas": "pallas",
+    "batch-native": "native",
+    "batch-jax": "jax",
+}
+
+_KNOBS = []
+for _spec in _modes.ENGINES:
+    if _spec.optional_import and not _modes.engine_importable(_spec):
+        continue
+    knob = _ENGINE_KNOB[_spec.name]
+    if knob not in _KNOBS:
+        _KNOBS.append(knob)
+
+
+def _split_pair(n_shards: int = 8):
+    """Two cohort names whose hashes land on different shards at both 2
+    and `n_shards` shards — guarantees the tree they share splits."""
+    names = ["east", "west", "north", "south", "alpha", "beta", "gamma",
+             "delta", "omega", "sigma"]
+    for i, a in enumerate(names):
+        ha = zlib.crc32(a.encode())
+        for b in names[i + 1:]:
+            hb = zlib.crc32(b.encode())
+            if ha % n_shards != hb % n_shards and ha % 2 != hb % 2:
+                return a, b
+    raise AssertionError("no splitting cohort-name pair found")
+
+
+def build(shards, engine):
+    """Mixed topology: 4 CQs over 2 flat cohorts (the test_arena shape)
+    PLUS a hierarchical tree `root <- {A, B, pool}` where pool lends at
+    most 4 cpu (lendingLimit) and A/B hash to different shards — every
+    borrow across the tree exercises the reconcile pass when sharded."""
+    features.set_enabled(features.LENDING_LIMIT, True)
+    cfg = Configuration(tpu_solver=TPUSolverConfig(
+        preemption_engine="host" if engine is None else engine))
+    fw = Framework(batch_solver=BatchSolver(shards=shards), config=cfg)
+    fw.create_namespace("default", labels={})
+    fw.create_resource_flavor(make_flavor("on-demand", zone="a"))
+    fw.create_resource_flavor(make_flavor("spot", zone="b"))
+    for i in range(4):
+        fw.create_cluster_queue(make_cq(
+            f"cq-{i}",
+            rg("cpu", fq("on-demand", cpu=(16, 16)), fq("spot", cpu=(8, 8))),
+            cohort=f"cohort-{i % 2}",
+            preemption=ClusterQueuePreemption(
+                within_cluster_queue="LowerPriority",
+                reclaim_within_cohort="Any")))
+        fw.create_local_queue(make_lq(f"lq-{i}", "default", cq=f"cq-{i}"))
+    ca, cb = _split_pair()
+    fw.create_cohort(CohortSpec(name="hroot"))
+    fw.create_cohort(CohortSpec(name=ca, parent="hroot"))
+    fw.create_cohort(CohortSpec(name=cb, parent="hroot"))
+    fw.create_cohort(CohortSpec(
+        name="hpool", parent="hroot",
+        resource_groups=(rg("cpu", fq("on-demand", cpu=(8, None, 4))),)))
+    for side, idx in ((ca, 4), (cb, 5)):
+        fw.create_cluster_queue(make_cq(
+            f"cq-{idx}", rg("cpu", fq("on-demand", cpu=4)), cohort=side))
+        fw.create_local_queue(make_lq(f"lq-{idx}", "default",
+                                      cq=f"cq-{idx}"))
+    return fw
+
+
+def drive(shards, engine, ticks: int = TICKS):
+    """Seeded churn over the mixed topology; returns the decision trail
+    plus the reconcile revocation count."""
+    fw = build(shards, engine)
+    rnd = random.Random(4321)
+    seq = [0]
+    pending: dict = {}
+    admitted: dict = {}
+    trail = []
+
+    orig_admit = fw.scheduler.apply_admission
+    orig_preempt = fw.scheduler.apply_preemption
+    tick_admitted: list = []
+    tick_preempted: list = []
+
+    def apply_admission(wl):
+        ok = orig_admit(wl)
+        if ok:
+            tick_admitted.append(wl.key)
+            admitted[wl.key] = wl
+            pending.pop(wl.key, None)
+        return ok
+
+    def apply_preemption(wl, msg):
+        tick_preempted.append(wl.key)
+        return orig_preempt(wl, msg)
+
+    fw.scheduler.apply_admission = apply_admission
+    fw.scheduler.apply_preemption = apply_preemption
+
+    def submit_one():
+        seq[0] += 1
+        i = seq[0]
+        # Mostly flat-cohort traffic; every 4th lands in the split tree
+        # (cpu up to 8 > nominal 4 forces borrowing through the clamp).
+        if i % 4 == 0:
+            q = f"lq-{4 + (i // 4) % 2}"
+            cpu = rnd.randint(2, 8)
+        else:
+            q = f"lq-{rnd.randrange(4)}"
+            cpu = rnd.randint(1, 4)
+        wl = Workload(
+            name=f"wl-{i}", namespace="default", queue_name=q,
+            priority=rnd.randint(-2, 3),
+            creation_time=float(1000 + i),
+            pod_sets=[PodSet.make("ps0", count=rnd.randint(1, 3), cpu=cpu)])
+        pending[wl.key] = wl
+        fw.submit(wl)
+
+    for _ in range(40):
+        submit_one()
+
+    for _ in range(ticks):
+        tick_admitted.clear()
+        tick_preempted.clear()
+        fw.tick()
+        trail.append((tuple(sorted(tick_admitted)),
+                      tuple(sorted(tick_preempted))))
+        for _ in range(rnd.randint(0, 3)):
+            submit_one()
+        if pending and rnd.random() < 0.3:
+            key = rnd.choice(sorted(pending))
+            wl = pending.pop(key)
+            if not wl.is_admitted:
+                fw.delete_workload(wl)
+        done = [k for k, w in sorted(admitted.items())
+                if w.is_admitted and not w.is_finished]
+        for key in done[:rnd.randint(0, 4)]:
+            wl = admitted.pop(key)
+            fw.finish(wl)
+            fw.delete_workload(wl)
+        for key in list(admitted):
+            if not admitted[key].is_admitted:
+                wl = admitted.pop(key)
+                if not wl.is_finished:
+                    pending[key] = wl
+        fw.prewarm_idle()
+
+    trail.append(("pending", sum(fw.queues.pending(f"cq-{i}")
+                                 for i in range(6))))
+    return trail, fw.scheduler.metrics.reconcile_revocations
+
+
+_BASELINES: dict = {}
+
+
+def _baseline(engine):
+    if engine not in _BASELINES:
+        _BASELINES[engine] = drive(None, engine)[0]
+    return _BASELINES[engine]
+
+
+@pytest.mark.parametrize("engine", _KNOBS, ids=[str(k) for k in _KNOBS])
+@pytest.mark.parametrize("shards", [1, 2, 8])
+def test_sharded_churn_decisions_identical(engine, shards):
+    """200 randomized churn ticks: the cohort-sharded path (per-shard
+    solve blocks + two-phase reconcile) must replay the unsharded trail
+    byte for byte, at every shard count, on every engine."""
+    trail, _ = drive(shards, engine)
+    assert trail == _baseline(engine)
+
+
+def test_sharded_victim_scan_flat_cohorts():
+    """The packed-XLA victim search shards over the same cohort mesh
+    (per-shard search blocks). Flat-cohort preemption churn at shards=2
+    must be decision-identical to unsharded AND must actually route
+    through the sharded scan program (hier scenarios fall back to the
+    host searches, so the churn matrix above never compiles it)."""
+    from kueue_tpu.ops import preemption_batch as pb
+
+    def flat_drive(shards):
+        cfg = Configuration(tpu_solver=TPUSolverConfig(
+            preemption_engine="jax"))
+        fw = Framework(batch_solver=BatchSolver(shards=shards), config=cfg)
+        fw.create_namespace("default", labels={})
+        fw.create_resource_flavor(make_flavor("on-demand"))
+        for i in range(4):
+            fw.create_cluster_queue(make_cq(
+                f"cq-{i}", rg("cpu", fq("on-demand", cpu=(8, 8))),
+                cohort=f"cohort-{i % 2}",
+                preemption=ClusterQueuePreemption(
+                    within_cluster_queue="LowerPriority",
+                    reclaim_within_cohort="Any")))
+            fw.create_local_queue(make_lq(f"lq-{i}", "default",
+                                          cq=f"cq-{i}"))
+        rnd = random.Random(99)
+        trail = []
+        tick_events: list = []
+        orig_admit = fw.scheduler.apply_admission
+        orig_preempt = fw.scheduler.apply_preemption
+
+        def apply_admission(wl):
+            ok = orig_admit(wl)
+            if ok:
+                tick_events.append(("A", wl.key))
+            return ok
+
+        def apply_preemption(wl, msg):
+            tick_events.append(("P", wl.key))
+            return orig_preempt(wl, msg)
+
+        fw.scheduler.apply_admission = apply_admission
+        fw.scheduler.apply_preemption = apply_preemption
+        # Saturate with low priority, then churn high-priority arrivals
+        # so every tick runs real victim searches.
+        for i in range(24):
+            fw.submit(make_wl(f"low-{i}", f"lq-{i % 4}", cpu=2,
+                              priority=-1, creation_time=float(i)))
+        for t in range(60):
+            tick_events.clear()
+            fw.tick()
+            trail.append(tuple(sorted(tick_events)))
+            if t % 3 == 0:
+                # Two arrivals on DIFFERENT cohorts per wave: the tick's
+                # admit cycle then batches two victim searches, which is
+                # what routes through the per-shard scan blocks.
+                for q in (0, 1):
+                    fw.submit(make_wl(
+                        f"hi-{t}-{q}", f"lq-{q + 2 * rnd.randrange(2)}",
+                        cpu=2, priority=2,
+                        creation_time=float(1000 + 2 * t + q)))
+            fw.prewarm_idle()
+        return trail
+
+    pb._SHARDED_SCAN_CACHE.clear()
+    sharded = flat_drive(2)
+    assert pb._SHARDED_SCAN_CACHE, \
+        "the sharded victim scan never ran (searches fell back to the " \
+        "single-device kernel)"
+    unsharded = flat_drive(None)
+    assert sharded == unsharded
+
+
+def test_split_tree_detected():
+    fw = build(8, None)
+    fw.submit(make_wl("probe", "lq-4", cpu=1, creation_time=5.0))
+    fw.tick()
+    solver = fw.scheduler.batch_solver
+    a = solver._cohort_mesh.assignment(solver._enc)
+    assert "hroot" in a.split_roots
+    # Flat cohorts can never split: each hashes to exactly one shard.
+    assert all(r == "hroot" for r in a.split_roots)
+
+
+def test_lending_clamp_reconcile_revokes():
+    """Two same-tick heads on different shards of a split tree, both
+    borrowing from one lending-limited pool that can serve only one:
+    shard-locally both fit (optimistic), globally one must lose — the
+    reconcile pass revokes it, and the final decision matches the
+    unsharded cycle exactly."""
+    results = {}
+    for shards in (None, 8):
+        fw = build(shards, None)
+        # Each alone borrows 4 of the pool's lendingLimit 4; together
+        # they need 8 — exactly one can win.
+        fw.submit(make_wl("wa", "lq-4", cpu=8, creation_time=1.0))
+        fw.submit(make_wl("wb", "lq-5", cpu=8, creation_time=2.0))
+        fw.run_until_settled(max_ticks=6)
+        winners = tuple(sorted(
+            fw.admitted_workloads("cq-4") + fw.admitted_workloads("cq-5")))
+        results[shards] = (winners, fw.scheduler.metrics)
+    w_unsharded, _ = results[None]
+    w_sharded, metrics = results[8]
+    assert len(w_unsharded) == 1
+    assert w_sharded == w_unsharded
+    assert metrics.reconcile_revocations >= 1
+
+
+def test_assignment_deterministic_and_flat_cohorts_never_split():
+    fw = build(8, None)
+    fw.submit(make_wl("p", "lq-0", cpu=1, creation_time=1.0))
+    fw.tick()
+    enc = fw.scheduler.batch_solver._enc
+    a1 = assign_shards(enc, 8)
+    a2 = assign_shards(enc, 8)
+    assert np.array_equal(a1.shard_of_cq, a2.shard_of_cq)
+    assert a1.split_roots == a2.split_roots
+    # Every CQ of a flat cohort shares its cohort's shard.
+    for ci, k in enumerate(enc.cohort_id):
+        assert a1.shard_of_cq[ci] == a1.shard_of_cohort[k]
+
+
+def test_plan_shards_roundtrip():
+    rnd = np.random.RandomState(7)
+    shard_of_cq = rnd.randint(0, 8, size=40).astype(np.int32)
+    wl_cq = rnd.randint(0, 40, size=100).astype(np.int32)
+
+    class A:
+        n_shards = 8
+    a = A()
+    a.shard_of_cq = shard_of_cq
+    dest, counts, Ws = plan_shards(a, wl_cq, 100)
+    assert counts.sum() == 100
+    assert Ws >= counts.max() and (Ws & (Ws - 1)) == 0
+    # Slots are unique and land inside their shard's block.
+    assert len(set(dest.tolist())) == 100
+    shards = shard_of_cq[wl_cq]
+    assert np.array_equal(dest // Ws, shards)
+    # Batch order is preserved within each shard (decision order).
+    for s in range(8):
+        rows = dest[shards == s] % Ws
+        assert np.array_equal(rows, np.arange(len(rows)))
+
+
+def test_arena_shard_views_follow_sink_events():
+    """The per-shard pending/admitted counts ride the same queue/cache
+    sink events that feed the arenas."""
+    fw = build(8, None)
+    solver = fw.scheduler.batch_solver
+    for i in range(12):
+        fw.submit(Workload(
+            name=f"w-{i}", namespace="default",
+            queue_name=f"lq-{i % 4}", priority=0, creation_time=float(i),
+            pod_sets=[PodSet.make("ps0", count=1, cpu=1)]))
+    fw.run_until_settled()
+    a = solver._cohort_mesh.assignment(solver._enc)
+    arena = solver._arena
+    assert arena is not None and arena.shard_counts is not None
+    # Recompute per-shard pending rows from scratch and compare.
+    expect = np.zeros(8, dtype=np.int64)
+    for row in arena._rows.values():
+        expect[a.shard_of_cq[arena.wl_cq[row]]] += 1
+    assert np.array_equal(arena.shard_counts, expect)
+    admit = solver._admit_arena
+    assert admit is not None and admit.shard_counts is not None
+    expect_adm = np.zeros(8, dtype=np.int64)
+    for row in admit._rows.values():
+        expect_adm[a.shard_of_cq[admit.row_ci[row]]] += 1
+    assert np.array_equal(admit.shard_counts, expect_adm)
+    assert int(admit.shard_counts.sum()) > 0
+    su = admit.shard_usage()
+    assert su is not None and su.shape[0] == 8
+    # Per-shard usage sums telescope to the total committed usage.
+    assert su.sum() == admit.usage_cfr.sum()
+
+
+def test_per_shard_jaxpr_is_shard_count_independent():
+    """TRC03 across shard counts: at a fixed per-shard bucket, the
+    program each device compiles is structurally identical whether the
+    mesh has 2 or 4 shards — the one-compile-per-bucket contract holds
+    per shard, independent of fleet size."""
+    import jax
+
+    from kueue_tpu.analysis import jaxpr_tools as jt
+    from kueue_tpu.parallel import mesh as pmesh
+
+    fw = build(None, None)
+    fw.submit(make_wl("p", "lq-0", cpu=1, creation_time=1.0))
+    fw.tick()
+    enc = fw.scheduler.batch_solver._enc
+    Ws, P = 8, 1
+
+    def inner_jaxpr(n_shards):
+        cm = CohortMesh(n_shards)
+        program = pmesh._build_cohort_program(
+            cm, enc.num_slots, enc.num_cohorts, True, enc.hier is not None)
+        R = len(enc.resource_names)
+        G = enc.num_groups
+        S = enc.num_slots
+        WsS = n_shards * Ws
+        args = pmesh._static_args(enc) + (
+            np.zeros(enc.nominal.shape, np.int64),
+            np.zeros(WsS, np.int32), np.zeros((WsS, P, R), np.int64),
+            np.zeros((WsS, P, R), bool), np.zeros((WsS, P), bool),
+            np.zeros((WsS, P), bool), np.zeros((WsS, P, G, S), bool),
+            np.zeros((WsS, P, G), np.int32))
+        closed = jax.make_jaxpr(program)(*args)
+
+        def find(jaxpr):
+            for eqn in jaxpr.eqns:
+                if "shard_map" in eqn.primitive.name:
+                    return eqn.params["jaxpr"]
+                for v in eqn.params.values():
+                    inner = getattr(v, "jaxpr", v if hasattr(v, "eqns")
+                                    else None)
+                    if inner is not None:
+                        hit = find(inner)
+                        if hit is not None:
+                            return hit
+            return None
+
+        hit = find(closed.jaxpr)
+        assert hit is not None, \
+            "no shard_map equation in the lowered program"
+        return hit
+
+    j2 = inner_jaxpr(2)
+    j4 = inner_jaxpr(4)
+    sig2 = jt.structural_signature(j2)
+    sig4 = jt.structural_signature(j4)
+    assert jt.first_divergence(sig2, sig4) is None, \
+        "per-shard program depends on the shard count"
