@@ -87,13 +87,29 @@ bench-smoke:
 	    and shard.get('shard_imbalance_ratio') is not None \
 	    and shard.get('reconcile_revocations') is not None, \
 	    f'shard config missing per-shard evidence: {shard}'; \
+	  fair = by[METRIC_NAMES['fair']]; \
+	  r = fair.get('fair_vs_northstar_p99_ratio'); \
+	  assert r is not None \
+	    and fair.get('fair_share_compute_ms') is not None, \
+	    f'fair config missing device-fair evidence: {fair}'; \
+	  assert fair['ticks'] < 50 or r <= 1.10, \
+	    f'fair p99 is x{r} the northstar twin (budget 1.10): the fair ' \
+	    f'path is paying host DRF work again: {fair}'; \
+	  fsteady = by[steady].get('fair_steady'); \
+	  assert fsteady is not None \
+	    and fsteady.get('solver_dispatches') == 0, \
+	    f'fair steady state dispatched solves (the share state is ' \
+	    f'defeating the nominate cache): {fsteady}'; \
 	  print('bench-smoke arena gate OK:', ratios); \
 	  print('bench-smoke steady gate OK: hit_ratio', hit, \
 	        'quiescent_tick_ms', q, \
 	        'replayed', by[steady].get('quiescent_ticks_replayed')); \
 	  print('bench-smoke shard gate OK: imbalance', \
 	        shard.get('shard_imbalance_ratio'), 'scaling', \
-	        shard.get('p99_scaling_ratio'))"
+	        shard.get('p99_scaling_ratio')); \
+	  print('bench-smoke fair gate OK: ratio', r, \
+	        'share_compute_ms', fair.get('fair_share_compute_ms'), \
+	        'fair_steady_dispatches', fsteady.get('solver_dispatches'))"
 
 # End-to-end tracing smoke: drive the real CLI with span tracing on,
 # then prove the exported file is valid Chrome trace-event JSON (the

@@ -58,8 +58,11 @@ def run_config(*, label, num_cqs, num_cohorts, num_flavors, backlog, ticks,
 
     from kueue_tpu import features
 
-    if fair_hierarchy:
-        features.set_enabled(features.FAIR_SHARING, True)
+    # Explicit on AND off: the fair config measures fair-on and fair-off
+    # windows in one process (the northstar twin + the A/B/A
+    # re-baseline), so the gate must track the window instead of
+    # latching on.
+    features.set_enabled(features.FAIR_SHARING, fair_hierarchy)
     if lending:
         features.set_enabled(features.LENDING_LIMIT, True)
     t0 = time.perf_counter()
@@ -450,6 +453,14 @@ def run_config(*, label, num_cqs, num_cohorts, num_flavors, backlog, ticks,
     }
     if overhead is not None:
         stats["tracer_overhead"] = overhead
+    if fair_hierarchy:
+        # Device-fair evidence for the measured window: what the
+        # incremental share-state refresh (weighted-DRF recompute for
+        # dirty cohorts + rank upkeep) cost per tick — the
+        # `nominate.fair` phase span, so metrics/bench/traces report
+        # the same measurement.
+        stats["fair_share_compute_ms"] = round(
+            phase_means.get("nominate.fair", 0.0), 3)
     if shard_before is not None:
         sa = solver.shard_stats()
         d = sa["shard_dispatches"] - shard_before["shard_dispatches"]
@@ -602,10 +613,58 @@ def run_one(config: str) -> None:
         # BASELINE config #4: weighted-DRF fair sharing over a KEP-79
         # hierarchical cohort tree (leaf cohorts -> mids -> root) — the
         # greenfield feature pair, at the same scale as the headline.
-        emit(METRIC_NAMES[config], run_config(
-            label="fair", ticks=max(ticks // 2, 8), usage_fill=0.7,
+        # Since the fair path went tensor-resident (incremental share
+        # state + packed fair sort key + vectorized fair-preemption
+        # victim search) the config also measures the SAME shape with
+        # fair sharing OFF — the northstar twin (run_config pins the
+        # FAIR_SHARING gate per window, so each window measures its
+        # true path) — and records the p99 ratio: the "fair sharing is
+        # not a tax" contract (ROADMAP item 4), gated at <= 1.10
+        # in-process when the window has enough samples for a stable
+        # percentile.
+        w_ticks = max(ticks // 2, 8)
+        twin = run_config(
+            label="fair_twin", ticks=w_ticks, usage_fill=0.7,
+            depth=depth, preemption_heavy=False, **shape)
+        stats = run_config(
+            label="fair", ticks=w_ticks, usage_fill=0.7,
             depth=depth, preemption_heavy=False, fair_hierarchy=True,
-            **shape))
+            **shape)
+        ratio = (stats["p99_ms"] / twin["p99_ms"]
+                 if twin["p99_ms"] else None)
+        stats["northstar_twin"] = {"p50_ms": twin["p50_ms"],
+                                   "p99_ms": twin["p99_ms"]}
+        if ratio is not None and ratio > 1.10:
+            # A/B/A re-baseline: this class of container drifts (the
+            # r06 BENCH note) — a load spike landing after the first
+            # twin window inflates every fair phase uniformly and fakes
+            # a regression. Re-measure the twin AFTER the fair window:
+            # if it is slow too, the box moved, not the fair path (use
+            # the slower baseline); a real fair regression keeps both
+            # twins fast and the ratio high.
+            twin2 = run_config(
+                label="fair_twin_aba", ticks=w_ticks, usage_fill=0.7,
+                depth=depth, preemption_heavy=False, **shape)
+            stats["northstar_twin_aba"] = {"p50_ms": twin2["p50_ms"],
+                                           "p99_ms": twin2["p99_ms"]}
+            base = max(twin["p99_ms"], twin2["p99_ms"])
+            ratio = stats["p99_ms"] / base if base else None
+        stats["fair_vs_northstar_p99_ratio"] = (
+            round(ratio, 3) if ratio is not None else None)
+        # The HARD gate arms at >= 50 measured ticks per window — the
+        # tracer-overhead gate's sample-count discipline: below that,
+        # "p99" is literally the single slowest tick and one OS
+        # contention burst (this box sustains multi-second 5x bursts,
+        # see the r06 note) flakes CI. The ratio is recorded either
+        # way; CI can arm the gate with KUEUE_BENCH_TICKS>=100.
+        if w_ticks >= 50 and ratio is not None and ratio > 1.10:
+            raise RuntimeError(
+                f"[fair] fair-hier p99 {stats['p99_ms']:.1f}ms is "
+                f"x{ratio:.2f} the northstar twin's (budget 1.10): the "
+                "device-side fair path is paying host DRF work again — "
+                "check fair.bulk_miss and the share-state memoization "
+                "before trusting this run.")
+        emit(METRIC_NAMES[config], stats)
     elif config == "topo":
         # Topology-aware scheduling: every flavor declares a
         # block→rack→host tree and every arrival requests slice packing
@@ -640,11 +699,33 @@ def run_one(config: str) -> None:
         # measured window must dispatch zero solves (asserted inside
         # run_config) and bench-smoke additionally requires
         # nominate_cache_hit_ratio > 0.8.
-        emit(METRIC_NAMES[config], run_config(
-            label="steady", ticks=max(ticks // 2, 8), usage_fill=1.0,
+        w_ticks = max(ticks // 2, 8)
+        stats = run_config(
+            label="steady", ticks=w_ticks, usage_fill=1.0,
             depth=depth, preemption_heavy=False, strict_fifo=True,
-            no_preemption=True, churn_enabled=False, **shape),
-            target_ms=15.0)
+            no_preemption=True, churn_enabled=False, **shape)
+        # Quiescent FAIR steady state: the same churn-free window over
+        # the weighted KEP-79 tree with FairSharing ON. run_config's
+        # in-window assertion proves a fair steady state ALSO
+        # dispatches zero solves — the share state replays on untouched
+        # usage-value generations instead of defeating the nominate
+        # cache (the PR-6/PR-7 machinery fair sharing used to bypass).
+        fair_stats = run_config(
+            label="fair_steady", ticks=w_ticks, usage_fill=1.0,
+            depth=depth, preemption_heavy=False, strict_fifo=True,
+            no_preemption=True, churn_enabled=False,
+            fair_hierarchy=True, **shape)
+        stats["fair_steady"] = {
+            "p50_ms": fair_stats["p50_ms"],
+            "p99_ms": fair_stats["p99_ms"],
+            "solver_dispatches": fair_stats["solver_dispatches"],
+            "quiescent_tick_ms": fair_stats["quiescent_tick_ms"],
+            "quiescent_ticks_replayed":
+                fair_stats["quiescent_ticks_replayed"],
+            "fair_share_compute_ms":
+                fair_stats.get("fair_share_compute_ms"),
+        }
+        emit(METRIC_NAMES[config], stats, target_ms=15.0)
     elif config == "shard":
         # Cohort-sharded scale axis (ROADMAP item 1): the same admission
         # mix at the northstar-ish backlog and again at 4x backlog /

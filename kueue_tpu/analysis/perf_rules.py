@@ -16,6 +16,12 @@ the solve's CSR coordinates (core/cache.py, core/snapshot.py):
   * a `for`/`while` loop body subscripting a solver output tensor with
     the loop variable — directly (`out["ps_ok"][w]`) or through a local
     alias (`ps_ok = out["ps_ok"][:n]` ... `ps_ok[w]`);
+  * a `for`/`while` loop body calling `dominant_resource_share` — the
+    per-candidate/per-iteration dict DRF walk that dominated the fair
+    path (BENCH_r04 fair p99 156ms vs 69ms northstar): shares belong on
+    the vectorized tensors (models/fair_share.FairShareState,
+    ops/fair_preempt) with the dict walk reserved for the referee oracle
+    (which carries explanatory suppressions).
 
 Whole-array reads OUTSIDE loops (fancy indexing, reductions) and
 `.tolist()` materializations iterated as plain lists are the sanctioned
@@ -33,6 +39,10 @@ from kueue_tpu.analysis.core import (
 
 _PERF_PATHS = ("scheduler/", "solver/", "models/", "core/cache.py",
                "core/snapshot.py", "fixtures/lint/")
+
+# Per-CQ share functions whose dict-walk cost makes a Python loop around
+# them the fair-path hot-spot shape (the KEP-1714 victim-search loop).
+_SHARE_WALK_CALLS = {"dominant_resource_share"}
 
 # The batched solve's output pytree keys (models/flavor_fit.solve_core
 # `outputs` dict + the derived wl_mode).
@@ -117,6 +127,30 @@ def _check_perf01(f: SourceFile, ctx: AnalysisContext):
                         "indexing, batch_usage_csr/csr_gather) or "
                         "materialize once with .tolist() and iterate "
                         "the list")
+
+        # Fair-loop shape: a share-value dict walk re-derived inside a
+        # loop (per candidate / per while-iteration). Nested loops see
+        # the same call several times; flag each call node once.
+        seen_calls: Set[int] = set()
+        for loop in ast.walk(func):
+            if not isinstance(loop, (ast.For, ast.While)):
+                continue
+            for call in ast.walk(loop):
+                if not isinstance(call, ast.Call) \
+                        or id(call) in seen_calls:
+                    continue
+                seen_calls.add(id(call))
+                fn = call.func
+                name = fn.id if isinstance(fn, ast.Name) else (
+                    fn.attr if isinstance(fn, ast.Attribute) else None)
+                if name in _SHARE_WALK_CALLS:
+                    yield finding(
+                        PERF01, f, call,
+                        "per-iteration dominant_resource_share dict walk "
+                        "inside a Python loop — compute shares once on "
+                        "the vectorized tensors (models/fair_share."
+                        "FairShareState / ops/fair_preempt share-without-"
+                        "victim broadcast) and compare arrays instead")
 
 
 PERF01 = register(Rule(

@@ -659,10 +659,30 @@ class Framework:
                         name, fname, rname, value=used)
         if features.enabled(features.FAIR_SHARING):
             from kueue_tpu.solver.fair_share import dominant_resource_share
-            snap = self.cache.snapshot()
-            for name, cq in snap.cluster_queues.items():
-                REGISTRY.cluster_queue_fair_share.set(
-                    name, value=dominant_resource_share(cq)[0])
+            # Serve the gauge from the share kernel's last-tick bulk
+            # output instead of building a snapshot and running a per-CQ
+            # dict DRF walk on every scrape; deleted ClusterQueues
+            # cannot leak stale series — the bulk dict is refused the
+            # moment the cache structure rotates (fair_shares_last) and
+            # the prune above drops dead names either way. The referee
+            # walk remains the fallback (no solver / no tick yet /
+            # KUEUE_TPU_NO_DEVICE_FAIR=1).
+            shares = None
+            solver = getattr(self.scheduler, "batch_solver", None)
+            if solver is not None:
+                last = getattr(solver, "fair_shares_last", None)
+                shares = last() if last is not None else None
+            if shares is not None:
+                live_cqs = self.cache.cluster_queues
+                for name, value in shares.items():
+                    if name in live_cqs:
+                        REGISTRY.cluster_queue_fair_share.set(
+                            name, value=value)
+            else:
+                snap = self.cache.snapshot()
+                for name, cq in snap.cluster_queues.items():
+                    REGISTRY.cluster_queue_fair_share.set(
+                        name, value=dominant_resource_share(cq)[0])
         self._record_topology_metrics()
         if self.config.metrics.enable_cluster_queue_resources:
             self._record_resource_metrics()

@@ -71,7 +71,7 @@ class Cohort:
 
     __slots__ = ("name", "members", "requestable_resources", "usage",
                  "allocatable_generation", "spec", "parent", "children",
-                 "_root_name", "_is_hier", "_tree_cap")
+                 "_root_name", "_is_hier", "_tree_cap", "_sorted_members")
 
     def __init__(self, name: str, spec=None):
         self.name = name
@@ -92,6 +92,12 @@ class Cohort:
         # memoized on roots: it depends only on specs and member quotas,
         # both structural (changes rebuild the snapshot's cohorts).
         self._tree_cap: Optional[dict] = None
+        # Name-sorted member list (the deterministic preemption walk),
+        # memoized because tree_cluster_queues runs once per preempting
+        # head per tick. Every `members` mutation must clear it
+        # (invalidate_memos, or note_members_changed where the
+        # structural memos deliberately survive).
+        self._sorted_members: Optional[List["CachedClusterQueue"]] = None
 
     # -- hierarchy helpers (KEP-79) -----------------------------------------
 
@@ -109,9 +115,25 @@ class Cohort:
         self._root_name = None
         self._is_hier = None
         self._tree_cap = None
+        self._sorted_members = None
         root = self.root()
         if root is not self:
             root._tree_cap = None
+
+    def note_members_changed(self) -> None:
+        """Invalidate only the membership memo: the snapshot mirror swaps
+        re-cloned members in place every refresh, which moves no
+        structural state (roots, tree capacity) — those memos survive."""
+        self._sorted_members = None
+
+    def sorted_members(self) -> List["CachedClusterQueue"]:
+        """`members` in NAME order (see tree_cluster_queues for why the
+        walk must be deterministic), memoized until membership changes."""
+        sm = self._sorted_members
+        if sm is None:
+            sm = self._sorted_members = sorted(
+                self.members, key=lambda c: c.name)
+        return sm
 
     @property
     def root_name(self) -> str:
@@ -147,12 +169,20 @@ class Cohort:
 
     def tree_cluster_queues(self) -> List["CachedClusterQueue"]:
         """All member CQs in the subtree rooted here (preemption and
-        reclaim act across the whole structure)."""
+        reclaim act across the whole structure).
+
+        Members are yielded in NAME order: `members` is an identity-
+        hashed set, and raw iteration order varies with memory layout —
+        which leaks into preemption candidate-queue order and flips the
+        victim choice between equal-share ClusterQueues from one run to
+        the next (caught by the fair churn goldens). Every
+        decision-identity contract (goldens, HA replay, the shards=N ==
+        shards=1 gate) needs this walk deterministic."""
         out: List["CachedClusterQueue"] = []
         stack = [self]
         while stack:
             node = stack.pop()
-            out.extend(node.members)
+            out.extend(node.sorted_members())
             stack.extend(node.children)
         return out
 

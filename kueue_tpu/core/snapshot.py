@@ -162,6 +162,7 @@ def _build_hierarchy(snap: "Snapshot", cache: Cache,
                 snap.inactive_cluster_queues.add(member.name)
                 del snap.cluster_queues[member.name]
             nodes[name].members.clear()
+            nodes[name].note_members_changed()
             nodes[name].parent = None
             nodes[name].children = []
 
@@ -312,6 +313,7 @@ class SnapshotMirror:
                     if old is not None:
                         cohort.members.discard(old)
                     cohort.members.add(fresh)
+                    cohort.note_members_changed()
                     fresh.cohort = cohort
                     if old is not None and old.cohort is cohort \
                             and cohort.name not in dirty_cohorts:

@@ -6,14 +6,31 @@ capacity, max over resources, divided by weight. Integer parts-per-1024,
 exactly matching `kueue_tpu.solver.fair_share.dominant_resource_share`.
 
 At the north-star scale (1k CQs) the host loop is per-CQ Python; this model
-scores all CQs in one fused XLA program -- it is also the building block
-for device-side fair ordering of the admission batch.
+scores all CQs in one fused XLA program. Since PR 8 it is also the building
+block for device-side fair ORDERING of the admission batch:
+`FairShareState` derives a dense order-preserving RANK per ClusterQueue
+from the shares (one np.unique pass, redone only when a share changes) —
+the quantized share component of the scheduler's int64 lexsort nomination
+key (`FairShareState.rank`), so `nominate.sort` under FairSharing rides
+the same two-pass memoized lexsort as the default mode.
+
+`FairShareState` maintains the shares INCREMENTALLY across ticks, memoized
+on the per-cohort usage-VALUE generations the fingerprinted nominate cache
+already tracks (solver/schema.UsageEncoder.cohort_gens): an untouched
+cohort's shares replay from the previous tick, and a fully-quiescent tick
+recomputes nothing. Shares are cohort-local (a CQ's share reads only its
+OWN usage row plus a structural capacity denominator), so the full-pass
+kernel also runs per-shard over the PR-7 `CohortMesh` with zero
+collectives (parallel/mesh.sharded_fair_shares).
+
+Kill switch: KUEUE_TPU_NO_DEVICE_FAIR=1 restores the per-CQ dict DRF
+walks everywhere (share_of fallback, host fair-preemption referee).
 """
 
 from __future__ import annotations
 
 import functools
-from typing import Dict, Sequence, Tuple
+from typing import Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -26,12 +43,31 @@ from kueue_tpu.solver.fair_share import SHARE_SCALE
 _BIG = np.float64(np.inf)
 
 
+def _weighted_shares_xp(xp, above, cap, weight):
+    """The ONE home of the KEP-1714 weighted-share arithmetic —
+    parameterized over the array module (np / jnp) so the numpy referee
+    twin, the jit kernel and the per-shard mesh pass cannot drift; the
+    "bitwise-identical" contract between them rests on this being a
+    single function. Returns (weighted [n] f64, ratio_f [n,R] f64 — the
+    per-resource scaled ratios the dominant-resource argmax reads)."""
+    ratio = xp.where(cap > 0, (above * SHARE_SCALE) // xp.maximum(cap, 1), 0)
+    # Zero capacity but positive overage is an infinite share.
+    ratio_f = xp.where((cap <= 0) & (above > 0), xp.inf,
+                       ratio.astype(xp.float64))
+    share = ratio_f.max(axis=1)
+    weighted = xp.where(share == 0.0, 0.0,
+                        xp.where(weight > 0, share / weight, xp.inf))
+    return weighted, ratio_f
+
+
 @functools.partial(jax.jit, static_argnames=("num_cohorts",))
 def _share_kernel(nominal, lendable, usage, cohort_id, weight,
                   num_cohorts: int):
     """[C,F,R] quota/usage -> per-CQ share values (scaled int ratio / weight).
 
-    Returns (share[C] f64, dominant[C] i32).
+    Returns (share[C] f64, dominant[C] i32). The int64-lexsort RANK of
+    the shares lives on `FairShareState.rank` (a dense np.unique pass,
+    recomputed only when a share changes), not here.
     """
     # Usage above nominal, summed over flavors: [C,R].
     above = jnp.maximum(usage - nominal, 0).sum(axis=1)
@@ -40,15 +76,8 @@ def _share_kernel(nominal, lendable, usage, cohort_id, weight,
     cohort_lendable = jax.ops.segment_sum(lend_r, cohort_id,
                                           num_segments=num_cohorts)
     cap = cohort_lendable[cohort_id]
-    ratio = jnp.where(cap > 0, (above * SHARE_SCALE) // jnp.maximum(cap, 1), 0)
-    # Zero capacity but positive overage is an infinite share.
-    inf_mask = (cap <= 0) & (above > 0)
-    ratio_f = jnp.where(inf_mask, jnp.inf, ratio.astype(jnp.float64))
-    share = ratio_f.max(axis=1)
+    weighted, ratio_f = _weighted_shares_xp(jnp, above, cap, weight)
     dominant = jnp.argmax(ratio_f, axis=1).astype(jnp.int32)
-    weighted = jnp.where(
-        share == 0.0, 0.0,
-        jnp.where(weight > 0, share / weight, jnp.inf))
     return weighted, dominant
 
 
@@ -74,3 +103,192 @@ def share_values(snapshot: Snapshot,
             dom = enc.resource_names[int(dominant[i])] if share[i] > 0 else ""
             out[name] = (float(share[i]), dom)
     return out
+
+
+def fair_structural(enc: sch.CQEncoding, snapshot: Snapshot):
+    """(cap [C,R], weight [C], cohorted [C]) — the structural half of the
+    KEP-1714 share value, cached for the encoding's lifetime.
+
+    Capacity denominators: flat cohorts sum member lendable quota
+    (enc.lendable pooled per cohort); hierarchical trees use the whole
+    structure under the root (hierarchy.tree_capacity via Cohort.tree_cap).
+    Both depend only on specs/quotas, which rotate the encoding on change.
+    """
+    cached = getattr(enc, "_fair_cache", None)
+    if cached is not None:
+        return cached
+    C, F, R = enc.nominal.shape
+    cap = np.zeros((C, R), dtype=np.int64)
+    weight = np.zeros(C, dtype=np.float64)
+    cohorted = np.zeros(C, dtype=bool)
+    # Flat-cohort capacity: lendable summed over flavors, pooled per
+    # cohort.
+    lend_r = enc.lendable.sum(axis=1)              # [C,R]
+    pool = np.zeros((enc.num_cohorts + 1, R), dtype=np.int64)
+    np.add.at(pool, enc.cohort_id, lend_r)
+    cap_flat = pool[enc.cohort_id]
+    r_index = enc.resource_index
+    for i, name in enumerate(enc.cq_names):
+        cq = snapshot.cluster_queues.get(name)
+        if cq is None or cq.cohort is None:
+            continue
+        cohorted[i] = True
+        weight[i] = cq.fair_weight
+        if cq.cohort.is_hierarchical():
+            tc = cq.cohort.tree_cap()
+            for resources in tc.values():
+                for rname, val in resources.items():
+                    ri = r_index.get(rname)
+                    if ri is not None:
+                        cap[i, ri] += val
+        else:
+            cap[i] = cap_flat[i]
+    enc._fair_cache = (cap, weight, cohorted)
+    return enc._fair_cache
+
+
+def weighted_shares_np(above: np.ndarray, cap: np.ndarray,
+                       weight: np.ndarray) -> np.ndarray:
+    """[n,R] usage-above-nominal + [n,R] capacity + [n] weight -> [n]
+    weighted share values, exactly `dominant_resource_share`'s arithmetic
+    (integer ratio parts-per-1024, inf on zero-capacity overage or zero
+    weight)."""
+    if above.size == 0:
+        return np.zeros(len(above), dtype=np.float64)
+    with np.errstate(divide="ignore", invalid="ignore"):
+        return _weighted_shares_xp(np, above, cap, weight)[0]
+
+
+class FairShareState:
+    """Incremental per-CQ weighted-DRF shares + their lexsort quantization.
+
+    One instance per CQ-encoding generation (owned by BatchSolver,
+    rebuilt on rotation). `refresh()` recomputes shares ONLY for the
+    member rows of cohorts whose usage-VALUE generation moved since the
+    last call (solver/schema.UsageEncoder.cohort_gens — bumped on every
+    row movement, value-stable under the preemption sim's restore-exactly
+    churn), so a quiescent tick's refresh is one [K] array compare.
+
+    `rank` is the dense order-preserving quantization of `share` (equal
+    floats share a rank), recomputed in one np.unique pass only when a
+    share actually changed; `version` bumps with it — the share term of
+    the quiescent-tick signature.
+    """
+
+    def __init__(self, enc: sch.CQEncoding, usage_enc, snapshot: Snapshot,
+                 cohort_mesh=None):
+        self.enc = enc
+        self._ue = usage_enc
+        self.cap, self.weight, self.cohorted = fair_structural(enc, snapshot)
+        C = enc.nominal.shape[0]
+        self.share = np.zeros(C, dtype=np.float64)
+        self.rank = np.zeros(C, dtype=np.int64)
+        self.version = 0
+        self._gens: Optional[np.ndarray] = None
+        self._dict: Optional[Dict[str, float]] = None
+        self._mesh = cohort_mesh
+        # Scrape-safe publication: a COPY of the shares, swapped in
+        # atomically at the end of refresh(), so the off-thread metrics
+        # scrape can never observe a half-written refresh (mixed-tick
+        # values); it sees either the previous fully-refreshed state or
+        # the new one.
+        self._pub: Optional[np.ndarray] = None
+        self._pub_dict: Optional[tuple] = None
+
+    def _compute_rows(self, rows: np.ndarray) -> np.ndarray:
+        u = self._ue.usage[rows]
+        above = np.maximum(u - self.enc.nominal[rows], 0).sum(axis=1)
+        return weighted_shares_np(above, self.cap[rows], self.weight[rows])
+
+    def _full_pass(self) -> None:
+        """Seed pass over every cohorted row. With a CohortMesh bound the
+        kernel runs per-shard over the mesh (shares are cohort-local —
+        zero collectives; parallel/mesh.sharded_fair_shares is pinned
+        bitwise-identical to the numpy arithmetic); otherwise one
+        vectorized numpy pass."""
+        rows = np.nonzero(self.cohorted)[0]
+        if not rows.size:
+            return
+        if self._mesh is not None and self._mesh.n_shards > 1:
+            from kueue_tpu.parallel.mesh import sharded_fair_shares
+            full = sharded_fair_shares(
+                self._mesh, self.enc.nominal, self._ue.usage,
+                self.cap, self.weight)
+            self.share[rows] = full[rows]
+        else:
+            self.share[rows] = self._compute_rows(rows)
+
+    def refresh(self) -> "FairShareState":
+        gens = self._ue.cohort_gens
+        if self._gens is None:
+            self._full_pass()
+            self._rerank()
+            self._pub = self.share.copy()
+        else:
+            moved = gens != self._gens
+            if not moved.any():
+                return self
+            rows = np.nonzero(moved[self.enc.cohort_id] & self.cohorted)[0]
+            if rows.size:
+                fresh = self._compute_rows(rows)
+                if not np.array_equal(fresh, self.share[rows]):
+                    self.share[rows] = fresh
+                    self._rerank()
+                    # Republish ONLY on a value change: gen movement
+                    # with equal values (the preemption sim's
+                    # restore-exactly churn) must not invalidate the
+                    # scrape memo or pay the copy.
+                    self._pub = self.share.copy()
+        self._gens = gens.copy()
+        return self
+
+    def _rerank(self) -> None:
+        # Dense rank via one unique pass: equal shares (exact float
+        # compare, inf included) collapse to one rank, so the int64 key
+        # orders entries exactly as the float share would.
+        _, inv = np.unique(self.share, return_inverse=True)
+        self.rank = inv.astype(np.int64)
+        self.version += 1
+        self._dict = None
+
+    def share_of_ci(self, ci: int) -> float:
+        return float(self.share[ci])
+
+    def as_dict(self) -> Dict[str, float]:
+        d = self._dict
+        if d is None:
+            d = self._dict = {name: float(self.share[i])
+                              for i, name in enumerate(self.enc.cq_names)}
+        return d
+
+    def published_dict(self) -> Optional[Dict[str, float]]:
+        """The last fully-refreshed shares, for the off-thread metrics
+        scrape: reads only the atomically-swapped publication copy, never
+        the live `share` array a concurrent refresh() may be mid-write
+        on. None before the first refresh."""
+        pub = self._pub
+        if pub is None:
+            return None
+        cached = self._pub_dict
+        if cached is not None and cached[0] is pub:
+            return cached[1]
+        d = {name: float(pub[i])
+             for i, name in enumerate(self.enc.cq_names)}
+        self._pub_dict = (pub, d)
+        return d
+
+    def verify(self, snapshot: Snapshot) -> None:
+        """Assert the incremental shares equal a from-scratch referee pass
+        (KUEUE_TPU_DEBUG_FAIR=1 drives this from the scheduler)."""
+        from kueue_tpu.solver.fair_share import dominant_resource_share
+        for i, name in enumerate(self.enc.cq_names):
+            cq = snapshot.cluster_queues.get(name)
+            if cq is None:
+                continue
+            # Debug-only referee walk (the loop PERF01 exists to banish
+            # from the tick path).
+            want = dominant_resource_share(cq)[0]  # kueuelint: disable=PERF01
+            if self.share[i] != want:
+                raise AssertionError(
+                    f"FairShareState drift: {name} share {self.share[i]} "
+                    f"!= referee {want} (generation memo out of lockstep)")

@@ -704,6 +704,14 @@ class BatchSolver:
         self._usage_enc: Optional[sch.UsageEncoder] = None
         self._row_cache: Optional[sch.WorkloadRowCache] = None
         self._preempt_ctx = None
+        # Device-side fair sharing (KEP-1714): the incremental share
+        # state (models/fair_share.FairShareState) and the vectorized
+        # fair-preemption context (ops/fair_preempt), both rebuilt with
+        # the encoding. KUEUE_TPU_NO_DEVICE_FAIR=1 kills the whole fair
+        # fast path (share_of falls back to the dict DRF walk and the
+        # victim search to the host referee).
+        self._fair_state = None
+        self._fair_preempt_ctx = None
         self._mesh = mesh
         # Cohort-sharded solve (the production scale-out path). Built
         # eagerly so a misconfigured shard count fails at construction,
@@ -815,6 +823,8 @@ class BatchSolver:
             # Row cache indices/eligibility are relative to the encoding.
             self._row_cache = sch.WorkloadRowCache()
             self._preempt_ctx = None
+            self._fair_state = None
+            self._fair_preempt_ctx = None
             # P-axis stickiness restarts with the encoding generation.
             self._p_floor = 1
             # The jit cache keys on the static arrays' SHAPES too ([C,F,R]
@@ -1012,62 +1022,83 @@ class BatchSolver:
         return enc.cq_names, enc.flavor_names, enc.resource_names, \
             enc.cq_index
 
-    def fair_shares(self, snapshot: Snapshot) -> Optional[dict]:
-        """{cq name: share value} for every ClusterQueue, vectorized
-        (KEP-1714 weighted DRF; dominant_resource_share is the dict
-        referee). None when no current encoding matches the snapshot.
+    @staticmethod
+    def device_fair_enabled() -> bool:
+        """The device-side fair-sharing kill switch (read live so the
+        differential goldens can flip it per run)."""
+        return os.environ.get("KUEUE_TPU_NO_DEVICE_FAIR", "") != "1"
 
-        Capacity denominators are structural: flat cohorts sum member
-        lendable quota (enc.lendable); hierarchical trees use the whole
-        structure under the root (hierarchy.tree_capacity), both cached
-        for the encoding's lifetime. The per-tick part is three numpy
-        ops over the lockstep usage tensor."""
+    def fair_share_state(self, snapshot: Snapshot):
+        """The refreshed incremental share state
+        (models/fair_share.FairShareState) — per-CQ weighted-DRF share
+        values plus their int64-lexsort rank quantization, memoized on
+        the per-cohort usage-VALUE generations so an untouched cohort's
+        shares replay across ticks. None when no current encoding
+        matches the snapshot or KUEUE_TPU_NO_DEVICE_FAIR=1 (the
+        scheduler falls back to per-CQ dict DRF walks)."""
         enc = self._enc
         ue = self._usage_enc
-        if enc is None or ue is None or not self.encoding_matches(snapshot):
+        if enc is None or ue is None or not self.device_fair_enabled() \
+                or not self.encoding_matches(snapshot):
             return None
-        cached = getattr(enc, "_fair_cache", None)
-        if cached is None:
-            C, F, R = enc.nominal.shape
-            cap = np.zeros((C, R), dtype=np.int64)
-            weight = np.zeros(C, dtype=np.float64)
-            cohorted = np.zeros(C, dtype=bool)
-            # Flat-cohort capacity: lendable summed over flavors, pooled
-            # per cohort.
-            lend_r = enc.lendable.sum(axis=1)              # [C,R]
-            pool = np.zeros((enc.num_cohorts + 1, R), dtype=np.int64)
-            np.add.at(pool, enc.cohort_id, lend_r)
-            cap_flat = pool[enc.cohort_id]
-            r_index = enc.resource_index
-            for i, name in enumerate(enc.cq_names):
-                cq = snapshot.cluster_queues.get(name)
-                if cq is None or cq.cohort is None:
-                    continue
-                cohorted[i] = True
-                weight[i] = cq.fair_weight
-                if cq.cohort.is_hierarchical():
-                    tc = cq.cohort.tree_cap()
-                    for resources in tc.values():
-                        for rname, val in resources.items():
-                            ri = r_index.get(rname)
-                            if ri is not None:
-                                cap[i, ri] += val
-                else:
-                    cap[i] = cap_flat[i]
-            cached = enc._fair_cache = (cap, weight, cohorted)
-        cap, weight, cohorted = cached
-        from kueue_tpu.solver.fair_share import SHARE_SCALE
-        above = np.maximum(ue.usage - enc.nominal, 0).sum(axis=1)  # [C,R]
-        with np.errstate(divide="ignore"):
-            ratio = np.where(cap > 0, (above * SHARE_SCALE) // np.maximum(
-                cap, 1), 0).astype(np.float64)
-        ratio[(cap <= 0) & (above > 0)] = np.inf
-        share = ratio.max(axis=1)
-        out = np.where(share == 0.0, 0.0,
-                       np.where(weight > 0, share / np.maximum(weight, 1e-9),
-                                np.inf))
-        out = np.where(cohorted, out, 0.0)
-        return {name: float(out[i]) for i, name in enumerate(enc.cq_names)}
+        st = self._fair_state
+        if st is None:
+            from kueue_tpu.models.fair_share import FairShareState
+            st = self._fair_state = FairShareState(
+                enc, ue, snapshot, self._cohort_mesh)
+        return st.refresh()
+
+    def fair_shares(self, snapshot: Snapshot) -> Optional[dict]:
+        """{cq name: share value} for every ClusterQueue, served from the
+        incremental share state (KEP-1714 weighted DRF;
+        dominant_resource_share is the dict referee). None when no
+        current encoding matches the snapshot or the device-fair kill
+        switch is set."""
+        st = self.fair_share_state(snapshot)
+        return st.as_dict() if st is not None else None
+
+    def fair_shares_last(self) -> Optional[dict]:
+        """The last tick's END-OF-TICK bulk share output (the scheduler
+        republishes after the cycle's commits — `fair.publish`), for the
+        metrics scrape — no refresh here (scrapes run off-thread), and
+        None whenever the encoding no longer matches the cache structure
+        (a rotation is pending; the scraper falls back to the referee
+        walk so deleted ClusterQueues cannot serve stale series)."""
+        st = self._fair_state
+        cache = self._cache
+        if st is None or cache is None or not self.device_fair_enabled():
+            return None
+        key = (cache.structure_version,
+               features.enabled(features.LENDING_LIMIT),
+               features.enabled(features.FAIR_SHARING))
+        if key != self._key:
+            return None
+        # The publication copy, not the live arrays: scrapes run off the
+        # tick thread and must never see a half-written refresh.
+        return st.published_dict()
+
+    def fair_preempt_context(self, snapshot: Optional[Snapshot] = None):
+        """The vectorized fair-preemption context (ops/fair_preempt.
+        FairPreemptContext) with live usage/arena refs, or None
+        (no/stale encoding, or the kill switch) — the caller falls back
+        to the host fair referee."""
+        enc = self._enc
+        ue = self._usage_enc
+        if enc is None or ue is None or not self.device_fair_enabled():
+            return None
+        if snapshot is not None and not self.encoding_matches(snapshot):
+            return None
+        ctx = self._fair_preempt_ctx
+        if ctx is None:
+            if snapshot is None:
+                return None
+            from kueue_tpu.models.fair_share import fair_structural
+            from kueue_tpu.ops.fair_preempt import FairPreemptContext
+            ctx = self._fair_preempt_ctx = FairPreemptContext(
+                enc, fair_structural(enc, snapshot))
+        ctx.usage = ue.usage
+        ctx.arena = self._admit_arena
+        return ctx
 
     def hier_cycle_state(self, snapshot: Snapshot):
         """Admission-cycle bookkeeping for hierarchical cohorts

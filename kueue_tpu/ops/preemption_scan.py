@@ -83,7 +83,10 @@ def encode_problem(cq: CachedClusterQueue, snapshot: Snapshot,
     """Tensorize one victim search against the tick snapshot."""
     members = [cq]
     if cq.cohort is not None:
-        members += [m for m in cq.cohort.members if m is not cq]
+        # Name order: the identity-hashed set iterates in memory-layout
+        # order, and the member/pair tensor layout should not vary
+        # between runs of the same cluster state.
+        members += [m for m in cq.cohort.sorted_members() if m is not cq]
     member_idx = {m.name: i for i, m in enumerate(members)}
 
     pairs: List[Tuple[str, str]] = []

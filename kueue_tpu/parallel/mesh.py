@@ -516,3 +516,60 @@ def prewarm_cohort_program(enc, cmesh: CohortMesh, Ws: int, P_: int,
         jnp.zeros((WsS, P_, G, S_slots), dtype=bool),
         jnp.zeros((WsS, P_, G), dtype=jnp.int32))
     jax.block_until_ready(program(*args))
+
+
+# -- cohort-sharded fair shares (KEP-1714 over the cohort mesh) -------------
+
+
+def _share_program(cmesh: CohortMesh):
+    """Per-shard weighted-DRF share pass: shard_map over the CQ axis with
+    ZERO collectives — a ClusterQueue's share reads only its own usage
+    row and its structural capacity row (the cohort denominators are
+    baked into `cap` per CQ), so any partition of the CQ axis is valid
+    and each device scores its block independently."""
+    key = ("fair-share", id(cmesh.mesh), cmesh.n_shards)
+    program = _PROGRAM_CACHE.get(key)
+    if program is not None:
+        return program
+    from kueue_tpu.models.fair_share import _weighted_shares_xp
+
+    sharded = P(SHARD_AXIS)
+
+    def run(nominal, usage, cap, weight):
+        above = jnp.maximum(usage - nominal, 0).sum(axis=1)    # [c,R]
+        # The SAME arithmetic function as the numpy referee twin and the
+        # bulk kernel — the bitwise-identity contract is structural, not
+        # a hand-synced copy.
+        return _weighted_shares_xp(jnp, above, cap, weight)[0]
+
+    program = jax.jit(shard_map(
+        run, mesh=cmesh.mesh, in_specs=(sharded,) * 4,
+        out_specs=sharded, check_rep=False))
+    _PROGRAM_CACHE[key] = program
+    return program
+
+
+def sharded_fair_shares(cmesh: CohortMesh, nominal: np.ndarray,
+                        usage: np.ndarray, cap: np.ndarray,
+                        weight: np.ndarray) -> np.ndarray:
+    """[C] weighted share values over the cohort mesh, bitwise-identical
+    to the host arithmetic (models/fair_share.weighted_shares_np): the
+    integer ratio and the float64 division are the same IEEE ops on
+    every backend. Rows are padded to a shard multiple with zero
+    usage/cap (share 0) and truncated on return."""
+    C = nominal.shape[0]
+    S = cmesh.n_shards
+    pad = (-C) % S
+    if pad:
+        nominal = np.concatenate(
+            [nominal, np.zeros((pad,) + nominal.shape[1:], nominal.dtype)])
+        usage = np.concatenate(
+            [usage, np.zeros((pad,) + usage.shape[1:], usage.dtype)])
+        cap = np.concatenate(
+            [cap, np.zeros((pad,) + cap.shape[1:], cap.dtype)])
+        weight = np.concatenate([weight, np.zeros(pad, weight.dtype)])
+    program = _share_program(cmesh)
+    out = jax.device_get(program(
+        jnp.asarray(nominal), jnp.asarray(usage),
+        jnp.asarray(cap), jnp.asarray(weight)))
+    return np.asarray(out[:C])

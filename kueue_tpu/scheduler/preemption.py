@@ -9,6 +9,7 @@ tick snapshot.
 
 from __future__ import annotations
 
+import os
 from typing import Dict, List, Optional, Set
 
 from kueue_tpu import features
@@ -59,24 +60,34 @@ def _plan_rounds(wi: WorkloadInfo, cq: CachedClusterQueue,
 def get_targets(wi: WorkloadInfo, assignment: Assignment, snapshot: Snapshot,
                 ordering: WorkloadOrdering, now: float,
                 fair_strategies=DEFAULT_FAIR_STRATEGIES,
-                engine: Optional[str] = None) -> List[WorkloadInfo]:
+                engine: Optional[str] = None,
+                fair_ctx=None,
+                key_memo: Optional[dict] = None) -> List[WorkloadInfo]:
     """Workloads to evict so `wi` fits (preemption.go:81-126).
 
     With the FairSharing gate on and the CQ in a cohort, victim selection is
-    share-based (KEP-1714) instead of the classic priority/reclaim rules.
+    share-based (KEP-1714) instead of the classic priority/reclaim rules;
+    `fair_ctx` (BatchSolver.fair_preempt_context) routes that search
+    through the vectorized tensors (ops/fair_preempt), with the
+    sequential dict walk as the referee oracle.
 
     `engine` selects the minimalPreemptions implementation: None = the
     sequential host referee; "jax" / "pallas" = the device scan
     (ops/preemption_scan, ops/preemption_pallas — decision-equivalent).
     Hierarchical trees always run the host referee: its workloadFits is the
     only implementation of the KEP-79 ancestor walk.
+
+    `key_memo` shares `_candidate_sort_key`'s per-candidate parts across
+    every search of a tick (get_targets_batch owns one) — cohort mates
+    are re-sorted by every searching entry.
     """
     res_per_flv = _resources_requiring_preemption(assignment)
     cq = snapshot.cluster_queues[wi.cluster_queue]
 
     if features.enabled(features.FAIR_SHARING) and cq.cohort is not None:
         return _fair_preemptions(wi, assignment, snapshot, res_per_flv,
-                                 ordering, now, fair_strategies)
+                                 ordering, now, fair_strategies,
+                                 fair_ctx=fair_ctx, key_memo=key_memo)
 
     if cq.cohort is not None and cq.cohort.is_hierarchical():
         engine = None
@@ -102,7 +113,8 @@ def get_targets(wi: WorkloadInfo, assignment: Assignment, snapshot: Snapshot,
     candidates = _find_candidates(wi, ordering, cq, res_per_flv)
     if not candidates:
         return []
-    candidates.sort(key=lambda c: _candidate_sort_key(c, cq.name, now))
+    candidates.sort(key=lambda c: _candidate_sort_key(c, cq.name, now,
+                                                      key_memo))
     if hint is not None:
         candidates = _topology_prefer(candidates, hint, snapshot)
 
@@ -115,7 +127,7 @@ def get_targets(wi: WorkloadInfo, assignment: Assignment, snapshot: Snapshot,
 
 def get_targets_batch(items, snapshot: Snapshot, ordering: WorkloadOrdering,
                       now: float, fair_strategies, ctx, usage,
-                      backend: str = "native",
+                      backend: str = "native", fair_ctx=None,
                       ) -> List[List[WorkloadInfo]]:
     """Victim search for every PREEMPT-mode entry of a tick in (at most)
     two batched engine calls (ops/preemption_batch).
@@ -142,7 +154,8 @@ def get_targets_batch(items, snapshot: Snapshot, ordering: WorkloadOrdering,
         if (fair and cq.cohort is not None) or hier or ci is None \
                 or getattr(assignment, "topology_hint", None) is not None:
             results[idx] = get_targets(wi, assignment, snapshot, ordering,
-                                       now, fair_strategies, engine=None)
+                                       now, fair_strategies, engine=None,
+                                       fair_ctx=fair_ctx, key_memo=key_memo)
             continue
         candidates = _find_candidates(wi, ordering, cq, res_per_flv)
         if not candidates:
@@ -407,35 +420,25 @@ def _negated_usage(wi: WorkloadInfo) -> FlavorResourceQuantities:
             for f, res in wi.usage().items()}
 
 
-def _fair_preemptions(wi: WorkloadInfo, assignment: Assignment,
-                      snapshot: Snapshot, res_per_flv: ResourcesPerFlavor,
-                      ordering: WorkloadOrdering, now: float,
-                      strategies) -> List[WorkloadInfo]:
-    """Share-based victim search (KEP-1714 "Preemption algorithm").
-
-    Round by round, pick the next victim from the cohort member with the
-    highest share value, admitting it only if the configured strategy holds:
-      * LessThanOrEqualToFinalShare (S2-a): after removing the victim, the
-        offender's share is still >= the preemptor's share with the incoming
-        workload admitted.
-      * LessThanInitialShare (S2-b): the offender's current share strictly
-        exceeds the preemptor's prospective share.
-    Own-CQ victims follow the classic WithinClusterQueue policy. Ends with
-    the same add-back minimization as the classic path.
-    """
-    cq = snapshot.cluster_queues[wi.cluster_queue]
-    wl_req = _total_requests_for_assignment(wi, assignment)
-
-    # Per-CQ candidate queues, best victim first. Cross-CQ candidates still
-    # honor the preemptor's reclaimWithinCohort contract: Never forbids any
-    # cross-queue eviction, LowerPriority restricts victims by priority
-    # (fair-share rules replace only the share comparison, not the
-    # admin-facing policy).
+def _fair_candidate_queues(wi: WorkloadInfo, cq: CachedClusterQueue,
+                           res_per_flv: ResourcesPerFlavor,
+                           ordering: WorkloadOrdering, now: float,
+                           key_memo: Optional[dict] = None,
+                           ) -> Dict[str, List[WorkloadInfo]]:
+    """Per-CQ candidate queues, best victim first — shared by the host
+    referee and the vectorized search. Cross-CQ candidates still honor
+    the preemptor's reclaimWithinCohort contract: Never forbids any
+    cross-queue eviction, LowerPriority restricts victims by priority
+    (fair-share rules replace only the share comparison, not the
+    admin-facing policy). `key_memo` is the tick-level sort-key memo
+    (get_targets_batch): cohort mates are re-sorted by every searching
+    entry, and within one search each candidate is keyed exactly once."""
     per_cq: Dict[str, List[WorkloadInfo]] = {}
     own = _find_candidates(wi, ordering, cq, res_per_flv)
     own = [c for c in own if c.cluster_queue == cq.name]
     if own:
-        own.sort(key=lambda c: _candidate_sort_key(c, cq.name, now))
+        own.sort(key=lambda c: _candidate_sort_key(c, cq.name, now,
+                                                   key_memo))
         per_cq[cq.name] = own
     reclaim = cq.preemption.reclaim_within_cohort
     if reclaim != PreemptionPolicy.NEVER:
@@ -447,19 +450,95 @@ def _fair_preemptions(wi: WorkloadInfo, assignment: Assignment,
                      if _uses_resources(c, res_per_flv)
                      and not (only_lower and c.obj.priority >= wi.priority)]
             if cands:
-                cands.sort(key=lambda c: _candidate_sort_key(c, cq.name, now))
+                cands.sort(key=lambda c: _candidate_sort_key(c, cq.name, now,
+                                                             key_memo))
                 per_cq[member.name] = cands
+    return per_cq
 
+
+def _fair_preemptions(wi: WorkloadInfo, assignment: Assignment,
+                      snapshot: Snapshot, res_per_flv: ResourcesPerFlavor,
+                      ordering: WorkloadOrdering, now: float,
+                      strategies, fair_ctx=None,
+                      key_memo: Optional[dict] = None) -> List[WorkloadInfo]:
+    """Share-based victim search (KEP-1714): the vectorized tensor search
+    (ops/fair_preempt) when a solver context covers this search, the
+    sequential dict-walk referee otherwise. KUEUE_TPU_NO_DEVICE_FAIR=1
+    forces the referee; KUEUE_TPU_DEBUG_FAIR=1 runs both and asserts
+    identical victim sequences."""
+    cq = snapshot.cluster_queues[wi.cluster_queue]
+    wl_req = _total_requests_for_assignment(wi, assignment)
+    per_cq = _fair_candidate_queues(wi, cq, res_per_flv, ordering, now,
+                                    key_memo)
+    if not per_cq:
+        # No eligible candidates (policies Never, or nothing borrowing
+        # uses the contended resources): both searches end victimless —
+        # the referee's first round finds no `best` and the vectorized
+        # search has no rows — so skip building either. This is the
+        # common shape of a steady state whose heads re-pop as Preempt
+        # mode every tick.
+        return []
+
+    # The kill switch lives with the producers: both fair_ctx sources
+    # (BatchSolver.fair_preempt_context, Scheduler._fair_ctx) return
+    # None under KUEUE_TPU_NO_DEVICE_FAIR=1.
+    if fair_ctx is not None:
+        from kueue_tpu.ops.fair_preempt import fair_targets
+        debug = os.environ.get("KUEUE_TPU_DEBUG_FAIR", "") == "1"
+        vec_per_cq = {n: list(c) for n, c in per_cq.items()} if debug \
+            else per_cq
+        out = fair_targets(fair_ctx, cq, wl_req, vec_per_cq, res_per_flv,
+                           strategies)
+        if out is not None:
+            if debug:
+                oracle = _fair_preemptions_host(
+                    cq, wl_req, per_cq, snapshot, res_per_flv, strategies)
+                if [t.obj.uid for t in out] != \
+                        [t.obj.uid for t in oracle]:
+                    raise AssertionError(
+                        "fair_preempt drift: vectorized victims "
+                        f"{[t.obj.name for t in out]} != referee "
+                        f"{[t.obj.name for t in oracle]} for "
+                        f"{wi.obj.name}")
+            return out
+    return _fair_preemptions_host(cq, wl_req, per_cq, snapshot,
+                                  res_per_flv, strategies)
+
+
+def _fair_preemptions_host(cq: CachedClusterQueue,
+                           wl_req: FlavorResourceQuantities,
+                           per_cq: Dict[str, List[WorkloadInfo]],
+                           snapshot: Snapshot,
+                           res_per_flv: ResourcesPerFlavor,
+                           strategies) -> List[WorkloadInfo]:
+    """The sequential share-based referee (KEP-1714 "Preemption
+    algorithm") — the oracle the vectorized search is pinned against.
+
+    Round by round, pick the next victim from the cohort member with the
+    highest share value, admitting it only if the configured strategy holds:
+      * LessThanOrEqualToFinalShare (S2-a): after removing the victim, the
+        offender's share is still >= the preemptor's share with the incoming
+        workload admitted.
+      * LessThanInitialShare (S2-b): the offender's current share strictly
+        exceeds the preemptor's prospective share.
+    Own-CQ victims follow the classic WithinClusterQueue policy. Ends with
+    the same add-back minimization as the classic path.
+
+    NOTE: `per_cq` lists are consumed (popped) by the search.
+    """
     targets: List[WorkloadInfo] = []
     fits = False
     while True:
         if _workload_fits(wl_req, cq, True):
             fits = True
             break
-        share_x, _ = dominant_resource_share(cq, wl_req)
+        # The referee oracle intentionally keeps the per-iteration dict
+        # walks the vectorized search (ops/fair_preempt) replaces — the
+        # two are pinned identical by the churn goldens.
+        share_x, _ = dominant_resource_share(cq, wl_req)  # kueuelint: disable=PERF01
         order = sorted(
             (name for name, cands in per_cq.items() if cands),
-            key=lambda n: -dominant_resource_share(
+            key=lambda n: -dominant_resource_share(  # kueuelint: disable=PERF01
                 snapshot.cluster_queues[n])[0])
         best = None
         for strategy in strategies:
@@ -477,11 +556,11 @@ def _fair_preemptions(wi: WorkloadInfo, assignment: Assignment,
                 # matches"), not just the head.
                 for zi, z in enumerate(cands):
                     if strategy == FairSharingStrategy.LESS_THAN_OR_EQUAL_TO_FINAL_SHARE:
-                        share_y_wo, _ = dominant_resource_share(
+                        share_y_wo, _ = dominant_resource_share(  # kueuelint: disable=PERF01
                             y, _negated_usage(z))
                         ok = share_y_wo >= share_x
                     else:
-                        share_y, _ = dominant_resource_share(y)
+                        share_y, _ = dominant_resource_share(y)  # kueuelint: disable=PERF01
                         ok = share_y > share_x
                     if ok:
                         best = (y_name, zi)

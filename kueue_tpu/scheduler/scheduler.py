@@ -221,6 +221,12 @@ class Scheduler:
         # two held objects, and both are replaced (never mutated) on
         # change, so identity-keyed memoization is exact.
         self._ns_match_memo: Dict[tuple, tuple] = {}
+        # Per-tick fair-sharing state (KEP-1714): the solver's
+        # incremental share state (set by _resolve; None with fair off,
+        # no solver, or KUEUE_TPU_NO_DEVICE_FAIR=1) and the count of
+        # ClusterQueues the bulk share tensors did not cover this tick.
+        self._tick_fair_state = None
+        self._fair_bulk_miss = 0
 
     def close(self) -> None:
         """Release cache/queue subscriptions. Call when retiring this
@@ -309,6 +315,10 @@ class Scheduler:
         entries = tick.entries
         with TRACER.phase("nominate") as nsp:
             self._resolve(tick)
+            if features.enabled(features.FAIR_SHARING):
+                # How many ClusterQueues fell off the bulk share tensors
+                # onto the per-CQ dict walk (0 in a normal tick).
+                nsp.set("fair.bulk_miss", self._fair_bulk_miss)
             if tick.handle is not None:
                 cached = tick.handle.get("cached")
                 if cached is not None:
@@ -397,6 +407,18 @@ class Scheduler:
         with TRACER.phase("requeue"):
             self._requeue_sweep([e for e in entries if e.status != ASSUMED],
                                 quiescent=skip_cycle)
+        st = self._tick_fair_state
+        if st is not None:
+            # Post-commit publication refresh: fold the cycle's usage
+            # movement into the share state NOW (dirty cohorts only; one
+            # generation compare when nothing committed), so the
+            # off-thread metrics scrape (fair_shares_last) serves
+            # end-of-tick shares even when the system then drains and no
+            # later nominate refreshes. Decision paths are untouched —
+            # the next nominate's refresh is idempotent on the same
+            # usage tensors.
+            with TRACER.phase("fair.publish"):
+                st.refresh()
         self.metrics.admission_attempts += 1
         self.metrics.last_tick_seconds = self.clock() - tick.start
         self._record_decisions(entries, quiescent=skip_cycle)
@@ -415,6 +437,16 @@ class Scheduler:
     # entry is three lists of per-head refs, so the ring is a few MB at
     # 1k heads, pinned only while quiescence holds.
     QUIET_RING_MAX = 128
+
+    def _fair_share_term(self) -> int:
+        """The quiescent-signature share term: the incremental share
+        state's version (bumped exactly when any share value changed),
+        -1 when fair sharing runs on the dict-walk fallback, 0 with the
+        gate off."""
+        if not features.enabled(features.FAIR_SHARING):
+            return 0
+        st = self._tick_fair_state
+        return st.version if st is not None else -1
 
     def _quiescent_match(self, tick: TickInFlight,
                          entries: List[Entry]) -> Optional[dict]:
@@ -443,10 +475,16 @@ class Scheduler:
         # its refs alive, so its recorded ids cannot have been recycled.)
         # The sort-relevant feature gates ride along: they can flip
         # without a cache mutation, and the recorded order bakes them in.
+        # So does the fair-share state VERSION (the share term of the
+        # signature): shares are a pure function of cache usage — which
+        # the mutation stamp already pins — but the explicit term keeps
+        # the fair sort order provably identical even if the share
+        # machinery ever gained another input.
         key = (tuple(e.info.obj.uid for e in entries),
                tuple(id(e.assignment) for e in entries),
                features.enabled(features.FAIR_SHARING),
-               features.enabled(features.PRIORITY_SORTING_WITHIN_COHORT))
+               features.enabled(features.PRIORITY_SORTING_WITHIN_COHORT),
+               self._fair_share_term())
         ent = self._quiet_ring.get(key)
         if ent is None or ent["mut"] != self._mirror.mutation_count:
             return None
@@ -494,7 +532,8 @@ class Scheduler:
         ring = self._quiet_ring
         ring[(pre_uids, tuple(id(a) for a in pre_assign),
               features.enabled(features.FAIR_SHARING),
-              features.enabled(features.PRIORITY_SORTING_WITHIN_COHORT))] = {
+              features.enabled(features.PRIORITY_SORTING_WITHIN_COHORT),
+              self._fair_share_term())] = {
             "assignments": pre_assign,
             "msgs": pre_msgs,
             "order": sort_order,
@@ -652,22 +691,49 @@ class Scheduler:
             assignments = None
         fair = features.enabled(features.FAIR_SHARING)
         shares: Dict[str, float] = {}
+        fair_state = None
+        fair_cq_index = None
         if fair:
-            # One vectorized pass over the lockstep usage tensor instead
-            # of a dict DRF walk per ClusterQueue (KEP-1714 at 1k-CQ
-            # scale); falls back to the per-CQ referee when the solver
-            # has no matching encoding.
-            bulk = getattr(self.batch_solver, "fair_shares", None)
-            if bulk is not None:
-                shares = bulk(snapshot) or {}
+            # The incremental share state: shares replayed across ticks
+            # (memoized on the per-cohort usage-VALUE generations) with
+            # only dirty cohorts' members recomputed — instead of a dict
+            # DRF walk per ClusterQueue, or even a full [C,F,R] pass,
+            # per tick (KEP-1714 at 1k-CQ scale). Falls back to the
+            # per-CQ referee when the solver has no matching encoding
+            # or KUEUE_TPU_NO_DEVICE_FAIR=1.
+            with TRACER.phase("nominate.fair"):
+                fs_fn = getattr(self.batch_solver, "fair_share_state",
+                                None)
+                fair_state = fs_fn(snapshot) if fs_fn is not None else None
+            if fair_state is not None:
+                fair_cq_index = fair_state.enc.cq_index
+                if os.environ.get("KUEUE_TPU_DEBUG_FAIR", "") == "1":
+                    fair_state.verify(snapshot)
+        self._tick_fair_state = fair_state
+        self._fair_bulk_miss = 0
 
         def share_of(cq_name: str) -> float:
+            if fair_cq_index is not None:
+                ci = fair_cq_index.get(cq_name)
+                if ci is not None:
+                    return fair_state.share_of_ci(ci)
             s = shares.get(cq_name)
             if s is None:
                 cq = snapshot.cluster_queues.get(cq_name)
-                s = shares[cq_name] = (
-                    fair_share.dominant_resource_share(cq)[0]
-                    if cq is not None else 0.0)
+                if cq is None:
+                    # A CQ outside the snapshot entirely (inactive or
+                    # deleted — only non-solvable entries get here):
+                    # share 0 by definition, not an encoding gap.
+                    s = shares[cq_name] = 0.0
+                else:
+                    # Bulk miss: a ClusterQueue outside the solver's
+                    # share tensors (no encoding, rotation in flight, or
+                    # the kill switch) pays the dict DRF walk — counted
+                    # and surfaced as the nominate span's
+                    # `fair.bulk_miss` attribute.
+                    self._fair_bulk_miss += 1
+                    s = shares[cq_name] = \
+                        fair_share.dominant_resource_share(cq)[0]
             return s
         # Batched device victim search: all PREEMPT-mode entries of the
         # tick solved in at most two dispatches instead of one per entry
@@ -695,8 +761,6 @@ class Scheduler:
                 e.preemption_targets = []
                 e.inadmissible_msg = ""
                 e.info.last_assignment = full.last_state
-                if fair:
-                    e.share = share_of(e.info.cluster_queue)
                 continue
             if (full is not None and full.representative_mode == PREEMPT
                     and i not in batch_targets):
@@ -721,10 +785,27 @@ class Scheduler:
                 partial_pending.append(e)
             else:
                 e.info.last_assignment = assignment.last_state
-            if fair:
+        if fair:
+            # ALL entries are sorted, not just the solvable ones — key
+            # every entry (incl. failed-checks / inactive-CQ / namespace
+            # mismatches) by its ClusterQueue's actual share, so the
+            # packed rank sort, the float-share fallback, and the tuple
+            # referee (_entry_sort_key) order identically.
+            for e in tick.entries:
                 e.share = share_of(e.info.cluster_queue)
         if partial_pending:
             self._batch_partial_admission(partial_pending, snapshot)
+
+    def _fair_ctx(self, snapshot: Snapshot):
+        """The solver's vectorized fair-preemption context for this
+        snapshot (ops/fair_preempt), or None — fair sharing off, no
+        batch solver, stale encoding, or the device-fair kill switch;
+        get_targets then runs the host fair referee."""
+        if not features.enabled(features.FAIR_SHARING) \
+                or self.batch_solver is None:
+            return None
+        fn = getattr(self.batch_solver, "fair_preempt_context", None)
+        return fn(snapshot) if fn is not None else None
 
     def _get_assignment(self, wi: WorkloadInfo, snap: Snapshot,
                         precomputed: Optional[Assignment],
@@ -746,7 +827,8 @@ class Scheduler:
                 else preemption_mod.get_targets(
                     wi, full, snap, self.ordering, self.clock(),
                     fair_strategies=self.fair_strategies,
-                    engine=self.preemption_engine)
+                    engine=self.preemption_engine,
+                    fair_ctx=self._fair_ctx(snap))
         if not allow_partial \
                 or not features.enabled(features.PARTIAL_ADMISSION) or targets:
             return full, targets
@@ -760,7 +842,8 @@ class Scheduler:
                 t = preemption_mod.get_targets(
                     wi, assignment, snap, self.ordering, self.clock(),
                     fair_strategies=self.fair_strategies,
-                    engine=self.preemption_engine)
+                    engine=self.preemption_engine,
+                    fair_ctx=self._fair_ctx(snap))
                 if t:
                     return (assignment, t), True
                 return None, False
@@ -787,12 +870,14 @@ class Scheduler:
                 [(wi, a) for _, wi, a in pairs],
                 snapshot, self.ordering, self.clock(),
                 self.fair_strategies, *ctx_usage,
-                backend=self.preemption_engine)
+                backend=self.preemption_engine,
+                fair_ctx=self._fair_ctx(snapshot))
             return {key: t for (key, _, _), t in zip(pairs, targets_list)}
+        fair_ctx = self._fair_ctx(snapshot)
         return {key: preemption_mod.get_targets(
                     wi, a, snapshot, self.ordering, self.clock(),
                     fair_strategies=self.fair_strategies,
-                    engine=self.preemption_engine)
+                    engine=self.preemption_engine, fair_ctx=fair_ctx)
                 for key, wi, a in pairs}
 
     def _batch_partial_admission(self, entries: List[Entry],
@@ -886,11 +971,14 @@ class Scheduler:
 
         The queue-order timestamps come from the memoized
         `queue_order_time` (they only move on Evicted transitions), and
-        without fair sharing the two adjacent integer components —
-        borrowing (most significant) and negated priority — are PACKED
-        into one int64 key (borrow in bit 62; priorities are far below
-        2^61), so the common config sorts with two argsort passes instead
-        of four `np.fromiter` generator walks plus three passes."""
+        the adjacent integer components — borrowing (most significant),
+        the fair-share RANK (the share kernel's dense order-preserving
+        quantization of the weighted share, when FairSharing is on and
+        the solver's share state covers every entry), and negated
+        priority — are PACKED into one int64 key (borrow in bit 62,
+        rank in bits 34..61, priority far below 2^33), so BOTH configs
+        sort with two argsort passes instead of four `np.fromiter`
+        generator walks plus three passes."""
         n = len(entries)
         if n < 64:
             entries.sort(key=self._entry_sort_key)
@@ -905,9 +993,11 @@ class Scheduler:
         borrow = np.array(
             [e.assignment is not None and e.assignment.borrowing
              for e in entries], dtype=np.int64)
-        if fair:
-            # Share sits between priority and borrowing in significance,
-            # so the components stay separate lexsort keys.
+        ranks = self._fair_ranks(entries) if fair else None
+        if fair and ranks is None:
+            # No share state covering every entry (kill switch / stale
+            # encoding / out-of-encoding CQ): the float share stays its
+            # own lexsort key between priority and borrowing.
             if prio_on:
                 keys.append(np.array([-e.info.obj.priority for e in entries],
                                      dtype=np.int64))
@@ -916,12 +1006,40 @@ class Scheduler:
             keys.append(borrow)
         else:
             packed = borrow << 62
+            if ranks is not None:
+                # Dense ranks order exactly as the float shares (equal
+                # shares share a rank), so the packed key sorts
+                # identically to the separate share component.
+                packed += ranks << 34
             if prio_on:
                 packed += np.array([-e.info.obj.priority for e in entries],
                                    dtype=np.int64)
             keys.append(packed)
         order = np.lexsort(keys)
         entries[:] = [entries[i] for i in order.tolist()]
+
+    def _fair_ranks(self, entries: List[Entry]):
+        """[n] int64 share ranks for the packed fair sort key, or None
+        when the tick's share state does not cover every entry's
+        ClusterQueue (the caller falls back to float-share lexsort)."""
+        st = self._tick_fair_state
+        if st is None:
+            return None
+        import numpy as np
+        cq_index = st.enc.cq_index
+        rank = st.rank
+        memo: Dict[str, int] = {}
+        out = np.empty(len(entries), dtype=np.int64)
+        for i, e in enumerate(entries):
+            name = e.info.cluster_queue
+            r = memo.get(name)
+            if r is None:
+                ci = cq_index.get(name)
+                if ci is None:
+                    return None
+                r = memo[name] = int(rank[ci])
+            out[i] = r
+        return out
 
     # -- admission cycle (scheduler.go:204-275) ------------------------------
 
@@ -1196,7 +1314,8 @@ class Scheduler:
                     e.preemption_targets = preemption_mod.get_targets(
                         e.info, e.assignment, snapshot, self.ordering,
                         self.clock(), fair_strategies=self.fair_strategies,
-                        engine=self.preemption_engine)
+                        engine=self.preemption_engine,
+                        fair_ctx=self._fair_ctx(snapshot))
                 if e.preemption_targets:
                     # Next attempt should try all flavors (scheduler.go:240).
                     e.info.last_assignment = None

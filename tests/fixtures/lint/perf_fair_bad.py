@@ -1,0 +1,27 @@
+"""PERF01 fair-loop fixtures: per-iteration share dict walks in loops."""
+
+from kueue_tpu.solver.fair_share import dominant_resource_share
+
+
+def fair_victims_slow(snapshot, per_cq, strategies, cq, wl_req):
+    # The KEP-1714 loop shape PERF01 polices: dominant_resource_share
+    # re-derived per candidate per while-iteration.
+    targets = []
+    while per_cq:
+        share_x, _ = dominant_resource_share(cq, wl_req)  # finding
+        for name, cands in per_cq.items():
+            y = snapshot.cluster_queues[name]
+            for z in cands:
+                share_y, _ = dominant_resource_share(y)  # finding
+                if share_y > share_x:
+                    targets.append(z)
+        break
+    return targets
+
+
+def order_slow(snapshot, names):
+    out = []
+    for name in names:
+        out.append(dominant_resource_share(  # finding
+            snapshot.cluster_queues[name])[0])
+    return out

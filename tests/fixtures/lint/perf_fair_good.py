@@ -1,0 +1,24 @@
+"""PERF01 fair-loop good twins: shares computed once, arrays compared."""
+
+import numpy as np
+
+from kueue_tpu.solver.fair_share import dominant_resource_share
+
+
+def fair_victims_vectorized(state, swo, valid, sx):
+    # Shares computed ONCE on the vectorized tensors; the loop compares
+    # precomputed arrays (masked argmax), never re-walking the dicts.
+    ok = valid & (swo >= sx)
+    targets = []
+    while ok.any():
+        z = int(np.argmax(ok))
+        targets.append(z)
+        ok[z] = False
+    return targets
+
+
+def share_once_outside_loop(snapshot, cq, names):
+    # A single share walk OUTSIDE any loop is fine (the referee's
+    # one-shot reads, the metrics fallback).
+    base = dominant_resource_share(cq)[0]
+    return [base for _ in names]

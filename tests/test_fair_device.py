@@ -1,0 +1,460 @@
+"""Device-side fair sharing: differential goldens + unit coverage.
+
+The PR-8 contract: the vectorized fair path (incremental share state,
+packed int64 fair sort key, tensor victim search) is DECISION-IDENTICAL
+to the dict-walk referee everywhere. The churn goldens drive 200
+randomized ticks of add/admit/preempt/delete churn over a WEIGHTED
+KEP-79 hierarchical tree + a flat cohort + cohortless ClusterQueues,
+with FairSharing on, twice — device fair on (with KUEUE_TPU_DEBUG_FAIR=1,
+so every search additionally runs the host oracle in-line and asserts
+equal victim sequences, and every tick cross-checks the incremental
+share state against the referee) and off (KUEUE_TPU_NO_DEVICE_FAIR=1) —
+across every registered victim-search engine and both
+FairSharingStrategy orders.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from kueue_tpu import features
+from kueue_tpu.api.types import (
+    ClusterQueuePreemption,
+    CohortSpec,
+    FairSharing,
+    FairSharingStrategy,
+    PodSet,
+    Workload,
+)
+from kueue_tpu.config import Configuration, FairSharingConfig, TPUSolverConfig
+from kueue_tpu.controllers.runtime import Framework
+from kueue_tpu.models.flavor_fit import BatchSolver
+from kueue_tpu.solver import modes as _modes
+
+from tests.util import fq, make_cq, make_flavor, make_lq, rg
+
+TICKS = 200
+
+_ENGINE_KNOB = {
+    "host": None,
+    "scan-jax": "jax",
+    "scan-pallas": "pallas",
+    "batch-native": "native",
+    "batch-jax": "jax",
+}
+
+_KNOBS = []
+for _spec in _modes.ENGINES:
+    if _spec.optional_import and not _modes.engine_importable(_spec):
+        continue
+    knob = _ENGINE_KNOB[_spec.name]
+    if knob not in _KNOBS:
+        _KNOBS.append(knob)
+
+S2A_FIRST = (FairSharingStrategy.LESS_THAN_OR_EQUAL_TO_FINAL_SHARE,
+             FairSharingStrategy.LESS_THAN_INITIAL_SHARE)
+S2B_FIRST = (FairSharingStrategy.LESS_THAN_INITIAL_SHARE,
+             FairSharingStrategy.LESS_THAN_OR_EQUAL_TO_FINAL_SHARE)
+
+
+@pytest.fixture(autouse=True)
+def fair_on():
+    features.set_enabled(features.FAIR_SHARING, True)
+    yield
+
+
+class TickClock:
+    """Deterministic scheduler clock: frozen within a tick, advanced by
+    the churn driver between ticks. The A/B goldens compare two full
+    drives, and real wall-clock condition timestamps (QuotaReserved /
+    Evicted transition times feed the candidate ordering) differ between
+    them — a microsecond tie in one drive but not the other flips a
+    sort tiebreak and fakes a decision divergence."""
+
+    def __init__(self):
+        self.now = 1_000_000.0
+
+    def advance(self, dt: float = 1.0) -> None:
+        self.now += dt
+
+    def __call__(self) -> float:
+        return self.now
+
+
+def build(engine, strategies):
+    cfg = Configuration(
+        tpu_solver=TPUSolverConfig(
+            preemption_engine="host" if engine is None else engine),
+        fair_sharing=FairSharingConfig(
+            enable=True, preemption_strategies=tuple(strategies)))
+    fw = Framework(batch_solver=BatchSolver(), config=cfg,
+                   clock=TickClock())
+    fw.create_namespace("default", labels={})
+    fw.create_resource_flavor(make_flavor("default"))
+    # A weighted KEP-79 tree: two mid cohorts under one root, plus a
+    # flat cohort and two cohortless CQs (the classic engine path).
+    fw.create_cohort(CohortSpec(name="root"))
+    fw.create_cohort(CohortSpec(name="mid-a", parent="root"))
+    fw.create_cohort(CohortSpec(name="mid-b", parent="root"))
+    weights = [0.0, 1.0, 2.0, 4.0, 1.0, 3.0, 2.0, 1.0]
+    for i in range(8):
+        cohort = ("mid-a" if i < 3 else "mid-b" if i < 5
+                  else "flatpool" if i < 7 else "")
+        import dataclasses
+        quota = fq("default", cpu=(4, 8)) if cohort \
+            else fq("default", cpu=4)
+        cq = make_cq(
+            f"cq-{i}", rg("cpu", quota),
+            cohort=cohort,
+            preemption=ClusterQueuePreemption(
+                within_cluster_queue="LowerPriority",
+                reclaim_within_cohort="Any"))
+        cq = dataclasses.replace(
+            cq, fair_sharing=FairSharing(weight=weights[i]))
+        fw.create_cluster_queue(cq)
+        fw.create_local_queue(make_lq(f"lq-{i}", "default", cq=f"cq-{i}"))
+    return fw
+
+
+def drive(engine, strategies, ticks: int = TICKS):
+    fw = build(engine, strategies)
+    rnd = random.Random(99)
+    seq = [0]
+    pending: dict = {}
+    admitted: dict = {}
+    trail = []
+
+    orig_admit = fw.scheduler.apply_admission
+    orig_preempt = fw.scheduler.apply_preemption
+    tick_admitted: list = []
+    tick_preempted: list = []
+
+    def apply_admission(wl):
+        ok = orig_admit(wl)
+        if ok:
+            tick_admitted.append(wl.key)
+            admitted[wl.key] = wl
+            pending.pop(wl.key, None)
+        return ok
+
+    def apply_preemption(wl, msg):
+        tick_preempted.append(wl.key)
+        return orig_preempt(wl, msg)
+
+    fw.scheduler.apply_admission = apply_admission
+    fw.scheduler.apply_preemption = apply_preemption
+
+    def submit_one():
+        seq[0] += 1
+        i = seq[0]
+        wl = Workload(
+            name=f"wl-{i}", namespace="default",
+            queue_name=f"lq-{rnd.randrange(8)}",
+            priority=rnd.randint(-2, 3),
+            creation_time=float(1000 + i),
+            pod_sets=[PodSet.make("ps0", count=rnd.randint(1, 2),
+                                  cpu=rnd.randint(1, 4))])
+        pending[wl.key] = wl
+        fw.submit(wl)
+
+    for _ in range(30):
+        submit_one()
+
+    for _ in range(ticks):
+        tick_admitted.clear()
+        tick_preempted.clear()
+        fw.clock.advance()
+        fw.tick()
+        # Preserving tick ORDER of preemptions pins the victim SEQUENCE
+        # (issue order), not just the set.
+        trail.append((tuple(sorted(tick_admitted)), tuple(tick_preempted)))
+        for _ in range(rnd.randint(0, 3)):
+            submit_one()
+        done = [k for k, w in sorted(admitted.items())
+                if w.is_admitted and not w.is_finished]
+        for key in done[:rnd.randint(0, 3)]:
+            wl = admitted.pop(key)
+            fw.finish(wl)
+            fw.delete_workload(wl)
+        for key in list(admitted):
+            if not admitted[key].is_admitted:
+                wl = admitted.pop(key)
+                if not wl.is_finished:
+                    pending[key] = wl
+        fw.prewarm_idle()
+    trail.append(("pending", sum(fw.queues.pending(f"cq-{i}")
+                                 for i in range(8))))
+    return trail
+
+
+_PARAMS = [(k, S2A_FIRST) for k in _KNOBS] + [(None, S2B_FIRST)]
+
+
+@pytest.mark.parametrize(
+    "engine,strategies", _PARAMS,
+    ids=[f"{k}-s2a" for k in _KNOBS] + ["None-s2b"])
+def test_device_fair_vs_referee_decisions_identical(engine, strategies,
+                                                    monkeypatch):
+    monkeypatch.setenv("KUEUE_TPU_DEBUG_FAIR", "1")
+    with_device = drive(engine, strategies)
+    monkeypatch.delenv("KUEUE_TPU_DEBUG_FAIR")
+    monkeypatch.setenv("KUEUE_TPU_NO_DEVICE_FAIR", "1")
+    without = drive(engine, strategies)
+    monkeypatch.delenv("KUEUE_TPU_NO_DEVICE_FAIR")
+    assert with_device == without
+
+
+def test_registry_covered():
+    assert set(_ENGINE_KNOB) == {e.name for e in _modes.ENGINES}, \
+        "new victim-search engine registered; map it here so the fair " \
+        "differential goldens run it"
+
+
+# -- scenario goldens: weighted KEP-79 tree, every engine, A/B -------------
+
+
+@pytest.mark.parametrize("device_fair", [True, False],
+                         ids=["device", "referee"])
+@pytest.mark.parametrize("engine", _KNOBS, ids=[str(k) for k in _KNOBS])
+@pytest.mark.parametrize("weight,expect_preempt",
+                         [(1.0, True), (3.0, False)])
+def test_weighted_tree_fair_preemption_golden(weight, expect_preempt,
+                                              engine, device_fair,
+                                              monkeypatch):
+    """The TestPreemption-style fair golden over a weighted (weight != 1)
+    hierarchical tree: `heavy` (in one subtree) borrows the whole shared
+    pool; a borrowing request from `light` (in the sibling subtree)
+    preempts heavy at weight 1 (equal standing) but not at weight 3 —
+    identical victims for every registered engine with the device fair
+    path on or off."""
+    import dataclasses
+
+    if device_fair:
+        monkeypatch.setenv("KUEUE_TPU_DEBUG_FAIR", "1")
+    else:
+        monkeypatch.setenv("KUEUE_TPU_NO_DEVICE_FAIR", "1")
+    cfg = Configuration(
+        tpu_solver=TPUSolverConfig(
+            preemption_engine="host" if engine is None else engine),
+        fair_sharing=FairSharingConfig(enable=True))
+    fw = Framework(batch_solver=BatchSolver(), config=cfg)
+    fw.create_resource_flavor(make_flavor("default"))
+    fw.create_cohort(CohortSpec(name="root"))
+    fw.create_cohort(CohortSpec(name="wing-a", parent="root"))
+    fw.create_cohort(CohortSpec(name="wing-b", parent="root"))
+    for name, cohort, w in (("heavy", "wing-a", weight),
+                            ("light", "wing-b", 1.0),
+                            ("pool", "wing-b", 1.0)):
+        cq = make_cq(name, rg("cpu", fq("default", cpu=2)), cohort=cohort,
+                     preemption=ClusterQueuePreemption(
+                         reclaim_within_cohort="Any",
+                         within_cluster_queue="LowerPriority"))
+        cq = dataclasses.replace(cq, fair_sharing=FairSharing(weight=w))
+        fw.create_cluster_queue(cq)
+    fw.create_local_queue(make_lq("h", cq="heavy"))
+    fw.create_local_queue(make_lq("l", cq="light"))
+    from tests.util import make_wl
+    for i in range(3):
+        fw.submit(make_wl(f"h{i}", "h", cpu=2, creation_time=float(i)))
+    fw.run_until_settled()
+    assert len(fw.admitted_workloads("heavy")) == 3  # borrowing 4 of 6
+    fw.submit(make_wl("l0", "l", cpu="3500m", creation_time=10.0))
+    fw.run_until_settled()
+    if expect_preempt:
+        assert len(fw.admitted_workloads("light")) == 1
+        assert len(fw.admitted_workloads("heavy")) == 1
+    else:
+        assert len(fw.admitted_workloads("light")) == 0
+        assert len(fw.admitted_workloads("heavy")) == 3
+
+
+# -- incremental share state ------------------------------------------------
+
+
+def test_share_state_matches_referee_after_churn():
+    """The generation-memoized shares equal a from-scratch referee pass
+    after randomized admit/finish churn (the replay path, not just the
+    seed pass)."""
+    from kueue_tpu.solver.fair_share import dominant_resource_share
+
+    fw = build(None, S2A_FIRST)
+    rnd = random.Random(5)
+    for i in range(24):
+        fw.submit(Workload(
+            name=f"w-{i}", namespace="default",
+            queue_name=f"lq-{rnd.randrange(8)}",
+            priority=rnd.randint(-1, 2), creation_time=float(i),
+            pod_sets=[PodSet.make("ps0", count=1, cpu=rnd.randint(1, 4))]))
+    for _ in range(12):
+        fw.tick()
+    solver = fw.scheduler.batch_solver
+    snapshot = fw.scheduler._mirror.refresh()
+    st = solver.fair_share_state(snapshot)
+    assert st is not None
+    st.verify(snapshot)
+    # Ranks order exactly as the float shares.
+    order_rank = np.lexsort((np.arange(len(st.share)), st.rank))
+    order_share = np.lexsort((np.arange(len(st.share)), st.share))
+    assert list(order_rank) == list(order_share)
+    # And the dict view matches the referee per CQ.
+    shares = solver.fair_shares(snapshot)
+    for name, cq in snapshot.cluster_queues.items():
+        assert shares[name] == dominant_resource_share(cq)[0], name
+
+
+def test_share_state_replays_untouched_cohorts():
+    """A tick with no usage movement recomputes nothing: the state's
+    version is stable and refresh() is a pure generation compare."""
+    fw = build(None, S2A_FIRST)
+    for i in range(6):
+        fw.submit(Workload(
+            name=f"w-{i}", namespace="default", queue_name=f"lq-{i}",
+            priority=0, creation_time=float(i),
+            pod_sets=[PodSet.make("ps0", count=1, cpu=6)]))
+    for _ in range(6):
+        fw.tick()
+    solver = fw.scheduler.batch_solver
+    snapshot = fw.scheduler._mirror.refresh()
+    st = solver.fair_share_state(snapshot)
+    v0 = st.version
+    st2 = solver.fair_share_state(snapshot)
+    assert st2 is st and st2.version == v0
+    # Releasing quota moves a cohort's generation and its shares.
+    victim = fw.workloads["default/w-0"]
+    fw.finish(victim)
+    fw.delete_workload(victim)
+    fw.tick()
+    snapshot = fw.scheduler._mirror.refresh()
+    st3 = solver.fair_share_state(snapshot)
+    st3.verify(snapshot)
+
+
+def test_fair_bulk_covers_every_cq_in_normal_tick():
+    """`fair.bulk_miss` stays 0 when the solver's encoding is current —
+    every ClusterQueue's share comes from the bulk tensors, never the
+    per-CQ dict walk."""
+    fw = build(None, S2A_FIRST)
+    for i in range(8):
+        fw.submit(Workload(
+            name=f"w-{i}", namespace="default",
+            queue_name=f"lq-{i % 8}", priority=0, creation_time=float(i),
+            pod_sets=[PodSet.make("ps0", count=1, cpu=6)]))
+    for _ in range(4):
+        fw.tick()
+        assert fw.scheduler._fair_bulk_miss == 0
+    assert fw.scheduler._tick_fair_state is not None
+
+
+def test_sharded_fair_shares_bitwise_identical():
+    """The per-shard share kernel (zero collectives over the cohort
+    mesh) equals the numpy arithmetic bitwise."""
+    from kueue_tpu.models.fair_share import weighted_shares_np
+    from kueue_tpu.parallel.mesh import CohortMesh, sharded_fair_shares
+
+    rnd = np.random.RandomState(7)
+    C, F, R = 23, 3, 2
+    nominal = rnd.randint(0, 50, size=(C, F, R)).astype(np.int64)
+    usage = rnd.randint(0, 80, size=(C, F, R)).astype(np.int64)
+    cap = rnd.randint(0, 120, size=(C, R)).astype(np.int64)
+    cap[3] = 0
+    weight = rnd.choice([0.0, 1.0, 2.0, 4.0], size=C)
+    above = np.maximum(usage - nominal, 0).sum(axis=1)
+    want = weighted_shares_np(above, cap, weight)
+    cmesh = CohortMesh(4)
+    got = sharded_fair_shares(cmesh, nominal, usage, cap, weight)
+    assert got.shape == want.shape
+    assert np.array_equal(got, want)
+
+
+def test_quiescent_fair_steady_state_dispatches_nothing():
+    """The fair twin of the PR-6 quiescent-tick contract: with fair
+    sharing ON, a steady state (StrictFIFO, nothing changing) replays
+    fingerprint-cached verdicts, dispatches ZERO solves, and takes the
+    quiescent-tick replay path — fair sharing no longer defeats the
+    steady-state machinery."""
+    fw = Framework(batch_solver=BatchSolver())
+    fw.create_namespace("default", labels={})
+    fw.create_resource_flavor(make_flavor("default"))
+    import dataclasses
+    for i in range(3):
+        cq = make_cq(f"cq-{i}", rg("cpu", fq("default", cpu=4)),
+                     cohort="pool", strategy="StrictFIFO")
+        cq = dataclasses.replace(cq,
+                                 fair_sharing=FairSharing(weight=2.0))
+        fw.create_cluster_queue(cq)
+        fw.create_local_queue(make_lq(f"lq-{i}", "default", cq=f"cq-{i}"))
+    for i in range(3):
+        for j in range(3):
+            fw.submit(Workload(
+                name=f"w-{i}-{j}", namespace="default",
+                queue_name=f"lq-{i}", priority=0,
+                creation_time=float(10 * i + j),
+                pod_sets=[PodSet.make("ps0", count=1, cpu=4)]))
+    solver = fw.scheduler.batch_solver
+    for _ in range(12):
+        fw.tick()
+    d0 = solver.dispatches
+    q0 = fw.scheduler.metrics.quiescent_ticks
+    for _ in range(5):
+        fw.tick()
+    assert solver.dispatches == d0, \
+        "quiescent fair tick dispatched a solve"
+    assert fw.scheduler.metrics.quiescent_ticks > q0, \
+        "fair steady state never took the quiescent replay path"
+
+
+def test_fair_share_gauge_served_from_bulk_and_pruned_on_delete():
+    """The metrics scrape serves cluster_queue_fair_share from the share
+    kernel's last tick output (no per-scrape snapshot + DRF walk) and a
+    deleted ClusterQueue's series prunes away."""
+    from kueue_tpu.metrics import REGISTRY
+    from kueue_tpu.solver.fair_share import dominant_resource_share
+
+    fw = build(None, S2A_FIRST)
+    for i in range(4):
+        fw.submit(Workload(
+            name=f"w-{i}", namespace="default", queue_name=f"lq-{i}",
+            priority=0, creation_time=float(i),
+            pod_sets=[PodSet.make("ps0", count=1, cpu=6)]))
+    for _ in range(4):
+        fw.tick()
+    assert fw.scheduler.batch_solver.fair_shares_last() is not None
+    fw.update_metrics_gauges()
+    snapshot = fw.scheduler._mirror.refresh()
+    gauge = REGISTRY.cluster_queue_fair_share
+    for name, cq in snapshot.cluster_queues.items():
+        assert gauge.values.get((name,)) == pytest.approx(
+            dominant_resource_share(cq)[0]), name
+    # Delete a CQ: its series must prune on the next scrape, whether or
+    # not a tick has rebuilt the share tensors since.
+    fw.delete_cluster_queue("cq-7")
+    fw.update_metrics_gauges()
+    assert ("cq-7",) not in gauge.values
+
+
+def test_fair_share_publication_fresh_after_drain():
+    """The end-of-tick republish (`fair.publish`): a commit on the LAST
+    tick before the system drains must reach the scrape — the
+    nominate-time refresh alone runs before the cycle's commits, so a
+    drained system would serve the pre-admission shares forever."""
+    from kueue_tpu.solver.fair_share import dominant_resource_share
+
+    fw = build(None, S2A_FIRST)
+    # cq-5 (flatpool, nominal 4, borrowable to 8): cpu=6 borrows 2
+    # above nominal, so its post-admission share is strictly positive.
+    fw.submit(Workload(
+        name="w-drain", namespace="default", queue_name="lq-5",
+        priority=0, creation_time=1.0,
+        pod_sets=[PodSet.make("ps0", count=1, cpu=6)]))
+    fw.tick()
+    assert fw.cache.cluster_queues["cq-5"].workloads, "setup: not admitted"
+    # No further tick: the publication must already hold end-of-tick
+    # shares, matching the referee on the CURRENT usage.
+    shares = fw.scheduler.batch_solver.fair_shares_last()
+    assert shares is not None
+    snapshot = fw.scheduler._mirror.refresh()
+    for name, cq in snapshot.cluster_queues.items():
+        assert shares[name] == pytest.approx(
+            dominant_resource_share(cq)[0]), name
+    assert shares["cq-5"] > 0

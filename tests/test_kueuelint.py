@@ -199,6 +199,20 @@ def test_perf_good_fixture():
     assert run_analysis([str(FIXTURES / "perf_good.py")]) == []
 
 
+def test_perf_fair_bad_fixture():
+    findings = run_analysis([str(FIXTURES / "perf_fair_bad.py")])
+    perf = [f for f in findings if f.rule == "PERF01"]
+    # share_x + per-candidate share in the while loop, plus the
+    # per-name for-loop walk.
+    assert len(perf) == 3
+    assert all("dominant_resource_share" in f.message for f in perf)
+    assert all(f.severity.label == "error" for f in perf)
+
+
+def test_perf_fair_good_fixture():
+    assert run_analysis([str(FIXTURES / "perf_fair_good.py")]) == []
+
+
 def test_perf_rule_scoped_to_solver_packages(tmp_path):
     # The same loop shape OUTSIDE scheduler//solver//models/ (analysis
     # tooling, tests, benchmarks post-processing) is not PERF01's
