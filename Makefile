@@ -10,7 +10,7 @@
 PYTHON ?= python
 
 .PHONY: help test test-fast bench bench-smoke trace-smoke multichip-smoke \
-	native lint verify-static install serve dryrun
+	replica-smoke native lint verify-static install serve dryrun
 
 help:
 	@echo "kueue-tpu developer targets:"
@@ -28,6 +28,10 @@ help:
 	@echo "                      Chrome trace-event export (Perfetto)"
 	@echo "  make multichip-smoke  8-shard cohort-mesh dryrun + sharded"
 	@echo "                      differential goldens on CPU host devices"
+	@echo "  make replica-smoke  3-replica multi-process run on CPU:"
+	@echo "                      spawn-mode identity gate + fail-over"
+	@echo "                      drill + the replica bench config with"
+	@echo "                      commit-protocol evidence gates"
 	@echo "  make native         build the C++ runtime pieces"
 	@echo "  make serve          run the API server"
 	@echo "  make dryrun         compile-check the flagship jit path"
@@ -61,9 +65,10 @@ bench-smoke:
 	  missing = set(METRIC_NAMES.values()) - set(by); \
 	  assert not missing, f'configs missing from BENCH output: {missing}'; \
 	  steady = METRIC_NAMES['steady']; \
+	  replica = METRIC_NAMES['replica']; \
 	  ratios = {m: l.get('arena_reuse_ratio') for m, l in by.items()}; \
 	  bad = {m: r for m, r in ratios.items() \
-	         if (r is None or r <= 0.9) and m != steady}; \
+	         if (r is None or r <= 0.9) and m not in (steady, replica)}; \
 	  assert not bad, f'arena_reuse_ratio <= 0.9: {bad}'; \
 	  rebuilds = {m: l.get('arena_full_rebuilds') for m, l in by.items()}; \
 	  assert not any(rebuilds.values()), f'full rebuilds in window: {rebuilds}'; \
@@ -107,9 +112,23 @@ bench-smoke:
 	  print('bench-smoke shard gate OK: imbalance', \
 	        shard.get('shard_imbalance_ratio'), 'scaling', \
 	        shard.get('p99_scaling_ratio')); \
+	  rep = by[replica]; \
+	  assert rep.get('identity_gate_admitted', 0) > 0, \
+	    f'replica config missing the identity-gate evidence: {rep}'; \
+	  drill = rep.get('forced_revocation_drill') or {}; \
+	  assert drill.get('revocations', 0) >= 1, \
+	    f'replica config produced no forced cross-replica revocation: {rep}'; \
+	  rtt = rep.get('reconcile_rtt_ms') or {}; \
+	  assert rtt.get('p99') is not None and rtt.get('p50') is not None, \
+	    f'replica config missing reconcile_rtt_ms evidence: {rep}'; \
+	  assert rep.get('peak_rss_mb', 0) > 0 and rep.get('n_replicas', 0) >= 2, \
+	    f'replica config missing peak-RSS / replica-count evidence: {rep}'; \
 	  print('bench-smoke fair gate OK: ratio', r, \
 	        'share_compute_ms', fair.get('fair_share_compute_ms'), \
-	        'fair_steady_dispatches', fsteady.get('solver_dispatches'))"
+	        'fair_steady_dispatches', fsteady.get('solver_dispatches')); \
+	  print('bench-smoke replica gate OK: replicas', rep.get('n_replicas'), \
+	        'rtt_p99_ms', rtt.get('p99'), 'revocations', \
+	        drill.get('revocations'), 'peak_rss_mb', rep.get('peak_rss_mb'))"
 
 # End-to-end tracing smoke: drive the real CLI with span tracing on,
 # then prove the exported file is valid Chrome trace-event JSON (the
@@ -141,6 +160,44 @@ multichip-smoke:
 	  $(PYTHON) __graft_entry__.py
 	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_shard.py \
 	  tests/test_sharded_solve.py -q
+
+# Multi-process replica smoke on CPU: the spawn-mode (real
+# multiprocessing) identity gate — a churn drive over real pipes must
+# match the single-process trail — plus the SIGKILL fail-over drill
+# (lease reassignment + partition-journal replay), the deterministic
+# cross-replica lending-clamp revocation, and a 3-replica replica bench
+# config whose gates assert the in-run identity check, >= 1 forced
+# revocation, and the reconcile-RTT/peak-RSS evidence. Runs in CI next
+# to multichip-smoke so the process-scale-out seam cannot rot.
+replica-smoke:
+	JAX_PLATFORMS=cpu $(PYTHON) -m pytest \
+	  "tests/test_replica.py::test_spawn_identity_smoke" \
+	  "tests/test_replica.py::test_spawn_failover_drill" \
+	  "tests/test_replica.py::test_lending_clamp_commit_protocol_revokes" \
+	  "tests/test_replica.py::test_merged_trace_is_valid_chrome_with_flow_events" \
+	  "tests/test_durable.py::test_replica_failover_replays_partition_journal" \
+	  -q
+	KUEUE_BENCH_SMOKE=1 KUEUE_BENCH_TICKS=10 KUEUE_TPU_REPLICAS=3 \
+	  KUEUE_BENCH_CONFIG=replica JAX_PLATFORMS=cpu \
+	  $(PYTHON) bench.py > /tmp/kueue-replica-smoke.jsonl
+	@cat /tmp/kueue-replica-smoke.jsonl
+	$(PYTHON) -c "import json; \
+	  lines = [json.loads(l) for l in open('/tmp/kueue-replica-smoke.jsonl') \
+	           if l.strip().startswith('{')]; \
+	  rep = lines[-1]; \
+	  assert rep['metric'] == 'p99_replica_tick_ms', rep; \
+	  assert rep.get('n_replicas') == 3, rep; \
+	  assert rep.get('transport') == 'spawn', rep; \
+	  assert rep.get('identity_gate_admitted', 0) > 0, rep; \
+	  assert (rep.get('forced_revocation_drill') or {}) \
+	    .get('revocations', 0) >= 1, rep; \
+	  rtt = rep.get('reconcile_rtt_ms') or {}; \
+	  assert rtt.get('p50') is not None and rtt.get('p99') is not None, rep; \
+	  assert rep.get('peak_rss_mb', 0) > 0, rep; \
+	  print('replica-smoke OK: rtt_p99_ms', rtt.get('p99'), \
+	        'revocations', rep['forced_revocation_drill']['revocations'], \
+	        'peak_rss_mb', rep['peak_rss_mb'], \
+	        'scaling', rep.get('p99_scaling_ratio'))"
 
 # Build the C++ runtime pieces (keyed heap, admission decoder) explicitly;
 # they are also built lazily on first import.

@@ -47,6 +47,20 @@ import numpy as np
 LINGER_TICKS = (4, 5, 6)
 
 
+def _rss_mb() -> float:
+    """Current resident set of this process in MB (the replica
+    runtime's reader, converted)."""
+    from kueue_tpu.controllers.replica_runtime import _rss_bytes
+
+    return _rss_bytes() / (1024.0 ** 2)
+
+
+def _pctl(samples, q):
+    if not samples:
+        return None
+    return float(np.percentile(np.asarray(samples, dtype=np.float64), q))
+
+
 def run_config(*, label, num_cqs, num_cohorts, num_flavors, backlog, ticks,
                usage_fill, depth, preemption_heavy, fair_hierarchy=False,
                lending=False, topology=False, strict_fifo=False,
@@ -248,6 +262,11 @@ def run_config(*, label, num_cqs, num_cohorts, num_flavors, backlog, ticks,
     quiescent_before = fw.scheduler.metrics.quiescent_ticks
     tick_phases = []
     base_admitted = fw.scheduler.metrics.admitted
+    # Per-window peak RSS, sampled once per tick (/proc read, ~µs): at
+    # 1M-backlog scale memory is first-class evidence next to latency,
+    # so EVERY config's BENCH record carries it (single process here —
+    # the replica config adds the children).
+    rss_peak = [0.0]
 
     def measure(n):
         window = []
@@ -258,6 +277,7 @@ def run_config(*, label, num_cqs, num_cohorts, num_flavors, backlog, ticks,
             t = time.perf_counter()
             fw.tick()
             window.append(time.perf_counter() - t)
+            rss_peak[0] = max(rss_peak[0], _rss_mb())
             if verbose:
                 tick_phases.append(
                     {k[0]: phases.sums[k] - before.get(k, 0.0)
@@ -450,6 +470,14 @@ def run_config(*, label, num_cqs, num_cohorts, num_flavors, backlog, ticks,
         "slowest_tick_trace": trace_path,
         "slowest_tick_ms": round(slowest.duration * 1000.0, 3)
         if slowest is not None else None,
+        # Memory + commit-latency evidence, recorded for EVERY config:
+        # peak RSS over the measured window (self only here — the
+        # replica config sums the worker processes in) and the
+        # cross-replica reconcile round trip (None in single-process
+        # mode: phase B is an in-process pass, there is no commit
+        # protocol to time).
+        "peak_rss_mb": round(rss_peak[0], 1),
+        "reconcile_rtt_ms": None,
     }
     if overhead is not None:
         stats["tracer_overhead"] = overhead
@@ -516,6 +544,7 @@ METRIC_NAMES = {
     "topo": "p99_topology_tick_ms",
     "steady": "p99_steady_state_tick_ms",
     "shard": "p99_sharded_tick_ms",
+    "replica": "p99_replica_tick_ms",
     "northstar": "p99_e2e_tick_ms",
 }
 
@@ -559,6 +588,271 @@ def _shard_identity_gate(n_shards: int, ticks: int = 25) -> int:
             "cohort-sharded solve or the two-phase reconcile broke "
             "decision identity; do not trust this run.")
     return len(sharded)
+
+
+def _replica_identity_gate(replicas: int, ticks: int = 25) -> int:
+    """`_shard_identity_gate` for the PROCESS split: drive the golden
+    seed through a replicas=N deployment (loopback transport — the
+    protocol and worker code are identical to spawn mode, pinned by
+    tests/test_replica.py's spawn smoke) and through the single-process
+    scheduler, and FAIL the bench if they admit different workload sets.
+    Returns the admitted count."""
+    from kueue_tpu.config import Configuration, TPUSolverConfig
+    from kueue_tpu.controllers.replica_runtime import ReplicaRuntime
+    from kueue_tpu.models.flavor_fit import BatchSolver
+    from kueue_tpu.utils.synthetic import synthetic_framework
+
+    kw = dict(num_cqs=24, num_cohorts=6, num_flavors=4, num_pending=256,
+              usage_fill=0.7, seed=7)
+
+    # Single-process reference, constructed exactly like a replica
+    # worker's vertical slice (explicit BatchSolver, no probing, barrier
+    # depth 1) so the only difference IS the partitioning.
+    fw = synthetic_framework(
+        batch_solver=BatchSolver(), pipeline_depth=1,
+        config=Configuration(tpu_solver=TPUSolverConfig(enable=False)),
+        **kw)
+    single: set = set()
+    orig = fw.scheduler.apply_admission
+
+    def hook(wl):
+        ok = orig(wl)
+        if ok:
+            single.add(wl.key)
+        return ok
+
+    fw.scheduler.apply_admission = hook
+    for _ in range(ticks):
+        fw.tick()
+        fw.prewarm_idle()
+
+    rt = ReplicaRuntime(replicas, spawn=False)
+    try:
+        rt.load_synthetic(**kw)
+        sharded: set = set()
+        for _ in range(ticks):
+            for key, _cq in rt.tick()["admitted"]:
+                sharded.add(key)
+    finally:
+        rt.close()
+    if sharded != single:
+        raise RuntimeError(
+            f"[replica] replicas={replicas} and the single-process "
+            f"scheduler admitted DIFFERENT workload sets on the golden "
+            f"seed (only-replica={sorted(sharded - single)[:5]}, "
+            f"only-single={sorted(single - sharded)[:5]}) — the "
+            "shard-group partition or the commit protocol broke decision "
+            "identity; do not trust this run.")
+    return len(sharded)
+
+
+def _replica_revocation_drill() -> dict:
+    """Force >= 1 cross-replica revocation and return the coordinator's
+    evidence: two same-tick heads on different replicas of a split
+    KEP-79 tree both borrow from one lending-limited pool that can serve
+    only one — each replica's optimistic local pass admits its own, the
+    coordinator commits exactly one in global cycle order and REVOKES
+    the other. The bench fails if the protocol never revokes (the
+    optimistic-local-pass / global-revoke loop went dead)."""
+    import zlib
+
+    from kueue_tpu import features
+    from kueue_tpu.api.types import CohortSpec, PodSet, Workload
+    from kueue_tpu.controllers.replica_runtime import ReplicaRuntime
+
+    features.set_enabled(features.LENDING_LIMIT, True)
+    names = ["east", "west", "north", "south", "alpha", "beta"]
+    pair = next(
+        (a, b) for i, a in enumerate(names) for b in names[i + 1:]
+        if zlib.crc32(a.encode()) % 2 != zlib.crc32(b.encode()) % 2)
+
+    from kueue_tpu.api.types import (
+        ClusterQueue, FlavorQuotas, LocalQueue, ResourceFlavor,
+        ResourceGroup)
+
+    def _rg(*quotas):
+        return ResourceGroup(covered_resources=("cpu",),
+                             flavors=tuple(quotas))
+
+    rt = ReplicaRuntime(2, spawn=False, engine="host")
+    try:
+        rt.create_resource_flavor(ResourceFlavor.make("on-demand"))
+        rt.create_cohort(CohortSpec(name="hroot"))
+        rt.create_cohort(CohortSpec(name=pair[0], parent="hroot"))
+        rt.create_cohort(CohortSpec(name=pair[1], parent="hroot"))
+        rt.create_cohort(CohortSpec(
+            name="hpool", parent="hroot",
+            resource_groups=(
+                _rg(FlavorQuotas.make("on-demand", cpu=(8, None, 4))),)))
+        for side, cq in ((pair[0], "drill-a"), (pair[1], "drill-b")):
+            rt.create_cluster_queue(ClusterQueue(
+                name=cq, cohort=side,
+                resource_groups=(
+                    _rg(FlavorQuotas.make("on-demand", cpu=4)),)))
+            rt.create_local_queue(LocalQueue(
+                name=f"lq-{cq}", namespace="default", cluster_queue=cq))
+        assert "hroot" in rt.gmap.split_roots
+        for i, cq in enumerate(("drill-a", "drill-b")):
+            rt.submit(Workload(
+                name=f"borrow-{cq}", namespace="default",
+                queue_name=f"lq-{cq}", creation_time=float(i + 1),
+                pod_sets=[PodSet.make("ps0", count=1, cpu=8)]))
+        revocations = 0
+        for _ in range(6):
+            revocations += rt.tick()["revocations"]
+        evidence = {
+            "revocations": revocations,
+            "coordinator_commits": rt.coordinator.commits,
+            "coordinator_rounds": rt.coordinator.rounds,
+        }
+    finally:
+        rt.close()
+    if revocations < 1:
+        raise RuntimeError(
+            "[replica] the forced lending-clamp drill produced ZERO "
+            "cross-replica revocations: both borrowers were committed "
+            "against a pool that can serve only one — the coordinator's "
+            "merged lending-clamp replay is not gating split-root "
+            "admissions; do not trust this run.")
+    return evidence
+
+
+def run_replica_config(*, label, replicas, num_cqs, num_cohorts,
+                       num_flavors, backlog, ticks, usage_fill, seed=42,
+                       spawn=True, warmup=12):
+    """One multi-process replica window: N spawn-mode worker processes
+    (each owning its shard groups' full vertical slice), the parent
+    driving the tick barrier + coordinator. The synthetic load is
+    generated WORKER-SIDE (each process keeps only its cohort-hash
+    slice from the shared seed), so the 1M-backlog window loads without
+    a million workloads ever crossing the parent pipe; churn rides the
+    compact submit_many/finish_many bulk messages."""
+    from kueue_tpu.controllers.replica_runtime import ReplicaRuntime
+
+    t0 = time.perf_counter()
+    rt = ReplicaRuntime(replicas, spawn=spawn)
+    # First ticks at 1M backlog pay the whole-backlog encode + XLA
+    # compile inside one barrier round; the default 60s round timeout
+    # would misread that as a dead worker.
+    rt.round_timeout = max(rt.round_timeout, 900.0)
+    try:
+        rt.load_synthetic(
+            num_cqs=num_cqs, num_cohorts=num_cohorts,
+            num_flavors=num_flavors, num_pending=backlog,
+            usage_fill=usage_fill, seed=seed)
+        t_setup = time.perf_counter() - t0
+
+        rnd = random.Random(seed + 1)
+        admitted_logs = [deque() for _ in LINGER_TICKS]
+        admit_seq = [0]
+        submit_seq = [0]
+        tick_no = [0]
+
+        def churn(stats):
+            """The run_config completion flux over the bulk wire: track
+            this tick's admissions, finish the expired ones in one
+            message per owning replica, replace each with a fresh
+            arrival routed by its LocalQueue hash."""
+            for key, cq in stats["admitted"]:
+                i = admit_seq[0] % len(LINGER_TICKS)
+                admit_seq[0] += 1
+                admitted_logs[i].append(
+                    (tick_no[0] + LINGER_TICKS[i], key, cq))
+            done = []
+            for log in admitted_logs:
+                while log and log[0][0] <= tick_no[0]:
+                    _, key, cq = log.popleft()
+                    done.append((key, cq))
+            if not done:
+                return
+            rt.finish_many(done)
+            specs = []
+            for _ in done:
+                submit_seq[0] += 1
+                i = submit_seq[0]
+                specs.append({
+                    "name": f"churn-{label}-{i}",
+                    "queue": f"lq-{rnd.randrange(num_cqs)}",
+                    "priority": rnd.randint(-2, 2),
+                    "creation_time": float(100_000 + i),
+                    "count": rnd.randint(1, 8),
+                    "cpu": rnd.randint(1, 8),
+                    "memory_gi": rnd.randint(1, 16),
+                })
+            rt.submit_many(specs)
+
+        for _ in range(warmup):
+            tick_no[0] += 1
+            churn(rt.tick())
+        # Freeze the warmup survivors out of the cyclic GC's scan set
+        # (workers already froze the bulk load): a gen-2 pass over a
+        # million-workload heap is a multi-second stop, and at the
+        # barrier ANY worker's pause stalls the whole measured tick.
+        rt.gc_settle()
+
+        times = []
+        rtts = []
+        worker_ticks = []
+        rss_peak = 0.0
+        admitted = 0
+        preempted = 0
+        revocations = 0
+        for _ in range(ticks):
+            tick_no[0] += 1
+            t = time.perf_counter()
+            stats = rt.tick()
+            times.append(time.perf_counter() - t)
+            admitted += stats["n"]
+            preempted += len(stats["preempted"])
+            revocations += stats["revocations"]
+            rtts.extend(stats["rtt"])
+            worker_ticks.extend(stats["tick_s"])
+            # Peak RSS of the WHOLE deployment: the parent plus every
+            # worker process, sampled at each one's tick end.
+            rss_peak = max(rss_peak, stats["rss"] / (1024.0 ** 2))
+            churn(stats)
+        times_ms = np.array(times) * 1000.0
+        p50 = float(np.percentile(times_ms, 50))
+        p99 = float(np.percentile(times_ms, 99))
+        out = {
+            "ticks": ticks,
+            "n_replicas": replicas,
+            "transport": "spawn" if spawn else "loopback",
+            "p50_ms": round(p50, 3),
+            "p99_ms": round(p99, 3),
+            "mean_ms": round(float(times_ms.mean()), 3),
+            "admitted": admitted,
+            "preempted": preempted,
+            "admissions_per_s": round(admitted / (sum(times) or 1e-9), 1),
+            # Commit-protocol evidence: the in-cycle round trip each
+            # replica pays at the coordinator barrier (ship candidates,
+            # wait for every peer's phase A, receive verdicts) and the
+            # revocations the merged replay issued inside the window.
+            "reconcile_rtt_ms": {
+                "p50": round(_pctl(rtts, 50) * 1000.0, 3) if rtts else None,
+                "p99": round(_pctl(rtts, 99) * 1000.0, 3) if rtts else None,
+                "rounds": len(rtts),
+            },
+            "reconcile_revocations": revocations,
+            # Memory evidence: peak RSS of parent + all replica workers
+            # over the measured window.
+            "peak_rss_mb": round(rss_peak, 1),
+            "worker_tick_ms_mean": (
+                round(1000.0 * sum(worker_ticks) / len(worker_ticks), 3)
+                if worker_ticks else None),
+        }
+        print(
+            f"# [{label}] {num_cqs} CQs x {num_cohorts} cohorts, backlog "
+            f"{backlog}, replicas={replicas} "
+            f"({'spawn' if spawn else 'loopback'}), {ticks} ticks, "
+            f"setup {t_setup:.1f}s\n"
+            f"# [{label}] barrier tick: p50 {p50:.2f}ms  p99 {p99:.2f}ms  "
+            f"({admitted} admitted, peak RSS {rss_peak:.0f}MB, "
+            f"rtt p99 {out['reconcile_rtt_ms']['p99']}ms)",
+            file=sys.stderr)
+        return out
+    finally:
+        rt.close()
 
 
 def run_one(config: str) -> None:
@@ -780,6 +1074,70 @@ def run_one(config: str) -> None:
                 "the cohort-sharded solve is not absorbing the scale "
                 "axis it exists for.")
         emit(METRIC_NAMES[config], s_large)
+    elif config == "replica":
+        # Multi-process replica scheduler (ROADMAP item 1, the process
+        # era): N spawn-mode worker processes each owning its shard
+        # groups' full vertical slice, the parent driving the tick
+        # barrier + the cross-replica commit protocol. Two windows — the
+        # shard config's 200k large window, then the 1M-backlog / 10k-CQ
+        # window the single process cannot hold — with the decision-
+        # identity gate (replicas=N == single-process admitted set) and
+        # a forced cross-replica revocation drill re-proven on EVERY
+        # run before anything is measured.
+        if os.environ.get("KUEUE_BENCH_FORCE_CPU") == "1":
+            # Spawned workers see only the environment, not this
+            # process's jax.config — pin their backend the same way.
+            os.environ["JAX_PLATFORMS"] = "cpu"
+        n_rep = int(os.environ.get("KUEUE_TPU_REPLICAS", "4") or 4)
+        identity_admitted = _replica_identity_gate(n_rep)
+        drill = _replica_revocation_drill()
+        if smoke:
+            small = dict(num_cqs=48, num_cohorts=12, num_flavors=4,
+                         backlog=768)
+            large = dict(num_cqs=96, num_cohorts=24, num_flavors=4,
+                         backlog=3840)
+        else:
+            small = dict(num_cqs=2000, num_cohorts=200, num_flavors=8,
+                         backlog=200_000)
+            large = dict(num_cqs=10_000, num_cohorts=1000, num_flavors=8,
+                         backlog=1_000_000)
+        w_ticks = max(ticks // 4, 8)
+        s_small = run_replica_config(
+            label="replica", replicas=n_rep, ticks=w_ticks,
+            usage_fill=0.7, **small)
+        s_large = run_replica_config(
+            label="replica5x", replicas=n_rep, ticks=w_ticks,
+            usage_fill=0.7, **large)
+        backlog_ratio = large["backlog"] / small["backlog"]
+        p99_ratio = (s_large["p99_ms"] / s_small["p99_ms"]
+                     if s_small["p99_ms"] else None)
+        s_large.update({
+            "identity_gate_admitted": identity_admitted,
+            "forced_revocation_drill": drill,
+            "small_window": {
+                "backlog": small["backlog"],
+                "num_cqs": small["num_cqs"],
+                "p50_ms": s_small["p50_ms"],
+                "p99_ms": s_small["p99_ms"],
+                "peak_rss_mb": s_small["peak_rss_mb"],
+                "reconcile_rtt_ms": s_small["reconcile_rtt_ms"]},
+            "backlog_ratio": backlog_ratio,
+            "p99_scaling_ratio": (round(p99_ratio, 3)
+                                  if p99_ratio is not None else None),
+        })
+        # Sublinear-scaling gate, the shard config's discipline on the
+        # process axis: 5x backlog (+5x CQs) must cost < 5x p99 — the
+        # whole point of one scheduler process per shard group is that
+        # per-replica host tick cost scales with process count.
+        if not smoke and p99_ratio is not None \
+                and p99_ratio >= backlog_ratio:
+            raise RuntimeError(
+                f"[replica] p99 scaled superlinearly with backlog: "
+                f"{s_small['p99_ms']:.1f}ms -> {s_large['p99_ms']:.1f}ms "
+                f"(x{p99_ratio:.2f} for x{backlog_ratio:.0f} backlog) — "
+                "the replica split is not absorbing the scale axis it "
+                "exists for.")
+        emit(METRIC_NAMES[config], s_large)
     else:
         # North-star headline (config #5 shape): LAST line = parsed metric.
         emit(METRIC_NAMES["northstar"], run_config(
@@ -820,15 +1178,18 @@ def main() -> None:
               "backend for this run", file=sys.stderr)
         env_extra["KUEUE_BENCH_FORCE_CPU"] = "1"
     for config in ("single", "cohortlend", "preempt", "fair", "topo",
-                   "steady", "shard", "northstar"):
+                   "steady", "shard", "replica", "northstar"):
         env = dict(os.environ, KUEUE_BENCH_CONFIG=config, **env_extra)
+        # Generous ceiling: a healthy config finishes in minutes; a
+        # device attachment dying MID-RUN (after the probe passed)
+        # hangs forever otherwise. The replica config gets longer — its
+        # 1M-backlog window generates and loads 4 worker processes'
+        # slices before the first measured tick.
+        budget = 3600 if config == "replica" else 1800
         try:
-            # Generous ceiling: a healthy config finishes in minutes; a
-            # device attachment dying MID-RUN (after the probe passed)
-            # hangs forever otherwise.
             res = subprocess.run([sys.executable, os.path.abspath(__file__)],
                                  env=env, stdout=subprocess.PIPE,
-                                 timeout=1800)
+                                 timeout=budget)
         except subprocess.TimeoutExpired:
             print(f"# {config}: run hung (device lost mid-run?); "
                   "retrying on the CPU backend", file=sys.stderr)
@@ -837,7 +1198,7 @@ def main() -> None:
             try:
                 res = subprocess.run(
                     [sys.executable, os.path.abspath(__file__)],
-                    env=env, stdout=subprocess.PIPE, timeout=1800)
+                    env=env, stdout=subprocess.PIPE, timeout=budget)
             except subprocess.TimeoutExpired:
                 # Even the CPU retry hung: report the failed config and
                 # keep measuring the rest instead of crashing the driver.

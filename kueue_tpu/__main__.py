@@ -104,6 +104,11 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--pipeline-depth", type=int, default=None,
                         help="keep N ticks' device solves in flight "
                         "(overrides tpuSolver.pipelineDepth; default 1)")
+    parser.add_argument("--replicas", type=int, default=None,
+                        help="run N scheduler replica processes (one per "
+                        "shard group) behind the coordinator commit "
+                        "protocol; defaults to $KUEUE_TPU_REPLICAS, and "
+                        "KUEUE_TPU_NO_REPLICA=1 forces single-process")
     parser.add_argument("--leader-elect", action="store_true",
                         help="join lease-based leader election")
     parser.add_argument("--lease-file", default=None,
@@ -127,6 +132,109 @@ def build_parser() -> argparse.ArgumentParser:
     return parser
 
 
+def _replica_main(args, cfg, n_replicas: int) -> int:
+    """Multi-process deployment: N spawn-mode scheduler replicas (one
+    vertical slice per shard group) + the coordinator commit protocol,
+    fed by the partitioned watch stream off the parent's Store. The
+    parent serves the HTTP object API and the MERGED Chrome trace at
+    GET /debug/traces; per-workload runtime endpoints (jobs, finish)
+    live in the replicas and answer 501 here."""
+    from kueue_tpu.controllers.replica_runtime import (
+        ReplicaRuntime,
+        ReplicaStoreBridge,
+    )
+
+    rt = ReplicaRuntime(n_replicas, spawn=True, state_dir=args.state_dir,
+                        solver=args.batch_solver,
+                        trace=bool(args.trace_out))
+    store = Store()
+    ReplicaStoreBridge(store, rt)
+
+    server = None
+    if args.port is not None:
+        from kueue_tpu.server import APIServer
+
+        server = APIServer(
+            store, None, host=args.host, port=args.port,
+            trace_export=lambda slowest: rt.export_chrome(
+                slowest_only=slowest))
+        server.start()
+        print(f"serving HTTP API on {server.url} "
+              f"({n_replicas} scheduler replicas)",
+              file=sys.stderr, flush=True)
+
+    applied = 0
+    errors: List[str] = []
+    manifests = []
+    for path in args.objects:
+        manifests.extend(serialization.load_manifests(path))
+    for kind_wanted in _APPLY_ORDER:
+        for kind, obj in manifests:
+            if kind != kind_wanted:
+                continue
+            try:
+                if kind == "Job":
+                    raise ValueError(
+                        "Job manifests are not supported in replica "
+                        "mode; submit Workload objects")
+                store.create(kind, obj)
+                applied += 1
+            except Exception as exc:  # surface, don't abort the rest
+                errors.append(f"{kind} {getattr(obj, 'name', '?')}: {exc}")
+    if args.verbosity >= 1:
+        print(f"applied {applied} objects"
+              + (f", {len(errors)} errors" if errors else ""),
+              file=sys.stderr)
+    for err in errors:
+        print(f"apply error: {err}", file=sys.stderr)
+
+    total_admitted = 0
+    try:
+        if args.serve:
+            try:
+                while True:
+                    total_admitted += rt.tick()["n"]
+                    time.sleep(args.tick_interval)
+            except KeyboardInterrupt:
+                pass
+        elif args.ticks is not None:
+            for _ in range(args.ticks):
+                total_admitted += rt.tick()["n"]
+        else:
+            idle = 0
+            for _ in range(1000):
+                n = rt.tick()["n"]
+                total_admitted += n
+                idle = idle + 1 if n == 0 else 0
+                if idle >= 2:
+                    break
+
+        dump = rt.dump()
+        summary = {
+            "admitted": total_admitted,
+            "replicas": n_replicas,
+            "clusterQueues": {
+                name: {
+                    "admitted": len(keys),
+                    "pending": dump["pending"].get(name, 0),
+                }
+                for name, keys in sorted(dump["admitted"].items())
+            },
+        }
+        print(json.dumps(summary, indent=2 if args.verbosity else None))
+        if args.trace_out:
+            with open(args.trace_out, "w", encoding="utf-8") as f:
+                json.dump(rt.export_chrome(), f)
+            print(f"wrote merged {n_replicas}-replica trace to "
+                  f"{args.trace_out} (load in Perfetto / chrome://tracing)",
+                  file=sys.stderr)
+    finally:
+        if server is not None:
+            server.stop()
+        rt.close()
+    return 1 if errors else 0
+
+
 def main(argv: Optional[Sequence[str]] = None) -> int:
     args = build_parser().parse_args(argv)
 
@@ -137,6 +245,15 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         from kueue_tpu.tracing import TRACER
 
         TRACER.configure(enabled=True)
+
+    from kueue_tpu.controllers.replica_runtime import replicas_from_env
+
+    n_replicas = (args.replicas if args.replicas is not None
+                  else replicas_from_env())
+    if os.environ.get("KUEUE_TPU_NO_REPLICA", "") == "1":
+        n_replicas = 0  # the kill switch beats the flag too
+    if n_replicas:
+        return _replica_main(args, cfg, n_replicas)
 
     batch_solver = None
     if args.batch_solver:

@@ -76,30 +76,93 @@ class _Func:
         self.calls: List[Tuple[str, str, ast.Call]] = []
 
 
+def _annotation_class(node: ast.AST):
+    """Leaf class name of a type annotation: `Foo`, `mod.Foo`, `"Foo"`,
+    `Optional[Foo]` / any single-parameter generic wrapper. None when
+    the annotation names no resolvable class."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        try:
+            node = ast.parse(node.value, mode="eval").body
+        except SyntaxError:
+            return None
+    if isinstance(node, ast.Subscript):
+        outer = dotted_name(node.value)
+        if outer and outer.rsplit(".", 1)[-1] in ("Optional", "Final",
+                                                  "ClassVar", "Annotated"):
+            inner = node.slice
+            if isinstance(inner, ast.Tuple) and inner.elts:
+                inner = inner.elts[0]
+            return _annotation_class(inner)
+        return None
+    name = dotted_name(node)
+    if name is None:
+        return None
+    leaf = name.rsplit(".", 1)[-1]
+    return leaf if leaf[:1].isupper() else None
+
+
 class _Program:
     def __init__(self, files: Sequence[SourceFile]):
         self.funcs: Dict[str, _Func] = {}           # qualname -> func
         self.methods: Dict[str, List[str]] = {}     # method name -> quals
         self.attr_types: Dict[Tuple[str, str], str] = {}  # (cls, attr) -> cls
         self.classes: Set[str] = set()
+        # Protocol machinery: protocol class -> its declared method
+        # names; class -> explicit base names. A call through a
+        # Protocol-typed attribute fans out to every conforming class
+        # (explicit subclassing OR structural: defines all the
+        # protocol's methods) — the coordinator/replica channel objects
+        # are exactly this shape.
+        self.protocols: Dict[str, Set[str]] = {}
+        self.bases: Dict[str, Set[str]] = {}
+        self.class_methods: Dict[str, Set[str]] = {}
         for f in files:
             self._index(f)
+        self._conformers: Dict[str, List[str]] = {}
 
     def _index(self, f: SourceFile) -> None:
         for node in f.tree.body:
             if isinstance(node, ast.ClassDef):
                 self.classes.add(node.name)
+                base_names = {
+                    (dotted_name(b) or "").rsplit(".", 1)[-1]
+                    for b in node.bases}
+                self.bases[node.name] = base_names
+                meths = {item.name for item in node.body
+                         if isinstance(item, (ast.FunctionDef,
+                                              ast.AsyncFunctionDef))}
+                self.class_methods[node.name] = meths
+                if "Protocol" in base_names:
+                    self.protocols[node.name] = meths - {"__init__"}
                 for item in node.body:
                     if isinstance(item, (ast.FunctionDef,
                                          ast.AsyncFunctionDef)):
                         self._add(f, item, node.name)
             elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 self._add(f, node, None)
-        # attribute types: `self.X = Class(...)` anywhere in the class
+        # attribute types, two sources (constructor assignment wins over
+        # a bare annotation — it names the concrete class):
+        #   * annotations: class-level `x: Foo` / `self.x: Foo = ...`
+        #   * assignments: `self.X = Class(...)` anywhere in the class
         for cls in ast.walk(f.tree):
             if not isinstance(cls, ast.ClassDef):
                 continue
             for node in ast.walk(cls):
+                if isinstance(node, ast.AnnAssign):
+                    target = node.target
+                    attr = None
+                    if isinstance(target, ast.Name):
+                        attr = target.id          # class-level `x: Foo`
+                    elif isinstance(target, ast.Attribute) \
+                            and isinstance(target.value, ast.Name) \
+                            and target.value.id in ("self", "cls"):
+                        attr = target.attr        # `self.x: Foo = ...`
+                    ann = _annotation_class(node.annotation) \
+                        if attr is not None else None
+                    if ann is not None \
+                            and (cls.name, attr) not in self.attr_types:
+                        self.attr_types[(cls.name, attr)] = ann
+                    continue
                 if not isinstance(node, ast.Assign):
                     continue
                 # First class-looking constructor call anywhere in the
@@ -122,6 +185,25 @@ class _Program:
                             and isinstance(t.value, ast.Name) \
                             and t.value.id in ("self", "cls"):
                         self.attr_types[(cls.name, t.attr)] = ctor
+
+    def conformers(self, protocol: str) -> List[str]:
+        """Classes a Protocol-typed attribute may hold at runtime:
+        explicit implementers plus structural conformers (every declared
+        protocol method present)."""
+        hit = self._conformers.get(protocol)
+        if hit is not None:
+            return hit
+        wanted = self.protocols.get(protocol, set())
+        out = []
+        for cls in self.classes:
+            if cls == protocol or cls in self.protocols:
+                continue
+            if protocol in self.bases.get(cls, ()):
+                out.append(cls)
+            elif wanted and wanted <= self.class_methods.get(cls, set()):
+                out.append(cls)
+        self._conformers[protocol] = out
+        return out
 
     def _add(self, f: SourceFile, node, cls: Optional[str]) -> None:
         qual = f"{cls}.{node.name}" if cls else \
@@ -150,9 +232,16 @@ class _Program:
             elif len(parts) == 3:                    # self.attr.m()
                 target_cls = self.attr_types.get((caller.cls, parts[1]))
                 if target_cls:
-                    q = f"{target_cls}.{parts[2]}"
-                    if q in self.funcs:
-                        out.append(self.funcs[q])
+                    targets = [target_cls]
+                    if target_cls in self.protocols:
+                        # Protocol-typed attribute: the call lands on
+                        # whichever conformer is wired at runtime — take
+                        # every one (lock edges are may-acquire).
+                        targets += self.conformers(target_cls)
+                    for tc in targets:
+                        q = f"{tc}.{parts[2]}"
+                        if q in self.funcs:
+                            out.append(self.funcs[q])
         elif len(parts) == 1:                        # module function f()
             for q in self.methods.get(parts[0], []):
                 fn = self.funcs[q]

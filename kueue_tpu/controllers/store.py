@@ -364,9 +364,12 @@ class StoreAdapter:
             (rs.count, rs.requeue_at) if rs is not None else None,
         )
 
-    def sync_status(self) -> None:
+    def sync_status(self, collect: Optional[list] = None) -> None:
         """Write workload status back (SSA apply analog). The runtime owns
-        the status fields; the store version is the published view."""
+        the status fields; the store version is the published view.
+        `collect` (when given) receives each workload published THIS call
+        — the replica runtime ships exactly those statuses back to the
+        parent deployment's read-surface Store."""
         published = self._published
         for wl in list(self.fw.workloads.values()):
             key = _obj_key(KIND_WORKLOAD, wl)
@@ -376,6 +379,8 @@ class StoreAdapter:
             if self.store.get(KIND_WORKLOAD, key) is not None:
                 self.store.update_status(KIND_WORKLOAD, wl)
                 published[key] = fp
+                if collect is not None:
+                    collect.append(wl)
         if len(published) > 2 * len(self.fw.workloads) + 64:
             live = {_obj_key(KIND_WORKLOAD, wl)
                     for wl in self.fw.workloads.values()}

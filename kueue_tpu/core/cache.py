@@ -676,6 +676,24 @@ class Cache:
             cohort.invalidate_memos()
             cq.cohort = cohort
 
+    def set_external_usage(self, name: str, usage) -> None:
+        """Overwrite a ClusterQueue's usage with an EXTERNALLY OWNED view
+        (the multi-process replica runtime's ghost members: split-tree
+        CQs scheduled by another replica, whose authoritative usage
+        arrives through the pre-tick exchange). Rides the sanctioned
+        mutation plumbing — usage_version bump + mirror dirty mark — so
+        the snapshot mirror and the solver's usage tensors pick the new
+        values up exactly like a local admission. No-ops when the view
+        is unchanged (a quiescent remote tree must not dirty this
+        replica's tick)."""
+        with self._lock:
+            cq = self.cluster_queues.get(name)
+            if cq is None or cq.usage == usage:
+                return
+            cq.usage = {f: dict(res) for f, res in usage.items()}
+            cq.usage_version += 1
+            cq._mark_dirty()
+
     # -- local queues --------------------------------------------------------
 
     def add_local_queue(self, lq: LocalQueue) -> None:

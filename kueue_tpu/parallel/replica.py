@@ -1,0 +1,463 @@
+"""Multi-process replica scheduling: shard-group partitioning + the
+cross-replica reconcile commit protocol.
+
+PR 7 sharded the *device solve* over a cohort-hash mesh; this module
+shards the *scheduler itself*: one replica process per shard group, each
+owning the full vertical slice for its cohorts (queue manager, cache,
+arenas, nominate cache, BatchSolver), fed by a partitioned Store watch
+stream. The partition key is exactly the PR 7 hash — crc32 of the direct
+cohort name (cohort-less ClusterQueues hash by their ``__solo__/<cq>``
+singleton) — so every flat cohort is replica-complete and all of its
+quota math stays in one process.
+
+Hierarchical KEP-79 trees hash by DIRECT cohort, so one tree's subtrees
+may land on different replicas (``GroupMap.split_roots``). Those roots
+are the ONLY cross-replica traffic: each replica's admission cycle runs
+phase A shard-local exactly as before, and phase B becomes a real commit
+protocol — replicas ship their split-root candidate admissions (usage
+triples + the packed sort key, the PR 6/7 wire shape) plus their local
+members' pre-cycle usage to the lease-holding :class:`Coordinator`,
+which replays every candidate in GLOBAL cycle order against the merged
+lending-clamp state (the same `fits_in_hierarchy` arithmetic the
+single-process phase B uses) and returns per-entry commit/revoke
+verdicts BEFORE any replica flushes. The optimistic-local-pass /
+global-revoke loop is Aryl's cross-partition capacity-loaning reconcile
+(PAPERS.md), layered on a two-level resource-offer split in the Mesos
+allocation spirit: replicas claim locally, the coordinator arbitrates
+only what genuinely spans partitions.
+
+Known, deliberate divergences from the single-process scheduler (all
+outside the pinned golden scenarios, documented in README):
+
+  * preemption victim search inside a SPLIT root sees only the owning
+    replica's subtree members (candidates never cross processes);
+  * fair-sharing share denominators of a split tree are subtree-local;
+  * the PodsReady block-admission gate is evaluated per replica.
+
+Everything else — flat cohorts, same-replica trees, ordering, lending
+clamps — is decision-identical by construction and pinned by
+tests/test_replica.py's churn goldens at replicas {1, 2, 4}.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import zlib
+from typing import Callable, Dict, FrozenSet, List, Optional, Protocol
+
+from kueue_tpu.core.cache import CachedClusterQueue, Cohort, frq_add
+from kueue_tpu.core.hierarchy import fits_in_hierarchy
+
+SOLO_PREFIX = "__solo__/"
+
+
+def group_of(name: str, n_groups: int) -> int:
+    """Stable shard-group hash — the PR 7 cohort hash
+    (`parallel.mesh._crc_shard`): process-independent, so every replica
+    and the coordinator agree on the group of every cohort."""
+    return zlib.crc32(name.encode("utf-8")) % n_groups
+
+
+def group_key(cq_name: str, cohort: Optional[str]) -> str:
+    """The hash key of a ClusterQueue: its direct cohort, or its
+    ``__solo__/<name>`` singleton when cohort-less (schema.py naming)."""
+    return cohort if cohort else SOLO_PREFIX + cq_name
+
+
+class GroupMap:
+    """Shard-group assignment + split-root tracking for one deployment.
+
+    Placement is FIRST-SEEN: a ClusterQueue keeps the group its original
+    cohort hashed to even if its cohort later changes — correctness does
+    not depend on placement (a mis-placed member simply makes its root
+    split, which routes its quota math through the commit protocol);
+    placement only decides which process pays the work.
+    """
+
+    def __init__(self, n_groups: int):
+        self.n_groups = n_groups
+        self.cq_group: Dict[str, int] = {}       # cq -> placed group
+        self.cq_cohort: Dict[str, str] = {}      # cq -> direct cohort ("")
+        self.lq_cq: Dict[str, str] = {}          # "ns/lq" -> cq name
+        self.cohort_parent: Dict[str, str] = {}  # cohort -> parent ("")
+        self.split_roots: FrozenSet[str] = frozenset()
+
+    def root_of(self, cohort: str) -> str:
+        seen = set()
+        node = cohort
+        while self.cohort_parent.get(node):
+            if node in seen:
+                return cohort  # cycle: the snapshot deactivates these
+            seen.add(node)
+            node = self.cohort_parent[node]
+        return node
+
+    def place_cq(self, name: str, cohort: Optional[str]) -> int:
+        g = self.cq_group.get(name)
+        if g is None:
+            g = group_of(group_key(name, cohort), self.n_groups)
+            self.cq_group[name] = g
+        self.cq_cohort[name] = cohort or ""
+        return g
+
+    def note_cohort(self, name: str, parent: Optional[str]) -> None:
+        self.cohort_parent[name] = parent or ""
+
+    def drop_cohort(self, name: str) -> None:
+        self.cohort_parent.pop(name, None)
+
+    def drop_cq(self, name: str) -> None:
+        self.cq_group.pop(name, None)
+        self.cq_cohort.pop(name, None)
+
+    def place_lq(self, key: str, cq: str) -> Optional[int]:
+        self.lq_cq[key] = cq
+        return self.cq_group.get(cq)
+
+    def recompute_split(self) -> FrozenSet[str]:
+        """Roots whose member ClusterQueues live on more than one group.
+        Flat cohorts can only split after a live cohort move (first-seen
+        placement); KEP-79 trees split whenever their direct cohorts
+        hash apart — exactly `mesh.ShardAssignment.split_roots`."""
+        by_root: Dict[str, set] = {}
+        for cq, g in self.cq_group.items():
+            cohort = self.cq_cohort.get(cq)
+            if not cohort:
+                continue  # __solo__ singletons are their own root/group
+            by_root.setdefault(self.root_of(cohort), set()).add(g)
+        self.split_roots = frozenset(
+            r for r, gs in by_root.items() if len(gs) > 1)
+        return self.split_roots
+
+
+# ---------------------------------------------------------------------------
+# Wire format
+# ---------------------------------------------------------------------------
+#
+# One reconcile ROUND per replica per tick (submitted even when empty —
+# the barrier is the protocol's ordering guarantee, and a replica's
+# shipped usage feeds OTHER replicas' candidate gating):
+#
+#   {"replica": int, "tick": int,
+#    "usage": {cq_name: {flavor: {resource: int}}},   # split-root members
+#    "candidates": [candidate, ...]}                  # local cycle order
+#
+# candidate = {"i": submission index, "key": workload key, "cq": name,
+#              "mode": solver mode (FIT/PREEMPT), "usage": frq dict,
+#              "borrow": bool, "sort": entry sort-key components,
+#              "pos": cycle position, "has_targets": bool,
+#              "opt_ok": shard-local optimistic verdict (FIT only)}
+#
+# The verdict reply is a per-replica list of bools aligned with the
+# submission order. Candidate usage is the admission's (flavor,
+# resource, value) coordinates — the same triples the PR 6 CSR commit
+# flattens — and `sort` is the packed entry ordering key, so the
+# coordinator replays in exactly the single-process cycle order.
+
+
+class ReplicaChannel(Protocol):
+    """Transport seam between a replica and its runtime: loopback queue
+    pairs in-process, a multiprocessing pipe across processes."""
+
+    def send(self, msg) -> None: ...
+
+    def recv(self): ...
+
+
+class ReplicaContext:
+    """Scheduler-side handle for the commit protocol.
+
+    The owning runtime wires `submit` (blocking round-trip to the
+    coordinator) and `usage_provider` (split-root member usage from the
+    live cache, for rounds submitted outside an admission cycle); the
+    scheduler reads `split_roots` to decide deferral and calls
+    `reconcile` exactly once per cycle."""
+
+    def __init__(self, submit: Callable[[dict], List[bool]],
+                 usage_provider: Optional[Callable[[], dict]] = None):
+        self._submit = submit
+        self.usage_provider = usage_provider
+        self.split_roots: FrozenSet[str] = frozenset()
+        self.tick_submitted = False
+        self.rtt_samples: List[float] = []
+        self.rounds = 0
+        # False when the owning runtime feeds the coordinator from a
+        # pre-tick usage exchange instead (the ghost-member design):
+        # rounds then ship no usage — the exchange is authoritative, and
+        # a replica must never ship its (one-exchange-stale) ghost view
+        # of a member another replica owns.
+        self.ship_usage = True
+
+    def reconcile(self, candidates: List[dict],
+                  usage: Dict[str, dict]) -> List[bool]:
+        from kueue_tpu.tracing import trace_now
+
+        self.tick_submitted = True
+        self.rounds += 1
+        t0 = trace_now()
+        verdicts = self._submit({"candidates": candidates, "usage": usage})
+        self.rtt_samples.append(trace_now() - t0)
+        return verdicts
+
+    def flush_tick(self) -> None:
+        """Submit the tick's round if the scheduler never did (no heads,
+        quiescent replay, empty cycle): the coordinator barrier needs one
+        round per live replica per tick."""
+        if self.tick_submitted:
+            self.tick_submitted = False
+            return
+        usage = (self.usage_provider()
+                 if self.usage_provider and self.ship_usage else {})
+        self.reconcile([], usage)
+        self.tick_submitted = False
+
+    def drain_rtt(self) -> List[float]:
+        out, self.rtt_samples = self.rtt_samples, []
+        return out
+
+
+# ---------------------------------------------------------------------------
+# Coordinator
+# ---------------------------------------------------------------------------
+
+
+class Coordinator:
+    """The lease-holding reconcile authority for split cohort roots.
+
+    Holds the admin SPECS (flavors, cohort specs, ClusterQueues) routed
+    through the runtime, rebuilds a minimal cached view of the split
+    trees on structure changes, and replays each barrier's candidates in
+    global cycle order against the merged lending-clamp state — the
+    exact `fits_in_hierarchy` arithmetic (plus the skip-preemption /
+    common-resource gates) the single-process phase B applies, so a
+    replica-split deployment admits the same set a single process would.
+
+    Per-round state is rebuilt from the replicas' shipped absolute usage,
+    which makes the coordinator restart-safe by construction; committed
+    verdicts are journaled (`coordinator.jsonl`) when a state dir is
+    configured, so a takeover can audit-replay every cross-replica
+    decision."""
+
+    def __init__(self, journal_path: Optional[str] = None):
+        self.journal_path = journal_path
+        self._journal_file = None
+        self._lock = threading.Lock()
+        self._flavors: Dict[str, object] = {}
+        self._cohort_specs: Dict[str, object] = {}
+        self._cq_specs: Dict[str, object] = {}
+        self._split: FrozenSet[str] = frozenset()
+        self._dirty = True
+        self._cqs: Dict[str, CachedClusterQueue] = {}
+        self.rounds = 0
+        self.revocations = 0
+        self.commits = 0
+
+    # -- admin state --------------------------------------------------------
+
+    def note_flavor(self, rf, deleted: bool = False) -> None:
+        with self._lock:
+            if deleted:
+                self._flavors.pop(rf if isinstance(rf, str) else rf.name,
+                                  None)
+            else:
+                self._flavors[rf.name] = rf
+            self._dirty = True
+
+    def note_cohort(self, spec, deleted: bool = False) -> None:
+        with self._lock:
+            if deleted:
+                self._cohort_specs.pop(
+                    spec if isinstance(spec, str) else spec.name, None)
+            else:
+                self._cohort_specs[spec.name] = spec
+            self._dirty = True
+
+    def note_cluster_queue(self, spec, deleted: bool = False) -> None:
+        with self._lock:
+            if deleted:
+                self._cq_specs.pop(
+                    spec if isinstance(spec, str) else spec.name, None)
+            else:
+                self._cq_specs[spec.name] = spec
+            self._dirty = True
+
+    def set_split(self, split_roots: FrozenSet[str]) -> None:
+        with self._lock:
+            if split_roots != self._split:
+                self._split = frozenset(split_roots)
+                self._dirty = True
+
+    def _root_of(self, cohort: str) -> str:
+        seen = set()
+        node = cohort
+        while True:
+            spec = self._cohort_specs.get(node)
+            parent = spec.parent if spec is not None else ""
+            if not parent or node in seen:
+                return node
+            seen.add(node)
+            node = parent
+
+    def _rebuild(self) -> None:
+        """Materialize the split trees: Cohort nodes linked per the specs
+        (the snapshot's tree-building shape) with a CachedClusterQueue
+        per member — usage dicts are overwritten per round."""
+        self._cqs = {}
+        nodes: Dict[str, Cohort] = {}
+
+        def get_node(name: str) -> Cohort:
+            node = nodes.get(name)
+            if node is None:
+                node = nodes[name] = Cohort(
+                    name, spec=self._cohort_specs.get(name))
+            return node
+
+        member_cqs = [
+            spec for spec in self._cq_specs.values()
+            if spec.cohort and self._root_of(spec.cohort) in self._split]
+        needed = set()
+        for spec in member_cqs:
+            node = spec.cohort
+            while node and node not in needed:
+                needed.add(node)
+                cspec = self._cohort_specs.get(node)
+                node = cspec.parent if cspec is not None else ""
+        # EVERY node of a split tree participates in the balance math,
+        # not just member-ancestor chains: a spec-only cohort (e.g. a
+        # lending pool with quota but no ClusterQueues) contributes
+        # lendable capacity its siblings borrow through.
+        for name in list(self._cohort_specs):
+            if self._root_of(name) in self._split:
+                node = name
+                while node and node not in needed:
+                    needed.add(node)
+                    cspec = self._cohort_specs.get(node)
+                    node = cspec.parent if cspec is not None else ""
+        for name in needed:
+            get_node(name)
+        for name in needed:
+            node = nodes[name]
+            if node.spec is not None and node.spec.parent:
+                parent = get_node(node.spec.parent)
+                node.parent = parent
+                parent.children.append(node)
+        for spec in member_cqs:
+            cq = CachedClusterQueue(spec, self._flavors)
+            cohort = nodes[spec.cohort]
+            cohort.members.add(cq)
+            cq.cohort = cohort
+            self._cqs[spec.name] = cq
+        for node in nodes.values():
+            node.invalidate_memos()
+        self._dirty = False
+
+    # -- the round ----------------------------------------------------------
+
+    def run_round(self, rounds: List[dict],
+                  usage: Optional[Dict[str, dict]] = None,
+                  ) -> Dict[int, List[bool]]:
+        """Arbitrate one barrier: merge the shipped usage (per-round, or
+        the runtime's authoritative pre-tick exchange via `usage`), sort
+        every candidate by its entry ordering key (the single-process
+        cycle order — ties broken by cycle position, then workload key
+        across replicas), and gate each against
+        the merged tree state with the same-cycle reservations folded in.
+        Returns per-replica verdict lists in submission order."""
+        with self._lock:
+            if self._dirty:
+                self._rebuild()
+            merged = dict(usage or {})
+            for r in rounds:
+                merged.update(r.get("usage", {}))
+            for cq_name, cq_usage in merged.items():
+                cq = self._cqs.get(cq_name)
+                if cq is not None:
+                    cq.usage = {f: dict(res)
+                                for f, res in cq_usage.items()}
+            ordered = []
+            for r in rounds:
+                for c in r.get("candidates", ()):
+                    ordered.append((tuple(c["sort"]), c["key"],
+                                    r["replica"], c))
+            # Cycle position FIRST among equal sort keys: the single-
+            # process cycle replays deferred entries in original cycle
+            # order, and cycle_pos is exactly that order — with one
+            # replica this reproduces it bit for bit even when two heads
+            # tie on the whole sort key (same priority + timestamp); the
+            # workload key only disambiguates true cross-replica ties.
+            ordered.sort(key=lambda t: (t[0], t[3].get("pos", 0), t[1]))
+            verdicts = {r["replica"]: [False] * len(r.get("candidates", ()))
+                        for r in rounds}
+            cycle_usage: Dict[str, dict] = {}
+            root_usage: Dict[str, dict] = {}
+            skip: set = set()
+            from kueue_tpu.scheduler.scheduler import (
+                _has_common_flavor_resources, preempt_reserve)
+            from kueue_tpu.solver.modes import FIT, PREEMPT
+
+            committed = 0
+            for _, _, rid, c in ordered:
+                cq = self._cqs.get(c["cq"])
+                if cq is None or cq.cohort is None:
+                    # A candidate for a root the coordinator does not
+                    # model (spec lag): commit — the owning replica's
+                    # local pass already validated it, and refusing here
+                    # would wedge the workload until the specs arrive.
+                    verdicts[rid][c["i"]] = True
+                    continue
+                mode = c["mode"]
+                usage = c["usage"]
+                root = cq.cohort.root_name
+                blocked = False
+                if mode == PREEMPT and root in skip:
+                    blocked = _has_common_flavor_resources(
+                        root_usage.get(root), usage)
+                if not blocked and mode == FIT:
+                    blocked = not fits_in_hierarchy(
+                        cq, usage, extra=cycle_usage)
+                if not blocked:
+                    reserve = usage if mode != PREEMPT else \
+                        preempt_reserve(usage, c["borrow"], cq)
+                    frq_add(cycle_usage.setdefault(cq.cohort.name, {}),
+                            reserve)
+                    frq_add(root_usage.setdefault(root, {}), reserve)
+                    if mode == FIT or c.get("has_targets"):
+                        skip.add(root)
+                    committed += 1
+                verdicts[rid][c["i"]] = not blocked
+            self.rounds += 1
+            self.commits += committed
+            self.revocations += sum(
+                1 for _, _, rid, c in ordered
+                if c.get("opt_ok") and not verdicts[rid][c["i"]])
+            if ordered and self.journal_path is not None:
+                self._journal(ordered, verdicts)
+            return verdicts
+
+    def _journal(self, ordered, verdicts) -> None:
+        """Append the round's verdicts (reconcile decisions are durable
+        like every other admission input: a takeover can audit-replay
+        exactly which cross-replica admissions were committed)."""
+        if self._journal_file is None:
+            os.makedirs(os.path.dirname(self.journal_path) or ".",
+                        exist_ok=True)
+            self._journal_file = open(
+                self.journal_path, "a", encoding="utf-8")
+        entry = {
+            "round": self.rounds,
+            "verdicts": [
+                {"key": c["key"], "cq": c["cq"], "replica": rid,
+                 "ok": verdicts[rid][c["i"]]}
+                for _, _, rid, c in ordered],
+        }
+        self._journal_file.write(json.dumps(entry, separators=(",", ":"))
+                                 + "\n")
+        self._journal_file.flush()
+
+    def close(self) -> None:
+        with self._lock:
+            if self._journal_file is not None:
+                self._journal_file.close()
+                self._journal_file = None

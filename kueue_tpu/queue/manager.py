@@ -342,7 +342,11 @@ class Manager:
                 self._drop_cohort_member(old_cohort, cq.name)
                 if cq.cohort:
                     self._cohort_members.setdefault(cq.cohort, {})[cq.name] = cq
-                self._queue_cohort_inadmissible(cq.cohort)
+            # Any spec update (quota raise, namespace selector, stop
+            # policy) may make parked workloads admissible: requeue the
+            # whole cohort's inadmissible set (manager.go
+            # UpdateClusterQueue with specUpdated=true).
+            self._queue_cohort_inadmissible(cq.cohort, fallback=cq)
             self._cond.notify_all()
 
     def delete_cluster_queue(self, name: str) -> None:

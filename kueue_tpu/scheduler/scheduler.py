@@ -227,6 +227,15 @@ class Scheduler:
         # ClusterQueues the bulk share tensors did not cover this tick.
         self._tick_fair_state = None
         self._fair_bulk_miss = 0
+        # Multi-process replica mode (parallel/replica.py): when the
+        # owning runtime wires a ReplicaContext here, entries whose
+        # cohort root spans replica shard groups are deferred to the
+        # cross-replica commit protocol instead of the in-process
+        # reconcile — the coordinator replays them in global cycle order
+        # and returns commit/revoke verdicts before the flush.
+        self.replica_ctx = None
+        self._cycle_replica_candidates = 0
+        self._replica_member_memo = None
 
     def close(self) -> None:
         """Release cache/queue subscriptions. Call when retiring this
@@ -391,10 +400,14 @@ class Scheduler:
                                                  usage_csr=usage_csr)
                 # Replayable = nothing escaped the tick: no admission
                 # assumed, no preemption issued — only NOT_NOMINATED
-                # losers and deterministic SKIPPED bookkeeping.
+                # losers and deterministic SKIPPED bookkeeping. A cycle
+                # that shipped candidates to the cross-replica
+                # coordinator is never replayable: its outcome depends on
+                # OTHER replicas' state, which no local signature pins.
                 replayable = (
                     admitted == 0
                     and self.metrics.preempted == preempted_before
+                    and self._cycle_replica_candidates == 0
                     and all(e.status in (NOT_NOMINATED, SKIPPED)
                             for e in entries))
                 self._quiescent_record(
@@ -1174,6 +1187,15 @@ class Scheduler:
                 sv = sv_fn(snapshot)
         split_roots = sv[0].split_roots if sv is not None else None
         deferred: List = []
+        # Cross-REPLICA deferral (multi-process mode): roots whose member
+        # ClusterQueues live on other replica processes. Checked before
+        # the mesh deferral — a root that is both replica-split and
+        # device-shard-split belongs to the commit protocol (the local
+        # reconcile cannot see the remote members at all).
+        rctx = self.replica_ctx
+        replica_roots = rctx.split_roots if rctx is not None else None
+        deferred_replica: List = []
+        self._cycle_replica_candidates = 0
 
         def _cycle_one(e: Entry, cq: CachedClusterQueue, mode: int) -> None:
             nonlocal topo_cycle
@@ -1354,6 +1376,50 @@ class Scheduler:
             if cq.cohort is not None:
                 cycle_cohorts_skip_preemption.add(cq.cohort.root_name)
 
+        def _commit_replica(e: Entry, cq: CachedClusterQueue,
+                            mode: int) -> None:
+            """Apply a coordinator-COMMITTED verdict: _cycle_one without
+            the local cohort gating/bookkeeping — the merged-tree gate
+            already ran (and folded) at the coordinator, in global cycle
+            order, before any replica flushed."""
+            nonlocal topo_cycle
+            if mode != FIT:
+                if e.preemption_targets:
+                    e.info.last_assignment = None
+                    preempting.append((e, cq))
+                    count = len(e.preemption_targets)
+                    self.metrics.preempted += count
+                    e.inadmissible_msg += \
+                        f". Pending the preemption of {count} workload(s)"
+                    e.requeue_reason = RequeueReason.PENDING_PREEMPTION
+                return
+            if self.pods_ready_gate is not None \
+                    and not self.pods_ready_gate():
+                e.status = SKIPPED
+                e.inadmissible_msg = (
+                    "Waiting for all admitted workloads to be in the "
+                    "PodsReady condition")
+                return
+            topo_assignments = None
+            if topo_stage is not None \
+                    and getattr(e.assignment, "topology", None):
+                if topo_cycle is None:
+                    from kueue_tpu.topology import TopologyCycle
+                    topo_cycle = TopologyCycle(self.cache.topology)
+                topo_assignments, ok = self._charge_topology(
+                    topo_stage, topo_cycle, e.assignment)
+                if not ok:
+                    e.status = SKIPPED
+                    e.inadmissible_msg = (
+                        "topology domain no longer fits; other workloads "
+                        "were prioritized")
+                    e.info.last_assignment = None
+                    self.metrics.skipped += 1
+                    return
+            e.status = NOMINATED
+            self._admit(e, cq, pending_assumes,
+                        topo_assignments=topo_assignments)
+
         # -- phase A: the optimistic pass -------------------------------
         for pos, e in enumerate(entries):
             e.cycle_pos = pos
@@ -1380,15 +1446,25 @@ class Scheduler:
                     e.info.last_assignment = None
                     self.metrics.skipped += 1
                     continue
+            if replica_roots and cq.cohort is not None \
+                    and cq.cohort.root_name in replica_roots:
+                deferred_replica.append((e, cq, mode))
+                continue
             if split_roots and cq.cohort is not None \
                     and cq.cohort.root_name in split_roots:
                 deferred.append((e, cq, mode))
                 continue
             _cycle_one(e, cq, mode)
 
+        # -- phase B: cross-replica commit protocol ---------------------
+        if rctx is not None:
+            self._cycle_replica_candidates = len(deferred_replica)
+            self._replica_reconcile(deferred_replica, snapshot,
+                                    _commit_replica)
         # -- phase B: cross-shard borrow reconciliation -----------------
         if deferred:
             self._reconcile_deferred(deferred, sv, snapshot, _cycle_one)
+        if deferred or deferred_replica:
             # Deferred entries re-merge into the commit sequences at
             # their original cycle position.
             pending_assumes.sort(key=lambda item: item[0].cycle_pos)
@@ -1446,6 +1522,95 @@ class Scheduler:
             rsp.set("revoked", revoked)
         self.metrics.reconcile_revocations += revoked
         return revoked
+
+    def _replica_reconcile(self, deferred, snapshot: Snapshot,
+                           commit) -> None:
+        """Phase B across PROCESSES (parallel/replica.py): ship this
+        replica's split-root candidates (usage triples, packed sort key,
+        cycle position) plus its local members' pre-cycle usage to the
+        lease-holding coordinator, which replays every replica's
+        candidates in global cycle order against the merged lending-clamp
+        state and answers commit/revoke per entry — the in-process
+        `_reconcile_deferred` promoted to a real commit protocol (Aryl's
+        optimistic-local-pass / global-revoke loaning loop between
+        scheduler replicas). Always submits, even with zero candidates:
+        the coordinator barrier orders the round, and this replica's
+        shipped usage feeds the OTHER replicas' gating."""
+        rctx = self.replica_ctx
+        # Victim searches for deferred PREEMPT entries run against the
+        # frozen snapshot BEFORE submission (pre-computing is decision-
+        # identical — the prebatch argument), because the coordinator's
+        # skip-preemption bookkeeping needs to know whether each
+        # preempting candidate actually found victims. Candidates are
+        # subtree-local: a split root's victims never cross processes.
+        need = [(id(e), e.info, e.assignment) for e, _cq, m in deferred
+                if m == PREEMPT and e.preemption_targets is None]
+        if need:
+            got = self._batched_targets(need, snapshot)
+            for e, _cq, m in deferred:
+                if m == PREEMPT and e.preemption_targets is None:
+                    e.preemption_targets = got.get(id(e), [])
+        opt_usage: Dict[str, FlavorResourceQuantities] = {}
+        cands: List[dict] = []
+        for e, cq, mode in deferred:
+            usage = e.assignment.usage
+            opt_ok = False
+            if mode == FIT:
+                # The shard-local optimistic twin: this replica's subtree
+                # view only (the per-shard HierCycleState analog of
+                # _reconcile_deferred) — optimistic pass + coordinator
+                # revoke is exactly one counted revocation.
+                opt_ok = fits_in_hierarchy(cq, usage, extra=opt_usage)
+                if opt_ok:
+                    frq_add(opt_usage.setdefault(cq.cohort.name, {}),
+                            usage)
+            cands.append({
+                "i": len(cands), "key": e.info.key, "cq": cq.name,
+                "mode": mode, "usage": usage,
+                "borrow": bool(e.assignment.borrowing),
+                "sort": list(self._entry_sort_key(e)),
+                "pos": e.cycle_pos,
+                "has_targets": bool(e.preemption_targets),
+                "opt_ok": opt_ok,
+            })
+        with TRACER.phase("admit.reconcile.rtt") as sp:
+            usage = self._replica_usage(snapshot) if rctx.ship_usage else {}
+            verdicts = rctx.reconcile(cands, usage)
+            sp.set("deferred", len(deferred))
+            sp.set("round", rctx.rounds)
+        revoked = 0
+        for (e, cq, mode), cand, ok in zip(deferred, cands, verdicts):
+            if ok:
+                commit(e, cq, mode)
+            else:
+                e.status = SKIPPED
+                e.inadmissible_msg = \
+                    "other workloads in the cohort were prioritized"
+                e.info.last_assignment = None
+                self.metrics.skipped += 1
+                if cand["opt_ok"]:
+                    revoked += 1
+        self.metrics.reconcile_revocations += revoked
+
+    def _replica_usage(self, snapshot: Snapshot) -> Dict[str, dict]:
+        """This replica's split-root members' PRE-CYCLE usage (snapshot
+        copies, flavor -> resource -> value). The coordinator reassembles
+        the merged lending-clamp state from every replica's shipped view
+        each round, so it never holds usage a live replica did not just
+        vouch for (and a coordinator restart loses nothing)."""
+        rctx = self.replica_ctx
+        key = (snapshot.structure_version, rctx.split_roots)
+        memo = self._replica_member_memo
+        if memo is None or memo[0] != key:
+            names = [
+                cq.name for cq in snapshot.cluster_queues.values()
+                if cq.cohort is not None
+                and cq.cohort.root_name in rctx.split_roots]
+            memo = self._replica_member_memo = (key, names)
+        cqs = snapshot.cluster_queues
+        return {
+            name: {f: dict(res) for f, res in cqs[name].usage.items()}
+            for name in memo[1] if name in cqs}
 
     @staticmethod
     def _charge_topology(stage, topo_cycle, assignment):
@@ -1855,10 +2020,19 @@ def _resources_to_reserve(e: Entry,
     cycle (scheduler.go:353-387)."""
     if e.assignment.representative_mode != PREEMPT:
         return e.assignment.usage
+    return preempt_reserve(e.assignment.usage, e.assignment.borrowing, cq)
+
+
+def preempt_reserve(usage: FlavorResourceQuantities, borrowing: bool,
+                    cq: CachedClusterQueue) -> FlavorResourceQuantities:
+    """The PREEMPT-mode reserve arithmetic of `_resources_to_reserve`,
+    exposed on raw (usage, borrowing) inputs so the cross-replica
+    coordinator (parallel/replica.py) folds exactly what the in-process
+    cycle would."""
     reserved: FlavorResourceQuantities = {}
-    for flavor, resources in e.assignment.usage.items():
+    for flavor, resources in usage.items():
         reserved[flavor] = {}
-        for resource, usage in resources.items():
+        for resource, val in resources.items():
             rg = cq.rg_by_resource.get(resource)
             nominal, borrowing_limit = 0, None
             if rg is not None:
@@ -1870,13 +2044,13 @@ def _resources_to_reserve(e: Entry,
                             borrowing_limit = quota.borrowing_limit
                         break
             used = cq.usage.get(flavor, {}).get(resource, 0)
-            if not e.assignment.borrowing:
-                reserved[flavor][resource] = max(0, min(usage, nominal - used))
+            if not borrowing:
+                reserved[flavor][resource] = max(0, min(val, nominal - used))
             elif borrowing_limit is None:
-                reserved[flavor][resource] = usage
+                reserved[flavor][resource] = val
             else:
                 reserved[flavor][resource] = min(
-                    usage, nominal + borrowing_limit - used)
+                    val, nominal + borrowing_limit - used)
     return reserved
 
 

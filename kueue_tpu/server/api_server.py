@@ -172,7 +172,15 @@ class _Handler(BaseHTTPRequestHandler):
                 # trace pull must not stall the scheduler. `?slowest=true`
                 # returns just the slowest retained tick.
                 slowest = (params.get("slowest") or ["false"])[0] == "true"
-                self._send_json(TRACER.export_chrome(slowest_only=slowest))
+                if self.api.trace_export is not None:
+                    # Replica deployments serve the MERGED trace: every
+                    # worker process's ring dump rebased onto one
+                    # timeline with the coordinator's reconcile rounds
+                    # bound to the replicas' RTT spans as flow events.
+                    self._send_json(self.api.trace_export(slowest))
+                else:
+                    self._send_json(
+                        TRACER.export_chrome(slowest_only=slowest))
             elif path.startswith(VISIBILITY_PREFIX):
                 self._get_visibility(path, params)
             elif path.startswith(BATCH_PREFIX):
@@ -196,7 +204,7 @@ class _Handler(BaseHTTPRequestHandler):
                     if doc is None:
                         self._error(404, f"{kind} {name} not found")
                     else:
-                        if kind == "LocalQueue":
+                        if kind == "LocalQueue" and self.api.fw is not None:
                             # LocalQueue status derives from workload
                             # churn, not LQ writes — enrich on read from
                             # the cache (its own lock; no runtime-lock
@@ -272,6 +280,10 @@ class _Handler(BaseHTTPRequestHandler):
         self._send_json({"kind": "PendingWorkloadsSummary", "items": items})
 
     def _get_job(self, path: str) -> None:
+        if self.api.fw is None:
+            self._error(501, "job endpoints are served per replica; "
+                             "not available on the coordinator")
+            return
         rest = [p for p in path[len(BATCH_PREFIX):].split("/") if p]
         if len(rest) != 4 or rest[0] != "namespaces" or rest[2] != "jobs":
             self._error(404, f"unknown path {path}")
@@ -381,6 +393,10 @@ class _Handler(BaseHTTPRequestHandler):
             self._error(409, str(exc))
 
     def _post_job(self, path: str, body: dict) -> None:
+        if self.api.fw is None:
+            self._error(501, "job endpoints are served per replica; "
+                             "not available on the coordinator")
+            return
         rest = [p for p in path[len(BATCH_PREFIX):].split("/") if p]
         # POST /apis/batch/v1/namespaces/<ns>/jobs — create + submit
         if len(rest) == 3 and rest[0] == "namespaces" and rest[2] == "jobs":
@@ -432,6 +448,10 @@ class _Handler(BaseHTTPRequestHandler):
         if route is None or route[0] != KIND_WORKLOAD or route[2] is None:
             self._error(404, f"unknown path {path}")
             return
+        if self.api.fw is None:
+            self._error(501, "workload finish is served per replica; "
+                             "not available on the coordinator")
+            return
         kind, ns, name = route
         with self.api.runtime_lock:
             wl = self.api.fw.workloads.get(self._key(kind, ns, name))
@@ -463,10 +483,18 @@ class APIServer:
     def __init__(self, store: Store, framework, visibility=None,
                  host: str = "127.0.0.1", port: int = 0,
                  runtime_lock: Optional[threading.RLock] = None,
-                 sync_status=None, verbose: bool = False):
+                 sync_status=None, verbose: bool = False,
+                 trace_export=None):
         self.store = store
+        # None in multi-process replica mode: the coordinator serves the
+        # object store + merged traces, per-workload runtime endpoints
+        # (jobs, finish, LocalQueue status enrichment) live in the
+        # replicas and answer 501 here.
         self.fw = framework
         self.visibility = visibility
+        # Optional slowest->doc hook replacing the process-local TRACER
+        # export at GET /debug/traces (replica mode: merged trace).
+        self.trace_export = trace_export
         self.runtime_lock = runtime_lock or threading.RLock()
         self.verbose = verbose
         self.stopping = threading.Event()

@@ -20,6 +20,7 @@ from kueue_tpu.tracing.tracer import (
     NULL_SPAN,
     TickTrace,
     Tracer,
+    merge_chrome_traces,
     trace_now,
     validate_chrome_trace,
 )
@@ -39,6 +40,7 @@ __all__ = [
     "TickTrace",
     "Tracer",
     "build_record",
+    "merge_chrome_traces",
     "trace_now",
     "validate_chrome_trace",
 ]

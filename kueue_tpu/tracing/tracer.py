@@ -384,6 +384,74 @@ class Tracer:
         return json.dumps(self.export_chrome(slowest_only=slowest_only))
 
 
+def merge_chrome_traces(docs) -> dict:
+    """Merge per-process Chrome trace docs into ONE Perfetto-loadable
+    trace (the multi-process replica runtime's `GET /debug/traces`).
+
+    `docs` is [(pid, process_name, chrome_doc), ...]. Each process's
+    tracer timestamps run on its own perf_counter timebase; the export's
+    `epoch_unix` anchors that timebase to the wall clock, so events are
+    REBASED onto the earliest epoch (same-host wall clocks — the replica
+    deployment's substrate — keep the lanes aligned to ~ms). Every
+    event's pid becomes its process's lane, a process_name metadata row
+    labels it, and the reconcile commit protocol becomes visible as flow
+    events: each replica's in-cycle `admit.reconcile.rtt` span (args:
+    round) emits a flow start ("s") that finishes ("f") on the
+    coordinator's matching `reconcile.round` span — the cross-process
+    round trip drawn as an arrow."""
+    epochs = [d.get("otherData", {}).get("epoch_unix")
+              for _, _, d in docs]
+    known = [e for e in epochs if isinstance(e, (int, float))]
+    base = min(known) if known else 0.0
+    events: List[dict] = []
+    # Coordinator round spans by round id, for the flow-event sinks.
+    rounds: Dict[object, dict] = {}
+    ticks_retained = 0
+    for (pid, name, doc), epoch in zip(docs, epochs):
+        shift = ((epoch - base) * 1e6
+                 if isinstance(epoch, (int, float)) else 0.0)
+        events.append({"ph": "M", "name": "process_name", "pid": pid,
+                       "ts": 0, "args": {"name": name}})
+        ticks_retained += doc.get("otherData", {}).get("ticks_retained", 0)
+        for ev in doc.get("traceEvents", ()):
+            if ev.get("ph") == "M":
+                continue
+            ev = dict(ev)
+            ev["pid"] = pid
+            if "ts" in ev:
+                ev["ts"] = round(ev["ts"] + shift, 3)
+            events.append(ev)
+            rnd = (ev.get("args") or {}).get("round")
+            if rnd is not None and ev.get("name") == "reconcile.round":
+                rounds[rnd] = ev
+    flows = []
+    for ev in events:
+        rnd = (ev.get("args") or {}).get("round")
+        if rnd is None or ev.get("name") != "admit.reconcile.rtt":
+            continue
+        sink = rounds.get(rnd)
+        if sink is None:
+            continue
+        flows.append({"ph": "s", "id": int(rnd), "name": "reconcile",
+                      "cat": "kueue", "pid": ev["pid"], "tid": ev["tid"],
+                      "ts": ev["ts"]})
+        flows.append({"ph": "f", "bp": "e", "id": int(rnd),
+                      "name": "reconcile", "cat": "kueue",
+                      "pid": sink["pid"], "tid": sink["tid"],
+                      "ts": round(sink["ts"] + sink.get("dur", 0), 3)})
+    events.extend(flows)
+    return {
+        "traceEvents": events,
+        "displayTimeUnit": "ms",
+        "otherData": {
+            "tracer": "kueue-tpu",
+            "merged_processes": len(docs),
+            "ticks_retained": ticks_retained,
+            "epoch_unix": base,
+        },
+    }
+
+
 def validate_chrome_trace(doc) -> List[str]:
     """Schema check for the Chrome trace-event JSON object format; returns
     problem strings (empty == valid, loads in Perfetto). Dependency-free
@@ -402,11 +470,13 @@ def validate_chrome_trace(doc) -> List[str]:
         ph = ev.get("ph")
         if not isinstance(ev.get("name"), str) or not ev["name"]:
             problems.append(f"{where}: missing/empty name")
-        if ph not in ("X", "B", "E", "M", "i", "C"):
+        if ph not in ("X", "B", "E", "M", "i", "C", "s", "t", "f"):
             problems.append(f"{where}: unknown phase {ph!r}")
         if not isinstance(ev.get("pid"), int):
             problems.append(f"{where}: pid must be an int")
-        if ph in ("X", "B", "E", "i", "C"):
+        if ph in ("s", "t", "f") and ev.get("id") is None:
+            problems.append(f"{where}: flow event needs an id")
+        if ph in ("X", "B", "E", "i", "C", "s", "t", "f"):
             if not isinstance(ev.get("tid"), int):
                 problems.append(f"{where}: tid must be an int")
             ts = ev.get("ts")

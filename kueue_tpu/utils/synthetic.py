@@ -41,6 +41,7 @@ def synthetic_objects(
     topology: bool = False,
     strict_fifo: bool = False,
     no_preemption: bool = False,
+    cq_filter=None,
 ):
     """Generate the raw API objects of a north-star-scale cluster:
     (flavors, cluster_queues, local_queues, admitted workloads with their
@@ -62,7 +63,13 @@ def synthetic_objects(
     and every pending workload's podsets request slice packing — each
     fourth workload `required: rack`, the rest `preferred: rack` — so the
     whole topology stage (batched fit, cycle charging, ledger) runs on
-    every tick."""
+    every tick.
+
+    `cq_filter(c) -> bool` keeps only the objects of the selected
+    ClusterQueue indices — the replica runtime's per-worker slice. The
+    RANDOM DRAWS still run for every index (filtered or not), so any
+    union of slices equals the unfiltered world object for object; only
+    the construction (and memory) of filtered objects is skipped."""
     rnd = random.Random(seed)
     if preemption_heavy:
         pending_priority = (1, 5)
@@ -88,9 +95,21 @@ def synthetic_objects(
 
     cqs: List[ClusterQueue] = []
     lqs: List[LocalQueue] = []
+    kept: List[int] = []
+    cq_by_index = {}
     for c in range(num_cqs):
+        keep = cq_filter is None or cq_filter(c)
         n_flavors = rnd.randint(2, min(4, num_flavors))
         chosen = rnd.sample(range(num_flavors), n_flavors)
+        # Draw the quota numbers (and the fair weight) unconditionally
+        # (the cq_filter draw contract), construct objects only for
+        # kept indices.
+        draws = [(rnd.randint(16, 128), rnd.randint(64, 512))
+                 for _fi in chosen]
+        fair_weight = float(rnd.randint(1, 4)) if fair_hierarchy else None
+        if not keep:
+            continue
+        kept.append(c)
         if lending:
             # BASELINE config #2 quotas: borrowing allowed, lending
             # clamped below nominal (clusterqueue.go:583-629 semantics).
@@ -100,19 +119,19 @@ def synthetic_objects(
             fqs = tuple(
                 FlavorQuotas.make(
                     f"flavor-{fi}",
-                    cpu=_q(rnd.randint(16, 128)),
-                    memory=_q(rnd.randint(64, 512), unit=1024 ** 3),
+                    cpu=_q(cpu_nom),
+                    memory=_q(mem_nom, unit=1024 ** 3),
                 )
-                for fi in chosen
+                for fi, (cpu_nom, mem_nom) in zip(chosen, draws)
             )
         else:
             fqs = tuple(
                 FlavorQuotas.make(
                     f"flavor-{fi}",
-                    cpu=rnd.randint(16, 128),
-                    memory=f"{rnd.randint(64, 512)}Gi",
+                    cpu=cpu_nom,
+                    memory=f"{mem_nom}Gi",
                 )
-                for fi in chosen
+                for fi, (cpu_nom, mem_nom) in zip(chosen, draws)
             )
         preemption = ClusterQueuePreemption(
             within_cluster_queue="LowerPriority",
@@ -131,8 +150,8 @@ def synthetic_objects(
                     policy="LowerPriority", max_priority_threshold=0))
         fair = None
         if fair_hierarchy:
-            fair = FairSharing(weight=float(rnd.randint(1, 4)))
-        cqs.append(ClusterQueue(
+            fair = FairSharing(weight=fair_weight)
+        cq = ClusterQueue(
             name=f"cq-{c}",
             resource_groups=(ResourceGroup(("cpu", "memory"), fqs),),
             cohort=f"cohort-{c % num_cohorts}" if num_cohorts > 0
@@ -143,7 +162,9 @@ def synthetic_objects(
             # (no parking lot), so every tick re-pops the same heads —
             # the steady-state/quiescent bench shape.
             **({"queueing_strategy": "StrictFIFO"} if strict_fifo else {}),
-        ))
+        )
+        cqs.append(cq)
+        cq_by_index[c] = cq
         lqs.append(LocalQueue(
             name=f"lq-{c}", namespace="default", cluster_queue=f"cq-{c}"))
 
@@ -153,8 +174,8 @@ def synthetic_objects(
     # arrivals can only start by preempting and minimalPreemptions has
     # granular victims to choose among (preemption.go:172-231).
     admitted: List[Workload] = []
-    for c in range(num_cqs):
-        cq_flavors = cqs[c].resource_groups[0].flavors
+    for c in kept:
+        cq_flavors = cq_by_index[c].resource_groups[0].flavors
         fill_flavors = cq_flavors if preemption_heavy else cq_flavors[:1]
         chunks = 4 if preemption_heavy else 1
         for fq_obj in fill_flavors:
@@ -183,6 +204,7 @@ def synthetic_objects(
                 wl.set_condition("Admitted", True, now=float(c))
                 admitted.append(wl)
 
+    kept_set = set(kept)
     pending: List[Workload] = []
     for i in range(num_pending):
         c = i % num_cqs
@@ -191,16 +213,22 @@ def synthetic_objects(
         if topology:
             topo_kw = ({"topology_required": "rack"} if i % 4 == 0
                        else {"topology_preferred": "rack"})
+        # Draw-then-construct (the cq_filter draw contract): the random
+        # stream advances identically whether or not this index is kept.
+        specs = [(rnd.randint(1, 8), rnd.randint(1, 8),
+                  rnd.randint(1, 16)) for _p in range(n_podsets)]
+        priority = rnd.randint(*pending_priority)
+        if c not in kept_set:
+            continue
         pod_sets = [
             PodSet.make(
-                f"ps{p}", count=rnd.randint(1, 8),
-                cpu=rnd.randint(1, 8),
-                memory=f"{rnd.randint(1, 16)}Gi", **topo_kw)
-            for p in range(n_podsets)
+                f"ps{p}", count=count, cpu=cpu,
+                memory=f"{mem}Gi", **topo_kw)
+            for p, (count, cpu, mem) in enumerate(specs)
         ]
         pending.append(Workload(
             name=f"pend-{i}", namespace="default", queue_name=f"lq-{c}",
-            priority=rnd.randint(*pending_priority), creation_time=float(i),
+            priority=priority, creation_time=float(i),
             pod_sets=pod_sets))
     return flavors, cqs, lqs, admitted, pending, cohort_specs
 

@@ -1,0 +1,52 @@
+# Fixture: a lock-order cycle only visible through Protocol/annotation
+# attribute typing. The runtime's channel attribute is typed by a
+# Protocol annotation (the concrete class is wired through a factory, so
+# constructor inference sees nothing), and the channel's owner back-ref
+# is a bare class annotation. LOCK03 must resolve submit() ->
+# Channel.push -> LockedChannel.push (structural conformer) ->
+# Runtime.note and report the Runtime._lock <-> LockedChannel._lock
+# cycle.
+import threading
+from typing import Protocol
+
+
+class Channel(Protocol):
+    def push(self, item): ...
+
+
+def make_channel(owner):
+    return LockedChannel(owner)
+
+
+class LockedChannel:
+    owner: "Runtime"
+
+    def __init__(self, owner):
+        self._lock = threading.Lock()
+        self.owner = owner
+        self.items = []
+
+    def push(self, item):
+        with self._lock:
+            self.items.append(item)
+            # channel lock held -> runtime lock acquired inside
+            # (opposite order to Runtime.submit)
+            self.owner.note(item)
+
+
+class Runtime:
+    chan: Channel
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self.chan = make_channel(self)
+        self.seen = []
+
+    def submit(self, item):
+        with self._lock:
+            # runtime lock held -> channel lock acquired inside
+            self.chan.push(item)
+
+    def note(self, item):
+        with self._lock:
+            self.seen.append(item)

@@ -1,0 +1,48 @@
+# Fixture: the same Protocol-typed channel wiring as
+# lockgraph_proto_bad.py, but with one global acquisition order — the
+# channel's callback runs OUTSIDE its lock, so no cycle exists and
+# LOCK03 must stay silent (the Protocol resolution must not invent
+# edges that are not there).
+import threading
+from typing import Protocol
+
+
+class Channel(Protocol):
+    def push(self, item): ...
+
+
+def make_channel(owner):
+    return LockedChannel(owner)
+
+
+class LockedChannel:
+    owner: "Runtime"
+
+    def __init__(self, owner):
+        self._lock = threading.Lock()
+        self.owner = owner
+        self.items = []
+
+    def push(self, item):
+        with self._lock:
+            self.items.append(item)
+        # callback outside the channel lock: runtime lock is only ever
+        # taken lock-free or strictly first
+        self.owner.note(item)
+
+
+class Runtime:
+    chan: Channel
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self.chan = make_channel(self)
+        self.seen = []
+
+    def submit(self, item):
+        with self._lock:
+            self.chan.push(item)
+
+    def note(self, item):
+        with self._lock:
+            self.seen.append(item)

@@ -306,6 +306,72 @@ leaderElection:
 """
 
 
+def test_replica_failover_replays_partition_journal(tmp_path):
+    """Multi-process replica HA (the PR 2 takeover, per PARTITION): kill
+    a replica mid-window; the lease-holding runtime reassigns its shard
+    group to a survivor, which attaches the dead replica's per-group
+    journal and replays it — the admitted set then matches the
+    uninterrupted single-process run exactly (quota restored by replay,
+    never re-admission), and pending workloads keep waiting."""
+    from kueue_tpu.config import Configuration, TPUSolverConfig
+    from kueue_tpu.controllers.replica_runtime import ReplicaRuntime
+    from tests.util import fq, make_cq, make_lq, make_wl, rg
+
+    def build(target):
+        target.create_resource_flavor(make_flavor("default"))
+        for i in range(4):
+            target.create_cluster_queue(make_cq(
+                f"cq-{i}", rg("cpu", fq("default", cpu=4))))
+            target.create_local_queue(make_lq(
+                f"lq-{i}", "default", cq=f"cq-{i}"))
+
+    def load(target):
+        for i in range(4):
+            target.submit(make_wl(f"fits-{i}", f"lq-{i}", cpu=3,
+                                  creation_time=float(i)))
+            target.submit(make_wl(f"waits-{i}", f"lq-{i}", cpu=3,
+                                  creation_time=float(10 + i)))
+
+    # Uninterrupted single-process reference.
+    fw = Framework(config=Configuration(
+        tpu_solver=TPUSolverConfig(enable=False)))
+    fw.create_namespace("default", labels={})
+    build(fw)
+    load(fw)
+    fw.run_until_settled(max_ticks=8)
+    expect = {f"cq-{i}": sorted(fw.cache.cluster_queues[f"cq-{i}"].workloads)
+              for i in range(4)}
+
+    rt = ReplicaRuntime(3, spawn=False, engine="host",
+                        state_dir=str(tmp_path / "state"))
+    try:
+        build(rt)
+        load(rt)
+        for _ in range(4):
+            rt.tick()
+        assert rt.dump()["admitted"] == expect
+        victim_gid = rt.gmap.cq_group["cq-1"]
+        victim = rt.group_owner[victim_gid]
+        rt.kill_replica(victim)
+        for _ in range(5):
+            rt.tick()
+        after = rt.dump()
+        assert rt.group_owner[victim_gid] != victim
+        assert after["admitted"] == expect
+        # Exactly-once: recovered admissions hold the quota, so every
+        # pending workload must still be waiting.
+        assert all(n == 1 for n in after["pending"].values()), \
+            after["pending"]
+        # The reassigned group's journal kept recording: one owner file
+        # per shard-group journal exists in the shared state dir.
+        journals = sorted(p for p in os.listdir(tmp_path / "state")
+                          if p.startswith("journal-g")
+                          and p.endswith(".jsonl"))
+        assert len(journals) == 3
+    finally:
+        rt.close()
+
+
 def test_ha_takeover_replays_shared_journal(tmp_path):
     """HA takeover with ONE shared journal across both replicas (the
     deferred-attach replay path): replicas share the state dir AND the

@@ -175,6 +175,24 @@ def test_lockgraph_good_fixture_is_clean():
                         engine="flow") == []
 
 
+def test_lockgraph_protocol_bad_fixture_reports_cycle():
+    """LOCK03 resolves calls through Protocol- and annotation-typed
+    attributes: the channel attribute is typed only by a Protocol
+    annotation (the concrete class hides behind a factory) and the
+    back-ref only by a string annotation — the cycle must still be
+    found, through the structural conformer."""
+    findings = run_analysis([str(FIXTURES / "lockgraph_proto_bad.py")],
+                            engine="flow")
+    assert _rules_of(findings) == {"LOCK03"}
+    msg = findings[0].message
+    assert "Runtime._lock" in msg and "LockedChannel._lock" in msg
+
+
+def test_lockgraph_protocol_good_fixture_is_clean():
+    assert run_analysis([str(FIXTURES / "lockgraph_proto_good.py")],
+                        engine="flow") == []
+
+
 def test_ledger_bad_fixture_reports_imbalance_and_error_path():
     findings = run_analysis([str(FIXTURES / "ledger_bad.py")],
                             engine="flow")

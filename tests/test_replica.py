@@ -1,0 +1,649 @@
+"""Multi-process replica scheduler: decision-identity goldens + the
+cross-replica commit protocol (parallel/replica.py,
+controllers/replica_runtime.py).
+
+The replica split must be decision-INVISIBLE: for any replica count, the
+partitioned deployment (one queue manager/cache/solver slice per shard
+group + the coordinator commit protocol for split KEP-79 roots) admits
+and preempts exactly what the single-process scheduler does. Pinned:
+
+  * 200-tick randomized churn (the tests/test_shard.py harness shape —
+    flat cohorts + a hierarchical tree whose subtree cohorts hash onto
+    different replicas, so the commit protocol runs live during churn)
+    at replicas {1, 2, 4}, across every registered victim-search
+    engine, against the unsharded single-process trail, bitwise;
+  * a deterministic cross-REPLICA LendingLimit scenario where two
+    same-tick heads on different replicas both pass their local
+    optimistic view but only one fits the shared clamp — the
+    coordinator MUST revoke exactly one, matching single-process;
+  * a spawn-mode (real multiprocessing) identity run — same protocol,
+    real pipes — plus the fail-over drill: kill a replica mid-window,
+    the lease holder reassigns its shard group, the partition journal
+    replays, and the admitted set matches the uninterrupted run.
+
+The churn goldens run the LOOPBACK transport (threads + queues): the
+protocol and the worker code are identical to spawn mode; only the
+channel differs, and the spawn smoke pins that the pipes carry the same
+decisions.
+"""
+
+import os
+import random
+import zlib
+
+import pytest
+
+from kueue_tpu import features
+from kueue_tpu.api.types import (
+    ClusterQueuePreemption,
+    CohortSpec,
+    PodSet,
+    Workload,
+)
+from kueue_tpu.config import Configuration, TPUSolverConfig
+from kueue_tpu.controllers.replica_runtime import ReplicaRuntime
+from kueue_tpu.controllers.runtime import Framework
+from kueue_tpu.models.flavor_fit import BatchSolver
+from kueue_tpu.parallel.replica import GroupMap, group_key, group_of
+from kueue_tpu.solver import modes as _modes
+
+from tests.util import fq, make_cq, make_flavor, make_lq, make_wl, rg
+
+TICKS = 200
+
+_ENGINE_KNOB = {
+    "host": None,
+    "scan-jax": "jax",
+    "scan-pallas": "pallas",
+    "batch-native": "native",
+    "batch-jax": "jax",
+}
+
+_KNOBS = []
+for _spec in _modes.ENGINES:
+    if _spec.optional_import and not _modes.engine_importable(_spec):
+        continue
+    knob = _ENGINE_KNOB[_spec.name]
+    if knob not in _KNOBS:
+        _KNOBS.append(knob)
+
+
+def _split_pair(n_groups: int):
+    """Two cohort names whose hashes land on different groups at both 2
+    and `n_groups` replicas — the tree they share is replica-split."""
+    names = ["east", "west", "north", "south", "alpha", "beta", "gamma",
+             "delta", "omega", "sigma"]
+    for i, a in enumerate(names):
+        ha = zlib.crc32(a.encode())
+        for b in names[i + 1:]:
+            hb = zlib.crc32(b.encode())
+            if ha % n_groups != hb % n_groups and ha % 2 != hb % 2:
+                return a, b
+    raise AssertionError("no splitting cohort-name pair found")
+
+
+def _world_objects():
+    """The test_shard mixed topology: 4 CQs over 2 flat cohorts with
+    cohort-reclaim preemption, plus a hierarchical tree
+    `hroot <- {A, B, hpool}` where hpool lends at most 4 cpu and A/B
+    hash to different replicas — every borrow across the tree runs the
+    commit protocol when replicated."""
+    ca, cb = _split_pair(4)
+    objs = [
+        ("flavor", make_flavor("on-demand", zone="a")),
+        ("flavor", make_flavor("spot", zone="b")),
+    ]
+    for i in range(4):
+        objs.append(("cq", make_cq(
+            f"cq-{i}",
+            rg("cpu", fq("on-demand", cpu=(16, 16)), fq("spot", cpu=(8, 8))),
+            cohort=f"cohort-{i % 2}",
+            preemption=ClusterQueuePreemption(
+                within_cluster_queue="LowerPriority",
+                reclaim_within_cohort="Any"))))
+        objs.append(("lq", make_lq(f"lq-{i}", "default", cq=f"cq-{i}")))
+    objs.append(("cohort", CohortSpec(name="hroot")))
+    objs.append(("cohort", CohortSpec(name=ca, parent="hroot")))
+    objs.append(("cohort", CohortSpec(name=cb, parent="hroot")))
+    objs.append(("cohort", CohortSpec(
+        name="hpool", parent="hroot",
+        resource_groups=(rg("cpu", fq("on-demand", cpu=(8, None, 4))),))))
+    for side, idx in ((ca, 4), (cb, 5)):
+        objs.append(("cq", make_cq(
+            f"cq-{idx}", rg("cpu", fq("on-demand", cpu=4)), cohort=side)))
+        objs.append(("lq", make_lq(f"lq-{idx}", "default",
+                                   cq=f"cq-{idx}")))
+    return objs
+
+
+def _apply_world(target) -> None:
+    handlers = {
+        "flavor": target.create_resource_flavor,
+        "cohort": target.create_cohort,
+        "cq": target.create_cluster_queue,
+        "lq": target.create_local_queue,
+    }
+    for kind, obj in _world_objects():
+        handlers[kind](obj)
+
+
+class _SingleTarget:
+    """Single-process Framework behind the same driving interface the
+    replica runtime exposes — so ONE churn loop drives both and every
+    input is provably identical."""
+
+    def __init__(self, engine):
+        features.set_enabled(features.LENDING_LIMIT, True)
+        cfg = Configuration(tpu_solver=TPUSolverConfig(
+            preemption_engine="host" if engine is None else engine))
+        self.fw = Framework(batch_solver=BatchSolver(), config=cfg,
+                            pipeline_depth=1)
+        self.fw.create_namespace("default", labels={})
+        self._admitted: list = []
+        self._preempted: list = []
+        orig_admit = self.fw.scheduler.apply_admission
+        orig_preempt = self.fw.scheduler.apply_preemption
+
+        def apply_admission(wl):
+            ok = orig_admit(wl)
+            if ok:
+                self._admitted.append((wl.key, wl.admission.cluster_queue))
+            return ok
+
+        def apply_preemption(wl, msg):
+            self._preempted.append(wl.key)
+            return orig_preempt(wl, msg)
+
+        self.fw.scheduler.apply_admission = apply_admission
+        self.fw.scheduler.apply_preemption = apply_preemption
+        _apply_world(self.fw)
+
+    def submit(self, wl):
+        self.fw.submit(wl)
+
+    def finish(self, key, cq=None, delete=True):
+        wl = self.fw.workloads.get(key)
+        if wl is not None:
+            self.fw.finish(wl)
+            if delete:
+                self.fw.delete_workload(wl)
+
+    def delete_workload(self, key):
+        wl = self.fw.workloads.get(key)
+        if wl is not None:
+            self.fw.delete_workload(wl)
+
+    def tick(self):
+        self._admitted, self._preempted = [], []
+        self.fw.tick()
+        self.fw.prewarm_idle()
+        return {"admitted": list(self._admitted),
+                "preempted": list(self._preempted)}
+
+    def pending_total(self):
+        return sum(self.fw.queues.pending(f"cq-{i}") for i in range(6))
+
+    def revocations(self):
+        return self.fw.scheduler.metrics.reconcile_revocations
+
+    def close(self):
+        pass
+
+
+class _ReplicaTarget:
+    def __init__(self, engine, replicas, spawn=False, state_dir=None):
+        features.set_enabled(features.LENDING_LIMIT, True)
+        self.rt = ReplicaRuntime(
+            replicas, spawn=spawn, state_dir=state_dir,
+            engine="host" if engine is None else engine)
+        _apply_world(self.rt)
+        self._revocations = 0
+
+    def submit(self, wl):
+        self.rt.submit(wl)
+
+    def finish(self, key, cq=None, delete=True):
+        self.rt.finish(key, cq=cq, delete=delete)
+
+    def delete_workload(self, key):
+        self.rt.delete_workload(key)
+
+    def tick(self):
+        stats = self.rt.tick()
+        self._revocations += stats["revocations"]
+        return stats
+
+    def pending_total(self):
+        return sum(self.rt.dump()["pending"].get(f"cq-{i}", 0)
+                   for i in range(6))
+
+    def revocations(self):
+        return self._revocations
+
+    def close(self):
+        self.rt.close()
+
+
+def drive(target, ticks: int = TICKS):
+    """Seeded churn through the shared driving interface; returns the
+    decision trail. All bookkeeping runs on the tick stats (keys + CQs),
+    never on object state, so the single-process and replica drives
+    receive byte-identical inputs."""
+    rnd = random.Random(4321)
+    seq = [0]
+    pending: dict = {}    # key -> True (submitted, not admitted)
+    admitted: dict = {}   # key -> cq
+    trail = []
+
+    def submit_one():
+        seq[0] += 1
+        i = seq[0]
+        if i % 4 == 0:
+            q = f"lq-{4 + (i // 4) % 2}"
+            cpu = rnd.randint(2, 8)
+        else:
+            q = f"lq-{rnd.randrange(4)}"
+            cpu = rnd.randint(1, 4)
+        wl = Workload(
+            name=f"wl-{i}", namespace="default", queue_name=q,
+            priority=rnd.randint(-2, 3),
+            creation_time=float(1000 + i),
+            pod_sets=[PodSet.make("ps0", count=rnd.randint(1, 3), cpu=cpu)])
+        pending[wl.key] = True
+        target.submit(wl)
+
+    for _ in range(40):
+        submit_one()
+
+    for _ in range(ticks):
+        stats = target.tick()
+        tick_admitted = sorted(k for k, _cq in stats["admitted"])
+        tick_preempted = sorted(stats["preempted"])
+        trail.append((tuple(tick_admitted), tuple(tick_preempted)))
+        for key, cq in stats["admitted"]:
+            admitted[key] = cq
+            pending.pop(key, None)
+        for key in stats["preempted"]:
+            # Evicted this tick's reconcile: back to pending.
+            if key in admitted:
+                admitted.pop(key)
+                pending[key] = True
+        for _ in range(rnd.randint(0, 3)):
+            submit_one()
+        if pending and rnd.random() < 0.3:
+            key = rnd.choice(sorted(pending))
+            del pending[key]
+            target.delete_workload(key)
+        done = sorted(admitted)
+        for key in done[:rnd.randint(0, 4)]:
+            cq = admitted.pop(key)
+            target.finish(key, cq=cq)
+    trail.append(("pending", target.pending_total()))
+    return trail
+
+
+_BASELINES: dict = {}
+
+
+def _baseline(engine):
+    if engine not in _BASELINES:
+        target = _SingleTarget(engine)
+        _BASELINES[engine] = drive(target)
+        target.close()
+    return _BASELINES[engine]
+
+
+@pytest.mark.parametrize("engine", _KNOBS, ids=[str(k) for k in _KNOBS])
+@pytest.mark.parametrize("replicas", [1, 2, 4])
+def test_replica_churn_decisions_identical(engine, replicas):
+    """200 randomized churn ticks: the partitioned deployment (per-group
+    vertical slices + the coordinator commit protocol for the split
+    tree) must replay the single-process trail byte for byte, at every
+    replica count, on every engine."""
+    target = _ReplicaTarget(engine, replicas)
+    try:
+        trail = drive(target)
+    finally:
+        target.close()
+    assert trail == _baseline(engine)
+
+
+def _lending_world(target, ca, cb):
+    for kind, obj in [
+        ("flavor", make_flavor("on-demand")),
+        ("cohort", CohortSpec(name="hroot")),
+        ("cohort", CohortSpec(name=ca, parent="hroot")),
+        ("cohort", CohortSpec(name=cb, parent="hroot")),
+        ("cohort", CohortSpec(
+            name="hpool", parent="hroot",
+            resource_groups=(rg("cpu",
+                                fq("on-demand", cpu=(8, None, 4))),))),
+        ("cq", make_cq("cq-a", rg("cpu", fq("on-demand", cpu=4)),
+                       cohort=ca)),
+        ("lq", make_lq("lq-a", "default", cq="cq-a")),
+        ("cq", make_cq("cq-b", rg("cpu", fq("on-demand", cpu=4)),
+                       cohort=cb)),
+        ("lq", make_lq("lq-b", "default", cq="cq-b")),
+    ]:
+        {"flavor": target.create_resource_flavor,
+         "cohort": target.create_cohort,
+         "cq": target.create_cluster_queue,
+         "lq": target.create_local_queue}[kind](obj)
+
+
+def test_lending_clamp_commit_protocol_revokes():
+    """Two same-tick heads on different REPLICAS of a split tree, both
+    borrowing from one lending-limited pool that can serve only one:
+    each replica's local optimistic pass admits its own, the coordinator
+    commits exactly one in global cycle order and revokes the other —
+    and the winner matches the single-process decision."""
+    features.set_enabled(features.LENDING_LIMIT, True)
+    ca, cb = _split_pair(2)
+
+    cfg = Configuration(tpu_solver=TPUSolverConfig(
+        preemption_engine="host"))
+    fw = Framework(batch_solver=BatchSolver(), config=cfg,
+                   pipeline_depth=1)
+    fw.create_namespace("default", labels={})
+    _lending_world(fw, ca, cb)
+    fw.submit(make_wl("wa", "lq-a", cpu=8, creation_time=1.0))
+    fw.submit(make_wl("wb", "lq-b", cpu=8, creation_time=2.0))
+    fw.run_until_settled(max_ticks=6)
+    single = tuple(sorted(
+        fw.admitted_workloads("cq-a") + fw.admitted_workloads("cq-b")))
+    assert len(single) == 1
+
+    rt = ReplicaRuntime(2, spawn=False, engine="host")
+    try:
+        _lending_world(rt, ca, cb)
+        assert "hroot" in rt.gmap.split_roots
+        rt.submit(make_wl("wa", "lq-a", cpu=8, creation_time=1.0))
+        rt.submit(make_wl("wb", "lq-b", cpu=8, creation_time=2.0))
+        revocations = 0
+        for _ in range(6):
+            revocations += rt.tick()["revocations"]
+        dump = rt.dump()
+        winners = tuple(sorted(dump["admitted"].get("cq-a", [])
+                               + dump["admitted"].get("cq-b", [])))
+        assert winners == single
+        assert revocations >= 1
+        assert rt.coordinator.revocations >= 1
+        assert rt.coordinator.commits >= 1
+    finally:
+        rt.close()
+
+
+def test_spawn_identity_smoke():
+    """Real multiprocessing (spawn) replicas, 3 processes: a short churn
+    drive must match the single-process trail — the pipes carry exactly
+    what the loopback queues carry. This is the `make replica-smoke`
+    identity gate."""
+    target = _ReplicaTarget(None, 3, spawn=True)
+    try:
+        trail = drive(target, ticks=30)
+    finally:
+        target.close()
+    single = _SingleTarget(None)
+    expect = drive(single, ticks=30)
+    assert trail == expect
+
+
+def test_spawn_failover_drill(tmp_path):
+    """Kill a replica PROCESS mid-window (SIGKILL, no shutdown path):
+    the lease-holding parent reassigns its shard group, the partition
+    journal replays on the adopter, and the final admitted set matches
+    the uninterrupted single-process run — the PR 2 HA takeover, per
+    partition. This is the `make replica-smoke` fail-over drill."""
+    state = str(tmp_path / "state")
+
+    def build(target):
+        target.create_resource_flavor(make_flavor("default"))
+        for i in range(3):
+            target.create_cluster_queue(make_cq(
+                f"cq-{i}", rg("cpu", fq("default", cpu=4))))
+            target.create_local_queue(make_lq(
+                f"lq-{i}", "default", cq=f"cq-{i}"))
+
+    def load(target):
+        for i in range(3):
+            target.submit(make_wl(f"fits-{i}", f"lq-{i}", cpu=3,
+                                  creation_time=float(i)))
+            target.submit(make_wl(f"waits-{i}", f"lq-{i}", cpu=3,
+                                  creation_time=float(10 + i)))
+
+    # Uninterrupted single-process reference.
+    fw = Framework(batch_solver=None, config=Configuration(
+        tpu_solver=TPUSolverConfig(enable=False)))
+    fw.create_namespace("default", labels={})
+    build(fw)
+    load(fw)
+    fw.run_until_settled(max_ticks=8)
+    expect = {f"cq-{i}": sorted(fw.cache.cluster_queues[f"cq-{i}"].workloads)
+              for i in range(3)}
+
+    rt = ReplicaRuntime(3, spawn=True, engine="host", state_dir=state)
+    try:
+        build(rt)
+        load(rt)
+        for _ in range(4):
+            rt.tick()
+        before = rt.dump()
+        assert {k: v for k, v in before["admitted"].items()} == expect
+        victim_gid = rt.gmap.cq_group["cq-0"]
+        victim = rt.group_owner[victim_gid]
+        rt.kill_replica(victim)
+        for _ in range(5):
+            rt.tick()
+        after = rt.dump()
+        assert rt.group_owner[victim_gid] != victim
+        assert {k: v for k, v in after["admitted"].items()} == expect
+        # The recovered admissions still hold the quota: every pending
+        # workload must still be waiting (exactly-once, never re-admitted
+        # or double-counted across the takeover).
+        assert all(n == 1 for n in after["pending"].values()), \
+            after["pending"]
+    finally:
+        rt.close()
+
+
+def test_merged_trace_is_valid_chrome_with_flow_events():
+    """The coordinator merges per-process ring dumps into ONE
+    Perfetto-loadable trace: per-pid lanes, process_name metadata, and
+    the reconcile round-trips visible as flow events (replica rtt span
+    -> coordinator round span)."""
+    from kueue_tpu.tracing import TRACER, validate_chrome_trace
+
+    features.set_enabled(features.LENDING_LIMIT, True)
+    ca, cb = _split_pair(2)
+    TRACER.reset()
+    TRACER.configure(enabled=True)
+    try:
+        rt = ReplicaRuntime(2, spawn=False, engine="host")
+        try:
+            _lending_world(rt, ca, cb)
+            rt.submit(make_wl("wa", "lq-a", cpu=8, creation_time=1.0))
+            rt.submit(make_wl("wb", "lq-b", cpu=8, creation_time=2.0))
+            for _ in range(3):
+                rt.tick()
+            doc = rt.export_chrome()
+        finally:
+            rt.close()
+    finally:
+        TRACER.configure(enabled=False)
+        TRACER.reset()
+    assert validate_chrome_trace(doc) == []
+    names = {e["name"] for e in doc["traceEvents"]}
+    assert "reconcile.round" in names
+    assert "admit.reconcile.rtt" in names
+    flows = [e for e in doc["traceEvents"] if e.get("ph") in ("s", "f")]
+    assert flows, "reconcile round-trips must appear as flow events"
+    assert {e["ph"] for e in flows} == {"s", "f"}
+    # Every flow event carries an id binding start to finish.
+    assert all(e.get("id") is not None for e in flows)
+
+
+# -- unit tests --------------------------------------------------------------
+
+
+def test_group_map_split_roots():
+    gm = GroupMap(4)
+    ca, cb = _split_pair(4)
+    gm.note_cohort("hroot", None)
+    gm.note_cohort(ca, "hroot")
+    gm.note_cohort(cb, "hroot")
+    gm.place_cq("cq-a", ca)
+    gm.place_cq("cq-b", cb)
+    gm.place_cq("cq-flat", "flat-1")
+    assert gm.recompute_split() == frozenset({"hroot"})
+    # Flat cohorts hash whole: never split.
+    gm.place_cq("cq-flat2", "flat-1")
+    assert gm.recompute_split() == frozenset({"hroot"})
+    # Stable first-seen placement survives cohort updates.
+    g = gm.cq_group["cq-a"]
+    gm.place_cq("cq-a", cb)
+    assert gm.cq_group["cq-a"] == g
+
+
+def test_group_hash_matches_mesh_hash():
+    """The replica partition key IS the PR 7 cohort hash: the same
+    crc32, the same __solo__ naming, so a cohort's replica and its
+    device-mesh shard derive from one function of its name."""
+    from kueue_tpu.parallel.mesh import _crc_shard
+
+    for name in ("cohort-1", "east", "__solo__/cq-7"):
+        assert group_of(name, 8) == _crc_shard(name, 8)
+    assert group_key("cq-7", None) == "__solo__/cq-7"
+    assert group_key("cq-7", "east") == "east"
+
+
+def test_store_bridge_routes_partitioned_watch_stream():
+    """The partitioned watch stream: a parent apiserver-analog Store
+    drives the replica deployment through ReplicaStoreBridge exactly
+    like direct create_* calls — including MODIFIED (quota edit reaches
+    the owning replica) and DELETED (workload removal) routing."""
+    from kueue_tpu.api.types import FlavorQuotas, ResourceGroup
+    from kueue_tpu.controllers.replica_runtime import ReplicaStoreBridge
+    from kueue_tpu.controllers.store import (
+        KIND_CLUSTER_QUEUE,
+        KIND_LOCAL_QUEUE,
+        KIND_RESOURCE_FLAVOR,
+        KIND_WORKLOAD,
+        Store,
+    )
+
+    rt = ReplicaRuntime(2, spawn=False, engine="host")
+    store = Store()
+    ReplicaStoreBridge(store, rt)
+    try:
+        store.create(KIND_RESOURCE_FLAVOR, make_flavor("default"))
+        for i in range(3):
+            store.create(KIND_CLUSTER_QUEUE, make_cq(
+                f"cq-{i}", rg("cpu", fq("default", cpu=2)),
+                cohort=f"flat-{i}"))
+            store.create(KIND_LOCAL_QUEUE,
+                         make_lq(f"lq-{i}", "default", cq=f"cq-{i}"))
+        for i in range(3):
+            store.create(KIND_WORKLOAD, make_wl(
+                f"small-{i}", f"lq-{i}", cpu=2, creation_time=float(i)))
+            store.create(KIND_WORKLOAD, make_wl(
+                f"big-{i}", f"lq-{i}", cpu=4,
+                creation_time=float(10 + i)))
+        for _ in range(4):
+            rt.tick()
+        dump = rt.dump()
+        # cpu=2 quota: only the small workloads fit, the big ones wait.
+        assert {name: keys for name, keys in dump["admitted"].items()} \
+            == {f"cq-{i}": [f"default/small-{i}"] for i in range(3)}
+        # Quota edit flows as MODIFIED to the owning replica: raise
+        # cq-1 to 8 cpu and its big workload admits.
+        cq1 = make_cq("cq-1", ResourceGroup(
+            covered_resources=("cpu",),
+            flavors=(FlavorQuotas.make("default", cpu=8),)),
+            cohort="flat-1")
+        store.update(KIND_CLUSTER_QUEUE, cq1)
+        for _ in range(4):
+            rt.tick()
+        assert sorted(rt.dump()["admitted"]["cq-1"]) == [
+            "default/big-1", "default/small-1"]
+        # Worker-published status mirrors back into the parent Store
+        # (the GET/watch read surface): the admitted workload shows its
+        # conditions + admission there, and the mirror's MODIFIED echo
+        # must NOT route back (a takeover replay would doubly rebuild).
+        mirrored = store.get(KIND_WORKLOAD, "default/big-1")
+        assert mirrored.has_quota_reservation
+        assert mirrored.admission.cluster_queue == "cq-1"
+        # Workload DELETE routes to the owner and releases the quota.
+        store.delete(KIND_WORKLOAD, "default/small-0")
+        for _ in range(2):
+            rt.tick()
+        assert rt.dump()["admitted"]["cq-0"] == []
+    finally:
+        rt.close()
+
+
+def test_cli_replica_mode_smoke(tmp_path):
+    """`python -m kueue_tpu --replicas 2`: the single-binary CLI runs
+    the manifests through real replica processes (the KUEUE_TPU_REPLICAS
+    / --replicas opt-in) and reports the same admission summary shape;
+    the merged multi-process trace lands at --trace-out."""
+    import json
+    import subprocess
+    import sys
+
+    from kueue_tpu.api import serialization
+    from kueue_tpu.controllers.store import KIND_WORKLOAD
+    from kueue_tpu.tracing import validate_chrome_trace
+
+    wl_path = tmp_path / "workloads.yaml"
+    docs = [serialization.encode(KIND_WORKLOAD, make_wl(
+        f"wl-{i}", "user-queue", cpu=3, creation_time=float(i)))
+        for i in range(3)]
+    wl_path.write_text("\n---\n".join(json.dumps(d) for d in docs))
+    trace_path = tmp_path / "trace.json"
+
+    res = subprocess.run(
+        [sys.executable, "-m", "kueue_tpu", "--replicas", "2",
+         "--objects", "examples/single-clusterqueue-setup.yaml",
+         "--objects", str(wl_path), "--ticks", "5",
+         "--trace-out", str(trace_path)],
+        capture_output=True, text=True, timeout=300,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert res.returncode == 0, res.stderr[-2000:]
+    summary = json.loads(res.stdout.strip().splitlines()[-1])
+    assert summary["replicas"] == 2
+    # 9 cpu quota, three 3-cpu workloads: all admitted.
+    assert summary["clusterQueues"]["cluster-queue"]["admitted"] == 3
+    assert summary["clusterQueues"]["cluster-queue"]["pending"] == 0
+    doc = json.loads(trace_path.read_text())
+    assert validate_chrome_trace(doc) == []
+    assert doc["otherData"]["merged_processes"] >= 1
+
+
+def test_synthetic_cq_filter_slices_union_to_whole():
+    """The per-worker synthetic slice contract: filtered generation
+    draws the identical random stream, so the union of slices equals
+    the unfiltered world object for object."""
+    from kueue_tpu.utils.synthetic import synthetic_objects
+
+    kw = dict(num_cqs=12, num_cohorts=3, num_flavors=4, num_pending=40,
+              usage_fill=0.5, seed=9)
+    _fl, cqs, lqs, admitted, pending, _cs = synthetic_objects(**kw)
+    def sig(w):
+        return (w.name, w.priority,
+                tuple((ps.count, tuple(sorted(ps.requests.items()))
+                       if isinstance(ps.requests, dict) else ())
+                      for ps in w.pod_sets))
+
+    got_cqs, got_lqs, got_adm, got_pend = [], [], [], []
+    for part in range(3):
+        _fl2, c2, l2, a2, p2, _cs2 = synthetic_objects(
+            cq_filter=lambda c: c % 3 == part, **kw)
+        got_cqs += [c.name for c in c2]
+        got_lqs += [lq.name for lq in l2]
+        got_adm += [w.name for w in a2]
+        got_pend += [sig(w) for w in p2]
+    assert sorted(got_cqs) == sorted(c.name for c in cqs)
+    assert sorted(got_lqs) == sorted(lq.name for lq in lqs)
+    assert sorted(got_adm) == sorted(w.name for w in admitted)
+    expect_pend = [sig(w) for w in pending]
+    assert sorted(got_pend) == sorted(expect_pend)
