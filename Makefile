@@ -10,7 +10,7 @@
 PYTHON ?= python
 
 .PHONY: help test test-fast bench bench-smoke trace-smoke multichip-smoke \
-	replica-smoke native lint verify-static install serve dryrun
+	replica-smoke hetero-smoke native lint verify-static install serve dryrun
 
 help:
 	@echo "kueue-tpu developer targets:"
@@ -28,6 +28,8 @@ help:
 	@echo "                      Chrome trace-event export (Perfetto)"
 	@echo "  make multichip-smoke  8-shard cohort-mesh dryrun + sharded"
 	@echo "                      differential goldens on CPU host devices"
+	@echo "  make hetero-smoke   hetero solve-mode gates: churn goldens,"
+	@echo "                      referee identity, smoke-scale bench gain"
 	@echo "  make replica-smoke  3-replica multi-process run on CPU:"
 	@echo "                      spawn-mode identity gate + fail-over"
 	@echo "                      drill + the replica bench config with"
@@ -148,6 +150,36 @@ trace-smoke:
 	  names = {e['name'] for e in doc['traceEvents']}; \
 	  assert 'tick' in names and 'admit' in names, sorted(names); \
 	  print('trace-smoke OK:', len(doc['traceEvents']), 'events')"
+
+# Heterogeneity-aware solve-mode smoke: the default-mode churn goldens
+# (hetero on-but-unprofiled == off, per engine) + kill-switch A/B, the
+# device-vs-referee oracle drives (borrowing + weighted KEP-79), the
+# steady-state zero-dispatch test, then the smoke-scale hetero bench
+# config whose in-process gates assert a measured aggregate-effective-
+# throughput gain over the first-fit twin and a dispatch-free hetero
+# steady window. Runs in CI next to bench-smoke/replica-smoke so the
+# hetero seam cannot rot.
+hetero-smoke:
+	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_hetero.py \
+	  tests/test_engine_coverage.py -q
+	KUEUE_BENCH_SMOKE=1 KUEUE_BENCH_TICKS=10 KUEUE_BENCH_CONFIG=hetero \
+	  JAX_PLATFORMS=cpu $(PYTHON) bench.py > /tmp/kueue-hetero-smoke.jsonl
+	@cat /tmp/kueue-hetero-smoke.jsonl
+	$(PYTHON) -c "import json; \
+	  lines = [json.loads(l) for l in open('/tmp/kueue-hetero-smoke.jsonl') \
+	           if l.strip().startswith('{')]; \
+	  rep = lines[-1]; \
+	  assert rep['metric'] == 'p99_hetero_tick_ms', rep; \
+	  gain = rep.get('throughput_gain_vs_first_fit'); \
+	  assert gain is not None and gain > 1.0, rep; \
+	  steady = rep.get('hetero_steady') or {}; \
+	  assert steady.get('solver_dispatches') == 0, rep; \
+	  assert rep.get('hetero_overrides', 0) > 0, rep; \
+	  util = rep.get('flavor_utilization') or {}; \
+	  assert len(util) == 8, rep; \
+	  print('hetero-smoke OK: gain', gain, \
+	        'overrides', rep['hetero_overrides'], \
+	        'steady dispatches', steady.get('solver_dispatches'))"
 
 # Cohort-mesh smoke on CPU host devices: the 8-shard dryrun (sharded
 # solve bitwise-equal to single-device, hierarchy + lending-clamp probes

@@ -65,7 +65,7 @@ def run_config(*, label, num_cqs, num_cohorts, num_flavors, backlog, ticks,
                usage_fill, depth, preemption_heavy, fair_hierarchy=False,
                lending=False, topology=False, strict_fifo=False,
                no_preemption=False, churn_enabled=True, seed=42,
-               shards=None):
+               shards=None, hetero_cluster=False, hetero_mode=False):
     from kueue_tpu.models.flavor_fit import BatchSolver
     from kueue_tpu.api.types import PodSet, Workload
     from kueue_tpu.utils.synthetic import synthetic_framework
@@ -85,8 +85,9 @@ def run_config(*, label, num_cqs, num_cohorts, num_flavors, backlog, ticks,
         num_pending=backlog, usage_fill=usage_fill, seed=seed,
         preemption_heavy=preemption_heavy, fair_hierarchy=fair_hierarchy,
         lending=lending, topology=topology, strict_fifo=strict_fifo,
-        no_preemption=no_preemption,
-        batch_solver=BatchSolver(shards=shards), pipeline_depth=depth)
+        no_preemption=no_preemption, hetero=hetero_cluster,
+        batch_solver=BatchSolver(shards=shards, hetero=hetero_mode),
+        pipeline_depth=depth)
     t_setup = time.perf_counter() - t0
 
     inject_ms = float(os.environ.get("KUEUE_BENCH_INJECT_MS", "0") or 0)
@@ -147,13 +148,18 @@ def run_config(*, label, num_cqs, num_cohorts, num_flavors, backlog, ticks,
         if topology:
             topo_kw = ({"topology_required": "rack"} if i % 4 == 0
                        else {"topology_preferred": "rack"})
+        tputs = None
+        if hetero_cluster:
+            from kueue_tpu.utils.synthetic import hetero_profile_draw
+            tputs = hetero_profile_draw(rnd, num_flavors)
         fw.submit(Workload(
             name=f"churn-{label}-{i}", namespace="default",
             queue_name=f"lq-{c}", priority=priority,
             creation_time=float(100_000 + i),
             pod_sets=[PodSet.make(
                 "ps0", count=rnd.randint(1, 8), cpu=rnd.randint(1, 8),
-                memory=f"{rnd.randint(1, 16)}Gi", **topo_kw)]))
+                memory=f"{rnd.randint(1, 16)}Gi",
+                flavor_throughputs=tputs, **topo_kw)]))
 
     def churn():
         """Completion flux: finish workloads whose linger expired, then
@@ -258,6 +264,8 @@ def run_config(*, label, num_cqs, num_cohorts, num_flavors, backlog, ticks,
     # Cohort-shard evidence: per-shard head sums / imbalance-ratio sums
     # over the window, plus the reconcile pass's revocation count.
     shard_before = solver.shard_stats() if solver and shards else None
+    hetero_overrides_before = getattr(solver, "hetero_overrides_total", 0) \
+        if solver else 0
     revoked_before = fw.scheduler.metrics.reconcile_revocations
     quiescent_before = fw.scheduler.metrics.quiescent_ticks
     tick_phases = []
@@ -478,7 +486,19 @@ def run_config(*, label, num_cqs, num_cohorts, num_flavors, backlog, ticks,
         # protocol to time).
         "peak_rss_mb": round(rss_peak[0], 1),
         "reconcile_rtt_ms": None,
+        # Heterogeneity evidence, recorded for EVERY config: per-flavor
+        # utilization histogram (primary resource) and the Gavel
+        # objective over the live admitted set — the hetero config gates
+        # its gain over the first-fit twin on these.
+        "flavor_utilization": (solver.flavor_utilization()
+                               if solver is not None else {}),
+        "aggregate_effective_throughput": round(
+            _aggregate_throughput(fw), 2),
     }
+    if hetero_mode and solver is not None:
+        stats["hetero_overrides"] = (solver.hetero_overrides_total
+                                     - hetero_overrides_before)
+        stats["hetero_score_version"] = solver.hetero_version
     if overhead is not None:
         stats["tracer_overhead"] = overhead
     if fair_hierarchy:
@@ -536,6 +556,12 @@ def run_config(*, label, num_cqs, num_cohorts, num_flavors, backlog, ticks,
     return stats
 
 
+def _aggregate_throughput(fw) -> float:
+    from kueue_tpu.hetero.profile import aggregate_effective_throughput
+
+    return aggregate_effective_throughput(fw.cache)
+
+
 METRIC_NAMES = {
     "single": "p99_single_cq_tick_ms",
     "cohortlend": "p99_cohort_lending_tick_ms",
@@ -545,6 +571,7 @@ METRIC_NAMES = {
     "steady": "p99_steady_state_tick_ms",
     "shard": "p99_sharded_tick_ms",
     "replica": "p99_replica_tick_ms",
+    "hetero": "p99_hetero_tick_ms",
     "northstar": "p99_e2e_tick_ms",
 }
 
@@ -1074,6 +1101,65 @@ def run_one(config: str) -> None:
                 "the cohort-sharded solve is not absorbing the scale "
                 "axis it exists for.")
         emit(METRIC_NAMES[config], s_large)
+    elif config == "hetero":
+        # Heterogeneity-aware solve mode (ROADMAP item 2, Gavel-style):
+        # a synthetic 8-flavor heterogeneous cluster (speed-class ladder
+        # 1.0..4.5, per-workload speedup profiles, ClusterQueues listing
+        # flavors SLOWEST FIRST — the regime where ordered first-fit
+        # burns 2-3x aggregate throughput per Gavel). Three windows in
+        # one process: the first-fit TWIN (same cluster, mode off), the
+        # hetero window (mode on — gated to beat the twin's aggregate
+        # effective throughput), and a churn-free hetero STEADY window
+        # (run_config's in-window assertion proves a hetero steady
+        # state dispatches zero solves).
+        h_shape = dict(shape)
+        h_shape["num_flavors"] = 8
+        w_ticks = max(ticks // 2, 8)
+        ff = run_config(
+            label="hetero_firstfit", ticks=w_ticks, usage_fill=0.3,
+            depth=depth, preemption_heavy=False, hetero_cluster=True,
+            hetero_mode=False, **h_shape)
+        stats = run_config(
+            label="hetero", ticks=w_ticks, usage_fill=0.3,
+            depth=depth, preemption_heavy=False, hetero_cluster=True,
+            hetero_mode=True, **h_shape)
+        steady = run_config(
+            label="hetero_steady", ticks=w_ticks, usage_fill=1.0,
+            depth=depth, preemption_heavy=False, strict_fifo=True,
+            no_preemption=True, churn_enabled=False,
+            hetero_cluster=True, hetero_mode=True, **h_shape)
+        agg_h = stats["aggregate_effective_throughput"]
+        agg_ff = ff["aggregate_effective_throughput"]
+        gain = (agg_h / agg_ff) if agg_ff else None
+        stats.update({
+            "throughput_gain_vs_first_fit": (round(gain, 3)
+                                             if gain is not None else None),
+            "first_fit_twin": {
+                "p50_ms": ff["p50_ms"], "p99_ms": ff["p99_ms"],
+                "aggregate_effective_throughput": agg_ff,
+                "flavor_utilization": ff["flavor_utilization"]},
+            "hetero_steady": {
+                "p50_ms": steady["p50_ms"], "p99_ms": steady["p99_ms"],
+                "solver_dispatches": steady["solver_dispatches"],
+                "quiescent_tick_ms": steady["quiescent_tick_ms"],
+                "quiescent_ticks_replayed":
+                    steady["quiescent_ticks_replayed"]},
+        })
+        # The headline gate: measured aggregate-effective-throughput
+        # gain over the first-fit twin on the 8-flavor cluster.
+        if gain is None or gain <= 1.0:
+            raise RuntimeError(
+                f"[hetero] no throughput gain over the first-fit twin: "
+                f"aggregate {agg_h} vs {agg_ff} (gain "
+                f"{gain if gain is not None else 'n/a'}) — the hetero "
+                "solve mode is not steering workloads to their faster "
+                "flavors.")
+        if steady["solver_dispatches"]:
+            raise RuntimeError(
+                "[hetero] the hetero steady window dispatched solves — "
+                "the score-matrix version is invalidating fingerprints "
+                "spuriously.")
+        emit(METRIC_NAMES[config], stats)
     elif config == "replica":
         # Multi-process replica scheduler (ROADMAP item 1, the process
         # era): N spawn-mode worker processes each owning its shard
@@ -1178,7 +1264,7 @@ def main() -> None:
               "backend for this run", file=sys.stderr)
         env_extra["KUEUE_BENCH_FORCE_CPU"] = "1"
     for config in ("single", "cohortlend", "preempt", "fair", "topo",
-                   "steady", "shard", "replica", "northstar"):
+                   "steady", "shard", "hetero", "replica", "northstar"):
         env = dict(os.environ, KUEUE_BENCH_CONFIG=config, **env_extra)
         # Generous ceiling: a healthy config finishes in minutes; a
         # device attachment dying MID-RUN (after the probe passed)

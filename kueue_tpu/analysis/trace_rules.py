@@ -224,6 +224,87 @@ def _build_cohort_shard(w: int):
     return fn, args
 
 
+def _build_flavor_fit_hier(w: int):
+    """solve_core with the KEP-79 cohort-forest pytree: the ancestor-path
+    T-invariant walk is a materially different jaxpr from the flat-pool
+    arithmetic, so it gets its own roster entry (the carried-over "hier
+    solve_core in the trace roster" ROADMAP item)."""
+    import functools
+
+    import numpy as np
+
+    import kueue_tpu.ops  # noqa: F401
+    from kueue_tpu.models.flavor_fit import solve_core
+
+    import jax.numpy as jnp
+
+    C, F, R, G, S, K, P, K2, D = 4, 4, 3, 2, 2, 3, 2, 3, 2
+    z64 = lambda s: np.zeros(s, np.int64)  # noqa: E731
+    z32 = lambda s: np.zeros(s, np.int32)  # noqa: E731
+    zb = lambda s: np.zeros(s, bool)  # noqa: E731
+    # One tree level (node 1,2 -> parent 0), every CQ hierarchical. The
+    # forest rides in as closure constants (like device_static's pytree),
+    # so the tensors must be jax arrays — tracers index them.
+    hier = tuple(jnp.asarray(x) for x in (
+        z64((K2, F, R)), z64((K2, F, R)), z64((K2, F, R)),
+        z32(C), z64((C, F, R)), np.ones(C, bool),
+        np.zeros((C, D), np.int32))) + (
+        ((jnp.asarray(np.array([1, 2], np.int32)),
+          jnp.asarray(np.array([0, 0], np.int32))),),)
+    args = (z64((C, F, R)), z64((C, F, R)), z64((C, F, R)), z64((C, F, R)),
+            z64((K, F, R)), z64((K, F, R)), z32(C),
+            z32((C, R)), z32((C, G, S)), z32((C, G)),
+            zb(C), zb(C), zb(C),
+            z32(w), z64((w, P, R)), zb((w, P, R)),
+            zb((w, P)), zb((w, P)), zb((w, P, G, S)), z32((w, P, G)))
+    fn = functools.partial(solve_core, num_slots=S, hier=hier)
+    return fn, args
+
+
+def _build_flavor_fit_hetero(w: int):
+    """solve_core with the hetero score override (the `hetero` solve
+    mode's rounding jaxpr — argmax over FIT slots plus the first-fit
+    twin output)."""
+    import functools
+
+    import numpy as np
+
+    import kueue_tpu.ops  # noqa: F401
+    from kueue_tpu.models.flavor_fit import solve_core
+
+    C, F, R, G, S, K, P = 4, 4, 3, 2, 2, 3, 2
+    z64 = lambda s: np.zeros(s, np.int64)  # noqa: E731
+    z32 = lambda s: np.zeros(s, np.int32)  # noqa: E731
+    zb = lambda s: np.zeros(s, bool)  # noqa: E731
+    args = (z64((C, F, R)), z64((C, F, R)), z64((C, F, R)), z64((C, F, R)),
+            z64((K, F, R)), z64((K, F, R)), z32(C),
+            z32((C, R)), z32((C, G, S)), z32((C, G)),
+            zb(C), zb(C), zb(C),
+            z32(w), z64((w, P, R)), zb((w, P, R)),
+            zb((w, P)), zb((w, P)), zb((w, P, G, S)), z32((w, P, G)),
+            (z64((w, F)), zb(w)))
+    fn = functools.partial(
+        lambda *a, hetero=None, **kw: solve_core(
+            *a[:-1], hetero=a[-1], **kw), num_slots=S)
+    return fn, args
+
+
+def _build_hetero_scores(n: int):
+    """The Gavel projected dual iteration (kueue_tpu/hetero/solve.py)."""
+    import functools
+
+    import numpy as np
+
+    import kueue_tpu.ops  # noqa: F401
+    from kueue_tpu.hetero.solve import hetero_scores_core
+
+    F = 8
+    args = (np.zeros((n, F), np.int64), np.zeros(n, np.int64),
+            np.zeros(n, bool), np.zeros(F, np.int64))
+    fn = functools.partial(hetero_scores_core, iters=4)
+    return fn, args
+
+
 def _build_topology(n: int):
     import functools
 
@@ -276,6 +357,24 @@ def package_roster() -> List[KernelSpec]:
             name="flavor-fit-packed",
             anchor=_module_file("kueue_tpu.models.flavor_fit"),
             build=_build_flavor_fit_packed, buckets=(8, 16),
+            rules=NO_TRC02),
+        KernelSpec(
+            name="flavor-fit-hier",
+            anchor=_module_file("kueue_tpu.models.flavor_fit"),
+            build=_build_flavor_fit_hier, buckets=(8, 16),
+            seeds={1: sentinel}),
+        KernelSpec(
+            # The hetero solve mode's rounding variant of solve_core
+            # (score argmax over FIT slots + the first-fit twin output).
+            name="flavor-fit-hetero",
+            anchor=_module_file("kueue_tpu.models.flavor_fit"),
+            build=_build_flavor_fit_hetero, buckets=(8, 16),
+            seeds={1: sentinel}),
+        KernelSpec(
+            # The Gavel score iteration (all-integer dual tatonnement).
+            name="hetero-scores",
+            anchor=_module_file("kueue_tpu.hetero.solve"),
+            build=_build_hetero_scores, buckets=(8, 16),
             rules=NO_TRC02),
         KernelSpec(
             # The cohort-sharded per-shard body (parallel/mesh): one

@@ -143,15 +143,44 @@ def _topology_spec(doc: Optional[Mapping[str, Any]]):
                      for l in doc.get("leaves") or ()))
 
 
+def _throughput_value(raw: Any, path: str) -> float:
+    """Decoder hardening for throughput numbers (hetero scheduling): a
+    NaN/inf/negative value would poison the dense score matrix (every
+    comparison against NaN is False — the solve would silently fall back
+    to slot 0), so malformed manifests are rejected at the boundary."""
+    import math
+    try:
+        val = float(raw)
+    except (TypeError, ValueError):
+        raise DecodeError(f"{path}: throughput {raw!r} is not a number")
+    if math.isnan(val) or math.isinf(val) or val < 0:
+        raise DecodeError(
+            f"{path}: throughput must be a finite non-negative number, "
+            f"got {raw!r}")
+    return val
+
+
 def decode_resource_flavor(doc: Mapping[str, Any]) -> ResourceFlavor:
     name, _ = _meta(doc)
     spec = doc.get("spec") or {}
+    speed = spec.get("speedClass")
+    if speed is not None:
+        # Stricter than the per-podset rule (where 0 means "cannot run
+        # here"): a flavor-wide speed class of 0 would mark every
+        # workload profiled and every slot unrunnable — the webhook
+        # requires > 0, and the decoder enforces the same so manifests
+        # that bypass the webhook (store sync, bench) cannot differ.
+        speed = _throughput_value(speed, "spec.speedClass")
+        if speed == 0:
+            raise DecodeError(
+                "spec.speedClass: must be a finite positive number, got 0")
     return ResourceFlavor.make(
         name,
         node_labels=spec.get("nodeLabels"),
         node_taints=_taints(spec.get("nodeTaints")),
         tolerations=_tolerations(spec.get("tolerations")),
-        topology=_topology_spec(spec.get("topologySpec")))
+        topology=_topology_spec(spec.get("topologySpec")),
+        speed_class=1.0 if speed is None else speed)
 
 
 def _flavor_quotas(doc: Mapping[str, Any]) -> FlavorQuotas:
@@ -257,6 +286,12 @@ def decode_workload(doc: Mapping[str, Any]) -> Workload:
             tolerations=_tolerations(ps_spec.get("tolerations")),
             topology_required=topo_req.get("required"),
             topology_preferred=topo_req.get("preferred"),
+            flavor_throughputs=tuple(sorted(
+                (fname,
+                 _throughput_value(
+                     v, f"spec.podSets[{ps.get('name', 'main')}]"
+                        f".flavorThroughputs[{fname}]"))
+                for fname, v in (ps.get("flavorThroughputs") or {}).items())),
             template=template))
     return Workload(
         name=name, namespace=namespace,
@@ -383,6 +418,8 @@ def encode_resource_flavor(rf: ResourceFlavor) -> Dict[str, Any]:
             "leaves": [{"path": list(leaf.path), "capacity": leaf.capacity}
                        for leaf in rf.topology.leaves],
         }
+    if rf.speed_class != 1.0:
+        spec["speedClass"] = rf.speed_class
     return {
         "apiVersion": API_VERSION, "kind": "ResourceFlavor",
         "metadata": {"name": rf.name},
@@ -501,6 +538,8 @@ def _encode_pod_set(ps: PodSet) -> Dict[str, Any]:
         out["topologyRequest"] = {"required": ps.topology_required}
     elif ps.topology_preferred is not None:
         out["topologyRequest"] = {"preferred": ps.topology_preferred}
+    if ps.flavor_throughputs:
+        out["flavorThroughputs"] = dict(ps.flavor_throughputs)
     return out
 
 

@@ -270,18 +270,26 @@ class ResourceFlavor:
     # Optional placement hierarchy; None = topology-blind flavor (every
     # existing code path is then byte-identical to the pre-topology build).
     topology: Optional[TopologySpec] = None
+    # Relative accelerator speed of this flavor (heterogeneity-aware
+    # scheduling, kueue_tpu/hetero): the default throughput a workload
+    # gets on this flavor when it declares no per-flavor number of its
+    # own. 1.0 (the default) on every flavor means a homogeneous cluster
+    # — the hetero solve mode is then a provable no-op.
+    speed_class: float = 1.0
 
     @staticmethod
     def make(name: str, node_labels: Optional[Mapping[str, str]] = None,
              node_taints: Sequence[Taint] = (),
              tolerations: Sequence[Toleration] = (),
-             topology: Optional[TopologySpec] = None) -> "ResourceFlavor":
+             topology: Optional[TopologySpec] = None,
+             speed_class: float = 1.0) -> "ResourceFlavor":
         return ResourceFlavor(
             name=name,
             node_labels=tuple(sorted((node_labels or {}).items())),
             node_taints=tuple(node_taints),
             tolerations=tuple(tolerations),
             topology=topology,
+            speed_class=speed_class,
         )
 
     @property
@@ -443,6 +451,12 @@ class PodSet:
     # unconstrained placement (`topology_preferred`). At most one is set.
     topology_required: Optional[str] = None
     topology_preferred: Optional[str] = None
+    # Heterogeneity-aware scheduling (kueue_tpu/hetero): relative
+    # throughput of THIS pod set per flavor name — "these pods run at
+    # 4.0x the reference speed on flavor B". Flavors not listed fall
+    # back to the flavor's `speed_class`. Sorted (flavor, value) pairs
+    # so the spec stays hashable for memo keys.
+    flavor_throughputs: Tuple[Tuple[str, float], ...] = ()
     # Optional full template; when set, `requests` is derived from it by
     # workload.adjust_resources (pkg/workload/resources.go).
     template: Optional[PodTemplate] = None
@@ -454,6 +468,7 @@ class PodSet:
              tolerations: Sequence[Toleration] = (),
              topology_required: Optional[str] = None,
              topology_preferred: Optional[str] = None,
+             flavor_throughputs: Optional[Mapping[str, float]] = None,
              **requests: Quantity) -> "PodSet":
         reqs = {r.replace("_", "-"): resource_value(r.replace("_", "-"), q)
                 for r, q in requests.items()}
@@ -464,6 +479,8 @@ class PodSet:
             tolerations=tuple(tolerations),
             topology_required=topology_required,
             topology_preferred=topology_preferred,
+            flavor_throughputs=tuple(
+                sorted((flavor_throughputs or {}).items())),
         )
 
 

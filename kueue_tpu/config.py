@@ -152,6 +152,11 @@ class TPUSolverConfig:
     # 0/1 = single-device; -1 = all visible devices. Kill switch:
     # KUEUE_TPU_NO_SHARD=1.
     cohort_shards: int = 0
+    # Flavor-assignment solve mode (solver/modes.SOLVE_MODES): "default"
+    # = the reference's ordered first-fit; "hetero" = Gavel-style
+    # max-effective-throughput scoring over the same quota constraints
+    # (kueue_tpu/hetero). Kill switch: KUEUE_TPU_NO_HETERO=1.
+    mode: str = "default"
 
 
 @dataclass(frozen=True)
@@ -340,7 +345,8 @@ def from_dict(doc: Mapping[str, Any]) -> Configuration:
             pipeline_depth=int(t.get("pipelineDepth", 1)),
             preemption_engine=t.get("preemptionEngine"),
             shard_devices=int(t.get("shardDevices", 0)),
-            cohort_shards=int(t.get("cohortShards", 0)))
+            cohort_shards=int(t.get("cohortShards", 0)),
+            mode=t.get("mode") or "default")
 
     mc = MetricsConfig()
     if isinstance(doc.get("metrics"), dict):
@@ -497,6 +503,20 @@ def validate_configuration(cfg: Configuration) -> List[str]:
             and cfg.tpu_solver.shard_devices not in (0, 1):
         errors.append("tpuSolver.cohortShards and tpuSolver.shardDevices "
                       "are mutually exclusive sharding modes")
+    # Solve mode: only REGISTERED modes pass (solver/modes.SOLVE_MODES —
+    # the registry the kueueverify roster and the coverage meta-test are
+    # pinned to), so a typo'd or unregistered mode fails at config load,
+    # not silently at the first tick.
+    from kueue_tpu.solver.modes import solve_mode_names
+    if cfg.tpu_solver.mode not in solve_mode_names():
+        errors.append(
+            f"tpuSolver.mode: unknown solve mode {cfg.tpu_solver.mode!r} "
+            f"(registered modes: {', '.join(solve_mode_names())})")
+    if cfg.tpu_solver.mode == "hetero" \
+            and cfg.tpu_solver.shard_devices not in (0, 1):
+        errors.append("tpuSolver.mode: hetero runs single-device or over "
+                      "cohortShards — shardDevices is not a supported "
+                      "combination")
 
     # leaderElection
     le = cfg.leader_election

@@ -129,7 +129,12 @@ class Framework:
                     mesh = make_mesh(None if shard == -1 else shard)
                 batch_solver = BatchSolver(
                     mesh=mesh,
-                    shards=self.config.tpu_solver.cohort_shards)
+                    shards=self.config.tpu_solver.cohort_shards,
+                    # None (not False) when the config doesn't select
+                    # the mode, so the KUEUE_TPU_HETERO=1 env default
+                    # still works on a default-config deployment.
+                    hetero=(True if self.config.tpu_solver.mode == "hetero"
+                            else None))
         if getattr(batch_solver, "_mesh", None) is not None:
             # The sharded program runs to completion at dispatch (its
             # collectives ride ICI; there is no host-link round trip to

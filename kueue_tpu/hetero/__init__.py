@@ -1,0 +1,35 @@
+"""Heterogeneity-aware flavor scoring (the `hetero` solve mode).
+
+Gavel-style max-effective-throughput flavor assignment (arxiv
+2008.09213) over the existing quota/borrowing constraints:
+
+  profile.py  ThroughputProfileStore — the [N,F] fixed-point throughput
+              matrix over the pending backlog, fed by the same queue
+              dirty events as the WorkloadArena, plus the per-flavor
+              speed-class defaults and the bench's aggregate metric.
+  solve.py    The Gavel LP relaxation as a jit dense projected dual
+              iteration (all-integer — the numpy referee twin is
+              bitwise identical), plus the per-flavor capacity proxy.
+  referee.py  The sequential host oracle the batched device solve is
+              pinned decision-identical to.
+
+Selected via `tpuSolver.mode: hetero` (kill switch
+KUEUE_TPU_NO_HETERO=1); with the mode off — or on with no profiled
+workload and a homogeneous speed-class vocabulary — every decision is
+byte-identical to the default first-fit mode.
+"""
+
+from kueue_tpu.hetero.profile import (  # noqa: F401
+    ThroughputProfileStore,
+    aggregate_effective_throughput,
+    speed_vector,
+    workload_throughputs,
+)
+from kueue_tpu.hetero.solve import (  # noqa: F401
+    DEFAULT_ITERS,
+    SCORE_SCALE,
+    flavor_capacity,
+    hetero_scores,
+    hetero_scores_core,
+    hetero_scores_np,
+)

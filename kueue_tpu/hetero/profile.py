@@ -1,0 +1,238 @@
+"""Per-workload x per-flavor throughput profiles (the hetero model side).
+
+The `ThroughputProfileStore` is the hetero twin of the WorkloadArena
+(solver/schema.py): one pooled row per pending workload holding its
+fixed-point [F] throughput vector, aligned to the solver's CQ-encoding
+generation (the F axis is the encoding's flavor vocabulary; the store is
+rebuilt on every encoding rotation) and fed by the SAME queue-manager
+dirty events — `note` on add/update, `forget` on delete — so the matrix
+is fresh before the tick without any per-tick backlog walk.
+
+Throughput semantics (the spec both the device kernel and the sequential
+referee implement):
+
+  * a flavor's baseline is its `ResourceFlavor.speed_class` (1.0 when
+    unset — a homogeneous cluster);
+  * a pod set may override per flavor via `PodSet.flavor_throughputs`;
+    when several pod sets of one workload override the same flavor the
+    MINIMUM wins (synchronous pods run at the slowest member's pace);
+  * a value of 0 means "cannot run on this flavor" (the hetero choice
+    never picks it; quota feasibility is unaffected);
+  * a workload is PROFILED when any pod set carries an override or any
+    flavor in the vocabulary declares a non-default speed class.
+    Unprofiled workloads keep the default first-fit decision byte for
+    byte — hetero-on-but-unprofiled is a provable no-op.
+
+`generation` bumps on any row-content change; the BatchSolver keys its
+score refresh and the nominate fingerprints on it (plus the global
+usage generation), so a hetero steady state still replays every cached
+verdict and dispatches zero solves.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from kueue_tpu.hetero.solve import SCORE_SCALE
+
+
+def workload_throughputs(pod_sets, speed_q: np.ndarray,
+                         flavor_index: Dict[str, int]) -> np.ndarray:
+    """[F] i64 fixed-point throughput row for one workload's pod sets —
+    the ONE home of the min-over-overriding-podsets rule, shared by the
+    store, the sequential referee and the bench's aggregate metric.
+    A reference to a flavor outside the current vocabulary falls back
+    to that slot's speed-class default (the webhook rejects MALFORMED
+    names, but a well-formed name matching no live flavor — a typo, or
+    a flavor created later — cannot be scored and is deliberately
+    inert rather than fatal)."""
+    row = speed_q.copy()
+    seen: Dict[int, int] = {}
+    for ps in pod_sets:
+        for fname, val in getattr(ps, "flavor_throughputs", ()):
+            fi = flavor_index.get(fname)
+            if fi is None:
+                continue
+            q = int(round(float(val) * SCORE_SCALE))
+            prev = seen.get(fi)
+            seen[fi] = q if prev is None else min(prev, q)
+    for fi, q in seen.items():
+        row[fi] = q
+    return row
+
+
+def speed_vector(flavor_names: Sequence[str],
+                 resource_flavors: Dict[str, "ResourceFlavor"],
+                 ) -> np.ndarray:
+    """[F] i64 fixed-point speed-class defaults in encoding flavor
+    order (1.0 for flavors missing from the live set)."""
+    out = np.empty(len(flavor_names), dtype=np.int64)
+    for fi, name in enumerate(flavor_names):
+        rf = resource_flavors.get(name)
+        sc = rf.speed_class if rf is not None else 1.0
+        out[fi] = int(round(float(sc) * SCORE_SCALE))
+    return out
+
+
+class ThroughputProfileStore:
+    """Dense [capacity, F] fixed-point throughput matrix over the
+    pending backlog, plus per-row primary-resource demand and the
+    profiled mask — the score kernel's inputs."""
+
+    def __init__(self, enc, resource_flavors: Dict[str, "ResourceFlavor"],
+                 capacity: int = 1024):
+        F = len(enc.flavor_names)
+        self.enc = enc
+        self.flavor_index = enc.flavor_index
+        self.primary_resource = enc.resource_names[0] \
+            if enc.resource_names else ""
+        self.speed_q = speed_vector(enc.flavor_names, resource_flavors)
+        self.speed_hetero = bool((self.speed_q != SCORE_SCALE).any())
+        self.capacity = capacity
+        self.tput = np.tile(self.speed_q, (capacity, 1))
+        self.demand = np.zeros(capacity, dtype=np.int64)
+        self.profiled = np.zeros(capacity, dtype=bool)
+        self.valid = np.zeros(capacity, dtype=bool)
+        self._row_of: Dict[str, int] = {}
+        self._free: List[int] = list(range(capacity - 1, -1, -1))
+        self.generation = 0
+
+    # -- row encoding -------------------------------------------------------
+
+    def _encode(self, wi) -> Tuple[np.ndarray, int, bool]:
+        wl = wi.obj
+        row = workload_throughputs(wl.pod_sets, self.speed_q,
+                                   self.flavor_index)
+        demand = 0
+        for ps in wi.total_requests:
+            demand += int(ps.requests.get(self.primary_resource, 0))
+        has_override = any(getattr(ps, "flavor_throughputs", ())
+                           for ps in wl.pod_sets)
+        profiled = has_override or self.speed_hetero
+        return row, max(demand, 1), profiled
+
+    def _grow(self) -> None:
+        old = self.capacity
+        new = old * 2
+        self.tput = np.concatenate(
+            [self.tput, np.tile(self.speed_q, (old, 1))], axis=0)
+        self.demand = np.concatenate(
+            [self.demand, np.zeros(old, dtype=np.int64)])
+        self.profiled = np.concatenate(
+            [self.profiled, np.zeros(old, dtype=bool)])
+        self.valid = np.concatenate(
+            [self.valid, np.zeros(old, dtype=bool)])
+        self._free.extend(range(new - 1, old - 1, -1))
+        self.capacity = new
+        self.generation += 1
+
+    # -- dirty-event sink (same protocol as the WorkloadArena) --------------
+
+    def note(self, wi) -> int:
+        """(Re-)encode one pending workload's row; returns the row index.
+        Bumps `generation` exactly when the stored content changes."""
+        uid = wi.obj.uid
+        row_new, demand, profiled = self._encode(wi)
+        ri = self._row_of.get(uid)
+        if ri is None:
+            if not self._free:
+                self._grow()
+            ri = self._free.pop()
+            self._row_of[uid] = ri
+            self.valid[ri] = True
+            self.tput[ri] = row_new
+            self.demand[ri] = demand
+            self.profiled[ri] = profiled
+            self.generation += 1
+            return ri
+        if (self.demand[ri] != demand or self.profiled[ri] != profiled
+                or not np.array_equal(self.tput[ri], row_new)):
+            self.tput[ri] = row_new
+            self.demand[ri] = demand
+            self.profiled[ri] = profiled
+            self.generation += 1
+        return ri
+
+    def forget(self, uid: str) -> None:
+        ri = self._row_of.pop(uid, None)
+        if ri is None:
+            return
+        self.valid[ri] = False
+        self.profiled[ri] = False
+        self.tput[ri] = self.speed_q
+        self.demand[ri] = 0
+        self._free.append(ri)
+        self.generation += 1
+
+    def seed(self, infos) -> None:
+        """Whole-backlog (re-)seed on encoding rotation — off the
+        measured path, like WorkloadArena.seed."""
+        for wi in infos:
+            self.note(wi)
+
+    # -- readers ------------------------------------------------------------
+
+    def rows_for(self, workloads) -> np.ndarray:
+        """[n] i64 row indices, encoding any uid the sink events missed
+        (a workload submitted before the solver bound its queues)."""
+        out = np.empty(len(workloads), dtype=np.int64)
+        row_of = self._row_of
+        for i, wi in enumerate(workloads):
+            ri = row_of.get(wi.obj.uid)
+            if ri is None:
+                ri = self.note(wi)
+            out[i] = ri
+        return out
+
+    def any_profiled(self) -> bool:
+        return bool((self.profiled & self.valid).any())
+
+    def active_mask(self) -> np.ndarray:
+        return self.profiled & self.valid
+
+    def throughput_of(self, row: int, fi: int) -> float:
+        return float(self.tput[row, fi]) / SCORE_SCALE
+
+
+def aggregate_effective_throughput(
+        cache, resource_flavors: Optional[Dict[str, "ResourceFlavor"]] = None,
+        ) -> float:
+    """Sum over currently-admitted workloads of their relative throughput
+    on the flavor they were ASSIGNED — Gavel's objective, measured on the
+    live admitted set (the bench records it for every config and gates
+    the hetero config's gain over its first-fit twin).
+
+    A workload's factor is min over pod sets of the assigned flavor's
+    throughput (override if declared, flavor speed class otherwise) — the
+    same rule as `workload_throughputs`, read through the Admission's
+    pod-set assignments."""
+    flavors = resource_flavors if resource_flavors is not None \
+        else cache.resource_flavors
+    speed = {name: float(rf.speed_class) for name, rf in flavors.items()}
+    total = 0.0
+    for cq in cache.cluster_queues.values():
+        for wi in cq.workloads.values():
+            wl = wi.obj
+            adm = wl.admission
+            if adm is None:
+                continue
+            by_name = {ps.name: ps for ps in wl.pod_sets}
+            factor = None
+            for psa in adm.pod_set_assignments:
+                fnames = set(psa.flavors.values())
+                if not fnames:
+                    continue
+                ps = by_name.get(psa.name)
+                overrides = dict(getattr(ps, "flavor_throughputs", ())) \
+                    if ps is not None else {}
+                # A pod set split across flavors runs at its slowest part.
+                ps_factor = min(
+                    float(overrides.get(f, speed.get(f, 1.0)))
+                    for f in fnames)
+                factor = ps_factor if factor is None \
+                    else min(factor, ps_factor)
+            if factor is not None:
+                total += factor
+    return total

@@ -1,0 +1,226 @@
+"""Sequential host referee for the hetero solve mode (the pinned oracle).
+
+Mirrors the device kernel's heterogeneity-aware flavor choice (models/
+flavor_fit.solve_core with `hetero=`) one workload at a time against the
+same snapshot, reusing the reference referee's quota primitives
+(solver/referee._fits_resource_quota, flavor_eligible) verbatim:
+
+  * the DEFAULT walk (resume slot, eligibility, fungibility stop rule,
+    tried-flavor bookkeeping) runs exactly as in the reference referee —
+    including which reasons accumulate and where the walk would stop;
+  * the walk then CONTINUES past the default stop to enumerate every
+    currently-FIT slot, and when the workload is profiled the slot with
+    the maximum effective score wins (ties to the earliest slot — the
+    kernel's argmax-first-occurrence);
+  * when nothing fits, or the workload is unprofiled, the default result
+    is returned byte for byte.
+
+tests/test_hetero.py pins the batched device solve decision-identical to
+this referee on weighted / borrowing / KEP-79 scenarios, and
+`KUEUE_TPU_DEBUG_HETERO=1` re-runs the comparison inside every tick.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from kueue_tpu import features
+from kueue_tpu.core.cache import CachedClusterQueue
+from kueue_tpu.core.workload import AssignmentClusterQueueState, WorkloadInfo
+from kueue_tpu.solver.eligibility import flavor_eligible
+from kueue_tpu.solver.modes import FIT, NO_FIT
+from kueue_tpu.solver.referee import (
+    Assignment,
+    FlavorAssignment,
+    PodSetAssignmentResult,
+    _append_podset,
+    _fits_resource_quota,
+    _last_assignment_outdated,
+    _should_try_next_flavor,
+)
+from kueue_tpu.hetero.solve import NEG_SCORE
+
+PODS_RESOURCE = "pods"
+
+
+def hetero_assign_flavors(wi: WorkloadInfo, cq: CachedClusterQueue,
+                          resource_flavors: Dict[str, "ResourceFlavor"],
+                          score_row: np.ndarray,
+                          flavor_index: Dict[str, int],
+                          profiled: bool,
+                          counts: Optional[List[int]] = None) -> Assignment:
+    """The hetero twin of solver/referee.assign_flavors: identical outer
+    structure (podset loop, usage carry, resume-state stamping), with the
+    per-group flavor search swapped for the score-aware walk."""
+    if wi.last_assignment is not None and _last_assignment_outdated(wi, cq):
+        wi.last_assignment = None
+
+    if counts is None:
+        requests = wi.total_requests
+    else:
+        requests = [wi.total_requests[i].scaled_to(c)
+                    for i, c in enumerate(counts)]
+
+    assignment = Assignment(
+        usage={},
+        last_state=AssignmentClusterQueueState(
+            cluster_queue_generation=cq.allocatable_generation,
+            cohort_generation=(cq.cohort.allocatable_generation
+                               if cq.cohort is not None else 0),
+        ),
+    )
+
+    for ps_idx, podset in enumerate(requests):
+        ps_requests = dict(podset.requests)
+        if PODS_RESOURCE in cq.rg_by_resource:
+            ps_requests[PODS_RESOURCE] = podset.count
+
+        psa = PodSetAssignmentResult(
+            name=podset.name, requests=ps_requests, count=podset.count)
+
+        for res_name in ps_requests:
+            if res_name in psa.flavors:
+                continue
+            flavors, reasons, error = _find_flavor_hetero(
+                wi, cq, resource_flavors, ps_idx, ps_requests, res_name,
+                assignment.usage, score_row, flavor_index, profiled)
+            if error is not None or not flavors:
+                psa.flavors = {}
+                psa.reasons = reasons
+                psa.error = error
+                break
+            psa.flavors.update(flavors)
+            psa.reasons.extend(reasons)
+
+        _append_podset(assignment, ps_requests, psa)
+        if psa.error is not None or (ps_requests and not psa.flavors):
+            break
+    return assignment
+
+
+def _find_flavor_hetero(
+        wi: WorkloadInfo, cq: CachedClusterQueue,
+        resource_flavors: Dict[str, "ResourceFlavor"],
+        ps_idx: int, requests: Dict[str, int], res_name: str,
+        assignment_usage, score_row: np.ndarray,
+        flavor_index: Dict[str, int], profiled: bool,
+) -> Tuple[Dict[str, FlavorAssignment], List[str], Optional[str]]:
+    """One resource group's search: the reference walk's bookkeeping up
+    to its stop slot, a full continuation to enumerate FIT slots, then
+    the score argmax."""
+    rg = cq.rg_by_resource.get(res_name)
+    if rg is None:
+        return {}, [f"resource {res_name} unavailable in ClusterQueue"], None
+
+    grouped = {r: v for r, v in requests.items()
+               if r in rg.covered_resources}
+    podset = wi.obj.pod_sets[ps_idx]
+    allowed_keys = cq.label_keys(rg, resource_flavors)
+    fungibility = features.enabled(features.FLAVOR_FUNGIBILITY)
+
+    idx0 = 0
+    if wi.last_assignment is not None:
+        idx0 = wi.last_assignment.next_flavor_to_try(ps_idx, res_name)
+    num_flavors = len(rg.flavors)
+
+    # Default-walk state (frozen the moment the default walk would stop).
+    reasons: List[str] = []
+    best_assignment: Dict[str, FlavorAssignment] = {}
+    best_mode = NO_FIT
+    assigned_flavor_idx = -1
+    stopped = False
+    # Every currently-FIT slot from the resume point on, walk order.
+    fit_slots: List[Tuple[int, Dict[str, FlavorAssignment]]] = []
+
+    for idx in range(idx0, num_flavors):
+        fq = rg.flavors[idx]
+        flavor = resource_flavors.get(fq.name)
+        if flavor is None:
+            if not stopped:
+                reasons.append(f"flavor {fq.name} not found")
+            continue
+        ok, why = flavor_eligible(podset, flavor, allowed_keys)
+        if not ok:
+            if not stopped:
+                reasons.append(why)
+            continue
+
+        needs_borrowing = False
+        assignments: Dict[str, FlavorAssignment] = {}
+        representative_mode = FIT
+        quotas = fq.resources_dict
+        for rname, val in grouped.items():
+            quota = quotas.get(rname)
+            prev = assignment_usage.get(fq.name, {}).get(rname, 0)
+            mode, borrow, reason = _fits_resource_quota(
+                cq, fq.name, rname, val + prev, quota)
+            if reason is not None and not stopped:
+                reasons.append(reason)
+            representative_mode = min(representative_mode, mode)
+            needs_borrowing = needs_borrowing or borrow
+            if representative_mode == NO_FIT:
+                break
+            assignments[rname] = FlavorAssignment(
+                name=fq.name, mode=mode, borrow=borrow)
+
+        if representative_mode == FIT:
+            fit_slots.append((idx, assignments))
+
+        if stopped:
+            continue
+        assigned_flavor_idx = idx
+        if fungibility:
+            if not _should_try_next_flavor(
+                    representative_mode, cq.flavor_fungibility,
+                    needs_borrowing):
+                best_assignment = assignments
+                best_mode = representative_mode
+                stopped = True
+            elif representative_mode > best_mode:
+                best_assignment = assignments
+                best_mode = representative_mode
+        else:
+            if representative_mode > best_mode:
+                best_assignment = assignments
+                best_mode = representative_mode
+                if best_mode == FIT:
+                    stopped = True
+
+    # The device kernel's default `tried` bookkeeping (identical to the
+    # reference referee: the stop slot, else the last eligible slot).
+    tried = 0
+    if fungibility:
+        tried = assigned_flavor_idx
+        if assigned_flavor_idx in (-1, num_flavors - 1):
+            tried = -1
+
+    chosen: Optional[Dict[str, FlavorAssignment]] = None
+    if profiled and fit_slots:
+        # Slots scoring exactly NEG_SCORE are "cannot run here" (a 0
+        # throughput, or a flavor outside the score matrix): they are
+        # never chosen, and when EVERY fit slot scores NEG_SCORE the
+        # override is skipped entirely — the default decision stands
+        # (the kernel's strict `best_score > neg` gate).
+        best_score = int(NEG_SCORE)
+        for idx, assignments in fit_slots:
+            fi = flavor_index.get(rg.flavors[idx].name)
+            s = int(score_row[fi]) if fi is not None else int(NEG_SCORE)
+            if s > best_score:
+                best_score = s
+                chosen = assignments
+    if chosen is not None:
+        for fa in chosen.values():
+            if fungibility:
+                fa.tried_flavor_idx = tried
+        return chosen, [], None
+
+    if fungibility:
+        for fa in best_assignment.values():
+            fa.tried_flavor_idx = tried
+        if best_mode == FIT:
+            return best_assignment, [], None
+    elif best_mode == FIT:
+        return best_assignment, [], None
+    return best_assignment, reasons, None

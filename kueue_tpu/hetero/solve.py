@@ -1,0 +1,147 @@
+"""Gavel-style max-effective-throughput scoring (the hetero solve mode).
+
+Gavel ("Heterogeneity-Aware Cluster Scheduling Policies for Deep Learning
+Workloads", arxiv 2008.09213) frames heterogeneous-cluster scheduling as
+an LP: maximize the aggregate effective throughput sum_{w,f} x_{wf} *
+T_{wf} subject to per-accelerator-type capacity and sum_f x_{wf} <= 1.
+This module relaxes that LP onto the device as a dense projected dual
+iteration over the SAME lockstep tensors the flavor-fit solve reads:
+
+  * `T` is the [N,F] fixed-point throughput matrix maintained by the
+    ThroughputProfileStore (kueue_tpu/hetero/profile.py) over the whole
+    pending backlog — Gavel's rounds also score every runnable job, not
+    just the current heads;
+  * the capacity vector is the per-flavor free quota in the primary
+    resource (nominal - usage, clamped at 0, summed over ClusterQueues);
+  * each iteration is a best-response assignment (every profiled row
+    picks its current-max-score flavor) followed by a dual price ascent
+    on overloaded flavors — a tatonnement on the LP's capacity duals.
+
+The iteration is ALL INTEGER (fixed-point SCORE_SCALE units): integer
+adds and floor-divides are associative and identical on every backend,
+so the jit kernel and the numpy referee twin below are BITWISE equal —
+the decision-identity contract costs nothing.
+
+The deterministic rounding to an integral assignment happens inside the
+flavor-fit kernel (models/flavor_fit.solve_core `hetero=` argument): per
+(workload, podset, group), the slot with the maximum effective score
+among the currently-FIT slots wins, ties break to the earliest slot
+(first-fit order), and when nothing fits the default rules (including
+preemption stops) apply unchanged — so the hetero mode is quota- and
+borrowing-respecting by construction, and the host admission cycle
+arbitrates cross-workload races exactly as in the default mode.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+import kueue_tpu.ops  # noqa: F401  (x64 before tracing)
+import jax
+import jax.numpy as jnp
+
+# Fixed-point unit: a relative throughput of 1.0 encodes as 1024.
+SCORE_SCALE = 1024
+# Dual-step numerator: price moves by over/capacity * PRICE_STEP per
+# iteration (a quarter of a throughput unit at full overload).
+PRICE_STEP = 256
+# Projected-iteration depth. The dual converges geometrically on the
+# bench shapes; 8 steps separate contended from free flavors by whole
+# score units, far beyond the rounding granularity.
+DEFAULT_ITERS = 8
+# "Cannot run here" score for masked slots; far below any real score and
+# far above int64 overflow when summed with prices.
+NEG_SCORE = np.int64(-(np.int64(1) << 62))
+
+
+def hetero_scores_core(tput_q, demand, active, capacity, *,
+                       iters: int = DEFAULT_ITERS):
+    """The jit score kernel: [N,F] i64 fixed-point throughputs, [N] i64
+    primary-resource demand, [N] bool profiled-and-valid mask, [F] i64
+    free capacity -> [N,F] i64 effective scores (NEG_SCORE where the row
+    cannot run on the flavor).
+
+    Pure dense integer math — no data-dependent shapes — so one compile
+    serves every tick of a store capacity bucket.
+    """
+    allowed = tput_q > 0
+    runnable = active & allowed.any(axis=1)
+    cap_safe = jnp.maximum(capacity, 1)
+    farange = jnp.arange(capacity.shape[0])
+
+    def body(price, _):
+        score = tput_q - price[None, :]
+        masked = jnp.where(allowed, score, NEG_SCORE)
+        best = jnp.argmax(masked, axis=1)
+        onehot = (best[:, None] == farange[None, :]) \
+            & runnable[:, None] & allowed
+        load = jnp.sum(jnp.where(onehot, demand[:, None],
+                                 jnp.int64(0)), axis=0)
+        over = load - capacity
+        price = jnp.maximum(price + (over * PRICE_STEP) // cap_safe,
+                            jnp.int64(0))
+        return price, None
+
+    price0 = jnp.zeros(capacity.shape, dtype=jnp.int64)
+    price, _ = jax.lax.scan(body, price0, None, length=iters)
+    return jnp.where(allowed, tput_q - price[None, :], NEG_SCORE)
+
+
+_scores_kernel = functools.partial(jax.jit,
+                                   static_argnames=("iters",))(
+    hetero_scores_core)
+
+
+def hetero_scores(tput_q: np.ndarray, demand: np.ndarray,
+                  active: np.ndarray, capacity: np.ndarray,
+                  iters: int = DEFAULT_ITERS) -> np.ndarray:
+    """Dispatch the jit score kernel and materialize the [N,F] i64 score
+    matrix on host (the BatchSolver's per-(store,usage)-generation score
+    refresh)."""
+    out = _scores_kernel(jnp.asarray(tput_q), jnp.asarray(demand),
+                         jnp.asarray(active), jnp.asarray(capacity),
+                         iters=iters)
+    return np.asarray(jax.device_get(out))
+
+
+def hetero_scores_np(tput_q: np.ndarray, demand: np.ndarray,
+                     active: np.ndarray, capacity: np.ndarray,
+                     iters: int = DEFAULT_ITERS) -> np.ndarray:
+    """The sequential referee twin of `hetero_scores_core`: the same
+    integer iteration in numpy, bitwise-identical to the device kernel
+    (all-integer arithmetic is associative — there is no float drift to
+    tolerate). Pinned by tests/test_hetero.py."""
+    tput_q = np.asarray(tput_q, dtype=np.int64)
+    demand = np.asarray(demand, dtype=np.int64)
+    capacity = np.asarray(capacity, dtype=np.int64)
+    allowed = tput_q > 0
+    runnable = np.asarray(active, dtype=bool) & allowed.any(axis=1)
+    cap_safe = np.maximum(capacity, 1)
+    F = capacity.shape[0]
+    farange = np.arange(F)
+    price = np.zeros(F, dtype=np.int64)
+    for _ in range(iters):
+        score = tput_q - price[None, :]
+        masked = np.where(allowed, score, NEG_SCORE)
+        best = np.argmax(masked, axis=1)
+        onehot = (best[:, None] == farange[None, :]) \
+            & runnable[:, None] & allowed
+        load = np.sum(np.where(onehot, demand[:, None],
+                               np.int64(0)), axis=0)
+        over = load - capacity
+        price = np.maximum(price + (over * PRICE_STEP) // cap_safe,
+                           np.int64(0))
+    return np.where(allowed, tput_q - price[None, :], NEG_SCORE)
+
+
+def flavor_capacity(enc, usage: np.ndarray) -> np.ndarray:
+    """[F] i64 free-capacity vector in the PRIMARY resource (the
+    encoding's first resource name — cpu under the sorted vocabulary):
+    sum over ClusterQueues of max(nominal - usage, 0). A proxy for the
+    LP's per-accelerator-type capacity — the hetero mode only needs a
+    congestion signal per flavor; exact feasibility stays with the
+    flavor-fit quota math."""
+    free = np.maximum(enc.nominal[:, :, 0] - usage[:, :, 0], 0)
+    return free.sum(axis=0, dtype=np.int64)

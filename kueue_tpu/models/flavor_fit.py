@@ -42,6 +42,13 @@ from kueue_tpu.solver.referee import (
 
 MODE_SENTINEL = FIT + 1  # "no resource in group" marker for masked mins
 
+# The hetero score matrix's "cannot run here" sentinel. Imported (not
+# re-derived) because exact bitwise equality with the scores the
+# ThroughputProfileStore/score kernel emit is load-bearing: the rounding
+# masks non-FIT slots with this value and overrides only on a strictly
+# greater max.
+from kueue_tpu.hetero.solve import NEG_SCORE as HETERO_NEG_SCORE  # noqa: E402
+
 
 def solve_core(
     # CQ-side [C,F,R] and friends
@@ -56,6 +63,7 @@ def solve_core(
     num_slots: int,
     fungibility_enabled: bool = True,
     hier=None,
+    hetero=None,
 ):
     """Returns per-(W,P) assignment tensors; see outputs dict at the end.
 
@@ -63,7 +71,18 @@ def solve_core(
     hierarchical cohorts (KEP-79): per-node T balances are aggregated on
     device (segment-sum of lending-clamped leaf balances, then one clamped
     scatter-add per tree level), and each candidate value runs the
-    ancestor-path delta walk of core/hierarchy.py fully vectorized."""
+    ancestor-path delta walk of core/hierarchy.py fully vectorized.
+
+    `hetero` (optional) is the heterogeneity-aware solve mode
+    (kueue_tpu/hetero): an `(effective_score [W,F] i64, profiled [W]
+    bool)` pair. For profiled rows the chosen slot becomes the
+    currently-FIT slot with the maximum score (Gavel's deterministic
+    rounding; ties to the earliest slot — first-fit order); rows without
+    a FIT slot, and unprofiled rows, keep the default decision exactly.
+    The default first-fit choice rides along as the `group_ff` output so
+    the scheduler can explain "why flavor B". None (the default) leaves
+    the jaxpr — and every decision — byte-identical to the pre-hetero
+    kernel."""
     W = wl_cq.shape[0]
     P = req.shape[1]
     F = nominal.shape[1]
@@ -234,6 +253,34 @@ def solve_core(
         chosen = jnp.where(stopped, first_stop,
                            jnp.where(best_mode > NO_FIT, best_idx, -1))
 
+        if hetero is not None:
+            # Heterogeneity-aware rounding: profiled rows take the
+            # max-score slot among the currently-FIT slots (argmax ==
+            # first occurrence of the max, so equal scores fall back to
+            # first-fit order); everything else keeps the default
+            # choice, so quota/borrowing/preemption semantics are
+            # untouched. The mask value is exactly HETERO_NEG_SCORE —
+            # the score matrix's "cannot run here" sentinel — so a FIT
+            # slot whose profile says 0 throughput ties the mask and the
+            # strict `best_score > neg` gate falls back to the default
+            # decision (the referee's rule) instead of letting argmax
+            # land on slot 0 blind.
+            h_score, h_prof = hetero
+            chosen_ff = chosen
+            score_s = h_score[wix[:, None, None], sf]       # [W,G,S]
+            fit_ok = (rep == FIT) & sv
+            neg = jnp.int64(HETERO_NEG_SCORE)
+            masked_score = jnp.where(fit_ok, score_s, neg)
+            best_fit = jnp.argmax(masked_score, axis=2)
+            best_score = masked_score.max(axis=2)
+            # `ghr` keeps requestless groups on the default choice:
+            # their chosen slot is decision-inert (decode only reads
+            # requested resources) but a moved slot would read as a
+            # spurious "override" in the group_ff diff the explain
+            # records are built from.
+            use = h_prof[:, None] & (best_score > neg) & ghr
+            chosen = jnp.where(use, best_fit, chosen_ff)
+
         # Resume bookkeeping (flavorassigner.go:412,462-470): the last slot
         # whose eligibility checks passed, or the stop slot. With the
         # FlavorFungibility gate off the referee leaves TriedFlavorIdx at
@@ -300,6 +347,10 @@ def solve_core(
             ps_ok=ps_ok,
             ps_mode=ps_mode.astype(jnp.int8),
         )
+        if hetero is not None:
+            # The first-fit twin choice, for the `nominate.hetero`
+            # explain records ("chose flavor B over first-fit A").
+            outputs["group_ff"] = chosen_ff.astype(jnp.int16)
         return carry_usage, outputs
 
     carry0 = jnp.zeros((W, F, R), dtype=req.dtype)
@@ -326,7 +377,7 @@ def _solve_kernel_packed(
     nominal, borrow_limit, guaranteed, lendable, cohort_id,
     group_of_resource, slot_flavor, num_flavors,
     bwc_enabled, borrow_policy_is_borrow, preempt_policy_is_preempt,
-    hier, buf, *, num_slots: int, shapes,
+    hier, buf, hetero=None, *, num_slots: int, shapes,
     fungibility_enabled: bool = True,
 ):
     """Transfer-minimal entry: statics live on device across ticks; the
@@ -372,7 +423,7 @@ def _solve_kernel_packed(
         bwc_enabled, borrow_policy_is_borrow, preempt_policy_is_preempt,
         wl_cq, req, has_req, podset_valid, podset_unsat, elig, resume_slot,
         num_slots=num_slots, fungibility_enabled=fungibility_enabled,
-        hier=hier)
+        hier=hier, hetero=hetero)
 
 
 def device_static(enc: sch.CQEncoding) -> tuple:
@@ -416,7 +467,8 @@ def pack_dynamic(usage_cfr: np.ndarray, wl: sch.WorkloadTensors) -> np.ndarray:
 
 def solve_flavor_fit_async(enc: sch.CQEncoding, usage: sch.UsageTensors,
                            wl: sch.WorkloadTensors,
-                           static: Optional[tuple] = None) -> Dict[str, "jax.Array"]:
+                           static: Optional[tuple] = None,
+                           hetero=None) -> Dict[str, "jax.Array"]:
     """Dispatch the batched solve without synchronizing.
 
     Everything up to the fetch is fire-and-forget: three packed host->device
@@ -433,8 +485,10 @@ def solve_flavor_fit_async(enc: sch.CQEncoding, usage: sch.UsageTensors,
     W, P, R = wl.req.shape
     G = wl.resume_slot.shape[2]
     buf = pack_dynamic(usage.usage, wl)
+    if hetero is not None:
+        hetero = (jnp.asarray(hetero[0]), jnp.asarray(hetero[1]))
     out = _solve_kernel_packed(
-        *static, jnp.asarray(buf),
+        *static, jnp.asarray(buf), hetero,
         num_slots=enc.num_slots,
         shapes=(W, P, R, G, enc.num_cohorts),
         fungibility_enabled=features.enabled(features.FLAVOR_FUNGIBILITY),
@@ -666,7 +720,8 @@ class BatchSolver:
     def __init__(self, mesh=None, use_arena: Optional[bool] = None,
                  use_admit_arena: Optional[bool] = None,
                  use_nominate_cache: Optional[bool] = None,
-                 shards: Optional[int] = None):
+                 shards: Optional[int] = None,
+                 hetero: Optional[bool] = None):
         """`mesh` (a jax.sharding.Mesh, e.g. parallel.mesh.make_mesh())
         shards every solve over the mesh's devices: ClusterQueue usage is
         partitioned on the CQ axis with on-device cohort aggregation
@@ -697,7 +752,17 @@ class BatchSolver:
         hierarchical trees that DO span shards (optimistic per-shard
         solve, then the lending-clamp reconcile). -1 = all visible
         devices; 0/1/None = single-device. Env: KUEUE_TPU_SHARDS sets a
-        default, KUEUE_TPU_NO_SHARD=1 kills the path entirely."""
+        default, KUEUE_TPU_NO_SHARD=1 kills the path entirely.
+
+        `hetero` selects the heterogeneity-aware solve mode
+        (kueue_tpu/hetero; config `tpuSolver.mode: hetero`, env default
+        KUEUE_TPU_HETERO=1): flavor choice maximizes Gavel-style
+        effective throughput among fitting flavors, scored by the
+        ThroughputProfileStore's [N,F] matrix through the projected dual
+        iteration. Kill switch KUEUE_TPU_NO_HETERO=1 (read live, so A/B
+        drives can flip it per run); with the mode off — or on with no
+        profiled workload — every decision is byte-identical to the
+        default first-fit mode."""
         self._key = None
         self._enc: Optional[sch.CQEncoding] = None
         self._static: Optional[tuple] = None
@@ -713,6 +778,31 @@ class BatchSolver:
         self._fair_state = None
         self._fair_preempt_ctx = None
         self._mesh = mesh
+        # Heterogeneity-aware solve mode (kueue_tpu/hetero): the
+        # throughput profile store (rebuilt with the encoding, fed by
+        # the same queue dirty events as the workload arena), the
+        # memoized [cap,F] score matrix keyed on (store generation,
+        # global usage generation), and the per-tick activity flag
+        # (False whenever nothing is profiled — the provable no-op).
+        if hetero is None:
+            hetero = os.environ.get("KUEUE_TPU_HETERO", "") == "1"
+        self._hetero_mode = bool(hetero)
+        if self._hetero_mode and mesh is not None:
+            raise ValueError(
+                "the hetero solve mode runs single-device or over the "
+                "cohort mesh — the legacy wl-axis device mesh is not a "
+                "supported combination")
+        self._hetero_store = None
+        self._hetero_scores: Optional[np.ndarray] = None
+        self._hetero_scores_key = None
+        self._hetero_rows: Optional[np.ndarray] = None
+        self._hetero_active_tick = False
+        # Bumped whenever the score matrix is recomputed from changed
+        # inputs — the nominate-fingerprint and quiescent-signature term.
+        self.hetero_version = 0
+        # Per-window evidence: how many decided heads took a different
+        # flavor than first-fit would have (the bench reads the delta).
+        self.hetero_overrides_total = 0
         # Cohort-sharded solve (the production scale-out path). Built
         # eagerly so a misconfigured shard count fails at construction,
         # not inside the first tick.
@@ -844,6 +934,8 @@ class BatchSolver:
                 self._rebuild_arena(snapshot)
             if self._use_admit_arena:
                 self._rebuild_admit_arena()
+            if self._hetero_mode:
+                self._rebuild_hetero_store(snapshot)
             if self._cohort_mesh is not None:
                 # One shard assignment per encoding generation; both
                 # arenas maintain per-shard views off the same sink
@@ -874,6 +966,27 @@ class BatchSolver:
             cache.register_admitted_sink(arena)
             if old is not None:
                 cache.unregister_admitted_sink(old)
+
+    def _rebuild_hetero_store(self, snapshot: Snapshot) -> None:
+        """Throughput-profile store rebuild on encoding rotation: the F
+        axis is the encoding's flavor vocabulary, so rows are re-encoded
+        against the new speed-class vector and re-seeded from the whole
+        pending backlog (off the measured path, like the arena)."""
+        from kueue_tpu.hetero.profile import ThroughputProfileStore
+
+        infos = []
+        queues = self._queues
+        if queues is not None:
+            pending = getattr(queues, "pending_infos", None)
+            if pending is not None:
+                infos = pending()
+        self._hetero_store = ThroughputProfileStore(
+            self._enc, snapshot.resource_flavors,
+            capacity=sch._pad_pow2(max(len(infos), 1), floor=1024))
+        if infos:
+            self._hetero_store.seed(infos)
+        self._hetero_scores = None
+        self._hetero_scores_key = None
 
     def _rebuild_arena(self, snapshot: Snapshot) -> None:
         """Full arena rebuild (encoding-generation change): new pool, the
@@ -959,10 +1072,13 @@ class BatchSolver:
 
     def note_pending_workload(self, wi: WorkloadInfo) -> None:
         """Queue add/update event: (re-)encode the workload's arena row
-        off the measured tick path."""
+        (and its throughput-profile row) off the measured tick path."""
         arena = self._arena
         if arena is not None:
             arena.note(wi)
+        store = self._hetero_store
+        if store is not None:
+            store.note(wi)
 
     def forget_pending_workload(self, uid: str) -> None:
         """Queue delete event: free the workload's arena row (and its
@@ -970,6 +1086,9 @@ class BatchSolver:
         arena = self._arena
         if arena is not None:
             arena.forget(uid)
+        store = self._hetero_store
+        if store is not None:
+            store.forget(uid)
         self._nominate_cache.pop(uid, None)
 
     def forget_verdict(self, uid: str) -> None:
@@ -1100,6 +1219,182 @@ class BatchSolver:
         ctx.arena = self._admit_arena
         return ctx
 
+    # -- heterogeneity-aware solve mode (kueue_tpu/hetero) ------------------
+
+    def hetero_enabled(self) -> bool:
+        """Mode requested AND the kill switch clear (read live so A/B
+        identity drives can flip KUEUE_TPU_NO_HETERO per run)."""
+        return self._hetero_mode \
+            and os.environ.get("KUEUE_TPU_NO_HETERO", "") != "1"
+
+    def _hetero_prepare(self, workloads: Sequence[WorkloadInfo]) -> None:
+        """Per-tick hetero refresh, BEFORE fingerprinting: ensure every
+        head has a profile row, then recompute the score matrix iff its
+        inputs moved — (store generation, global usage generation) pins
+        both the [N,F] throughput matrix and the capacity vector, so a
+        hetero steady state recomputes nothing and replays every cached
+        verdict. Leaves `_hetero_active_tick` False whenever nothing is
+        profiled: the dispatch then passes `hetero=None` and the solve
+        is byte-identical to the default mode."""
+        if not self.hetero_enabled():
+            self._hetero_active_tick = False
+            self._hetero_rows = None
+            return
+        store = self._hetero_store
+        if store is None:
+            self._hetero_active_tick = False
+            self._hetero_rows = None
+            return
+        rows = store.rows_for(workloads)
+        if not store.any_profiled():
+            self._hetero_active_tick = False
+            self._hetero_rows = None
+            return
+        key = (store.generation, self._usage_enc.global_gen)
+        if key != self._hetero_scores_key:
+            from kueue_tpu.hetero import solve as hetero_solve
+            capacity = hetero_solve.flavor_capacity(
+                self._enc, self._usage_enc.usage)
+            self._hetero_scores = hetero_solve.hetero_scores(
+                store.tput, store.demand, store.active_mask(), capacity)
+            self._hetero_scores_key = key
+            self.hetero_version += 1
+        self._hetero_active_tick = True
+        self._hetero_rows = rows
+
+    def _hetero_batch(self, miss_idx, wt: sch.WorkloadTensors):
+        """(score [W,F] i64, profiled [W] bool) for the miss batch, or
+        None when no row of the batch is profiled (identity fast path:
+        the kernel then runs without the hetero argument at all)."""
+        rows = self._hetero_rows
+        scores = self._hetero_scores
+        if rows is None or scores is None:
+            return None, None
+        if miss_idx is not None:
+            rows = rows[np.asarray(miss_idx, dtype=np.int64)] \
+                if len(miss_idx) else rows[:0]
+        store = self._hetero_store
+        W = wt.wl_cq.shape[0]
+        F = scores.shape[1]
+        h_score = np.zeros((W, F), dtype=np.int64)
+        h_prof = np.zeros(W, dtype=bool)
+        n = len(rows)
+        h_score[:n] = scores[rows]
+        h_prof[:n] = store.profiled[rows] & store.valid[rows]
+        if not h_prof.any():
+            return None, None
+        return (h_score, h_prof), rows
+
+    def _hetero_overrides(self, inflight: dict,
+                          out: Dict[str, np.ndarray]) -> dict:
+        """{miss-batch row: (flavor, first_fit_flavor, throughput,
+        score, score_rank, podset_idx)} for every head whose hetero
+        choice differs from the first-fit twin — the `nominate.hetero`
+        explain payload."""
+        het = inflight.get("hetero")
+        ff = out.get("group_ff")
+        if het is None or ff is None:
+            return {}
+        h_score, h_prof = het
+        wt = inflight["wt"]
+        enc = inflight["enc"]
+        ch = np.asarray(out["group_chosen"])
+        ff = np.asarray(ff)
+        n = wt.num_real
+        # ps_ok keeps podsets past the first failure out of the explain
+        # payload — decode never materializes them, so a moved slot
+        # there is not a decision.
+        diff = (ch[:n] != ff[:n]) & (ch[:n] >= 0) \
+            & h_prof[:n, None, None] \
+            & np.asarray(out["ps_ok"])[:n][:, :, None]
+        ws, pp, gg = np.nonzero(diff)
+        rows = inflight.get("hetero_rows")
+        store = self._hetero_store
+        res: dict = {}
+        for w, p, g in zip(ws.tolist(), pp.tolist(), gg.tolist()):
+            if w in res:
+                continue   # first differing (podset, group) per head
+            ci = int(wt.wl_cq[w])
+            s1 = int(ch[w, p, g])
+            s0 = int(ff[w, p, g])
+            fi1 = int(enc.slot_flavor[ci, g, s1]) if s1 >= 0 else -1
+            fi0 = int(enc.slot_flavor[ci, g, s0]) if s0 >= 0 else -1
+            if fi1 < 0:
+                continue
+            row = int(rows[w]) if rows is not None and w < len(rows) \
+                else -1
+            tput = store.throughput_of(row, fi1) if row >= 0 else 1.0
+            sc = int(h_score[w, fi1])
+            rank = int((h_score[w] > sc).sum()) + 1
+            res[w] = (enc.flavor_names[fi1],
+                      enc.flavor_names[fi0] if fi0 >= 0 else "",
+                      tput, sc, rank, p)
+        self.hetero_overrides_total += len(res)
+        return res
+
+    def _debug_verify_hetero(self, inflight: dict, miss_wls,
+                             fresh) -> None:
+        """KUEUE_TPU_DEBUG_HETERO=1: re-derive every fresh verdict with
+        the sequential hetero referee and assert the flavor choices
+        match — the oracle comparison run inside the live tick."""
+        from kueue_tpu.hetero.referee import hetero_assign_flavors
+
+        het = inflight.get("hetero")
+        if het is None:
+            return
+        h_score, h_prof = het
+        snapshot = inflight["snapshot"]
+        enc = inflight["enc"]
+        for j, wi in enumerate(miss_wls):
+            cq = snapshot.cluster_queues.get(wi.cluster_queue)
+            if cq is None:
+                continue
+            saved = wi.last_assignment
+            try:
+                ref = hetero_assign_flavors(
+                    wi, cq, snapshot.resource_flavors, h_score[j],
+                    enc.flavor_index, bool(h_prof[j]))
+            finally:
+                wi.last_assignment = saved
+            got = fresh[j]
+            ref_trail = [
+                sorted((r, fa.name, fa.mode, fa.borrow)
+                       for r, fa in ps.flavors.items())
+                for ps in ref.pod_sets]
+            got_trail = [
+                sorted((r, fa.name, fa.mode, fa.borrow)
+                       for r, fa in ps.flavors.items())
+                for ps in got.pod_sets]
+            if ref_trail != got_trail:
+                raise AssertionError(
+                    f"hetero device/referee divergence for "
+                    f"{wi.obj.name}: device {got_trail} vs referee "
+                    f"{ref_trail}")
+
+    def hetero_signature_term(self) -> int:
+        """The quiescent-tick signature's hetero term: the score-matrix
+        version while the mode is actively overriding, 0 otherwise
+        (inactive hetero decides exactly like the default mode, so the
+        0 key may alias it safely)."""
+        return self.hetero_version if self._hetero_active_tick else 0
+
+    def flavor_utilization(self) -> dict:
+        """{flavor: {used, nominal, ratio}} in the PRIMARY resource,
+        summed over ClusterQueues — the bench's per-flavor utilization
+        histogram (heterogeneous clusters show whether fast flavors
+        actually fill)."""
+        enc = self._enc
+        ue = self._usage_enc
+        if enc is None or ue is None:
+            return {}
+        used = ue.usage[:, :, 0].sum(axis=0)
+        nom = enc.nominal[:, :, 0].sum(axis=0)
+        return {
+            name: {"used": int(used[fi]), "nominal": int(nom[fi]),
+                   "ratio": (round(float(used[fi]) / float(nom[fi]), 4)
+                             if nom[fi] else None)}
+            for fi, name in enumerate(enc.flavor_names)}
+
     def hier_cycle_state(self, snapshot: Snapshot):
         """Admission-cycle bookkeeping for hierarchical cohorts
         (ops/hier_cycle.HierCycleState) built on this solver's dense
@@ -1197,6 +1492,14 @@ class BatchSolver:
         fung = features.enabled(features.FLAVOR_FUNGIBILITY)
         cq_index = enc.cq_index
         cqs = snapshot.cluster_queues
+        # Active hetero widens every head's usage dependency to the
+        # global generation (the score matrix's dual prices read the
+        # WHOLE usage tensor — exactly the hierarchical-tree precedent)
+        # and adds the score-matrix version, so a verdict replays only
+        # while both the throughput inputs and every price input are
+        # provably unchanged.
+        hetero_v = self.hetero_version if self._hetero_active_tick \
+            else None
         out = []
         for wi in workloads:
             ci = cq_index.get(wi.cluster_queue)
@@ -1204,7 +1507,8 @@ class BatchSolver:
             if ci is None or cq is None:
                 out.append(None)
                 continue
-            gen = gg if (hmask is not None and hmask[ci]) \
+            gen = gg if (hetero_v is not None
+                         or (hmask is not None and hmask[ci])) \
                 else int(gens[cid[ci]])
             last = wi.last_assignment
             resume = None
@@ -1216,7 +1520,10 @@ class BatchSolver:
                             and cohort.allocatable_generation
                             > last.cohort_generation)):
                     resume = last.sig()
-            out.append((wi.rev, gen, resume, fung))
+            if hetero_v is not None:
+                out.append((wi.rev, gen, resume, fung, hetero_v))
+            else:
+                out.append((wi.rev, gen, resume, fung))
         return out
 
     def solve_async(self, workloads: Sequence[WorkloadInfo],
@@ -1239,6 +1546,10 @@ class BatchSolver:
                 enc = self._encoding_for(snapshot)
                 usage = self._usage_enc.refresh(snapshot)
             workloads = list(workloads)
+            # Hetero score refresh BEFORE fingerprinting: the verdict
+            # cache must key on the final score-matrix version.
+            if self._hetero_mode:
+                self._hetero_prepare(workloads)
             cached = None
             miss_idx = None
             fps = None
@@ -1293,6 +1604,8 @@ class BatchSolver:
             handle = None
             out = None
             cold = False
+            het = None
+            hrows = None
             if miss_workloads:
                 with TRACER.phase("tensorize.encode") as esp:
                     if self._arena is not None:
@@ -1312,6 +1625,8 @@ class BatchSolver:
                         esp.set("rows_total", wt.num_real)
                         esp.set("full_rebuild", True)
                     self._p_floor = max(self._p_floor, wt.req.shape[1])
+                if self._hetero_active_tick:
+                    het, hrows = self._hetero_batch(miss_idx, wt)
                 with TRACER.phase("tensorize.dispatch"):
                     self.dispatches += 1
                     if self._cohort_mesh is not None:
@@ -1322,7 +1637,8 @@ class BatchSolver:
                         from kueue_tpu.parallel.mesh import \
                             cohort_sharded_solve
                         out, sstats = cohort_sharded_solve(
-                            enc, usage, wt, self._cohort_mesh)
+                            enc, usage, wt, self._cohort_mesh,
+                            hetero=het)
                         counts = sstats["shard_heads"]
                         Ws = sstats["shard_bucket"]
                         self.shard_dispatches += 1
@@ -1339,7 +1655,8 @@ class BatchSolver:
                         key = ("cs", sstats["n_shards"], Ws,
                                wt.req.shape[1],
                                features.enabled(
-                                   features.FLAVOR_FUNGIBILITY))
+                                   features.FLAVOR_FUNGIBILITY),
+                               het is not None)
                         with self._warm_lock:
                             if key not in self._warm_keys:
                                 cold = True
@@ -1359,14 +1676,15 @@ class BatchSolver:
                                                  self._mesh)
                     else:
                         handle = solve_flavor_fit_async(
-                            enc, usage, wt, static=self._static)
+                            enc, usage, wt, static=self._static,
+                            hetero=het)
                         W, P, R = wt.req.shape
                         C, F = enc.nominal.shape[0], enc.nominal.shape[1]
                         key = (W, P, R, wt.resume_slot.shape[2],
                                enc.num_cohorts, enc.num_slots,
                                features.enabled(
                                    features.FLAVOR_FUNGIBILITY),
-                               C, F)
+                               C, F, het is not None)
                         with self._warm_lock:
                             if key not in self._warm_keys:
                                 cold = True
@@ -1392,6 +1710,7 @@ class BatchSolver:
         return {"workloads": workloads, "snapshot": snapshot,
                 "enc": enc, "wt": wt, "handle": handle, "out": out,
                 "cached": cached, "miss_idx": miss_idx, "fps": fps,
+                "hetero": het, "hetero_rows": hrows,
                 "dispatched": trace_now()}
 
     # -- bucket prewarm (compile-proof ticks) -------------------------------
@@ -1464,13 +1783,16 @@ class BatchSolver:
 
         with TRACER.span("solver.prewarm_compile") as sp:
             if nkey[0] == "cs":
-                # Cohort-sharded bucket: ("cs", n_shards, Ws, P, fung).
+                # Cohort-sharded bucket:
+                # ("cs", n_shards, Ws, P, fung[, hetero]).
                 sp.set("bucket", list(nkey[1:4]))
                 try:
                     from kueue_tpu.parallel.mesh import \
                         prewarm_cohort_program
-                    prewarm_cohort_program(self._enc, self._cohort_mesh,
-                                           nkey[2], nkey[3], nkey[4])
+                    prewarm_cohort_program(
+                        self._enc, self._cohort_mesh,
+                        nkey[2], nkey[3], nkey[4],
+                        hetero=len(nkey) > 5 and bool(nkey[5]))
                 except Exception:
                     sp.set("failed", True)
                     return
@@ -1484,8 +1806,12 @@ class BatchSolver:
                 C, F = static[0].shape[0], static[0].shape[1]
                 nb = ((C * F * R + W * P * R) * 8 + (W + W * P * G) * 4
                       + W * P * R + 2 * W * P + W * P * G * S)
+                hetero = None
+                if len(nkey) > 9 and nkey[9]:
+                    hetero = (jnp.zeros((W, F), dtype=jnp.int64),
+                              jnp.zeros(W, dtype=bool))
                 out = _solve_kernel_packed(
-                    *static, jnp.zeros(nb, dtype=jnp.uint8),
+                    *static, jnp.zeros(nb, dtype=jnp.uint8), hetero,
                     num_slots=S, shapes=(W, P, R, G, K),
                     fungibility_enabled=fung)
                 jax.block_until_ready(out)
@@ -1505,6 +1831,10 @@ class BatchSolver:
             return
         enc = self._encoding_for(snapshot)
         fung = features.enabled(features.FLAVOR_FUNGIBILITY)
+        # Compile the default-shape program, plus the hetero-flavored
+        # twin when the mode is on (a profiled tick dispatches the
+        # hetero jaxpr — a different compile).
+        het_flags = (False, True) if self._hetero_mode else (False,)
         if self._cohort_mesh is not None:
             # Per-shard buckets: an even split is the best startup guess
             # (the real bucket is pow2 of the LARGEST shard's heads; the
@@ -1513,29 +1843,31 @@ class BatchSolver:
             done_s = set()
             for hc in head_counts:
                 Ws = sch._pad_pow2(max((int(hc) + n_sh - 1) // n_sh, 1))
-                key = ("cs", n_sh, Ws, max(podsets, 1), fung)
-                if key in done_s:
-                    continue
-                done_s.add(key)
-                with self._warm_lock:
-                    if key in self._warm_keys:
+                for het in het_flags:
+                    key = ("cs", n_sh, Ws, max(podsets, 1), fung, het)
+                    if key in done_s:
                         continue
-                self._prewarm_one(key)
+                    done_s.add(key)
+                    with self._warm_lock:
+                        if key in self._warm_keys:
+                            continue
+                    self._prewarm_one(key)
             return
         R = len(enc.resource_names)
         C, F = enc.nominal.shape[0], enc.nominal.shape[1]
         done = set()
         for hc in head_counts:
             W = sch._pad_pow2(max(int(hc), 1))
-            key = (W, max(podsets, 1), R, enc.num_groups, enc.num_cohorts,
-                   enc.num_slots, fung, C, F)
-            if key in done:
-                continue
-            done.add(key)
-            with self._warm_lock:
-                if key in self._warm_keys:
+            for het in het_flags:
+                key = (W, max(podsets, 1), R, enc.num_groups,
+                       enc.num_cohorts, enc.num_slots, fung, C, F, het)
+                if key in done:
                     continue
-            self._prewarm_one(key)
+                done.add(key)
+                with self._warm_lock:
+                    if key in self._warm_keys:
+                        continue
+                self._prewarm_one(key)
 
     def collect(self, inflight: dict) -> List[Assignment]:
         """Fetch + decode a solve dispatched by solve_async; cached heads
@@ -1562,6 +1894,12 @@ class BatchSolver:
                 # walks.
                 inflight["usage_csr"] = sch.batch_usage_csr(
                     out, inflight["wt"])
+                if out is not None and inflight.get("hetero") is not None:
+                    inflight["hetero_overrides"] = \
+                        self._hetero_overrides(inflight, out)
+                    if os.environ.get("KUEUE_TPU_DEBUG_HETERO") == "1":
+                        self._debug_verify_hetero(
+                            inflight, inflight["workloads"], assignments)
                 return assignments
             workloads = inflight["workloads"]
             n = len(workloads)
@@ -1573,6 +1911,12 @@ class BatchSolver:
                     miss_wls, inflight["snapshot"], inflight["enc"], out)
                 inflight["usage_csr"] = sch.batch_usage_csr(
                     out, inflight["wt"])
+                if inflight.get("hetero") is not None:
+                    inflight["hetero_overrides"] = \
+                        self._hetero_overrides(inflight, out)
+                    if os.environ.get("KUEUE_TPU_DEBUG_HETERO") == "1":
+                        self._debug_verify_hetero(inflight, miss_wls,
+                                                  fresh)
                 nc = self._nominate_cache
                 if len(nc) >= self.NOMINATE_CACHE_MAX:
                     nc.clear()
@@ -1627,7 +1971,14 @@ class BatchSolver:
         overrides — one device dispatch per partial-admission search ROUND
         for every searching workload at once, instead of one referee run
         per probe per workload (podset_reducer.go:86; scheduler
-        _batch_partial_admission)."""
+        _batch_partial_admission).
+
+        Partial-admission probes deliberately run the DEFAULT first-fit
+        ordering even in hetero mode: the reducer's binary search only
+        asks "does any count fit", and a downsized workload is already
+        off the throughput-optimal path — keeping the probes
+        mode-independent keeps the reducer's monotonicity contract
+        simple (documented in the README's hetero section)."""
         enc = self._encoding_for(snapshot)
         usage = self._usage_enc.refresh(snapshot)
         wt = sch.encode_workloads(workloads, snapshot, enc, counts=counts,

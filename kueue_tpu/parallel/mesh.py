@@ -307,6 +307,7 @@ def shard_solve_body(
     bwc_enabled, borrow_policy_is_borrow, preempt_policy_is_preempt,
     hier, usage,
     wl_cq, req, has_req, podset_valid, podset_unsat, elig, resume_slot,
+    hetero=None,
     *, num_slots: int, num_cohorts: int, fungibility_enabled: bool,
 ):
     """One shard's solve: the exact per-shard program `shard_map` runs on
@@ -333,20 +334,22 @@ def shard_solve_body(
         bwc_enabled, borrow_policy_is_borrow, preempt_policy_is_preempt,
         wl_cq, req, has_req, podset_valid, podset_unsat, elig, resume_slot,
         num_slots=num_slots, fungibility_enabled=fungibility_enabled,
-        hier=hier)
+        hier=hier, hetero=hetero)
 
 
 def _build_cohort_program(cmesh: CohortMesh, num_slots: int,
                           num_cohorts: int, fungibility_enabled: bool,
-                          has_hier: bool):
+                          has_hier: bool, has_hetero: bool = False):
     repl = P()
     sharded = P(SHARD_AXIS)
     # CQ statics + usage broadcast (each shard READS only its own
     # cohorts' rows — the gathers are wl_cq-indexed — but the tensor is
     # replicated so the layout matches the single-device kernel exactly);
-    # the 7 workload tensors are block-sharded on the leading axis.
+    # the 7 workload tensors — plus the per-shard hetero score/profile
+    # views in hetero mode — are block-sharded on the leading axis.
+    n_wl = 7 + (2 if has_hetero else 0)
     in_specs = (repl,) * 11 + ((repl,) if has_hier else ()) + (repl,) \
-        + (sharded,) * 7
+        + (sharded,) * n_wl
 
     def run(nominal, borrow_limit, guaranteed, lendable, cohort_id,
             group_of_resource, slot_flavor, num_flavors,
@@ -358,6 +361,13 @@ def _build_cohort_program(cmesh: CohortMesh, num_slots: int,
         else:
             hier, usage = None, rest[0]
             wl = rest[1:]
+        hetero = None
+        if has_hetero:
+            # The trailing two block-sharded tensors are this shard's
+            # score-matrix view and profiled mask (each shard reads only
+            # its own rows — the per-shard matrix view).
+            hetero = (wl[-2], wl[-1])
+            wl = wl[:-2]
         # Closure captures (num_slots/num_cohorts/fungibility) are safe:
         # every captured value is part of the _PROGRAM_CACHE key, so a
         # different value builds a fresh program instead of retracing.
@@ -365,7 +375,7 @@ def _build_cohort_program(cmesh: CohortMesh, num_slots: int,
             nominal, borrow_limit, guaranteed, lendable, cohort_id,
             group_of_resource, slot_flavor, num_flavors,
             bwc_enabled, borrow_policy_is_borrow,
-            preempt_policy_is_preempt, hier, usage, *wl,
+            preempt_policy_is_preempt, hier, usage, *wl, hetero,
             num_slots=num_slots, num_cohorts=num_cohorts,
             fungibility_enabled=fungibility_enabled)
 
@@ -400,7 +410,7 @@ def plan_shards(assignment: ShardAssignment, wl_cq: np.ndarray, n: int,
 
 
 def _cohort_program_key(cmesh: CohortMesh, enc, Ws: int, P_: int,
-                        fungible: bool):
+                        fungible: bool, has_hetero: bool = False):
     h = enc.hier
     hier_shape = None if h is None else (
         h.node_own_nominal.shape, h.cq_path.shape,
@@ -408,17 +418,17 @@ def _cohort_program_key(cmesh: CohortMesh, enc, Ws: int, P_: int,
     C, F, R = enc.nominal.shape
     return ("cohort-shard", id(cmesh.mesh), cmesh.n_shards, Ws, P_, R,
             enc.num_groups, enc.num_slots, C, F, enc.num_cohorts,
-            fungible, hier_shape)
+            fungible, hier_shape, has_hetero)
 
 
 def _cohort_program(cmesh: CohortMesh, enc, Ws: int, P_: int,
-                    fungible: bool):
-    key = _cohort_program_key(cmesh, enc, Ws, P_, fungible)
+                    fungible: bool, has_hetero: bool = False):
+    key = _cohort_program_key(cmesh, enc, Ws, P_, fungible, has_hetero)
     program = _PROGRAM_CACHE.get(key)
     if program is None:
         program = _build_cohort_program(
             cmesh, enc.num_slots, enc.num_cohorts, fungible,
-            enc.hier is not None)
+            enc.hier is not None, has_hetero)
         _PROGRAM_CACHE[key] = program
     return program
 
@@ -441,6 +451,7 @@ def _static_args(enc) -> tuple:
 
 
 def cohort_sharded_solve(enc, usage_tensors, wt, cmesh: CohortMesh,
+                         hetero=None,
                          ) -> Tuple[Dict[str, np.ndarray], dict]:
     """Run the batched flavor-fit solve cohort-sharded over `cmesh`.
 
@@ -477,12 +488,25 @@ def cohort_sharded_solve(enc, usage_tensors, wt, cmesh: CohortMesh,
         resume_slot[dest] = wt.resume_slot[:n]
 
     fungible = features.enabled(features.FLAVOR_FUNGIBILITY)
-    program = _cohort_program(cmesh, enc, Ws, P_, fungible)
+    program = _cohort_program(cmesh, enc, Ws, P_, fungible,
+                              hetero is not None)
     args = _static_args(enc) + (
         jnp.asarray(usage_tensors.usage),
         jnp.asarray(wl_cq), jnp.asarray(req), jnp.asarray(has_req),
         jnp.asarray(podset_valid), jnp.asarray(podset_unsat),
         jnp.asarray(elig), jnp.asarray(resume_slot))
+    if hetero is not None:
+        # Per-shard score-matrix views: the [W,F] scores and profiled
+        # mask compact through the SAME dest plan as the workload
+        # tensors, so each shard's block carries exactly its own rows.
+        h_score, h_prof = hetero
+        F_ = h_score.shape[1]
+        score_s = np.zeros((WsS, F_), dtype=np.int64)
+        prof_s = np.zeros(WsS, dtype=bool)
+        if n:
+            score_s[dest] = h_score[:n]
+            prof_s[dest] = h_prof[:n]
+        args = args + (jnp.asarray(score_s), jnp.asarray(prof_s))
     out = program(*args)
     out = jax.device_get(out)
     stats = {"shard_heads": counts, "shard_bucket": Ws,
@@ -495,7 +519,7 @@ def cohort_sharded_solve(enc, usage_tensors, wt, cmesh: CohortMesh,
 
 
 def prewarm_cohort_program(enc, cmesh: CohortMesh, Ws: int, P_: int,
-                           fungible: bool) -> None:
+                           fungible: bool, hetero: bool = False) -> None:
     """Compile the cohort-sharded program for one per-shard bucket NOW
     (all-zeros inputs; compilation depends only on shapes/dtypes) — the
     sharded twin of BatchSolver._prewarm_one, called from the idle
@@ -505,7 +529,7 @@ def prewarm_cohort_program(enc, cmesh: CohortMesh, Ws: int, P_: int,
     R = len(enc.resource_names)
     G = enc.num_groups
     S_slots = enc.num_slots
-    program = _cohort_program(cmesh, enc, Ws, P_, fungible)
+    program = _cohort_program(cmesh, enc, Ws, P_, fungible, hetero)
     args = _static_args(enc) + (
         jnp.zeros(enc.nominal.shape, dtype=jnp.int64),
         jnp.zeros(WsS, dtype=jnp.int32),
@@ -515,6 +539,10 @@ def prewarm_cohort_program(enc, cmesh: CohortMesh, Ws: int, P_: int,
         jnp.zeros((WsS, P_), dtype=bool),
         jnp.zeros((WsS, P_, G, S_slots), dtype=bool),
         jnp.zeros((WsS, P_, G), dtype=jnp.int32))
+    if hetero:
+        F_ = enc.nominal.shape[1]
+        args = args + (jnp.zeros((WsS, F_), dtype=jnp.int64),
+                       jnp.zeros(WsS, dtype=bool))
     jax.block_until_ready(program(*args))
 
 

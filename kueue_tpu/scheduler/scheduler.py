@@ -81,6 +81,11 @@ class Entry:
     # preemption-issue sequences at their original position, so the
     # two-phase cycle commits in exactly the single-phase order.
     cycle_pos: int = 0
+    # Hetero solve mode (kueue_tpu/hetero): set when this entry's chosen
+    # flavor differs from the first-fit twin — (flavor, first_fit_flavor,
+    # throughput, score, score_rank, podset_idx), surfaced through the
+    # explain records so `?explain=true` answers "why flavor B".
+    hetero: Optional[tuple] = None
 
 
 @dataclass
@@ -461,6 +466,15 @@ class Scheduler:
         st = self._tick_fair_state
         return st.version if st is not None else -1
 
+    def _hetero_term(self) -> int:
+        """The quiescent-signature hetero term: the solver's score-matrix
+        version while the hetero mode is actively overriding, 0 otherwise
+        (an inactive hetero tick decides exactly like the default mode,
+        so the 0 key aliases it safely) — a hetero steady state replays
+        sort/admit/requeue AND dispatches zero solves."""
+        fn = getattr(self.batch_solver, "hetero_signature_term", None)
+        return fn() if fn is not None else 0
+
     def _quiescent_match(self, tick: TickInFlight,
                          entries: List[Entry]) -> Optional[dict]:
         """The recorded ring entry whose inputs provably equal this
@@ -497,7 +511,8 @@ class Scheduler:
                tuple(id(e.assignment) for e in entries),
                features.enabled(features.FAIR_SHARING),
                features.enabled(features.PRIORITY_SORTING_WITHIN_COHORT),
-               self._fair_share_term())
+               self._fair_share_term(),
+               self._hetero_term())
         ent = self._quiet_ring.get(key)
         if ent is None or ent["mut"] != self._mirror.mutation_count:
             return None
@@ -546,7 +561,8 @@ class Scheduler:
         ring[(pre_uids, tuple(id(a) for a in pre_assign),
               features.enabled(features.FAIR_SHARING),
               features.enabled(features.PRIORITY_SORTING_WITHIN_COHORT),
-              self._fair_share_term())] = {
+              self._fair_share_term(),
+              self._hetero_term())] = {
             "assignments": pre_assign,
             "msgs": pre_msgs,
             "order": sort_order,
@@ -806,6 +822,31 @@ class Scheduler:
             # referee (_entry_sort_key) order identically.
             for e in tick.entries:
                 e.share = share_of(e.info.cluster_queue)
+        hov = tick.handle.get("hetero_overrides") \
+            if tick.handle is not None else None
+        if hov is not None:
+            # Hetero solve mode: annotate the entries whose chosen flavor
+            # beat the first-fit twin, so the explain records (and the
+            # span) answer "why flavor B" — present only when a hetero
+            # solve actually dispatched, so the default mode's trace is
+            # untouched.
+            with TRACER.phase("nominate.hetero") as hsp:
+                if hov:
+                    row_to_entry: Dict[int, int] = {}
+                    if solve_rows is None:
+                        for i in range(len(entries)):
+                            row_to_entry[i] = i
+                    else:
+                        for i, r in enumerate(solve_rows):
+                            if r >= 0:
+                                row_to_entry[int(r)] = i
+                    for row, info in hov.items():
+                        i = row_to_entry.get(row)
+                        if i is not None:
+                            entries[i].hetero = info
+                hsp.set("overrides", len(hov))
+                hsp.set("version", getattr(self.batch_solver,
+                                           "hetero_version", 0))
         if partial_pending:
             self._batch_partial_admission(partial_pending, snapshot)
 

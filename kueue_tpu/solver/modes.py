@@ -69,11 +69,58 @@ SOLVE_ENTRYPOINTS: Tuple[SolveEntrySpec, ...] = (
                    "kueue_tpu.models.flavor_fit", "solve_core"),
     SolveEntrySpec("flavor-fit-packed",
                    "kueue_tpu.models.flavor_fit", "_solve_kernel_packed"),
+    # The KEP-79 variant of solve_core: the hierarchical cohort-forest
+    # pytree swaps the flat-pool arithmetic for the ancestor-path
+    # T-invariant walk — a materially different jaxpr, lowered and
+    # verified separately (the carried-over "hier solve_core in the
+    # trace roster" ROADMAP item).
+    SolveEntrySpec("flavor-fit-hier",
+                   "kueue_tpu.models.flavor_fit", "solve_core"),
+    # Heterogeneity-aware solve mode (kueue_tpu/hetero): the
+    # throughput-override variant of solve_core plus the Gavel
+    # price-iteration score kernel.
+    SolveEntrySpec("flavor-fit-hetero",
+                   "kueue_tpu.models.flavor_fit", "solve_core"),
+    SolveEntrySpec("hetero-scores",
+                   "kueue_tpu.hetero.solve", "hetero_scores_core"),
     SolveEntrySpec("cohort-shard-solve",
                    "kueue_tpu.parallel.mesh", "shard_solve_body"),
     SolveEntrySpec("topology-fit",
                    "kueue_tpu.topology.fit", "solve_topology_core"),
 )
+
+
+@dataclass(frozen=True)
+class SolveModeSpec:
+    """One registered flavor-assignment solve MODE (tpuSolver.mode).
+
+    A mode is a decision POLICY over the same quota constraints —
+    "default" is the reference's ordered first-fit; "hetero" is the
+    Gavel-style max-effective-throughput policy (kueue_tpu/hetero).
+    `entrypoints` names the SOLVE_ENTRYPOINTS kernels the mode
+    dispatches: the coverage meta-test
+    (tests/test_engine_coverage.py::test_every_solve_mode_is_registered)
+    fails CI when a mode's kernels are missing from the registry or the
+    kueueverify trace roster — an unregistered mode cannot land."""
+
+    name: str
+    entrypoints: Tuple[str, ...]
+    kill_switch: str = ""
+
+
+SOLVE_MODES: Tuple[SolveModeSpec, ...] = (
+    SolveModeSpec("default",
+                  ("flavor-fit", "flavor-fit-packed", "flavor-fit-hier",
+                   "cohort-shard-solve", "topology-fit")),
+    SolveModeSpec("hetero",
+                  ("flavor-fit-hetero", "hetero-scores",
+                   "cohort-shard-solve"),
+                  kill_switch="KUEUE_TPU_NO_HETERO"),
+)
+
+
+def solve_mode_names() -> Tuple[str, ...]:
+    return tuple(m.name for m in SOLVE_MODES)
 
 
 ENGINES: Tuple[EngineSpec, ...] = (

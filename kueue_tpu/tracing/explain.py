@@ -79,8 +79,8 @@ class ExplainStore:
                 if not dq:
                     continue
                 rec = dq[-1]
-                reps = rec[8] if len(rec) > 8 else 1
-                dq[-1] = (tick_seq, now) + tuple(rec[2:8]) + (reps + 1,)
+                reps = rec[9] if len(rec) > 9 else 1
+                dq[-1] = (tick_seq, now) + tuple(rec[2:9]) + (reps + 1,)
 
     def forget(self, key: str) -> None:
         with self._lock:
@@ -118,9 +118,12 @@ def build_record(entry, tick_seq: int, now: float, outcome: str) -> tuple:
     tracing→scheduler edge one-directional).
 
     Layout: (tick, time, cluster_queue, outcome, reason, flavors,
-             topology, preempted) where `flavors` is a tuple of
-    (pod_set, resource, flavor, verdict, borrow) and `topology` a tuple
-    of (pod_set, flavor, level, domain, ok) — or None each."""
+             topology, preempted, hetero) where `flavors` is a tuple of
+    (pod_set, resource, flavor, verdict, borrow), `topology` a tuple
+    of (pod_set, flavor, level, domain, ok) — or None each — and
+    `hetero` the hetero solve mode's override detail (flavor,
+    first_fit_flavor, throughput, score, score_rank, podset_idx) when
+    the chosen flavor beat the first-fit twin, None otherwise."""
     a = entry.assignment
     flavors: tuple = ()
     topology = None
@@ -145,12 +148,14 @@ def build_record(entry, tick_seq: int, now: float, outcome: str) -> tuple:
     preempted = len(entry.preemption_targets) \
         if entry.preemption_targets else 0
     return (tick_seq, now, entry.info.cluster_queue, outcome,
-            entry.inadmissible_msg, flavors, topology, preempted)
+            entry.inadmissible_msg, flavors, topology, preempted,
+            getattr(entry, "hetero", None))
 
 
 def _materialize(rec: tuple) -> dict:
     tick, now, cq, outcome, reason, flavors, topology, preempted = rec[:8]
-    repeats = rec[8] if len(rec) > 8 else 1
+    hetero = rec[8] if len(rec) > 8 else None
+    repeats = rec[9] if len(rec) > 9 else 1
     out = {
         "tick": tick,
         "time": now,
@@ -169,6 +174,16 @@ def _materialize(rec: tuple) -> dict:
             for ps, f, lvl, dom, ok in topology]
     if preempted:
         out["preemptionTargets"] = preempted
+    if hetero is not None:
+        flavor, ff_flavor, tput, score, rank, ps_idx = hetero
+        out["hetero"] = {
+            "flavor": flavor,
+            "firstFitFlavor": ff_flavor,
+            "throughput": tput,
+            "score": score,
+            "scoreRank": rank,
+            "podSetIndex": ps_idx,
+        }
     if repeats > 1:
         out["repeats"] = repeats
     return out

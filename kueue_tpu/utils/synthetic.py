@@ -27,6 +27,16 @@ from kueue_tpu.core.snapshot import Snapshot
 from kueue_tpu.core.workload import WorkloadInfo
 
 
+def hetero_profile_draw(rnd, num_flavors: int):
+    """One workload's synthetic per-flavor speedup profile — shared by
+    the generator's pending loop and bench.py's churn arrivals so the
+    hetero bench measures ONE population (a drift between the two would
+    silently mix distributions under the gain gate)."""
+    f_a, f_b = rnd.sample(range(num_flavors), 2)
+    return {f"flavor-{f_a}": float(rnd.choice([2, 4, 8])),
+            f"flavor-{f_b}": float(rnd.choice([1, 2]))}
+
+
 def synthetic_objects(
     num_cqs: int = 1000,
     num_cohorts: int = 100,
@@ -41,6 +51,7 @@ def synthetic_objects(
     topology: bool = False,
     strict_fifo: bool = False,
     no_preemption: bool = False,
+    hetero: bool = False,
     cq_filter=None,
 ):
     """Generate the raw API objects of a north-star-scale cluster:
@@ -64,6 +75,14 @@ def synthetic_objects(
     fourth workload `required: rack`, the rest `preferred: rack` — so the
     whole topology stage (batched fit, cycle charging, ledger) runs on
     every tick.
+
+    `hetero` builds the heterogeneity-aware bench config: the flavor set
+    becomes a speed ladder (flavor-f at speed_class 1.0 + 0.5*f), every
+    ClusterQueue lists its flavors SLOWEST FIRST (the regime where
+    ordered first-fit parks fast workloads on slow accelerators — what
+    Gavel measures as the 2-3x aggregate-throughput loss), and every
+    pending workload declares per-flavor throughput overrides on two of
+    its flavors.
 
     `cq_filter(c) -> bool` keeps only the objects of the selected
     ClusterQueue indices — the replica runtime's per-worker slice. The
@@ -90,8 +109,10 @@ def synthetic_objects(
         from kueue_tpu.api.types import TopologySpec
         topo_spec = TopologySpec.uniform(
             ("block", "rack", "host"), (2, 2, 4), leaf_capacity=8)
-    flavors = [ResourceFlavor.make(f"flavor-{f}", topology=topo_spec)
-               for f in range(num_flavors)]
+    flavors = [ResourceFlavor.make(
+        f"flavor-{f}", topology=topo_spec,
+        speed_class=(1.0 + 0.5 * f) if hetero else 1.0)
+        for f in range(num_flavors)]
 
     cqs: List[ClusterQueue] = []
     lqs: List[LocalQueue] = []
@@ -101,6 +122,10 @@ def synthetic_objects(
         keep = cq_filter is None or cq_filter(c)
         n_flavors = rnd.randint(2, min(4, num_flavors))
         chosen = rnd.sample(range(num_flavors), n_flavors)
+        if hetero:
+            # Slowest flavor first: the ordered first-fit baseline lands
+            # here, which is exactly what the hetero mode must beat.
+            chosen.sort()
         # Draw the quota numbers (and the fair weight) unconditionally
         # (the cq_filter draw contract), construct objects only for
         # kept indices.
@@ -218,12 +243,17 @@ def synthetic_objects(
         specs = [(rnd.randint(1, 8), rnd.randint(1, 8),
                   rnd.randint(1, 16)) for _p in range(n_podsets)]
         priority = rnd.randint(*pending_priority)
+        tputs = None
+        if hetero:
+            # Per-workload speedups on two random flavors (draw-then-
+            # construct: the stream advances for filtered indices too).
+            tputs = hetero_profile_draw(rnd, num_flavors)
         if c not in kept_set:
             continue
         pod_sets = [
             PodSet.make(
                 f"ps{p}", count=count, cpu=cpu,
-                memory=f"{mem}Gi", **topo_kw)
+                memory=f"{mem}Gi", flavor_throughputs=tputs, **topo_kw)
             for p, (count, cpu, mem) in enumerate(specs)
         ]
         pending.append(Workload(
@@ -284,6 +314,7 @@ def synthetic_framework(
     topology: bool = False,
     strict_fifo: bool = False,
     no_preemption: bool = False,
+    hetero: bool = False,
     **framework_kwargs,
 ):
     """Build a full Framework loaded with the synthetic cluster — the
@@ -296,7 +327,8 @@ def synthetic_framework(
         num_pending=num_pending, usage_fill=usage_fill, seed=seed,
         pending_priority=pending_priority, preemption_heavy=preemption_heavy,
         fair_hierarchy=fair_hierarchy, lending=lending, topology=topology,
-        strict_fifo=strict_fifo, no_preemption=no_preemption)
+        strict_fifo=strict_fifo, no_preemption=no_preemption,
+        hetero=hetero)
     fw = Framework(batch_solver=batch_solver, **framework_kwargs)
     for rf in flavors:
         fw.create_resource_flavor(rf)

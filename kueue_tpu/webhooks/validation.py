@@ -256,6 +256,7 @@ def validate_workload(wl: Workload) -> List[str]:
             if val is not None and (not val or not _QUALIFIED_NAME.match(val)):
                 errs.append(f"{path}.topologyRequest.{fld}: invalid level "
                             f"name {val!r}")
+        errs += _validate_flavor_throughputs(ps, path)
     if variable_count > 1:
         errs.append("spec.podSets: at most one podSet can use minCount")
     if wl.priority_class:
@@ -391,8 +392,37 @@ def validate_local_queue_update(new: LocalQueue, old: LocalQueue) -> List[str]:
     return errs
 
 
+def _validate_flavor_throughputs(ps, path: str) -> List[str]:
+    """Heterogeneity-aware scheduling hardening: throughput values must
+    be finite and non-negative (a NaN/inf would poison every dense-score
+    comparison in the hetero solve; a negative value is meaningless),
+    and flavor references must be syntactically valid ResourceFlavor
+    names. This is a SYNTAX check — the webhook has no flavor list; a
+    well-formed name that matches no live flavor falls back to that
+    flavor's speed-class default at scoring time (documented in
+    hetero/profile.workload_throughputs)."""
+    import math
+    errs: List[str] = []
+    for fname, val in ps.flavor_throughputs:
+        fpath = f"{path}.flavorThroughputs[{fname}]"
+        if not is_dns1123_subdomain(fname):
+            errs.append(f"{fpath}: invalid flavor reference — {fname!r} "
+                        "is not a valid ResourceFlavor name")
+        if not isinstance(val, (int, float)) or math.isnan(val) \
+                or math.isinf(val) or val < 0:
+            errs.append(f"{fpath}: throughput must be a finite "
+                        f"non-negative number, got {val!r}")
+    return errs
+
+
 def validate_resource_flavor(rf: ResourceFlavor) -> List[str]:
     errs: List[str] = []
+    import math
+    sc = rf.speed_class
+    if not isinstance(sc, (int, float)) or math.isnan(sc) \
+            or math.isinf(sc) or sc <= 0:
+        errs.append("spec.speedClass: must be a finite positive number, "
+                    f"got {sc!r}")
     for k, v in rf.node_labels:
         if not _QUALIFIED_NAME.match(k):
             errs.append(f"spec.nodeLabels: invalid key {k!r}")

@@ -73,6 +73,42 @@ def test_every_solve_entry_point_exists():
             f"{spec.name}: {spec.module}.{spec.entry} does not exist"
 
 
+def test_every_solve_mode_is_registered():
+    """An UNREGISTERED solve mode fails CI: every mode in SOLVE_MODES
+    must name only registered SOLVE_ENTRYPOINTS kernels, every one of
+    those kernels must be in the kueueverify trace roster, and the
+    config layer must accept exactly the registered mode names — so a
+    new `tpuSolver.mode` cannot land with unverified kernels."""
+    entry_names = {s.name for s in modes.SOLVE_ENTRYPOINTS}
+    roster = {spec.name for spec in trace_rules.package_roster()}
+    names = [m.name for m in modes.SOLVE_MODES]
+    assert len(names) == len(set(names))
+    assert "default" in names
+    for mode in modes.SOLVE_MODES:
+        assert mode.entrypoints, f"mode {mode.name}: no entrypoints"
+        missing = set(mode.entrypoints) - entry_names
+        assert not missing, \
+            f"mode {mode.name}: entrypoints missing from " \
+            f"SOLVE_ENTRYPOINTS: {missing}"
+        untraced = set(mode.entrypoints) - roster
+        assert not untraced, \
+            f"mode {mode.name}: kernels missing from the kueueverify " \
+            f"trace roster: {untraced}"
+
+
+def test_config_accepts_only_registered_solve_modes():
+    from kueue_tpu.config import (
+        Configuration, TPUSolverConfig, validate_configuration)
+
+    for name in modes.solve_mode_names():
+        cfg = Configuration(tpu_solver=TPUSolverConfig(mode=name))
+        assert not [e for e in validate_configuration(cfg)
+                    if "tpuSolver.mode" in e]
+    bad = Configuration(tpu_solver=TPUSolverConfig(mode="not-a-mode"))
+    assert any("tpuSolver.mode" in e
+               for e in validate_configuration(bad))
+
+
 def test_optional_engines_are_skipped_only_when_unimportable():
     from tests import test_preemption_goldens as goldens
 
