@@ -10,7 +10,8 @@
 PYTHON ?= python
 
 .PHONY: help test test-fast bench bench-smoke trace-smoke multichip-smoke \
-	replica-smoke hetero-smoke native lint verify-static install serve dryrun
+	replica-smoke multihost-smoke hetero-smoke native lint verify-static \
+	install serve dryrun
 
 help:
 	@echo "kueue-tpu developer targets:"
@@ -34,6 +35,12 @@ help:
 	@echo "                      spawn-mode identity gate + fail-over"
 	@echo "                      drill + the replica bench config with"
 	@echo "                      commit-protocol evidence gates"
+	@echo "  make multihost-smoke  2-emulated-host socket-transport run:"
+	@echo "                      frame codec + channel tests, coordinator"
+	@echo "                      kill + replica SIGKILL + revocation +"
+	@echo "                      SIGSTOP-watchdog drills, packet-delay"
+	@echo "                      injection, elastic scaling, and the"
+	@echo "                      multihost bench config's evidence gates"
 	@echo "  make native         build the C++ runtime pieces"
 	@echo "  make serve          run the API server"
 	@echo "  make dryrun         compile-check the flagship jit path"
@@ -68,9 +75,11 @@ bench-smoke:
 	  assert not missing, f'configs missing from BENCH output: {missing}'; \
 	  steady = METRIC_NAMES['steady']; \
 	  replica = METRIC_NAMES['replica']; \
+	  multihost = METRIC_NAMES['multihost']; \
 	  ratios = {m: l.get('arena_reuse_ratio') for m, l in by.items()}; \
 	  bad = {m: r for m, r in ratios.items() \
-	         if (r is None or r <= 0.9) and m not in (steady, replica)}; \
+	         if (r is None or r <= 0.9) and m not in (steady, replica, \
+	                                                  multihost)}; \
 	  assert not bad, f'arena_reuse_ratio <= 0.9: {bad}'; \
 	  rebuilds = {m: l.get('arena_full_rebuilds') for m, l in by.items()}; \
 	  assert not any(rebuilds.values()), f'full rebuilds in window: {rebuilds}'; \
@@ -125,12 +134,19 @@ bench-smoke:
 	    f'replica config missing reconcile_rtt_ms evidence: {rep}'; \
 	  assert rep.get('peak_rss_mb', 0) > 0 and rep.get('n_replicas', 0) >= 2, \
 	    f'replica config missing peak-RSS / replica-count evidence: {rep}'; \
+	  mh = by[multihost]; \
+	  assert mh.get('transport') == 'socket', mh; \
+	  assert mh.get('coordinator_failover'), mh; \
+	  assert (mh.get('elastic_drill') or {}).get('steady_dispatches') == 0, mh; \
 	  print('bench-smoke fair gate OK: ratio', r, \
 	        'share_compute_ms', fair.get('fair_share_compute_ms'), \
 	        'fair_steady_dispatches', fsteady.get('solver_dispatches')); \
 	  print('bench-smoke replica gate OK: replicas', rep.get('n_replicas'), \
 	        'rtt_p99_ms', rtt.get('p99'), 'revocations', \
-	        drill.get('revocations'), 'peak_rss_mb', rep.get('peak_rss_mb'))"
+	        drill.get('revocations'), 'peak_rss_mb', rep.get('peak_rss_mb')); \
+	  print('bench-smoke multihost gate OK: epoch', \
+	        mh.get('reconcile_epoch'), 'rtt_p99_ms', \
+	        (mh.get('reconcile_rtt_ms') or {}).get('p99'))"
 
 # End-to-end tracing smoke: drive the real CLI with span tracing on,
 # then prove the exported file is valid Chrome trace-event JSON (the
@@ -230,6 +246,50 @@ replica-smoke:
 	        'revocations', rep['forced_revocation_drill']['revocations'], \
 	        'peak_rss_mb', rep['peak_rss_mb'], \
 	        'scaling', rep.get('p99_scaling_ratio'))"
+
+# Multi-host smoke on CPU: the frame-codec / fault-injection / reliable-
+# channel unit tests, the two-emulated-host (separate state dirs,
+# loopback sockets) identity goldens vs the pipe transport — with and
+# without injected packet delay — the coordinator-kill mid-window
+# fail-over (epoch bump + journaled-verdict replay), the SIGSTOP
+# barrier-stall watchdog regression, journal replication, the elastic
+# scaling + capacity-loan drills, and then the multihost bench config
+# whose in-run gates re-prove the kill drills (coordinator kill +
+# replica SIGKILL == uninterrupted == single-process, zero
+# oversubscription) and the Aryl elastic loop at smoke scale. Runs in
+# CI next to replica-smoke so the network seam cannot rot.
+multihost-smoke:
+	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_transport.py \
+	  tests/test_multihost.py -q
+	KUEUE_BENCH_SMOKE=1 KUEUE_BENCH_TICKS=10 KUEUE_TPU_REPLICAS=2 \
+	  KUEUE_BENCH_CONFIG=multihost JAX_PLATFORMS=cpu \
+	  $(PYTHON) bench.py > /tmp/kueue-multihost-smoke.jsonl
+	@cat /tmp/kueue-multihost-smoke.jsonl
+	$(PYTHON) -c "import json; \
+	  lines = [json.loads(l) for l in open('/tmp/kueue-multihost-smoke.jsonl') \
+	           if l.strip().startswith('{')]; \
+	  rep = lines[-1]; \
+	  assert rep['metric'] == 'p99_multihost_tick_ms', rep; \
+	  assert rep.get('transport') == 'socket', rep; \
+	  assert rep.get('per_host_state') is True, rep; \
+	  assert rep.get('fault_delay_ms'), rep; \
+	  fo = rep.get('coordinator_failover') or {}; \
+	  assert fo.get('epoch_after', 0) > fo.get('epoch_before', 0), rep; \
+	  kd = rep.get('kill_drill') or {}; \
+	  assert kd.get('admitted', 0) > 0, rep; \
+	  el = rep.get('elastic_drill') or {}; \
+	  assert el.get('scaled_up') and (el.get('scaled_down') or \
+	    el.get('returned')), rep; \
+	  assert el.get('steady_dispatches') == 0, rep; \
+	  assert el.get('loan_throughput_gain') is not None, rep; \
+	  assert rep.get('identity_gate_admitted', 0) > 0, rep; \
+	  assert (rep.get('forced_revocation_drill') or {}) \
+	    .get('revocations', 0) >= 1, rep; \
+	  rtt = rep.get('reconcile_rtt_ms') or {}; \
+	  assert rtt.get('p99') is not None, rep; \
+	  print('multihost-smoke OK: rtt_p99_ms', rtt.get('p99'), \
+	        'epoch', rep.get('reconcile_epoch'), 'elastic', \
+	        el.get('actions'), 'gain', el.get('loan_throughput_gain'))"
 
 # Build the C++ runtime pieces (keyed heap, admission decoder) explicitly;
 # they are also built lazily on first import.

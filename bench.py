@@ -571,6 +571,7 @@ METRIC_NAMES = {
     "steady": "p99_steady_state_tick_ms",
     "shard": "p99_sharded_tick_ms",
     "replica": "p99_replica_tick_ms",
+    "multihost": "p99_multihost_tick_ms",
     "hetero": "p99_hetero_tick_ms",
     "northstar": "p99_e2e_tick_ms",
 }
@@ -617,7 +618,9 @@ def _shard_identity_gate(n_shards: int, ticks: int = 25) -> int:
     return len(sharded)
 
 
-def _replica_identity_gate(replicas: int, ticks: int = 25) -> int:
+def _replica_identity_gate(replicas: int, ticks: int = 25,
+                           transport: str = "pipe",
+                           state_dir=None) -> int:
     """`_shard_identity_gate` for the PROCESS split: drive the golden
     seed through a replicas=N deployment (loopback transport — the
     protocol and worker code are identical to spawn mode, pinned by
@@ -653,7 +656,8 @@ def _replica_identity_gate(replicas: int, ticks: int = 25) -> int:
         fw.tick()
         fw.prewarm_idle()
 
-    rt = ReplicaRuntime(replicas, spawn=False)
+    rt = ReplicaRuntime(replicas, spawn=False, transport=transport,
+                        state_dir=state_dir)
     try:
         rt.load_synthetic(**kw)
         sharded: set = set()
@@ -673,7 +677,8 @@ def _replica_identity_gate(replicas: int, ticks: int = 25) -> int:
     return len(sharded)
 
 
-def _replica_revocation_drill() -> dict:
+def _replica_revocation_drill(transport: str = "pipe",
+                              state_dir=None) -> dict:
     """Force >= 1 cross-replica revocation and return the coordinator's
     evidence: two same-tick heads on different replicas of a split
     KEP-79 tree both borrow from one lending-limited pool that can serve
@@ -701,7 +706,8 @@ def _replica_revocation_drill() -> dict:
         return ResourceGroup(covered_resources=("cpu",),
                              flavors=tuple(quotas))
 
-    rt = ReplicaRuntime(2, spawn=False, engine="host")
+    rt = ReplicaRuntime(2, spawn=False, engine="host",
+                        transport=transport, state_dir=state_dir)
     try:
         rt.create_resource_flavor(ResourceFlavor.make("on-demand"))
         rt.create_cohort(CohortSpec(name="hroot"))
@@ -744,23 +750,323 @@ def _replica_revocation_drill() -> dict:
     return evidence
 
 
+def _multihost_kill_drill_gate(state_root: str, ticks: int = 14) -> dict:
+    """The multi-host fail-over identity gate: drive one seed through
+    THREE deployments — (A) socket transport, per-host state dirs,
+    seeded packet delay, a coordinator kill AND a replica SIGKILL
+    mid-window; (B) the same deployment uninterrupted; (C) the
+    single-process scheduler — and FAIL the bench unless all three end
+    on the SAME admitted set with zero quota oversubscription. This is
+    the drill the transport subsystem exists to survive."""
+    import os as _os
+
+    from kueue_tpu.config import Configuration, TPUSolverConfig
+    from kueue_tpu.controllers.replica_runtime import ReplicaRuntime
+    from kueue_tpu.controllers.runtime import Framework
+    from kueue_tpu.transport import FaultPlan
+
+    def build(t):
+        from kueue_tpu.api.types import (
+            ClusterQueue, FlavorQuotas, LocalQueue, PodSet,
+            ResourceFlavor, ResourceGroup, Workload)
+
+        t.create_resource_flavor(ResourceFlavor.make("default"))
+        for i in range(6):
+            t.create_cluster_queue(ClusterQueue(
+                name=f"mh-cq-{i}", resource_groups=(ResourceGroup(
+                    covered_resources=("cpu",),
+                    flavors=(FlavorQuotas.make("default", cpu=6),)),)))
+            t.create_local_queue(LocalQueue(
+                name=f"mh-lq-{i}", namespace="default",
+                cluster_queue=f"mh-cq-{i}"))
+        for i in range(6):
+            for j in range(4):
+                t.submit(Workload(
+                    name=f"mh-{i}-{j}", namespace="default",
+                    queue_name=f"mh-lq-{i}", priority=j % 2,
+                    creation_time=float(i * 10 + j),
+                    pod_sets=[PodSet.make("ps0", count=1, cpu=3)]))
+
+    # (C) single-process reference.
+    fw = Framework(batch_solver=None, config=Configuration(
+        tpu_solver=TPUSolverConfig(enable=False)))
+    fw.create_namespace("default", labels={})
+    build(fw)
+    fw.run_until_settled(max_ticks=ticks)
+    expect = {name: sorted(cq.workloads)
+              for name, cq in fw.cache.cluster_queues.items()}
+    # cpu=6 in milli-units, the cache's usage resolution.
+    quota = {name: 6000 for name in expect}
+
+    def run(tag, kill):
+        rt = ReplicaRuntime(
+            2, spawn=True, engine="host", transport="socket",
+            state_dir=_os.path.join(state_root, tag),
+            faults=FaultPlan(seed=9, delay_ms=2.0, delay_prob=0.4))
+        try:
+            build(rt)
+            for i in range(ticks):
+                if kill and i == 4:
+                    rt.kill_coordinator()
+                if kill and i == 7:
+                    rt.kill_replica(rt.group_owner[
+                        rt.gmap.cq_group["mh-cq-0"]])
+                rt.tick()
+            dump = rt.dump()
+            for name, usage in dump["usage"].items():
+                used = sum(usage.get("default", {}).values())
+                if used > quota.get(name, 0):
+                    raise RuntimeError(
+                        f"[multihost] quota OVERSUBSCRIBED on {name}: "
+                        f"{used} > {quota[name]} after the {tag} drill")
+            return ({name: sorted(keys)
+                     for name, keys in dump["admitted"].items()},
+                    rt.failover_evidence, rt.coordinator.epoch)
+        finally:
+            rt.close()
+
+    interrupted, failover, epoch = run("drill", kill=True)
+    clean, _, _ = run("clean", kill=False)
+    for tag, got in (("interrupted", interrupted), ("clean", clean)):
+        if got != expect:
+            raise RuntimeError(
+                f"[multihost] the {tag} multi-host run admitted a "
+                f"DIFFERENT set than single-process: {got} != {expect} "
+                "— fail-over or the socket transport broke decision "
+                "identity; do not trust this run.")
+    if failover is None or failover["epoch_after"] <= \
+            failover["epoch_before"]:
+        raise RuntimeError(
+            "[multihost] the coordinator kill drill never failed over "
+            f"(evidence: {failover}); do not trust this run.")
+    return {"admitted": sum(len(v) for v in expect.values()),
+            "coordinator_failover": failover,
+            "final_epoch": epoch}
+
+
+def _multihost_elastic_drill(ticks: int = 24, n_cqs: int = 48,
+                             backlog_per_cq: int = 6,
+                             spawn: bool = False) -> dict:
+    """The Aryl elastic drill: replicas scale N -> N+1 (load) -> N
+    (drain) LIVE during churn, with capacity LOANED from an idle
+    replica to the loaded one in between — and after resettling, a
+    steady window must dispatch ZERO solves (the quiescent-tick
+    discipline survives every migration). Returns throughput evidence:
+    admitted/s for the LOADED groups before vs during the loan — the
+    number Aryl's loaning loop exists to raise. (Per-tick host cost
+    scales with the number of ClusterQueues carrying heads, so the
+    loaded groups hold MANY small CQs; the loan splits them across
+    processes and the wall-clock per tick — hence admissions/s at
+    constant per-tick quota — improves.)"""
+    from kueue_tpu.controllers.replica_runtime import ReplicaRuntime
+    from kueue_tpu.transport import ElasticController
+
+    from kueue_tpu.api.types import (
+        ClusterQueue, FlavorQuotas, LocalQueue, PodSet, ResourceFlavor,
+        ResourceGroup, Workload)
+
+    rt = ReplicaRuntime(2, spawn=spawn, engine=None, transport="socket",
+                        n_groups=8)
+    ctl = ElasticController(rt, scale_up_backlog=8, idle_backlog=0,
+                            loan_min_backlog=4, min_replicas=2,
+                            max_replicas=3, cooldown_ticks=1)
+    try:
+        rt.create_resource_flavor(ResourceFlavor.make("default"))
+        for i in range(n_cqs):
+            rt.create_cluster_queue(ClusterQueue(
+                name=f"el-cq-{i}", resource_groups=(ResourceGroup(
+                    covered_resources=("cpu",),
+                    flavors=(FlavorQuotas.make("default", cpu=4),)),)))
+            rt.create_local_queue(LocalQueue(
+                name=f"el-lq-{i}", namespace="default",
+                cluster_queue=f"el-cq-{i}"))
+        # Load ONLY worker 0's groups (the "loaded group" of the gate);
+        # worker 1 idles — the Aryl shape.
+        loaded_cqs = [
+            i for i in range(n_cqs)
+            if rt.group_owner[rt.gmap.cq_group[f"el-cq-{i}"]] == 0]
+        seq = [0]
+        outstanding: set = set()
+
+        def submit_loaded(n_each):
+            for i in loaded_cqs:
+                for _ in range(n_each):
+                    seq[0] += 1
+                    key = f"default/el-{seq[0]}"
+                    outstanding.add(key)
+                    rt.submit(Workload(
+                        name=f"el-{seq[0]}", namespace="default",
+                        queue_name=f"el-lq-{i}",
+                        creation_time=float(seq[0]),
+                        pod_sets=[PodSet.make("ps0", count=1, cpu=2)]))
+
+        rr = [0]
+
+        def resupply(n):
+            """One fresh arrival per finished workload (round-robin over
+            the loaded CQs): the loaded groups stay loaded, so both
+            measured windows see the same sustained demand."""
+            for _ in range(n):
+                i = loaded_cqs[rr[0] % len(loaded_cqs)]
+                rr[0] += 1
+                seq[0] += 1
+                key = f"default/el-{seq[0]}"
+                outstanding.add(key)
+                rt.submit(Workload(
+                    name=f"el-{seq[0]}", namespace="default",
+                    queue_name=f"el-lq-{i}",
+                    creation_time=float(seq[0]),
+                    pod_sets=[PodSet.make("ps0", count=1, cpu=2)]))
+
+        submit_loaded(backlog_per_cq)
+        rt.tick()  # settle routing + first admissions off the clock
+
+        def window(n, step_ctl, churn=True):
+            """n churn ticks: finish everything admitted and resupply
+            (so quota refills and throughput is compute-bound, not
+            quota- or supply-bound); returns
+            (admitted_for_loaded_groups, elapsed_s)."""
+            admitted = 0
+            t0 = time.perf_counter()
+            for _ in range(n):
+                stats = rt.tick()
+                done = [(k, cq) for k, cq in stats["admitted"]]
+                admitted += sum(
+                    1 for _k, cq in done if cq.startswith("el-cq-"))
+                if done:
+                    for k, _cq in done:
+                        outstanding.discard(k)
+                    rt.finish_many(done)
+                    if churn:
+                        resupply(len(done))
+                if step_ctl:
+                    ctl.step(rt.backlog_last)
+            return admitted, time.perf_counter() - t0
+
+        # Window 1: loaded worker alone (controller off) — the
+        # steady-state BEFORE any capacity arrives.
+        a1, t1 = window(max(ticks // 3, 4), step_ctl=False)
+        submit_loaded(backlog_per_cq // 2 or 1)
+        # Transition (unmeasured): the controller loans/scales while
+        # churn continues; migrations + the new workers' cold compiles
+        # land here, not in either measured window. Settled = three
+        # consecutive idle policy steps.
+        idle_steps = 0
+        for _ in range(ticks):
+            stats = rt.tick()
+            done = [(k, cq) for k, cq in stats["admitted"]]
+            if done:
+                for k, _cq in done:
+                    outstanding.discard(k)
+                rt.finish_many(done)
+                resupply(len(done))
+            act = ctl.step(rt.backlog_last)
+            idle_steps = 0 if act else idle_steps + 1
+            if idle_steps >= 3 and any(
+                    a.startswith(("loan", "scale-up"))
+                    for a in ctl.actions):
+                break
+        # Window 2: the loaded groups now run on the borrowed capacity
+        # (controller off again) — the steady-state DURING the loan.
+        a2, t2 = window(max(ticks // 3, 4), step_ctl=False)
+        # Window 3: churn stops refilling; the backlog drains and the
+        # controller takes the DOWN half (return + scale-down).
+        a3, t3 = window(max(ticks // 3, 4), step_ctl=True, churn=False)
+        # Drain: finish the last admissions, CANCEL the rest of the
+        # synthetic backlog (the drill measured what it needed), and
+        # let the controller finish the DOWN half — loans return home,
+        # the surplus replica empties and stops.
+        stats = rt.tick()
+        done = [(k, cq) for k, cq in stats["admitted"]]
+        if done:
+            for k, _cq in done:
+                outstanding.discard(k)
+            rt.finish_many(done)
+        for key in sorted(outstanding):
+            rt.delete_workload(key)
+        outstanding.clear()
+        for _ in range(10):
+            stats = rt.tick()
+            done = [(k, cq) for k, cq in stats["admitted"]]
+            if done:
+                rt.finish_many(done)
+            ctl.step(rt.backlog_last)
+        # Post-resettle steady window: zero dispatches, or the elastic
+        # churn broke the quiescent-tick discipline.
+        steady_dispatches = 0
+        for _ in range(3):
+            steady_dispatches += rt.tick()["dispatches"] or 0
+        tput_before = a1 / t1 if t1 else 0.0
+        tput_during = a2 / t2 if t2 else 0.0
+        evidence = {
+            "actions": list(ctl.actions),
+            "scaled_up": any(a.startswith("scale-up")
+                             for a in ctl.actions),
+            "loaned": any(a.startswith("scale-up") or a.startswith("loan")
+                          for a in ctl.actions),
+            "scaled_down": any(a.startswith("scale-down")
+                               for a in ctl.actions),
+            "returned": any(a.startswith("return") for a in ctl.actions),
+            "n_workers_final": len([w for w in rt.workers if w.alive]),
+            "loaded_tput_before_per_s": round(tput_before, 1),
+            "loaded_tput_during_loan_per_s": round(tput_during, 1),
+            "loan_throughput_gain": (round(tput_during / tput_before, 3)
+                                     if tput_before else None),
+            "steady_dispatches": steady_dispatches,
+            "drained": sum(rt.dump()["pending"].values()) == 0,
+        }
+    finally:
+        rt.close()
+    if not evidence["scaled_up"]:
+        raise RuntimeError(
+            "[multihost] the elastic drill never scaled up under load "
+            f"(actions: {evidence['actions']}); do not trust this run.")
+    if not (evidence["scaled_down"] or evidence["returned"]):
+        raise RuntimeError(
+            "[multihost] the elastic drill never scaled back down / "
+            f"returned the loan (actions: {evidence['actions']}).")
+    if evidence["steady_dispatches"]:
+        raise RuntimeError(
+            "[multihost] the post-resettle steady window dispatched "
+            f"{evidence['steady_dispatches']} solves — elastic churn "
+            "broke the quiescent-tick discipline.")
+    return evidence
+
+
 def run_replica_config(*, label, replicas, num_cqs, num_cohorts,
                        num_flavors, backlog, ticks, usage_fill, seed=42,
-                       spawn=True, warmup=12):
+                       spawn=True, warmup=12, transport="pipe",
+                       state_dir=None, fault_delay_ms=0.0,
+                       mid_window=None):
     """One multi-process replica window: N spawn-mode worker processes
     (each owning its shard groups' full vertical slice), the parent
     driving the tick barrier + coordinator. The synthetic load is
     generated WORKER-SIDE (each process keeps only its cohort-hash
     slice from the shared seed), so the 1M-backlog window loads without
     a million workloads ever crossing the parent pipe; churn rides the
-    compact submit_many/finish_many bulk messages."""
+    compact submit_many/finish_many bulk messages.
+
+    `transport="socket"` runs the framed multi-host protocol with
+    per-host state dirs under `state_dir` (+ coordinator journal
+    replication) and optional seeded packet-delay injection;
+    `mid_window(i, rt)` fires before measured tick i — the coordinator-
+    kill / replica-SIGKILL drill hook."""
     from kueue_tpu.controllers.replica_runtime import ReplicaRuntime
+    from kueue_tpu.transport import FaultPlan
 
     t0 = time.perf_counter()
-    rt = ReplicaRuntime(replicas, spawn=spawn)
+    faults = FaultPlan(seed=seed, delay_ms=fault_delay_ms,
+                       delay_prob=0.5) if fault_delay_ms else None
     # First ticks at 1M backlog pay the whole-backlog encode + XLA
-    # compile inside one barrier round; the default 60s round timeout
-    # would misread that as a dead worker.
+    # compile inside one barrier round; the default 60s deadline would
+    # misread that as a dead worker — on BOTH sides of the watchdog:
+    # the env var reaches the spawned workers' verdict wait, which the
+    # parent-side round_timeout alone would not.
+    if float(os.environ.get("KUEUE_TPU_BARRIER_DEADLINE", "0") or 0) \
+            < 900.0:
+        os.environ["KUEUE_TPU_BARRIER_DEADLINE"] = "900"
+    rt = ReplicaRuntime(replicas, spawn=spawn, transport=transport,
+                        state_dir=state_dir, faults=faults)
     rt.round_timeout = max(rt.round_timeout, 900.0)
     try:
         rt.load_synthetic(
@@ -824,7 +1130,9 @@ def run_replica_config(*, label, replicas, num_cqs, num_cohorts,
         admitted = 0
         preempted = 0
         revocations = 0
-        for _ in range(ticks):
+        for i in range(ticks):
+            if mid_window is not None:
+                mid_window(i, rt)
             tick_no[0] += 1
             t = time.perf_counter()
             stats = rt.tick()
@@ -844,7 +1152,17 @@ def run_replica_config(*, label, replicas, num_cqs, num_cohorts,
         out = {
             "ticks": ticks,
             "n_replicas": replicas,
-            "transport": "spawn" if spawn else "loopback",
+            "transport": ("socket" if transport == "socket"
+                          else "spawn" if spawn else "loopback"),
+            "process_mode": "spawn" if spawn else "loopback",
+            "fault_delay_ms": fault_delay_ms or None,
+            "per_host_state": rt.per_host,
+            "coordinator_failover": rt.failover_evidence,
+            "barrier_stalls": rt.stall_count,
+            "journal_replicated_lines": (
+                rt.replicator.applied_lines
+                if rt.replicator is not None else None),
+            "reconcile_epoch": rt.coordinator.epoch,
             "p50_ms": round(p50, 3),
             "p99_ms": round(p99, 3),
             "mean_ms": round(float(times_ms.mean()), 3),
@@ -1224,6 +1542,78 @@ def run_one(config: str) -> None:
                 "the replica split is not absorbing the scale axis it "
                 "exists for.")
         emit(METRIC_NAMES[config], s_large)
+    elif config == "multihost":
+        # Multi-host transport (ROADMAP item 1, the network era): the
+        # replica deployment over the framed SOCKET protocol — separate
+        # per-host state dirs, coordinator-owned journal replication,
+        # seeded packet-delay injection — with every drill the subsystem
+        # exists to survive re-proven in-run BEFORE the measured window:
+        # the socket identity gate, the cross-replica revocation drill
+        # over sockets, the kill-drill gate (coordinator kill + replica
+        # SIGKILL mid-window == uninterrupted == single-process, zero
+        # oversubscription), and the Aryl elastic drill (scale
+        # N->N+1->N live, capacity loaned idle->loaded, post-resettle
+        # steady window dispatching zero solves). The measured window
+        # then runs the socket transport at scale WITH injected delay
+        # and a coordinator kill mid-window. (The replica SIGKILL drill
+        # lives in the store-fed kill-drill gate: the measured window's
+        # worker-side synthetic load deliberately bypasses the Store,
+        # so it has no journal to fail over from.)
+        import tempfile
+
+        if os.environ.get("KUEUE_BENCH_FORCE_CPU") == "1":
+            os.environ["JAX_PLATFORMS"] = "cpu"
+        n_rep = int(os.environ.get("KUEUE_TPU_REPLICAS", "2") or 2)
+        with tempfile.TemporaryDirectory() as td:
+            identity_admitted = _replica_identity_gate(
+                n_rep, transport="socket",
+                state_dir=os.path.join(td, "ident"))
+            drill = _replica_revocation_drill(
+                transport="socket", state_dir=os.path.join(td, "revoke"))
+            kill_drill = _multihost_kill_drill_gate(
+                os.path.join(td, "kill"))
+            elastic = _multihost_elastic_drill(
+                spawn=not smoke,
+                n_cqs=48 if smoke else 240,
+                backlog_per_cq=6 if smoke else 8)
+            if smoke:
+                shape = dict(num_cqs=48, num_cohorts=12, num_flavors=4,
+                             backlog=768)
+            else:
+                # The acceptance shape: the 1M-backlog / 10k-CQ window
+                # over real sockets with packet delay.
+                shape = dict(num_cqs=10_000, num_cohorts=1000,
+                             num_flavors=8, backlog=1_000_000)
+            w_ticks = max(ticks // 2, 8)
+            kill_at = max(w_ticks // 3, 2)
+
+            def mid_window(i, rt):
+                if i == kill_at:
+                    rt.kill_coordinator()
+
+            s = run_replica_config(
+                label="multihost", replicas=n_rep, ticks=w_ticks,
+                usage_fill=0.7, transport="socket",
+                state_dir=os.path.join(td, "bench"),
+                fault_delay_ms=2.0, mid_window=mid_window, **shape)
+        s.update({
+            "n_hosts": n_rep,
+            "identity_gate_admitted": identity_admitted,
+            "forced_revocation_drill": drill,
+            "kill_drill": kill_drill,
+            "elastic_drill": elastic,
+        })
+        if s.get("coordinator_failover") is None:
+            raise RuntimeError(
+                "[multihost] the measured window's coordinator kill "
+                "never fired; do not trust this run.")
+        gain = elastic.get("loan_throughput_gain")
+        if not smoke and (gain is None or gain <= 1.0):
+            raise RuntimeError(
+                f"[multihost] capacity loaning did not raise the loaded "
+                f"group's admitted throughput (gain {gain}); the Aryl "
+                "loop is not delivering; do not trust this run.")
+        emit(METRIC_NAMES[config], s)
     else:
         # North-star headline (config #5 shape): LAST line = parsed metric.
         emit(METRIC_NAMES["northstar"], run_config(
@@ -1264,14 +1654,15 @@ def main() -> None:
               "backend for this run", file=sys.stderr)
         env_extra["KUEUE_BENCH_FORCE_CPU"] = "1"
     for config in ("single", "cohortlend", "preempt", "fair", "topo",
-                   "steady", "shard", "hetero", "replica", "northstar"):
+                   "steady", "shard", "hetero", "replica", "multihost",
+                   "northstar"):
         env = dict(os.environ, KUEUE_BENCH_CONFIG=config, **env_extra)
         # Generous ceiling: a healthy config finishes in minutes; a
         # device attachment dying MID-RUN (after the probe passed)
         # hangs forever otherwise. The replica config gets longer — its
         # 1M-backlog window generates and loads 4 worker processes'
         # slices before the first measured tick.
-        budget = 3600 if config == "replica" else 1800
+        budget = 3600 if config in ("replica", "multihost") else 1800
         try:
             res = subprocess.run([sys.executable, os.path.abspath(__file__)],
                                  env=env, stdout=subprocess.PIPE,

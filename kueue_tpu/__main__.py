@@ -109,6 +109,18 @@ def build_parser() -> argparse.ArgumentParser:
                         "shard group) behind the coordinator commit "
                         "protocol; defaults to $KUEUE_TPU_REPLICAS, and "
                         "KUEUE_TPU_NO_REPLICA=1 forces single-process")
+    parser.add_argument("--transport", choices=("pipe", "socket"),
+                        default=None,
+                        help="replica transport: pipe (single-machine "
+                        "multiprocessing pipes) or socket (framed "
+                        "reconcile protocol over TCP with per-host state "
+                        "dirs + journal replication); defaults to the "
+                        "config file's transport.mode, and "
+                        "KUEUE_TPU_NO_SOCKET=1 forces pipe")
+    parser.add_argument("--listen", default=None, metavar="HOST:PORT",
+                        help="coordinator bind address for the socket "
+                        "transport (port 0 = ephemeral; defaults to "
+                        "transport.listen, 127.0.0.1:0)")
     parser.add_argument("--leader-elect", action="store_true",
                         help="join lease-based leader election")
     parser.add_argument("--lease-file", default=None,
@@ -143,12 +155,34 @@ def _replica_main(args, cfg, n_replicas: int) -> int:
         ReplicaRuntime,
         ReplicaStoreBridge,
     )
+    from kueue_tpu.controllers.replica_runtime import transport_from_env
+    from kueue_tpu.transport import parse_fault_env
 
+    # Precedence: --transport flag > KUEUE_TPU_TRANSPORT env > config
+    # (KUEUE_TPU_NO_SOCKET=1 beats all of them, inside the runtime).
+    transport = args.transport or transport_from_env(cfg.transport.mode)
+    listen = None
+    if args.listen:
+        try:
+            host, _, port = args.listen.rpartition(":")
+            listen = (host or "127.0.0.1", int(port))
+        except (ValueError, TypeError):
+            raise SystemExit(
+                f"--listen: invalid address {args.listen!r} "
+                "(want host:port, port 0 for ephemeral)")
+    elif transport == "socket":
+        listen = cfg.transport.listen_addr()
     rt = ReplicaRuntime(n_replicas, spawn=True, state_dir=args.state_dir,
                         solver=args.batch_solver,
-                        trace=bool(args.trace_out))
+                        trace=bool(args.trace_out),
+                        transport=transport, listen=listen,
+                        faults=parse_fault_env(cfg.transport.faults))
     store = Store()
     ReplicaStoreBridge(store, rt)
+    # SIGUSR2 in replica mode dumps the COORDINATOR's view: barrier
+    # round + epoch, per-shard-group backlog depth, group ownership.
+    dumper = Dumper(reconcile=rt.reconcile_info)
+    dumper.listen_for_signal()
 
     server = None
     if args.port is not None:

@@ -160,6 +160,31 @@ class TPUSolverConfig:
 
 
 @dataclass(frozen=True)
+class TransportConfig:
+    """Replica transport (kueue_tpu/transport) — how scheduler replicas
+    and the coordinator talk.
+
+    `mode` "pipe" keeps the single-machine multiprocessing pipes;
+    "socket" runs the length-prefixed framed reconcile protocol over
+    TCP (per-host state dirs + coordinator-owned journal replication,
+    the multi-host deployment). `listen` is the coordinator's bind
+    address ("host:port", port 0 = ephemeral); `peers` carries the
+    replica hosts' advertised addresses (accepted and carried for
+    real multi-machine deployments; the single-binary CLI spawns its
+    replicas locally and they dial `listen`). `faults` is a drill-only
+    injection spec ("delay_ms=5,delay_p=0.5,drop_p=0.01,seed=7").
+    Kill switch: KUEUE_TPU_NO_SOCKET=1 forces pipe mode."""
+    mode: str = "pipe"
+    listen: str = "127.0.0.1:0"
+    peers: Tuple[str, ...] = ()
+    faults: str = ""
+
+    def listen_addr(self) -> Tuple[str, int]:
+        host, _, port = self.listen.rpartition(":")
+        return (host or "127.0.0.1", int(port))
+
+
+@dataclass(frozen=True)
 class LeaderElectionConfig:
     """Lease-based leader election for HA replicas
     (configv1alpha1.LeaderElectionConfiguration; defaults.go:37-44)."""
@@ -193,6 +218,7 @@ class Configuration:
     multikueue: MultiKueueConfig = field(default_factory=MultiKueueConfig)
     leader_election: LeaderElectionConfig = field(default_factory=LeaderElectionConfig)
     tpu_solver: TPUSolverConfig = field(default_factory=TPUSolverConfig)
+    transport: TransportConfig = field(default_factory=TransportConfig)
     metrics: MetricsConfig = field(default_factory=MetricsConfig)
     # Transport-only reference knobs, carried opaquely (see module doc).
     extra: Dict[str, Any] = field(default_factory=dict)
@@ -348,6 +374,15 @@ def from_dict(doc: Mapping[str, Any]) -> Configuration:
             cohort_shards=int(t.get("cohortShards", 0)),
             mode=t.get("mode") or "default")
 
+    tr = TransportConfig()
+    if doc.get("transport") is not None:
+        t = doc["transport"]
+        tr = TransportConfig(
+            mode=t.get("mode") or "pipe",
+            listen=t.get("listen") or "127.0.0.1:0",
+            peers=tuple(t.get("peers") or ()),
+            faults=t.get("faults") or "")
+
     mc = MetricsConfig()
     if isinstance(doc.get("metrics"), dict):
         mc = MetricsConfig(enable_cluster_queue_resources=bool(
@@ -380,6 +415,7 @@ def from_dict(doc: Mapping[str, Any]) -> Configuration:
         multikueue=mk,
         leader_election=le,
         tpu_solver=ts,
+        transport=tr,
         metrics=mc,
         extra={k: doc[k] for k in _TRANSPORT_KEYS if k in doc},
     )
@@ -517,6 +553,23 @@ def validate_configuration(cfg: Configuration) -> List[str]:
         errors.append("tpuSolver.mode: hetero runs single-device or over "
                       "cohortShards — shardDevices is not a supported "
                       "combination")
+
+    # transport
+    tr = cfg.transport
+    if tr.mode not in ("pipe", "socket"):
+        errors.append("transport.mode: must be pipe or socket")
+    try:
+        tr.listen_addr()
+    except (ValueError, TypeError):
+        errors.append(
+            f"transport.listen: invalid address {tr.listen!r} "
+            "(want host:port, port 0 for ephemeral)")
+    if tr.faults:
+        from kueue_tpu.transport.faults import parse_fault_env
+        try:
+            parse_fault_env(tr.faults)
+        except ValueError as exc:
+            errors.append(f"transport.faults: {exc}")
 
     # leaderElection
     le = cfg.leader_election

@@ -16,19 +16,26 @@ from kueue_tpu.queue.manager import Manager
 
 
 class Dumper:
-    def __init__(self, cache: Cache, queues: Manager, events=None,
-                 explain=None):
+    def __init__(self, cache: Cache = None, queues: Manager = None,
+                 events=None, explain=None, reconcile=None):
+        # cache/queues may be None in replica mode: the parent process
+        # owns no scheduler slice — only the coordinator's reconcile
+        # state (the `reconcile` provider below).
         self.cache = cache
         self.queues = queues
         # Optional extras: the Framework's EventRecorder (occupancy /
-        # drop accounting) and the scheduler's ExplainStore (last
-        # admission decision per workload).
+        # drop accounting), the scheduler's ExplainStore (last
+        # admission decision per workload), and the replica runtime's
+        # reconcile info provider (barrier round + coordinator epoch +
+        # per-shard-group backlog depth).
         self.events = events
         self.explain = explain
+        self.reconcile = reconcile
 
     def dump(self) -> Dict:
         cache_dump = {}
-        for name, cq in self.cache.cluster_queues.items():
+        for name, cq in (self.cache.cluster_queues.items()
+                         if self.cache is not None else ()):
             cache_dump[name] = {
                 "cohort": cq.cohort_name,
                 "usage": {f: dict(r) for f, r in cq.usage.items()},
@@ -37,13 +44,16 @@ class Dumper:
                 "active": cq.active(),
             }
         queue_dump = {}
-        for name, cq in self.queues.cluster_queues.items():
+        for name, cq in (self.queues.cluster_queues.items()
+                         if self.queues is not None else ()):
             queue_dump[name] = {
                 "active": [wi.key for wi in cq.heap.items()],
                 "inadmissible": sorted(cq.inadmissible),
                 "popCycle": cq.pop_cycle,
             }
         out = {"cache": cache_dump, "queues": queue_dump}
+        if self.reconcile is not None:
+            out["reconcile"] = self.reconcile()
         if self.events is not None:
             out["events"] = {
                 "occupancy": self.events.occupancy,

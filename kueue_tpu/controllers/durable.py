@@ -55,6 +55,12 @@ class Journal:
         self._lines = 0
         self._store: Optional[Store] = None
         self._owner_lock_file = None
+        # Replication tap (transport/replication.py): every recorded
+        # line is mirrored as ("append", line), every compaction as
+        # ("reset", [lines]) — the multi-host runtime ships these
+        # segment ops to the coordinator so fail-over does not need to
+        # read this host's filesystem. None = no replication.
+        self.sink = None
 
     # -- boot ---------------------------------------------------------------
 
@@ -154,6 +160,8 @@ class Journal:
                         os.fsync(self._file.fileno())
                 sp.set("bytes", len(line) + 1)
             self._lines += 1
+            if self.sink is not None:
+                self.sink(("append", line))
             if self._lines >= COMPACT_MIN_LINES and self._store is not None:
                 live = sum(len(self._store.list(k)) for k in KIND_ORDER)
                 if live * 2 < self._lines:
@@ -169,13 +177,17 @@ class Journal:
         """Atomically rewrite the journal as a snapshot of current state."""
         tmp = f"{self.path}.{os.getpid()}.tmp"
         lines = 0
+        snapshot = [] if self.sink is not None else None
         with open(tmp, "w", encoding="utf-8") as f:
             for kind in KIND_ORDER:
                 for obj in store.list(kind):
                     entry = {"type": store_mod.ADDED, "kind": kind,
                              "key": store_mod._obj_key(kind, obj),
                              "object": serialization.encode(kind, obj)}
-                    f.write(json.dumps(entry, separators=(",", ":")) + "\n")
+                    line = json.dumps(entry, separators=(",", ":"))
+                    f.write(line + "\n")
+                    if snapshot is not None:
+                        snapshot.append(line)
                     lines += 1
             f.flush()
             os.fsync(f.fileno())
@@ -184,6 +196,24 @@ class Journal:
             self._file.close()
         self._file = open(self.path, "a", encoding="utf-8")
         self._lines = lines
+        if snapshot is not None:
+            self.sink(("reset", snapshot))
+
+    def detach(self) -> None:
+        """Stop recording (unhook the store watchers) and release the
+        journal: the single-writer flock clears, so another process —
+        or another replica adopting this shard group — can attach. Used
+        by the live group-migration path: the releasing owner detaches
+        BEFORE deleting the group's objects from its framework, so the
+        deletion storm is never journaled and the file keeps the final
+        state for the adopter's replay."""
+        with self._lock:
+            store = self._store
+        if store is not None:
+            for kind in KIND_ORDER:
+                store.unwatch(kind, self._record)
+        self.close()
+        self._store = None
 
     def close(self) -> None:
         with self._lock:

@@ -77,6 +77,15 @@ class LeaseStore:
             lease = self._leases.get(name)
             return lease.holder if lease is not None else ""
 
+    def transitions(self, name: str) -> int:
+        """Holder-change count (Lease.spec.leaseTransitions) — the
+        multi-host coordinator's barrier-round EPOCH source: every
+        takeover bumps it, so journal entries and reconcile rounds are
+        attributable to exactly one coordinator incarnation."""
+        with self._lock:
+            lease = self._leases.get(name)
+            return lease.transitions if lease is not None else 0
+
 
 class FileLeaseStore:
     """Cross-process lease records in a shared state directory.
@@ -157,6 +166,12 @@ class FileLeaseStore:
             return (lease["holder"] if lease is not None else ""), False
         return self._rmw(read)
 
+    def transitions(self, name: str) -> int:
+        def read(leases):
+            lease = leases.get(name)
+            return (lease["transitions"] if lease is not None else 0), False
+        return self._rmw(read)
+
 
 class LeaderElector:
     """One replica's view of the election.
@@ -209,6 +224,13 @@ class LeaderElector:
             self._last_renew = now
         self._set_leading(ok or self.is_leader())
         return self._leading
+
+    def step_now(self) -> bool:
+        """step() with the retry-period throttle bypassed — the
+        coordinator takeover path cannot wait a retry period to rejoin
+        the election mid-barrier."""
+        self._last_attempt = -float("inf")
+        return self.step()
 
     def release(self) -> None:
         """Voluntarily abdicate (graceful shutdown)."""

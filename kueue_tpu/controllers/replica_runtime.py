@@ -67,8 +67,25 @@ from kueue_tpu.parallel.replica import (
     group_key,
     group_of,
 )
+from kueue_tpu.transport.faults import FaultPlan, parse_fault_env
+from kueue_tpu.transport.replication import JournalReplicator, host_state_dir
+from kueue_tpu.transport.socket_channel import (
+    ChannelListener,
+    SocketChannel,
+    WorkerDiedError,
+)
+from kueue_tpu.transport.watchdog import BarrierStallError, barrier_deadline
 
 _ROUND_TIMEOUT = float(os.environ.get("KUEUE_TPU_ROUND_TIMEOUT", "60"))
+
+
+def transport_from_env(default: str = "pipe") -> str:
+    """The configured replica transport: KUEUE_TPU_TRANSPORT, with the
+    KUEUE_TPU_NO_SOCKET=1 kill switch forcing pipes regardless."""
+    if os.environ.get("KUEUE_TPU_NO_SOCKET", "") == "1":
+        return "pipe"
+    mode = os.environ.get("KUEUE_TPU_TRANSPORT", "") or default
+    return mode if mode in ("pipe", "socket") else default
 
 
 def replicas_from_env() -> int:
@@ -147,6 +164,21 @@ class ReplicaWorker:
         self.worker_id = worker_id
         self.opts = opts
         self.chan = chan
+        self.host_id = opts.get("host_id") or f"host-{worker_id}"
+        # Journal replication (per-host state dirs): each group's
+        # journal tap appends segment ops here; the tick's done reply
+        # ships + clears them (transport/replication.py).
+        self.replicate = bool(opts.get("replicate"))
+        self._seg: Dict[int, list] = {}
+        self.cq_gid: Dict[str, int] = {}     # cq name -> owning group
+        # The parent ships its own barrier deadline so both sides of
+        # the watchdog agree (a bench that raises the parent's round
+        # timeout must raise the workers' verdict wait too, or a fast
+        # worker times out on its slow siblings' phase A).
+        self._barrier_deadline = float(
+            opts.get("barrier_deadline")
+            or barrier_deadline(_ROUND_TIMEOUT))
+        self._dispatches_seen = 0
         batch_solver = None
         if opts.get("solver", True):
             from kueue_tpu.models.flavor_fit import BatchSolver
@@ -212,6 +244,13 @@ class ReplicaWorker:
         restored = 0
         if journal_path:
             journal = Journal(journal_path)
+            if self.replicate:
+                # Tap BEFORE attach: the attach-time compaction ships a
+                # ("reset", snapshot) op, so the coordinator's replica
+                # copy starts from exactly this journal's content.
+                journal.sink = \
+                    lambda op, _g=gid: self._seg.setdefault(
+                        _g, []).append(op)
             restored = journal.attach(store)
         self.groups[gid] = (store, adapter, journal)
         return restored
@@ -221,12 +260,22 @@ class ReplicaWorker:
     def _submit_round(self, payload: dict) -> List[bool]:
         self.chan.send(("round", {"replica": self.worker_id,
                                   "tick": 0, **payload}))
-        msg = self.chan.recv()
+        try:
+            msg = self.chan.recv(timeout=self._barrier_deadline)
+        except (WorkerDied, WorkerDiedError):
+            # The coordinator missed the barrier: surface WHO and WHICH
+            # round instead of blocking this replica forever (the
+            # watchdog half of the commit protocol — the parent has the
+            # matching deadline for replicas).
+            raise BarrierStallError(
+                "coordinator", wid=self.worker_id, pid=os.getpid(),
+                host=self.host_id, round_no=self.rctx.rounds,
+                phase="verdicts", timeout_s=self._barrier_deadline)
         if msg[0] != "verdicts":
             raise RuntimeError(
                 f"replica protocol violation: expected verdicts, "
                 f"got {msg[0]!r}")
-        return msg[1]
+        return list(msg[1])
 
     def _root_of(self, cohort: str) -> str:
         specs = self.fw.cache.cohort_specs
@@ -285,7 +334,12 @@ class ReplicaWorker:
                 self.rctx.split_roots = frozenset(msg[1])
                 self._usage_memo = None
             elif op == "adopt":
-                self._adopt(msg[1], msg[2])
+                self._adopt(msg[1], msg[2],
+                            msg[3] if len(msg) > 3 else None)
+            elif op == "release":
+                self._release(msg[1],
+                              want_entries=bool(msg[2])
+                              if len(msg) > 2 else True)
             elif op == "synth":
                 self.chan.send(("synth_done", self._synth(msg[1])))
             elif op == "gc":
@@ -311,7 +365,8 @@ class ReplicaWorker:
                 self.chan.send(("trace", os.getpid(),
                                 TRACER.export_chrome(
                                     slowest_only=len(msg) > 1
-                                    and bool(msg[1]))))
+                                    and bool(msg[1])),
+                                self.host_id))
             elif op == "stop":
                 self._close()
                 self.chan.send(("stopped", self.worker_id))
@@ -347,6 +402,12 @@ class ReplicaWorker:
             status_docs = [serialization.encode(KIND_WORKLOAD, wl)
                            for wl in changed]
         self.fw.prewarm_idle()
+        solver = getattr(self.fw.scheduler, "batch_solver", None)
+        dispatches = None
+        if solver is not None:
+            total = getattr(solver, "dispatches", 0)
+            dispatches = total - self._dispatches_seen
+            self._dispatches_seen = total
         self.chan.send(("done", {
             "admitted": list(self.tick_admitted),
             "preempted": list(self.tick_preempted),
@@ -356,6 +417,16 @@ class ReplicaWorker:
             "rss": _rss_bytes(),
             "tick_s": trace_now() - t0,
             "status_docs": status_docs,
+            # The elastic-scaling signal: pending backlog per owned
+            # shard group (feeds kueue_replica_backlog_depth).
+            "backlog": [[gid, depth] for gid, depth
+                        in sorted(self._backlog_by_group().items())],
+            # Journal replication segments (per-host mode; empty lists
+            # stripped to keep the barrier reply lean).
+            "segments": self._drain_segments(),
+            "dispatches": dispatches,
+            "pid": os.getpid(),
+            "host": self.host_id,
         }))
 
     def _apply_batch(self, entries) -> None:
@@ -371,6 +442,11 @@ class ReplicaWorker:
                     self.wl_gid.pop(entry["key"], None)
                 else:
                     self.wl_gid[entry["key"]] = gid
+            elif entry["kind"] == KIND_CLUSTER_QUEUE:
+                if entry["type"] == DELETED:
+                    self.cq_gid.pop(entry["key"], None)
+                else:
+                    self.cq_gid[entry["key"]] = gid
             if entry["type"] == DELETED:
                 store.delete(entry["kind"], entry["key"])
             else:
@@ -412,6 +488,78 @@ class ReplicaWorker:
         if wl is not None:
             self.fw.delete_workload(wl)
 
+    def _backlog_by_group(self) -> Dict[int, int]:
+        """Pending-workload depth per OWNED shard group — the elastic
+        signal. Store-routed ClusterQueues map through cq_gid; direct-
+        loaded ones (bench synth) fall back to the cohort hash, which is
+        the same function the router uses."""
+        out: Dict[int, int] = {}
+        n_groups = self.opts.get("n_groups", 1)
+        qm = self.fw.queues
+        cache_cqs = self.fw.cache.cluster_queues
+        for name in qm.cluster_queues:
+            if name in self.ghost_cqs:
+                continue
+            gid = self.cq_gid.get(name)
+            if gid is None:
+                cq = cache_cqs.get(name)
+                cohort = cq.cohort_name if cq is not None else None
+                # Memoize: the mapping is static per CQ, and at 10k CQs
+                # re-hashing every tick is measurable barrier work.
+                gid = self.cq_gid[name] = group_of(
+                    group_key(name, cohort), n_groups)
+            out[gid] = out.get(gid, 0) + qm.pending(name)
+        return out
+
+    def _drain_segments(self) -> list:
+        """Ship + clear the journal segment ops buffered since the last
+        barrier reply (JSON-safe [[gid, ops], ...])."""
+        if not self._seg:
+            return []
+        out = [[gid, ops] for gid, ops in sorted(self._seg.items()) if ops]
+        self._seg = {}
+        return out
+
+    def _release(self, gid: int, want_entries: bool = True) -> None:
+        """Give up a shard group for migration: detach its journal (the
+        flock clears, recording stops), snapshot its objects (the
+        journal-free migration channel — built only when the parent
+        asked; journal-backed adoption never reads it), then delete
+        every group-routed object from this framework — the DELETE
+        events fan through the adapter, releasing quota and pruning
+        queues. Admin kinds stay: they are broadcast to every group and
+        shared by the framework."""
+        from kueue_tpu.api import serialization
+        from kueue_tpu.controllers.store import _obj_key
+
+        group = self.groups.pop(gid, None)
+        if group is None:
+            self.chan.send(("released", gid, {"ops": [], "entries": []}))
+            return
+        store, _adapter, journal = group
+        ops = self._seg.pop(gid, [])
+        if journal is not None:
+            journal.detach()
+        entries = []
+        from kueue_tpu.controllers.durable import KIND_ORDER
+
+        if want_entries:
+            for kind in KIND_ORDER:
+                for obj in store.list(kind):
+                    entries.append({
+                        "type": ADDED, "kind": kind,
+                        "key": _obj_key(kind, obj),
+                        "object": serialization.encode(kind, obj)})
+        for kind in (KIND_WORKLOAD, KIND_LOCAL_QUEUE, KIND_CLUSTER_QUEUE):
+            for key in [_obj_key(kind, obj) for obj in store.list(kind)]:
+                store.delete(kind, key)
+        for key in [k for k, g in self.wl_gid.items() if g == gid]:
+            del self.wl_gid[key]
+        for key in [k for k, g in self.cq_gid.items() if g == gid]:
+            del self.cq_gid[key]
+        self._usage_memo = None
+        self.chan.send(("released", gid, {"ops": ops, "entries": entries}))
+
     def _apply_ghost(self, entry: dict) -> None:
         """Mirror a remote split-tree member into the CACHE only: its
         quota rows join this replica's tree math, its usage arrives via
@@ -436,7 +584,8 @@ class ReplicaWorker:
         self.ghost_cqs.add(spec.name)
         self._usage_memo = None
 
-    def _adopt(self, gid: int, journal_path: Optional[str]) -> None:
+    def _adopt(self, gid: int, journal_path: Optional[str],
+               seed: Optional[dict] = None) -> None:
         # A journal may re-create ClusterQueues this replica holds as
         # ghosts: purge every ghost first (the replay re-adds the now-
         # owned ones; the parent re-routes the rest at the next ghost
@@ -445,6 +594,15 @@ class ReplicaWorker:
             self.fw.cache.delete_cluster_queue(name)
         self.ghost_cqs.clear()
         self._usage_memo = None
+        if seed and seed.get("lines") is not None and journal_path:
+            # Per-host fail-over/migration: seed THIS host's local
+            # journal from the coordinator's replicated copy, then
+            # attach-replay it like any restart.
+            os.makedirs(os.path.dirname(journal_path) or ".",
+                        exist_ok=True)
+            with open(journal_path, "w", encoding="utf-8") as f:
+                for line in seed["lines"]:
+                    f.write(line + "\n")
         try:
             restored = self.add_group(gid, journal_path)
         except RuntimeError as exc:
@@ -452,6 +610,11 @@ class ReplicaWorker:
             # process is not dead after all): report, parent retries.
             self.chan.send(("adopt_err", gid, str(exc)))
             return
+        if seed and seed.get("entries"):
+            # Journal-less migration: the releasing owner's snapshot
+            # entries rebuild the group through the routing applier.
+            self._apply_batch([(gid, e) for e in seed["entries"]])
+            restored += len(seed["entries"])
         self.chan.send(("adopted", gid, restored))
 
     def _synth(self, kw: dict) -> dict:
@@ -530,7 +693,10 @@ class ReplicaWorker:
 def _worker_main(conn, worker_id: int, opts: dict) -> None:
     """Spawn-mode entry point (module top level: picklable under the
     spawn start method). Rebuilds the feature-gate state the parent
-    shipped, then runs the worker loop until stop/EOF."""
+    shipped, then runs the worker loop until stop/EOF. `conn` is the
+    multiprocessing pipe end (pipe transport) or None (socket
+    transport — the worker dials opts["connect"] and identifies itself
+    with its worker id)."""
     from kueue_tpu import features
 
     try:
@@ -543,11 +709,19 @@ def _worker_main(conn, worker_id: int, opts: dict) -> None:
             from kueue_tpu.tracing import TRACER
 
             TRACER.configure(enabled=True)
-        worker = ReplicaWorker(worker_id, opts, _PipeChan(conn))
+        if conn is None:
+            chan: ReplicaChannel = SocketChannel.connect(
+                tuple(opts["connect"]), cid=worker_id,
+                plan=FaultPlan.from_dict(opts.get("faults")),
+                name=f"worker-{worker_id}")
+        else:
+            chan = _PipeChan(conn)
+        worker = ReplicaWorker(worker_id, opts, chan)
         for gid, journal_path in opts.get("groups", ()):
             worker.add_group(gid, journal_path)
         worker.run()
-    except (EOFError, OSError, KeyboardInterrupt):
+    except (EOFError, OSError, KeyboardInterrupt,
+            WorkerDied, WorkerDiedError):
         pass
 
 
@@ -557,45 +731,81 @@ def _worker_main(conn, worker_id: int, opts: dict) -> None:
 
 
 class _WorkerHandle:
-    """Parent-side handle: the channel plus liveness/kill control."""
+    """Parent-side handle: the channel plus liveness/kill control.
+
+    Transport matrix: spawn x {pipe, socket} and loopback x {queue,
+    socket}. The socket variants exercise the full framed reliable
+    channel (the loopback-socket pair is the "two emulated hosts on one
+    machine" harness: real TCP framing, reconnects and faults, no
+    process overhead)."""
 
     chan: ReplicaChannel
 
     def __init__(self, wid: int, spawn: bool, opts: dict,
-                 groups: List[tuple]):
+                 groups: List[tuple],
+                 listener: Optional[ChannelListener] = None):
         self.wid = wid
         self.alive = True
         self.spawn = spawn
+        self.host_id = opts.get("host_id") or f"host-{wid}"
+        self.pid: Optional[int] = None
+        # True once a worker_error message arrived: the worker CRASHED
+        # with a real exception — the watchdog must report that, not a
+        # "stall" (the loopback thread may still be microseconds from
+        # exiting when the parent reads the error).
+        self.crashed = False
+        if listener is not None:
+            self.chan = listener.endpoint(wid, name=f"replica-{wid}")
         if spawn:
             import multiprocessing
 
             ctx = multiprocessing.get_context("spawn")
-            parent_conn, child_conn = ctx.Pipe()
-            self.proc = ctx.Process(
-                target=_worker_main,
-                args=(child_conn, wid, {**opts, "groups": groups}),
-                daemon=True)
-            self.proc.start()
-            child_conn.close()
-            self.chan = _PipeChan(parent_conn)
+            if listener is not None:
+                self.proc = ctx.Process(
+                    target=_worker_main,
+                    args=(None, wid, {**opts, "groups": groups}),
+                    daemon=True)
+                self.proc.start()
+            else:
+                parent_conn, child_conn = ctx.Pipe()
+                self.proc = ctx.Process(
+                    target=_worker_main,
+                    args=(child_conn, wid, {**opts, "groups": groups}),
+                    daemon=True)
+                self.proc.start()
+                child_conn.close()
+                self.chan = _PipeChan(parent_conn)
+            self.pid = self.proc.pid
             self.thread = None
         else:
-            to_worker: "queue.Queue" = queue.Queue()
-            to_parent: "queue.Queue" = queue.Queue()
-            self.chan = _QueueChan(to_worker, to_parent)
-            worker_chan = _QueueChan(to_parent, to_worker)
+            if listener is not None:
+                addr = listener.address
+                worker_chan = None  # dialed inside the thread
+            else:
+                to_worker: "queue.Queue" = queue.Queue()
+                to_parent: "queue.Queue" = queue.Queue()
+                self.chan = _QueueChan(to_worker, to_parent)
+                worker_chan = _QueueChan(to_parent, to_worker)
             self.proc = None
+            self.pid = os.getpid()
 
             def run():
+                chan = worker_chan
                 try:
-                    worker = ReplicaWorker(wid, opts, worker_chan)
+                    if chan is None:
+                        chan = SocketChannel.connect(
+                            addr, cid=wid,
+                            plan=FaultPlan.from_dict(opts.get("faults")),
+                            name=f"worker-{wid}")
+                    worker = ReplicaWorker(wid, opts, chan)
                     for gid, journal_path in groups:
                         worker.add_group(gid, journal_path)
                     worker.run()
-                except WorkerDied:
+                except (WorkerDied, WorkerDiedError):
                     pass
                 except Exception as exc:  # surface, never hang the barrier
-                    worker_chan.send(("worker_error", wid, repr(exc)))
+                    if chan is not None:
+                        chan.send(("worker_error", wid, repr(exc)))
 
             self.thread = threading.Thread(
                 target=run, name=f"replica-{wid}", daemon=True)
@@ -605,9 +815,14 @@ class _WorkerHandle:
         self.chan.send(msg)
 
     def recv(self, timeout: Optional[float] = None):
-        msg = self.chan.recv(timeout=timeout)
+        try:
+            msg = self.chan.recv(timeout=timeout)
+        except WorkerDiedError as exc:
+            # Transport-level timeout/close -> the runtime's own type.
+            raise WorkerDied(str(exc))
         if msg and msg[0] == "worker_error":
             self.alive = False
+            self.crashed = True
             raise WorkerDied(f"replica {msg[1]} crashed: {msg[2]}")
         return msg
 
@@ -617,6 +832,13 @@ class _WorkerHandle:
         if self.proc is not None:
             return self.proc.is_alive()
         return self.thread.is_alive()
+
+    def os_alive(self) -> bool:
+        """Is the underlying process/thread still RUNNING (stalled
+        counts as alive — the watchdog's stall-vs-crash distinction)?"""
+        if self.proc is not None:
+            return self.proc.is_alive()
+        return self.thread is not None and self.thread.is_alive()
 
     def kill(self) -> None:
         self.alive = False
@@ -633,7 +855,7 @@ class _WorkerHandle:
                     msg = deadline_chan.recv(timeout=10)
                     if msg[0] == "stopped":
                         break
-            except WorkerDied:
+            except (WorkerDied, WorkerDiedError):
                 pass
 
 
@@ -650,7 +872,11 @@ class ReplicaRuntime:
                  state_dir: Optional[str] = None,
                  engine: Optional[str] = None, solver: bool = True,
                  lease_store=None, identity: Optional[str] = None,
-                 trace: bool = False):
+                 trace: bool = False, transport: Optional[str] = None,
+                 listen: Optional[tuple] = None,
+                 per_host: Optional[bool] = None,
+                 faults: Optional[FaultPlan] = None,
+                 n_groups: Optional[int] = None):
         from kueue_tpu import features
         from kueue_tpu.config import LeaderElectionConfig
         from kueue_tpu.controllers.leaderelection import (
@@ -661,10 +887,37 @@ class ReplicaRuntime:
         self.n = replicas
         self.spawn = spawn
         self.state_dir = state_dir
-        self.gmap = GroupMap(replicas)
-        self.coordinator = Coordinator(
-            journal_path=os.path.join(state_dir, "coordinator.jsonl")
-            if state_dir else None)
+        # An EXPLICIT transport argument wins over the generic
+        # KUEUE_TPU_TRANSPORT default; only the documented kill switch
+        # (KUEUE_TPU_NO_SOCKET=1) overrides it.
+        if transport is None:
+            self.transport = transport_from_env("pipe")
+        elif os.environ.get("KUEUE_TPU_NO_SOCKET", "") == "1":
+            self.transport = "pipe"
+        else:
+            self.transport = transport if transport in ("pipe", "socket") \
+                else "pipe"
+        # Per-host state: each replica journals in its OWN directory
+        # (the socket transport's default — real hosts share nothing)
+        # with coordinator-owned replication; pipe mode keeps PR 9's
+        # shared-directory layout unless opted in.
+        self.per_host = (self.transport == "socket") \
+            if per_host is None else per_host
+        if faults is None and self.transport == "socket":
+            faults = parse_fault_env(os.environ.get("KUEUE_TPU_FAULTS"))
+        self.faults = faults
+        self.listener: Optional[ChannelListener] = None
+        if self.transport == "socket":
+            host, port = listen or ("127.0.0.1", 0)
+            self.listener = ChannelListener(host, port, plan=faults)
+        self.replicator: Optional[JournalReplicator] = None
+        if self.per_host and state_dir:
+            self.replicator = JournalReplicator(
+                os.path.join(state_dir, "coordinator-replica"))
+        n_groups = replicas if not n_groups or n_groups < replicas \
+            else n_groups
+        self.n_groups = n_groups
+        self.gmap = GroupMap(n_groups)
         if lease_store is None:
             lease_store = FileLeaseStore(
                 os.path.join(state_dir, "leases.json")) \
@@ -673,10 +926,19 @@ class ReplicaRuntime:
             lease_store, identity=identity or f"coordinator-{os.getpid()}",
             config=LeaderElectionConfig(enable=True))
         self.elector.step()
+        self.coordinator = Coordinator(
+            journal_path=os.path.join(state_dir, "coordinator.jsonl")
+            if state_dir else None,
+            epoch=self._lease_transitions())
         opts = {
             "engine": engine,
             "solver": solver,
-            "n_groups": replicas,
+            "n_groups": n_groups,
+            "barrier_deadline": barrier_deadline(_ROUND_TIMEOUT),
+            "replicate": self.replicator is not None,
+            "connect": list(self.listener.address)
+            if self.listener is not None else None,
+            "faults": faults.to_dict() if faults is not None else None,
             # Spawned workers run their own TRACER; loopback threads
             # share this process's (already configured by the caller).
             "trace": trace and spawn,
@@ -684,21 +946,37 @@ class ReplicaRuntime:
             if spawn else None,
         }
         self._opts = opts
-        self.group_owner: Dict[int, int] = {g: g for g in range(replicas)}
+        self.group_owner: Dict[int, int] = {
+            g: g % replicas for g in range(n_groups)}
         self.workers = [
-            _WorkerHandle(w, spawn, opts,
-                          groups=[(w, self._journal_path(w))])
+            _WorkerHandle(w, spawn, {**opts, "host_id": f"host-{w}"},
+                          groups=[(g, self._journal_path(g, wid=w))
+                                  for g in range(n_groups)
+                                  if g % replicas == w],
+                          listener=self.listener)
             for w in range(replicas)
         ]
         self.pen: Dict[str, List[tuple]] = {}   # "ns/lq" -> queued entries
         self.wl_group: Dict[str, int] = {}
         self._cq_specs: Dict[str, object] = {}
+        # Admin specs retained for coordinator REBUILD at fail-over (a
+        # new incarnation cannot read the dead one's memory).
+        self._flavor_specs: Dict[str, object] = {}
+        self._cohort_spec_objs: Dict[str, object] = {}
         self._ghost_sent: set = set()            # (wid, cq name)
         self.tick_no = 0
         self._last_split = frozenset()
         self._lock = threading.RLock()
-        self.round_timeout = _ROUND_TIMEOUT
+        self.round_timeout = barrier_deadline(_ROUND_TIMEOUT)
         self.stats_last: dict = {}
+        self.backlog_last: Dict[int, int] = {}
+        self.stall_count = 0
+        # Surfaced-error hook for barrier stalls (stderr by default; a
+        # deployment can swap in structured logging).
+        self.on_stall = lambda err: print(
+            f"kueue-tpu: {err}", file=__import__("sys").stderr, flush=True)
+        self._coord_kill_pending = False
+        self.failover_evidence: Optional[dict] = None
         # Set by ReplicaStoreBridge: the parent deployment's read-surface
         # Store. When present, each tick asks workers for the statuses
         # they published this round and mirrors them here so GET/watch
@@ -709,9 +987,27 @@ class ReplicaRuntime:
         self.status_store = None
         self._applying_status: Optional[int] = None
 
-    def _journal_path(self, gid: int) -> Optional[str]:
+    def _lease_transitions(self) -> int:
+        """The coordinator epoch source: how many times the lease has
+        changed hands."""
+        try:
+            return self.elector.store.transitions(
+                self.elector.config.resource_name)
+        except AttributeError:
+            return 0
+
+    def _journal_path(self, gid: int,
+                      wid: Optional[int] = None) -> Optional[str]:
+        """Where shard group `gid`'s journal lives. Per-host mode keys
+        by the OWNING worker's private host directory (pass `wid` when
+        ownership is mid-change); shared mode keeps one flat dir."""
         if not self.state_dir:
             return None
+        if self.per_host:
+            if wid is None:
+                wid = self.group_owner.get(gid, gid % self.n)
+            d = host_state_dir(self.state_dir, f"host-{wid}")
+            return os.path.join(d, f"journal-g{gid}.jsonl")
         os.makedirs(self.state_dir, exist_ok=True)
         return os.path.join(self.state_dir, f"journal-g{gid}.jsonl")
 
@@ -807,11 +1103,13 @@ class ReplicaRuntime:
     # -- admin API (the partitioned watch stream) ----------------------------
 
     def create_resource_flavor(self, rf) -> None:
+        self._flavor_specs[rf.name] = rf
         self.coordinator.note_flavor(rf)
         self._broadcast(KIND_RESOURCE_FLAVOR, rf)
 
     def create_cohort(self, spec) -> None:
         self.gmap.note_cohort(spec.name, spec.parent)
+        self._cohort_spec_objs[spec.name] = spec
         self.coordinator.note_cohort(spec)
         self._broadcast(KIND_COHORT, spec)
         self._resplit()
@@ -923,6 +1221,7 @@ class ReplicaRuntime:
             key = _obj_key(kind, obj)
         if kind == KIND_RESOURCE_FLAVOR:
             if ev_type == DELETED:
+                self._flavor_specs.pop(key, None)
                 self.coordinator.note_flavor(key, deleted=True)
                 self._broadcast(kind, obj, DELETED, key=key)
             else:
@@ -930,6 +1229,7 @@ class ReplicaRuntime:
         elif kind == KIND_COHORT:
             if ev_type == DELETED:
                 self.gmap.drop_cohort(key)
+                self._cohort_spec_objs.pop(key, None)
                 self.coordinator.note_cohort(key, deleted=True)
                 self._broadcast(kind, obj, DELETED, key=key)
                 self._resplit()
@@ -1038,17 +1338,58 @@ class ReplicaRuntime:
 
     # -- the tick barrier ----------------------------------------------------
 
+    def _barrier_recv(self, w: _WorkerHandle, phase: str, want: str,
+                      stalls: List[dict]):
+        """One barrier wait on one replica. A miss surfaces as a
+        BarrierStallError naming the pid/host/round (the watchdog), is
+        counted, and — when the process is STALLED rather than dead
+        (SIGSTOP, wedged GC) — the process is killed so its journal
+        flocks clear and the group reassignment can actually proceed
+        (previously a stopped worker kept its flocks and adoption
+        retried silently forever). Returns the payload or None."""
+        from kueue_tpu.metrics import REGISTRY
+
+        try:
+            msg = w.recv(timeout=self.round_timeout)
+            if msg[0] != want:
+                raise WorkerDied(
+                    f"protocol violation from replica {w.wid}: "
+                    f"{msg[0]!r}")
+            return msg
+        except WorkerDied as exc:
+            stalled = w.os_alive() and not w.crashed
+            err = BarrierStallError(
+                "replica", wid=w.wid, pid=w.pid, host=w.host_id,
+                round_no=self.tick_no, phase=phase,
+                timeout_s=self.round_timeout)
+            w.alive = False
+            if stalled:
+                self.stall_count += 1
+                REGISTRY.replica_barrier_stalls_total.inc(str(w.wid))
+                stalls.append(err.to_dict())
+                self.on_stall(err)
+                if w.proc is not None:
+                    # A stalled process still holds its flocks; clear
+                    # them so the adopters are not wedged behind it.
+                    w.proc.kill()
+            else:
+                stalls.append({**err.to_dict(), "who": "replica-death",
+                               "error": str(exc)})
+            return None
+
     def tick(self) -> dict:
         """One barrier tick across every live replica; returns the
         aggregated evidence. Dead replicas are detected here and their
         shard groups reassigned (journal replay on the adopter) BEFORE
-        the tick runs."""
+        the tick runs; stalled ones surface through the watchdog."""
+        from kueue_tpu.metrics import REGISTRY
         from kueue_tpu.tracing import TRACER
 
         with self._lock:
             empty = {"admitted": [], "preempted": [], "n": 0,
                      "revocations": 0, "rtt": [], "rss": _rss_bytes(),
-                     "tick_s": []}
+                     "tick_s": [], "stalls": [], "dispatches": 0}
+            stalls: List[dict] = []
             self.tick_no += 1
             self.elector.step()
             if not self.elector.is_leader():
@@ -1067,15 +1408,9 @@ class ReplicaRuntime:
                 for w in live:
                     w.send(("pretick",))
                 for w in live:
-                    try:
-                        msg = w.recv(timeout=self.round_timeout)
-                        if msg[0] != "usage":
-                            raise WorkerDied(
-                                f"protocol violation from replica "
-                                f"{w.wid}: {msg[0]!r}")
+                    msg = self._barrier_recv(w, "pretick", "usage", stalls)
+                    if msg is not None:
                         merged.update(msg[1])
-                    except WorkerDied:
-                        w.alive = False
                 live = [w for w in live if w.alive]
                 if merged:
                     for w in live:
@@ -1084,24 +1419,30 @@ class ReplicaRuntime:
                 w.send(("tick", self.tick_no, self.status_store is not None))
             rounds = []
             for w in live:
-                try:
-                    msg = w.recv(timeout=self.round_timeout)
-                    if msg[0] != "round":
-                        raise WorkerDied(
-                            f"protocol violation from replica {w.wid}: "
-                            f"{msg[0]!r}")
+                msg = self._barrier_recv(w, "round", "round", stalls)
+                if msg is not None:
                     rounds.append(msg[1])
-                except WorkerDied:
-                    w.alive = False
             with TRACER.span("reconcile.round") as sp:
                 verdicts = self.coordinator.run_round(rounds, usage=merged)
+                if self._coord_kill_pending:
+                    # Mid-window coordinator death drill: the previous
+                    # incarnation arbitrated + journaled this round but
+                    # never answered; a newly elected incarnation must
+                    # resume the barrier, not stall it.
+                    self._coord_kill_pending = False
+                    verdicts = self._coordinator_takeover(
+                        rounds, merged, verdicts)
                 sp.set("round", self.coordinator.rounds)
+                sp.set("epoch", self.coordinator.epoch)
                 sp.set("candidates",
                        sum(len(r.get("candidates", ())) for r in rounds))
+            REGISTRY.reconcile_round_epoch.set(
+                value=self.coordinator.epoch)
             stats = {"admitted": [], "preempted": [], "n": 0,
                      "revocations": 0, "rtt": [], "rss": _rss_bytes(),
-                     "tick_s": []}
+                     "tick_s": [], "stalls": stalls, "dispatches": 0}
             status_batches: list = []
+            backlog: Dict[int, int] = {}
             for w in live:
                 if not w.alive:
                     continue
@@ -1109,25 +1450,32 @@ class ReplicaRuntime:
             for w in live:
                 if not w.alive:
                     continue
-                try:
-                    msg = w.recv(timeout=self.round_timeout)
-                    if msg[0] != "done":
-                        raise WorkerDied(
-                            f"protocol violation from replica {w.wid}: "
-                            f"{msg[0]!r}")
-                except WorkerDied:
-                    w.alive = False
+                msg = self._barrier_recv(w, "done", "done", stalls)
+                if msg is None:
                     continue
                 d = msg[1]
-                stats["admitted"].extend(d["admitted"])
+                stats["admitted"].extend(
+                    [tuple(pair) for pair in d["admitted"]])
                 stats["preempted"].extend(d["preempted"])
                 stats["n"] += d["n"]
                 stats["revocations"] += d["revocations"]
                 stats["rtt"].extend(d["rtt"])
                 stats["rss"] += d["rss"]
                 stats["tick_s"].append(d["tick_s"])
+                stats["dispatches"] += d.get("dispatches") or 0
+                for gid, depth in d.get("backlog") or ():
+                    backlog[int(gid)] = backlog.get(int(gid), 0) \
+                        + int(depth)
+                if self.replicator is not None:
+                    for gid, ops in d.get("segments") or ():
+                        self.replicator.submit(int(gid), ops)
                 if d.get("status_docs"):
                     status_batches.extend(d["status_docs"])
+            for gid, depth in backlog.items():
+                REGISTRY.replica_backlog_depth.set(
+                    str(gid), value=float(depth))
+            self.backlog_last = backlog
+            stats["backlog"] = backlog
             self.stats_last = stats
         # Status mirror OUTSIDE self._lock: update_status takes the
         # parent Store's lock, and Store watch callbacks (an HTTP POST
@@ -1163,7 +1511,30 @@ class ReplicaRuntime:
         finally:
             self._applying_status = None
 
+    def _adopt_seed(self, gid: int, to_wid: int,
+                    released: Optional[dict] = None):
+        """(journal_path, seed) for adopting `gid` on worker `to_wid`:
+        per-host mode ships the coordinator's replicated journal lines
+        (the adopter cannot read the old owner's disk); shared-dir mode
+        hands over the released/orphaned file itself; journal-less
+        deployments ship the releasing owner's object snapshot."""
+        path = self._journal_path(gid, wid=to_wid)
+        if self.replicator is not None:
+            if released is not None:
+                # The owner's final unshipped segments land first.
+                self.replicator.submit(gid, released.get("ops") or [])
+            return path, {"lines": self.replicator.read_lines(gid)}
+        if path is None and released is not None:
+            return None, {"entries": released.get("entries") or []}
+        return path, None
+
     def _reassign_dead(self) -> None:
+        # Re-entrant: tick() already holds the lock; the RLock makes
+        # this explicit for the ghost-marker writes below.
+        with self._lock:
+            self._reassign_dead_locked()
+
+    def _reassign_dead_locked(self) -> None:
         for w in self.workers:
             if w.alive and not w.is_alive():
                 w.alive = False
@@ -1174,7 +1545,8 @@ class ReplicaRuntime:
             if self.workers[wid].alive:
                 continue
             target = survivors[0]
-            target.send(("adopt", gid, self._journal_path(gid)))
+            path, seed = self._adopt_seed(gid, target.wid)
+            target.send(("adopt", gid, path, seed))
             try:
                 msg = target.recv(timeout=self.round_timeout)
             except WorkerDied:
@@ -1197,6 +1569,185 @@ class ReplicaRuntime:
         loopback, which releases its journal flocks like process death
         would). The next tick reassigns its shard groups."""
         self.workers[wid].kill()
+
+    # -- coordinator fail-over -----------------------------------------------
+
+    def kill_coordinator(self) -> None:
+        """Drill hook: the coordinator incarnation dies at the NEXT
+        barrier round, at the worst moment — after arbitrating and
+        journaling the round, before any replica hears its verdict. The
+        runtime then elects a new incarnation that resumes the barrier
+        from the journal (epoch bump + verdict replay) instead of
+        stalling it."""
+        with self._lock:
+            self._coord_kill_pending = True
+
+    def _coordinator_takeover(self, rounds, merged,
+                              dead_verdicts) -> Dict[int, List[bool]]:
+        """Replace the coordinator mid-round: release + retake the
+        lease (the epoch source), rebuild a fresh incarnation from the
+        retained admin specs, recover the in-flight round's journaled
+        verdicts, and re-run the round. The takeover CONTRACT is that
+        the resumed round answers exactly what the dead incarnation
+        decided — violated means the journal and the arbitration logic
+        disagree, which must surface, not ship."""
+        old = self.coordinator
+        old.close()
+        self.elector.release()
+        self.elector.step_now()
+        coord = Coordinator(journal_path=old.journal_path,
+                            epoch=self._lease_transitions())
+        for rf in self._flavor_specs.values():
+            coord.note_flavor(rf)
+        for spec in self._cohort_spec_objs.values():
+            coord.note_cohort(spec)
+        for spec in self._cq_specs.values():
+            coord.note_cluster_queue(spec)
+        coord.set_split(self._last_split)
+        replayed = coord.recover(in_flight=True)
+        self.coordinator = coord
+        verdicts = coord.run_round(rounds, usage=merged)
+        if dead_verdicts is not None and verdicts != dead_verdicts:
+            raise RuntimeError(
+                "coordinator takeover diverged: the resumed round's "
+                f"verdicts differ from the dead incarnation's (epoch "
+                f"{old.epoch} -> {coord.epoch}, round {coord.rounds})")
+        self.failover_evidence = {
+            "epoch_before": old.epoch,
+            "epoch_after": coord.epoch,
+            "round": coord.rounds,
+            "replayed_verdicts": replayed,
+            "candidates": sum(len(r.get("candidates", ()))
+                              for r in rounds),
+        }
+        return verdicts
+
+    # -- elastic scaling (transport/elastic.py drives these) -----------------
+
+    def add_worker(self) -> int:
+        """Start one more replica (no shard groups yet — migrate some
+        onto it). Scale-up half of the Aryl elastic loop."""
+        with self._lock:
+            wid = len(self.workers)
+            self.workers.append(_WorkerHandle(
+                wid, self.spawn, {**self._opts, "host_id": f"host-{wid}"},
+                groups=[], listener=self.listener))
+            return wid
+
+    def migrate_group(self, gid: int, to_wid: int) -> bool:
+        """Move one shard group to another LIVE replica: the owner
+        releases it (journal detached, objects dropped), the target
+        adopts it (journal replay — replicated lines in per-host mode,
+        the shared file otherwise, the owner's snapshot without
+        journals). Runs between barriers, so decisions stay identical:
+        the group's pending workloads simply resume on the adopter."""
+        with self._lock:
+            from_wid = self.group_owner.get(gid)
+            if from_wid is None or to_wid >= len(self.workers) \
+                    or to_wid < 0:
+                return False
+            if from_wid == to_wid:
+                return True
+            target = self.workers[to_wid]
+            if not target.alive:
+                return False
+            released = None
+            owner = self.workers[from_wid]
+            if owner.alive:
+                # The object snapshot is only consumed by journal-less
+                # adoption; with journals it is dead weight (megabytes
+                # at bench scale) — tell the owner whether to build it.
+                want_entries = (self.replicator is None
+                                and self._journal_path(
+                                    gid, wid=to_wid) is None)
+                owner.send(("release", gid, want_entries))
+                try:
+                    msg = owner.recv(timeout=self.round_timeout)
+                    if msg[0] != "released":
+                        raise WorkerDied(
+                            f"protocol violation from replica "
+                            f"{owner.wid}: {msg[0]!r}")
+                    released = msg[2]
+                except WorkerDied:
+                    owner.alive = False
+            path, seed = self._adopt_seed(gid, to_wid, released=released)
+            target.send(("adopt", gid, path, seed))
+            try:
+                msg = target.recv(timeout=self.round_timeout)
+            except WorkerDied:
+                target.alive = False
+                msg = ("adopt_err", gid, "target died")
+            if msg[0] != "adopted":
+                # The owner already RELEASED: without a rollback the
+                # group is orphaned (owner no longer holds it, and
+                # _reassign_dead never fires for a live owner). Re-adopt
+                # on the original owner from the same seed.
+                if owner.alive:
+                    # released=None: the first _adopt_seed already
+                    # submitted the owner's final segment ops — a second
+                    # submit would duplicate replica-journal lines.
+                    rb_released = (released
+                                   if self.replicator is None else None)
+                    rb_path, rb_seed = self._adopt_seed(
+                        gid, from_wid, released=rb_released)
+                    owner.send(("adopt", gid, rb_path, rb_seed))
+                    try:
+                        rb = owner.recv(timeout=self.round_timeout)
+                        if rb[0] != "adopted":
+                            raise WorkerDied(f"rollback failed: {rb!r}")
+                    except WorkerDied as exc:
+                        owner.alive = False
+                        print(f"kueue-tpu: group {gid} migration AND "
+                              f"rollback failed ({exc}); groups "
+                              "reassign at the next barrier",
+                              file=__import__("sys").stderr, flush=True)
+                return False
+            self.group_owner[gid] = to_wid
+            for w in (owner, target):
+                if w.alive:
+                    w.send(("split", sorted(self._last_split)))
+            self._ghost_sent = {
+                (wid, name) for wid, name in self._ghost_sent
+                if wid != to_wid}
+            self._sync_ghosts()
+            return True
+
+    def remove_worker(self, wid: int) -> bool:
+        """Drain one replica (migrate every group it owns to the least-
+        loaded survivor) and stop it. Scale-down half of the elastic
+        loop."""
+        with self._lock:
+            w = self.workers[wid]
+            survivors = [x for x in self.workers
+                         if x.alive and x.wid != wid]
+            if not w.alive or not survivors:
+                return False
+            for gid in [g for g, ow in sorted(self.group_owner.items())
+                        if ow == wid]:
+                target = min(
+                    survivors,
+                    key=lambda x: (sum(1 for ow in self.group_owner.values()
+                                       if ow == x.wid), x.wid))
+                if not self.migrate_group(gid, target.wid):
+                    return False
+            w.kill()
+            return True
+
+    def reconcile_info(self) -> dict:
+        """The SIGUSR2 Dumper's reconcile view: barrier round + epoch,
+        per-shard-group backlog depth (the elastic signal), group
+        ownership, and stall evidence."""
+        return {
+            "tick": self.tick_no,
+            "round": self.coordinator.rounds,
+            "epoch": self.coordinator.epoch,
+            "transport": self.transport,
+            "backlogDepth": {str(g): n for g, n
+                             in sorted(self.backlog_last.items())},
+            "groupOwner": {str(g): w for g, w
+                           in sorted(self.group_owner.items())},
+            "stalls": self.stall_count,
+        }
 
     # -- introspection -------------------------------------------------------
 
@@ -1229,7 +1780,8 @@ class ReplicaRuntime:
 
         with self._lock:
             docs = [(os.getpid(), "coordinator",
-                     TRACER.export_chrome(slowest_only=slowest_only))]
+                     TRACER.export_chrome(slowest_only=slowest_only),
+                     "host-coordinator")]
             if not self.spawn:
                 # Loopback replicas share this process's tracer ring —
                 # the parent export above already holds every span.
@@ -1240,7 +1792,8 @@ class ReplicaRuntime:
                 w.send(("trace", slowest_only))
                 msg = w.recv(timeout=self.round_timeout)
                 assert msg[0] == "trace", msg
-                docs.append((msg[1], f"replica-{w.wid}", msg[2]))
+                docs.append((msg[1], f"replica-{w.wid}", msg[2],
+                             msg[3] if len(msg) > 3 else w.host_id))
         return merge_chrome_traces(docs)
 
     def close(self) -> None:
@@ -1261,6 +1814,10 @@ class ReplicaRuntime:
                     w.proc.join(timeout=10)
             self.coordinator.close()
             self.elector.release()
+            if self.replicator is not None:
+                self.replicator.close()
+            if self.listener is not None:
+                self.listener.close()
 
 
 class ReplicaStoreBridge:

@@ -205,6 +205,21 @@ class Registry:
         self.events_dropped_total = Counter(
             p + "events_dropped_total",
             "Events dropped by the bounded recorder")
+        # Multi-host replica runtime: pending backlog per shard group
+        # (the elastic-scaling signal — transport/elastic.py reads the
+        # same feed), barrier stalls surfaced by the watchdog, and the
+        # coordinator incarnation arbitrating reconcile rounds.
+        self.replica_backlog_depth = Gauge(
+            p + "replica_backlog_depth",
+            "Pending-workload backlog depth per shard group",
+            ("shard_group",))
+        self.replica_barrier_stalls_total = Counter(
+            p + "replica_barrier_stalls_total",
+            "Barrier deadlines missed by a stalled replica", ("replica",))
+        self.reconcile_round_epoch = Gauge(
+            p + "reconcile_round_epoch",
+            "Coordinator incarnation (lease transitions) arbitrating "
+            "reconcile rounds")
         # TPU-build additions: per-tick phase timings.
         self.tick_phase_seconds = Histogram(
             p + "tick_phase_seconds",

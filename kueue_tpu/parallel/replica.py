@@ -240,8 +240,16 @@ class Coordinator:
     configured, so a takeover can audit-replay every cross-replica
     decision."""
 
-    def __init__(self, journal_path: Optional[str] = None):
+    def __init__(self, journal_path: Optional[str] = None,
+                 epoch: int = 0):
         self.journal_path = journal_path
+        # Barrier-round epoch: which coordinator INCARNATION arbitrated.
+        # Sourced from the lease's transition count, bumped at every
+        # takeover; journal entries carry (epoch, round) so an audit
+        # attributes every verdict to exactly one incarnation, and a
+        # re-run of an interrupted round is visible as the same round
+        # number under a higher epoch.
+        self.epoch = epoch
         self._journal_file = None
         self._lock = threading.Lock()
         self._flavors: Dict[str, object] = {}
@@ -253,6 +261,12 @@ class Coordinator:
         self.rounds = 0
         self.revocations = 0
         self.commits = 0
+        # Takeover replay (recover()): journaled verdicts of the round
+        # the previous incarnation arbitrated but may not have answered
+        # — consumed by the next run_round so the resumed barrier gets
+        # the SAME verdicts it would have gotten.
+        self._replay: Optional[Dict[tuple, bool]] = None
+        self.replayed_verdicts = 0
 
     # -- admin state --------------------------------------------------------
 
@@ -353,6 +367,46 @@ class Coordinator:
             node.invalidate_memos()
         self._dirty = False
 
+    # -- takeover ------------------------------------------------------------
+
+    def recover(self, in_flight: bool = False) -> int:
+        """Rebuild this (newly elected) incarnation's round state from
+        the journal: the round counter resumes where the previous
+        incarnation stopped, and — when a round was IN FLIGHT at the
+        takeover (arbitrated + journaled, but the verdicts may never
+        have reached the replicas) — its journaled verdicts are loaded
+        for replay, so re-running the round resumes the barrier with
+        bit-identical answers instead of re-deciding (or stalling).
+        Returns the number of verdicts staged for replay."""
+        if not self.journal_path or not os.path.exists(self.journal_path):
+            return 0
+        last = None
+        with self._lock:
+            with open(self.journal_path, "r", encoding="utf-8") as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        entry = json.loads(line)
+                    except json.JSONDecodeError:
+                        continue  # torn final line: never acknowledged
+                    last = entry
+            if last is None:
+                return 0
+            last_round = int(last.get("round", 0))
+            if not in_flight:
+                self.rounds = last_round
+                return 0
+            # The interrupted round re-runs under this epoch: rewind the
+            # counter so it keeps its number, and stage its verdicts.
+            self.rounds = max(0, last_round - 1)
+            self._replay = {
+                (v.get("replica"), v.get("i"), v.get("key")): bool(v["ok"])
+                for v in last.get("verdicts", ())
+                if "i" in v}
+            return len(self._replay)
+
     # -- the round ----------------------------------------------------------
 
     def run_round(self, rounds: List[dict],
@@ -397,8 +451,11 @@ class Coordinator:
                 _has_common_flavor_resources, preempt_reserve)
             from kueue_tpu.solver.modes import FIT, PREEMPT
 
+            replay, self._replay = self._replay, None
             committed = 0
             for _, _, rid, c in ordered:
+                journaled = (replay.get((rid, c["i"], c["key"]))
+                             if replay is not None else None)
                 cq = self._cqs.get(c["cq"])
                 if cq is None or cq.cohort is None:
                     # A candidate for a root the coordinator does not
@@ -410,13 +467,22 @@ class Coordinator:
                 mode = c["mode"]
                 usage = c["usage"]
                 root = cq.cohort.root_name
-                blocked = False
-                if mode == PREEMPT and root in skip:
-                    blocked = _has_common_flavor_resources(
-                        root_usage.get(root), usage)
-                if not blocked and mode == FIT:
-                    blocked = not fits_in_hierarchy(
-                        cq, usage, extra=cycle_usage)
+                if journaled is not None:
+                    # Takeover replay: the previous incarnation already
+                    # arbitrated this candidate; honor its journaled
+                    # verdict — but still fold committed reserves so any
+                    # non-replayed candidate later in the order gates
+                    # against the same cycle state it would have.
+                    blocked = not journaled
+                    self.replayed_verdicts += 1
+                else:
+                    blocked = False
+                    if mode == PREEMPT and root in skip:
+                        blocked = _has_common_flavor_resources(
+                            root_usage.get(root), usage)
+                    if not blocked and mode == FIT:
+                        blocked = not fits_in_hierarchy(
+                            cq, usage, extra=cycle_usage)
                 if not blocked:
                     reserve = usage if mode != PREEMPT else \
                         preempt_reserve(usage, c["borrow"], cq)
@@ -447,9 +513,10 @@ class Coordinator:
                 self.journal_path, "a", encoding="utf-8")
         entry = {
             "round": self.rounds,
+            "epoch": self.epoch,
             "verdicts": [
                 {"key": c["key"], "cq": c["cq"], "replica": rid,
-                 "ok": verdicts[rid][c["i"]]}
+                 "i": c["i"], "ok": verdicts[rid][c["i"]]}
                 for _, _, rid, c in ordered],
         }
         self._journal_file.write(json.dumps(entry, separators=(",", ":"))

@@ -388,30 +388,46 @@ def merge_chrome_traces(docs) -> dict:
     """Merge per-process Chrome trace docs into ONE Perfetto-loadable
     trace (the multi-process replica runtime's `GET /debug/traces`).
 
-    `docs` is [(pid, process_name, chrome_doc), ...]. Each process's
-    tracer timestamps run on its own perf_counter timebase; the export's
-    `epoch_unix` anchors that timebase to the wall clock, so events are
-    REBASED onto the earliest epoch (same-host wall clocks — the replica
-    deployment's substrate — keep the lanes aligned to ~ms). Every
-    event's pid becomes its process's lane, a process_name metadata row
-    labels it, and the reconcile commit protocol becomes visible as flow
+    `docs` is [(pid, process_name, chrome_doc), ...] or, in multi-host
+    mode, [(pid, process_name, chrome_doc, host_id), ...]. Each
+    process's tracer timestamps run on its own perf_counter timebase;
+    the export's `epoch_unix` anchors that timebase to the wall clock,
+    so events are REBASED onto the earliest epoch. Every event's pid
+    becomes its process's lane, labeled by process_name metadata; with
+    a host id the lane is ALSO labeled with its host (process_name
+    carries "name @host" and a process_labels metadata row carries the
+    bare host id, so Perfetto groups and filters by host alongside
+    pid/tid). The reconcile commit protocol becomes visible as flow
     events: each replica's in-cycle `admit.reconcile.rtt` span (args:
     round) emits a flow start ("s") that finishes ("f") on the
     coordinator's matching `reconcile.round` span — the cross-process
-    round trip drawn as an arrow."""
+    round trip drawn as an arrow. Hosts' wall clocks may disagree
+    (emulated hosts share one, real ones drift); the rebase is
+    epoch-anchored per process, and any residual skew that would point
+    a flow arrow BACKWARDS in merged time is clamped to the sink, so
+    the arrows survive cross-host clock rebasing."""
+    norm = [(d + (None,)) if len(d) == 3 else d for d in docs]
     epochs = [d.get("otherData", {}).get("epoch_unix")
-              for _, _, d in docs]
+              for _, _, d, _ in norm]
     known = [e for e in epochs if isinstance(e, (int, float))]
     base = min(known) if known else 0.0
     events: List[dict] = []
     # Coordinator round spans by round id, for the flow-event sinks.
     rounds: Dict[object, dict] = {}
     ticks_retained = 0
-    for (pid, name, doc), epoch in zip(docs, epochs):
+    hosts: List[str] = []
+    for (pid, name, doc, host), epoch in zip(norm, epochs):
         shift = ((epoch - base) * 1e6
                  if isinstance(epoch, (int, float)) else 0.0)
+        label = f"{name} @{host}" if host else name
         events.append({"ph": "M", "name": "process_name", "pid": pid,
-                       "ts": 0, "args": {"name": name}})
+                       "ts": 0, "args": {"name": label}})
+        if host:
+            events.append({"ph": "M", "name": "process_labels",
+                           "pid": pid, "ts": 0,
+                           "args": {"labels": str(host)}})
+            if host not in hosts:
+                hosts.append(host)
         ticks_retained += doc.get("otherData", {}).get("ticks_retained", 0)
         for ev in doc.get("traceEvents", ()):
             if ev.get("ph") == "M":
@@ -432,22 +448,27 @@ def merge_chrome_traces(docs) -> dict:
         sink = rounds.get(rnd)
         if sink is None:
             continue
+        end_ts = round(sink["ts"] + sink.get("dur", 0), 3)
+        # Clock-skew clamp: a flow must not start after it finishes in
+        # MERGED time, or Perfetto drops the arrow.
+        start_ts = min(ev["ts"], end_ts)
         flows.append({"ph": "s", "id": int(rnd), "name": "reconcile",
                       "cat": "kueue", "pid": ev["pid"], "tid": ev["tid"],
-                      "ts": ev["ts"]})
+                      "ts": start_ts})
         flows.append({"ph": "f", "bp": "e", "id": int(rnd),
                       "name": "reconcile", "cat": "kueue",
                       "pid": sink["pid"], "tid": sink["tid"],
-                      "ts": round(sink["ts"] + sink.get("dur", 0), 3)})
+                      "ts": end_ts})
     events.extend(flows)
     return {
         "traceEvents": events,
         "displayTimeUnit": "ms",
         "otherData": {
             "tracer": "kueue-tpu",
-            "merged_processes": len(docs),
+            "merged_processes": len(norm),
             "ticks_retained": ticks_retained,
             "epoch_unix": base,
+            "hosts": hosts,
         },
     }
 

@@ -1,0 +1,62 @@
+"""Multi-host transport for the replica scheduler.
+
+The pieces that let the PR 9 replica runtime leave the single machine:
+
+  * framing       — length-prefixed JSON frames (the wire format IS
+                    journal lines) with partial-read reassembly;
+  * socket_channel— the reliable seq/ack/resume channel implementing
+                    the existing ReplicaChannel seam over TCP, plus the
+                    coordinator-side ChannelListener;
+  * faults        — seeded injectable delay/drop/reorder for drills;
+  * replication   — coordinator-owned async replication of per-host
+                    journal segments (fail-over without a shared fs);
+  * watchdog      — BarrierStallError: the stalling pid/host/round
+                    surfaced instead of a silent hang;
+  * elastic       — backlog-driven replica scaling + Aryl-style
+                    capacity loaning over the group-reassignment seam.
+
+Kill switch: KUEUE_TPU_NO_SOCKET=1 forces the pipe transport
+everywhere (the runtime falls back to PR 9's multiprocessing pipes).
+"""
+
+from kueue_tpu.transport.elastic import ElasticController
+from kueue_tpu.transport.faults import (
+    FaultInjector,
+    FaultPlan,
+    parse_fault_env,
+)
+from kueue_tpu.transport.framing import (
+    FrameDecoder,
+    FrameError,
+    decode_message,
+    encode_frame,
+    encode_message,
+)
+from kueue_tpu.transport.replication import JournalReplicator, host_state_dir
+from kueue_tpu.transport.socket_channel import (
+    ChannelClosed,
+    ChannelListener,
+    SocketChannel,
+    WorkerDiedError,
+)
+from kueue_tpu.transport.watchdog import BarrierStallError, barrier_deadline
+
+__all__ = [
+    "BarrierStallError",
+    "ChannelClosed",
+    "ChannelListener",
+    "ElasticController",
+    "FaultInjector",
+    "FaultPlan",
+    "FrameDecoder",
+    "FrameError",
+    "JournalReplicator",
+    "SocketChannel",
+    "WorkerDiedError",
+    "barrier_deadline",
+    "decode_message",
+    "encode_frame",
+    "encode_message",
+    "host_state_dir",
+    "parse_fault_env",
+]

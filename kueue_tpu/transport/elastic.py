@@ -1,0 +1,175 @@
+"""Elastic replica count + Aryl-style capacity loaning.
+
+Aryl (PAPERS.md, arxiv 2202.07896) scales a job's replica set with load
+and LOANS idle capacity to loaded peers instead of letting it sit. The
+replica runtime's shard groups are the unit of work here, and its group
+reassignment (built for fail-over) is the mechanism: this controller
+watches per-shard-group backlog depth (the `kueue_replica_backlog_depth`
+gauge's feed) and drives three moves, all at barrier boundaries so the
+quiescent-tick discipline is never violated mid-tick:
+
+  * scale UP   — every worker is loaded past the high watermark: start
+    a new replica process and migrate the deepest-backlog group onto it.
+  * LOAN       — one worker idles while another drowns: migrate the
+    loaded worker's deepest group onto the idle one, remembering its
+    home; the loan RETURNS when the group's backlog drains. This is
+    Aryl's capacity-loaning loop — the idle replica's process capacity
+    serves the loaded group's solves, and the commit protocol (phase B)
+    keeps any split-root quota math exact across the move.
+  * scale DOWN — a surplus worker's groups are all idle: migrate them
+    back to survivors and stop the process.
+
+One move per step: each migration is a release/replay/adopt cycle, and
+spacing them keeps every intermediate state settled (the post-resettle
+steady window must dispatch ZERO solves — pinned by the elastic drill).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+
+class ElasticController:
+    """Backlog-driven scaling policy over a ReplicaRuntime."""
+
+    def __init__(self, runtime, *, scale_up_backlog: int = 64,
+                 idle_backlog: int = 0, loan_min_backlog: int = 8,
+                 min_replicas: int = 1, max_replicas: int = 8,
+                 cooldown_ticks: int = 2):
+        self.rt = runtime
+        self.scale_up_backlog = scale_up_backlog
+        self.idle_backlog = idle_backlog
+        self.loan_min_backlog = loan_min_backlog
+        self.min_replicas = min_replicas
+        self.max_replicas = max_replicas
+        self.cooldown_ticks = cooldown_ticks
+        self._cooldown = 0
+        # gid -> home wid, for loans outstanding.
+        self.loans: Dict[int, int] = {}
+        self.actions: List[str] = []
+
+    # -- introspection -------------------------------------------------------
+
+    def _live_workers(self) -> List[int]:
+        return [w.wid for w in self.rt.workers if w.alive]
+
+    def _backlog_by_worker(self, backlog: Dict[int, int]) -> Dict[int, int]:
+        by_worker = {wid: 0 for wid in self._live_workers()}
+        for gid, depth in backlog.items():
+            wid = self.rt.group_owner.get(gid)
+            if wid in by_worker:
+                by_worker[wid] += depth
+        return by_worker
+
+    # -- the policy step -----------------------------------------------------
+
+    def step(self, backlog: Dict[int, int]) -> Optional[str]:
+        """One policy decision against the tick's backlog depths
+        (gid -> pending workloads). Returns the action taken (logged in
+        `self.actions`) or None."""
+        if self._cooldown > 0:
+            self._cooldown -= 1
+            return None
+        action = self._decide(backlog)
+        if action is not None:
+            self.actions.append(action)
+            self._cooldown = self.cooldown_ticks
+        return action
+
+    def _decide(self, backlog: Dict[int, int]) -> Optional[str]:
+        by_worker = self._backlog_by_worker(backlog)
+        if not by_worker:
+            return None
+
+        # 1. Return drained loans home first: the loan was temporary
+        # capacity, and home placement keeps the cohort-hash locality.
+        for gid, home in sorted(self.loans.items()):
+            if home not in by_worker:
+                # The home worker died: the loan can never return, and
+                # keeping the entry would exclude this group from every
+                # future move forever. Its current owner IS home now.
+                del self.loans[gid]
+            elif backlog.get(gid, 0) <= self.idle_backlog \
+                    and self.rt.group_owner.get(gid) != home:
+                if self.rt.migrate_group(gid, home):
+                    del self.loans[gid]
+                    return f"return g{gid}->w{home}"
+            elif self.rt.group_owner.get(gid) == home:
+                del self.loans[gid]
+
+        # 2. Scale up: everyone loaded, room for one more replica.
+        n_live = len(by_worker)
+        if n_live < self.max_replicas \
+                and by_worker \
+                and min(by_worker.values()) > self.scale_up_backlog:
+            gid = self._deepest_group(backlog,
+                                      min_depth=self.loan_min_backlog)
+            if gid is not None:
+                # Capture the home BEFORE the migration rewrites
+                # ownership — it is where the group returns on drain.
+                home = self.rt.group_owner.get(gid, 0)
+                new_wid = self.rt.add_worker()
+                if self.rt.migrate_group(gid, new_wid):
+                    self.loans.setdefault(gid, home)
+                    return f"scale-up w{new_wid} g{gid}"
+                # Migration failed: reap the group-less newcomer rather
+                # than leaving a dead-weight process the policy would
+                # only collect on a later scale-down pass.
+                self.rt.remove_worker(new_wid)
+
+        # 3. Loan: an idle worker next to a drowning one.
+        idle = [w for w, b in by_worker.items() if b <= self.idle_backlog]
+        loaded = [w for w, b in by_worker.items()
+                  if b >= self.loan_min_backlog
+                  and self._group_count(w) >= 2]
+        if idle and loaded:
+            donor = max(loaded, key=lambda w: (by_worker[w], w))
+            taker = min(idle, key=lambda w: (by_worker[w], w))
+            gid = self._deepest_group(backlog, owner=donor,
+                                      min_depth=self.loan_min_backlog)
+            if gid is not None and self.rt.migrate_group(gid, taker):
+                self.loans.setdefault(gid, donor)
+                return f"loan g{gid} w{donor}->w{taker}"
+
+        # 4. Scale down: a surplus worker with nothing to do.
+        if n_live > self.min_replicas:
+            for wid in sorted(by_worker, reverse=True):
+                if by_worker[wid] <= self.idle_backlog \
+                        and all(backlog.get(g, 0) <= self.idle_backlog
+                                for g in self._groups_of(wid)):
+                    if self.rt.remove_worker(wid):
+                        return f"scale-down w{wid}"
+        return None
+
+    # -- helpers -------------------------------------------------------------
+
+    def _groups_of(self, wid: int) -> List[int]:
+        return [g for g, w in self.rt.group_owner.items() if w == wid]
+
+    def _group_count(self, wid: int) -> int:
+        return len(self._groups_of(wid))
+
+    def _deepest_group(self, backlog: Dict[int, int],
+                       owner: Optional[int] = None,
+                       min_depth: int = 0) -> Optional[int]:
+        """The deepest-backlog group (optionally among one worker's),
+        never the owner's last group (a worker must keep one — moving
+        its only group is a scale-down, not a loan), never a group
+        ALREADY on loan (a loaned group only moves again by returning
+        home, or the policy ping-pongs it between a draining donor and
+        its taker every step), and never one below `min_depth` (moving
+        an empty group is churn with nothing to gain)."""
+        best, best_depth = None, min_depth - 1
+        for gid, depth in sorted(backlog.items()):
+            if gid in self.loans:
+                continue
+            wid = self.rt.group_owner.get(gid)
+            if wid is None:
+                continue
+            if owner is not None and wid != owner:
+                continue
+            if self._group_count(wid) < 2:
+                continue
+            if depth > best_depth:
+                best, best_depth = gid, depth
+        return best

@@ -1,0 +1,145 @@
+"""Injectable transport faults for multi-host drills.
+
+A `FaultPlan` describes the fault mix (delay / drop / reorder) and a
+seed; each channel derives a `FaultInjector` whose per-frame schedule is
+a pure function of (seed, channel id, frame ordinal) — two runs with the
+same plan draw the SAME schedule, so a fault drill is reproducible and
+the two-host identity goldens can run WITH faults on.
+
+Semantics against the reliable channel (socket_channel.py):
+
+  * delay — hold the frame for `delay_ms` before writing. The barrier
+    protocol is latency-tolerant by construction, so delay shows up as
+    reconcile RTT, never as a decision change.
+  * drop — sever the connection instead of silently discarding: the
+    channel has no retransmit timer (messages are acked, not timed), so
+    a silent drop would stall the barrier forever; a severed connection
+    models the same packet loss at the only layer that can recover it —
+    the reconnect handshake retransmits everything unacked.
+  * reorder — swap the frame with the next one written. The receiver
+    resequences by frame number, so reordering is absorbed; the drill
+    proves that property stays true.
+"""
+
+from __future__ import annotations
+
+import random
+import zlib
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+
+@dataclass(frozen=True)
+class FaultPlan:
+    seed: int = 0
+    delay_ms: float = 0.0
+    delay_prob: float = 0.0
+    drop_prob: float = 0.0
+    reorder_prob: float = 0.0
+
+    @property
+    def active(self) -> bool:
+        return (self.delay_prob > 0 and self.delay_ms > 0) \
+            or self.drop_prob > 0 or self.reorder_prob > 0
+
+    def injector(self, channel_id) -> Optional["FaultInjector"]:
+        return FaultInjector(self, channel_id) if self.active else None
+
+    def to_dict(self) -> Dict[str, float]:
+        """Wire/opts form (spawned workers rebuild their side from it)."""
+        return {"seed": self.seed, "delay_ms": self.delay_ms,
+                "delay_prob": self.delay_prob, "drop_prob": self.drop_prob,
+                "reorder_prob": self.reorder_prob}
+
+    @classmethod
+    def from_dict(cls, d: Optional[dict]) -> Optional["FaultPlan"]:
+        if not d:
+            return None
+        return cls(seed=int(d.get("seed", 0)),
+                   delay_ms=float(d.get("delay_ms", 0.0)),
+                   delay_prob=float(d.get("delay_prob", 0.0)),
+                   drop_prob=float(d.get("drop_prob", 0.0)),
+                   reorder_prob=float(d.get("reorder_prob", 0.0)))
+
+
+def parse_fault_env(spec: Optional[str]) -> Optional[FaultPlan]:
+    """Parse `KUEUE_TPU_FAULTS` ("delay_ms=5,delay_p=0.5,drop_p=0.01,
+    reorder_p=0.1,seed=7"); None/empty disables."""
+    if not spec:
+        return None
+    keys = {"delay_ms": "delay_ms", "delay_p": "delay_prob",
+            "drop_p": "drop_prob", "reorder_p": "reorder_prob",
+            "seed": "seed"}
+    kw: Dict[str, float] = {}
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        name, _, val = part.partition("=")
+        field_name = keys.get(name.strip())
+        if field_name is None:
+            raise ValueError(
+                f"KUEUE_TPU_FAULTS: unknown knob {name.strip()!r} "
+                f"(known: {', '.join(sorted(keys))})")
+        kw[field_name] = float(val)
+    if "seed" in kw:
+        kw["seed"] = int(kw["seed"])
+    plan = FaultPlan(**kw)
+    return plan if plan.active else None
+
+
+# Frame dispositions (FaultInjector.next_action return values).
+PASS = "pass"
+DELAY = "delay"
+DROP = "drop"
+REORDER = "reorder"
+
+
+@dataclass
+class FaultStats:
+    delays: int = 0
+    drops: int = 0
+    reorders: int = 0
+    schedule: List[str] = field(default_factory=list)
+
+    def to_dict(self) -> dict:
+        return {"delays": self.delays, "drops": self.drops,
+                "reorders": self.reorders}
+
+
+class FaultInjector:
+    """Per-channel deterministic fault schedule.
+
+    The RNG seeds from crc32 of the channel id mixed with the plan seed
+    (never `hash()` — string hashing is salted per process, and the
+    schedule must agree across runs and across spawned workers)."""
+
+    def __init__(self, plan: FaultPlan, channel_id):
+        self.plan = plan
+        self.channel_id = channel_id
+        self._rnd = random.Random(
+            plan.seed * 1_000_003
+            + zlib.crc32(str(channel_id).encode("utf-8")))
+        self.stats = FaultStats()
+
+    def next_action(self) -> str:
+        """Disposition for the next data frame. Draw order is fixed
+        (drop, reorder, delay) so the schedule is reproducible."""
+        rnd = self._rnd
+        plan = self.plan
+        action = PASS
+        if rnd.random() < plan.drop_prob:
+            action = DROP
+        elif rnd.random() < plan.reorder_prob:
+            action = REORDER
+        elif plan.delay_ms > 0 and rnd.random() < plan.delay_prob:
+            action = DELAY
+        stats = self.stats
+        if action == DROP:
+            stats.drops += 1
+        elif action == REORDER:
+            stats.reorders += 1
+        elif action == DELAY:
+            stats.delays += 1
+        stats.schedule.append(action)
+        return action

@@ -1,0 +1,162 @@
+"""Coordinator-owned asynchronous replication of shard-group journals.
+
+With per-host state directories, each replica journals its shard groups
+LOCALLY (controllers/durable.py), and a fail-over can no longer assume
+the adopter reads the dead owner's filesystem. The replication loop
+closes that gap: every journal append (and every compaction snapshot)
+is tapped as a segment op, shipped to the coordinator with the tick's
+barrier reply, and applied here to a per-group replica file on the
+coordinator's own disk — asynchronously, off the barrier path, by a
+single writer thread. At adoption the coordinator flushes the queue and
+ships the replica file's lines to the new owner, which seeds its own
+local journal from them and replays.
+
+Replication lag is bounded by the barrier: segments ride the `done`
+reply, so the replica copy is complete through the last finished tick.
+A worker killed MID-tick loses at most that tick's lines — and those
+admissions never reached the parent either (the worker flushes before
+`done`), so replay + re-scheduling converge on the identical set; the
+multi-host drills pin exactly that.
+
+Segment ops (JSON-safe, they travel the socket transport):
+    ["append", <journal line>]          one recorded event
+    ["reset", [<line>, ...]]            compaction snapshot (rewrite)
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import threading
+from typing import Dict, List, Optional
+
+
+class JournalReplicator:
+    """Single-writer async applier of journal segment ops."""
+
+    def __init__(self, directory: str):
+        self.directory = directory
+        os.makedirs(directory, exist_ok=True)
+        self._q: "queue.Queue" = queue.Queue()
+        self._files: Dict[int, object] = {}
+        self._lock = threading.Lock()
+        self._closed = False
+        self.applied_ops = 0
+        self.applied_lines = 0
+        self.errors = 0
+        self.last_error: Optional[str] = None
+        self._thread = threading.Thread(
+            target=self._run, name="journal-replicator", daemon=True)
+        self._thread.start()
+
+    def path(self, gid: int) -> str:
+        return os.path.join(self.directory, f"journal-g{gid}.jsonl")
+
+    # -- producer side -------------------------------------------------------
+
+    def submit(self, gid: int, ops: List[list]) -> None:
+        """Enqueue one shard group's segment ops (in order)."""
+        if ops:
+            self._q.put((int(gid), ops))
+
+    def flush(self) -> None:
+        """Block until everything submitted so far is on disk (adoption
+        reads the replica file next)."""
+        self._q.join()
+
+    # -- writer thread -------------------------------------------------------
+
+    def _run(self) -> None:
+        while True:
+            item = self._q.get()
+            try:
+                if item is None:
+                    return
+                gid, ops = item
+                try:
+                    self._apply(gid, ops)
+                except Exception as exc:
+                    # The writer thread must OUTLIVE a bad segment
+                    # (ENOSPC, EACCES, a corrupt op): dying here would
+                    # leave every future flush()/read_lines() blocked
+                    # on Queue.join() forever — inside the runtime
+                    # lock, wedging fail-over with no error anywhere.
+                    # Count + surface and keep consuming instead.
+                    self.errors += 1
+                    self.last_error = repr(exc)
+                    import sys
+
+                    print(f"kueue-tpu: journal replication of group "
+                          f"{gid} failed: {exc!r}", file=sys.stderr,
+                          flush=True)
+            finally:
+                self._q.task_done()
+
+    def _apply(self, gid: int, ops: List[list]) -> None:
+        with self._lock:
+            for op in ops:
+                kind = op[0]
+                if kind == "append":
+                    f = self._file(gid)
+                    f.write(op[1] if op[1].endswith("\n") else op[1] + "\n")
+                    self.applied_lines += 1
+                elif kind == "reset":
+                    # Compaction snapshot: atomic rewrite, like the
+                    # journal's own compaction.
+                    path = self.path(gid)
+                    tmp = f"{path}.{os.getpid()}.tmp"
+                    with open(tmp, "w", encoding="utf-8") as f:
+                        for line in op[1]:
+                            f.write(line if line.endswith("\n")
+                                    else line + "\n")
+                        f.flush()
+                        os.fsync(f.fileno())
+                    old = self._files.pop(gid, None)
+                    if old is not None:
+                        old.close()
+                    os.replace(tmp, path)
+                    self.applied_lines += len(op[1])
+                self.applied_ops += 1
+
+    def _file(self, gid: int):
+        f = self._files.get(gid)
+        if f is None:
+            f = self._files[gid] = open(self.path(gid), "a",
+                                        encoding="utf-8")
+        return f
+
+    # -- adoption side -------------------------------------------------------
+
+    def read_lines(self, gid: int) -> List[str]:
+        """The replicated journal content for one shard group (flush
+        first so in-flight segments land)."""
+        self.flush()
+        with self._lock:
+            f = self._files.get(gid)
+            if f is not None:
+                f.flush()
+        path = self.path(gid)
+        if not os.path.exists(path):
+            return []
+        with open(path, "r", encoding="utf-8") as f:
+            return [line.rstrip("\n") for line in f if line.strip()]
+
+    def close(self) -> None:
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+        self._q.put(None)
+        self._thread.join(timeout=10)
+        with self._lock:
+            for f in self._files.values():
+                f.close()
+            self._files.clear()
+
+
+def host_state_dir(state_dir: str, host_id: str) -> str:
+    """One emulated host's private state directory (its journals live
+    here; nothing else reads it — fail-over goes through replication)."""
+    path = os.path.join(state_dir, host_id)
+    os.makedirs(path, exist_ok=True)
+    return path

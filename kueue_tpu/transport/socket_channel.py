@@ -1,0 +1,493 @@
+"""Reliable framed socket channel: the replica protocol over real TCP.
+
+Implements the existing `parallel.replica.ReplicaChannel` seam — the
+same `send`/`recv` the pipe and loopback-queue transports implement —
+over length-prefixed JSON frames (framing.py) with exactly-once in-order
+delivery across reconnects:
+
+  * every data frame carries a sequence number; the receiver delivers
+    in sequence order (out-of-order frames are held, duplicates
+    dropped) and acks cumulatively;
+  * the sender keeps every unacked frame; a reconnect handshake
+    exchanges each side's next-expected sequence and retransmits the
+    gap — a connection severed mid-stream (process kill, injected
+    drop fault, torn write) resumes with nothing lost or doubled;
+  * partial reads reassemble through `FrameDecoder`; a torn trailing
+    frame dies with its connection and is retransmitted whole.
+
+Topology: the coordinator host runs ONE `ChannelListener`; each replica
+host dials it and identifies itself with a hello frame, so N replicas
+need N outbound connections and one listening port — the kube-ish
+"workers dial the control plane" shape. Either side may lose the socket;
+only the replica redials (the listener re-binds the endpoint on the
+new connection's hello).
+
+Faults (faults.py) inject at the data-frame write: delay sleeps, drop
+severs (the reconnect machinery is the retransmission layer), reorder
+swaps adjacent frames (absorbed by receiver resequencing).
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+import time
+from collections import OrderedDict
+from typing import Dict, Optional, Tuple
+
+import queue as queue_mod
+
+from kueue_tpu.transport.faults import (
+    DELAY,
+    DROP,
+    REORDER,
+    FaultInjector,
+    FaultPlan,
+)
+from kueue_tpu.transport.framing import (
+    FrameDecoder,
+    FrameError,
+    decode_message,
+    encode_message,
+)
+
+_CLOSED = object()
+
+# Reconnect backoff (connector side): first retry fast, cap low — the
+# drills sever connections constantly and the barrier is waiting.
+_RECONNECT_BASE_S = 0.02
+_RECONNECT_MAX_S = 1.0
+_HELLO_TIMEOUT_S = 10.0
+# Blocked-write ceiling: acks are written from the READER thread under
+# the write lock, so two peers simultaneously pushing large frames into
+# full TCP buffers could deadlock symmetrically (neither reader drains
+# because both are stuck in sendall). A bounded send converts that into
+# a severed connection — which the seq/ack/resume layer recovers.
+_SEND_TIMEOUT_S = 30.0
+
+
+class ChannelClosed(RuntimeError):
+    pass
+
+
+class SocketChannel:
+    """One end of a reliable message channel (ReplicaChannel interface).
+
+    Built either by `SocketChannel.connect` (replica side: dials and
+    redials the listener) or by `ChannelListener.endpoint` (coordinator
+    side: passive, rebound by each hello)."""
+
+    def __init__(self, cid, faults: Optional[FaultInjector] = None,
+                 name: str = ""):
+        self.cid = cid
+        self.name = name or f"chan-{cid}"
+        self._faults = faults
+        self._in_q: "queue_mod.Queue" = queue_mod.Queue()
+        self._wlock = threading.RLock()
+        self._out_seq = 0
+        self._out_buf: "OrderedDict[int, object]" = OrderedDict()
+        self._in_next = 0
+        self._in_hold: Dict[int, object] = {}
+        self._sock: Optional[socket.socket] = None
+        self._sock_gen = 0
+        self._closed = False
+        self._held_frame = None  # reorder fault: frame awaiting a swap
+        # Frames that arrived ahead of sequence and were held for
+        # resequencing (drill evidence that reordering really happened).
+        self.resequenced = 0
+        # Connector-side only:
+        self._addr: Optional[Tuple[str, int]] = None
+        self._dialer: Optional[threading.Thread] = None
+        self._disconnected = threading.Event()
+        self._disconnected.set()
+
+    # -- construction --------------------------------------------------------
+
+    @classmethod
+    def connect(cls, addr, cid, faults: Optional[FaultInjector] = None,
+                plan: Optional[FaultPlan] = None,
+                name: str = "") -> "SocketChannel":
+        """Replica-side channel: dial `addr`, identify as `cid`, redial
+        forever on loss until closed."""
+        if faults is None and plan is not None:
+            faults = plan.injector(cid)
+        chan = cls(cid, faults=faults, name=name)
+        chan._addr = (addr[0], int(addr[1]))
+        chan._dialer = threading.Thread(
+            target=chan._dial_loop, name=f"dial-{chan.name}", daemon=True)
+        chan._dialer.start()
+        return chan
+
+    # -- ReplicaChannel ------------------------------------------------------
+
+    def send(self, msg) -> None:
+        """Enqueue + best-effort write. Never raises on connection loss:
+        the frame stays in the unacked buffer and the reconnect
+        handshake retransmits it."""
+        with self._wlock:
+            if self._closed:
+                raise ChannelClosed(f"{self.name} is closed")
+            seq = self._out_seq
+            self._out_seq = seq + 1
+            self._out_buf[seq] = msg
+            self._write_data(seq, msg)
+
+    def recv(self, timeout: Optional[float] = None):
+        try:
+            item = self._in_q.get(timeout=timeout)
+        except queue_mod.Empty:
+            raise WorkerDiedError(
+                f"{self.name}: no message within {timeout}s")
+        if item is _CLOSED:
+            raise WorkerDiedError(f"{self.name}: channel closed")
+        return item
+
+    def close(self) -> None:
+        with self._wlock:
+            if self._closed:
+                return
+            self._closed = True
+            self._drop_socket()
+        self._in_q.put(_CLOSED)
+
+    # -- wire ----------------------------------------------------------------
+
+    def _write_frame(self, obj) -> bool:
+        """Write one frame on the current socket; False (and socket
+        dropped) on failure. Caller holds _wlock."""
+        sock = self._sock
+        if sock is None:
+            return False
+        try:
+            sock.sendall(encode_message(obj))
+            return True
+        except OSError:
+            self._drop_socket()
+            return False
+
+    def _write_data(self, seq: int, msg) -> None:
+        """Data-frame write with fault injection. Caller holds _wlock."""
+        frame = {"t": "d", "s": seq, "m": msg}
+        faults = self._faults
+        if faults is None or self._sock is None:
+            self._flush_held()
+            self._write_frame(frame)
+            return
+        action = faults.next_action()
+        if action == DROP:
+            # Model packet loss at the recoverable layer: sever. The
+            # unacked buffer (this frame included) retransmits on the
+            # reconnect handshake.
+            self._drop_socket()
+            return
+        if action == REORDER:
+            if self._held_frame is None:
+                # Hold this frame so the NEXT one passes it on the wire
+                # (the actual swap happens in _flush_held below, which
+                # writes the newer frame FIRST). If nothing follows, a
+                # short timer flushes it so a quiet channel cannot
+                # stall behind its own fault.
+                self._held_frame = frame
+                gen = self._sock_gen
+                t = threading.Timer(0.01, self._flush_held_timer,
+                                    args=(gen,))
+                t.daemon = True
+                t.start()
+            else:
+                # Already holding one: emit this pair swapped.
+                held, self._held_frame = self._held_frame, None
+                self._write_frame(frame)
+                self._write_frame(held)
+            return
+        if action == DELAY:
+            time.sleep(self._faults.plan.delay_ms / 1000.0)
+        # Current frame FIRST, held frame after: a held frame reaches
+        # the wire one slot late — genuinely out of order, which the
+        # receiver's resequencing absorbs (and the drills prove).
+        self._write_frame(frame)
+        self._flush_held()
+
+    def _flush_held(self) -> None:
+        held, self._held_frame = self._held_frame, None
+        if held is not None:
+            self._write_frame(held)
+
+    def _flush_held_timer(self, gen: int) -> None:
+        with self._wlock:
+            if not self._closed and self._sock_gen == gen:
+                self._flush_held()
+
+    def _drop_socket(self) -> None:
+        """Caller holds _wlock."""
+        sock, self._sock = self._sock, None
+        self._sock_gen += 1
+        self._held_frame = None
+        if sock is not None:
+            try:
+                sock.close()
+            except OSError:
+                pass
+        self._disconnected.set()
+
+    # -- attachment (both sides) --------------------------------------------
+
+    def attach(self, sock: socket.socket, peer_rx: Optional[int] = None,
+               send_hello: bool = False, preload: bytes = b"") -> None:
+        """Adopt a connected socket: start its reader, optionally greet,
+        and retransmit everything the peer has not seen (`peer_rx` is
+        the peer's next-expected sequence from its hello; None = unknown
+        yet, retransmission waits for the peer's hello frame).
+        `preload` is residual stream bytes a handshake read past the
+        hello — the reader resumes mid-frame from them."""
+        try:
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        except OSError:
+            pass
+        # Bounded blocking for BOTH directions (one socket timeout
+        # governs send and recv): a send stuck past the ceiling severs
+        # the connection instead of deadlocking the reader thread; the
+        # reader treats the same timeout as "idle, keep reading".
+        sock.settimeout(_SEND_TIMEOUT_S)
+        with self._wlock:
+            if self._closed:
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+                return
+            self._drop_socket()
+            self._sock = sock
+            self._sock_gen += 1
+            gen = self._sock_gen
+            self._disconnected.clear()
+            if send_hello:
+                self._write_frame({"t": "h", "id": self.cid,
+                                   "rx": self._in_next})
+            if peer_rx is not None:
+                self._retransmit(peer_rx)
+        reader = threading.Thread(
+            target=self._read_loop, args=(sock, gen, preload),
+            name=f"read-{self.name}", daemon=True)
+        reader.start()
+
+    def _retransmit(self, peer_rx: int) -> None:
+        """Resend every buffered frame the peer has not delivered, and
+        drop the ones it has (an ack can be lost with the connection).
+        Caller holds _wlock."""
+        for seq in [s for s in self._out_buf if s < peer_rx]:
+            del self._out_buf[seq]
+        for seq, msg in list(self._out_buf.items()):
+            if not self._write_frame({"t": "d", "s": seq, "m": msg}):
+                return
+
+    # -- reader --------------------------------------------------------------
+
+    def _read_loop(self, sock: socket.socket, gen: int,
+                   preload: bytes = b"") -> None:
+        decoder = FrameDecoder()
+        try:
+            if preload:
+                for payload in decoder.feed(preload):
+                    self._on_frame(decode_message(payload))
+            while True:
+                try:
+                    data = sock.recv(1 << 16)
+                except socket.timeout:
+                    continue  # idle channel, not a dead one
+                except OSError:
+                    break
+                if not data:
+                    break
+                try:
+                    frames = decoder.feed(data)
+                except FrameError:
+                    break
+                for payload in frames:
+                    self._on_frame(decode_message(payload))
+        finally:
+            with self._wlock:
+                if self._sock is sock:
+                    self._drop_socket()
+
+    def _on_frame(self, frame) -> None:
+        t = frame.get("t")
+        if t == "d":
+            msg = frame["m"]
+            if isinstance(msg, list):
+                # The envelope decoded as a dict, so the message itself
+                # is still a JSON array: deliver it as the tuple the
+                # pipe/queue transports would have delivered.
+                msg = tuple(msg)
+            self._on_data(frame["s"], msg)
+        elif t == "a":
+            with self._wlock:
+                acked = frame["s"]
+                for seq in [s for s in self._out_buf if s <= acked]:
+                    del self._out_buf[seq]
+        elif t == "h":
+            # Peer's (re)connect greeting: its next-expected sequence.
+            with self._wlock:
+                self._retransmit(int(frame["rx"]))
+
+    def _on_data(self, seq: int, msg) -> None:
+        with self._wlock:
+            if seq == self._in_next:
+                self._in_next += 1
+                self._in_q.put(msg)
+                hold = self._in_hold
+                while self._in_next in hold:
+                    self._in_q.put(hold.pop(self._in_next))
+                    self._in_next += 1
+            elif seq > self._in_next:
+                self._in_hold[seq] = msg
+                self.resequenced += 1
+            # seq < in_next: duplicate of a delivered frame; ack only.
+            self._write_frame({"t": "a", "s": self._in_next - 1})
+
+    # -- connector loop ------------------------------------------------------
+
+    def _dial_loop(self) -> None:
+        attempt = 0
+        while True:
+            self._disconnected.wait()
+            if self._closed:
+                return
+            try:
+                sock = socket.create_connection(self._addr, timeout=5.0)
+            except OSError:
+                attempt += 1
+                time.sleep(min(_RECONNECT_BASE_S * (2 ** min(attempt, 8)),
+                               _RECONNECT_MAX_S))
+                continue
+            attempt = 0
+            # Greet with our identity + next-expected seq; the listener
+            # answers with its own hello, which triggers retransmit.
+            self.attach(sock, peer_rx=None, send_hello=True)
+            # Wait until this socket dies before dialing again.
+            while not self._disconnected.wait(timeout=0.05):
+                if self._closed:
+                    return
+            if self._closed:
+                return
+
+    # -- drills --------------------------------------------------------------
+
+    def sever(self) -> None:
+        """Drop the live connection (drill hook): everything unacked
+        retransmits on the next handshake."""
+        with self._wlock:
+            self._drop_socket()
+
+    @property
+    def connected(self) -> bool:
+        return self._sock is not None
+
+    @property
+    def unacked(self) -> int:
+        with self._wlock:
+            return len(self._out_buf)
+
+
+class WorkerDiedError(RuntimeError):
+    """recv timeout / closed channel — the transport-level analog of
+    replica_runtime.WorkerDied (kept separate so transport/ has no
+    import cycle with controllers/; the runtime maps one to the
+    other)."""
+
+
+class ChannelListener:
+    """The coordinator host's accept loop: one listening socket, one
+    passive `SocketChannel` endpoint per replica id, re-bound on every
+    hello (reconnects included)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 plan: Optional[FaultPlan] = None):
+        self._plan = plan
+        self._endpoints: Dict[object, SocketChannel] = {}
+        self._lock = threading.Lock()
+        self._closed = False
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(64)
+        self.address: Tuple[str, int] = self._sock.getsockname()[:2]
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name="chan-listener", daemon=True)
+        self._accept_thread.start()
+
+    def endpoint(self, cid, name: str = "") -> SocketChannel:
+        """The coordinator-side channel for replica `cid` (created on
+        first use; the replica may not have dialed yet — sends buffer
+        until its hello arrives)."""
+        with self._lock:
+            chan = self._endpoints.get(cid)
+            if chan is None:
+                faults = self._plan.injector(
+                    f"listener/{cid}") if self._plan else None
+                chan = SocketChannel(cid, faults=faults,
+                                     name=name or f"endpoint-{cid}")
+                self._endpoints[cid] = chan
+            return chan
+
+    def _accept_loop(self) -> None:
+        while True:
+            try:
+                sock, _ = self._sock.accept()
+            except OSError:
+                return  # listener closed
+            threading.Thread(target=self._handshake, args=(sock,),
+                             name="chan-hello", daemon=True).start()
+
+    def _handshake(self, sock: socket.socket) -> None:
+        """Read the dialer's hello, bind its endpoint, answer with ours
+        (which carries our next-expected seq and triggers the peer's
+        retransmission)."""
+        decoder = FrameDecoder()
+        sock.settimeout(_HELLO_TIMEOUT_S)
+        hello = None
+        extra: list = []
+        try:
+            while hello is None:
+                data = sock.recv(1 << 16)
+                if not data:
+                    sock.close()
+                    return
+                frames = decoder.feed(data)
+                if frames:
+                    hello = decode_message(frames[0])
+                    extra = frames[1:]
+        except (OSError, FrameError):
+            try:
+                sock.close()
+            except OSError:
+                pass
+            return
+        sock.settimeout(None)
+        if not isinstance(hello, dict) or hello.get("t") != "h":
+            sock.close()
+            return
+        cid = hello.get("id")
+        chan = self.endpoint(cid)
+        # Frames that arrived glued to the hello dispatch BEFORE the
+        # reader starts (resequencing absorbs any interleaving); the
+        # decoder's residual partial-frame bytes ride into the reader.
+        for payload in extra:
+            chan._on_frame(decode_message(payload))
+        chan.attach(sock, peer_rx=int(hello.get("rx", 0)),
+                    preload=decoder.take_buffer())
+        with chan._wlock:
+            chan._write_frame({"t": "h", "id": "listener",
+                               "rx": chan._in_next})
+
+    def close(self) -> None:
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            endpoints = list(self._endpoints.values())
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        for chan in endpoints:
+            chan.close()

@@ -1,0 +1,69 @@
+"""Barrier stall watchdog: name the process that is holding up the tick.
+
+The replica tick is a barrier — every live replica must answer the
+round, and the coordinator must answer every replica. Before this
+module, a stalled participant (SIGSTOPped worker, wedged coordinator)
+surfaced as a generic timeout at best and a silent forever-retry at
+worst (a stopped process keeps its journal flocks, so group adoption
+span forever with no error anyone could see). `BarrierStallError`
+carries the offending pid / host / replica id and the barrier round
+number, so the error that finally surfaces says exactly WHO missed WHAT.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+
+def barrier_deadline(default: float) -> float:
+    """Seconds a barrier participant may lag before the watchdog calls
+    it stalled (`KUEUE_TPU_BARRIER_DEADLINE` overrides)."""
+    raw = os.environ.get("KUEUE_TPU_BARRIER_DEADLINE", "")
+    if raw:
+        try:
+            return float(raw)
+        except ValueError:
+            pass
+    return default
+
+
+class BarrierStallError(RuntimeError):
+    """A barrier participant missed its deadline.
+
+    `who` is "replica" or "coordinator"; pid/host identify the process
+    (host is the emulated host id in multi-host mode), `round_no` the
+    barrier round that stalled, `phase` which barrier wait noticed
+    ("pretick" / "round" / "verdicts" / "done")."""
+
+    def __init__(self, who: str, *, wid: Optional[int] = None,
+                 pid: Optional[int] = None, host: Optional[str] = None,
+                 round_no: Optional[int] = None, phase: str = "",
+                 timeout_s: Optional[float] = None):
+        self.who = who
+        self.wid = wid
+        self.pid = pid
+        self.host = host
+        self.round_no = round_no
+        self.phase = phase
+        self.timeout_s = timeout_s
+        ident = who
+        if wid is not None:
+            ident += f" {wid}"
+        if pid is not None:
+            ident += f" (pid {pid}"
+            ident += f", {host})" if host else ")"
+        elif host:
+            ident += f" ({host})"
+        msg = f"barrier stall: {ident} missed round {round_no}"
+        if phase:
+            msg += f" at the {phase} wait"
+        if timeout_s is not None:
+            msg += f" beyond the {timeout_s:g}s deadline"
+        super().__init__(msg)
+
+    def to_dict(self) -> dict:
+        return {"who": self.who, "wid": self.wid, "pid": self.pid,
+                "host": self.host, "round": self.round_no,
+                "phase": self.phase, "timeout_s": self.timeout_s,
+                "error": str(self)}

@@ -148,6 +148,27 @@ class TestConfiguration:
                 "leaderElect": True,
                 "leaseDuration": "5s", "renewDeadline": "10s"}})
 
+    def test_transport_defaults_and_loading(self):
+        cfg = config_mod.from_dict({})
+        assert cfg.transport.mode == "pipe"
+        assert cfg.transport.listen_addr() == ("127.0.0.1", 0)
+        cfg = config_mod.from_dict({"transport": {
+            "mode": "socket", "listen": "0.0.0.0:7070",
+            "peers": ["10.0.0.2:7071"],
+            "faults": "delay_ms=5,delay_p=0.5,seed=3"}})
+        assert cfg.transport.mode == "socket"
+        assert cfg.transport.listen_addr() == ("0.0.0.0", 7070)
+        assert cfg.transport.peers == ("10.0.0.2:7071",)
+
+    def test_transport_validation(self):
+        with pytest.raises(config_mod.ConfigurationError):
+            config_mod.from_dict({"transport": {"mode": "carrier-pigeon"}})
+        with pytest.raises(config_mod.ConfigurationError):
+            config_mod.from_dict({"transport": {"listen": "no-port"}})
+        with pytest.raises(config_mod.ConfigurationError):
+            config_mod.from_dict({"transport": {
+                "mode": "socket", "faults": "bogus_knob=1"}})
+
 
 # -- manifest decoding -------------------------------------------------------
 

@@ -1,0 +1,463 @@
+"""Multi-host runtime drills: coordinator fail-over mid-window, the
+barrier stall watchdog, journal replication across per-host state dirs,
+elastic replica scaling + capacity loaning, and the host-lane trace
+merge (kueue_tpu/transport/ + the replica runtime's multi-host wiring).
+"""
+
+import json
+import os
+import signal
+import tempfile
+import time
+
+import pytest
+
+from kueue_tpu import features
+from kueue_tpu.controllers.replica_runtime import ReplicaRuntime
+from kueue_tpu.metrics import REGISTRY
+from kueue_tpu.transport import BarrierStallError, ElasticController
+
+from tests.test_replica import _lending_world, _split_pair
+from tests.util import fq, make_cq, make_flavor, make_lq, make_wl, rg
+
+
+def _flat_world(rt, n_cqs=4, cpu=4):
+    rt.create_resource_flavor(make_flavor("default"))
+    for i in range(n_cqs):
+        rt.create_cluster_queue(make_cq(
+            f"cq-{i}", rg("cpu", fq("default", cpu=cpu))))
+        rt.create_local_queue(make_lq(f"lq-{i}", "default", cq=f"cq-{i}"))
+
+
+def _settle(rt, ticks=4):
+    for _ in range(ticks):
+        rt.tick()
+
+
+# -- coordinator fail-over ---------------------------------------------------
+
+
+def test_coordinator_failover_replays_journaled_round(tmp_path):
+    """Kill the coordinator MID-WINDOW at the worst moment: after it
+    arbitrated and journaled a round with real split-root candidates,
+    before any replica heard a verdict. The newly elected incarnation
+    (epoch bump via lease transitions) must replay the journaled
+    verdicts and resume the barrier — and the admitted set must match
+    the single-process decision."""
+    from kueue_tpu.config import Configuration, TPUSolverConfig
+    from kueue_tpu.controllers.runtime import Framework
+    from kueue_tpu.models.flavor_fit import BatchSolver
+
+    features.set_enabled(features.LENDING_LIMIT, True)
+    ca, cb = _split_pair(2)
+
+    fw = Framework(batch_solver=BatchSolver(), config=Configuration(
+        tpu_solver=TPUSolverConfig(preemption_engine="host")),
+        pipeline_depth=1)
+    fw.create_namespace("default", labels={})
+    _lending_world(fw, ca, cb)
+    fw.submit(make_wl("wa", "lq-a", cpu=8, creation_time=1.0))
+    fw.submit(make_wl("wb", "lq-b", cpu=8, creation_time=2.0))
+    fw.run_until_settled(max_ticks=6)
+    single = tuple(sorted(
+        fw.admitted_workloads("cq-a") + fw.admitted_workloads("cq-b")))
+    assert len(single) == 1
+
+    rt = ReplicaRuntime(2, spawn=False, engine="host",
+                        state_dir=str(tmp_path / "state"))
+    try:
+        _lending_world(rt, ca, cb)
+        assert "hroot" in rt.gmap.split_roots
+        rt.submit(make_wl("wa", "lq-a", cpu=8, creation_time=1.0))
+        rt.submit(make_wl("wb", "lq-b", cpu=8, creation_time=2.0))
+        epoch_before = rt.coordinator.epoch
+        rt.kill_coordinator()  # dies inside the NEXT round
+        for _ in range(6):
+            rt.tick()
+        ev = rt.failover_evidence
+        assert ev is not None
+        assert ev["epoch_after"] > epoch_before == ev["epoch_before"]
+        # The interrupted round carried the two borrowers' candidates,
+        # and the new incarnation REPLAYED their journaled verdicts.
+        assert ev["candidates"] >= 2
+        assert ev["replayed_verdicts"] >= 2
+        assert rt.coordinator.replayed_verdicts >= 2
+        dump = rt.dump()
+        winners = tuple(sorted(dump["admitted"].get("cq-a", [])
+                               + dump["admitted"].get("cq-b", [])))
+        assert winners == single
+        # The coordinator journal shows the same round under two epochs
+        # (the takeover's audit trail).
+        with open(rt.coordinator.journal_path) as f:
+            entries = [json.loads(line) for line in f if line.strip()]
+        by_round = {}
+        for e in entries:
+            by_round.setdefault(e["round"], set()).add(e["epoch"])
+        assert any(len(eps) > 1 for eps in by_round.values()), by_round
+    finally:
+        rt.close()
+
+
+def test_coordinator_failover_without_journal_recomputes(tmp_path):
+    """No state dir -> no verdict journal: the takeover recomputes the
+    round from the shipped absolute usage (the coordinator is
+    restart-safe by construction) and the contract check still holds."""
+    rt = ReplicaRuntime(2, spawn=False, engine="host")
+    try:
+        _flat_world(rt)
+        for i in range(4):
+            rt.submit(make_wl(f"w-{i}", f"lq-{i}", cpu=3,
+                              creation_time=float(i)))
+        rt.kill_coordinator()
+        _settle(rt)
+        assert rt.failover_evidence is not None
+        assert rt.failover_evidence["epoch_after"] \
+            > rt.failover_evidence["epoch_before"]
+        admitted = rt.dump()["admitted"]
+        assert sum(len(v) for v in admitted.values()) == 4
+    finally:
+        rt.close()
+
+
+# -- barrier stall watchdog --------------------------------------------------
+
+
+def test_worker_side_coordinator_stall_raises(monkeypatch):
+    """A replica blocked on verdicts past the deadline raises a
+    BarrierStallError naming itself and the round — today's silent
+    forever-block, surfaced."""
+    import queue
+
+    from kueue_tpu.controllers.replica_runtime import (
+        ReplicaWorker,
+        _QueueChan,
+    )
+
+    monkeypatch.setenv("KUEUE_TPU_BARRIER_DEADLINE", "0.1")
+    to_worker: "queue.Queue" = queue.Queue()
+    to_parent: "queue.Queue" = queue.Queue()
+    worker = ReplicaWorker(0, {"solver": False, "n_groups": 1},
+                           _QueueChan(to_parent, to_worker))
+    with pytest.raises(BarrierStallError) as exc_info:
+        worker._submit_round({"candidates": [], "usage": {}})
+    err = exc_info.value
+    assert err.who == "coordinator"
+    assert err.pid == os.getpid()
+    assert err.phase == "verdicts"
+    assert "round" in str(err) and "deadline" in str(err)
+
+
+@pytest.mark.slow
+def test_sigstopped_worker_surfaces_stall_and_recovers(tmp_path):
+    """REGRESSION for today's silent stall: a SIGSTOPped worker used to
+    hold the barrier to the timeout and then its journal flocks forever
+    (adoption retried silently every tick). Now the watchdog surfaces a
+    BarrierStallError with the offending pid + round, kills the stalled
+    process so the flocks clear, and the groups fail over."""
+    stalled_errors = []
+    rt = ReplicaRuntime(2, spawn=True, engine="host",
+                        state_dir=str(tmp_path / "state"))
+    rt.round_timeout = 5.0
+    rt.on_stall = stalled_errors.append
+    try:
+        _flat_world(rt, n_cqs=3, cpu=4)
+        for i in range(3):
+            rt.submit(make_wl(f"a-{i}", f"lq-{i}", cpu=3,
+                              creation_time=float(i)))
+            rt.submit(make_wl(f"b-{i}", f"lq-{i}", cpu=3,
+                              creation_time=float(10 + i)))
+        _settle(rt, 3)
+        before = rt.dump()["admitted"]
+        assert sum(len(v) for v in before.values()) == 3
+        victim = rt.workers[0]
+        os.kill(victim.pid, signal.SIGSTOP)
+        stalls_before = REGISTRY.replica_barrier_stalls_total.get(
+            str(victim.wid))
+        stats = rt.tick()
+        assert stats["stalls"], "the stall never surfaced"
+        stall = stats["stalls"][0]
+        assert stall["pid"] == victim.pid
+        assert stall["round"] == rt.tick_no
+        assert stall["who"] == "replica"
+        assert stalled_errors and isinstance(
+            stalled_errors[0], BarrierStallError)
+        assert REGISTRY.replica_barrier_stalls_total.get(
+            str(victim.wid)) == stalls_before + 1
+        assert rt.stall_count >= 1
+        # Recovery: the stalled process was killed (flocks cleared) and
+        # its groups adopted — the admitted set survives intact.
+        _settle(rt, 4)
+        after = rt.dump()["admitted"]
+        assert after == before
+        assert all(owner != victim.wid
+                   for owner in rt.group_owner.values())
+    finally:
+        rt.close()
+
+
+# -- per-host journals + replication -----------------------------------------
+
+
+def test_per_host_journals_replicate_to_coordinator(tmp_path):
+    """Per-host mode: each replica journals in its OWN host dir; the
+    coordinator's replica copies mirror them line for line through the
+    async segment stream — no shared filesystem between hosts."""
+    state = str(tmp_path / "state")
+    rt = ReplicaRuntime(2, spawn=False, engine="host", state_dir=state,
+                        transport="socket")
+    try:
+        assert rt.per_host and rt.replicator is not None
+        _flat_world(rt)
+        for i in range(4):
+            rt.submit(make_wl(f"w-{i}", f"lq-{i}", cpu=3,
+                              creation_time=float(i)))
+        _settle(rt)
+        rt.replicator.flush()
+        host_dirs = sorted(d for d in os.listdir(state)
+                           if d.startswith("host-"))
+        assert host_dirs == ["host-0", "host-1"]
+        mirrored = 0
+        for wid, host in enumerate(host_dirs):
+            for fn in sorted(os.listdir(os.path.join(state, host))):
+                if not fn.endswith(".jsonl"):
+                    continue
+                local = os.path.join(state, host, fn)
+                gid = int(fn[len("journal-g"):-len(".jsonl")])
+                with open(local) as f:
+                    local_lines = [ln.rstrip("\n") for ln in f
+                                   if ln.strip()]
+                assert rt.replicator.read_lines(gid) == local_lines, \
+                    f"replica copy of {fn} diverged"
+                mirrored += 1
+        assert mirrored == rt.n_groups
+        assert rt.replicator.applied_lines > 0
+    finally:
+        rt.close()
+
+
+def test_backlog_gauge_and_dumper_reconcile_info():
+    """Satellite: the per-shard-group backlog gauge feeds from the
+    barrier replies, and the SIGUSR2 Dumper carries the reconcile
+    round + coordinator epoch + backlog depth."""
+    from kueue_tpu.controllers.debugger import Dumper
+
+    rt = ReplicaRuntime(2, spawn=False, engine="host")
+    try:
+        _flat_world(rt, n_cqs=4, cpu=4)
+        for i in range(4):
+            for j in range(3):  # 1 fits, 2 wait per CQ
+                rt.submit(make_wl(f"w-{i}-{j}", f"lq-{i}", cpu=3,
+                                  creation_time=float(i * 10 + j)))
+        _settle(rt, 3)
+        assert rt.backlog_last, "no backlog reported"
+        assert sum(rt.backlog_last.values()) == 8  # 2 waiting per CQ
+        for gid, depth in rt.backlog_last.items():
+            assert REGISTRY.replica_backlog_depth.get(str(gid)) \
+                == float(depth)
+        dump = Dumper(reconcile=rt.reconcile_info).dump()
+        rec = dump["reconcile"]
+        assert rec["round"] == rt.coordinator.rounds
+        assert rec["epoch"] == rt.coordinator.epoch >= 1
+        assert rec["backlogDepth"] \
+            == {str(g): n for g, n in rt.backlog_last.items()}
+        assert REGISTRY.reconcile_round_epoch.get() \
+            == float(rt.coordinator.epoch)
+    finally:
+        rt.close()
+
+
+# -- elastic scaling + capacity loaning --------------------------------------
+
+
+def test_elastic_scale_up_loan_and_scale_down(tmp_path):
+    """The Aryl loop end to end on the socket transport: scale N->N+1
+    under load (group migrated to the new replica), capacity LOANED
+    from an idle replica to a loaded one, loans returned + scale down
+    to N once drained — with the decision set complete and the
+    post-resettle steady window dispatching ZERO solves (the
+    quiescent-tick discipline survives every migration)."""
+    rt = ReplicaRuntime(2, spawn=False, engine="host",
+                        state_dir=str(tmp_path / "state"),
+                        transport="socket", n_groups=4)
+    ctl = ElasticController(rt, scale_up_backlog=3, idle_backlog=0,
+                            loan_min_backlog=2, min_replicas=2,
+                            max_replicas=3, cooldown_ticks=0)
+    try:
+        _flat_world(rt, n_cqs=8, cpu=2)
+        # Load EVERY group deeply -> scale-up fires first.
+        keys = []
+        for i in range(8):
+            for j in range(4):
+                key = f"w-{i}-{j}"
+                keys.append((f"default/{key}", f"cq-{i}"))
+                rt.submit(make_wl(key, f"lq-{i}", cpu=2,
+                                  creation_time=float(i * 100 + j)))
+        stats = rt.tick()
+        actions = []
+        for _ in range(30):
+            # Churn: finish everything admitted so the backlog drains
+            # and the DOWN half of the loop gets its turn.
+            done = [(k, cq) for k, cq in stats["admitted"]]
+            if done:
+                rt.finish_many(done)
+            act = ctl.step(rt.backlog_last)
+            if act:
+                actions.append(act)
+            stats = rt.tick()
+        assert any(a.startswith("scale-up") for a in actions), actions
+        assert any(a.startswith("scale-down") or a.startswith("return")
+                   for a in actions), actions
+        assert len(rt.workers) == 3  # the elastic worker was created
+        # Post-resettle steady window: everything drained, every tick
+        # must dispatch zero solves.
+        _settle(rt, 2)
+        for _ in range(3):
+            stats = rt.tick()
+            assert stats["dispatches"] == 0, \
+                f"steady tick dispatched solves after elastic churn: {stats}"
+        # Nothing lost across all the migrations: every workload was
+        # admitted exactly once (finish_many consumed them).
+        assert sum(rt.dump()["pending"].values()) == 0
+    finally:
+        rt.close()
+
+
+def test_capacity_loan_moves_group_to_idle_replica(tmp_path):
+    """The loan in isolation: one replica drowning, one idle -> the
+    controller migrates the deepest group onto the idle replica and
+    RETURNS it home once drained."""
+    rt = ReplicaRuntime(2, spawn=False, engine="host", n_groups=4)
+    ctl = ElasticController(rt, scale_up_backlog=10_000, idle_backlog=0,
+                            loan_min_backlog=2, min_replicas=2,
+                            max_replicas=2, cooldown_ticks=0)
+    try:
+        _flat_world(rt, n_cqs=8, cpu=2)
+        # Load ONLY worker 0's groups.
+        loaded = [i for i in range(8)
+                  if rt.group_owner[rt.gmap.cq_group[f"cq-{i}"]] == 0]
+        assert loaded, "hash landed every cq on worker 1; world too small"
+        for i in loaded:
+            for j in range(4):
+                rt.submit(make_wl(f"w-{i}-{j}", f"lq-{i}", cpu=2,
+                                  creation_time=float(i * 100 + j)))
+        stats = rt.tick()
+        act = ctl.step(rt.backlog_last)
+        assert act is not None and act.startswith("loan"), act
+        gid = int(act.split()[1][1:])
+        assert rt.group_owner[gid] == 1  # moved to the idle replica
+        assert ctl.loans == {gid: 0}
+        # Drain the loaned group's backlog -> the loan returns home.
+        for _ in range(24):
+            done = [(k, cq) for k, cq in stats["admitted"]]
+            if done:
+                rt.finish_many(done)
+            stats = rt.tick()
+            act = ctl.step(rt.backlog_last)
+            if act and act.startswith("return"):
+                break
+        assert act == f"return g{gid}->w0", act
+        assert rt.group_owner[gid] == 0
+        assert not ctl.loans
+    finally:
+        rt.close()
+
+
+def test_migrate_group_preserves_admitted_set(tmp_path):
+    """A live migration moves a group's ENTIRE vertical slice (admitted
+    quota re-accounted via journal replay, pending re-queued) without
+    changing a single decision."""
+    rt = ReplicaRuntime(2, spawn=False, engine="host",
+                        state_dir=str(tmp_path / "state"), n_groups=2)
+    try:
+        _flat_world(rt, n_cqs=4, cpu=4)
+        for i in range(4):
+            rt.submit(make_wl(f"a-{i}", f"lq-{i}", cpu=3,
+                              creation_time=float(i)))
+            rt.submit(make_wl(f"b-{i}", f"lq-{i}", cpu=3,
+                              creation_time=float(10 + i)))
+        _settle(rt, 3)
+        before = rt.dump()
+        gid = rt.gmap.cq_group["cq-0"]
+        assert rt.migrate_group(gid, 1 - rt.group_owner[gid])
+        _settle(rt, 2)
+        after = rt.dump()
+        assert after["admitted"] == before["admitted"]
+        assert after["pending"] == before["pending"]
+        # Finishing a migrated admitted workload still releases quota on
+        # the adopter: its waiting twin admits.
+        rt.finish("default/a-0", cq="cq-0")
+        _settle(rt, 3)
+        assert rt.dump()["admitted"]["cq-0"] == ["default/b-0"]
+    finally:
+        rt.close()
+
+
+# -- host-lane trace merge ---------------------------------------------------
+
+
+def test_merged_trace_host_lanes_and_skew_clamped_flows():
+    """Satellite: merged Chrome traces label every process lane with its
+    host id, and the reconcile flow arrows survive cross-host clock
+    rebasing — an epoch skew that would point an arrow backwards in
+    merged time is clamped, never dropped."""
+    from kueue_tpu.tracing import merge_chrome_traces, validate_chrome_trace
+
+    def doc(epoch, events):
+        return {"traceEvents": events, "displayTimeUnit": "ms",
+                "otherData": {"tracer": "kueue-tpu", "enabled": True,
+                              "ticks_retained": 1, "epoch_unix": epoch}}
+
+    rtt = {"name": "admit.reconcile.rtt", "ph": "X", "ts": 1000.0,
+           "dur": 500.0, "pid": 1, "tid": 2, "cat": "kueue",
+           "args": {"round": 1}}
+    rnd = {"name": "reconcile.round", "ph": "X", "ts": 1100.0,
+           "dur": 100.0, "pid": 1, "tid": 3, "cat": "kueue",
+           "args": {"round": 1}}
+    # The replica host's clock runs 10ms AHEAD of the coordinator's:
+    # naive rebasing would start the flow after its finish.
+    merged = merge_chrome_traces([
+        (100, "coordinator", doc(1000.0, [rnd]), "host-coordinator"),
+        (200, "replica-0", doc(1000.010, [rtt]), "host-0"),
+    ])
+    assert validate_chrome_trace(merged) == []
+    labels = {e["pid"]: e["args"]["labels"]
+              for e in merged["traceEvents"]
+              if e.get("name") == "process_labels"}
+    assert labels == {100: "host-coordinator", 200: "host-0"}
+    names = {e["args"]["name"] for e in merged["traceEvents"]
+             if e.get("name") == "process_name"}
+    assert names == {"coordinator @host-coordinator",
+                     "replica-0 @host-0"}
+    assert merged["otherData"]["hosts"] == ["host-coordinator", "host-0"]
+    flows = {e["ph"]: e for e in merged["traceEvents"]
+             if e.get("ph") in ("s", "f")}
+    assert set(flows) == {"s", "f"}
+    assert flows["s"]["ts"] <= flows["f"]["ts"], \
+        "flow arrow points backwards after rebasing"
+    # 3-tuple docs (no host) still merge — the PR 9 call sites.
+    legacy = merge_chrome_traces([(1, "solo", doc(0.0, []))])
+    assert validate_chrome_trace(legacy) == []
+    assert legacy["otherData"]["hosts"] == []
+
+
+def test_runtime_merged_trace_carries_host_lanes():
+    """The loopback runtime's own export rides the same path: the
+    coordinator lane is host-labeled and the doc validates."""
+    from kueue_tpu.tracing import TRACER, validate_chrome_trace
+
+    TRACER.reset()
+    TRACER.configure(enabled=True)
+    try:
+        rt = ReplicaRuntime(2, spawn=False, engine="host")
+        try:
+            _flat_world(rt, n_cqs=2)
+            rt.submit(make_wl("w", "lq-0", cpu=2, creation_time=1.0))
+            _settle(rt, 2)
+            doc = rt.export_chrome()
+        finally:
+            rt.close()
+    finally:
+        TRACER.configure(enabled=False)
+        TRACER.reset()
+    assert validate_chrome_trace(doc) == []
+    assert doc["otherData"]["hosts"] == ["host-coordinator"]

@@ -1,0 +1,309 @@
+"""Multi-host transport: frame codec, fault injection, the reliable
+socket channel, and the two-"host" identity golden vs the pipe transport
+(kueue_tpu/transport/).
+
+The codec contract: partial frames across arbitrarily split reads decode
+identically to one big read, torn trailing frames stay pending (and die
+with the connection — the reconnect handshake retransmits them whole),
+and the fault schedule is a pure function of (seed, channel id) so fault
+drills are reproducible. The channel contract: exactly-once in-order
+delivery across severed connections, injected drops, and reordered
+frames. The deployment contract: a socket-transport replica deployment
+with SEPARATE per-host state directories replays the pipe-transport
+(and single-process) decision trail byte for byte.
+"""
+
+import tempfile
+
+import pytest
+
+from kueue_tpu import features
+from kueue_tpu.transport import (
+    ChannelListener,
+    FaultPlan,
+    FrameDecoder,
+    FrameError,
+    SocketChannel,
+    WorkerDiedError,
+    decode_message,
+    encode_frame,
+    encode_message,
+    parse_fault_env,
+)
+from kueue_tpu.transport.faults import PASS
+
+from tests.test_replica import _ReplicaTarget, _SingleTarget, drive
+
+
+# -- frame codec -------------------------------------------------------------
+
+
+def test_codec_roundtrip_and_partial_reads():
+    msgs = [("tick", 3, True), {"op": "round", "usage": {"f": {"cpu": 2}}},
+            ("verdicts", [True, False]), ("objs", [[0, {"kind": "W"}]])]
+    blob = b"".join(encode_message(m) for m in msgs)
+    # Whole-blob feed and byte-by-byte feed decode identically.
+    whole = [decode_message(p) for p in FrameDecoder().feed(blob)]
+    dec = FrameDecoder()
+    dribble = []
+    for i in range(len(blob)):
+        dribble.extend(decode_message(p) for p in dec.feed(blob[i:i + 1]))
+    assert whole == dribble
+    assert dec.pending_bytes == 0
+    # Tuples survive the JSON wire at the top level (the transports'
+    # message shape); nested containers are positional, lists are fine.
+    assert dribble[0] == ("tick", 3, True)
+    assert dribble[1]["usage"]["f"]["cpu"] == 2
+
+
+def test_codec_torn_trailing_frame_stays_pending():
+    dec = FrameDecoder()
+    blob = encode_message(("a",)) + encode_message(("b", 2))
+    torn = blob[:-3]  # killed mid-append
+    frames = dec.feed(torn)
+    assert [decode_message(p) for p in frames] == [("a",)]
+    assert dec.pending_bytes > 0  # the torn write, visibly incomplete
+    # The retransmitted whole frame completes it.
+    frames = dec.feed(blob[-3:])
+    assert [decode_message(p) for p in frames] == [("b", 2)]
+
+
+def test_codec_rejects_desynced_stream():
+    dec = FrameDecoder()
+    with pytest.raises(FrameError):
+        dec.feed(b"\xff\xff\xff\xff garbage that is not a frame header")
+
+
+def test_encode_frame_layout():
+    payload = b'{"x":1}'
+    frame = encode_frame(payload)
+    assert frame[:4] == len(payload).to_bytes(4, "big")
+    assert frame[4:] == payload
+
+
+# -- fault injection ---------------------------------------------------------
+
+
+def test_fault_schedule_is_seed_deterministic():
+    plan = FaultPlan(seed=11, drop_prob=0.1, reorder_prob=0.2,
+                     delay_prob=0.4, delay_ms=1)
+    a = [plan.injector("chan-x").next_action() for _ in range(200)]
+    b = [plan.injector("chan-x").next_action() for _ in range(200)]
+    assert a == b  # same seed + channel -> same schedule
+    c = [plan.injector("chan-y").next_action() for _ in range(200)]
+    assert a != c  # channels draw independent schedules
+    assert any(x != PASS for x in a)  # the mix actually fires
+    d = [FaultPlan(seed=12, drop_prob=0.1, reorder_prob=0.2,
+                   delay_prob=0.4, delay_ms=1)
+         .injector("chan-x").next_action() for _ in range(200)]
+    assert a != d  # the seed matters
+
+
+def test_parse_fault_env():
+    plan = parse_fault_env("delay_ms=5,delay_p=0.5,drop_p=0.01,seed=7")
+    assert plan == FaultPlan(seed=7, delay_ms=5, delay_prob=0.5,
+                             drop_prob=0.01)
+    assert parse_fault_env("") is None
+    assert parse_fault_env("delay_ms=5") is None  # no probability: inert
+    with pytest.raises(ValueError):
+        parse_fault_env("bogus_knob=1")
+
+
+# -- the reliable channel ----------------------------------------------------
+
+
+def _pair(plan=None):
+    lis = ChannelListener(plan=plan)
+    ep = lis.endpoint(0)
+    ch = SocketChannel.connect(lis.address, 0, plan=plan)
+    return lis, ep, ch
+
+
+def test_channel_delivers_both_directions():
+    lis, ep, ch = _pair()
+    try:
+        ep.send(("down", 1))
+        ch.send(("up", 2))
+        assert ch.recv(timeout=10) == ("down", 1)
+        assert ep.recv(timeout=10) == ("up", 2)
+    finally:
+        ch.close(); ep.close(); lis.close()
+
+
+def test_channel_recv_timeout_raises():
+    lis, ep, ch = _pair()
+    try:
+        with pytest.raises(WorkerDiedError):
+            ch.recv(timeout=0.05)
+    finally:
+        ch.close(); ep.close(); lis.close()
+
+
+def test_channel_reconnect_and_resume_exactly_once():
+    """Sever the connection repeatedly mid-stream: every message still
+    arrives exactly once, in order — the seq/ack/retransmit layer."""
+    lis, ep, ch = _pair()
+    try:
+        got = []
+        for i in range(30):
+            ep.send(("n", i))
+            if i % 7 == 3:
+                ch.sever()       # connector-side loss
+            if i % 11 == 5:
+                ep.sever()       # listener-side loss
+            if i % 3 == 0:
+                got.append(ch.recv(timeout=10))
+        while len(got) < 30:
+            got.append(ch.recv(timeout=10))
+        assert got == [("n", i) for i in range(30)]
+    finally:
+        ch.close(); ep.close(); lis.close()
+
+
+def test_channel_survives_fault_storm_in_order():
+    """Seeded drop/reorder/delay storm: delivery stays exactly-once and
+    ordered in both directions (drop severs + resumes, reorder is
+    absorbed by resequencing)."""
+    import time
+
+    plan = FaultPlan(seed=3, drop_prob=0.05, reorder_prob=0.15,
+                     delay_prob=0.3, delay_ms=1)
+    lis, ep, ch = _pair(plan=plan)
+    try:
+        deadline = time.time() + 10
+        while not (ch.connected and ep.connected):
+            assert time.time() < deadline, "never connected"
+            time.sleep(0.01)
+        n = 120
+        for i in range(n):
+            ep.send(("m", i))
+            ch.send(("r", i))
+        assert [ch.recv(timeout=15) for _ in range(n)] \
+            == [("m", i) for i in range(n)]
+        assert [ep.recv(timeout=15) for _ in range(n)] \
+            == [("r", i) for i in range(n)]
+        fired = ep._faults.stats.to_dict()
+        assert sum(fired.values()) > 0, f"storm never fired: {fired}"
+    finally:
+        ch.close(); ep.close(); lis.close()
+
+
+def test_channel_reorder_fault_really_reorders_the_wire():
+    """A pure-reorder storm must put frames on the wire OUT of order —
+    provable by the receiver's resequencing hold counter — while
+    delivery stays in order. (Regression: an earlier fault path flushed
+    the held frame before every write, silently preserving wire order
+    and drilling nothing.)"""
+    plan = FaultPlan(seed=2, reorder_prob=0.5)
+    lis, ep, ch = _pair(plan=plan)
+    try:
+        import time
+
+        deadline = time.time() + 10
+        while not (ch.connected and ep.connected):
+            assert time.time() < deadline, "never connected"
+            time.sleep(0.01)
+        n = 60
+        for i in range(n):
+            ep.send(("m", i))
+        assert [ch.recv(timeout=15) for _ in range(n)] \
+            == [("m", i) for i in range(n)]
+        assert ep._faults.stats.reorders > 0
+        assert ch.resequenced > 0, \
+            "reorder faults fired but the wire order never changed"
+    finally:
+        ch.close(); ep.close(); lis.close()
+
+
+def test_channel_buffers_before_first_connect():
+    """Sends before the peer ever dialed deliver after the handshake
+    (the runtime routes objects to workers as soon as they spawn)."""
+    lis = ChannelListener()
+    ep = lis.endpoint(4)
+    try:
+        for i in range(5):
+            ep.send(("early", i))
+        ch = SocketChannel.connect(lis.address, 4)
+        try:
+            assert [ch.recv(timeout=10) for _ in range(5)] \
+                == [("early", i) for i in range(5)]
+        finally:
+            ch.close()
+    finally:
+        ep.close(); lis.close()
+
+
+# -- the two-"host" identity golden ------------------------------------------
+
+
+def _expected_trail():
+    target = _SingleTarget(None)
+    try:
+        return drive(target, ticks=40)
+    finally:
+        target.close()
+
+
+class _SocketTarget(_ReplicaTarget):
+    """The replica harness on the SOCKET transport with separate
+    per-host state dirs — two emulated hosts over loopback TCP."""
+
+    def __init__(self, replicas, state_dir, faults=None):
+        from kueue_tpu.controllers.replica_runtime import ReplicaRuntime
+        from tests.test_replica import _apply_world
+
+        features.set_enabled(features.LENDING_LIMIT, True)
+        self.rt = ReplicaRuntime(replicas, spawn=False, engine="host",
+                                 state_dir=state_dir, transport="socket",
+                                 faults=faults)
+        _apply_world(self.rt)
+        self._revocations = 0
+
+
+def test_two_host_socket_identity_vs_pipe_transport():
+    """Two emulated hosts (separate state dirs, loopback sockets, the
+    framed reconcile protocol end to end, split KEP-79 tree included)
+    replay the single-process decision trail byte for byte — the
+    socket transport is decision-invisible, exactly like the pipe
+    transport it replaces."""
+    expect = _expected_trail()
+    with tempfile.TemporaryDirectory() as td:
+        target = _SocketTarget(2, state_dir=td)
+        try:
+            trail = drive(target, ticks=40)
+            assert target.rt.transport == "socket"
+            assert target.rt.per_host
+        finally:
+            target.close()
+    assert trail == expect
+
+
+def test_two_host_socket_identity_with_injected_delay():
+    """The same golden WITH seeded packet-delay injection: latency
+    faults shift reconcile RTT, never decisions."""
+    expect = _expected_trail()
+    with tempfile.TemporaryDirectory() as td:
+        target = _SocketTarget(
+            2, state_dir=td,
+            faults=FaultPlan(seed=5, delay_ms=2, delay_prob=0.3))
+        try:
+            trail = drive(target, ticks=40)
+        finally:
+            target.close()
+    assert trail == expect
+
+
+def test_no_socket_kill_switch_forces_pipe(monkeypatch):
+    from kueue_tpu.controllers.replica_runtime import (
+        ReplicaRuntime,
+        transport_from_env,
+    )
+
+    monkeypatch.setenv("KUEUE_TPU_NO_SOCKET", "1")
+    assert transport_from_env("socket") == "pipe"
+    rt = ReplicaRuntime(2, spawn=False, engine="host", transport="socket")
+    try:
+        assert rt.transport == "pipe"
+        assert rt.listener is None
+    finally:
+        rt.close()
