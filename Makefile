@@ -10,8 +10,8 @@
 PYTHON ?= python
 
 .PHONY: help test test-fast bench bench-smoke trace-smoke multichip-smoke \
-	replica-smoke multihost-smoke hetero-smoke native lint verify-static \
-	install serve dryrun
+	replica-smoke multihost-smoke hetero-smoke fuzz-smoke fuzz-soak \
+	native lint verify-static install serve dryrun
 
 help:
 	@echo "kueue-tpu developer targets:"
@@ -41,6 +41,15 @@ help:
 	@echo "                      SIGSTOP-watchdog drills, packet-delay"
 	@echo "                      injection, elastic scaling, and the"
 	@echo "                      multihost bench config's evidence gates"
+	@echo "  make fuzz-smoke     kueuefuzz CI budget: unit/corpus tests"
+	@echo "                      (incl. the oracle-mutation self-test +"
+	@echo "                      shrinker), then >= 25 seeded scenarios"
+	@echo "                      replayed across the engine x shards x"
+	@echo "                      replicas x kill-switch lattice with"
+	@echo "                      zero oracle violations"
+	@echo "  make fuzz-soak      hours-scale churn soak watching RSS /"
+	@echo "                      arena occupancy / cache-hit / dispatch"
+	@echo "                      drift (KUEUE_FUZZ_SOAK_SECONDS)"
 	@echo "  make native         build the C++ runtime pieces"
 	@echo "  make serve          run the API server"
 	@echo "  make dryrun         compile-check the flagship jit path"
@@ -73,6 +82,9 @@ bench-smoke:
 	  by = {l['metric']: l for l in lines}; \
 	  missing = set(METRIC_NAMES.values()) - set(by); \
 	  assert not missing, f'configs missing from BENCH output: {missing}'; \
+	  noenv = [m for m, l in by.items() \
+	           if not (l.get('environment') or {}).get('cpu_count')]; \
+	  assert not noenv, f'BENCH records missing environment block: {noenv}'; \
 	  steady = METRIC_NAMES['steady']; \
 	  replica = METRIC_NAMES['replica']; \
 	  multihost = METRIC_NAMES['multihost']; \
@@ -290,6 +302,45 @@ multihost-smoke:
 	  print('multihost-smoke OK: rtt_p99_ms', rtt.get('p99'), \
 	        'epoch', rep.get('reconcile_epoch'), 'elastic', \
 	        el.get('actions'), 'gain', el.get('loan_throughput_gain'))"
+
+# kueuefuzz CI budget (the acceptance gate): the unit + corpus + soak
+# tests first — including the oracle-mutation self-test, which proves the
+# fuzzer CATCHES an env-gated revert of the name-sorted Cohort member
+# walk within a bounded seed budget and shrinks the divergence to a
+# reproducer <= 3 CQs / <= 10 workloads (the checked-in corpus under
+# tests/fixtures/fuzz/ replays green, and each entry goes RED under its
+# bug's mutation drill) — then the seeded campaign: >= 25 scenarios,
+# each replayed across the (engine x shards {1,2} x replicas {1,2} x
+# kill-switch set) lattice plus the fail-over (journal replay) and
+# capacity-loan drill points, with ZERO oracle violations.
+fuzz-smoke:
+	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_fuzz.py \
+	  tests/test_fuzz_corpus.py tests/test_fuzz_soak.py -q -m "not slow"
+	JAX_PLATFORMS=cpu $(PYTHON) -m kueue_tpu.fuzz --seeds 25 \
+	  --out /tmp/kueue-fuzz-smoke.json
+	$(PYTHON) -c "import json; \
+	  rep = json.load(open('/tmp/kueue-fuzz-smoke.json')); \
+	  assert rep['scenarios'] >= 25, rep['scenarios']; \
+	  assert rep['violations'] == [], rep['violations'][:3]; \
+	  ax = rep['lattice_axes']; \
+	  assert {1, 2} <= set(ax['shards']), ax; \
+	  assert {1, 2} <= set(ax['replicas']), ax; \
+	  assert True in ax['kill_switches'], ax; \
+	  assert 'referee' in ax['engines'] and 'jax' in ax['engines'], ax; \
+	  assert {'failover', 'loan'} <= set(ax['drills']), ax; \
+	  assert rep['environment'].get('cpu_count'), rep['environment']; \
+	  print('fuzz-smoke OK:', rep['scenarios'], 'scenarios, axes', ax)"
+
+# Hours-scale churn soak (default 2h; KUEUE_FUZZ_SOAK_SECONDS overrides):
+# RSS / arena-occupancy / nominate-cache-hit / dispatch-rate curves must
+# show no monotonic drift between the early and late halves of the run.
+# The 120s pytest twin is registered behind the `slow` marker
+# (tests/test_fuzz_soak.py); seconds-scale drift-detector units ride
+# tier-1.
+fuzz-soak:
+	JAX_PLATFORMS=cpu $(PYTHON) -m kueue_tpu.fuzz \
+	  --soak $${KUEUE_FUZZ_SOAK_SECONDS:-7200} \
+	  --out /tmp/kueue-fuzz-soak.json
 
 # Build the C++ runtime pieces (keyed heap, admission decoder) explicitly;
 # they are also built lazily on first import.

@@ -134,32 +134,27 @@ def run_config(*, label, num_cqs, num_cohorts, num_flavors, backlog, ticks,
     submit_seq = [0]
 
     def submit_replacement():
-        """A fresh arrival with the generator's distribution; in the
-        preemption config arrivals alternate low/high priority so the
-        preemption flux sustains (victims to preempt keep existing)."""
+        """A fresh arrival with the generator's distribution (one shared
+        draw — utils/synthetic.churn_arrival_draw — with the replica
+        churn loop and the fuzz generator); in the preemption config
+        arrivals alternate low/high priority so the preemption flux
+        sustains (victims to preempt keep existing)."""
+        from kueue_tpu.utils.synthetic import churn_arrival_draw
+
         submit_seq[0] += 1
         i = submit_seq[0]
-        c = rnd.randrange(num_cqs)
-        if preemption_heavy:
-            priority = rnd.randint(1, 5) if i % 2 else rnd.randint(-2, 0)
-        else:
-            priority = rnd.randint(-2, 2)
-        topo_kw = {}
-        if topology:
-            topo_kw = ({"topology_required": "rack"} if i % 4 == 0
-                       else {"topology_preferred": "rack"})
-        tputs = None
-        if hetero_cluster:
-            from kueue_tpu.utils.synthetic import hetero_profile_draw
-            tputs = hetero_profile_draw(rnd, num_flavors)
+        spec = churn_arrival_draw(
+            rnd, num_cqs, num_flavors, preemption_heavy=preemption_heavy,
+            topology=topology, hetero=hetero_cluster, seq=i)
         fw.submit(Workload(
             name=f"churn-{label}-{i}", namespace="default",
-            queue_name=f"lq-{c}", priority=priority,
+            queue_name=f"lq-{spec['queue_index']}",
+            priority=spec["priority"],
             creation_time=float(100_000 + i),
             pod_sets=[PodSet.make(
-                "ps0", count=rnd.randint(1, 8), cpu=rnd.randint(1, 8),
-                memory=f"{rnd.randint(1, 16)}Gi",
-                flavor_throughputs=tputs, **topo_kw)]))
+                "ps0", count=spec["count"], cpu=spec["cpu"],
+                memory=f"{spec['memory_gi']}Gi",
+                flavor_throughputs=spec["tputs"], **spec["topo_kw"])]))
 
     def churn():
         """Completion flux: finish workloads whose linger expired, then
@@ -432,8 +427,16 @@ def run_config(*, label, num_cqs, num_cohorts, num_flavors, backlog, ticks,
     inject_ms = float(os.environ.get("KUEUE_BENCH_INJECT_MS", "0") or 0)
     if inject_ms:
         backend = f"{backend}+inject{inject_ms:g}ms"
+    from kueue_tpu.utils.envinfo import environment_block
+
     stats = {
         "backend": backend,
+        # Machine-checkable home of the "bench boxes drift run to run —
+        # compare within-run only" caveat: cpu count, load average at
+        # measurement end, python/jax versions, container hint. Readers
+        # comparing two BENCH artifacts can now verify the box shape
+        # instead of trusting the prose note.
+        "environment": environment_block(),
         "ticks": ticks,
         "p50_ms": round(p50, 3),
         "p99_ms": round(p99, 3),
@@ -1099,18 +1102,21 @@ def run_replica_config(*, label, replicas, num_cqs, num_cohorts,
             if not done:
                 return
             rt.finish_many(done)
+            from kueue_tpu.utils.synthetic import churn_arrival_draw
+
             specs = []
             for _ in done:
                 submit_seq[0] += 1
                 i = submit_seq[0]
+                d = churn_arrival_draw(rnd, num_cqs, num_flavors, seq=i)
                 specs.append({
                     "name": f"churn-{label}-{i}",
-                    "queue": f"lq-{rnd.randrange(num_cqs)}",
-                    "priority": rnd.randint(-2, 2),
+                    "queue": f"lq-{d['queue_index']}",
+                    "priority": d["priority"],
                     "creation_time": float(100_000 + i),
-                    "count": rnd.randint(1, 8),
-                    "cpu": rnd.randint(1, 8),
-                    "memory_gi": rnd.randint(1, 16),
+                    "count": d["count"],
+                    "cpu": d["cpu"],
+                    "memory_gi": d["memory_gi"],
                 })
             rt.submit_many(specs)
 
@@ -1149,8 +1155,13 @@ def run_replica_config(*, label, replicas, num_cqs, num_cohorts,
         times_ms = np.array(times) * 1000.0
         p50 = float(np.percentile(times_ms, 50))
         p99 = float(np.percentile(times_ms, 99))
+        from kueue_tpu.utils.envinfo import environment_block
+
         out = {
             "ticks": ticks,
+            # Same machine-evidence block as run_config: EVERY BENCH
+            # record carries it (the within-run-only caveat, checkable).
+            "environment": environment_block(),
             "n_replicas": replicas,
             "transport": ("socket" if transport == "socket"
                           else "spawn" if spawn else "loopback"),

@@ -128,11 +128,23 @@ class Cohort:
 
     def sorted_members(self) -> List["CachedClusterQueue"]:
         """`members` in NAME order (see tree_cluster_queues for why the
-        walk must be deterministic), memoized until membership changes."""
+        walk must be deterministic), memoized until membership changes.
+
+        KUEUE_TPU_FUZZ_MUTATION=unsorted-members reverts to the raw
+        identity-hashed set iteration (the PR 8 victim-flip bug shape) —
+        an oracle-mutation drill for the fuzz harness: tests/test_fuzz
+        proves the decision-identity fuzzer CATCHES this bug class
+        within a bounded seed budget. Inert unless the env gate is set;
+        never set it in production."""
         sm = self._sorted_members
         if sm is None:
-            sm = self._sorted_members = sorted(
-                self.members, key=lambda c: c.name)
+            import os
+            if os.environ.get("KUEUE_TPU_FUZZ_MUTATION") == \
+                    "unsorted-members":
+                sm = self._sorted_members = list(self.members)
+            else:
+                sm = self._sorted_members = sorted(
+                    self.members, key=lambda c: c.name)
         return sm
 
     @property

@@ -1,0 +1,31 @@
+"""kueuefuzz: randomized scenario corpus + decision-identity fuzzer.
+
+The repo's strongest asset is its oracle density — every scheduling path
+has a sequential referee twin, a kill switch, and churn goldens. This
+package weaponizes those oracles into a randomized fuzzer over
+policy/topology/traffic space (ROADMAP item 5, in the spirit of the
+Mesos fair-allocation study's multi-framework workload mixes):
+
+- `generator`  draws seeded scenarios: cluster topologies (flavor speed
+  ladders, TopologySpecs, KEP-79 cohort trees with lending limits),
+  policy mixes (queueing strategy x fair sharing x hetero x preemption
+  x PodsReady) and traffic shapes (diurnal, heavy-tailed, adversarial
+  churn, multi-framework mixes).
+- `lattice`    replays each scenario across configuration points —
+  sequential referee, batched engines, shards {1,2}, replicas {1,2},
+  a kill-switch set, plus fail-over (journal replay) and capacity-loan
+  drill points — with decision identity, repeat determinism,
+  quota-never-oversubscribed and journal-replay equivalence as oracles.
+- `shrink`     minimizes a diverging scenario (drop workloads/CQs/ticks,
+  simplify policies, re-check divergence each step) and emits a
+  self-contained reproducer that checks in under tests/fixtures/fuzz/.
+- `corpus`     loads + replays those reproducer files (the seed corpus
+  meta-test: every checked-in entry must replay green).
+- `soak`       hours-scale churn run watching RSS / arena occupancy /
+  nominate-cache hit ratio / dispatch counts for monotonic drift.
+
+Entry point: `python -m kueue_tpu.fuzz` (see __main__.py; `make
+fuzz-smoke` runs the CI budget).
+"""
+
+from kueue_tpu.fuzz.scenario import Scenario  # noqa: F401

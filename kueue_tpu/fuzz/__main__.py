@@ -1,0 +1,149 @@
+"""CLI: `python -m kueue_tpu.fuzz` — campaign, corpus replay, soak.
+
+Default mode runs a seeded campaign: N scenarios, each replayed across
+the full lattice (see lattice.default_lattice), writing a JSON report
+with per-seed oracle results, the lattice axes covered, and the machine
+environment block. Any violation shrinks to a reproducer file next to
+the report and exits non-zero — `make fuzz-smoke` runs the CI budget.
+
+  python -m kueue_tpu.fuzz --seeds 25 --out /tmp/fuzz.json
+  python -m kueue_tpu.fuzz --corpus tests/fixtures/fuzz
+  python -m kueue_tpu.fuzz --soak 7200 --out /tmp/soak.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def _pin_cpu_backend() -> None:
+    """CPU backend + >= 2 virtual host devices BEFORE jax initializes,
+    so the shards lattice axis runs everywhere (same trick as
+    tests/conftest.py and the multichip dryrun)."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    xf = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in xf:
+        os.environ["XLA_FLAGS"] = (
+            xf + " --xla_force_host_platform_device_count=2").strip()
+
+
+def run_campaign(seeds: int, start_seed: int, out: str,
+                 shrink_on_failure: bool = True) -> int:
+    from kueue_tpu.fuzz import generator, lattice, shrink
+    from kueue_tpu.utils.envinfo import environment_block
+
+    reports = []
+    all_violations = []
+    axes_seen = {"engines": set(), "shards": set(), "replicas": set(),
+                 "kill_switches": set(), "drills": set()}
+    for seed in range(start_seed, start_seed + seeds):
+        sc = generator.draw_scenario(seed)
+        report = lattice.check_scenario(sc)
+        for ax in report["axes"]:
+            axes_seen["engines"].add(ax["engine"])
+            axes_seen["shards"].add(ax["shards"])
+            axes_seen["replicas"].add(ax["replicas"])
+            axes_seen["kill_switches"].add(ax["kill_switches"])
+            if ax["drill"]:
+                axes_seen["drills"].add(ax["drill"])
+        reports.append(report)
+        status = "ok" if not report["violations"] else "DIVERGED"
+        print(f"# seed {seed}: {status} "
+              f"({len(report['points'])} lattice points, "
+              f"shape {sc.policy.get('shape')})", file=sys.stderr)
+        for vi in report["violations"]:
+            all_violations.append({"seed": seed, **vi})
+            print(f"#   violation: {vi}", file=sys.stderr)
+        if report["violations"] and shrink_on_failure:
+            def still_fails(cand):
+                return bool(lattice.check_scenario(cand)["violations"])
+
+            small, attempts = shrink.shrink(sc, still_fails)
+            repro_path = (os.path.splitext(out)[0]
+                          + f"_repro_seed{seed}.json")
+            shrink.write_reproducer(
+                repro_path, small,
+                name=f"fuzz-seed-{seed}",
+                description="shrunk from a live campaign divergence",
+                found={"seed": seed,
+                       "violations": report["violations"][:4],
+                       "shrink_attempts": attempts})
+            print(f"#   reproducer written: {repro_path} "
+                  f"(size {small.size()})", file=sys.stderr)
+
+    doc = {
+        "scenarios": seeds,
+        "start_seed": start_seed,
+        "violations": all_violations,
+        "lattice_axes": {k: sorted(v, key=str)
+                         for k, v in axes_seen.items()},
+        "environment": environment_block(),
+        "reports": reports,
+    }
+    with open(out, "w", encoding="utf-8") as f:
+        json.dump(doc, f, indent=1)
+    print(json.dumps({
+        "metric": "fuzz_campaign", "scenarios": seeds,
+        "violations": len(all_violations),
+        "lattice_axes": doc["lattice_axes"]}), flush=True)
+    return 1 if all_violations else 0
+
+
+def run_corpus(dirpath: str) -> int:
+    from kueue_tpu.fuzz import corpus
+
+    entries = corpus.load_corpus(dirpath)
+    if not entries:
+        print(f"# no corpus entries under {dirpath}", file=sys.stderr)
+        return 1
+    bad = 0
+    for entry in entries:
+        violations = corpus.replay_entry(entry)
+        status = "ok" if not violations else "RED"
+        print(f"# corpus {entry['name']}: {status}", file=sys.stderr)
+        for vi in violations:
+            bad += 1
+            print(f"#   {vi}", file=sys.stderr)
+    return 1 if bad else 0
+
+
+def main(argv=None) -> int:
+    _pin_cpu_backend()
+    ap = argparse.ArgumentParser(
+        prog="python -m kueue_tpu.fuzz",
+        description="kueuefuzz: scenario corpus + decision-identity "
+                    "fuzzer")
+    ap.add_argument("--seeds", type=int, default=25)
+    ap.add_argument("--start-seed", type=int, default=0)
+    ap.add_argument("--out", default="/tmp/kueue-fuzz-report.json")
+    ap.add_argument("--no-shrink", action="store_true",
+                    help="report divergences without shrinking them")
+    ap.add_argument("--corpus", metavar="DIR",
+                    help="replay the reproducer corpus instead of "
+                         "fuzzing")
+    ap.add_argument("--soak", type=float, metavar="SECONDS",
+                    help="run the long-run churn soak instead of "
+                         "fuzzing")
+    args = ap.parse_args(argv)
+    if args.corpus:
+        return run_corpus(args.corpus)
+    if args.soak is not None:
+        from kueue_tpu.fuzz.soak import run_soak
+
+        report = run_soak(args.soak, report_path=args.out)
+        print(json.dumps({
+            "metric": "fuzz_soak", "ok": report["ok"],
+            "ticks": report["ticks"],
+            "verdict": {k: v["ok"]
+                        for k, v in report["verdict"].items()}}),
+            flush=True)
+        return 0 if report["ok"] else 1
+    return run_campaign(args.seeds, args.start_seed, args.out,
+                        shrink_on_failure=not args.no_shrink)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

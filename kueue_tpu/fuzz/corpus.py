@@ -1,0 +1,107 @@
+"""Seed corpus: checked-in reproducer files replayed as goldens.
+
+Every file under tests/fixtures/fuzz/ is a self-contained scenario
+(shrunk from a real fuzz divergence, or hand-minimized from a known
+churn-found bug) plus its replay contract:
+
+- `lattice`: which lattice points to drive (names resolved against
+  lattice.default_lattice, or the full default lattice when null);
+- `expect`: behavioral assertions beyond the standard oracles —
+  `admitted_final_contains` (workload keys that must hold quota at the
+  end, the PR 9 quota-raise-requeue shape) and `min_preempted`
+  (the drive must actually exercise preemption, so a reproducer can't
+  silently decay into a no-op).
+
+The corpus meta-test (tests/test_fuzz_corpus.py) replays every entry
+green on the fixed build; the oracle-mutation drills prove each entry
+goes RED under the env-gated revert of the bug it was minimized from.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import List, Optional
+
+from kueue_tpu.fuzz import lattice as lat
+from kueue_tpu.fuzz.scenario import Scenario
+from kueue_tpu.fuzz.shrink import REPRO_FORMAT
+
+CORPUS_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))),
+    "tests", "fixtures", "fuzz")
+
+
+def load_entry(path: str) -> dict:
+    with open(path, "r", encoding="utf-8") as f:
+        doc = json.load(f)
+    if doc.get("format") != REPRO_FORMAT:
+        raise ValueError(
+            f"{path}: not a kueuefuzz reproducer "
+            f"(format={doc.get('format')!r})")
+    doc["scenario_obj"] = Scenario.from_dict(doc["scenario"])
+    doc["path"] = path
+    return doc
+
+
+def load_corpus(dirpath: Optional[str] = None) -> List[dict]:
+    dirpath = dirpath or CORPUS_DIR
+    entries = []
+    if not os.path.isdir(dirpath):
+        return entries
+    for fn in sorted(os.listdir(dirpath)):
+        if fn.endswith(".json"):
+            entries.append(load_entry(os.path.join(dirpath, fn)))
+    return entries
+
+
+def _resolve_points(entry: dict, sc: Scenario) -> list:
+    points = lat.default_lattice(sc)
+    wanted = entry.get("lattice")
+    if not wanted:
+        return points
+    by_name = {p.name: p for p in points}
+    out = []
+    for name in wanted:
+        if name in by_name:
+            out.append(by_name[name])
+    # The reference point always drives (trail comparisons need it).
+    if points and points[0] not in out:
+        out.insert(0, points[0])
+    return out
+
+
+def replay_entry(entry: dict) -> List[dict]:
+    """Replay one corpus entry; returns the violation list (empty =
+    green). Standard lattice oracles run first, then the entry's own
+    `expect` block."""
+    sc: Scenario = entry["scenario_obj"]
+    points = _resolve_points(entry, sc)
+    report = lat.check_scenario(sc, points=points, keep_results=True)
+    violations = list(report["violations"])
+
+    expect = entry.get("expect") or {}
+    ref = report["results"].get(points[0].name) if expect else None
+    if expect and ref is not None:
+        # The reference drive check_scenario already paid — asserting
+        # expect against the SAME drive the trails were compared on.
+        admitted_keys = {key for keys in ref["final_admitted"].values()
+                         for key in keys}
+        for key in expect.get("admitted_final_contains", ()):
+            if key not in admitted_keys:
+                violations.append({
+                    "oracle": "expect", "point": points[0].name,
+                    "detail": f"{key} not admitted at end of replay "
+                              f"(admitted: {sorted(admitted_keys)})"})
+        min_preempted = expect.get("min_preempted")
+        if min_preempted:
+            n = sum(len(pre) for _adm, pre in ref["trail"])
+            if n < min_preempted:
+                violations.append({
+                    "oracle": "expect", "point": points[0].name,
+                    "detail": f"only {n} preemptions in replay "
+                              f"(expected >= {min_preempted}): the "
+                              "reproducer no longer exercises the "
+                              "path it was minimized for"})
+    return violations

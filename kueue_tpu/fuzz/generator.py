@@ -1,0 +1,294 @@
+"""Seeded scenario generator: topology x policy x traffic draws.
+
+Each seed deterministically expands into one Scenario. The draw space
+covers the dimensions the hand-written suites pin individually but never
+cross-product:
+
+- cluster structure: solo ClusterQueues / flat cohorts / KEP-79 trees
+  (root + mid cohorts, optionally carrying their own shareable quota and
+  lending limits);
+- flavors: 1-3, optionally a hetero speed ladder (speed_class 1.0+0.5f
+  with per-workload throughput overrides) or a TopologySpec
+  (rack/host tree with slice-packing requests);
+- policy mix: BestEffortFIFO/StrictFIFO per CQ, preemption combos
+  (within LowerPriority, reclaim Any/LowerOrNewerEqualPriority,
+  borrowWithinCohort), weighted fair sharing, LendingLimit clamps,
+  waitForPodsReady;
+- traffic shapes (à la the Mesos multi-framework study): `diurnal`
+  (sinusoidal arrival rate), `heavy_tailed` (Pareto-ish sizes, rare
+  spikes), `adversarial` (tie-heavy identical workloads + add/update/
+  delete churn bursts + quota resizes), `multiframework` (interleaved
+  per-framework populations with distinct shapes and priorities).
+
+Workload sizes draw from the SAME distribution helpers bench.py's churn
+uses (utils/synthetic.churn_arrival_draw and friends), so the fuzzer and
+the bench exercise one population instead of drifting copies.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import List
+
+from kueue_tpu.fuzz.scenario import Scenario
+from kueue_tpu.utils.synthetic import (
+    churn_arrival_draw,
+    diurnal_rate,
+    heavy_tailed_int,
+    hetero_profile_draw,
+)
+
+TRAFFIC_SHAPES = ("diurnal", "heavy_tailed", "adversarial",
+                  "multiframework")
+
+
+def draw_scenario(seed: int) -> Scenario:
+    rnd = random.Random(0x5EED0000 + seed)
+
+    # Stratified sampling over the lattice axes: every 4th seed draws a
+    # replica-focused profile (inside the documented multi-process
+    # identity envelope — scenario.replica_safe), so the replicas-{1,2}
+    # axis and its fail-over / capacity-loan drill points get steady
+    # coverage instead of depending on the conjunction of independent
+    # policy draws coming up safe.
+    replica_profile = seed % 4 == 3
+
+    # -- flavors / topology -------------------------------------------------
+    hetero = (not replica_profile) and rnd.random() < 0.18
+    topology = (not hetero) and rnd.random() < 0.15
+    num_flavors = rnd.randint(2, 3) if hetero else rnd.randint(1, 2)
+    flavors = [{"name": f"flavor-{f}",
+                "speed_class": (1.0 + 0.5 * f) if hetero else 1.0}
+               for f in range(num_flavors)]
+    topo = None
+    if topology:
+        topo = {"levels": ["rack", "host"], "counts": [2, 2],
+                "leaf_capacity": rnd.choice([4, 8])}
+
+    # -- cohort structure ---------------------------------------------------
+    structure = rnd.choices(["solo", "flat", "tree"],
+                            weights=[0.25, 0.45, 0.30])[0]
+    lending = structure != "solo" and rnd.random() < 0.35
+    cohorts: List[dict] = []
+    if structure == "tree":
+        cohorts.append({"name": "root", "parent": ""})
+        n_mids = rnd.randint(1, 2)
+        for m in range(n_mids):
+            quota = None
+            if rnd.random() < 0.5:
+                # A mid cohort with its own shareable pool — lending
+                # limits clamp what leaves outside it can take.
+                nom = rnd.randint(4, 12)
+                quota = {"flavor-0": {"cpu": [
+                    nom, None, (nom // 2) if lending else None]}}
+            cohorts.append({"name": f"mid-{m}", "parent": "root",
+                            "quota": quota})
+        leaf_names = [f"mid-{m}" for m in range(n_mids)]
+    elif structure == "flat":
+        n_cohorts = rnd.randint(1, 2)
+        leaf_names = [f"cohort-{k}" for k in range(n_cohorts)]
+    else:
+        leaf_names = []
+
+    # -- ClusterQueues + policy mix -----------------------------------------
+    num_cqs = rnd.randint(2, 5)
+    fair = structure != "solo" and rnd.random() < 0.25
+    pods_ready = (not fair) and rnd.random() < 0.10
+    preempt_style = rnd.choices(
+        ["never", "within", "reclaim", "borrow"],
+        weights=[0.35, 0.2, 0.3, 0.15])[0]
+    if replica_profile:
+        fair = False
+        pods_ready = False
+        preempt_style = "never"
+    cqs: List[dict] = []
+    for c in range(num_cqs):
+        chosen = sorted(rnd.sample(range(num_flavors),
+                                   rnd.randint(1, num_flavors)))
+        quotas = {}
+        for fi in chosen:
+            nom_cpu = rnd.randint(4, 16)
+            nom_mem = rnd.randint(8, 32)
+            if lending:
+                quotas[f"flavor-{fi}"] = {
+                    "cpu": [nom_cpu, nom_cpu // 2,
+                            max(1, (3 * nom_cpu) // 4)],
+                    "memory_gi": [nom_mem, nom_mem // 2,
+                                  max(1, (3 * nom_mem) // 4)]}
+            else:
+                quotas[f"flavor-{fi}"] = {"cpu": [nom_cpu, None, None],
+                                          "memory_gi": [nom_mem, None,
+                                                        None]}
+        pre = {"within": "Never", "reclaim": "Never"}
+        if preempt_style == "within":
+            pre = {"within": "LowerPriority", "reclaim": "Never"}
+        elif preempt_style == "reclaim":
+            pre = {"within": "LowerPriority",
+                   "reclaim": rnd.choice(
+                       ["Any", "LowerOrNewerEqualPriority"])}
+        elif preempt_style == "borrow":
+            pre = {"within": "LowerPriority", "reclaim": "Any",
+                   "borrow": {"policy": "LowerPriority",
+                              "threshold": 0}}
+        cqs.append({
+            "name": f"cq-{c}",
+            "cohort": rnd.choice(leaf_names) if leaf_names else "",
+            "strategy": rnd.choices(["BestEffortFIFO", "StrictFIFO"],
+                                    weights=[0.7, 0.3])[0],
+            "quotas": quotas,
+            "preemption": pre,
+            "fair_weight": float(rnd.randint(1, 4)) if fair else None,
+        })
+
+    # -- traffic ------------------------------------------------------------
+    shape = rnd.choice(TRAFFIC_SHAPES)
+    ticks = rnd.randint(10, 24)
+    seq = [0]
+
+    # Adversarial tie storm: the population the PR 8 bug class hides in.
+    # Equal-weight fair sharing + reclaimWithinCohort, every cohort
+    # member holding an EQUAL borrower (same size, priority and creation
+    # time), then high-priority reclaimers — the fair victim search must
+    # pick among equal-share member queues, where only the deterministic
+    # name-sorted member walk keeps the choice stable run to run.
+    tie_storm = (shape == "adversarial" and not replica_profile
+                 and structure != "solo")
+    tie_cpu = 0
+    if tie_storm:
+        fair = True
+        pods_ready = False
+        # A REAL tie needs equal shares: ONE flavor, identical quotas,
+        # one cohort, equal weights — only then does the fair victim
+        # search have to break the tie by member-walk order.
+        tie_flavor = sorted(cqs[0]["quotas"])[0]
+        tie_cpu = max(cqs[0]["quotas"][tie_flavor]["cpu"][0], 5)
+        for cq in cqs:
+            cq["fair_weight"] = 1.0
+            cq["preemption"] = {"within": "LowerPriority",
+                                "reclaim": "Any"}
+            cq["quotas"] = {tie_flavor: {
+                "cpu": [tie_cpu, None, None],
+                "memory_gi": [32, None, None]}}
+            cq["cohort"] = leaf_names[0]
+        while len(cqs) < 6:
+            # Wide member sets: the bug class is identity-hash SET
+            # iteration, and a 2-3 element set often lands in the same
+            # bucket order across drives — 5+ equal members make the
+            # walk order genuinely layout-sensitive.
+            cqs.append({**cqs[0],
+                        "name": f"cq-{len(cqs)}",
+                        "quotas": {tie_flavor: {
+                            "cpu": [tie_cpu, None, None],
+                            "memory_gi": [32, None, None]}}})
+
+    def wl_spec(*, framework: int = 0, tie: bool = False) -> dict:
+        seq[0] += 1
+        i = seq[0]
+        # hetero=False: the throughput profile is drawn once, below —
+        # a second draw inside churn_arrival_draw would be dead RNG.
+        d = churn_arrival_draw(rnd, num_cqs, num_flavors)
+        if tie:
+            # Adversarial tie shape: identical size, priority and
+            # near-identical names — the population where victim/order
+            # bugs (PR 8's identity-hash flip) hide.
+            d["priority"], d["count"], d["cpu"], d["memory_gi"] = \
+                0, 1, 2, 2
+        if shape == "heavy_tailed":
+            d["cpu"] = heavy_tailed_int(rnd, 1, 12)
+            d["count"] = heavy_tailed_int(rnd, 1, 4)
+        if shape == "multiframework":
+            # Per-framework populations: batch (big, low prio), service
+            # (small, high prio), interactive (tiny, mid prio bursts).
+            fw_shape = [(4, 8, -1), (1, 2, 2), (1, 1, 1)][framework % 3]
+            d["count"], d["cpu"], d["priority"] = fw_shape
+        topo_kw = None
+        if topology and rnd.random() < 0.5:
+            topo_kw = ["required" if i % 4 == 0 else "preferred", "rack"]
+        return {
+            "name": f"wl-{i}",
+            "queue": f"lq-cq-{d['queue_index']}",
+            "priority": d["priority"],
+            "creation_time": float(1000 + i),
+            "pod_sets": [{"name": "ps0", "count": d["count"],
+                          "cpu": d["cpu"],
+                          "memory_gi": d["memory_gi"],
+                          "topo": topo_kw}],
+            "tputs": (hetero_profile_draw(rnd, num_flavors)
+                      if hetero else None),
+        }
+
+    workloads = [wl_spec(framework=k, tie=(shape == "adversarial"
+                                           and rnd.random() < 0.5))
+                 for k in range(rnd.randint(3, 8))]
+    if tie_storm:
+        # One equal borrower per ClusterQueue (cpu = own first-flavor
+        # nominal + 2, so any admitted one is BORROWING and thus a
+        # reclaim candidate), all at the same priority and creation
+        # time; then early-tick high-priority reclaimers.
+        # cqs[0] stays borrower-free: a preemptor whose OWN queue holds
+        # candidates resolves there first, and the member-order tie the
+        # storm exists to exercise is between OTHER equal-share
+        # members. Borrower size soaks the whole pool exactly
+        # (n_cqs * nominal split over n_cqs - 1 borrowers, each above
+        # nominal so every admitted one is BORROWING), leaving less
+        # free capacity than one reclaimer needs.
+        borrow_cpu = (len(cqs) * tie_cpu) // (len(cqs) - 1)
+        borrowers = []
+        for cq in cqs[1:]:
+            seq[0] += 1
+            borrowers.append({
+                "name": f"tie-borrow-{cq['name']}",
+                "queue": f"lq-{cq['name']}",
+                "priority": 0, "creation_time": 999.0,
+                "pod_sets": [{"name": "ps0", "count": 1,
+                              "cpu": borrow_cpu, "memory_gi": 2,
+                              "topo": None}],
+                "tputs": None})
+        workloads = borrowers + workloads
+
+    traffic: List[list] = []
+    for t in range(ticks):
+        ops: List[list] = []
+        if shape == "diurnal":
+            n_arrivals = int(diurnal_rate(t, period=max(ticks // 2, 4),
+                                          lo=0.0, hi=3.0) + rnd.random())
+        elif shape == "adversarial":
+            n_arrivals = rnd.choice([0, 0, 1, 4])
+        else:
+            n_arrivals = rnd.randint(0, 2)
+        for k in range(n_arrivals):
+            ops.append(["submit", wl_spec(
+                framework=t + k,
+                tie=(shape == "adversarial" and rnd.random() < 0.6))])
+        if tie_storm and 1 <= t <= max(len(cqs) - 1, 1):
+            # The reclaimer wave: high-priority sub-nominal arrivals
+            # into the borrower-free cqs[0], each forcing a fair victim
+            # choice among the OTHER members' equal-share borrowers.
+            cq = cqs[0]
+            seq[0] += 1
+            ops.append(["submit", {
+                "name": f"tie-reclaim-{seq[0]}",
+                "queue": f"lq-{cq['name']}",
+                "priority": 5, "creation_time": float(2000 + t),
+                "pod_sets": [{"name": "ps0", "count": 1,
+                              "cpu": tie_cpu,
+                              "memory_gi": 1, "topo": None}],
+                "tputs": None}])
+        if rnd.random() < 0.35:
+            ops.append(["finish", rnd.randint(1, 3)])
+        if rnd.random() < 0.15:
+            ops.append(["delete", f"wl-{rnd.randint(1, max(seq[0], 1))}"])
+        if shape == "adversarial" and rnd.random() < 0.15:
+            ops.append(["update_cq", f"cq-{rnd.randrange(num_cqs)}",
+                        rnd.choice([0.5, 2.0, 4.0])])
+        if pods_ready and rnd.random() < 0.5:
+            ops.append(["ready", rnd.randint(1, 4)])
+        traffic.append(ops)
+
+    return Scenario(
+        seed=seed, ticks=ticks, settle_ticks=4,
+        flavors=flavors, topology=topo, cohorts=cohorts,
+        cluster_queues=cqs,
+        policy={"fair": fair, "lending": lending, "hetero": hetero,
+                "pods_ready": pods_ready, "shape": shape},
+        workloads=workloads, traffic=traffic)

@@ -1,0 +1,604 @@
+"""Lattice driver: replay one scenario across configuration points.
+
+Every scenario is driven through a lattice of configurations of the SAME
+scheduler — the sequential referee, the batched device solve under
+different victim-search engines, cohort shards {1,2}, multi-process
+replicas {1,2}, the incremental-fast-path kill-switch set, and (on a
+rotating subset of seeds) a replica fail-over drill (journal replay) and
+an elastic capacity-loan drill. The repo's standing decision-identity
+contracts become fuzz oracles:
+
+  identity      every lattice point's decision trail equals the
+                reference point's (per-tick admitted+preempted for
+                in-process points; per-tick admitted + final admitted
+                set for replica points)
+  determinism   the reference point driven TWICE produces the identical
+                trail (the oracle that catches PR 8's identity-hash
+                victim flip class — run-to-run nondeterminism)
+  quota         no cohort tree's total usage ever exceeds its total
+                nominal capacity, and no solo CQ exceeds its own
+                (checked after every tick)
+  journal       the fail-over drill point kills a replica mid-run; the
+                survivor adopts its shard groups by REPLAYING their
+                journals, and the final admitted set must still equal
+                the reference — journal-replay equivalence
+  loan          the elastic drill migrates a live shard group between
+                workers mid-run; decisions must be unchanged
+
+Traffic ops apply through deterministic selectors (see scenario.py), so
+all points replay identical traffic while their decisions agree.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import os
+import tempfile
+from typing import Dict, List, Optional
+
+from kueue_tpu.fuzz import scenario as sc_mod
+from kueue_tpu.fuzz.scenario import Scenario
+
+
+@dataclasses.dataclass(frozen=True)
+class LatticePoint:
+    name: str
+    kind: str                      # "referee" | "framework" | "replica"
+    engine: Optional[str] = None   # preemption_engine knob
+    shards: int = 1
+    replicas: int = 1
+    kill_switches: bool = False    # incremental fast paths OFF
+    drill: Optional[str] = None    # None | "failover" | "loan"
+    env: tuple = ()                # extra (key, value) env pairs
+
+    def axes(self) -> dict:
+        return {"engine": self.engine or ("referee" if
+                                          self.kind == "referee"
+                                          else "host"),
+                "shards": self.shards, "replicas": self.replicas,
+                "kill_switches": self.kill_switches, "drill": self.drill}
+
+
+class TickClock:
+    """Deterministic scheduler clock: frozen within a tick, advanced by
+    the driver between ticks. Wall-clock condition timestamps
+    (QuotaReserved/Evicted transition times feed candidate ordering)
+    differ between two drives of the same scenario and would fake — or
+    mask — a decision divergence (the fair-golden lesson)."""
+
+    def __init__(self):
+        self.now = 1_000_000.0
+
+    def __call__(self) -> float:
+        return self.now
+
+    def advance(self, dt: float = 1.0) -> None:
+        self.now += dt
+
+
+def _shards_available(n: int) -> bool:
+    try:
+        import jax
+
+        return len(jax.devices()) >= n
+    except Exception:
+        return False
+
+
+def default_lattice(sc: Scenario) -> List[LatticePoint]:
+    """The smoke lattice for one scenario: engine x shards {1,2} x
+    replicas {1,2} x one kill-switch set, plus drill points on a
+    rotating third of the seeds. Hetero scenarios swap the sequential
+    referee for a KUEUE_TPU_DEBUG_HETERO reference (the hetero referee
+    asserts device-vs-sequential identity INSIDE every tick); scenarios
+    outside the documented replica-identity envelope skip the replica
+    points (scenario.replica_safe)."""
+    points: List[LatticePoint] = []
+    if sc.policy.get("hetero"):
+        points.append(LatticePoint(
+            name="hetero-referee", kind="framework", engine="host",
+            env=(("KUEUE_TPU_DEBUG_HETERO", "1"),)))
+        points.append(LatticePoint(
+            name="hetero-referee-repeat", kind="framework",
+            engine="host",
+            env=(("KUEUE_TPU_DEBUG_HETERO", "1"),)))
+    else:
+        points.append(LatticePoint(name="referee", kind="referee"))
+        points.append(LatticePoint(name="referee-repeat",
+                                   kind="referee"))
+        points.append(LatticePoint(name="batched-host",
+                                   kind="framework", engine="host"))
+    points.append(LatticePoint(name="batched-jax", kind="framework",
+                               engine="jax"))
+    if _shards_available(2):
+        points.append(LatticePoint(name="shards-2", kind="framework",
+                                   engine="jax", shards=2))
+    points.append(LatticePoint(name="kill-switches", kind="framework",
+                               engine="jax", kill_switches=True,
+                               env=(("KUEUE_TPU_NO_QUIET_TICK", "1"),)))
+    if sc.replica_safe():
+        points.append(LatticePoint(name="replicas-2", kind="replica",
+                                   replicas=2))
+        if sc.seed % 3 == 0:
+            points.append(LatticePoint(name="failover-journal",
+                                       kind="replica", replicas=2,
+                                       drill="failover"))
+        if sc.seed % 3 == 1:
+            points.append(LatticePoint(name="elastic-loan",
+                                       kind="replica", replicas=2,
+                                       drill="loan"))
+    return points
+
+
+@contextlib.contextmanager
+def _env_ctx(pairs):
+    old = {}
+    try:
+        for k, v in pairs:
+            old[k] = os.environ.get(k)
+            os.environ[k] = v
+        yield
+    finally:
+        for k, v in old.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+def _set_gates(sc: Scenario) -> None:
+    from kueue_tpu import features
+
+    features.set_enabled(features.FAIR_SHARING,
+                         bool(sc.policy.get("fair")))
+    features.set_enabled(features.LENDING_LIMIT,
+                         bool(sc.policy.get("lending")))
+
+
+def _merge_caps(hw: dict, caps: dict) -> dict:
+    """Elementwise max of two capacity maps: the quota oracle bounds
+    usage by the HIGH-WATER capacity, because a quota SHRINK (an
+    update_cq with factor < 1) legitimately leaves already-committed
+    usage above the new nominal — the reference never evicts on spec
+    shrink; only NEW admissions see the reduced quota."""
+    for root, by_flavor in caps.items():
+        dst = hw.setdefault(root, {})
+        for fname, res in by_flavor.items():
+            d = dst.setdefault(fname, {})
+            for rname, val in res.items():
+                d[rname] = max(d.get(rname, 0), val)
+    return hw
+
+
+def _check_oversub(sc: Scenario, usage_by_cq: Dict[str, dict],
+                   caps: dict, tick: int) -> List[dict]:
+    """The quota oracle: per cohort-tree root (and per solo CQ), total
+    usage must never exceed the total (high-water) nominal capacity —
+    borrowing moves quota between members, it never mints any."""
+    used: Dict[str, dict] = {}
+    for cq in sc.cluster_queues:
+        root = sc_mod.cq_root(sc, cq["name"])
+        u = usage_by_cq.get(cq["name"]) or {}
+        dst = used.setdefault(root, {})
+        for fname, res in u.items():
+            d = dst.setdefault(fname, {})
+            for rname, val in res.items():
+                d[rname] = d.get(rname, 0) + val
+    out = []
+    for root, by_flavor in used.items():
+        for fname, res in by_flavor.items():
+            for rname, val in res.items():
+                cap = caps.get(root, {}).get(fname, {}).get(rname, 0)
+                if val > cap:
+                    out.append({
+                        "oracle": "quota", "tick": tick,
+                        "detail": f"root {root} {fname}/{rname}: "
+                                  f"usage {val} > capacity {cap}"})
+    return out
+
+
+class _TrafficState:
+    """Driver-side bookkeeping shared by the Framework and replica
+    drives: which workloads are pending/admitted, in which deterministic
+    order, so op selectors resolve identically everywhere."""
+
+    def __init__(self):
+        self.submitted: Dict[str, dict] = {}    # key -> wl spec
+        self.pending: set = set()
+        self.admit_order: List[tuple] = []      # (tick, key, cq)
+        self.admitted: Dict[str, str] = {}      # key -> cq
+        self.ready_marked: set = set()
+        self.factors: Dict[str, float] = {}
+
+    def note_admitted(self, tick: int, pairs) -> None:
+        for key, cq in sorted(pairs):
+            self.admit_order.append((tick, key, cq))
+            self.admitted[key] = cq
+            self.pending.discard(key)
+
+    def note_preempted(self, keys) -> None:
+        for key in keys:
+            if key in self.admitted:
+                del self.admitted[key]
+                self.pending.add(key)
+
+    def oldest_admitted(self, n: int) -> List[tuple]:
+        out = []
+        seen = set()
+        for tick, key, cq in self.admit_order:
+            # A preempted-then-readmitted workload appears twice in
+            # admit_order; dedup so one finish op never double-counts
+            # (or double-finishes) a key.
+            if key in self.admitted and key not in seen:
+                seen.add(key)
+                out.append((key, cq))
+                if len(out) >= n:
+                    break
+        return out
+
+
+def drive(sc: Scenario, point: LatticePoint,
+          state_dir: Optional[str] = None) -> dict:
+    """Replay `sc` at `point`; returns {"trail", "final_admitted",
+    "violations", "evidence"}. Raises nothing scenario-shaped — build
+    or drive crashes propagate to the caller (crashes are findings)."""
+    _set_gates(sc)
+    try:
+        with _env_ctx(point.env):
+            if point.kind == "replica":
+                return _drive_replica(sc, point, state_dir)
+            return _drive_framework(sc, point)
+    finally:
+        from kueue_tpu import features
+
+        features.reset()
+
+
+# -- in-process drives ------------------------------------------------------
+
+
+def _build_framework(sc: Scenario, point: LatticePoint, clock):
+    from kueue_tpu.config import Configuration, TPUSolverConfig, \
+        WaitForPodsReady
+    from kueue_tpu.controllers.runtime import Framework
+
+    wfpr = None
+    if sc.policy.get("pods_ready"):
+        # Huge timeout: the not-ready eviction pass reads wall-deltas
+        # and would otherwise make drives time-dependent.
+        wfpr = WaitForPodsReady(enable=True, timeout_seconds=1e9)
+    if point.kind == "referee":
+        cfg = Configuration(tpu_solver=TPUSolverConfig(enable=False),
+                            wait_for_pods_ready=wfpr)
+        solver = None
+    else:
+        from kueue_tpu.models.flavor_fit import BatchSolver
+
+        cfg = Configuration(
+            tpu_solver=TPUSolverConfig(
+                preemption_engine=point.engine or "host"),
+            wait_for_pods_ready=wfpr)
+        inc = not point.kill_switches
+        solver = BatchSolver(
+            shards=point.shards if point.shards > 1 else None,
+            hetero=True if sc.policy.get("hetero") else None,
+            use_arena=inc, use_admit_arena=inc, use_nominate_cache=inc)
+    fw = Framework(batch_solver=solver, config=cfg, pipeline_depth=1,
+                   clock=clock)
+    fw.create_namespace("default", labels={})
+    for rf in sc_mod.flavor_objects(sc):
+        fw.create_resource_flavor(rf)
+    for spec in sc_mod.cohort_objects(sc):
+        fw.create_cohort(spec)
+    for cq in sc.cluster_queues:
+        fw.create_cluster_queue(sc_mod.cq_object(cq))
+        fw.create_local_queue(sc_mod.lq_object(cq))
+    return fw
+
+
+def _drive_framework(sc: Scenario, point: LatticePoint) -> dict:
+    clock = TickClock()
+    fw = _build_framework(sc, point, clock)
+    st = _TrafficState()
+    cq_specs = {c["name"]: c for c in sc.cluster_queues}
+    caps_hw = sc_mod.nominal_capacity(sc, {})
+
+    tick_admitted: List[str] = []
+    tick_preempted: List[str] = []
+    orig_admit = fw.scheduler.apply_admission
+    orig_preempt = fw.scheduler.apply_preemption
+
+    def apply_admission(wl):
+        ok = orig_admit(wl)
+        if ok:
+            tick_admitted.append(wl.key)
+        return ok
+
+    def apply_preemption(wl, msg):
+        tick_preempted.append(wl.key)
+        return orig_preempt(wl, msg)
+
+    fw.scheduler.apply_admission = apply_admission
+    fw.scheduler.apply_preemption = apply_preemption
+
+    objects: Dict[str, object] = {}
+
+    def submit(spec: dict) -> None:
+        wl = sc_mod.workload_object(spec)
+        objects[wl.key] = wl
+        st.submitted[wl.key] = spec
+        st.pending.add(wl.key)
+        fw.submit(wl)
+
+    def apply_op(op: list) -> None:
+        kind = op[0]
+        if kind == "submit":
+            submit(op[1])
+        elif kind == "finish":
+            for key, _cq in st.oldest_admitted(int(op[1])):
+                wl = objects.get(key)
+                if wl is None or not wl.is_admitted or wl.is_finished:
+                    continue
+                fw.finish(wl)
+                fw.delete_workload(wl)
+                del st.admitted[key]
+                st.ready_marked.discard(key)
+        elif kind == "delete":
+            key = f"default/{op[1]}"
+            wl = objects.get(key)
+            if wl is not None and key in st.pending \
+                    and not wl.is_admitted and not wl.is_finished:
+                fw.delete_workload(wl)
+                st.pending.discard(key)
+        elif kind == "update_cq":
+            name, factor = op[1], float(op[2])
+            st.factors[name] = st.factors.get(name, 1.0) * factor
+            _merge_caps(caps_hw, sc_mod.nominal_capacity(sc, st.factors))
+            fw.update_cluster_queue(
+                sc_mod.cq_object(cq_specs[name], st.factors[name]))
+        elif kind == "ready":
+            n = int(op[1])
+            marked = 0
+            for _tick, key, _cq in st.admit_order:
+                if key in st.admitted and key not in st.ready_marked:
+                    wl = objects.get(key)
+                    if wl is not None and wl.is_admitted:
+                        fw.mark_pods_ready(wl)
+                        st.ready_marked.add(key)
+                        marked += 1
+                        if marked >= n:
+                            break
+        else:
+            raise ValueError(f"unknown traffic op {op!r}")
+
+    for spec in sc.workloads:
+        submit(spec)
+
+    trail = []
+    violations: List[dict] = []
+    for t in range(sc.ticks + sc.settle_ticks):
+        tick_admitted.clear()
+        tick_preempted.clear()
+        if t < sc.ticks:
+            for op in sc.traffic[t] if t < len(sc.traffic) else ():
+                apply_op(op)
+        fw.tick()
+        clock.advance()
+        st.note_admitted(t, [(k, st.submitted[k]["queue"][3:])
+                             for k in tick_admitted])
+        st.note_preempted(tick_preempted)
+        trail.append((tuple(sorted(tick_admitted)),
+                      tuple(sorted(tick_preempted))))
+        usage = {name: {f: dict(r) for f, r in cq.usage.items()}
+                 for name, cq in fw.cache.cluster_queues.items()}
+        violations.extend(_check_oversub(sc, usage, caps_hw, t))
+
+    final = {name: sorted(cq.workloads)
+             for name, cq in fw.cache.cluster_queues.items()}
+    return {"trail": trail, "final_admitted": final,
+            "violations": violations, "evidence": {}}
+
+
+# -- replica drives ---------------------------------------------------------
+
+
+def _drive_replica(sc: Scenario, point: LatticePoint,
+                   state_dir: Optional[str]) -> dict:
+    from kueue_tpu.controllers.replica_runtime import ReplicaRuntime
+    from kueue_tpu.controllers.store import KIND_CLUSTER_QUEUE, MODIFIED
+
+    tmp = None
+    if point.drill == "failover" and state_dir is None:
+        tmp = tempfile.TemporaryDirectory(prefix="kueuefuzz-journal-")
+        state_dir = tmp.name
+    rt = ReplicaRuntime(
+        point.replicas, spawn=False, engine=point.engine,
+        state_dir=state_dir if point.drill == "failover" else None,
+        n_groups=(2 * point.replicas if point.drill == "loan" else None))
+    st = _TrafficState()
+    cq_specs = {c["name"]: c for c in sc.cluster_queues}
+    caps_hw = sc_mod.nominal_capacity(sc, {})
+    trail = []
+    violations: List[dict] = []
+    evidence: dict = {}
+    try:
+        for rf in sc_mod.flavor_objects(sc):
+            rt.create_resource_flavor(rf)
+        for spec in sc_mod.cohort_objects(sc):
+            rt.create_cohort(spec)
+        for cq in sc.cluster_queues:
+            rt.create_cluster_queue(sc_mod.cq_object(cq))
+            rt.create_local_queue(sc_mod.lq_object(cq))
+
+        def submit(spec: dict) -> None:
+            wl = sc_mod.workload_object(spec)
+            st.submitted[wl.key] = spec
+            st.pending.add(wl.key)
+            rt.submit(wl)
+
+        def apply_op(op: list) -> None:
+            kind = op[0]
+            if kind == "submit":
+                submit(op[1])
+            elif kind == "finish":
+                pairs = st.oldest_admitted(int(op[1]))
+                if pairs:
+                    rt.finish_many(pairs)
+                    for key, _cq in pairs:
+                        del st.admitted[key]
+            elif kind == "delete":
+                key = f"default/{op[1]}"
+                if key in st.pending and key not in st.admitted:
+                    rt.delete_workload(key)
+                    st.pending.discard(key)
+            elif kind == "update_cq":
+                name, factor = op[1], float(op[2])
+                st.factors[name] = st.factors.get(name, 1.0) * factor
+                _merge_caps(caps_hw,
+                            sc_mod.nominal_capacity(sc, st.factors))
+                rt.apply_event(
+                    KIND_CLUSTER_QUEUE, MODIFIED,
+                    obj=sc_mod.cq_object(cq_specs[name],
+                                         st.factors[name]))
+            elif kind == "ready":
+                pass  # pods_ready scenarios never take replica points
+            else:
+                raise ValueError(f"unknown traffic op {op!r}")
+
+        for spec in sc.workloads:
+            submit(spec)
+
+        for t in range(sc.ticks + sc.settle_ticks):
+            if t < sc.ticks:
+                for op in sc.traffic[t] if t < len(sc.traffic) else ():
+                    apply_op(op)
+            elif t == sc.ticks and point.drill == "failover":
+                # Journal-replay equivalence: kill one replica at the
+                # settle boundary; the next tick reassigns its shard
+                # groups to the survivor, which attach-replays their
+                # journals — the final admitted set must still match.
+                victim = rt.group_owner[
+                    rt.gmap.cq_group[sc.cluster_queues[0]["name"]]]
+                rt.kill_replica(victim)
+                evidence["killed_replica"] = victim
+            elif t == sc.ticks and point.drill == "loan":
+                # Elastic capacity loan: migrate a live group from
+                # worker 0 to worker 1 mid-run; decisions must be
+                # unchanged (migration preserves admitted state).
+                gid = next((g for g, w in sorted(
+                    rt.group_owner.items()) if w == 0), None)
+                if gid is not None:
+                    rt.migrate_group(gid, 1 % point.replicas)
+                    evidence["loaned_group"] = gid
+            stats = rt.tick()
+            admitted_pairs = sorted(stats["admitted"])
+            st.note_admitted(t, admitted_pairs)
+            st.note_preempted(sorted(stats["preempted"]))
+            trail.append((tuple(k for k, _cq in admitted_pairs),
+                          tuple(sorted(stats["preempted"]))))
+            # Per-tick quota oracle, same cadence as the in-process
+            # drive — a TRANSIENT oversubscription during the drill
+            # windows (migration, journal replay) must not hide behind
+            # a legal final state. Best-effort mid-drill: a dump racing
+            # a just-killed worker is skipped (the final check below
+            # always runs).
+            try:
+                mid = rt.dump().get("usage") or {}
+            except Exception:
+                mid = None
+            if mid is not None:
+                violations.extend(_check_oversub(sc, mid, caps_hw, t))
+        dump = rt.dump()
+        violations.extend(_check_oversub(
+            sc, dump.get("usage") or {}, caps_hw,
+            sc.ticks + sc.settle_ticks - 1))
+        final = {name: sorted(keys)
+                 for name, keys in (dump.get("admitted") or {}).items()}
+        evidence["coordinator"] = rt.coordinator.evidence()
+    finally:
+        rt.close()
+        if tmp is not None:
+            tmp.cleanup()
+    return {"trail": trail, "final_admitted": final,
+            "violations": violations, "evidence": evidence}
+
+
+# -- scenario-level check ---------------------------------------------------
+
+
+def _first_divergence(ref_trail, got_trail, admitted_only: bool):
+    for t, (a, b) in enumerate(zip(ref_trail, got_trail)):
+        ra = a[0] if admitted_only else a
+        rb = b[0] if admitted_only else b
+        if ra != rb:
+            return t, ra, rb
+    if len(ref_trail) != len(got_trail):
+        return min(len(ref_trail), len(got_trail)), None, None
+    return None
+
+
+def check_scenario(sc: Scenario,
+                   points: Optional[List[LatticePoint]] = None,
+                   keep_results: bool = False) -> dict:
+    """Drive `sc` across the lattice and return the oracle report:
+    {"seed", "points", "violations": [...], "axes"}. An empty
+    violations list means every oracle held at every point.
+    `keep_results=True` attaches each point's raw drive result under
+    "results" (the corpus replay reads the reference drive from there
+    instead of paying a second one)."""
+    points = points if points is not None else default_lattice(sc)
+    results: Dict[str, dict] = {}
+    violations: List[dict] = []
+    for p in points:
+        try:
+            results[p.name] = drive(sc, p)
+        except Exception as exc:  # crashes are findings, not aborts
+            violations.append({"oracle": "crash", "point": p.name,
+                               "detail": f"{type(exc).__name__}: {exc}"})
+            results[p.name] = None
+    # Per-point oracle violations (quota, drive-local) stand on their
+    # own — collect them even when the reference point crashed.
+    for p in points:
+        r = results.get(p.name)
+        if r is not None:
+            for vi in r["violations"]:
+                violations.append({**vi, "point": p.name})
+    ref_point = points[0]
+    ref = results.get(ref_point.name)
+    if ref is not None:
+        for p in points[1:]:
+            r = results.get(p.name)
+            if r is None:
+                continue
+            admitted_only = p.kind == "replica"
+            div = _first_divergence(ref["trail"], r["trail"],
+                                    admitted_only)
+            oracle = ("determinism" if p.name.endswith("-repeat")
+                      else "journal" if p.drill == "failover"
+                      else "loan" if p.drill == "loan"
+                      else "identity")
+            if div is not None:
+                t, a, b = div
+                violations.append({
+                    "oracle": oracle, "point": p.name, "tick": t,
+                    "detail": f"tick {t}: {ref_point.name}={a!r} "
+                              f"vs {p.name}={b!r}"})
+            elif r["final_admitted"] != ref["final_admitted"]:
+                diff = {
+                    name for name in set(r["final_admitted"])
+                    | set(ref["final_admitted"])
+                    if r["final_admitted"].get(name)
+                    != ref["final_admitted"].get(name)}
+                violations.append({
+                    "oracle": oracle, "point": p.name,
+                    "tick": sc.ticks + sc.settle_ticks,
+                    "detail": f"final admitted sets differ on "
+                              f"{sorted(diff)[:4]}"})
+    report = {"seed": sc.seed,
+              "points": [p.name for p in points],
+              "axes": [p.axes() for p in points],
+              "violations": violations}
+    if keep_results:
+        report["results"] = results
+    return report

@@ -1,0 +1,286 @@
+"""Scenario model: a fully JSON-serializable scheduling scenario.
+
+A Scenario is the fuzzer's unit of work AND the reproducer file format:
+everything the lattice driver needs to rebuild a cluster (flavors,
+cohort tree, ClusterQueues, policy gates) and replay a traffic script is
+plain data, so a diverging draw can be shrunk structurally and checked
+in under tests/fixtures/fuzz/ as a self-contained golden.
+
+Traffic is a per-tick op script. Ops reference live state only through
+DETERMINISTIC selectors ("finish the n oldest admitted", "delete this
+workload if still pending"), so two drives that have made identical
+decisions so far apply identical traffic — the property the
+decision-identity oracles rest on (after the first divergence the
+streams may differ, but the oracle has already fired).
+
+Op forms (each a JSON list):
+  ["submit", workload_spec]   submit a fresh workload
+  ["finish", n]               finish+delete the n oldest still-admitted
+  ["delete", name]            delete "default/<name>" if still pending
+  ["update_cq", name, factor] re-apply the CQ spec with quotas scaled
+  ["ready", n]                mark the n oldest not-ready admitted
+                              workloads PodsReady (pods_ready policy)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import List, Optional
+
+GI = 1024 ** 3
+FORMAT = "kueuefuzz/v1"
+
+
+@dataclasses.dataclass
+class Scenario:
+    seed: int
+    ticks: int
+    settle_ticks: int
+    flavors: List[dict]          # [{"name", "speed_class"}]
+    topology: Optional[dict]     # {"levels", "counts", "leaf_capacity"}
+    cohorts: List[dict]          # [{"name", "parent", "quota"}]
+    cluster_queues: List[dict]
+    policy: dict                 # {"fair","lending","hetero","pods_ready"}
+    workloads: List[dict]        # initial submissions (before tick 0)
+    traffic: List[list]          # traffic[t] = list of ops for tick t
+
+    # -- (de)serialization --------------------------------------------------
+
+    def to_dict(self) -> dict:
+        d = dataclasses.asdict(self)
+        d["format"] = FORMAT
+        return d
+
+    @staticmethod
+    def from_dict(d: dict) -> "Scenario":
+        fmt = d.get("format", FORMAT)
+        if not str(fmt).startswith("kueuefuzz/"):
+            raise ValueError(f"not a kueuefuzz scenario (format={fmt!r})")
+        return Scenario(
+            seed=int(d["seed"]), ticks=int(d["ticks"]),
+            settle_ticks=int(d.get("settle_ticks", 3)),
+            flavors=list(d["flavors"]), topology=d.get("topology"),
+            cohorts=list(d.get("cohorts", ())),
+            cluster_queues=list(d["cluster_queues"]),
+            policy=dict(d["policy"]), workloads=list(d["workloads"]),
+            traffic=[list(ops) for ops in d["traffic"]])
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_dict(), indent=1, sort_keys=True)
+
+    @staticmethod
+    def from_json(text: str) -> "Scenario":
+        return Scenario.from_dict(json.loads(text))
+
+    # -- size metric (the shrinker minimizes this lexicographically) --------
+
+    def size(self) -> tuple:
+        n_submits = len(self.workloads) + sum(
+            1 for ops in self.traffic for op in ops if op[0] == "submit")
+        return (len(self.cluster_queues), n_submits, self.ticks,
+                sum(len(ops) for ops in self.traffic))
+
+    def replica_safe(self) -> bool:
+        """True when the scenario avoids every DOCUMENTED multi-process
+        divergence and nondeterminism source: split-root preemption
+        candidates and fair-share denominators are subtree-local,
+        PodsReady gates per replica, hetero rides an env the referee
+        comparison can't share, and replica workers run on wall-clock
+        condition timestamps (same-priority preemption tiebreaks would
+        flake). Replica lattice points only run when this holds."""
+        if self.policy.get("pods_ready") or self.policy.get("hetero"):
+            return False
+        if self.policy.get("fair") and self.cohorts:
+            return False
+        for cq in self.cluster_queues:
+            pre = cq.get("preemption") or {}
+            if pre.get("within", "Never") != "Never" \
+                    or pre.get("reclaim", "Never") != "Never":
+                return False
+        return True
+
+
+# -- API-object builders ----------------------------------------------------
+
+
+def _topo_spec(sc: Scenario):
+    if not sc.topology:
+        return None
+    from kueue_tpu.api.types import TopologySpec
+
+    t = sc.topology
+    return TopologySpec.uniform(
+        tuple(t["levels"]), tuple(t["counts"]), t["leaf_capacity"])
+
+
+def flavor_objects(sc: Scenario) -> list:
+    from kueue_tpu.api.types import ResourceFlavor
+
+    topo = _topo_spec(sc)
+    return [ResourceFlavor.make(
+        f["name"], topology=topo,
+        speed_class=float(f.get("speed_class", 1.0)))
+        for f in sc.flavors]
+
+
+def _quota_tuple(vals, unit: int = 1):
+    """[nom, borrow, lend] (borrow/lend may be None) -> FlavorQuotas arg."""
+    nom, borrow, lend = (list(vals) + [None, None])[:3]
+    if borrow is None and lend is None:
+        return nom * unit
+    return (nom * unit,
+            None if borrow is None else borrow * unit,
+            None if lend is None else lend * unit)
+
+
+def _resource_groups(quotas: dict) -> tuple:
+    from kueue_tpu.api.types import FlavorQuotas, ResourceGroup
+
+    fqs = []
+    for fname in sorted(quotas):
+        res = quotas[fname]
+        kwargs = {}
+        if "cpu" in res:
+            kwargs["cpu"] = _quota_tuple(res["cpu"])
+        if "memory_gi" in res:
+            kwargs["memory"] = _quota_tuple(res["memory_gi"], unit=GI)
+        fqs.append(FlavorQuotas.make(fname, **kwargs))
+    covered = tuple(r for r in ("cpu", "memory")
+                    if any(("memory_gi" if r == "memory" else r) in q
+                           for q in quotas.values()))
+    return (ResourceGroup(covered_resources=covered, flavors=tuple(fqs)),)
+
+
+def cohort_objects(sc: Scenario) -> list:
+    from kueue_tpu.api.types import CohortSpec
+
+    out = []
+    for c in sc.cohorts:
+        rgs = _resource_groups(c["quota"]) if c.get("quota") else ()
+        out.append(CohortSpec(name=c["name"], parent=c.get("parent", ""),
+                              resource_groups=rgs))
+    return out
+
+
+def cq_object(spec: dict, quota_factor: float = 1.0):
+    """Build the ClusterQueue API object; `quota_factor` != 1 rebuilds
+    with every nominal (and borrow/lend limit) scaled — the update_cq
+    traffic op."""
+    from kueue_tpu.api.types import (
+        BorrowWithinCohort, ClusterQueue, ClusterQueuePreemption,
+        FairSharing)
+
+    quotas = spec["quotas"]
+    if quota_factor != 1.0:
+        def _scale(v):
+            return None if v is None else max(1, int(v * quota_factor))
+        quotas = {f: {r: [_scale(x) for x in vals]
+                      for r, vals in res.items()}
+                  for f, res in quotas.items()}
+    pre = spec.get("preemption") or {}
+    borrow = None
+    if pre.get("borrow"):
+        borrow = BorrowWithinCohort(
+            policy=pre["borrow"]["policy"],
+            max_priority_threshold=pre["borrow"].get("threshold"))
+    preemption = ClusterQueuePreemption(
+        within_cluster_queue=pre.get("within", "Never"),
+        reclaim_within_cohort=pre.get("reclaim", "Never"),
+        borrow_within_cohort=borrow)
+    fair = None
+    if spec.get("fair_weight") is not None:
+        fair = FairSharing(weight=float(spec["fair_weight"]))
+    return ClusterQueue(
+        name=spec["name"],
+        resource_groups=_resource_groups(quotas),
+        cohort=spec.get("cohort", ""),
+        queueing_strategy=spec.get("strategy", "BestEffortFIFO"),
+        preemption=preemption,
+        fair_sharing=fair)
+
+
+def lq_object(spec: dict):
+    from kueue_tpu.api.types import LocalQueue
+
+    return LocalQueue(name=f"lq-{spec['name']}", namespace="default",
+                      cluster_queue=spec["name"])
+
+
+def workload_object(w: dict):
+    from kueue_tpu.api.types import PodSet, Workload
+
+    pod_sets = []
+    for ps in w["pod_sets"]:
+        kwargs = {}
+        topo = ps.get("topo")
+        if topo:
+            mode, level = topo
+            kwargs["topology_required" if mode == "required"
+                   else "topology_preferred"] = level
+        if w.get("tputs"):
+            kwargs["flavor_throughputs"] = dict(w["tputs"])
+        pod_sets.append(PodSet.make(
+            ps.get("name", "ps0"), count=int(ps["count"]),
+            cpu=int(ps["cpu"]), memory=f"{int(ps['memory_gi'])}Gi",
+            **kwargs))
+    return Workload(
+        name=w["name"], namespace="default", queue_name=w["queue"],
+        priority=int(w.get("priority", 0)),
+        creation_time=float(w["creation_time"]),
+        pod_sets=pod_sets)
+
+
+def nominal_capacity(sc: Scenario, factors: dict) -> dict:
+    """Total nominal capacity per cohort-tree root (plus one pseudo-root
+    per solo ClusterQueue): {root: {flavor: {resource: canonical_units}}}.
+    `factors` carries the live update_cq quota scales. This is the
+    quota-never-oversubscribed oracle's bound — borrowing moves usage
+    between members but the sum over a tree can never exceed the sum of
+    nominals (clusterqueue.go borrowing semantics)."""
+    parent = {c["name"]: c.get("parent", "") for c in sc.cohorts}
+
+    def root_of(cohort: str) -> str:
+        seen = set()
+        while cohort in parent and parent[cohort] and cohort not in seen:
+            seen.add(cohort)
+            cohort = parent[cohort]
+        return cohort
+
+    caps: dict = {}
+
+    def add(root: str, quotas: dict, factor: float = 1.0):
+        dst = caps.setdefault(root, {})
+        for fname, res in quotas.items():
+            d = dst.setdefault(fname, {})
+            for rname, vals in res.items():
+                unit = GI if rname == "memory_gi" else 1000  # cpu -> milli
+                r = "memory" if rname == "memory_gi" else rname
+                nom = max(1, int(vals[0] * factor)) if factor != 1.0 \
+                    else vals[0]
+                d[r] = d.get(r, 0) + nom * unit
+
+    for cq in sc.cluster_queues:
+        root = root_of(cq.get("cohort", "")) if cq.get("cohort") \
+            else f"__solo__/{cq['name']}"
+        add(root, cq["quotas"], factors.get(cq["name"], 1.0))
+    for c in sc.cohorts:
+        if c.get("quota"):
+            add(root_of(c["name"]), c["quota"])
+    return caps
+
+
+def cq_root(sc: Scenario, cq_name: str) -> str:
+    parent = {c["name"]: c.get("parent", "") for c in sc.cohorts}
+    for cq in sc.cluster_queues:
+        if cq["name"] == cq_name:
+            cohort = cq.get("cohort", "")
+            if not cohort:
+                return f"__solo__/{cq_name}"
+            seen = set()
+            while cohort in parent and parent[cohort] \
+                    and cohort not in seen:
+                seen.add(cohort)
+                cohort = parent[cohort]
+            return cohort
+    return f"__solo__/{cq_name}"

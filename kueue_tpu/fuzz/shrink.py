@@ -1,0 +1,270 @@
+"""Scenario shrinker: minimize a diverging draw into a reproducer.
+
+Greedy structural delta-debugging over the scenario's own shape: drop
+whole traffic ticks, drop submit ops and initial workloads (halves, then
+singles), drop ClusterQueues (with their workloads), and simplify
+policies (fair off, hetero off, lending off, topology off, preemption
+down) — re-checking the failure predicate after every candidate and
+keeping any candidate that still fails. The result is the smallest
+scenario the passes could reach, written as a self-contained reproducer
+file that checks in under tests/fixtures/fuzz/ as a new golden (green on
+a fixed build; red again the day the bug class returns).
+"""
+
+from __future__ import annotations
+
+import copy
+import json
+import os
+from typing import Callable, List, Optional
+
+from kueue_tpu.fuzz.scenario import FORMAT, Scenario
+
+REPRO_FORMAT = "kueuefuzz-repro/v1"
+
+
+def _with(sc: Scenario, **patch) -> Scenario:
+    d = sc.to_dict()
+    d.update(patch)
+    return Scenario.from_dict(d)
+
+
+def _used_queues(sc: Scenario) -> set:
+    used = {w["queue"] for w in sc.workloads}
+    for ops in sc.traffic:
+        for op in ops:
+            if op[0] == "submit":
+                used.add(op[1]["queue"])
+    return used
+
+
+def _drop_cq(sc: Scenario, name: str) -> Scenario:
+    lq = f"lq-{name}"
+    cqs = [c for c in sc.cluster_queues if c["name"] != name]
+    workloads = [w for w in sc.workloads if w["queue"] != lq]
+    traffic = []
+    for ops in sc.traffic:
+        kept = []
+        for op in ops:
+            if op[0] == "submit" and op[1]["queue"] == lq:
+                continue
+            if op[0] == "update_cq" and op[1] == name:
+                continue
+            kept.append(op)
+        traffic.append(kept)
+    return _with(sc, cluster_queues=cqs, workloads=workloads,
+                 traffic=traffic)
+
+
+def _merge_cq(sc: Scenario, src: str, dst: str) -> Scenario:
+    """Drop ClusterQueue `src` but RETARGET its workloads onto `dst`
+    instead of dropping them — the pass that collapses a divergence
+    spread over many queues onto fewer (a plain CQ drop would lose the
+    workloads that make it diverge)."""
+    src_lq, dst_lq = f"lq-{src}", f"lq-{dst}"
+
+    def retarget(w: dict) -> dict:
+        return {**w, "queue": dst_lq} if w["queue"] == src_lq else w
+
+    cqs = [c for c in sc.cluster_queues if c["name"] != src]
+    workloads = [retarget(w) for w in sc.workloads]
+    traffic = []
+    for ops in sc.traffic:
+        kept = []
+        for op in ops:
+            if op[0] == "submit":
+                kept.append(["submit", retarget(op[1])])
+            elif op[0] == "update_cq" and op[1] == src:
+                continue
+            else:
+                kept.append(op)
+        traffic.append(kept)
+    return _with(sc, cluster_queues=cqs, workloads=workloads,
+                 traffic=traffic)
+
+
+def _submit_positions(sc: Scenario) -> List[tuple]:
+    """Every submission site: ("init", i) or ("tick", t, j)."""
+    out: List[tuple] = [("init", i) for i in range(len(sc.workloads))]
+    for t, ops in enumerate(sc.traffic):
+        for j, op in enumerate(ops):
+            if op[0] == "submit":
+                out.append(("tick", t, j))
+    return out
+
+
+def _drop_submits(sc: Scenario, positions: List[tuple]) -> Scenario:
+    drop_init = {p[1] for p in positions if p[0] == "init"}
+    drop_tick = {(p[1], p[2]) for p in positions if p[0] == "tick"}
+    workloads = [w for i, w in enumerate(sc.workloads)
+                 if i not in drop_init]
+    traffic = [[op for j, op in enumerate(ops)
+                if not (op[0] == "submit" and (t, j) in drop_tick)]
+               for t, ops in enumerate(sc.traffic)]
+    return _with(sc, workloads=workloads, traffic=traffic)
+
+
+def shrink(sc: Scenario, still_fails: Callable[[Scenario], bool],
+           budget: int = 250) -> tuple:
+    """Minimize `sc` under the predicate; returns (scenario, attempts).
+    `still_fails` must re-run the diverging check (the caller typically
+    closes over the lattice-point pair that diverged). The predicate is
+    never trusted blindly: a candidate is kept only when it STILL
+    fails, so the result always reproduces the original divergence."""
+    attempts = [0]
+
+    def check(cand: Scenario) -> bool:
+        if attempts[0] >= budget:
+            return False
+        attempts[0] += 1
+        try:
+            return bool(still_fails(cand))
+        except Exception:
+            # A candidate that crashes the harness is not a valid
+            # reproducer of the ORIGINAL divergence; skip it.
+            return False
+
+    best = sc
+    improved = True
+    while improved and attempts[0] < budget:
+        improved = False
+
+        # 1. Truncate the tail: divergences live at some first tick;
+        #    everything after it is dead weight.
+        ticks = best.ticks
+        for frac in (0.25, 0.5, 0.75):
+            t = max(1, int(ticks * frac))
+            if t >= ticks:
+                continue
+            cand = _with(best, ticks=t,
+                         traffic=[list(o) for o in best.traffic[:t]])
+            if check(cand):
+                best, improved = cand, True
+                break
+
+        # 2. Drop ClusterQueues one at a time (smallest axis first:
+        #    the acceptance bound is <= 3 CQs / <= 10 workloads), then
+        #    try MERGING each into a sibling (retargeting its
+        #    workloads) — a drop loses the workloads, a merge keeps the
+        #    contention they create.
+        for cq in list(best.cluster_queues):
+            if len(best.cluster_queues) <= 1:
+                break
+            cand = _drop_cq(best, cq["name"])
+            if not cand.cluster_queues:
+                continue
+            if check(cand):
+                best, improved = cand, True
+        for cq in list(best.cluster_queues):
+            if len(best.cluster_queues) <= 1:
+                break
+            others = [c["name"] for c in best.cluster_queues
+                      if c["name"] != cq["name"]]
+            for dst in others[:2]:
+                cand = _merge_cq(best, cq["name"], dst)
+                if check(cand):
+                    best, improved = cand, True
+                    break
+
+        # 3. Drop submissions: halves, then singles.
+        positions = _submit_positions(best)
+        chunk = max(len(positions) // 2, 1)
+        while chunk >= 1 and positions:
+            i = 0
+            while i < len(positions):
+                batch = positions[i:i + chunk]
+                cand = _drop_submits(best, batch)
+                if check(cand):
+                    best, improved = cand, True
+                    positions = _submit_positions(best)
+                    i = 0
+                    continue
+                i += chunk
+            if chunk == 1:
+                break
+            chunk //= 2
+
+        # 4. Drop non-submit traffic ops (finish/delete/update/ready).
+        for t in range(len(best.traffic)):
+            for j in range(len(best.traffic[t]) - 1, -1, -1):
+                if best.traffic[t][j][0] == "submit":
+                    continue
+                traffic = [list(ops) for ops in best.traffic]
+                del traffic[t][j]
+                cand = _with(best, traffic=traffic)
+                if check(cand):
+                    best, improved = cand, True
+
+        # 5. Simplify policy dimensions. Each transform is built IN
+        #    FULL before the no-op check — flat-cohort scenarios have
+        #    cohorts == [] already but still carry per-CQ cohort names,
+        #    so the cohort-clearing rewrite must run before deciding
+        #    the candidate changed nothing.
+        def _simplify(patch):
+            cand = _with(best, **patch)
+            if patch.get("policy", {}).get("fair") is False:
+                cand = _with(cand, cluster_queues=[
+                    {**c, "fair_weight": None}
+                    for c in cand.cluster_queues])
+            if "cohorts" in patch:
+                cand = _with(cand, cluster_queues=[
+                    {**c, "cohort": ""} for c in cand.cluster_queues])
+            return cand
+
+        # Patches are built LAZILY from the current best: a tuple of
+        # pre-built dicts would snapshot best.policy at pass start, so
+        # accepting {fair: False} and then applying a stale
+        # {hetero: False} patch would resurrect fair=True — the pass
+        # ping-pongs and burns the whole attempt budget instead of
+        # converging.
+        for make_patch in (
+                lambda: {"policy": {**best.policy, "fair": False}},
+                lambda: {"policy": {**best.policy, "hetero": False}},
+                lambda: {"policy": {**best.policy, "lending": False}},
+                lambda: {"policy": {**best.policy,
+                                    "pods_ready": False}},
+                lambda: {"topology": None},
+                lambda: {"cohorts": []},
+                lambda: {"settle_ticks": 1},
+        ):
+            cand = _simplify(make_patch())
+            if cand.to_dict() == best.to_dict():
+                continue
+            if check(cand):
+                best, improved = cand, True
+
+        # 6. Simplify preemption per CQ.
+        for i, cq in enumerate(best.cluster_queues):
+            pre = cq.get("preemption") or {}
+            if pre.get("within", "Never") == "Never" \
+                    and pre.get("reclaim", "Never") == "Never":
+                continue
+            cqs = copy.deepcopy(best.cluster_queues)
+            cqs[i]["preemption"] = {"within": "Never",
+                                    "reclaim": "Never"}
+            cand = _with(best, cluster_queues=cqs)
+            if check(cand):
+                best, improved = cand, True
+    return best, attempts[0]
+
+
+def write_reproducer(path: str, sc: Scenario, *, name: str,
+                     description: str, found: Optional[dict] = None,
+                     lattice: Optional[list] = None,
+                     expect: Optional[dict] = None) -> dict:
+    """Emit a self-contained reproducer file (the corpus entry format —
+    see corpus.py for the replay contract)."""
+    doc = {
+        "format": REPRO_FORMAT,
+        "name": name,
+        "description": description,
+        "found": found or {},
+        "lattice": lattice,
+        "expect": expect or {},
+        "scenario": {**sc.to_dict(), "format": FORMAT},
+    }
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(doc, f, indent=1, sort_keys=True)
+        f.write("\n")
+    return doc

@@ -1,0 +1,211 @@
+"""Long-run soak: churn for hours, watch resource curves for drift.
+
+The bug class the soak exists for (PR 8's iteration-order victim flip,
+PR 9's gen-2 GC barrier stall) only shows under churn VOLUME — no
+20-tick golden finds a free-list leak, a cache that slowly stops
+hitting, or RSS that creeps 1MB/minute. The soak drives the bench's
+churn loop (same synthetic distributions) for a wall-clock budget and
+samples, per window of ticks:
+
+  rss_mb                    resident set (the leak curve)
+  arena_occupancy           live rows / pool capacity (free-list leaks)
+  arena_reuse_ratio         windowed gather reuse (incrementality decay)
+  nominate_hit_ratio        windowed cache hit rate (fingerprint churn)
+  dispatches_per_tick       solver dispatch rate (quiescence decay)
+  backlog                   pending population (equilibrium check)
+
+Verdict: after a warmup quarter, the run is split into an early and a
+late half; a MONOTONIC drift beyond tolerance between them (late RSS /
+occupancy / dispatch rate meaningfully above early, late hit/reuse
+ratios meaningfully below) fails the soak. Registered behind the `slow`
+pytest marker (tests/test_fuzz_soak.py) and `make fuzz-soak`
+(KUEUE_FUZZ_SOAK_SECONDS sets the hours-scale budget).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import List, Optional
+
+# Drift tolerances: absolute floors absorb small-number noise, the
+# ratios catch the monotonic creep the soak exists to find.
+RSS_RATIO, RSS_FLOOR_MB = 1.25, 48.0
+OCC_RATIO, OCC_FLOOR = 1.25, 0.05
+RATIO_DROP = 0.15          # hit/reuse ratios may degrade at most this
+DISPATCH_RATIO, DISPATCH_FLOOR = 1.5, 0.5
+
+
+def _rss_mb() -> float:
+    from kueue_tpu.controllers.replica_runtime import _rss_bytes
+
+    return _rss_bytes() / (1024.0 ** 2)
+
+
+def _mean(vals: List[float]) -> Optional[float]:
+    vals = [v for v in vals if v is not None]
+    return sum(vals) / len(vals) if vals else None
+
+
+def run_soak(duration_s: float, *, seed: int = 0, num_cqs: int = 32,
+             backlog: int = 512, sample_every: int = 25,
+             report_path: Optional[str] = None,
+             gc_every: int = 50) -> dict:
+    """Run the churn soak for `duration_s` wall seconds; returns the
+    report dict (also written to `report_path` when given). The verdict
+    lives under report["verdict"]; report["ok"] is the rollup."""
+    import random
+
+    from kueue_tpu.api.types import PodSet, Workload
+    from kueue_tpu.models.flavor_fit import BatchSolver
+    from kueue_tpu.utils.envinfo import environment_block
+    from kueue_tpu.utils.synthetic import (churn_arrival_draw,
+                                           synthetic_framework)
+
+    fw = synthetic_framework(
+        num_cqs=num_cqs, num_cohorts=max(num_cqs // 4, 1), num_flavors=4,
+        num_pending=backlog, usage_fill=0.5, seed=seed,
+        batch_solver=BatchSolver(), pipeline_depth=2)
+    solver = fw.scheduler.batch_solver
+    rnd = random.Random(seed + 1)
+
+    admitted: List[tuple] = []
+    seq = [0]
+    orig_apply = fw.scheduler.apply_admission
+
+    def apply_admission(wl):
+        ok = orig_apply(wl)
+        if ok:
+            admitted.append((tick_no[0] + rnd.choice((4, 5, 6)), wl))
+        return ok
+
+    fw.scheduler.apply_admission = apply_admission
+    tick_no = [0]
+
+    def churn():
+        keep = []
+        for due, wl in admitted:
+            if wl.is_finished or not wl.is_admitted:
+                # Finished already, or preempted/evicted: drop the
+                # entry now — a readmission appends a FRESH entry, so
+                # keeping this one would pin the dead Workload (and
+                # rescan it every tick) for the rest of an hours-scale
+                # run; the harness itself would then produce the RSS
+                # creep the drift verdict gates on.
+                continue
+            if due <= tick_no[0]:
+                fw.finish(wl)
+                fw.delete_workload(wl)
+                seq[0] += 1
+                d = churn_arrival_draw(rnd, num_cqs, 4, seq=seq[0])
+                fw.submit(Workload(
+                    name=f"soak-{seq[0]}", namespace="default",
+                    queue_name=f"lq-{d['queue_index']}",
+                    priority=d["priority"],
+                    creation_time=float(100_000 + seq[0]),
+                    pod_sets=[PodSet.make(
+                        "ps0", count=d["count"], cpu=d["cpu"],
+                        memory=f"{d['memory_gi']}Gi")]))
+            else:
+                keep.append((due, wl))
+        admitted[:] = keep
+        fw.prewarm_idle()
+
+    samples: List[dict] = []
+    t_end = time.monotonic() + duration_s
+    window_base = solver.fuzz_counters()
+    window_ticks = 0
+    while time.monotonic() < t_end:
+        tick_no[0] += 1
+        window_ticks += 1
+        fw.tick()
+        churn()
+        if tick_no[0] % gc_every == 0:
+            import gc
+
+            gc.collect()
+        if window_ticks >= sample_every:
+            now = solver.fuzz_counters()
+            hits = now["nominate_cache_hits"] \
+                - window_base["nominate_cache_hits"]
+            misses = now["nominate_cache_misses"] \
+                - window_base["nominate_cache_misses"]
+            reused = now["arena_rows_reused"] \
+                - window_base["arena_rows_reused"]
+            missed = now["arena_rows_missed"] \
+                - window_base["arena_rows_missed"]
+            samples.append({
+                "tick": tick_no[0],
+                "rss_mb": round(_rss_mb(), 1),
+                "arena_occupancy": now["arena_occupancy"],
+                "arena_reuse_ratio": (
+                    reused / (reused + missed)
+                    if reused + missed else None),
+                "nominate_hit_ratio": (
+                    hits / (hits + misses) if hits + misses else None),
+                "dispatches_per_tick": (
+                    (now["dispatches"] - window_base["dispatches"])
+                    / window_ticks),
+                "backlog": sum(
+                    fw.queues.pending(f"cq-{i}")
+                    for i in range(num_cqs)),
+            })
+            window_base = now
+            window_ticks = 0
+    report = {
+        "ticks": tick_no[0],
+        "duration_s": round(duration_s, 1),
+        "samples": samples,
+        "environment": environment_block(),
+        "verdict": drift_verdict(samples),
+    }
+    report["ok"] = all(v["ok"] for v in report["verdict"].values()) \
+        if report["verdict"] else False
+    if report_path:
+        with open(report_path, "w", encoding="utf-8") as f:
+            json.dump(report, f, indent=1)
+    return report
+
+
+def drift_verdict(samples: List[dict]) -> dict:
+    """Monotonic-drift detection over the sample curves: drop the first
+    quarter (warmup), split the rest into an early and a late half, and
+    compare window means against per-metric tolerances. Pure function of
+    the samples so the unit tests can exercise it directly."""
+    if len(samples) < 4:
+        return {}
+    body = samples[len(samples) // 4:]
+    early = body[:len(body) // 2]
+    late = body[len(body) // 2:]
+
+    def series(key):
+        return (_mean([s[key] for s in early]),
+                _mean([s[key] for s in late]))
+
+    out = {}
+
+    e, l = series("rss_mb")
+    out["rss_mb"] = {
+        "early": e, "late": l,
+        "ok": e is None or l is None
+        or l <= max(e * RSS_RATIO, e + RSS_FLOOR_MB)}
+    e, l = series("arena_occupancy")
+    out["arena_occupancy"] = {
+        "early": e, "late": l,
+        "ok": e is None or l is None
+        or l <= max(e * OCC_RATIO, e + OCC_FLOOR)}
+    for key in ("arena_reuse_ratio", "nominate_hit_ratio"):
+        e, l = series(key)
+        out[key] = {"early": e, "late": l,
+                    "ok": e is None or l is None or l >= e - RATIO_DROP}
+    e, l = series("dispatches_per_tick")
+    out["dispatches_per_tick"] = {
+        "early": e, "late": l,
+        "ok": e is None or l is None
+        or l <= max(e * DISPATCH_RATIO, e + DISPATCH_FLOOR)}
+    return out
+
+
+def soak_seconds_from_env(default: float = 7200.0) -> float:
+    return float(os.environ.get("KUEUE_FUZZ_SOAK_SECONDS", "") or default)

@@ -1115,6 +1115,34 @@ class BatchSolver:
         arena = self._arena
         return arena.rows_encoded if arena is not None else 0
 
+    def arena_occupancy(self) -> Optional[float]:
+        """Live rows / pool capacity of the workload arena (None when
+        the arena is off). The soak harness watches this for monotonic
+        drift: a leak in the free-list (rows never returned on
+        delete/admit) shows up as occupancy creeping toward 1.0 while
+        the backlog stays flat."""
+        arena = self._arena
+        if arena is None or not arena.cap:
+            return None
+        return (arena.cap - len(arena._free)) / arena.cap
+
+    def fuzz_counters(self) -> dict:
+        """One snapshot of the cumulative solver counters the fuzz
+        lattice driver and the soak harness difference across windows
+        (the lattice drive hook: everything here is already maintained
+        on the hot path, this just reads it)."""
+        return {
+            "dispatches": self.dispatches,
+            "cold_dispatches": self.cold_dispatches,
+            "nominate_cache_hits": self.nominate_cache_hits,
+            "nominate_cache_misses": self.nominate_cache_misses,
+            "arena_rows_reused": self.arena_rows_reused,
+            "arena_rows_missed": self.arena_rows_missed,
+            "arena_rows_encoded": self.arena_rows_encoded,
+            "arena_full_rebuilds": self.arena_full_rebuilds,
+            "arena_occupancy": self.arena_occupancy(),
+        }
+
     def encoding_matches(self, snapshot: Snapshot) -> bool:
         """True when the solver's current encoding was built from exactly
         this snapshot's structure (and feature bits). Index-space state

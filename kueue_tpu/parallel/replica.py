@@ -268,6 +268,20 @@ class Coordinator:
         self._replay: Optional[Dict[tuple, bool]] = None
         self.replayed_verdicts = 0
 
+    def evidence(self) -> dict:
+        """Commit-protocol counters as one JSON-ready block — the fuzz
+        lattice driver attaches this to every replica drive's report so
+        a divergence can be triaged against what the coordinator
+        actually arbitrated (rounds run, split-root commits, revocations,
+        verdicts replayed at fail-over)."""
+        return {
+            "rounds": self.rounds,
+            "commits": self.commits,
+            "revocations": self.revocations,
+            "replayed_verdicts": self.replayed_verdicts,
+            "epoch": self.epoch,
+        }
+
     # -- admin state --------------------------------------------------------
 
     def note_flavor(self, rf, deleted: bool = False) -> None:

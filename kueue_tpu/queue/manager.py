@@ -346,7 +346,19 @@ class Manager:
             # policy) may make parked workloads admissible: requeue the
             # whole cohort's inadmissible set (manager.go
             # UpdateClusterQueue with specUpdated=true).
-            self._queue_cohort_inadmissible(cq.cohort, fallback=cq)
+            # KUEUE_TPU_FUZZ_MUTATION=no-requeue-on-cq-update reverts to
+            # the pre-PR-9 bug (requeue only on cohort CHANGE, so a
+            # plain quota raise leaves NoFit workloads parked forever) —
+            # an oracle-mutation drill: the fuzz corpus meta-test proves
+            # the checked-in PR 9 reproducer goes red under it. Inert
+            # unless the env gate is set; never set it in production.
+            import os
+            if os.environ.get("KUEUE_TPU_FUZZ_MUTATION") == \
+                    "no-requeue-on-cq-update":
+                if cq.cohort != old_cohort:
+                    self._queue_cohort_inadmissible(cq.cohort, fallback=cq)
+            else:
+                self._queue_cohort_inadmissible(cq.cohort, fallback=cq)
             self._cond.notify_all()
 
     def delete_cluster_queue(self, name: str) -> None:

@@ -1,0 +1,70 @@
+"""Machine environment snapshot for benchmark / fuzz artifacts.
+
+Every BENCH json record (and every fuzz/soak report) carries this block so
+the long-standing "bench boxes drift run to run — compare within-run only"
+caveat is machine-checkable: a reader comparing two artifacts can tell
+whether they came from the same container shape (cpu count, python/jax
+versions, container hint) and how loaded the box was while measuring
+(load average next to cpu count), instead of trusting a prose note.
+
+Import-light by design: jax is only version-probed through importlib
+metadata (no backend initialization), so bench's subprocess drivers and
+the stdlib-only analysis tools can all call it.
+"""
+
+from __future__ import annotations
+
+import os
+import platform
+import socket
+
+
+def _container_hint() -> str:
+    """Best-effort container runtime detection: docker/podman drop
+    marker files, k8s mounts a service-account dir, and cgroup paths
+    name the runtime. "none" means no marker found, not proof of bare
+    metal."""
+    if os.path.exists("/var/run/secrets/kubernetes.io"):
+        return "kubernetes"
+    if os.path.exists("/.dockerenv"):
+        return "docker"
+    if os.path.exists("/run/.containerenv"):
+        return "podman"
+    try:
+        with open("/proc/1/cgroup", "r", encoding="utf-8") as f:
+            body = f.read()
+        for marker in ("kubepods", "docker", "containerd", "lxc"):
+            if marker in body:
+                return marker
+    except OSError:
+        pass
+    return "none"
+
+
+def _dist_version(name: str):
+    try:
+        from importlib import metadata
+
+        return metadata.version(name)
+    except Exception:
+        return None
+
+
+def environment_block() -> dict:
+    """The per-run environment evidence block (JSON-ready)."""
+    try:
+        load1, load5, load15 = os.getloadavg()
+        load = [round(load1, 2), round(load5, 2), round(load15, 2)]
+    except OSError:
+        load = None
+    return {
+        "hostname": socket.gethostname(),
+        "platform": platform.platform(),
+        "cpu_count": os.cpu_count(),
+        "load_avg": load,
+        "python": platform.python_version(),
+        "jax": _dist_version("jax"),
+        "numpy": _dist_version("numpy"),
+        "container": _container_hint(),
+        "backend_env": os.environ.get("JAX_PLATFORMS") or None,
+    }

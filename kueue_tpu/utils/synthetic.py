@@ -37,6 +37,63 @@ def hetero_profile_draw(rnd, num_flavors: int):
             f"flavor-{f_b}": float(rnd.choice([1, 2]))}
 
 
+def churn_arrival_draw(rnd, num_cqs: int, num_flavors: int = 0, *,
+                       preemption_heavy: bool = False, topology: bool = False,
+                       hetero: bool = False, seq: int = 0) -> dict:
+    """One churn/replacement arrival's randomized fields — the ONE home of
+    the arrival distribution shared by bench.py's completion flux (both
+    the in-process loop and the replica bulk-wire variant) and the fuzz
+    generator's traffic shapes. Before this helper the three call sites
+    carried drifting copies of the same draws; now a distribution change
+    lands everywhere at once.
+
+    Returns a plain spec dict (`queue_index`, `priority`, `count`, `cpu`,
+    `memory_gi`, plus `topo_kw` / `tputs` extras) the caller turns into a
+    Workload (or ships over the replica bulk wire)."""
+    c = rnd.randrange(num_cqs)
+    if preemption_heavy:
+        priority = rnd.randint(1, 5) if seq % 2 else rnd.randint(-2, 0)
+    else:
+        priority = rnd.randint(-2, 2)
+    topo_kw = {}
+    if topology:
+        topo_kw = ({"topology_required": "rack"} if seq % 4 == 0
+                   else {"topology_preferred": "rack"})
+    tputs = hetero_profile_draw(rnd, num_flavors) if hetero else None
+    return {
+        "queue_index": c,
+        "priority": priority,
+        "count": rnd.randint(1, 8),
+        "cpu": rnd.randint(1, 8),
+        "memory_gi": rnd.randint(1, 16),
+        "topo_kw": topo_kw,
+        "tputs": tputs,
+    }
+
+
+def diurnal_rate(tick: int, period: int = 24, lo: float = 0.0,
+                 hi: float = 3.0) -> float:
+    """Mean arrivals for tick `tick` of a diurnal (sinusoidal) traffic
+    shape: peaks mid-period, troughs at the boundaries. Shared by the
+    fuzz generator's `diurnal` traffic shape so replays are a pure
+    function of the tick index."""
+    import math
+
+    period = max(period, 1)
+    phase = (tick % period) / period
+    return lo + (hi - lo) * 0.5 * (1.0 - math.cos(2.0 * math.pi * phase))
+
+
+def heavy_tailed_int(rnd, lo: int = 1, hi: int = 64,
+                     alpha: float = 1.3) -> int:
+    """A bounded Pareto-ish integer draw (most values near `lo`, rare
+    large spikes up to `hi`) — the heavy-tailed job-size distribution of
+    the Mesos multi-framework study's workload mixes."""
+    u = max(rnd.random(), 1e-9)
+    v = int(lo / (u ** (1.0 / alpha)))
+    return max(lo, min(hi, v))
+
+
 def synthetic_objects(
     num_cqs: int = 1000,
     num_cohorts: int = 100,

@@ -1,0 +1,213 @@
+"""kueuefuzz unit + smoke tests: generator, lattice driver, oracles,
+shrinker. The CI-budget campaign itself runs via `make fuzz-smoke`
+(python -m kueue_tpu.fuzz); here we pin the machinery's contracts at
+test scale."""
+
+import json
+
+import pytest
+
+from kueue_tpu.fuzz import generator, lattice, shrink
+from kueue_tpu.fuzz.generator import TRAFFIC_SHAPES
+from kueue_tpu.fuzz.scenario import Scenario
+
+
+def test_generator_is_deterministic():
+    a = generator.draw_scenario(7)
+    b = generator.draw_scenario(7)
+    assert a.to_dict() == b.to_dict()
+    assert a.to_dict() != generator.draw_scenario(8).to_dict()
+
+
+def test_scenario_json_roundtrip():
+    sc = generator.draw_scenario(3)
+    again = Scenario.from_json(sc.to_json())
+    assert again.to_dict() == sc.to_dict()
+    with pytest.raises(ValueError):
+        Scenario.from_dict({"format": "not-a-scenario"})
+
+
+def test_generator_covers_the_draw_space():
+    """25 seeds (the smoke budget) must cover every traffic shape and
+    every policy dimension — the whole point of the fuzzer is breadth
+    the hand-written suites don't have."""
+    scs = [generator.draw_scenario(s) for s in range(25)]
+    shapes = {sc.policy["shape"] for sc in scs}
+    assert shapes == set(TRAFFIC_SHAPES)
+    assert any(sc.policy["hetero"] for sc in scs)
+    assert any(sc.policy["fair"] for sc in scs)
+    assert any(sc.policy["lending"] for sc in scs)
+    assert any(sc.policy["pods_ready"] for sc in scs)
+    assert any(sc.topology for sc in scs)
+    assert any(sc.cohorts for sc in scs)
+    assert any(sc.replica_safe() for sc in scs)
+    # The adversarial tie storm (the PR 8 bug-class population).
+    assert any(w["name"].startswith("tie-borrow")
+               for sc in scs for w in sc.workloads)
+
+
+def test_lattice_covers_the_required_axes():
+    """Acceptance shape: engine x shards {1,2} x replicas {1,2} x one
+    kill-switch set, plus the fail-over and loan drill points on the
+    rotating seed subsets."""
+    axes = {"engines": set(), "shards": set(), "replicas": set(),
+            "kill": set(), "drills": set()}
+    for s in range(25):
+        for p in lattice.default_lattice(generator.draw_scenario(s)):
+            axes["engines"].add(p.axes()["engine"])
+            axes["shards"].add(p.shards)
+            axes["replicas"].add(p.replicas)
+            axes["kill"].add(p.kill_switches)
+            if p.drill:
+                axes["drills"].add(p.drill)
+    assert {"referee", "jax"} <= axes["engines"]
+    assert {1, 2} <= axes["shards"]
+    assert {1, 2} <= axes["replicas"]
+    assert axes["kill"] == {False, True}
+    assert axes["drills"] == {"failover", "loan"}
+
+
+def test_replica_points_only_inside_the_identity_envelope():
+    for s in range(25):
+        sc = generator.draw_scenario(s)
+        has_replica = any(p.kind == "replica"
+                          for p in lattice.default_lattice(sc))
+        assert has_replica == sc.replica_safe()
+
+
+def test_smoke_scenarios_replay_identically():
+    """A slice of the campaign in tier-1: a replica-profile seed (drill
+    coverage) and an ordinary seed replay with zero oracle violations
+    across the full lattice."""
+    for seed in (0, 3):
+        report = lattice.check_scenario(generator.draw_scenario(seed))
+        assert report["violations"] == [], report["violations"][:3]
+
+
+def test_quota_oracle_flags_minted_quota():
+    sc = generator.draw_scenario(0)
+    caps = lattice.sc_mod.nominal_capacity(sc, {})
+    cq = sc.cluster_queues[0]
+    flavor = sorted(cq["quotas"])[0]
+    over = {cq["name"]: {flavor: {"cpu": 10 ** 12}}}
+    out = lattice._check_oversub(sc, over, caps, tick=5)
+    assert out and out[0]["oracle"] == "quota"
+    assert "10" in out[0]["detail"]
+    # At-capacity usage is legal.
+    root = lattice.sc_mod.cq_root(sc, cq["name"])
+    exact = {cq["name"]: {flavor: dict(caps[root][flavor])}}
+    assert lattice._check_oversub(sc, exact, caps, tick=5) == []
+
+
+def test_high_water_capacity_tolerates_quota_shrink():
+    """A quota SHRINK leaves committed usage above the new nominal —
+    the oracle bounds by high-water capacity, not the live one."""
+    sc = generator.draw_scenario(0)
+    hw = lattice.sc_mod.nominal_capacity(sc, {})
+    shrunk = lattice.sc_mod.nominal_capacity(
+        sc, {sc.cluster_queues[0]["name"]: 0.5})
+    lattice._merge_caps(hw, shrunk)
+    root = lattice.sc_mod.cq_root(sc, sc.cluster_queues[0]["name"])
+    flavor = sorted(sc.cluster_queues[0]["quotas"])[0]
+    assert hw[root][flavor]["cpu"] \
+        >= shrunk[root][flavor]["cpu"]
+
+
+def test_first_divergence_reports_the_tick():
+    ref = [(("a",), ()), (("b",), ()), (("c",), ())]
+    same = [tuple(x) for x in ref]
+    assert lattice._first_divergence(ref, same, False) is None
+    div = [(("a",), ()), (("X",), ()), (("c",), ())]
+    t, a, b = lattice._first_divergence(ref, div, False)
+    assert t == 1 and a != b
+    # admitted_only ignores preempted-set differences.
+    pre = [(("a",), ("p",)), (("b",), ()), (("c",), ())]
+    assert lattice._first_divergence(ref, pre, True) is None
+    assert lattice._first_divergence(ref, pre, False)[0] == 0
+
+
+def test_traffic_ops_apply_deterministically():
+    """finish/delete/update_cq resolve through deterministic selectors;
+    the update_cq op actually raises quota (the parked workload admits
+    afterwards — the PR 9 corpus shape, checked here at unit scale)."""
+    from kueue_tpu.fuzz.corpus import CORPUS_DIR, load_entry
+    import os
+
+    entry = load_entry(os.path.join(
+        CORPUS_DIR, "pr9-quota-raise-requeue.json"))
+    sc = entry["scenario_obj"]
+    ref = lattice.drive(sc, lattice.default_lattice(sc)[0])
+    admitted = {k for keys in ref["final_admitted"].values()
+                for k in keys}
+    assert "default/park-me" in admitted
+
+
+def test_shrinker_minimizes_under_a_pure_predicate():
+    """Structural passes only: a predicate that needs one 'poison'
+    submission and >= 2 ClusterQueues must shrink everything else
+    away (no scheduler drives involved — pure and fast)."""
+    sc = generator.draw_scenario(2)
+    poison = {
+        "name": "poison", "queue": f"lq-{sc.cluster_queues[0]['name']}",
+        "priority": 0, "creation_time": 1.0,
+        "pod_sets": [{"name": "ps0", "count": 1, "cpu": 1,
+                      "memory_gi": 1, "topo": None}], "tputs": None}
+    sc = Scenario.from_dict({**sc.to_dict(),
+                             "workloads": sc.workloads + [poison]})
+
+    def fails(cand):
+        has_poison = any(w["name"] == "poison" for w in cand.workloads)
+        return has_poison and len(cand.cluster_queues) >= 2
+
+    small, attempts = shrink.shrink(sc, fails, budget=300)
+    assert fails(small)
+    assert len(small.cluster_queues) == 2
+    assert [w["name"] for w in small.workloads] == ["poison"]
+    assert small.ticks <= sc.ticks
+    assert attempts <= 300
+
+
+def test_shrinker_converges_without_exhausting_the_budget():
+    """An always-failing predicate (the crash-class shape) must reach
+    the floor and STOP: stale policy patches used to resurrect already-
+    simplified dimensions and ping-pong until the budget ran out."""
+    sc = generator.draw_scenario(5)  # hetero+fair+lending draw
+    assert sc.policy["hetero"] and sc.policy["fair"]
+    small, attempts = shrink.shrink(sc, lambda cand: True, budget=250)
+    assert attempts < 250, "shrinker burned the whole budget"
+    assert not small.policy["fair"]
+    assert not small.policy["hetero"]
+    assert not small.policy["lending"]
+    assert len(small.cluster_queues) == 1
+    assert small.size()[1] == 0  # every submission dropped
+
+
+def test_shrinker_merge_cq_retargets_workloads():
+    sc = generator.draw_scenario(2)
+    src = sc.cluster_queues[0]["name"]
+    dst = sc.cluster_queues[1]["name"]
+    merged = shrink._merge_cq(sc, src, dst)
+    assert all(c["name"] != src for c in merged.cluster_queues)
+    assert not any(w["queue"] == f"lq-{src}" for w in merged.workloads)
+
+
+def test_reproducer_roundtrip(tmp_path):
+    sc = generator.draw_scenario(5)
+    path = str(tmp_path / "repro.json")
+    shrink.write_reproducer(path, sc, name="t", description="d",
+                            expect={"min_preempted": 0})
+    doc = json.load(open(path))
+    assert doc["format"] == shrink.REPRO_FORMAT
+    assert Scenario.from_dict(doc["scenario"]).to_dict() == sc.to_dict()
+
+
+def test_crash_is_a_finding_not_an_abort():
+    """A lattice point that crashes mid-drive must surface as a crash
+    violation while the other points still run."""
+    sc = generator.draw_scenario(0)
+    bad = Scenario.from_dict({**sc.to_dict(), "traffic": [
+        [["no-such-op"]]] + [list(o) for o in sc.traffic[1:]]})
+    report = lattice.check_scenario(
+        bad, points=lattice.default_lattice(bad)[:2])
+    assert report["violations"]
+    assert all(v["oracle"] == "crash" for v in report["violations"])

@@ -1,0 +1,87 @@
+"""Soak harness tests: the drift detector's contracts (fast, pure) and
+the long churn soak itself (slow-marked; hours-scale in `make
+fuzz-soak` via KUEUE_FUZZ_SOAK_SECONDS, a short budget here)."""
+
+import pytest
+
+from kueue_tpu.fuzz import soak
+
+
+def _samples(n, **overrides):
+    base = {"tick": 0, "rss_mb": 500.0, "arena_occupancy": 0.5,
+            "arena_reuse_ratio": 0.95, "nominate_hit_ratio": 0.6,
+            "dispatches_per_tick": 1.0, "backlog": 300}
+    out = []
+    for i in range(n):
+        s = dict(base, tick=25 * (i + 1))
+        for key, fn in overrides.items():
+            s[key] = fn(i, n)
+        out.append(s)
+    return out
+
+
+def test_drift_verdict_passes_flat_curves():
+    v = soak.drift_verdict(_samples(20))
+    assert v and all(m["ok"] for m in v.values())
+
+
+def test_drift_verdict_flags_rss_leak():
+    v = soak.drift_verdict(_samples(
+        20, rss_mb=lambda i, n: 500.0 + 40.0 * i))
+    assert not v["rss_mb"]["ok"]
+    assert all(m["ok"] for k, m in v.items() if k != "rss_mb")
+
+
+def test_drift_verdict_flags_occupancy_creep():
+    v = soak.drift_verdict(_samples(
+        20, arena_occupancy=lambda i, n: min(0.2 + 0.05 * i, 1.0)))
+    assert not v["arena_occupancy"]["ok"]
+
+
+def test_drift_verdict_flags_cache_decay():
+    v = soak.drift_verdict(_samples(
+        20, nominate_hit_ratio=lambda i, n: max(0.8 - 0.05 * i, 0.0)))
+    assert not v["nominate_hit_ratio"]["ok"]
+
+
+def test_drift_verdict_flags_dispatch_rate_growth():
+    v = soak.drift_verdict(_samples(
+        20, dispatches_per_tick=lambda i, n: 0.5 + 0.3 * i))
+    assert not v["dispatches_per_tick"]["ok"]
+
+
+def test_drift_verdict_tolerates_noise_and_nones():
+    v = soak.drift_verdict(_samples(
+        20,
+        rss_mb=lambda i, n: 500.0 + (7.0 if i % 2 else -7.0),
+        arena_reuse_ratio=lambda i, n: None if i % 3 == 0 else 0.93))
+    assert all(m["ok"] for m in v.values())
+    assert soak.drift_verdict([]) == {}
+    assert soak.drift_verdict(_samples(3)) == {}
+
+
+def test_soak_smoke_brief(tmp_path):
+    """A seconds-scale soak: the loop runs, samples accumulate, the
+    report lands on disk with the environment block."""
+    report = soak.run_soak(
+        3.0, seed=1, num_cqs=8, backlog=96, sample_every=10,
+        report_path=str(tmp_path / "soak.json"))
+    assert report["ticks"] > 0
+    assert report["samples"], "no samples collected"
+    assert (tmp_path / "soak.json").exists()
+    assert report["environment"]["cpu_count"]
+    first = report["samples"][0]
+    assert first["rss_mb"] > 0
+    assert first["backlog"] >= 0
+
+
+@pytest.mark.slow
+def test_soak_long_run_has_no_monotonic_drift():
+    """The registered long soak (the `slow` marker keeps it out of
+    tier-1): default 120s here, hours-scale in `make fuzz-soak` where
+    KUEUE_FUZZ_SOAK_SECONDS drives the budget."""
+    seconds = soak.soak_seconds_from_env(default=120.0)
+    report = soak.run_soak(seconds, seed=0)
+    assert report["verdict"], "soak too short to produce a verdict"
+    bad = {k: v for k, v in report["verdict"].items() if not v["ok"]}
+    assert report["ok"], f"monotonic drift detected: {bad}"
