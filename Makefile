@@ -10,8 +10,8 @@
 PYTHON ?= python
 
 .PHONY: help test test-fast bench bench-smoke trace-smoke multichip-smoke \
-	replica-smoke multihost-smoke hetero-smoke fuzz-smoke fuzz-soak \
-	native lint verify-static install serve dryrun
+	replica-smoke multihost-smoke fleet-smoke hetero-smoke fuzz-smoke \
+	fuzz-nightly fuzz-soak native lint verify-static install serve dryrun
 
 help:
 	@echo "kueue-tpu developer targets:"
@@ -41,6 +41,14 @@ help:
 	@echo "                      SIGSTOP-watchdog drills, packet-delay"
 	@echo "                      injection, elastic scaling, and the"
 	@echo "                      multihost bench config's evidence gates"
+	@echo "  make fleet-smoke    fleet control-plane drill: TWO real OS"
+	@echo "                      worker processes --join a coordinator"
+	@echo "                      over TLS + auth token (no loopback"
+	@echo "                      emulation), coordinator killed mid-"
+	@echo "                      window -> degraded flat-cohort"
+	@echo "                      admission continues, new incarnation"
+	@echo "                      rejoin-reconciles == uninterrupted"
+	@echo "                      single-process admitted set"
 	@echo "  make fuzz-smoke     kueuefuzz CI budget: unit/corpus tests"
 	@echo "                      (incl. the oracle-mutation self-test +"
 	@echo "                      shrinker), then >= 25 seeded scenarios"
@@ -299,9 +307,57 @@ multihost-smoke:
 	    .get('revocations', 0) >= 1, rep; \
 	  rtt = rep.get('reconcile_rtt_ms') or {}; \
 	  assert rtt.get('p99') is not None, rep; \
+	  dd = rep.get('degraded_drill') or {}; \
+	  assert dd.get('degraded_window_ticks', 0) >= 3, rep; \
+	  assert dd.get('degraded_admissions', 0) > 0, rep; \
+	  assert dd.get('rejoin_revocations', 0) >= 1, rep; \
+	  assert dd.get('time_to_recover_s') is not None, rep; \
 	  print('multihost-smoke OK: rtt_p99_ms', rtt.get('p99'), \
 	        'epoch', rep.get('reconcile_epoch'), 'elastic', \
-	        el.get('actions'), 'gain', el.get('loan_throughput_gain'))"
+	        el.get('actions'), 'gain', el.get('loan_throughput_gain'), \
+	        'degraded', dd)"
+
+# Fleet control-plane smoke: two REAL OS worker processes join an
+# in-driver coordinator via `python -m kueue_tpu --join 127.0.0.1:PORT`
+# with TLS on and a shared auth token (zero loopback emulation), the
+# channel-protocol lease service + degraded-mode tests first, then the
+# kill drill: coordinator torn down mid-window with a wave pending ->
+# both workers' watchdogs + failed re-election probes drop them to
+# journaled degraded admission (flat cohorts keep admitting), a new
+# coordinator incarnation on the same port rejoin-reconciles, and the
+# final admitted set must equal the uninterrupted single-process run
+# with zero quota oversubscription. Runs in CI next to multihost-smoke.
+fleet-smoke:
+	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_lease_channel.py \
+	  tests/test_fleet.py tests/test_disk_faults.py -q -m "not slow"
+	JAX_PLATFORMS=cpu $(PYTHON) -m kueue_tpu.transport.fleet_smoke \
+	  > /tmp/kueue-fleet-smoke.jsonl
+	@cat /tmp/kueue-fleet-smoke.jsonl
+	$(PYTHON) -c "import json; \
+	  rep = json.loads(open('/tmp/kueue-fleet-smoke.jsonl').read() \
+	                   .strip().splitlines()[-1]); \
+	  assert rep['ok'] is True, rep; \
+	  assert rep['tls'] and rep['auth'], rep; \
+	  assert rep['degraded_admissions'] > 0, rep; \
+	  assert rep['degraded_window_ticks'] >= 3, rep; \
+	  assert rep['admitted'] == 12, rep; \
+	  print('fleet-smoke OK: recover', rep['time_to_recover_s'], 's,', \
+	        rep['degraded_admissions'], 'degraded admissions over', \
+	        rep['degraded_window_ticks'], 'ticks')"
+
+# Nightly fuzz budget: the campaign WITH the multi-HOST socket lattice
+# points (real framed TCP replica drives, clean + seeded packet faults)
+# — excluded from fuzz-smoke's 25-seed CI budget by cost, run here and
+# in the soak instead.
+fuzz-nightly:
+	JAX_PLATFORMS=cpu $(PYTHON) -m kueue_tpu.fuzz --seeds 12 \
+	  --lattice socket --out /tmp/kueue-fuzz-nightly.json
+	$(PYTHON) -c "import json; \
+	  rep = json.load(open('/tmp/kueue-fuzz-nightly.json')); \
+	  assert rep['violations'] == [], rep['violations'][:3]; \
+	  ax = rep['lattice_axes']; \
+	  assert 'socket' in ax.get('transports', []), ax; \
+	  print('fuzz-nightly OK:', rep['scenarios'], 'scenarios, axes', ax)"
 
 # kueuefuzz CI budget (the acceptance gate): the unit + corpus + soak
 # tests first — including the oracle-mutation self-test, which proves the

@@ -1036,6 +1036,105 @@ def _multihost_elastic_drill(ticks: int = 24, n_cqs: int = 48,
     return evidence
 
 
+def _multihost_degraded_drill(window_s: float = 1.5, n_cqs: int = 6,
+                              cpu: int = 6) -> dict:
+    """The degraded-window drill: the coordinator goes SILENT for the
+    whole window (>= K self-ticks on every replica) while flat-cohort
+    admission keeps flowing shard-locally under the journaled safe
+    mode; it then comes back knowing a SMALLER quota on a third of the
+    ClusterQueues, so the rejoin reconcile must REVOKE (newest-first,
+    counted) — with the zero-oversubscription gate held at milli-unit
+    resolution throughout the recovery. Records the four acceptance
+    numbers: degraded_window_ticks, degraded_admissions,
+    rejoin_revocations, time_to_recover_s."""
+    from kueue_tpu.api.types import (
+        ClusterQueue, FlavorQuotas, LocalQueue, PodSet, ResourceFlavor,
+        ResourceGroup, Workload)
+    from kueue_tpu.controllers.replica_runtime import ReplicaRuntime
+    from kueue_tpu.controllers.store import KIND_CLUSTER_QUEUE, MODIFIED
+
+    def cq_spec(i, c):
+        return ClusterQueue(
+            name=f"dg-cq-{i}", resource_groups=(ResourceGroup(
+                covered_resources=("cpu",),
+                flavors=(FlavorQuotas.make("default", cpu=c),)),))
+
+    rt = ReplicaRuntime(2, spawn=False, engine="host", solver=False,
+                        transport="socket", degraded_after=0.3)
+    try:
+        rt.create_resource_flavor(ResourceFlavor.make("default"))
+        for i in range(n_cqs):
+            rt.create_cluster_queue(cq_spec(i, cpu))
+            rt.create_local_queue(LocalQueue(
+                name=f"dg-lq-{i}", namespace="default",
+                cluster_queue=f"dg-cq-{i}"))
+        half = cpu // 2
+        for i in range(n_cqs):
+            rt.submit(Workload(
+                name=f"dg-old-{i}", namespace="default",
+                queue_name=f"dg-lq-{i}", creation_time=float(i),
+                pod_sets=[PodSet.make("ps0", count=1, cpu=half)]))
+        for _ in range(2):
+            rt.tick()
+        for i in range(n_cqs):
+            rt.submit(Workload(
+                name=f"dg-new-{i}", namespace="default",
+                queue_name=f"dg-lq-{i}", creation_time=float(100 + i),
+                pod_sets=[PodSet.make("ps0", count=1, cpu=half)]))
+        rt.degraded_window(window_s)
+        # The restarted coordinator's config halves a third of the CQs:
+        # their degraded-window admission no longer fits.
+        shrunk = list(range(0, n_cqs, 3))
+        for i in shrunk:
+            spec = cq_spec(i, half)
+            rt._cq_specs[spec.name] = spec
+            rt.coordinator.note_cluster_queue(spec)
+        t0 = time.perf_counter()
+        ev = rt.rejoin()
+        for i in shrunk:
+            rt.apply_event(KIND_CLUSTER_QUEUE, MODIFIED,
+                           obj=rt._cq_specs[f"dg-cq-{i}"])
+        rt.tick()  # first post-recovery barrier tick
+        recover_s = time.perf_counter() - t0
+        # Zero-oversubscription gate at MILLI-unit resolution, post-
+        # recovery AND after two more settle ticks.
+        caps = {f"dg-cq-{i}": (half if i in shrunk else cpu) * 1000
+                for i in range(n_cqs)}
+        for _ in range(3):
+            for name, usage in rt.dump()["usage"].items():
+                used = sum(usage.get("default", {}).values())
+                if used > caps[name]:
+                    raise RuntimeError(
+                        f"[multihost] degraded drill OVERSUBSCRIBED "
+                        f"{name}: {used} > {caps[name]} milli-units")
+            rt.tick()
+        evidence = {
+            "degraded_window_ticks": ev["degraded_window_ticks"],
+            "degraded_admissions": ev["degraded_admissions"],
+            "degraded_workers": ev["degraded_workers"],
+            "parked": ev["parked"],
+            "rejoin_revocations": ev["rejoin_revocations"],
+            "time_to_recover_s": round(recover_s, 3),
+            "window_s": window_s,
+        }
+    finally:
+        rt.close()
+    if evidence["degraded_window_ticks"] < 3:
+        raise RuntimeError(
+            "[multihost] the degraded window ran fewer than 3 self-"
+            f"ticks ({evidence}); the safe mode never engaged.")
+    if evidence["degraded_admissions"] <= 0:
+        raise RuntimeError(
+            "[multihost] flat-cohort admission throughput did NOT stay "
+            f"> 0 during the degraded window ({evidence}).")
+    if evidence["rejoin_revocations"] < 1:
+        raise RuntimeError(
+            "[multihost] the quota shrink produced no rejoin "
+            f"revocation ({evidence}); the catch-up reconcile is not "
+            "replaying the degraded window.")
+    return evidence
+
+
 def run_replica_config(*, label, replicas, num_cqs, num_cohorts,
                        num_flavors, backlog, ticks, usage_fill, seed=42,
                        spawn=True, warmup=12, transport="pipe",
@@ -1587,6 +1686,8 @@ def run_one(config: str) -> None:
                 spawn=not smoke,
                 n_cqs=48 if smoke else 240,
                 backlog_per_cq=6 if smoke else 8)
+            degraded = _multihost_degraded_drill(
+                window_s=1.5 if smoke else 4.0)
             if smoke:
                 shape = dict(num_cqs=48, num_cohorts=12, num_flavors=4,
                              backlog=768)
@@ -1613,6 +1714,7 @@ def run_one(config: str) -> None:
             "forced_revocation_drill": drill,
             "kill_drill": kill_drill,
             "elastic_drill": elastic,
+            "degraded_drill": degraded,
         })
         if s.get("coordinator_failover") is None:
             raise RuntimeError(

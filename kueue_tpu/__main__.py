@@ -121,12 +121,56 @@ def build_parser() -> argparse.ArgumentParser:
                         help="coordinator bind address for the socket "
                         "transport (port 0 = ephemeral; defaults to "
                         "transport.listen, 127.0.0.1:0)")
+    parser.add_argument("--join", default=None, metavar="HOST:PORT",
+                        help="run as a WORKER-ONLY process: dial the "
+                        "remote coordinator at HOST:PORT, identify via "
+                        "hello, receive the shard-group assignment + "
+                        "admin-object seed over the channel, and serve "
+                        "the tick barrier (journals land under this "
+                        "host's --state-dir)")
+    parser.add_argument("--remote-workers", action="store_true",
+                        help="with --replicas N: do NOT spawn local "
+                        "replicas — wait for N remote workers to "
+                        "--join this coordinator's --listen address")
+    parser.add_argument("--join-timeout", type=float, default=60.0,
+                        help="seconds to wait for remote workers to "
+                        "join (--remote-workers) or for the "
+                        "assignment (--join)")
+    parser.add_argument("--degraded-after", type=float, default=None,
+                        metavar="SECONDS",
+                        help="worker-side watchdog: after this much "
+                        "coordinator silence (and a failed re-election "
+                        "probe) drop to journaled degraded admission — "
+                        "flat cohorts keep admitting shard-locally, "
+                        "split roots park (default 5s for --join "
+                        "workers, off otherwise)")
+    parser.add_argument("--tls-cert", default=None, metavar="FILE",
+                        help="TLS certificate: served by the "
+                        "coordinator's listener (with --tls-key), "
+                        "trusted as the CA pin by --join workers")
+    parser.add_argument("--tls-key", default=None, metavar="FILE",
+                        help="TLS private key for the coordinator "
+                        "listener")
+    parser.add_argument("--auth-token", default=None,
+                        help="shared token carried in channel hellos; "
+                        "the listener rejects (counts + logs) hellos "
+                        "that do not present it")
+    parser.add_argument("--node-name", default=None,
+                        help="this worker's fleet identity for --join "
+                        "(default: hostname-pid)")
     parser.add_argument("--leader-elect", action="store_true",
                         help="join lease-based leader election")
     parser.add_argument("--lease-file", default=None,
                         help="shared lease file for cross-process leader "
                         "election (defaults to <state-dir>/leases.json; "
                         "put it on the mount all replicas share)")
+    parser.add_argument("--lease-server", default=None,
+                        metavar="HOST:PORT",
+                        help="lease arbitration over the channel "
+                        "protocol instead of a shared file: dial the "
+                        "LeaseService riding this coordinator "
+                        "listener (no shared filesystem needed; "
+                        "honors --tls-cert/--auth-token)")
     parser.add_argument("--state-dir", default=None,
                         help="directory for the durable state journal; the "
                         "process recovers admitted/pending workloads from "
@@ -172,10 +216,21 @@ def _replica_main(args, cfg, n_replicas: int) -> int:
                 "(want host:port, port 0 for ephemeral)")
     elif transport == "socket":
         listen = cfg.transport.listen_addr()
-    rt = ReplicaRuntime(n_replicas, spawn=True, state_dir=args.state_dir,
+    if args.remote_workers and transport != "socket":
+        transport = "socket"  # remote workers only exist on the wire
+        if listen is None:
+            listen = cfg.transport.listen_addr()
+    rt = ReplicaRuntime(n_replicas,
+                        spawn=not args.remote_workers,
+                        state_dir=args.state_dir,
                         solver=args.batch_solver,
                         trace=bool(args.trace_out),
                         transport=transport, listen=listen,
+                        remote=args.remote_workers,
+                        join_timeout=args.join_timeout,
+                        degraded_after=args.degraded_after,
+                        tls_cert=args.tls_cert, tls_key=args.tls_key,
+                        auth_token=args.auth_token,
                         faults=parse_fault_env(cfg.transport.faults))
     store = Store()
     ReplicaStoreBridge(store, rt)
@@ -221,6 +276,22 @@ def _replica_main(args, cfg, n_replicas: int) -> int:
               file=sys.stderr)
     for err in errors:
         print(f"apply error: {err}", file=sys.stderr)
+
+    if args.remote_workers:
+        # Fleet restart path: the joined workers may have served a
+        # DEGRADED window while no coordinator existed. Now that the
+        # manifests are applied (the capacity map is current), run the
+        # catch-up reconcile BEFORE the first tick — it collects each
+        # worker's degraded report and revokes whatever the merged
+        # capacity no longer fits. A fresh fleet answers with empty
+        # reports; the call is harmless.
+        ev = rt.rejoin()
+        if ev.get("degraded_workers"):
+            print(f"rejoin reconcile: {ev['degraded_admissions']} "
+                  f"degraded admissions over "
+                  f"{ev['degraded_window_ticks']} ticks, "
+                  f"{ev['rejoin_revocations']} revoked",
+                  file=sys.stderr, flush=True)
 
     total_admitted = 0
     try:
@@ -269,11 +340,41 @@ def _replica_main(args, cfg, n_replicas: int) -> int:
     return 1 if errors else 0
 
 
+def _parse_hostport(spec: str, flag: str) -> tuple:
+    try:
+        host, _, port = spec.rpartition(":")
+        return (host or "127.0.0.1", int(port))
+    except (ValueError, TypeError):
+        raise SystemExit(f"{flag}: invalid address {spec!r} "
+                         "(want host:port)")
+
+
+def _join_main(args) -> int:
+    """Worker-only fleet process (`--join HOST:PORT`)."""
+    from kueue_tpu.controllers.replica_runtime import worker_join_main
+
+    state_dir = args.state_dir
+    if state_dir:
+        os.makedirs(state_dir, exist_ok=True)
+    return worker_join_main(
+        _parse_hostport(args.join, "--join"),
+        state_dir=state_dir,
+        tls_cafile=args.tls_cert,
+        auth_token=args.auth_token,
+        node=args.node_name,
+        join_timeout=args.join_timeout,
+        degraded_after=(args.degraded_after
+                        if args.degraded_after is not None else 5.0))
+
+
 def main(argv: Optional[Sequence[str]] = None) -> int:
     args = build_parser().parse_args(argv)
 
     cfg = config_mod.load(args.config) if args.config else config_mod.Configuration()
     _parse_feature_gates(args.feature_gates)
+
+    if args.join:
+        return _join_main(args)
 
     if args.trace_out:
         from kueue_tpu.tracing import TRACER
@@ -348,7 +449,21 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         lease_path = args.lease_file or (
             os.path.join(args.state_dir, "leases.json")
             if args.state_dir else None)
-        if lease_path:
+        if args.lease_server:
+            # Channel-protocol election: the CAS lives behind a
+            # LeaseService (another coordinator's listener) — no
+            # shared filesystem between the candidates.
+            from kueue_tpu.transport.lease_channel import ChannelLeaseStore
+
+            tls_ctx = None
+            if args.tls_cert:
+                from kueue_tpu.transport.security import client_tls_context
+
+                tls_ctx = client_tls_context(args.tls_cert)
+            lease_store = ChannelLeaseStore(
+                _parse_hostport(args.lease_server, "--lease-server"),
+                tls_context=tls_ctx, auth_token=args.auth_token)
+        elif lease_path:
             # Cross-process election: the lease lives on a shared mount
             # (the etcd analog), so a standby replica actually defers.
             from kueue_tpu.controllers.leaderelection import FileLeaseStore

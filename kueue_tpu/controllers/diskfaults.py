@@ -1,0 +1,157 @@
+"""Injectable DISK faults for durable-journal drills.
+
+The seeded-schedule twin of transport/faults.py, aimed at the failures
+a real fleet's disks actually throw at an append-only journal:
+
+  * enospc — the append raises OSError(ENOSPC) before any byte lands
+    (a full volume). The event is lost exactly as an unacknowledged
+    write is lost; the error is counted in
+    kueue_journal_write_errors_total, never swallowed.
+  * fsync  — the data write lands but fsync raises (EIO). The line MAY
+    survive a crash; durability of that one record is unknown — which
+    is precisely what replay's torn/complete distinction absorbs.
+  * torn   — only a PREFIX of the line reaches the file and the writer
+    "crashes" (TornWrite raised after the partial write). This is the
+    power-cut mid-append shape; attach-time replay must truncate the
+    torn tail and recover every complete record.
+
+A `DiskFaultPlan` is a pure function of (seed, path, append ordinal),
+so a drill replays bit-identically — same discipline as the transport
+plans, same reason (the soak and the regression fixtures must be
+reproducible).
+
+Opt-in only: `KUEUE_TPU_DISK_FAULTS="enospc_p=0.01,torn_p=0.005,
+fsync_p=0.01,seed=7"` (or a plan passed to `Journal(faults=...)`).
+"""
+
+from __future__ import annotations
+
+import errno
+import random
+import zlib
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+# Append dispositions.
+PASS = "pass"
+ENOSPC = "enospc"
+FSYNC = "fsync"
+TORN = "torn"
+
+
+class TornWrite(OSError):
+    """A torn trailing write: the partial prefix is on disk and the
+    writer is considered crashed for this record (the injection's
+    stand-in for power loss mid-append)."""
+
+
+@dataclass(frozen=True)
+class DiskFaultPlan:
+    seed: int = 0
+    enospc_prob: float = 0.0
+    fsync_prob: float = 0.0
+    torn_prob: float = 0.0
+
+    @property
+    def active(self) -> bool:
+        return (self.enospc_prob > 0 or self.fsync_prob > 0
+                or self.torn_prob > 0)
+
+    def injector(self, path: str) -> Optional["DiskFaultInjector"]:
+        return DiskFaultInjector(self, path) if self.active else None
+
+    def to_dict(self) -> Dict[str, float]:
+        return {"seed": self.seed, "enospc_prob": self.enospc_prob,
+                "fsync_prob": self.fsync_prob, "torn_prob": self.torn_prob}
+
+    @classmethod
+    def from_dict(cls, d: Optional[dict]) -> Optional["DiskFaultPlan"]:
+        if not d:
+            return None
+        return cls(seed=int(d.get("seed", 0)),
+                   enospc_prob=float(d.get("enospc_prob", 0.0)),
+                   fsync_prob=float(d.get("fsync_prob", 0.0)),
+                   torn_prob=float(d.get("torn_prob", 0.0)))
+
+
+def parse_disk_fault_env(spec: Optional[str]) -> Optional[DiskFaultPlan]:
+    """Parse `KUEUE_TPU_DISK_FAULTS` ("enospc_p=0.01,fsync_p=0.02,
+    torn_p=0.005,seed=7"); None/empty disables."""
+    if not spec:
+        return None
+    keys = {"enospc_p": "enospc_prob", "fsync_p": "fsync_prob",
+            "torn_p": "torn_prob", "seed": "seed"}
+    kw: Dict[str, float] = {}
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        name, _, val = part.partition("=")
+        field_name = keys.get(name.strip())
+        if field_name is None:
+            raise ValueError(
+                f"KUEUE_TPU_DISK_FAULTS: unknown knob {name.strip()!r} "
+                f"(known: {', '.join(sorted(keys))})")
+        kw[field_name] = float(val)
+    if "seed" in kw:
+        kw["seed"] = int(kw["seed"])
+    plan = DiskFaultPlan(**kw)
+    return plan if plan.active else None
+
+
+@dataclass
+class DiskFaultStats:
+    enospc: int = 0
+    fsyncs: int = 0
+    torn: int = 0
+    schedule: List[str] = field(default_factory=list)
+
+    def to_dict(self) -> dict:
+        return {"enospc": self.enospc, "fsyncs": self.fsyncs,
+                "torn": self.torn}
+
+
+class DiskFaultInjector:
+    """Per-journal deterministic fault schedule (crc32-of-path mixed
+    with the plan seed — never `hash()`, which is salted per process)."""
+
+    def __init__(self, plan: DiskFaultPlan, path: str):
+        self.plan = plan
+        self.path = path
+        self._rnd = random.Random(
+            plan.seed * 1_000_003
+            + zlib.crc32(str(path).encode("utf-8")))
+        self.stats = DiskFaultStats()
+
+    def next_action(self) -> str:
+        """Disposition for the next append. Draw order fixed (enospc,
+        torn, fsync) so the schedule reproduces."""
+        rnd = self._rnd
+        plan = self.plan
+        action = PASS
+        if rnd.random() < plan.enospc_prob:
+            action = ENOSPC
+        elif rnd.random() < plan.torn_prob:
+            action = TORN
+        elif rnd.random() < plan.fsync_prob:
+            action = FSYNC
+        stats = self.stats
+        if action == ENOSPC:
+            stats.enospc += 1
+        elif action == TORN:
+            stats.torn += 1
+        elif action == FSYNC:
+            stats.fsyncs += 1
+        stats.schedule.append(action)
+        return action
+
+    def torn_prefix_len(self, line_len: int) -> int:
+        """How many bytes of the line land before the 'power cut' (at
+        least 1, never the whole line + newline)."""
+        return max(1, int(self._rnd.random() * line_len))
+
+    def enospc_error(self) -> OSError:
+        return OSError(errno.ENOSPC, "No space left on device (injected)")
+
+    def fsync_error(self) -> OSError:
+        return OSError(errno.EIO, "fsync failed (injected)")

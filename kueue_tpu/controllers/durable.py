@@ -46,7 +46,10 @@ COMPACT_MIN_LINES = 2000
 class Journal:
     """Append-only event log attached to a Store."""
 
-    def __init__(self, path: str, fsync: Optional[bool] = None):
+    def __init__(self, path: str, fsync: Optional[bool] = None,
+                 faults=None):
+        from kueue_tpu.controllers.diskfaults import parse_disk_fault_env
+
         self.path = path
         self.fsync = (os.environ.get("KUEUE_TPU_DURABLE_FSYNC") == "1"
                       if fsync is None else fsync)
@@ -55,6 +58,24 @@ class Journal:
         self._lines = 0
         self._store: Optional[Store] = None
         self._owner_lock_file = None
+        # Seeded disk-fault injection (diskfaults.py): a DiskFaultPlan,
+        # a prebuilt injector, or the KUEUE_TPU_DISK_FAULTS env knob.
+        # None (the default, env unset) injects nothing.
+        if faults is None:
+            faults = parse_disk_fault_env(
+                os.environ.get("KUEUE_TPU_DISK_FAULTS"))
+        self.faults = (faults.injector(path)
+                       if faults is not None and hasattr(faults, "injector")
+                       else faults)
+        # Durability bookkeeping for torn-tail repair: the file offset
+        # after the last append KNOWN to be complete. A failed append
+        # truncates back to it before the next record, so a torn prefix
+        # can never glue onto a later line.
+        self._good_offset = 0
+        self._dirty_tail = False
+        self.write_errors = 0
+        self.replay_skipped = 0
+        self.torn_tail_recovered = 0
         # Replication tap (transport/replication.py): every recorded
         # line is mirrored as ("append", line), every compaction as
         # ("reset", [lines]) — the multi-host runtime ships these
@@ -104,18 +125,51 @@ class Journal:
     def _replay(self, store: Store) -> int:
         if not os.path.exists(self.path):
             return 0
-        with open(self.path, "r", encoding="utf-8") as f:
-            for line in f:
-                line = line.strip()
-                if not line:
+        import sys
+
+        from kueue_tpu.metrics import REGISTRY
+
+        # Parse with byte offsets so a torn TRAILING line can be
+        # truncated off the file (not just skipped: a skipped-but-kept
+        # torn prefix would glue onto the next append and corrupt BOTH
+        # records), while a torn/corrupt MID-file line — which cannot be
+        # a crash artifact of append-only writing — is skipped, counted
+        # and logged, never silently absorbed.
+        parsed = []  # (start_offset, entry_or_None)
+        offset = 0
+        with open(self.path, "rb") as f:
+            for raw in f:
+                start = offset
+                offset += len(raw)
+                text = raw.decode("utf-8", errors="replace").strip()
+                if not text:
                     continue
                 try:
-                    entry = json.loads(line)
-                except json.JSONDecodeError:
-                    # A torn final line from a crash mid-append: the write
-                    # was never acknowledged; drop it.
-                    continue
-                self._apply(store, entry)
+                    parsed.append((start, json.loads(text)))
+                except ValueError:
+                    parsed.append((start, None))
+        torn_at = None
+        if parsed and parsed[-1][1] is None:
+            torn_at, _ = parsed.pop()
+        for start, entry in parsed:
+            if entry is None:
+                self.replay_skipped += 1
+                REGISTRY.journal_write_errors_total.inc("corrupt-replay")
+                print(f"kueue-tpu: journal {self.path}: skipped a "
+                      f"corrupt mid-file line at byte {start}",
+                      file=sys.stderr, flush=True)
+                continue
+            self._apply(store, entry)
+        if torn_at is not None:
+            # The crash-mid-append artifact: the record was never
+            # acknowledged, so dropping it is correct — and truncating
+            # it keeps the file appendable (no glued lines).
+            with open(self.path, "r+b") as f:
+                f.truncate(torn_at)
+            self.torn_tail_recovered += 1
+            print(f"kueue-tpu: journal {self.path}: truncated a torn "
+                  f"trailing line at byte {torn_at}",
+                  file=sys.stderr, flush=True)
         return sum(len(store.list(kind)) for kind in KIND_ORDER)
 
     @staticmethod
@@ -147,25 +201,99 @@ class Journal:
             entry["object"] = serialization.encode(ev.kind, ev.obj)
         line = json.dumps(entry, separators=(",", ":"))
         with TRACER.lock(self._lock, "journal.lock_wait"):
-            if self._file is None:
-                # Serializing append I/O is this lock's purpose: entries
-                # must hit the journal in event order.
-                self._file = open(  # kueuelint: disable=LOCK01
-                    self.path, "a", encoding="utf-8")
-            with TRACER.span("journal.append") as sp:
-                self._file.write(line + "\n")
-                self._file.flush()
-                if self.fsync:
-                    with TRACER.span("journal.fsync"):
-                        os.fsync(self._file.fileno())
-                sp.set("bytes", len(line) + 1)
+            try:
+                self._append_locked(line)
+            except OSError as exc:
+                # The record is LOST (exactly as an unacknowledged
+                # write is lost in a crash) — but the error is counted
+                # and logged, never swallowed, and the tail is marked
+                # dirty so a torn prefix can never glue onto the next
+                # append.
+                self._dirty_tail = True
+                self._note_write_error(exc)
+                return
             self._lines += 1
             if self.sink is not None:
                 self.sink(("append", line))
             if self._lines >= COMPACT_MIN_LINES and self._store is not None:
                 live = sum(len(self._store.list(k)) for k in KIND_ORDER)
                 if live * 2 < self._lines:
-                    self._compact_locked(self._store)
+                    try:
+                        self._compact_locked(self._store)
+                    except OSError as exc:
+                        # A failed compaction (ENOSPC on the tmp file)
+                        # leaves the journal as it was; surface + retry
+                        # at the next threshold crossing.
+                        self._note_write_error(exc, reason="compact")
+
+    def _append_locked(self, line: str) -> None:
+        """One fault-injectable append. Caller holds _lock; raises
+        OSError when the record did not (completely) land."""
+        from kueue_tpu.controllers import diskfaults
+
+        if self._file is None:
+            # Serializing append I/O is this lock's purpose: entries
+            # must hit the journal in event order.
+            self._file = open(self.path, "a", encoding="utf-8")
+            self._good_offset = self._file.tell()
+        if self._dirty_tail:
+            self._repair_tail_locked()
+        injector = self.faults
+        action = injector.next_action() if injector is not None \
+            else diskfaults.PASS
+        with TRACER.span("journal.append") as sp:
+            if action == diskfaults.ENOSPC:
+                raise injector.enospc_error()
+            if action == diskfaults.TORN:
+                prefix = (line + "\n")[:injector.torn_prefix_len(
+                    len(line))]
+                self._file.write(prefix)
+                self._file.flush()
+                raise diskfaults.TornWrite(
+                    f"torn write after {len(prefix)} bytes (injected)")
+            self._file.write(line + "\n")
+            self._file.flush()
+            if self.fsync:
+                with TRACER.span("journal.fsync"):
+                    try:
+                        if action == diskfaults.FSYNC:
+                            raise injector.fsync_error()
+                        os.fsync(self._file.fileno())
+                    except OSError as exc:
+                        # The data write landed; only this record's
+                        # DURABILITY is unknown. Count it, keep it —
+                        # replay's complete/torn distinction absorbs
+                        # whichever way the disk went.
+                        self._note_write_error(exc, reason="fsync")
+            sp.set("bytes", len(line) + 1)
+        self._good_offset = self._file.tell()
+
+    def _repair_tail_locked(self) -> None:
+        """Truncate back to the last known-complete append (a previous
+        failed write may have left a torn prefix)."""
+        self._file.flush()
+        self._file.truncate(self._good_offset)
+        self._dirty_tail = False
+
+    def _note_write_error(self, exc: OSError,
+                          reason: Optional[str] = None) -> None:
+        import errno
+        import sys
+
+        from kueue_tpu.controllers.diskfaults import TornWrite
+        from kueue_tpu.metrics import REGISTRY
+
+        if reason is None:
+            if isinstance(exc, TornWrite):
+                reason = "torn"
+            elif getattr(exc, "errno", None) == errno.ENOSPC:
+                reason = "enospc"
+            else:
+                reason = "os-error"
+        self.write_errors += 1
+        REGISTRY.journal_write_errors_total.inc(reason)
+        print(f"kueue-tpu: journal {self.path} write failed "
+              f"({reason}): {exc}", file=sys.stderr, flush=True)
 
     # -- compaction -----------------------------------------------------------
 
@@ -195,6 +323,8 @@ class Journal:
         if self._file is not None:
             self._file.close()
         self._file = open(self.path, "a", encoding="utf-8")
+        self._good_offset = self._file.tell()
+        self._dirty_tail = False
         self._lines = lines
         if snapshot is not None:
             self.sink(("reset", snapshot))

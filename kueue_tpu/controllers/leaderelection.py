@@ -26,6 +26,16 @@ from typing import Callable, Dict, Optional
 from kueue_tpu.config import LeaderElectionConfig
 
 
+def _count_transition(name: str) -> None:
+    """Every holder change bumps kueue_lease_transitions_total — the
+    audit-trail twin of the lease's own transitions field (the metric
+    is per-process and monotonic; the field is the cross-process epoch
+    source)."""
+    from kueue_tpu.metrics import REGISTRY
+
+    REGISTRY.lease_transitions_total.inc(name)
+
+
 @dataclass
 class Lease:
     name: str
@@ -64,6 +74,7 @@ class LeaseStore:
             lease.renew_time = now
             lease.lease_duration_seconds = lease_duration
             lease.transitions += 1
+            _count_transition(name)
             return True
 
     def release(self, name: str, identity: str) -> None:
@@ -148,6 +159,7 @@ class FileLeaseStore:
             lease.update(holder=identity, acquire_time=now, renew_time=now,
                          lease_duration_seconds=lease_duration,
                          transitions=lease["transitions"] + 1)
+            _count_transition(name)
             return True, True
         return self._rmw(cas)
 

@@ -60,6 +60,7 @@ from kueue_tpu.controllers.store import (
     StoreAdapter,
 )
 from kueue_tpu.parallel.replica import (
+    SOLO_PREFIX,
     Coordinator,
     GroupMap,
     ReplicaChannel,
@@ -70,6 +71,7 @@ from kueue_tpu.parallel.replica import (
 from kueue_tpu.transport.faults import FaultPlan, parse_fault_env
 from kueue_tpu.transport.replication import JournalReplicator, host_state_dir
 from kueue_tpu.transport.socket_channel import (
+    PEER_RESTART,
     ChannelListener,
     SocketChannel,
     WorkerDiedError,
@@ -134,6 +136,10 @@ class _PipeChan:
 
     def __init__(self, conn):
         self._conn = conn
+        # A closed pipe raises IMMEDIATELY on every recv (EOF, not
+        # timeout): the worker's degraded loop must tell the two apart
+        # or a dead parent becomes a zero-delay busy-spin.
+        self._closed = False
 
     def send(self, msg) -> None:
         self._conn.send(msg)
@@ -144,6 +150,7 @@ class _PipeChan:
         try:
             return self._conn.recv()
         except (EOFError, OSError):
+            self._closed = True
             raise WorkerDied("worker pipe closed")
 
 
@@ -179,6 +186,26 @@ class ReplicaWorker:
             opts.get("barrier_deadline")
             or barrier_deadline(_ROUND_TIMEOUT))
         self._dispatches_seen = 0
+        # Degraded safe mode (fleet deployments): after this many
+        # seconds of coordinator silence — and a failed re-election
+        # probe — the worker drops to journaled shard-local admission.
+        # None (the default for single-machine runs) keeps the PR 11
+        # behavior: coordinator loss surfaces as a BarrierStallError.
+        self._degraded_after = opts.get("degraded_after")
+        self._degraded_interval = float(
+            opts.get("degraded_tick_interval")
+            or (min(float(self._degraded_after), 0.05)
+                if self._degraded_after else 0.05))
+        self._state_dir = opts.get("state_dir")
+        self.degraded = False
+        self.degraded_epoch = 0
+        self._degraded_windows = 0
+        self._degraded_ticks = 0
+        self._degraded_admitted: List[Tuple[str, str]] = []
+        self._degraded_t0: Optional[float] = None
+        self._last_epoch = int(opts.get("epoch", 0) or 0)
+        self._lease_probe = opts.get("lease_probe")  # callable or None
+        self.revoked_total = 0
         batch_solver = None
         if opts.get("solver", True):
             from kueue_tpu.models.flavor_fit import BatchSolver
@@ -201,6 +228,7 @@ class ReplicaWorker:
         self.ghost_cqs: set = set()
         self.rctx = ReplicaContext(submit=self._submit_round,
                                    usage_provider=self._cache_split_usage)
+        self.rctx.on_stall = self._maybe_degrade
         # The runtime's pre-tick exchange is the authoritative usage
         # channel; rounds ship none (a ghost view must never overwrite
         # its owner's).
@@ -312,15 +340,284 @@ class ReplicaWorker:
             name: {f: dict(res) for f, res in cqs[name].usage.items()}
             for name in memo[1] if name in cqs}
 
+    def _local_journal_path(self, gid: int) -> Optional[str]:
+        """Where THIS worker journals shard group `gid` when the
+        parent cannot name a path on our disk (remote join: journals
+        are host-local by construction)."""
+        if not self._state_dir:
+            return None
+        os.makedirs(self._state_dir, exist_ok=True)
+        return os.path.join(self._state_dir, f"journal-g{gid}.jsonl")
+
+    # -- degraded safe mode ---------------------------------------------------
+    #
+    # The coordinator is dead (watchdog silence past `degraded_after`)
+    # and the re-election probe failed: this replica keeps serving what
+    # it can PROVE safe alone. Flat cohorts are replica-complete by the
+    # shard-group hash, so their quota math never needed the
+    # coordinator — those heads keep admitting shard-locally. Split
+    # roots park with an explain reason. Every degraded tick's verdicts
+    # are journaled with a degraded-epoch stamp; the rejoin reconcile
+    # replays the window against the merged state (quota is never
+    # oversubscribed; revocations are allowed and counted).
+
+    def _maybe_degrade(self) -> bool:
+        """ReplicaContext.on_stall: a live round missed the barrier
+        deadline — park and degrade (True) or surface the stall
+        (False)?"""
+        if self.degraded:
+            return True
+        if self._degraded_after is None:
+            return False
+        if self._coordinator_presumed_dead():
+            self._enter_degraded("barrier-stall")
+            return True
+        return False
+
+    def _coordinator_presumed_dead(self) -> bool:
+        """One re-election probe. Without a lease seam (local pipe /
+        loopback workers), silence past the deadline is the only
+        signal (presume dead) — which is why `degraded_after` is OFF
+        by default for local deployments and an operator who sets it
+        must size it above the longest legitimate idle gap between
+        coordinator messages. Joined workers probe the lease service:
+        a reachable service whose lease is held means the coordinator
+        (or a successor) is alive — keep waiting."""
+        probe = self._lease_probe
+        if probe is None:
+            return True
+        try:
+            return not probe()
+        except Exception:
+            return True
+
+    def _enter_degraded(self, why: str) -> None:
+        import sys
+        import time as _time
+
+        from kueue_tpu.metrics import REGISTRY
+
+        self.degraded = True
+        self.rctx.degraded = True
+        self._degraded_windows += 1
+        self.degraded_epoch = self._last_epoch + 1
+        # Wall-clock window bookkeeping (liveness evidence), not tick-
+        # phase timing — the tracer may be disabled in a degraded
+        # worker and the window must still measure.
+        self._degraded_t0 = _time.monotonic()  # kueuelint: disable=OBS01
+        REGISTRY.coordinator_degraded.set(self.host_id, value=1.0)
+        self._djournal({"event": "enter",
+                        "degraded_epoch": self.degraded_epoch,
+                        "why": why, "host": self.host_id})
+        print(f"kueue-tpu: replica {self.worker_id} ({self.host_id}) "
+              f"entered DEGRADED admission ({why}): flat cohorts admit "
+              "shard-locally, split roots park",
+              file=sys.stderr, flush=True)
+
+    def _exit_degraded(self, why: str) -> None:
+        import sys
+        import time as _time
+
+        from kueue_tpu.metrics import REGISTRY
+
+        if not self.degraded:
+            return
+        self.degraded = False
+        self.rctx.degraded = False
+        REGISTRY.coordinator_degraded.set(self.host_id, value=0.0)
+        now = _time.monotonic()  # kueuelint: disable=OBS01
+        dur = now - (self._degraded_t0 or now)
+        self._djournal({"event": "exit",
+                        "degraded_epoch": self.degraded_epoch,
+                        "why": why, "ticks": self._degraded_ticks,
+                        "duration_s": round(dur, 3),
+                        "host": self.host_id})
+        print(f"kueue-tpu: replica {self.worker_id} ({self.host_id}) "
+              f"left degraded admission after {self._degraded_ticks} "
+              f"ticks ({why})", file=sys.stderr, flush=True)
+
+    def _degraded_tick(self) -> None:
+        """One self-paced tick of the safe mode: the same Framework
+        tick, with the replica context parking every split-root
+        candidate locally instead of shipping a round."""
+        from kueue_tpu.metrics import REGISTRY
+
+        self.tick_admitted.clear()
+        self.tick_preempted.clear()
+        parked0 = self.rctx.parked
+        self.fw.tick()
+        self.rctx.flush_tick()
+        self._degraded_ticks += 1
+        if self.tick_admitted:
+            REGISTRY.degraded_admissions_total.inc(
+                self.host_id, by=float(len(self.tick_admitted)))
+            self._degraded_admitted.extend(self.tick_admitted)
+        # Degraded verdicts are durable like every other admission:
+        # status syncs into the group journals, and the degraded
+        # journal stamps the window's trail with its epoch.
+        for _store, adapter, _journal in self.groups.values():
+            adapter.sync_status()
+        self._djournal({
+            "event": "tick", "degraded_epoch": self.degraded_epoch,
+            "tick": self._degraded_ticks,
+            "admitted": [list(p) for p in self.tick_admitted],
+            "parked": self.rctx.parked - parked0,
+            "host": self.host_id})
+
+    def _degraded_journal_path(self) -> Optional[str]:
+        if not self._state_dir:
+            return None
+        os.makedirs(self._state_dir, exist_ok=True)
+        return os.path.join(self._state_dir,
+                            f"degraded-{self.host_id}.jsonl")
+
+    def _djournal(self, entry: dict) -> None:
+        import json as _json
+
+        path = self._degraded_journal_path()
+        if path is None:
+            return
+        try:
+            with open(path, "a", encoding="utf-8") as f:
+                f.write(_json.dumps(entry, separators=(",", ":")) + "\n")
+        except OSError as exc:
+            import sys
+
+            from kueue_tpu.metrics import REGISTRY
+
+            REGISTRY.journal_write_errors_total.inc("degraded-journal")
+            print(f"kueue-tpu: degraded journal write failed: {exc}",
+                  file=sys.stderr, flush=True)
+
+    def _handle_rejoin(self, epoch: int,
+                       caps: Optional[dict] = None) -> None:
+        """The coordinator is back: leave safe mode, resolve any
+        oversubscription against the merged capacity it shipped
+        (revocations counted, newest-first), and answer with the
+        degraded window's full evidence."""
+        import time as _time
+
+        was = self.degraded
+        now = _time.monotonic()  # kueuelint: disable=OBS01
+        dur = (now - self._degraded_t0) \
+            if (was and self._degraded_t0) else 0.0
+        if was:
+            self._exit_degraded("rejoin")
+        self._last_epoch = int(epoch)
+        revoked = self._revoke_oversubscribed(caps) if caps else []
+        report = {
+            "replica": self.worker_id, "host": self.host_id,
+            "was_degraded": bool(was or self._degraded_ticks),
+            "degraded_epoch": self.degraded_epoch,
+            "windows": self._degraded_windows,
+            "ticks": self._degraded_ticks,
+            "admitted": [list(p) for p in self._degraded_admitted],
+            "parked": self.rctx.parked,
+            "revoked": revoked,
+            "duration_s": round(dur, 3),
+            "usage": {name: {f: dict(r) for f, r in cq.usage.items()}
+                      for name, cq in
+                      self.fw.cache.cluster_queues.items()
+                      if name not in self.ghost_cqs},
+        }
+        self._djournal({"event": "rejoin", "epoch": int(epoch),
+                        "revoked": revoked, "host": self.host_id})
+        # The report consumed this window's accumulators.
+        self._degraded_admitted = []
+        self._degraded_ticks = 0
+        self.rctx.parked = 0
+        self.chan.send(("degraded_report", report))
+
+    def _revoke_oversubscribed(self, caps: dict) -> List[str]:
+        """Replay the degraded window against the merged capacity: for
+        every cohort root whose total usage exceeds the CURRENT nominal
+        capacity the coordinator shipped, evict this window's newest
+        degraded admissions until it fits again. Evictions requeue, so
+        a revoked workload re-admits against the new quota the moment
+        it fits — a journaled revocation, never a silent loss."""
+        roots = caps.get("roots") or {}
+        cq_root = caps.get("cq_root") or {}
+        cache = self.fw.cache
+
+        def over(root: str) -> bool:
+            cap = roots.get(root)
+            if cap is None:
+                return False  # the coordinator models no cap: trust it
+            total: Dict[str, dict] = {}
+            for name, cq in cache.cluster_queues.items():
+                if name in self.ghost_cqs or cq_root.get(name) != root:
+                    continue
+                for f, res in cq.usage.items():
+                    d = total.setdefault(f, {})
+                    for rname, val in res.items():
+                        d[rname] = d.get(rname, 0) + val
+            for f, res in total.items():
+                for rname, val in res.items():
+                    if val > cap.get(f, {}).get(rname, 0):
+                        return True
+            return False
+
+        revoked: List[str] = []
+        for key, cq_name in reversed(self._degraded_admitted):
+            root = cq_root.get(cq_name)
+            if root is None or not over(root):
+                continue
+            wl = self.fw.workloads.get(key)
+            if wl is None or not wl.is_admitted:
+                continue
+            self.fw.evict_workload(
+                wl, reason="DegradedRejoinRevoked",
+                message="degraded-window admission revoked by the "
+                        "rejoin reconcile (merged capacity shrank)")
+            revoked.append(key)
+        if revoked:
+            self.revoked_total += len(revoked)
+            for _store, adapter, _journal in self.groups.values():
+                adapter.sync_status()
+        return revoked
+
     # -- message loop --------------------------------------------------------
 
-    def run(self) -> None:
+    def run(self) -> Optional[str]:
         while True:
-            msg = self.chan.recv()
+            try:
+                if self.degraded:
+                    msg = self.chan.recv(timeout=self._degraded_interval)
+                elif self._degraded_after is not None:
+                    msg = self.chan.recv(timeout=self._degraded_after)
+                else:
+                    msg = self.chan.recv()
+            except (WorkerDied, WorkerDiedError):
+                if self._degraded_after is None \
+                        or getattr(self.chan, "_closed", False):
+                    raise
+                # Coordinator silence past the deadline: probe the
+                # election once, then drop to (or continue) journaled
+                # shard-local admission.
+                if self.degraded:
+                    self._degraded_tick()
+                elif self._coordinator_presumed_dead():
+                    self._enter_degraded("recv-timeout")
+                continue
+            if msg == PEER_RESTART:
+                # The coordinator came back as a NEW incarnation: the
+                # old conversation is void; the join driver
+                # (worker_join_main) re-handshakes from scratch.
+                return "peer-restart"
             op = msg[0]
+            if self.degraded:
+                if op == "verdicts":
+                    continue  # stale reply from the dead incarnation
+                # Any other coordinator message means it is back. The
+                # rejoin op exits the window itself (it measures it);
+                # everything else resumes normal service first.
+                if op != "rejoin":
+                    self._exit_degraded(f"coordinator message ({op})")
             if op == "objs":
                 self._apply_batch(msg[1])
             elif op == "tick":
+                if len(msg) > 3:
+                    self._last_epoch = int(msg[3])
                 self._tick(want_status=len(msg) > 2 and bool(msg[2]))
             elif op == "pretick":
                 self.chan.send(("usage", self._cache_split_usage()))
@@ -357,6 +654,9 @@ class ReplicaWorker:
                 self._submit_many(msg[1])
             elif op == "delete_wl":
                 self._delete(msg[1])
+            elif op == "rejoin":
+                self._handle_rejoin(msg[1],
+                                    msg[2] if len(msg) > 2 else None)
             elif op == "dump":
                 self.chan.send(("dump", self._dump()))
             elif op == "trace":
@@ -521,21 +821,28 @@ class ReplicaWorker:
         return out
 
     def _release(self, gid: int, want_entries: bool = True) -> None:
-        """Give up a shard group for migration: detach its journal (the
-        flock clears, recording stops), snapshot its objects (the
-        journal-free migration channel — built only when the parent
-        asked; journal-backed adoption never reads it), then delete
-        every group-routed object from this framework — the DELETE
+        """Give up a shard group for migration (parent-requested):
+        `_drop_group` does the work; the reply carries the snapshot."""
+        self.chan.send(("released", gid,
+                        self._drop_group(gid, want_entries)))
+
+    def _drop_group(self, gid: int, want_entries: bool = True) -> dict:
+        """Detach a shard group from this worker: journal released (the
+        flock clears, recording stops), objects snapshotted (the
+        journal-free migration channel — built only when asked;
+        journal-backed adoption never reads it), then every
+        group-routed object deleted from this framework — the DELETE
         events fan through the adapter, releasing quota and pruning
         queues. Admin kinds stay: they are broadcast to every group and
-        shared by the framework."""
+        shared by the framework. Used by the migration protocol AND by
+        a rejoin assignment that took a group away (first-join-wins
+        conflict resolution keeps the single-owner invariant)."""
         from kueue_tpu.api import serialization
         from kueue_tpu.controllers.store import _obj_key
 
         group = self.groups.pop(gid, None)
         if group is None:
-            self.chan.send(("released", gid, {"ops": [], "entries": []}))
-            return
+            return {"ops": [], "entries": []}
         store, _adapter, journal = group
         ops = self._seg.pop(gid, [])
         if journal is not None:
@@ -558,7 +865,7 @@ class ReplicaWorker:
         for key in [k for k, g in self.cq_gid.items() if g == gid]:
             del self.cq_gid[key]
         self._usage_memo = None
-        self.chan.send(("released", gid, {"ops": ops, "entries": entries}))
+        return {"ops": ops, "entries": entries}
 
     def _apply_ghost(self, entry: dict) -> None:
         """Mirror a remote split-tree member into the CACHE only: its
@@ -594,6 +901,12 @@ class ReplicaWorker:
             self.fw.cache.delete_cluster_queue(name)
         self.ghost_cqs.clear()
         self._usage_memo = None
+        if journal_path is None and self._state_dir \
+                and seed and seed.get("lines") is not None:
+            # Remote adoption: the parent cannot name a path on THIS
+            # host's disk — seed the replicated lines into our own
+            # state dir instead.
+            journal_path = self._local_journal_path(gid)
         if seed and seed.get("lines") is not None and journal_path:
             # Per-host fail-over/migration: seed THIS host's local
             # journal from the coordinator's replicated copy, then
@@ -725,6 +1038,130 @@ def _worker_main(conn, worker_id: int, opts: dict) -> None:
         pass
 
 
+def worker_join_main(addr, state_dir: Optional[str] = None,
+                     tls_cafile: Optional[str] = None,
+                     auth_token: Optional[str] = None,
+                     node: Optional[str] = None,
+                     join_timeout: float = 60.0,
+                     degraded_after: Optional[float] = 5.0) -> int:
+    """`python -m kueue_tpu --join HOST:PORT`: the worker-only fleet
+    entry point. Dials the REMOTE coordinator (TLS + auth token when
+    configured), identifies via a join hello, receives its shard-group
+    assignment + admin-object seed over the channel, and runs the
+    worker loop. Survives coordinator restarts: the channel's session
+    ids surface the new incarnation, the worker re-joins carrying the
+    shard groups it already owns, and the degraded window it served in
+    between is reported to the rejoin reconcile. Returns only on stop
+    (0) or an unrecoverable join failure (1)."""
+    import socket as socket_mod
+    import sys
+
+    from kueue_tpu import features
+    from kueue_tpu.config import LeaderElectionConfig
+    from kueue_tpu.transport.lease_channel import ChannelLeaseStore
+
+    node = node or f"{socket_mod.gethostname()}-{os.getpid()}"
+    tls_ctx = None
+    if tls_cafile:
+        from kueue_tpu.transport.security import client_tls_context
+
+        tls_ctx = client_tls_context(tls_cafile)
+    addr = (addr[0], int(addr[1]))
+    chan = SocketChannel.connect(
+        addr, cid=f"join/{node}", name=f"join-{node}",
+        auth_token=auth_token, tls_context=tls_ctx,
+        restart_markers=True)
+    lease_name = LeaderElectionConfig().resource_name
+    lease_store: List[Optional[ChannelLeaseStore]] = [None]
+
+    def lease_probe() -> bool:
+        """True iff a live coordinator holds the lease: reachable
+        lease service + non-empty holder. The service rides the
+        coordinator's own listener, so 'unreachable' and 'dead
+        coordinator' coincide — which is the point."""
+        if lease_store[0] is None:
+            lease_store[0] = ChannelLeaseStore(
+                addr, identity=f"probe-{node}", tls_context=tls_ctx,
+                auth_token=auth_token,
+                timeout=min(2.0, degraded_after or 2.0))
+        store = lease_store[0]
+        holder = store.holder(lease_name)
+        return bool(holder) and store.available
+
+    worker: Optional[ReplicaWorker] = None
+    try:
+        while True:
+            chan.send(("join", {
+                "node": node, "pid": os.getpid(),
+                "groups": sorted(worker.groups)
+                if worker is not None else []}))
+            msg = None
+            while True:
+                try:
+                    msg = chan.recv(timeout=join_timeout)
+                except (WorkerDied, WorkerDiedError):
+                    print(f"kueue-tpu: --join: no assignment from "
+                          f"{addr[0]}:{addr[1]} within {join_timeout:g}s",
+                          file=sys.stderr, flush=True)
+                    return 1
+                if msg == PEER_RESTART:
+                    break  # raced a coordinator restart: re-greet
+                if isinstance(msg, (tuple, list)) and msg \
+                        and msg[0] == "assign":
+                    break
+            if msg == PEER_RESTART:
+                continue
+            _, wid, opts, gids = msg
+            for gate, val in (opts.get("gates") or {}).items():
+                try:
+                    features.set_enabled(gate, val)
+                except KeyError:
+                    pass
+            opts = {**opts, "state_dir": state_dir}
+            if degraded_after is not None:
+                opts["degraded_after"] = degraded_after
+            if worker is None:
+                worker = ReplicaWorker(wid, opts, chan)
+                worker._lease_probe = lease_probe
+            else:
+                # Re-assigned by a new coordinator incarnation: adopt
+                # the (possibly new) id and epoch; the framework state
+                # and owned groups are live and stay.
+                worker.worker_id = wid
+                worker._last_epoch = int(opts.get("epoch", 0) or 0)
+            restored = 0
+            # A rejoin assignment is AUTHORITATIVE both ways: groups
+            # the new coordinator gave to another claimant (it failed
+            # over before the restart; first-join-wins resolved against
+            # us) must be dropped here, or the same group would live on
+            # two workers and double-count usage.
+            for gid in [g for g in sorted(worker.groups)
+                        if g not in gids]:
+                worker._drop_group(gid, want_entries=False)
+                print(f"kueue-tpu: --join: dropped shard group {gid} "
+                      "(reassigned elsewhere)", file=sys.stderr,
+                      flush=True)
+            for gid in gids:
+                if gid not in worker.groups:
+                    restored += worker.add_group(
+                        gid, worker._local_journal_path(gid))
+            chan.send(("joined", wid, restored))
+            print(f"kueue-tpu: joined coordinator at "
+                  f"{addr[0]}:{addr[1]} as worker {wid} "
+                  f"(groups {sorted(worker.groups)})",
+                  file=sys.stderr, flush=True)
+            if worker.run() != "peer-restart":
+                return 0
+            print("kueue-tpu: --join: coordinator restarted; "
+                  "re-joining", file=sys.stderr, flush=True)
+    except (EOFError, OSError, KeyboardInterrupt,
+            WorkerDied, WorkerDiedError):
+        return 0
+    finally:
+        if lease_store[0] is not None:
+            lease_store[0].close()
+
+
 # ---------------------------------------------------------------------------
 # Parent runtime
 # ---------------------------------------------------------------------------
@@ -747,6 +1184,7 @@ class _WorkerHandle:
         self.wid = wid
         self.alive = True
         self.spawn = spawn
+        self.remote = False
         self.host_id = opts.get("host_id") or f"host-{wid}"
         self.pid: Optional[int] = None
         # True once a worker_error message arrived: the worker CRASHED
@@ -811,6 +1249,28 @@ class _WorkerHandle:
                 target=run, name=f"replica-{wid}", daemon=True)
             self.thread.start()
 
+    @classmethod
+    def remote(cls, wid: int, chan, host_id: str,
+               pid: Optional[int] = None) -> "_WorkerHandle":
+        """A worker that JOINED over the wire (`--join`): the handle is
+        just its listener endpoint — no process or thread to supervise.
+        Liveness is protocol liveness: a remote worker that misses a
+        barrier deadline is declared dead by the watchdog exactly as a
+        stalled local process is (its shard groups then fail over via
+        the replicated journals)."""
+        self = cls.__new__(cls)
+        self.wid = wid
+        self.alive = True
+        self.spawn = False
+        self.remote = True
+        self.host_id = host_id
+        self.pid = pid
+        self.crashed = False
+        self.chan = chan
+        self.proc = None
+        self.thread = None
+        return self
+
     def send(self, msg) -> None:
         self.chan.send(msg)
 
@@ -829,6 +1289,8 @@ class _WorkerHandle:
     def is_alive(self) -> bool:
         if not self.alive:
             return False
+        if self.remote:
+            return True  # liveness is decided at the barrier
         if self.proc is not None:
             return self.proc.is_alive()
         return self.thread.is_alive()
@@ -836,12 +1298,21 @@ class _WorkerHandle:
     def os_alive(self) -> bool:
         """Is the underlying process/thread still RUNNING (stalled
         counts as alive — the watchdog's stall-vs-crash distinction)?"""
+        if self.remote:
+            return self.chan.connected if hasattr(
+                self.chan, "connected") else False
         if self.proc is not None:
             return self.proc.is_alive()
         return self.thread is not None and self.thread.is_alive()
 
     def kill(self) -> None:
         self.alive = False
+        if self.remote:
+            try:
+                self.chan.send(("stop",))
+            except Exception:
+                pass
+            return
         if self.proc is not None:
             self.proc.kill()
             self.proc.join(timeout=10)
@@ -876,7 +1347,12 @@ class ReplicaRuntime:
                  listen: Optional[tuple] = None,
                  per_host: Optional[bool] = None,
                  faults: Optional[FaultPlan] = None,
-                 n_groups: Optional[int] = None):
+                 n_groups: Optional[int] = None,
+                 remote: bool = False, join_timeout: float = 60.0,
+                 degraded_after: Optional[float] = None,
+                 tls_cert: Optional[str] = None,
+                 tls_key: Optional[str] = None,
+                 auth_token: Optional[str] = None):
         from kueue_tpu import features
         from kueue_tpu.config import LeaderElectionConfig
         from kueue_tpu.controllers.leaderelection import (
@@ -886,6 +1362,10 @@ class ReplicaRuntime:
             raise ValueError(f"replicas must be >= 1, got {replicas}")
         self.n = replicas
         self.spawn = spawn
+        self.remote = remote
+        if remote and transport != "socket":
+            transport = "socket"  # remote workers only exist on the wire
+        self.degraded_after = degraded_after
         self.state_dir = state_dir
         # An EXPLICIT transport argument wins over the generic
         # KUEUE_TPU_TRANSPORT default; only the documented kill switch
@@ -897,6 +1377,14 @@ class ReplicaRuntime:
         else:
             self.transport = transport if transport in ("pipe", "socket") \
                 else "pipe"
+        if remote and self.transport != "socket":
+            # The KUEUE_TPU_NO_SOCKET=1 kill switch forced pipes, but
+            # remote workers only exist on the wire: fail loudly
+            # instead of crashing later on a listener that was never
+            # created.
+            raise RuntimeError(
+                "remote worker join requires the socket transport; "
+                "unset KUEUE_TPU_NO_SOCKET or drop --remote-workers")
         # Per-host state: each replica journals in its OWN directory
         # (the socket transport's default — real hosts share nothing)
         # with coordinator-owned replication; pipe mode keeps PR 9's
@@ -907,9 +1395,20 @@ class ReplicaRuntime:
             faults = parse_fault_env(os.environ.get("KUEUE_TPU_FAULTS"))
         self.faults = faults
         self.listener: Optional[ChannelListener] = None
+        self._join_q: "queue.Queue" = queue.Queue()
+        self.tls_cert = tls_cert
+        self.auth_token = auth_token
+        server_tls = None
+        if tls_cert and tls_key:
+            from kueue_tpu.transport.security import server_tls_context
+
+            server_tls = server_tls_context(tls_cert, tls_key)
         if self.transport == "socket":
             host, port = listen or ("127.0.0.1", 0)
-            self.listener = ChannelListener(host, port, plan=faults)
+            self.listener = ChannelListener(
+                host, port, plan=faults, tls_context=server_tls,
+                auth_token=auth_token,
+                on_hello=self._on_join_hello if remote else None)
         self.replicator: Optional[JournalReplicator] = None
         if self.per_host and state_dir:
             self.replicator = JournalReplicator(
@@ -926,6 +1425,16 @@ class ReplicaRuntime:
             lease_store, identity=identity or f"coordinator-{os.getpid()}",
             config=LeaderElectionConfig(enable=True))
         self.elector.step()
+        # Lease arbitration rides the control-plane port: any channel
+        # whose cid starts with "lease/" gets the CAS — the workers'
+        # re-election probe, a standby coordinator's ChannelLeaseStore,
+        # and the no-shared-fs equivalence suite all dial this.
+        self.lease_service = None
+        if self.listener is not None:
+            from kueue_tpu.transport.lease_channel import LeaseService
+
+            self.lease_service = LeaseService(lease_store).attach(
+                self.listener)
         self.coordinator = Coordinator(
             journal_path=os.path.join(state_dir, "coordinator.jsonl")
             if state_dir else None,
@@ -939,23 +1448,37 @@ class ReplicaRuntime:
             "connect": list(self.listener.address)
             if self.listener is not None else None,
             "faults": faults.to_dict() if faults is not None else None,
+            "degraded_after": degraded_after,
+            "epoch": self.coordinator.epoch,
+            "auth_token": auth_token,
             # Spawned workers run their own TRACER; loopback threads
             # share this process's (already configured by the caller).
             "trace": trace and spawn,
             "gates": {g: features.enabled(g) for g in features.all_gates()}
-            if spawn else None,
+            if (spawn or remote) else None,
         }
         self._opts = opts
-        self.group_owner: Dict[int, int] = {
-            g: g % replicas for g in range(n_groups)}
-        self.workers = [
-            _WorkerHandle(w, spawn, {**opts, "host_id": f"host-{w}"},
-                          groups=[(g, self._journal_path(g, wid=w))
-                                  for g in range(n_groups)
-                                  if g % replicas == w],
-                          listener=self.listener)
-            for w in range(replicas)
-        ]
+        if remote:
+            # Fleet mode: the replicas are REMOTE processes that dial
+            # in (`python -m kueue_tpu --join HOST:PORT`); the join
+            # wait runs at the END of construction (it needs the admin
+            # spec retention below for rejoin seeding).
+            self.group_owner: Dict[int, int] = {}
+            self.workers: List[_WorkerHandle] = []
+        else:
+            self.group_owner = {
+                g: g % replicas for g in range(n_groups)}
+            self.workers = [
+                _WorkerHandle(w, spawn,
+                              {**opts, "host_id": f"host-{w}",
+                               "state_dir": self._worker_state_dir(
+                                   f"host-{w}")},
+                              groups=[(g, self._journal_path(g, wid=w))
+                                      for g in range(n_groups)
+                                      if g % replicas == w],
+                              listener=self.listener)
+                for w in range(replicas)
+            ]
         self.pen: Dict[str, List[tuple]] = {}   # "ns/lq" -> queued entries
         self.wl_group: Dict[str, int] = {}
         self._cq_specs: Dict[str, object] = {}
@@ -977,6 +1500,9 @@ class ReplicaRuntime:
             f"kueue-tpu: {err}", file=__import__("sys").stderr, flush=True)
         self._coord_kill_pending = False
         self.failover_evidence: Optional[dict] = None
+        self.degraded_evidence: Optional[dict] = None
+        if remote:
+            self._await_joins(replicas, join_timeout)
         # Set by ReplicaStoreBridge: the parent deployment's read-surface
         # Store. When present, each tick asks workers for the statuses
         # they published this round and mirrors them here so GET/watch
@@ -1010,6 +1536,218 @@ class ReplicaRuntime:
             return os.path.join(d, f"journal-g{gid}.jsonl")
         os.makedirs(self.state_dir, exist_ok=True)
         return os.path.join(self.state_dir, f"journal-g{gid}.jsonl")
+
+    def _worker_state_dir(self, host_id: str) -> Optional[str]:
+        """Where one worker keeps its own non-group durable state (the
+        degraded journal): its host dir in per-host mode, the shared
+        dir otherwise, None without a state dir."""
+        if not self.state_dir:
+            return None
+        if self.per_host:
+            return host_state_dir(self.state_dir, host_id)
+        os.makedirs(self.state_dir, exist_ok=True)
+        return self.state_dir
+
+    # -- remote worker join (the --join fleet path) ---------------------------
+
+    def _on_join_hello(self, cid, chan) -> None:
+        if isinstance(cid, str) and cid.startswith("join/"):
+            self._join_q.put((cid, chan))
+
+    def _await_joins(self, n: int, timeout: float) -> None:
+        """Collect N remote workers: each dials the listener, greets
+        with ("join", {node, pid, groups}) and receives ("assign", wid,
+        opts, gids) + the admin-object seed back. A REJOINING worker
+        (the coordinator restarted, not the worker) reports the shard
+        groups it already owns and keeps them — its framework state is
+        live and its journals are local; reassigning would orphan
+        both."""
+        import sys
+        import time as _time
+
+        addr = self.listener.address
+        print(f"kueue-tpu: coordinator listening on "
+              f"{addr[0]}:{addr[1]}; waiting for {n} workers to --join",
+              file=sys.stderr, flush=True)
+        # Join-wait deadline arithmetic, not tick-phase timing.
+        deadline = _time.monotonic() + timeout  # kueuelint: disable=OBS01
+        joined: List[tuple] = []  # (cid, chan, info)
+        while len(joined) < n:
+            remaining = deadline \
+                - _time.monotonic()  # kueuelint: disable=OBS01
+            if remaining <= 0:
+                raise RuntimeError(
+                    f"fleet join timed out: {len(joined)}/{n} workers "
+                    f"joined within {timeout:g}s")
+            try:
+                cid, chan = self._join_q.get(timeout=remaining)
+            except queue.Empty:
+                continue
+            try:
+                msg = chan.recv(timeout=min(10.0, max(remaining, 0.1)))
+            except WorkerDiedError:
+                continue
+            if not isinstance(msg, (tuple, list)) or not msg \
+                    or msg[0] != "join":
+                continue
+            joined.append((cid, chan, msg[1] or {}))
+        # Group assignment: rejoiners keep their reported groups; the
+        # rest round-robin over the remaining workers. CONFLICTING
+        # claims (a group failed over to worker B before the restart,
+        # then both A and B rejoin reporting it) resolve first-join-
+        # wins deterministically — and the loser DROPS the group when
+        # its assignment comes back without it (worker_join_main),
+        # preserving the single-owner invariant.
+        taken: Dict[int, int] = {}
+        for idx, (_cid, _chan, info) in enumerate(joined):
+            for g in info.get("groups") or ():
+                taken.setdefault(int(g), idx)
+        assigns: Dict[int, List[int]] = {i: [] for i in range(n)}
+        for g, idx in taken.items():
+            assigns[idx].append(g)
+        free = [g for g in range(self.n_groups) if g not in taken]
+        for g in free:
+            idx = min(assigns, key=lambda i: (len(assigns[i]), i))
+            assigns[idx].append(g)
+        for wid, (cid, chan, info) in enumerate(joined):
+            host = info.get("node") or str(cid)[len("join/"):]
+            handle = _WorkerHandle.remote(wid, chan, host_id=host,
+                                          pid=info.get("pid"))
+            gids = sorted(assigns[wid])
+            handle.send(("assign", wid,
+                         {**self._opts, "host_id": host}, gids))
+            reply = handle.recv(timeout=self.round_timeout
+                                if hasattr(self, "round_timeout")
+                                else 60.0)
+            if reply[0] != "joined":
+                raise RuntimeError(
+                    f"fleet join protocol violation from {host}: "
+                    f"{reply[0]!r}")
+            self.workers.append(handle)
+            for g in gids:
+                self.group_owner[g] = wid
+            self._seed_admin(handle, gids)
+            print(f"kueue-tpu: worker {wid} joined from {host} "
+                  f"(pid {info.get('pid')}, groups {gids}, "
+                  f"restored {reply[2] if len(reply) > 2 else 0})",
+                  file=__import__("sys").stderr, flush=True)
+
+    def _seed_admin(self, handle: "_WorkerHandle",
+                    gids: List[int]) -> None:
+        """Ship the retained admin specs to a late joiner: flavors and
+        cohorts to every owned group (each group journal must stay
+        self-contained), ClusterQueues to the group they hash to.
+        Workload/LocalQueue state rides the group journals (local
+        replay or the coordinator's replicated copy) — never this
+        seed."""
+        if not gids:
+            return
+        batch: List[tuple] = []
+        for rf in self._flavor_specs.values():
+            entry = self._entry(KIND_RESOURCE_FLAVOR, rf)
+            batch.extend((g, entry) for g in gids)
+        for spec in self._cohort_spec_objs.values():
+            entry = self._entry(KIND_COHORT, spec)
+            batch.extend((g, entry) for g in gids)
+        for name, spec in self._cq_specs.items():
+            gid = self.gmap.cq_group.get(name)
+            if gid in gids:
+                batch.append((gid, self._entry(KIND_CLUSTER_QUEUE,
+                                               spec)))
+        if batch:
+            handle.send(("objs", batch))
+
+    # -- degraded window: rejoin + catch-up reconcile -------------------------
+
+    def _root_caps(self) -> dict:
+        """The merged capacity view for the rejoin reconcile: nominal
+        quota per cohort root (milli-unit resolution, straight off the
+        retained CURRENT specs) + each ClusterQueue's root. Degraded
+        windows admit against possibly-stale local specs; replaying
+        their verdicts against THIS map is what makes
+        quota-never-oversubscribed an invariant rather than a hope."""
+        cq_root: Dict[str, str] = {}
+        roots: Dict[str, dict] = {}
+        for name, spec in self._cq_specs.items():
+            cohort = self.gmap.cq_cohort.get(name) or spec.cohort
+            root = (self.gmap.root_of(cohort) if cohort
+                    else f"{SOLO_PREFIX}{name}")
+            cq_root[name] = root
+            dst = roots.setdefault(root, {})
+            for rg_ in spec.resource_groups:
+                for fq in rg_.flavors:
+                    d = dst.setdefault(fq.name, {})
+                    for rname, quota in fq.resources:
+                        d[rname] = d.get(rname, 0) + quota.nominal
+        return {"roots": roots, "cq_root": cq_root}
+
+    def rejoin(self) -> dict:
+        """Catch-up reconcile after a degraded window (or a coordinator
+        restart): every live worker leaves safe mode, replays its
+        degraded admissions against the merged capacity map (revoking
+        newest-first where the window oversubscribed — counted, never
+        silent), and reports the window's evidence. Returns the
+        aggregated evidence block."""
+        caps = self._root_caps()
+        with self._lock:
+            live = [w for w in self.workers if w.alive]
+            for w in live:
+                w.send(("rejoin", self.coordinator.epoch, caps))
+            reports = []
+            for w in live:
+                deadline_misses = 0
+                while True:
+                    try:
+                        msg = w.recv(timeout=self.round_timeout)
+                    except WorkerDied:
+                        w.alive = False
+                        break
+                    if msg[0] == "degraded_report":
+                        reports.append(msg[1])
+                        break
+                    # Stale barrier traffic from the degraded window
+                    # (an unanswered round, a late done): drain it.
+                    deadline_misses += 1
+                    if deadline_misses > 64:
+                        w.alive = False
+                        break
+            evidence = self._fold_degraded_reports(reports)
+            self.degraded_evidence = evidence
+            return evidence
+
+    def _fold_degraded_reports(self, reports: List[dict]) -> dict:
+        return {
+            "workers": len(reports),
+            "degraded_workers": sum(
+                1 for r in reports if r.get("was_degraded")),
+            "degraded_window_ticks": max(
+                (r.get("ticks", 0) for r in reports), default=0),
+            "degraded_admissions": sum(
+                len(r.get("admitted") or ()) for r in reports),
+            "parked": sum(r.get("parked", 0) for r in reports),
+            "rejoin_revocations": sum(
+                len(r.get("revoked") or ()) for r in reports),
+            "revoked_keys": sorted(
+                k for r in reports for k in (r.get("revoked") or ())),
+            "window_s": max(
+                (r.get("duration_s", 0.0) for r in reports),
+                default=0.0),
+            "epoch": self.coordinator.epoch,
+            "reports": reports,
+        }
+
+    def degraded_window(self, seconds: float) -> None:
+        """Drill hook: the coordinator goes silent for `seconds` while
+        the workers' own deadlines fire and they self-tick in safe mode
+        (requires the runtime to have been built with
+        `degraded_after`). Call `rejoin()` afterwards to run the
+        catch-up reconcile."""
+        import time as _time
+
+        if self.degraded_after is None:
+            raise RuntimeError(
+                "degraded_window needs ReplicaRuntime(degraded_after=…)")
+        _time.sleep(seconds)
 
     # -- routing -------------------------------------------------------------
 
@@ -1416,7 +2154,9 @@ class ReplicaRuntime:
                     for w in live:
                         w.send(("ghost_usage", merged))
             for w in live:
-                w.send(("tick", self.tick_no, self.status_store is not None))
+                w.send(("tick", self.tick_no,
+                        self.status_store is not None,
+                        self.coordinator.epoch))
             rounds = []
             for w in live:
                 msg = self._barrier_recv(w, "round", "round", stalls)
@@ -1517,8 +2257,11 @@ class ReplicaRuntime:
         per-host mode ships the coordinator's replicated journal lines
         (the adopter cannot read the old owner's disk); shared-dir mode
         hands over the released/orphaned file itself; journal-less
-        deployments ship the releasing owner's object snapshot."""
-        path = self._journal_path(gid, wid=to_wid)
+        deployments ship the releasing owner's object snapshot. A
+        REMOTE adopter derives its own local path (the coordinator
+        cannot name a file on another host's disk)."""
+        path = (None if self.workers[to_wid].remote
+                else self._journal_path(gid, wid=to_wid))
         if self.replicator is not None:
             if released is not None:
                 # The owner's final unshipped segments land first.
@@ -1630,7 +2373,9 @@ class ReplicaRuntime:
         with self._lock:
             wid = len(self.workers)
             self.workers.append(_WorkerHandle(
-                wid, self.spawn, {**self._opts, "host_id": f"host-{wid}"},
+                wid, self.spawn,
+                {**self._opts, "host_id": f"host-{wid}",
+                 "state_dir": self._worker_state_dir(f"host-{wid}")},
                 groups=[], listener=self.listener))
             return wid
 
@@ -1736,18 +2481,43 @@ class ReplicaRuntime:
     def reconcile_info(self) -> dict:
         """The SIGUSR2 Dumper's reconcile view: barrier round + epoch,
         per-shard-group backlog depth (the elastic signal), group
-        ownership, and stall evidence."""
-        return {
+        ownership, stall evidence, the fleet topology (remote joins),
+        and the last degraded window's catch-up evidence."""
+        from kueue_tpu.metrics import REGISTRY
+
+        out = {
             "tick": self.tick_no,
             "round": self.coordinator.rounds,
             "epoch": self.coordinator.epoch,
             "transport": self.transport,
+            "remoteWorkers": self.remote,
             "backlogDepth": {str(g): n for g, n
                              in sorted(self.backlog_last.items())},
             "groupOwner": {str(g): w for g, w
                            in sorted(self.group_owner.items())},
             "stalls": self.stall_count,
+            "hosts": {str(w.wid): {"host": w.host_id, "pid": w.pid,
+                                   "alive": w.alive,
+                                   "remote": w.remote}
+                      for w in self.workers},
+            "degradedHosts": {
+                host: gauge for (host,), gauge in sorted(
+                    REGISTRY.coordinator_degraded.values.items())
+                if gauge},
+            "leaseTransitions": {
+                lease: int(count) for (lease,), count in sorted(
+                    REGISTRY.lease_transitions_total.values.items())},
+            "journalWriteErrors": {
+                reason: int(count) for (reason,), count in sorted(
+                    REGISTRY.journal_write_errors_total.values.items())},
         }
+        if self.listener is not None:
+            out["rejectedHellos"] = self.listener.rejected_hellos
+        if self.degraded_evidence is not None:
+            out["degradedWindow"] = {
+                k: v for k, v in self.degraded_evidence.items()
+                if k != "reports"}
+        return out
 
     # -- introspection -------------------------------------------------------
 

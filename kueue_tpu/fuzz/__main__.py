@@ -31,17 +31,19 @@ def _pin_cpu_backend() -> None:
 
 
 def run_campaign(seeds: int, start_seed: int, out: str,
-                 shrink_on_failure: bool = True) -> int:
+                 shrink_on_failure: bool = True,
+                 include_socket: bool = False) -> int:
     from kueue_tpu.fuzz import generator, lattice, shrink
     from kueue_tpu.utils.envinfo import environment_block
 
     reports = []
     all_violations = []
     axes_seen = {"engines": set(), "shards": set(), "replicas": set(),
-                 "kill_switches": set(), "drills": set()}
+                 "kill_switches": set(), "drills": set(),
+                 "transports": set()}
     for seed in range(start_seed, start_seed + seeds):
         sc = generator.draw_scenario(seed)
-        report = lattice.check_scenario(sc)
+        report = lattice.check_scenario(sc, include_socket=include_socket)
         for ax in report["axes"]:
             axes_seen["engines"].add(ax["engine"])
             axes_seen["shards"].add(ax["shards"])
@@ -49,6 +51,8 @@ def run_campaign(seeds: int, start_seed: int, out: str,
             axes_seen["kill_switches"].add(ax["kill_switches"])
             if ax["drill"]:
                 axes_seen["drills"].add(ax["drill"])
+            if ax.get("transport"):
+                axes_seen["transports"].add(ax["transport"])
         reports.append(report)
         status = "ok" if not report["violations"] else "DIVERGED"
         print(f"# seed {seed}: {status} "
@@ -127,7 +131,18 @@ def main(argv=None) -> int:
     ap.add_argument("--soak", type=float, metavar="SECONDS",
                     help="run the long-run churn soak instead of "
                          "fuzzing")
+    ap.add_argument("--lattice", choices=("default", "socket"),
+                    default="default",
+                    help="'socket' adds the multi-HOST lattice points "
+                         "(real TCP replica drives + seeded packet "
+                         "faults) — the make fuzz-nightly budget, "
+                         "excluded from the 25-seed CI smoke")
     args = ap.parse_args(argv)
+    if args.lattice == "socket" and (args.corpus or args.soak is not None):
+        # The soak is a churn drive, not a lattice campaign: silently
+        # accepting the flag would report ok with zero socket coverage.
+        ap.error("--lattice socket applies to campaign mode only "
+                 "(run `make fuzz-nightly` for the socket budget)")
     if args.corpus:
         return run_corpus(args.corpus)
     if args.soak is not None:
@@ -142,7 +157,8 @@ def main(argv=None) -> int:
             flush=True)
         return 0 if report["ok"] else 1
     return run_campaign(args.seeds, args.start_seed, args.out,
-                        shrink_on_failure=not args.no_shrink)
+                        shrink_on_failure=not args.no_shrink,
+                        include_socket=args.lattice == "socket")
 
 
 if __name__ == "__main__":

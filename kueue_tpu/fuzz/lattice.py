@@ -51,13 +51,23 @@ class LatticePoint:
     kill_switches: bool = False    # incremental fast paths OFF
     drill: Optional[str] = None    # None | "failover" | "loan"
     env: tuple = ()                # extra (key, value) env pairs
+    # Replica-point transport: None = the loopback queue pairs (the
+    # smoke default); "socket" = the real framed TCP channel, with
+    # seeded packet faults when `socket_faults` — the multi-HOST
+    # lattice point. Budget-gated (--lattice socket / make fuzz-nightly):
+    # a socket drive pays listener + reconnect machinery per scenario,
+    # too much for the 25-seed smoke budget.
+    transport: Optional[str] = None
+    socket_faults: bool = False
 
     def axes(self) -> dict:
         return {"engine": self.engine or ("referee" if
                                           self.kind == "referee"
                                           else "host"),
                 "shards": self.shards, "replicas": self.replicas,
-                "kill_switches": self.kill_switches, "drill": self.drill}
+                "kill_switches": self.kill_switches, "drill": self.drill,
+                "transport": self.transport or
+                ("loopback" if self.kind == "replica" else None)}
 
 
 class TickClock:
@@ -86,14 +96,34 @@ def _shards_available(n: int) -> bool:
         return False
 
 
-def default_lattice(sc: Scenario) -> List[LatticePoint]:
+def socket_points(sc: Scenario) -> List[LatticePoint]:
+    """The multi-HOST lattice points (budget-gated: `--lattice
+    socket` / `make fuzz-nightly`, never the 25-seed smoke): the same replica
+    drive over the REAL framed TCP channel — once clean, once under
+    seeded packet delay + reorder faults (drop adds reconnect churn on
+    a rotating third of seeds). Decision identity must hold across all
+    of it: the transport is exactly-once in-order by construction, and
+    these points are where that claim meets the fuzzer."""
+    if not sc.replica_safe():
+        return []
+    pts = [LatticePoint(name="socket", kind="replica", replicas=2,
+                        transport="socket")]
+    pts.append(LatticePoint(name="socket-faults", kind="replica",
+                            replicas=2, transport="socket",
+                            socket_faults=True))
+    return pts
+
+
+def default_lattice(sc: Scenario,
+                    include_socket: bool = False) -> List[LatticePoint]:
     """The smoke lattice for one scenario: engine x shards {1,2} x
     replicas {1,2} x one kill-switch set, plus drill points on a
     rotating third of the seeds. Hetero scenarios swap the sequential
     referee for a KUEUE_TPU_DEBUG_HETERO reference (the hetero referee
     asserts device-vs-sequential identity INSIDE every tick); scenarios
     outside the documented replica-identity envelope skip the replica
-    points (scenario.replica_safe)."""
+    points (scenario.replica_safe). `include_socket` appends the
+    multi-HOST socket points (see socket_points — nightly budget)."""
     points: List[LatticePoint] = []
     if sc.policy.get("hetero"):
         points.append(LatticePoint(
@@ -128,6 +158,8 @@ def default_lattice(sc: Scenario) -> List[LatticePoint]:
             points.append(LatticePoint(name="elastic-loan",
                                        kind="replica", replicas=2,
                                        drill="loan"))
+    if include_socket:
+        points.extend(socket_points(sc))
     return points
 
 
@@ -412,9 +444,20 @@ def _drive_replica(sc: Scenario, point: LatticePoint,
     if point.drill == "failover" and state_dir is None:
         tmp = tempfile.TemporaryDirectory(prefix="kueuefuzz-journal-")
         state_dir = tmp.name
+    faults = None
+    if point.socket_faults:
+        from kueue_tpu.transport.faults import FaultPlan
+
+        # Seeded per scenario: identical schedule on every re-drive
+        # (shrinking included). Drop only on a rotating third — it
+        # severs connections, which is reconnect churn, not decisions.
+        faults = FaultPlan(seed=sc.seed, delay_ms=1.0, delay_prob=0.3,
+                           reorder_prob=0.1,
+                           drop_prob=0.02 if sc.seed % 3 == 0 else 0.0)
     rt = ReplicaRuntime(
         point.replicas, spawn=False, engine=point.engine,
         state_dir=state_dir if point.drill == "failover" else None,
+        transport=point.transport, faults=faults,
         n_groups=(2 * point.replicas if point.drill == "loan" else None))
     st = _TrafficState()
     cq_specs = {c["name"]: c for c in sc.cluster_queues}
@@ -540,14 +583,16 @@ def _first_divergence(ref_trail, got_trail, admitted_only: bool):
 
 def check_scenario(sc: Scenario,
                    points: Optional[List[LatticePoint]] = None,
-                   keep_results: bool = False) -> dict:
+                   keep_results: bool = False,
+                   include_socket: bool = False) -> dict:
     """Drive `sc` across the lattice and return the oracle report:
     {"seed", "points", "violations": [...], "axes"}. An empty
     violations list means every oracle held at every point.
     `keep_results=True` attaches each point's raw drive result under
     "results" (the corpus replay reads the reference drive from there
     instead of paying a second one)."""
-    points = points if points is not None else default_lattice(sc)
+    points = points if points is not None else default_lattice(
+        sc, include_socket=include_socket)
     results: Dict[str, dict] = {}
     violations: List[dict] = []
     for p in points:

@@ -220,6 +220,31 @@ class Registry:
             p + "reconcile_round_epoch",
             "Coordinator incarnation (lease transitions) arbitrating "
             "reconcile rounds")
+        # Fleet-grade control plane: degraded-mode admission (the
+        # coordinator is dead and no re-election succeeded — replicas
+        # keep admitting flat cohorts shard-locally under a journaled
+        # safe mode), disk-fault hardening on the durable journals, the
+        # lease-transition audit trail, and listener hello rejections
+        # (TLS / auth / malformed greetings on the control-plane port).
+        self.coordinator_degraded = Gauge(
+            p + "coordinator_degraded",
+            "1 while this replica admits in degraded (coordinator-"
+            "unreachable) safe mode, 0 otherwise", ("host",))
+        self.degraded_admissions_total = Counter(
+            p + "degraded_admissions_total",
+            "Workloads admitted shard-locally during degraded windows",
+            ("host",))
+        self.journal_write_errors_total = Counter(
+            p + "journal_write_errors_total",
+            "Durable-journal append failures surfaced (not swallowed)",
+            ("reason",))
+        self.lease_transitions_total = Counter(
+            p + "lease_transitions_total",
+            "Lease holder changes (the coordinator epoch source)",
+            ("lease",))
+        self.channel_rejected_hellos_total = Counter(
+            p + "channel_rejected_hellos_total",
+            "Hellos the ChannelListener rejected", ("reason",))
         # TPU-build additions: per-tick phase timings.
         self.tick_phase_seconds = Histogram(
             p + "tick_phase_seconds",

@@ -49,6 +49,7 @@ from typing import Callable, Dict, FrozenSet, List, Optional, Protocol
 
 from kueue_tpu.core.cache import CachedClusterQueue, Cohort, frq_add
 from kueue_tpu.core.hierarchy import fits_in_hierarchy
+from kueue_tpu.transport.watchdog import BarrierStallError
 
 SOLO_PREFIX = "__solo__/"
 
@@ -189,15 +190,40 @@ class ReplicaContext:
         # a replica must never ship its (one-exchange-stale) ghost view
         # of a member another replica owns.
         self.ship_usage = True
+        # Degraded safe mode (the coordinator is unreachable and no
+        # re-election succeeded): reconcile goes SHARD-LOCAL — every
+        # split-root candidate parks (all-False verdicts, no channel
+        # traffic), flat-cohort admission continues untouched because
+        # it never needed the coordinator's arithmetic in the first
+        # place. `on_stall` (set by the owning worker) is consulted
+        # when a live round misses the barrier deadline: returning True
+        # flips the context into degraded mode instead of raising.
+        self.degraded = False
+        self.parked = 0
+        self.on_stall: Optional[Callable[[], bool]] = None
 
     def reconcile(self, candidates: List[dict],
                   usage: Dict[str, dict]) -> List[bool]:
         from kueue_tpu.tracing import trace_now
 
         self.tick_submitted = True
+        if self.degraded:
+            self.parked += len(candidates)
+            return [False] * len(candidates)
         self.rounds += 1
         t0 = trace_now()
-        verdicts = self._submit({"candidates": candidates, "usage": usage})
+        try:
+            verdicts = self._submit({"candidates": candidates,
+                                     "usage": usage})
+        except BarrierStallError:
+            if self.on_stall is not None and self.on_stall():
+                # The worker confirmed the coordinator is presumed dead:
+                # park this round's candidates and finish the cycle in
+                # degraded mode rather than unwinding mid-admission.
+                self.degraded = True
+                self.parked += len(candidates)
+                return [False] * len(candidates)
+            raise
         self.rtt_samples.append(trace_now() - t0)
         return verdicts
 

@@ -1620,16 +1620,24 @@ class Scheduler:
             sp.set("deferred", len(deferred))
             sp.set("round", rctx.rounds)
         revoked = 0
+        # Degraded safe mode parks split-root entries with an explain
+        # reason that says so (the coordinator's merged arithmetic is
+        # unavailable, not lost to a priority race) and counts no
+        # revocations — nothing was arbitrated.
+        parked = bool(getattr(rctx, "degraded", False))
+        deny_msg = ("parked: degraded mode (coordinator unreachable); "
+                    "split-root admission awaits the rejoin reconcile"
+                    if parked else
+                    "other workloads in the cohort were prioritized")
         for (e, cq, mode), cand, ok in zip(deferred, cands, verdicts):
             if ok:
                 commit(e, cq, mode)
             else:
                 e.status = SKIPPED
-                e.inadmissible_msg = \
-                    "other workloads in the cohort were prioritized"
+                e.inadmissible_msg = deny_msg
                 e.info.last_assignment = None
                 self.metrics.skipped += 1
-                if cand["opt_ok"]:
+                if cand["opt_ok"] and not parked:
                     revoked += 1
         self.metrics.reconcile_revocations += revoked
 

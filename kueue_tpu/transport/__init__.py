@@ -13,7 +13,12 @@ The pieces that let the PR 9 replica runtime leave the single machine:
   * watchdog      — BarrierStallError: the stalling pid/host/round
                     surfaced instead of a silent hang;
   * elastic       — backlog-driven replica scaling + Aryl-style
-                    capacity loaning over the group-reassignment seam.
+                    capacity loaning over the group-reassignment seam;
+  * security      — TLS contexts + shared-token auth for the listener
+                    (rejected hellos counted and logged);
+  * lease_channel — lease CAS over the channel protocol (LeaseService
+                    riding the listener + ChannelLeaseStore client),
+                    so coordinator election needs no shared filesystem.
 
 Kill switch: KUEUE_TPU_NO_SOCKET=1 forces the pipe transport
 everywhere (the runtime falls back to PR 9's multiprocessing pipes).
@@ -25,6 +30,11 @@ from kueue_tpu.transport.faults import (
     FaultPlan,
     parse_fault_env,
 )
+from kueue_tpu.transport.lease_channel import (
+    ChannelLeaseStore,
+    LeaseService,
+    LeaseUnavailable,
+)
 from kueue_tpu.transport.framing import (
     FrameDecoder,
     FrameError,
@@ -33,7 +43,14 @@ from kueue_tpu.transport.framing import (
     encode_message,
 )
 from kueue_tpu.transport.replication import JournalReplicator, host_state_dir
+from kueue_tpu.transport.security import (
+    client_tls_context,
+    generate_self_signed,
+    openssl_available,
+    server_tls_context,
+)
 from kueue_tpu.transport.socket_channel import (
+    PEER_RESTART,
     ChannelClosed,
     ChannelListener,
     SocketChannel,
@@ -44,6 +61,7 @@ from kueue_tpu.transport.watchdog import BarrierStallError, barrier_deadline
 __all__ = [
     "BarrierStallError",
     "ChannelClosed",
+    "ChannelLeaseStore",
     "ChannelListener",
     "ElasticController",
     "FaultInjector",
@@ -51,12 +69,19 @@ __all__ = [
     "FrameDecoder",
     "FrameError",
     "JournalReplicator",
+    "LeaseService",
+    "LeaseUnavailable",
+    "PEER_RESTART",
     "SocketChannel",
     "WorkerDiedError",
     "barrier_deadline",
+    "client_tls_context",
     "decode_message",
     "encode_frame",
     "encode_message",
+    "generate_self_signed",
     "host_state_dir",
+    "openssl_available",
     "parse_fault_env",
+    "server_tls_context",
 ]

@@ -1,0 +1,215 @@
+"""`make fleet-smoke`: the zero-emulation fleet drill.
+
+Two REAL operating-system worker processes (no loopback threads, no
+per-host directory emulation) join an in-process coordinator over
+`python -m kueue_tpu --join 127.0.0.1:PORT` with TLS and a shared auth
+token. The drill then does to the control plane exactly what a fleet
+does:
+
+  1. admit a first wave over the wire (identity with single-process);
+  2. kill the coordinator mid-window (listener torn down, object
+     dropped) with a second wave pending;
+  3. hold it dead while both workers' watchdogs fire, their
+     re-election probes fail, and they drop to journaled DEGRADED
+     admission — the second wave (flat cohorts) must keep admitting;
+  4. start a NEW coordinator incarnation on the same port: the
+     workers' channels detect the fresh session id, re-join carrying
+     the shard groups they own, and serve their degraded reports;
+  5. the rejoin reconcile replays the degraded window against merged
+     state — and the final admitted set must equal an uninterrupted
+     single-process run (zero revocations here: nothing shrank).
+
+Exits 0 with a JSON summary line on success, 1 with a reason on any
+violated gate.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+
+N_CQS = 6
+CPU = 6
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _build(t):
+    from kueue_tpu.api.types import (
+        ClusterQueue, FlavorQuotas, LocalQueue, ResourceFlavor,
+        ResourceGroup)
+
+    t.create_resource_flavor(ResourceFlavor.make("default"))
+    for i in range(N_CQS):
+        t.create_cluster_queue(ClusterQueue(
+            name=f"fs-cq-{i}", resource_groups=(ResourceGroup(
+                covered_resources=("cpu",),
+                flavors=(FlavorQuotas.make("default", cpu=CPU),)),)))
+        t.create_local_queue(LocalQueue(
+            name=f"fs-lq-{i}", namespace="default",
+            cluster_queue=f"fs-cq-{i}"))
+
+
+def _submit_wave(t, tag, base_time):
+    from kueue_tpu.api.types import PodSet, Workload
+
+    for i in range(N_CQS):
+        t.submit(Workload(
+            name=f"fs-{tag}-{i}", namespace="default",
+            queue_name=f"fs-lq-{i}", creation_time=base_time + i,
+            pod_sets=[PodSet.make("ps0", count=1, cpu=3)]))
+
+
+def _single_process_reference():
+    from kueue_tpu.config import Configuration, TPUSolverConfig
+    from kueue_tpu.controllers.runtime import Framework
+
+    fw = Framework(batch_solver=None, config=Configuration(
+        tpu_solver=TPUSolverConfig(enable=False)))
+    fw.create_namespace("default", labels={})
+    _build(fw)
+    _submit_wave(fw, "a", 0.0)
+    _submit_wave(fw, "b", 100.0)
+    fw.run_until_settled(max_ticks=10)
+    return {name: sorted(cq.workloads)
+            for name, cq in fw.cache.cluster_queues.items()
+            if cq.workloads}
+
+
+def _fail(msg: str, procs=()) -> int:
+    for p in procs:
+        p.kill()
+    print(json.dumps({"metric": "fleet_smoke", "ok": False,
+                      "reason": msg}), flush=True)
+    return 1
+
+
+def main() -> int:
+    from kueue_tpu.controllers.replica_runtime import ReplicaRuntime
+    from kueue_tpu.transport.security import (generate_self_signed,
+                                              openssl_available)
+
+    if not openssl_available():
+        return _fail("openssl CLI unavailable; fleet-smoke requires TLS")
+    repo_root = os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    token = "fleet-smoke-token"
+    td = tempfile.mkdtemp(prefix="kueue-fleet-smoke-")
+    cert, key = generate_self_signed(os.path.join(td, "pki"))
+    port = _free_port()
+
+    procs = []
+    for i in range(2):
+        procs.append(subprocess.Popen(
+            [sys.executable, "-m", "kueue_tpu",
+             "--join", f"127.0.0.1:{port}",
+             "--state-dir", os.path.join(td, f"worker-{i}"),
+             "--tls-cert", cert, "--auth-token", token,
+             "--node-name", f"smoke-{i}",
+             "--degraded-after", "0.5",
+             "--join-timeout", "300"],
+            env={**os.environ, "JAX_PLATFORMS": "cpu",
+                 "PYTHONPATH": repo_root},
+            cwd=repo_root))
+    print(f"# fleet-smoke: 2 worker processes "
+          f"(pids {[p.pid for p in procs]}) joining "
+          f"127.0.0.1:{port} over TLS", file=sys.stderr, flush=True)
+
+    def coordinator(state_tag):
+        return ReplicaRuntime(
+            2, remote=True, transport="socket",
+            listen=("127.0.0.1", port), engine="host", solver=False,
+            state_dir=os.path.join(td, state_tag),
+            tls_cert=cert, tls_key=key, auth_token=token,
+            join_timeout=240.0, degraded_after=0.5)
+
+    expect = _single_process_reference()
+    try:
+        rt = coordinator("coord-1")
+    except RuntimeError as exc:
+        return _fail(f"join phase failed: {exc}", procs)
+    hosts = sorted(w.host_id for w in rt.workers)
+    if hosts != ["smoke-0", "smoke-1"]:
+        return _fail(f"wrong fleet joined: {hosts}", procs)
+    _build(rt)
+    _submit_wave(rt, "a", 0.0)
+    for _ in range(4):
+        rt.tick()
+    wave1 = sum(len(v) for v in rt.dump()["admitted"].values())
+    if wave1 != N_CQS:
+        return _fail(f"wave 1 admitted {wave1} != {N_CQS}", procs)
+    rejected = rt.listener.rejected_hellos
+
+    # -- the kill: second wave pending, coordinator dies ---------------------
+    _submit_wave(rt, "b", 100.0)
+    time.sleep(0.3)  # let the routed objs drain to the workers
+    rt.listener.close()
+    print("# fleet-smoke: coordinator KILLED; holding it dead while "
+          "the workers degrade", file=sys.stderr, flush=True)
+    t_dead = time.monotonic()
+    time.sleep(4.0)  # watchdogs fire, probes fail, safe mode admits
+
+    # -- the new incarnation -------------------------------------------------
+    try:
+        rt2 = coordinator("coord-2")
+    except RuntimeError as exc:
+        return _fail(f"re-join phase failed: {exc}", procs)
+    _build(rt2)  # a restarted coordinator re-applies its manifests
+    ev = rt2.rejoin()
+    recover_s = time.monotonic() - t_dead
+    if ev["degraded_workers"] < 1:
+        return _fail(f"no worker entered degraded mode: {ev}", procs)
+    if ev["degraded_admissions"] <= 0:
+        return _fail(
+            "flat-cohort admission did not continue during the "
+            f"degraded window: {ev}", procs)
+    for _ in range(4):
+        rt2.tick()
+    dump = rt2.dump()
+    got = {name: sorted(keys)
+           for name, keys in dump["admitted"].items() if keys}
+    if got != expect:
+        return _fail(
+            f"post-rejoin admitted set diverged from the uninterrupted "
+            f"single-process run: {got} != {expect}", procs)
+    for name, usage in dump["usage"].items():
+        used = sum(usage.get("default", {}).values())
+        if used > CPU * 1000:
+            return _fail(f"quota oversubscribed on {name}: {used} "
+                         f"milli-units > {CPU * 1000}", procs)
+    rejected += rt2.listener.rejected_hellos
+    rt2.close()  # stops the workers cooperatively
+    for p in procs:
+        try:
+            p.wait(timeout=30)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            return _fail("a worker did not stop on close", procs)
+    summary = {
+        "metric": "fleet_smoke", "ok": True,
+        "workers": hosts,
+        "tls": True, "auth": True,
+        "rejected_hellos": rejected,
+        "admitted": sum(len(v) for v in got.values()),
+        "degraded_window_ticks": ev["degraded_window_ticks"],
+        "degraded_admissions": ev["degraded_admissions"],
+        "rejoin_revocations": ev["rejoin_revocations"],
+        "time_to_recover_s": round(recover_s, 2),
+    }
+    print(json.dumps(summary), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

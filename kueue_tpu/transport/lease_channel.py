@@ -1,0 +1,213 @@
+"""Lease arbitration over the channel protocol: no shared filesystem.
+
+`FileLeaseStore` (controllers/leaderelection.py) gives kube-style lease
+CAS across processes that share a mount — which a real fleet does not
+have. This module moves the same compare-and-swap onto the framed
+channel protocol:
+
+  * `LeaseService` — the arbitration authority. It owns one in-process
+    `LeaseStore` (or any store with the same interface, e.g. a
+    `FileLeaseStore` for durability across coordinator restarts) and
+    answers lease RPCs from any channel whose cid starts with
+    ``lease/``. Attach it to the coordinator's existing
+    `ChannelListener`: lease traffic rides the same port, TLS and
+    auth-token guards included.
+
+  * `ChannelLeaseStore` — the client. Same interface as
+    `LeaseStore`/`FileLeaseStore` (`try_acquire_or_renew`, `release`,
+    `holder`, `transitions`), implemented as blocking request/response
+    over a `SocketChannel`. An unreachable service NEVER reports
+    acquisition: `try_acquire_or_renew` returns False on timeout (a
+    candidate that cannot confirm the CAS must not lead), `release` is
+    best-effort, and `holder`/`transitions` fall back to the last
+    confirmed value (with `available` False so callers can tell).
+
+Clock semantics match the reference's coordination.k8s.io Lease: the
+candidate supplies `now` and the renew/acquire timestamps, so the
+store is a pure CAS and the deterministic fake-clock semantics suite
+runs identically against all three stores. Production fleets therefore
+need loosely synchronized clocks — the same requirement kube's
+client-supplied renewTime imposes.
+"""
+
+from __future__ import annotations
+
+import threading
+import uuid
+from typing import Optional
+
+from kueue_tpu.transport.socket_channel import (
+    PEER_RESTART,
+    ChannelListener,
+    SocketChannel,
+    WorkerDiedError,
+)
+
+LEASE_CID_PREFIX = "lease/"
+
+
+class LeaseUnavailable(RuntimeError):
+    """The lease service did not answer within the deadline."""
+
+
+class LeaseService:
+    """Channel-side lease authority: serves the CAS to every dialer."""
+
+    def __init__(self, store):
+        self.store = store
+        self.requests = 0
+        self.clients = 0
+        self._threads = []
+
+    def attach(self, listener: ChannelListener) -> "LeaseService":
+        """Serve lease cids on `listener`, chaining (not replacing) any
+        existing on_hello hook — join traffic and lease traffic share
+        the control-plane port."""
+        prev = listener.on_hello
+
+        def hook(cid, chan):
+            if isinstance(cid, str) and cid.startswith(LEASE_CID_PREFIX):
+                self.serve(cid, chan)
+            elif prev is not None:
+                prev(cid, chan)
+
+        listener.on_hello = hook
+        return self
+
+    def serve(self, cid, chan) -> None:
+        self.clients += 1
+        t = threading.Thread(target=self._serve_loop, args=(chan,),
+                             name=f"lease-{cid}", daemon=True)
+        t.start()
+        self._threads.append(t)
+
+    def _serve_loop(self, chan) -> None:
+        while True:
+            try:
+                msg = chan.recv()
+            except WorkerDiedError:
+                return  # client gone
+            if not isinstance(msg, (tuple, list)) or len(msg) != 4 \
+                    or msg[0] != "lease":
+                continue  # restart markers / stray / malformed frames
+            _, rid, op, kw = msg
+            self.requests += 1
+            try:
+                result = self._dispatch(op, kw)
+                reply = ("lease_reply", rid, result)
+            except Exception as exc:  # surface, never kill the loop
+                reply = ("lease_err", rid, repr(exc))
+            try:
+                chan.send(reply)
+            except Exception:
+                return
+
+    def _dispatch(self, op: str, kw: dict):
+        store = self.store
+        if op == "acquire":
+            return store.try_acquire_or_renew(
+                kw["name"], kw["identity"], float(kw["duration"]),
+                float(kw["now"]))
+        if op == "release":
+            store.release(kw["name"], kw["identity"])
+            return None
+        if op == "holder":
+            return store.holder(kw["name"])
+        if op == "transitions":
+            return store.transitions(kw["name"])
+        raise ValueError(f"unknown lease op {op!r}")
+
+
+class ChannelLeaseStore:
+    """Lease CAS client over the channel protocol (LeaseStore API)."""
+
+    def __init__(self, addr, identity: Optional[str] = None,
+                 tls_context=None, auth_token: Optional[str] = None,
+                 timeout: float = 5.0,
+                 chan: Optional[SocketChannel] = None):
+        self.identity = identity or uuid.uuid4().hex[:8]
+        self.timeout = timeout
+        self.available = True
+        self.last_error: Optional[str] = None
+        self._transitions_cache = 0
+        self._holder_cache = ""
+        self._lock = threading.Lock()
+        self._rid = 0
+        self._chan = chan if chan is not None else SocketChannel.connect(
+            (addr[0], int(addr[1])),
+            cid=f"{LEASE_CID_PREFIX}{self.identity}",
+            tls_context=tls_context, auth_token=auth_token,
+            restart_markers=True,
+            name=f"lease-{self.identity}")
+
+    def _rpc(self, op: str, **kw):
+        with self._lock:
+            self._rid += 1
+            rid = self._rid
+            self._chan.send(("lease", rid, op, kw))
+            while True:
+                try:
+                    msg = self._chan.recv(timeout=self.timeout)
+                except WorkerDiedError as exc:
+                    self.available = False
+                    self.last_error = str(exc)
+                    raise LeaseUnavailable(
+                        f"lease service unreachable: {exc}")
+                if msg == PEER_RESTART:
+                    # The service restarted mid-request: the request is
+                    # gone with the old conversation. Resend it on the
+                    # fresh stream.
+                    self._chan.send(("lease", rid, op, kw))
+                    continue
+                if not isinstance(msg, (tuple, list)) or len(msg) < 3 \
+                        or msg[1] != rid:
+                    continue  # stale reply from a timed-out earlier rpc
+                if msg[0] == "lease_err":
+                    self.available = False
+                    self.last_error = msg[2]
+                    raise LeaseUnavailable(f"lease service error: {msg[2]}")
+                self.available = True
+                return msg[2]
+
+    # -- LeaseStore interface ------------------------------------------------
+
+    def try_acquire_or_renew(self, name: str, identity: str,
+                             lease_duration: float, now: float) -> bool:
+        try:
+            ok = bool(self._rpc("acquire", name=name, identity=identity,
+                                duration=lease_duration, now=now))
+        except LeaseUnavailable:
+            # Unconfirmed CAS == not acquired: a candidate that cannot
+            # reach the authority must not lead.
+            return False
+        if ok:
+            with self._lock:
+                self._holder_cache = identity
+        return ok
+
+    def release(self, name: str, identity: str) -> None:
+        try:
+            self._rpc("release", name=name, identity=identity)
+        except LeaseUnavailable:
+            pass  # best-effort: expiry reclaims it anyway
+
+    def holder(self, name: str) -> str:
+        try:
+            got = self._rpc("holder", name=name)
+        except LeaseUnavailable:
+            return self._holder_cache
+        with self._lock:
+            self._holder_cache = got
+        return got
+
+    def transitions(self, name: str) -> int:
+        try:
+            got = int(self._rpc("transitions", name=name))
+        except LeaseUnavailable:
+            return self._transitions_cache
+        with self._lock:
+            self._transitions_cache = got
+        return got
+
+    def close(self) -> None:
+        self._chan.close()

@@ -1,0 +1,75 @@
+"""Channel security: TLS contexts + shared-token auth for the listener.
+
+A real fleet's control-plane port is reachable by more than the
+control plane, so the `ChannelListener` grows the two guards every
+kube-ish join path has: transport encryption (TLS on the accept loop,
+`--tls-cert/--tls-key`) and a shared bearer token carried in the hello
+frame (`--auth-token`). Both are optional and independent; rejected
+hellos are counted (`ChannelListener.rejected_hellos`) and logged, and
+surface in `kueue_channel_rejected_hellos_total` — a nonzero rate on a
+production listener means something other than your workers is dialing
+the control plane.
+
+The worker side trusts exactly the coordinator's certificate: the same
+`--tls-cert` file doubles as the dial-side CA anchor (self-signed
+single-cert deployments — the fleet-smoke shape — need no real PKI).
+Hostname checking is off because fleet workers dial by address, not by
+name; the cert pin is the identity.
+
+`generate_self_signed` shells out to the `openssl` CLI (no python
+crypto dependency) so tests and `make fleet-smoke` can mint a
+throwaway cert; callers must skip TLS coverage when the binary is
+absent (`openssl_available`).
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import ssl
+import subprocess
+from typing import Tuple
+
+
+def server_tls_context(certfile: str, keyfile: str) -> ssl.SSLContext:
+    """The listener's accept-side context: present `certfile`, require
+    nothing from the client (identity is the auth token's job)."""
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+    ctx.load_cert_chain(certfile=certfile, keyfile=keyfile)
+    return ctx
+
+
+def client_tls_context(cafile: str) -> ssl.SSLContext:
+    """The worker's dial-side context: trust exactly the coordinator's
+    certificate (the pin), no hostname check (workers dial addresses)."""
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+    ctx.check_hostname = False
+    ctx.verify_mode = ssl.CERT_REQUIRED
+    ctx.load_verify_locations(cafile=cafile)
+    return ctx
+
+
+def openssl_available() -> bool:
+    return shutil.which("openssl") is not None
+
+
+def generate_self_signed(directory: str, cn: str = "kueue-tpu-coordinator",
+                         days: int = 3650) -> Tuple[str, str]:
+    """Mint a self-signed cert + key under `directory` via the openssl
+    CLI; returns (certfile, keyfile). Raises RuntimeError when openssl
+    is unavailable or fails — callers gate on `openssl_available`."""
+    if not openssl_available():
+        raise RuntimeError("openssl CLI not found; cannot mint a "
+                           "self-signed certificate")
+    os.makedirs(directory, exist_ok=True)
+    cert = os.path.join(directory, "coordinator.crt")
+    key = os.path.join(directory, "coordinator.key")
+    proc = subprocess.run(
+        ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+         "-keyout", key, "-out", cert, "-days", str(days),
+         "-subj", f"/CN={cn}",
+         "-addext", "subjectAltName=IP:127.0.0.1,DNS:localhost"],
+        capture_output=True, text=True)
+    if proc.returncode != 0:
+        raise RuntimeError(f"openssl failed: {proc.stderr.strip()}")
+    return cert, key

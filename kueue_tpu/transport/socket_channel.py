@@ -30,8 +30,10 @@ swaps adjacent frames (absorbed by receiver resequencing).
 from __future__ import annotations
 
 import socket
+import ssl
 import threading
 import time
+import uuid
 from collections import OrderedDict
 from typing import Dict, Optional, Tuple
 
@@ -52,6 +54,14 @@ from kueue_tpu.transport.framing import (
 )
 
 _CLOSED = object()
+
+# In-band marker delivered through `recv()` when the PEER came back as a
+# new incarnation (its hello carries a fresh session id): every sequence
+# number of the old conversation is void, the channel has already reset
+# itself, and the application layer must re-handshake (the worker's
+# re-join path). Only channels that opted in (`restart_markers=True`)
+# deliver it — everyone else just gets the silent reset.
+PEER_RESTART = ("__peer_restart__",)
 
 # Reconnect backoff (connector side): first retry fast, cap low — the
 # drills sever connections constantly and the barrier is waiting.
@@ -78,10 +88,21 @@ class SocketChannel:
     side: passive, rebound by each hello)."""
 
     def __init__(self, cid, faults: Optional[FaultInjector] = None,
-                 name: str = ""):
+                 name: str = "", auth_token: Optional[str] = None,
+                 tls_context: Optional[ssl.SSLContext] = None,
+                 restart_markers: bool = False):
         self.cid = cid
         self.name = name or f"chan-{cid}"
         self._faults = faults
+        # This channel's incarnation id: a fresh one per construction,
+        # carried in every hello. The peer detects a restart (all old
+        # sequence numbers void) by the session id changing.
+        self.session = uuid.uuid4().hex[:12]
+        self._peer_session: Optional[str] = None
+        self._auth_token = auth_token
+        self._tls = tls_context
+        self.restart_markers = restart_markers
+        self.peer_restarts = 0
         self._in_q: "queue_mod.Queue" = queue_mod.Queue()
         self._wlock = threading.RLock()
         self._out_seq = 0
@@ -106,12 +127,16 @@ class SocketChannel:
     @classmethod
     def connect(cls, addr, cid, faults: Optional[FaultInjector] = None,
                 plan: Optional[FaultPlan] = None,
-                name: str = "") -> "SocketChannel":
+                name: str = "", auth_token: Optional[str] = None,
+                tls_context: Optional[ssl.SSLContext] = None,
+                restart_markers: bool = False) -> "SocketChannel":
         """Replica-side channel: dial `addr`, identify as `cid`, redial
         forever on loss until closed."""
         if faults is None and plan is not None:
             faults = plan.injector(cid)
-        chan = cls(cid, faults=faults, name=name)
+        chan = cls(cid, faults=faults, name=name, auth_token=auth_token,
+                   tls_context=tls_context,
+                   restart_markers=restart_markers)
         chan._addr = (addr[0], int(addr[1]))
         chan._dialer = threading.Thread(
             target=chan._dial_loop, name=f"dial-{chan.name}", daemon=True)
@@ -224,6 +249,13 @@ class SocketChannel:
         self._held_frame = None
         if sock is not None:
             try:
+                # shutdown BEFORE close: on Linux, close() does not
+                # wake a thread blocked in recv() — the kernel socket
+                # (and its port) would linger until the recv timeout.
+                sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
                 sock.close()
             except OSError:
                 pass
@@ -261,14 +293,44 @@ class SocketChannel:
             gen = self._sock_gen
             self._disconnected.clear()
             if send_hello:
-                self._write_frame({"t": "h", "id": self.cid,
-                                   "rx": self._in_next})
+                self._write_frame(self.hello_frame())
             if peer_rx is not None:
                 self._retransmit(peer_rx)
         reader = threading.Thread(
             target=self._read_loop, args=(sock, gen, preload),
             name=f"read-{self.name}", daemon=True)
         reader.start()
+
+    def hello_frame(self) -> dict:
+        """This side's greeting: identity, next-expected sequence, our
+        session (incarnation) id, and the auth token when configured.
+        Caller holds _wlock (reads _in_next)."""
+        frame = {"t": "h", "id": self.cid, "rx": self._in_next,
+                 "sess": self.session}
+        if self._auth_token:
+            frame["tok"] = self._auth_token
+        return frame
+
+    def _note_peer_session(self, sess: Optional[str]) -> bool:
+        """Track the peer's incarnation id from its hello. A CHANGED id
+        means the peer restarted: every sequence number of the old
+        conversation is void on its side, so restart ours to match —
+        unacked frames are lost by definition (the process that would
+        have consumed them is gone); the application re-handshakes over
+        the fresh stream. Returns True on a detected restart. Caller
+        holds _wlock."""
+        if sess is None:
+            return False
+        restarted = (self._peer_session is not None
+                     and self._peer_session != sess)
+        if restarted:
+            self._out_seq = 0
+            self._out_buf.clear()
+            self._in_next = 0
+            self._in_hold.clear()
+            self.peer_restarts += 1
+        self._peer_session = sess
+        return restarted
 
     def _retransmit(self, peer_rx: int) -> None:
         """Resend every buffered frame the peer has not delivered, and
@@ -326,8 +388,12 @@ class SocketChannel:
                     del self._out_buf[seq]
         elif t == "h":
             # Peer's (re)connect greeting: its next-expected sequence.
+            restarted = False
             with self._wlock:
+                restarted = self._note_peer_session(frame.get("sess"))
                 self._retransmit(int(frame["rx"]))
+            if restarted and self.restart_markers:
+                self._in_q.put(PEER_RESTART)
 
     def _on_data(self, seq: int, msg) -> None:
         with self._wlock:
@@ -354,7 +420,22 @@ class SocketChannel:
                 return
             try:
                 sock = socket.create_connection(self._addr, timeout=5.0)
-            except OSError:
+                if sock.getsockname() == sock.getpeername():
+                    # Loopback self-connect (TCP simultaneous open): a
+                    # dial aimed at a dead port can land on ITSELF when
+                    # the kernel picks the target as the ephemeral
+                    # source port. The phantom "connection" would echo
+                    # our own frames back and squat on the port the
+                    # real listener needs — reject and back off.
+                    sock.close()
+                    raise OSError("self-connect rejected")
+                if self._tls is not None:
+                    # The TLS handshake rides the dial loop: a reject
+                    # (bad cert, plaintext listener) retries with the
+                    # same backoff as a refused connection.
+                    sock = self._tls.wrap_socket(
+                        sock, server_hostname=self._addr[0])
+            except OSError:  # ssl.SSLError is an OSError subclass
                 attempt += 1
                 time.sleep(min(_RECONNECT_BASE_S * (2 ** min(attempt, 8)),
                                _RECONNECT_MAX_S))
@@ -401,14 +482,41 @@ class ChannelListener:
     hello (reconnects included)."""
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
-                 plan: Optional[FaultPlan] = None):
+                 plan: Optional[FaultPlan] = None,
+                 tls_context: Optional[ssl.SSLContext] = None,
+                 auth_token: Optional[str] = None,
+                 on_hello=None):
         self._plan = plan
+        self._tls = tls_context
+        self._auth_token = auth_token
+        # on_hello(cid, chan) fires after a NEW endpoint's first hello
+        # binds (not on reconnects of a known cid) — the remote-join and
+        # lease-service attach points.
+        self.on_hello = on_hello
+        # Rejected hellos: bad/missing auth token, TLS handshake
+        # failures, malformed greetings. Counted + logged — on a real
+        # fleet's port a nonzero rate is a probe, not noise.
+        self.rejected_hellos = 0
         self._endpoints: Dict[object, SocketChannel] = {}
         self._lock = threading.Lock()
         self._closed = False
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        self._sock.bind((host, port))
+        # Bounded bind retry: a coordinator RESTART re-binds a port the
+        # dead incarnation's workers are actively redialing, and a
+        # loopback redial can transiently self-connect (simultaneous
+        # open) and squat on the port until the dialer rejects it —
+        # seconds, not forever, so retry instead of failing the
+        # restart.
+        deadline = time.monotonic() + 10.0
+        while True:
+            try:
+                self._sock.bind((host, port))
+                break
+            except OSError:
+                if port == 0 or time.monotonic() >= deadline:
+                    raise
+                time.sleep(0.2)
         self._sock.listen(64)
         self.address: Tuple[str, int] = self._sock.getsockname()[:2]
         self._accept_thread = threading.Thread(
@@ -438,10 +546,34 @@ class ChannelListener:
             threading.Thread(target=self._handshake, args=(sock,),
                              name="chan-hello", daemon=True).start()
 
+    def _reject(self, sock: socket.socket, reason: str,
+                detail: str = "") -> None:
+        import sys
+
+        from kueue_tpu.metrics import REGISTRY
+
+        self.rejected_hellos += 1
+        REGISTRY.channel_rejected_hellos_total.inc(reason)
+        print(f"kueue-tpu: listener rejected hello ({reason})"
+              + (f": {detail}" if detail else ""),
+              file=sys.stderr, flush=True)
+        try:
+            sock.close()
+        except OSError:
+            pass
+
     def _handshake(self, sock: socket.socket) -> None:
-        """Read the dialer's hello, bind its endpoint, answer with ours
-        (which carries our next-expected seq and triggers the peer's
+        """TLS-wrap (when configured), read the dialer's hello, check
+        its auth token, bind its endpoint, answer with ours (which
+        carries our next-expected seq and triggers the peer's
         retransmission)."""
+        if self._tls is not None:
+            try:
+                sock.settimeout(_HELLO_TIMEOUT_S)
+                sock = self._tls.wrap_socket(sock, server_side=True)
+            except (OSError, ssl.SSLError) as exc:
+                self._reject(sock, "tls", repr(exc))
+                return
         decoder = FrameDecoder()
         sock.settimeout(_HELLO_TIMEOUT_S)
         hello = None
@@ -464,10 +596,27 @@ class ChannelListener:
             return
         sock.settimeout(None)
         if not isinstance(hello, dict) or hello.get("t") != "h":
-            sock.close()
+            self._reject(sock, "malformed", repr(hello)[:80])
+            return
+        if self._auth_token and hello.get("tok") != self._auth_token:
+            self._reject(sock, "auth",
+                         f"peer {hello.get('id')!r} presented a "
+                         + ("wrong" if hello.get("tok") else "missing")
+                         + " token")
             return
         cid = hello.get("id")
+        with self._lock:
+            fresh = cid not in self._endpoints
         chan = self.endpoint(cid)
+        # Session FIRST: frames glued to a restarted peer's hello are
+        # numbered in the NEW conversation — dispatching them before
+        # the reset would misread them under the old sequence space
+        # (dropped as duplicates now, re-delivered after the peer's
+        # retransmit: duplicate delivery on an exactly-once channel).
+        with chan._wlock:
+            restarted = chan._note_peer_session(hello.get("sess"))
+        if restarted and chan.restart_markers:
+            chan._in_q.put(PEER_RESTART)
         # Frames that arrived glued to the hello dispatch BEFORE the
         # reader starts (resequencing absorbs any interleaving); the
         # decoder's residual partial-frame bytes ride into the reader.
@@ -476,8 +625,9 @@ class ChannelListener:
         chan.attach(sock, peer_rx=int(hello.get("rx", 0)),
                     preload=decoder.take_buffer())
         with chan._wlock:
-            chan._write_frame({"t": "h", "id": "listener",
-                               "rx": chan._in_next})
+            chan._write_frame(chan.hello_frame())
+        if fresh and self.on_hello is not None:
+            self.on_hello(cid, chan)
 
     def close(self) -> None:
         with self._lock:
@@ -485,6 +635,14 @@ class ChannelListener:
                 return
             self._closed = True
             endpoints = list(self._endpoints.values())
+        try:
+            # shutdown wakes the thread parked in accept() — without it
+            # the LISTEN socket survives close() on Linux and keeps
+            # accepting dials into a backlog nobody reads, wedging
+            # every reconnecting worker until their hello timeouts.
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
         try:
             self._sock.close()
         except OSError:

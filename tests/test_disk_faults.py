@@ -1,0 +1,239 @@
+"""Disk-fault hardening on the durable journal: seeded ENOSPC / fsync
+/ torn-write injection (controllers/diskfaults.py), write errors
+surfaced in kueue_journal_write_errors_total instead of swallowed, and
+attach-time replay that TRUNCATES a torn trailing line — the
+crash-mid-append regression fixtures the fleet-grade control plane
+requires."""
+
+import json
+import os
+
+import pytest
+
+from kueue_tpu.api.types import ResourceFlavor
+from kueue_tpu.controllers.diskfaults import (
+    ENOSPC,
+    PASS,
+    TORN,
+    DiskFaultInjector,
+    DiskFaultPlan,
+    parse_disk_fault_env,
+)
+from kueue_tpu.controllers.durable import Journal
+from kueue_tpu.controllers.store import KIND_RESOURCE_FLAVOR, Store
+from kueue_tpu.metrics import REGISTRY
+
+
+def _flavor(name):
+    return ResourceFlavor.make(name)
+
+
+def _journal_with_store(path, **kw):
+    store = Store()
+    journal = Journal(str(path), **kw)
+    journal.attach(store)
+    return journal, store
+
+
+# -- seeded schedule reproducibility -----------------------------------------
+
+
+def test_injector_schedule_is_deterministic():
+    plan = DiskFaultPlan(seed=7, enospc_prob=0.3, torn_prob=0.2,
+                         fsync_prob=0.1)
+    a = plan.injector("/state/journal.jsonl")
+    b = plan.injector("/state/journal.jsonl")
+    other = plan.injector("/state/journal-g1.jsonl")
+    sched_a = [a.next_action() for _ in range(64)]
+    sched_b = [b.next_action() for _ in range(64)]
+    assert sched_a == sched_b
+    assert sched_a != [other.next_action() for _ in range(64)]
+    assert set(sched_a) - {PASS}, "seed 7 drew no faults in 64 appends"
+
+
+def test_parse_disk_fault_env():
+    plan = parse_disk_fault_env("enospc_p=0.01,torn_p=0.005,seed=9")
+    assert plan == DiskFaultPlan(seed=9, enospc_prob=0.01,
+                                 torn_prob=0.005)
+    assert parse_disk_fault_env("") is None
+    assert parse_disk_fault_env("enospc_p=0") is None
+    with pytest.raises(ValueError):
+        parse_disk_fault_env("bogus_knob=1")
+
+
+# -- write errors surfaced, never swallowed ----------------------------------
+
+
+class _Scripted(DiskFaultInjector):
+    """An injector with an explicit per-append script (deterministic
+    fixtures want exact placement, not probabilities)."""
+
+    def __init__(self, script, torn_len=5):
+        super().__init__(DiskFaultPlan(seed=0, torn_prob=1e-9), "x")
+        self._script = list(script)
+        self._torn_len = torn_len
+
+    def next_action(self):
+        return self._script.pop(0) if self._script else PASS
+
+    def torn_prefix_len(self, line_len):
+        return min(self._torn_len, max(1, line_len - 1))
+
+
+def test_enospc_is_counted_and_journal_survives(tmp_path):
+    before = REGISTRY.journal_write_errors_total.get("enospc")
+    journal, store = _journal_with_store(tmp_path / "j.jsonl")
+    journal.faults = _Scripted([PASS, ENOSPC, PASS])
+    store.create(KIND_RESOURCE_FLAVOR, _flavor("f-ok"))
+    store.create(KIND_RESOURCE_FLAVOR, _flavor("f-lost"))  # ENOSPC
+    store.create(KIND_RESOURCE_FLAVOR, _flavor("f-after"))
+    journal.close()
+    assert journal.write_errors == 1
+    assert REGISTRY.journal_write_errors_total.get("enospc") \
+        == before + 1
+    # The lost record is lost (unacknowledged-write semantics); the
+    # journal stays consistent and later appends replay cleanly.
+    store2 = Store()
+    j2 = Journal(str(tmp_path / "j.jsonl"))
+    j2.attach(store2)
+    names = sorted(rf.name for rf in store2.list(KIND_RESOURCE_FLAVOR))
+    assert names == ["f-after", "f-ok"]
+    j2.close()
+
+
+def test_fsync_failure_keeps_the_record_and_counts(tmp_path):
+    before = REGISTRY.journal_write_errors_total.get("fsync")
+    journal, store = _journal_with_store(tmp_path / "j.jsonl",
+                                         fsync=True)
+    journal.faults = _Scripted(["fsync"])
+    store.create(KIND_RESOURCE_FLAVOR, _flavor("f-maybe"))
+    journal.close()
+    assert REGISTRY.journal_write_errors_total.get("fsync") == before + 1
+    # The data write landed: the record survives this (non-crash) run.
+    store2 = Store()
+    j2 = Journal(str(tmp_path / "j.jsonl"))
+    j2.attach(store2)
+    assert [rf.name for rf in store2.list(KIND_RESOURCE_FLAVOR)] \
+        == ["f-maybe"]
+    j2.close()
+
+
+def test_torn_write_repairs_tail_before_next_append(tmp_path):
+    """A torn append inside a LIVE journal: the next append first
+    truncates back to the last complete record, so the torn prefix can
+    never glue onto a later line."""
+    before = REGISTRY.journal_write_errors_total.get("torn")
+    journal, store = _journal_with_store(tmp_path / "j.jsonl")
+    journal.faults = _Scripted([PASS, TORN, PASS])
+    store.create(KIND_RESOURCE_FLAVOR, _flavor("f-0"))
+    store.create(KIND_RESOURCE_FLAVOR, _flavor("f-torn"))
+    store.create(KIND_RESOURCE_FLAVOR, _flavor("f-1"))
+    journal.close()
+    assert REGISTRY.journal_write_errors_total.get("torn") == before + 1
+    with open(tmp_path / "j.jsonl") as f:
+        entries = [json.loads(line) for line in f if line.strip()]
+    assert [e["object"]["metadata"]["name"] for e in entries] \
+        == ["f-0", "f-1"]
+
+
+# -- torn-tail regression fixtures: crash mid-append, attach recovers --------
+
+
+def _crash_mid_append(path, n_complete=5):
+    """Build a journal of `n_complete` records whose writer 'crashes'
+    mid-append on the LAST one (fault hook tears it), leaving the torn
+    tail on disk exactly as a power cut would."""
+    store = Store()
+    journal = Journal(str(path))
+    journal.attach(store)
+    journal.faults = _Scripted([PASS] * n_complete + [TORN])
+    for i in range(n_complete):
+        store.create(KIND_RESOURCE_FLAVOR, _flavor(f"f-{i}"))
+    # The fatal append: tear, then abandon the journal object without
+    # repair (the process died). Re-tear the file AFTER close because
+    # close() flushes nothing new but the next test stage needs the
+    # torn bytes present.
+    store.create(KIND_RESOURCE_FLAVOR, _flavor("f-crash"))
+    journal._file.close()  # simulate process death: no repair runs
+    journal._owner_lock_file.close()
+    raw = open(path, "rb").read()
+    assert not raw.endswith(b"\n"), "fixture did not produce a torn tail"
+    return raw
+
+
+def test_attach_replay_truncates_torn_tail_and_recovers_all(tmp_path):
+    path = tmp_path / "crash.jsonl"
+    raw_before = _crash_mid_append(path, n_complete=5)
+    store = Store()
+    journal = Journal(str(path))
+    restored = journal.attach(store)
+    # Every COMPLETE record recovered; the torn record dropped (its
+    # write was never acknowledged); the torn bytes gone from disk.
+    assert restored == 5
+    assert sorted(rf.name for rf in store.list(KIND_RESOURCE_FLAVOR)) \
+        == [f"f-{i}" for i in range(5)]
+    assert journal.torn_tail_recovered == 1
+    raw_after = open(path, "rb").read()
+    assert len(raw_after) < len(raw_before)
+    # ...and the journal is APPENDABLE: a new record lands on a clean
+    # line, and a third replay sees exactly 6 records.
+    store.create(KIND_RESOURCE_FLAVOR, _flavor("f-new"))
+    journal.close()
+    store3 = Store()
+    j3 = Journal(str(path))
+    assert j3.attach(store3) == 6
+    j3.close()
+
+
+def test_mid_file_corruption_is_skipped_counted_not_truncated(tmp_path):
+    """Corruption that is NOT a trailing torn line cannot be a clean
+    crash artifact: skip + count + keep every later complete record."""
+    path = tmp_path / "corrupt.jsonl"
+    journal, store = _journal_with_store(path)
+    for i in range(3):
+        store.create(KIND_RESOURCE_FLAVOR, _flavor(f"f-{i}"))
+    journal.close()
+    lines = open(path).read().splitlines()
+    lines[1] = lines[1][: len(lines[1]) // 2]  # wound the middle
+    with open(path, "w") as f:
+        f.write("\n".join(lines) + "\n")
+    before = REGISTRY.journal_write_errors_total.get("corrupt-replay")
+    store2 = Store()
+    j2 = Journal(str(path))
+    restored = j2.attach(store2)
+    assert restored == 2
+    assert j2.replay_skipped == 1
+    assert j2.torn_tail_recovered == 0
+    assert REGISTRY.journal_write_errors_total.get("corrupt-replay") \
+        == before + 1
+    j2.close()
+
+
+def test_soak_random_faults_lose_no_acknowledged_record(tmp_path):
+    """The seeded fault soak at journal level: every record whose
+    append RETURNED cleanly (acknowledged) must survive replay; records
+    the injector killed must be exactly the ones missing."""
+    plan = DiskFaultPlan(seed=11, enospc_prob=0.08, torn_prob=0.08,
+                         fsync_prob=0.05)
+    path = tmp_path / "soak.jsonl"
+    store = Store()
+    journal = Journal(str(path), faults=plan)
+    journal.attach(store)
+    acked = []
+    for i in range(200):
+        errors_before = journal.write_errors
+        store.create(KIND_RESOURCE_FLAVOR, _flavor(f"s-{i}"))
+        if journal.write_errors == errors_before:
+            acked.append(f"s-{i}")
+    # fsync faults ack the record (the data write landed), so the only
+    # permissible difference is fsync-flagged survivors.
+    journal.close()
+    store2 = Store()
+    j2 = Journal(str(path))
+    j2.attach(store2)
+    names = {rf.name for rf in store2.list(KIND_RESOURCE_FLAVOR)}
+    missing_acked = [n for n in acked if n not in names]
+    assert not missing_acked, \
+        f"acknowledged records lost on replay: {missing_acked[:5]}"
+    assert journal.write_errors > 0, "seed 11 drew no faults in 200"
+    j2.close()

@@ -1,0 +1,464 @@
+"""Fleet-grade control plane drills: remote worker join over TLS +
+auth (`--join`), degraded-mode admission when the coordinator dies
+(flat cohorts keep admitting shard-locally, split roots park), the
+rejoin catch-up reconcile with counted revocations, and the coordinator
+restart/re-join cycle over the channel's session ids."""
+
+import os
+import socket
+import threading
+import time
+
+import pytest
+
+from kueue_tpu import features
+from kueue_tpu.controllers.replica_runtime import (
+    ReplicaRuntime,
+    ReplicaWorker,
+    _QueueChan,
+    worker_join_main,
+)
+from kueue_tpu.metrics import REGISTRY
+from kueue_tpu.transport import openssl_available
+from kueue_tpu.transport.security import generate_self_signed
+
+from tests.test_replica import _lending_world, _split_pair
+from tests.util import fq, make_cq, make_flavor, make_lq, make_wl, rg
+
+
+def _flat_world(rt, n_cqs=4, cpu=4):
+    rt.create_resource_flavor(make_flavor("default"))
+    for i in range(n_cqs):
+        rt.create_cluster_queue(make_cq(
+            f"cq-{i}", rg("cpu", fq("default", cpu=cpu))))
+        rt.create_local_queue(make_lq(f"lq-{i}", "default", cq=f"cq-{i}"))
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _start_join_workers(port, tmp_path, n=2, cert=None, token=None,
+                        degraded_after=0.3):
+    threads = []
+    for i in range(n):
+        t = threading.Thread(
+            target=worker_join_main, args=(("127.0.0.1", port),),
+            kwargs=dict(state_dir=str(tmp_path / f"w{i}"),
+                        tls_cafile=cert, auth_token=token,
+                        node=f"node-{i}", join_timeout=60.0,
+                        degraded_after=degraded_after),
+            daemon=True)
+        t.start()
+        threads.append(t)
+    return threads
+
+
+# -- remote worker join -------------------------------------------------------
+
+
+@pytest.mark.skipif(not openssl_available(), reason="needs openssl CLI")
+def test_remote_join_admits_over_tls_with_auth(tmp_path):
+    """The zero-emulation fleet shape: workers dial a REMOTE
+    coordinator (TLS + token), receive shard groups + the admin seed
+    over the channel, and the whole admission pipeline runs across the
+    wire."""
+    cert, key = generate_self_signed(str(tmp_path / "pki"))
+    port = _free_port()
+    _start_join_workers(port, tmp_path, cert=cert, token="sekrit")
+    rt = ReplicaRuntime(2, remote=True, transport="socket",
+                        listen=("127.0.0.1", port), engine="host",
+                        solver=False,
+                        state_dir=str(tmp_path / "coord"),
+                        tls_cert=cert, tls_key=key,
+                        auth_token="sekrit", join_timeout=60.0,
+                        degraded_after=0.5)
+    try:
+        # Join ORDER is a race (whichever worker dials first gets wid
+        # 0); membership is not.
+        assert sorted(w.host_id for w in rt.workers) \
+            == ["node-0", "node-1"]
+        assert all(w.remote for w in rt.workers)
+        assert sorted(rt.group_owner) == [0, 1]
+        _flat_world(rt)
+        for i in range(4):
+            rt.submit(make_wl(f"w-{i}", f"lq-{i}", cpu=3,
+                              creation_time=float(i)))
+        for _ in range(3):
+            rt.tick()
+        dump = rt.dump()
+        assert sum(len(v) for v in dump["admitted"].values()) == 4
+        # Workers journal on their OWN disks (per-host by construction).
+        for i in range(2):
+            journals = [f for f in os.listdir(tmp_path / f"w{i}")
+                        if f.startswith("journal-g")]
+            assert journals, f"worker {i} journaled nothing locally"
+        assert rt.listener.rejected_hellos == 0
+        info = rt.reconcile_info()
+        assert info["remoteWorkers"] is True
+        assert {h["host"] for h in info["hosts"].values()} \
+            == {"node-0", "node-1"}
+    finally:
+        rt.close()
+
+
+@pytest.mark.skipif(not openssl_available(), reason="needs openssl CLI")
+def test_wrong_token_hello_rejected_counted_and_logged(tmp_path,
+                                                      capfd):
+    from kueue_tpu.transport import ChannelListener, SocketChannel
+
+    cert, key = generate_self_signed(str(tmp_path / "pki"))
+    from kueue_tpu.transport.security import (client_tls_context,
+                                              server_tls_context)
+
+    before = REGISTRY.channel_rejected_hellos_total.get("auth")
+    listener = ChannelListener(
+        "127.0.0.1", 0, tls_context=server_tls_context(cert, key),
+        auth_token="right")
+    chan = SocketChannel.connect(
+        listener.address, cid="join/evil", auth_token="wrong",
+        tls_context=client_tls_context(cert))
+    try:
+        deadline = time.monotonic() + 10
+        while listener.rejected_hellos == 0 \
+                and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert listener.rejected_hellos >= 1
+        assert REGISTRY.channel_rejected_hellos_total.get("auth") \
+            > before
+        assert "rejected hello (auth)" in capfd.readouterr().err
+    finally:
+        chan.close()
+        listener.close()
+
+
+def test_plaintext_hello_against_tls_listener_rejected(tmp_path):
+    if not openssl_available():
+        pytest.skip("needs openssl CLI")
+    from kueue_tpu.transport import ChannelListener, SocketChannel
+    from kueue_tpu.transport.security import server_tls_context
+
+    cert, key = generate_self_signed(str(tmp_path / "pki"))
+    before = REGISTRY.channel_rejected_hellos_total.get("tls")
+    listener = ChannelListener(
+        "127.0.0.1", 0, tls_context=server_tls_context(cert, key))
+    chan = SocketChannel.connect(listener.address, cid="join/plain")
+    try:
+        deadline = time.monotonic() + 10
+        while listener.rejected_hellos == 0 \
+                and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert listener.rejected_hellos >= 1
+        assert REGISTRY.channel_rejected_hellos_total.get("tls") > before
+    finally:
+        chan.close()
+        listener.close()
+
+
+# -- degraded-mode admission --------------------------------------------------
+
+
+def test_degraded_window_flat_cohorts_keep_admitting(tmp_path):
+    """The acceptance drill (loopback transport): coordinator silent
+    for >= K ticks -> flat-cohort admission throughput stays > 0,
+    every degraded verdict is journaled with a degraded-epoch stamp,
+    the gauge raises and zeroes, and post-rejoin state equals an
+    uninterrupted run (no revocations needed here: nothing
+    oversubscribed)."""
+    import json
+
+    state = tmp_path / "state"
+    rt = ReplicaRuntime(2, spawn=False, engine="host",
+                        state_dir=str(state), degraded_after=0.25)
+    try:
+        _flat_world(rt)
+        for i in range(4):
+            rt.submit(make_wl(f"w-{i}", f"lq-{i}", cpu=3,
+                              creation_time=float(i)))
+        rt.tick()
+        # New arrivals land, then the coordinator goes silent.
+        for i in range(4):
+            rt.submit(make_wl(f"d-{i}", f"lq-{i}", cpu=1,
+                              creation_time=float(10 + i)))
+        rt.degraded_window(1.2)
+        hosts_degraded = [h for h in ("host-0", "host-1")
+                          if REGISTRY.coordinator_degraded.get(h) == 1.0]
+        assert hosts_degraded, "no replica raised the degraded gauge"
+        ev = rt.rejoin()
+        assert ev["degraded_workers"] >= 1
+        assert ev["degraded_window_ticks"] >= 3
+        assert ev["degraded_admissions"] == 4  # throughput stayed > 0
+        assert ev["rejoin_revocations"] == 0
+        assert REGISTRY.coordinator_degraded.get("host-0") == 0.0
+        assert REGISTRY.coordinator_degraded.get("host-1") == 0.0
+        assert sum(REGISTRY.degraded_admissions_total.get(h)
+                   for h in ("host-0", "host-1")) >= 4
+        # Post-rejoin state == the uninterrupted outcome: everything
+        # that fits is admitted.
+        for _ in range(2):
+            rt.tick()
+        dump = rt.dump()
+        assert sum(len(v) for v in dump["admitted"].values()) == 8
+        # The degraded journal stamps every window event with its epoch.
+        djs = [os.path.join(root, f)
+               for root, _dirs, files in os.walk(state)
+               for f in files if f.startswith("degraded-")]
+        assert djs, "no degraded journal written"
+        events = [json.loads(line)
+                  for p in djs for line in open(p) if line.strip()]
+        kinds = {e["event"] for e in events}
+        assert {"enter", "tick", "rejoin"} <= kinds
+        assert all(e.get("degraded_epoch", e.get("epoch")) is not None
+                   for e in events)
+        tick_events = [e for e in events if e["event"] == "tick"]
+        assert sum(len(e["admitted"]) for e in tick_events) == 4
+        # The SIGUSR2 view carries the window's evidence.
+        info = rt.reconcile_info()
+        assert info["degradedWindow"]["degraded_admissions"] == 4
+    finally:
+        rt.close()
+
+
+def test_degraded_split_roots_park_not_admit(tmp_path):
+    """Split-root entries must PARK during a degraded window (the
+    merged lending-clamp arithmetic is unavailable), then admit after
+    rejoin exactly as the uninterrupted run would."""
+    features.set_enabled(features.LENDING_LIMIT, True)
+    try:
+        ca, cb = _split_pair(2)
+        rt = ReplicaRuntime(2, spawn=False, engine="host",
+                            degraded_after=0.25)
+        try:
+            _lending_world(rt, ca, cb)
+            assert "hroot" in rt.gmap.split_roots
+            rt.tick()
+            # Borrowers whose roots are split across the two replicas.
+            rt.submit(make_wl("wa", "lq-a", cpu=8, creation_time=1.0))
+            rt.submit(make_wl("wb", "lq-b", cpu=8, creation_time=2.0))
+            rt.degraded_window(1.0)
+            ev = rt.rejoin()
+            assert ev["degraded_window_ticks"] >= 1
+            # Parked: degraded ticks saw the split-root heads and
+            # refused them locally.
+            assert ev["parked"] >= 1
+            assert ev["degraded_admissions"] == 0
+            mid = rt.dump()
+            assert not mid["admitted"].get("cq-a") \
+                and not mid["admitted"].get("cq-b")
+            # After rejoin the coordinator arbitration resumes and
+            # exactly one borrower wins — the single-process outcome.
+            for _ in range(4):
+                rt.tick()
+            dump = rt.dump()
+            winners = sorted(dump["admitted"].get("cq-a", [])
+                             + dump["admitted"].get("cq-b", []))
+            assert len(winners) == 1
+        finally:
+            rt.close()
+    finally:
+        features.reset()
+
+
+def test_degraded_parking_explain_reason(monkeypatch):
+    """Unit: a split-root head parked by a degraded replica carries the
+    degraded explain reason, not the priority-race one."""
+    monkeypatch.setenv("KUEUE_TPU_BARRIER_DEADLINE", "5")
+    features.set_enabled(features.LENDING_LIMIT, True)
+    try:
+        import queue
+
+        to_worker: "queue.Queue" = queue.Queue()
+        to_parent: "queue.Queue" = queue.Queue()
+        worker = ReplicaWorker(
+            0, {"solver": False, "n_groups": 1, "engine": "host",
+                "degraded_after": 0.1},
+            _QueueChan(to_parent, to_worker))
+        ca, cb = _split_pair(2)
+        fw = worker.fw
+        fw.create_namespace("default", labels={})
+        _lending_world(fw, ca, cb)
+        worker.rctx.split_roots = frozenset({"hroot"})
+        worker._enter_degraded("test")
+        fw.submit(make_wl("wa", "lq-a", cpu=8, creation_time=1.0))
+        worker._degraded_tick()
+        assert worker.rctx.parked >= 1
+        assert not fw.admitted_workloads("cq-a")
+        records = fw.scheduler.explain.snapshot(limit=10)
+        reasons = [str(rec.get("reason", "")) + str(rec)
+                   for rec in records.values()]
+        assert any("degraded mode (coordinator unreachable)" in r
+                   for r in reasons), records
+        assert REGISTRY.coordinator_degraded.get(worker.host_id) == 1.0
+        worker._exit_degraded("test-done")
+        assert REGISTRY.coordinator_degraded.get(worker.host_id) == 0.0
+    finally:
+        features.reset()
+
+
+def test_rejoin_revokes_when_merged_capacity_shrank(tmp_path):
+    """The revocation half of the catch-up contract: the coordinator
+    comes back knowing a SMALLER quota than the degraded window
+    admitted against — the rejoin reconcile revokes (newest first,
+    counted, journaled as evictions) until nothing is oversubscribed,
+    at milli-unit resolution."""
+    rt = ReplicaRuntime(2, spawn=False, engine="host",
+                        state_dir=str(tmp_path / "state"),
+                        degraded_after=0.25)
+    try:
+        _flat_world(rt, n_cqs=2, cpu=6)
+        # The old pair admits NORMALLY (and pays the first-tick device
+        # compiles outside the degraded window).
+        for i in range(2):
+            rt.submit(make_wl(f"old-{i}", f"lq-{i}", cpu=3,
+                              creation_time=float(i)))
+        for _ in range(2):
+            rt.tick()
+        # The new pair arrives, then the coordinator goes silent: the
+        # degraded window admits them against the OLD quota (6 cpu).
+        for i in range(2):
+            rt.submit(make_wl(f"new-{i}", f"lq-{i}", cpu=3,
+                              creation_time=float(10 + i)))
+        rt.degraded_window(1.2)
+        # The restarted coordinator's config shrank every CQ to cpu=3
+        # (3000 milli-units): only ONE of each pair still fits.
+        for i in range(2):
+            spec = make_cq(f"cq-{i}", rg("cpu", fq("default", cpu=3)))
+            rt._cq_specs[spec.name] = spec
+            rt.coordinator.note_cluster_queue(spec)
+        ev = rt.rejoin()
+        assert ev["degraded_admissions"] == 2
+        assert ev["rejoin_revocations"] == 2
+        # Newest-first: the creation-order survivors are the old pair.
+        assert ev["revoked_keys"] == ["default/new-0", "default/new-1"]
+        # The restarted coordinator now applies its (shrunk) manifests
+        # — the routed MODIFIED events shrink the workers' quota, so
+        # the revoked pair stays pending instead of re-admitting.
+        from kueue_tpu.controllers.store import (KIND_CLUSTER_QUEUE,
+                                                 MODIFIED)
+
+        for i in range(2):
+            rt.apply_event(KIND_CLUSTER_QUEUE, MODIFIED,
+                           obj=rt._cq_specs[f"cq-{i}"])
+        for _ in range(2):
+            rt.tick()
+        dump = rt.dump()
+        for i in range(2):
+            assert dump["admitted"][f"cq-{i}"] == [f"default/old-{i}"]
+            usage = dump["usage"][f"cq-{i}"]["default"]["cpu"]
+            assert usage <= 3000, f"cq-{i} oversubscribed: {usage}"
+    finally:
+        rt.close()
+
+
+def test_remote_mode_conflicts_loudly_with_no_socket(monkeypatch):
+    """KUEUE_TPU_NO_SOCKET=1 + remote workers cannot coexist: fail at
+    construction with a clear message, not later on a missing
+    listener."""
+    monkeypatch.setenv("KUEUE_TPU_NO_SOCKET", "1")
+    with pytest.raises(RuntimeError, match="socket transport"):
+        ReplicaRuntime(2, remote=True, transport="socket",
+                       join_timeout=0.1)
+
+
+def test_drop_group_releases_slice_without_reply(monkeypatch):
+    """A rejoin assignment that took a group away drops its whole
+    vertical slice (objects, quota, journal flock) WITHOUT a released
+    reply — the single-owner invariant after first-join-wins conflict
+    resolution."""
+    import queue
+
+    to_worker: "queue.Queue" = queue.Queue()
+    to_parent: "queue.Queue" = queue.Queue()
+    worker = ReplicaWorker(0, {"solver": False, "n_groups": 2},
+                           _QueueChan(to_parent, to_worker))
+    worker.add_group(0)
+    worker.add_group(1)
+    fw = worker.fw
+    fw.create_namespace("default", labels={})
+    from kueue_tpu.controllers.store import (KIND_CLUSTER_QUEUE,
+                                             KIND_RESOURCE_FLAVOR)
+
+    for gid, name in ((0, "cq-keep"), (1, "cq-drop")):
+        store = worker.groups[gid][0]
+        store.create(KIND_RESOURCE_FLAVOR, make_flavor(f"f-{gid}"))
+        store.create(KIND_CLUSTER_QUEUE,
+                     make_cq(name, rg("cpu", fq("default", cpu=4))))
+    assert "cq-drop" in fw.cache.cluster_queues
+    worker._drop_group(1, want_entries=False)
+    assert 1 not in worker.groups
+    assert "cq-drop" not in fw.cache.cluster_queues
+    assert "cq-keep" in fw.cache.cluster_queues
+    assert to_parent.empty()  # no released reply on the rejoin path
+
+
+# -- coordinator restart + re-join -------------------------------------------
+
+
+def test_coordinator_restart_workers_rejoin_and_report(tmp_path):
+    """Kill the coordinator OUTRIGHT (listener closed, object gone) and
+    start a new incarnation on the same port: the workers' channels
+    detect the new session, re-join carrying the shard groups they
+    already own, serve their degraded report, and the admitted set ends
+    identical to an uninterrupted single-process run."""
+    from kueue_tpu.config import Configuration, TPUSolverConfig
+    from kueue_tpu.controllers.runtime import Framework
+
+    def build(t):
+        _flat_world(t)
+        for i in range(4):
+            t.submit(make_wl(f"w-{i}", f"lq-{i}", cpu=3,
+                             creation_time=float(i)))
+
+    # The uninterrupted single-process reference.
+    fw = Framework(batch_solver=None, config=Configuration(
+        tpu_solver=TPUSolverConfig(enable=False)))
+    fw.create_namespace("default", labels={})
+    build(fw)
+    fw.run_until_settled(max_ticks=8)
+    expect = {name: sorted(cq.workloads)
+              for name, cq in fw.cache.cluster_queues.items()
+              if cq.workloads}
+
+    port = _free_port()
+    _start_join_workers(port, tmp_path, degraded_after=0.3)
+    rt = ReplicaRuntime(2, remote=True, transport="socket",
+                        listen=("127.0.0.1", port), engine="host",
+                        solver=False, join_timeout=60.0,
+                        degraded_after=0.3)
+    owner_before = {
+        g: rt.workers[w].host_id for g, w in rt.group_owner.items()}
+    build(rt)
+    for _ in range(3):
+        rt.tick()
+    assert sum(len(v) for v in rt.dump()["admitted"].values()) == 4
+    # Coordinator dies. (Do not rt.close(): that would stop the
+    # workers — this is the crash path.)
+    rt.listener.close()
+    time.sleep(1.0)
+    rt2 = ReplicaRuntime(2, remote=True, transport="socket",
+                         listen=("127.0.0.1", port), engine="host",
+                         solver=False, join_timeout=60.0,
+                         degraded_after=0.3)
+    try:
+        # The new incarnation re-learns the world (a restarted
+        # coordinator re-applies its manifests), then reconciles.
+        _flat_world(rt2)
+        ev = rt2.rejoin()
+        assert ev["workers"] == 2
+        # Shard groups survived the restart with their owners.
+        owner_after = {
+            g: rt2.workers[w].host_id
+            for g, w in rt2.group_owner.items()}
+        assert owner_after == owner_before
+        for _ in range(3):
+            rt2.tick()
+        dump = rt2.dump()
+        got = {name: sorted(keys)
+               for name, keys in dump["admitted"].items() if keys}
+        assert got == expect
+    finally:
+        rt2.close()

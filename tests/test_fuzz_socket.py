@@ -1,0 +1,61 @@
+"""The multi-HOST socket lattice point (kueuefuzz): budget-gated
+behind `--lattice socket` / nightly / soak — never in the 25-seed CI
+smoke — and decision-identical to the reference when driven."""
+
+import pytest
+
+from kueue_tpu.fuzz import generator, lattice
+
+
+def test_socket_points_are_budget_gated():
+    """The smoke lattice NEVER contains socket points; the nightly
+    lattice appends them (clean + seeded-fault) for replica-safe
+    scenarios only."""
+    for seed in range(6):
+        sc = generator.draw_scenario(seed)
+        smoke = lattice.default_lattice(sc)
+        assert not any(p.transport == "socket" for p in smoke), \
+            "socket points leaked into the smoke budget"
+        nightly = lattice.default_lattice(sc, include_socket=True)
+        socket_pts = [p for p in nightly if p.transport == "socket"]
+        if sc.replica_safe():
+            names = {p.name for p in socket_pts}
+            assert names == {"socket", "socket-faults"}
+            assert all(p.kind == "replica" for p in socket_pts)
+            assert any(p.socket_faults for p in socket_pts)
+        else:
+            assert not socket_pts
+        # The axes advertise the transport (coverage accounting).
+        for p in nightly:
+            ax = p.axes()
+            if p.kind == "replica":
+                assert ax["transport"] in ("loopback", "socket")
+
+
+def test_fuzz_cli_accepts_lattice_flag(capsys):
+    """--lattice socket parses; --lattice default is the default."""
+    import argparse
+
+    from kueue_tpu.fuzz.__main__ import main
+
+    with pytest.raises(SystemExit):
+        main(["--lattice", "bogus"])  # argparse rejects unknown values
+    capsys.readouterr()
+
+
+@pytest.mark.slow
+def test_socket_point_decision_identity_one_seed():
+    """Nightly-shape spot check: one replica-safe seed driven at the
+    socket points (clean + faults) agrees with the sequential referee
+    on every tick and the final admitted set."""
+    sc = None
+    for seed in range(32):
+        cand = generator.draw_scenario(seed)
+        if cand.replica_safe():
+            sc = cand
+            break
+    assert sc is not None, "no replica-safe seed in the first 32"
+    points = [p for p in lattice.default_lattice(sc, include_socket=True)
+              if p.kind == "referee" or p.transport == "socket"]
+    report = lattice.check_scenario(sc, points=points)
+    assert report["violations"] == [], report["violations"][:3]
