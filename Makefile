@@ -96,10 +96,11 @@ bench-smoke:
 	  steady = METRIC_NAMES['steady']; \
 	  replica = METRIC_NAMES['replica']; \
 	  multihost = METRIC_NAMES['multihost']; \
+	  microtick = METRIC_NAMES['microtick']; \
 	  ratios = {m: l.get('arena_reuse_ratio') for m, l in by.items()}; \
 	  bad = {m: r for m, r in ratios.items() \
 	         if (r is None or r <= 0.9) and m not in (steady, replica, \
-	                                                  multihost)}; \
+	                                                  multihost, microtick)}; \
 	  assert not bad, f'arena_reuse_ratio <= 0.9: {bad}'; \
 	  rebuilds = {m: l.get('arena_full_rebuilds') for m, l in by.items()}; \
 	  assert not any(rebuilds.values()), f'full rebuilds in window: {rebuilds}'; \
@@ -158,6 +159,22 @@ bench-smoke:
 	  assert mh.get('transport') == 'socket', mh; \
 	  assert mh.get('coordinator_failover'), mh; \
 	  assert (mh.get('elastic_drill') or {}).get('steady_dispatches') == 0, mh; \
+	  mt = by[microtick]; \
+	  assert mt.get('microticks', 0) > 0 \
+	    and mt.get('micro_admitted', 0) > 0, \
+	    f'microtick config never took the event-driven path: {mt}'; \
+	  mvt = mt.get('micro_vs_tickpath_p50'); \
+	  assert mvt is not None and mvt < 1.0, \
+	    f'micro-tick p50 not below the kill-switch tick-path p50: {mt}'; \
+	  minv = mt.get('invariants') or {}; \
+	  assert minv.get('oversubscription') == 0 \
+	    and minv.get('unjournaled_revocations') == 0 \
+	    and minv.get('fifo_violations') == 0, \
+	    f'microtick invariant gate missing/red: {mt}'; \
+	  print('bench-smoke microtick gate OK: p99_admit_ms', \
+	        mt.get('p99_microtick_admit_ms'), 'vs tickpath p50', \
+	        mt.get('p50_tickpath_admit_ms'), 'microticks', \
+	        mt.get('microticks')); \
 	  print('bench-smoke fair gate OK: ratio', r, \
 	        'share_compute_ms', fair.get('fair_share_compute_ms'), \
 	        'fair_steady_dispatches', fsteady.get('solver_dispatches')); \
@@ -383,7 +400,8 @@ fuzz-smoke:
 	  assert {1, 2} <= set(ax['replicas']), ax; \
 	  assert True in ax['kill_switches'], ax; \
 	  assert 'referee' in ax['engines'] and 'jax' in ax['engines'], ax; \
-	  assert {'failover', 'loan'} <= set(ax['drills']), ax; \
+	  assert {'failover', 'loan', 'degraded'} <= set(ax['drills']), ax; \
+	  assert True in ax.get('micro', []), ax; \
 	  assert rep['environment'].get('cpu_count'), rep['environment']; \
 	  print('fuzz-smoke OK:', rep['scenarios'], 'scenarios, axes', ax)"
 

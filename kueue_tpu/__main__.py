@@ -551,7 +551,45 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                             fw.update_metrics_gauges()
                     else:
                         fw.update_metrics_gauges()
-                time.sleep(args.tick_interval)
+                # Event-driven admission between ticks: instead of one
+                # opaque sleep, the idle window polls the dirty-cohort
+                # marks and micro-ticks arrivals the moment they land —
+                # submit->admitted stops riding the tick interval.
+                # Only when this process may actually schedule: the
+                # kill switch is off, it HOLDS the lease (a standby
+                # must not admit), and no deferred journal attach is
+                # pending (a fresh leader that has not replayed the
+                # dead leader's journal yet would admit against a cache
+                # missing its workloads). Otherwise the window is one
+                # plain sleep, exactly the pre-micro serve loop.
+                micro_ok = fw.scheduler.microtick_enabled() \
+                    and (elector is None or elector.is_leader()) \
+                    and pending_journal[0] is None
+                if not micro_ok:
+                    time.sleep(args.tick_interval)
+                    continue
+                deadline = time.monotonic() + args.tick_interval
+                while True:
+                    if fw.queues.has_dirty_cohorts():
+                        # Status publication rides every micro admission
+                        # (the StoreAdapter.tick contract): a workload
+                        # admitted between ticks must be VISIBLE between
+                        # ticks, or the fast path only moved internal
+                        # state.
+                        if runtime_lock is not None:
+                            with runtime_lock:
+                                n = fw.microtick()
+                                if n:
+                                    adapter.sync_status()
+                        else:
+                            n = fw.microtick()
+                            if n:
+                                adapter.sync_status()
+                        total_admitted += n
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        break
+                    time.sleep(min(0.02, remaining))
         except KeyboardInterrupt:
             pass
     elif args.ticks is not None:

@@ -206,6 +206,30 @@ class ReplicaWorker:
         self._last_epoch = int(opts.get("epoch", 0) or 0)
         self._lease_probe = opts.get("lease_probe")  # callable or None
         self.revoked_total = 0
+        # Dirty-cohort micro-ticks between barriers (opt-in: they
+        # intentionally reorder vs the barrier-paced trail) and the
+        # eager-encode predispatch (identity-preserving: abandoned on
+        # any state-changing message). Both are the PR 9 barrier-stall
+        # fix: a replica blocked behind a slow sibling keeps doing
+        # useful work instead of idling.
+        self._micro_enabled = bool(opts.get("microtick"))
+        self._eager = bool(opts.get("eager_encode")) and os.environ.get(
+            "KUEUE_TPU_NO_EAGER_ENCODE", "") != "1"
+        self._predispatched = None
+        self.predispatch_used = 0
+        self.predispatch_abandoned = 0
+        self.micro_admitted: List[Tuple[str, str]] = []
+        self.micro_preempted: List[str] = []
+        self.microticks_run = 0
+        # Last-shipped watermarks: the barrier done reply carries
+        # DELTAS for every micro/predispatch counter (micro_admitted
+        # already drains), so the coordinator's per-tick stats never
+        # mix per-tick and lifetime semantics.
+        self._microticks_sent = 0
+        self._predispatch_sent = (0, 0)
+        # Seeded slow-worker drill: sleep this long inside every tick
+        # (the laggard the barrier-stall drill measures against).
+        self._drill_slow_s = float(opts.get("drill_slow_s") or 0.0)
         batch_solver = None
         if opts.get("solver", True):
             from kueue_tpu.models.flavor_fit import BatchSolver
@@ -593,18 +617,42 @@ class ReplicaWorker:
                     raise
                 # Coordinator silence past the deadline: probe the
                 # election once, then drop to (or continue) journaled
-                # shard-local admission.
+                # shard-local admission. A predispatched tick must be
+                # abandoned first — the degraded self-ticks run the
+                # framework directly, and its popped heads would
+                # otherwise sit in limbo for the whole window.
+                if self._predispatched is not None:
+                    self.fw.abandon_predispatch(self._predispatched)
+                    self._predispatched = None
+                    self.predispatch_abandoned += 1
                 if self.degraded:
                     self._degraded_tick()
                 elif self._coordinator_presumed_dead():
                     self._enter_degraded("recv-timeout")
                 continue
             if msg == PEER_RESTART:
+                if self._predispatched is not None:
+                    # The re-join handshake mutates state outside this
+                    # loop (group drops/adoptions); a stale predispatch
+                    # must not survive into the new incarnation.
+                    self.fw.abandon_predispatch(self._predispatched)
+                    self._predispatched = None
+                    self.predispatch_abandoned += 1
                 # The coordinator came back as a NEW incarnation: the
                 # old conversation is void; the join driver
                 # (worker_join_main) re-handshakes from scratch.
                 return "peer-restart"
             op = msg[0]
+            if self._predispatched is not None \
+                    and op not in ("tick", "pretick"):
+                # Anything but the tick command (or the read-only
+                # pre-tick usage exchange) can change this worker's
+                # inputs: the predispatched tick is no longer provably
+                # what a lazy tick would compute — abandon it (heads
+                # restored unchanged; only device work is wasted).
+                self.fw.abandon_predispatch(self._predispatched)
+                self._predispatched = None
+                self.predispatch_abandoned += 1
             if self.degraded:
                 if op == "verdicts":
                     continue  # stale reply from the dead incarnation
@@ -615,6 +663,7 @@ class ReplicaWorker:
                     self._exit_degraded(f"coordinator message ({op})")
             if op == "objs":
                 self._apply_batch(msg[1])
+                self._maybe_microtick()
             elif op == "tick":
                 if len(msg) > 3:
                     self._last_epoch = int(msg[3])
@@ -652,6 +701,7 @@ class ReplicaWorker:
                     self._finish(key, True)
             elif op == "submit_many":
                 self._submit_many(msg[1])
+                self._maybe_microtick()
             elif op == "delete_wl":
                 self._delete(msg[1])
             elif op == "rejoin":
@@ -672,16 +722,63 @@ class ReplicaWorker:
                 self.chan.send(("stopped", self.worker_id))
                 return
 
+    def _maybe_microtick(self) -> None:
+        """Dirty-cohort micro-tick between barriers: arrivals routed to
+        this worker admit NOW instead of waiting out a slow sibling's
+        barrier stall — flat cohorts are replica-complete by the shard
+        hash, so their quota math never needed the coordinator (the same
+        soundness argument as degraded-mode admission, without the
+        outage). Micro admissions are journaled via the group status
+        sync and reported in the next barrier reply."""
+        if not self._micro_enabled or self.degraded:
+            return
+        if not self.fw.queues.has_dirty_cohorts():
+            return
+        before = len(self.tick_admitted)
+        before_p = len(self.tick_preempted)
+        n = self.fw.microtick()
+        moved = len(self.tick_admitted) > before \
+            or len(self.tick_preempted) > before_p
+        if moved:
+            self.microticks_run += 1
+            # Micro admissions AND preemptions report separately from
+            # the barrier tick's (they happened BETWEEN ticks, and the
+            # tick clears its own accumulators at start).
+            self.micro_admitted.extend(self.tick_admitted[before:])
+            del self.tick_admitted[before:]
+            self.micro_preempted.extend(self.tick_preempted[before_p:])
+            del self.tick_preempted[before_p:]
+            for _store, adapter, _journal in self.groups.values():
+                adapter.sync_status()
+
     def _tick(self, want_status: bool = False) -> None:
         from kueue_tpu.tracing import TRACER, trace_now
 
+        if self._drill_slow_s:
+            import time as _time
+
+            _time.sleep(self._drill_slow_s)  # the seeded laggard drill
         self.tick_admitted.clear()
         self.tick_preempted.clear()
         m = self.fw.scheduler.metrics
         rev0 = m.reconcile_revocations
         t0 = trace_now()
         with TRACER.span("replica.tick") as sp:
-            n = self.fw.tick()
+            pre = self._predispatched
+            self._predispatched = None
+            if pre is not None:
+                n = self.fw.tick_prepared(pre)
+                if getattr(self.fw, "predispatch_consumed", False):
+                    # Eager encode paid off: this tick's ingest/encode/
+                    # solve already ran during the previous barrier's
+                    # idle window.
+                    self.predispatch_used += 1
+                else:
+                    # A backoff expired in between: tick_prepared
+                    # abandoned the predispatch and ran the lazy path.
+                    self.predispatch_abandoned += 1
+            else:
+                n = self.fw.tick()
             # Barrier discipline: exactly one round per tick. A tick
             # whose cycle never submitted (no heads, quiescent replay,
             # all-NoFit) submits the empty round here — carrying this
@@ -708,10 +805,27 @@ class ReplicaWorker:
             total = getattr(solver, "dispatches", 0)
             dispatches = total - self._dispatches_seen
             self._dispatches_seen = total
+        micro_pairs, self.micro_admitted = self.micro_admitted, []
+        micro_evicted, self.micro_preempted = self.micro_preempted, []
+        microticks_delta = self.microticks_run - self._microticks_sent
+        self._microticks_sent = self.microticks_run
+        pd_delta = [self.predispatch_used - self._predispatch_sent[0],
+                    self.predispatch_abandoned - self._predispatch_sent[1]]
+        self._predispatch_sent = (self.predispatch_used,
+                                  self.predispatch_abandoned)
         self.chan.send(("done", {
             "admitted": list(self.tick_admitted),
-            "preempted": list(self.tick_preempted),
+            # Between-barrier micro-tick preemptions fold into the
+            # tick's eviction evidence (they are real evictions the
+            # drivers' bookkeeping must see).
+            "preempted": list(self.tick_preempted) + micro_evicted,
             "n": n,
+            # Between-barrier micro-tick admissions since the last done
+            # (already journaled via the group status sync). Every
+            # micro/predispatch counter here is a since-last-done DELTA.
+            "micro_admitted": [list(p) for p in micro_pairs],
+            "microticks": microticks_delta,
+            "predispatch": pd_delta,
             "revocations": m.reconcile_revocations - rev0,
             "rtt": self.rctx.drain_rtt(),
             "rss": _rss_bytes(),
@@ -728,6 +842,12 @@ class ReplicaWorker:
             "pid": os.getpid(),
             "host": self.host_id,
         }))
+        if self._eager and not self.degraded:
+            # Barrier idle window: start the NEXT tick's encode now
+            # instead of waiting out a slow sibling — any state-changing
+            # message before the next tick command abandons it (the
+            # run-loop guard), keeping decisions byte-identical.
+            self._predispatched = self.fw.predispatch()
 
     def _apply_batch(self, entries) -> None:
         from kueue_tpu.controllers.durable import Journal
@@ -1352,7 +1472,10 @@ class ReplicaRuntime:
                  degraded_after: Optional[float] = None,
                  tls_cert: Optional[str] = None,
                  tls_key: Optional[str] = None,
-                 auth_token: Optional[str] = None):
+                 auth_token: Optional[str] = None,
+                 microtick: bool = False,
+                 eager_encode: Optional[bool] = None,
+                 drill_slow: Optional[Dict[int, float]] = None):
         from kueue_tpu import features
         from kueue_tpu.config import LeaderElectionConfig
         from kueue_tpu.controllers.leaderelection import (
@@ -1439,10 +1562,27 @@ class ReplicaRuntime:
             journal_path=os.path.join(state_dir, "coordinator.jsonl")
             if state_dir else None,
             epoch=self._lease_transitions())
+        # Worker-side dirty-cohort micro-ticks between barriers: OFF by
+        # default — every decision-identity golden compares against the
+        # barrier-paced trail, and micro-ticks intentionally reorder.
+        # The serve CLI opts in; the invariant oracles (quota high-water,
+        # journal replay) cover the reordered mode in the fuzz lattice.
+        self.microtick = microtick
+        # Eager encode at the barrier (the PR 9 slow-worker-stall fix):
+        # a replica that finishes its tick early predispatches its NEXT
+        # tick's ingest+encode+solve instead of idling — abandoned (and
+        # therefore decision-identical) whenever any state-changing
+        # message lands first. KUEUE_TPU_NO_EAGER_ENCODE=1 kills it.
+        if eager_encode is None:
+            eager_encode = os.environ.get(
+                "KUEUE_TPU_NO_EAGER_ENCODE", "") != "1"
+        self.eager_encode = eager_encode
         opts = {
             "engine": engine,
             "solver": solver,
             "n_groups": n_groups,
+            "microtick": microtick,
+            "eager_encode": eager_encode,
             "barrier_deadline": barrier_deadline(_ROUND_TIMEOUT),
             "replicate": self.replicator is not None,
             "connect": list(self.listener.address)
@@ -1471,6 +1611,7 @@ class ReplicaRuntime:
             self.workers = [
                 _WorkerHandle(w, spawn,
                               {**opts, "host_id": f"host-{w}",
+                               "drill_slow_s": (drill_slow or {}).get(w),
                                "state_dir": self._worker_state_dir(
                                    f"host-{w}")},
                               groups=[(g, self._journal_path(g, wid=w))
@@ -2126,7 +2267,9 @@ class ReplicaRuntime:
         with self._lock:
             empty = {"admitted": [], "preempted": [], "n": 0,
                      "revocations": 0, "rtt": [], "rss": _rss_bytes(),
-                     "tick_s": [], "stalls": [], "dispatches": 0}
+                     "tick_s": [], "stalls": [], "dispatches": 0,
+                     "micro_admitted": 0, "microticks": 0,
+                     "predispatch": [0, 0]}
             stalls: List[dict] = []
             self.tick_no += 1
             self.elector.step()
@@ -2180,7 +2323,9 @@ class ReplicaRuntime:
                 value=self.coordinator.epoch)
             stats = {"admitted": [], "preempted": [], "n": 0,
                      "revocations": 0, "rtt": [], "rss": _rss_bytes(),
-                     "tick_s": [], "stalls": stalls, "dispatches": 0}
+                     "tick_s": [], "stalls": stalls, "dispatches": 0,
+                     "micro_admitted": 0, "microticks": 0,
+                     "predispatch": [0, 0]}
             status_batches: list = []
             backlog: Dict[int, int] = {}
             for w in live:
@@ -2196,8 +2341,19 @@ class ReplicaRuntime:
                 d = msg[1]
                 stats["admitted"].extend(
                     [tuple(pair) for pair in d["admitted"]])
+                # Between-barrier micro-tick admissions fold into the
+                # same admitted evidence (they are real admissions the
+                # drivers' bookkeeping must see), counted separately.
+                micro = [tuple(pair)
+                         for pair in d.get("micro_admitted") or ()]
+                stats["admitted"].extend(micro)
+                stats["micro_admitted"] += len(micro)
+                stats["microticks"] += d.get("microticks") or 0
+                pd = d.get("predispatch") or (0, 0)
+                stats["predispatch"][0] += pd[0]
+                stats["predispatch"][1] += pd[1]
                 stats["preempted"].extend(d["preempted"])
-                stats["n"] += d["n"]
+                stats["n"] += d["n"] + len(micro)
                 stats["revocations"] += d["revocations"]
                 stats["rtt"].extend(d["rtt"])
                 stats["rss"] += d["rss"]

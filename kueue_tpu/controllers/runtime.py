@@ -106,6 +106,10 @@ class Framework:
             pipeline_depth = self.config.tpu_solver.pipeline_depth
         self.pipeline_depth = max(1, pipeline_depth)
         self._inflight_ticks: List = []
+        # Whether the last tick_prepared call actually consumed its
+        # predispatched tick (False = a backoff expiry abandoned it and
+        # the lazy path ran) — the eager-encode accounting's source.
+        self.predispatch_consumed = False
         if batch_solver is None:
             solver_enable = self.config.tpu_solver.enable
             if solver_enable is None:
@@ -895,6 +899,71 @@ class Framework:
         the idle gap between ticks (the serve loop does; so does the
         bench's completion-flux slot). Keeps XLA compiles out of ticks."""
         return self.scheduler.prewarm_idle()
+
+    def microtick(self) -> int:
+        """Event-driven admission between full ticks: solve only the
+        cohorts dirtied since the last tick (Scheduler.microtick) and
+        run the reconcile pass for whatever admitted, so two-phase
+        admission checks and job objects advance without waiting for
+        the next tick. No-op when nothing is dirty or the
+        KUEUE_TPU_NO_MICROTICK=1 kill switch is set; returns
+        admissions."""
+        admitted = self.scheduler.microtick()
+        if admitted:
+            with TRACER.phase("reconcile"):
+                self.reconcile()
+                self.job_reconciler.reconcile()
+        return admitted
+
+    # -- eager encode (the barrier-stall fix for replica workers) ------------
+
+    def predispatch(self) -> Optional["object"]:
+        """Start the NEXT tick's ingest+encode+solve now, instead of
+        idling until the next tick is driven — a replica worker calls
+        this right after its barrier reply, so a laggard sibling's stall
+        window does this worker's dispatch work. Only valid at depth 1
+        (deeper pipelines already overlap). The returned in-flight tick
+        MUST be either finished by `tick_prepared` or returned through
+        `abandon_predispatch` — and the caller must abandon it if ANY
+        state-changing input arrives before the tick is driven, which
+        makes the eager path decision-identical to the lazy one."""
+        if self.pipeline_depth > 1 or self._inflight_ticks:
+            return None
+        self.queues.flush_expired_backoffs()
+        return self.scheduler.schedule_async(timeout=0.0)
+
+    def abandon_predispatch(self, tick) -> None:
+        """Invalidate a predispatched tick: push its popped heads back
+        (unchanged — nothing was decided) and drop the in-flight solve.
+        The un-fetched device work is the only waste."""
+        if tick is not None:
+            self.queues.restore_heads([e.info for e in tick.entries])
+
+    def tick_prepared(self, tick) -> int:
+        """Drive one tick whose dispatch half already ran (predispatch).
+        A clock-gated backoff expiring between the predispatch and now
+        means the lazy tick would have popped a different head set: the
+        predispatched tick is abandoned and re-run fresh.
+        `predispatch_consumed` reports which path actually ran — the
+        caller's eager-encode accounting must not count an abandoned
+        predispatch as a hit."""
+        self.predispatch_consumed = False
+        if tick is not None and self.queues.flush_expired_backoffs():
+            self.abandon_predispatch(tick)
+            tick = None
+        if tick is None:
+            return self.tick()
+        self.predispatch_consumed = True
+        with TRACER.tick() as tick_span:
+            admitted = self.scheduler.schedule_finish(tick)
+            with TRACER.phase("reconcile"):
+                self.reconcile()
+                self.job_reconciler.reconcile()
+                if features.enabled(features.QUEUE_VISIBILITY):
+                    self.queue_visibility.maybe_update(self.clock())
+            tick_span.set("admitted", admitted)
+            tick_span.set("predispatched", True)
+        return admitted
 
     def run_until_settled(self, max_ticks: int = 100) -> int:
         """Tick until no progress is made; returns total admissions."""

@@ -40,7 +40,7 @@ def run_campaign(seeds: int, start_seed: int, out: str,
     all_violations = []
     axes_seen = {"engines": set(), "shards": set(), "replicas": set(),
                  "kill_switches": set(), "drills": set(),
-                 "transports": set()}
+                 "transports": set(), "micro": set()}
     for seed in range(start_seed, start_seed + seeds):
         sc = generator.draw_scenario(seed)
         report = lattice.check_scenario(sc, include_socket=include_socket)
@@ -53,6 +53,7 @@ def run_campaign(seeds: int, start_seed: int, out: str,
                 axes_seen["drills"].add(ax["drill"])
             if ax.get("transport"):
                 axes_seen["transports"].add(ax["transport"])
+            axes_seen["micro"].add(bool(ax.get("micro")))
         reports.append(report)
         status = "ok" if not report["violations"] else "DIVERGED"
         print(f"# seed {seed}: {status} "

@@ -49,8 +49,16 @@ class LatticePoint:
     shards: int = 1
     replicas: int = 1
     kill_switches: bool = False    # incremental fast paths OFF
-    drill: Optional[str] = None    # None | "failover" | "loan"
+    drill: Optional[str] = None    # None | "failover" | "loan" | "degraded"
     env: tuple = ()                # extra (key, value) env pairs
+    # Dirty-cohort micro-ticks interleaved with the traffic (the
+    # event-driven fast path). Micro-ticks intentionally reorder vs the
+    # barrier-paced trail, so a micro point with the kill switch CLEAR
+    # is exempt from the identity oracle and pinned by the invariant
+    # oracles instead (quota high-water, per-CQ FIFO, journal replay);
+    # with KUEUE_TPU_NO_MICROTICK=1 in `env` the micro calls are no-ops
+    # and byte identity with the reference must hold.
+    micro: bool = False
     # Replica-point transport: None = the loopback queue pairs (the
     # smoke default); "socket" = the real framed TCP channel, with
     # seeded packet faults when `socket_faults` — the multi-HOST
@@ -66,8 +74,19 @@ class LatticePoint:
                                           else "host"),
                 "shards": self.shards, "replicas": self.replicas,
                 "kill_switches": self.kill_switches, "drill": self.drill,
+                "micro": self.micro,
                 "transport": self.transport or
                 ("loopback" if self.kind == "replica" else None)}
+
+    def identity_exempt(self) -> bool:
+        """True when this point's decisions may legally reorder vs the
+        reference (live micro-ticks, degraded windows): the identity /
+        final-set oracles stand down and the invariant oracles rule."""
+        if self.drill == "degraded":
+            return True
+        return self.micro and not any(
+            k == "KUEUE_TPU_NO_MICROTICK" and v == "1"
+            for k, v in self.env)
 
 
 class TickClock:
@@ -147,6 +166,15 @@ def default_lattice(sc: Scenario,
     points.append(LatticePoint(name="kill-switches", kind="framework",
                                engine="jax", kill_switches=True,
                                env=(("KUEUE_TPU_NO_QUIET_TICK", "1"),)))
+    # Event-driven admission: micro-ticks interleaved with the traffic.
+    # The live point is identity-EXEMPT (intentional reorder; invariant
+    # oracles rule); the kill-switch twin proves KUEUE_TPU_NO_MICROTICK=1
+    # restores byte identity with the reference.
+    points.append(LatticePoint(name="microtick", kind="framework",
+                               engine="jax", micro=True))
+    points.append(LatticePoint(
+        name="microtick-off", kind="framework", engine="jax", micro=True,
+        env=(("KUEUE_TPU_NO_MICROTICK", "1"),)))
     if sc.replica_safe():
         points.append(LatticePoint(name="replicas-2", kind="replica",
                                    replicas=2))
@@ -158,6 +186,18 @@ def default_lattice(sc: Scenario,
             points.append(LatticePoint(name="elastic-loan",
                                        kind="replica", replicas=2,
                                        drill="loan"))
+        if sc.seed % 3 == 2:
+            # The rotation's third slot: micro-ticks under the
+            # journal-replay drill (a worker killed mid-run; its micro
+            # admissions must replay without oversubscription), and the
+            # degraded-window drill (coordinator silence + rejoin under
+            # the revocation-bounded identity oracle).
+            points.append(LatticePoint(name="microtick-failover",
+                                       kind="replica", replicas=2,
+                                       drill="failover", micro=True))
+            points.append(LatticePoint(name="degraded-window",
+                                       kind="replica", replicas=2,
+                                       drill="degraded"))
     if include_socket:
         points.extend(socket_points(sc))
     return points
@@ -407,29 +447,91 @@ def _drive_framework(sc: Scenario, point: LatticePoint) -> dict:
     for spec in sc.workloads:
         submit(spec)
 
+    # Micro-point bookkeeping for the per-CQ FIFO invariant oracle:
+    # per-CQ admission sequence (StrictFIFO queues only — BestEffortFIFO
+    # legally lets smaller later workloads overtake a parked NoFit
+    # head), with preempted/evicted keys excluded (a readmission's
+    # position is policy, not queue order).
+    admit_seq_by_cq: Dict[str, List[str]] = {}
+    ever_preempted: set = set()
+
     trail = []
     violations: List[dict] = []
+    evidence: dict = {}
     for t in range(sc.ticks + sc.settle_ticks):
         tick_admitted.clear()
         tick_preempted.clear()
         if t < sc.ticks:
             for op in sc.traffic[t] if t < len(sc.traffic) else ():
                 apply_op(op)
+        if point.micro:
+            # The event-driven path: dirty cohorts admit NOW, before
+            # the tick (a no-op under KUEUE_TPU_NO_MICROTICK=1 — the
+            # kill-switch twin must replay the reference byte for byte).
+            fw.microtick()
         fw.tick()
         clock.advance()
         st.note_admitted(t, [(k, st.submitted[k]["queue"][3:])
                              for k in tick_admitted])
         st.note_preempted(tick_preempted)
+        ever_preempted.update(tick_preempted)
+        for k in tick_admitted:
+            cq_name = st.submitted[k]["queue"][3:]
+            admit_seq_by_cq.setdefault(cq_name, []).append(k)
         trail.append((tuple(sorted(tick_admitted)),
                       tuple(sorted(tick_preempted))))
         usage = {name: {f: dict(r) for f, r in cq.usage.items()}
                  for name, cq in fw.cache.cluster_queues.items()}
         violations.extend(_check_oversub(sc, usage, caps_hw, t))
 
+    if point.micro and not any(k == "KUEUE_TPU_NO_MICROTICK"
+                               for k, _v in point.env):
+        violations.extend(_check_fifo(sc, st, admit_seq_by_cq,
+                                      ever_preempted))
+        evidence["microticks"] = fw.scheduler.metrics.microticks
+        evidence["micro_admitted"] = fw.scheduler.metrics.micro_admitted
+
     final = {name: sorted(cq.workloads)
              for name, cq in fw.cache.cluster_queues.items()}
     return {"trail": trail, "final_admitted": final,
-            "violations": violations, "evidence": {}}
+            "violations": violations, "evidence": evidence}
+
+
+def _check_fifo(sc: Scenario, st: _TrafficState,
+                admit_seq_by_cq: Dict[str, List[str]],
+                ever_preempted: set) -> List[dict]:
+    """The micro-tick FIFO invariant: within each StrictFIFO
+    ClusterQueue, same-priority workloads that were never preempted
+    must admit in queue order (priority desc, creation time asc is the
+    heap order; micro-ticks pop heads exactly like the full sweep, so
+    any inversion is a fast-path ordering bug)."""
+    strict = {c["name"] for c in sc.cluster_queues
+              if c.get("strategy") == "StrictFIFO"}
+    out: List[dict] = []
+    for cq_name, keys in admit_seq_by_cq.items():
+        if cq_name not in strict:
+            continue
+        last_by_priority: Dict[int, float] = {}
+        for key in keys:
+            if key in ever_preempted:
+                continue
+            spec = st.submitted.get(key)
+            if spec is None:
+                continue
+            prio = int(spec.get("priority", 0))
+            ct = float(spec["creation_time"])
+            prev = last_by_priority.get(prio)
+            if prev is not None and ct < prev:
+                out.append({
+                    "oracle": "fifo", "tick": -1,
+                    "detail": f"CQ {cq_name}: same-priority ({prio}) "
+                              f"admission order inverted at {key} "
+                              f"(creation {ct} after {prev})"})
+            last_by_priority[prio] = max(
+                ct, prev if prev is not None else ct)
+        # (max keeps the watermark: an EARLIER creation admitted after
+        # a later one is the inversion; equal times are fine.)
+    return out
 
 
 # -- replica drives ---------------------------------------------------------
@@ -458,6 +560,8 @@ def _drive_replica(sc: Scenario, point: LatticePoint,
         point.replicas, spawn=False, engine=point.engine,
         state_dir=state_dir if point.drill == "failover" else None,
         transport=point.transport, faults=faults,
+        microtick=point.micro,
+        degraded_after=(0.8 if point.drill == "degraded" else None),
         n_groups=(2 * point.replicas if point.drill == "loan" else None))
     st = _TrafficState()
     cq_specs = {c["name"]: c for c in sc.cluster_queues}
@@ -534,6 +638,24 @@ def _drive_replica(sc: Scenario, point: LatticePoint,
                 if gid is not None:
                     rt.migrate_group(gid, 1 % point.replicas)
                     evidence["loaned_group"] = gid
+            elif t == sc.ticks and point.drill == "degraded":
+                # Degraded window: the coordinator goes SILENT long
+                # enough for every worker's deadline to fire (they
+                # self-tick flat cohorts under the journaled safe
+                # mode), then rejoin runs the catch-up reconcile. The
+                # revocation-bounded identity oracle closes the drive:
+                # workloads the reference run admitted may only be
+                # missing from this final set if a counted rejoin
+                # revocation took them back.
+                rt.degraded_window(1.8)
+                ev = rt.rejoin()
+                evidence["degraded"] = {
+                    "window_ticks": ev["degraded_window_ticks"],
+                    "admissions": ev["degraded_admissions"],
+                    "parked": ev["parked"],
+                    "revocations": ev["rejoin_revocations"],
+                    "revoked_keys": ev.get("revoked_keys") or [],
+                }
             stats = rt.tick()
             admitted_pairs = sorted(stats["admitted"])
             st.note_admitted(t, admitted_pairs)
@@ -581,6 +703,31 @@ def _first_divergence(ref_trail, got_trail, admitted_only: bool):
     return None
 
 
+def _check_degraded_bound(sc: Scenario, ref: dict, got: dict,
+                          point_name: str) -> List[dict]:
+    """The revocation-bounded identity oracle for the degraded-window
+    drill: after rejoin + settle, every (cq, workload) pair the
+    uninterrupted reference holds admitted must either be admitted here
+    too, or appear among the rejoin reconcile's counted revocations —
+    an UNEXPLAINED loss is a violation (a silent take-back, exactly
+    what the journaled-verdict invariant forbids)."""
+    ref_pairs = {(cq, k) for cq, keys in ref["final_admitted"].items()
+                 for k in keys}
+    got_pairs = {(cq, k) for cq, keys in got["final_admitted"].items()
+                 for k in keys}
+    revoked = set((got.get("evidence") or {}).get(
+        "degraded", {}).get("revoked_keys") or [])
+    missing = {(cq, k) for cq, k in ref_pairs - got_pairs
+               if k not in revoked}
+    if not missing:
+        return []
+    return [{
+        "oracle": "degraded-identity", "point": point_name,
+        "tick": sc.ticks + sc.settle_ticks,
+        "detail": f"workloads lost without a counted revocation: "
+                  f"{sorted(missing)[:4]}"}]
+
+
 def check_scenario(sc: Scenario,
                    points: Optional[List[LatticePoint]] = None,
                    keep_results: bool = False,
@@ -615,6 +762,18 @@ def check_scenario(sc: Scenario,
         for p in points[1:]:
             r = results.get(p.name)
             if r is None:
+                continue
+            if p.identity_exempt():
+                # Live micro-ticks / degraded windows intentionally
+                # reorder vs the barrier-paced reference: the per-point
+                # invariant oracles (quota high-water, FIFO, crash)
+                # already ran above. The degraded drill additionally
+                # gets the revocation-bounded identity check: anything
+                # the reference's final set holds that this drive lost
+                # must be covered by a counted rejoin revocation.
+                if p.drill == "degraded":
+                    violations.extend(_check_degraded_bound(
+                        sc, ref, r, p.name))
                 continue
             admitted_only = p.kind == "replica"
             div = _first_divergence(ref["trail"], r["trail"],

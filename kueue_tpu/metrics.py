@@ -250,6 +250,20 @@ class Registry:
             p + "tick_phase_seconds",
             "Per-phase tick latency (snapshot/tensorize/solve/apply)",
             ("phase",))
+        # Event-driven admission fast path: micro-ticks solve ONLY the
+        # cohorts dirtied since the last full tick (flat cohorts are
+        # solve-independent), cutting submit->admitted latency from
+        # p99-tick-ms to p99-micro-tick-ms. The histogram buckets sit an
+        # order of magnitude below the tick buckets — a micro-tick that
+        # costs a full tick is a regression the buckets must resolve.
+        self.microticks_total = Counter(
+            p + "microticks_total",
+            "Dirty-cohort micro-ticks run between full scheduling ticks")
+        self.microtick_latency_seconds = Histogram(
+            p + "microtick_latency_seconds",
+            "Latency of one dirty-cohort micro-tick (dispatch to flush)",
+            buckets=(0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01,
+                     0.025, 0.05, 0.1, 0.25, 1.0))
         # Topology-aware scheduling: free-capacity fragmentation per
         # (flavor, level) — 1 - largest free domain / total free slots.
         # 0 = all free capacity sits in one domain (any fitting podset can

@@ -38,6 +38,11 @@ class RequeueReason:
     PENDING_PREEMPTION = "PendingPreemption"
 
 
+# Dirty-cohort routing key prefix for cohort-less ClusterQueues (each is
+# its own admission domain — the solver's __solo__ singleton idiom).
+SOLO_COHORT = "__cq__/"
+
+
 def _evicted_by_pods_ready_timeout(wl: Workload) -> bool:
     c = wl.find_condition(CONDITION_EVICTED)
     return c is not None and c.status and c.reason == EVICTED_BY_PODS_READY_TIMEOUT
@@ -275,6 +280,15 @@ class Manager:
         self._cq_version = 0
         self._pop_plan = None
         self._pop_plan_version = -1
+        # Dirty-cohort event routing (the micro-tick fast path's feed):
+        # {cohort name | SOLO_COHORT+cq: triggering event} recorded on
+        # every admission-relevant arrival (submit, quota-release flush,
+        # backoff expiry) and drained by Scheduler.microtick — or folded
+        # into the next full heads sweep, which pops every queue anyway.
+        # Bounded by the cohort+CQ population; requeues of losing heads
+        # deliberately record NOTHING (a NoFit requeue re-dirtying its
+        # cohort would spin micro-ticks forever on an unchanged input).
+        self._dirty_cohorts: Dict[str, str] = {}
 
     # -- pending-workload events (solver arena subscription) -----------------
 
@@ -298,6 +312,80 @@ class Manager:
     def _forget_sinks(self, wl: Workload) -> None:
         for sink in self._workload_sinks:
             sink.forget_pending_workload(wl.uid)
+
+    # -- dirty-cohort events (the micro-tick fast path) ----------------------
+
+    def _mark_dirty(self, cq: PendingClusterQueue, event: str) -> None:
+        """Record an admission-relevant event against the CQ's cohort
+        (callers hold the manager lock). Latest event wins — the mark is
+        a routing key, the event string only explains the trigger."""
+        self._dirty_cohorts[cq.cohort or SOLO_COHORT + cq.name] = event
+
+    def has_dirty_cohorts(self) -> bool:
+        return bool(self._dirty_cohorts)
+
+    def remark_dirty(self, key: str, event: str) -> None:
+        """Put a drained dirty-cohort key back (micro-tick CQ-budget
+        overflow: the full tick, or a later micro-tick, handles it)."""
+        with self._cond:
+            self._dirty_cohorts.setdefault(key, event)
+
+    def mark_dirty_cq(self, name: str, event: str) -> None:
+        """Externally re-mark one ClusterQueue's cohort dirty (the
+        micro-tick's round-cap handback: pending heads remain that a
+        later micro-tick should continue draining)."""
+        with self._cond:
+            cq = self.cluster_queues.get(name)
+            if cq is not None:
+                self._mark_dirty(cq, event)
+
+    def drain_dirty_cohorts(self) -> Dict[str, str]:
+        """Take (and clear) the dirty-cohort marks accumulated since the
+        last drain: {cohort | SOLO_COHORT+cq: triggering event}."""
+        with self._cond:
+            if not self._dirty_cohorts:
+                return {}
+            out, self._dirty_cohorts = self._dirty_cohorts, {}
+            return out
+
+    def cohort_member_names(self, key: str) -> List[str]:
+        """The ClusterQueues a dirty-cohort key routes to: the cohort's
+        member queues, or the solo CQ itself."""
+        with self._cond:
+            if key.startswith(SOLO_COHORT):
+                name = key[len(SOLO_COHORT):]
+                return [name] if name in self.cluster_queues else []
+            return sorted(self._cohort_members.get(key, {}))
+
+    def pop_heads_for(self, cq_names) -> List[WorkloadInfo]:
+        """Pop one head from each NAMED ClusterQueue (the micro-tick's
+        focused twin of the full `heads` sweep — same pop semantics,
+        including the popCycle advance, so the popCycle /
+        queueInadmissibleCycle race guard keeps counting)."""
+        out: List[WorkloadInfo] = []
+        with self._cond:
+            for name in cq_names:
+                cq = self.cluster_queues.get(name)
+                if cq is None or not cq.active:
+                    continue
+                wi = cq.pop()
+                if wi is not None:
+                    out.append(wi)
+        return out
+
+    def restore_heads(self, infos) -> None:
+        """Push popped-but-undecided heads back onto their heaps (the
+        eager-encode abandon path: a predispatched tick was invalidated
+        before its completion ran, and nothing about the heads changed
+        — they re-enter exactly as they were popped)."""
+        with self._cond:
+            restored = False
+            for wi in infos:
+                cq = self.cluster_queues.get(wi.cluster_queue)
+                if cq is not None:
+                    restored = cq.heap.push_if_not_present(wi) or restored
+            if restored:
+                self._cond.notify_all()
 
     def pending_infos(self) -> List[WorkloadInfo]:
         """Every pending WorkloadInfo (heaps + parking lots) — the
@@ -331,6 +419,7 @@ class Manager:
                     wi = WorkloadInfo(wl, cluster_queue=spec.name)
                     cq.push_or_update(wi)
                     self._note_sinks(wi)
+                    self._mark_dirty(cq, f"submit {wl.name}")
             self._cond.notify_all()
 
     def update_cluster_queue(self, spec: ClusterQueue) -> None:
@@ -389,6 +478,7 @@ class Manager:
                         wi = WorkloadInfo(wl, cluster_queue=cq.name)
                         cq.push_or_update(wi)
                         self._note_sinks(wi)
+                        self._mark_dirty(cq, f"submit {wl.name}")
                 self._cond.notify_all()
 
     def delete_local_queue(self, lq: LocalQueue) -> None:
@@ -412,6 +502,7 @@ class Manager:
             wi = WorkloadInfo(wl, cluster_queue=cq_name)
             cq.push_or_update(wi)
             self._note_sinks(wi)
+            self._mark_dirty(cq, f"submit {wl.name}")
             self._cond.notify_all()
             return True
 
@@ -475,10 +566,13 @@ class Manager:
                 return
             self._queue_cohort_inadmissible(cq.cohort, fallback=cq)
 
-    def flush_expired_backoffs(self) -> None:
+    def flush_expired_backoffs(self) -> bool:
         """Move parked workloads whose requeue backoff has expired back to
         their heaps (the reference does this with per-workload RequeueAfter
-        timers, workload_controller.go:352-356)."""
+        timers, workload_controller.go:352-356). Returns whether anything
+        moved — the eager-encode path invalidates a predispatched tick on
+        True (a clock-gated head became poppable after the predispatch
+        popped its sweep)."""
         with self._cond:
             moved = False
             now = self._clock()
@@ -494,14 +588,20 @@ class Manager:
                     # release flush, not the clock) — O(1) instead of a
                     # whole-lot walk per tick.
                     continue
+                cq_moved = False
                 for key, wi in list(cq.inadmissible.items()):
                     rs = wi.obj.requeue_state
                     if rs is not None and rs.requeue_at is not None \
                             and cq._backoff_expired(wi):
                         cq._unpark(key)
-                        moved = cq.heap.push_if_not_present(wi) or moved
+                        cq_moved = cq.heap.push_if_not_present(wi) \
+                            or cq_moved
+                if cq_moved:
+                    self._mark_dirty(cq, "backoff-expired")
+                    moved = True
             if moved:
                 self._cond.notify_all()
+            return moved
 
     def queue_inadmissible_workloads(self, cq_names) -> None:
         with self._cond:
@@ -513,8 +613,9 @@ class Manager:
                     continue
                 if cq.cohort:
                     cohorts.add(cq.cohort)
-                else:
-                    queued = cq.queue_inadmissible_workloads(self._ns_lister) or queued
+                elif cq.queue_inadmissible_workloads(self._ns_lister):
+                    self._mark_dirty(cq, "quota-release")
+                    queued = True
             for cohort in cohorts:
                 queued = self._flush_cohort(cohort) or queued
             if queued:
@@ -527,12 +628,15 @@ class Manager:
                 self._cond.notify_all()
         elif fallback is not None:
             if fallback.queue_inadmissible_workloads(self._ns_lister):
+                self._mark_dirty(fallback, "quota-release")
                 self._cond.notify_all()
 
     def _flush_cohort(self, cohort: str) -> bool:
         queued = False
         for cq in self._cohort_members.get(cohort, {}).values():
-            queued = cq.queue_inadmissible_workloads(self._ns_lister) or queued
+            if cq.queue_inadmissible_workloads(self._ns_lister):
+                self._mark_dirty(cq, "quota-release")
+                queued = True
         return queued
 
     # -- heads ---------------------------------------------------------------
@@ -579,6 +683,10 @@ class Manager:
     def _heads_locked(self) -> List[WorkloadInfo]:
         if self._pop_plan_version != self._cq_version:
             self._build_pop_plan()
+        # The full sweep pops every queue: standing dirty-cohort marks
+        # are consumed by this tick (anything it could not pop — parked
+        # workloads — a micro-tick could not pop either).
+        self._dirty_cohorts.clear()
         plan, group = self._pop_plan
         popped = group.pop_each() if group is not None else None
         out: List[WorkloadInfo] = []

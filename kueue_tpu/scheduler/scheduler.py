@@ -104,6 +104,12 @@ class TickInFlight:
     handle: Optional[dict]
     snapshot: Snapshot
     dispatched_at: float = 0.0
+    # Dirty-cohort micro-tick (event-driven fast path): {cq name:
+    # triggering dirty event} when this tick solves ONLY the cohorts
+    # dirtied since the last full tick; None for a full tick. Drives
+    # the "admitted: micro-tick" explain reason, the micro metrics, and
+    # the cycle's no-replica-round guard.
+    micro: Optional[Dict[str, str]] = None
 
 
 @dataclass
@@ -122,6 +128,10 @@ class SchedulerMetrics:
     # bookkeeping replayed the previous tick's (provably identical)
     # outcome instead of recomputing it.
     quiescent_ticks: int = 0
+    # Event-driven fast path: dirty-cohort micro-ticks run between full
+    # ticks, and the workloads they admitted.
+    microticks: int = 0
+    micro_admitted: int = 0
 
 
 class Scheduler:
@@ -305,17 +315,33 @@ class Scheduler:
         heads = self.queues.heads(timeout=timeout)
         if not heads:
             return None
+        return self._dispatch(heads)
+
+    def _dispatch(self, heads: Sequence[WorkloadInfo],
+                  snapshot: Optional[Snapshot] = None,
+                  micro: Optional[Dict[str, str]] = None,
+                  ) -> Optional[TickInFlight]:
+        """The tick pipeline's first two stages over already-popped
+        heads: INGEST (snapshot refresh + entry gating) and ENCODE
+        (arena gather + device dispatch, which returns without blocking
+        — the solve itself runs on the device lane while later host
+        stages of OLDER ticks execute). Shared by the full tick
+        (`schedule_async`) and the dirty-cohort micro-tick."""
         start = self.clock()
-        with TRACER.phase("snapshot"):
-            snapshot = self._mirror.refresh()
-        entries, solvable = self._prep_entries(heads, snapshot)
+        with TRACER.phase("tick.stage.ingest"):
+            if snapshot is None:
+                with TRACER.phase("snapshot"):
+                    snapshot = self._mirror.refresh()
+            entries, solvable = self._prep_entries(heads, snapshot)
         handle = None
         if self.batch_solver is not None and solvable:
-            handle = self.batch_solver.solve_async(
-                [e.info for e in solvable], snapshot)
+            with TRACER.phase("tick.stage.encode"):
+                handle = self.batch_solver.solve_async(
+                    [e.info for e in solvable], snapshot)
         return TickInFlight(start=start, entries=entries, solvable=solvable,
                             handle=handle, snapshot=snapshot,
-                            dispatched_at=self._mirror.mutation_count)
+                            dispatched_at=self._mirror.mutation_count,
+                            micro=micro)
 
     def schedule_finish(self, tick: TickInFlight) -> int:
         """Completion phase: collect the solve, search preemption targets,
@@ -329,6 +355,20 @@ class Scheduler:
         entries = tick.entries
         with TRACER.phase("nominate") as nsp:
             self._resolve(tick)
+            if tick.handle is not None and (
+                    tick.handle.get("handle") is not None
+                    or tick.handle.get("out") is not None):
+                # The device-solve stage's span: dispatch -> fetch, on
+                # its own Perfetto lane (DEVICE_LANE) — in pipelined
+                # mode it visibly overlaps the NEXT tick's host-side
+                # ingest/encode stage spans.
+                from kueue_tpu.tracing import DEVICE_LANE, trace_now
+                t0 = tick.handle.get("dispatched")
+                if t0 is not None:
+                    TRACER.record_span(
+                        "tick.stage.solve", t0, trace_now(),
+                        lane=DEVICE_LANE,
+                        attrs={"micro": tick.micro is not None})
             if features.enabled(features.FAIR_SHARING):
                 # How many ClusterQueues fell off the bulk share tensors
                 # onto the per-CQ dict walk (0 in a normal tick).
@@ -402,7 +442,8 @@ class Scheduler:
                 revoked_before = self.metrics.reconcile_revocations
                 admitted = self._admission_cycle(entries, snapshot,
                                                  revalidate=stale,
-                                                 usage_csr=usage_csr)
+                                                 usage_csr=usage_csr,
+                                                 micro=tick.micro is not None)
                 # Replayable = nothing escaped the tick: no admission
                 # assumed, no preemption issued — only NOT_NOMINATED
                 # losers and deterministic SKIPPED bookkeeping. A cycle
@@ -439,11 +480,18 @@ class Scheduler:
                 st.refresh()
         self.metrics.admission_attempts += 1
         self.metrics.last_tick_seconds = self.clock() - tick.start
-        self._record_decisions(entries, quiescent=skip_cycle)
+        self._record_decisions(entries, quiescent=skip_cycle,
+                               micro=tick.micro)
         result = "success" if admitted else "inadmissible"
         REGISTRY.admission_attempts_total.inc(result)
         REGISTRY.admission_attempt_duration_seconds.observe(
             result, value=self.metrics.last_tick_seconds)
+        if tick.micro is not None:
+            self.metrics.microticks += 1
+            self.metrics.micro_admitted += admitted
+            REGISTRY.microticks_total.inc()
+            REGISTRY.microtick_latency_seconds.observe(
+                value=max(0.0, self.metrics.last_tick_seconds))
         return admitted
 
     # How many distinct recent tick signatures the quiescent ring
@@ -575,7 +623,8 @@ class Scheduler:
             ring.popitem(last=False)
 
     def _record_decisions(self, entries: List[Entry],
-                          quiescent: bool = False) -> None:
+                          quiescent: bool = False,
+                          micro: Optional[Dict[str, str]] = None) -> None:
         """Append this attempt's decision record per workload (admission
         explainability). Runs after the requeue sweep so each record
         carries the final outcome + Pending message of the attempt.
@@ -584,7 +633,12 @@ class Scheduler:
         provably-identical outcome) each workload's LAST record is
         collapsed in place — its tick/time stamps advance and a repeat
         counter bumps — instead of rebuilding an identical flavor-trail
-        record per head per tick."""
+        record per head per tick.
+
+        Micro-tick admissions (`micro` = {cq: triggering dirty event})
+        record the outcome reason "admitted: micro-tick (<event>)", so
+        `?explain=true` distinguishes the event-driven fast path from
+        full-tick decisions — and names the dirty event that woke it."""
         from kueue_tpu.tracing import explain as explain_mod
 
         seq = self.metrics.admission_attempts
@@ -603,8 +657,149 @@ class Scheduler:
                 outcome = explain_mod.PREEMPTING
             else:
                 outcome = explain_mod.INADMISSIBLE
-            items.append((e.info.key, build_record(e, seq, now, outcome)))
+            rec = build_record(e, seq, now, outcome)
+            if micro is not None and e.status == ASSUMED:
+                event = micro.get(e.info.cluster_queue, "dirty cohort")
+                # Layout index 4 is the reason field (an admitted
+                # entry's inadmissible_msg is empty otherwise).
+                rec = rec[:4] + (f"admitted: micro-tick ({event})",) \
+                    + rec[5:]
+            items.append((e.info.key, rec))
         self.explain.record_bulk(items)
+
+    # -- dirty-cohort micro-tick (event-driven fast path) --------------------
+
+    @staticmethod
+    def microtick_enabled() -> bool:
+        """The micro-tick kill switch, read live so identity drives can
+        flip KUEUE_TPU_NO_MICROTICK per run."""
+        return os.environ.get("KUEUE_TPU_NO_MICROTICK", "") != "1"
+
+    def microtick(self) -> int:
+        """Solve ONLY the cohorts dirtied since the last tick — the
+        event-driven admission path between full ticks.
+
+        Flat cohorts are solve-independent by construction (the
+        CohortMesh shards over exactly this property), so a micro-tick
+        pops just the dirty cohorts' heads and runs the normal
+        dispatch/finish pipeline over them: the nominate-cache
+        fingerprints replay unchanged heads, the admission cycle runs
+        the same quota arithmetic against the refreshed mirror, and any
+        in-flight pipelined full tick re-validates against the mirror
+        mutations this commit makes (the standing optimistic-concurrency
+        contract). Hierarchical trees, shard-split and replica-split
+        roots always defer to the next full tick — their quota math
+        needs merged state a focused pass does not hold.
+
+        Intentional reorder vs the sequential tick is pinned by
+        linearizability-style invariants instead of byte identity: no
+        quota oversubscribed (same milli-unit cycle gates), no admitted
+        workload revoked without a journaled verdict (micro-ticks never
+        ship replica rounds, so nothing arbitrates them remotely), and
+        FIFO preserved within each ClusterQueue (heads pop in heap
+        order, exactly like the full sweep). KUEUE_TPU_NO_MICROTICK=1
+        makes this a no-op — decisions then match the barrier-paced
+        trail byte for byte."""
+        if not self.microtick_enabled():
+            return 0
+        queues = self.queues
+        if not queues.has_dirty_cohorts():
+            return 0
+        dirty = queues.drain_dirty_cohorts()
+        if not dirty:
+            return 0
+        with TRACER.tick("microtick"):
+            with TRACER.phase("microtick.route") as rsp:
+                snapshot = self._mirror.refresh()
+                split = frozenset()
+                if self.batch_solver is not None:
+                    sv_fn = getattr(self.batch_solver, "shard_view", None)
+                    sv = sv_fn(snapshot) if sv_fn is not None else None
+                    if sv is not None:
+                        split = sv[0].split_roots
+                rctx = self.replica_ctx
+                rsplit = rctx.split_roots if rctx is not None \
+                    else frozenset()
+                events: Dict[str, str] = {}
+                deferred = 0
+                overflow = 0
+                # Submit events first: the micro-tick is a LATENCY
+                # path. A mass quota-release storm (hundreds of cohorts
+                # flushed by a completion wave) is throughput work the
+                # full tick's batched sweep does better — cohorts past
+                # the CQ budget are re-marked and handed back to it.
+                ordered = sorted(
+                    dirty.items(),
+                    key=lambda kv: (0 if kv[1].startswith("submit")
+                                    else 1, kv[0]))
+                for key, event in ordered:
+                    members = queues.cohort_member_names(key)
+                    eligible = bool(members)
+                    for name in members:
+                        cq = snapshot.cluster_queues.get(name)
+                        if cq is None:
+                            continue
+                        cohort = cq.cohort
+                        if cohort is not None and (
+                                cohort.is_hierarchical()
+                                or cohort.root_name in split
+                                or cohort.root_name in rsplit):
+                            eligible = False
+                            break
+                    if not eligible:
+                        deferred += 1
+                        continue
+                    if events and len(events) + len(members) \
+                            > self.MICROTICK_MAX_CQS:
+                        overflow += 1
+                        queues.remark_dirty(key, event)
+                        continue
+                    for name in members:
+                        events[name] = event
+                rsp.set("dirty", len(dirty))
+                rsp.set("deferred", deferred)
+                rsp.set("overflow", overflow)
+                rsp.set("cqs", len(events))
+            if not events:
+                return 0
+            # Drain loop: one head pops per CQ per round (the sweep
+            # semantics), so a burst deeper than one per queue needs
+            # several rounds — keep going while admissions flow, up to
+            # a bound that keeps a single micro-tick from starving the
+            # caller. An early stop with pending left re-marks the
+            # cohorts dirty so the NEXT micro-tick continues instead of
+            # waiting for a fresh event.
+            total = 0
+            names = sorted(events)
+            for _round in range(self.MICROTICK_MAX_ROUNDS):
+                heads = queues.pop_heads_for(names)
+                if not heads:
+                    return total
+                tick = self._dispatch(heads, snapshot=snapshot,
+                                      micro=events)
+                admitted = self.schedule_finish(tick)
+                total += admitted
+                if not admitted:
+                    return total
+                # The finish may have moved the mirror; later rounds
+                # must gate against the refreshed view.
+                snapshot = self._mirror.refresh()
+            for name in names:
+                if self.queues.pending(name):
+                    self.queues.mark_dirty_cq(
+                        name, "micro-tick round cap")
+            return total
+
+    # One micro-tick drains at most this many rounds before handing the
+    # rest back (as fresh dirty marks) — bounds the caller's stall while
+    # a deep burst drains.
+    MICROTICK_MAX_ROUNDS = 16
+    # ... and touches at most this many ClusterQueues: past the budget a
+    # dirty cohort is re-marked for the full tick (whose batched sweep
+    # is the right tool for completion-wave storms). One cohort whose
+    # member count alone exceeds the budget still runs whole — cohorts
+    # are the atomic admission domain.
+    MICROTICK_MAX_CQS = 64
 
     # -- nomination (scheduler.go:317-351) ----------------------------------
 
@@ -1099,7 +1294,7 @@ class Scheduler:
 
     def _admission_cycle(self, entries: List[Entry], snapshot: Snapshot,
                          revalidate: bool = False,
-                         usage_csr=None) -> int:
+                         usage_csr=None, micro: bool = False) -> int:
         cycle_cohorts_usage: Dict[str, FlavorResourceQuantities] = {}
         # Root-merged view of the same reservations: the preempt skip gate
         # compares against the whole tree's cycle usage (for flat cohorts
@@ -1498,7 +1693,12 @@ class Scheduler:
             _cycle_one(e, cq, mode)
 
         # -- phase B: cross-replica commit protocol ---------------------
-        if rctx is not None:
+        if rctx is not None and not micro:
+            # Micro-ticks NEVER ship a reconcile round: their
+            # eligibility gate keeps replica-split roots out (so
+            # deferred_replica is empty by construction), and the
+            # coordinator barrier counts exactly one round per replica
+            # per FULL tick — an extra mid-window round would desync it.
             self._cycle_replica_candidates = len(deferred_replica)
             self._replica_reconcile(deferred_replica, snapshot,
                                     _commit_replica)
@@ -1510,11 +1710,12 @@ class Scheduler:
             # their original cycle position.
             pending_assumes.sort(key=lambda item: item[0].cycle_pos)
             preempting.sort(key=lambda item: item[0].cycle_pos)
-        with TRACER.phase("admit.flush"):
-            admitted = self._flush_assumes(pending_assumes, snapshot,
-                                           usage_csr=usage_csr)
-        for e, cq in preempting:
-            self._issue_preemptions(e, cq)
+        with TRACER.phase("tick.stage.flush"):
+            with TRACER.phase("admit.flush"):
+                admitted = self._flush_assumes(pending_assumes, snapshot,
+                                               usage_csr=usage_csr)
+            for e, cq in preempting:
+                self._issue_preemptions(e, cq)
         return admitted
 
     def _reconcile_deferred(self, deferred, sv, snapshot: Snapshot,

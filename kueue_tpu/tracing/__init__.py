@@ -17,6 +17,7 @@ from __future__ import annotations
 import os
 
 from kueue_tpu.tracing.tracer import (
+    DEVICE_LANE,
     NULL_SPAN,
     TickTrace,
     Tracer,
@@ -34,6 +35,7 @@ TRACER = Tracer(enabled=os.environ.get("KUEUE_TPU_TRACE") == "1")
 from kueue_tpu.tracing.explain import ExplainStore, build_record  # noqa: E402
 
 __all__ = [
+    "DEVICE_LANE",
     "ExplainStore",
     "NULL_SPAN",
     "TRACER",

@@ -77,6 +77,12 @@ class _NullSpan:
 
 NULL_SPAN = _NullSpan()
 
+# Synthetic tid for spans that time the DEVICE-side solve window
+# (dispatch -> fetch) rather than host execution: exporting them on their
+# own Perfetto lane makes the stage pipelining visible — tick T's
+# in-flight solve overlapping tick T+1's host-side ingest/encode spans.
+DEVICE_LANE = 99
+
 
 class _Span:
     """One timed region. Context-manager; `set()` attaches attributes."""
@@ -269,6 +275,25 @@ class Tracer:
             return lk
         return _LockSpan(self, lk, name)
 
+    def record_span(self, name: str, t0: float, t1: float,
+                    lane: Optional[int] = None,
+                    attrs: Optional[Dict] = None) -> None:
+        """Record an already-timed region — the device-solve window
+        between `solve_async`'s dispatch and `collect`'s fetch, which no
+        with-block can bracket because host code runs other stages in
+        between. `lane` substitutes a synthetic tid (see DEVICE_LANE) so
+        Perfetto renders it on its own track, where its overlap with the
+        NEXT tick's host-side stage spans is visible."""
+        if not self.enabled:
+            return
+        sp = _Span(self, name)
+        sp.tid = lane if lane is not None else threading.get_ident()
+        sp.t0 = t0
+        sp.t1 = t1
+        if attrs:
+            sp.attrs = dict(attrs)
+        self._record(sp)
+
     def tick(self, label: str = "tick"):
         """Open a tick grouping: spans finished while it is open attach to
         it, and the finished tick enters the ring/slowest buffers."""
@@ -361,7 +386,12 @@ class Tracer:
         else:
             ticks = self.ticks()
         events = [{"ph": "M", "name": "process_name", "pid": 1, "ts": 0,
-                   "args": {"name": "kueue-tpu"}}]
+                   "args": {"name": "kueue-tpu"}},
+                  # The device-solve lane's label: spans recorded with
+                  # lane=DEVICE_LANE (tick.stage.solve) group here.
+                  {"ph": "M", "name": "thread_name", "pid": 1,
+                   "tid": DEVICE_LANE, "ts": 0,
+                   "args": {"name": "device solve (in flight)"}}]
         for rec in ticks:
             for span in rec.spans:
                 ev = self._event(span)

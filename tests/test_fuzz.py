@@ -48,23 +48,26 @@ def test_generator_covers_the_draw_space():
 
 def test_lattice_covers_the_required_axes():
     """Acceptance shape: engine x shards {1,2} x replicas {1,2} x one
-    kill-switch set, plus the fail-over and loan drill points on the
-    rotating seed subsets."""
+    kill-switch set, plus the fail-over / loan / degraded-window drill
+    points and the micro-tick on/off pair on the rotating seed
+    subsets."""
     axes = {"engines": set(), "shards": set(), "replicas": set(),
-            "kill": set(), "drills": set()}
+            "kill": set(), "drills": set(), "micro": set()}
     for s in range(25):
         for p in lattice.default_lattice(generator.draw_scenario(s)):
             axes["engines"].add(p.axes()["engine"])
             axes["shards"].add(p.shards)
             axes["replicas"].add(p.replicas)
             axes["kill"].add(p.kill_switches)
+            axes["micro"].add(p.micro)
             if p.drill:
                 axes["drills"].add(p.drill)
     assert {"referee", "jax"} <= axes["engines"]
     assert {1, 2} <= axes["shards"]
     assert {1, 2} <= axes["replicas"]
     assert axes["kill"] == {False, True}
-    assert axes["drills"] == {"failover", "loan"}
+    assert axes["drills"] == {"failover", "loan", "degraded"}
+    assert axes["micro"] == {False, True}
 
 
 def test_replica_points_only_inside_the_identity_envelope():

@@ -1,0 +1,348 @@
+"""Event-driven admission: dirty-cohort micro-ticks + eager encode.
+
+The tentpole contract (PR 15): between full ticks, a micro-tick solves
+ONLY the cohorts dirtied since the last tick — flat cohorts are
+solve-independent, hierarchical/split roots defer to the full tick —
+pinned by linearizability-style invariants (no oversubscription, no
+unjournaled take-backs, per-CQ FIFO) instead of byte identity, with
+KUEUE_TPU_NO_MICROTICK=1 restoring the barrier-paced trail exactly. The
+replica half: a worker blocked behind a slow sibling keeps admitting its
+own flat cohorts via micro-ticks and predispatches its next tick's
+encode (eager encode), abandoned whenever a state-changing message
+lands first.
+"""
+
+import os
+import time
+
+import pytest
+
+from kueue_tpu.api.types import (
+    ClusterQueue,
+    CohortSpec,
+    FlavorQuotas,
+    LocalQueue,
+    PodSet,
+    ResourceFlavor,
+    ResourceGroup,
+    Workload,
+)
+from kueue_tpu.controllers.runtime import Framework
+from kueue_tpu.metrics import REGISTRY
+from kueue_tpu.models.flavor_fit import BatchSolver
+
+
+def build_fw(num_cqs=4, quota=8, cohort_of=None, depth=1, solver=True,
+             cohort_specs=()):
+    fw = Framework(batch_solver=BatchSolver() if solver else None,
+                   pipeline_depth=depth)
+    fw.create_resource_flavor(ResourceFlavor.make("default"))
+    for spec in cohort_specs:
+        fw.create_cohort(spec)
+    for c in range(num_cqs):
+        fw.create_cluster_queue(ClusterQueue(
+            name=f"cq-{c}",
+            cohort=cohort_of(c) if cohort_of else "",
+            resource_groups=(ResourceGroup(
+                ("cpu",), (FlavorQuotas.make("default", cpu=quota),)),)))
+        fw.create_local_queue(LocalQueue(
+            name=f"lq-{c}", namespace="default", cluster_queue=f"cq-{c}"))
+    return fw
+
+
+def submit(fw, name, lq, cpu=2, ts=1.0, priority=0):
+    wl = Workload(name=name, queue_name=lq, creation_time=ts,
+                  priority=priority,
+                  pod_sets=[PodSet.make("main", count=1, cpu=cpu)])
+    fw.submit(wl)
+    return wl
+
+
+def usage_cpu(fw, cq_name):
+    return fw.cache.cluster_queues[cq_name].usage.get(
+        "default", {}).get("cpu", 0)
+
+
+class TestMicrotick:
+    def test_submit_admits_without_a_tick(self):
+        fw = build_fw(cohort_of=lambda c: f"pool-{c % 2}")
+        submit(fw, "w0", "lq-0")
+        assert fw.microtick() == 1
+        assert usage_cpu(fw, "cq-0") == 2000
+        assert fw.scheduler.metrics.microticks >= 1
+        assert fw.scheduler.metrics.micro_admitted == 1
+
+    def test_kill_switch_makes_it_a_noop(self, monkeypatch):
+        monkeypatch.setenv("KUEUE_TPU_NO_MICROTICK", "1")
+        fw = build_fw()
+        submit(fw, "w0", "lq-0")
+        assert fw.microtick() == 0
+        assert usage_cpu(fw, "cq-0") == 0
+        monkeypatch.delenv("KUEUE_TPU_NO_MICROTICK")
+        # Marks survived the disabled call; the next tick admits.
+        assert fw.tick() == 1
+
+    def test_explain_reason_names_the_dirty_event(self):
+        fw = build_fw()
+        submit(fw, "w0", "lq-0")
+        fw.microtick()
+        rec = fw.scheduler.explain.last_decision("default/w0")
+        assert rec["outcome"] == "Admitted"
+        assert rec["reason"] == "admitted: micro-tick (submit w0)"
+
+    def test_metrics_counters_move(self):
+        before = REGISTRY.microticks_total.get()
+        fw = build_fw()
+        submit(fw, "w0", "lq-0")
+        fw.microtick()
+        assert REGISTRY.microticks_total.get() == before + 1
+        assert REGISTRY.microtick_latency_seconds.totals.get((), 0) >= 1
+
+    def test_hierarchical_roots_defer_to_the_full_tick(self):
+        specs = (CohortSpec(name="leaf", parent="root"),
+                 CohortSpec(name="root"))
+        fw = build_fw(cohort_of=lambda c: "leaf", cohort_specs=specs)
+        submit(fw, "w0", "lq-0")
+        assert fw.microtick() == 0          # split/hier roots park
+        assert usage_cpu(fw, "cq-0") == 0
+        assert fw.tick() == 1               # the full tick admits
+
+    def test_referee_mode_microticks_too(self):
+        fw = build_fw(solver=False)
+        submit(fw, "w0", "lq-0")
+        assert fw.microtick() == 1
+
+    def test_deep_burst_drains_in_one_call(self):
+        fw = build_fw(num_cqs=2, quota=32)
+        for i in range(6):
+            submit(fw, f"w{i}", "lq-0", cpu=2, ts=float(i))
+        # One head pops per CQ per round; the drain loop keeps going
+        # while admissions flow.
+        assert fw.microtick() == 6
+        assert usage_cpu(fw, "cq-0") == 12000
+
+    def test_fifo_within_cq_and_no_oversubscription(self):
+        fw = build_fw(num_cqs=2, quota=8, cohort_of=lambda c: "pool")
+        order = []
+        orig = fw.scheduler.apply_admission
+
+        def apply(wl):
+            ok = orig(wl)
+            if ok:
+                order.append(wl.name)
+            return ok
+
+        fw.scheduler.apply_admission = apply
+        for i in range(10):
+            submit(fw, f"w{i:02d}", "lq-0", cpu=2, ts=float(i))
+            fw.microtick()
+        # Quota 8 cpu per CQ, 16 in the flat pool: never oversubscribed
+        # at milli resolution...
+        total = usage_cpu(fw, "cq-0") + usage_cpu(fw, "cq-1")
+        assert total <= 16000
+        # ...and the admitted prefix is exactly FIFO within the CQ.
+        assert order == sorted(order)
+
+    def test_pipelined_full_ticks_interleaved_with_microticks(self):
+        """Micro admissions land between pipelined dispatch and finish:
+        the staleness re-validation must catch them (never overadmit)."""
+        fw = build_fw(num_cqs=4, quota=8, depth=4)
+        for i in range(6):
+            for c in range(4):
+                submit(fw, f"wl-{c}-{i}", f"lq-{c}", cpu=2,
+                       ts=float(i * 4 + c))
+        fw.tick()                     # dispatch in flight at depth 4
+        for c in range(4):
+            submit(fw, f"burst-{c}", f"lq-{c}", cpu=2, ts=100.0 + c)
+        fw.microtick()                # commits under the in-flight solve
+        fw.run_until_settled(max_ticks=80)
+        for c in range(4):
+            assert usage_cpu(fw, f"cq-{c}") <= 8000
+
+    def test_quiescent_goldens_unaffected_by_standing_marks(self):
+        """A full tick's heads sweep consumes standing dirty marks, so
+        micro-disabled deployments accumulate nothing."""
+        fw = build_fw()
+        submit(fw, "w0", "lq-0")
+        assert fw.queues.has_dirty_cohorts()
+        fw.tick()
+        assert not fw.queues.has_dirty_cohorts()
+
+    def test_stage_spans_and_device_lane_in_trace(self):
+        from kueue_tpu.tracing import DEVICE_LANE, TRACER
+
+        TRACER.reset()
+        TRACER.configure(enabled=True)
+        try:
+            fw = build_fw()
+            for c in range(4):
+                submit(fw, f"w{c}", f"lq-{c}", ts=float(c))
+            fw.tick()
+            doc = TRACER.export_chrome()
+        finally:
+            TRACER.configure(enabled=False)
+            TRACER.reset()
+        names = {ev["name"] for ev in doc["traceEvents"]}
+        assert "tick.stage.ingest" in names
+        assert "tick.stage.encode" in names
+        assert "tick.stage.flush" in names
+        solve = [ev for ev in doc["traceEvents"]
+                 if ev["name"] == "tick.stage.solve"]
+        assert solve and all(ev["tid"] == DEVICE_LANE for ev in solve)
+
+
+class TestDirtyCohortRouting:
+    def test_quota_release_marks_the_cohort(self):
+        fw = build_fw(num_cqs=2, quota=4, cohort_of=lambda c: "pool")
+        a = submit(fw, "a", "lq-0", cpu=4, ts=1.0)
+        submit(fw, "b", "lq-1", cpu=4, ts=2.0)
+        fw.run_until_settled(max_ticks=20)
+        assert not fw.queues.has_dirty_cohorts()
+        # b parked NoFit?  quota 4 each + flat pool: both fit.  Fill it:
+        submit(fw, "c", "lq-0", cpu=4, ts=3.0)
+        fw.run_until_settled(max_ticks=20)
+        assert usage_cpu(fw, "cq-0") == 4000
+        # Finishing `a` flushes the cohort -> dirty -> micro admits c.
+        fw.finish(a)
+        assert fw.queues.has_dirty_cohorts()
+        assert fw.microtick() == 1
+        assert usage_cpu(fw, "cq-0") == 4000
+
+    def test_drain_returns_latest_event_and_clears(self):
+        fw = build_fw()
+        submit(fw, "w0", "lq-0")
+        marks = fw.queues.drain_dirty_cohorts()
+        assert marks and not fw.queues.has_dirty_cohorts()
+        assert any(ev.startswith("submit") for ev in marks.values())
+
+
+class TestReplicaBarrierStall:
+    """Satellite 4: one laggard must no longer pace everyone's
+    throughput. Worker 1's flat cohorts admit via micro-ticks the moment
+    arrivals land, while worker 0 sleeps inside every barrier tick; and
+    fast workers predispatch their next tick's encode at the barrier."""
+
+    def _cluster(self, rt, n_cqs=4):
+        rt.create_resource_flavor(ResourceFlavor.make("default"))
+        for c in range(n_cqs):
+            rt.create_cluster_queue(ClusterQueue(
+                name=f"cq-{c}", cohort=f"pool-{c}",
+                resource_groups=(ResourceGroup(
+                    ("cpu",),
+                    (FlavorQuotas.make("default", cpu=64),)),)))
+            rt.create_local_queue(LocalQueue(
+                name=f"lq-{c}", namespace="default",
+                cluster_queue=f"cq-{c}"))
+
+    def _drive(self, micro: bool, barriers=3, per_round=4):
+        from kueue_tpu.controllers.replica_runtime import ReplicaRuntime
+
+        rt = ReplicaRuntime(2, spawn=False, engine="host",
+                            microtick=micro, drill_slow={0: 0.05})
+        try:
+            self._cluster(rt)
+            rt.tick()
+            seq = [0]
+            t0 = time.perf_counter()
+            for _ in range(barriers):
+                for _ in range(per_round):
+                    seq[0] += 1
+                    for c in range(4):
+                        rt.submit(Workload(
+                            name=f"w-{c}-{seq[0]}", queue_name=f"lq-{c}",
+                            creation_time=float(seq[0]),
+                            pod_sets=[PodSet.make("m", count=1, cpu=1)]))
+                time.sleep(0.15)   # let workers drain + micro-tick
+                rt.tick()
+            wall = time.perf_counter() - t0
+            dump = rt.dump()
+            admitted = sum(len(v) for v in dump["admitted"].values())
+            return admitted, wall, rt.stats_last
+        finally:
+            rt.close()
+
+    def test_throughput_no_longer_barrier_paced(self):
+        # Micro OFF: each barrier admits ONE head per CQ -> 3 barriers
+        # admit ~3 per CQ of the 12 queued.
+        admitted_off, _, _ = self._drive(micro=False)
+        # Micro ON: every arrival admits between barriers -> all 48.
+        admitted_on, _, stats = self._drive(micro=True)
+        assert admitted_off <= 4 * 4   # barrier-paced (one/CQ/barrier +1)
+        assert admitted_on == 4 * 3 * 4  # everything, laggard or not
+        assert stats["micro_admitted"] > 0
+
+    def test_eager_encode_uses_the_barrier_idle_window(self):
+        from kueue_tpu.controllers.replica_runtime import ReplicaRuntime
+
+        rt = ReplicaRuntime(2, spawn=False, engine="host")
+        try:
+            self._cluster(rt)
+            # Deep per-CQ backlog: consecutive barriers with NO messages
+            # in between keep every predispatch valid.
+            for i in range(6):
+                for c in range(4):
+                    rt.submit(Workload(
+                        name=f"w-{c}-{i}", queue_name=f"lq-{c}",
+                        creation_time=float(i * 4 + c),
+                        pod_sets=[PodSet.make("m", count=1, cpu=1)]))
+            time.sleep(0.1)
+            used = abandoned = 0
+            for _ in range(7):
+                s = rt.tick()
+                used += s["predispatch"][0]
+                abandoned += s["predispatch"][1]
+            assert used > 0
+            dump = rt.dump()
+            assert sum(len(v) for v in dump["admitted"].values()) == 24
+        finally:
+            rt.close()
+
+    def test_eager_encode_abandons_on_new_state(self):
+        """A message between barriers invalidates the predispatch — the
+        decisions stay byte-identical to the lazy path."""
+        from kueue_tpu.controllers.replica_runtime import ReplicaRuntime
+
+        def drive(eager):
+            rt = ReplicaRuntime(2, spawn=False, engine="host",
+                                eager_encode=eager)
+            try:
+                self._cluster(rt)
+                trail = []
+                used = 0
+                for i in range(8):
+                    for c in range(4):
+                        rt.submit(Workload(
+                            name=f"w-{c}-{i}", queue_name=f"lq-{c}",
+                            creation_time=float(i * 4 + c),
+                            pod_sets=[PodSet.make("m", count=1, cpu=1)]))
+                    time.sleep(0.05)
+                    s = rt.tick()
+                    used += s["predispatch"][0]
+                    trail.append(tuple(sorted(s["admitted"])))
+                return trail, rt.dump()["admitted"], used
+            finally:
+                rt.close()
+
+        trail_eager, final_eager, used = drive(True)
+        trail_lazy, final_lazy, _ = drive(False)
+        assert trail_eager == trail_lazy
+        assert final_eager == final_lazy
+        # Every predispatch was invalidated by the submit batches.
+        assert used == 0
+
+
+class TestMicrotickScopeBudget:
+    def test_overflow_cohorts_hand_back_to_the_full_tick(self):
+        from kueue_tpu.scheduler.scheduler import Scheduler
+
+        n = Scheduler.MICROTICK_MAX_CQS + 8
+        fw = build_fw(num_cqs=n, quota=8)
+        for c in range(n):
+            submit(fw, f"w{c}", f"lq-{c}", ts=float(c))
+        admitted = fw.microtick()
+        assert 0 < admitted <= Scheduler.MICROTICK_MAX_CQS
+        # The overflow was re-marked; a second micro (or the tick)
+        # finishes the job.
+        admitted += fw.microtick()
+        admitted += fw.tick()
+        assert admitted == n
