@@ -11,7 +11,8 @@ PYTHON ?= python
 
 .PHONY: help test test-fast bench bench-smoke trace-smoke multichip-smoke \
 	replica-smoke multihost-smoke fleet-smoke hetero-smoke fuzz-smoke \
-	fuzz-nightly fuzz-soak native lint verify-static install serve dryrun
+	fuzz-nightly fuzz-soak native lint verify-static verify-threads \
+	verify-knobs knob-table install serve dryrun
 
 help:
 	@echo "kueue-tpu developer targets:"
@@ -22,6 +23,13 @@ help:
 	@echo "  make verify-static  ALL analysis engines: ast + flow (lock"
 	@echo "                      graph, ledger flow) + trace (kueueverify"
 	@echo "                      jaxpr rules TRC01-04; needs jax)"
+	@echo "  make verify-threads fast slice: just the cross-thread engine"
+	@echo "                      (THR01 shared-state races, THR02"
+	@echo "                      unbounded blocking on service threads)"
+	@echo "  make verify-knobs   the knob contract: KNOB01 + registry/"
+	@echo "                      README-table sync tests"
+	@echo "  make knob-table     print the README knob table generated"
+	@echo "                      from the kueue_tpu.knobs registry"
 	@echo "  make bench          full-scale benchmark (north-star shapes)"
 	@echo "  make bench-smoke    tiny-shape bench for CI/laptops"
 	@echo "  make trace-smoke    end-to-end trace: run the CLI with"
@@ -439,6 +447,28 @@ lint:
 # unlike `make lint` which stays import-free).
 verify-static:
 	$(PYTHON) -m kueue_tpu.analysis --engine all --fail-on error kueue_tpu/
+
+# Fast thread-safety slice: only the cross-thread shared-state engine
+# (THR01 inconsistent locking across thread roots, THR02 unbounded
+# blocking calls on service threads) over the threaded surfaces —
+# import-free, sub-second, the right loop while editing transport code.
+verify-threads:
+	$(PYTHON) -m kueue_tpu.analysis --select THR01 --select THR02 \
+	  --fail-on error kueue_tpu/
+
+# The knob contract end to end: KNOB01 (no raw KUEUE_TPU_* env reads,
+# no unregistered accessor names, no dead registry entries) plus the
+# registry sanity + README-table drift tests.
+verify-knobs:
+	$(PYTHON) -m kueue_tpu.analysis --select KNOB01 \
+	  --fail-on error kueue_tpu/
+	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_knobs.py -q
+
+# The README "Environment knobs" table, generated from the registry —
+# paste between the knob-table markers in README.md when knobs change
+# (tests/test_knobs.py and CI fail on drift).
+knob-table:
+	@$(PYTHON) -c "from kueue_tpu import knobs; print(knobs.markdown_table())"
 
 install:
 	$(PYTHON) -m pip install -e .

@@ -23,6 +23,7 @@ import uuid
 from typing import List, Optional, Sequence
 
 from kueue_tpu import config as config_mod
+from kueue_tpu import knobs
 from kueue_tpu import features
 from kueue_tpu.api import serialization
 from kueue_tpu.controllers.debugger import Dumper
@@ -385,7 +386,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
 
     n_replicas = (args.replicas if args.replicas is not None
                   else replicas_from_env())
-    if os.environ.get("KUEUE_TPU_NO_REPLICA", "") == "1":
+    if knobs.flag("KUEUE_TPU_NO_REPLICA"):
         n_replicas = 0  # the kill switch beats the flag too
     if n_replicas:
         return _replica_main(args, cfg, n_replicas)

@@ -8,6 +8,11 @@ Rule families (see `python -m kueue_tpu.analysis --list-rules`):
     LOCK01-02 lock discipline: blocking under a lock, inconsistent guarding
     API01-03  API hygiene: mutable defaults, freezable dataclasses,
               serialization roundtrip coverage
+    OBS01     raw time.monotonic/perf_counter timing bypassing the tracer
+    PERF01    quadratic full-scan idioms on hot tick paths
+    THR01-02  cross-thread shared state without a consistent lock;
+              unbounded blocking calls on service thread roots
+    KNOB01    KUEUE_TPU_* env knobs bypassing the knob-contract registry
     W001      stale `# kueuelint: disable=RULE` suppressions
 
   flow engine (`--engine flow`; whole-program AST flow analysis)
@@ -34,6 +39,8 @@ from kueue_tpu.analysis.core import (  # noqa: F401
 # stays jax-free (the ast/flow engines never need it).
 from kueue_tpu.analysis import api_rules, jit_rules, lock_rules  # noqa: F401
 from kueue_tpu.analysis import flow_rules, trace_rules  # noqa: F401
+from kueue_tpu.analysis import obs_rules, perf_rules  # noqa: F401
+from kueue_tpu.analysis import knob_rules, thread_rules  # noqa: F401
 from kueue_tpu.analysis.reporters import (  # noqa: F401
     render_json, render_text)
 

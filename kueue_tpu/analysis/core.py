@@ -216,7 +216,7 @@ def run_analysis(paths: Sequence[str],
     # Rule modules register on import; pulled in here to avoid import cycles.
     from kueue_tpu.analysis import api_rules, jit_rules, lock_rules  # noqa: F401
     from kueue_tpu.analysis import flow_rules, obs_rules, trace_rules  # noqa: F401
-    from kueue_tpu.analysis import perf_rules  # noqa: F401
+    from kueue_tpu.analysis import knob_rules, perf_rules, thread_rules  # noqa: F401
 
     files = collect_files(paths)
     ctx = AnalysisContext(files)

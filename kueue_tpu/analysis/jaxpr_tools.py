@@ -18,6 +18,20 @@ Three capabilities, all operating on the ClosedJaxpr a kernel lowers to:
     an overflow hazard (TRC02). Scan carries are widened linearly by trip
     count, which keeps monotone accumulators finite and sound.
 
+Packed byte-buffer kernels are covered by a second abstract domain: a
+`Packed` value is a window into a uint8 argument whose byte ranges carry
+per-field intervals (the kernel's wire layout, declared by the roster's
+`packed_seeds`). The domain survives the canonical unpack chain — 1-D
+`slice` shifts the window, `reshape` is byte-order-preserving, and
+`bitcast_convert_type` only changes the element width — so when a field
+finally reaches arithmetic it degrades to exactly its seeded interval
+(sentinel fields stay 2^62, bool fields stay [0, 1]) instead of the whole
+dtype. `select_n` additionally refines each case's interval under the
+selecting predicate when that predicate is a comparison over the case
+operands (mask-aware `where`), and `pallas_call` bodies are interpreted
+with ref semantics (`get`/`swap`/`addupdate` over a mutable cell, widened
+by the grid size like a scan carry).
+
 This module imports jax lazily inside functions: the analysis package
 itself must stay importable (and the ast/flow engines runnable) on hosts
 without jax.
@@ -26,7 +40,8 @@ without jax.
 from __future__ import annotations
 
 import re
-from typing import Callable, Dict, Iterable, List, Optional, Tuple
+from typing import (
+    Callable, Dict, Iterable, List, Optional, Sequence, Tuple)
 
 INT64_MAX = 2**63 - 1
 INT64_MIN = -(2**63)
@@ -160,6 +175,96 @@ class Interval:
 UNKNOWN = Interval(None, None)
 
 
+class Packed:
+    """A window into a packed byte buffer whose wire layout is known.
+
+    `sections` is a tuple of `(start, stop, width, lo, hi)` in byte
+    coordinates of the ORIGINAL buffer argument: bytes [start, stop)
+    reinterpret (little-endian, as the kernels pack them) as integers of
+    `width` bytes with values in [lo, hi]. The window is bytes
+    [base, base + nbytes) viewed as elements of `elem_bytes` each.
+
+    The domain is closed under the unpack chain — rank-1 unit-stride
+    `slice` (shifts the window), `reshape`/`squeeze`/`expand_dims`
+    (byte-order preserving), `bitcast_convert_type` (element width
+    change) — and degrades to an Interval the moment anything else
+    consumes it: the union of the overlapped sections when the window is
+    fully covered at a matching width and aligned on element boundaries,
+    UNKNOWN otherwise (an unknown never produces a false finding)."""
+
+    __slots__ = ("base", "nbytes", "elem_bytes", "sections")
+
+    def __init__(self, base: int, nbytes: int, elem_bytes: int,
+                 sections: Tuple[Tuple[int, int, int, int, int], ...]):
+        self.base = base
+        self.nbytes = nbytes
+        self.elem_bytes = elem_bytes
+        self.sections = sections
+
+    def to_interval(self) -> Interval:
+        lo = self.base
+        hi = self.base + self.nbytes
+        out: Optional[Interval] = None
+        covered = 0
+        for start, stop, width, slo, shi in self.sections:
+            os_, oe = max(start, lo), min(stop, hi)
+            if os_ >= oe:
+                continue
+            if width != self.elem_bytes:
+                return UNKNOWN
+            # A window that enters a field mid-element fuses bytes of two
+            # fields into one value — unknowable.
+            if (os_ - lo) % self.elem_bytes or (oe - os_) % self.elem_bytes:
+                return UNKNOWN
+            covered += oe - os_
+            iv = Interval(slo, shi)
+            out = iv if out is None else out.union(iv)
+        if out is None or covered < self.nbytes:
+            return UNKNOWN
+        return out
+
+    # Interval-protocol shims so a Packed that leaks past the degrade
+    # boundary (e.g. a kernel returning a raw window) stays harmless.
+    @property
+    def known(self) -> bool:
+        return self.to_interval().known
+
+    @property
+    def lo(self):
+        return self.to_interval().lo
+
+    @property
+    def hi(self):
+        return self.to_interval().hi
+
+    def union(self, other) -> Interval:
+        return self.to_interval().union(as_interval(other))
+
+    def __repr__(self):
+        return (f"Packed[{self.base}:{self.base + self.nbytes}]"
+                f"x{self.elem_bytes}")
+
+
+def as_interval(x) -> Interval:
+    return x.to_interval() if isinstance(x, Packed) else x
+
+
+def packed_layout(
+        fields: Sequence[Tuple[int, int, Tuple[int, int]]]) -> Packed:
+    """Declare a packed byte-buffer argument's wire layout as a seed
+    value: `fields` lists `(count, width, (lo, hi))` in pack order —
+    `count` elements of `width` bytes each, valued in [lo, hi] — and the
+    result is the whole-buffer `Packed` window the roster hands to the
+    interval analysis in place of a flat Interval."""
+    sections = []
+    off = 0
+    for count, width, (lo, hi) in fields:
+        nbytes = int(count) * int(width)
+        sections.append((off, off + nbytes, int(width), int(lo), int(hi)))
+        off += nbytes
+    return Packed(0, off, 1, tuple(sections))
+
+
 def _dtype_range(dtype) -> Optional[Tuple[int, int]]:
     import numpy as np
 
@@ -224,12 +329,105 @@ def _reduced_count(eqn) -> int:
     return max(_shape_size(in_shape) // _shape_size(out_shape), 1)
 
 
+def _const_interval(val) -> Interval:
+    """Interval of a concrete constant (closed-jaxpr const)."""
+    import numpy as np
+
+    try:
+        arr = np.asarray(val)
+        if arr.dtype.kind in "iub" and arr.size:
+            return Interval(int(arr.min()), int(arr.max()))
+    except Exception:
+        pass
+    return UNKNOWN
+
+
+class _Scope:
+    """Var-resolution view for cross-call pattern chasing: producers and
+    intervals resolve at this jaxpr level, falling through to the
+    enclosing level for vars bound to outer values (call invars). The
+    interval env lives only at the root level — inner scopes read
+    through their varmap."""
+
+    __slots__ = ("prods", "env", "parent", "varmap")
+
+    def __init__(self, prods: Dict, env: Optional[Dict],
+                 parent: Optional["_Scope"] = None,
+                 varmap: Optional[Dict] = None):
+        self.prods = prods
+        self.env = env
+        self.parent = parent
+        self.varmap = varmap or {}
+
+    @classmethod
+    def inner(cls, closed, call_eqn, parent: "_Scope") -> "_Scope":
+        jaxpr = getattr(closed, "jaxpr", closed)
+        prods = {ov: e for e in jaxpr.eqns for ov in e.outvars}
+        varmap = dict(zip(jaxpr.invars, call_eqn.invars))
+        env: Dict = {}
+        for cv, val in zip(jaxpr.constvars,
+                           getattr(closed, "consts", ()) or ()):
+            env[cv] = _const_interval(val)
+        return cls(prods, env or None, parent, varmap)
+
+    def producer(self, v):
+        from jax.core import Literal
+
+        if isinstance(v, Literal):
+            return None, self
+        e = self.prods.get(v)
+        if e is not None:
+            return e, self
+        outer = self.varmap.get(v)
+        if outer is not None and self.parent is not None:
+            return self.parent.producer(outer)
+        return None, self
+
+    def read(self, v) -> Interval:
+        from jax.core import Literal
+
+        if isinstance(v, Literal):
+            try:
+                val = int(v.val)
+                return Interval(val, val)
+            except (TypeError, ValueError, OverflowError):
+                return UNKNOWN
+        if self.env is not None and v in self.env:
+            return as_interval(self.env[v])
+        outer = self.varmap.get(v)
+        if outer is not None and self.parent is not None:
+            return self.parent.read(outer)
+        return UNKNOWN
+
+
 class IntervalAnalysis:
     """One pass of abstract interpretation over a closed jaxpr."""
 
     def __init__(self, on_overflow: Callable[[Overflow], None]):
         self.on_overflow = on_overflow
         self._reported: set = set()
+        # (scope, varmap) frames linking descended sub-jaxpr runs (cond
+        # branches, calls, pallas bodies) to their callers, so pattern
+        # matchers can chase producer chains across the boundary.
+        self._outer_stack: List = []
+        # Contract intervals for pallas out/scratch refs, indexed from
+        # the first body invar past the kernel operands (they have no
+        # outer operand to seed through) — set from the roster's
+        # KernelSpec.scratch_seeds.
+        self._scratch_seeds: Optional[Dict[int, Tuple[int, int]]] = None
+
+    def _push_scope(self, prods: Dict, env: Dict,
+                    inner_invars, outer_invars) -> None:
+        if self._outer_stack:
+            pscope, pmap = self._outer_stack[-1]
+            scope = _Scope(prods, env, pscope, pmap)
+        else:
+            scope = _Scope(prods, env)
+        self._outer_stack.append(
+            (scope, dict(zip(inner_invars, outer_invars))))
+
+    def _pop_scope(self) -> None:
+        self._outer_stack.pop()
 
     # -- environment --------------------------------------------------------
 
@@ -245,11 +443,13 @@ class IntervalAnalysis:
                 return UNKNOWN
         return env.get(v, UNKNOWN)
 
-    def _check(self, eqn, lo: int, hi: int) -> Interval:
+    def _check(self, eqn, lo: int, hi: int, aval=None) -> Interval:
         """Flag the equation when [lo, hi] escapes the output dtype; the
         returned interval is clamped so one hazard does not cascade into
         a finding on every downstream consumer."""
-        rng = _dtype_range(getattr(eqn.outvars[0].aval, "dtype", None))
+        if aval is None:
+            aval = eqn.outvars[0].aval
+        rng = _dtype_range(getattr(aval, "dtype", None))
         if rng is None:
             return Interval(lo, hi)
         dlo, dhi = rng
@@ -259,7 +459,7 @@ class IntervalAnalysis:
                 self._reported.add(key)
                 self.on_overflow(Overflow(
                     eqn, eqn.primitive.name, lo, hi,
-                    str(eqn.outvars[0].aval.dtype), eqn_location(eqn)))
+                    str(aval.dtype), eqn_location(eqn)))
             return Interval(max(lo, dlo), min(hi, dhi))
         return Interval(lo, hi)
 
@@ -267,23 +467,76 @@ class IntervalAnalysis:
 
     def run(self, jaxpr, consts: List[Interval],
             args: List[Interval]) -> List[Interval]:
+        outs, _env = self.run_env(jaxpr, consts, args)
+        return outs
+
+    def run_env(self, jaxpr, consts: List[Interval],
+                args: List[Interval]) -> Tuple[List[Interval], Dict]:
+        """Like `run`, but also returns the final environment — the
+        pallas widening pass needs the end state of the mutated refs,
+        which are invars, not outvars."""
+        from jax.core import DropVar, Literal
+
         env: Dict = {}
+        prods: Dict = {}
         for v, iv in zip(jaxpr.constvars, consts):
             env[v] = iv
         for v, iv in zip(jaxpr.invars, args):
             env[v] = iv
         for eqn in jaxpr.eqns:
-            outs = self._eqn(eqn, [self._read(env, v) for v in eqn.invars])
+            ins = [self._read(env, v) for v in eqn.invars]
+            outs = self._eqn(eqn, ins, prods, env)
+            prim = eqn.primitive.name
+            if prim in ("swap", "addupdate") and eqn.invars \
+                    and not isinstance(eqn.invars[0], Literal):
+                # Ref mutation: the target is invars[0], not an outvar.
+                ref_v = eqn.invars[0]
+                old = as_interval(self._read(env, ref_v))
+                val = as_interval(ins[1]) if len(ins) > 1 else UNKNOWN
+                if prim == "addupdate" and old.known and val.known:
+                    acc = self._check(eqn, old.lo + min(val.lo, 0),
+                                      old.hi + max(val.hi, 0),
+                                      aval=getattr(ref_v.aval, "inner_aval",
+                                                   ref_v.aval))
+                    env[ref_v] = old.union(acc)
+                elif old.known and val.known:
+                    env[ref_v] = old.union(val)
+                else:
+                    env[ref_v] = UNKNOWN
             for v, iv in zip(eqn.outvars, outs):
-                from jax.core import DropVar
-
                 if not isinstance(v, DropVar):
                     env[v] = iv
-        return [self._read(env, v) for v in jaxpr.outvars]
+                    prods[v] = eqn
+        return [self._read(env, v) for v in jaxpr.outvars], env
 
-    def _eqn(self, eqn, ins: List[Interval]) -> List[Interval]:
+    # Prims the Packed domain passes through unchanged (byte order and
+    # element width preserved).
+    _PACKED_THRU = ("reshape", "squeeze", "expand_dims")
+    # Producer chains _origin follows when matching a select predicate's
+    # comparison operands to the select cases (value-preserving).
+    _ORIGIN_THRU = ("broadcast_in_dim", "reshape", "squeeze",
+                    "expand_dims", "copy", "transpose")
+
+    def _eqn(self, eqn, ins: List[Interval], prods: Optional[Dict] = None,
+             env: Optional[Dict] = None) -> List[Interval]:
         prim = eqn.primitive.name
         n_out = len(eqn.outvars)
+
+        if any(isinstance(x, Packed) for x in ins):
+            if prim in self._PACKED_THRU:
+                return [ins[0]] * n_out
+            if prim == "slice":
+                return [self._packed_slice(eqn, ins[0])] * n_out
+            if prim == "bitcast_convert_type":
+                p = ins[0]
+                width = _itemsize(getattr(eqn.outvars[0].aval, "dtype",
+                                          None))
+                if isinstance(p, Packed) and width:
+                    return [Packed(p.base, p.nbytes, width, p.sections)]
+                return [UNKNOWN] * n_out
+            if prim not in ("pjit", "closed_call", "core_call"):
+                # Anything else consumes the bytes as values.
+                ins = [as_interval(x) for x in ins]
 
         def allk(*ivs):
             return all(iv.known for iv in ivs)
@@ -313,6 +566,14 @@ class IntervalAnalysis:
             a = ins[0]
             if not a.known:
                 return [UNKNOWN]
+            if prim == "reduce_sum" and prods is not None \
+                    and env is not None:
+                onehot = self._onehot_factor(eqn, prods, env)
+                if onehot is not None:
+                    k, sel_iv = onehot
+                    lo = min(sel_iv.lo * k, 0)
+                    hi = max(sel_iv.hi * k, 0)
+                    return [self._check(eqn, lo, hi)]
             k = _reduced_count(eqn) if prim == "reduce_sum" else \
                 _shape_size(getattr(eqn.invars[0].aval, "shape", ()))
             return [self._check(eqn, min(a.lo * k, a.lo),
@@ -323,11 +584,43 @@ class IntervalAnalysis:
                     "eq", "ne", "lt", "le", "gt", "ge", "is_finite"):
             return [Interval(0, 1)] * n_out
         if prim == "select_n":
-            cases = ins[1:]
+            cases = [as_interval(c) for c in ins[1:]]
+            if prods is not None and env is not None and len(cases) == 2:
+                cases = self._refine_select(eqn, cases, prods, env)
             out = cases[0]
             for c in cases[1:]:
                 out = out.union(c)
             return [out]
+        if prim == "div":
+            a, b = ins
+            if allk(a, b) and b.lo >= 1:
+                cands = [_trunc_div(x, y)
+                         for x in (a.lo, a.hi) for y in (b.lo, b.hi)]
+                return [Interval(min(cands), max(cands))]
+            return [UNKNOWN]
+        if prim == "rem":
+            a, b = ins
+            if allk(a, b) and b.lo >= 1:
+                # lax.rem takes the dividend's sign; |rem| < |divisor|.
+                lo = -(b.hi - 1) if a.lo < 0 else 0
+                hi = (b.hi - 1) if a.hi > 0 else 0
+                return [Interval(lo, hi)]
+            return [UNKNOWN]
+        if prim == "sign":
+            return [Interval(-1, 1)]
+        if prim == "get":
+            return [as_interval(ins[0])] * n_out
+        if prim == "swap":
+            return [as_interval(ins[0])] * n_out
+        if prim == "addupdate":
+            return []
+        if prim == "program_id":
+            grid = getattr(self, "_grid", None)
+            if grid:
+                return [Interval(0, max(grid - 1, 0))]
+            return [UNKNOWN]
+        if prim == "pallas_call":
+            return self._pallas(eqn, ins)
         if prim in ("broadcast_in_dim", "reshape", "squeeze", "transpose",
                     "rev", "slice", "copy", "stop_gradient", "expand_dims",
                     "gather", "dynamic_slice", "reduce_precision"):
@@ -352,8 +645,19 @@ class IntervalAnalysis:
         if prim == "convert_element_type":
             a = ins[0]
             rng = _dtype_range(getattr(eqn.outvars[0].aval, "dtype", None))
-            if rng is None or not a.known:
-                return [UNKNOWN if rng is None else Interval(*rng)]
+            if rng is None:
+                return [UNKNOWN]
+            if not a.known:
+                # An unknown value is still bounded by its INPUT dtype: a
+                # widening i32->i64 conversion of an unknown stays inside
+                # the i32 range (returning the full i64 range here would
+                # cascade spurious overflows through every consumer).
+                in_rng = _dtype_range(getattr(eqn.invars[0].aval, "dtype",
+                                              None))
+                if in_rng is None:
+                    return [Interval(*rng)]
+                return [Interval(max(in_rng[0], rng[0]),
+                                 min(in_rng[1], rng[1]))]
             # Out-of-range conversions wrap; TRC01 owns flagging those.
             return [Interval(max(a.lo, rng[0]), min(a.hi, rng[1]))]
         if prim.startswith("scatter"):
@@ -361,7 +665,20 @@ class IntervalAnalysis:
             if prim == "scatter-add":
                 if not allk(op, upd):
                     return [UNKNOWN]
-                k = _shape_size(getattr(eqn.invars[2].aval, "shape", (1,)))
+                # One index row writes each operand element at most once,
+                # so an element accumulates at most one update per row:
+                # k is the number of index rows (the update dims that are
+                # NOT window dims), not the total update size — under
+                # vmap the batched window dims would otherwise inflate
+                # the widening quadratically.
+                dn = eqn.params.get("dimension_numbers")
+                window = set(getattr(dn, "update_window_dims", ()) or ())
+                upd_shape = getattr(eqn.invars[2].aval, "shape", ())
+                k = 1
+                for d, size in enumerate(upd_shape):
+                    if d not in window:
+                        k *= int(size)
+                k = max(k, 1)
                 return [self._check(
                     eqn, op.lo + min(0, upd.lo) * k,
                     op.hi + max(0, upd.hi) * k)]
@@ -371,21 +688,62 @@ class IntervalAnalysis:
             if sub is None:
                 return [UNKNOWN] * n_out
             consts = [UNKNOWN] * len(sub.jaxpr.constvars)
-            return self.run(sub.jaxpr, consts, ins)
+            self._push_scope(prods or {}, env or {},
+                             sub.jaxpr.invars, eqn.invars)
+            try:
+                res, senv = self.run_env(sub.jaxpr, consts, ins)
+            finally:
+                self._pop_scope()
+            self._propagate_refs(eqn, eqn.invars, sub.jaxpr.invars,
+                                 ins, senv, env)
+            return res
         if prim == "scan":
             return self._scan(eqn, ins)
         if prim == "cond":
             branches = eqn.params.get("branches", ())
             outs = None
             for br in branches:
-                res = self.run(br.jaxpr, [UNKNOWN] * len(br.jaxpr.constvars),
-                               ins[1:])
+                sub = br.jaxpr if hasattr(br, "jaxpr") else br
+                self._push_scope(prods or {}, env or {},
+                                 sub.invars, eqn.invars[1:])
+                try:
+                    res, benv = self.run_env(
+                        sub, [UNKNOWN] * len(sub.constvars), ins[1:])
+                finally:
+                    self._pop_scope()
+                self._propagate_refs(eqn, eqn.invars[1:], sub.invars,
+                                     ins[1:], benv, env)
                 outs = res if outs is None else [
                     a.union(b) for a, b in zip(outs, res)]
             return outs if outs is not None else [UNKNOWN] * n_out
         if prim == "while":
             return [UNKNOWN] * n_out
         return [UNKNOWN] * n_out
+
+    def _propagate_refs(self, eqn, outer_vars, inner_vars,
+                        ins: List[Interval], sub_env: Dict,
+                        env: Optional[Dict]) -> None:
+        """Carry ref mutations out of a descended call/branch: a ref
+        whose interval changed inside the sub-jaxpr (swap/addupdate
+        mutate invars, not outvars) must widen the caller's binding —
+        otherwise `pl.when`-guarded writes are silently dropped and the
+        pallas widening pass reasons about stale ref states. Plain
+        values never change (SSA), so this is a no-op for them."""
+        from jax.core import Literal
+
+        if env is None:
+            return
+        for outer_v, inner_v, init in zip(outer_vars, inner_vars, ins):
+            if isinstance(outer_v, Literal):
+                continue
+            init = as_interval(init)
+            fin = as_interval(sub_env.get(inner_v, UNKNOWN))
+            if fin.known and init.known:
+                if fin.lo < init.lo or fin.hi > init.hi:
+                    cur = as_interval(env.get(outer_v, UNKNOWN))
+                    env[outer_v] = cur.union(fin) if cur.known else UNKNOWN
+            elif init.known and not fin.known:
+                env[outer_v] = UNKNOWN
 
     def _scan(self, eqn, ins: List[Interval]) -> List[Interval]:
         """Linear widening: run the body once from the initial carry, then
@@ -418,3 +776,358 @@ class IntervalAnalysis:
         out2 = self.run(body, [UNKNOWN] * len(body.constvars),
                         consts + widened + xs)
         return out2[:num_carry] + out2[num_carry:]
+
+    # -- packed / select / pallas helpers ------------------------------------
+
+    def _packed_slice(self, eqn, p: Packed):
+        """Shift the byte window for a rank-1 unit-stride slice; any other
+        slice degrades to the window's interval (a subset of it — sound)."""
+        if not isinstance(p, Packed):
+            return as_interval(p)
+        starts = eqn.params.get("start_indices", ())
+        limits = eqn.params.get("limit_indices", ())
+        strides = eqn.params.get("strides")
+        if len(starts) == 1 and (strides is None or tuple(strides) == (1,)):
+            start, limit = int(starts[0]), int(limits[0])
+            return Packed(p.base + start * p.elem_bytes,
+                          (limit - start) * p.elem_bytes,
+                          p.elem_bytes, p.sections)
+        return p.to_interval()
+
+    def _origin(self, v, prods):
+        """Chase `v` back through value-preserving reshapes/broadcasts to
+        the var the data originates from."""
+        from jax.core import Literal
+
+        for _ in range(32):
+            if isinstance(v, Literal):
+                return v
+            src = prods.get(v)
+            if src is None or src.primitive.name not in self._ORIGIN_THRU:
+                return v
+            v = src.invars[0]
+        return v
+
+    def _refine_select(self, eqn, cases: List[Interval], prods: Dict,
+                       env: Dict) -> List[Interval]:
+        """Mask-aware `where`: when select_n's predicate is a comparison
+        whose operands are (broadcasts of) the case operands, each case
+        holds only where its branch condition does — narrow its interval
+        accordingly. `where(x <= cap, x, cap)` caps the true case at
+        cap.hi and floors the false case at cap.lo + 1."""
+        import numpy as np
+
+        from jax.core import Literal
+
+        pred = self._origin(eqn.invars[0], prods)
+        if isinstance(pred, Literal):
+            return cases
+        cmp = prods.get(pred)
+        if cmp is None or cmp.primitive.name not in ("lt", "le", "gt",
+                                                     "ge", "eq"):
+            return cases
+        op = cmp.primitive.name
+        a_v, b_v = (self._origin(v, prods) for v in cmp.invars)
+        bounds = [as_interval(self._read(env, v)) for v in cmp.invars]
+        dtype = getattr(eqn.outvars[0].aval, "dtype", None)
+        try:
+            integral = dtype is not None and (
+                np.issubdtype(dtype, np.integer)
+                or np.issubdtype(dtype, np.bool_))
+        except Exception:
+            integral = False
+        step = 1 if integral else 0
+        out = list(cases)
+        for idx, case_var in enumerate(eqn.invars[1:]):
+            cv = self._origin(case_var, prods)
+            if isinstance(cv, Literal):
+                continue
+            if cv is a_v:
+                role = 0
+            elif cv is b_v:
+                role = 1
+            else:
+                continue
+            # select_n picks case 0 when the predicate is False, case 1
+            # when True; the false branch holds the negated comparison.
+            op_b = op if idx == 1 else _CMP_NEG[op]
+            if op_b is None:
+                continue
+            if role == 1:
+                op_b = _CMP_MIRROR[op_b]
+            iv, other = cases[idx], bounds[1 - role]
+            if not (iv.known and other.known):
+                continue
+            if op_b == "eq":
+                lo, hi = max(iv.lo, other.lo), min(iv.hi, other.hi)
+            elif op_b == "lt":
+                lo, hi = iv.lo, min(iv.hi, other.hi - step)
+            elif op_b == "le":
+                lo, hi = iv.lo, min(iv.hi, other.hi)
+            elif op_b == "gt":
+                lo, hi = max(iv.lo, other.lo + step), iv.hi
+            else:  # ge
+                lo, hi = max(iv.lo, other.lo), iv.hi
+            if lo <= hi:
+                out[idx] = Interval(lo, hi)
+        return out
+
+    def _chase(self, v, scope: "_Scope", depth: int = 32):
+        """(var, scope, producer) after chasing shape-preserving hops
+        and unwrapping call results (jnp.where wraps its select in a
+        pjit) to the var's real producing equation. Only hops that keep
+        the axis structure intact are followed — the one-hot matcher
+        relies on the reduce axes mapping straight onto the select's."""
+        from jax.core import Literal
+
+        for _ in range(depth):
+            if isinstance(v, Literal):
+                return v, scope, None
+            # Translate call-invar bindings to the enclosing scope so the
+            # returned (var, scope) pair is internally consistent.
+            while scope.parent is not None and v not in scope.prods \
+                    and v in scope.varmap:
+                v, scope = scope.varmap[v], scope.parent
+                if isinstance(v, Literal):
+                    return v, scope, None
+            src, s = scope.producer(v)
+            if src is None:
+                return v, scope, None
+            prim = src.primitive.name
+            if prim in ("copy", "reshape"):
+                in_shape = tuple(getattr(src.invars[0].aval, "shape", ())
+                                 or ())
+                out_shape = tuple(getattr(v.aval, "shape", ()) or ())
+                if in_shape != out_shape:
+                    return v, s, src
+                v, scope = src.invars[0], s
+                continue
+            if prim in ("pjit", "closed_call", "core_call"):
+                closed = src.params.get("jaxpr") \
+                    or src.params.get("call_jaxpr")
+                inner = getattr(closed, "jaxpr", closed)
+                if inner is None:
+                    return v, s, src
+                try:
+                    k = list(src.outvars).index(v)
+                except ValueError:
+                    return v, s, src
+                scope = _Scope.inner(closed, src, s)
+                v = inner.outvars[k]
+                continue
+            return v, s, src
+        return v, scope, None
+
+    def _value_of(self, v, scope: "_Scope", depth: int = 0) -> Interval:
+        """Interval of `v`, chasing value-preserving broadcasts/reshapes
+        and call boundaries (broadcasting never changes the value SET,
+        only the shape — fine for interval reads, unlike axis mapping)."""
+        for _ in range(32):
+            v, scope, src = self._chase(v, scope)
+            if src is not None and src.primitive.name in self._ORIGIN_THRU:
+                v = src.invars[0]
+                continue
+            if src is not None \
+                    and src.primitive.name == "convert_element_type" \
+                    and depth < 8:
+                # Value-preserving iff the source values fit the target
+                # dtype (e.g. a weak int64 literal 0 cast down to int32).
+                out_rng = _dtype_range(
+                    getattr(src.outvars[0].aval, "dtype", None))
+                inner = self._value_of(src.invars[0], scope, depth + 1)
+                if inner.known and out_rng \
+                        and out_rng[0] <= inner.lo \
+                        and inner.hi <= out_rng[1]:
+                    return inner
+                return UNKNOWN
+            return scope.read(v)
+        return UNKNOWN
+
+    def _onehot_factor(self, eqn, prods: Dict, env: Dict):
+        """One-hot masked reduction: when reduce_sum's operand is
+        `where(iota_d == y, x, 0)` with `y` invariant along `d` and `d`
+        among the reduced axes, each output element sums at most ONE
+        element of `x` per position along `d` (the row/column-select
+        idiom in the Pallas kernels) — so the sum is bounded by x's own
+        interval times the residual reduction size, not the full
+        reduced count. Returns (residual_factor, x_interval) or None."""
+        axes = tuple(eqn.params.get("axes", ()) or ())
+        if not axes or not eqn.invars:
+            return None
+        if self._outer_stack:
+            pscope, pmap = self._outer_stack[-1]
+            root = _Scope(prods, env, pscope, pmap)
+        else:
+            root = _Scope(prods, env)
+        _, s, src = self._chase(eqn.invars[0], root)
+        if src is None or src.primitive.name != "select_n" \
+                or len(src.invars) != 3:
+            return None
+        # where(pred, x, 0) lowers to select_n(pred, 0, x): the false
+        # case (invars[1]) must be exactly zero for the bound to hold.
+        zero = self._value_of(src.invars[1], s)
+        if not (zero.known and zero.lo == 0 and zero.hi == 0):
+            return None
+        sel = self._value_of(src.invars[2], s)
+        if not sel.known:
+            return None
+        _, cs, cmp = self._chase(src.invars[0], s)
+        if cmp is None or cmp.primitive.name != "eq":
+            return None
+        d = None
+        for lhs, rhs in ((cmp.invars[0], cmp.invars[1]),
+                         (cmp.invars[1], cmp.invars[0])):
+            di = self._iota_dim(lhs, cs)
+            if di is not None and di in axes \
+                    and self._invariant_along(rhs, di, cs):
+                d = di
+                break
+        if d is None:
+            return None
+        shape = tuple(getattr(eqn.invars[0].aval, "shape", ()) or ())
+        k = 1
+        for ax in axes:
+            if ax != d and 0 <= ax < len(shape):
+                k *= int(shape[ax])
+        return max(k, 1), sel
+
+    def _iota_dim(self, v, scope: "_Scope", depth: int = 0):
+        """The output axis along which `v` counts 0..n-1 (an iota,
+        possibly broadcast with the axis remapped), or None. Broadcasts
+        that stretch the iota axis itself disqualify it — the values
+        would repeat and the one-hot property would not hold."""
+        from jax.core import Literal
+
+        if depth > 16 or isinstance(v, Literal):
+            return None
+        src, s = scope.producer(v)
+        if src is None:
+            return None
+        prim = src.primitive.name
+        if prim == "iota":
+            dim = src.params.get("dimension")
+            return int(dim) if dim is not None else None
+        if prim == "broadcast_in_dim":
+            bd = tuple(src.params.get("broadcast_dimensions", ()) or ())
+            inner = self._iota_dim(src.invars[0], s, depth + 1)
+            if inner is None or inner >= len(bd):
+                return None
+            in_shape = tuple(getattr(src.invars[0].aval, "shape", ())
+                             or ())
+            out_shape = tuple(getattr(src.outvars[0].aval, "shape", ())
+                              or ())
+            outer = int(bd[inner])
+            if inner >= len(in_shape) or outer >= len(out_shape) \
+                    or int(in_shape[inner]) != int(out_shape[outer]):
+                return None
+            return outer
+        if prim in ("convert_element_type", "copy"):
+            return self._iota_dim(src.invars[0], s, depth + 1)
+        return None
+
+    def _invariant_along(self, v, d: int, scope: "_Scope",
+                         depth: int = 0) -> bool:
+        """True when `v` provably takes a single value along axis `d`
+        (so eq against an iota on `d` matches at most one position)."""
+        from jax.core import Literal
+
+        if depth > 16:
+            return False
+        if isinstance(v, Literal):
+            return True
+        shape = tuple(getattr(getattr(v, "aval", None), "shape", ())
+                      or ())
+        if not shape:
+            return True  # rank-0: one value everywhere
+        if d < len(shape) and int(shape[d]) == 1:
+            return True
+        src, s = scope.producer(v)
+        if src is None:
+            return False
+        prim = src.primitive.name
+        if prim == "broadcast_in_dim":
+            bd = tuple(src.params.get("broadcast_dimensions", ()) or ())
+            if d not in bd:
+                return True
+            return self._invariant_along(src.invars[0], bd.index(d),
+                                         s, depth + 1)
+        if prim == "iota":
+            dim = src.params.get("dimension")
+            return dim is not None and int(dim) != d
+        if prim in ("convert_element_type", "copy"):
+            return self._invariant_along(src.invars[0], d, s, depth + 1)
+        return False
+
+    def _pallas(self, eqn, ins: List[Interval]) -> List[Interval]:
+        """Interpret a pallas_call body with ref semantics. The kernel
+        jaxpr's invars are the in/out refs (plus scratch); outputs start
+        unknown. Like `_scan`, refs that grow across one body execution
+        are widened linearly by the grid size before the checked pass —
+        sound for the kernels' monotone per-step accumulators."""
+        closed = eqn.params.get("jaxpr")
+        n_out = len(eqn.outvars)
+        if closed is None:
+            return [UNKNOWN] * n_out
+        body = closed.jaxpr if hasattr(closed, "jaxpr") else closed
+        grid = 1
+        gm = eqn.params.get("grid_mapping")
+        for d in tuple(getattr(gm, "grid", ()) or ()):
+            try:
+                grid *= int(d)
+            except (TypeError, ValueError):
+                grid = 0
+                break
+        args = [as_interval(x) for x in ins]
+        # Trailing body invars are the out refs and scratch refs; they
+        # have no outer operand, so their contract arrives via seeds
+        # (KernelSpec.scratch_seeds, indexed from the first extra invar).
+        extra = len(body.invars) - len(args)
+        tail = [UNKNOWN] * max(extra, 0)
+        for k, bound in (self._scratch_seeds or {}).items():
+            if 0 <= k < len(tail):
+                tail[k] = Interval(int(bound[0]), int(bound[1]))
+        args += tail
+        args = args[:len(body.invars)]
+        consts = [UNKNOWN] * len(body.constvars)
+        prev_grid = getattr(self, "_grid", None)
+        self._grid = grid or None
+        try:
+            silent = IntervalAnalysis(lambda o: None)
+            silent._grid = grid or None
+            _, env1 = silent.run_env(body, consts, args)
+            widened: List[Interval] = []
+            for v, a0 in zip(body.invars, args):
+                a1 = as_interval(env1.get(v, UNKNOWN))
+                if not (a0.known and a1.known):
+                    widened.append(UNKNOWN)
+                    continue
+                grew = a1.lo < a0.lo or a1.hi > a0.hi
+                if grew and not grid:
+                    widened.append(UNKNOWN)  # unknown trip count
+                    continue
+                grow_lo = min(a1.lo - a0.lo, 0) * grid
+                grow_hi = max(a1.hi - a0.hi, 0) * grid
+                widened.append(Interval(a0.lo + grow_lo, a0.hi + grow_hi))
+            self.run(body, consts, widened)
+        finally:
+            self._grid = prev_grid
+        return [UNKNOWN] * n_out
+
+
+_CMP_NEG = {"lt": "ge", "le": "gt", "gt": "le", "ge": "lt", "eq": None}
+_CMP_MIRROR = {"lt": "gt", "le": "ge", "gt": "lt", "ge": "le", "eq": "eq"}
+
+
+def _itemsize(dtype) -> Optional[int]:
+    import numpy as np
+
+    try:
+        return int(np.dtype(dtype).itemsize)
+    except Exception:
+        return None
+
+
+def _trunc_div(a: int, b: int) -> int:
+    """lax.div semantics: integer division rounding toward zero."""
+    q = abs(a) // abs(b)
+    return q if (a >= 0) == (b > 0) else -q

@@ -29,7 +29,7 @@ from kueue_tpu.analysis.core import (
     finding, register)
 
 _JIT_PATHS = ("models/", "ops/", "solver/", "parallel/", "topology/",
-              "hetero/", "fixtures/lint/")
+              "hetero/", "transport/", "fuzz/", "fixtures/lint/")
 
 # Names whose call result is host-side static even when fed a tracer.
 _UNTAINT_CALLS = {"len", "isinstance", "type", "getattr", "hasattr"}

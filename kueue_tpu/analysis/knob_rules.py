@@ -1,0 +1,231 @@
+"""Knob-contract rule (KNOB01): every KUEUE_TPU_* env knob goes through
+the registry.
+
+`kueue_tpu/knobs.py` is the single declaration point for the package's
+environment knobs: name, kind (kill-switch / debug / tuning), default,
+read discipline, doc. The accessors (`knobs.raw` / `knobs.flag`) are the
+only sanctioned read path — so a knob cannot ship undocumented, the
+README table generates from the registry, and the fuzz lattice can
+enumerate kill switches from one place.
+
+KNOB01 enforces the contract from three sides (one rule id, so a single
+suppression token covers the whole contract):
+
+  * a raw `os.environ` read of a literal `KUEUE_TPU_*` name anywhere
+    outside `knobs.py` — `os.environ.get`, `os.getenv`, subscript, and
+    the `from os import environ/getenv` spellings;
+  * an accessor call naming a knob the registry does not declare
+    (`knobs.flag("KUEUE_TPU_TYPO")` fails at lint time, not as a
+    KeyError in a kill-switch drill);
+  * a registry entry no analyzed file ever references — dead weight in
+    the README table and a lie about the supported surface. This half
+    only runs when the registry file itself is in the analyzed set
+    (whole-package runs), so analyzing one subpackage cannot
+    false-positive every knob it doesn't use.
+
+The registry is recovered from the ANALYZED `knobs.py` when present
+(fixtures can carry their own), else parsed once from the package's own
+copy on disk — import-free either way, like every ast-engine rule.
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from kueue_tpu.analysis.core import (
+    AnalysisContext, Finding, Rule, Severity, SourceFile, dotted_name,
+    finding, register)
+
+_PREFIX = "KUEUE_TPU_"
+_ACCESSORS = {"raw", "flag", "get"}
+
+
+def _registry_entries(tree: ast.Module) -> Optional[List[Tuple[str, int]]]:
+    """(knob name, line) per Knob(...) inside a REGISTRY assignment, or
+    None when the module declares no REGISTRY."""
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets = [node.target]
+        else:
+            continue
+        if not any(isinstance(t, ast.Name) and t.id == "REGISTRY"
+                   for t in targets):
+            continue
+        out: List[Tuple[str, int]] = []
+        for call in ast.walk(node.value):
+            if not isinstance(call, ast.Call):
+                continue
+            name = dotted_name(call.func)
+            if name is None or name.split(".")[-1] != "Knob":
+                continue
+            knob = None
+            if call.args and isinstance(call.args[0], ast.Constant) \
+                    and isinstance(call.args[0].value, str):
+                knob = call.args[0].value
+            for kw in call.keywords:
+                if kw.arg == "name" and isinstance(kw.value, ast.Constant):
+                    knob = kw.value.value
+            if knob is not None:
+                out.append((knob, call.lineno))
+        return out
+    return None
+
+
+def _is_registry_file(f: SourceFile) -> bool:
+    return f.path.name == "knobs.py"
+
+
+_PACKAGE_REGISTRY: Optional[List[Tuple[str, int]]] = None
+
+
+def _package_registry() -> List[Tuple[str, int]]:
+    """The package's own registry, parsed from disk once — the fallback
+    when the analyzed set does not include a knobs.py (single-file runs,
+    fixture tests)."""
+    global _PACKAGE_REGISTRY
+    if _PACKAGE_REGISTRY is None:
+        path = Path(__file__).resolve().parent.parent / "knobs.py"
+        try:
+            tree = ast.parse(path.read_text(encoding="utf-8"))
+            _PACKAGE_REGISTRY = _registry_entries(tree) or []
+        except (OSError, SyntaxError):
+            _PACKAGE_REGISTRY = []
+    return _PACKAGE_REGISTRY
+
+
+def _env_aliases(tree: ast.Module) -> Tuple[Set[str], Set[str]]:
+    """(names bound to os.environ, names bound to os.getenv) via
+    `from os import environ/getenv [as ...]`."""
+    environs: Set[str] = set()
+    getenvs: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module == "os":
+            for a in node.names:
+                if a.name == "environ":
+                    environs.add(a.asname or a.name)
+                elif a.name == "getenv":
+                    getenvs.add(a.asname or a.name)
+    return environs, getenvs
+
+
+def _knob_literal(node: Optional[ast.AST]) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str) \
+            and node.value.startswith(_PREFIX):
+        return node.value
+    return None
+
+
+def _raw_reads(f: SourceFile) -> Iterable[Tuple[str, ast.AST, str]]:
+    """(knob name, node, spelling) for every raw env read of a literal
+    KUEUE_TPU_* name in the file."""
+    environs, getenvs = _env_aliases(f.tree)
+    for node in ast.walk(f.tree):
+        if isinstance(node, ast.Call):
+            name = dotted_name(node.func)
+            arg = node.args[0] if node.args else None
+            if name in ("os.environ.get", "os.getenv") \
+                    or (name is not None
+                        and (name in getenvs
+                             or (name.endswith(".get")
+                                 and name[:-len(".get")] in environs))):
+                knob = _knob_literal(arg)
+                if knob is not None:
+                    yield knob, node, name
+        elif isinstance(node, ast.Subscript):
+            base = dotted_name(node.value)
+            if base == "os.environ" or (base is not None
+                                        and base in environs):
+                knob = _knob_literal(node.slice)
+                if knob is not None:
+                    yield knob, node, f"{base}[...]"
+
+
+def _accessor_calls(f: SourceFile) -> Iterable[Tuple[str, ast.AST, str]]:
+    """(knob name, node, accessor) for knobs.raw/flag/get calls with a
+    literal name."""
+    # `from kueue_tpu.knobs import flag` binds the accessor bare.
+    bare: Set[str] = set()
+    for node in ast.walk(f.tree):
+        if isinstance(node, ast.ImportFrom) \
+                and node.module == "kueue_tpu.knobs":
+            for a in node.names:
+                if a.name in _ACCESSORS:
+                    bare.add(a.asname or a.name)
+    for node in ast.walk(f.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = dotted_name(node.func)
+        if name is None:
+            continue
+        parts = name.split(".")
+        qualified = (len(parts) >= 2 and parts[-2] == "knobs"
+                     and parts[-1] in _ACCESSORS)
+        if not qualified and name not in bare:
+            continue
+        knob = _knob_literal(node.args[0] if node.args else None)
+        if knob is not None:
+            yield knob, node, parts[-1]
+
+
+def _check_knob01(ctx: AnalysisContext) -> Iterable[Finding]:
+    registry_file = next(
+        (f for f in ctx.files
+         if _is_registry_file(f) and f.tree is not None
+         and _registry_entries(f.tree) is not None), None)
+    if registry_file is not None:
+        entries = _registry_entries(registry_file.tree) or []
+    else:
+        entries = _package_registry()
+    registered = {name for name, _ in entries}
+
+    referenced: Set[str] = set()
+    for f in ctx.files:
+        if f.tree is None or f is registry_file:
+            continue
+        # Any literal mention counts as a read-site reference — accessor
+        # calls, the fuzz lattice's subprocess env tuples, drill configs.
+        for node in ast.walk(f.tree):
+            if isinstance(node, ast.Constant) \
+                    and isinstance(node.value, str) \
+                    and node.value.startswith(_PREFIX):
+                referenced.add(node.value)
+
+        for knob, node, spelling in _raw_reads(f):
+            tail = ("" if knob in registered
+                    else " — and the registry does not declare it")
+            yield finding(
+                KNOB01, f, node,
+                f"raw `{spelling}` read of {knob} bypasses the knob "
+                "registry — declare it in kueue_tpu/knobs.py and read it "
+                "through knobs.flag()/knobs.raw() (the registry is what "
+                "generates the README table and feeds the kill-switch "
+                f"lattice){tail}")
+        for knob, node, accessor in _accessor_calls(f):
+            if knob not in registered:
+                yield finding(
+                    KNOB01, f, node,
+                    f"knobs.{accessor}({knob!r}) names a knob the "
+                    "registry does not declare — add a Knob entry to "
+                    "kueue_tpu/knobs.py (kind, default, read discipline, "
+                    "doc) or fix the name")
+
+    if registry_file is not None:
+        for knob, line in entries:
+            if knob not in referenced:
+                yield Finding(
+                    rule=KNOB01.id, severity=KNOB01.severity,
+                    path=registry_file.display_path, line=line, col=0,
+                    message=f"registered knob {knob} has no read site in "
+                            "the analyzed files — dead registry entries "
+                            "document a contract nothing honors; delete "
+                            "the entry or wire up the read")
+
+
+KNOB01 = register(Rule(
+    id="KNOB01", severity=Severity.ERROR,
+    summary="env knob bypasses or drifts from the kueue_tpu.knobs registry",
+    check=_check_knob01, project=True))

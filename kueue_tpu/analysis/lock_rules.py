@@ -19,14 +19,16 @@ later, usually after release.
 LOCK02 collects, per class, the set of `self.X` attributes ever assigned
 inside a lock block, then flags assignments to the same attributes outside
 any lock in other methods. `__init__`/`__post_init__`/`__new__` and methods
-whose name ends in `_locked` (the repo convention for "caller holds the
-lock") are exempt. Warning severity: private helpers called under the
-caller's lock are common and legitimate.
+that document delegated guarding — a name ending in `_locked`, or a
+docstring stating "Caller holds <lock>" (the same two conventions the
+THR01 cross-thread engine honors) — are exempt. Warning severity: private
+helpers called under the caller's lock are common and legitimate.
 """
 
 from __future__ import annotations
 
 import ast
+import re
 from typing import Dict, List, Optional, Set, Tuple
 
 from kueue_tpu.analysis.core import (
@@ -34,7 +36,8 @@ from kueue_tpu.analysis.core import (
     register)
 
 _LOCK_PATHS = ("scheduler/", "core/", "queue/", "controllers/", "server/",
-               "metrics.py", "__main__.py", "fixtures/lint/")
+               "transport/", "parallel/", "metrics.py", "__main__.py",
+               "fixtures/lint/")
 
 _LOCKY = ("lock", "cond", "mutex", "sem")
 
@@ -158,6 +161,20 @@ def _check_lock01(f: SourceFile, ctx: AnalysisContext):
 _EXEMPT_METHODS = {"__init__", "__post_init__", "__new__", "__enter__",
                    "__exit__"}
 
+# A docstring saying "Caller holds <the lock>" documents delegated
+# guarding — the prose twin of the `*_locked` suffix. \s+ because
+# docstrings line-wrap. Shared with the THR01/THR02 thread engine.
+_HELD_DOC_RE = re.compile(r"[Cc]aller\s+holds")
+
+
+def _delegates_guarding(fn: ast.AST) -> bool:
+    """True when the method documents that its caller holds the lock
+    (`*_locked` name or a `Caller holds ...` docstring)."""
+    if fn.name.endswith("_locked"):
+        return True
+    doc = ast.get_docstring(fn)
+    return bool(doc and _HELD_DOC_RE.search(doc))
+
 
 def _self_attr_writes(fn: ast.AST, self_name: str):
     """(attr, node) for every `self.X = ...` / `self.X op= ...` in fn."""
@@ -211,16 +228,17 @@ def _check_lock02(f: SourceFile, ctx: AnalysisContext):
         if not guarded:
             continue
         for m, writes, spans in per_method:
-            if m.name in _EXEMPT_METHODS or m.name.endswith("_locked"):
+            if m.name in _EXEMPT_METHODS or _delegates_guarding(m):
                 continue
             for attr, node in writes:
                 if attr in guarded and not _in_spans(node.lineno, spans):
                     yield finding(
                         LOCK02, f, node,
                         f"`self.{attr}` is written under a lock elsewhere "
-                        f"in `{cls.name}` but bare in `{m.name}` — either "
-                        "take the lock here or rename the method "
-                        "`*_locked` to document that the caller holds it")
+                        f"in `{cls.name}` but bare in `{m.name}` — take "
+                        "the lock here, or document delegated guarding "
+                        "(`*_locked` name / `Caller holds <lock>` "
+                        "docstring)")
 
 
 LOCK01 = register(Rule(

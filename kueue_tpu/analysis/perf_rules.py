@@ -38,7 +38,7 @@ from kueue_tpu.analysis.core import (
     AnalysisContext, Rule, Severity, SourceFile, finding, register)
 
 _PERF_PATHS = ("scheduler/", "solver/", "models/", "core/cache.py",
-               "core/snapshot.py", "fixtures/lint/")
+               "core/snapshot.py", "hetero/referee.py", "fixtures/lint/")
 
 # Per-CQ share functions whose dict-walk cost makes a Python loop around
 # them the fair-path hot-spot shape (the KEP-1714 victim-search loop).

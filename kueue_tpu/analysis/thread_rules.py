@@ -1,0 +1,323 @@
+"""Cross-thread shared-state rules (THR01, THR02).
+
+LOCK02 checks lock *consistency* per class but is blind to WHICH thread
+runs a method: an attribute written bare in a method that only ever runs
+on one thread is fine, while the same bare write is a data race the
+moment a `threading.Thread(target=self._loop)` executes a reader of it.
+Two past incidents motivated making thread identity explicit:
+
+  * the symmetric-sendall deadlock (PR 11): acks are written from the
+    READER thread, so two peers pushing large frames into full TCP
+    buffers wedged each other — neither reader drained because both
+    were stuck in an unbounded `sendall`;
+  * the zombie-socket wedge (PR 13): a blocking call issued on a
+    service thread that other threads join/flush against turned a
+    slow peer into a fleet-wide stall.
+
+This module infers *thread roots* per class — the targets of
+`threading.Thread(...)` / `threading.Timer(...)` spawns (`self.method`
+or a nested closure), the accept/reader/dialer loops of the transport
+layer — and extends LOCK02's guard inference to thread-root
+reachability over the class-local call graph (`self.m()` edges and
+calls to nested defs). Public methods are the "main" root: the thread
+the owner calls the API from.
+
+THR01 (error): an attribute written on one thread root and accessed on
+another where some cross-thread access holds no lock. An access counts
+as guarded when it sits inside a `with <lock>` span, when the method
+name ends in `_locked`, or when the docstring documents the contract
+("Caller holds <lock>") — the same conventions LOCK02 honors.
+`__init__`-family methods are exempt (they run before any spawn).
+
+THR02 (error): unbounded blocking calls issued from a service thread
+root (reader/accept/serve/run loops, timers): `sendall`/`recv` on a
+socket in a class that never bounds it with `settimeout(...)`, a
+zero-argument `.join()` (Queue.join / Thread.join block forever), and
+`fsync` (a stalled disk wedges every thread that joins or flushes
+against the service loop). Classes that call `settimeout(<bound>)`
+anywhere are recognized as having bounded their socket I/O — the
+documented fix for the sendall deadlock.
+
+Both rules are class-local and import-free; cross-object handoffs
+(e.g. a channel owned by another class) are out of scope by design —
+the owning class is analyzed where the threads are spawned.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Set
+
+from kueue_tpu.analysis.core import (
+    AnalysisContext, Rule, Severity, SourceFile, dotted_name, finding,
+    register)
+from kueue_tpu.analysis.lock_rules import (
+    _EXEMPT_METHODS, _HELD_DOC_RE, _in_spans, _lock_spans,
+    _walk_stopping_at_defs)
+
+_THREAD_PATHS = ("transport/", "parallel/", "controllers/", "server/",
+                 "fixtures/lint/")
+
+# Spawn constructors whose arguments name a thread root. Matched on the
+# dotted leaf so `threading.Thread`, `Thread`, `threading.Timer` all
+# resolve.
+_SPAWNERS = {"Thread", "Timer"}
+
+# Thread roots that count as *service* threads for THR02: loops other
+# threads hand work to (and block on via join/flush/barrier).
+_SERVICE_RE = re.compile(
+    r"read|recv|serve|listen|accept|watch|handshake|dispatch|handle"
+    r"|loop|run|timer|_on_")
+
+
+class _Ctx:
+    """One execution context: a method body or a nested def's body."""
+
+    __slots__ = ("qual", "leaf", "node", "self_name", "spans", "held",
+                 "calls", "spawns", "accesses", "call_nodes", "labels")
+
+    def __init__(self, qual: str, node: ast.AST, self_name: str):
+        self.qual = qual
+        self.leaf = qual.rsplit(".", 1)[-1]
+        self.node = node
+        self.self_name = self_name
+        self.spans = _lock_spans(node)
+        doc = ast.get_docstring(node) or ""
+        self.held = (self.leaf.endswith("_locked")
+                     or bool(_HELD_DOC_RE.search(doc)))
+        self.calls: Set[str] = set()        # quals of class-local callees
+        self.spawns: Set[str] = set()       # quals spawned as thread roots
+        self.accesses: List = []            # (attr, node, is_write)
+        self.call_nodes: List[ast.Call] = []
+        self.labels: Set[str] = set()       # thread-root leaves + "main"
+
+
+def _spawn_target(value: ast.AST, methods: Set[str],
+                  visible: Dict[str, str]) -> Optional[str]:
+    """Resolve a spawn-constructor argument to a class-local context."""
+    if isinstance(value, ast.Attribute) and value.attr in methods:
+        return value.attr
+    if isinstance(value, ast.Name) and value.id in visible:
+        return visible[value.id]
+    return None
+
+
+def _collect(fn: ast.AST, self_name: str, qual: str,
+             ctxs: Dict[str, _Ctx], methods: Set[str],
+             visible: Dict[str, str]) -> None:
+    ctx = ctxs[qual] = _Ctx(qual, fn, self_name)
+    body = list(_walk_stopping_at_defs(fn.body))
+    local = {n.name: f"{qual}.{n.name}"
+             for n in body
+             if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+    seen = dict(visible)
+    seen.update(local)
+    method_call_funcs: Set[int] = set()
+    for node in body:
+        if not isinstance(node, ast.Call):
+            continue
+        ctx.call_nodes.append(node)
+        func = node.func
+        name = dotted_name(func) or ""
+        if name.rsplit(".", 1)[-1] in _SPAWNERS:
+            for value in list(node.args) + [k.value for k in node.keywords]:
+                target = _spawn_target(value, methods, seen)
+                if target is not None:
+                    ctx.spawns.add(target)
+        if isinstance(func, ast.Attribute) \
+                and isinstance(func.value, ast.Name) \
+                and func.value.id == self_name and func.attr in methods:
+            ctx.calls.add(func.attr)
+            method_call_funcs.add(id(func))
+        elif isinstance(func, ast.Name) and func.id in seen:
+            ctx.calls.add(seen[func.id])
+    for node in body:
+        if isinstance(node, ast.Attribute) \
+                and isinstance(node.value, ast.Name) \
+                and node.value.id == self_name \
+                and id(node) not in method_call_funcs:
+            if isinstance(node.ctx, ast.Store):
+                ctx.accesses.append((node.attr, node, True))
+            elif isinstance(node.ctx, ast.Load):
+                ctx.accesses.append((node.attr, node, False))
+    for n in body:
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            _collect(n, self_name, f"{qual}.{n.name}", ctxs, methods, seen)
+
+
+class _ClassModel:
+    __slots__ = ("cls", "ctxs", "roots")
+
+    def __init__(self, cls: ast.ClassDef):
+        self.cls = cls
+        methods = {n.name for n in cls.body
+                   if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+        self.ctxs: Dict[str, _Ctx] = {}
+        for m in cls.body:
+            if isinstance(m, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and m.args.args:
+                _collect(m, m.args.args[0].arg, m.name, self.ctxs,
+                         methods, {})
+        self.roots: Set[str] = set()
+        for ctx in self.ctxs.values():
+            self.roots |= {t for t in ctx.spawns if t in self.ctxs}
+        for root in self.roots:
+            self._propagate(root, self.ctxs[root].leaf)
+        # Public methods are the main-thread entry points: the owner's
+        # calling thread. Private helpers inherit labels only through
+        # the call graph (reachable solely from a root == that root's
+        # thread; from both == shared).
+        for qual, ctx in self.ctxs.items():
+            if "." not in qual and qual not in self.roots \
+                    and not qual.startswith("_"):
+                self._propagate(qual, "main")
+
+    def _propagate(self, start: str, label: str) -> None:
+        stack, seen = [start], set()
+        while stack:
+            qual = stack.pop()
+            if qual in seen or qual not in self.ctxs:
+                continue
+            seen.add(qual)
+            self.ctxs[qual].labels.add(label)
+            stack.extend(self.ctxs[qual].calls)
+
+    def exempt(self, ctx: _Ctx) -> bool:
+        # __init__-family bodies run before any thread is spawned —
+        # unless the context itself is (or runs on) a spawned root.
+        return (ctx.qual.split(".")[0] in _EXEMPT_METHODS
+                and not (ctx.labels - {"main"}))
+
+
+def _locked(ctx: _Ctx, node: ast.AST) -> bool:
+    return ctx.held or _in_spans(node.lineno, ctx.spans)
+
+
+def _check_thr01(f: SourceFile, actx: AnalysisContext):
+    for cls in ast.walk(f.tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        model = _ClassModel(cls)
+        if not model.roots:
+            continue
+        by_attr: Dict[str, List] = {}
+        for ctx in model.ctxs.values():
+            if model.exempt(ctx) or not ctx.labels:
+                continue
+            for attr, node, is_write in ctx.accesses:
+                by_attr.setdefault(attr, []).append((ctx, node, is_write))
+        for attr in sorted(by_attr):
+            acc = by_attr[attr]
+            writes = [a for a in acc if a[2]]
+            if not writes:
+                continue  # set before spawn (or never in-class): immutable
+            labels: Set[str] = set()
+            for ctx, _, _ in acc:
+                labels |= ctx.labels
+            if len(labels) < 2:
+                continue  # only ever touched on one thread root
+            offenders = [(ctx, node, w) for ctx, node, w in acc
+                         if not _locked(ctx, node)]
+            if not offenders:
+                continue
+            offenders.sort(key=lambda a: (not a[2], a[1].lineno))
+            ctx, node, is_write = offenders[0]
+            kind = "write" if is_write else "read"
+            yield finding(
+                THR01, f, node,
+                f"`self.{attr}` is shared across threads in `{cls.name}` "
+                f"(roots: {', '.join(sorted(labels))}) but this {kind} in "
+                f"`{ctx.leaf}` holds no lock — guard every cross-thread "
+                "access consistently, or document the contract "
+                "(`Caller holds <lock>` docstring / `*_locked` name)")
+
+
+def _class_bounds_sockets(cls: ast.ClassDef) -> bool:
+    """True when the class calls `settimeout(<bound>)` anywhere: its
+    socket I/O is bounded (a stuck send/recv severs instead of
+    wedging), the documented fix for the symmetric-sendall deadlock."""
+    for node in ast.walk(cls):
+        if isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr == "settimeout" and node.args:
+            arg = node.args[0]
+            if not (isinstance(arg, ast.Constant) and arg.value is None):
+                return True
+    return False
+
+
+def _check_thr02(f: SourceFile, actx: AnalysisContext):
+    for cls in ast.walk(f.tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        model = _ClassModel(cls)
+        if not model.roots:
+            continue
+        bounded = _class_bounds_sockets(cls)
+        for ctx in model.ctxs.values():
+            service = sorted(label for label in ctx.labels
+                             if label != "main"
+                             and _SERVICE_RE.search(label))
+            if not service:
+                continue
+            root = service[0]
+            for call in ctx.call_nodes:
+                func = call.func
+                if isinstance(func, ast.Name) and func.id == "fsync":
+                    recv_name = "fsync"
+                elif isinstance(func, ast.Attribute):
+                    recv_name = dotted_name(func) or func.attr
+                else:
+                    continue
+                attr = recv_name.rsplit(".", 1)[-1]
+                if attr == "sendall" and not bounded:
+                    yield finding(
+                        THR02, f, call,
+                        f"unbounded `{recv_name}(...)` on the `{root}` "
+                        f"thread of `{cls.name}`: a peer that stops "
+                        "draining blocks this service thread forever "
+                        "(the symmetric-sendall deadlock) — bound the "
+                        "socket with `settimeout(...)` so a stuck send "
+                        "severs instead of wedging")
+                elif attr == "recv" and not bounded \
+                        and isinstance(func, ast.Attribute) \
+                        and (dotted_name(func.value) or "").startswith(
+                            ctx.self_name + ".") \
+                        and not any(k.arg == "timeout"
+                                    for k in call.keywords):
+                    yield finding(
+                        THR02, f, call,
+                        f"unbounded `{recv_name}(...)` on the `{root}` "
+                        f"thread of `{cls.name}`: a silent peer parks "
+                        "this service thread forever — pass a timeout "
+                        "or bound the socket with `settimeout(...)`")
+                elif attr == "join" and not call.args \
+                        and not call.keywords:
+                    yield finding(
+                        THR02, f, call,
+                        f"`{recv_name}()` with no timeout on the "
+                        f"`{root}` thread of `{cls.name}`: Queue.join/"
+                        "Thread.join block forever if the counterpart "
+                        "wedges — a service thread must not make other "
+                        "threads' liveness its own; pass a timeout")
+                elif attr == "fsync":
+                    yield finding(
+                        THR02, f, call,
+                        f"`{recv_name}(...)` on the `{root}` thread of "
+                        f"`{cls.name}`: a stalled disk parks the "
+                        "service loop and wedges every thread that "
+                        "joins or flushes against it — move durability "
+                        "off the service thread or document why the "
+                        "stall is survivable")
+
+
+THR01 = register(Rule(
+    id="THR01", severity=Severity.ERROR,
+    summary="attribute crosses thread roots with inconsistent/no lock",
+    check=_check_thr01, path_fragments=_THREAD_PATHS))
+
+THR02 = register(Rule(
+    id="THR02", severity=Severity.ERROR,
+    summary="unbounded blocking call on a service thread root",
+    check=_check_thr02, path_fragments=_THREAD_PATHS))

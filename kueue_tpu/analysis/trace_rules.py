@@ -20,7 +20,11 @@ families run over the equations:
          by the canonical-unit ceiling) and propagates exact ranges
          through the arithmetic; any add/sub/mul/sum whose result range
          escapes the output dtype can wrap on real inputs and silently
-         diverge from the host referee.
+         diverge from the host referee. Packed byte-buffer kernels are
+         seeded with their wire layout (jaxpr_tools.Packed) so each
+         field's contract survives the slice/bitcast unpack chain, and
+         Pallas kernels seed their scratch refs from the roster — every
+         packed twin is verified directly, not via an unpacked stand-in.
   TRC03  recompile hazards: the same kernel lowered at two ADJACENT
          head-count buckets must produce structurally equal jaxprs
          (modulo shapes) — the one-XLA-compile-per-bucket contract that
@@ -35,7 +39,8 @@ built-in roster below runs; any analyzed file (e.g. a test fixture) may
 additionally declare its own kernels via a module-level
 `KUEUEVERIFY_KERNELS` manifest — a list of dicts with keys `name`,
 `build` (bucket -> (fn, args)), and optionally `buckets`, `rules`,
-`seeds`. Manifest files are IMPORTED (this engine must execute the trace),
+`seeds`, `scratch_seeds`.
+Manifest files are IMPORTED (this engine must execute the trace),
 unlike everything the ast/flow engines touch.
 
 jax is imported lazily at rule execution, never at module import.
@@ -53,10 +58,6 @@ from kueue_tpu.analysis.core import (
     AnalysisContext, Finding, Rule, Severity, SourceFile, register)
 
 ALL_TRC = frozenset({"TRC01", "TRC02", "TRC03", "TRC04"})
-# Packed/byte-buffer wrappers and ref-based Pallas kernels carry no usable
-# input contract for interval analysis (a bitcast output ranges over the
-# whole dtype); their arithmetic cores are verified unpacked instead.
-NO_TRC02 = ALL_TRC - {"TRC02"}
 
 _FORBIDDEN_EFFECTS = {
     "io_callback", "pure_callback", "debug_callback", "callback",
@@ -71,8 +72,15 @@ class KernelSpec:
     `build(bucket)` returns `(fn, args)`; the kernel is lowered as
     `jax.make_jaxpr(fn)(*args)`. `buckets` are two ADJACENT padded sizes
     of the kernel's dynamic axis (TRC03 compares their jaxprs).
-    `seeds` overrides the TRC02 input intervals by flat arg position
-    (defaults come from the dtype contract — see jaxpr_tools.default_seed).
+    `seeds` overrides the TRC02 input contract by flat arg position
+    (negative positions count from the end); a value is a plain
+    `(lo, hi)` interval or a `jaxpr_tools.Packed` wire layout (see
+    `jaxpr_tools.packed_layout`) for byte-buffer arguments, and the
+    whole mapping may be a callable of the bucket when the layout is
+    size-dependent. `scratch_seeds` carries the contract of pallas
+    out/scratch refs (indexed from the first body invar past the kernel
+    operands — they have no outer argument to seed through). Defaults
+    come from the dtype contract — see jaxpr_tools.default_seed.
     `anchor` is the source file the kernel lives in; findings whose
     equations carry no usable traceback anchor there."""
 
@@ -81,7 +89,8 @@ class KernelSpec:
     build: Callable[[int], tuple]
     buckets: Tuple[int, int] = (8, 16)
     rules: frozenset = ALL_TRC
-    seeds: Optional[Dict[int, Tuple[int, int]]] = None
+    seeds: object = None  # Dict[int, seed] | Callable[[int], Dict[int, seed]]
+    scratch_seeds: Optional[Dict[int, Tuple[int, int]]] = None
     optional: bool = False
 
 
@@ -323,6 +332,96 @@ def _build_topology(n: int):
     return fn, args
 
 
+# ---------------------------------------------------------------------------
+# TRC02 input contracts for the packed byte-buffer kernels
+# ---------------------------------------------------------------------------
+
+# Interval vocabulary of the solver schema (solver/schema.py): quota
+# tensors may carry the NO_LIMIT/BIG = 2^62 sentinel; every real
+# quantity is a canonical-unit integer far inside its dtype.
+_SENTINEL = (0, 2**62)
+_CANON64 = (-(2**50), 2**50)
+_CANON32 = (-(2**28), 2**28)
+_BOOLEAN = (0, 1)
+
+
+def _batch_packed_seeds(b: int) -> Dict[int, object]:
+    """Wire layout of the batch-packed-XLA one-transfer buffer (the
+    unpack chain at the top of `_packed_batch_kernel`): the int64 plane
+    (usage0, nominal, guaranteed, wl_req, blim, requestable, cand_use —
+    nominal and blim carry the NO_LIMIT/BIG sentinel), the int32 plane
+    (cand_y, cand_prio, threshold), and the byte plane of bool masks."""
+    from kueue_tpu.analysis import jaxpr_tools as jt
+
+    Y, FR, N = 8, 16, 8
+    fields = [
+        (b * Y * FR, 8, _CANON64),    # usage0
+        (b * Y * FR, 8, _SENTINEL),   # nominal
+        (b * Y * FR, 8, _CANON64),    # guaranteed
+        (b * FR, 8, _CANON64),        # wl_req
+        (b * FR, 8, _SENTINEL),       # blim
+        (b * FR, 8, _CANON64),        # requestable
+        (b * N * FR, 8, _CANON64),    # cand_use
+        (b * N, 4, _CANON32),         # cand_y
+        (b * N, 4, _CANON32),         # cand_prio
+        (b, 4, _CANON32),             # threshold
+        (b * Y * FR + 4 * b * FR + b * N + 3 * b, 1, _BOOLEAN),  # masks
+    ]
+    return {0: jt.packed_layout(fields)}
+
+
+def _flavor_fit_packed_seeds(w: int) -> Dict[int, object]:
+    """Wire layout of the flavor-fit one-transfer buffer (the unpack at
+    the top of `_solve_kernel_packed`): i64 usage + requests, i32 cq
+    index + resume slots, u8 masks. The buffer is the LAST flat
+    argument; the borrow_limit static (position 1) carries the quota
+    sentinel."""
+    from kueue_tpu.analysis import jaxpr_tools as jt
+
+    C, F, R, G, S, P = 4, 4, 3, 2, 2, 2
+    fields = [
+        (C * F * R, 8, _CANON64),      # usage
+        (w * P * R, 8, _CANON64),      # req
+        (w, 4, _CANON32),              # wl_cq
+        (w * P * G, 4, _CANON32),      # resume_slot
+        (w * P * R, 1, _BOOLEAN),      # has_req
+        (w * P, 1, _BOOLEAN),          # podset_valid
+        (w * P, 1, _BOOLEAN),          # podset_unsat
+        (w * P * G * S, 1, _BOOLEAN),  # elig
+    ]
+    return {1: _SENTINEL, -1: jt.packed_layout(fields)}
+
+
+# The Pallas int32 twin runs AFTER `_rescale_int32`: every real quantity
+# is proven < (2^31 - 1) / (ypad + 2) before dispatch (ypad = 8 at the
+# roster shape — fits_now folds ypad usage rows, the lending credit and
+# the request into one int32 sum) and nominal/blim carry I32_SENTINEL
+# (2^30) for "no limit".
+_PALLAS_BOUND = (2**31 - 1) // 10
+
+_PALLAS_SEEDS = {
+    0: (0, 7),                  # cand_y: padded row index < ypad
+    1: (-(2**31), 2**31 - 1),   # cand_prio: raw int32 priority
+    2: (-(2**15), 2**15),       # scalars (n, mode flags, threshold)
+    3: (0, _PALLAS_BOUND),      # usage0
+    4: (0, 2**30),              # nominal (I32_SENTINEL for no-limit)
+    5: (0, 1),                  # q_def
+    6: (0, _PALLAS_BOUND),      # guaranteed
+    7: (0, _PALLAS_BOUND),      # wl_req
+    8: (0, 1),                  # wl_req_mask
+    9: (0, 2**30),              # blim (I32_SENTINEL for no-limit)
+    10: (0, 1),                 # blim_def
+    11: (0, _PALLAS_BOUND),     # requestable
+    12: (0, 1),                 # res_mask
+    13: (0, _PALLAS_BOUND),     # cand_use
+}
+_PALLAS_SCRATCH = {
+    2: (0, _PALLAS_BOUND),      # U: usage working copy (clamped writes)
+    3: (0, 3),                  # taken: per-candidate verdict enum
+    4: (-(2**16), 2**16),       # flags: loop bookkeeping scalars
+}
+
+
 def package_roster() -> List[KernelSpec]:
     """The built-in kernel roster. Preemption engines come from the
     `solver/modes.ENGINES` registry (every `traceable` engine MUST appear
@@ -340,13 +439,19 @@ def package_roster() -> List[KernelSpec]:
             build=_build_scan, buckets=(8, 16),
             seeds={1: sentinel, 6: sentinel}),
         KernelSpec(
+            # The whole dynamic side arrives as one byte buffer; the
+            # bitcast-aware Packed domain carries the per-field contract
+            # through the unpack chain, so TRC02 runs on the packed
+            # kernel itself (not an unpacked stand-in).
             name="batch-jax",
             anchor=_module_file("kueue_tpu.ops.preemption_batch"),
-            build=_build_batch_packed, buckets=(4, 8), rules=NO_TRC02),
+            build=_build_batch_packed, buckets=(4, 8),
+            seeds=_batch_packed_seeds),
         KernelSpec(
             name="scan-pallas",
             anchor=_module_file("kueue_tpu.ops.preemption_pallas"),
-            build=_build_pallas, buckets=(4, 8), rules=NO_TRC02,
+            build=_build_pallas, buckets=(4, 8),
+            seeds=_PALLAS_SEEDS, scratch_seeds=_PALLAS_SCRATCH,
             optional=True),
         KernelSpec(
             name="flavor-fit",
@@ -357,7 +462,7 @@ def package_roster() -> List[KernelSpec]:
             name="flavor-fit-packed",
             anchor=_module_file("kueue_tpu.models.flavor_fit"),
             build=_build_flavor_fit_packed, buckets=(8, 16),
-            rules=NO_TRC02),
+            seeds=_flavor_fit_packed_seeds),
         KernelSpec(
             name="flavor-fit-hier",
             anchor=_module_file("kueue_tpu.models.flavor_fit"),
@@ -371,11 +476,12 @@ def package_roster() -> List[KernelSpec]:
             build=_build_flavor_fit_hetero, buckets=(8, 16),
             seeds={1: sentinel}),
         KernelSpec(
-            # The Gavel score iteration (all-integer dual tatonnement).
+            # The Gavel score iteration (all-integer dual tatonnement);
+            # capacity sums nominal quotas, so it carries the sentinel.
             name="hetero-scores",
             anchor=_module_file("kueue_tpu.hetero.solve"),
             build=_build_hetero_scores, buckets=(8, 16),
-            rules=NO_TRC02),
+            seeds={3: sentinel}),
         KernelSpec(
             # The cohort-sharded per-shard body (parallel/mesh): one
             # shard's compacted block at its per-shard padded bucket —
@@ -420,7 +526,8 @@ def _manifest_specs(f: SourceFile) -> Tuple[List[KernelSpec], Optional[str]]:
             build=entry["build"],
             buckets=tuple(entry.get("buckets", (8, 16))),
             rules=frozenset(entry.get("rules", ALL_TRC)),
-            seeds=entry.get("seeds")))
+            seeds=entry.get("seeds"),
+            scratch_seeds=entry.get("scratch_seeds")))
     return out, None
 
 
@@ -533,7 +640,8 @@ def _trace_findings(ctx: AnalysisContext) -> Dict[str, List[Finding]]:
         if "TRC01" in spec.rules:
             out["TRC01"].extend(_check_trc01(ctx, spec, first))
         if "TRC02" in spec.rules:
-            out["TRC02"].extend(_check_trc02(ctx, spec, first))
+            out["TRC02"].extend(
+                _check_trc02(ctx, spec, first, spec.buckets[0]))
         if "TRC03" in spec.rules:
             out["TRC03"].extend(_check_trc03(ctx, spec, jaxprs))
         if "TRC04" in spec.rules:
@@ -665,7 +773,7 @@ def _check_trc01(ctx, spec, closed) -> List[Finding]:
 # ---------------------------------------------------------------------------
 
 
-def _check_trc02(ctx, spec, closed) -> List[Finding]:
+def _check_trc02(ctx, spec, closed, bucket: int) -> List[Finding]:
     from kueue_tpu.analysis import jaxpr_tools as jt
 
     findings: List[Finding] = []
@@ -678,12 +786,18 @@ def _check_trc02(ctx, spec, closed) -> List[Finding]:
             "and silently diverge from the host referee; rewrite to avoid "
             "the overflowing intermediate (e.g. compare via subtraction)"))
 
-    seeds = spec.seeds or {}
+    raw = spec.seeds(bucket) if callable(spec.seeds) else (spec.seeds or {})
+    n_args = len(closed.jaxpr.invars)
+    seeds = {(k if k >= 0 else n_args + k): v for k, v in raw.items()}
     arg_ivs = []
     for i, v in enumerate(closed.jaxpr.invars):
         if i in seeds:
-            lo, hi = seeds[i]
-            arg_ivs.append(jt.Interval(lo, hi))
+            s = seeds[i]
+            if isinstance(s, (jt.Interval, jt.Packed)):
+                arg_ivs.append(s)
+            else:
+                lo, hi = s
+                arg_ivs.append(jt.Interval(lo, hi))
         else:
             arg_ivs.append(jt.default_seed(v.aval))
     const_ivs = []
@@ -698,7 +812,10 @@ def _check_trc02(ctx, spec, closed) -> List[Finding]:
                 const_ivs.append(jt.UNKNOWN)
         except Exception:
             const_ivs.append(jt.UNKNOWN)
-    jt.IntervalAnalysis(on_overflow).run(closed.jaxpr, const_ivs, arg_ivs)
+    analysis = jt.IntervalAnalysis(on_overflow)
+    if spec.scratch_seeds:
+        analysis._scratch_seeds = dict(spec.scratch_seeds)
+    analysis.run(closed.jaxpr, const_ivs, arg_ivs)
     return findings
 
 

@@ -23,6 +23,7 @@ import os
 import threading
 from typing import Optional
 
+from kueue_tpu import knobs
 from kueue_tpu.api import serialization
 from kueue_tpu.controllers import store as store_mod
 from kueue_tpu.controllers.store import DELETED, Event, Store
@@ -51,7 +52,7 @@ class Journal:
         from kueue_tpu.controllers.diskfaults import parse_disk_fault_env
 
         self.path = path
-        self.fsync = (os.environ.get("KUEUE_TPU_DURABLE_FSYNC") == "1"
+        self.fsync = (knobs.flag("KUEUE_TPU_DURABLE_FSYNC")
                       if fsync is None else fsync)
         self._lock = threading.Lock()
         self._file = None
@@ -63,7 +64,7 @@ class Journal:
         # None (the default, env unset) injects nothing.
         if faults is None:
             faults = parse_disk_fault_env(
-                os.environ.get("KUEUE_TPU_DISK_FAULTS"))
+                knobs.raw("KUEUE_TPU_DISK_FAULTS"))
         self.faults = (faults.injector(path)
                        if faults is not None and hasattr(faults, "injector")
                        else faults)

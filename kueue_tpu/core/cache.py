@@ -138,8 +138,8 @@ class Cohort:
         never set it in production."""
         sm = self._sorted_members
         if sm is None:
-            import os
-            if os.environ.get("KUEUE_TPU_FUZZ_MUTATION") == \
+            from kueue_tpu import knobs
+            if knobs.raw("KUEUE_TPU_FUZZ_MUTATION") == \
                     "unsorted-members":
                 sm = self._sorted_members = list(self.members)
             else:

@@ -9,10 +9,10 @@ add/remove-workload simulation primitive used by preemption
 
 from __future__ import annotations
 
-import os
 from typing import Dict, List, Optional, Set, Tuple
 
 from kueue_tpu import features
+from kueue_tpu import knobs
 from kueue_tpu.api.types import ResourceFlavor
 from kueue_tpu.core.cache import (
     Cache,
@@ -203,8 +203,7 @@ class SnapshotMirror:
         # per-CQ tensor — reading the clamped cohort delta off the
         # arrays — instead of walking every pending item's usage dicts.
         self._admitted_view = None
-        self._arena_flush_forced = \
-            os.environ.get("KUEUE_TPU_ARENA_FLUSH", "") == "1"
+        self._arena_flush_forced = knobs.flag("KUEUE_TPU_ARENA_FLUSH")
         # CQ names whose usage moved since the last refresh (fed by the
         # cache's dirty-sink hook) — the refresh visits only these.
         self._dirty: set = set()

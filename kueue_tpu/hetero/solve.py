@@ -54,6 +54,20 @@ DEFAULT_ITERS = 8
 # "Cannot run here" score for masked slots; far below any real score and
 # far above int64 overflow when summed with prices.
 NEG_SCORE = np.int64(-(np.int64(1) << 62))
+# Capacity ceiling: flavor_capacity sums nominal quotas, and a nominal
+# can be the schema's BIG/NO_LIMIT sentinel (2^62) — `over * PRICE_STEP`
+# on a sentinel capacity wraps int64. 2^53 still exceeds any in-contract
+# aggregate demand (canonical units are <= 2^50), so a clamped flavor is
+# never overloaded, exactly as with the raw sentinel capacity.
+CAP_CEIL = np.int64(np.int64(1) << 53)
+# Dual-price ceiling: the tatonnement is self-limiting at equilibrium
+# (an over-priced flavor attracts no rows, so its price decays), but
+# nothing bounds the price structurally between iterations. 2^55 is far
+# above any reachable score (fixed-point throughputs are canonical-unit
+# sized), so the clamp never binds on in-contract inputs; it makes the
+# no-wrap property hold unconditionally. Mirrored in the numpy referee,
+# so decision identity is unaffected.
+PRICE_CEIL = np.int64(np.int64(1) << 55)
 
 
 def hetero_scores_core(tput_q, demand, active, capacity, *,
@@ -68,6 +82,7 @@ def hetero_scores_core(tput_q, demand, active, capacity, *,
     """
     allowed = tput_q > 0
     runnable = active & allowed.any(axis=1)
+    capacity = jnp.minimum(capacity, jnp.int64(CAP_CEIL))
     cap_safe = jnp.maximum(capacity, 1)
     farange = jnp.arange(capacity.shape[0])
 
@@ -80,8 +95,8 @@ def hetero_scores_core(tput_q, demand, active, capacity, *,
         load = jnp.sum(jnp.where(onehot, demand[:, None],
                                  jnp.int64(0)), axis=0)
         over = load - capacity
-        price = jnp.maximum(price + (over * PRICE_STEP) // cap_safe,
-                            jnp.int64(0))
+        price = jnp.clip(price + (over * PRICE_STEP) // cap_safe,
+                         jnp.int64(0), jnp.int64(PRICE_CEIL))
         return price, None
 
     price0 = jnp.zeros(capacity.shape, dtype=jnp.int64)
@@ -118,6 +133,7 @@ def hetero_scores_np(tput_q: np.ndarray, demand: np.ndarray,
     capacity = np.asarray(capacity, dtype=np.int64)
     allowed = tput_q > 0
     runnable = np.asarray(active, dtype=bool) & allowed.any(axis=1)
+    capacity = np.minimum(capacity, CAP_CEIL)
     cap_safe = np.maximum(capacity, 1)
     F = capacity.shape[0]
     farange = np.arange(F)
@@ -131,8 +147,8 @@ def hetero_scores_np(tput_q: np.ndarray, demand: np.ndarray,
         load = np.sum(np.where(onehot, demand[:, None],
                                np.int64(0)), axis=0)
         over = load - capacity
-        price = np.maximum(price + (over * PRICE_STEP) // cap_safe,
-                           np.int64(0))
+        price = np.clip(price + (over * PRICE_STEP) // cap_safe,
+                        np.int64(0), PRICE_CEIL)
     return np.where(allowed, tput_q - price[None, :], NEG_SCORE)
 
 

@@ -1,0 +1,186 @@
+"""The KUEUE_TPU_* environment-knob contract registry.
+
+Every environment variable the package consults is declared HERE, once,
+with its kind, default, read discipline, and a doc line. Read sites go
+through the accessors (`raw` / `flag`) instead of `os.environ` so that:
+
+  * an undeclared knob cannot ship: KNOB01 (kueuelint) flags raw
+    `os.environ` reads of `KUEUE_TPU_*` names and accessor calls naming
+    an unregistered knob — and registry entries nothing reads;
+  * the README's knob table is GENERATED from this registry
+    (`markdown_table()`) and checked against it in CI, so the docs
+    cannot drift from the code;
+  * the read discipline is explicit: a `live` knob is consulted at
+    every decision point (the fuzz lattice and the A/B drills rely on
+    flipping these per run), a `startup` knob is captured once at
+    import or construction — moving a read between disciplines is a
+    contract change, not an accident.
+
+Kinds:
+  * kill-switch — reverts a feature to its pre-feature behavior
+    (`KUEUE_TPU_NO_*=1`, or an opt-out like `KUEUE_TPU_NATIVE_HEAP=0`);
+    every one must keep a green A/B twin somewhere in the suite.
+  * debug      — extra verification/telemetry or test-only injection
+    (fault plans, oracle mutations); never changes decisions when unset.
+  * tuning     — selects topology/limits/modes (replica count,
+    transport, timeouts).
+
+This module imports nothing beyond the stdlib and is imported from
+everywhere, including package `__init__` paths — keep it dependency-free.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Dict, Optional, Tuple
+
+KILL_SWITCH = "kill-switch"
+DEBUG = "debug"
+TUNING = "tuning"
+_KINDS = (KILL_SWITCH, DEBUG, TUNING)
+
+LIVE = "live"        # consulted at every decision point
+STARTUP = "startup"  # captured once at import or construction
+_READS = (LIVE, STARTUP)
+
+
+@dataclass(frozen=True)
+class Knob:
+    name: str
+    kind: str
+    default: Optional[str]  # value the read site assumes when unset
+    read: str
+    doc: str
+
+    def __post_init__(self):
+        if not self.name.startswith("KUEUE_TPU_"):
+            raise ValueError(f"knob {self.name!r}: not a KUEUE_TPU_* name")
+        if self.kind not in _KINDS:
+            raise ValueError(f"knob {self.name}: kind {self.kind!r}")
+        if self.read not in _READS:
+            raise ValueError(f"knob {self.name}: read {self.read!r}")
+
+
+REGISTRY: Tuple[Knob, ...] = (
+    # -- kill switches (feature reverts; each keeps an A/B twin) ------------
+    Knob("KUEUE_TPU_NO_ARENA", KILL_SWITCH, "", LIVE,
+         "=1 disables the incremental workload arena (from-scratch "
+         "encode every solve)."),
+    Knob("KUEUE_TPU_NO_ADMIT_ARENA", KILL_SWITCH, "", LIVE,
+         "=1 disables the admitted-workload arena (full re-encode of "
+         "admitted state)."),
+    Knob("KUEUE_TPU_NO_NOMINATE_CACHE", KILL_SWITCH, "", LIVE,
+         "=1 disables the nominate cache (every head re-solved every "
+         "tick)."),
+    Knob("KUEUE_TPU_NO_DEVICE_FAIR", KILL_SWITCH, "", LIVE,
+         "=1 restores the per-CQ host dict DRF walk instead of the "
+         "device fair-share stage."),
+    Knob("KUEUE_TPU_NO_HETERO", KILL_SWITCH, "", LIVE,
+         "=1 disables heterogeneity-aware scoring even when profiles "
+         "are loaded."),
+    Knob("KUEUE_TPU_NO_QUIET_TICK", KILL_SWITCH, "", LIVE,
+         "=1 disables the quiescent-tick replay fast path (full "
+         "pipeline every tick)."),
+    Knob("KUEUE_TPU_NO_MICROTICK", KILL_SWITCH, "", LIVE,
+         "=1 disables event-driven micro-ticks between full ticks."),
+    Knob("KUEUE_TPU_NO_EAGER_ENCODE", KILL_SWITCH, "", LIVE,
+         "=1 disables eager arena encode at the replica barrier."),
+    Knob("KUEUE_TPU_NO_SHARD", KILL_SWITCH, "", LIVE,
+         "=1 forces single-device solves even when a cohort mesh is "
+         "available."),
+    Knob("KUEUE_TPU_NO_REPLICA", KILL_SWITCH, "", STARTUP,
+         "=1 forces the single-process runtime regardless of "
+         "KUEUE_TPU_REPLICAS."),
+    Knob("KUEUE_TPU_NO_SOCKET", KILL_SWITCH, "", STARTUP,
+         "=1 forbids the socket transport (pipe/queue loopback only)."),
+    Knob("KUEUE_TPU_NATIVE_HEAP", KILL_SWITCH, "1", STARTUP,
+         "=0 disables the C++ keyed heap (pure-Python queue ordering); "
+         "opt-out, default on."),
+    # -- debug / test injection --------------------------------------------
+    Knob("KUEUE_TPU_TRACE", DEBUG, "", STARTUP,
+         "=1 enables span tracing (Chrome trace-event export)."),
+    Knob("KUEUE_TPU_DEBUG_ARENA", DEBUG, "", STARTUP,
+         "=1 cross-checks every arena row against a from-scratch "
+         "encode."),
+    Knob("KUEUE_TPU_DEBUG_ADMIT_ARENA", DEBUG, "", STARTUP,
+         "=1 cross-checks the admitted arena against a full re-encode."),
+    Knob("KUEUE_TPU_DEBUG_DRIFT", DEBUG, "", STARTUP,
+         "=1 verifies the incremental usage drift against a recompute."),
+    Knob("KUEUE_TPU_DEBUG_FAIR", DEBUG, "", LIVE,
+         "=1 cross-checks device fair-share preemption against the "
+         "host referee."),
+    Knob("KUEUE_TPU_DEBUG_HETERO", DEBUG, "", LIVE,
+         "=1 cross-checks hetero scoring against the NumPy twin per "
+         "solve."),
+    Knob("KUEUE_TPU_ARENA_FLUSH", DEBUG, "", LIVE,
+         "=1 flushes the arena every snapshot (drills the rebuild "
+         "path)."),
+    Knob("KUEUE_TPU_FUZZ_MUTATION", DEBUG, None, LIVE,
+         "Arms an env-gated oracle mutation (e.g. unsorted-cohort-walk) "
+         "for the fuzzer self-test."),
+    Knob("KUEUE_TPU_FAULTS", DEBUG, None, STARTUP,
+         "Packet-fault plan for the socket transport "
+         "(drop_p=..,delay_ms=..,seed=..)."),
+    Knob("KUEUE_TPU_DISK_FAULTS", DEBUG, None, STARTUP,
+         "Disk-fault plan for the durable journals "
+         "(enospc_p=..,fsync_p=..,torn_p=..,seed=..)."),
+    # -- tuning -------------------------------------------------------------
+    Knob("KUEUE_TPU_REPLICAS", TUNING, "0", STARTUP,
+         "Replica count for the multi-process runtime (0/unset = "
+         "single process)."),
+    Knob("KUEUE_TPU_TRANSPORT", TUNING, "", STARTUP,
+         "Replica channel transport: pipe, queue, or socket (unset = "
+         "per-mode default)."),
+    Knob("KUEUE_TPU_SHARDS", TUNING, "", LIVE,
+         "Cohort-mesh shard count override (unset = device count)."),
+    Knob("KUEUE_TPU_HETERO", TUNING, "", LIVE,
+         "=1 opts the packed solver into hetero scoring when profiles "
+         "exist."),
+    Knob("KUEUE_TPU_ROUND_TIMEOUT", TUNING, "60", STARTUP,
+         "Replica barrier round timeout in seconds."),
+    Knob("KUEUE_TPU_BARRIER_DEADLINE", TUNING, "", STARTUP,
+         "Barrier-stall watchdog deadline in seconds (unset = derived "
+         "from the round timeout)."),
+    Knob("KUEUE_TPU_CSR_ASSUME", TUNING, "", LIVE,
+         "Pre-seeds the cohort-state-root cache (advanced: skips the "
+         "first-tick probe)."),
+    Knob("KUEUE_TPU_DURABLE_FSYNC", TUNING, "", STARTUP,
+         "=1 fsyncs every journal append (durability over append "
+         "latency)."),
+)
+
+_BY_NAME: Dict[str, Knob] = {k.name: k for k in REGISTRY}
+if len(_BY_NAME) != len(REGISTRY):
+    raise RuntimeError("duplicate knob registration")
+
+
+def get(name: str) -> Knob:
+    return _BY_NAME[name]
+
+
+def raw(name: str) -> Optional[str]:
+    """The knob's environment value, or its registered default when
+    unset. KeyError on an unregistered name — the runtime twin of
+    KNOB01 (declare the knob in REGISTRY first)."""
+    return os.environ.get(name, _BY_NAME[name].default)
+
+
+def flag(name: str) -> bool:
+    """True iff the boolean knob is set to "1" — the single opt-in
+    idiom every `KUEUE_TPU_*=1` site uses. Kill-switch guards read
+    `not flag(...)`; opt-out knobs (NATIVE_HEAP) compare `raw(...)`
+    against their off value explicitly."""
+    return raw(name) == "1"
+
+
+def markdown_table() -> str:
+    """The README knob table, generated from the registry (checked
+    against the README in CI so the docs cannot drift)."""
+    lines = ["| Knob | Kind | Default | Read | What it does |",
+             "| --- | --- | --- | --- | --- |"]
+    for k in REGISTRY:
+        default = "_unset_" if k.default in (None, "") else f"`{k.default}`"
+        lines.append(f"| `{k.name}` | {k.kind} | {default} | {k.read} "
+                     f"| {k.doc} |")
+    return "\n".join(lines)

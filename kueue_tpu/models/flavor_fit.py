@@ -30,6 +30,7 @@ import numpy as np
 from kueue_tpu.utils import native_decode
 
 from kueue_tpu import features
+from kueue_tpu import knobs
 from kueue_tpu.core.snapshot import Snapshot
 from kueue_tpu.core.workload import AssignmentClusterQueueState, WorkloadInfo
 from kueue_tpu.solver import schema as sch
@@ -785,7 +786,7 @@ class BatchSolver:
         # global usage generation), and the per-tick activity flag
         # (False whenever nothing is profiled — the provable no-op).
         if hetero is None:
-            hetero = os.environ.get("KUEUE_TPU_HETERO", "") == "1"
+            hetero = knobs.flag("KUEUE_TPU_HETERO")
         self._hetero_mode = bool(hetero)
         if self._hetero_mode and mesh is not None:
             raise ValueError(
@@ -813,9 +814,9 @@ class BatchSolver:
             # sharding modes are mutually exclusive (the config layer
             # rejects the pair, and a stray bench env var must not
             # silently flip the engine).
-            env = os.environ.get("KUEUE_TPU_SHARDS", "")
+            env = knobs.raw("KUEUE_TPU_SHARDS")
             shards = int(env) if env else 0
-        if os.environ.get("KUEUE_TPU_NO_SHARD", "") == "1":
+        if knobs.flag("KUEUE_TPU_NO_SHARD"):
             shards = 0
         self._cohort_mesh = None
         if shards == -1 or shards > 1:
@@ -836,21 +837,20 @@ class BatchSolver:
         self.shard_bucket_last = 0
         # Incremental workload arena (the tensorize.encode fast path).
         if use_arena is None:
-            use_arena = os.environ.get("KUEUE_TPU_NO_ARENA", "") != "1"
+            use_arena = not knobs.flag("KUEUE_TPU_NO_ARENA")
         self._use_arena = use_arena
         self._arena: Optional[sch.WorkloadArena] = None
         self._arena_rebuilt = False
         # Admitted-set arena (committed usage rows; fed by cache events).
         if use_admit_arena is None:
-            use_admit_arena = os.environ.get(
-                "KUEUE_TPU_NO_ADMIT_ARENA", "") != "1"
+            use_admit_arena = not knobs.flag("KUEUE_TPU_NO_ADMIT_ARENA")
         self._use_admit_arena = use_admit_arena
         self._admit_arena: Optional[sch.AdmittedArena] = None
         self._cache = None
         # Fingerprinted nominate cache: uid -> (fingerprint, Assignment).
         if use_nominate_cache is None:
-            use_nominate_cache = os.environ.get(
-                "KUEUE_TPU_NO_NOMINATE_CACHE", "") != "1"
+            use_nominate_cache = \
+                not knobs.flag("KUEUE_TPU_NO_NOMINATE_CACHE")
         self._use_nominate_cache = use_nominate_cache
         self._nominate_cache: dict = {}
         self.nominate_cache_hits = 0
@@ -1173,7 +1173,7 @@ class BatchSolver:
     def device_fair_enabled() -> bool:
         """The device-side fair-sharing kill switch (read live so the
         differential goldens can flip it per run)."""
-        return os.environ.get("KUEUE_TPU_NO_DEVICE_FAIR", "") != "1"
+        return not knobs.flag("KUEUE_TPU_NO_DEVICE_FAIR")
 
     def fair_share_state(self, snapshot: Snapshot):
         """The refreshed incremental share state
@@ -1253,7 +1253,7 @@ class BatchSolver:
         """Mode requested AND the kill switch clear (read live so A/B
         identity drives can flip KUEUE_TPU_NO_HETERO per run)."""
         return self._hetero_mode \
-            and os.environ.get("KUEUE_TPU_NO_HETERO", "") != "1"
+            and not knobs.flag("KUEUE_TPU_NO_HETERO")
 
     def _hetero_prepare(self, workloads: Sequence[WorkloadInfo]) -> None:
         """Per-tick hetero refresh, BEFORE fingerprinting: ensure every
@@ -1925,7 +1925,7 @@ class BatchSolver:
                 if out is not None and inflight.get("hetero") is not None:
                     inflight["hetero_overrides"] = \
                         self._hetero_overrides(inflight, out)
-                    if os.environ.get("KUEUE_TPU_DEBUG_HETERO") == "1":
+                    if knobs.flag("KUEUE_TPU_DEBUG_HETERO"):
                         self._debug_verify_hetero(
                             inflight, inflight["workloads"], assignments)
                 return assignments
@@ -1942,7 +1942,7 @@ class BatchSolver:
                 if inflight.get("hetero") is not None:
                     inflight["hetero_overrides"] = \
                         self._hetero_overrides(inflight, out)
-                    if os.environ.get("KUEUE_TPU_DEBUG_HETERO") == "1":
+                    if knobs.flag("KUEUE_TPU_DEBUG_HETERO"):
                         self._debug_verify_hetero(inflight, miss_wls,
                                                   fresh)
                 nc = self._nominate_cache

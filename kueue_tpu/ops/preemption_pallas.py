@@ -48,8 +48,15 @@ def _pad_axis(a: np.ndarray, axis: int, to: int) -> np.ndarray:
     return np.pad(a, pad)
 
 
-def _rescale_int32(p: ps.Problem):
-    """Per-column gcd rescale to int32; returns None when impossible."""
+def _rescale_int32(p: ps.Problem, bound: int = 2**30):
+    """Per-column gcd rescale to int32; returns None when impossible.
+
+    `bound` is the acceptance ceiling for REAL (non-sentinel) values.
+    The kernel sums up to `ypad` usage rows plus the lending credit and
+    the workload request into one int32 (`cohort_used + wl_req`), so the
+    caller passes (2^31 - 1) // (ypad + 2) — any rescaled value at or
+    above that could wrap int32 inside `fits_now` on contract-valid
+    inputs (the static TRC02 interval analysis proves the bound tight)."""
     FR = p.usage0.shape[1]
     cols = []
     for c in range(FR):
@@ -73,7 +80,7 @@ def _rescale_int32(p: ps.Problem):
         # undefined quota/limit — which made the Pallas path unreachable
         # dead code (every call fell back to the XLA scan).
         real = out if sentinel_mask is None else out[~sentinel_mask]
-        if real.max(initial=0) >= 2**30:
+        if real.max(initial=0) >= bound:
             return None
         if sentinel_mask is not None:
             out = np.where(sentinel_mask, I32_SENTINEL, out)
@@ -174,7 +181,11 @@ def _kernel(cand_y, cand_prio, scalars,          # scalar-prefetch (SMEM)
         flip = (act & jnp.logical_not(is_target) & (has_threshold != 0)
                 & (prio >= threshold))
         flags[0] = jnp.where(flip, 0, flags[0])
-        new_row = row - jnp.where(act, use_row, 0)
+        # In contract, removed usage never exceeds the row's current
+        # usage, so the floor is a no-op — it pins U to [0, usage0] for
+        # the interval analysis instead of drifting one candidate-range
+        # lower per grid step.
+        new_row = jnp.maximum(row - jnp.where(act, use_row, 0), 0)
         U[:, :] = jnp.where(sel, new_row, U[:, :])
         taken[i] = act.astype(jnp.int32)
         # Host semantics: fits is only checked right after an actual removal.
@@ -192,13 +203,21 @@ def _kernel(cand_y, cand_prio, scalars,          # scalar-prefetch (SMEM)
         removed = (taken[i] != 0) & (i <= stop_idx) & fits_any
         tentative = removed & (i != stop_idx)
         row_now = row_of(U)
-        row_try = row_now + jnp.where(tentative, use_row, 0)
+        # Adding back only ever restores usage removed in phase 1, so U
+        # stays within [0, usage0] in contract — the ceiling/floor are
+        # no-ops that keep the interval analysis from widening U by one
+        # candidate range per grid step.
+        row_try = jnp.minimum(row_now + jnp.where(tentative, use_row, 0),
+                              row_of(usage0))
         U[:, :] = jnp.where(sel, row_try, U[:, :])
         fits = fits_now(flags[0])
         keep_added = tentative & fits
         # Roll back the tentative add when the preemptor no longer fits.
         rollback = tentative & jnp.logical_not(keep_added)
-        U[:, :] = jnp.where(sel, row_try - jnp.where(rollback, use_row, 0),
+        U[:, :] = jnp.where(sel,
+                            jnp.maximum(
+                                row_try - jnp.where(rollback, use_row, 0),
+                                0),
                             U[:, :])
         victim = removed & jnp.logical_not(keep_added)
         victim_out[:, :] = jnp.full((1, LANES), 1, jnp.int32) \
@@ -261,7 +280,12 @@ def scan_kernel_pallas(p: ps.Problem,
                        ) -> Tuple[np.ndarray, np.ndarray]:
     """Run the Pallas kernel on a Problem; falls back to the int64 XLA scan
     when the int32 rescale is impossible."""
-    scaled = _rescale_int32(p)
+    Y = p.usage0.shape[0]
+    ypad = max(SUBLANES, ((Y + SUBLANES - 1) // SUBLANES) * SUBLANES)
+    # fits_now folds ypad usage rows + the lending credit + wl_req into
+    # one int32 sum; values must leave that much headroom or the kernel
+    # can wrap where the int64 referee does not.
+    scaled = _rescale_int32(p, bound=(2**31 - 1) // (ypad + 2))
     if scaled is None:
         victim, fits = ps.scan_kernel(
             jnp.asarray(p.usage0), jnp.asarray(p.nominal),
@@ -278,11 +302,10 @@ def scan_kernel_pallas(p: ps.Problem,
         return np.asarray(victim), np.asarray(fits)
 
     usage0, nominal, guaranteed, wl_req, blim, requestable, cand_use = scaled
-    Y, FR = usage0.shape
+    FR = usage0.shape[1]
     N = cand_use.shape[0]
     if FR > LANES:
         raise ValueError(f"FR={FR} exceeds one lane tile")
-    ypad = max(SUBLANES, ((Y + SUBLANES - 1) // SUBLANES) * SUBLANES)
 
     def pad2(a, rows):
         return _pad_axis(_pad_axis(np.atleast_2d(a), 1, LANES), 0, rows)

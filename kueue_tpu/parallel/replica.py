@@ -357,7 +357,8 @@ class Coordinator:
     def _rebuild(self) -> None:
         """Materialize the split trees: Cohort nodes linked per the specs
         (the snapshot's tree-building shape) with a CachedClusterQueue
-        per member — usage dicts are overwritten per round."""
+        per member — usage dicts are overwritten per round. Caller
+        holds _lock."""
         self._cqs = {}
         nodes: Dict[str, Cohort] = {}
 
@@ -422,7 +423,11 @@ class Coordinator:
             return 0
         last = None
         with self._lock:
-            with open(self.journal_path, "r", encoding="utf-8") as f:
+            # One-shot takeover path: the journal read MUST complete
+            # before any round touches the tree, and nothing else runs
+            # yet in this incarnation — blocking here is the point.
+            with open(self.journal_path, "r",  # kueuelint: disable=LOCK01
+                      encoding="utf-8") as f:
                 for line in f:
                     line = line.strip()
                     if not line:
@@ -545,7 +550,8 @@ class Coordinator:
     def _journal(self, ordered, verdicts) -> None:
         """Append the round's verdicts (reconcile decisions are durable
         like every other admission input: a takeover can audit-replay
-        exactly which cross-replica admissions were committed)."""
+        exactly which cross-replica admissions were committed). Caller
+        holds _lock."""
         if self._journal_file is None:
             os.makedirs(os.path.dirname(self.journal_path) or ".",
                         exist_ok=True)

@@ -11,11 +11,11 @@ inadmissible flushes, and the blocking `heads()` used by the scheduler tick
 
 from __future__ import annotations
 
-import os
 import threading
 import time as _time
 from typing import Callable, Dict, List, Mapping, Optional
 
+from kueue_tpu import knobs
 from kueue_tpu.api.types import (
     CONDITION_EVICTED,
     CONDITION_FINISHED,
@@ -91,7 +91,7 @@ class PendingClusterQueue:
         """Native C++ heap when the toolchain built it (utils/native_heap,
         the counterpart of the reference's Go heap running outside the
         interpreter); pure-Python fallback otherwise."""
-        if os.environ.get("KUEUE_TPU_NATIVE_HEAP", "1") != "0":
+        if knobs.raw("KUEUE_TPU_NATIVE_HEAP") != "0":
             from kueue_tpu.utils import native_heap
             if native_heap.native_available():
                 return native_heap.NativeKeyedHeap(
@@ -441,8 +441,8 @@ class Manager:
             # an oracle-mutation drill: the fuzz corpus meta-test proves
             # the checked-in PR 9 reproducer goes red under it. Inert
             # unless the env gate is set; never set it in production.
-            import os
-            if os.environ.get("KUEUE_TPU_FUZZ_MUTATION") == \
+            from kueue_tpu import knobs as _knobs
+            if _knobs.raw("KUEUE_TPU_FUZZ_MUTATION") == \
                     "no-requeue-on-cq-update":
                 if cq.cohort != old_cohort:
                     self._queue_cohort_inadmissible(cq.cohort, fallback=cq)

@@ -9,10 +9,10 @@ tick snapshot.
 
 from __future__ import annotations
 
-import os
 from typing import Dict, List, Optional, Set
 
 from kueue_tpu import features
+from kueue_tpu import knobs
 from kueue_tpu.api.types import (
     BorrowWithinCohortPolicy,
     CONDITION_EVICTED,
@@ -484,7 +484,7 @@ def _fair_preemptions(wi: WorkloadInfo, assignment: Assignment,
     # None under KUEUE_TPU_NO_DEVICE_FAIR=1.
     if fair_ctx is not None:
         from kueue_tpu.ops.fair_preempt import fair_targets
-        debug = os.environ.get("KUEUE_TPU_DEBUG_FAIR", "") == "1"
+        debug = knobs.flag("KUEUE_TPU_DEBUG_FAIR")
         vec_per_cq = {n: list(c) for n, c in per_cq.items()} if debug \
             else per_cq
         out = fair_targets(fair_ctx, cq, wl_req, vec_per_cq, res_per_flv,

@@ -14,13 +14,13 @@ preemption-target search runs host-side on the snapshot.
 
 from __future__ import annotations
 
-import os
 import time as _time
 from collections import OrderedDict
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Sequence, Set
 
 from kueue_tpu import features
+from kueue_tpu import knobs
 from kueue_tpu.api.types import (
     Admission,
     Condition,
@@ -209,7 +209,7 @@ class Scheduler:
         # walk, unset = on exactly when the native bulk-assume is not
         # built (cache.native_assume_available — the C++ walk wins when
         # present, the aggregation wins over the Python fallback).
-        knob = os.environ.get("KUEUE_TPU_CSR_ASSUME", "")
+        knob = knobs.raw("KUEUE_TPU_CSR_ASSUME")
         from kueue_tpu.core import cache as cache_mod
         self._csr_assume = knob == "1" or (
             knob != "0" and not cache_mod.native_assume_available())
@@ -221,8 +221,7 @@ class Scheduler:
         # order / admit cycle / loser condition-writes are replayed
         # instead of recomputed. KUEUE_TPU_NO_QUIET_TICK=1 kills it (the
         # goldens drive both paths).
-        self._quiet_enabled = os.environ.get(
-            "KUEUE_TPU_NO_QUIET_TICK", "") != "1"
+        self._quiet_enabled = not knobs.flag("KUEUE_TPU_NO_QUIET_TICK")
         # Ring of recent fully-cached tick signatures keyed by the entry
         # uid sequence (pipelined ticks cycle head sets with period ~=
         # depth, so "the identical tick" is usually depth ticks back, not
@@ -673,7 +672,7 @@ class Scheduler:
     def microtick_enabled() -> bool:
         """The micro-tick kill switch, read live so identity drives can
         flip KUEUE_TPU_NO_MICROTICK per run."""
-        return os.environ.get("KUEUE_TPU_NO_MICROTICK", "") != "1"
+        return not knobs.flag("KUEUE_TPU_NO_MICROTICK")
 
     def microtick(self) -> int:
         """Solve ONLY the cohorts dirtied since the last tick — the
@@ -931,7 +930,7 @@ class Scheduler:
                 fair_state = fs_fn(snapshot) if fs_fn is not None else None
             if fair_state is not None:
                 fair_cq_index = fair_state.enc.cq_index
-                if os.environ.get("KUEUE_TPU_DEBUG_FAIR", "") == "1":
+                if knobs.flag("KUEUE_TPU_DEBUG_FAIR"):
                     fair_state.verify(snapshot)
         self._tick_fair_state = fair_state
         self._fair_bulk_miss = 0

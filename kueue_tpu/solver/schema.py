@@ -34,11 +34,11 @@ import threading
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
 
-import os
 
 import numpy as np
 
 from kueue_tpu import features
+from kueue_tpu import knobs
 from kueue_tpu.api.types import (
     BorrowWithinCohortPolicy,
     FlavorFungibilityPolicy,
@@ -477,7 +477,7 @@ class UsageEncoder:
     # refresh re-reads ALL rows and asserts the incrementally-maintained
     # tensor matches — catches any apply_delta/version drift at the cost
     # of the full encode this class exists to avoid. Debug builds only.
-    debug_verify = os.environ.get("KUEUE_TPU_DEBUG_DRIFT", "") == "1"
+    debug_verify = knobs.flag("KUEUE_TPU_DEBUG_DRIFT")
 
     def __init__(self, enc: CQEncoding):
         self.enc = enc
@@ -1126,7 +1126,7 @@ class WorkloadArena:
     # gather ALSO runs the from-scratch encode and asserts tensor
     # equality — the UsageEncoder.debug_verify discipline applied to the
     # workload side.
-    debug_verify = os.environ.get("KUEUE_TPU_DEBUG_ARENA", "") == "1"
+    debug_verify = knobs.flag("KUEUE_TPU_DEBUG_ARENA")
 
     def __init__(self, enc: CQEncoding, snapshot: Snapshot,
                  capacity: int = 1024):
@@ -1446,7 +1446,7 @@ class AdmittedArena:
     the cache dicts after every mutation batch and asserts equality.
     """
 
-    debug_verify = os.environ.get("KUEUE_TPU_DEBUG_ADMIT_ARENA", "") == "1"
+    debug_verify = knobs.flag("KUEUE_TPU_DEBUG_ADMIT_ARENA")
 
     def __init__(self, enc: CQEncoding, capacity: int = 1024):
         self.enc = enc

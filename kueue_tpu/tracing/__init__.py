@@ -14,8 +14,7 @@ Enable with `KUEUE_TPU_TRACE=1`, the `--trace-out` CLI flag, or
 
 from __future__ import annotations
 
-import os
-
+from kueue_tpu import knobs
 from kueue_tpu.tracing.tracer import (
     DEVICE_LANE,
     NULL_SPAN,
@@ -30,7 +29,7 @@ from kueue_tpu.tracing.tracer import (
 # solver/core modules whose import chain circles back to
 # `from kueue_tpu.tracing import TRACER` — by then this name must exist
 # on the partially initialized package.
-TRACER = Tracer(enabled=os.environ.get("KUEUE_TPU_TRACE") == "1")
+TRACER = Tracer(enabled=knobs.flag("KUEUE_TPU_TRACE"))
 
 from kueue_tpu.tracing.explain import ExplainStore, build_record  # noqa: E402
 

@@ -233,6 +233,7 @@ class SocketChannel:
         self._flush_held()
 
     def _flush_held(self) -> None:
+        """Emit the reorder-held frame, if any. Caller holds _wlock."""
         held, self._held_frame = self._held_frame, None
         if held is not None:
             self._write_frame(held)
@@ -412,11 +413,15 @@ class SocketChannel:
 
     # -- connector loop ------------------------------------------------------
 
+    def _is_closed(self) -> bool:
+        with self._wlock:
+            return self._closed
+
     def _dial_loop(self) -> None:
         attempt = 0
         while True:
             self._disconnected.wait()
-            if self._closed:
+            if self._is_closed():
                 return
             try:
                 sock = socket.create_connection(self._addr, timeout=5.0)
@@ -446,9 +451,9 @@ class SocketChannel:
             self.attach(sock, peer_rx=None, send_hello=True)
             # Wait until this socket dies before dialing again.
             while not self._disconnected.wait(timeout=0.05):
-                if self._closed:
+                if self._is_closed():
                     return
-            if self._closed:
+            if self._is_closed():
                 return
 
     # -- drills --------------------------------------------------------------
@@ -461,7 +466,8 @@ class SocketChannel:
 
     @property
     def connected(self) -> bool:
-        return self._sock is not None
+        with self._wlock:
+            return self._sock is not None
 
     @property
     def unacked(self) -> int:

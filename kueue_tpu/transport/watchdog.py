@@ -12,17 +12,18 @@ number, so the error that finally surfaces says exactly WHO missed WHAT.
 
 from __future__ import annotations
 
-import os
 from typing import Optional
+
+from kueue_tpu import knobs
 
 
 def barrier_deadline(default: float) -> float:
     """Seconds a barrier participant may lag before the watchdog calls
     it stalled (`KUEUE_TPU_BARRIER_DEADLINE` overrides)."""
-    raw = os.environ.get("KUEUE_TPU_BARRIER_DEADLINE", "")
-    if raw:
+    override = knobs.raw("KUEUE_TPU_BARRIER_DEADLINE")
+    if override:
         try:
-            return float(raw)
+            return float(override)
         except ValueError:
             pass
     return default

@@ -1,0 +1,25 @@
+# Fixture: three ways to break the knob contract (KNOB01) — a raw
+# os.environ read of a registered knob, a raw read of a knob the
+# registry never declared, and an accessor call with a typo'd name.
+# The disciplined twin is knob_good.py.
+import os
+from os import environ
+
+from kueue_tpu import knobs
+
+
+def arena_disabled():
+    # Registered knob, but read bare: bypasses the registry default and
+    # the README-table contract.
+    return os.environ.get("KUEUE_TPU_NO_ARENA", "") == "1"
+
+
+def secret_mode():
+    # A knob nobody declared: invisible to the docs and the lattice.
+    return environ["KUEUE_TPU_SECRET_MODE"]
+
+
+def eager():
+    # Accessor with a name the registry does not know — a typo that
+    # would otherwise surface as a KeyError inside a kill-switch drill.
+    return knobs.flag("KUEUE_TPU_NO_EAGER_ENCODING")

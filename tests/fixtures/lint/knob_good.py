@@ -1,0 +1,16 @@
+# Fixture: the disciplined twin of knob_bad.py — every env knob goes
+# through the kueue_tpu.knobs registry accessors with registered names.
+from kueue_tpu import knobs
+
+
+def arena_disabled():
+    return knobs.flag("KUEUE_TPU_NO_ARENA")
+
+
+def round_timeout():
+    return float(knobs.raw("KUEUE_TPU_ROUND_TIMEOUT"))
+
+
+def native_heap():
+    # Opt-out knobs compare raw() against their off value explicitly.
+    return knobs.raw("KUEUE_TPU_NATIVE_HEAP") != "0"

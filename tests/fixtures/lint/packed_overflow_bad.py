@@ -1,0 +1,46 @@
+# Fixture: a packed byte-buffer kernel whose SENTINEL FIELD overflows
+# after the unpack chain. The wire layout packs an int64 quota plane
+# (carrying the 2^62 NO_LIMIT sentinel) next to an int64 usage plane;
+# the kernel bitcasts them apart and adds them — exactly the hazard the
+# bitcast-aware Packed domain exists to catch (a flat interval seed
+# would see only "uint8 in [0, 255]" and prove nothing). The good twin
+# of this shape is the real roster: batch-jax / flavor-fit-packed are
+# verified clean with the same packed seeding.
+import jax
+import jax.numpy as jnp  # noqa: F401
+import numpy as np
+
+import kueue_tpu.ops  # noqa: F401  (x64 before tracing)
+
+from kueue_tpu.analysis.jaxpr_tools import packed_layout
+
+SENTINEL = (0, 2**62)
+CANON = (-(2**50), 2**50)
+
+
+def packed_sentinel_add(buf, *, n):
+    # Unpack chain: slice the byte planes apart, bitcast to int64.
+    nominal = jax.lax.bitcast_convert_type(
+        buf[:n * 8].reshape(-1, 8), jnp.int64)
+    usage = jax.lax.bitcast_convert_type(
+        buf[n * 8:].reshape(-1, 8), jnp.int64)
+    # Headroom computed ADDITIVELY on the sentinel plane: 2^62 + 2^62
+    # escapes int64 (the pre-fix `own <= nominal + blim` shape, now
+    # reached through the packed wire format).
+    return usage <= nominal + nominal
+
+
+def _layout(n):
+    return packed_layout([(n, 8, SENTINEL), (n, 8, CANON)])
+
+
+def _build(n):
+    import functools
+    fn = functools.partial(packed_sentinel_add, n=n)
+    return fn, (np.zeros(2 * n * 8, np.uint8),)
+
+
+KUEUEVERIFY_KERNELS = [
+    dict(name="bad-packed-sentinel", buckets=(4, 8), rules=("TRC02",),
+         seeds=lambda n: {0: _layout(n)}, build=_build),
+]

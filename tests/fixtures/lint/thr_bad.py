@@ -1,0 +1,54 @@
+# Fixture: cross-thread state shared without a consistent lock (THR01)
+# and unbounded blocking calls issued on service threads (THR02) — the
+# symmetric-sendall deadlock and zombie-socket wedge shapes. The
+# disciplined twin is thr_good.py.
+import os
+import threading
+
+
+class BadPump:
+    """Reader thread publishes into shared state bare and writes acks
+    with an unbounded sendall on a socket nobody ever bounded."""
+
+    def __init__(self, sock):
+        self._sock = sock
+        self._lock = threading.Lock()
+        self._last = None
+        self._closed = False
+        self._reader = threading.Thread(target=self._read_loop,
+                                        daemon=True)
+        self._reader.start()
+
+    def _read_loop(self):
+        while True:
+            data = self._sock.recv(1 << 16)
+            if not data:
+                return
+            self._last = data
+            self._sock.sendall(b"ack")
+            os.fsync(self._sock.fileno())
+
+    def last(self):
+        with self._lock:
+            return self._last
+
+    def close(self):
+        with self._lock:
+            self._closed = True
+
+
+class BadFlusher:
+    """Service thread makes another queue's liveness its own with an
+    untimed join."""
+
+    def __init__(self, inbox, outbox):
+        self._q = inbox
+        self._other = outbox
+        threading.Thread(target=self._drain_loop, daemon=True).start()
+
+    def _drain_loop(self):
+        while True:
+            item = self._q.get()
+            self._other.put(item)
+            self._other.join()
+            self._q.task_done()

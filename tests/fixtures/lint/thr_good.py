@@ -1,0 +1,45 @@
+# Fixture: the disciplined twin of thr_bad.py. Same thread topology —
+# reader thread, shared state, acks written from the reader — but the
+# socket is bounded with settimeout (a stuck send severs instead of
+# wedging), every cross-thread access is guarded, and the helper
+# documents the lock contract via the *_locked naming convention.
+import socket
+import threading
+
+
+class GoodPump:
+    def __init__(self, sock):
+        sock.settimeout(30.0)
+        self._sock = sock
+        self._lock = threading.Lock()
+        self._last = None
+        self._closed = False
+        self._reader = threading.Thread(target=self._read_loop,
+                                        daemon=True)
+        self._reader.start()
+
+    def _read_loop(self):
+        while True:
+            try:
+                data = self._sock.recv(1 << 16)
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            if not data:
+                return
+            with self._lock:
+                self._note_locked(data)
+            self._sock.sendall(b"ack")
+
+    def _note_locked(self, data):
+        self._last = data
+
+    def last(self):
+        with self._lock:
+            return self._last
+
+    def stop(self):
+        with self._lock:
+            self._closed = True
+        self._reader.join(timeout=5.0)

@@ -66,6 +66,22 @@ def test_trace_roster_covers_every_solve_entry():
         f"kueueverify roster misses solve entry points: {solves - roster}"
 
 
+def test_every_registered_kernel_is_trc02_verified():
+    """No roster entry — in particular no PACKED entry point — may opt
+    out of sentinel-overflow verification: the "verified unpacked
+    instead" exemption is retired (the bitcast-aware Packed domain seeds
+    byte buffers with their wire layout), so every traceable engine and
+    every SOLVE_ENTRYPOINTS kernel runs the full TRC rule set."""
+    by_name = {spec.name: spec for spec in trace_rules.package_roster()}
+    must_verify = {e.name for e in modes.ENGINES if e.traceable}
+    must_verify |= {s.name for s in modes.SOLVE_ENTRYPOINTS}
+    for name in sorted(must_verify):
+        spec = by_name[name]
+        assert "TRC02" in spec.rules, \
+            f"{name}: TRC02 exempted — packed kernels must be verified " \
+            "directly, not via an unpacked stand-in"
+
+
 def test_every_solve_entry_point_exists():
     for spec in modes.SOLVE_ENTRYPOINTS:
         mod = importlib.import_module(spec.module)

@@ -151,6 +151,34 @@ def test_score_kernel_bitwise_identical_to_numpy_twin():
         assert np.array_equal(dev, ref)
 
 
+def test_sentinel_capacity_never_wraps_and_stays_bitwise():
+    """flavor_capacity sums nominal quotas, and a nominal can be the
+    schema's NO_LIMIT/BIG = 2^62 sentinel. Before the CAP_CEIL/PRICE_CEIL
+    clamps, `over * PRICE_STEP` on a sentinel capacity wrapped int64
+    (found statically by TRC02 once the hetero-scores roster entry got
+    its sentinel seed). Pin: sentinel capacity behaves exactly like
+    abundant capacity (price never rises), both twins stay bitwise
+    identical, and nothing wraps."""
+    rng = np.random.default_rng(11)
+    n, f = 32, 4
+    tput = rng.integers(1, 8 * SCORE_SCALE, size=(n, f)).astype(np.int64)
+    demand = rng.integers(1, 64, size=n).astype(np.int64)
+    active = np.ones(n, dtype=bool)
+    sentinel_cap = np.full(f, np.int64(1) << 62, dtype=np.int64)
+    dev = hetero_scores(tput, demand, active, sentinel_cap)
+    ref = hetero_scores_np(tput, demand, active, sentinel_cap)
+    assert np.array_equal(dev, ref)
+    # Capacity is unconstrained -> no flavor is ever overloaded -> the
+    # dual price never moves and every score is the raw throughput.
+    assert np.array_equal(ref, tput)
+    # Zero-capacity extreme with the price ascent saturated: still
+    # bitwise, still inside int64 (the PRICE_CEIL clamp binds).
+    zero_cap = np.zeros(f, dtype=np.int64)
+    dev0 = hetero_scores(tput, demand, active, zero_cap)
+    ref0 = hetero_scores_np(tput, demand, active, zero_cap)
+    assert np.array_equal(dev0, ref0)
+
+
 def test_score_iteration_prices_contended_flavor():
     """One fast flavor everyone wants, with tiny capacity: the dual
     price must push part of the crowd toward the runner-up."""

@@ -1,0 +1,112 @@
+"""The knob-contract registry: accessor semantics, registry hygiene,
+and the generated README table (the CI drift gate).
+
+KNOB01 (tests/test_kueuelint.py) proves every env read goes THROUGH the
+registry; this file proves the registry itself is sound and that the
+documented table is byte-identical to what the registry generates.
+"""
+
+from __future__ import annotations
+
+import re
+from pathlib import Path
+
+import pytest
+
+from kueue_tpu import knobs
+
+README = Path(__file__).resolve().parent.parent / "README.md"
+
+
+def test_registry_names_are_unique_and_prefixed():
+    names = [k.name for k in knobs.REGISTRY]
+    assert len(names) == len(set(names))
+    assert all(n.startswith("KUEUE_TPU_") for n in names)
+
+
+def test_registry_kinds_and_read_disciplines_are_closed():
+    for k in knobs.REGISTRY:
+        assert k.kind in (knobs.KILL_SWITCH, knobs.DEBUG, knobs.TUNING)
+        assert k.read in (knobs.LIVE, knobs.STARTUP)
+        assert k.doc  # every knob is documented; the README table needs it
+
+
+def test_every_kill_switch_reads_as_a_flag_or_documented_opt_out():
+    """NO_* kill switches are opt-in `=1` flags; the only non-NO_* kill
+    switch is the documented NATIVE_HEAP opt-out (default "1", off at
+    "0")."""
+    for k in knobs.REGISTRY:
+        if k.kind != knobs.KILL_SWITCH:
+            continue
+        if "KUEUE_TPU_NO_" in k.name:
+            assert k.default == ""
+        else:
+            assert k.name == "KUEUE_TPU_NATIVE_HEAP"
+            assert k.default == "1"
+
+
+def test_flag_and_raw_semantics(monkeypatch):
+    monkeypatch.delenv("KUEUE_TPU_NO_ARENA", raising=False)
+    assert knobs.flag("KUEUE_TPU_NO_ARENA") is False
+    assert knobs.raw("KUEUE_TPU_NO_ARENA") == ""
+    monkeypatch.setenv("KUEUE_TPU_NO_ARENA", "1")
+    assert knobs.flag("KUEUE_TPU_NO_ARENA") is True
+    # Anything but "1" is off — same as the historical `== "1"` sites.
+    monkeypatch.setenv("KUEUE_TPU_NO_ARENA", "true")
+    assert knobs.flag("KUEUE_TPU_NO_ARENA") is False
+
+
+def test_raw_returns_registered_default(monkeypatch):
+    monkeypatch.delenv("KUEUE_TPU_ROUND_TIMEOUT", raising=False)
+    assert knobs.raw("KUEUE_TPU_ROUND_TIMEOUT") == "60"
+    monkeypatch.delenv("KUEUE_TPU_FAULTS", raising=False)
+    assert knobs.raw("KUEUE_TPU_FAULTS") is None
+    monkeypatch.setenv("KUEUE_TPU_ROUND_TIMEOUT", "5")
+    assert knobs.raw("KUEUE_TPU_ROUND_TIMEOUT") == "5"
+
+
+def test_unregistered_name_is_a_keyerror():
+    """The runtime twin of KNOB01: an undeclared knob cannot be read."""
+    with pytest.raises(KeyError):
+        knobs.raw("KUEUE_TPU_NOT_A_KNOB")
+    with pytest.raises(KeyError):
+        knobs.flag("KUEUE_TPU_NOT_A_KNOB")
+
+
+def test_get_returns_the_declaration():
+    k = knobs.get("KUEUE_TPU_NO_MICROTICK")
+    assert k.kind == knobs.KILL_SWITCH
+    assert k.read == knobs.LIVE
+
+
+def test_readme_knob_table_matches_registry():
+    """The README table between the knob-table markers is EXACTLY
+    markdown_table() — edit kueue_tpu/knobs.py and regenerate
+    (`make knob-table`), never the README by hand."""
+    text = README.read_text(encoding="utf-8")
+    m = re.search(r"<!-- knob-table:begin -->\n(.*?)\n"
+                  r"<!-- knob-table:end -->", text, re.DOTALL)
+    assert m, "README.md lost its knob-table markers"
+    assert m.group(1) == knobs.markdown_table(), (
+        "README knob table drifted from kueue_tpu/knobs.py — regenerate "
+        "with `make knob-table` (see README 'Environment knobs')")
+
+
+def test_fuzz_lattice_toggles_are_registered_kill_switches():
+    """The fuzz identity lattice flips env toggles per run; every
+    toggle it uses must be a registered live kill switch, or the
+    lattice is drilling a knob the contract does not cover."""
+    import ast
+
+    src = (Path(__file__).resolve().parent.parent / "kueue_tpu" / "fuzz"
+           / "lattice.py").read_text(encoding="utf-8")
+    used = {node.value for node in ast.walk(ast.parse(src))
+            if isinstance(node, ast.Constant)
+            and isinstance(node.value, str)
+            and node.value.startswith("KUEUE_TPU_")}
+    assert used, "lattice.py no longer names any env toggles?"
+    for name in sorted(used):
+        k = knobs.get(name)  # KeyError -> unregistered toggle
+        if name.startswith("KUEUE_TPU_NO_"):
+            assert k.kind == knobs.KILL_SWITCH, name
+            assert k.read == knobs.LIVE, name

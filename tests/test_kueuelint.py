@@ -155,6 +155,114 @@ def test_lock_good_fixture():
     assert run_analysis([str(FIXTURES / "lock_good.py")]) == []
 
 
+def test_thr_bad_fixture():
+    findings = run_analysis([str(FIXTURES / "thr_bad.py")])
+    assert _rules_of(findings) == {"THR01", "THR02"}
+    assert all(f.severity == Severity.ERROR for f in findings)
+    thr01 = [f for f in findings if f.rule == "THR01"]
+    assert len(thr01) == 1  # one finding per attribute, not per access
+    assert "`self._last`" in thr01[0].message
+    assert "_read_loop" in thr01[0].message
+    text = (FIXTURES / "thr_bad.py").read_text().splitlines()
+    assert "self._last = data" in text[thr01[0].line - 1]
+    joined = " ".join(f.message for f in findings if f.rule == "THR02")
+    assert "sendall" in joined           # the symmetric-sendall deadlock
+    assert "recv" in joined              # unbounded recv, no settimeout
+    assert "fsync" in joined             # durability on the service loop
+    assert "join()" in joined            # untimed Queue.join
+    assert "_drain_loop" in joined       # root attribution in the message
+
+
+def test_thr_good_fixture():
+    """Identical thread topology, disciplined: settimeout bounds the
+    socket, locks guard the shared state, `*_locked` documents the
+    helper contract — zero findings."""
+    assert run_analysis([str(FIXTURES / "thr_good.py")]) == []
+
+
+def test_thread_roots_inferred_from_real_transport():
+    """Regression-pin the root inference on the richest real surface:
+    SocketChannel spawns a dialer (from a classmethod, via `chan.X`), a
+    reader, and a Timer callback; ChannelListener spawns the accept
+    loop and per-connection handshakes. Losing any of these roots would
+    silently blind THR01/THR02 to the exact threads the PR 11/13
+    incidents ran on."""
+    import ast as ast_mod
+
+    from kueue_tpu.analysis import thread_rules
+
+    src = (Path(__file__).resolve().parent.parent / "kueue_tpu"
+           / "transport" / "socket_channel.py").read_text()
+    tree = ast_mod.parse(src)
+    roots = {}
+    for node in ast_mod.walk(tree):
+        if isinstance(node, ast_mod.ClassDef):
+            roots[node.name] = thread_rules._ClassModel(node).roots
+    assert roots["SocketChannel"] == {"_dial_loop", "_read_loop",
+                                      "_flush_held_timer"}
+    assert roots["ChannelListener"] == {"_accept_loop", "_handshake"}
+
+
+def test_knob_bad_fixture():
+    findings = run_analysis([str(FIXTURES / "knob_bad.py")])
+    assert _rules_of(findings) == {"KNOB01"}
+    assert all(f.severity == Severity.ERROR for f in findings)
+    assert len(findings) == 3
+    joined = " ".join(f.message for f in findings)
+    # Raw read of a registered knob: flagged for bypassing the registry,
+    # but NOT called undeclared.
+    no_arena = [f for f in findings if "KUEUE_TPU_NO_ARENA" in f.message]
+    assert len(no_arena) == 1
+    assert "does not declare" not in no_arena[0].message
+    # Raw read of an undeclared knob: both complaints.
+    secret = [f for f in findings if "KUEUE_TPU_SECRET_MODE" in f.message]
+    assert len(secret) == 1
+    assert "does not declare" in secret[0].message
+    # Typo'd accessor name: caught at lint time, not as a drill KeyError.
+    assert "KUEUE_TPU_NO_EAGER_ENCODING" in joined
+
+
+def test_knob_good_fixture():
+    """Same knobs, read through the registry accessors with registered
+    names — zero findings."""
+    assert run_analysis([str(FIXTURES / "knob_good.py")]) == []
+
+
+def test_knob_dead_registry_entry(tmp_path):
+    """A registry entry no analyzed file references is flagged AT the
+    entry (whole-package runs include knobs.py, so the dead-entry half
+    is live exactly when the registry itself is in scope)."""
+    (tmp_path / "knobs.py").write_text(
+        "class Knob:\n"
+        "    def __init__(self, name, kind, default, read, doc):\n"
+        "        pass\n"
+        "\n"
+        "REGISTRY = (\n"
+        '    Knob("KUEUE_TPU_USED_KNOB", "debug", "", "live", "used"),\n'
+        '    Knob("KUEUE_TPU_UNUSED_KNOB", "debug", "", "live", "dead"),\n'
+        ")\n")
+    (tmp_path / "app.py").write_text(
+        "from kueue_tpu import knobs\n"
+        "\n"
+        "\n"
+        "def on():\n"
+        '    return knobs.flag("KUEUE_TPU_USED_KNOB")\n')
+    findings = run_analysis([str(tmp_path)], select=["KNOB01"])
+    assert len(findings) == 1
+    assert "KUEUE_TPU_UNUSED_KNOB" in findings[0].message
+    assert "no read site" in findings[0].message
+    assert findings[0].path.endswith("knobs.py")
+
+
+def test_knob_registry_covers_every_env_read():
+    """The package-wide contract: zero raw KUEUE_TPU_* env reads outside
+    knobs.py, every accessor name registered, every registry entry
+    read somewhere."""
+    findings = run_analysis([str(PACKAGE)], select=["KNOB01"])
+    report = "\n".join(f.render() for f in findings)
+    assert findings == [], f"knob contract violations:\n{report}"
+
+
 def test_api_bad_fixture():
     findings = run_analysis([str(FIXTURES / "api_bad.py")])
     rules = _rules_of(findings)

@@ -157,6 +157,92 @@ def test_roster_buckets_are_adjacent_powers():
 
 
 # ---------------------------------------------------------------------------
+# TRC02 through the packed byte-buffer kernels (bitcast-aware domain)
+# ---------------------------------------------------------------------------
+
+
+def test_no_roster_kernel_is_exempt_from_trc02():
+    """The packed kernels used to run NO_TRC02 ("verified unpacked
+    instead"); the bitcast-aware Packed domain retired that exemption —
+    every roster entry must run the FULL rule set."""
+    for spec in trace_rules.package_roster():
+        assert spec.rules == trace_rules.ALL_TRC, \
+            f"{spec.name} exempts {trace_rules.ALL_TRC - spec.rules}"
+
+
+def test_packed_kernels_have_wire_layout_seeds():
+    """The packed twins verify via their declared wire layout, not the
+    meaningless uint8 dtype default: their seeds must be bucket-callables
+    producing at least one Packed value."""
+    from kueue_tpu.analysis import jaxpr_tools as jt
+
+    by_name = {s.name: s for s in trace_rules.package_roster()}
+    for name in ("batch-jax", "flavor-fit-packed"):
+        spec = by_name[name]
+        assert callable(spec.seeds), name
+        seeded = spec.seeds(spec.buckets[0])
+        assert any(isinstance(v, jt.Packed) for v in seeded.values()), name
+    pallas = by_name["scan-pallas"]
+    assert pallas.seeds and pallas.scratch_seeds
+
+
+def test_packed_domain_survives_unpack_chain():
+    """Unit-level: a Packed window pushed through the canonical
+    slice -> reshape -> bitcast unpack chain degrades to exactly the
+    seeded per-field interval, and a window that fuses two fields
+    degrades to UNKNOWN (never a false bound)."""
+    from kueue_tpu.analysis import jaxpr_tools as jt
+
+    layout = jt.packed_layout([(4, 8, (0, 2**62)), (4, 8, (-5, 7))])
+    assert not layout.to_interval().known  # mixed widths vs elem_bytes=1
+    first = jt.Packed(0, 32, 8, layout.sections)
+    assert (first.to_interval().lo, first.to_interval().hi) == (0, 2**62)
+    second = jt.Packed(32, 32, 8, layout.sections)
+    assert (second.to_interval().lo, second.to_interval().hi) == (-5, 7)
+    both = jt.Packed(0, 64, 8, layout.sections)
+    assert (both.to_interval().lo, both.to_interval().hi) == (-5, 2**62)
+    misaligned = jt.Packed(4, 32, 8, layout.sections)
+    assert not misaligned.to_interval().known
+    wrong_width = jt.Packed(0, 32, 4, layout.sections)
+    assert not wrong_width.to_interval().known
+
+
+def test_packed_overflow_bad_fixture_caught():
+    """A sentinel overflow reachable only THROUGH the packed wire format
+    (slice + bitcast unpack) must be found — a flat interval seed on the
+    uint8 buffer proves nothing about the int64 planes inside."""
+    findings = run_analysis([str(FIXTURES / "packed_overflow_bad.py")],
+                            engine="trace")
+    assert _rules_of(findings) == {"TRC02"}
+    assert any("exceeds int64" in f.message for f in findings)
+    text = (FIXTURES / "packed_overflow_bad.py").read_text().splitlines()
+    f = next(f for f in findings if f.rule == "TRC02")
+    assert "nominal + nominal" in text[f.line - 1]
+
+
+def test_packed_roster_kernels_verify_clean_under_trc02():
+    """The real packed kernels, seeded with their wire layouts (and the
+    Pallas scratch contract), carry NO sentinel-overflow hazards — the
+    tentpole acceptance: TRC02 verifies every packed kernel at its
+    canonical buckets."""
+    from kueue_tpu.analysis.trace_rules import (
+        _check_trc02, package_roster)
+
+    class _Ctx:
+        files = ()
+
+    for spec in package_roster():
+        if spec.name not in ("batch-jax", "flavor-fit-packed",
+                             "scan-pallas", "hetero-scores"):
+            continue
+        jaxprs = trace_rules._lower(spec)
+        for bucket in spec.buckets:
+            found = _check_trc02(_Ctx(), spec, jaxprs[bucket], bucket)
+            assert not found, (spec.name, bucket,
+                               [f.message for f in found])
+
+
+# ---------------------------------------------------------------------------
 # Flow engine fixtures
 # ---------------------------------------------------------------------------
 
