@@ -11,8 +11,8 @@ PYTHON ?= python
 
 .PHONY: help test test-fast bench bench-smoke trace-smoke multichip-smoke \
 	replica-smoke multihost-smoke fleet-smoke hetero-smoke fuzz-smoke \
-	fuzz-nightly fuzz-soak native lint verify-static verify-threads \
-	verify-knobs knob-table install serve dryrun
+	fuzz-nightly fuzz-soak twin-smoke native lint verify-static \
+	verify-threads verify-knobs knob-table install serve dryrun
 
 help:
 	@echo "kueue-tpu developer targets:"
@@ -66,6 +66,10 @@ help:
 	@echo "  make fuzz-soak      hours-scale churn soak watching RSS /"
 	@echo "                      arena occupancy / cache-hit / dispatch"
 	@echo "                      drift (KUEUE_FUZZ_SOAK_SECONDS)"
+	@echo "  make twin-smoke     digital twin CI budget: twin unit tests,"
+	@echo "                      byte cross-check vs lattice.drive(), a"
+	@echo "                      trace replay, and the 3-config what-if"
+	@echo "                      sweep on a CPU-sized trace"
 	@echo "  make native         build the C++ runtime pieces"
 	@echo "  make serve          run the API server"
 	@echo "  make dryrun         compile-check the flagship jit path"
@@ -412,6 +416,36 @@ fuzz-smoke:
 	  assert True in ax.get('micro', []), ax; \
 	  assert rep['environment'].get('cpu_count'), rep['environment']; \
 	  print('fuzz-smoke OK:', rep['scenarios'], 'scenarios, axes', ax)"
+
+# Digital-twin CI budget (< 2 min on CPU): the twin unit tests (trace
+# model, generators, duration model, what-if algebra, determinism, and
+# the pinned twin-vs-drive() byte-identity seeds), then the CLI three
+# ways — byte cross-check against lattice.drive() on fresh generator
+# seeds, a small replay that must finish with zero quota violations,
+# and the what-if sweep over >= 3 capacity configs whose report gates
+# on per-config oracle cleanliness.
+twin-smoke:
+	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_twin.py -q \
+	  -m "not slow"
+	JAX_PLATFORMS=cpu $(PYTHON) -m kueue_tpu.twin --crosscheck 3
+	JAX_PLATFORMS=cpu $(PYTHON) -m kueue_tpu.twin \
+	  --shape diurnal_heavy --workloads 20000 --days 1 --cqs 16 \
+	  --cohorts 4 --engine referee --whatif baseline \
+	  --whatif quota-75:quota=0.75 --whatif quota-150:quota=1.5 \
+	  --out /tmp/kueue-twin-smoke.json
+	$(PYTHON) -c "import json; \
+	  rep = json.load(open('/tmp/kueue-twin-smoke.json')); \
+	  assert rep['format'] == 'kueuetwin-report/v1', rep['format']; \
+	  assert rep['ok'], [r['name'] for r in rep['configs'] \
+	                     if r['quota_violations']]; \
+	  names = [r['name'] for r in rep['configs']]; \
+	  assert len(names) >= 3, names; \
+	  base = rep['configs'][0]['metrics']; \
+	  assert base['completed'] > 0, base; \
+	  assert base['goodput_wl_per_vday'] > 0, base; \
+	  print('twin-smoke OK:', names, 'goodput', \
+	        {r['name']: r['metrics']['goodput_wl_per_vday'] \
+	         for r in rep['configs']})"
 
 # Hours-scale churn soak (default 2h; KUEUE_FUZZ_SOAK_SECONDS overrides):
 # RSS / arena-occupancy / nominate-cache-hit / dispatch-rate curves must

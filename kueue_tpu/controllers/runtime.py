@@ -422,12 +422,19 @@ class Framework:
 
     # -- workload lifecycle --------------------------------------------------
 
-    def submit(self, wl: Workload) -> None:
-        """A new pending workload enters the system."""
+    def submit(self, wl: Workload, *, validate: bool = True) -> None:
+        """A new pending workload enters the system.
+
+        `validate=False` skips the webhook validation pass only — a
+        pure check that cannot mutate the object, so the admitted
+        state is identical either way. Bulk trusted ingest (the twin's
+        10^6-arrival replays) uses it; everything defaulting or
+        resource-adjusting still runs."""
         webhooks.default_workload(wl)
-        errs = webhooks.validate_workload(wl)
-        if errs:
-            raise webhooks.ValidationError(errs)
+        if validate:
+            errs = webhooks.validate_workload(wl)
+            if errs:
+                raise webhooks.ValidationError(errs)
         # Fold RuntimeClass overhead, LimitRange defaults and limits->
         # requests into podset requests (workload.AdjustResources; done by
         # the Workload reconciler on create in the reference,

@@ -30,9 +30,35 @@ def _pin_cpu_backend() -> None:
             xf + " --xla_force_host_platform_device_count=2").strip()
 
 
+def parse_shard(spec: str) -> tuple:
+    """"I/N" -> (i, n): shard i of n, 0-based, 0 <= i < n."""
+    try:
+        i_s, n_s = spec.split("/", 1)
+        i, n = int(i_s), int(n_s)
+    except ValueError:
+        raise ValueError(f"--shard wants I/N (got {spec!r})")
+    if n < 1 or not 0 <= i < n:
+        raise ValueError(f"--shard {spec!r}: need 0 <= I < N")
+    return i, n
+
+
+def shard_range(start_seed: int, seeds: int, shard) -> tuple:
+    """The contiguous [lo, hi) seed slice shard i of n owns. The slices
+    partition the full range exactly (no seed dropped or doubled), so
+    N processes running `--shard 0/N .. (N-1)/N` over the same
+    --seeds/--start-seed jointly cover the same campaign one process
+    would — the nightly 1k-seed budget split across runners."""
+    if not shard:
+        return start_seed, start_seed + seeds
+    i, n = shard
+    return (start_seed + (seeds * i) // n,
+            start_seed + (seeds * (i + 1)) // n)
+
+
 def run_campaign(seeds: int, start_seed: int, out: str,
                  shrink_on_failure: bool = True,
-                 include_socket: bool = False) -> int:
+                 include_socket: bool = False,
+                 shard=None) -> int:
     from kueue_tpu.fuzz import generator, lattice, shrink
     from kueue_tpu.utils.envinfo import environment_block
 
@@ -41,9 +67,27 @@ def run_campaign(seeds: int, start_seed: int, out: str,
     axes_seen = {"engines": set(), "shards": set(), "replicas": set(),
                  "kill_switches": set(), "drills": set(),
                  "transports": set(), "micro": set()}
-    for seed in range(start_seed, start_seed + seeds):
+    # Per-oracle coverage: how many preemptions / revocations / micro
+    # admissions each draw dimension produced across the campaign. A
+    # dimension whose count stays zero lands on the "never" list — the
+    # dead corpus regions ROADMAP 5a wants visible in every report.
+    coverage = {"preemption": {}, "revocation": {},
+                "micro_admission": {}}
+    lo, hi = shard_range(start_seed, seeds, shard)
+    if shard:
+        print(f"# shard {shard[0]}/{shard[1]}: seeds [{lo}, {hi})",
+              file=sys.stderr)
+    for seed in range(lo, hi):
         sc = generator.draw_scenario(seed)
         report = lattice.check_scenario(sc, include_socket=include_socket)
+        events = report.get("events") or {}
+        hits = {"preemption": events.get("preempted", 0),
+                "revocation": events.get("revocations", 0),
+                "micro_admission": events.get("micro_admitted", 0)}
+        for dim in generator.scenario_dimensions(sc):
+            for family, n in hits.items():
+                bucket = coverage[family]
+                bucket[dim] = bucket.get(dim, 0) + n
         for ax in report["axes"]:
             axes_seen["engines"].add(ax["engine"])
             axes_seen["shards"].add(ax["shards"])
@@ -79,21 +123,35 @@ def run_campaign(seeds: int, start_seed: int, out: str,
             print(f"#   reproducer written: {repro_path} "
                   f"(size {small.size()})", file=sys.stderr)
 
+    oracle_coverage = {
+        family: {
+            "events_by_dimension": dict(sorted(counts.items())),
+            "never": sorted(d for d, c in counts.items() if c == 0),
+        }
+        for family, counts in coverage.items()}
     doc = {
-        "scenarios": seeds,
-        "start_seed": start_seed,
+        "scenarios": hi - lo,
+        "start_seed": lo,
+        "requested": {"seeds": seeds, "start_seed": start_seed},
+        "shard": ({"index": shard[0], "of": shard[1],
+                   "seed_lo": lo, "seed_hi": hi - 1} if shard else None),
         "violations": all_violations,
         "lattice_axes": {k: sorted(v, key=str)
                          for k, v in axes_seen.items()},
+        "oracle_coverage": oracle_coverage,
         "environment": environment_block(),
         "reports": reports,
     }
     with open(out, "w", encoding="utf-8") as f:
         json.dump(doc, f, indent=1)
     print(json.dumps({
-        "metric": "fuzz_campaign", "scenarios": seeds,
+        "metric": "fuzz_campaign", "scenarios": hi - lo,
+        "shard": doc["shard"],
         "violations": len(all_violations),
-        "lattice_axes": doc["lattice_axes"]}), flush=True)
+        "lattice_axes": doc["lattice_axes"],
+        "coverage_never": {f: c["never"]
+                           for f, c in oracle_coverage.items()}}),
+        flush=True)
     return 1 if all_violations else 0
 
 
@@ -132,6 +190,12 @@ def main(argv=None) -> int:
     ap.add_argument("--soak", type=float, metavar="SECONDS",
                     help="run the long-run churn soak instead of "
                          "fuzzing")
+    ap.add_argument("--shard", metavar="I/N", default=None,
+                    help="run seed shard I of N (0-based): the "
+                         "contiguous slice of [--start-seed, "
+                         "--start-seed + --seeds) this process owns — "
+                         "N processes with 0/N..N-1/N cover the full "
+                         "range exactly once (the nightly split)")
     ap.add_argument("--lattice", choices=("default", "socket"),
                     default="default",
                     help="'socket' adds the multi-HOST lattice points "
@@ -144,6 +208,14 @@ def main(argv=None) -> int:
         # accepting the flag would report ok with zero socket coverage.
         ap.error("--lattice socket applies to campaign mode only "
                  "(run `make fuzz-nightly` for the socket budget)")
+    if args.shard is not None and (args.corpus or args.soak is not None):
+        ap.error("--shard applies to campaign mode only")
+    shard = None
+    if args.shard is not None:
+        try:
+            shard = parse_shard(args.shard)
+        except ValueError as exc:
+            ap.error(str(exc))
     if args.corpus:
         return run_corpus(args.corpus)
     if args.soak is not None:
@@ -153,13 +225,17 @@ def main(argv=None) -> int:
         print(json.dumps({
             "metric": "fuzz_soak", "ok": report["ok"],
             "ticks": report["ticks"],
+            "findings": len(report.get("findings") or []),
             "verdict": {k: v["ok"]
                         for k, v in report["verdict"].items()}}),
             flush=True)
+        for finding in report.get("findings") or []:
+            print(f"#   soak finding: {finding}", file=sys.stderr)
         return 0 if report["ok"] else 1
     return run_campaign(args.seeds, args.start_seed, args.out,
                         shrink_on_failure=not args.no_shrink,
-                        include_socket=args.lattice == "socket")
+                        include_socket=args.lattice == "socket",
+                        shard=shard)
 
 
 if __name__ == "__main__":

@@ -292,3 +292,34 @@ def draw_scenario(seed: int) -> Scenario:
         policy={"fair": fair, "lending": lending, "hetero": hetero,
                 "pods_ready": pods_ready, "shape": shape},
         workloads=workloads, traffic=traffic)
+
+
+def scenario_dimensions(sc: Scenario) -> list:
+    """Draw-dimension labels for one scenario — the keys of the
+    campaign's per-oracle coverage rollup. Derived from the scenario
+    itself (not the draw code paths), so loaded reproducers and
+    hand-written scenarios label identically to fresh draws."""
+    structure = ("tree" if sc.cohorts
+                 else "flat" if any(c.get("cohort")
+                                    for c in sc.cluster_queues)
+                 else "solo")
+    styles = set()
+    for cq in sc.cluster_queues:
+        pre = cq.get("preemption") or {}
+        if pre.get("borrow"):
+            styles.add("borrow")
+        if pre.get("reclaim", "Never") != "Never":
+            styles.add("reclaim")
+        if pre.get("within", "Never") != "Never":
+            styles.add("within")
+    dims = [f"shape={sc.policy.get('shape')}",
+            f"structure={structure}",
+            f"preemption={'+'.join(sorted(styles)) or 'never'}"]
+    for flag in ("fair", "lending", "hetero", "pods_ready"):
+        if sc.policy.get(flag):
+            dims.append(f"policy={flag}")
+    if sc.topology:
+        dims.append("policy=topology")
+    if sc.seed % 4 == 3:
+        dims.append("profile=replica")
+    return dims

@@ -369,12 +369,101 @@ def _build_framework(sc: Scenario, point: LatticePoint, clock):
     return fw
 
 
+class FrameworkTrafficDriver:
+    """Traffic application against a live Framework — the ONE home of
+    the deterministic op selectors for in-process drives. Shared by the
+    lattice's framework points and the digital twin's replay engine
+    (kueue_tpu/twin/engine.py), so the twin applies exactly the op
+    semantics the decision-identity oracles were proven on; a selector
+    change lands in both or the byte-match cross-check goes red."""
+
+    def __init__(self, fw, sc: Scenario,
+                 st: Optional[_TrafficState] = None):
+        self.fw = fw
+        self.sc = sc
+        self.st = st if st is not None else _TrafficState()
+        self.objects: Dict[str, object] = {}
+        self.cq_specs = {c["name"]: c for c in sc.cluster_queues}
+        self.caps_hw = sc_mod.nominal_capacity(sc, {})
+
+    def submit(self, spec: dict, wl=None, validate: bool = True):
+        """`wl`/`validate` are the twin's bulk-ingest seam: a prebuilt
+        (equal) workload object and a skipped pure-validation pass.
+        Fuzz drives never pass them — the lattice keeps the full
+        production submit path."""
+        st = self.st
+        if wl is None:
+            wl = sc_mod.workload_object(spec)
+        self.objects[wl.key] = wl
+        st.submitted[wl.key] = spec
+        st.pending.add(wl.key)
+        self.fw.submit(wl, validate=validate)
+        return wl
+
+    def finish_key(self, key: str) -> bool:
+        """Finish+delete one admitted workload by key — the same body
+        as one step of the "finish" selector; the twin's duration-driven
+        completions route through here."""
+        st = self.st
+        wl = self.objects.get(key)
+        if wl is None or not wl.is_admitted or wl.is_finished:
+            return False
+        self.fw.finish(wl)
+        self.fw.delete_workload(wl)
+        st.admitted.pop(key, None)
+        st.ready_marked.discard(key)
+        return True
+
+    def apply(self, op: list) -> None:
+        st = self.st
+        kind = op[0]
+        if kind == "submit":
+            self.submit(op[1])
+        elif kind == "finish":
+            for key, _cq in st.oldest_admitted(int(op[1])):
+                self.finish_key(key)
+        elif kind == "delete":
+            key = f"default/{op[1]}"
+            wl = self.objects.get(key)
+            if wl is not None and key in st.pending \
+                    and not wl.is_admitted and not wl.is_finished:
+                self.fw.delete_workload(wl)
+                st.pending.discard(key)
+        elif kind == "update_cq":
+            name, factor = op[1], float(op[2])
+            st.factors[name] = st.factors.get(name, 1.0) * factor
+            _merge_caps(self.caps_hw,
+                        sc_mod.nominal_capacity(self.sc, st.factors))
+            self.fw.update_cluster_queue(
+                sc_mod.cq_object(self.cq_specs[name], st.factors[name]))
+        elif kind == "ready":
+            n = int(op[1])
+            marked = 0
+            for _tick, key, _cq in st.admit_order:
+                if key in st.admitted and key not in st.ready_marked:
+                    wl = self.objects.get(key)
+                    if wl is not None and wl.is_admitted:
+                        self.fw.mark_pods_ready(wl)
+                        st.ready_marked.add(key)
+                        marked += 1
+                        if marked >= n:
+                            break
+        else:
+            raise ValueError(f"unknown traffic op {op!r}")
+
+    def note_tick(self, t: int, tick_admitted, tick_preempted) -> None:
+        st = self.st
+        st.note_admitted(t, [(k, st.submitted[k]["queue"][3:])
+                             for k in tick_admitted])
+        st.note_preempted(tick_preempted)
+
+
 def _drive_framework(sc: Scenario, point: LatticePoint) -> dict:
     clock = TickClock()
     fw = _build_framework(sc, point, clock)
-    st = _TrafficState()
-    cq_specs = {c["name"]: c for c in sc.cluster_queues}
-    caps_hw = sc_mod.nominal_capacity(sc, {})
+    drv = FrameworkTrafficDriver(fw, sc)
+    st = drv.st
+    caps_hw = drv.caps_hw
 
     tick_admitted: List[str] = []
     tick_preempted: List[str] = []
@@ -394,58 +483,8 @@ def _drive_framework(sc: Scenario, point: LatticePoint) -> dict:
     fw.scheduler.apply_admission = apply_admission
     fw.scheduler.apply_preemption = apply_preemption
 
-    objects: Dict[str, object] = {}
-
-    def submit(spec: dict) -> None:
-        wl = sc_mod.workload_object(spec)
-        objects[wl.key] = wl
-        st.submitted[wl.key] = spec
-        st.pending.add(wl.key)
-        fw.submit(wl)
-
-    def apply_op(op: list) -> None:
-        kind = op[0]
-        if kind == "submit":
-            submit(op[1])
-        elif kind == "finish":
-            for key, _cq in st.oldest_admitted(int(op[1])):
-                wl = objects.get(key)
-                if wl is None or not wl.is_admitted or wl.is_finished:
-                    continue
-                fw.finish(wl)
-                fw.delete_workload(wl)
-                del st.admitted[key]
-                st.ready_marked.discard(key)
-        elif kind == "delete":
-            key = f"default/{op[1]}"
-            wl = objects.get(key)
-            if wl is not None and key in st.pending \
-                    and not wl.is_admitted and not wl.is_finished:
-                fw.delete_workload(wl)
-                st.pending.discard(key)
-        elif kind == "update_cq":
-            name, factor = op[1], float(op[2])
-            st.factors[name] = st.factors.get(name, 1.0) * factor
-            _merge_caps(caps_hw, sc_mod.nominal_capacity(sc, st.factors))
-            fw.update_cluster_queue(
-                sc_mod.cq_object(cq_specs[name], st.factors[name]))
-        elif kind == "ready":
-            n = int(op[1])
-            marked = 0
-            for _tick, key, _cq in st.admit_order:
-                if key in st.admitted and key not in st.ready_marked:
-                    wl = objects.get(key)
-                    if wl is not None and wl.is_admitted:
-                        fw.mark_pods_ready(wl)
-                        st.ready_marked.add(key)
-                        marked += 1
-                        if marked >= n:
-                            break
-        else:
-            raise ValueError(f"unknown traffic op {op!r}")
-
     for spec in sc.workloads:
-        submit(spec)
+        drv.submit(spec)
 
     # Micro-point bookkeeping for the per-CQ FIFO invariant oracle:
     # per-CQ admission sequence (StrictFIFO queues only — BestEffortFIFO
@@ -463,7 +502,7 @@ def _drive_framework(sc: Scenario, point: LatticePoint) -> dict:
         tick_preempted.clear()
         if t < sc.ticks:
             for op in sc.traffic[t] if t < len(sc.traffic) else ():
-                apply_op(op)
+                drv.apply(op)
         if point.micro:
             # The event-driven path: dirty cohorts admit NOW, before
             # the tick (a no-op under KUEUE_TPU_NO_MICROTICK=1 — the
@@ -471,9 +510,7 @@ def _drive_framework(sc: Scenario, point: LatticePoint) -> dict:
             fw.microtick()
         fw.tick()
         clock.advance()
-        st.note_admitted(t, [(k, st.submitted[k]["queue"][3:])
-                             for k in tick_admitted])
-        st.note_preempted(tick_preempted)
+        drv.note_tick(t, tick_admitted, tick_preempted)
         ever_preempted.update(tick_preempted)
         for k in tick_admitted:
             cq_name = st.submitted[k]["queue"][3:]
@@ -802,7 +839,36 @@ def check_scenario(sc: Scenario,
     report = {"seed": sc.seed,
               "points": [p.name for p in points],
               "axes": [p.axes() for p in points],
-              "violations": violations}
+              "violations": violations,
+              "events": _event_rollup(points, results)}
     if keep_results:
         report["results"] = results
     return report
+
+
+def _event_rollup(points: List[LatticePoint],
+                  results: Dict[str, dict]) -> dict:
+    """What the scenario actually EXERCISED, rolled up across the
+    lattice: admission / preemption counts from the reference trail,
+    micro admissions and replica revocations from the point evidence.
+    The campaign aggregates these per draw dimension so dead corpus
+    regions (a dimension that never produced a preemption, revocation,
+    or micro admission) are visible in every report."""
+    ev = {"admitted": 0, "preempted": 0, "micro_admitted": 0,
+          "revocations": 0}
+    ref = results.get(points[0].name) if points else None
+    if ref is not None:
+        for adm, pre in ref["trail"]:
+            ev["admitted"] += len(adm)
+            ev["preempted"] += len(pre)
+    for p in points:
+        r = results.get(p.name)
+        if r is None:
+            continue
+        evidence = r.get("evidence") or {}
+        ev["micro_admitted"] += int(evidence.get("micro_admitted") or 0)
+        coord = evidence.get("coordinator") or {}
+        ev["revocations"] += int(coord.get("revocations") or 0)
+        deg = evidence.get("degraded") or {}
+        ev["revocations"] += int(deg.get("revocations") or 0)
+    return ev

@@ -20,6 +20,14 @@ occupancy / dispatch rate meaningfully above early, late hit/reuse
 ratios meaningfully below) fails the soak. Registered behind the `slow`
 pytest marker (tests/test_fuzz_soak.py) and `make fuzz-soak`
 (KUEUE_FUZZ_SOAK_SECONDS sets the hours-scale budget).
+
+Divergences auto-file, same as campaign divergences: every
+`oracle_every` sample windows the soak interleaves one lattice
+scenario spot-check (the campaign's oracles at a small point budget);
+a violation shrinks through shrink.shrink and lands as a reproducer
+file next to the report, and a failed drift verdict writes a
+self-contained soak-repro doc (params + samples + verdict) — soak
+findings used to die in the log (ROADMAP 5a).
 """
 
 from __future__ import annotations
@@ -28,6 +36,8 @@ import json
 import os
 import time
 from typing import List, Optional
+
+SOAK_REPRO_FORMAT = "kueuefuzz-soak-repro/v1"
 
 # Drift tolerances: absolute floors absorb small-number noise, the
 # ratios catch the monotonic creep the soak exists to find.
@@ -48,13 +58,92 @@ def _mean(vals: List[float]) -> Optional[float]:
     return sum(vals) / len(vals) if vals else None
 
 
+def _findings_dir(findings_dir: Optional[str],
+                  report_path: Optional[str]) -> str:
+    if findings_dir:
+        return findings_dir
+    if report_path:
+        return os.path.dirname(os.path.abspath(report_path)) or "."
+    return "."
+
+
+def _oracle_spot_check(seed: int, findings_dir: str,
+                       check=None, shrinker=None,
+                       points=None) -> List[dict]:
+    """One interleaved lattice spot-check: draw a scenario, run the
+    campaign's oracles over a small point budget, and on any violation
+    auto-file a shrunk reproducer exactly like a campaign divergence.
+    `check` / `shrinker` / `points` are injectable for the tier-1 tests
+    (a real shrink loop is minutes, not tier-1 budget)."""
+    from kueue_tpu.fuzz import generator, lattice, shrink
+
+    if check is None:
+        check = lattice.check_scenario
+    sc = generator.draw_scenario(seed)
+    if points is None:
+        # Reference + repeat + one batched engine: the determinism,
+        # identity, and quota oracles at soak-lane cost (the full
+        # replica/drill budget stays with the campaign).
+        points = lattice.default_lattice(sc)[:4]
+    report = check(sc, points=points)
+    if not report["violations"]:
+        return []
+
+    def still_fails(cand):
+        return bool(check(cand, points=points)["violations"])
+
+    if shrinker is None:
+        def shrinker(s, pred):
+            return shrink.shrink(s, pred, budget=80)
+
+    small, attempts = shrinker(sc, still_fails)
+    path = os.path.join(findings_dir, f"soak-repro-seed{seed}.json")
+    shrink.write_reproducer(
+        path, small, name=f"soak-seed-{seed}",
+        description="shrunk from a soak oracle spot-check divergence",
+        found={"seed": seed, "lane": "soak-oracle",
+               "violations": report["violations"][:4],
+               "shrink_attempts": attempts})
+    return [{"kind": "oracle", "seed": seed, "reproducer": path,
+             "violations": report["violations"][:4]}]
+
+
+def _file_drift_repro(findings_dir: str, params: dict, samples: list,
+                      verdict: dict) -> dict:
+    """A failed drift verdict files a self-contained repro doc: the
+    exact run_soak params to re-drive it plus the curves and the
+    verdict that went red — the soak equivalent of a shrunk scenario
+    (there is no smaller scenario than "these params, this long")."""
+    path = os.path.join(findings_dir, "soak-drift-repro.json")
+    doc = {"format": SOAK_REPRO_FORMAT,
+           "name": "soak-drift",
+           "description": "soak drift verdict failure: re-run "
+                          "run_soak(**params) to reproduce",
+           "params": params,
+           "verdict": verdict,
+           "samples": samples}
+    os.makedirs(findings_dir or ".", exist_ok=True)
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(doc, f, indent=1, sort_keys=True)
+        f.write("\n")
+    return {"kind": "drift", "reproducer": path,
+            "failed": sorted(k for k, v in verdict.items()
+                             if not v["ok"])}
+
+
 def run_soak(duration_s: float, *, seed: int = 0, num_cqs: int = 32,
              backlog: int = 512, sample_every: int = 25,
              report_path: Optional[str] = None,
-             gc_every: int = 50) -> dict:
+             gc_every: int = 50, oracle_every: int = 8,
+             findings_dir: Optional[str] = None) -> dict:
     """Run the churn soak for `duration_s` wall seconds; returns the
     report dict (also written to `report_path` when given). The verdict
-    lives under report["verdict"]; report["ok"] is the rollup."""
+    lives under report["verdict"]; report["ok"] is the rollup (drift
+    verdict AND zero oracle findings). Every `oracle_every` sample
+    windows one lattice scenario spot-check interleaves with the churn;
+    its divergences (and a failed drift verdict) auto-file reproducers
+    under `findings_dir` (default: next to the report) and land in
+    report["findings"]."""
     import random
 
     from kueue_tpu.api.types import PodSet, Workload
@@ -113,6 +202,9 @@ def run_soak(duration_s: float, *, seed: int = 0, num_cqs: int = 32,
         fw.prewarm_idle()
 
     samples: List[dict] = []
+    findings: List[dict] = []
+    fdir = _findings_dir(findings_dir, report_path)
+    spot_no = [0]
     t_end = time.monotonic() + duration_s
     window_base = solver.fuzz_counters()
     window_ticks = 0
@@ -153,6 +245,15 @@ def run_soak(duration_s: float, *, seed: int = 0, num_cqs: int = 32,
             })
             window_base = now
             window_ticks = 0
+            if oracle_every and len(samples) % oracle_every == 0:
+                # The divergence lane: one lattice scenario through
+                # the campaign's oracles, auto-filing any finding.
+                # Seeded off the soak's own seed + a running counter —
+                # a distinct base keeps the lane from re-walking the
+                # campaign's seed space.
+                spot_no[0] += 1
+                findings.extend(_oracle_spot_check(
+                    7_700_000 + seed * 1_000 + spot_no[0], fdir))
     report = {
         "ticks": tick_no[0],
         "duration_s": round(duration_s, 1),
@@ -160,8 +261,18 @@ def run_soak(duration_s: float, *, seed: int = 0, num_cqs: int = 32,
         "environment": environment_block(),
         "verdict": drift_verdict(samples),
     }
-    report["ok"] = all(v["ok"] for v in report["verdict"].values()) \
+    drift_ok = all(v["ok"] for v in report["verdict"].values()) \
         if report["verdict"] else False
+    if report["verdict"] and not drift_ok:
+        findings.append(_file_drift_repro(
+            fdir,
+            {"duration_s": duration_s, "seed": seed,
+             "num_cqs": num_cqs, "backlog": backlog,
+             "sample_every": sample_every, "gc_every": gc_every},
+            samples, report["verdict"]))
+    report["findings"] = findings
+    report["ok"] = drift_ok and not any(
+        f["kind"] == "oracle" for f in findings)
     if report_path:
         with open(report_path, "w", encoding="utf-8") as f:
             json.dump(report, f, indent=1)

@@ -1,0 +1,32 @@
+"""kueue_tpu.twin: the digital twin — discrete-event trace replay on
+the real decision kernels.
+
+A capacity simulator that is not a model: the twin builds the same
+Framework the fuzz lattice builds (flavor-fit, preemption,
+fair-sharing, cohort quota — the real kernels) and drives it at
+virtual time from a trace, so a multi-day 10^6-workload arrival
+process replays in minutes in one process while making exactly the
+decisions production would make. Cross-check mode proves it: on
+lattice-sized scenarios the twin's decision trail is byte-identical
+to lattice.drive().
+
+    trace.py       Trace model + JSON formats (also loads fuzz
+                   scenarios/reproducers), twin_cluster()
+    generators.py  seeded lazy arrival shapes (diurnal, heavy-tailed,
+                   adversarial-burst, Mesos-style mix)
+    engine.py      TwinEngine: paced + event-driven virtual-time replay
+    whatif.py      capacity sweeps + comparison report
+    crosscheck.py  twin-vs-drive() byte-identity oracle
+    __main__.py    python -m kueue_tpu.twin
+"""
+
+from kueue_tpu.twin.engine import DurationModel, TwinEngine, replay
+from kueue_tpu.twin.trace import Trace, twin_cluster
+from kueue_tpu.twin.whatif import (CapacityConfig, apply_config,
+                                   default_sweep, parse_config, sweep)
+
+__all__ = [
+    "CapacityConfig", "DurationModel", "Trace", "TwinEngine",
+    "apply_config", "default_sweep", "parse_config", "replay",
+    "sweep", "twin_cluster",
+]

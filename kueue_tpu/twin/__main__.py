@@ -1,0 +1,187 @@
+"""CLI: `python -m kueue_tpu.twin` — replay, what-if sweep, cross-check.
+
+Replay a trace file (kueuetwin-trace/v1, a kueuefuzz/v1 scenario, or a
+kueuefuzz-repro/v1 reproducer) or synthesize one from a generator
+shape, on the real decision kernels at virtual time:
+
+  # one replay, metrics to stdout
+  python -m kueue_tpu.twin --shape diurnal_heavy --workloads 100000 \\
+      --days 3 --out /tmp/twin.json
+
+  # the capacity question: sweep 3 configs over one 10^6 trace
+  python -m kueue_tpu.twin --shape diurnal_heavy --workloads 1000000 \\
+      --days 3 --whatif baseline --whatif quota-75:quota=0.75 \\
+      --whatif quota-150:quota=1.5 --out /tmp/twin-report.json
+
+  # hold the twin to byte identity with lattice.drive()
+  python -m kueue_tpu.twin --crosscheck 6
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def _pin_cpu_backend() -> None:
+    # Same pin as kueue_tpu.fuzz.__main__: CPU + 2 virtual host
+    # devices before jax initializes, so sharded configs run anywhere.
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    xf = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in xf:
+        os.environ["XLA_FLAGS"] = (
+            xf + " --xla_force_host_platform_device_count=2").strip()
+
+
+def build_trace(args) -> "Trace":
+    from kueue_tpu.twin import generators, trace as trace_mod
+
+    if args.trace:
+        return trace_mod.Trace.load(args.trace)
+    gen = {"shape": args.shape, "workloads": args.workloads,
+           "days": args.days, "seed": args.seed, "cqs": args.cqs,
+           "mean_duration_s": args.mean_duration_s}
+    if args.cpu_quota is not None:
+        quota = {"cpu": args.cpu_quota,
+                 "memory_gi": 4 * args.cpu_quota}
+    else:
+        # Size the uniform cluster to carry the spec's offered load —
+        # the baseline should be feasible so the sweep measures the
+        # perturbations, not an arbitrary under-provisioning.
+        quota = generators.size_cluster_quota(gen, args.cqs)
+    cluster = trace_mod.twin_cluster(
+        num_cqs=args.cqs, num_cohorts=args.cohorts,
+        num_flavors=args.flavors, cpu_quota=quota["cpu"],
+        memory_gi_quota=quota["memory_gi"], hetero=args.hetero)
+    return trace_mod.Trace(
+        name=f"{args.shape}-{args.workloads}x{args.days}d",
+        seed=args.seed, cluster=cluster, generator=gen,
+        tick_interval_s=args.tick_interval_s,
+        meta={"sized_quota": quota})
+
+
+def main(argv=None) -> int:
+    _pin_cpu_backend()
+    ap = argparse.ArgumentParser(
+        prog="python -m kueue_tpu.twin",
+        description="digital twin: discrete-event capacity simulator "
+                    "on the real decision kernels")
+    src = ap.add_argument_group("trace source")
+    src.add_argument("--trace", metavar="FILE",
+                     help="replay this trace file (kueuetwin-trace/v1, "
+                          "kueuefuzz/v1, or kueuefuzz-repro/v1)")
+    src.add_argument("--shape", default="diurnal_heavy",
+                     help="generator shape (diurnal, heavy_tailed, "
+                          "diurnal_heavy, adversarial_burst, mix)")
+    src.add_argument("--workloads", type=int, default=100_000)
+    src.add_argument("--days", type=float, default=1.0)
+    src.add_argument("--seed", type=int, default=0)
+    src.add_argument("--cqs", type=int, default=64)
+    src.add_argument("--cohorts", type=int, default=16)
+    src.add_argument("--flavors", type=int, default=2)
+    src.add_argument("--hetero", action="store_true")
+    src.add_argument("--cpu-quota", type=int, default=None,
+                     help="per-CQ per-flavor cpu quota (default: "
+                          "sized from the generator's offered load)")
+    src.add_argument("--mean-duration-s", type=float, default=1800.0)
+    src.add_argument("--tick-interval-s", type=float, default=600.0)
+    run = ap.add_argument_group("modes")
+    run.add_argument("--whatif", action="append", metavar="SPEC",
+                     help="sweep configuration 'name[:k=v,...]' (keys: "
+                          "quota, flavor.<name>, speed.<name>, shards, "
+                          "engine); repeat for more configs; first is "
+                          "the baseline; bare '--whatif default' runs "
+                          "baseline/quota-75/quota-150")
+    run.add_argument("--crosscheck", type=int, metavar="N",
+                     help="byte-compare twin replay vs lattice.drive() "
+                          "on N generator seeds instead of replaying")
+    run.add_argument("--start-seed", type=int, default=0)
+    run.add_argument("--engine", default="jax",
+                     help="solver engine: jax | host | referee (the "
+                          "sequential reference — fastest for huge "
+                          "replays, decision-identical per the fuzz "
+                          "lattice); also the default for what-if "
+                          "configs that don't set engine=")
+    run.add_argument("--default-duration-s", type=float, default=900.0,
+                     help="DurationModel fallback for workloads with "
+                          "no declared duration_s")
+    ap.add_argument("--out", default=None, metavar="FILE",
+                    help="write the full JSON report here")
+    ap.add_argument("--save-trace", default=None, metavar="FILE",
+                    help="also save the (synthesized) trace file")
+    args = ap.parse_args(argv)
+
+    if args.crosscheck is not None:
+        from kueue_tpu.twin import crosscheck
+
+        report = crosscheck.crosscheck_seeds(
+            args.crosscheck, start_seed=args.start_seed)
+        if args.out:
+            with open(args.out, "w", encoding="utf-8") as f:
+                json.dump(report, f, indent=1)
+        print(json.dumps({
+            "metric": "twin_crosscheck",
+            "scenarios": report["scenarios"],
+            "engines": report["engines"],
+            "mismatched": report["mismatched"],
+            "ok": report["ok"]}), flush=True)
+        for res in report["results"]:
+            if not res["ok"]:
+                print(f"# seed {res['seed']}: BYTE MISMATCH "
+                      f"{json.dumps(res['points'])}", file=sys.stderr)
+        return 0 if report["ok"] else 1
+
+    trace = build_trace(args)
+    if args.save_trace:
+        trace.save(args.save_trace)
+        print(f"# trace saved: {args.save_trace}", file=sys.stderr)
+
+    if args.whatif:
+        from kueue_tpu.twin import whatif
+
+        if args.whatif == ["default"]:
+            configs = whatif.default_sweep()
+        else:
+            configs = [whatif.parse_config(s) for s in args.whatif]
+        report = whatif.sweep(
+            trace, configs, default_engine=args.engine,
+            default_duration_s=args.default_duration_s)
+        if args.out:
+            with open(args.out, "w", encoding="utf-8") as f:
+                json.dump(report, f, indent=1)
+        print(whatif.format_report(report), file=sys.stderr)
+        print(json.dumps({
+            "metric": "twin_whatif", "trace": report["trace"]["name"],
+            "baseline": report["baseline"],
+            "configs": [r["name"] for r in report["configs"]],
+            "goodput": {r["name"]:
+                        r["metrics"]["goodput_wl_per_vday"]
+                        for r in report["configs"]},
+            "wall_seconds": round(sum(
+                r["metrics"]["wall_seconds"]
+                for r in report["configs"]), 2),
+            "ok": report["ok"]}), flush=True)
+        return 0 if report["ok"] else 1
+
+    from kueue_tpu.twin.engine import TwinEngine
+
+    res = TwinEngine(trace, engine=args.engine,
+                     default_duration_s=args.default_duration_s).run()
+    if args.out:
+        from kueue_tpu.utils.envinfo import environment_block
+
+        doc = dict(res)
+        doc["environment"] = environment_block()
+        with open(args.out, "w", encoding="utf-8") as f:
+            json.dump(doc, f, indent=1)
+    print(json.dumps({
+        "metric": "twin_replay", "trace": res["trace"]["name"],
+        "engine": args.engine, "metrics": res["metrics"],
+        "ok": res["violation_count"] == 0}), flush=True)
+    return 0 if res["violation_count"] == 0 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,92 @@
+"""Cross-check mode: the twin must BE the scheduler, not a model of it.
+
+For lattice-sized scenarios, convert the fuzz scenario into a paced
+trace, replay it on the TwinEngine, and hold the result byte-identical
+to lattice.drive() at the same framework point: the JSON encodings of
+(decision trail, final admitted set, oracle violations) must match to
+the byte. Any drift means the twin's replay loop departed from the
+reference drive loop — a planning result from it would be fiction —
+so cross-check failures are release-gating, not advisory.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import List, Optional
+
+from kueue_tpu.fuzz import generator, lattice
+from kueue_tpu.fuzz.lattice import LatticePoint
+from kueue_tpu.twin.engine import TwinEngine
+from kueue_tpu.twin.trace import Trace
+
+
+def _doc_bytes(trail, final_admitted, violations) -> str:
+    return json.dumps(
+        {"trail": trail, "final_admitted": final_admitted,
+         "violations": violations},
+        sort_keys=True, default=list)
+
+
+def _first_divergence(ref_trail, twin_trail) -> Optional[dict]:
+    for t in range(max(len(ref_trail), len(twin_trail))):
+        r = ref_trail[t] if t < len(ref_trail) else None
+        w = twin_trail[t] if t < len(twin_trail) else None
+        if json.dumps(r, default=list) != json.dumps(w, default=list):
+            return {"tick": t, "reference": r, "twin": w}
+    return None
+
+
+def crosscheck_scenario(sc, engines=("host", "jax",
+                                     "referee")) -> dict:
+    """Replay one fuzz scenario both ways at each engine; returns
+    {"seed", "points": [...], "ok"} with per-point byte verdicts."""
+    trace = Trace.from_scenario(sc)
+    points = []
+    ok = True
+    for eng in engines:
+        if eng == "referee":
+            point = LatticePoint(name="crosscheck-referee",
+                                 kind="referee")
+        else:
+            point = LatticePoint(name=f"crosscheck-{eng}",
+                                 kind="framework", engine=eng)
+        ref = lattice.drive(sc, point)
+        twin = TwinEngine(trace, engine=eng, record_trail=True).run()
+        ref_b = _doc_bytes(ref["trail"], ref["final_admitted"],
+                           ref["violations"])
+        twin_b = _doc_bytes(twin["trail"], twin["final_admitted"],
+                            twin["violations"])
+        match = ref_b == twin_b
+        entry = {"engine": eng, "byte_identical": match,
+                 "ticks": len(ref["trail"])}
+        if not match:
+            ok = False
+            entry["divergence"] = _first_divergence(
+                ref["trail"], twin.get("trail") or [])
+            if (json.dumps(ref["final_admitted"], sort_keys=True)
+                    != json.dumps(twin["final_admitted"],
+                                  sort_keys=True)):
+                entry["final_admitted"] = {
+                    "reference": ref["final_admitted"],
+                    "twin": twin["final_admitted"]}
+        points.append(entry)
+    return {"seed": sc.seed, "shape": sc.policy.get("shape"),
+            "points": points, "ok": ok}
+
+
+def crosscheck_seeds(seeds: int, start_seed: int = 0,
+                     engines=("host", "jax", "referee")) -> dict:
+    """The campaign form: N generator-drawn scenarios, each replayed
+    twin-vs-drive at every engine. The what-if CI gate runs this on a
+    small budget; red means no capacity report can be trusted."""
+    results: List[dict] = []
+    bad = 0
+    for seed in range(start_seed, start_seed + seeds):
+        sc = generator.draw_scenario(seed)
+        res = crosscheck_scenario(sc, engines=engines)
+        if not res["ok"]:
+            bad += 1
+        results.append(res)
+    return {"scenarios": seeds, "start_seed": start_seed,
+            "engines": list(engines), "mismatched": bad,
+            "ok": bad == 0, "results": results}

@@ -1,0 +1,292 @@
+"""Seeded lazy trace generators: the capacity-planning arrival shapes.
+
+Each generator streams (vtime, kind, payload) events in virtual-time
+order WITHOUT materializing the trace — a 10^6-workload multi-day
+trace costs bucket-local memory (one hour of arrivals at a time). All
+draws run through per-(stream, bucket) child RNGs keyed on the trace
+seed, so the same generator spec always streams the identical event
+sequence (the twin determinism oracle) and a trace file can carry just
+the spec.
+
+Shapes (the Mesos multi-framework study's mixes, ROADMAP item 5b):
+
+  diurnal           sinusoidal day/night arrival rate, modest sizes
+  heavy_tailed      bounded-Pareto sizes AND per-hour burst weights
+  diurnal_heavy     diurnal rate x heavy-tailed sizes/durations — the
+                    production-shaped default for capacity planning
+  adversarial_burst low uniform baseline + spike events: each spike
+                    expands into a same-CQ high-priority burst at
+                    replay time (one trace entry, thousands of
+                    arrivals)
+  mix               three frameworks a la Mesos: batch (heavy, long,
+                    low priority), service (small, very long, high
+                    priority), interactive (tiny, short, diurnal)
+
+The generator spec is a plain dict (lives inside the trace JSON):
+  {"shape", "workloads", "days", "seed", "cqs",
+   "mean_duration_s", ...}
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Iterator, List, Optional
+
+from kueue_tpu.utils.synthetic import diurnal_rate, heavy_tailed_int
+
+BUCKET_S = 3600.0          # one virtual hour per arrival bucket
+
+
+def _bucket_counts(total: int, weights: List[float]) -> List[int]:
+    """Split `total` arrivals over buckets proportionally to `weights`
+    with cumulative rounding: deterministic, sums to exactly total."""
+    s = sum(weights) or 1.0
+    counts, acc, cum = [], 0, 0.0
+    for w in weights:
+        cum += w / s
+        c = int(round(total * cum))
+        counts.append(c - acc)
+        acc = c
+    return counts
+
+
+def _child(seed: int, salt: int, bucket: int) -> random.Random:
+    return random.Random(((seed + 1) * 1_000_003) ^ (salt * 7_919)
+                         ^ (bucket * 104_729))
+
+
+def _dur_exp(rb: random.Random, mean: float) -> float:
+    return min(max(rb.expovariate(1.0 / mean), 60.0), 12.0 * mean)
+
+
+def _dur_heavy(rb: random.Random, mean: float) -> float:
+    return float(heavy_tailed_int(
+        rb, max(int(mean / 6), 60), int(mean * 24)))
+
+
+def _base_spec(rb: random.Random, num_cqs: int, *, cpu: int,
+               count: int, memory_gi: int, priority: int,
+               duration_s: float, queue: Optional[str] = None) -> dict:
+    return {"queue": queue or f"lq-cq-{rb.randrange(num_cqs)}",
+            "priority": priority,
+            "pod_sets": [{"name": "ps0", "count": count, "cpu": cpu,
+                          "memory_gi": memory_gi, "topo": None}],
+            "tputs": None,
+            "duration_s": duration_s}
+
+
+def _spec_diurnal(rb: random.Random, gen: dict) -> dict:
+    cpu = rb.choice((1, 1, 2, 4))
+    return _base_spec(rb, gen["cqs"], cpu=cpu,
+                      count=rb.choice((1, 1, 2)), memory_gi=cpu,
+                      priority=rb.randrange(3),
+                      duration_s=_dur_exp(
+                          rb, gen.get("mean_duration_s", 1800.0)))
+
+
+def _spec_heavy(rb: random.Random, gen: dict) -> dict:
+    cpu = heavy_tailed_int(rb, 1, 16)
+    return _base_spec(rb, gen["cqs"], cpu=cpu,
+                      count=heavy_tailed_int(rb, 1, 4), memory_gi=cpu,
+                      priority=rb.randrange(3),
+                      duration_s=_dur_heavy(
+                          rb, gen.get("mean_duration_s", 1800.0)))
+
+
+def _spec_batch(rb: random.Random, gen: dict) -> dict:
+    cpu = heavy_tailed_int(rb, 2, 16)
+    return _base_spec(rb, gen["cqs"], cpu=cpu,
+                      count=heavy_tailed_int(rb, 1, 8), memory_gi=cpu,
+                      priority=0,
+                      duration_s=_dur_heavy(
+                          rb, 2.0 * gen.get("mean_duration_s",
+                                            1800.0)))
+
+
+def _spec_service(rb: random.Random, gen: dict) -> dict:
+    return _base_spec(rb, gen["cqs"], cpu=rb.choice((1, 2)),
+                      count=rb.choice((1, 2)), memory_gi=2,
+                      priority=2,
+                      duration_s=_dur_exp(
+                          rb, 6.0 * gen.get("mean_duration_s",
+                                            1800.0)))
+
+
+def _spec_interactive(rb: random.Random, gen: dict) -> dict:
+    return _base_spec(rb, gen["cqs"], cpu=1, count=1, memory_gi=1,
+                      priority=1,
+                      duration_s=_dur_exp(
+                          rb, 0.2 * gen.get("mean_duration_s",
+                                            1800.0)))
+
+
+def _diurnal_weights(n_buckets: int) -> List[float]:
+    # Hour-of-day sinusoid, never fully dark (lo) so the trough still
+    # trickles arrivals.
+    return [diurnal_rate(b, period=24, lo=0.2, hi=1.0)
+            for b in range(n_buckets)]
+
+
+def _heavy_weights(n_buckets: int, seed: int, salt: int) -> List[float]:
+    return [float(heavy_tailed_int(_child(seed, salt, b), 1, 40))
+            for b in range(n_buckets)]
+
+
+def _flat_weights(n_buckets: int) -> List[float]:
+    return [1.0] * n_buckets
+
+
+# shape -> list of (salt, weight_fn(n_buckets, seed), spec_fn, share)
+_STREAMS = {
+    "diurnal": [(1, lambda n, s: _diurnal_weights(n),
+                 _spec_diurnal, 1.0)],
+    "heavy_tailed": [(2, lambda n, s: _heavy_weights(n, s, 2),
+                      _spec_heavy, 1.0)],
+    "diurnal_heavy": [(3, lambda n, s: _diurnal_weights(n),
+                       _spec_heavy, 1.0)],
+    "mix": [(4, lambda n, s: _heavy_weights(n, s, 4), _spec_batch, 0.5),
+            (5, lambda n, s: _flat_weights(n), _spec_service, 0.2),
+            (6, lambda n, s: _diurnal_weights(n),
+             _spec_interactive, 0.3)],
+}
+
+SHAPES = tuple(_STREAMS) + ("adversarial_burst",)
+
+# adversarial_burst: this fraction of the workload count arrives as
+# spike events (same-CQ, high-priority bursts); the rest is a flat
+# baseline.
+_SPIKE_FRACTION = 0.4
+
+
+def _spike_events(gen: dict, t0: float, horizon: float,
+                  total: int) -> List[tuple]:
+    seed = int(gen.get("seed", 0))
+    n_spikes = max(1, int(gen.get("spikes",
+                                  4 * float(gen.get("days", 1.0)))))
+    rs = _child(seed, 9, 0)
+    per = _bucket_counts(total, [1.0 + rs.random()
+                                 for _ in range(n_spikes)])
+    out = []
+    for s, n in enumerate(per):
+        if n <= 0:
+            continue
+        v = t0 + rs.random() * horizon
+        cpu = rs.choice((1, 2, 4))
+        out.append((v, "spike", {
+            "n": n, "name_prefix": f"spike-{s}",
+            "queue": f"lq-cq-{rs.randrange(gen['cqs'])}",
+            "priority": 4, "cpu": cpu, "count": 1, "memory_gi": cpu,
+            "duration_s": _dur_exp(rs, gen.get("mean_duration_s",
+                                               1800.0))}))
+    out.sort(key=lambda e: e[0])
+    return out
+
+
+def iter_generator(gen: dict, t0: float) -> Iterator[tuple]:
+    """Stream the generator spec's events, sorted by vtime. Yields
+    (vtime, "submit", spec) and (vtime, "spike", payload) tuples;
+    submit specs carry no name/creation_time — the engine assigns the
+    global arrival index and stamps creation_time = vtime."""
+    shape = gen["shape"]
+    if shape not in SHAPES:
+        raise ValueError(f"unknown trace shape {shape!r} "
+                         f"(have {sorted(SHAPES)})")
+    seed = int(gen.get("seed", 0))
+    total = int(gen["workloads"])
+    days = float(gen.get("days", 1.0))
+    horizon = days * 86400.0
+    n_buckets = max(1, int(round(horizon / BUCKET_S)))
+    width = horizon / n_buckets
+
+    spikes: List[tuple] = []
+    if shape == "adversarial_burst":
+        spike_total = int(total * _SPIKE_FRACTION)
+        spikes = _spike_events(gen, t0, horizon, spike_total)
+        streams = [(8, lambda n, s: _flat_weights(n), _spec_diurnal,
+                    1.0)]
+        total -= spike_total
+    else:
+        streams = _STREAMS[shape]
+
+    shares = [max(sh, 0.0) for _salt, _w, _f, sh in streams]
+    totals = _bucket_counts(total, shares)
+    counts = [_bucket_counts(totals[k], w_fn(n_buckets, seed))
+              for k, (_salt, w_fn, _f, _sh) in enumerate(streams)]
+
+    spike_i = 0
+    for b in range(n_buckets):
+        start = t0 + b * width
+        bucket: List[tuple] = []
+        for k, (salt, _w_fn, spec_fn, _sh) in enumerate(streams):
+            c = counts[k][b]
+            if not c:
+                continue
+            rb = _child(seed, salt, b)
+            for j in range(c):
+                v = start + (j + rb.random()) * width / c
+                bucket.append((v, "submit", spec_fn(rb, gen)))
+        while spike_i < len(spikes) \
+                and spikes[spike_i][0] < start + width:
+            bucket.append(spikes[spike_i])
+            spike_i += 1
+        bucket.sort(key=lambda e: e[0])
+        for ev in bucket:
+            yield ev
+    # Spikes drawn exactly at the horizon edge.
+    while spike_i < len(spikes):
+        yield spikes[spike_i]
+        spike_i += 1
+
+
+def iter_trace_events(trace) -> Iterator[tuple]:
+    """The engine's event source: explicit events verbatim (assumed
+    recorded in vtime order), else the lazy generator stream."""
+    if trace.events is not None:
+        for e in trace.events:
+            kind, vtime = e[0], float(e[1])
+            yield (vtime, kind, e[2] if len(e) > 2 else None)
+    elif trace.generator:
+        for ev in iter_generator(trace.generator, trace.t0):
+            yield ev
+
+
+def estimate_demand(gen: dict, samples: int = 512) -> dict:
+    """Mean per-arrival resource-time demand, estimated by sampling the
+    spec's own draw functions — what the CLI sizes cluster quotas from
+    (offered load = rate x mean cpu-seconds per arrival)."""
+    probe = dict(gen)
+    probe["workloads"] = samples
+    probe.setdefault("days", 1.0)
+    cpu_s = mem_s = 0.0
+    n = 0
+    for _v, kind, payload in iter_generator(probe, 0.0):
+        if kind == "submit":
+            ps = payload["pod_sets"][0]
+            cpu_s += ps["cpu"] * ps["count"] * payload["duration_s"]
+            mem_s += (ps["memory_gi"] * ps["count"]
+                      * payload["duration_s"])
+            n += 1
+        elif kind == "spike":
+            cpu_s += (payload["cpu"] * payload["count"]
+                      * payload["duration_s"] * payload["n"])
+            mem_s += (payload["memory_gi"] * payload["count"]
+                      * payload["duration_s"] * payload["n"])
+            n += payload["n"]
+    n = max(n, 1)
+    return {"cpu_core_s": cpu_s / n, "memory_gi_s": mem_s / n,
+            "sampled": n}
+
+
+def size_cluster_quota(gen: dict, num_cqs: int,
+                       utilization: float = 0.6,
+                       peak_factor: float = 2.0) -> dict:
+    """Per-CQ quota that carries the spec's offered load: mean demand
+    rate scaled by the diurnal peak and a utilization headroom. Returns
+    {"cpu", "memory_gi"} per ClusterQueue."""
+    d = estimate_demand(gen)
+    rate = float(gen["workloads"]) / (float(gen.get("days", 1.0))
+                                      * 86400.0)
+    need_cpu = rate * d["cpu_core_s"] * peak_factor / utilization
+    need_mem = rate * d["memory_gi_s"] * peak_factor / utilization
+    return {"cpu": max(2, int(round(need_cpu / num_cqs)) + 1),
+            "memory_gi": max(2, int(round(need_mem / num_cqs)) + 1)}

@@ -1,0 +1,187 @@
+"""Trace model: the digital twin's unit of replay.
+
+A Trace is a cluster description (the fuzz scenario language's cluster
+fields: flavors, cohort tree, ClusterQueues, policy gates) plus a
+virtual-time event stream. Events come in two interchangeable forms:
+
+  explicit     trace.events = [[kind, vtime, payload...], ...] — small
+               traces, recorded fuzz scenarios, future production
+               journals; kinds: "submit" (a workload spec, with an
+               optional "duration_s"), "op" (any fuzz traffic op —
+               finish/delete/update_cq/ready selectors), "tick" (a
+               barrier tick at vtime; its presence makes the trace
+               PACED — see engine.py), "spike" (a burst expanded into
+               n submits at pop time, so a 50k-workload burst costs
+               one trace entry).
+  generator    trace.generator = {"shape", "workloads", "days", ...} —
+               a lazy, seeded arrival process (see generators.py) that
+               streams ~10^6 events without materializing them; the
+               multi-day capacity-planning traces.
+
+The JSON format (kueuetwin-trace/v1) also LOADS the fuzz subsystem's
+files directly: a kueuefuzz/v1 scenario or a kueuefuzz-repro/v1
+reproducer converts through from_scenario() into a paced trace whose
+replay byte-matches the lattice drive (the cross-check oracle).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import List, Optional
+
+from kueue_tpu.fuzz.scenario import Scenario
+
+FORMAT = "kueuetwin-trace/v1"
+
+# The virtual epoch: the lattice's TickClock starts here; paced traces
+# must replay on the same clock values or condition timestamps (which
+# feed candidate ordering) would fake a divergence.
+T0 = 1_000_000.0
+
+CLUSTER_FIELDS = ("flavors", "topology", "cohorts", "cluster_queues",
+                  "policy")
+
+
+@dataclasses.dataclass
+class Trace:
+    name: str
+    seed: int
+    cluster: dict                      # the scenario-language cluster
+    events: Optional[List[list]] = None
+    generator: Optional[dict] = None   # lazy spec (generators.py)
+    paced: bool = False                # explicit tick events present
+    tick_interval_s: float = 600.0     # event-driven tick cadence
+    t0: float = T0
+    meta: dict = dataclasses.field(default_factory=dict)
+
+    # -- (de)serialization --------------------------------------------------
+
+    def to_dict(self) -> dict:
+        return {"format": FORMAT, "name": self.name, "seed": self.seed,
+                "cluster": self.cluster, "events": self.events,
+                "generator": self.generator, "paced": self.paced,
+                "tick_interval_s": self.tick_interval_s,
+                "t0": self.t0, "meta": self.meta}
+
+    @staticmethod
+    def from_dict(d: dict) -> "Trace":
+        fmt = str(d.get("format", FORMAT))
+        if fmt.startswith("kueuefuzz-repro/"):
+            return Trace.from_scenario(
+                Scenario.from_dict(d["scenario"]),
+                name=str(d.get("name") or "fuzz-repro"))
+        if fmt.startswith("kueuefuzz/"):
+            return Trace.from_scenario(Scenario.from_dict(d))
+        if not fmt.startswith("kueuetwin-trace/"):
+            raise ValueError(f"not a twin trace (format={fmt!r})")
+        return Trace(
+            name=str(d.get("name") or "trace"),
+            seed=int(d.get("seed", 0)),
+            cluster=dict(d["cluster"]),
+            events=[list(e) for e in d["events"]]
+            if d.get("events") is not None else None,
+            generator=(dict(d["generator"])
+                       if d.get("generator") else None),
+            paced=bool(d.get("paced")),
+            tick_interval_s=float(d.get("tick_interval_s", 600.0)),
+            t0=float(d.get("t0", T0)),
+            meta=dict(d.get("meta") or {}))
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_dict(), indent=1, sort_keys=True)
+
+    @staticmethod
+    def load(path: str) -> "Trace":
+        """Load any of the three accepted formats: kueuetwin-trace/v1,
+        a kueuefuzz/v1 scenario, or a kueuefuzz-repro/v1 reproducer."""
+        with open(path, "r", encoding="utf-8") as f:
+            return Trace.from_dict(json.load(f))
+
+    def save(self, path: str) -> None:
+        with open(path, "w", encoding="utf-8") as f:
+            f.write(self.to_json())
+            f.write("\n")
+
+    # -- scenario bridge ----------------------------------------------------
+
+    @staticmethod
+    def from_scenario(sc: Scenario, name: Optional[str] = None) -> "Trace":
+        """Convert a fuzz scenario into a PACED trace: initial
+        workloads at t0, then per tick t the tick's ops at t0+t
+        followed by an explicit tick event at t0+t — exactly the clock
+        sequence of lattice._drive_framework (ops apply at the frozen
+        clock, the tick runs, the clock advances by 1s). Replaying the
+        result must byte-match drive() at the same lattice point; the
+        cross-check mode (crosscheck.py) holds the twin to that."""
+        events: List[list] = []
+        for spec in sc.workloads:
+            events.append(["submit", T0, dict(spec)])
+        for t in range(sc.ticks + sc.settle_ticks):
+            v = T0 + t
+            if t < sc.ticks:
+                for op in (sc.traffic[t]
+                           if t < len(sc.traffic) else ()):
+                    events.append(["op", v, list(op)])
+            events.append(["tick", v])
+        return Trace(
+            name=name or f"fuzz-seed-{sc.seed}",
+            seed=sc.seed,
+            cluster=cluster_from_scenario(sc),
+            events=events, paced=True, tick_interval_s=1.0,
+            meta={"source": "kueuefuzz", "ticks": sc.ticks,
+                  "settle_ticks": sc.settle_ticks})
+
+    def cluster_scenario(self) -> Scenario:
+        """The trace's cluster as an (empty-traffic) Scenario — what
+        the engine hands to the fuzz subsystem's builders (flavor /
+        cohort / CQ objects, nominal-capacity oracle)."""
+        c = self.cluster
+        return Scenario(
+            seed=self.seed, ticks=0, settle_ticks=0,
+            flavors=list(c["flavors"]), topology=c.get("topology"),
+            cohorts=list(c.get("cohorts") or ()),
+            cluster_queues=list(c["cluster_queues"]),
+            policy=dict(c.get("policy") or {}),
+            workloads=[], traffic=[])
+
+
+def cluster_from_scenario(sc: Scenario) -> dict:
+    return {"flavors": list(sc.flavors), "topology": sc.topology,
+            "cohorts": list(sc.cohorts),
+            "cluster_queues": list(sc.cluster_queues),
+            "policy": dict(sc.policy)}
+
+
+def twin_cluster(num_cqs: int = 64, num_cohorts: int = 16,
+                 num_flavors: int = 2, cpu_quota: int = 64,
+                 memory_gi_quota: int = 256, hetero: bool = False,
+                 strategy: str = "BestEffortFIFO",
+                 preemption: Optional[dict] = None) -> dict:
+    """A uniform capacity-planning cluster in the scenario language:
+    num_cqs ClusterQueues round-robined over flat cohorts, each with
+    the same per-flavor quota. The what-if harness then perturbs THIS
+    dict (quota resize, flavor-ladder change) per configuration."""
+    flavors = [{"name": f"flavor-{f}",
+                "speed_class": (1.0 + 0.5 * f) if hetero else 1.0}
+               for f in range(num_flavors)]
+    cqs = []
+    for i in range(num_cqs):
+        quotas = {fl["name"]: {"cpu": [cpu_quota, None, None],
+                               "memory_gi": [memory_gi_quota,
+                                             None, None]}
+                  for fl in flavors}
+        cqs.append({
+            "name": f"cq-{i}",
+            "cohort": (f"cohort-{i % num_cohorts}"
+                       if num_cohorts else ""),
+            "strategy": strategy,
+            "preemption": dict(preemption) if preemption
+            else {"within": "Never", "reclaim": "Never"},
+            "fair_weight": None,
+            "quotas": quotas})
+    return {"flavors": flavors, "topology": None, "cohorts": [],
+            "cluster_queues": cqs,
+            "policy": {"fair": False, "lending": False,
+                       "hetero": hetero, "pods_ready": False,
+                       "shape": "twin"}}

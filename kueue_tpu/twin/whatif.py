@@ -1,0 +1,195 @@
+"""What-if harness: one trace, a sweep of capacity configurations.
+
+A CapacityConfig perturbs the trace's cluster in the scenario
+language — global quota resize, per-flavor quota resize (the
+flavor-ladder question: what if we shift capacity from flavor-0 to
+flavor-1?), speed-class changes on the hetero ladder, solver shards —
+then the SAME virtual-time replay runs once per configuration and the
+report compares the outcomes: goodput (completions per virtual day),
+p50/p99 virtual submit->admitted wait, preemption count, quota
+high-water ratio, and the fuzzer's quota-oracle verdict. Deltas are
+against the first (baseline) configuration.
+
+Config spec strings (the CLI surface):
+
+    baseline
+    quota-150:quota=1.5
+    ladder:flavor.flavor-0=0.5,flavor.flavor-1=2.0
+    fast-1:speed.flavor-1=2.0,shards=2,engine=jax
+
+i.e. `name[:k=v,...]` with keys quota, flavor.<name>, speed.<name>,
+shards, engine.
+"""
+
+from __future__ import annotations
+
+import copy
+import dataclasses
+from typing import Dict, List, Optional
+
+from kueue_tpu.twin.engine import TwinEngine
+from kueue_tpu.twin.trace import Trace
+from kueue_tpu.utils.envinfo import environment_block
+
+REPORT_FORMAT = "kueuetwin-report/v1"
+
+
+@dataclasses.dataclass
+class CapacityConfig:
+    name: str
+    quota_factor: float = 1.0
+    flavor_factors: Dict[str, float] = dataclasses.field(
+        default_factory=dict)
+    speed_factors: Dict[str, float] = dataclasses.field(
+        default_factory=dict)
+    shards: int = 1
+    engine: Optional[str] = None   # None = the sweep's default_engine
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def parse_config(spec: str) -> CapacityConfig:
+    name, _, rest = spec.partition(":")
+    cfg = CapacityConfig(name=name or "config")
+    if not rest:
+        return cfg
+    for item in rest.split(","):
+        k, _, v = item.partition("=")
+        k = k.strip()
+        if not _ or not k:
+            raise ValueError(f"what-if config wants k=v items "
+                             f"(got {item!r} in {spec!r})")
+        if k == "quota":
+            cfg.quota_factor = float(v)
+        elif k.startswith("flavor."):
+            cfg.flavor_factors[k[len("flavor."):]] = float(v)
+        elif k.startswith("speed."):
+            cfg.speed_factors[k[len("speed."):]] = float(v)
+        elif k == "shards":
+            cfg.shards = int(v)
+        elif k == "engine":
+            cfg.engine = v.strip()
+        else:
+            raise ValueError(f"unknown what-if key {k!r} in {spec!r} "
+                             f"(have quota, flavor.<name>, "
+                             f"speed.<name>, shards, engine)")
+    return cfg
+
+
+def default_sweep() -> List[CapacityConfig]:
+    """The stock capacity question: would 75% of today's quota still
+    carry the trace, and what does 150% buy?"""
+    return [CapacityConfig(name="baseline"),
+            CapacityConfig(name="quota-75", quota_factor=0.75),
+            CapacityConfig(name="quota-150", quota_factor=1.5)]
+
+
+def _scale(val, f: float):
+    # Quota tuples are [nominal, borrowing_limit, lending_limit] with
+    # None = unlimited; unlimited stays unlimited under any resize.
+    if val is None:
+        return None
+    return max(1, int(round(val * f)))
+
+
+def apply_config(cluster: dict, cfg: CapacityConfig) -> dict:
+    """The perturbed cluster: per-CQ per-flavor quota triples scaled by
+    quota_factor x flavor_factors[flavor], flavor speed_classes scaled
+    by speed_factors. Pure function — the input dict is not touched."""
+    out = copy.deepcopy(cluster)
+    for fl in out["flavors"]:
+        sf = cfg.speed_factors.get(fl["name"])
+        if sf is not None and fl.get("speed_class") is not None:
+            fl["speed_class"] = round(fl["speed_class"] * sf, 4)
+    for cq in out["cluster_queues"]:
+        for fname, quotas in cq["quotas"].items():
+            f = cfg.quota_factor * cfg.flavor_factors.get(fname, 1.0)
+            if f == 1.0:
+                continue
+            for rname, triple in quotas.items():
+                quotas[rname] = [_scale(v, f) for v in triple]
+    return out
+
+
+_DELTA_KEYS = ("goodput_wl_per_vday", "wait_p50_s", "wait_p99_s",
+               "preemptions", "completed", "quota_high_water_max")
+
+
+def _delta(base: dict, m: dict) -> dict:
+    out = {}
+    for k in _DELTA_KEYS:
+        b, v = base.get(k), m.get(k)
+        if b is None or v is None:
+            out[k] = None
+        else:
+            out[k] = round(v - b, 4)
+            if b:
+                out[k + "_pct"] = round(100.0 * (v - b) / b, 2)
+    return out
+
+
+def sweep(trace: Trace, configs: Optional[List[CapacityConfig]] = None,
+          default_engine: str = "jax", **engine_kwargs) -> dict:
+    """Replay `trace` once per configuration; returns the comparison
+    report (kueuetwin-report/v1). The first config is the baseline."""
+    configs = configs or default_sweep()
+    rows = []
+    for cfg in configs:
+        t = Trace(name=trace.name, seed=trace.seed,
+                  cluster=apply_config(trace.cluster, cfg),
+                  events=trace.events, generator=trace.generator,
+                  paced=trace.paced,
+                  tick_interval_s=trace.tick_interval_s,
+                  t0=trace.t0, meta=trace.meta)
+        engine = cfg.engine or default_engine
+        res = TwinEngine(t, engine=engine, shards=cfg.shards,
+                         record_trail=False, **engine_kwargs).run()
+        cfg_doc = cfg.to_dict()
+        cfg_doc["engine"] = engine
+        rows.append({"name": cfg.name, "config": cfg_doc,
+                     "metrics": res["metrics"],
+                     "high_water": res["high_water"],
+                     "quota_violations": res["violation_count"],
+                     "violations_sample": res["violations"][:8]})
+    base = rows[0]["metrics"]
+    for row in rows[1:]:
+        row["delta_vs_baseline"] = _delta(base, row["metrics"])
+    return {
+        "format": REPORT_FORMAT,
+        "trace": {"name": trace.name, "seed": trace.seed,
+                  "generator": trace.generator,
+                  "paced": trace.paced,
+                  "tick_interval_s": trace.tick_interval_s,
+                  "events": (len(trace.events)
+                             if trace.events is not None else None)},
+        "baseline": rows[0]["name"],
+        "configs": rows,
+        # Same machine block as every BENCH json (cpu count, load,
+        # python/jax versions) — cross-run comparisons stay
+        # machine-checkable.
+        "environment": environment_block(),
+        "ok": all(r["quota_violations"] == 0 for r in rows),
+    }
+
+
+def format_report(report: dict) -> str:
+    """The human view: one aligned row per configuration."""
+    cols = ("config", "goodput/vday", "p50 wait", "p99 wait",
+            "preempt", "hiwater", "quota-red")
+    lines = [" | ".join(f"{c:>13}" for c in cols)]
+    lines.append("-+-".join("-" * 13 for _ in cols))
+    for row in report["configs"]:
+        m = row["metrics"]
+
+        def fmt(v):
+            return "-" if v is None else (f"{v:.1f}"
+                                          if isinstance(v, float)
+                                          else str(v))
+
+        lines.append(" | ".join(f"{fmt(v):>13}" for v in (
+            row["name"], m.get("goodput_wl_per_vday"),
+            m.get("wait_p50_s"), m.get("wait_p99_s"),
+            m.get("preemptions"), m.get("quota_high_water_max"),
+            row["quota_violations"])))
+    return "\n".join(lines)

@@ -214,3 +214,82 @@ def test_crash_is_a_finding_not_an_abort():
         bad, points=lattice.default_lattice(bad)[:2])
     assert report["violations"]
     assert all(v["oracle"] == "crash" for v in report["violations"])
+
+
+def test_parse_shard_validates_and_partitions():
+    """--shard I/N: strict parse, and the N slices of a seed range
+    partition it exactly — no seed dropped, none doubled (the nightly
+    split's correctness condition)."""
+    from kueue_tpu.fuzz.__main__ import parse_shard, shard_range
+
+    assert parse_shard("0/4") == (0, 4)
+    assert parse_shard("3/4") == (3, 4)
+    for bad in ("4/4", "-1/4", "1", "a/b", "1/0"):
+        with pytest.raises(ValueError):
+            parse_shard(bad)
+    for start, seeds, n in ((0, 10, 4), (100, 7, 3), (5, 1000, 4),
+                            (0, 3, 8)):
+        covered = []
+        for i in range(n):
+            lo, hi = shard_range(start, seeds, (i, n))
+            covered.extend(range(lo, hi))
+        assert covered == list(range(start, start + seeds))
+    assert shard_range(7, 10, None) == (7, 17)
+
+
+def test_scenario_dimensions_are_stable_labels():
+    """The coverage vocabulary: every drawn scenario labels itself
+    with shape/structure/preemption dimensions, deterministically."""
+    for seed in range(12):
+        sc = generator.draw_scenario(seed)
+        dims = generator.scenario_dimensions(sc)
+        assert dims == generator.scenario_dimensions(sc)
+        assert any(d.startswith("shape=") for d in dims)
+        assert any(d.startswith("structure=") for d in dims)
+        assert any(d.startswith("preemption=") for d in dims)
+    all_dims = {d for s in range(12)
+                for d in generator.scenario_dimensions(
+                    generator.draw_scenario(s))}
+    assert len(all_dims) > 4   # the space is not one label
+
+
+def test_check_scenario_reports_event_rollup():
+    """Per-oracle coverage raw material: every campaign report carries
+    the reference drive's admitted/preempted counts plus micro/
+    revocation evidence sums."""
+    sc = generator.draw_scenario(1)
+    report = lattice.check_scenario(
+        sc, points=lattice.default_lattice(sc)[:3])
+    ev = report["events"]
+    assert set(ev) >= {"admitted", "preempted", "micro_admitted",
+                       "revocations"}
+    assert ev["admitted"] >= 0
+    assert all(isinstance(v, int) for v in ev.values())
+
+
+def test_campaign_emits_shard_and_oracle_coverage(tmp_path):
+    """End-to-end campaign contract: a sharded run writes the shard
+    block, per-family oracle coverage with a `never` list, and stays
+    inside its seed slice."""
+    from kueue_tpu.fuzz.__main__ import run_campaign
+
+    out = str(tmp_path / "campaign.json")
+    rc = run_campaign(2, 0, out, shrink_on_failure=False,
+                      shard=(1, 2))
+    assert rc == 0
+    doc = json.loads(open(out).read())
+    assert doc["scenarios"] == 1
+    assert doc["start_seed"] == 1
+    assert doc["shard"] == {"index": 1, "of": 2,
+                            "seed_lo": 1, "seed_hi": 1}
+    assert doc["requested"] == {"seeds": 2, "start_seed": 0}
+    cov = doc["oracle_coverage"]
+    assert set(cov) == {"preemption", "revocation",
+                        "micro_admission"}
+    for family in cov.values():
+        assert set(family) == {"events_by_dimension", "never"}
+        assert sorted(family["events_by_dimension"]) \
+            == sorted(generator.scenario_dimensions(
+                generator.draw_scenario(1)))
+        for dim in family["never"]:
+            assert family["events_by_dimension"][dim] == 0

@@ -85,3 +85,57 @@ def test_soak_long_run_has_no_monotonic_drift():
     assert report["verdict"], "soak too short to produce a verdict"
     bad = {k: v for k, v in report["verdict"].items() if not v["ok"]}
     assert report["ok"], f"monotonic drift detected: {bad}"
+
+
+def test_oracle_spot_check_files_shrunk_reproducer(tmp_path):
+    """The soak divergence lane: a red spot-check shrinks and lands as
+    a campaign-style reproducer file (injected check/shrinker — a real
+    shrink loop is not tier-1 budget)."""
+    import json
+
+    violations = [{"oracle": "identity", "detail": "injected"}]
+
+    def check(sc, points=None):
+        return {"violations": violations}
+
+    def shrinker(sc, still_fails):
+        assert still_fails(sc)   # the predicate re-runs the check
+        return sc, 5
+
+    findings = soak._oracle_spot_check(
+        123, str(tmp_path), check=check, shrinker=shrinker)
+    assert len(findings) == 1
+    f = findings[0]
+    assert f["kind"] == "oracle" and f["seed"] == 123
+    doc = json.load(open(f["reproducer"]))
+    assert doc["format"].startswith("kueuefuzz-repro/")
+    assert doc["found"]["lane"] == "soak-oracle"
+    assert doc["found"]["shrink_attempts"] == 5
+    assert doc["found"]["violations"] == violations
+
+
+def test_oracle_spot_check_green_files_nothing(tmp_path):
+    findings = soak._oracle_spot_check(
+        7, str(tmp_path), check=lambda sc, points=None:
+        {"violations": []})
+    assert findings == []
+    assert list(tmp_path.iterdir()) == []
+
+
+def test_drift_failure_files_self_contained_repro(tmp_path):
+    """A failed drift verdict writes the soak-repro doc: the exact
+    run_soak params plus the red verdict — re-runnable evidence, not a
+    log line."""
+    import json
+
+    verdict = {"rss_mb": {"ok": False, "first": 500.0, "last": 900.0},
+               "backlog": {"ok": True}}
+    params = {"duration_s": 60.0, "seed": 3, "num_cqs": 8}
+    finding = soak._file_drift_repro(
+        str(tmp_path), params, [{"tick": 25}], verdict)
+    assert finding["kind"] == "drift"
+    assert finding["failed"] == ["rss_mb"]
+    doc = json.load(open(finding["reproducer"]))
+    assert doc["format"] == soak.SOAK_REPRO_FORMAT
+    assert doc["params"] == params
+    assert doc["verdict"]["rss_mb"]["ok"] is False

@@ -1,0 +1,347 @@
+"""Digital-twin tests: trace model, generators, engine determinism,
+what-if harness, and the twin-vs-drive() byte-identity cross-check.
+The 10^6-scale replay runs via `make twin-smoke` at a smaller budget;
+here the contracts are pinned at test scale."""
+
+import copy
+import json
+
+import pytest
+
+from kueue_tpu.fuzz import generator as fuzz_gen, lattice
+from kueue_tpu.fuzz.lattice import LatticePoint
+from kueue_tpu.twin import (CapacityConfig, DurationModel, Trace,
+                            TwinEngine, apply_config, default_sweep,
+                            parse_config, replay, twin_cluster)
+from kueue_tpu.twin import crosscheck, generators, whatif
+
+
+def small_gen(shape="diurnal_heavy", workloads=400, days=0.25,
+              seed=11, cqs=8, **kw):
+    gen = {"shape": shape, "workloads": workloads, "days": days,
+           "seed": seed, "cqs": cqs, "mean_duration_s": 900.0}
+    gen.update(kw)
+    return gen
+
+
+def small_trace(**kw):
+    gen = small_gen(**kw)
+    quota = generators.size_cluster_quota(gen, gen["cqs"])
+    cluster = twin_cluster(num_cqs=gen["cqs"], num_cohorts=4,
+                           cpu_quota=quota["cpu"],
+                           memory_gi_quota=quota["memory_gi"])
+    return Trace(name="t", seed=gen["seed"], cluster=cluster,
+                 generator=gen, tick_interval_s=600.0)
+
+
+# -- trace model ------------------------------------------------------------
+
+
+def test_trace_json_roundtrip():
+    tr = small_trace()
+    again = Trace.from_dict(json.loads(tr.to_json()))
+    assert again.to_dict() == tr.to_dict()
+    with pytest.raises(ValueError):
+        Trace.from_dict({"format": "not-a-trace"})
+
+
+def test_trace_loads_fuzz_scenario_and_reproducer_formats():
+    """The format bridge: a kueuefuzz/v1 scenario dict and a
+    kueuefuzz-repro/v1 reproducer both load as PACED traces."""
+    sc = fuzz_gen.draw_scenario(5)
+    tr = Trace.from_dict(sc.to_dict())
+    assert tr.paced
+    assert tr.cluster["cluster_queues"] == sc.cluster_queues
+    assert sum(1 for e in tr.events if e[0] == "tick") \
+        == sc.ticks + sc.settle_ticks
+    repro = {"format": "kueuefuzz-repro/v1", "name": "r",
+             "scenario": sc.to_dict()}
+    tr2 = Trace.from_dict(repro)
+    assert tr2.paced and tr2.events == tr.events
+
+
+def test_twin_cluster_is_scenario_language():
+    cluster = twin_cluster(num_cqs=4, num_cohorts=2, cpu_quota=8)
+    tr = Trace(name="c", seed=0, cluster=cluster, events=[])
+    sc = tr.cluster_scenario()
+    assert len(sc.cluster_queues) == 4
+    # The LocalQueue naming contract the generators rely on:
+    # lq_object(cq) names the queue "lq-<cq-name>".
+    from kueue_tpu.fuzz.scenario import lq_object, nominal_capacity
+    assert lq_object(sc.cluster_queues[0]).name == "lq-cq-0"
+    caps = nominal_capacity(sc, {})
+    assert caps  # the quota oracle can price the twin cluster
+
+
+# -- generators -------------------------------------------------------------
+
+
+def test_generator_streams_are_deterministic_and_sized():
+    gen = small_gen(workloads=300)
+    a = list(generators.iter_generator(gen, 0.0))
+    b = list(generators.iter_generator(gen, 0.0))
+    assert a == b
+    n = sum(1 for _v, k, _p in a if k == "submit")
+    assert n == 300
+    times = [v for v, _k, _p in a]
+    assert times == sorted(times)
+    assert all(0.0 <= v <= gen["days"] * 86400.0 for v in times)
+    c = list(generators.iter_generator(dict(gen, seed=12), 0.0))
+    assert c != a
+
+
+@pytest.mark.parametrize("shape", generators.SHAPES)
+def test_every_shape_streams_valid_specs(shape):
+    gen = small_gen(shape=shape, workloads=120)
+    subs = spikes = 0
+    for _v, kind, payload in generators.iter_generator(gen, 0.0):
+        if kind == "submit":
+            subs += 1
+            assert payload["queue"].startswith("lq-cq-")
+            assert payload["pod_sets"][0]["cpu"] >= 1
+            assert payload["duration_s"] >= 60.0
+        else:
+            assert kind == "spike"
+            spikes += payload["n"]
+    assert subs + spikes == 120
+    if shape == "adversarial_burst":
+        assert spikes > 0
+
+
+def test_size_cluster_quota_carries_offered_load():
+    gen = small_gen(workloads=2000, days=0.5)
+    q = generators.size_cluster_quota(gen, 8)
+    assert q["cpu"] >= 2 and q["memory_gi"] >= 2
+    # Double the load, the sizing grows.
+    q2 = generators.size_cluster_quota(
+        dict(gen, workloads=4000), 8)
+    assert q2["cpu"] > q["cpu"]
+
+
+# -- engine -----------------------------------------------------------------
+
+
+def test_twin_determinism_same_trace_identical_timeline():
+    """The twin determinism oracle: same trace + seed => identical
+    timeline, metrics (minus wall-clock), and final admitted set."""
+    tr = small_trace()
+
+    def strip(res):
+        m = {k: v for k, v in res["metrics"].items()
+             if not k.startswith("wall") and k != "workloads_per_wall_s"}
+        return (res["timeline"], m, res["final_admitted"],
+                res["high_water"], res["violation_count"])
+
+    a = replay(tr, engine="referee")
+    b = replay(tr, engine="referee")
+    assert strip(a) == strip(b)
+
+
+def test_twin_replays_to_completion_with_physical_waits():
+    tr = small_trace(workloads=300)
+    res = replay(tr, engine="referee")
+    m = res["metrics"]
+    assert m["workloads_submitted"] == 300
+    # Heavy-tailed draws include giants beyond the cohort root's total
+    # capacity: those legally strand (NoFit forever) and the twin
+    # reports them instead of hanging. Everything feasible completes.
+    assert m["completed"] + m["stranded_pending"] == 300
+    assert m["completed"] >= 270
+    assert m["quota_violations"] == 0
+    # Submit->admit waits are bounded by the discretization: an
+    # uncongested trace admits within ~a tick interval.
+    assert m["wait_p50_s"] is not None
+    assert 0.0 <= m["wait_p50_s"] <= 2 * tr.tick_interval_s
+    # Timeline rows are [vtime, admitted, preempted, completed,
+    # pending, live] and conserve the workload count.
+    assert sum(r[1] for r in res["timeline"]) >= m["completed"]
+    assert sum(r[3] for r in res["timeline"]) == m["completed"]
+
+
+def test_twin_engines_agree_on_the_same_trace():
+    """referee / host / jax replays of one trace reach the same
+    timeline — the fuzz identity promise, restated at the twin's
+    level."""
+    tr = small_trace(workloads=250)
+    rows = [replay(tr, engine=e)["timeline"]
+            for e in ("referee", "host", "jax")]
+    assert rows[0] == rows[1] == rows[2]
+
+
+def test_adversarial_burst_spikes_preempt_or_queue():
+    """Spike expansion: one spike event becomes n submits; with
+    preemption enabled the high-priority burst evicts baseline load."""
+    gen = small_gen(shape="adversarial_burst", workloads=300,
+                    spikes=2)
+    quota = generators.size_cluster_quota(gen, gen["cqs"])
+    cluster = twin_cluster(
+        num_cqs=gen["cqs"], num_cohorts=4,
+        cpu_quota=max(2, quota["cpu"] // 2),
+        memory_gi_quota=max(2, quota["memory_gi"] // 2),
+        preemption={"within": "LowerPriority", "reclaim": "Any"})
+    tr = Trace(name="burst", seed=gen["seed"], cluster=cluster,
+               generator=gen)
+    res = replay(tr, engine="referee")
+    assert res["metrics"]["spikes"] == 2
+    assert res["metrics"]["workloads_submitted"] == 300
+    assert res["metrics"]["quota_violations"] == 0
+
+
+def test_fast_workload_equals_scenario_workload_object():
+    # The trusted bulk-ingest constructor must build the SAME object
+    # the full scenario path builds — dataclass equality over every
+    # field — and must refuse anything it can't replicate exactly.
+    from kueue_tpu.fuzz import scenario as sc_mod
+    from kueue_tpu.twin.engine import TwinEngine
+
+    specs = [
+        {"name": "w-0", "queue": "lq-cq-0", "priority": 0,
+         "creation_time": 1_000_000.0,
+         "pod_sets": [{"name": "ps0", "count": 1, "cpu": 2,
+                       "memory_gi": 4, "topo": None}],
+         "tputs": None},
+        {"name": "w-1", "queue": "lq-cq-3", "priority": 4,
+         "creation_time": 1_000_600.5,
+         "pod_sets": [{"name": "ps0", "count": 8, "cpu": 13,
+                       "memory_gi": 1, "topo": None},
+                      {"name": "ps1", "count": 2, "cpu": 1,
+                       "memory_gi": 64, "topo": None}],
+         "tputs": None},
+    ]
+    import dataclasses
+
+    for spec in specs:
+        fast = TwinEngine._fast_workload(spec)
+        assert fast is not None
+        full = sc_mod.workload_object(spec)
+        # uid is a process-global creation counter — the only field
+        # that can differ, and only because this test builds the same
+        # spec twice (a real replay builds each workload once).
+        assert dataclasses.replace(fast, uid=full.uid) == full
+
+    topo = dict(specs[0])
+    topo["pod_sets"] = [{"name": "ps0", "count": 1, "cpu": 1,
+                         "memory_gi": 1,
+                         "topo": ("required", "rack")}]
+    assert TwinEngine._fast_workload(topo) is None
+    tput = dict(specs[0])
+    tput["tputs"] = {"flavor-0": 2.0}
+    assert TwinEngine._fast_workload(tput) is None
+
+
+def test_duration_model_learns_and_falls_back():
+    dm = DurationModel(default_s=111.0)
+    assert dm.estimate("cq-0") == 111.0
+    dm.observe("cq-0", 100.0)
+    assert dm.estimate("cq-0") == 100.0
+    assert dm.estimate("cq-1") == 100.0   # global EWMA fallback
+    dm.observe("cq-0", 200.0)
+    assert 100.0 < dm.estimate("cq-0") < 200.0
+
+
+# -- what-if ----------------------------------------------------------------
+
+
+def test_parse_config_round_trips_the_spec_language():
+    cfg = parse_config(
+        "ladder:quota=1.5,flavor.flavor-0=0.5,speed.flavor-1=2.0,"
+        "shards=2,engine=host")
+    assert cfg.name == "ladder"
+    assert cfg.quota_factor == 1.5
+    assert cfg.flavor_factors == {"flavor-0": 0.5}
+    assert cfg.speed_factors == {"flavor-1": 2.0}
+    assert cfg.shards == 2 and cfg.engine == "host"
+    assert parse_config("baseline").quota_factor == 1.0
+    with pytest.raises(ValueError):
+        parse_config("x:bogus=1")
+    with pytest.raises(ValueError):
+        parse_config("x:quota")
+
+
+def test_apply_config_scales_quota_triples_pure():
+    cluster = twin_cluster(num_cqs=2, num_flavors=2, cpu_quota=10)
+    before = copy.deepcopy(cluster)
+    out = apply_config(cluster, CapacityConfig(
+        name="x", quota_factor=2.0, flavor_factors={"flavor-1": 0.5},
+        speed_factors={"flavor-0": 3.0}))
+    assert cluster == before           # pure: input untouched
+    q = out["cluster_queues"][0]["quotas"]
+    assert q["flavor-0"]["cpu"][0] == 20
+    assert q["flavor-1"]["cpu"][0] == 10   # 10 * 2.0 * 0.5
+    assert out["flavors"][0]["speed_class"] == 3.0
+    # None (unlimited) stays None under any resize.
+    q["flavor-0"]["cpu"][1] is None
+
+
+def test_whatif_sweep_compares_configs():
+    tr = small_trace(workloads=250)
+    report = whatif.sweep(
+        tr, [CapacityConfig(name="baseline"),
+             CapacityConfig(name="squeeze", quota_factor=0.3)],
+        default_engine="referee")
+    assert report["format"] == whatif.REPORT_FORMAT
+    assert report["baseline"] == "baseline"
+    names = [r["name"] for r in report["configs"]]
+    assert names == ["baseline", "squeeze"]
+    squeeze = report["configs"][1]
+    assert "delta_vs_baseline" in squeeze
+    # A 70% quota cut must not improve p99 wait.
+    base_p99 = report["configs"][0]["metrics"]["wait_p99_s"]
+    sq_p99 = squeeze["metrics"]["wait_p99_s"]
+    assert sq_p99 >= base_p99
+    # Quota oracle holds under every config (the sweep's "ok").
+    assert all(r["quota_violations"] == 0 for r in report["configs"])
+    assert report["ok"]
+    assert "squeeze" in whatif.format_report(report)
+
+
+def test_default_sweep_is_three_configs():
+    names = [c.name for c in default_sweep()]
+    assert names == ["baseline", "quota-75", "quota-150"]
+
+
+# -- cross-check ------------------------------------------------------------
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_twin_byte_matches_drive_on_lattice_scenarios(seed):
+    """THE truthfulness gate: paced replay of a fuzz scenario is
+    byte-identical to lattice.drive() — trail, final admitted set, and
+    oracle violations — at every engine."""
+    sc = fuzz_gen.draw_scenario(seed)
+    res = crosscheck.crosscheck_scenario(sc)
+    assert res["ok"], json.dumps(res, indent=1, default=list)
+    assert {p["engine"] for p in res["points"]} \
+        == {"host", "jax", "referee"}
+    assert all(p["byte_identical"] for p in res["points"])
+
+
+def test_crosscheck_detects_a_lying_twin(monkeypatch):
+    """If the twin's decisions drift from drive()'s, the byte gate
+    must go red — prove the comparator can actually fail."""
+    sc = fuzz_gen.draw_scenario(0)
+    real_run = TwinEngine.run
+
+    def lying_run(self):
+        res = real_run(self)
+        if res.get("trail"):
+            res["trail"] = list(res["trail"])
+            res["trail"][-1] = (("default/phantom",), ())
+        return res
+
+    monkeypatch.setattr(TwinEngine, "run", lying_run)
+    res = crosscheck.crosscheck_scenario(sc, engines=("host",))
+    assert not res["ok"]
+    assert res["points"][0]["divergence"] is not None
+
+
+def test_paced_replay_of_converted_scenario_runs_ops():
+    """A converted scenario's traffic ops (finish/update_cq/...) apply
+    through the shared FrameworkTrafficDriver selectors."""
+    sc = fuzz_gen.draw_scenario(4)
+    tr = Trace.from_scenario(sc)
+    res = TwinEngine(tr, engine="host", record_trail=True).run()
+    ref = lattice.drive(sc, LatticePoint(name="x", kind="framework",
+                                         engine="host"))
+    assert res["trail"] == ref["trail"]
+    assert res["final_admitted"] == ref["final_admitted"]
