@@ -12,7 +12,7 @@ PYTHON ?= python
 .PHONY: help test test-fast bench bench-smoke trace-smoke multichip-smoke \
 	replica-smoke multihost-smoke fleet-smoke hetero-smoke fuzz-smoke \
 	fuzz-nightly fuzz-soak twin-smoke native lint verify-static \
-	verify-threads verify-knobs knob-table install serve dryrun
+	verify-det verify-threads verify-knobs knob-table install serve dryrun
 
 help:
 	@echo "kueue-tpu developer targets:"
@@ -21,8 +21,15 @@ help:
 	@echo "  make lint           kueuelint ast engine (jit purity, locks,"
 	@echo "                      retrace, API hygiene) + ruff if installed"
 	@echo "  make verify-static  ALL analysis engines: ast + flow (lock"
-	@echo "                      graph, ledger flow) + trace (kueueverify"
-	@echo "                      jaxpr rules TRC01-04; needs jax)"
+	@echo "                      graph, ledger flow) + det (determinism"
+	@echo "                      contract) + trace (kueueverify jaxpr"
+	@echo "                      rules TRC01-04; needs jax)"
+	@echo "  make verify-det     the determinism contract, statically:"
+	@echo "                      DET01 unordered iteration into decision"
+	@echo "                      state, DET02 wall-clock/randomness"
+	@echo "                      taint, TNT01 knob decision contract +"
+	@echo "                      the det/taint test module (fixture pins,"
+	@echo "                      the static unsorted-members drill)"
 	@echo "  make verify-threads fast slice: just the cross-thread engine"
 	@echo "                      (THR01 shared-state races, THR02"
 	@echo "                      unbounded blocking on service threads)"
@@ -476,11 +483,22 @@ lint:
 	  echo "ruff not installed; skipped (pip install -e .[dev])"; \
 	fi
 
-# Every analysis engine at the CI gate severity: ast + flow + trace
+# Every analysis engine at the CI gate severity: ast + flow + det + trace
 # (kueueverify lowers the registered solver kernels to jaxprs — needs jax,
 # unlike `make lint` which stays import-free).
 verify-static:
 	$(PYTHON) -m kueue_tpu.analysis --engine all --fail-on error kueue_tpu/
+
+# The determinism contract, statically — the det engine alone (DET01
+# unordered iteration reaching decision state, DET02 wall-clock/
+# randomness taint into decision records and sort keys, TNT01 the knob
+# registry's decision contract), then the test module that pins the
+# fixture pairs and proves the unsorted-members oracle mutation is
+# caught on SOURCE without running a fuzz campaign. Import-free and
+# sub-second, same as `make lint`.
+verify-det:
+	$(PYTHON) -m kueue_tpu.analysis --engine det --fail-on error kueue_tpu/
+	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_det_taint.py -q
 
 # Fast thread-safety slice: only the cross-thread shared-state engine
 # (THR01 inconsistent locking across thread roots, THR02 unbounded

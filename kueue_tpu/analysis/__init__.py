@@ -19,6 +19,15 @@ Rule families (see `python -m kueue_tpu.analysis --list-rules`):
     LOCK03    lock-acquisition order cycles (potential deadlocks)
     LED01     ledger charge without release on a forget/delete/error path
 
+  det engine (`--engine det`; determinism & decision-taint dataflow over
+  the decision core — the static twin of the fuzzer)
+    DET01     unordered-collection iteration order reaching
+              decision-bearing state (the PR 8 victim-flip bug class)
+    DET02     wall-clock/randomness taint flowing into decision state
+              instead of the injected TickClock (the PR 9 bug class)
+    TNT01     knob decision contract: neutral-knob values reaching
+              decision state; gate knobs read off their registered sites
+
   trace engine (`--engine trace`; kueueverify — lowers every registered
   solver kernel to a jaxpr and interprets the equations; needs jax)
     TRC01     dtype-promotion hazards (mixed-dtype writes, silent casts)
@@ -41,6 +50,7 @@ from kueue_tpu.analysis import api_rules, jit_rules, lock_rules  # noqa: F401
 from kueue_tpu.analysis import flow_rules, trace_rules  # noqa: F401
 from kueue_tpu.analysis import obs_rules, perf_rules  # noqa: F401
 from kueue_tpu.analysis import knob_rules, thread_rules  # noqa: F401
+from kueue_tpu.analysis import det_rules, taint_rules  # noqa: F401
 from kueue_tpu.analysis.reporters import (  # noqa: F401
     render_json, render_text)
 

@@ -27,16 +27,25 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         description="Codebase-specific static analysis for kueue-tpu: "
                     "jit purity, retrace hygiene, lock discipline, API "
                     "hygiene (ast engine); lock-order/ledger-flow analysis "
-                    "(flow engine); trace-level jaxpr verification of the "
-                    "solver kernels — kueueverify (trace engine).")
+                    "(flow engine); determinism & decision-taint dataflow "
+                    "over the decision core (det engine); trace-level "
+                    "jaxpr verification of the solver kernels — "
+                    "kueueverify (trace engine).")
     parser.add_argument("paths", nargs="*",
                         help="files or directories to analyze "
                              "(default: the kueue_tpu package)")
-    parser.add_argument("--engine", choices=("ast", "flow", "trace", "all"),
+    parser.add_argument("--engine",
+                        choices=("ast", "flow", "det", "trace", "all"),
                         default="ast",
                         help="analysis engine: ast (default, import-free), "
-                             "flow (lock graph + ledger flow), trace "
+                             "flow (lock graph + ledger flow), det "
+                             "(determinism/decision-taint dataflow), trace "
                              "(jaxpr verification; imports jax), or all")
+    parser.add_argument("--det-wide", action="store_true",
+                        help="drop the det engine's decision-core roster "
+                             "filter and analyze every given file (the "
+                             "nightly wide sweep over tests/ and "
+                             "examples/)")
     parser.add_argument("--format", "-f", choices=("text", "json"),
                         default="text")
     parser.add_argument("--fail-on", choices=("error", "warning"),
@@ -96,7 +105,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             return 2
 
     findings = run_analysis(paths, select=args.select, disable=args.disable,
-                            engine=args.engine)
+                            engine=args.engine,
+                            options={"det_wide": args.det_wide})
     if args.format == "json":
         print(render_json(findings, engine=args.engine))
     else:

@@ -121,12 +121,16 @@ class SourceFile:
 
 
 class AnalysisContext:
-    """Everything a rule may look at: the full set of analyzed files."""
+    """Everything a rule may look at: the full set of analyzed files,
+    plus engine options (e.g. det_wide=True drops the determinism
+    engine's decision-core roster filter for nightly wide runs)."""
 
-    def __init__(self, files: Sequence[SourceFile]):
+    def __init__(self, files: Sequence[SourceFile],
+                 options: Optional[Dict[str, object]] = None):
         self.files = list(files)
         self.by_path: Dict[str, SourceFile] = {
             f.display_path: f for f in files}
+        self.options: Dict[str, object] = dict(options or {})
 
 
 @dataclasses.dataclass(frozen=True)
@@ -196,19 +200,22 @@ def collect_files(paths: Sequence[str]) -> List[SourceFile]:
     return out
 
 
-ENGINES = ("ast", "flow", "trace")
+ENGINES = ("ast", "flow", "det", "trace")
 
 
 def run_analysis(paths: Sequence[str],
                  select: Optional[Sequence[str]] = None,
                  disable: Optional[Sequence[str]] = None,
-                 engine: str = "ast") -> List[Finding]:
+                 engine: str = "ast",
+                 options: Optional[Dict[str, object]] = None
+                 ) -> List[Finding]:
     """Analyze `paths` (files or directories) and return active findings,
     with per-line suppressions already applied.
 
     `engine` selects the analysis engine(s): "ast" (default), "flow",
-    "trace", or "all". The trace engine imports jax; the others never
-    import anything."""
+    "det", "trace", or "all". The trace engine imports jax; the others
+    never import anything. `options` are engine options exposed to the
+    rules on the context (e.g. {"det_wide": True})."""
     if engine != "all" and engine not in ENGINES:
         raise ValueError(f"unknown engine {engine!r} "
                          f"(choose from {ENGINES + ('all',)})")
@@ -217,9 +224,10 @@ def run_analysis(paths: Sequence[str],
     from kueue_tpu.analysis import api_rules, jit_rules, lock_rules  # noqa: F401
     from kueue_tpu.analysis import flow_rules, obs_rules, trace_rules  # noqa: F401
     from kueue_tpu.analysis import knob_rules, perf_rules, thread_rules  # noqa: F401
+    from kueue_tpu.analysis import det_rules, taint_rules  # noqa: F401
 
     files = collect_files(paths)
-    ctx = AnalysisContext(files)
+    ctx = AnalysisContext(files, options)
     rules = [r for r in all_rules() if r.engine in engines]
     if select:
         wanted = set(select)

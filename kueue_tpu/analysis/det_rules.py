@@ -1,0 +1,875 @@
+"""Determinism engine (DET01, DET02): the static twin of the fuzzer.
+
+Every load-bearing contract in this repo — the fuzz lattice's identity
+oracles, HA journal replay, the shards=N==1 and replicas=N==1 gates, the
+twin byte cross-check — reduces to ONE property: the decision trail is a
+pure deterministic function of (store events, TickClock, declared
+knobs). Both worst bugs so far violated it silently, and each cost hours
+of fuzz campaign + shrinking to find:
+
+  * PR 8: `Cohort.members` is a set of identity-hashed objects; the
+    preemption walk iterated it raw, so victim selection flipped
+    run-to-run (fixed by the name-sorted `sorted_members()` memo);
+  * PR 9: wall-clock Condition stamps made A/B tiebreaks
+    nondeterministic (fixed by stamping from the injected TickClock).
+
+These rules make the contract itself statically checkable, so the next
+bug of either class dies in CI in seconds instead of in a nightly
+1000-seed campaign:
+
+DET01 (error) — iteration over an unordered collection whose order can
+reach decision-bearing state. Unordered sources: sets of non-str /
+identity-hashed elements (annotation- and `add()`-site-inferred; sets
+proven str-keyed are exempt — hash order of strs is still arbitrary
+across processes, but every str-keyed walk in the repo feeds a
+`sorted()` or a reduction, and flagging them would bury the
+identity-hash class this rule exists for), `.keys()/.values()/.items()`
+over object-keyed dicts, and unsorted `os.listdir`/`iterdir`/`glob`.
+The order is "observed" when the source is materialized
+(`list`/`tuple`, or a directory listing used raw), position-paired
+(`enumerate`/`zip`), first-element-picked (`next(iter(..))`),
+list-comprehended, or driven through a loop whose body is
+order-sensitive — appends/extends/yields, breaks or returns
+(first-match selection), directly or through a call into an analyzed
+function that does (bounded two-level resolution via the flow engine's
+program model). Sanitizers are recognized: `sorted(...)`, reductions
+(`sum`/`min`/`max`/`len`/`any`/`all`), set/frozenset rebuilds,
+membership tests, and loops whose bodies are commutative (set adds,
+keyed stores, numeric accumulation).
+
+DET02 (error) — wall-clock / randomness taint flowing into decision
+state instead of the injected TickClock. Sources: `time.time` /
+`monotonic` / `perf_counter` (+`_ns`), `datetime.now/utcnow/today`,
+unseeded module-level `random.*`, `os.urandom`, `uuid.uuid1/uuid4`.
+Taint propagates through assignments, arithmetic, conditionals,
+containers, attribute stores on `self`, and function returns (bounded,
+two-level call context); the finding carries the full source→sink
+path. Sinks are DECISION STATE: arguments into constructors of classes
+defined in the analyzed program (Condition stamps, decision records)
+and sort keys (`sorted`/`sort`/`min`/`max` key callables). Deadline
+anchors and elapsed-time comparisons (`now - t0 > timeout`) never sink
+— that is liveness machinery, deliberately wall-clock-driven, which is
+exactly the flow-sensitivity OBS01's per-module blanket ban lacked
+(controllers/ carried six OBS01 suppressions for clean anchors; DET02
+checks the same modules with zero). Seeded `random.Random(seed)`
+instances and injectable clock DEFAULTS (`clock: ... = time.time` — the
+TickClock seam itself, an attribute reference, never a call) are not
+sources.
+
+Scope: the decision core (scheduler/, queue/, core/, models/, solver/,
+ops/, parallel/, hetero/, topology/). DET02 additionally covers
+controllers/ (liveness machinery whose wall-clock must stay OUT of
+decision records) and twin/ (virtual-time by contract: the byte
+cross-check vs lattice.drive() dies if wall time leaks into the
+simulated trail). `tests/test_det_taint.py` keeps the roster in sync
+with the package layout. The nightly wide run (`--det-wide`) drops the
+roster filter and analyzes everything it is pointed at, warnings
+allowed.
+
+Both rules are pure-AST and import-free, like the flow engine.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from kueue_tpu.analysis.core import (
+    AnalysisContext, Finding, Rule, Severity, SourceFile, dotted_name,
+    finding, register)
+from kueue_tpu.analysis.flow_rules import _Program
+
+# ---------------------------------------------------------------------------
+# The decision-core roster. tests/test_det_taint.py asserts every
+# top-level entry of the package appears in exactly one roster, so a new
+# subsystem cannot silently ship outside the determinism contract.
+# ---------------------------------------------------------------------------
+
+# Decision core: modules whose state IS the decision trail.
+DECISION_CORE = ("scheduler", "queue", "core", "models", "solver",
+                 "ops", "parallel", "hetero", "topology")
+
+# DET02-only extension: wall-clock is legitimate here (liveness
+# deadlines; bench wall timing) but must never flow into decision
+# records or sort keys.
+CLOCK_SENSITIVE = ("controllers", "twin")
+
+# Everything else at the package top level, explicitly: glue, I/O,
+# tooling, and surfaces whose determinism is checked dynamically
+# (transport framing, server). The roster meta-test fails when a new
+# top-level module appears in none of the three tuples.
+NON_DECISION = ("analysis", "api", "fuzz", "jobs", "native", "server",
+                "tracing", "transport", "utils", "webhooks",
+                "__init__", "__main__", "config", "events", "features",
+                "importer", "knobs", "metrics")
+
+_DET01_PATHS = tuple(f"{d}/" for d in DECISION_CORE) + ("fixtures/lint/",)
+_DET02_PATHS = _DET01_PATHS + tuple(f"{d}/" for d in CLOCK_SENSITIVE)
+
+
+def _in_scope(f: SourceFile, fragments: Tuple[str, ...],
+              ctx: AnalysisContext) -> bool:
+    if f.tree is None:
+        return False
+    if getattr(ctx, "options", {}).get("det_wide"):
+        return True
+    posix = f.path.as_posix()
+    return any(p in posix for p in fragments)
+
+
+# ---------------------------------------------------------------------------
+# Shared: per-function parent map and small AST predicates
+# ---------------------------------------------------------------------------
+
+
+def _parents(root: ast.AST) -> Dict[int, ast.AST]:
+    out: Dict[int, ast.AST] = {}
+    for node in ast.walk(root):
+        for child in ast.iter_child_nodes(node):
+            out[id(child)] = node
+    return out
+
+
+def _functions(tree: ast.Module):
+    """(class name or None, function node) for every top-level def and
+    method in the module."""
+    for node in tree.body:
+        if isinstance(node, ast.ClassDef):
+            for item in node.body:
+                if isinstance(item, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    yield node.name, item
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield None, node
+
+
+def _self_name(fn: ast.AST, cls: Optional[str]) -> Optional[str]:
+    if cls and getattr(fn, "args", None) and fn.args.args:
+        return fn.args.args[0].arg
+    return None
+
+
+class _CallerLike:
+    """Just enough _Func surface for _Program.resolve_call."""
+
+    def __init__(self, cls: Optional[str], self_name: Optional[str],
+                 src: Optional[SourceFile]):
+        self.cls = cls
+        self.self_name = self_name
+        self.src = src
+
+
+# ---------------------------------------------------------------------------
+# DET01 — unordered iteration reaching decision-bearing state
+# ---------------------------------------------------------------------------
+
+# Element kinds for set-typed state: 'str' is exempt (name-keyed walks),
+# 'obj' fires, 'unknown' stays quiet (precision over recall — the
+# annotation or an add()-site names the element type wherever it
+# matters; Cohort.members is `Set["CachedClusterQueue"]`).
+_STR_TYPES = {"str", "bytes", "int", "float", "bool", "Tuple", "tuple"}
+
+_SANITIZERS = {"sorted", "sum", "min", "max", "len", "any", "all",
+               "set", "frozenset", "Counter", "sorted_members",
+               "isdisjoint", "issubset", "issuperset", "update",
+               "intersection", "union", "difference"}
+
+_ORDER_SENSITIVE_METHODS = {"append", "extend", "insert", "appendleft"}
+
+
+def _elem_kind_of_annotation(node: ast.AST) -> str:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        try:
+            node = ast.parse(node.value, mode="eval").body
+        except SyntaxError:
+            return "unknown"
+    name = dotted_name(node)
+    if name is None:
+        return "unknown"
+    leaf = name.rsplit(".", 1)[-1]
+    return "str" if leaf in _STR_TYPES else "obj"
+
+
+def _unwrap_annotation(node: ast.AST) -> Optional[ast.AST]:
+    """Strip string quoting and Optional/Final/ClassVar/Annotated."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        try:
+            node = ast.parse(node.value, mode="eval").body
+        except SyntaxError:
+            return None
+    if isinstance(node, ast.Subscript):
+        outer = (dotted_name(node.value) or "").rsplit(".", 1)[-1]
+        if outer in ("Optional", "Final", "ClassVar", "Annotated"):
+            inner = node.slice
+            if isinstance(inner, ast.Tuple) and inner.elts:
+                inner = inner.elts[0]
+            return _unwrap_annotation(inner)
+    return node
+
+
+def _ann_set_elem(node: ast.AST) -> Optional[str]:
+    """'str' / 'obj' / 'unknown' when the annotation is a set type."""
+    node = _unwrap_annotation(node)
+    if node is None:
+        return None
+    if isinstance(node, ast.Subscript):
+        leaf = (dotted_name(node.value) or "").rsplit(".", 1)[-1]
+        if leaf in ("Set", "FrozenSet", "MutableSet", "AbstractSet",
+                    "set", "frozenset"):
+            elem = node.slice
+            if isinstance(elem, ast.Tuple) and elem.elts:
+                elem = elem.elts[0]
+            return _elem_kind_of_annotation(elem)
+        return None
+    leaf = (dotted_name(node) or "").rsplit(".", 1)[-1]
+    if leaf in ("set", "frozenset", "Set", "FrozenSet"):
+        return "unknown"  # bare `x: set` — element type unstated
+    return None
+
+
+def _ann_dict_key(node: ast.AST) -> Optional[str]:
+    """'str' / 'obj' key kind when the annotation is a Dict type."""
+    node = _unwrap_annotation(node)
+    if isinstance(node, ast.Subscript):
+        leaf = (dotted_name(node.value) or "").rsplit(".", 1)[-1]
+        if leaf in ("Dict", "MutableMapping", "Mapping", "dict",
+                    "DefaultDict", "OrderedDict"):
+            key = node.slice
+            if isinstance(key, ast.Tuple) and key.elts:
+                key = key.elts[0]
+            return _elem_kind_of_annotation(key)
+    return None
+
+
+def _str_ish(node: ast.AST) -> bool:
+    """The added element is string-shaped: a literal, an f-string, or a
+    `.name`/`.key`-style attribute read."""
+    if isinstance(node, ast.Constant):
+        return True
+    if isinstance(node, ast.JoinedStr):
+        return True
+    if isinstance(node, ast.Attribute) and node.attr in (
+            "name", "key", "uid", "id"):
+        return True
+    if isinstance(node, ast.Call):
+        name = dotted_name(node.func) or ""
+        if name.rsplit(".", 1)[-1] in ("str", "repr", "format"):
+            return True
+    return False
+
+
+class _SetIndex:
+    """Which class attributes are unordered sets (and of what), per file."""
+
+    def __init__(self, f: SourceFile):
+        # (class name, attr) -> elem kind; dict keys indexed under
+        # (class name, attr + ".__dictkey__")
+        self.attr_elems: Dict[Tuple[str, str], str] = {}
+        for cls in ast.walk(f.tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            for node in ast.walk(cls):
+                if isinstance(node, ast.AnnAssign):
+                    target, attr = node.target, None
+                    if isinstance(target, ast.Name):
+                        attr = target.id
+                    elif isinstance(target, ast.Attribute) \
+                            and isinstance(target.value, ast.Name) \
+                            and target.value.id in ("self", "cls"):
+                        attr = target.attr
+                    if attr is None:
+                        continue
+                    kind = _ann_set_elem(node.annotation)
+                    if kind is not None:
+                        self.attr_elems[(cls.name, attr)] = kind
+                        continue
+                    dk = _ann_dict_key(node.annotation)
+                    if dk is not None:
+                        self.attr_elems[
+                            (cls.name, f"{attr}.__dictkey__")] = dk
+                elif isinstance(node, ast.Assign):
+                    if not (isinstance(node.value, ast.Call)
+                            and (dotted_name(node.value.func) or "")
+                            .rsplit(".", 1)[-1] in ("set", "frozenset")
+                            and not node.value.args):
+                        continue
+                    for t in node.targets:
+                        if isinstance(t, ast.Attribute) \
+                                and isinstance(t.value, ast.Name) \
+                                and t.value.id in ("self", "cls"):
+                            self.attr_elems.setdefault(
+                                (cls.name, t.attr), "unknown")
+            # refine unknown element kinds from add() sites
+            for node in ast.walk(cls):
+                if isinstance(node, ast.Call) \
+                        and isinstance(node.func, ast.Attribute) \
+                        and node.func.attr in ("add", "discard") \
+                        and node.args \
+                        and isinstance(node.func.value, ast.Attribute) \
+                        and isinstance(node.func.value.value, ast.Name) \
+                        and node.func.value.value.id in ("self", "cls"):
+                    key = (cls.name, node.func.value.attr)
+                    if self.attr_elems.get(key) == "unknown":
+                        self.attr_elems[key] = (
+                            "str" if _str_ish(node.args[0]) else "obj")
+
+
+def _local_sets(fn: ast.AST) -> Dict[str, str]:
+    """local name -> elem kind for set-typed locals (and parameters)."""
+    out: Dict[str, str] = {}
+    args = getattr(fn, "args", None)
+    if args is not None:
+        for a in list(args.args) + list(args.kwonlyargs):
+            if a.annotation is not None:
+                kind = _ann_set_elem(a.annotation)
+                if kind is not None:
+                    out[a.arg] = kind
+    for node in ast.walk(fn):
+        if isinstance(node, ast.AnnAssign) \
+                and isinstance(node.target, ast.Name):
+            kind = _ann_set_elem(node.annotation)
+            if kind is not None:
+                out[node.target.id] = kind
+        elif isinstance(node, ast.Assign) \
+                and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            name = node.targets[0].id
+            v = node.value
+            if isinstance(v, ast.Call) and not v.args \
+                    and (dotted_name(v.func) or "").rsplit(".", 1)[-1] \
+                    in ("set", "frozenset"):
+                out.setdefault(name, "unknown")
+            elif isinstance(v, ast.Set):
+                kinds = {("str" if _str_ish(e) else "obj")
+                         for e in v.elts}
+                out[name] = "str" if kinds == {"str"} else "obj"
+            elif isinstance(v, ast.SetComp):
+                out[name] = "str" if _str_ish(v.elt) else "obj"
+    # refine unknowns from add() sites
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr in ("add", "discard") and node.args \
+                and isinstance(node.func.value, ast.Name):
+            name = node.func.value.id
+            if out.get(name) == "unknown":
+                out[name] = "str" if _str_ish(node.args[0]) else "obj"
+    return out
+
+
+_LISTING_LEAVES = ("listdir", "iterdir", "glob", "rglob", "scandir")
+
+
+def _unordered_desc(node: ast.AST, caller: _CallerLike, sets: "_SetIndex",
+                    local: Dict[str, str]
+                    ) -> Optional[Tuple[str, bool]]:
+    """(description, is_materialized_listing) when `node` evaluates to
+    an unordered collection of non-str elements, else None."""
+    if isinstance(node, ast.Attribute) \
+            and isinstance(node.value, ast.Name):
+        if caller.self_name and node.value.id == caller.self_name \
+                and caller.cls:
+            if sets.attr_elems.get((caller.cls, node.attr)) == "obj":
+                return f"set `self.{node.attr}`", False
+        return None
+    if isinstance(node, ast.Name):
+        if local.get(node.id) == "obj":
+            return f"set `{node.id}`", False
+        return None
+    if isinstance(node, ast.Set):
+        if node.elts and any(not _str_ish(e) for e in node.elts):
+            return "set literal", False
+        return None
+    if isinstance(node, ast.Call):
+        name = dotted_name(node.func) or ""
+        leaf = name.rsplit(".", 1)[-1]
+        if leaf in ("keys", "values", "items") \
+                and isinstance(node.func, ast.Attribute):
+            base = node.func.value
+            if isinstance(base, ast.Attribute) \
+                    and isinstance(base.value, ast.Name) \
+                    and caller.self_name \
+                    and base.value.id == caller.self_name \
+                    and caller.cls:
+                if sets.attr_elems.get(
+                        (caller.cls,
+                         f"{base.attr}.__dictkey__")) == "obj":
+                    return (f"object-keyed dict "
+                            f"`self.{base.attr}.{leaf}()`", False)
+            return None
+        if leaf in _LISTING_LEAVES:
+            return f"`{name or leaf}(...)` directory listing", True
+        return None
+    if isinstance(node, ast.BinOp) and isinstance(
+            node.op, (ast.BitOr, ast.BitAnd, ast.Sub, ast.BitXor)):
+        for side in (node.left, node.right):
+            d = _unordered_desc(side, caller, sets, local)
+            if d is not None:
+                return f"set expression over {d[0]}", False
+    return None
+
+
+def _loop_order_sensitivity(body: Sequence[ast.AST], prog: _Program,
+                            caller, depth: int = 0,
+                            loop_body: bool = True) -> Optional[str]:
+    """Why this body observes iteration order, or None when every
+    statement is commutative. `loop_body=True` means `body` is the body
+    of a loop iterating the unordered value directly, where an early
+    exit (`break`/`return`) IS first-match selection; a CALLEE's body
+    (`loop_body=False`, reached through the bounded two-level descent)
+    runs once per element, so its own returns are harmless — only
+    ordered OUTPUT (append/extend/yield) leaks the order from there."""
+    for stmt in body:
+        for node in ast.walk(stmt):
+            if loop_body and isinstance(node, ast.Break):
+                return (f"`break` (first-match selection) at line "
+                        f"{node.lineno}")
+            if loop_body and isinstance(node, ast.Return):
+                return f"`return` inside the loop at line {node.lineno}"
+            if isinstance(node, (ast.Yield, ast.YieldFrom)):
+                return f"`yield` (ordered stream) at line {node.lineno}"
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute):
+                if node.func.attr in _ORDER_SENSITIVE_METHODS:
+                    recv = dotted_name(node.func.value) or "<expr>"
+                    return (f"`{recv}.{node.func.attr}(...)` (ordered "
+                            f"output) at line {node.lineno}")
+                if depth < 2:
+                    # bounded descent: a call into an analyzed function
+                    # that appends/yields observes the order too
+                    for callee in prog.resolve_call(caller, node):
+                        why = _loop_order_sensitivity(
+                            callee.node.body, prog, callee, depth + 1,
+                            loop_body=False)
+                        if why is not None:
+                            return (f"call into `{callee.qualname}` "
+                                    f"at line {node.lineno} -> {why}")
+    return None
+
+
+def _consumption(node: ast.AST, parents: Dict[int, ast.AST],
+                 prog: _Program, caller: _CallerLike,
+                 materialized: bool) -> Optional[str]:
+    """How the unordered value's ORDER escapes, or None when the
+    consumer is order-insensitive. `materialized` means the value is
+    already an arbitrarily-ordered SEQUENCE (a directory listing, or a
+    `list()` of a set): any consumer that is not a recognized sanitizer
+    observes the order, including a plain assignment or return."""
+    parent = parents.get(id(node))
+    while isinstance(parent, (ast.Starred, ast.keyword)):
+        node = parent
+        parent = parents.get(id(node))
+    if isinstance(parent, ast.Call) and node in parent.args:
+        name = dotted_name(parent.func) or ""
+        leaf = name.rsplit(".", 1)[-1]
+        if leaf in _SANITIZERS:
+            return None
+        if leaf in ("list", "tuple"):
+            why = _consumption(parent, parents, prog, caller,
+                               materialized=True)
+            if why is None:
+                return None
+            return (f"materialized in arbitrary order by `{leaf}(...)` "
+                    f"at line {parent.lineno} -> {why}")
+        if leaf in ("enumerate", "zip"):
+            return (f"position-paired by `{leaf}(...)` at line "
+                    f"{parent.lineno}")
+        if leaf == "iter":
+            return (f"`iter(...)`/`next(...)` picks an arbitrary "
+                    f"element at line {parent.lineno}")
+        if leaf in ("join", "writelines"):
+            return f"emitted unsorted at line {parent.lineno}"
+        # a call into the analyzed program: does the callee observe
+        # the order of this argument?
+        for callee in prog.resolve_call(caller, parent):
+            try:
+                idx = parent.args.index(node)
+            except ValueError:
+                break
+            params = [a.arg for a in callee.node.args.args]
+            if callee.cls is not None:
+                params = params[1:]
+            if idx >= len(params):
+                continue
+            pname = params[idx]
+            why = _param_order_sensitivity(callee, pname, prog)
+            if why is not None:
+                return (f"passed into `{callee.qualname}({pname})` at "
+                        f"line {parent.lineno} -> {why}")
+        return None
+    if isinstance(parent, ast.Compare) and any(
+            isinstance(op, (ast.In, ast.NotIn)) for op in parent.ops):
+        return None
+    if isinstance(parent, (ast.For, ast.AsyncFor)) \
+            and parent.iter is node:
+        why = _loop_order_sensitivity(parent.body, prog, caller)
+        if why is None:
+            return None
+        return f"loop at line {parent.lineno} is order-sensitive: {why}"
+    if isinstance(parent, ast.comprehension) and parent.iter is node:
+        comp = parents.get(id(parent))
+        if isinstance(comp, ast.ListComp):
+            return (f"list comprehension at line {comp.lineno} "
+                    "materializes the arbitrary order")
+        if isinstance(comp, ast.GeneratorExp):
+            return _consumption(comp, parents, prog, caller,
+                                materialized)
+        return None  # set/dict comprehensions stay unordered
+    if materialized:
+        # An arbitrarily-ordered sequence escaping through assignment,
+        # return, or any unrecognized consumer IS the order leak — this
+        # is exactly `sm = list(self.members)`, the PR 8 revert shape.
+        line = getattr(parent, "lineno", getattr(node, "lineno", 0))
+        kind = type(parent).__name__ if parent is not None else "module"
+        return f"arbitrary order escapes via {kind} at line {line}"
+    return None
+
+
+def _param_order_sensitivity(callee, pname: str,
+                             prog: _Program) -> Optional[str]:
+    """Does `callee` observe the iteration order of parameter `pname`?"""
+    for node in ast.walk(callee.node):
+        if isinstance(node, (ast.For, ast.AsyncFor)) \
+                and isinstance(node.iter, ast.Name) \
+                and node.iter.id == pname:
+            why = _loop_order_sensitivity(node.body, prog, callee,
+                                          depth=1)
+            if why is not None:
+                return why
+        if isinstance(node, ast.Call) and node.args:
+            name = (dotted_name(node.func) or "").rsplit(".", 1)[-1]
+            if name in ("list", "tuple", "enumerate", "zip") and any(
+                    isinstance(a, ast.Name) and a.id == pname
+                    for a in node.args):
+                return (f"`{name}({pname})` materializes it at line "
+                        f"{node.lineno}")
+    return None
+
+
+def _check_det01(ctx: AnalysisContext) -> Iterable[Finding]:
+    files = [f for f in ctx.files if _in_scope(f, _DET01_PATHS, ctx)]
+    if not files:
+        return
+    prog = _Program(files)
+    for f in files:
+        sets = _SetIndex(f)
+        for cls, fn in _functions(f.tree):
+            caller = _CallerLike(cls, _self_name(fn, cls), f)
+            local = _local_sets(fn)
+            parents = _parents(fn)
+            for node in ast.walk(fn):
+                got = _unordered_desc(node, caller, sets, local)
+                if got is None:
+                    continue
+                desc, listing = got
+                why = _consumption(node, parents, prog, caller,
+                                   materialized=listing)
+                if why is None:
+                    continue
+                yield finding(
+                    DET01, f, node,
+                    f"iteration order of {desc} can reach "
+                    f"decision-bearing state: {why} — identity-hash "
+                    "order flips decisions run-to-run (the PR 8 "
+                    "victim-flip bug class); sort first "
+                    "(`sorted(..., key=...)` / a name-keyed walk) or "
+                    "reduce order-insensitively")
+
+
+# ---------------------------------------------------------------------------
+# DET02 — wall-clock / randomness taint into decision state
+# ---------------------------------------------------------------------------
+
+_CLOCK_FNS = {"time.time", "time.monotonic", "time.perf_counter",
+              "time.monotonic_ns", "time.perf_counter_ns",
+              "time.time_ns"}
+_DATETIME_LEAVES = {"now", "utcnow", "today"}
+_RANDOM_FNS = {"random", "randint", "randrange", "uniform", "choice",
+               "choices", "sample", "shuffle", "gauss", "betavariate",
+               "expovariate", "triangular", "vonmisesvariate"}
+_MISC_SOURCES = {"os.urandom", "uuid.uuid1", "uuid.uuid4"}
+
+
+class _Taint:
+    """A wall-clock/randomness value plus the path it travelled."""
+
+    __slots__ = ("source", "line", "hops")
+
+    def __init__(self, source: str, line: int,
+                 hops: Optional[List[str]] = None):
+        self.source = source
+        self.line = line
+        self.hops = hops or []
+
+    def via(self, hop: str) -> "_Taint":
+        # keep the rendered path readable: bound its length, keep the
+        # most recent hops (the source itself is always retained)
+        hops = self.hops + [hop]
+        return _Taint(self.source, self.line, hops[-6:])
+
+    def render(self) -> str:
+        return " -> ".join(
+            [f"{self.source} (line {self.line})"] + self.hops)
+
+
+def _time_aliases(tree: ast.Module) -> Dict[str, str]:
+    """alias -> canonical dotted prefix for the source modules."""
+    out: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name in ("time", "datetime", "random", "os",
+                              "uuid", "secrets"):
+                    out[a.asname or a.name] = a.name
+        elif isinstance(node, ast.ImportFrom):
+            if node.module in ("time", "datetime", "random", "os",
+                               "uuid", "secrets"):
+                for a in node.names:
+                    out[a.asname or a.name] = f"{node.module}.{a.name}"
+    return out
+
+
+def _source_desc(call: ast.Call, aliases: Dict[str, str]
+                 ) -> Optional[str]:
+    """`time.time()` etc. rendered canonically when `call` is a
+    wall-clock/randomness source, else None."""
+    name = dotted_name(call.func)
+    if name is None:
+        return None
+    parts = name.split(".")
+    canon = aliases.get(parts[0])
+    if canon is not None:
+        parts = canon.split(".") + parts[1:]
+    full = ".".join(parts)
+    if full in _CLOCK_FNS or full in _MISC_SOURCES:
+        return f"`{full}()`"
+    if parts[0] == "secrets":
+        return f"`{full}()`"
+    if parts[0] == "datetime" and parts[-1] in _DATETIME_LEAVES:
+        return f"`{full}()`"
+    if parts[0] == "random" and len(parts) == 2 \
+            and parts[1] in _RANDOM_FNS:
+        # only module-level reads of the shared global PRNG fire;
+        # `random.Random(seed)` instances are the sanctioned path
+        return f"`{full}()`"
+    return None
+
+
+class _TaintPass:
+    """One function's wall-clock taint environment."""
+
+    def __init__(self, f: SourceFile, cls: Optional[str], fn: ast.AST,
+                 aliases: Dict[str, str],
+                 fn_summaries: Dict[str, "_Taint"],
+                 attr_taint: Dict[Tuple[str, str], "_Taint"],
+                 prog: _Program):
+        self.f = f
+        self.cls = cls
+        self.fn = fn
+        self.self_name = _self_name(fn, cls)
+        self.caller = _CallerLike(cls, self.self_name, f)
+        self.aliases = aliases
+        self.fn_summaries = fn_summaries
+        self.attr_taint = attr_taint
+        self.prog = prog
+        self.env: Dict[str, _Taint] = {}
+
+    def taint_of(self, node: ast.AST) -> Optional["_Taint"]:
+        if isinstance(node, ast.Call):
+            src = _source_desc(node, self.aliases)
+            if src is not None:
+                return _Taint(src, node.lineno)
+            # bounded interprocedural: calls into analyzed functions
+            # whose returns are wall-clock values
+            for callee in self.prog.resolve_call(self.caller, node):
+                t = self.fn_summaries.get(callee.qualname)
+                if t is not None:
+                    return t.via(f"returned by `{callee.qualname}` "
+                                 f"(call at line {node.lineno})")
+            return None
+        if isinstance(node, ast.Name):
+            return self.env.get(node.id)
+        if isinstance(node, ast.Attribute):
+            if isinstance(node.value, ast.Name) and self.self_name \
+                    and node.value.id == self.self_name and self.cls:
+                t = self.attr_taint.get((self.cls, node.attr))
+                if t is not None:
+                    return t.via(f"read back from `self.{node.attr}` "
+                                 f"at line {node.lineno}")
+            return None
+        if isinstance(node, ast.BinOp):
+            return self.taint_of(node.left) or self.taint_of(node.right)
+        if isinstance(node, ast.UnaryOp):
+            return self.taint_of(node.operand)
+        if isinstance(node, ast.IfExp):
+            return self.taint_of(node.body) or self.taint_of(node.orelse)
+        if isinstance(node, ast.BoolOp):
+            for v in node.values:
+                t = self.taint_of(v)
+                if t is not None:
+                    return t
+            return None
+        if isinstance(node, (ast.List, ast.Tuple, ast.Set)):
+            for e in node.elts:
+                t = self.taint_of(e)
+                if t is not None:
+                    return t.via("carried in a container literal")
+            return None
+        if isinstance(node, ast.Subscript):
+            return self.taint_of(node.value)
+        return None
+
+    def run_env(self) -> None:
+        """Two linear passes so loop-carried assignments settle."""
+        for _ in range(2):
+            for node in ast.walk(self.fn):
+                if isinstance(node, ast.Assign):
+                    t = self.taint_of(node.value)
+                    if t is None:
+                        continue
+                    for target in node.targets:
+                        if isinstance(target, ast.Name):
+                            self.env[target.id] = t.via(
+                                f"assigned to `{target.id}` at line "
+                                f"{node.lineno}")
+                elif isinstance(node, ast.AnnAssign) \
+                        and node.value is not None \
+                        and isinstance(node.target, ast.Name):
+                    t = self.taint_of(node.value)
+                    if t is not None:
+                        self.env[node.target.id] = t.via(
+                            f"assigned to `{node.target.id}` at line "
+                            f"{node.lineno}")
+                elif isinstance(node, ast.AugAssign) \
+                        and isinstance(node.target, ast.Name):
+                    t = self.taint_of(node.value)
+                    if t is not None:
+                        self.env[node.target.id] = t.via(
+                            f"accumulated into `{node.target.id}` at "
+                            f"line {node.lineno}")
+
+
+def _decision_ctor(call: ast.Call, prog: _Program) -> Optional[str]:
+    name = dotted_name(call.func)
+    if name is None:
+        return None
+    leaf = name.rsplit(".", 1)[-1]
+    if leaf[:1].isupper() and leaf in prog.classes:
+        return leaf
+    return None
+
+
+_SORTERS = {"sorted", "sort", "min", "max", "nsmallest", "nlargest"}
+
+
+def _key_callable_taint(call: ast.Call, tp: "_TaintPass"
+                        ) -> Optional["_Taint"]:
+    """Tainted value referenced inside a sort-key callable."""
+    name = (dotted_name(call.func) or "").rsplit(".", 1)[-1]
+    if name not in _SORTERS:
+        return None
+    for kw in call.keywords:
+        if kw.arg != "key":
+            continue
+        for sub in ast.walk(kw.value):
+            if isinstance(sub, (ast.Name, ast.Call, ast.Attribute)):
+                t = tp.taint_of(sub)
+                if t is not None:
+                    return t
+    return None
+
+
+def _check_det02(ctx: AnalysisContext) -> Iterable[Finding]:
+    files = [f for f in ctx.files if _in_scope(f, _DET02_PATHS, ctx)]
+    if not files:
+        return
+    prog = _Program(files)
+    alias_by_file = {id(f): _time_aliases(f.tree) for f in files}
+
+    # Pass 1: function return summaries + self-attribute taint, to a
+    # bounded fixed point (two rounds = two-level call context).
+    fn_summaries: Dict[str, _Taint] = {}
+    attr_taint: Dict[Tuple[str, str], _Taint] = {}
+    for _ in range(2):
+        for f in files:
+            aliases = alias_by_file[id(f)]
+            for cls, fn in _functions(f.tree):
+                tp = _TaintPass(f, cls, fn, aliases, fn_summaries,
+                                attr_taint, prog)
+                tp.run_env()
+                qual = f"{cls}.{fn.name}" if cls else \
+                    f"{f.path.stem}:{fn.name}"
+                for node in ast.walk(fn):
+                    if isinstance(node, ast.Return) \
+                            and node.value is not None:
+                        t = tp.taint_of(node.value)
+                        if t is not None and qual not in fn_summaries:
+                            fn_summaries[qual] = t
+                    elif isinstance(node, ast.Assign):
+                        t = tp.taint_of(node.value)
+                        if t is None:
+                            continue
+                        for target in node.targets:
+                            if isinstance(target, ast.Attribute) \
+                                    and isinstance(
+                                        target.value, ast.Name) \
+                                    and tp.self_name \
+                                    and target.value.id \
+                                    == tp.self_name and cls:
+                                key = (cls, target.attr)
+                                if key not in attr_taint:
+                                    attr_taint[key] = t.via(
+                                        f"stored to `self."
+                                        f"{target.attr}` at line "
+                                        f"{node.lineno}")
+
+    # Pass 2: sinks — program-class constructor arguments and sort keys.
+    for f in files:
+        aliases = alias_by_file[id(f)]
+        for cls, fn in _functions(f.tree):
+            tp = _TaintPass(f, cls, fn, aliases, fn_summaries,
+                            attr_taint, prog)
+            tp.run_env()
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                ctor = _decision_ctor(node, prog)
+                if ctor is not None:
+                    for arg in (list(node.args)
+                                + [k.value for k in node.keywords]):
+                        t = tp.taint_of(arg)
+                        if t is not None:
+                            yield finding(
+                                DET02, f, node,
+                                "wall-clock/randomness flows into "
+                                f"decision state: {t.render()} -> "
+                                f"`{ctor}(...)` constructor argument "
+                                f"at line {node.lineno} — decisions "
+                                "must be a pure function of (store "
+                                "events, TickClock, knobs); stamp from "
+                                "the injected clock instead (the PR 9 "
+                                "wall-clock-stamp bug class)")
+                            break
+                t = _key_callable_taint(node, tp)
+                if t is not None:
+                    yield finding(
+                        DET02, f, node,
+                        "wall-clock/randomness flows into a sort key: "
+                        f"{t.render()} -> `key=` callable at line "
+                        f"{node.lineno} — ordering decisions on wall "
+                        "time makes A/B tiebreaks nondeterministic; "
+                        "key on stable fields (names, TickClock "
+                        "stamps)")
+
+
+DET01 = register(Rule(
+    id="DET01", severity=Severity.ERROR,
+    summary="unordered-collection iteration order reaching "
+            "decision-bearing state",
+    check=_check_det01, project=True, engine="det"))
+
+DET02 = register(Rule(
+    id="DET02", severity=Severity.ERROR,
+    summary="wall-clock/randomness taint flowing into decision state "
+            "instead of the injected TickClock",
+    check=_check_det02, project=True, engine="det"))

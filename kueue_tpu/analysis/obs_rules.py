@@ -21,6 +21,15 @@ and stay unflagged. The tracer's own internals are the sanctioned
 consumer and carry explicit suppressions; non-measurement uses (e.g. a
 monotonic TTL anchor for a health cache) suppress with a justification,
 same as the LOCK01 discipline.
+
+controllers/ left this roster when the det engine landed: its monotonic
+reads are liveness anchors (degraded-mode stamps, barrier deadlines),
+not measurements, and every one needed a justification suppression
+under the blanket ban. DET02 now checks the same modules
+FLOW-SENSITIVELY — wall-clock may anchor deadlines and elapsed
+comparisons freely, and only flows into decision records or sort keys
+are flagged — so the six suppressions came out and the real hazard
+stayed covered.
 """
 
 from __future__ import annotations
@@ -31,7 +40,7 @@ from typing import Dict, Set
 from kueue_tpu.analysis.core import (
     AnalysisContext, Rule, Severity, SourceFile, finding, register)
 
-_OBS_PATHS = ("scheduler/", "solver/", "controllers/", "queue/", "core/",
+_OBS_PATHS = ("scheduler/", "solver/", "queue/", "core/",
               "models/", "tracing/", "fixtures/lint/")
 
 _TIMING_FNS = {"monotonic", "perf_counter", "monotonic_ns",

@@ -1,0 +1,438 @@
+"""Decision-taint rule (TNT01): the knob registry's decision contract.
+
+The fuzz lattice observes, per kill switch, that flipping it preserves
+the decision-trail byte identity of whatever the switch does NOT gate
+("kill switch => byte identity"). That was an observation; this rule
+turns it into a checked CONTRACT. Every knob in `kueue_tpu/knobs.py`
+now declares which side of the decision boundary it lives on:
+
+  * `decision=NEUTRAL` — tracing/debug/tuning knobs whose value must
+    NEVER reach decision state. A neutral knob may branch (enabling a
+    cross-check, a tracer, a drill) but the VALUE may not be stored
+    into decision-core objects, passed into decision-record
+    constructors, or used in sort keys. The engine proves this by
+    taint: accessor reads (`knobs.raw/flag/get`) of neutral knobs are
+    sources; attribute stores, program-class constructor arguments,
+    and sort keys in the decision core are sinks; branch tests are
+    exempt (that is what neutral knobs are FOR).
+  * `decision=GATE` — kill switches (and the drill/mutation arms) that
+    deliberately select between decision paths, each with its
+    registered gate sites (`gates=(path fragment, ...)`). The engine
+    enforces that a gate knob is read ONLY at its registered gate
+    points — a new read site elsewhere is a contract change that must
+    be declared, not an accident that silently widens the switch's
+    blast radius (and invalidates the A/B twin that certifies it).
+
+Three checks under one rule id (one suppression token covers the whole
+contract, mirroring KNOB01):
+
+  1. registry hygiene, on the analyzed `knobs.py` itself: every Knob
+     declares a valid decision; every kill-switch is a GATE; a GATE
+     registers at least one gate site; a NEUTRAL registers none;
+  2. gate discipline: an accessor call naming a GATE knob in a file
+     matching none of its registered gate fragments;
+  3. neutral flow: a NEUTRAL knob's value reaching decision state in
+     the decision core (taint through locals, intra-procedural, with
+     the source→sink path in the message).
+
+Like KNOB01, the registry is recovered from the ANALYZED knobs.py when
+present (fixtures can carry their own), else parsed once from the
+package's own copy on disk — import-free either way.
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from kueue_tpu.analysis.core import (
+    AnalysisContext, Finding, Rule, Severity, SourceFile, dotted_name,
+    finding, register)
+from kueue_tpu.analysis.det_rules import (
+    _CallerLike, _functions, _in_scope, _self_name, DECISION_CORE)
+from kueue_tpu.analysis.flow_rules import _Program
+from kueue_tpu.analysis.knob_rules import (
+    _accessor_calls, _is_registry_file)
+
+_TNT_PATHS = tuple(f"{d}/" for d in DECISION_CORE) + ("fixtures/lint/",)
+
+NEUTRAL = "neutral"
+GATE = "gate"
+
+
+class _Contract:
+    """One knob's decision contract as declared in the registry."""
+
+    __slots__ = ("name", "kind", "decision", "gates", "line")
+
+    def __init__(self, name: str, kind: Optional[str],
+                 decision: Optional[str], gates: Tuple[str, ...],
+                 line: int):
+        self.name = name
+        self.kind = kind
+        self.decision = decision
+        self.gates = gates
+        self.line = line
+
+
+def _module_str_constants(tree: ast.Module) -> Dict[str, str]:
+    """NAME -> value for module-level `NAME = "literal"` assigns, so
+    `decision=GATE` resolves without importing the module."""
+    out: Dict[str, str] = {}
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and isinstance(node.value, ast.Constant) \
+                and isinstance(node.value.value, str):
+            out[node.targets[0].id] = node.value.value
+    return out
+
+
+def _const_str(node: ast.AST, consts: Dict[str, str]) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    if isinstance(node, ast.Name):
+        return consts.get(node.id)
+    return None
+
+
+def _registry_contracts(tree: ast.Module
+                        ) -> Optional[List[_Contract]]:
+    """Decision contracts per Knob(...) inside a REGISTRY assignment,
+    or None when the module declares no REGISTRY."""
+    consts = _module_str_constants(tree)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets = [node.target]
+        else:
+            continue
+        if not any(isinstance(t, ast.Name) and t.id == "REGISTRY"
+                   for t in targets):
+            continue
+        out: List[_Contract] = []
+        for call in ast.walk(node.value):
+            if not isinstance(call, ast.Call):
+                continue
+            cname = dotted_name(call.func)
+            if cname is None or cname.split(".")[-1] != "Knob":
+                continue
+            name = kind = decision = None
+            gates: Tuple[str, ...] = ()
+            pos = ["name", "kind"]
+            for i, arg in enumerate(call.args[:2]):
+                v = _const_str(arg, consts)
+                if pos[i] == "name":
+                    name = v
+                else:
+                    kind = v
+            for kw in call.keywords:
+                if kw.arg == "name":
+                    name = _const_str(kw.value, consts)
+                elif kw.arg == "kind":
+                    kind = _const_str(kw.value, consts)
+                elif kw.arg == "decision":
+                    decision = _const_str(kw.value, consts)
+                elif kw.arg == "gates" \
+                        and isinstance(kw.value, (ast.Tuple, ast.List)):
+                    gates = tuple(
+                        g for g in (_const_str(e, consts)
+                                    for e in kw.value.elts)
+                        if g is not None)
+            if name is not None:
+                out.append(_Contract(name, kind, decision, gates,
+                                     call.lineno))
+        return out
+    return None
+
+
+_PACKAGE_CONTRACTS: Optional[Dict[str, _Contract]] = None
+
+
+def _package_contracts() -> Dict[str, _Contract]:
+    global _PACKAGE_CONTRACTS
+    if _PACKAGE_CONTRACTS is None:
+        path = Path(__file__).resolve().parent.parent / "knobs.py"
+        try:
+            tree = ast.parse(path.read_text(encoding="utf-8"))
+            entries = _registry_contracts(tree) or []
+        except (OSError, SyntaxError):
+            entries = []
+        _PACKAGE_CONTRACTS = {c.name: c for c in entries}
+    return _PACKAGE_CONTRACTS
+
+
+# ---------------------------------------------------------------------------
+# Check 1 — registry hygiene
+# ---------------------------------------------------------------------------
+
+
+def _registry_findings(f: SourceFile,
+                       entries: List[_Contract]) -> Iterable[Finding]:
+    for c in entries:
+        if c.decision is None:
+            yield _at(f, c.line,
+                      f"knob {c.name} declares no decision contract — "
+                      f"every knob is either decision={NEUTRAL!r} (its "
+                      "value never reaches decision state) or "
+                      f"decision={GATE!r} with registered gate sites")
+            continue
+        if c.decision not in (NEUTRAL, GATE):
+            yield _at(f, c.line,
+                      f"knob {c.name}: decision {c.decision!r} is not "
+                      f"{NEUTRAL!r} or {GATE!r}")
+            continue
+        if c.kind == "kill-switch" and c.decision != GATE:
+            yield _at(f, c.line,
+                      f"knob {c.name} is a kill-switch but declares "
+                      f"decision={c.decision!r} — a kill switch "
+                      "selects between decision paths by definition; "
+                      "declare it a gate with its gate sites")
+        if c.decision == GATE and not c.gates:
+            yield _at(f, c.line,
+                      f"gate knob {c.name} registers no gate sites — "
+                      "list the path fragments where the switch is "
+                      "allowed to branch (gates=(...,))")
+        if c.decision == NEUTRAL and c.gates:
+            yield _at(f, c.line,
+                      f"neutral knob {c.name} registers gate sites — "
+                      "a neutral knob gates nothing; drop gates= or "
+                      "declare it a gate")
+
+
+def _at(f: SourceFile, line: int, message: str) -> Finding:
+    return Finding(rule=TNT01.id, severity=TNT01.severity,
+                   path=f.display_path, line=line, col=0,
+                   message=message)
+
+
+# ---------------------------------------------------------------------------
+# Check 3 — neutral-knob value flow (intra-procedural taint)
+# ---------------------------------------------------------------------------
+
+
+class _KnobTaint:
+    __slots__ = ("knob", "line", "hops")
+
+    def __init__(self, knob: str, line: int,
+                 hops: Optional[List[str]] = None):
+        self.knob = knob
+        self.line = line
+        self.hops = hops or []
+
+    def via(self, hop: str) -> "_KnobTaint":
+        hops = self.hops + [hop]
+        return _KnobTaint(self.knob, self.line, hops[-6:])
+
+    def render(self) -> str:
+        return " -> ".join(
+            [f"knobs read of {self.knob} (line {self.line})"]
+            + self.hops)
+
+
+def _neutral_read(node: ast.AST, neutral: Set[str],
+                  bare: Set[str]) -> Optional[str]:
+    """Knob name when `node` is an accessor call reading a neutral
+    knob with a literal name."""
+    if not isinstance(node, ast.Call) or not node.args:
+        return None
+    name = dotted_name(node.func)
+    if name is None:
+        return None
+    parts = name.split(".")
+    qualified = (len(parts) >= 2 and parts[-2] == "knobs"
+                 and parts[-1] in ("raw", "flag", "get"))
+    if not qualified and name not in bare:
+        return None
+    arg = node.args[0]
+    if isinstance(arg, ast.Constant) and isinstance(arg.value, str) \
+            and arg.value in neutral:
+        return arg.value
+    return None
+
+
+def _bare_accessors(tree: ast.Module) -> Set[str]:
+    out: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) \
+                and node.module == "kueue_tpu.knobs":
+            for a in node.names:
+                if a.name in ("raw", "flag", "get"):
+                    out.add(a.asname or a.name)
+    return out
+
+
+class _NeutralPass:
+    """Taint env for one function: locals carrying neutral-knob values."""
+
+    def __init__(self, fn: ast.AST, neutral: Set[str],
+                 bare: Set[str]):
+        self.fn = fn
+        self.neutral = neutral
+        self.bare = bare
+        self.env: Dict[str, _KnobTaint] = {}
+
+    def taint_of(self, node: ast.AST) -> Optional[_KnobTaint]:
+        knob = _neutral_read(node, self.neutral, self.bare)
+        if knob is not None:
+            return _KnobTaint(knob, node.lineno)
+        if isinstance(node, ast.Name):
+            return self.env.get(node.id)
+        if isinstance(node, ast.BinOp):
+            return self.taint_of(node.left) or self.taint_of(node.right)
+        if isinstance(node, ast.UnaryOp):
+            return self.taint_of(node.operand)
+        if isinstance(node, ast.IfExp):
+            # only VALUE positions taint; the test is a branch (exempt)
+            return self.taint_of(node.body) or self.taint_of(node.orelse)
+        if isinstance(node, ast.Call):
+            # int(env) / float(env) conversions keep the taint
+            leaf = (dotted_name(node.func) or "").rsplit(".", 1)[-1]
+            if leaf in ("int", "float", "str", "bool") and node.args:
+                return self.taint_of(node.args[0])
+            return None
+        if isinstance(node, (ast.List, ast.Tuple, ast.Set)):
+            for e in node.elts:
+                t = self.taint_of(e)
+                if t is not None:
+                    return t.via("carried in a container literal")
+            return None
+        return None
+
+    def run_env(self) -> None:
+        for _ in range(2):
+            for node in ast.walk(self.fn):
+                if isinstance(node, ast.Assign):
+                    t = self.taint_of(node.value)
+                    if t is None:
+                        continue
+                    for target in node.targets:
+                        if isinstance(target, ast.Name):
+                            self.env[target.id] = t.via(
+                                f"assigned to `{target.id}` at line "
+                                f"{node.lineno}")
+                elif isinstance(node, ast.AnnAssign) \
+                        and node.value is not None \
+                        and isinstance(node.target, ast.Name):
+                    t = self.taint_of(node.value)
+                    if t is not None:
+                        self.env[node.target.id] = t.via(
+                            f"assigned to `{node.target.id}` at line "
+                            f"{node.lineno}")
+
+
+def _neutral_flow_findings(f: SourceFile, neutral: Set[str],
+                           prog: _Program) -> Iterable[Finding]:
+    bare = _bare_accessors(f.tree)
+    for cls, fn in _functions(f.tree):
+        self_name = _self_name(fn, cls)
+        np = _NeutralPass(fn, neutral, bare)
+        np.run_env()
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign):
+                for target in node.targets:
+                    if not isinstance(target, ast.Attribute):
+                        continue
+                    t = np.taint_of(node.value)
+                    if t is None:
+                        continue
+                    recv = dotted_name(target.value) or "<expr>"
+                    yield finding(
+                        TNT01, f, node,
+                        f"neutral knob value reaches decision state: "
+                        f"{t.render()} -> stored to "
+                        f"`{recv}.{target.attr}` at line {node.lineno} "
+                        f"— {t.knob} is declared decision=neutral, so "
+                        "its VALUE must never persist in decision-core "
+                        "objects (branch on it instead, or declare the "
+                        "knob a gate with this site registered)")
+                    break
+            elif isinstance(node, ast.Call):
+                name = dotted_name(node.func)
+                leaf = (name or "").rsplit(".", 1)[-1]
+                if leaf[:1].isupper() and leaf in prog.classes:
+                    for arg in (list(node.args)
+                                + [k.value for k in node.keywords]):
+                        t = np.taint_of(arg)
+                        if t is not None:
+                            yield finding(
+                                TNT01, f, node,
+                                "neutral knob value reaches decision "
+                                f"state: {t.render()} -> `{leaf}(...)` "
+                                "constructor argument at line "
+                                f"{node.lineno} — {t.knob} is declared "
+                                "decision=neutral; decision records "
+                                "must not embed it")
+                            break
+                elif leaf in ("sorted", "sort", "min", "max"):
+                    for kw in node.keywords:
+                        if kw.arg != "key":
+                            continue
+                        for sub in ast.walk(kw.value):
+                            if isinstance(sub, (ast.Name, ast.Call)):
+                                t = np.taint_of(sub)
+                                if t is not None:
+                                    yield finding(
+                                        TNT01, f, node,
+                                        "neutral knob value reaches a "
+                                        f"sort key: {t.render()} -> "
+                                        "`key=` callable at line "
+                                        f"{node.lineno} — ordering on "
+                                        f"{t.knob} makes the trail a "
+                                        "function of an undeclared "
+                                        "decision input")
+                                    break
+
+
+# ---------------------------------------------------------------------------
+# Driver
+# ---------------------------------------------------------------------------
+
+
+def _check_tnt01(ctx: AnalysisContext) -> Iterable[Finding]:
+    registry_file = next(
+        (f for f in ctx.files
+         if _is_registry_file(f) and f.tree is not None
+         and _registry_contracts(f.tree) is not None), None)
+    if registry_file is not None:
+        entries = _registry_contracts(registry_file.tree) or []
+        contracts = {c.name: c for c in entries}
+        yield from _registry_findings(registry_file, entries)
+    else:
+        contracts = _package_contracts()
+
+    neutral = {name for name, c in contracts.items()
+               if c.decision == NEUTRAL}
+
+    for f in ctx.files:
+        if f.tree is None or f is registry_file:
+            continue
+        posix = f.path.as_posix()
+        # Check 2 — gate discipline, every analyzed file.
+        for knob, node, accessor in _accessor_calls(f):
+            c = contracts.get(knob)
+            if c is None or c.decision != GATE:
+                continue
+            if not any(frag in posix for frag in c.gates):
+                sites = ", ".join(c.gates) or "<none>"
+                yield finding(
+                    TNT01, f, node,
+                    f"gate knob {knob} is read outside its registered "
+                    f"gate sites ({sites}) — a new gate point widens "
+                    "the switch's blast radius and invalidates its A/B "
+                    "twin; register the site in knobs.py (gates=...) "
+                    "or route the behavior through an existing gate")
+        # Check 3 — neutral flow, decision core only.
+        if _in_scope(f, _TNT_PATHS, ctx) and neutral:
+            prog = _Program([f])
+            yield from _neutral_flow_findings(f, neutral, prog)
+
+
+TNT01 = register(Rule(
+    id="TNT01", severity=Severity.ERROR,
+    summary="knob decision contract: neutral-knob value reaching "
+            "decision state, or gate knob read off its registered "
+            "gate sites",
+    check=_check_tnt01, project=True, engine="det"))

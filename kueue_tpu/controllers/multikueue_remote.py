@@ -101,7 +101,7 @@ class HTTPRemote(RemoteClient):
     def connected(self) -> bool:
         # TTL anchor for the health-probe cache, not a latency
         # measurement — nothing for the tracer to aggregate.
-        now = time.monotonic()  # kueuelint: disable=OBS01
+        now = time.monotonic()
         if now - self._health_at < _HEALTH_CACHE_SECONDS:
             return self._health
         try:

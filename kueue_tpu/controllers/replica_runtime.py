@@ -429,7 +429,7 @@ class ReplicaWorker:
         # Wall-clock window bookkeeping (liveness evidence), not tick-
         # phase timing — the tracer may be disabled in a degraded
         # worker and the window must still measure.
-        self._degraded_t0 = _time.monotonic()  # kueuelint: disable=OBS01
+        self._degraded_t0 = _time.monotonic()
         REGISTRY.coordinator_degraded.set(self.host_id, value=1.0)
         self._djournal({"event": "enter",
                         "degraded_epoch": self.degraded_epoch,
@@ -450,7 +450,7 @@ class ReplicaWorker:
         self.degraded = False
         self.rctx.degraded = False
         REGISTRY.coordinator_degraded.set(self.host_id, value=0.0)
-        now = _time.monotonic()  # kueuelint: disable=OBS01
+        now = _time.monotonic()
         dur = now - (self._degraded_t0 or now)
         self._djournal({"event": "exit",
                         "degraded_epoch": self.degraded_epoch,
@@ -523,7 +523,7 @@ class ReplicaWorker:
         import time as _time
 
         was = self.degraded
-        now = _time.monotonic()  # kueuelint: disable=OBS01
+        now = _time.monotonic()
         dur = (now - self._degraded_t0) \
             if (was and self._degraded_t0) else 0.0
         if was:
@@ -1711,11 +1711,11 @@ class ReplicaRuntime:
               f"{addr[0]}:{addr[1]}; waiting for {n} workers to --join",
               file=sys.stderr, flush=True)
         # Join-wait deadline arithmetic, not tick-phase timing.
-        deadline = _time.monotonic() + timeout  # kueuelint: disable=OBS01
+        deadline = _time.monotonic() + timeout
         joined: List[tuple] = []  # (cid, chan, info)
         while len(joined) < n:
             remaining = deadline \
-                - _time.monotonic()  # kueuelint: disable=OBS01
+                - _time.monotonic()
             if remaining <= 0:
                 raise RuntimeError(
                     f"fleet join timed out: {len(joined)}/{n} workers "

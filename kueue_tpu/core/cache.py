@@ -141,7 +141,10 @@ class Cohort:
             from kueue_tpu import knobs
             if knobs.raw("KUEUE_TPU_FUZZ_MUTATION") == \
                     "unsorted-members":
-                sm = self._sorted_members = list(self.members)
+                # The armed oracle-mutation drill IS the PR 8 bug on
+                # purpose; DET01 catching this exact line is asserted by
+                # tests/test_det_taint.py (the static half of the drill).
+                sm = self._sorted_members = list(self.members)  # kueuelint: disable=DET01
             else:
                 sm = self._sorted_members = sorted(
                     self.members, key=lambda c: c.name)

@@ -203,7 +203,11 @@ class SnapshotMirror:
         # per-CQ tensor — reading the clamped cohort delta off the
         # arrays — instead of walking every pending item's usage dicts.
         self._admitted_view = None
-        self._arena_flush_forced = knobs.flag("KUEUE_TPU_ARENA_FLUSH")
+        # Startup capture of the rebuild-drill flag; it is only ever
+        # BRANCHED on (flush vs incremental apply), and the two paths
+        # are byte-identical by the arena A/B contract — the value never
+        # shapes a decision record.
+        self._arena_flush_forced = knobs.flag("KUEUE_TPU_ARENA_FLUSH")  # kueuelint: disable=TNT01
         # CQ names whose usage moved since the last refresh (fed by the
         # cache's dirty-sink hook) — the refresh visits only these.
         self._dirty: set = set()

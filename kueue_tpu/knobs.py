@@ -44,6 +44,19 @@ LIVE = "live"        # consulted at every decision point
 STARTUP = "startup"  # captured once at import or construction
 _READS = (LIVE, STARTUP)
 
+# The decision contract (checked statically by TNT01):
+#   * a NEUTRAL knob's VALUE never reaches decision state — it may
+#     branch (enable a tracer, a cross-check, a drill) but may not be
+#     stored into decision-core objects, passed into decision-record
+#     constructors, or used in sort keys;
+#   * a GATE knob deliberately selects between decision paths and is
+#     read ONLY at its registered gate sites (`gates=` path fragments)
+#     — a new read site is a declared contract change, never an
+#     accident that silently widens the switch's blast radius.
+NEUTRAL = "neutral"
+GATE = "gate"
+_DECISIONS = (NEUTRAL, GATE)
+
 
 @dataclass(frozen=True)
 class Knob:
@@ -52,6 +65,8 @@ class Knob:
     default: Optional[str]  # value the read site assumes when unset
     read: str
     doc: str
+    decision: str = ""            # NEUTRAL or GATE — required
+    gates: Tuple[str, ...] = ()   # path fragments of the gate sites
 
     def __post_init__(self):
         if not self.name.startswith("KUEUE_TPU_"):
@@ -60,94 +75,141 @@ class Knob:
             raise ValueError(f"knob {self.name}: kind {self.kind!r}")
         if self.read not in _READS:
             raise ValueError(f"knob {self.name}: read {self.read!r}")
+        if self.decision not in _DECISIONS:
+            raise ValueError(
+                f"knob {self.name}: decision {self.decision!r} "
+                f"(declare {NEUTRAL!r} or {GATE!r})")
+        if self.kind == KILL_SWITCH and self.decision != GATE:
+            raise ValueError(
+                f"knob {self.name}: a kill-switch selects between "
+                "decision paths by definition — declare decision=GATE")
+        if self.decision == GATE and not self.gates:
+            raise ValueError(
+                f"knob {self.name}: a gate knob must register its "
+                "gate sites (gates=(path fragment, ...))")
+        if self.decision == NEUTRAL and self.gates:
+            raise ValueError(
+                f"knob {self.name}: a neutral knob gates nothing — "
+                "drop gates= or declare decision=GATE")
 
 
 REGISTRY: Tuple[Knob, ...] = (
     # -- kill switches (feature reverts; each keeps an A/B twin) ------------
     Knob("KUEUE_TPU_NO_ARENA", KILL_SWITCH, "", LIVE,
          "=1 disables the incremental workload arena (from-scratch "
-         "encode every solve)."),
+         "encode every solve).",
+         decision=GATE, gates=("models/flavor_fit.py",)),
     Knob("KUEUE_TPU_NO_ADMIT_ARENA", KILL_SWITCH, "", LIVE,
          "=1 disables the admitted-workload arena (full re-encode of "
-         "admitted state)."),
+         "admitted state).",
+         decision=GATE, gates=("models/flavor_fit.py",)),
     Knob("KUEUE_TPU_NO_NOMINATE_CACHE", KILL_SWITCH, "", LIVE,
          "=1 disables the nominate cache (every head re-solved every "
-         "tick)."),
+         "tick).",
+         decision=GATE, gates=("models/flavor_fit.py",)),
     Knob("KUEUE_TPU_NO_DEVICE_FAIR", KILL_SWITCH, "", LIVE,
          "=1 restores the per-CQ host dict DRF walk instead of the "
-         "device fair-share stage."),
+         "device fair-share stage.",
+         decision=GATE, gates=("models/flavor_fit.py",)),
     Knob("KUEUE_TPU_NO_HETERO", KILL_SWITCH, "", LIVE,
          "=1 disables heterogeneity-aware scoring even when profiles "
-         "are loaded."),
+         "are loaded.",
+         decision=GATE, gates=("models/flavor_fit.py",)),
     Knob("KUEUE_TPU_NO_QUIET_TICK", KILL_SWITCH, "", LIVE,
          "=1 disables the quiescent-tick replay fast path (full "
-         "pipeline every tick)."),
+         "pipeline every tick).",
+         decision=GATE, gates=("scheduler/scheduler.py",)),
     Knob("KUEUE_TPU_NO_MICROTICK", KILL_SWITCH, "", LIVE,
-         "=1 disables event-driven micro-ticks between full ticks."),
+         "=1 disables event-driven micro-ticks between full ticks.",
+         decision=GATE, gates=("scheduler/scheduler.py",)),
     Knob("KUEUE_TPU_NO_EAGER_ENCODE", KILL_SWITCH, "", LIVE,
-         "=1 disables eager arena encode at the replica barrier."),
+         "=1 disables eager arena encode at the replica barrier.",
+         decision=GATE, gates=("controllers/replica_runtime.py",)),
     Knob("KUEUE_TPU_NO_SHARD", KILL_SWITCH, "", LIVE,
          "=1 forces single-device solves even when a cohort mesh is "
-         "available."),
+         "available.",
+         decision=GATE, gates=("models/flavor_fit.py",)),
     Knob("KUEUE_TPU_NO_REPLICA", KILL_SWITCH, "", STARTUP,
          "=1 forces the single-process runtime regardless of "
-         "KUEUE_TPU_REPLICAS."),
+         "KUEUE_TPU_REPLICAS.",
+         decision=GATE, gates=("controllers/replica_runtime.py",
+                               "kueue_tpu/__main__.py")),
     Knob("KUEUE_TPU_NO_SOCKET", KILL_SWITCH, "", STARTUP,
-         "=1 forbids the socket transport (pipe/queue loopback only)."),
+         "=1 forbids the socket transport (pipe/queue loopback only).",
+         decision=GATE, gates=("controllers/replica_runtime.py",)),
     Knob("KUEUE_TPU_NATIVE_HEAP", KILL_SWITCH, "1", STARTUP,
          "=0 disables the C++ keyed heap (pure-Python queue ordering); "
-         "opt-out, default on."),
+         "opt-out, default on.",
+         decision=GATE, gates=("queue/manager.py",)),
     # -- debug / test injection --------------------------------------------
     Knob("KUEUE_TPU_TRACE", DEBUG, "", STARTUP,
-         "=1 enables span tracing (Chrome trace-event export)."),
+         "=1 enables span tracing (Chrome trace-event export).",
+         decision=NEUTRAL),
     Knob("KUEUE_TPU_DEBUG_ARENA", DEBUG, "", STARTUP,
          "=1 cross-checks every arena row against a from-scratch "
-         "encode."),
+         "encode.",
+         decision=NEUTRAL),
     Knob("KUEUE_TPU_DEBUG_ADMIT_ARENA", DEBUG, "", STARTUP,
-         "=1 cross-checks the admitted arena against a full re-encode."),
+         "=1 cross-checks the admitted arena against a full re-encode.",
+         decision=NEUTRAL),
     Knob("KUEUE_TPU_DEBUG_DRIFT", DEBUG, "", STARTUP,
-         "=1 verifies the incremental usage drift against a recompute."),
+         "=1 verifies the incremental usage drift against a recompute.",
+         decision=NEUTRAL),
     Knob("KUEUE_TPU_DEBUG_FAIR", DEBUG, "", LIVE,
          "=1 cross-checks device fair-share preemption against the "
-         "host referee."),
+         "host referee.",
+         decision=NEUTRAL),
     Knob("KUEUE_TPU_DEBUG_HETERO", DEBUG, "", LIVE,
          "=1 cross-checks hetero scoring against the NumPy twin per "
-         "solve."),
+         "solve.",
+         decision=NEUTRAL),
     Knob("KUEUE_TPU_ARENA_FLUSH", DEBUG, "", LIVE,
          "=1 flushes the arena every snapshot (drills the rebuild "
-         "path)."),
+         "path).",
+         decision=NEUTRAL),
     Knob("KUEUE_TPU_FUZZ_MUTATION", DEBUG, None, LIVE,
          "Arms an env-gated oracle mutation (e.g. unsorted-cohort-walk) "
-         "for the fuzzer self-test."),
+         "for the fuzzer self-test.",
+         decision=GATE, gates=("core/cache.py", "queue/manager.py")),
     Knob("KUEUE_TPU_FAULTS", DEBUG, None, STARTUP,
          "Packet-fault plan for the socket transport "
-         "(drop_p=..,delay_ms=..,seed=..)."),
+         "(drop_p=..,delay_ms=..,seed=..).",
+         decision=NEUTRAL),
     Knob("KUEUE_TPU_DISK_FAULTS", DEBUG, None, STARTUP,
          "Disk-fault plan for the durable journals "
-         "(enospc_p=..,fsync_p=..,torn_p=..,seed=..)."),
+         "(enospc_p=..,fsync_p=..,torn_p=..,seed=..).",
+         decision=NEUTRAL),
     # -- tuning -------------------------------------------------------------
     Knob("KUEUE_TPU_REPLICAS", TUNING, "0", STARTUP,
          "Replica count for the multi-process runtime (0/unset = "
-         "single process)."),
+         "single process).",
+         decision=NEUTRAL),
     Knob("KUEUE_TPU_TRANSPORT", TUNING, "", STARTUP,
          "Replica channel transport: pipe, queue, or socket (unset = "
-         "per-mode default)."),
+         "per-mode default).",
+         decision=NEUTRAL),
     Knob("KUEUE_TPU_SHARDS", TUNING, "", LIVE,
-         "Cohort-mesh shard count override (unset = device count)."),
+         "Cohort-mesh shard count override (unset = device count).",
+         decision=GATE, gates=("models/flavor_fit.py",)),
     Knob("KUEUE_TPU_HETERO", TUNING, "", LIVE,
          "=1 opts the packed solver into hetero scoring when profiles "
-         "exist."),
+         "exist.",
+         decision=GATE, gates=("models/flavor_fit.py",)),
     Knob("KUEUE_TPU_ROUND_TIMEOUT", TUNING, "60", STARTUP,
-         "Replica barrier round timeout in seconds."),
+         "Replica barrier round timeout in seconds.",
+         decision=NEUTRAL),
     Knob("KUEUE_TPU_BARRIER_DEADLINE", TUNING, "", STARTUP,
          "Barrier-stall watchdog deadline in seconds (unset = derived "
-         "from the round timeout)."),
+         "from the round timeout).",
+         decision=NEUTRAL),
     Knob("KUEUE_TPU_CSR_ASSUME", TUNING, "", LIVE,
          "Pre-seeds the cohort-state-root cache (advanced: skips the "
-         "first-tick probe)."),
+         "first-tick probe).",
+         decision=GATE, gates=("scheduler/scheduler.py",)),
     Knob("KUEUE_TPU_DURABLE_FSYNC", TUNING, "", STARTUP,
          "=1 fsyncs every journal append (durability over append "
-         "latency)."),
+         "latency).",
+         decision=NEUTRAL),
 )
 
 _BY_NAME: Dict[str, Knob] = {k.name: k for k in REGISTRY}
@@ -177,10 +239,10 @@ def flag(name: str) -> bool:
 def markdown_table() -> str:
     """The README knob table, generated from the registry (checked
     against the README in CI so the docs cannot drift)."""
-    lines = ["| Knob | Kind | Default | Read | What it does |",
-             "| --- | --- | --- | --- | --- |"]
+    lines = ["| Knob | Kind | Default | Read | Decision | What it does |",
+             "| --- | --- | --- | --- | --- | --- |"]
     for k in REGISTRY:
         default = "_unset_" if k.default in (None, "") else f"`{k.default}`"
         lines.append(f"| `{k.name}` | {k.kind} | {default} | {k.read} "
-                     f"| {k.doc} |")
+                     f"| {k.decision} | {k.doc} |")
     return "\n".join(lines)

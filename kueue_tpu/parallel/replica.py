@@ -390,9 +390,13 @@ class Coordinator:
                     needed.add(node)
                     cspec = self._cohort_specs.get(node)
                     node = cspec.parent if cspec is not None else ""
-        for name in needed:
+        # Sorted walk: `needed` accumulates in ancestor-chain discovery
+        # order (a set), but `parent.children` ordering feeds the
+        # balance walk — keep it a function of the names, not of set
+        # iteration order.
+        for name in sorted(needed):
             get_node(name)
-        for name in needed:
+        for name in sorted(needed):
             node = nodes[name]
             if node.spec is not None and node.spec.parent:
                 parent = get_node(node.spec.parent)

@@ -1,0 +1,69 @@
+# Fixture: determinism-engine violations (DET01 unordered iteration,
+# DET02 wall-clock/randomness into decision state) — each marked line
+# is pinned by tests/test_det_taint.py. The disciplined twin is
+# det_good.py.
+import os
+import random
+import time
+from typing import Dict, List, Optional, Set
+
+
+class Workload:
+    def __init__(self, name: str, priority: int):
+        self.name = name
+        self.priority = priority
+
+
+class Condition:
+    def __init__(self, kind: str, stamp: float):
+        self.kind = kind
+        self.stamp = stamp
+
+
+class Cohort:
+    def __init__(self):
+        self.members: Set[Workload] = set()
+        self.by_workload: Dict[Workload, int] = {}
+        self.children: List["Cohort"] = []
+
+    def victim_walk(self) -> List[Workload]:
+        # DET01: the PR 8 revert shape — an identity-hashed set
+        # materialized into an arbitrarily-ordered list that escapes.
+        return list(self.members)                        # line 32: DET01
+
+    def first_member(self) -> Workload:
+        # DET01: next(iter(set)) picks whichever element hashes first.
+        return next(iter(self.members))                  # line 36: DET01
+
+    def collect(self) -> List[str]:
+        out: List[str] = []
+        # DET01: order-sensitive loop body (append) over the raw set.
+        for wl in self.members:                          # line 41: DET01
+            out.append(wl.name)
+        return out
+
+    def usage_rows(self) -> List[int]:
+        # DET01: list comprehension over an object-keyed dict's values.
+        return [v for v in self.by_workload.values()]    # line 47: DET01
+
+    def stamp_admission(self, wl: Workload) -> Condition:
+        # DET02: the PR 9 shape — wall clock into a decision record.
+        return Condition("Admitted", time.time())        # line 51: DET02
+
+    def tiebreak(self, wls: List[Workload]) -> List[Workload]:
+        # DET02: randomness inside a sort key.
+        return sorted(wls, key=lambda w: random.random())  # line 55: DET02
+
+
+def spill_listing(root: str) -> List[str]:
+    # DET01: readdir order is filesystem-arbitrary; returning it raw
+    # makes the caller's walk nondeterministic across hosts.
+    return os.listdir(root)                              # line 61: DET01
+
+
+def stamp_via_local(wl: Workload) -> Condition:
+    # DET02: taint through a local assignment still reaches the
+    # constructor — the finding carries the full source->sink path.
+    now = time.monotonic()
+    elapsed = now + 5.0
+    return Condition("Requeued", elapsed)                # line 69: DET02

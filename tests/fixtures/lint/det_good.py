@@ -1,0 +1,68 @@
+# Fixture: the disciplined twin of det_bad.py — every unordered source
+# is sorted, reduced, or membership-tested before its order could reach
+# decision state, and every timestamp comes from the injected clock or
+# a seeded PRNG. Must produce ZERO det-engine findings.
+import os
+import random
+import time
+from typing import Callable, Dict, List, Set
+
+
+class Workload:
+    def __init__(self, name: str, priority: int):
+        self.name = name
+        self.priority = priority
+
+
+class Condition:
+    def __init__(self, kind: str, stamp: float):
+        self.kind = kind
+        self.stamp = stamp
+
+
+class Cohort:
+    def __init__(self, clock: Callable[[], float] = time.time):
+        # An attribute REFERENCE as the injectable default is the
+        # sanctioned TickClock seam — not a call, so never a source.
+        self._clock = clock
+        self.members: Set[Workload] = set()
+        self.by_workload: Dict[Workload, int] = {}
+        self.names: Set[str] = set()
+
+    def victim_walk(self) -> List[Workload]:
+        # Sanitized: name-keyed sort before the order can matter.
+        return sorted(self.members, key=lambda w: w.name)
+
+    def total_priority(self) -> int:
+        # Reductions are order-insensitive.
+        return sum(w.priority for w in self.members)
+
+    def has(self, wl: Workload) -> bool:
+        # Membership tests never observe iteration order.
+        return wl in self.members
+
+    def usage_total(self) -> int:
+        return sum(self.by_workload.values())
+
+    def rebuild(self) -> Set[str]:
+        # Set-to-set rebuilds stay unordered (no order observed).
+        return {w.name for w in self.members}
+
+    def stamp_admission(self, wl: Workload) -> Condition:
+        # Stamps come from the INJECTED clock, not the wall.
+        return Condition("Admitted", self._clock())
+
+    def tiebreak(self, wls: List[Workload]) -> List[Workload]:
+        # Stable field keys; no wall-clock, no randomness.
+        return sorted(wls, key=lambda w: (w.priority, w.name))
+
+
+def spill_listing(root: str) -> List[str]:
+    # Directory listings are sorted at the boundary.
+    return sorted(os.listdir(root))
+
+
+def jittered_backoff(seed: int) -> float:
+    # Seeded PRNG instances are the sanctioned randomness path.
+    rng = random.Random(seed)
+    return rng.uniform(0.5, 1.5)

@@ -1,0 +1,36 @@
+# Fixture: knob decision-contract violations (TNT01) — a gate knob read
+# off its registered gate sites, and neutral-knob values reaching
+# decision state (attribute store, decision-record constructor, sort
+# key). Knob names are REAL registry entries: the rule resolves their
+# contracts from the package registry when the analyzed set carries no
+# knobs.py of its own. The disciplined twin is taint_good.py.
+from typing import List, Optional
+
+from kueue_tpu import knobs
+
+
+class AdmissionRecord:
+    def __init__(self, name: str, debug_tag: Optional[str]):
+        self.name = name
+        self.debug_tag = debug_tag
+
+
+class TickState:
+    def __init__(self):
+        # TNT01: KUEUE_TPU_NO_ARENA gates at models/flavor_fit.py only;
+        # reading it here is an unregistered gate point.
+        self.arena_off = knobs.flag("KUEUE_TPU_NO_ARENA")  # line 22: TNT01 (gate)
+        # TNT01: a neutral knob's VALUE persisted into decision-core
+        # state (branching on it would be fine; storing it is not).
+        self.debug_fair = knobs.raw("KUEUE_TPU_DEBUG_FAIR")  # line 25: TNT01 (neutral store)
+
+    def record(self, name: str) -> AdmissionRecord:
+        # TNT01: neutral knob value embedded in a decision record.
+        tag = knobs.raw("KUEUE_TPU_TRACE")
+        return AdmissionRecord(name, tag)                # line 30: TNT01 (neutral ctor)
+
+    def order(self, names: List[str]) -> List[str]:
+        # TNT01: neutral knob value inside a sort key.
+        return sorted(
+            names,
+            key=lambda n: (knobs.raw("KUEUE_TPU_DEBUG_HETERO"), n))  # line 34: TNT01 (neutral key)

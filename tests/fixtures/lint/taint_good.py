@@ -1,0 +1,38 @@
+# Fixture: the disciplined twin of taint_bad.py — neutral knobs only
+# ever BRANCH (enabling a cross-check or a tracer), their values never
+# persist into decision state, and no gate knob is read outside its
+# registered sites. Must produce ZERO det-engine findings.
+from typing import List, Optional
+
+from kueue_tpu import knobs
+
+
+class AdmissionRecord:
+    def __init__(self, name: str, debug_tag: Optional[str]):
+        self.name = name
+        self.debug_tag = debug_tag
+
+
+class TickState:
+    def __init__(self):
+        self.cross_check_ran = False
+
+    def maybe_cross_check(self, result: int, referee: int) -> None:
+        # Branching on a neutral knob is exactly what neutral knobs are
+        # for — the VALUE dies at the test.
+        if knobs.flag("KUEUE_TPU_DEBUG_FAIR"):
+            assert result == referee
+            self.cross_check_ran = True
+
+    def record(self, name: str) -> AdmissionRecord:
+        # Decision records carry decision inputs only.
+        return AdmissionRecord(name, None)
+
+    def order(self, names: List[str]) -> List[str]:
+        # Stable, knob-free sort key.
+        return sorted(names, key=lambda n: n)
+
+    def trace_enabled(self) -> bool:
+        # Returning the flag for a BRANCH decision elsewhere is fine —
+        # nothing here stores it into decision-core state.
+        return knobs.flag("KUEUE_TPU_TRACE")

@@ -1,0 +1,205 @@
+"""det engine (DET01/DET02/TNT01) — the static twin of the fuzzer.
+
+Four layers:
+
+  * fixture pins — det_bad/taint_bad produce EXACTLY the marked
+    (rule, line) sets, the good twins produce nothing;
+  * the static half of the oracle-mutation drill — the same
+    `unsorted-members` mutation the fuzz campaign catches dynamically
+    (tests/test_fuzz_corpus) is caught by DET01 on the mutated SOURCE,
+    no campaign required;
+  * the package gate — `--engine det` over kueue_tpu/ reports zero
+    errors (the det analog of test_kueuelint's headline test);
+  * roster sync — every top-level package entry is classified in
+    exactly one of DECISION_CORE / CLOCK_SENSITIVE / NON_DECISION, so
+    adding a module forces an explicit determinism-scope decision.
+"""
+
+from __future__ import annotations
+
+import subprocess
+import sys
+from pathlib import Path
+
+from kueue_tpu import knobs
+from kueue_tpu.analysis import Severity, run_analysis
+from kueue_tpu.analysis.det_rules import (
+    CLOCK_SENSITIVE, DECISION_CORE, NON_DECISION)
+
+REPO = Path(__file__).resolve().parent.parent
+PACKAGE = REPO / "kueue_tpu"
+FIXTURES = Path(__file__).resolve().parent / "fixtures" / "lint"
+
+
+def _det(path, **kw):
+    return run_analysis([str(path)], engine="det", **kw)
+
+
+def _pins(findings):
+    return {(f.rule, f.line) for f in findings}
+
+
+# ---------------------------------------------------------------------------
+# Fixture pairs: exact finding sets
+# ---------------------------------------------------------------------------
+
+
+def test_det_bad_fixture_exact_findings():
+    findings = _det(FIXTURES / "det_bad.py")
+    assert _pins(findings) == {
+        ("DET01", 32),   # list(self.members) escapes via return
+        ("DET01", 36),   # next(iter(set)) arbitrary pick
+        ("DET01", 41),   # order-sensitive loop body (append)
+        ("DET01", 47),   # comprehension over object-keyed dict values
+        ("DET02", 51),   # wall clock into a Condition stamp
+        ("DET02", 55),   # randomness inside a sort key
+        ("DET01", 61),   # raw os.listdir escapes via return
+        ("DET02", 69),   # clock taint through two local assignments
+    }
+
+
+def test_det02_finding_carries_source_to_sink_path():
+    findings = _det(FIXTURES / "det_bad.py")
+    (through_locals,) = [f for f in findings if f.line == 69]
+    # The report narrates the full hop chain, not just the sink.
+    assert "`time.monotonic()` (line 67)" in through_locals.message
+    assert "assigned to `now` at line 67" in through_locals.message
+    assert "assigned to `elapsed` at line 68" in through_locals.message
+    assert "constructor argument at line 69" in through_locals.message
+
+
+def test_det_good_fixture_clean():
+    assert _det(FIXTURES / "det_good.py") == []
+
+
+def test_taint_bad_fixture_exact_findings():
+    findings = _det(FIXTURES / "taint_bad.py")
+    assert _pins(findings) == {
+        ("TNT01", 22),   # gate knob read off its registered sites
+        ("TNT01", 25),   # neutral knob value stored on decision state
+        ("TNT01", 30),   # neutral knob value into a decision record
+        ("TNT01", 34),   # neutral knob value inside a sort key
+    }
+    msgs = "\n".join(f.message for f in findings)
+    # Contracts resolve from the real package registry: the gate report
+    # names the registered site, the neutral reports name the knob.
+    assert "models/flavor_fit.py" in msgs
+    assert "KUEUE_TPU_TRACE" in msgs
+    assert "KUEUE_TPU_DEBUG_FAIR" in msgs
+
+
+def test_taint_good_fixture_clean():
+    assert _det(FIXTURES / "taint_good.py") == []
+
+
+# ---------------------------------------------------------------------------
+# The static half of the oracle-mutation drill
+# ---------------------------------------------------------------------------
+
+_SORTED_WALK = (
+    "sm = self._sorted_members = sorted(\n"
+    "                    self.members, key=lambda c: c.name)")
+_MUTATED_WALK = "sm = self._sorted_members = list(self.members)"
+
+
+def test_unsorted_members_mutation_caught_statically(tmp_path):
+    """KUEUE_TPU_FUZZ_MUTATION=unsorted-members takes a bounded fuzz
+    campaign to catch dynamically (test_fuzz_corpus); applying the same
+    mutation to the SOURCE is caught by DET01 in one analyzer pass."""
+    src = (PACKAGE / "core" / "cache.py").read_text(encoding="utf-8")
+    mutated = src.replace(_SORTED_WALK, _MUTATED_WALK)
+    assert mutated != src, \
+        "the sorted_members() walk moved in core/cache.py — update the " \
+        "static half of the unsorted-members drill"
+
+    core = tmp_path / "core"
+    core.mkdir()
+    target = core / "cache.py"
+
+    # Control: the shipped source is det-clean (the armed drill branch
+    # carries its justification suppression).
+    target.write_text(src, encoding="utf-8")
+    assert _det(tmp_path) == []
+
+    # Mutated: the PR 8 revert fires DET01, pointing at the raw walk.
+    target.write_text(mutated, encoding="utf-8")
+    findings = _det(tmp_path)
+    assert [f.rule for f in findings] == ["DET01"]
+    (f,) = findings
+    assert "self.members" in f.message
+    assert "PR 8" in f.message
+    assert f.line == src[:src.index(_SORTED_WALK)].count("\n") + 1
+
+
+# ---------------------------------------------------------------------------
+# Package gate and CLI plumbing
+# ---------------------------------------------------------------------------
+
+
+def test_package_det_engine_zero_errors():
+    findings = _det(PACKAGE)
+    errors = [f for f in findings if f.severity == Severity.ERROR]
+    report = "\n".join(f.render() for f in errors)
+    assert not errors, f"det-engine errors in kueue_tpu/:\n{report}"
+
+
+def test_det_wide_extends_roster_beyond_decision_core(tmp_path):
+    # Outside the decision-core roster the engine stays quiet by
+    # default; --det-wide (nightly) analyzes everything.
+    mod = tmp_path / "helpers.py"
+    mod.write_text((FIXTURES / "det_bad.py").read_text(encoding="utf-8"),
+                   encoding="utf-8")
+    assert _det(tmp_path) == []
+    assert _det(tmp_path, options={"det_wide": True}) != []
+    proc = subprocess.run(
+        [sys.executable, "-m", "kueue_tpu.analysis", "--engine", "det",
+         "--det-wide", str(tmp_path)],
+        cwd=str(REPO), capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 1
+    assert "DET01" in proc.stdout and "DET02" in proc.stdout
+
+
+# ---------------------------------------------------------------------------
+# Rosters and the knob decision contract stay in sync with reality
+# ---------------------------------------------------------------------------
+
+
+def test_decision_rosters_cover_package_exactly():
+    entries = set()
+    for p in sorted(PACKAGE.iterdir()):
+        if p.name == "__pycache__":
+            continue
+        if p.is_dir():
+            entries.add(p.name)
+        elif p.suffix == ".py":
+            entries.add(p.stem)
+
+    core, clock, non = set(DECISION_CORE), set(CLOCK_SENSITIVE), \
+        set(NON_DECISION)
+    assert not core & clock and not core & non and not clock & non, \
+        "determinism rosters overlap"
+    classified = core | clock | non
+    missing = entries - classified
+    stale = classified - entries
+    assert not missing, \
+        f"new top-level package entries need a determinism-scope call " \
+        f"(DECISION_CORE / CLOCK_SENSITIVE / NON_DECISION in " \
+        f"analysis/det_rules.py): {sorted(missing)}"
+    assert not stale, \
+        f"det_rules.py rosters name entries that left the package: " \
+        f"{sorted(stale)}"
+
+
+def test_every_registered_gate_site_exists():
+    # TNT01's gate discipline keys off path fragments in knobs.py; a
+    # renamed module would silently legalize reads everywhere.
+    paths = [p.as_posix() for p in PACKAGE.rglob("*.py")]
+    for k in knobs.REGISTRY:
+        assert k.decision in (knobs.NEUTRAL, knobs.GATE)
+        if k.kind == knobs.KILL_SWITCH:
+            assert k.decision == knobs.GATE, \
+                f"{k.name}: kill-switches are decision gates by definition"
+        for frag in k.gates:
+            assert any(frag in p for p in paths), \
+                f"{k.name} registers gate site {frag!r} which matches " \
+                f"no file under kueue_tpu/"
