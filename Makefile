@@ -10,8 +10,8 @@
 PYTHON ?= python
 
 .PHONY: help test test-fast bench bench-smoke trace-smoke multichip-smoke \
-	replica-smoke multihost-smoke fleet-smoke hetero-smoke fuzz-smoke \
-	fuzz-nightly fuzz-soak twin-smoke native lint verify-static \
+	replica-smoke multihost-smoke fleet-smoke hetero-smoke ingest-smoke \
+	fuzz-smoke fuzz-nightly fuzz-soak twin-smoke native lint verify-static \
 	verify-det verify-threads verify-knobs knob-table install serve dryrun
 
 help:
@@ -46,6 +46,11 @@ help:
 	@echo "                      differential goldens on CPU host devices"
 	@echo "  make hetero-smoke   hetero solve-mode gates: churn goldens,"
 	@echo "                      referee identity, smoke-scale bench gain"
+	@echo "  make ingest-smoke   ingest-plane gates: batch-lane goldens,"
+	@echo "                      then the ingest bench config — sustained"
+	@echo "                      HTTP submit QPS (batch vs per-object),"
+	@echo "                      submit->admitted p99, and the mid-window"
+	@echo "                      snapshot-bootstrap rejoin drill"
 	@echo "  make replica-smoke  3-replica multi-process run on CPU:"
 	@echo "                      spawn-mode identity gate + fail-over"
 	@echo "                      drill + the replica bench config with"
@@ -116,10 +121,12 @@ bench-smoke:
 	  replica = METRIC_NAMES['replica']; \
 	  multihost = METRIC_NAMES['multihost']; \
 	  microtick = METRIC_NAMES['microtick']; \
+	  ingest = METRIC_NAMES['ingest']; \
 	  ratios = {m: l.get('arena_reuse_ratio') for m, l in by.items()}; \
 	  bad = {m: r for m, r in ratios.items() \
 	         if (r is None or r <= 0.9) and m not in (steady, replica, \
-	                                                  multihost, microtick)}; \
+	                                                  multihost, microtick, \
+	                                                  ingest)}; \
 	  assert not bad, f'arena_reuse_ratio <= 0.9: {bad}'; \
 	  rebuilds = {m: l.get('arena_full_rebuilds') for m, l in by.items()}; \
 	  assert not any(rebuilds.values()), f'full rebuilds in window: {rebuilds}'; \
@@ -252,6 +259,41 @@ hetero-smoke:
 	  print('hetero-smoke OK: gain', gain, \
 	        'overrides', rep['hetero_overrides'], \
 	        'steady dispatches', steady.get('solver_dispatches'))"
+
+# Million-user ingest-plane smoke: the batch-lane differential goldens
+# (batch vs per-object byte-identical decision trails, kill-switch A/B,
+# snapshot bootstrap == line replay), then the ingest bench config whose
+# in-process gates check sustained HTTP submit QPS (batch lane vs the
+# per-object baseline), submit->admitted p99, bounded RSS growth, and
+# the mid-window rejoin drill bootstrapping from a shipped snapshot in
+# under 10% of the journal history. Runs in CI next to bench-smoke so
+# the ingest seam cannot rot.
+ingest-smoke:
+	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_ingest.py -q
+	KUEUE_BENCH_SMOKE=1 KUEUE_BENCH_CONFIG=ingest JAX_PLATFORMS=cpu \
+	  $(PYTHON) bench.py > /tmp/kueue-ingest-smoke.jsonl
+	@cat /tmp/kueue-ingest-smoke.jsonl
+	$(PYTHON) -c "import json; \
+	  lines = [json.loads(l) for l in open('/tmp/kueue-ingest-smoke.jsonl') \
+	           if l.strip().startswith('{')]; \
+	  rep = lines[-1]; \
+	  assert rep['metric'] == 'submit_to_admitted_p99_ms', rep; \
+	  ratio = rep.get('ingest_batch_vs_per_object'); \
+	  assert ratio is not None and ratio > 1.2, \
+	    f'batch lane not beating the per-object baseline: {rep}'; \
+	  assert rep.get('ingest_qps_sustained', 0) > 0, rep; \
+	  assert rep.get('submit_to_admitted_p99_ms') is not None, rep; \
+	  assert rep.get('bootstrap_snapshot') is True, \
+	    f'rejoin did not bootstrap from a shipped snapshot: {rep}'; \
+	  hist = rep.get('bootstrap_history_lines', 0); \
+	  replay = rep.get('bootstrap_replay_lines'); \
+	  assert hist > 0 and replay is not None and replay < 0.10 * hist, \
+	    f'bootstrap replayed {replay} of {hist} journal lines: {rep}'; \
+	  print('ingest-smoke OK: qps', rep['ingest_qps_sustained'], \
+	        f'({ratio}x per-object), admit p99', \
+	        rep['submit_to_admitted_p99_ms'], 'ms, bootstrap', \
+	        f'{replay}/{hist} lines in', \
+	        rep.get('bootstrap_seconds'), 's')"
 
 # Cohort-mesh smoke on CPU host devices: the 8-shard dryrun (sharded
 # solve bitwise-equal to single-device, hierarchy + lending-clamp probes

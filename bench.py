@@ -867,6 +867,310 @@ def run_microtick_config(*, label, num_cqs, num_cohorts, num_flavors,
     return stats
 
 
+def run_ingest_config(*, label, num_cqs, total_submits, batch_size,
+                      seed=42, strict_gate=True):
+    """The million-user ingest plane bench: submit->admitted as a
+    measured streaming pipeline.
+
+    Three phases on the REAL serve-path lanes (Store + StoreAdapter,
+    not a direct Framework driver):
+
+      1. Sustained-QPS window — the same submission doc stream pushed
+         through (a) the per-object lane (decode -> create per doc,
+         exactly what KUEUE_TPU_NO_BATCH_INGEST=1 reverts to) and
+         (b) the batch lane (decode_workload_batch -> create_batch:
+         one validation sweep, one dirty-event flush). Records
+         `ingest_qps_sustained` and the ratio; full runs gate the
+         batch lane at >= 5x the per-object baseline AND >= 10k
+         submits/s, with RSS growth over the window bounded.
+      2. Admission latency — bursts land through the batch lane and
+         are admitted by dirty-cohort micro-ticks; records
+         `submit_to_admitted_p99_ms` (bounded in full runs).
+      3. Mid-window rejoin drill — a per-host replica deployment
+         churns workloads to grow journal history, a worker is killed
+         mid-window, and the rejoin must bootstrap from a shipped
+         compacted snapshot: `bootstrap_replay_lines` is gated below
+         10% of the journal history, `bootstrap_seconds` is the
+         takeover tick's wall time.
+    """
+    import tempfile
+
+    from kueue_tpu import knobs as knobs_mod
+    from kueue_tpu.api import serialization
+    from kueue_tpu.api.types import (ClusterQueue, FlavorQuotas,
+                                     LocalQueue, PodSet, ResourceFlavor,
+                                     ResourceGroup, Workload)
+    from kueue_tpu.config import Configuration, TPUSolverConfig
+    from kueue_tpu.controllers.replica_runtime import ReplicaRuntime
+    from kueue_tpu.controllers.runtime import Framework
+    from kueue_tpu.controllers.store import (
+        KIND_CLUSTER_QUEUE, KIND_LOCAL_QUEUE, KIND_RESOURCE_FLAVOR,
+        KIND_WORKLOAD, Store, StoreAdapter)
+    from kueue_tpu.models.flavor_fit import BatchSolver
+
+    t0 = time.perf_counter()
+    fw = Framework(batch_solver=BatchSolver(), config=Configuration(
+        tpu_solver=TPUSolverConfig(enable=False)))
+    fw.create_namespace("default", labels={})
+    store = Store()
+    StoreAdapter(store, fw)
+    store.create(KIND_RESOURCE_FLAVOR, ResourceFlavor.make("flavor-0"))
+    for i in range(num_cqs):
+        store.create(KIND_CLUSTER_QUEUE, ClusterQueue(
+            name=f"ing-cq-{i}", cohort=f"ing-pool-{i % 8}",
+            resource_groups=(ResourceGroup(
+                ("cpu",), (FlavorQuotas.make("flavor-0", cpu=64),)),)))
+        store.create(KIND_LOCAL_QUEUE, LocalQueue(
+            name=f"ing-lq-{i}", namespace="default",
+            cluster_queue=f"ing-cq-{i}"))
+    t_setup = time.perf_counter() - t0
+
+    # One encoded doc template; each submission doc differs only in
+    # metadata.name — the shape a burst of same-manifest users
+    # produces, and what the batch decoder's template-clone path is
+    # for. Built through encode() so the docs match the POST wire shape.
+    base = serialization.encode(KIND_WORKLOAD, Workload(
+        name="ing-proto", namespace="default", queue_name="ing-lq-0",
+        pod_sets=[PodSet.make("ps0", count=1, cpu=1)]))
+    base.pop("status", None)
+
+    def make_docs(n, start, prefix):
+        # Uniform within a submission batch (the queue rotates per
+        # chunk, not per doc): a burst of same-manifest users, the shape
+        # the template-clone decode and one-sweep validation are for.
+        docs = []
+        for i in range(start, start + n):
+            doc = json.loads(json.dumps(base))
+            doc["metadata"]["name"] = f"{prefix}-{i}"
+            doc["spec"]["queueName"] = \
+                f"ing-lq-{(i // batch_size) % num_cqs}"
+            docs.append(doc)
+        return docs
+
+    def drain():
+        """Delete every submitted workload between windows (untimed) so
+        each window starts from the same store/queue shape."""
+        for wl in store.list(KIND_WORKLOAD):
+            store.delete(KIND_WORKLOAD, f"{wl.namespace}/{wl.name}")
+        gc.collect()
+
+    # -- phase 1: sustained-QPS window ------------------------------------
+    # Both lanes measured at the REAL serve surface: HTTP POSTs against
+    # the API server on loopback over one keep-alive connection. The
+    # per-object baseline is what a client submitting N manifests
+    # individually pays (JSON parse, route, webhook, create, response —
+    # per object); the batch lane is ONE WorkloadList POST per
+    # `batch_size` docs landing through decode_workload_batch +
+    # create_batch.
+    import http.client
+    import socket
+
+    from kueue_tpu.server.api_server import APIServer
+
+    srv = APIServer(store, fw).start()
+    wl_path = ("/apis/kueue.x-k8s.io/v1beta1/namespaces/default/"
+               "workloads")
+    conn = http.client.HTTPConnection("127.0.0.1", srv.port)
+    conn.connect()
+    conn.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+
+    def post(payload):
+        conn.request("POST", wl_path, json.dumps(payload).encode(),
+                     {"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        body = resp.read()
+        if resp.status != 201:
+            raise RuntimeError(
+                f"[{label}] ingest POST failed ({resp.status}): "
+                f"{body[:300]!r}")
+
+    try:
+        # Per-object baseline: a fraction of the batch total is enough
+        # for a stable rate — the per-POST loop is the slow side.
+        n_base = max(total_submits // 8, 512)
+        docs = make_docs(n_base, 0, "po")
+        t = time.perf_counter()
+        for doc in docs:
+            post(doc)
+        qps_base = n_base / (time.perf_counter() - t)
+        drain()
+
+        rss_before = _rss_mb()
+        docs = make_docs(total_submits, 0, "bl")
+        t = time.perf_counter()
+        for i in range(0, total_submits, batch_size):
+            post({"apiVersion": "kueue.x-k8s.io/v1beta1",
+                  "kind": "WorkloadList",
+                  "items": docs[i:i + batch_size]})
+        qps_batch = total_submits / (time.perf_counter() - t)
+        rss_growth = _rss_mb() - rss_before
+        drain()
+    finally:
+        conn.close()
+        srv.stop()
+    ratio = qps_batch / qps_base if qps_base else None
+    if ratio is not None and ratio < (5.0 if strict_gate else 1.2):
+        raise RuntimeError(
+            f"[{label}] batch ingest lane at {qps_batch:,.0f} submits/s "
+            f"is only {ratio:.2f}x the per-object baseline "
+            f"({qps_base:,.0f}/s) — the one-pass decode/validate/flush "
+            "lane is not paying for itself")
+    if strict_gate and qps_batch < 10_000:
+        raise RuntimeError(
+            f"[{label}] sustained batch ingest {qps_batch:,.0f} "
+            "submits/s is below the 10k/s target")
+    if strict_gate and rss_growth > 2048:
+        raise RuntimeError(
+            f"[{label}] RSS grew {rss_growth:.0f}MB over the sustained "
+            "window — the ingest path is not bounded")
+
+    # -- phase 2: submit->admitted over the batch lane --------------------
+    submit_t = {}
+    admit_t = {}
+    orig_apply = fw.scheduler.apply_admission
+
+    def apply_admission(wl):
+        ok = orig_apply(wl)
+        if ok and wl.key in submit_t:
+            admit_t[wl.key] = time.perf_counter()
+        return ok
+
+    fw.scheduler.apply_admission = apply_admission
+    rnd = random.Random(seed)
+    seq = [0]
+
+    def burst(n, measured):
+        docs = make_docs(n, seq[0], "adm")
+        seq[0] += n
+        t_sub = time.perf_counter()
+        wls = serialization.decode_workload_batch(docs)
+        created = store.create_batch(KIND_WORKLOAD, wls)
+        if measured:
+            for wl in created:
+                submit_t[wl.key] = t_sub
+        fw.microtick()
+
+    for _ in range(6):          # warmup: compile the micro buckets
+        burst(rnd.randrange(2, 9), measured=False)
+    n_bursts = 40
+    for _ in range(n_bursts):
+        burst(rnd.randrange(2, 9), measured=True)
+        # Completion flux keeps quota free and the store bounded.
+        for wl in list(fw.workloads.values()):
+            if wl.is_admitted and not wl.is_finished:
+                fw.finish(wl)
+                fw.delete_workload(wl)
+    lat_ms = [(admit_t[k] - t_sub) * 1000.0
+              for k, t_sub in submit_t.items() if k in admit_t]
+    if len(lat_ms) < n_bursts:
+        raise RuntimeError(
+            f"[{label}] only {len(lat_ms)} submit->admitted samples — "
+            "the batch lane's arrivals are not reaching admission")
+    p50_adm = _pctl(lat_ms, 50)
+    p99_adm = _pctl(lat_ms, 99)
+    if strict_gate and p99_adm >= 100.0:
+        raise RuntimeError(
+            f"[{label}] submit->admitted p99 {p99_adm:.2f}ms breaches "
+            "the 100ms ingest-plane bound")
+
+    # -- phase 3: mid-window rejoin drill ---------------------------------
+    old_floor = os.environ.get("KUEUE_TPU_SNAPSHOT_BOOT_FLOOR")
+    os.environ["KUEUE_TPU_SNAPSHOT_BOOT_FLOOR"] = "16"
+    try:
+        with tempfile.TemporaryDirectory() as td:
+            rt = ReplicaRuntime(2, spawn=False, engine="host",
+                                transport="pipe", per_host=True,
+                                state_dir=td)
+            try:
+                rt.create_resource_flavor(ResourceFlavor.make("flavor-0"))
+                for i in range(6):
+                    rt.create_cluster_queue(ClusterQueue(
+                        name=f"rj-cq-{i}", resource_groups=(ResourceGroup(
+                            ("cpu",),
+                            (FlavorQuotas.make("flavor-0", cpu=8),)),)))
+                    rt.create_local_queue(LocalQueue(
+                        name=f"rj-lq-{i}", namespace="default",
+                        cluster_queue=f"rj-cq-{i}"))
+                # Churn history: submitted + finished + deleted workloads
+                # leave journal lines but no live state, so the shipped
+                # snapshot must be a small fraction of the history.
+                n_churn = 120
+                for r in range(4):
+                    pairs = []
+                    for i in range(r * (n_churn // 4),
+                                   (r + 1) * (n_churn // 4)):
+                        rt.submit(Workload(
+                            name=f"rj-{i}", namespace="default",
+                            queue_name=f"rj-lq-{i % 6}",
+                            creation_time=float(i),
+                            pod_sets=[PodSet.make("ps0", count=1, cpu=1)]))
+                        pairs.append((f"default/rj-{i}", f"rj-cq-{i % 6}"))
+                    rt.tick()
+                    rt.finish_many(pairs)
+                    rt.tick()
+                victim = rt.group_owner[min(rt.group_owner)]
+                rt.kill_replica(victim)
+                t = time.perf_counter()
+                rt.tick()       # detects the death, adopts via snapshot
+                bootstrap_seconds = time.perf_counter() - t
+                evidence = rt.bootstrap_evidence
+                rt.tick()       # the adopter keeps scheduling
+            finally:
+                rt.close()
+    finally:
+        if old_floor is None:
+            os.environ.pop("KUEUE_TPU_SNAPSHOT_BOOT_FLOOR", None)
+        else:
+            os.environ["KUEUE_TPU_SNAPSHOT_BOOT_FLOOR"] = old_floor
+    if not evidence or not evidence.get("snapshot"):
+        raise RuntimeError(
+            f"[{label}] rejoin drill did not bootstrap from a shipped "
+            f"snapshot (evidence: {evidence}) — the O(live-state) "
+            "takeover path is not engaging")
+    history = evidence["history_lines"]
+    replay_lines = evidence["lines"]
+    if history <= 0 or replay_lines >= 0.10 * history:
+        raise RuntimeError(
+            f"[{label}] rejoin replayed {replay_lines} of "
+            f"{history} journal lines (>= 10%) — snapshot shipping is "
+            "not compacting the bootstrap")
+
+    import jax
+    from kueue_tpu.utils.envinfo import environment_block
+
+    stats = {
+        "backend": jax.default_backend(),
+        "environment": environment_block(),
+        "submit_to_admitted_p99_ms": round(p99_adm, 3),
+        "submit_to_admitted_p50_ms": round(p50_adm, 3),
+        "admitted_samples": len(lat_ms),
+        "ingest_qps_sustained": round(qps_batch, 1),
+        "ingest_qps_per_object": round(qps_base, 1),
+        "ingest_batch_vs_per_object": round(ratio, 2)
+        if ratio is not None else None,
+        "ingest_batch_size": batch_size,
+        "ingest_total_submits": total_submits,
+        "ingest_rss_growth_mb": round(rss_growth, 1),
+        "bootstrap_replay_lines": replay_lines,
+        "bootstrap_history_lines": history,
+        "bootstrap_snapshot": bool(evidence.get("snapshot")),
+        "bootstrap_seconds": round(bootstrap_seconds, 3),
+        "strict_gate": bool(strict_gate),
+        "peak_rss_mb": round(_rss_mb(), 1),
+    }
+    print(
+        f"# [{label}] {num_cqs} CQs, {total_submits} submits (batch "
+        f"{batch_size}), setup {t_setup:.1f}s\n"
+        f"# [{label}] ingest: batch {qps_batch:,.0f}/s vs per-object "
+        f"{qps_base:,.0f}/s ({ratio:.1f}x)  submit->admitted p50 "
+        f"{p50_adm:.2f}ms p99 {p99_adm:.2f}ms\n"
+        f"# [{label}] rejoin: {replay_lines}/{history} lines replayed "
+        f"({100.0 * replay_lines / history:.1f}% of history) in "
+        f"{bootstrap_seconds * 1000:.0f}ms",
+        file=sys.stderr)
+    return stats
+
+
 METRIC_NAMES = {
     "single": "p99_single_cq_tick_ms",
     "cohortlend": "p99_cohort_lending_tick_ms",
@@ -879,6 +1183,7 @@ METRIC_NAMES = {
     "multihost": "p99_multihost_tick_ms",
     "hetero": "p99_hetero_tick_ms",
     "microtick": "p99_microtick_admit_ms",
+    "ingest": "submit_to_admitted_p99_ms",
     "northstar": "p99_e2e_tick_ms",
 }
 
@@ -2061,6 +2366,27 @@ def run_one(config: str) -> None:
         }
         line.update(stats)
         print(json.dumps(line), flush=True)
+    elif config == "ingest":
+        # The million-user ingest plane: sustained-QPS submission window
+        # over the batch lane vs the per-object lane, submit->admitted
+        # micro-latency through dirty-cohort micro-ticks, and a
+        # mid-window rejoin drill bootstrapping from a shipped snapshot.
+        if smoke:
+            ishape = dict(num_cqs=32, total_submits=6_000, batch_size=256)
+        else:
+            ishape = dict(num_cqs=256, total_submits=60_000,
+                          batch_size=512)
+        stats = run_ingest_config(label="ingest", strict_gate=not smoke,
+                                  **ishape)
+        p99i = stats["submit_to_admitted_p99_ms"]
+        line = {
+            "metric": METRIC_NAMES[config], "value": p99i, "unit": "ms",
+            # Recorded ratio: how much faster the batch ingest lane
+            # sustains submissions than the per-object lane it replaces.
+            "vs_baseline": stats["ingest_batch_vs_per_object"],
+        }
+        line.update(stats)
+        print(json.dumps(line), flush=True)
     else:
         # North-star headline (config #5 shape): LAST line = parsed metric.
         emit(METRIC_NAMES["northstar"], run_config(
@@ -2101,8 +2427,8 @@ def main() -> None:
               "backend for this run", file=sys.stderr)
         env_extra["KUEUE_BENCH_FORCE_CPU"] = "1"
     for config in ("single", "cohortlend", "preempt", "fair", "topo",
-                   "steady", "shard", "hetero", "microtick", "replica",
-                   "multihost", "northstar"):
+                   "steady", "shard", "hetero", "microtick", "ingest",
+                   "replica", "multihost", "northstar"):
         env = dict(os.environ, KUEUE_BENCH_CONFIG=config, **env_extra)
         # Generous ceiling: a healthy config finishes in minutes; a
         # device attachment dying MID-RUN (after the probe passed)

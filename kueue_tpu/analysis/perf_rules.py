@@ -27,6 +27,16 @@ Whole-array reads OUTSIDE loops (fancy indexing, reductions) and
 `.tolist()` materializations iterated as plain lists are the sanctioned
 patterns and stay unflagged — the decode fallback's fill loop walks
 `tolist()`ed columns precisely so each tensor is touched once.
+
+The ingest plane extends the roster to `controllers/store.py` and
+`server/`: a `for` loop over a batch payload whose body calls the
+per-object ingest surface (`.create(...)`, `.submit(...)`,
+`decode(...)`/`decode_workload(...)`) re-creates the decode→webhook→
+sink fan-out the batch lane (`Store.create_batch` /
+`Framework.submit_batch` / `decode_workload_batch`) exists to collapse
+— one validation sweep and one dirty-event flush per burst, not per
+object. Kill-switch twins keep the loop on purpose and carry an
+explanatory suppression.
 """
 
 from __future__ import annotations
@@ -38,7 +48,15 @@ from kueue_tpu.analysis.core import (
     AnalysisContext, Rule, Severity, SourceFile, finding, register)
 
 _PERF_PATHS = ("scheduler/", "solver/", "models/", "core/cache.py",
-               "core/snapshot.py", "hetero/referee.py", "fixtures/lint/")
+               "core/snapshot.py", "hetero/referee.py",
+               "controllers/store.py", "server/", "fixtures/lint/")
+
+# The per-object ingest surface: calling any of these once per element
+# of a batch payload is the decode→webhook→sink fan-out shape the batch
+# lane collapses. Only checked in the ingest files (store/server) so the
+# solver packages' unrelated `.submit(...)` idioms stay unflagged.
+_INGEST_PATHS = ("controllers/store.py", "server/", "fixtures/lint/")
+_INGEST_CALLS = {"create", "submit", "decode", "decode_workload"}
 
 # Per-CQ share functions whose dict-walk cost makes a Python loop around
 # them the fair-path hot-spot shape (the KEP-1714 victim-search loop).
@@ -68,6 +86,8 @@ def _loop_target_names(target: ast.expr) -> Set[str]:
 
 
 def _check_perf01(f: SourceFile, ctx: AnalysisContext):
+    if any(frag in f.display_path for frag in _INGEST_PATHS):
+        yield from _check_ingest_loops(f)
     for func in ast.walk(f.tree):
         if not isinstance(func, (ast.FunctionDef, ast.AsyncFunctionDef)):
             continue
@@ -151,6 +171,29 @@ def _check_perf01(f: SourceFile, ctx: AnalysisContext):
                         "the vectorized tensors (models/fair_share."
                         "FairShareState / ops/fair_preempt share-without-"
                         "victim broadcast) and compare arrays instead")
+
+
+def _check_ingest_loops(f: SourceFile):
+    """Per-object ingest loop over a batch payload (store/server only):
+    a `for` body calling .create()/.submit()/decode()/decode_workload()
+    once per element instead of the batch lane's one-pass sweep."""
+    for loop in ast.walk(f.tree):
+        if not isinstance(loop, ast.For):
+            continue
+        for call in ast.walk(loop):
+            if not isinstance(call, ast.Call):
+                continue
+            fn = call.func
+            name = fn.id if isinstance(fn, ast.Name) else (
+                fn.attr if isinstance(fn, ast.Attribute) else None)
+            if name in _INGEST_CALLS:
+                yield finding(
+                    PERF01, f, call,
+                    f"per-object {name}() inside a Python loop over a "
+                    "batch payload — use the batch ingest lane "
+                    "(Store.create_batch / Framework.submit_batch / "
+                    "decode_workload_batch): one validation sweep and "
+                    "one dirty-event flush per burst, not per object")
 
 
 PERF01 = register(Rule(

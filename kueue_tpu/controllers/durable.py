@@ -77,6 +77,13 @@ class Journal:
         self.write_errors = 0
         self.replay_skipped = 0
         self.torn_tail_recovered = 0
+        # Bootstrap evidence: journal lines applied by _replay on attach
+        # (the rejoin-cost number the snapshot-shipping path minimizes).
+        self.replayed_lines = 0
+        # Deferred-sync bookkeeping (_record_batch): an injected fsync
+        # failure drawn mid-batch surfaces at the batch flush, exactly
+        # like a real one would.
+        self._pending_fsync_error: Optional[OSError] = None
         # Replication tap (transport/replication.py): every recorded
         # line is mirrored as ("append", line), every compaction as
         # ("reset", [lines]) — the multi-host runtime ships these
@@ -120,7 +127,8 @@ class Journal:
         restored = self._replay(store)
         self._compact(store)
         for kind in KIND_ORDER:
-            store.watch(kind, self._record, send_initial=False)
+            store.watch(kind, self._record, send_initial=False,
+                        batch=self._record_batch)
         return restored
 
     def _replay(self, store: Store) -> int:
@@ -161,6 +169,7 @@ class Journal:
                       file=sys.stderr, flush=True)
                 continue
             self._apply(store, entry)
+            self.replayed_lines += 1
         if torn_at is not None:
             # The crash-mid-append artifact: the record was never
             # acknowledged, so dropping it is correct — and truncating
@@ -227,9 +236,70 @@ class Journal:
                         # at the next threshold crossing.
                         self._note_write_error(exc, reason="compact")
 
-    def _append_locked(self, line: str) -> None:
+    def _record_batch(self, events) -> None:
+        """Batched recording (Store.create_batch): encode every line
+        first, then ONE lock acquisition, buffered appends, and one
+        flush/fsync for the whole burst instead of per line. The
+        per-line fault draw (_append_locked) is unchanged — a seeded
+        fault plan injects at the same records either way — and error
+        handling stays per line: a failed record is lost and counted,
+        the rest of the batch still lands."""
+        lines = []
+        for ev in events:
+            entry = {"type": ev.type, "kind": ev.kind, "key": ev.key}
+            if ev.type != DELETED:
+                entry["object"] = serialization.encode(ev.kind, ev.obj)
+            lines.append(json.dumps(entry, separators=(",", ":")))
+        with TRACER.lock(self._lock, "journal.lock_wait"):
+            appended = False
+            for line in lines:
+                try:
+                    self._append_locked(line, sync=False)
+                except OSError as exc:
+                    self._dirty_tail = True
+                    self._note_write_error(exc)
+                    continue
+                appended = True
+                self._lines += 1
+                if self.sink is not None:
+                    self.sink(("append", line))
+            if appended:
+                self._flush_locked()
+            if self._lines >= COMPACT_MIN_LINES and self._store is not None:
+                live = sum(len(self._store.list(k)) for k in KIND_ORDER)
+                if live * 2 < self._lines:
+                    try:
+                        self._compact_locked(self._store)
+                    except OSError as exc:
+                        self._note_write_error(exc, reason="compact")
+
+    def _flush_locked(self) -> None:
+        """One flush (and fsync, when configured) for a whole batch; a
+        deferred injected fsync failure surfaces here."""
+        if self._file is None:
+            return
+        try:
+            self._file.flush()
+        except OSError as exc:
+            self._dirty_tail = True
+            self._note_write_error(exc)
+            return
+        if self.fsync:
+            with TRACER.span("journal.fsync"):
+                err, self._pending_fsync_error = \
+                    self._pending_fsync_error, None
+                try:
+                    if err is not None:
+                        raise err
+                    os.fsync(self._file.fileno())
+                except OSError as exc:
+                    self._note_write_error(exc, reason="fsync")
+
+    def _append_locked(self, line: str, sync: bool = True) -> None:
         """One fault-injectable append. Caller holds _lock; raises
-        OSError when the record did not (completely) land."""
+        OSError when the record did not (completely) land. sync=False
+        (the batch path) buffers the write and defers flush/fsync to
+        _flush_locked — one disk round trip per burst."""
         from kueue_tpu.controllers import diskfaults
 
         if self._file is None:
@@ -253,19 +323,22 @@ class Journal:
                 raise diskfaults.TornWrite(
                     f"torn write after {len(prefix)} bytes (injected)")
             self._file.write(line + "\n")
-            self._file.flush()
-            if self.fsync:
-                with TRACER.span("journal.fsync"):
-                    try:
-                        if action == diskfaults.FSYNC:
-                            raise injector.fsync_error()
-                        os.fsync(self._file.fileno())
-                    except OSError as exc:
-                        # The data write landed; only this record's
-                        # DURABILITY is unknown. Count it, keep it —
-                        # replay's complete/torn distinction absorbs
-                        # whichever way the disk went.
-                        self._note_write_error(exc, reason="fsync")
+            if sync:
+                self._file.flush()
+                if self.fsync:
+                    with TRACER.span("journal.fsync"):
+                        try:
+                            if action == diskfaults.FSYNC:
+                                raise injector.fsync_error()
+                            os.fsync(self._file.fileno())
+                        except OSError as exc:
+                            # The data write landed; only this record's
+                            # DURABILITY is unknown. Count it, keep it —
+                            # replay's complete/torn distinction absorbs
+                            # whichever way the disk went.
+                            self._note_write_error(exc, reason="fsync")
+            elif self.fsync and action == diskfaults.FSYNC:
+                self._pending_fsync_error = injector.fsync_error()
             sp.set("bytes", len(line) + 1)
         self._good_offset = self._file.tell()
 

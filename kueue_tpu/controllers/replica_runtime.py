@@ -882,15 +882,21 @@ class ReplicaWorker:
         the parent out of the per-workload serialization business)."""
         from kueue_tpu.api.types import PodSet, Workload
 
-        for s in specs:
-            wl = Workload(
-                name=s["name"], namespace=s.get("namespace", "default"),
-                queue_name=s["queue"], priority=s.get("priority", 0),
-                creation_time=s["creation_time"],
-                pod_sets=[PodSet.make(
-                    "ps0", count=s.get("count", 1), cpu=s.get("cpu", 1),
-                    memory=f"{s.get('memory_gi', 1)}Gi")])
-            self.fw.submit(wl)
+        wls = [Workload(
+            name=s["name"], namespace=s.get("namespace", "default"),
+            queue_name=s["queue"], priority=s.get("priority", 0),
+            creation_time=s["creation_time"],
+            pod_sets=[PodSet.make(
+                "ps0", count=s.get("count", 1), cpu=s.get("cpu", 1),
+                memory=f"{s.get('memory_gi', 1)}Gi")])
+            for s in specs]
+        if knobs.flag("KUEUE_TPU_NO_BATCH_INGEST"):
+            for wl in wls:  # kill-switch twin of the batch lane
+                self.fw.submit(wl)
+            return
+        # Specs were built from trusted tuples above; validate=False is
+        # the bulk-ingest lane submit() itself documents.
+        self.fw.submit_batch(wls, validate=False)
 
     def _finish(self, key: str, delete: bool) -> None:
         wl = self.fw.workloads.get(key)
@@ -1032,11 +1038,16 @@ class ReplicaWorker:
             # Per-host fail-over/migration: seed THIS host's local
             # journal from the coordinator's replicated copy, then
             # attach-replay it like any restart.
-            os.makedirs(os.path.dirname(journal_path) or ".",
-                        exist_ok=True)
-            with open(journal_path, "w", encoding="utf-8") as f:
-                for line in seed["lines"]:
-                    f.write(line + "\n")
+            try:
+                self._write_seed(journal_path, seed)
+            except OSError as exc:
+                # A snapshot seed that did not land whole must NOT be
+                # attach-replayed — truncation machinery would silently
+                # drop live objects. Report; the parent falls back to
+                # shipping raw history (lossless).
+                self.chan.send(
+                    ("adopt_err", gid, f"snapshot-write-torn: {exc}"))
+                return
         try:
             restored = self.add_group(gid, journal_path)
         except RuntimeError as exc:
@@ -1050,6 +1061,49 @@ class ReplicaWorker:
             self._apply_batch([(gid, e) for e in seed["entries"]])
             restored += len(seed["entries"])
         self.chan.send(("adopted", gid, restored))
+
+    def _write_seed(self, journal_path: str, seed: dict) -> None:
+        """Write the shipped seed lines into this host's journal file.
+
+        Snapshot seeds get the extra care raw-history seeds do not need:
+        a compacted snapshot has NO redundancy, so a torn or short write
+        here silently loses live objects that raw replay would have
+        recovered. The write is therefore (a) fault-injectable via
+        KUEUE_TPU_SNAPSHOT_BOOT_FAULTS — the lattice's torn-snapshot
+        drill arms it — and (b) read back and verified line-for-line
+        before attach is allowed to replay it."""
+        from kueue_tpu.controllers import diskfaults
+
+        os.makedirs(os.path.dirname(journal_path) or ".", exist_ok=True)
+        lines = seed["lines"]
+        snapshot = bool((seed.get("bootstrap") or {}).get("snapshot"))
+        injector = None
+        if snapshot:
+            plan = diskfaults.parse_disk_fault_env(
+                knobs.raw("KUEUE_TPU_SNAPSHOT_BOOT_FAULTS"))
+            if plan is not None:
+                injector = plan.injector(journal_path)
+        with open(journal_path, "w", encoding="utf-8") as f:
+            for line in lines:
+                data = line + "\n"
+                if injector is not None:
+                    action = injector.next_action()
+                    if action == diskfaults.ENOSPC:
+                        raise injector.enospc_error()
+                    if action == diskfaults.TORN:
+                        f.write(data[:injector.torn_prefix_len(len(data))])
+                        f.flush()
+                        raise diskfaults.TornWrite(
+                            f"torn snapshot seed write: {journal_path}")
+                f.write(data)
+            f.flush()
+        if snapshot:
+            with open(journal_path, "r", encoding="utf-8") as f:
+                written = [ln.rstrip("\n") for ln in f if ln.strip()]
+            if written != list(lines):
+                raise OSError(
+                    f"snapshot seed verification failed: wrote "
+                    f"{len(lines)} lines, read back {len(written)}")
 
     def _synth(self, kw: dict) -> dict:
         """Generate this worker's slice of a synthetic cluster LOCALLY
@@ -1308,6 +1362,12 @@ class _WorkerHandle:
         self.remote = False
         self.host_id = opts.get("host_id") or f"host-{wid}"
         self.pid: Optional[int] = None
+        # Parent-side sends come from the runtime lock AND the watch
+        # fan-out writer threads; a mp.Pipe connection is not safe for
+        # concurrent writers, so every send serializes here (queue and
+        # socket transports lock internally — this is belt-and-braces
+        # for them, load-bearing for pipes).
+        self._send_lock = threading.Lock()
         # True once a worker_error message arrived: the worker CRASHED
         # with a real exception — the watchdog must report that, not a
         # "stall" (the loopback thread may still be microseconds from
@@ -1390,10 +1450,12 @@ class _WorkerHandle:
         self.chan = chan
         self.proc = None
         self.thread = None
+        self._send_lock = threading.Lock()
         return self
 
     def send(self, msg) -> None:
-        self.chan.send(msg)
+        with self._send_lock:
+            self.chan.send(msg)
 
     def recv(self, timeout: Optional[float] = None):
         try:
@@ -1642,6 +1704,15 @@ class ReplicaRuntime:
         self._coord_kill_pending = False
         self.failover_evidence: Optional[dict] = None
         self.degraded_evidence: Optional[dict] = None
+        # Rejoin-cost evidence from the last snapshot-shipped adoption
+        # (history_lines vs shipped lines; reconcile_info surfaces it).
+        self.bootstrap_evidence: Optional[dict] = None
+        # Sharded watch fan-out (submit_fanout): per-worker writer
+        # queues + threads, created lazily per wid. Encode+send of a
+        # submission burst leave the caller's lock; flush_fanout() is
+        # the ordering barrier before any synchronous send.
+        self._fanout_queues: Dict[int, "queue.Queue"] = {}
+        self._fanout_threads: Dict[int, threading.Thread] = {}
         if remote:
             self._await_joins(replicas, join_timeout)
         # Set by ReplicaStoreBridge: the parent deployment's read-surface
@@ -2033,6 +2104,82 @@ class ReplicaRuntime:
         self.wl_group[wl.key] = gid
         self._send_group(gid, KIND_WORKLOAD, wl)
 
+    def submit_fanout(self, wls) -> None:
+        """Sharded watch fan-out for a submission burst: route every
+        workload under ONE lock acquisition, then hand each owner's
+        slice to that owner's dedicated writer queue — encode + channel
+        write happen on per-worker threads, so the parent Store's watch
+        stream never serializes N workers' sockets through this lock.
+        flush_fanout() is the ordering barrier before any synchronous
+        send (tick, finish, adopt) to the same workers."""
+        if knobs.flag("KUEUE_TPU_NO_BATCH_INGEST"):
+            for wl in wls:  # kill-switch twin of the fan-out lane
+                self.submit(wl)
+            return
+        by_wid: Dict[int, list] = {}
+        with self._lock:
+            for wl in wls:
+                lq_key = f"{wl.namespace}/{wl.queue_name}"
+                cq = self.gmap.lq_cq.get(lq_key)
+                if cq is None:
+                    self.pen.setdefault(lq_key, []).append(
+                        (KIND_WORKLOAD, wl))
+                    continue
+                gid = self.gmap.cq_group.get(cq)
+                if gid is None:
+                    gid = self.gmap.place_cq(cq, None)
+                self.wl_group[wl.key] = gid
+                wid = self.group_owner.get(gid)
+                if wid is None or not self.workers[wid].alive:
+                    continue  # reassigned at the next barrier, like submit
+                by_wid.setdefault(wid, []).append((gid, wl))
+            for wid, items in by_wid.items():
+                self._fanout_queue(wid).put(items)
+
+    def _fanout_queue(self, wid: int) -> "queue.Queue":
+        # Callers hold self._lock, so lazy creation never races.
+        q = self._fanout_queues.get(wid)
+        if q is None:
+            q = self._fanout_queues[wid] = queue.Queue()
+            t = threading.Thread(
+                target=self._fanout_run, args=(wid, q),
+                name=f"watch-fanout-{wid}", daemon=True)
+            self._fanout_threads[wid] = t
+            t.start()
+        return q
+
+    def _fanout_run(self, wid: int, q: "queue.Queue") -> None:
+        while True:
+            items = q.get()
+            try:
+                if items is None:
+                    return
+                batch = [(gid, self._entry(KIND_WORKLOAD, wl))
+                         for gid, wl in items]
+                w = self.workers[wid]
+                if w.alive:
+                    try:
+                        w.send(("objs", batch))
+                    except Exception as exc:
+                        # Worker death surfaces at the next barrier; the
+                        # writer thread must outlive a dead socket or
+                        # every future flush_fanout() wedges on join().
+                        import sys
+
+                        print(f"kueue-tpu: watch fan-out to replica "
+                              f"{wid} failed: {exc!r}", file=sys.stderr,
+                              flush=True)
+            finally:
+                q.task_done()
+
+    def flush_fanout(self) -> None:
+        """Barrier: every burst handed to the writer threads is encoded
+        and on the wire. Per-worker channel bytes stay ordered because
+        each worker has exactly one writer thread and synchronous sends
+        flush first."""
+        for q in list(self._fanout_queues.values()):
+            q.join()
+
     def finish(self, key: str, cq: Optional[str] = None,
                delete: bool = True) -> None:
         gid = self.wl_group.pop(key, None)
@@ -2265,6 +2412,10 @@ class ReplicaRuntime:
         from kueue_tpu.tracing import TRACER
 
         with self._lock:
+            # Ordering barrier: every fan-out burst must be on the wire
+            # before the tick message (new bursts can't start — routing
+            # needs this lock).
+            self.flush_fanout()
             empty = {"admitted": [], "preempted": [], "n": 0,
                      "revocations": 0, "rtt": [], "rss": _rss_bytes(),
                      "tick_s": [], "stalls": [], "dispatches": 0,
@@ -2422,10 +2573,42 @@ class ReplicaRuntime:
             if released is not None:
                 # The owner's final unshipped segments land first.
                 self.replicator.submit(gid, released.get("ops") or [])
+            if not knobs.flag("KUEUE_TPU_NO_SNAPSHOT_BOOT"):
+                # Snapshot shipping: compact the replicated history to
+                # live state so the adopter replays O(live-state), not
+                # O(history). The kill switch (and any build failure
+                # inside bootstrap_lines) falls back to raw lines.
+                floor = int(
+                    knobs.raw("KUEUE_TPU_SNAPSHOT_BOOT_FLOOR") or 256)
+                lines, meta = self.replicator.bootstrap_lines(
+                    gid, floor=floor)
+                self.bootstrap_evidence = {**meta, "gid": gid}
+                return path, {"lines": lines, "bootstrap": meta}
             return path, {"lines": self.replicator.read_lines(gid)}
         if path is None and released is not None:
             return None, {"entries": released.get("entries") or []}
         return path, None
+
+    def _adopt_exchange(self, target, gid: int, path, seed):
+        """One adopt round-trip with the torn-snapshot fallback: when a
+        shipped SNAPSHOT seed fails the adopter's write verification
+        (disk fault on the seed write), retry immediately with the raw
+        replicated history — raw lines replay through the journal's
+        torn/corrupt recovery, so the fallback is lossless. Raises
+        WorkerDied like a bare recv would."""
+        target.send(("adopt", gid, path, seed))
+        msg = target.recv(timeout=self.round_timeout)
+        if (msg[0] == "adopt_err" and self.replicator is not None
+                and "snapshot-write-torn" in str(msg[2])):
+            fallback = self.replicator.read_lines(gid)
+            if self.bootstrap_evidence is not None \
+                    and self.bootstrap_evidence.get("gid") == gid:
+                self.bootstrap_evidence["torn_fallback"] = True
+                self.bootstrap_evidence["snapshot"] = False
+                self.bootstrap_evidence["lines"] = len(fallback)
+            target.send(("adopt", gid, path, {"lines": fallback}))
+            msg = target.recv(timeout=self.round_timeout)
+        return msg
 
     def _reassign_dead(self) -> None:
         # Re-entrant: tick() already holds the lock; the RLock makes
@@ -2445,9 +2628,8 @@ class ReplicaRuntime:
                 continue
             target = survivors[0]
             path, seed = self._adopt_seed(gid, target.wid)
-            target.send(("adopt", gid, path, seed))
             try:
-                msg = target.recv(timeout=self.round_timeout)
+                msg = self._adopt_exchange(target, gid, path, seed)
             except WorkerDied:
                 target.alive = False
                 return
@@ -2572,9 +2754,8 @@ class ReplicaRuntime:
                 except WorkerDied:
                     owner.alive = False
             path, seed = self._adopt_seed(gid, to_wid, released=released)
-            target.send(("adopt", gid, path, seed))
             try:
-                msg = target.recv(timeout=self.round_timeout)
+                msg = self._adopt_exchange(target, gid, path, seed)
             except WorkerDied:
                 target.alive = False
                 msg = ("adopt_err", gid, "target died")
@@ -2591,9 +2772,9 @@ class ReplicaRuntime:
                                    if self.replicator is None else None)
                     rb_path, rb_seed = self._adopt_seed(
                         gid, from_wid, released=rb_released)
-                    owner.send(("adopt", gid, rb_path, rb_seed))
                     try:
-                        rb = owner.recv(timeout=self.round_timeout)
+                        rb = self._adopt_exchange(
+                            owner, gid, rb_path, rb_seed)
                         if rb[0] != "adopted":
                             raise WorkerDied(f"rollback failed: {rb!r}")
                     except WorkerDied as exc:
@@ -2673,6 +2854,8 @@ class ReplicaRuntime:
             out["degradedWindow"] = {
                 k: v for k, v in self.degraded_evidence.items()
                 if k != "reports"}
+        if self.bootstrap_evidence is not None:
+            out["snapshotBootstrap"] = dict(self.bootstrap_evidence)
         return out
 
     # -- introspection -------------------------------------------------------
@@ -2724,6 +2907,14 @@ class ReplicaRuntime:
 
     def close(self) -> None:
         with self._lock:
+            # Drain + retire the fan-out writers first: their sockets
+            # are about to be told to stop.
+            for q in self._fanout_queues.values():
+                q.put(None)
+            for t in self._fanout_threads.values():
+                t.join(timeout=5)
+            self._fanout_queues.clear()
+            self._fanout_threads.clear()
             for w in self.workers:
                 if not w.alive:
                     continue
@@ -2771,7 +2962,14 @@ class ReplicaStoreBridge:
         self.runtime = runtime
         runtime.status_store = store
         for kind in self.KINDS:
-            store.watch(kind, self._on_event)
+            if kind == KIND_WORKLOAD:
+                # Bulk creates deliver one batched callback: ADDED runs
+                # take the sharded fan-out (one routing pass, per-worker
+                # writer threads) instead of N synchronous sends.
+                store.watch(kind, self._on_event,
+                            batch=self._on_workload_batch)
+            else:
+                store.watch(kind, self._on_event)
 
     def _on_event(self, ev) -> None:
         if self.runtime._applying_status == threading.get_ident():
@@ -2781,4 +2979,30 @@ class ReplicaStoreBridge:
             # Other threads' writes (an HTTP create landing mid-mirror)
             # route normally.
             return
+        # A synchronous route must observe every fan-out burst already
+        # on the wire (cheap no-op when the writer queues are idle).
+        self.runtime.flush_fanout()
         self.runtime.apply_event(ev.kind, ev.type, ev.obj, key=ev.key)
+
+    def _on_workload_batch(self, events) -> None:
+        if self.runtime._applying_status == threading.get_ident():
+            return
+        run: List[object] = []
+
+        def flush():
+            if run:
+                self.runtime.submit_fanout(run)
+                run.clear()
+
+        for ev in events:
+            if ev.type == ADDED:
+                run.append(ev.obj)
+            else:
+                # MODIFIED/DELETED must observe every prior ADDED on the
+                # worker before they route: drain the fan-out, then go
+                # synchronous.
+                flush()
+                self.runtime.flush_fanout()
+                self.runtime.apply_event(ev.kind, ev.type, ev.obj,
+                                         key=ev.key)
+        flush()

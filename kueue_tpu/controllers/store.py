@@ -86,6 +86,20 @@ def _obj_key(kind: str, obj) -> str:
     return f"{getattr(obj, 'namespace', 'default')}/{obj.name}"
 
 
+def _workload_validation_equal(a: Workload, b: Workload) -> bool:
+    """True when validate_workload(a) provably returns validate_workload(b)'s
+    verdict: equal on every field the validator reads (pod_sets, queue_name,
+    priority_class) and free of status state — status-bearing workloads
+    (admission internals, reclaimable counts) always take the full check."""
+    for wl in (a, b):
+        if wl.conditions or wl.admission is not None or wl.reclaimable_pods \
+                or wl.admission_check_states or wl.requeue_state is not None:
+            return False
+    return (a.queue_name == b.queue_name
+            and a.priority_class == b.priority_class
+            and a.pod_sets == b.pod_sets)
+
+
 class Store:
     """Versioned object store with watch fan-out (apiserver analog).
 
@@ -108,8 +122,13 @@ class Store:
         self._docs: Dict[Tuple[str, str], dict] = {}
         self._rv = itertools.count(1)
         self._watchers: Dict[str, List[Callable[[Event], None]]] = {}
+        # Optional batch entry points, keyed by the per-event callback
+        # they accompany: create_batch hands such a watcher the whole
+        # event list in ONE call (one journal lock, one submit sweep)
+        # instead of N per-event calls.
+        self._batch_watchers: Dict[str, Dict[Callable, Callable]] = {}
 
-    def _publish(self, kind: str, key: str, obj) -> None:
+    def _publish(self, kind: str, key: str, obj) -> Optional[dict]:
         from kueue_tpu.api import serialization
         try:
             doc = serialization.encode(kind, obj)
@@ -117,9 +136,10 @@ class Store:
             # Kinds without an encoder stay readable via get()/list().
             with self._docs_lock:
                 self._docs.pop((kind, key), None)
-            return
+            return None
         with self._docs_lock:
             self._docs[(kind, key)] = doc
+        return doc
 
     def _unpublish(self, kind: str, key: str) -> None:
         with self._docs_lock:
@@ -143,11 +163,16 @@ class Store:
     # -- watch (informer analog) -------------------------------------------
 
     def watch(self, kind: str, callback: Callable[[Event], None],
-              send_initial: bool = True) -> None:
+              send_initial: bool = True,
+              batch: Optional[Callable[[List[Event]], None]] = None) -> None:
         """Register a watcher; existing objects replay as ADDED first
-        (informer initial list-then-watch semantics)."""
+        (informer initial list-then-watch semantics). `batch`, when
+        given, receives a whole create_batch event list in one call
+        instead of `callback` per event — same events, same order."""
         with self._lock:
             self._watchers.setdefault(kind, []).append(callback)
+            if batch is not None:
+                self._batch_watchers.setdefault(kind, {})[callback] = batch
             if send_initial:
                 for key, obj in self._objects.get(kind, {}).items():
                     callback(Event(ADDED, kind, key, obj,
@@ -160,10 +185,21 @@ class Store:
                 self._watchers.get(kind, []).remove(callback)
             except ValueError:
                 pass
+            self._batch_watchers.get(kind, {}).pop(callback, None)
 
     def _notify(self, event: Event) -> None:
         for cb in list(self._watchers.get(event.kind, [])):
             cb(event)
+
+    def _notify_batch(self, kind: str, events: List[Event]) -> None:
+        batch_fns = self._batch_watchers.get(kind, {})
+        for cb in list(self._watchers.get(kind, [])):
+            batch_fn = batch_fns.get(cb)
+            if batch_fn is not None:
+                batch_fn(events)
+            else:
+                for ev in events:
+                    cb(ev)
 
     # -- CRUD (webhooked, like apiserver admission) ------------------------
 
@@ -186,6 +222,76 @@ class Store:
             self._publish(kind, key, obj)
             self._notify(Event(ADDED, kind, key, obj, rv))
             return obj
+
+    def create_batch(self, kind: str, objs) -> List[object]:
+        """Create a burst of objects of one kind in one pass: one lock
+        acquisition, one validation sweep (structurally identical
+        workloads validate once — validation is a pure function of the
+        fields it reads), and one batched watch flush, instead of N
+        decode→webhook→fan-out round trips.
+
+        Error semantics match the per-object loop: on a validation or
+        key-collision failure the already-created prefix stays created
+        (its events still flush) and the error propagates.
+
+        KUEUE_TPU_NO_BATCH_INGEST=1 reverts to N create() calls."""
+        from kueue_tpu import knobs
+        if knobs.flag("KUEUE_TPU_NO_BATCH_INGEST"):
+            out = []
+            for obj in objs:  # the per-object twin, on purpose
+                one = self.create(kind, obj)  # kueuelint: disable=PERF01
+                out.append(one)
+            return out
+        from kueue_tpu.api import serialization
+        out: List[object] = []
+        events: List[Event] = []
+        defaulter = _DEFAULTERS.get(kind)
+        validate, _ = _VALIDATORS.get(kind, (None, None))
+        exemplar = None  # last fully-validated workload (dedup anchor)
+        exemplar_doc = None  # its published doc (encode-clone anchor)
+        with self._lock:
+            try:
+                for obj in objs:
+                    if defaulter is not None:
+                        defaulter(obj)
+                    cloned = False
+                    if validate is not None:
+                        if kind == KIND_WORKLOAD and exemplar is not None \
+                                and _workload_validation_equal(obj, exemplar):
+                            # Equal on every field validate_workload
+                            # reads — the exemplar's (empty) verdict
+                            # stands for this object too.
+                            cloned = True
+                        else:
+                            errs = validate(obj)
+                            if errs:
+                                raise webhooks.ValidationError(errs)
+                            if kind == KIND_WORKLOAD:
+                                exemplar = obj
+                    key = _obj_key(kind, obj)
+                    if key in self._objects.get(kind, {}):
+                        raise ValueError(f"{kind} {key} already exists")
+                    rv = next(self._rv)
+                    self._objects.setdefault(kind, {})[key] = obj
+                    self._versions[(kind, key)] = rv
+                    if cloned and exemplar_doc is not None:
+                        # Validation-equal ⇒ encode-equal on podSets:
+                        # publish a structural clone of the exemplar's
+                        # doc instead of re-encoding the pod sets.
+                        doc = serialization.encode_workload_cloned(
+                            obj, exemplar_doc)
+                        with self._docs_lock:
+                            self._docs[(kind, key)] = doc
+                    else:
+                        doc = self._publish(kind, key, obj)
+                        if kind == KIND_WORKLOAD and obj is exemplar:
+                            exemplar_doc = doc
+                    events.append(Event(ADDED, kind, key, obj, rv))
+                    out.append(obj)
+            finally:
+                if events:
+                    self._notify_batch(kind, events)
+        return out
 
     def update(self, kind: str, obj) -> object:
         with self._lock:
@@ -270,7 +376,8 @@ class StoreAdapter:
         store.watch(KIND_WORKLOAD_PRIORITY_CLASS, self._on_priority_class)
         store.watch(KIND_ADMISSION_CHECK, self._on_admission_check)
         store.watch(KIND_COHORT, self._on_cohort)
-        store.watch(KIND_WORKLOAD, self._on_workload)
+        store.watch(KIND_WORKLOAD, self._on_workload,
+                    batch=self._on_workload_batch)
 
     def _on_flavor(self, ev: Event) -> None:
         if ev.type in (ADDED, MODIFIED):
@@ -344,6 +451,31 @@ class StoreAdapter:
                 self.fw.workloads[ev.key] = ev.obj  # deactivated: record
         elif ev.type == DELETED:
             self.fw.delete_workload(ev.obj)
+
+    def _on_workload_batch(self, events: List[Event]) -> None:
+        """Batched workload fan-out (Store.create_batch): consecutive
+        fresh pending ADDED events funnel through ONE Framework.submit_batch
+        — one queue-manager lock, one dirty mark per cohort — instead of N
+        submit() calls. The store already ran defaulting+validation at
+        create, and validation is a pure check (submit's docstring), so
+        validate=False here is decision-identical to the per-event path.
+        Anything else (restores, MODIFIED, DELETED) flushes the run first
+        and takes the per-event handler, preserving event order."""
+        run: List[Workload] = []
+
+        def flush():
+            if run:
+                self.fw.submit_batch(run, validate=False)
+                run.clear()
+
+        for ev in events:
+            if ev.type == ADDED and not (
+                    ev.obj.has_quota_reservation or ev.obj.is_finished):
+                run.append(ev.obj)
+            else:
+                flush()
+                self._on_workload(ev)
+        flush()
 
     @staticmethod
     def _status_fingerprint(wl: Workload) -> tuple:

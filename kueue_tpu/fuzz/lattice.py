@@ -49,7 +49,11 @@ class LatticePoint:
     shards: int = 1
     replicas: int = 1
     kill_switches: bool = False    # incremental fast paths OFF
-    drill: Optional[str] = None    # None | "failover" | "loan" | "degraded"
+    # None | "failover" | "loan" | "degraded" | "snapshot"
+    # ("snapshot" = the failover kill, but the survivor bootstraps the
+    # dead worker's groups from a shipped compacted snapshot instead of
+    # full line replay — same journal-replay-equivalence oracle)
+    drill: Optional[str] = None
     env: tuple = ()                # extra (key, value) env pairs
     # Dirty-cohort micro-ticks interleaved with the traffic (the
     # event-driven fast path). Micro-ticks intentionally reorder vs the
@@ -182,10 +186,26 @@ def default_lattice(sc: Scenario,
             points.append(LatticePoint(name="failover-journal",
                                        kind="replica", replicas=2,
                                        drill="failover"))
+            # Snapshot-rejoin rides the SAME seeds as the full-replay
+            # drill: both must match the uninterrupted reference, so
+            # snapshot bootstrap == full replay == uninterrupted run.
+            points.append(LatticePoint(
+                name="snapshot-rejoin", kind="replica", replicas=2,
+                drill="snapshot",
+                env=(("KUEUE_TPU_SNAPSHOT_BOOT_FLOOR", "1"),)))
         if sc.seed % 3 == 1:
             points.append(LatticePoint(name="elastic-loan",
                                        kind="replica", replicas=2,
                                        drill="loan"))
+            # Seeded disk faults on the snapshot write: the bootstrap
+            # seed tears mid-write and the adoption must fall back to
+            # line replay with zero records lost (same identity bar).
+            points.append(LatticePoint(
+                name="snapshot-rejoin-torn", kind="replica", replicas=2,
+                drill="snapshot",
+                env=(("KUEUE_TPU_SNAPSHOT_BOOT_FLOOR", "1"),
+                     ("KUEUE_TPU_SNAPSHOT_BOOT_FAULTS",
+                      f"torn_p=1.0,seed={sc.seed}"))))
         if sc.seed % 3 == 2:
             # The rotation's third slot: micro-ticks under the
             # journal-replay drill (a worker killed mid-run; its micro
@@ -580,7 +600,7 @@ def _drive_replica(sc: Scenario, point: LatticePoint,
     from kueue_tpu.controllers.store import KIND_CLUSTER_QUEUE, MODIFIED
 
     tmp = None
-    if point.drill == "failover" and state_dir is None:
+    if point.drill in ("failover", "snapshot") and state_dir is None:
         tmp = tempfile.TemporaryDirectory(prefix="kueuefuzz-journal-")
         state_dir = tmp.name
     faults = None
@@ -595,7 +615,12 @@ def _drive_replica(sc: Scenario, point: LatticePoint,
                            drop_prob=0.02 if sc.seed % 3 == 0 else 0.0)
     rt = ReplicaRuntime(
         point.replicas, spawn=False, engine=point.engine,
-        state_dir=state_dir if point.drill == "failover" else None,
+        state_dir=(state_dir if point.drill in ("failover", "snapshot")
+                   else None),
+        # The snapshot drill needs the coordinator-side replicator (the
+        # per-host journal layout), so the adoption seed can come from
+        # bootstrap_lines instead of the dead worker's local file.
+        per_host=True if point.drill == "snapshot" else None,
         transport=point.transport, faults=faults,
         microtick=point.micro,
         degraded_after=(0.8 if point.drill == "degraded" else None),
@@ -657,7 +682,8 @@ def _drive_replica(sc: Scenario, point: LatticePoint,
             if t < sc.ticks:
                 for op in sc.traffic[t] if t < len(sc.traffic) else ():
                     apply_op(op)
-            elif t == sc.ticks and point.drill == "failover":
+            elif t == sc.ticks and point.drill in ("failover",
+                                                   "snapshot"):
                 # Journal-replay equivalence: kill one replica at the
                 # settle boundary; the next tick reassigns its shard
                 # groups to the survivor, which attach-replays their
@@ -718,6 +744,30 @@ def _drive_replica(sc: Scenario, point: LatticePoint,
         final = {name: sorted(keys)
                  for name, keys in (dump.get("admitted") or {}).items()}
         evidence["coordinator"] = rt.coordinator.evidence()
+        if point.drill == "snapshot":
+            boot = rt.bootstrap_evidence
+            if boot is None:
+                # The kill happened but adoption never took the
+                # replicator-seeded path: the snapshot drill was
+                # vacuous — a wiring failure, not a passing run.
+                violations.append({
+                    "oracle": "snapshot-bootstrap",
+                    "tick": sc.ticks,
+                    "detail": "adoption produced no bootstrap evidence "
+                              "(replicator seed path never engaged)"})
+            else:
+                evidence["snapshot_bootstrap"] = dict(boot)
+                torn_armed = any(
+                    k == "KUEUE_TPU_SNAPSHOT_BOOT_FAULTS"
+                    for k, v in point.env)
+                if torn_armed and boot.get("snapshot") \
+                        and not boot.get("torn_fallback"):
+                    violations.append({
+                        "oracle": "snapshot-bootstrap",
+                        "tick": sc.ticks,
+                        "detail": "torn-write faults armed and a "
+                                  "snapshot shipped, but the adoption "
+                                  "never fell back to line replay"})
     finally:
         rt.close()
         if tmp is not None:
@@ -816,7 +866,8 @@ def check_scenario(sc: Scenario,
             div = _first_divergence(ref["trail"], r["trail"],
                                     admitted_only)
             oracle = ("determinism" if p.name.endswith("-repeat")
-                      else "journal" if p.drill == "failover"
+                      else "journal" if p.drill in ("failover",
+                                                    "snapshot")
                       else "loan" if p.drill == "loan"
                       else "identity")
             if div is not None:
@@ -855,7 +906,8 @@ def _event_rollup(points: List[LatticePoint],
     regions (a dimension that never produced a preemption, revocation,
     or micro admission) are visible in every report."""
     ev = {"admitted": 0, "preempted": 0, "micro_admitted": 0,
-          "revocations": 0}
+          "revocations": 0, "snapshot_bootstraps": 0,
+          "torn_fallbacks": 0}
     ref = results.get(points[0].name) if points else None
     if ref is not None:
         for adm, pre in ref["trail"]:
@@ -871,4 +923,9 @@ def _event_rollup(points: List[LatticePoint],
         ev["revocations"] += int(coord.get("revocations") or 0)
         deg = evidence.get("degraded") or {}
         ev["revocations"] += int(deg.get("revocations") or 0)
+        boot = evidence.get("snapshot_bootstrap") or {}
+        if boot.get("snapshot") or boot.get("torn_fallback"):
+            ev["snapshot_bootstraps"] += 1
+        if boot.get("torn_fallback"):
+            ev["torn_fallbacks"] += 1
     return ev

@@ -141,6 +141,15 @@ REGISTRY: Tuple[Knob, ...] = (
          "=0 disables the C++ keyed heap (pure-Python queue ordering); "
          "opt-out, default on.",
          decision=GATE, gates=("queue/manager.py",)),
+    Knob("KUEUE_TPU_NO_BATCH_INGEST", KILL_SWITCH, "", LIVE,
+         "=1 reverts batch ingest to per-object create/submit and "
+         "synchronous watch fan-out.",
+         decision=GATE, gates=("controllers/store.py",
+                               "controllers/replica_runtime.py")),
+    Knob("KUEUE_TPU_NO_SNAPSHOT_BOOT", KILL_SWITCH, "", LIVE,
+         "=1 ships full journal history on rejoin/takeover instead of "
+         "a compacted snapshot.",
+         decision=GATE, gates=("controllers/replica_runtime.py",)),
     # -- debug / test injection --------------------------------------------
     Knob("KUEUE_TPU_TRACE", DEBUG, "", STARTUP,
          "=1 enables span tracing (Chrome trace-event export).",
@@ -179,6 +188,10 @@ REGISTRY: Tuple[Knob, ...] = (
          "Disk-fault plan for the durable journals "
          "(enospc_p=..,fsync_p=..,torn_p=..,seed=..).",
          decision=NEUTRAL),
+    Knob("KUEUE_TPU_SNAPSHOT_BOOT_FAULTS", DEBUG, None, LIVE,
+         "Disk-fault plan armed only on the snapshot-seed write of an "
+         "adopting worker (same format as KUEUE_TPU_DISK_FAULTS).",
+         decision=NEUTRAL),
     # -- tuning -------------------------------------------------------------
     Knob("KUEUE_TPU_REPLICAS", TUNING, "0", STARTUP,
          "Replica count for the multi-process runtime (0/unset = "
@@ -209,6 +222,10 @@ REGISTRY: Tuple[Knob, ...] = (
     Knob("KUEUE_TPU_DURABLE_FSYNC", TUNING, "", STARTUP,
          "=1 fsyncs every journal append (durability over append "
          "latency).",
+         decision=NEUTRAL),
+    Knob("KUEUE_TPU_SNAPSHOT_BOOT_FLOOR", TUNING, "256", LIVE,
+         "Journal-history line count below which a rejoin ships raw "
+         "lines instead of building a snapshot.",
          decision=NEUTRAL),
 )
 

@@ -506,6 +506,35 @@ class Manager:
             self._cond.notify_all()
             return True
 
+    def add_or_update_workloads(self, wls) -> int:
+        """Bulk submit under ONE lock acquisition with one wakeup and one
+        dirty mark per distinct cohort — the micro-tick storm guard: the
+        serve loop polls dirty cohorts at 20ms granularity, so a 10k-burst
+        arriving as per-workload marks would re-trigger micro-tick after
+        micro-tick mid-burst. Returns the routed count (unroutable
+        workloads skip silently, exactly like add_or_update_workload
+        returning False)."""
+        added = 0
+        with TRACER.lock(self._cond, "queue.lock_wait.submit_batch"):
+            dirty: Dict[str, PendingClusterQueue] = {}
+            for wl in wls:
+                cq_name = self.cluster_queue_for(wl)
+                if cq_name is None:
+                    continue
+                cq = self.cluster_queues.get(cq_name)
+                if cq is None:
+                    continue
+                wi = WorkloadInfo(wl, cluster_queue=cq_name)
+                cq.push_or_update(wi)
+                self._note_sinks(wi)
+                dirty[cq.cohort or SOLO_COHORT + cq.name] = cq
+                added += 1
+            for cq in dirty.values():
+                self._mark_dirty(cq, f"submit-batch x{added}")
+            if added:
+                self._cond.notify_all()
+        return added
+
     def delete_workload(self, wl: Workload) -> None:
         with self._cond:
             cq_name = self.cluster_queue_for(wl)

@@ -151,6 +151,54 @@ class JournalReplicator:
         with open(path, "r", encoding="utf-8") as f:
             return [line.rstrip("\n") for line in f if line.strip()]
 
+    def bootstrap_lines(self, gid: int, floor: int = 256):
+        """Snapshot-shipped bootstrap for an adopting worker: compact the
+        replicated history down to live state so the rejoin replays
+        O(live-state) lines, not O(history).
+
+        Returns (lines, meta) where meta records history_lines/lines/
+        snapshot for the rejoin-cost evidence. Histories at or under
+        `floor` ship raw (a snapshot would not pay for itself); so does
+        anything the scratch replay cannot parse — raw lines are the
+        lossless fallback. When a snapshot IS built, it is also pushed
+        through the ("reset", ...) seam so this coordinator replica file
+        compacts to match what was shipped."""
+        lines = self.read_lines(gid)
+        history = len(lines)
+        meta = {"history_lines": history, "lines": history,
+                "snapshot": False}
+        if history <= max(int(floor), 0):
+            return lines, meta
+        import json
+
+        from kueue_tpu.api.serialization import encode as serialization_encode
+        from kueue_tpu.controllers import store as store_mod
+        from kueue_tpu.controllers.durable import KIND_ORDER, Journal
+
+        scratch = store_mod.Store()
+        try:
+            for line in lines:
+                Journal._apply(scratch, json.loads(line))
+        except Exception:
+            # A line the scratch replay cannot digest: ship the raw
+            # history — the adopter's own replay has the torn/corrupt
+            # recovery machinery, this fast path does not.
+            return lines, meta
+        snapshot = []
+        for kind in KIND_ORDER:
+            for obj in scratch.list(kind):
+                entry = {"type": store_mod.ADDED, "kind": kind,
+                         "key": store_mod._obj_key(kind, obj),
+                         "object": serialization_encode(kind, obj)}
+                snapshot.append(json.dumps(entry, separators=(",", ":")))
+        if len(snapshot) >= history:
+            return lines, meta  # no shrink: raw is strictly simpler
+        self.submit(gid, [("reset", snapshot)])
+        self.flush()
+        meta = {"history_lines": history, "lines": len(snapshot),
+                "snapshot": True}
+        return snapshot, meta
+
     def close(self) -> None:
         with self._lock:
             if self._closed:

@@ -1,0 +1,27 @@
+"""PERF01 ingest-loop fixture: per-object ingest calls inside a loop
+over a batch payload — the decode→webhook→sink fan-out shape the batch
+lane collapses."""
+
+
+def ingest_docs(store, fw, serialization, docs):
+    created = []
+    for doc in docs:
+        kind, obj = serialization.decode(doc)  # PERF01: per-object decode
+        created.append(store.create(kind, obj))  # PERF01: per-object create
+    return created
+
+
+def submit_all(fw, workloads):
+    for wl in workloads:
+        fw.submit(wl)  # PERF01: per-object submit
+
+
+def decode_items(items):
+    out = []
+    for doc in items:
+        out.append(decode_workload(doc))  # PERF01: per-object decode
+    return out
+
+
+def decode_workload(doc):
+    return doc

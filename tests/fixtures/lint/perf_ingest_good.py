@@ -1,0 +1,21 @@
+"""PERF01 ingest-loop fixture (clean): the batch lane — one decode
+sweep, one create_batch, one submit_batch — plus a sanctioned
+kill-switch twin carrying an explanatory suppression."""
+
+def ingest_docs(store, fw, serialization, docs):
+    wls = serialization.decode_workload_batch(docs)
+    return store.create_batch("Workload", wls)
+
+
+def submit_all(fw, workloads):
+    fw.submit_batch(list(workloads), validate=False)
+
+
+def kill_switch_twin(store, kind, objs, no_batch_ingest=False):
+    if no_batch_ingest:
+        out = []
+        for obj in objs:  # the per-object twin, on purpose
+            one = store.create(kind, obj)  # kueuelint: disable=PERF01
+            out.append(one)
+        return out
+    return store.create_batch(kind, objs)

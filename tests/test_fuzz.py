@@ -48,9 +48,9 @@ def test_generator_covers_the_draw_space():
 
 def test_lattice_covers_the_required_axes():
     """Acceptance shape: engine x shards {1,2} x replicas {1,2} x one
-    kill-switch set, plus the fail-over / loan / degraded-window drill
-    points and the micro-tick on/off pair on the rotating seed
-    subsets."""
+    kill-switch set, plus the fail-over / loan / degraded-window /
+    snapshot-rejoin drill points and the micro-tick on/off pair on the
+    rotating seed subsets."""
     axes = {"engines": set(), "shards": set(), "replicas": set(),
             "kill": set(), "drills": set(), "micro": set()}
     for s in range(25):
@@ -66,7 +66,7 @@ def test_lattice_covers_the_required_axes():
     assert {1, 2} <= axes["shards"]
     assert {1, 2} <= axes["replicas"]
     assert axes["kill"] == {False, True}
-    assert axes["drills"] == {"failover", "loan", "degraded"}
+    assert axes["drills"] == {"failover", "loan", "degraded", "snapshot"}
     assert axes["micro"] == {False, True}
 
 

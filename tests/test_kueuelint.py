@@ -321,6 +321,32 @@ def test_perf_fair_good_fixture():
     assert run_analysis([str(FIXTURES / "perf_fair_good.py")]) == []
 
 
+def test_perf_ingest_bad_fixture():
+    findings = run_analysis([str(FIXTURES / "perf_ingest_bad.py")])
+    perf = [f for f in findings if f.rule == "PERF01"]
+    # per-object decode + create + submit + decode_workload
+    assert len(perf) == 4
+    assert all("batch ingest lane" in f.message for f in perf)
+    assert all(f.severity.label == "error" for f in perf)
+
+
+def test_perf_ingest_good_fixture():
+    # The kill-switch twin's suppressed loop both stays quiet AND keeps
+    # its suppression live (no W001).
+    assert run_analysis([str(FIXTURES / "perf_ingest_good.py")]) == []
+
+
+def test_perf_ingest_scoped_to_ingest_files(tmp_path):
+    # The same per-object loop outside store/server (a test driver, the
+    # bench harness) is not the ingest rule's business.
+    other = tmp_path / "driver_tool.py"
+    other.write_text(
+        "def drive(fw, wls):\n"
+        "    for wl in wls:\n"
+        "        fw.submit(wl)\n")
+    assert run_analysis([str(other)]) == []
+
+
 def test_perf_rule_scoped_to_solver_packages(tmp_path):
     # The same loop shape OUTSIDE scheduler//solver//models/ (analysis
     # tooling, tests, benchmarks post-processing) is not PERF01's
